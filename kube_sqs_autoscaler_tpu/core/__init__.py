@@ -1,0 +1,23 @@
+"""Core: clock abstraction, pure scaling policy, and the control loop."""
+
+from .clock import Clock, FakeClock, SystemClock
+from .policy import (
+    Gate,
+    PolicyConfig,
+    PolicyState,
+    TickPlan,
+    initial_state,
+    plan_tick,
+)
+
+__all__ = [
+    "Clock",
+    "FakeClock",
+    "SystemClock",
+    "Gate",
+    "PolicyConfig",
+    "PolicyState",
+    "TickPlan",
+    "initial_state",
+    "plan_tick",
+]

@@ -1,0 +1,5 @@
+"""Shared utilities: Go-style durations, logging setup."""
+
+from .duration import format_duration, parse_duration
+
+__all__ = ["parse_duration", "format_duration"]

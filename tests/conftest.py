@@ -1,0 +1,18 @@
+"""Test configuration.
+
+JAX-based workload tests run on a virtual 8-device CPU mesh (no TPU needed):
+the env vars must be set before the first ``import jax`` anywhere in the
+process, which is why they live here at conftest import time.
+
+The controller-side tests (policy/loop/actuator/metrics/cli) import no JAX
+at all — mirroring the layering: the control plane is plain Python.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,54 @@
+"""FakeClock determinism: ordered event delivery, sleep-advance semantics."""
+
+from kube_sqs_autoscaler_tpu.core.clock import Clock, FakeClock, SystemClock
+
+
+def test_sleep_advances_time():
+    clock = FakeClock()
+    clock.sleep(5.0)
+    assert clock.now() == 5.0
+    clock.sleep(0.5)
+    assert clock.now() == 5.5
+    assert clock.sleeps == [5.0, 0.5]
+
+
+def test_scheduled_events_fire_in_order_at_their_instants():
+    clock = FakeClock()
+    seen = []
+    clock.at(3.0, lambda: seen.append(("a", clock.now())))
+    clock.at(1.0, lambda: seen.append(("b", clock.now())))
+    clock.at(1.0, lambda: seen.append(("c", clock.now())))  # FIFO tie-break
+    clock.advance(2.0)
+    assert seen == [("b", 1.0), ("c", 1.0)]
+    clock.advance(2.0)
+    assert seen == [("b", 1.0), ("c", 1.0), ("a", 3.0)]
+    assert clock.now() == 4.0
+
+
+def test_event_scheduled_in_past_fires_on_next_advance():
+    clock = FakeClock(start=10.0)
+    seen = []
+    clock.at(1.0, lambda: seen.append(clock.now()))
+    clock.advance(0.0)
+    assert seen == [10.0]
+
+
+def test_events_can_schedule_events():
+    clock = FakeClock()
+    seen = []
+    clock.at(1.0, lambda: clock.after(1.0, lambda: seen.append(clock.now())))
+    clock.advance(5.0)
+    assert seen == [2.0]
+
+
+def test_protocol_conformance():
+    assert isinstance(SystemClock(), Clock)
+    assert isinstance(FakeClock(), Clock)
+
+
+def test_system_clock_monotonic_and_sleeps():
+    clock = SystemClock()
+    t0 = clock.now()
+    clock.sleep(0.01)
+    assert clock.now() >= t0 + 0.009
+    clock.sleep(-1.0)  # negative sleep is a no-op, not an error

@@ -1,0 +1,56 @@
+"""Go duration grammar parity (utils/duration.py).
+
+The reference accepts every knob as a Go ``time.Duration`` flag
+(``main.go:83-85``); these cases mirror ``time.ParseDuration`` semantics.
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.utils.duration import (
+    DurationError,
+    format_duration,
+    parse_duration,
+)
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("0", 0.0),
+        ("5s", 5.0),
+        ("30s", 30.0),
+        ("10s", 10.0),
+        ("300ms", 0.3),
+        ("1.5h", 5400.0),
+        ("2h45m", 9900.0),
+        ("1m30s", 90.0),
+        ("-1.5h", -5400.0),
+        ("+5s", 5.0),
+        ("100us", 1e-4),
+        ("100µs", 1e-4),
+        ("1000ns", 1e-6),
+        ("1h1m1s", 3661.0),
+        (".5s", 0.5),
+        ("1.s", 1.0),
+    ],
+)
+def test_parse_valid(text, expected):
+    assert parse_duration(text) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("text", ["", "10", "5 s", "s", "1.2.3s", "-", "1d", "5x"])
+def test_parse_invalid(text):
+    with pytest.raises(DurationError):
+        parse_duration(text)
+
+
+@pytest.mark.parametrize("seconds", [0.0, 5.0, 30.0, 90.0, 5400.0, 0.3, 1e-4, 9900.0])
+def test_format_round_trips(seconds):
+    assert parse_duration(format_duration(seconds)) == pytest.approx(seconds)
+
+
+def test_format_examples():
+    assert format_duration(5.0) == "5s"
+    assert format_duration(90.0) == "1m30s"
+    assert format_duration(0.0) == "0s"
+    assert format_duration(3600.0) == "1h"

@@ -1,0 +1,149 @@
+"""Pure-policy tests: every SURVEY.md §2.2-C2 subtlety, exercised directly.
+
+The reference never tests its policy in isolation (it can't — the policy is
+welded to the loop at ``main.go:35-80``); these tests pin the factored-out
+semantics so the loop tests (test_loop.py) only need to cover wiring.
+"""
+
+import random
+
+from kube_sqs_autoscaler_tpu.core.policy import (
+    Gate,
+    PolicyConfig,
+    PolicyState,
+    initial_state,
+    mark_scaled_down,
+    mark_scaled_up,
+    plan_tick,
+)
+
+CFG = PolicyConfig(
+    scale_up_messages=100,
+    scale_down_messages=10,
+    scale_up_cooldown=10.0,
+    scale_down_cooldown=30.0,
+)
+
+COLD = PolicyState(last_scale_up=-1e9, last_scale_down=-1e9)  # cooldowns long past
+
+
+def test_up_threshold_is_inclusive():
+    # main.go:51 `numMessages >= scaleUpMessages`
+    assert plan_tick(100, 0.0, CFG, COLD).up is Gate.FIRE
+    assert plan_tick(99, 0.0, CFG, COLD).up is Gate.IDLE
+    assert plan_tick(101, 0.0, CFG, COLD).up is Gate.FIRE
+
+
+def test_down_threshold_is_inclusive():
+    # main.go:65 `numMessages <= scaleDownMessages`
+    assert plan_tick(10, 0.0, CFG, COLD).down is Gate.FIRE
+    assert plan_tick(11, 0.0, CFG, COLD).down is Gate.IDLE
+    assert plan_tick(9, 0.0, CFG, COLD).down is Gate.FIRE
+
+
+def test_startup_grace_blocks_both_directions():
+    # main.go:37-38: timestamps initialized to now at boot.
+    state = initial_state(0.0)
+    assert plan_tick(1000, 5.0, CFG, state).up is Gate.COOLING
+    assert plan_tick(0, 5.0, CFG, state).down is Gate.COOLING
+    # up grace ends at t=10, down grace at t=30
+    assert plan_tick(1000, 10.0, CFG, state).up is Gate.FIRE
+    assert plan_tick(0, 10.0, CFG, state).down is Gate.COOLING
+    assert plan_tick(0, 30.0, CFG, state).down is Gate.FIRE
+
+
+def test_cooldown_boundary_fires_exactly_at_expiry():
+    # main.go:52: cooling iff last+cool is strictly After(now).
+    state = PolicyState(last_scale_up=0.0, last_scale_down=-1e9)
+    assert plan_tick(100, 9.999, CFG, state).up is Gate.COOLING
+    assert plan_tick(100, 10.0, CFG, state).up is Gate.FIRE
+
+
+def test_cooling_up_skips_down_branch_entirely():
+    # The `continue` at main.go:54: with overlapping thresholds, an up-cooling
+    # tick must not evaluate (let alone fire) the down branch.
+    cfg = PolicyConfig(
+        scale_up_messages=5,
+        scale_down_messages=50,  # overlapping: 5..50 triggers both
+        scale_up_cooldown=10.0,
+        scale_down_cooldown=0.0,
+    )
+    state = PolicyState(last_scale_up=0.0, last_scale_down=-1e9)
+    plan = plan_tick(20, 5.0, cfg, state)
+    assert plan.up is Gate.COOLING
+    assert plan.down is Gate.SKIPPED
+
+
+def test_overlapping_thresholds_can_fire_both_in_one_tick():
+    # main.go:51,65 are `if` + `if`, not `else if`.
+    cfg = PolicyConfig(
+        scale_up_messages=5,
+        scale_down_messages=50,
+        scale_up_cooldown=0.0,
+        scale_down_cooldown=0.0,
+    )
+    plan = plan_tick(20, 100.0, cfg, COLD)
+    assert plan.up is Gate.FIRE
+    assert plan.down is Gate.FIRE
+
+
+def test_idle_band_between_thresholds():
+    plan = plan_tick(50, 0.0, CFG, COLD)
+    assert plan.up is Gate.IDLE
+    assert plan.down is Gate.IDLE
+
+
+def test_mark_helpers_touch_only_their_own_timestamp():
+    state = PolicyState(last_scale_up=1.0, last_scale_down=2.0)
+    up = mark_scaled_up(state, 7.0)
+    assert (up.last_scale_up, up.last_scale_down) == (7.0, 2.0)
+    down = mark_scaled_down(state, 9.0)
+    assert (down.last_scale_up, down.last_scale_down) == (1.0, 9.0)
+
+
+def test_plan_is_pure():
+    state = PolicyState(last_scale_up=0.0, last_scale_down=0.0)
+    a = plan_tick(100, 5.0, CFG, state)
+    b = plan_tick(100, 5.0, CFG, state)
+    assert a == b
+    assert state == PolicyState(last_scale_up=0.0, last_scale_down=0.0)
+
+
+def test_property_up_gate_matches_reference_predicate():
+    # Randomized check of the exact reference predicates (main.go:51-52,65-66).
+    rng = random.Random(1234)
+    for _ in range(2000):
+        cfg = PolicyConfig(
+            scale_up_messages=rng.randint(0, 50),
+            scale_down_messages=rng.randint(0, 50),
+            scale_up_cooldown=rng.choice([0.0, 1.0, 10.0]),
+            scale_down_cooldown=rng.choice([0.0, 1.0, 10.0]),
+        )
+        state = PolicyState(
+            last_scale_up=rng.uniform(-20, 20), last_scale_down=rng.uniform(-20, 20)
+        )
+        now = rng.uniform(0, 40)
+        n = rng.randint(0, 60)
+        plan = plan_tick(n, now, cfg, state)
+
+        if n >= cfg.scale_up_messages:
+            expect_up = (
+                Gate.COOLING
+                if state.last_scale_up + cfg.scale_up_cooldown > now
+                else Gate.FIRE
+            )
+        else:
+            expect_up = Gate.IDLE
+        assert plan.up is expect_up
+
+        if expect_up is Gate.COOLING:
+            assert plan.down is Gate.SKIPPED
+        elif n <= cfg.scale_down_messages:
+            expect_down = (
+                Gate.COOLING
+                if state.last_scale_down + cfg.scale_down_cooldown > now
+                else Gate.FIRE
+            )
+            assert plan.down is expect_down
+        else:
+            assert plan.down is Gate.IDLE
