@@ -1,0 +1,7 @@
+"""``python -m kube_sqs_autoscaler_tpu`` — the controller binary entry point
+(reference: the ``/kube-sqs-autoscaler`` static binary, ``Dockerfile:9``).
+"""
+
+from .cli import main
+
+main()

@@ -1,0 +1,160 @@
+"""CLI: the reference's 14 flags, verbatim names and defaults.
+
+Reference counterpart: ``main()`` at ``main.go:82-116``.  Flag table
+(names, defaults, and help text from ``main.go:83-97``):
+
+====================== ======================================= =========
+flag                   default                                 type
+====================== ======================================= =========
+--poll-period          5s                                      duration
+--scale-down-cool-down 30s                                     duration
+--scale-up-cool-down   10s                                     duration
+--scale-up-messages    100                                     int
+--scale-down-messages  10                                      int
+--scale-up-pods        1                                       int
+--scale-down-pods      1                                       int
+--max-pods             5                                       int
+--min-pods             1                                       int
+--aws-region           ""                                      string
+--attribute-names      the 3-attribute CSV (``main.go:28``)    string
+--sqs-queue-url        ""                                      string
+--kubernetes-deployment ""                                     string
+--kubernetes-namespace default                                 string
+====================== ======================================= =========
+
+Faithfully preserved quirks (SURVEY.md §2.2-C1): required-by-doc flags
+(``--kubernetes-deployment``, ``--sqs-queue-url``) are *not* validated at
+startup — empty values only fail later at RPC time; the ``--attribute-names``
+override is string-compared against the default CSV, with a non-default
+value split on ``,`` and each item trimmed (``main.go:103-110``).
+
+Env vars: ``KUBE_CONFIG_PATH`` selects a kubeconfig file (in-cluster config
+when unset/empty, ``scale/scale.go:32-33``); AWS credentials come from the
+standard AWS env chain (``sqs/sqs.go:36``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Sequence
+
+from .core.loop import ControlLoop, LoopConfig
+from .core.policy import PolicyConfig
+from .metrics.queue import (
+    DEFAULT_ATTRIBUTE_NAMES_CSV,
+    QueueMetricSource,
+    parse_attribute_names,
+)
+from .utils.duration import parse_duration
+from .utils.logging import configure_logging
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kube-sqs-autoscaler",
+        description=(
+            "Queue-driven pod autoscaler: polls queue depth and scales a "
+            "Kubernetes Deployment between --min-pods and --max-pods."
+        ),
+    )
+    parser.add_argument(
+        "--poll-period", type=parse_duration, default=5.0, metavar="DURATION",
+        help="The interval in seconds for checking if scaling is required",
+    )
+    parser.add_argument(
+        "--scale-down-cool-down", type=parse_duration, default=30.0,
+        metavar="DURATION", help="The cool down period for scaling down",
+    )
+    parser.add_argument(
+        "--scale-up-cool-down", type=parse_duration, default=10.0,
+        metavar="DURATION", help="The cool down period for scaling up",
+    )
+    parser.add_argument(
+        "--scale-up-messages", type=int, default=100,
+        help="Number of sqs messages queued up required for scaling up",
+    )
+    parser.add_argument(
+        "--scale-down-messages", type=int, default=10,
+        help="Number of messages required to scaling down",
+    )
+    parser.add_argument(
+        "--scale-up-pods", type=int, default=1, help="Number of Pod in scaling up"
+    )
+    parser.add_argument(
+        "--scale-down-pods", type=int, default=1, help="Number of Pod in scaling down"
+    )
+    parser.add_argument(
+        "--max-pods", type=int, default=5,
+        help="Max pods that kube-sqs-autoscaler can scale",
+    )
+    parser.add_argument(
+        "--min-pods", type=int, default=1,
+        help="Min pods that kube-sqs-autoscaler can scale",
+    )
+    parser.add_argument("--aws-region", default="", help="Your AWS region")
+    parser.add_argument(
+        "--attribute-names", default=DEFAULT_ATTRIBUTE_NAMES_CSV,
+        help=(
+            "A comma-separated list of queue attribute names to query in "
+            "calculating the number of messages"
+        ),
+    )
+    parser.add_argument("--sqs-queue-url", default="", help="The sqs queue url")
+    parser.add_argument(
+        "--kubernetes-deployment", default="",
+        help="Kubernetes Deployment to scale. This field is required",
+    )
+    parser.add_argument(
+        "--kubernetes-namespace", default="default",
+        help="The namespace your deployment is running in",
+    )
+    return parser
+
+
+def config_from_args(args: argparse.Namespace) -> LoopConfig:
+    return LoopConfig(
+        poll_interval=args.poll_period,
+        policy=PolicyConfig(
+            scale_up_messages=args.scale_up_messages,
+            scale_down_messages=args.scale_down_messages,
+            scale_up_cooldown=args.scale_up_cool_down,
+            scale_down_cooldown=args.scale_down_cool_down,
+        ),
+    )
+
+
+def main(argv: Sequence[str] | None = None) -> None:
+    """Wire real clients and run forever (``main.go:82-116``)."""
+    configure_logging()
+    args = build_parser().parse_args(argv)
+
+    # Imports deferred so the pure-control-plane modules (policy/loop/fakes)
+    # never pull in the real-client stacks, mirroring the package split.
+    from .metrics.sqs_aws import AwsSqsService
+    from .scale.actuator import PodAutoScaler
+    from .scale.kube import KubeDeploymentAPI
+
+    autoscaler = PodAutoScaler(
+        client=KubeDeploymentAPI(namespace=args.kubernetes_namespace),
+        max=args.max_pods,
+        min=args.min_pods,
+        scale_up_pods=args.scale_up_pods,
+        scale_down_pods=args.scale_down_pods,
+        deployment=args.kubernetes_deployment,
+        namespace=args.kubernetes_namespace,
+    )
+    metric_source = QueueMetricSource(
+        client=AwsSqsService(region=args.aws_region),
+        queue_url=args.sqs_queue_url,
+        attribute_names=parse_attribute_names(args.attribute_names),
+    )
+
+    log.info("Starting kube-sqs-autoscaler")
+    ControlLoop(autoscaler, metric_source, config_from_args(args)).run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

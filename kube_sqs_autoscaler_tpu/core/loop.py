@@ -1,0 +1,135 @@
+"""The control loop: sleep → poll → plan → actuate.
+
+Reference counterpart: ``Run()`` at ``main.go:35-80``.  The loop owns the
+side effects; all decisions come from the pure policy (:mod:`.policy`).
+Execution follows the :class:`~.policy.TickPlan` contract exactly:
+
+- sleep *first*, then poll (``main.go:41``) — so the first observation
+  happens one poll interval after start, and cooldown timestamps initialized
+  at start (:func:`~.policy.initial_state`) give the startup grace window;
+- a metric failure logs ``"Failed to get SQS messages: …"`` and skips the
+  tick (``main.go:43-47``) — the loop never dies;
+- every observation logs ``"Found %d messages in the queue"`` (``main.go:49``);
+- an up-cooling tick logs and ends the tick (``main.go:52-55``, including the
+  reference's trailing space in ``"… skipping scale up "``);
+- an actuation failure logs and ends the tick without touching policy state
+  (``main.go:57-60,71-74``);
+- only successful actuation (including boundary no-ops) advances the
+  matching cooldown timestamp (``main.go:62,76``).
+
+Deviation from the reference (deliberate, SURVEY.md §7.1): the loop takes an
+injectable :class:`~.clock.Clock` and supports bounded runs (``max_ticks``)
+and cooperative stop, so behavior is testable without real time.  With
+``SystemClock`` and defaults it blocks forever exactly like ``Run``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+
+from .clock import Clock, SystemClock
+from .policy import (
+    Gate,
+    PolicyConfig,
+    PolicyState,
+    gate_down,
+    gate_up,
+    initial_state,
+    mark_scaled_down,
+    mark_scaled_up,
+)
+from .types import MetricSource, Scaler
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class LoopConfig:
+    """Loop cadence + policy knobs (defaults: ``main.go:83-87``)."""
+
+    poll_interval: float = 5.0  # --poll-period
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
+
+
+class ControlLoop:
+    """Drives one scaler from one metric source on one clock."""
+
+    def __init__(
+        self,
+        scaler: Scaler,
+        metric_source: MetricSource,
+        config: LoopConfig | None = None,
+        clock: Clock | None = None,
+    ) -> None:
+        self.scaler = scaler
+        self.metric_source = metric_source
+        self.config = config or LoopConfig()
+        self.clock = clock or SystemClock()
+        self.ticks = 0  # completed ticks (observability; not used by policy)
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        """Ask a running loop to exit after its current tick."""
+        self._stop.set()
+
+    def run(self, max_ticks: int | None = None) -> PolicyState:
+        """Run the loop; blocks until ``max_ticks`` ticks or :meth:`stop`.
+
+        ``max_ticks=None`` runs forever, like the reference.  Each call is a
+        fresh episode (fresh startup-grace state and tick budget);
+        ``self.ticks`` accumulates across episodes for observability.
+        """
+        self._stop.clear()
+        state = initial_state(self.clock.now())
+        ticks_this_run = 0
+        while not self._stop.is_set():
+            if max_ticks is not None and ticks_this_run >= max_ticks:
+                break
+            self.clock.sleep(self.config.poll_interval)
+            state = self.tick(state)
+            ticks_this_run += 1
+            self.ticks += 1
+        return state
+
+    def tick(self, state: PolicyState) -> PolicyState:
+        """One loop body (post-sleep): observe, plan, actuate. Returns new state."""
+        try:
+            num_messages = self.metric_source.num_messages()
+        except Exception as err:  # the loop must never die (main.go:43-47)
+            log.error("Failed to get SQS messages: %s", err)
+            return state
+
+        log.info("Found %d messages in the queue", num_messages)
+
+        # Gates are evaluated sequentially with a fresh clock read each, like
+        # the reference's inline time.Now() calls (main.go:52,66): under a
+        # real clock the down gate sees time that has advanced past the
+        # scale-up RPCs.
+        policy = self.config.policy
+        up = gate_up(num_messages, self.clock.now(), policy, state)
+        if up is Gate.COOLING:
+            log.info("Waiting for cool down, skipping scale up ")
+            return state
+        if up is Gate.FIRE:
+            try:
+                self.scaler.scale_up()
+            except Exception as err:
+                log.error("Failed scaling up: %s", err)
+                return state
+            state = mark_scaled_up(state, self.clock.now())
+
+        down = gate_down(num_messages, self.clock.now(), policy, state)
+        if down is Gate.COOLING:
+            log.info("Waiting for cool down, skipping scale down")
+            return state
+        if down is Gate.FIRE:
+            try:
+                self.scaler.scale_down()
+            except Exception as err:
+                log.error("Failed scaling down: %s", err)
+                return state
+            state = mark_scaled_down(state, self.clock.now())
+
+        return state

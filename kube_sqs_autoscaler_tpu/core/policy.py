@@ -62,17 +62,14 @@ class PolicyState:
 
 @dataclass(frozen=True)
 class TickPlan:
-    """What one tick should do, in order.
+    """A whole tick's decisions as one pure value (both gates at one instant).
 
-    Execution contract (matching ``main.go:51-77``):
-
-    - If ``up is Gate.COOLING``: log, end the tick (down is ``SKIPPED``).
-    - If ``up is Gate.FIRE``: actuate scale-up.  On failure end the tick
-      without touching state; on success (including a clamp/no-op at the max
-      bound) record the time via :func:`mark_scaled_up`.
-    - Then the same for ``down``.  ``down`` was planned with the *pre-tick*
-      state; that is faithful because a scale-up this tick never alters the
-      scale-down cooldown timestamp.
+    Used for analysis and property tests.  The live loop instead calls
+    :func:`gate_up` / :func:`gate_down` sequentially — the reference
+    re-reads ``time.Now()`` when it reaches the down branch
+    (``main.go:66``), after the scale-up RPCs, so under a real clock the
+    down gate must be evaluated with a *fresh* timestamp, not the one the
+    up gate saw.
     """
 
     up: Gate
@@ -84,33 +81,40 @@ def initial_state(now: float) -> PolicyState:
     return PolicyState(last_scale_up=now, last_scale_down=now)
 
 
+def gate_up(
+    num_messages: int, now: float, config: PolicyConfig, state: PolicyState
+) -> Gate:
+    """The scale-up gate (``main.go:51-52``). Pure."""
+    if num_messages < config.scale_up_messages:
+        return Gate.IDLE
+    if state.last_scale_up + config.scale_up_cooldown > now:
+        return Gate.COOLING
+    return Gate.FIRE
+
+
+def gate_down(
+    num_messages: int, now: float, config: PolicyConfig, state: PolicyState
+) -> Gate:
+    """The scale-down gate (``main.go:65-66``). Pure."""
+    if num_messages > config.scale_down_messages:
+        return Gate.IDLE
+    if state.last_scale_down + config.scale_down_cooldown > now:
+        return Gate.COOLING
+    return Gate.FIRE
+
+
 def plan_tick(
     num_messages: int,
     now: float,
     config: PolicyConfig,
     state: PolicyState,
 ) -> TickPlan:
-    """Decide what this tick does. Pure; no clocks, no I/O, no mutation."""
-    if num_messages >= config.scale_up_messages:
-        if state.last_scale_up + config.scale_up_cooldown > now:
-            up = Gate.COOLING
-        else:
-            up = Gate.FIRE
-    else:
-        up = Gate.IDLE
-
+    """Both gates at one instant. Pure; no clocks, no I/O, no mutation."""
+    up = gate_up(num_messages, now, config, state)
     if up is Gate.COOLING:
         # the reference `continue`s: the down branch is never evaluated
         return TickPlan(up=up, down=Gate.SKIPPED)
-
-    if num_messages <= config.scale_down_messages:
-        if state.last_scale_down + config.scale_down_cooldown > now:
-            down = Gate.COOLING
-        else:
-            down = Gate.FIRE
-    else:
-        down = Gate.IDLE
-    return TickPlan(up=up, down=down)
+    return TickPlan(up=up, down=gate_down(num_messages, now, config, state))
 
 
 def mark_scaled_up(state: PolicyState, now: float) -> PolicyState:

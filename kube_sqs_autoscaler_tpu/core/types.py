@@ -1,0 +1,47 @@
+"""Framework seams: the two leaf protocols and their error types.
+
+The reference gets its testability from two interface seams — ``SQS``
+(``sqs/sqs.go:14-18``) behind the metric source and client-go's
+``DeploymentInterface`` (``scale/scale.go:22``) behind the actuator
+(SURVEY.md §1).  These protocols are the same seams, idiomatically Python:
+anything with ``num_messages()`` is a metric source, anything with
+``scale_up()``/``scale_down()`` is a scaler.
+
+Failures are exceptions rather than Go error returns; the control loop
+catches :class:`MetricError`/:class:`ScaleError` and continues the loop,
+matching ``main.go:43-47,57-60,71-74``.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+class MetricError(RuntimeError):
+    """Metric source failure (reference: wrapped error at ``sqs/sqs.go:53,60``)."""
+
+
+class ScaleError(RuntimeError):
+    """Actuator failure (reference: wrapped error at ``scale/scale.go:57,74``)."""
+
+
+@runtime_checkable
+class MetricSource(Protocol):
+    """Produces the scalar the policy thresholds on (queue depth)."""
+
+    def num_messages(self) -> int:
+        """Current queue depth. Raises :class:`MetricError` on failure."""
+        ...
+
+
+@runtime_checkable
+class Scaler(Protocol):
+    """Actuates the replica count on an orchestrator."""
+
+    def scale_up(self) -> None:
+        """Step replicas up (clamped). No-op at max. Raises :class:`ScaleError`."""
+        ...
+
+    def scale_down(self) -> None:
+        """Step replicas down (clamped). No-op at min. Raises :class:`ScaleError`."""
+        ...

@@ -1,0 +1,18 @@
+"""Metric sources: queue-depth clients.
+
+Reference counterpart: package ``sqs`` (``sqs/sqs.go``).
+"""
+
+from .fake import FakeQueueService
+from .queue import (
+    DEFAULT_ATTRIBUTE_NAMES,
+    QueueMetricSource,
+    parse_attribute_names,
+)
+
+__all__ = [
+    "DEFAULT_ATTRIBUTE_NAMES",
+    "QueueMetricSource",
+    "parse_attribute_names",
+    "FakeQueueService",
+]

@@ -1,0 +1,66 @@
+"""In-memory fake queue service.
+
+Equivalent of the reference's ``MockSQS`` (``main_test.go:273-286``,
+``sqs/sqs_test.go:27-41``): holds one attribute map; ``get_queue_attributes``
+returns it, and ``set_queue_attributes`` is the write-side seam tests use to
+change queue depth mid-run (``main_test.go:46-49``).  Also supports error
+injection for the metric-failure paths the reference never tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping, Sequence
+
+
+class FakeQueueService:
+    """Settable attribute map behind the ``QueueService`` seam."""
+
+    def __init__(self, attributes: Mapping[str, str] | None = None):
+        self._lock = threading.Lock()
+        self._attributes: dict[str, str] = dict(attributes or {})
+        self.fail_next_get: Exception | None = None
+        self.get_calls = 0
+
+    @classmethod
+    def with_depths(
+        cls, visible: int, delayed: int = 0, not_visible: int = 0
+    ) -> "FakeQueueService":
+        """Seed the three default attributes (cf. ``main_test.go:289-293``)."""
+        return cls(
+            {
+                "ApproximateNumberOfMessages": str(visible),
+                "ApproximateNumberOfMessagesDelayed": str(delayed),
+                "ApproximateNumberOfMessagesNotVisible": str(not_visible),
+            }
+        )
+
+    def get_queue_attributes(
+        self, queue_url: str, attribute_names: Sequence[str]
+    ) -> Mapping[str, str]:
+        with self._lock:
+            self.get_calls += 1
+            if self.fail_next_get is not None:
+                err, self.fail_next_get = self.fail_next_get, None
+                raise err
+            # Like the reference mock (main_test.go:277-279), returns the
+            # whole stored map regardless of the requested names; the metric
+            # source picks out what it asked for.
+            return dict(self._attributes)
+
+    def set_queue_attributes(self, attributes: Mapping[str, str]) -> None:
+        """Test seam: replace the attribute map (``main_test.go:281-286``)."""
+        with self._lock:
+            self._attributes = dict(attributes)
+
+    def set_depths(
+        self, visible: int, delayed: int = 0, not_visible: int = 0
+    ) -> None:
+        """Convenience for the common three-attribute reseed."""
+        self.set_queue_attributes(
+            {
+                "ApproximateNumberOfMessages": str(visible),
+                "ApproximateNumberOfMessagesDelayed": str(delayed),
+                "ApproximateNumberOfMessagesNotVisible": str(not_visible),
+            }
+        )

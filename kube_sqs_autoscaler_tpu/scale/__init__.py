@@ -1,0 +1,10 @@
+"""Actuator layer: replica scalers over an orchestrator API.
+
+Reference counterpart: package ``scale`` (``scale/scale.go``).
+"""
+
+from .actuator import PodAutoScaler
+from .fake import FakeDeploymentAPI, NotFoundError
+from .objects import Deployment
+
+__all__ = ["PodAutoScaler", "FakeDeploymentAPI", "NotFoundError", "Deployment"]

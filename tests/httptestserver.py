@@ -1,0 +1,75 @@
+"""Tiny in-process HTTP server for client tests.
+
+Python's answer to Go's ``httptest``: a ThreadingHTTPServer on a random
+localhost port, with the handler delegating to a per-test callable so tests
+can assert on requests and script responses.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+@dataclass
+class Exchange:
+    method: str
+    path: str
+    headers: dict[str, str]
+    body: bytes
+
+
+@dataclass
+class Reply:
+    status: int = 200
+    body: bytes = b"{}"
+    content_type: str = "application/json"
+
+    @classmethod
+    def json(cls, obj, status: int = 200) -> "Reply":
+        return cls(status=status, body=json.dumps(obj).encode("utf-8"))
+
+
+@dataclass
+class LocalHttpServer:
+    handler: Callable[[Exchange], Reply]
+    exchanges: list[Exchange] = field(default_factory=list)
+
+    def __enter__(self) -> "LocalHttpServer":
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _serve(self) -> None:
+                length = int(self.headers.get("Content-Length") or 0)
+                exchange = Exchange(
+                    method=self.command,
+                    path=self.path,
+                    headers={k: v for k, v in self.headers.items()},
+                    body=self.rfile.read(length) if length else b"",
+                )
+                outer.exchanges.append(exchange)
+                reply = outer.handler(exchange)
+                self.send_response(reply.status)
+                self.send_header("Content-Type", reply.content_type)
+                self.send_header("Content-Length", str(len(reply.body)))
+                self.end_headers()
+                self.wfile.write(reply.body)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _serve
+
+            def log_message(self, *args) -> None:  # keep test output clean
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}"
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)

@@ -1,0 +1,141 @@
+"""Actuator tests: the reference's four scale_test.go scenarios with exact
+replica sequences, plus the error paths the reference leaves untested
+(SURVEY.md §4 gaps).
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.types import ScaleError, Scaler
+from kube_sqs_autoscaler_tpu.scale import (
+    Deployment,
+    FakeDeploymentAPI,
+    NotFoundError,
+    PodAutoScaler,
+)
+
+
+def make_autoscaler(max_, min_, init, up_pods, down_pods) -> PodAutoScaler:
+    # Mirrors NewMockPodAutoScaler (scale/scale_test.go:85-115): two seeded
+    # deployments; only "deploy" is scaled, "deploy-no-scale" is the control.
+    api = FakeDeploymentAPI.with_deployments(
+        "namespace", init, "deploy", "deploy-no-scale"
+    )
+    return PodAutoScaler(
+        client=api,
+        max=max_,
+        min=min_,
+        scale_up_pods=up_pods,
+        scale_down_pods=down_pods,
+        deployment="deploy",
+        namespace="namespace",
+    )
+
+
+def test_scale_up_to_max_then_noop():
+    # scale/scale_test.go:14-33 — 3 -> 4 -> 5, then no-op at max, all successful
+    p = make_autoscaler(5, 1, 3, 1, 1)
+    p.scale_up()
+    assert p.client.replicas("deploy") == 4
+    p.scale_up()
+    assert p.client.replicas("deploy") == 5
+    p.scale_up()  # boundary no-op must be success, not an error
+    assert p.client.replicas("deploy") == 5
+    assert p.client.replicas("deploy-no-scale") == 3  # untouched control
+
+
+def test_scale_up_with_step_clamps_to_max():
+    # scale/scale_test.go:35-49 — step 5: 3 -> 8 -> clamp 10
+    p = make_autoscaler(10, 1, 3, 5, 5)
+    p.scale_up()
+    assert p.client.replicas("deploy") == 8
+    p.scale_up()
+    assert p.client.replicas("deploy") == 10
+
+
+def test_scale_down_to_min_then_noop():
+    # scale/scale_test.go:51-68 — 3 -> 2 -> 1, then no-op at min
+    p = make_autoscaler(5, 1, 3, 1, 1)
+    p.scale_down()
+    assert p.client.replicas("deploy") == 2
+    p.scale_down()
+    assert p.client.replicas("deploy") == 1
+    p.scale_down()
+    assert p.client.replicas("deploy") == 1
+
+
+def test_scale_down_with_step_clamps_to_min():
+    # scale/scale_test.go:70-83 — step 5: 8 -> 3 -> clamp 1
+    p = make_autoscaler(10, 1, 8, 5, 5)
+    p.scale_down()
+    assert p.client.replicas("deploy") == 3
+    p.scale_down()
+    assert p.client.replicas("deploy") == 1
+
+
+def test_boundary_noop_does_not_call_update():
+    # At the bound the reference returns before Update (scale/scale.go:62-65).
+    p = make_autoscaler(5, 1, 5, 1, 1)
+    p.scale_up()
+    assert p.client.update_calls == 0
+    p2 = make_autoscaler(5, 1, 1, 1, 1)
+    p2.scale_down()
+    assert p2.client.update_calls == 0
+
+
+def test_get_failure_wraps_reference_context_string():
+    p = make_autoscaler(5, 1, 3, 1, 1)
+    p.client.fail_next_get = ConnectionError("apiserver down")
+    with pytest.raises(ScaleError, match="no scale up occurred"):
+        p.scale_up()
+    assert p.client.replicas("deploy") == 3  # no write happened
+
+    p.client.fail_next_get = ConnectionError("apiserver down")
+    with pytest.raises(ScaleError, match="no scale down occurred"):
+        p.scale_down()
+    assert p.client.replicas("deploy") == 3
+
+
+def test_update_failure_raises_and_leaves_store():
+    p = make_autoscaler(5, 1, 3, 1, 1)
+    p.client.fail_next_update = ConnectionError("conflict")
+    with pytest.raises(ScaleError, match="Failed to scale up"):
+        p.scale_up()
+    assert p.client.replicas("deploy") == 3
+    p.client.fail_next_update = ConnectionError("conflict")
+    with pytest.raises(ScaleError, match="Failed to scale down"):
+        p.scale_down()
+    assert p.client.replicas("deploy") == 3
+
+
+def test_missing_deployment_is_a_scale_error():
+    api = FakeDeploymentAPI("namespace", [])
+    p = PodAutoScaler(
+        client=api, max=5, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="ghost", namespace="namespace",
+    )
+    with pytest.raises(ScaleError):
+        p.scale_up()
+
+
+def test_fake_copies_objects_like_clientgo_fake():
+    api = FakeDeploymentAPI(
+        "ns", [Deployment(name="d", namespace="ns", replicas=3)]
+    )
+    fetched = api.get("d")
+    fetched.replicas = 99  # mutating the returned object must not leak in
+    assert api.replicas("d") == 3
+
+
+def test_current_above_max_is_noop_and_below_min_is_noop():
+    # current > max: reference's `>=` gate no-ops rather than clamping down
+    p = make_autoscaler(5, 1, 8, 1, 1)
+    p.scale_up()
+    assert p.client.replicas("deploy") == 8
+    # current < min: `<=` gate no-ops rather than clamping up
+    p2 = make_autoscaler(5, 3, 1, 1, 1)
+    p2.scale_down()
+    assert p2.client.replicas("deploy") == 1
+
+
+def test_protocol_conformance():
+    assert isinstance(make_autoscaler(5, 1, 3, 1, 1), Scaler)

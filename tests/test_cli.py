@@ -1,0 +1,89 @@
+"""CLI parity tests: all 14 reference flags, names and defaults verbatim
+(main.go:83-97), Go duration syntax, and the attribute-override path the
+reference wires in main() (main.go:103-110).
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.cli import build_parser, config_from_args
+from kube_sqs_autoscaler_tpu.metrics.queue import (
+    DEFAULT_ATTRIBUTE_NAMES,
+    DEFAULT_ATTRIBUTE_NAMES_CSV,
+)
+from kube_sqs_autoscaler_tpu.metrics import parse_attribute_names
+
+
+def test_all_fourteen_flags_exist_with_reference_defaults():
+    args = build_parser().parse_args([])
+    assert args.poll_period == 5.0
+    assert args.scale_down_cool_down == 30.0
+    assert args.scale_up_cool_down == 10.0
+    assert args.scale_up_messages == 100
+    assert args.scale_down_messages == 10
+    assert args.scale_up_pods == 1
+    assert args.scale_down_pods == 1
+    assert args.max_pods == 5
+    assert args.min_pods == 1
+    assert args.aws_region == ""
+    assert args.attribute_names == DEFAULT_ATTRIBUTE_NAMES_CSV
+    assert args.sqs_queue_url == ""
+    assert args.kubernetes_deployment == ""
+    assert args.kubernetes_namespace == "default"
+
+
+def test_flag_equals_value_style_from_reference_manifest():
+    # README.md:39-53 passes --flag=value args; durations use Go syntax
+    args = build_parser().parse_args(
+        [
+            "--sqs-queue-url=https://sqs.us-east-1.amazonaws.com/123/q",
+            "--kubernetes-deployment=workers",
+            "--kubernetes-namespace=prod",
+            "--aws-region=us-east-1",
+            "--poll-period=5s",
+            "--scale-down-cool-down=30s",
+            "--scale-up-cool-down=5m",
+            "--scale-up-messages=100",
+            "--scale-down-messages=10",
+            "--scale-up-pods=1",
+            "--scale-down-pods=1",
+            "--max-pods=5",
+            "--min-pods=1",
+            "--attribute-names=ApproximateNumberOfMessages",
+        ]
+    )
+    assert args.scale_up_cool_down == 300.0
+    assert args.kubernetes_deployment == "workers"
+    assert parse_attribute_names(args.attribute_names) == (
+        "ApproximateNumberOfMessages",
+    )
+
+
+def test_invalid_duration_is_a_usage_error():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--poll-period=10"])  # Go rejects unitless too
+
+
+def test_required_by_doc_flags_are_not_validated():
+    # Reference quirk preserved (SURVEY §2.2-C1): empty required flags parse
+    # fine and only fail at RPC time.
+    args = build_parser().parse_args([])
+    assert args.kubernetes_deployment == ""
+    assert args.sqs_queue_url == ""
+
+
+def test_config_from_args_maps_to_loop_and_policy():
+    args = build_parser().parse_args(
+        ["--poll-period=1s", "--scale-up-cool-down=2s", "--scale-down-cool-down=3s",
+         "--scale-up-messages=7", "--scale-down-messages=2"]
+    )
+    config = config_from_args(args)
+    assert config.poll_interval == 1.0
+    assert config.policy.scale_up_cooldown == 2.0
+    assert config.policy.scale_down_cooldown == 3.0
+    assert config.policy.scale_up_messages == 7
+    assert config.policy.scale_down_messages == 2
+
+
+def test_default_attribute_names_round_trip():
+    args = build_parser().parse_args([])
+    assert parse_attribute_names(args.attribute_names) is DEFAULT_ATTRIBUTE_NAMES

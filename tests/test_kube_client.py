@@ -1,0 +1,200 @@
+"""KubeDeploymentAPI tests against a local HTTP double of the apiserver's
+apps/v1 Deployment endpoints, plus config-resolution tests mirroring the
+reference's KUBE_CONFIG_PATH / in-cluster / panic behavior
+(scale/scale.go:31-52).
+"""
+
+import json
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.types import ScaleError
+from kube_sqs_autoscaler_tpu.scale.actuator import PodAutoScaler
+from kube_sqs_autoscaler_tpu.scale.kube import (
+    ClusterConfig,
+    KubeApiError,
+    KubeConfigError,
+    KubeDeploymentAPI,
+    load_config,
+    load_kubeconfig,
+)
+
+from .httptestserver import Reply, LocalHttpServer
+
+
+def deployment_body(name="workers", namespace="prod", replicas=3, rv="100"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, "resourceVersion": rv},
+        "spec": {"replicas": replicas, "selector": {"matchLabels": {"app": name}}},
+        "status": {"replicas": replicas},
+    }
+
+
+class FakeApiServer:
+    """Scriptable apps/v1 Deployment endpoints over LocalHttpServer."""
+
+    def __init__(self, deployments: dict[str, dict]):
+        self.deployments = deployments
+
+    def __call__(self, exchange):
+        parts = exchange.path.strip("/").split("/")
+        # apis/apps/v1/namespaces/{ns}/deployments/{name}
+        if parts[:4] != ["apis", "apps", "v1", "namespaces"] or parts[5] != "deployments":
+            return Reply.json({"message": "not found"}, status=404)
+        name = parts[6]
+        if exchange.method == "GET":
+            if name not in self.deployments:
+                return Reply.json(
+                    {"kind": "Status", "message": f'deployments.apps "{name}" not found'},
+                    status=404,
+                )
+            return Reply.json(self.deployments[name])
+        if exchange.method == "PUT":
+            if name not in self.deployments:
+                return Reply.json({"kind": "Status", "message": "not found"}, status=404)
+            self.deployments[name] = json.loads(exchange.body)
+            return Reply.json(self.deployments[name])
+        return Reply.json({"message": "method not allowed"}, status=405)
+
+
+def make_api(server_url, namespace="prod"):
+    return KubeDeploymentAPI(
+        namespace=namespace, config=ClusterConfig(server=server_url, token="tok-abc")
+    )
+
+
+def test_get_parses_deployment():
+    fake = FakeApiServer({"workers": deployment_body(replicas=7)})
+    with LocalHttpServer(fake) as server:
+        deployment = make_api(server.url).get("workers")
+    assert deployment.name == "workers"
+    assert deployment.namespace == "prod"
+    assert deployment.replicas == 7
+    exchange = server.exchanges[0]
+    assert exchange.path == "/apis/apps/v1/namespaces/prod/deployments/workers"
+    assert exchange.headers["Authorization"] == "Bearer tok-abc"
+
+
+def test_update_puts_full_object():
+    fake = FakeApiServer({"workers": deployment_body(replicas=3)})
+    with LocalHttpServer(fake) as server:
+        api = make_api(server.url)
+        deployment = api.get("workers")
+        api.update(deployment.with_replicas(5))
+    put = server.exchanges[-1]
+    assert put.method == "PUT"
+    body = json.loads(put.body)
+    # full-object read-modify-write: everything round-trips, replicas changed
+    assert body["spec"]["replicas"] == 5
+    assert body["spec"]["selector"] == {"matchLabels": {"app": "workers"}}
+    assert body["metadata"]["resourceVersion"] == "100"
+    assert fake.deployments["workers"]["spec"]["replicas"] == 5
+
+
+def test_actuator_end_to_end_over_http():
+    # The production PodAutoScaler driving the real REST client against the
+    # fake apiserver: 3 -> 4 -> 5 -> clamp no-op (scale/scale_test.go:14-33
+    # over a socket instead of an in-memory fake).
+    fake = FakeApiServer({"workers": deployment_body(replicas=3)})
+    with LocalHttpServer(fake) as server:
+        scaler = PodAutoScaler(
+            client=make_api(server.url), max=5, min=1, scale_up_pods=1,
+            scale_down_pods=1, deployment="workers", namespace="prod",
+        )
+        scaler.scale_up()
+        assert fake.deployments["workers"]["spec"]["replicas"] == 4
+        scaler.scale_up()
+        assert fake.deployments["workers"]["spec"]["replicas"] == 5
+        scaler.scale_up()  # boundary no-op, no PUT
+        assert fake.deployments["workers"]["spec"]["replicas"] == 5
+        scaler.scale_down()
+        assert fake.deployments["workers"]["spec"]["replicas"] == 4
+    puts = [e for e in server.exchanges if e.method == "PUT"]
+    assert len(puts) == 3
+
+
+def test_missing_deployment_becomes_scale_error_with_reference_context():
+    fake = FakeApiServer({})
+    with LocalHttpServer(fake) as server:
+        scaler = PodAutoScaler(
+            client=make_api(server.url), max=5, min=1, scale_up_pods=1,
+            scale_down_pods=1, deployment="ghost", namespace="prod",
+        )
+        with pytest.raises(ScaleError, match="no scale up occurred"):
+            scaler.scale_up()
+
+
+def test_http_error_carries_status_and_message():
+    fake = FakeApiServer({})
+    with LocalHttpServer(fake) as server:
+        with pytest.raises(KubeApiError, match="not found") as info:
+            make_api(server.url).get("ghost")
+    assert info.value.status == 404
+
+
+def test_transport_error_is_kube_api_error():
+    api = KubeDeploymentAPI(
+        namespace="prod",
+        config=ClusterConfig(server="http://127.0.0.1:1"),
+        timeout=0.5,
+    )
+    with pytest.raises(KubeApiError, match="failed"):
+        api.get("workers")
+
+
+def test_load_kubeconfig_current_context(tmp_path):
+    config_file = tmp_path / "kubeconfig"
+    config_file.write_text(
+        """
+apiVersion: v1
+kind: Config
+current-context: prod-ctx
+contexts:
+- name: prod-ctx
+  context: {cluster: prod-cluster, user: prod-user}
+- name: other
+  context: {cluster: other-cluster, user: other-user}
+clusters:
+- name: prod-cluster
+  cluster: {server: "https://10.0.0.1:6443", insecure-skip-tls-verify: true}
+- name: other-cluster
+  cluster: {server: "https://10.9.9.9:6443"}
+users:
+- name: prod-user
+  user: {token: sekrit}
+- name: other-user
+  user: {}
+"""
+    )
+    config = load_kubeconfig(config_file)
+    assert config.server == "https://10.0.0.1:6443"
+    assert config.token == "sekrit"
+    assert config.skip_tls_verify is True
+
+
+def test_kube_config_path_env_selects_kubeconfig(tmp_path, monkeypatch):
+    config_file = tmp_path / "kubeconfig"
+    config_file.write_text(
+        """
+current-context: c
+contexts: [{name: c, context: {cluster: cl, user: u}}]
+clusters: [{name: cl, cluster: {server: "http://localhost:8080"}}]
+users: [{name: u, user: {}}]
+"""
+    )
+    monkeypatch.setenv("KUBE_CONFIG_PATH", str(config_file))
+    assert load_config().server == "http://localhost:8080"
+
+
+def test_config_failure_raises_reference_panic_message(monkeypatch):
+    # scale/scale.go:35 panics with this exact message on config failure;
+    # no kubeconfig and no in-cluster env must be fatal at construction.
+    monkeypatch.setenv("KUBE_CONFIG_PATH", "/does/not/exist")
+    with pytest.raises(KubeConfigError, match="Failed to configure incluster or local config"):
+        load_config()
+    monkeypatch.delenv("KUBE_CONFIG_PATH")
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    with pytest.raises(KubeConfigError, match="Failed to configure incluster or local config"):
+        load_config()

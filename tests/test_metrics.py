@@ -1,0 +1,115 @@
+"""Metric-source tests: the reference's sqs_test.go scenarios plus the
+error paths (missing attribute, garbage value, transport failure) that the
+reference leaves untested — including the nil-deref fixed per SURVEY §2.2-C3.
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.types import MetricError, MetricSource
+from kube_sqs_autoscaler_tpu.metrics import (
+    DEFAULT_ATTRIBUTE_NAMES,
+    FakeQueueService,
+    QueueMetricSource,
+    parse_attribute_names,
+)
+from kube_sqs_autoscaler_tpu.metrics.queue import DEFAULT_ATTRIBUTE_NAMES_CSV
+
+
+def test_constructor_fields():
+    # sqs/sqs_test.go:11-17
+    source = QueueMetricSource(
+        client=FakeQueueService.with_depths(0),
+        queue_url="queue",
+        attribute_names=DEFAULT_ATTRIBUTE_NAMES,
+    )
+    assert source.queue_url == "queue"
+    assert source.attribute_names == DEFAULT_ATTRIBUTE_NAMES
+
+
+def test_num_messages_sums_all_three_attributes():
+    # sqs/sqs_test.go:19-25 — 10+10+10 == 30
+    source = QueueMetricSource(
+        client=FakeQueueService.with_depths(10, 10, 10), queue_url="example.com"
+    )
+    assert source.num_messages() == 30
+
+
+def test_default_attribute_names_match_reference():
+    # sqs/sqs.go:28-33 and main.go:28
+    assert DEFAULT_ATTRIBUTE_NAMES == (
+        "ApproximateNumberOfMessages",
+        "ApproximateNumberOfMessagesDelayed",
+        "ApproximateNumberOfMessagesNotVisible",
+    )
+    assert DEFAULT_ATTRIBUTE_NAMES_CSV == (
+        "ApproximateNumberOfMessages,ApproximateNumberOfMessagesDelayed,"
+        "ApproximateNumberOfMessagesNotVisible"
+    )
+
+
+def test_subset_of_attributes_only_sums_requested():
+    source = QueueMetricSource(
+        client=FakeQueueService.with_depths(7, 5, 3),
+        queue_url="q",
+        attribute_names=("ApproximateNumberOfMessages",),
+    )
+    assert source.num_messages() == 7
+
+
+def test_missing_attribute_is_explicit_error_not_crash():
+    # The reference nil-derefs at sqs/sqs.go:58; we raise MetricError instead.
+    source = QueueMetricSource(
+        client=FakeQueueService({"ApproximateNumberOfMessages": "5"}),
+        queue_url="q",
+        attribute_names=("ApproximateNumberOfMessages", "NoSuchAttribute"),
+    )
+    with pytest.raises(MetricError, match="'NoSuchAttribute'"):
+        source.num_messages()
+
+
+def test_non_integer_value_is_metric_error_with_reference_context():
+    source = QueueMetricSource(
+        client=FakeQueueService({"ApproximateNumberOfMessages": "not-a-number"}),
+        queue_url="q",
+        attribute_names=("ApproximateNumberOfMessages",),
+    )
+    with pytest.raises(
+        MetricError,
+        match="Failed to get 'ApproximateNumberOfMessages' number of messages",
+    ):
+        source.num_messages()
+
+
+def test_transport_failure_wraps_reference_context():
+    fake = FakeQueueService.with_depths(10)
+    fake.fail_next_get = ConnectionError("SQS unreachable")
+    source = QueueMetricSource(client=fake, queue_url="q")
+    with pytest.raises(MetricError, match="Failed to get messages in SQS"):
+        source.num_messages()
+    # next call succeeds again (error was one-shot)
+    assert source.num_messages() == 10
+
+
+def test_set_queue_attributes_seam_changes_depth_mid_run():
+    # main_test.go:46-49 — the mock's write side
+    fake = FakeQueueService.with_depths(100, 100, 100)
+    source = QueueMetricSource(client=fake, queue_url="q")
+    assert source.num_messages() == 300
+    fake.set_depths(1, 1, 1)
+    assert source.num_messages() == 3
+
+
+def test_parse_attribute_names_default_fast_path_and_override():
+    # main.go:103-110
+    assert parse_attribute_names(DEFAULT_ATTRIBUTE_NAMES_CSV) is DEFAULT_ATTRIBUTE_NAMES
+    assert parse_attribute_names("A, B ,C") == ("A", "B", "C")
+    assert parse_attribute_names("ApproximateNumberOfMessages") == (
+        "ApproximateNumberOfMessages",
+    )
+
+
+def test_protocol_conformance():
+    assert isinstance(
+        QueueMetricSource(client=FakeQueueService.with_depths(0), queue_url="q"),
+        MetricSource,
+    )

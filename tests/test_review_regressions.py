@@ -1,0 +1,123 @@
+"""Regression tests for review findings on the client/loop layer:
+credential refresh, token rotation, repeated run() episodes, canonical-query
+plus-sign handling, and fresh-clock down-gate evaluation.
+"""
+
+import time
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.metrics import FakeQueueService, QueueMetricSource
+from kube_sqs_autoscaler_tpu.metrics.sqs_aws import AwsSqsService
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+from kube_sqs_autoscaler_tpu.scale.kube import ClusterConfig
+from kube_sqs_autoscaler_tpu.utils.sigv4 import (
+    Credentials,
+    SignableRequest,
+    _canonical_query,
+    sign_request,
+)
+
+
+def test_run_twice_gives_two_full_episodes():
+    # A second run(max_ticks=N) must do N fresh ticks, not exit immediately.
+    api = FakeDeploymentAPI.with_deployments("ns", 3, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=5, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(0)
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(poll_interval=1.0, policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=3,
+            scale_up_cooldown=0.0, scale_down_cooldown=0.0)),
+        clock=FakeClock(),
+    )
+    loop.run(max_ticks=2)
+    assert queue.get_calls == 2
+    loop.run(max_ticks=2)
+    assert queue.get_calls == 4
+    assert loop.ticks == 4  # cumulative across episodes
+
+
+def test_canonical_query_preserves_literal_plus():
+    # RFC3986 query: '+' is a literal plus, not a space.
+    assert _canonical_query("Marker=a+b") == "Marker=a%2Bb"
+    assert _canonical_query("b=2&a=1") == "a=1&b=2"
+    assert _canonical_query("k=%41") == "k=A"
+    assert _canonical_query("empty=") == "empty="
+
+
+def test_expired_chain_credentials_are_refreshed(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDFRESH")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "fresh")
+    monkeypatch.delenv("AWS_SESSION_TOKEN", raising=False)
+    service = AwsSqsService(region="us-east-1")
+    # simulate a previously chain-resolved temporary credential near expiry
+    service._credentials = Credentials(
+        "AKIDOLD", "old", "tok", expires_at=time.time() + 10
+    )
+    assert service._current_credentials().access_key_id == "AKIDFRESH"
+
+
+def test_injected_credentials_are_never_refreshed():
+    creds = Credentials("AKIDPIN", "pin", expires_at=time.time() - 1000)
+    service = AwsSqsService(region="us-east-1", credentials=creds)
+    assert service._current_credentials() is creds
+
+
+def test_bearer_token_reread_from_rotating_file(tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("token-v1\n")
+    config = ClusterConfig(
+        server="http://x", token="token-v1", token_file=str(token_file)
+    )
+    assert config.bearer_token() == "token-v1"
+    token_file.write_text("token-v2\n")  # kubelet rotates the projected token
+    assert config.bearer_token() == "token-v2"
+    token_file.unlink()
+    assert config.bearer_token() == "token-v1"  # falls back to startup token
+
+
+def test_down_gate_sees_time_advanced_by_scale_up_rpc():
+    # Reference semantics (main.go:66): time.Now() is re-read after the
+    # scale-up RPCs, so a down-cooldown that expires *during* the scale-up
+    # call still fires in the same tick.
+    clock = FakeClock()
+
+    api = FakeDeploymentAPI.with_deployments("ns", 3, "deploy")
+
+    class SlowRpcApi:
+        # wraps the fake, advancing the clock 1s per RPC like a slow network
+        def get(self, name):
+            clock.advance(0.5)
+            return api.get(name)
+
+        def update(self, deployment):
+            clock.advance(0.5)
+            return api.update(deployment)
+
+    scaler = PodAutoScaler(
+        client=SlowRpcApi(), max=10, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="deploy", namespace="ns",
+    )
+    # overlapping thresholds: depth 5 triggers both directions
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=FakeQueueService.with_depths(5), queue_url="q"),
+        LoopConfig(poll_interval=10.0, policy=PolicyConfig(
+            scale_up_messages=5, scale_down_messages=5,
+            scale_up_cooldown=0.0,
+            # expires at t=10.5: after the tick-1 plan instant (t=10) but
+            # before the post-scale-up clock read (t=11)
+            scale_down_cooldown=10.5,
+        )),
+        clock=clock,
+    )
+    loop.run(max_ticks=1)
+    # up fired (3 -> 4) at some t in (10, 11); down gate evaluated at t=11
+    # where 0 + 10.5 > 11 is false -> down fires too (4 -> 3)
+    assert api.replicas("deploy") == 3

@@ -1,0 +1,156 @@
+"""AwsSqsService tests against a local HTTP double of the SQS JSON API,
+plus credential-chain and region-resolution unit tests.  No real AWS.
+"""
+
+import json
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.types import MetricError
+from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+from kube_sqs_autoscaler_tpu.metrics.sqs_aws import (
+    AwsError,
+    AwsSqsService,
+    CredentialsError,
+    region_from_queue_url,
+    resolve_credentials,
+)
+from kube_sqs_autoscaler_tpu.utils.sigv4 import Credentials
+
+from .httptestserver import Reply, LocalHttpServer
+
+CREDS = Credentials("AKIDTEST", "secret")
+
+
+def test_get_queue_attributes_roundtrip():
+    def handler(exchange):
+        body = json.loads(exchange.body)
+        assert body["QueueUrl"].endswith("/123/my-queue")
+        assert body["AttributeNames"] == ["ApproximateNumberOfMessages"]
+        return Reply.json({"Attributes": {"ApproximateNumberOfMessages": "42"}})
+
+    with LocalHttpServer(handler) as server:
+        service = AwsSqsService(
+            region="us-east-1", credentials=CREDS, endpoint=server.url
+        )
+        attributes = service.get_queue_attributes(
+            f"{server.url}/123/my-queue", ["ApproximateNumberOfMessages"]
+        )
+    assert attributes == {"ApproximateNumberOfMessages": "42"}
+
+    exchange = server.exchanges[0]
+    assert exchange.method == "POST"
+    assert exchange.headers["X-Amz-Target"] == "AmazonSQS.GetQueueAttributes"
+    assert exchange.headers["Content-Type"] == "application/x-amz-json-1.0"
+    auth = exchange.headers["Authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIDTEST/")
+    assert "/us-east-1/sqs/aws4_request" in auth
+    assert "x-amz-date" in auth  # signed headers include the date
+
+
+def test_full_metric_source_over_http():
+    # End-to-end: QueueMetricSource -> AwsSqsService -> HTTP -> sum
+    def handler(exchange):
+        return Reply.json(
+            {
+                "Attributes": {
+                    "ApproximateNumberOfMessages": "10",
+                    "ApproximateNumberOfMessagesDelayed": "10",
+                    "ApproximateNumberOfMessagesNotVisible": "10",
+                }
+            }
+        )
+
+    with LocalHttpServer(handler) as server:
+        source = QueueMetricSource(
+            client=AwsSqsService(
+                region="us-east-1", credentials=CREDS, endpoint=server.url
+            ),
+            queue_url=f"{server.url}/123/q",
+        )
+        assert source.num_messages() == 30
+
+
+def test_service_error_becomes_metric_error():
+    def handler(exchange):
+        return Reply.json(
+            {"__type": "com.amazonaws.sqs#QueueDoesNotExist"}, status=400
+        )
+
+    with LocalHttpServer(handler) as server:
+        source = QueueMetricSource(
+            client=AwsSqsService(
+                region="us-east-1", credentials=CREDS, endpoint=server.url
+            ),
+            queue_url=f"{server.url}/123/q",
+        )
+        with pytest.raises(MetricError, match="Failed to get messages in SQS"):
+            source.num_messages()
+
+
+def test_transport_error_is_aws_error():
+    service = AwsSqsService(
+        region="us-east-1", credentials=CREDS, endpoint="http://127.0.0.1:1",
+        timeout=0.5,
+    )
+    with pytest.raises(AwsError, match="request failed"):
+        service.get_queue_attributes("http://127.0.0.1:1/q", ["A"])
+
+
+def test_region_from_queue_url():
+    assert (
+        region_from_queue_url("https://sqs.eu-west-2.amazonaws.com/1/q") == "eu-west-2"
+    )
+    assert region_from_queue_url("http://127.0.0.1:999/1/q") is None
+
+
+def test_region_resolution_order(monkeypatch):
+    monkeypatch.setenv("AWS_REGION", "ap-south-1")
+    service = AwsSqsService(credentials=CREDS)
+    assert service._resolve_region("http://host/q") == "ap-south-1"
+    monkeypatch.delenv("AWS_REGION")
+    monkeypatch.delenv("AWS_DEFAULT_REGION", raising=False)
+    assert (
+        AwsSqsService(credentials=CREDS)._resolve_region(
+            "https://sqs.us-west-2.amazonaws.com/1/q"
+        )
+        == "us-west-2"
+    )
+    with pytest.raises(AwsError, match="Cannot determine AWS region"):
+        AwsSqsService(credentials=CREDS)._resolve_region("http://host/q")
+
+
+def test_credentials_from_env(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDENV")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "s3cret")
+    monkeypatch.setenv("AWS_SESSION_TOKEN", "tok")
+    creds = resolve_credentials(allow_imds=False)
+    assert creds == Credentials("AKIDENV", "s3cret", "tok")
+
+
+def test_credentials_from_shared_file(monkeypatch, tmp_path):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    creds_file = tmp_path / "credentials"
+    creds_file.write_text(
+        "[default]\n"
+        "aws_access_key_id = AKIDFILE\n"
+        "aws_secret_access_key = filesecret\n"
+        "\n"
+        "[other]\n"
+        "aws_access_key_id = AKIDOTHER\n"
+        "aws_secret_access_key = othersecret\n"
+    )
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(creds_file))
+    assert resolve_credentials(allow_imds=False).access_key_id == "AKIDFILE"
+    monkeypatch.setenv("AWS_PROFILE", "other")
+    assert resolve_credentials(allow_imds=False).access_key_id == "AKIDOTHER"
+
+
+def test_no_credentials_anywhere_raises(monkeypatch, tmp_path):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    monkeypatch.delenv("AWS_PROFILE", raising=False)
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(tmp_path / "missing"))
+    with pytest.raises(CredentialsError):
+        resolve_credentials(allow_imds=False)
