@@ -1,0 +1,16 @@
+# Controller image (reference counterpart: Dockerfile — two-stage build to
+# a minimal runtime; SURVEY.md §2.1 C6). The controller is stdlib-only, so
+# the runtime stage is a bare python:slim with just the package installed —
+# no JAX, no SDKs (the TPU workload layer is a separate image concern).
+
+FROM python:3.12-slim AS builder
+WORKDIR /work
+COPY pyproject.toml README.md ./
+COPY kube_sqs_autoscaler_tpu ./kube_sqs_autoscaler_tpu
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir pyyaml  # YAML kubeconfigs (optional extra)
+COPY --from=builder /work/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+ENTRYPOINT ["kube-sqs-autoscaler"]

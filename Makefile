@@ -1,0 +1,32 @@
+# Build/packaging targets (reference counterpart: Makefile — same five
+# targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
+
+.PHONY: test clean compile build push bench dryrun
+
+IMAGE=kube-sqs-autoscaler-tpu
+VERSION=v0.1.0
+
+test:
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -rf build dist *.egg-info
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+
+# "compile" for Python: byte-compile everything and fail on syntax errors
+# (the analogue of the reference's GOOS=linux go build sanity check).
+compile: clean
+	python -m compileall -q kube_sqs_autoscaler_tpu tests bench.py __graft_entry__.py
+
+build: clean
+	docker build -t $(IMAGE):$(VERSION) .
+
+push: build
+	docker push $(IMAGE):$(VERSION)
+
+bench:
+	python bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

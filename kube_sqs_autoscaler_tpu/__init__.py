@@ -22,6 +22,12 @@ Layers (reference counterpart in parens, file:line cited per module):
   Kubernetes REST actuator.
 - :mod:`.cli`          — all 14 reference flags with identical names and
   defaults (``main.go:83-97``).
+- :mod:`.workloads`    — what this controller scales in a TPU shop: queue-fed
+  JAX inference/training workers (sharded over a ``jax.sharding.Mesh``).
+  This is the only part of the tree that touches JAX; the controller itself
+  is deliberately plain Python, mirroring the reference's plain Go.
+- :mod:`.sim`          — deterministic closed-loop queue/worker-pool
+  simulator used by tests and ``bench.py``.
 """
 
 __version__ = "0.1.0"

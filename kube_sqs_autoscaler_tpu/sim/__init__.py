@@ -1,0 +1,15 @@
+"""Closed-loop autoscaling simulation.
+
+The reference can only be observed end-to-end against real AWS + a real
+cluster; its tests exercise open-loop fragments with hand-set queue depths
+(SURVEY.md §4).  This simulator closes the loop deterministically: a
+virtual queue fed at a configured arrival rate, drained by virtual worker
+replicas at a configured per-replica service rate, scaled by the *real*
+production ``ControlLoop``/``PodAutoScaler`` against the in-memory fakes on
+a ``FakeClock``.  Used by tests (dynamics assertions) and ``bench.py``
+(throughput measurement).
+"""
+
+from .simulator import SimConfig, SimResult, Simulation
+
+__all__ = ["SimConfig", "SimResult", "Simulation"]

@@ -1,0 +1,136 @@
+"""Deterministic queue/worker-pool world driven by the production loop.
+
+World model (fluid approximation of an SQS-fed worker Deployment):
+
+- messages arrive at ``arrival_rate`` msg/s;
+- each of the current ``replicas`` drains ``service_rate_per_replica`` msg/s;
+- queue depth integrates the net rate, floored at zero, and is updated
+  lazily whenever the controller observes it (each poll), so dynamics are
+  exact at observation points regardless of poll cadence.
+
+The controller under simulation is the real production stack —
+``ControlLoop`` + ``PodAutoScaler`` + ``QueueMetricSource`` — wired to the
+in-memory fakes on a ``FakeClock``; nothing is mocked *inside* the system
+under test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.clock import FakeClock
+from ..core.loop import ControlLoop, LoopConfig
+from ..metrics.fake import FakeQueueService
+from ..metrics.queue import QueueMetricSource
+from ..scale.actuator import PodAutoScaler
+from ..scale.fake import FakeDeploymentAPI
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """World + policy parameters (policy defaults = reference defaults)."""
+
+    arrival_rate: float = 50.0  # msg/s into the queue
+    service_rate_per_replica: float = 10.0  # msg/s drained per replica
+    duration: float = 600.0  # simulated seconds
+    initial_depth: float = 0.0
+    initial_replicas: int = 1
+    min_pods: int = 1
+    max_pods: int = 20
+    scale_up_pods: int = 1
+    scale_down_pods: int = 1
+    loop: LoopConfig = field(default_factory=LoopConfig)
+
+
+@dataclass
+class SimResult:
+    """Timeline of (t, observed_depth, replicas) at each poll + summary."""
+
+    timeline: list[tuple[float, int, int]]
+    final_replicas: int
+    final_depth: float
+    max_depth: float
+    ticks: int
+
+    @property
+    def replica_changes(self) -> int:
+        changes = 0
+        for (_, _, a), (_, _, b) in zip(self.timeline, self.timeline[1:]):
+            if a != b:
+                changes += 1
+        return changes
+
+
+class _WorldQueue(FakeQueueService):
+    """Queue whose depth integrates arrivals/drains up to observation time."""
+
+    def __init__(self, sim: "Simulation"):
+        super().__init__()
+        self._sim = sim
+
+    def get_queue_attributes(self, queue_url, attribute_names):
+        self._sim.advance_world()
+        depth = int(self._sim.depth)
+        self.set_queue_attributes({"ApproximateNumberOfMessages": str(depth)})
+        return super().get_queue_attributes(queue_url, attribute_names)
+
+
+class Simulation:
+    """One closed-loop episode."""
+
+    def __init__(self, config: SimConfig | None = None):
+        self.config = config or SimConfig()
+        self.clock = FakeClock()
+        self.depth = float(self.config.initial_depth)
+        self._last_world_update = 0.0
+        self.deployments = FakeDeploymentAPI.with_deployments(
+            "sim", self.config.initial_replicas, "workers"
+        )
+        self.scaler = PodAutoScaler(
+            client=self.deployments,
+            max=self.config.max_pods,
+            min=self.config.min_pods,
+            scale_up_pods=self.config.scale_up_pods,
+            scale_down_pods=self.config.scale_down_pods,
+            deployment="workers",
+            namespace="sim",
+        )
+        self.queue = _WorldQueue(self)
+        self.metric_source = QueueMetricSource(
+            client=self.queue,
+            queue_url="sim://queue",
+            attribute_names=("ApproximateNumberOfMessages",),
+        )
+        self.loop = ControlLoop(
+            self.scaler, self.metric_source, self.config.loop, clock=self.clock
+        )
+        self.timeline: list[tuple[float, int, int]] = []
+        self._max_depth = self.depth
+
+    def advance_world(self) -> None:
+        """Integrate queue dynamics from the last update to clock.now()."""
+        now = self.clock.now()
+        dt = now - self._last_world_update
+        if dt <= 0:
+            return
+        replicas = self.deployments.replicas("workers")
+        net_rate = (
+            self.config.arrival_rate
+            - replicas * self.config.service_rate_per_replica
+        )
+        self.depth = max(0.0, self.depth + net_rate * dt)
+        self._max_depth = max(self._max_depth, self.depth)
+        self._last_world_update = now
+        self.timeline.append((now, int(self.depth), replicas))
+
+    def run(self) -> SimResult:
+        ticks = max(1, int(self.config.duration / self.config.loop.poll_interval))
+        self.loop.run(max_ticks=ticks)
+        self.advance_world()
+        return SimResult(
+            timeline=self.timeline,
+            final_replicas=self.deployments.replicas("workers"),
+            final_depth=self.depth,
+            max_depth=self._max_depth,
+            ticks=self.loop.ticks,
+        )

@@ -1,0 +1,19 @@
+"""TPU workloads: what this controller scales.
+
+The reference scales generic queue-consumer pods (``README.md:18-66`` deploys
+it beside any Deployment that drains an SQS queue).  In a TPU shop the
+queue-fed worker is a JAX inference/training process, so this package
+provides a reference workload the rest of the framework can autoscale and
+benchmark against:
+
+- :mod:`.model`  — a decoder-only transformer in pure JAX, bf16, shaped for
+  the MXU (dims multiples of 128, fused-friendly ops, static shapes).
+- :mod:`.train`  — loss/step functions compiled with ``jax.jit`` over a
+  ``jax.sharding.Mesh`` with data/tensor-parallel sharding rules.
+- :mod:`.worker` — a queue-fed batch-inference worker: the process that a
+  Deployment replica runs, draining the very queue the controller watches.
+
+The controller itself (core/metrics/scale/cli) imports none of this; the
+dependency edge goes one way, mirroring the reference where the autoscaler
+and the scaled workload are separate programs.
+"""

@@ -1,0 +1,166 @@
+"""A decoder-only transformer in pure JAX, designed for TPU.
+
+This is the reference workload the autoscaler scales (see package
+docstring) — not a port of anything in ``/root/reference`` (the reference
+contains no model code; SURVEY.md §2 native-code census).
+
+TPU-first design choices:
+
+- **bf16 everywhere the MXU is involved**: parameters and activations are
+  ``bfloat16``; layernorm statistics and attention softmax run in ``float32``
+  for stability (the usual TPU mixed-precision recipe).
+- **MXU-friendly shapes**: all model dims default to multiples of 128 so XLA
+  tiles matmuls onto the 128x128 systolic array without padding waste.
+- **Static shapes, functional params**: params are a pytree of arrays;
+  ``forward`` is a pure function of ``(params, tokens)`` — trace-once,
+  compile-once under ``jax.jit``.
+- **Fusion-friendly**: elementwise work (gelu, residuals, scaling) is left
+  to XLA to fuse into the surrounding matmuls rather than hand-scheduled.
+- **Sharding-ready**: every parameter has a logical axis signature (see
+  :data:`PARAM_AXES`) that :mod:`.train` maps onto a device mesh for
+  data/tensor parallelism.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Transformer dimensions (defaults sized for quick single-chip runs)."""
+
+    vocab_size: int = 8192
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# Logical axes of each parameter, used by train.mesh_shardings to build
+# PartitionSpecs: "model" axes are sharded tensor-parallel, "ff"/"heads" are
+# the conventionally-sharded output axes of the two matmul families.
+PARAM_AXES = {
+    "embed": ("vocab", "model"),
+    "pos_embed": ("seq", "model"),
+    "final_ln_scale": ("model",),
+    "final_ln_bias": ("model",),
+    # per layer:
+    "ln1_scale": ("model",),
+    "ln1_bias": ("model",),
+    "wqkv": ("model", "three_heads"),  # [d_model, 3*d_model], shard out axis
+    "wo": ("heads", "model"),  # [d_model, d_model], shard in axis
+    "ln2_scale": ("model",),
+    "ln2_bias": ("model",),
+    "w_up": ("model", "ff"),  # [d_model, d_ff], shard out axis
+    "w_down": ("ff", "model"),  # [d_ff, d_model], shard in axis
+}
+
+
+def init_params(rng: jax.Array, config: ModelConfig) -> dict:
+    """Initialize a parameter pytree (scaled-normal init, bf16 storage)."""
+    dtype = config.dtype
+    keys = jax.random.split(rng, 2 + config.n_layers)
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    params = {
+        "embed": normal(keys[0], (config.vocab_size, config.d_model), 0.02),
+        "pos_embed": normal(keys[1], (config.max_seq_len, config.d_model), 0.02),
+        "final_ln_scale": jnp.ones((config.d_model,), dtype),
+        "final_ln_bias": jnp.zeros((config.d_model,), dtype),
+        "layers": [],
+    }
+    out_scale = 0.02 / (2 * config.n_layers) ** 0.5  # GPT-2-style depth scaling
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[2 + i], 4)
+        params["layers"].append(
+            {
+                "ln1_scale": jnp.ones((config.d_model,), dtype),
+                "ln1_bias": jnp.zeros((config.d_model,), dtype),
+                "wqkv": normal(lk[0], (config.d_model, 3 * config.d_model), 0.02),
+                "wo": normal(lk[1], (config.d_model, config.d_model), out_scale),
+                "ln2_scale": jnp.ones((config.d_model,), dtype),
+                "ln2_bias": jnp.zeros((config.d_model,), dtype),
+                "w_up": normal(lk[2], (config.d_model, config.d_ff), 0.02),
+                "w_down": normal(lk[3], (config.d_ff, config.d_model), out_scale),
+            }
+        )
+    return params
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    # fp32 statistics, bf16 output — the TPU-stable layernorm shape
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
+    batch, seq, _ = x.shape
+    qkv = x @ layer["wqkv"]  # [B, S, 3D] — one fused MXU matmul for q,k,v
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(batch, seq, config.n_heads, config.head_dim).transpose(
+            0, 2, 1, 3
+        )
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (config.head_dim**0.5)
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.where(causal, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)  # fp32 softmax
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
+    return out @ layer["wo"]
+
+
+def _mlp(x: jax.Array, layer: dict) -> jax.Array:
+    return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
+
+
+def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Logits for a token batch. Pure; jit/pjit at the call site.
+
+    ``tokens``: int32 ``[batch, seq]`` -> logits ``[batch, seq, vocab]``.
+    """
+    seq = tokens.shape[1]
+    x = params["embed"][tokens] + params["pos_embed"][:seq]
+    for layer in params["layers"]:
+        x = x + _attention(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]),
+                           layer, config)
+        x = x + _mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    # fp32 logits for a stable softmax/cross-entropy downstream
+    return jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+
+
+@partial(jax.jit, static_argnums=2)
+def forward_jit(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Single-chip jitted forward (the driver's ``entry()`` target)."""
+    return forward(params, tokens, config)
+
+
+def param_count(params: dict) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -1,0 +1,213 @@
+"""Sharded training/inference compilation over a device mesh.
+
+Scaling here is pure JAX SPMD: pick a ``Mesh`` with ``("data", "model")``
+axes, annotate parameter/batch shardings with ``NamedSharding`` /
+``PartitionSpec``, ``jax.jit`` the step, and let XLA insert the collectives
+(all-reduce for data-parallel grads, all-gather/reduce-scatter around the
+Megatron-style tensor-parallel matmuls) so they ride ICI.
+
+Sharding rules (classic Megatron pairing, applied via
+:data:`~.model.PARAM_AXES` logical names):
+
+- ``wqkv``/``w_up`` shard their *output* axis over ``model``;
+- ``wo``/``w_down`` shard their *input* axis over ``model`` (the pair's
+  all-reduce happens once, after the second matmul);
+- the embedding shards its vocab axis over ``model`` (the fp32 logits
+  einsum then reduce-scatters naturally);
+- layernorm scales/biases replicate;
+- activations/batches shard over ``data``.
+
+Optimizer state inherits each parameter's sharding, so Adam moments are
+distributed exactly like the weights (ZeRO-1-style for the tensor-parallel
+shards, replicated across ``data``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import ModelConfig, forward, init_params
+
+# logical axis name (model.PARAM_AXES) -> mesh axis
+_LOGICAL_TO_MESH = {
+    "vocab": "model",
+    "three_heads": "model",
+    "heads": "model",
+    "ff": "model",
+    "model": None,  # d_model axes replicate (Megatron 1D sharding)
+    "seq": None,
+}
+
+
+def make_mesh(
+    devices: list | None = None, model_parallel: int | None = None
+) -> Mesh:
+    """A ``("data", "model")`` mesh over the available devices.
+
+    ``model_parallel`` defaults to the largest power of two <= 4 dividing the
+    device count — small TP degree, rest data-parallel, the usual
+    bandwidth-friendly default for small models.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if model_parallel is None:
+        model_parallel = 1
+        for candidate in (4, 2):
+            if n % candidate == 0:
+                model_parallel = candidate
+                break
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    import numpy as np
+
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, ("data", "model"))
+
+
+def _param_spec(path: tuple, mesh: Mesh) -> P:
+    from .model import PARAM_AXES
+
+    name = path[-1]
+    axes = PARAM_AXES.get(name)
+    if axes is None:
+        return P()
+    return P(*(_LOGICAL_TO_MESH[a] for a in axes))
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    """NamedSharding pytree matching ``params`` (by PARAM_AXES rules)."""
+
+    def spec_for(path, leaf):
+        keys = tuple(
+            p.key if hasattr(p, "key") else str(p.idx) for p in path
+            if hasattr(p, "key") or hasattr(p, "idx")
+        )
+        return NamedSharding(mesh, _param_spec(keys or ("",), mesh))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data", None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    b1: float = 0.9
+    b2: float = 0.95
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    return optax.adamw(
+        config.learning_rate, b1=config.b1, b2=config.b2,
+        weight_decay=config.weight_decay,
+    )
+
+
+def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Next-token cross-entropy in fp32 (the standard LM objective)."""
+    logits = forward(params, tokens[:, :-1], config)  # [B, S-1, V] fp32
+    targets = tokens[:, 1:]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def init_train_state(
+    rng: jax.Array, model_config: ModelConfig, train_config: TrainConfig
+) -> dict:
+    params = init_params(rng, model_config)
+    opt_state = make_optimizer(train_config).init(params)
+    return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+
+def state_shardings(mesh: Mesh, state: dict) -> dict:
+    """Shard optimizer moments like their parameters; scalars replicate."""
+    p_shardings = param_shardings(mesh, state["params"])
+
+    # optax.adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/others)
+    def shard_opt(opt_state):
+        def map_one(entry):
+            if hasattr(entry, "mu"):  # ScaleByAdamState
+                return entry._replace(
+                    count=replicated(mesh),
+                    mu=p_shardings,
+                    nu=p_shardings,
+                )
+            return jax.tree.map(lambda _: replicated(mesh), entry)
+
+        return tuple(map_one(e) for e in opt_state)
+
+    return {
+        "params": p_shardings,
+        "opt_state": shard_opt(state["opt_state"]),
+        "step": replicated(mesh),
+    }
+
+
+def place_state(mesh: Mesh, state: dict) -> dict:
+    """Device-put the state pytree onto the mesh with its shardings."""
+    shardings = state_shardings(mesh, state)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state, shardings,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def make_train_step(
+    mesh: Mesh, model_config: ModelConfig, train_config: TrainConfig, state: dict
+):
+    """Compile one optimizer step over the mesh.
+
+    Returns ``step_fn(state, tokens) -> (state, loss)`` with input/output
+    shardings pinned so repeated calls stay stable (no resharding churn).
+    """
+    optimizer = make_optimizer(train_config)
+    shardings = state_shardings(mesh, state)
+
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], tokens, model_config
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
+            loss,
+        )
+
+    return jax.jit(
+        train_step,
+        in_shardings=(shardings, batch_sharding(mesh)),
+        out_shardings=(shardings, replicated(mesh)),
+        donate_argnums=0,
+    )
+
+
+def make_forward_step(mesh: Mesh, model_config: ModelConfig, params: Any):
+    """Compile sharded batch inference (the serving path workers run)."""
+    p_shardings = param_shardings(mesh, params)
+
+    def forward_step(params, tokens):
+        return forward(params, tokens, model_config)
+
+    return jax.jit(
+        forward_step,
+        in_shardings=(p_shardings, batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )

@@ -1,0 +1,127 @@
+"""Queue-fed inference worker: one replica of the Deployment being scaled.
+
+The reference autoscales pods that drain an SQS queue (``README.md:7-17``);
+this module is that pod's TPU-shaped equivalent: pull token batches off a
+work queue, run them through the sharded jitted forward pass, report results
+and throughput.  The simulator (:mod:`..sim`) and benchmarks compose many of
+these with the controller to close the loop end-to-end.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig, forward_jit
+
+
+@dataclass
+class WorkItem:
+    """One inference request: a token batch (static shape for jit reuse)."""
+
+    tokens: Any  # int32 [batch, seq]
+    id: int = 0
+
+
+@dataclass
+class WorkResult:
+    id: int
+    next_tokens: Any  # int32 [batch] — greedy next-token per sequence
+    latency_s: float
+
+
+class InferenceWorker:
+    """Drains a work queue through a compiled forward pass.
+
+    ``serve_forever`` mirrors the scaled pod's main loop; ``process`` is the
+    single-item path used by tests and the simulator.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: ModelConfig,
+        forward_fn: Callable[..., Any] | None = None,
+    ) -> None:
+        self.params = params
+        self.config = config
+        # default: single-chip jit; pass train.make_forward_step(...) output
+        # for a mesh-sharded serving path
+        self._forward = forward_fn or (
+            lambda params, tokens: forward_jit(params, tokens, config)
+        )
+        self.processed = 0
+
+    def process(self, item: WorkItem) -> WorkResult:
+        start = time.perf_counter()
+        logits = self._forward(self.params, item.tokens)
+        next_tokens = jnp.argmax(logits[:, -1, :], axis=-1)
+        next_tokens.block_until_ready()
+        self.processed += 1
+        return WorkResult(
+            id=item.id,
+            next_tokens=next_tokens,
+            latency_s=time.perf_counter() - start,
+        )
+
+    def serve_forever(
+        self,
+        work: "queue.Queue[WorkItem | None]",
+        results: "queue.Queue[WorkResult]",
+    ) -> None:
+        """Blocking drain loop; a ``None`` item is the shutdown sentinel."""
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            results.put(self.process(item))
+
+
+@dataclass
+class WorkerPool:
+    """A fixed-size pool of threads sharing one compiled model.
+
+    Thread-per-replica is faithful to "N pods drain one queue" while staying
+    in-process for tests/benchmarks; JAX dispatch releases the GIL during
+    device execution, so threads overlap host-side work.
+    """
+
+    worker_factory: Callable[[], InferenceWorker]
+    size: int = 1
+    work: "queue.Queue[WorkItem | None]" = field(default_factory=queue.Queue)
+    results: "queue.Queue[WorkResult]" = field(default_factory=queue.Queue)
+
+    def __post_init__(self) -> None:
+        self._threads: list[threading.Thread] = []
+        self.workers: list[InferenceWorker] = []
+
+    def start(self) -> None:
+        for _ in range(self.size):
+            worker = self.worker_factory()
+            self.workers.append(worker)
+            thread = threading.Thread(
+                target=worker.serve_forever, args=(self.work, self.results),
+                daemon=True,
+            )
+            thread.start()
+            self._threads.append(thread)
+
+    def submit(self, item: WorkItem) -> None:
+        self.work.put(item)
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self.work.put(None)
+        for thread in self._threads:
+            thread.join(timeout=30)
+        self._threads.clear()
+
+    def depth(self) -> int:
+        """Current backlog — the quantity the autoscaler thresholds on."""
+        return self.work.qsize()

@@ -1,8 +1,16 @@
 """Test configuration.
 
-JAX-based workload tests run on a virtual 8-device CPU mesh (no TPU needed):
-the env vars must be set before the first ``import jax`` anywhere in the
-process, which is why they live here at conftest import time.
+JAX-based workload tests run on a virtual 8-device CPU mesh (no TPU
+needed).  Two quirks of this image make the setup more than env vars:
+
+- ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` must be set before
+  the CPU backend initializes (done below; the backend only initializes on
+  first ``jax.devices()``).
+- The image's ``sitecustomize`` registers a TPU-tunnel PJRT plugin in every
+  Python process and calls ``jax.config.update("jax_platforms", "axon,cpu")``,
+  which *overrides* the ``JAX_PLATFORMS`` env var.  Re-apply the env choice
+  via ``jax.config`` so tests run on the virtual CPU mesh even when the
+  tunnel is unreachable.
 
 The controller-side tests (policy/loop/actuator/metrics/cli) import no JAX
 at all — mirroring the layering: the control plane is plain Python.
@@ -10,9 +18,28 @@ at all — mirroring the layering: the control plane is plain Python.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: this suite targets the virtual 8-device mesh, and the image's
+# global env carries JAX_PLATFORMS=axon (the TPU tunnel), so setdefault is
+# not enough.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+def _pin_jax_platform() -> None:
+    # jax may already be imported (sitecustomize); pin config to the env var.
+    # Guarded: jax is an optional extra — without it the controller tests
+    # must still collect and run.
+    import importlib.util
+
+    if importlib.util.find_spec("jax") is None:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+_pin_jax_platform()
