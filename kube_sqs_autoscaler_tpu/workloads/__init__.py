@@ -9,7 +9,12 @@ benchmark against:
 - :mod:`.model`  — a decoder-only transformer in pure JAX, bf16, shaped for
   the MXU (dims multiples of 128, fused-friendly ops, static shapes).
 - :mod:`.train`  — loss/step functions compiled with ``jax.jit`` over a
-  ``jax.sharding.Mesh`` with data/tensor-parallel sharding rules.
+  ``("data", "seq", "model")`` ``jax.sharding.Mesh``: data-parallel
+  batches, Megatron-style tensor-parallel weights, and sequence-parallel
+  activations.
+- :mod:`.ring`   — ring attention (``shard_map`` + ``ppermute`` + online
+  softmax) for the sequence axis: long-context support without ever
+  materializing the full attention matrix.
 - :mod:`.worker` — a queue-fed batch-inference worker: the process that a
   Deployment replica runs, draining the very queue the controller watches.
 

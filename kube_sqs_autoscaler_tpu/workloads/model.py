@@ -112,7 +112,22 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     )
 
 
-def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
+def _dense_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Default attention on ``[B, H, S, D]``: full causal, fp32 softmax."""
+    head_dim = q.shape[-1]
+    seq = q.shape[2]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (head_dim**0.5)
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.where(causal, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _attention(
+    x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
+) -> jax.Array:
     batch, seq, _ = x.shape
     qkv = x @ layer["wqkv"]  # [B, S, 3D] — one fused MXU matmul for q,k,v
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -123,13 +138,9 @@ def _attention(x: jax.Array, layer: dict, config: ModelConfig) -> jax.Array:
         )
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) / (config.head_dim**0.5)
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-    scores = jnp.where(causal, scores, jnp.float32(-1e9))
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)  # fp32 softmax
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    # seam for sequence-parallel ring attention (workloads.ring); the
+    # default is the dense single-mesh-shard path
+    out = (attention_fn or _dense_attention)(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
     return out @ layer["wo"]
 
@@ -138,16 +149,20 @@ def _mlp(x: jax.Array, layer: dict) -> jax.Array:
     return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
 
 
-def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+def forward(
+    params: dict, tokens: jax.Array, config: ModelConfig, attention_fn=None
+) -> jax.Array:
     """Logits for a token batch. Pure; jit/pjit at the call site.
 
     ``tokens``: int32 ``[batch, seq]`` -> logits ``[batch, seq, vocab]``.
+    ``attention_fn`` overrides the attention inner op (``[B,H,S,D]^3 -> out``),
+    e.g. ring attention for a sequence-sharded mesh.
     """
     seq = tokens.shape[1]
     x = params["embed"][tokens] + params["pos_embed"][:seq]
     for layer in params["layers"]:
         x = x + _attention(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]),
-                           layer, config)
+                           layer, config, attention_fn)
         x = x + _mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
     # fp32 logits for a stable softmax/cross-entropy downstream

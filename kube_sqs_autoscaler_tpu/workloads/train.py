@@ -47,28 +47,47 @@ _LOGICAL_TO_MESH = {
 
 
 def make_mesh(
-    devices: list | None = None, model_parallel: int | None = None
+    devices: list | None = None,
+    model_parallel: int | None = None,
+    seq_parallel: int = 1,
 ) -> Mesh:
-    """A ``("data", "model")`` mesh over the available devices.
+    """A ``("data", "seq", "model")`` mesh over the available devices.
 
     ``model_parallel`` defaults to the largest power of two <= 4 dividing the
     device count — small TP degree, rest data-parallel, the usual
-    bandwidth-friendly default for small models.
+    bandwidth-friendly default for small models.  ``seq_parallel`` > 1 adds
+    sequence/context parallelism: batches shard their sequence axis over
+    ``"seq"`` and attention runs as ring attention (:mod:`.ring`).
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if model_parallel is None:
         model_parallel = 1
         for candidate in (4, 2):
-            if n % candidate == 0:
+            if n % (candidate * seq_parallel) == 0:
                 model_parallel = candidate
                 break
-    if n % model_parallel:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    if n % (model_parallel * seq_parallel):
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel={model_parallel} "
+            f"x seq_parallel={seq_parallel}"
+        )
     import numpy as np
 
-    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(grid, ("data", "model"))
+    grid = np.asarray(devices).reshape(
+        n // (model_parallel * seq_parallel), seq_parallel, model_parallel
+    )
+    return Mesh(grid, ("data", "seq", "model"))
+
+
+def mesh_attention_fn(mesh: Mesh):
+    """Ring attention when the mesh has a nontrivial ``seq`` axis, else the
+    model's default dense path."""
+    if mesh.shape.get("seq", 1) > 1:
+        from .ring import make_ring_attention
+
+        return make_ring_attention(mesh)
+    return None
 
 
 def _param_spec(path: tuple, mesh: Mesh) -> P:
@@ -95,6 +114,9 @@ def param_shardings(mesh: Mesh, params: Any) -> Any:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
+    # tokens [B, S]: batch over data, sequence over seq (trivial when sp=1)
+    if "seq" in mesh.shape:
+        return NamedSharding(mesh, P("data", "seq"))
     return NamedSharding(mesh, P("data", None))
 
 
@@ -117,9 +139,16 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    """Next-token cross-entropy in fp32 (the standard LM objective)."""
-    logits = forward(params, tokens[:, :-1], config)  # [B, S-1, V] fp32
+def loss_fn(
+    params: Any, tokens: jax.Array, config: ModelConfig, attention_fn=None
+) -> jax.Array:
+    """Next-token cross-entropy in fp32 (the standard LM objective).
+
+    The forward pass runs on the full (shardable) sequence and the shift
+    happens on the logits, so the input length stays divisible by the
+    ``seq`` mesh axis under sequence parallelism.
+    """
+    logits = forward(params, tokens, config, attention_fn)[:, :-1]
     targets = tokens[:, 1:]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)
@@ -177,10 +206,11 @@ def make_train_step(
     """
     optimizer = make_optimizer(train_config)
     shardings = state_shardings(mesh, state)
+    attention_fn = mesh_attention_fn(mesh)
 
     def train_step(state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], tokens, model_config
+            state["params"], tokens, model_config, attention_fn
         )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
@@ -202,9 +232,10 @@ def make_train_step(
 def make_forward_step(mesh: Mesh, model_config: ModelConfig, params: Any):
     """Compile sharded batch inference (the serving path workers run)."""
     p_shardings = param_shardings(mesh, params)
+    attention_fn = mesh_attention_fn(mesh)
 
     def forward_step(params, tokens):
-        return forward(params, tokens, model_config)
+        return forward(params, tokens, model_config, attention_fn)
 
     return jax.jit(
         forward_step,

@@ -81,11 +81,13 @@ def test_param_count_is_plausible(tiny_params):
 
 def test_mesh_factory_prefers_small_model_parallel():
     mesh = make_mesh(jax.devices())
-    assert mesh.shape == {"data": 2, "model": 4}
+    assert mesh.shape == {"data": 2, "seq": 1, "model": 4}
     mesh2 = make_mesh(jax.devices()[:2])
-    assert mesh2.shape == {"data": 1, "model": 2}
+    assert mesh2.shape == {"data": 1, "seq": 1, "model": 2}
     mesh1 = make_mesh(jax.devices()[:1])
-    assert mesh1.shape == {"data": 1, "model": 1}
+    assert mesh1.shape == {"data": 1, "seq": 1, "model": 1}
+    mesh3 = make_mesh(jax.devices(), seq_parallel=2)
+    assert mesh3.shape == {"data": 1, "seq": 2, "model": 4}
 
 
 def test_param_shardings_follow_megatron_rules(tiny_params):
