@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import signal
 from typing import Sequence
 
 from .core.loop import ControlLoop, LoopConfig
@@ -152,8 +153,22 @@ def main(argv: Sequence[str] | None = None) -> None:
         attribute_names=parse_attribute_names(args.attribute_names),
     )
 
+    loop = ControlLoop(autoscaler, metric_source, config_from_args(args))
+
+    # Extension over the reference (which runs until killed): exit cleanly
+    # on SIGTERM/SIGINT so Kubernetes pod termination ends the current tick
+    # instead of hard-killing mid-RPC. Takes effect at the next tick
+    # boundary (at most one poll period later).
+    def _shutdown(signum: int, frame) -> None:
+        log.info("Received signal %d, shutting down after current tick", signum)
+        loop.stop()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+
     log.info("Starting kube-sqs-autoscaler")
-    ControlLoop(autoscaler, metric_source, config_from_args(args)).run()
+    loop.run()
+    log.info("kube-sqs-autoscaler stopped")
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -71,8 +71,18 @@ class ControlLoop:
         self._stop = threading.Event()
 
     def stop(self) -> None:
-        """Ask a running loop to exit after its current tick."""
+        """Ask the loop to exit after its current tick.
+
+        Sticky: a stop requested even *before* :meth:`run` starts (e.g. a
+        SIGTERM landing between handler installation and the run call) still
+        takes effect — ``run`` never clears the flag itself.  Use
+        :meth:`reset` to reuse a stopped loop.
+        """
         self._stop.set()
+
+    def reset(self) -> None:
+        """Clear a previous :meth:`stop` so the loop can run again."""
+        self._stop.clear()
 
     def run(self, max_ticks: int | None = None) -> PolicyState:
         """Run the loop; blocks until ``max_ticks`` ticks or :meth:`stop`.
@@ -81,13 +91,14 @@ class ControlLoop:
         fresh episode (fresh startup-grace state and tick budget);
         ``self.ticks`` accumulates across episodes for observability.
         """
-        self._stop.clear()
         state = initial_state(self.clock.now())
         ticks_this_run = 0
         while not self._stop.is_set():
             if max_ticks is not None and ticks_this_run >= max_ticks:
                 break
             self.clock.sleep(self.config.poll_interval)
+            if self._stop.is_set():  # stop requested mid-sleep: skip the tick
+                break
             state = self.tick(state)
             ticks_this_run += 1
             self.ticks += 1

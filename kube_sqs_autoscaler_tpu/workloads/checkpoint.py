@@ -1,0 +1,79 @@
+"""Checkpoint/resume for the workload's training state (orbax-backed).
+
+The *controller* is deliberately stateless — its whole memory is two
+in-process cooldown timestamps, reset on restart, with desired replica
+state living in the cluster (reference behavior, SURVEY.md §5
+"checkpoint/resume: none").  The *workload* is where checkpointing belongs
+in a TPU shop: a preemptible queue-fed trainer must save and restore its
+sharded train state.  This module wraps orbax's PyTree checkpointing with
+the two things our state needs:
+
+- restore **onto the mesh**: arrays come back placed with the same
+  ``NamedSharding``s the train step expects, so resume does not trigger a
+  resharding step;
+- tolerance of the optimizer-state pytree (optax namedtuples) via orbax's
+  standard tree handling.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from jax.sharding import Mesh
+
+from .train import state_shardings
+
+
+class TrainCheckpointer:
+    """Save/restore numbered train-state checkpoints under one directory."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._ckpt = ocp.StandardCheckpointer()
+
+    def _path(self, step: int) -> Path:
+        return self.directory / f"step_{step:08d}"
+
+    def save(self, state: dict, wait: bool = True) -> Path:
+        step = int(jax.device_get(state["step"]))
+        path = self._path(step)
+        self._ckpt.save(path, state)
+        if wait:
+            self._ckpt.wait_until_finished()
+        return path
+
+    def latest_step(self) -> int | None:
+        steps = sorted(
+            int(p.name.split("_")[1])
+            for p in self.directory.glob("step_*")
+            if p.is_dir()
+        )
+        return steps[-1] if steps else None
+
+    def restore(self, mesh: Mesh, reference_state: dict, step: int | None = None) -> dict:
+        """Restore (latest by default) placed onto ``mesh``'s shardings.
+
+        ``reference_state`` supplies the pytree structure/shapes/dtypes
+        (e.g. a freshly-initialized state); restored arrays are placed with
+        the exact shardings the train step uses.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        shardings = state_shardings(mesh, reference_state)
+        targets = jax.tree.map(
+            lambda leaf, sharding: jax.ShapeDtypeStruct(
+                jax.numpy.shape(leaf),
+                leaf.dtype if hasattr(leaf, "dtype") else type(leaf),
+                sharding=sharding,
+            ),
+            reference_state,
+            shardings,
+        )
+        return self._ckpt.restore(self._path(step), targets)

@@ -154,11 +154,18 @@ def forward(
 ) -> jax.Array:
     """Logits for a token batch. Pure; jit/pjit at the call site.
 
-    ``tokens``: int32 ``[batch, seq]`` -> logits ``[batch, seq, vocab]``.
+    ``tokens``: int32 ``[batch, seq]`` -> logits ``[batch, seq, vocab]``,
+    with ``seq <= config.max_seq_len`` (the LM loss shifts on the *logits*,
+    so a full-context training example is ``max_seq_len`` tokens long and
+    yields ``max_seq_len - 1`` targets; see ``train.loss_fn``).
     ``attention_fn`` overrides the attention inner op (``[B,H,S,D]^3 -> out``),
     e.g. ring attention for a sequence-sharded mesh.
     """
     seq = tokens.shape[1]
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
     x = params["embed"][tokens] + params["pos_embed"][:seq]
     for layer in params["layers"]:
         x = x + _attention(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]),

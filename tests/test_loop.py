@@ -205,6 +205,6 @@ def test_boundary_noop_refreshes_cooldown():
 
 def test_stop_exits_run():
     loop, _, _, clock = make_system(init_pods=3)
-    clock.at(3.5, loop.stop)  # fires during the 4th sleep
+    clock.at(3.5, loop.stop)  # fires during the 4th sleep: tick 4 is skipped
     loop.run()
-    assert loop.ticks == 4
+    assert loop.ticks == 3

@@ -1,0 +1,142 @@
+"""Property-based tests (hypothesis): the policy engine against its
+reference predicates for arbitrary inputs, and episode-level invariants of
+the full loop under arbitrary workload traces — bounds are never violated
+and cooldowns always separate actuations.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import (
+    Gate,
+    PolicyConfig,
+    PolicyState,
+    gate_down,
+    gate_up,
+    plan_tick,
+)
+from kube_sqs_autoscaler_tpu.metrics import FakeQueueService, QueueMetricSource
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+
+configs = st.builds(
+    PolicyConfig,
+    scale_up_messages=st.integers(0, 1000),
+    scale_down_messages=st.integers(0, 1000),
+    scale_up_cooldown=st.floats(0, 100, allow_nan=False),
+    scale_down_cooldown=st.floats(0, 100, allow_nan=False),
+)
+states = st.builds(
+    PolicyState,
+    last_scale_up=st.floats(-100, 100, allow_nan=False),
+    last_scale_down=st.floats(-100, 100, allow_nan=False),
+)
+
+
+@given(
+    n=st.integers(0, 2000),
+    now=st.floats(-100, 200, allow_nan=False),
+    config=configs,
+    state=states,
+)
+def test_gates_match_reference_predicates(n, now, config, state):
+    # main.go:51-52: inclusive threshold, strictly-After cooldown
+    up = gate_up(n, now, config, state)
+    if n >= config.scale_up_messages:
+        expected = (
+            Gate.COOLING
+            if state.last_scale_up + config.scale_up_cooldown > now
+            else Gate.FIRE
+        )
+    else:
+        expected = Gate.IDLE
+    assert up is expected
+
+    down = gate_down(n, now, config, state)
+    if n <= config.scale_down_messages:
+        expected = (
+            Gate.COOLING
+            if state.last_scale_down + config.scale_down_cooldown > now
+            else Gate.FIRE
+        )
+    else:
+        expected = Gate.IDLE
+    assert down is expected
+
+    # composed plan: up-cooling always skips the down branch (main.go:54)
+    plan = plan_tick(n, now, config, state)
+    assert plan.up is up
+    assert plan.down is (Gate.SKIPPED if up is Gate.COOLING else down)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    depths=st.lists(st.integers(0, 500), min_size=1, max_size=60),
+    up=st.integers(50, 300),
+    down=st.integers(0, 49),
+    up_cool=st.floats(0, 30, allow_nan=False),
+    down_cool=st.floats(0, 30, allow_nan=False),
+    min_pods=st.integers(1, 3),
+    extra=st.integers(0, 10),
+    init_offset=st.integers(0, 5),
+    step=st.integers(1, 5),
+)
+def test_episode_invariants(
+    depths, up, down, up_cool, down_cool, min_pods, extra, init_offset, step
+):
+    max_pods = min_pods + extra
+    init = min(min_pods + init_offset, max_pods)
+    api = FakeDeploymentAPI.with_deployments("ns", init, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=max_pods, min=min_pods, scale_up_pods=step,
+        scale_down_pods=step, deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(depths[0])
+    clock = FakeClock()
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(
+            poll_interval=1.0,
+            policy=PolicyConfig(
+                scale_up_messages=up, scale_down_messages=down,
+                scale_up_cooldown=up_cool, scale_down_cooldown=down_cool,
+            ),
+        ),
+        clock=clock,
+    )
+    # feed the depth trace: depth[i] becomes visible at t=i
+    for i, depth in enumerate(depths):
+        clock.at(float(i), lambda d=depth: queue.set_depths(d))
+
+    observations: list[tuple[float, int]] = []  # (t, replicas after tick)
+    original_tick = loop.tick
+
+    def recording_tick(state):
+        new_state = original_tick(state)
+        observations.append((clock.now(), api.replicas("deploy")))
+        return new_state
+
+    loop.tick = recording_tick
+    loop.run(max_ticks=len(depths))
+
+    # invariant 1: replica count always within [init-clamped bounds]
+    low = min(min_pods, init)
+    high = max(max_pods, init)
+    assert all(low <= r <= high for _, r in observations)
+
+    # invariant 2: successive increases are separated by >= up_cool
+    # (and decreases by >= down_cool)
+    last_up_time = None
+    last_down_time = None
+    prev = init
+    for t, replicas in observations:
+        if replicas > prev:
+            if last_up_time is not None:
+                assert t - last_up_time >= up_cool - 1e-6
+            last_up_time = t
+        elif replicas < prev:
+            if last_down_time is not None:
+                assert t - last_down_time >= down_cool - 1e-6
+            last_down_time = t
+        prev = replicas

@@ -1,0 +1,66 @@
+"""Shutdown semantics: stop is sticky across the run() boundary (the
+SIGTERM-before-run race) and reset() re-arms a stopped loop.
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.metrics import FakeQueueService, QueueMetricSource
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+
+
+def make_loop():
+    api = FakeDeploymentAPI.with_deployments("ns", 3, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=5, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(50)
+    return ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(poll_interval=1.0, policy=PolicyConfig()),
+        clock=FakeClock(),
+    ), queue
+
+
+def test_stop_before_run_prevents_any_tick():
+    # The SIGTERM-before-run race: a stop that lands before run() must hold.
+    loop, queue = make_loop()
+    loop.stop()
+    loop.run()  # must return immediately, forever-run notwithstanding
+    assert loop.ticks == 0
+    assert queue.get_calls == 0
+
+
+def test_reset_rearms_a_stopped_loop():
+    loop, queue = make_loop()
+    loop.stop()
+    loop.run()
+    assert loop.ticks == 0
+    loop.reset()
+    loop.run(max_ticks=2)
+    assert loop.ticks == 2
+    assert queue.get_calls == 2
+
+
+def test_model_rejects_overlong_sequence():
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        forward,
+        init_params,
+    )
+
+    config = ModelConfig(
+        vocab_size=64, d_model=128, n_heads=4, n_layers=1, d_ff=256,
+        max_seq_len=16,
+    )
+    params = init_params(jax.random.key(0), config)
+    tokens = jnp.zeros((1, 17), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        forward(params, tokens, config)
