@@ -10,6 +10,7 @@ injection for the metric-failure paths the reference never tests.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Mapping, Sequence
 
 
@@ -64,3 +65,77 @@ class FakeQueueService:
                 "ApproximateNumberOfMessagesNotVisible": str(not_visible),
             }
         )
+
+
+class FakeMessageQueue:
+    """In-memory queue with real message semantics (send/receive/delete).
+
+    Where :class:`FakeQueueService` fakes only the *attributes* surface the
+    controller reads (all the reference's mock does), this fake also models
+    the messages themselves with SQS-like visibility: ``receive`` makes a
+    message in-flight (counted in ``ApproximateNumberOfMessagesNotVisible``)
+    until it is ``delete``d or its visibility timeout lapses.  Lets worker +
+    autoscaler integration tests share one queue object end-to-end.
+
+    Time is injectable (``now_fn``) so visibility timeouts are
+    deterministic under a ``FakeClock``.
+    """
+
+    def __init__(self, visibility_timeout: float = 30.0, now_fn=None):
+        self._lock = threading.Lock()
+        self._now = now_fn or time.monotonic
+        self.visibility_timeout = visibility_timeout
+        self._visible: list[tuple[str, str]] = []  # (message_id, body)
+        # receipt_handle -> (deadline, message_id, body); like real SQS, a
+        # fresh receipt handle is issued per receive, so a stale handle
+        # from a previous delivery cannot delete a redelivered message
+        self._inflight: dict[str, tuple[float, str, str]] = {}
+        self._message_counter = 0
+        self._receipt_counter = 0
+
+    def _requeue_expired(self) -> None:
+        now = self._now()
+        expired = [
+            h for h, (deadline, _, _) in self._inflight.items() if deadline <= now
+        ]
+        for handle in expired:
+            _, message_id, body = self._inflight.pop(handle)
+            self._visible.append((message_id, body))
+
+    def send_message(self, queue_url: str, body: str) -> str:
+        with self._lock:
+            self._message_counter += 1
+            message_id = f"msg-{self._message_counter}"
+            self._visible.append((message_id, body))
+            return message_id
+
+    def receive_messages(
+        self, queue_url: str, max_messages: int = 1
+    ) -> list[dict]:
+        with self._lock:
+            self._requeue_expired()
+            batch, self._visible = (
+                self._visible[:max_messages],
+                self._visible[max_messages:],
+            )
+            deadline = self._now() + self.visibility_timeout
+            out = []
+            for message_id, body in batch:
+                self._receipt_counter += 1
+                handle = f"rh-{self._receipt_counter}"
+                self._inflight[handle] = (deadline, message_id, body)
+                out.append({"ReceiptHandle": handle, "Body": body})
+            return out
+
+    def delete_message(self, queue_url: str, receipt_handle: str) -> None:
+        with self._lock:
+            self._inflight.pop(receipt_handle, None)
+
+    def get_queue_attributes(self, queue_url, attribute_names):
+        with self._lock:
+            self._requeue_expired()
+            return {
+                "ApproximateNumberOfMessages": str(len(self._visible)),
+                "ApproximateNumberOfMessagesDelayed": "0",
+                "ApproximateNumberOfMessagesNotVisible": str(len(self._inflight)),
+            }

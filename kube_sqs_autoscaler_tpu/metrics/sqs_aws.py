@@ -196,25 +196,21 @@ class AwsSqsService:
             "or use a regional queue URL"
         )
 
-    def get_queue_attributes(
-        self, queue_url: str, attribute_names: Sequence[str]
-    ) -> Mapping[str, str]:
+    def _call(self, action: str, queue_url: str, body: dict) -> dict:
+        """One signed SQS JSON-protocol call (``X-Amz-Target`` dispatch)."""
         region = self._resolve_region(queue_url)
         credentials = self._current_credentials()
 
         parsed = urllib.parse.urlsplit(self.endpoint or queue_url)
         url = urllib.parse.urlunsplit((parsed.scheme, parsed.netloc, "/", "", ""))
-        body = json.dumps(
-            {"QueueUrl": queue_url, "AttributeNames": list(attribute_names)}
-        ).encode("utf-8")
         request = SignableRequest(
             method="POST",
             url=url,
             headers={
                 "Content-Type": "application/x-amz-json-1.0",
-                "X-Amz-Target": "AmazonSQS.GetQueueAttributes",
+                "X-Amz-Target": f"AmazonSQS.{action}",
             },
-            body=body,
+            body=json.dumps(body).encode("utf-8"),
         )
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         signed = sign_request(request, credentials, region, "sqs", amz_date)
@@ -224,11 +220,55 @@ class AwsSqsService:
         )
         try:
             with urllib.request.urlopen(http_request, timeout=self.timeout) as resp:
-                payload = json.loads(resp.read())
+                raw = resp.read()
+                return json.loads(raw) if raw.strip() else {}
         except urllib.error.HTTPError as err:
             detail = err.read().decode("utf-8", "replace")[:512]
             raise AwsError(f"SQS returned HTTP {err.code}: {detail}") from err
         except urllib.error.URLError as err:
             raise AwsError(f"SQS request failed: {err.reason}") from err
 
+    def get_queue_attributes(
+        self, queue_url: str, attribute_names: Sequence[str]
+    ) -> Mapping[str, str]:
+        payload = self._call(
+            "GetQueueAttributes",
+            queue_url,
+            {"QueueUrl": queue_url, "AttributeNames": list(attribute_names)},
+        )
         return payload.get("Attributes", {})
+
+    # --- message operations (used by the scaled workers, not the
+    # controller; the reference's controller likewise only ever reads
+    # attributes, sqs/sqs.go:51) ---------------------------------------
+
+    def send_message(self, queue_url: str, body: str) -> str:
+        payload = self._call(
+            "SendMessage", queue_url, {"QueueUrl": queue_url, "MessageBody": body}
+        )
+        return payload.get("MessageId", "")
+
+    def receive_messages(
+        self, queue_url: str, max_messages: int = 1, wait_time_s: int = 0
+    ) -> list[dict]:
+        payload = self._call(
+            "ReceiveMessage",
+            queue_url,
+            {
+                "QueueUrl": queue_url,
+                # SQS rejects MaxNumberOfMessages outside 1..10
+                "MaxNumberOfMessages": max(1, min(max_messages, 10)),
+                "WaitTimeSeconds": wait_time_s,
+            },
+        )
+        return [
+            {"ReceiptHandle": m["ReceiptHandle"], "Body": m.get("Body", "")}
+            for m in payload.get("Messages", [])
+        ]
+
+    def delete_message(self, queue_url: str, receipt_handle: str) -> None:
+        self._call(
+            "DeleteMessage",
+            queue_url,
+            {"QueueUrl": queue_url, "ReceiptHandle": receipt_handle},
+        )

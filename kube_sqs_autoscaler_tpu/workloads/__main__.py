@@ -1,0 +1,91 @@
+"""Worker binary: ``python -m kube_sqs_autoscaler_tpu.workloads``.
+
+Runs one queue-draining inference worker — the process a scaled Deployment
+replica executes.  ``--demo N`` self-feeds a local in-memory queue with N
+random messages instead of connecting to AWS (no credentials needed), which
+is also the quickest way to see the full workload path run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+from ..utils.logging import configure_logging
+
+
+def _honor_env_platforms() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative even when a site hook already
+    imported jax and overrode platform selection via ``jax.config``."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
+
+def main(argv=None) -> None:
+    configure_logging()
+    _honor_env_platforms()
+    log = logging.getLogger("worker")
+    parser = argparse.ArgumentParser(prog="kube-sqs-autoscaler-worker")
+    parser.add_argument("--sqs-queue-url", default="", help="The sqs queue url")
+    parser.add_argument("--aws-region", default="", help="Your AWS region")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument(
+        "--demo", type=int, default=0, metavar="N",
+        help="process N random messages from a local in-memory queue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from .model import ModelConfig, init_params
+    from .service import QueueWorker, ServiceConfig
+
+    model_config = ModelConfig(
+        vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
+        max_seq_len=max(64, args.seq_len),
+    )
+    params = init_params(jax.random.key(0), model_config)
+    service_config = ServiceConfig(
+        queue_url=args.sqs_queue_url, batch_size=args.batch_size,
+        seq_len=args.seq_len,
+    )
+
+    if args.demo:
+        import numpy as np
+
+        from ..metrics.fake import FakeMessageQueue
+
+        queue = FakeMessageQueue()
+        rng = np.random.default_rng(0)
+        for _ in range(args.demo):
+            ids = rng.integers(0, model_config.vocab_size, args.seq_len).tolist()
+            queue.send_message("demo://queue", json.dumps(ids))
+        service_config.queue_url = "demo://queue"
+        worker = QueueWorker(queue, params, model_config, service_config)
+        start = time.perf_counter()
+        while worker.processed < args.demo:
+            worker.run_once()
+        elapsed = time.perf_counter() - start
+        log.info(
+            "Processed %d messages in %.2fs (%.1f msg/s)",
+            worker.processed, elapsed, worker.processed / elapsed,
+        )
+        return
+
+    from ..metrics.sqs_aws import AwsSqsService
+
+    queue = AwsSqsService(region=args.aws_region)
+    worker = QueueWorker(queue, params, model_config, service_config)
+    log.info("Starting worker on %s", args.sqs_queue_url)
+    worker.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""The scaled workload as a service: queue-draining inference workers.
+
+This is the missing half of the reference's architecture: the reference
+README deploys the autoscaler *next to* an unspecified Deployment of
+queue-consumer pods (``README.md:7-17``).  Here that consumer exists — a
+worker that receives token batches from an SQS-compatible queue, runs the
+compiled model, and deletes processed messages — plus an elastic pool that
+sizes its worker count from a Deployment's replica count, closing the whole
+loop (queue → autoscaler → Deployment replicas → workers → queue) in one
+process for tests and demos.
+
+Message format: each message body is a JSON array of token ids.  Bodies are
+padded/truncated to the model's configured sequence length so every batch
+hits the same compiled XLA program (static shapes, no recompiles).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Protocol
+
+import jax.numpy as jnp
+import numpy as np
+
+from .model import ModelConfig, forward_jit
+
+log = logging.getLogger(__name__)
+
+
+class MessageQueue(Protocol):
+    """What a worker needs from a queue (satisfied by
+    :class:`~..metrics.fake.FakeMessageQueue` and
+    :class:`~..metrics.sqs_aws.AwsSqsService`)."""
+
+    def receive_messages(self, queue_url: str, max_messages: int = 1) -> list[dict]:
+        ...
+
+    def delete_message(self, queue_url: str, receipt_handle: str) -> None:
+        ...
+
+
+@dataclass
+class ServiceConfig:
+    queue_url: str
+    batch_size: int = 8  # messages pulled (and padded) per model call
+    seq_len: int = 64  # fixed length every body is padded/truncated to
+    pad_token: int = 0
+    idle_sleep_s: float = 0.05  # backoff when the queue is empty
+
+
+class QueueWorker:
+    """One worker: receive → batch → forward → delete, until stopped."""
+
+    def __init__(
+        self,
+        queue: MessageQueue,
+        params: Any,
+        model_config: ModelConfig,
+        service_config: ServiceConfig,
+        forward_fn=None,
+    ) -> None:
+        self.queue = queue
+        self.params = params
+        self.model_config = model_config
+        self.config = service_config
+        self._forward = forward_fn or (
+            lambda params, tokens: forward_jit(params, tokens, model_config)
+        )
+        self._stop = threading.Event()
+        self.processed = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _batch_tokens(self, bodies: list[str]) -> jnp.ndarray:
+        rows = np.full(
+            (self.config.batch_size, self.config.seq_len),
+            self.config.pad_token,
+            np.int32,
+        )
+        for i, body in enumerate(bodies):
+            try:
+                ids = json.loads(body)
+            except ValueError:
+                log.error("Dropping malformed message body (not JSON): %.64r", body)
+                continue
+            ids = np.asarray(ids, np.int32)[: self.config.seq_len]
+            rows[i, : ids.size] = ids
+        return jnp.asarray(rows)
+
+    def run_once(self) -> int:
+        """One receive/process/delete cycle. Returns messages processed."""
+        messages = self.queue.receive_messages(
+            self.config.queue_url, max_messages=self.config.batch_size
+        )
+        if not messages:
+            return 0
+        tokens = self._batch_tokens([m["Body"] for m in messages])
+        logits = self._forward(self.params, tokens)
+        # greedy next token per sequence; block so deletion happens strictly
+        # after compute succeeds (at-least-once processing: a crash here
+        # leaves messages in-flight to reappear after visibility timeout)
+        jnp.argmax(logits[:, -1, :], axis=-1).block_until_ready()
+        for message in messages:
+            self.queue.delete_message(
+                self.config.queue_url, message["ReceiptHandle"]
+            )
+        self.processed += len(messages)
+        return len(messages)
+
+    def run_forever(self) -> None:
+        import time
+
+        while not self._stop.is_set():
+            if self.run_once() == 0:
+                time.sleep(self.config.idle_sleep_s)
+
+
+class ElasticWorkerPool:
+    """Keeps the worker-thread count equal to a Deployment's replica count.
+
+    In production each replica is a pod running one :class:`QueueWorker`;
+    in-process this pool plays kubelet: poll the (fake or real) Deployment
+    API and start/stop worker threads to match ``spec.replicas`` — which is
+    exactly the surface the autoscaler actuates, closing the loop.
+    """
+
+    def __init__(self, deployment_api, deployment: str, worker_factory) -> None:
+        self.api = deployment_api
+        self.deployment = deployment
+        self.worker_factory = worker_factory
+        self.workers: list[QueueWorker] = []
+        self._threads: list[threading.Thread] = []
+
+    def reconcile(self) -> int:
+        """Match worker count to the Deployment's replicas; returns count."""
+        want = self.api.get(self.deployment).replicas
+        while len(self.workers) < want:
+            worker = self.worker_factory()
+            thread = threading.Thread(target=worker.run_forever, daemon=True)
+            thread.start()
+            self.workers.append(worker)
+            self._threads.append(thread)
+        while len(self.workers) > want:
+            worker = self.workers.pop()
+            worker.stop()
+        return len(self.workers)
+
+    @property
+    def processed(self) -> int:
+        return sum(w.processed for w in self.workers)
+
+    def stop_all(self) -> None:
+        for worker in self.workers:
+            worker.stop()
+        for thread in self._threads:
+            thread.join(timeout=30)
+        self.workers.clear()
+        self._threads.clear()

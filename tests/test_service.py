@@ -1,0 +1,178 @@
+"""Worker-service tests, ending in the full-story integration: one queue,
+real model compute, autoscaler scaling a fake Deployment, elastic worker
+pool following the replica count — queue drains, pool grows then shrinks.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig, init_params
+from kube_sqs_autoscaler_tpu.workloads.service import (
+    ElasticWorkerPool,
+    QueueWorker,
+    ServiceConfig,
+)
+
+TINY = ModelConfig(
+    vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256, max_seq_len=64
+)
+URL = "fake://jobs"
+
+
+def send_token_messages(queue, n, seq_len=16, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        ids = rng.integers(0, TINY.vocab_size, seq_len).tolist()
+        queue.send_message(URL, json.dumps(ids))
+
+
+def test_fake_message_queue_visibility_semantics():
+    now = [0.0]
+    queue = FakeMessageQueue(visibility_timeout=10.0, now_fn=lambda: now[0])
+    queue.send_message(URL, "a")
+    queue.send_message(URL, "b")
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "2"
+
+    batch = queue.receive_messages(URL, max_messages=1)
+    assert len(batch) == 1
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "1"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "1"
+
+    queue.delete_message(URL, batch[0]["ReceiptHandle"])
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+    # undeleted message reappears after the visibility timeout
+    second = queue.receive_messages(URL, max_messages=1)
+    now[0] = 11.0
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "1"
+    again = queue.receive_messages(URL, max_messages=1)
+    assert again[0]["Body"] == second[0]["Body"]
+    # fresh receipt handle per delivery: the stale handle from the first
+    # delivery must NOT delete the redelivered message (real SQS semantics)
+    assert again[0]["ReceiptHandle"] != second[0]["ReceiptHandle"]
+    queue.delete_message(URL, second[0]["ReceiptHandle"])  # stale: no-op
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "1"
+    queue.delete_message(URL, again[0]["ReceiptHandle"])  # current: works
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+
+def test_queue_worker_processes_and_deletes():
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 5)
+    params = init_params(jax.random.key(0), TINY)
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=4, seq_len=16),
+    )
+    assert worker.run_once() == 4
+    assert worker.run_once() == 1
+    assert worker.run_once() == 0
+    assert worker.processed == 5
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+
+def test_queue_worker_drops_malformed_messages():
+    queue = FakeMessageQueue()
+    queue.send_message(URL, "not json at all {{{")
+    params = init_params(jax.random.key(0), TINY)
+    worker = QueueWorker(
+        queue, params, TINY, ServiceConfig(queue_url=URL, batch_size=2, seq_len=16)
+    )
+    assert worker.run_once() == 1  # processed (as padding) and deleted
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+
+
+def test_full_story_queue_autoscaler_elastic_workers():
+    """The whole system, live: burst of work -> depth crosses threshold ->
+    autoscaler raises replicas -> pool adds workers -> queue drains ->
+    autoscaler scales back down -> pool shrinks."""
+    queue = FakeMessageQueue(visibility_timeout=60.0)
+    send_token_messages(queue, 120)
+
+    api = FakeDeploymentAPI.with_deployments("ns", 1, "workers")
+    scaler = PodAutoScaler(
+        client=api, max=4, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="workers", namespace="ns",
+    )
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url=URL),
+        LoopConfig(
+            poll_interval=0.05,
+            policy=PolicyConfig(
+                scale_up_messages=20, scale_down_messages=0,
+                scale_up_cooldown=0.1, scale_down_cooldown=0.1,
+            ),
+        ),
+    )
+    loop_thread = threading.Thread(target=loop.run, daemon=True)
+
+    params = init_params(jax.random.key(0), TINY)
+
+    from kube_sqs_autoscaler_tpu.workloads.model import forward_jit
+
+    def throttled_forward(params, tokens):
+        # simulate heavier inference so draining 120 messages reliably takes
+        # longer than the startup grace + one cooldown — otherwise a warm
+        # jit cache lets one worker drain the queue before any scale-up
+        time.sleep(0.02)
+        return forward_jit(params, tokens, TINY)
+
+    pool = ElasticWorkerPool(
+        api, "workers",
+        worker_factory=lambda: QueueWorker(
+            queue, params, TINY,
+            ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                          idle_sleep_s=0.01),
+            forward_fn=throttled_forward,
+        ),
+    )
+
+    loop_thread.start()
+    max_workers = 0
+    deadline = time.time() + 60
+    try:
+        while time.time() < deadline:
+            max_workers = max(max_workers, pool.reconcile())
+            attrs = queue.get_queue_attributes(URL, ())
+            if (
+                attrs["ApproximateNumberOfMessages"] == "0"
+                and attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+                and api.replicas("workers") == 1
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"did not settle: depth={attrs}, replicas={api.replicas('workers')}"
+            )
+    finally:
+        loop.stop()
+        pool.stop_all()
+        loop_thread.join(timeout=10)
+
+    assert max_workers > 1  # burst actually scaled the pool out
+    assert pool.processed + sum(w.processed for w in pool.workers) >= 0
+    # all 120 messages were processed exactly once (none lost, none left)
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"

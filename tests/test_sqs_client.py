@@ -88,6 +88,41 @@ def test_service_error_becomes_metric_error():
             source.num_messages()
 
 
+def test_message_operations_roundtrip():
+    # send/receive/delete speak the same signed JSON protocol with the
+    # right X-Amz-Target per action
+    state = {"deleted": []}
+
+    def handler(exchange):
+        target = exchange.headers["X-Amz-Target"]
+        body = json.loads(exchange.body)
+        if target == "AmazonSQS.SendMessage":
+            assert body["MessageBody"] == "[1, 2, 3]"
+            return Reply.json({"MessageId": "m-1"})
+        if target == "AmazonSQS.ReceiveMessage":
+            assert 1 <= body["MaxNumberOfMessages"] <= 10  # SQS hard limit
+            return Reply.json(
+                {"Messages": [{"ReceiptHandle": "rh-1", "Body": "[1, 2, 3]"}]}
+            )
+        if target == "AmazonSQS.DeleteMessage":
+            state["deleted"].append(body["ReceiptHandle"])
+            return Reply.json({})
+        raise AssertionError(f"unexpected target {target}")
+
+    with LocalHttpServer(handler) as server:
+        service = AwsSqsService(
+            region="us-east-1", credentials=CREDS, endpoint=server.url
+        )
+        url = f"{server.url}/123/q"
+        assert service.send_message(url, "[1, 2, 3]") == "m-1"
+        messages = service.receive_messages(url, max_messages=16)  # clamped
+        assert messages == [{"ReceiptHandle": "rh-1", "Body": "[1, 2, 3]"}]
+        service.delete_message(url, "rh-1")
+    assert state["deleted"] == ["rh-1"]
+    for exchange in server.exchanges:
+        assert exchange.headers["Authorization"].startswith("AWS4-HMAC-SHA256")
+
+
 def test_transport_error_is_aws_error():
     service = AwsSqsService(
         region="us-east-1", credentials=CREDS, endpoint="http://127.0.0.1:1",
