@@ -112,6 +112,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--kubernetes-namespace", default="default",
         help="The namespace your deployment is running in",
     )
+    # Extension over the reference (which has no metrics/health endpoints,
+    # SURVEY.md §5). 0 disables the server entirely = reference behavior.
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help=(
+            "Serve /metrics (Prometheus), /healthz and /readyz on this port "
+            "(0 = disabled)"
+        ),
+    )
     return parser
 
 
@@ -153,7 +162,18 @@ def main(argv: Sequence[str] | None = None) -> None:
         attribute_names=parse_attribute_names(args.attribute_names),
     )
 
-    loop = ControlLoop(autoscaler, metric_source, config_from_args(args))
+    server = None
+    observer = None
+    if args.metrics_port:
+        from .obs import ControllerMetrics, ObservabilityServer
+
+        observer = ControllerMetrics()
+        server = ObservabilityServer(observer, port=args.metrics_port)
+        server.start()
+
+    loop = ControlLoop(
+        autoscaler, metric_source, config_from_args(args), observer=observer
+    )
 
     # Extension over the reference (which runs until killed): exit cleanly
     # on SIGTERM/SIGINT so Kubernetes pod termination ends the current tick
@@ -167,7 +187,11 @@ def main(argv: Sequence[str] | None = None) -> None:
     signal.signal(signal.SIGINT, _shutdown)
 
     log.info("Starting kube-sqs-autoscaler")
-    loop.run()
+    try:
+        loop.run()
+    finally:
+        if server is not None:
+            server.stop()
     log.info("kube-sqs-autoscaler stopped")
 
 

@@ -1,0 +1,72 @@
+"""Structured tick events: the loop's observability seam.
+
+The reference's only observability is logrus text lines at fixed decision
+points (SURVEY.md §5 "Metrics / logging / observability — Logging only").
+Those log lines are preserved verbatim in :mod:`.loop`; this module adds the
+structured counterpart as an *extension*: the loop fills one
+:class:`TickRecord` per tick and hands it to an optional
+:class:`TickObserver`.  Consumers (the Prometheus registry in
+:mod:`..obs.prometheus`, tests, traces) read the record; the loop itself
+never depends on what observers do — an observer exception is logged and
+swallowed so the loop's never-dies guarantee (``main.go:43-47``) extends to
+instrumentation.
+
+Lives in ``core`` (not ``obs``) so the layering stays one-directional:
+``obs`` imports ``core``, never the reverse.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+from .policy import Gate
+
+
+@dataclass
+class TickRecord:
+    """Everything that happened in one loop tick, as one value.
+
+    Field semantics mirror the tick flow (``main.go:41-79``):
+
+    - ``metric_error`` set ⇒ the tick ended at the observation
+      (``num_messages`` is ``None`` and both gates stay ``SKIPPED``);
+    - ``up``/``down`` are the gate outcomes actually evaluated this tick —
+      ``down`` remains ``SKIPPED`` when the up gate was ``COOLING`` (the
+      reference's ``continue`` at ``main.go:54``);
+    - ``up_error``/``down_error`` set ⇒ the gate fired but actuation failed
+      (the cooldown timestamp was *not* advanced);
+    - ``duration`` is measured on the loop's own clock, so it is virtual
+      under a ``FakeClock`` and wall-clock in production.
+    """
+
+    start: float
+    duration: float = 0.0
+    num_messages: int | None = None
+    metric_error: str | None = None
+    up: Gate = Gate.SKIPPED
+    down: Gate = Gate.SKIPPED
+    up_error: str | None = None
+    down_error: str | None = None
+
+    def scaled(self, direction: str) -> bool:
+        """Did this tick successfully actuate in ``direction`` ("up"/"down")?
+
+        Mirrors the reference's "success" notion (``main.go:62,76``):
+        the gate fired and the actuation call returned — including
+        boundary no-ops, which count as success.
+        """
+        if direction == "up":
+            return self.up is Gate.FIRE and self.up_error is None
+        if direction == "down":
+            return self.down is Gate.FIRE and self.down_error is None
+        raise ValueError(f"direction must be 'up' or 'down', got {direction!r}")
+
+
+@runtime_checkable
+class TickObserver(Protocol):
+    """Anything that wants the per-tick record."""
+
+    def on_tick(self, record: TickRecord) -> None:
+        """Called once per completed tick, after all tick side effects."""
+        ...

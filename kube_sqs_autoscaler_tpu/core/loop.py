@@ -30,6 +30,7 @@ import threading
 from dataclasses import dataclass, field
 
 from .clock import Clock, SystemClock
+from .events import TickObserver, TickRecord
 from .policy import (
     Gate,
     PolicyConfig,
@@ -62,11 +63,13 @@ class ControlLoop:
         metric_source: MetricSource,
         config: LoopConfig | None = None,
         clock: Clock | None = None,
+        observer: TickObserver | None = None,
     ) -> None:
         self.scaler = scaler
         self.metric_source = metric_source
         self.config = config or LoopConfig()
         self.clock = clock or SystemClock()
+        self.observer = observer
         self.ticks = 0  # completed ticks (observability; not used by policy)
         self._stop = threading.Event()
 
@@ -105,13 +108,32 @@ class ControlLoop:
         return state
 
     def tick(self, state: PolicyState) -> PolicyState:
-        """One loop body (post-sleep): observe, plan, actuate. Returns new state."""
+        """One loop body (post-sleep): observe, plan, actuate. Returns new state.
+
+        Side-effect order and log lines are the reference's; the only
+        addition is the :class:`~.events.TickRecord` handed to the optional
+        observer after the tick completes.
+        """
+        record = TickRecord(start=self.clock.now())
+        try:
+            return self._tick(state, record)
+        finally:
+            record.duration = self.clock.now() - record.start
+            if self.observer is not None:
+                try:
+                    self.observer.on_tick(record)
+                except Exception:  # instrumentation must never kill the loop
+                    log.exception("Tick observer failed")
+
+    def _tick(self, state: PolicyState, record: TickRecord) -> PolicyState:
         try:
             num_messages = self.metric_source.num_messages()
         except Exception as err:  # the loop must never die (main.go:43-47)
             log.error("Failed to get SQS messages: %s", err)
+            record.metric_error = str(err)
             return state
 
+        record.num_messages = num_messages
         log.info("Found %d messages in the queue", num_messages)
 
         # Gates are evaluated sequentially with a fresh clock read each, like
@@ -119,7 +141,7 @@ class ControlLoop:
         # real clock the down gate sees time that has advanced past the
         # scale-up RPCs.
         policy = self.config.policy
-        up = gate_up(num_messages, self.clock.now(), policy, state)
+        record.up = up = gate_up(num_messages, self.clock.now(), policy, state)
         if up is Gate.COOLING:
             log.info("Waiting for cool down, skipping scale up ")
             return state
@@ -128,10 +150,13 @@ class ControlLoop:
                 self.scaler.scale_up()
             except Exception as err:
                 log.error("Failed scaling up: %s", err)
+                record.up_error = str(err)
                 return state
             state = mark_scaled_up(state, self.clock.now())
 
-        down = gate_down(num_messages, self.clock.now(), policy, state)
+        record.down = down = gate_down(
+            num_messages, self.clock.now(), policy, state
+        )
         if down is Gate.COOLING:
             log.info("Waiting for cool down, skipping scale down")
             return state
@@ -140,6 +165,7 @@ class ControlLoop:
                 self.scaler.scale_down()
             except Exception as err:
                 log.error("Failed scaling down: %s", err)
+                record.down_error = str(err)
                 return state
             state = mark_scaled_down(state, self.clock.now())
 

@@ -1,0 +1,11 @@
+"""Observability: Prometheus metrics + health/readiness endpoints.
+
+Extension over the reference, which has *no* metrics endpoint, no
+Prometheus, no health/readiness probes (SURVEY.md §5).  Opt-in via
+``--metrics-port`` (default 0 = disabled ⇒ reference behavior exactly).
+"""
+
+from .prometheus import ControllerMetrics
+from .server import ObservabilityServer
+
+__all__ = ["ControllerMetrics", "ObservabilityServer"]

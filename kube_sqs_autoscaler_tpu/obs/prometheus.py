@@ -1,0 +1,119 @@
+"""Prometheus metrics registry fed by the loop's tick records.
+
+The reference exposes no metrics of any kind — "No metrics endpoint, no
+Prometheus, no events posted to Kubernetes" (SURVEY.md §5).  This registry
+is the structured counterpart of its logrus decision-point lines
+(``main.go:49,53,67``): every number here is derivable from the per-tick
+:class:`~..core.events.TickRecord`, so plugging it in changes nothing about
+loop behavior.
+
+No client library: the exposition format is the simple line-oriented
+Prometheus text format 0.0.4 and the dependency budget is stdlib-only
+(mirroring the reference's tiny dependency footprint).  Thread-safe —
+the loop thread writes, HTTP handler threads render.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core.events import TickRecord
+from ..core.policy import Gate
+
+_PREFIX = "kube_sqs_autoscaler"
+
+
+class ControllerMetrics:
+    """Tick-record aggregator + Prometheus text renderer.
+
+    Implements the :class:`~..core.events.TickObserver` protocol; pass as
+    ``ControlLoop(observer=...)``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._observations = 0
+        self._metric_failures = 0
+        self._queue_messages: int | None = None
+        self._cooldown_skips = {"up": 0, "down": 0}
+        self._scale_events = {"up": 0, "down": 0}
+        self._scale_failures = {"up": 0, "down": 0}
+        self._tick_seconds_sum = 0.0
+
+    def on_tick(self, record: TickRecord) -> None:
+        with self._lock:
+            self._ticks += 1
+            self._tick_seconds_sum += record.duration
+            if record.metric_error is not None:
+                self._metric_failures += 1
+                return
+            self._observations += 1
+            self._queue_messages = record.num_messages
+            for direction, gate, error in (
+                ("up", record.up, record.up_error),
+                ("down", record.down, record.down_error),
+            ):
+                if gate is Gate.COOLING:
+                    self._cooldown_skips[direction] += 1
+                elif gate is Gate.FIRE:
+                    if error is None:
+                        self._scale_events[direction] += 1
+                    else:
+                        self._scale_failures[direction] += 1
+
+    @property
+    def ready(self) -> bool:
+        """Readiness = at least one successful queue observation."""
+        with self._lock:
+            return self._observations > 0
+
+    def render(self) -> str:
+        """The registry as Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            lines = [
+                f"# HELP {_PREFIX}_ticks_total Completed control-loop ticks.",
+                f"# TYPE {_PREFIX}_ticks_total counter",
+                f"{_PREFIX}_ticks_total {self._ticks}",
+                f"# HELP {_PREFIX}_observations_total Successful queue-depth reads.",
+                f"# TYPE {_PREFIX}_observations_total counter",
+                f"{_PREFIX}_observations_total {self._observations}",
+                f"# HELP {_PREFIX}_metric_failures_total Failed queue-depth reads.",
+                f"# TYPE {_PREFIX}_metric_failures_total counter",
+                f"{_PREFIX}_metric_failures_total {self._metric_failures}",
+                f"# HELP {_PREFIX}_queue_messages Last observed queue depth.",
+                f"# TYPE {_PREFIX}_queue_messages gauge",
+            ]
+            if self._queue_messages is not None:
+                lines.append(f"{_PREFIX}_queue_messages {self._queue_messages}")
+            lines += [
+                f"# HELP {_PREFIX}_scale_events_total Successful scale actuations"
+                " (includes boundary no-ops, which the reference counts as"
+                " success).",
+                f"# TYPE {_PREFIX}_scale_events_total counter",
+            ]
+            lines += self._directional(self._scale_events, "scale_events_total")
+            lines += [
+                f"# HELP {_PREFIX}_scale_failures_total Failed scale actuations.",
+                f"# TYPE {_PREFIX}_scale_failures_total counter",
+            ]
+            lines += self._directional(self._scale_failures, "scale_failures_total")
+            lines += [
+                f"# HELP {_PREFIX}_cooldown_skips_total Ticks skipped in cooldown.",
+                f"# TYPE {_PREFIX}_cooldown_skips_total counter",
+            ]
+            lines += self._directional(self._cooldown_skips, "cooldown_skips_total")
+            lines += [
+                f"# HELP {_PREFIX}_tick_duration_seconds Tick latency"
+                " (observe + decide + actuate).",
+                f"# TYPE {_PREFIX}_tick_duration_seconds summary",
+                f"{_PREFIX}_tick_duration_seconds_sum {self._tick_seconds_sum}",
+                f"{_PREFIX}_tick_duration_seconds_count {self._ticks}",
+            ]
+            return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _directional(values: dict[str, int], name: str) -> list[str]:
+        return [
+            f'{_PREFIX}_{name}{{direction="{d}"}} {v}' for d, v in values.items()
+        ]

@@ -1,0 +1,234 @@
+"""Observability extension: tick records, Prometheus registry, HTTP probes.
+
+The reference has no metrics endpoint, no Prometheus, and no
+health/readiness probes (SURVEY.md §5); these tests cover the opt-in
+extension and — critically — that plugging it in changes nothing about loop
+behavior (same replica outcomes, observer failures swallowed).
+"""
+
+import http.client
+import re
+
+from kube_sqs_autoscaler_tpu.cli import build_parser
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.events import TickRecord
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import Gate, PolicyConfig
+from kube_sqs_autoscaler_tpu.metrics import FakeQueueService, QueueMetricSource
+from kube_sqs_autoscaler_tpu.obs import ControllerMetrics, ObservabilityServer
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+from kube_sqs_autoscaler_tpu.core.types import MetricError, ScaleError
+
+
+class RecordingObserver:
+    def __init__(self):
+        self.records: list[TickRecord] = []
+
+    def on_tick(self, record: TickRecord) -> None:
+        self.records.append(record)
+
+
+def make_system(observer, *, depths=(100, 100, 100), init_pods=3, **policy):
+    api = FakeDeploymentAPI.with_deployments("ns", init_pods, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=5, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(*depths)
+    source = QueueMetricSource(client=queue, queue_url="example.com")
+    loop = ControlLoop(
+        scaler,
+        source,
+        LoopConfig(
+            poll_interval=1.0,
+            policy=PolicyConfig(
+                scale_up_messages=policy.get("up_msgs", 100),
+                scale_down_messages=policy.get("down_msgs", 3),
+                scale_up_cooldown=policy.get("up_cool", 1.0),
+                scale_down_cooldown=policy.get("down_cool", 1.0),
+            ),
+        ),
+        clock=FakeClock(),
+        observer=observer,
+    )
+    return loop, api, queue
+
+
+# --- tick records -----------------------------------------------------------
+
+
+def test_observer_sees_one_record_per_tick_with_gate_outcomes():
+    obs = RecordingObserver()
+    loop, _, _ = make_system(obs, depths=(100, 100, 100))  # 300 >= 100: up
+    loop.run(max_ticks=3)
+    assert len(obs.records) == 3
+    assert all(r.num_messages == 300 for r in obs.records)
+    assert all(r.up is Gate.FIRE for r in obs.records)
+    assert all(r.down is Gate.IDLE for r in obs.records)
+    assert obs.records[0].scaled("up") and not obs.records[0].scaled("down")
+
+
+def test_record_on_metric_failure_skips_gates():
+    obs = RecordingObserver()
+    loop, _, queue = make_system(obs)
+    queue.fail_next_get = MetricError("boom")
+    loop.run(max_ticks=1)
+    (record,) = obs.records
+    # the metric source wraps with the reference's context string
+    # ("Failed to get messages in SQS", sqs/sqs.go:53)
+    assert record.metric_error == "Failed to get messages in SQS"
+    assert record.num_messages is None
+    assert record.up is Gate.SKIPPED and record.down is Gate.SKIPPED
+
+
+def test_record_up_cooling_marks_down_skipped():
+    obs = RecordingObserver()
+    # up_cool=2, poll=1: tick1 (t=1) is in startup grace -> COOLING,
+    # tick2 (t=2) fires, tick3 (t=3, last=2) -> COOLING again
+    loop, _, _ = make_system(obs, up_cool=2.0)
+    loop.run(max_ticks=3)
+    assert [r.up for r in obs.records] == [Gate.COOLING, Gate.FIRE, Gate.COOLING]
+    assert obs.records[0].down is Gate.SKIPPED  # the reference's `continue`
+    assert obs.records[2].down is Gate.SKIPPED
+
+
+def test_record_actuation_failure_sets_error_not_scaled():
+    obs = RecordingObserver()
+    loop, api, _ = make_system(obs)
+    api.fail_next_update = ScaleError("apiserver 500")
+    loop.run(max_ticks=1)
+    (record,) = obs.records
+    assert record.up is Gate.FIRE
+    # the actuator raises the reference's context string (scale/scale.go:57)
+    assert record.up_error == "Failed to scale up"
+    assert not record.scaled("up")
+
+
+def test_observer_exception_does_not_kill_loop():
+    class Exploding:
+        def on_tick(self, record):
+            raise RuntimeError("observer bug")
+
+    loop, api, _ = make_system(Exploding())
+    loop.run(max_ticks=3)
+    assert api.replicas("deploy") == 5  # 3→4→5 with up_cool=1.0 = poll
+
+
+def test_loop_behavior_identical_with_and_without_observer():
+    plain, plain_api, _ = make_system(None, depths=(1, 1, 1))
+    observed, obs_api, _ = make_system(
+        ControllerMetrics(), depths=(1, 1, 1)
+    )
+    plain.run(max_ticks=10)
+    observed.run(max_ticks=10)
+    assert plain_api.replicas("deploy") == obs_api.replicas("deploy") == 1
+
+
+# --- Prometheus registry ----------------------------------------------------
+
+
+def test_registry_counts_full_episode():
+    metrics = ControllerMetrics()
+    loop, _, queue = make_system(metrics, up_cool=2.0)
+    queue.fail_next_get = MetricError("transient")
+    # tick1 (t=1): metric failure; tick2 (t=2): cooldown expired, scale up;
+    # tick3 (t=3): up cooling (down skipped)
+    loop.run(max_ticks=3)
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_ticks_total 3" in text
+    assert "kube_sqs_autoscaler_metric_failures_total 1" in text
+    assert "kube_sqs_autoscaler_observations_total 2" in text
+    assert "kube_sqs_autoscaler_queue_messages 300" in text
+    assert 'kube_sqs_autoscaler_scale_events_total{direction="up"} 1' in text
+    assert 'kube_sqs_autoscaler_scale_events_total{direction="down"} 0' in text
+    assert 'kube_sqs_autoscaler_cooldown_skips_total{direction="up"} 1' in text
+    assert "kube_sqs_autoscaler_tick_duration_seconds_count 3" in text
+
+
+def test_registry_counts_scale_failures():
+    metrics = ControllerMetrics()
+    loop, api, _ = make_system(metrics)
+    api.fail_next_update = ScaleError("conflict")
+    loop.run(max_ticks=1)
+    text = metrics.render()
+    assert 'kube_sqs_autoscaler_scale_failures_total{direction="up"} 1' in text
+    assert 'kube_sqs_autoscaler_scale_events_total{direction="up"} 0' in text
+
+
+def test_queue_messages_gauge_absent_until_first_observation():
+    metrics = ControllerMetrics()
+    sample_lines = [
+        line
+        for line in metrics.render().splitlines()
+        if line.startswith("kube_sqs_autoscaler_queue_messages")
+    ]
+    assert sample_lines == []  # HELP/TYPE only, no sample yet
+    assert "# TYPE kube_sqs_autoscaler_queue_messages gauge" in metrics.render()
+
+
+# --- HTTP endpoints ---------------------------------------------------------
+
+
+def _get(port: int, path: str) -> tuple[int, str]:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.status, response.read().decode()
+    finally:
+        conn.close()
+
+
+def test_http_endpoints_health_ready_metrics_404():
+    metrics = ControllerMetrics()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert _get(server.port, "/healthz") == (200, "ok\n")
+        status, _ = _get(server.port, "/readyz")
+        assert status == 503  # no observation yet
+        metrics.on_tick(TickRecord(start=0.0, num_messages=42))
+        assert _get(server.port, "/readyz") == (200, "ok\n")
+        status, body = _get(server.port, "/metrics")
+        assert status == 200
+        assert "kube_sqs_autoscaler_queue_messages 42" in body
+        status, _ = _get(server.port, "/nope")
+        assert status == 404
+    finally:
+        server.stop()
+
+
+def test_http_server_serves_registry_fed_by_live_loop():
+    metrics = ControllerMetrics()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        loop, _, _ = make_system(metrics)
+        loop.run(max_ticks=5)
+        _, body = _get(server.port, "/metrics")
+        assert "kube_sqs_autoscaler_ticks_total 5" in body
+    finally:
+        server.stop()
+
+
+# --- CLI wiring -------------------------------------------------------------
+
+
+def test_metrics_port_flag_defaults_to_disabled():
+    args = build_parser().parse_args([])
+    assert args.metrics_port == 0
+
+
+def test_metrics_render_is_prometheus_parseable():
+    """Every non-comment line is `name{labels}? value` with a float value."""
+    metrics = ControllerMetrics()
+    metrics.on_tick(TickRecord(start=0.0, duration=0.25, num_messages=7))
+    sample = re.compile(
+        r'^kube_sqs_autoscaler_[a-z_]+(\{[a-z_]+="[a-z]+"(,[a-z_]+="[a-z]+")*\})?'
+        r" -?[0-9.]+$"
+    )
+    for line in metrics.render().strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), line
+        float(line.rsplit(" ", 1)[1])  # value must parse
