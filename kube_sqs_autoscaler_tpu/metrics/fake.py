@@ -110,8 +110,10 @@ class FakeMessageQueue:
             return message_id
 
     def receive_messages(
-        self, queue_url: str, max_messages: int = 1
+        self, queue_url: str, max_messages: int = 1, wait_time_s: int = 0
     ) -> list[dict]:
+        # long polling is a no-op for the in-memory fake: an empty receive
+        # returns immediately rather than blocking virtual/real time
         with self._lock:
             self._requeue_expired()
             batch, self._visible = (

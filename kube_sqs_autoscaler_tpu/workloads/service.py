@@ -35,7 +35,9 @@ class MessageQueue(Protocol):
     :class:`~..metrics.fake.FakeMessageQueue` and
     :class:`~..metrics.sqs_aws.AwsSqsService`)."""
 
-    def receive_messages(self, queue_url: str, max_messages: int = 1) -> list[dict]:
+    def receive_messages(
+        self, queue_url: str, max_messages: int = 1, wait_time_s: int = 0
+    ) -> list[dict]:
         ...
 
     def delete_message(self, queue_url: str, receipt_handle: str) -> None:
@@ -49,6 +51,11 @@ class ServiceConfig:
     seq_len: int = 64  # fixed length every body is padded/truncated to
     pad_token: int = 0
     idle_sleep_s: float = 0.05  # backoff when the queue is empty
+    # SQS long polling: the receive call itself blocks up to this long when
+    # the queue is empty, so idle workers cost ~0.05 req/s instead of one
+    # (billed) empty ReceiveMessage per idle_sleep_s. Fakes ignore it.
+    receive_wait_s: int = 20
+    error_backoff_s: float = 1.0  # pause after a failed cycle
 
 
 class QueueWorker:
@@ -82,19 +89,26 @@ class QueueWorker:
             np.int32,
         )
         for i, body in enumerate(bodies):
+            # the whole decode is guarded: a body that is valid JSON but not
+            # an integer array ('"abc"', '5', nested lists of strings) must
+            # be dropped like non-JSON, not crash the worker — the message
+            # still gets deleted after the batch, so poison messages are
+            # consumed rather than redelivered forever
             try:
-                ids = json.loads(body)
-            except ValueError:
-                log.error("Dropping malformed message body (not JSON): %.64r", body)
+                ids = np.asarray(json.loads(body), np.int32).reshape(-1)
+            except Exception:
+                log.error("Dropping malformed message body: %.64r", body)
                 continue
-            ids = np.asarray(ids, np.int32)[: self.config.seq_len]
+            ids = ids[: self.config.seq_len]
             rows[i, : ids.size] = ids
         return jnp.asarray(rows)
 
     def run_once(self) -> int:
         """One receive/process/delete cycle. Returns messages processed."""
         messages = self.queue.receive_messages(
-            self.config.queue_url, max_messages=self.config.batch_size
+            self.config.queue_url,
+            max_messages=self.config.batch_size,
+            wait_time_s=self.config.receive_wait_s,
         )
         if not messages:
             return 0
@@ -112,11 +126,20 @@ class QueueWorker:
         return len(messages)
 
     def run_forever(self) -> None:
-        import time
-
+        # same never-dies guarantee as the control loop (main.go:43-47):
+        # a transient queue/compute error logs, backs off, and retries —
+        # unprocessed messages stay in-flight and reappear after the
+        # visibility timeout. Pauses use the stop event so stop() wakes a
+        # backing-off worker immediately.
         while not self._stop.is_set():
-            if self.run_once() == 0:
-                time.sleep(self.config.idle_sleep_s)
+            try:
+                idle = self.run_once() == 0
+            except Exception as err:
+                log.error("Worker cycle failed: %s", err)
+                self._stop.wait(self.config.error_backoff_s)
+                continue
+            if idle:
+                self._stop.wait(self.config.idle_sleep_s)
 
 
 class ElasticWorkerPool:
@@ -132,31 +155,75 @@ class ElasticWorkerPool:
         self.api = deployment_api
         self.deployment = deployment
         self.worker_factory = worker_factory
-        self.workers: list[QueueWorker] = []
-        self._threads: list[threading.Thread] = []
+        # live (worker, thread) pairs; scaled-down pairs move to _retiring
+        # until their thread exits, so their processed counts are never lost
+        self._members: list[tuple[QueueWorker, threading.Thread]] = []
+        self._retiring: list[tuple[QueueWorker, threading.Thread]] = []
+        self._retired_processed = 0
+
+    @property
+    def workers(self) -> list[QueueWorker]:
+        """The live workers (kubelet view: running pods of the Deployment)."""
+        return [worker for worker, _ in self._members]
+
+    def _prune(self) -> None:
+        # fold finished retirees' final counts into the retired total
+        still_retiring = []
+        for worker, thread in self._retiring:
+            if thread.is_alive():
+                still_retiring.append((worker, thread))
+            else:
+                self._retired_processed += worker.processed
+        self._retiring = still_retiring
+        # a dead thread is not a live worker: drop it (keeping its count) so
+        # reconcile replaces it instead of counting a corpse toward replicas
+        live = []
+        for worker, thread in self._members:
+            if thread.is_alive():
+                live.append((worker, thread))
+            else:
+                log.error("Worker thread died; replacing on this reconcile")
+                self._retired_processed += worker.processed
+        self._members = live
 
     def reconcile(self) -> int:
-        """Match worker count to the Deployment's replicas; returns count."""
+        """Match live worker count to the Deployment's replicas; returns count."""
+        self._prune()
         want = self.api.get(self.deployment).replicas
-        while len(self.workers) < want:
+        while len(self._members) < want:
             worker = self.worker_factory()
             thread = threading.Thread(target=worker.run_forever, daemon=True)
             thread.start()
-            self.workers.append(worker)
-            self._threads.append(thread)
-        while len(self.workers) > want:
-            worker = self.workers.pop()
+            self._members.append((worker, thread))
+        while len(self._members) > want:
+            worker, thread = self._members.pop()
             worker.stop()
-        return len(self.workers)
+            self._retiring.append((worker, thread))
+        return len(self._members)
 
     @property
     def processed(self) -> int:
-        return sum(w.processed for w in self.workers)
+        """Total messages processed over the pool's lifetime (scaled-down and
+        crashed workers included)."""
+        return (
+            self._retired_processed
+            + sum(w.processed for w, _ in self._members)
+            + sum(w.processed for w, _ in self._retiring)
+        )
 
     def stop_all(self) -> None:
-        for worker in self.workers:
+        for worker, _ in self._members + self._retiring:
             worker.stop()
-        for thread in self._threads:
+        self._retiring += self._members
+        self._members = []
+        for _, thread in self._retiring:
             thread.join(timeout=30)
-        self.workers.clear()
-        self._threads.clear()
+        # folds counts of exited threads only; a straggler that outlives the
+        # join timeout stays in _retiring (and in `processed`) rather than
+        # having a stale count frozen while it is still deleting messages
+        self._prune()
+        if self._retiring:
+            log.error(
+                "%d worker thread(s) still alive after stop_all join timeout",
+                len(self._retiring),
+            )

@@ -101,6 +101,89 @@ def test_queue_worker_drops_malformed_messages():
     assert attrs["ApproximateNumberOfMessages"] == "0"
 
 
+def test_queue_worker_survives_poison_json_bodies():
+    """Valid JSON that is not an int array must be dropped, not crash the
+    worker — and must be deleted, not redelivered forever."""
+    queue = FakeMessageQueue()
+    queue.send_message(URL, '"abc"')  # JSON string -> asarray ValueError
+    queue.send_message(URL, "5")  # 0-d scalar
+    queue.send_message(URL, "[[1, 2], [3, 4]]")  # nested: flattened
+    queue.send_message(URL, '["x", "y"]')  # non-int list
+    params = init_params(jax.random.key(0), TINY)
+    worker = QueueWorker(
+        queue, params, TINY, ServiceConfig(queue_url=URL, batch_size=8, seq_len=16)
+    )
+    assert worker.run_once() == 4  # no crash, all consumed
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+
+def test_worker_loop_survives_transient_queue_errors():
+    """run_forever extends the control loop's never-dies guarantee
+    (main.go:43-47) to the worker: a receive error backs off and retries."""
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 2)
+    boom = {"armed": True}
+    real_receive = queue.receive_messages
+
+    def flaky_receive(*args, **kwargs):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("transient network blip")
+        return real_receive(*args, **kwargs)
+
+    queue.receive_messages = flaky_receive
+    params = init_params(jax.random.key(0), TINY)
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                      idle_sleep_s=0.01, error_backoff_s=0.01),
+    )
+    thread = threading.Thread(target=worker.run_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.time() + 30
+        while worker.processed < 2 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        worker.stop()
+        thread.join(timeout=10)
+    assert worker.processed == 2  # survived the blip and drained the queue
+
+
+def test_pool_replaces_dead_workers():
+    """reconcile must count thread liveness, not list length: a crashed
+    worker is pruned (keeping its count) and replaced."""
+    queue = FakeMessageQueue()
+    api = FakeDeploymentAPI.with_deployments("ns", 2, "workers")
+    params = init_params(jax.random.key(0), TINY)
+    pool = ElasticWorkerPool(
+        api, "workers",
+        worker_factory=lambda: QueueWorker(
+            queue, params, TINY,
+            ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                          idle_sleep_s=0.01),
+        ),
+    )
+    try:
+        assert pool.reconcile() == 2
+        # kill one worker thread by stopping its worker (thread exits)
+        victim = pool.workers[0]
+        victim.processed = 7  # pretend it did work before dying
+        victim.stop()
+        deadline = time.time() + 10
+        while pool._members[0][1].is_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        # same replica count: the dead thread is replaced, not double-counted
+        assert pool.reconcile() == 2
+        assert all(t.is_alive() for _, t in pool._members)
+        assert pool.processed == 7  # the dead worker's count was retired
+    finally:
+        pool.stop_all()
+    assert pool.processed == 7  # lifetime count survives stop_all
+
+
 def test_full_story_queue_autoscaler_elastic_workers():
     """The whole system, live: burst of work -> depth crosses threshold ->
     autoscaler raises replicas -> pool adds workers -> queue drains ->
@@ -171,7 +254,9 @@ def test_full_story_queue_autoscaler_elastic_workers():
         loop_thread.join(timeout=10)
 
     assert max_workers > 1  # burst actually scaled the pool out
-    assert pool.processed + sum(w.processed for w in pool.workers) >= 0
+    # lifetime count survives scale-down and stop_all: every message that
+    # left the queue was counted by some (possibly retired) worker
+    assert pool.processed == 120
     # all 120 messages were processed exactly once (none lost, none left)
     attrs = queue.get_queue_attributes(URL, ())
     assert attrs["ApproximateNumberOfMessages"] == "0"
