@@ -1,0 +1,197 @@
+"""Pallas flash attention: the workload's hot op as a TPU kernel.
+
+Causal multi-head attention with the flash-attention schedule — online
+softmax over key/value blocks, never materializing the ``[S, S]`` score
+matrix — written in Pallas for TPU (no reference counterpart: the reference
+contains no numerical code at all, SURVEY.md §2).
+
+Why a kernel when XLA already fuses well: for ``S`` up to a few thousand the
+dense path (``model._dense_attention``) is fine, but its ``[B, H, S, S]``
+fp32 score tensor is HBM-resident; at ``S = 8k`` with 8 heads that is 2 GiB
+per example. The flash schedule keeps only per-block tiles on chip, turning
+attention from HBM-bandwidth-bound to MXU-bound.
+
+TPU mapping:
+
+- grid ``(batch, heads, S/block_q, S/block_k)``; TPU grid iteration is
+  sequential with the last axis innermost, so the fp32 running
+  max / sum / output accumulators live in VMEM *scratch* that persists
+  across the ``k`` axis (initialized at ``k==0``, written out at the last
+  ``k`` block) — VMEM residency is O(block), independent of ``S``;
+- q/k/v arrive as ``[block, head_dim]`` VMEM tiles via BlockSpec index
+  maps; score tiles hit the MXU via
+  ``jnp.dot(..., preferred_element_type=f32)``;
+- causality makes blocks strictly above the diagonal no-ops (``pl.when``
+  skips their compute entirely — about half the FLOPs of full attention)
+  and masks the partial diagonal blocks with ``-inf``;
+- block sizes default to 128 to match the MXU/VPU lane width.
+
+Plugs into the model through the ``attention_fn`` seam
+(``model.forward(..., attention_fn=flash_attention)``); composes with ring
+attention by serving as the per-shard local kernel.
+
+Off TPU the kernel runs in Pallas interpret mode (exact same code path), so
+the CPU test suite validates the real kernel — but interpret mode is
+Python-speed, which is why :func:`attention_fn_for` only dispatches to the
+kernel when actually running on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 128
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, max_ref, sum_ref, acc_ref,
+    *, block_q: int, block_k: int, scale: float, causal: bool,
+):
+    # q_ref/o_ref: [1, 1, block_q, D] tiles; k_ref/v_ref: [1, 1, block_k, D]
+    q_block_idx = pl.program_id(2)
+    k_block_idx = pl.program_id(3)
+    num_k_blocks = pl.num_programs(3)
+    q_offset = q_block_idx * block_q
+    k_offset = k_block_idx * block_k
+
+    @pl.when(k_block_idx == 0)
+    def _init():
+        max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # blocks strictly above the diagonal contribute nothing under causality
+    diagonal_or_below = k_offset <= q_offset + block_q - 1
+
+    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        scores = jnp.dot(
+            q, k.astype(jnp.float32).T, preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(rows >= cols, scores, -jnp.inf)
+        run_max = max_ref[:]
+        block_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_max = jnp.maximum(run_max, block_max)
+        # rows fully masked in THIS block get exp(-inf - finite) = 0; rows
+        # with no finite max yet cannot occur under causal iteration order
+        # (k block 0 is unmasked for every q row)
+        probs = jnp.exp(scores - new_max)
+        correction = jnp.exp(run_max - new_max)
+        max_ref[:] = new_max
+        sum_ref[:] = sum_ref[:] * correction + jnp.sum(
+            probs, axis=-1, keepdims=True
+        )
+        acc_ref[:] = acc_ref[:] * correction + jnp.dot(
+            probs.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(k_block_idx == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / sum_ref[:]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "causal", "interpret")
+)
+def _flash_call(
+    q, k, v, *, block_q: int, block_k: int, causal: bool, interpret: bool
+):
+    batch, heads, seq_len, head_dim = q.shape
+    grid = (batch, heads, seq_len // block_q, seq_len // block_k)
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+    )
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        scale=1.0 / head_dim**0.5,
+        causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    causal: bool = True,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal flash attention on ``[B, H, S, D]`` (drop-in for
+    ``model._dense_attention``).
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, Pallas
+    interpreter elsewhere (same code path, for tests/CPU dev — slow).
+    Requires ``S`` divisible by the block sizes; callers with small/odd
+    shapes should use the dense path (see :func:`attention_fn_for`).
+    """
+    seq_len = q.shape[2]
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    if seq_len % block_q or seq_len % block_k:
+        raise ValueError(
+            f"seq_len={seq_len} not divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_call(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        interpret=interpret,
+    )
+
+
+def attention_fn_for(
+    seq_len: int, *, block: int = DEFAULT_BLOCK, backend: str | None = None
+):
+    """Pick the attention implementation for a static sequence length.
+
+    The flash kernel is chosen only when (a) the shape tiles cleanly onto
+    the MXU blocks AND (b) the backend is actually TPU — everywhere else
+    the dense XLA path wins (off TPU the kernel would run in the
+    Python-speed Pallas interpreter, which must never end up on a serving
+    hot path). ``backend=None`` reads ``jax.default_backend()``.
+
+    Use as ``forward(..., attention_fn=attention_fn_for(seq))``.
+    """
+    from .model import _dense_attention
+
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "tpu" and seq_len >= block and seq_len % block == 0:
+        return flash_attention
+    return _dense_attention

@@ -184,5 +184,15 @@ def forward_jit(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Arr
     return forward(params, tokens, config)
 
 
+@partial(jax.jit, static_argnums=(2, 3))
+def forward_jit_with(
+    params: dict, tokens: jax.Array, config: ModelConfig, attention_fn
+) -> jax.Array:
+    """Jitted forward with a chosen attention implementation (e.g. the
+    Pallas flash kernel from :mod:`.flash`); ``attention_fn`` is static so
+    each implementation gets its own compiled program."""
+    return forward(params, tokens, config, attention_fn)
+
+
 def param_count(params: dict) -> int:
     return sum(p.size for p in jax.tree.leaves(params))

@@ -25,7 +25,8 @@ from typing import Any, Protocol
 import jax.numpy as jnp
 import numpy as np
 
-from .model import ModelConfig, forward_jit
+from .flash import attention_fn_for
+from .model import ModelConfig, forward_jit_with
 
 log = logging.getLogger(__name__)
 
@@ -73,8 +74,14 @@ class QueueWorker:
         self.params = params
         self.model_config = model_config
         self.config = service_config
+        # default forward picks the attention kernel by sequence length:
+        # the Pallas flash kernel when seq_len tiles onto the MXU blocks,
+        # the dense XLA path for small/odd shapes
+        attention_fn = attention_fn_for(service_config.seq_len)
         self._forward = forward_fn or (
-            lambda params, tokens: forward_jit(params, tokens, model_config)
+            lambda params, tokens: forward_jit_with(
+                params, tokens, model_config, attention_fn
+            )
         )
         self._stop = threading.Event()
         self.processed = 0

@@ -101,6 +101,38 @@ def test_queue_worker_drops_malformed_messages():
     assert attrs["ApproximateNumberOfMessages"] == "0"
 
 
+def test_queue_worker_with_flash_attention_forward():
+    """The worker drains the queue with the Pallas flash kernel as its
+    forward (forced into interpret mode here since this suite runs on CPU;
+    on TPU the default forward picks this kernel automatically via
+    flash.attention_fn_for whenever seq_len tiles onto the MXU blocks)."""
+    import functools
+
+    from kube_sqs_autoscaler_tpu.workloads.flash import (
+        attention_fn_for,
+        flash_attention,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import forward
+
+    assert attention_fn_for(128, backend="tpu") is flash_attention
+    config = ModelConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=128,
+    )
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 2, seq_len=128)
+    params = init_params(jax.random.key(0), config)
+    flash_interpret = functools.partial(flash_attention, interpret=True)
+    worker = QueueWorker(
+        queue, params, config,
+        ServiceConfig(queue_url=URL, batch_size=2, seq_len=128),
+        forward_fn=lambda p, t: forward(p, t, config, flash_interpret),
+    )
+    assert worker.run_once() == 2
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+
+
 def test_queue_worker_survives_poison_json_bodies():
     """Valid JSON that is not an int array must be dropped, not crash the
     worker — and must be deleted, not redelivered forever."""
