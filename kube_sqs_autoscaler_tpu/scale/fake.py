@@ -14,9 +14,7 @@ cover the error paths the reference never tests (SURVEY.md §4 gaps).
 
 from __future__ import annotations
 
-import copy
 import threading
-
 
 from .objects import Deployment
 
@@ -37,7 +35,7 @@ class FakeDeploymentAPI:
         self.fail_next_get: Exception | None = None
         self.fail_next_update: Exception | None = None
         for deployment in deployments or []:
-            self._store[deployment.name] = copy.deepcopy(deployment)
+            self._store[deployment.name] = deployment.clone()
 
     @classmethod
     def with_deployments(
@@ -58,7 +56,7 @@ class FakeDeploymentAPI:
                 raise err
             if name not in self._store:
                 raise NotFoundError(f'deployments.apps "{name}" not found')
-            return copy.deepcopy(self._store[name])
+            return self._store[name].clone()
 
     def update(self, deployment: Deployment) -> Deployment:
         with self._lock:
@@ -68,8 +66,8 @@ class FakeDeploymentAPI:
                 raise err
             if deployment.name not in self._store:
                 raise NotFoundError(f'deployments.apps "{deployment.name}" not found')
-            self._store[deployment.name] = copy.deepcopy(deployment)
-            return copy.deepcopy(deployment)
+            self._store[deployment.name] = deployment.clone()
+            return deployment.clone()
 
     def replicas(self, name: str) -> int:
         """Test convenience: current stored replica count."""

@@ -126,6 +126,22 @@ def test_fake_copies_objects_like_clientgo_fake():
     assert api.replicas("d") == 3
 
 
+def test_fake_copies_are_deep_through_the_raw_body():
+    api = FakeDeploymentAPI(
+        "ns",
+        [Deployment(name="d", namespace="ns", replicas=3,
+                    raw={"spec": {"replicas": 3, "template": {"x": 1}}})],
+    )
+    fetched = api.get("d")
+    fetched.raw["spec"]["template"]["x"] = 99  # nested mutation must not leak
+    assert api.get("d").raw["spec"]["template"]["x"] == 1
+    # and store-side objects must be independent of the caller's after update
+    sent = fetched.with_replicas(4)
+    api.update(sent)
+    sent.raw["spec"]["template"]["x"] = 42
+    assert api.get("d").raw["spec"]["template"]["x"] == 99
+
+
 def test_current_above_max_is_noop_and_below_min_is_noop():
     # current > max: reference's `>=` gate no-ops rather than clamping down
     p = make_autoscaler(5, 1, 8, 1, 1)
