@@ -1,0 +1,302 @@
+"""Autoregressive decoding with a KV cache: the serving-shaped workload.
+
+The reference's scaled pods are queue-draining workers (``README.md:7-17``);
+:mod:`.worker` models them with a full forward per request.  Real LM serving
+decodes token-by-token, so this module adds the TPU-native decode path (no
+reference counterpart — the reference contains no model code, SURVEY.md §2):
+
+- **Static shapes under jit**: the cache is pre-allocated at
+  ``max_seq_len`` and the current length is a traced ``int32`` scalar —
+  every decode step compiles once and reuses the same executable
+  regardless of position (``lax.dynamic_update_slice`` writes, an
+  iota-vs-length mask reads).
+- **Prefill vs decode split**: the prompt runs through one big causal
+  forward (MXU-bound, reuses the model's dense/flash attention) while
+  populating the cache; each generated token then runs the cheap
+  single-position path (HBM-bandwidth-bound GEMVs against the cache).
+- **``lax.scan`` generation**: the whole generate loop lives inside one
+  jit — no per-token Python dispatch, no host↔device sync until the
+  final token block comes back.
+- **bf16 cache, fp32 softmax**: cache entries store in the model dtype;
+  attention scores and normalization run in fp32 like the training path.
+- **Mesh-ready**: :func:`cache_shardings` shards the cache's heads axis
+  over ``"model"`` (matching the Megatron-sharded ``wqkv``) and batch over
+  ``"data"``; :func:`make_serving_fns` pins those shardings into compiled
+  prefill/decode/generate steps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import (
+    ModelConfig,
+    _block,
+    _dense_attention,
+    _layer_norm,
+)
+
+
+def init_cache(config: ModelConfig, batch: int) -> dict:
+    """Empty KV cache: per layer ``[B, H, max_seq_len, head_dim]`` in the
+    model dtype, plus the current ``length`` as a traced-friendly scalar."""
+    shape = (batch, config.n_heads, config.max_seq_len, config.head_dim)
+    return {
+        "layers": [
+            {
+                "k": jnp.zeros(shape, config.dtype),
+                "v": jnp.zeros(shape, config.dtype),
+            }
+            for _ in range(config.n_layers)
+        ],
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _final_logits(params: dict, x: jax.Array) -> jax.Array:
+    """Last-position logits: final LN + tied-embedding readout in fp32."""
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )[:, -1]
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attention_fn=None,
+) -> tuple[jax.Array, dict]:
+    """Run the prompt through the model, populating a fresh cache.
+
+    ``tokens``: int32 ``[batch, prompt_len]`` → (last-position logits
+    ``[batch, vocab]`` fp32, cache at ``length == prompt_len``).  The prompt
+    occupies cache positions ``[0, prompt_len)``; ``attention_fn`` selects
+    the prompt-pass attention (dense default, flash kernel on TPU).
+    """
+    batch, prompt_len = tokens.shape
+    if prompt_len > config.max_seq_len:
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len={config.max_seq_len}"
+        )
+    cache = init_cache(config, batch)
+    inner = attention_fn or _dense_attention
+    new_layers = []
+    x = params["embed"][tokens] + params["pos_embed"][:prompt_len]
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            # capture this layer's k/v into the padded cache, then run the
+            # normal causal attention for the prompt pass
+            new_layers.append(
+                {
+                    "k": _lc["k"].at[:, :, :prompt_len].set(k.astype(config.dtype)),
+                    "v": _lc["v"].at[:, :, :prompt_len].set(v.astype(config.dtype)),
+                }
+            )
+            return inner(q, k, v)
+
+        x = _block(x, layer, config, attend)
+    logits = _final_logits(params, x)
+    return logits, {
+        "layers": new_layers,
+        "length": jnp.asarray(prompt_len, jnp.int32),
+    }
+
+
+def _cached_attention(
+    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, length: jax.Array
+) -> jax.Array:
+    """One query position against the padded cache.
+
+    ``q``: ``[B, H, 1, D]``; cache: ``[B, H, S_max, D]`` with valid entries
+    at positions ``<= length`` (the current token was just written at
+    ``length``). fp32 scores/softmax; masked positions get ``-inf``.
+    """
+    head_dim = q.shape[-1]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / (head_dim**0.5)
+    positions = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    scores = jnp.where(positions <= length, scores, jnp.float32(-jnp.inf))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
+
+
+def decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step: feed ``tokens`` (int32 ``[batch]``, the
+    token at position ``cache["length"]``), return (fp32 logits
+    ``[batch, vocab]`` for the next position, updated cache)."""
+    pos = cache["length"]
+    x = params["embed"][tokens][:, None, :] + jnp.take(
+        params["pos_embed"], pos, axis=0
+    )
+    new_layers = []
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            # write this position's k/v at `pos`, then attend the single
+            # query against the whole (masked) cache
+            k_cache = jax.lax.dynamic_update_slice(
+                _lc["k"], k.astype(config.dtype), (0, 0, pos, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                _lc["v"], v.astype(config.dtype), (0, 0, pos, 0)
+            )
+            new_layers.append({"k": k_cache, "v": v_cache})
+            return _cached_attention(q, k_cache, v_cache, pos)
+
+        x = _block(x, layer, config, attend)
+    logits = _final_logits(params, x)
+    return logits, {"layers": new_layers, "length": pos + 1}
+
+
+def _pick(logits: jax.Array, key: jax.Array | None, temperature: float) -> jax.Array:
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    num_tokens: int,
+    config: ModelConfig,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    attention_fn=None,
+) -> jax.Array:
+    """Generate ``num_tokens`` continuation tokens for each prompt.
+
+    Greedy at ``temperature=0`` (default), else temperature sampling with
+    ``rng``.  Pure and jittable end-to-end: prefill once, then a
+    ``lax.scan`` of decode steps — one compiled program for the entire
+    episode. Returns int32 ``[batch, num_tokens]``.
+    """
+    batch, prompt_len = prompt.shape
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    if prompt_len + num_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
+            f"max_seq_len={config.max_seq_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling requires an rng key")
+    keys = (
+        jax.random.split(rng, num_tokens)
+        if rng is not None
+        else jnp.zeros((num_tokens, 2), jnp.uint32)
+    )
+    logits, cache = prefill(params, prompt, config, attention_fn)
+    first = _pick(logits, keys[0], temperature)
+
+    def body(carry, key):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token, config)
+        nxt = _pick(logits, key, temperature)
+        return (cache, nxt), token
+
+    (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
+    produced = jnp.moveaxis(produced, 0, 1)  # [steps-1, B] -> [B, steps-1]
+    return jnp.concatenate([produced, last[:, None]], axis=1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_tokens", "config", "temperature", "attention_fn"),
+)
+def generate_jit(
+    params: dict,
+    prompt: jax.Array,
+    num_tokens: int,
+    config: ModelConfig,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    attention_fn=None,
+) -> jax.Array:
+    """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
+    prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
+    own compiled program, exactly like ``model.forward_jit_with``)."""
+    return generate(
+        params, prompt, num_tokens, config, temperature=temperature, rng=rng,
+        attention_fn=attention_fn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded serving
+# ---------------------------------------------------------------------------
+
+
+def cache_shardings(mesh: Mesh, cache: dict) -> dict:
+    """Cache layout on the mesh: batch over ``data``, heads over ``model``
+    (the axis ``wqkv``'s output sharding produces), positions unsharded.
+    Serving uses no ``seq`` axis — decode has nothing to ring over."""
+    kv = NamedSharding(mesh, P("data", "model", None, None))
+    return {
+        "layers": [{"k": kv, "v": kv} for _ in cache["layers"]],
+        "length": NamedSharding(mesh, P()),
+    }
+
+
+def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
+    """Compile (prefill, decode_step, generate) over the mesh.
+
+    Requires a serving mesh (``seq`` axis of size 1): tensor-parallel heads
+    + data-parallel batch. Shardings are pinned on inputs and outputs so
+    the cache never reshards between steps.  The returned generate fn's
+    signature is ``generate_fn(params, prompt, rng, num_tokens,
+    temperature=0.0)``, all positional (pjit rejects kwargs when
+    in_shardings is set); rng is required — pass any key under greedy.
+    """
+    from .train import param_shardings
+
+    if mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "decode serving uses a (data, model) mesh; got seq="
+            f"{mesh.shape['seq']} (ring/sequence parallelism applies to "
+            "training and prefill, not token-by-token decode)"
+        )
+    p_shard = param_shardings(mesh, params)
+    tokens_1d = NamedSharding(mesh, P("data"))
+    tokens_2d = NamedSharding(mesh, P("data", None))
+    logits_s = NamedSharding(mesh, P("data", None))
+    template = jax.eval_shape(lambda: init_cache(config, mesh.shape["data"]))
+    c_shard = cache_shardings(mesh, template)
+
+    prefill_fn = jax.jit(
+        partial(prefill, config=config),
+        in_shardings=(p_shard, tokens_2d),
+        out_shardings=(logits_s, c_shard),
+    )
+    decode_fn = jax.jit(
+        partial(decode_step, config=config),
+        in_shardings=(p_shard, c_shard, tokens_1d),
+        out_shardings=(logits_s, c_shard),
+        donate_argnums=1,  # reuse the cache buffers step to step
+    )
+    def _generate(params, prompt, rng, num_tokens, temperature=0.0):
+        return generate(
+            params, prompt, num_tokens, config,
+            temperature=temperature, rng=rng,
+        )
+
+    # rng is a required positional (replicated) so pjit's
+    # no-kwargs-with-in_shardings rule can't bite: pass any key for greedy
+    # (temperature=0 ignores it) and the sampling path shares the layout
+    generate_fn = jax.jit(
+        _generate,
+        static_argnames=("num_tokens", "temperature"),
+        in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P())),
+        out_shardings=tokens_2d,
+    )
+    return prefill_fn, decode_fn, generate_fn

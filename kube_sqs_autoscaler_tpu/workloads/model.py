@@ -125,24 +125,41 @@ def _dense_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _attention(
-    x: jax.Array, layer: dict, config: ModelConfig, attention_fn=None
-) -> jax.Array:
-    batch, seq, _ = x.shape
-    qkv = x @ layer["wqkv"]  # [B, S, 3D] — one fused MXU matmul for q,k,v
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+def _split_heads(t: jax.Array, config: ModelConfig) -> jax.Array:
+    """``[B, S, D] -> [B, H, S, head_dim]``."""
+    batch, seq, _ = t.shape
+    return t.reshape(batch, seq, config.n_heads, config.head_dim).transpose(
+        0, 2, 1, 3
+    )
 
-    def heads(t):
-        return t.reshape(batch, seq, config.n_heads, config.head_dim).transpose(
-            0, 2, 1, 3
-        )
 
-    q, k, v = heads(q), heads(k), heads(v)
-    # seam for sequence-parallel ring attention (workloads.ring); the
-    # default is the dense single-mesh-shard path
-    out = (attention_fn or _dense_attention)(q, k, v)
-    out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
-    return out @ layer["wo"]
+def _merge_heads(t: jax.Array, config: ModelConfig) -> jax.Array:
+    """``[B, H, S, head_dim] -> [B, S, D]``."""
+    batch, _, seq, _ = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
+
+
+def _project_qkv(
+    h: jax.Array, layer: dict, config: ModelConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused MXU matmul for q,k,v, split into heads."""
+    q, k, v = jnp.split(h @ layer["wqkv"], 3, axis=-1)
+    return _split_heads(q, config), _split_heads(k, config), _split_heads(v, config)
+
+
+def _block(x: jax.Array, layer: dict, config: ModelConfig, attend) -> jax.Array:
+    """One transformer block: pre-LN attention + pre-LN MLP, residual both.
+
+    The single source of truth for the layer wiring — the training forward,
+    KV-cache prefill, and single-token decode (:mod:`.decode`) all run this
+    exact function, differing only in the ``attend(q, k, v) -> [B,H,S,D]``
+    callback (dense/flash/ring attention, or a cache-updating closure).
+    """
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    q, k, v = _project_qkv(h, layer, config)
+    out = _merge_heads(attend(q, k, v), config)
+    x = x + out @ layer["wo"]
+    return x + _mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
 
 
 def _mlp(x: jax.Array, layer: dict) -> jax.Array:
@@ -167,10 +184,11 @@ def forward(
             f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
         )
     x = params["embed"][tokens] + params["pos_embed"][:seq]
+    # attention_fn is the seam for sequence-parallel ring attention and the
+    # Pallas flash kernel; the default is the dense single-mesh-shard path
+    attend = attention_fn or _dense_attention
     for layer in params["layers"]:
-        x = x + _attention(_layer_norm(x, layer["ln1_scale"], layer["ln1_bias"]),
-                           layer, config, attention_fn)
-        x = x + _mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
+        x = _block(x, layer, config, attend)
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
     # fp32 logits for a stable softmax/cross-entropy downstream
     return jnp.einsum(

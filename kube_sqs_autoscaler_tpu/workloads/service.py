@@ -12,6 +12,15 @@ process for tests and demos.
 Message format: each message body is a JSON array of token ids.  Bodies are
 padded/truncated to the model's configured sequence length so every batch
 hits the same compiled XLA program (static shapes, no recompiles).
+
+Two compute modes per worker:
+
+- **classify** (default): one forward pass, greedy next token — the
+  cheapest "drain the queue" workload;
+- **generate** (``ServiceConfig.generate_tokens > 0``): treat each body as
+  a prompt and decode that many continuation tokens through the KV-cache
+  path (:mod:`.decode`) — the serving-shaped workload. Fixed prompt length
+  and token budget keep it a single compiled program.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from typing import Any, Protocol
 import jax.numpy as jnp
 import numpy as np
 
+from .decode import generate_jit
 from .flash import attention_fn_for
 from .model import ModelConfig, forward_jit_with
 
@@ -57,6 +67,9 @@ class ServiceConfig:
     # (billed) empty ReceiveMessage per idle_sleep_s. Fakes ignore it.
     receive_wait_s: int = 20
     error_backoff_s: float = 1.0  # pause after a failed cycle
+    # > 0: decode this many continuation tokens per message (KV-cache
+    # generate mode) instead of a single classify forward
+    generate_tokens: int = 0
 
 
 class QueueWorker:
@@ -69,6 +82,7 @@ class QueueWorker:
         model_config: ModelConfig,
         service_config: ServiceConfig,
         forward_fn=None,
+        generate_fn=None,
     ) -> None:
         self.queue = queue
         self.params = params
@@ -81,6 +95,20 @@ class QueueWorker:
         self._forward = forward_fn or (
             lambda params, tokens: forward_jit_with(
                 params, tokens, model_config, attention_fn
+            )
+        )
+        if service_config.generate_tokens > 0:
+            budget = service_config.seq_len + service_config.generate_tokens
+            if budget > model_config.max_seq_len:
+                raise ValueError(
+                    f"seq_len + generate_tokens = {budget} exceeds the "
+                    f"model's max_seq_len={model_config.max_seq_len}"
+                )
+        # the prompt pass uses the same attention selection as classify mode
+        # (flash kernel when seq_len tiles onto the MXU blocks, on TPU)
+        self._generate = generate_fn or (
+            lambda params, tokens, n: generate_jit(
+                params, tokens, n, model_config, attention_fn=attention_fn
             )
         )
         self._stop = threading.Event()
@@ -120,11 +148,17 @@ class QueueWorker:
         if not messages:
             return 0
         tokens = self._batch_tokens([m["Body"] for m in messages])
-        logits = self._forward(self.params, tokens)
-        # greedy next token per sequence; block so deletion happens strictly
-        # after compute succeeds (at-least-once processing: a crash here
-        # leaves messages in-flight to reappear after visibility timeout)
-        jnp.argmax(logits[:, -1, :], axis=-1).block_until_ready()
+        # block so deletion happens strictly after compute succeeds
+        # (at-least-once processing: a crash here leaves messages in-flight
+        # to reappear after the visibility timeout)
+        if self.config.generate_tokens > 0:
+            self._generate(
+                self.params, tokens, self.config.generate_tokens
+            ).block_until_ready()
+        else:
+            # greedy next token per sequence
+            logits = self._forward(self.params, tokens)
+            jnp.argmax(logits[:, -1, :], axis=-1).block_until_ready()
         for message in messages:
             self.queue.delete_message(
                 self.config.queue_url, message["ReceiptHandle"]

@@ -89,6 +89,43 @@ def test_queue_worker_processes_and_deletes():
     assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
 
 
+def test_queue_worker_generate_mode_decodes_and_deletes():
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 3)
+    params = init_params(jax.random.key(0), TINY)
+    calls = []
+
+    def spy_generate(params, tokens, n):
+        from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
+
+        out = generate_jit(params, tokens, n, TINY)
+        calls.append((tokens.shape, n, out.shape))
+        return out
+
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=4, seq_len=16, generate_tokens=4),
+        generate_fn=spy_generate,
+    )
+    assert worker.run_once() == 3
+    assert worker.processed == 3
+    assert calls == [((4, 16), 4, (4, 4))]
+    attrs = queue.get_queue_attributes(URL, ())
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+
+def test_queue_worker_generate_budget_validated_against_model():
+    import pytest
+
+    params = init_params(jax.random.key(0), TINY)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        QueueWorker(
+            FakeMessageQueue(), params, TINY,
+            ServiceConfig(queue_url=URL, seq_len=60, generate_tokens=8),
+        )
+
+
 def test_queue_worker_drops_malformed_messages():
     queue = FakeMessageQueue()
     queue.send_message(URL, "not json at all {{{")
