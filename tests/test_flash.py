@@ -97,6 +97,22 @@ def test_attention_fn_for_dispatch():
     assert attention_fn_for(256) is _dense_attention  # this suite runs on CPU
 
 
+def test_block_auto_selection():
+    from kube_sqs_autoscaler_tpu.workloads.flash import _pick_block
+
+    assert _pick_block(4096, None) == 512  # long S: the fast v5e tile
+    assert _pick_block(2048, None) == 512
+    assert _pick_block(640, None) == 128  # halves until it divides S
+    assert _pick_block(384, None) == 128  # power-of-two only above 128
+    assert _pick_block(256, None) == 256
+    assert _pick_block(96, None) == 96  # short S: clamp to S itself
+    assert _pick_block(64, None) == 64
+    # non-dividing S -> 128, so flash_attention raises its clean ValueError
+    assert _pick_block(136, None) == 128
+    assert _pick_block(2048, 128) == 128  # explicit request wins
+    assert _pick_block(64, 128) == 64  # ...clamped to S
+
+
 def test_forward_with_flash_matches_dense_forward():
     """End-to-end through the model's attention_fn seam."""
     config = ModelConfig(
