@@ -65,6 +65,12 @@ PARAM_AXES = {
     "ln2_bias": ("model",),
     "w_up": ("model", "ff"),  # [d_model, d_ff], shard out axis
     "w_down": ("ff", "model"),  # [d_ff, d_model], shard in axis
+    # per MoE layer (workloads.moe): the router replicates; expert weights
+    # shard their leading expert axis (expert parallelism) and keep the ff
+    # axis tensor-parallel, so each expert is itself Megatron-sharded
+    "router": ("model", "experts_out"),
+    "w_up_experts": ("expert", "model", "ff"),
+    "w_down_experts": ("expert", "ff", "model"),
 }
 
 
@@ -147,19 +153,24 @@ def _project_qkv(
     return _split_heads(q, config), _split_heads(k, config), _split_heads(v, config)
 
 
-def _block(x: jax.Array, layer: dict, config: ModelConfig, attend) -> jax.Array:
+def _block(
+    x: jax.Array, layer: dict, config: ModelConfig, attend, mlp=None
+) -> jax.Array:
     """One transformer block: pre-LN attention + pre-LN MLP, residual both.
 
     The single source of truth for the layer wiring — the training forward,
-    KV-cache prefill, and single-token decode (:mod:`.decode`) all run this
-    exact function, differing only in the ``attend(q, k, v) -> [B,H,S,D]``
-    callback (dense/flash/ring attention, or a cache-updating closure).
+    KV-cache prefill, single-token decode (:mod:`.decode`), and the MoE
+    variant (:mod:`.moe`) all run this exact function, differing only in
+    the ``attend(q, k, v) -> [B,H,S,D]`` callback (dense/flash/ring
+    attention, or a cache-updating closure) and the ``mlp(x, layer)``
+    callback (dense :func:`_mlp` by default; sparse expert MLP for MoE).
     """
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     q, k, v = _project_qkv(h, layer, config)
     out = _merge_heads(attend(q, k, v), config)
     x = x + out @ layer["wo"]
-    return x + _mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
+    mlp = mlp or _mlp
+    return x + mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
 
 
 def _mlp(x: jax.Array, layer: dict) -> jax.Array:

@@ -1,0 +1,276 @@
+"""Mixture-of-Experts MLP with expert parallelism over the device mesh.
+
+The reference workload (``/root/reference``) has no model code at all
+(SURVEY.md §2 native-code census); this module extends the package's own
+TPU workload (:mod:`.model`) with the standard sparse-MLP scaling axis so
+the framework's parallelism story covers **ep** alongside dp/tp/sp/pp.
+
+TPU-first design:
+
+- **GShard-style dense dispatch**: routing is expressed as one-hot
+  dispatch/combine einsums with a static per-expert capacity, so the whole
+  layer is fixed-shape matmuls — no gather/scatter with data-dependent
+  shapes, which XLA cannot tile onto the MXU.
+- **Expert parallelism over the ``"data"`` mesh axis**: expert weights
+  (``w_up_experts [E, D, F]``, ``w_down_experts [E, F, D]``) shard their
+  leading expert axis over ``"data"`` (the canonical ep=dp layout), while
+  their ``F`` axis stays tensor-parallel over ``"model"`` — so each expert
+  is itself Megatron-sharded.  XLA's SPMD partitioner sees batch sharded
+  over ``"data"`` feeding expert-sharded weights and inserts the
+  all-to-alls (token shuffle to experts and back) over ICI automatically.
+- **fp32 routing**: router logits/softmax/top-k run in fp32; expert
+  matmuls run in the model dtype (bf16 on TPU).
+
+Load balancing uses the Switch-Transformer auxiliary loss
+(``E * mean_e(frac_tokens_e * mean_prob_e)``), returned per layer and
+averaged by :func:`moe_forward` so the train loss can add it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .model import (
+    ModelConfig,
+    _block,
+    _dense_attention,
+    _layer_norm,
+    init_params,
+)
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    """Routing hyper-parameters (defaults follow Switch/GShard practice)."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.top_k <= self.n_experts:
+            # with top_k > n_experts the greedy argmax would silently
+            # double-assign expert 0 once `remaining` zeroes out
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, n_experts={self.n_experts}]"
+            )
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Static per-expert slot count for a group of that many tokens."""
+        return max(
+            1,
+            math.ceil(
+                self.top_k * tokens_per_group * self.capacity_factor
+                / self.n_experts
+            ),
+        )
+
+
+def init_moe_params(
+    rng: jax.Array, config: ModelConfig, moe: MoeConfig
+) -> dict:
+    """Like :func:`.model.init_params` but every layer's dense MLP is
+    replaced by ``router`` + stacked expert weights."""
+    base_rng, expert_rng = jax.random.split(rng)
+    params = init_params(base_rng, config)
+    out_scale = 0.02 / (2 * config.n_layers) ** 0.5
+    keys = jax.random.split(expert_rng, 3 * config.n_layers)
+    for i, layer in enumerate(params["layers"]):
+        del layer["w_up"], layer["w_down"]
+        k_r, k_up, k_down = keys[3 * i : 3 * i + 3]
+        layer["router"] = (
+            jax.random.normal(k_r, (config.d_model, moe.n_experts), jnp.float32)
+            * 0.02
+        )  # router stays fp32: routing decisions are precision-sensitive
+        layer["w_up_experts"] = (
+            jax.random.normal(
+                k_up, (moe.n_experts, config.d_model, config.d_ff), jnp.float32
+            )
+            * 0.02
+        ).astype(config.dtype)
+        layer["w_down_experts"] = (
+            jax.random.normal(
+                k_down, (moe.n_experts, config.d_ff, config.d_model), jnp.float32
+            )
+            * out_scale
+        ).astype(config.dtype)
+    return params
+
+
+def _top_k_routing(
+    probs: jax.Array, moe: MoeConfig, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy top-k assignment with per-expert capacity.
+
+    ``probs``: fp32 ``[B, S, E]`` router softmax.  Returns
+    ``dispatch [B, S, E, C]`` (0/1), ``combine [B, S, E, C]``
+    (gate-weighted dispatch), and the Switch aux loss scalar.  Tokens that
+    overflow an expert's capacity are dropped for that choice (standard
+    GShard behavior); gates are renormalized over the *selected* experts
+    before capacity dropping, so a token whose second choice overflows
+    still contributes its first-choice share.
+    """
+    batch, seq, n_experts = probs.shape
+
+    remaining = probs
+    choices = []  # (expert_onehot [B,S,E], gate [B,S])
+    for _ in range(moe.top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(idx, n_experts, dtype=probs.dtype)
+        choices.append((onehot, jnp.sum(probs * onehot, axis=-1)))
+        remaining = remaining * (1.0 - onehot)
+
+    gate_sum = sum(g for _, g in choices)
+    denom = jnp.maximum(gate_sum, 1e-9)
+
+    dispatch = jnp.zeros((batch, seq, n_experts, capacity), probs.dtype)
+    combine = jnp.zeros_like(dispatch)
+    # slots already used per (batch row, expert) by earlier choices
+    used = jnp.zeros((batch, n_experts), probs.dtype)
+    for onehot, gate in choices:
+        # position of each token within its chosen expert's slot sequence
+        pos = jnp.cumsum(onehot, axis=1) - onehot + used[:, None, :]
+        used = used + jnp.sum(onehot, axis=1)
+        kept = jnp.sum(onehot * (pos < capacity), axis=-1)  # [B, S] 0/1
+        slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=probs.dtype)
+        mask = onehot[..., None] * slot_onehot[:, :, None, :]
+        mask = mask * kept[..., None, None]
+        dispatch = dispatch + mask
+        combine = combine + mask * (gate / denom)[..., None, None]
+
+    # Switch aux loss on first-choice assignment fractions
+    first_onehot = choices[0][0]
+    frac_tokens = jnp.mean(first_onehot, axis=(0, 1))  # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))  # [E]
+    aux = n_experts * jnp.sum(frac_tokens * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_mlp(
+    x: jax.Array, layer: dict, moe: MoeConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse MLP: route, dispatch, expert FFN, combine.
+
+    ``x``: ``[B, S, D]`` -> ``([B, S, D], aux_loss)``.  Each batch row is a
+    routing group (capacity is per row), so the dispatch einsums keep a
+    leading ``B`` axis that stays sharded over ``"data"`` while the expert
+    axis of the weights is also ``"data"``-sharded — the mismatch is
+    exactly the token all-to-all.
+    """
+    capacity = moe.capacity(x.shape[1])
+    logits = jnp.einsum(
+        "bsd,de->bse", x, layer["router"], preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _top_k_routing(probs, moe, capacity)
+
+    dispatch = dispatch.astype(x.dtype)
+    # [B,S,E,C] x [B,S,D] -> [E,B,C,D]: the forward all-to-all
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+    hidden = jax.nn.gelu(
+        jnp.einsum("ebcd,edf->ebcf", expert_in, layer["w_up_experts"])
+    )
+    expert_out = jnp.einsum("ebcf,efd->ebcd", hidden, layer["w_down_experts"])
+    # combine (return all-to-all) in fp32 so gate weighting is exact
+    out = jnp.einsum(
+        "bsec,ebcd->bsd", combine, expert_out.astype(jnp.float32)
+    )
+    return out.astype(x.dtype), aux
+
+
+def _moe_block(
+    x: jax.Array, layer: dict, config: ModelConfig, moe: MoeConfig, attend
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`.model._block` with the dense MLP swapped for :func:`moe_mlp`
+    via its ``mlp`` seam, so the attention wiring has one source of truth."""
+    aux_out = []
+
+    def sparse_mlp(h, layer):
+        out, aux = moe_mlp(h, layer, moe)
+        aux_out.append(aux)
+        return out
+
+    x = _block(x, layer, config, attend, mlp=sparse_mlp)
+    return x, aux_out[0]
+
+
+def moe_forward(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    moe: MoeConfig,
+    attention_fn=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Logits plus mean auxiliary load-balance loss.
+
+    Mirrors :func:`.model.forward` (same embedding/unembedding, same block
+    wiring via the ``attention_fn`` seam) with MoE MLPs.
+    """
+    seq = tokens.shape[1]
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    x = params["embed"][tokens] + params["pos_embed"][:seq]
+    attend = attention_fn or _dense_attention
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, aux = _moe_block(x, layer, config, moe, attend)
+        aux_total = aux_total + aux
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+    return logits, aux_total / len(params["layers"])
+
+
+def moe_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config: ModelConfig,
+    moe: MoeConfig,
+    attention_fn=None,
+) -> jax.Array:
+    """Next-token cross-entropy + weighted aux loss (fp32)."""
+    from .train import next_token_nll
+
+    logits, aux = moe_forward(params, tokens, config, moe, attention_fn)
+    return next_token_nll(logits, tokens) + moe.aux_loss_weight * aux
+
+
+def init_moe_train_state(
+    rng: jax.Array, config: ModelConfig, moe: MoeConfig, train_config
+) -> dict:
+    from functools import partial
+
+    from .train import init_train_state
+
+    return init_train_state(
+        rng, config, train_config, init_fn=partial(init_moe_params, moe=moe)
+    )
+
+
+def make_moe_train_step(mesh, config: ModelConfig, moe: MoeConfig,
+                        train_config, state: dict):
+    """Compile one MoE optimizer step over the mesh (dp x sp x tp x ep).
+
+    Delegates to :func:`.train.make_train_step` through its ``loss`` seam;
+    expert weights shard via the ``"expert" -> "data"`` rule in
+    :mod:`.train`, so the dispatch einsums lower to token all-to-alls over
+    ICI.
+    """
+    from functools import partial
+
+    from .train import make_train_step
+
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(moe_loss_fn, config=config, moe=moe),
+    )

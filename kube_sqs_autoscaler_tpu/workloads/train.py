@@ -43,6 +43,8 @@ _LOGICAL_TO_MESH = {
     "ff": "model",
     "model": None,  # d_model axes replicate (Megatron 1D sharding)
     "seq": None,
+    "expert": "data",  # expert parallelism rides the data axis (ep=dp)
+    "experts_out": None,  # router output axis (n_experts) replicates
 }
 
 
@@ -139,26 +141,35 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(
-    params: Any, tokens: jax.Array, config: ModelConfig, attention_fn=None
-) -> jax.Array:
-    """Next-token cross-entropy in fp32 (the standard LM objective).
+def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy from full-sequence logits (fp32).
 
-    The forward pass runs on the full (shardable) sequence and the shift
-    happens on the logits, so the input length stays divisible by the
-    ``seq`` mesh axis under sequence parallelism.
+    The shift happens on the *logits*, so the input length stays divisible
+    by the ``seq`` mesh axis under sequence parallelism.  Shared by the
+    dense (:func:`loss_fn`) and MoE (:func:`.moe.moe_loss_fn`) objectives.
     """
-    logits = forward(params, tokens, config, attention_fn)[:, :-1]
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)
     return jnp.mean(nll)
 
 
+def loss_fn(
+    params: Any, tokens: jax.Array, config: ModelConfig, attention_fn=None
+) -> jax.Array:
+    """Next-token cross-entropy in fp32 (the standard LM objective)."""
+    return next_token_nll(forward(params, tokens, config, attention_fn), tokens)
+
+
 def init_train_state(
-    rng: jax.Array, model_config: ModelConfig, train_config: TrainConfig
+    rng: jax.Array,
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    init_fn=init_params,
 ) -> dict:
-    params = init_params(rng, model_config)
+    """Fresh params (via ``init_fn(rng, model_config)``) + optimizer state."""
+    params = init_fn(rng, model_config)
     opt_state = make_optimizer(train_config).init(params)
     return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 
@@ -197,20 +208,29 @@ def place_state(mesh: Mesh, state: dict) -> dict:
 
 
 def make_train_step(
-    mesh: Mesh, model_config: ModelConfig, train_config: TrainConfig, state: dict
+    mesh: Mesh,
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    state: dict,
+    loss: Any = None,
 ):
     """Compile one optimizer step over the mesh.
 
     Returns ``step_fn(state, tokens) -> (state, loss)`` with input/output
     shardings pinned so repeated calls stay stable (no resharding churn).
+    ``loss(params, tokens, attention_fn) -> scalar`` overrides the
+    objective (default: :func:`loss_fn` on the dense model); :mod:`.moe`
+    passes its aux-loss-augmented objective through this seam.
     """
     optimizer = make_optimizer(train_config)
     shardings = state_shardings(mesh, state)
     attention_fn = mesh_attention_fn(mesh)
+    if loss is None:
+        loss = partial(loss_fn, config=model_config)
 
     def train_step(state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], tokens, model_config, attention_fn
+        loss_value, grads = jax.value_and_grad(loss)(
+            state["params"], tokens, attention_fn=attention_fn
         )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
@@ -218,7 +238,7 @@ def make_train_step(
         params = optax.apply_updates(state["params"], updates)
         return (
             {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
-            loss,
+            loss_value,
         )
 
     return jax.jit(

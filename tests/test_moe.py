@@ -1,0 +1,147 @@
+"""MoE correctness: a 1-expert MoE must reduce exactly to the dense MLP,
+routing must respect top-k and capacity invariants, and the full
+dp x sp x tp x ep train step must compile over the mesh and learn.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.model import (
+    ModelConfig,
+    _mlp,
+    forward,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.moe import (
+    MoeConfig,
+    _top_k_routing,
+    init_moe_params,
+    init_moe_train_state,
+    make_moe_train_step,
+    moe_forward,
+    moe_loss_fn,
+    moe_mlp,
+)
+from kube_sqs_autoscaler_tpu.workloads.train import (
+    TrainConfig,
+    batch_sharding,
+    make_mesh,
+    place_state,
+)
+
+TINY = ModelConfig(
+    vocab_size=256, d_model=128, n_heads=8, n_layers=2, d_ff=256, max_seq_len=64
+)
+
+
+def test_single_expert_moe_equals_dense_mlp():
+    # E=1, top_k=1, ample capacity: the router has one choice with gate 1,
+    # so the sparse layer must reproduce the dense MLP bit-for-bit in fp32
+    config = ModelConfig(d_model=64, d_ff=128, dtype=jnp.float32)
+    rng = jax.random.key(0)
+    w_up = jax.random.normal(rng, (64, 128), jnp.float32) * 0.1
+    w_down = jax.random.normal(jax.random.key(1), (128, 64), jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.key(2), (2, 16, 64), jnp.float32)
+
+    dense = _mlp(x, {"w_up": w_up, "w_down": w_down})
+    layer = {
+        "router": jnp.zeros((64, 1), jnp.float32),
+        "w_up_experts": w_up[None],
+        "w_down_experts": w_down[None],
+    }
+    moe = MoeConfig(n_experts=1, top_k=1, capacity_factor=4.0)
+    sparse, aux = moe_mlp(x, layer, moe)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(sparse), rtol=1e-6, atol=1e-6
+    )
+    assert float(aux) == pytest.approx(1.0)  # balanced by definition
+
+
+def test_top_k_must_not_exceed_n_experts():
+    with pytest.raises(ValueError, match="top_k"):
+        MoeConfig(n_experts=2, top_k=3)
+    with pytest.raises(ValueError, match="top_k"):
+        MoeConfig(n_experts=4, top_k=0)
+
+
+def test_routing_invariants_with_ample_capacity():
+    moe = MoeConfig(n_experts=4, top_k=2, capacity_factor=8.0)
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.key(3), (2, 16, 4), jnp.float32), axis=-1
+    )
+    capacity = moe.capacity(16)
+    dispatch, combine, aux = _top_k_routing(probs, moe, capacity)
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    # every token lands in exactly top_k slots, gates renormalize to 1
+    np.testing.assert_array_equal(d.sum(axis=(2, 3)), 2.0)
+    np.testing.assert_allclose(c.sum(axis=(2, 3)), 1.0, rtol=1e-6)
+    # no expert slot is double-booked within a batch row
+    assert d.sum(axis=1).max() <= 1.0 + 1e-6
+    assert float(aux) >= 1.0 - 1e-6  # Switch aux loss lower bound
+
+
+def test_capacity_overflow_drops_tokens_but_stays_finite():
+    # capacity 1 with 16 tokens per row: most choices overflow
+    moe = MoeConfig(n_experts=2, top_k=2, capacity_factor=1e-6)
+    assert moe.capacity(16) == 1
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.key(4), (1, 16, 2), jnp.float32), axis=-1
+    )
+    dispatch, combine, _ = _top_k_routing(probs, moe, 1)
+    d = np.asarray(dispatch)
+    assert d.sum() <= 2.0 + 1e-6  # at most E*C=2 slots filled per row
+    assert np.isfinite(np.asarray(combine)).all()
+
+
+def test_moe_forward_shapes_and_finite():
+    moe = MoeConfig(n_experts=4, top_k=2)
+    params = init_moe_params(jax.random.key(0), TINY, moe)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, TINY.vocab_size, jnp.int32
+    )
+    logits, aux = moe_forward(params, tokens, TINY, moe)
+    assert logits.shape == (2, 32, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+    assert np.isfinite(float(aux))
+    # attention path is shared with the dense model: same wqkv/wo names
+    assert "w_up" not in params["layers"][0]
+    assert params["layers"][0]["w_up_experts"].shape == (4, 128, 256)
+
+
+def test_moe_train_step_sharded_over_all_four_axes_learns():
+    # dp2 x sp2 x tp2 mesh; experts (E=8) shard over "data" (ep=dp)
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
+    moe = MoeConfig(n_experts=8, top_k=2)
+    train_config = TrainConfig(learning_rate=1e-2)
+    state = place_state(
+        mesh, init_moe_train_state(jax.random.key(0), TINY, moe, train_config)
+    )
+    step_fn = make_moe_train_step(mesh, TINY, moe, train_config, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, TINY.vocab_size,
+                           jnp.int32),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_loss_includes_aux_term():
+    moe_on = MoeConfig(n_experts=4, top_k=2, aux_loss_weight=1.0)
+    moe_off = MoeConfig(n_experts=4, top_k=2, aux_loss_weight=0.0)
+    params = init_moe_params(jax.random.key(0), TINY, moe_on)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, TINY.vocab_size, jnp.int32
+    )
+    with_aux = float(moe_loss_fn(params, tokens, TINY, moe_on))
+    without = float(moe_loss_fn(params, tokens, TINY, moe_off))
+    _, aux = moe_forward(params, tokens, TINY, moe_on)
+    assert with_aux == pytest.approx(without + float(aux), rel=1e-5)
