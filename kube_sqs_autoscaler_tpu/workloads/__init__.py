@@ -15,6 +15,11 @@ benchmark against:
 - :mod:`.ring`   — ring attention (``shard_map`` + ``ppermute`` + online
   softmax) for the sequence axis: long-context support without ever
   materializing the full attention matrix.
+- :mod:`.moe`    — Mixture-of-Experts MLP with GShard-style dense dispatch
+  and expert parallelism over the ``data`` axis (ep=dp, token all-to-all).
+- :mod:`.pipeline` — GPipe pipeline parallelism: the layer stack sharded
+  over a ``"pipe"`` mesh axis, microbatches handed stage-to-stage with
+  ``ppermute``.
 - :mod:`.worker` — a queue-fed batch-inference worker: the process that a
   Deployment replica runs, draining the very queue the controller watches.
 

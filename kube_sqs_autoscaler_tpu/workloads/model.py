@@ -74,8 +74,15 @@ PARAM_AXES = {
 }
 
 
-def init_params(rng: jax.Array, config: ModelConfig) -> dict:
-    """Initialize a parameter pytree (scaled-normal init, bf16 storage)."""
+def init_params(
+    rng: jax.Array, config: ModelConfig, dense_mlp: bool = True
+) -> dict:
+    """Initialize a parameter pytree (scaled-normal init, bf16 storage).
+
+    ``dense_mlp=False`` skips the per-layer ``w_up``/``w_down`` weights —
+    for variants that replace the dense MLP (MoE) and would otherwise
+    throw the freshly-sampled weights away.
+    """
     dtype = config.dtype
     keys = jax.random.split(rng, 2 + config.n_layers)
 
@@ -92,18 +99,20 @@ def init_params(rng: jax.Array, config: ModelConfig) -> dict:
     out_scale = 0.02 / (2 * config.n_layers) ** 0.5  # GPT-2-style depth scaling
     for i in range(config.n_layers):
         lk = jax.random.split(keys[2 + i], 4)
-        params["layers"].append(
-            {
-                "ln1_scale": jnp.ones((config.d_model,), dtype),
-                "ln1_bias": jnp.zeros((config.d_model,), dtype),
-                "wqkv": normal(lk[0], (config.d_model, 3 * config.d_model), 0.02),
-                "wo": normal(lk[1], (config.d_model, config.d_model), out_scale),
-                "ln2_scale": jnp.ones((config.d_model,), dtype),
-                "ln2_bias": jnp.zeros((config.d_model,), dtype),
-                "w_up": normal(lk[2], (config.d_model, config.d_ff), 0.02),
-                "w_down": normal(lk[3], (config.d_ff, config.d_model), out_scale),
-            }
-        )
+        layer = {
+            "ln1_scale": jnp.ones((config.d_model,), dtype),
+            "ln1_bias": jnp.zeros((config.d_model,), dtype),
+            "wqkv": normal(lk[0], (config.d_model, 3 * config.d_model), 0.02),
+            "wo": normal(lk[1], (config.d_model, config.d_model), out_scale),
+            "ln2_scale": jnp.ones((config.d_model,), dtype),
+            "ln2_bias": jnp.zeros((config.d_model,), dtype),
+        }
+        if dense_mlp:
+            layer["w_up"] = normal(lk[2], (config.d_model, config.d_ff), 0.02)
+            layer["w_down"] = normal(
+                lk[3], (config.d_ff, config.d_model), out_scale
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -178,7 +187,11 @@ def _mlp(x: jax.Array, layer: dict) -> jax.Array:
 
 
 def forward(
-    params: dict, tokens: jax.Array, config: ModelConfig, attention_fn=None
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attention_fn=None,
+    mlp=None,
 ) -> jax.Array:
     """Logits for a token batch. Pure; jit/pjit at the call site.
 
@@ -187,7 +200,8 @@ def forward(
     so a full-context training example is ``max_seq_len`` tokens long and
     yields ``max_seq_len - 1`` targets; see ``train.loss_fn``).
     ``attention_fn`` overrides the attention inner op (``[B,H,S,D]^3 -> out``),
-    e.g. ring attention for a sequence-sharded mesh.
+    e.g. ring attention for a sequence-sharded mesh; ``mlp(x, layer)``
+    overrides the per-block MLP (e.g. the sparse expert MLP in :mod:`.moe`).
     """
     seq = tokens.shape[1]
     if seq > config.max_seq_len:
@@ -199,7 +213,7 @@ def forward(
     # Pallas flash kernel; the default is the dense single-mesh-shard path
     attend = attention_fn or _dense_attention
     for layer in params["layers"]:
-        x = _block(x, layer, config, attend)
+        x = _block(x, layer, config, attend, mlp=mlp)
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
     # fp32 logits for a stable softmax/cross-entropy downstream
     return jnp.einsum(

@@ -35,13 +35,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from .model import (
-    ModelConfig,
-    _block,
-    _dense_attention,
-    _layer_norm,
-    init_params,
-)
+from .model import ModelConfig, forward, init_params
 
 
 @dataclass(frozen=True)
@@ -78,11 +72,10 @@ def init_moe_params(
     """Like :func:`.model.init_params` but every layer's dense MLP is
     replaced by ``router`` + stacked expert weights."""
     base_rng, expert_rng = jax.random.split(rng)
-    params = init_params(base_rng, config)
+    params = init_params(base_rng, config, dense_mlp=False)
     out_scale = 0.02 / (2 * config.n_layers) ** 0.5
     keys = jax.random.split(expert_rng, 3 * config.n_layers)
     for i, layer in enumerate(params["layers"]):
-        del layer["w_up"], layer["w_down"]
         k_r, k_up, k_down = keys[3 * i : 3 * i + 3]
         layer["router"] = (
             jax.random.normal(k_r, (config.d_model, moe.n_experts), jnp.float32)
@@ -185,22 +178,6 @@ def moe_mlp(
     return out.astype(x.dtype), aux
 
 
-def _moe_block(
-    x: jax.Array, layer: dict, config: ModelConfig, moe: MoeConfig, attend
-) -> tuple[jax.Array, jax.Array]:
-    """:func:`.model._block` with the dense MLP swapped for :func:`moe_mlp`
-    via its ``mlp`` seam, so the attention wiring has one source of truth."""
-    aux_out = []
-
-    def sparse_mlp(h, layer):
-        out, aux = moe_mlp(h, layer, moe)
-        aux_out.append(aux)
-        return out
-
-    x = _block(x, layer, config, attend, mlp=sparse_mlp)
-    return x, aux_out[0]
-
-
 def moe_forward(
     params: dict,
     tokens: jax.Array,
@@ -210,25 +187,20 @@ def moe_forward(
 ) -> tuple[jax.Array, jax.Array]:
     """Logits plus mean auxiliary load-balance loss.
 
-    Mirrors :func:`.model.forward` (same embedding/unembedding, same block
-    wiring via the ``attention_fn`` seam) with MoE MLPs.
+    Runs :func:`.model.forward` itself (one source of truth for the
+    embedding/block/unembedding wiring) with the sparse expert MLP plugged
+    into its ``mlp`` seam; the per-layer aux losses are collected through
+    the closure.
     """
-    seq = tokens.shape[1]
-    if seq > config.max_seq_len:
-        raise ValueError(
-            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
-        )
-    x = params["embed"][tokens] + params["pos_embed"][:seq]
-    attend = attention_fn or _dense_attention
-    aux_total = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
-        x, aux = _moe_block(x, layer, config, moe, attend)
-        aux_total = aux_total + aux
-    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
-    logits = jnp.einsum(
-        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-    )
-    return logits, aux_total / len(params["layers"])
+    aux_out = []
+
+    def sparse_mlp(h, layer):
+        out, aux = moe_mlp(h, layer, moe)
+        aux_out.append(aux)
+        return out
+
+    logits = forward(params, tokens, config, attention_fn, mlp=sparse_mlp)
+    return logits, sum(aux_out) / len(aux_out)
 
 
 def moe_loss_fn(

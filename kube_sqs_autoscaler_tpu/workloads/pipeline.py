@@ -1,0 +1,288 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a mesh axis.
+
+The reference (``/root/reference``) has no parallelism of any kind
+(SURVEY.md §2 — a single-goroutine Go control loop); this module completes
+the package's parallelism set (dp/tp/sp/ep in :mod:`.train`/:mod:`.ring`/
+:mod:`.moe`) with **pp**, TPU-native:
+
+- The transformer's layer stack is *stacked* into one pytree with a leading
+  ``[n_layers, ...]`` axis and sharded over a ``"pipe"`` mesh axis, so each
+  device holds ``n_layers / pipe`` contiguous layers (one stage).
+- Inside ``shard_map``, microbatches flow through the stages on a GPipe
+  schedule: ``n_micro + pipe - 1`` lockstep steps, each ending with a
+  single-hop ``jax.lax.ppermute`` that hands every stage's activation to
+  its successor — neighbor traffic that rides the ICI torus, never DCN.
+- Per-stage compute is a ``lax.scan`` over the stage's stacked layers
+  (trace one layer, compile once, no Python unrolling), running the same
+  :func:`.model._block` as every other execution path.
+- The remaining mesh axis is ``"data"``: microbatches shard their batch
+  dim over it, so pp x dp composes in one ``jit``.  (Combining pp with
+  tp/sp is a matter of meshes with more axes; embedding/unembedding stay
+  outside the pipelined region and replicate over ``"pipe"``.)
+
+The bubble fraction is the usual ``(pipe-1) / (n_micro + pipe - 1)`` —
+raise ``n_microbatches`` to amortize it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import ModelConfig, _block, _dense_attention, _layer_norm, init_params
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Schedule knobs: how many microbatches flow through the stages."""
+
+    n_microbatches: int = 4
+
+
+def make_pipeline_mesh(
+    devices: list | None = None, pipe_parallel: int | None = None
+) -> Mesh:
+    """A ``("pipe", "data")`` mesh; ``pipe_parallel`` defaults to all devices."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    pipe = pipe_parallel if pipe_parallel is not None else n
+    if n % pipe:
+        raise ValueError(f"{n} devices not divisible by pipe_parallel={pipe}")
+    grid = np.asarray(devices).reshape(pipe, n // pipe)
+    return Mesh(grid, ("pipe", "data"))
+
+
+def stack_layers(params: dict) -> dict:
+    """``layers`` list-of-dicts -> one stacked pytree with leading ``[L]``.
+
+    The stacked form is what shards over ``"pipe"`` and what ``lax.scan``
+    consumes; stacking order == layer order, and GSPMD's contiguous
+    leading-axis sharding assigns layers ``[i*L/P, (i+1)*L/P)`` to stage
+    ``i`` — the natural pipeline placement.
+    """
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *params["layers"])
+
+
+def init_pipeline_params(
+    rng: jax.Array, config: ModelConfig, n_stages: int
+) -> dict:
+    """:func:`.model.init_params` with the layer stack pre-stacked."""
+    if config.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by n_stages={n_stages}"
+        )
+    params = init_params(rng, config)
+    stages = stack_layers(params)
+    del params["layers"]
+    params["stages"] = stages
+    return params
+
+
+def _stage_apply(stage_layers: dict, x: jax.Array, config: ModelConfig) -> jax.Array:
+    """Run one stage's stacked layers over an activation microbatch."""
+
+    def one_layer(h, layer):
+        return _block(h, layer, config, _dense_attention), None
+
+    out, _ = jax.lax.scan(one_layer, x, stage_layers)
+    return out
+
+
+def _pipeline_body(
+    stage_layers: dict,
+    x_micro: jax.Array,
+    *,
+    config: ModelConfig,
+    n_micro: int,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device GPipe schedule (inside ``shard_map``).
+
+    ``stage_layers``: this stage's ``[L/P, ...]`` slice of the stack.
+    ``x_micro``: embedded microbatches ``[M, B_m, S, D]`` (replicated over
+    ``"pipe"``; stage 0 is the only reader, but keeping the buffer
+    everywhere makes the schedule a pure lockstep loop).  Returns the
+    fully-processed microbatches, replicated back over ``"pipe"``.
+    """
+    stage = jax.lax.axis_index(axis_name)
+    last = axis_size - 1
+
+    # x_micro replicates over "pipe" (in_spec P(None, "data")), but the
+    # carried activations diverge per stage, so mark the accumulators as
+    # pipe-varying for shard_map's scan-carry type check
+    act0 = jax.lax.pcast(x_micro[0] * 0.0, (axis_name,), to="varying")
+    out0 = jax.lax.pcast(x_micro * 0.0, (axis_name,), to="varying")
+
+    def step(carry, t):
+        act_in, outputs = carry
+        fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
+        inp = jnp.where(stage == 0, fresh, act_in)
+        act_out = _stage_apply(stage_layers, inp, config)
+
+        out_idx = jnp.clip(t - last, 0, n_micro - 1)
+        outputs = jnp.where(
+            (stage == last) & (t >= last),
+            jax.lax.dynamic_update_index_in_dim(outputs, act_out, out_idx, 0),
+            outputs,
+        )
+        # hand every stage's activation to its successor (single ICI hop)
+        ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        act_next = jax.lax.ppermute(act_out, axis_name, ring)
+        return (act_next, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        step, (act0, out0), jnp.arange(n_micro + axis_size - 1)
+    )
+    # only the last stage wrote real outputs; psum broadcasts them to all
+    # stages so the result is replicated over "pipe" (out_specs P(None,...))
+    return jax.lax.psum(
+        jnp.where(stage == last, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+
+
+def pipeline_forward(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+) -> jax.Array:
+    """Logits via the pipelined layer stack.
+
+    ``tokens``: int32 ``[M, B_m, S]`` — microbatch-major so the schedule is
+    explicit in the type (shard ``B_m`` over ``"data"`` with
+    :func:`pipeline_batch_sharding`).  Returns fp32 logits
+    ``[M, B_m, S, vocab]``.
+    """
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    x = params["embed"][tokens] + params["pos_embed"][:seq]
+
+    pipe = mesh.shape["pipe"]
+    body = partial(
+        _pipeline_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=pipe,
+    )
+    y = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(None, "data")),
+        out_specs=P(None, "data"),
+    )(params["stages"], x)
+
+    y = _layer_norm(y, params["final_ln_scale"], params["final_ln_bias"])
+    return jnp.einsum(
+        "mbsd,vd->mbsv", y, params["embed"], preferred_element_type=jnp.float32
+    )
+
+
+def pipeline_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config: ModelConfig,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+    attention_fn=None,  # accepted for train.make_train_step's loss seam
+) -> jax.Array:
+    """Mean next-token NLL over all microbatches."""
+    from .train import next_token_nll
+
+    logits = pipeline_forward(params, tokens, config, pcfg, mesh)
+    m, b, s, v = logits.shape
+    return next_token_nll(
+        logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
+    )
+
+
+def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Tokens ``[M, B_m, S]``: microbatch axis replicated, batch over data."""
+    return NamedSharding(mesh, P(None, "data", None))
+
+
+def pipeline_state_shardings(mesh: Mesh, state: dict) -> dict:
+    """Stage stacks shard over ``"pipe"``; everything else replicates.
+
+    Adam moments mirror their parameters, as in
+    :func:`.train.state_shardings`.
+    """
+
+    def param_spec(path, leaf):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        return NamedSharding(mesh, P("pipe") if "stages" in keys else P())
+
+    p_shardings = jax.tree_util.tree_map_with_path(param_spec, state["params"])
+    replicated = NamedSharding(mesh, P())
+
+    def shard_opt(opt_state):
+        def map_one(entry):
+            if hasattr(entry, "mu"):  # ScaleByAdamState
+                return entry._replace(
+                    count=replicated, mu=p_shardings, nu=p_shardings
+                )
+            return jax.tree.map(lambda _: replicated, entry)
+
+        return tuple(map_one(e) for e in opt_state)
+
+    return {
+        "params": p_shardings,
+        "opt_state": shard_opt(state["opt_state"]),
+        "step": replicated,
+    }
+
+
+def init_pipeline_train_state(
+    rng: jax.Array, config: ModelConfig, train_config, n_stages: int
+) -> dict:
+    from .train import init_train_state
+
+    return init_train_state(
+        rng, config, train_config,
+        init_fn=partial(init_pipeline_params, n_stages=n_stages),
+    )
+
+
+def place_pipeline_state(mesh: Mesh, state: dict) -> dict:
+    shardings = pipeline_state_shardings(mesh, state)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, shardings)
+
+
+def make_pipeline_train_step(
+    mesh: Mesh,
+    config: ModelConfig,
+    pcfg: PipelineConfig,
+    train_config,
+    state: dict,
+):
+    """Compile one pp x dp optimizer step: grads flow back through the
+    ``ppermute`` schedule (reverse-pipeline collectives inserted by AD).
+
+    Delegates to :func:`.train.make_train_step` through its loss/sharding
+    seams so there is exactly one optimizer-step implementation.
+    """
+    from .train import make_train_step
+
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh),
+        state_shardings_fn=pipeline_state_shardings,
+        batch_sharding_fn=pipeline_batch_sharding,
+    )

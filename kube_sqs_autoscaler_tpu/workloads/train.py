@@ -213,17 +213,24 @@ def make_train_step(
     train_config: TrainConfig,
     state: dict,
     loss: Any = None,
+    state_shardings_fn: Any = None,
+    batch_sharding_fn: Any = None,
 ):
     """Compile one optimizer step over the mesh.
 
     Returns ``step_fn(state, tokens) -> (state, loss)`` with input/output
     shardings pinned so repeated calls stay stable (no resharding churn).
-    ``loss(params, tokens, attention_fn) -> scalar`` overrides the
-    objective (default: :func:`loss_fn` on the dense model); :mod:`.moe`
-    passes its aux-loss-augmented objective through this seam.
+    Three seams keep this the single optimizer-step implementation for all
+    model variants: ``loss(params, tokens, attention_fn) -> scalar``
+    overrides the objective (default :func:`loss_fn`; :mod:`.moe` passes
+    its aux-augmented loss, :mod:`.pipeline` its microbatched one), and
+    ``state_shardings_fn(mesh, state)`` / ``batch_sharding_fn(mesh)``
+    override the placement rules (default: the PARAM_AXES rules here;
+    :mod:`.pipeline` passes its stage-stacked rules).
     """
     optimizer = make_optimizer(train_config)
-    shardings = state_shardings(mesh, state)
+    shardings = (state_shardings_fn or state_shardings)(mesh, state)
+    batch_shard = (batch_sharding_fn or batch_sharding)(mesh)
     attention_fn = mesh_attention_fn(mesh)
     if loss is None:
         loss = partial(loss_fn, config=model_config)
@@ -243,7 +250,7 @@ def make_train_step(
 
     return jax.jit(
         train_step,
-        in_shardings=(shardings, batch_sharding(mesh)),
+        in_shardings=(shardings, batch_shard),
         out_shardings=(shardings, replicated(mesh)),
         donate_argnums=0,
     )
