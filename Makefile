@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test clean compile build push bench dryrun
+.PHONY: test clean compile build push bench dryrun native
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.1.0
@@ -26,6 +26,11 @@ push: build
 
 bench:
 	python bench.py
+
+# Build the native (C++) local-queue broker explicitly.  Optional: the
+# ctypes binding also builds it on first use.
+native:
+	python -c "from kube_sqs_autoscaler_tpu.native import load_library; load_library(); print('native queue built')"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

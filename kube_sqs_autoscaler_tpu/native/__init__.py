@@ -1,0 +1,225 @@
+"""Native local queue: ctypes binding over ``localqueue.cpp``.
+
+The C++ broker (built on demand with ``g++``; see the .cpp header for why
+it exists) is exposed here as :class:`LocalQueue`, which speaks **both**
+protocols the framework defines:
+
+- the controller's :class:`~..metrics.queue.QueueService`
+  (``get_queue_attributes``) — so ``QueueMetricSource`` can watch a local
+  queue exactly like SQS, and
+- the workers' :class:`~..workloads.service.MessageQueue`
+  (``receive_messages`` / ``delete_message``) — so ``QueueWorker`` can
+  drain one.
+
+That makes the native broker a drop-in replacement for AWS SQS when
+producer, queue, and TPU workers are co-located: the whole
+autoscaling-plus-worker stack runs against it unchanged (see
+``tests/test_native_queue.py`` for the closed loop).
+
+Build model: one ``g++ -O2 -shared -fPIC`` invocation, cached in
+``_build/`` next to this file and rebuilt when the source is newer.  No
+pybind11 (not in this image); plain ``extern "C"`` + ctypes, which also
+releases the GIL during blocking receives.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).with_name("localqueue.cpp")
+_BUILD_DIR = Path(__file__).with_name("_build")
+_LIB = _BUILD_DIR / "liblocalqueue.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeUnavailableError(RuntimeError):
+    """Raised when the native library cannot be built (no g++)."""
+
+
+def _compile() -> None:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    # per-process tmp name: concurrent builders (parallel pytest workers,
+    # several pods on a shared volume) each write their own file and the
+    # final os.replace is atomic, so a complete .so always wins
+    tmp = _BUILD_DIR / f"liblocalqueue.{os.getpid()}.so.tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as err:
+        raise NativeUnavailableError("g++ not found; native queue unavailable") from err
+    except subprocess.CalledProcessError as err:
+        raise NativeUnavailableError(
+            f"native build failed:\n{err.stderr}"
+        ) from err
+    os.replace(tmp, _LIB)
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if stale) and load the native library; cached per process."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            _compile()
+        lib = ctypes.CDLL(str(_LIB))
+        c = ctypes
+        lib.lq_create.argtypes = [c.c_double]
+        lib.lq_create.restype = c.c_void_p
+        lib.lq_destroy.argtypes = [c.c_void_p]
+        lib.lq_destroy.restype = None
+        lib.lq_use_manual_clock.argtypes = [c.c_void_p, c.c_int]
+        lib.lq_use_manual_clock.restype = None
+        lib.lq_advance.argtypes = [c.c_void_p, c.c_double]
+        lib.lq_advance.restype = None
+        lib.lq_send.argtypes = [c.c_void_p, c.c_char_p, c.c_longlong, c.c_double]
+        lib.lq_send.restype = c.c_longlong
+        lib.lq_receive.argtypes = [
+            c.c_void_p, c.c_double,
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+        ]
+        lib.lq_receive.restype = c.c_int
+        lib.lq_fetch_body.argtypes = [
+            c.c_void_p, c.c_longlong, c.c_char_p, c.c_longlong,
+        ]
+        lib.lq_fetch_body.restype = c.c_longlong
+        lib.lq_delete.argtypes = [c.c_void_p, c.c_longlong]
+        lib.lq_delete.restype = c.c_int
+        lib.lq_change_visibility.argtypes = [c.c_void_p, c.c_longlong, c.c_double]
+        lib.lq_change_visibility.restype = c.c_int
+        lib.lq_attributes.argtypes = [c.c_void_p, c.c_longlong * 3]
+        lib.lq_attributes.restype = None
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    """True if the native library is (or can be) built on this machine."""
+    try:
+        load_library()
+        return True
+    except NativeUnavailableError:
+        return False
+
+
+class LocalQueue:
+    """One native queue.  Implements the controller's ``QueueService`` and
+    the workers' ``MessageQueue`` protocols (the ``queue_url`` arguments
+    those carry are accepted and ignored — a local queue *is* its handle).
+    """
+
+    def __init__(
+        self, visibility_timeout: float = 30.0, manual_clock: bool = False
+    ) -> None:
+        self._lib = load_library()
+        self._q = self._lib.lq_create(float(visibility_timeout))
+        if manual_clock:
+            self._lib.lq_use_manual_clock(self._q, 1)
+
+    # --- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._q is not None:
+            # null the handle first (under the GIL) so no new call can
+            # reach the C++ object while lq_destroy drains long-pollers
+            handle, self._q = self._q, None
+            self._lib.lq_destroy(handle)
+
+    def _handle(self):
+        if self._q is None:
+            raise ValueError("operation on closed LocalQueue")
+        return self._q
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "LocalQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- test clock ------------------------------------------------------
+    def advance(self, seconds: float) -> None:
+        """Advance the queue's manual clock (visibility/delay expiry)."""
+        self._lib.lq_advance(self._handle(), float(seconds))
+
+    # --- producer --------------------------------------------------------
+    def send_message(
+        self, queue_url: str = "", body: str = "", delay_s: float = 0.0
+    ) -> str:
+        data = body.encode()
+        msg_id = self._lib.lq_send(self._handle(), data, len(data), float(delay_s))
+        return f"msg-{msg_id}"
+
+    # --- consumer (workers' MessageQueue protocol) -----------------------
+    def receive_messages(
+        self, queue_url: str = "", max_messages: int = 1, wait_time_s: int = 0
+    ) -> list[dict]:
+        out = []
+        wait = float(wait_time_s)
+        for _ in range(max_messages):
+            receipt = ctypes.c_longlong()
+            length = ctypes.c_longlong()
+            status = self._lib.lq_receive(
+                self._handle(), wait, ctypes.byref(receipt), ctypes.byref(length)
+            )
+            if status != 0:
+                break
+            wait = 0.0  # only the first receive of a batch long-polls
+            buf = ctypes.create_string_buffer(int(length.value))
+            n = self._lib.lq_fetch_body(
+                self._handle(), receipt.value, buf, length.value
+            )
+            if n < 0:  # expired between receive and fetch (real clock only)
+                continue
+            out.append(
+                {"ReceiptHandle": f"rh-{receipt.value}", "Body": buf.raw[:n].decode()}
+            )
+        return out
+
+    def delete_message(self, queue_url: str = "", receipt_handle: str = "") -> None:
+        self._lib.lq_delete(self._handle(), self._parse_receipt(receipt_handle))
+
+    def change_message_visibility(
+        self, receipt_handle: str, timeout_s: float
+    ) -> bool:
+        status = self._lib.lq_change_visibility(
+            self._handle(), self._parse_receipt(receipt_handle), float(timeout_s)
+        )
+        return status == 0
+
+    # --- controller (QueueService protocol) ------------------------------
+    def get_queue_attributes(
+        self, queue_url: str = "", attribute_names: list | None = None
+    ) -> dict:
+        counts = (ctypes.c_longlong * 3)()
+        self._lib.lq_attributes(self._handle(), counts)
+        attributes = {
+            "ApproximateNumberOfMessages": str(counts[0]),
+            "ApproximateNumberOfMessagesDelayed": str(counts[1]),
+            "ApproximateNumberOfMessagesNotVisible": str(counts[2]),
+        }
+        if attribute_names is None:
+            return attributes
+        return {
+            name: attributes[name]
+            for name in attribute_names
+            if name in attributes
+        }
+
+    @staticmethod
+    def _parse_receipt(receipt_handle: str) -> int:
+        if receipt_handle.startswith("rh-"):
+            return int(receipt_handle[3:])
+        return -1  # unknown handles fail the delete, mirroring SQS

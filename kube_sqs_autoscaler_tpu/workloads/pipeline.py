@@ -218,35 +218,25 @@ def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "data", None))
 
 
-def pipeline_state_shardings(mesh: Mesh, state: dict) -> dict:
-    """Stage stacks shard over ``"pipe"``; everything else replicates.
-
-    Adam moments mirror their parameters, as in
-    :func:`.train.state_shardings`.
-    """
+def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
+    """Stage stacks shard their leading layer axis over ``"pipe"``;
+    embedding/unembedding/final-LN replicate."""
 
     def param_spec(path, leaf):
         keys = [p.key for p in path if hasattr(p, "key")]
         return NamedSharding(mesh, P("pipe") if "stages" in keys else P())
 
-    p_shardings = jax.tree_util.tree_map_with_path(param_spec, state["params"])
-    replicated = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map_with_path(param_spec, params)
 
-    def shard_opt(opt_state):
-        def map_one(entry):
-            if hasattr(entry, "mu"):  # ScaleByAdamState
-                return entry._replace(
-                    count=replicated, mu=p_shardings, nu=p_shardings
-                )
-            return jax.tree.map(lambda _: replicated, entry)
 
-        return tuple(map_one(e) for e in opt_state)
+def pipeline_state_shardings(mesh: Mesh, state: dict) -> dict:
+    """:func:`.train.state_shardings` with the stage-stacked param rules
+    (Adam moments mirror their parameters either way)."""
+    from .train import state_shardings
 
-    return {
-        "params": p_shardings,
-        "opt_state": shard_opt(state["opt_state"]),
-        "step": replicated,
-    }
+    return state_shardings(
+        mesh, state, param_shardings_fn=pipeline_param_shardings
+    )
 
 
 def init_pipeline_train_state(
@@ -261,8 +251,9 @@ def init_pipeline_train_state(
 
 
 def place_pipeline_state(mesh: Mesh, state: dict) -> dict:
-    shardings = pipeline_state_shardings(mesh, state)
-    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, shardings)
+    from .train import place_state
+
+    return place_state(mesh, state, state_shardings_fn=pipeline_state_shardings)
 
 
 def make_pipeline_train_step(

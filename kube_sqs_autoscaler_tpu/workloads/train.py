@@ -174,9 +174,17 @@ def init_train_state(
     return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 
 
-def state_shardings(mesh: Mesh, state: dict) -> dict:
-    """Shard optimizer moments like their parameters; scalars replicate."""
-    p_shardings = param_shardings(mesh, state["params"])
+def state_shardings(
+    mesh: Mesh, state: dict, param_shardings_fn: Any = None
+) -> dict:
+    """Shard optimizer moments like their parameters; scalars replicate.
+
+    ``param_shardings_fn(mesh, params)`` overrides the parameter placement
+    rules (default: the PARAM_AXES rules in :func:`param_shardings`;
+    :mod:`.pipeline` passes its stage-stacked rules) — the Adam-moment
+    mirroring is the same for every variant.
+    """
+    p_shardings = (param_shardings_fn or param_shardings)(mesh, state["params"])
 
     # optax.adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/others)
     def shard_opt(opt_state):
@@ -198,9 +206,11 @@ def state_shardings(mesh: Mesh, state: dict) -> dict:
     }
 
 
-def place_state(mesh: Mesh, state: dict) -> dict:
+def place_state(
+    mesh: Mesh, state: dict, state_shardings_fn: Any = None
+) -> dict:
     """Device-put the state pytree onto the mesh with its shardings."""
-    shardings = state_shardings(mesh, state)
+    shardings = (state_shardings_fn or state_shardings)(mesh, state)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, s), state, shardings,
         is_leaf=lambda x: x is None,
