@@ -192,6 +192,7 @@ def forward(
     config: ModelConfig,
     attention_fn=None,
     mlp=None,
+    positions: jax.Array | None = None,
 ) -> jax.Array:
     """Logits for a token batch. Pure; jit/pjit at the call site.
 
@@ -202,13 +203,19 @@ def forward(
     ``attention_fn`` overrides the attention inner op (``[B,H,S,D]^3 -> out``),
     e.g. ring attention for a sequence-sharded mesh; ``mlp(x, layer)``
     overrides the per-block MLP (e.g. the sparse expert MLP in :mod:`.moe`).
+    ``positions`` overrides the positional-embedding indices (default
+    ``0..seq-1``) for permuted-order execution, e.g. the zig-zag layout in
+    :mod:`.zigzag`.
     """
     seq = tokens.shape[1]
     if seq > config.max_seq_len:
         raise ValueError(
             f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
         )
-    x = params["embed"][tokens] + params["pos_embed"][:seq]
+    if positions is None:
+        x = params["embed"][tokens] + params["pos_embed"][:seq]
+    else:
+        x = params["embed"][tokens] + params["pos_embed"][positions]
     # attention_fn is the seam for sequence-parallel ring attention and the
     # Pallas flash kernel; the default is the dense single-mesh-shard path
     attend = attention_fn or _dense_attention

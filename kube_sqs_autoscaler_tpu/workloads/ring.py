@@ -23,8 +23,8 @@ value (not ``-inf``), keeping the running max finite so ``exp`` never sees
 
 Compute note: like standard ring attention, every device runs all ``P``
 steps (lockstep collectives), so causal masking wastes ~half the FLOPs;
-zig-zag block reordering recovers that and is a known follow-up, not done
-here.
+:mod:`.zigzag` implements the block reordering that recovers it (balanced
+per-device load, half-size unmasked matmuls on every non-diagonal hop).
 """
 
 from __future__ import annotations
@@ -37,6 +37,27 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = jnp.float32(-1e9)  # finite mask value; see module docstring
+NEG_INF = _NEG_INF  # shared with .zigzag
+
+
+def online_update(o, l, m, scores, v_blk):
+    """Numerically-stable online-softmax merge of one fp32 score block
+    into running ``(o, l, m)`` accumulators.  The single implementation
+    both ring schedules (:mod:`.ring`, :mod:`.zigzag`) use — the
+    stability-sensitive math lives in exactly one place."""
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+    )
+    return o_new, l_new, m_new
+
+
+def ring_rotation(axis_size: int) -> list[tuple[int, int]]:
+    """The one-hop ``ppermute`` pattern ``i -> i+1`` (mod ``axis_size``)."""
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
 
 def _ring_attention_local(
@@ -81,16 +102,10 @@ def _ring_attention_local(
         causal = q_positions[:, None] >= k_positions[None, :]
         scores = jnp.where(causal, scores, _NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
-        )
+        o_new, l_new, m_new = online_update(o, l, m, scores, v_blk)
 
         # rotate k/v one hop around the ring: i -> i+1
-        ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        ring = ring_rotation(axis_size)
         k_next = jax.lax.ppermute(k_blk, axis_name, ring)
         v_next = jax.lax.ppermute(v_blk, axis_name, ring)
         return (o_new, l_new, m_new, k_next, v_next), None

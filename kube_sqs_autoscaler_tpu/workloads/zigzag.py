@@ -1,0 +1,279 @@
+"""Zig-zag ring attention: load-balanced causal sequence parallelism.
+
+Plain ring attention (:mod:`.ring`) shards the sequence *contiguously*:
+device ``d`` owns block ``d``.  Under a causal mask that is imbalanced —
+device 0's queries attend to almost nothing, device ``P-1``'s to
+everything — and because the ring's collectives are lockstep, every device
+pays the worst device's cost each hop: ~half the attention FLOPs are
+spent on fully-masked blocks.
+
+The zig-zag layout (used by modern long-context stacks) fixes this. Split
+the sequence into ``2P`` chunks; device ``d`` owns chunks ``d`` **and**
+``2P-1-d`` (one early, one late).  Now every device's causal workload is
+identical, and each ring hop needs only *half* the score matrix:
+
+- hop 0 (own k/v): the full ``2c x 2c`` block with the positional causal
+  mask (the only masked matmul);
+- k/v from an earlier device (``e < d``): **all** local queries attend to
+  the *early* k/v chunk and **none** to the late one — compute
+  ``[2c, c]`` unmasked, skip the other half entirely;
+- k/v from a later device (``e > d``): only the *late* local queries
+  attend, to **both** k/v chunks — compute ``[c, 2c]`` unmasked.
+
+Same online-softmax merge, same one-hop ``ppermute`` ring as
+:mod:`.ring`; per-hop compute drops ~2x and is identical on every device,
+so the lockstep no longer waits on stragglers.
+
+Layout contract: q/k/v enter (and the output leaves) in **zig-zag order**
+— natural position ``zigzag_permutation(S, P)[i]`` lives at permuted slot
+``i``.  The loss is computed in permuted order too (permuted positional
+indices and permuted shifted targets), so the model's *output* never
+needs a cross-shard unpermute.  The *input* permute can live in either
+place: :func:`permute_batch` applies it host-side (so the jitted step
+sees pre-permuted arrays and does zero permute work on device — the
+production path), while :func:`zigzag_loss_fn` accepts natural-order
+tokens and permutes inside the program with static index gathers (the
+convenience/reference form the tests compare against).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring import NEG_INF as _NEG_INF, online_update, ring_rotation
+
+
+def zigzag_permutation(seq: int, n_devices: int) -> np.ndarray:
+    """``perm[i]`` = natural position stored at zig-zag slot ``i``.
+
+    Slots are laid out device-major: device ``d`` gets chunks ``d`` and
+    ``2P-1-d`` of size ``seq / (2P)``, concatenated.  Static/host-side
+    (NumPy): the permutation is data-independent.
+    """
+    if seq % (2 * n_devices):
+        raise ValueError(
+            f"seq={seq} must be divisible by 2*n_devices={2 * n_devices}"
+        )
+    chunk = seq // (2 * n_devices)
+    out = []
+    for d in range(n_devices):
+        out.append(np.arange(d * chunk, (d + 1) * chunk))
+        hi = 2 * n_devices - 1 - d
+        out.append(np.arange(hi * chunk, (hi + 1) * chunk))
+    return np.concatenate(out)
+
+
+def inverse_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return inv
+
+
+def _zigzag_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device body. q/k/v: ``[B, H, 2c, D]`` in zig-zag order."""
+    seq_local = q.shape[2]
+    chunk = seq_local // 2
+    head_dim = q.shape[-1]
+    my_index = jax.lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32) * (1.0 / head_dim**0.5)
+    local = jnp.arange(chunk)
+    # global positions of this device's two chunks (low: d, high: 2P-1-d)
+    pos_lo = my_index * chunk + local
+    pos_hi = (2 * axis_size - 1 - my_index) * chunk + local
+    q_positions = jnp.concatenate([pos_lo, pos_hi])
+
+    o0 = q32 * 0.0
+    l0 = q32[..., :1] * 0.0
+    m0 = q32[..., :1] * 0.0 + _NEG_INF
+
+    def scores_for(q_part, k_part):
+        return jnp.einsum(
+            "bhqd,bhkd->bhqk", q_part, k_part.astype(jnp.float32)
+        )
+
+    def step(carry, step_index):
+        o, l, m, k_blk, v_blk = carry
+        kv_index = (my_index - step_index) % axis_size
+
+        def diag(o, l, m):
+            # own k/v: the only masked block (both causal diagonals);
+            # k positions == q_positions since kv_index == my_index here
+            scores = scores_for(q32, k_blk)
+            causal = q_positions[:, None] >= q_positions[None, :]
+            return online_update(
+                o, l, m, jnp.where(causal, scores, _NEG_INF), v_blk
+            )
+
+        def from_earlier(o, l, m):
+            # e < d: every local q attends the early chunk, none the late
+            # one — half the matmul, no mask
+            scores = scores_for(q32, k_blk[:, :, :chunk])
+            return online_update(o, l, m, scores, v_blk[:, :, :chunk])
+
+        def from_later(o, l, m):
+            # e > d: only the late local queries attend, to both chunks —
+            # half the matmul, no mask; early-q accumulators pass through
+            scores = scores_for(q32[:, :, chunk:], k_blk)
+            o_hi, l_hi, m_hi = online_update(
+                o[:, :, chunk:], l[:, :, chunk:], m[:, :, chunk:],
+                scores, v_blk,
+            )
+            return (
+                jnp.concatenate([o[:, :, :chunk], o_hi], axis=2),
+                jnp.concatenate([l[:, :, :chunk], l_hi], axis=2),
+                jnp.concatenate([m[:, :, :chunk], m_hi], axis=2),
+            )
+
+        o, l, m = jax.lax.cond(
+            kv_index == my_index,
+            diag,
+            lambda o, l, m: jax.lax.cond(
+                kv_index < my_index, from_earlier, from_later, o, l, m
+            ),
+            o, l, m,
+        )
+
+        ring = ring_rotation(axis_size)
+        k_next = jax.lax.ppermute(k_blk, axis_name, ring)
+        v_next = jax.lax.ppermute(v_blk, axis_name, ring)
+        return (o, l, m, k_next, v_next), None
+
+    (o, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(axis_size)
+    )
+    return (o / l).astype(q.dtype)
+
+
+def make_zigzag_ring_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Attention fn over ``mesh[seq_axis]`` for **zig-zag-ordered** inputs.
+
+    Same signature/sharding as :func:`.ring.make_ring_attention`
+    (``[B, H, S, D]``; batch over ``data_axis``, heads over
+    ``model_axis``, sequence over ``seq_axis``) but the sequence axis must
+    carry :func:`zigzag_permutation` order — which makes the contiguous
+    shard on device ``d`` exactly its two zig-zag chunks.
+    """
+    axis_size = mesh.shape[seq_axis]
+    if axis_size < 2:
+        raise ValueError("zig-zag needs a nontrivial seq axis (P >= 2)")
+    spec = P(data_axis, model_axis, seq_axis, None)
+    body = partial(
+        _zigzag_attention_local, axis_name=seq_axis, axis_size=axis_size
+    )
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+
+
+def permute_batch(tokens, n_devices: int):
+    """Host-side zig-zag preparation of one natural-order token batch.
+
+    Returns ``(tokens_zz, targets_zz, valid)`` — the permuted inputs, the
+    permuted shifted targets (target at slot ``i`` is the token at natural
+    position ``perm[i] + 1``), and the validity mask (the slot holding the
+    last natural position has no target).  Feed these to
+    :func:`zigzag_loss_from_permuted` so the jitted step does **zero**
+    permute work on device; do this in the input pipeline of a real
+    sequence-sharded run.
+    """
+    tokens = np.asarray(tokens)
+    seq = tokens.shape[1]
+    perm = zigzag_permutation(seq, n_devices)
+    next_tokens = np.concatenate(
+        [tokens[:, 1:], np.zeros_like(tokens[:, :1])], axis=1
+    )
+    return tokens[:, perm], next_tokens[:, perm], (perm < seq - 1)[None, :]
+
+
+def zigzag_loss_from_permuted(
+    params,
+    tokens_zz: jax.Array,
+    targets_zz: jax.Array,
+    valid: jax.Array,
+    config,
+    mesh: Mesh,
+    attention_fn=None,
+):
+    """LM loss on a batch already in zig-zag order (see
+    :func:`permute_batch`): forward runs with permuted positional indices,
+    the loss masks the target-less slot — no permute happens on device.
+    """
+    from .model import forward
+
+    seq = tokens_zz.shape[1]
+    perm = jnp.asarray(zigzag_permutation(seq, mesh.shape["seq"]))
+    attend = attention_fn or make_zigzag_ring_attention(mesh)
+
+    logits = forward(params, tokens_zz, config, attend, positions=perm)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets_zz[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / (tokens_zz.shape[0] * (seq - 1))
+
+
+def zigzag_loss_fn(
+    params,
+    tokens: jax.Array,
+    config,
+    mesh: Mesh,
+    attention_fn=None,
+):
+    """Convenience/reference form: **natural-order** tokens in, permutes
+    inside the traced program with static index gathers.
+
+    On a seq-sharded mesh those gathers cross shards once per step (XLA
+    lowers them to collective permutes of the int32 token array — cheap
+    next to the model compute, but not free); the production input
+    pipeline should pre-permute with :func:`permute_batch` and call
+    :func:`zigzag_loss_from_permuted` instead.  Tests pin this form and
+    the pre-permuted form to the natural-order :func:`.train.loss_fn`.
+    """
+    seq = tokens.shape[1]
+    perm = jnp.asarray(zigzag_permutation(seq, mesh.shape["seq"]))
+    tokens_zz = tokens[:, perm]
+    next_tokens = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    targets_zz = next_tokens[:, perm]
+    valid = (perm < seq - 1)[None, :]
+    return zigzag_loss_from_permuted(
+        params, tokens_zz, targets_zz, valid, config, mesh, attention_fn
+    )
+
+
+def make_zigzag_train_step(mesh: Mesh, config, train_config, state):
+    """Compile a dp x sp x tp train step whose sequence parallelism runs
+    the balanced zig-zag schedule instead of plain ring attention.
+
+    Takes **natural-order** tokens (the in-program permute documented on
+    :func:`zigzag_loss_fn`).  Delegates to :func:`.train.make_train_step`
+    through its ``loss`` seam; an input pipeline that pre-permutes should
+    jit :func:`zigzag_loss_from_permuted` directly instead.
+    """
+    from .train import make_train_step
+
+    attend = make_zigzag_ring_attention(mesh)
+
+    def loss(params, tokens, attention_fn=None):  # seam signature
+        return zigzag_loss_fn(params, tokens, config, mesh, attend)
+
+    return make_train_step(mesh, config, train_config, state, loss=loss)
