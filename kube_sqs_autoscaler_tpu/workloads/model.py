@@ -193,6 +193,7 @@ def forward(
     attention_fn=None,
     mlp=None,
     positions: jax.Array | None = None,
+    remat: bool = False,
 ) -> jax.Array:
     """Logits for a token batch. Pure; jit/pjit at the call site.
 
@@ -205,7 +206,9 @@ def forward(
     overrides the per-block MLP (e.g. the sparse expert MLP in :mod:`.moe`).
     ``positions`` overrides the positional-embedding indices (default
     ``0..seq-1``) for permuted-order execution, e.g. the zig-zag layout in
-    :mod:`.zigzag`.
+    :mod:`.zigzag`.  ``remat=True`` wraps each block in ``jax.checkpoint``
+    so the backward pass recomputes block activations instead of keeping
+    them in HBM (identical values, lower peak memory).
     """
     seq = tokens.shape[1]
     if seq > config.max_seq_len:
@@ -219,8 +222,12 @@ def forward(
     # attention_fn is the seam for sequence-parallel ring attention and the
     # Pallas flash kernel; the default is the dense single-mesh-shard path
     attend = attention_fn or _dense_attention
+    block = _block
+    if remat:
+        # config/attend/mlp are static (hashable, trace-time) arguments
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4))
     for layer in params["layers"]:
-        x = _block(x, layer, config, attend, mlp=mlp)
+        x = block(x, layer, config, attend, mlp)
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
     # fp32 logits for a stable softmax/cross-entropy downstream
     return jnp.einsum(

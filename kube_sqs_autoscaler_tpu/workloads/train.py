@@ -132,6 +132,19 @@ class TrainConfig:
     weight_decay: float = 0.01
     b1: float = 0.9
     b2: float = 0.95
+    # remat: recompute block activations in the backward pass instead of
+    # keeping them resident in HBM — the standard TPU memory/FLOPs trade
+    # (jax.checkpoint around the loss).  Identical results, lower peak HBM.
+    remat: bool = False
+    # grad_accum > 1 splits each batch into that many microbatches and
+    # averages their grads under one optimizer step (lax.scan, so the
+    # compiled program is one XLA module regardless of the count) —
+    # large effective batches without large resident activations.
+    grad_accum: int = 1
+
+    def __post_init__(self) -> None:
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum={self.grad_accum} must be >= 1")
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -156,10 +169,16 @@ def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 
 
 def loss_fn(
-    params: Any, tokens: jax.Array, config: ModelConfig, attention_fn=None
+    params: Any,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attention_fn=None,
+    remat: bool = False,
 ) -> jax.Array:
     """Next-token cross-entropy in fp32 (the standard LM objective)."""
-    return next_token_nll(forward(params, tokens, config, attention_fn), tokens)
+    return next_token_nll(
+        forward(params, tokens, config, attention_fn, remat=remat), tokens
+    )
 
 
 def init_train_state(
@@ -243,12 +262,55 @@ def make_train_step(
     batch_shard = (batch_sharding_fn or batch_sharding)(mesh)
     attention_fn = mesh_attention_fn(mesh)
     if loss is None:
-        loss = partial(loss_fn, config=model_config)
+        loss = partial(
+            loss_fn, config=model_config, remat=train_config.remat
+        )
+    # custom losses opt into remat themselves (forward's remat flag)
+
+    accum = train_config.grad_accum
+
+    def compute_grads(params, tokens):
+        if accum == 1:
+            return jax.value_and_grad(loss)(
+                params, tokens, attention_fn=attention_fn
+            )
+        if tokens.shape[0] % accum:
+            raise ValueError(
+                f"batch dim {tokens.shape[0]} not divisible by "
+                f"grad_accum={accum}"
+            )
+        # interleave: microbatch j takes rows ≡ j (mod accum), so each
+        # data-parallel shard contributes evenly to every microbatch and
+        # the split stays shard-local
+        micro = jnp.swapaxes(
+            tokens.reshape(tokens.shape[0] // accum, accum, *tokens.shape[1:]),
+            0, 1,
+        )
+
+        def one(carry, microbatch):
+            loss_sum, grad_sum = carry
+            l, g = jax.value_and_grad(loss)(
+                params, microbatch, attention_fn=attention_fn
+            )
+            # fp32 accumulation regardless of the grad dtype
+            grad_sum = jax.tree.map(
+                lambda acc, grad: acc + grad.astype(jnp.float32), grad_sum, g
+            )
+            return (loss_sum + l, grad_sum), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            one, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        grads = jax.tree.map(
+            lambda g, p: (g / accum).astype(p.dtype), grad_sum, params
+        )
+        return loss_sum / accum, grads
 
     def train_step(state, tokens):
-        loss_value, grads = jax.value_and_grad(loss)(
-            state["params"], tokens, attention_fn=attention_fn
-        )
+        loss_value, grads = compute_grads(state["params"], tokens)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

@@ -70,9 +70,8 @@ def zigzag_permutation(seq: int, n_devices: int) -> np.ndarray:
 
 
 def inverse_permutation(perm: np.ndarray) -> np.ndarray:
-    inv = np.empty_like(perm)
-    inv[perm] = np.arange(perm.size)
-    return inv
+    """Inverse of a permutation (argsort is exactly that for a bijection)."""
+    return np.argsort(perm)
 
 
 def _zigzag_attention_local(
@@ -180,9 +179,11 @@ def make_zigzag_ring_attention(
     body = partial(
         _zigzag_attention_local, axis_name=seq_axis, axis_size=axis_size
     )
-    return jax.shard_map(
+    fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
+    fn._zigzag = True  # layout marker checked by the zig-zag losses
+    return fn
 
 
 def permute_batch(tokens, n_devices: int):
@@ -205,6 +206,26 @@ def permute_batch(tokens, n_devices: int):
     return tokens[:, perm], next_tokens[:, perm], (perm < seq - 1)[None, :]
 
 
+def _require_zigzag_attention(attention_fn, mesh: Mesh):
+    """The zig-zag losses only make sense with zig-zag-layout attention.
+
+    A natural-order attention fn (plain ring, dense causal) on permuted
+    inputs computes a *wrong but finite* loss — e.g. wiring
+    ``partial(zigzag_loss_fn, ...)`` through ``make_train_step``'s loss
+    seam would silently inject the seam's ring attention.  Fail loudly
+    instead.
+    """
+    if attention_fn is None:
+        return make_zigzag_ring_attention(mesh)
+    if not getattr(attention_fn, "_zigzag", False):
+        raise ValueError(
+            "zig-zag loss requires attention built by "
+            "make_zigzag_ring_attention (inputs are in zig-zag order; a "
+            "natural-order attention fn would apply the wrong causal mask)"
+        )
+    return attention_fn
+
+
 def zigzag_loss_from_permuted(
     params,
     tokens_zz: jax.Array,
@@ -213,6 +234,7 @@ def zigzag_loss_from_permuted(
     config,
     mesh: Mesh,
     attention_fn=None,
+    remat: bool = False,
 ):
     """LM loss on a batch already in zig-zag order (see
     :func:`permute_batch`): forward runs with permuted positional indices,
@@ -222,9 +244,11 @@ def zigzag_loss_from_permuted(
 
     seq = tokens_zz.shape[1]
     perm = jnp.asarray(zigzag_permutation(seq, mesh.shape["seq"]))
-    attend = attention_fn or make_zigzag_ring_attention(mesh)
+    attend = _require_zigzag_attention(attention_fn, mesh)
 
-    logits = forward(params, tokens_zz, config, attend, positions=perm)
+    logits = forward(
+        params, tokens_zz, config, attend, positions=perm, remat=remat
+    )
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, targets_zz[..., None], axis=-1)[..., 0]
     return jnp.sum(nll * valid) / (tokens_zz.shape[0] * (seq - 1))
@@ -236,6 +260,7 @@ def zigzag_loss_fn(
     config,
     mesh: Mesh,
     attention_fn=None,
+    remat: bool = False,
 ):
     """Convenience/reference form: **natural-order** tokens in, permutes
     inside the traced program with static index gathers.
@@ -256,7 +281,8 @@ def zigzag_loss_fn(
     targets_zz = next_tokens[:, perm]
     valid = (perm < seq - 1)[None, :]
     return zigzag_loss_from_permuted(
-        params, tokens_zz, targets_zz, valid, config, mesh, attention_fn
+        params, tokens_zz, targets_zz, valid, config, mesh, attention_fn,
+        remat=remat,
     )
 
 
@@ -274,6 +300,11 @@ def make_zigzag_train_step(mesh: Mesh, config, train_config, state):
     attend = make_zigzag_ring_attention(mesh)
 
     def loss(params, tokens, attention_fn=None):  # seam signature
-        return zigzag_loss_fn(params, tokens, config, mesh, attend)
+        # the seam's attention_fn (plain ring) is deliberately discarded:
+        # zig-zag inputs need the zig-zag schedule built above
+        return zigzag_loss_fn(
+            params, tokens, config, mesh, attend,
+            remat=train_config.remat,
+        )
 
     return make_train_step(mesh, config, train_config, state, loss=loss)

@@ -100,6 +100,32 @@ def test_zigzag_requires_nontrivial_seq_axis():
         make_zigzag_ring_attention(mesh)
 
 
+def test_zigzag_loss_rejects_natural_order_attention():
+    # injecting plain ring attention (e.g. via make_train_step's loss
+    # seam) would compute a wrong-but-finite loss; it must fail loudly
+    from kube_sqs_autoscaler_tpu.workloads.ring import make_ring_attention
+
+    mesh = make_mesh(jax.devices(), model_parallel=1, seq_parallel=4)
+    params = init_params(jax.random.key(0), TINY)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, TINY.vocab_size, jnp.int32
+    )
+    with pytest.raises(ValueError, match="zig-zag"):
+        zigzag_loss_fn(params, tokens, TINY, mesh,
+                       attention_fn=make_ring_attention(mesh))
+
+
+def test_zigzag_remat_is_bit_identical():
+    mesh = make_mesh(jax.devices(), model_parallel=1, seq_parallel=4)
+    params = init_params(jax.random.key(0), TINY)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, TINY.vocab_size, jnp.int32
+    )
+    plain = float(zigzag_loss_fn(params, tokens, TINY, mesh))
+    remat = float(zigzag_loss_fn(params, tokens, TINY, mesh, remat=True))
+    assert plain == remat
+
+
 def test_zigzag_loss_matches_natural_order_loss():
     from kube_sqs_autoscaler_tpu.workloads.train import loss_fn
     from kube_sqs_autoscaler_tpu.workloads.zigzag import (
