@@ -1,0 +1,93 @@
+"""Opt-in profiling: JAX device traces and wall-clock span timing.
+
+The reference has no tracing/profiling of any kind (SURVEY.md §5 — no
+pprof, no OpenTelemetry, no timing instrumentation), so none is on by
+default here either.  But a TPU workload fleet without profilers is
+undiagnosable, so the framework ships two small opt-in tools:
+
+- :func:`maybe_trace` — a context manager that wraps a region in
+  ``jax.profiler.trace`` (XLA/TensorBoard trace of device + host
+  activity) when given a directory, and is a free no-op when not.
+  Workers enable it with ``ServiceConfig(profile_dir=...)``.
+- :class:`SpanTimer` — a dependency-free wall-clock span recorder for
+  control-plane code (which deliberately imports no JAX): named spans,
+  monotonic clock, summary percentiles.  The observability layer
+  (:mod:`..obs`) exposes per-tick latencies built on the same idea.
+
+Layering: ``maybe_trace`` imports JAX lazily inside the context manager,
+so importing this module from controller code keeps the no-JAX rule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: str | None):
+    """``with maybe_trace(dir):`` — JAX device trace when ``dir`` is set.
+
+    The trace is viewable with TensorBoard (or ``xprof``) pointed at the
+    directory.  ``None``/empty disables tracing with zero overhead.
+    """
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+@dataclass
+class SpanTimer:
+    """Thread-safe wall-clock span aggregation, dependency-free
+    (controller-safe).  :class:`~..workloads.service.QueueWorker` records
+    each serve cycle under ``"cycle"``; reusable for any span.
+
+    >>> timer = SpanTimer()
+    >>> with timer.span("tick"):
+    ...     pass
+    >>> timer.summary()["tick"]["count"]
+    1
+    """
+
+    clock: object = time  # injectable: needs .monotonic()
+    _durations: dict = field(default_factory=lambda: defaultdict(list))
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        start = self.clock.monotonic()
+        try:
+            yield
+        finally:
+            elapsed = self.clock.monotonic() - start
+            with self._lock:
+                self._durations[name].append(elapsed)
+
+    def summary(self) -> dict:
+        """Per-span ``{count, total_s, mean_s, p50_s, p99_s, max_s}``."""
+        with self._lock:
+            snapshot = {k: list(v) for k, v in self._durations.items()}
+        out = {}
+        for name, durations in snapshot.items():
+            ordered = sorted(durations)
+            n = len(ordered)
+            out[name] = {
+                "count": n,
+                "total_s": sum(ordered),
+                "mean_s": sum(ordered) / n,
+                "p50_s": ordered[n // 2],
+                "p99_s": ordered[min(n - 1, (n * 99) // 100)],
+                "max_s": ordered[-1],
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._durations.clear()

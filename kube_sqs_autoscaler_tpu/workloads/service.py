@@ -34,6 +34,7 @@ from typing import Any, Protocol
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.profiling import SpanTimer, maybe_trace
 from .decode import generate_jit
 from .flash import attention_fn_for
 from .model import ModelConfig, forward_jit_with
@@ -70,6 +71,12 @@ class ServiceConfig:
     # > 0: decode this many continuation tokens per message (KV-cache
     # generate mode) instead of a single classify forward
     generate_tokens: int = 0
+    # set to a directory to capture a JAX device trace of the first
+    # profile_cycles serve cycles (utils/profiling.maybe_trace), flushed
+    # as soon as the window closes — never the whole (unbounded) loop.
+    # Empty = no tracing, no overhead.
+    profile_dir: str = ""
+    profile_cycles: int = 20
 
 
 class QueueWorker:
@@ -113,6 +120,8 @@ class QueueWorker:
         )
         self._stop = threading.Event()
         self.processed = 0
+        # wall-clock cycle spans (summary() gives count/mean/p50/p99/max)
+        self.timer = SpanTimer()
 
     def stop(self) -> None:
         self._stop.set()
@@ -172,9 +181,29 @@ class QueueWorker:
         # unprocessed messages stay in-flight and reappear after the
         # visibility timeout. Pauses use the stop event so stop() wakes a
         # backing-off worker immediately.
-        while not self._stop.is_set():
+        if self.config.profile_dir:
+            # bounded window: trace only the first profile_cycles cycles
+            # so the trace flushes promptly and never grows with uptime.
+            # Profiler failures (unwritable dir, one-session-per-process
+            # when several pool workers all request tracing) must not
+            # break the never-dies guarantee — log and serve unprofiled.
             try:
-                idle = self.run_once() == 0
+                with maybe_trace(self.config.profile_dir):
+                    self._serve(max_cycles=self.config.profile_cycles)
+            except Exception as err:
+                log.error("Profiling failed (continuing unprofiled): %s", err)
+        self._serve()
+
+    def _serve(self, max_cycles: int | None = None) -> None:
+        """The serve loop body; ``max_cycles`` bounds it (None = forever)."""
+        cycles = 0
+        while not self._stop.is_set():
+            if max_cycles is not None and cycles >= max_cycles:
+                return
+            cycles += 1
+            try:
+                with self.timer.span("cycle"):
+                    idle = self.run_once() == 0
             except Exception as err:
                 log.error("Worker cycle failed: %s", err)
                 self._stop.wait(self.config.error_backoff_s)

@@ -1,0 +1,173 @@
+"""Profiling tools: the no-op path costs nothing and imports no JAX, the
+trace path writes an XLA trace, and span timing aggregates correctly on
+an injected clock.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.utils.profiling import SpanTimer, maybe_trace
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self):
+        return self.t
+
+
+def test_span_timer_aggregates_on_injected_clock():
+    clock = ManualClock()
+    timer = SpanTimer(clock=clock)
+    for dt in (0.1, 0.3, 0.2):
+        with timer.span("tick"):
+            clock.t += dt
+    s = timer.summary()["tick"]
+    assert s["count"] == 3
+    assert s["total_s"] == pytest.approx(0.6)
+    assert s["mean_s"] == pytest.approx(0.2)
+    assert s["p50_s"] == pytest.approx(0.2)
+    assert s["max_s"] == pytest.approx(0.3)
+    timer.reset()
+    assert timer.summary() == {}
+
+
+def test_span_timer_records_even_on_exception():
+    clock = ManualClock()
+    timer = SpanTimer(clock=clock)
+    with pytest.raises(RuntimeError):
+        with timer.span("bad"):
+            clock.t += 1.0
+            raise RuntimeError("boom")
+    assert timer.summary()["bad"]["count"] == 1
+
+
+def test_maybe_trace_none_is_noop_without_jax():
+    # the controller-safe path: no profile dir, no jax import
+    code = (
+        "import sys\n"
+        "base = 'jax' in sys.modules\n"
+        "from kube_sqs_autoscaler_tpu.utils.profiling import maybe_trace\n"
+        "with maybe_trace(None):\n"
+        "    pass\n"
+        "with maybe_trace(''):\n"
+        "    pass\n"
+        "assert ('jax' in sys.modules) == base, 'maybe_trace imported jax'\n"
+        "print('ok')\n"
+    )
+    from pathlib import Path
+
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
+
+
+def test_maybe_trace_writes_a_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with maybe_trace(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    written = list(tmp_path.rglob("*"))
+    assert any(p.is_file() for p in written), "no trace files written"
+
+
+def test_worker_profile_dir_traces_serve_loop(tmp_path):
+    import jax
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        QueueWorker,
+        ServiceConfig,
+    )
+
+    tiny = ModelConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=64,
+    )
+    queue = FakeMessageQueue()
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        queue.send_message(
+            "fake://q", json.dumps(rng.integers(0, 512, 16).tolist())
+        )
+    worker = QueueWorker(
+        queue, init_params(jax.random.key(0), tiny), tiny,
+        ServiceConfig(queue_url="fake://q", batch_size=4, seq_len=16,
+                      profile_dir=str(tmp_path)),
+    )
+    t = threading.Thread(target=worker.run_forever)
+    t.start()
+    for _ in range(200):
+        if worker.processed >= 3:
+            break
+        threading.Event().wait(0.05)
+    worker.stop()
+    t.join(timeout=10)
+    assert worker.processed >= 3
+    assert any(p.is_file() for p in tmp_path.rglob("*")), "no trace written"
+    # cycle spans were recorded through the timer
+    assert worker.timer.summary()["cycle"]["count"] >= 1
+
+
+def test_two_profiled_workers_both_survive(tmp_path):
+    # JAX allows one profiler session per process; the loser must log and
+    # keep serving unprofiled (never-dies guarantee), not crash-loop
+    import jax
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        QueueWorker,
+        ServiceConfig,
+    )
+
+    tiny = ModelConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=64,
+    )
+    queue = FakeMessageQueue()
+    rng = np.random.default_rng(1)
+    for _ in range(8):
+        queue.send_message(
+            "fake://q", json.dumps(rng.integers(0, 512, 16).tolist())
+        )
+    params = init_params(jax.random.key(0), tiny)
+    workers = [
+        QueueWorker(
+            queue, params, tiny,
+            ServiceConfig(queue_url="fake://q", batch_size=2, seq_len=16,
+                          profile_dir=str(tmp_path / f"w{i}")),
+        )
+        for i in range(2)
+    ]
+    threads = [threading.Thread(target=w.run_forever) for w in workers]
+    for t in threads:
+        t.start()
+    for _ in range(200):
+        if sum(w.processed for w in workers) >= 8:
+            break
+        threading.Event().wait(0.05)
+    for w in workers:
+        w.stop()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert sum(w.processed for w in workers) >= 8
