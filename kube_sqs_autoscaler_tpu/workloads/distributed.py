@@ -1,0 +1,137 @@
+"""Multi-host scaling: process initialization and topology-aware meshes.
+
+The reference is a single Go process making RPCs (SURVEY.md §5: its only
+"distributed" aspect is client HTTPS to two services); the workload this
+framework scales is a JAX SPMD program, and scaling *that* past one host
+is a first-class concern:
+
+- :func:`initialize_from_env` — one call at worker/trainer startup.  On a
+  multi-host TPU pod slice (or any fleet launched with coordinator env
+  vars) it runs ``jax.distributed.initialize`` so every process sees the
+  global device set; on a single host it is a no-op.  Controllers never
+  call this — they import no JAX.
+- :func:`make_topology_mesh` — a drop-in for :func:`.train.make_mesh`
+  that asks ``mesh_utils.create_device_mesh`` to order devices by the
+  physical ICI topology (so neighboring mesh coordinates are neighboring
+  chips and ``ppermute`` rings ride single hops) instead of naive
+  enumeration order.
+- :func:`make_hybrid_mesh` — multi-slice/multi-host layout: the ``data``
+  axis spans the slow DCN boundary (its collectives are the small
+  gradient all-reduces), while ``seq``/``model`` stay inside a slice on
+  ICI (their collectives are the big activation exchanges) — the
+  standard bandwidth-matched assignment from the scaling playbook.
+
+All three return/feed the same ``("data", "seq", "model")`` mesh
+contract every step-builder in :mod:`.train`/:mod:`.moe`/:mod:`.zigzag`
+already speaks, so going multi-host changes mesh construction only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+log = logging.getLogger(__name__)
+
+# env vars that make initialize_from_env run distributed init.  The TPU
+# runtime does NOT export a coordinator address itself — on a pod slice
+# jax.distributed.initialize() auto-detects the cluster from TPU metadata
+# once *called*, so the launcher must opt in by setting one of these (or
+# the code must pass require=True).  Detection is deliberately explicit:
+# probing cluster metadata from a no-egress or single-host environment
+# can hang, and silently staying single-process on a pod would train
+# disjoint replicas.
+_TRIGGER_VARS = (
+    "KSAT_DISTRIBUTED",  # this framework's explicit opt-in (any value)
+    "COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+)
+
+
+def initialize_from_env(require: bool = False) -> bool:
+    """``jax.distributed.initialize`` when launched as a multi-process job.
+
+    Returns True when distributed init ran.  Triggers: ``require=True``
+    (the launcher *knows* this is a pod job — preferred), or any of
+    ``KSAT_DISTRIBUTED`` / ``COORDINATOR_ADDRESS`` /
+    ``JAX_COORDINATOR_ADDRESS`` set, or ``JAX_NUM_PROCESSES`` > 1.  The
+    actual coordinator/process-id discovery is ``initialize()``'s own
+    cluster detection (TPU metadata, Slurm, MPI, or the JAX env vars).
+    Idempotent: a second call is a no-op.
+    """
+    already = getattr(initialize_from_env, "_done", False)
+    if already:
+        return True
+    num = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
+    triggered = (
+        require
+        or num > 1
+        or any(os.environ.get(v) for v in _TRIGGER_VARS)
+    )
+    if not triggered:
+        return False
+    jax.distributed.initialize()  # cluster auto-detection happens here
+    initialize_from_env._done = True
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+    return True
+
+
+def make_topology_mesh(
+    model_parallel: int = 1, seq_parallel: int = 1, devices: list | None = None
+) -> Mesh:
+    """A ``("data", "seq", "model")`` mesh ordered by physical topology.
+
+    Same contract as :func:`.train.make_mesh`, but device placement comes
+    from ``mesh_utils.create_device_mesh``, which on TPU assigns mesh
+    coordinates along the ICI torus — neighbor exchanges (ring attention,
+    pipeline hops) become single physical hops instead of whatever the
+    enumeration order happens to give.
+    """
+    from .train import mesh_shape
+
+    devices = devices if devices is not None else jax.devices()
+    shape = mesh_shape(len(devices), model_parallel, seq_parallel)
+    grid = mesh_utils.create_device_mesh(shape, devices)
+    return Mesh(grid, ("data", "seq", "model"))
+
+
+def make_hybrid_mesh(
+    dcn_data_parallel: int,
+    model_parallel: int = 1,
+    seq_parallel: int = 1,
+) -> Mesh:
+    """Multi-slice mesh: ``data`` crosses DCN, ``seq``/``model`` stay on ICI.
+
+    ``dcn_data_parallel`` is the slice count and is deliberately
+    **required** — the launcher knows it, and guessing wrong would lay
+    ICI-assumed axes across the DCN boundary with no error.  Requires a
+    multi-process runtime (call :func:`initialize_from_env` first);
+    ``dcn_data_parallel=1`` (one slice) degenerates to
+    :func:`make_topology_mesh`.
+    """
+    dcn = dcn_data_parallel
+    devices = jax.devices()
+    n = len(devices)
+    if n % (dcn * model_parallel * seq_parallel):
+        raise ValueError(
+            f"{n} devices not divisible by dcn={dcn} x "
+            f"model={model_parallel} x seq={seq_parallel}"
+        )
+    if dcn == 1:
+        return make_topology_mesh(model_parallel, seq_parallel, devices)
+    per_slice_data = n // (dcn * model_parallel * seq_parallel)
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice_data, seq_parallel, model_parallel),
+        dcn_mesh_shape=(dcn, 1, 1),
+        devices=devices,
+    )
+    # hybrid grid axis 0 is dcn*per_slice_data: exactly the "data" axis —
+    # DCN carries only the data-parallel gradient all-reduce
+    return Mesh(grid, ("data", "seq", "model"))

@@ -48,6 +48,24 @@ _LOGICAL_TO_MESH = {
 }
 
 
+def mesh_shape(
+    n_devices: int, model_parallel: int, seq_parallel: int
+) -> tuple[int, int, int]:
+    """Validated ``(data, seq, model)`` axis sizes for ``n_devices`` — the
+    one place the mesh contract's arithmetic lives (shared with
+    :mod:`.distributed`)."""
+    if n_devices % (model_parallel * seq_parallel):
+        raise ValueError(
+            f"{n_devices} devices not divisible by "
+            f"model_parallel={model_parallel} x seq_parallel={seq_parallel}"
+        )
+    return (
+        n_devices // (model_parallel * seq_parallel),
+        seq_parallel,
+        model_parallel,
+    )
+
+
 def make_mesh(
     devices: list | None = None,
     model_parallel: int | None = None,
@@ -60,6 +78,9 @@ def make_mesh(
     bandwidth-friendly default for small models.  ``seq_parallel`` > 1 adds
     sequence/context parallelism: batches shard their sequence axis over
     ``"seq"`` and attention runs as ring attention (:mod:`.ring`).
+    Devices are used in enumeration order; on real hardware prefer
+    :func:`.distributed.make_topology_mesh`, which orders them along the
+    physical ICI torus.
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
@@ -69,15 +90,10 @@ def make_mesh(
             if n % (candidate * seq_parallel) == 0:
                 model_parallel = candidate
                 break
-    if n % (model_parallel * seq_parallel):
-        raise ValueError(
-            f"{n} devices not divisible by model_parallel={model_parallel} "
-            f"x seq_parallel={seq_parallel}"
-        )
     import numpy as np
 
     grid = np.asarray(devices).reshape(
-        n // (model_parallel * seq_parallel), seq_parallel, model_parallel
+        mesh_shape(n, model_parallel, seq_parallel)
     )
     return Mesh(grid, ("data", "seq", "model"))
 
@@ -142,14 +158,40 @@ class TrainConfig:
     # large effective batches without large resident activations.
     grad_accum: int = 1
 
+    # learning-rate schedule: constant by default (reference-free choice);
+    # warmup_steps > 0 adds linear warmup from 0, decay_steps > 0 adds
+    # cosine decay to min_lr_ratio * learning_rate over that many steps —
+    # together the standard warmup-cosine LM recipe.
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    min_lr_ratio: float = 0.1
+
     def __post_init__(self) -> None:
         if self.grad_accum < 1:
             raise ValueError(f"grad_accum={self.grad_accum} must be >= 1")
+        if self.warmup_steps < 0 or self.decay_steps < 0:
+            raise ValueError("warmup_steps/decay_steps must be >= 0")
+
+    def schedule(self):
+        """The optax learning-rate schedule this config describes."""
+        if self.warmup_steps == 0 and self.decay_steps == 0:
+            return self.learning_rate
+        if self.decay_steps == 0:
+            return optax.linear_schedule(
+                0.0, self.learning_rate, self.warmup_steps
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=self.learning_rate,
+            warmup_steps=self.warmup_steps,
+            decay_steps=self.warmup_steps + self.decay_steps,
+            end_value=self.min_lr_ratio * self.learning_rate,
+        )
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     return optax.adamw(
-        config.learning_rate, b1=config.b1, b2=config.b2,
+        config.schedule(), b1=config.b1, b2=config.b2,
         weight_decay=config.weight_decay,
     )
 

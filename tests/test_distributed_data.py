@@ -1,0 +1,164 @@
+"""Multi-host helpers, topology meshes, LR schedules, and the prefetching
+input pipeline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.data import (
+    prefetch_to_mesh,
+    synthetic_token_stream,
+)
+from kube_sqs_autoscaler_tpu.workloads.distributed import (
+    initialize_from_env,
+    make_hybrid_mesh,
+    make_topology_mesh,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+from kube_sqs_autoscaler_tpu.workloads.train import (
+    TrainConfig,
+    batch_sharding,
+    init_train_state,
+    make_train_step,
+    place_state,
+)
+
+TINY = ModelConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32,
+)
+
+
+def test_initialize_from_env_is_noop_single_process(monkeypatch):
+    for var in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                "JAX_NUM_PROCESSES", "KSAT_DISTRIBUTED"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_from_env() is False
+
+
+def test_topology_mesh_runs_the_train_step():
+    mesh = make_topology_mesh(model_parallel=2, seq_parallel=2)
+    assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+    config = TrainConfig(learning_rate=1e-2)
+    state = place_state(mesh, init_train_state(jax.random.key(0), TINY, config))
+    step_fn = make_train_step(mesh, TINY, config, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, TINY.vocab_size,
+                           jnp.int32),
+        batch_sharding(mesh),
+    )
+    state, loss = step_fn(state, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_topology_mesh_validates_divisibility():
+    with pytest.raises(ValueError, match="divisible"):
+        make_topology_mesh(model_parallel=3)
+
+
+def test_hybrid_mesh_single_slice_degenerates_to_topology():
+    mesh = make_hybrid_mesh(dcn_data_parallel=1, model_parallel=2,
+                            seq_parallel=1)
+    assert mesh.shape == {"data": 4, "seq": 1, "model": 2}
+
+
+def test_hybrid_mesh_multi_slice_requires_multiple_processes():
+    # all 8 virtual CPU devices live in one process, so asking for a
+    # 2-slice DCN axis must fail loudly rather than mis-assign
+    with pytest.raises(Exception):
+        make_hybrid_mesh(dcn_data_parallel=2, model_parallel=2,
+                         seq_parallel=1)
+
+
+def test_lr_schedule_warmup_cosine_shape():
+    config = TrainConfig(learning_rate=1e-3, warmup_steps=10, decay_steps=90)
+    sched = config.schedule()
+    assert float(sched(0)) == pytest.approx(0.0)
+    assert float(sched(10)) == pytest.approx(1e-3, rel=1e-6)
+    assert float(sched(100)) == pytest.approx(1e-4, rel=1e-3)
+    # monotone decay after warmup
+    assert float(sched(50)) < float(sched(10))
+    # warmup-only variant ramps then holds
+    warm = TrainConfig(learning_rate=1e-3, warmup_steps=5).schedule()
+    assert float(warm(5)) == pytest.approx(1e-3, rel=1e-6)
+    assert float(warm(50)) == pytest.approx(1e-3, rel=1e-6)
+    # constant variant is a plain float
+    assert TrainConfig(learning_rate=1e-3).schedule() == 1e-3
+
+
+def test_scheduled_train_step_learns():
+    mesh = make_topology_mesh(model_parallel=2, seq_parallel=1)
+    config = TrainConfig(learning_rate=1e-2, warmup_steps=2, decay_steps=20)
+    state = place_state(mesh, init_train_state(jax.random.key(0), TINY, config))
+    step_fn = make_train_step(mesh, TINY, config, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, TINY.vocab_size,
+                           jnp.int32),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(5):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    # step 0 has lr=0 (warmup), so compare later steps
+    assert losses[-1] < losses[1]
+
+
+def test_synthetic_stream_is_deterministic():
+    a = synthetic_token_stream(100, 2, 8, seed=7)
+    b = synthetic_token_stream(100, 2, 8, seed=7)
+    for _ in range(3):
+        np.testing.assert_array_equal(next(a), next(b))
+
+
+@pytest.mark.parametrize("depth", [0, 1, 2])
+def test_prefetch_preserves_order_values_and_sharding(depth):
+    mesh = make_topology_mesh(model_parallel=2, seq_parallel=1)
+    sharding = batch_sharding(mesh)
+    source = [np.full((4, 8), i, dtype=np.int32) for i in range(5)]
+    out = list(prefetch_to_mesh(iter(source), sharding, depth=depth))
+    assert len(out) == 5
+    for i, batch in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(batch), source[i])
+        assert batch.sharding.is_equivalent_to(sharding, batch.ndim)
+
+
+def test_prefetch_runs_ahead_of_consumption():
+    mesh = make_topology_mesh(model_parallel=1, seq_parallel=1)
+    sharding = batch_sharding(mesh)
+    pulled = []
+
+    def source():
+        for i in range(6):
+            pulled.append(i)
+            yield np.full((8, 8), i, dtype=np.int32)
+
+    it = prefetch_to_mesh(source(), sharding, depth=2)
+    first = next(it)
+    # after one yield, the pipeline has pulled the yielded batch plus
+    # depth+1 staged transfers
+    assert len(pulled) >= 3
+    np.testing.assert_array_equal(np.asarray(first), 0)
+    assert sum(1 for _ in it) == 5  # drains cleanly
+
+
+def test_prefetch_validates_depth():
+    mesh = make_topology_mesh(model_parallel=1, seq_parallel=1)
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch_to_mesh(iter([]), batch_sharding(mesh), depth=-1))
+
+
+def test_prefetch_feeds_the_train_step():
+    mesh = make_topology_mesh(model_parallel=2, seq_parallel=2)
+    config = TrainConfig(learning_rate=1e-2)
+    state = place_state(mesh, init_train_state(jax.random.key(0), TINY, config))
+    step_fn = make_train_step(mesh, TINY, config, state)
+    stream = synthetic_token_stream(TINY.vocab_size, 4, 32, seed=3)
+    batches = prefetch_to_mesh(stream, batch_sharding(mesh), depth=2)
+    losses = []
+    for _, tokens in zip(range(4), batches):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
