@@ -1,0 +1,204 @@
+"""Trainer binary: ``python -m kube_sqs_autoscaler_tpu.workloads.trainer``.
+
+The end-to-end training entry point that wires the whole workload layer
+together: multi-host init (:mod:`.distributed`), a topology-aware
+``("data", "seq", "model")`` mesh, the sharded train step with every knob
+(:mod:`.train`: remat, grad accumulation, warmup-cosine schedule;
+:mod:`.zigzag` for balanced long-context), the prefetching input pipeline
+(:mod:`.data`), orbax checkpoint/resume (:mod:`.checkpoint`), and JAX
+device tracing (:mod:`..utils.profiling`).
+
+The built-in data source is the synthetic token stream (deterministic,
+dependency-free — this repo's workload is a *reference* workload, see the
+package docstring); swap ``make_batches`` for a real corpus iterator to
+train on data.  Everything else is production-shaped.
+
+The reference (``/root/reference``) has no trainer — it is a 290-line
+autoscaler (SURVEY.md §7.0); this is part of the TPU workload the
+autoscaler scales.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+from ..utils.logging import configure_logging
+from ..utils.profiling import maybe_trace
+
+log = logging.getLogger("trainer")
+
+
+def _honor_env_platforms() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative.
+
+    Not redundant with JAX's own env handling: a site hook may already
+    have imported jax and overridden platform selection via
+    ``jax.config`` (this image's sitecustomize does exactly that to
+    register a TPU-tunnel plugin), and config beats env once set.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="kube-sqs-autoscaler-trainer")
+    # model (defaults sized for a quick single-chip run)
+    parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--d-model", type=int, default=512)
+    parser.add_argument("--n-heads", type=int, default=8)
+    parser.add_argument("--n-layers", type=int, default=4)
+    parser.add_argument("--d-ff", type=int, default=2048)
+    parser.add_argument("--seq-len", type=int, default=256)
+    # schedule / optimization
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--learning-rate", type=float, default=3e-4)
+    parser.add_argument("--warmup-steps", type=int, default=0)
+    parser.add_argument("--decay-steps", type=int, default=0)
+    parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument("--remat", action="store_true")
+    # parallelism
+    parser.add_argument("--model-parallel", type=int, default=1)
+    parser.add_argument("--seq-parallel", type=int, default=1)
+    parser.add_argument(
+        "--zigzag", action="store_true",
+        help="balanced zig-zag schedule for the seq axis (needs seq-parallel >= 2)",
+    )
+    parser.add_argument(
+        "--topology-mesh", action="store_true",
+        help="order devices along the physical ICI torus (real TPU hardware)",
+    )
+    # ops
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--checkpoint-every", type=int, default=50)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--profile-dir", default="")
+    parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--overfit", action="store_true",
+        help="repeat the first batch every step — the standard smoke test "
+             "that the whole stack can drive the loss toward zero",
+    )
+    return parser
+
+
+def train(args) -> dict:
+    """Run the loop; returns ``{"losses": [...], "final_step": int}``."""
+    import jax
+
+    from .checkpoint import TrainCheckpointer
+    from .data import prefetch_to_mesh, synthetic_token_stream
+    from .distributed import initialize_from_env, make_topology_mesh
+    from .model import ModelConfig, param_count
+    from .train import (
+        TrainConfig,
+        batch_sharding,
+        init_train_state,
+        make_mesh,
+        make_train_step,
+        place_state,
+    )
+
+    initialize_from_env()
+    model_config = ModelConfig(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq_len=args.seq_len,
+    )
+    train_config = TrainConfig(
+        learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
+        decay_steps=args.decay_steps, remat=args.remat,
+        grad_accum=args.grad_accum,
+    )
+    mesh_fn = make_topology_mesh if args.topology_mesh else make_mesh
+    mesh = mesh_fn(model_parallel=args.model_parallel,
+                   seq_parallel=args.seq_parallel)
+    log.info("Mesh: %s over %d devices", dict(mesh.shape), mesh.size)
+
+    state = place_state(
+        mesh, init_train_state(jax.random.key(args.seed), model_config,
+                               train_config)
+    )
+    log.info("Model: %s parameters", f"{param_count(state['params']):,}")
+
+    checkpointer = (
+        TrainCheckpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    )
+    if checkpointer and args.resume:
+        latest = checkpointer.latest_step()
+        if latest is not None:
+            state = checkpointer.restore(mesh, state)
+            log.info("Resumed from checkpoint step %d", latest)
+
+    if args.zigzag:
+        from .zigzag import make_zigzag_train_step
+
+        step_fn = make_zigzag_train_step(mesh, model_config, train_config,
+                                         state)
+    else:
+        step_fn = make_train_step(mesh, model_config, train_config, state)
+
+    losses = []
+    start_step = int(jax.device_get(state["step"]))
+    last_saved = start_step if args.resume else None
+
+    stream = synthetic_token_stream(
+        model_config.vocab_size, args.batch_size, args.seq_len,
+        seed=args.seed,
+    )
+    if args.overfit:
+        import itertools
+
+        stream = itertools.repeat(next(stream))
+    elif start_step:
+        # data parity on resume: skip the batches the checkpointed run
+        # already consumed so 4+4 resumed steps == one 8-step run.  (A
+        # real corpus source should instead checkpoint its own cursor.)
+        for _ in range(start_step):
+            next(stream)
+    batches = prefetch_to_mesh(stream, batch_sharding(mesh))
+
+    log_every = max(1, args.log_every)
+    t0 = time.perf_counter()
+    # --steps bounds the run, so tracing it (when asked) is a bounded trace
+    with maybe_trace(args.profile_dir):
+        for local_step in range(args.steps):
+            tokens = next(batches)
+            state, loss = step_fn(state, tokens)
+            step = start_step + local_step + 1
+            if local_step % log_every == 0 or local_step == args.steps - 1:
+                loss_value = float(loss)  # sync point, only when logging
+                losses.append(loss_value)
+                dt = time.perf_counter() - t0
+                log.info(
+                    "step %d loss %.4f (%.2f steps/s)",
+                    step, loss_value, (local_step + 1) / dt,
+                )
+            # checkpoint-every 0 = only the final save below
+            if (checkpointer and args.checkpoint_every > 0
+                    and step % args.checkpoint_every == 0):
+                checkpointer.save(state)
+                last_saved = step
+                log.info("Checkpointed step %d", step)
+    final_step = int(jax.device_get(state["step"]))
+    if checkpointer and last_saved != final_step:
+        checkpointer.save(state)
+    return {"losses": losses, "final_step": final_step}
+
+
+def main(argv=None) -> dict:
+    configure_logging()
+    args = build_parser().parse_args(argv)  # --help exits before jax loads
+    _honor_env_platforms()
+    return train(args)
+
+
+if __name__ == "__main__":
+    main()
