@@ -1,0 +1,455 @@
+"""A second model family: Llama-style decoder (RoPE, GQA, RMSNorm, SwiGLU).
+
+The reference has no model code at all (SURVEY.md §2); the package's first
+workload (:mod:`.model`) is a GPT-2-shaped transformer (learned positions,
+MHA, LayerNorm, GELU).  This module adds the architecture modern open
+models actually ship — rotary position embeddings, grouped-query
+attention, RMSNorm, and a SwiGLU MLP — as a *separate family* with the
+same integration seams, so everything else (train step via
+:func:`.train.make_train_step`'s ``loss`` seam, PARAM_AXES-driven
+sharding, checkpointing, the serving worker) applies unchanged.
+
+TPU-first notes:
+
+- **GQA = smaller KV cache**: the cache stores ``n_kv_heads`` heads
+  (``[B, H_kv, S, D]``); query heads share them in groups.  Decode is
+  HBM-bandwidth-bound, so an 8x head reduction is ~8x less cache traffic.
+  K/V are broadcast to full heads only inside the attention compute
+  (XLA fuses the broadcast into the matmul).
+- **RoPE in fp32**: rotation angles and the rotation itself run in fp32
+  (bf16 angles visibly degrade long-context quality), output cast back.
+- **RMSNorm/SwiGLU**: fp32 statistics like the sibling model's LayerNorm;
+  gate/up projections fused into one matmul (``w_gate_up``) for one MXU
+  pass, split on the output axis — output-axis sharding stays
+  tensor-parallel via PARAM_AXES ``("model", "ff2")``.
+
+Sharding: query heads shard over ``"model"`` like the sibling model; K/V
+projections shard over ``"model"`` too, which requires
+``n_kv_heads % tensor_parallel == 0`` (checked at mesh placement time by
+the divisibility of the array dimension itself).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .model import _dense_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """Llama-family dimensions (defaults sized for quick runs)."""
+
+    vocab_size: int = 8192
+    d_model: int = 512
+    n_heads: int = 8
+    n_kv_heads: int = 2  # GQA: query heads share n_heads//n_kv_heads groups
+    n_layers: int = 4
+    d_ff: int = 1408  # SwiGLU convention: ~2/3 * 4 * d_model, 128-aligned
+    max_seq_len: int = 1024
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self) -> None:
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads={self.n_heads} must be divisible by "
+                f"n_kv_heads={self.n_kv_heads}"
+            )
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model={self.d_model} must be divisible by "
+                f"n_heads={self.n_heads}"
+            )
+
+
+# sharding rules for this family's parameter names live in
+# model.PARAM_AXES (the one static registry, like the MoE entries) so
+# placement never depends on whether this module was imported
+
+
+def init_llama_params(rng: jax.Array, config: LlamaConfig) -> dict:
+    """Parameter pytree (scaled-normal init, bf16 storage, fp32 norms)."""
+    dtype = config.dtype
+    head_dim = config.head_dim
+    kv_dim = config.n_kv_heads * head_dim
+    keys = jax.random.split(rng, 1 + config.n_layers)
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    params = {
+        "embed": normal(keys[0], (config.vocab_size, config.d_model), 0.02),
+        "final_norm": jnp.ones((config.d_model,), dtype),
+        "layers": [],
+    }
+    out_scale = 0.02 / (2 * config.n_layers) ** 0.5
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[1 + i], 4)
+        params["layers"].append(
+            {
+                "attn_norm": jnp.ones((config.d_model,), dtype),
+                "wq": normal(lk[0], (config.d_model, config.d_model), 0.02),
+                "wkv": normal(lk[1], (config.d_model, 2 * kv_dim), 0.02),
+                "wo": normal(lk[2], (config.d_model, config.d_model), out_scale),
+                "mlp_norm": jnp.ones((config.d_model,), dtype),
+                "w_gate_up": normal(
+                    lk[3], (config.d_model, 2 * config.d_ff), 0.02
+                ),
+                "w_down": normal(
+                    jax.random.fold_in(lk[3], 1),
+                    (config.d_ff, config.d_model), out_scale,
+                ),
+            }
+        )
+    return params
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """fp32 statistics, model-dtype output (no mean subtraction, no bias)."""
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6
+    )
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_angles(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables ``[*positions.shape, head_dim/2]`` in fp32."""
+    freqs = theta ** (
+        -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float
+) -> jax.Array:
+    """Rotate ``[B, H, S, D]`` by per-position angles (fp32 rotation).
+
+    ``positions``: int32 ``[S]`` (broadcast over batch/heads).  Pairs
+    ``(x[2i], x[2i+1])`` rotate by ``pos * theta^(-2i/D)``.
+    """
+    cos, sin = rope_angles(positions, x.shape[-1], theta)  # [S, D/2]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return (
+        jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+    )
+
+
+def _split_heads(t: jax.Array, n_heads: int, head_dim: int) -> jax.Array:
+    batch, seq, _ = t.shape
+    return t.reshape(batch, seq, n_heads, head_dim).transpose(0, 2, 1, 3)
+
+
+def repeat_kv(t: jax.Array, groups: int) -> jax.Array:
+    """``[B, H_kv, S, D] -> [B, H_kv*groups, S, D]`` (GQA broadcast).
+
+    Done just before the attention matmuls; XLA fuses the broadcast, so
+    the full-head K/V never lives in HBM.
+    """
+    if groups == 1:
+        return t
+    batch, kv_heads, seq, dim = t.shape
+    return jnp.broadcast_to(
+        t[:, :, None], (batch, kv_heads, groups, seq, dim)
+    ).reshape(batch, kv_heads * groups, seq, dim)
+
+
+def _project_qkv(
+    h: jax.Array, layer: dict, config: LlamaConfig, positions: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """q (full heads, rotated), k (kv heads, rotated), v (kv heads)."""
+    head_dim = config.head_dim
+    q = _split_heads(h @ layer["wq"], config.n_heads, head_dim)
+    kv = h @ layer["wkv"]
+    k, v = jnp.split(kv, 2, axis=-1)
+    k = _split_heads(k, config.n_kv_heads, head_dim)
+    v = _split_heads(v, config.n_kv_heads, head_dim)
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+    return q, k, v
+
+
+def _swiglu(x: jax.Array, layer: dict) -> jax.Array:
+    gate, up = jnp.split(x @ layer["w_gate_up"], 2, axis=-1)
+    return (jax.nn.silu(gate) * up) @ layer["w_down"]
+
+
+def _llama_block(
+    x: jax.Array,
+    layer: dict,
+    config: LlamaConfig,
+    positions: jax.Array,
+    attend,
+) -> jax.Array:
+    """Pre-RMSNorm attention + pre-RMSNorm SwiGLU, residual both.
+
+    ``attend(q, k, v) -> [B, H, S, D]`` receives GQA-shaped k/v
+    (``H_kv`` heads); the default broadcasts to full heads and runs the
+    shared dense causal kernel.  The single source of truth for the
+    family's wiring — training forward, prefill, and decode all run it.
+    """
+    h = _rms_norm(x, layer["attn_norm"])
+    q, k, v = _project_qkv(h, layer, config, positions)
+    out = attend(q, k, v)
+    batch, _, seq, _ = out.shape
+    out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
+    x = x + out @ layer["wo"]
+    return x + _swiglu(_rms_norm(x, layer["mlp_norm"]), layer)
+
+
+def _gqa_dense_attention(config: LlamaConfig):
+    groups = config.n_heads // config.n_kv_heads
+
+    def attend(q, k, v):
+        return _dense_attention(q, repeat_kv(k, groups), repeat_kv(v, groups))
+
+    return attend
+
+
+def llama_forward(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    attention_fn=None,
+    positions: jax.Array | None = None,
+    remat: bool = False,
+) -> jax.Array:
+    """Logits ``[B, S, vocab]`` (fp32, tied-embedding readout).
+
+    ``attention_fn(q, k, v)`` sees GQA-shaped k/v; use
+    :func:`repeat_kv` when plugging in an MHA kernel.  ``positions``
+    overrides the RoPE positions (decode passes the cache offset).
+    ``remat=True`` checkpoints each block like :func:`.model.forward`.
+    """
+    seq = tokens.shape[1]
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    if positions is None:
+        positions = jnp.arange(seq)
+    attend = attention_fn or _gqa_dense_attention(config)
+    block = _llama_block
+    if remat:
+        # config/attend are static; positions is a traced array argument
+        block = jax.checkpoint(_llama_block, static_argnums=(2, 4))
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = block(x, layer, config, positions, attend)
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+
+
+def llama_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    attention_fn=None,
+    remat: bool = False,
+) -> jax.Array:
+    from .train import next_token_nll
+
+    return next_token_nll(
+        llama_forward(params, tokens, config, attention_fn, remat=remat),
+        tokens,
+    )
+
+
+def init_llama_train_state(
+    rng: jax.Array, config: LlamaConfig, train_config
+) -> dict:
+    from .train import init_train_state
+
+    return init_train_state(
+        rng, config, train_config, init_fn=init_llama_params
+    )
+
+
+def make_llama_train_step(mesh, config: LlamaConfig, train_config,
+                          state: dict):
+    """dp x tp train step via :func:`.train.make_train_step`'s loss seam.
+
+    The seam's ring attention_fn is discarded: GQA-shaped k/v need the
+    family's own attention.  Sequence parallelism for this family is a
+    follow-up, so a mesh with a nontrivial ``seq`` axis is rejected
+    (dense attention would silently all-gather the sequence otherwise).
+    """
+    from .train import make_train_step
+
+    if mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "llama train step uses a (data, model) mesh; got seq="
+            f"{mesh.shape['seq']} (sequence parallelism for the GQA family "
+            "is not implemented yet)"
+        )
+
+    def loss(params, tokens, attention_fn=None):
+        return llama_loss_fn(params, tokens, config,
+                             remat=train_config.remat)
+
+    return make_train_step(mesh, config, train_config, state, loss=loss)
+
+
+# ---------------------------------------------------------------------------
+# GQA KV-cache decoding
+# ---------------------------------------------------------------------------
+
+
+def init_llama_cache(config: LlamaConfig, batch: int) -> dict:
+    """KV cache with only ``n_kv_heads`` heads: the GQA memory win."""
+    shape = (batch, config.n_kv_heads, config.max_seq_len, config.head_dim)
+    return {
+        "layers": [
+            {"k": jnp.zeros(shape, config.dtype),
+             "v": jnp.zeros(shape, config.dtype)}
+            for _ in range(config.n_layers)
+        ],
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _final_logits(params: dict, x: jax.Array) -> jax.Array:
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )[:, -1]
+
+
+def llama_prefill(
+    params: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """Prompt pass populating a fresh GQA cache (same contract as
+    :func:`.decode.prefill`)."""
+    batch, prompt_len = tokens.shape
+    if prompt_len > config.max_seq_len:
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len={config.max_seq_len}"
+        )
+    cache = init_llama_cache(config, batch)
+    groups = config.n_heads // config.n_kv_heads
+    new_layers = []
+
+    def attend(q, k, v):
+        # k/v arrive GQA-shaped [B, H_kv, S, D]: capture into the cache,
+        # then broadcast for the causal prompt pass
+        new_layers.append(
+            {
+                "k": cache["layers"][len(new_layers)]["k"]
+                .at[:, :, :prompt_len].set(k.astype(config.dtype)),
+                "v": cache["layers"][len(new_layers)]["v"]
+                .at[:, :, :prompt_len].set(v.astype(config.dtype)),
+            }
+        )
+        return _dense_attention(q, repeat_kv(k, groups), repeat_kv(v, groups))
+
+    logits = llama_forward(params, tokens, config, attention_fn=attend)
+    return (
+        logits[:, -1] if logits.ndim == 3 else logits,
+        {"layers": new_layers, "length": jnp.asarray(prompt_len, jnp.int32)},
+    )
+
+
+def llama_decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """One token (int32 ``[batch]``) against the GQA cache; same contract
+    as :func:`.decode.decode_step` (reuses its masked cached-attention
+    math via :func:`.decode._cached_attention`)."""
+    from .decode import _cached_attention
+
+    pos = cache["length"]
+    groups = config.n_heads // config.n_kv_heads
+    positions = pos[None]  # RoPE rotates by the absolute position
+    x = params["embed"][tokens][:, None, :]
+    new_layers = []
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            k_cache = jax.lax.dynamic_update_slice(
+                _lc["k"], k.astype(config.dtype), (0, 0, pos, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                _lc["v"], v.astype(config.dtype), (0, 0, pos, 0)
+            )
+            new_layers.append({"k": k_cache, "v": v_cache})
+            return _cached_attention(
+                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups), pos
+            )
+
+        x = _llama_block(x, layer, config, positions, attend)
+    return _final_logits(params, x), {"layers": new_layers, "length": pos + 1}
+
+
+def llama_generate(
+    params: dict,
+    prompt: jax.Array,
+    num_tokens: int,
+    config: LlamaConfig,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy/temperature generation, one compiled program (same contract
+    and scan structure as :func:`.decode.generate`)."""
+    from .decode import _pick
+
+    batch, prompt_len = prompt.shape
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    if prompt_len + num_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
+            f"max_seq_len={config.max_seq_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling requires an rng key")
+    keys = (
+        jax.random.split(rng, num_tokens)
+        if rng is not None
+        else jnp.zeros((num_tokens, 2), jnp.uint32)
+    )
+    logits, cache = llama_prefill(params, prompt, config)
+    first = _pick(logits, keys[0], temperature)
+
+    def body(carry, key):
+        cache, token = carry
+        logits, cache = llama_decode_step(params, cache, token, config)
+        nxt = _pick(logits, key, temperature)
+        return (cache, nxt), token
+
+    (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
+    produced = jnp.moveaxis(produced, 0, 1)
+    return jnp.concatenate([produced, last[:, None]], axis=1)
+
+
+@partial(jax.jit, static_argnames=("num_tokens", "config", "temperature"))
+def llama_generate_jit(
+    params: dict,
+    prompt: jax.Array,
+    num_tokens: int,
+    config: LlamaConfig,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    return llama_generate(
+        params, prompt, num_tokens, config, temperature=temperature, rng=rng
+    )

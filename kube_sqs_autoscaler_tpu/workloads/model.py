@@ -71,6 +71,14 @@ PARAM_AXES = {
     "router": ("model", "experts_out"),
     "w_up_experts": ("expert", "model", "ff"),
     "w_down_experts": ("expert", "ff", "model"),
+    # llama family (workloads.llama): fused kv / gate-up projections shard
+    # their output axis tensor-parallel; RMSNorm scales replicate
+    "attn_norm": ("model",),
+    "mlp_norm": ("model",),
+    "final_norm": ("model",),
+    "wq": ("model", "heads"),
+    "wkv": ("model", "kv_heads"),
+    "w_gate_up": ("model", "ff2"),
 }
 
 

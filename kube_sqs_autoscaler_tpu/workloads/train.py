@@ -45,6 +45,10 @@ _LOGICAL_TO_MESH = {
     "seq": None,
     "expert": "data",  # expert parallelism rides the data axis (ep=dp)
     "experts_out": None,  # router output axis (n_experts) replicates
+    # llama family (workloads.llama): fused kv / gate-up projections shard
+    # their output axis tensor-parallel like the query/ff projections
+    "kv_heads": "model",
+    "ff2": "model",
 }
 
 

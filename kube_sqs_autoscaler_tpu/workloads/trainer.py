@@ -131,11 +131,19 @@ def train(args) -> dict:
     checkpointer = (
         TrainCheckpointer(args.checkpoint_dir) if args.checkpoint_dir else None
     )
-    if checkpointer and args.resume:
+    if checkpointer:
         latest = checkpointer.latest_step()
-        if latest is not None:
+        if args.resume and latest is not None:
             state = checkpointer.restore(mesh, state)
             log.info("Resumed from checkpoint step %d", latest)
+        elif latest is not None:
+            # fail fast: orbax refuses to overwrite an existing step, so
+            # without --resume this run would crash at its first save —
+            # after training for checkpoint_every steps
+            raise SystemExit(
+                f"checkpoint dir {args.checkpoint_dir} already has step "
+                f"{latest}; pass --resume to continue it or use a fresh dir"
+            )
 
     if args.zigzag:
         from .zigzag import make_zigzag_train_step

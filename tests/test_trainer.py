@@ -41,6 +41,10 @@ def test_trainer_checkpoints_and_resumes(tmp_path):
                                str(tmp_path / "other")])
     assert fresh["final_step"] == 2
 
+    # dirty dir without --resume fails fast, before any training
+    with pytest.raises(SystemExit, match="--resume"):
+        main(TINY_FLAGS + ["--steps", "2", "--checkpoint-dir", ckpt])
+
 
 def test_trainer_zigzag_remat_accum_flags():
     result = main(
