@@ -37,6 +37,16 @@ def main(argv=None) -> None:
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=64)
     parser.add_argument(
+        "--generate-tokens", type=int, default=0, metavar="N",
+        help="decode N continuation tokens per message (KV-cache generate "
+             "mode) instead of one classify forward",
+    )
+    parser.add_argument(
+        "--family", choices=("gpt", "llama"), default="gpt",
+        help="model family served: gpt (learned pos/MHA) or llama "
+             "(RoPE/GQA — n_kv_heads-sized KV cache)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -47,14 +57,36 @@ def main(argv=None) -> None:
     from .model import ModelConfig, init_params
     from .service import QueueWorker, ServiceConfig
 
-    model_config = ModelConfig(
-        vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
-        max_seq_len=max(64, args.seq_len),
-    )
-    params = init_params(jax.random.key(0), model_config)
+    worker_kwargs = {}
+    if args.family == "llama":
+        from .llama import (
+            LlamaConfig,
+            init_llama_params,
+            llama_forward_jit,
+            llama_generate_jit,
+        )
+
+        model_config = LlamaConfig(
+            vocab_size=8192, d_model=512, n_heads=8, n_kv_heads=2,
+            n_layers=4, d_ff=1408,
+            max_seq_len=max(64, args.seq_len + args.generate_tokens),
+        )
+        params = init_llama_params(jax.random.key(0), model_config)
+        worker_kwargs = {
+            "forward_fn": lambda p, t: llama_forward_jit(p, t, model_config),
+            "generate_fn": lambda p, t, n: llama_generate_jit(
+                p, t, n, model_config
+            ),
+        }
+    else:
+        model_config = ModelConfig(
+            vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
+            max_seq_len=max(64, args.seq_len + args.generate_tokens),
+        )
+        params = init_params(jax.random.key(0), model_config)
     service_config = ServiceConfig(
         queue_url=args.sqs_queue_url, batch_size=args.batch_size,
-        seq_len=args.seq_len,
+        seq_len=args.seq_len, generate_tokens=args.generate_tokens,
     )
 
     if args.demo:
@@ -68,7 +100,8 @@ def main(argv=None) -> None:
             ids = rng.integers(0, model_config.vocab_size, args.seq_len).tolist()
             queue.send_message("demo://queue", json.dumps(ids))
         service_config.queue_url = "demo://queue"
-        worker = QueueWorker(queue, params, model_config, service_config)
+        worker = QueueWorker(queue, params, model_config, service_config,
+                             **worker_kwargs)
         start = time.perf_counter()
         while worker.processed < args.demo:
             worker.run_once()
@@ -82,7 +115,8 @@ def main(argv=None) -> None:
     from ..metrics.sqs_aws import AwsSqsService
 
     queue = AwsSqsService(region=args.aws_region)
-    worker = QueueWorker(queue, params, model_config, service_config)
+    worker = QueueWorker(queue, params, model_config, service_config,
+                         **worker_kwargs)
     log.info("Starting worker on %s", args.sqs_queue_url)
     worker.run_forever()
 

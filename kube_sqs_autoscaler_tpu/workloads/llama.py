@@ -441,6 +441,14 @@ def llama_generate(
     return jnp.concatenate([produced, last[:, None]], axis=1)
 
 
+@partial(jax.jit, static_argnums=2)
+def llama_forward_jit(
+    params: dict, tokens: jax.Array, config: LlamaConfig
+) -> jax.Array:
+    """Single-chip jitted forward (the serving worker's classify path)."""
+    return llama_forward(params, tokens, config)
+
+
 @partial(jax.jit, static_argnames=("num_tokens", "config", "temperature"))
 def llama_generate_jit(
     params: dict,

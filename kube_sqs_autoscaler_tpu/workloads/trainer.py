@@ -49,9 +49,16 @@ def _honor_env_platforms() -> None:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="kube-sqs-autoscaler-trainer")
     # model (defaults sized for a quick single-chip run)
+    parser.add_argument(
+        "--family", choices=("gpt", "llama"), default="gpt",
+        help="gpt: learned positions/MHA/LayerNorm/GELU; "
+             "llama: RoPE/GQA/RMSNorm/SwiGLU",
+    )
     parser.add_argument("--vocab-size", type=int, default=8192)
     parser.add_argument("--d-model", type=int, default=512)
     parser.add_argument("--n-heads", type=int, default=8)
+    parser.add_argument("--n-kv-heads", type=int, default=2,
+                        help="llama family only: GQA KV head count")
     parser.add_argument("--n-layers", type=int, default=4)
     parser.add_argument("--d-ff", type=int, default=2048)
     parser.add_argument("--seq-len", type=int, default=256)
@@ -107,11 +114,6 @@ def train(args) -> dict:
     )
 
     initialize_from_env()
-    model_config = ModelConfig(
-        vocab_size=args.vocab_size, d_model=args.d_model,
-        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
-        max_seq_len=args.seq_len,
-    )
     train_config = TrainConfig(
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps, remat=args.remat,
@@ -122,10 +124,40 @@ def train(args) -> dict:
                    seq_parallel=args.seq_parallel)
     log.info("Mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
-    state = place_state(
-        mesh, init_train_state(jax.random.key(args.seed), model_config,
-                               train_config)
-    )
+    if args.family == "llama":
+        from .llama import (
+            LlamaConfig,
+            init_llama_train_state,
+            make_llama_train_step,
+        )
+
+        if args.seq_parallel != 1 or args.zigzag:
+            raise SystemExit(
+                "--family llama does not support --seq-parallel/--zigzag "
+                "yet (sequence parallelism for the GQA family is a "
+                "follow-up)"
+            )
+        model_config = LlamaConfig(
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq_len=args.seq_len,
+        )
+        state = place_state(
+            mesh,
+            init_llama_train_state(jax.random.key(args.seed), model_config,
+                                   train_config),
+        )
+    else:
+        model_config = ModelConfig(
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq_len=args.seq_len,
+        )
+        state = place_state(
+            mesh, init_train_state(jax.random.key(args.seed), model_config,
+                                   train_config)
+        )
     log.info("Model: %s parameters", f"{param_count(state['params']):,}")
 
     checkpointer = (
@@ -145,7 +177,10 @@ def train(args) -> dict:
                 f"{latest}; pass --resume to continue it or use a fresh dir"
             )
 
-    if args.zigzag:
+    if args.family == "llama":
+        step_fn = make_llama_train_step(mesh, model_config, train_config,
+                                        state)
+    elif args.zigzag:
         from .zigzag import make_zigzag_train_step
 
         step_fn = make_zigzag_train_step(mesh, model_config, train_config,

@@ -56,6 +56,21 @@ def test_trainer_zigzag_remat_accum_flags():
     assert all(np.isfinite(result["losses"]))
 
 
+def test_trainer_llama_family_learns():
+    result = main(TINY_FLAGS + ["--steps", "5", "--family", "llama",
+                                "--n-kv-heads", "2", "--model-parallel", "2",
+                                "--overfit", "--remat"])
+    assert result["final_step"] == 5
+    assert all(np.isfinite(result["losses"]))
+    assert result["losses"][-1] < result["losses"][0]
+
+
+def test_trainer_llama_rejects_seq_parallel():
+    with pytest.raises(SystemExit, match="llama"):
+        main(TINY_FLAGS + ["--steps", "1", "--family", "llama",
+                           "--seq-parallel", "2"])
+
+
 def test_trainer_profile_writes_trace(tmp_path):
     result = main(TINY_FLAGS + ["--steps", "2",
                                 "--profile-dir", str(tmp_path)])
