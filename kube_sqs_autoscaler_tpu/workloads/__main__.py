@@ -62,7 +62,8 @@ def main(argv=None) -> None:
         from .llama import (
             LlamaConfig,
             init_llama_params,
-            llama_forward_jit,
+            llama_attention_fn_for,
+            llama_forward_jit_with,
             llama_generate_jit,
         )
 
@@ -72,10 +73,18 @@ def main(argv=None) -> None:
             max_seq_len=max(64, args.seq_len + args.generate_tokens),
         )
         params = init_llama_params(jax.random.key(0), model_config)
+        # flash kernel on TPU when seq_len tiles onto the MXU blocks —
+        # for both the classify forward and the generate-mode prefill
+        from .flash import attention_fn_for
+
+        attend = llama_attention_fn_for(model_config, args.seq_len)
+        prompt_attention = attention_fn_for(args.seq_len)
         worker_kwargs = {
-            "forward_fn": lambda p, t: llama_forward_jit(p, t, model_config),
+            "forward_fn": lambda p, t: llama_forward_jit_with(
+                p, t, model_config, attend
+            ),
             "generate_fn": lambda p, t, n: llama_generate_jit(
-                p, t, n, model_config
+                p, t, n, model_config, prompt_attention=prompt_attention
             ),
         }
     else:

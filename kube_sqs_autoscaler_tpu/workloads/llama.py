@@ -214,13 +214,36 @@ def _llama_block(
     return x + _swiglu(_rms_norm(x, layer["mlp_norm"]), layer)
 
 
-def _gqa_dense_attention(config: LlamaConfig):
+def _gqa_wrap(config: LlamaConfig, inner):
+    """Adapt an MHA-shaped attention kernel (dense, flash) to GQA inputs:
+    broadcast k/v to full heads just before the kernel.  The one place
+    the broadcast policy lives."""
     groups = config.n_heads // config.n_kv_heads
 
     def attend(q, k, v):
-        return _dense_attention(q, repeat_kv(k, groups), repeat_kv(v, groups))
+        return inner(q, repeat_kv(k, groups), repeat_kv(v, groups))
 
     return attend
+
+
+def _gqa_dense_attention(config: LlamaConfig):
+    return _gqa_wrap(config, _dense_attention)
+
+
+def llama_attention_fn_for(
+    config: LlamaConfig, seq_len: int, *, backend: str | None = None
+):
+    """GQA-aware attention selection for a static prompt length.
+
+    Same policy as :func:`.flash.attention_fn_for` (Pallas flash kernel
+    on TPU when the shape tiles onto the MXU blocks, dense XLA path
+    elsewhere); K/V broadcast from ``n_kv_heads`` to full heads just
+    before the kernel, which is MHA-shaped.  Plug into
+    :func:`llama_forward`/:func:`llama_forward_jit_with`.
+    """
+    from .flash import attention_fn_for
+
+    return _gqa_wrap(config, attention_fn_for(seq_len, backend=backend))
 
 
 def llama_forward(
@@ -335,22 +358,28 @@ def _final_logits(params: dict, x: jax.Array) -> jax.Array:
 
 
 def llama_prefill(
-    params: dict, tokens: jax.Array, config: LlamaConfig
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    prompt_attention=None,
 ) -> tuple[jax.Array, dict]:
     """Prompt pass populating a fresh GQA cache (same contract as
-    :func:`.decode.prefill`)."""
+    :func:`.decode.prefill`).  ``prompt_attention`` is an MHA-shaped
+    causal kernel for the prompt pass (dense default; pass
+    :func:`.flash.attention_fn_for`'s pick on TPU).
+    """
     batch, prompt_len = tokens.shape
     if prompt_len > config.max_seq_len:
         raise ValueError(
             f"prompt length {prompt_len} exceeds max_seq_len={config.max_seq_len}"
         )
     cache = init_llama_cache(config, batch)
-    groups = config.n_heads // config.n_kv_heads
+    inner = _gqa_wrap(config, prompt_attention or _dense_attention)
     new_layers = []
 
     def attend(q, k, v):
         # k/v arrive GQA-shaped [B, H_kv, S, D]: capture into the cache,
-        # then broadcast for the causal prompt pass
+        # then run the (broadcast-wrapped) causal prompt kernel
         new_layers.append(
             {
                 "k": cache["layers"][len(new_layers)]["k"]
@@ -359,7 +388,7 @@ def llama_prefill(
                 .at[:, :, :prompt_len].set(v.astype(config.dtype)),
             }
         )
-        return _dense_attention(q, repeat_kv(k, groups), repeat_kv(v, groups))
+        return inner(q, k, v)
 
     logits = llama_forward(params, tokens, config, attention_fn=attend)
     return (
@@ -407,9 +436,11 @@ def llama_generate(
     *,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    prompt_attention=None,
 ) -> jax.Array:
     """Greedy/temperature generation, one compiled program (same contract
-    and scan structure as :func:`.decode.generate`)."""
+    and scan structure as :func:`.decode.generate`).  ``prompt_attention``
+    selects the prefill kernel (see :func:`llama_prefill`)."""
     from .decode import _pick
 
     batch, prompt_len = prompt.shape
@@ -427,7 +458,7 @@ def llama_generate(
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    logits, cache = llama_prefill(params, prompt, config)
+    logits, cache = llama_prefill(params, prompt, config, prompt_attention)
     first = _pick(logits, keys[0], temperature)
 
     def body(carry, key):
@@ -449,7 +480,20 @@ def llama_forward_jit(
     return llama_forward(params, tokens, config)
 
 
-@partial(jax.jit, static_argnames=("num_tokens", "config", "temperature"))
+@partial(jax.jit, static_argnums=(2, 3))
+def llama_forward_jit_with(
+    params: dict, tokens: jax.Array, config: LlamaConfig, attention_fn
+) -> jax.Array:
+    """Jitted forward with a chosen attention implementation (e.g. the
+    flash-backed :func:`llama_attention_fn_for` result); static so each
+    implementation gets its own compiled program."""
+    return llama_forward(params, tokens, config, attention_fn)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_tokens", "config", "temperature", "prompt_attention"),
+)
 def llama_generate_jit(
     params: dict,
     prompt: jax.Array,
@@ -457,7 +501,9 @@ def llama_generate_jit(
     config: LlamaConfig,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    prompt_attention=None,
 ) -> jax.Array:
     return llama_generate(
-        params, prompt, num_tokens, config, temperature=temperature, rng=rng
+        params, prompt, num_tokens, config, temperature=temperature, rng=rng,
+        prompt_attention=prompt_attention,
     )

@@ -60,7 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--n-kv-heads", type=int, default=2,
                         help="llama family only: GQA KV head count")
     parser.add_argument("--n-layers", type=int, default=4)
-    parser.add_argument("--d-ff", type=int, default=2048)
+    parser.add_argument(
+        "--d-ff", type=int, default=None,
+        help="default: 2048 (gpt GELU), 1408 (llama SwiGLU convention, "
+             "matching the serving binary)",
+    )
     parser.add_argument("--seq-len", type=int, default=256)
     # schedule / optimization
     parser.add_argument("--steps", type=int, default=100)
@@ -124,6 +128,12 @@ def train(args) -> dict:
                    seq_parallel=args.seq_parallel)
     log.info("Mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
+    # per-family d_ff default: llama's SwiGLU convention differs from the
+    # gpt GELU MLP, and must match the serving binary's LlamaConfig
+    d_ff = args.d_ff if args.d_ff is not None else (
+        1408 if args.family == "llama" else 2048
+    )
+
     if args.family == "llama":
         from .llama import (
             LlamaConfig,
@@ -140,7 +150,7 @@ def train(args) -> dict:
         model_config = LlamaConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
             n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
-            n_layers=args.n_layers, d_ff=args.d_ff,
+            n_layers=args.n_layers, d_ff=d_ff,
             max_seq_len=args.seq_len,
         )
         state = place_state(
@@ -151,7 +161,7 @@ def train(args) -> dict:
     else:
         model_config = ModelConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
-            n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+            n_heads=args.n_heads, n_layers=args.n_layers, d_ff=d_ff,
             max_seq_len=args.seq_len,
         )
         state = place_state(

@@ -167,6 +167,31 @@ def test_generate_greedy_matches_manual_argmax_rollout():
     )
 
 
+def test_llama_attention_fn_for_selects_and_matches_dense():
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        llama_attention_fn_for,
+        llama_forward_jit_with,
+    )
+
+    # off TPU (this suite) the selection must be dense-backed and the
+    # forward must equal the default path exactly
+    params = init_llama_params(jax.random.key(0), TINY)
+    tokens = tokens_batch()
+    attend = llama_attention_fn_for(TINY, tokens.shape[1])
+    np.testing.assert_allclose(
+        np.asarray(llama_forward_jit_with(params, tokens, TINY, attend)),
+        np.asarray(llama_forward(params, tokens, TINY)),
+        rtol=1e-3, atol=1e-5,  # jit fusion reorders fp ops slightly
+    )
+    # on TPU with a tiling seq_len the flash kernel is selected
+    from kube_sqs_autoscaler_tpu.workloads import flash
+
+    tpu_attend = llama_attention_fn_for(TINY, 256, backend="tpu")
+    assert tpu_attend.__closure__ is not None  # wraps the flash kernel
+    closed_over = [c.cell_contents for c in tpu_attend.__closure__]
+    assert flash.flash_attention in closed_over
+
+
 def test_loss_is_finite_and_loss_fn_composes():
     params = init_llama_params(jax.random.key(0), TINY)
     loss = float(llama_loss_fn(params, tokens_batch(), TINY))

@@ -204,6 +204,99 @@ def test_close_releases_blocked_long_poller_and_guards_reuse():
     q.close()  # idempotent
 
 
+def test_full_story_on_native_broker_with_llama_workers():
+    """The whole system against the NATIVE C++ broker, serving the llama
+    family: burst -> depth crosses threshold -> autoscaler raises
+    replicas -> elastic pool adds workers -> queue drains -> scale-down
+    -> pool shrinks.  (The fake-queue twin lives in test_service.py.)"""
+    import json
+    import time
+
+    import jax
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+    from kube_sqs_autoscaler_tpu.scale.actuator import PodAutoScaler
+    from kube_sqs_autoscaler_tpu.scale.fake import FakeDeploymentAPI
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_forward_jit,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ElasticWorkerPool,
+        QueueWorker,
+        ServiceConfig,
+    )
+
+    tiny = LlamaConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=256, max_seq_len=64,
+    )
+    queue = LocalQueue(visibility_timeout=60.0)  # real clock, real blocking
+    rng = np.random.default_rng(0)
+    for _ in range(120):
+        queue.send_message(
+            body=json.dumps(rng.integers(0, tiny.vocab_size, 16).tolist())
+        )
+
+    api = FakeDeploymentAPI.with_deployments("ns", 1, "workers")
+    loop = ControlLoop(
+        PodAutoScaler(client=api, max=4, min=1, scale_up_pods=1,
+                      scale_down_pods=1, deployment="workers",
+                      namespace="ns"),
+        QueueMetricSource(client=queue, queue_url="local://jobs"),
+        LoopConfig(
+            poll_interval=0.05,
+            policy=PolicyConfig(scale_up_messages=20, scale_down_messages=0,
+                                scale_up_cooldown=0.1,
+                                scale_down_cooldown=0.1),
+        ),
+    )
+    loop_thread = threading.Thread(target=loop.run, daemon=True)
+
+    params = init_llama_params(jax.random.key(0), tiny)
+
+    def throttled_forward(p, t):
+        time.sleep(0.02)  # keep the drain slower than startup grace
+        return llama_forward_jit(p, t, tiny)
+
+    pool = ElasticWorkerPool(
+        api, "workers",
+        worker_factory=lambda: QueueWorker(
+            queue, params, tiny,
+            ServiceConfig(queue_url="local://jobs", batch_size=4, seq_len=16,
+                          idle_sleep_s=0.01),
+            forward_fn=throttled_forward,
+        ),
+    )
+    loop_thread.start()
+    max_workers = 0
+    deadline = time.time() + 60
+    try:
+        while time.time() < deadline:
+            max_workers = max(max_workers, pool.reconcile())
+            if depth3(queue) == (0, 0, 0) and api.replicas("workers") == 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"did not settle: depth={depth3(queue)}, "
+                f"replicas={api.replicas('workers')}"
+            )
+    finally:
+        loop.stop()
+        pool.stop_all()
+        loop_thread.join(timeout=10)
+
+    assert max_workers > 1  # the burst actually scaled the pool out
+    assert pool.processed == 120  # every message processed exactly once
+    assert depth3(queue) == (0, 0, 0)
+    queue.close()
+
+
 def test_jax_queue_worker_drains_native_queue():
     # the real TPU inference worker consuming from the native broker:
     # receive -> batch -> jitted forward -> delete, queue fully acked
