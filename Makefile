@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test clean compile build push bench dryrun native
+.PHONY: test clean compile build push bench workbench dryrun native
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.1.0
@@ -26,6 +26,11 @@ push: build
 
 bench:
 	python bench.py
+
+# TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
+# the real chip; writes WORKBENCH.json
+workbench:
+	python workbench.py
 
 # Build the native (C++) local-queue broker explicitly.  Optional: the
 # ctypes binding also builds it on first use.

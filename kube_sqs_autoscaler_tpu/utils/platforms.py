@@ -1,0 +1,26 @@
+"""JAX platform-selection helper shared by every entry point.
+
+This image's sitecustomize registers a TPU-tunnel PJRT plugin in each
+Python process and calls ``jax.config.update("jax_platforms",
+"axon,cpu")``, which OVERRIDES the ``JAX_PLATFORMS`` env var (config
+beats env once set).  Any binary that must honor the env var — the graft
+dryrun, the trainer, the worker, the workbench — calls
+:func:`honor_env_platforms` before touching devices.
+
+Lives in ``utils`` (not ``workloads``) because importing it must not pull
+jax into controller-side processes; jax is imported lazily, only when the
+env var is actually set.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_env_platforms() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative over sitecustomize's config."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

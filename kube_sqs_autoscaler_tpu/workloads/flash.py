@@ -25,17 +25,41 @@ TPU mapping:
   skips their compute entirely — about half the FLOPs of full attention)
   and masks the partial diagonal blocks with ``-inf``;
 - block sizes auto-select the largest power-of-two tile up to 512 dividing
-  ``S`` (128 = lane-width minimum): measured on TPU v5e at ``S = 4k``,
-  512-wide tiles run ~2x faster than 128-wide and ~3x faster than the
-  dense XLA path, while bf16-into-the-MXU (fp32 accumulate only) is what
-  keeps the score matmul on the fast path.
+  ``S`` (128 = lane-width minimum); bf16-into-the-MXU (fp32 accumulate
+  only) keeps the score matmul on the fast path.
+
+**GQA-native**: ``k``/``v`` may carry fewer heads than ``q``
+(``[B, H_kv, S, D]`` with ``H % H_kv == 0``).  The query-head → kv-head
+mapping happens in the BlockSpec *index maps* (``h // groups``), so the
+kernel streams the compact ``H_kv``-head K/V straight from HBM — the
+bandwidth GQA exists to save is actually saved, with no
+``repeat_kv`` materialization before the kernel (the dense XLA path needs
+the broadcast; see ``llama._gqa_wrap``).
+
+**Differentiable**: the backward pass is two more Pallas kernels under
+``jax.custom_vjp`` (the flash-attention backward recurrence):
+
+- the forward additionally emits the per-row logsumexp ``L = m + log(l)``;
+- ``dq`` kernel: grid ``(B, H, S/bq, S/bk)``, recomputes the probability
+  tile ``p = exp(q kᵀ·s − L)`` and accumulates ``dq += (p∘(dp − Δ))·s @ k``
+  in VMEM scratch across the k axis;
+- ``dk``/``dv`` kernel: grid ``(B, H_kv, S/bk, groups·S/bq)`` — the
+  query-head group is *folded into the innermost grid axis*, so the
+  per-kv-head accumulators sum over all query heads of the group in VMEM
+  and each compact dk/dv block is written exactly once (this is where
+  GQA's backward would otherwise materialize full-head gradients);
+- ``Δ = rowsum(dO ∘ O)`` is precomputed outside the kernels (one fused
+  elementwise reduction, XLA's bread and butter).
 
 Plugs into the model through the ``attention_fn`` seam
 (``model.forward(..., attention_fn=flash_attention)``); composes with ring
-attention by serving as the per-shard local kernel.
+attention by serving as the per-shard local kernel, and with a sharded
+mesh via :func:`make_sharded_attention` (a ``shard_map`` wrapper, so the
+``pallas_call`` partitions over data/model axes instead of forcing XLA to
+gather around an opaque custom call).
 
-Off TPU the kernel runs in Pallas interpret mode (exact same code path), so
-the CPU test suite validates the real kernel — but interpret mode is
+Off TPU the kernels run in Pallas interpret mode (exact same code path), so
+the CPU test suite validates the real kernels — but interpret mode is
 Python-speed, which is why :func:`attention_fn_for` only dispatches to the
 kernel when actually running on TPU.
 """
@@ -48,9 +72,16 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
 
 DEFAULT_BLOCK = 128  # minimum tile: the MXU/VPU lane width
 PREFERRED_BLOCK = 512  # best-measured tile on TPU v5e (see module docstring)
+# Row statistics (logsumexp, Δ) are stored lane-replicated as
+# [B, H, S, 128]: Mosaic requires the last two block dims to be
+# (8, 128)-tiled, so a [bq]-shaped row vector is not a legal output tile —
+# broadcasting each per-row scalar across one lane width is the canonical
+# TPU layout for them (the upstream TPU flash kernel does the same).
+_LANES = 128
 
 
 def _pick_block(seq_len: int, requested: int | None) -> int:
@@ -72,11 +103,24 @@ def _pick_block(seq_len: int, requested: int | None) -> int:
     return block
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, max_ref, sum_ref, acc_ref,
-    *, block_q: int, block_k: int, scale: float, causal: bool,
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *rest,
+    block_q: int, block_k: int, scale: float, causal: bool,
 ):
+    # rest = (lse_ref,) + scratch when the caller needs the backward's
+    # logsumexp residual, else just the scratch refs
+    if len(rest) == 4:
+        lse_ref, max_ref, sum_ref, acc_ref = rest
+    else:
+        lse_ref = None
+        max_ref, sum_ref, acc_ref = rest
     # q_ref/o_ref: [1, 1, block_q, D] tiles; k_ref/v_ref: [1, 1, block_k, D]
+    # (already the kv head for this query head, via the BlockSpec index map)
     q_block_idx = pl.program_id(2)
     k_block_idx = pl.program_id(3)
     num_k_blocks = pl.num_programs(3)
@@ -130,35 +174,60 @@ def _flash_kernel(
     @pl.when(k_block_idx == num_k_blocks - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] / sum_ref[:]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row logsumexp, the backward pass's softmax residual
+            lse_ref[0, 0] = jnp.broadcast_to(
+                max_ref[:] + jnp.log(sum_ref[:]), (o_ref.shape[2], _LANES)
+            )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "causal", "interpret")
+    jax.jit,
+    static_argnames=("block_q", "block_k", "causal", "interpret", "need_lse"),
 )
-def _flash_call(
-    q, k, v, *, block_q: int, block_k: int, causal: bool, interpret: bool
+def _fwd_call(
+    q, k, v, *, block_q: int, block_k: int, causal: bool, interpret: bool,
+    need_lse: bool,
 ):
+    # need_lse=False (forward-only / serving): the logsumexp output is not
+    # declared at all, so the kernel writes no [B, H, S, _LANES] residual
+    # to HBM; the differentiated path requests it for the backward
     batch, heads, seq_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    groups = heads // kv_heads
     grid = (batch, heads, seq_len // block_q, seq_len // block_k)
     q_spec = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
     kv_spec = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+        (1, 1, block_k, head_dim),
+        lambda b, h, i, j: (b, h // groups, j, 0),
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)
     )
     kernel = functools.partial(
-        _flash_kernel,
+        _fwd_kernel,
         block_q=block_q,
         block_k=block_k,
         scale=1.0 / head_dim**0.5,
         causal=causal,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=(q_spec, lse_spec) if need_lse else (q_spec,),
+        out_shape=(
+            (
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(
+                    (batch, heads, seq_len, _LANES), jnp.float32
+                ),
+            )
+            if need_lse
+            else (jax.ShapeDtypeStruct(q.shape, q.dtype),)
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
             pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
@@ -166,6 +235,230 @@ def _flash_call(
         ],
         interpret=interpret,
     )(q, k, v)
+    return out if need_lse else (out[0], None)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q: int, block_k: int, scale: float, causal: bool,
+):
+    q_block_idx = pl.program_id(2)
+    k_block_idx = pl.program_id(3)
+    num_k_blocks = pl.num_programs(3)
+    q_offset = q_block_idx * block_q
+    k_offset = k_block_idx * block_k
+
+    @pl.when(k_block_idx == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    diagonal_or_below = k_offset <= q_offset + block_q - 1
+
+    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        scores = (
+            jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        )  # [bq, bk]
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(rows >= cols, scores, -jnp.inf)
+        # exact softmax probabilities via the saved logsumexp: masked
+        # entries are exp(-inf - finite) = 0 (row stats are
+        # lane-replicated [bq, _LANES] tiles; column 0 is the value)
+        p = jnp.exp(scores - lse_ref[0, 0][:, :1])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale  # [bq, bk] fp32
+        dq_acc[:] += jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(k_block_idx == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_k: int, num_q_blocks: int, scale: float,
+    causal: bool,
+):
+    # grid (B, H_kv, S/bk, groups * S/bq): the innermost axis enumerates
+    # (query head of the group, q block) pairs, so the VMEM accumulators
+    # sum the whole group's contribution and each compact [bk, D] dk/dv
+    # block is written exactly once
+    k_block_idx = pl.program_id(2)
+    t = pl.program_id(3)
+    num_t = pl.num_programs(3)
+    q_block_idx = t % num_q_blocks
+    q_offset = q_block_idx * block_q
+    k_offset = k_block_idx * block_k
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diagonal_or_below = k_offset <= q_offset + block_q - 1
+
+    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        scores = (
+            jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        )  # [bq, bk]
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(rows >= cols, scores, -jnp.inf)
+        p = jnp.exp(scores - lse_ref[0, 0][:, :1])  # [bq, bk]
+        dv_acc[:] += jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dk_acc[:] += jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(t == num_t - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "causal", "interpret")
+)
+def _bwd_call(
+    q, k, v, out, lse, do,
+    *, block_q: int, block_k: int, causal: bool, interpret: bool,
+):
+    batch, heads, seq_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    groups = heads // kv_heads
+    num_q_blocks = seq_len // block_q
+    num_k_blocks = seq_len // block_k
+    scale = 1.0 / head_dim**0.5
+
+    # Δ = rowsum(dO ∘ O): one fused elementwise reduction, no kernel
+    # needed; lane-replicated to the [B, H, S, _LANES] row-stat layout
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        ),
+        (batch, heads, seq_len, _LANES),
+    )
+
+    # dq: same grid shape as the forward
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, head_dim),
+        lambda b, h, i, j: (b, h // groups, j, 0),
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        ),
+        grid=(batch, heads, num_q_blocks, num_k_blocks),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: kv-head-major grid; query-head group folded into the inner axis
+    def q_idx(b, g, j, t):
+        return (b, g * groups + t // num_q_blocks, t % num_q_blocks, 0)
+
+    q_spec2 = pl.BlockSpec((1, 1, block_q, head_dim), q_idx)
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, g, j, t: (b, g, j, 0)
+    )
+    row_spec2 = pl.BlockSpec((1, 1, block_q, _LANES), q_idx)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
+            scale=scale, causal=causal,
+        ),
+        grid=(batch, kv_heads, num_k_blocks, groups * num_q_blocks),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, block_q, block_k, causal, interpret):
+    out, _ = _fwd_call(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        interpret=interpret, need_lse=False,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
+    out, lse = _fwd_call(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        interpret=interpret, need_lse=True,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(block_q, block_k, causal, interpret, residuals, do):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _bwd_call(
+        q, k, v, out, lse, do,
+        block_q=block_q, block_k=block_k, causal=causal, interpret=interpret,
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -179,17 +472,23 @@ def flash_attention(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal flash attention on ``[B, H, S, D]`` (drop-in for
-    ``model._dense_attention``).
+    ``model._dense_attention``), differentiable (Pallas backward kernels)
+    and GQA-native: ``k``/``v`` may be ``[B, H_kv, S, D]`` with
+    ``H % H_kv == 0`` — the compact heads are streamed directly, no
+    ``repeat_kv`` materialization.
 
     ``block_q``/``block_k`` default to the largest power-of-two tile up to
-    512 that divides ``S`` — measured on TPU v5e, 512-wide tiles run ~2x
-    faster than 128 at long S (fewer grid steps, better MXU utilization).
-    ``interpret=None`` auto-selects: compiled kernel on TPU, Pallas
-    interpreter elsewhere (same code path, for tests/CPU dev — slow).
-    Requires ``S`` divisible by the block sizes; callers with small/odd
-    shapes should use the dense path (see :func:`attention_fn_for`).
+    512 that divides ``S``. ``interpret=None`` auto-selects: compiled
+    kernel on TPU, Pallas interpreter elsewhere (same code path, for
+    tests/CPU dev — slow). Requires ``S`` divisible by the block sizes;
+    callers with small/odd shapes should use the dense path (see
+    :func:`attention_fn_for`).
     """
     seq_len = q.shape[2]
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads {q.shape[1]} not divisible by kv heads {k.shape[1]}"
+        )
     block_q = _pick_block(seq_len, block_q)
     block_k = _pick_block(seq_len, block_k)
     if seq_len % block_q or seq_len % block_k:
@@ -199,10 +498,12 @@ def flash_attention(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_call(
-        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        interpret=interpret,
-    )
+    return _flash(q, k, v, block_q, block_k, causal, interpret)
+
+
+# GQA marker the attention_fn dispatchers check: this kernel accepts
+# [B, H_kv, S, D] k/v directly (the dense path needs repeat_kv first)
+flash_attention.gqa_native = True
 
 
 def attention_fn_for(
@@ -225,3 +526,81 @@ def attention_fn_for(
     if backend == "tpu" and seq_len >= block and seq_len % block == 0:
         return flash_attention
     return _dense_attention
+
+
+def gqa_adapt(fn):
+    """The one place the GQA broadcast policy lives: adapt ``fn`` so it
+    accepts compact ``[B, H_kv, S, D]`` k/v.  GQA-native kernels (marked
+    ``gqa_native`` — the flash kernel, the sharded dispatcher) pass
+    through untouched; MHA-shaped ones (dense XLA) get ``repeat_kv``
+    applied just before the call (XLA fuses the broadcast into the
+    matmul).  MHA inputs (``H == H_kv``) are unaffected either way.
+    """
+    if getattr(fn, "gqa_native", False):
+        return fn
+
+    def attend(q, k, v):
+        if q.shape[1] != k.shape[1]:
+            from .llama import repeat_kv
+
+            groups = q.shape[1] // k.shape[1]
+            k = repeat_kv(k, groups)
+            v = repeat_kv(v, groups)
+        return fn(q, k, v)
+
+    return attend
+
+
+def make_sharded_attention(
+    mesh: Mesh,
+    *,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    backend: str | None = None,
+):
+    """Attention fn for a ``(data, model)``-sharded mesh: per-shard
+    flash-or-dense, wrapped in ``shard_map``.
+
+    A ``pallas_call`` is an opaque custom call to the SPMD partitioner —
+    left inside a plain ``jit``, sharded operands would be gathered to run
+    it replicated. ``shard_map`` pins the shard-local view instead: batch
+    shards over ``data_axis``, heads over ``model_axis`` (q's full heads
+    and the compact GQA kv heads shard the same way, so the per-shard
+    group structure is preserved), and the kernel choice is made at trace
+    time from the *local* static shape (flash on TPU when it tiles, dense
+    XLA elsewhere — same policy as :func:`attention_fn_for`).
+
+    Meshes with a nontrivial ``seq`` axis use :mod:`.ring` instead (see
+    ``train.mesh_attention_fn``).
+    """
+    spec = P(data_axis, model_axis, None, None)
+    data_n = mesh.shape.get(data_axis, 1)
+    model_n = mesh.shape.get(model_axis, 1)
+
+    def local(q, k, v):
+        return gqa_adapt(attention_fn_for(q.shape[2], backend=backend))(
+            q, k, v
+        )
+
+    def attend(q, k, v):
+        # shard_map needs exact divisibility (unlike NamedSharding, which
+        # pads); shapes that don't tile onto the mesh keep the plain XLA
+        # dense path, where the partitioner handles any layout (never the
+        # kernel: an unpartitioned pallas_call would force a gather)
+        if (
+            q.shape[0] % data_n
+            or q.shape[1] % model_n
+            or k.shape[1] % model_n
+        ):
+            from .model import _dense_attention
+
+            return gqa_adapt(_dense_attention)(q, k, v)
+        # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes
+        # info, so the vma checker cannot type the kernel's outputs
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    attend.gqa_native = True
+    return attend

@@ -1,0 +1,107 @@
+"""Model-FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference publishes no performance numbers (SURVEY.md §6), so the
+workload layer's perf contract is self-generated: tokens/s and MFU
+measured on the bench chip (``workbench.py`` at the repo root) and
+surfaced in the trainer's log line.
+
+Conventions (stated so the numbers are comparable across rounds):
+
+- FLOPs are *model* FLOPs — the matmul work the architecture defines —
+  not hardware FLOPs: rematerialization or a recomputing backward kernel
+  does not change the number (standard MFU convention, PaLM appendix B).
+- 2 FLOPs per multiply-accumulate.
+- Attention score/value matmuls are counted *full* (no causal ½
+  discount), again the common convention; the flash kernel's causal
+  block-skip therefore shows up as higher MFU, which is the point.
+- A train step is 3x the forward (backward = 2x forward).
+- Peak chip FLOP/s are bf16 dense figures from the public TPU specs;
+  unknown device kinds yield ``None`` (callers print tokens/s only).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# bf16 dense peak FLOP/s per chip, by jax device_kind substring.
+# Ordered: more specific names first (``v5 lite`` before ``v5``).
+_PEAK_FLOPS = (
+    ("v6 lite", 918e12),  # Trillium / v6e
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops(device: Any = None) -> float | None:
+    """Per-chip bf16 peak for ``device`` (default: first local device)."""
+    if device is None:
+        import jax
+
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for marker, peak in _PEAK_FLOPS:
+        if marker in kind:
+            return peak
+    return None
+
+
+def _attention_flops(batch: int, seq: int, d_model: int, n_layers: int) -> float:
+    # scores (q kᵀ) + values (p v): 2 matmuls of S² x Dh MACs per head
+    # per layer per example = 2 (matmuls) x 2 (FLOPs/MAC) x S² x d_model
+    # FLOPs — already FLOPs, not MACs (GQA changes bandwidth, not FLOPs:
+    # every query head still attends)
+    return n_layers * batch * 4.0 * seq * seq * d_model
+
+
+def forward_flops(config: Any, batch: int, seq: int) -> float:
+    """Forward-pass model FLOPs for one ``[batch, seq]`` token batch.
+
+    Works for both families (duck-typed on the config): projection
+    weights are read off the architecture, attention is counted full.
+    """
+    d = config.d_model
+    tokens = batch * seq
+    if hasattr(config, "n_kv_heads"):  # llama family
+        kv_dim = config.n_kv_heads * config.head_dim
+        per_token = (
+            d * d  # wq
+            + d * 2 * kv_dim  # wkv
+            + d * d  # wo
+            + d * 2 * config.d_ff  # w_gate_up
+            + config.d_ff * d  # w_down
+        ) * config.n_layers
+    else:  # gpt family
+        per_token = (
+            d * 3 * d  # wqkv
+            + d * d  # wo
+            + d * config.d_ff  # w_up
+            + config.d_ff * d  # w_down
+        ) * config.n_layers
+    per_token += d * config.vocab_size  # tied-embedding logits
+    return 2.0 * tokens * per_token + _attention_flops(
+        batch, seq, d, config.n_layers
+    )
+
+
+def train_step_flops(config: Any, batch: int, seq: int) -> float:
+    """fwd + bwd model FLOPs for one optimizer step (bwd = 2x fwd)."""
+    return 3.0 * forward_flops(config, batch, seq)
+
+
+def mfu(flops: float, seconds: float, device: Any = None) -> float | None:
+    """``flops / seconds`` as a fraction of the chip's bf16 peak
+    (``None`` when the peak is unknown — e.g. the CPU test mesh)."""
+    peak = peak_flops(device)
+    if peak is None or seconds <= 0:
+        return None
+    return flops / seconds / peak

@@ -104,12 +104,16 @@ def make_mesh(
 
 def mesh_attention_fn(mesh: Mesh):
     """Ring attention when the mesh has a nontrivial ``seq`` axis, else the
-    model's default dense path."""
+    per-shard flash-or-dense dispatcher (:func:`.flash.make_sharded_attention`)
+    — on TPU this is what puts the Pallas flash kernel (forward *and*
+    backward) on the training hot path."""
     if mesh.shape.get("seq", 1) > 1:
         from .ring import make_ring_attention
 
         return make_ring_attention(mesh)
-    return None
+    from .flash import make_sharded_attention
+
+    return make_sharded_attention(mesh)
 
 
 def _param_spec(path: tuple, mesh: Mesh) -> P:
@@ -374,13 +378,22 @@ def make_train_step(
     )
 
 
-def make_forward_step(mesh: Mesh, model_config: ModelConfig, params: Any):
-    """Compile sharded batch inference (the serving path workers run)."""
+def make_forward_step(
+    mesh: Mesh, model_config: Any, params: Any, forward_fn: Any = None
+):
+    """Compile sharded batch inference (the serving path workers run).
+
+    ``forward_fn(params, tokens, config, attention_fn)`` defaults to the
+    gpt-family :func:`.model.forward`; the llama family passes
+    ``llama.llama_forward`` (the mesh attention seam is GQA-native, so
+    the same wiring serves both).
+    """
     p_shardings = param_shardings(mesh, params)
     attention_fn = mesh_attention_fn(mesh)
+    forward_fn = forward_fn or forward
 
     def forward_step(params, tokens):
-        return forward(params, tokens, model_config, attention_fn)
+        return forward_fn(params, tokens, model_config, attention_fn)
 
     return jax.jit(
         forward_step,

@@ -108,6 +108,10 @@ def test_train_step_with_all_three_axes_learns():
     assert losses[-1] < losses[0]
 
 
-def test_trivial_seq_axis_uses_dense_path():
+def test_trivial_seq_axis_uses_sharded_flash_dispatcher():
+    # seq=1 meshes get the per-shard flash-or-dense dispatcher (the train
+    # hot path), which is GQA-native; only seq>1 meshes use ring attention
     mesh = make_mesh(jax.devices())  # seq=1
-    assert mesh_attention_fn(mesh) is None
+    attend = mesh_attention_fn(mesh)
+    assert attend is not None
+    assert getattr(attend, "gqa_native", False)

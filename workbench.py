@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Workload benchmark: train-step tokens/s + MFU, flash-vs-dense attention.
+
+The controller benchmark (``bench.py``, the driver's one-line contract)
+measures the control plane; this file measures the TPU workload the
+controller scales.  Run on the bench chip via ``make workbench``; results
+land in ``WORKBENCH.json`` and each metric is also printed as its own JSON
+line (same shape as ``bench.py``'s).
+
+What it measures (single chip):
+
+- ``train_tokens_per_sec`` / ``train_mfu`` — one optimizer step of the
+  flagship GPT-family config (bf16, flash attention on the hot path via
+  ``train.mesh_attention_fn``), steady-state over ``--steps`` steps.
+- ``llama_train_tokens_per_sec`` / ``llama_train_mfu`` — same for the
+  GQA llama family (compact-KV flash kernel path).
+- ``flash_fwdbwd_ms_s{N}`` vs ``dense_fwdbwd_ms_s{N}`` — value+grad of
+  the attention op alone at S ∈ {1k, 2k, 4k, 8k}, the kernel's headline.
+
+FLOPs conventions are in ``workloads/perf.py`` (full attention FLOPs, 2
+FLOPs/MAC, bwd = 2x fwd); "vs_baseline" is 1.0 by definition — the
+reference publishes no numbers (SURVEY.md §6), so these ARE the baseline
+the next round is held to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+from kube_sqs_autoscaler_tpu.utils.platforms import (
+    honor_env_platforms as _honor_env_platforms,
+)
+
+ATTN_SEQ_LENS = (1024, 2048, 4096, 8192)
+
+
+def _sync(out) -> None:
+    """Force execution to completion by fetching one output to the host.
+
+    ``block_until_ready`` is NOT a reliable sync on this image's TPU
+    tunnel (the experimental axon PJRT plugin returns from it before
+    execution finishes — measured 2 ms/step for 205 ms steps); an actual
+    device-to-host fetch of an output waits correctly, and the device
+    executes its stream in order, so fetching the last dispatch's output
+    fences all prior ones.
+    """
+    import jax
+
+    jax.device_get(jax.tree.leaves(out)[0])
+
+
+def _time_compiled(fn, *args, iters: int, warmup: int = 2) -> float:
+    """Steady-state seconds/call (host-fetch fence on the last result)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_train_step(family: str, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.perf import mfu, train_step_flops
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        TrainConfig,
+        batch_sharding,
+        init_train_state,
+        make_mesh,
+        make_train_step,
+        place_state,
+    )
+
+    batch, seq = 8, 2048
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    train_config = TrainConfig()
+    if family == "llama":
+        from kube_sqs_autoscaler_tpu.workloads.llama import (
+            LlamaConfig,
+            init_llama_train_state,
+            make_llama_train_step,
+        )
+
+        config = LlamaConfig(
+            vocab_size=8192, d_model=1024, n_heads=16, n_kv_heads=4,
+            n_layers=8, d_ff=2816, max_seq_len=seq,
+        )
+        state = place_state(
+            mesh, init_llama_train_state(jax.random.key(0), config,
+                                         train_config)
+        )
+        step_fn = make_llama_train_step(mesh, config, train_config, state)
+    else:
+        from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+
+        config = ModelConfig(
+            vocab_size=8192, d_model=1024, n_heads=16, n_layers=8,
+            d_ff=4096, max_seq_len=seq,
+        )
+        state = place_state(
+            mesh, init_train_state(jax.random.key(0), config, train_config)
+        )
+        step_fn = make_train_step(mesh, config, train_config, state)
+
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                           config.vocab_size, jnp.int32),
+        batch_sharding(mesh),
+    )
+    # step donates state: time full steps in a rolling loop, fenced by a
+    # host fetch of the final loss (see _sync for why not block_until_ready)
+    state, _ = step_fn(state, tokens)  # compile
+    state, loss = step_fn(state, tokens)  # warm
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step_fn(state, tokens)
+    final_loss = float(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    flops = train_step_flops(config, batch, seq)
+    return {
+        "seconds_per_step": dt,
+        "tokens_per_sec": batch * seq / dt,
+        "mfu": mfu(flops, dt),
+        "batch": batch,
+        "seq": seq,
+        "loss": final_loss,
+        "config": {
+            "d_model": config.d_model, "n_layers": config.n_layers,
+            "d_ff": config.d_ff, "vocab": config.vocab_size,
+        },
+    }
+
+
+def bench_attention(seq: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention
+    from kube_sqs_autoscaler_tpu.workloads.model import _dense_attention
+
+    batch, heads, dim = 2, 8, 128
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        (jax.random.normal(key, (batch, heads, seq, dim), jnp.float32)
+         / dim**0.25).astype(jnp.bfloat16)
+        for key in keys
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.mean(_dense_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    flash_fn = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
+    dense_fn = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
+    flash_s = _time_compiled(flash_fn, q, k, v, iters=iters)
+    dense_s = _time_compiled(dense_fn, q, k, v, iters=iters)
+    return {
+        "flash_fwdbwd_ms": flash_s * 1e3,
+        "dense_fwdbwd_ms": dense_s * 1e3,
+        "speedup": dense_s / flash_s,
+    }
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser(prog="workbench")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--attn-iters", type=int, default=20)
+    parser.add_argument("--out", default="WORKBENCH.json")
+    parser.add_argument(
+        "--skip-llama", action="store_true",
+        help="GPT family + attention micro-bench only",
+    )
+    args = parser.parse_args(argv)
+    _honor_env_platforms()
+
+    import jax
+
+    device = jax.devices()[0]
+    results = {
+        "device": str(device),
+        "device_kind": getattr(device, "device_kind", "unknown"),
+        "backend": jax.default_backend(),
+        "train": bench_train_step("gpt", args.steps),
+    }
+    if not args.skip_llama:
+        results["llama_train"] = bench_train_step("llama", args.steps)
+    for seq in ATTN_SEQ_LENS:
+        results[f"attention_s{seq}"] = bench_attention(seq, args.attn_iters)
+
+    metrics = [
+        ("train_tokens_per_sec", results["train"]["tokens_per_sec"],
+         "tokens/s"),
+        ("train_mfu", results["train"]["mfu"], "fraction"),
+    ]
+    if "llama_train" in results:
+        metrics += [
+            ("llama_train_tokens_per_sec",
+             results["llama_train"]["tokens_per_sec"], "tokens/s"),
+            ("llama_train_mfu", results["llama_train"]["mfu"], "fraction"),
+        ]
+    for seq in ATTN_SEQ_LENS:
+        att = results[f"attention_s{seq}"]
+        metrics += [
+            (f"flash_fwdbwd_ms_s{seq}", att["flash_fwdbwd_ms"], "ms"),
+            (f"dense_fwdbwd_ms_s{seq}", att["dense_fwdbwd_ms"], "ms"),
+            (f"flash_speedup_s{seq}", att["speedup"], "x"),
+        ]
+    for name, value, unit in metrics:
+        print(json.dumps({
+            "metric": name,
+            "value": None if value is None else round(float(value), 6),
+            "unit": unit,
+            "vs_baseline": 1.0,  # self-generated baseline (SURVEY.md §6)
+        }))
+    with open(args.out, "w") as fh:
+        json.dump(results, fh, indent=2)
+        fh.write("\n")
+    return results
+
+
+if __name__ == "__main__":
+    main()
