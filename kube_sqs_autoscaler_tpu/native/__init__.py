@@ -28,6 +28,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from contextlib import contextmanager
 from pathlib import Path
 
 _SRC = Path(__file__).with_name("localqueue.cpp")
@@ -76,6 +77,8 @@ def load_library() -> ctypes.CDLL:
         lib.lq_create.restype = c.c_void_p
         lib.lq_destroy.argtypes = [c.c_void_p]
         lib.lq_destroy.restype = None
+        lib.lq_close.argtypes = [c.c_void_p]
+        lib.lq_close.restype = None
         lib.lq_use_manual_clock.argtypes = [c.c_void_p, c.c_int]
         lib.lq_use_manual_clock.restype = None
         lib.lq_advance.argtypes = [c.c_void_p, c.c_double]
@@ -121,21 +124,46 @@ class LocalQueue:
     ) -> None:
         self._lib = load_library()
         self._q = self._lib.lq_create(float(visibility_timeout))
+        # active-call refcount: every native entry runs inside _native(),
+        # so close() can wait until no thread is inside the C++ object
+        # before freeing it (ctypes releases the GIL, so "null the handle
+        # first" alone is not enough — a thread can have passed the handle
+        # check but not yet entered the C function)
+        self._cv = threading.Condition()
+        self._active_calls = 0
         if manual_clock:
             self._lib.lq_use_manual_clock(self._q, 1)
 
     # --- lifecycle -------------------------------------------------------
-    def close(self) -> None:
-        if self._q is not None:
-            # null the handle first (under the GIL) so no new call can
-            # reach the C++ object while lq_destroy drains long-pollers
-            handle, self._q = self._q, None
-            self._lib.lq_destroy(handle)
+    @contextmanager
+    def _native(self):
+        """Yield the handle while holding an active-call ref."""
+        with self._cv:
+            if self._q is None:
+                raise ValueError("operation on closed LocalQueue")
+            handle = self._q
+            self._active_calls += 1
+        try:
+            yield handle
+        finally:
+            with self._cv:
+                self._active_calls -= 1
+                if self._active_calls == 0:
+                    self._cv.notify_all()
 
-    def _handle(self):
-        if self._q is None:
-            raise ValueError("operation on closed LocalQueue")
-        return self._q
+    def close(self) -> None:
+        with self._cv:
+            if self._q is None:
+                return
+            # no new call can acquire the handle past this point
+            handle, self._q = self._q, None
+        # wake long-pollers (they see `closing` and return -1 promptly) ...
+        self._lib.lq_close(handle)
+        # ... then wait for every in-flight native call to exit the C++
+        # object before freeing it
+        with self._cv:
+            self._cv.wait_for(lambda: self._active_calls == 0)
+        self._lib.lq_destroy(handle)
 
     def __del__(self) -> None:  # pragma: no cover - GC timing
         try:
@@ -152,14 +180,16 @@ class LocalQueue:
     # --- test clock ------------------------------------------------------
     def advance(self, seconds: float) -> None:
         """Advance the queue's manual clock (visibility/delay expiry)."""
-        self._lib.lq_advance(self._handle(), float(seconds))
+        with self._native() as handle:
+            self._lib.lq_advance(handle, float(seconds))
 
     # --- producer --------------------------------------------------------
     def send_message(
         self, queue_url: str = "", body: str = "", delay_s: float = 0.0
     ) -> str:
         data = body.encode()
-        msg_id = self._lib.lq_send(self._handle(), data, len(data), float(delay_s))
+        with self._native() as handle:
+            msg_id = self._lib.lq_send(handle, data, len(data), float(delay_s))
         return f"msg-{msg_id}"
 
     # --- consumer (workers' MessageQueue protocol) -----------------------
@@ -168,35 +198,41 @@ class LocalQueue:
     ) -> list[dict]:
         out = []
         wait = float(wait_time_s)
-        for _ in range(max_messages):
-            receipt = ctypes.c_longlong()
-            length = ctypes.c_longlong()
-            status = self._lib.lq_receive(
-                self._handle(), wait, ctypes.byref(receipt), ctypes.byref(length)
-            )
-            if status != 0:
-                break
-            wait = 0.0  # only the first receive of a batch long-polls
-            buf = ctypes.create_string_buffer(int(length.value))
-            n = self._lib.lq_fetch_body(
-                self._handle(), receipt.value, buf, length.value
-            )
-            if n < 0:  # expired between receive and fetch (real clock only)
-                continue
-            out.append(
-                {"ReceiptHandle": f"rh-{receipt.value}", "Body": buf.raw[:n].decode()}
-            )
+        with self._native() as handle:
+            for _ in range(max_messages):
+                receipt = ctypes.c_longlong()
+                length = ctypes.c_longlong()
+                status = self._lib.lq_receive(
+                    handle, wait, ctypes.byref(receipt), ctypes.byref(length)
+                )
+                if status != 0:
+                    break
+                wait = 0.0  # only the first receive of a batch long-polls
+                buf = ctypes.create_string_buffer(int(length.value))
+                n = self._lib.lq_fetch_body(
+                    handle, receipt.value, buf, length.value
+                )
+                if n < 0:  # expired between receive and fetch (real clock)
+                    continue
+                out.append(
+                    {
+                        "ReceiptHandle": f"rh-{receipt.value}",
+                        "Body": buf.raw[:n].decode(),
+                    }
+                )
         return out
 
     def delete_message(self, queue_url: str = "", receipt_handle: str = "") -> None:
-        self._lib.lq_delete(self._handle(), self._parse_receipt(receipt_handle))
+        with self._native() as handle:
+            self._lib.lq_delete(handle, self._parse_receipt(receipt_handle))
 
     def change_message_visibility(
         self, receipt_handle: str, timeout_s: float
     ) -> bool:
-        status = self._lib.lq_change_visibility(
-            self._handle(), self._parse_receipt(receipt_handle), float(timeout_s)
-        )
+        with self._native() as handle:
+            status = self._lib.lq_change_visibility(
+                handle, self._parse_receipt(receipt_handle), float(timeout_s)
+            )
         return status == 0
 
     # --- controller (QueueService protocol) ------------------------------
@@ -204,7 +240,8 @@ class LocalQueue:
         self, queue_url: str = "", attribute_names: list | None = None
     ) -> dict:
         counts = (ctypes.c_longlong * 3)()
-        self._lib.lq_attributes(self._handle(), counts)
+        with self._native() as handle:
+            self._lib.lq_attributes(handle, counts)
         attributes = {
             "ApproximateNumberOfMessages": str(counts[0]),
             "ApproximateNumberOfMessagesDelayed": str(counts[1]),
@@ -221,5 +258,8 @@ class LocalQueue:
     @staticmethod
     def _parse_receipt(receipt_handle: str) -> int:
         if receipt_handle.startswith("rh-"):
-            return int(receipt_handle[3:])
+            try:
+                return int(receipt_handle[3:])
+            except ValueError:
+                return -1  # malformed ("rh-abc") fails like unknown ones
         return -1  # unknown handles fail the delete, mirroring SQS

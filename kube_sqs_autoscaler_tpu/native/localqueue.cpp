@@ -108,10 +108,24 @@ LocalQueue* lq_create(double visibility_timeout_s) {
   return q;
 }
 
+// Begin shutdown without freeing: mark the queue closing and wake
+// long-pollers so they return promptly (-1).  The Python binding calls
+// this first, then waits for its own active-call refcount to drain, then
+// calls lq_destroy — so no thread can be inside the object when it is
+// freed, even threads that had already passed the binding's handle check
+// but not yet entered the C function.
+void lq_close(LocalQueue* q) {
+  if (q == nullptr) return;
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->closing = true;
+  q->cv.notify_all();
+}
+
 // Safe even with receivers blocked in lq_receive's long poll: wakes them,
 // waits for them to leave the queue's mutex/condvar, then deletes.  The
-// caller must still prevent *new* calls after destroy begins (the Python
-// binding nulls its handle under the GIL before calling this).
+// caller must still prevent *new* calls after destroy begins AND ensure
+// no thread is still executing any lq_* entry on this queue (the Python
+// binding's refcount in close() guarantees both).
 void lq_destroy(LocalQueue* q) {
   if (q == nullptr) return;
   {

@@ -242,6 +242,16 @@ def make_moe_train_step(mesh, config: ModelConfig, moe: MoeConfig,
 
     from .train import make_train_step
 
+    if getattr(train_config, "remat", False):
+        # moe_forward collects per-layer aux losses through a closure over
+        # the mlp seam; jax.checkpoint re-traces the block in the backward
+        # pass, so closure-captured intermediates would leak tracers.
+        # Fail fast instead of silently ignoring the flag.
+        raise ValueError(
+            "TrainConfig.remat is not supported for the MoE loss (the "
+            "aux-loss collection is incompatible with jax.checkpoint "
+            "re-tracing); set remat=False"
+        )
     return make_train_step(
         mesh, config, train_config, state,
         loss=partial(moe_loss_fn, config=config, moe=moe),

@@ -85,11 +85,22 @@ def init_pipeline_params(
     return params
 
 
-def _stage_apply(stage_layers: dict, x: jax.Array, config: ModelConfig) -> jax.Array:
-    """Run one stage's stacked layers over an activation microbatch."""
+def _stage_apply(
+    stage_layers: dict, x: jax.Array, config: ModelConfig,
+    remat: bool = False,
+) -> jax.Array:
+    """Run one stage's stacked layers over an activation microbatch.
+
+    ``remat=True`` checkpoints each layer like :func:`.model.forward`
+    does: the backward pass recomputes block activations instead of
+    keeping every microbatch's every layer resident — on a pipeline
+    stage that is the difference between O(M·L/P) and O(M + L/P) live
+    activations.
+    """
+    block = jax.checkpoint(_block, static_argnums=(2, 3)) if remat else _block
 
     def one_layer(h, layer):
-        return _block(h, layer, config, _dense_attention), None
+        return block(h, layer, config, _dense_attention), None
 
     out, _ = jax.lax.scan(one_layer, x, stage_layers)
     return out
@@ -103,6 +114,7 @@ def _pipeline_body(
     n_micro: int,
     axis_name: str,
     axis_size: int,
+    remat: bool = False,
 ) -> jax.Array:
     """Per-device GPipe schedule (inside ``shard_map``).
 
@@ -125,7 +137,7 @@ def _pipeline_body(
         act_in, outputs = carry
         fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, fresh, act_in)
-        act_out = _stage_apply(stage_layers, inp, config)
+        act_out = _stage_apply(stage_layers, inp, config, remat=remat)
 
         out_idx = jnp.clip(t - last, 0, n_micro - 1)
         outputs = jnp.where(
@@ -154,6 +166,7 @@ def pipeline_forward(
     config: ModelConfig,
     pcfg: PipelineConfig,
     mesh: Mesh,
+    remat: bool = False,
 ) -> jax.Array:
     """Logits via the pipelined layer stack.
 
@@ -181,6 +194,7 @@ def pipeline_forward(
         n_micro=pcfg.n_microbatches,
         axis_name="pipe",
         axis_size=pipe,
+        remat=remat,
     )
     y = jax.shard_map(
         body,
@@ -202,11 +216,13 @@ def pipeline_loss_fn(
     pcfg: PipelineConfig,
     mesh: Mesh,
     attention_fn=None,  # accepted for train.make_train_step's loss seam
+    remat: bool = False,
 ) -> jax.Array:
     """Mean next-token NLL over all microbatches."""
     from .train import next_token_nll
 
-    logits = pipeline_forward(params, tokens, config, pcfg, mesh)
+    logits = pipeline_forward(params, tokens, config, pcfg, mesh,
+                              remat=remat)
     m, b, s, v = logits.shape
     return next_token_nll(
         logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
@@ -273,7 +289,8 @@ def make_pipeline_train_step(
 
     return make_train_step(
         mesh, config, train_config, state,
-        loss=partial(pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh),
+        loss=partial(pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh,
+                     remat=getattr(train_config, "remat", False)),
         state_shardings_fn=pipeline_state_shardings,
         batch_sharding_fn=pipeline_batch_sharding,
     )

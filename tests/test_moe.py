@@ -145,3 +145,14 @@ def test_moe_loss_includes_aux_term():
     without = float(moe_loss_fn(params, tokens, TINY, moe_off))
     _, aux = moe_forward(params, tokens, TINY, moe_on)
     assert with_aux == pytest.approx(without + float(aux), rel=1e-5)
+
+
+def test_moe_train_step_rejects_remat():
+    # the aux-loss closure is incompatible with jax.checkpoint re-tracing;
+    # the flag must fail fast, not be silently ignored
+    mesh = make_mesh(jax.devices(), model_parallel=2)
+    moe = MoeConfig(n_experts=4, top_k=1)
+    train_config = TrainConfig(remat=True)
+    state = init_moe_train_state(jax.random.key(0), TINY, moe, train_config)
+    with pytest.raises(ValueError, match="remat"):
+        make_moe_train_step(mesh, TINY, moe, train_config, state)

@@ -204,6 +204,55 @@ def test_close_releases_blocked_long_poller_and_guards_reuse():
     q.close()  # idempotent
 
 
+def test_close_waits_for_inflight_native_calls():
+    # the active-call refcount: close() must not free the C++ object while
+    # another thread is inside a native entry (it had passed the handle
+    # check before close nulled it)
+    import time
+
+    q = LocalQueue(visibility_timeout=30.0)
+    for _ in range(20):
+        q.send_message(body="x")
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                try:
+                    q.get_queue_attributes()
+                    msgs = q.receive_messages(max_messages=2)
+                    for m in msgs:
+                        q.delete_message(receipt_handle=m["ReceiptHandle"])
+                except ValueError:
+                    return  # closed — the expected exit
+        except Exception as err:  # pragma: no cover - the bug under test
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    q.close()
+    stop.set()
+    for t in threads:
+        t.join(timeout=3)
+        assert not t.is_alive()
+    assert not errors
+
+
+def test_malformed_receipt_handle_fails_like_unknown():
+    with LocalQueue() as q:
+        q.send_message(body="x")
+        # neither form may raise; both must leave the message in flight
+        q.delete_message(receipt_handle="rh-abc")
+        q.delete_message(receipt_handle="bogus")
+        assert not q.change_message_visibility("rh-12notanint", 0.0)
+        (msg,) = q.receive_messages()
+        q.delete_message(receipt_handle=msg["ReceiptHandle"])
+        assert q.get_queue_attributes()["ApproximateNumberOfMessages"] == "0"
+
+
 def test_full_story_on_native_broker_with_llama_workers():
     """The whole system against the NATIVE C++ broker, serving the llama
     family: burst -> depth crosses threshold -> autoscaler raises
