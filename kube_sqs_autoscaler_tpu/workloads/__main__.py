@@ -4,6 +4,15 @@ Runs one queue-draining inference worker — the process a scaled Deployment
 replica executes.  ``--demo N`` self-feeds a local in-memory queue with N
 random messages instead of connecting to AWS (no credentials needed), which
 is also the quickest way to see the full workload path run.
+
+Two flags close the train→serve loop:
+
+- ``--checkpoint-dir DIR`` serves the weights a trainer
+  (``python -m ...workloads.trainer --checkpoint-dir DIR``) saved there,
+  reading the ``model_config.json`` manifest for the architecture;
+- ``--model-parallel TP`` shards serving over a ``(data, model)`` mesh
+  (classify via ``train.make_forward_step``, generate via
+  ``decode.make_serving_fns`` / ``llama.make_llama_serving_fns``).
 """
 
 from __future__ import annotations
@@ -11,20 +20,10 @@ from __future__ import annotations
 import argparse
 import json
 import logging
-import os
 import time
 
 from ..utils.logging import configure_logging
-
-
-def _honor_env_platforms() -> None:
-    """Make ``JAX_PLATFORMS`` authoritative even when a site hook already
-    imported jax and overrode platform selection via ``jax.config``."""
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
-
-        jax.config.update("jax_platforms", platforms)
+from ..utils.platforms import honor_env_platforms as _honor_env_platforms
 
 
 def main(argv=None) -> None:
@@ -47,6 +46,17 @@ def main(argv=None) -> None:
              "(RoPE/GQA — n_kv_heads-sized KV cache)",
     )
     parser.add_argument(
+        "--checkpoint-dir", default="", metavar="DIR",
+        help="serve the weights a trainer checkpointed here (reads the "
+             "model_config.json manifest for family + dimensions; "
+             "default: random init — smoke/bench mode)",
+    )
+    parser.add_argument(
+        "--model-parallel", type=int, default=0, metavar="TP",
+        help="shard serving over a (data, model) mesh with this "
+             "tensor-parallel degree (0 = single chip)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -57,26 +67,100 @@ def main(argv=None) -> None:
     from .model import ModelConfig, init_params
     from .service import QueueWorker, ServiceConfig
 
+    # --- model: architecture from the trainer's manifest, or built-in ----
+    needed_ctx = max(64, args.seq_len + args.generate_tokens)
+    if args.checkpoint_dir:
+        from .checkpoint import load_model_manifest
+
+        family, model_config = load_model_manifest(args.checkpoint_dir)
+        if family != args.family:
+            log.info("Checkpoint manifest says family=%s (overriding CLI)",
+                     family)
+        needed = args.seq_len + args.generate_tokens
+        if model_config.max_seq_len < needed:
+            raise SystemExit(
+                f"checkpointed model has max_seq_len="
+                f"{model_config.max_seq_len} < seq_len + generate_tokens = "
+                f"{needed}; lower --seq-len/--generate-tokens"
+            )
+    elif args.family == "llama":
+        from .llama import LlamaConfig
+
+        family = "llama"
+        model_config = LlamaConfig(
+            vocab_size=8192, d_model=512, n_heads=8, n_kv_heads=2,
+            n_layers=4, d_ff=1408, max_seq_len=needed_ctx,
+        )
+    else:
+        family = "gpt"
+        model_config = ModelConfig(
+            vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
+            max_seq_len=needed_ctx,
+        )
+
+    # --- mesh + weights --------------------------------------------------
+    from .train import make_mesh, param_shardings
+
+    mesh = None
+    if args.model_parallel:
+        mesh = make_mesh(model_parallel=args.model_parallel)
+        if args.batch_size % mesh.shape["data"]:
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must be divisible by the "
+                f"mesh's data axis ({mesh.shape['data']})"
+            )
+        log.info("Serving mesh: %s over %d devices", dict(mesh.shape),
+                 mesh.size)
+
+    if args.checkpoint_dir:
+        from .checkpoint import TrainCheckpointer
+
+        restore_mesh = mesh or make_mesh(jax.devices()[:1], model_parallel=1)
+        checkpointer = TrainCheckpointer(args.checkpoint_dir)
+        params = checkpointer.restore_params(restore_mesh, family,
+                                             model_config)
+        log.info("Restored weights from %s step %s", args.checkpoint_dir,
+                 checkpointer.latest_step())
+    else:
+        if family == "llama":
+            from .llama import init_llama_params
+
+            params = init_llama_params(jax.random.key(0), model_config)
+        else:
+            params = init_params(jax.random.key(0), model_config)
+        if mesh is not None:
+            params = jax.device_put(params, param_shardings(mesh, params))
+
+    # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
-    if args.family == "llama":
+    if mesh is not None:
+        from .train import make_forward_step
+
+        if family == "llama":
+            from .llama import llama_forward, make_llama_serving_fns
+
+            fwd = make_forward_step(mesh, model_config, params,
+                                    forward_fn=llama_forward)
+            _, _, gen = make_llama_serving_fns(mesh, model_config, params)
+        else:
+            from .decode import make_serving_fns
+
+            fwd = make_forward_step(mesh, model_config, params)
+            _, _, gen = make_serving_fns(mesh, model_config, params)
+        worker_kwargs = {
+            "forward_fn": fwd,
+            "generate_fn": lambda p, t, n: gen(p, t, jax.random.key(0), n),
+        }
+    elif family == "llama":
+        from .flash import attention_fn_for
         from .llama import (
-            LlamaConfig,
-            init_llama_params,
             llama_attention_fn_for,
             llama_forward_jit_with,
             llama_generate_jit,
         )
 
-        model_config = LlamaConfig(
-            vocab_size=8192, d_model=512, n_heads=8, n_kv_heads=2,
-            n_layers=4, d_ff=1408,
-            max_seq_len=max(64, args.seq_len + args.generate_tokens),
-        )
-        params = init_llama_params(jax.random.key(0), model_config)
         # flash kernel on TPU when seq_len tiles onto the MXU blocks —
         # for both the classify forward and the generate-mode prefill
-        from .flash import attention_fn_for
-
         attend = llama_attention_fn_for(model_config, args.seq_len)
         prompt_attention = attention_fn_for(args.seq_len)
         worker_kwargs = {
@@ -87,12 +171,6 @@ def main(argv=None) -> None:
                 p, t, n, model_config, prompt_attention=prompt_attention
             ),
         }
-    else:
-        model_config = ModelConfig(
-            vocab_size=8192, d_model=512, n_heads=8, n_layers=4, d_ff=2048,
-            max_seq_len=max(64, args.seq_len + args.generate_tokens),
-        )
-        params = init_params(jax.random.key(0), model_config)
     service_config = ServiceConfig(
         queue_url=args.sqs_queue_url, batch_size=args.batch_size,
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,

@@ -17,6 +17,7 @@ the two things our state needs:
 
 from __future__ import annotations
 
+import json
 from pathlib import Path
 from typing import Any
 
@@ -26,6 +27,41 @@ import orbax.checkpoint as ocp
 from jax.sharding import Mesh
 
 from .train import state_shardings
+
+# Manifest the trainer writes next to its checkpoints so a serving worker
+# pointed at --checkpoint-dir can reconstruct the exact architecture
+# (family + dimensions) without repeating the trainer's flags.
+MODEL_MANIFEST = "model_config.json"
+
+
+def save_model_manifest(directory: str | Path, family: str, config: Any) -> Path:
+    """Record ``family`` + the config's dimension fields as JSON.
+
+    Only JSON-representable fields are kept (``dtype`` is storage policy,
+    not architecture — both families default it; a worker restoring the
+    params gets the stored dtypes regardless).
+    """
+    payload = {"family": family}
+    for name, value in vars(config).items():
+        if isinstance(value, (int, float, str, bool)):
+            payload[name] = value
+    path = Path(directory) / MODEL_MANIFEST
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    return path
+
+
+def load_model_manifest(directory: str | Path) -> tuple[str, Any]:
+    """``(family, config)`` from a checkpoint directory's manifest."""
+    payload = json.loads((Path(directory) / MODEL_MANIFEST).read_text())
+    family = payload.pop("family")
+    if family == "llama":
+        from .llama import LlamaConfig
+
+        return family, LlamaConfig(**payload)
+    from .model import ModelConfig
+
+    return family, ModelConfig(**payload)
 
 
 class TrainCheckpointer:
@@ -77,3 +113,47 @@ class TrainCheckpointer:
             shardings,
         )
         return self._ckpt.restore(self._path(step), targets)
+
+    def restore_params(
+        self, mesh: Mesh, family: str, config: Any, step: int | None = None
+    ) -> Any:
+        """Restore just the model weights, placed for serving on ``mesh``.
+
+        This is the train→serve handoff: a worker reconstructs the params
+        structure from the manifest's family/config and restores ONLY the
+        ``params`` subtree (orbax partial restore) — the Adam moments stay
+        on disk, so serving startup costs 1x the weights in HBM and I/O,
+        not 3x.  Arrays come back with the mesh's PARAM_AXES shardings.
+        """
+        from .train import param_shardings
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if family == "llama":
+            from .llama import init_llama_params
+
+            init_fn = init_llama_params
+        else:
+            from .model import init_params
+
+            init_fn = init_params
+        reference = jax.eval_shape(lambda: init_fn(jax.random.key(0), config))
+        shardings = param_shardings(mesh, reference)
+        restore_args = jax.tree.map(
+            lambda leaf, sharding: ocp.ArrayRestoreArgs(
+                sharding=sharding, global_shape=leaf.shape, dtype=leaf.dtype
+            ),
+            reference,
+            shardings,
+        )
+        restored = ocp.PyTreeCheckpointer().restore(
+            self._path(step),
+            args=ocp.args.PyTreeRestore(
+                item={"params": reference},
+                restore_args={"params": restore_args},
+                partial_restore=True,
+            ),
+        )
+        return restored["params"]

@@ -238,9 +238,11 @@ def generate_jit(
 
 
 def cache_shardings(mesh: Mesh, cache: dict) -> dict:
-    """Cache layout on the mesh: batch over ``data``, heads over ``model``
-    (the axis ``wqkv``'s output sharding produces), positions unsharded.
-    Serving uses no ``seq`` axis — decode has nothing to ring over."""
+    """Cache layout on the mesh: batch over ``data``, the cache's head
+    axis over ``model`` (full heads for the gpt family via ``wqkv``'s
+    output sharding; compact kv heads for llama via ``wkv``'s), positions
+    unsharded.  Serving uses no ``seq`` axis — decode has nothing to ring
+    over."""
     kv = NamedSharding(mesh, P("data", "model", None, None))
     return {
         "layers": [{"k": kv, "v": kv} for _ in cache["layers"]],
@@ -248,21 +250,35 @@ def cache_shardings(mesh: Mesh, cache: dict) -> dict:
     }
 
 
-def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
-    """Compile (prefill, decode_step, generate) over the mesh.
+def compile_serving_fns(
+    mesh: Mesh,
+    params: Any,
+    cache_template: dict,
+    prefill_fn: Any,
+    decode_fn: Any,
+    generate_fn: Any,
+):
+    """The family-agnostic serving jit wiring (one implementation for the
+    gpt and llama families — only the four family ops differ).
 
     Requires a serving mesh (``seq`` axis of size 1): tensor-parallel heads
     + data-parallel batch. Shardings are pinned on inputs and outputs so
-    the cache never reshards between steps.  The returned generate fn's
-    signature is ``generate_fn(params, prompt, rng, num_tokens,
-    temperature=0.0)``, all positional (pjit rejects kwargs when
-    in_shardings is set); rng is required — pass any key under greedy.
+    the cache never reshards between steps.  Family ops (config already
+    bound): ``prefill_fn(params, tokens)``,
+    ``decode_fn(params, cache, token)``, and
+    ``generate_fn(params, prompt, num_tokens, temperature, rng)``.
+
+    The returned generate fn's signature is ``(params, prompt, rng,
+    num_tokens, temperature=0.0)``, all positional (pjit rejects kwargs
+    when in_shardings is set); rng is required — pass any key under
+    greedy (temperature=0 ignores it), so the sampling path shares the
+    compiled layout.
     """
     from .train import param_shardings
 
     if mesh.shape.get("seq", 1) != 1:
         raise ValueError(
-            "decode serving uses a (data, model) mesh; got seq="
+            "serving uses a (data, model) mesh; got seq="
             f"{mesh.shape['seq']} (ring/sequence parallelism applies to "
             "training and prefill, not token-by-token decode)"
         )
@@ -270,33 +286,45 @@ def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
     tokens_1d = NamedSharding(mesh, P("data"))
     tokens_2d = NamedSharding(mesh, P("data", None))
     logits_s = NamedSharding(mesh, P("data", None))
-    template = jax.eval_shape(lambda: init_cache(config, mesh.shape["data"]))
-    c_shard = cache_shardings(mesh, template)
+    c_shard = cache_shardings(mesh, cache_template)
 
-    prefill_fn = jax.jit(
-        partial(prefill, config=config),
+    prefill_jit = jax.jit(
+        prefill_fn,
         in_shardings=(p_shard, tokens_2d),
         out_shardings=(logits_s, c_shard),
     )
-    decode_fn = jax.jit(
-        partial(decode_step, config=config),
+    decode_jit = jax.jit(
+        decode_fn,
         in_shardings=(p_shard, c_shard, tokens_1d),
         out_shardings=(logits_s, c_shard),
         donate_argnums=1,  # reuse the cache buffers step to step
     )
-    def _generate(params, prompt, rng, num_tokens, temperature=0.0):
-        return generate(
-            params, prompt, num_tokens, config,
-            temperature=temperature, rng=rng,
-        )
 
-    # rng is a required positional (replicated) so pjit's
-    # no-kwargs-with-in_shardings rule can't bite: pass any key for greedy
-    # (temperature=0 ignores it) and the sampling path shares the layout
-    generate_fn = jax.jit(
+    def _generate(params, prompt, rng, num_tokens, temperature=0.0):
+        return generate_fn(params, prompt, num_tokens, temperature, rng)
+
+    generate_jit_fn = jax.jit(
         _generate,
         static_argnames=("num_tokens", "temperature"),
         in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P())),
         out_shardings=tokens_2d,
     )
-    return prefill_fn, decode_fn, generate_fn
+    return prefill_jit, decode_jit, generate_jit_fn
+
+
+def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
+    """Compile (prefill, decode_step, generate) over the mesh for the
+    gpt family (see :func:`compile_serving_fns` for the contract; the
+    llama counterpart is ``llama.make_llama_serving_fns``)."""
+    template = jax.eval_shape(lambda: init_cache(config, mesh.shape["data"]))
+    return compile_serving_fns(
+        mesh,
+        params,
+        template,
+        partial(prefill, config=config),
+        partial(decode_step, config=config),
+        lambda params, prompt, num_tokens, temperature, rng: generate(
+            params, prompt, num_tokens, config,
+            temperature=temperature, rng=rng,
+        ),
+    )

@@ -215,15 +215,13 @@ def _llama_block(
 
 
 def _gqa_wrap(config: LlamaConfig, inner):
-    """Adapt an MHA-shaped attention kernel (dense, flash) to GQA inputs:
-    broadcast k/v to full heads just before the kernel.  The one place
-    the broadcast policy lives."""
-    groups = config.n_heads // config.n_kv_heads
+    """Adapt an attention kernel to this family's GQA inputs — delegates
+    to :func:`.flash.gqa_adapt`, the single owner of the broadcast
+    policy (gqa-native kernels take compact k/v directly; MHA-shaped
+    ones get ``repeat_kv`` fused in just before the call)."""
+    from .flash import gqa_adapt
 
-    def attend(q, k, v):
-        return inner(q, repeat_kv(k, groups), repeat_kv(v, groups))
-
-    return attend
+    return gqa_adapt(inner)
 
 
 def _gqa_dense_attention(config: LlamaConfig):
@@ -309,24 +307,22 @@ def init_llama_train_state(
 
 def make_llama_train_step(mesh, config: LlamaConfig, train_config,
                           state: dict):
-    """dp x tp train step via :func:`.train.make_train_step`'s loss seam.
+    """dp x tp (x sp) train step via :func:`.train.make_train_step`'s seams.
 
-    The seam's ring attention_fn is discarded: GQA-shaped k/v need the
-    family's own attention.  Sequence parallelism for this family is a
-    follow-up, so a mesh with a nontrivial ``seq`` axis is rejected
-    (dense attention would silently all-gather the sequence otherwise).
+    The seam's mesh attention_fn (per-shard flash on TPU, ring attention
+    when the mesh has a ``seq`` axis) is adapted through :func:`_gqa_wrap`:
+    gqa-native fns take the compact k/v directly, MHA-shaped ones get the
+    broadcast.
     """
     from .train import make_train_step
 
-    if mesh.shape.get("seq", 1) != 1:
-        raise ValueError(
-            "llama train step uses a (data, model) mesh; got seq="
-            f"{mesh.shape['seq']} (sequence parallelism for the GQA family "
-            "is not implemented yet)"
-        )
-
     def loss(params, tokens, attention_fn=None):
-        return llama_loss_fn(params, tokens, config,
+        attend = (
+            _gqa_wrap(config, attention_fn)
+            if attention_fn is not None
+            else None
+        )
+        return llama_loss_fn(params, tokens, config, attention_fn=attend,
                              remat=train_config.remat)
 
     return make_train_step(mesh, config, train_config, state, loss=loss)
@@ -470,6 +466,35 @@ def llama_generate(
     (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
     produced = jnp.moveaxis(produced, 0, 1)
     return jnp.concatenate([produced, last[:, None]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded serving (the llama counterpart of decode.make_serving_fns)
+# ---------------------------------------------------------------------------
+
+
+def make_llama_serving_fns(mesh, config: LlamaConfig, params: dict):
+    """Compile (prefill, decode_step, generate) over a ``(data, model)``
+    mesh — same contract as :func:`.decode.make_serving_fns` (shared jit
+    wiring via :func:`.decode.compile_serving_fns`), with the compact GQA
+    cache sharded by *kv* head over ``model`` (requires
+    ``n_kv_heads % model_parallel == 0``)."""
+    from .decode import compile_serving_fns
+
+    template = jax.eval_shape(
+        lambda: init_llama_cache(config, mesh.shape["data"])
+    )
+    return compile_serving_fns(
+        mesh,
+        params,
+        template,
+        partial(llama_prefill, config=config),
+        partial(llama_decode_step, config=config),
+        lambda params, prompt, num_tokens, temperature, rng: llama_generate(
+            params, prompt, num_tokens, config,
+            temperature=temperature, rng=rng,
+        ),
+    )
 
 
 @partial(jax.jit, static_argnums=2)

@@ -22,28 +22,14 @@ from __future__ import annotations
 
 import argparse
 import logging
-import os
 import time
+from pathlib import Path
 
 from ..utils.logging import configure_logging
+from ..utils.platforms import honor_env_platforms as _honor_env_platforms
 from ..utils.profiling import maybe_trace
 
 log = logging.getLogger("trainer")
-
-
-def _honor_env_platforms() -> None:
-    """Make ``JAX_PLATFORMS`` authoritative.
-
-    Not redundant with JAX's own env handling: a site hook may already
-    have imported jax and overridden platform selection via
-    ``jax.config`` (this image's sitecustomize does exactly that to
-    register a TPU-tunnel plugin), and config beats env once set.
-    """
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
-
-        jax.config.update("jax_platforms", platforms)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -141,11 +127,11 @@ def train(args) -> dict:
             make_llama_train_step,
         )
 
-        if args.seq_parallel != 1 or args.zigzag:
+        if args.zigzag:
             raise SystemExit(
-                "--family llama does not support --seq-parallel/--zigzag "
-                "yet (sequence parallelism for the GQA family is a "
-                "follow-up)"
+                "--family llama does not support --zigzag yet (the "
+                "balanced schedule is wired for the gpt family; llama "
+                "sequence parallelism itself works via --seq-parallel)"
             )
         model_config = LlamaConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
@@ -181,11 +167,35 @@ def train(args) -> dict:
         elif latest is not None:
             # fail fast: orbax refuses to overwrite an existing step, so
             # without --resume this run would crash at its first save —
-            # after training for checkpoint_every steps
+            # after training for checkpoint_every steps.  Checked BEFORE
+            # the manifest write so an aborted mistaken re-run cannot
+            # clobber the dir's manifest with a different architecture.
             raise SystemExit(
                 f"checkpoint dir {args.checkpoint_dir} already has step "
                 f"{latest}; pass --resume to continue it or use a fresh dir"
             )
+        # train→serve handoff: record the architecture next to the
+        # checkpoints so a serving worker pointed at this directory can
+        # reconstruct the exact model without repeating these flags.  On
+        # resume an existing manifest must MATCH, never be overwritten.
+        from .checkpoint import MODEL_MANIFEST, load_model_manifest, \
+            save_model_manifest
+
+        manifest_path = Path(args.checkpoint_dir) / MODEL_MANIFEST
+        if manifest_path.exists():
+            prior_family, prior_config = load_model_manifest(
+                args.checkpoint_dir
+            )
+            if (prior_family, prior_config) != (args.family, model_config):
+                raise SystemExit(
+                    f"checkpoint dir {args.checkpoint_dir} was written by a "
+                    f"{prior_family} run with {prior_config}; this run's "
+                    f"flags describe a different model ({args.family}, "
+                    f"{model_config})"
+                )
+        else:
+            save_model_manifest(args.checkpoint_dir, args.family,
+                                model_config)
 
     if args.family == "llama":
         step_fn = make_llama_train_step(mesh, model_config, train_config,
@@ -201,6 +211,10 @@ def train(args) -> dict:
     losses = []
     start_step = int(jax.device_get(state["step"]))
     last_saved = start_step if args.resume else None
+
+    from .perf import mfu as mfu_of, train_step_flops
+
+    step_flops = train_step_flops(model_config, args.batch_size, args.seq_len)
 
     stream = synthetic_token_stream(
         model_config.vocab_size, args.batch_size, args.seq_len,
@@ -219,21 +233,41 @@ def train(args) -> dict:
     batches = prefetch_to_mesh(stream, batch_sharding(mesh))
 
     log_every = max(1, args.log_every)
-    t0 = time.perf_counter()
+    # throughput is per logging interval (the float(loss) fetch below is
+    # the sync point), and the interval containing the first step is
+    # excluded: it is dominated by XLA compilation, not steady-state work
+    interval_start = time.perf_counter()
+    interval_steps = 0
     # --steps bounds the run, so tracing it (when asked) is a bounded trace
     with maybe_trace(args.profile_dir):
         for local_step in range(args.steps):
             tokens = next(batches)
             state, loss = step_fn(state, tokens)
+            interval_steps += 1
             step = start_step + local_step + 1
             if local_step % log_every == 0 or local_step == args.steps - 1:
                 loss_value = float(loss)  # sync point, only when logging
                 losses.append(loss_value)
-                dt = time.perf_counter() - t0
-                log.info(
-                    "step %d loss %.4f (%.2f steps/s)",
-                    step, loss_value, (local_step + 1) / dt,
-                )
+                now = time.perf_counter()
+                rate = ""
+                if local_step > 0:
+                    steps_per_sec = interval_steps / (now - interval_start)
+                    tokens_per_sec = (
+                        steps_per_sec * args.batch_size * args.seq_len
+                    )
+                    # MFU is per-chip: divide the global step FLOPs across
+                    # the mesh before comparing against one chip's peak
+                    mfu_value = mfu_of(
+                        step_flops / mesh.size, 1.0 / steps_per_sec
+                    )
+                    rate = f" ({steps_per_sec:.2f} steps/s, " \
+                           f"{tokens_per_sec:.0f} tokens/s" + (
+                               f", {mfu_value:.1%} MFU"
+                               if mfu_value is not None else ""
+                           ) + ")"
+                interval_start = now
+                interval_steps = 0
+                log.info("step %d loss %.4f%s", step, loss_value, rate)
             # checkpoint-every 0 = only the final save below
             if (checkpointer and args.checkpoint_every > 0
                     and step % args.checkpoint_every == 0):
