@@ -32,6 +32,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .model import ModelConfig, _block, _dense_attention, _layer_norm, init_params
@@ -39,22 +40,43 @@ from .model import ModelConfig, _block, _dense_attention, _layer_norm, init_para
 
 @dataclass(frozen=True)
 class PipelineConfig:
-    """Schedule knobs: how many microbatches flow through the stages."""
+    """Schedule knobs: how many microbatches, and which schedule —
+    ``"gpipe"`` (all-forward-then-all-backward, bubble
+    ``(P-1)/(M+P-1)``, activations for all M microbatches live) or
+    ``"1f1b"`` (interleaved one-forward-one-backward, same bubble but
+    only ``min(M, P)`` stage inputs live)."""
 
     n_microbatches: int = 4
+    schedule: str = "gpipe"
+
+    def __post_init__(self) -> None:
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+            )
 
 
 def make_pipeline_mesh(
-    devices: list | None = None, pipe_parallel: int | None = None
+    devices: list | None = None,
+    pipe_parallel: int | None = None,
+    model_parallel: int = 1,
 ) -> Mesh:
-    """A ``("pipe", "data")`` mesh; ``pipe_parallel`` defaults to all devices."""
-    import numpy as np
-
+    """A ``("pipe", "data")`` mesh (or ``("pipe", "data", "model")`` when
+    ``model_parallel > 1`` — pp x dp x tp); ``pipe_parallel`` defaults to
+    all devices."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     pipe = pipe_parallel if pipe_parallel is not None else n
-    if n % pipe:
-        raise ValueError(f"{n} devices not divisible by pipe_parallel={pipe}")
+    if n % (pipe * model_parallel):
+        raise ValueError(
+            f"{n} devices not divisible by pipe_parallel={pipe} x "
+            f"model_parallel={model_parallel}"
+        )
+    if model_parallel > 1:
+        grid = np.asarray(devices).reshape(
+            pipe, n // (pipe * model_parallel), model_parallel
+        )
+        return Mesh(grid, ("pipe", "data", "model"))
     grid = np.asarray(devices).reshape(pipe, n // pipe)
     return Mesh(grid, ("pipe", "data"))
 
@@ -127,11 +149,10 @@ def _pipeline_body(
     stage = jax.lax.axis_index(axis_name)
     last = axis_size - 1
 
-    # x_micro replicates over "pipe" (in_spec P(None, "data")), but the
-    # carried activations diverge per stage, so mark the accumulators as
-    # pipe-varying for shard_map's scan-carry type check
-    act0 = jax.lax.pcast(x_micro[0] * 0.0, (axis_name,), to="varying")
-    out0 = jax.lax.pcast(x_micro * 0.0, (axis_name,), to="varying")
+    # carried activations diverge per stage; with check_vma=False on the
+    # (partial-manual) shard_map no varying-type annotation is needed
+    act0 = x_micro[0] * 0.0
+    out0 = x_micro * 0.0
 
     def step(carry, t):
         act_in, outputs = carry
@@ -158,6 +179,75 @@ def _pipeline_body(
     return jax.lax.psum(
         jnp.where(stage == last, outputs, jnp.zeros_like(outputs)), axis_name
     )
+
+
+def one_f_one_b_schedule(
+    n_stages: int, n_micro: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Static 1F1B slot tables: ``(fwd[T, P], bwd[T, P])`` with the
+    microbatch index each stage runs at each slot (-1 = idle).
+
+    Built by greedy simulation of the classic non-interleaved 1F1B
+    discipline: stage ``s`` runs ``min(M, P - s)`` warmup forwards, then
+    prefers backward whenever one is ready.  Dependencies: ``fwd(s, m)``
+    needs ``fwd(s-1, m)`` from an earlier slot; ``bwd(s, m)`` needs
+    ``fwd(s, m)`` and (below the last stage) ``bwd(s+1, m)`` earlier.
+
+    The builder *asserts* the two buffer disciplines the SPMD body relies
+    on (single-slot activation/cotangent mailboxes are never overwritten
+    before consumption), so an invalid schedule fails at trace time, not
+    as silent corruption.
+    """
+    P_, M = n_stages, n_micro
+    warmup = [min(M, P_ - s) for s in range(P_)]
+    fwd_done = [[-1] * M for _ in range(P_)]  # slot of fwd(s, m)
+    bwd_done = [[-1] * M for _ in range(P_)]
+    fwd_next = [0] * P_  # next microbatch each stage forwards
+    bwd_next = [0] * P_
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(b < M for b in bwd_next):
+        fwd_row, bwd_row = [-1] * P_, [-1] * P_
+        for s in range(P_):
+            m_f, m_b = fwd_next[s], bwd_next[s]
+            fwd_ready = m_f < M and (
+                s == 0 or (fwd_done[s - 1][m_f] not in (-1, t)
+                           and fwd_done[s - 1][m_f] < t)
+            )
+            bwd_ready = m_b < M and fwd_done[s][m_b] not in (-1,) and (
+                fwd_done[s][m_b] < t
+            ) and (
+                s == P_ - 1
+                or (bwd_done[s + 1][m_b] != -1 and bwd_done[s + 1][m_b] < t)
+            )
+            # the 1F1B discipline: backward whenever one is ready; forward
+            # only while fewer than warmup_s microbatches are in flight
+            # (this cap is what bounds activation memory to O(P) and what
+            # keeps the mailbox assertions below true)
+            can_fwd = fwd_ready and (fwd_next[s] - bwd_next[s]) < warmup[s]
+            if bwd_ready:
+                bwd_row[s] = m_b
+                bwd_done[s][m_b] = t
+                bwd_next[s] += 1
+            elif can_fwd:
+                fwd_row[s] = m_f
+                fwd_done[s][m_f] = t
+                fwd_next[s] += 1
+        fwd_rows.append(fwd_row)
+        bwd_rows.append(bwd_row)
+        t += 1
+        if t > 4 * (M + P_):  # pragma: no cover - builder bug guard
+            raise RuntimeError("1F1B schedule did not converge")
+    # mailbox discipline: stage s consumes act(m) at fwd(s,m); its
+    # predecessor writes act(m+1) at the END of fwd(s-1, m+1) — require
+    # consumption no later than that write for every (s, m)
+    for s in range(1, P_):
+        for m in range(M - 1):
+            assert fwd_done[s][m] <= fwd_done[s - 1][m + 1], (s, m)
+    for s in range(P_ - 1):
+        for m in range(M - 1):
+            assert bwd_done[s][m] <= bwd_done[s + 1][m + 1], (s, m)
+    return np.asarray(fwd_rows), np.asarray(bwd_rows)
 
 
 def pipeline_forward(
@@ -196,11 +286,16 @@ def pipeline_forward(
         axis_size=pipe,
         remat=remat,
     )
+    # manual over "pipe" only: the schedule's ppermutes/psum are explicit,
+    # while batch/tensor axes stay auto so GSPMD shards the stage matmuls
+    # over "data"/"model" (pp x dp x tp in one program)
     y = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P("pipe"), P(None, "data")),
-        out_specs=P(None, "data"),
+        in_specs=(P("pipe"), P(None)),
+        out_specs=P(None),
+        axis_names={"pipe"},
+        check_vma=False,
     )(params["stages"], x)
 
     y = _layer_norm(y, params["final_ln_scale"], params["final_ln_bias"])
@@ -229,18 +324,306 @@ def pipeline_loss_fn(
     )
 
 
+def _one_f_one_b_body(
+    stage_layers: dict,
+    head: dict,
+    x_micro: jax.Array,
+    tokens_micro: jax.Array,
+    *,
+    config: ModelConfig,
+    n_micro: int,
+    axis_name: str,
+    axis_size: int,
+    remat: bool,
+):
+    """Per-stage 1F1B schedule (inside a ``shard_map`` manual over
+    ``axis_name`` only — batch/tensor axes stay auto, so GSPMD shards the
+    stage matmuls over ``data``/``model``; pp x dp x tp in one program).
+
+    The backward slot *recomputes* the stage forward from the saved stage
+    input and vjp's it immediately (``jax.vjp`` closures cannot be
+    carried across ``lax.scan`` steps) — stage-granular rematerialization,
+    which is exactly what bounds live activations to the 1F1B in-flight
+    cap (min(M, P) stage inputs) instead of GPipe's all-M.
+
+    Returns ``(loss_sum, dstages, dhead, dx_micro)``; the caller divides
+    by M and feeds ``dx_micro`` to the embedding vjp.
+    """
+    fwd_tbl, bwd_tbl = one_f_one_b_schedule(axis_size, n_micro)
+    window = int(min(n_micro, axis_size))
+    stage = jax.lax.axis_index(axis_name)
+    last = axis_size - 1
+    pred = (stage - 1) % axis_size
+    succ = (stage + 1) % axis_size
+    fwd_ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    bwd_ring = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+    act_shape = x_micro.shape[1:]  # [B_m, S, D]
+
+    def stage_fwd(layers, x):
+        return _stage_apply(layers, x, config)
+
+    def stage_fwd_remat(layers, x):
+        return _stage_apply(layers, x, config, remat=remat)
+
+    def last_stage_loss(layers, head, x):
+        from .train import next_token_nll
+
+        y = stage_fwd_remat(layers, x)
+        y = _layer_norm(y, head["final_ln_scale"], head["final_ln_bias"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", y, head["embed"],
+            preferred_element_type=jnp.float32,
+        )
+        # targets for THIS microbatch (closure over the scanned index is
+        # not possible; the token row is indexed dynamically below and
+        # passed in)
+        return logits
+
+    def slot(carry, tables):
+        (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+         loss_acc) = carry
+        fwd_row, bwd_row = tables  # [P] each
+        fwd_m = fwd_row[stage]
+        bwd_m = bwd_row[stage]
+
+        # ---- forward slot -------------------------------------------
+        def do_fwd(args):
+            act_in, saved = args
+            m = jnp.clip(fwd_m, 0, n_micro - 1)
+            inp = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(x_micro, m, 0, keepdims=False),
+                act_in,
+            )
+            saved = jax.lax.dynamic_update_index_in_dim(
+                saved, inp, m % window, 0
+            )
+            # the last stage's output goes nowhere (its bwd slot
+            # recomputes through the loss head), so skip its matmuls
+            y = jax.lax.cond(
+                stage == last,
+                lambda layers, x: jnp.zeros(act_shape, x.dtype),
+                stage_fwd,
+                stage_layers, inp,
+            )
+            return y, saved
+
+        act_out, saved = jax.lax.cond(
+            fwd_m >= 0,
+            do_fwd,
+            lambda args: (jnp.zeros(act_shape, x_micro.dtype), args[1]),
+            (act_in, saved),
+        )
+
+        # ---- backward slot ------------------------------------------
+        def do_bwd(args):
+            grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc = args
+            m = jnp.clip(bwd_m, 0, n_micro - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                saved, m % window, 0, keepdims=False
+            )
+
+            def last_branch(grad_in):
+                targets = jax.lax.dynamic_index_in_dim(
+                    tokens_micro, m, 0, keepdims=False
+                )
+
+                def loss_of(layers, head, x):
+                    from .train import next_token_nll
+
+                    logits = last_stage_loss(layers, head, x)
+                    return next_token_nll(logits, targets)
+
+                loss_m, (dstage, dhead, dx) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1, 2)
+                )(stage_layers, head, x_saved)
+                return loss_m, dstage, dhead, dx
+
+            def mid_branch(grad_in):
+                _, vjp = jax.vjp(stage_fwd_remat, stage_layers, x_saved)
+                dstage, dx = vjp(grad_in)
+                zero_head = jax.tree.map(jnp.zeros_like, head)
+                return jnp.zeros((), jnp.float32), dstage, zero_head, dx
+
+            loss_m, dstage, dhead, dx = jax.lax.cond(
+                stage == last, last_branch, mid_branch, grad_in
+            )
+            dstage_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), dstage_acc, dstage
+            )
+            dhead_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), dhead_acc, dhead
+            )
+            # only stage 0's dx feeds the embedding backward; other
+            # stages write zeros into their (ignored, psum'ed-away) rows
+            dx_masked = jnp.where(stage == 0, dx, jnp.zeros_like(dx))
+            dx_buf = jax.lax.dynamic_update_index_in_dim(
+                dx_buf, dx_masked, m, 0
+            )
+            return grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc + loss_m, dx
+
+        def skip_bwd(args):
+            grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc = args
+            return (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc,
+                    jnp.zeros(act_shape, x_micro.dtype))
+
+        (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc,
+         grad_out) = jax.lax.cond(
+            bwd_m >= 0,
+            do_bwd,
+            skip_bwd,
+            (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc),
+        )
+
+        # ---- communication (every slot, validity-gated mailboxes) ----
+        act_arrived = jax.lax.ppermute(act_out, axis_name, fwd_ring)
+        grad_arrived = jax.lax.ppermute(
+            grad_out.astype(x_micro.dtype), axis_name, bwd_ring
+        )
+        act_in = jnp.where(fwd_row[pred] >= 0, act_arrived, act_in)
+        grad_in = jnp.where(bwd_row[succ] >= 0, grad_arrived, grad_in)
+
+        return (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+                loss_acc), None
+
+    carry0 = (
+        jnp.zeros(act_shape, x_micro.dtype),  # act mailbox
+        jnp.zeros(act_shape, x_micro.dtype),  # grad mailbox
+        jnp.zeros((window, *act_shape), x_micro.dtype),  # saved inputs
+        jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), stage_layers
+        ),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), head),
+        jnp.zeros((n_micro, *act_shape), x_micro.dtype),
+        jnp.zeros((), jnp.float32),
+    )
+    tables = (jnp.asarray(fwd_tbl), jnp.asarray(bwd_tbl))
+    (_, _, _, dstage_acc, dhead_acc, dx_buf, loss_acc), _ = jax.lax.scan(
+        slot, carry0, tables
+    )
+
+    # replicate the pieces only one stage holds
+    loss = jax.lax.psum(
+        jnp.where(stage == last, loss_acc, 0.0), axis_name
+    )
+    dhead = jax.tree.map(
+        lambda g: jax.lax.psum(
+            jnp.where(stage == last, g, jnp.zeros_like(g)), axis_name
+        ),
+        dhead_acc,
+    )
+    dx_micro = jax.lax.psum(
+        jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
+    )
+    return loss, dstage_acc, dhead, dx_micro
+
+
+def one_f_one_b_value_and_grad(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    pcfg: "PipelineConfig",
+    mesh: Mesh,
+    remat: bool = False,
+):
+    """``(loss, grads)`` for the pipelined LM via the 1F1B schedule.
+
+    Gradient-equal to ``jax.value_and_grad(pipeline_loss_fn)`` (same math,
+    different schedule/memory profile); the embedding lookup runs outside
+    the pipelined region with its vjp fed by stage 0's input cotangents,
+    while the tied-embedding unembed contribution comes from the last
+    stage — the two are summed here.
+    """
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+
+    def embed_fn(embed_params):
+        return (
+            embed_params["embed"][tokens]
+            + embed_params["pos_embed"][:seq]
+        )
+
+    embed_params = {
+        "embed": params["embed"], "pos_embed": params["pos_embed"]
+    }
+    x_micro, embed_vjp = jax.vjp(embed_fn, embed_params)
+    head = {
+        "embed": params["embed"],
+        "final_ln_scale": params["final_ln_scale"],
+        "final_ln_bias": params["final_ln_bias"],
+    }
+
+    pipe = mesh.shape["pipe"]
+    body = partial(
+        _one_f_one_b_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=pipe,
+        remat=remat,
+    )
+    loss_sum, dstages, dhead, dx_micro = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P(), P()),
+        out_specs=(P(), P("pipe"), P(), P()),
+        axis_names={"pipe"},
+        check_vma=False,
+    )(params["stages"], head, x_micro, tokens)
+
+    inv_m = 1.0 / pcfg.n_microbatches
+    (d_embed_side,) = embed_vjp(dx_micro * inv_m)
+    dtype_of = lambda name: params[name].dtype  # noqa: E731
+    grads = {
+        "stages": jax.tree.map(
+            lambda g, p: (g * inv_m).astype(p.dtype),
+            dstages, params["stages"],
+        ),
+        "embed": (
+            dhead["embed"] * inv_m + d_embed_side["embed"].astype(jnp.float32)
+        ).astype(dtype_of("embed")),
+        "pos_embed": d_embed_side["pos_embed"].astype(dtype_of("pos_embed")),
+        "final_ln_scale": (dhead["final_ln_scale"] * inv_m).astype(
+            dtype_of("final_ln_scale")
+        ),
+        "final_ln_bias": (dhead["final_ln_bias"] * inv_m).astype(
+            dtype_of("final_ln_bias")
+        ),
+    }
+    return loss_sum * inv_m, grads
+
+
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
     """Tokens ``[M, B_m, S]``: microbatch axis replicated, batch over data."""
     return NamedSharding(mesh, P(None, "data", None))
 
 
 def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
-    """Stage stacks shard their leading layer axis over ``"pipe"``;
-    embedding/unembedding/final-LN replicate."""
+    """Stage stacks shard their leading layer axis over ``"pipe"`` — and,
+    on a pp x tp mesh, their Megatron axes over ``"model"`` via the same
+    PARAM_AXES rules the non-pipelined trainer uses.
+    Embedding/unembedding/final-LN replicate (they live outside the
+    pipelined region)."""
+    from .model import PARAM_AXES
+    from .train import _LOGICAL_TO_MESH
+
+    has_model = "model" in mesh.shape
 
     def param_spec(path, leaf):
         keys = [p.key for p in path if hasattr(p, "key")]
-        return NamedSharding(mesh, P("pipe") if "stages" in keys else P())
+        if "stages" not in keys:
+            return NamedSharding(mesh, P())
+        axes = PARAM_AXES.get(keys[-1]) if has_model else None
+        if axes is None:
+            return NamedSharding(mesh, P("pipe"))
+        return NamedSharding(
+            mesh, P("pipe", *(_LOGICAL_TO_MESH[a] for a in axes))
+        )
 
     return jax.tree_util.tree_map_with_path(param_spec, params)
 
@@ -279,18 +662,34 @@ def make_pipeline_train_step(
     train_config,
     state: dict,
 ):
-    """Compile one pp x dp optimizer step: grads flow back through the
-    ``ppermute`` schedule (reverse-pipeline collectives inserted by AD).
+    """Compile one pp x dp (x tp) optimizer step.
+
+    ``pcfg.schedule`` picks the pipeline schedule: ``"gpipe"``
+    differentiates the lockstep forward (reverse-pipeline collectives
+    inserted by AD); ``"1f1b"`` uses the explicitly-scheduled backward
+    (:func:`one_f_one_b_value_and_grad`) — same gradients, ``min(M, P)``
+    live stage inputs instead of all M.
 
     Delegates to :func:`.train.make_train_step` through its loss/sharding
     seams so there is exactly one optimizer-step implementation.
     """
     from .train import make_train_step
 
+    remat = getattr(train_config, "remat", False)
+    if pcfg.schedule == "1f1b":
+        return make_train_step(
+            mesh, config, train_config, state,
+            value_and_grad_fn=partial(
+                one_f_one_b_value_and_grad,
+                config=config, pcfg=pcfg, mesh=mesh, remat=remat,
+            ),
+            state_shardings_fn=pipeline_state_shardings,
+            batch_sharding_fn=pipeline_batch_sharding,
+        )
     return make_train_step(
         mesh, config, train_config, state,
         loss=partial(pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh,
-                     remat=getattr(train_config, "remat", False)),
+                     remat=remat),
         state_shardings_fn=pipeline_state_shardings,
         batch_sharding_fn=pipeline_batch_sharding,
     )

@@ -60,6 +60,19 @@ def ring_rotation(axis_size: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
 
+def expand_kv(t: jax.Array, groups: int) -> jax.Array:
+    """GQA broadcast ``[B, H_kv, S, D] -> [B, H_kv*groups, S, D]`` at the
+    compute site.  XLA fuses the broadcast into the consuming einsum, so
+    the full-head tensor never materializes — the *carried/rotated* blocks
+    stay compact (``groups``x less ICI traffic per hop)."""
+    if groups == 1:
+        return t
+    batch, kv_heads, seq, dim = t.shape
+    return jnp.broadcast_to(
+        t[:, :, None], (batch, kv_heads, groups, seq, dim)
+    ).reshape(batch, kv_heads * groups, seq, dim)
+
+
 def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -68,8 +81,11 @@ def _ring_attention_local(
     axis_name: str,
     axis_size: int,
 ) -> jax.Array:
-    """Per-device body. q/k/v: ``[B, H, S_local, D]`` (already sharded)."""
+    """Per-device body. q: ``[B, H, S_local, D]``; k/v may carry compact
+    GQA heads ``[B, H_kv, S_local, D]`` (broadcast at the compute site,
+    rotated compact)."""
     batch, heads, seq_local, head_dim = q.shape
+    groups = heads // k.shape[1]
     my_index = jax.lax.axis_index(axis_name)
 
     q32 = q.astype(jnp.float32)
@@ -95,14 +111,16 @@ def _ring_attention_local(
             jnp.einsum(
                 "bhqd,bhkd->bhqk",
                 q32,
-                k_blk.astype(jnp.float32),
+                expand_kv(k_blk, groups).astype(jnp.float32),
             )
             * scale
         )
         causal = q_positions[:, None] >= k_positions[None, :]
         scores = jnp.where(causal, scores, _NEG_INF)
 
-        o_new, l_new, m_new = online_update(o, l, m, scores, v_blk)
+        o_new, l_new, m_new = online_update(
+            o, l, m, scores, expand_kv(v_blk, groups)
+        )
 
         # rotate k/v one hop around the ring: i -> i+1
         ring = ring_rotation(axis_size)
@@ -135,9 +153,17 @@ def make_ring_attention(
     body = partial(
         _ring_attention_local, axis_name=seq_axis, axis_size=axis_size
     )
-    return jax.shard_map(
+    sharded = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
+
+    def attend(q, k, v):
+        return sharded(q, k, v)
+
+    # GQA-native: compact [B, H_kv, S, D] k/v rotate around the ring as-is
+    # (see expand_kv) — no repeat_kv before the call
+    attend.gqa_native = True
+    return attend
 
 
 # Single-device ground truth the ring must reproduce: the model's own

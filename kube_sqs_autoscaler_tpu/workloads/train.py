@@ -294,6 +294,7 @@ def make_train_step(
     loss: Any = None,
     state_shardings_fn: Any = None,
     batch_sharding_fn: Any = None,
+    value_and_grad_fn: Any = None,
 ):
     """Compile one optimizer step over the mesh.
 
@@ -306,6 +307,9 @@ def make_train_step(
     ``state_shardings_fn(mesh, state)`` / ``batch_sharding_fn(mesh)``
     override the placement rules (default: the PARAM_AXES rules here;
     :mod:`.pipeline` passes its stage-stacked rules).
+    ``value_and_grad_fn(params, tokens) -> (loss, grads)`` replaces
+    autodiff of ``loss`` entirely — for schedules that compute their own
+    backward (the 1F1B pipeline); mutually exclusive with grad_accum > 1.
     """
     optimizer = make_optimizer(train_config)
     shardings = (state_shardings_fn or state_shardings)(mesh, state)
@@ -318,8 +322,15 @@ def make_train_step(
     # custom losses opt into remat themselves (forward's remat flag)
 
     accum = train_config.grad_accum
+    if value_and_grad_fn is not None and accum != 1:
+        raise ValueError(
+            "value_and_grad_fn computes its own backward; combine it with "
+            "grad_accum by microbatching inside it, not via grad_accum"
+        )
 
     def compute_grads(params, tokens):
+        if value_and_grad_fn is not None:
+            return value_and_grad_fn(params, tokens)
         if accum == 1:
             return jax.value_and_grad(loss)(
                 params, tokens, attention_fn=attention_fn

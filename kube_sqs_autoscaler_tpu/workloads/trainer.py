@@ -127,12 +127,6 @@ def train(args) -> dict:
             make_llama_train_step,
         )
 
-        if args.zigzag:
-            raise SystemExit(
-                "--family llama does not support --zigzag yet (the "
-                "balanced schedule is wired for the gpt family; llama "
-                "sequence parallelism itself works via --seq-parallel)"
-            )
         model_config = LlamaConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
             n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
@@ -197,14 +191,19 @@ def train(args) -> dict:
             save_model_manifest(args.checkpoint_dir, args.family,
                                 model_config)
 
-    if args.family == "llama":
-        step_fn = make_llama_train_step(mesh, model_config, train_config,
-                                        state)
-    elif args.zigzag:
+    if args.zigzag:
         from .zigzag import make_zigzag_train_step
 
+        forward_fn = None
+        if args.family == "llama":
+            from .llama import llama_forward
+
+            forward_fn = llama_forward
         step_fn = make_zigzag_train_step(mesh, model_config, train_config,
-                                         state)
+                                         state, forward_fn=forward_fn)
+    elif args.family == "llama":
+        step_fn = make_llama_train_step(mesh, model_config, train_config,
+                                        state)
     else:
         step_fn = make_train_step(mesh, model_config, train_config, state)
 

@@ -46,7 +46,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring import NEG_INF as _NEG_INF, online_update, ring_rotation
+from .ring import (
+    NEG_INF as _NEG_INF,
+    expand_kv,
+    online_update,
+    ring_rotation,
+)
 
 
 def zigzag_permutation(seq: int, n_devices: int) -> np.ndarray:
@@ -82,10 +87,13 @@ def _zigzag_attention_local(
     axis_name: str,
     axis_size: int,
 ) -> jax.Array:
-    """Per-device body. q/k/v: ``[B, H, 2c, D]`` in zig-zag order."""
+    """Per-device body. q: ``[B, H, 2c, D]`` in zig-zag order; k/v may
+    carry compact GQA heads (broadcast at the compute site via
+    :func:`.ring.expand_kv`, rotated compact)."""
     seq_local = q.shape[2]
     chunk = seq_local // 2
     head_dim = q.shape[-1]
+    groups = q.shape[1] // k.shape[1]
     my_index = jax.lax.axis_index(axis_name)
 
     q32 = q.astype(jnp.float32) * (1.0 / head_dim**0.5)
@@ -101,7 +109,9 @@ def _zigzag_attention_local(
 
     def scores_for(q_part, k_part):
         return jnp.einsum(
-            "bhqd,bhkd->bhqk", q_part, k_part.astype(jnp.float32)
+            "bhqd,bhkd->bhqk",
+            q_part,
+            expand_kv(k_part, groups).astype(jnp.float32),
         )
 
     def step(carry, step_index):
@@ -114,14 +124,17 @@ def _zigzag_attention_local(
             scores = scores_for(q32, k_blk)
             causal = q_positions[:, None] >= q_positions[None, :]
             return online_update(
-                o, l, m, jnp.where(causal, scores, _NEG_INF), v_blk
+                o, l, m, jnp.where(causal, scores, _NEG_INF),
+                expand_kv(v_blk, groups),
             )
 
         def from_earlier(o, l, m):
             # e < d: every local q attends the early chunk, none the late
             # one — half the matmul, no mask
             scores = scores_for(q32, k_blk[:, :, :chunk])
-            return online_update(o, l, m, scores, v_blk[:, :, :chunk])
+            return online_update(
+                o, l, m, scores, expand_kv(v_blk[:, :, :chunk], groups)
+            )
 
         def from_later(o, l, m):
             # e > d: only the late local queries attend, to both chunks —
@@ -129,7 +142,7 @@ def _zigzag_attention_local(
             scores = scores_for(q32[:, :, chunk:], k_blk)
             o_hi, l_hi, m_hi = online_update(
                 o[:, :, chunk:], l[:, :, chunk:], m[:, :, chunk:],
-                scores, v_blk,
+                scores, expand_kv(v_blk, groups),
             )
             return (
                 jnp.concatenate([o[:, :, :chunk], o_hi], axis=2),
@@ -179,10 +192,16 @@ def make_zigzag_ring_attention(
     body = partial(
         _zigzag_attention_local, axis_name=seq_axis, axis_size=axis_size
     )
-    fn = jax.shard_map(
+    sharded = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
+
+    def fn(q, k, v):
+        return sharded(q, k, v)
+
     fn._zigzag = True  # layout marker checked by the zig-zag losses
+    # GQA-native: compact k/v rotate as-is (see ring.expand_kv)
+    fn.gqa_native = True
     return fn
 
 
@@ -235,10 +254,17 @@ def zigzag_loss_from_permuted(
     mesh: Mesh,
     attention_fn=None,
     remat: bool = False,
+    forward_fn=None,
 ):
     """LM loss on a batch already in zig-zag order (see
     :func:`permute_batch`): forward runs with permuted positional indices,
     the loss masks the target-less slot — no permute happens on device.
+
+    ``forward_fn(params, tokens, config, attention_fn, positions=...,
+    remat=...)`` defaults to the gpt family's :func:`.model.forward`; the
+    llama family passes :func:`.llama.llama_forward` (RoPE rotates by the
+    permuted positions; the zig-zag attention is GQA-native, so compact
+    k/v rotate as-is).
     """
     from .model import forward
 
@@ -246,7 +272,7 @@ def zigzag_loss_from_permuted(
     perm = jnp.asarray(zigzag_permutation(seq, mesh.shape["seq"]))
     attend = _require_zigzag_attention(attention_fn, mesh)
 
-    logits = forward(
+    logits = (forward_fn or forward)(
         params, tokens_zz, config, attend, positions=perm, remat=remat
     )
     log_probs = jax.nn.log_softmax(logits, axis=-1)
@@ -261,6 +287,7 @@ def zigzag_loss_fn(
     mesh: Mesh,
     attention_fn=None,
     remat: bool = False,
+    forward_fn=None,
 ):
     """Convenience/reference form: **natural-order** tokens in, permutes
     inside the traced program with static index gathers.
@@ -282,11 +309,12 @@ def zigzag_loss_fn(
     valid = (perm < seq - 1)[None, :]
     return zigzag_loss_from_permuted(
         params, tokens_zz, targets_zz, valid, config, mesh, attention_fn,
-        remat=remat,
+        remat=remat, forward_fn=forward_fn,
     )
 
 
-def make_zigzag_train_step(mesh: Mesh, config, train_config, state):
+def make_zigzag_train_step(mesh: Mesh, config, train_config, state,
+                           forward_fn=None):
     """Compile a dp x sp x tp train step whose sequence parallelism runs
     the balanced zig-zag schedule instead of plain ring attention.
 
@@ -294,6 +322,8 @@ def make_zigzag_train_step(mesh: Mesh, config, train_config, state):
     :func:`zigzag_loss_fn`).  Delegates to :func:`.train.make_train_step`
     through its ``loss`` seam; an input pipeline that pre-permutes should
     jit :func:`zigzag_loss_from_permuted` directly instead.
+    ``forward_fn`` selects the family (see
+    :func:`zigzag_loss_from_permuted`).
     """
     from .train import make_train_step
 
@@ -304,7 +334,7 @@ def make_zigzag_train_step(mesh: Mesh, config, train_config, state):
         # zig-zag inputs need the zig-zag schedule built above
         return zigzag_loss_fn(
             params, tokens, config, mesh, attend,
-            remat=train_config.remat,
+            remat=train_config.remat, forward_fn=forward_fn,
         )
 
     return make_train_step(mesh, config, train_config, state, loss=loss)

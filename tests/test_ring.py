@@ -115,3 +115,22 @@ def test_trivial_seq_axis_uses_sharded_flash_dispatcher():
     attend = mesh_attention_fn(mesh)
     assert attend is not None
     assert getattr(attend, "gqa_native", False)
+
+
+def test_ring_gqa_matches_broadcast_dense():
+    """Compact [B, H_kv, S, D] k/v rotate around the ring and must equal
+    repeat_kv + dense causal (the llama family's sp path)."""
+    from kube_sqs_autoscaler_tpu.workloads.llama import repeat_kv
+
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
+    keys = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(keys[0], (2, 4, 32, 16), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 2, 32, 16), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 2, 32, 16), jnp.float32)
+    ring_fn = make_ring_attention(mesh)
+    assert ring_fn.gqa_native
+    expected = dense_causal_attention(q, repeat_kv(k, 2), repeat_kv(v, 2))
+    np.testing.assert_allclose(
+        np.asarray(ring_fn(q, k, v)), np.asarray(expected),
+        rtol=2e-5, atol=2e-5,
+    )

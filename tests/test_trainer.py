@@ -77,10 +77,17 @@ def test_trainer_llama_seq_parallel_trains():
     assert losses[-1] < losses[0]
 
 
-def test_trainer_llama_rejects_zigzag():
-    with pytest.raises(SystemExit, match="zigzag"):
-        main(TINY_FLAGS + ["--steps", "1", "--family", "llama",
-                           "--seq-parallel", "2", "--zigzag"])
+def test_trainer_llama_zigzag_trains():
+    # balanced zig-zag schedule with GQA (compact k/v rotation): llama +
+    # --zigzag learns under --overfit
+    result = main(TINY_FLAGS + ["--steps", "4", "--family", "llama",
+                                "--model-parallel", "2",
+                                "--seq-parallel", "2", "--zigzag",
+                                "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
 
 
 def test_trainer_profile_writes_trace(tmp_path):
