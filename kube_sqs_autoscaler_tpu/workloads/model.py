@@ -79,6 +79,12 @@ PARAM_AXES = {
     "wq": ("model", "heads"),
     "wkv": ("model", "kv_heads"),
     "w_gate_up": ("model", "ff2"),
+    # pipeline stage stacks (workloads.pipeline) split the fused wqkv into
+    # per-projection weights so each shards contiguous heads under the
+    # fully-manual pp x tp shard_map (a fused 3*d_model axis chunks across
+    # the q/k/v boundary); wq above is shared with the llama family
+    "wk": ("model", "heads"),
+    "wv": ("model", "heads"),
 }
 
 
@@ -165,13 +171,23 @@ def _merge_heads(t: jax.Array, config: ModelConfig) -> jax.Array:
 def _project_qkv(
     h: jax.Array, layer: dict, config: ModelConfig
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One fused MXU matmul for q,k,v, split into heads."""
-    q, k, v = jnp.split(h @ layer["wqkv"], 3, axis=-1)
+    """q,k,v projections split into heads.
+
+    Layers carry either the fused ``wqkv`` (one MXU matmul, the single-chip
+    layout) or split ``wq``/``wk``/``wv`` (the pipeline stage layout, whose
+    fully-manual tensor-parallel sharding needs contiguous heads per
+    projection); both produce identical values.
+    """
+    if "wqkv" in layer:
+        q, k, v = jnp.split(h @ layer["wqkv"], 3, axis=-1)
+    else:
+        q, k, v = h @ layer["wq"], h @ layer["wk"], h @ layer["wv"]
     return _split_heads(q, config), _split_heads(k, config), _split_heads(v, config)
 
 
 def _block(
-    x: jax.Array, layer: dict, config: ModelConfig, attend, mlp=None
+    x: jax.Array, layer: dict, config: ModelConfig, attend, mlp=None,
+    reduce=None, promote=None,
 ) -> jax.Array:
     """One transformer block: pre-LN attention + pre-LN MLP, residual both.
 
@@ -181,13 +197,40 @@ def _block(
     the ``attend(q, k, v) -> [B,H,S,D]`` callback (dense/flash/ring
     attention, or a cache-updating closure) and the ``mlp(x, layer)``
     callback (dense :func:`_mlp` by default; sparse expert MLP for MoE).
+
+    ``reduce``/``promote`` are the Megatron tensor-parallel seams for
+    fully-manual ``shard_map`` execution with column-parallel
+    ``wq/wk/wv/w_up`` and row-parallel ``wo/w_down`` shards:
+
+    - ``reduce`` is Megatron's *g* operator (all-reduce forward, identity
+      backward), applied where the row-parallel matmuls leave partial
+      sums: after the attention output projection and after the MLP down
+      projection.
+    - ``promote`` is Megatron's *f* operator (identity forward, all-reduce
+      backward), applied to each layernormed block input right before it
+      feeds the column-parallel matmuls — its backward sums the per-shard
+      partial input-cotangents that plain AD of ``replicated @ sharded``
+      would silently leave unreduced under ``check_vma=False``.
+
+    Both ``None`` (default) for unsharded or GSPMD-auto execution.
     """
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    if promote is not None:
+        h = promote(h)
     q, k, v = _project_qkv(h, layer, config)
     out = _merge_heads(attend(q, k, v), config)
-    x = x + out @ layer["wo"]
+    proj = out @ layer["wo"]
+    if reduce is not None:
+        proj = reduce(proj)
+    x = x + proj
     mlp = mlp or _mlp
-    return x + mlp(_layer_norm(x, layer["ln2_scale"], layer["ln2_bias"]), layer)
+    h2 = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    if promote is not None:
+        h2 = promote(h2)
+    up = mlp(h2, layer)
+    if reduce is not None:
+        up = reduce(up)
+    return x + up
 
 
 def _mlp(x: jax.Array, layer: dict) -> jax.Array:
@@ -232,10 +275,12 @@ def forward(
     attend = attention_fn or _dense_attention
     block = _block
     if remat:
-        # config/attend/mlp are static (hashable, trace-time) arguments
-        block = jax.checkpoint(_block, static_argnums=(2, 3, 4))
+        # config/attend/mlp/reduce/promote are static (hashable) arguments
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
     for layer in params["layers"]:
-        x = block(x, layer, config, attend, mlp)
+        # pass the full arity: jax.checkpoint validates static_argnums
+        # against the actual call's positional args
+        x = block(x, layer, config, attend, mlp, None, None)
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
     # fp32 logits for a stable softmax/cross-entropy downstream
     return jnp.einsum(

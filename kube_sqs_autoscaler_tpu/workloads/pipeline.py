@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe-style microbatched stages over a mesh axis.
+"""Pipeline parallelism: GPipe and 1F1B microbatched stages over a mesh axis.
 
 The reference (``/root/reference``) has no parallelism of any kind
 (SURVEY.md §2 — a single-goroutine Go control loop); this module completes
@@ -15,17 +15,24 @@ the package's parallelism set (dp/tp/sp/ep in :mod:`.train`/:mod:`.ring`/
 - Per-stage compute is a ``lax.scan`` over the stage's stacked layers
   (trace one layer, compile once, no Python unrolling), running the same
   :func:`.model._block` as every other execution path.
-- The remaining mesh axis is ``"data"``: microbatches shard their batch
-  dim over it, so pp x dp composes in one ``jit``.  (Combining pp with
-  tp/sp is a matter of meshes with more axes; embedding/unembedding stay
-  outside the pipelined region and replicate over ``"pipe"``.)
+- The remaining mesh axes are ``"data"`` (microbatches shard their batch
+  dim) and, on a pp x dp x tp mesh, ``"model"``: stage weights carry
+  Megatron column/row-parallel shards and the body places the two
+  ``psum("model")`` all-reduces itself (via :func:`.model._block`'s
+  ``reduce`` seam).  The ``shard_map`` is **fully manual over every mesh
+  axis** — partial-manual mode (``axis_names`` a strict subset) miscompiles
+  bf16 programs in this jax/XLA version (XLA CPU check-failure ``Invalid
+  binary instruction opcode copy``; reproduced minimally), so nothing here
+  relies on it.
 
 The bubble fraction is the usual ``(pipe-1) / (n_micro + pipe - 1)`` —
-raise ``n_microbatches`` to amortize it.
+raise ``n_microbatches`` to amortize it.  The ``"1f1b"`` schedule keeps
+only ``min(M, P)`` stage inputs live instead of GPipe's all-M.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -35,7 +42,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .model import ModelConfig, _block, _dense_attention, _layer_norm, init_params
+from .model import (
+    PARAM_AXES,
+    ModelConfig,
+    _block,
+    _dense_attention,
+    _layer_norm,
+    init_params,
+)
 
 
 @dataclass(frozen=True)
@@ -85,11 +99,37 @@ def stack_layers(params: dict) -> dict:
     """``layers`` list-of-dicts -> one stacked pytree with leading ``[L]``.
 
     The stacked form is what shards over ``"pipe"`` and what ``lax.scan``
-    consumes; stacking order == layer order, and GSPMD's contiguous
-    leading-axis sharding assigns layers ``[i*L/P, (i+1)*L/P)`` to stage
-    ``i`` — the natural pipeline placement.
+    consumes; stacking order == layer order, and contiguous leading-axis
+    sharding assigns layers ``[i*L/P, (i+1)*L/P)`` to stage ``i`` — the
+    natural pipeline placement.
+
+    The fused ``wqkv`` is split into ``wq``/``wk``/``wv``: under the
+    fully-manual pp x tp ``shard_map``, each projection's output axis
+    shards into contiguous head groups (Megatron column-parallel), which a
+    fused ``[D, 3D]`` axis cannot do — a contiguous ``3D/tp`` chunk crosses
+    the q/k/v boundary.  :func:`.model._project_qkv` accepts both layouts.
     """
-    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *params["layers"])
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *params["layers"])
+    wq, wk, wv = jnp.split(stacked.pop("wqkv"), 3, axis=-1)
+    stacked["wq"], stacked["wk"], stacked["wv"] = wq, wk, wv
+    return stacked
+
+
+def unstack_layers(params: dict) -> dict:
+    """Inverse of the pipeline layout: stage stack -> flat ``layers`` list
+    with the fused ``wqkv`` — the layout :func:`.model.forward`, the
+    serving worker, and the decode paths consume.  Used by
+    :meth:`.checkpoint.TrainCheckpointer.restore_params` so pipeline-trained
+    checkpoints serve like any other."""
+    stages = dict(params["stages"])
+    wq, wk, wv = stages.pop("wq"), stages.pop("wk"), stages.pop("wv")
+    stages["wqkv"] = jnp.concatenate([wq, wk, wv], axis=-1)
+    n_layers = next(iter(stages.values())).shape[0]
+    flat = {k: v for k, v in params.items() if k != "stages"}
+    flat["layers"] = [
+        {k: v[i] for k, v in stages.items()} for i in range(n_layers)
+    ]
+    return flat
 
 
 def init_pipeline_params(
@@ -107,9 +147,28 @@ def init_pipeline_params(
     return params
 
 
+def _stage_spec(name: str, with_model: bool) -> P:
+    """PartitionSpec of one stage-stack leaf: leading layer axis over
+    ``"pipe"``; on a pp x tp mesh, the PARAM_AXES Megatron axes over
+    ``"model"`` (column-parallel wq/wk/wv/w_up, row-parallel wo/w_down)."""
+    from .train import _LOGICAL_TO_MESH
+
+    axes = PARAM_AXES.get(name) if with_model else None
+    if axes is None:
+        return P("pipe")
+    return P("pipe", *(_LOGICAL_TO_MESH[a] for a in axes))
+
+
+def stage_partition_specs(stages: dict, mesh: Mesh) -> dict:
+    """Per-leaf ``PartitionSpec`` pytree for the stage stack — the
+    ``shard_map`` in/out specs of the pipelined bodies."""
+    with_model = mesh.shape.get("model", 1) > 1
+    return {k: _stage_spec(k, with_model) for k in stages}
+
+
 def _stage_apply(
     stage_layers: dict, x: jax.Array, config: ModelConfig,
-    remat: bool = False,
+    remat: bool = False, tp_size: int = 1,
 ) -> jax.Array:
     """Run one stage's stacked layers over an activation microbatch.
 
@@ -118,14 +177,105 @@ def _stage_apply(
     keeping every microbatch's every layer resident — on a pipeline
     stage that is the difference between O(M·L/P) and O(M + L/P) live
     activations.
+
+    ``tp_size > 1``: the layer weights are local Megatron shards
+    (contiguous ``n_heads/tp`` heads per projection, ``d_ff/tp`` MLP
+    columns); the block runs on the local head group with Megatron's
+    *f*/*g* conjugate operators hand-placed (:func:`_tp_promote` /
+    :func:`_tp_reduce`) — explicit because the body is fully manual and
+    ``check_vma=False`` AD would otherwise drop the backward all-reduce
+    of ``replicated @ sharded`` matmuls.  Activations stay full
+    ``d_model`` (replicated over ``"model"``) — the classic Megatron
+    dataflow.
     """
-    block = jax.checkpoint(_block, static_argnums=(2, 3)) if remat else _block
+    if tp_size > 1:
+        cfg = dataclasses.replace(
+            config,
+            d_model=config.d_model // tp_size,
+            n_heads=config.n_heads // tp_size,
+        )
+        reduce, promote = _tp_reduce, _tp_promote
+    else:
+        cfg, reduce, promote = config, None, None
+    block = (
+        jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
+        if remat else _block
+    )
 
     def one_layer(h, layer):
-        return block(h, layer, config, _dense_attention), None
+        return block(h, layer, cfg, _dense_attention, None, reduce,
+                     promote), None
 
     out, _ = jax.lax.scan(one_layer, x, stage_layers)
     return out
+
+
+# Megatron's conjugate communication operators, as custom_vjps so the
+# backward collectives are explicit rather than relying on AD's transpose
+# rules for psum under check_vma=False:
+#   g (_tp_reduce):  all-reduce forward, identity backward — closes the
+#                    row-parallel partial sums.
+#   f (_tp_promote): identity forward, all-reduce backward — merges the
+#                    per-shard input cotangents of column-parallel matmuls.
+@jax.custom_vjp
+def _tp_reduce(y: jax.Array) -> jax.Array:
+    return jax.lax.psum(y, "model")
+
+
+def _tp_reduce_fwd(y):
+    return jax.lax.psum(y, "model"), None
+
+
+def _tp_reduce_bwd(_, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+@jax.custom_vjp
+def _tp_promote(y: jax.Array) -> jax.Array:
+    return y
+
+
+def _tp_promote_fwd(y):
+    return y, None
+
+
+def _tp_promote_bwd(_, g):
+    return (jax.lax.psum(g, "model"),)
+
+
+_tp_promote.defvjp(_tp_promote_fwd, _tp_promote_bwd)
+
+
+def _gpipe_tp_boundary(tp_size: int):
+    """Boundary conjugates for differentiating the GPipe body under tp.
+
+    With ``check_vma=False``, ``shard_map``'s AD handles axes a spec does
+    not mention as: *outputs* split their cotangent evenly across the
+    unmentioned axis (each model shard receives ``dy/tp``), and *inputs*
+    ``psum`` their per-shard cotangents over it.  Both conventions are
+    measured behavior (pinned by
+    ``tests/test_pipeline.py::test_gpipe_tp_grads_match_no_tp_truth``)
+    and both are wrong for our replicated-over-``"model"`` activations,
+    so the body wraps its input/output with explicit inverses:
+
+    - ``share`` (input): identity forward; backward divides by tp so the
+      in-spec's psum over ``"model"`` restores the true cotangent.
+    - ``unsplit`` (output): identity forward; backward psums the split
+      ``dy/tp`` shards back into the full ``dy`` on every shard — which
+      is exactly Megatron's *f* operator, so :func:`_tp_promote` is
+      reused rather than redefined.
+    """
+
+    @jax.custom_vjp
+    def share(x):
+        return x
+
+    share.defvjp(lambda x: (x, None), lambda _, g: (g / tp_size,))
+
+    return share, _tp_promote
 
 
 def _pipeline_body(
@@ -137,20 +287,34 @@ def _pipeline_body(
     axis_name: str,
     axis_size: int,
     remat: bool = False,
+    tp_size: int = 1,
 ) -> jax.Array:
-    """Per-device GPipe schedule (inside ``shard_map``).
+    """Per-device GPipe schedule (inside a fully-manual ``shard_map``).
 
-    ``stage_layers``: this stage's ``[L/P, ...]`` slice of the stack.
-    ``x_micro``: embedded microbatches ``[M, B_m, S, D]`` (replicated over
-    ``"pipe"``; stage 0 is the only reader, but keeping the buffer
-    everywhere makes the schedule a pure lockstep loop).  Returns the
-    fully-processed microbatches, replicated back over ``"pipe"``.
+    ``stage_layers``: this stage's ``[L/P, ...]`` slice of the stack
+    (tp-sharded leaves when ``tp_size > 1``).
+    ``x_micro``: embedded microbatches ``[M, B_loc, S, D]`` (replicated
+    over ``"pipe"``/``"model"``, batch-sharded over ``"data"``; stage 0 is
+    the only reader, but keeping the buffer everywhere makes the schedule
+    a pure lockstep loop).  Returns the fully-processed microbatches with
+    the same layout.
     """
     stage = jax.lax.axis_index(axis_name)
     last = axis_size - 1
 
-    # carried activations diverge per stage; with check_vma=False on the
-    # (partial-manual) shard_map no varying-type annotation is needed
+    if tp_size > 1:
+        share, unsplit = _gpipe_tp_boundary(tp_size)
+        x_micro = share(x_micro)
+        # replicated stage leaves (layernorm scales/biases, in-spec
+        # P("pipe")) also see the in-spec psum over "model" on identical
+        # per-shard cotangents — share() divides it back out.  Leaves with
+        # a "model" dimension in their spec transpose shard-locally and
+        # stay untouched.
+        stage_layers = {
+            k: (v if "model" in _stage_spec(k, True) else share(v))
+            for k, v in stage_layers.items()
+        }
+
     act0 = x_micro[0] * 0.0
     out0 = x_micro * 0.0
 
@@ -158,7 +322,9 @@ def _pipeline_body(
         act_in, outputs = carry
         fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, fresh, act_in)
-        act_out = _stage_apply(stage_layers, inp, config, remat=remat)
+        act_out = _stage_apply(
+            stage_layers, inp, config, remat=remat, tp_size=tp_size
+        )
 
         out_idx = jnp.clip(t - last, 0, n_micro - 1)
         outputs = jnp.where(
@@ -176,9 +342,12 @@ def _pipeline_body(
     )
     # only the last stage wrote real outputs; psum broadcasts them to all
     # stages so the result is replicated over "pipe" (out_specs P(None,...))
-    return jax.lax.psum(
+    result = jax.lax.psum(
         jnp.where(stage == last, outputs, jnp.zeros_like(outputs)), axis_name
     )
+    if tp_size > 1:
+        result = unsplit(result)
+    return result
 
 
 def one_f_one_b_schedule(
@@ -278,6 +447,7 @@ def pipeline_forward(
     x = params["embed"][tokens] + params["pos_embed"][:seq]
 
     pipe = mesh.shape["pipe"]
+    tp_size = mesh.shape.get("model", 1)
     body = partial(
         _pipeline_body,
         config=config,
@@ -285,16 +455,20 @@ def pipeline_forward(
         axis_name="pipe",
         axis_size=pipe,
         remat=remat,
+        tp_size=tp_size,
     )
-    # manual over "pipe" only: the schedule's ppermutes/psum are explicit,
-    # while batch/tensor axes stay auto so GSPMD shards the stage matmuls
-    # over "data"/"model" (pp x dp x tp in one program)
+    # FULLY manual over every mesh axis: the schedule's ppermutes/psums
+    # (and, under tp, the Megatron model-axis psums) are all explicit.
+    # Partial-manual mode miscompiles bf16 on this jax/XLA version (see
+    # module docstring), so no axis stays auto.  check_vma=False: the
+    # carried activations diverge per stage and the varying-type algebra
+    # adds nothing once every collective is hand-placed.
     y = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P("pipe"), P(None)),
-        out_specs=P(None),
-        axis_names={"pipe"},
+        in_specs=(stage_partition_specs(params["stages"], mesh),
+                  P(None, "data")),
+        out_specs=P(None, "data"),
         check_vma=False,
     )(params["stages"], x)
 
@@ -334,11 +508,17 @@ def _one_f_one_b_body(
     n_micro: int,
     axis_name: str,
     axis_size: int,
+    data_size: int,
     remat: bool,
+    tp_size: int,
 ):
-    """Per-stage 1F1B schedule (inside a ``shard_map`` manual over
-    ``axis_name`` only — batch/tensor axes stay auto, so GSPMD shards the
-    stage matmuls over ``data``/``model``; pp x dp x tp in one program).
+    """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
+    every mesh axis — see the module docstring for why partial-manual is
+    off the table).  Batch rows are manual over ``"data"`` too, so the
+    loss/grads computed here are per-data-shard means; the epilogue
+    ``psum`` s them over ``"data"`` and divides by ``data_size``, making
+    every output already globally averaged (matching
+    :func:`pipeline_loss_fn`'s all-rows mean exactly).
 
     The backward slot *recomputes* the stage forward from the saved stage
     input and vjp's it immediately (``jax.vjp`` closures cannot be
@@ -346,8 +526,8 @@ def _one_f_one_b_body(
     which is exactly what bounds live activations to the 1F1B in-flight
     cap (min(M, P) stage inputs) instead of GPipe's all-M.
 
-    Returns ``(loss_sum, dstages, dhead, dx_micro)``; the caller divides
-    by M and feeds ``dx_micro`` to the embedding vjp.
+    Returns ``(loss, dstages, dhead, dx_micro)``; the caller divides by M
+    and feeds ``dx_micro`` to the embedding vjp.
     """
     fwd_tbl, bwd_tbl = one_f_one_b_schedule(axis_size, n_micro)
     window = int(min(n_micro, axis_size))
@@ -358,27 +538,13 @@ def _one_f_one_b_body(
     fwd_ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     bwd_ring = [(i, (i - 1) % axis_size) for i in range(axis_size)]
 
-    act_shape = x_micro.shape[1:]  # [B_m, S, D]
+    act_shape = x_micro.shape[1:]  # [B_loc, S, D]
 
     def stage_fwd(layers, x):
-        return _stage_apply(layers, x, config)
+        return _stage_apply(layers, x, config, tp_size=tp_size)
 
     def stage_fwd_remat(layers, x):
-        return _stage_apply(layers, x, config, remat=remat)
-
-    def last_stage_loss(layers, head, x):
-        from .train import next_token_nll
-
-        y = stage_fwd_remat(layers, x)
-        y = _layer_norm(y, head["final_ln_scale"], head["final_ln_bias"])
-        logits = jnp.einsum(
-            "bsd,vd->bsv", y, head["embed"],
-            preferred_element_type=jnp.float32,
-        )
-        # targets for THIS microbatch (closure over the scanned index is
-        # not possible; the token row is indexed dynamically below and
-        # passed in)
-        return logits
+        return _stage_apply(layers, x, config, remat=remat, tp_size=tp_size)
 
     def slot(carry, tables):
         (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
@@ -432,7 +598,14 @@ def _one_f_one_b_body(
                 def loss_of(layers, head, x):
                     from .train import next_token_nll
 
-                    logits = last_stage_loss(layers, head, x)
+                    y = stage_fwd_remat(layers, x)
+                    y = _layer_norm(
+                        y, head["final_ln_scale"], head["final_ln_bias"]
+                    )
+                    logits = jnp.einsum(
+                        "bsd,vd->bsv", y, head["embed"],
+                        preferred_element_type=jnp.float32,
+                    )
                     return next_token_nll(logits, targets)
 
                 loss_m, (dstage, dhead, dx) = jax.value_and_grad(
@@ -503,20 +676,27 @@ def _one_f_one_b_body(
         slot, carry0, tables
     )
 
-    # replicate the pieces only one stage holds
+    # epilogue: replicate the pieces only one stage holds, and average the
+    # per-data-shard means into the global all-rows mean (1/dp).  No psum
+    # over "model": activations/head stay replicated there, so each model
+    # shard already computed identical loss/dhead/dx values.
+    inv_dp = 1.0 / data_size
     loss = jax.lax.psum(
-        jnp.where(stage == last, loss_acc, 0.0), axis_name
+        jnp.where(stage == last, loss_acc, 0.0), (axis_name, "data")
+    ) * inv_dp
+    dstages = jax.tree.map(
+        lambda g: jax.lax.psum(g, "data") * inv_dp, dstage_acc
     )
     dhead = jax.tree.map(
         lambda g: jax.lax.psum(
-            jnp.where(stage == last, g, jnp.zeros_like(g)), axis_name
-        ),
+            jnp.where(stage == last, g, jnp.zeros_like(g)), (axis_name, "data")
+        ) * inv_dp,
         dhead_acc,
     )
     dx_micro = jax.lax.psum(
         jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
-    )
-    return loss, dstage_acc, dhead, dx_micro
+    ) * inv_dp
+    return loss, dstages, dhead, dx_micro
 
 
 def one_f_one_b_value_and_grad(
@@ -530,10 +710,11 @@ def one_f_one_b_value_and_grad(
     """``(loss, grads)`` for the pipelined LM via the 1F1B schedule.
 
     Gradient-equal to ``jax.value_and_grad(pipeline_loss_fn)`` (same math,
-    different schedule/memory profile); the embedding lookup runs outside
-    the pipelined region with its vjp fed by stage 0's input cotangents,
-    while the tied-embedding unembed contribution comes from the last
-    stage — the two are summed here.
+    different schedule/memory profile — asserted by
+    ``tests/test_pipeline.py::test_1f1b_grads_match_gpipe_autodiff``); the
+    embedding lookup runs outside the pipelined region with its vjp fed by
+    stage 0's input cotangents, while the tied-embedding unembed
+    contribution comes from the last stage — the two are summed here.
     """
     n_micro, _, seq = tokens.shape
     if n_micro != pcfg.n_microbatches:
@@ -559,20 +740,22 @@ def one_f_one_b_value_and_grad(
     }
 
     pipe = mesh.shape["pipe"]
+    stage_specs = stage_partition_specs(params["stages"], mesh)
     body = partial(
         _one_f_one_b_body,
         config=config,
         n_micro=pcfg.n_microbatches,
         axis_name="pipe",
         axis_size=pipe,
+        data_size=mesh.shape["data"],
         remat=remat,
+        tp_size=mesh.shape.get("model", 1),
     )
-    loss_sum, dstages, dhead, dx_micro = jax.shard_map(
+    loss, dstages, dhead, dx_micro = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P("pipe"), P(), P(), P()),
-        out_specs=(P(), P("pipe"), P(), P()),
-        axis_names={"pipe"},
+        in_specs=(stage_specs, P(), P(None, "data"), P(None, "data")),
+        out_specs=(P(), stage_specs, P(), P(None, "data")),
         check_vma=False,
     )(params["stages"], head, x_micro, tokens)
 
@@ -595,7 +778,7 @@ def one_f_one_b_value_and_grad(
             dtype_of("final_ln_bias")
         ),
     }
-    return loss_sum * inv_m, grads
+    return loss * inv_m, grads
 
 
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -606,24 +789,18 @@ def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
 def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
     """Stage stacks shard their leading layer axis over ``"pipe"`` — and,
     on a pp x tp mesh, their Megatron axes over ``"model"`` via the same
-    PARAM_AXES rules the non-pipelined trainer uses.
+    PARAM_AXES rules the non-pipelined trainer uses (these NamedShardings
+    agree leaf-for-leaf with :func:`stage_partition_specs`, so device_put
+    placement and the manual body see the same layout).
     Embedding/unembedding/final-LN replicate (they live outside the
     pipelined region)."""
-    from .model import PARAM_AXES
-    from .train import _LOGICAL_TO_MESH
-
-    has_model = "model" in mesh.shape
+    with_model = mesh.shape.get("model", 1) > 1
 
     def param_spec(path, leaf):
         keys = [p.key for p in path if hasattr(p, "key")]
         if "stages" not in keys:
             return NamedSharding(mesh, P())
-        axes = PARAM_AXES.get(keys[-1]) if has_model else None
-        if axes is None:
-            return NamedSharding(mesh, P("pipe"))
-        return NamedSharding(
-            mesh, P("pipe", *(_LOGICAL_TO_MESH[a] for a in axes))
-        )
+        return NamedSharding(mesh, _stage_spec(keys[-1], with_model))
 
     return jax.tree_util.tree_map_with_path(param_spec, params)
 
