@@ -70,9 +70,10 @@ def main(argv=None) -> None:
     # --- model: architecture from the trainer's manifest, or built-in ----
     needed_ctx = max(64, args.seq_len + args.generate_tokens)
     if args.checkpoint_dir:
-        from .checkpoint import load_model_manifest
+        from .checkpoint import load_model_layout, load_model_manifest
 
         family, model_config = load_model_manifest(args.checkpoint_dir)
+        param_layout = load_model_layout(args.checkpoint_dir)
         if family != args.family:
             log.info("Checkpoint manifest says family=%s (overriding CLI)",
                      family)
@@ -118,7 +119,8 @@ def main(argv=None) -> None:
         restore_mesh = mesh or make_mesh(jax.devices()[:1], model_parallel=1)
         checkpointer = TrainCheckpointer(args.checkpoint_dir)
         params = checkpointer.restore_params(restore_mesh, family,
-                                             model_config)
+                                             model_config,
+                                             layout=param_layout)
         log.info("Restored weights from %s step %s", args.checkpoint_dir,
                  checkpointer.latest_step())
     else:

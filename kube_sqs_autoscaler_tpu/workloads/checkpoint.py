@@ -34,17 +34,28 @@ from .train import state_shardings
 MODEL_MANIFEST = "model_config.json"
 
 
-def save_model_manifest(directory: str | Path, family: str, config: Any) -> Path:
+def save_model_manifest(
+    directory: str | Path, family: str, config: Any,
+    layout: dict | None = None,
+) -> Path:
     """Record ``family`` + the config's dimension fields as JSON.
 
     Only JSON-representable fields are kept (``dtype`` is storage policy,
     not architecture — both families default it; a worker restoring the
     params gets the stored dtypes regardless).
+
+    ``layout`` records a non-flat parameter layout — the pipeline trainer
+    passes ``{"kind": "pipeline", "n_stages": N}`` so a serving worker
+    knows the checkpoint stores stage-stacked params (``stages`` with
+    split wq/wk/wv) rather than the flat ``layers`` list, and
+    :meth:`TrainCheckpointer.restore_params` can convert.
     """
     payload = {"family": family}
     for name, value in vars(config).items():
         if isinstance(value, (int, float, str, bool)):
             payload[name] = value
+    if layout is not None:
+        payload["layout"] = layout
     path = Path(directory) / MODEL_MANIFEST
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(payload, indent=2) + "\n")
@@ -54,6 +65,7 @@ def save_model_manifest(directory: str | Path, family: str, config: Any) -> Path
 def load_model_manifest(directory: str | Path) -> tuple[str, Any]:
     """``(family, config)`` from a checkpoint directory's manifest."""
     payload = json.loads((Path(directory) / MODEL_MANIFEST).read_text())
+    payload.pop("layout", None)  # parameter layout, not architecture
     family = payload.pop("family")
     if family == "llama":
         from .llama import LlamaConfig
@@ -62,6 +74,12 @@ def load_model_manifest(directory: str | Path) -> tuple[str, Any]:
     from .model import ModelConfig
 
     return family, ModelConfig(**payload)
+
+
+def load_model_layout(directory: str | Path) -> dict | None:
+    """The manifest's parameter-layout record (``None`` = flat params)."""
+    payload = json.loads((Path(directory) / MODEL_MANIFEST).read_text())
+    return payload.get("layout")
 
 
 class TrainCheckpointer:
@@ -115,7 +133,8 @@ class TrainCheckpointer:
         return self._ckpt.restore(self._path(step), targets)
 
     def restore_params(
-        self, mesh: Mesh, family: str, config: Any, step: int | None = None
+        self, mesh: Mesh, family: str, config: Any, step: int | None = None,
+        layout: dict | None = None,
     ) -> Any:
         """Restore just the model weights, placed for serving on ``mesh``.
 
@@ -124,6 +143,12 @@ class TrainCheckpointer:
         ``params`` subtree (orbax partial restore) — the Adam moments stay
         on disk, so serving startup costs 1x the weights in HBM and I/O,
         not 3x.  Arrays come back with the mesh's PARAM_AXES shardings.
+
+        ``layout`` (from :func:`load_model_layout`) describes a non-flat
+        checkpoint layout: for ``{"kind": "pipeline", ...}`` the stage
+        stack is restored and converted to the flat serving layout
+        (:func:`.pipeline.unstack_layers`) — so any checkpoint serves,
+        regardless of which parallelism trained it.
         """
         from .train import param_shardings
 
@@ -131,7 +156,15 @@ class TrainCheckpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        if family == "llama":
+        pipeline_layout = (layout or {}).get("kind") == "pipeline"
+        if pipeline_layout:
+            from .pipeline import init_pipeline_params, unstack_layers
+
+            def init_fn(key, config):
+                return init_pipeline_params(
+                    key, config, n_stages=int(layout["n_stages"])
+                )
+        elif family == "llama":
             from .llama import init_llama_params
 
             init_fn = init_llama_params
@@ -140,7 +173,17 @@ class TrainCheckpointer:
 
             init_fn = init_params
         reference = jax.eval_shape(lambda: init_fn(jax.random.key(0), config))
-        shardings = param_shardings(mesh, reference)
+        if pipeline_layout:
+            # the serving mesh has no "pipe" axis: restore the stage stack
+            # replicated, convert to the flat layout, then place normally
+            # (one transient replicated copy of the weights at startup)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            shardings = jax.tree.map(
+                lambda _: NamedSharding(mesh, PartitionSpec()), reference
+            )
+        else:
+            shardings = param_shardings(mesh, reference)
         restore_args = jax.tree.map(
             lambda leaf, sharding: ocp.ArrayRestoreArgs(
                 sharding=sharding, global_shape=leaf.shape, dtype=leaf.dtype
@@ -156,4 +199,10 @@ class TrainCheckpointer:
                 partial_restore=True,
             ),
         )
-        return restored["params"]
+        params = restored["params"]
+        if pipeline_layout:
+            from .pipeline import unstack_layers
+
+            params = unstack_layers(params)
+            params = jax.device_put(params, param_shardings(mesh, params))
+        return params

@@ -68,6 +68,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="balanced zig-zag schedule for the seq axis (needs seq-parallel >= 2)",
     )
     parser.add_argument(
+        "--pipe-parallel", type=int, default=1,
+        help="pipeline-parallel stages over a ('pipe','data'[,'model']) "
+             "mesh (gpt family; composes with --model-parallel, not with "
+             "--seq-parallel/--zigzag)",
+    )
+    parser.add_argument(
+        "--pipe-schedule", choices=("gpipe", "1f1b"), default="gpipe",
+        help="gpipe: all-forward-then-all-backward; 1f1b: interleaved, "
+             "min(M, P) live stage inputs",
+    )
+    parser.add_argument(
+        "--pipe-microbatches", type=int, default=4,
+        help="microbatches per step; batch-size must divide by it",
+    )
+    # mixture-of-experts (gpt family)
+    parser.add_argument(
+        "--moe", action="store_true",
+        help="replace the dense MLP with a top-k routed expert MLP "
+             "(expert parallelism over the data axis)",
+    )
+    parser.add_argument("--moe-experts", type=int, default=8)
+    parser.add_argument("--moe-top-k", type=int, default=2)
+    parser.add_argument(
         "--topology-mesh", action="store_true",
         help="order devices along the physical ICI torus (real TPU hardware)",
     )
@@ -104,14 +127,48 @@ def train(args) -> dict:
     )
 
     initialize_from_env()
+    pipe = args.pipe_parallel
+    if pipe > 1:
+        # the pipelined stack is the gpt family sharded over a dedicated
+        # ("pipe","data"[,"model"]) mesh; seq/zigzag/MoE don't compose
+        # with it (yet) and fail fast rather than silently ignore flags
+        for flag, bad in (("--family llama", args.family == "llama"),
+                          ("--seq-parallel > 1", args.seq_parallel > 1),
+                          ("--zigzag", args.zigzag),
+                          ("--moe", args.moe),
+                          ("--topology-mesh", args.topology_mesh),
+                          ("--grad-accum > 1", args.grad_accum > 1)):
+            if bad:
+                raise SystemExit(
+                    f"--pipe-parallel does not combine with {flag}"
+                )
+        if args.batch_size % args.pipe_microbatches:
+            raise SystemExit(
+                f"--batch-size {args.batch_size} not divisible by "
+                f"--pipe-microbatches {args.pipe_microbatches}"
+            )
+    if args.moe and args.family == "llama":
+        raise SystemExit("--moe is gpt-family only")
+    if args.moe and args.zigzag:
+        raise SystemExit(
+            "--moe does not combine with --zigzag (the MoE loss runs the "
+            "seam's ring attention; a zig-zag schedule would be silently "
+            "dropped)"
+        )
     train_config = TrainConfig(
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps, remat=args.remat,
         grad_accum=args.grad_accum,
     )
-    mesh_fn = make_topology_mesh if args.topology_mesh else make_mesh
-    mesh = mesh_fn(model_parallel=args.model_parallel,
-                   seq_parallel=args.seq_parallel)
+    if pipe > 1:
+        from .pipeline import make_pipeline_mesh
+
+        mesh = make_pipeline_mesh(pipe_parallel=pipe,
+                                  model_parallel=args.model_parallel)
+    else:
+        mesh_fn = make_topology_mesh if args.topology_mesh else make_mesh
+        mesh = mesh_fn(model_parallel=args.model_parallel,
+                       seq_parallel=args.seq_parallel)
     log.info("Mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
     # per-family d_ff default: llama's SwiGLU convention differs from the
@@ -144,10 +201,34 @@ def train(args) -> dict:
             n_heads=args.n_heads, n_layers=args.n_layers, d_ff=d_ff,
             max_seq_len=args.seq_len,
         )
-        state = place_state(
-            mesh, init_train_state(jax.random.key(args.seed), model_config,
-                                   train_config)
-        )
+        if pipe > 1:
+            from .pipeline import (
+                init_pipeline_train_state,
+                place_pipeline_state,
+            )
+
+            state = place_pipeline_state(
+                mesh,
+                init_pipeline_train_state(
+                    jax.random.key(args.seed), model_config, train_config,
+                    n_stages=pipe,
+                ),
+            )
+        elif args.moe:
+            from .moe import MoeConfig, init_moe_train_state
+
+            moe_config = MoeConfig(n_experts=args.moe_experts,
+                                   top_k=args.moe_top_k)
+            state = place_state(
+                mesh,
+                init_moe_train_state(jax.random.key(args.seed), model_config,
+                                     moe_config, train_config),
+            )
+        else:
+            state = place_state(
+                mesh, init_train_state(jax.random.key(args.seed), model_config,
+                                       train_config)
+            )
     log.info("Model: %s parameters", f"{param_count(state['params']):,}")
 
     checkpointer = (
@@ -155,10 +236,7 @@ def train(args) -> dict:
     )
     if checkpointer:
         latest = checkpointer.latest_step()
-        if args.resume and latest is not None:
-            state = checkpointer.restore(mesh, state)
-            log.info("Resumed from checkpoint step %d", latest)
-        elif latest is not None:
+        if latest is not None and not args.resume:
             # fail fast: orbax refuses to overwrite an existing step, so
             # without --resume this run would crash at its first save —
             # after training for checkpoint_every steps.  Checked BEFORE
@@ -171,27 +249,54 @@ def train(args) -> dict:
         # train→serve handoff: record the architecture next to the
         # checkpoints so a serving worker pointed at this directory can
         # reconstruct the exact model without repeating these flags.  On
-        # resume an existing manifest must MATCH, never be overwritten.
-        from .checkpoint import MODEL_MANIFEST, load_model_manifest, \
-            save_model_manifest
+        # resume an existing manifest must MATCH, never be overwritten —
+        # and the check runs BEFORE the orbax restore, so a layout or
+        # architecture mismatch is a one-line SystemExit, not a pytree
+        # error deep inside orbax.
+        from .checkpoint import MODEL_MANIFEST, load_model_layout, \
+            load_model_manifest, save_model_manifest
 
+        layout = (
+            {"kind": "pipeline", "n_stages": pipe} if pipe > 1 else None
+        )
         manifest_path = Path(args.checkpoint_dir) / MODEL_MANIFEST
         if manifest_path.exists():
             prior_family, prior_config = load_model_manifest(
                 args.checkpoint_dir
             )
-            if (prior_family, prior_config) != (args.family, model_config):
+            prior_layout = load_model_layout(args.checkpoint_dir)
+            if (prior_family, prior_config, prior_layout) != (
+                args.family, model_config, layout
+            ):
                 raise SystemExit(
                     f"checkpoint dir {args.checkpoint_dir} was written by a "
-                    f"{prior_family} run with {prior_config}; this run's "
-                    f"flags describe a different model ({args.family}, "
-                    f"{model_config})"
+                    f"{prior_family} run with {prior_config} "
+                    f"(layout={prior_layout}); this run's flags describe a "
+                    f"different model ({args.family}, {model_config}, "
+                    f"layout={layout})"
                 )
         else:
             save_model_manifest(args.checkpoint_dir, args.family,
-                                model_config)
+                                model_config, layout=layout)
+        if args.resume and latest is not None:
+            state = checkpointer.restore(mesh, state)
+            log.info("Resumed from checkpoint step %d", latest)
 
-    if args.zigzag:
+    if pipe > 1:
+        from .pipeline import PipelineConfig, make_pipeline_train_step
+
+        pipe_config = PipelineConfig(
+            n_microbatches=args.pipe_microbatches,
+            schedule=args.pipe_schedule,
+        )
+        step_fn = make_pipeline_train_step(mesh, model_config, pipe_config,
+                                           train_config, state)
+    elif args.moe:
+        from .moe import make_moe_train_step
+
+        step_fn = make_moe_train_step(mesh, model_config, moe_config,
+                                      train_config, state)
+    elif args.zigzag:
         from .zigzag import make_zigzag_train_step
 
         forward_fn = None
@@ -229,7 +334,17 @@ def train(args) -> dict:
         # real corpus source should instead checkpoint its own cursor.)
         for _ in range(start_step):
             next(stream)
-    batches = prefetch_to_mesh(stream, batch_sharding(mesh))
+    if pipe > 1:
+        from .pipeline import pipeline_batch_sharding
+
+        # microbatch-major [M, B/M, S]: the pipelined step's batch type
+        m = args.pipe_microbatches
+        stream = (
+            b.reshape(m, b.shape[0] // m, b.shape[1]) for b in stream
+        )
+        batches = prefetch_to_mesh(stream, pipeline_batch_sharding(mesh))
+    else:
+        batches = prefetch_to_mesh(stream, batch_sharding(mesh))
 
     log_every = max(1, args.log_every)
     # throughput is per logging interval (the float(loss) fetch below is

@@ -138,3 +138,66 @@ def test_worker_sharded_demo_runs(tmp_path):
     worker_main(["--demo", "8", "--checkpoint-dir", ckpt,
                  "--model-parallel", "2", "--batch-size", "4",
                  "--seq-len", "16", "--generate-tokens", "4"])
+
+
+def test_pipeline_trained_checkpoint_serves(tmp_path):
+    """pp-trained checkpoints close the train→serve loop too: the manifest
+    records the stage-stacked layout, restore_params converts it to the
+    flat layers/wqkv serving layout, and the converted weights produce the
+    same logits as the pipelined forward did at train time."""
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        load_model_layout,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import forward
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+
+    ckpt = str(tmp_path / "ckpt")
+    result = trainer_main(
+        TINY_TRAIN + ["--steps", "2", "--pipe-parallel", "2",
+                      "--pipe-microbatches", "2", "--checkpoint-dir", ckpt]
+    )
+    assert result["final_step"] == 2
+    layout = load_model_layout(ckpt)
+    assert layout == {"kind": "pipeline", "n_stages": 2}
+
+    man_family, config = load_model_manifest(ckpt)
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    served = TrainCheckpointer(ckpt).restore_params(
+        mesh, man_family, config, layout=layout
+    )
+    # flat serving layout, fused wqkv
+    assert "layers" in served and "stages" not in served
+    assert "wqkv" in served["layers"][0]
+    assert len(served["layers"]) == config.n_layers
+
+    # trained weights, not init: compare against the pipeline init
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        init_pipeline_params,
+        unstack_layers,
+    )
+
+    fresh = unstack_layers(
+        init_pipeline_params(jax.random.key(0), config, n_stages=2)
+    )
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(served), jax.tree.leaves(fresh))
+    )
+
+    # the worker binary serves it end to end
+    worker_main(["--demo", "4", "--checkpoint-dir", ckpt,
+                 "--batch-size", "4", "--seq-len", "16"])
+
+    tokens = jax.random.randint(jax.random.key(3), (2, 16), 0,
+                                config.vocab_size, jnp.int32)
+    assert np.isfinite(np.asarray(forward(served, tokens, config))).all()
+
+
+def test_resume_pipeline_dir_without_pipe_flag_fails_fast(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    trainer_main(TINY_TRAIN + ["--steps", "2", "--pipe-parallel", "2",
+                               "--pipe-microbatches", "2",
+                               "--checkpoint-dir", ckpt])
+    with pytest.raises(SystemExit, match="layout"):
+        trainer_main(TINY_TRAIN + ["--steps", "1", "--checkpoint-dir", ckpt,
+                                   "--resume"])

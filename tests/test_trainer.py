@@ -95,3 +95,47 @@ def test_trainer_profile_writes_trace(tmp_path):
                                 "--profile-dir", str(tmp_path)])
     assert result["final_step"] == 2
     assert any(p.is_file() for p in tmp_path.rglob("*")), "no trace written"
+
+
+def test_trainer_pipeline_gpipe_learns():
+    # pp from the binary: pp2 x dp4 mesh, GPipe schedule
+    result = main(TINY_FLAGS + ["--steps", "4", "--pipe-parallel", "2",
+                                "--pipe-microbatches", "2", "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_pipeline_1f1b_with_tp_learns():
+    # pp2 x dp2 x tp2 + the explicitly-scheduled 1F1B backward
+    result = main(TINY_FLAGS + ["--steps", "4", "--pipe-parallel", "2",
+                                "--model-parallel", "2",
+                                "--pipe-schedule", "1f1b",
+                                "--pipe-microbatches", "2", "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_pipeline_flag_conflicts_fail_fast():
+    with pytest.raises(SystemExit, match="--zigzag"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
+                           "--seq-parallel", "1", "--zigzag"])
+    with pytest.raises(SystemExit, match="--moe"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe"])
+    with pytest.raises(SystemExit, match="not divisible"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
+                           "--pipe-microbatches", "3"])
+
+
+def test_trainer_moe_learns():
+    # ep from the binary: top-2 routed expert MLP over the data axis
+    result = main(TINY_FLAGS + ["--steps", "4", "--moe",
+                                "--moe-experts", "4", "--moe-top-k", "2",
+                                "--model-parallel", "2", "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
