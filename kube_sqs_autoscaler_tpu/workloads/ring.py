@@ -44,13 +44,21 @@ def online_update(o, l, m, scores, v_blk):
     """Numerically-stable online-softmax merge of one fp32 score block
     into running ``(o, l, m)`` accumulators.  The single implementation
     both ring schedules (:mod:`.ring`, :mod:`.zigzag`) use — the
-    stability-sensitive math lives in exactly one place."""
+    stability-sensitive math lives in exactly one place.
+
+    Statistics (max/sum/exp) stay fp32; the probability-times-value
+    matmul runs with the probabilities cast to ``v``'s storage dtype and
+    fp32 accumulation (``preferred_element_type``) — under bf16 that is
+    the MXU fast path, and exactly the rounding the dense path
+    (:func:`.model._dense_attention`) applies to its probabilities, so
+    ring == dense holds bit-for-bit-comparably in either dtype."""
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
     p = jnp.exp(scores - m_new)
     correction = jnp.exp(m - m_new)
     l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
     o_new = o * correction + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
     )
     return o_new, l_new, m_new
 
@@ -88,14 +96,14 @@ def _ring_attention_local(
     groups = heads // k.shape[1]
     my_index = jax.lax.axis_index(axis_name)
 
-    q32 = q.astype(jnp.float32)
     scale = 1.0 / (head_dim**0.5)
     local_positions = jnp.arange(seq_local)
     q_positions = my_index * seq_local + local_positions  # global q rows
 
     # accumulators derived from q so they carry q's "varying over mesh axes"
     # type (plain zeros/full literals are unvarying and trip shard_map's
-    # scan-carry type check)
+    # scan-carry type check); fp32 statistics regardless of input dtype
+    q32 = q.astype(jnp.float32)
     o0 = q32 * 0.0
     l0 = q32[..., :1] * 0.0
     m0 = q32[..., :1] * 0.0 + _NEG_INF
@@ -107,11 +115,16 @@ def _ring_attention_local(
         kv_index = (my_index - step_index) % axis_size
         k_positions = kv_index * seq_local + local_positions
 
+        # q/k enter the score matmul in their storage dtype with fp32
+        # accumulation — bf16 inputs ride the MXU fast path (same
+        # convention as the dense path and the flash kernel); the 1/sqrt(D)
+        # scale folds in afterwards, in fp32
         scores = (
             jnp.einsum(
                 "bhqd,bhkd->bhqk",
-                q32,
-                expand_kv(k_blk, groups).astype(jnp.float32),
+                q,
+                expand_kv(k_blk, groups),
+                preferred_element_type=jnp.float32,
             )
             * scale
         )

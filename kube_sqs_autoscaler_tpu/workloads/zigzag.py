@@ -96,13 +96,17 @@ def _zigzag_attention_local(
     groups = q.shape[1] // k.shape[1]
     my_index = jax.lax.axis_index(axis_name)
 
-    q32 = q.astype(jnp.float32) * (1.0 / head_dim**0.5)
+    scale = 1.0 / head_dim**0.5
     local = jnp.arange(chunk)
     # global positions of this device's two chunks (low: d, high: 2P-1-d)
     pos_lo = my_index * chunk + local
     pos_hi = (2 * axis_size - 1 - my_index) * chunk + local
     q_positions = jnp.concatenate([pos_lo, pos_hi])
 
+    # fp32 statistics; q/k stay in storage dtype for the score matmuls
+    # (bf16 MXU fast path with fp32 accumulation, the dense-path and
+    # flash-kernel convention) and the scale folds in afterwards in fp32
+    q32 = q.astype(jnp.float32)
     o0 = q32 * 0.0
     l0 = q32[..., :1] * 0.0
     m0 = q32[..., :1] * 0.0 + _NEG_INF
@@ -111,8 +115,9 @@ def _zigzag_attention_local(
         return jnp.einsum(
             "bhqd,bhkd->bhqk",
             q_part,
-            expand_kv(k_part, groups).astype(jnp.float32),
-        )
+            expand_kv(k_part, groups),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     def step(carry, step_index):
         o, l, m, k_blk, v_blk = carry
@@ -121,7 +126,7 @@ def _zigzag_attention_local(
         def diag(o, l, m):
             # own k/v: the only masked block (both causal diagonals);
             # k positions == q_positions since kv_index == my_index here
-            scores = scores_for(q32, k_blk)
+            scores = scores_for(q, k_blk)
             causal = q_positions[:, None] >= q_positions[None, :]
             return online_update(
                 o, l, m, jnp.where(causal, scores, _NEG_INF),
@@ -131,7 +136,7 @@ def _zigzag_attention_local(
         def from_earlier(o, l, m):
             # e < d: every local q attends the early chunk, none the late
             # one — half the matmul, no mask
-            scores = scores_for(q32, k_blk[:, :, :chunk])
+            scores = scores_for(q, k_blk[:, :, :chunk])
             return online_update(
                 o, l, m, scores, expand_kv(v_blk[:, :, :chunk], groups)
             )
@@ -139,7 +144,7 @@ def _zigzag_attention_local(
         def from_later(o, l, m):
             # e > d: only the late local queries attend, to both chunks —
             # half the matmul, no mask; early-q accumulators pass through
-            scores = scores_for(q32[:, :, chunk:], k_blk)
+            scores = scores_for(q[:, :, chunk:], k_blk)
             o_hi, l_hi, m_hi = online_update(
                 o[:, :, chunk:], l[:, :, chunk:], m[:, :, chunk:],
                 scores, expand_kv(v_blk, groups),

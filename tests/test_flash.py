@@ -88,13 +88,19 @@ def test_flash_rejects_non_tiling_seq():
 
 
 def test_attention_fn_for_dispatch():
-    assert attention_fn_for(256, backend="tpu") is flash_attention
+    from kube_sqs_autoscaler_tpu.workloads.flash import FLASH_MIN_SEQ
+
+    assert attention_fn_for(FLASH_MIN_SEQ, backend="tpu") is flash_attention
+    assert attention_fn_for(4096, backend="tpu") is flash_attention
+    # below the measured crossover dense wins: never pick the kernel there
+    assert attention_fn_for(FLASH_MIN_SEQ // 2,
+                            backend="tpu") is _dense_attention
     assert attention_fn_for(64, backend="tpu") is _dense_attention  # small
-    assert attention_fn_for(200, backend="tpu") is _dense_attention  # odd
+    assert attention_fn_for(1200, backend="tpu") is _dense_attention  # odd
     # off TPU the kernel would run in the Python-speed interpreter: never
     # auto-dispatch it onto a serving hot path
-    assert attention_fn_for(256, backend="cpu") is _dense_attention
-    assert attention_fn_for(256) is _dense_attention  # this suite runs on CPU
+    assert attention_fn_for(FLASH_MIN_SEQ, backend="cpu") is _dense_attention
+    assert attention_fn_for(FLASH_MIN_SEQ) is _dense_attention  # CPU suite
 
 
 def test_block_auto_selection():

@@ -188,7 +188,8 @@ def test_llama_attention_fn_for_selects_and_matches_dense():
     # so the compact k/v stream straight into the kernel
     from kube_sqs_autoscaler_tpu.workloads import flash
 
-    tpu_attend = llama_attention_fn_for(TINY, 256, backend="tpu")
+    tpu_attend = llama_attention_fn_for(TINY, flash.FLASH_MIN_SEQ,
+                                        backend="tpu")
     assert tpu_attend is flash.flash_attention
 
 

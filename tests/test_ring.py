@@ -134,3 +134,19 @@ def test_ring_gqa_matches_broadcast_dense():
         np.asarray(ring_fn(q, k, v)), np.asarray(expected),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_ring_matches_dense_bf16():
+    # the production dtype: bf16 q/k/v take the MXU fast path (storage
+    # dtype into the score matmul, fp32 accumulation, probs rounded to
+    # bf16 for the value matmul) — the same convention as the dense path,
+    # so ring == dense stays tight even in bf16
+    mesh = make_mesh(jax.devices(), model_parallel=1, seq_parallel=4)
+    q, k, v = qkv(dtype=jnp.bfloat16)
+    expected = dense_causal_attention(q, k, v)
+    actual = jax.jit(make_ring_attention(mesh))(q, k, v)
+    assert actual.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(expected, np.float32), np.asarray(actual, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )

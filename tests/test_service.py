@@ -151,7 +151,10 @@ def test_queue_worker_with_flash_attention_forward():
     )
     from kube_sqs_autoscaler_tpu.workloads.model import forward
 
-    assert attention_fn_for(128, backend="tpu") is flash_attention
+    # on TPU the kernel is picked from the measured crossover up, and
+    # never below it (where dense measures faster)
+    assert attention_fn_for(2048, backend="tpu") is flash_attention
+    assert attention_fn_for(128, backend="tpu") is not flash_attention
     config = ModelConfig(
         vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
         max_seq_len=128,

@@ -233,3 +233,34 @@ def test_llama_zigzag_loss_matches_llama_dense_loss():
                        forward_fn=llama_forward)
     )
     np.testing.assert_allclose(zz, dense, rtol=2e-5)
+
+
+def test_zigzag_matches_dense_bf16():
+    # bf16 MXU convention (storage-dtype score matmuls, fp32 stats) must
+    # keep zig-zag == dense within bf16 rounding
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.ring import dense_causal_attention
+    from kube_sqs_autoscaler_tpu.workloads.zigzag import (
+        inverse_permutation,
+        make_zigzag_ring_attention,
+        zigzag_permutation,
+    )
+
+    mesh = make_mesh(jax.devices(), model_parallel=1, seq_parallel=4)
+    keys = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (
+        jax.random.normal(kk, (2, 4, 32, 16), jnp.bfloat16) for kk in keys
+    )
+    perm = zigzag_permutation(32, 4)
+    inv = inverse_permutation(perm)
+    expected = dense_causal_attention(q, k, v)
+    zz = jax.jit(make_zigzag_ring_attention(mesh))(
+        q[:, :, perm], k[:, :, perm], v[:, :, perm]
+    )
+    actual = zz[:, :, inv]
+    assert actual.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(expected, np.float32), np.asarray(actual, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )

@@ -54,9 +54,10 @@ def _sync(out) -> None:
 
 def _time_compiled(fn, *args, iters: int, warmup: int = 2) -> float:
     """Steady-state seconds/call (host-fetch fence on the last result)."""
-    for _ in range(warmup):
-        out = fn(*args)
-    _sync(out)
+    if warmup:
+        for _ in range(warmup):
+            out = fn(*args)
+        _sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
@@ -163,12 +164,39 @@ def bench_attention(seq: int, iters: int) -> dict:
 
     flash_fn = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
     dense_fn = jax.jit(jax.value_and_grad(loss_dense, argnums=(0, 1, 2)))
-    flash_s = _time_compiled(flash_fn, q, k, v, iters=iters)
-    dense_s = _time_compiled(dense_fn, q, k, v, iters=iters)
+    # the tunnel's step timing drifts run-to-run by 2x on small shapes;
+    # interleaved repeats + medians cancel the drift so the recorded
+    # crossover is the kernel's, not the session's
+    import statistics
+
+    _time_compiled(flash_fn, q, k, v, iters=2)
+    _time_compiled(dense_fn, q, k, v, iters=2)
+    flash_reps, dense_reps = [], []
+    for _ in range(5):
+        flash_reps.append(_time_compiled(flash_fn, q, k, v, iters=iters,
+                                         warmup=0))
+        dense_reps.append(_time_compiled(dense_fn, q, k, v, iters=iters,
+                                         warmup=0))
+    flash_s = statistics.median(flash_reps)
+    dense_s = statistics.median(dense_reps)
+    # what the training/serving hot path actually runs at this S: the
+    # dispatcher (attention_fn_for) picks flash only past its measured
+    # crossover, so the hot-path speedup is >= 1.0 by construction — the
+    # raw kernel numbers above are the kernel's own scorecard
+    from kube_sqs_autoscaler_tpu.workloads.flash import attention_fn_for
+
+    picked = (
+        "flash"
+        if attention_fn_for(seq, backend="tpu") is flash_attention
+        else "dense"
+    )
+    hot_path = dense_s / flash_s if picked == "flash" else 1.0
     return {
         "flash_fwdbwd_ms": flash_s * 1e3,
         "dense_fwdbwd_ms": dense_s * 1e3,
         "speedup": dense_s / flash_s,
+        "dispatched": picked,
+        "hot_path_speedup": hot_path,
     }
 
 
@@ -215,6 +243,7 @@ def main(argv=None) -> dict:
             (f"flash_fwdbwd_ms_s{seq}", att["flash_fwdbwd_ms"], "ms"),
             (f"dense_fwdbwd_ms_s{seq}", att["dense_fwdbwd_ms"], "ms"),
             (f"flash_speedup_s{seq}", att["speedup"], "x"),
+            (f"attn_hot_path_speedup_s{seq}", att["hot_path_speedup"], "x"),
         ]
     for name, value, unit in metrics:
         print(json.dumps({
