@@ -57,6 +57,11 @@ def main(argv=None) -> None:
              "tensor-parallel degree (0 = single chip)",
     )
     parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve /metrics with serve-cycle latency summaries "
+             "(p50/p99/max from the worker's SpanTimer; 0 = disabled)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -151,7 +156,9 @@ def main(argv=None) -> None:
             _, _, gen = make_serving_fns(mesh, model_config, params)
         worker_kwargs = {
             "forward_fn": fwd,
-            "generate_fn": lambda p, t, n: gen(p, t, jax.random.key(0), n),
+            "generate_fn": lambda p, t, n, lengths: gen(
+                p, t, jax.random.key(0), lengths, n
+            ),
         }
     elif family == "llama":
         from .flash import attention_fn_for
@@ -161,16 +168,19 @@ def main(argv=None) -> None:
             llama_generate_jit,
         )
 
-        # flash kernel on TPU when seq_len tiles onto the MXU blocks —
-        # for both the classify forward and the generate-mode prefill
-        attend = llama_attention_fn_for(model_config, args.seq_len)
-        prompt_attention = attention_fn_for(args.seq_len)
+        # attention picked per BATCH BUCKET length (the worker pads to
+        # power-of-two buckets, and the flash/dense crossover is decided
+        # by the actual padded length, not --seq-len) — same policy as
+        # the gpt family's default forward in service.QueueWorker
         worker_kwargs = {
             "forward_fn": lambda p, t: llama_forward_jit_with(
-                p, t, model_config, attend
+                p, t, model_config,
+                llama_attention_fn_for(model_config, t.shape[1]),
             ),
-            "generate_fn": lambda p, t, n: llama_generate_jit(
-                p, t, n, model_config, prompt_attention=prompt_attention
+            "generate_fn": lambda p, t, n, lengths: llama_generate_jit(
+                p, t, n, model_config,
+                prompt_attention=attention_fn_for(t.shape[1]),
+                lengths=lengths,
             ),
         }
     service_config = ServiceConfig(
@@ -191,14 +201,18 @@ def main(argv=None) -> None:
         service_config.queue_url = "demo://queue"
         worker = QueueWorker(queue, params, model_config, service_config,
                              **worker_kwargs)
+        obs = _maybe_serve_metrics(args.metrics_port, worker)
         start = time.perf_counter()
         while worker.processed < args.demo:
-            worker.run_once()
+            with worker.timer.span("cycle"):
+                worker.run_once()
         elapsed = time.perf_counter() - start
         log.info(
             "Processed %d messages in %.2fs (%.1f msg/s)",
             worker.processed, elapsed, worker.processed / elapsed,
         )
+        if obs is not None:
+            obs.stop()
         return
 
     from ..metrics.sqs_aws import AwsSqsService
@@ -206,8 +220,23 @@ def main(argv=None) -> None:
     queue = AwsSqsService(region=args.aws_region)
     worker = QueueWorker(queue, params, model_config, service_config,
                          **worker_kwargs)
+    _maybe_serve_metrics(args.metrics_port, worker)
     log.info("Starting worker on %s", args.sqs_queue_url)
     worker.run_forever()
+
+
+def _maybe_serve_metrics(port: int, worker):
+    """Start /metrics with the worker's serve-cycle SpanTimer attached
+    (``--metrics-port 0`` = disabled)."""
+    if not port:
+        return None
+    from ..obs import ObservabilityServer, WorkloadMetrics
+
+    metrics = WorkloadMetrics()
+    metrics.attach_timer("worker", worker.timer)
+    server = ObservabilityServer(metrics, port=port)
+    server.start()
+    return server
 
 
 if __name__ == "__main__":

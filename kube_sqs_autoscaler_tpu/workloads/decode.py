@@ -44,7 +44,9 @@ from .model import (
 
 def init_cache(config: ModelConfig, batch: int) -> dict:
     """Empty KV cache: per layer ``[B, H, max_seq_len, head_dim]`` in the
-    model dtype, plus the current ``length`` as a traced-friendly scalar."""
+    model dtype, plus per-row ``length`` (int32 ``[batch]``) — rows may
+    hold prompts of different lengths (ragged batches), each decoding at
+    its own position."""
     shape = (batch, config.n_heads, config.max_seq_len, config.head_dim)
     return {
         "layers": [
@@ -54,16 +56,27 @@ def init_cache(config: ModelConfig, batch: int) -> dict:
             }
             for _ in range(config.n_layers)
         ],
-        "length": jnp.zeros((), jnp.int32),
+        "length": jnp.zeros((batch,), jnp.int32),
     }
 
 
-def _final_logits(params: dict, x: jax.Array) -> jax.Array:
-    """Last-position logits: final LN + tied-embedding readout in fp32."""
+def _final_logits(
+    params: dict, x: jax.Array, last_pos: jax.Array | None = None
+) -> jax.Array:
+    """Readout logits: final LN + tied-embedding readout in fp32.
+
+    ``last_pos`` (int32 ``[batch]``) selects each row's readout position —
+    the last *valid* position of a right-padded row, so a short body is
+    never read out of a pad slot.  ``None`` reads position -1 (all rows
+    full).
+    """
     x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-    )[:, -1]
+    )
+    if last_pos is None:
+        return logits[:, -1]
+    return logits[jnp.arange(logits.shape[0]), last_pos]
 
 
 def prefill(
@@ -71,13 +84,24 @@ def prefill(
     tokens: jax.Array,
     config: ModelConfig,
     attention_fn=None,
+    lengths: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """Run the prompt through the model, populating a fresh cache.
 
-    ``tokens``: int32 ``[batch, prompt_len]`` → (last-position logits
-    ``[batch, vocab]`` fp32, cache at ``length == prompt_len``).  The prompt
-    occupies cache positions ``[0, prompt_len)``; ``attention_fn`` selects
-    the prompt-pass attention (dense default, flash kernel on TPU).
+    ``tokens``: int32 ``[batch, prompt_len]`` → (readout logits
+    ``[batch, vocab]`` fp32, cache at ``length == prompt_len`` per row).
+    The prompt occupies cache positions ``[0, prompt_len)``;
+    ``attention_fn`` selects the prompt-pass attention (dense default,
+    flash kernel on TPU).
+
+    ``lengths`` (int32 ``[batch]``) marks ragged right-padded prompts:
+    row ``i``'s real tokens are ``[0, lengths[i])``.  Causality already
+    keeps real positions from attending pad keys (pads sit *after* every
+    real position), so the forward needs no extra mask — what changes is
+    the readout (each row reads its last valid position, not the pad at
+    -1) and the cache lengths (row ``i`` continues decoding at
+    ``lengths[i]``, overwriting its pad slots; the decode mask hides the
+    still-padded tail).
     """
     batch, prompt_len = tokens.shape
     if prompt_len > config.max_seq_len:
@@ -102,28 +126,32 @@ def prefill(
             return inner(q, k, v)
 
         x = _block(x, layer, config, attend)
-    logits = _final_logits(params, x)
-    return logits, {
-        "layers": new_layers,
-        "length": jnp.asarray(prompt_len, jnp.int32),
-    }
+    if lengths is None:
+        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        logits = _final_logits(params, x)
+    else:
+        row_lengths = lengths.astype(jnp.int32)
+        logits = _final_logits(params, x, last_pos=row_lengths - 1)
+    return logits, {"layers": new_layers, "length": row_lengths}
 
 
 def _cached_attention(
     q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, length: jax.Array
 ) -> jax.Array:
-    """One query position against the padded cache.
+    """One query position per row against the padded cache.
 
-    ``q``: ``[B, H, 1, D]``; cache: ``[B, H, S_max, D]`` with valid entries
-    at positions ``<= length`` (the current token was just written at
-    ``length``). fp32 scores/softmax; masked positions get ``-inf``.
+    ``q``: ``[B, H, 1, D]``; cache: ``[B, H, S_max, D]`` with row ``b``'s
+    valid entries at positions ``<= length[b]`` (the current token was
+    just written at ``length[b]``) — later positions are pads or other
+    rows' leftovers and get ``-inf``.  fp32 scores/softmax.
     """
     head_dim = q.shape[-1]
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
     ) / (head_dim**0.5)
     positions = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    scores = jnp.where(positions <= length, scores, jnp.float32(-jnp.inf))
+    valid = positions <= length[:, None, None, None]
+    scores = jnp.where(valid, scores, jnp.float32(-jnp.inf))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
 
@@ -131,24 +159,26 @@ def _cached_attention(
 def decode_step(
     params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
 ) -> tuple[jax.Array, dict]:
-    """One autoregressive step: feed ``tokens`` (int32 ``[batch]``, the
-    token at position ``cache["length"]``), return (fp32 logits
-    ``[batch, vocab]`` for the next position, updated cache)."""
-    pos = cache["length"]
-    x = params["embed"][tokens][:, None, :] + jnp.take(
-        params["pos_embed"], pos, axis=0
-    )
+    """One autoregressive step: feed ``tokens`` (int32 ``[batch]``, row
+    ``b``'s token for position ``cache["length"][b]``), return (fp32
+    logits ``[batch, vocab]`` for each row's next position, updated
+    cache).  Rows advance independently — a ragged batch decodes in
+    lockstep with per-row positions."""
+    pos = cache["length"]  # [B]
+    batch = tokens.shape[0]
+    rows = jnp.arange(batch)
+    x = params["embed"][tokens][:, None, :] + params["pos_embed"][pos][:, None, :]
     new_layers = []
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            # write this position's k/v at `pos`, then attend the single
-            # query against the whole (masked) cache
-            k_cache = jax.lax.dynamic_update_slice(
-                _lc["k"], k.astype(config.dtype), (0, 0, pos, 0)
+            # write each row's k/v at its own position, then attend the
+            # single query against the whole (row-masked) cache
+            k_cache = _lc["k"].at[rows, :, pos].set(
+                k[:, :, 0].astype(config.dtype)
             )
-            v_cache = jax.lax.dynamic_update_slice(
-                _lc["v"], v.astype(config.dtype), (0, 0, pos, 0)
+            v_cache = _lc["v"].at[rows, :, pos].set(
+                v[:, :, 0].astype(config.dtype)
             )
             new_layers.append({"k": k_cache, "v": v_cache})
             return _cached_attention(q, k_cache, v_cache, pos)
@@ -173,6 +203,7 @@ def generate(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     attention_fn=None,
+    lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Generate ``num_tokens`` continuation tokens for each prompt.
 
@@ -180,6 +211,12 @@ def generate(
     ``rng``.  Pure and jittable end-to-end: prefill once, then a
     ``lax.scan`` of decode steps — one compiled program for the entire
     episode. Returns int32 ``[batch, num_tokens]``.
+
+    ``lengths`` (int32 ``[batch]``) marks ragged right-padded prompts:
+    each row continues from its own last real token — pad slots are
+    overwritten by generated tokens and never attended (see
+    :func:`prefill`) — so a padded batch generates exactly what each
+    prompt would generate unpadded.
     """
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
@@ -196,7 +233,8 @@ def generate(
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    logits, cache = prefill(params, prompt, config, attention_fn)
+    logits, cache = prefill(params, prompt, config, attention_fn,
+                            lengths=lengths)
     first = _pick(logits, keys[0], temperature)
 
     def body(carry, key):
@@ -222,13 +260,14 @@ def generate_jit(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     attention_fn=None,
+    lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
     prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
     own compiled program, exactly like ``model.forward_jit_with``)."""
     return generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
-        attention_fn=attention_fn,
+        attention_fn=attention_fn, lengths=lengths,
     )
 
 
@@ -246,7 +285,8 @@ def cache_shardings(mesh: Mesh, cache: dict) -> dict:
     kv = NamedSharding(mesh, P("data", "model", None, None))
     return {
         "layers": [{"k": kv, "v": kv} for _ in cache["layers"]],
-        "length": NamedSharding(mesh, P()),
+        # per-row lengths ride with their rows
+        "length": NamedSharding(mesh, P("data")),
     }
 
 
@@ -269,10 +309,11 @@ def compile_serving_fns(
     ``generate_fn(params, prompt, num_tokens, temperature, rng)``.
 
     The returned generate fn's signature is ``(params, prompt, rng,
-    num_tokens, temperature=0.0)``, all positional (pjit rejects kwargs
-    when in_shardings is set); rng is required — pass any key under
-    greedy (temperature=0 ignores it), so the sampling path shares the
-    compiled layout.
+    lengths, num_tokens, temperature=0.0)``, all positional (pjit rejects
+    kwargs when in_shardings is set); rng is required — pass any key under
+    greedy (temperature=0 ignores it) — and so are ``lengths`` (pass the
+    full prompt length per row when nothing is padded), so ragged and
+    full batches share the compiled layout.
     """
     from .train import param_shardings
 
@@ -300,13 +341,15 @@ def compile_serving_fns(
         donate_argnums=1,  # reuse the cache buffers step to step
     )
 
-    def _generate(params, prompt, rng, num_tokens, temperature=0.0):
-        return generate_fn(params, prompt, num_tokens, temperature, rng)
+    def _generate(params, prompt, rng, lengths, num_tokens, temperature=0.0):
+        return generate_fn(params, prompt, num_tokens, temperature, rng,
+                           lengths)
 
     generate_jit_fn = jax.jit(
         _generate,
         static_argnames=("num_tokens", "temperature"),
-        in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P())),
+        in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P()),
+                      tokens_1d),
         out_shardings=tokens_2d,
     )
     return prefill_jit, decode_jit, generate_jit_fn
@@ -323,8 +366,9 @@ def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
         template,
         partial(prefill, config=config),
         partial(decode_step, config=config),
-        lambda params, prompt, num_tokens, temperature, rng: generate(
-            params, prompt, num_tokens, config,
-            temperature=temperature, rng=rng,
-        ),
+        lambda params, prompt, num_tokens, temperature, rng, lengths:
+            generate(
+                params, prompt, num_tokens, config,
+                temperature=temperature, rng=rng, lengths=lengths,
+            ),
     )

@@ -334,7 +334,9 @@ def make_llama_train_step(mesh, config: LlamaConfig, train_config,
 
 
 def init_llama_cache(config: LlamaConfig, batch: int) -> dict:
-    """KV cache with only ``n_kv_heads`` heads: the GQA memory win."""
+    """KV cache with only ``n_kv_heads`` heads: the GQA memory win.
+    ``length`` is per-row (int32 ``[batch]``) — ragged batches decode in
+    lockstep at their own positions, like :func:`.decode.init_cache`."""
     shape = (batch, config.n_kv_heads, config.max_seq_len, config.head_dim)
     return {
         "layers": [
@@ -342,15 +344,20 @@ def init_llama_cache(config: LlamaConfig, batch: int) -> dict:
              "v": jnp.zeros(shape, config.dtype)}
             for _ in range(config.n_layers)
         ],
-        "length": jnp.zeros((), jnp.int32),
+        "length": jnp.zeros((batch,), jnp.int32),
     }
 
 
-def _final_logits(params: dict, x: jax.Array) -> jax.Array:
+def _final_logits(
+    params: dict, x: jax.Array, last_pos: jax.Array | None = None
+) -> jax.Array:
     x = _rms_norm(x, params["final_norm"])
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-    )[:, -1]
+    )
+    if last_pos is None:
+        return logits[:, -1]
+    return logits[jnp.arange(logits.shape[0]), last_pos]
 
 
 def llama_prefill(
@@ -358,10 +365,12 @@ def llama_prefill(
     tokens: jax.Array,
     config: LlamaConfig,
     prompt_attention=None,
+    lengths: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """Prompt pass populating a fresh GQA cache (same contract as
-    :func:`.decode.prefill`).  ``prompt_attention`` is an MHA-shaped
-    causal kernel for the prompt pass (dense default; pass
+    :func:`.decode.prefill`, including ragged right-padded prompts via
+    ``lengths``).  ``prompt_attention`` is an MHA-shaped causal kernel
+    for the prompt pass (dense default; pass
     :func:`.flash.attention_fn_for`'s pick on TPU).
     """
     batch, prompt_len = tokens.shape
@@ -387,33 +396,41 @@ def llama_prefill(
         return inner(q, k, v)
 
     logits = llama_forward(params, tokens, config, attention_fn=attend)
-    return (
-        logits[:, -1] if logits.ndim == 3 else logits,
-        {"layers": new_layers, "length": jnp.asarray(prompt_len, jnp.int32)},
-    )
+    if lengths is None:
+        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        readout = logits[:, -1] if logits.ndim == 3 else logits
+    else:
+        row_lengths = lengths.astype(jnp.int32)
+        readout = logits[jnp.arange(batch), row_lengths - 1]
+    return readout, {"layers": new_layers, "length": row_lengths}
 
 
 def llama_decode_step(
     params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
 ) -> tuple[jax.Array, dict]:
-    """One token (int32 ``[batch]``) against the GQA cache; same contract
-    as :func:`.decode.decode_step` (reuses its masked cached-attention
-    math via :func:`.decode._cached_attention`)."""
+    """One token per row (int32 ``[batch]``) against the GQA cache; same
+    contract as :func:`.decode.decode_step` (reuses its masked
+    cached-attention math via :func:`.decode._cached_attention`), with
+    per-row positions."""
     from .decode import _cached_attention
 
-    pos = cache["length"]
+    pos = cache["length"]  # [B]
+    batch = tokens.shape[0]
+    rows = jnp.arange(batch)
     groups = config.n_heads // config.n_kv_heads
-    positions = pos[None]  # RoPE rotates by the absolute position
+    # RoPE rotates by each row's absolute position: [B, 1, 1] broadcasts
+    # against the [B, H, 1, D/2] rotation pairs
+    positions = pos[:, None, None]
     x = params["embed"][tokens][:, None, :]
     new_layers = []
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            k_cache = jax.lax.dynamic_update_slice(
-                _lc["k"], k.astype(config.dtype), (0, 0, pos, 0)
+            k_cache = _lc["k"].at[rows, :, pos].set(
+                k[:, :, 0].astype(config.dtype)
             )
-            v_cache = jax.lax.dynamic_update_slice(
-                _lc["v"], v.astype(config.dtype), (0, 0, pos, 0)
+            v_cache = _lc["v"].at[rows, :, pos].set(
+                v[:, :, 0].astype(config.dtype)
             )
             new_layers.append({"k": k_cache, "v": v_cache})
             return _cached_attention(
@@ -433,10 +450,12 @@ def llama_generate(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     prompt_attention=None,
+    lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Greedy/temperature generation, one compiled program (same contract
-    and scan structure as :func:`.decode.generate`).  ``prompt_attention``
-    selects the prefill kernel (see :func:`llama_prefill`)."""
+    and scan structure as :func:`.decode.generate`, including ragged
+    prompts via ``lengths``).  ``prompt_attention`` selects the prefill
+    kernel (see :func:`llama_prefill`)."""
     from .decode import _pick
 
     batch, prompt_len = prompt.shape
@@ -454,7 +473,8 @@ def llama_generate(
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    logits, cache = llama_prefill(params, prompt, config, prompt_attention)
+    logits, cache = llama_prefill(params, prompt, config, prompt_attention,
+                                  lengths=lengths)
     first = _pick(logits, keys[0], temperature)
 
     def body(carry, key):
@@ -490,10 +510,11 @@ def make_llama_serving_fns(mesh, config: LlamaConfig, params: dict):
         template,
         partial(llama_prefill, config=config),
         partial(llama_decode_step, config=config),
-        lambda params, prompt, num_tokens, temperature, rng: llama_generate(
-            params, prompt, num_tokens, config,
-            temperature=temperature, rng=rng,
-        ),
+        lambda params, prompt, num_tokens, temperature, rng, lengths:
+            llama_generate(
+                params, prompt, num_tokens, config,
+                temperature=temperature, rng=rng, lengths=lengths,
+            ),
     )
 
 
@@ -527,8 +548,9 @@ def llama_generate_jit(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     prompt_attention=None,
+    lengths: jax.Array | None = None,
 ) -> jax.Array:
     return llama_generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
-        prompt_attention=prompt_attention,
+        prompt_attention=prompt_attention, lengths=lengths,
     )

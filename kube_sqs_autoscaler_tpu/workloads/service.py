@@ -10,8 +10,15 @@ loop (queue → autoscaler → Deployment replicas → workers → queue) in one
 process for tests and demos.
 
 Message format: each message body is a JSON array of token ids.  Bodies are
-padded/truncated to the model's configured sequence length so every batch
-hits the same compiled XLA program (static shapes, no recompiles).
+right-padded to a power-of-two **length bucket** (the smallest that holds
+the batch's longest body, capped at ``seq_len``) — short batches run small
+compiled programs instead of always paying the full ``seq_len``, and the
+bucket set is finite so there are at most ``log2(seq_len)`` compiles per
+shape family.  Per-row ``lengths`` travel with every batch: the classify
+readout takes each row's *last valid* position (never a pad slot), and
+generate mode decodes each row from its own prompt length with pad slots
+masked out of the cache — a padded batch produces exactly what each body
+would produce unpadded.
 
 Two compute modes per worker:
 
@@ -95,13 +102,14 @@ class QueueWorker:
         self.params = params
         self.model_config = model_config
         self.config = service_config
-        # default forward picks the attention kernel by sequence length:
-        # the Pallas flash kernel when seq_len tiles onto the MXU blocks,
-        # the dense XLA path for small/odd shapes
-        attention_fn = attention_fn_for(service_config.seq_len)
+        # default forward picks the attention kernel by the BATCH's bucket
+        # length (the Pallas flash kernel when it tiles onto the MXU blocks
+        # and is past the measured crossover, dense otherwise) — one
+        # compiled program per bucket
         self._forward = forward_fn or (
             lambda params, tokens: forward_jit_with(
-                params, tokens, model_config, attention_fn
+                params, tokens, model_config,
+                attention_fn_for(tokens.shape[1]),
             )
         )
         if service_config.generate_tokens > 0:
@@ -111,11 +119,14 @@ class QueueWorker:
                     f"seq_len + generate_tokens = {budget} exceeds the "
                     f"model's max_seq_len={model_config.max_seq_len}"
                 )
-        # the prompt pass uses the same attention selection as classify mode
-        # (flash kernel when seq_len tiles onto the MXU blocks, on TPU)
+        # generate seam: (params, tokens, num_tokens, lengths) — the
+        # per-row lengths let ragged right-padded prompts decode from
+        # their own last real token (see decode.generate)
         self._generate = generate_fn or (
-            lambda params, tokens, n: generate_jit(
-                params, tokens, n, model_config, attention_fn=attention_fn
+            lambda params, tokens, n, lengths: generate_jit(
+                params, tokens, n, model_config,
+                attention_fn=attention_fn_for(tokens.shape[1]),
+                lengths=lengths,
             )
         )
         self._stop = threading.Event()
@@ -126,13 +137,20 @@ class QueueWorker:
     def stop(self) -> None:
         self._stop.set()
 
-    def _batch_tokens(self, bodies: list[str]) -> jnp.ndarray:
-        rows = np.full(
-            (self.config.batch_size, self.config.seq_len),
-            self.config.pad_token,
-            np.int32,
-        )
-        for i, body in enumerate(bodies):
+    MIN_BUCKET = 16  # smallest padded length (keeps the compile-cache tiny)
+
+    def _bucket_len(self, longest: int) -> int:
+        """Smallest power-of-two >= ``longest``, in
+        ``[MIN_BUCKET, seq_len]`` — the batch's padded length."""
+        bucket = self.MIN_BUCKET
+        while bucket < min(longest, self.config.seq_len):
+            bucket *= 2
+        return min(bucket, self.config.seq_len)
+
+    def _batch_tokens(self, bodies: list[str]) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(tokens ``[batch, bucket]``, lengths ``[batch]``) for one batch."""
+        parsed: list[np.ndarray] = []
+        for body in bodies:
             # the whole decode is guarded: a body that is valid JSON but not
             # an integer array ('"abc"', '5', nested lists of strings) must
             # be dropped like non-JSON, not crash the worker — the message
@@ -142,10 +160,19 @@ class QueueWorker:
                 ids = np.asarray(json.loads(body), np.int32).reshape(-1)
             except Exception:
                 log.error("Dropping malformed message body: %.64r", body)
-                continue
-            ids = ids[: self.config.seq_len]
+                ids = np.zeros((0,), np.int32)
+            parsed.append(ids[: self.config.seq_len])
+        bucket = self._bucket_len(max((p.size for p in parsed), default=1))
+        rows = np.full(
+            (self.config.batch_size, bucket), self.config.pad_token, np.int32
+        )
+        # empty/dropped bodies read out position 0 (one pad token) rather
+        # than indexing at -1
+        lengths = np.ones((self.config.batch_size,), np.int32)
+        for i, ids in enumerate(parsed):
             rows[i, : ids.size] = ids
-        return jnp.asarray(rows)
+            lengths[i] = max(1, ids.size)
+        return jnp.asarray(rows), jnp.asarray(lengths)
 
     def run_once(self) -> int:
         """One receive/process/delete cycle. Returns messages processed."""
@@ -156,18 +183,21 @@ class QueueWorker:
         )
         if not messages:
             return 0
-        tokens = self._batch_tokens([m["Body"] for m in messages])
+        tokens, lengths = self._batch_tokens([m["Body"] for m in messages])
         # block so deletion happens strictly after compute succeeds
         # (at-least-once processing: a crash here leaves messages in-flight
         # to reappear after the visibility timeout)
         if self.config.generate_tokens > 0:
             self._generate(
-                self.params, tokens, self.config.generate_tokens
+                self.params, tokens, self.config.generate_tokens, lengths
             ).block_until_ready()
         else:
-            # greedy next token per sequence
+            # greedy next token per sequence, read at each row's last
+            # VALID position — never the pad slot at -1
             logits = self._forward(self.params, tokens)
-            jnp.argmax(logits[:, -1, :], axis=-1).block_until_ready()
+            jnp.argmax(
+                logits[jnp.arange(logits.shape[0]), lengths - 1], axis=-1
+            ).block_until_ready()
         for message in messages:
             self.queue.delete_message(
                 self.config.queue_url, message["ReceiptHandle"]

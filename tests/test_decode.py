@@ -64,7 +64,8 @@ def test_prefill_matches_full_forward_last_position(params):
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(expected), rtol=1e-5, atol=1e-5
     )
-    assert int(cache["length"]) == prompt.shape[1]
+    assert cache["length"].shape == (prompt.shape[0],)
+    assert np.asarray(cache["length"]).tolist() == [prompt.shape[1]] * 2
     assert cache["layers"][0]["k"].shape == (
         2, TINY.n_heads, TINY.max_seq_len, TINY.head_dim
     )
@@ -80,7 +81,7 @@ def test_decode_step_matches_full_forward(params):
     np.testing.assert_allclose(
         np.asarray(step_logits), np.asarray(expected), rtol=1e-5, atol=1e-5
     )
-    assert int(cache["length"]) == prompt.shape[1] + 1
+    assert np.asarray(cache["length"]).tolist() == [prompt.shape[1] + 1] * 2
 
 
 def test_generate_greedy_matches_naive_decoder(params):
@@ -159,14 +160,15 @@ def test_sharded_serving_matches_single_device(params):
     prefill_fn, decode_fn, generate_fn = make_serving_fns(mesh, TINY, params)
     prompt = prompt_tokens(batch=4)
 
+    lengths = jnp.full((prompt.shape[0],), prompt.shape[1], jnp.int32)
     expected = naive_greedy(params, prompt, 6)
-    got = generate_fn(params, prompt, jax.random.key(0), 6)
+    got = generate_fn(params, prompt, jax.random.key(0), lengths, 6)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
 
     # sampling through the same compiled path: deterministic per key
     # (all args positional: pjit rejects kwargs when in_shardings is set)
-    a = generate_fn(params, prompt, jax.random.key(3), 6, 0.9)
-    b = generate_fn(params, prompt, jax.random.key(3), 6, 0.9)
+    a = generate_fn(params, prompt, jax.random.key(3), lengths, 6, 0.9)
+    b = generate_fn(params, prompt, jax.random.key(3), lengths, 6, 0.9)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     logits, cache = prefill_fn(params, prompt)
@@ -188,3 +190,66 @@ def test_serving_mesh_rejects_seq_axis(params):
     mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
     with pytest.raises(ValueError, match="seq"):
         make_serving_fns(mesh, TINY, params)
+
+
+def test_ragged_prefill_readout_equals_unpadded(params):
+    """The padded-batch contract: each right-padded row's prefill readout
+    equals running that row alone, unpadded."""
+    rng = jax.random.key(9)
+    full = jax.random.randint(rng, (3, 16), 1, TINY.vocab_size, jnp.int32)
+    lengths = jnp.asarray([5, 16, 9], jnp.int32)
+    mask = jnp.arange(16)[None, :] < lengths[:, None]
+    padded = jnp.where(mask, full, 0)
+
+    batch_logits, cache = prefill(params, padded, TINY, lengths=lengths)
+    assert cache["length"].shape == (3,)
+    np.testing.assert_array_equal(np.asarray(cache["length"]),
+                                  np.asarray(lengths))
+    for i, n in enumerate([5, 16, 9]):
+        solo_logits, _ = prefill(params, padded[i:i + 1, :n], TINY)
+        np.testing.assert_allclose(
+            np.asarray(batch_logits[i]), np.asarray(solo_logits[0]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_ragged_generate_equals_unpadded(params):
+    """Generate on a ragged padded batch == each prompt generated alone,
+    unpadded — pads never attend, rows continue at their own positions."""
+    rng = jax.random.key(11)
+    full = jax.random.randint(rng, (3, 12), 1, TINY.vocab_size, jnp.int32)
+    lengths = jnp.asarray([4, 12, 7], jnp.int32)
+    mask = jnp.arange(12)[None, :] < lengths[:, None]
+    padded = jnp.where(mask, full, 0)
+
+    batch_out = generate(params, padded, 6, TINY, lengths=lengths)
+    for i, n in enumerate([4, 12, 7]):
+        solo = generate(params, padded[i:i + 1, :n], 6, TINY)
+        np.testing.assert_array_equal(
+            np.asarray(batch_out[i]), np.asarray(solo[0])
+        )
+
+
+def test_ragged_generate_llama_equals_unpadded():
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_generate,
+    )
+
+    config = LlamaConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=32, dtype=jnp.float32,
+    )
+    lparams = init_llama_params(jax.random.key(0), config)
+    full = jax.random.randint(jax.random.key(13), (2, 10), 1, 128, jnp.int32)
+    lengths = jnp.asarray([3, 10], jnp.int32)
+    mask = jnp.arange(10)[None, :] < lengths[:, None]
+    padded = jnp.where(mask, full, 0)
+
+    batch_out = llama_generate(lparams, padded, 5, config, lengths=lengths)
+    for i, n in enumerate([3, 10]):
+        solo = llama_generate(lparams, padded[i:i + 1, :n], 5, config)
+        np.testing.assert_array_equal(
+            np.asarray(batch_out[i]), np.asarray(solo[0])
+        )

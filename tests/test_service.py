@@ -95,10 +95,10 @@ def test_queue_worker_generate_mode_decodes_and_deletes():
     params = init_params(jax.random.key(0), TINY)
     calls = []
 
-    def spy_generate(params, tokens, n):
+    def spy_generate(params, tokens, n, lengths):
         from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
 
-        out = generate_jit(params, tokens, n, TINY)
+        out = generate_jit(params, tokens, n, TINY, lengths=lengths)
         calls.append((tokens.shape, n, out.shape))
         return out
 
@@ -333,3 +333,71 @@ def test_full_story_queue_autoscaler_elastic_workers():
     attrs = queue.get_queue_attributes(URL, ())
     assert attrs["ApproximateNumberOfMessages"] == "0"
     assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+
+
+def test_worker_buckets_short_batches():
+    """Short bodies run in a small padded bucket (power of two >= longest
+    body, floored at MIN_BUCKET), not always the full seq_len."""
+    queue = FakeMessageQueue()
+    rng = np.random.default_rng(3)
+    for n in (3, 7, 5):  # longest body 7 -> bucket 16 (MIN_BUCKET)
+        ids = rng.integers(1, TINY.vocab_size, n).tolist()
+        queue.send_message(URL, json.dumps(ids))
+    params = init_params(jax.random.key(0), TINY)
+    shapes = []
+
+    def spy_forward(params, tokens):
+        from kube_sqs_autoscaler_tpu.workloads.model import forward_jit
+
+        shapes.append(tokens.shape)
+        return forward_jit(params, tokens, TINY)
+
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=4, seq_len=64),
+        forward_fn=spy_forward,
+    )
+    assert worker.run_once() == 3
+    assert shapes == [(4, 16)]  # bucketed, not (4, 64)
+
+    # a longer body widens the bucket to the next power of two
+    queue.send_message(
+        URL, json.dumps(rng.integers(1, TINY.vocab_size, 20).tolist())
+    )
+    assert worker.run_once() == 1
+    assert shapes[-1] == (4, 32)
+
+
+def test_worker_classify_reads_each_rows_last_valid_position():
+    """The classify readout must equal running each body alone, unpadded
+    — the padded batch never reads a pad slot."""
+    from kube_sqs_autoscaler_tpu.workloads.model import forward_jit
+
+    queue = FakeMessageQueue()
+    rng = np.random.default_rng(5)
+    bodies = [rng.integers(1, TINY.vocab_size, n).tolist() for n in (4, 11)]
+    for ids in bodies:
+        queue.send_message(URL, json.dumps(ids))
+    params = init_params(jax.random.key(0), TINY)
+    picked = []
+
+    def spy_forward(p, tokens):
+        logits = forward_jit(p, tokens, TINY)
+        picked.append(np.asarray(logits))
+        return logits
+
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=2, seq_len=64),
+        forward_fn=spy_forward,
+    )
+    assert worker.run_once() == 2
+    (logits,) = picked
+    for i, ids in enumerate(bodies):
+        solo = np.asarray(
+            forward_jit(params, jnp.asarray([ids], jnp.int32), TINY)
+        )
+        # row i's readout position (len-1) matches the unpadded run's last
+        np.testing.assert_allclose(
+            logits[i, len(ids) - 1], solo[0, -1], rtol=1e-3, atol=1e-3
+        )

@@ -127,7 +127,10 @@ def test_sharded_serving_matches_single_chip(tmp_path):
     )
 
     _, _, gen = make_llama_serving_fns(mesh, config, params)
-    sharded_out = np.asarray(gen(params, tokens, jax.random.key(0), 4))
+    lengths = jnp.full((tokens.shape[0],), tokens.shape[1], jnp.int32)
+    sharded_out = np.asarray(
+        gen(params, tokens, jax.random.key(0), lengths, 4)
+    )
     single_out = np.asarray(llama_generate_jit(params, tokens, 4, config))
     np.testing.assert_array_equal(sharded_out, single_out)
 
