@@ -5,7 +5,7 @@ Prometheus, no health/readiness probes (SURVEY.md §5).  Opt-in via
 ``--metrics-port`` (default 0 = disabled ⇒ reference behavior exactly).
 """
 
-from .prometheus import ControllerMetrics
+from .prometheus import ControllerMetrics, WorkloadMetrics
 from .server import ObservabilityServer
 
-__all__ = ["ControllerMetrics", "ObservabilityServer"]
+__all__ = ["ControllerMetrics", "ObservabilityServer", "WorkloadMetrics"]

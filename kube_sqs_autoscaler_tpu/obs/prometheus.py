@@ -117,3 +117,67 @@ class ControllerMetrics:
         return [
             f'{_PREFIX}_{name}{{direction="{d}"}} {v}' for d, v in values.items()
         ]
+
+
+_WORKLOAD_PREFIX = "kube_sqs_autoscaler_workload"
+
+
+class WorkloadMetrics:
+    """Workload-side registry: trainer throughput and worker span latencies.
+
+    Serves the numbers the controller-side :class:`ControllerMetrics`
+    cannot see — trainer tokens/s + MFU (set from the trainer's logging
+    interval) and serve-cycle latency summaries pulled live from attached
+    :class:`~..utils.profiling.SpanTimer` s at scrape time (p50/p99/max
+    straight from the timer, no double bookkeeping).  Same
+    dependency-free text-format contract as the controller registry; same
+    :class:`~.server.ObservabilityServer` serves either.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._gauges: dict[str, tuple[float, str]] = {}
+        self._timers: dict[str, object] = {}
+
+    def set_gauge(self, name: str, value: float, help_text: str = "") -> None:
+        """Record one gauge sample (e.g. ``train_tokens_per_sec``)."""
+        with self._lock:
+            self._gauges[name] = (float(value), help_text)
+
+    def attach_timer(self, name: str, timer) -> None:
+        """Expose a SpanTimer's spans as ``<name>_<span>_seconds{quantile}``
+        families, read live at every scrape."""
+        with self._lock:
+            self._timers[name] = timer
+
+    @property
+    def ready(self) -> bool:
+        """Readiness = at least one gauge sample or timed span recorded."""
+        with self._lock:
+            gauges, timers = dict(self._gauges), dict(self._timers)
+        return bool(gauges) or any(t.summary() for t in timers.values())
+
+    def render(self) -> str:
+        with self._lock:
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        lines: list[str] = []
+        for name, (value, help_text) in sorted(gauges.items()):
+            metric = f"{_WORKLOAD_PREFIX}_{name}"
+            if help_text:
+                lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        for name, timer in sorted(timers.items()):
+            for span, stats in sorted(timer.summary().items()):
+                metric = f"{_WORKLOAD_PREFIX}_{name}_{span}_seconds"
+                lines += [
+                    f"# HELP {metric} Wall-clock span latency.",
+                    f"# TYPE {metric} summary",
+                    f'{metric}{{quantile="0.5"}} {stats["p50_s"]}',
+                    f'{metric}{{quantile="0.99"}} {stats["p99_s"]}',
+                    f'{metric}{{quantile="1.0"}} {stats["max_s"]}',
+                    f"{metric}_sum {stats['total_s']}",
+                    f"{metric}_count {stats['count']}",
+                ]
+        return "\n".join(lines) + "\n"

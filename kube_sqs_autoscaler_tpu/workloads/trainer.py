@@ -99,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--resume", action="store_true")
     parser.add_argument("--profile-dir", default="")
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve /metrics with tokens/s, MFU and loss gauges "
+             "(0 = disabled)",
+    )
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -316,6 +321,15 @@ def train(args) -> dict:
     start_step = int(jax.device_get(state["step"]))
     last_saved = start_step if args.resume else None
 
+    # opt-in /metrics with the trainer's own numbers (tokens/s, MFU, loss)
+    metrics = obs_server = None
+    if args.metrics_port:
+        from ..obs import ObservabilityServer, WorkloadMetrics
+
+        metrics = WorkloadMetrics()
+        obs_server = ObservabilityServer(metrics, port=args.metrics_port)
+        obs_server.start()
+
     from .perf import mfu as mfu_of, train_step_flops
 
     step_flops = train_step_flops(model_config, args.batch_size, args.seq_len)
@@ -379,6 +393,25 @@ def train(args) -> dict:
                                f", {mfu_value:.1%} MFU"
                                if mfu_value is not None else ""
                            ) + ")"
+                    if metrics is not None:
+                        metrics.set_gauge(
+                            "train_tokens_per_sec", tokens_per_sec,
+                            "Trainer throughput over the last log interval.",
+                        )
+                        metrics.set_gauge(
+                            "train_steps_per_sec", steps_per_sec,
+                            "Optimizer steps per second.",
+                        )
+                        if mfu_value is not None:
+                            metrics.set_gauge(
+                                "train_mfu", mfu_value,
+                                "Model FLOPs utilization (per chip).",
+                            )
+                if metrics is not None:
+                    metrics.set_gauge("train_loss", loss_value,
+                                      "Last logged training loss.")
+                    metrics.set_gauge("train_step", step,
+                                      "Global optimizer step.")
                 interval_start = now
                 interval_steps = 0
                 log.info("step %d loss %.4f%s", step, loss_value, rate)
@@ -391,6 +424,8 @@ def train(args) -> dict:
     final_step = int(jax.device_get(state["step"]))
     if checkpointer and last_saved != final_step:
         checkpointer.save(state)
+    if obs_server is not None:
+        obs_server.stop()
     return {"losses": losses, "final_step": final_step}
 
 

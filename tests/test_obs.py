@@ -232,3 +232,104 @@ def test_metrics_render_is_prometheus_parseable():
             continue
         assert sample.match(line), line
         float(line.rsplit(" ", 1)[1])  # value must parse
+
+
+def test_workload_metrics_gauges_and_timer_summaries():
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+    from kube_sqs_autoscaler_tpu.utils.profiling import SpanTimer
+
+    metrics = WorkloadMetrics()
+    assert not metrics.ready  # nothing recorded yet
+
+    metrics.set_gauge("train_tokens_per_sec", 81234.5, "Trainer throughput.")
+    metrics.set_gauge("train_mfu", 0.35)
+    timer = SpanTimer()
+    for _ in range(3):
+        with timer.span("cycle"):
+            pass
+    metrics.attach_timer("worker", timer)
+
+    assert metrics.ready
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_workload_train_tokens_per_sec 81234.5" in text
+    assert "kube_sqs_autoscaler_workload_train_mfu 0.35" in text
+    assert 'kube_sqs_autoscaler_workload_worker_cycle_seconds{quantile="0.5"}' in text
+    assert 'quantile="0.99"' in text
+    assert "kube_sqs_autoscaler_workload_worker_cycle_seconds_count 3" in text
+
+
+def test_workload_metrics_served_over_http():
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.obs import (
+        ObservabilityServer,
+        WorkloadMetrics,
+    )
+
+    metrics = WorkloadMetrics()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # not ready until a sample lands
+        try:
+            urllib.request.urlopen(f"{base}/readyz")
+            raise AssertionError("expected 503 before first sample")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+        metrics.set_gauge("train_loss", 3.25)
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "kube_sqs_autoscaler_workload_train_loss 3.25" in body
+        assert urllib.request.urlopen(f"{base}/readyz").status == 200
+    finally:
+        server.stop()
+
+
+def test_trainer_metrics_port_exposes_training_gauges(tmp_path):
+    """--metrics-port on the trainer binary: /metrics shows the trainer's
+    own tokens/s + loss gauges while it runs (VERDICT round-2 item 7)."""
+    import threading
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    # run the trainer in a thread so we can scrape mid-run; port=0 is not
+    # knowable from outside, so grab a free port first
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    seen: dict = {}
+
+    def scrape():
+        # poll until the trainer publishes its first interval
+        import time as _t
+
+        # generous window: the first step is behind XLA compilation
+        for _ in range(1200):
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1
+                ).read().decode()
+                if "workload_train_loss" in body:
+                    seen["body"] = body
+                    return
+            except Exception:
+                pass
+            _t.sleep(0.05)
+
+    scraper = threading.Thread(target=scrape)
+    scraper.start()
+    trainer_main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128", "--seq-len", "32",
+        "--batch-size", "8", "--steps", "8", "--log-every", "1",
+        "--metrics-port", str(port),
+    ])
+    scraper.join(timeout=30)
+    assert "body" in seen, "never scraped a train_loss gauge mid-run"
+    assert "kube_sqs_autoscaler_workload_train_loss" in seen["body"]
+    assert "kube_sqs_autoscaler_workload_train_step" in seen["body"]
