@@ -37,15 +37,44 @@ import jax.numpy as jnp
 
 from .model import ModelConfig, forward, init_params
 
+# Default routing-group budget (tokens).  GShard/Switch route in groups of
+# a few hundred to a few thousand tokens; keeping groups bounded keeps the
+# [G, T, E, C] dispatch tensors linear in batch size (one all-tokens group
+# would make them quadratic) and keeps a shardable leading group axis.
+DEFAULT_GROUP_TOKENS = 4096
+
+
+def _default_group(tokens: int) -> int:
+    """Largest divisor of ``tokens`` that is <= DEFAULT_GROUP_TOKENS —
+    a function of the token count alone, so routing stays invariant to
+    batch reshape.  Trace-time only."""
+    group = min(DEFAULT_GROUP_TOKENS, tokens)
+    while tokens % group:
+        group -= 1
+    return group
+
 
 @dataclass(frozen=True)
 class MoeConfig:
-    """Routing hyper-parameters (defaults follow Switch/GShard practice)."""
+    """Routing hyper-parameters (defaults follow Switch/GShard practice).
+
+    ``group_size`` fixes the routing-group length in *tokens* over the
+    flattened ``[B*S]`` token stream (``None`` = the largest divisor of
+    the total token count up to :data:`DEFAULT_GROUP_TOKENS` — bounded
+    groups in GShard/Switch's practiced range, so the ``[G, T, E, C]``
+    dispatch tensors stay linear in batch size rather than one
+    all-tokens group going quadratic).  Capacity is per group and groups
+    are carved from the flattened stream, so routing depends only on the
+    token stream — reshaping the batch (``[B, S]`` vs ``[2B, S/2]``)
+    neither changes which tokens share capacity nor how much there is
+    (previously each batch row was a group, coupling load-balance
+    behavior to batch layout)."""
 
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
+    group_size: int | None = None
 
     def __post_init__(self) -> None:
         if not 1 <= self.top_k <= self.n_experts:
@@ -54,6 +83,8 @@ class MoeConfig:
             raise ValueError(
                 f"top_k={self.top_k} must be in [1, n_experts={self.n_experts}]"
             )
+        if self.group_size is not None and self.group_size < 1:
+            raise ValueError(f"group_size={self.group_size} must be >= 1")
 
     def capacity(self, tokens_per_group: int) -> int:
         """Static per-expert slot count for a group of that many tokens."""
@@ -101,8 +132,9 @@ def _top_k_routing(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Greedy top-k assignment with per-expert capacity.
 
-    ``probs``: fp32 ``[B, S, E]`` router softmax.  Returns
-    ``dispatch [B, S, E, C]`` (0/1), ``combine [B, S, E, C]``
+    ``probs``: fp32 ``[G, T, E]`` router softmax (``G`` routing groups of
+    ``T`` tokens each).  Returns ``dispatch [G, T, E, C]`` (0/1),
+    ``combine [G, T, E, C]``
     (gate-weighted dispatch), and the Switch aux loss scalar.  Tokens that
     overflow an expert's capacity are dropped for that choice (standard
     GShard behavior); gates are renormalized over the *selected* experts
@@ -151,31 +183,43 @@ def moe_mlp(
 ) -> tuple[jax.Array, jax.Array]:
     """Sparse MLP: route, dispatch, expert FFN, combine.
 
-    ``x``: ``[B, S, D]`` -> ``([B, S, D], aux_loss)``.  Each batch row is a
-    routing group (capacity is per row), so the dispatch einsums keep a
-    leading ``B`` axis that stays sharded over ``"data"`` while the expert
-    axis of the weights is also ``"data"``-sharded — the mismatch is
-    exactly the token all-to-all.
+    ``x``: ``[B, S, D]`` -> ``([B, S, D], aux_loss)``.  Tokens are routed
+    over the **flattened** ``[B*S]`` stream in groups of
+    ``moe.group_size`` (default: one group of all tokens), so routing and
+    capacity are functions of the token stream alone — invariant to how
+    the batch is reshaped.  The dispatch einsums keep a leading group
+    axis that stays sharded over ``"data"`` while the expert axis of the
+    weights is also ``"data"``-sharded — the mismatch is exactly the
+    token all-to-all.
     """
-    capacity = moe.capacity(x.shape[1])
+    b, s, d = x.shape
+    tokens = b * s
+    group = moe.group_size or _default_group(tokens)
+    if tokens % group:
+        raise ValueError(
+            f"batch of {tokens} tokens not divisible by "
+            f"group_size={group}"
+        )
+    xg = x.reshape(tokens // group, group, d)
+    capacity = moe.capacity(group)
     logits = jnp.einsum(
-        "bsd,de->bse", x, layer["router"], preferred_element_type=jnp.float32
+        "gtd,de->gte", xg, layer["router"], preferred_element_type=jnp.float32
     )
     probs = jax.nn.softmax(logits, axis=-1)
     dispatch, combine, aux = _top_k_routing(probs, moe, capacity)
 
     dispatch = dispatch.astype(x.dtype)
-    # [B,S,E,C] x [B,S,D] -> [E,B,C,D]: the forward all-to-all
-    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+    # [G,T,E,C] x [G,T,D] -> [E,G,C,D]: the forward all-to-all
+    expert_in = jnp.einsum("gtec,gtd->egcd", dispatch, xg)
     hidden = jax.nn.gelu(
-        jnp.einsum("ebcd,edf->ebcf", expert_in, layer["w_up_experts"])
+        jnp.einsum("egcd,edf->egcf", expert_in, layer["w_up_experts"])
     )
-    expert_out = jnp.einsum("ebcf,efd->ebcd", hidden, layer["w_down_experts"])
+    expert_out = jnp.einsum("egcf,efd->egcd", hidden, layer["w_down_experts"])
     # combine (return all-to-all) in fp32 so gate weighting is exact
     out = jnp.einsum(
-        "bsec,ebcd->bsd", combine, expert_out.astype(jnp.float32)
+        "gtec,egcd->gtd", combine, expert_out.astype(jnp.float32)
     )
-    return out.astype(x.dtype), aux
+    return out.reshape(b, s, d).astype(x.dtype), aux
 
 
 def moe_forward(
@@ -237,6 +281,15 @@ def make_moe_train_step(mesh, config: ModelConfig, moe: MoeConfig,
     expert weights shard via the ``"expert" -> "data"`` rule in
     :mod:`.train`, so the dispatch einsums lower to token all-to-alls over
     ICI.
+
+    On the expert axis choice: ep deliberately rides the ``data`` mesh
+    axis (the canonical ep=dp layout) rather than a dedicated fourth
+    axis — with routing decoupled from batch layout (flattened-stream
+    groups, see :class:`MoeConfig`), a separate axis would only change
+    *which* devices hold which experts, not the all-to-all volume, while
+    multiplying every mesh-shape constraint in the package.  A dedicated
+    axis becomes worth it when experts outnumber what dp-sharding can
+    hold; revisit then.
     """
     from functools import partial
 

@@ -156,3 +156,61 @@ def test_moe_train_step_rejects_remat():
     state = init_moe_train_state(jax.random.key(0), TINY, moe, train_config)
     with pytest.raises(ValueError, match="remat"):
         make_moe_train_step(mesh, TINY, moe, train_config, state)
+
+
+def test_routing_invariant_to_batch_reshape():
+    """Decoupled capacity: the same flattened token stream routes
+    identically whether presented as [B, S] or [2B, S/2] — the MLP output
+    per token is unchanged (capacity/groups follow the stream, not the
+    batch layout)."""
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.moe import (
+        MoeConfig,
+        init_moe_params,
+        moe_mlp,
+    )
+
+    config = ModelConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    moe = MoeConfig(n_experts=4, top_k=2, capacity_factor=1.0)
+    params = init_moe_params(jax.random.key(0), config, moe)
+    layer = params["layers"][0]
+    x = jax.random.normal(jax.random.key(1), (4, 16, 32), jnp.float32)
+
+    out_a, aux_a = moe_mlp(x, layer, moe)
+    out_b, aux_b = moe_mlp(x.reshape(8, 8, 32), layer, moe)
+    np.testing.assert_allclose(
+        np.asarray(out_a).reshape(-1, 32),
+        np.asarray(out_b).reshape(-1, 32),
+        rtol=1e-6, atol=1e-6,
+    )
+    assert float(aux_a) == pytest.approx(float(aux_b))
+
+
+def test_explicit_group_size_routes_per_group():
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.moe import (
+        MoeConfig,
+        init_moe_params,
+        moe_mlp,
+    )
+
+    config = ModelConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    moe = MoeConfig(n_experts=4, top_k=1, capacity_factor=1.0, group_size=16)
+    params = init_moe_params(jax.random.key(0), config, moe)
+    layer = params["layers"][0]
+    x = jax.random.normal(jax.random.key(2), (2, 16, 32), jnp.float32)
+    out, aux = moe_mlp(x, layer, moe)
+    assert out.shape == (2, 16, 32)
+    assert np.isfinite(np.asarray(out)).all()
+
+    # group_size must divide the token count
+    with pytest.raises(ValueError, match="divisible"):
+        moe_mlp(x[:, :10], layer, MoeConfig(n_experts=4, group_size=16))
