@@ -74,7 +74,22 @@ def initialize_from_env(require: bool = False) -> bool:
     )
     if not triggered:
         return False
-    jax.distributed.initialize()  # cluster auto-detection happens here
+    # jax.distributed.initialize()'s no-arg form only covers environments
+    # its cluster detectors know (TPU pod metadata, Slurm, MPI).  For a
+    # plainly-launched fleet, pass the standard env vars through
+    # explicitly — this is what makes a 2-process CPU job (and the
+    # two-process test) bootstrap the same way a pod slice does.
+    kwargs = {}
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if addr:
+        kwargs["coordinator_address"] = addr
+    if os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = num
+    if os.environ.get("JAX_PROCESS_ID") is not None:
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
     initialize_from_env._done = True
     log.info(
         "jax.distributed initialized: process %d/%d, %d global devices",

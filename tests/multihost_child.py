@@ -1,0 +1,78 @@
+"""Child process for the two-process multi-host test (see
+``tests/test_multihost.py``).
+
+Each process: bootstrap via ``initialize_from_env`` (coordinator env
+vars), build the global ``("data", "seq", "model")`` mesh over all 8
+devices (4 per process), feed the global-batch synthetic stream through
+``prefetch_to_mesh`` against the global batch sharding, run 2 sharded
+train steps, and print the loss.  The parent asserts both processes
+bootstrapped, saw the global device count, and computed the SAME loss —
+the only place a per-host-array/global-sharding mismatch could surface.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kube_sqs_autoscaler_tpu.utils.platforms import honor_env_platforms
+
+honor_env_platforms()
+
+from kube_sqs_autoscaler_tpu.workloads.distributed import initialize_from_env
+
+
+def main() -> None:
+    ok = initialize_from_env()
+    assert ok, "initialize_from_env did not trigger"
+
+    import jax
+    import jax.numpy as jnp
+
+    print(
+        f"BOOT process={jax.process_index()}/{jax.process_count()} "
+        f"global_devices={jax.device_count()} "
+        f"local_devices={len(jax.local_devices())}",
+        flush=True,
+    )
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8
+
+    from kube_sqs_autoscaler_tpu.workloads.data import (
+        prefetch_to_mesh,
+        synthetic_token_stream,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        TrainConfig,
+        batch_sharding,
+        init_train_state,
+        make_mesh,
+        make_train_step,
+        place_state,
+    )
+
+    config = ModelConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    # global mesh over BOTH processes' devices: dp4 x sp1 x tp2
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=1)
+    state = place_state(
+        mesh, init_train_state(jax.random.key(0), config, TrainConfig())
+    )
+    step_fn = make_train_step(mesh, config, TrainConfig(), state)
+
+    # every process generates the same global batch (same seed); device_put
+    # against the global sharding takes each process's addressable shards
+    stream = synthetic_token_stream(config.vocab_size, batch=8, seq=16,
+                                    seed=7)
+    batches = prefetch_to_mesh(stream, batch_sharding(mesh))
+    for _ in range(2):
+        state, loss = step_fn(state, next(batches))
+    # fetching a fully-replicated scalar is legal on every process
+    print(f"LOSS {float(loss):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Two-process multi-host data-path test (VERDICT round-2 item 8).
+
+Launches two REAL Python processes that form a jax.distributed cluster
+over CPU (4 forced host devices each = 8 global), build one global
+``("data", "seq", "model")`` mesh spanning both, and push the synthetic
+input pipeline through ``prefetch_to_mesh`` against the global batch
+sharding — the only environment where per-host-array vs global-sharding
+mismatches can surface (the in-process 8-device suite cannot see them).
+
+Success = both children bootstrap (process_count == 2, 8 global devices),
+both run 2 sharded train steps, and both report the SAME loss (the loss
+is a replicated scalar produced by a psum over the whole mesh — a
+mismatch means the hosts trained on inconsistent shards).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_CHILD = Path(__file__).with_name("multihost_child.py")
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def test_two_process_data_path_and_train_step():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(_CHILD)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=str(_CHILD.parent.parent),
+            )
+        )
+    outputs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=240)
+        outputs.append(out)
+    for pid, (proc, out) in enumerate(zip(procs, outputs)):
+        assert proc.returncode == 0, (
+            f"child {pid} failed (rc={proc.returncode}):\n{out[-3000:]}"
+        )
+    losses = []
+    for pid, out in enumerate(outputs):
+        assert f"BOOT process={pid}/2 global_devices=8" in out, out[-2000:]
+        loss_lines = [l for l in out.splitlines() if l.startswith("LOSS ")]
+        assert loss_lines, f"child {pid} printed no loss:\n{out[-2000:]}"
+        losses.append(float(loss_lines[-1].split()[1]))
+    # replicated psum-produced scalar: must be identical across hosts
+    assert losses[0] == pytest.approx(losses[1], abs=0.0), losses
