@@ -52,11 +52,15 @@ the broadcast; see ``llama._gqa_wrap``).
   elementwise reduction, XLA's bread and butter).
 
 Plugs into the model through the ``attention_fn`` seam
-(``model.forward(..., attention_fn=flash_attention)``); composes with ring
-attention by serving as the per-shard local kernel, and with a sharded
-mesh via :func:`make_sharded_attention` (a ``shard_map`` wrapper, so the
-``pallas_call`` partitions over data/model axes instead of forcing XLA to
-gather around an opaque custom call).
+(``model.forward(..., attention_fn=flash_attention)``); composes with
+ring/zig-zag attention as the per-hop local kernel via
+:func:`flash_attention_lse` — rectangular blocks with a ``q_shift``
+causal offset return normalized ``(out, lse)`` partials that
+:func:`merge_attention_partials` folds across hops (see
+``ring._ring_attention_kernel_local`` / ``zigzag``'s counterpart) — and
+with a sharded mesh via :func:`make_sharded_attention` (a ``shard_map``
+wrapper, so the ``pallas_call`` partitions over data/model axes instead
+of forcing XLA to gather around an opaque custom call).
 
 Off TPU the kernels run in Pallas interpret mode (exact same code path), so
 the CPU test suite validates the real kernels — but interpret mode is
@@ -82,6 +86,14 @@ PREFERRED_BLOCK = 512  # best-measured tile on TPU v5e (see module docstring)
 # broadcasting each per-row scalar across one lane width is the canonical
 # TPU layout for them (the upstream TPU flash kernel does the same).
 _LANES = 128
+
+
+def tiles_cleanly(seq_len: int) -> bool:
+    """Whether the auto-picked block divides ``seq_len`` — the shape gate
+    callers use before choosing a kernel path (e.g. ring/zig-zag fall
+    back to their einsum body for local lengths like 192 that no
+    power-of-two block >= 128 divides)."""
+    return seq_len > 0 and seq_len % _pick_block(seq_len, None) == 0
 
 
 def _pick_block(seq_len: int, requested: int | None) -> int:
@@ -110,7 +122,7 @@ def _pick_block(seq_len: int, requested: int | None) -> int:
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest,
-    block_q: int, block_k: int, scale: float, causal: bool,
+    block_q: int, block_k: int, scale: float, causal: bool, q_shift: int,
 ):
     # rest = (lse_ref,) + scratch when the caller needs the backward's
     # logsumexp residual, else just the scratch refs
@@ -124,6 +136,10 @@ def _fwd_kernel(
     q_block_idx = pl.program_id(2)
     k_block_idx = pl.program_id(3)
     num_k_blocks = pl.num_programs(3)
+    # q_shift: static offset of q row 0's causal position relative to k
+    # column 0 — rectangular blocks of a larger attention problem (ring /
+    # zig-zag hops) express their piece of the global causal mask with it
+    # (row i attends cols <= i + q_shift); 0 = plain causal
     q_offset = q_block_idx * block_q
     k_offset = k_block_idx * block_k
 
@@ -134,7 +150,7 @@ def _fwd_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # blocks strictly above the diagonal contribute nothing under causality
-    diagonal_or_below = k_offset <= q_offset + block_q - 1
+    diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
 
     @pl.when(jnp.logical_or(not causal, diagonal_or_below))
     def _compute():
@@ -148,7 +164,7 @@ def _fwd_kernel(
             jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         )  # [bq, bk] fp32
         if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(
+            rows = q_offset + q_shift + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             cols = k_offset + jax.lax.broadcasted_iota(
@@ -183,19 +199,23 @@ def _fwd_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_q", "block_k", "causal", "interpret", "need_lse"),
+    static_argnames=(
+        "block_q", "block_k", "causal", "interpret", "need_lse", "q_shift",
+    ),
 )
 def _fwd_call(
     q, k, v, *, block_q: int, block_k: int, causal: bool, interpret: bool,
-    need_lse: bool,
+    need_lse: bool, q_shift: int = 0,
 ):
     # need_lse=False (forward-only / serving): the logsumexp output is not
     # declared at all, so the kernel writes no [B, H, S, _LANES] residual
-    # to HBM; the differentiated path requests it for the backward
-    batch, heads, seq_len, head_dim = q.shape
-    kv_heads = k.shape[1]
+    # to HBM; the differentiated path requests it for the backward.
+    # q and k/v may carry different sequence lengths (rectangular blocks
+    # of a larger problem — the ring/zig-zag hops).
+    batch, heads, q_len, head_dim = q.shape
+    kv_heads, k_len = k.shape[1], k.shape[2]
     groups = heads // kv_heads
-    grid = (batch, heads, seq_len // block_q, seq_len // block_k)
+    grid = (batch, heads, q_len // block_q, k_len // block_k)
     q_spec = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
@@ -212,6 +232,7 @@ def _fwd_call(
         block_k=block_k,
         scale=1.0 / head_dim**0.5,
         causal=causal,
+        q_shift=q_shift,
     )
     out = pl.pallas_call(
         kernel,
@@ -222,7 +243,7 @@ def _fwd_call(
             (
                 jax.ShapeDtypeStruct(q.shape, q.dtype),
                 jax.ShapeDtypeStruct(
-                    (batch, heads, seq_len, _LANES), jnp.float32
+                    (batch, heads, q_len, _LANES), jnp.float32
                 ),
             )
             if need_lse
@@ -245,7 +266,7 @@ def _fwd_call(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, block_q: int, block_k: int, scale: float, causal: bool,
+    *, block_q: int, block_k: int, scale: float, causal: bool, q_shift: int,
 ):
     q_block_idx = pl.program_id(2)
     k_block_idx = pl.program_id(3)
@@ -257,7 +278,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    diagonal_or_below = k_offset <= q_offset + block_q - 1
+    diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
 
     @pl.when(jnp.logical_or(not causal, diagonal_or_below))
     def _compute():
@@ -269,7 +290,7 @@ def _bwd_dq_kernel(
             jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         )  # [bq, bk]
         if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(
+            rows = q_offset + q_shift + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             cols = k_offset + jax.lax.broadcasted_iota(
@@ -295,7 +316,7 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, block_k: int, num_q_blocks: int, scale: float,
-    causal: bool,
+    causal: bool, q_shift: int,
 ):
     # grid (B, H_kv, S/bk, groups * S/bq): the innermost axis enumerates
     # (query head of the group, q block) pairs, so the VMEM accumulators
@@ -313,7 +334,7 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    diagonal_or_below = k_offset <= q_offset + block_q - 1
+    diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
 
     @pl.when(jnp.logical_or(not causal, diagonal_or_below))
     def _compute():
@@ -325,7 +346,7 @@ def _bwd_dkv_kernel(
             jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         )  # [bq, bk]
         if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(
+            rows = q_offset + q_shift + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             cols = k_offset + jax.lax.broadcasted_iota(
@@ -349,28 +370,33 @@ def _bwd_dkv_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "causal", "interpret")
+    jax.jit,
+    static_argnames=("block_q", "block_k", "causal", "interpret", "q_shift"),
 )
 def _bwd_call(
-    q, k, v, out, lse, do,
+    q, k, v, out, lse, do, dlse=None,
     *, block_q: int, block_k: int, causal: bool, interpret: bool,
+    q_shift: int = 0,
 ):
-    batch, heads, seq_len, head_dim = q.shape
-    kv_heads = k.shape[1]
+    batch, heads, q_len, head_dim = q.shape
+    kv_heads, k_len = k.shape[1], k.shape[2]
     groups = heads // kv_heads
-    num_q_blocks = seq_len // block_q
-    num_k_blocks = seq_len // block_k
+    num_q_blocks = q_len // block_q
+    num_k_blocks = k_len // block_k
     scale = 1.0 / head_dim**0.5
 
     # Δ = rowsum(dO ∘ O): one fused elementwise reduction, no kernel
-    # needed; lane-replicated to the [B, H, S, _LANES] row-stat layout
-    delta = jnp.broadcast_to(
-        jnp.sum(
-            do.astype(jnp.float32) * out.astype(jnp.float32),
-            axis=-1, keepdims=True,
-        ),
-        (batch, heads, seq_len, _LANES),
+    # needed; lane-replicated to the [B, H, S, _LANES] row-stat layout.
+    # An lse cotangent folds in as Δ' = Δ − dlse: the total score
+    # cotangent is ds = p∘(dp − Δ + dlse) (d lse/d s = p), so shifting Δ
+    # routes it through the existing kernels unchanged.
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
     )
+    if dlse is not None:
+        delta_rows = delta_rows - dlse.astype(jnp.float32)[..., None]
+    delta = jnp.broadcast_to(delta_rows, (batch, heads, q_len, _LANES))
 
     # dq: same grid shape as the forward
     q_spec = pl.BlockSpec(
@@ -387,6 +413,7 @@ def _bwd_call(
         functools.partial(
             _bwd_dq_kernel,
             block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+            q_shift=q_shift,
         ),
         grid=(batch, heads, num_q_blocks, num_k_blocks),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -409,7 +436,7 @@ def _bwd_call(
         functools.partial(
             _bwd_dkv_kernel,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
-            scale=scale, causal=causal,
+            scale=scale, causal=causal, q_shift=q_shift,
         ),
         grid=(batch, kv_heads, num_k_blocks, groups * num_q_blocks),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
@@ -459,6 +486,107 @@ def _flash_bwd(block_q, block_k, causal, interpret, residuals, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, block_q, block_k, causal, q_shift, interpret):
+    out, lse = _fwd_call(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        interpret=interpret, need_lse=True, q_shift=q_shift,
+    )
+    return out, lse[..., 0]
+
+
+def _flash_lse_fwd(q, k, v, block_q, block_k, causal, q_shift, interpret):
+    out, lse = _fwd_call(
+        q, k, v, block_q=block_q, block_k=block_k, causal=causal,
+        interpret=interpret, need_lse=True, q_shift=q_shift,
+    )
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(block_q, block_k, causal, q_shift, interpret, residuals,
+                   cotangents):
+    q, k, v, out, lse = residuals
+    do, dlse = cotangents
+    dq, dk, dv = _bwd_call(
+        q, k, v, out, lse, do, dlse,
+        block_q=block_q, block_k=block_k, causal=causal, interpret=interpret,
+        q_shift=q_shift,
+    )
+    return dq, dk, dv
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_shift: int = 0,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row logsumexp.
+
+    The composable form: ``(out, lse)`` partials from rectangular blocks
+    of a larger attention problem merge exactly via
+    :func:`merge_attention_partials` — this is what makes the kernel the
+    per-shard local op of ring/zig-zag attention (each hop is one kernel
+    call; the online-softmax merge happens across hops).  Differentiable
+    in both outputs: an ``lse`` cotangent folds into the backward kernels
+    as a Δ shift (see ``_bwd_call``).
+
+    ``q`` may be shorter than ``k``/``v`` (rectangular); ``q_shift``
+    places q row 0 at that causal position relative to k column 0 (row
+    ``i`` attends cols ``<= i + q_shift``; must be >= 0 so every row has
+    at least one visible key).  ``lse`` is fp32 ``[B, H, S_q]``.
+    """
+    q_len, k_len = q.shape[2], k.shape[2]
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads {q.shape[1]} not divisible by kv heads {k.shape[1]}"
+        )
+    if causal and q_shift < 0:
+        raise ValueError(f"q_shift={q_shift} must be >= 0 under causal")
+    block_q = _pick_block(q_len, block_q)
+    block_k = _pick_block(k_len, block_k)
+    if q_len % block_q or k_len % block_k:
+        raise ValueError(
+            f"shapes ({q_len}, {k_len}) not divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_lse(q, k, v, block_q, block_k, causal, q_shift, interpret)
+
+
+MERGE_NEG_INF = -1e9
+"""Initial / not-covered lse value for :func:`merge_attention_partials`:
+large-negative *finite* so ``-inf - -inf`` NaNs can never arise in the
+merge or its gradient (``exp(-1e9 - x)`` underflows to exactly 0).  A
+plain Python float on purpose: a module-level ``jnp`` constant would be
+traced into the first ``shard_map``'s mesh context and then poison every
+later trace on a different mesh."""
+
+
+def merge_attention_partials(acc_out, acc_lse, out, lse):
+    """Fold one ``(out, lse)`` attention partial into fp32 accumulators.
+
+    Standard normalized-partial merge: with ``L = logaddexp(acc_lse,
+    lse)``, the merged output is ``acc_out·e^{acc_lse−L} + out·e^{lse−L}``
+    — associative, so hops can arrive in any order.  Start from
+    ``acc_out = 0``, ``acc_lse = MERGE_NEG_INF``; rows a partial does not
+    cover contribute ``lse = MERGE_NEG_INF`` (weight exactly 0).
+    """
+    new_lse = jnp.logaddexp(acc_lse, lse)
+    w_acc = jnp.exp(acc_lse - new_lse)[..., None]
+    w_new = jnp.exp(lse - new_lse)[..., None]
+    return acc_out * w_acc + out.astype(jnp.float32) * w_new, new_lse
 
 
 def flash_attention(

@@ -25,6 +25,15 @@ Compute note: like standard ring attention, every device runs all ``P``
 steps (lockstep collectives), so causal masking wastes ~half the FLOPs;
 :mod:`.zigzag` implements the block reordering that recovers it (balanced
 per-device load, half-size unmasked matmuls on every non-diagonal hop).
+
+Two local-op implementations share the hop/merge structure: the einsum
+reference body (:func:`_ring_attention_local` — runs anywhere, the
+ground truth tests pin against) and the **Pallas flash kernel body**
+(:func:`_ring_attention_kernel_local` — default on TPU): each hop is one
+:func:`.flash.flash_attention_lse` call whose ``(out, lse)`` partial
+merges across hops, so per-hop VMEM stays O(block) and no
+``[S_local, S_local]`` score tensor ever reaches HBM — the property that
+matters when long-context sharding still leaves multi-k local sequences.
 """
 
 from __future__ import annotations
@@ -79,6 +88,78 @@ def expand_kv(t: jax.Array, groups: int) -> jax.Array:
     return jnp.broadcast_to(
         t[:, :, None], (batch, kv_heads, groups, seq, dim)
     ).reshape(batch, kv_heads * groups, seq, dim)
+
+
+def _ring_attention_kernel_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-device body with the Pallas flash kernel as the local op.
+
+    Each hop is ONE kernel call on the resident q block against the
+    arriving k/v block — causal for the diagonal hop (own k/v), full for
+    k/v from earlier devices, skipped entirely for later devices (fully
+    masked under causality; the ``lax.cond`` means those hops cost one
+    ppermute and zero FLOPs).  Hop results are normalized ``(out, lse)``
+    partials merged by :func:`.flash.merge_attention_partials` — the
+    online softmax now lives *across hops* while each hop's inner loop
+    runs at kernel speed with O(block) VMEM, so no ``[S_loc, S_loc]``
+    score tensor ever reaches HBM.  GQA-native: compact k/v feed the
+    kernel directly and rotate compact.
+    """
+    from .flash import (
+        MERGE_NEG_INF,
+        flash_attention_lse,
+        merge_attention_partials,
+    )
+
+    my_index = jax.lax.axis_index(axis_name)
+
+    acc0 = q.astype(jnp.float32) * 0.0
+    lse0 = (
+        q[..., 0].astype(jnp.float32) * 0.0 + MERGE_NEG_INF
+    )  # [B, H, S_loc], varying like q
+
+    def step(carry, step_index):
+        acc, acc_lse, k_blk, v_blk = carry
+        kv_index = (my_index - step_index) % axis_size
+
+        def diag(k_blk, v_blk):
+            return flash_attention_lse(q, k_blk, v_blk, causal=True,
+                                       interpret=interpret)
+
+        def earlier(k_blk, v_blk):
+            return flash_attention_lse(q, k_blk, v_blk, causal=False,
+                                       interpret=interpret)
+
+        def later(k_blk, v_blk):
+            # fully masked: contributes nothing, costs nothing
+            return jnp.zeros_like(q), jnp.full_like(lse0, MERGE_NEG_INF)
+
+        out_h, lse_h = jax.lax.cond(
+            kv_index == my_index,
+            diag,
+            lambda k_blk, v_blk: jax.lax.cond(
+                kv_index < my_index, earlier, later, k_blk, v_blk
+            ),
+            k_blk, v_blk,
+        )
+        acc, acc_lse = merge_attention_partials(acc, acc_lse, out_h, lse_h)
+
+        ring = ring_rotation(axis_size)
+        k_next = jax.lax.ppermute(k_blk, axis_name, ring)
+        v_next = jax.lax.ppermute(v_blk, axis_name, ring)
+        return (acc, acc_lse, k_next, v_next), None
+
+    (acc, _, _, _), _ = jax.lax.scan(
+        step, (acc0, lse0, k, v), jnp.arange(axis_size)
+    )
+    return acc.astype(q.dtype)
 
 
 def _ring_attention_local(
@@ -153,6 +234,8 @@ def make_ring_attention(
     seq_axis: str = "seq",
     data_axis: str = "data",
     model_axis: str = "model",
+    use_kernel: bool | None = None,
+    interpret: bool | None = None,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """Build an attention fn ``(q, k, v) -> out`` (``[B, H, S, D]`` each)
     that runs as ring attention over ``mesh[seq_axis]``.
@@ -160,18 +243,46 @@ def make_ring_attention(
     Batch shards over ``data_axis``, heads over ``model_axis`` (tensor
     parallel), sequence over ``seq_axis`` — the full dp x tp x sp layout.
     Plugs into :func:`..model.forward` as ``attention_fn``.
+
+    ``use_kernel`` selects the per-hop local op: the Pallas flash kernel
+    (:func:`_ring_attention_kernel_local` — default on TPU) or the
+    einsum reference body (default elsewhere: off TPU the kernel would
+    run in the Python-speed interpreter).  ``interpret`` forces the
+    kernel's interpret mode (tests exercise the kernel path on CPU
+    with ``use_kernel=True, interpret=True``).
     """
     axis_size = mesh.shape[seq_axis]
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
     spec = P(data_axis, model_axis, seq_axis, None)
-    body = partial(
-        _ring_attention_local, axis_name=seq_axis, axis_size=axis_size
+    # check_vma=False on the kernel body: pallas_call outputs carry no
+    # varying-axes info for the checker (same reason as
+    # flash.make_sharded_attention)
+    sharded_kernel = jax.shard_map(
+        partial(
+            _ring_attention_kernel_local, axis_name=seq_axis,
+            axis_size=axis_size, interpret=interpret,
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )
-    sharded = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    sharded_einsum = jax.shard_map(
+        partial(
+            _ring_attention_local, axis_name=seq_axis, axis_size=axis_size
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
 
     def attend(q, k, v):
-        return sharded(q, k, v)
+        # kernel only for local shapes the blocks tile (e.g. S_local=192
+        # has no dividing power-of-two block >= 128); everything else
+        # keeps the einsum body rather than raising at trace time
+        from .flash import tiles_cleanly
+
+        s_local = q.shape[2] // axis_size
+        if use_kernel and tiles_cleanly(s_local):
+            return sharded_kernel(q, k, v)
+        return sharded_einsum(q, k, v)
 
     # GQA-native: compact [B, H_kv, S, D] k/v rotate around the ring as-is
     # (see expand_kv) — no repeat_kv before the call

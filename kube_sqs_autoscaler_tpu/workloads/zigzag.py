@@ -175,12 +175,113 @@ def _zigzag_attention_local(
     return (o / l).astype(q.dtype)
 
 
+def _zigzag_attention_kernel_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-device zig-zag body with the Pallas flash kernel as the local
+    op (the kernel counterpart of :func:`_zigzag_attention_local`).
+
+    Same three hop shapes, each now a rectangular kernel call instead of
+    a materialized score block:
+
+    - diagonal: lo rows are plain causal over the lo chunk; hi rows run
+      one call over BOTH chunks with ``q_shift=chunk`` (full over lo,
+      causal within hi — exactly the zig-zag diagonal mask);
+    - from earlier: one unmasked ``[2c, c]`` call against the early
+      chunk;
+    - from later: one unmasked ``[c, 2c]`` call for the hi rows only (lo
+      rows contribute a zero-weight partial).
+
+    Cross-hop combining is the ``(out, lse)`` merge
+    (:func:`.flash.merge_attention_partials`); GQA-compact k/v feed the
+    kernel directly and rotate compact.
+    """
+    from .flash import (
+        MERGE_NEG_INF,
+        flash_attention_lse,
+        merge_attention_partials,
+    )
+
+    seq_local = q.shape[2]
+    chunk = seq_local // 2
+    my_index = jax.lax.axis_index(axis_name)
+
+    acc0 = q.astype(jnp.float32) * 0.0
+    lse0 = q[..., 0].astype(jnp.float32) * 0.0 + MERGE_NEG_INF
+
+    def step(carry, step_index):
+        acc, acc_lse, k_blk, v_blk = carry
+        kv_index = (my_index - step_index) % axis_size
+
+        def diag(k_blk, v_blk):
+            out_lo, lse_lo = flash_attention_lse(
+                q[:, :, :chunk], k_blk[:, :, :chunk], v_blk[:, :, :chunk],
+                causal=True, interpret=interpret,
+            )
+            out_hi, lse_hi = flash_attention_lse(
+                q[:, :, chunk:], k_blk, v_blk, causal=True, q_shift=chunk,
+                interpret=interpret,
+            )
+            return (
+                jnp.concatenate([out_lo, out_hi], axis=2),
+                jnp.concatenate([lse_lo, lse_hi], axis=2),
+            )
+
+        def from_earlier(k_blk, v_blk):
+            return flash_attention_lse(
+                q, k_blk[:, :, :chunk], v_blk[:, :, :chunk], causal=False,
+                interpret=interpret,
+            )
+
+        def from_later(k_blk, v_blk):
+            out_hi, lse_hi = flash_attention_lse(
+                q[:, :, chunk:], k_blk, v_blk, causal=False,
+                interpret=interpret,
+            )
+            return (
+                jnp.concatenate(
+                    [jnp.zeros_like(q[:, :, :chunk]), out_hi], axis=2
+                ),
+                jnp.concatenate(
+                    [jnp.full_like(lse_hi, MERGE_NEG_INF), lse_hi], axis=2
+                ),
+            )
+
+        out_h, lse_h = jax.lax.cond(
+            kv_index == my_index,
+            diag,
+            lambda k_blk, v_blk: jax.lax.cond(
+                kv_index < my_index, from_earlier, from_later, k_blk, v_blk
+            ),
+            k_blk, v_blk,
+        )
+        acc, acc_lse = merge_attention_partials(acc, acc_lse, out_h, lse_h)
+
+        ring = ring_rotation(axis_size)
+        k_next = jax.lax.ppermute(k_blk, axis_name, ring)
+        v_next = jax.lax.ppermute(v_blk, axis_name, ring)
+        return (acc, acc_lse, k_next, v_next), None
+
+    (acc, _, _, _), _ = jax.lax.scan(
+        step, (acc0, lse0, k, v), jnp.arange(axis_size)
+    )
+    return acc.astype(q.dtype)
+
+
 def make_zigzag_ring_attention(
     mesh: Mesh,
     *,
     seq_axis: str = "seq",
     data_axis: str = "data",
     model_axis: str = "model",
+    use_kernel: bool | None = None,
+    interpret: bool | None = None,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """Attention fn over ``mesh[seq_axis]`` for **zig-zag-ordered** inputs.
 
@@ -189,20 +290,47 @@ def make_zigzag_ring_attention(
     ``model_axis``, sequence over ``seq_axis``) but the sequence axis must
     carry :func:`zigzag_permutation` order — which makes the contiguous
     shard on device ``d`` exactly its two zig-zag chunks.
+
+    ``use_kernel``/``interpret``: same local-op selection as
+    :func:`.ring.make_ring_attention` (Pallas flash hops on TPU, einsum
+    reference elsewhere; tests force the kernel in interpret mode).
     """
     axis_size = mesh.shape[seq_axis]
     if axis_size < 2:
         raise ValueError("zig-zag needs a nontrivial seq axis (P >= 2)")
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
     spec = P(data_axis, model_axis, seq_axis, None)
-    body = partial(
-        _zigzag_attention_local, axis_name=seq_axis, axis_size=axis_size
+    sharded_kernel = jax.shard_map(
+        partial(
+            _zigzag_attention_kernel_local, axis_name=seq_axis,
+            axis_size=axis_size, interpret=interpret,
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )
-    sharded = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    sharded_einsum = jax.shard_map(
+        partial(
+            _zigzag_attention_local, axis_name=seq_axis, axis_size=axis_size
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
 
     def fn(q, k, v):
-        return sharded(q, k, v)
+        # kernel only when both hop shapes tile (the diag lo call runs at
+        # chunk = S_local/2; the hi/later calls at S_local) — else the
+        # einsum body, rather than a trace-time block error
+        from .flash import tiles_cleanly
+
+        s_local = q.shape[2] // axis_size
+        if (
+            use_kernel
+            and s_local % 2 == 0
+            and tiles_cleanly(s_local)
+            and tiles_cleanly(s_local // 2)
+        ):
+            return sharded_kernel(q, k, v)
+        return sharded_einsum(q, k, v)
 
     fn._zigzag = True  # layout marker checked by the zig-zag losses
     # GQA-native: compact k/v rotate as-is (see ring.expand_kv)

@@ -257,3 +257,121 @@ def test_forward_with_flash_matches_dense_forward():
         np.asarray(jnp.argmax(dense_logits[:, -1, :], -1)),
         np.asarray(jnp.argmax(flash_logits[:, -1, :], -1)),
     )
+
+
+# ------------------------------------------------- composable (out, lse)
+
+
+def _ref_attention_lse(q, k, v, mask=None):
+    """Pure-jnp reference: softmax attention + per-row logsumexp."""
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (q.shape[-1] ** 0.5)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    probs = jnp.exp(scores - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v), lse
+
+
+def _rand_qkv(key, batch=2, heads=2, q_len=16, k_len=16, dim=8):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (batch, heads, q_len, dim), jnp.float32)
+    k = jax.random.normal(ks[1], (batch, heads, k_len, dim), jnp.float32)
+    v = jax.random.normal(ks[2], (batch, heads, k_len, dim), jnp.float32)
+    return q, k, v
+
+
+def test_flash_lse_matches_reference_full_and_causal():
+    from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention_lse
+
+    q, k, v = _rand_qkv(jax.random.key(0))
+    out, lse = flash_attention_lse(q, k, v, causal=False, interpret=True)
+    ref_out, ref_lse = _ref_attention_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
+
+    causal = jnp.tril(jnp.ones((16, 16), bool))
+    out, lse = flash_attention_lse(q, k, v, causal=True, interpret=True)
+    ref_out, ref_lse = _ref_attention_lse(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_lse_rectangular_with_q_shift():
+    from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention_lse
+
+    # q rows sit at causal positions 8..15 against 16 keys (the ring
+    # "later queries attend both chunks" shape)
+    q, k, v = _rand_qkv(jax.random.key(1), q_len=8, k_len=16)
+    out, lse = flash_attention_lse(q, k, v, causal=True, q_shift=8,
+                                   interpret=True)
+    rows = jnp.arange(8)[:, None] + 8
+    cols = jnp.arange(16)[None, :]
+    ref_out, ref_lse = _ref_attention_lse(q, k, v, rows >= cols)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_merge_partials_reconstructs_full_attention():
+    from kube_sqs_autoscaler_tpu.workloads.flash import (
+        MERGE_NEG_INF,
+        flash_attention_lse,
+        merge_attention_partials,
+    )
+
+    q, k, v = _rand_qkv(jax.random.key(2), q_len=16, k_len=32)
+    # split keys in half, compute two rectangular partials, merge
+    out_a, lse_a = flash_attention_lse(q, k[:, :, :16], v[:, :, :16],
+                                       causal=False, interpret=True)
+    out_b, lse_b = flash_attention_lse(q, k[:, :, 16:], v[:, :, 16:],
+                                       causal=False, interpret=True)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    acc_lse = jnp.full(lse_a.shape, MERGE_NEG_INF)
+    acc, acc_lse = merge_attention_partials(acc, acc_lse, out_a, lse_a)
+    acc, acc_lse = merge_attention_partials(acc, acc_lse, out_b, lse_b)
+
+    ref_out, ref_lse = _ref_attention_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(acc), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(acc_lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_lse_gradients_match_reference_through_merge():
+    from kube_sqs_autoscaler_tpu.workloads.flash import (
+        MERGE_NEG_INF,
+        flash_attention_lse,
+        merge_attention_partials,
+    )
+
+    q, k, v = _rand_qkv(jax.random.key(3), q_len=16, k_len=32)
+
+    def merged_loss(q, k, v):
+        out_a, lse_a = flash_attention_lse(q, k[:, :, :16], v[:, :, :16],
+                                           causal=False, interpret=True)
+        out_b, lse_b = flash_attention_lse(q, k[:, :, 16:], v[:, :, 16:],
+                                           causal=False, interpret=True)
+        acc = jnp.zeros(q.shape, jnp.float32)
+        acc_lse = jnp.full(lse_a.shape, MERGE_NEG_INF)
+        acc, acc_lse = merge_attention_partials(acc, acc_lse, out_a, lse_a)
+        acc, acc_lse = merge_attention_partials(acc, acc_lse, out_b, lse_b)
+        return jnp.mean(acc**2)
+
+    def ref_loss(q, k, v):
+        out, _ = _ref_attention_lse(q, k, v)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    got = jax.grad(merged_loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-5,
+            err_msg=f"d{name}",
+        )
