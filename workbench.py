@@ -200,6 +200,55 @@ def bench_attention(seq: int, iters: int) -> dict:
     }
 
 
+def bench_ring_local(seq: int, iters: int) -> dict:
+    """Per-hop local op of ring attention: flash-kernel body vs the
+    einsum reference body, fwd+bwd, on a 1-device seq mesh (a single
+    diagonal hop — the per-hop cost that multiplies by P on a real
+    sp ring; the collectives are identical either way)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.ring import make_ring_attention
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1, seq_parallel=1)
+    batch, heads, dim = 2, 8, 128
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        (jax.random.normal(key, (batch, heads, seq, dim), jnp.float32)
+         / dim**0.25).astype(jnp.bfloat16)
+        for key in keys
+    )
+
+    def loss_of(fn):
+        return jax.jit(jax.value_and_grad(
+            lambda q, k, v: jnp.mean(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2),
+        ))
+
+    kernel_fn = loss_of(make_ring_attention(mesh, use_kernel=True))
+    einsum_fn = loss_of(make_ring_attention(mesh, use_kernel=False))
+    _time_compiled(kernel_fn, q, k, v, iters=2)
+    _time_compiled(einsum_fn, q, k, v, iters=2)
+    kernel_reps, einsum_reps = [], []
+    for _ in range(5):
+        kernel_reps.append(
+            _time_compiled(kernel_fn, q, k, v, iters=iters, warmup=0)
+        )
+        einsum_reps.append(
+            _time_compiled(einsum_fn, q, k, v, iters=iters, warmup=0)
+        )
+    kernel_s = statistics.median(kernel_reps)
+    einsum_s = statistics.median(einsum_reps)
+    return {
+        "kernel_fwdbwd_ms": kernel_s * 1e3,
+        "einsum_fwdbwd_ms": einsum_s * 1e3,
+        "speedup": einsum_s / kernel_s,
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser(prog="workbench")
     parser.add_argument("--steps", type=int, default=20)
@@ -225,6 +274,10 @@ def main(argv=None) -> dict:
         results["llama_train"] = bench_train_step("llama", args.steps)
     for seq in ATTN_SEQ_LENS:
         results[f"attention_s{seq}"] = bench_attention(seq, args.attn_iters)
+    # the ring/zig-zag per-hop local op: kernel vs einsum body at the
+    # local lengths a long-context sp run actually sees
+    for seq in (4096, 8192):
+        results[f"ring_local_s{seq}"] = bench_ring_local(seq, args.attn_iters)
 
     metrics = [
         ("train_tokens_per_sec", results["train"]["tokens_per_sec"],
@@ -245,6 +298,11 @@ def main(argv=None) -> dict:
             (f"flash_speedup_s{seq}", att["speedup"], "x"),
             (f"attn_hot_path_speedup_s{seq}", att["hot_path_speedup"], "x"),
         ]
+    for seq in (4096, 8192):
+        ring = results[f"ring_local_s{seq}"]
+        metrics.append(
+            (f"ring_kernel_speedup_s{seq}", ring["speedup"], "x")
+        )
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
