@@ -62,6 +62,12 @@ def main(argv=None) -> None:
              "(p50/p99/max from the worker's SpanTimer; 0 = disabled)",
     )
     parser.add_argument(
+        "--continuous", action="store_true",
+        help="continuous batching: rolling decode slots that refill as "
+             "each message finishes instead of batch-at-a-time (requires "
+             "--generate-tokens >= 1; gpt family, single chip)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -188,6 +194,17 @@ def main(argv=None) -> None:
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,
     )
 
+    if args.continuous:
+        # rolling-slot serving: single-chip gpt decode path (the slot
+        # insertion splices into the per-row cache; mesh-sharded and GQA
+        # variants are batch-mode only for now — fail fast, don't ignore)
+        for flag, bad in (("--family llama", family == "llama"),
+                          ("--model-parallel", bool(args.model_parallel)),
+                          ("--generate-tokens >= 1 required",
+                           args.generate_tokens < 1)):
+            if bad:
+                raise SystemExit(f"--continuous does not support {flag}")
+
     if args.demo:
         import numpy as np
 
@@ -199,6 +216,22 @@ def main(argv=None) -> None:
             ids = rng.integers(0, model_config.vocab_size, args.seq_len).tolist()
             queue.send_message("demo://queue", json.dumps(ids))
         service_config.queue_url = "demo://queue"
+        if args.continuous:
+            from .continuous import ContinuousWorker
+
+            cworker = ContinuousWorker(queue, params, model_config,
+                                       service_config)
+            obs = _maybe_serve_metrics(args.metrics_port, cworker)
+            start = time.perf_counter()
+            cworker.drain(total=args.demo)
+            elapsed = time.perf_counter() - start
+            log.info(
+                "Processed %d messages in %.2fs (%.1f msg/s, continuous)",
+                cworker.processed, elapsed, cworker.processed / elapsed,
+            )
+            if obs is not None:
+                obs.stop()
+            return
         worker = QueueWorker(queue, params, model_config, service_config,
                              **worker_kwargs)
         obs = _maybe_serve_metrics(args.metrics_port, worker)
@@ -218,6 +251,15 @@ def main(argv=None) -> None:
     from ..metrics.sqs_aws import AwsSqsService
 
     queue = AwsSqsService(region=args.aws_region)
+    if args.continuous:
+        from .continuous import ContinuousWorker
+
+        cworker = ContinuousWorker(queue, params, model_config,
+                                   service_config)
+        _maybe_serve_metrics(args.metrics_port, cworker)
+        log.info("Starting continuous worker on %s", args.sqs_queue_url)
+        cworker.run_forever()
+        return
     worker = QueueWorker(queue, params, model_config, service_config,
                          **worker_kwargs)
     _maybe_serve_metrics(args.metrics_port, worker)

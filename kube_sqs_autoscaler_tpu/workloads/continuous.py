@@ -1,0 +1,331 @@
+"""Continuous batching: rolling decode slots that refill independently.
+
+The batch-generate worker (:mod:`.service` in generate mode) decodes a
+whole batch to completion before touching the queue again — one long
+prompt or one unlucky batch blocks every other message (head-of-line
+blocking).  Real LM serving keeps a *rolling* batch instead: every row of
+the KV cache is an independent slot; each engine step advances all active
+slots by one token, finished slots emit their continuation immediately,
+and new requests are prefilled **into** a free slot while the others keep
+decoding.  The per-row cache machinery from :mod:`.decode` (per-row
+``length``, per-row write positions, per-row masks) is exactly what makes
+this work.
+
+TPU shape discipline: there are only two compiled programs —
+
+- ``decode_step`` (the existing one): advances all ``batch`` slots one
+  position, active or not (inactive rows compute garbage that is never
+  read — lockstep static shapes beat dynamic batch reshapes);
+- ``insert`` : prefill one prompt (padded to a fixed bucket) as a
+  ``[1, P]`` batch and ``dynamic_update_slice`` its layer caches into the
+  slot's row, set the row's length, and return the first sampled token.
+
+The reference has no serving at all (SURVEY.md §2); this is the TPU-shop
+shape of the queue-consumer its README deploys.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import _pick, init_cache, prefill
+from .model import ModelConfig
+
+log = logging.getLogger(__name__)
+
+
+@partial(
+    jax.jit, static_argnames=("config", "prompt_len"), donate_argnums=(1,)
+)
+def _insert_row(
+    params: dict,
+    cache: dict,
+    row: jax.Array,
+    prompt: jax.Array,
+    length: jax.Array,
+    config: ModelConfig,
+    prompt_len: int,
+) -> tuple[dict, jax.Array]:
+    """Prefill ``prompt`` (int32 ``[prompt_len]``, right-padded to the
+    static bucket) and splice it into slot ``row`` of ``cache``.
+
+    Returns ``(cache, first_token)`` — the slot's length is the prompt's
+    real length and its first greedy continuation token is ready to feed
+    the next ``decode_step``.
+    """
+    logits, row_cache = prefill(
+        params, prompt[None], config, lengths=length[None]
+    )
+    new_layers = []
+    for layer_cache, row_layer in zip(cache["layers"], row_cache["layers"]):
+        new_layers.append({
+            "k": jax.lax.dynamic_update_slice(
+                layer_cache["k"], row_layer["k"][:, :, :prompt_len],
+                (row, 0, 0, 0),
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                layer_cache["v"], row_layer["v"][:, :, :prompt_len],
+                (row, 0, 0, 0),
+            ),
+        })
+    lengths = jax.lax.dynamic_update_index_in_dim(
+        cache["length"], length, row, 0
+    )
+    first = _pick(logits, None, 0.0)[0]
+    return {"layers": new_layers, "length": lengths}, first
+
+
+@dataclass
+class _Slot:
+    busy: bool = False
+    produced: list = field(default_factory=list)
+    budget: int = 0
+    payload: Any = None  # caller's per-request context (receipt handle...)
+
+
+class ContinuousBatcher:
+    """The slot machine: submit prompts, step the batch, collect results.
+
+    Queue-agnostic and synchronous — drive it from anything that produces
+    ``(token_ids, payload)`` requests.  Greedy decoding (the generate-mode
+    worker's semantics).  Outputs are exactly what :func:`.decode.generate`
+    produces for each prompt alone (pinned by test): continuous batching
+    changes *scheduling*, never results.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: ModelConfig,
+        batch_size: int,
+        prompt_len: int,
+        generate_tokens: int,
+    ) -> None:
+        if prompt_len + generate_tokens > config.max_seq_len:
+            raise ValueError(
+                f"prompt_len + generate_tokens = "
+                f"{prompt_len + generate_tokens} exceeds max_seq_len="
+                f"{config.max_seq_len}"
+            )
+        self.params = params
+        self.config = config
+        self.prompt_len = prompt_len
+        self.generate_tokens = generate_tokens
+        self.cache = init_cache(config, batch_size)
+        self.slots = [_Slot() for _ in range(batch_size)]
+        # each slot's pending input token for the next decode step
+        self._current = jnp.zeros((batch_size,), jnp.int32)
+        self._decode = self._make_decode_step()
+
+    def _make_decode_step(self):
+        from .decode import decode_step
+
+        # donate the cache: self.cache is reassigned from the result every
+        # call, so the multi-layer KV buffers are reused in place instead
+        # of copied per generated token (same as compile_serving_fns)
+        @partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, tokens):
+            logits, cache = decode_step(params, cache, tokens, self.config)
+            return cache, _pick(logits, None, 0.0)
+
+        return step
+
+    @property
+    def free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if not s.busy]
+
+    @property
+    def active(self) -> int:
+        return sum(s.busy for s in self.slots)
+
+    def submit(self, token_ids: np.ndarray, payload: Any = None) -> int:
+        """Prefill one request into a free slot; returns the slot index.
+
+        ``token_ids`` is truncated/right-padded to the batcher's static
+        ``prompt_len`` bucket (empty prompts count one pad token).
+        """
+        free = self.free_slots
+        if not free:
+            raise RuntimeError("no free slot; call step() until one opens")
+        row = free[0]
+        ids = np.zeros((self.prompt_len,), np.int32)
+        real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
+        ids[: real.size] = real
+        length = max(1, real.size)
+        self.cache, first = _insert_row(
+            self.params, self.cache, jnp.asarray(row, jnp.int32),
+            jnp.asarray(ids), jnp.asarray(length, jnp.int32), self.config,
+            self.prompt_len,
+        )
+        self._current = self._current.at[row].set(first)
+        slot = self.slots[row]
+        slot.busy = True
+        slot.produced = [first]
+        slot.budget = self.generate_tokens
+        slot.payload = payload
+        return row
+
+    def step(self) -> list[tuple[Any, np.ndarray]]:
+        """Advance every active slot one token; return finished requests
+        as ``(payload, continuation_tokens)`` pairs (their slots are free
+        again on return).  No-op when nothing is active."""
+        if self.active == 0:
+            return []
+        finished = []
+        # rows whose budget is a single token never need a decode step
+        pending_decode = any(
+            s.busy and len(s.produced) < s.budget for s in self.slots
+        )
+        if pending_decode:
+            self.cache, nxt = self._decode(
+                self.params, self.cache, self._current
+            )
+            nxt_host = np.asarray(nxt)
+            for row, slot in enumerate(self.slots):
+                if slot.busy and len(slot.produced) < slot.budget:
+                    slot.produced.append(int(nxt_host[row]))
+            self._current = nxt
+        for row, slot in enumerate(self.slots):
+            if slot.busy and len(slot.produced) >= slot.budget:
+                finished.append(
+                    (slot.payload, np.asarray(slot.produced, np.int32))
+                )
+                self.slots[row] = _Slot()
+        return finished
+
+
+class ContinuousWorker:
+    """A queue-draining worker built on :class:`ContinuousBatcher`.
+
+    Same at-least-once contract as :class:`.service.QueueWorker`: a
+    message is deleted only after its continuation is fully generated.
+    Unlike the batch worker, a slow batch never blocks fresh messages —
+    slots refill the moment they finish.
+    """
+
+    def __init__(
+        self,
+        queue,
+        params: Any,
+        model_config: ModelConfig,
+        service_config,
+    ) -> None:
+        if service_config.generate_tokens < 1:
+            raise ValueError(
+                "ContinuousWorker is generate-mode serving; set "
+                "ServiceConfig.generate_tokens >= 1"
+            )
+        self.queue = queue
+        self.config = service_config
+        self.batcher = ContinuousBatcher(
+            params, model_config,
+            batch_size=service_config.batch_size,
+            prompt_len=service_config.seq_len,
+            generate_tokens=service_config.generate_tokens,
+        )
+        self.processed = 0
+        # wall-clock engine-cycle spans (same metrics surface as
+        # QueueWorker: obs attaches this to /metrics)
+        from ..utils.profiling import SpanTimer
+
+        self.timer = SpanTimer()
+        self._stop = None  # lazily a threading.Event in run_forever
+        self._poll_backoff = 0
+
+    # poll throttle: after an EMPTY zero-wait receive while slots are
+    # still decoding, skip this many cycles before polling again — one
+    # billed ReceiveMessage per generated token would be absurd on SQS
+    POLL_BACKOFF_CYCLES = 16
+
+    def _refill(self) -> int:
+        """Pull up to free-slot-count messages and prefill them in."""
+        import json
+
+        free = len(self.batcher.free_slots)
+        if not free:
+            return 0
+        if self._poll_backoff > 0:
+            self._poll_backoff -= 1
+            return 0
+        messages = self.queue.receive_messages(
+            self.config.queue_url, max_messages=free,
+            wait_time_s=0 if self.batcher.active else
+            self.config.receive_wait_s,
+        )
+        if not messages and self.batcher.active:
+            self._poll_backoff = self.POLL_BACKOFF_CYCLES
+        for message in messages:
+            try:
+                ids = np.asarray(
+                    json.loads(message["Body"]), np.int32
+                ).reshape(-1)
+            except Exception:
+                log.error("Dropping malformed message body: %.64r",
+                          message["Body"])
+                # poison messages are consumed, not redelivered forever
+                self.queue.delete_message(
+                    self.config.queue_url, message["ReceiptHandle"]
+                )
+                continue
+            self.batcher.submit(ids, payload=message["ReceiptHandle"])
+        return len(messages)
+
+    def run_once(self) -> int:
+        """One engine cycle: refill free slots, advance one token, settle
+        finished requests.  Returns messages completed this cycle."""
+        self._refill()
+        done = self.batcher.step()
+        for receipt, _tokens in done:
+            self.queue.delete_message(self.config.queue_url, receipt)
+        if done:
+            self._poll_backoff = 0  # a slot just freed: poll right away
+        self.processed += len(done)
+        return len(done)
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+
+    def run_forever(self) -> None:
+        """Serve until :meth:`stop` — same never-dies guarantee as
+        :meth:`.service.QueueWorker.run_forever`: a transient queue or
+        compute error logs, backs off, and retries (unfinished slots stay
+        in flight; their messages reappear after the visibility timeout
+        if the process dies)."""
+        import threading
+
+        if self._stop is None:
+            self._stop = threading.Event()
+        while not self._stop.is_set():
+            try:
+                with self.timer.span("cycle"):
+                    idle = self.run_once() == 0 and self.batcher.active == 0
+            except Exception as err:
+                log.error("Continuous worker cycle failed: %s", err)
+                self._stop.wait(self.config.error_backoff_s)
+                continue
+            if idle:
+                self._stop.wait(self.config.idle_sleep_s)
+
+    def drain(self, total: int, max_cycles: int | None = None) -> int:
+        """Run cycles until ``total`` messages complete (or the cycle
+        budget runs out); returns the number completed."""
+        cycles = 0
+        while self.processed < total:
+            if max_cycles is not None and cycles >= max_cycles:
+                break
+            cycles += 1
+            with self.timer.span("cycle"):
+                done = self.run_once()
+            if done == 0 and self.batcher.active == 0:
+                # the cycle's own refill got nothing and nothing is in
+                # flight: the queue is drained
+                break
+        return self.processed
