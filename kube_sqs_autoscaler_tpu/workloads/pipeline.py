@@ -46,7 +46,6 @@ from .model import (
     PARAM_AXES,
     ModelConfig,
     _block,
-    _dense_attention,
     _layer_norm,
     init_params,
 )
@@ -201,10 +200,16 @@ def _stage_apply(
         jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
         if remat else _block
     )
+    # attention by the measured dispatcher: the Pallas flash kernel on
+    # TPU past its crossover (the pallas_call runs fine inside the
+    # fully-manual body — same situation as the ring kernel hops), the
+    # dense XLA path elsewhere
+    from .flash import attention_fn_for
+
+    attend = attention_fn_for(x.shape[1])
 
     def one_layer(h, layer):
-        return block(h, layer, cfg, _dense_attention, None, reduce,
-                     promote), None
+        return block(h, layer, cfg, attend, None, reduce, promote), None
 
     out, _ = jax.lax.scan(one_layer, x, stage_layers)
     return out
