@@ -68,10 +68,21 @@ def main(argv=None) -> None:
              "--generate-tokens >= 1; gpt family, single chip)",
     )
     parser.add_argument(
+        "--quantize", choices=("none", "int8"), default="none",
+        help="int8: post-training per-channel weight quantization of the "
+             "served matmul weights (half the HBM bytes per decode step; "
+             "single chip)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
     args = parser.parse_args(argv)
+    if args.quantize == "int8" and args.model_parallel:
+        # fail BEFORE the mesh is built or a checkpoint restored
+        raise SystemExit(
+            "--quantize int8 is single-chip serving; drop --model-parallel"
+        )
 
     import jax
 
@@ -143,6 +154,17 @@ def main(argv=None) -> None:
             params = init_params(jax.random.key(0), model_config)
         if mesh is not None:
             params = jax.device_put(params, param_shardings(mesh, params))
+
+    if args.quantize == "int8":
+        # applies to restored checkpoints AND random-init smoke mode
+        from .quantize import quantize_params, quantized_bytes
+
+        before = quantized_bytes(params)
+        params = quantize_params(params, family=family)
+        log.info(
+            "Quantized weights to int8: %.1f MiB -> %.1f MiB",
+            before / 2**20, quantized_bytes(params) / 2**20,
+        )
 
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}

@@ -1,0 +1,119 @@
+"""Post-training int8 weight quantization for serving.
+
+Serving is HBM-bandwidth-bound: decode steps are GEMVs that stream every
+weight once per token, so halving weight bytes (bf16 -> int8) directly
+buys decode throughput and doubles the model size a chip can serve.  The
+scheme is the standard TPU-friendly one:
+
+- **per-output-channel symmetric int8**: each matmul weight ``W [in, out]``
+  stores ``int8`` codes plus one fp32 scale per output column
+  (``W ~ codes * scale``).  Symmetric (no zero point) keeps the matmul a
+  plain ``dot``; per-channel scales absorb the dynamic-range variance that
+  per-tensor scales would blow up on.
+- **dequantize-at-the-matmul**: the forward multiplies codes back to the
+  activation dtype right at the use site; XLA fuses the
+  ``int8 -> bf16 * scale`` conversion into the matmul's operand load, so
+  nothing materializes a full-precision copy of the weights in HBM — the
+  bytes that move are int8.
+- **embeddings / norms stay high precision**: layernorm scales and biases
+  are tiny, and the tied embedding doubles as the output head where
+  quantization error lands directly on the logits.
+
+Only the per-layer matmul families quantize (``wqkv/wo/w_up/w_down`` for
+the gpt family; ``wq/wkv/wo/w_gate_up/w_down`` for llama).  The
+quantized pytree is a drop-in for the serving paths: `forward`, prefill/
+decode, the worker binary (``--quantize int8``) — training stays in
+bf16/fp32 (this is a serving artifact, not QAT).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# per-layer weight names to quantize, by family (see module docstring)
+_GPT_WEIGHTS = ("wqkv", "wo", "w_up", "w_down")
+_LLAMA_WEIGHTS = ("wq", "wkv", "wo", "w_gate_up", "w_down")
+
+
+class QuantizedTensor:
+    """int8 codes + per-output-channel fp32 scales, posing as the weight.
+
+    Registered as a pytree so it flows through ``jax.jit``/``device_put``
+    like any array; ``__jax_array__`` + the ``@`` operator dequantize at
+    the use site, so model code (``h @ layer["wqkv"]``) runs unchanged.
+    """
+
+    def __init__(self, codes: jax.Array, scale: jax.Array, dtype: Any):
+        self.codes = codes  # int8 [in, out]
+        self.scale = scale  # fp32 [out]
+        self.dtype = dtype  # the activation dtype to dequantize into
+
+    @property
+    def shape(self):
+        return self.codes.shape
+
+    @property
+    def size(self):
+        return self.codes.size
+
+    def dequantize(self) -> jax.Array:
+        # int8 -> fp32 * scale -> activation dtype; XLA fuses this into
+        # the consuming matmul's operand load
+        return (
+            self.codes.astype(jnp.float32) * self.scale
+        ).astype(self.dtype)
+
+    def __jax_array__(self) -> jax.Array:
+        return self.dequantize()
+
+    def __rmatmul__(self, other) -> jax.Array:
+        return other @ self.dequantize()
+
+    def __matmul__(self, other) -> jax.Array:
+        return self.dequantize() @ other
+
+
+def _quantize_weight(w: jax.Array) -> QuantizedTensor:
+    """Per-output-channel symmetric int8 of a ``[in, out]`` matmul weight."""
+    w32 = w.astype(jnp.float32)
+    max_abs = jnp.max(jnp.abs(w32), axis=0)  # [out]
+    scale = jnp.maximum(max_abs / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(codes, scale, w.dtype)
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    lambda t: ((t.codes, t.scale), t.dtype),
+    lambda dtype, leaves: QuantizedTensor(leaves[0], leaves[1], dtype),
+)
+
+
+def quantize_params(params: dict, family: str = "gpt") -> dict:
+    """Quantize a parameter pytree's per-layer matmul weights to int8.
+
+    Embeddings, positional tables, and norm scales stay in their stored
+    dtype.  Returns a new pytree with :class:`QuantizedTensor` leaves in
+    place of the selected weights — serving code consumes it unchanged.
+    """
+    names = _LLAMA_WEIGHTS if family == "llama" else _GPT_WEIGHTS
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = [
+        {
+            k: (_quantize_weight(v) if k in names else v)
+            for k, v in layer.items()
+        }
+        for layer in params["layers"]
+    ]
+    return out
+
+
+def quantized_bytes(params: dict) -> int:
+    """Total parameter bytes as stored (int8 codes count 1 byte)."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -1,0 +1,109 @@
+"""int8 post-training quantization for serving: the quantized pytree is a
+drop-in (same model code), close to the full-precision outputs, and half
+the bytes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.model import (
+    ModelConfig,
+    forward,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.quantize import (
+    QuantizedTensor,
+    quantize_params,
+    quantized_bytes,
+)
+
+TINY = ModelConfig(
+    vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=32, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), TINY)
+
+
+def test_roundtrip_error_is_small(params):
+    w = params["layers"][0]["wqkv"]
+    q = quantize_params(params)["layers"][0]["wqkv"]
+    assert isinstance(q, QuantizedTensor)
+    assert q.codes.dtype == jnp.int8
+    err = np.abs(np.asarray(q.dequantize(), np.float32) -
+                 np.asarray(w, np.float32))
+    # per-channel symmetric int8: max error is scale/2 per channel
+    scale = np.asarray(q.scale)
+    assert (err <= scale / 2 + 1e-7).all()
+
+
+def test_quantized_forward_close_to_full_precision(params):
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                TINY.vocab_size, jnp.int32)
+    full = np.asarray(forward(params, tokens, TINY))
+    quant = np.asarray(
+        jax.jit(lambda p, t: forward(p, t, TINY))(
+            quantize_params(params), tokens
+        )
+    )
+    # int8 weights: logits move a little, the distribution barely
+    assert np.isfinite(quant).all()
+    np.testing.assert_allclose(quant, full, rtol=0.2, atol=0.35)
+    # greedy decisions overwhelmingly agree on the tiny model
+    agree = (quant[:, -1].argmax(-1) == full[:, -1].argmax(-1)).mean()
+    assert agree == 1.0
+
+
+def test_quantized_generate_runs(params):
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate
+
+    prompt = jax.random.randint(jax.random.key(2), (2, 8), 1,
+                                TINY.vocab_size, jnp.int32)
+    out = generate(quantize_params(params), prompt, 4, TINY)
+    assert out.shape == (2, 4)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quantized_bytes_shrink(params):
+    full = quantized_bytes(params)
+    quant = quantized_bytes(quantize_params(params))
+    # fp32 matmul weights -> int8 codes (+small scales): well under half
+    assert quant < 0.45 * full
+
+
+def test_llama_family_quantizes():
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_forward,
+    )
+
+    config = LlamaConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=32, dtype=jnp.float32,
+    )
+    lparams = init_llama_params(jax.random.key(0), config)
+    tokens = jax.random.randint(jax.random.key(3), (2, 16), 0, 128,
+                                jnp.int32)
+    full = np.asarray(llama_forward(lparams, tokens, config))
+    quant = np.asarray(
+        llama_forward(quantize_params(lparams, family="llama"), tokens,
+                      config)
+    )
+    assert np.isfinite(quant).all()
+    assert (quant[:, -1].argmax(-1) == full[:, -1].argmax(-1)).all()
+
+
+def test_worker_binary_serves_quantized():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "4", "--quantize", "int8", "--batch-size", "2",
+                 "--seq-len", "16"])
+    # quantize + generate mode together
+    worker_main(["--demo", "2", "--quantize", "int8", "--batch-size", "2",
+                 "--seq-len", "12", "--generate-tokens", "2"])
