@@ -167,7 +167,7 @@ def stage_partition_specs(stages: dict, mesh: Mesh) -> dict:
 
 def _stage_apply(
     stage_layers: dict, x: jax.Array, config: ModelConfig,
-    remat: bool = False, tp_size: int = 1,
+    remat: bool = False, tp_size: int = 1, attention_fn=None,
 ) -> jax.Array:
     """Run one stage's stacked layers over an activation microbatch.
 
@@ -200,13 +200,17 @@ def _stage_apply(
         jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
         if remat else _block
     )
-    # attention by the measured dispatcher: the Pallas flash kernel on
-    # TPU past its crossover (the pallas_call runs fine inside the
-    # fully-manual body — same situation as the ring kernel hops), the
-    # dense XLA path elsewhere
-    from .flash import attention_fn_for
+    # attention by the measured dispatcher unless the caller injects one
+    # (the seam CPU tests use to run the Pallas kernel in interpret mode
+    # inside the pipelined bodies): the flash kernel on TPU past its
+    # crossover (the pallas_call runs fine inside the fully-manual body —
+    # same situation as the ring kernel hops), the dense XLA path
+    # elsewhere
+    if attention_fn is None:
+        from .flash import attention_fn_for
 
-    attend = attention_fn_for(x.shape[1])
+        attention_fn = attention_fn_for(x.shape[1])
+    attend = attention_fn
 
     def one_layer(h, layer):
         return block(h, layer, cfg, attend, None, reduce, promote), None
@@ -293,6 +297,7 @@ def _pipeline_body(
     axis_size: int,
     remat: bool = False,
     tp_size: int = 1,
+    attention_fn=None,
 ) -> jax.Array:
     """Per-device GPipe schedule (inside a fully-manual ``shard_map``).
 
@@ -328,7 +333,8 @@ def _pipeline_body(
         fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, fresh, act_in)
         act_out = _stage_apply(
-            stage_layers, inp, config, remat=remat, tp_size=tp_size
+            stage_layers, inp, config, remat=remat, tp_size=tp_size,
+            attention_fn=attention_fn,
         )
 
         out_idx = jnp.clip(t - last, 0, n_micro - 1)
@@ -431,6 +437,7 @@ def pipeline_forward(
     pcfg: PipelineConfig,
     mesh: Mesh,
     remat: bool = False,
+    stage_attention=None,
 ) -> jax.Array:
     """Logits via the pipelined layer stack.
 
@@ -461,6 +468,7 @@ def pipeline_forward(
         axis_size=pipe,
         remat=remat,
         tp_size=tp_size,
+        attention_fn=stage_attention,
     )
     # FULLY manual over every mesh axis: the schedule's ppermutes/psums
     # (and, under tp, the Megatron model-axis psums) are all explicit.
@@ -491,12 +499,19 @@ def pipeline_loss_fn(
     mesh: Mesh,
     attention_fn=None,  # accepted for train.make_train_step's loss seam
     remat: bool = False,
+    stage_attention=None,
 ) -> jax.Array:
-    """Mean next-token NLL over all microbatches."""
+    """Mean next-token NLL over all microbatches.
+
+    ``attention_fn`` (the train seam's mesh dispatcher) is deliberately
+    ignored — it wraps its own ``shard_map`` and cannot run inside the
+    fully-manual body; ``stage_attention`` is the pipeline's own
+    injection seam (per-shard kernel, e.g. flash in interpret mode for
+    CPU tests; default: the measured dispatcher)."""
     from .train import next_token_nll
 
     logits = pipeline_forward(params, tokens, config, pcfg, mesh,
-                              remat=remat)
+                              remat=remat, stage_attention=stage_attention)
     m, b, s, v = logits.shape
     return next_token_nll(
         logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
@@ -516,6 +531,7 @@ def _one_f_one_b_body(
     data_size: int,
     remat: bool,
     tp_size: int,
+    attention_fn=None,
 ):
     """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
     every mesh axis — see the module docstring for why partial-manual is
@@ -546,10 +562,12 @@ def _one_f_one_b_body(
     act_shape = x_micro.shape[1:]  # [B_loc, S, D]
 
     def stage_fwd(layers, x):
-        return _stage_apply(layers, x, config, tp_size=tp_size)
+        return _stage_apply(layers, x, config, tp_size=tp_size,
+                            attention_fn=attention_fn)
 
     def stage_fwd_remat(layers, x):
-        return _stage_apply(layers, x, config, remat=remat, tp_size=tp_size)
+        return _stage_apply(layers, x, config, remat=remat, tp_size=tp_size,
+                            attention_fn=attention_fn)
 
     def slot(carry, tables):
         (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
@@ -711,6 +729,7 @@ def one_f_one_b_value_and_grad(
     pcfg: "PipelineConfig",
     mesh: Mesh,
     remat: bool = False,
+    stage_attention=None,
 ):
     """``(loss, grads)`` for the pipelined LM via the 1F1B schedule.
 
@@ -755,6 +774,7 @@ def one_f_one_b_value_and_grad(
         data_size=mesh.shape["data"],
         remat=remat,
         tp_size=mesh.shape.get("model", 1),
+        attention_fn=stage_attention,
     )
     loss, dstages, dhead, dx_micro = jax.shard_map(
         body,

@@ -431,3 +431,61 @@ def test_1f1b_remat_matches_plain_loss_and_learns():
             run.append(float(loss))
         losses[remat] = run
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
+
+
+def test_pipeline_with_flash_kernel_stage_attention():
+    # the stage_attention seam: run the Pallas kernel (interpret mode) as
+    # the per-stage attention inside BOTH pipelined bodies on CPU — the
+    # combination that otherwise only exists on real TPU
+    import functools
+
+    from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        one_f_one_b_value_and_grad,
+    )
+
+    flash_interpret = functools.partial(flash_attention, interpret=True)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    params = as_pipeline_params(init_params(jax.random.key(0), TINY))
+    pcfg = PipelineConfig(n_microbatches=2)
+    tokens = jax.device_put(microtokens(m=2, bm=4),
+                            pipeline_batch_sharding(mesh))
+
+    dense_loss, dense_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss_fn(p, t, TINY, pcfg, mesh)
+        )
+    )(params, tokens)
+    flash_loss, flash_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss_fn(
+                p, t, TINY, pcfg, mesh, stage_attention=flash_interpret
+            )
+        )
+    )(params, tokens)
+    assert float(flash_loss) == pytest.approx(float(dense_loss), rel=1e-5)
+    for (k1, g), (k2, e) in zip(
+        sorted(
+            (jax.tree_util.keystr(k), v) for k, v in
+            jax.tree_util.tree_leaves_with_path(flash_grads)
+        ),
+        sorted(
+            (jax.tree_util.keystr(k), v) for k, v in
+            jax.tree_util.tree_leaves_with_path(dense_grads)
+        ),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(e, np.float32),
+            rtol=5e-4, atol=1e-5, err_msg=k1,
+        )
+
+    # the explicitly-scheduled 1F1B backward through the kernel's custom
+    # vjp (and its remat recompute) agrees too
+    fcfg = PipelineConfig(n_microbatches=2, schedule="1f1b")
+    loss_1f1b, grads_1f1b = jax.jit(
+        lambda p, t: one_f_one_b_value_and_grad(
+            p, t, TINY, fcfg, mesh, remat=True,
+            stage_attention=flash_interpret,
+        )
+    )(params, tokens)
+    assert float(loss_1f1b) == pytest.approx(float(dense_loss), rel=1e-5)
