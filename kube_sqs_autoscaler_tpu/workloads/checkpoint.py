@@ -156,6 +156,13 @@ class TrainCheckpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if (layout or {}).get("kind") == "moe":
+            raise ValueError(
+                "this checkpoint stores MoE expert weights (layout="
+                f"{layout}); the serving worker has no routed-expert "
+                "forward — serve a dense checkpoint, or load the MoE "
+                "state with TrainCheckpointer.restore for training"
+            )
         pipeline_layout = (layout or {}).get("kind") == "pipeline"
         if pipeline_layout:
             from .pipeline import init_pipeline_params, unstack_layers

@@ -77,8 +77,16 @@ class LlamaConfig:
 # placement never depends on whether this module was imported
 
 
-def init_llama_params(rng: jax.Array, config: LlamaConfig) -> dict:
-    """Parameter pytree (scaled-normal init, bf16 storage, fp32 norms)."""
+def init_llama_params(
+    rng: jax.Array, config: LlamaConfig, dense_mlp: bool = True
+) -> dict:
+    """Parameter pytree (scaled-normal init, bf16 storage, fp32 norms).
+
+    ``dense_mlp=False`` skips the per-layer SwiGLU weights — for the MoE
+    variant, which replaces them with routed experts and would otherwise
+    throw the freshly-sampled weights away (same flag as
+    :func:`.model.init_params`).
+    """
     dtype = config.dtype
     head_dim = config.head_dim
     kv_dim = config.n_kv_heads * head_dim
@@ -95,22 +103,22 @@ def init_llama_params(rng: jax.Array, config: LlamaConfig) -> dict:
     out_scale = 0.02 / (2 * config.n_layers) ** 0.5
     for i in range(config.n_layers):
         lk = jax.random.split(keys[1 + i], 4)
-        params["layers"].append(
-            {
-                "attn_norm": jnp.ones((config.d_model,), dtype),
-                "wq": normal(lk[0], (config.d_model, config.d_model), 0.02),
-                "wkv": normal(lk[1], (config.d_model, 2 * kv_dim), 0.02),
-                "wo": normal(lk[2], (config.d_model, config.d_model), out_scale),
-                "mlp_norm": jnp.ones((config.d_model,), dtype),
-                "w_gate_up": normal(
-                    lk[3], (config.d_model, 2 * config.d_ff), 0.02
-                ),
-                "w_down": normal(
-                    jax.random.fold_in(lk[3], 1),
-                    (config.d_ff, config.d_model), out_scale,
-                ),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((config.d_model,), dtype),
+            "wq": normal(lk[0], (config.d_model, config.d_model), 0.02),
+            "wkv": normal(lk[1], (config.d_model, 2 * kv_dim), 0.02),
+            "wo": normal(lk[2], (config.d_model, config.d_model), out_scale),
+            "mlp_norm": jnp.ones((config.d_model,), dtype),
+        }
+        if dense_mlp:
+            layer["w_gate_up"] = normal(
+                lk[3], (config.d_model, 2 * config.d_ff), 0.02
+            )
+            layer["w_down"] = normal(
+                jax.random.fold_in(lk[3], 1),
+                (config.d_ff, config.d_model), out_scale,
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -197,12 +205,15 @@ def _llama_block(
     config: LlamaConfig,
     positions: jax.Array,
     attend,
+    mlp=None,
 ) -> jax.Array:
     """Pre-RMSNorm attention + pre-RMSNorm SwiGLU, residual both.
 
     ``attend(q, k, v) -> [B, H, S, D]`` receives GQA-shaped k/v
     (``H_kv`` heads); the default broadcasts to full heads and runs the
-    shared dense causal kernel.  The single source of truth for the
+    shared dense causal kernel.  ``mlp(h, layer)`` overrides the
+    feed-forward (dense :func:`_swiglu` by default; the routed SwiGLU
+    expert MLP for the MoE variant).  The single source of truth for the
     family's wiring — training forward, prefill, and decode all run it.
     """
     h = _rms_norm(x, layer["attn_norm"])
@@ -211,7 +222,7 @@ def _llama_block(
     batch, _, seq, _ = out.shape
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
     x = x + out @ layer["wo"]
-    return x + _swiglu(_rms_norm(x, layer["mlp_norm"]), layer)
+    return x + (mlp or _swiglu)(_rms_norm(x, layer["mlp_norm"]), layer)
 
 
 def _gqa_wrap(config: LlamaConfig, inner):
@@ -251,6 +262,7 @@ def llama_forward(
     attention_fn=None,
     positions: jax.Array | None = None,
     remat: bool = False,
+    mlp=None,
 ) -> jax.Array:
     """Logits ``[B, S, vocab]`` (fp32, tied-embedding readout).
 
@@ -258,6 +270,8 @@ def llama_forward(
     :func:`repeat_kv` when plugging in an MHA kernel.  ``positions``
     overrides the RoPE positions (decode passes the cache offset).
     ``remat=True`` checkpoints each block like :func:`.model.forward`.
+    ``mlp(h, layer)`` overrides the per-block feed-forward (the MoE
+    variant's routed SwiGLU experts — see :func:`.moe.llama_moe_forward`).
     """
     seq = tokens.shape[1]
     if seq > config.max_seq_len:
@@ -269,11 +283,11 @@ def llama_forward(
     attend = attention_fn or _gqa_dense_attention(config)
     block = _llama_block
     if remat:
-        # config/attend are static; positions is a traced array argument
-        block = jax.checkpoint(_llama_block, static_argnums=(2, 4))
+        # config/attend/mlp are static; positions is a traced argument
+        block = jax.checkpoint(_llama_block, static_argnums=(2, 4, 5))
     x = params["embed"][tokens]
     for layer in params["layers"]:
-        x = block(x, layer, config, positions, attend)
+        x = block(x, layer, config, positions, attend, mlp)
     x = _rms_norm(x, params["final_norm"])
     return jnp.einsum(
         "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32

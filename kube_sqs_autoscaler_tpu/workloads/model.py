@@ -71,6 +71,8 @@ PARAM_AXES = {
     "router": ("model", "experts_out"),
     "w_up_experts": ("expert", "model", "ff"),
     "w_down_experts": ("expert", "ff", "model"),
+    # llama MoE: fused gate+up expert projection (SwiGLU experts)
+    "w_gate_up_experts": ("expert", "model", "ff2"),
     # llama family (workloads.llama): fused kv / gate-up projections shard
     # their output axis tensor-parallel; RMSNorm scales replicate
     "attn_norm": ("model",),

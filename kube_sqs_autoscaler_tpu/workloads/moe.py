@@ -178,19 +178,21 @@ def _top_k_routing(
     return dispatch, combine, aux
 
 
-def moe_mlp(
-    x: jax.Array, layer: dict, moe: MoeConfig
+def _routed_ffn(
+    x: jax.Array, layer: dict, moe: MoeConfig, expert_ffn
 ) -> tuple[jax.Array, jax.Array]:
-    """Sparse MLP: route, dispatch, expert FFN, combine.
+    """The family-agnostic route/dispatch/combine skeleton.
 
     ``x``: ``[B, S, D]`` -> ``([B, S, D], aux_loss)``.  Tokens are routed
     over the **flattened** ``[B*S]`` stream in groups of
-    ``moe.group_size`` (default: one group of all tokens), so routing and
-    capacity are functions of the token stream alone — invariant to how
-    the batch is reshaped.  The dispatch einsums keep a leading group
-    axis that stays sharded over ``"data"`` while the expert axis of the
-    weights is also ``"data"``-sharded — the mismatch is exactly the
-    token all-to-all.
+    ``moe.group_size`` (default: bounded groups from the token count
+    alone), so routing and capacity are functions of the token stream —
+    invariant to how the batch is reshaped.  The dispatch einsums keep a
+    leading group axis that stays sharded over ``"data"`` while the
+    expert axis of the weights is also ``"data"``-sharded — the mismatch
+    is exactly the token all-to-all.  ``expert_ffn(expert_in, layer)``
+    maps ``[E, G, C, D] -> [E, G, C, D]`` (GELU experts for the gpt
+    family, SwiGLU for llama).
     """
     b, s, d = x.shape
     tokens = b * s
@@ -211,15 +213,78 @@ def moe_mlp(
     dispatch = dispatch.astype(x.dtype)
     # [G,T,E,C] x [G,T,D] -> [E,G,C,D]: the forward all-to-all
     expert_in = jnp.einsum("gtec,gtd->egcd", dispatch, xg)
-    hidden = jax.nn.gelu(
-        jnp.einsum("egcd,edf->egcf", expert_in, layer["w_up_experts"])
-    )
-    expert_out = jnp.einsum("egcf,efd->egcd", hidden, layer["w_down_experts"])
+    expert_out = expert_ffn(expert_in, layer)
     # combine (return all-to-all) in fp32 so gate weighting is exact
     out = jnp.einsum(
         "gtec,egcd->gtd", combine, expert_out.astype(jnp.float32)
     )
     return out.reshape(b, s, d).astype(x.dtype), aux
+
+
+def _gelu_experts(expert_in: jax.Array, layer: dict) -> jax.Array:
+    hidden = jax.nn.gelu(
+        jnp.einsum("egcd,edf->egcf", expert_in, layer["w_up_experts"])
+    )
+    return jnp.einsum("egcf,efd->egcd", hidden, layer["w_down_experts"])
+
+
+def _swiglu_experts(expert_in: jax.Array, layer: dict) -> jax.Array:
+    gate_up = jnp.einsum(
+        "egcd,edf->egcf", expert_in, layer["w_gate_up_experts"]
+    )
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jnp.einsum(
+        "egcf,efd->egcd", jax.nn.silu(gate) * up, layer["w_down_experts"]
+    )
+
+
+def moe_mlp(
+    x: jax.Array, layer: dict, moe: MoeConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse MLP for the gpt family: GELU experts behind the shared
+    routing skeleton (:func:`_routed_ffn`)."""
+    return _routed_ffn(x, layer, moe, _gelu_experts)
+
+
+def llama_moe_mlp(
+    x: jax.Array, layer: dict, moe: MoeConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse MLP for the llama family: SwiGLU experts (fused gate+up
+    projection per expert) behind the same routing skeleton."""
+    return _routed_ffn(x, layer, moe, _swiglu_experts)
+
+
+def init_llama_moe_params(
+    rng: jax.Array, config, moe: MoeConfig
+) -> dict:
+    """Llama params with every layer's dense SwiGLU replaced by
+    ``router`` + stacked SwiGLU expert weights (``w_gate_up_experts
+    [E, D, 2F]``, ``w_down_experts [E, F, D]``)."""
+    from .llama import init_llama_params
+
+    base_rng, expert_rng = jax.random.split(rng)
+    params = init_llama_params(base_rng, config, dense_mlp=False)
+    out_scale = 0.02 / (2 * config.n_layers) ** 0.5
+    keys = jax.random.split(expert_rng, 3 * config.n_layers)
+    for i, layer in enumerate(params["layers"]):
+        k_r, k_gu, k_down = keys[3 * i : 3 * i + 3]
+        layer["router"] = (
+            jax.random.normal(k_r, (config.d_model, moe.n_experts), jnp.float32)
+            * 0.02
+        )
+        layer["w_gate_up_experts"] = (
+            jax.random.normal(
+                k_gu, (moe.n_experts, config.d_model, 2 * config.d_ff),
+                jnp.float32,
+            ) * 0.02
+        ).astype(config.dtype)
+        layer["w_down_experts"] = (
+            jax.random.normal(
+                k_down, (moe.n_experts, config.d_ff, config.d_model),
+                jnp.float32,
+            ) * out_scale
+        ).astype(config.dtype)
+    return params
 
 
 def moe_forward(
@@ -247,6 +312,30 @@ def moe_forward(
     return logits, sum(aux_out) / len(aux_out)
 
 
+def llama_moe_forward(
+    params: dict,
+    tokens: jax.Array,
+    config,
+    moe: MoeConfig,
+    attention_fn=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Llama counterpart of :func:`moe_forward`: the routed SwiGLU expert
+    MLP through :func:`.llama.llama_forward`'s ``mlp`` seam (RoPE, GQA,
+    RMSNorm all unchanged)."""
+    from .llama import llama_forward
+
+    aux_out = []
+
+    def sparse_mlp(h, layer):
+        out, aux = llama_moe_mlp(h, layer, moe)
+        aux_out.append(aux)
+        return out
+
+    logits = llama_forward(params, tokens, config, attention_fn,
+                           mlp=sparse_mlp)
+    return logits, sum(aux_out) / len(aux_out)
+
+
 def moe_loss_fn(
     params: Any,
     tokens: jax.Array,
@@ -261,6 +350,21 @@ def moe_loss_fn(
     return next_token_nll(logits, tokens) + moe.aux_loss_weight * aux
 
 
+def llama_moe_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config,
+    moe: MoeConfig,
+    attention_fn=None,
+) -> jax.Array:
+    """Llama-family MoE objective (cross-entropy + weighted aux)."""
+    from .train import next_token_nll
+
+    logits, aux = llama_moe_forward(params, tokens, config, moe,
+                                    attention_fn)
+    return next_token_nll(logits, tokens) + moe.aux_loss_weight * aux
+
+
 def init_moe_train_state(
     rng: jax.Array, config: ModelConfig, moe: MoeConfig, train_config
 ) -> dict:
@@ -271,6 +375,52 @@ def init_moe_train_state(
     return init_train_state(
         rng, config, train_config, init_fn=partial(init_moe_params, moe=moe)
     )
+
+
+def init_llama_moe_train_state(
+    rng: jax.Array, config, moe: MoeConfig, train_config
+) -> dict:
+    from functools import partial
+
+    from .train import init_train_state
+
+    return init_train_state(
+        rng, config, train_config,
+        init_fn=partial(init_llama_moe_params, moe=moe),
+    )
+
+
+def _make_moe_step(mesh, config, moe: MoeConfig, train_config, state: dict,
+                   loss_fn):
+    """Shared MoE step builder: the remat guard and the
+    :func:`.train.make_train_step` delegation live exactly once for both
+    families."""
+    from functools import partial
+
+    from .train import make_train_step
+
+    if getattr(train_config, "remat", False):
+        # the MoE forwards collect per-layer aux losses through a closure
+        # over the mlp seam; jax.checkpoint re-traces the block in the
+        # backward pass, so closure-captured intermediates would leak
+        # tracers.  Fail fast instead of silently ignoring the flag.
+        raise ValueError(
+            "TrainConfig.remat is not supported for the MoE loss (the "
+            "aux-loss collection is incompatible with jax.checkpoint "
+            "re-tracing); set remat=False"
+        )
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(loss_fn, config=config, moe=moe),
+    )
+
+
+def make_llama_moe_train_step(mesh, config, moe: MoeConfig, train_config,
+                              state: dict):
+    """Llama-family MoE optimizer step (same seams and constraints as
+    :func:`make_moe_train_step`)."""
+    return _make_moe_step(mesh, config, moe, train_config, state,
+                          llama_moe_loss_fn)
 
 
 def make_moe_train_step(mesh, config: ModelConfig, moe: MoeConfig,
@@ -291,21 +441,5 @@ def make_moe_train_step(mesh, config: ModelConfig, moe: MoeConfig,
     axis becomes worth it when experts outnumber what dp-sharding can
     hold; revisit then.
     """
-    from functools import partial
-
-    from .train import make_train_step
-
-    if getattr(train_config, "remat", False):
-        # moe_forward collects per-layer aux losses through a closure over
-        # the mlp seam; jax.checkpoint re-traces the block in the backward
-        # pass, so closure-captured intermediates would leak tracers.
-        # Fail fast instead of silently ignoring the flag.
-        raise ValueError(
-            "TrainConfig.remat is not supported for the MoE loss (the "
-            "aux-loss collection is incompatible with jax.checkpoint "
-            "re-tracing); set remat=False"
-        )
-    return make_train_step(
-        mesh, config, train_config, state,
-        loss=partial(moe_loss_fn, config=config, moe=moe),
-    )
+    return _make_moe_step(mesh, config, moe, train_config, state,
+                          moe_loss_fn)

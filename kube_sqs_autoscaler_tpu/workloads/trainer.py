@@ -82,11 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--pipe-microbatches", type=int, default=4,
         help="microbatches per step; batch-size must divide by it",
     )
-    # mixture-of-experts (gpt family)
+    # mixture-of-experts (both families)
     parser.add_argument(
         "--moe", action="store_true",
         help="replace the dense MLP with a top-k routed expert MLP "
-             "(expert parallelism over the data axis)",
+             "(expert parallelism over the data axis; GELU experts for "
+             "gpt, SwiGLU experts for llama)",
     )
     parser.add_argument("--moe-experts", type=int, default=8)
     parser.add_argument("--moe-top-k", type=int, default=2)
@@ -152,8 +153,6 @@ def train(args) -> dict:
                 f"--batch-size {args.batch_size} not divisible by "
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
-    if args.moe and args.family == "llama":
-        raise SystemExit("--moe is gpt-family only")
     if args.moe and args.zigzag:
         raise SystemExit(
             "--moe does not combine with --zigzag (the MoE loss runs the "
@@ -195,11 +194,24 @@ def train(args) -> dict:
             n_layers=args.n_layers, d_ff=d_ff,
             max_seq_len=args.seq_len,
         )
-        state = place_state(
-            mesh,
-            init_llama_train_state(jax.random.key(args.seed), model_config,
-                                   train_config),
-        )
+        if args.moe:
+            from .moe import MoeConfig, init_llama_moe_train_state
+
+            moe_config = MoeConfig(n_experts=args.moe_experts,
+                                   top_k=args.moe_top_k)
+            state = place_state(
+                mesh,
+                init_llama_moe_train_state(
+                    jax.random.key(args.seed), model_config, moe_config,
+                    train_config,
+                ),
+            )
+        else:
+            state = place_state(
+                mesh,
+                init_llama_train_state(jax.random.key(args.seed),
+                                       model_config, train_config),
+            )
     else:
         model_config = ModelConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
@@ -261,15 +273,32 @@ def train(args) -> dict:
         from .checkpoint import MODEL_MANIFEST, load_model_layout, \
             load_model_manifest, save_model_manifest
 
-        layout = (
-            {"kind": "pipeline", "n_stages": pipe} if pipe > 1 else None
-        )
+        if pipe > 1:
+            layout = {"kind": "pipeline", "n_stages": pipe}
+        elif args.moe:
+            layout = {"kind": "moe", "n_experts": args.moe_experts,
+                      "top_k": args.moe_top_k}
+        else:
+            layout = None
         manifest_path = Path(args.checkpoint_dir) / MODEL_MANIFEST
         if manifest_path.exists():
             prior_family, prior_config = load_model_manifest(
                 args.checkpoint_dir
             )
             prior_layout = load_model_layout(args.checkpoint_dir)
+            if (
+                prior_layout is None
+                and layout is not None
+                and layout.get("kind") == "moe"
+                and (prior_family, prior_config)
+                == (args.family, model_config)
+            ):
+                # manifests written before the moe layout record existed:
+                # same flags, same model — upgrade in place rather than
+                # refusing an unchanged resume
+                save_model_manifest(args.checkpoint_dir, args.family,
+                                    model_config, layout=layout)
+                prior_layout = layout
             if (prior_family, prior_config, prior_layout) != (
                 args.family, model_config, layout
             ):
@@ -296,6 +325,11 @@ def train(args) -> dict:
         )
         step_fn = make_pipeline_train_step(mesh, model_config, pipe_config,
                                            train_config, state)
+    elif args.moe and args.family == "llama":
+        from .moe import make_llama_moe_train_step
+
+        step_fn = make_llama_moe_train_step(mesh, model_config, moe_config,
+                                            train_config, state)
     elif args.moe:
         from .moe import make_moe_train_step
 

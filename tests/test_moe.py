@@ -214,3 +214,148 @@ def test_explicit_group_size_routes_per_group():
     # group_size must divide the token count
     with pytest.raises(ValueError, match="divisible"):
         moe_mlp(x[:, :10], layer, MoeConfig(n_experts=4, group_size=16))
+
+
+# ---------------------------------------------------------------- llama MoE
+
+
+def test_single_expert_llama_moe_equals_dense_swiglu():
+    # E=1, top_k=1, ample capacity: routed SwiGLU == the dense SwiGLU
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.llama import _swiglu
+    from kube_sqs_autoscaler_tpu.workloads.moe import MoeConfig, llama_moe_mlp
+
+    w_gate_up = jax.random.normal(jax.random.key(0), (64, 256),
+                                  jnp.float32) * 0.1
+    w_down = jax.random.normal(jax.random.key(1), (128, 64),
+                               jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.key(2), (2, 16, 64), jnp.float32)
+
+    dense = _swiglu(x, {"w_gate_up": w_gate_up, "w_down": w_down})
+    layer = {
+        "router": jnp.zeros((64, 1), jnp.float32),
+        "w_gate_up_experts": w_gate_up[None],
+        "w_down_experts": w_down[None],
+    }
+    sparse, aux = llama_moe_mlp(
+        x, layer, MoeConfig(n_experts=1, top_k=1, capacity_factor=4.0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(sparse), rtol=1e-6, atol=1e-6
+    )
+    assert float(aux) == pytest.approx(1.0)
+
+
+def test_llama_moe_train_step_sharded_learns():
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.llama import LlamaConfig
+    from kube_sqs_autoscaler_tpu.workloads.moe import (
+        MoeConfig,
+        init_llama_moe_train_state,
+        make_llama_moe_train_step,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        TrainConfig,
+        batch_sharding,
+        make_mesh,
+        place_state,
+    )
+
+    config = LlamaConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32,
+    )
+    moe = MoeConfig(n_experts=4, top_k=2)
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
+    train_config = TrainConfig(learning_rate=1e-2)
+    state = place_state(
+        mesh,
+        init_llama_moe_train_state(jax.random.key(0), config, moe,
+                                   train_config),
+    )
+    step_fn = make_llama_moe_train_step(mesh, config, moe, train_config,
+                                        state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, 128, jnp.int32),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_llama_moe_flag():
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    result = trainer_main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "64", "--seq-len", "32",
+        "--batch-size", "8", "--learning-rate", "1e-2", "--log-every", "1",
+        "--steps", "4", "--family", "llama", "--moe", "--moe-experts", "4",
+        "--model-parallel", "2", "--overfit",
+    ])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_checkpoint_refuses_to_serve_with_clear_error(tmp_path):
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        TrainCheckpointer,
+        load_model_layout,
+        load_model_manifest,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    import jax
+
+    ckpt = str(tmp_path / "ckpt")
+    trainer_main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "64", "--seq-len", "32",
+        "--batch-size", "8", "--steps", "2", "--moe", "--moe-experts", "4",
+        "--model-parallel", "2", "--checkpoint-dir", ckpt,
+    ])
+    layout = load_model_layout(ckpt)
+    assert layout["kind"] == "moe"
+    family, config = load_model_manifest(ckpt)
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    with pytest.raises(ValueError, match="routed-expert"):
+        TrainCheckpointer(ckpt).restore_params(mesh, family, config,
+                                               layout=layout)
+
+
+def test_resume_pre_layout_moe_manifest_upgrades(tmp_path):
+    # manifests written before the moe layout record existed (layout
+    # absent) must resume with unchanged flags, not refuse
+    import json
+    from pathlib import Path
+
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        MODEL_MANIFEST,
+        load_model_layout,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    flags = [
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "64", "--seq-len", "32",
+        "--batch-size", "8", "--steps", "2", "--moe", "--moe-experts", "4",
+        "--model-parallel", "2", "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]
+    trainer_main(flags)
+    manifest = Path(tmp_path / "ckpt") / MODEL_MANIFEST
+    payload = json.loads(manifest.read_text())
+    del payload["layout"]  # simulate a pre-layout-record manifest
+    manifest.write_text(json.dumps(payload))
+
+    result = trainer_main(flags + ["--resume"])
+    assert result["final_step"] == 4
+    assert load_model_layout(tmp_path / "ckpt")["kind"] == "moe"
