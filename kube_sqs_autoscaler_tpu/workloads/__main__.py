@@ -41,6 +41,11 @@ def main(argv=None) -> None:
              "mode) instead of one classify forward",
     )
     parser.add_argument(
+        "--temperature", type=float, default=0.0,
+        help="generate-mode sampling temperature (0 = greedy; single-chip "
+             "default path)",
+    )
+    parser.add_argument(
         "--family", choices=("gpt", "llama"), default="gpt",
         help="model family served: gpt (learned pos/MHA) or llama "
              "(RoPE/GQA — n_kv_heads-sized KV cache)",
@@ -182,10 +187,13 @@ def main(argv=None) -> None:
 
             fwd = make_forward_step(mesh, model_config, params)
             _, _, gen = make_serving_fns(mesh, model_config, params)
+        batches = iter(range(10**12))  # per-batch sampling keys
+
         worker_kwargs = {
             "forward_fn": fwd,
             "generate_fn": lambda p, t, n, lengths: gen(
-                p, t, jax.random.key(0), lengths, n
+                p, t, jax.random.key(next(batches)), lengths, n,
+                args.temperature,
             ),
         }
     elif family == "llama":
@@ -200,6 +208,8 @@ def main(argv=None) -> None:
         # power-of-two buckets, and the flash/dense crossover is decided
         # by the actual padded length, not --seq-len) — same policy as
         # the gpt family's default forward in service.QueueWorker
+        batches = iter(range(10**12))  # per-batch sampling keys
+
         worker_kwargs = {
             "forward_fn": lambda p, t: llama_forward_jit_with(
                 p, t, model_config,
@@ -207,6 +217,9 @@ def main(argv=None) -> None:
             ),
             "generate_fn": lambda p, t, n, lengths: llama_generate_jit(
                 p, t, n, model_config,
+                temperature=args.temperature,
+                rng=(jax.random.key(next(batches))
+                     if args.temperature > 0.0 else None),
                 prompt_attention=attention_fn_for(t.shape[1]),
                 lengths=lengths,
             ),
@@ -214,6 +227,7 @@ def main(argv=None) -> None:
     service_config = ServiceConfig(
         queue_url=args.sqs_queue_url, batch_size=args.batch_size,
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,
+        temperature=args.temperature,
     )
 
     if args.continuous:
@@ -222,6 +236,7 @@ def main(argv=None) -> None:
         # variants are batch-mode only for now — fail fast, don't ignore)
         for flag, bad in (("--family llama", family == "llama"),
                           ("--model-parallel", bool(args.model_parallel)),
+                          ("--temperature > 0", args.temperature > 0.0),
                           ("--generate-tokens >= 1 required",
                            args.generate_tokens < 1)):
             if bad:

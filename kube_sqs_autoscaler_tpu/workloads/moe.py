@@ -97,13 +97,14 @@ class MoeConfig:
         )
 
 
-def init_moe_params(
-    rng: jax.Array, config: ModelConfig, moe: MoeConfig
+def _add_expert_weights(
+    params: dict, config, moe: MoeConfig, expert_rng: jax.Array,
+    up_name: str, up_cols: int,
 ) -> dict:
-    """Like :func:`.model.init_params` but every layer's dense MLP is
-    replaced by ``router`` + stacked expert weights."""
-    base_rng, expert_rng = jax.random.split(rng)
-    params = init_params(base_rng, config, dense_mlp=False)
+    """Attach ``router`` + stacked expert weights to every layer of a
+    dense-MLP-free parameter pytree — the init both families share (only
+    the up-projection name/width differs: ``w_up_experts [E, D, F]`` for
+    GELU experts, ``w_gate_up_experts [E, D, 2F]`` for SwiGLU)."""
     out_scale = 0.02 / (2 * config.n_layers) ** 0.5
     keys = jax.random.split(expert_rng, 3 * config.n_layers)
     for i, layer in enumerate(params["layers"]):
@@ -112,9 +113,9 @@ def init_moe_params(
             jax.random.normal(k_r, (config.d_model, moe.n_experts), jnp.float32)
             * 0.02
         )  # router stays fp32: routing decisions are precision-sensitive
-        layer["w_up_experts"] = (
+        layer[up_name] = (
             jax.random.normal(
-                k_up, (moe.n_experts, config.d_model, config.d_ff), jnp.float32
+                k_up, (moe.n_experts, config.d_model, up_cols), jnp.float32
             )
             * 0.02
         ).astype(config.dtype)
@@ -125,6 +126,18 @@ def init_moe_params(
             * out_scale
         ).astype(config.dtype)
     return params
+
+
+def init_moe_params(
+    rng: jax.Array, config: ModelConfig, moe: MoeConfig
+) -> dict:
+    """Like :func:`.model.init_params` but every layer's dense MLP is
+    replaced by ``router`` + stacked expert weights."""
+    base_rng, expert_rng = jax.random.split(rng)
+    params = init_params(base_rng, config, dense_mlp=False)
+    return _add_expert_weights(
+        params, config, moe, expert_rng, "w_up_experts", config.d_ff
+    )
 
 
 def _top_k_routing(
@@ -264,27 +277,10 @@ def init_llama_moe_params(
 
     base_rng, expert_rng = jax.random.split(rng)
     params = init_llama_params(base_rng, config, dense_mlp=False)
-    out_scale = 0.02 / (2 * config.n_layers) ** 0.5
-    keys = jax.random.split(expert_rng, 3 * config.n_layers)
-    for i, layer in enumerate(params["layers"]):
-        k_r, k_gu, k_down = keys[3 * i : 3 * i + 3]
-        layer["router"] = (
-            jax.random.normal(k_r, (config.d_model, moe.n_experts), jnp.float32)
-            * 0.02
-        )
-        layer["w_gate_up_experts"] = (
-            jax.random.normal(
-                k_gu, (moe.n_experts, config.d_model, 2 * config.d_ff),
-                jnp.float32,
-            ) * 0.02
-        ).astype(config.dtype)
-        layer["w_down_experts"] = (
-            jax.random.normal(
-                k_down, (moe.n_experts, config.d_ff, config.d_model),
-                jnp.float32,
-            ) * out_scale
-        ).astype(config.dtype)
-    return params
+    return _add_expert_weights(
+        params, config, moe, expert_rng, "w_gate_up_experts",
+        2 * config.d_ff,
+    )
 
 
 def moe_forward(

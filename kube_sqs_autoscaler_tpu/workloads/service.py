@@ -78,6 +78,11 @@ class ServiceConfig:
     # > 0: decode this many continuation tokens per message (KV-cache
     # generate mode) instead of a single classify forward
     generate_tokens: int = 0
+    # generate-mode sampling: 0 = greedy (default); > 0 = temperature
+    # sampling, seeded per batch from sample_seed + a batch counter so
+    # runs are reproducible but batches are not identical
+    temperature: float = 0.0
+    sample_seed: int = 0
     # set to a directory to capture a JAX device trace of the first
     # profile_cycles serve cycles (utils/profiling.maybe_trace), flushed
     # as soon as the window closes — never the whole (unbounded) loop.
@@ -121,14 +126,30 @@ class QueueWorker:
                 )
         # generate seam: (params, tokens, num_tokens, lengths) — the
         # per-row lengths let ragged right-padded prompts decode from
-        # their own last real token (see decode.generate)
-        self._generate = generate_fn or (
-            lambda params, tokens, n, lengths: generate_jit(
+        # their own last real token (see decode.generate).  The default
+        # honors ServiceConfig.temperature: greedy at 0 (one compiled
+        # program), else temperature sampling with a per-batch key
+        # derived from sample_seed + a batch counter (reproducible runs,
+        # non-identical batches).
+        self._generate_batches = 0
+
+        def _default_generate(params, tokens, n, lengths):
+            import jax
+
+            rng = None
+            if service_config.temperature > 0.0:
+                rng = jax.random.key(
+                    service_config.sample_seed + self._generate_batches
+                )
+            self._generate_batches += 1
+            return generate_jit(
                 params, tokens, n, model_config,
+                temperature=service_config.temperature, rng=rng,
                 attention_fn=attention_fn_for(tokens.shape[1]),
                 lengths=lengths,
             )
-        )
+
+        self._generate = generate_fn or _default_generate
         self._stop = threading.Event()
         self.processed = 0
         # wall-clock cycle spans (summary() gives count/mean/p50/p99/max)

@@ -286,28 +286,32 @@ def train(args) -> dict:
                 args.checkpoint_dir
             )
             prior_layout = load_model_layout(args.checkpoint_dir)
-            if (
-                prior_layout is None
-                and layout is not None
-                and layout.get("kind") == "moe"
-                and (prior_family, prior_config)
-                == (args.family, model_config)
+            if (prior_layout, layout) == (None, None) or (
+                prior_layout is not None and layout is not None
             ):
-                # manifests written before the moe layout record existed:
-                # same flags, same model — upgrade in place rather than
-                # refusing an unchanged resume
-                save_model_manifest(args.checkpoint_dir, args.family,
-                                    model_config, layout=layout)
-                prior_layout = layout
-            if (prior_family, prior_config, prior_layout) != (
-                args.family, model_config, layout
-            ):
+                mismatch = (prior_family, prior_config, prior_layout) != (
+                    args.family, model_config, layout
+                )
+                hint = ""
+            else:
+                # a manifest with no layout record cannot distinguish a
+                # dense run from a pre-layout-record --moe run, and
+                # guessing wrong would corrupt the manifest — refuse with
+                # the migration step instead of auto-upgrading
+                mismatch = True
+                hint = (
+                    "; if this dir WAS trained with these exact flags "
+                    "before the layout record existed, add "
+                    f'"layout": {layout!r} to its model_config.json'
+                    if layout is not None else ""
+                )
+            if mismatch:
                 raise SystemExit(
                     f"checkpoint dir {args.checkpoint_dir} was written by a "
                     f"{prior_family} run with {prior_config} "
                     f"(layout={prior_layout}); this run's flags describe a "
                     f"different model ({args.family}, {model_config}, "
-                    f"layout={layout})"
+                    f"layout={layout}){hint}"
                 )
         else:
             save_model_manifest(args.checkpoint_dir, args.family,

@@ -332,9 +332,11 @@ def test_moe_checkpoint_refuses_to_serve_with_clear_error(tmp_path):
                                                layout=layout)
 
 
-def test_resume_pre_layout_moe_manifest_upgrades(tmp_path):
-    # manifests written before the moe layout record existed (layout
-    # absent) must resume with unchanged flags, not refuse
+def test_resume_pre_layout_manifest_refuses_with_migration_hint(tmp_path):
+    # a manifest with no layout record cannot be told apart from a dense
+    # run's: refusing with the migration step beats guessing (a wrong
+    # auto-upgrade would corrupt a dense dir's manifest); applying the
+    # hinted one-line edit then resumes cleanly
     import json
     from pathlib import Path
 
@@ -353,9 +355,15 @@ def test_resume_pre_layout_moe_manifest_upgrades(tmp_path):
     trainer_main(flags)
     manifest = Path(tmp_path / "ckpt") / MODEL_MANIFEST
     payload = json.loads(manifest.read_text())
-    del payload["layout"]  # simulate a pre-layout-record manifest
+    saved_layout = payload.pop("layout")  # simulate a pre-record manifest
     manifest.write_text(json.dumps(payload))
 
+    with pytest.raises(SystemExit, match="model_config.json"):
+        trainer_main(flags + ["--resume"])
+
+    # the migration the error describes
+    payload["layout"] = saved_layout
+    manifest.write_text(json.dumps(payload))
     result = trainer_main(flags + ["--resume"])
     assert result["final_step"] == 4
     assert load_model_layout(tmp_path / "ckpt")["kind"] == "moe"

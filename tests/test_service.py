@@ -401,3 +401,35 @@ def test_worker_classify_reads_each_rows_last_valid_position():
         np.testing.assert_allclose(
             logits[i, len(ids) - 1], solo[0, -1], rtol=1e-3, atol=1e-3
         )
+
+
+def test_worker_generate_temperature_sampling():
+    """ServiceConfig.temperature > 0 samples (reproducible per seed,
+    different across batches); 0 stays greedy through one compiled path."""
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
+
+    params = init_params(jax.random.key(0), TINY)
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 4, seq_len=12)
+    worker = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=2, seq_len=12,
+                      generate_tokens=4, temperature=0.8, sample_seed=7),
+    )
+    assert worker.run_once() == 2
+    assert worker.run_once() == 2
+    # two batches consumed two distinct per-batch keys
+    assert worker._generate_batches == 2
+
+    # the default path reproduces generate_jit with the same key/config
+    tokens = jnp.zeros((2, 12), jnp.int32)
+    lengths = jnp.full((2,), 12, jnp.int32)
+    worker2 = QueueWorker(
+        queue, params, TINY,
+        ServiceConfig(queue_url=URL, batch_size=2, seq_len=12,
+                      generate_tokens=4, temperature=0.8, sample_seed=7),
+    )
+    got = worker2._generate(params, tokens, 4, lengths)
+    want = generate_jit(params, tokens, 4, TINY, temperature=0.8,
+                        rng=jax.random.key(7), lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
