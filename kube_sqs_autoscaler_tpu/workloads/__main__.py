@@ -171,6 +171,12 @@ def main(argv=None) -> None:
             before / 2**20, quantized_bytes(params) / 2**20,
         )
 
+    service_config = ServiceConfig(
+        queue_url=args.sqs_queue_url, batch_size=args.batch_size,
+        seq_len=args.seq_len, generate_tokens=args.generate_tokens,
+        temperature=args.temperature,
+    )
+
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
     if mesh is not None:
@@ -187,13 +193,13 @@ def main(argv=None) -> None:
 
             fwd = make_forward_step(mesh, model_config, params)
             _, _, gen = make_serving_fns(mesh, model_config, params)
-        batches = iter(range(10**12))  # per-batch sampling keys
+        from .service import sampling_keys
 
+        keys = sampling_keys(service_config.sample_seed)
         worker_kwargs = {
             "forward_fn": fwd,
             "generate_fn": lambda p, t, n, lengths: gen(
-                p, t, jax.random.key(next(batches)), lengths, n,
-                args.temperature,
+                p, t, next(keys), lengths, n, args.temperature
             ),
         }
     elif family == "llama":
@@ -208,8 +214,9 @@ def main(argv=None) -> None:
         # power-of-two buckets, and the flash/dense crossover is decided
         # by the actual padded length, not --seq-len) — same policy as
         # the gpt family's default forward in service.QueueWorker
-        batches = iter(range(10**12))  # per-batch sampling keys
+        from .service import sampling_keys
 
+        keys = sampling_keys(service_config.sample_seed)
         worker_kwargs = {
             "forward_fn": lambda p, t: llama_forward_jit_with(
                 p, t, model_config,
@@ -218,18 +225,11 @@ def main(argv=None) -> None:
             "generate_fn": lambda p, t, n, lengths: llama_generate_jit(
                 p, t, n, model_config,
                 temperature=args.temperature,
-                rng=(jax.random.key(next(batches))
-                     if args.temperature > 0.0 else None),
+                rng=(next(keys) if args.temperature > 0.0 else None),
                 prompt_attention=attention_fn_for(t.shape[1]),
                 lengths=lengths,
             ),
         }
-    service_config = ServiceConfig(
-        queue_url=args.sqs_queue_url, batch_size=args.batch_size,
-        seq_len=args.seq_len, generate_tokens=args.generate_tokens,
-        temperature=args.temperature,
-    )
-
     if args.continuous:
         # rolling-slot serving: single-chip gpt decode path (the slot
         # insertion splices into the per-row cache; mesh-sharded and GQA

@@ -83,30 +83,76 @@ def load_model_layout(directory: str | Path) -> dict | None:
 
 
 class TrainCheckpointer:
-    """Save/restore numbered train-state checkpoints under one directory."""
+    """Save/restore numbered train-state checkpoints under one directory.
 
-    def __init__(self, directory: str | Path):
+    ``keep`` bounds retention: after each completed save, only the newest
+    ``keep`` step directories survive (0/None = keep everything).  A
+    preempted trainer resumes from ``latest_step`` either way; retention
+    is about the disk, not correctness.
+    """
+
+    def __init__(self, directory: str | Path, keep: int | None = None):
+        if keep is not None and keep < 0:
+            raise ValueError(f"keep={keep} must be >= 0")
         self.directory = Path(directory).resolve()
         self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep = keep or 0
         self._ckpt = ocp.StandardCheckpointer()
 
     def _path(self, step: int) -> Path:
         return self.directory / f"step_{step:08d}"
 
     def save(self, state: dict, wait: bool = True) -> Path:
+        """Checkpoint the state (async by default at the orbax layer).
+
+        ``wait=False`` returns while the write streams in the background
+        — the trainer overlaps it with the next steps and calls
+        :meth:`wait_until_finished` (or the next ``save``, which fences)
+        before relying on it.  Retention pruning runs only after a
+        completed save, so an in-flight checkpoint is never the one
+        being deleted.
+        """
         step = int(jax.device_get(state["step"]))
         path = self._path(step)
+        # fence any still-streaming previous async save first (orbax
+        # rejects overlapping saves) — at which point that save is
+        # committed and retention can prune
+        self._ckpt.wait_until_finished()
+        self._prune()
         self._ckpt.save(path, state)
         if wait:
             self._ckpt.wait_until_finished()
+            self._prune()
         return path
 
-    def latest_step(self) -> int | None:
-        steps = sorted(
-            int(p.name.split("_")[1])
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed, then prune."""
+        self._ckpt.wait_until_finished()
+        self._prune()
+
+    def _steps(self) -> list[int]:
+        # only fully-committed step dirs: orbax streams async saves into
+        # temp names like step_NNN.orbax-checkpoint-tmp-*, which must be
+        # invisible to resume and retention
+        import re
+
+        return sorted(
+            int(match.group(1))
             for p in self.directory.glob("step_*")
             if p.is_dir()
+            and (match := re.fullmatch(r"step_(\d+)", p.name))
         )
+
+    def _prune(self) -> None:
+        if not self.keep:
+            return
+        import shutil
+
+        for step in self._steps()[: -self.keep]:
+            shutil.rmtree(self._path(step), ignore_errors=True)
+
+    def latest_step(self) -> int | None:
+        steps = self._steps()
         return steps[-1] if steps else None
 
     def restore(self, mesh: Mesh, reference_state: dict, step: int | None = None) -> dict:

@@ -49,6 +49,18 @@ from .model import ModelConfig, forward_jit_with
 log = logging.getLogger(__name__)
 
 
+def sampling_keys(seed: int):
+    """Endless per-batch PRNG keys ``key(seed), key(seed+1), ...`` — THE
+    seed-per-batch policy every generate-mode serving path shares
+    (reproducible runs, non-identical batches)."""
+    import itertools
+
+    import jax
+
+    for i in itertools.count():
+        yield jax.random.key(seed + i)
+
+
 class MessageQueue(Protocol):
     """What a worker needs from a queue (satisfied by
     :class:`~..metrics.fake.FakeMessageQueue` and
@@ -128,19 +140,15 @@ class QueueWorker:
         # per-row lengths let ragged right-padded prompts decode from
         # their own last real token (see decode.generate).  The default
         # honors ServiceConfig.temperature: greedy at 0 (one compiled
-        # program), else temperature sampling with a per-batch key
-        # derived from sample_seed + a batch counter (reproducible runs,
-        # non-identical batches).
+        # program), else temperature sampling with :func:`sampling_keys`
+        # (the shared seed-per-batch policy).
         self._generate_batches = 0
+        self._sample_keys = sampling_keys(service_config.sample_seed)
 
         def _default_generate(params, tokens, n, lengths):
-            import jax
-
             rng = None
             if service_config.temperature > 0.0:
-                rng = jax.random.key(
-                    service_config.sample_seed + self._generate_batches
-                )
+                rng = next(self._sample_keys)
             self._generate_batches += 1
             return generate_jit(
                 params, tokens, n, model_config,

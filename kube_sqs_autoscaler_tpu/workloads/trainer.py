@@ -21,6 +21,7 @@ autoscaler scales.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import time
 from pathlib import Path
@@ -98,6 +99,10 @@ def build_parser() -> argparse.ArgumentParser:
     # ops
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
+    parser.add_argument(
+        "--checkpoint-keep", type=int, default=0,
+        help="retain only the newest N step checkpoints (0 = keep all)",
+    )
     parser.add_argument("--resume", action="store_true")
     parser.add_argument("--profile-dir", default="")
     parser.add_argument(
@@ -249,7 +254,8 @@ def train(args) -> dict:
     log.info("Model: %s parameters", f"{param_count(state['params']):,}")
 
     checkpointer = (
-        TrainCheckpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+        TrainCheckpointer(args.checkpoint_dir, keep=args.checkpoint_keep)
+        if args.checkpoint_dir else None
     )
     if checkpointer:
         latest = checkpointer.latest_step()
@@ -302,7 +308,8 @@ def train(args) -> dict:
                 hint = (
                     "; if this dir WAS trained with these exact flags "
                     "before the layout record existed, add "
-                    f'"layout": {layout!r} to its model_config.json'
+                    f'"layout": {json.dumps(layout)} to its '
+                    "model_config.json"
                     if layout is not None else ""
                 )
             if mismatch:
@@ -456,12 +463,16 @@ def train(args) -> dict:
             # checkpoint-every 0 = only the final save below
             if (checkpointer and args.checkpoint_every > 0
                     and step % args.checkpoint_every == 0):
-                checkpointer.save(state)
+                # async: the write streams while training continues; the
+                # next save (or the final wait) fences it
+                checkpointer.save(state, wait=False)
                 last_saved = step
                 log.info("Checkpointed step %d", step)
     final_step = int(jax.device_get(state["step"]))
     if checkpointer and last_saved != final_step:
         checkpointer.save(state)
+    elif checkpointer:
+        checkpointer.wait_until_finished()  # fence the last async save
     if obs_server is not None:
         obs_server.stop()
     return {"losses": losses, "final_step": final_step}

@@ -80,3 +80,52 @@ def test_latest_step_and_missing(tmp_path, mesh):
     assert ckpt.latest_step() is None
     with pytest.raises(FileNotFoundError):
         ckpt.restore(mesh, {})
+
+
+def test_checkpoint_retention_keeps_newest_n(tmp_path):
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import TrainCheckpointer
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        TrainConfig,
+        init_train_state,
+    )
+
+    config = ModelConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                         d_ff=64, max_seq_len=16, dtype=jnp.float32)
+    state = init_train_state(jax.random.key(0), config, TrainConfig())
+    ckpt = TrainCheckpointer(tmp_path / "ckpt", keep=2)
+    for step in (1, 2, 3, 4):
+        state["step"] = jnp.asarray(step, jnp.int32)
+        ckpt.save(state)
+    steps = sorted(
+        int(p.name.split("_")[1])
+        for p in (tmp_path / "ckpt").glob("step_*")
+    )
+    assert steps == [3, 4]
+    assert ckpt.latest_step() == 4
+
+
+def test_trainer_checkpoint_keep_flag(tmp_path):
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    ckpt = str(tmp_path / "ckpt")
+    trainer_main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128", "--seq-len", "32",
+        "--batch-size", "8", "--steps", "6", "--checkpoint-dir", ckpt,
+        "--checkpoint-every", "2", "--checkpoint-keep", "1",
+    ])
+    from pathlib import Path
+
+    steps = sorted(p.name for p in Path(ckpt).glob("step_*"))
+    assert steps == ["step_00000006"]
+    # the kept checkpoint resumes
+    result = trainer_main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128", "--seq-len", "32",
+        "--batch-size", "8", "--steps", "2", "--checkpoint-dir", ckpt,
+        "--resume",
+    ])
+    assert result["final_step"] == 8
