@@ -79,13 +79,26 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 DEFAULT_BLOCK = 128  # minimum tile: the MXU/VPU lane width
-PREFERRED_BLOCK = 512  # best-measured tile on TPU v5e (see module docstring)
+# Best-measured tile on TPU v5e: 1024 beats 512 at every measured shape
+# (fwd+bwd, S ∈ {2k, 4k, 8k}, D ∈ {64, 128} — e.g. S=2048/D=128
+# 4.40 -> 2.68 ms); 2048-wide tiles exceed VMEM and fail to compile.
+PREFERRED_BLOCK = 1024
 # Row statistics (logsumexp, Δ) are stored lane-replicated as
 # [B, H, S, 128]: Mosaic requires the last two block dims to be
 # (8, 128)-tiled, so a [bq]-shaped row vector is not a legal output tile —
 # broadcasting each per-row scalar across one lane width is the canonical
 # TPU layout for them (the upstream TPU flash kernel does the same).
 _LANES = 128
+
+# All three kernels iterate (batch, head, outer block, inner block) with the
+# VMEM accumulators carried across the innermost axis only: batch/head/outer
+# are embarrassingly parallel, the inner axis is a sequential reduction.
+# Telling Mosaic so (instead of the all-"arbitrary" default) lets it
+# reorder/pipeline the parallel dims — measured ~10% off fwd+bwd at the
+# flagship train shape (B=8, H=16, S=2048, D=64, TPU v5e).
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+)
 
 
 def tiles_cleanly(seq_len: int) -> bool:
@@ -237,6 +250,7 @@ def _fwd_call(
     out = pl.pallas_call(
         kernel,
         grid=grid,
+        compiler_params=_GRID_SEMANTICS,
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=(q_spec, lse_spec) if need_lse else (q_spec,),
         out_shape=(
@@ -416,6 +430,7 @@ def _bwd_call(
             q_shift=q_shift,
         ),
         grid=(batch, heads, num_q_blocks, num_k_blocks),
+        compiler_params=_GRID_SEMANTICS,
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -439,6 +454,7 @@ def _bwd_call(
             scale=scale, causal=causal, q_shift=q_shift,
         ),
         grid=(batch, kv_heads, num_k_blocks, groups * num_q_blocks),
+        compiler_params=_GRID_SEMANTICS,
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=(kv_spec2, kv_spec2),
         out_shape=(

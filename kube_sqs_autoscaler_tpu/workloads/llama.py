@@ -273,6 +273,27 @@ def llama_forward(
     ``mlp(h, layer)`` overrides the per-block feed-forward (the MoE
     variant's routed SwiGLU experts — see :func:`.moe.llama_moe_forward`).
     """
+    from .model import unembed
+
+    return unembed(
+        llama_forward_hidden(
+            params, tokens, config, attention_fn, positions, remat, mlp
+        ),
+        params["embed"],
+    )
+
+
+def llama_forward_hidden(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    attention_fn=None,
+    positions: jax.Array | None = None,
+    remat: bool = False,
+    mlp=None,
+) -> jax.Array:
+    """:func:`llama_forward` minus the unembedding: final RMS-normed
+    hidden states ``[B, S, d_model]`` (see ``model.forward_hidden``)."""
     seq = tokens.shape[1]
     if seq > config.max_seq_len:
         raise ValueError(
@@ -288,10 +309,7 @@ def llama_forward(
     x = params["embed"][tokens]
     for layer in params["layers"]:
         x = block(x, layer, config, positions, attend, mlp)
-    x = _rms_norm(x, params["final_norm"])
-    return jnp.einsum(
-        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-    )
+    return _rms_norm(x, params["final_norm"])
 
 
 def llama_loss_fn(
@@ -301,10 +319,13 @@ def llama_loss_fn(
     attention_fn=None,
     remat: bool = False,
 ) -> jax.Array:
-    from .train import next_token_nll
+    from .train import fused_next_token_nll
 
-    return next_token_nll(
-        llama_forward(params, tokens, config, attention_fn, remat=remat),
+    return fused_next_token_nll(
+        params["embed"],
+        llama_forward_hidden(
+            params, tokens, config, attention_fn, remat=remat
+        ),
         tokens,
     )
 

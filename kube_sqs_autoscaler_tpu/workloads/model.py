@@ -239,6 +239,52 @@ def _mlp(x: jax.Array, layer: dict) -> jax.Array:
     return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
 
 
+def forward_hidden(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attention_fn=None,
+    mlp=None,
+    positions: jax.Array | None = None,
+    remat: bool = False,
+) -> jax.Array:
+    """Final layernormed hidden states ``[batch, seq, d_model]``.
+
+    The body of :func:`forward` without the unembedding einsum — the
+    training objective (``train.fused_next_token_nll``) consumes the
+    hidden states directly so its backward never has to keep the fp32
+    ``[B, S, vocab]`` logits resident in HBM.
+    """
+    seq = tokens.shape[1]
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    if positions is None:
+        x = params["embed"][tokens] + params["pos_embed"][:seq]
+    else:
+        x = params["embed"][tokens] + params["pos_embed"][positions]
+    # attention_fn is the seam for sequence-parallel ring attention and the
+    # Pallas flash kernel; the default is the dense single-mesh-shard path
+    attend = attention_fn or _dense_attention
+    block = _block
+    if remat:
+        # config/attend/mlp/reduce/promote are static (hashable) arguments
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
+    for layer in params["layers"]:
+        # pass the full arity: jax.checkpoint validates static_argnums
+        # against the actual call's positional args
+        x = block(x, layer, config, attend, mlp, None, None)
+    return _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+
+
+def unembed(x: jax.Array, embed: jax.Array) -> jax.Array:
+    """Tied-embedding readout: fp32 logits for a stable softmax/CE."""
+    return jnp.einsum(
+        "bsd,vd->bsv", x, embed, preferred_element_type=jnp.float32
+    )
+
+
 def forward(
     params: dict,
     tokens: jax.Array,
@@ -263,30 +309,11 @@ def forward(
     so the backward pass recomputes block activations instead of keeping
     them in HBM (identical values, lower peak memory).
     """
-    seq = tokens.shape[1]
-    if seq > config.max_seq_len:
-        raise ValueError(
-            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
-        )
-    if positions is None:
-        x = params["embed"][tokens] + params["pos_embed"][:seq]
-    else:
-        x = params["embed"][tokens] + params["pos_embed"][positions]
-    # attention_fn is the seam for sequence-parallel ring attention and the
-    # Pallas flash kernel; the default is the dense single-mesh-shard path
-    attend = attention_fn or _dense_attention
-    block = _block
-    if remat:
-        # config/attend/mlp/reduce/promote are static (hashable) arguments
-        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
-    for layer in params["layers"]:
-        # pass the full arity: jax.checkpoint validates static_argnums
-        # against the actual call's positional args
-        x = block(x, layer, config, attend, mlp, None, None)
-    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
-    # fp32 logits for a stable softmax/cross-entropy downstream
-    return jnp.einsum(
-        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    return unembed(
+        forward_hidden(
+            params, tokens, config, attention_fn, mlp, positions, remat
+        ),
+        params["embed"],
     )
 
 

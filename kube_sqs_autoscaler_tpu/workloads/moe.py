@@ -283,6 +283,24 @@ def init_llama_moe_params(
     )
 
 
+def _collecting_mlp(expert_mlp, moe: MoeConfig):
+    """The aux-collection seam, in one place: wrap an ``(h, layer, moe) ->
+    (out, aux)`` expert MLP as a ``model``-seam ``mlp(h, layer)`` that
+    appends each layer's aux loss to the returned list; ``mean_aux``
+    reduces the list to the objective's mean aux term."""
+    aux_out = []
+
+    def sparse_mlp(h, layer):
+        out, aux = expert_mlp(h, layer, moe)
+        aux_out.append(aux)
+        return out
+
+    def mean_aux():
+        return sum(aux_out) / len(aux_out)
+
+    return sparse_mlp, mean_aux
+
+
 def moe_forward(
     params: dict,
     tokens: jax.Array,
@@ -297,15 +315,9 @@ def moe_forward(
     into its ``mlp`` seam; the per-layer aux losses are collected through
     the closure.
     """
-    aux_out = []
-
-    def sparse_mlp(h, layer):
-        out, aux = moe_mlp(h, layer, moe)
-        aux_out.append(aux)
-        return out
-
+    sparse_mlp, mean_aux = _collecting_mlp(moe_mlp, moe)
     logits = forward(params, tokens, config, attention_fn, mlp=sparse_mlp)
-    return logits, sum(aux_out) / len(aux_out)
+    return logits, mean_aux()
 
 
 def llama_moe_forward(
@@ -320,16 +332,10 @@ def llama_moe_forward(
     RMSNorm all unchanged)."""
     from .llama import llama_forward
 
-    aux_out = []
-
-    def sparse_mlp(h, layer):
-        out, aux = llama_moe_mlp(h, layer, moe)
-        aux_out.append(aux)
-        return out
-
+    sparse_mlp, mean_aux = _collecting_mlp(llama_moe_mlp, moe)
     logits = llama_forward(params, tokens, config, attention_fn,
                            mlp=sparse_mlp)
-    return logits, sum(aux_out) / len(aux_out)
+    return logits, mean_aux()
 
 
 def moe_loss_fn(
@@ -339,11 +345,18 @@ def moe_loss_fn(
     moe: MoeConfig,
     attention_fn=None,
 ) -> jax.Array:
-    """Next-token cross-entropy + weighted aux loss (fp32)."""
-    from .train import next_token_nll
+    """Next-token cross-entropy + weighted aux loss (fp32).
 
-    logits, aux = moe_forward(params, tokens, config, moe, attention_fn)
-    return next_token_nll(logits, tokens) + moe.aux_loss_weight * aux
+    The cross-entropy goes through ``train.fused_next_token_nll`` (same
+    value, logits-free backward); only the expert-MLP seam differs from
+    the dense objective."""
+    from .model import forward_hidden
+    from .train import fused_next_token_nll
+
+    sparse_mlp, mean_aux = _collecting_mlp(moe_mlp, moe)
+    x = forward_hidden(params, tokens, config, attention_fn, mlp=sparse_mlp)
+    nll = fused_next_token_nll(params["embed"], x, tokens)
+    return nll + moe.aux_loss_weight * mean_aux()
 
 
 def llama_moe_loss_fn(
@@ -354,11 +367,14 @@ def llama_moe_loss_fn(
     attention_fn=None,
 ) -> jax.Array:
     """Llama-family MoE objective (cross-entropy + weighted aux)."""
-    from .train import next_token_nll
+    from .llama import llama_forward_hidden
+    from .train import fused_next_token_nll
 
-    logits, aux = llama_moe_forward(params, tokens, config, moe,
-                                    attention_fn)
-    return next_token_nll(logits, tokens) + moe.aux_loss_weight * aux
+    sparse_mlp, mean_aux = _collecting_mlp(llama_moe_mlp, moe)
+    x = llama_forward_hidden(params, tokens, config, attention_fn,
+                             mlp=sparse_mlp)
+    nll = fused_next_token_nll(params["embed"], x, tokens)
+    return nll + moe.aux_loss_weight * mean_aux()
 
 
 def init_moe_train_state(

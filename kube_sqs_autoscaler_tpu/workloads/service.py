@@ -142,6 +142,8 @@ class QueueWorker:
         # honors ServiceConfig.temperature: greedy at 0 (one compiled
         # program), else temperature sampling with :func:`sampling_keys`
         # (the shared seed-per-batch policy).
+        # observability counter only (batches through the generate path);
+        # sampling reproducibility is driven by _sample_keys, not this
         self._generate_batches = 0
         self._sample_keys = sampling_keys(service_config.sample_seed)
 

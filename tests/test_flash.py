@@ -106,8 +106,8 @@ def test_attention_fn_for_dispatch():
 def test_block_auto_selection():
     from kube_sqs_autoscaler_tpu.workloads.flash import _pick_block
 
-    assert _pick_block(4096, None) == 512  # long S: the fast v5e tile
-    assert _pick_block(2048, None) == 512
+    assert _pick_block(4096, None) == 1024  # long S: the fast v5e tile
+    assert _pick_block(2048, None) == 1024
     assert _pick_block(640, None) == 128  # halves until it divides S
     assert _pick_block(384, None) == 128  # power-of-two only above 128
     assert _pick_block(256, None) == 256
