@@ -46,6 +46,16 @@ def main(argv=None) -> None:
              "default path)",
     )
     parser.add_argument(
+        "--top-k", type=int, default=0,
+        help="sample only the k highest-probability tokens (0 = off; "
+             "needs --temperature > 0)",
+    )
+    parser.add_argument(
+        "--top-p", type=float, default=1.0,
+        help="nucleus sampling: smallest token set with cumulative "
+             "probability >= p (1.0 = off; needs --temperature > 0)",
+    )
+    parser.add_argument(
         "--family", choices=("gpt", "llama"), default="gpt",
         help="model family served: gpt (learned pos/MHA) or llama "
              "(RoPE/GQA — n_kv_heads-sized KV cache)",
@@ -174,7 +184,7 @@ def main(argv=None) -> None:
     service_config = ServiceConfig(
         queue_url=args.sqs_queue_url, batch_size=args.batch_size,
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,
-        temperature=args.temperature,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
     )
 
     # --- compute fns: sharded (mesh) or single-chip ----------------------
@@ -199,7 +209,8 @@ def main(argv=None) -> None:
         worker_kwargs = {
             "forward_fn": fwd,
             "generate_fn": lambda p, t, n, lengths: gen(
-                p, t, next(keys), lengths, n, args.temperature
+                p, t, next(keys), lengths, n, args.temperature,
+                service_config.top_k, service_config.top_p
             ),
         }
     elif family == "llama":
@@ -227,7 +238,8 @@ def main(argv=None) -> None:
                 temperature=args.temperature,
                 rng=(next(keys) if args.temperature > 0.0 else None),
                 prompt_attention=attention_fn_for(t.shape[1]),
-                lengths=lengths,
+                lengths=lengths, top_k=service_config.top_k,
+                top_p=service_config.top_p,
             ),
         }
     if args.continuous:

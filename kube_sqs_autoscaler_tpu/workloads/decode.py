@@ -188,10 +188,59 @@ def decode_step(
     return logits, {"layers": new_layers, "length": pos + 1}
 
 
-def _pick(logits: jax.Array, key: jax.Array | None, temperature: float) -> jax.Array:
+def _mask_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the ``top_k`` highest logits per row, ``-inf`` elsewhere.
+    Ties at the k-th value are all kept (the usual top-k caveat)."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _mask_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (the highest-probability token is always
+    kept), ``-inf`` elsewhere.  One sort over the vocab per row — cheap
+    against the decode step's cache GEMVs."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+    keep = exclusive_cum < top_p  # position 0 always kept (cum 0 < p)
+    kth = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _pick(
+    logits: jax.Array,
+    key: jax.Array | None,
+    temperature: float,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """The one sampling policy for every decode path (both families, all
+    serving surfaces): greedy at ``temperature <= 0``; otherwise
+    temperature-scaled sampling, optionally truncated by ``top_k > 0``
+    and/or nucleus ``top_p < 1`` (applied in that order, on the scaled
+    logits — the conventional composition).
+
+    ``top_k``/``top_p`` are static Python values, so validation raises at
+    trace time (before a worker thread is mid-batch): ``top_k`` must be
+    >= 0 (values past the vocab clamp to it — "keep everything"),
+    ``top_p`` must be in ``(0, 1]`` (0 would mask the argmax too and
+    degenerate to always emitting token 0).
+    """
+    if top_k < 0:
+        raise ValueError(f"top_k={top_k} must be >= 0 (0 = off)")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1] (1.0 = off)")
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        logits = _mask_top_k(logits, min(top_k, logits.shape[-1]))
+    if top_p < 1.0:
+        logits = _mask_top_p(logits, top_p)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
 def generate(
@@ -204,11 +253,14 @@ def generate(
     rng: jax.Array | None = None,
     attention_fn=None,
     lengths: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Generate ``num_tokens`` continuation tokens for each prompt.
 
     Greedy at ``temperature=0`` (default), else temperature sampling with
-    ``rng``.  Pure and jittable end-to-end: prefill once, then a
+    ``rng``, optionally truncated by ``top_k``/nucleus ``top_p`` (see
+    :func:`_pick`).  Pure and jittable end-to-end: prefill once, then a
     ``lax.scan`` of decode steps — one compiled program for the entire
     episode. Returns int32 ``[batch, num_tokens]``.
 
@@ -235,12 +287,12 @@ def generate(
     )
     logits, cache = prefill(params, prompt, config, attention_fn,
                             lengths=lengths)
-    first = _pick(logits, keys[0], temperature)
+    first = _pick(logits, keys[0], temperature, top_k, top_p)
 
     def body(carry, key):
         cache, token = carry
         logits, cache = decode_step(params, cache, token, config)
-        nxt = _pick(logits, key, temperature)
+        nxt = _pick(logits, key, temperature, top_k, top_p)
         return (cache, nxt), token
 
     (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
@@ -250,7 +302,10 @@ def generate(
 
 @partial(
     jax.jit,
-    static_argnames=("num_tokens", "config", "temperature", "attention_fn"),
+    static_argnames=(
+        "num_tokens", "config", "temperature", "attention_fn", "top_k",
+        "top_p",
+    ),
 )
 def generate_jit(
     params: dict,
@@ -261,13 +316,15 @@ def generate_jit(
     rng: jax.Array | None = None,
     attention_fn=None,
     lengths: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
     prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
     own compiled program, exactly like ``model.forward_jit_with``)."""
     return generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
-        attention_fn=attention_fn, lengths=lengths,
+        attention_fn=attention_fn, lengths=lengths, top_k=top_k, top_p=top_p,
     )
 
 
@@ -306,14 +363,16 @@ def compile_serving_fns(
     the cache never reshards between steps.  Family ops (config already
     bound): ``prefill_fn(params, tokens)``,
     ``decode_fn(params, cache, token)``, and
-    ``generate_fn(params, prompt, num_tokens, temperature, rng)``.
+    ``generate_fn(params, prompt, num_tokens, temperature, rng, lengths,
+    top_k, top_p)``.
 
     The returned generate fn's signature is ``(params, prompt, rng,
-    lengths, num_tokens, temperature=0.0)``, all positional (pjit rejects
-    kwargs when in_shardings is set); rng is required — pass any key under
-    greedy (temperature=0 ignores it) — and so are ``lengths`` (pass the
-    full prompt length per row when nothing is padded), so ragged and
-    full batches share the compiled layout.
+    lengths, num_tokens, temperature=0.0, top_k=0, top_p=1.0)``, all
+    positional (pjit rejects kwargs when in_shardings is set); rng is
+    required — pass any key under greedy (temperature=0 ignores it) — and
+    so are ``lengths`` (pass the full prompt length per row when nothing
+    is padded), so ragged and full batches share the compiled layout.
+    ``top_k``/``top_p`` are static (see ``_pick``).
     """
     from .train import param_shardings
 
@@ -341,13 +400,14 @@ def compile_serving_fns(
         donate_argnums=1,  # reuse the cache buffers step to step
     )
 
-    def _generate(params, prompt, rng, lengths, num_tokens, temperature=0.0):
+    def _generate(params, prompt, rng, lengths, num_tokens,
+                  temperature=0.0, top_k=0, top_p=1.0):
         return generate_fn(params, prompt, num_tokens, temperature, rng,
-                           lengths)
+                           lengths, top_k, top_p)
 
     generate_jit_fn = jax.jit(
         _generate,
-        static_argnames=("num_tokens", "temperature"),
+        static_argnames=("num_tokens", "temperature", "top_k", "top_p"),
         in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P()),
                       tokens_1d),
         out_shardings=tokens_2d,
@@ -366,9 +426,11 @@ def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
         template,
         partial(prefill, config=config),
         partial(decode_step, config=config),
-        lambda params, prompt, num_tokens, temperature, rng, lengths:
+        lambda params, prompt, num_tokens, temperature, rng, lengths,
+               top_k, top_p:
             generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
+                top_k=top_k, top_p=top_p,
             ),
     )

@@ -486,10 +486,13 @@ def llama_generate(
     rng: jax.Array | None = None,
     prompt_attention=None,
     lengths: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
-    """Greedy/temperature generation, one compiled program (same contract
-    and scan structure as :func:`.decode.generate`, including ragged
-    prompts via ``lengths``).  ``prompt_attention`` selects the prefill
+    """Greedy/temperature/top-k/top-p generation, one compiled program
+    (same contract and scan structure as :func:`.decode.generate`,
+    including ragged prompts via ``lengths``; sampling policy is
+    ``decode._pick``).  ``prompt_attention`` selects the prefill
     kernel (see :func:`llama_prefill`)."""
     from .decode import _pick
 
@@ -510,12 +513,12 @@ def llama_generate(
     )
     logits, cache = llama_prefill(params, prompt, config, prompt_attention,
                                   lengths=lengths)
-    first = _pick(logits, keys[0], temperature)
+    first = _pick(logits, keys[0], temperature, top_k, top_p)
 
     def body(carry, key):
         cache, token = carry
         logits, cache = llama_decode_step(params, cache, token, config)
-        nxt = _pick(logits, key, temperature)
+        nxt = _pick(logits, key, temperature, top_k, top_p)
         return (cache, nxt), token
 
     (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
@@ -545,10 +548,12 @@ def make_llama_serving_fns(mesh, config: LlamaConfig, params: dict):
         template,
         partial(llama_prefill, config=config),
         partial(llama_decode_step, config=config),
-        lambda params, prompt, num_tokens, temperature, rng, lengths:
+        lambda params, prompt, num_tokens, temperature, rng, lengths,
+               top_k, top_p:
             llama_generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
+                top_k=top_k, top_p=top_p,
             ),
     )
 
@@ -573,7 +578,10 @@ def llama_forward_jit_with(
 
 @partial(
     jax.jit,
-    static_argnames=("num_tokens", "config", "temperature", "prompt_attention"),
+    static_argnames=(
+        "num_tokens", "config", "temperature", "prompt_attention", "top_k",
+        "top_p",
+    ),
 )
 def llama_generate_jit(
     params: dict,
@@ -584,8 +592,11 @@ def llama_generate_jit(
     rng: jax.Array | None = None,
     prompt_attention=None,
     lengths: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     return llama_generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
-        prompt_attention=prompt_attention, lengths=lengths,
+        prompt_attention=prompt_attention, lengths=lengths, top_k=top_k,
+        top_p=top_p,
     )

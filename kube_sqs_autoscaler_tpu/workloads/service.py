@@ -92,9 +92,13 @@ class ServiceConfig:
     generate_tokens: int = 0
     # generate-mode sampling: 0 = greedy (default); > 0 = temperature
     # sampling, seeded per batch from sample_seed + a batch counter so
-    # runs are reproducible but batches are not identical
+    # runs are reproducible but batches are not identical.  top_k > 0 /
+    # top_p < 1 truncate the sampled distribution (decode._pick — ignored
+    # under greedy).
     temperature: float = 0.0
     sample_seed: int = 0
+    top_k: int = 0
+    top_p: float = 1.0
     # set to a directory to capture a JAX device trace of the first
     # profile_cycles serve cycles (utils/profiling.maybe_trace), flushed
     # as soon as the window closes — never the whole (unbounded) loop.
@@ -156,7 +160,8 @@ class QueueWorker:
                 params, tokens, n, model_config,
                 temperature=service_config.temperature, rng=rng,
                 attention_fn=attention_fn_for(tokens.shape[1]),
-                lengths=lengths,
+                lengths=lengths, top_k=service_config.top_k,
+                top_p=service_config.top_p,
             )
 
         self._generate = generate_fn or _default_generate

@@ -174,11 +174,23 @@ class TrainConfig:
     decay_steps: int = 0
     min_lr_ratio: float = 0.1
 
+    # > 0: clip the global gradient norm to this value before the Adam
+    # update (optax.clip_by_global_norm — the global norm is computed over
+    # the whole pytree, so under SPMD the all-reduce of sharded-grad norms
+    # is inserted by XLA; the clip composes with grad_accum and with
+    # pipeline's hand-built value_and_grad alike since it acts on the
+    # final gradient).  0 = no clipping (default, matches prior behavior).
+    grad_clip_norm: float = 0.0
+
     def __post_init__(self) -> None:
         if self.grad_accum < 1:
             raise ValueError(f"grad_accum={self.grad_accum} must be >= 1")
         if self.warmup_steps < 0 or self.decay_steps < 0:
             raise ValueError("warmup_steps/decay_steps must be >= 0")
+        if self.grad_clip_norm < 0:
+            raise ValueError(
+                f"grad_clip_norm={self.grad_clip_norm} must be >= 0"
+            )
 
     def schedule(self):
         """The optax learning-rate schedule this config describes."""
@@ -198,10 +210,15 @@ class TrainConfig:
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
-    return optax.adamw(
+    adamw = optax.adamw(
         config.schedule(), b1=config.b1, b2=config.b2,
         weight_decay=config.weight_decay,
     )
+    if config.grad_clip_norm > 0:
+        return optax.chain(
+            optax.clip_by_global_norm(config.grad_clip_norm), adamw
+        )
+    return adamw
 
 
 def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -337,7 +354,11 @@ def state_shardings(
     """
     p_shardings = (param_shardings_fn or param_shardings)(mesh, state["params"])
 
-    # optax.adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/others)
+    # optax.adamw state: (ScaleByAdamState(count, mu, nu), EmptyState/...);
+    # wrapping transforms (e.g. the grad_clip_norm chain) nest that tuple
+    # one level deeper, so the walk recurses through plain tuples
+    # (NamedTuple states like ScaleByAdamState/EmptyState are handled as
+    # leaves — they carry _fields).
     def shard_opt(opt_state):
         def map_one(entry):
             if hasattr(entry, "mu"):  # ScaleByAdamState
@@ -346,6 +367,8 @@ def state_shardings(
                     mu=p_shardings,
                     nu=p_shardings,
                 )
+            if isinstance(entry, tuple) and not hasattr(entry, "_fields"):
+                return tuple(map_one(e) for e in entry)
             return jax.tree.map(lambda _: replicated(mesh), entry)
 
         return tuple(map_one(e) for e in opt_state)

@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--warmup-steps", type=int, default=0)
     parser.add_argument("--decay-steps", type=int, default=0)
     parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument(
+        "--grad-clip-norm", type=float, default=0.0,
+        help="clip the global gradient norm before the optimizer update "
+             "(0 = off)",
+    )
     parser.add_argument("--remat", action="store_true")
     # parallelism
     parser.add_argument("--model-parallel", type=int, default=1)
@@ -167,7 +172,7 @@ def train(args) -> dict:
     train_config = TrainConfig(
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps, remat=args.remat,
-        grad_accum=args.grad_accum,
+        grad_accum=args.grad_accum, grad_clip_norm=args.grad_clip_norm,
     )
     if pipe > 1:
         from .pipeline import make_pipeline_mesh

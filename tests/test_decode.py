@@ -104,6 +104,79 @@ def test_generate_rejects_zero_tokens(params):
         generate(params, prompt_tokens(), 0, TINY)
 
 
+def test_top_k_one_equals_greedy(params):
+    # the top-1 truncation leaves only the argmax, so sampling at any
+    # temperature reproduces the greedy sequence key-independently
+    prompt = prompt_tokens()
+    greedy = generate(params, prompt, 6, TINY)
+    for seed in (0, 1):
+        sampled = generate(
+            params, prompt, 6, TINY, temperature=1.7,
+            rng=jax.random.key(seed), top_k=1,
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_top_p_tiny_equals_greedy(params):
+    # nucleus with p -> 0 keeps only the highest-probability token
+    prompt = prompt_tokens()
+    greedy = generate(params, prompt, 6, TINY)
+    sampled = generate(
+        params, prompt, 6, TINY, temperature=1.3,
+        rng=jax.random.key(3), top_p=1e-6,
+    )
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_top_k_top_p_masks():
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        _mask_top_k,
+        _mask_top_p,
+    )
+
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.15, 0.1]], jnp.float32))
+    # top-2 keeps exactly the two largest
+    kept = np.isfinite(np.asarray(_mask_top_k(logits, 2)))
+    assert kept.tolist() == [[True, True, False, False]]
+    # p=0.7: {0.5} reaches only 0.5 < 0.7, so the second token is needed
+    kept = np.isfinite(np.asarray(_mask_top_p(logits, 0.7)))
+    assert kept.tolist() == [[True, True, False, False]]
+    # p=1.0 keeps everything; surviving logits are untouched
+    full = np.asarray(_mask_top_p(logits, 1.0))
+    np.testing.assert_array_equal(full, np.asarray(logits))
+    # the top token always survives even with p ~ 0
+    kept = np.isfinite(np.asarray(_mask_top_p(logits, 1e-9)))
+    assert kept.tolist() == [[True, False, False, False]]
+
+
+def test_sampling_param_validation(params):
+    from kube_sqs_autoscaler_tpu.workloads.decode import _pick
+
+    logits = jnp.zeros((1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="top_p"):
+        _pick(logits, jax.random.key(0), 1.0, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        _pick(logits, jax.random.key(0), 1.0, top_k=-1)
+    # top_k past the vocab clamps to "keep everything" instead of crashing
+    out = _pick(logits, jax.random.key(0), 1.0, top_k=100000)
+    assert out.shape == (1,)
+
+
+def test_sampled_support_respects_top_k(params):
+    # with temperature sampling over k=2, every generated token must come
+    # from that step's two most likely tokens — check the first step
+    prompt = prompt_tokens()
+    logits, _ = prefill(params, prompt, TINY)
+    top2 = np.asarray(jax.lax.top_k(logits, 2)[1])
+    for seed in range(4):
+        first = np.asarray(
+            generate(params, prompt, 1, TINY, temperature=2.0,
+                     rng=jax.random.key(seed), top_k=2)
+        )[:, 0]
+        for row in range(first.shape[0]):
+            assert first[row] in top2[row]
+
+
 def test_prefill_through_flash_attention_seam_matches_dense(params):
     import functools
 
@@ -170,6 +243,10 @@ def test_sharded_serving_matches_single_device(params):
     a = generate_fn(params, prompt, jax.random.key(3), lengths, 6, 0.9)
     b = generate_fn(params, prompt, jax.random.key(3), lengths, 6, 0.9)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # top-k/top-p ride the sharded contract too: top_k=1 is greedy
+    k1 = generate_fn(params, prompt, jax.random.key(4), lengths, 6, 0.9, 1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(expected))
 
     logits, cache = prefill_fn(params, prompt)
     ref_logits = forward(params, prompt, TINY)[:, -1]

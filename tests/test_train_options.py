@@ -82,6 +82,74 @@ def test_grad_accum_with_remat_learns_on_full_mesh():
     assert losses[-1] < losses[0]
 
 
+def test_grad_clip_bounds_the_update():
+    """With an aggressively small clip norm, the parameter update per step
+    is bounded by ~lr * clip (Adam normalizes, but the clipped gradient's
+    global norm caps what the moments can see on step one); the unclipped
+    step must differ — proving the clip transform is actually in the
+    chain."""
+    from kube_sqs_autoscaler_tpu.workloads.train import make_optimizer
+    import optax
+
+    params = init_params(jax.random.key(0), TINY)
+    tokens = tokens_batch()
+    _, grads = jax.value_and_grad(loss_fn)(params, tokens, TINY)
+
+    clipped_cfg = TrainConfig(learning_rate=1e-3, grad_clip_norm=1e-3)
+    plain_cfg = TrainConfig(learning_rate=1e-3)
+    for cfg in (clipped_cfg, plain_cfg):
+        opt = make_optimizer(cfg)
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        norm = float(optax.global_norm(updates))
+        if cfg.grad_clip_norm:
+            clipped_norm = norm
+        else:
+            plain_norm = norm
+    assert clipped_norm != plain_norm
+    # the clipped gradient has global norm <= 1e-3, so Adam's step-one
+    # update is epsilon-dominated and far smaller than the plain one
+    assert clipped_norm < plain_norm
+
+
+def test_grad_clip_state_shardings_keep_moments_sharded():
+    """The clip chain nests the adamw state one tuple deeper —
+    state_shardings must still shard mu/nu like the params (a flat walk
+    would silently replicate them)."""
+    from jax.sharding import PartitionSpec as P
+    from kube_sqs_autoscaler_tpu.workloads.train import state_shardings
+
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=1)
+    config = TrainConfig(grad_clip_norm=1.0)
+    state = init_train_state(jax.random.key(0), TINY, config)
+    shardings = state_shardings(mesh, state)
+
+    def find_adam(entry):
+        if hasattr(entry, "mu"):
+            return entry
+        if isinstance(entry, tuple):
+            for e in entry:
+                found = find_adam(e)
+                if found is not None:
+                    return found
+        return None
+
+    adam = find_adam(shardings["opt_state"])
+    assert adam is not None
+    # wqkv shards its output axis over "model" — its moments must too
+    assert adam.mu["layers"][0]["wqkv"].spec == P(None, "model")
+    assert adam.nu["layers"][0]["wqkv"].spec == P(None, "model")
+    # and the clipped step still runs + learns on the mesh
+    placed = place_state(mesh, state)
+    step_fn = make_train_step(mesh, TINY, config, placed)
+    tokens = jax.device_put(tokens_batch(), batch_sharding(mesh))
+    losses = []
+    for _ in range(3):
+        placed, loss = step_fn(placed, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
 def test_grad_accum_validation():
     with pytest.raises(ValueError, match="grad_accum"):
         TrainConfig(grad_accum=0)
