@@ -67,6 +67,12 @@ def main(argv=None) -> None:
              "default: random init — smoke/bench mode)",
     )
     parser.add_argument(
+        "--hf-checkpoint", default="", metavar="DIR",
+        help="serve a Hugging Face Llama checkpoint directory "
+             "(transformers format; converted via workloads.hf_convert — "
+             "implies --family llama)",
+    )
+    parser.add_argument(
         "--model-parallel", type=int, default=0, metavar="TP",
         help="shard serving over a (data, model) mesh with this "
              "tensor-parallel degree (0 = single chip)",
@@ -98,15 +104,46 @@ def main(argv=None) -> None:
         raise SystemExit(
             "--quantize int8 is single-chip serving; drop --model-parallel"
         )
+    if args.top_k < 0:
+        raise SystemExit(f"--top-k {args.top_k} must be >= 0 (0 = off)")
+    if not 0.0 < args.top_p <= 1.0:
+        raise SystemExit(
+            f"--top-p {args.top_p} must be in (0, 1] (1.0 = off)"
+        )
 
     import jax
 
     from .model import ModelConfig, init_params
     from .service import QueueWorker, ServiceConfig
 
+    if args.hf_checkpoint and args.checkpoint_dir:
+        raise SystemExit(
+            "--hf-checkpoint and --checkpoint-dir are mutually exclusive"
+        )
+
     # --- model: architecture from the trainer's manifest, or built-in ----
     needed_ctx = max(64, args.seq_len + args.generate_tokens)
-    if args.checkpoint_dir:
+    hf_params = None
+    if args.hf_checkpoint:
+        from .hf_convert import load_hf_llama
+
+        family = "llama"
+        model_config, hf_params = load_hf_llama(args.hf_checkpoint)
+        log.info(
+            "Imported HF llama checkpoint %s (d_model=%d layers=%d "
+            "heads=%d/%d, %s readout)",
+            args.hf_checkpoint, model_config.d_model, model_config.n_layers,
+            model_config.n_heads, model_config.n_kv_heads,
+            "untied" if "lm_head" in hf_params else "tied",
+        )
+        needed = args.seq_len + args.generate_tokens
+        if model_config.max_seq_len < needed:
+            raise SystemExit(
+                f"HF model has max_seq_len={model_config.max_seq_len} < "
+                f"seq_len + generate_tokens = {needed}; lower "
+                "--seq-len/--generate-tokens"
+            )
+    elif args.checkpoint_dir:
         from .checkpoint import load_model_layout, load_model_manifest
 
         family, model_config = load_model_manifest(args.checkpoint_dir)
@@ -150,7 +187,11 @@ def main(argv=None) -> None:
         log.info("Serving mesh: %s over %d devices", dict(mesh.shape),
                  mesh.size)
 
-    if args.checkpoint_dir:
+    if hf_params is not None:
+        params = hf_params
+        if mesh is not None:
+            params = jax.device_put(params, param_shardings(mesh, params))
+    elif args.checkpoint_dir:
         from .checkpoint import TrainCheckpointer
 
         restore_mesh = mesh or make_mesh(jax.devices()[:1], model_parallel=1)

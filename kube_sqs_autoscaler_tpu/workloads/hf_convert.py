@@ -1,0 +1,184 @@
+"""Hugging Face Llama checkpoint import.
+
+Users of mainstream frameworks arrive with weights, not configs — this
+module converts a ``transformers`` Llama checkpoint (a model instance, a
+state dict, or a saved directory) into this package's llama-family
+pytree, so the same weights serve/fine-tune here (no reference
+counterpart: the reference has no model code at all, SURVEY.md §2).
+
+Three conventions differ and are handled explicitly:
+
+- **Layout**: ``nn.Linear`` stores ``[out, in]`` and computes ``x Wᵀ``;
+  this package stores ``[in, out]`` and computes ``x @ W`` — every
+  projection transposes.
+- **Fusions**: ``k_proj``/``v_proj`` concatenate into ``wkv``;
+  ``gate_proj``/``up_proj`` into ``w_gate_up`` (both on the output axis,
+  matching the splits in ``llama._project_qkv`` / ``llama._swiglu``).
+- **RoPE pairing**: HF rotates half-split pairs ``(x[i], x[i + D/2])``
+  (``rotate_half``); this package rotates interleaved pairs
+  ``(x[2i], x[2i+1])``.  Both use frequency ``theta^{-2i/D}`` for pair
+  ``i``, so permuting each head's q/k *output* channels with
+  ``[0, D/2, 1, D/2+1, ...]`` makes the interleaved rotation compute
+  exactly what HF's half-split rotation computes.  The attention output
+  is a sum over channels of ``softmax(q·k)``, invariant to the (shared)
+  channel permutation, and ``v``/``wo`` are untouched — logits match to
+  float tolerance (``tests/test_hf_convert.py`` asserts it against
+  ``transformers``' own forward).
+
+Untied checkpoints (``tie_word_embeddings=False``, e.g. Llama-2) import
+their ``lm_head`` as a separate parameter; ``llama.readout_weights``
+prefers it everywhere logits are produced.  ``rms_norm_eps`` and
+``rope_theta`` are carried into :class:`~.llama.LlamaConfig` so Llama-2's
+1e-5 epsilon is honored.
+
+Torch is imported lazily and only on the host — the converted pytree is
+plain device arrays; nothing torch survives into the jit path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def llama_config_from_hf(hf_config: Any, dtype: Any = None) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`~.llama.LlamaConfig`.
+
+    ``head_dim`` must equal ``hidden_size // num_attention_heads`` (the
+    only geometry this family implements); models overriding it raise.
+    """
+    head_dim = getattr(hf_config, "head_dim", None)
+    if head_dim and head_dim != hf_config.hidden_size // hf_config.num_attention_heads:
+        raise ValueError(
+            f"unsupported head_dim override: {head_dim} != "
+            f"{hf_config.hidden_size // hf_config.num_attention_heads}"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+    )
+
+
+def _interleave_perm(head_dim: int) -> np.ndarray:
+    """Channel permutation mapping HF's half-split RoPE layout to the
+    interleaved layout: output channel ``2i`` takes HF channel ``i``,
+    ``2i+1`` takes ``i + D/2``."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def _rope_permute(w_t: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Permute the per-head output channels of a transposed projection
+    ``[d_model, n_heads * head_dim]`` with :func:`_interleave_perm`."""
+    d_model = w_t.shape[0]
+    perm = _interleave_perm(head_dim)
+    return (
+        w_t.reshape(d_model, n_heads, head_dim)[:, :, perm]
+        .reshape(d_model, n_heads * head_dim)
+    )
+
+
+def _to_numpy(tensor: Any) -> np.ndarray:
+    # torch tensor (possibly bf16, which numpy lacks) -> fp32 ndarray
+    return tensor.detach().to("cpu").float().numpy()
+
+
+def llama_params_from_hf(
+    state_dict: dict, config: LlamaConfig, dtype: Any = None
+) -> dict:
+    """Convert an HF Llama ``state_dict`` into this package's pytree.
+
+    Accepts torch tensors or numpy arrays as values; keys follow the
+    ``transformers`` naming (``model.layers.N.self_attn.q_proj.weight``
+    etc.).  ``dtype`` defaults to ``config.dtype`` (bf16 storage; pass
+    ``jnp.float32`` for exactness tests).
+    """
+    dtype = dtype if dtype is not None else config.dtype
+
+    def get(name):
+        w = state_dict[name]
+        w = w if isinstance(w, np.ndarray) else _to_numpy(w)
+        return w.astype(np.float32)
+
+    def as_param(w):
+        return jnp.asarray(w).astype(dtype)
+
+    head_dim = config.head_dim
+    params = {
+        "embed": as_param(get("model.embed_tokens.weight")),
+        "final_norm": as_param(get("model.norm.weight")),
+        "layers": [],
+    }
+    if "lm_head.weight" in state_dict:
+        params["lm_head"] = as_param(get("lm_head.weight"))
+    for i in range(config.n_layers):
+        prefix = f"model.layers.{i}."
+        wq = _rope_permute(
+            get(prefix + "self_attn.q_proj.weight").T, config.n_heads,
+            head_dim,
+        )
+        wk = _rope_permute(
+            get(prefix + "self_attn.k_proj.weight").T, config.n_kv_heads,
+            head_dim,
+        )
+        wv = get(prefix + "self_attn.v_proj.weight").T
+        params["layers"].append(
+            {
+                "attn_norm": as_param(get(prefix + "input_layernorm.weight")),
+                "wq": as_param(wq),
+                "wkv": as_param(np.concatenate([wk, wv], axis=1)),
+                "wo": as_param(get(prefix + "self_attn.o_proj.weight").T),
+                "mlp_norm": as_param(
+                    get(prefix + "post_attention_layernorm.weight")
+                ),
+                "w_gate_up": as_param(
+                    np.concatenate(
+                        [
+                            get(prefix + "mlp.gate_proj.weight").T,
+                            get(prefix + "mlp.up_proj.weight").T,
+                        ],
+                        axis=1,
+                    )
+                ),
+                "w_down": as_param(get(prefix + "mlp.down_proj.weight").T),
+            }
+        )
+    return params
+
+
+def load_hf_llama(
+    source: Any, dtype: Any = None
+) -> tuple[LlamaConfig, dict]:
+    """One-call import: ``(LlamaConfig, params)`` from an HF source.
+
+    ``source`` is a ``transformers`` Llama model instance (e.g. just
+    constructed or ``from_pretrained``-loaded) or a checkpoint directory
+    path; directories load via ``LlamaForCausalLM.from_pretrained`` on
+    the CPU.  ``dtype`` sets the parameter storage dtype (default bf16).
+    """
+    if isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
+        from transformers import LlamaForCausalLM
+
+        source = LlamaForCausalLM.from_pretrained(source)
+    config = llama_config_from_hf(source.config, dtype=dtype)
+    state = dict(source.state_dict())
+    if getattr(source.config, "tie_word_embeddings", False):
+        # tied checkpoints may still materialize lm_head.weight as a view
+        # of the embedding — drop it so readout_weights uses the tie
+        state.pop("lm_head.weight", None)
+    return config, llama_params_from_hf(state, config, dtype=dtype)

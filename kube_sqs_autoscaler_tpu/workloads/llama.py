@@ -53,6 +53,9 @@ class LlamaConfig:
     d_ff: int = 1408  # SwiGLU convention: ~2/3 * 4 * d_model, 128-aligned
     max_seq_len: int = 1024
     rope_theta: float = 10_000.0
+    # RMSNorm epsilon: 1e-6 is the Llama-1/3 convention; Llama-2
+    # checkpoints ship 1e-5 (carried through by .hf_convert)
+    rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
 
     @property
@@ -122,13 +125,23 @@ def init_llama_params(
     return params
 
 
-def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+def _rms_norm(
+    x: jax.Array, scale: jax.Array, eps: float = 1e-6
+) -> jax.Array:
     """fp32 statistics, model-dtype output (no mean subtraction, no bias)."""
     x32 = x.astype(jnp.float32)
     normed = x32 * jax.lax.rsqrt(
-        jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps
     )
     return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def readout_weights(params: dict) -> jax.Array:
+    """The unembedding matrix ``[vocab, d_model]``: a separate ``lm_head``
+    when the checkpoint ships one (untied, e.g. Llama-2 via
+    :mod:`.hf_convert`), else the tied input embedding."""
+    head = params.get("lm_head")
+    return head if head is not None else params["embed"]
 
 
 def rope_angles(
@@ -216,13 +229,15 @@ def _llama_block(
     expert MLP for the MoE variant).  The single source of truth for the
     family's wiring — training forward, prefill, and decode all run it.
     """
-    h = _rms_norm(x, layer["attn_norm"])
+    h = _rms_norm(x, layer["attn_norm"], config.rms_eps)
     q, k, v = _project_qkv(h, layer, config, positions)
     out = attend(q, k, v)
     batch, _, seq, _ = out.shape
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
     x = x + out @ layer["wo"]
-    return x + (mlp or _swiglu)(_rms_norm(x, layer["mlp_norm"]), layer)
+    return x + (mlp or _swiglu)(
+        _rms_norm(x, layer["mlp_norm"], config.rms_eps), layer
+    )
 
 
 def _gqa_wrap(config: LlamaConfig, inner):
@@ -279,7 +294,7 @@ def llama_forward(
         llama_forward_hidden(
             params, tokens, config, attention_fn, positions, remat, mlp
         ),
-        params["embed"],
+        readout_weights(params),
     )
 
 
@@ -309,7 +324,7 @@ def llama_forward_hidden(
     x = params["embed"][tokens]
     for layer in params["layers"]:
         x = block(x, layer, config, positions, attend, mlp)
-    return _rms_norm(x, params["final_norm"])
+    return _rms_norm(x, params["final_norm"], config.rms_eps)
 
 
 def llama_loss_fn(
@@ -322,7 +337,7 @@ def llama_loss_fn(
     from .train import fused_next_token_nll
 
     return fused_next_token_nll(
-        params["embed"],
+        readout_weights(params),
         llama_forward_hidden(
             params, tokens, config, attention_fn, remat=remat
         ),
@@ -384,11 +399,17 @@ def init_llama_cache(config: LlamaConfig, batch: int) -> dict:
 
 
 def _final_logits(
-    params: dict, x: jax.Array, last_pos: jax.Array | None = None
+    params: dict,
+    x: jax.Array,
+    eps: float,
+    last_pos: jax.Array | None = None,
 ) -> jax.Array:
-    x = _rms_norm(x, params["final_norm"])
+    # eps is required (no default): a defaulted 1e-6 here would silently
+    # diverge from LlamaConfig.rms_eps for Llama-2 (1e-5) checkpoints
+    x = _rms_norm(x, params["final_norm"], eps)
     logits = jnp.einsum(
-        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+        "bsd,vd->bsv", x, readout_weights(params),
+        preferred_element_type=jnp.float32,
     )
     if last_pos is None:
         return logits[:, -1]
@@ -473,7 +494,10 @@ def llama_decode_step(
             )
 
         x = _llama_block(x, layer, config, positions, attend)
-    return _final_logits(params, x), {"layers": new_layers, "length": pos + 1}
+    return (
+        _final_logits(params, x, config.rms_eps),
+        {"layers": new_layers, "length": pos + 1},
+    )
 
 
 def llama_generate(

@@ -53,6 +53,7 @@ class ModelConfig:
 # the conventionally-sharded output axes of the two matmul families.
 PARAM_AXES = {
     "embed": ("vocab", "model"),
+    "lm_head": ("vocab", "model"),  # untied readout (hf_convert imports)
     "pos_embed": ("seq", "model"),
     "final_ln_scale": ("model",),
     "final_ln_bias": ("model",),

@@ -367,13 +367,13 @@ def llama_moe_loss_fn(
     attention_fn=None,
 ) -> jax.Array:
     """Llama-family MoE objective (cross-entropy + weighted aux)."""
-    from .llama import llama_forward_hidden
+    from .llama import llama_forward_hidden, readout_weights
     from .train import fused_next_token_nll
 
     sparse_mlp, mean_aux = _collecting_mlp(llama_moe_mlp, moe)
     x = llama_forward_hidden(params, tokens, config, attention_fn,
                              mlp=sparse_mlp)
-    nll = fused_next_token_nll(params["embed"], x, tokens)
+    nll = fused_next_token_nll(readout_weights(params), x, tokens)
     return nll + moe.aux_loss_weight * mean_aux()
 
 

@@ -106,6 +106,17 @@ class ServiceConfig:
     profile_dir: str = ""
     profile_cycles: int = 20
 
+    def __post_init__(self) -> None:
+        # fail at construction, not at first-batch trace time: run_forever's
+        # never-dies loop would otherwise catch the tracing ValueError and
+        # retry a doomed batch forever (same policy as decode._pick)
+        if self.top_k < 0:
+            raise ValueError(f"top_k={self.top_k} must be >= 0 (0 = off)")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p={self.top_p} must be in (0, 1] (1.0 = off)"
+            )
+
 
 class QueueWorker:
     """One worker: receive → batch → forward → delete, until stopped."""

@@ -161,6 +161,18 @@ def test_sampling_param_validation(params):
     out = _pick(logits, jax.random.key(0), 1.0, top_k=100000)
     assert out.shape == (1,)
 
+    # the serving surfaces fail fast, before any batch is traced: the
+    # config at construction, the binary at flag-parse time
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    with pytest.raises(ValueError, match="top_p"):
+        ServiceConfig(queue_url="q", top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        ServiceConfig(queue_url="q", top_k=-1)
+    with pytest.raises(SystemExit, match="top-p"):
+        main(["--demo", "1", "--top-p", "0.0"])
+
 
 def test_sampled_support_respects_top_k(params):
     # with temperature sampling over k=2, every generated token must come
