@@ -1,0 +1,219 @@
+"""Speculative decoding: draft-and-verify greedy generation.
+
+Token-by-token decode is HBM-bandwidth-bound — every step streams the
+whole model (and cache) for one token's worth of MXU work.  Speculative
+decoding converts that into compute: a small *draft* model proposes ``k``
+tokens autoregressively (cheap — k small-model steps), then the *target*
+model scores all ``k+1`` positions in ONE chunk-wide forward
+(:func:`.decode.chunk_decode` — k+1 times the MXU work of a decode step
+for roughly the same HBM traffic).  Accepted drafts advance the sequence
+several tokens per target pass; rejected tails cost nothing extra
+(no reference counterpart: the reference has no model code, SURVEY.md §2).
+
+**Exactness.** This implements greedy speculative decoding: the output
+equals :func:`.decode.generate`'s greedy output token for token for any
+draft model — the draft only decides how many target-forward passes are
+needed, never what is emitted.  The one caveat: the verify pass computes
+the target's logits through :func:`.decode.chunk_decode` (a ``T``-wide
+batch of the same math), so positions where the target's top-2 logits
+are within floating-point reassociation error of each other can resolve
+the argmax differently than the sequential decode would — exactness is
+"up to argmax ties", not bitwise on the logits.  Per round, with pending
+token ``p`` and draft proposals ``d_1..d_k``:
+
+- the target chunk-decodes inputs ``[p, d_1..d_k]`` into greedy picks
+  ``g_0..g_k`` (``g_i`` = target's choice after consuming input ``i``);
+- ``d_j`` is accepted while every earlier draft matched: the accepted
+  count is ``n = Σ_j Π_{i<=j} [d_i == g_{i-1}]``;
+- ``d_1..d_n`` plus the bonus ``g_n`` are emitted (``n+1 >= 1`` tokens —
+  a round can never stall), and ``g_n`` becomes the next pending token;
+- both caches roll back by *length*, not by rewriting: the chunk's k/v
+  entries past the accepted prefix stay in HBM but are masked out by the
+  per-row ``length`` (the same mechanism that makes ragged batches work),
+  so rollback is one scalar update per row.
+
+Rows accept independently (per-row ``n``), so a batch decodes in
+lockstep with per-row progress — the same ragged-batch contract as
+:mod:`.decode`.  The whole generate loop is one ``lax.while_loop`` under
+jit: static shapes (the output buffer is over-allocated by one round and
+sliced), no host round-trips.  Rows that reach ``num_tokens`` freeze
+(zero advance, writes masked) while slower rows finish, so cache
+positions never grow past the validated budget.
+
+The draft runs one extra consume step per round (input ``d_k``) so its
+cache always holds every accepted input even on full acceptance; like
+the rejected entries, it is masked out when not needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .decode import chunk_decode, decode_step, prefill
+from .model import ModelConfig
+
+
+def speculative_generate(
+    params_target: dict,
+    config_target: ModelConfig,
+    params_draft: dict,
+    config_draft: ModelConfig,
+    prompt: jax.Array,
+    num_tokens: int,
+    *,
+    draft_tokens: int = 4,
+    attention_fn=None,
+    lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy generation through the draft-and-verify loop.
+
+    Returns int32 ``[batch, num_tokens]`` — the greedy sequence of
+    ``generate(params_target, prompt, num_tokens, config_target)``,
+    exact up to argmax ties in the verify logits (module docstring).
+    ``draft_tokens`` (k) is the proposals-per-round knob: each round runs
+    k draft steps + 1 extra draft consume + one (k+1)-wide target chunk,
+    and emits between 1 and k+1 tokens.  The models must share a
+    vocabulary; ``lengths`` marks ragged right-padded prompts (both
+    models prefill with it).
+    """
+    if config_target.vocab_size != config_draft.vocab_size:
+        raise ValueError(
+            f"target vocab {config_target.vocab_size} != draft vocab "
+            f"{config_draft.vocab_size}"
+        )
+    if draft_tokens < 1:
+        raise ValueError(f"draft_tokens must be >= 1, got {draft_tokens}")
+    batch, prompt_len = prompt.shape
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    # worst-case cache position: a row can overshoot num_tokens by up to
+    # k when it freezes (count <= num_tokens + k -> frozen length up to
+    # prompt + num_tokens + k - 1), and each later round still writes k
+    # masked slots past that length — so both caches need
+    # prompt + num_tokens + 2k positions
+    budget = prompt_len + num_tokens + 2 * draft_tokens
+    for name, config in (("target", config_target), ("draft", config_draft)):
+        if budget > config.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + num_tokens ({num_tokens}) + "
+                f"2x draft window ({2 * draft_tokens}) exceeds the {name} "
+                f"model's max_seq_len={config.max_seq_len}"
+            )
+
+    k = draft_tokens
+    rows = jnp.arange(batch)
+    t_logits, t_cache = prefill(
+        params_target, prompt, config_target, attention_fn, lengths=lengths
+    )
+    _, d_cache = prefill(
+        params_draft, prompt, config_draft, attention_fn, lengths=lengths
+    )
+    pending = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B]
+
+    # over-allocate one full round past num_tokens so the fixed-width
+    # round write never clips; sliced off at the end
+    out = jnp.zeros((batch, num_tokens + k + 1), jnp.int32)
+    out = out.at[:, 0].set(pending)
+    count = jnp.ones((batch,), jnp.int32)  # emitted per row (incl. pending)
+
+    def round_body(carry):
+        out, count, pending, t_cache, d_cache = carry
+        # rows already at num_tokens freeze: no emission, no cache/count
+        # advance — their chunk writes land in masked slots within the
+        # validated budget instead of marching past max_seq_len while
+        # slower rows finish
+        done = count >= num_tokens
+
+        # --- draft: propose k tokens autoregressively ------------------
+        proposals = []
+        token = pending
+        dc = d_cache
+        for _ in range(k):  # k is small and static — unrolled
+            logits, dc = decode_step(params_draft, dc, token, config_draft)
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            proposals.append(token)
+        drafts = jnp.stack(proposals, axis=1)  # [B, k]
+        # extra consume of d_k so the draft cache holds every accepted
+        # input even when all k drafts are accepted (masked otherwise)
+        _, dc = decode_step(params_draft, dc, drafts[:, -1], config_draft)
+
+        # --- target: verify the whole window in one chunk forward ------
+        chunk = jnp.concatenate([pending[:, None], drafts], axis=1)  # [B,k+1]
+        t_len = t_cache["length"]
+        d_len = d_cache["length"]
+        logits, t_cache_adv = chunk_decode(
+            params_target, t_cache, chunk, config_target
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+
+        # --- accept the longest matching prefix ------------------------
+        matches = (drafts == greedy[:, :k]).astype(jnp.int32)
+        accepted = jnp.cumprod(matches, axis=1)  # [B, k] all-prefix match
+        n = jnp.sum(accepted, axis=1)  # [B] in [0, k]
+        bonus = jnp.take_along_axis(greedy, n[:, None], axis=1)[:, 0]
+
+        # --- emit d_1..d_n then the bonus ------------------------------
+        j = jnp.arange(k + 1)[None, :]
+        round_tokens = jnp.where(
+            j < n[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            bonus[:, None],
+        )  # position j: draft j while j < n, bonus at j == n, bonus pad after
+        idx = jnp.minimum(count[:, None] + j, out.shape[1] - 1)
+        keep = (j <= n[:, None]) & ~done[:, None]
+        current = jnp.take_along_axis(out, idx, axis=1)
+        out = out.at[rows[:, None], idx].set(
+            jnp.where(keep, round_tokens, current)
+        )
+
+        # --- advance: counts, pending, cache rollback by length --------
+        # frozen rows advance by 0 (their draft/chunk writes this round
+        # landed in slots their unchanged length keeps masked)
+        advance = jnp.where(done, 0, n + 1)
+        count = count + advance
+        # the target consumed inputs [p, d_1..d_n] validly -> +n+1; the
+        # draft consumed the same accepted prefix (its extra step covers
+        # the n == k case); later entries are masked by length
+        t_cache_adv = dict(t_cache_adv, length=t_len + advance)
+        dc = dict(dc, length=d_len + advance)
+        pending_next = jnp.where(done, pending, bonus)
+        return out, count, pending_next, t_cache_adv, dc
+
+    def cond(carry):
+        _, count, *_ = carry
+        return jnp.min(count) < num_tokens
+
+    out, count, *_ = jax.lax.while_loop(
+        cond, round_body, (out, count, pending, t_cache, d_cache)
+    )
+    return out[:, :num_tokens]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "config_target", "config_draft", "num_tokens", "draft_tokens",
+        "attention_fn",
+    ),
+)
+def speculative_generate_jit(
+    params_target: dict,
+    config_target: ModelConfig,
+    params_draft: dict,
+    config_draft: ModelConfig,
+    prompt: jax.Array,
+    num_tokens: int,
+    draft_tokens: int = 4,
+    attention_fn=None,
+    lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Compiled :func:`speculative_generate` (one program: prefills +
+    the whole while_loop of rounds)."""
+    return speculative_generate(
+        params_target, config_target, params_draft, config_draft, prompt,
+        num_tokens, draft_tokens=draft_tokens, attention_fn=attention_fn,
+        lengths=lengths,
+    )

@@ -1,0 +1,155 @@
+"""Speculative decoding: the draft-and-verify loop must be an exact
+greedy decoder — same tokens as decode.generate for ANY draft model
+(speculative.py module docstring) — and chunk_decode must equal the
+sequential decode steps it batches."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.decode import (
+    chunk_decode,
+    decode_step,
+    generate,
+    prefill,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig, init_params
+from kube_sqs_autoscaler_tpu.workloads.speculative import (
+    speculative_generate,
+    speculative_generate_jit,
+)
+
+TARGET = ModelConfig(
+    vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=96,
+)
+DRAFT = ModelConfig(
+    vocab_size=128, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+    max_seq_len=96,
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (
+        init_params(jax.random.key(0), TARGET),
+        init_params(jax.random.key(9), DRAFT),
+    )
+
+
+def prompt_tokens(batch=3, length=6, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (batch, length), 0, TARGET.vocab_size,
+        jnp.int32,
+    )
+
+
+def test_chunk_decode_equals_sequential_steps(models):
+    params, _ = models
+    prompt = prompt_tokens(batch=2, length=5)
+    lengths = jnp.asarray([3, 5], jnp.int32)  # ragged
+    _, cache_a = prefill(params, prompt, TARGET, lengths=lengths)
+    _, cache_b = prefill(params, prompt, TARGET, lengths=lengths)
+
+    chunk = jax.random.randint(jax.random.key(2), (2, 4), 0,
+                               TARGET.vocab_size, jnp.int32)
+    step_logits = []
+    for t in range(4):
+        logits, cache_a = decode_step(params, cache_a, chunk[:, t], TARGET)
+        step_logits.append(logits)
+    got, cache_b = chunk_decode(params, cache_b, chunk, TARGET)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(jnp.stack(step_logits, axis=1)),
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache_a["length"]), np.asarray(cache_b["length"])
+    )
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_equals_greedy_for_independent_draft(models, k):
+    params_t, params_d = models
+    prompt = prompt_tokens()
+    ref = np.asarray(generate(params_t, prompt, 12, TARGET))
+    got = np.asarray(
+        speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 12,
+                             draft_tokens=k)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_with_self_draft_fully_accepts(models):
+    # draft == target: every round accepts all k proposals, and the output
+    # is still exactly the greedy sequence
+    params_t, _ = models
+    prompt = prompt_tokens(seed=4)
+    ref = np.asarray(generate(params_t, prompt, 12, TARGET))
+    got = np.asarray(
+        speculative_generate(params_t, TARGET, params_t, TARGET, prompt, 12,
+                             draft_tokens=4)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_ragged_prompts(models):
+    params_t, params_d = models
+    prompt = prompt_tokens()
+    lengths = jnp.asarray([3, 6, 4], jnp.int32)
+    ref = np.asarray(generate(params_t, prompt, 10, TARGET, lengths=lengths))
+    got = np.asarray(
+        speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 10,
+                             draft_tokens=3, lengths=lengths)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_jit_compiled_path(models):
+    params_t, params_d = models
+    prompt = prompt_tokens(seed=7)
+    ref = np.asarray(generate(params_t, prompt, 8, TARGET))
+    got = np.asarray(
+        speculative_generate_jit(params_t, TARGET, params_d, DRAFT, prompt,
+                                 8, 3)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_tight_budget_with_uneven_acceptance():
+    """Rows that finish early freeze instead of marching their cache past
+    max_seq_len: with a small vocab (high random acceptance variance) and
+    max_seq_len at exactly the validated budget, the output still equals
+    greedy decoding for every row."""
+    vocab = 16
+    num, k, prompt_len = 20, 4, 4
+    tight = prompt_len + num + 2 * k  # exactly the documented budget
+    tcfg = ModelConfig(vocab_size=vocab, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq_len=tight)
+    dcfg = ModelConfig(vocab_size=vocab, d_model=32, n_heads=2, n_layers=1,
+                       d_ff=64, max_seq_len=tight)
+    params_t = init_params(jax.random.key(21), tcfg)
+    params_d = init_params(jax.random.key(22), dcfg)
+    prompt = jax.random.randint(jax.random.key(23), (4, prompt_len), 0,
+                                vocab, jnp.int32)
+    ref = np.asarray(generate(params_t, prompt, num, tcfg))
+    got = np.asarray(
+        speculative_generate(params_t, tcfg, params_d, dcfg, prompt, num,
+                             draft_tokens=k)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_validation(models):
+    params_t, params_d = models
+    prompt = prompt_tokens()
+    with pytest.raises(ValueError, match="vocab"):
+        bad = ModelConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+                          d_ff=64, max_seq_len=96)
+        speculative_generate(params_t, TARGET, init_params(
+            jax.random.key(2), bad), bad, prompt, 4)
+    with pytest.raises(ValueError, match="draft_tokens"):
+        speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 4,
+                             draft_tokens=0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 96)

@@ -249,6 +249,63 @@ def bench_ring_local(seq: int, iters: int) -> dict:
     }
 
 
+def bench_speculative(num_tokens: int = 64, draft_tokens: int = 4) -> dict:
+    """Greedy decode tokens/s: plain KV-cache generate vs speculative
+    draft-and-verify, on a serving-shaped config (identical outputs by
+    construction — the speedup is the acceptance rate paying off)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.speculative import (
+        speculative_generate_jit,
+    )
+
+    target = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=512,
+    )
+    # early-exit self-speculation (LayerSkip-style): the draft is the
+    # target's own first 2 layers + shared embeddings/final norm — a
+    # 4x-shallower model whose greedy picks track the target's (the
+    # residual stream is shared), with zero extra weights to train or
+    # store.  Output is still exactly the target's greedy sequence.
+    draft = ModelConfig(
+        vocab_size=target.vocab_size, d_model=target.d_model,
+        n_heads=target.n_heads, n_layers=2, d_ff=target.d_ff,
+        max_seq_len=target.max_seq_len,
+    )
+    params_t = init_params(jax.random.key(0), target)
+    params_d = dict(params_t, layers=params_t["layers"][:draft.n_layers])
+    prompt = jax.random.randint(jax.random.key(2), (4, 32), 0,
+                                target.vocab_size, jnp.int32)
+
+    def plain():
+        return generate_jit(params_t, prompt, num_tokens, target)
+
+    def spec():
+        return speculative_generate_jit(
+            params_t, target, params_d, draft, prompt, num_tokens,
+            draft_tokens,
+        )
+
+    plain_s = _time_compiled(plain, iters=3)
+    spec_s = _time_compiled(spec, iters=3)
+    toks = prompt.shape[0] * num_tokens
+    return {
+        "plain_tokens_per_sec": toks / plain_s,
+        "speculative_tokens_per_sec": toks / spec_s,
+        "speedup": plain_s / spec_s,
+        "num_tokens": num_tokens,
+        "draft_tokens": draft_tokens,
+        "draft_layers": draft.n_layers,
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser(prog="workbench")
     parser.add_argument("--steps", type=int, default=20)
@@ -278,6 +335,7 @@ def main(argv=None) -> dict:
     # local lengths a long-context sp run actually sees
     for seq in (4096, 8192):
         results[f"ring_local_s{seq}"] = bench_ring_local(seq, args.attn_iters)
+    results["speculative"] = bench_speculative()
 
     metrics = [
         ("train_tokens_per_sec", results["train"]["tokens_per_sec"],
@@ -303,6 +361,12 @@ def main(argv=None) -> dict:
         metrics.append(
             (f"ring_kernel_speedup_s{seq}", ring["speedup"], "x")
         )
+    metrics += [
+        ("decode_tokens_per_sec",
+         results["speculative"]["plain_tokens_per_sec"], "tokens/s"),
+        ("speculative_decode_speedup",
+         results["speculative"]["speedup"], "x"),
+    ]
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
