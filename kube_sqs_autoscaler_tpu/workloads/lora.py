@@ -1,0 +1,218 @@
+"""LoRA: low-rank adapter fine-tuning for both model families.
+
+Fine-tuning a full model multiplies optimizer memory by 3 (params + two
+Adam moments); LoRA trains rank-``r`` factors ``A [in, r]``, ``B [r,
+out]`` per projection instead — the adapter set is ~``r * (in + out) /
+(in * out)`` of the base weights (<1% at r=8 on the flagship config), so
+the frozen base stays in bf16 HBM once and only the adapters carry
+optimizer state (no reference counterpart: the reference has no model
+code, SURVEY.md §2).
+
+Design: adapters are a *parallel pytree* mirroring ``params["layers"]``,
+and :func:`apply_lora` produces effective weights ``W + (alpha/r)·A@B``
+*inside* the jitted step.  That keeps every existing forward, loss,
+attention kernel, and sharding rule untouched — a LoRA step is the
+ordinary step evaluated at ``apply_lora(frozen, adapters)``, with
+gradients flowing only to the adapters (the frozen base is a closed-over
+constant).  The per-step ``A@B`` materialization costs ``in·r·out``
+FLOPs per weight — noise next to the ``tokens·in·out`` forward matmuls
+it shadows.
+
+Init is the standard LoRA scheme: ``A ~ N(0, 1/r)``, ``B = 0`` — the
+adapted model starts exactly equal to the base, so step 0's loss matches
+the frozen model bit for bit (tested).
+
+TPU notes: adapters replicate across the mesh (rank-8 factors are tiny;
+replicating avoids resharding the skinny matmuls), while the frozen base
+keeps its PARAM_AXES sharding — ``W + AB`` broadcasts the replicated
+product into the sharded weight layout and XLA partitions the add.
+:func:`merge_lora` folds the adapters into plain weights for serving
+(zero inference overhead; the merged pytree round-trips through the
+existing checkpoint/quantize/serve paths unchanged).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# per-family default adaptation targets: the attention projections (the
+# LoRA paper's choice) plus the MLP matmuls — every 2-D weight the block
+# multiplies by
+DEFAULT_TARGETS = (
+    "wq", "wkv", "wqkv", "wo", "w_up", "w_down", "w_gate_up",
+)
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # weight names (within each layer dict) that receive adapters; names
+    # absent from a family's layers are skipped, so one default covers
+    # both families
+    targets: tuple = field(default_factory=lambda: DEFAULT_TARGETS)
+
+    def __post_init__(self) -> None:
+        if self.rank < 1:
+            raise ValueError(f"rank={self.rank} must be >= 1")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora_params(
+    rng: jax.Array, params: dict, config: LoraConfig
+) -> dict:
+    """Adapters for every targeted 2-D weight in ``params["layers"]``.
+
+    Returns ``{"layers": [{name: {"a": [in, r], "b": [r, out]}, ...},
+    ...]}`` in fp32 (adapters are tiny; fp32 keeps the update math
+    exact).  ``B = 0`` start: ``apply_lora(params, adapters) == params``.
+    """
+    layers = []
+    for i, layer in enumerate(params["layers"]):
+        adapters = {}
+        for t, name in enumerate(config.targets):
+            w = layer.get(name)
+            if w is None or w.ndim != 2:
+                continue
+            # fold in the stable (layer, target-index) pair — hash(name)
+            # would be salted per process and break seed reproducibility
+            key = jax.random.fold_in(jax.random.fold_in(rng, i), t)
+            adapters[name] = {
+                "a": (
+                    jax.random.normal(key, (w.shape[0], config.rank),
+                                      jnp.float32)
+                    / config.rank
+                ),
+                "b": jnp.zeros((config.rank, w.shape[1]), jnp.float32),
+            }
+        if not adapters:
+            raise ValueError(
+                f"no targeted weights found in layer {i}: targets="
+                f"{config.targets}, layer keys={sorted(layer)}"
+            )
+        layers.append(adapters)
+    return {"layers": layers}
+
+
+def apply_lora(params: dict, adapters: dict, config: LoraConfig) -> dict:
+    """Effective parameters ``W + (alpha/r)·A@B`` for adapted weights
+    (everything else passes through by reference).  Pure — call inside
+    the jitted step so the delta participates in autodiff; gradients
+    w.r.t. ``adapters`` flow through the add, the base stays constant.
+    """
+    merged_layers = []
+    for layer, adapter in zip(params["layers"], adapters["layers"]):
+        merged = dict(layer)
+        for name, ab in adapter.items():
+            w = layer[name]
+            delta = (ab["a"] @ ab["b"]) * config.scale
+            merged[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+        merged_layers.append(merged)
+    return dict(params, layers=merged_layers)
+
+
+def merge_lora(params: dict, adapters: dict, config: LoraConfig) -> dict:
+    """Fold adapters into plain weights (serving form, zero overhead).
+    Same math as :func:`apply_lora`; a separate name so call sites say
+    what they mean."""
+    return apply_lora(params, adapters, config)
+
+
+def lora_param_count(adapters: dict) -> int:
+    return sum(p.size for p in jax.tree.leaves(adapters))
+
+
+def make_lora_train_step(
+    mesh,
+    model_config: Any,
+    train_config: Any,
+    frozen_params: dict,
+    adapter_state: dict,
+    lora: LoraConfig,
+    loss: Any = None,
+):
+    """Compile one adapter-only optimizer step over the mesh.
+
+    ``adapter_state`` comes from :func:`init_lora_train_state`; the
+    frozen base is closed over (already placed on the mesh with its
+    usual shardings) and never donated or updated.  ``loss(params,
+    tokens, attention_fn)`` defaults to the family objective via
+    ``train.loss_fn`` — pass ``llama.llama_loss_fn``-shaped callables for
+    other families (same seam as ``train.make_train_step``).
+
+    Adapters and their Adam moments replicate across the mesh; their
+    gradients arrive via XLA's all-reduce of the data-parallel shards.
+    """
+    import optax
+
+    from .train import (
+        batch_sharding,
+        make_optimizer,
+        mesh_attention_fn,
+        replicated,
+    )
+
+    optimizer = make_optimizer(train_config)
+    attention_fn = mesh_attention_fn(mesh)
+    if loss is None:
+        from .train import loss_fn
+
+        loss = partial(loss_fn, config=model_config,
+                       remat=train_config.remat)
+
+    def adapter_loss(adapters, tokens):
+        return loss(
+            apply_lora(frozen_params, adapters, lora), tokens,
+            attention_fn=attention_fn,
+        )
+
+    def train_step(state, tokens):
+        loss_value, grads = jax.value_and_grad(adapter_loss)(
+            state["adapters"], tokens
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["adapters"]
+        )
+        adapters = optax.apply_updates(state["adapters"], updates)
+        return (
+            {
+                "adapters": adapters,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            },
+            loss_value,
+        )
+
+    rep = replicated(mesh)
+    state_shard = jax.tree.map(lambda _: rep, adapter_state,
+                               is_leaf=lambda x: x is None)
+    return jax.jit(
+        train_step,
+        in_shardings=(state_shard, batch_sharding(mesh)),
+        out_shardings=(state_shard, rep),
+        donate_argnums=0,
+    )
+
+
+def init_lora_train_state(
+    rng: jax.Array, params: dict, lora: LoraConfig, train_config: Any
+) -> dict:
+    """Adapters + their optimizer state (the trainable state is ONLY the
+    adapters — the base model carries no moments)."""
+    from .train import make_optimizer
+
+    adapters = init_lora_params(rng, params, lora)
+    opt_state = make_optimizer(train_config).init(adapters)
+    return {
+        "adapters": adapters,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }

@@ -97,6 +97,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--moe-experts", type=int, default=8)
     parser.add_argument("--moe-top-k", type=int, default=2)
+    # parameter-efficient fine-tuning
+    parser.add_argument(
+        "--lora-rank", type=int, default=0,
+        help="train rank-N LoRA adapters on a frozen base instead of full "
+             "weights (0 = off); checkpoints save the MERGED weights, so "
+             "the serve binary works unchanged",
+    )
+    parser.add_argument("--lora-alpha", type=float, default=16.0)
+    parser.add_argument(
+        "--hf-checkpoint", default="", metavar="DIR",
+        help="start from a Hugging Face Llama checkpoint directory "
+             "(workloads.hf_convert; implies --family llama and the "
+             "architecture from its config) — the usual base for "
+             "--lora-rank fine-tuning",
+    )
     parser.add_argument(
         "--topology-mesh", action="store_true",
         help="order devices along the physical ICI torus (real TPU hardware)",
@@ -169,6 +184,26 @@ def train(args) -> dict:
             "seam's ring attention; a zig-zag schedule would be silently "
             "dropped)"
         )
+    if args.lora_rank:
+        # adapters wrap the flat dense params; layouts that restructure
+        # them (stage stacks, expert weights, permuted-order losses) and
+        # adapter-state resume are out of scope — fail fast
+        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1),
+                          ("--zigzag", args.zigzag),
+                          ("--resume", args.resume),
+                          ("--grad-accum > 1", args.grad_accum > 1)):
+            if bad:
+                raise SystemExit(f"--lora-rank does not combine with {flag}")
+    if args.hf_checkpoint:
+        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1)):
+            if bad:
+                raise SystemExit(
+                    f"--hf-checkpoint is a llama-family base; it does not "
+                    f"combine with {flag}"
+                )
+        if args.family != "llama":
+            log.info("--hf-checkpoint implies --family llama")
+            args.family = "llama"
     train_config = TrainConfig(
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps, remat=args.remat,
@@ -191,6 +226,7 @@ def train(args) -> dict:
         1408 if args.family == "llama" else 2048
     )
 
+    hf_base = None
     if args.family == "llama":
         from .llama import (
             LlamaConfig,
@@ -198,12 +234,28 @@ def train(args) -> dict:
             make_llama_train_step,
         )
 
-        model_config = LlamaConfig(
-            vocab_size=args.vocab_size, d_model=args.d_model,
-            n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
-            n_layers=args.n_layers, d_ff=d_ff,
-            max_seq_len=args.seq_len,
-        )
+        if args.hf_checkpoint:
+            from .hf_convert import load_hf_llama
+
+            model_config, hf_base = load_hf_llama(args.hf_checkpoint)
+            log.info(
+                "HF base: %s (d_model=%d layers=%d heads=%d/%d)",
+                args.hf_checkpoint, model_config.d_model,
+                model_config.n_layers, model_config.n_heads,
+                model_config.n_kv_heads,
+            )
+            if model_config.max_seq_len < args.seq_len:
+                raise SystemExit(
+                    f"HF model max_seq_len={model_config.max_seq_len} < "
+                    f"--seq-len {args.seq_len}"
+                )
+        else:
+            model_config = LlamaConfig(
+                vocab_size=args.vocab_size, d_model=args.d_model,
+                n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+                n_layers=args.n_layers, d_ff=d_ff,
+                max_seq_len=args.seq_len,
+            )
         if args.moe:
             from .moe import MoeConfig, init_llama_moe_train_state
 
@@ -214,6 +266,17 @@ def train(args) -> dict:
                 init_llama_moe_train_state(
                     jax.random.key(args.seed), model_config, moe_config,
                     train_config,
+                ),
+            )
+        elif hf_base is not None:
+            # same state shape as a fresh init, with the imported weights
+            # as the starting point (full fine-tune, or the frozen base
+            # for --lora-rank)
+            state = place_state(
+                mesh,
+                init_train_state(
+                    jax.random.key(args.seed), model_config, train_config,
+                    init_fn=lambda rng, cfg: hf_base,
                 ),
             )
         else:
@@ -257,6 +320,35 @@ def train(args) -> dict:
                                        train_config)
             )
     log.info("Model: %s parameters", f"{param_count(state['params']):,}")
+
+    # --lora-rank: swap the full train state for frozen base + adapters.
+    # save_state maps the in-memory state to its checkpointed form —
+    # identity normally; for LoRA the MERGED weights (+ step), so the
+    # serve binary and restore_params work on LoRA checkpoints unchanged.
+    lora_cfg = lora_frozen = None
+    save_state = lambda s: s  # noqa: E731
+    if args.lora_rank:
+        from .lora import (
+            LoraConfig,
+            init_lora_train_state,
+            lora_param_count,
+            merge_lora,
+        )
+
+        lora_cfg = LoraConfig(rank=args.lora_rank, alpha=args.lora_alpha)
+        lora_frozen = state["params"]  # placed on the mesh, never updated
+        state = init_lora_train_state(
+            jax.random.key(args.seed + 1), lora_frozen, lora_cfg,
+            train_config,
+        )
+        save_state = lambda s: {  # noqa: E731
+            "params": merge_lora(lora_frozen, s["adapters"], lora_cfg),
+            "step": s["step"],
+        }
+        log.info(
+            "LoRA: rank %d, %s adapter parameters (base frozen)",
+            args.lora_rank, f"{lora_param_count(state['adapters']):,}",
+        )
 
     checkpointer = (
         TrainCheckpointer(args.checkpoint_dir, keep=args.checkpoint_keep)
@@ -332,7 +424,27 @@ def train(args) -> dict:
             state = checkpointer.restore(mesh, state)
             log.info("Resumed from checkpoint step %d", latest)
 
-    if pipe > 1:
+    if args.lora_rank:
+        from .lora import make_lora_train_step
+
+        loss = None
+        if args.family == "llama":
+            from .llama import _gqa_wrap, llama_loss_fn
+
+            def loss(params, tokens, attention_fn=None):
+                attend = (
+                    _gqa_wrap(model_config, attention_fn)
+                    if attention_fn is not None else None
+                )
+                return llama_loss_fn(params, tokens, model_config,
+                                     attention_fn=attend,
+                                     remat=train_config.remat)
+
+        step_fn = make_lora_train_step(
+            mesh, model_config, train_config, lora_frozen, state, lora_cfg,
+            loss=loss,
+        )
+    elif pipe > 1:
         from .pipeline import PipelineConfig, make_pipeline_train_step
 
         pipe_config = PipelineConfig(
@@ -470,12 +582,12 @@ def train(args) -> dict:
                     and step % args.checkpoint_every == 0):
                 # async: the write streams while training continues; the
                 # next save (or the final wait) fences it
-                checkpointer.save(state, wait=False)
+                checkpointer.save(save_state(state), wait=False)
                 last_saved = step
                 log.info("Checkpointed step %d", step)
     final_step = int(jax.device_get(state["step"]))
     if checkpointer and last_saved != final_step:
-        checkpointer.save(state)
+        checkpointer.save(save_state(state))
     elif checkpointer:
         checkpointer.wait_until_finished()  # fence the last async save
     if obs_server is not None:

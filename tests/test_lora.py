@@ -1,0 +1,210 @@
+"""LoRA adapters: zero-init equivalence, adapter-only training, merge
+semantics, mesh execution, and the HF-import composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.lora import (
+    LoraConfig,
+    apply_lora,
+    init_lora_params,
+    init_lora_train_state,
+    lora_param_count,
+    make_lora_train_step,
+    merge_lora,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (
+    ModelConfig,
+    init_params,
+    param_count,
+)
+from kube_sqs_autoscaler_tpu.workloads.train import (
+    TrainConfig,
+    batch_sharding,
+    loss_fn,
+    make_mesh,
+    param_shardings,
+)
+
+TINY = ModelConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=64,
+)
+
+
+@pytest.fixture(scope="module")
+def base_params():
+    return init_params(jax.random.key(0), TINY)
+
+
+def tokens_batch(batch=8, seq=32, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (batch, seq), 0, TINY.vocab_size, jnp.int32
+    )
+
+
+def test_zero_init_is_identity(base_params):
+    lora = LoraConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(1), base_params, lora)
+    adapted = apply_lora(base_params, adapters, lora)
+    for a, b in zip(jax.tree.leaves(base_params), jax.tree.leaves(adapted)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adapter_size_is_a_fraction_of_the_base(base_params):
+    lora = LoraConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(1), base_params, lora)
+    assert lora_param_count(adapters) < 0.25 * param_count(base_params)
+    # every 2-D layer weight of the gpt family is covered
+    assert set(adapters["layers"][0]) == {"wqkv", "wo", "w_up", "w_down"}
+
+
+def test_lora_training_moves_loss_and_only_adapters(base_params):
+    mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=1)
+    lora = LoraConfig(rank=4)
+    tc = TrainConfig(learning_rate=3e-2)
+    frozen = jax.device_put(base_params,
+                            param_shardings(mesh, base_params))
+    state = init_lora_train_state(jax.random.key(2), base_params, lora, tc)
+    step = make_lora_train_step(mesh, TINY, tc, frozen, state, lora)
+    tokens = jax.device_put(tokens_batch(), batch_sharding(mesh))
+
+    base_loss = float(loss_fn(base_params, tokens, TINY))
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    # step 0's loss is the frozen model's loss (B = 0 start)
+    assert losses[0] == pytest.approx(base_loss, abs=1e-5)
+    assert losses[-1] < losses[0]
+    # the base stayed frozen; the adapters moved
+    for a, b in zip(jax.tree.leaves(base_params), jax.tree.leaves(frozen)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    b_leaf = state["adapters"]["layers"][0]["wqkv"]["b"]
+    assert float(jnp.abs(b_leaf).max()) > 0
+
+
+def test_merge_equals_adapted_forward(base_params):
+    lora = LoraConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(3), base_params, lora)
+    # make the delta nonzero
+    adapters = jax.tree.map(
+        lambda x: x + 0.01 if x.ndim == 2 else x, adapters
+    )
+    tokens = tokens_batch(batch=2, seq=16)
+    adapted = float(loss_fn(apply_lora(base_params, adapters, lora),
+                            tokens, TINY))
+    merged = float(loss_fn(merge_lora(base_params, adapters, lora),
+                           tokens, TINY))
+    assert adapted == pytest.approx(merged, rel=1e-6)
+    assert adapted != pytest.approx(
+        float(loss_fn(base_params, tokens, TINY)), abs=1e-4
+    )
+
+
+def test_lora_on_hf_imported_llama():
+    """The headline composition: import an HF Llama, LoRA-adapt it, and
+    the llama objective trains adapter-only on the mesh."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import load_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import llama_loss_fn
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        attn_implementation="eager",
+    ))
+    config, params = load_hf_llama(hf, dtype=jnp.float32)
+    assert set(
+        init_lora_params(jax.random.key(0), params, LoraConfig())
+        ["layers"][0]
+    ) == {"wq", "wkv", "wo", "w_gate_up", "w_down"}
+
+    mesh = make_mesh(jax.devices()[:2], model_parallel=1, seq_parallel=1)
+    lora = LoraConfig(rank=2)
+    tc = TrainConfig(learning_rate=3e-2)
+    frozen = jax.device_put(params, param_shardings(mesh, params))
+    state = init_lora_train_state(jax.random.key(4), params, lora, tc)
+
+    def loss(p, tokens, attention_fn=None):
+        return llama_loss_fn(p, tokens, config, attention_fn=None)
+
+    step = make_lora_train_step(mesh, config, tc, frozen, state, lora,
+                                loss=loss)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(5), (4, 16), 0, 128, jnp.int32),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(5):
+        state, loss_v = step(state, tokens)
+        losses.append(float(loss_v))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_rank_validation():
+    with pytest.raises(ValueError, match="rank"):
+        LoraConfig(rank=0)
+
+
+def test_trainer_binary_lora_on_hf_base_serves_merged(tmp_path):
+    """The whole fine-tuning story through the real binaries: HF llama
+    directory -> trainer --hf-checkpoint --lora-rank (merged-weights
+    checkpoint + manifest) -> serve binary generates from it."""
+    import os
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        attn_implementation="eager",
+    ))
+    from pathlib import Path
+
+    repo_root = Path(__file__).resolve().parent.parent
+    hf_dir, ckpt = tmp_path / "hf", tmp_path / "trained"
+    hf.save_pretrained(hf_dir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    run = subprocess.run(
+        [sys.executable, "-m", "kube_sqs_autoscaler_tpu.workloads.trainer",
+         "--hf-checkpoint", str(hf_dir), "--lora-rank", "4",
+         "--steps", "3", "--batch-size", "8", "--seq-len", "16",
+         "--checkpoint-dir", str(ckpt), "--checkpoint-every", "0",
+         "--log-every", "2"],
+        capture_output=True, text=True, env=env, cwd=repo_root,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    assert "LoRA: rank 4" in run.stderr
+    serve = subprocess.run(
+        [sys.executable, "-m", "kube_sqs_autoscaler_tpu.workloads",
+         "--checkpoint-dir", str(ckpt), "--family", "llama", "--demo", "2",
+         "--batch-size", "1", "--seq-len", "8", "--generate-tokens", "4"],
+        capture_output=True, text=True, env=env, cwd=repo_root,
+    )
+    assert serve.returncode == 0, serve.stderr[-3000:]
+    assert "Processed 2 messages" in serve.stderr
+
+
+def test_trainer_rejects_lora_with_incompatible_flags():
+    from kube_sqs_autoscaler_tpu.workloads.trainer import build_parser, train
+
+    args = build_parser().parse_args(
+        ["--lora-rank", "4", "--moe", "--steps", "1"]
+    )
+    with pytest.raises(SystemExit, match="lora"):
+        train(args)
