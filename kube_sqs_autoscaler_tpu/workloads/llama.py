@@ -355,16 +355,12 @@ def init_llama_train_state(
     )
 
 
-def make_llama_train_step(mesh, config: LlamaConfig, train_config,
-                          state: dict):
-    """dp x tp (x sp) train step via :func:`.train.make_train_step`'s seams.
-
-    The seam's mesh attention_fn (per-shard flash on TPU, ring attention
-    when the mesh has a ``seq`` axis) is adapted through :func:`_gqa_wrap`:
-    gqa-native fns take the compact k/v directly, MHA-shaped ones get the
-    broadcast.
-    """
-    from .train import make_train_step
+def llama_mesh_loss(config: LlamaConfig, train_config):
+    """The family objective in ``make_train_step``'s loss-seam shape:
+    the seam's attention_fn (per-shard flash on TPU, ring attention on a
+    ``seq`` mesh) is adapted through :func:`_gqa_wrap` — gqa-native fns
+    take the compact k/v directly, MHA-shaped ones get the broadcast.
+    One implementation for the full train step and the LoRA step."""
 
     def loss(params, tokens, attention_fn=None):
         attend = (
@@ -375,7 +371,19 @@ def make_llama_train_step(mesh, config: LlamaConfig, train_config,
         return llama_loss_fn(params, tokens, config, attention_fn=attend,
                              remat=train_config.remat)
 
-    return make_train_step(mesh, config, train_config, state, loss=loss)
+    return loss
+
+
+def make_llama_train_step(mesh, config: LlamaConfig, train_config,
+                          state: dict):
+    """dp x tp (x sp) train step via :func:`.train.make_train_step`'s
+    seams, with :func:`llama_mesh_loss` as the objective."""
+    from .train import make_train_step
+
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=llama_mesh_loss(config, train_config),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +506,49 @@ def llama_decode_step(
         _final_logits(params, x, config.rms_eps),
         {"layers": new_layers, "length": pos + 1},
     )
+
+
+def llama_chunk_decode(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """Decode a ``T``-token chunk per row in one forward (the llama
+    counterpart of :func:`.decode.chunk_decode` — GQA cache writes, RoPE
+    at per-row chunk positions, same start-offset causal mask).  Entry
+    ``t`` of the fp32 ``[B, T, vocab]`` logits is the next-token
+    distribution after consuming input ``t``; the cache advances by
+    ``T``.  The verify step of llama-family speculative decoding."""
+    from .decode import _chunk_cached_attention
+
+    start = cache["length"]  # [B]
+    batch, chunk = tokens.shape
+    rows = jnp.arange(batch)[:, None]
+    cols = start[:, None] + jnp.arange(chunk)[None, :]  # [B, T]
+    groups = config.n_heads // config.n_kv_heads
+    # [B, 1, T] RoPE positions broadcast against [B, H, T, D/2] angles
+    positions = start[:, None, None] + jnp.arange(chunk)[None, None, :]
+    x = params["embed"][tokens]
+    new_layers = []
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            k_cache = _lc["k"].at[rows, :, cols].set(
+                k.transpose(0, 2, 1, 3).astype(config.dtype)
+            )
+            v_cache = _lc["v"].at[rows, :, cols].set(
+                v.transpose(0, 2, 1, 3).astype(config.dtype)
+            )
+            new_layers.append({"k": k_cache, "v": v_cache})
+            return _chunk_cached_attention(
+                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
+                start,
+            )
+
+        x = _llama_block(x, layer, config, positions, attend)
+    x = _rms_norm(x, params["final_norm"], config.rms_eps)
+    from .model import unembed
+
+    logits = unembed(x, readout_weights(params))
+    return logits, {"layers": new_layers, "length": start + chunk}
 
 
 def llama_generate(

@@ -56,6 +56,25 @@ from .decode import chunk_decode, decode_step, prefill
 from .model import ModelConfig
 
 
+def _family_ops(config):
+    """(prefill, decode_step, chunk_decode) for the config's family —
+    llama configs (they carry ``n_kv_heads``) get the GQA/RoPE cache ops,
+    everything else the gpt-family ops.  Target and draft dispatch
+    independently, so a llama target can use a gpt draft and vice versa
+    (the only shared contract is the vocabulary)."""
+    if hasattr(config, "n_kv_heads"):
+        from .llama import (
+            llama_chunk_decode,
+            llama_decode_step,
+            llama_prefill,
+        )
+
+        # llama_prefill's (params, tokens, config, prompt_attention,
+        # lengths) lines up with the gpt prefill call shape directly
+        return llama_prefill, llama_decode_step, llama_chunk_decode
+    return prefill, decode_step, chunk_decode
+
+
 def speculative_generate(
     params_target: dict,
     config_target: ModelConfig,
@@ -105,10 +124,12 @@ def speculative_generate(
 
     k = draft_tokens
     rows = jnp.arange(batch)
-    t_logits, t_cache = prefill(
+    t_prefill, t_step, t_chunk = _family_ops(config_target)
+    d_prefill, d_step, _ = _family_ops(config_draft)
+    t_logits, t_cache = t_prefill(
         params_target, prompt, config_target, attention_fn, lengths=lengths
     )
-    _, d_cache = prefill(
+    _, d_cache = d_prefill(
         params_draft, prompt, config_draft, attention_fn, lengths=lengths
     )
     pending = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B]
@@ -132,19 +153,19 @@ def speculative_generate(
         token = pending
         dc = d_cache
         for _ in range(k):  # k is small and static — unrolled
-            logits, dc = decode_step(params_draft, dc, token, config_draft)
+            logits, dc = d_step(params_draft, dc, token, config_draft)
             token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             proposals.append(token)
         drafts = jnp.stack(proposals, axis=1)  # [B, k]
         # extra consume of d_k so the draft cache holds every accepted
         # input even when all k drafts are accepted (masked otherwise)
-        _, dc = decode_step(params_draft, dc, drafts[:, -1], config_draft)
+        _, dc = d_step(params_draft, dc, drafts[:, -1], config_draft)
 
         # --- target: verify the whole window in one chunk forward ------
         chunk = jnp.concatenate([pending[:, None], drafts], axis=1)  # [B,k+1]
         t_len = t_cache["length"]
         d_len = d_cache["length"]
-        logits, t_cache_adv = chunk_decode(
+        logits, t_cache_adv = t_chunk(
             params_target, t_cache, chunk, config_target
         )
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]

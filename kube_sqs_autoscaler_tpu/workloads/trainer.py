@@ -268,10 +268,27 @@ def train(args) -> dict:
                     train_config,
                 ),
             )
+        elif args.lora_rank:
+            # params only: the base is frozen, so no full-model Adam
+            # moments are ever materialized (the whole point of LoRA —
+            # peak HBM stays at 1x the base, not 3x)
+            from .llama import init_llama_params
+            from .train import param_shardings
+
+            base = (
+                hf_base if hf_base is not None
+                else init_llama_params(jax.random.key(args.seed),
+                                       model_config)
+            )
+            state = {
+                "params": jax.device_put(
+                    base, param_shardings(mesh, base)
+                ),
+                "step": jax.numpy.zeros((), jax.numpy.int32),
+            }
         elif hf_base is not None:
             # same state shape as a fresh init, with the imported weights
-            # as the starting point (full fine-tune, or the frozen base
-            # for --lora-rank)
+            # as the starting point (full fine-tune)
             state = place_state(
                 mesh,
                 init_train_state(
@@ -314,6 +331,18 @@ def train(args) -> dict:
                 init_moe_train_state(jax.random.key(args.seed), model_config,
                                      moe_config, train_config),
             )
+        elif args.lora_rank:
+            # params only — no full-model Adam moments (see llama branch)
+            from .model import init_params
+            from .train import param_shardings
+
+            base = init_params(jax.random.key(args.seed), model_config)
+            state = {
+                "params": jax.device_put(
+                    base, param_shardings(mesh, base)
+                ),
+                "step": jax.numpy.zeros((), jax.numpy.int32),
+            }
         else:
             state = place_state(
                 mesh, init_train_state(jax.random.key(args.seed), model_config,
@@ -429,17 +458,9 @@ def train(args) -> dict:
 
         loss = None
         if args.family == "llama":
-            from .llama import _gqa_wrap, llama_loss_fn
+            from .llama import llama_mesh_loss
 
-            def loss(params, tokens, attention_fn=None):
-                attend = (
-                    _gqa_wrap(model_config, attention_fn)
-                    if attention_fn is not None else None
-                )
-                return llama_loss_fn(params, tokens, model_config,
-                                     attention_fn=attend,
-                                     remat=train_config.remat)
-
+            loss = llama_mesh_loss(model_config, train_config)
         step_fn = make_lora_train_step(
             mesh, model_config, train_config, lora_frozen, state, lora_cfg,
             loss=loss,

@@ -116,6 +116,52 @@ def test_speculative_jit_compiled_path(models):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_speculative_llama_family():
+    """Family dispatch: a llama target (GQA cache, RoPE chunk positions,
+    llama_chunk_decode verify) with a llama draft reproduces the llama
+    greedy sequence; chunk verify equals sequential llama decode."""
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_chunk_decode,
+        llama_decode_step,
+        llama_generate,
+        llama_prefill,
+    )
+
+    tcfg = LlamaConfig(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                       n_layers=2, d_ff=96, max_seq_len=96)
+    dcfg = LlamaConfig(vocab_size=128, d_model=32, n_heads=2, n_kv_heads=1,
+                       n_layers=1, d_ff=64, max_seq_len=96)
+    params_t = init_llama_params(jax.random.key(31), tcfg)
+    params_d = init_llama_params(jax.random.key(32), dcfg)
+    prompt = jax.random.randint(jax.random.key(33), (2, 6), 0, 128,
+                                jnp.int32)
+
+    # chunk verify == sequential decode steps
+    _, cache_a = llama_prefill(params_t, prompt, tcfg)
+    _, cache_b = llama_prefill(params_t, prompt, tcfg)
+    chunk = jax.random.randint(jax.random.key(34), (2, 3), 0, 128,
+                               jnp.int32)
+    seq_logits = []
+    for t in range(3):
+        logits, cache_a = llama_decode_step(params_t, cache_a, chunk[:, t],
+                                            tcfg)
+        seq_logits.append(logits)
+    got, cache_b = llama_chunk_decode(params_t, cache_b, chunk, tcfg)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(jnp.stack(seq_logits, axis=1)),
+        rtol=2e-4, atol=2e-4,
+    )
+
+    ref = np.asarray(llama_generate(params_t, prompt, 10, tcfg))
+    got = np.asarray(
+        speculative_generate(params_t, tcfg, params_d, dcfg, prompt, 10,
+                             draft_tokens=3)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_speculative_tight_budget_with_uneven_acceptance():
     """Rows that finish early freeze instead of marching their cache past
     max_seq_len: with a small vocab (high random acceptance variance) and
