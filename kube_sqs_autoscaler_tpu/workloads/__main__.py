@@ -89,6 +89,19 @@ def main(argv=None) -> None:
              "--generate-tokens >= 1; gpt family, single chip)",
     )
     parser.add_argument(
+        "--speculative-draft-layers", type=int, default=0, metavar="N",
+        help="speculative decoding with an early-exit self-draft: the "
+             "model's own first N layers propose tokens and the full "
+             "model verifies them in one chunk forward (greedy only — "
+             "output identical to plain greedy decode; requires "
+             "--generate-tokens >= 1, single chip)",
+    )
+    parser.add_argument(
+        "--speculative-draft-tokens", type=int, default=4, metavar="K",
+        help="proposals per speculative round (each round emits 1..K+1 "
+             "tokens for one full-model pass)",
+    )
+    parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
         help="int8: post-training per-channel weight quantization of the "
              "served matmul weights (half the HBM bytes per decode step; "
@@ -122,7 +135,13 @@ def main(argv=None) -> None:
         )
 
     # --- model: architecture from the trainer's manifest, or built-in ----
-    needed_ctx = max(64, args.seq_len + args.generate_tokens)
+    # (speculative decoding needs 2k cache positions of headroom past the
+    # generated tokens — see speculative.speculative_generate's budget)
+    spec_headroom = (
+        2 * args.speculative_draft_tokens
+        if args.speculative_draft_layers else 0
+    )
+    needed_ctx = max(64, args.seq_len + args.generate_tokens + spec_headroom)
     hf_params = None
     if args.hf_checkpoint:
         from .hf_convert import load_hf_llama
@@ -283,6 +302,60 @@ def main(argv=None) -> None:
                 top_p=service_config.top_p,
             ),
         }
+    if args.speculative_draft_layers:
+        # early-exit self-draft: the same weights, truncated depth — the
+        # verify chunk keeps the output exactly the greedy sequence, so
+        # sampling/temperature and the parallel serving paths don't apply
+        for flag, bad in (
+            ("--temperature > 0 (speculative is greedy-exact)",
+             args.temperature > 0.0),
+            ("--model-parallel", bool(args.model_parallel)),
+            ("--continuous", args.continuous),
+            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
+        ):
+            if bad:
+                raise SystemExit(
+                    f"--speculative-draft-layers does not support {flag}"
+                )
+        n_draft = args.speculative_draft_layers
+        k = args.speculative_draft_tokens
+        if k < 1:
+            raise SystemExit(
+                f"--speculative-draft-tokens {k} must be >= 1"
+            )
+        if not 0 < n_draft < model_config.n_layers:
+            raise SystemExit(
+                f"--speculative-draft-layers {n_draft} must be in "
+                f"[1, n_layers-1] (model has n_layers="
+                f"{model_config.n_layers})"
+            )
+        budget = args.seq_len + args.generate_tokens + 2 * k
+        if budget > model_config.max_seq_len:
+            # fail at startup, not at first-batch trace time inside the
+            # worker's never-dies retry loop
+            raise SystemExit(
+                f"seq_len + generate_tokens + 2*draft_tokens = {budget} "
+                f"exceeds the model's max_seq_len="
+                f"{model_config.max_seq_len} (the speculative cache "
+                "budget); lower --speculative-draft-tokens or the lengths"
+            )
+        from dataclasses import replace
+
+        from .speculative import speculative_generate_jit
+
+        draft_config = replace(model_config, n_layers=n_draft)
+        worker_kwargs["generate_fn"] = (
+            lambda p, t, n, lengths: speculative_generate_jit(
+                p, model_config,
+                dict(p, layers=p["layers"][:n_draft]), draft_config,
+                t, n, k, lengths=lengths,
+            )
+        )
+        log.info(
+            "Speculative decoding: %d-layer early-exit self-draft, "
+            "%d proposals/round", n_draft, k,
+        )
+
     if args.continuous:
         # rolling-slot serving: single-chip gpt decode path (the slot
         # insertion splices into the per-row cache; mesh-sharded and GQA

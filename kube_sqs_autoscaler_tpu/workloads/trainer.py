@@ -140,6 +140,17 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _lora_base_state(mesh, base):
+    """The frozen-base 'state' of a LoRA run: just the placed params —
+    no optimizer moments, no step (init_lora_train_state carries those
+    for the adapters)."""
+    import jax
+
+    from .train import param_shardings
+
+    return {"params": jax.device_put(base, param_shardings(mesh, base))}
+
+
 def train(args) -> dict:
     """Run the loop; returns ``{"losses": [...], "final_step": int}``."""
     import jax
@@ -273,19 +284,13 @@ def train(args) -> dict:
             # moments are ever materialized (the whole point of LoRA —
             # peak HBM stays at 1x the base, not 3x)
             from .llama import init_llama_params
-            from .train import param_shardings
 
-            base = (
+            state = _lora_base_state(
+                mesh,
                 hf_base if hf_base is not None
                 else init_llama_params(jax.random.key(args.seed),
-                                       model_config)
+                                       model_config),
             )
-            state = {
-                "params": jax.device_put(
-                    base, param_shardings(mesh, base)
-                ),
-                "step": jax.numpy.zeros((), jax.numpy.int32),
-            }
         elif hf_base is not None:
             # same state shape as a fresh init, with the imported weights
             # as the starting point (full fine-tune)
@@ -334,15 +339,10 @@ def train(args) -> dict:
         elif args.lora_rank:
             # params only — no full-model Adam moments (see llama branch)
             from .model import init_params
-            from .train import param_shardings
 
-            base = init_params(jax.random.key(args.seed), model_config)
-            state = {
-                "params": jax.device_put(
-                    base, param_shardings(mesh, base)
-                ),
-                "step": jax.numpy.zeros((), jax.numpy.int32),
-            }
+            state = _lora_base_state(
+                mesh, init_params(jax.random.key(args.seed), model_config)
+            )
         else:
             state = place_state(
                 mesh, init_train_state(jax.random.key(args.seed), model_config,

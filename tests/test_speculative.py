@@ -186,6 +186,31 @@ def test_speculative_tight_budget_with_uneven_acceptance():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_serve_binary_speculative_flag():
+    """--speculative-draft-layers end to end for both families, plus the
+    fail-fast guards (sampling, layer bound)."""
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--speculative-draft-layers", "2"])
+    main(["--family", "llama", "--demo", "2", "--batch-size", "1",
+          "--seq-len", "8", "--generate-tokens", "4",
+          "--speculative-draft-layers", "1"])
+    with pytest.raises(SystemExit, match="greedy-exact"):
+        main(["--demo", "1", "--generate-tokens", "4", "--temperature",
+              "0.5", "--speculative-draft-layers", "1"])
+    with pytest.raises(SystemExit, match="n_layers"):
+        main(["--demo", "1", "--generate-tokens", "4",
+              "--speculative-draft-layers", "99"])
+    with pytest.raises(SystemExit, match="n_layers"):
+        main(["--demo", "1", "--generate-tokens", "4",
+              "--speculative-draft-layers", "-1"])
+    with pytest.raises(SystemExit, match="draft-tokens"):
+        main(["--demo", "1", "--generate-tokens", "4",
+              "--speculative-draft-layers", "1",
+              "--speculative-draft-tokens", "0"])
+
+
 def test_speculative_validation(models):
     params_t, params_d = models
     prompt = prompt_tokens()
