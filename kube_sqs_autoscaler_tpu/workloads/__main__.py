@@ -274,7 +274,6 @@ def main(argv=None) -> None:
             ),
         }
     elif family == "llama":
-        from .flash import attention_fn_for
         from .llama import (
             llama_attention_fn_for,
             llama_forward_jit_with,
@@ -297,7 +296,13 @@ def main(argv=None) -> None:
                 p, t, n, model_config,
                 temperature=args.temperature,
                 rng=(next(keys) if args.temperature > 0.0 else None),
-                prompt_attention=attention_fn_for(t.shape[1]),
+                # llama_attention_fn_for carries config.sliding_window
+                # into the prefill kernel (flash windowed block-skip or
+                # windowed dense) — a bare attention_fn_for pick would
+                # prefill a Mistral-style model full-causal
+                prompt_attention=llama_attention_fn_for(
+                    model_config, t.shape[1]
+                ),
                 lengths=lengths, top_k=service_config.top_k,
                 top_p=service_config.top_p,
             ),

@@ -136,7 +136,11 @@ def prefill(
 
 
 def _cached_attention(
-    q: jax.Array, k_cache: jax.Array, v_cache: jax.Array, length: jax.Array
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    length: jax.Array,
+    window: int | None = None,
 ) -> jax.Array:
     """One query position per row against the padded cache.
 
@@ -145,9 +149,9 @@ def _cached_attention(
     just written at ``length[b]``) — later positions are pads or other
     rows' leftovers and get ``-inf``.  The ``T = 1`` case of
     :func:`_chunk_cached_attention` (one implementation of the masked
-    fp32 score/softmax math).
+    fp32 score/softmax math; ``window`` = sliding-window lookback).
     """
-    return _chunk_cached_attention(q, k_cache, v_cache, length)
+    return _chunk_cached_attention(q, k_cache, v_cache, length, window)
 
 
 def decode_step(
@@ -209,6 +213,7 @@ def _chunk_cached_attention(
     k_cache: jax.Array,
     v_cache: jax.Array,
     start: jax.Array,
+    window: int | None = None,
 ) -> jax.Array:
     """``T`` query positions per row against the padded cache.
 
@@ -216,7 +221,9 @@ def _chunk_cached_attention(
     ``[B, H, S_max, D]`` with the chunk's keys already written at those
     positions.  Query ``t`` attends cache entries ``<= start[b] + t`` —
     the causal mask of a chunk appended to a ragged prefix (fp32
-    scores/softmax, like :func:`_cached_attention`).
+    scores/softmax, like :func:`_cached_attention`).  ``window``
+    additionally hides entries older than the query's last ``window``
+    positions (sliding-window models).
     """
     head_dim = q.shape[-1]
     chunk = q.shape[2]
@@ -227,7 +234,10 @@ def _chunk_cached_attention(
     q_pos = start[:, None, None, None] + jax.lax.broadcasted_iota(
         jnp.int32, (1, 1, chunk, 1), 2
     )
-    scores = jnp.where(key_pos <= q_pos, scores, jnp.float32(-jnp.inf))
+    valid = key_pos <= q_pos
+    if window is not None:
+        valid = valid & (key_pos > q_pos - window)
+    scores = jnp.where(valid, scores, jnp.float32(-jnp.inf))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
 

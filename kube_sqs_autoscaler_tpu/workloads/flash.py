@@ -133,9 +133,28 @@ def _pick_block(seq_len: int, requested: int | None) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _window_live(q_offset, k_offset, block_k: int, window: int | None):
+    """Whether any of this block's keys can fall inside some row's
+    sliding window (False = the whole block is older than the oldest
+    row's window start and is skipped like an above-diagonal block).
+    Offsets are traced grid values; ``window`` is static."""
+    if window is None:
+        return True
+    return k_offset + block_k - 1 >= q_offset - window + 1
+
+
+def _window_mask(scores, rows, cols, window: int | None):
+    """Mask keys older than each row's ``window``-position lookback
+    (row ``r`` attends ``r - window + 1 .. r`` under causality)."""
+    if window is None:
+        return scores
+    return jnp.where(cols > rows - window, scores, -jnp.inf)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest,
     block_q: int, block_k: int, scale: float, causal: bool, q_shift: int,
+    window: int | None,
 ):
     # rest = (lse_ref,) + scratch when the caller needs the backward's
     # logsumexp residual, else just the scratch refs
@@ -162,10 +181,15 @@ def _fwd_kernel(
         sum_ref[:] = jnp.zeros_like(sum_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # blocks strictly above the diagonal contribute nothing under causality
+    # blocks strictly above the diagonal contribute nothing under
+    # causality; blocks entirely below the sliding window likewise
     diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
+    live = jnp.logical_and(
+        jnp.logical_or(not causal, diagonal_or_below),
+        _window_live(q_offset + q_shift, k_offset, block_k, window),
+    )
 
-    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    @pl.when(live)
     def _compute():
         # keep q/k in their storage dtype (bf16) into the dot so the MXU
         # runs bf16 inputs with fp32 accumulate — casting to f32 first would
@@ -183,14 +207,26 @@ def _fwd_kernel(
             cols = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            scores = jnp.where(rows >= cols, scores, -jnp.inf)
+            # a sliding window uses a large FINITE mask: a row whose whole
+            # block is below its window would make block_max = -inf and
+            # exp(-inf - -inf) = NaN; with -1e30 the dead row's new_max
+            # stays -1e30 and the explicit live-row guard below zeroes its
+            # probs.  The windowless path keeps the exact -inf masking
+            # (every row's k block 0 is live under plain causality).
+            mask_value = -jnp.inf if window is None else jnp.float32(-1e30)
+            scores = jnp.where(rows >= cols, scores, mask_value)
+            if window is not None:
+                scores = jnp.where(cols > rows - window, scores, mask_value)
         run_max = max_ref[:]
         block_max = jnp.max(scores, axis=-1, keepdims=True)
         new_max = jnp.maximum(run_max, block_max)
         # rows fully masked in THIS block get exp(-inf - finite) = 0; rows
         # with no finite max yet cannot occur under causal iteration order
-        # (k block 0 is unmasked for every q row)
+        # (k block 0 is unmasked for every q row) — except under a sliding
+        # window, where the live-row guard handles them
         probs = jnp.exp(scores - new_max)
+        if window is not None:
+            probs = probs * (new_max > -1e29)
         correction = jnp.exp(run_max - new_max)
         max_ref[:] = new_max
         sum_ref[:] = sum_ref[:] * correction + jnp.sum(
@@ -214,11 +250,12 @@ def _fwd_kernel(
     jax.jit,
     static_argnames=(
         "block_q", "block_k", "causal", "interpret", "need_lse", "q_shift",
+        "window",
     ),
 )
 def _fwd_call(
     q, k, v, *, block_q: int, block_k: int, causal: bool, interpret: bool,
-    need_lse: bool, q_shift: int = 0,
+    need_lse: bool, q_shift: int = 0, window: int | None = None,
 ):
     # need_lse=False (forward-only / serving): the logsumexp output is not
     # declared at all, so the kernel writes no [B, H, S, _LANES] residual
@@ -246,6 +283,7 @@ def _fwd_call(
         scale=1.0 / head_dim**0.5,
         causal=causal,
         q_shift=q_shift,
+        window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -281,6 +319,7 @@ def _fwd_call(
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, block_q: int, block_k: int, scale: float, causal: bool, q_shift: int,
+    window: int | None,
 ):
     q_block_idx = pl.program_id(2)
     k_block_idx = pl.program_id(3)
@@ -293,8 +332,12 @@ def _bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
+    live = jnp.logical_and(
+        jnp.logical_or(not causal, diagonal_or_below),
+        _window_live(q_offset + q_shift, k_offset, block_k, window),
+    )
 
-    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -310,7 +353,10 @@ def _bwd_dq_kernel(
             cols = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
+            # -inf is NaN-safe here: p = exp(-inf - lse) = 0 because every
+            # row's lse is finite (the diagonal key is always in-window)
             scores = jnp.where(rows >= cols, scores, -jnp.inf)
+            scores = _window_mask(scores, rows, cols, window)
         # exact softmax probabilities via the saved logsumexp: masked
         # entries are exp(-inf - finite) = 0 (row stats are
         # lane-replicated [bq, _LANES] tiles; column 0 is the value)
@@ -330,7 +376,7 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, block_k: int, num_q_blocks: int, scale: float,
-    causal: bool, q_shift: int,
+    causal: bool, q_shift: int, window: int | None,
 ):
     # grid (B, H_kv, S/bk, groups * S/bq): the innermost axis enumerates
     # (query head of the group, q block) pairs, so the VMEM accumulators
@@ -349,8 +395,12 @@ def _bwd_dkv_kernel(
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     diagonal_or_below = k_offset <= q_offset + q_shift + block_q - 1
+    live = jnp.logical_and(
+        jnp.logical_or(not causal, diagonal_or_below),
+        _window_live(q_offset + q_shift, k_offset, block_k, window),
+    )
 
-    @pl.when(jnp.logical_or(not causal, diagonal_or_below))
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -367,6 +417,7 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             scores = jnp.where(rows >= cols, scores, -jnp.inf)
+            scores = _window_mask(scores, rows, cols, window)
         p = jnp.exp(scores - lse_ref[0, 0][:, :1])  # [bq, bk]
         dv_acc[:] += jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -385,12 +436,14 @@ def _bwd_dkv_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_q", "block_k", "causal", "interpret", "q_shift"),
+    static_argnames=(
+        "block_q", "block_k", "causal", "interpret", "q_shift", "window",
+    ),
 )
 def _bwd_call(
     q, k, v, out, lse, do, dlse=None,
     *, block_q: int, block_k: int, causal: bool, interpret: bool,
-    q_shift: int = 0,
+    q_shift: int = 0, window: int | None = None,
 ):
     batch, heads, q_len, head_dim = q.shape
     kv_heads, k_len = k.shape[1], k.shape[2]
@@ -427,7 +480,7 @@ def _bwd_call(
         functools.partial(
             _bwd_dq_kernel,
             block_q=block_q, block_k=block_k, scale=scale, causal=causal,
-            q_shift=q_shift,
+            q_shift=q_shift, window=window,
         ),
         grid=(batch, heads, num_q_blocks, num_k_blocks),
         compiler_params=_GRID_SEMANTICS,
@@ -451,7 +504,7 @@ def _bwd_call(
         functools.partial(
             _bwd_dkv_kernel,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
-            scale=scale, causal=causal, q_shift=q_shift,
+            scale=scale, causal=causal, q_shift=q_shift, window=window,
         ),
         grid=(batch, kv_heads, num_k_blocks, groups * num_q_blocks),
         compiler_params=_GRID_SEMANTICS,
@@ -475,28 +528,29 @@ def _bwd_call(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, block_q, block_k, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, block_q, block_k, causal, interpret, window):
     out, _ = _fwd_call(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        interpret=interpret, need_lse=False,
+        interpret=interpret, need_lse=False, window=window,
     )
     return out
 
 
-def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
+def _flash_fwd(q, k, v, block_q, block_k, causal, interpret, window):
     out, lse = _fwd_call(
         q, k, v, block_q=block_q, block_k=block_k, causal=causal,
-        interpret=interpret, need_lse=True,
+        interpret=interpret, need_lse=True, window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(block_q, block_k, causal, interpret, residuals, do):
+def _flash_bwd(block_q, block_k, causal, interpret, window, residuals, do):
     q, k, v, out, lse = residuals
     dq, dk, dv = _bwd_call(
         q, k, v, out, lse, do,
         block_q=block_q, block_k=block_k, causal=causal, interpret=interpret,
+        window=window,
     )
     return dq, dk, dv
 
@@ -614,6 +668,7 @@ def flash_attention(
     block_k: int | None = None,
     causal: bool = True,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Causal flash attention on ``[B, H, S, D]`` (drop-in for
     ``model._dense_attention``), differentiable (Pallas backward kernels)
@@ -621,8 +676,13 @@ def flash_attention(
     ``H % H_kv == 0`` — the compact heads are streamed directly, no
     ``repeat_kv`` materialization.
 
+    ``window`` enables Mistral-style sliding-window attention: row ``r``
+    attends keys ``r - window + 1 .. r`` (requires ``causal``).  Blocks
+    entirely below the window are skipped like above-diagonal blocks, so
+    long-sequence cost is ``O(S·window)``, not ``O(S²)``.
+
     ``block_q``/``block_k`` default to the largest power-of-two tile up to
-    512 that divides ``S``. ``interpret=None`` auto-selects: compiled
+    1024 that divides ``S``. ``interpret=None`` auto-selects: compiled
     kernel on TPU, Pallas interpreter elsewhere (same code path, for
     tests/CPU dev — slow). Requires ``S`` divisible by the block sizes;
     callers with small/odd shapes should use the dense path (see
@@ -633,6 +693,11 @@ def flash_attention(
         raise ValueError(
             f"query heads {q.shape[1]} not divisible by kv heads {k.shape[1]}"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window={window} must be >= 1")
     block_q = _pick_block(seq_len, block_q)
     block_k = _pick_block(seq_len, block_k)
     if seq_len % block_q or seq_len % block_k:
@@ -642,7 +707,7 @@ def flash_attention(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, block_q, block_k, causal, interpret)
+    return _flash(q, k, v, block_q, block_k, causal, interpret, window)
 
 
 # GQA marker the attention_fn dispatchers check: this kernel accepts
@@ -687,6 +752,20 @@ def attention_fn_for(
     return _dense_attention
 
 
+def windowed(fn, window: int | None):
+    """Bind a sliding window into an attention fn (``flash_attention`` or
+    ``model._dense_attention`` — both take ``window=``), preserving the
+    ``gqa_native`` marker.  ``None`` returns ``fn`` untouched."""
+    if window is None:
+        return fn
+
+    def attend(q, k, v):
+        return fn(q, k, v, window=window)
+
+    attend.gqa_native = getattr(fn, "gqa_native", False)
+    return attend
+
+
 def gqa_adapt(fn):
     """The one place the GQA broadcast policy lives: adapt ``fn`` so it
     accepts compact ``[B, H_kv, S, D]`` k/v.  GQA-native kernels (marked
@@ -716,6 +795,7 @@ def make_sharded_attention(
     data_axis: str = "data",
     model_axis: str = "model",
     backend: str | None = None,
+    window: int | None = None,
 ):
     """Attention fn for a ``(data, model)``-sharded mesh: per-shard
     flash-or-dense, wrapped in ``shard_map``.
@@ -737,9 +817,9 @@ def make_sharded_attention(
     model_n = mesh.shape.get(model_axis, 1)
 
     def local(q, k, v):
-        return gqa_adapt(attention_fn_for(q.shape[2], backend=backend))(
-            q, k, v
-        )
+        return gqa_adapt(
+            windowed(attention_fn_for(q.shape[2], backend=backend), window)
+        )(q, k, v)
 
     def attend(q, k, v):
         # shard_map needs exact divisibility (unlike NamedSharding, which
@@ -753,7 +833,7 @@ def make_sharded_attention(
         ):
             from .model import _dense_attention
 
-            return gqa_adapt(_dense_attention)(q, k, v)
+            return gqa_adapt(windowed(_dense_attention, window))(q, k, v)
         # check_vma=False: pallas_call out_shapes carry no varying-mesh-axes
         # info, so the vma checker cannot type the kernel's outputs
         return jax.shard_map(

@@ -67,6 +67,8 @@ def llama_config_from_hf(hf_config: Any, dtype: Any = None) -> LlamaConfig:
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(hf_config.rope_theta),
         rms_eps=float(hf_config.rms_norm_eps),
+        # Mistral configs carry sliding_window (None for plain Llama)
+        sliding_window=getattr(hf_config, "sliding_window", None),
         dtype=dtype if dtype is not None else jnp.bfloat16,
     )
 
@@ -172,9 +174,16 @@ def load_hf_llama(
     the CPU.  ``dtype`` sets the parameter storage dtype (default bf16).
     """
     if isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
-        from transformers import LlamaForCausalLM
+        # Llama and Mistral share the state-dict layout; dispatch on the
+        # saved config's model_type so both directory kinds load
+        from transformers import AutoConfig
 
-        source = LlamaForCausalLM.from_pretrained(source)
+        model_type = AutoConfig.from_pretrained(source).model_type
+        if model_type == "mistral":
+            from transformers import MistralForCausalLM as _Model
+        else:
+            from transformers import LlamaForCausalLM as _Model
+        source = _Model.from_pretrained(source)
     config = llama_config_from_hf(source.config, dtype=dtype)
     state = dict(source.state_dict())
     if getattr(source.config, "tie_word_embeddings", False):

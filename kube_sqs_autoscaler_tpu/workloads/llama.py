@@ -56,6 +56,11 @@ class LlamaConfig:
     # RMSNorm epsilon: 1e-6 is the Llama-1/3 convention; Llama-2
     # checkpoints ship 1e-5 (carried through by .hf_convert)
     rms_eps: float = 1e-6
+    # Mistral-style sliding-window attention: each position attends only
+    # its last `sliding_window` keys (None = full causal).  Carried from
+    # HF Mistral configs by .hf_convert; applies to training forwards,
+    # prefill, and decode alike.
+    sliding_window: int | None = None
     dtype: Any = jnp.bfloat16
 
     @property
@@ -251,7 +256,10 @@ def _gqa_wrap(config: LlamaConfig, inner):
 
 
 def _gqa_dense_attention(config: LlamaConfig):
-    return _gqa_wrap(config, _dense_attention)
+    from .flash import windowed
+
+    return _gqa_wrap(config, windowed(_dense_attention,
+                                      config.sliding_window))
 
 
 def llama_attention_fn_for(
@@ -262,12 +270,20 @@ def llama_attention_fn_for(
     Same policy as :func:`.flash.attention_fn_for` (Pallas flash kernel
     on TPU when the shape tiles onto the MXU blocks, dense XLA path
     elsewhere); K/V broadcast from ``n_kv_heads`` to full heads just
-    before the kernel, which is MHA-shaped.  Plug into
+    before the kernel, which is MHA-shaped.  ``config.sliding_window``
+    rides along into whichever implementation wins (the flash kernel's
+    windowed block-skip, or the dense mask).  Plug into
     :func:`llama_forward`/:func:`llama_forward_jit_with`.
     """
-    from .flash import attention_fn_for
+    from .flash import attention_fn_for, windowed
 
-    return _gqa_wrap(config, attention_fn_for(seq_len, backend=backend))
+    return _gqa_wrap(
+        config,
+        windowed(
+            attention_fn_for(seq_len, backend=backend),
+            config.sliding_window,
+        ),
+    )
 
 
 def llama_forward(
@@ -377,12 +393,16 @@ def llama_mesh_loss(config: LlamaConfig, train_config):
 def make_llama_train_step(mesh, config: LlamaConfig, train_config,
                           state: dict):
     """dp x tp (x sp) train step via :func:`.train.make_train_step`'s
-    seams, with :func:`llama_mesh_loss` as the objective."""
+    seams, with :func:`llama_mesh_loss` as the objective.
+    ``config.sliding_window`` rides the shared attention seam (windowed
+    flash/dense per shard; fails fast on a ``seq`` mesh — the ring
+    schedule has no window-skip)."""
     from .train import make_train_step
 
     return make_train_step(
         mesh, config, train_config, state,
         loss=llama_mesh_loss(config, train_config),
+        window=config.sliding_window,
     )
 
 
@@ -433,9 +453,11 @@ def llama_prefill(
 ) -> tuple[jax.Array, dict]:
     """Prompt pass populating a fresh GQA cache (same contract as
     :func:`.decode.prefill`, including ragged right-padded prompts via
-    ``lengths``).  ``prompt_attention`` is an MHA-shaped causal kernel
-    for the prompt pass (dense default; pass
-    :func:`.flash.attention_fn_for`'s pick on TPU).
+    ``lengths``).  ``prompt_attention`` is a causal kernel for the
+    prompt pass — pass :func:`llama_attention_fn_for`'s pick (it carries
+    the config's sliding window into flash/dense; a plain
+    ``.flash.attention_fn_for`` pick would prefill a windowed model
+    full-causal).  Default: window-aware dense.
     """
     batch, prompt_len = tokens.shape
     if prompt_len > config.max_seq_len:
@@ -443,7 +465,11 @@ def llama_prefill(
             f"prompt length {prompt_len} exceeds max_seq_len={config.max_seq_len}"
         )
     cache = init_llama_cache(config, batch)
-    inner = _gqa_wrap(config, prompt_attention or _dense_attention)
+    inner = (
+        _gqa_wrap(config, prompt_attention)
+        if prompt_attention is not None
+        else _gqa_dense_attention(config)  # window-aware default
+    )
     new_layers = []
 
     def attend(q, k, v):
@@ -498,7 +524,8 @@ def llama_decode_step(
             )
             new_layers.append({"k": k_cache, "v": v_cache})
             return _cached_attention(
-                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups), pos
+                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
+                pos, window=config.sliding_window,
             )
 
         x = _llama_block(x, layer, config, positions, attend)
@@ -540,7 +567,7 @@ def llama_chunk_decode(
             new_layers.append({"k": k_cache, "v": v_cache})
             return _chunk_cached_attention(
                 q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
-                start,
+                start, window=config.sliding_window,
             )
 
         x = _llama_block(x, layer, config, positions, attend)

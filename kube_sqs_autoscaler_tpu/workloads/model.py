@@ -144,15 +144,24 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     )
 
 
-def _dense_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Default attention on ``[B, H, S, D]``: full causal, fp32 softmax."""
+def _dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, window: int | None = None
+) -> jax.Array:
+    """Default attention on ``[B, H, S, D]``: full causal, fp32 softmax.
+
+    ``window`` restricts each row to its last ``window`` keys
+    (Mistral-style sliding window; ``None`` = full causal)."""
     head_dim = q.shape[-1]
     seq = q.shape[2]
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) / (head_dim**0.5)
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-    scores = jnp.where(causal, scores, jnp.float32(-1e9))
+    rows = jnp.arange(seq)[:, None]
+    cols = jnp.arange(seq)[None, :]
+    mask = rows >= cols
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    scores = jnp.where(mask, scores, jnp.float32(-1e9))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 

@@ -424,6 +424,9 @@ def _make_moe_step(mesh, config, moe: MoeConfig, train_config, state: dict,
     return make_train_step(
         mesh, config, train_config, state,
         loss=partial(loss_fn, config=config, moe=moe),
+        # llama MoE configs may carry a sliding window; it rides the
+        # shared attention seam like the dense llama step's
+        window=getattr(config, "sliding_window", None),
     )
 
 

@@ -102,18 +102,30 @@ def make_mesh(
     return Mesh(grid, ("data", "seq", "model"))
 
 
-def mesh_attention_fn(mesh: Mesh):
+def mesh_attention_fn(mesh: Mesh, window: int | None = None):
     """Ring attention when the mesh has a nontrivial ``seq`` axis, else the
     per-shard flash-or-dense dispatcher (:func:`.flash.make_sharded_attention`)
     — on TPU this is what puts the Pallas flash kernel (forward *and*
-    backward) on the training hot path."""
+    backward) on the training hot path.
+
+    ``window`` threads sliding-window attention through the seam (windowed
+    flash block-skip / windowed dense mask per shard); it does not compose
+    with the ring schedule, so a windowed config on a ``seq`` mesh fails
+    here — the one place every consumer of the seam shares.
+    """
     if mesh.shape.get("seq", 1) > 1:
+        if window is not None:
+            raise ValueError(
+                "sliding_window does not compose with sequence "
+                "parallelism (ring attention has no windowed schedule); "
+                "use a (data, model) mesh"
+            )
         from .ring import make_ring_attention
 
         return make_ring_attention(mesh)
     from .flash import make_sharded_attention
 
-    return make_sharded_attention(mesh)
+    return make_sharded_attention(mesh, window=window)
 
 
 def _param_spec(path: tuple, mesh: Mesh) -> P:
@@ -400,6 +412,7 @@ def make_train_step(
     state_shardings_fn: Any = None,
     batch_sharding_fn: Any = None,
     value_and_grad_fn: Any = None,
+    window: int | None = None,
 ):
     """Compile one optimizer step over the mesh.
 
@@ -419,7 +432,10 @@ def make_train_step(
     optimizer = make_optimizer(train_config)
     shardings = (state_shardings_fn or state_shardings)(mesh, state)
     batch_shard = (batch_sharding_fn or batch_sharding)(mesh)
-    attention_fn = mesh_attention_fn(mesh)
+    # ``window`` reaches every objective through the shared seam (see
+    # mesh_attention_fn) — the llama/moe factories pass their config's
+    # sliding_window so no consumer re-plumbs it by hand
+    attention_fn = mesh_attention_fn(mesh, window=window)
     if loss is None:
         loss = partial(
             loss_fn, config=model_config, remat=train_config.remat
@@ -502,10 +518,13 @@ def make_forward_step(
     ``forward_fn(params, tokens, config, attention_fn)`` defaults to the
     gpt-family :func:`.model.forward`; the llama family passes
     ``llama.llama_forward`` (the mesh attention seam is GQA-native, so
-    the same wiring serves both).
+    the same wiring serves both).  A ``sliding_window`` on the config is
+    read off it and threaded through the seam.
     """
     p_shardings = param_shardings(mesh, params)
-    attention_fn = mesh_attention_fn(mesh)
+    attention_fn = mesh_attention_fn(
+        mesh, window=getattr(model_config, "sliding_window", None)
+    )
     forward_fn = forward_fn or forward
 
     def forward_step(params, tokens):

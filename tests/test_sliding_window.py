@@ -1,0 +1,234 @@
+"""Sliding-window attention: the flash kernel's windowed block-skip must
+equal the windowed dense mask (values AND gradients), the llama family
+must reproduce transformers' Mistral forward on converted weights, and
+decode must respect the window through the cache masks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention
+from kube_sqs_autoscaler_tpu.workloads.model import _dense_attention
+
+
+def qkv(batch=2, heads=4, seq=256, dim=32, seed=0):
+    keys = jax.random.split(jax.random.key(seed), 3)
+    return tuple(
+        (jax.random.normal(key, (batch, heads, seq, dim), jnp.float32)
+         / dim**0.25)
+        for key in keys
+    )
+
+
+@pytest.mark.parametrize("window", [1, 7, 128, 300])
+def test_flash_window_matches_dense_window(window):
+    q, k, v = qkv()
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, window=window) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.mean(_dense_attention(q, k, v, window=window) ** 2)
+
+    out_f = flash_attention(q, k, v, window=window)
+    out_d = _dense_attention(q, k, v, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-5, atol=2e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_window_at_least_seq_equals_full_causal():
+    q, k, v = qkv(seq=128)
+    full = flash_attention(q, k, v)
+    windowed = flash_attention(q, k, v, window=128)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(windowed), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_flash_window_gqa_compact_kv():
+    q, _, _ = qkv(heads=4)
+    _, k, v = qkv(heads=2, seed=5)
+    from kube_sqs_autoscaler_tpu.workloads.llama import repeat_kv
+
+    out = flash_attention(q, k, v, window=9)
+    ref = _dense_attention(q, repeat_kv(k, 2), repeat_kv(v, 2), window=9)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_window_validation():
+    q, k, v = qkv(seq=128)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=4)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, window=0)
+
+
+# ---------------------------------------------------------------------------
+# Mistral parity through hf_convert
+# ---------------------------------------------------------------------------
+
+
+def make_hf_mistral(sliding_window, seed=0):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(seed)
+    model = MistralForCausalLM(MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=sliding_window,
+        attn_implementation="eager", tie_word_embeddings=False,
+    ))
+    model.eval()
+    return model
+
+
+def test_converted_mistral_matches_transformers():
+    torch = pytest.importorskip("torch")
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import load_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import llama_forward
+
+    model = make_hf_mistral(sliding_window=8)
+    config, params = load_hf_llama(model, dtype=jnp.float32)
+    assert config.sliding_window == 8
+
+    tokens = np.random.default_rng(1).integers(0, 128, (2, 24)).astype(
+        np.int32
+    )  # 24 > window so the mask really bites
+    ours = np.asarray(llama_forward(params, jnp.asarray(tokens), config))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens).long()).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_converted_mistral_greedy_generation_matches():
+    torch = pytest.importorskip("torch")
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import load_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import llama_generate
+
+    model = make_hf_mistral(sliding_window=6, seed=3)
+    config, params = load_hf_llama(model, dtype=jnp.float32)
+    prompt = np.random.default_rng(2).integers(0, 128, (2, 10)).astype(
+        np.int32
+    )
+    ours = np.asarray(llama_generate(params, jnp.asarray(prompt), 12,
+                                     config))
+    with torch.no_grad():
+        theirs = model.generate(
+            torch.from_numpy(prompt).long(), max_new_tokens=12,
+            do_sample=False, num_beams=1, pad_token_id=0,
+        )[:, prompt.shape[1]:].numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_serve_path_prefill_kernel_carries_the_window():
+    """The serve binary's generate lambda passes an explicit prefill
+    kernel; llama_attention_fn_for must carry the window so a Mistral
+    prompt longer than its window prefills windowed (a bare
+    flash.attention_fn_for pick would not)."""
+    torch = pytest.importorskip("torch")
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import load_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        llama_attention_fn_for,
+        llama_generate_jit,
+    )
+
+    model = make_hf_mistral(sliding_window=6, seed=9)
+    config, params = load_hf_llama(model, dtype=jnp.float32)
+    prompt = np.random.default_rng(4).integers(0, 128, (2, 16)).astype(
+        np.int32
+    )  # 16 > window=6: prefill masking matters
+    ours = np.asarray(llama_generate_jit(
+        params, jnp.asarray(prompt), 8, config,
+        prompt_attention=llama_attention_fn_for(config, prompt.shape[1]),
+    ))
+    with torch.no_grad():
+        theirs = model.generate(
+            torch.from_numpy(prompt).long(), max_new_tokens=8,
+            do_sample=False, num_beams=1, pad_token_id=0,
+        )[:, prompt.shape[1]:].numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_mesh_forward_step_carries_the_window():
+    """make_forward_step (the sharded classify path) reads
+    sliding_window off the config — sharded logits must equal the
+    windowed single-chip forward, not the full-causal one."""
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_forward,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        batch_sharding,
+        make_forward_step,
+        make_mesh,
+        param_shardings,
+    )
+
+    config = LlamaConfig(vocab_size=128, d_model=64, n_heads=4,
+                         n_kv_heads=2, n_layers=2, d_ff=96, max_seq_len=64,
+                         sliding_window=8, dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(0), config)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params, param_shardings(mesh, params))
+    step = make_forward_step(mesh, config, placed, forward_fn=llama_forward)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 128,
+                                jnp.int32)
+    sharded = step(placed, jax.device_put(tokens, batch_sharding(mesh)))
+    reference = llama_forward(params, tokens, config)  # windowed default
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(reference), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_windowed_llama_trains_on_the_mesh():
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_train_state,
+        make_llama_train_step,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        TrainConfig,
+        batch_sharding,
+        make_mesh,
+        place_state,
+    )
+
+    config = LlamaConfig(vocab_size=128, d_model=64, n_heads=4,
+                         n_kv_heads=2, n_layers=2, d_ff=96, max_seq_len=64,
+                         sliding_window=8, dtype=jnp.float32)
+    tc = TrainConfig(learning_rate=1e-2)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    state = place_state(
+        mesh, init_llama_train_state(jax.random.key(0), config, tc)
+    )
+    step = make_llama_train_step(mesh, config, tc, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (4, 32), 0, 128, jnp.int32),
+        batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(4):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    # sequence parallelism has no windowed ring schedule — fail fast
+    sp_mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
+    sp_state = place_state(
+        sp_mesh, init_llama_train_state(jax.random.key(0), config, tc)
+    )
+    with pytest.raises(ValueError, match="sliding_window"):
+        make_llama_train_step(sp_mesh, config, tc, sp_state)
