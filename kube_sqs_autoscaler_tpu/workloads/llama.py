@@ -31,6 +31,7 @@ the divisibility of the array dimension itself).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -262,10 +263,19 @@ def _gqa_dense_attention(config: LlamaConfig):
                                       config.sliding_window))
 
 
+@functools.lru_cache(maxsize=None)
 def llama_attention_fn_for(
     config: LlamaConfig, seq_len: int, *, backend: str | None = None
 ):
     """GQA-aware attention selection for a static prompt length.
+
+    Memoized per ``(config, seq_len, backend)``: callers pass the result
+    as a jit-STATIC argument (``llama_generate_jit``'s
+    ``prompt_attention``, ``llama_forward_jit_with``), which is keyed by
+    object identity — a fresh closure per batch would retrace and
+    recompile the whole program every call.  ``LlamaConfig`` is frozen,
+    so the cache key is exact; the serving worker sees one compiled
+    program per length bucket, as intended.
 
     Same policy as :func:`.flash.attention_fn_for` (Pallas flash kernel
     on TPU when the shape tiles onto the MXU blocks, dense XLA path

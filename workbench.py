@@ -249,6 +249,52 @@ def bench_ring_local(seq: int, iters: int) -> dict:
     }
 
 
+def bench_window(seq: int, window: int, iters: int) -> dict:
+    """Sliding-window flash vs full-causal flash, fwd+bwd: the windowed
+    block-skip should turn O(S^2) into ~O(S*window) past the window."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.flash import flash_attention
+
+    batch, heads, dim = 2, 8, 128
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        (jax.random.normal(key, (batch, heads, seq, dim), jnp.float32)
+         / dim**0.25).astype(jnp.bfloat16)
+        for key in keys
+    )
+
+    def loss_of(window):
+        def fn(q, k, v):
+            return jnp.mean(
+                flash_attention(q, k, v, window=window).astype(jnp.float32)
+                ** 2
+            )
+        return jax.jit(jax.value_and_grad(fn, argnums=(0, 1, 2)))
+
+    win_fn = loss_of(window)
+    full_fn = loss_of(None)
+    _time_compiled(win_fn, q, k, v, iters=2)
+    _time_compiled(full_fn, q, k, v, iters=2)
+    win_reps, full_reps = [], []
+    for _ in range(5):
+        win_reps.append(_time_compiled(win_fn, q, k, v, iters=iters,
+                                       warmup=0))
+        full_reps.append(_time_compiled(full_fn, q, k, v, iters=iters,
+                                        warmup=0))
+    win_s = statistics.median(win_reps)
+    full_s = statistics.median(full_reps)
+    return {
+        "window": window,
+        "windowed_fwdbwd_ms": win_s * 1e3,
+        "full_fwdbwd_ms": full_s * 1e3,
+        "speedup": full_s / win_s,
+    }
+
+
 def bench_speculative(num_tokens: int = 64, draft_tokens: int = 4) -> dict:
     """Greedy decode tokens/s: plain KV-cache generate vs speculative
     draft-and-verify, on a serving-shaped config (identical outputs by
@@ -335,6 +381,7 @@ def main(argv=None) -> dict:
     # local lengths a long-context sp run actually sees
     for seq in (4096, 8192):
         results[f"ring_local_s{seq}"] = bench_ring_local(seq, args.attn_iters)
+    results["window_s8192"] = bench_window(8192, 1024, args.attn_iters)
     results["speculative"] = bench_speculative()
 
     metrics = [
@@ -362,6 +409,8 @@ def main(argv=None) -> dict:
             (f"ring_kernel_speedup_s{seq}", ring["speedup"], "x")
         )
     metrics += [
+        ("window_attention_speedup_s8192",
+         results["window_s8192"]["speedup"], "x"),
         ("decode_tokens_per_sec",
          results["speculative"]["plain_tokens_per_sec"], "tokens/s"),
         ("speculative_decode_speedup",
