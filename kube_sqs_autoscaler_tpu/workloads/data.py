@@ -34,6 +34,31 @@ def synthetic_token_stream(
         yield rng.integers(0, vocab_size, (batch, seq), dtype=np.int32)
 
 
+def corpus_token_stream(
+    data_dir: str,
+    batch: int,
+    seq: int,
+    seed: int = 0,
+    start_step: int = 0,
+) -> Iterator[np.ndarray]:
+    """Endless random-crop batches from an on-disk token corpus via the
+    native mmap reader (``native/tokenreader.py``: C++ double-buffered
+    shard reader; ``write_token_shards`` produces the format).
+
+    Every batch is a pure function of ``(seed, step)``, so a trainer
+    resumed at step ``N`` passes ``start_step=N`` and reads exactly the
+    stream the uninterrupted run would have — no data-cursor state in
+    the checkpoint.
+    """
+    from ..native.tokenreader import TokenReader
+
+    reader = TokenReader(data_dir, min_window=seq)
+    step = start_step
+    while True:
+        yield reader.batch(batch, seq, seed, step)
+        step += 1
+
+
 def prefetch_to_mesh(
     batches: Iterable[np.ndarray],
     sharding: NamedSharding,

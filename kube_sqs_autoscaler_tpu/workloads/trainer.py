@@ -116,6 +116,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--topology-mesh", action="store_true",
         help="order devices along the physical ICI torus (real TPU hardware)",
     )
+    # data
+    parser.add_argument(
+        "--data-dir", default="", metavar="DIR",
+        help="train on an on-disk token corpus (*.bin shards + meta.json, "
+             "see native.tokenreader.write_token_shards) through the "
+             "native mmap reader; default: the synthetic stream",
+    )
     # ops
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
@@ -156,7 +163,11 @@ def train(args) -> dict:
     import jax
 
     from .checkpoint import TrainCheckpointer
-    from .data import prefetch_to_mesh, synthetic_token_stream
+    from .data import (
+        corpus_token_stream,
+        prefetch_to_mesh,
+        synthetic_token_stream,
+    )
     from .distributed import initialize_from_env, make_topology_mesh
     from .model import ModelConfig, param_count
     from .train import (
@@ -517,20 +528,37 @@ def train(args) -> dict:
 
     step_flops = train_step_flops(model_config, args.batch_size, args.seq_len)
 
-    stream = synthetic_token_stream(
-        model_config.vocab_size, args.batch_size, args.seq_len,
-        seed=args.seed,
-    )
+    if args.data_dir:
+        # cheap metadata check before any shard is mmapped
+        from ..native.tokenreader import read_meta
+
+        corpus_vocab = int(read_meta(args.data_dir)["vocab_size"])
+        if corpus_vocab > model_config.vocab_size:
+            raise SystemExit(
+                f"corpus vocab_size={corpus_vocab} exceeds the model's "
+                f"vocab_size={model_config.vocab_size}"
+            )
+        # counter-addressed corpus: resume parity is start_step itself,
+        # no batch skipping needed — except --overfit, which must pin the
+        # step-0 batch on resume too (matching the synthetic branch)
+        stream = corpus_token_stream(
+            args.data_dir, args.batch_size, args.seq_len, seed=args.seed,
+            start_step=0 if args.overfit else start_step,
+        )
+    else:
+        stream = synthetic_token_stream(
+            model_config.vocab_size, args.batch_size, args.seq_len,
+            seed=args.seed,
+        )
+        if start_step and not args.overfit:
+            # data parity on resume: skip the batches the checkpointed run
+            # already consumed so 4+4 resumed steps == one 8-step run.
+            for _ in range(start_step):
+                next(stream)
     if args.overfit:
         import itertools
 
         stream = itertools.repeat(next(stream))
-    elif start_step:
-        # data parity on resume: skip the batches the checkpointed run
-        # already consumed so 4+4 resumed steps == one 8-step run.  (A
-        # real corpus source should instead checkpoint its own cursor.)
-        for _ in range(start_step):
-            next(stream)
     if pipe > 1:
         from .pipeline import pipeline_batch_sharding
 
