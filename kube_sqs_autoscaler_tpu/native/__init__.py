@@ -42,25 +42,35 @@ class NativeUnavailableError(RuntimeError):
     """Raised when the native library cannot be built (no g++)."""
 
 
-def _compile() -> None:
-    _BUILD_DIR.mkdir(exist_ok=True)
-    # per-process tmp name: concurrent builders (parallel pytest workers,
-    # several pods on a shared volume) each write their own file and the
-    # final os.replace is atomic, so a complete .so always wins
-    tmp = _BUILD_DIR / f"liblocalqueue.{os.getpid()}.so.tmp"
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(tmp),
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except FileNotFoundError as err:
-        raise NativeUnavailableError("g++ not found; native queue unavailable") from err
-    except subprocess.CalledProcessError as err:
-        raise NativeUnavailableError(
-            f"native build failed:\n{err.stderr}"
-        ) from err
-    os.replace(tmp, _LIB)
+def build_shared_library(src: Path, lib_path: Path) -> ctypes.CDLL:
+    """Compile-if-stale and dlopen one ``extern "C"`` source — the build
+    model every native component shares (this queue, the token reader).
+
+    One ``g++ -O2 -shared -fPIC -pthread`` invocation cached next to the
+    source and rebuilt when the source is newer.  Concurrent builders
+    (parallel pytest workers, several pods on a shared volume) each write
+    a per-pid tmp file and the final ``os.replace`` is atomic, so a
+    complete .so always wins.
+    """
+    if not lib_path.exists() or lib_path.stat().st_mtime < src.stat().st_mtime:
+        lib_path.parent.mkdir(exist_ok=True)
+        tmp = lib_path.parent / f"{lib_path.stem}.{os.getpid()}.so.tmp"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            str(src), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as err:
+            raise NativeUnavailableError(
+                f"g++ not found; {src.name} unavailable"
+            ) from err
+        except subprocess.CalledProcessError as err:
+            raise NativeUnavailableError(
+                f"native build failed:\n{err.stderr}"
+            ) from err
+        os.replace(tmp, lib_path)
+    return ctypes.CDLL(str(lib_path))
 
 
 def load_library() -> ctypes.CDLL:
@@ -69,9 +79,7 @@ def load_library() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            _compile()
-        lib = ctypes.CDLL(str(_LIB))
+        lib = build_shared_library(_SRC, _LIB)
         c = ctypes
         lib.lq_create.argtypes = [c.c_double]
         lib.lq_create.restype = c.c_void_p

@@ -54,6 +54,7 @@ struct Reader {
   std::vector<Shard> shards;
   int token_bytes = 2;
   long long total_tokens = 0;
+  long long min_shard_tokens = 0;  // crop-safety bound for fill requests
 
   // double buffer: the worker fills `next` for key (step+1) while the
   // caller copies `ready` out
@@ -207,6 +208,10 @@ void* tr_open(const char** paths, long long n, int token_bytes,
     reader->shards.push_back(s);
   }
   reader->total_tokens = first;
+  reader->min_shard_tokens = reader->shards[0].tokens;
+  for (const auto& s : reader->shards) {
+    reader->min_shard_tokens = std::min(reader->min_shard_tokens, s.tokens);
+  }
   reader->worker = std::thread(&Reader::worker_loop, reader);
   if (total_out) *total_out = first;
   if (err_out) *err_out = 0;
@@ -220,10 +225,13 @@ long long tr_total_tokens(void* handle) {
 // Fill [batch, seq] int32 tokens for (seed, step).  Serves from the
 // prefetch buffer when the worker already assembled this exact request,
 // else assembles synchronously; either way kicks off a prefetch of
-// step+1 before returning.
-void tr_fill_batch(void* handle, int32_t* out, long long batch,
-                   long long seq, uint64_t seed, long long step) {
+// step+1 before returning.  Returns 0, or -1 when `seq` exceeds the
+// smallest shard (pick_offset's crops-per-shard count would underflow
+// into an out-of-bounds read).
+int tr_fill_batch(void* handle, int32_t* out, long long batch,
+                  long long seq, uint64_t seed, long long step) {
   auto* r = static_cast<Reader*>(handle);
+  if (seq < 1 || batch < 1 || seq > r->min_shard_tokens) return -1;
   bool served = false;
   {
     std::unique_lock<std::mutex> lock(r->mu);
@@ -252,6 +260,7 @@ void tr_fill_batch(void* handle, int32_t* out, long long batch,
     r->job_pending = true;
     r->cv.notify_all();
   }
+  return 0;
 }
 
 void tr_close(void* handle) { delete static_cast<Reader*>(handle); }

@@ -12,7 +12,6 @@ from __future__ import annotations
 import ctypes
 import json
 import os
-import subprocess
 import threading
 from pathlib import Path
 
@@ -43,34 +42,14 @@ _OPEN_ERRORS = {
 }
 
 
-def _compile() -> None:
-    _BUILD_DIR.mkdir(exist_ok=True)
-    tmp = _BUILD_DIR / f"libtokenreader.{os.getpid()}.so.tmp"
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(tmp),
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except FileNotFoundError as err:
-        raise NativeUnavailableError(
-            "g++ not found; native token reader unavailable"
-        ) from err
-    except subprocess.CalledProcessError as err:
-        raise NativeUnavailableError(
-            f"native build failed:\n{err.stderr}"
-        ) from err
-    os.replace(tmp, _LIB)
-
-
 def load_library() -> ctypes.CDLL:
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            _compile()
-        lib = ctypes.CDLL(str(_LIB))
+        from . import build_shared_library
+
+        lib = build_shared_library(_SRC, _LIB)
         c = ctypes
         lib.tr_open.argtypes = [
             c.POINTER(c.c_char_p), c.c_longlong, c.c_int, c.c_longlong,
@@ -83,7 +62,7 @@ def load_library() -> ctypes.CDLL:
             c.c_void_p, c.POINTER(c.c_int32), c.c_longlong, c.c_longlong,
             c.c_uint64, c.c_longlong,
         ]
-        lib.tr_fill_batch.restype = None
+        lib.tr_fill_batch.restype = c.c_int
         lib.tr_close.argtypes = [c.c_void_p]
         lib.tr_close.restype = None
         _lib = lib
@@ -103,17 +82,24 @@ def write_token_shards(
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     source = np.asarray(tokens)
+    if source.size == 0:
+        raise ValueError("empty token corpus (nothing to shard)")
+    # validate BEFORE the cast: a silent wrap (old numpy) or an obscure
+    # OverflowError (new numpy) would otherwise stand in for these
+    # messages — and a wrapped corpus trains on garbage with no error
+    # anywhere downstream
+    if int(source.min()) < 0:
+        raise ValueError(
+            f"negative token ids (min {int(source.min())}) are not valid "
+            "corpus tokens"
+        )
     if dtype == "uint16":
-        # validate BEFORE the cast: a silent wrap (old numpy) or an
-        # obscure OverflowError (new numpy) would otherwise stand in for
-        # this message — and a wrapped corpus trains on garbage with no
-        # error anywhere downstream
         if vocab_size > 2**16:
             raise ValueError(
                 f"vocab_size={vocab_size} does not fit uint16 tokens; "
                 "pass dtype='int32'"
             )
-        if source.size and int(source.max()) >= 2**16:
+        if int(source.max()) >= 2**16:
             raise ValueError(
                 "token ids >= 2**16 do not fit uint16 shards; pass "
                 "dtype='int32'"
@@ -174,10 +160,16 @@ class TokenReader:
 
     def batch(self, batch: int, seq: int, seed: int, step: int) -> np.ndarray:
         out = np.empty((batch, seq), np.int32)
-        self._lib.tr_fill_batch(
+        status = self._lib.tr_fill_batch(
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             batch, seq, seed & (2**64 - 1), step,
         )
+        if status != 0:
+            raise ValueError(
+                f"batch(seq={seq}) exceeds the smallest shard's tokens "
+                "(crops never span shard boundaries) or has a "
+                "non-positive shape"
+            )
         return out
 
     def close(self) -> None:

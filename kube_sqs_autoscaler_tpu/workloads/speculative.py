@@ -86,6 +86,7 @@ def speculative_generate(
     draft_tokens: int = 4,
     attention_fn=None,
     lengths: jax.Array | None = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy generation through the draft-and-verify loop.
 
@@ -96,7 +97,10 @@ def speculative_generate(
     k draft steps + 1 extra draft consume + one (k+1)-wide target chunk,
     and emits between 1 and k+1 tokens.  The models must share a
     vocabulary; ``lengths`` marks ragged right-padded prompts (both
-    models prefill with it).
+    models prefill with it).  ``return_stats=True`` additionally
+    returns ``{"rounds": [B] int32, "acceptance_rate": [B] fp32}`` — the
+    per-row target-pass count and mean fraction of drafts accepted, the
+    serving-side signal for tuning ``draft_tokens`` and the draft model.
     """
     if config_target.vocab_size != config_draft.vocab_size:
         raise ValueError(
@@ -139,9 +143,11 @@ def speculative_generate(
     out = jnp.zeros((batch, num_tokens + k + 1), jnp.int32)
     out = out.at[:, 0].set(pending)
     count = jnp.ones((batch,), jnp.int32)  # emitted per row (incl. pending)
+    rounds = jnp.zeros((batch,), jnp.int32)
+    accepted_total = jnp.zeros((batch,), jnp.int32)
 
     def round_body(carry):
-        out, count, pending, t_cache, d_cache = carry
+        out, count, pending, t_cache, d_cache, rounds, accepted_total = carry
         # rows already at num_tokens freeze: no emission, no cache/count
         # advance — their chunk writes land in masked slots within the
         # validated budget instead of marching past max_seq_len while
@@ -201,15 +207,25 @@ def speculative_generate(
         t_cache_adv = dict(t_cache_adv, length=t_len + advance)
         dc = dict(dc, length=d_len + advance)
         pending_next = jnp.where(done, pending, bonus)
-        return out, count, pending_next, t_cache_adv, dc
+        rounds = rounds + jnp.where(done, 0, 1)
+        accepted_total = accepted_total + jnp.where(done, 0, n)
+        return (out, count, pending_next, t_cache_adv, dc, rounds,
+                accepted_total)
 
     def cond(carry):
         _, count, *_ = carry
         return jnp.min(count) < num_tokens
 
-    out, count, *_ = jax.lax.while_loop(
-        cond, round_body, (out, count, pending, t_cache, d_cache)
+    out, count, _, _, _, rounds, accepted_total = jax.lax.while_loop(
+        cond, round_body,
+        (out, count, pending, t_cache, d_cache, rounds, accepted_total),
     )
+    if return_stats:
+        proposed = jnp.maximum(rounds * k, 1)
+        return out[:, :num_tokens], {
+            "rounds": rounds,
+            "acceptance_rate": accepted_total / proposed,
+        }
     return out[:, :num_tokens]
 
 
@@ -217,7 +233,7 @@ def speculative_generate(
     jax.jit,
     static_argnames=(
         "config_target", "config_draft", "num_tokens", "draft_tokens",
-        "attention_fn",
+        "attention_fn", "return_stats",
     ),
 )
 def speculative_generate_jit(
@@ -230,11 +246,12 @@ def speculative_generate_jit(
     draft_tokens: int = 4,
     attention_fn=None,
     lengths: jax.Array | None = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Compiled :func:`speculative_generate` (one program: prefills +
     the whole while_loop of rounds)."""
     return speculative_generate(
         params_target, config_target, params_draft, config_draft, prompt,
         num_tokens, draft_tokens=draft_tokens, attention_fn=attention_fn,
-        lengths=lengths,
+        lengths=lengths, return_stats=return_stats,
     )

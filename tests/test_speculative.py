@@ -105,6 +105,30 @@ def test_speculative_ragged_prompts(models):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_speculative_stats(models):
+    params_t, params_d = models
+    prompt = prompt_tokens()
+    tokens, stats = speculative_generate(
+        params_t, TARGET, params_d, DRAFT, prompt, 12, draft_tokens=3,
+        return_stats=True,
+    )
+    ref = np.asarray(generate(params_t, prompt, 12, TARGET))
+    np.testing.assert_array_equal(np.asarray(tokens), ref)
+    rounds = np.asarray(stats["rounds"])
+    rate = np.asarray(stats["acceptance_rate"])
+    # each round emits 1..k+1 tokens: rounds bounded by [ceil(12/4), 12]
+    assert (rounds >= 3).all() and (rounds <= 12).all()
+    assert (rate >= 0).all() and (rate <= 1).all()
+    # self-draft accepts everything: minimal rounds, rate 1
+    tokens, stats = speculative_generate(
+        params_t, TARGET, params_t, TARGET, prompt, 12, draft_tokens=3,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(tokens), ref)
+    assert (np.asarray(stats["acceptance_rate"]) == 1.0).all()
+    assert (np.asarray(stats["rounds"]) == 3).all()  # ceil(12 / 4)
+
+
 def test_speculative_jit_compiled_path(models):
     params_t, params_d = models
     prompt = prompt_tokens(seed=7)

@@ -97,6 +97,19 @@ def test_uint16_writer_rejects_oversized_vocab(tmp_path):
         write_token_shards(tmp_path / "c", [1, 2, 3], vocab_size=70_000)
     with pytest.raises(ValueError, match="uint16"):
         write_token_shards(tmp_path / "c", [1, 70_000], vocab_size=65_536)
+    with pytest.raises(ValueError, match="negative"):
+        write_token_shards(tmp_path / "c", [1, -1], vocab_size=100)
+    with pytest.raises(ValueError, match="empty"):
+        write_token_shards(tmp_path / "c", [], vocab_size=100)
+
+
+def test_oversized_window_request_fails_loudly(tmp_path):
+    # the native fill rejects seq > smallest shard instead of an OOB read
+    path, _ = make_corpus(tmp_path, n_tokens=1000, shard_tokens=100)
+    with TokenReader(path) as reader:  # default min_window=1
+        with pytest.raises(ValueError, match="smallest shard"):
+            reader.batch(2, 512, seed=0, step=0)
+        assert reader.batch(2, 100, seed=0, step=0).shape == (2, 100)
 
 
 def test_open_validation(tmp_path):
