@@ -163,6 +163,129 @@ def llama_params_from_hf(
     return params
 
 
+def _deinterleave_perm(head_dim: int) -> np.ndarray:
+    """Inverse of :func:`_interleave_perm`: interleaved channel ``2i``
+    returns to HF's half-split position ``i``, ``2i+1`` to ``i + D/2``."""
+    perm = _interleave_perm(head_dim)
+    inverse = np.empty_like(perm)
+    inverse[perm] = np.arange(head_dim)
+    return inverse
+
+
+def hf_state_dict_from_llama(params: dict, config: LlamaConfig) -> dict:
+    """The reverse conversion: this package's llama pytree -> an HF
+    ``state_dict`` of numpy fp32 arrays (``transformers`` naming).
+
+    Exact inverse of :func:`llama_params_from_hf`: un-fuse ``wkv`` /
+    ``w_gate_up``, transpose back to ``nn.Linear``'s ``[out, in]``, and
+    apply the inverse RoPE channel permutation to ``wq``/``wk`` so HF's
+    ``rotate_half`` rotation reproduces the interleaved one.  Tied
+    checkpoints (no ``lm_head`` key) omit ``lm_head.weight`` — HF re-ties
+    it from the embedding when ``tie_word_embeddings=True``.
+    """
+    head_dim = config.head_dim
+
+    def t(x):
+        return np.asarray(x, np.float32).T
+
+    def unpermute(w_t: np.ndarray, n_heads: int) -> np.ndarray:
+        d_model = w_t.shape[0]
+        perm = _deinterleave_perm(head_dim)
+        return (
+            w_t.reshape(d_model, n_heads, head_dim)[:, :, perm]
+            .reshape(d_model, n_heads * head_dim)
+        )
+
+    state = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if "lm_head" in params:
+        state["lm_head.weight"] = np.asarray(params["lm_head"], np.float32)
+    for i, layer in enumerate(params["layers"]):
+        prefix = f"model.layers.{i}."
+        wq_t = np.asarray(layer["wq"], np.float32)
+        wkv_t = np.asarray(layer["wkv"], np.float32)
+        kv_dim = config.n_kv_heads * head_dim
+        wk_t, wv_t = wkv_t[:, :kv_dim], wkv_t[:, kv_dim:]
+        gate_up_t = np.asarray(layer["w_gate_up"], np.float32)
+        state.update({
+            prefix + "input_layernorm.weight":
+                np.asarray(layer["attn_norm"], np.float32),
+            prefix + "self_attn.q_proj.weight":
+                unpermute(wq_t, config.n_heads).T,
+            prefix + "self_attn.k_proj.weight":
+                unpermute(wk_t, config.n_kv_heads).T,
+            prefix + "self_attn.v_proj.weight": wv_t.T,
+            prefix + "self_attn.o_proj.weight": t(layer["wo"]),
+            prefix + "post_attention_layernorm.weight":
+                np.asarray(layer["mlp_norm"], np.float32),
+            prefix + "mlp.gate_proj.weight":
+                gate_up_t[:, :config.d_ff].T,
+            prefix + "mlp.up_proj.weight": gate_up_t[:, config.d_ff:].T,
+            prefix + "mlp.down_proj.weight": t(layer["w_down"]),
+        })
+    return state
+
+
+def save_hf_llama(
+    params: dict, config: LlamaConfig, directory: Any
+) -> Any:
+    """Export to a ``transformers``-loadable checkpoint directory.
+
+    Builds the matching HF config (Llama, or Mistral when the config
+    carries a ``sliding_window``), loads the reverse-converted state
+    dict, and ``save_pretrained``s — so weights trained or LoRA-merged
+    here round-trip into the mainstream ecosystem.  Returns the HF model
+    (also handy for in-process comparison).
+    """
+    import torch
+
+    tie = "lm_head" not in params
+    common = dict(
+        vocab_size=config.vocab_size,
+        hidden_size=config.d_model,
+        intermediate_size=config.d_ff,
+        num_hidden_layers=config.n_layers,
+        num_attention_heads=config.n_heads,
+        num_key_value_heads=config.n_kv_heads,
+        max_position_embeddings=config.max_seq_len,
+        rope_theta=config.rope_theta,
+        rms_norm_eps=config.rms_eps,
+        tie_word_embeddings=tie,
+        attn_implementation="eager",
+    )
+    if config.sliding_window is not None:
+        from transformers import MistralConfig, MistralForCausalLM
+
+        hf = MistralForCausalLM(MistralConfig(
+            sliding_window=config.sliding_window, **common
+        ))
+    else:
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM
+
+        hf = LlamaForCausalLM(HFLlamaConfig(**common))
+    state = {
+        k: torch.from_numpy(np.array(v, copy=True))
+        for k, v in hf_state_dict_from_llama(params, config).items()
+    }
+    missing, unexpected = hf.load_state_dict(state, strict=False)
+    # tied models derive lm_head from the embedding; anything else
+    # missing/unexpected is a conversion bug — fail loudly
+    allowed_missing = {"lm_head.weight"} if tie else set()
+    if set(missing) - allowed_missing or unexpected:
+        raise ValueError(
+            f"HF export mismatch: missing={missing} unexpected={unexpected}"
+        )
+    if tie:
+        hf.tie_weights()
+    hf.eval()
+    if directory is not None:
+        hf.save_pretrained(directory)
+    return hf
+
+
 def load_hf_llama(
     source: Any, dtype: Any = None
 ) -> tuple[LlamaConfig, dict]:

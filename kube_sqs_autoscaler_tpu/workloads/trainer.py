@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
              "--lora-rank fine-tuning",
     )
     parser.add_argument(
+        "--hf-export", default="", metavar="DIR",
+        help="after training, export the final weights (LoRA-merged when "
+             "--lora-rank is set) as a transformers-loadable Llama/"
+             "Mistral checkpoint directory (llama family only)",
+    )
+    parser.add_argument(
         "--topology-mesh", action="store_true",
         help="order devices along the physical ICI torus (real TPU hardware)",
     )
@@ -226,6 +232,25 @@ def train(args) -> dict:
         if args.family != "llama":
             log.info("--hf-checkpoint implies --family llama")
             args.family = "llama"
+    if args.hf_export:
+        for flag, bad in (("--family gpt", args.family != "llama"
+                           and not args.hf_checkpoint),
+                          ("--moe", args.moe),
+                          ("--pipe-parallel", pipe > 1)):
+            if bad:
+                raise SystemExit(
+                    f"--hf-export writes llama-family checkpoints; it "
+                    f"does not combine with {flag}"
+                )
+        try:
+            # probe BEFORE training: discovering a missing torch after a
+            # long run (with no --checkpoint-dir) would lose the weights
+            import torch  # noqa: F401
+            import transformers  # noqa: F401
+        except ImportError as err:
+            raise SystemExit(
+                f"--hf-export needs torch + transformers ({err})"
+            ) from err
     train_config = TrainConfig(
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps, remat=args.remat,
@@ -635,10 +660,23 @@ def train(args) -> dict:
                 last_saved = step
                 log.info("Checkpointed step %d", step)
     final_step = int(jax.device_get(state["step"]))
+    # one save_state evaluation serves both the final checkpoint and the
+    # HF export (for LoRA it merges the adapters — do that once)
+    final_state = (
+        save_state(state) if (checkpointer or args.hf_export) else None
+    )
     if checkpointer and last_saved != final_step:
-        checkpointer.save(save_state(state))
+        checkpointer.save(final_state)
     elif checkpointer:
         checkpointer.wait_until_finished()  # fence the last async save
+    if args.hf_export:
+        from .hf_convert import save_hf_llama
+
+        save_hf_llama(
+            jax.device_get(final_state["params"]), model_config,
+            args.hf_export,
+        )
+        log.info("Exported transformers checkpoint to %s", args.hf_export)
     if obs_server is not None:
         obs_server.stop()
     return {"losses": losses, "final_step": final_step}

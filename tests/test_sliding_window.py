@@ -193,6 +193,40 @@ def test_mesh_forward_step_carries_the_window():
     )
 
 
+def test_mistral_export_round_trip(tmp_path):
+    """save_hf_llama's Mistral branch: a windowed config exports as a
+    transformers Mistral checkpoint whose from_pretrained logits match
+    our windowed forward."""
+    torch = pytest.importorskip("torch")
+    from transformers import MistralForCausalLM
+
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import save_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_forward,
+    )
+
+    config = LlamaConfig(vocab_size=128, d_model=64, n_heads=4,
+                         n_kv_heads=2, n_layers=2, d_ff=96, max_seq_len=64,
+                         sliding_window=8, dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(17), config)
+    out = tmp_path / "mistral"
+    save_hf_llama(params, config, out)
+    reloaded = MistralForCausalLM.from_pretrained(out)
+    reloaded.eval()
+    assert reloaded.config.sliding_window == 8
+    tokens = np.random.default_rng(5).integers(0, 128, (2, 20)).astype(
+        np.int32
+    )  # 20 > window so the mask bites
+    ours = np.asarray(llama_forward(params, jnp.asarray(tokens), config))
+    with torch.no_grad():
+        theirs = reloaded(
+            torch.from_numpy(tokens).long()
+        ).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_windowed_llama_trains_on_the_mesh():
     from kube_sqs_autoscaler_tpu.workloads.llama import (
         LlamaConfig,
