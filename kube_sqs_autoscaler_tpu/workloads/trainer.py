@@ -144,6 +144,13 @@ def build_parser() -> argparse.ArgumentParser:
              "(0 = disabled)",
     )
     parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument(
+        "--eval-every", type=int, default=0, metavar="N",
+        help="every N steps, evaluate mean loss on a fixed held-out set "
+             "(--eval-batches batches drawn from a disjoint seed domain "
+             "of the same source; 0 = no eval)",
+    )
+    parser.add_argument("--eval-batches", type=int, default=4)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--overfit", action="store_true",
@@ -232,6 +239,15 @@ def train(args) -> dict:
         if args.family != "llama":
             log.info("--hf-checkpoint implies --family llama")
             args.family = "llama"
+    if args.eval_every > 0:
+        # fail fast with the other combo checks, before any device work
+        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1),
+                          ("--zigzag", args.zigzag),
+                          ("--eval-batches < 1", args.eval_batches < 1)):
+            if bad:
+                raise SystemExit(
+                    f"--eval-every does not combine with {flag}"
+                )
     if args.hf_export:
         for flag, bad in (("--family gpt", args.family != "llama"
                            and not args.hf_checkpoint),
@@ -540,6 +556,64 @@ def train(args) -> dict:
     start_step = int(jax.device_get(state["step"]))
     last_saved = start_step if args.resume else None
 
+    # --- held-out evaluation (fixed batches, pure loss, no update) -------
+    eval_fn = eval_data = None
+    if args.eval_every > 0:
+        from .train import mesh_attention_fn
+
+        window = getattr(model_config, "sliding_window", None)
+        attend = mesh_attention_fn(mesh, window=window)
+        if args.family == "llama":
+            from .llama import llama_mesh_loss
+
+            base_loss = llama_mesh_loss(model_config, train_config)
+        else:
+            from functools import partial as _partial
+
+            from .train import loss_fn as _loss_fn
+
+            base_loss = _partial(_loss_fn, config=model_config,
+                                 remat=train_config.remat)
+
+        if args.lora_rank:
+            from .lora import apply_lora
+
+            def eval_fn_impl(state, tokens):
+                return base_loss(
+                    apply_lora(lora_frozen, state["adapters"], lora_cfg),
+                    tokens, attention_fn=attend,
+                )
+        else:
+            def eval_fn_impl(state, tokens):
+                return base_loss(state["params"], tokens,
+                                 attention_fn=attend)
+
+        eval_fn = jax.jit(eval_fn_impl)
+        # a fixed held-out set from a disjoint seed domain of the same
+        # source — reproducible across runs and resumes
+        eval_seed = args.seed + 0x5EED
+        if args.data_dir:
+            eval_stream = corpus_token_stream(
+                args.data_dir, args.batch_size, args.seq_len,
+                seed=eval_seed, start_step=0,
+            )
+        else:
+            eval_stream = synthetic_token_stream(
+                model_config.vocab_size, args.batch_size, args.seq_len,
+                seed=eval_seed,
+            )
+        shard = batch_sharding(mesh)
+        eval_data = [
+            jax.device_put(next(eval_stream), shard)
+            for _ in range(args.eval_batches)
+        ]
+
+    def run_eval(state):
+        total = 0.0
+        for tokens in eval_data:
+            total += float(eval_fn(state, tokens))
+        return total / len(eval_data)
+
     # opt-in /metrics with the trainer's own numbers (tokens/s, MFU, loss)
     metrics = obs_server = None
     if args.metrics_port:
@@ -651,6 +725,19 @@ def train(args) -> dict:
                 interval_start = now
                 interval_steps = 0
                 log.info("step %d loss %.4f%s", step, loss_value, rate)
+            if eval_fn is not None and step % args.eval_every == 0:
+                eval_loss = run_eval(state)
+                log.info("step %d eval_loss %.4f (%d held-out batches)",
+                         step, eval_loss, len(eval_data))
+                if metrics is not None:
+                    metrics.set_gauge(
+                        "eval_loss", eval_loss,
+                        "Mean loss on the fixed held-out batches.",
+                    )
+                # eval wall time (incl. its first-call compile) must not
+                # be charged to the training-throughput interval
+                interval_start = time.perf_counter()
+                interval_steps = 0
             # checkpoint-every 0 = only the final save below
             if (checkpointer and args.checkpoint_every > 0
                     and step % args.checkpoint_every == 0):
@@ -661,11 +748,13 @@ def train(args) -> dict:
                 log.info("Checkpointed step %d", step)
     final_step = int(jax.device_get(state["step"]))
     # one save_state evaluation serves both the final checkpoint and the
-    # HF export (for LoRA it merges the adapters — do that once)
+    # HF export (for LoRA it merges the adapters — once, and only when
+    # something actually consumes the result)
+    needs_final_save = checkpointer and last_saved != final_step
     final_state = (
-        save_state(state) if (checkpointer or args.hf_export) else None
+        save_state(state) if (needs_final_save or args.hf_export) else None
     )
-    if checkpointer and last_saved != final_step:
+    if needs_final_save:
         checkpointer.save(final_state)
     elif checkpointer:
         checkpointer.wait_until_finished()  # fence the last async save

@@ -139,3 +139,25 @@ def test_trainer_moe_learns():
     losses = result["losses"]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_trainer_eval_loop(caplog):
+    """--eval-every evaluates a fixed held-out set (no update) for both
+    the full and LoRA paths, and rejects the unevaluable layouts."""
+    import logging
+
+    with caplog.at_level(logging.INFO):
+        result = main(TINY_FLAGS + ["--steps", "4", "--eval-every", "2",
+                                    "--eval-batches", "2"])
+    assert result["final_step"] == 4
+    evals = [r for r in caplog.records if "eval_loss" in r.getMessage()]
+    assert len(evals) == 2  # steps 2 and 4
+
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        main(TINY_FLAGS + ["--steps", "2", "--eval-every", "2",
+                           "--lora-rank", "2"])
+    assert any("eval_loss" in r.getMessage() for r in caplog.records)
+
+    with pytest.raises(SystemExit, match="eval-every"):
+        main(TINY_FLAGS + ["--steps", "1", "--eval-every", "1", "--moe"])
