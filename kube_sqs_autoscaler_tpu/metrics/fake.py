@@ -126,7 +126,11 @@ class FakeMessageQueue:
                 self._receipt_counter += 1
                 handle = f"rh-{self._receipt_counter}"
                 self._inflight[handle] = (deadline, message_id, body)
-                out.append({"ReceiptHandle": handle, "Body": body})
+                out.append({
+                    "MessageId": message_id,
+                    "ReceiptHandle": handle,
+                    "Body": body,
+                })
             return out
 
     def delete_message(self, queue_url: str, receipt_handle: str) -> None:

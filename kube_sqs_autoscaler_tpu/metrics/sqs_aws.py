@@ -262,7 +262,8 @@ class AwsSqsService:
             },
         )
         return [
-            {"ReceiptHandle": m["ReceiptHandle"], "Body": m.get("Body", "")}
+            {"MessageId": m.get("MessageId", ""),
+             "ReceiptHandle": m["ReceiptHandle"], "Body": m.get("Body", "")}
             for m in payload.get("Messages", [])
         ]
 

@@ -108,6 +108,18 @@ def main(argv=None) -> None:
              "single chip)",
     )
     parser.add_argument(
+        "--result-queue-url", default="",
+        help="publish one JSON reply per message to this queue "
+             "(classify: {'next_token': N}; generate: {'tokens': [...]}"
+             " plus decoded 'text' when --tokenizer is set)",
+    )
+    parser.add_argument(
+        "--tokenizer", default="", metavar="DIR",
+        help="text-in/text-out: load a transformers tokenizer and encode "
+             "plain-text or {'text': ...} message bodies (and decode "
+             "generate-mode replies)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -245,7 +257,24 @@ def main(argv=None) -> None:
         queue_url=args.sqs_queue_url, batch_size=args.batch_size,
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        result_queue_url=args.result_queue_url,
     )
+    tokenizer = None
+    if args.tokenizer:
+        try:
+            from transformers import AutoTokenizer
+        except ImportError as err:
+            raise SystemExit(f"--tokenizer needs transformers ({err})")
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+        tok_vocab = len(tokenizer)  # incl. added special tokens
+        if tok_vocab > model_config.vocab_size:
+            # JAX gathers clamp out-of-bounds ids on device, so an
+            # oversized tokenizer would silently serve garbage
+            raise SystemExit(
+                f"tokenizer vocab ({tok_vocab}) exceeds the model's "
+                f"vocab_size ({model_config.vocab_size})"
+            )
+        log.info("Tokenizer: %s (vocab %d)", args.tokenizer, tok_vocab)
 
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
@@ -368,6 +397,9 @@ def main(argv=None) -> None:
         for flag, bad in (("--family llama", family == "llama"),
                           ("--model-parallel", bool(args.model_parallel)),
                           ("--temperature > 0", args.temperature > 0.0),
+                          ("--result-queue-url",
+                           bool(args.result_queue_url)),
+                          ("--tokenizer", bool(args.tokenizer)),
                           ("--generate-tokens >= 1 required",
                            args.generate_tokens < 1)):
             if bad:
@@ -400,7 +432,12 @@ def main(argv=None) -> None:
             if obs is not None:
                 obs.stop()
             return
+        result_queue = None
+        if args.result_queue_url:
+            # demo replies land on a second in-memory queue
+            result_queue = FakeMessageQueue()
         worker = QueueWorker(queue, params, model_config, service_config,
+                             tokenizer=tokenizer, result_queue=result_queue,
                              **worker_kwargs)
         obs = _maybe_serve_metrics(args.metrics_port, worker)
         start = time.perf_counter()
@@ -412,6 +449,12 @@ def main(argv=None) -> None:
             "Processed %d messages in %.2fs (%.1f msg/s)",
             worker.processed, elapsed, worker.processed / elapsed,
         )
+        if result_queue is not None:
+            sample = result_queue.receive_messages(
+                args.result_queue_url, max_messages=2
+            )
+            for message in sample:
+                log.info("Reply: %.120s", message["Body"])
         if obs is not None:
             obs.stop()
         return
@@ -428,8 +471,13 @@ def main(argv=None) -> None:
         log.info("Starting continuous worker on %s", args.sqs_queue_url)
         cworker.run_forever()
         return
-    worker = QueueWorker(queue, params, model_config, service_config,
-                         **worker_kwargs)
+    worker = QueueWorker(
+        queue, params, model_config, service_config, tokenizer=tokenizer,
+        # AWS SQS addresses queues per call by url, so the same client
+        # publishes replies when --result-queue-url is set
+        result_queue=(queue if args.result_queue_url else None),
+        **worker_kwargs,
+    )
     _maybe_serve_metrics(args.metrics_port, worker)
     log.info("Starting worker on %s", args.sqs_queue_url)
     worker.run_forever()

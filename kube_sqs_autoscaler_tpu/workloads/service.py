@@ -99,6 +99,12 @@ class ServiceConfig:
     sample_seed: int = 0
     top_k: int = 0
     top_p: float = 1.0
+    # request/reply: when set, the worker publishes one JSON result per
+    # input message to this queue (after compute, before deleting the
+    # input — at-least-once semantics, so consumers must tolerate
+    # duplicates).  Classify mode sends {"next_token": int}; generate
+    # mode {"tokens": [...]} (+ {"text": ...} when a tokenizer decodes).
+    result_queue_url: str = ""
     # set to a directory to capture a JAX device trace of the first
     # profile_cycles serve cycles (utils/profiling.maybe_trace), flushed
     # as soon as the window closes — never the whole (unbounded) loop.
@@ -119,7 +125,18 @@ class ServiceConfig:
 
 
 class QueueWorker:
-    """One worker: receive → batch → forward → delete, until stopped."""
+    """One worker: receive → batch → forward → (reply) → delete, until
+    stopped.
+
+    ``tokenizer`` (optional, anything with HF-shaped ``encode(text) ->
+    ids`` and ``decode(ids) -> text``) turns the worker into text-in /
+    text-out: message bodies that are not integer-array JSON are treated
+    as text (or ``{"text": ...}`` JSON) and encoded; generate-mode
+    results carry the decoded continuation.  ``result_queue`` (defaults
+    to the input queue object, addressed by
+    ``ServiceConfig.result_queue_url``) receives one JSON reply per
+    message when the url is set.
+    """
 
     def __init__(
         self,
@@ -129,11 +146,25 @@ class QueueWorker:
         service_config: ServiceConfig,
         forward_fn=None,
         generate_fn=None,
+        tokenizer=None,
+        result_queue: MessageQueue | None = None,
     ) -> None:
         self.queue = queue
         self.params = params
         self.model_config = model_config
         self.config = service_config
+        self.tokenizer = tokenizer
+        if service_config.result_queue_url and result_queue is None:
+            # explicit on purpose: in-memory clients (FakeMessageQueue,
+            # the native LocalQueue) ignore queue urls, so silently
+            # defaulting replies onto the input queue object would
+            # self-feed — pass result_queue=queue for url-addressed
+            # clients (AWS SQS), or a second queue object otherwise
+            raise ValueError(
+                "result_queue_url is set but no result_queue client was "
+                "given"
+            )
+        self.result_queue = result_queue
         # default forward picks the attention kernel by the BATCH's bucket
         # length (the Pallas flash kernel when it tiles onto the MXU blocks
         # and is past the measured crossover, dense otherwise) — one
@@ -194,21 +225,60 @@ class QueueWorker:
             bucket *= 2
         return min(bucket, self.config.seq_len)
 
-    def _batch_tokens(self, bodies: list[str]) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """(tokens ``[batch, bucket]``, lengths ``[batch]``) for one batch."""
-        parsed: list[np.ndarray] = []
-        for body in bodies:
-            # the whole decode is guarded: a body that is valid JSON but not
-            # an integer array ('"abc"', '5', nested lists of strings) must
-            # be dropped like non-JSON, not crash the worker — the message
-            # still gets deleted after the batch, so poison messages are
-            # consumed rather than redelivered forever
+    def _parse_body(self, body: str) -> np.ndarray | None:
+        """One body -> int32 ids, or ``None`` for a malformed (dropped)
+        body.  Id-array JSON always works; with a tokenizer, plain text,
+        a JSON string, or ``{"text": ...}`` JSON encodes (the two JSON
+        text forms encode the same characters)."""
+        try:
+            payload = json.loads(body)
+        except Exception:
+            payload = None
+        if payload is not None:
+            if self.tokenizer is not None:
+                text = None
+                if isinstance(payload, dict) and isinstance(
+                        payload.get("text"), str):
+                    text = payload["text"]
+                elif isinstance(payload, str):
+                    text = payload
+                if text is not None:
+                    return np.asarray(
+                        self.tokenizer.encode(text), np.int32
+                    ).reshape(-1)
             try:
-                ids = np.asarray(json.loads(body), np.int32).reshape(-1)
+                return np.asarray(payload, np.int32).reshape(-1)
             except Exception:
-                log.error("Dropping malformed message body: %.64r", body)
-                ids = np.zeros((0,), np.int32)
-            parsed.append(ids[: self.config.seq_len])
+                pass
+        if self.tokenizer is not None:
+            try:
+                return np.asarray(
+                    self.tokenizer.encode(body), np.int32
+                ).reshape(-1)
+            except Exception:
+                pass
+        # a body that is valid JSON but not an integer array ('"abc"'
+        # without a tokenizer, nested lists of strings) is dropped like
+        # non-JSON, not allowed to crash the worker — the message still
+        # gets deleted after the batch, so poison messages are consumed
+        # rather than redelivered forever; its reply (when replies are
+        # on) is an error payload, never a fabricated result
+        log.error("Dropping malformed message body: %.64r", body)
+        return None
+
+    def _batch_tokens(
+        self, bodies: list[str]
+    ) -> tuple[jnp.ndarray, jnp.ndarray, list[bool]]:
+        """(tokens ``[batch, bucket]``, lengths ``[batch]``, per-body
+        validity) for one batch; dropped bodies occupy a one-pad-token
+        row so the batch shape holds, flagged invalid."""
+        raw = [self._parse_body(body) for body in bodies]
+        valid = [ids is not None for ids in raw]
+        parsed: list[np.ndarray] = [
+            (ids if ids is not None else np.zeros((0,), np.int32))
+            [: self.config.seq_len]
+            for ids in raw
+        ]
         bucket = self._bucket_len(max((p.size for p in parsed), default=1))
         rows = np.full(
             (self.config.batch_size, bucket), self.config.pad_token, np.int32
@@ -219,7 +289,7 @@ class QueueWorker:
         for i, ids in enumerate(parsed):
             rows[i, : ids.size] = ids
             lengths[i] = max(1, ids.size)
-        return jnp.asarray(rows), jnp.asarray(lengths)
+        return jnp.asarray(rows), jnp.asarray(lengths), valid
 
     def run_once(self) -> int:
         """One receive/process/delete cycle. Returns messages processed."""
@@ -230,21 +300,57 @@ class QueueWorker:
         )
         if not messages:
             return 0
-        tokens, lengths = self._batch_tokens([m["Body"] for m in messages])
+        tokens, lengths, valid = self._batch_tokens(
+            [m["Body"] for m in messages]
+        )
         # block so deletion happens strictly after compute succeeds
         # (at-least-once processing: a crash here leaves messages in-flight
         # to reappear after the visibility timeout)
         if self.config.generate_tokens > 0:
-            self._generate(
+            produced = self._generate(
                 self.params, tokens, self.config.generate_tokens, lengths
-            ).block_until_ready()
+            )
+            produced.block_until_ready()
+            results = None
+            if self.config.result_queue_url:
+                rows = np.asarray(produced)[: len(messages)]
+                results = []
+                for row in rows:
+                    payload = {"tokens": row.tolist()}
+                    if self.tokenizer is not None:
+                        payload["text"] = self.tokenizer.decode(row.tolist())
+                    results.append(payload)
         else:
             # greedy next token per sequence, read at each row's last
             # VALID position — never the pad slot at -1
             logits = self._forward(self.params, tokens)
-            jnp.argmax(
+            picks = jnp.argmax(
                 logits[jnp.arange(logits.shape[0]), lengths - 1], axis=-1
-            ).block_until_ready()
+            )
+            picks.block_until_ready()
+            results = None
+            if self.config.result_queue_url:
+                results = [
+                    {"next_token": int(t)}
+                    for t in np.asarray(picks)[: len(messages)]
+                ]
+        if results is not None:
+            # reply BEFORE deleting the input: a crash between the two
+            # redelivers the input, so consumers may see duplicate
+            # results (at-least-once) but never lose one.  Each reply
+            # carries its request's MessageId so consumers sharing the
+            # result queue can correlate (and dedup redeliveries);
+            # dropped bodies get an error payload, never a fabricated
+            # result computed from their pad-token placeholder row.
+            for i, (message, payload) in enumerate(zip(messages, results)):
+                if not valid[i]:
+                    payload = {"error": "malformed body"}
+                payload["request_id"] = message.get(
+                    "MessageId", message["ReceiptHandle"]
+                )
+                self.result_queue.send_message(
+                    self.config.result_queue_url, json.dumps(payload)
+                )
         for message in messages:
             self.queue.delete_message(
                 self.config.queue_url, message["ReceiptHandle"]

@@ -433,3 +433,109 @@ def test_worker_generate_temperature_sampling():
     want = generate_jit(params, tokens, 4, TINY, temperature=0.8,
                         rng=jax.random.key(7), lengths=lengths)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class StubTokenizer:
+    """HF-shaped encode/decode over a byte vocabulary (ids = bytes)."""
+
+    vocab_size = 256
+
+    def encode(self, text):
+        return list(text.encode())[:64]
+
+    def decode(self, ids):
+        return bytes(int(i) % 256 for i in ids).decode(errors="replace")
+
+
+def test_result_queue_replies_classify_and_generate():
+    """The request/reply loop: one JSON reply per input message, on a
+    separate result queue, for both compute modes."""
+    params = init_params(jax.random.key(0), TINY)
+    for generate_tokens in (0, 4):
+        queue, replies = FakeMessageQueue(), FakeMessageQueue()
+        send_token_messages(queue, 3)
+        config = ServiceConfig(
+            queue_url=URL, batch_size=4, seq_len=16,
+            generate_tokens=generate_tokens,
+            result_queue_url="fake://results",
+        )
+        worker = QueueWorker(queue, params, TINY, config,
+                             result_queue=replies)
+        assert worker.run_once() == 3
+        out = replies.receive_messages("fake://results", max_messages=10)
+        assert len(out) == 3
+        for message in out:
+            payload = json.loads(message["Body"])
+            if generate_tokens:
+                assert len(payload["tokens"]) == 4
+                assert all(0 <= t < TINY.vocab_size
+                           for t in payload["tokens"])
+            else:
+                assert 0 <= payload["next_token"] < TINY.vocab_size
+
+
+def test_tokenizer_text_in_text_out():
+    """Plain-text and {'text': ...} bodies encode through the tokenizer;
+    generate replies carry the decoded continuation."""
+    config_model = ModelConfig(vocab_size=256, d_model=64, n_heads=4,
+                               n_layers=2, d_ff=128, max_seq_len=64)
+    params = init_params(jax.random.key(1), config_model)
+    queue, replies = FakeMessageQueue(), FakeMessageQueue()
+    queue.send_message(URL, json.dumps({"text": "hello tpu"}))
+    queue.send_message(URL, "plain text body")
+    queue.send_message(URL, json.dumps([1, 2, 3]))  # ids still work
+    config = ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                           generate_tokens=3,
+                           result_queue_url="fake://results")
+    worker = QueueWorker(queue, params, config_model, config,
+                         tokenizer=StubTokenizer(), result_queue=replies)
+    assert worker.run_once() == 3
+    out = replies.receive_messages("fake://results", max_messages=10)
+    assert len(out) == 3
+    for message in out:
+        payload = json.loads(message["Body"])
+        assert len(payload["tokens"]) == 3
+        assert isinstance(payload["text"], str)
+
+
+def test_no_result_queue_url_sends_nothing():
+    params = init_params(jax.random.key(0), TINY)
+    queue = FakeMessageQueue()
+    send_token_messages(queue, 2)
+    config = ServiceConfig(queue_url=URL, batch_size=4, seq_len=16)
+    worker = QueueWorker(queue, params, TINY, config)
+    assert worker.run_once() == 2
+    assert queue.receive_messages(URL, max_messages=10) == []
+
+
+def test_result_queue_url_requires_explicit_client():
+    # in-memory clients ignore urls, so a silent same-queue default
+    # would self-feed replies back as inputs — construction rejects it
+    import pytest
+
+    params = init_params(jax.random.key(0), TINY)
+    config = ServiceConfig(queue_url=URL, batch_size=2, seq_len=16,
+                           result_queue_url="fake://results")
+    with pytest.raises(ValueError, match="result_queue"):
+        QueueWorker(FakeMessageQueue(), params, TINY, config)
+
+
+def test_replies_carry_request_ids_and_error_payloads():
+    """Replies correlate to inputs by MessageId; malformed bodies get an
+    error payload, never a fabricated result."""
+    params = init_params(jax.random.key(0), TINY)
+    queue, replies = FakeMessageQueue(), FakeMessageQueue()
+    good_id = queue.send_message(URL, json.dumps([1, 2, 3]))
+    bad_id = queue.send_message(URL, json.dumps("not ids"))
+    config = ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                           result_queue_url="fake://results")
+    worker = QueueWorker(queue, params, TINY, config, result_queue=replies)
+    assert worker.run_once() == 2
+    out = {
+        json.loads(m["Body"])["request_id"]: json.loads(m["Body"])
+        for m in replies.receive_messages("fake://results", max_messages=10)
+    }
+    assert set(out) == {good_id, bad_id}
+    assert "next_token" in out[good_id]
+    assert out[bad_id] == {"error": "malformed body",
+                           "request_id": bad_id}

@@ -116,7 +116,8 @@ def test_message_operations_roundtrip():
         url = f"{server.url}/123/q"
         assert service.send_message(url, "[1, 2, 3]") == "m-1"
         messages = service.receive_messages(url, max_messages=16)  # clamped
-        assert messages == [{"ReceiptHandle": "rh-1", "Body": "[1, 2, 3]"}]
+        assert messages == [{"MessageId": "", "ReceiptHandle": "rh-1",
+                                 "Body": "[1, 2, 3]"}]
         service.delete_message(url, "rh-1")
     assert state["deleted"] == ["rh-1"]
     for exchange in server.exchanges:
