@@ -436,6 +436,159 @@ def init_llama_cache(config: LlamaConfig, batch: int) -> dict:
     }
 
 
+def init_llama_rolling_cache(config: LlamaConfig, batch: int) -> dict:
+    """Rolling-buffer KV cache for sliding-window models: only
+    ``sliding_window`` positions per layer — O(window) HBM instead of
+    O(max_seq_len) — with position ``p`` living in slot ``p % window``.
+
+    The windowed attention mask makes this exact, not approximate: a
+    query at position ``p`` may only attend ``p - window + 1 .. p``, and
+    those are precisely the positions the ring of slots retains (older
+    entries are the ones overwritten).  Slot ``s``'s occupant is
+    recoverable from arithmetic alone — the largest ``c <= p`` with
+    ``c ≡ s (mod window)`` — so validity needs no bookkeeping beyond the
+    per-row ``length`` the full cache already carries.
+    """
+    if config.sliding_window is None:
+        raise ValueError(
+            "rolling cache requires a sliding_window config (a full-"
+            "attention model needs every past position — use "
+            "init_llama_cache)"
+        )
+    shape = (batch, config.n_kv_heads, config.sliding_window,
+             config.head_dim)
+    return {
+        "layers": [
+            {"k": jnp.zeros(shape, config.dtype),
+             "v": jnp.zeros(shape, config.dtype)}
+            for _ in range(config.n_layers)
+        ],
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _rolling_cached_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    window: int,
+) -> jax.Array:
+    """One query per row against the ring of ``window`` slots.
+
+    ``q``: ``[B, H, 1, D]`` at global position ``pos[b]``; slot ``s``
+    holds position ``c_s = pos - ((pos - s) mod window)``; slots with
+    ``c_s < 0`` (warm-up) are masked.  fp32 scores/softmax, identical
+    numerics to the masked full-cache path — order of keys is
+    irrelevant to attention, and RoPE was applied at each key's absolute
+    position before it was stored.
+    """
+    head_dim = q.shape[-1]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / (head_dim**0.5)
+    slots = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    p = pos[:, None, None, None]
+    occupant = p - jnp.remainder(p - slots, window)
+    scores = jnp.where(occupant >= 0, scores, jnp.float32(-jnp.inf))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
+
+
+def llama_rolling_prefill(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    prompt_attention=None,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """Prompt pass for the rolling cache: the full windowed forward runs
+    as usual, then each layer's LAST ``min(window, length)`` keys/values
+    are gathered into their slots (earlier positions would have been
+    overwritten anyway).  Same readout contract as :func:`llama_prefill`.
+    """
+    window = config.sliding_window
+    batch, prompt_len = tokens.shape
+    if window is None:
+        raise ValueError("rolling prefill requires a sliding_window config")
+    if prompt_len > config.max_seq_len:
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len="
+            f"{config.max_seq_len}"
+        )
+    inner = (
+        _gqa_wrap(config, prompt_attention)
+        if prompt_attention is not None
+        else _gqa_dense_attention(config)
+    )
+    captured: list[dict] = []
+
+    def attend(q, k, v):
+        captured.append({"k": k, "v": v})
+        return inner(q, k, v)
+
+    logits = llama_forward(params, tokens, config, attention_fn=attend)
+    if lengths is None:
+        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        readout = logits[:, -1]
+    else:
+        row_lengths = lengths.astype(jnp.int32)
+        readout = logits[jnp.arange(batch), row_lengths - 1]
+
+    # slot s <- position c_s = (len-1) - ((len-1 - s) mod window): the
+    # newest prompt position congruent to s; warm-up slots (c_s < 0)
+    # hold zeros and stay masked by the attention arithmetic
+    slots = jnp.arange(window)[None, :]  # [1, W]
+    last = (row_lengths - 1)[:, None]  # [B, 1]
+    source = last - jnp.remainder(last - slots, window)  # [B, W]
+    gather_idx = jnp.clip(source, 0)[:, None, :, None]  # [B, 1, W, 1]
+    new_layers = []
+    for layer_kv in captured:
+        k = jnp.take_along_axis(
+            layer_kv["k"].astype(config.dtype), gather_idx, axis=2
+        )
+        v = jnp.take_along_axis(
+            layer_kv["v"].astype(config.dtype), gather_idx, axis=2
+        )
+        keep = (source >= 0)[:, None, :, None]
+        new_layers.append({
+            "k": jnp.where(keep, k, 0).astype(config.dtype),
+            "v": jnp.where(keep, v, 0).astype(config.dtype),
+        })
+    return readout, {"layers": new_layers, "length": row_lengths}
+
+
+def llama_rolling_decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """One token per row against the rolling cache: write at
+    ``pos % window``, attend the ring (same contract as
+    :func:`llama_decode_step`)."""
+    window = config.sliding_window
+    if window is None:
+        raise ValueError(
+            "rolling decode requires a sliding_window config"
+        )
+    slot_axis = cache["layers"][0]["k"].shape[2]
+    if slot_axis != window:
+        # a full-size cache here would write at pos % window inside a
+        # max_seq_len buffer and score mostly-zero slots — wrong logits
+        # with no error; refuse the mismatched layout instead
+        raise ValueError(
+            f"rolling decode needs a window-sized cache ({window} slots), "
+            f"got {slot_axis} — build it with init_llama_rolling_cache/"
+            "llama_rolling_prefill"
+        )
+
+    def attend_cache(q, k_cache, v_cache, pos):
+        return _rolling_cached_attention(q, k_cache, v_cache, pos, window)
+
+    return _decode_step_impl(
+        params, cache, tokens, config,
+        jnp.remainder(cache["length"], window), attend_cache,
+    )
+
+
 def _final_logits(
     params: dict,
     x: jax.Array,
@@ -505,15 +658,20 @@ def llama_prefill(
     return readout, {"layers": new_layers, "length": row_lengths}
 
 
-def llama_decode_step(
-    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+def _decode_step_impl(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    write_slot: jax.Array,
+    cached_attention,
 ) -> tuple[jax.Array, dict]:
-    """One token per row (int32 ``[batch]``) against the GQA cache; same
-    contract as :func:`.decode.decode_step` (reuses its masked
-    cached-attention math via :func:`.decode._cached_attention`), with
-    per-row positions."""
-    from .decode import _cached_attention
-
+    """The one decode-step skeleton both cache layouts share: embed at
+    the absolute position, write each layer's k/v at ``write_slot``,
+    attend via ``cached_attention(q, k_cache, v_cache, pos)``
+    (full-head inputs), final logits.  Layout-specific pieces — the
+    slot arithmetic and the masked-attention math — are the
+    parameters."""
     pos = cache["length"]  # [B]
     batch = tokens.shape[0]
     rows = jnp.arange(batch)
@@ -526,22 +684,40 @@ def llama_decode_step(
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            k_cache = _lc["k"].at[rows, :, pos].set(
+            k_cache = _lc["k"].at[rows, :, write_slot].set(
                 k[:, :, 0].astype(config.dtype)
             )
-            v_cache = _lc["v"].at[rows, :, pos].set(
+            v_cache = _lc["v"].at[rows, :, write_slot].set(
                 v[:, :, 0].astype(config.dtype)
             )
             new_layers.append({"k": k_cache, "v": v_cache})
-            return _cached_attention(
+            return cached_attention(
                 q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
-                pos, window=config.sliding_window,
+                pos,
             )
 
         x = _llama_block(x, layer, config, positions, attend)
     return (
         _final_logits(params, x, config.rms_eps),
         {"layers": new_layers, "length": pos + 1},
+    )
+
+
+def llama_decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """One token per row (int32 ``[batch]``) against the GQA cache; same
+    contract as :func:`.decode.decode_step` (reuses its masked
+    cached-attention math via :func:`.decode._cached_attention`), with
+    per-row positions."""
+    from .decode import _cached_attention
+
+    def attend_cache(q, k_cache, v_cache, pos):
+        return _cached_attention(q, k_cache, v_cache, pos,
+                                 window=config.sliding_window)
+
+    return _decode_step_impl(
+        params, cache, tokens, config, cache["length"], attend_cache
     )
 
 
@@ -600,12 +776,16 @@ def llama_generate(
     lengths: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    rolling: bool = False,
 ) -> jax.Array:
     """Greedy/temperature/top-k/top-p generation, one compiled program
     (same contract and scan structure as :func:`.decode.generate`,
     including ragged prompts via ``lengths``; sampling policy is
     ``decode._pick``).  ``prompt_attention`` selects the prefill
-    kernel (see :func:`llama_prefill`)."""
+    kernel (see :func:`llama_prefill`).  ``rolling=True`` decodes
+    through the O(window) rolling-buffer cache (sliding-window configs
+    only; identical outputs — the window mask already hides everything
+    the ring evicts)."""
     from .decode import _pick
 
     batch, prompt_len = prompt.shape
@@ -623,13 +803,15 @@ def llama_generate(
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    logits, cache = llama_prefill(params, prompt, config, prompt_attention,
-                                  lengths=lengths)
+    prefill_fn = llama_rolling_prefill if rolling else llama_prefill
+    step_fn = llama_rolling_decode_step if rolling else llama_decode_step
+    logits, cache = prefill_fn(params, prompt, config, prompt_attention,
+                               lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
 
     def body(carry, key):
         cache, token = carry
-        logits, cache = llama_decode_step(params, cache, token, config)
+        logits, cache = step_fn(params, cache, token, config)
         nxt = _pick(logits, key, temperature, top_k, top_p)
         return (cache, nxt), token
 

@@ -193,6 +193,60 @@ def test_mesh_forward_step_carries_the_window():
     )
 
 
+def test_rolling_cache_equals_full_cache_decode():
+    """The O(window) ring cache decodes the exact sequence the full
+    O(max_seq_len) cache does — long prompts, multiple ring wraps,
+    ragged warm-up rows — and is window-sized in memory."""
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        init_llama_rolling_cache,
+        llama_generate,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=96, max_seq_len=96,
+                      sliding_window=6, dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (3, 12), 0, 128,
+                                jnp.int32)
+
+    full = np.asarray(llama_generate(params, prompt, 20, cfg))
+    roll = np.asarray(llama_generate(params, prompt, 20, cfg,
+                                     rolling=True))
+    np.testing.assert_array_equal(full, roll)
+
+    lengths = jnp.asarray([3, 12, 7], jnp.int32)  # warm-up + wrapped rows
+    full = np.asarray(llama_generate(params, prompt, 15, cfg,
+                                     lengths=lengths))
+    roll = np.asarray(llama_generate(params, prompt, 15, cfg,
+                                     lengths=lengths, rolling=True))
+    np.testing.assert_array_equal(full, roll)
+
+    cache = init_llama_rolling_cache(cfg, batch=3)
+    assert cache["layers"][0]["k"].shape == (3, 2, 6, 16)  # W, not S_max
+
+    with pytest.raises(ValueError, match="sliding_window"):
+        init_llama_rolling_cache(
+            LlamaConfig(vocab_size=128, d_model=64, n_heads=4,
+                        n_kv_heads=2, n_layers=2, d_ff=96, max_seq_len=96),
+            batch=1,
+        )
+
+    # a full-size cache handed to the rolling step fails loudly instead
+    # of silently scoring mostly-zero slots
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        init_llama_cache,
+        llama_rolling_decode_step,
+    )
+
+    full_cache = init_llama_cache(cfg, batch=1)
+    with pytest.raises(ValueError, match="window-sized"):
+        llama_rolling_decode_step(
+            params, full_cache, jnp.zeros((1,), jnp.int32), cfg
+        )
+
+
 def test_mistral_export_round_trip(tmp_path):
     """save_hf_llama's Mistral branch: a windowed config exports as a
     transformers Mistral checkpoint whose from_pretrained logits match
