@@ -337,12 +337,11 @@ def main(argv=None) -> None:
             ),
         }
     if args.speculative_draft_layers:
-        # early-exit self-draft: the same weights, truncated depth — the
-        # verify chunk keeps the output exactly the greedy sequence, so
-        # sampling/temperature and the parallel serving paths don't apply
+        # early-exit self-draft: the same weights, truncated depth.
+        # Greedy runs are token-identical to plain greedy decode;
+        # temperature > 0 runs full speculative sampling (the rejection
+        # rule keeps every emitted token an exact warped-target sample).
         for flag, bad in (
-            ("--temperature > 0 (speculative is greedy-exact)",
-             args.temperature > 0.0),
             ("--model-parallel", bool(args.model_parallel)),
             ("--continuous", args.continuous),
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
@@ -377,12 +376,18 @@ def main(argv=None) -> None:
 
         from .speculative import speculative_generate_jit
 
+        from .service import sampling_keys
+
         draft_config = replace(model_config, n_layers=n_draft)
+        spec_keys = sampling_keys(service_config.sample_seed)
         worker_kwargs["generate_fn"] = (
             lambda p, t, n, lengths: speculative_generate_jit(
                 p, model_config,
                 dict(p, layers=p["layers"][:n_draft]), draft_config,
                 t, n, k, lengths=lengths,
+                temperature=args.temperature,
+                rng=(next(spec_keys) if args.temperature > 0.0 else None),
+                top_k=args.top_k, top_p=args.top_p,
             )
         )
         log.info(

@@ -315,12 +315,25 @@ def _pick(
         raise ValueError(f"top_p={top_p} must be in (0, 1] (1.0 = off)")
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, warp_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
+
+
+def warp_logits(
+    logits: jax.Array, temperature: float, top_k: int, top_p: float
+) -> jax.Array:
+    """The one definition of the warped sampling distribution —
+    temperature scale, then top-k, then nucleus truncation.  Shared by
+    :func:`_pick` (categorical over the result) and the speculative
+    sampler (whose acceptance-rule exactness depends on warping the
+    draft and target identically to this policy)."""
     logits = logits / temperature
     if top_k > 0:
         logits = _mask_top_k(logits, min(top_k, logits.shape[-1]))
     if top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return logits
 
 
 def generate(

@@ -508,32 +508,11 @@ def llama_rolling_prefill(
     overwritten anyway).  Same readout contract as :func:`llama_prefill`.
     """
     window = config.sliding_window
-    batch, prompt_len = tokens.shape
     if window is None:
         raise ValueError("rolling prefill requires a sliding_window config")
-    if prompt_len > config.max_seq_len:
-        raise ValueError(
-            f"prompt length {prompt_len} exceeds max_seq_len="
-            f"{config.max_seq_len}"
-        )
-    inner = (
-        _gqa_wrap(config, prompt_attention)
-        if prompt_attention is not None
-        else _gqa_dense_attention(config)
+    readout, row_lengths, captured = _prefill_forward(
+        params, tokens, config, prompt_attention, lengths
     )
-    captured: list[dict] = []
-
-    def attend(q, k, v):
-        captured.append({"k": k, "v": v})
-        return inner(q, k, v)
-
-    logits = llama_forward(params, tokens, config, attention_fn=attend)
-    if lengths is None:
-        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
-        readout = logits[:, -1]
-    else:
-        row_lengths = lengths.astype(jnp.int32)
-        readout = logits[jnp.arange(batch), row_lengths - 1]
 
     # slot s <- position c_s = (len-1) - ((len-1 - s) mod window): the
     # newest prompt position congruent to s; warm-up slots (c_s < 0)
@@ -607,6 +586,46 @@ def _final_logits(
     return logits[jnp.arange(logits.shape[0]), last_pos]
 
 
+def _prefill_forward(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    prompt_attention,
+    lengths: jax.Array | None,
+):
+    """The shared prompt pass of both cache layouts: validation, the
+    window-aware kernel selection, the forward with per-layer GQA k/v
+    capture, and the ragged readout.  Returns ``(readout, row_lengths,
+    captured)`` — cache population (full-slice write vs ring gather) is
+    the caller's job."""
+    batch, prompt_len = tokens.shape
+    if prompt_len > config.max_seq_len:
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len="
+            f"{config.max_seq_len}"
+        )
+    inner = (
+        _gqa_wrap(config, prompt_attention)
+        if prompt_attention is not None
+        else _gqa_dense_attention(config)  # window-aware default
+    )
+    captured: list[dict] = []
+
+    def attend(q, k, v):
+        # k/v arrive GQA-shaped [B, H_kv, S, D]
+        captured.append({"k": k, "v": v})
+        return inner(q, k, v)
+
+    logits = llama_forward(params, tokens, config, attention_fn=attend)
+    if lengths is None:
+        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        readout = logits[:, -1]
+    else:
+        row_lengths = lengths.astype(jnp.int32)
+        readout = logits[jnp.arange(batch), row_lengths - 1]
+    return readout, row_lengths, captured
+
+
 def llama_prefill(
     params: dict,
     tokens: jax.Array,
@@ -623,38 +642,21 @@ def llama_prefill(
     full-causal).  Default: window-aware dense.
     """
     batch, prompt_len = tokens.shape
-    if prompt_len > config.max_seq_len:
-        raise ValueError(
-            f"prompt length {prompt_len} exceeds max_seq_len={config.max_seq_len}"
-        )
-    cache = init_llama_cache(config, batch)
-    inner = (
-        _gqa_wrap(config, prompt_attention)
-        if prompt_attention is not None
-        else _gqa_dense_attention(config)  # window-aware default
+    readout, row_lengths, captured = _prefill_forward(
+        params, tokens, config, prompt_attention, lengths
     )
-    new_layers = []
-
-    def attend(q, k, v):
-        # k/v arrive GQA-shaped [B, H_kv, S, D]: capture into the cache,
-        # then run the (broadcast-wrapped) causal prompt kernel
-        new_layers.append(
-            {
-                "k": cache["layers"][len(new_layers)]["k"]
-                .at[:, :, :prompt_len].set(k.astype(config.dtype)),
-                "v": cache["layers"][len(new_layers)]["v"]
-                .at[:, :, :prompt_len].set(v.astype(config.dtype)),
-            }
-        )
-        return inner(q, k, v)
-
-    logits = llama_forward(params, tokens, config, attention_fn=attend)
-    if lengths is None:
-        row_lengths = jnp.full((batch,), prompt_len, jnp.int32)
-        readout = logits[:, -1] if logits.ndim == 3 else logits
-    else:
-        row_lengths = lengths.astype(jnp.int32)
-        readout = logits[jnp.arange(batch), row_lengths - 1]
+    cache = init_llama_cache(config, batch)
+    new_layers = [
+        {
+            "k": layer["k"].at[:, :, :prompt_len].set(
+                kv["k"].astype(config.dtype)
+            ),
+            "v": layer["v"].at[:, :, :prompt_len].set(
+                kv["v"].astype(config.dtype)
+            ),
+        }
+        for layer, kv in zip(cache["layers"], captured)
+    ]
     return readout, {"layers": new_layers, "length": row_lengths}
 
 

@@ -210,6 +210,58 @@ def test_speculative_tight_budget_with_uneven_acceptance():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_rejection_rule_marginal_is_the_warped_target_distribution():
+    """The speculative-sampling acceptance rule: over 10^5 i.i.d. rows,
+    the emitted position's empirical distribution equals the warped
+    target softmax — min(p,q) + (1-Σmin)·(q-p)+/Z == q, measured."""
+    from kube_sqs_autoscaler_tpu.workloads.speculative import (
+        _accept_and_fixup,
+        _warp,
+    )
+
+    B, k, V = 100_000, 1, 5
+    draft_logits = jnp.asarray([0.1, 1.0, -0.4, 0.7, 0.2], jnp.float32)
+    target_logits = jnp.asarray([0.9, -0.2, 0.5, 0.0, -1.0], jnp.float32)
+    draft_w = jnp.broadcast_to(_warp(draft_logits, 0.8, 0, 1.0), (B, k, V))
+    target_w = jnp.broadcast_to(
+        _warp(target_logits, 0.8, 0, 1.0), (B, k + 1, V)
+    )
+    kd, ka = jax.random.split(jax.random.key(0))
+    drafts = jax.random.categorical(
+        kd, jnp.broadcast_to(draft_w[:, 0], (B, V))
+    )[:, None]
+    n, fixup = _accept_and_fixup(ka, drafts, draft_w, target_w)
+    emitted = np.where(
+        np.asarray(n) >= 1, np.asarray(drafts[:, 0]), np.asarray(fixup)
+    )
+    empirical = np.bincount(emitted, minlength=V) / B
+    expected = np.asarray(jax.nn.softmax(_warp(target_logits, 0.8, 0, 1.0)))
+    np.testing.assert_allclose(empirical, expected, atol=0.012)
+
+
+def test_speculative_sampling_end_to_end(models):
+    """Sampled speculative decoding: deterministic per key, key-sensitive,
+    in-vocab, and the greedy path is untouched by the new arguments."""
+    params_t, params_d = models
+    prompt = prompt_tokens()
+    a = speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 12,
+                             draft_tokens=3, temperature=0.9,
+                             rng=jax.random.key(7), top_k=8)
+    b = speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 12,
+                             draft_tokens=3, temperature=0.9,
+                             rng=jax.random.key(7), top_k=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (3, 12)
+    assert 0 <= int(a.min()) and int(a.max()) < TARGET.vocab_size
+    c = speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 12,
+                             draft_tokens=3, temperature=0.9,
+                             rng=jax.random.key(8), top_k=8)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(params_t, TARGET, params_d, DRAFT, prompt, 4,
+                             temperature=0.5)
+
+
 def test_serve_binary_speculative_flag():
     """--speculative-draft-layers end to end for both families, plus the
     fail-fast guards (sampling, layer bound)."""
@@ -220,9 +272,10 @@ def test_serve_binary_speculative_flag():
     main(["--family", "llama", "--demo", "2", "--batch-size", "1",
           "--seq-len", "8", "--generate-tokens", "4",
           "--speculative-draft-layers", "1"])
-    with pytest.raises(SystemExit, match="greedy-exact"):
-        main(["--demo", "1", "--generate-tokens", "4", "--temperature",
-              "0.5", "--speculative-draft-layers", "1"])
+    # temperature > 0 runs speculative SAMPLING through the same flag
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--speculative-draft-layers", "2",
+          "--temperature", "0.8", "--top-k", "8"])
     with pytest.raises(SystemExit, match="n_layers"):
         main(["--demo", "1", "--generate-tokens", "4",
               "--speculative-draft-layers", "99"])
