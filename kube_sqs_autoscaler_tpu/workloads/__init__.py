@@ -22,6 +22,27 @@ benchmark against:
   ``ppermute``.
 - :mod:`.worker` — a queue-fed batch-inference worker: the process that a
   Deployment replica runs, draining the very queue the controller watches.
+- :mod:`.llama` — the second model family (RoPE, GQA, RMSNorm, SwiGLU,
+  optional Mistral-style sliding window) sharing every seam above, with
+  GQA KV-cache decode and an O(window) rolling-buffer cache.
+- :mod:`.flash` — the Pallas flash-attention kernels (forward and
+  backward, windowed, GQA-native, ``(out, lse)`` partials) plus the
+  measured-crossover dispatcher and the sharded ``shard_map`` wrapper.
+- :mod:`.zigzag` — balanced zig-zag sequence parallelism for the causal
+  triangle; :mod:`.pipeline` adds the 1F1B schedule and pp x tp.
+- :mod:`.decode`/:mod:`.service`/:mod:`.continuous` — KV-cache serving:
+  ragged right-padded batches, length bucketing, sampling
+  (temperature/top-k/nucleus), continuous batching, request/reply over
+  queues with optional tokenizers; :mod:`.speculative` adds greedy-exact
+  and distribution-exact (rejection-sampled) draft-and-verify decoding;
+  :mod:`.quantize` int8 post-training weight quantization.
+- :mod:`.hf_convert` — Hugging Face Llama/Mistral checkpoints in and out,
+  proven logit-exact against ``transformers``; :mod:`.lora` adapter-only
+  fine-tuning on a frozen base.
+- :mod:`.trainer` — the training binary (remat, grad accum/clip, LR
+  schedules, eval loop, orbax checkpoint/resume, /metrics gauges, corpus
+  data via the native reader); :mod:`.checkpoint`, :mod:`.data`,
+  :mod:`.distributed`, :mod:`.perf` support it.
 
 The controller itself (core/metrics/scale/cli) imports none of this; the
 dependency edge goes one way, mirroring the reference where the autoscaler
