@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test clean compile build push bench workbench dryrun native
+.PHONY: test clean compile build push bench workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.1.0
@@ -40,3 +40,11 @@ native:
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# One-command showcase: queue-fed generate-mode workers with sampled
+# decoding and request/reply over an in-memory queue (CPU; drop
+# JAX_PLATFORMS to run the same thing on TPU)
+demo:
+	JAX_PLATFORMS=cpu python -m kube_sqs_autoscaler_tpu.workloads \
+		--demo 6 --batch-size 2 --seq-len 16 --generate-tokens 8 \
+		--temperature 0.8 --top-p 0.9 --result-queue-url demo://results
