@@ -102,6 +102,11 @@ def main(argv=None) -> None:
              "tokens for one full-model pass)",
     )
     parser.add_argument(
+        "--beams", type=int, default=1, metavar="W",
+        help="beam-search generation with W beams (deterministic — does "
+             "not combine with --temperature; 1 = greedy/sampled decode)",
+    )
+    parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
         help="int8: post-training per-channel weight quantization of the "
              "served matmul weights (half the HBM bytes per decode step; "
@@ -129,6 +134,22 @@ def main(argv=None) -> None:
         raise SystemExit(
             "--quantize int8 is single-chip serving; drop --model-parallel"
         )
+    if args.beams < 1:
+        raise SystemExit(f"--beams {args.beams} must be >= 1")
+    if args.beams > 1:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --quantize check above)
+        for flag, bad in (
+            ("--temperature > 0 (beam search is deterministic)",
+             args.temperature > 0.0),
+            ("--speculative-draft-layers",
+             bool(args.speculative_draft_layers)),
+            ("--model-parallel", bool(args.model_parallel)),
+            ("--continuous", args.continuous),
+            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
+        ):
+            if bad:
+                raise SystemExit(f"--beams does not support {flag}")
     if args.top_k < 0:
         raise SystemExit(f"--top-k {args.top_k} must be >= 0 (0 = off)")
     if not 0.0 < args.top_p <= 1.0:
@@ -336,6 +357,30 @@ def main(argv=None) -> None:
                 top_p=service_config.top_p,
             ),
         }
+    if args.beams > 1:
+        from .beam import beam_search_jit
+
+        if family == "llama":
+            from .llama import llama_attention_fn_for as _prefill_pick
+
+            def _beam_prefill_attention(bucket_len):
+                return _prefill_pick(model_config, bucket_len)
+        else:
+            from .flash import attention_fn_for as _prefill_pick
+
+            _beam_prefill_attention = _prefill_pick
+
+        worker_kwargs["generate_fn"] = (
+            # prefill picks the bucket-length flash/dense kernel like the
+            # plain generate paths (memoized factories, jit-static safe)
+            lambda p, t, n, lengths: beam_search_jit(
+                p, model_config, t, n, args.beams,
+                attention_fn=_beam_prefill_attention(t.shape[1]),
+                lengths=lengths,
+            )
+        )
+        log.info("Beam search: %d beams", args.beams)
+
     if args.speculative_draft_layers:
         # early-exit self-draft: the same weights, truncated depth.
         # Greedy runs are token-identical to plain greedy decode;

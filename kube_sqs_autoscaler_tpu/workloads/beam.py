@@ -1,0 +1,172 @@
+"""Beam search over the KV caches (both model families).
+
+Greedy decoding commits to the argmax at every step; beam search keeps
+the ``W`` highest joint-log-probability prefixes alive and returns the
+best full sequence — the standard quality knob for deterministic
+generation (no reference counterpart: the reference has no model code,
+SURVEY.md §2).
+
+TPU shape: the batch axis carries the beams.  The prompt prefills once
+per row, the cache is row-repeated to ``B*W``, and each step is one
+``decode_step`` over all beams at once — the same compiled kernel the
+plain decoder uses, at ``W``-times the batch.  Beam reordering after
+each expansion is a *row gather* of the cache (``cache[flat_parent]``),
+which XLA lowers to a dynamic-gather over the batch axis — no
+re-prefill, no host round-trips; the whole search is one ``lax.scan``.
+
+Scoring is joint log-probability with optional GNMT-style length
+normalization (``score / ((5 + len) / 6) ** length_penalty``); with a
+fixed generation length the penalty only matters when ``eos_id`` is
+set, which freezes finished beams (their score stops accumulating and
+they emit ``eos_id`` forever).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .model import ModelConfig
+from .speculative import _family_ops
+
+
+def beam_search(
+    params: dict,
+    config: ModelConfig,
+    prompt: jax.Array,
+    num_tokens: int,
+    *,
+    beams: int = 4,
+    length_penalty: float = 0.0,
+    eos_id: int | None = None,
+    attention_fn=None,
+    lengths: jax.Array | None = None,
+    return_all: bool = False,
+) -> jax.Array:
+    """The best continuation of each prompt under beam search.
+
+    Returns int32 ``[batch, num_tokens]`` (the highest-scoring beam), or
+    with ``return_all=True`` a ``(sequences [B, W, T], scores [B, W])``
+    pair sorted best-first.  ``beams=1`` reduces exactly to greedy
+    decoding.  ``eos_id`` (optional) ends a beam when it emits that id:
+    the beam's score freezes and it pads with ``eos_id``; scores are
+    length-normalized by each beam's finished length when
+    ``length_penalty > 0``.
+    """
+    batch, prompt_len = prompt.shape
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    if beams < 1:
+        raise ValueError(f"beams must be >= 1, got {beams}")
+    if prompt_len + num_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
+            f"max_seq_len={config.max_seq_len}"
+        )
+    prefill_fn, step_fn, _ = _family_ops(config)
+    width = beams
+    rows = jnp.arange(batch)
+
+    logits, cache = prefill_fn(params, prompt, config, attention_fn,
+                               lengths=lengths)
+    logp = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
+    vocab = logp.shape[-1]
+    # first expansion: the top-W first tokens seed the beams
+    first_scores, first_tokens = jax.lax.top_k(logp, width)  # [B, W]
+    # repeat each row's cache W times -> beams ride the batch axis
+    cache = jax.tree.map(
+        lambda a: jnp.repeat(a, width, axis=0), cache
+    )
+
+    out = jnp.full((batch, width, num_tokens),
+                   eos_id if eos_id is not None else 0, jnp.int32)
+    out = out.at[:, :, 0].set(first_tokens)
+    alive = (
+        first_tokens != eos_id if eos_id is not None
+        else jnp.ones((batch, width), bool)
+    )
+    # emitted length per beam (freezes with the beam)
+    emitted = jnp.ones((batch, width), jnp.int32)
+
+    def body(carry, _):
+        cache, last, scores, out, alive, emitted = carry
+        logits, cache = step_fn(
+            params, cache, last.reshape(batch * width), config
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(
+            batch, width, vocab
+        )
+        if eos_id is not None:
+            # a finished beam contributes exactly one continuation — its
+            # frozen self emitting eos at no score cost — so it competes
+            # in the top-k without multiplying into V children
+            frozen = jnp.full((batch, width, vocab), -jnp.inf)
+            frozen = frozen.at[:, :, eos_id].set(0.0)
+            logp = jnp.where(alive[..., None], logp, frozen)
+        total = scores[..., None] + logp  # [B, W, V]
+        flat_scores, flat_idx = jax.lax.top_k(
+            total.reshape(batch, width * vocab), width
+        )
+        parent = flat_idx // vocab  # [B, W]
+        token = (flat_idx % vocab).astype(jnp.int32)
+        flat_parent = (rows[:, None] * width + parent).reshape(-1)
+        cache = jax.tree.map(lambda a: a[flat_parent], cache)
+        out = out[rows[:, None], parent]
+        alive = alive[rows[:, None], parent]
+        emitted = emitted[rows[:, None], parent]
+        # the frozen-beam continuation emits eos (already the pad value)
+        write = jnp.where(alive, token,
+                          eos_id if eos_id is not None else token)
+        out = jax.vmap(
+            jax.vmap(lambda row, t, v: row.at[t].set(v))
+        )(out, jnp.minimum(emitted, num_tokens - 1), write)
+        emitted = emitted + jnp.where(alive, 1, 0)
+        if eos_id is not None:
+            alive = alive & (token != eos_id)
+        return (cache, token, flat_scores, out, alive, emitted), None
+
+    carry = (cache, first_tokens, first_scores, out, alive, emitted)
+    (cache, last, scores, out, alive, emitted), _ = jax.lax.scan(
+        body, carry, None, length=num_tokens - 1
+    )
+
+    if length_penalty > 0:
+        norm = ((5.0 + emitted.astype(jnp.float32)) / 6.0) ** length_penalty
+        ranked = scores / norm
+    else:
+        ranked = scores
+    order = jnp.argsort(-ranked, axis=1)  # best first
+    out = jnp.take_along_axis(out, order[..., None], axis=1)
+    ranked = jnp.take_along_axis(ranked, order, axis=1)
+    if return_all:
+        return out, ranked
+    return out[:, 0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "config", "num_tokens", "beams", "length_penalty", "eos_id",
+        "attention_fn", "return_all",
+    ),
+)
+def beam_search_jit(
+    params: dict,
+    config: ModelConfig,
+    prompt: jax.Array,
+    num_tokens: int,
+    beams: int = 4,
+    length_penalty: float = 0.0,
+    eos_id: int | None = None,
+    attention_fn=None,
+    lengths: jax.Array | None = None,
+    return_all: bool = False,
+):
+    """Compiled :func:`beam_search` (prefill + the whole scan)."""
+    return beam_search(
+        params, config, prompt, num_tokens, beams=beams,
+        length_penalty=length_penalty, eos_id=eos_id,
+        attention_fn=attention_fn, lengths=lengths, return_all=return_all,
+    )

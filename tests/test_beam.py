@@ -1,0 +1,146 @@
+"""Beam search: beams=1 must equal greedy decoding exactly (both
+families), wider beams must never find a worse joint log-probability
+than greedy, return_all is sorted best-first, and eos freezes beams."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.beam import (
+    beam_search,
+    beam_search_jit,
+)
+from kube_sqs_autoscaler_tpu.workloads.decode import generate
+from kube_sqs_autoscaler_tpu.workloads.model import (
+    ModelConfig,
+    forward,
+    init_params,
+)
+
+TINY = ModelConfig(
+    vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+    max_seq_len=96,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), TINY)
+
+
+def prompt_tokens(batch=3, length=6, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (batch, length), 0, TINY.vocab_size, jnp.int32
+    )
+
+
+def sequence_logprob(params, config, prompt, continuation):
+    """Teacher-forced joint log-probability of the continuation."""
+    full = jnp.concatenate([prompt, jnp.asarray(continuation)], axis=1)
+    logp = jax.nn.log_softmax(forward(params, full, config), axis=-1)
+    total = np.zeros(full.shape[0])
+    for b in range(full.shape[0]):
+        for t in range(continuation.shape[1]):
+            pos = prompt.shape[1] - 1 + t
+            total[b] += float(logp[b, pos, full[b, pos + 1]])
+    return total
+
+
+def test_single_beam_equals_greedy(params):
+    prompt = prompt_tokens()
+    ref = np.asarray(generate(params, prompt, 10, TINY))
+    got = np.asarray(beam_search(params, TINY, prompt, 10, beams=1))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_wider_beam_never_worse_than_greedy(params):
+    prompt = prompt_tokens()
+    greedy = np.asarray(generate(params, prompt, 10, TINY))
+    beamed = np.asarray(beam_search(params, TINY, prompt, 10, beams=4))
+    greedy_lp = sequence_logprob(params, TINY, prompt, greedy)
+    beam_lp = sequence_logprob(params, TINY, prompt, beamed)
+    assert (beam_lp >= greedy_lp - 1e-3).all()
+
+
+def test_return_all_sorted_and_shaped(params):
+    prompt = prompt_tokens()
+    seqs, scores = beam_search_jit(params, TINY, prompt, 8, 4,
+                                   return_all=True)
+    assert seqs.shape == (3, 4, 8)
+    s = np.asarray(scores)
+    assert (s[:, :-1] >= s[:, 1:] - 1e-6).all()  # best first
+    # row 0 of return_all == the single-sequence API
+    best = np.asarray(beam_search(params, TINY, prompt, 8, beams=4))
+    np.testing.assert_array_equal(np.asarray(seqs)[:, 0], best)
+
+
+def test_llama_family_beam(params):
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_generate,
+    )
+
+    config = LlamaConfig(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=1,
+                         n_layers=2, d_ff=48, max_seq_len=96,
+                         dtype=jnp.float32)
+    lparams = init_llama_params(jax.random.key(2), config)
+    prompt = prompt_tokens()
+    ref = np.asarray(llama_generate(lparams, prompt, 8, config))
+    got = np.asarray(beam_search(lparams, config, prompt, 8, beams=1))
+    np.testing.assert_array_equal(got, ref)
+    # a wider llama beam is at least as probable too
+    beamed = beam_search(lparams, config, prompt, 8, beams=3)
+    # (scores checked via the gpt-family test; here shape/validity)
+    assert beamed.shape == (3, 8)
+    assert 0 <= int(jnp.min(beamed)) and int(jnp.max(beamed)) < 64
+
+
+def test_eos_freezes_and_pads(params):
+    prompt = prompt_tokens()
+    greedy = np.asarray(generate(params, prompt, 10, TINY))
+    eos = int(greedy[0, 3])  # an id the model actually produces
+    out = np.asarray(beam_search(params, TINY, prompt, 10, beams=3,
+                                 eos_id=eos, length_penalty=1.0))
+    for row in out:
+        ids = row.tolist()
+        if eos in ids:
+            first = ids.index(eos)
+            assert all(x == eos for x in ids[first:])
+
+
+def test_ragged_prompts(params):
+    prompt = prompt_tokens()
+    lengths = jnp.asarray([3, 6, 4], jnp.int32)
+    full = np.asarray(generate(params, prompt, 8, TINY, lengths=lengths))
+    got = np.asarray(beam_search(params, TINY, prompt, 8, beams=1,
+                                 lengths=lengths))
+    np.testing.assert_array_equal(got, full)
+
+
+def test_serve_binary_beams_flag():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--beams", "3"])
+    main(["--family", "llama", "--demo", "2", "--batch-size", "1",
+          "--seq-len", "8", "--generate-tokens", "4", "--beams", "2"])
+    with pytest.raises(SystemExit, match="deterministic"):
+        main(["--demo", "1", "--generate-tokens", "4", "--beams", "2",
+              "--temperature", "0.5"])
+    with pytest.raises(SystemExit, match="beams"):
+        main(["--demo", "1", "--generate-tokens", "4", "--beams", "2",
+              "--speculative-draft-layers", "1"])
+    with pytest.raises(SystemExit, match="beams"):
+        main(["--demo", "1", "--generate-tokens", "4", "--beams", "0"])
+
+
+def test_validation(params):
+    prompt = prompt_tokens()
+    with pytest.raises(ValueError, match="beams"):
+        beam_search(params, TINY, prompt, 4, beams=0)
+    with pytest.raises(ValueError, match="num_tokens"):
+        beam_search(params, TINY, prompt, 0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        beam_search(params, TINY, prompt, 96)
