@@ -186,6 +186,39 @@ def test_speculative_llama_family():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_speculative_untied_readout_llama():
+    """An HF-imported llama with a separate lm_head speculates correctly
+    (the chunk verify reads readout_weights, not the tied embedding)."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from kube_sqs_autoscaler_tpu.workloads.hf_convert import load_hf_llama
+    from kube_sqs_autoscaler_tpu.workloads.llama import llama_generate
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=96, tie_word_embeddings=False,
+        attn_implementation="eager",
+    ))
+    from dataclasses import replace
+
+    config, params = load_hf_llama(hf, dtype=jnp.float32)
+    assert "lm_head" in params
+    dcfg = replace(config, n_layers=2)
+    dparams = dict(params, layers=params["layers"][:2])
+    prompt = jax.random.randint(jax.random.key(41), (2, 8), 0, 128,
+                                jnp.int32)
+    ref = np.asarray(llama_generate(params, prompt, 12, config))
+    got = np.asarray(
+        speculative_generate(params, config, dparams, dcfg, prompt, 12,
+                             draft_tokens=3)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_speculative_tight_budget_with_uneven_acceptance():
     """Rows that finish early freeze instead of marching their cache past
     max_seq_len: with a small vocab (high random acceptance variance) and
