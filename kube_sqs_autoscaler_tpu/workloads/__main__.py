@@ -119,6 +119,11 @@ def main(argv=None) -> None:
              " plus decoded 'text' when --tokenizer is set)",
     )
     parser.add_argument(
+        "--eos-id", type=int, default=-1, metavar="ID",
+        help="stop generating a row once it emits this token id (pads "
+             "with it afterwards; -1 = none / auto from --tokenizer)",
+    )
+    parser.add_argument(
         "--tokenizer", default="", metavar="DIR",
         help="text-in/text-out: load a transformers tokenizer and encode "
              "plain-text or {'text': ...} message bodies (and decode "
@@ -279,6 +284,7 @@ def main(argv=None) -> None:
         seq_len=args.seq_len, generate_tokens=args.generate_tokens,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         result_queue_url=args.result_queue_url,
+        eos_id=None if args.eos_id < 0 else args.eos_id,
     )
     tokenizer = None
     if args.tokenizer:
@@ -295,11 +301,19 @@ def main(argv=None) -> None:
                 f"tokenizer vocab ({tok_vocab}) exceeds the model's "
                 f"vocab_size ({model_config.vocab_size})"
             )
+        if service_config.eos_id is None and tokenizer.eos_token_id is not None:
+            service_config.eos_id = int(tokenizer.eos_token_id)
+            log.info("eos_id %d from the tokenizer", service_config.eos_id)
         log.info("Tokenizer: %s (vocab %d)", args.tokenizer, tok_vocab)
 
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
     if mesh is not None:
+        if service_config.eos_id is not None:
+            raise SystemExit(
+                "--eos-id is not supported with --model-parallel (the "
+                "sharded generate contract has no eos slot yet)"
+            )
         from .train import make_forward_step
 
         if family == "llama":
@@ -355,6 +369,7 @@ def main(argv=None) -> None:
                 ),
                 lengths=lengths, top_k=service_config.top_k,
                 top_p=service_config.top_p,
+                eos_id=service_config.eos_id,
             ),
         }
     if args.beams > 1:
@@ -375,6 +390,7 @@ def main(argv=None) -> None:
             # plain generate paths (memoized factories, jit-static safe)
             lambda p, t, n, lengths: beam_search_jit(
                 p, model_config, t, n, args.beams,
+                eos_id=service_config.eos_id,
                 attention_fn=_beam_prefill_attention(t.shape[1]),
                 lengths=lengths,
             )
@@ -395,6 +411,12 @@ def main(argv=None) -> None:
                 raise SystemExit(
                     f"--speculative-draft-layers does not support {flag}"
                 )
+        if service_config.eos_id is not None:
+            raise SystemExit(
+                "--eos-id is not supported with "
+                "--speculative-draft-layers (the draft-and-verify loop "
+                "has no eos pinning yet)"
+            )
         n_draft = args.speculative_draft_layers
         k = args.speculative_draft_tokens
         if k < 1:
@@ -450,6 +472,7 @@ def main(argv=None) -> None:
                           ("--result-queue-url",
                            bool(args.result_queue_url)),
                           ("--tokenizer", bool(args.tokenizer)),
+                          ("--eos-id", service_config.eos_id is not None),
                           ("--generate-tokens >= 1 required",
                            args.generate_tokens < 1)):
             if bad:

@@ -348,8 +348,14 @@ def generate(
     lengths: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Generate ``num_tokens`` continuation tokens for each prompt.
+
+    ``eos_id`` (optional) ends a row's generation: once the row emits
+    that id every later position is ``eos_id`` (the shapes stay static —
+    finished rows keep stepping but their output is pinned), so
+    consumers can truncate at the first eos.
 
     Greedy at ``temperature=0`` (default), else temperature sampling with
     ``rng``, optionally truncated by ``top_k``/nucleus ``top_p`` (see
@@ -381,14 +387,23 @@ def generate(
     logits, cache = prefill(params, prompt, config, attention_fn,
                             lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
+    done0 = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros(first.shape, bool)
+    )
 
     def body(carry, key):
-        cache, token = carry
+        cache, token, done = carry
         logits, cache = decode_step(params, cache, token, config)
         nxt = _pick(logits, key, temperature, top_k, top_p)
-        return (cache, nxt), token
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), token
 
-    (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
+    (_, last, _), produced = jax.lax.scan(
+        body, (cache, first, done0), keys[1:]
+    )
     produced = jnp.moveaxis(produced, 0, 1)  # [steps-1, B] -> [B, steps-1]
     return jnp.concatenate([produced, last[:, None]], axis=1)
 
@@ -397,7 +412,7 @@ def generate(
     jax.jit,
     static_argnames=(
         "num_tokens", "config", "temperature", "attention_fn", "top_k",
-        "top_p",
+        "top_p", "eos_id",
     ),
 )
 def generate_jit(
@@ -411,6 +426,7 @@ def generate_jit(
     lengths: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
     prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
@@ -418,6 +434,7 @@ def generate_jit(
     return generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         attention_fn=attention_fn, lengths=lengths, top_k=top_k, top_p=top_p,
+        eos_id=eos_id,
     )
 
 

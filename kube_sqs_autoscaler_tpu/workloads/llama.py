@@ -779,6 +779,7 @@ def llama_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     rolling: bool = False,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Greedy/temperature/top-k/top-p generation, one compiled program
     (same contract and scan structure as :func:`.decode.generate`,
@@ -810,14 +811,23 @@ def llama_generate(
     logits, cache = prefill_fn(params, prompt, config, prompt_attention,
                                lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
+    done0 = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros(first.shape, bool)
+    )
 
     def body(carry, key):
-        cache, token = carry
+        cache, token, done = carry
         logits, cache = step_fn(params, cache, token, config)
         nxt = _pick(logits, key, temperature, top_k, top_p)
-        return (cache, nxt), token
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), token
 
-    (_, last), produced = jax.lax.scan(body, (cache, first), keys[1:])
+    (_, last, _), produced = jax.lax.scan(
+        body, (cache, first, done0), keys[1:]
+    )
     produced = jnp.moveaxis(produced, 0, 1)
     return jnp.concatenate([produced, last[:, None]], axis=1)
 
@@ -876,7 +886,7 @@ def llama_forward_jit_with(
     jax.jit,
     static_argnames=(
         "num_tokens", "config", "temperature", "prompt_attention", "top_k",
-        "top_p",
+        "top_p", "rolling", "eos_id",
     ),
 )
 def llama_generate_jit(
@@ -890,9 +900,11 @@ def llama_generate_jit(
     lengths: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    rolling: bool = False,
+    eos_id: int | None = None,
 ) -> jax.Array:
     return llama_generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         prompt_attention=prompt_attention, lengths=lengths, top_k=top_k,
-        top_p=top_p,
+        top_p=top_p, rolling=rolling, eos_id=eos_id,
     )

@@ -99,6 +99,10 @@ class ServiceConfig:
     sample_seed: int = 0
     top_k: int = 0
     top_p: float = 1.0
+    # generation stops at this id (rows pad with it afterwards); None =
+    # always generate the full generate_tokens.  The serve binary
+    # auto-fills it from --tokenizer's eos_token_id when present.
+    eos_id: int | None = None
     # request/reply: when set, the worker publishes one JSON result per
     # input message to this queue (after compute, before deleting the
     # input — at-least-once semantics, so consumers must tolerate
@@ -204,6 +208,7 @@ class QueueWorker:
                 attention_fn=attention_fn_for(tokens.shape[1]),
                 lengths=lengths, top_k=service_config.top_k,
                 top_p=service_config.top_p,
+                eos_id=service_config.eos_id,
             )
 
         self._generate = generate_fn or _default_generate
@@ -316,9 +321,15 @@ class QueueWorker:
                 rows = np.asarray(produced)[: len(messages)]
                 results = []
                 for row in rows:
-                    payload = {"tokens": row.tolist()}
+                    ids = row.tolist()
+                    if self.config.eos_id is not None and \
+                            self.config.eos_id in ids:
+                        # reply carries the finished sequence, not the
+                        # eos padding after it
+                        ids = ids[: ids.index(self.config.eos_id)]
+                    payload = {"tokens": ids}
                     if self.tokenizer is not None:
-                        payload["text"] = self.tokenizer.decode(row.tolist())
+                        payload["text"] = self.tokenizer.decode(ids)
                     results.append(payload)
         else:
             # greedy next token per sequence, read at each row's last

@@ -342,3 +342,21 @@ def test_ragged_generate_llama_equals_unpadded():
         np.testing.assert_array_equal(
             np.asarray(batch_out[i]), np.asarray(solo[0])
         )
+
+
+def test_eos_stops_generation_and_pads(params):
+    """Once a row emits eos_id, every later position is eos_id; rows
+    that never emit it are unaffected (identical to the eos-free run)."""
+    prompt = prompt_tokens()
+    free = np.asarray(generate(params, prompt, 10, TINY))
+    eos = int(free[0, 4])  # an id the model actually emits mid-sequence
+    out = np.asarray(generate(params, prompt, 10, TINY, eos_id=eos))
+    for row_free, row in zip(free, out):
+        ids = row.tolist()
+        if eos in ids:
+            first = ids.index(eos)
+            assert all(x == eos for x in ids[first:])
+            # the prefix before the first eos matches the free run
+            assert ids[:first] == row_free.tolist()[:first]
+        else:
+            assert ids == row_free.tolist()

@@ -539,3 +539,34 @@ def test_replies_carry_request_ids_and_error_payloads():
     assert "next_token" in out[good_id]
     assert out[bad_id] == {"error": "malformed body",
                            "request_id": bad_id}
+
+
+def test_generate_replies_truncate_at_eos():
+    params = init_params(jax.random.key(0), TINY)
+    queue, replies = FakeMessageQueue(), FakeMessageQueue()
+    send_token_messages(queue, 2)
+    # discover an id the model emits for the first message, then serve
+    # with it as eos and expect the reply to stop there
+    probe_cfg = ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                              generate_tokens=6,
+                              result_queue_url="fake://results")
+    worker = QueueWorker(queue, params, TINY, probe_cfg,
+                         result_queue=replies)
+    assert worker.run_once() == 2
+    probe = json.loads(
+        replies.receive_messages("fake://results", 10)[0]["Body"]
+    )["tokens"]
+    eos = probe[2]
+
+    queue2, replies2 = FakeMessageQueue(), FakeMessageQueue()
+    send_token_messages(queue2, 2)
+    config = ServiceConfig(queue_url=URL, batch_size=4, seq_len=16,
+                           generate_tokens=6, eos_id=eos,
+                           result_queue_url="fake://results")
+    worker = QueueWorker(queue2, params, TINY, config,
+                         result_queue=replies2)
+    assert worker.run_once() == 2
+    for message in replies2.receive_messages("fake://results", 10):
+        payload = json.loads(message["Body"])
+        assert eos not in payload["tokens"]
+        assert len(payload["tokens"]) <= 6
