@@ -320,3 +320,40 @@ def test_windowed_llama_trains_on_the_mesh():
     )
     with pytest.raises(ValueError, match="sliding_window"):
         make_llama_train_step(sp_mesh, config, tc, sp_state)
+
+
+def test_windowed_llama_composes_with_beam_and_rolling_eos():
+    """Cross-feature interactions: beam search over a sliding-window
+    llama (beams=1 == windowed greedy) and rolling-cache decode with an
+    eos id (finished rows pin, prefixes match the eos-free run)."""
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_generate,
+    )
+
+    cfg = LlamaConfig(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=1,
+                      n_layers=2, d_ff=48, max_seq_len=96,
+                      sliding_window=6, dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 10), 0, 64,
+                                jnp.int32)
+
+    ref = np.asarray(llama_generate(params, prompt, 10, cfg))
+    b1 = np.asarray(beam_search(params, cfg, prompt, 10, beams=1))
+    np.testing.assert_array_equal(b1, ref)
+
+    free = np.asarray(llama_generate(params, prompt, 12, cfg,
+                                     rolling=True))
+    eos = int(free[0, 4])
+    out = np.asarray(llama_generate(params, prompt, 12, cfg, rolling=True,
+                                    eos_id=eos))
+    for row_free, row in zip(free, out):
+        ids = row.tolist()
+        if eos in ids:
+            first = ids.index(eos)
+            assert all(x == eos for x in ids[first:])
+            assert ids[:first] == row_free.tolist()[:first]
+        else:
+            assert ids == row_free.tolist()
