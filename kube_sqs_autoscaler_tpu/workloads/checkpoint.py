@@ -155,18 +155,27 @@ class TrainCheckpointer:
         steps = self._steps()
         return steps[-1] if steps else None
 
-    def restore(self, mesh: Mesh, reference_state: dict, step: int | None = None) -> dict:
+    def restore(
+        self, mesh: Mesh, reference_state: dict, step: int | None = None,
+        state_shardings_fn=None,
+    ) -> dict:
         """Restore (latest by default) placed onto ``mesh``'s shardings.
 
         ``reference_state`` supplies the pytree structure/shapes/dtypes
         (e.g. a freshly-initialized state); restored arrays are placed with
-        the exact shardings the train step uses.
+        the exact shardings the train step uses.  ``state_shardings_fn``
+        overrides the placement rules (default: the flat PARAM_AXES rules;
+        pipeline resumes pass :func:`.pipeline.pipeline_state_shardings` —
+        their stage stacks carry a leading layer axis the flat rules would
+        mis-place).
         """
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        shardings = state_shardings(mesh, reference_state)
+        shardings = (state_shardings_fn or state_shardings)(
+            mesh, reference_state
+        )
         targets = jax.tree.map(
             lambda leaf, sharding: jax.ShapeDtypeStruct(
                 jax.numpy.shape(leaf),
@@ -211,10 +220,23 @@ class TrainCheckpointer:
             )
         pipeline_layout = (layout or {}).get("kind") == "pipeline"
         if pipeline_layout:
-            from .pipeline import init_pipeline_params, unstack_layers
+            from .pipeline import (
+                init_llama_pipeline_params,
+                init_pipeline_params,
+                unstack_layers,
+                unstack_llama_layers,
+            )
+
+            stage_init = (
+                init_llama_pipeline_params if family == "llama"
+                else init_pipeline_params
+            )
+            unstack = (
+                unstack_llama_layers if family == "llama" else unstack_layers
+            )
 
             def init_fn(key, config):
-                return init_pipeline_params(
+                return stage_init(
                     key, config, n_stages=int(layout["n_stages"])
                 )
         elif family == "llama":
@@ -254,8 +276,6 @@ class TrainCheckpointer:
         )
         params = restored["params"]
         if pipeline_layout:
-            from .pipeline import unstack_layers
-
-            params = unstack_layers(params)
+            params = unstack(params)
             params = jax.device_put(params, param_shardings(mesh, params))
         return params

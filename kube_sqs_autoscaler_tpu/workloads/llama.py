@@ -201,11 +201,21 @@ def repeat_kv(t: jax.Array, groups: int) -> jax.Array:
 def _project_qkv(
     h: jax.Array, layer: dict, config: LlamaConfig, positions: jax.Array
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """q (full heads, rotated), k (kv heads, rotated), v (kv heads)."""
+    """q (full heads, rotated), k (kv heads, rotated), v (kv heads).
+
+    Layers carry either the fused ``wkv`` (one MXU matmul — the
+    single-chip layout) or split ``wk``/``wv`` (the pipeline stage
+    layout, whose fully-manual tensor-parallel sharding needs contiguous
+    kv heads per projection — a fused ``2*kv_dim`` axis chunks across
+    the k/v boundary); both produce identical values, mirroring
+    :func:`.model._project_qkv`'s two layouts.
+    """
     head_dim = config.head_dim
     q = _split_heads(h @ layer["wq"], config.n_heads, head_dim)
-    kv = h @ layer["wkv"]
-    k, v = jnp.split(kv, 2, axis=-1)
+    if "wkv" in layer:
+        k, v = jnp.split(h @ layer["wkv"], 2, axis=-1)
+    else:
+        k, v = h @ layer["wk"], h @ layer["wv"]
     k = _split_heads(k, config.n_kv_heads, head_dim)
     v = _split_heads(v, config.n_kv_heads, head_dim)
     q = apply_rope(q, positions, config.rope_theta)
@@ -214,7 +224,13 @@ def _project_qkv(
 
 
 def _swiglu(x: jax.Array, layer: dict) -> jax.Array:
-    gate, up = jnp.split(x @ layer["w_gate_up"], 2, axis=-1)
+    """SwiGLU from either the fused ``w_gate_up`` or the pipeline stage
+    layout's split ``w_gate``/``w_up`` (contiguous ff columns per
+    projection under tensor-parallel sharding)."""
+    if "w_gate_up" in layer:
+        gate, up = jnp.split(x @ layer["w_gate_up"], 2, axis=-1)
+    else:
+        gate, up = x @ layer["w_gate"], x @ layer["w_up"]
     return (jax.nn.silu(gate) * up) @ layer["w_down"]
 
 
@@ -225,6 +241,8 @@ def _llama_block(
     positions: jax.Array,
     attend,
     mlp=None,
+    reduce=None,
+    promote=None,
 ) -> jax.Array:
     """Pre-RMSNorm attention + pre-RMSNorm SwiGLU, residual both.
 
@@ -234,16 +252,32 @@ def _llama_block(
     feed-forward (dense :func:`_swiglu` by default; the routed SwiGLU
     expert MLP for the MoE variant).  The single source of truth for the
     family's wiring — training forward, prefill, and decode all run it.
+
+    ``reduce``/``promote`` are the same Megatron tensor-parallel seams as
+    :func:`.model._block`'s (the *g*/*f* conjugate operators for
+    fully-manual ``shard_map`` execution — see that docstring): ``reduce``
+    closes the row-parallel partial sums after ``wo`` and ``w_down``,
+    ``promote`` guards each normed input to the column-parallel matmuls.
+    Both ``None`` (default) for unsharded or GSPMD-auto execution.
     """
     h = _rms_norm(x, layer["attn_norm"], config.rms_eps)
+    if promote is not None:
+        h = promote(h)
     q, k, v = _project_qkv(h, layer, config, positions)
     out = attend(q, k, v)
     batch, _, seq, _ = out.shape
     out = out.transpose(0, 2, 1, 3).reshape(batch, seq, config.d_model)
-    x = x + out @ layer["wo"]
-    return x + (mlp or _swiglu)(
-        _rms_norm(x, layer["mlp_norm"], config.rms_eps), layer
-    )
+    proj = out @ layer["wo"]
+    if reduce is not None:
+        proj = reduce(proj)
+    x = x + proj
+    h2 = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    if promote is not None:
+        h2 = promote(h2)
+    up = (mlp or _swiglu)(h2, layer)
+    if reduce is not None:
+        up = reduce(up)
+    return x + up
 
 
 def _gqa_wrap(config: LlamaConfig, inner):

@@ -85,9 +85,12 @@ PARAM_AXES = {
     # pipeline stage stacks (workloads.pipeline) split the fused wqkv into
     # per-projection weights so each shards contiguous heads under the
     # fully-manual pp x tp shard_map (a fused 3*d_model axis chunks across
-    # the q/k/v boundary); wq above is shared with the llama family
+    # the q/k/v boundary); wq above is shared with the llama family.  The
+    # llama stage stack splits wkv into wk/wv (contiguous kv heads) and
+    # w_gate_up into w_gate/w_up (contiguous ff columns) the same way.
     "wk": ("model", "heads"),
     "wv": ("model", "heads"),
+    "w_gate": ("model", "ff"),
 }
 
 

@@ -146,6 +146,57 @@ def init_pipeline_params(
     return params
 
 
+def stack_llama_layers(params: dict) -> dict:
+    """The llama-family counterpart of :func:`stack_layers`: one stacked
+    pytree with leading ``[L]``, fused projections split so every weight's
+    output axis shards into contiguous blocks under the fully-manual
+    pp x tp ``shard_map`` — ``wkv`` into ``wk``/``wv`` (contiguous kv
+    heads; a fused ``2*kv_dim`` chunk crosses the k/v boundary) and
+    ``w_gate_up`` into ``w_gate``/``w_up`` (contiguous ff columns).
+    :func:`.llama._project_qkv` / :func:`.llama._swiglu` accept both
+    layouts."""
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *params["layers"])
+    wk, wv = jnp.split(stacked.pop("wkv"), 2, axis=-1)
+    stacked["wk"], stacked["wv"] = wk, wv
+    w_gate, w_up = jnp.split(stacked.pop("w_gate_up"), 2, axis=-1)
+    stacked["w_gate"], stacked["w_up"] = w_gate, w_up
+    return stacked
+
+
+def unstack_llama_layers(params: dict) -> dict:
+    """Inverse of the llama pipeline layout: stage stack -> flat
+    ``layers`` list with the fused ``wkv``/``w_gate_up`` — the layout
+    :func:`.llama.llama_forward` and the decode paths consume (the
+    llama counterpart of :func:`unstack_layers`, used by the
+    checkpoint train→serve handoff)."""
+    stages = dict(params["stages"])
+    wk, wv = stages.pop("wk"), stages.pop("wv")
+    stages["wkv"] = jnp.concatenate([wk, wv], axis=-1)
+    w_gate, w_up = stages.pop("w_gate"), stages.pop("w_up")
+    stages["w_gate_up"] = jnp.concatenate([w_gate, w_up], axis=-1)
+    n_layers = next(iter(stages.values())).shape[0]
+    flat = {k: v for k, v in params.items() if k != "stages"}
+    flat["layers"] = [
+        {k: v[i] for k, v in stages.items()} for i in range(n_layers)
+    ]
+    return flat
+
+
+def init_llama_pipeline_params(rng: jax.Array, config, n_stages: int) -> dict:
+    """:func:`.llama.init_llama_params` with the stack pre-stacked."""
+    from .llama import init_llama_params
+
+    if config.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by n_stages={n_stages}"
+        )
+    params = init_llama_params(rng, config)
+    stages = stack_llama_layers(params)
+    del params["layers"]
+    params["stages"] = stages
+    return params
+
+
 def _stage_spec(name: str, with_model: bool) -> P:
     """PartitionSpec of one stage-stack leaf: leading layer axis over
     ``"pipe"``; on a pp x tp mesh, the PARAM_AXES Megatron axes over
@@ -214,6 +265,70 @@ def _stage_apply(
 
     def one_layer(h, layer):
         return block(h, layer, cfg, attend, None, reduce, promote), None
+
+    out, _ = jax.lax.scan(one_layer, x, stage_layers)
+    return out
+
+
+def _llama_stage_apply(
+    stage_layers: dict, x: jax.Array, config,
+    remat: bool = False, tp_size: int = 1, attention_fn=None,
+) -> jax.Array:
+    """The llama-family counterpart of :func:`_stage_apply`: one stage's
+    stacked llama layers (RoPE/GQA/RMSNorm/SwiGLU via
+    :func:`.llama._llama_block`) over an activation microbatch.
+
+    RoPE positions are ``0..seq-1`` — a static function of the microbatch
+    shape, identical on every stage, so no position state crosses the
+    ``ppermute`` hops.  ``tp_size > 1`` runs the local Megatron shard
+    (contiguous ``n_heads/tp`` query heads, ``n_kv_heads/tp`` kv heads,
+    ``d_ff/tp`` ff columns) with the *f*/*g* conjugates hand-placed
+    through the block's ``reduce``/``promote`` seams; requires
+    ``n_kv_heads % tp == 0``.  ``config.sliding_window`` rides into the
+    default kernel pick (windowed flash block-skip / windowed dense).
+    """
+    from .llama import _llama_block
+
+    if tp_size > 1 and (config.n_heads % tp_size
+                        or config.n_kv_heads % tp_size):
+        # catch it here, not as a reshape-to-zero-heads error deep inside
+        # the shard_map trace (kv_dim can divide evenly while the head
+        # count does not)
+        raise ValueError(
+            f"n_heads={config.n_heads} / n_kv_heads={config.n_kv_heads} "
+            f"must both be divisible by model_parallel={tp_size}"
+        )
+    if tp_size > 1:
+        cfg = dataclasses.replace(
+            config,
+            d_model=config.d_model // tp_size,
+            n_heads=config.n_heads // tp_size,
+            n_kv_heads=config.n_kv_heads // tp_size,
+        )
+        reduce, promote = _tp_reduce, _tp_promote
+    else:
+        cfg, reduce, promote = config, None, None
+    block = (
+        jax.checkpoint(_llama_block, static_argnums=(2, 4, 5, 6, 7))
+        if remat else _llama_block
+    )
+    # same kernel policy as _stage_apply (measured dispatcher unless the
+    # caller injects one), adapted to the family's GQA-shaped k/v and
+    # sliding window
+    if attention_fn is None:
+        from .flash import attention_fn_for, windowed
+
+        attention_fn = windowed(
+            attention_fn_for(x.shape[1]), config.sliding_window
+        )
+    from .flash import gqa_adapt
+
+    attend = gqa_adapt(attention_fn)
+    positions = jnp.arange(x.shape[1])
+
+    def one_layer(h, layer):
+        return block(h, layer, cfg, positions, attend, None, reduce,
+                     promote), None
 
     out, _ = jax.lax.scan(one_layer, x, stage_layers)
     return out
@@ -298,6 +413,7 @@ def _pipeline_body(
     remat: bool = False,
     tp_size: int = 1,
     attention_fn=None,
+    stage_apply=None,
 ) -> jax.Array:
     """Per-device GPipe schedule (inside a fully-manual ``shard_map``).
 
@@ -307,8 +423,10 @@ def _pipeline_body(
     over ``"pipe"``/``"model"``, batch-sharded over ``"data"``; stage 0 is
     the only reader, but keeping the buffer everywhere makes the schedule
     a pure lockstep loop).  Returns the fully-processed microbatches with
-    the same layout.
+    the same layout.  ``stage_apply`` is the family seam (default: the
+    gpt :func:`_stage_apply`; llama passes :func:`_llama_stage_apply`).
     """
+    stage_apply = stage_apply or _stage_apply
     stage = jax.lax.axis_index(axis_name)
     last = axis_size - 1
 
@@ -332,7 +450,7 @@ def _pipeline_body(
         act_in, outputs = carry
         fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, fresh, act_in)
-        act_out = _stage_apply(
+        act_out = stage_apply(
             stage_layers, inp, config, remat=remat, tp_size=tp_size,
             attention_fn=attention_fn,
         )
@@ -518,6 +636,117 @@ def pipeline_loss_fn(
     )
 
 
+def llama_pipeline_forward(
+    params: dict,
+    tokens: jax.Array,
+    config,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+    remat: bool = False,
+    stage_attention=None,
+) -> jax.Array:
+    """Logits via the pipelined llama stack — :func:`pipeline_forward`
+    with the family's pieces swapped in: RoPE positions instead of a
+    learned ``pos_embed`` (so embedding is just the table lookup),
+    :func:`_llama_stage_apply` inside the same GPipe body, and a final
+    RMSNorm + (possibly untied) readout.  ``tokens``: int32
+    ``[M, B_m, S]`` -> fp32 logits ``[M, B_m, S, vocab]``."""
+    from .llama import _rms_norm, readout_weights
+
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    x = params["embed"][tokens]
+
+    body = partial(
+        _pipeline_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=mesh.shape["pipe"],
+        remat=remat,
+        tp_size=mesh.shape.get("model", 1),
+        attention_fn=stage_attention,
+        stage_apply=_llama_stage_apply,
+    )
+    y = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_partition_specs(params["stages"], mesh),
+                  P(None, "data")),
+        out_specs=P(None, "data"),
+        check_vma=False,
+    )(params["stages"], x)
+
+    y = _rms_norm(y, params["final_norm"], config.rms_eps)
+    return jnp.einsum(
+        "mbsd,vd->mbsv", y, readout_weights(params),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def llama_pipeline_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+    attention_fn=None,  # accepted for train.make_train_step's loss seam
+    remat: bool = False,
+    stage_attention=None,
+) -> jax.Array:
+    """Mean next-token NLL over all microbatches (llama family; same
+    seam contract as :func:`pipeline_loss_fn`)."""
+    from .train import next_token_nll
+
+    logits = llama_pipeline_forward(params, tokens, config, pcfg, mesh,
+                                    remat=remat,
+                                    stage_attention=stage_attention)
+    m, b, s, v = logits.shape
+    return next_token_nll(
+        logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
+    )
+
+
+def _gpt_head_loss(head, y, targets):
+    """Last-stage readout objective of the gpt family: final LayerNorm +
+    tied-embedding logits + mean next-token NLL (the 1F1B body's default
+    ``head_loss`` seam)."""
+    from .train import next_token_nll
+
+    y = _layer_norm(y, head["final_ln_scale"], head["final_ln_bias"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", y, head["embed"], preferred_element_type=jnp.float32
+    )
+    return next_token_nll(logits, targets)
+
+
+def _llama_head_loss(rms_eps: float):
+    """The llama-family ``head_loss`` seam: final RMSNorm + readout
+    (tied embed or untied ``lm_head``, already selected into
+    ``head["readout"]``) + mean next-token NLL."""
+
+    def head_loss(head, y, targets):
+        from .llama import _rms_norm
+        from .train import next_token_nll
+
+        y = _rms_norm(y, head["final_norm"], rms_eps)
+        logits = jnp.einsum(
+            "bsd,vd->bsv", y, head["readout"],
+            preferred_element_type=jnp.float32,
+        )
+        return next_token_nll(logits, targets)
+
+    return head_loss
+
+
 def _one_f_one_b_body(
     stage_layers: dict,
     head: dict,
@@ -532,6 +761,8 @@ def _one_f_one_b_body(
     remat: bool,
     tp_size: int,
     attention_fn=None,
+    stage_apply=None,
+    head_loss=None,
 ):
     """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
     every mesh axis — see the module docstring for why partial-manual is
@@ -549,7 +780,15 @@ def _one_f_one_b_body(
 
     Returns ``(loss, dstages, dhead, dx_micro)``; the caller divides by M
     and feeds ``dx_micro`` to the embedding vjp.
+
+    ``stage_apply``/``head_loss`` are the family seams: the per-stage
+    stacked-layer forward (default gpt :func:`_stage_apply`) and the
+    last stage's ``head_loss(head, y, targets) -> scalar`` readout
+    objective (default :func:`_gpt_head_loss`; llama passes its
+    RMSNorm + readout version).
     """
+    stage_apply = stage_apply or _stage_apply
+    head_loss = head_loss or _gpt_head_loss
     fwd_tbl, bwd_tbl = one_f_one_b_schedule(axis_size, n_micro)
     window = int(min(n_micro, axis_size))
     stage = jax.lax.axis_index(axis_name)
@@ -562,12 +801,12 @@ def _one_f_one_b_body(
     act_shape = x_micro.shape[1:]  # [B_loc, S, D]
 
     def stage_fwd(layers, x):
-        return _stage_apply(layers, x, config, tp_size=tp_size,
-                            attention_fn=attention_fn)
+        return stage_apply(layers, x, config, tp_size=tp_size,
+                           attention_fn=attention_fn)
 
     def stage_fwd_remat(layers, x):
-        return _stage_apply(layers, x, config, remat=remat, tp_size=tp_size,
-                            attention_fn=attention_fn)
+        return stage_apply(layers, x, config, remat=remat, tp_size=tp_size,
+                           attention_fn=attention_fn)
 
     def slot(carry, tables):
         (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
@@ -619,17 +858,8 @@ def _one_f_one_b_body(
                 )
 
                 def loss_of(layers, head, x):
-                    from .train import next_token_nll
-
-                    y = stage_fwd_remat(layers, x)
-                    y = _layer_norm(
-                        y, head["final_ln_scale"], head["final_ln_bias"]
-                    )
-                    logits = jnp.einsum(
-                        "bsd,vd->bsv", y, head["embed"],
-                        preferred_element_type=jnp.float32,
-                    )
-                    return next_token_nll(logits, targets)
+                    return head_loss(head, stage_fwd_remat(layers, x),
+                                     targets)
 
                 loss_m, (dstage, dhead, dx) = jax.value_and_grad(
                     loss_of, argnums=(0, 1, 2)
@@ -806,6 +1036,85 @@ def one_f_one_b_value_and_grad(
     return loss * inv_m, grads
 
 
+def llama_one_f_one_b_value_and_grad(
+    params: dict,
+    tokens: jax.Array,
+    config,
+    pcfg: "PipelineConfig",
+    mesh: Mesh,
+    remat: bool = False,
+    stage_attention=None,
+):
+    """``(loss, grads)`` for the pipelined llama LM via the 1F1B schedule
+    — :func:`one_f_one_b_value_and_grad` with the family seams swapped in
+    (:func:`_llama_stage_apply`, :func:`_llama_head_loss`).  Gradient-
+    equal to autodiff of :func:`llama_pipeline_loss_fn` (asserted by
+    ``tests/test_pipeline_llama.py``).  The embedding lookup runs outside
+    the pipelined region; with a tied readout its cotangent sums with the
+    last stage's, while an untied ``lm_head`` (HF imports) gets its own
+    gradient entry."""
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    tied = "lm_head" not in params
+
+    def embed_fn(embed_table):
+        return embed_table[tokens]
+
+    x_micro, embed_vjp = jax.vjp(embed_fn, params["embed"])
+    head = {
+        "readout": params["embed"] if tied else params["lm_head"],
+        "final_norm": params["final_norm"],
+    }
+
+    stage_specs = stage_partition_specs(params["stages"], mesh)
+    body = partial(
+        _one_f_one_b_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=mesh.shape["pipe"],
+        data_size=mesh.shape["data"],
+        remat=remat,
+        tp_size=mesh.shape.get("model", 1),
+        attention_fn=stage_attention,
+        stage_apply=_llama_stage_apply,
+        head_loss=_llama_head_loss(config.rms_eps),
+    )
+    loss, dstages, dhead, dx_micro = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_specs, P(), P(None, "data"), P(None, "data")),
+        out_specs=(P(), stage_specs, P(), P(None, "data")),
+        check_vma=False,
+    )(params["stages"], head, x_micro, tokens)
+
+    inv_m = 1.0 / pcfg.n_microbatches
+    (d_embed_side,) = embed_vjp(dx_micro * inv_m)
+    grads = {
+        "stages": jax.tree.map(
+            lambda g, p: (g * inv_m).astype(p.dtype),
+            dstages, params["stages"],
+        ),
+        "final_norm": (dhead["final_norm"] * inv_m).astype(
+            params["final_norm"].dtype
+        ),
+    }
+    if tied:
+        grads["embed"] = (
+            dhead["readout"] * inv_m + d_embed_side.astype(jnp.float32)
+        ).astype(params["embed"].dtype)
+    else:
+        grads["embed"] = d_embed_side.astype(params["embed"].dtype)
+        grads["lm_head"] = (dhead["readout"] * inv_m).astype(
+            params["lm_head"].dtype
+        )
+    return loss * inv_m, grads
+
+
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
     """Tokens ``[M, B_m, S]``: microbatch axis replicated, batch over data."""
     return NamedSharding(mesh, P(None, "data", None))
@@ -887,6 +1196,7 @@ def make_pipeline_train_step(
             ),
             state_shardings_fn=pipeline_state_shardings,
             batch_sharding_fn=pipeline_batch_sharding,
+            accum_axis=1,
         )
     return make_train_step(
         mesh, config, train_config, state,
@@ -894,4 +1204,57 @@ def make_pipeline_train_step(
                      remat=remat),
         state_shardings_fn=pipeline_state_shardings,
         batch_sharding_fn=pipeline_batch_sharding,
+        accum_axis=1,
+    )
+
+
+def init_llama_pipeline_train_state(
+    rng: jax.Array, config, train_config, n_stages: int
+) -> dict:
+    from .train import init_train_state
+
+    return init_train_state(
+        rng, config, train_config,
+        init_fn=partial(init_llama_pipeline_params, n_stages=n_stages),
+    )
+
+
+def make_llama_pipeline_train_step(
+    mesh: Mesh,
+    config,
+    pcfg: PipelineConfig,
+    train_config,
+    state: dict,
+):
+    """Compile one llama-family pp x dp (x tp) optimizer step — the
+    counterpart of :func:`make_pipeline_train_step` with the family's
+    loss/backward swapped through the same :func:`.train.make_train_step`
+    seams (one optimizer-step implementation for every variant).
+
+    ``config.sliding_window`` rides into the per-stage kernel pick via
+    :func:`_llama_stage_apply`'s default dispatcher; gradient
+    accumulation microbatches over the batch axis (``accum_axis=1`` —
+    the leading axis is the pipeline's own microbatch schedule).
+    """
+    from .train import make_train_step
+
+    remat = getattr(train_config, "remat", False)
+    if pcfg.schedule == "1f1b":
+        return make_train_step(
+            mesh, config, train_config, state,
+            value_and_grad_fn=partial(
+                llama_one_f_one_b_value_and_grad,
+                config=config, pcfg=pcfg, mesh=mesh, remat=remat,
+            ),
+            state_shardings_fn=pipeline_state_shardings,
+            batch_sharding_fn=pipeline_batch_sharding,
+            accum_axis=1,
+        )
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(llama_pipeline_loss_fn, config=config, pcfg=pcfg,
+                     mesh=mesh, remat=remat),
+        state_shardings_fn=pipeline_state_shardings,
+        batch_sharding_fn=pipeline_batch_sharding,
+        accum_axis=1,
     )

@@ -413,6 +413,7 @@ def make_train_step(
     batch_sharding_fn: Any = None,
     value_and_grad_fn: Any = None,
     window: int | None = None,
+    accum_axis: int = 0,
 ):
     """Compile one optimizer step over the mesh.
 
@@ -427,7 +428,11 @@ def make_train_step(
     :mod:`.pipeline` passes its stage-stacked rules).
     ``value_and_grad_fn(params, tokens) -> (loss, grads)`` replaces
     autodiff of ``loss`` entirely — for schedules that compute their own
-    backward (the 1F1B pipeline); mutually exclusive with grad_accum > 1.
+    backward (the 1F1B pipeline); ``grad_accum`` composes with it by
+    chunking the batch and scanning the custom backward per chunk.
+    ``accum_axis`` is the tokens axis gradient accumulation splits
+    (default 0, the batch axis; the pipeline's microbatch-major
+    ``[M, B_m, S]`` batches pass 1 — axis 0 is the schedule's own).
     """
     optimizer = make_optimizer(train_config)
     shardings = (state_shardings_fn or state_shardings)(mesh, state)
@@ -443,37 +448,36 @@ def make_train_step(
     # custom losses opt into remat themselves (forward's remat flag)
 
     accum = train_config.grad_accum
-    if value_and_grad_fn is not None and accum != 1:
-        raise ValueError(
-            "value_and_grad_fn computes its own backward; combine it with "
-            "grad_accum by microbatching inside it, not via grad_accum"
+
+    def vag(params, tokens):
+        if value_and_grad_fn is not None:
+            return value_and_grad_fn(params, tokens)
+        return jax.value_and_grad(loss)(
+            params, tokens, attention_fn=attention_fn
         )
 
     def compute_grads(params, tokens):
-        if value_and_grad_fn is not None:
-            return value_and_grad_fn(params, tokens)
         if accum == 1:
-            return jax.value_and_grad(loss)(
-                params, tokens, attention_fn=attention_fn
-            )
-        if tokens.shape[0] % accum:
+            return vag(params, tokens)
+        ax = accum_axis
+        n = tokens.shape[ax]
+        if n % accum:
             raise ValueError(
-                f"batch dim {tokens.shape[0]} not divisible by "
+                f"batch axis {ax} (size {n}) not divisible by "
                 f"grad_accum={accum}"
             )
-        # interleave: microbatch j takes rows ≡ j (mod accum), so each
-        # data-parallel shard contributes evenly to every microbatch and
-        # the split stays shard-local
-        micro = jnp.swapaxes(
-            tokens.reshape(tokens.shape[0] // accum, accum, *tokens.shape[1:]),
-            0, 1,
+        # interleave: microbatch j takes rows ≡ j (mod accum) along the
+        # accumulation axis, so each data-parallel shard contributes
+        # evenly to every microbatch and the split stays shard-local
+        shape = tokens.shape
+        micro = jnp.moveaxis(
+            tokens.reshape(*shape[:ax], n // accum, accum, *shape[ax + 1:]),
+            ax + 1, 0,
         )
 
         def one(carry, microbatch):
             loss_sum, grad_sum = carry
-            l, g = jax.value_and_grad(loss)(
-                params, microbatch, attention_fn=attention_fn
-            )
+            l, g = vag(params, microbatch)
             # fp32 accumulation regardless of the grad dtype
             grad_sum = jax.tree.map(
                 lambda acc, grad: acc + grad.astype(jnp.float32), grad_sum, g

@@ -195,15 +195,13 @@ def train(args) -> dict:
     initialize_from_env()
     pipe = args.pipe_parallel
     if pipe > 1:
-        # the pipelined stack is the gpt family sharded over a dedicated
+        # the pipelined stack (either family) runs over a dedicated
         # ("pipe","data"[,"model"]) mesh; seq/zigzag/MoE don't compose
         # with it (yet) and fail fast rather than silently ignore flags
-        for flag, bad in (("--family llama", args.family == "llama"),
-                          ("--seq-parallel > 1", args.seq_parallel > 1),
+        for flag, bad in (("--seq-parallel > 1", args.seq_parallel > 1),
                           ("--zigzag", args.zigzag),
                           ("--moe", args.moe),
-                          ("--topology-mesh", args.topology_mesh),
-                          ("--grad-accum > 1", args.grad_accum > 1)):
+                          ("--topology-mesh", args.topology_mesh)):
             if bad:
                 raise SystemExit(
                     f"--pipe-parallel does not combine with {flag}"
@@ -319,7 +317,20 @@ def train(args) -> dict:
                 n_layers=args.n_layers, d_ff=d_ff,
                 max_seq_len=args.seq_len,
             )
-        if args.moe:
+        if pipe > 1:
+            from .pipeline import (
+                init_llama_pipeline_train_state,
+                place_pipeline_state,
+            )
+
+            state = place_pipeline_state(
+                mesh,
+                init_llama_pipeline_train_state(
+                    jax.random.key(args.seed), model_config, train_config,
+                    n_stages=pipe,
+                ),
+            )
+        elif args.moe:
             from .moe import MoeConfig, init_llama_moe_train_state
 
             moe_config = MoeConfig(n_experts=args.moe_experts,
@@ -502,7 +513,14 @@ def train(args) -> dict:
             save_model_manifest(args.checkpoint_dir, args.family,
                                 model_config, layout=layout)
         if args.resume and latest is not None:
-            state = checkpointer.restore(mesh, state)
+            shardings_fn = None
+            if pipe > 1:
+                from .pipeline import pipeline_state_shardings
+
+                shardings_fn = pipeline_state_shardings
+            state = checkpointer.restore(
+                mesh, state, state_shardings_fn=shardings_fn
+            )
             log.info("Resumed from checkpoint step %d", latest)
 
     if args.lora_rank:
@@ -518,14 +536,22 @@ def train(args) -> dict:
             loss=loss,
         )
     elif pipe > 1:
-        from .pipeline import PipelineConfig, make_pipeline_train_step
+        from .pipeline import (
+            PipelineConfig,
+            make_llama_pipeline_train_step,
+            make_pipeline_train_step,
+        )
 
         pipe_config = PipelineConfig(
             n_microbatches=args.pipe_microbatches,
             schedule=args.pipe_schedule,
         )
-        step_fn = make_pipeline_train_step(mesh, model_config, pipe_config,
-                                           train_config, state)
+        make_pp_step = (
+            make_llama_pipeline_train_step if args.family == "llama"
+            else make_pipeline_train_step
+        )
+        step_fn = make_pp_step(mesh, model_config, pipe_config,
+                               train_config, state)
     elif args.moe and args.family == "llama":
         from .moe import make_llama_moe_train_step
 

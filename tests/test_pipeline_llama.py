@@ -1,0 +1,351 @@
+"""Llama-family pipeline parallelism: the pp-sharded RoPE/GQA/RMSNorm/
+SwiGLU stack must reproduce the plain llama forward exactly, learn under
+both schedules in bf16, and the 1F1B hand-built backward must be
+gradient-equal to autodiff.  Plus the gradient-accumulation composition
+the pipelined batch type needs (``accum_axis=1``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.llama import (
+    LlamaConfig,
+    init_llama_params,
+    llama_forward,
+)
+from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+    PipelineConfig,
+    init_llama_pipeline_params,
+    init_llama_pipeline_train_state,
+    llama_one_f_one_b_value_and_grad,
+    llama_pipeline_forward,
+    llama_pipeline_loss_fn,
+    make_llama_pipeline_train_step,
+    make_pipeline_mesh,
+    pipeline_batch_sharding,
+    place_pipeline_state,
+    stack_llama_layers,
+    unstack_llama_layers,
+)
+from kube_sqs_autoscaler_tpu.workloads.train import TrainConfig
+
+# fp32 so the pipeline/dense comparison is exact (no bf16 rounding skew)
+TINY = LlamaConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=4,
+    d_ff=128, max_seq_len=64, dtype=jnp.float32,
+)
+TINY_BF16 = LlamaConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=4,
+    d_ff=128, max_seq_len=64,
+)
+
+
+def microtokens(m=4, bm=2, seq=16, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (m, bm, seq), 0, TINY.vocab_size, jnp.int32
+    )
+
+
+def as_pipeline_params(params):
+    stacked = dict(params)
+    stacked["stages"] = stack_llama_layers(params)
+    del stacked["layers"]
+    return stacked
+
+
+def test_stack_unstack_roundtrip():
+    params = init_llama_params(jax.random.key(0), TINY)
+    roundtrip = unstack_llama_layers(as_pipeline_params(params))
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    back = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(roundtrip)
+    )
+    assert len(flat) == len(back)
+    for key, leaf in flat:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(back[jax.tree_util.keystr(key)]),
+            err_msg=jax.tree_util.keystr(key),
+        )
+
+
+def test_stage_stack_splits_fused_projections():
+    params = init_llama_params(jax.random.key(0), TINY)
+    stages = stack_llama_layers(params)
+    kv_dim = TINY.n_kv_heads * TINY.head_dim
+    for i in range(TINY.n_layers):
+        fused_kv = np.asarray(params["layers"][i]["wkv"])
+        np.testing.assert_array_equal(
+            np.asarray(stages["wk"][i]), fused_kv[:, :kv_dim]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stages["wv"][i]), fused_kv[:, kv_dim:]
+        )
+        fused_gu = np.asarray(params["layers"][i]["w_gate_up"])
+        np.testing.assert_array_equal(
+            np.asarray(stages["w_gate"][i]), fused_gu[:, : TINY.d_ff]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stages["w_up"][i]), fused_gu[:, TINY.d_ff:]
+        )
+
+
+@pytest.mark.parametrize("pipe", [2, 4])
+def test_llama_pipeline_forward_matches_dense(pipe):
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=pipe)
+    params = init_llama_params(jax.random.key(0), TINY)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    dense = llama_forward(params, tokens.reshape(4 * bm, 16), TINY)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: llama_pipeline_forward(p, t, TINY, pcfg, mesh)
+    )(
+        as_pipeline_params(params),
+        jax.device_put(tokens, pipeline_batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, TINY.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_llama_pipeline_forward_matches_dense_pp2_tp2():
+    # the llama block's Megatron reduce/promote seams inside the
+    # fully-manual pp x dp x tp body
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              model_parallel=2)
+    params = init_llama_params(jax.random.key(0), TINY)
+    bm = mesh.shape["data"] * 2
+    tokens = microtokens(bm=bm)
+    dense = llama_forward(params, tokens.reshape(4 * bm, 16), TINY)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: llama_pipeline_forward(p, t, TINY, pcfg, mesh)
+    )(
+        as_pipeline_params(params),
+        jax.device_put(tokens, pipeline_batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, TINY.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_llama_windowed_pipeline_forward_matches_dense():
+    # sliding_window rides the per-stage kernel pick (windowed dense on
+    # CPU) — the pipelined windowed forward must equal the flat one
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=4,
+        d_ff=128, max_seq_len=64, sliding_window=8, dtype=jnp.float32,
+    )
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    params = init_llama_params(jax.random.key(2), cfg)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    dense = llama_forward(params, tokens.reshape(4 * bm, 16), cfg)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: llama_pipeline_forward(p, t, cfg, pcfg, mesh)
+    )(
+        as_pipeline_params(params),
+        jax.device_put(tokens, pipeline_batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, cfg.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("cfg", [TINY, TINY_BF16], ids=["fp32", "bf16"])
+def test_llama_pipeline_train_step_learns(schedule, cfg):
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=4, schedule=schedule)
+    train_config = TrainConfig(learning_rate=1e-2)
+    state = place_pipeline_state(
+        mesh,
+        init_llama_pipeline_train_state(jax.random.key(0), cfg, train_config,
+                                        n_stages=2),
+    )
+    step_fn = make_llama_pipeline_train_step(mesh, cfg, pcfg, train_config,
+                                             state)
+    tokens = jax.device_put(microtokens(bm=4), pipeline_batch_sharding(mesh))
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def _grads_allclose(grads, ref_grads, rtol=2e-4, atol=2e-6):
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(grads)
+    )
+    assert len(flat_ref) == len(flat)
+    for key, ref in flat_ref:
+        name = jax.tree_util.keystr(key)
+        np.testing.assert_allclose(
+            np.asarray(flat[name], np.float32), np.asarray(ref, np.float32),
+            rtol=rtol, atol=atol, err_msg=name,
+        )
+
+
+@pytest.mark.parametrize("pipe,bm", [(2, 4), (4, 2)])
+def test_llama_1f1b_grads_match_gpipe_autodiff(pipe, bm):
+    # the claim in llama_one_f_one_b_value_and_grad's docstring:
+    # gradient-equal to jax.value_and_grad(llama_pipeline_loss_fn)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=pipe)
+    params = as_pipeline_params(init_llama_params(jax.random.key(0), TINY))
+    pcfg = PipelineConfig(n_microbatches=4, schedule="1f1b")
+    tokens = jax.device_put(microtokens(bm=bm), pipeline_batch_sharding(mesh))
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: llama_pipeline_loss_fn(p, t, TINY, pcfg, mesh)
+        )
+    )(params, tokens)
+    loss, grads = jax.jit(
+        lambda p, t: llama_one_f_one_b_value_and_grad(p, t, TINY, pcfg, mesh)
+    )(params, tokens)
+
+    assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+    _grads_allclose(grads, ref_grads)
+
+
+def test_llama_1f1b_untied_readout_grads():
+    # an untied lm_head (the HF-import layout) gets its own gradient and
+    # leaves the embedding gradient to the lookup path alone
+    params = as_pipeline_params(init_llama_params(jax.random.key(0), TINY))
+    params["lm_head"] = jax.random.normal(
+        jax.random.key(9), (TINY.vocab_size, TINY.d_model), jnp.float32
+    ) * 0.02
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=4, schedule="1f1b")
+    tokens = jax.device_put(microtokens(bm=4), pipeline_batch_sharding(mesh))
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: llama_pipeline_loss_fn(p, t, TINY, pcfg, mesh)
+        )
+    )(params, tokens)
+    loss, grads = jax.jit(
+        lambda p, t: llama_one_f_one_b_value_and_grad(p, t, TINY, pcfg, mesh)
+    )(params, tokens)
+
+    assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+    assert "lm_head" in grads
+    _grads_allclose(grads, ref_grads)
+
+
+# ------------------------------------------------ grad accumulation
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_llama_pipeline_grad_accum_matches_single(schedule):
+    # one step with grad_accum=2 must equal one step on the same total
+    # batch with grad_accum=1 (fp32; the accumulation axis is the batch
+    # axis of the [M, B_m, S] pipelined batch, not the microbatch axis)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=4, schedule=schedule)
+    # bm=8: each accum=2 chunk keeps 4 rows — divisible by the dp axis
+    tokens = jax.device_put(microtokens(bm=8), pipeline_batch_sharding(mesh))
+
+    def one_step(accum):
+        train_config = TrainConfig(learning_rate=1e-2, grad_accum=accum)
+        state = place_pipeline_state(
+            mesh,
+            init_llama_pipeline_train_state(
+                jax.random.key(0), TINY, train_config, n_stages=2
+            ),
+        )
+        step_fn = make_llama_pipeline_train_step(
+            mesh, TINY, pcfg, train_config, state
+        )
+        state, loss = step_fn(state, tokens)
+        return state, float(loss)
+
+    state1, loss1 = one_step(1)
+    state2, loss2 = one_step(2)
+    assert loss2 == pytest.approx(loss1, rel=1e-5)
+    # fp32 reassociation (chunked grad sums + Adam's rsqrt) leaves a few
+    # ulp-level stragglers; the math is the same
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-3, atol=1e-4,
+        ),
+        state1["params"], state2["params"],
+    )
+
+
+def test_gpt_pipeline_grad_accum_learns():
+    # the gpt family through the same accum_axis=1 path
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        init_pipeline_train_state,
+        make_pipeline_train_step,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=4, d_ff=128,
+        max_seq_len=64,
+    )
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=2, schedule="1f1b")
+    train_config = TrainConfig(learning_rate=1e-2, grad_accum=2)
+    state = place_pipeline_state(
+        mesh,
+        init_pipeline_train_state(jax.random.key(0), cfg, train_config,
+                                  n_stages=2),
+    )
+    step_fn = make_pipeline_train_step(mesh, cfg, pcfg, train_config, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (2, 8, 16), 0, 256, jnp.int32),
+        pipeline_batch_sharding(mesh),
+    )
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_grad_accum_requires_divisible_batch():
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        init_pipeline_train_state,
+        make_pipeline_train_step,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=4, d_ff=128,
+        max_seq_len=64,
+    )
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=2)
+    train_config = TrainConfig(grad_accum=3)
+    state = place_pipeline_state(
+        mesh,
+        init_pipeline_train_state(jax.random.key(0), cfg, train_config,
+                                  n_stages=2),
+    )
+    step_fn = make_pipeline_train_step(mesh, cfg, pcfg, train_config, state)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (2, 4, 16), 0, 256, jnp.int32),
+        pipeline_batch_sharding(mesh),
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        step_fn(state, tokens)

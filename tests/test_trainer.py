@@ -119,6 +119,35 @@ def test_trainer_pipeline_1f1b_with_tp_learns():
     assert losses[-1] < losses[0]
 
 
+def test_trainer_llama_pipeline_learns():
+    # the modern family pipelined from the binary: llama x pp2, 1F1B,
+    # with gradient accumulation over the batch axis
+    # batch 16: 2 pipeline microbatches x 2 accum chunks x dp4
+    result = main(TINY_FLAGS + ["--steps", "4", "--family", "llama",
+                                "--n-kv-heads", "2", "--pipe-parallel", "2",
+                                "--pipe-schedule", "1f1b",
+                                "--pipe-microbatches", "2",
+                                "--batch-size", "16",
+                                "--grad-accum", "2", "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_pipeline_checkpoints_and_resumes(tmp_path):
+    # stage-stacked states restore with the pipeline sharding rules (the
+    # flat PARAM_AXES rules would mis-place the leading layer axis)
+    ckpt = str(tmp_path / "ckpt")
+    pp = ["--pipe-parallel", "2", "--pipe-microbatches", "2"]
+    first = main(TINY_FLAGS + pp + ["--steps", "4", "--checkpoint-dir",
+                                    ckpt, "--checkpoint-every", "2"])
+    assert first["final_step"] == 4
+    resumed = main(TINY_FLAGS + pp + ["--steps", "3", "--checkpoint-dir",
+                                      ckpt, "--resume"])
+    assert resumed["final_step"] == 7
+
+
 def test_trainer_pipeline_flag_conflicts_fail_fast():
     with pytest.raises(SystemExit, match="--zigzag"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
