@@ -86,7 +86,8 @@ def main(argv=None) -> None:
         "--continuous", action="store_true",
         help="continuous batching: rolling decode slots that refill as "
              "each message finishes instead of batch-at-a-time (requires "
-             "--generate-tokens >= 1; gpt family, single chip)",
+             "--generate-tokens >= 1; both families, sampling/eos/"
+             "tokenizer/replies supported; single chip)",
     )
     parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
@@ -463,16 +464,11 @@ def main(argv=None) -> None:
         )
 
     if args.continuous:
-        # rolling-slot serving: single-chip gpt decode path (the slot
-        # insertion splices into the per-row cache; mesh-sharded and GQA
-        # variants are batch-mode only for now — fail fast, don't ignore)
-        for flag, bad in (("--family llama", family == "llama"),
-                          ("--model-parallel", bool(args.model_parallel)),
-                          ("--temperature > 0", args.temperature > 0.0),
-                          ("--result-queue-url",
-                           bool(args.result_queue_url)),
-                          ("--tokenizer", bool(args.tokenizer)),
-                          ("--eos-id", service_config.eos_id is not None),
+        # rolling-slot serving: single-chip decode path, both families,
+        # greedy or sampled, eos, tokenizer, replies.  Only the
+        # mesh-sharded variant stays batch-mode (the slot insertion
+        # splices into a local per-row cache) — fail fast, don't ignore
+        for flag, bad in (("--model-parallel", bool(args.model_parallel)),
                           ("--generate-tokens >= 1 required",
                            args.generate_tokens < 1)):
             if bad:
@@ -489,11 +485,17 @@ def main(argv=None) -> None:
             ids = rng.integers(0, model_config.vocab_size, args.seq_len).tolist()
             queue.send_message("demo://queue", json.dumps(ids))
         service_config.queue_url = "demo://queue"
+        result_queue = None
+        if args.result_queue_url:
+            # demo replies land on a second in-memory queue
+            result_queue = FakeMessageQueue()
         if args.continuous:
             from .continuous import ContinuousWorker
 
             cworker = ContinuousWorker(queue, params, model_config,
-                                       service_config)
+                                       service_config, family=family,
+                                       tokenizer=tokenizer,
+                                       result_queue=result_queue)
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
@@ -502,13 +504,13 @@ def main(argv=None) -> None:
                 "Processed %d messages in %.2fs (%.1f msg/s, continuous)",
                 cworker.processed, elapsed, cworker.processed / elapsed,
             )
+            if result_queue is not None:
+                for message in result_queue.receive_messages(
+                        args.result_queue_url, max_messages=2):
+                    log.info("Reply: %.120s", message["Body"])
             if obs is not None:
                 obs.stop()
             return
-        result_queue = None
-        if args.result_queue_url:
-            # demo replies land on a second in-memory queue
-            result_queue = FakeMessageQueue()
         worker = QueueWorker(queue, params, model_config, service_config,
                              tokenizer=tokenizer, result_queue=result_queue,
                              **worker_kwargs)
@@ -538,8 +540,13 @@ def main(argv=None) -> None:
     if args.continuous:
         from .continuous import ContinuousWorker
 
-        cworker = ContinuousWorker(queue, params, model_config,
-                                   service_config)
+        cworker = ContinuousWorker(
+            queue, params, model_config, service_config, family=family,
+            tokenizer=tokenizer,
+            # AWS SQS addresses queues per call by url, so the same
+            # client publishes replies when --result-queue-url is set
+            result_queue=(queue if args.result_queue_url else None),
+        )
         _maybe_serve_metrics(args.metrics_port, cworker)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)
         cworker.run_forever()

@@ -9,7 +9,9 @@ slots by one token, finished slots emit their continuation immediately,
 and new requests are prefilled **into** a free slot while the others keep
 decoding.  The per-row cache machinery from :mod:`.decode` (per-row
 ``length``, per-row write positions, per-row masks) is exactly what makes
-this work.
+this work — and the llama family's compact GQA cache
+(:func:`.llama.init_llama_cache`) has the same per-row shape, so both
+families serve through one slot machine.
 
 TPU shape discipline: there are only two compiled programs —
 
@@ -20,12 +22,22 @@ TPU shape discipline: there are only two compiled programs —
   ``[1, P]`` batch and ``dynamic_update_slice`` its layer caches into the
   slot's row, set the row's length, and return the first sampled token.
 
+Sampling is :func:`.decode._pick` — the one policy every decode path
+shares (greedy at temperature 0, else temperature/top-k/top-p), keyed
+per engine step from :func:`.service.sampling_keys`.  ``eos_id`` frees a
+slot the moment it fires (the continuous-batching win: the row's cache
+becomes a fresh slot while its batchmates keep decoding); outputs are
+padded with ``eos_id`` to the token budget, exactly like
+:func:`.decode.generate`'s post-eos padding, so the greedy
+outputs-equal-per-request invariant holds verbatim.
+
 The reference has no serving at all (SURVEY.md §2); this is the TPU-shop
 shape of the queue-consumer its README deploys.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
 from dataclasses import dataclass, field
 from functools import partial
@@ -36,13 +48,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import _pick, init_cache, prefill
-from .model import ModelConfig
 
 log = logging.getLogger(__name__)
 
 
 @partial(
-    jax.jit, static_argnames=("config", "prompt_len"), donate_argnums=(1,)
+    jax.jit,
+    static_argnames=("config", "prompt_len", "family", "temperature",
+                     "top_k", "top_p"),
+    donate_argnums=(1,),
 )
 def _insert_row(
     params: dict,
@@ -50,17 +64,29 @@ def _insert_row(
     row: jax.Array,
     prompt: jax.Array,
     length: jax.Array,
-    config: ModelConfig,
+    key: jax.Array | None,
+    config: Any,
     prompt_len: int,
+    family: str = "gpt",
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> tuple[dict, jax.Array]:
     """Prefill ``prompt`` (int32 ``[prompt_len]``, right-padded to the
     static bucket) and splice it into slot ``row`` of ``cache``.
 
     Returns ``(cache, first_token)`` — the slot's length is the prompt's
-    real length and its first greedy continuation token is ready to feed
-    the next ``decode_step``.
+    real length and its first continuation token (greedy or sampled by
+    the shared ``_pick`` policy with ``key``) is ready to feed the next
+    ``decode_step``.  ``family`` picks the prefill: the gpt path or the
+    llama GQA path — the splice is layout-agnostic (both caches are
+    ``[B, H, S, D]`` per layer with a per-row ``length``).
     """
-    logits, row_cache = prefill(
+    if family == "llama":
+        from .llama import llama_prefill as prefill_fn
+    else:
+        prefill_fn = prefill
+    logits, row_cache = prefill_fn(
         params, prompt[None], config, lengths=length[None]
     )
     new_layers = []
@@ -78,7 +104,7 @@ def _insert_row(
     lengths = jax.lax.dynamic_update_index_in_dim(
         cache["length"], length, row, 0
     )
-    first = _pick(logits, None, 0.0)[0]
+    first = _pick(logits, key, temperature, top_k, top_p)[0]
     return {"layers": new_layers, "length": lengths}, first
 
 
@@ -87,6 +113,7 @@ class _Slot:
     busy: bool = False
     produced: list = field(default_factory=list)
     budget: int = 0
+    done: bool = False  # hit eos before the budget (frees this step)
     payload: Any = None  # caller's per-request context (receipt handle...)
 
 
@@ -94,19 +121,30 @@ class ContinuousBatcher:
     """The slot machine: submit prompts, step the batch, collect results.
 
     Queue-agnostic and synchronous — drive it from anything that produces
-    ``(token_ids, payload)`` requests.  Greedy decoding (the generate-mode
-    worker's semantics).  Outputs are exactly what :func:`.decode.generate`
-    produces for each prompt alone (pinned by test): continuous batching
-    changes *scheduling*, never results.
+    ``(token_ids, payload)`` requests.  Both model families (``family`` —
+    the llama GQA cache is per-row just like the gpt one), greedy or
+    sampled decoding (``temperature``/``top_k``/``top_p`` through the
+    shared ``_pick`` policy, keyed per engine step), ``eos_id``
+    termination per slot.  Greedy outputs are exactly what
+    :func:`.decode.generate` / :func:`.llama.llama_generate` produce for
+    each prompt alone, eos padding included (pinned by test): continuous
+    batching changes *scheduling*, never results.
     """
 
     def __init__(
         self,
         params: Any,
-        config: ModelConfig,
+        config: Any,
         batch_size: int,
         prompt_len: int,
         generate_tokens: int,
+        *,
+        family: str = "gpt",
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int | None = None,
+        sample_seed: int = 0,
     ) -> None:
         if prompt_len + generate_tokens > config.max_seq_len:
             raise ValueError(
@@ -114,26 +152,59 @@ class ContinuousBatcher:
                 f"{prompt_len + generate_tokens} exceeds max_seq_len="
                 f"{config.max_seq_len}"
             )
+        if family not in ("gpt", "llama"):
+            raise ValueError(f"unknown family {family!r}")
+        # unconditional (decode._pick re-checks at trace time, but that
+        # would fire inside a worker's never-dies retry loop; greedy mode
+        # must reject bad knobs at construction too)
+        if top_k < 0:
+            raise ValueError(f"top_k={top_k} must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p={top_p} must be in (0, 1]")
         self.params = params
         self.config = config
+        self.family = family
         self.prompt_len = prompt_len
         self.generate_tokens = generate_tokens
-        self.cache = init_cache(config, batch_size)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        if family == "llama":
+            from .llama import init_llama_cache
+
+            self.cache = init_llama_cache(config, batch_size)
+        else:
+            self.cache = init_cache(config, batch_size)
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token for the next decode step
         self._current = jnp.zeros((batch_size,), jnp.int32)
+        # one PRNG key per engine step / insert (greedy: no keys at all,
+        # so the compiled programs take a None operand)
+        if temperature > 0.0:
+            from .service import sampling_keys
+
+            self._keys = sampling_keys(sample_seed)
+        else:
+            self._keys = itertools.repeat(None)
         self._decode = self._make_decode_step()
 
     def _make_decode_step(self):
-        from .decode import decode_step
+        if self.family == "llama":
+            from .llama import llama_decode_step as step_fn
+        else:
+            from .decode import decode_step as step_fn
+
+        config = self.config
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
 
         # donate the cache: self.cache is reassigned from the result every
         # call, so the multi-layer KV buffers are reused in place instead
         # of copied per generated token (same as compile_serving_fns)
         @partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, tokens):
-            logits, cache = decode_step(params, cache, tokens, self.config)
-            return cache, _pick(logits, None, 0.0)
+        def step(params, cache, tokens, key):
+            logits, cache = step_fn(params, cache, tokens, config)
+            return cache, _pick(logits, key, temperature, top_k, top_p)
 
         return step
 
@@ -161,41 +232,59 @@ class ContinuousBatcher:
         length = max(1, real.size)
         self.cache, first = _insert_row(
             self.params, self.cache, jnp.asarray(row, jnp.int32),
-            jnp.asarray(ids), jnp.asarray(length, jnp.int32), self.config,
-            self.prompt_len,
+            jnp.asarray(ids), jnp.asarray(length, jnp.int32),
+            next(self._keys), self.config, self.prompt_len,
+            family=self.family, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p,
         )
+        first = int(first)
         self._current = self._current.at[row].set(first)
         slot = self.slots[row]
         slot.busy = True
         slot.produced = [first]
         slot.budget = self.generate_tokens
+        slot.done = self.eos_id is not None and first == self.eos_id
         slot.payload = payload
         return row
+
+    def _needs_decode(self, slot: _Slot) -> bool:
+        return slot.busy and not slot.done and len(slot.produced) < slot.budget
 
     def step(self) -> list[tuple[Any, np.ndarray]]:
         """Advance every active slot one token; return finished requests
         as ``(payload, continuation_tokens)`` pairs (their slots are free
-        again on return).  No-op when nothing is active."""
+        again on return).  Finished = budget reached or eos emitted;
+        either way the tokens are padded with ``eos_id`` to the budget
+        (matching ``generate``'s post-eos padding).  No-op when nothing
+        is active."""
         if self.active == 0:
             return []
         finished = []
-        # rows whose budget is a single token never need a decode step
-        pending_decode = any(
-            s.busy and len(s.produced) < s.budget for s in self.slots
-        )
-        if pending_decode:
+        # rows whose budget is a single token (or that already hit eos)
+        # never need a decode step
+        if any(self._needs_decode(s) for s in self.slots):
             self.cache, nxt = self._decode(
-                self.params, self.cache, self._current
+                self.params, self.cache, self._current, next(self._keys)
             )
             nxt_host = np.asarray(nxt)
             for row, slot in enumerate(self.slots):
-                if slot.busy and len(slot.produced) < slot.budget:
-                    slot.produced.append(int(nxt_host[row]))
+                if self._needs_decode(slot):
+                    token = int(nxt_host[row])
+                    slot.produced.append(token)
+                    if self.eos_id is not None and token == self.eos_id:
+                        slot.done = True
             self._current = nxt
         for row, slot in enumerate(self.slots):
-            if slot.busy and len(slot.produced) >= slot.budget:
+            if slot.busy and (slot.done or len(slot.produced) >= slot.budget):
+                tokens = slot.produced
+                if len(tokens) < slot.budget:
+                    # eos fired early: the slot frees NOW; pad the reply
+                    # to the static budget exactly like generate does
+                    tokens = tokens + [self.eos_id] * (
+                        slot.budget - len(tokens)
+                    )
                 finished.append(
-                    (slot.payload, np.asarray(slot.produced, np.int32))
+                    (slot.payload, np.asarray(tokens, np.int32))
                 )
                 self.slots[row] = _Slot()
         return finished
@@ -207,28 +296,53 @@ class ContinuousWorker:
     Same at-least-once contract as :class:`.service.QueueWorker`: a
     message is deleted only after its continuation is fully generated.
     Unlike the batch worker, a slow batch never blocks fresh messages —
-    slots refill the moment they finish.
+    slots refill the moment they finish (and an ``eos_id`` frees a slot
+    early).  Full reply parity with the batch worker: ``tokenizer``
+    turns it text-in/text-out, ``result_queue`` +
+    ``ServiceConfig.result_queue_url`` publish one JSON reply per
+    message ({"tokens": [...]} trimmed at eos, + {"text": ...} with a
+    tokenizer, + the request's MessageId as "request_id").
     """
 
     def __init__(
         self,
         queue,
         params: Any,
-        model_config: ModelConfig,
+        model_config: Any,
         service_config,
+        *,
+        family: str = "gpt",
+        tokenizer=None,
+        result_queue=None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
                 "ContinuousWorker is generate-mode serving; set "
                 "ServiceConfig.generate_tokens >= 1"
             )
+        if service_config.result_queue_url and result_queue is None:
+            # same explicit-client rule as QueueWorker: in-memory queues
+            # ignore urls, so defaulting replies onto the input queue
+            # object would self-feed
+            raise ValueError(
+                "result_queue_url is set but no result_queue client was "
+                "given"
+            )
         self.queue = queue
         self.config = service_config
+        self.tokenizer = tokenizer
+        self.result_queue = result_queue
         self.batcher = ContinuousBatcher(
             params, model_config,
             batch_size=service_config.batch_size,
             prompt_len=service_config.seq_len,
             generate_tokens=service_config.generate_tokens,
+            family=family,
+            temperature=service_config.temperature,
+            top_k=service_config.top_k,
+            top_p=service_config.top_p,
+            eos_id=service_config.eos_id,
+            sample_seed=service_config.sample_seed,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as
@@ -244,9 +358,33 @@ class ContinuousWorker:
     # billed ReceiveMessage per generated token would be absurd on SQS
     POLL_BACKOFF_CYCLES = 16
 
+    def _settle(self, message, tokens: np.ndarray | None) -> None:
+        """Reply (when configured) and delete one finished message.
+        ``tokens=None`` marks a malformed body: error reply, no result."""
+        import json
+
+        from .service import build_token_reply, request_id
+
+        if self.config.result_queue_url:
+            if tokens is None:
+                payload = {"error": "malformed body"}
+            else:
+                payload = build_token_reply(
+                    tokens, self.config.eos_id, self.tokenizer
+                )
+            payload["request_id"] = request_id(message)
+            # reply BEFORE deleting the input (at-least-once: consumers
+            # may see duplicates, never lose a result)
+            self.result_queue.send_message(
+                self.config.result_queue_url, json.dumps(payload)
+            )
+        self.queue.delete_message(
+            self.config.queue_url, message["ReceiptHandle"]
+        )
+
     def _refill(self) -> int:
         """Pull up to free-slot-count messages and prefill them in."""
-        import json
+        from .service import parse_request_body
 
         free = len(self.batcher.free_slots)
         if not free:
@@ -262,19 +400,14 @@ class ContinuousWorker:
         if not messages and self.batcher.active:
             self._poll_backoff = self.POLL_BACKOFF_CYCLES
         for message in messages:
-            try:
-                ids = np.asarray(
-                    json.loads(message["Body"]), np.int32
-                ).reshape(-1)
-            except Exception:
-                log.error("Dropping malformed message body: %.64r",
-                          message["Body"])
-                # poison messages are consumed, not redelivered forever
-                self.queue.delete_message(
-                    self.config.queue_url, message["ReceiptHandle"]
-                )
+            ids = parse_request_body(message["Body"], self.tokenizer)
+            if ids is None:
+                # poison messages are consumed (with an error reply when
+                # replies are on), not redelivered forever — and not
+                # counted as processed work
+                self._settle(message, None)
                 continue
-            self.batcher.submit(ids, payload=message["ReceiptHandle"])
+            self.batcher.submit(ids, payload=message)
         return len(messages)
 
     def run_once(self) -> int:
@@ -282,8 +415,8 @@ class ContinuousWorker:
         finished requests.  Returns messages completed this cycle."""
         self._refill()
         done = self.batcher.step()
-        for receipt, _tokens in done:
-            self.queue.delete_message(self.config.queue_url, receipt)
+        for message, tokens in done:
+            self._settle(message, tokens)
         if done:
             self._poll_backoff = 0  # a slot just freed: poll right away
         self.processed += len(done)

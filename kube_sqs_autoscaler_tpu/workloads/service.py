@@ -61,6 +61,69 @@ def sampling_keys(seed: int):
         yield jax.random.key(seed + i)
 
 
+def parse_request_body(body: str, tokenizer=None) -> np.ndarray | None:
+    """One message body -> int32 ids, or ``None`` for a malformed
+    (dropped) body.  Id-array JSON always works; with a tokenizer, plain
+    text, a JSON string, or ``{"text": ...}`` JSON encodes (the two JSON
+    text forms encode the same characters).  The one request-parsing
+    policy — shared by the batch worker and the continuous worker.
+    """
+    try:
+        payload = json.loads(body)
+    except Exception:
+        payload = None
+    if payload is not None:
+        if tokenizer is not None:
+            text = None
+            if isinstance(payload, dict) and isinstance(
+                    payload.get("text"), str):
+                text = payload["text"]
+            elif isinstance(payload, str):
+                text = payload
+            if text is not None:
+                return np.asarray(
+                    tokenizer.encode(text), np.int32
+                ).reshape(-1)
+        try:
+            return np.asarray(payload, np.int32).reshape(-1)
+        except Exception:
+            pass
+    if tokenizer is not None:
+        try:
+            return np.asarray(tokenizer.encode(body), np.int32).reshape(-1)
+        except Exception:
+            pass
+    # a body that is valid JSON but not an integer array ('"abc"' without
+    # a tokenizer, nested lists of strings) is dropped like non-JSON, not
+    # allowed to crash the worker — the message still gets deleted, so
+    # poison messages are consumed rather than redelivered forever; its
+    # reply (when replies are on) is an error payload, never a
+    # fabricated result
+    log.error("Dropping malformed message body: %.64r", body)
+    return None
+
+
+def build_token_reply(tokens, eos_id: int | None, tokenizer=None) -> dict:
+    """One generate-mode reply payload: ``{"tokens": [...]}`` trimmed at
+    ``eos_id`` (the reply carries the finished sequence, not the eos
+    padding after it), plus ``{"text": ...}`` when a tokenizer decodes.
+    The one reply-construction policy — shared by the batch worker and
+    the continuous worker."""
+    ids = list(int(t) for t in tokens)
+    if eos_id is not None and eos_id in ids:
+        ids = ids[: ids.index(eos_id)]
+    payload = {"tokens": ids}
+    if tokenizer is not None:
+        payload["text"] = tokenizer.decode(ids)
+    return payload
+
+
+def request_id(message: dict) -> str:
+    """The correlation id a reply carries: the request's MessageId (falls
+    back to the receipt handle for queues that don't assign ids)."""
+    return message.get("MessageId", message["ReceiptHandle"])
+
+
 class MessageQueue(Protocol):
     """What a worker needs from a queue (satisfied by
     :class:`~..metrics.fake.FakeMessageQueue` and
@@ -231,45 +294,7 @@ class QueueWorker:
         return min(bucket, self.config.seq_len)
 
     def _parse_body(self, body: str) -> np.ndarray | None:
-        """One body -> int32 ids, or ``None`` for a malformed (dropped)
-        body.  Id-array JSON always works; with a tokenizer, plain text,
-        a JSON string, or ``{"text": ...}`` JSON encodes (the two JSON
-        text forms encode the same characters)."""
-        try:
-            payload = json.loads(body)
-        except Exception:
-            payload = None
-        if payload is not None:
-            if self.tokenizer is not None:
-                text = None
-                if isinstance(payload, dict) and isinstance(
-                        payload.get("text"), str):
-                    text = payload["text"]
-                elif isinstance(payload, str):
-                    text = payload
-                if text is not None:
-                    return np.asarray(
-                        self.tokenizer.encode(text), np.int32
-                    ).reshape(-1)
-            try:
-                return np.asarray(payload, np.int32).reshape(-1)
-            except Exception:
-                pass
-        if self.tokenizer is not None:
-            try:
-                return np.asarray(
-                    self.tokenizer.encode(body), np.int32
-                ).reshape(-1)
-            except Exception:
-                pass
-        # a body that is valid JSON but not an integer array ('"abc"'
-        # without a tokenizer, nested lists of strings) is dropped like
-        # non-JSON, not allowed to crash the worker — the message still
-        # gets deleted after the batch, so poison messages are consumed
-        # rather than redelivered forever; its reply (when replies are
-        # on) is an error payload, never a fabricated result
-        log.error("Dropping malformed message body: %.64r", body)
-        return None
+        return parse_request_body(body, self.tokenizer)
 
     def _batch_tokens(
         self, bodies: list[str]
@@ -318,19 +343,11 @@ class QueueWorker:
             produced.block_until_ready()
             results = None
             if self.config.result_queue_url:
-                rows = np.asarray(produced)[: len(messages)]
-                results = []
-                for row in rows:
-                    ids = row.tolist()
-                    if self.config.eos_id is not None and \
-                            self.config.eos_id in ids:
-                        # reply carries the finished sequence, not the
-                        # eos padding after it
-                        ids = ids[: ids.index(self.config.eos_id)]
-                    payload = {"tokens": ids}
-                    if self.tokenizer is not None:
-                        payload["text"] = self.tokenizer.decode(ids)
-                    results.append(payload)
+                results = [
+                    build_token_reply(row, self.config.eos_id,
+                                      self.tokenizer)
+                    for row in np.asarray(produced)[: len(messages)]
+                ]
         else:
             # greedy next token per sequence, read at each row's last
             # VALID position — never the pad slot at -1
@@ -356,9 +373,7 @@ class QueueWorker:
             for i, (message, payload) in enumerate(zip(messages, results)):
                 if not valid[i]:
                     payload = {"error": "malformed body"}
-                payload["request_id"] = message.get(
-                    "MessageId", message["ReceiptHandle"]
-                )
+                payload["request_id"] = request_id(message)
                 self.result_queue.send_message(
                     self.config.result_queue_url, json.dumps(payload)
                 )
