@@ -310,11 +310,6 @@ def main(argv=None) -> None:
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
     if mesh is not None:
-        if service_config.eos_id is not None:
-            raise SystemExit(
-                "--eos-id is not supported with --model-parallel (the "
-                "sharded generate contract has no eos slot yet)"
-            )
         from .train import make_forward_step
 
         if family == "llama":
@@ -335,7 +330,8 @@ def main(argv=None) -> None:
             "forward_fn": fwd,
             "generate_fn": lambda p, t, n, lengths: gen(
                 p, t, next(keys), lengths, n, args.temperature,
-                service_config.top_k, service_config.top_p
+                service_config.top_k, service_config.top_p,
+                service_config.eos_id,
             ),
         }
     elif family == "llama":

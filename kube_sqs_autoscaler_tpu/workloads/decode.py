@@ -474,15 +474,18 @@ def compile_serving_fns(
     bound): ``prefill_fn(params, tokens)``,
     ``decode_fn(params, cache, token)``, and
     ``generate_fn(params, prompt, num_tokens, temperature, rng, lengths,
-    top_k, top_p)``.
+    top_k, top_p, eos_id)``.
 
     The returned generate fn's signature is ``(params, prompt, rng,
-    lengths, num_tokens, temperature=0.0, top_k=0, top_p=1.0)``, all
-    positional (pjit rejects kwargs when in_shardings is set); rng is
-    required — pass any key under greedy (temperature=0 ignores it) — and
-    so are ``lengths`` (pass the full prompt length per row when nothing
-    is padded), so ragged and full batches share the compiled layout.
-    ``top_k``/``top_p`` are static (see ``_pick``).
+    lengths, num_tokens, temperature=0.0, top_k=0, top_p=1.0,
+    eos_id=None)``, all positional (pjit rejects kwargs when in_shardings
+    is set); rng is required — pass any key under greedy (temperature=0
+    ignores it) — and so are ``lengths`` (pass the full prompt length per
+    row when nothing is padded), so ragged and full batches share the
+    compiled layout.  ``top_k``/``top_p``/``eos_id`` are static (see
+    ``_pick``; eos pins a finished row's later positions to the id, same
+    contract as single-chip :func:`generate` — the done mask is per-row
+    elementwise, so it shards over ``data`` like every other row state).
     """
     from .train import param_shardings
 
@@ -511,13 +514,14 @@ def compile_serving_fns(
     )
 
     def _generate(params, prompt, rng, lengths, num_tokens,
-                  temperature=0.0, top_k=0, top_p=1.0):
+                  temperature=0.0, top_k=0, top_p=1.0, eos_id=None):
         return generate_fn(params, prompt, num_tokens, temperature, rng,
-                           lengths, top_k, top_p)
+                           lengths, top_k, top_p, eos_id)
 
     generate_jit_fn = jax.jit(
         _generate,
-        static_argnames=("num_tokens", "temperature", "top_k", "top_p"),
+        static_argnames=("num_tokens", "temperature", "top_k", "top_p",
+                         "eos_id"),
         in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P()),
                       tokens_1d),
         out_shardings=tokens_2d,
@@ -537,10 +541,10 @@ def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
         partial(prefill, config=config),
         partial(decode_step, config=config),
         lambda params, prompt, num_tokens, temperature, rng, lengths,
-               top_k, top_p:
+               top_k, top_p, eos_id:
             generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
-                top_k=top_k, top_p=top_p,
+                top_k=top_k, top_p=top_p, eos_id=eos_id,
             ),
     )

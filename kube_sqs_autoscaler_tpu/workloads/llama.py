@@ -889,11 +889,11 @@ def make_llama_serving_fns(mesh, config: LlamaConfig, params: dict):
         partial(llama_prefill, config=config),
         partial(llama_decode_step, config=config),
         lambda params, prompt, num_tokens, temperature, rng, lengths,
-               top_k, top_p:
+               top_k, top_p, eos_id:
             llama_generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
-                top_k=top_k, top_p=top_p,
+                top_k=top_k, top_p=top_p, eos_id=eos_id,
             ),
     )
 

@@ -275,6 +275,31 @@ def test_sharded_serving_matches_single_device(params):
     )
 
 
+def test_sharded_generate_eos_matches_single_device(params):
+    # eos through the sharded contract: identical to single-chip generate
+    # with the same eos, finished rows pinned to the id (VERDICT r3 #4:
+    # eos was previously rejected under --model-parallel)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    _, _, generate_fn = make_serving_fns(mesh, TINY, params)
+    prompt = prompt_tokens(batch=4)
+    lengths = jnp.full((prompt.shape[0],), prompt.shape[1], jnp.int32)
+
+    plain = np.asarray(
+        generate_fn(params, prompt, jax.random.key(0), lengths, 6)
+    )
+    eos = int(plain[0, 1])  # fires early for row 0 by construction
+    expected = np.asarray(generate(
+        params, prompt, 6, TINY, eos_id=eos
+    ))
+    got = np.asarray(generate_fn(
+        params, prompt, jax.random.key(0), lengths, 6, 0.0, 0, 1.0, eos
+    ))
+    np.testing.assert_array_equal(got, expected)
+    row = got[0]
+    hits = np.flatnonzero(row == eos)
+    assert hits.size and (row[hits[0]:] == eos).all()
+
+
 def test_serving_mesh_rejects_seq_axis(params):
     mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
     with pytest.raises(ValueError, match="seq"):
