@@ -187,6 +187,64 @@ class TrainCheckpointer:
         )
         return self._ckpt.restore(self._path(step), targets)
 
+    def restore_lora(
+        self, mesh: Mesh, reference_state: dict, step: int | None = None
+    ) -> dict:
+        """Resume a LoRA run: partial-restore ONLY the adapter train
+        state (+ step) from a :func:`.lora.lora_checkpoint_state`-shaped
+        checkpoint.  The merged ``params`` stay on disk — the frozen
+        base is rebuilt by the trainer from the run's own seed or HF
+        source, so resume I/O is the (tiny) adapters, not the model.
+        ``reference_state`` is a fresh ``init_lora_train_state`` result
+        supplying structure/shapes/dtypes; adapters and moments come
+        back replicated (their placement by design).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        rep = NamedSharding(mesh, PartitionSpec())
+        item = {
+            "lora": {
+                "adapters": reference_state["adapters"],
+                "opt_state": reference_state["opt_state"],
+            },
+            "step": reference_state["step"],
+        }
+        restore_args = jax.tree.map(
+            lambda leaf: ocp.ArrayRestoreArgs(
+                sharding=rep, global_shape=jax.numpy.shape(leaf),
+                dtype=leaf.dtype,
+            ),
+            item,
+        )
+        try:
+            restored = ocp.PyTreeCheckpointer().restore(
+                self._path(step),
+                args=ocp.args.PyTreeRestore(
+                    item=item,
+                    restore_args=restore_args,
+                    partial_restore=True,
+                ),
+            )
+        except Exception as err:
+            # the likely cause is a LoRA checkpoint written before
+            # adapter-state saving existed (merged params + step only):
+            # surface one clear line instead of an orbax pytree error
+            raise ValueError(
+                f"step {step} under {self.directory} has no restorable "
+                "'lora' adapter subtree — checkpoints from before "
+                "adapter-state saving cannot be resumed (restart the "
+                f"fine-tune, or serve their merged weights): {err}"
+            ) from err
+        return {
+            "adapters": restored["lora"]["adapters"],
+            "opt_state": restored["lora"]["opt_state"],
+            "step": restored["step"],
+        }
+
     def restore_params(
         self, mesh: Mesh, family: str, config: Any, step: int | None = None,
         layout: dict | None = None,

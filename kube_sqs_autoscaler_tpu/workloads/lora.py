@@ -154,6 +154,7 @@ def make_lora_train_step(
     import optax
 
     from .train import (
+        accumulate_value_and_grad,
         batch_sharding,
         make_optimizer,
         mesh_attention_fn,
@@ -174,10 +175,14 @@ def make_lora_train_step(
             attention_fn=attention_fn,
         )
 
+    # grad_accum composes here like everywhere else: the shared fp32
+    # chunked scan, accumulating only the (tiny) adapter gradients
+    compute_grads = accumulate_value_and_grad(
+        jax.value_and_grad(adapter_loss), train_config.grad_accum
+    )
+
     def train_step(state, tokens):
-        loss_value, grads = jax.value_and_grad(adapter_loss)(
-            state["adapters"], tokens
-        )
+        loss_value, grads = compute_grads(state["adapters"], tokens)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["adapters"]
         )
@@ -216,3 +221,25 @@ def init_lora_train_state(
         "opt_state": opt_state,
         "step": jnp.zeros((), jnp.int32),
     }
+
+
+def lora_checkpoint_state(
+    frozen_params: dict, state: dict, lora: LoraConfig
+) -> dict:
+    """The on-disk form of a LoRA run: MERGED weights under ``params``
+    (so the serving worker's partial ``params`` restore and
+    ``restore_params`` work on LoRA checkpoints unchanged) plus the
+    adapter train state under ``lora`` — what resume actually needs.
+    The frozen base itself is NOT stored: it is reproducible from the
+    run's own seed or HF checkpoint, and merged = base + delta would
+    store it redundantly anyway."""
+    return {
+        "params": merge_lora(frozen_params, state["adapters"], lora),
+        "step": state["step"],
+        "lora": {
+            "adapters": state["adapters"],
+            "opt_state": state["opt_state"],
+        },
+    }
+
+

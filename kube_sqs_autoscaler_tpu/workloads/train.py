@@ -403,6 +403,60 @@ def place_state(
     )
 
 
+def accumulate_value_and_grad(vag: Any, accum: int, accum_axis: int = 0):
+    """Wrap ``vag(params, tokens) -> (loss, grads)`` in fp32 chunked
+    gradient accumulation over ``accum`` microbatches (``accum == 1``
+    returns ``vag`` untouched).
+
+    Chunks interleave along ``accum_axis`` — microbatch ``j`` takes rows
+    ``≡ j (mod accum)`` — so each data-parallel shard contributes evenly
+    to every microbatch and the split stays shard-local.  One
+    ``lax.scan``, so the compiled program is a single XLA module
+    regardless of the count.  The one accumulation implementation for
+    every step variant (dense/llama/moe objectives, the pipeline's
+    custom 1F1B backward via ``accum_axis=1``, LoRA's adapter-only
+    backward).
+    """
+    if accum == 1:
+        return vag
+
+    def wrapped(params, tokens):
+        ax = accum_axis
+        n = tokens.shape[ax]
+        if n % accum:
+            raise ValueError(
+                f"batch axis {ax} (size {n}) not divisible by "
+                f"grad_accum={accum}"
+            )
+        shape = tokens.shape
+        micro = jnp.moveaxis(
+            tokens.reshape(*shape[:ax], n // accum, accum, *shape[ax + 1:]),
+            ax + 1, 0,
+        )
+
+        def one(carry, microbatch):
+            loss_sum, grad_sum = carry
+            l, g = vag(params, microbatch)
+            # fp32 accumulation regardless of the grad dtype
+            grad_sum = jax.tree.map(
+                lambda acc, grad: acc + grad.astype(jnp.float32), grad_sum, g
+            )
+            return (loss_sum + l, grad_sum), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            one, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        grads = jax.tree.map(
+            lambda g, p: (g / accum).astype(p.dtype), grad_sum, params
+        )
+        return loss_sum / accum, grads
+
+    return wrapped
+
+
 def make_train_step(
     mesh: Mesh,
     model_config: ModelConfig,
@@ -447,8 +501,6 @@ def make_train_step(
         )
     # custom losses opt into remat themselves (forward's remat flag)
 
-    accum = train_config.grad_accum
-
     def vag(params, tokens):
         if value_and_grad_fn is not None:
             return value_and_grad_fn(params, tokens)
@@ -456,44 +508,9 @@ def make_train_step(
             params, tokens, attention_fn=attention_fn
         )
 
-    def compute_grads(params, tokens):
-        if accum == 1:
-            return vag(params, tokens)
-        ax = accum_axis
-        n = tokens.shape[ax]
-        if n % accum:
-            raise ValueError(
-                f"batch axis {ax} (size {n}) not divisible by "
-                f"grad_accum={accum}"
-            )
-        # interleave: microbatch j takes rows ≡ j (mod accum) along the
-        # accumulation axis, so each data-parallel shard contributes
-        # evenly to every microbatch and the split stays shard-local
-        shape = tokens.shape
-        micro = jnp.moveaxis(
-            tokens.reshape(*shape[:ax], n // accum, accum, *shape[ax + 1:]),
-            ax + 1, 0,
-        )
-
-        def one(carry, microbatch):
-            loss_sum, grad_sum = carry
-            l, g = vag(params, microbatch)
-            # fp32 accumulation regardless of the grad dtype
-            grad_sum = jax.tree.map(
-                lambda acc, grad: acc + grad.astype(jnp.float32), grad_sum, g
-            )
-            return (loss_sum + l, grad_sum), None
-
-        zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
-        )
-        (loss_sum, grad_sum), _ = jax.lax.scan(
-            one, (jnp.zeros((), jnp.float32), zeros), micro
-        )
-        grads = jax.tree.map(
-            lambda g, p: (g / accum).astype(p.dtype), grad_sum, params
-        )
-        return loss_sum / accum, grads
+    compute_grads = accumulate_value_and_grad(
+        vag, train_config.grad_accum, accum_axis
+    )
 
     def train_step(state, tokens):
         loss_value, grads = compute_grads(state["params"], tokens)

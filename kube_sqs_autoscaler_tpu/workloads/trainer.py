@@ -219,12 +219,10 @@ def train(args) -> dict:
         )
     if args.lora_rank:
         # adapters wrap the flat dense params; layouts that restructure
-        # them (stage stacks, expert weights, permuted-order losses) and
-        # adapter-state resume are out of scope — fail fast
+        # them (stage stacks, expert weights, permuted-order losses) are
+        # out of scope — fail fast.  Resume and grad-accum compose.
         for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1),
-                          ("--zigzag", args.zigzag),
-                          ("--resume", args.resume),
-                          ("--grad-accum > 1", args.grad_accum > 1)):
+                          ("--zigzag", args.zigzag)):
             if bad:
                 raise SystemExit(f"--lora-rank does not combine with {flag}")
     if args.hf_checkpoint:
@@ -423,8 +421,8 @@ def train(args) -> dict:
         from .lora import (
             LoraConfig,
             init_lora_train_state,
+            lora_checkpoint_state,
             lora_param_count,
-            merge_lora,
         )
 
         lora_cfg = LoraConfig(rank=args.lora_rank, alpha=args.lora_alpha)
@@ -433,10 +431,12 @@ def train(args) -> dict:
             jax.random.key(args.seed + 1), lora_frozen, lora_cfg,
             train_config,
         )
-        save_state = lambda s: {  # noqa: E731
-            "params": merge_lora(lora_frozen, s["adapters"], lora_cfg),
-            "step": s["step"],
-        }
+        # checkpoints carry the MERGED weights (so serving and hf-export
+        # read them like any flat checkpoint) plus the adapter train
+        # state under "lora" — what restore_lora resumes from
+        save_state = lambda s: lora_checkpoint_state(  # noqa: E731
+            lora_frozen, s, lora_cfg
+        )
         log.info(
             "LoRA: rank %d, %s adapter parameters (base frozen)",
             args.lora_rank, f"{lora_param_count(state['adapters']):,}",
@@ -473,6 +473,16 @@ def train(args) -> dict:
         elif args.moe:
             layout = {"kind": "moe", "n_experts": args.moe_experts,
                       "top_k": args.moe_top_k}
+        elif args.lora_rank:
+            # params on disk are flat MERGED weights (serving reads them
+            # unchanged); the record is what makes a dense re-run of a
+            # lora dir (or a different rank) fail loudly, and marks the
+            # "lora" subtree restore_lora resumes from.  seed/base are
+            # part of the record because resume REBUILDS the frozen base
+            # from them — a different seed or HF source would silently
+            # continue against a different base
+            layout = {"kind": "lora", "rank": args.lora_rank,
+                      "seed": args.seed, "base": args.hf_checkpoint or ""}
         else:
             layout = None
         manifest_path = Path(args.checkpoint_dir) / MODEL_MANIFEST
@@ -513,14 +523,19 @@ def train(args) -> dict:
             save_model_manifest(args.checkpoint_dir, args.family,
                                 model_config, layout=layout)
         if args.resume and latest is not None:
-            shardings_fn = None
-            if pipe > 1:
-                from .pipeline import pipeline_state_shardings
+            if args.lora_rank:
+                # adapter-only partial restore; the frozen base was just
+                # rebuilt above from the same seed / HF source
+                state = checkpointer.restore_lora(mesh, state)
+            else:
+                shardings_fn = None
+                if pipe > 1:
+                    from .pipeline import pipeline_state_shardings
 
-                shardings_fn = pipeline_state_shardings
-            state = checkpointer.restore(
-                mesh, state, state_shardings_fn=shardings_fn
-            )
+                    shardings_fn = pipeline_state_shardings
+                state = checkpointer.restore(
+                    mesh, state, state_shardings_fn=shardings_fn
+                )
             log.info("Resumed from checkpoint step %d", latest)
 
     if args.lora_rank:

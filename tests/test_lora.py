@@ -200,6 +200,129 @@ def test_trainer_binary_lora_on_hf_base_serves_merged(tmp_path):
     assert "Processed 2 messages" in serve.stderr
 
 
+TRAINER_LORA_FLAGS = [
+    "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+    "--n-layers", "2", "--d-ff", "128", "--seq-len", "32",
+    "--batch-size", "8", "--learning-rate", "1e-2", "--log-every", "1",
+    "--lora-rank", "4",
+]
+
+
+def test_lora_trainer_resume_equals_uninterrupted(tmp_path):
+    # the invariant test_checkpoint pins for full training, for LoRA:
+    # interrupt/resume must replay exactly (adapter state + step come
+    # back from the checkpoint; the frozen base is rebuilt from the
+    # same seed)
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    full_dir = str(tmp_path / "full")
+    split_dir = str(tmp_path / "split")
+    full = main(TRAINER_LORA_FLAGS + ["--steps", "6",
+                                      "--checkpoint-dir", full_dir])
+    main(TRAINER_LORA_FLAGS + ["--steps", "4", "--checkpoint-dir",
+                               split_dir, "--checkpoint-every", "2"])
+    resumed = main(TRAINER_LORA_FLAGS + ["--steps", "2",
+                                         "--checkpoint-dir", split_dir,
+                                         "--resume"])
+    assert resumed["final_step"] == 6
+    np.testing.assert_allclose(
+        resumed["losses"], full["losses"][4:], rtol=1e-6
+    )
+    # and the final MERGED weights on disk are identical
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        TrainCheckpointer,
+        load_model_layout,
+        load_model_manifest,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    family, config = load_model_manifest(full_dir)
+    assert load_model_layout(full_dir) == {
+        "kind": "lora", "rank": 4, "seed": 0, "base": "",
+    }
+    # a different seed would rebuild a DIFFERENT frozen base — the
+    # layout record makes that resume fail loudly instead of silently
+    # fine-tuning against the wrong base
+    with pytest.raises(SystemExit, match="layout"):
+        main(TRAINER_LORA_FLAGS + ["--steps", "1", "--checkpoint-dir",
+                                   split_dir, "--resume", "--seed", "1"])
+    a = TrainCheckpointer(full_dir).restore_params(
+        mesh, family, config, layout=load_model_layout(full_dir))
+    b = TrainCheckpointer(split_dir).restore_params(
+        mesh, family, config, layout=load_model_layout(split_dir))
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+def test_lora_grad_accum_matches_single_pass():
+    # accumulated adapter GRADIENTS == whole-batch gradients (comparing
+    # post-Adam states would be sign-unstable: Adam normalizes near-zero
+    # grads to ±lr, so fp reassociation noise flips update signs)
+    from functools import partial
+
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        accumulate_value_and_grad,
+        loss_fn,
+    )
+
+    # fp32 base so the comparison is numerical, not bf16 reassociation
+    fp32 = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    base = init_params(jax.random.key(0), fp32)
+    lora = LoraConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(2), base, lora)
+    batch = tokens_batch(batch=8)
+    loss = partial(loss_fn, config=fp32)
+
+    def adapter_loss(ad, tokens):
+        return loss(apply_lora(base, ad, lora), tokens)
+
+    vag = jax.jit(jax.value_and_grad(adapter_loss))
+    loss1, grads1 = vag(adapters, batch)
+    loss2, grads2 = jax.jit(
+        accumulate_value_and_grad(vag, 2)
+    )(adapters, batch)
+    assert float(loss2) == pytest.approx(float(loss1), rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-3, atol=1e-6,
+        ),
+        grads1, grads2,
+    )
+
+
+def test_lora_trainer_grad_accum_learns(tmp_path):
+    # the flag composition end to end: --lora-rank + --grad-accum
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    result = main(TRAINER_LORA_FLAGS + ["--steps", "4", "--grad-accum", "2",
+                                        "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_dense_resume_of_lora_dir_fails_loudly(tmp_path):
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    ckpt = str(tmp_path / "ckpt")
+    main(TRAINER_LORA_FLAGS + ["--steps", "2", "--checkpoint-dir", ckpt])
+    assert TRAINER_LORA_FLAGS[-2:] == ["--lora-rank", "4"]
+    dense_flags = TRAINER_LORA_FLAGS[:-2]
+    with pytest.raises(SystemExit, match="layout"):
+        main(dense_flags + ["--steps", "1", "--checkpoint-dir", ckpt,
+                            "--resume"])
+
+
 def test_trainer_rejects_lora_with_incompatible_flags():
     from kube_sqs_autoscaler_tpu.workloads.trainer import build_parser, train
 
