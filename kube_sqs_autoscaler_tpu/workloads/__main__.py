@@ -111,7 +111,15 @@ def main(argv=None) -> None:
         "--quantize", choices=("none", "int8"), default="none",
         help="int8: post-training per-channel weight quantization of the "
              "served matmul weights (half the HBM bytes per decode step; "
-             "single chip)",
+             "composes with --model-parallel — codes shard like the bf16 "
+             "weights would)",
+    )
+    parser.add_argument(
+        "--quantize-kv", action="store_true",
+        help="int8 KV cache: decode streams int8 codes + per-position "
+             "scales instead of bf16 k/v (half the cache bytes per "
+             "generated token; requires --generate-tokens >= 1, single "
+             "chip, batch mode)",
     )
     parser.add_argument(
         "--result-queue-url", default="",
@@ -135,11 +143,6 @@ def main(argv=None) -> None:
         help="process N random messages from a local in-memory queue and exit",
     )
     args = parser.parse_args(argv)
-    if args.quantize == "int8" and args.model_parallel:
-        # fail BEFORE the mesh is built or a checkpoint restored
-        raise SystemExit(
-            "--quantize int8 is single-chip serving; drop --model-parallel"
-        )
     if args.beams < 1:
         raise SystemExit(f"--beams {args.beams} must be >= 1")
     if args.beams > 1:
@@ -156,6 +159,17 @@ def main(argv=None) -> None:
         ):
             if bad:
                 raise SystemExit(f"--beams does not support {flag}")
+    if args.quantize_kv:
+        for flag, bad in (
+            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
+            ("--model-parallel", bool(args.model_parallel)),
+            ("--continuous", args.continuous),
+            ("--beams > 1", args.beams > 1),
+            ("--speculative-draft-layers",
+             bool(args.speculative_draft_layers)),
+        ):
+            if bad:
+                raise SystemExit(f"--quantize-kv does not support {flag}")
     if args.top_k < 0:
         raise SystemExit(f"--top-k {args.top_k} must be >= 0 (0 = off)")
     if not 0.0 < args.top_p <= 1.0:
@@ -275,6 +289,11 @@ def main(argv=None) -> None:
 
         before = quantized_bytes(params)
         params = quantize_params(params, family=family)
+        if mesh is not None:
+            # pin the int8 codes to the weight's Megatron layout and the
+            # per-channel scales to its output-axis slice (the quantize
+            # ops above ran under GSPMD's inferred placement)
+            params = jax.device_put(params, param_shardings(mesh, params))
         log.info(
             "Quantized weights to int8: %.1f MiB -> %.1f MiB",
             before / 2**20, quantized_bytes(params) / 2**20,
@@ -286,6 +305,7 @@ def main(argv=None) -> None:
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         result_queue_url=args.result_queue_url,
         eos_id=None if args.eos_id < 0 else args.eos_id,
+        quantized_kv=args.quantize_kv,
     )
     tokenizer = None
     if args.tokenizer:
@@ -367,6 +387,7 @@ def main(argv=None) -> None:
                 lengths=lengths, top_k=service_config.top_k,
                 top_p=service_config.top_p,
                 eos_id=service_config.eos_id,
+                quantized_cache=service_config.quantized_kv,
             ),
         }
     if args.beams > 1:

@@ -186,6 +186,131 @@ def decode_step(
     return logits, {"layers": new_layers, "length": pos + 1}
 
 
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+#
+# Decode is HBM-bandwidth-bound and the cache is what it streams: every
+# step reads all [B, H, S_max, D] keys AND values of every layer.  The
+# same argument that halves weight bytes (.quantize) applies — store the
+# cache as int8 codes with one fp32 scale per (batch, head, position)
+# vector.  The per-position scale factors OUT of both attention matmuls:
+#
+#   scores[b,h,q,s] = (q · k[b,h,s]) / sqrt(D)
+#                   = (q · codes[b,h,s]) * k_scale[b,h,s] / sqrt(D)
+#   out[b,h,q]      = sum_s probs[b,h,q,s] * v[b,h,s]
+#                   = sum_s (probs * v_scale)[b,h,q,s] * codes[b,h,s]
+#
+# so the matmuls run on the int8 codes (cast fused into the operand load,
+# like the quantized weights) and the dequantize is a cheap elementwise
+# scale on the [B, H, T, S] scores — nothing rematerializes a
+# full-precision cache in HBM.
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-position symmetric int8 of a ``[..., T, D]`` k/v slice:
+    (codes ``int8 [..., T, D]``, scale ``fp32 [..., T]``)."""
+    x32 = x.astype(jnp.float32)
+    max_abs = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.maximum(max_abs / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return codes, scale[..., 0]
+
+
+def quantize_cache(cache: dict) -> dict:
+    """A populated full-precision cache -> its int8 form (codes+scales
+    per layer, same per-row ``length``)."""
+    layers = []
+    for lc in cache["layers"]:
+        k_codes, k_scale = quantize_kv(lc["k"])
+        v_codes, v_scale = quantize_kv(lc["v"])
+        layers.append({
+            "k_codes": k_codes, "k_scale": k_scale,
+            "v_codes": v_codes, "v_scale": v_scale,
+        })
+    return {"layers": layers, "length": cache["length"]}
+
+
+def quantized_prefill(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attention_fn=None,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """:func:`prefill` with the populated cache quantized to int8.
+
+    The prompt pass itself runs full precision (it is MXU-bound, not
+    cache-bound); quantization happens once at the end — the decode
+    steps that follow stream int8.
+    """
+    logits, cache = prefill(params, tokens, config, attention_fn, lengths)
+    return logits, quantize_cache(cache)
+
+
+def _quantized_chunk_cached_attention(
+    q: jax.Array,
+    k_codes: jax.Array,
+    k_scale: jax.Array,
+    v_codes: jax.Array,
+    v_scale: jax.Array,
+    start: jax.Array,
+    window: int | None = None,
+) -> jax.Array:
+    """:func:`_chunk_cached_attention` over the int8 cache (factorized
+    dequantize — see the section comment above)."""
+    head_dim = q.shape[-1]
+    chunk = q.shape[2]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_codes.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * k_scale[:, :, None, :] / (head_dim**0.5)
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    q_pos = start[:, None, None, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, chunk, 1), 2
+    )
+    valid = key_pos <= q_pos
+    if window is not None:
+        valid = valid & (key_pos > q_pos - window)
+    scores = jnp.where(valid, scores, jnp.float32(-jnp.inf))
+    probs = jax.nn.softmax(scores, axis=-1)
+    weighted = (probs * v_scale[:, :, None, :]).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weighted, v_codes.astype(q.dtype))
+
+
+def quantized_decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
+) -> tuple[jax.Array, dict]:
+    """:func:`decode_step` against the int8 cache: quantize the new
+    position's k/v vectors, write codes+scales, attend via the
+    factorized dequantize.  Same ragged per-row contract."""
+    pos = cache["length"]  # [B]
+    batch = tokens.shape[0]
+    rows = jnp.arange(batch)
+    x = params["embed"][tokens][:, None, :] + params["pos_embed"][pos][:, None, :]
+    new_layers = []
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            kc, ks = quantize_kv(k[:, :, 0])  # [B, H, D] -> codes, [B, H]
+            vc, vs = quantize_kv(v[:, :, 0])
+            k_codes = _lc["k_codes"].at[rows, :, pos].set(kc)
+            k_scale = _lc["k_scale"].at[rows, :, pos].set(ks)
+            v_codes = _lc["v_codes"].at[rows, :, pos].set(vc)
+            v_scale = _lc["v_scale"].at[rows, :, pos].set(vs)
+            new_layers.append({
+                "k_codes": k_codes, "k_scale": k_scale,
+                "v_codes": v_codes, "v_scale": v_scale,
+            })
+            return _quantized_chunk_cached_attention(
+                q, k_codes, k_scale, v_codes, v_scale, pos
+            )
+
+        x = _block(x, layer, config, attend)
+    logits = _final_logits(params, x)
+    return logits, {"layers": new_layers, "length": pos + 1}
+
+
 def _mask_top_k(logits: jax.Array, top_k: int) -> jax.Array:
     """Keep the ``top_k`` highest logits per row, ``-inf`` elsewhere.
     Ties at the k-th value are all kept (the usual top-k caveat)."""
@@ -349,6 +474,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """Generate ``num_tokens`` continuation tokens for each prompt.
 
@@ -368,6 +494,10 @@ def generate(
     overwritten by generated tokens and never attended (see
     :func:`prefill`) — so a padded batch generates exactly what each
     prompt would generate unpadded.
+
+    ``quantized_cache=True`` decodes through the int8 KV cache (half the
+    cache bytes each step streams; see :func:`quantized_decode_step` —
+    outputs match the full-precision path to int8 rounding).
     """
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
@@ -384,8 +514,10 @@ def generate(
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    logits, cache = prefill(params, prompt, config, attention_fn,
-                            lengths=lengths)
+    prefill_fn = quantized_prefill if quantized_cache else prefill
+    step_fn = quantized_decode_step if quantized_cache else decode_step
+    logits, cache = prefill_fn(params, prompt, config, attention_fn,
+                               lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
     done0 = (
         first == eos_id if eos_id is not None
@@ -394,7 +526,7 @@ def generate(
 
     def body(carry, key):
         cache, token, done = carry
-        logits, cache = decode_step(params, cache, token, config)
+        logits, cache = step_fn(params, cache, token, config)
         nxt = _pick(logits, key, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
@@ -412,7 +544,7 @@ def generate(
     jax.jit,
     static_argnames=(
         "num_tokens", "config", "temperature", "attention_fn", "top_k",
-        "top_p", "eos_id",
+        "top_p", "eos_id", "quantized_cache",
     ),
 )
 def generate_jit(
@@ -427,6 +559,7 @@ def generate_jit(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
     prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
@@ -434,7 +567,7 @@ def generate_jit(
     return generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         attention_fn=attention_fn, lengths=lengths, top_k=top_k, top_p=top_p,
-        eos_id=eos_id,
+        eos_id=eos_id, quantized_cache=quantized_cache,
     )
 
 

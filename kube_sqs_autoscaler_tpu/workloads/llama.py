@@ -757,6 +757,73 @@ def llama_decode_step(
     )
 
 
+def llama_quantized_prefill(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    prompt_attention=None,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """:func:`llama_prefill` with the populated GQA cache quantized to
+    int8 (codes + per-position scales — see ``decode.quantize_cache``;
+    the compact kv-head cache is the part decode streams, so the GQA
+    memory win and the int8 bandwidth win compose)."""
+    from .decode import quantize_cache
+
+    logits, cache = llama_prefill(params, tokens, config, prompt_attention,
+                                  lengths=lengths)
+    return logits, quantize_cache(cache)
+
+
+def llama_quantized_decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """:func:`llama_decode_step` against the int8 GQA cache: quantize
+    the new position's compact k/v vectors, write codes+scales, broadcast
+    to full heads, attend via the factorized dequantize
+    (``decode._quantized_chunk_cached_attention`` — the per-position
+    scales ride the broadcast exactly like the values do)."""
+    from .decode import _quantized_chunk_cached_attention, quantize_kv
+
+    pos = cache["length"]  # [B]
+    batch = tokens.shape[0]
+    rows = jnp.arange(batch)
+    groups = config.n_heads // config.n_kv_heads
+    positions = pos[:, None, None]
+    x = params["embed"][tokens][:, None, :]
+    new_layers = []
+
+    def scale_repeat(s):
+        # [B, H_kv, S] scales broadcast to full heads like their codes
+        return repeat_kv(s[..., None], groups)[..., 0]
+
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            kc, ks = quantize_kv(k[:, :, 0])  # [B, H_kv, D] -> codes, scale
+            vc, vs = quantize_kv(v[:, :, 0])
+            k_codes = _lc["k_codes"].at[rows, :, pos].set(kc)
+            k_scale = _lc["k_scale"].at[rows, :, pos].set(ks)
+            v_codes = _lc["v_codes"].at[rows, :, pos].set(vc)
+            v_scale = _lc["v_scale"].at[rows, :, pos].set(vs)
+            new_layers.append({
+                "k_codes": k_codes, "k_scale": k_scale,
+                "v_codes": v_codes, "v_scale": v_scale,
+            })
+            return _quantized_chunk_cached_attention(
+                q,
+                repeat_kv(k_codes, groups), scale_repeat(k_scale),
+                repeat_kv(v_codes, groups), scale_repeat(v_scale),
+                pos, window=config.sliding_window,
+            )
+
+        x = _llama_block(x, layer, config, positions, attend)
+    return (
+        _final_logits(params, x, config.rms_eps),
+        {"layers": new_layers, "length": pos + 1},
+    )
+
+
 def llama_chunk_decode(
     params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
 ) -> tuple[jax.Array, dict]:
@@ -814,6 +881,7 @@ def llama_generate(
     top_p: float = 1.0,
     rolling: bool = False,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """Greedy/temperature/top-k/top-p generation, one compiled program
     (same contract and scan structure as :func:`.decode.generate`,
@@ -822,7 +890,9 @@ def llama_generate(
     kernel (see :func:`llama_prefill`).  ``rolling=True`` decodes
     through the O(window) rolling-buffer cache (sliding-window configs
     only; identical outputs — the window mask already hides everything
-    the ring evicts)."""
+    the ring evicts).  ``quantized_cache=True`` decodes through the int8
+    GQA cache (half the cache bytes per step; outputs match to int8
+    rounding)."""
     from .decode import _pick
 
     batch, prompt_len = prompt.shape
@@ -835,13 +905,22 @@ def llama_generate(
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
+    if rolling and quantized_cache:
+        raise ValueError(
+            "rolling and quantized_cache do not compose (the ring's slot "
+            "arithmetic is a full-precision layout); pick one"
+        )
     keys = (
         jax.random.split(rng, num_tokens)
         if rng is not None
         else jnp.zeros((num_tokens, 2), jnp.uint32)
     )
-    prefill_fn = llama_rolling_prefill if rolling else llama_prefill
-    step_fn = llama_rolling_decode_step if rolling else llama_decode_step
+    if quantized_cache:
+        prefill_fn = llama_quantized_prefill
+        step_fn = llama_quantized_decode_step
+    else:
+        prefill_fn = llama_rolling_prefill if rolling else llama_prefill
+        step_fn = llama_rolling_decode_step if rolling else llama_decode_step
     logits, cache = prefill_fn(params, prompt, config, prompt_attention,
                                lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
@@ -920,7 +999,7 @@ def llama_forward_jit_with(
     jax.jit,
     static_argnames=(
         "num_tokens", "config", "temperature", "prompt_attention", "top_k",
-        "top_p", "rolling", "eos_id",
+        "top_p", "rolling", "eos_id", "quantized_cache",
     ),
 )
 def llama_generate_jit(
@@ -936,9 +1015,11 @@ def llama_generate_jit(
     top_p: float = 1.0,
     rolling: bool = False,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     return llama_generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         prompt_attention=prompt_attention, lengths=lengths, top_k=top_k,
         top_p=top_p, rolling=rolling, eos_id=eos_id,
+        quantized_cache=quantized_cache,
     )

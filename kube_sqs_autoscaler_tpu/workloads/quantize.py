@@ -85,9 +85,19 @@ def _quantize_weight(w: jax.Array) -> QuantizedTensor:
     return QuantizedTensor(codes, scale, w.dtype)
 
 
-jax.tree_util.register_pytree_node(
+# keyed registration: the codes/scale leaves carry named path entries
+# ("wqkv" -> "codes"/"scale"), which is what lets train._param_spec give
+# codes the weight's Megatron sharding and scale its output-axis slice —
+# int8 serving shards over a (data, model) mesh like bf16 serving does
+jax.tree_util.register_pytree_with_keys(
     QuantizedTensor,
-    lambda t: ((t.codes, t.scale), t.dtype),
+    lambda t: (
+        (
+            (jax.tree_util.DictKey("codes"), t.codes),
+            (jax.tree_util.DictKey("scale"), t.scale),
+        ),
+        t.dtype,
+    ),
     lambda dtype, leaves: QuantizedTensor(leaves[0], leaves[1], dtype),
 )
 

@@ -166,6 +166,10 @@ class ServiceConfig:
     # always generate the full generate_tokens.  The serve binary
     # auto-fills it from --tokenizer's eos_token_id when present.
     eos_id: int | None = None
+    # generate mode decodes through the int8 KV cache (half the cache
+    # bytes per token — decode.quantized_decode_step); weights-int8 is a
+    # separate, composable choice (the quantize module)
+    quantized_kv: bool = False
     # request/reply: when set, the worker publishes one JSON result per
     # input message to this queue (after compute, before deleting the
     # input — at-least-once semantics, so consumers must tolerate
@@ -272,6 +276,7 @@ class QueueWorker:
                 lengths=lengths, top_k=service_config.top_k,
                 top_p=service_config.top_p,
                 eos_id=service_config.eos_id,
+                quantized_cache=service_config.quantized_kv,
             )
 
         self._generate = generate_fn or _default_generate

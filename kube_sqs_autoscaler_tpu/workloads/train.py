@@ -132,6 +132,20 @@ def _param_spec(path: tuple, mesh: Mesh) -> P:
     from .model import PARAM_AXES
 
     name = path[-1]
+    # quantized weights (.quantize.QuantizedTensor) flatten into
+    # codes [in, out] + scale [out] under the weight's name: codes take
+    # the weight's spec, the per-output-channel scale takes the output
+    # axis's slice of it (replicated for row-parallel weights, whose
+    # output axis replicates)
+    if (
+        name in ("codes", "scale")
+        and len(path) >= 2
+        and path[-2] in PARAM_AXES
+    ):
+        axes = PARAM_AXES[path[-2]]
+        if name == "codes":
+            return P(*(_LOGICAL_TO_MESH[a] for a in axes))
+        return P(_LOGICAL_TO_MESH[axes[-1]])
     axes = PARAM_AXES.get(name)
     if axes is None:
         return P()

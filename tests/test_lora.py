@@ -299,7 +299,7 @@ def test_lora_grad_accum_matches_single_pass():
     )
 
 
-def test_lora_trainer_grad_accum_learns(tmp_path):
+def test_lora_trainer_grad_accum_learns():
     # the flag composition end to end: --lora-rank + --grad-accum
     from kube_sqs_autoscaler_tpu.workloads.trainer import main
 

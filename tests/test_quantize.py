@@ -107,3 +107,156 @@ def test_worker_binary_serves_quantized():
     # quantize + generate mode together
     worker_main(["--demo", "2", "--quantize", "int8", "--batch-size", "2",
                  "--seq-len", "12", "--generate-tokens", "2"])
+
+
+# ---------------------------------------------------- tp-sharded int8
+
+
+def test_int8_tp_sharded_serving_matches_single_chip(params):
+    # VERDICT r3 #6: int8 codes shard like the bf16 weights would
+    # (codes take the weight's Megatron spec, per-channel scales its
+    # output-axis slice) — sharded int8 generate ≡ single-chip int8
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        generate,
+        make_serving_fns,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    qparams = quantize_params(params)
+    shardings = param_shardings(mesh, qparams)
+    # codes carry the weight's spec, scales the output-axis slice
+    wqkv = shardings["layers"][0]["wqkv"]
+    assert wqkv.codes.spec == jax.sharding.PartitionSpec(None, "model")
+    assert wqkv.scale.spec == jax.sharding.PartitionSpec("model")
+    wo = shardings["layers"][0]["wo"]
+    assert wo.codes.spec == jax.sharding.PartitionSpec("model", None)
+    assert wo.scale.spec == jax.sharding.PartitionSpec(None)
+
+    placed = jax.device_put(qparams, shardings)
+    _, _, gen = make_serving_fns(mesh, TINY, placed)
+    prompt = jax.random.randint(jax.random.key(3), (4, 8), 1,
+                                TINY.vocab_size, jnp.int32)
+    lengths = jnp.full((4,), 8, jnp.int32)
+    sharded = np.asarray(gen(placed, prompt, jax.random.key(0), lengths, 5))
+    single = np.asarray(generate(qparams, prompt, 5, TINY))
+    np.testing.assert_array_equal(sharded, single)
+
+
+def test_worker_binary_serves_int8_model_parallel():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "4", "--quantize", "int8", "--model-parallel",
+                 "2", "--batch-size", "4", "--seq-len", "12",
+                 "--generate-tokens", "3"])
+
+
+# ------------------------------------------------------ int8 KV cache
+
+
+def test_quantized_cache_decode_close_to_exact(params):
+    # the factorized dequantize must track the full-precision decode to
+    # int8 rounding, step after step (errors compound through the scan)
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        decode_step,
+        prefill,
+        quantized_decode_step,
+        quantized_prefill,
+    )
+
+    prompt = jax.random.randint(jax.random.key(4), (2, 8), 1,
+                                TINY.vocab_size, jnp.int32)
+    logits_q, qcache = quantized_prefill(params, prompt, TINY)
+    logits_f, fcache = prefill(params, prompt, TINY)
+    np.testing.assert_array_equal(np.asarray(logits_q),
+                                  np.asarray(logits_f))  # prompt pass: exact
+    tok = jnp.argmax(logits_f, axis=-1).astype(jnp.int32)
+    for _ in range(4):
+        lq, qcache = quantized_decode_step(params, qcache, tok, TINY)
+        lf, fcache = decode_step(params, fcache, tok, TINY)
+        np.testing.assert_allclose(
+            np.asarray(lq), np.asarray(lf), rtol=0.25, atol=0.6
+        )
+        tok = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+
+
+def test_quantized_cache_generate_runs_both_families(params):
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_generate,
+    )
+
+    prompt = jax.random.randint(jax.random.key(5), (2, 8), 1,
+                                TINY.vocab_size, jnp.int32)
+    out = generate(params, prompt, 4, TINY, quantized_cache=True,
+                   eos_id=5)
+    assert out.shape == (2, 4)
+    assert np.isfinite(np.asarray(out)).all()
+
+    lcfg = LlamaConfig(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                       n_layers=2, d_ff=128, max_seq_len=32,
+                       dtype=jnp.float32)
+    lparams = init_llama_params(jax.random.key(6), lcfg)
+    lout = llama_generate(lparams, prompt, 4, lcfg, quantized_cache=True)
+    assert lout.shape == (2, 4)
+    assert np.isfinite(np.asarray(lout)).all()
+
+
+def test_quantized_cache_bytes_halve(params):
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        init_cache,
+        quantize_cache,
+    )
+
+    def nbytes(tree):
+        return sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(tree)
+        )
+
+    # bf16 baseline (the production cache dtype; TINY here is fp32).
+    # head_dim 64 so the per-vector fp32 scale amortizes like it does at
+    # production widths: (64·1 + 4) / (64·2) ≈ 0.53
+    bf16 = ModelConfig(vocab_size=128, d_model=256, n_heads=4, n_layers=2,
+                       d_ff=128, max_seq_len=32)
+    cache = init_cache(bf16, batch=4)
+    q = quantize_cache(cache)
+    assert nbytes(q) < 0.6 * nbytes(cache)
+
+
+def test_rolling_and_quantized_cache_fail_fast():
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        LlamaConfig,
+        init_llama_params,
+        llama_generate,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=128, max_seq_len=32,
+                      sliding_window=8, dtype=jnp.float32)
+    p = init_llama_params(jax.random.key(0), cfg)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="rolling"):
+        llama_generate(p, prompt, 2, cfg, rolling=True,
+                       quantized_cache=True)
+
+
+def test_worker_binary_quantize_kv_flag():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "2", "--quantize-kv", "--batch-size", "2",
+                 "--seq-len", "12", "--generate-tokens", "3"])
+    worker_main(["--demo", "2", "--quantize-kv", "--family", "llama",
+                 "--quantize", "int8", "--batch-size", "2",
+                 "--seq-len", "12", "--generate-tokens", "3",
+                 "--temperature", "0.7"])
+    with pytest.raises(SystemExit, match="generate-tokens"):
+        worker_main(["--demo", "1", "--quantize-kv"])
+    with pytest.raises(SystemExit, match="model-parallel"):
+        worker_main(["--demo", "1", "--quantize-kv", "--generate-tokens",
+                     "2", "--model-parallel", "2"])

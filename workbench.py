@@ -352,6 +352,48 @@ def bench_speculative(num_tokens: int = 64, draft_tokens: int = 4) -> dict:
     }
 
 
+def bench_kv_cache(num_tokens: int = 64) -> dict:
+    """Greedy decode tokens/s: bf16 KV cache vs the int8 cache
+    (identical sampling path; decode streams the whole cache every
+    token, so halving its bytes is the bandwidth headline for serving a
+    long context)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    config = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=2048,
+    )
+    params = init_params(jax.random.key(0), config)
+    # long prompt: the cache a real serving step drags through HBM
+    prompt = jax.random.randint(jax.random.key(2), (4, 1024), 0,
+                                config.vocab_size, jnp.int32)
+
+    def plain():
+        return generate_jit(params, prompt, num_tokens, config)
+
+    def quantized():
+        return generate_jit(params, prompt, num_tokens, config,
+                            quantized_cache=True)
+
+    plain_s = _time_compiled(plain, iters=3)
+    quant_s = _time_compiled(quantized, iters=3)
+    toks = prompt.shape[0] * num_tokens
+    return {
+        "bf16_tokens_per_sec": toks / plain_s,
+        "int8_tokens_per_sec": toks / quant_s,
+        "speedup": plain_s / quant_s,
+        "num_tokens": num_tokens,
+        "prompt_len": int(prompt.shape[1]),
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser(prog="workbench")
     parser.add_argument("--steps", type=int, default=20)
@@ -383,6 +425,7 @@ def main(argv=None) -> dict:
         results[f"ring_local_s{seq}"] = bench_ring_local(seq, args.attn_iters)
     results["window_s8192"] = bench_window(8192, 1024, args.attn_iters)
     results["speculative"] = bench_speculative()
+    results["kv_cache_int8"] = bench_kv_cache()
 
     metrics = [
         ("train_tokens_per_sec", results["train"]["tokens_per_sec"],
@@ -415,6 +458,8 @@ def main(argv=None) -> dict:
          results["speculative"]["plain_tokens_per_sec"], "tokens/s"),
         ("speculative_decode_speedup",
          results["speculative"]["speedup"], "x"),
+        ("kv_cache_int8_decode_speedup",
+         results["kv_cache_int8"]["speedup"], "x"),
     ]
     for name, value, unit in metrics:
         print(json.dumps({
