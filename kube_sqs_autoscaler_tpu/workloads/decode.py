@@ -154,14 +154,18 @@ def _cached_attention(
     return _chunk_cached_attention(q, k_cache, v_cache, length, window)
 
 
-def decode_step(
-    params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
+def _decode_impl(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    write_and_attend,
 ) -> tuple[jax.Array, dict]:
-    """One autoregressive step: feed ``tokens`` (int32 ``[batch]``, row
-    ``b``'s token for position ``cache["length"][b]``), return (fp32
-    logits ``[batch, vocab]`` for each row's next position, updated
-    cache).  Rows advance independently — a ragged batch decodes in
-    lockstep with per-row positions."""
+    """The gpt-family decode-step skeleton every cache layout shares
+    (full-precision, int8): embed at each row's position, per layer call
+    ``write_and_attend(q, k, v, layer_cache, rows, pos) -> (new_entry,
+    out)``, final logits.  The llama counterpart is
+    ``llama._decode_step_impl`` (same seam shape)."""
     pos = cache["length"]  # [B]
     batch = tokens.shape[0]
     rows = jnp.arange(batch)
@@ -170,20 +174,37 @@ def decode_step(
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            # write each row's k/v at its own position, then attend the
-            # single query against the whole (row-masked) cache
-            k_cache = _lc["k"].at[rows, :, pos].set(
-                k[:, :, 0].astype(config.dtype)
-            )
-            v_cache = _lc["v"].at[rows, :, pos].set(
-                v[:, :, 0].astype(config.dtype)
-            )
-            new_layers.append({"k": k_cache, "v": v_cache})
-            return _cached_attention(q, k_cache, v_cache, pos)
+            entry, out = write_and_attend(q, k, v, _lc, rows, pos)
+            new_layers.append(entry)
+            return out
 
         x = _block(x, layer, config, attend)
     logits = _final_logits(params, x)
     return logits, {"layers": new_layers, "length": pos + 1}
+
+
+def decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step: feed ``tokens`` (int32 ``[batch]``, row
+    ``b``'s token for position ``cache["length"][b]``), return (fp32
+    logits ``[batch, vocab]`` for each row's next position, updated
+    cache).  Rows advance independently — a ragged batch decodes in
+    lockstep with per-row positions."""
+
+    def write_and_attend(q, k, v, layer_cache, rows, pos):
+        # write each row's k/v at its own position, then attend the
+        # single query against the whole (row-masked) cache
+        k_cache = layer_cache["k"].at[rows, :, pos].set(
+            k[:, :, 0].astype(config.dtype)
+        )
+        v_cache = layer_cache["v"].at[rows, :, pos].set(
+            v[:, :, 0].astype(config.dtype)
+        )
+        entry = {"k": k_cache, "v": v_cache}
+        return entry, _cached_attention(q, k_cache, v_cache, pos)
+
+    return _decode_impl(params, cache, tokens, config, write_and_attend)
 
 
 # ---------------------------------------------------------------------------
@@ -278,37 +299,42 @@ def _quantized_chunk_cached_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", weighted, v_codes.astype(q.dtype))
 
 
+def _quantized_write_and_attend(window: int | None = None, broadcast=None):
+    """The int8-cache write+attend both families' decode skeletons plug
+    in: quantize the new position's k/v vectors, write codes+scales at
+    each row's position, attend via the factorized dequantize.
+    ``broadcast`` expands compact GQA codes/scales to full heads (llama;
+    identity for the gpt full-head cache)."""
+    expand = broadcast or (lambda t: t)
+
+    def write_and_attend(q, k, v, layer_cache, rows, pos):
+        kc, ks = quantize_kv(k[:, :, 0])  # [B, H, D] -> codes, [B, H]
+        vc, vs = quantize_kv(v[:, :, 0])
+        k_codes = layer_cache["k_codes"].at[rows, :, pos].set(kc)
+        k_scale = layer_cache["k_scale"].at[rows, :, pos].set(ks)
+        v_codes = layer_cache["v_codes"].at[rows, :, pos].set(vc)
+        v_scale = layer_cache["v_scale"].at[rows, :, pos].set(vs)
+        entry = {
+            "k_codes": k_codes, "k_scale": k_scale,
+            "v_codes": v_codes, "v_scale": v_scale,
+        }
+        return entry, _quantized_chunk_cached_attention(
+            q, expand(k_codes), expand(k_scale), expand(v_codes),
+            expand(v_scale), pos, window=window,
+        )
+
+    return write_and_attend
+
+
 def quantized_decode_step(
     params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
 ) -> tuple[jax.Array, dict]:
-    """:func:`decode_step` against the int8 cache: quantize the new
-    position's k/v vectors, write codes+scales, attend via the
-    factorized dequantize.  Same ragged per-row contract."""
-    pos = cache["length"]  # [B]
-    batch = tokens.shape[0]
-    rows = jnp.arange(batch)
-    x = params["embed"][tokens][:, None, :] + params["pos_embed"][pos][:, None, :]
-    new_layers = []
-    for layer, layer_cache in zip(params["layers"], cache["layers"]):
-
-        def attend(q, k, v, _lc=layer_cache):
-            kc, ks = quantize_kv(k[:, :, 0])  # [B, H, D] -> codes, [B, H]
-            vc, vs = quantize_kv(v[:, :, 0])
-            k_codes = _lc["k_codes"].at[rows, :, pos].set(kc)
-            k_scale = _lc["k_scale"].at[rows, :, pos].set(ks)
-            v_codes = _lc["v_codes"].at[rows, :, pos].set(vc)
-            v_scale = _lc["v_scale"].at[rows, :, pos].set(vs)
-            new_layers.append({
-                "k_codes": k_codes, "k_scale": k_scale,
-                "v_codes": v_codes, "v_scale": v_scale,
-            })
-            return _quantized_chunk_cached_attention(
-                q, k_codes, k_scale, v_codes, v_scale, pos
-            )
-
-        x = _block(x, layer, config, attend)
-    logits = _final_logits(params, x)
-    return logits, {"layers": new_layers, "length": pos + 1}
+    """:func:`decode_step` against the int8 cache: same
+    :func:`_decode_impl` skeleton, int8 write+attend.  Same ragged
+    per-row contract."""
+    return _decode_impl(
+        params, cache, tokens, config, _quantized_write_and_attend()
+    )
 
 
 def _mask_top_k(logits: jax.Array, top_k: int) -> jax.Array:

@@ -598,7 +598,9 @@ def llama_rolling_decode_step(
 
     return _decode_step_impl(
         params, cache, tokens, config,
-        jnp.remainder(cache["length"], window), attend_cache,
+        _full_cache_write_and_attend(
+            config, lambda pos: jnp.remainder(pos, window), attend_cache
+        ),
     )
 
 
@@ -699,19 +701,18 @@ def _decode_step_impl(
     cache: dict,
     tokens: jax.Array,
     config: LlamaConfig,
-    write_slot: jax.Array,
-    cached_attention,
+    write_and_attend,
 ) -> tuple[jax.Array, dict]:
-    """The one decode-step skeleton both cache layouts share: embed at
-    the absolute position, write each layer's k/v at ``write_slot``,
-    attend via ``cached_attention(q, k_cache, v_cache, pos)``
-    (full-head inputs), final logits.  Layout-specific pieces — the
-    slot arithmetic and the masked-attention math — are the
-    parameters."""
+    """The one decode-step skeleton every cache layout shares (full,
+    rolling-ring, int8): embed at the absolute position, per layer call
+    ``write_and_attend(q, k, v, layer_cache, rows, pos) -> (new_entry,
+    out)`` — which writes the new position into its layout's slot(s) and
+    attends against it — then final logits.  Layout-specific pieces (the
+    slot arithmetic, the cache-entry dtype, the masked-attention math)
+    live entirely in the callback."""
     pos = cache["length"]  # [B]
     batch = tokens.shape[0]
     rows = jnp.arange(batch)
-    groups = config.n_heads // config.n_kv_heads
     # RoPE rotates by each row's absolute position: [B, 1, 1] broadcasts
     # against the [B, H, 1, D/2] rotation pairs
     positions = pos[:, None, None]
@@ -720,23 +721,39 @@ def _decode_step_impl(
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            k_cache = _lc["k"].at[rows, :, write_slot].set(
-                k[:, :, 0].astype(config.dtype)
-            )
-            v_cache = _lc["v"].at[rows, :, write_slot].set(
-                v[:, :, 0].astype(config.dtype)
-            )
-            new_layers.append({"k": k_cache, "v": v_cache})
-            return cached_attention(
-                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
-                pos,
-            )
+            entry, out = write_and_attend(q, k, v, _lc, rows, pos)
+            new_layers.append(entry)
+            return out
 
         x = _llama_block(x, layer, config, positions, attend)
     return (
         _final_logits(params, x, config.rms_eps),
         {"layers": new_layers, "length": pos + 1},
     )
+
+
+def _full_cache_write_and_attend(
+    config: LlamaConfig, write_slot_of, cached_attention
+):
+    """The full-precision k/v write for :func:`_decode_step_impl`:
+    write at ``write_slot_of(pos)``, GQA-broadcast, attend via
+    ``cached_attention(q, k_cache, v_cache, pos)``."""
+    groups = config.n_heads // config.n_kv_heads
+
+    def write_and_attend(q, k, v, layer_cache, rows, pos):
+        slot = write_slot_of(pos)
+        k_cache = layer_cache["k"].at[rows, :, slot].set(
+            k[:, :, 0].astype(config.dtype)
+        )
+        v_cache = layer_cache["v"].at[rows, :, slot].set(
+            v[:, :, 0].astype(config.dtype)
+        )
+        entry = {"k": k_cache, "v": v_cache}
+        return entry, cached_attention(
+            q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups), pos
+        )
+
+    return write_and_attend
 
 
 def llama_decode_step(
@@ -753,7 +770,8 @@ def llama_decode_step(
                                  window=config.sliding_window)
 
     return _decode_step_impl(
-        params, cache, tokens, config, cache["length"], attend_cache
+        params, cache, tokens, config,
+        _full_cache_write_and_attend(config, lambda pos: pos, attend_cache),
     )
 
 
@@ -782,45 +800,22 @@ def llama_quantized_decode_step(
     the new position's compact k/v vectors, write codes+scales, broadcast
     to full heads, attend via the factorized dequantize
     (``decode._quantized_chunk_cached_attention`` — the per-position
-    scales ride the broadcast exactly like the values do)."""
-    from .decode import _quantized_chunk_cached_attention, quantize_kv
+    scales ride the broadcast exactly like the values do).  Same
+    :func:`_decode_step_impl` skeleton as the other cache layouts."""
+    from .decode import _quantized_write_and_attend
 
-    pos = cache["length"]  # [B]
-    batch = tokens.shape[0]
-    rows = jnp.arange(batch)
     groups = config.n_heads // config.n_kv_heads
-    positions = pos[:, None, None]
-    x = params["embed"][tokens][:, None, :]
-    new_layers = []
 
-    def scale_repeat(s):
-        # [B, H_kv, S] scales broadcast to full heads like their codes
-        return repeat_kv(s[..., None], groups)[..., 0]
+    def broadcast(t):
+        if t.ndim == 3:  # [B, H_kv, S] scales ride like their codes
+            return repeat_kv(t[..., None], groups)[..., 0]
+        return repeat_kv(t, groups)
 
-    for layer, layer_cache in zip(params["layers"], cache["layers"]):
-
-        def attend(q, k, v, _lc=layer_cache):
-            kc, ks = quantize_kv(k[:, :, 0])  # [B, H_kv, D] -> codes, scale
-            vc, vs = quantize_kv(v[:, :, 0])
-            k_codes = _lc["k_codes"].at[rows, :, pos].set(kc)
-            k_scale = _lc["k_scale"].at[rows, :, pos].set(ks)
-            v_codes = _lc["v_codes"].at[rows, :, pos].set(vc)
-            v_scale = _lc["v_scale"].at[rows, :, pos].set(vs)
-            new_layers.append({
-                "k_codes": k_codes, "k_scale": k_scale,
-                "v_codes": v_codes, "v_scale": v_scale,
-            })
-            return _quantized_chunk_cached_attention(
-                q,
-                repeat_kv(k_codes, groups), scale_repeat(k_scale),
-                repeat_kv(v_codes, groups), scale_repeat(v_scale),
-                pos, window=config.sliding_window,
-            )
-
-        x = _llama_block(x, layer, config, positions, attend)
-    return (
-        _final_logits(params, x, config.rms_eps),
-        {"layers": new_layers, "length": pos + 1},
+    return _decode_step_impl(
+        params, cache, tokens, config,
+        _quantized_write_and_attend(
+            window=config.sliding_window, broadcast=broadcast
+        ),
     )
 
 

@@ -237,13 +237,10 @@ def train(args) -> dict:
             args.family = "llama"
     if args.eval_every > 0:
         # fail fast with the other combo checks, before any device work
-        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1),
-                          ("--zigzag", args.zigzag),
-                          ("--eval-batches < 1", args.eval_batches < 1)):
-            if bad:
-                raise SystemExit(
-                    f"--eval-every does not combine with {flag}"
-                )
+        if args.eval_batches < 1:
+            raise SystemExit(
+                "--eval-every needs --eval-batches >= 1"
+            )
     if args.hf_export:
         for flag, bad in (("--family gpt", args.family != "llama"
                            and not args.hf_checkpoint),
@@ -598,36 +595,87 @@ def train(args) -> dict:
     last_saved = start_step if args.resume else None
 
     # --- held-out evaluation (fixed batches, pure loss, no update) -------
+    # every training layout evaluates: dense (either family, LoRA too)
+    # through the family loss, MoE through its routed forward (pure LM
+    # NLL — the aux load-balance term is a training regularizer, not a
+    # quality signal), zig-zag through its permuted-order loss, pipeline
+    # through the microbatched pipeline loss.
     eval_fn = eval_data = None
     if args.eval_every > 0:
-        from .train import mesh_attention_fn
+        from functools import partial as _partial
 
-        window = getattr(model_config, "sliding_window", None)
-        attend = mesh_attention_fn(mesh, window=window)
-        if args.family == "llama":
-            from .llama import llama_mesh_loss
+        if pipe > 1:
+            from .pipeline import (
+                llama_pipeline_loss_fn,
+                pipeline_loss_fn,
+            )
 
-            base_loss = llama_mesh_loss(model_config, train_config)
-        else:
-            from functools import partial as _partial
-
-            from .train import loss_fn as _loss_fn
-
-            base_loss = _partial(_loss_fn, config=model_config,
-                                 remat=train_config.remat)
-
-        if args.lora_rank:
-            from .lora import apply_lora
+            pp_loss = (
+                llama_pipeline_loss_fn if args.family == "llama"
+                else pipeline_loss_fn
+            )
+            pp_eval = _partial(pp_loss, config=model_config,
+                               pcfg=pipe_config, mesh=mesh)
 
             def eval_fn_impl(state, tokens):
-                return base_loss(
-                    apply_lora(lora_frozen, state["adapters"], lora_cfg),
-                    tokens, attention_fn=attend,
+                return pp_eval(state["params"], tokens)
+        elif args.moe:
+            from .moe import llama_moe_forward, moe_forward
+            from .train import mesh_attention_fn, next_token_nll
+
+            attend = mesh_attention_fn(
+                mesh, window=getattr(model_config, "sliding_window", None)
+            )
+            moe_fwd = (
+                llama_moe_forward if args.family == "llama" else moe_forward
+            )
+
+            def eval_fn_impl(state, tokens):
+                logits, _aux = moe_fwd(state["params"], tokens,
+                                       model_config, moe_config, attend)
+                return next_token_nll(logits, tokens)
+        elif args.zigzag:
+            from .zigzag import make_zigzag_ring_attention, zigzag_loss_fn
+
+            zz_attend = make_zigzag_ring_attention(mesh)
+            zz_forward = None
+            if args.family == "llama":
+                from .llama import llama_forward
+
+                zz_forward = llama_forward
+
+            def eval_fn_impl(state, tokens):
+                return zigzag_loss_fn(
+                    state["params"], tokens, model_config, mesh, zz_attend,
+                    forward_fn=zz_forward,
                 )
         else:
-            def eval_fn_impl(state, tokens):
-                return base_loss(state["params"], tokens,
-                                 attention_fn=attend)
+            from .train import mesh_attention_fn
+
+            window = getattr(model_config, "sliding_window", None)
+            attend = mesh_attention_fn(mesh, window=window)
+            if args.family == "llama":
+                from .llama import llama_mesh_loss
+
+                base_loss = llama_mesh_loss(model_config, train_config)
+            else:
+                from .train import loss_fn as _loss_fn
+
+                base_loss = _partial(_loss_fn, config=model_config,
+                                     remat=train_config.remat)
+
+            if args.lora_rank:
+                from .lora import apply_lora
+
+                def eval_fn_impl(state, tokens):
+                    return base_loss(
+                        apply_lora(lora_frozen, state["adapters"], lora_cfg),
+                        tokens, attention_fn=attend,
+                    )
+            else:
+                def eval_fn_impl(state, tokens):
+                    return base_loss(state["params"], tokens,
+                                     attention_fn=attend)
 
         eval_fn = jax.jit(eval_fn_impl)
         # a fixed held-out set from a disjoint seed domain of the same
@@ -643,11 +691,26 @@ def train(args) -> dict:
                 model_config.vocab_size, args.batch_size, args.seq_len,
                 seed=eval_seed,
             )
-        shard = batch_sharding(mesh)
-        eval_data = [
-            jax.device_put(next(eval_stream), shard)
-            for _ in range(args.eval_batches)
-        ]
+        if pipe > 1:
+            from .pipeline import pipeline_batch_sharding
+
+            m = args.pipe_microbatches
+            shard = pipeline_batch_sharding(mesh)
+            eval_data = [
+                jax.device_put(
+                    (b := next(eval_stream)).reshape(
+                        m, b.shape[0] // m, b.shape[1]
+                    ),
+                    shard,
+                )
+                for _ in range(args.eval_batches)
+            ]
+        else:
+            shard = batch_sharding(mesh)
+            eval_data = [
+                jax.device_put(next(eval_stream), shard)
+                for _ in range(args.eval_batches)
+            ]
 
     def run_eval(state):
         total = 0.0
