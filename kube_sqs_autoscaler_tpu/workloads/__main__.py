@@ -429,12 +429,6 @@ def main(argv=None) -> None:
                 raise SystemExit(
                     f"--speculative-draft-layers does not support {flag}"
                 )
-        if service_config.eos_id is not None:
-            raise SystemExit(
-                "--eos-id is not supported with "
-                "--speculative-draft-layers (the draft-and-verify loop "
-                "has no eos pinning yet)"
-            )
         n_draft = args.speculative_draft_layers
         k = args.speculative_draft_tokens
         if k < 1:
@@ -473,6 +467,7 @@ def main(argv=None) -> None:
                 temperature=args.temperature,
                 rng=(next(spec_keys) if args.temperature > 0.0 else None),
                 top_k=args.top_k, top_p=args.top_p,
+                eos_id=service_config.eos_id,
             )
         )
         log.info(

@@ -187,6 +187,27 @@ class TrainCheckpointer:
         )
         return self._ckpt.restore(self._path(step), targets)
 
+    def _params_metadata(self, step: int) -> dict:
+        """The on-disk structure of a step's ``params`` subtree (orbax
+        array metadata by name) — how restores discover leaves a fresh
+        init does not have (the untied ``lm_head``).  Raises rather than
+        guessing when the metadata shape is unparseable: a silent ``{}``
+        here would be indistinguishable from a tied checkpoint, and the
+        caller would quietly drop a trained readout."""
+        meta = ocp.PyTreeCheckpointer().metadata(self._path(step))
+        tree = getattr(meta, "item_metadata", meta)
+        tree = getattr(tree, "tree", tree)
+        if isinstance(tree, dict):
+            params = tree.get("params")
+            if isinstance(params, dict):
+                return params
+        raise ValueError(
+            f"could not parse the params structure of step {step} under "
+            f"{self.directory} (orbax metadata layout changed?) — "
+            "refusing to guess whether the checkpoint carries an untied "
+            "lm_head"
+        )
+
     def restore_lora(
         self, mesh: Mesh, reference_state: dict, step: int | None = None
     ) -> dict:
@@ -306,6 +327,16 @@ class TrainCheckpointer:
 
             init_fn = init_params
         reference = jax.eval_shape(lambda: init_fn(jax.random.key(0), config))
+        if family == "llama" and "lm_head" not in reference:
+            # untied readout: a checkpoint written from an HF import
+            # carries an "lm_head" no fresh init has — detect it from the
+            # on-disk structure, or the partial restore would silently
+            # drop the trained readout and serve the tied embedding
+            head_meta = self._params_metadata(step).get("lm_head")
+            if head_meta is not None:
+                reference["lm_head"] = jax.ShapeDtypeStruct(
+                    tuple(head_meta.shape), head_meta.dtype
+                )
         if pipeline_layout:
             # the serving mesh has no "pipe" axis: restore the stage stack
             # replicated, convert to the flat layout, then place normally

@@ -182,6 +182,15 @@ def unstack_llama_layers(params: dict) -> dict:
     return flat
 
 
+def as_llama_pipeline_params(params: dict) -> dict:
+    """Flat llama params -> the stage-stacked pipeline layout (the
+    non-layer leaves — embed, final_norm, an untied lm_head — pass
+    through).  Inverse: :func:`unstack_llama_layers`."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = stack_llama_layers(params)
+    return out
+
+
 def init_llama_pipeline_params(rng: jax.Array, config, n_stages: int) -> dict:
     """:func:`.llama.init_llama_params` with the stack pre-stacked."""
     from .llama import init_llama_params
@@ -190,11 +199,7 @@ def init_llama_pipeline_params(rng: jax.Array, config, n_stages: int) -> dict:
         raise ValueError(
             f"n_layers={config.n_layers} not divisible by n_stages={n_stages}"
         )
-    params = init_llama_params(rng, config)
-    stages = stack_llama_layers(params)
-    del params["layers"]
-    params["stages"] = stages
-    return params
+    return as_llama_pipeline_params(init_llama_params(rng, config))
 
 
 def _stage_spec(name: str, with_model: bool) -> P:

@@ -151,6 +151,7 @@ def speculative_generate(
     rng: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Greedy generation through the draft-and-verify loop — or, with
     ``temperature > 0`` (and ``rng``), full *speculative sampling*: the
@@ -172,6 +173,12 @@ def speculative_generate(
     returns ``{"rounds": [B] int32, "acceptance_rate": [B] fp32}`` — the
     per-row target-pass count and mean fraction of drafts accepted, the
     serving-side signal for tuning ``draft_tokens`` and the draft model.
+
+    ``eos_id`` carries :func:`.decode.generate`'s eos contract into the
+    speculative loop: a row that emits the id freezes (no further draft
+    or verify work charged to it) and its later positions are pinned to
+    the id — the pre-eos prefix is untouched, so greedy speculative with
+    eos still equals plain greedy generate with eos token for token.
     """
     if config_target.vocab_size != config_draft.vocab_size:
         raise ValueError(
@@ -231,15 +238,22 @@ def speculative_generate(
     count = jnp.ones((batch,), jnp.int32)  # emitted per row (incl. pending)
     rounds = jnp.zeros((batch,), jnp.int32)
     accepted_total = jnp.zeros((batch,), jnp.int32)
+    eos_seen = (
+        pending == eos_id if eos_id is not None
+        else jnp.zeros((batch,), bool)
+    )
+
+    def row_done(count, eos_seen):
+        return (count >= num_tokens) | eos_seen
 
     def round_body(carry):
         (out, count, pending, t_cache, d_cache, rounds, accepted_total,
-         rng) = carry
-        # rows already at num_tokens freeze: no emission, no cache/count
-        # advance — their chunk writes land in masked slots within the
-        # validated budget instead of marching past max_seq_len while
-        # slower rows finish
-        done = count >= num_tokens
+         rng, eos_seen) = carry
+        # rows already at num_tokens (or past their eos) freeze: no
+        # emission, no cache/count advance — their chunk writes land in
+        # masked slots within the validated budget instead of marching
+        # past max_seq_len while slower rows finish
+        done = row_done(count, eos_seen)
         if sampled:
             rng, accept_key, *draft_keys = jax.random.split(rng, k + 2)
 
@@ -312,25 +326,38 @@ def speculative_generate(
         pending_next = jnp.where(done, pending, bonus)
         rounds = rounds + jnp.where(done, 0, 1)
         accepted_total = accepted_total + jnp.where(done, 0, n)
+        if eos_id is not None:
+            emitted_eos = jnp.any(
+                (round_tokens == eos_id) & (j <= n[:, None]), axis=1
+            )
+            eos_seen = eos_seen | (~done & emitted_eos)
         return (out, count, pending_next, t_cache_adv, dc, rounds,
-                accepted_total, rng)
+                accepted_total, rng, eos_seen)
 
     def cond(carry):
-        _, count, *_ = carry
-        return jnp.min(count) < num_tokens
+        _, count, *rest = carry
+        eos_seen = rest[-1]
+        return jnp.any(~row_done(count, eos_seen))
 
-    out, count, _, _, _, rounds, accepted_total, _ = jax.lax.while_loop(
+    out, count, _, _, _, rounds, accepted_total, _, _ = jax.lax.while_loop(
         cond, round_body,
         (out, count, pending, t_cache, d_cache, rounds, accepted_total,
-         rng),
+         rng, eos_seen),
     )
+    result = out[:, :num_tokens]
+    if eos_id is not None:
+        # pin everything from the first eos on to the id (an eos row may
+        # have frozen mid-buffer; its unwritten tail holds zeros) —
+        # exactly generate's post-eos padding
+        hit = jnp.cumsum((result == eos_id).astype(jnp.int32), axis=1) > 0
+        result = jnp.where(hit, eos_id, result)
     if return_stats:
         proposed = jnp.maximum(rounds * k, 1)
-        return out[:, :num_tokens], {
+        return result, {
             "rounds": rounds,
             "acceptance_rate": accepted_total / proposed,
         }
-    return out[:, :num_tokens]
+    return result
 
 
 @partial(
@@ -338,6 +365,7 @@ def speculative_generate(
     static_argnames=(
         "config_target", "config_draft", "num_tokens", "draft_tokens",
         "attention_fn", "return_stats", "temperature", "top_k", "top_p",
+        "eos_id",
     ),
 )
 def speculative_generate_jit(
@@ -355,6 +383,7 @@ def speculative_generate_jit(
     rng: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Compiled :func:`speculative_generate` (one program: prefills +
     the whole while_loop of rounds)."""
@@ -363,4 +392,5 @@ def speculative_generate_jit(
         num_tokens, draft_tokens=draft_tokens, attention_fn=attention_fn,
         lengths=lengths, return_stats=return_stats,
         temperature=temperature, rng=rng, top_k=top_k, top_p=top_p,
+        eos_id=eos_id,
     )

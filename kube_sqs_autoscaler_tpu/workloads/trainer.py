@@ -226,12 +226,11 @@ def train(args) -> dict:
             if bad:
                 raise SystemExit(f"--lora-rank does not combine with {flag}")
     if args.hf_checkpoint:
-        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1)):
-            if bad:
-                raise SystemExit(
-                    f"--hf-checkpoint is a llama-family base; it does not "
-                    f"combine with {flag}"
-                )
+        if args.moe:
+            raise SystemExit(
+                "--hf-checkpoint is a llama-family base; it does not "
+                "combine with --moe"
+            )
         if args.family != "llama":
             log.info("--hf-checkpoint implies --family llama")
             args.family = "llama"
@@ -313,16 +312,34 @@ def train(args) -> dict:
                 max_seq_len=args.seq_len,
             )
         if pipe > 1:
+            from functools import partial
+
             from .pipeline import (
-                init_llama_pipeline_train_state,
+                as_llama_pipeline_params,
+                init_llama_pipeline_params,
                 place_pipeline_state,
             )
 
+            if hf_base is not None:
+                # fine-tune the imported base THROUGH the pipeline: the
+                # flat HF weights stack into the stage layout (untied
+                # lm_head rides along — both schedules support it)
+                if model_config.n_layers % pipe:
+                    raise SystemExit(
+                        f"HF model has n_layers={model_config.n_layers}, "
+                        f"not divisible by --pipe-parallel {pipe}"
+                    )
+                stage_init = lambda rng, cfg: (  # noqa: E731
+                    as_llama_pipeline_params(hf_base)
+                )
+            else:
+                stage_init = partial(init_llama_pipeline_params,
+                                     n_stages=pipe)
             state = place_pipeline_state(
                 mesh,
-                init_llama_pipeline_train_state(
+                init_train_state(
                     jax.random.key(args.seed), model_config, train_config,
-                    n_stages=pipe,
+                    init_fn=stage_init,
                 ),
             )
         elif args.moe:

@@ -323,6 +323,79 @@ def test_gpt_pipeline_grad_accum_learns():
     assert losses[-1] < losses[0]
 
 
+def test_hf_checkpoint_pipelines(tmp_path):
+    # fine-tune an imported HF llama THROUGH the pipeline (untied
+    # lm_head riding the 1F1B head), then serve the pp-trained
+    # checkpoint flat — the full hf -> pp-train -> serve loop
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    ))
+    hf_dir, ckpt = tmp_path / "hf", tmp_path / "trained"
+    hf.save_pretrained(hf_dir)
+    result = trainer_main([
+        "--hf-checkpoint", str(hf_dir), "--pipe-parallel", "2",
+        "--pipe-microbatches", "2", "--pipe-schedule", "1f1b",
+        "--steps", "4", "--batch-size", "8", "--seq-len", "16",
+        "--learning-rate", "1e-2", "--log-every", "1", "--overfit",
+        "--checkpoint-dir", str(ckpt), "--checkpoint-every", "0",
+    ])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+    # the untied readout survives the train->serve handoff: a fresh-init
+    # reference has no lm_head, so restore_params must discover it from
+    # the on-disk structure (silently dropping it would serve the tied
+    # embedding as the readout — wrong logits, no error)
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        TrainCheckpointer,
+        load_model_layout,
+        load_model_manifest,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+
+    smesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    family, config = load_model_manifest(str(ckpt))
+    served = TrainCheckpointer(str(ckpt)).restore_params(
+        smesh, family, config, layout=load_model_layout(str(ckpt))
+    )
+    assert "lm_head" in served
+    assert served["lm_head"].shape == (config.vocab_size, config.d_model)
+
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--checkpoint-dir", str(ckpt), "--demo", "2",
+                 "--batch-size", "1", "--seq-len", "8",
+                 "--generate-tokens", "3"])
+
+    # same guarantee for the FLAT layout (no pp): an untied fine-tune
+    # checkpoint restores with its lm_head
+    flat_ckpt = tmp_path / "flat"
+    trainer_main([
+        "--hf-checkpoint", str(hf_dir), "--steps", "2", "--batch-size",
+        "8", "--seq-len", "16", "--log-every", "1",
+        "--checkpoint-dir", str(flat_ckpt), "--checkpoint-every", "0",
+    ])
+    family2, config2 = load_model_manifest(str(flat_ckpt))
+    flat_served = TrainCheckpointer(str(flat_ckpt)).restore_params(
+        smesh, family2, config2, layout=load_model_layout(str(flat_ckpt))
+    )
+    assert "lm_head" in flat_served
+
+
 def test_pipeline_grad_accum_requires_divisible_batch():
     from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
     from kube_sqs_autoscaler_tpu.workloads.pipeline import (
