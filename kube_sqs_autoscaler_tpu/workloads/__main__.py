@@ -153,7 +153,6 @@ def main(argv=None) -> None:
              args.temperature > 0.0),
             ("--speculative-draft-layers",
              bool(args.speculative_draft_layers)),
-            ("--model-parallel", bool(args.model_parallel)),
             ("--continuous", args.continuous),
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
         ):
@@ -391,28 +390,42 @@ def main(argv=None) -> None:
             ),
         }
     if args.beams > 1:
-        from .beam import beam_search_jit
+        if mesh is not None:
+            # beams over the (data, model) mesh: expanded rows shard over
+            # data, weights/caches keep their Megatron shardings
+            from .beam import make_beam_serving_fn
 
-        if family == "llama":
-            from .llama import llama_attention_fn_for as _prefill_pick
-
-            def _beam_prefill_attention(bucket_len):
-                return _prefill_pick(model_config, bucket_len)
-        else:
-            from .flash import attention_fn_for as _prefill_pick
-
-            _beam_prefill_attention = _prefill_pick
-
-        worker_kwargs["generate_fn"] = (
-            # prefill picks the bucket-length flash/dense kernel like the
-            # plain generate paths (memoized factories, jit-static safe)
-            lambda p, t, n, lengths: beam_search_jit(
-                p, model_config, t, n, args.beams,
+            beam_run = make_beam_serving_fn(
+                mesh, model_config, params, beams=args.beams,
                 eos_id=service_config.eos_id,
-                attention_fn=_beam_prefill_attention(t.shape[1]),
-                lengths=lengths,
             )
-        )
+            worker_kwargs["generate_fn"] = (
+                lambda p, t, n, lengths: beam_run(p, t, lengths, n)
+            )
+        else:
+            from .beam import beam_search_jit
+
+            if family == "llama":
+                from .llama import llama_attention_fn_for as _prefill_pick
+
+                def _beam_prefill_attention(bucket_len):
+                    return _prefill_pick(model_config, bucket_len)
+            else:
+                from .flash import attention_fn_for as _prefill_pick
+
+                _beam_prefill_attention = _prefill_pick
+
+            worker_kwargs["generate_fn"] = (
+                # prefill picks the bucket-length flash/dense kernel like
+                # the plain generate paths (memoized factories,
+                # jit-static safe)
+                lambda p, t, n, lengths: beam_search_jit(
+                    p, model_config, t, n, args.beams,
+                    eos_id=service_config.eos_id,
+                    attention_fn=_beam_prefill_attention(t.shape[1]),
+                    lengths=lengths,
+                )
+            )
         log.info("Beam search: %d beams", args.beams)
 
     if args.speculative_draft_layers:

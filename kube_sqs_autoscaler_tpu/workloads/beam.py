@@ -145,6 +145,53 @@ def beam_search(
     return out[:, 0]
 
 
+def make_beam_serving_fn(
+    mesh,
+    config,
+    params: dict,
+    *,
+    beams: int,
+    length_penalty: float = 0.0,
+    eos_id: int | None = None,
+):
+    """Compile :func:`beam_search` over a ``(data, model)`` serving mesh.
+
+    Beams ride the batch axis, so the ``B*W`` expanded rows shard over
+    ``data`` and the per-step reorder (``cache[flat_parent]``) lowers to
+    an XLA gather across the data shards; weights and the KV caches keep
+    their Megatron/head shardings — the same layout contract as
+    :func:`.decode.compile_serving_fns`.  Prefill runs the config's
+    default attention (window-aware for llama), like the sharded
+    generate path.  Returns ``run(params, prompt, lengths, num_tokens)
+    -> [B, num_tokens]`` with ``num_tokens`` static.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .train import param_shardings
+
+    if mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "beam serving uses a (data, model) mesh; got seq="
+            f"{mesh.shape['seq']}"
+        )
+    p_shard = param_shardings(mesh, params)
+    tokens_2d = NamedSharding(mesh, P("data", None))
+    tokens_1d = NamedSharding(mesh, P("data"))
+
+    def run(params, prompt, lengths, num_tokens):
+        return beam_search(
+            params, config, prompt, num_tokens, beams=beams,
+            length_penalty=length_penalty, eos_id=eos_id, lengths=lengths,
+        )
+
+    return jax.jit(
+        run,
+        static_argnames=("num_tokens",),
+        in_shardings=(p_shard, tokens_2d, tokens_1d),
+        out_shardings=tokens_2d,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(

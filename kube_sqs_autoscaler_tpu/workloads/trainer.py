@@ -312,11 +312,9 @@ def train(args) -> dict:
                 max_seq_len=args.seq_len,
             )
         if pipe > 1:
-            from functools import partial
-
             from .pipeline import (
                 as_llama_pipeline_params,
-                init_llama_pipeline_params,
+                init_llama_pipeline_train_state,
                 place_pipeline_state,
             )
 
@@ -329,19 +327,18 @@ def train(args) -> dict:
                         f"HF model has n_layers={model_config.n_layers}, "
                         f"not divisible by --pipe-parallel {pipe}"
                     )
-                stage_init = lambda rng, cfg: (  # noqa: E731
-                    as_llama_pipeline_params(hf_base)
+                fresh = init_train_state(
+                    jax.random.key(args.seed), model_config, train_config,
+                    init_fn=lambda rng, cfg: as_llama_pipeline_params(
+                        hf_base
+                    ),
                 )
             else:
-                stage_init = partial(init_llama_pipeline_params,
-                                     n_stages=pipe)
-            state = place_pipeline_state(
-                mesh,
-                init_train_state(
+                fresh = init_llama_pipeline_train_state(
                     jax.random.key(args.seed), model_config, train_config,
-                    init_fn=stage_init,
-                ),
-            )
+                    n_stages=pipe,
+                )
+            state = place_pipeline_state(mesh, fresh)
         elif args.moe:
             from .moe import MoeConfig, init_llama_moe_train_state
 

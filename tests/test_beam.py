@@ -119,6 +119,36 @@ def test_ragged_prompts(params):
     np.testing.assert_array_equal(got, full)
 
 
+def test_beam_tp_sharded_matches_single_chip(params):
+    # VERDICT r3 composition hole: beams over a (data, model) mesh —
+    # identical sequences to the single-chip search (deterministic)
+    from kube_sqs_autoscaler_tpu.workloads.beam import make_beam_serving_fn
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params, param_shardings(mesh, params))
+    prompt = prompt_tokens(batch=2)
+    lengths = jnp.full((2,), prompt.shape[1], jnp.int32)
+    single = np.asarray(beam_search(params, TINY, prompt, 8, beams=3))
+
+    run = make_beam_serving_fn(mesh, TINY, placed, beams=3)
+    sharded = np.asarray(run(placed, prompt, lengths, 8))
+    np.testing.assert_array_equal(sharded, single)
+
+    # eos rides the sharded search too
+    eos = int(single[0, 1])
+    single_eos = np.asarray(
+        beam_search(params, TINY, prompt, 8, beams=3, eos_id=eos)
+    )
+    run_eos = make_beam_serving_fn(mesh, TINY, placed, beams=3, eos_id=eos)
+    np.testing.assert_array_equal(
+        np.asarray(run_eos(placed, prompt, lengths, 8)), single_eos
+    )
+
+
 def test_serve_binary_beams_flag():
     from kube_sqs_autoscaler_tpu.workloads.__main__ import main
 
@@ -126,6 +156,14 @@ def test_serve_binary_beams_flag():
           "--generate-tokens", "4", "--beams", "3"])
     main(["--family", "llama", "--demo", "2", "--batch-size", "1",
           "--seq-len", "8", "--generate-tokens", "4", "--beams", "2"])
+    # tp-sharded beams from the binary (the fail-fast this composed away)
+    import os
+
+    if "xla_force_host_platform_device_count" in os.environ.get(
+            "XLA_FLAGS", ""):
+        main(["--demo", "4", "--batch-size", "4", "--seq-len", "8",
+              "--generate-tokens", "4", "--beams", "2",
+              "--model-parallel", "2", "--eos-id", "5"])
     with pytest.raises(SystemExit, match="deterministic"):
         main(["--demo", "1", "--generate-tokens", "4", "--beams", "2",
               "--temperature", "0.5"])
