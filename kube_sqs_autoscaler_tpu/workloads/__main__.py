@@ -87,7 +87,9 @@ def main(argv=None) -> None:
         help="continuous batching: rolling decode slots that refill as "
              "each message finishes instead of batch-at-a-time (requires "
              "--generate-tokens >= 1; both families, sampling/eos/"
-             "tokenizer/replies supported; single chip)",
+             "tokenizer/replies supported; composes with "
+             "--model-parallel — slots shard batch-over-data, "
+             "heads-over-model)",
     )
     parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
@@ -488,16 +490,10 @@ def main(argv=None) -> None:
             "%d proposals/round", n_draft, k,
         )
 
-    if args.continuous:
-        # rolling-slot serving: single-chip decode path, both families,
-        # greedy or sampled, eos, tokenizer, replies.  Only the
-        # mesh-sharded variant stays batch-mode (the slot insertion
-        # splices into a local per-row cache) — fail fast, don't ignore
-        for flag, bad in (("--model-parallel", bool(args.model_parallel)),
-                          ("--generate-tokens >= 1 required",
-                           args.generate_tokens < 1)):
-            if bad:
-                raise SystemExit(f"--continuous does not support {flag}")
+    if args.continuous and args.generate_tokens < 1:
+        # rolling-slot serving: both families, greedy or sampled, eos,
+        # tokenizer, replies, single-chip or (data, model)-sharded
+        raise SystemExit("--continuous requires --generate-tokens >= 1")
 
     if args.demo:
         import numpy as np
@@ -520,7 +516,8 @@ def main(argv=None) -> None:
             cworker = ContinuousWorker(queue, params, model_config,
                                        service_config, family=family,
                                        tokenizer=tokenizer,
-                                       result_queue=result_queue)
+                                       result_queue=result_queue,
+                                       mesh=mesh)
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
@@ -571,6 +568,7 @@ def main(argv=None) -> None:
             # AWS SQS addresses queues per call by url, so the same
             # client publishes replies when --result-queue-url is set
             result_queue=(queue if args.result_queue_url else None),
+            mesh=mesh,
         )
         _maybe_serve_metrics(args.metrics_port, cworker)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)

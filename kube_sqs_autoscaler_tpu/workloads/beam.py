@@ -167,13 +167,10 @@ def make_beam_serving_fn(
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .decode import require_serving_mesh
     from .train import param_shardings
 
-    if mesh.shape.get("seq", 1) != 1:
-        raise ValueError(
-            "beam serving uses a (data, model) mesh; got seq="
-            f"{mesh.shape['seq']}"
-        )
+    require_serving_mesh(mesh)
     p_shard = param_shardings(mesh, params)
     tokens_2d = NamedSharding(mesh, P("data", None))
     tokens_1d = NamedSharding(mesh, P("data"))

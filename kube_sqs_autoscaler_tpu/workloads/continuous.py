@@ -52,13 +52,7 @@ from .decode import _pick, init_cache, prefill
 log = logging.getLogger(__name__)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("config", "prompt_len", "family", "temperature",
-                     "top_k", "top_p"),
-    donate_argnums=(1,),
-)
-def _insert_row(
+def _insert_row_impl(
     params: dict,
     cache: dict,
     row: jax.Array,
@@ -108,6 +102,14 @@ def _insert_row(
     return {"layers": new_layers, "length": lengths}, first
 
 
+_insert_row = partial(
+    jax.jit,
+    static_argnames=("config", "prompt_len", "family", "temperature",
+                     "top_k", "top_p"),
+    donate_argnums=(1,),
+)(_insert_row_impl)
+
+
 @dataclass
 class _Slot:
     busy: bool = False
@@ -145,6 +147,7 @@ class ContinuousBatcher:
         top_p: float = 1.0,
         eos_id: int | None = None,
         sample_seed: int = 0,
+        mesh=None,
     ) -> None:
         if prompt_len + generate_tokens > config.max_seq_len:
             raise ValueError(
@@ -170,6 +173,7 @@ class ContinuousBatcher:
         self.top_k = top_k
         self.top_p = top_p
         self.eos_id = eos_id
+        self.mesh = mesh
         if family == "llama":
             from .llama import init_llama_cache
 
@@ -179,15 +183,63 @@ class ContinuousBatcher:
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token for the next decode step
         self._current = jnp.zeros((batch_size,), jnp.int32)
-        # one PRNG key per engine step / insert (greedy: no keys at all,
-        # so the compiled programs take a None operand)
-        if temperature > 0.0:
+        if mesh is not None:
+            # mesh-sharded slots: batch rows over "data", heads over
+            # "model" (the serving layout of decode.cache_shardings);
+            # the one-prompt insert prefill replicates over data — tp is
+            # the axis that matters for a model too big for one chip
+            from .decode import require_serving_mesh
+
+            require_serving_mesh(mesh)
+            if batch_size % mesh.shape["data"]:
+                raise ValueError(
+                    f"batch_size {batch_size} not divisible by the "
+                    f"mesh's data axis ({mesh.shape['data']})"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .decode import cache_shardings
+
+            self._cache_shard = cache_shardings(mesh, self.cache)
+            self._rows_shard = NamedSharding(mesh, P("data"))
+            self.cache = jax.device_put(self.cache, self._cache_shard)
+            self._current = jax.device_put(self._current, self._rows_shard)
+        # one PRNG key per engine step / insert.  Greedy single-chip: no
+        # keys at all (the compiled programs take a None operand); under
+        # a mesh the pinned in_shardings need a real (ignored) key even
+        # when greedy.
+        if temperature > 0.0 or mesh is not None:
             from .service import sampling_keys
 
             self._keys = sampling_keys(sample_seed)
         else:
             self._keys = itertools.repeat(None)
+        self._insert = self._make_insert()
         self._decode = self._make_decode_step()
+
+    def _make_insert(self):
+        statics = dict(
+            config=self.config, prompt_len=self.prompt_len,
+            family=self.family, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p,
+        )
+        if self.mesh is None:
+            return lambda params, cache, row, prompt, length, key: (
+                _insert_row(params, cache, row, prompt, length, key,
+                            **statics)
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(
+            partial(_insert_row_impl, **statics),
+            in_shardings=(param_shardings(self.mesh, self.params),
+                          self._cache_shard, rep, rep, rep, rep),
+            out_shardings=(self._cache_shard, rep),
+            donate_argnums=(1,),
+        )
 
     def _make_decode_step(self):
         if self.family == "llama":
@@ -201,12 +253,24 @@ class ContinuousBatcher:
         # donate the cache: self.cache is reassigned from the result every
         # call, so the multi-layer KV buffers are reused in place instead
         # of copied per generated token (same as compile_serving_fns)
-        @partial(jax.jit, donate_argnums=(1,))
         def step(params, cache, tokens, key):
             logits, cache = step_fn(params, cache, tokens, config)
             return cache, _pick(logits, key, temperature, top_k, top_p)
 
-        return step
+        if self.mesh is None:
+            return jax.jit(step, donate_argnums=(1,))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(
+            step,
+            in_shardings=(param_shardings(self.mesh, self.params),
+                          self._cache_shard, self._rows_shard, rep),
+            out_shardings=(self._cache_shard, self._rows_shard),
+            donate_argnums=(1,),
+        )
 
     @property
     def free_slots(self) -> list[int]:
@@ -230,12 +294,10 @@ class ContinuousBatcher:
         real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
         ids[: real.size] = real
         length = max(1, real.size)
-        self.cache, first = _insert_row(
+        self.cache, first = self._insert(
             self.params, self.cache, jnp.asarray(row, jnp.int32),
             jnp.asarray(ids), jnp.asarray(length, jnp.int32),
-            next(self._keys), self.config, self.prompt_len,
-            family=self.family, temperature=self.temperature,
-            top_k=self.top_k, top_p=self.top_p,
+            next(self._keys),
         )
         first = int(first)
         self._current = self._current.at[row].set(first)
@@ -314,6 +376,7 @@ class ContinuousWorker:
         family: str = "gpt",
         tokenizer=None,
         result_queue=None,
+        mesh=None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -343,6 +406,7 @@ class ContinuousWorker:
             top_p=service_config.top_p,
             eos_id=service_config.eos_id,
             sample_seed=service_config.sample_seed,
+            mesh=mesh,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as

@@ -602,6 +602,19 @@ def generate_jit(
 # ---------------------------------------------------------------------------
 
 
+def require_serving_mesh(mesh: Mesh) -> None:
+    """The one serving-mesh contract check: decode needs a
+    ``(data, model)`` mesh — ring/sequence parallelism applies to
+    training and prefill, not token-by-token decode.  Shared by every
+    sharded serving factory (generate, beams, continuous slots)."""
+    if mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "serving uses a (data, model) mesh; got seq="
+            f"{mesh.shape['seq']} (ring/sequence parallelism applies to "
+            "training and prefill, not token-by-token decode)"
+        )
+
+
 def cache_shardings(mesh: Mesh, cache: dict) -> dict:
     """Cache layout on the mesh: batch over ``data``, the cache's head
     axis over ``model`` (full heads for the gpt family via ``wqkv``'s
@@ -648,12 +661,7 @@ def compile_serving_fns(
     """
     from .train import param_shardings
 
-    if mesh.shape.get("seq", 1) != 1:
-        raise ValueError(
-            "serving uses a (data, model) mesh; got seq="
-            f"{mesh.shape['seq']} (ring/sequence parallelism applies to "
-            "training and prefill, not token-by-token decode)"
-        )
+    require_serving_mesh(mesh)
     p_shard = param_shardings(mesh, params)
     tokens_1d = NamedSharding(mesh, P("data"))
     tokens_2d = NamedSharding(mesh, P("data", None))
