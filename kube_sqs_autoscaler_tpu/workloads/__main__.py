@@ -436,7 +436,6 @@ def main(argv=None) -> None:
         # temperature > 0 runs full speculative sampling (the rejection
         # rule keeps every emitted token an exact warped-target sample).
         for flag, bad in (
-            ("--model-parallel", bool(args.model_parallel)),
             ("--continuous", args.continuous),
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
         ):
@@ -468,23 +467,43 @@ def main(argv=None) -> None:
             )
         from dataclasses import replace
 
-        from .speculative import speculative_generate_jit
-
         from .service import sampling_keys
 
         draft_config = replace(model_config, n_layers=n_draft)
         spec_keys = sampling_keys(service_config.sample_seed)
-        worker_kwargs["generate_fn"] = (
-            lambda p, t, n, lengths: speculative_generate_jit(
-                p, model_config,
-                dict(p, layers=p["layers"][:n_draft]), draft_config,
-                t, n, k, lengths=lengths,
-                temperature=args.temperature,
-                rng=(next(spec_keys) if args.temperature > 0.0 else None),
+        if mesh is not None:
+            # speculative serving over the (data, model) mesh: both
+            # models' weights/caches keep their Megatron shardings, rows
+            # shard over data (acceptance and rollback are row-local)
+            from .speculative import make_speculative_serving_fn
+
+            spec_run = make_speculative_serving_fn(
+                mesh, model_config, params, draft_config,
+                draft_tokens=k, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
                 eos_id=service_config.eos_id,
             )
-        )
+            worker_kwargs["generate_fn"] = (
+                lambda p, t, n, lengths: spec_run(
+                    p, dict(p, layers=p["layers"][:n_draft]), t, lengths,
+                    next(spec_keys), n,
+                )
+            )
+        else:
+            from .speculative import speculative_generate_jit
+
+            worker_kwargs["generate_fn"] = (
+                lambda p, t, n, lengths: speculative_generate_jit(
+                    p, model_config,
+                    dict(p, layers=p["layers"][:n_draft]), draft_config,
+                    t, n, k, lengths=lengths,
+                    temperature=args.temperature,
+                    rng=(next(spec_keys) if args.temperature > 0.0
+                         else None),
+                    top_k=args.top_k, top_p=args.top_p,
+                    eos_id=service_config.eos_id,
+                )
+            )
         log.info(
             "Speculative decoding: %d-layer early-exit self-draft, "
             "%d proposals/round", n_draft, k,

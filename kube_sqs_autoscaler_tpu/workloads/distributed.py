@@ -117,6 +117,30 @@ def make_topology_mesh(
     return Mesh(grid, ("data", "seq", "model"))
 
 
+def make_topology_pipeline_mesh(
+    pipe_parallel: int,
+    model_parallel: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """A ``("pipe", "data"[, "model"])`` mesh ordered by physical
+    topology — the pipeline counterpart of :func:`make_topology_mesh`.
+    The pipe axis is the one that most wants torus placement: every
+    schedule slot ends in a single-neighbor ``ppermute`` hop, so stage
+    ``i`` and stage ``i+1`` should be physically adjacent chips.  Same
+    contract as :func:`.pipeline.make_pipeline_mesh`.
+    """
+    from .pipeline import make_pipeline_mesh
+
+    devices = devices if devices is not None else jax.devices()
+    # one source of truth for the pipeline mesh contract (divisibility,
+    # shape, axis names): build the enumeration-order mesh, then re-grid
+    # the same shape with topology-ordered placement
+    plain = make_pipeline_mesh(devices, pipe_parallel=pipe_parallel,
+                               model_parallel=model_parallel)
+    grid = mesh_utils.create_device_mesh(plain.devices.shape, devices)
+    return Mesh(grid, plain.axis_names)
+
+
 def make_hybrid_mesh(
     dcn_data_parallel: int,
     model_parallel: int = 1,

@@ -360,6 +360,64 @@ def speculative_generate(
     return result
 
 
+def make_speculative_serving_fn(
+    mesh,
+    config_target: ModelConfig,
+    params_target: dict,
+    config_draft: ModelConfig,
+    *,
+    draft_tokens: int = 4,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+):
+    """Compile the draft-and-verify loop over a ``(data, model)`` serving
+    mesh: batch rows shard over ``data``, both models' weights and KV
+    caches keep their Megatron/head shardings (the same layout contract
+    as :func:`.decode.compile_serving_fns` — chunk verify, single-token
+    draft steps, and the per-row rollback are all row-local, so nothing
+    about the speculative schedule fights the partitioner).
+
+    Returns ``run(params_target, params_draft, prompt, lengths, rng,
+    num_tokens) -> [B, num_tokens]`` with ``num_tokens`` static; ``rng``
+    is always an operand (ignored under greedy), so greedy and sampled
+    batches share the compiled layout.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .decode import require_serving_mesh
+    from .train import param_shardings
+
+    require_serving_mesh(mesh)
+    p_shard_t = param_shardings(mesh, params_target)
+    # the early-exit self-draft shares the target's leaves — same
+    # sharding rules, fewer layers — so its sharding tree is literally a
+    # slice of the target's
+    p_shard_d = dict(
+        p_shard_t, layers=p_shard_t["layers"][:config_draft.n_layers]
+    )
+    tokens_2d = NamedSharding(mesh, P("data", None))
+    tokens_1d = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+
+    def run(params_t, params_d, prompt, lengths, rng, num_tokens):
+        return speculative_generate(
+            params_t, config_target, params_d, config_draft, prompt,
+            num_tokens, draft_tokens=draft_tokens, lengths=lengths,
+            temperature=temperature,
+            rng=rng if temperature > 0.0 else None,
+            top_k=top_k, top_p=top_p, eos_id=eos_id,
+        )
+
+    return jax.jit(
+        run,
+        static_argnames=("num_tokens",),
+        in_shardings=(p_shard_t, p_shard_d, tokens_2d, tokens_1d, rep),
+        out_shardings=tokens_2d,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(

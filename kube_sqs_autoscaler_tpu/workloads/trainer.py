@@ -200,8 +200,7 @@ def train(args) -> dict:
         # with it (yet) and fail fast rather than silently ignore flags
         for flag, bad in (("--seq-parallel > 1", args.seq_parallel > 1),
                           ("--zigzag", args.zigzag),
-                          ("--moe", args.moe),
-                          ("--topology-mesh", args.topology_mesh)):
+                          ("--moe", args.moe)):
             if bad:
                 raise SystemExit(
                     f"--pipe-parallel does not combine with {flag}"
@@ -265,10 +264,17 @@ def train(args) -> dict:
         grad_accum=args.grad_accum, grad_clip_norm=args.grad_clip_norm,
     )
     if pipe > 1:
-        from .pipeline import make_pipeline_mesh
+        if args.topology_mesh:
+            from .distributed import make_topology_pipeline_mesh
 
-        mesh = make_pipeline_mesh(pipe_parallel=pipe,
-                                  model_parallel=args.model_parallel)
+            mesh = make_topology_pipeline_mesh(
+                pipe, model_parallel=args.model_parallel
+            )
+        else:
+            from .pipeline import make_pipeline_mesh
+
+            mesh = make_pipeline_mesh(pipe_parallel=pipe,
+                                      model_parallel=args.model_parallel)
     else:
         mesh_fn = make_topology_mesh if args.topology_mesh else make_mesh
         mesh = mesh_fn(model_parallel=args.model_parallel,

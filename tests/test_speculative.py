@@ -329,6 +329,42 @@ def test_speculative_sampling_end_to_end(models):
                              temperature=0.5)
 
 
+def test_speculative_tp_sharded_matches_single_chip(models):
+    # the last sharded-serving composition hole: draft-and-verify over a
+    # (data, model) mesh, identical greedy outputs to single-chip
+    from kube_sqs_autoscaler_tpu.workloads.speculative import (
+        make_speculative_serving_fn,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    params_t, _ = models
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params_t, param_shardings(mesh, params_t))
+    # early-exit self-draft: the target's own first layer
+    draft_cfg = ModelConfig(
+        vocab_size=TARGET.vocab_size, d_model=TARGET.d_model,
+        n_heads=TARGET.n_heads, n_layers=1, d_ff=TARGET.d_ff,
+        max_seq_len=TARGET.max_seq_len,
+    )
+    prompt = prompt_tokens(batch=4)
+    lengths = jnp.full((4,), prompt.shape[1], jnp.int32)
+    single = np.asarray(speculative_generate(
+        params_t, TARGET, dict(params_t, layers=params_t["layers"][:1]),
+        draft_cfg, prompt, 10, draft_tokens=3,
+    ))
+
+    run = make_speculative_serving_fn(mesh, TARGET, placed, draft_cfg,
+                                      draft_tokens=3)
+    sharded = np.asarray(run(
+        placed, dict(placed, layers=placed["layers"][:1]), prompt,
+        lengths, jax.random.key(0), 10,
+    ))
+    np.testing.assert_array_equal(sharded, single)
+
+
 def test_serve_binary_speculative_flag():
     """--speculative-draft-layers end to end for both families, plus the
     fail-fast guards (sampling, layer bound)."""
@@ -347,6 +383,14 @@ def test_serve_binary_speculative_flag():
     main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
           "--generate-tokens", "4", "--speculative-draft-layers", "2",
           "--eos-id", "5"])
+    # tp-sharded speculative serving (the last sharded-serving hole)
+    import os
+
+    if "xla_force_host_platform_device_count" in os.environ.get(
+            "XLA_FLAGS", ""):
+        main(["--demo", "4", "--batch-size", "4", "--seq-len", "8",
+              "--generate-tokens", "4", "--speculative-draft-layers", "2",
+              "--model-parallel", "2"])
     with pytest.raises(SystemExit, match="n_layers"):
         main(["--demo", "1", "--generate-tokens", "4",
               "--speculative-draft-layers", "99"])
