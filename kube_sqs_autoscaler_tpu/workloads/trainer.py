@@ -242,8 +242,7 @@ def train(args) -> dict:
     if args.hf_export:
         for flag, bad in (("--family gpt", args.family != "llama"
                            and not args.hf_checkpoint),
-                          ("--moe", args.moe),
-                          ("--pipe-parallel", pipe > 1)):
+                          ("--moe", args.moe)):
             if bad:
                 raise SystemExit(
                     f"--hf-export writes llama-family checkpoints; it "
@@ -885,9 +884,15 @@ def train(args) -> dict:
     if args.hf_export:
         from .hf_convert import save_hf_llama
 
+        export_params = final_state["params"]
+        if pipe > 1:
+            # pipeline-trained stacks export like any other llama run:
+            # unstack to the flat layout the converter writes
+            from .pipeline import unstack_llama_layers
+
+            export_params = unstack_llama_layers(export_params)
         save_hf_llama(
-            jax.device_get(final_state["params"]), model_config,
-            args.hf_export,
+            jax.device_get(export_params), model_config, args.hf_export,
         )
         log.info("Exported transformers checkpoint to %s", args.hf_export)
     if obs_server is not None:

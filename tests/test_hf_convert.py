@@ -174,12 +174,15 @@ def test_trainer_hf_export_flag(tmp_path):
     out = tmp_path / "hf_out"
     repo_root = Path(__file__).resolve().parent.parent
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_args = [
+        sys.executable, "-m", "kube_sqs_autoscaler_tpu.workloads.trainer",
+        "--family", "llama", "--steps", "2", "--batch-size", "8",
+        "--seq-len", "16", "--d-model", "64", "--n-heads", "4",
+        "--n-kv-heads", "2", "--n-layers", "2", "--vocab-size", "128",
+        "--log-every", "1",
+    ]
     run = subprocess.run(
-        [sys.executable, "-m", "kube_sqs_autoscaler_tpu.workloads.trainer",
-         "--family", "llama", "--steps", "2", "--batch-size", "8",
-         "--seq-len", "16", "--d-model", "64", "--n-heads", "4",
-         "--n-kv-heads", "2", "--n-layers", "2", "--vocab-size", "128",
-         "--hf-export", str(out), "--log-every", "1"],
+        base_args + ["--hf-export", str(out)],
         capture_output=True, text=True, env=env, cwd=repo_root,
     )
     assert run.returncode == 0, run.stderr[-3000:]
@@ -187,6 +190,20 @@ def test_trainer_hf_export_flag(tmp_path):
     from transformers import LlamaForCausalLM
 
     model = LlamaForCausalLM.from_pretrained(out)
+    assert model.config.num_hidden_layers == 2
+
+    # pipeline-trained weights export too (the stage stack unstacks to
+    # the flat layout the converter writes)
+    pp_out = tmp_path / "hf_out_pp"
+    run = subprocess.run(
+        base_args + ["--pipe-parallel", "2", "--pipe-microbatches", "2",
+                     "--hf-export", str(pp_out)],
+        capture_output=True, text=True,
+        env=dict(env, XLA_FLAGS="--xla_force_host_platform_device_count=8"),
+        cwd=repo_root,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    model = LlamaForCausalLM.from_pretrained(pp_out)
     assert model.config.num_hidden_layers == 2
 
 
