@@ -160,6 +160,17 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _family_forward(family: str):
+    """The family's forward for objectives that take a ``forward_fn``
+    seam (zig-zag): ``llama_forward`` for llama, ``None`` (the gpt
+    default) otherwise."""
+    if family == "llama":
+        from .llama import llama_forward
+
+        return llama_forward
+    return None
+
+
 def _lora_base_state(mesh, base):
     """The frozen-base 'state' of a LoRA run: just the placed params —
     no optimizer moments, no step (init_lora_train_state carries those
@@ -217,11 +228,12 @@ def train(args) -> dict:
             "dropped)"
         )
     if args.lora_rank:
-        # adapters wrap the flat dense params; layouts that restructure
-        # them (stage stacks, expert weights, permuted-order losses) are
-        # out of scope — fail fast.  Resume and grad-accum compose.
-        for flag, bad in (("--moe", args.moe), ("--pipe-parallel", pipe > 1),
-                          ("--zigzag", args.zigzag)):
+        # adapters wrap the flat dense params; layouts that RESTRUCTURE
+        # them (stage stacks, expert weights) are out of scope — fail
+        # fast.  Resume, grad-accum, and zig-zag (which permutes the
+        # batch, not the params) compose.
+        for flag, bad in (("--moe", args.moe),
+                          ("--pipe-parallel", pipe > 1)):
             if bad:
                 raise SystemExit(f"--lora-rank does not combine with {flag}")
     if args.hf_checkpoint:
@@ -558,7 +570,17 @@ def train(args) -> dict:
         from .lora import make_lora_train_step
 
         loss = None
-        if args.family == "llama":
+        if args.zigzag:
+            # permuted-order objective through the same loss seam: the
+            # adapters wrap flat params, so zig-zag composes like any
+            # other objective
+            from .zigzag import make_zigzag_loss
+
+            loss = make_zigzag_loss(
+                mesh, model_config, remat=train_config.remat,
+                forward_fn=_family_forward(args.family),
+            )
+        elif args.family == "llama":
             from .llama import llama_mesh_loss
 
             loss = llama_mesh_loss(model_config, train_config)
@@ -596,13 +618,10 @@ def train(args) -> dict:
     elif args.zigzag:
         from .zigzag import make_zigzag_train_step
 
-        forward_fn = None
-        if args.family == "llama":
-            from .llama import llama_forward
-
-            forward_fn = llama_forward
-        step_fn = make_zigzag_train_step(mesh, model_config, train_config,
-                                         state, forward_fn=forward_fn)
+        step_fn = make_zigzag_train_step(
+            mesh, model_config, train_config, state,
+            forward_fn=_family_forward(args.family),
+        )
     elif args.family == "llama":
         step_fn = make_llama_train_step(mesh, model_config, train_config,
                                         state)
@@ -654,20 +673,23 @@ def train(args) -> dict:
                                        model_config, moe_config, attend)
                 return next_token_nll(logits, tokens)
         elif args.zigzag:
-            from .zigzag import make_zigzag_ring_attention, zigzag_loss_fn
+            from .zigzag import make_zigzag_loss
 
-            zz_attend = make_zigzag_ring_attention(mesh)
-            zz_forward = None
-            if args.family == "llama":
-                from .llama import llama_forward
+            zz_eval_loss = make_zigzag_loss(
+                mesh, model_config, forward_fn=_family_forward(args.family)
+            )
+            if args.lora_rank:
+                from .lora import apply_lora
 
-                zz_forward = llama_forward
+                def zz_eval_params(state):
+                    return apply_lora(lora_frozen, state["adapters"],
+                                      lora_cfg)
+            else:
+                def zz_eval_params(state):
+                    return state["params"]
 
             def eval_fn_impl(state, tokens):
-                return zigzag_loss_fn(
-                    state["params"], tokens, model_config, mesh, zz_attend,
-                    forward_fn=zz_forward,
-                )
+                return zz_eval_loss(zz_eval_params(state), tokens)
         else:
             from .train import mesh_attention_fn
 

@@ -446,6 +446,28 @@ def zigzag_loss_fn(
     )
 
 
+def make_zigzag_loss(mesh: Mesh, config, remat: bool = False,
+                     forward_fn=None):
+    """The zig-zag objective in the ``make_train_step`` loss-seam shape:
+    builds the zig-zag ring attention once and returns
+    ``loss(params, tokens, attention_fn=None)``.  The seam's
+    ``attention_fn`` (plain ring) is deliberately discarded — zig-zag
+    inputs need the zig-zag schedule built here.  The one construction
+    site for every consumer (the train step below, the LoRA trainer
+    branch, the held-out eval), so the schedule/forward selection cannot
+    drift between them.  ``forward_fn`` selects the family (see
+    :func:`zigzag_loss_from_permuted`)."""
+    attend = make_zigzag_ring_attention(mesh)
+
+    def loss(params, tokens, attention_fn=None):  # seam signature
+        return zigzag_loss_fn(
+            params, tokens, config, mesh, attend,
+            remat=remat, forward_fn=forward_fn,
+        )
+
+    return loss
+
+
 def make_zigzag_train_step(mesh: Mesh, config, train_config, state,
                            forward_fn=None):
     """Compile a dp x sp x tp train step whose sequence parallelism runs
@@ -460,14 +482,6 @@ def make_zigzag_train_step(mesh: Mesh, config, train_config, state,
     """
     from .train import make_train_step
 
-    attend = make_zigzag_ring_attention(mesh)
-
-    def loss(params, tokens, attention_fn=None):  # seam signature
-        # the seam's attention_fn (plain ring) is deliberately discarded:
-        # zig-zag inputs need the zig-zag schedule built above
-        return zigzag_loss_fn(
-            params, tokens, config, mesh, attend,
-            remat=train_config.remat, forward_fn=forward_fn,
-        )
-
+    loss = make_zigzag_loss(mesh, config, remat=train_config.remat,
+                            forward_fn=forward_fn)
     return make_train_step(mesh, config, train_config, state, loss=loss)

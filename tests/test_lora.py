@@ -311,6 +311,25 @@ def test_lora_trainer_grad_accum_learns():
     assert losses[-1] < losses[0]
 
 
+def test_lora_zigzag_trains_and_evals(caplog):
+    # adapters wrap flat params, so the permuted-order zig-zag objective
+    # composes: --lora-rank + --zigzag learns and evaluates
+    import logging
+
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    with caplog.at_level(logging.INFO):
+        result = main(TRAINER_LORA_FLAGS + [
+            "--steps", "4", "--seq-parallel", "2", "--zigzag", "--overfit",
+            "--eval-every", "4", "--eval-batches", "2",
+        ])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert any("eval_loss" in r.getMessage() for r in caplog.records)
+
+
 def test_dense_resume_of_lora_dir_fails_loudly(tmp_path):
     from kube_sqs_autoscaler_tpu.workloads.trainer import main
 
