@@ -402,6 +402,19 @@ def init_llama_moe_train_state(
     )
 
 
+def _require_no_remat(train_config) -> None:
+    """The MoE forwards collect per-layer aux losses through a closure
+    over the mlp seam; ``jax.checkpoint`` re-traces the block in the
+    backward pass, so closure-captured intermediates would leak tracers.
+    Fail fast instead of silently ignoring the flag."""
+    if getattr(train_config, "remat", False):
+        raise ValueError(
+            "TrainConfig.remat is not supported for the MoE loss (the "
+            "aux-loss collection is incompatible with jax.checkpoint "
+            "re-tracing); set remat=False"
+        )
+
+
 def _make_moe_step(mesh, config, moe: MoeConfig, train_config, state: dict,
                    loss_fn):
     """Shared MoE step builder: the remat guard and the
@@ -411,16 +424,7 @@ def _make_moe_step(mesh, config, moe: MoeConfig, train_config, state: dict,
 
     from .train import make_train_step
 
-    if getattr(train_config, "remat", False):
-        # the MoE forwards collect per-layer aux losses through a closure
-        # over the mlp seam; jax.checkpoint re-traces the block in the
-        # backward pass, so closure-captured intermediates would leak
-        # tracers.  Fail fast instead of silently ignoring the flag.
-        raise ValueError(
-            "TrainConfig.remat is not supported for the MoE loss (the "
-            "aux-loss collection is incompatible with jax.checkpoint "
-            "re-tracing); set remat=False"
-        )
+    _require_no_remat(train_config)
     return make_train_step(
         mesh, config, train_config, state,
         loss=partial(loss_fn, config=config, moe=moe),
@@ -428,6 +432,57 @@ def _make_moe_step(mesh, config, moe: MoeConfig, train_config, state: dict,
         # shared attention seam like the dense llama step's
         window=getattr(config, "sliding_window", None),
     )
+
+
+def make_zigzag_moe_train_step(mesh, config, moe: MoeConfig, train_config,
+                               state: dict, llama: bool = False):
+    """MoE × zig-zag: the routed expert MLP rides the permuted-order
+    zig-zag objective.
+
+    The expert machinery is already layout-invariant (flattened-stream
+    routing groups — which tokens share capacity does not depend on the
+    batch/sequence layout), so the composition is purely an objective
+    one: run the family forward with the sparse MLP in its ``mlp`` seam
+    and the zig-zag schedule as its attention, add the Switch aux term
+    to the permuted-order NLL.  Sliding-window llama-MoE configs fail
+    fast (the ring schedule has no window skip), like every other sp
+    consumer.
+    """
+    from .train import make_train_step
+    from .zigzag import make_zigzag_loss
+
+    _require_no_remat(train_config)
+    if getattr(config, "sliding_window", None) is not None:
+        raise ValueError(
+            "sliding_window does not compose with zig-zag sequence "
+            "parallelism (no windowed ring schedule); use a "
+            "(data, model) mesh"
+        )
+    if llama:
+        from .llama import llama_forward as family_forward
+
+        expert_mlp = llama_moe_mlp
+    else:
+        from .model import forward as family_forward
+
+        expert_mlp = moe_mlp
+
+    def forward_factory():
+        # fresh aux collection per loss evaluation (trace) — the same
+        # closure discipline as the flat MoE objectives
+        sparse_mlp, mean_aux = _collecting_mlp(expert_mlp, moe)
+
+        def fwd(params, tokens, config, attention_fn, positions=None,
+                remat=False):
+            return family_forward(
+                params, tokens, config, attention_fn, mlp=sparse_mlp,
+                positions=positions, remat=remat,
+            )
+
+        return fwd, lambda nll: nll + moe.aux_loss_weight * mean_aux()
+
+    loss = make_zigzag_loss(mesh, config, forward_factory=forward_factory)
+    return make_train_step(mesh, config, train_config, state, loss=loss)
 
 
 def make_llama_moe_train_step(mesh, config, moe: MoeConfig, train_config,

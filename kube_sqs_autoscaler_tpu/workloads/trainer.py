@@ -221,12 +221,6 @@ def train(args) -> dict:
                 f"--batch-size {args.batch_size} not divisible by "
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
-    if args.moe and args.zigzag:
-        raise SystemExit(
-            "--moe does not combine with --zigzag (the MoE loss runs the "
-            "seam's ring attention; a zig-zag schedule would be silently "
-            "dropped)"
-        )
     if args.lora_rank:
         # adapters wrap the flat dense params; layouts that RESTRUCTURE
         # them (stage stacks, expert weights) are out of scope — fail
@@ -605,6 +599,13 @@ def train(args) -> dict:
         )
         step_fn = make_pp_step(mesh, model_config, pipe_config,
                                train_config, state)
+    elif args.moe and args.zigzag:
+        from .moe import make_zigzag_moe_train_step
+
+        step_fn = make_zigzag_moe_train_step(
+            mesh, model_config, moe_config, train_config, state,
+            llama=args.family == "llama",
+        )
     elif args.moe and args.family == "llama":
         from .moe import make_llama_moe_train_step
 

@@ -447,23 +447,36 @@ def zigzag_loss_fn(
 
 
 def make_zigzag_loss(mesh: Mesh, config, remat: bool = False,
-                     forward_fn=None):
+                     forward_fn=None, forward_factory=None):
     """The zig-zag objective in the ``make_train_step`` loss-seam shape:
     builds the zig-zag ring attention once and returns
     ``loss(params, tokens, attention_fn=None)``.  The seam's
     ``attention_fn`` (plain ring) is deliberately discarded — zig-zag
     inputs need the zig-zag schedule built here.  The one construction
     site for every consumer (the train step below, the LoRA trainer
-    branch, the held-out eval), so the schedule/forward selection cannot
-    drift between them.  ``forward_fn`` selects the family (see
-    :func:`zigzag_loss_from_permuted`)."""
+    branch, the held-out eval, the MoE composition), so the
+    schedule/forward selection cannot drift between them.
+
+    ``forward_fn`` selects the family (see
+    :func:`zigzag_loss_from_permuted`).  ``forward_factory`` (mutually
+    exclusive) serves consumers whose forward collects per-trace state:
+    called once per loss evaluation, it returns ``(forward_fn,
+    finalize)`` where ``finalize(nll) -> loss`` folds the collected
+    state into the objective — the MoE aux term rides this."""
+    if forward_fn is not None and forward_factory is not None:
+        raise ValueError("pass forward_fn or forward_factory, not both")
     attend = make_zigzag_ring_attention(mesh)
 
     def loss(params, tokens, attention_fn=None):  # seam signature
-        return zigzag_loss_fn(
+        if forward_factory is not None:
+            fwd, finalize = forward_factory()
+        else:
+            fwd, finalize = forward_fn, None
+        nll = zigzag_loss_fn(
             params, tokens, config, mesh, attend,
-            remat=remat, forward_fn=forward_fn,
+            remat=remat, forward_fn=fwd,
         )
+        return finalize(nll) if finalize is not None else nll
 
     return loss
 
