@@ -139,11 +139,7 @@ def init_pipeline_params(
         raise ValueError(
             f"n_layers={config.n_layers} not divisible by n_stages={n_stages}"
         )
-    params = init_params(rng, config)
-    stages = stack_layers(params)
-    del params["layers"]
-    params["stages"] = stages
-    return params
+    return as_pipeline_params(init_params(rng, config))
 
 
 def stack_llama_layers(params: dict) -> dict:
@@ -154,12 +150,14 @@ def stack_llama_layers(params: dict) -> dict:
     heads; a fused ``2*kv_dim`` chunk crosses the k/v boundary) and
     ``w_gate_up`` into ``w_gate``/``w_up`` (contiguous ff columns).
     :func:`.llama._project_qkv` / :func:`.llama._swiglu` accept both
-    layouts."""
+    layouts.  MoE layers (no dense ``w_gate_up``; router + expert
+    stacks instead) pass through with just the kv split."""
     stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *params["layers"])
     wk, wv = jnp.split(stacked.pop("wkv"), 2, axis=-1)
     stacked["wk"], stacked["wv"] = wk, wv
-    w_gate, w_up = jnp.split(stacked.pop("w_gate_up"), 2, axis=-1)
-    stacked["w_gate"], stacked["w_up"] = w_gate, w_up
+    if "w_gate_up" in stacked:
+        w_gate, w_up = jnp.split(stacked.pop("w_gate_up"), 2, axis=-1)
+        stacked["w_gate"], stacked["w_up"] = w_gate, w_up
     return stacked
 
 
@@ -172,14 +170,24 @@ def unstack_llama_layers(params: dict) -> dict:
     stages = dict(params["stages"])
     wk, wv = stages.pop("wk"), stages.pop("wv")
     stages["wkv"] = jnp.concatenate([wk, wv], axis=-1)
-    w_gate, w_up = stages.pop("w_gate"), stages.pop("w_up")
-    stages["w_gate_up"] = jnp.concatenate([w_gate, w_up], axis=-1)
+    if "w_gate" in stages:
+        w_gate, w_up = stages.pop("w_gate"), stages.pop("w_up")
+        stages["w_gate_up"] = jnp.concatenate([w_gate, w_up], axis=-1)
     n_layers = next(iter(stages.values())).shape[0]
     flat = {k: v for k, v in params.items() if k != "stages"}
     flat["layers"] = [
         {k: v[i] for k, v in stages.items()} for i in range(n_layers)
     ]
     return flat
+
+
+def as_pipeline_params(params: dict) -> dict:
+    """Flat gpt-family params -> the stage-stacked pipeline layout (the
+    non-layer leaves pass through; the gpt counterpart of
+    :func:`as_llama_pipeline_params`)."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = stack_layers(params)
+    return out
 
 
 def as_llama_pipeline_params(params: dict) -> dict:
@@ -221,9 +229,35 @@ def stage_partition_specs(stages: dict, mesh: Mesh) -> dict:
     return {k: _stage_spec(k, with_model) for k in stages}
 
 
+def _moe_layer_scan(block_call, x, stage_layers, expert_mlp, moe):
+    """The MoE variant of the per-stage layer scan: the aux loss rides
+    the scan carry (a Python-list collection like the flat objectives
+    use would leak tracers out of ``lax.scan``).  ``block_call(h, layer,
+    mlp)`` runs one block with the given mlp seam; returns
+    ``(out, aux_sum)`` — the SUM of this stage's per-layer aux terms.
+    """
+    def one_layer(carry, layer):
+        h, aux_sum = carry
+        box = []
+
+        def sparse_mlp(v, lyr):
+            out, aux = expert_mlp(v, lyr, moe)
+            box.append(aux)
+            return out
+
+        h = block_call(h, layer, sparse_mlp)
+        return (h, aux_sum + box[0]), None
+
+    (out, aux_sum), _ = jax.lax.scan(
+        one_layer, (x, jnp.zeros((), jnp.float32)), stage_layers
+    )
+    return out, aux_sum
+
+
 def _stage_apply(
     stage_layers: dict, x: jax.Array, config: ModelConfig,
     remat: bool = False, tp_size: int = 1, attention_fn=None,
+    moe=None, expert_mlp=None,
 ) -> jax.Array:
     """Run one stage's stacked layers over an activation microbatch.
 
@@ -268,6 +302,14 @@ def _stage_apply(
         attention_fn = attention_fn_for(x.shape[1])
     attend = attention_fn
 
+    if moe is not None:
+        # routed expert MLP in the block's mlp seam; aux rides the carry
+        return _moe_layer_scan(
+            lambda h, layer, mlp: block(h, layer, cfg, attend, mlp,
+                                        reduce, promote),
+            x, stage_layers, expert_mlp, moe,
+        )
+
     def one_layer(h, layer):
         return block(h, layer, cfg, attend, None, reduce, promote), None
 
@@ -278,6 +320,7 @@ def _stage_apply(
 def _llama_stage_apply(
     stage_layers: dict, x: jax.Array, config,
     remat: bool = False, tp_size: int = 1, attention_fn=None,
+    moe=None, expert_mlp=None,
 ) -> jax.Array:
     """The llama-family counterpart of :func:`_stage_apply`: one stage's
     stacked llama layers (RoPE/GQA/RMSNorm/SwiGLU via
@@ -330,6 +373,13 @@ def _llama_stage_apply(
 
     attend = gqa_adapt(attention_fn)
     positions = jnp.arange(x.shape[1])
+
+    if moe is not None:
+        return _moe_layer_scan(
+            lambda h, layer, mlp: block(h, layer, cfg, positions, attend,
+                                        mlp, reduce, promote),
+            x, stage_layers, expert_mlp, moe,
+        )
 
     def one_layer(h, layer):
         return block(h, layer, cfg, positions, attend, None, reduce,
@@ -419,6 +469,8 @@ def _pipeline_body(
     tp_size: int = 1,
     attention_fn=None,
     stage_apply=None,
+    moe_aux: bool = False,
+    data_size: int = 1,
 ) -> jax.Array:
     """Per-device GPipe schedule (inside a fully-manual ``shard_map``).
 
@@ -430,6 +482,15 @@ def _pipeline_body(
     a pure lockstep loop).  Returns the fully-processed microbatches with
     the same layout.  ``stage_apply`` is the family seam (default: the
     gpt :func:`_stage_apply`; llama passes :func:`_llama_stage_apply`).
+
+    ``moe_aux=True``: ``stage_apply`` returns ``(y, aux_sum)`` per
+    microbatch; warmup/drain slots (whose clipped reads recompute a
+    microbatch whose output is masked) are masked out of the aux
+    accumulation too, and the body returns ``(outputs, aux_total)`` with
+    ``aux_total`` the psum over pipe AND data shards (divided by
+    ``data_size`` — each data shard routed its own rows, so the global
+    term is the mean over shards of the per-shard layer/microbatch
+    sums).
     """
     stage_apply = stage_apply or _stage_apply
     stage = jax.lax.axis_index(axis_name)
@@ -450,15 +511,24 @@ def _pipeline_body(
 
     act0 = x_micro[0] * 0.0
     out0 = x_micro * 0.0
+    aux0 = jnp.zeros((), jnp.float32)
 
     def step(carry, t):
-        act_in, outputs = carry
+        act_in, outputs, aux_acc = carry
         fresh = x_micro[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, fresh, act_in)
-        act_out = stage_apply(
+        applied = stage_apply(
             stage_layers, inp, config, remat=remat, tp_size=tp_size,
             attention_fn=attention_fn,
         )
+        if moe_aux:
+            act_out, aux = applied
+            # stage s runs microbatch m at slot t = m + s; anything else
+            # is warmup/drain garbage whose aux must not count
+            valid = (t >= stage) & (t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            act_out = applied
 
         out_idx = jnp.clip(t - last, 0, n_micro - 1)
         outputs = jnp.where(
@@ -469,10 +539,10 @@ def _pipeline_body(
         # hand every stage's activation to its successor (single ICI hop)
         ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         act_next = jax.lax.ppermute(act_out, axis_name, ring)
-        return (act_next, outputs), None
+        return (act_next, outputs, aux_acc), None
 
-    (_, outputs), _ = jax.lax.scan(
-        step, (act0, out0), jnp.arange(n_micro + axis_size - 1)
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        step, (act0, out0, aux0), jnp.arange(n_micro + axis_size - 1)
     )
     # only the last stage wrote real outputs; psum broadcasts them to all
     # stages so the result is replicated over "pipe" (out_specs P(None,...))
@@ -481,6 +551,9 @@ def _pipeline_body(
     )
     if tp_size > 1:
         result = unsplit(result)
+    if moe_aux:
+        aux_total = jax.lax.psum(aux_acc, (axis_name, "data")) / data_size
+        return result, aux_total
     return result
 
 
@@ -717,6 +790,166 @@ def llama_pipeline_loss_fn(
     m, b, s, v = logits.shape
     return next_token_nll(
         logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
+    )
+
+
+def moe_pipeline_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config,
+    moe,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+    llama: bool = False,
+    attention_fn=None,  # accepted for train.make_train_step's loss seam
+    stage_attention=None,
+    aux_weight: float | None = None,
+) -> jax.Array:
+    """MoE × pipeline objective (GPipe): mean next-token NLL over all
+    microbatches + the Switch aux term, with the routed expert MLP
+    running inside each stage's layer scan (aux rides the scan carry and
+    the schedule masks warmup/drain recomputation out of it).
+
+    Experts replicate per stage on the pp mesh — expert parallelism
+    rides ``data`` only in the non-pipelined path; a dedicated ep axis
+    inside the fully-manual body would buy nothing until experts
+    outnumber what replication can hold.  Routing is per data shard
+    (each shard's rows form its own flattened-stream groups), which with
+    GShard's bounded groups is the same policy the flat path applies —
+    pinned equal to the flat MoE loss under ample capacity by test.
+
+    ``aux_weight=None`` uses ``moe.aux_loss_weight``; held-out eval
+    passes ``0.0`` (pure LM NLL through the same routed forward).
+    """
+    from .moe import llama_moe_mlp, moe_mlp
+    from .train import next_token_nll
+
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    if seq > config.max_seq_len:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
+        )
+    if llama:
+        from .llama import _rms_norm, readout_weights
+
+        x = params["embed"][tokens]
+        stage_apply = partial(_llama_stage_apply, moe=moe,
+                              expert_mlp=llama_moe_mlp)
+    else:
+        x = params["embed"][tokens] + params["pos_embed"][:seq]
+        stage_apply = partial(_stage_apply, moe=moe, expert_mlp=moe_mlp)
+
+    body = partial(
+        _pipeline_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=mesh.shape["pipe"],
+        remat=False,  # MoE rejects remat (aux closure vs re-tracing)
+        tp_size=1,
+        attention_fn=stage_attention,
+        stage_apply=stage_apply,
+        moe_aux=True,
+        data_size=mesh.shape["data"],
+    )
+    y, aux_total = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_partition_specs(params["stages"], mesh),
+                  P(None, "data")),
+        out_specs=(P(None, "data"), P()),
+        check_vma=False,
+    )(params["stages"], x)
+
+    if llama:
+        y = _rms_norm(y, params["final_norm"], config.rms_eps)
+        readout = readout_weights(params)
+    else:
+        y = _layer_norm(y, params["final_ln_scale"], params["final_ln_bias"])
+        readout = params["embed"]
+    logits = jnp.einsum(
+        "mbsd,vd->mbsv", y, readout, preferred_element_type=jnp.float32
+    )
+    m, b, s, v = logits.shape
+    nll = next_token_nll(
+        logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
+    )
+    mean_aux = aux_total / (config.n_layers * pcfg.n_microbatches)
+    weight = moe.aux_loss_weight if aux_weight is None else aux_weight
+    return nll + weight * mean_aux
+
+
+def init_moe_pipeline_train_state(
+    rng: jax.Array, config, moe, train_config, n_stages: int,
+    llama: bool = False,
+) -> dict:
+    """MoE params with the layer stack pre-stacked (router + expert
+    weights keep their leading expert axis under the layer axis)."""
+    from .moe import init_llama_moe_params, init_moe_params
+    from .train import init_train_state
+
+    if config.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by "
+            f"n_stages={n_stages}"
+        )
+    if llama:
+        def init_fn(rng, cfg):
+            return as_llama_pipeline_params(
+                init_llama_moe_params(rng, cfg, moe)
+            )
+    else:
+        def init_fn(rng, cfg):
+            return as_pipeline_params(init_moe_params(rng, cfg, moe))
+    return init_train_state(rng, config, train_config, init_fn=init_fn)
+
+
+def make_moe_pipeline_train_step(
+    mesh: Mesh,
+    config,
+    moe,
+    pcfg: PipelineConfig,
+    train_config,
+    state: dict,
+    llama: bool = False,
+):
+    """Compile one MoE × pipeline optimizer step (GPipe only — the 1F1B
+    hand-built backward does not thread the aux term; autodiff of the
+    GPipe loss handles it).  No tp (experts replicate per stage; the
+    Megatron seams don't carve expert stacks), no remat (the flat MoE
+    constraint).  Gradient accumulation composes (``accum_axis=1``).
+    """
+    from .moe import _require_no_remat
+    from .train import make_train_step
+
+    _require_no_remat(train_config)
+    if pcfg.schedule != "gpipe":
+        raise ValueError(
+            "MoE x pipeline supports the gpipe schedule only (the 1F1B "
+            "hand-built backward does not thread the aux term)"
+        )
+    if mesh.shape.get("model", 1) > 1:
+        raise ValueError(
+            "MoE x pipeline does not compose with tensor parallelism "
+            "(experts replicate per stage); use a (pipe, data) mesh"
+        )
+    if getattr(config, "sliding_window", None) is not None:
+        raise ValueError(
+            "sliding_window does not compose with the pipelined MoE "
+            "stack's full-causal stage kernels"
+        )
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(moe_pipeline_loss_fn, config=config, moe=moe,
+                     pcfg=pcfg, mesh=mesh, llama=llama),
+        state_shardings_fn=pipeline_state_shardings,
+        batch_sharding_fn=pipeline_batch_sharding,
+        accum_axis=1,
     )
 
 

@@ -207,11 +207,10 @@ def train(args) -> dict:
     pipe = args.pipe_parallel
     if pipe > 1:
         # the pipelined stack (either family) runs over a dedicated
-        # ("pipe","data"[,"model"]) mesh; seq/zigzag/MoE don't compose
-        # with it (yet) and fail fast rather than silently ignore flags
+        # ("pipe","data"[,"model"]) mesh; seq/zigzag don't compose with
+        # it (yet) and fail fast rather than silently ignore flags
         for flag, bad in (("--seq-parallel > 1", args.seq_parallel > 1),
-                          ("--zigzag", args.zigzag),
-                          ("--moe", args.moe)):
+                          ("--zigzag", args.zigzag)):
             if bad:
                 raise SystemExit(
                     f"--pipe-parallel does not combine with {flag}"
@@ -221,6 +220,19 @@ def train(args) -> dict:
                 f"--batch-size {args.batch_size} not divisible by "
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
+        if args.moe:
+            # MoE x pp: gpipe only (1F1B's hand-built backward does not
+            # thread the aux term), no tp (experts replicate per stage)
+            if args.pipe_schedule != "gpipe":
+                raise SystemExit(
+                    "--moe with --pipe-parallel supports "
+                    "--pipe-schedule gpipe only"
+                )
+            if args.model_parallel > 1:
+                raise SystemExit(
+                    "--moe with --pipe-parallel does not combine with "
+                    "--model-parallel (experts replicate per stage)"
+                )
     if args.lora_rank:
         # adapters wrap the flat dense params; layouts that RESTRUCTURE
         # them (stage stacks, expert weights) are out of scope — fail
@@ -292,6 +304,15 @@ def train(args) -> dict:
         1408 if args.family == "llama" else 2048
     )
 
+    # one construction site: every moe consumer (state init, step
+    # builders, eval, the manifest) reads this binding
+    moe_config = None
+    if args.moe:
+        from .moe import MoeConfig
+
+        moe_config = MoeConfig(n_experts=args.moe_experts,
+                               top_k=args.moe_top_k)
+
     hf_base = None
     if args.family == "llama":
         from .llama import (
@@ -344,6 +365,13 @@ def train(args) -> dict:
                         hf_base
                     ),
                 )
+            elif args.moe:
+                from .pipeline import init_moe_pipeline_train_state
+
+                fresh = init_moe_pipeline_train_state(
+                    jax.random.key(args.seed), model_config, moe_config,
+                    train_config, n_stages=pipe, llama=True,
+                )
             else:
                 fresh = init_llama_pipeline_train_state(
                     jax.random.key(args.seed), model_config, train_config,
@@ -351,10 +379,8 @@ def train(args) -> dict:
                 )
             state = place_pipeline_state(mesh, fresh)
         elif args.moe:
-            from .moe import MoeConfig, init_llama_moe_train_state
+            from .moe import init_llama_moe_train_state
 
-            moe_config = MoeConfig(n_experts=args.moe_experts,
-                                   top_k=args.moe_top_k)
             state = place_state(
                 mesh,
                 init_llama_moe_train_state(
@@ -402,18 +428,22 @@ def train(args) -> dict:
                 place_pipeline_state,
             )
 
-            state = place_pipeline_state(
-                mesh,
-                init_pipeline_train_state(
+            if args.moe:
+                from .pipeline import init_moe_pipeline_train_state
+
+                fresh = init_moe_pipeline_train_state(
+                    jax.random.key(args.seed), model_config, moe_config,
+                    train_config, n_stages=pipe,
+                )
+            else:
+                fresh = init_pipeline_train_state(
                     jax.random.key(args.seed), model_config, train_config,
                     n_stages=pipe,
-                ),
-            )
+                )
+            state = place_pipeline_state(mesh, fresh)
         elif args.moe:
-            from .moe import MoeConfig, init_moe_train_state
+            from .moe import init_moe_train_state
 
-            moe_config = MoeConfig(n_experts=args.moe_experts,
-                                   top_k=args.moe_top_k)
             state = place_state(
                 mesh,
                 init_moe_train_state(jax.random.key(args.seed), model_config,
@@ -490,11 +520,17 @@ def train(args) -> dict:
         from .checkpoint import MODEL_MANIFEST, load_model_layout, \
             load_model_manifest, save_model_manifest
 
-        if pipe > 1:
-            layout = {"kind": "pipeline", "n_stages": pipe}
-        elif args.moe:
+        if args.moe:
+            # moe-first: restore_params refuses "moe" checkpoints with a
+            # clear error (no routed serving forward) — a pp+moe dir
+            # must say moe, not pipeline, or the serve-side unstack
+            # would fail deep in orbax instead
             layout = {"kind": "moe", "n_experts": args.moe_experts,
                       "top_k": args.moe_top_k}
+            if pipe > 1:
+                layout["pipeline_stages"] = pipe
+        elif pipe > 1:
+            layout = {"kind": "pipeline", "n_stages": pipe}
         elif args.lora_rank:
             # params on disk are flat MERGED weights (serving reads them
             # unchanged); the record is what makes a dense re-run of a
@@ -586,6 +622,7 @@ def train(args) -> dict:
         from .pipeline import (
             PipelineConfig,
             make_llama_pipeline_train_step,
+            make_moe_pipeline_train_step,
             make_pipeline_train_step,
         )
 
@@ -593,12 +630,18 @@ def train(args) -> dict:
             n_microbatches=args.pipe_microbatches,
             schedule=args.pipe_schedule,
         )
-        make_pp_step = (
-            make_llama_pipeline_train_step if args.family == "llama"
-            else make_pipeline_train_step
-        )
-        step_fn = make_pp_step(mesh, model_config, pipe_config,
-                               train_config, state)
+        if args.moe:
+            step_fn = make_moe_pipeline_train_step(
+                mesh, model_config, moe_config, pipe_config, train_config,
+                state, llama=args.family == "llama",
+            )
+        else:
+            make_pp_step = (
+                make_llama_pipeline_train_step if args.family == "llama"
+                else make_pipeline_train_step
+            )
+            step_fn = make_pp_step(mesh, model_config, pipe_config,
+                                   train_config, state)
     elif args.moe and args.zigzag:
         from .moe import make_zigzag_moe_train_step
 
@@ -646,15 +689,24 @@ def train(args) -> dict:
         if pipe > 1:
             from .pipeline import (
                 llama_pipeline_loss_fn,
+                moe_pipeline_loss_fn,
                 pipeline_loss_fn,
             )
 
-            pp_loss = (
-                llama_pipeline_loss_fn if args.family == "llama"
-                else pipeline_loss_fn
-            )
-            pp_eval = _partial(pp_loss, config=model_config,
-                               pcfg=pipe_config, mesh=mesh)
+            if args.moe:
+                # pure LM NLL through the pipelined routed forward
+                pp_eval = _partial(
+                    moe_pipeline_loss_fn, config=model_config,
+                    moe=moe_config, pcfg=pipe_config, mesh=mesh,
+                    llama=args.family == "llama", aux_weight=0.0,
+                )
+            else:
+                pp_loss = (
+                    llama_pipeline_loss_fn if args.family == "llama"
+                    else pipeline_loss_fn
+                )
+                pp_eval = _partial(pp_loss, config=model_config,
+                                   pcfg=pipe_config, mesh=mesh)
 
             def eval_fn_impl(state, tokens):
                 return pp_eval(state["params"], tokens)

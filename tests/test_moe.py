@@ -367,6 +367,132 @@ def test_trainer_zigzag_moe_flags():
     assert result["losses"][-1] < result["losses"][0]
 
 
+def test_moe_pipeline_equals_flat_moe_loss_and_learns():
+    # MoE x pp (GPipe): with ample capacity the pipelined routed loss is
+    # pinned equal to the flat MoE loss, and the step learns
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.moe import (
+        MoeConfig,
+        moe_loss_fn,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        PipelineConfig,
+        init_moe_pipeline_train_state,
+        make_moe_pipeline_train_step,
+        make_pipeline_mesh,
+        moe_pipeline_loss_fn,
+        pipeline_batch_sharding,
+        place_pipeline_state,
+        unstack_layers,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import TrainConfig
+
+    config = ModelConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    moe = MoeConfig(n_experts=4, top_k=2, capacity_factor=4.0)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    train_config = TrainConfig(learning_rate=1e-2)
+    pcfg = PipelineConfig(n_microbatches=2)
+    state = place_pipeline_state(
+        mesh,
+        init_moe_pipeline_train_state(jax.random.key(0), config, moe,
+                                      train_config, n_stages=2),
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (2, 4, 16), 0, 128,
+                           jnp.int32),
+        pipeline_batch_sharding(mesh),
+    )
+
+    flat = unstack_layers(state["params"])
+    plain = float(jax.jit(
+        lambda p, t: moe_loss_fn(p, t, config, moe)
+    )(flat, tokens.reshape(8, 16)))
+    piped = float(jax.jit(
+        lambda p, t: moe_pipeline_loss_fn(p, t, config, moe, pcfg, mesh)
+    )(state["params"], tokens))
+    assert piped == pytest.approx(plain, rel=2e-4)
+
+    step_fn = make_moe_pipeline_train_step(mesh, config, moe, pcfg,
+                                           train_config, state)
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_pipeline_rejects_1f1b_and_tp():
+    import jax
+
+    from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+    from kube_sqs_autoscaler_tpu.workloads.moe import MoeConfig
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        PipelineConfig,
+        init_moe_pipeline_train_state,
+        make_moe_pipeline_train_step,
+        make_pipeline_mesh,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import TrainConfig
+
+    config = ModelConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    moe = MoeConfig(n_experts=4, top_k=2)
+    tc = TrainConfig()
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    state = init_moe_pipeline_train_state(jax.random.key(0), config, moe,
+                                          tc, n_stages=2)
+    with pytest.raises(ValueError, match="gpipe"):
+        make_moe_pipeline_train_step(
+            mesh, config, moe, PipelineConfig(n_microbatches=2,
+                                              schedule="1f1b"), tc, state)
+    tp_mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                                 model_parallel=2)
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        make_moe_pipeline_train_step(
+            tp_mesh, config, moe, PipelineConfig(n_microbatches=2), tc,
+            state)
+
+
+def test_trainer_moe_pipeline_flags(caplog):
+    # --moe --pipe-parallel from the binary (both families), with eval
+    import logging
+
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
+
+    base = [
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "64", "--seq-len", "32",
+        "--batch-size", "8", "--learning-rate", "1e-2", "--log-every", "1",
+        "--steps", "4", "--moe", "--moe-experts", "4",
+        "--pipe-parallel", "2", "--pipe-microbatches", "2", "--overfit",
+    ]
+    with caplog.at_level(logging.INFO):
+        result = trainer_main(base + ["--eval-every", "4",
+                                      "--eval-batches", "2"])
+    assert result["final_step"] == 4
+    assert all(np.isfinite(result["losses"]))
+    assert result["losses"][-1] < result["losses"][0]
+    assert any("eval_loss" in r.getMessage() for r in caplog.records)
+
+    result = trainer_main(base + ["--family", "llama", "--n-kv-heads", "2"])
+    assert result["final_step"] == 4
+    assert all(np.isfinite(result["losses"]))
+    assert result["losses"][-1] < result["losses"][0]
+
+    with pytest.raises(SystemExit, match="gpipe"):
+        trainer_main(base + ["--pipe-schedule", "1f1b"])
+    with pytest.raises(SystemExit, match="model-parallel"):
+        trainer_main(base + ["--model-parallel", "2"])
+
+
 def test_trainer_llama_moe_flag():
     from kube_sqs_autoscaler_tpu.workloads.trainer import main as trainer_main
 
