@@ -439,8 +439,9 @@ def make_llama_train_step(mesh, config: LlamaConfig, train_config,
     """dp x tp (x sp) train step via :func:`.train.make_train_step`'s
     seams, with :func:`llama_mesh_loss` as the objective.
     ``config.sliding_window`` rides the shared attention seam (windowed
-    flash/dense per shard; fails fast on a ``seq`` mesh — the ring
-    schedule has no window-skip)."""
+    flash/dense per shard on a ``(data, model)`` mesh; the windowed ring
+    schedule on a ``seq`` mesh — long-context Mistral-style training
+    under sequence parallelism)."""
     from .train import make_train_step
 
     return make_train_step(

@@ -162,7 +162,12 @@ def make_lora_train_step(
     )
 
     optimizer = make_optimizer(train_config)
-    attention_fn = mesh_attention_fn(mesh)
+    # sliding-window configs fine-tune windowed, like every other step
+    # builder (a bare mesh_attention_fn(mesh) would silently train a
+    # Mistral-style base full-causal)
+    attention_fn = mesh_attention_fn(
+        mesh, window=getattr(model_config, "sliding_window", None)
+    )
     if loss is None:
         from .train import loss_fn
 

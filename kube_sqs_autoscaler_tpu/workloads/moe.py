@@ -452,12 +452,8 @@ def make_zigzag_moe_train_step(mesh, config, moe: MoeConfig, train_config,
     from .zigzag import make_zigzag_loss
 
     _require_no_remat(train_config)
-    if getattr(config, "sliding_window", None) is not None:
-        raise ValueError(
-            "sliding_window does not compose with zig-zag sequence "
-            "parallelism (no windowed ring schedule); use a "
-            "(data, model) mesh"
-        )
+    # windowed configs: make_zigzag_loss rejects them (the permuted
+    # blocks have no banded form; plain windowed sp would work)
     if llama:
         from .llama import llama_forward as family_forward
 

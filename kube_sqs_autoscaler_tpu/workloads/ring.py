@@ -169,10 +169,15 @@ def _ring_attention_local(
     *,
     axis_name: str,
     axis_size: int,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-device body. q: ``[B, H, S_local, D]``; k/v may carry compact
     GQA heads ``[B, H_kv, S_local, D]`` (broadcast at the compute site,
-    rotated compact)."""
+    rotated compact).  ``window`` adds the Mistral sliding-window bound:
+    global row ``r`` attends global keys ``r - window + 1 .. r`` — the
+    per-hop mask is a band in GLOBAL positions, which the hop origin
+    tracking already provides, so the windowed schedule is the causal
+    one with one more mask term."""
     batch, heads, seq_local, head_dim = q.shape
     groups = heads // k.shape[1]
     my_index = jax.lax.axis_index(axis_name)
@@ -209,8 +214,12 @@ def _ring_attention_local(
             )
             * scale
         )
-        causal = q_positions[:, None] >= k_positions[None, :]
-        scores = jnp.where(causal, scores, _NEG_INF)
+        visible = q_positions[:, None] >= k_positions[None, :]
+        if window is not None:
+            visible = visible & (
+                k_positions[None, :] > q_positions[:, None] - window
+            )
+        scores = jnp.where(visible, scores, _NEG_INF)
 
         o_new, l_new, m_new = online_update(
             o, l, m, scores, expand_kv(v_blk, groups)
@@ -236,6 +245,7 @@ def make_ring_attention(
     model_axis: str = "model",
     use_kernel: bool | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """Build an attention fn ``(q, k, v) -> out`` (``[B, H, S, D]`` each)
     that runs as ring attention over ``mesh[seq_axis]``.
@@ -250,6 +260,11 @@ def make_ring_attention(
     run in the Python-speed interpreter).  ``interpret`` forces the
     kernel's interpret mode (tests exercise the kernel path on CPU
     with ``use_kernel=True, interpret=True``).
+
+    ``window`` runs the Mistral sliding-window schedule over the ring
+    (global band mask per hop).  Windowed hops use the einsum body —
+    ``flash_attention_lse`` has no banded-block form (yet), and a long-
+    context window run is dominated by the in-window hops either way.
     """
     axis_size = mesh.shape[seq_axis]
     if use_kernel is None:
@@ -268,7 +283,8 @@ def make_ring_attention(
     )
     sharded_einsum = jax.shard_map(
         partial(
-            _ring_attention_local, axis_name=seq_axis, axis_size=axis_size
+            _ring_attention_local, axis_name=seq_axis, axis_size=axis_size,
+            window=window,
         ),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
@@ -280,7 +296,7 @@ def make_ring_attention(
         from .flash import tiles_cleanly
 
         s_local = q.shape[2] // axis_size
-        if use_kernel and tiles_cleanly(s_local):
+        if window is None and use_kernel and tiles_cleanly(s_local):
             return sharded_kernel(q, k, v)
         return sharded_einsum(q, k, v)
 

@@ -108,21 +108,19 @@ def mesh_attention_fn(mesh: Mesh, window: int | None = None):
     — on TPU this is what puts the Pallas flash kernel (forward *and*
     backward) on the training hot path.
 
-    ``window`` threads sliding-window attention through the seam (windowed
-    flash block-skip / windowed dense mask per shard); it does not compose
-    with the ring schedule, so a windowed config on a ``seq`` mesh fails
-    here — the one place every consumer of the seam shares.
+    ``window`` threads sliding-window attention through the seam: the
+    windowed flash block-skip / windowed dense mask per shard on a
+    ``(data, model)`` mesh, and the windowed ring schedule (a global
+    band mask per hop — :func:`.ring.make_ring_attention`) on a ``seq``
+    mesh, so Mistral-style configs train under sequence parallelism too.
+    The zig-zag schedule remains windowless (its permuted blocks have no
+    banded form; :func:`.zigzag.make_zigzag_loss` rejects windowed
+    configs).
     """
     if mesh.shape.get("seq", 1) > 1:
-        if window is not None:
-            raise ValueError(
-                "sliding_window does not compose with sequence "
-                "parallelism (ring attention has no windowed schedule); "
-                "use a (data, model) mesh"
-            )
         from .ring import make_ring_attention
 
-        return make_ring_attention(mesh)
+        return make_ring_attention(mesh, window=window)
     from .flash import make_sharded_attention
 
     return make_sharded_attention(mesh, window=window)

@@ -46,6 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--n-heads", type=int, default=8)
     parser.add_argument("--n-kv-heads", type=int, default=2,
                         help="llama family only: GQA KV head count")
+    parser.add_argument(
+        "--sliding-window", type=int, default=0, metavar="W",
+        help="llama family only: Mistral-style sliding-window attention "
+             "(each position attends its last W keys; 0 = full causal). "
+             "Composes with --seq-parallel (windowed ring schedule) and "
+             "--pipe-parallel (windowed stage kernels); not with "
+             "--zigzag",
+    )
     parser.add_argument("--n-layers", type=int, default=4)
     parser.add_argument(
         "--d-ff", type=int, default=None,
@@ -233,6 +241,22 @@ def train(args) -> dict:
                     "--moe with --pipe-parallel does not combine with "
                     "--model-parallel (experts replicate per stage)"
                 )
+    if args.sliding_window < 0:
+        raise SystemExit(
+            f"--sliding-window {args.sliding_window} must be >= 0 "
+            "(0 = full causal)"
+        )
+    if args.sliding_window and args.family != "llama":
+        raise SystemExit(
+            "--sliding-window is a llama-family knob (the gpt family has "
+            "no windowed config)"
+        )
+    if args.sliding_window and args.hf_checkpoint:
+        raise SystemExit(
+            "--sliding-window does not combine with --hf-checkpoint (the "
+            "HF config carries the architecture, window included — a "
+            "Mistral import brings its own)"
+        )
     if args.lora_rank:
         # adapters wrap the flat dense params; layouts that RESTRUCTURE
         # them (stage stacks, expert weights) are out of scope — fail
@@ -342,6 +366,7 @@ def train(args) -> dict:
                 n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
                 n_layers=args.n_layers, d_ff=d_ff,
                 max_seq_len=args.seq_len,
+                sliding_window=args.sliding_window or None,
             )
         if pipe > 1:
             from .pipeline import (

@@ -465,6 +465,15 @@ def make_zigzag_loss(mesh: Mesh, config, remat: bool = False,
     state into the objective — the MoE aux term rides this."""
     if forward_fn is not None and forward_factory is not None:
         raise ValueError("pass forward_fn or forward_factory, not both")
+    if getattr(config, "sliding_window", None) is not None:
+        # the permuted zig-zag blocks have no banded form; silently
+        # training a Mistral-style config full-causal would be wrong —
+        # plain (unpermuted) ring attention DOES support the window
+        raise ValueError(
+            "sliding_window does not compose with the zig-zag schedule; "
+            "use plain sequence parallelism (windowed ring attention) "
+            "or a (data, model) mesh"
+        )
     attend = make_zigzag_ring_attention(mesh)
 
     def loss(params, tokens, attention_fn=None):  # seam signature

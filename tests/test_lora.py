@@ -330,6 +330,25 @@ def test_lora_zigzag_trains_and_evals(caplog):
     assert any("eval_loss" in r.getMessage() for r in caplog.records)
 
 
+def test_lora_windowed_llama_trains_under_sp():
+    # a Mistral-style base fine-tunes WINDOWED (the lora step threads
+    # config.sliding_window through the attention seam), including on a
+    # seq mesh via the windowed ring schedule
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    result = main([
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128", "--seq-len", "32",
+        "--batch-size", "8", "--learning-rate", "1e-2", "--log-every", "1",
+        "--steps", "4", "--family", "llama", "--n-kv-heads", "2",
+        "--sliding-window", "8", "--lora-rank", "4",
+        "--seq-parallel", "2", "--overfit",
+    ])
+    assert result["final_step"] == 4
+    assert all(np.isfinite(result["losses"]))
+    assert result["losses"][-1] < result["losses"][0]
+
+
 def test_dense_resume_of_lora_dir_fails_loudly(tmp_path):
     from kube_sqs_autoscaler_tpu.workloads.trainer import main
 

@@ -313,13 +313,22 @@ def test_windowed_llama_trains_on_the_mesh():
         losses.append(float(loss))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
-    # sequence parallelism has no windowed ring schedule — fail fast
+    # sequence parallelism runs the WINDOWED ring schedule (a global
+    # band mask per hop) — previously a fail-fast, now a capability
     sp_mesh = make_mesh(jax.devices(), model_parallel=2, seq_parallel=2)
     sp_state = place_state(
         sp_mesh, init_llama_train_state(jax.random.key(0), config, tc)
     )
-    with pytest.raises(ValueError, match="sliding_window"):
-        make_llama_train_step(sp_mesh, config, tc, sp_state)
+    sp_step = make_llama_train_step(sp_mesh, config, tc, sp_state)
+    sp_tokens = jax.device_put(
+        jax.random.randint(jax.random.key(2), (4, 32), 0, 128, jnp.int32),
+        batch_sharding(sp_mesh),
+    )
+    sp_losses = []
+    for _ in range(4):
+        sp_state, sp_loss = sp_step(sp_state, sp_tokens)
+        sp_losses.append(float(sp_loss))
+    assert all(np.isfinite(sp_losses)) and sp_losses[-1] < sp_losses[0]
 
 
 def test_windowed_llama_composes_with_beam_and_rolling_eos():
