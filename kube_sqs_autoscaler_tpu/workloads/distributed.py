@@ -120,14 +120,16 @@ def make_topology_mesh(
 def make_topology_pipeline_mesh(
     pipe_parallel: int,
     model_parallel: int = 1,
+    seq_parallel: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """A ``("pipe", "data"[, "model"])`` mesh ordered by physical
+    """A ``("pipe", "data"[, "model"|"seq"])`` mesh ordered by physical
     topology — the pipeline counterpart of :func:`make_topology_mesh`.
     The pipe axis is the one that most wants torus placement: every
     schedule slot ends in a single-neighbor ``ppermute`` hop, so stage
-    ``i`` and stage ``i+1`` should be physically adjacent chips.  Same
-    contract as :func:`.pipeline.make_pipeline_mesh`.
+    ``i`` and stage ``i+1`` should be physically adjacent chips (and
+    under pp x sp, so should the ring neighbors).  Same contract as
+    :func:`.pipeline.make_pipeline_mesh`.
     """
     from .pipeline import make_pipeline_mesh
 
@@ -136,7 +138,8 @@ def make_topology_pipeline_mesh(
     # shape, axis names): build the enumeration-order mesh, then re-grid
     # the same shape with topology-ordered placement
     plain = make_pipeline_mesh(devices, pipe_parallel=pipe_parallel,
-                               model_parallel=model_parallel)
+                               model_parallel=model_parallel,
+                               seq_parallel=seq_parallel)
     grid = mesh_utils.create_device_mesh(plain.devices.shape, devices)
     return Mesh(grid, plain.axis_names)
 

@@ -73,23 +73,36 @@ def make_pipeline_mesh(
     devices: list | None = None,
     pipe_parallel: int | None = None,
     model_parallel: int = 1,
+    seq_parallel: int = 1,
 ) -> Mesh:
-    """A ``("pipe", "data")`` mesh (or ``("pipe", "data", "model")`` when
-    ``model_parallel > 1`` — pp x dp x tp); ``pipe_parallel`` defaults to
-    all devices."""
+    """A ``("pipe", "data")`` mesh — or ``("pipe", "data", "model")``
+    (pp x dp x tp) / ``("pipe", "data", "seq")`` (pp x dp x sp, ring
+    attention inside the stages) when the respective degree is > 1;
+    ``pipe_parallel`` defaults to all devices.  tp and sp are mutually
+    exclusive under pp (a 4-axis manual body buys nothing at this
+    scale)."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     pipe = pipe_parallel if pipe_parallel is not None else n
-    if n % (pipe * model_parallel):
+    if model_parallel > 1 and seq_parallel > 1:
+        raise ValueError(
+            "pipeline meshes take model_parallel OR seq_parallel, not both"
+        )
+    if n % (pipe * model_parallel * seq_parallel):
         raise ValueError(
             f"{n} devices not divisible by pipe_parallel={pipe} x "
-            f"model_parallel={model_parallel}"
+            f"model_parallel={model_parallel} x seq_parallel={seq_parallel}"
         )
     if model_parallel > 1:
         grid = np.asarray(devices).reshape(
             pipe, n // (pipe * model_parallel), model_parallel
         )
         return Mesh(grid, ("pipe", "data", "model"))
+    if seq_parallel > 1:
+        grid = np.asarray(devices).reshape(
+            pipe, n // (pipe * seq_parallel), seq_parallel
+        )
+        return Mesh(grid, ("pipe", "data", "seq"))
     grid = np.asarray(devices).reshape(pipe, n // pipe)
     return Mesh(grid, ("pipe", "data"))
 
@@ -210,6 +223,46 @@ def init_llama_pipeline_params(rng: jax.Array, config, n_stages: int) -> dict:
     return as_llama_pipeline_params(init_llama_params(rng, config))
 
 
+def _act_spec(mesh: Mesh) -> P:
+    """PartitionSpec of the microbatched activations/tokens entering the
+    pipelined body: ``[M, B_m, ...]`` with batch over ``data`` and (on a
+    pp x dp x sp mesh) the sequence axis over ``seq``."""
+    if "seq" in mesh.shape:
+        return P(None, "data", "seq")
+    return P(None, "data")
+
+
+def _stage_ring_attention(mesh: Mesh, window: int | None = None):
+    """The per-stage attention for a pp x dp x sp mesh: the ring-attention
+    per-device body running INSIDE the pipeline's fully-manual region —
+    k/v rotate over ``seq`` within each stage's compute while activations
+    flow over ``pipe`` between stages.  Same body dispatch as
+    :func:`.ring.make_ring_attention`: the Pallas flash-lse kernel per
+    hop on TPU when the local length tiles (and no window — the kernel
+    has no banded-block form), the einsum reference body elsewhere.
+    GQA-native (compact k/v rotate as-is); ``window`` adds the Mistral
+    band."""
+    from .ring import _ring_attention_kernel_local, _ring_attention_local
+
+    sp = mesh.shape["seq"]
+
+    def attend(q, k, v):
+        from .flash import tiles_cleanly
+
+        # q.shape[2] is already the LOCAL length here (manual region)
+        if (window is None and jax.default_backend() == "tpu"
+                and tiles_cleanly(q.shape[2])):
+            return _ring_attention_kernel_local(
+                q, k, v, axis_name="seq", axis_size=sp
+            )
+        return _ring_attention_local(
+            q, k, v, axis_name="seq", axis_size=sp, window=window
+        )
+
+    attend.gqa_native = True
+    return attend
+
+
 def _stage_spec(name: str, with_model: bool) -> P:
     """PartitionSpec of one stage-stack leaf: leading layer axis over
     ``"pipe"``; on a pp x tp mesh, the PARAM_AXES Megatron axes over
@@ -320,15 +373,17 @@ def _stage_apply(
 def _llama_stage_apply(
     stage_layers: dict, x: jax.Array, config,
     remat: bool = False, tp_size: int = 1, attention_fn=None,
-    moe=None, expert_mlp=None,
+    moe=None, expert_mlp=None, seq_axis: str | None = None,
 ) -> jax.Array:
     """The llama-family counterpart of :func:`_stage_apply`: one stage's
     stacked llama layers (RoPE/GQA/RMSNorm/SwiGLU via
     :func:`.llama._llama_block`) over an activation microbatch.
 
-    RoPE positions are ``0..seq-1`` — a static function of the microbatch
-    shape, identical on every stage, so no position state crosses the
-    ``ppermute`` hops.  ``tp_size > 1`` runs the local Megatron shard
+    RoPE positions are a static function of the microbatch shape plus
+    (under ``seq_axis``, the pp x sp layout) the shard's global offset
+    via ``axis_index`` — identical on every PIPE stage either way, so no
+    position state crosses the ``ppermute`` hops.  ``tp_size > 1`` runs
+    the local Megatron shard
     (contiguous ``n_heads/tp`` query heads, ``n_kv_heads/tp`` kv heads,
     ``d_ff/tp`` ff columns) with the *f*/*g* conjugates hand-placed
     through the block's ``reduce``/``promote`` seams; requires
@@ -373,6 +428,10 @@ def _llama_stage_apply(
 
     attend = gqa_adapt(attention_fn)
     positions = jnp.arange(x.shape[1])
+    if seq_axis is not None:
+        # sequence-sharded stage: RoPE rotates by GLOBAL positions (the
+        # local shard holds rows [i*S_loc, (i+1)*S_loc))
+        positions = positions + jax.lax.axis_index(seq_axis) * x.shape[1]
 
     if moe is not None:
         return _moe_layer_scan(
@@ -656,6 +715,10 @@ def pipeline_forward(
 
     pipe = mesh.shape["pipe"]
     tp_size = mesh.shape.get("model", 1)
+    if stage_attention is None and mesh.shape.get("seq", 1) > 1:
+        # pp x sp: ring attention inside the stages (the per-shard
+        # default kernel would attend local keys only)
+        stage_attention = _stage_ring_attention(mesh)
     body = partial(
         _pipeline_body,
         config=config,
@@ -667,17 +730,18 @@ def pipeline_forward(
         attention_fn=stage_attention,
     )
     # FULLY manual over every mesh axis: the schedule's ppermutes/psums
-    # (and, under tp, the Megatron model-axis psums) are all explicit.
-    # Partial-manual mode miscompiles bf16 on this jax/XLA version (see
-    # module docstring), so no axis stays auto.  check_vma=False: the
-    # carried activations diverge per stage and the varying-type algebra
-    # adds nothing once every collective is hand-placed.
+    # (and, under tp, the Megatron model-axis psums; under sp, the ring
+    # rotation) are all explicit.  Partial-manual mode miscompiles bf16
+    # on this jax/XLA version (see module docstring), so no axis stays
+    # auto.  check_vma=False: the carried activations diverge per stage
+    # and the varying-type algebra adds nothing once every collective is
+    # hand-placed.
     y = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(stage_partition_specs(params["stages"], mesh),
-                  P(None, "data")),
-        out_specs=P(None, "data"),
+                  _act_spec(mesh)),
+        out_specs=_act_spec(mesh),
         check_vma=False,
     )(params["stages"], x)
 
@@ -743,6 +807,15 @@ def llama_pipeline_forward(
         )
     x = params["embed"][tokens]
 
+    stage_apply = _llama_stage_apply
+    if mesh.shape.get("seq", 1) > 1:
+        if stage_attention is None:
+            # pp x sp: GQA ring attention inside the stages, window and
+            # all (compact k/v rotate over "seq")
+            stage_attention = _stage_ring_attention(
+                mesh, window=config.sliding_window
+            )
+        stage_apply = partial(_llama_stage_apply, seq_axis="seq")
     body = partial(
         _pipeline_body,
         config=config,
@@ -752,14 +825,14 @@ def llama_pipeline_forward(
         remat=remat,
         tp_size=mesh.shape.get("model", 1),
         attention_fn=stage_attention,
-        stage_apply=_llama_stage_apply,
+        stage_apply=stage_apply,
     )
     y = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(stage_partition_specs(params["stages"], mesh),
-                  P(None, "data")),
-        out_specs=P(None, "data"),
+                  _act_spec(mesh)),
+        out_specs=_act_spec(mesh),
         check_vma=False,
     )(params["stages"], x)
 
@@ -928,6 +1001,7 @@ def make_moe_pipeline_train_step(
     from .train import make_train_step
 
     _require_no_remat(train_config)
+    _require_no_seq_axis(mesh)
     if pcfg.schedule != "gpipe":
         raise ValueError(
             "MoE x pipeline supports the gpipe schedule only (the 1F1B "
@@ -1354,8 +1428,10 @@ def llama_one_f_one_b_value_and_grad(
 
 
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Tokens ``[M, B_m, S]``: microbatch axis replicated, batch over data."""
-    return NamedSharding(mesh, P(None, "data", None))
+    """Tokens ``[M, B_m, S]``: microbatch axis replicated, batch over
+    data, sequence over ``seq`` on a pp x dp x sp mesh (the same rule
+    the body's activation specs use — :func:`_act_spec`)."""
+    return NamedSharding(mesh, _act_spec(mesh))
 
 
 def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
@@ -1426,6 +1502,7 @@ def make_pipeline_train_step(
 
     remat = getattr(train_config, "remat", False)
     if pcfg.schedule == "1f1b":
+        _require_no_seq_axis(mesh)
         return make_train_step(
             mesh, config, train_config, state,
             value_and_grad_fn=partial(
@@ -1444,6 +1521,17 @@ def make_pipeline_train_step(
         batch_sharding_fn=pipeline_batch_sharding,
         accum_axis=1,
     )
+
+
+def _require_no_seq_axis(mesh: Mesh) -> None:
+    """pp x sp is GPipe-only: the 1F1B hand-built backward (and the MoE
+    pipeline objective) keep their activations/loss head unsharded over
+    sequence; autodiff of the GPipe loss handles the ring's transposes."""
+    if mesh.shape.get("seq", 1) > 1:
+        raise ValueError(
+            "this pipeline schedule/objective supports (pipe, data"
+            "[, model]) meshes only — pp x sp runs the gpipe schedule"
+        )
 
 
 def init_llama_pipeline_train_state(
@@ -1478,6 +1566,7 @@ def make_llama_pipeline_train_step(
 
     remat = getattr(train_config, "remat", False)
     if pcfg.schedule == "1f1b":
+        _require_no_seq_axis(mesh)
         return make_train_step(
             mesh, config, train_config, state,
             value_and_grad_fn=partial(

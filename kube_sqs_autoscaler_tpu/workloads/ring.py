@@ -45,7 +45,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-_NEG_INF = jnp.float32(-1e9)  # finite mask value; see module docstring
+# finite mask value; see module docstring.  A plain Python float on
+# purpose (same rule as flash.MERGE_NEG_INF): a module-level jnp scalar
+# would be traced into the first jit/shard_map context as a captured
+# constant and then poison later traces — observed concretely as
+# "Execution supplied N buffers but compiled program expected N+1" on
+# the SECOND call of a pp x sp train step whose process had previously
+# lowered any other program touching this constant (the stale captured
+# const lowers as an extra executable parameter the C++ fastpath does
+# not supply).
+_NEG_INF = -1e9
 NEG_INF = _NEG_INF  # shared with .zigzag
 
 

@@ -83,9 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--pipe-parallel", type=int, default=1,
-        help="pipeline-parallel stages over a ('pipe','data'[,'model']) "
-             "mesh (gpt family; composes with --model-parallel, not with "
-             "--seq-parallel/--zigzag)",
+        help="pipeline-parallel stages over a "
+             "('pipe','data'[,'model'|'seq']) mesh (both families; "
+             "composes with --model-parallel OR --seq-parallel — ring "
+             "attention inside the GPipe stages — and with --moe/"
+             "--grad-accum; not with --zigzag)",
     )
     parser.add_argument(
         "--pipe-schedule", choices=("gpipe", "1f1b"), default="gpipe",
@@ -215,19 +217,34 @@ def train(args) -> dict:
     pipe = args.pipe_parallel
     if pipe > 1:
         # the pipelined stack (either family) runs over a dedicated
-        # ("pipe","data"[,"model"]) mesh; seq/zigzag don't compose with
-        # it (yet) and fail fast rather than silently ignore flags
-        for flag, bad in (("--seq-parallel > 1", args.seq_parallel > 1),
-                          ("--zigzag", args.zigzag)):
-            if bad:
-                raise SystemExit(
-                    f"--pipe-parallel does not combine with {flag}"
-                )
+        # ("pipe","data"[,"model"|"seq"]) mesh; zigzag doesn't compose
+        # with it (yet) and fails fast rather than silently ignore flags
+        if args.zigzag:
+            raise SystemExit(
+                "--pipe-parallel does not combine with --zigzag"
+            )
         if args.batch_size % args.pipe_microbatches:
             raise SystemExit(
                 f"--batch-size {args.batch_size} not divisible by "
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
+        if args.seq_parallel > 1:
+            # pp x sp: ring attention inside the GPipe stages
+            if args.pipe_schedule != "gpipe":
+                raise SystemExit(
+                    "--pipe-parallel with --seq-parallel supports "
+                    "--pipe-schedule gpipe only"
+                )
+            if args.model_parallel > 1:
+                raise SystemExit(
+                    "--pipe-parallel takes --model-parallel OR "
+                    "--seq-parallel, not both"
+                )
+            if args.moe:
+                raise SystemExit(
+                    "--moe with --pipe-parallel does not combine with "
+                    "--seq-parallel"
+                )
         if args.moe:
             # MoE x pp: gpipe only (1F1B's hand-built backward does not
             # thread the aux term), no tp (experts replicate per stage)
@@ -309,13 +326,15 @@ def train(args) -> dict:
             from .distributed import make_topology_pipeline_mesh
 
             mesh = make_topology_pipeline_mesh(
-                pipe, model_parallel=args.model_parallel
+                pipe, model_parallel=args.model_parallel,
+                seq_parallel=args.seq_parallel,
             )
         else:
             from .pipeline import make_pipeline_mesh
 
             mesh = make_pipeline_mesh(pipe_parallel=pipe,
-                                      model_parallel=args.model_parallel)
+                                      model_parallel=args.model_parallel,
+                                      seq_parallel=args.seq_parallel)
     else:
         mesh_fn = make_topology_mesh if args.topology_mesh else make_mesh
         mesh = mesh_fn(model_parallel=args.model_parallel,

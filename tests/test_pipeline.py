@@ -67,6 +67,27 @@ def test_pipeline_forward_matches_dense(pipe):
     )
 
 
+def test_pipeline_forward_matches_dense_pp2_sp2():
+    # ring attention inside the pipeline stages: pp x dp x sp
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              seq_parallel=2)
+    params = init_params(jax.random.key(0), TINY)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    dense = forward(params, tokens.reshape(4 * bm, 16), TINY)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: pipeline_forward(p, t, TINY, pcfg, mesh)
+    )(as_pipeline_params(params),
+      jax.device_put(tokens, pipeline_batch_sharding(mesh)))
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, TINY.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
 def test_pipeline_microbatches_are_independent():
     # perturbing microbatch 3 must not change microbatch 0's logits
     mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=4)

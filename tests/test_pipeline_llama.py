@@ -165,6 +165,88 @@ def test_llama_windowed_pipeline_forward_matches_dense():
     )
 
 
+def test_llama_pipeline_forward_matches_dense_pp2_sp2():
+    # ring attention inside the pipeline stages (pp x dp x sp): GQA k/v
+    # rotate over "seq" within each stage while activations flow over
+    # "pipe" — and RoPE rotates by GLOBAL positions per shard
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              seq_parallel=2)
+    params = init_llama_params(jax.random.key(0), TINY)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    dense = llama_forward(params, tokens.reshape(4 * bm, 16), TINY)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: llama_pipeline_forward(p, t, TINY, pcfg, mesh)
+    )(
+        as_pipeline_params(params),
+        jax.device_put(tokens, pipeline_batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, TINY.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_llama_windowed_pipeline_sp_matches_dense():
+    # the full stack: sliding window x sequence parallelism x pipeline
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=4,
+        d_ff=128, max_seq_len=64, sliding_window=5, dtype=jnp.float32,
+    )
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              seq_parallel=2)
+    params = init_llama_params(jax.random.key(4), cfg)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    dense = llama_forward(params, tokens.reshape(4 * bm, 16), cfg)
+
+    pcfg = PipelineConfig(n_microbatches=4)
+    piped = jax.jit(
+        lambda p, t: llama_pipeline_forward(p, t, cfg, pcfg, mesh)
+    )(
+        as_pipeline_params(params),
+        jax.device_put(tokens, pipeline_batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, cfg.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_llama_pipeline_sp_train_step_learns_and_1f1b_rejected():
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              seq_parallel=2)
+    pcfg = PipelineConfig(n_microbatches=2)
+    train_config = TrainConfig(learning_rate=1e-2)
+    state = place_pipeline_state(
+        mesh,
+        init_llama_pipeline_train_state(jax.random.key(0), TINY_BF16,
+                                        train_config, n_stages=2),
+    )
+    step_fn = make_llama_pipeline_train_step(mesh, TINY_BF16, pcfg,
+                                             train_config, state)
+    tokens = jax.device_put(
+        microtokens(m=2, bm=4), pipeline_batch_sharding(mesh)
+    )
+    losses = []
+    for _ in range(4):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+    with pytest.raises(ValueError, match="gpipe"):
+        make_llama_pipeline_train_step(
+            mesh, TINY_BF16,
+            PipelineConfig(n_microbatches=2, schedule="1f1b"),
+            train_config, state,
+        )
+
+
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("cfg", [TINY, TINY_BF16], ids=["fp32", "bf16"])
 def test_llama_pipeline_train_step_learns(schedule, cfg):

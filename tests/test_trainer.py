@@ -148,6 +148,25 @@ def test_trainer_pipeline_checkpoints_and_resumes(tmp_path):
     assert resumed["final_step"] == 7
 
 
+def test_trainer_pipeline_seq_parallel_learns():
+    # pp x sp from the binary: ring attention inside the GPipe stages
+    result = main(TINY_FLAGS + ["--steps", "4", "--pipe-parallel", "2",
+                                "--pipe-microbatches", "2",
+                                "--seq-parallel", "2", "--overfit"])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+    with pytest.raises(SystemExit, match="gpipe"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
+                           "--seq-parallel", "2",
+                           "--pipe-schedule", "1f1b"])
+    with pytest.raises(SystemExit, match="not both"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
+                           "--seq-parallel", "2", "--model-parallel", "2"])
+
+
 def test_trainer_pipeline_topology_mesh_learns():
     # pp over the topology-ordered ("pipe","data") mesh: stage i and
     # stage i+1 as physical neighbors (trivial on the CPU mesh, but the
@@ -165,8 +184,13 @@ def test_trainer_pipeline_flag_conflicts_fail_fast():
     with pytest.raises(SystemExit, match="--zigzag"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--seq-parallel", "1", "--zigzag"])
-    with pytest.raises(SystemExit, match="--moe"):
-        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe"])
+    # moe x pp works (gpipe) — but not with 1F1B or tp
+    with pytest.raises(SystemExit, match="gpipe"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe",
+                           "--pipe-schedule", "1f1b"])
+    with pytest.raises(SystemExit, match="model-parallel"):
+        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe",
+                           "--model-parallel", "2"])
     with pytest.raises(SystemExit, match="not divisible"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--pipe-microbatches", "3"])
