@@ -121,7 +121,8 @@ def main(argv=None) -> None:
         help="int8 KV cache: decode streams int8 codes + per-position "
              "scales instead of bf16 k/v (half the cache bytes per "
              "generated token; requires --generate-tokens >= 1, single "
-             "chip, batch mode)",
+             "chip; composes with --continuous — rolling slots store "
+             "int8)",
     )
     parser.add_argument(
         "--result-queue-url", default="",
@@ -164,7 +165,6 @@ def main(argv=None) -> None:
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--model-parallel", bool(args.model_parallel)),
-            ("--continuous", args.continuous),
             ("--beams > 1", args.beams > 1),
             ("--speculative-draft-layers",
              bool(args.speculative_draft_layers)),

@@ -65,6 +65,7 @@ def _insert_row_impl(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    quantized_kv: bool = False,
 ) -> tuple[dict, jax.Array]:
     """Prefill ``prompt`` (int32 ``[prompt_len]``, right-padded to the
     static bucket) and splice it into slot ``row`` of ``cache``.
@@ -73,10 +74,17 @@ def _insert_row_impl(
     real length and its first continuation token (greedy or sampled by
     the shared ``_pick`` policy with ``key``) is ready to feed the next
     ``decode_step``.  ``family`` picks the prefill: the gpt path or the
-    llama GQA path — the splice is layout-agnostic (both caches are
-    ``[B, H, S, D]`` per layer with a per-row ``length``).
+    llama GQA path — the splice is layout-agnostic (cache entries are
+    per-layer arrays with the batch row leading and the position on the
+    third-from-last axis for 4-d codes/values, last for 3-d scales;
+    both the bf16 and the int8 layouts fit that shape).
     """
-    if family == "llama":
+    if quantized_kv:
+        if family == "llama":
+            from .llama import llama_quantized_prefill as prefill_fn
+        else:
+            from .decode import quantized_prefill as prefill_fn
+    elif family == "llama":
         from .llama import llama_prefill as prefill_fn
     else:
         prefill_fn = prefill
@@ -85,16 +93,16 @@ def _insert_row_impl(
     )
     new_layers = []
     for layer_cache, row_layer in zip(cache["layers"], row_cache["layers"]):
-        new_layers.append({
-            "k": jax.lax.dynamic_update_slice(
-                layer_cache["k"], row_layer["k"][:, :, :prompt_len],
-                (row, 0, 0, 0),
-            ),
-            "v": jax.lax.dynamic_update_slice(
-                layer_cache["v"], row_layer["v"][:, :, :prompt_len],
-                (row, 0, 0, 0),
-            ),
-        })
+        entry = {}
+        for name, buf in layer_cache.items():
+            piece = row_layer[name]
+            # keep only the prompt positions: axis 2 for [1, H, S, D]
+            # codes/values, axis 2 for [1, H, S] scales too
+            piece = jax.lax.slice_in_dim(piece, 0, prompt_len, axis=2)
+            entry[name] = jax.lax.dynamic_update_slice(
+                buf, piece, (row,) + (0,) * (buf.ndim - 1)
+            )
+        new_layers.append(entry)
     lengths = jax.lax.dynamic_update_index_in_dim(
         cache["length"], length, row, 0
     )
@@ -105,7 +113,7 @@ def _insert_row_impl(
 _insert_row = partial(
     jax.jit,
     static_argnames=("config", "prompt_len", "family", "temperature",
-                     "top_k", "top_p"),
+                     "top_k", "top_p", "quantized_kv"),
     donate_argnums=(1,),
 )(_insert_row_impl)
 
@@ -148,6 +156,7 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         sample_seed: int = 0,
         mesh=None,
+        quantized_kv: bool = False,
     ) -> None:
         if prompt_len + generate_tokens > config.max_seq_len:
             raise ValueError(
@@ -174,12 +183,19 @@ class ContinuousBatcher:
         self.top_p = top_p
         self.eos_id = eos_id
         self.mesh = mesh
+        self.quantized_kv = quantized_kv
         if family == "llama":
             from .llama import init_llama_cache
 
             self.cache = init_llama_cache(config, batch_size)
         else:
             self.cache = init_cache(config, batch_size)
+        if quantized_kv:
+            # slots store int8 codes + per-position scales: half the
+            # bytes every engine step streams (see decode's int8 cache)
+            from .decode import quantize_cache
+
+            self.cache = quantize_cache(self.cache)
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token for the next decode step
         self._current = jnp.zeros((batch_size,), jnp.int32)
@@ -222,6 +238,7 @@ class ContinuousBatcher:
             config=self.config, prompt_len=self.prompt_len,
             family=self.family, temperature=self.temperature,
             top_k=self.top_k, top_p=self.top_p,
+            quantized_kv=self.quantized_kv,
         )
         if self.mesh is None:
             return lambda params, cache, row, prompt, length, key: (
@@ -242,7 +259,12 @@ class ContinuousBatcher:
         )
 
     def _make_decode_step(self):
-        if self.family == "llama":
+        if self.quantized_kv:
+            if self.family == "llama":
+                from .llama import llama_quantized_decode_step as step_fn
+            else:
+                from .decode import quantized_decode_step as step_fn
+        elif self.family == "llama":
             from .llama import llama_decode_step as step_fn
         else:
             from .decode import decode_step as step_fn
@@ -407,6 +429,7 @@ class ContinuousWorker:
             eos_id=service_config.eos_id,
             sample_seed=service_config.sample_seed,
             mesh=mesh,
+            quantized_kv=service_config.quantized_kv,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as

@@ -619,11 +619,21 @@ def cache_shardings(mesh: Mesh, cache: dict) -> dict:
     """Cache layout on the mesh: batch over ``data``, the cache's head
     axis over ``model`` (full heads for the gpt family via ``wqkv``'s
     output sharding; compact kv heads for llama via ``wkv``'s), positions
-    unsharded.  Serving uses no ``seq`` axis — decode has nothing to ring
-    over."""
-    kv = NamedSharding(mesh, P("data", "model", None, None))
+    unsharded.  Works for both cache layouts — bf16 ``k``/``v``
+    ``[B, H, S, D]`` and the int8 codes ``[B, H, S, D]`` + scales
+    ``[B, H, S]`` (same leading axes, one fewer trailing dim).  Serving
+    uses no ``seq`` axis — decode has nothing to ring over."""
+    four = NamedSharding(mesh, P("data", "model", None, None))
+    three = NamedSharding(mesh, P("data", "model", None))
+
+    def entry_shardings(layer: dict) -> dict:
+        return {
+            name: (four if leaf.ndim == 4 else three)
+            for name, leaf in layer.items()
+        }
+
     return {
-        "layers": [{"k": kv, "v": kv} for _ in cache["layers"]],
+        "layers": [entry_shardings(layer) for layer in cache["layers"]],
         # per-row lengths ride with their rows
         "length": NamedSharding(mesh, P("data")),
     }

@@ -322,6 +322,65 @@ def test_sharded_batcher_outputs_equal_single_chip():
                                       err_msg=f"request {idx}")
 
 
+def test_quantized_slots_equal_per_request_quantized_generate():
+    # int8 KV slots: the outputs-equal-per-request invariant holds
+    # against generate(quantized_cache=True) — same quantized math,
+    # rolling scheduling
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        quantized_kv=True, eos_id=5,
+    )
+    requests = prompts(5, rng_seed=9)
+    results = {}
+    queue = list(enumerate(requests))
+    for _ in range(200):
+        while queue and batcher.free_slots:
+            idx, ids = queue.pop(0)
+            batcher.submit(ids, payload=idx)
+        for idx, tokens in batcher.step():
+            results[idx] = tokens
+        if not queue and batcher.active == 0:
+            break
+    assert len(results) == 5
+    for idx, ids in enumerate(requests):
+        ref = np.asarray(generate(
+            params, jnp.asarray(ids, jnp.int32)[None], 4, TINY,
+            quantized_cache=True, eos_id=5,
+        )[0])
+        np.testing.assert_array_equal(results[idx], ref,
+                                      err_msg=f"request {idx}")
+
+
+def test_quantized_llama_slots_run():
+    from kube_sqs_autoscaler_tpu.workloads.llama import init_llama_params
+
+    config = _llama_tiny()
+    params = init_llama_params(jax.random.key(1), config)
+    batcher = ContinuousBatcher(
+        params, config, batch_size=2, prompt_len=8, generate_tokens=3,
+        family="llama", quantized_kv=True,
+    )
+    done = 0
+    for ids in prompts(3, rng_seed=10, max_len=8):
+        while not batcher.free_slots:
+            done += len(batcher.step())
+        batcher.submit(ids)
+    for _ in range(50):
+        done += len(batcher.step())
+        if batcher.active == 0:
+            break
+    assert done == 3
+
+
+def test_worker_binary_continuous_quantize_kv_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "4", "--continuous", "--quantize-kv",
+                 "--batch-size", "2", "--seq-len", "12",
+                 "--generate-tokens", "3", "--eos-id", "5"])
+
+
 def test_worker_binary_continuous_model_parallel_demo():
     from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
 
