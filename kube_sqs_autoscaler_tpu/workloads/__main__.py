@@ -166,8 +166,6 @@ def main(argv=None) -> None:
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--model-parallel", bool(args.model_parallel)),
             ("--beams > 1", args.beams > 1),
-            ("--speculative-draft-layers",
-             bool(args.speculative_draft_layers)),
         ):
             if bad:
                 raise SystemExit(f"--quantize-kv does not support {flag}")
@@ -502,6 +500,7 @@ def main(argv=None) -> None:
                          else None),
                     top_k=args.top_k, top_p=args.top_p,
                     eos_id=service_config.eos_id,
+                    quantized_cache=service_config.quantized_kv,
                 )
             )
         log.info(

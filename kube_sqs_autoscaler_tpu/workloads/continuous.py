@@ -74,10 +74,10 @@ def _insert_row_impl(
     real length and its first continuation token (greedy or sampled by
     the shared ``_pick`` policy with ``key``) is ready to feed the next
     ``decode_step``.  ``family`` picks the prefill: the gpt path or the
-    llama GQA path — the splice is layout-agnostic (cache entries are
-    per-layer arrays with the batch row leading and the position on the
-    third-from-last axis for 4-d codes/values, last for 3-d scales;
-    both the bf16 and the int8 layouts fit that shape).
+    llama GQA path — the splice is layout-agnostic (every cache entry
+    puts the batch row on axis 0 and the POSITION on axis 2: ``[B, H,
+    S, D]`` codes/values and ``[B, H, S]`` scales alike, so one
+    axis-2 slice serves both the bf16 and the int8 layouts).
     """
     if quantized_kv:
         if family == "llama":
@@ -184,18 +184,23 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.mesh = mesh
         self.quantized_kv = quantized_kv
-        if family == "llama":
+        if quantized_kv:
+            # slots store int8 codes + per-position scales: half the
+            # bytes every engine step streams (see decode's int8 cache),
+            # allocated directly — no transient bf16 buffers at startup
+            from .decode import init_quantized_cache
+
+            self.cache = init_quantized_cache(
+                config, batch_size,
+                kv_heads=(config.n_kv_heads if family == "llama"
+                          else None),
+            )
+        elif family == "llama":
             from .llama import init_llama_cache
 
             self.cache = init_llama_cache(config, batch_size)
         else:
             self.cache = init_cache(config, batch_size)
-        if quantized_kv:
-            # slots store int8 codes + per-position scales: half the
-            # bytes every engine step streams (see decode's int8 cache)
-            from .decode import quantize_cache
-
-            self.cache = quantize_cache(self.cache)
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token for the next decode step
         self._current = jnp.zeros((batch_size,), jnp.int32)

@@ -252,6 +252,33 @@ def quantize_cache(cache: dict) -> dict:
     return {"layers": layers, "length": cache["length"]}
 
 
+def init_quantized_cache(
+    config: ModelConfig, batch: int, kv_heads: int | None = None
+) -> dict:
+    """An EMPTY int8 cache, allocated directly — no transient bf16
+    buffers, no quantize pass over zeros (what ``quantize_cache`` of a
+    fresh :func:`init_cache` would produce, at ~2.5x the startup HBM).
+    ``kv_heads`` overrides the head count for the llama family's
+    compact GQA layout.  Zero codes with the floor scale match
+    ``quantize_kv`` of zeros exactly; empty slots are masked by the
+    per-row ``length`` either way."""
+    heads = kv_heads if kv_heads is not None else config.n_heads
+    shape = (batch, heads, config.max_seq_len, config.head_dim)
+    sshape = shape[:3]
+    return {
+        "layers": [
+            {
+                "k_codes": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.full(sshape, 1e-12, jnp.float32),
+                "v_codes": jnp.zeros(shape, jnp.int8),
+                "v_scale": jnp.full(sshape, 1e-12, jnp.float32),
+            }
+            for _ in range(config.n_layers)
+        ],
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
 def quantized_prefill(
     params: dict,
     tokens: jax.Array,
@@ -337,6 +364,50 @@ def quantized_decode_step(
     )
 
 
+def _quantized_chunk_write(layer_cache, k, v, rows, cols):
+    """Quantize a ``[B, H, T, D]`` chunk's k/v per position and write the
+    codes+scales at each row's ``cols`` slots; returns the new entry.
+    Shared by the gpt and llama quantized chunk decoders."""
+    kc, ks = quantize_kv(k)  # codes [B, H, T, D], scales [B, H, T]
+    vc, vs = quantize_kv(v)
+    return {
+        "k_codes": layer_cache["k_codes"].at[rows, :, cols].set(
+            kc.transpose(0, 2, 1, 3)
+        ),
+        "k_scale": layer_cache["k_scale"].at[rows, :, cols].set(
+            ks.transpose(0, 2, 1)
+        ),
+        "v_codes": layer_cache["v_codes"].at[rows, :, cols].set(
+            vc.transpose(0, 2, 1, 3)
+        ),
+        "v_scale": layer_cache["v_scale"].at[rows, :, cols].set(
+            vs.transpose(0, 2, 1)
+        ),
+    }
+
+
+def quantized_chunk_decode(
+    params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
+) -> tuple[jax.Array, dict]:
+    """:func:`chunk_decode` against the int8 cache: quantize the chunk's
+    k/v per position, write codes+scales, attend via the factorized
+    dequantize.  Per-position quantization makes the written codes
+    IDENTICAL to what T :func:`quantized_decode_step` calls would write,
+    so the speculative verify step stays exact relative to sequential
+    quantized decode (same caveat as the bf16 pair: up to argmax ties).
+    """
+
+    def write_and_attend(q, k, v, layer_cache, rows, cols, start):
+        entry = _quantized_chunk_write(layer_cache, k, v, rows, cols)
+        return entry, _quantized_chunk_cached_attention(
+            q, entry["k_codes"], entry["k_scale"], entry["v_codes"],
+            entry["v_scale"], start,
+        )
+
+    return _chunk_decode_impl(params, cache, tokens, config,
+                              write_and_attend)
+
+
 def _mask_top_k(logits: jax.Array, top_k: int) -> jax.Array:
     """Keep the ``top_k`` highest logits per row, ``-inf`` elsewhere.
     Ties at the k-th value are all kept (the usual top-k caveat)."""
@@ -393,6 +464,43 @@ def _chunk_cached_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
 
 
+def _chunk_decode_impl(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    write_and_attend,
+) -> tuple[jax.Array, dict]:
+    """The gpt-family chunk-decode skeleton both cache layouts share:
+    embed at each row's chunk positions, per layer call
+    ``write_and_attend(q, k, v, layer_cache, rows, cols, start) ->
+    (new_entry, out)``, full-chunk logits (same seam shape as
+    :func:`_decode_impl`; the llama counterpart is
+    ``llama._llama_chunk_decode_impl``)."""
+    start = cache["length"]  # [B]
+    batch, chunk = tokens.shape
+    rows = jnp.arange(batch)[:, None]
+    cols = start[:, None] + jnp.arange(chunk)[None, :]  # [B, T]
+    x = (
+        params["embed"][tokens]
+        + params["pos_embed"][cols]
+    )
+    new_layers = []
+    for layer, layer_cache in zip(params["layers"], cache["layers"]):
+
+        def attend(q, k, v, _lc=layer_cache):
+            entry, out = write_and_attend(q, k, v, _lc, rows, cols, start)
+            new_layers.append(entry)
+            return out
+
+        x = _block(x, layer, config, attend)
+    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+    return logits, {"layers": new_layers, "length": start + chunk}
+
+
 def chunk_decode(
     params: dict, cache: dict, tokens: jax.Array, config: ModelConfig
 ) -> tuple[jax.Array, dict]:
@@ -410,35 +518,21 @@ def chunk_decode(
     :func:`decode_step` calls by construction (the chunk's keys land in
     the same cache slots; the mask reproduces causality).
     """
-    start = cache["length"]  # [B]
-    batch, chunk = tokens.shape
-    rows = jnp.arange(batch)[:, None]
-    cols = start[:, None] + jnp.arange(chunk)[None, :]  # [B, T]
-    x = (
-        params["embed"][tokens]
-        + params["pos_embed"][cols]
-    )
-    new_layers = []
-    for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
-        def attend(q, k, v, _lc=layer_cache):
-            # write the chunk's k/v at each row's positions, then attend
-            # the T queries against the whole (row+chunk masked) cache
-            k_cache = _lc["k"].at[rows, :, cols].set(
-                k.transpose(0, 2, 1, 3).astype(config.dtype)
-            )
-            v_cache = _lc["v"].at[rows, :, cols].set(
-                v.transpose(0, 2, 1, 3).astype(config.dtype)
-            )
-            new_layers.append({"k": k_cache, "v": v_cache})
-            return _chunk_cached_attention(q, k_cache, v_cache, start)
+    def write_and_attend(q, k, v, layer_cache, rows, cols, start):
+        # write the chunk's k/v at each row's positions, then attend
+        # the T queries against the whole (row+chunk masked) cache
+        k_cache = layer_cache["k"].at[rows, :, cols].set(
+            k.transpose(0, 2, 1, 3).astype(config.dtype)
+        )
+        v_cache = layer_cache["v"].at[rows, :, cols].set(
+            v.transpose(0, 2, 1, 3).astype(config.dtype)
+        )
+        entry = {"k": k_cache, "v": v_cache}
+        return entry, _chunk_cached_attention(q, k_cache, v_cache, start)
 
-        x = _block(x, layer, config, attend)
-    x = _layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
-    logits = jnp.einsum(
-        "bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32
-    )
-    return logits, {"layers": new_layers, "length": start + chunk}
+    return _chunk_decode_impl(params, cache, tokens, config,
+                              write_and_attend)
 
 
 def _pick(

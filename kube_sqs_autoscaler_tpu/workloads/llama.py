@@ -198,6 +198,16 @@ def repeat_kv(t: jax.Array, groups: int) -> jax.Array:
     ).reshape(batch, kv_heads * groups, seq, dim)
 
 
+def expand_gqa(t: jax.Array, groups: int) -> jax.Array:
+    """:func:`repeat_kv` for any rank: 4-d codes/values broadcast
+    directly; 3-d per-position int8-cache scales ``[B, H_kv, S]`` ride
+    the same broadcast through a trailing dummy dim.  The one GQA
+    expansion the quantized decode paths use for both leaf kinds."""
+    if t.ndim == 3:
+        return repeat_kv(t[..., None], groups)[..., 0]
+    return repeat_kv(t, groups)
+
+
 def _project_qkv(
     h: jax.Array, layer: dict, config: LlamaConfig, positions: jax.Array
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -806,16 +816,11 @@ def llama_quantized_decode_step(
     from .decode import _quantized_write_and_attend
 
     groups = config.n_heads // config.n_kv_heads
-
-    def broadcast(t):
-        if t.ndim == 3:  # [B, H_kv, S] scales ride like their codes
-            return repeat_kv(t[..., None], groups)[..., 0]
-        return repeat_kv(t, groups)
-
     return _decode_step_impl(
         params, cache, tokens, config,
         _quantized_write_and_attend(
-            window=config.sliding_window, broadcast=broadcast
+            window=config.sliding_window,
+            broadcast=lambda t: expand_gqa(t, groups),
         ),
     )
 
@@ -831,11 +836,41 @@ def llama_chunk_decode(
     ``T``.  The verify step of llama-family speculative decoding."""
     from .decode import _chunk_cached_attention
 
+    groups = config.n_heads // config.n_kv_heads
+
+    def write_and_attend(q, k, v, layer_cache, rows, cols, start):
+        k_cache = layer_cache["k"].at[rows, :, cols].set(
+            k.transpose(0, 2, 1, 3).astype(config.dtype)
+        )
+        v_cache = layer_cache["v"].at[rows, :, cols].set(
+            v.transpose(0, 2, 1, 3).astype(config.dtype)
+        )
+        entry = {"k": k_cache, "v": v_cache}
+        return entry, _chunk_cached_attention(
+            q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
+            start, window=config.sliding_window,
+        )
+
+    return _llama_chunk_decode_impl(params, cache, tokens, config,
+                                    write_and_attend)
+
+
+def _llama_chunk_decode_impl(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    write_and_attend,
+) -> tuple[jax.Array, dict]:
+    """The llama-family chunk-decode skeleton both cache layouts share:
+    embed, RoPE at per-row chunk positions, per layer
+    ``write_and_attend(q, k, v, layer_cache, rows, cols, start) ->
+    (new_entry, out)``, full-chunk logits (the chunk counterpart of
+    :func:`_decode_step_impl`)."""
     start = cache["length"]  # [B]
     batch, chunk = tokens.shape
     rows = jnp.arange(batch)[:, None]
     cols = start[:, None] + jnp.arange(chunk)[None, :]  # [B, T]
-    groups = config.n_heads // config.n_kv_heads
     # [B, 1, T] RoPE positions broadcast against [B, H, T, D/2] angles
     positions = start[:, None, None] + jnp.arange(chunk)[None, None, :]
     x = params["embed"][tokens]
@@ -843,17 +878,9 @@ def llama_chunk_decode(
     for layer, layer_cache in zip(params["layers"], cache["layers"]):
 
         def attend(q, k, v, _lc=layer_cache):
-            k_cache = _lc["k"].at[rows, :, cols].set(
-                k.transpose(0, 2, 1, 3).astype(config.dtype)
-            )
-            v_cache = _lc["v"].at[rows, :, cols].set(
-                v.transpose(0, 2, 1, 3).astype(config.dtype)
-            )
-            new_layers.append({"k": k_cache, "v": v_cache})
-            return _chunk_cached_attention(
-                q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
-                start, window=config.sliding_window,
-            )
+            entry, out = write_and_attend(q, k, v, _lc, rows, cols, start)
+            new_layers.append(entry)
+            return out
 
         x = _llama_block(x, layer, config, positions, attend)
     x = _rms_norm(x, params["final_norm"], config.rms_eps)
@@ -861,6 +888,34 @@ def llama_chunk_decode(
 
     logits = unembed(x, readout_weights(params))
     return logits, {"layers": new_layers, "length": start + chunk}
+
+
+def llama_quantized_chunk_decode(
+    params: dict, cache: dict, tokens: jax.Array, config: LlamaConfig
+) -> tuple[jax.Array, dict]:
+    """:func:`llama_chunk_decode` against the int8 GQA cache (the llama
+    counterpart of ``decode.quantized_chunk_decode`` — compact codes and
+    scales broadcast to full heads at the attention, window included)."""
+    from .decode import (
+        _quantized_chunk_cached_attention,
+        _quantized_chunk_write,
+    )
+
+    groups = config.n_heads // config.n_kv_heads
+
+    def write_and_attend(q, k, v, layer_cache, rows, cols, start):
+        entry = _quantized_chunk_write(layer_cache, k, v, rows, cols)
+        return entry, _quantized_chunk_cached_attention(
+            q,
+            expand_gqa(entry["k_codes"], groups),
+            expand_gqa(entry["k_scale"], groups),
+            expand_gqa(entry["v_codes"], groups),
+            expand_gqa(entry["v_scale"], groups),
+            start, window=config.sliding_window,
+        )
+
+    return _llama_chunk_decode_impl(params, cache, tokens, config,
+                                    write_and_attend)
 
 
 def llama_generate(

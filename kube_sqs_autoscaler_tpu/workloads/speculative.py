@@ -56,13 +56,28 @@ from .decode import chunk_decode, decode_step, prefill
 from .model import ModelConfig
 
 
-def _family_ops(config):
+def _family_ops(config, quantized_cache: bool = False):
     """(prefill, decode_step, chunk_decode) for the config's family —
     llama configs (they carry ``n_kv_heads``) get the GQA/RoPE cache ops,
     everything else the gpt-family ops.  Target and draft dispatch
     independently, so a llama target can use a gpt draft and vice versa
-    (the only shared contract is the vocabulary)."""
+    (the only shared contract is the vocabulary).
+
+    ``quantized_cache`` swaps in the int8-cache triple: per-position
+    quantization writes IDENTICAL codes whether a position arrives via a
+    draft step or the chunk-wide verify, so greedy speculative over int8
+    caches still equals plain quantized greedy decode token for token
+    (up to argmax ties)."""
     if hasattr(config, "n_kv_heads"):
+        if quantized_cache:
+            from .llama import (
+                llama_quantized_chunk_decode,
+                llama_quantized_decode_step,
+                llama_quantized_prefill,
+            )
+
+            return (llama_quantized_prefill, llama_quantized_decode_step,
+                    llama_quantized_chunk_decode)
         from .llama import (
             llama_chunk_decode,
             llama_decode_step,
@@ -72,6 +87,14 @@ def _family_ops(config):
         # llama_prefill's (params, tokens, config, prompt_attention,
         # lengths) lines up with the gpt prefill call shape directly
         return llama_prefill, llama_decode_step, llama_chunk_decode
+    if quantized_cache:
+        from .decode import (
+            quantized_chunk_decode,
+            quantized_decode_step,
+            quantized_prefill,
+        )
+
+        return quantized_prefill, quantized_decode_step, quantized_chunk_decode
     return prefill, decode_step, chunk_decode
 
 
@@ -152,6 +175,7 @@ def speculative_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """Greedy generation through the draft-and-verify loop — or, with
     ``temperature > 0`` (and ``rng``), full *speculative sampling*: the
@@ -214,8 +238,9 @@ def speculative_generate(
 
     k = draft_tokens
     rows = jnp.arange(batch)
-    t_prefill, t_step, t_chunk = _family_ops(config_target)
-    d_prefill, d_step, _ = _family_ops(config_draft)
+    t_prefill, t_step, t_chunk = _family_ops(config_target,
+                                             quantized_cache)
+    d_prefill, d_step, _ = _family_ops(config_draft, quantized_cache)
     t_logits, t_cache = t_prefill(
         params_target, prompt, config_target, attention_fn, lengths=lengths
     )
@@ -423,7 +448,7 @@ def make_speculative_serving_fn(
     static_argnames=(
         "config_target", "config_draft", "num_tokens", "draft_tokens",
         "attention_fn", "return_stats", "temperature", "top_k", "top_p",
-        "eos_id",
+        "eos_id", "quantized_cache",
     ),
 )
 def speculative_generate_jit(
@@ -442,6 +467,7 @@ def speculative_generate_jit(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """Compiled :func:`speculative_generate` (one program: prefills +
     the whole while_loop of rounds)."""
@@ -450,5 +476,5 @@ def speculative_generate_jit(
         num_tokens, draft_tokens=draft_tokens, attention_fn=attention_fn,
         lengths=lengths, return_stats=return_stats,
         temperature=temperature, rng=rng, top_k=top_k, top_p=top_p,
-        eos_id=eos_id,
+        eos_id=eos_id, quantized_cache=quantized_cache,
     )

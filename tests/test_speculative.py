@@ -219,6 +219,16 @@ def test_speculative_llama_family():
     )
     np.testing.assert_array_equal(got, ref)
 
+    # the int8 GQA caches through the same loop: identical to plain
+    # llama quantized greedy decode
+    qref = np.asarray(llama_generate(params_t, prompt, 10, tcfg,
+                                     quantized_cache=True))
+    qgot = np.asarray(
+        speculative_generate(params_t, tcfg, params_d, dcfg, prompt, 10,
+                             draft_tokens=3, quantized_cache=True)
+    )
+    np.testing.assert_array_equal(qgot, qref)
+
 
 def test_speculative_untied_readout_llama():
     """An HF-imported llama with a separate lm_head speculates correctly
@@ -329,6 +339,62 @@ def test_speculative_sampling_end_to_end(models):
                              temperature=0.5)
 
 
+def test_quantized_chunk_decode_equals_quantized_steps(models):
+    # per-position quantization: the chunk-wide verify writes IDENTICAL
+    # codes to T sequential quantized steps, so logits agree
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        quantized_chunk_decode,
+        quantized_decode_step,
+        quantized_prefill,
+    )
+
+    params_t, _ = models
+    prompt = prompt_tokens(seed=11)
+    _, chunk_cache = quantized_prefill(params_t, prompt, TARGET)
+    _, step_cache = quantized_prefill(params_t, prompt, TARGET)
+    chunk = jax.random.randint(jax.random.key(12), (3, 4), 0,
+                               TARGET.vocab_size, jnp.int32)
+    chunk_logits, chunk_cache = quantized_chunk_decode(
+        params_t, chunk_cache, chunk, TARGET
+    )
+    for t in range(4):
+        step_logits, step_cache = quantized_decode_step(
+            params_t, step_cache, chunk[:, t], TARGET
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunk_logits[:, t]), np.asarray(step_logits),
+            rtol=1e-4, atol=1e-4, err_msg=f"position {t}",
+        )
+    for a, b in zip(jax.tree.leaves(chunk_cache),
+                    jax.tree.leaves(step_cache)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quantized_speculative_equals_quantized_greedy(models):
+    # the int8-cache draft-and-verify loop: identical to plain quantized
+    # greedy generate (draft only buys throughput), eos included
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate as _gen
+
+    params_t, params_d = models
+    prompt = prompt_tokens(seed=13)
+    ref = np.asarray(_gen(params_t, prompt, 12, TARGET,
+                          quantized_cache=True))
+    got = np.asarray(speculative_generate(
+        params_t, TARGET, params_d, DRAFT, prompt, 12, draft_tokens=3,
+        quantized_cache=True,
+    ))
+    np.testing.assert_array_equal(got, ref)
+
+    eos = int(ref[0, 2])
+    ref_eos = np.asarray(_gen(params_t, prompt, 12, TARGET,
+                              quantized_cache=True, eos_id=eos))
+    got_eos = np.asarray(speculative_generate(
+        params_t, TARGET, params_d, DRAFT, prompt, 12, draft_tokens=3,
+        quantized_cache=True, eos_id=eos,
+    ))
+    np.testing.assert_array_equal(got_eos, ref_eos)
+
+
 def test_speculative_tp_sharded_matches_single_chip(models):
     # the last sharded-serving composition hole: draft-and-verify over a
     # (data, model) mesh, identical greedy outputs to single-chip
@@ -391,6 +457,10 @@ def test_serve_binary_speculative_flag():
         main(["--demo", "4", "--batch-size", "4", "--seq-len", "8",
               "--generate-tokens", "4", "--speculative-draft-layers", "2",
               "--model-parallel", "2"])
+    # int8 caches through the draft-and-verify loop
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--speculative-draft-layers", "2",
+          "--quantize-kv", "--eos-id", "5"])
     with pytest.raises(SystemExit, match="n_layers"):
         main(["--demo", "1", "--generate-tokens", "4",
               "--speculative-draft-layers", "99"])
