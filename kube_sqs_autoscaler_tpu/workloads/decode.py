@@ -364,6 +364,21 @@ def quantized_decode_step(
     )
 
 
+def _chunk_write(layer_cache, k, v, rows, cols, dtype):
+    """Write a ``[B, H, T, D]`` chunk's k/v at each row's ``cols`` slots
+    of the bf16 cache; returns the new entry.  Shared by the gpt and
+    llama chunk decoders (the int8 twin: :func:`_quantized_chunk_write`).
+    """
+    return {
+        "k": layer_cache["k"].at[rows, :, cols].set(
+            k.transpose(0, 2, 1, 3).astype(dtype)
+        ),
+        "v": layer_cache["v"].at[rows, :, cols].set(
+            v.transpose(0, 2, 1, 3).astype(dtype)
+        ),
+    }
+
+
 def _quantized_chunk_write(layer_cache, k, v, rows, cols):
     """Quantize a ``[B, H, T, D]`` chunk's k/v per position and write the
     codes+scales at each row's ``cols`` slots; returns the new entry.
@@ -522,14 +537,10 @@ def chunk_decode(
     def write_and_attend(q, k, v, layer_cache, rows, cols, start):
         # write the chunk's k/v at each row's positions, then attend
         # the T queries against the whole (row+chunk masked) cache
-        k_cache = layer_cache["k"].at[rows, :, cols].set(
-            k.transpose(0, 2, 1, 3).astype(config.dtype)
+        entry = _chunk_write(layer_cache, k, v, rows, cols, config.dtype)
+        return entry, _chunk_cached_attention(
+            q, entry["k"], entry["v"], start
         )
-        v_cache = layer_cache["v"].at[rows, :, cols].set(
-            v.transpose(0, 2, 1, 3).astype(config.dtype)
-        )
-        entry = {"k": k_cache, "v": v_cache}
-        return entry, _chunk_cached_attention(q, k_cache, v_cache, start)
 
     return _chunk_decode_impl(params, cache, tokens, config,
                               write_and_attend)

@@ -834,20 +834,14 @@ def llama_chunk_decode(
     ``t`` of the fp32 ``[B, T, vocab]`` logits is the next-token
     distribution after consuming input ``t``; the cache advances by
     ``T``.  The verify step of llama-family speculative decoding."""
-    from .decode import _chunk_cached_attention
+    from .decode import _chunk_cached_attention, _chunk_write
 
     groups = config.n_heads // config.n_kv_heads
 
     def write_and_attend(q, k, v, layer_cache, rows, cols, start):
-        k_cache = layer_cache["k"].at[rows, :, cols].set(
-            k.transpose(0, 2, 1, 3).astype(config.dtype)
-        )
-        v_cache = layer_cache["v"].at[rows, :, cols].set(
-            v.transpose(0, 2, 1, 3).astype(config.dtype)
-        )
-        entry = {"k": k_cache, "v": v_cache}
+        entry = _chunk_write(layer_cache, k, v, rows, cols, config.dtype)
         return entry, _chunk_cached_attention(
-            q, repeat_kv(k_cache, groups), repeat_kv(v_cache, groups),
+            q, repeat_kv(entry["k"], groups), repeat_kv(entry["v"], groups),
             start, window=config.sliding_window,
         )
 

@@ -130,6 +130,44 @@ def lora_param_count(adapters: dict) -> int:
     return sum(p.size for p in jax.tree.leaves(adapters))
 
 
+def _jit_adapter_step(
+    mesh, optimizer, compute_grads, adapter_state, batch_sharding
+):
+    """The one adapter-only optimizer step: shared by the flat and
+    pipelined LoRA step builders (they differ only in the loss closure
+    inside ``compute_grads`` and the batch sharding).  Adapters and
+    their Adam moments replicate across the mesh; their gradients arrive
+    via XLA's all-reduce of the data-parallel shards."""
+    import optax
+
+    from .train import replicated
+
+    def train_step(state, tokens):
+        loss_value, grads = compute_grads(state["adapters"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["adapters"]
+        )
+        adapters = optax.apply_updates(state["adapters"], updates)
+        return (
+            {
+                "adapters": adapters,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            },
+            loss_value,
+        )
+
+    rep = replicated(mesh)
+    state_shard = jax.tree.map(lambda _: rep, adapter_state,
+                               is_leaf=lambda x: x is None)
+    return jax.jit(
+        train_step,
+        in_shardings=(state_shard, batch_sharding),
+        out_shardings=(state_shard, rep),
+        donate_argnums=0,
+    )
+
+
 def make_lora_train_step(
     mesh,
     model_config: Any,
@@ -147,18 +185,12 @@ def make_lora_train_step(
     tokens, attention_fn)`` defaults to the family objective via
     ``train.loss_fn`` — pass ``llama.llama_loss_fn``-shaped callables for
     other families (same seam as ``train.make_train_step``).
-
-    Adapters and their Adam moments replicate across the mesh; their
-    gradients arrive via XLA's all-reduce of the data-parallel shards.
     """
-    import optax
-
     from .train import (
         accumulate_value_and_grad,
         batch_sharding,
         make_optimizer,
         mesh_attention_fn,
-        replicated,
     )
 
     optimizer = make_optimizer(train_config)
@@ -185,30 +217,8 @@ def make_lora_train_step(
     compute_grads = accumulate_value_and_grad(
         jax.value_and_grad(adapter_loss), train_config.grad_accum
     )
-
-    def train_step(state, tokens):
-        loss_value, grads = compute_grads(state["adapters"], tokens)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["adapters"]
-        )
-        adapters = optax.apply_updates(state["adapters"], updates)
-        return (
-            {
-                "adapters": adapters,
-                "opt_state": opt_state,
-                "step": state["step"] + 1,
-            },
-            loss_value,
-        )
-
-    rep = replicated(mesh)
-    state_shard = jax.tree.map(lambda _: rep, adapter_state,
-                               is_leaf=lambda x: x is None)
-    return jax.jit(
-        train_step,
-        in_shardings=(state_shard, batch_sharding(mesh)),
-        out_shardings=(state_shard, rep),
-        donate_argnums=0,
+    return _jit_adapter_step(
+        mesh, optimizer, compute_grads, adapter_state, batch_sharding(mesh)
     )
 
 
@@ -225,6 +235,177 @@ def init_lora_train_state(
         "adapters": adapters,
         "opt_state": opt_state,
         "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def _pipeline_targets(targets: tuple) -> tuple:
+    """Translate flat-layout target names to the stage-stacked layout's:
+    the pipeline splits fused projections (``stack_layers`` /
+    ``stack_llama_layers``), so a flat target like ``wqkv`` means the
+    split ``wq``/``wk``/``wv`` there.  Adapting the splits individually
+    is the LoRA paper's own per-projection scheme — rank ``r`` per
+    projection rather than one rank-``r`` factor across the fused axis."""
+    split = {
+        "wqkv": ("wq", "wk", "wv"),
+        "wkv": ("wk", "wv"),
+        "w_gate_up": ("w_gate", "w_up"),
+    }
+    out: list = []
+    for name in targets:
+        for t in split.get(name, (name,)):
+            if t not in out:
+                out.append(t)
+    return tuple(out)
+
+
+def init_pipeline_lora_params(
+    rng: jax.Array, params: dict, config: LoraConfig
+) -> dict:
+    """Adapters for the stage-stacked pipeline layout
+    (:func:`.pipeline.as_pipeline_params` /
+    :func:`.pipeline.as_llama_pipeline_params`).
+
+    Stacked layer weights carry a leading layer axis ``[L, in, out]``,
+    so each target gets ONE adapter pair ``a [L, in, r]``, ``b [L, r,
+    out]`` covering every layer — the per-layer factors ride the same
+    leading axis as the weights they adapt (and shard over ``"pipe"``
+    with them if placed; the trainer replicates them — they are tiny).
+    Same init scheme as :func:`init_lora_params`: ``A ~ N(0, 1/r)``,
+    ``B = 0`` so the adapted model starts exactly at the base.
+    """
+    stages = params["stages"]
+    adapters = {}
+    for t, name in enumerate(_pipeline_targets(config.targets)):
+        w = stages.get(name)
+        if w is None or w.ndim != 3:
+            continue
+        key = jax.random.fold_in(rng, t)
+        adapters[name] = {
+            "a": (
+                jax.random.normal(
+                    key, (w.shape[0], w.shape[1], config.rank), jnp.float32
+                )
+                / config.rank
+            ),
+            "b": jnp.zeros((w.shape[0], config.rank, w.shape[2]),
+                           jnp.float32),
+        }
+    if not adapters:
+        raise ValueError(
+            f"no targeted stage weights found: targets={config.targets}, "
+            f"stage keys={sorted(stages)}"
+        )
+    return {"stages": adapters}
+
+
+def apply_pipeline_lora(
+    params: dict, adapters: dict, config: LoraConfig
+) -> dict:
+    """Effective stage stacks ``W + (alpha/r)·A@B`` (leading layer axis
+    batched through the einsum; non-adapted leaves pass through by
+    reference).  Pure — call inside the jitted step, before the stacks
+    enter the pipeline's ``shard_map``: the add happens in auto-sharded
+    land, so XLA slices the (replicated) delta into each stage's
+    ``"pipe"`` shard without collectives."""
+    stages = dict(params["stages"])
+    for name, ab in adapters["stages"].items():
+        w = stages[name]
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * config.scale
+        stages[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return dict(params, stages=stages)
+
+
+def init_pipeline_lora_train_state(
+    rng: jax.Array, params: dict, lora: LoraConfig, train_config: Any
+) -> dict:
+    """:func:`init_lora_train_state` for the stage-stacked layout."""
+    from .train import make_optimizer
+
+    adapters = init_pipeline_lora_params(rng, params, lora)
+    opt_state = make_optimizer(train_config).init(adapters)
+    return {
+        "adapters": adapters,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def make_lora_pipeline_train_step(
+    mesh,
+    model_config: Any,
+    pcfg: Any,
+    train_config: Any,
+    frozen_params: dict,
+    adapter_state: dict,
+    lora: LoraConfig,
+    llama: bool = False,
+):
+    """Compile one adapter-only optimizer step over a pipeline mesh.
+
+    The GPipe loss is plain autodiff, so a LoRA step is the pipelined
+    loss evaluated at :func:`apply_pipeline_lora` with gradients flowing
+    only to the adapters — the frozen stage stacks are a closed-over
+    constant (placed with their usual ``"pipe"``-sharded layout, never
+    donated).  GPipe only: the 1F1B schedule's hand-built backward
+    produces stage-weight gradients, not adapter gradients.
+
+    Gradient accumulation composes via the shared fp32 chunked scan over
+    the batch axis (``accum_axis=1`` — axis 0 is the pipeline's own
+    microbatch schedule).
+    """
+    from .pipeline import (
+        llama_pipeline_loss_fn,
+        pipeline_batch_sharding,
+        pipeline_loss_fn,
+    )
+    from .train import accumulate_value_and_grad, make_optimizer
+
+    if pcfg.schedule != "gpipe":
+        raise ValueError(
+            "LoRA over pipeline parallelism runs the gpipe schedule only "
+            "(1f1b's explicitly-scheduled backward computes stage-weight "
+            "gradients, not adapter gradients)"
+        )
+    optimizer = make_optimizer(train_config)
+    loss_fn = llama_pipeline_loss_fn if llama else pipeline_loss_fn
+    remat = getattr(train_config, "remat", False)
+
+    def adapter_loss(adapters, tokens):
+        return loss_fn(
+            apply_pipeline_lora(frozen_params, adapters, lora), tokens,
+            config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
+        )
+
+    compute_grads = accumulate_value_and_grad(
+        jax.value_and_grad(adapter_loss), train_config.grad_accum,
+        accum_axis=1,
+    )
+    return _jit_adapter_step(
+        mesh, optimizer, compute_grads, adapter_state,
+        pipeline_batch_sharding(mesh),
+    )
+
+
+def lora_pipeline_checkpoint_state(
+    frozen_params: dict, state: dict, lora: LoraConfig, llama: bool = False
+) -> dict:
+    """:func:`lora_checkpoint_state` for a pipelined LoRA run: the
+    merged weights are UNSTACKED to the flat serving layout before
+    storage, so the on-disk ``params`` read like any flat checkpoint
+    (serve binary, ``restore_params``, hf-export — same contract as the
+    flat LoRA checkpoint), while the ``lora`` subtree keeps the
+    stage-stacked adapter train state resume needs."""
+    from .pipeline import unstack_layers, unstack_llama_layers
+
+    merged = apply_pipeline_lora(frozen_params, state["adapters"], lora)
+    unstack = unstack_llama_layers if llama else unstack_layers
+    return {
+        "params": unstack(merged),
+        "step": state["step"],
+        "lora": {
+            "adapters": state["adapters"],
+            "opt_state": state["opt_state"],
+        },
     }
 
 

@@ -181,15 +181,17 @@ def _family_forward(family: str):
     return None
 
 
-def _lora_base_state(mesh, base):
+def _lora_base_state(mesh, base, param_shardings_fn=None):
     """The frozen-base 'state' of a LoRA run: just the placed params —
     no optimizer moments, no step (init_lora_train_state carries those
-    for the adapters)."""
+    for the adapters).  ``param_shardings_fn`` overrides the flat layout
+    rules (pipeline runs pass ``pipeline_param_shardings``)."""
     import jax
 
     from .train import param_shardings
 
-    return {"params": jax.device_put(base, param_shardings(mesh, base))}
+    shardings_fn = param_shardings_fn or param_shardings
+    return {"params": jax.device_put(base, shardings_fn(mesh, base))}
 
 
 def train(args) -> dict:
@@ -275,14 +277,19 @@ def train(args) -> dict:
             "Mistral import brings its own)"
         )
     if args.lora_rank:
-        # adapters wrap the flat dense params; layouts that RESTRUCTURE
-        # them (stage stacks, expert weights) are out of scope — fail
-        # fast.  Resume, grad-accum, and zig-zag (which permutes the
-        # batch, not the params) compose.
-        for flag, bad in (("--moe", args.moe),
-                          ("--pipe-parallel", pipe > 1)):
-            if bad:
-                raise SystemExit(f"--lora-rank does not combine with {flag}")
+        # adapters wrap dense 2-D weights — flat or stage-stacked; only
+        # MoE's expert stacks (3-D routed weights) are out of scope.
+        # Resume, grad-accum, zig-zag (permutes the batch, not the
+        # params), and gpipe pipelines (autodiff backward) all compose;
+        # 1F1B's hand-built backward computes stage grads, not adapter
+        # grads, so it fails fast here.
+        if args.moe:
+            raise SystemExit("--lora-rank does not combine with --moe")
+        if pipe > 1 and args.pipe_schedule != "gpipe":
+            raise SystemExit(
+                "--lora-rank with --pipe-parallel supports "
+                "--pipe-schedule gpipe only"
+            )
     if args.hf_checkpoint:
         if args.moe:
             raise SystemExit(
@@ -394,34 +401,54 @@ def train(args) -> dict:
                 place_pipeline_state,
             )
 
-            if hf_base is not None:
-                # fine-tune the imported base THROUGH the pipeline: the
-                # flat HF weights stack into the stage layout (untied
-                # lm_head rides along — both schedules support it)
-                if model_config.n_layers % pipe:
-                    raise SystemExit(
-                        f"HF model has n_layers={model_config.n_layers}, "
-                        f"not divisible by --pipe-parallel {pipe}"
-                    )
-                fresh = init_train_state(
-                    jax.random.key(args.seed), model_config, train_config,
-                    init_fn=lambda rng, cfg: as_llama_pipeline_params(
-                        hf_base
-                    ),
+            if hf_base is not None and model_config.n_layers % pipe:
+                raise SystemExit(
+                    f"HF model has n_layers={model_config.n_layers}, "
+                    f"not divisible by --pipe-parallel {pipe}"
                 )
-            elif args.moe:
-                from .pipeline import init_moe_pipeline_train_state
+            if args.lora_rank:
+                # frozen stage-stacked base, params only (no full-model
+                # Adam moments — the LoRA point, same as the flat branch)
+                from .pipeline import (
+                    init_llama_pipeline_params,
+                    pipeline_param_shardings,
+                )
 
-                fresh = init_moe_pipeline_train_state(
-                    jax.random.key(args.seed), model_config, moe_config,
-                    train_config, n_stages=pipe, llama=True,
+                state = _lora_base_state(
+                    mesh,
+                    as_llama_pipeline_params(hf_base)
+                    if hf_base is not None
+                    else init_llama_pipeline_params(
+                        jax.random.key(args.seed), model_config, pipe
+                    ),
+                    pipeline_param_shardings,
                 )
             else:
-                fresh = init_llama_pipeline_train_state(
-                    jax.random.key(args.seed), model_config, train_config,
-                    n_stages=pipe,
-                )
-            state = place_pipeline_state(mesh, fresh)
+                if hf_base is not None:
+                    # fine-tune the imported base THROUGH the pipeline:
+                    # the flat HF weights stack into the stage layout
+                    # (untied lm_head rides along — both schedules
+                    # support it)
+                    fresh = init_train_state(
+                        jax.random.key(args.seed), model_config,
+                        train_config,
+                        init_fn=lambda rng, cfg: as_llama_pipeline_params(
+                            hf_base
+                        ),
+                    )
+                elif args.moe:
+                    from .pipeline import init_moe_pipeline_train_state
+
+                    fresh = init_moe_pipeline_train_state(
+                        jax.random.key(args.seed), model_config, moe_config,
+                        train_config, n_stages=pipe, llama=True,
+                    )
+                else:
+                    fresh = init_llama_pipeline_train_state(
+                        jax.random.key(args.seed), model_config,
+                        train_config, n_stages=pipe,
+                    )
+                state = place_pipeline_state(mesh, fresh)
         elif args.moe:
             from .moe import init_llama_moe_train_state
 
@@ -472,19 +499,34 @@ def train(args) -> dict:
                 place_pipeline_state,
             )
 
-            if args.moe:
-                from .pipeline import init_moe_pipeline_train_state
+            if args.lora_rank:
+                # frozen stage-stacked base, params only (see llama branch)
+                from .pipeline import (
+                    init_pipeline_params,
+                    pipeline_param_shardings,
+                )
 
-                fresh = init_moe_pipeline_train_state(
-                    jax.random.key(args.seed), model_config, moe_config,
-                    train_config, n_stages=pipe,
+                state = _lora_base_state(
+                    mesh,
+                    init_pipeline_params(
+                        jax.random.key(args.seed), model_config, pipe
+                    ),
+                    pipeline_param_shardings,
                 )
             else:
-                fresh = init_pipeline_train_state(
-                    jax.random.key(args.seed), model_config, train_config,
-                    n_stages=pipe,
-                )
-            state = place_pipeline_state(mesh, fresh)
+                if args.moe:
+                    from .pipeline import init_moe_pipeline_train_state
+
+                    fresh = init_moe_pipeline_train_state(
+                        jax.random.key(args.seed), model_config, moe_config,
+                        train_config, n_stages=pipe,
+                    )
+                else:
+                    fresh = init_pipeline_train_state(
+                        jax.random.key(args.seed), model_config,
+                        train_config, n_stages=pipe,
+                    )
+                state = place_pipeline_state(mesh, fresh)
         elif args.moe:
             from .moe import init_moe_train_state
 
@@ -517,22 +559,34 @@ def train(args) -> dict:
         from .lora import (
             LoraConfig,
             init_lora_train_state,
+            init_pipeline_lora_train_state,
             lora_checkpoint_state,
             lora_param_count,
+            lora_pipeline_checkpoint_state,
         )
 
         lora_cfg = LoraConfig(rank=args.lora_rank, alpha=args.lora_alpha)
         lora_frozen = state["params"]  # placed on the mesh, never updated
-        state = init_lora_train_state(
+        init_adapters = (
+            init_pipeline_lora_train_state if pipe > 1
+            else init_lora_train_state
+        )
+        state = init_adapters(
             jax.random.key(args.seed + 1), lora_frozen, lora_cfg,
             train_config,
         )
         # checkpoints carry the MERGED weights (so serving and hf-export
-        # read them like any flat checkpoint) plus the adapter train
-        # state under "lora" — what restore_lora resumes from
-        save_state = lambda s: lora_checkpoint_state(  # noqa: E731
-            lora_frozen, s, lora_cfg
-        )
+        # read them like any flat checkpoint — a pipelined run unstacks
+        # them to the same flat layout) plus the adapter train state
+        # under "lora" — what restore_lora resumes from
+        if pipe > 1:
+            save_state = lambda s: lora_pipeline_checkpoint_state(  # noqa: E731
+                lora_frozen, s, lora_cfg, llama=args.family == "llama"
+            )
+        else:
+            save_state = lambda s: lora_checkpoint_state(  # noqa: E731
+                lora_frozen, s, lora_cfg
+            )
         log.info(
             "LoRA: rank %d, %s adapter parameters (base frozen)",
             args.lora_rank, f"{lora_param_count(state['adapters']):,}",
@@ -573,18 +627,22 @@ def train(args) -> dict:
                       "top_k": args.moe_top_k}
             if pipe > 1:
                 layout["pipeline_stages"] = pipe
-        elif pipe > 1:
-            layout = {"kind": "pipeline", "n_stages": pipe}
         elif args.lora_rank:
             # params on disk are flat MERGED weights (serving reads them
-            # unchanged); the record is what makes a dense re-run of a
-            # lora dir (or a different rank) fail loudly, and marks the
-            # "lora" subtree restore_lora resumes from.  seed/base are
-            # part of the record because resume REBUILDS the frozen base
-            # from them — a different seed or HF source would silently
-            # continue against a different base
+            # unchanged — a pipelined run unstacks before storing); the
+            # record is what makes a dense re-run of a lora dir (or a
+            # different rank) fail loudly, and marks the "lora" subtree
+            # restore_lora resumes from.  seed/base are part of the
+            # record because resume REBUILDS the frozen base from them —
+            # a different seed or HF source would silently continue
+            # against a different base; pipeline_stages likewise (the
+            # stacked adapter shapes depend on it)
             layout = {"kind": "lora", "rank": args.lora_rank,
                       "seed": args.seed, "base": args.hf_checkpoint or ""}
+            if pipe > 1:
+                layout["pipeline_stages"] = pipe
+        elif pipe > 1:
+            layout = {"kind": "pipeline", "n_stages": pipe}
         else:
             layout = None
         manifest_path = Path(args.checkpoint_dir) / MODEL_MANIFEST
@@ -640,7 +698,23 @@ def train(args) -> dict:
                 )
             log.info("Resumed from checkpoint step %d", latest)
 
-    if args.lora_rank:
+    pipe_config = None
+    if pipe > 1:
+        from .pipeline import PipelineConfig
+
+        pipe_config = PipelineConfig(
+            n_microbatches=args.pipe_microbatches,
+            schedule=args.pipe_schedule,
+        )
+
+    if args.lora_rank and pipe > 1:
+        from .lora import make_lora_pipeline_train_step
+
+        step_fn = make_lora_pipeline_train_step(
+            mesh, model_config, pipe_config, train_config, lora_frozen,
+            state, lora_cfg, llama=args.family == "llama",
+        )
+    elif args.lora_rank:
         from .lora import make_lora_train_step
 
         loss = None
@@ -664,16 +738,11 @@ def train(args) -> dict:
         )
     elif pipe > 1:
         from .pipeline import (
-            PipelineConfig,
             make_llama_pipeline_train_step,
             make_moe_pipeline_train_step,
             make_pipeline_train_step,
         )
 
-        pipe_config = PipelineConfig(
-            n_microbatches=args.pipe_microbatches,
-            schedule=args.pipe_schedule,
-        )
         if args.moe:
             step_fn = make_moe_pipeline_train_step(
                 mesh, model_config, moe_config, pipe_config, train_config,
@@ -752,8 +821,18 @@ def train(args) -> dict:
                 pp_eval = _partial(pp_loss, config=model_config,
                                    pcfg=pipe_config, mesh=mesh)
 
-            def eval_fn_impl(state, tokens):
-                return pp_eval(state["params"], tokens)
+            if args.lora_rank:
+                from .lora import apply_pipeline_lora
+
+                def eval_fn_impl(state, tokens):
+                    return pp_eval(
+                        apply_pipeline_lora(lora_frozen, state["adapters"],
+                                            lora_cfg),
+                        tokens,
+                    )
+            else:
+                def eval_fn_impl(state, tokens):
+                    return pp_eval(state["params"], tokens)
         elif args.moe:
             from .moe import llama_moe_forward, moe_forward
             from .train import mesh_attention_fn, next_token_nll
@@ -1004,9 +1083,10 @@ def train(args) -> dict:
         from .hf_convert import save_hf_llama
 
         export_params = final_state["params"]
-        if pipe > 1:
+        if pipe > 1 and not args.lora_rank:
             # pipeline-trained stacks export like any other llama run:
-            # unstack to the flat layout the converter writes
+            # unstack to the flat layout the converter writes (a LoRA
+            # run's save_state already unstacked its merged weights)
             from .pipeline import unstack_llama_layers
 
             export_params = unstack_llama_layers(export_params)

@@ -1,0 +1,268 @@
+"""LoRA x pipeline parallelism: stage-stacked adapters must start at the
+base exactly, train adapter-only through the GPipe schedule, merge to the
+flat serving layout, and compose with resume/eval through the trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.lora import (
+    LoraConfig,
+    apply_pipeline_lora,
+    init_pipeline_lora_params,
+    init_pipeline_lora_train_state,
+    lora_pipeline_checkpoint_state,
+    make_lora_pipeline_train_step,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (
+    ModelConfig,
+    forward,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+    PipelineConfig,
+    as_pipeline_params,
+    make_pipeline_mesh,
+    pipeline_batch_sharding,
+    pipeline_forward,
+    pipeline_loss_fn,
+    pipeline_param_shardings,
+)
+from kube_sqs_autoscaler_tpu.workloads.train import TrainConfig
+
+# fp32 so pipeline/dense comparisons are exact (no bf16 rounding skew)
+TINY = ModelConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_layers=4, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def stacked_base():
+    return as_pipeline_params(init_params(jax.random.key(0), TINY))
+
+
+def microtokens(m=4, bm=2, seq=16, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (m, bm, seq), 0, TINY.vocab_size, jnp.int32
+    )
+
+
+def test_zero_init_is_identity(stacked_base):
+    lora = LoraConfig(rank=4)
+    adapters = init_pipeline_lora_params(jax.random.key(1), stacked_base,
+                                         lora)
+    adapted = apply_pipeline_lora(stacked_base, adapters, lora)
+    for a, b in zip(jax.tree.leaves(stacked_base),
+                    jax.tree.leaves(adapted)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adapters_cover_every_stacked_matmul(stacked_base):
+    lora = LoraConfig(rank=4)
+    adapters = init_pipeline_lora_params(jax.random.key(1), stacked_base,
+                                         lora)
+    # the split projections adapt individually (wqkv -> wq/wk/wv)
+    assert sorted(adapters["stages"]) == sorted(
+        ["wq", "wk", "wv", "wo", "w_up", "w_down"]
+    )
+    for name, ab in adapters["stages"].items():
+        w = stacked_base["stages"][name]
+        assert ab["a"].shape == (w.shape[0], w.shape[1], 4)
+        assert ab["b"].shape == (w.shape[0], 4, w.shape[2])
+
+
+def test_merged_unstacked_equals_adapted_pipeline_forward(stacked_base):
+    # nonzero adapters: the pipelined adapted forward and the FLAT dense
+    # forward of the merged-unstacked weights (the checkpoint/serving
+    # layout) must be the same model
+    lora = LoraConfig(rank=4)
+    adapters = init_pipeline_lora_params(jax.random.key(1), stacked_base,
+                                         lora)
+    adapters = jax.tree.map(
+        lambda x: x + 0.05 * jnp.ones_like(x), adapters
+    )
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    bm = mesh.shape["data"]
+    tokens = microtokens(bm=bm)
+    pcfg = PipelineConfig(n_microbatches=4)
+
+    piped = jax.jit(
+        lambda ad, t: pipeline_forward(
+            apply_pipeline_lora(stacked_base, ad, lora), t, TINY, pcfg, mesh
+        )
+    )(adapters, jax.device_put(tokens, pipeline_batch_sharding(mesh)))
+
+    state = {"adapters": adapters, "opt_state": None,
+             "step": jnp.zeros((), jnp.int32)}
+    flat = lora_pipeline_checkpoint_state(stacked_base, state, lora)["params"]
+    dense = forward(flat, tokens.reshape(4 * bm, 16), TINY)
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        np.asarray(piped).reshape(4 * bm, 16, TINY.vocab_size),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_training_moves_loss_and_only_adapters(stacked_base):
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    lora = LoraConfig(rank=4)
+    train_config = TrainConfig(learning_rate=3e-2)
+    frozen = jax.device_put(
+        stacked_base, pipeline_param_shardings(mesh, stacked_base)
+    )
+    state = init_pipeline_lora_train_state(
+        jax.random.key(1), frozen, lora, train_config
+    )
+    pcfg = PipelineConfig(n_microbatches=4)
+    step_fn = make_lora_pipeline_train_step(
+        mesh, TINY, pcfg, train_config, frozen, state, lora
+    )
+    tokens = jax.device_put(
+        microtokens(bm=mesh.shape["data"]), pipeline_batch_sharding(mesh)
+    )
+    # step 0's loss is the frozen model's loss (B = 0 start)
+    base_loss = float(pipeline_loss_fn(stacked_base, microtokens(
+        bm=mesh.shape["data"]), TINY, pcfg, mesh))
+    adapters0 = jax.tree.map(np.asarray, state["adapters"])
+    losses = []
+    for _ in range(8):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(loss))
+    assert losses[0] == pytest.approx(base_loss, abs=1e-5)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    changed = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - b).max()),
+        state["adapters"], adapters0,
+    ))
+    assert max(changed) > 0  # adapters moved; the base cannot (closed over)
+
+
+def test_grad_accum_matches_single_pass(stacked_base):
+    # same invariant the flat LoRA pins: accumulated adapter steps ==
+    # whole-batch steps (fp32 end to end, loss compared after one step)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    lora = LoraConfig(rank=4)
+    frozen = jax.device_put(
+        stacked_base, pipeline_param_shardings(mesh, stacked_base)
+    )
+    # bm=8: each accum chunk of 4 rows still fills the data axis (4)
+    pcfg = PipelineConfig(n_microbatches=2)
+    tokens = jax.device_put(
+        microtokens(m=2, bm=8), pipeline_batch_sharding(mesh)
+    )
+    losses = {}
+    for accum in (1, 2):
+        train_config = TrainConfig(learning_rate=1e-2, grad_accum=accum)
+        state = init_pipeline_lora_train_state(
+            jax.random.key(1), frozen, lora, train_config
+        )
+        step_fn = make_lora_pipeline_train_step(
+            mesh, TINY, pcfg, train_config, frozen, state, lora
+        )
+        state, loss = step_fn(state, tokens)
+        _, loss2 = step_fn(state, tokens)
+        losses[accum] = (float(loss), float(loss2))
+    assert losses[1][0] == pytest.approx(losses[2][0], rel=1e-5)
+    assert losses[1][1] == pytest.approx(losses[2][1], rel=1e-3)
+
+
+def test_gpipe_only(stacked_base):
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2)
+    lora = LoraConfig(rank=4)
+    train_config = TrainConfig()
+    state = init_pipeline_lora_train_state(
+        jax.random.key(1), stacked_base, lora, train_config
+    )
+    with pytest.raises(ValueError, match="gpipe"):
+        make_lora_pipeline_train_step(
+            mesh, TINY, PipelineConfig(n_microbatches=4, schedule="1f1b"),
+            train_config, stacked_base, state, lora,
+        )
+
+
+TRAINER_FLAGS = [
+    "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+    "--n-layers", "4", "--d-ff", "128", "--seq-len", "32",
+    "--batch-size", "8", "--learning-rate", "1e-2", "--log-every", "1",
+    "--lora-rank", "4", "--pipe-parallel", "2", "--pipe-microbatches", "2",
+]
+
+
+def test_trainer_resume_equals_uninterrupted(tmp_path):
+    # the LoRA lifecycle invariant, through the pipeline: interrupt and
+    # resume replays exactly (stacked adapters + step from the
+    # checkpoint, the frozen stage stacks rebuilt from the same seed)
+    from kube_sqs_autoscaler_tpu.workloads.checkpoint import (
+        TrainCheckpointer,
+        load_model_layout,
+        load_model_manifest,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    full_dir = str(tmp_path / "full")
+    split_dir = str(tmp_path / "split")
+    full = main(TRAINER_FLAGS + ["--steps", "6",
+                                 "--checkpoint-dir", full_dir])
+    main(TRAINER_FLAGS + ["--steps", "4", "--checkpoint-dir", split_dir,
+                          "--checkpoint-every", "2"])
+    resumed = main(TRAINER_FLAGS + ["--steps", "2", "--checkpoint-dir",
+                                    split_dir, "--resume"])
+    assert resumed["final_step"] == 6
+    np.testing.assert_allclose(
+        resumed["losses"], full["losses"][4:], rtol=1e-6
+    )
+    assert load_model_layout(full_dir) == {
+        "kind": "lora", "rank": 4, "seed": 0, "base": "",
+        "pipeline_stages": 2,
+    }
+    # merged weights on disk are FLAT (kind "lora", not "pipeline"):
+    # the serving restore reads them with no unstacking step
+    mesh = make_mesh(jax.devices()[:1], model_parallel=1)
+    family, config = load_model_manifest(full_dir)
+    a = TrainCheckpointer(full_dir).restore_params(
+        mesh, family, config, layout=load_model_layout(full_dir))
+    b = TrainCheckpointer(split_dir).restore_params(
+        mesh, family, config, layout=load_model_layout(split_dir))
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+def test_trainer_llama_pipeline_lora_learns_and_evals(caplog):
+    # the modern family end to end: --family llama --pipe-parallel
+    # --lora-rank (+ grad-accum + eval) through the trainer binary
+    import logging
+
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    with caplog.at_level(logging.INFO):
+        result = main([
+            "--family", "llama", "--vocab-size", "256", "--d-model", "64",
+            "--n-heads", "4", "--n-kv-heads", "2", "--n-layers", "4",
+            "--d-ff", "128", "--seq-len", "32", "--batch-size", "16",
+            "--learning-rate", "1e-2", "--log-every", "1",
+            "--lora-rank", "4", "--pipe-parallel", "2",
+            "--pipe-microbatches", "2", "--grad-accum", "2",
+            "--steps", "4", "--overfit",
+            "--eval-every", "4", "--eval-batches", "2",
+        ])
+    assert result["final_step"] == 4
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert any("eval_loss" in r.getMessage() for r in caplog.records)
+
+
+def test_trainer_1f1b_fails_fast():
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    with pytest.raises(SystemExit, match="gpipe"):
+        main(TRAINER_FLAGS + ["--steps", "1", "--pipe-schedule", "1f1b"])

@@ -394,6 +394,52 @@ def bench_kv_cache(num_tokens: int = 64) -> dict:
     }
 
 
+def bench_weight_int8(num_tokens: int = 64) -> dict:
+    """Greedy decode tokens/s: bf16 weights vs int8-quantized weights
+    (``quantize_params``).  Decode is a chain of GEMVs that stream every
+    weight once per token, so if XLA really fuses the ``int8 -> bf16 *
+    scale`` dequant into the matmul operand load (the scheme's premise,
+    ``quantize.py`` module docstring), halving the weight bytes should
+    show up directly as decode throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate_jit
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.quantize import quantize_params
+
+    config = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=512,
+    )
+    params = init_params(jax.random.key(0), config)
+    qparams = quantize_params(params, family="gpt")
+    # short prompt: keeps the KV cache small so the weight stream (fixed
+    # per token) dominates the bytes, isolating the weight-int8 effect
+    prompt = jax.random.randint(jax.random.key(2), (4, 32), 0,
+                                config.vocab_size, jnp.int32)
+
+    def plain():
+        return generate_jit(params, prompt, num_tokens, config)
+
+    def quantized():
+        return generate_jit(qparams, prompt, num_tokens, config)
+
+    plain_s = _time_compiled(plain, iters=3)
+    quant_s = _time_compiled(quantized, iters=3)
+    toks = prompt.shape[0] * num_tokens
+    return {
+        "bf16_tokens_per_sec": toks / plain_s,
+        "int8_tokens_per_sec": toks / quant_s,
+        "speedup": plain_s / quant_s,
+        "num_tokens": num_tokens,
+        "prompt_len": int(prompt.shape[1]),
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser(prog="workbench")
     parser.add_argument("--steps", type=int, default=20)
@@ -403,64 +449,135 @@ def main(argv=None) -> dict:
         "--skip-llama", action="store_true",
         help="GPT family + attention micro-bench only",
     )
+    parser.add_argument(
+        "--only", nargs="*", default=None, metavar="ENTRY",
+        help="run only these result entries (e.g. train attention_s2048 "
+        "weight_int8) and MERGE them into an existing --out file instead "
+        "of replacing it — for re-measuring one entry without the full "
+        "suite",
+    )
     args = parser.parse_args(argv)
     _honor_env_platforms()
 
     import jax
 
+    known_entries = (
+        ["train", "llama_train"]
+        + [f"attention_s{s}" for s in ATTN_SEQ_LENS]
+        + [f"ring_local_s{s}" for s in (4096, 8192)]
+        + ["window_s8192", "speculative", "kv_cache_int8", "weight_int8"]
+    )
+    if args.only is not None:
+        unknown = sorted(set(args.only) - set(known_entries))
+        if unknown:
+            parser.error(
+                f"unknown --only entries {unknown}; choose from "
+                f"{known_entries}"
+            )
+
+    def want(name: str) -> bool:
+        return args.only is None or name in args.only
+
     device = jax.devices()[0]
-    results = {
+    run_meta = {
         "device": str(device),
         "device_kind": getattr(device, "device_kind", "unknown"),
         "backend": jax.default_backend(),
-        "train": bench_train_step("gpt", args.steps),
     }
-    if not args.skip_llama:
-        results["llama_train"] = bench_train_step("llama", args.steps)
+    results = dict(run_meta)
+    if args.only is not None:
+        # merge mode: keep the loaded file's entries AND top-level device
+        # labels (they describe the full run); each re-run entry is
+        # stamped with its own device/backend below, so a partial re-run
+        # on a different host cannot masquerade as the original's
+        try:
+            with open(args.out) as fh:
+                results = {**results, **json.load(fh)}
+        except (OSError, ValueError):
+            results = dict(run_meta)
+    ran = set()
+
+    def record(name, entry):
+        results[name] = entry
+        ran.add(name)
+
+    if want("train"):
+        record("train", bench_train_step("gpt", args.steps))
+    if not args.skip_llama and want("llama_train"):
+        record("llama_train", bench_train_step("llama", args.steps))
     for seq in ATTN_SEQ_LENS:
-        results[f"attention_s{seq}"] = bench_attention(seq, args.attn_iters)
+        if want(f"attention_s{seq}"):
+            record(f"attention_s{seq}",
+                   bench_attention(seq, args.attn_iters))
     # the ring/zig-zag per-hop local op: kernel vs einsum body at the
     # local lengths a long-context sp run actually sees
     for seq in (4096, 8192):
-        results[f"ring_local_s{seq}"] = bench_ring_local(seq, args.attn_iters)
-    results["window_s8192"] = bench_window(8192, 1024, args.attn_iters)
-    results["speculative"] = bench_speculative()
-    results["kv_cache_int8"] = bench_kv_cache()
+        if want(f"ring_local_s{seq}"):
+            record(f"ring_local_s{seq}",
+                   bench_ring_local(seq, args.attn_iters))
+    if want("window_s8192"):
+        record("window_s8192", bench_window(8192, 1024, args.attn_iters))
+    if want("speculative"):
+        record("speculative", bench_speculative())
+    if want("kv_cache_int8"):
+        record("kv_cache_int8", bench_kv_cache())
+    if want("weight_int8"):
+        record("weight_int8", bench_weight_int8())
+    if args.only is not None:
+        for name in ran:
+            results[name] = {**results[name], **run_meta}
 
-    metrics = [
-        ("train_tokens_per_sec", results["train"]["tokens_per_sec"],
-         "tokens/s"),
-        ("train_mfu", results["train"]["mfu"], "fraction"),
-    ]
-    if "llama_train" in results:
+    # metric lines cover what THIS invocation measured (under --only,
+    # merged-in stale entries — and requested-but-gated ones like
+    # llama_train with --skip-llama — stay in the file but are not
+    # re-printed as fresh measurements)
+    report = {k: v for k, v in results.items()
+              if k in ran or args.only is None}
+    metrics = []
+    if "train" in report:
+        metrics += [
+            ("train_tokens_per_sec", report["train"]["tokens_per_sec"],
+             "tokens/s"),
+            ("train_mfu", report["train"]["mfu"], "fraction"),
+        ]
+    if "llama_train" in report:
         metrics += [
             ("llama_train_tokens_per_sec",
-             results["llama_train"]["tokens_per_sec"], "tokens/s"),
-            ("llama_train_mfu", results["llama_train"]["mfu"], "fraction"),
+             report["llama_train"]["tokens_per_sec"], "tokens/s"),
+            ("llama_train_mfu", report["llama_train"]["mfu"], "fraction"),
         ]
     for seq in ATTN_SEQ_LENS:
-        att = results[f"attention_s{seq}"]
-        metrics += [
-            (f"flash_fwdbwd_ms_s{seq}", att["flash_fwdbwd_ms"], "ms"),
-            (f"dense_fwdbwd_ms_s{seq}", att["dense_fwdbwd_ms"], "ms"),
-            (f"flash_speedup_s{seq}", att["speedup"], "x"),
-            (f"attn_hot_path_speedup_s{seq}", att["hot_path_speedup"], "x"),
-        ]
+        att = report.get(f"attention_s{seq}")
+        if att:
+            metrics += [
+                (f"flash_fwdbwd_ms_s{seq}", att["flash_fwdbwd_ms"], "ms"),
+                (f"dense_fwdbwd_ms_s{seq}", att["dense_fwdbwd_ms"], "ms"),
+                (f"flash_speedup_s{seq}", att["speedup"], "x"),
+                (f"attn_hot_path_speedup_s{seq}", att["hot_path_speedup"],
+                 "x"),
+            ]
     for seq in (4096, 8192):
-        ring = results[f"ring_local_s{seq}"]
-        metrics.append(
-            (f"ring_kernel_speedup_s{seq}", ring["speedup"], "x")
-        )
-    metrics += [
-        ("window_attention_speedup_s8192",
-         results["window_s8192"]["speedup"], "x"),
-        ("decode_tokens_per_sec",
-         results["speculative"]["plain_tokens_per_sec"], "tokens/s"),
-        ("speculative_decode_speedup",
-         results["speculative"]["speedup"], "x"),
-        ("kv_cache_int8_decode_speedup",
-         results["kv_cache_int8"]["speedup"], "x"),
-    ]
+        ring = report.get(f"ring_local_s{seq}")
+        if ring:
+            metrics.append(
+                (f"ring_kernel_speedup_s{seq}", ring["speedup"], "x")
+            )
+    if "window_s8192" in report:
+        metrics.append(("window_attention_speedup_s8192",
+                        report["window_s8192"]["speedup"], "x"))
+    if "speculative" in report:
+        metrics += [
+            ("decode_tokens_per_sec",
+             report["speculative"]["plain_tokens_per_sec"], "tokens/s"),
+            ("speculative_decode_speedup",
+             report["speculative"]["speedup"], "x"),
+        ]
+    if "kv_cache_int8" in report:
+        metrics.append(("kv_cache_int8_decode_speedup",
+                        report["kv_cache_int8"]["speedup"], "x"))
+    if "weight_int8" in report:
+        metrics.append(("weight_int8_decode_speedup",
+                        report["weight_int8"]["speedup"], "x"))
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
