@@ -1027,36 +1027,91 @@ def make_moe_pipeline_train_step(
     )
 
 
-def _gpt_head_loss(head, y, targets):
-    """Last-stage readout objective of the gpt family: final LayerNorm +
-    tied-embedding logits + mean next-token NLL (the 1F1B body's default
-    ``head_loss`` seam)."""
-    from .train import next_token_nll
-
+def _gpt_head_logits(head, y):
+    """Last-stage readout of the gpt family: final LayerNorm +
+    tied-embedding logits."""
     y = _layer_norm(y, head["final_ln_scale"], head["final_ln_bias"])
-    logits = jnp.einsum(
+    return jnp.einsum(
         "bsd,vd->bsv", y, head["embed"], preferred_element_type=jnp.float32
     )
-    return next_token_nll(logits, targets)
 
 
-def _llama_head_loss(rms_eps: float):
-    """The llama-family ``head_loss`` seam: final RMSNorm + readout
-    (tied embed or untied ``lm_head``, already selected into
-    ``head["readout"]``) + mean next-token NLL."""
+def _gpt_head_loss(head, y, targets):
+    """Mean next-token NLL through :func:`_gpt_head_logits` (the 1F1B
+    body's default ``head_loss`` seam)."""
+    from .train import next_token_nll
 
-    def head_loss(head, y, targets):
+    return next_token_nll(_gpt_head_logits(head, y), targets)
+
+
+def _llama_head_logits(rms_eps: float):
+    """The llama-family readout: final RMSNorm + (tied embed or untied
+    ``lm_head``, already selected into ``head["readout"]``) logits."""
+
+    def head_logits(head, y):
         from .llama import _rms_norm
-        from .train import next_token_nll
 
         y = _rms_norm(y, head["final_norm"], rms_eps)
-        logits = jnp.einsum(
+        return jnp.einsum(
             "bsd,vd->bsv", y, head["readout"],
             preferred_element_type=jnp.float32,
         )
-        return next_token_nll(logits, targets)
+
+    return head_logits
+
+
+def _llama_head_loss(rms_eps: float):
+    """The llama-family ``head_loss`` seam: :func:`_llama_head_logits`
+    + mean next-token NLL."""
+    head_logits = _llama_head_logits(rms_eps)
+
+    def head_loss(head, y, targets):
+        from .train import next_token_nll
+
+        return next_token_nll(head_logits(head, y), targets)
 
     return head_loss
+
+
+def _sp_shift_targets(targets: jax.Array, seq_size: int) -> jax.Array:
+    """Next-token targets for sequence-sharded loss heads, inside the
+    fully-manual region.
+
+    Each ``"seq"`` shard holds local ``targets [..., S_loc]``; global
+    position ``i*S_loc + t`` predicts the token at ``i*S_loc + t + 1``,
+    so every local position's target is the NEXT local token — except
+    the shard's last position, whose target is the RIGHT neighbor's
+    first token (one ``ppermute``; the last shard receives zeros, masked
+    by :func:`_sp_masked_nll`).  This is the ONLY collective of the sp
+    loss head; it depends on the targets alone, so the 1F1B body hoists
+    it outside the slot scan — the per-slot head computation stays
+    collective-free and can be gated to the last stage.
+    """
+    neighbor_first = jax.lax.ppermute(
+        targets[..., :1], "seq",
+        [(i, i - 1) for i in range(1, seq_size)],
+    )
+    return jnp.concatenate([targets[..., 1:], neighbor_first], axis=-1)
+
+
+def _sp_masked_nll(logits: jax.Array, next_t: jax.Array,
+                   seq_size: int) -> jax.Array:
+    """Summed NLL of pre-shifted targets (:func:`_sp_shift_targets`)
+    over one shard's local positions, divided by the GLOBAL count
+    ``B * (S_global - 1)`` — psum over ``"seq"`` (the 1F1B epilogue's)
+    reassembles exactly the unsharded next-token mean.  The global last
+    position has no target and is masked out.  Collective-free (the
+    ``axis_index`` is a constant per shard)."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    token_nll = -jnp.take_along_axis(
+        log_probs, next_t[..., None], axis=-1
+    )[..., 0]
+    s_loc = next_t.shape[-1]
+    idx = jax.lax.axis_index("seq")
+    valid = jnp.ones((1, s_loc), token_nll.dtype)
+    valid = valid.at[:, -1].set(jnp.where(idx == seq_size - 1, 0.0, 1.0))
+    total = next_t.shape[0] * (seq_size * s_loc - 1)
+    return jnp.sum(token_nll * valid) / total
 
 
 def _one_f_one_b_body(
@@ -1072,9 +1127,11 @@ def _one_f_one_b_body(
     data_size: int,
     remat: bool,
     tp_size: int,
+    seq_size: int = 1,
     attention_fn=None,
     stage_apply=None,
     head_loss=None,
+    head_logits=None,
 ):
     """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
     every mesh axis — see the module docstring for why partial-manual is
@@ -1119,6 +1176,114 @@ def _one_f_one_b_body(
     def stage_fwd_remat(layers, x):
         return stage_apply(layers, x, config, remat=remat, tp_size=tp_size,
                            attention_fn=attention_fn)
+
+    if seq_size > 1:
+        # the sp loss head's ONLY collective: next-token targets shifted
+        # across "seq" shards — depends on the tokens alone, so it runs
+        # ONCE here instead of inside every slot (keeping the per-slot
+        # head computation collective-free and gateable to the last
+        # stage)
+        next_targets_micro = _sp_shift_targets(tokens_micro, seq_size)
+
+    def uniform_slot(carry, tables):
+        """The sp variant of ``slot``: ring attention puts collectives
+        over ``"seq"`` INSIDE the stage compute, and this backend's
+        collective rendezvous spans every device of the computation — a
+        device skipping a ppermute (via ``lax.cond`` on a stage-varying
+        predicate) deadlocks the rest.  So under sp every stage executes
+        the SAME stage forward/vjp every slot, and validity gates the
+        *accumulation*, not the execution (the same compute-always
+        masking the GPipe body uses for its warmup/drain slots).  The
+        loss head IS still gated to the last stage — its collective (the
+        targets shift) was hoisted out of the scan, so the per-slot head
+        vjp is collective-free and safe inside ``lax.cond``."""
+        (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+         loss_acc) = carry
+        fwd_row, bwd_row = tables  # [P] each
+        fwd_m = fwd_row[stage]
+        bwd_m = bwd_row[stage]
+
+        # ---- forward slot (compute-always) --------------------------
+        m_f = jnp.clip(fwd_m, 0, n_micro - 1)
+        inp = jnp.where(
+            stage == 0,
+            jax.lax.dynamic_index_in_dim(x_micro, m_f, 0, keepdims=False),
+            act_in,
+        )
+        saved_new = jax.lax.dynamic_update_index_in_dim(
+            saved, inp, m_f % window, 0
+        )
+        saved = jnp.where(fwd_m >= 0, saved_new, saved)
+        act_out = stage_fwd(stage_layers, inp)
+
+        # ---- backward slot (stage vjp compute-always) ---------------
+        m_b = jnp.clip(bwd_m, 0, n_micro - 1)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            saved, m_b % window, 0, keepdims=False
+        )
+        next_t = jax.lax.dynamic_index_in_dim(
+            next_targets_micro, m_b, 0, keepdims=False
+        )
+        # one stage vjp serves both the last stage (cotangent from the
+        # loss head) and mid stages (cotangent from the pipe mailbox):
+        # select WHICH cotangent flows, not which code runs
+        y, stage_vjp = jax.vjp(stage_fwd_remat, stage_layers, x_saved)
+
+        def do_head(y):
+            def head_obj(h, yy):
+                return _sp_masked_nll(head_logits(h, yy), next_t, seq_size)
+
+            loss_m, (dhead, dy) = jax.value_and_grad(
+                head_obj, argnums=(0, 1)
+            )(head, y)
+            return loss_m, dhead, dy
+
+        def skip_head(y):
+            return (
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, head),
+                jnp.zeros_like(y),
+            )
+
+        loss_m, dhead, dy_head = jax.lax.cond(
+            stage == last, do_head, skip_head, y
+        )
+        g_y = jnp.where(stage == last, dy_head.astype(grad_in.dtype),
+                        grad_in)
+        dstage, dx = stage_vjp(g_y)
+
+        bwd_valid = bwd_m >= 0
+        is_last = stage == last
+        dstage_acc = jax.tree.map(
+            lambda a, g: a + jnp.where(bwd_valid, g, 0).astype(jnp.float32),
+            dstage_acc, dstage,
+        )
+        dhead_acc = jax.tree.map(
+            lambda a, g: a + jnp.where(
+                bwd_valid & is_last, g, 0
+            ).astype(jnp.float32),
+            dhead_acc, dhead,
+        )
+        loss_acc = loss_acc + jnp.where(
+            bwd_valid & is_last, loss_m, 0.0
+        )
+        dx_masked = jnp.where(stage == 0, dx, jnp.zeros_like(dx))
+        dx_buf_new = jax.lax.dynamic_update_index_in_dim(
+            dx_buf, dx_masked, m_b, 0
+        )
+        dx_buf = jnp.where(bwd_valid, dx_buf_new, dx_buf)
+        grad_out = jnp.where(bwd_valid, dx, jnp.zeros_like(dx))
+
+        # ---- communication (every slot, validity-gated mailboxes) ----
+        act_arrived = jax.lax.ppermute(act_out, axis_name, fwd_ring)
+        grad_arrived = jax.lax.ppermute(
+            grad_out.astype(x_micro.dtype), axis_name, bwd_ring
+        )
+        act_in = jnp.where(fwd_row[pred] >= 0, act_arrived, act_in)
+        grad_in = jnp.where(bwd_row[succ] >= 0, grad_arrived, grad_in)
+
+        return (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+                loss_acc), None
 
     def slot(carry, tables):
         (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
@@ -1238,23 +1403,30 @@ def _one_f_one_b_body(
     )
     tables = (jnp.asarray(fwd_tbl), jnp.asarray(bwd_tbl))
     (_, _, _, dstage_acc, dhead_acc, dx_buf, loss_acc), _ = jax.lax.scan(
-        slot, carry0, tables
+        uniform_slot if seq_size > 1 else slot, carry0, tables
     )
 
     # epilogue: replicate the pieces only one stage holds, and average the
     # per-data-shard means into the global all-rows mean (1/dp).  No psum
     # over "model": activations/head stay replicated there, so each model
-    # shard already computed identical loss/dhead/dx values.
+    # shard already computed identical loss/dhead/dx values.  Under sp the
+    # per-"seq"-shard loss/head/stage contributions are partial SUMS
+    # (each already carries the global position-count normalization, see
+    # _sp_next_token_nll), so "seq" joins the psums with no extra divide;
+    # dx stays per-seq-shard (its out spec is sequence-sharded).
+    seq_axes = ("seq",) if seq_size > 1 else ()
     inv_dp = 1.0 / data_size
     loss = jax.lax.psum(
-        jnp.where(stage == last, loss_acc, 0.0), (axis_name, "data")
+        jnp.where(stage == last, loss_acc, 0.0),
+        (axis_name, "data", *seq_axes),
     ) * inv_dp
     dstages = jax.tree.map(
-        lambda g: jax.lax.psum(g, "data") * inv_dp, dstage_acc
+        lambda g: jax.lax.psum(g, ("data", *seq_axes)) * inv_dp, dstage_acc
     )
     dhead = jax.tree.map(
         lambda g: jax.lax.psum(
-            jnp.where(stage == last, g, jnp.zeros_like(g)), (axis_name, "data")
+            jnp.where(stage == last, g, jnp.zeros_like(g)),
+            (axis_name, "data", *seq_axes),
         ) * inv_dp,
         dhead_acc,
     )
@@ -1306,6 +1478,13 @@ def one_f_one_b_value_and_grad(
     }
 
     pipe = mesh.shape["pipe"]
+    sp = mesh.shape.get("seq", 1)
+    if sp > 1 and stage_attention is None:
+        # pp x sp x 1F1B: ring attention inside the stage fwd/bwd (its
+        # ppermutes differentiate through jax.vjp — the transpose of a
+        # rotation is the inverse rotation); the loss head goes
+        # sequence-sharded via head_logits + the sp masked NLL
+        stage_attention = _stage_ring_attention(mesh)
     stage_specs = stage_partition_specs(params["stages"], mesh)
     body = partial(
         _one_f_one_b_body,
@@ -1316,13 +1495,15 @@ def one_f_one_b_value_and_grad(
         data_size=mesh.shape["data"],
         remat=remat,
         tp_size=mesh.shape.get("model", 1),
+        seq_size=sp,
         attention_fn=stage_attention,
+        head_logits=_gpt_head_logits,
     )
     loss, dstages, dhead, dx_micro = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(stage_specs, P(), P(None, "data"), P(None, "data")),
-        out_specs=(P(), stage_specs, P(), P(None, "data")),
+        in_specs=(stage_specs, P(), _act_spec(mesh), _act_spec(mesh)),
+        out_specs=(P(), stage_specs, P(), _act_spec(mesh)),
         check_vma=False,
     )(params["stages"], head, x_micro, tokens)
 
@@ -1382,6 +1563,17 @@ def llama_one_f_one_b_value_and_grad(
         "final_norm": params["final_norm"],
     }
 
+    sp = mesh.shape.get("seq", 1)
+    stage_apply = _llama_stage_apply
+    if sp > 1:
+        # pp x sp x 1F1B, llama: GQA ring attention (window included)
+        # inside the stage fwd/bwd, global RoPE offsets per seq shard,
+        # sequence-sharded loss head via head_logits + the sp masked NLL
+        if stage_attention is None:
+            stage_attention = _stage_ring_attention(
+                mesh, window=config.sliding_window
+            )
+        stage_apply = partial(_llama_stage_apply, seq_axis="seq")
     stage_specs = stage_partition_specs(params["stages"], mesh)
     body = partial(
         _one_f_one_b_body,
@@ -1392,15 +1584,17 @@ def llama_one_f_one_b_value_and_grad(
         data_size=mesh.shape["data"],
         remat=remat,
         tp_size=mesh.shape.get("model", 1),
+        seq_size=sp,
         attention_fn=stage_attention,
-        stage_apply=_llama_stage_apply,
+        stage_apply=stage_apply,
         head_loss=_llama_head_loss(config.rms_eps),
+        head_logits=_llama_head_logits(config.rms_eps),
     )
     loss, dstages, dhead, dx_micro = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(stage_specs, P(), P(None, "data"), P(None, "data")),
-        out_specs=(P(), stage_specs, P(), P(None, "data")),
+        in_specs=(stage_specs, P(), _act_spec(mesh), _act_spec(mesh)),
+        out_specs=(P(), stage_specs, P(), _act_spec(mesh)),
         check_vma=False,
     )(params["stages"], head, x_micro, tokens)
 
@@ -1502,7 +1696,6 @@ def make_pipeline_train_step(
 
     remat = getattr(train_config, "remat", False)
     if pcfg.schedule == "1f1b":
-        _require_no_seq_axis(mesh)
         return make_train_step(
             mesh, config, train_config, state,
             value_and_grad_fn=partial(
@@ -1524,13 +1717,15 @@ def make_pipeline_train_step(
 
 
 def _require_no_seq_axis(mesh: Mesh) -> None:
-    """pp x sp is GPipe-only: the 1F1B hand-built backward (and the MoE
-    pipeline objective) keep their activations/loss head unsharded over
-    sequence; autodiff of the GPipe loss handles the ring's transposes."""
+    """The MoE pipeline objective keeps its activations/loss head (and
+    the aux term riding the stage scan) unsharded over sequence — it runs
+    on (pipe, data[, model]) meshes only.  The plain 1F1B schedule DOES
+    compose with sp (ring attention in the stage fwd/bwd, sequence-
+    sharded loss head via ``_sp_next_token_nll``)."""
     if mesh.shape.get("seq", 1) > 1:
         raise ValueError(
-            "this pipeline schedule/objective supports (pipe, data"
-            "[, model]) meshes only — pp x sp runs the gpipe schedule"
+            "this pipeline objective supports (pipe, data[, model]) "
+            "meshes only — moe x pp does not combine with seq_parallel"
         )
 
 
@@ -1566,7 +1761,6 @@ def make_llama_pipeline_train_step(
 
     remat = getattr(train_config, "remat", False)
     if pcfg.schedule == "1f1b":
-        _require_no_seq_axis(mesh)
         return make_train_step(
             mesh, config, train_config, state,
             value_and_grad_fn=partial(

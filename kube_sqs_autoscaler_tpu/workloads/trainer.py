@@ -231,12 +231,7 @@ def train(args) -> dict:
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
         if args.seq_parallel > 1:
-            # pp x sp: ring attention inside the GPipe stages
-            if args.pipe_schedule != "gpipe":
-                raise SystemExit(
-                    "--pipe-parallel with --seq-parallel supports "
-                    "--pipe-schedule gpipe only"
-                )
+            # pp x sp: ring attention inside the stages (both schedules)
             if args.model_parallel > 1:
                 raise SystemExit(
                     "--pipe-parallel takes --model-parallel OR "
