@@ -991,22 +991,19 @@ def make_moe_pipeline_train_step(
     state: dict,
     llama: bool = False,
 ):
-    """Compile one MoE × pipeline optimizer step (GPipe only — the 1F1B
-    hand-built backward does not thread the aux term; autodiff of the
-    GPipe loss handles it).  No tp (experts replicate per stage; the
-    Megatron seams don't carve expert stacks), no remat (the flat MoE
-    constraint).  Gradient accumulation composes (``accum_axis=1``).
+    """Compile one MoE × pipeline optimizer step, either schedule —
+    GPipe differentiates the lockstep forward; 1F1B uses the explicitly
+    scheduled backward with the Switch aux term riding each stage vjp
+    as a constant cotangent (:func:`moe_one_f_one_b_value_and_grad`).
+    No tp (experts replicate per stage; the Megatron seams don't carve
+    expert stacks), no remat (the flat MoE constraint).  Gradient
+    accumulation composes (``accum_axis=1``).
     """
     from .moe import _require_no_remat
     from .train import make_train_step
 
     _require_no_remat(train_config)
     _require_no_seq_axis(mesh)
-    if pcfg.schedule != "gpipe":
-        raise ValueError(
-            "MoE x pipeline supports the gpipe schedule only (the 1F1B "
-            "hand-built backward does not thread the aux term)"
-        )
     if mesh.shape.get("model", 1) > 1:
         raise ValueError(
             "MoE x pipeline does not compose with tensor parallelism "
@@ -1016,6 +1013,17 @@ def make_moe_pipeline_train_step(
         raise ValueError(
             "sliding_window does not compose with the pipelined MoE "
             "stack's full-causal stage kernels"
+        )
+    if pcfg.schedule == "1f1b":
+        return make_train_step(
+            mesh, config, train_config, state,
+            value_and_grad_fn=partial(
+                moe_one_f_one_b_value_and_grad,
+                config=config, moe=moe, pcfg=pcfg, mesh=mesh, llama=llama,
+            ),
+            state_shardings_fn=pipeline_state_shardings,
+            batch_sharding_fn=pipeline_batch_sharding,
+            accum_axis=1,
         )
     return make_train_step(
         mesh, config, train_config, state,
@@ -1132,6 +1140,8 @@ def _one_f_one_b_body(
     stage_apply=None,
     head_loss=None,
     head_logits=None,
+    moe_aux: bool = False,
+    aux_cot: float = 0.0,
 ):
     """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
     every mesh axis — see the module docstring for why partial-manual is
@@ -1176,6 +1186,17 @@ def _one_f_one_b_body(
     def stage_fwd_remat(layers, x):
         return stage_apply(layers, x, config, remat=remat, tp_size=tp_size,
                            attention_fn=attention_fn)
+
+    def communicate(act_out, grad_out, act_in, grad_in, fwd_row, bwd_row):
+        """Every slot's pipe hops with validity-gated mailboxes — the one
+        implementation all three slot variants end on."""
+        act_arrived = jax.lax.ppermute(act_out, axis_name, fwd_ring)
+        grad_arrived = jax.lax.ppermute(
+            grad_out.astype(x_micro.dtype), axis_name, bwd_ring
+        )
+        act_in = jnp.where(fwd_row[pred] >= 0, act_arrived, act_in)
+        grad_in = jnp.where(bwd_row[succ] >= 0, grad_arrived, grad_in)
+        return act_in, grad_in
 
     if seq_size > 1:
         # the sp loss head's ONLY collective: next-token targets shifted
@@ -1274,13 +1295,8 @@ def _one_f_one_b_body(
         dx_buf = jnp.where(bwd_valid, dx_buf_new, dx_buf)
         grad_out = jnp.where(bwd_valid, dx, jnp.zeros_like(dx))
 
-        # ---- communication (every slot, validity-gated mailboxes) ----
-        act_arrived = jax.lax.ppermute(act_out, axis_name, fwd_ring)
-        grad_arrived = jax.lax.ppermute(
-            grad_out.astype(x_micro.dtype), axis_name, bwd_ring
-        )
-        act_in = jnp.where(fwd_row[pred] >= 0, act_arrived, act_in)
-        grad_in = jnp.where(bwd_row[succ] >= 0, grad_arrived, grad_in)
+        act_in, grad_in = communicate(act_out, grad_out, act_in, grad_in,
+                                      fwd_row, bwd_row)
 
         return (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
                 loss_acc), None
@@ -1379,16 +1395,121 @@ def _one_f_one_b_body(
             (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc),
         )
 
-        # ---- communication (every slot, validity-gated mailboxes) ----
-        act_arrived = jax.lax.ppermute(act_out, axis_name, fwd_ring)
-        grad_arrived = jax.lax.ppermute(
-            grad_out.astype(x_micro.dtype), axis_name, bwd_ring
-        )
-        act_in = jnp.where(fwd_row[pred] >= 0, act_arrived, act_in)
-        grad_in = jnp.where(bwd_row[succ] >= 0, grad_arrived, grad_in)
+        act_in, grad_in = communicate(act_out, grad_out, act_in, grad_in,
+                                      fwd_row, bwd_row)
 
         return (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
                 loss_acc), None
+
+    def moe_slot(carry, tables):
+        """The MoE variant of ``slot``: ``stage_apply`` returns
+        ``(y, aux_sum)`` per microbatch.  The aux term joins gradients as
+        a CONSTANT cotangent on the stage vjp's aux output (``aux_cot``,
+        pre-scaled by the caller so the epilogue/caller 1/M·1/dp scaling
+        lands it at ``weight/(n_layers·M)`` — exactly autodiff of the
+        GPipe objective), and joins the LOSS via a separate accumulator
+        so every stage's aux counts, not just the last's.  Routing is
+        shard-local (experts replicate per stage), so the stage compute
+        has no collectives and the validity ``lax.cond`` s stay safe."""
+        (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+         loss_acc, aux_acc) = carry
+        fwd_row, bwd_row = tables
+        fwd_m = fwd_row[stage]
+        bwd_m = bwd_row[stage]
+
+        # ---- forward slot -------------------------------------------
+        def do_fwd(args):
+            act_in, saved = args
+            m = jnp.clip(fwd_m, 0, n_micro - 1)
+            inp = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(x_micro, m, 0, keepdims=False),
+                act_in,
+            )
+            saved = jax.lax.dynamic_update_index_in_dim(
+                saved, inp, m % window, 0
+            )
+            y = jax.lax.cond(
+                stage == last,
+                lambda layers, x: jnp.zeros(act_shape, x.dtype),
+                lambda layers, x: stage_fwd(layers, x)[0],
+                stage_layers, inp,
+            )
+            return y, saved
+
+        act_out, saved = jax.lax.cond(
+            fwd_m >= 0,
+            do_fwd,
+            lambda args: (jnp.zeros(act_shape, x_micro.dtype), args[1]),
+            (act_in, saved),
+        )
+
+        # ---- backward slot ------------------------------------------
+        def do_bwd(args):
+            grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc, aux_acc = args
+            m = jnp.clip(bwd_m, 0, n_micro - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                saved, m % window, 0, keepdims=False
+            )
+            targets = jax.lax.dynamic_index_in_dim(
+                tokens_micro, m, 0, keepdims=False
+            )
+            (y, aux), stage_vjp = jax.vjp(
+                stage_fwd_remat, stage_layers, x_saved
+            )
+
+            def last_head(y):
+                loss_m, (dhead, dy) = jax.value_and_grad(
+                    head_loss, argnums=(0, 1)
+                )(head, y, targets)
+                return loss_m, dhead, dy
+
+            def mid_head(y):
+                return (
+                    jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, head),
+                    jnp.zeros_like(y),
+                )
+
+            loss_m, dhead, dy_head = jax.lax.cond(
+                stage == last, last_head, mid_head, y
+            )
+            g_y = jnp.where(stage == last, dy_head.astype(grad_in.dtype),
+                            grad_in)
+            dstage, dx = stage_vjp(
+                (g_y, jnp.asarray(aux_cot, aux.dtype))
+            )
+            dstage_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), dstage_acc, dstage
+            )
+            dhead_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), dhead_acc, dhead
+            )
+            dx_masked = jnp.where(stage == 0, dx, jnp.zeros_like(dx))
+            dx_buf = jax.lax.dynamic_update_index_in_dim(
+                dx_buf, dx_masked, m, 0
+            )
+            return (grad_in, dstage_acc, dhead_acc, dx_buf,
+                    loss_acc + loss_m, aux_acc + aux, dx)
+
+        def skip_bwd(args):
+            grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc, aux_acc = args
+            return (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc,
+                    aux_acc, jnp.zeros(act_shape, x_micro.dtype))
+
+        (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc, aux_acc,
+         grad_out) = jax.lax.cond(
+            bwd_m >= 0,
+            do_bwd,
+            skip_bwd,
+            (grad_in, dstage_acc, dhead_acc, dx_buf, loss_acc, aux_acc),
+        )
+
+        act_in, grad_in = communicate(act_out, grad_out, act_in, grad_in,
+                                      fwd_row, bwd_row)
+
+        return (act_in, grad_in, saved, dstage_acc, dhead_acc, dx_buf,
+                loss_acc, aux_acc), None
 
     carry0 = (
         jnp.zeros(act_shape, x_micro.dtype),  # act mailbox
@@ -1402,9 +1523,14 @@ def _one_f_one_b_body(
         jnp.zeros((), jnp.float32),
     )
     tables = (jnp.asarray(fwd_tbl), jnp.asarray(bwd_tbl))
-    (_, _, _, dstage_acc, dhead_acc, dx_buf, loss_acc), _ = jax.lax.scan(
-        uniform_slot if seq_size > 1 else slot, carry0, tables
-    )
+    if moe_aux:
+        carry0 = carry0 + (jnp.zeros((), jnp.float32),)
+        (_, _, _, dstage_acc, dhead_acc, dx_buf, loss_acc,
+         aux_acc), _ = jax.lax.scan(moe_slot, carry0, tables)
+    else:
+        (_, _, _, dstage_acc, dhead_acc, dx_buf, loss_acc), _ = jax.lax.scan(
+            uniform_slot if seq_size > 1 else slot, carry0, tables
+        )
 
     # epilogue: replicate the pieces only one stage holds, and average the
     # per-data-shard means into the global all-rows mean (1/dp).  No psum
@@ -1412,7 +1538,7 @@ def _one_f_one_b_body(
     # shard already computed identical loss/dhead/dx values.  Under sp the
     # per-"seq"-shard loss/head/stage contributions are partial SUMS
     # (each already carries the global position-count normalization, see
-    # _sp_next_token_nll), so "seq" joins the psums with no extra divide;
+    # _sp_masked_nll), so "seq" joins the psums with no extra divide;
     # dx stays per-seq-shard (its out spec is sequence-sharded).
     seq_axes = ("seq",) if seq_size > 1 else ()
     inv_dp = 1.0 / data_size
@@ -1433,33 +1559,23 @@ def _one_f_one_b_body(
     dx_micro = jax.lax.psum(
         jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
     ) * inv_dp
+    if moe_aux:
+        # every stage contributes aux (unlike loss/dhead, which only the
+        # last stage holds): psum over pipe SUMS the per-stage terms,
+        # matching _pipeline_body's gpipe aux_total
+        aux_total = jax.lax.psum(aux_acc, (axis_name, "data")) * inv_dp
+        return loss, dstages, dhead, dx_micro, aux_total
     return loss, dstages, dhead, dx_micro
 
 
-def one_f_one_b_value_and_grad(
-    params: dict,
-    tokens: jax.Array,
-    config: ModelConfig,
-    pcfg: "PipelineConfig",
-    mesh: Mesh,
-    remat: bool = False,
-    stage_attention=None,
-):
-    """``(loss, grads)`` for the pipelined LM via the 1F1B schedule.
-
-    Gradient-equal to ``jax.value_and_grad(pipeline_loss_fn)`` (same math,
-    different schedule/memory profile — asserted by
-    ``tests/test_pipeline.py::test_1f1b_grads_match_gpipe_autodiff``); the
-    embedding lookup runs outside the pipelined region with its vjp fed by
-    stage 0's input cotangents, while the tied-embedding unembed
-    contribution comes from the last stage — the two are summed here.
-    """
-    n_micro, _, seq = tokens.shape
-    if n_micro != pcfg.n_microbatches:
-        raise ValueError(
-            f"tokens have {n_micro} microbatches, config says "
-            f"{pcfg.n_microbatches}"
-        )
+def _gpt_embed_head(params: dict, tokens: jax.Array):
+    """The gpt family's outside-the-pipeline pieces for a 1F1B backward:
+    embedded microbatches (with the embed vjp), the loss-head leaves,
+    and the grads assembler that folds the body's raw sums into the
+    final gradient pytree (embedding lookup cotangents from stage 0,
+    tied-embedding unembed contribution from the last stage — summed).
+    One implementation for the dense AND MoE 1F1B callers."""
+    seq = tokens.shape[-1]
 
     def embed_fn(embed_params):
         return (
@@ -1476,6 +1592,99 @@ def one_f_one_b_value_and_grad(
         "final_ln_scale": params["final_ln_scale"],
         "final_ln_bias": params["final_ln_bias"],
     }
+
+    def assemble_grads(dstages, dhead, dx_micro, inv_m):
+        (d_embed_side,) = embed_vjp(dx_micro * inv_m)
+        dtype_of = lambda name: params[name].dtype  # noqa: E731
+        return {
+            "stages": jax.tree.map(
+                lambda g, p: (g * inv_m).astype(p.dtype),
+                dstages, params["stages"],
+            ),
+            "embed": (
+                dhead["embed"] * inv_m
+                + d_embed_side["embed"].astype(jnp.float32)
+            ).astype(dtype_of("embed")),
+            "pos_embed": d_embed_side["pos_embed"].astype(
+                dtype_of("pos_embed")
+            ),
+            "final_ln_scale": (dhead["final_ln_scale"] * inv_m).astype(
+                dtype_of("final_ln_scale")
+            ),
+            "final_ln_bias": (dhead["final_ln_bias"] * inv_m).astype(
+                dtype_of("final_ln_bias")
+            ),
+        }
+
+    return x_micro, head, assemble_grads
+
+
+def _llama_embed_head(params: dict, tokens: jax.Array):
+    """The llama counterpart of :func:`_gpt_embed_head`: lookup-only
+    embedding (RoPE lives inside the stages), RMSNorm + readout head
+    leaves, and the grads assembler — with a tied readout the embed
+    cotangent sums with the last stage's, an untied ``lm_head`` (HF
+    imports) gets its own gradient entry."""
+    tied = "lm_head" not in params
+
+    def embed_fn(embed_table):
+        return embed_table[tokens]
+
+    x_micro, embed_vjp = jax.vjp(embed_fn, params["embed"])
+    head = {
+        "readout": params["embed"] if tied else params["lm_head"],
+        "final_norm": params["final_norm"],
+    }
+
+    def assemble_grads(dstages, dhead, dx_micro, inv_m):
+        (d_embed_side,) = embed_vjp(dx_micro * inv_m)
+        grads = {
+            "stages": jax.tree.map(
+                lambda g, p: (g * inv_m).astype(p.dtype),
+                dstages, params["stages"],
+            ),
+            "final_norm": (dhead["final_norm"] * inv_m).astype(
+                params["final_norm"].dtype
+            ),
+        }
+        if tied:
+            grads["embed"] = (
+                dhead["readout"] * inv_m
+                + d_embed_side.astype(jnp.float32)
+            ).astype(params["embed"].dtype)
+        else:
+            grads["embed"] = d_embed_side.astype(params["embed"].dtype)
+            grads["lm_head"] = (dhead["readout"] * inv_m).astype(
+                params["lm_head"].dtype
+            )
+        return grads
+
+    return x_micro, head, assemble_grads
+
+
+def one_f_one_b_value_and_grad(
+    params: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    pcfg: "PipelineConfig",
+    mesh: Mesh,
+    remat: bool = False,
+    stage_attention=None,
+):
+    """``(loss, grads)`` for the pipelined LM via the 1F1B schedule.
+
+    Gradient-equal to ``jax.value_and_grad(pipeline_loss_fn)`` (same math,
+    different schedule/memory profile — asserted by
+    ``tests/test_pipeline.py::test_1f1b_grads_match_gpipe_autodiff``); the
+    embedding/head handling is :func:`_gpt_embed_head`.
+    """
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    x_micro, head, assemble_grads = _gpt_embed_head(params, tokens)
 
     pipe = mesh.shape["pipe"]
     sp = mesh.shape.get("seq", 1)
@@ -1508,25 +1717,7 @@ def one_f_one_b_value_and_grad(
     )(params["stages"], head, x_micro, tokens)
 
     inv_m = 1.0 / pcfg.n_microbatches
-    (d_embed_side,) = embed_vjp(dx_micro * inv_m)
-    dtype_of = lambda name: params[name].dtype  # noqa: E731
-    grads = {
-        "stages": jax.tree.map(
-            lambda g, p: (g * inv_m).astype(p.dtype),
-            dstages, params["stages"],
-        ),
-        "embed": (
-            dhead["embed"] * inv_m + d_embed_side["embed"].astype(jnp.float32)
-        ).astype(dtype_of("embed")),
-        "pos_embed": d_embed_side["pos_embed"].astype(dtype_of("pos_embed")),
-        "final_ln_scale": (dhead["final_ln_scale"] * inv_m).astype(
-            dtype_of("final_ln_scale")
-        ),
-        "final_ln_bias": (dhead["final_ln_bias"] * inv_m).astype(
-            dtype_of("final_ln_bias")
-        ),
-    }
-    return loss * inv_m, grads
+    return loss * inv_m, assemble_grads(dstages, dhead, dx_micro, inv_m)
 
 
 def llama_one_f_one_b_value_and_grad(
@@ -1542,26 +1733,15 @@ def llama_one_f_one_b_value_and_grad(
     — :func:`one_f_one_b_value_and_grad` with the family seams swapped in
     (:func:`_llama_stage_apply`, :func:`_llama_head_loss`).  Gradient-
     equal to autodiff of :func:`llama_pipeline_loss_fn` (asserted by
-    ``tests/test_pipeline_llama.py``).  The embedding lookup runs outside
-    the pipelined region; with a tied readout its cotangent sums with the
-    last stage's, while an untied ``lm_head`` (HF imports) gets its own
-    gradient entry."""
+    ``tests/test_pipeline_llama.py``).  The embedding/head handling is
+    :func:`_llama_embed_head`."""
     n_micro, _, seq = tokens.shape
     if n_micro != pcfg.n_microbatches:
         raise ValueError(
             f"tokens have {n_micro} microbatches, config says "
             f"{pcfg.n_microbatches}"
         )
-    tied = "lm_head" not in params
-
-    def embed_fn(embed_table):
-        return embed_table[tokens]
-
-    x_micro, embed_vjp = jax.vjp(embed_fn, params["embed"])
-    head = {
-        "readout": params["embed"] if tied else params["lm_head"],
-        "final_norm": params["final_norm"],
-    }
+    x_micro, head, assemble_grads = _llama_embed_head(params, tokens)
 
     sp = mesh.shape.get("seq", 1)
     stage_apply = _llama_stage_apply
@@ -1599,26 +1779,78 @@ def llama_one_f_one_b_value_and_grad(
     )(params["stages"], head, x_micro, tokens)
 
     inv_m = 1.0 / pcfg.n_microbatches
-    (d_embed_side,) = embed_vjp(dx_micro * inv_m)
-    grads = {
-        "stages": jax.tree.map(
-            lambda g, p: (g * inv_m).astype(p.dtype),
-            dstages, params["stages"],
-        ),
-        "final_norm": (dhead["final_norm"] * inv_m).astype(
-            params["final_norm"].dtype
-        ),
-    }
-    if tied:
-        grads["embed"] = (
-            dhead["readout"] * inv_m + d_embed_side.astype(jnp.float32)
-        ).astype(params["embed"].dtype)
-    else:
-        grads["embed"] = d_embed_side.astype(params["embed"].dtype)
-        grads["lm_head"] = (dhead["readout"] * inv_m).astype(
-            params["lm_head"].dtype
+    return loss * inv_m, assemble_grads(dstages, dhead, dx_micro, inv_m)
+
+
+def moe_one_f_one_b_value_and_grad(
+    params: dict,
+    tokens: jax.Array,
+    config,
+    moe,
+    pcfg: "PipelineConfig",
+    mesh: Mesh,
+    llama: bool = False,
+    remat: bool = False,  # accepted for seam parity; MoE rejects remat
+    stage_attention=None,
+):
+    """``(loss, grads)`` for the MoE pipelined LM via the 1F1B schedule
+    — gradient-equal to ``jax.value_and_grad(moe_pipeline_loss_fn)``
+    (asserted by ``tests/test_moe.py``).  The Switch aux term threads
+    through the hand-built backward as a constant cotangent on each
+    stage vjp's aux output (``weight / n_layers``, so the shared 1/M
+    scaling lands it at the GPipe objective's
+    ``weight · aux_total / (n_layers · M)``), and every stage's aux
+    value joins the reported loss via the body's separate accumulator.
+    Same mesh contract as the GPipe MoE objective: (pipe, data) only
+    (experts replicate per stage), no remat."""
+    from .moe import llama_moe_mlp, moe_mlp
+
+    _require_no_seq_axis(mesh)
+    n_micro, _, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
         )
-    return loss * inv_m, grads
+
+    if llama:
+        x_micro, head, assemble_grads = _llama_embed_head(params, tokens)
+        stage_apply = partial(_llama_stage_apply, moe=moe,
+                              expert_mlp=llama_moe_mlp)
+        head_loss = _llama_head_loss(config.rms_eps)
+    else:
+        x_micro, head, assemble_grads = _gpt_embed_head(params, tokens)
+        stage_apply = partial(_stage_apply, moe=moe, expert_mlp=moe_mlp)
+        head_loss = _gpt_head_loss
+
+    aux_cot = moe.aux_loss_weight / config.n_layers
+    stage_specs = stage_partition_specs(params["stages"], mesh)
+    body = partial(
+        _one_f_one_b_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=mesh.shape["pipe"],
+        data_size=mesh.shape["data"],
+        remat=False,  # MoE rejects remat (aux closure vs re-tracing)
+        tp_size=1,
+        attention_fn=stage_attention,
+        stage_apply=stage_apply,
+        head_loss=head_loss,
+        moe_aux=True,
+        aux_cot=aux_cot,
+    )
+    loss, dstages, dhead, dx_micro, aux_total = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_specs, P(), P(None, "data"), P(None, "data")),
+        out_specs=(P(), stage_specs, P(), P(None, "data"), P()),
+        check_vma=False,
+    )(params["stages"], head, x_micro, tokens)
+
+    inv_m = 1.0 / pcfg.n_microbatches
+    total_loss = (loss + aux_cot * aux_total) * inv_m
+    return total_loss, assemble_grads(dstages, dhead, dx_micro, inv_m)
 
 
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -1721,7 +1953,7 @@ def _require_no_seq_axis(mesh: Mesh) -> None:
     the aux term riding the stage scan) unsharded over sequence — it runs
     on (pipe, data[, model]) meshes only.  The plain 1F1B schedule DOES
     compose with sp (ring attention in the stage fwd/bwd, sequence-
-    sharded loss head via ``_sp_next_token_nll``)."""
+    sharded loss head via ``_sp_shift_targets`` + ``_sp_masked_nll``)."""
     if mesh.shape.get("seq", 1) > 1:
         raise ValueError(
             "this pipeline objective supports (pipe, data[, model]) "

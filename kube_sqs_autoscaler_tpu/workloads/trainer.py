@@ -243,13 +243,8 @@ def train(args) -> dict:
                     "--seq-parallel"
                 )
         if args.moe:
-            # MoE x pp: gpipe only (1F1B's hand-built backward does not
-            # thread the aux term), no tp (experts replicate per stage)
-            if args.pipe_schedule != "gpipe":
-                raise SystemExit(
-                    "--moe with --pipe-parallel supports "
-                    "--pipe-schedule gpipe only"
-                )
+            # MoE x pp, both schedules (1F1B threads the aux term as a
+            # constant cotangent); no tp (experts replicate per stage)
             if args.model_parallel > 1:
                 raise SystemExit(
                     "--moe with --pipe-parallel does not combine with "
