@@ -149,7 +149,9 @@ def test_trainer_pipeline_checkpoints_and_resumes(tmp_path):
 
 
 def test_trainer_pipeline_seq_parallel_learns():
-    # pp x sp from the binary: ring attention inside the GPipe stages
+    # pp x sp from the binary: ring attention inside the stages (the
+    # 1f1b schedule composes too — tests/test_pipeline.py runs it; here
+    # the gpipe default plus the tp/sp exclusivity check)
     result = main(TINY_FLAGS + ["--steps", "4", "--pipe-parallel", "2",
                                 "--pipe-microbatches", "2",
                                 "--seq-parallel", "2", "--overfit"])
@@ -158,10 +160,6 @@ def test_trainer_pipeline_seq_parallel_learns():
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
 
-    with pytest.raises(SystemExit, match="gpipe"):
-        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
-                           "--seq-parallel", "2",
-                           "--pipe-schedule", "1f1b"])
     with pytest.raises(SystemExit, match="not both"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--seq-parallel", "2", "--model-parallel", "2"])
@@ -184,10 +182,7 @@ def test_trainer_pipeline_flag_conflicts_fail_fast():
     with pytest.raises(SystemExit, match="--zigzag"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--seq-parallel", "1", "--zigzag"])
-    # moe x pp works (gpipe) — but not with 1F1B or tp
-    with pytest.raises(SystemExit, match="gpipe"):
-        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe",
-                           "--pipe-schedule", "1f1b"])
+    # moe x pp works (both schedules — tests/test_moe.py) but not with tp
     with pytest.raises(SystemExit, match="model-parallel"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe",
                            "--model-parallel", "2"])
