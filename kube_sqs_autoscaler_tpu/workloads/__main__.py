@@ -142,6 +142,15 @@ def main(argv=None) -> None:
              "generate-mode replies)",
     )
     parser.add_argument(
+        "--prefix-ids", default="", metavar="ID,ID,...",
+        help="shared prompt prefix (comma-separated token ids), prefilled "
+             "ONCE at startup and reused by every request: message bodies "
+             "become per-request suffixes continuing from the cached "
+             "prefix (identical outputs to prepending the prefix to every "
+             "prompt, minus its repeated prefill cost; single chip, "
+             "--generate-tokens >= 1)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -169,6 +178,30 @@ def main(argv=None) -> None:
         ):
             if bad:
                 raise SystemExit(f"--quantize-kv does not support {flag}")
+    prefix_ids: list[int] = []
+    if args.prefix_ids:
+        try:
+            prefix_ids = [
+                int(s) for s in args.prefix_ids.split(",") if s.strip()
+            ]
+        except ValueError as err:
+            raise SystemExit(f"--prefix-ids must be integers ({err})")
+        if not prefix_ids:
+            raise SystemExit("--prefix-ids is empty")
+        # the prefix rides the single-chip full-precision padded cache;
+        # every other decode layout fails fast (same convention as the
+        # --quantize-kv combo checks above)
+        for flag, bad in (
+            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
+            ("--model-parallel", bool(args.model_parallel)),
+            ("--beams > 1", args.beams > 1),
+            ("--speculative-draft-layers",
+             bool(args.speculative_draft_layers)),
+            ("--continuous", args.continuous),
+            ("--quantize-kv", args.quantize_kv),
+        ):
+            if bad:
+                raise SystemExit(f"--prefix-ids does not support {flag}")
     if args.top_k < 0:
         raise SystemExit(f"--top-k {args.top_k} must be >= 0 (0 = off)")
     if not 0.0 < args.top_p <= 1.0:
@@ -193,7 +226,11 @@ def main(argv=None) -> None:
         2 * args.speculative_draft_tokens
         if args.speculative_draft_layers else 0
     )
-    needed_ctx = max(64, args.seq_len + args.generate_tokens + spec_headroom)
+    needed_ctx = max(
+        64,
+        len(prefix_ids) + args.seq_len + args.generate_tokens
+        + spec_headroom,
+    )
     hf_params = None
     if args.hf_checkpoint:
         from .hf_convert import load_hf_llama
@@ -207,7 +244,7 @@ def main(argv=None) -> None:
             model_config.n_heads, model_config.n_kv_heads,
             "untied" if "lm_head" in hf_params else "tied",
         )
-        needed = args.seq_len + args.generate_tokens
+        needed = len(prefix_ids) + args.seq_len + args.generate_tokens
         if model_config.max_seq_len < needed:
             raise SystemExit(
                 f"HF model has max_seq_len={model_config.max_seq_len} < "
@@ -222,7 +259,7 @@ def main(argv=None) -> None:
         if family != args.family:
             log.info("Checkpoint manifest says family=%s (overriding CLI)",
                      family)
-        needed = args.seq_len + args.generate_tokens
+        needed = len(prefix_ids) + args.seq_len + args.generate_tokens
         if model_config.max_seq_len < needed:
             raise SystemExit(
                 f"checkpointed model has max_seq_len="
@@ -389,6 +426,44 @@ def main(argv=None) -> None:
                 quantized_cache=service_config.quantized_kv,
             ),
         }
+    if prefix_ids:
+        # prefill the shared prefix ONCE; every batch's bodies are then
+        # suffixes continuing from its cache (the combo checks at the
+        # top left only the plain single-chip generate paths standing)
+        import jax.numpy as jnp
+
+        bad = [i for i in prefix_ids if not 0 <= i < model_config.vocab_size]
+        if bad:
+            # JAX gathers clamp out-of-bounds ids on device, so these
+            # would silently prefill garbage
+            raise SystemExit(
+                f"--prefix-ids {bad} out of range for vocab_size="
+                f"{model_config.vocab_size}"
+            )
+        prefix_arr = jnp.asarray(prefix_ids, jnp.int32)
+        from .service import sampling_keys as _sampling_keys
+
+        pfx_keys = _sampling_keys(service_config.sample_seed)
+        if family == "llama":
+            from .llama import llama_generate_jit as _pfx_gen
+            from .llama import llama_prefill_prefix as _pfx_prefill
+        else:
+            from .decode import generate_jit as _pfx_gen
+            from .decode import prefill_prefix as _pfx_prefill
+        prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
+        worker_kwargs["generate_fn"] = (
+            lambda p, t, n, lengths: _pfx_gen(
+                p, t, n, model_config,
+                temperature=args.temperature,
+                rng=(next(pfx_keys) if args.temperature > 0.0 else None),
+                lengths=lengths, top_k=service_config.top_k,
+                top_p=service_config.top_p,
+                eos_id=service_config.eos_id,
+                prefix_cache=prefix_cache,
+            )
+        )
+        log.info("Prefix cache: %d shared tokens prefilled once",
+                 len(prefix_ids))
     if args.beams > 1:
         if mesh is not None:
             # beams over the (data, model) mesh: expanded rows shard over

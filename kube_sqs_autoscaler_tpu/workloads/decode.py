@@ -546,6 +546,79 @@ def chunk_decode(
                               write_and_attend)
 
 
+# ---------------------------------------------------------------------------
+# Prefix caching: share one prompt prefix's KV across a batch of requests
+# ---------------------------------------------------------------------------
+
+
+def prefill_prefix(
+    params: dict, prefix: jax.Array, config: ModelConfig, attention_fn=None
+) -> dict:
+    """KV cache of a SHARED prompt prefix, computed once.
+
+    Serving fleets front most requests with the same system prompt; its
+    prefill FLOPs and KV bytes are identical for every request, so they
+    should be paid once per process, not once per batch.  ``prefix``:
+    int32 ``[prefix_len]`` (or ``[1, prefix_len]``) → a batch-1 cache at
+    ``length == prefix_len`` to hand to :func:`prefill_with_prefix` (or
+    its llama twin).  No reference counterpart: the reference has no
+    model serving (SURVEY.md §2); the design is the standard
+    prefix-cache one (vLLM's shared-prompt case), re-expressed over this
+    package's padded-cache layout.
+    """
+    prefix = jnp.asarray(prefix, jnp.int32)
+    if prefix.ndim == 1:
+        prefix = prefix[None, :]
+    _, cache = prefill(params, prefix, config, attention_fn)
+    return cache
+
+
+def broadcast_prefix(prefix_cache: dict, batch: int) -> dict:
+    """A batch-1 prefix cache -> a batch-``B`` starting cache (one
+    materialized copy per row: every row decodes into its OWN cache
+    slots past the shared prefix)."""
+    def rows(leaf):
+        return jnp.broadcast_to(leaf, (batch, *leaf.shape[1:]))
+
+    return {
+        "layers": [
+            {name: rows(leaf) for name, leaf in layer.items()}
+            for layer in prefix_cache["layers"]
+        ],
+        "length": jnp.broadcast_to(prefix_cache["length"], (batch,)),
+    }
+
+
+def prefill_with_prefix(
+    params: dict,
+    prefix_cache: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """Per-request suffixes continue from a shared prefix's cache.
+
+    ``tokens``: int32 ``[batch, suffix_len]`` — each row's own tokens,
+    occupying positions ``[P, P + suffix_len)`` after the ``P``-token
+    prefix.  One :func:`chunk_decode` forward writes the suffix k/v and
+    attends prefix + causal suffix, so the result is bit-identical to
+    :func:`prefill` of the concatenated prompts (tested) at
+    ``suffix/(prefix+suffix)`` of the FLOPs.  ``lengths`` marks ragged
+    right-padded suffixes, same contract as :func:`prefill`.  Returns
+    (readout logits ``[batch, vocab]``, cache at ``P + suffix_len``
+    — or ``P + lengths[i]`` — per row).
+    """
+    batch, _ = tokens.shape
+    cache = broadcast_prefix(prefix_cache, batch)
+    start = cache["length"]
+    logits_all, cache = chunk_decode(params, cache, tokens, config)
+    if lengths is None:
+        return logits_all[:, -1], cache
+    lengths = lengths.astype(jnp.int32)
+    logits = logits_all[jnp.arange(batch), lengths - 1]
+    return logits, dict(cache, length=start + lengths)
+
+
 def _pick(
     logits: jax.Array,
     key: jax.Array | None,
@@ -606,8 +679,15 @@ def generate(
     top_p: float = 1.0,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
 ) -> jax.Array:
     """Generate ``num_tokens`` continuation tokens for each prompt.
+
+    ``prefix_cache`` (from :func:`prefill_prefix`) prepends a shared,
+    already-prefilled prompt prefix: ``prompt`` rows are then the
+    per-request SUFFIXES, continued from the prefix via
+    :func:`prefill_with_prefix` — identical outputs to generating from
+    the concatenated prompts, minus the prefix's repeated prefill cost.
 
     ``eos_id`` (optional) ends a row's generation: once the row emits
     that id every later position is ``eos_id`` (the shapes stay static —
@@ -633,6 +713,10 @@ def generate(
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    # with a prefix the full bound is prefix_len + prompt + num_tokens,
+    # but prefix_len lives in a (possibly traced) cache length — the
+    # static check here covers what it can; the decode mask makes an
+    # overrun wrap into visible garbage rather than silent corruption
     if prompt_len + num_tokens > config.max_seq_len:
         raise ValueError(
             f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
@@ -640,6 +724,11 @@ def generate(
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
+    if prefix_cache is not None and quantized_cache:
+        raise ValueError(
+            "prefix_cache does not combine with quantized_cache (the "
+            "prefix is prefilled into the bf16 cache layout)"
+        )
     keys = (
         jax.random.split(rng, num_tokens)
         if rng is not None
@@ -647,8 +736,13 @@ def generate(
     )
     prefill_fn = quantized_prefill if quantized_cache else prefill
     step_fn = quantized_decode_step if quantized_cache else decode_step
-    logits, cache = prefill_fn(params, prompt, config, attention_fn,
-                               lengths=lengths)
+    if prefix_cache is not None:
+        logits, cache = prefill_with_prefix(
+            params, prefix_cache, prompt, config, lengths=lengths
+        )
+    else:
+        logits, cache = prefill_fn(params, prompt, config, attention_fn,
+                                   lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
     done0 = (
         first == eos_id if eos_id is not None
@@ -691,14 +785,18 @@ def generate_jit(
     top_p: float = 1.0,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
 ) -> jax.Array:
     """Single-chip compiled :func:`generate`. ``attention_fn`` selects the
     prompt-pass attention (static, so e.g. the Pallas flash kernel gets its
-    own compiled program, exactly like ``model.forward_jit_with``)."""
+    own compiled program, exactly like ``model.forward_jit_with``).
+    ``prefix_cache`` is a dynamic pytree arg: one compiled program serves
+    any prefix CONTENT of the same shape."""
     return generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         attention_fn=attention_fn, lengths=lengths, top_k=top_k, top_p=top_p,
         eos_id=eos_id, quantized_cache=quantized_cache,
+        prefix_cache=prefix_cache,
     )
 
 

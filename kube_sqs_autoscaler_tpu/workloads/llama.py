@@ -912,6 +912,45 @@ def llama_quantized_chunk_decode(
                                     write_and_attend)
 
 
+def llama_prefill_prefix(
+    params: dict, prefix: jax.Array, config: LlamaConfig,
+    prompt_attention=None,
+) -> dict:
+    """KV cache of a SHARED prompt prefix, computed once — the llama
+    twin of :func:`.decode.prefill_prefix` (compact GQA cache; RoPE is
+    position-absolute so the cached keys are already rotated for their
+    slots)."""
+    prefix = jnp.asarray(prefix, jnp.int32)
+    if prefix.ndim == 1:
+        prefix = prefix[None, :]
+    _, cache = llama_prefill(params, prefix, config, prompt_attention)
+    return cache
+
+
+def llama_prefill_with_prefix(
+    params: dict,
+    prefix_cache: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """Per-request suffixes continue from a shared prefix's cache — the
+    llama twin of :func:`.decode.prefill_with_prefix` (one
+    :func:`llama_chunk_decode` forward; RoPE offsets come from the
+    cache's per-row lengths, window semantics included)."""
+    from .decode import broadcast_prefix
+
+    batch, _ = tokens.shape
+    cache = broadcast_prefix(prefix_cache, batch)
+    start = cache["length"]
+    logits_all, cache = llama_chunk_decode(params, cache, tokens, config)
+    if lengths is None:
+        return logits_all[:, -1], cache
+    lengths = lengths.astype(jnp.int32)
+    logits = logits_all[jnp.arange(batch), lengths - 1]
+    return logits, dict(cache, length=start + lengths)
+
+
 def llama_generate(
     params: dict,
     prompt: jax.Array,
@@ -927,6 +966,7 @@ def llama_generate(
     rolling: bool = False,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
 ) -> jax.Array:
     """Greedy/temperature/top-k/top-p generation, one compiled program
     (same contract and scan structure as :func:`.decode.generate`,
@@ -937,7 +977,9 @@ def llama_generate(
     only; identical outputs — the window mask already hides everything
     the ring evicts).  ``quantized_cache=True`` decodes through the int8
     GQA cache (half the cache bytes per step; outputs match to int8
-    rounding)."""
+    rounding).  ``prefix_cache`` (from :func:`llama_prefill_prefix`)
+    prepends a shared, already-prefilled prefix — ``prompt`` rows are
+    the per-request suffixes."""
     from .decode import _pick
 
     batch, prompt_len = prompt.shape
@@ -955,6 +997,11 @@ def llama_generate(
             "rolling and quantized_cache do not compose (the ring's slot "
             "arithmetic is a full-precision layout); pick one"
         )
+    if prefix_cache is not None and (rolling or quantized_cache):
+        raise ValueError(
+            "prefix_cache rides the full-precision padded cache layout; "
+            "it does not combine with rolling or quantized_cache"
+        )
     keys = (
         jax.random.split(rng, num_tokens)
         if rng is not None
@@ -966,8 +1013,13 @@ def llama_generate(
     else:
         prefill_fn = llama_rolling_prefill if rolling else llama_prefill
         step_fn = llama_rolling_decode_step if rolling else llama_decode_step
-    logits, cache = prefill_fn(params, prompt, config, prompt_attention,
-                               lengths=lengths)
+    if prefix_cache is not None:
+        logits, cache = llama_prefill_with_prefix(
+            params, prefix_cache, prompt, config, lengths=lengths
+        )
+    else:
+        logits, cache = prefill_fn(params, prompt, config, prompt_attention,
+                                   lengths=lengths)
     first = _pick(logits, keys[0], temperature, top_k, top_p)
     done0 = (
         first == eos_id if eos_id is not None
@@ -1061,10 +1113,11 @@ def llama_generate_jit(
     rolling: bool = False,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
 ) -> jax.Array:
     return llama_generate(
         params, prompt, num_tokens, config, temperature=temperature, rng=rng,
         prompt_attention=prompt_attention, lengths=lengths, top_k=top_k,
         top_p=top_p, rolling=rolling, eos_id=eos_id,
-        quantized_cache=quantized_cache,
+        quantized_cache=quantized_cache, prefix_cache=prefix_cache,
     )

@@ -1,0 +1,190 @@
+"""Prefix caching: a shared prompt prefix prefilled ONCE must produce
+exactly what prefilling the concatenated prompts produces — logits,
+caches, and whole greedy generations — for both families, ragged
+suffixes included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.decode import (
+    generate,
+    prefill,
+    prefill_prefix,
+    prefill_with_prefix,
+)
+from kube_sqs_autoscaler_tpu.workloads.llama import (
+    LlamaConfig,
+    init_llama_params,
+    llama_generate,
+    llama_prefill_prefix,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig, init_params
+
+# fp32 so prefix-vs-concat comparisons are exact
+TINY = ModelConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32,
+)
+TINY_LLAMA = LlamaConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+    d_ff=128, max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    return init_params(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    return init_llama_params(jax.random.key(0), TINY_LLAMA)
+
+
+def ids(shape, seed, vocab=256):
+    return jax.random.randint(jax.random.key(seed), shape, 0, vocab,
+                              jnp.int32)
+
+
+def test_prefill_with_prefix_equals_concat_prefill(gpt_params):
+    prefix = ids((8,), 1)
+    suffix = ids((4, 6), 2)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (4, 8)), suffix], axis=1
+    )
+
+    ref_logits, ref_cache = prefill(gpt_params, concat, TINY)
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+    logits, cache = prefill_with_prefix(gpt_params, pc, suffix, TINY)
+
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(cache["length"]),
+                                  np.asarray(ref_cache["length"]))
+    # the populated cache region must match exactly too
+    for got, ref in zip(cache["layers"], ref_cache["layers"]):
+        np.testing.assert_allclose(
+            np.asarray(got["k"][:, :, :14]), np.asarray(ref["k"][:, :, :14]),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got["v"][:, :, :14]), np.asarray(ref["v"][:, :, :14]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_generate_with_prefix_equals_concat(gpt_params):
+    prefix = ids((8,), 3)
+    suffix = ids((4, 5), 4)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (4, 8)), suffix], axis=1
+    )
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+
+    ref = generate(gpt_params, concat, 12, TINY)
+    got = generate(gpt_params, suffix, 12, TINY, prefix_cache=pc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # the prefix cache is reusable: a second, different batch gets its
+    # own rows (no mutation of the shared prefix)
+    suffix2 = ids((2, 5), 5)
+    concat2 = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (2, 8)), suffix2], axis=1
+    )
+    np.testing.assert_array_equal(
+        np.asarray(generate(gpt_params, suffix2, 6, TINY, prefix_cache=pc)),
+        np.asarray(generate(gpt_params, concat2, 6, TINY)),
+    )
+
+
+def test_ragged_suffixes_with_prefix(gpt_params):
+    # rows with different suffix lengths, right-padded: each row must
+    # generate exactly what its unpadded concat prompt would
+    prefix = ids((8,), 6)
+    lens = [5, 3]
+    suffix = ids((2, 5), 7)
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+    got = generate(gpt_params, suffix, 8, TINY, prefix_cache=pc,
+                   lengths=jnp.asarray(lens, jnp.int32))
+    for i, n in enumerate(lens):
+        concat = jnp.concatenate([prefix, suffix[i, :n]])[None, :]
+        ref = generate(gpt_params, concat, 8, TINY)
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref[0]))
+
+
+def test_llama_generate_with_prefix_equals_concat(llama_params):
+    prefix = ids((8,), 8)
+    suffix = ids((4, 5), 9)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (4, 8)), suffix], axis=1
+    )
+    pc = llama_prefill_prefix(llama_params, prefix, TINY_LLAMA)
+    ref = llama_generate(llama_params, concat, 10, TINY_LLAMA)
+    got = llama_generate(llama_params, suffix, 10, TINY_LLAMA,
+                         prefix_cache=pc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_llama_windowed_prefix(llama_params):
+    # sliding-window config: the window mask spans the prefix boundary
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=64, sliding_window=6, dtype=jnp.float32,
+    )
+    params = init_llama_params(jax.random.key(1), cfg)
+    prefix = ids((8,), 10)
+    suffix = ids((2, 4), 11)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (2, 8)), suffix], axis=1
+    )
+    pc = llama_prefill_prefix(params, prefix, cfg)
+    ref = llama_generate(params, concat, 8, cfg)
+    got = llama_generate(params, suffix, 8, cfg, prefix_cache=pc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_worker_binary_prefix_flag():
+    # the serve binary end to end: --prefix-ids prefills once and every
+    # demo message decodes as a suffix (both families)
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--prefix-ids", "5,6,7"])
+    main(["--family", "llama", "--demo", "2", "--batch-size", "1",
+          "--seq-len", "8", "--generate-tokens", "4",
+          "--prefix-ids", "5,6,7"])
+
+
+def test_worker_binary_prefix_combo_rejections():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    base = ["--demo", "1", "--seq-len", "8", "--generate-tokens", "4",
+            "--prefix-ids", "1,2"]
+    for extra, match in (
+        (["--quantize-kv"], "quantize-kv"),
+        (["--beams", "2"], "beams"),
+        (["--continuous"], "continuous"),
+        (["--speculative-draft-layers", "1"], "speculative"),
+        (["--model-parallel", "1"], "model-parallel"),
+    ):
+        with pytest.raises(SystemExit, match=match):
+            main(base + extra)
+    with pytest.raises(SystemExit, match="generate-tokens"):
+        main(["--demo", "1", "--seq-len", "8", "--prefix-ids", "1,2"])
+    with pytest.raises(SystemExit, match="integers"):
+        main(base[:-1] + ["1,two"])
+    with pytest.raises(SystemExit, match="out of range"):
+        main(base[:-1] + ["9999999"])
+
+
+def test_prefix_rejects_other_cache_layouts(gpt_params, llama_params):
+    pc = prefill_prefix(gpt_params, ids((4,), 12), TINY)
+    with pytest.raises(ValueError, match="quantized_cache"):
+        generate(gpt_params, ids((2, 3), 13), 4, TINY, prefix_cache=pc,
+                 quantized_cache=True)
+    lpc = llama_prefill_prefix(llama_params, ids((4,), 14), TINY_LLAMA)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        llama_generate(llama_params, ids((2, 3), 15), 4, TINY_LLAMA,
+                       prefix_cache=lpc, quantized_cache=True)

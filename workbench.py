@@ -440,6 +440,56 @@ def bench_weight_int8(num_tokens: int = 64) -> dict:
     }
 
 
+def bench_prefix_cache(prefix_len: int = 1024, suffix_len: int = 64) -> dict:
+    """Batch prefill seconds: shared-prefix path (``prefill_with_prefix``
+    on per-request suffixes) vs prefilling the concatenated prompts.
+    The prefix's FLOPs are paid once per PROCESS instead of once per
+    batch, so the expected speedup approaches
+    ``(prefix+suffix)/suffix`` for prefix >> suffix."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        prefill,
+        prefill_prefix,
+        prefill_with_prefix,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    config = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=prefix_len + suffix_len + 8,
+    )
+    params = init_params(jax.random.key(0), config)
+    batch = 8
+    prefix = jax.random.randint(jax.random.key(1), (prefix_len,), 0,
+                                config.vocab_size, jnp.int32)
+    suffix = jax.random.randint(jax.random.key(2), (batch, suffix_len), 0,
+                                config.vocab_size, jnp.int32)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (batch, prefix_len)), suffix], axis=1
+    )
+    pc = prefill_prefix(params, prefix, config)
+    with_prefix = jax.jit(
+        lambda pc, s: prefill_with_prefix(params, pc, s, config)[0]
+    )
+    full = jax.jit(lambda t: prefill(params, t, config)[0])
+
+    prefix_s = _time_compiled(with_prefix, pc, suffix, iters=10)
+    full_s = _time_compiled(full, concat, iters=10)
+    return {
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "batch": batch,
+        "with_prefix_ms": prefix_s * 1e3,
+        "full_prefill_ms": full_s * 1e3,
+        "speedup": full_s / prefix_s,
+    }
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser(prog="workbench")
     parser.add_argument("--steps", type=int, default=20)
@@ -465,7 +515,8 @@ def main(argv=None) -> dict:
         ["train", "llama_train"]
         + [f"attention_s{s}" for s in ATTN_SEQ_LENS]
         + [f"ring_local_s{s}" for s in (4096, 8192)]
-        + ["window_s8192", "speculative", "kv_cache_int8", "weight_int8"]
+        + ["window_s8192", "speculative", "kv_cache_int8", "weight_int8",
+           "prefix_cache"]
     )
     if args.only is not None:
         unknown = sorted(set(args.only) - set(known_entries))
@@ -523,6 +574,8 @@ def main(argv=None) -> dict:
         record("kv_cache_int8", bench_kv_cache())
     if want("weight_int8"):
         record("weight_int8", bench_weight_int8())
+    if want("prefix_cache"):
+        record("prefix_cache", bench_prefix_cache())
     if args.only is not None:
         for name in ran:
             results[name] = {**results[name], **run_meta}
@@ -578,6 +631,9 @@ def main(argv=None) -> dict:
     if "weight_int8" in report:
         metrics.append(("weight_int8_decode_speedup",
                         report["weight_int8"]["speedup"], "x"))
+    if "prefix_cache" in report:
+        metrics.append(("prefix_cache_prefill_speedup",
+                        report["prefix_cache"]["speedup"], "x"))
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
