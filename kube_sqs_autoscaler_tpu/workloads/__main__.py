@@ -148,7 +148,8 @@ def main(argv=None) -> None:
              "become per-request suffixes continuing from the cached "
              "prefix (identical outputs to prepending the prefix to every "
              "prompt, minus its repeated prefill cost; single chip, "
-             "--generate-tokens >= 1)",
+             "--generate-tokens >= 1; composes with --continuous — slots "
+             "start past the shared prefix)",
     )
     parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
@@ -197,7 +198,6 @@ def main(argv=None) -> None:
             ("--beams > 1", args.beams > 1),
             ("--speculative-draft-layers",
              bool(args.speculative_draft_layers)),
-            ("--continuous", args.continuous),
             ("--quantize-kv", args.quantize_kv),
         ):
             if bad:
@@ -426,10 +426,13 @@ def main(argv=None) -> None:
                 quantized_cache=service_config.quantized_kv,
             ),
         }
+    prefix_cache = None
     if prefix_ids:
         # prefill the shared prefix ONCE; every batch's bodies are then
         # suffixes continuing from its cache (the combo checks at the
-        # top left only the plain single-chip generate paths standing)
+        # top left the plain single-chip generate paths and continuous
+        # batching standing — --continuous hands the cache to the slot
+        # machine instead of the generate seam)
         import jax.numpy as jnp
 
         bad = [i for i in prefix_ids if not 0 <= i < model_config.vocab_size]
@@ -441,27 +444,31 @@ def main(argv=None) -> None:
                 f"{model_config.vocab_size}"
             )
         prefix_arr = jnp.asarray(prefix_ids, jnp.int32)
-        from .service import sampling_keys as _sampling_keys
-
-        pfx_keys = _sampling_keys(service_config.sample_seed)
         if family == "llama":
-            from .llama import llama_generate_jit as _pfx_gen
             from .llama import llama_prefill_prefix as _pfx_prefill
         else:
-            from .decode import generate_jit as _pfx_gen
             from .decode import prefill_prefix as _pfx_prefill
         prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
-        worker_kwargs["generate_fn"] = (
-            lambda p, t, n, lengths: _pfx_gen(
-                p, t, n, model_config,
-                temperature=args.temperature,
-                rng=(next(pfx_keys) if args.temperature > 0.0 else None),
-                lengths=lengths, top_k=service_config.top_k,
-                top_p=service_config.top_p,
-                eos_id=service_config.eos_id,
-                prefix_cache=prefix_cache,
+        if not args.continuous:
+            from .service import sampling_keys as _sampling_keys
+
+            pfx_keys = _sampling_keys(service_config.sample_seed)
+            if family == "llama":
+                from .llama import llama_generate_jit as _pfx_gen
+            else:
+                from .decode import generate_jit as _pfx_gen
+            worker_kwargs["generate_fn"] = (
+                lambda p, t, n, lengths: _pfx_gen(
+                    p, t, n, model_config,
+                    temperature=args.temperature,
+                    rng=(next(pfx_keys) if args.temperature > 0.0
+                         else None),
+                    lengths=lengths, top_k=service_config.top_k,
+                    top_p=service_config.top_p,
+                    eos_id=service_config.eos_id,
+                    prefix_cache=prefix_cache,
+                )
             )
-        )
         log.info("Prefix cache: %d shared tokens prefilled once",
                  len(prefix_ids))
     if args.beams > 1:
@@ -610,7 +617,8 @@ def main(argv=None) -> None:
                                        service_config, family=family,
                                        tokenizer=tokenizer,
                                        result_queue=result_queue,
-                                       mesh=mesh)
+                                       mesh=mesh,
+                                       prefix_cache=prefix_cache)
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
@@ -657,7 +665,7 @@ def main(argv=None) -> None:
 
         cworker = ContinuousWorker(
             queue, params, model_config, service_config, family=family,
-            tokenizer=tokenizer,
+            tokenizer=tokenizer, prefix_cache=prefix_cache,
             # AWS SQS addresses queues per call by url, so the same
             # client publishes replies when --result-queue-url is set
             result_queue=(queue if args.result_queue_url else None),

@@ -66,6 +66,8 @@ def _insert_row_impl(
     top_k: int = 0,
     top_p: float = 1.0,
     quantized_kv: bool = False,
+    prefix_len: int = 0,
+    prefix_cache: dict | None = None,
 ) -> tuple[dict, jax.Array]:
     """Prefill ``prompt`` (int32 ``[prompt_len]``, right-padded to the
     static bucket) and splice it into slot ``row`` of ``cache``.
@@ -78,33 +80,52 @@ def _insert_row_impl(
     puts the batch row on axis 0 and the POSITION on axis 2: ``[B, H,
     S, D]`` codes/values and ``[B, H, S]`` scales alike, so one
     axis-2 slice serves both the bf16 and the int8 layouts).
+
+    ``prefix_len > 0`` (with ``prefix_cache``): the prompt is a SUFFIX
+    continuing from a shared prefix — the prefill runs through
+    ``prefill_with_prefix``, only the suffix region ``[prefix_len,
+    prefix_len + prompt_len)`` is spliced (the batch cache's rows
+    already hold the broadcast prefix, which slot reuse never
+    overwrites — decode writes at ``length >= prefix_len``), and the
+    slot's length starts past the prefix.
     """
-    if quantized_kv:
+    if prefix_len:
         if family == "llama":
-            from .llama import llama_quantized_prefill as prefill_fn
+            from .llama import llama_prefill_with_prefix as pf
         else:
-            from .decode import quantized_prefill as prefill_fn
-    elif family == "llama":
-        from .llama import llama_prefill as prefill_fn
+            from .decode import prefill_with_prefix as pf
+        logits, row_cache = pf(
+            params, prefix_cache, prompt[None], config, lengths=length[None]
+        )
     else:
-        prefill_fn = prefill
-    logits, row_cache = prefill_fn(
-        params, prompt[None], config, lengths=length[None]
-    )
+        if quantized_kv:
+            if family == "llama":
+                from .llama import llama_quantized_prefill as prefill_fn
+            else:
+                from .decode import quantized_prefill as prefill_fn
+        elif family == "llama":
+            from .llama import llama_prefill as prefill_fn
+        else:
+            prefill_fn = prefill
+        logits, row_cache = prefill_fn(
+            params, prompt[None], config, lengths=length[None]
+        )
     new_layers = []
     for layer_cache, row_layer in zip(cache["layers"], row_cache["layers"]):
         entry = {}
         for name, buf in layer_cache.items():
             piece = row_layer[name]
             # keep only the prompt positions: axis 2 for [1, H, S, D]
-            # codes/values, axis 2 for [1, H, S] scales too
-            piece = jax.lax.slice_in_dim(piece, 0, prompt_len, axis=2)
-            entry[name] = jax.lax.dynamic_update_slice(
-                buf, piece, (row,) + (0,) * (buf.ndim - 1)
+            # codes/values, axis 2 for [1, H, S] scales too (under a
+            # prefix, the suffix positions only)
+            piece = jax.lax.slice_in_dim(
+                piece, prefix_len, prefix_len + prompt_len, axis=2
             )
+            start = (row, 0, prefix_len) + (0,) * (buf.ndim - 3)
+            entry[name] = jax.lax.dynamic_update_slice(buf, piece, start)
         new_layers.append(entry)
     lengths = jax.lax.dynamic_update_index_in_dim(
-        cache["length"], length, row, 0
+        cache["length"], prefix_len + length, row, 0
     )
     first = _pick(logits, key, temperature, top_k, top_p)[0]
     return {"layers": new_layers, "length": lengths}, first
@@ -113,7 +134,7 @@ def _insert_row_impl(
 _insert_row = partial(
     jax.jit,
     static_argnames=("config", "prompt_len", "family", "temperature",
-                     "top_k", "top_p", "quantized_kv"),
+                     "top_k", "top_p", "quantized_kv", "prefix_len"),
     donate_argnums=(1,),
 )(_insert_row_impl)
 
@@ -157,12 +178,29 @@ class ContinuousBatcher:
         sample_seed: int = 0,
         mesh=None,
         quantized_kv: bool = False,
+        prefix_cache: dict | None = None,
     ) -> None:
-        if prompt_len + generate_tokens > config.max_seq_len:
+        self.prefix_len = 0
+        self._prefix_cache = prefix_cache
+        if prefix_cache is not None:
+            # slots start past a shared, once-prefilled prefix (see
+            # decode.prefill_prefix); the prefix rides the single-chip
+            # full-precision padded cache layout
+            if quantized_kv:
+                raise ValueError(
+                    "prefix_cache does not combine with quantized_kv"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "prefix_cache is single-chip (the broadcast prefix "
+                    "rows are not mesh-sharded)"
+                )
+            self.prefix_len = int(prefix_cache["length"][0])
+        if self.prefix_len + prompt_len + generate_tokens > config.max_seq_len:
             raise ValueError(
-                f"prompt_len + generate_tokens = "
-                f"{prompt_len + generate_tokens} exceeds max_seq_len="
-                f"{config.max_seq_len}"
+                f"prefix + prompt_len + generate_tokens = "
+                f"{self.prefix_len + prompt_len + generate_tokens} exceeds "
+                f"max_seq_len={config.max_seq_len}"
             )
         if family not in ("gpt", "llama"):
             raise ValueError(f"unknown family {family!r}")
@@ -184,7 +222,14 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.mesh = mesh
         self.quantized_kv = quantized_kv
-        if quantized_kv:
+        if prefix_cache is not None:
+            # every slot row starts as a copy of the shared prefix (the
+            # broadcast is layout-agnostic: gpt and llama caches both
+            # put rows on axis 0)
+            from .decode import broadcast_prefix
+
+            self.cache = broadcast_prefix(prefix_cache, batch_size)
+        elif quantized_kv:
             # slots store int8 codes + per-position scales: half the
             # bytes every engine step streams (see decode's int8 cache),
             # allocated directly — no transient bf16 buffers at startup
@@ -244,11 +289,12 @@ class ContinuousBatcher:
             family=self.family, temperature=self.temperature,
             top_k=self.top_k, top_p=self.top_p,
             quantized_kv=self.quantized_kv,
+            prefix_len=self.prefix_len,
         )
         if self.mesh is None:
             return lambda params, cache, row, prompt, length, key: (
                 _insert_row(params, cache, row, prompt, length, key,
-                            **statics)
+                            prefix_cache=self._prefix_cache, **statics)
             )
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -404,6 +450,7 @@ class ContinuousWorker:
         tokenizer=None,
         result_queue=None,
         mesh=None,
+        prefix_cache: dict | None = None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -435,6 +482,7 @@ class ContinuousWorker:
             sample_seed=service_config.sample_seed,
             mesh=mesh,
             quantized_kv=service_config.quantized_kv,
+            prefix_cache=prefix_cache,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as

@@ -589,6 +589,29 @@ def broadcast_prefix(prefix_cache: dict, batch: int) -> dict:
     }
 
 
+def _prefill_with_prefix_impl(
+    chunk_decode_fn,
+    params: dict,
+    prefix_cache: dict,
+    tokens: jax.Array,
+    config,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """The one suffix-continuation implementation both families share
+    (``chunk_decode_fn`` is the family's chunk decoder): broadcast the
+    prefix, run the suffix chunk, read out each row's last valid
+    position, and account ragged lengths into the cache."""
+    batch, _ = tokens.shape
+    cache = broadcast_prefix(prefix_cache, batch)
+    start = cache["length"]
+    logits_all, cache = chunk_decode_fn(params, cache, tokens, config)
+    if lengths is None:
+        return logits_all[:, -1], cache
+    lengths = lengths.astype(jnp.int32)
+    logits = logits_all[jnp.arange(batch), lengths - 1]
+    return logits, dict(cache, length=start + lengths)
+
+
 def prefill_with_prefix(
     params: dict,
     prefix_cache: dict,
@@ -601,22 +624,30 @@ def prefill_with_prefix(
     ``tokens``: int32 ``[batch, suffix_len]`` — each row's own tokens,
     occupying positions ``[P, P + suffix_len)`` after the ``P``-token
     prefix.  One :func:`chunk_decode` forward writes the suffix k/v and
-    attends prefix + causal suffix, so the result is bit-identical to
-    :func:`prefill` of the concatenated prompts (tested) at
-    ``suffix/(prefix+suffix)`` of the FLOPs.  ``lengths`` marks ragged
-    right-padded suffixes, same contract as :func:`prefill`.  Returns
-    (readout logits ``[batch, vocab]``, cache at ``P + suffix_len``
-    — or ``P + lengths[i]`` — per row).
+    attends prefix + causal suffix, computing the same attention as
+    :func:`prefill` of the concatenated prompts at
+    ``suffix/(prefix+suffix)`` of the FLOPs — equal up to
+    reduction-order rounding (the chunk path softmaxes over the masked
+    full-cache axis; ~1e-7 in fp32, so an argmax tie could in principle
+    flip a sampled token — the same caveat every kernel-vs-dense pair
+    here carries).  ``lengths`` marks ragged right-padded suffixes,
+    same contract as :func:`prefill`.  Returns (readout logits
+    ``[batch, vocab]``, cache at ``P + suffix_len`` — or
+    ``P + lengths[i]`` — per row).
     """
-    batch, _ = tokens.shape
-    cache = broadcast_prefix(prefix_cache, batch)
-    start = cache["length"]
-    logits_all, cache = chunk_decode(params, cache, tokens, config)
-    if lengths is None:
-        return logits_all[:, -1], cache
-    lengths = lengths.astype(jnp.int32)
-    logits = logits_all[jnp.arange(batch), lengths - 1]
-    return logits, dict(cache, length=start + lengths)
+    return _prefill_with_prefix_impl(
+        chunk_decode, params, prefix_cache, tokens, config, lengths
+    )
+
+
+def _concrete_prefix_len(prefix_cache: dict) -> int | None:
+    """The prefix length when it is host-readable (eager callers), else
+    ``None`` (inside jit the length is a tracer and bounds become the
+    caller's contract)."""
+    try:
+        return int(prefix_cache["length"][0])
+    except jax.errors.ConcretizationTypeError:
+        return None
 
 
 def _pick(
@@ -686,8 +717,9 @@ def generate(
     ``prefix_cache`` (from :func:`prefill_prefix`) prepends a shared,
     already-prefilled prompt prefix: ``prompt`` rows are then the
     per-request SUFFIXES, continued from the prefix via
-    :func:`prefill_with_prefix` — identical outputs to generating from
-    the concatenated prompts, minus the prefix's repeated prefill cost.
+    :func:`prefill_with_prefix` — the same generations as the
+    concatenated prompts (up to that function's reduction-order
+    rounding caveat), minus the prefix's repeated prefill cost.
 
     ``eos_id`` (optional) ends a row's generation: once the row emits
     that id every later position is ``eos_id`` (the shapes stay static —
@@ -713,14 +745,18 @@ def generate(
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
-    # with a prefix the full bound is prefix_len + prompt + num_tokens,
-    # but prefix_len lives in a (possibly traced) cache length — the
-    # static check here covers what it can; the decode mask makes an
-    # overrun wrap into visible garbage rather than silent corruption
-    if prompt_len + num_tokens > config.max_seq_len:
+    # with a prefix the full bound is prefix_len + prompt + num_tokens;
+    # eager callers get the real check (the cache length is concrete),
+    # traced callers the partial one (inside jit the bound is the
+    # caller's contract — __main__ and ContinuousBatcher both check it)
+    prefix_len = (
+        _concrete_prefix_len(prefix_cache) or 0
+        if prefix_cache is not None else 0
+    )
+    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
         raise ValueError(
-            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
-            f"max_seq_len={config.max_seq_len}"
+            f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
+            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")

@@ -937,18 +937,13 @@ def llama_prefill_with_prefix(
     """Per-request suffixes continue from a shared prefix's cache — the
     llama twin of :func:`.decode.prefill_with_prefix` (one
     :func:`llama_chunk_decode` forward; RoPE offsets come from the
-    cache's per-row lengths, window semantics included)."""
-    from .decode import broadcast_prefix
+    cache's per-row lengths, window semantics included; same
+    reduction-order rounding caveat)."""
+    from .decode import _prefill_with_prefix_impl
 
-    batch, _ = tokens.shape
-    cache = broadcast_prefix(prefix_cache, batch)
-    start = cache["length"]
-    logits_all, cache = llama_chunk_decode(params, cache, tokens, config)
-    if lengths is None:
-        return logits_all[:, -1], cache
-    lengths = lengths.astype(jnp.int32)
-    logits = logits_all[jnp.arange(batch), lengths - 1]
-    return logits, dict(cache, length=start + lengths)
+    return _prefill_with_prefix_impl(
+        llama_chunk_decode, params, prefix_cache, tokens, config, lengths
+    )
 
 
 def llama_generate(
@@ -982,13 +977,19 @@ def llama_generate(
     the per-request suffixes."""
     from .decode import _pick
 
+    from .decode import _concrete_prefix_len
+
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
-    if prompt_len + num_tokens > config.max_seq_len:
+    prefix_len = (
+        _concrete_prefix_len(prefix_cache) or 0
+        if prefix_cache is not None else 0
+    )
+    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
         raise ValueError(
-            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
-            f"max_seq_len={config.max_seq_len}"
+            f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
+            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")

@@ -145,6 +145,68 @@ def test_llama_windowed_prefix(llama_params):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+def test_continuous_batcher_with_prefix_equals_concat(gpt_params):
+    # continuous batching x prefix caching: slots start past the shared
+    # prefix; every request's greedy output must equal generate() of its
+    # CONCATENATED prompt — slot reuse included (requests > slots)
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+
+    prefix = ids((6,), 20)
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+    batcher = ContinuousBatcher(
+        gpt_params, TINY, batch_size=2, prompt_len=8, generate_tokens=5,
+        prefix_cache=pc,
+    )
+    assert batcher.prefix_len == 6
+    rng = np.random.default_rng(21)
+    requests = [
+        rng.integers(1, TINY.vocab_size, rng.integers(2, 9))
+        .astype(np.int32)
+        for _ in range(5)
+    ]
+    results = {}
+    queue = list(enumerate(requests))
+    for _ in range(200):
+        while queue and batcher.free_slots:
+            idx, toks = queue.pop(0)
+            batcher.submit(toks, payload=idx)
+        for idx, tokens in batcher.step():
+            results[idx] = tokens
+        if not queue and batcher.active == 0:
+            break
+    assert len(results) == 5
+    for idx, toks in enumerate(requests):
+        concat = jnp.concatenate(
+            [prefix, jnp.asarray(toks, jnp.int32)]
+        )[None, :]
+        ref = np.asarray(generate(gpt_params, concat, 5, TINY)[0])
+        np.testing.assert_array_equal(results[idx], ref,
+                                      err_msg=f"request {idx}")
+
+
+def test_continuous_prefix_rejects_quantized_slots(gpt_params):
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+
+    pc = prefill_prefix(gpt_params, ids((4,), 22), TINY)
+    with pytest.raises(ValueError, match="quantized_kv"):
+        ContinuousBatcher(
+            gpt_params, TINY, batch_size=2, prompt_len=8,
+            generate_tokens=4, prefix_cache=pc, quantized_kv=True,
+        )
+
+
+def test_worker_binary_continuous_prefix_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "3", "--batch-size", "2", "--seq-len", "8",
+          "--generate-tokens", "4", "--continuous",
+          "--prefix-ids", "5,6,7"])
+
+
 def test_worker_binary_prefix_flag():
     # the serve binary end to end: --prefix-ids prefills once and every
     # demo message decodes as a suffix (both families)
@@ -165,7 +227,6 @@ def test_worker_binary_prefix_combo_rejections():
     for extra, match in (
         (["--quantize-kv"], "quantize-kv"),
         (["--beams", "2"], "beams"),
-        (["--continuous"], "continuous"),
         (["--speculative-draft-layers", "1"], "speculative"),
         (["--model-parallel", "1"], "model-parallel"),
     ):
