@@ -650,6 +650,25 @@ def _concrete_prefix_len(prefix_cache: dict) -> int | None:
         return None
 
 
+def _check_prefix_budget(
+    prefix_cache: dict | None, prompt_len: int, num_tokens: int, config
+) -> None:
+    """The generate-entry bound check both families share: with a
+    prefix the full budget is prefix + prompt + num_tokens; eager
+    callers get the real check (the cache length is concrete), traced
+    callers the partial one (inside jit the bound is the caller's
+    contract — ``__main__`` and ``ContinuousBatcher`` both check it)."""
+    prefix_len = (
+        _concrete_prefix_len(prefix_cache) or 0
+        if prefix_cache is not None else 0
+    )
+    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
+            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
+        )
+
+
 def _pick(
     logits: jax.Array,
     key: jax.Array | None,
@@ -745,19 +764,7 @@ def generate(
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
-    # with a prefix the full bound is prefix_len + prompt + num_tokens;
-    # eager callers get the real check (the cache length is concrete),
-    # traced callers the partial one (inside jit the bound is the
-    # caller's contract — __main__ and ContinuousBatcher both check it)
-    prefix_len = (
-        _concrete_prefix_len(prefix_cache) or 0
-        if prefix_cache is not None else 0
-    )
-    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
-        raise ValueError(
-            f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
-            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
-        )
+    _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
     if prefix_cache is not None and quantized_cache:

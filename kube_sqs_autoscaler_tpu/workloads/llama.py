@@ -977,20 +977,12 @@ def llama_generate(
     the per-request suffixes."""
     from .decode import _pick
 
-    from .decode import _concrete_prefix_len
+    from .decode import _check_prefix_budget
 
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
-    prefix_len = (
-        _concrete_prefix_len(prefix_cache) or 0
-        if prefix_cache is not None else 0
-    )
-    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
-        raise ValueError(
-            f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
-            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
-        )
+    _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
     if rolling and quantized_cache:

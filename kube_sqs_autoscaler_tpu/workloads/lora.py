@@ -330,6 +330,70 @@ def init_pipeline_lora_train_state(
     }
 
 
+def lora_pipeline_value_and_grad(
+    mesh,
+    model_config: Any,
+    pcfg: Any,
+    frozen_params: dict,
+    lora: LoraConfig,
+    llama: bool = False,
+    remat: bool = False,
+):
+    """``(adapters, tokens) -> (loss, adapter_grads)`` through the
+    pipeline, either schedule.
+
+    GPipe: plain autodiff of the pipelined loss evaluated at
+    :func:`apply_pipeline_lora` (the frozen stacks are a closed-over
+    constant).  1F1B: the hand-built backward computes effective-WEIGHT
+    gradients; the adapter gradients follow by the chain rule of
+    ``W_eff = W + s·A@B`` — ``dA = s · dW @ Bᵀ``, ``dB = s · Aᵀ @ dW``
+    (batched over the leading layer axis) — so the 1F1B memory schedule
+    and the LoRA optimizer-state savings compose.  Exported for the
+    schedule-equality test."""
+    from .pipeline import (
+        llama_one_f_one_b_value_and_grad,
+        llama_pipeline_loss_fn,
+        one_f_one_b_value_and_grad,
+        pipeline_loss_fn,
+    )
+
+    if pcfg.schedule == "1f1b":
+        vag_full = partial(
+            llama_one_f_one_b_value_and_grad if llama
+            else one_f_one_b_value_and_grad,
+            config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
+        )
+
+        def adapter_vag(adapters, tokens):
+            eff = apply_pipeline_lora(frozen_params, adapters, lora)
+            loss, full_grads = vag_full(eff, tokens)
+            dstages = full_grads["stages"]
+            dadapters = {"stages": {}}
+            for name, ab in adapters["stages"].items():
+                dw = dstages[name].astype(jnp.float32)
+                dadapters["stages"][name] = {
+                    "a": jnp.einsum("lio,lro->lir", dw, ab["b"])
+                    * lora.scale,
+                    "b": jnp.einsum("lir,lio->lro", ab["a"], dw)
+                    * lora.scale,
+                }
+            # the frozen base's other gradients (embed/head/non-adapted
+            # stage leaves) are discarded — nothing updates them
+            return loss, dadapters
+
+        return adapter_vag
+
+    loss_fn = llama_pipeline_loss_fn if llama else pipeline_loss_fn
+
+    def adapter_loss(adapters, tokens):
+        return loss_fn(
+            apply_pipeline_lora(frozen_params, adapters, lora), tokens,
+            config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
+        )
+
+    return jax.value_and_grad(adapter_loss)
+
+
 def make_lora_pipeline_train_step(
     mesh,
     model_config: Any,
@@ -340,44 +404,24 @@ def make_lora_pipeline_train_step(
     lora: LoraConfig,
     llama: bool = False,
 ):
-    """Compile one adapter-only optimizer step over a pipeline mesh.
-
-    The GPipe loss is plain autodiff, so a LoRA step is the pipelined
-    loss evaluated at :func:`apply_pipeline_lora` with gradients flowing
-    only to the adapters — the frozen stage stacks are a closed-over
-    constant (placed with their usual ``"pipe"``-sharded layout, never
-    donated).  GPipe only: the 1F1B schedule's hand-built backward
-    produces stage-weight gradients, not adapter gradients.
-
-    Gradient accumulation composes via the shared fp32 chunked scan over
-    the batch axis (``accum_axis=1`` — axis 0 is the pipeline's own
-    microbatch schedule).
+    """Compile one adapter-only optimizer step over a pipeline mesh,
+    either schedule (:func:`lora_pipeline_value_and_grad`).  The frozen
+    stage stacks stay a closed-over constant (their usual
+    ``"pipe"``-sharded layout, never donated); gradient accumulation
+    composes via the shared fp32 chunked scan over the batch axis
+    (``accum_axis=1`` — axis 0 is the pipeline's own microbatch
+    schedule).
     """
-    from .pipeline import (
-        llama_pipeline_loss_fn,
-        pipeline_batch_sharding,
-        pipeline_loss_fn,
-    )
+    from .pipeline import pipeline_batch_sharding
     from .train import accumulate_value_and_grad, make_optimizer
 
-    if pcfg.schedule != "gpipe":
-        raise ValueError(
-            "LoRA over pipeline parallelism runs the gpipe schedule only "
-            "(1f1b's explicitly-scheduled backward computes stage-weight "
-            "gradients, not adapter gradients)"
-        )
     optimizer = make_optimizer(train_config)
-    loss_fn = llama_pipeline_loss_fn if llama else pipeline_loss_fn
-    remat = getattr(train_config, "remat", False)
-
-    def adapter_loss(adapters, tokens):
-        return loss_fn(
-            apply_pipeline_lora(frozen_params, adapters, lora), tokens,
-            config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
-        )
-
     compute_grads = accumulate_value_and_grad(
-        jax.value_and_grad(adapter_loss), train_config.grad_accum,
+        lora_pipeline_value_and_grad(
+            mesh, model_config, pcfg, frozen_params, lora, llama=llama,
+            remat=getattr(train_config, "remat", False),
+        ),
+        train_config.grad_accum,
         accum_axis=1,
     )
     return _jit_adapter_step(

@@ -270,16 +270,11 @@ def train(args) -> dict:
         # adapters wrap dense 2-D weights — flat or stage-stacked; only
         # MoE's expert stacks (3-D routed weights) are out of scope.
         # Resume, grad-accum, zig-zag (permutes the batch, not the
-        # params), and gpipe pipelines (autodiff backward) all compose;
-        # 1F1B's hand-built backward computes stage grads, not adapter
-        # grads, so it fails fast here.
+        # params), and pipelines under BOTH schedules compose (1F1B's
+        # stage-weight gradients chain-rule into adapter gradients —
+        # lora.lora_pipeline_value_and_grad).
         if args.moe:
             raise SystemExit("--lora-rank does not combine with --moe")
-        if pipe > 1 and args.pipe_schedule != "gpipe":
-            raise SystemExit(
-                "--lora-rank with --pipe-parallel supports "
-                "--pipe-schedule gpipe only"
-            )
     if args.hf_checkpoint:
         if args.moe:
             raise SystemExit(
