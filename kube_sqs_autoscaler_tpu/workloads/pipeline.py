@@ -263,6 +263,45 @@ def _stage_ring_attention(mesh: Mesh, window: int | None = None):
     return attend
 
 
+def _stage_zigzag_attention(mesh: Mesh):
+    """The per-stage attention for a pp x dp x sp mesh whose sequence
+    axis carries the ZIG-ZAG layout (:func:`.zigzag.zigzag_permutation`):
+    the load-balanced ring body running inside the pipeline's
+    fully-manual region — every device owns one early and one late
+    chunk, so each hop computes the same half-block work (the imbalance
+    plain ring attention pays under a causal mask).  Same body dispatch
+    as :func:`.zigzag.make_zigzag_ring_attention`: the Pallas flash-lse
+    hop kernel on TPU when both hop shapes tile, the einsum reference
+    body elsewhere.  GQA-native."""
+    from .zigzag import (
+        _zigzag_attention_kernel_local,
+        _zigzag_attention_local,
+    )
+
+    sp = mesh.shape["seq"]
+
+    def attend(q, k, v):
+        from .flash import tiles_cleanly
+
+        s_local = q.shape[2]  # already the LOCAL length (manual region)
+        if (
+            jax.default_backend() == "tpu"
+            and s_local % 2 == 0
+            and tiles_cleanly(s_local)
+            and tiles_cleanly(s_local // 2)
+        ):
+            return _zigzag_attention_kernel_local(
+                q, k, v, axis_name="seq", axis_size=sp
+            )
+        return _zigzag_attention_local(
+            q, k, v, axis_name="seq", axis_size=sp
+        )
+
+    attend.gqa_native = True
+    attend._zigzag = True
+    return attend
+
+
 def _stage_spec(name: str, with_model: bool) -> P:
     """PartitionSpec of one stage-stack leaf: leading layer axis over
     ``"pipe"``; on a pp x tp mesh, the PARAM_AXES Megatron axes over
@@ -374,6 +413,7 @@ def _llama_stage_apply(
     stage_layers: dict, x: jax.Array, config,
     remat: bool = False, tp_size: int = 1, attention_fn=None,
     moe=None, expert_mlp=None, seq_axis: str | None = None,
+    positions_table: jax.Array | None = None,
 ) -> jax.Array:
     """The llama-family counterpart of :func:`_stage_apply`: one stage's
     stacked llama layers (RoPE/GQA/RMSNorm/SwiGLU via
@@ -427,11 +467,16 @@ def _llama_stage_apply(
     from .flash import gqa_adapt
 
     attend = gqa_adapt(attention_fn)
-    positions = jnp.arange(x.shape[1])
-    if seq_axis is not None:
-        # sequence-sharded stage: RoPE rotates by GLOBAL positions (the
-        # local shard holds rows [i*S_loc, (i+1)*S_loc))
-        positions = positions + jax.lax.axis_index(seq_axis) * x.shape[1]
+    if positions_table is not None:
+        # zig-zag layout: RoPE rotates by the PERMUTED positions — row i
+        # of the (static-content) table is seq-shard i's position vector
+        positions = positions_table[jax.lax.axis_index(seq_axis)]
+    else:
+        positions = jnp.arange(x.shape[1])
+        if seq_axis is not None:
+            # sequence-sharded stage: RoPE rotates by GLOBAL positions
+            # (the local shard holds rows [i*S_loc, (i+1)*S_loc))
+            positions = positions + jax.lax.axis_index(seq_axis) * x.shape[1]
 
     if moe is not None:
         return _moe_layer_scan(
@@ -693,13 +738,16 @@ def pipeline_forward(
     mesh: Mesh,
     remat: bool = False,
     stage_attention=None,
+    positions: jax.Array | None = None,
 ) -> jax.Array:
     """Logits via the pipelined layer stack.
 
     ``tokens``: int32 ``[M, B_m, S]`` — microbatch-major so the schedule is
     explicit in the type (shard ``B_m`` over ``"data"`` with
     :func:`pipeline_batch_sharding`).  Returns fp32 logits
-    ``[M, B_m, S, vocab]``.
+    ``[M, B_m, S, vocab]``.  ``positions`` (static-content int32 ``[S]``)
+    overrides the natural positional indices — the zig-zag objective
+    passes the permutation so slot ``i`` embeds position ``perm[i]``.
     """
     n_micro, _, seq = tokens.shape
     if n_micro != pcfg.n_microbatches:
@@ -711,7 +759,11 @@ def pipeline_forward(
         raise ValueError(
             f"sequence length {seq} exceeds max_seq_len={config.max_seq_len}"
         )
-    x = params["embed"][tokens] + params["pos_embed"][:seq]
+    pos = (
+        params["pos_embed"][:seq] if positions is None
+        else params["pos_embed"][positions]
+    )
+    x = params["embed"][tokens] + pos
 
     pipe = mesh.shape["pipe"]
     tp_size = mesh.shape.get("model", 1)
@@ -786,6 +838,7 @@ def llama_pipeline_forward(
     mesh: Mesh,
     remat: bool = False,
     stage_attention=None,
+    positions_table: jax.Array | None = None,
 ) -> jax.Array:
     """Logits via the pipelined llama stack — :func:`pipeline_forward`
     with the family's pieces swapped in: RoPE positions instead of a
@@ -815,7 +868,8 @@ def llama_pipeline_forward(
             stage_attention = _stage_ring_attention(
                 mesh, window=config.sliding_window
             )
-        stage_apply = partial(_llama_stage_apply, seq_axis="seq")
+        stage_apply = partial(_llama_stage_apply, seq_axis="seq",
+                              positions_table=positions_table)
     body = partial(
         _pipeline_body,
         config=config,
@@ -863,6 +917,100 @@ def llama_pipeline_loss_fn(
     m, b, s, v = logits.shape
     return next_token_nll(
         logits.reshape(m * b, s, v), tokens.reshape(m * b, s)
+    )
+
+
+def zigzag_pipeline_loss_fn(
+    params: Any,
+    tokens: jax.Array,
+    config,
+    pcfg: PipelineConfig,
+    mesh: Mesh,
+    llama: bool = False,
+    attention_fn=None,  # accepted for train.make_train_step's loss seam
+    remat: bool = False,
+) -> jax.Array:
+    """The zig-zag (load-balanced causal sp) objective through the
+    GPipe pipeline: natural-order ``[M, B_m, S]`` tokens are permuted
+    into the zig-zag layout with static index gathers, the stages run
+    :func:`_stage_zigzag_attention` (every seq shard owns one early and
+    one late chunk, so each ring hop computes identical half-block
+    work), positions ride permuted (gpt: ``pos_embed[perm]``; llama:
+    a per-shard RoPE position table), and the loss is the
+    permuted-order next-token NLL — same value as
+    :func:`pipeline_loss_fn` / :func:`llama_pipeline_loss_fn` on the
+    same batch (pinned by test; the permutation reorders terms of the
+    same mean).  GPipe only (autodiff backward); sliding windows are
+    rejected like the flat zig-zag objective (the permuted blocks have
+    no banded form)."""
+    from .zigzag import zigzag_permutation
+
+    if pcfg.schedule != "gpipe":
+        raise ValueError(
+            "the zig-zag pipeline objective runs the gpipe schedule only"
+        )
+    if getattr(config, "sliding_window", None) is not None:
+        raise ValueError(
+            "sliding_window does not compose with the zig-zag schedule; "
+            "use plain pp x sp (windowed ring attention inside stages)"
+        )
+    n_micro, b, seq = tokens.shape
+    sp = mesh.shape.get("seq", 1)
+    if sp < 2:
+        raise ValueError(
+            "the zig-zag pipeline objective needs a (pipe, data, seq) "
+            "mesh with seq >= 2"
+        )
+    perm = zigzag_permutation(seq, sp)
+    perm_j = jnp.asarray(perm)
+    tokens_zz = tokens[:, :, perm_j]
+    next_tokens = jnp.concatenate(
+        [tokens[:, :, 1:], jnp.zeros_like(tokens[:, :, :1])], axis=2
+    )
+    targets_zz = next_tokens[:, :, perm_j]
+    valid = jnp.asarray(perm < seq - 1)[None, None, :]
+
+    attend = _stage_zigzag_attention(mesh)
+    if llama:
+        logits = llama_pipeline_forward(
+            params, tokens_zz, config, pcfg, mesh, remat=remat,
+            stage_attention=attend,
+            positions_table=perm_j.reshape(sp, seq // sp),
+        )
+    else:
+        logits = pipeline_forward(
+            params, tokens_zz, config, pcfg, mesh, remat=remat,
+            stage_attention=attend, positions=perm_j,
+        )
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        log_probs, targets_zz[..., None], axis=-1
+    )[..., 0]
+    return jnp.sum(nll * valid) / (n_micro * b * (seq - 1))
+
+
+def make_zigzag_pipeline_train_step(
+    mesh: Mesh,
+    config,
+    pcfg: PipelineConfig,
+    train_config,
+    state: dict,
+    llama: bool = False,
+):
+    """Compile one pp x dp x sp optimizer step on the zig-zag objective
+    (:func:`zigzag_pipeline_loss_fn`) — the same
+    :func:`.train.make_train_step` seams every pipeline step uses."""
+    from .train import make_train_step
+
+    return make_train_step(
+        mesh, config, train_config, state,
+        loss=partial(
+            zigzag_pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh,
+            llama=llama, remat=getattr(train_config, "remat", False),
+        ),
+        state_shardings_fn=pipeline_state_shardings,
+        batch_sharding_fn=pipeline_batch_sharding,
+        accum_axis=1,
     )
 
 

@@ -219,12 +219,30 @@ def train(args) -> dict:
     pipe = args.pipe_parallel
     if pipe > 1:
         # the pipelined stack (either family) runs over a dedicated
-        # ("pipe","data"[,"model"|"seq"]) mesh; zigzag doesn't compose
-        # with it (yet) and fails fast rather than silently ignore flags
+        # ("pipe","data"[,"model"|"seq"]) mesh
         if args.zigzag:
-            raise SystemExit(
-                "--pipe-parallel does not combine with --zigzag"
-            )
+            # zig-zag inside the GPipe stages: load-balanced causal sp
+            # (zigzag_pipeline_loss_fn); the combos its objective cannot
+            # express fail fast rather than silently ignore flags
+            if args.seq_parallel < 2:
+                raise SystemExit(
+                    "--zigzag with --pipe-parallel needs "
+                    "--seq-parallel >= 2"
+                )
+            if args.pipe_schedule != "gpipe":
+                raise SystemExit(
+                    "--zigzag with --pipe-parallel supports "
+                    "--pipe-schedule gpipe only"
+                )
+            for flag, bad in (("--moe", args.moe),
+                              ("--lora-rank", bool(args.lora_rank)),
+                              ("--sliding-window",
+                               bool(args.sliding_window))):
+                if bad:
+                    raise SystemExit(
+                        f"--zigzag with --pipe-parallel does not combine "
+                        f"with {flag}"
+                    )
         if args.batch_size % args.pipe_microbatches:
             raise SystemExit(
                 f"--batch-size {args.batch_size} not divisible by "
@@ -726,9 +744,15 @@ def train(args) -> dict:
             make_llama_pipeline_train_step,
             make_moe_pipeline_train_step,
             make_pipeline_train_step,
+            make_zigzag_pipeline_train_step,
         )
 
-        if args.moe:
+        if args.zigzag:
+            step_fn = make_zigzag_pipeline_train_step(
+                mesh, model_config, pipe_config, train_config, state,
+                llama=args.family == "llama",
+            )
+        elif args.moe:
             step_fn = make_moe_pipeline_train_step(
                 mesh, model_config, moe_config, pipe_config, train_config,
                 state, llama=args.family == "llama",
@@ -789,9 +813,17 @@ def train(args) -> dict:
                 llama_pipeline_loss_fn,
                 moe_pipeline_loss_fn,
                 pipeline_loss_fn,
+                zigzag_pipeline_loss_fn,
             )
 
-            if args.moe:
+            if args.zigzag:
+                # permuted-order objective, same value as the natural one
+                pp_eval = _partial(
+                    zigzag_pipeline_loss_fn, config=model_config,
+                    pcfg=pipe_config, mesh=mesh,
+                    llama=args.family == "llama",
+                )
+            elif args.moe:
                 # pure LM NLL through the pipelined routed forward
                 pp_eval = _partial(
                     moe_pipeline_loss_fn, config=model_config,
