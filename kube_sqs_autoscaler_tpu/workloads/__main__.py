@@ -196,8 +196,6 @@ def main(argv=None) -> None:
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--model-parallel", bool(args.model_parallel)),
             ("--beams > 1", args.beams > 1),
-            ("--speculative-draft-layers",
-             bool(args.speculative_draft_layers)),
             ("--quantize-kv", args.quantize_kv),
         ):
             if bad:
@@ -535,13 +533,14 @@ def main(argv=None) -> None:
                 f"[1, n_layers-1] (model has n_layers="
                 f"{model_config.n_layers})"
             )
-        budget = args.seq_len + args.generate_tokens + 2 * k
+        budget = (len(prefix_ids) + args.seq_len + args.generate_tokens
+                  + 2 * k)
         if budget > model_config.max_seq_len:
             # fail at startup, not at first-batch trace time inside the
             # worker's never-dies retry loop
             raise SystemExit(
-                f"seq_len + generate_tokens + 2*draft_tokens = {budget} "
-                f"exceeds the model's max_seq_len="
+                f"prefix + seq_len + generate_tokens + 2*draft_tokens = "
+                f"{budget} exceeds the model's max_seq_len="
                 f"{model_config.max_seq_len} (the speculative cache "
                 "budget); lower --speculative-draft-tokens or the lengths"
             )
@@ -570,8 +569,15 @@ def main(argv=None) -> None:
                 )
             )
         else:
-            from .speculative import speculative_generate_jit
+            from .speculative import (
+                draft_prefix_from_target,
+                speculative_generate_jit,
+            )
 
+            spec_draft_pc = (
+                draft_prefix_from_target(prefix_cache, n_draft)
+                if prefix_cache is not None else None
+            )
             worker_kwargs["generate_fn"] = (
                 lambda p, t, n, lengths: speculative_generate_jit(
                     p, model_config,
@@ -583,6 +589,8 @@ def main(argv=None) -> None:
                     top_k=args.top_k, top_p=args.top_p,
                     eos_id=service_config.eos_id,
                     quantized_cache=service_config.quantized_kv,
+                    prefix_cache=prefix_cache,
+                    draft_prefix_cache=spec_draft_pc,
                 )
             )
         log.info(

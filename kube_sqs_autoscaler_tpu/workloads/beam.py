@@ -65,7 +65,7 @@ def beam_search(
             f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
             f"max_seq_len={config.max_seq_len}"
         )
-    prefill_fn, step_fn, _ = _family_ops(config)
+    prefill_fn, step_fn, _, _ = _family_ops(config)
     width = beams
     rows = jnp.arange(batch)
 

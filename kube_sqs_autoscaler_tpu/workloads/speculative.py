@@ -57,7 +57,8 @@ from .model import ModelConfig
 
 
 def _family_ops(config, quantized_cache: bool = False):
-    """(prefill, decode_step, chunk_decode) for the config's family —
+    """(prefill, decode_step, chunk_decode, prefill_with_prefix) for
+    the config's family —
     llama configs (they carry ``n_kv_heads``) get the GQA/RoPE cache ops,
     everything else the gpt-family ops.  Target and draft dispatch
     independently, so a llama target can use a gpt draft and vice versa
@@ -69,6 +70,8 @@ def _family_ops(config, quantized_cache: bool = False):
     caches still equals plain quantized greedy decode token for token
     (up to argmax ties)."""
     if hasattr(config, "n_kv_heads"):
+        from .llama import llama_prefill_with_prefix
+
         if quantized_cache:
             from .llama import (
                 llama_quantized_chunk_decode,
@@ -77,7 +80,7 @@ def _family_ops(config, quantized_cache: bool = False):
             )
 
             return (llama_quantized_prefill, llama_quantized_decode_step,
-                    llama_quantized_chunk_decode)
+                    llama_quantized_chunk_decode, llama_prefill_with_prefix)
         from .llama import (
             llama_chunk_decode,
             llama_decode_step,
@@ -86,7 +89,10 @@ def _family_ops(config, quantized_cache: bool = False):
 
         # llama_prefill's (params, tokens, config, prompt_attention,
         # lengths) lines up with the gpt prefill call shape directly
-        return llama_prefill, llama_decode_step, llama_chunk_decode
+        return (llama_prefill, llama_decode_step, llama_chunk_decode,
+                llama_prefill_with_prefix)
+    from .decode import prefill_with_prefix
+
     if quantized_cache:
         from .decode import (
             quantized_chunk_decode,
@@ -94,8 +100,20 @@ def _family_ops(config, quantized_cache: bool = False):
             quantized_prefill,
         )
 
-        return quantized_prefill, quantized_decode_step, quantized_chunk_decode
-    return prefill, decode_step, chunk_decode
+        return (quantized_prefill, quantized_decode_step,
+                quantized_chunk_decode, prefill_with_prefix)
+    return prefill, decode_step, chunk_decode, prefill_with_prefix
+
+
+def draft_prefix_from_target(prefix_cache: dict, n_layers: int) -> dict:
+    """The early-exit self-draft's prefix cache, for free: the draft IS
+    the target's first ``n_layers``, so its prefix KV is the layer-wise
+    slice of the target's already-computed prefix cache — no second
+    prefix prefill."""
+    return {
+        "layers": prefix_cache["layers"][:n_layers],
+        "length": prefix_cache["length"],
+    }
 
 
 def _warp(logits, temperature: float, top_k: int, top_p: float):
@@ -176,6 +194,8 @@ def speculative_generate(
     top_p: float = 1.0,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
+    draft_prefix_cache: dict | None = None,
 ) -> jax.Array:
     """Greedy generation through the draft-and-verify loop — or, with
     ``temperature > 0`` (and ``rng``), full *speculative sampling*: the
@@ -203,6 +223,14 @@ def speculative_generate(
     or verify work charged to it) and its later positions are pinned to
     the id — the pre-eos prefix is untouched, so greedy speculative with
     eos still equals plain greedy generate with eos token for token.
+
+    ``prefix_cache``/``draft_prefix_cache`` (both or neither): each
+    model continues its suffix prompts from a shared, once-prefilled
+    prefix (:func:`.decode.prefill_prefix`); an early-exit self-draft
+    gets its prefix cache for free via
+    :func:`draft_prefix_from_target`.  The speculative loop itself is
+    length-based and cache-agnostic, so everything downstream of the
+    prefill is unchanged.
     """
     if config_target.vocab_size != config_draft.vocab_size:
         raise ValueError(
@@ -214,17 +242,35 @@ def speculative_generate(
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    if (prefix_cache is None) != (draft_prefix_cache is None):
+        raise ValueError(
+            "prefix_cache and draft_prefix_cache come together (the "
+            "draft model needs its own prefix KV — "
+            "draft_prefix_from_target slices it for a self-draft)"
+        )
+    if prefix_cache is not None and quantized_cache:
+        raise ValueError(
+            "prefix_cache does not combine with quantized_cache (the "
+            "prefix is prefilled into the bf16 cache layout)"
+        )
     # worst-case cache position: a row can overshoot num_tokens by up to
     # k when it freezes (count <= num_tokens + k -> frozen length up to
     # prompt + num_tokens + k - 1), and each later round still writes k
     # masked slots past that length — so both caches need
-    # prompt + num_tokens + 2k positions
-    budget = prompt_len + num_tokens + 2 * draft_tokens
+    # prefix + prompt + num_tokens + 2k positions
+    from .decode import _concrete_prefix_len
+
+    prefix_len = (
+        _concrete_prefix_len(prefix_cache) or 0
+        if prefix_cache is not None else 0
+    )
+    budget = prefix_len + prompt_len + num_tokens + 2 * draft_tokens
     for name, config in (("target", config_target), ("draft", config_draft)):
         if budget > config.max_seq_len:
             raise ValueError(
-                f"prompt ({prompt_len}) + num_tokens ({num_tokens}) + "
-                f"2x draft window ({2 * draft_tokens}) exceeds the {name} "
+                f"prefix ({prefix_len}) + prompt ({prompt_len}) + "
+                f"num_tokens ({num_tokens}) + 2x draft window "
+                f"({2 * draft_tokens}) exceeds the {name} "
                 f"model's max_seq_len={config.max_seq_len}"
             )
 
@@ -238,15 +284,28 @@ def speculative_generate(
 
     k = draft_tokens
     rows = jnp.arange(batch)
-    t_prefill, t_step, t_chunk = _family_ops(config_target,
-                                             quantized_cache)
-    d_prefill, d_step, _ = _family_ops(config_draft, quantized_cache)
-    t_logits, t_cache = t_prefill(
-        params_target, prompt, config_target, attention_fn, lengths=lengths
-    )
-    _, d_cache = d_prefill(
-        params_draft, prompt, config_draft, attention_fn, lengths=lengths
-    )
+    t_prefill, t_step, t_chunk, t_prefix_prefill = _family_ops(
+        config_target, quantized_cache)
+    d_prefill, d_step, _, d_prefix_prefill = _family_ops(
+        config_draft, quantized_cache)
+    if prefix_cache is not None:
+        t_logits, t_cache = t_prefix_prefill(
+            params_target, prefix_cache, prompt, config_target,
+            lengths=lengths,
+        )
+        _, d_cache = d_prefix_prefill(
+            params_draft, draft_prefix_cache, prompt, config_draft,
+            lengths=lengths,
+        )
+    else:
+        t_logits, t_cache = t_prefill(
+            params_target, prompt, config_target, attention_fn,
+            lengths=lengths,
+        )
+        _, d_cache = d_prefill(
+            params_draft, prompt, config_draft, attention_fn,
+            lengths=lengths,
+        )
     if sampled:
         from .decode import _pick
 
@@ -468,6 +527,8 @@ def speculative_generate_jit(
     top_p: float = 1.0,
     eos_id: int | None = None,
     quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
+    draft_prefix_cache: dict | None = None,
 ) -> jax.Array:
     """Compiled :func:`speculative_generate` (one program: prefills +
     the whole while_loop of rounds)."""
@@ -477,4 +538,5 @@ def speculative_generate_jit(
         lengths=lengths, return_stats=return_stats,
         temperature=temperature, rng=rng, top_k=top_k, top_p=top_p,
         eos_id=eos_id, quantized_cache=quantized_cache,
+        prefix_cache=prefix_cache, draft_prefix_cache=draft_prefix_cache,
     )

@@ -207,6 +207,51 @@ def test_worker_binary_continuous_prefix_demo():
           "--prefix-ids", "5,6,7"])
 
 
+def test_speculative_with_prefix_equals_concat(gpt_params):
+    # speculative x prefix: the early-exit self-draft's prefix cache is
+    # the layer slice of the target's; greedy speculative output must
+    # equal plain greedy generate of the CONCATENATED prompts
+    from kube_sqs_autoscaler_tpu.workloads.speculative import (
+        draft_prefix_from_target,
+        speculative_generate,
+    )
+
+    draft_cfg = ModelConfig(
+        vocab_size=TINY.vocab_size, d_model=TINY.d_model,
+        n_heads=TINY.n_heads, n_layers=1, d_ff=TINY.d_ff,
+        max_seq_len=TINY.max_seq_len, dtype=jnp.float32,
+    )
+    draft_params = dict(gpt_params, layers=gpt_params["layers"][:1])
+    prefix = ids((8,), 30)
+    suffix = ids((2, 5), 31)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (2, 8)), suffix], axis=1
+    )
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+    got = speculative_generate(
+        gpt_params, TINY, draft_params, draft_cfg, suffix, 10,
+        draft_tokens=3, prefix_cache=pc,
+        draft_prefix_cache=draft_prefix_from_target(pc, 1),
+    )
+    ref = generate(gpt_params, concat, 10, TINY)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    with pytest.raises(ValueError, match="come together"):
+        speculative_generate(
+            gpt_params, TINY, draft_params, draft_cfg, suffix, 4,
+            prefix_cache=pc,
+        )
+
+
+def test_worker_binary_speculative_prefix_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--prefix-ids", "5,6,7",
+          "--speculative-draft-layers", "1",
+          "--speculative-draft-tokens", "2"])
+
+
 def test_worker_binary_prefix_flag():
     # the serve binary end to end: --prefix-ids prefills once and every
     # demo message decodes as a suffix (both families)
@@ -227,7 +272,6 @@ def test_worker_binary_prefix_combo_rejections():
     for extra, match in (
         (["--quantize-kv"], "quantize-kv"),
         (["--beams", "2"], "beams"),
-        (["--speculative-draft-layers", "1"], "speculative"),
         (["--model-parallel", "1"], "model-parallel"),
     ):
         with pytest.raises(SystemExit, match=match):
