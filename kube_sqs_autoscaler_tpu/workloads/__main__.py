@@ -195,7 +195,6 @@ def main(argv=None) -> None:
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--model-parallel", bool(args.model_parallel)),
-            ("--beams > 1", args.beams > 1),
             ("--quantize-kv", args.quantize_kv),
         ):
             if bad:
@@ -447,7 +446,11 @@ def main(argv=None) -> None:
         else:
             from .decode import prefill_prefix as _pfx_prefill
         prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
-        if not args.continuous:
+        # the plain prefix generate seam serves only when no other
+        # decode mode claims generate_fn below (beam/speculative) or
+        # takes the cache directly (continuous)
+        if (not args.continuous and args.beams == 1
+                and not args.speculative_draft_layers):
             from .service import sampling_keys as _sampling_keys
 
             pfx_keys = _sampling_keys(service_config.sample_seed)
@@ -498,12 +501,14 @@ def main(argv=None) -> None:
             worker_kwargs["generate_fn"] = (
                 # prefill picks the bucket-length flash/dense kernel like
                 # the plain generate paths (memoized factories,
-                # jit-static safe)
+                # jit-static safe); with a prefix the prompts are
+                # suffixes of the once-prefilled cache
                 lambda p, t, n, lengths: beam_search_jit(
                     p, model_config, t, n, args.beams,
                     eos_id=service_config.eos_id,
                     attention_fn=_beam_prefill_attention(t.shape[1]),
                     lengths=lengths,
+                    prefix_cache=prefix_cache,
                 )
             )
         log.info("Beam search: %d beams", args.beams)

@@ -44,6 +44,7 @@ def beam_search(
     attention_fn=None,
     lengths: jax.Array | None = None,
     return_all: bool = False,
+    prefix_cache: dict | None = None,
 ) -> jax.Array:
     """The best continuation of each prompt under beam search.
 
@@ -53,24 +54,30 @@ def beam_search(
     decoding.  ``eos_id`` (optional) ends a beam when it emits that id:
     the beam's score freezes and it pads with ``eos_id``; scores are
     length-normalized by each beam's finished length when
-    ``length_penalty > 0``.
+    ``length_penalty > 0``.  ``prefix_cache`` (from
+    :func:`.decode.prefill_prefix`) makes the prompts per-request
+    suffixes of a shared, once-prefilled prefix; the beam expansion and
+    steps are cache-agnostic, so the search equals beam search of the
+    concatenated prompts.
     """
+    from .decode import _check_prefix_budget
+
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
     if beams < 1:
         raise ValueError(f"beams must be >= 1, got {beams}")
-    if prompt_len + num_tokens > config.max_seq_len:
-        raise ValueError(
-            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
-            f"max_seq_len={config.max_seq_len}"
-        )
-    prefill_fn, step_fn, _, _ = _family_ops(config)
+    _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
+    prefill_fn, step_fn, _, prefix_prefill = _family_ops(config)
     width = beams
     rows = jnp.arange(batch)
 
-    logits, cache = prefill_fn(params, prompt, config, attention_fn,
-                               lengths=lengths)
+    if prefix_cache is not None:
+        logits, cache = prefix_prefill(params, prefix_cache, prompt,
+                                       config, lengths=lengths)
+    else:
+        logits, cache = prefill_fn(params, prompt, config, attention_fn,
+                                   lengths=lengths)
     logp = jax.nn.log_softmax(logits, axis=-1)  # [B, V]
     vocab = logp.shape[-1]
     # first expansion: the top-W first tokens seed the beams
@@ -207,10 +214,12 @@ def beam_search_jit(
     attention_fn=None,
     lengths: jax.Array | None = None,
     return_all: bool = False,
+    prefix_cache: dict | None = None,
 ):
     """Compiled :func:`beam_search` (prefill + the whole scan)."""
     return beam_search(
         params, config, prompt, num_tokens, beams=beams,
         length_penalty=length_penalty, eos_id=eos_id,
         attention_fn=attention_fn, lengths=lengths, return_all=return_all,
+        prefix_cache=prefix_cache,
     )

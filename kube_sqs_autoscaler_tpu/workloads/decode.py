@@ -651,21 +651,27 @@ def _concrete_prefix_len(prefix_cache: dict) -> int | None:
 
 
 def _check_prefix_budget(
-    prefix_cache: dict | None, prompt_len: int, num_tokens: int, config
+    prefix_cache: dict | None, prompt_len: int, num_tokens: int, config,
+    slack: int = 0, slack_label: str = "", model_name: str = "",
 ) -> None:
-    """The generate-entry bound check both families share: with a
-    prefix the full budget is prefix + prompt + num_tokens; eager
-    callers get the real check (the cache length is concrete), traced
-    callers the partial one (inside jit the bound is the caller's
-    contract — ``__main__`` and ``ContinuousBatcher`` both check it)."""
+    """The generate-entry bound check every decode entry shares: with a
+    prefix the full budget is prefix + prompt + num_tokens (+ ``slack``
+    — the speculative entry passes its 2k draft window, labeled);
+    eager callers get the real check (the cache length is concrete),
+    traced callers the partial one (inside jit the bound is the
+    caller's contract — ``__main__`` and ``ContinuousBatcher`` both
+    check it)."""
     prefix_len = (
         _concrete_prefix_len(prefix_cache) or 0
         if prefix_cache is not None else 0
     )
-    if prefix_len + prompt_len + num_tokens > config.max_seq_len:
+    if prefix_len + prompt_len + num_tokens + slack > config.max_seq_len:
+        extra = f" + {slack_label} ({slack})" if slack else ""
+        owner = f"the {model_name} model's " if model_name else ""
         raise ValueError(
             f"prefix ({prefix_len}) + prompt ({prompt_len}) + num_tokens "
-            f"({num_tokens}) exceeds max_seq_len={config.max_seq_len}"
+            f"({num_tokens}){extra} exceeds "
+            f"{owner}max_seq_len={config.max_seq_len}"
         )
 
 

@@ -258,21 +258,14 @@ def speculative_generate(
     # prompt + num_tokens + k - 1), and each later round still writes k
     # masked slots past that length — so both caches need
     # prefix + prompt + num_tokens + 2k positions
-    from .decode import _concrete_prefix_len
+    from .decode import _check_prefix_budget
 
-    prefix_len = (
-        _concrete_prefix_len(prefix_cache) or 0
-        if prefix_cache is not None else 0
-    )
-    budget = prefix_len + prompt_len + num_tokens + 2 * draft_tokens
     for name, config in (("target", config_target), ("draft", config_draft)):
-        if budget > config.max_seq_len:
-            raise ValueError(
-                f"prefix ({prefix_len}) + prompt ({prompt_len}) + "
-                f"num_tokens ({num_tokens}) + 2x draft window "
-                f"({2 * draft_tokens}) exceeds the {name} "
-                f"model's max_seq_len={config.max_seq_len}"
-            )
+        _check_prefix_budget(
+            prefix_cache, prompt_len, num_tokens, config,
+            slack=2 * draft_tokens, slack_label="2x draft window",
+            model_name=name,
+        )
 
     sampled = temperature > 0.0
     if sampled and rng is None:

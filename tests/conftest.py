@@ -43,3 +43,31 @@ def _pin_jax_platform() -> None:
 
 
 _pin_jax_platform()
+
+
+def _jax_available() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("jax") is not None
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled-executable caches after each test module.
+
+    The suite compiles hundreds of XLA CPU programs across ~40 modules
+    in one process; letting them all stay resident has produced
+    late-run crashes (a SIGSEGV at 91% and a SIGABRT at 65% on
+    otherwise-green tests that pass standalone — accumulated backend
+    state, not test bugs).  Modules rarely share shapes, so clearing
+    between modules costs little recompilation and bounds the resident
+    executable count.
+    """
+    yield
+    if _jax_available():
+        import jax
+
+        jax.clear_caches()

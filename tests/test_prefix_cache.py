@@ -243,6 +243,31 @@ def test_speculative_with_prefix_equals_concat(gpt_params):
         )
 
 
+def test_beam_with_prefix_equals_concat(gpt_params):
+    # beam x prefix: the search over suffixes continued from the cached
+    # prefix must pick exactly the beams of the concatenated prompts
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+
+    prefix = ids((8,), 40)
+    suffix = ids((2, 5), 41)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (2, 8)), suffix], axis=1
+    )
+    pc = prefill_prefix(gpt_params, prefix, TINY)
+    ref = beam_search(gpt_params, TINY, concat, 8, beams=3)
+    got = beam_search(gpt_params, TINY, suffix, 8, beams=3,
+                      prefix_cache=pc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_worker_binary_beam_prefix_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--prefix-ids", "5,6,7",
+          "--beams", "2"])
+
+
 def test_worker_binary_speculative_prefix_demo():
     from kube_sqs_autoscaler_tpu.workloads.__main__ import main
 
@@ -271,7 +296,6 @@ def test_worker_binary_prefix_combo_rejections():
             "--prefix-ids", "1,2"]
     for extra, match in (
         (["--quantize-kv"], "quantize-kv"),
-        (["--beams", "2"], "beams"),
         (["--model-parallel", "1"], "model-parallel"),
     ):
         with pytest.raises(SystemExit, match=match):
