@@ -195,7 +195,9 @@ def main(argv=None) -> None:
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--model-parallel", bool(args.model_parallel)),
-            ("--quantize-kv", args.quantize_kv),
+            ("--quantize-kv with --continuous (the rolling slot machine "
+             "does not take a prefix in the int8 layout)",
+             args.quantize_kv and args.continuous),
         ):
             if bad:
                 raise SystemExit(f"--prefix-ids does not support {flag}")
@@ -442,9 +444,22 @@ def main(argv=None) -> None:
             )
         prefix_arr = jnp.asarray(prefix_ids, jnp.int32)
         if family == "llama":
-            from .llama import llama_prefill_prefix as _pfx_prefill
+            from .llama import (
+                llama_prefill_prefix,
+                llama_quantized_prefill_prefix,
+            )
+
+            _pfx_prefill = (
+                llama_quantized_prefill_prefix if args.quantize_kv
+                else llama_prefill_prefix
+            )
         else:
-            from .decode import prefill_prefix as _pfx_prefill
+            from .decode import prefill_prefix, quantized_prefill_prefix
+
+            _pfx_prefill = (
+                quantized_prefill_prefix if args.quantize_kv
+                else prefill_prefix
+            )
         prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
         # the plain prefix generate seam serves only when no other
         # decode mode claims generate_fn below (beam/speculative) or
@@ -467,6 +482,7 @@ def main(argv=None) -> None:
                     lengths=lengths, top_k=service_config.top_k,
                     top_p=service_config.top_p,
                     eos_id=service_config.eos_id,
+                    quantized_cache=service_config.quantized_kv,
                     prefix_cache=prefix_cache,
                 )
             )

@@ -60,7 +60,7 @@ def beam_search(
     steps are cache-agnostic, so the search equals beam search of the
     concatenated prompts.
     """
-    from .decode import _check_prefix_budget
+    from .decode import _check_prefix_budget, _check_prefix_layout
 
     batch, prompt_len = prompt.shape
     if num_tokens < 1:
@@ -68,6 +68,9 @@ def beam_search(
     if beams < 1:
         raise ValueError(f"beams must be >= 1, got {beams}")
     _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
+    if prefix_cache is not None:
+        # beams decode the full-precision cache only
+        _check_prefix_layout(prefix_cache, False)
     prefill_fn, step_fn, _, prefix_prefill = _family_ops(config)
     width = beams
     rows = jnp.arange(batch)

@@ -296,6 +296,34 @@ def quantized_prefill(
     return logits, quantize_cache(cache)
 
 
+def quantized_prefill_prefix(
+    params: dict, prefix: jax.Array, config: ModelConfig, attention_fn=None
+) -> dict:
+    """:func:`prefill_prefix` in the int8 cache layout — the shared
+    prefix's codes+scales, computed once.  Per-position quantization is
+    position-local, so these codes are bitwise what
+    :func:`quantized_prefill` of any concatenated prompt would write at
+    the same positions."""
+    return _prefill_prefix_impl(quantized_prefill, params, prefix, config,
+                                attention_fn)
+
+
+def quantized_prefill_with_prefix(
+    params: dict,
+    prefix_cache: dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """:func:`prefill_with_prefix` over the int8 cache layout (the
+    prefix cache comes from :func:`quantized_prefill_prefix`; the
+    suffix chunk quantizes its own positions as it writes them)."""
+    return _prefill_with_prefix_impl(
+        quantized_chunk_decode, params, prefix_cache, tokens, config,
+        lengths,
+    )
+
+
 def _quantized_chunk_cached_attention(
     q: jax.Array,
     k_codes: jax.Array,
@@ -551,6 +579,18 @@ def chunk_decode(
 # ---------------------------------------------------------------------------
 
 
+def _prefill_prefix_impl(prefill_fn, params, prefix, config,
+                         attention_fn=None) -> dict:
+    """The one prefix-build wrapper all four family/layout variants
+    share: normalize the prefix to a batch-1 int32 prompt, prefill it
+    with ``prefill_fn``, and return the cache."""
+    prefix = jnp.asarray(prefix, jnp.int32)
+    if prefix.ndim == 1:
+        prefix = prefix[None, :]
+    _, cache = prefill_fn(params, prefix, config, attention_fn)
+    return cache
+
+
 def prefill_prefix(
     params: dict, prefix: jax.Array, config: ModelConfig, attention_fn=None
 ) -> dict:
@@ -566,11 +606,8 @@ def prefill_prefix(
     prefix-cache one (vLLM's shared-prompt case), re-expressed over this
     package's padded-cache layout.
     """
-    prefix = jnp.asarray(prefix, jnp.int32)
-    if prefix.ndim == 1:
-        prefix = prefix[None, :]
-    _, cache = prefill(params, prefix, config, attention_fn)
-    return cache
+    return _prefill_prefix_impl(prefill, params, prefix, config,
+                                attention_fn)
 
 
 def broadcast_prefix(prefix_cache: dict, batch: int) -> dict:
@@ -648,6 +685,22 @@ def _concrete_prefix_len(prefix_cache: dict) -> int | None:
         return int(prefix_cache["length"][0])
     except jax.errors.ConcretizationTypeError:
         return None
+
+
+def _check_prefix_layout(prefix_cache: dict, quantized: bool) -> None:
+    """A prefix cache must match the decode path's layout: int8
+    codes+scales for a quantized decode (:func:`quantized_prefill_prefix`),
+    bf16 k/v otherwise (:func:`prefill_prefix`) — a mismatch would
+    surface as a KeyError deep inside the chunk decoder."""
+    is_quantized = "k_codes" in prefix_cache["layers"][0]
+    if is_quantized != quantized:
+        want = "quantized (int8)" if quantized else "full-precision"
+        got = "quantized (int8)" if is_quantized else "full-precision"
+        raise ValueError(
+            f"prefix cache layout mismatch: this decode path needs a "
+            f"{want} prefix cache but was given a {got} one (build it "
+            f"with the matching prefill_prefix variant)"
+        )
 
 
 def _check_prefix_budget(
@@ -773,11 +826,8 @@ def generate(
     _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
-    if prefix_cache is not None and quantized_cache:
-        raise ValueError(
-            "prefix_cache does not combine with quantized_cache (the "
-            "prefix is prefilled into the bf16 cache layout)"
-        )
+    if prefix_cache is not None:
+        _check_prefix_layout(prefix_cache, quantized_cache)
     keys = (
         jax.random.split(rng, num_tokens)
         if rng is not None
@@ -786,7 +836,9 @@ def generate(
     prefill_fn = quantized_prefill if quantized_cache else prefill
     step_fn = quantized_decode_step if quantized_cache else decode_step
     if prefix_cache is not None:
-        logits, cache = prefill_with_prefix(
+        pf = (quantized_prefill_with_prefix if quantized_cache
+              else prefill_with_prefix)
+        logits, cache = pf(
             params, prefix_cache, prompt, config, lengths=lengths
         )
     else:

@@ -920,11 +920,10 @@ def llama_prefill_prefix(
     twin of :func:`.decode.prefill_prefix` (compact GQA cache; RoPE is
     position-absolute so the cached keys are already rotated for their
     slots)."""
-    prefix = jnp.asarray(prefix, jnp.int32)
-    if prefix.ndim == 1:
-        prefix = prefix[None, :]
-    _, cache = llama_prefill(params, prefix, config, prompt_attention)
-    return cache
+    from .decode import _prefill_prefix_impl
+
+    return _prefill_prefix_impl(llama_prefill, params, prefix, config,
+                                prompt_attention)
 
 
 def llama_prefill_with_prefix(
@@ -943,6 +942,34 @@ def llama_prefill_with_prefix(
 
     return _prefill_with_prefix_impl(
         llama_chunk_decode, params, prefix_cache, tokens, config, lengths
+    )
+
+
+def llama_quantized_prefill_prefix(
+    params: dict, prefix: jax.Array, config: LlamaConfig,
+    prompt_attention=None,
+) -> dict:
+    """:func:`llama_prefill_prefix` in the int8 GQA cache layout."""
+    from .decode import _prefill_prefix_impl
+
+    return _prefill_prefix_impl(llama_quantized_prefill, params, prefix,
+                                config, prompt_attention)
+
+
+def llama_quantized_prefill_with_prefix(
+    params: dict,
+    prefix_cache: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    lengths: jax.Array | None = None,
+) -> tuple[jax.Array, dict]:
+    """:func:`llama_prefill_with_prefix` over the int8 GQA cache
+    layout."""
+    from .decode import _prefill_with_prefix_impl
+
+    return _prefill_with_prefix_impl(
+        llama_quantized_chunk_decode, params, prefix_cache, tokens,
+        config, lengths,
     )
 
 
@@ -990,11 +1017,15 @@ def llama_generate(
             "rolling and quantized_cache do not compose (the ring's slot "
             "arithmetic is a full-precision layout); pick one"
         )
-    if prefix_cache is not None and (rolling or quantized_cache):
-        raise ValueError(
-            "prefix_cache rides the full-precision padded cache layout; "
-            "it does not combine with rolling or quantized_cache"
-        )
+    if prefix_cache is not None:
+        if rolling:
+            raise ValueError(
+                "prefix_cache rides the padded cache layout; it does not "
+                "combine with the rolling-buffer cache"
+            )
+        from .decode import _check_prefix_layout
+
+        _check_prefix_layout(prefix_cache, quantized_cache)
     keys = (
         jax.random.split(rng, num_tokens)
         if rng is not None
@@ -1007,7 +1038,9 @@ def llama_generate(
         prefill_fn = llama_rolling_prefill if rolling else llama_prefill
         step_fn = llama_rolling_decode_step if rolling else llama_decode_step
     if prefix_cache is not None:
-        logits, cache = llama_prefill_with_prefix(
+        pf = (llama_quantized_prefill_with_prefix if quantized_cache
+              else llama_prefill_with_prefix)
+        logits, cache = pf(
             params, prefix_cache, prompt, config, lengths=lengths
         )
     else:

@@ -79,8 +79,11 @@ def _family_ops(config, quantized_cache: bool = False):
                 llama_quantized_prefill,
             )
 
+            from .llama import llama_quantized_prefill_with_prefix
+
             return (llama_quantized_prefill, llama_quantized_decode_step,
-                    llama_quantized_chunk_decode, llama_prefill_with_prefix)
+                    llama_quantized_chunk_decode,
+                    llama_quantized_prefill_with_prefix)
         from .llama import (
             llama_chunk_decode,
             llama_decode_step,
@@ -100,8 +103,10 @@ def _family_ops(config, quantized_cache: bool = False):
             quantized_prefill,
         )
 
+        from .decode import quantized_prefill_with_prefix
+
         return (quantized_prefill, quantized_decode_step,
-                quantized_chunk_decode, prefill_with_prefix)
+                quantized_chunk_decode, quantized_prefill_with_prefix)
     return prefill, decode_step, chunk_decode, prefill_with_prefix
 
 
@@ -248,11 +253,11 @@ def speculative_generate(
             "draft model needs its own prefix KV — "
             "draft_prefix_from_target slices it for a self-draft)"
         )
-    if prefix_cache is not None and quantized_cache:
-        raise ValueError(
-            "prefix_cache does not combine with quantized_cache (the "
-            "prefix is prefilled into the bf16 cache layout)"
-        )
+    if prefix_cache is not None:
+        from .decode import _check_prefix_layout
+
+        _check_prefix_layout(prefix_cache, quantized_cache)
+        _check_prefix_layout(draft_prefix_cache, quantized_cache)
     # worst-case cache position: a row can overshoot num_tokens by up to
     # k when it freezes (count <= num_tokens + k -> frozen length up to
     # prompt + num_tokens + k - 1), and each later round still writes k

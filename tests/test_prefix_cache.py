@@ -295,7 +295,7 @@ def test_worker_binary_prefix_combo_rejections():
     base = ["--demo", "1", "--seq-len", "8", "--generate-tokens", "4",
             "--prefix-ids", "1,2"]
     for extra, match in (
-        (["--quantize-kv"], "quantize-kv"),
+        (["--quantize-kv", "--continuous"], "quantize-kv"),
         (["--model-parallel", "1"], "model-parallel"),
     ):
         with pytest.raises(SystemExit, match=match):
@@ -308,12 +308,60 @@ def test_worker_binary_prefix_combo_rejections():
         main(base[:-1] + ["9999999"])
 
 
+def test_quantized_prefix_equals_quantized_concat(gpt_params, llama_params):
+    # int8 KV x prefix: per-position quantization is position-local, so
+    # the prefix's codes are bitwise what the concat prefill writes —
+    # quantized decode from a quantized prefix equals quantized decode
+    # of the concatenated prompts, both families
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        quantized_prefill_prefix,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.llama import (
+        llama_quantized_prefill_prefix,
+    )
+
+    prefix = ids((8,), 50)
+    suffix = ids((2, 5), 51)
+    concat = jnp.concatenate(
+        [jnp.broadcast_to(prefix, (2, 8)), suffix], axis=1
+    )
+    qpc = quantized_prefill_prefix(gpt_params, prefix, TINY)
+    ref = generate(gpt_params, concat, 8, TINY, quantized_cache=True)
+    got = generate(gpt_params, suffix, 8, TINY, quantized_cache=True,
+                   prefix_cache=qpc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    lqpc = llama_quantized_prefill_prefix(llama_params, prefix, TINY_LLAMA)
+    lref = llama_generate(llama_params, concat, 8, TINY_LLAMA,
+                          quantized_cache=True)
+    lgot = llama_generate(llama_params, suffix, 8, TINY_LLAMA,
+                          quantized_cache=True, prefix_cache=lqpc)
+    np.testing.assert_array_equal(np.asarray(lgot), np.asarray(lref))
+
+
+def test_worker_binary_quantized_prefix_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--prefix-ids", "5,6,7",
+          "--quantize-kv"])
+
+
 def test_prefix_rejects_other_cache_layouts(gpt_params, llama_params):
+    # a prefix cache must match the decode path's layout (bf16 prefix
+    # into a quantized decode and vice versa fail loudly)
     pc = prefill_prefix(gpt_params, ids((4,), 12), TINY)
-    with pytest.raises(ValueError, match="quantized_cache"):
+    with pytest.raises(ValueError, match="layout mismatch"):
         generate(gpt_params, ids((2, 3), 13), 4, TINY, prefix_cache=pc,
                  quantized_cache=True)
     lpc = llama_prefill_prefix(llama_params, ids((4,), 14), TINY_LLAMA)
-    with pytest.raises(ValueError, match="prefix_cache"):
+    with pytest.raises(ValueError, match="layout mismatch"):
         llama_generate(llama_params, ids((2, 3), 15), 4, TINY_LLAMA,
                        prefix_cache=lpc, quantized_cache=True)
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        quantized_prefill_prefix,
+    )
+
+    qpc = quantized_prefill_prefix(gpt_params, ids((4,), 16), TINY)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        generate(gpt_params, ids((2, 3), 17), 4, TINY, prefix_cache=qpc)
