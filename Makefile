@@ -4,7 +4,7 @@
 .PHONY: test clean compile build push bench workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
-VERSION=v0.3.0
+VERSION=v0.4.0
 
 test:
 	python -m pytest tests/ -x -q
