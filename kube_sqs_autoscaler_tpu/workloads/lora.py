@@ -42,9 +42,13 @@ import jax.numpy as jnp
 
 # per-family default adaptation targets: the attention projections (the
 # LoRA paper's choice) plus the MLP matmuls — every 2-D weight the block
-# multiplies by
+# multiplies by — and the MoE expert stacks (3-D ``[E, in, out]``, which
+# get PER-EXPERT rank-r factors; the router stays frozen deliberately:
+# adapting it changes the discrete dispatch, the standard MoE
+# fine-tuning practice keeps routing fixed)
 DEFAULT_TARGETS = (
     "wq", "wkv", "wqkv", "wo", "w_up", "w_down", "w_gate_up",
+    "w_up_experts", "w_down_experts", "w_gate_up_experts",
 )
 
 
@@ -69,29 +73,35 @@ class LoraConfig:
 def init_lora_params(
     rng: jax.Array, params: dict, config: LoraConfig
 ) -> dict:
-    """Adapters for every targeted 2-D weight in ``params["layers"]``.
+    """Adapters for every targeted weight in ``params["layers"]``.
 
     Returns ``{"layers": [{name: {"a": [in, r], "b": [r, out]}, ...},
     ...]}`` in fp32 (adapters are tiny; fp32 keeps the update math
-    exact).  ``B = 0`` start: ``apply_lora(params, adapters) == params``.
+    exact).  3-D expert stacks ``[E, in, out]`` get per-expert factors
+    ``a [E, in, r]`` / ``b [E, r, out]`` (same leading-axis batching as
+    the pipeline stage adapters).  ``B = 0`` start:
+    ``apply_lora(params, adapters) == params``.
     """
     layers = []
     for i, layer in enumerate(params["layers"]):
         adapters = {}
         for t, name in enumerate(config.targets):
             w = layer.get(name)
-            if w is None or w.ndim != 2:
+            if w is None or w.ndim not in (2, 3):
                 continue
             # fold in the stable (layer, target-index) pair — hash(name)
             # would be salted per process and break seed reproducibility
             key = jax.random.fold_in(jax.random.fold_in(rng, i), t)
+            lead = w.shape[:-2]  # () for 2-D, (E,) for expert stacks
             adapters[name] = {
                 "a": (
-                    jax.random.normal(key, (w.shape[0], config.rank),
-                                      jnp.float32)
+                    jax.random.normal(
+                        key, (*lead, w.shape[-2], config.rank), jnp.float32
+                    )
                     / config.rank
                 ),
-                "b": jnp.zeros((config.rank, w.shape[1]), jnp.float32),
+                "b": jnp.zeros((*lead, config.rank, w.shape[-1]),
+                               jnp.float32),
             }
         if not adapters:
             raise ValueError(
@@ -113,7 +123,12 @@ def apply_lora(params: dict, adapters: dict, config: LoraConfig) -> dict:
         merged = dict(layer)
         for name, ab in adapter.items():
             w = layer[name]
-            delta = (ab["a"] @ ab["b"]) * config.scale
+            # matmul over the trailing two axes; any leading axis (the
+            # expert stack's E) batches through
+            delta = (
+                jnp.einsum("...ir,...ro->...io", ab["a"], ab["b"])
+                * config.scale
+            )
             merged[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
         merged_layers.append(merged)
     return dict(params, layers=merged_layers)

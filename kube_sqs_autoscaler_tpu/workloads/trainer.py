@@ -285,14 +285,22 @@ def train(args) -> dict:
             "Mistral import brings its own)"
         )
     if args.lora_rank:
-        # adapters wrap dense 2-D weights — flat or stage-stacked; only
-        # MoE's expert stacks (3-D routed weights) are out of scope.
-        # Resume, grad-accum, zig-zag (permutes the batch, not the
-        # params), and pipelines under BOTH schedules compose (1F1B's
-        # stage-weight gradients chain-rule into adapter gradients —
-        # lora.lora_pipeline_value_and_grad).
-        if args.moe:
-            raise SystemExit("--lora-rank does not combine with --moe")
+        # adapters wrap every targeted matmul weight — flat 2-D,
+        # stage-stacked, or 3-D expert stacks (per-expert factors; the
+        # router stays frozen).  Resume, grad-accum, zig-zag (permutes
+        # the batch, not the params), pipelines under BOTH schedules
+        # (1F1B chain-rules stage grads into adapter grads), and flat
+        # MoE all compose; the moe x {zigzag, pipeline} lora
+        # combinations are out of scope and fail fast.
+        if args.moe and args.zigzag:
+            raise SystemExit(
+                "--lora-rank with --moe does not combine with --zigzag"
+            )
+        if args.moe and pipe > 1:
+            raise SystemExit(
+                "--lora-rank with --moe does not combine with "
+                "--pipe-parallel"
+            )
     if args.hf_checkpoint:
         if args.moe:
             raise SystemExit(
@@ -452,6 +460,16 @@ def train(args) -> dict:
                         train_config, n_stages=pipe,
                     )
                 state = place_pipeline_state(mesh, fresh)
+        elif args.moe and args.lora_rank:
+            # frozen routed base, params only (adapters get per-expert
+            # factors; see the lora combo checks above)
+            from .moe import init_llama_moe_params
+
+            state = _lora_base_state(
+                mesh,
+                init_llama_moe_params(jax.random.key(args.seed),
+                                      model_config, moe_config),
+            )
         elif args.moe:
             from .moe import init_llama_moe_train_state
 
@@ -530,6 +548,15 @@ def train(args) -> dict:
                         train_config, n_stages=pipe,
                     )
                 state = place_pipeline_state(mesh, fresh)
+        elif args.moe and args.lora_rank:
+            # frozen routed base, params only (see llama branch)
+            from .moe import init_moe_params
+
+            state = _lora_base_state(
+                mesh,
+                init_moe_params(jax.random.key(args.seed), model_config,
+                                moe_config),
+            )
         elif args.moe:
             from .moe import init_moe_train_state
 
@@ -630,6 +657,13 @@ def train(args) -> dict:
                       "top_k": args.moe_top_k}
             if pipe > 1:
                 layout["pipeline_stages"] = pipe
+            if args.lora_rank:
+                # moe-first kind (restore_params must keep refusing to
+                # serve routed weights) + the lora resume record (a
+                # different rank or seed must fail loudly, like the
+                # dense lora layout)
+                layout["lora_rank"] = args.lora_rank
+                layout["seed"] = args.seed
         elif args.lora_rank:
             # params on disk are flat MERGED weights (serving reads them
             # unchanged — a pipelined run unstacks before storing); the
@@ -718,6 +752,8 @@ def train(args) -> dict:
             state, lora_cfg, llama=args.family == "llama",
         )
     elif args.lora_rank:
+        from functools import partial as _partial
+
         from .lora import make_lora_train_step
 
         loss = None
@@ -731,6 +767,17 @@ def train(args) -> dict:
                 mesh, model_config, remat=train_config.remat,
                 forward_fn=_family_forward(args.family),
             )
+        elif args.moe:
+            # adapter-only fine-tuning of a frozen routed base: the
+            # routed objective (aux term included) through the same
+            # loss seam; the router stays frozen with the base
+            from .moe import _require_no_remat, llama_moe_loss_fn, moe_loss_fn
+
+            _require_no_remat(train_config)
+            moe_fn = (
+                llama_moe_loss_fn if args.family == "llama" else moe_loss_fn
+            )
+            loss = _partial(moe_fn, config=model_config, moe=moe_config)
         elif args.family == "llama":
             from .llama import llama_mesh_loss
 
@@ -860,9 +907,18 @@ def train(args) -> dict:
             moe_fwd = (
                 llama_moe_forward if args.family == "llama" else moe_forward
             )
+            if args.lora_rank:
+                from .lora import apply_lora
+
+                def moe_eval_params(state):
+                    return apply_lora(lora_frozen, state["adapters"],
+                                      lora_cfg)
+            else:
+                def moe_eval_params(state):
+                    return state["params"]
 
             def eval_fn_impl(state, tokens):
-                logits, _aux = moe_fwd(state["params"], tokens,
+                logits, _aux = moe_fwd(moe_eval_params(state), tokens,
                                        model_config, moe_config, attend)
                 return next_token_nll(logits, tokens)
         elif args.zigzag:
