@@ -89,15 +89,21 @@ def main(argv=None) -> None:
              "--generate-tokens >= 1; both families, sampling/eos/"
              "tokenizer/replies supported; composes with "
              "--model-parallel — slots shard batch-over-data, "
-             "heads-over-model)",
+             "heads-over-model — with --quantize-kv, --prefix-ids, and "
+             "--speculative-draft-layers)",
     )
     parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
         help="speculative decoding with an early-exit self-draft: the "
              "model's own first N layers propose tokens and the full "
-             "model verifies them in one chunk forward (greedy only — "
-             "output identical to plain greedy decode; requires "
-             "--generate-tokens >= 1, single chip)",
+             "model verifies them in one chunk forward (greedy output "
+             "identical to plain greedy decode; --temperature > 0 runs "
+             "full speculative SAMPLING — every emitted token an exact "
+             "warped-target sample; requires --generate-tokens >= 1; "
+             "composes with --continuous (draft-and-verify rounds inside "
+             "the rolling slots, per-slot accept counts), with "
+             "--model-parallel, --quantize-kv, and --prefix-ids (all "
+             "three at once only under --continuous); not with --beams)",
     )
     parser.add_argument(
         "--speculative-draft-tokens", type=int, default=4, metavar="K",
@@ -120,9 +126,10 @@ def main(argv=None) -> None:
         "--quantize-kv", action="store_true",
         help="int8 KV cache: decode streams int8 codes + per-position "
              "scales instead of bf16 k/v (half the cache bytes per "
-             "generated token; requires --generate-tokens >= 1, single "
-             "chip; composes with --continuous — rolling slots store "
-             "int8)",
+             "generated token; requires --generate-tokens >= 1; composes "
+             "with --continuous — rolling slots store int8 — with "
+             "--model-parallel — codes/scales shard by head like the "
+             "bf16 cache — and with --prefix-ids; not with --beams)",
     )
     parser.add_argument(
         "--result-queue-url", default="",
@@ -147,9 +154,11 @@ def main(argv=None) -> None:
              "ONCE at startup and reused by every request: message bodies "
              "become per-request suffixes continuing from the cached "
              "prefix (identical outputs to prepending the prefix to every "
-             "prompt, minus its repeated prefill cost; single chip, "
+             "prompt, minus its repeated prefill cost; "
              "--generate-tokens >= 1; composes with --continuous — slots "
-             "start past the shared prefix)",
+             "start past the shared prefix — with --model-parallel — the "
+             "prefix shards by head over the mesh — and with "
+             "--quantize-kv)",
     )
     parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
@@ -174,8 +183,14 @@ def main(argv=None) -> None:
     if args.quantize_kv:
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
-            ("--model-parallel", bool(args.model_parallel)),
             ("--beams > 1", args.beams > 1),
+            ("--model-parallel with --speculative-draft-layers (the "
+             "sharded speculative factory streams bf16 caches; the "
+             "--continuous slot machine does shard int8 speculative "
+             "slots)",
+             bool(args.model_parallel)
+             and bool(args.speculative_draft_layers)
+             and not args.continuous),
         ):
             if bad:
                 raise SystemExit(f"--quantize-kv does not support {flag}")
@@ -189,15 +204,24 @@ def main(argv=None) -> None:
             raise SystemExit(f"--prefix-ids must be integers ({err})")
         if not prefix_ids:
             raise SystemExit("--prefix-ids is empty")
-        # the prefix rides the single-chip full-precision padded cache;
-        # every other decode layout fails fast (same convention as the
-        # --quantize-kv combo checks above)
+        # the prefix rides the padded cache (bf16 or int8, single-chip
+        # or head-sharded over a (data, model) mesh); the combos whose
+        # decode machinery does not take a prefix fail fast (same
+        # convention as the --quantize-kv combo checks above)
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
-            ("--model-parallel", bool(args.model_parallel)),
             ("--quantize-kv with --continuous (the rolling slot machine "
              "does not take a prefix in the int8 layout)",
              args.quantize_kv and args.continuous),
+            ("--model-parallel with --beams (the sharded beam factory "
+             "takes no prefix)",
+             bool(args.model_parallel) and args.beams > 1),
+            ("--model-parallel with --speculative-draft-layers (the "
+             "sharded speculative factory takes no prefix; the "
+             "--continuous slot machine does take one)",
+             bool(args.model_parallel)
+             and bool(args.speculative_draft_layers)
+             and not args.continuous),
         ):
             if bad:
                 raise SystemExit(f"--prefix-ids does not support {flag}")
@@ -362,6 +386,42 @@ def main(argv=None) -> None:
             log.info("eos_id %d from the tokenizer", service_config.eos_id)
         log.info("Tokenizer: %s (vocab %d)", args.tokenizer, tok_vocab)
 
+    # --- shared prompt prefix: prefilled ONCE, before the serving fns
+    # (the sharded factories pin it into their compiled generate)
+    prefix_cache = None
+    if prefix_ids:
+        import jax.numpy as jnp
+
+        bad = [i for i in prefix_ids if not 0 <= i < model_config.vocab_size]
+        if bad:
+            # JAX gathers clamp out-of-bounds ids on device, so these
+            # would silently prefill garbage
+            raise SystemExit(
+                f"--prefix-ids {bad} out of range for vocab_size="
+                f"{model_config.vocab_size}"
+            )
+        prefix_arr = jnp.asarray(prefix_ids, jnp.int32)
+        if family == "llama":
+            from .llama import (
+                llama_prefill_prefix,
+                llama_quantized_prefill_prefix,
+            )
+
+            _pfx_prefill = (
+                llama_quantized_prefill_prefix if args.quantize_kv
+                else llama_prefill_prefix
+            )
+        else:
+            from .decode import prefill_prefix, quantized_prefill_prefix
+
+            _pfx_prefill = (
+                quantized_prefill_prefix if args.quantize_kv
+                else prefill_prefix
+            )
+        prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
+        log.info("Prefix cache: %d shared tokens prefilled once",
+                 len(prefix_ids))
+
     # --- compute fns: sharded (mesh) or single-chip ----------------------
     worker_kwargs = {}
     if mesh is not None:
@@ -372,12 +432,20 @@ def main(argv=None) -> None:
 
             fwd = make_forward_step(mesh, model_config, params,
                                     forward_fn=llama_forward)
-            _, _, gen = make_llama_serving_fns(mesh, model_config, params)
+            _, _, gen = make_llama_serving_fns(
+                mesh, model_config, params,
+                quantized_cache=args.quantize_kv,
+                prefix_cache=prefix_cache,
+            )
         else:
             from .decode import make_serving_fns
 
             fwd = make_forward_step(mesh, model_config, params)
-            _, _, gen = make_serving_fns(mesh, model_config, params)
+            _, _, gen = make_serving_fns(
+                mesh, model_config, params,
+                quantized_cache=args.quantize_kv,
+                prefix_cache=prefix_cache,
+            )
         from .service import sampling_keys
 
         keys = sampling_keys(service_config.sample_seed)
@@ -425,46 +493,12 @@ def main(argv=None) -> None:
                 quantized_cache=service_config.quantized_kv,
             ),
         }
-    prefix_cache = None
-    if prefix_ids:
-        # prefill the shared prefix ONCE; every batch's bodies are then
-        # suffixes continuing from its cache (the combo checks at the
-        # top left the plain single-chip generate paths and continuous
-        # batching standing — --continuous hands the cache to the slot
-        # machine instead of the generate seam)
-        import jax.numpy as jnp
-
-        bad = [i for i in prefix_ids if not 0 <= i < model_config.vocab_size]
-        if bad:
-            # JAX gathers clamp out-of-bounds ids on device, so these
-            # would silently prefill garbage
-            raise SystemExit(
-                f"--prefix-ids {bad} out of range for vocab_size="
-                f"{model_config.vocab_size}"
-            )
-        prefix_arr = jnp.asarray(prefix_ids, jnp.int32)
-        if family == "llama":
-            from .llama import (
-                llama_prefill_prefix,
-                llama_quantized_prefill_prefix,
-            )
-
-            _pfx_prefill = (
-                llama_quantized_prefill_prefix if args.quantize_kv
-                else llama_prefill_prefix
-            )
-        else:
-            from .decode import prefill_prefix, quantized_prefill_prefix
-
-            _pfx_prefill = (
-                quantized_prefill_prefix if args.quantize_kv
-                else prefill_prefix
-            )
-        prefix_cache = _pfx_prefill(params, prefix_arr, model_config)
-        # the plain prefix generate seam serves only when no other
-        # decode mode claims generate_fn below (beam/speculative) or
-        # takes the cache directly (continuous)
-        if (not args.continuous and args.beams == 1
+    if prefix_cache is not None:
+        # the plain SINGLE-CHIP prefix generate seam serves only when no
+        # other decode mode claims generate_fn below (beam/speculative),
+        # takes the cache directly (continuous), or already pinned the
+        # prefix into its compiled generate (the mesh factories above)
+        if (mesh is None and not args.continuous and args.beams == 1
                 and not args.speculative_draft_layers):
             from .service import sampling_keys as _sampling_keys
 
@@ -486,8 +520,6 @@ def main(argv=None) -> None:
                     prefix_cache=prefix_cache,
                 )
             )
-        log.info("Prefix cache: %d shared tokens prefilled once",
-                 len(prefix_ids))
     if args.beams > 1:
         if mesh is not None:
             # beams over the (data, model) mesh: expanded rows shard over
@@ -534,14 +566,13 @@ def main(argv=None) -> None:
         # Greedy runs are token-identical to plain greedy decode;
         # temperature > 0 runs full speculative sampling (the rejection
         # rule keeps every emitted token an exact warped-target sample).
-        for flag, bad in (
-            ("--continuous", args.continuous),
-            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
-        ):
-            if bad:
-                raise SystemExit(
-                    f"--speculative-draft-layers does not support {flag}"
-                )
+        # --continuous re-hosts the draft-and-verify round inside the
+        # rolling slot machine (per-slot accept counts on the batcher).
+        if args.generate_tokens < 1:
+            raise SystemExit(
+                "--speculative-draft-layers requires "
+                "--generate-tokens >= 1"
+            )
         n_draft = args.speculative_draft_layers
         k = args.speculative_draft_tokens
         if k < 1:
@@ -571,7 +602,11 @@ def main(argv=None) -> None:
 
         draft_config = replace(model_config, n_layers=n_draft)
         spec_keys = sampling_keys(service_config.sample_seed)
-        if mesh is not None:
+        if args.continuous:
+            # the slot machine hosts the round itself (ContinuousWorker
+            # below gets the draft knobs); no generate_fn to wire
+            pass
+        elif mesh is not None:
             # speculative serving over the (data, model) mesh: both
             # models' weights/caches keep their Megatron shardings, rows
             # shard over data (acceptance and rollback are row-local)
@@ -642,12 +677,13 @@ def main(argv=None) -> None:
         if args.continuous:
             from .continuous import ContinuousWorker
 
-            cworker = ContinuousWorker(queue, params, model_config,
-                                       service_config, family=family,
-                                       tokenizer=tokenizer,
-                                       result_queue=result_queue,
-                                       mesh=mesh,
-                                       prefix_cache=prefix_cache)
+            cworker = ContinuousWorker(
+                queue, params, model_config, service_config, family=family,
+                tokenizer=tokenizer, result_queue=result_queue, mesh=mesh,
+                prefix_cache=prefix_cache,
+                draft_layers=args.speculative_draft_layers,
+                draft_tokens=args.speculative_draft_tokens,
+            )
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
@@ -699,6 +735,8 @@ def main(argv=None) -> None:
             # client publishes replies when --result-queue-url is set
             result_queue=(queue if args.result_queue_url else None),
             mesh=mesh,
+            draft_layers=args.speculative_draft_layers,
+            draft_tokens=args.speculative_draft_tokens,
         )
         _maybe_serve_metrics(args.metrics_port, cworker)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)

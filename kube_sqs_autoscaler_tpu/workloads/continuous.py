@@ -89,27 +89,46 @@ def _insert_row_impl(
     overwrites — decode writes at ``length >= prefix_len``), and the
     slot's length starts past the prefix.
     """
+    logits, row_cache = _row_prefill(
+        params, prompt, length, config, family, quantized_kv, prefix_len,
+        prefix_cache,
+    )
+    new_layers = _splice_row_layers(cache, row_cache, row, prefix_len,
+                                    prompt_len)
+    lengths = jax.lax.dynamic_update_index_in_dim(
+        cache["length"], prefix_len + length, row, 0
+    )
+    first = _pick(logits, key, temperature, top_k, top_p)[0]
+    return {"layers": new_layers, "length": lengths}, first
+
+
+def _row_prefill(params, prompt, length, config, family, quantized_kv,
+                 prefix_len, prefix_cache):
+    """One prompt's prefill as a ``[1, P]`` batch through the family's
+    layout variant; returns ``(logits [1, V], row_cache)``."""
     if prefix_len:
         if family == "llama":
             from .llama import llama_prefill_with_prefix as pf
         else:
             from .decode import prefill_with_prefix as pf
-        logits, row_cache = pf(
+        return pf(
             params, prefix_cache, prompt[None], config, lengths=length[None]
         )
-    else:
-        if quantized_kv:
-            if family == "llama":
-                from .llama import llama_quantized_prefill as prefill_fn
-            else:
-                from .decode import quantized_prefill as prefill_fn
-        elif family == "llama":
-            from .llama import llama_prefill as prefill_fn
+    if quantized_kv:
+        if family == "llama":
+            from .llama import llama_quantized_prefill as prefill_fn
         else:
-            prefill_fn = prefill
-        logits, row_cache = prefill_fn(
-            params, prompt[None], config, lengths=length[None]
-        )
+            from .decode import quantized_prefill as prefill_fn
+    elif family == "llama":
+        from .llama import llama_prefill as prefill_fn
+    else:
+        prefill_fn = prefill
+    return prefill_fn(params, prompt[None], config, lengths=length[None])
+
+
+def _splice_row_layers(cache, row_cache, row, prefix_len, prompt_len):
+    """Splice a ``[1, ...]`` row cache's prompt positions into slot
+    ``row`` of the batch cache; returns the new layers list."""
     new_layers = []
     for layer_cache, row_layer in zip(cache["layers"], row_cache["layers"]):
         entry = {}
@@ -124,11 +143,56 @@ def _insert_row_impl(
             start = (row, 0, prefix_len) + (0,) * (buf.ndim - 3)
             entry[name] = jax.lax.dynamic_update_slice(buf, piece, start)
         new_layers.append(entry)
+    return new_layers
+
+
+def _spec_insert_row_impl(
+    params: dict,
+    cache: dict,
+    draft_cache: dict,
+    row: jax.Array,
+    prompt: jax.Array,
+    length: jax.Array,
+    key: jax.Array | None,
+    config: Any,
+    prompt_len: int,
+    draft_layers: int,
+    family: str = "gpt",
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    quantized_kv: bool = False,
+    prefix_len: int = 0,
+    prefix_cache: dict | None = None,
+) -> tuple[dict, dict, jax.Array]:
+    """:func:`_insert_row_impl` for speculative slots: ONE target prefill
+    populates both caches — the early-exit self-draft is the target's
+    first ``draft_layers`` layers, and layer ``i``'s k/v depend only on
+    layers ``< i``, so the draft's row cache is literally the layer-wise
+    prefix of the target's (same trick as
+    :func:`.speculative.draft_prefix_from_target`)."""
+    logits, row_cache = _row_prefill(
+        params, prompt, length, config, family, quantized_kv, prefix_len,
+        prefix_cache,
+    )
+    new_layers = _splice_row_layers(cache, row_cache, row, prefix_len,
+                                    prompt_len)
+    draft_row = {"layers": row_cache["layers"][:draft_layers],
+                 "length": row_cache["length"]}
+    new_draft_layers = _splice_row_layers(draft_cache, draft_row, row,
+                                          prefix_len, prompt_len)
     lengths = jax.lax.dynamic_update_index_in_dim(
         cache["length"], prefix_len + length, row, 0
     )
+    draft_lengths = jax.lax.dynamic_update_index_in_dim(
+        draft_cache["length"], prefix_len + length, row, 0
+    )
     first = _pick(logits, key, temperature, top_k, top_p)[0]
-    return {"layers": new_layers, "length": lengths}, first
+    return (
+        {"layers": new_layers, "length": lengths},
+        {"layers": new_draft_layers, "length": draft_lengths},
+        first,
+    )
 
 
 _insert_row = partial(
@@ -139,6 +203,15 @@ _insert_row = partial(
 )(_insert_row_impl)
 
 
+_spec_insert_row = partial(
+    jax.jit,
+    static_argnames=("config", "prompt_len", "draft_layers", "family",
+                     "temperature", "top_k", "top_p", "quantized_kv",
+                     "prefix_len"),
+    donate_argnums=(1, 2),
+)(_spec_insert_row_impl)
+
+
 @dataclass
 class _Slot:
     busy: bool = False
@@ -146,6 +219,10 @@ class _Slot:
     budget: int = 0
     done: bool = False  # hit eos before the budget (frees this step)
     payload: Any = None  # caller's per-request context (receipt handle...)
+    # speculative slots: per-request verify rounds and accepted drafts
+    # (the serving-side signal for tuning draft_tokens / draft_layers)
+    rounds: int = 0
+    accepted: int = 0
 
 
 class ContinuousBatcher:
@@ -179,28 +256,45 @@ class ContinuousBatcher:
         mesh=None,
         quantized_kv: bool = False,
         prefix_cache: dict | None = None,
+        draft_layers: int = 0,
+        draft_tokens: int = 4,
     ) -> None:
         self.prefix_len = 0
         self._prefix_cache = prefix_cache
         if prefix_cache is not None:
             # slots start past a shared, once-prefilled prefix (see
-            # decode.prefill_prefix); the prefix rides the single-chip
-            # full-precision padded cache layout
+            # decode.prefill_prefix); the prefix rides the full-precision
+            # padded cache layout — single-chip, or head-sharded over a
+            # (data, model) mesh (the broadcast rows land under
+            # cache_shardings in the mesh block below)
             if quantized_kv:
                 raise ValueError(
                     "prefix_cache does not combine with quantized_kv"
                 )
-            if mesh is not None:
-                raise ValueError(
-                    "prefix_cache is single-chip (the broadcast prefix "
-                    "rows are not mesh-sharded)"
-                )
             self.prefix_len = int(prefix_cache["length"][0])
-        if self.prefix_len + prompt_len + generate_tokens > config.max_seq_len:
+        if draft_layers:
+            # speculative slots: early-exit self-draft inside the slot
+            # machine — each engine step is one draft-and-verify round
+            if not 0 < draft_layers < config.n_layers:
+                raise ValueError(
+                    f"draft_layers={draft_layers} must be in "
+                    f"[1, n_layers-1] (model has n_layers="
+                    f"{config.n_layers})"
+                )
+            if draft_tokens < 1:
+                raise ValueError(
+                    f"draft_tokens={draft_tokens} must be >= 1"
+                )
+        # speculative rounds can overshoot a slot's budget by up to k and
+        # still write k+1 masked positions past the frozen length — the
+        # same 2k slack speculative_generate reserves
+        spec_slack = 2 * draft_tokens if draft_layers else 0
+        budget = self.prefix_len + prompt_len + generate_tokens + spec_slack
+        if budget > config.max_seq_len:
+            slack = f" + 2*draft_tokens ({spec_slack})" if spec_slack else ""
             raise ValueError(
-                f"prefix + prompt_len + generate_tokens = "
-                f"{self.prefix_len + prompt_len + generate_tokens} exceeds "
-                f"max_seq_len={config.max_seq_len}"
+                f"prefix + prompt_len + generate_tokens{slack} = "
+                f"{budget} exceeds max_seq_len={config.max_seq_len}"
             )
         if family not in ("gpt", "llama"):
             raise ValueError(f"unknown family {family!r}")
@@ -222,6 +316,11 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.mesh = mesh
         self.quantized_kv = quantized_kv
+        self.draft_layers = draft_layers
+        self.draft_tokens = draft_tokens
+        # aggregate speculative stats (per-request stats ride the slots)
+        self.spec_rounds = 0
+        self.spec_accepted = 0
         if prefix_cache is not None:
             # every slot row starts as a copy of the shared prefix (the
             # broadcast is layout-agnostic: gpt and llama caches both
@@ -246,6 +345,42 @@ class ContinuousBatcher:
             self.cache = init_llama_cache(config, batch_size)
         else:
             self.cache = init_cache(config, batch_size)
+        if draft_layers:
+            # the draft is the target's first layers: its params are a
+            # layer slice, its cache the same layout with fewer layers
+            import dataclasses
+
+            self.draft_config = dataclasses.replace(
+                config, n_layers=draft_layers
+            )
+            self.draft_params = dict(
+                params, layers=params["layers"][:draft_layers]
+            )
+            if prefix_cache is not None:
+                from .decode import broadcast_prefix
+                from .speculative import draft_prefix_from_target
+
+                self.draft_cache = broadcast_prefix(
+                    draft_prefix_from_target(prefix_cache, draft_layers),
+                    batch_size,
+                )
+            elif quantized_kv:
+                from .decode import init_quantized_cache
+
+                self.draft_cache = init_quantized_cache(
+                    self.draft_config, batch_size,
+                    kv_heads=(config.n_kv_heads if family == "llama"
+                              else None),
+                )
+            elif family == "llama":
+                from .llama import init_llama_cache
+
+                self.draft_cache = init_llama_cache(
+                    self.draft_config, batch_size
+                )
+            else:
+                self.draft_cache = init_cache(self.draft_config,
+                                              batch_size)
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token for the next decode step
         self._current = jnp.zeros((batch_size,), jnp.int32)
@@ -270,6 +405,13 @@ class ContinuousBatcher:
             self._rows_shard = NamedSharding(mesh, P("data"))
             self.cache = jax.device_put(self.cache, self._cache_shard)
             self._current = jax.device_put(self._current, self._rows_shard)
+            if draft_layers:
+                self._draft_cache_shard = cache_shardings(
+                    mesh, self.draft_cache
+                )
+                self.draft_cache = jax.device_put(
+                    self.draft_cache, self._draft_cache_shard
+                )
         # one PRNG key per engine step / insert.  Greedy single-chip: no
         # keys at all (the compiled programs take a None operand); under
         # a mesh the pinned in_shardings need a real (ignored) key even
@@ -280,8 +422,12 @@ class ContinuousBatcher:
             self._keys = sampling_keys(sample_seed)
         else:
             self._keys = itertools.repeat(None)
-        self._insert = self._make_insert()
-        self._decode = self._make_decode_step()
+        if draft_layers:
+            self._insert = self._make_spec_insert()
+            self._spec = self._make_spec_round()
+        else:
+            self._insert = self._make_insert()
+            self._decode = self._make_decode_step()
 
     def _make_insert(self):
         statics = dict(
@@ -296,18 +442,47 @@ class ContinuousBatcher:
                 _insert_row(params, cache, row, prompt, length, key,
                             prefix_cache=self._prefix_cache, **statics)
             )
+        return self._mesh_insert_jit(_insert_row_impl, statics,
+                                     (self._cache_shard,))
+
+    def _mesh_insert_jit(self, impl, statics, cache_shards):
+        """The one mesh insert wiring the plain and speculative inserts
+        share: pinned in/out shardings with the cache operands donated,
+        and — under a prefix — the shared batch-1 prefix riding as an
+        explicit trailing operand (heads over "model", batch
+        replicated), injected by a closure so both returned callables
+        keep their prefix-free signature."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .train import param_shardings
 
         rep = NamedSharding(self.mesh, P())
-        return jax.jit(
-            partial(_insert_row_impl, **statics),
-            in_shardings=(param_shardings(self.mesh, self.params),
-                          self._cache_shard, rep, rep, rep, rep),
-            out_shardings=(self._cache_shard, rep),
-            donate_argnums=(1,),
+        p_shard = param_shardings(self.mesh, self.params)
+        scalar_ops = (rep, rep, rep, rep)  # row, prompt, length, key
+        donate = tuple(range(1, 1 + len(cache_shards)))
+        if self._prefix_cache is None:
+            return jax.jit(
+                partial(impl, **statics),
+                in_shardings=(p_shard, *cache_shards, *scalar_ops),
+                out_shardings=(*cache_shards, rep),
+                donate_argnums=donate,
+            )
+        from .decode import prefix_cache_shardings
+
+        pfx_shard = prefix_cache_shardings(self.mesh, self._prefix_cache)
+        placed_prefix = jax.device_put(self._prefix_cache, pfx_shard)
+
+        def _with_prefix(*args):
+            *operands, prefix = args
+            return impl(*operands, prefix_cache=prefix, **statics)
+
+        fn = jax.jit(
+            _with_prefix,
+            in_shardings=(p_shard, *cache_shards, *scalar_ops, pfx_shard),
+            out_shardings=(*cache_shards, rep),
+            donate_argnums=donate,
         )
+        return lambda *operands: fn(*operands, placed_prefix)
 
     def _make_decode_step(self):
         if self.quantized_kv:
@@ -345,6 +520,125 @@ class ContinuousBatcher:
             donate_argnums=(1,),
         )
 
+    def _make_spec_insert(self):
+        statics = dict(
+            config=self.config, prompt_len=self.prompt_len,
+            draft_layers=self.draft_layers,
+            family=self.family, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p,
+            quantized_kv=self.quantized_kv,
+            prefix_len=self.prefix_len,
+        )
+        if self.mesh is None:
+            return lambda params, cache, dcache, row, prompt, length, key: (
+                _spec_insert_row(params, cache, dcache, row, prompt,
+                                 length, key,
+                                 prefix_cache=self._prefix_cache,
+                                 **statics)
+            )
+        return self._mesh_insert_jit(
+            _spec_insert_row_impl, statics,
+            (self._cache_shard, self._draft_cache_shard),
+        )
+
+    def _make_spec_round(self):
+        """One compiled draft-and-verify round over ALL slots: k draft
+        steps + one extra draft consume + one (k+1)-wide target chunk
+        verify, per-row acceptance, per-row length advance gated by the
+        ``active`` mask (inactive slots neither emit nor advance — their
+        chunk writes land in slots their unchanged length keeps masked,
+        the same compute-always discipline as the plain decode step).
+        Exactly :func:`.speculative.speculative_generate`'s round body,
+        re-hosted in the slot machine: greedy rounds emit what plain
+        greedy decode would, sampled rounds apply the Leviathan/Chen
+        acceptance rule so every emitted token is an exact warped-target
+        sample."""
+        from .speculative import _accept_and_fixup, _family_ops, _warp
+
+        _, t_step, t_chunk, _ = _family_ops(self.config, self.quantized_kv)
+        _, d_step, _, _ = _family_ops(self.draft_config, self.quantized_kv)
+        k = self.draft_tokens
+        config, dconfig = self.config, self.draft_config
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        sampled = temperature > 0.0
+
+        def round_fn(params_t, params_d, t_cache, d_cache, pending,
+                     active, key):
+            if sampled:
+                keys = jax.random.split(key, k + 1)
+                accept_key, draft_keys = keys[0], keys[1:]
+            proposals, draft_warped = [], []
+            token = pending
+            dc = d_cache
+            for i in range(k):  # k is small and static — unrolled
+                logits, dc = d_step(params_d, dc, token, dconfig)
+                if sampled:
+                    warped = _warp(logits, temperature, top_k, top_p)
+                    draft_warped.append(warped)
+                    token = jax.random.categorical(
+                        draft_keys[i], warped
+                    ).astype(jnp.int32)
+                else:
+                    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                proposals.append(token)
+            drafts = jnp.stack(proposals, axis=1)  # [B, k]
+            # extra consume of d_k: the draft cache holds every accepted
+            # input even on full acceptance (masked otherwise)
+            _, dc = d_step(params_d, dc, drafts[:, -1], dconfig)
+
+            chunk = jnp.concatenate([pending[:, None], drafts], axis=1)
+            t_len = t_cache["length"]
+            d_len = d_cache["length"]
+            logits, t_adv = t_chunk(params_t, t_cache, chunk, config)
+
+            if sampled:
+                n, bonus = _accept_and_fixup(
+                    accept_key, drafts, jnp.stack(draft_warped, axis=1),
+                    _warp(logits, temperature, top_k, top_p),
+                )
+            else:
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                matches = (drafts == greedy[:, :k]).astype(jnp.int32)
+                n = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+                bonus = jnp.take_along_axis(
+                    greedy, n[:, None], axis=1
+                )[:, 0]
+
+            j = jnp.arange(k + 1)[None, :]
+            round_tokens = jnp.where(
+                j < n[:, None],
+                jnp.pad(drafts, ((0, 0), (0, 1))),
+                bonus[:, None],
+            )
+            advance = jnp.where(active, n + 1, 0)
+            t_cache = dict(t_adv, length=t_len + advance)
+            d_cache = dict(dc, length=d_len + advance)
+            pending_next = jnp.where(active, bonus, pending)
+            return (t_cache, d_cache, pending_next, round_tokens,
+                    jnp.where(active, n, 0))
+
+        if self.mesh is None:
+            return jax.jit(round_fn, donate_argnums=(2, 3))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        p_shard = param_shardings(self.mesh, self.params)
+        p_shard_d = dict(
+            p_shard, layers=p_shard["layers"][:self.draft_layers]
+        )
+        rows_2d = NamedSharding(self.mesh, P("data", None))
+        return jax.jit(
+            round_fn,
+            in_shardings=(p_shard, p_shard_d, self._cache_shard,
+                          self._draft_cache_shard, self._rows_shard,
+                          self._rows_shard, rep),
+            out_shardings=(self._cache_shard, self._draft_cache_shard,
+                           self._rows_shard, rows_2d, self._rows_shard),
+            donate_argnums=(2, 3),
+        )
+
     @property
     def free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if not s.busy]
@@ -367,43 +661,81 @@ class ContinuousBatcher:
         real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
         ids[: real.size] = real
         length = max(1, real.size)
-        self.cache, first = self._insert(
-            self.params, self.cache, jnp.asarray(row, jnp.int32),
-            jnp.asarray(ids), jnp.asarray(length, jnp.int32),
-            next(self._keys),
-        )
+        if self.draft_layers:
+            self.cache, self.draft_cache, first = self._insert(
+                self.params, self.cache, self.draft_cache,
+                jnp.asarray(row, jnp.int32), jnp.asarray(ids),
+                jnp.asarray(length, jnp.int32), next(self._keys),
+            )
+        else:
+            self.cache, first = self._insert(
+                self.params, self.cache, jnp.asarray(row, jnp.int32),
+                jnp.asarray(ids), jnp.asarray(length, jnp.int32),
+                next(self._keys),
+            )
         first = int(first)
         self._current = self._current.at[row].set(first)
-        slot = self.slots[row]
-        slot.busy = True
-        slot.produced = [first]
-        slot.budget = self.generate_tokens
-        slot.done = self.eos_id is not None and first == self.eos_id
-        slot.payload = payload
+        # a fresh record per request: step() replaces finished slots with
+        # new _Slot()s, but resetting here keeps the per-request
+        # rounds/accepted contract independent of that cleanup path
+        slot = _Slot(
+            busy=True, produced=[first], budget=self.generate_tokens,
+            done=self.eos_id is not None and first == self.eos_id,
+            payload=payload,
+        )
+        self.slots[row] = slot
         return row
 
     def _needs_decode(self, slot: _Slot) -> bool:
         return slot.busy and not slot.done and len(slot.produced) < slot.budget
 
     def step(self) -> list[tuple[Any, np.ndarray]]:
-        """Advance every active slot one token; return finished requests
-        as ``(payload, continuation_tokens)`` pairs (their slots are free
-        again on return).  Finished = budget reached or eos emitted;
-        either way the tokens are padded with ``eos_id`` to the budget
-        (matching ``generate``'s post-eos padding).  No-op when nothing
-        is active."""
+        """Advance every active slot; return finished requests as
+        ``(payload, continuation_tokens)`` pairs (their slots are free
+        again on return).  Plain slots advance ONE token per step;
+        speculative slots (``draft_layers > 0``) advance 1..k+1 tokens —
+        one draft-and-verify round.  Finished = budget reached or eos
+        emitted; either way the tokens are padded with ``eos_id`` to the
+        budget (matching ``generate``'s post-eos padding).  No-op when
+        nothing is active."""
         if self.active == 0:
             return []
         finished = []
+        needs = [self._needs_decode(s) for s in self.slots]
         # rows whose budget is a single token (or that already hit eos)
         # never need a decode step
-        if any(self._needs_decode(s) for s in self.slots):
+        if self.draft_layers and any(needs):
+            active = jnp.asarray(needs)
+            if self.mesh is not None:
+                active = jax.device_put(active, self._rows_shard)
+            (self.cache, self.draft_cache, self._current, round_tokens,
+             n) = self._spec(
+                self.params, self.draft_params, self.cache,
+                self.draft_cache, self._current, active, next(self._keys),
+            )
+            toks_host = np.asarray(round_tokens)
+            n_host = np.asarray(n)
+            for row, slot in enumerate(self.slots):
+                if not needs[row]:
+                    continue
+                slot.rounds += 1
+                slot.accepted += int(n_host[row])
+                self.spec_rounds += 1
+                self.spec_accepted += int(n_host[row])
+                for token in toks_host[row, : int(n_host[row]) + 1]:
+                    if slot.done or len(slot.produced) >= slot.budget:
+                        break
+                    token = int(token)
+                    slot.produced.append(token)
+                    if self.eos_id is not None and token == self.eos_id:
+                        slot.done = True
+        elif any(needs):
             self.cache, nxt = self._decode(
                 self.params, self.cache, self._current, next(self._keys)
             )
             nxt_host = np.asarray(nxt)
             for row, slot in enumerate(self.slots):
-                if self._needs_decode(slot):
+                if needs[row]:
                     token = int(nxt_host[row])
                     slot.produced.append(token)
                     if self.eos_id is not None and token == self.eos_id:
@@ -451,6 +783,8 @@ class ContinuousWorker:
         result_queue=None,
         mesh=None,
         prefix_cache: dict | None = None,
+        draft_layers: int = 0,
+        draft_tokens: int = 4,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -483,6 +817,8 @@ class ContinuousWorker:
             mesh=mesh,
             quantized_kv=service_config.quantized_kv,
             prefix_cache=prefix_cache,
+            draft_layers=draft_layers,
+            draft_tokens=draft_tokens,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as

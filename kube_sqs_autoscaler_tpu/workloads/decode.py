@@ -827,6 +827,14 @@ def generate(
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires an rng key")
     if prefix_cache is not None:
+        if attention_fn is not None:
+            # the prefix path prefills through the chunk decoder
+            # (prefill_with_prefix), which has no attention override —
+            # silently ignoring the caller's kernel pick would be worse
+            raise ValueError(
+                "attention_fn does not apply with prefix_cache (the "
+                "suffix prefill runs the chunk decoder); drop one"
+            )
         _check_prefix_layout(prefix_cache, quantized_cache)
     keys = (
         jax.random.split(rng, num_tokens)
@@ -943,6 +951,27 @@ def cache_shardings(mesh: Mesh, cache: dict) -> dict:
     }
 
 
+def prefix_cache_shardings(mesh: Mesh, prefix_cache: dict) -> dict:
+    """Shardings for a batch-1 prefix cache on a serving mesh: heads over
+    ``model`` exactly like :func:`cache_shardings` (both layouts — bf16
+    k/v and int8 codes+scales), but the batch axis UNSHARDED — the
+    prefix is one shared row that ``broadcast_prefix`` expands to every
+    data shard's rows inside the compiled generate."""
+    four = NamedSharding(mesh, P(None, "model", None, None))
+    three = NamedSharding(mesh, P(None, "model", None))
+
+    def entry_shardings(layer: dict) -> dict:
+        return {
+            name: (four if leaf.ndim == 4 else three)
+            for name, leaf in layer.items()
+        }
+
+    return {
+        "layers": [entry_shardings(layer) for layer in prefix_cache["layers"]],
+        "length": NamedSharding(mesh, P(None)),
+    }
+
+
 def compile_serving_fns(
     mesh: Mesh,
     params: Any,
@@ -950,6 +979,7 @@ def compile_serving_fns(
     prefill_fn: Any,
     decode_fn: Any,
     generate_fn: Any,
+    prefix_cache: dict | None = None,
 ):
     """The family-agnostic serving jit wiring (one implementation for the
     gpt and llama families — only the four family ops differ).
@@ -960,7 +990,18 @@ def compile_serving_fns(
     bound): ``prefill_fn(params, tokens)``,
     ``decode_fn(params, cache, token)``, and
     ``generate_fn(params, prompt, num_tokens, temperature, rng, lengths,
-    top_k, top_p, eos_id)``.
+    top_k, top_p, eos_id, prefix_cache)``.
+
+    ``prefix_cache`` (a batch-1 cache from the family's
+    ``prefill_prefix`` variant, in ``cache_template``'s layout — bf16 or
+    int8) pins a shared prompt prefix into the compiled generate: it is
+    device_put ONCE under :func:`prefix_cache_shardings` (heads over
+    ``model``, batch replicated over ``data``) and injected as a hidden
+    leading operand, so the returned generate keeps the same external
+    signature and every prompt row is a suffix continuing from the
+    shared prefix (identical outputs to prepending it, minus its
+    repeated prefill — the single-chip ``generate(prefix_cache=...)``
+    contract, sharded).
 
     The returned generate fn's signature is ``(params, prompt, rng,
     lengths, num_tokens, temperature=0.0, top_k=0, top_p=1.0,
@@ -994,38 +1035,94 @@ def compile_serving_fns(
         donate_argnums=1,  # reuse the cache buffers step to step
     )
 
-    def _generate(params, prompt, rng, lengths, num_tokens,
-                  temperature=0.0, top_k=0, top_p=1.0, eos_id=None):
-        return generate_fn(params, prompt, num_tokens, temperature, rng,
-                           lengths, top_k, top_p, eos_id)
+    if prefix_cache is None:
 
-    generate_jit_fn = jax.jit(
-        _generate,
+        def _generate(params, prompt, rng, lengths, num_tokens,
+                      temperature=0.0, top_k=0, top_p=1.0, eos_id=None):
+            return generate_fn(params, prompt, num_tokens, temperature, rng,
+                               lengths, top_k, top_p, eos_id, None)
+
+        generate_jit_fn = jax.jit(
+            _generate,
+            static_argnames=("num_tokens", "temperature", "top_k", "top_p",
+                             "eos_id"),
+            in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P()),
+                          tokens_1d),
+            out_shardings=tokens_2d,
+        )
+        return prefill_jit, decode_jit, generate_jit_fn
+
+    pfx_shard = prefix_cache_shardings(mesh, prefix_cache)
+    placed_prefix = jax.device_put(prefix_cache, pfx_shard)
+
+    def _generate_pfx(params, prefix, prompt, rng, lengths, num_tokens,
+                      temperature=0.0, top_k=0, top_p=1.0, eos_id=None):
+        return generate_fn(params, prompt, num_tokens, temperature, rng,
+                           lengths, top_k, top_p, eos_id, prefix)
+
+    pfx_jit = jax.jit(
+        _generate_pfx,
         static_argnames=("num_tokens", "temperature", "top_k", "top_p",
                          "eos_id"),
-        in_shardings=(p_shard, tokens_2d, NamedSharding(mesh, P()),
-                      tokens_1d),
+        in_shardings=(p_shard, pfx_shard, tokens_2d,
+                      NamedSharding(mesh, P()), tokens_1d),
         out_shardings=tokens_2d,
     )
-    return prefill_jit, decode_jit, generate_jit_fn
+
+    def generate_with_prefix(params, prompt, rng, lengths, num_tokens,
+                             temperature=0.0, top_k=0, top_p=1.0,
+                             eos_id=None):
+        return pfx_jit(params, placed_prefix, prompt, rng, lengths,
+                       num_tokens, temperature, top_k, top_p, eos_id)
+
+    return prefill_jit, decode_jit, generate_with_prefix
 
 
-def make_serving_fns(mesh: Mesh, config: ModelConfig, params: Any):
+def make_serving_fns(
+    mesh: Mesh,
+    config: ModelConfig,
+    params: Any,
+    *,
+    quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
+):
     """Compile (prefill, decode_step, generate) over the mesh for the
     gpt family (see :func:`compile_serving_fns` for the contract; the
-    llama counterpart is ``llama.make_llama_serving_fns``)."""
-    template = jax.eval_shape(lambda: init_cache(config, mesh.shape["data"]))
+    llama counterpart is ``llama.make_llama_serving_fns``).
+
+    ``quantized_cache=True`` serves through the int8 KV cache — the
+    codes/scales shard exactly like the bf16 cache (heads over
+    ``model``, :func:`cache_shardings` is layout-agnostic), so decode
+    streams half the cache bytes per step per shard.  ``prefix_cache``
+    (from :func:`prefill_prefix` / :func:`quantized_prefill_prefix`,
+    layout matching) pins a shared prompt prefix into the sharded
+    generate; both options compose."""
+    batch = mesh.shape["data"]
+    if quantized_cache:
+        template = jax.eval_shape(
+            lambda: init_quantized_cache(config, batch)
+        )
+        prefill_fn = partial(quantized_prefill, config=config)
+        decode_fn = partial(quantized_decode_step, config=config)
+    else:
+        template = jax.eval_shape(lambda: init_cache(config, batch))
+        prefill_fn = partial(prefill, config=config)
+        decode_fn = partial(decode_step, config=config)
+    if prefix_cache is not None:
+        _check_prefix_layout(prefix_cache, quantized_cache)
     return compile_serving_fns(
         mesh,
         params,
         template,
-        partial(prefill, config=config),
-        partial(decode_step, config=config),
+        prefill_fn,
+        decode_fn,
         lambda params, prompt, num_tokens, temperature, rng, lengths,
-               top_k, top_p, eos_id:
+               top_k, top_p, eos_id, prefix:
             generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
                 top_k=top_k, top_p=top_p, eos_id=eos_id,
+                quantized_cache=quantized_cache, prefix_cache=prefix,
             ),
+        prefix_cache=prefix_cache,
     )

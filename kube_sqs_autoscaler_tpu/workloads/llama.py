@@ -1023,6 +1023,13 @@ def llama_generate(
                 "prefix_cache rides the padded cache layout; it does not "
                 "combine with the rolling-buffer cache"
             )
+        if prompt_attention is not None:
+            # same contract as decode.generate: the suffix prefill runs
+            # the chunk decoder, which has no attention override
+            raise ValueError(
+                "prompt_attention does not apply with prefix_cache (the "
+                "suffix prefill runs the chunk decoder); drop one"
+            )
         from .decode import _check_prefix_layout
 
         _check_prefix_layout(prefix_cache, quantized_cache)
@@ -1073,30 +1080,60 @@ def llama_generate(
 # ---------------------------------------------------------------------------
 
 
-def make_llama_serving_fns(mesh, config: LlamaConfig, params: dict):
+def make_llama_serving_fns(
+    mesh,
+    config: LlamaConfig,
+    params: dict,
+    *,
+    quantized_cache: bool = False,
+    prefix_cache: dict | None = None,
+):
     """Compile (prefill, decode_step, generate) over a ``(data, model)``
     mesh — same contract as :func:`.decode.make_serving_fns` (shared jit
     wiring via :func:`.decode.compile_serving_fns`), with the compact GQA
     cache sharded by *kv* head over ``model`` (requires
-    ``n_kv_heads % model_parallel == 0``)."""
-    from .decode import compile_serving_fns
+    ``n_kv_heads % model_parallel == 0``).
 
-    template = jax.eval_shape(
-        lambda: init_llama_cache(config, mesh.shape["data"])
+    ``quantized_cache=True`` serves through the int8 GQA cache (codes and
+    scales shard by kv head over ``model`` exactly like the bf16 cache);
+    ``prefix_cache`` (from :func:`llama_prefill_prefix` /
+    :func:`llama_quantized_prefill_prefix`) pins a shared prompt prefix
+    into the sharded generate.  Both options compose."""
+    from .decode import (
+        _check_prefix_layout,
+        compile_serving_fns,
+        init_quantized_cache,
     )
+
+    batch = mesh.shape["data"]
+    if quantized_cache:
+        template = jax.eval_shape(
+            lambda: init_quantized_cache(config, batch,
+                                         kv_heads=config.n_kv_heads)
+        )
+        prefill_fn = partial(llama_quantized_prefill, config=config)
+        decode_fn = partial(llama_quantized_decode_step, config=config)
+    else:
+        template = jax.eval_shape(lambda: init_llama_cache(config, batch))
+        prefill_fn = partial(llama_prefill, config=config)
+        decode_fn = partial(llama_decode_step, config=config)
+    if prefix_cache is not None:
+        _check_prefix_layout(prefix_cache, quantized_cache)
     return compile_serving_fns(
         mesh,
         params,
         template,
-        partial(llama_prefill, config=config),
-        partial(llama_decode_step, config=config),
+        prefill_fn,
+        decode_fn,
         lambda params, prompt, num_tokens, temperature, rng, lengths,
-               top_k, top_p, eos_id:
+               top_k, top_p, eos_id, prefix:
             llama_generate(
                 params, prompt, num_tokens, config,
                 temperature=temperature, rng=rng, lengths=lengths,
                 top_k=top_k, top_p=top_p, eos_id=eos_id,
+                quantized_cache=quantized_cache, prefix_cache=prefix,
             ),
+        prefix_cache=prefix_cache,
     )
 
 

@@ -53,6 +53,47 @@ def _jax_available() -> bool:
 
 import pytest  # noqa: E402
 
+# Model/mesh-heavy workload modules — the `slow` tier.  The default gate
+# (`make test` = `pytest -m "not slow"`) runs the controller layer plus
+# the light workload smokes in well under 10 minutes; `make test-all`
+# runs everything (CI runs both).  The suite passed 48 minutes
+# single-process in round 4 and was still growing — without a tier the
+# green gate itself becomes flaky-by-timeout on the driver host.
+SLOW_MODULES = {
+    "test_beam", "test_checkpoint", "test_continuous", "test_decode",
+    "test_distributed_data", "test_flash", "test_hf_convert",
+    "test_llama", "test_lora", "test_lora_pipeline", "test_moe",
+    "test_multihost", "test_pipeline", "test_pipeline_4axis",
+    "test_pipeline_llama", "test_prefix_cache", "test_quantize",
+    "test_ring", "test_service", "test_sliding_window",
+    "test_speculative", "test_train_options", "test_train_serve",
+    "test_trainer", "test_workloads", "test_zigzag",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.purebasename in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
+
+def drain_batcher(batcher, requests, max_steps=300):
+    """Feed ``requests`` (list of token arrays) through a
+    ContinuousBatcher keeping slots full, collecting finished outputs by
+    submit order — the one drain loop the continuous/prefix/speculative
+    batcher tests share.  Returns ``{index: tokens}``."""
+    results = {}
+    queue = list(enumerate(requests))
+    for _ in range(max_steps):
+        while queue and batcher.free_slots:
+            idx, ids = queue.pop(0)
+            batcher.submit(ids, payload=idx)
+        for idx, tokens in batcher.step():
+            results[idx] = tokens
+        if not queue and batcher.active == 0:
+            break
+    return results
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():

@@ -424,3 +424,114 @@ def test_empty_poll_backoff_throttles_receives():
     # 8 decode cycles with 3 free slots: without the backoff this would
     # be ~8 receives; with it, the empty polls collapse to a couple
     assert receives["n"] <= 3, receives["n"]
+
+
+from tests.conftest import drain_batcher as _drain  # noqa: E402
+
+
+def test_speculative_slots_equal_per_request_generate():
+    # VERDICT r4 next #4: speculative decoding INSIDE continuous
+    # batching — each engine step is one draft-and-verify round over the
+    # rolling slots; greedy outputs equal plain generate per request,
+    # slot reuse included, and the per-slot accept counters report the
+    # serving-side tuning signal
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        draft_layers=1, draft_tokens=3,
+    )
+    requests = prompts(5, rng_seed=11)
+    results = _drain(batcher, requests)
+    assert len(results) == 5
+    for idx, ids in enumerate(requests):
+        np.testing.assert_array_equal(
+            results[idx], reference_continuation(params, ids, 5),
+            err_msg=f"request {idx}",
+        )
+    # the early-exit self-draft shares the target's first layer, so the
+    # aggregate accept stats must show real acceptance activity
+    assert batcher.spec_rounds > 0
+    assert 0 <= batcher.spec_accepted <= batcher.spec_rounds * 3
+
+
+def test_speculative_slots_eos_equal_generate():
+    params = init_params(jax.random.key(0), TINY)
+    requests = prompts(4, rng_seed=12)
+    ref0 = reference_continuation(params, requests[0], 5)
+    eos = int(ref0[1])  # fires early for request 0 by construction
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        draft_layers=1, draft_tokens=3, eos_id=eos,
+    )
+    results = _drain(batcher, requests)
+    assert len(results) == 4
+    for idx, ids in enumerate(requests):
+        expected = np.asarray(generate(
+            params, jnp.asarray(ids, jnp.int32)[None], 5, TINY, eos_id=eos
+        )[0])
+        np.testing.assert_array_equal(results[idx], expected,
+                                      err_msg=f"request {idx}")
+
+
+def test_sharded_speculative_slots_equal_single_chip():
+    # spec rounds over a (data, model) mesh: weights/caches keep their
+    # Megatron/head shardings, acceptance and rollback are row-local
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    params = init_params(jax.random.key(0), TINY)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params, param_shardings(mesh, params))
+    requests = prompts(5, rng_seed=13)
+    plain = _drain(ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        draft_layers=1, draft_tokens=2,
+    ), requests)
+    sharded = _drain(ContinuousBatcher(
+        placed, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        draft_layers=1, draft_tokens=2, mesh=mesh,
+    ), requests)
+    assert len(sharded) == 5
+    for idx in plain:
+        np.testing.assert_array_equal(sharded[idx], plain[idx],
+                                      err_msg=f"request {idx}")
+
+
+def test_speculative_slots_sampled_terminate_in_vocab():
+    # sampled spec slots: the Leviathan/Chen rule keeps every emitted
+    # token an exact warped-target sample; here we pin termination,
+    # shape, and vocab-range (the distributional identity is pinned in
+    # test_speculative.py over 10^5 rows)
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        draft_layers=1, draft_tokens=2, temperature=0.8, top_p=0.9,
+    )
+    requests = prompts(4, rng_seed=14)
+    results = _drain(batcher, requests)
+    assert len(results) == 4
+    for idx, tokens in results.items():
+        assert tokens.shape == (5,)
+        assert ((tokens >= 0) & (tokens < TINY.vocab_size)).all()
+
+
+def test_speculative_slots_reject_bad_draft_depth():
+    import pytest
+
+    params = init_params(jax.random.key(0), TINY)
+    with pytest.raises(ValueError, match="draft_layers"):
+        ContinuousBatcher(
+            params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+            draft_layers=TINY.n_layers, draft_tokens=2,
+        )
+
+
+def test_worker_binary_continuous_speculative_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "3", "--batch-size", "2", "--seq-len", "8",
+          "--generate-tokens", "4", "--continuous",
+          "--speculative-draft-layers", "1",
+          "--speculative-draft-tokens", "2"])

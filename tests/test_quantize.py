@@ -154,6 +154,21 @@ def test_worker_binary_serves_int8_model_parallel():
                  "--generate-tokens", "3"])
 
 
+def test_worker_binary_serves_quantized_kv_model_parallel():
+    # the round-4 hole: --quantize-kv rejected --model-parallel; now the
+    # int8 cache shards by head over the serving mesh (plain generate AND
+    # the continuous slot machine), and int8 weights compose on top
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "2", "--quantize-kv", "--model-parallel", "2",
+                 "--batch-size", "4", "--seq-len", "8",
+                 "--generate-tokens", "3"])
+    worker_main(["--demo", "3", "--quantize-kv", "--model-parallel", "2",
+                 "--continuous", "--quantize", "int8", "--batch-size", "4",
+                 "--seq-len", "8", "--generate-tokens", "3",
+                 "--family", "llama"])
+
+
 # ------------------------------------------------------ int8 KV cache
 
 
@@ -257,6 +272,10 @@ def test_worker_binary_quantize_kv_flag():
                  "--temperature", "0.7"])
     with pytest.raises(SystemExit, match="generate-tokens"):
         worker_main(["--demo", "1", "--quantize-kv"])
-    with pytest.raises(SystemExit, match="model-parallel"):
+    # --model-parallel alone now composes (codes/scales shard by head);
+    # the sharded speculative factory still streams bf16, so the triple
+    # fails fast
+    with pytest.raises(SystemExit, match="speculative"):
         worker_main(["--demo", "1", "--quantize-kv", "--generate-tokens",
-                     "2", "--model-parallel", "2"])
+                     "2", "--model-parallel", "2",
+                     "--speculative-draft-layers", "1"])
