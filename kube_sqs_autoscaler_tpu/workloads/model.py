@@ -72,8 +72,12 @@ PARAM_AXES = {
     "router": ("model", "experts_out"),
     "w_up_experts": ("expert", "model", "ff"),
     "w_down_experts": ("expert", "ff", "model"),
-    # llama MoE: fused gate+up expert projection (SwiGLU experts)
+    # llama MoE: fused gate+up expert projection (SwiGLU experts); the
+    # pipeline stage stack splits it into w_gate_experts/w_up_experts
+    # (contiguous ff columns per expert shard under pp x tp — a fused
+    # [2F] chunk crosses the gate/up boundary)
     "w_gate_up_experts": ("expert", "model", "ff2"),
+    "w_gate_experts": ("expert", "model", "ff"),
     # llama family (workloads.llama): fused kv / gate-up projections shard
     # their output axis tensor-parallel; RMSNorm scales replicate
     "attn_norm": ("model",),

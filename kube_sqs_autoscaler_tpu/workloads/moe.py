@@ -192,7 +192,7 @@ def _top_k_routing(
 
 
 def _routed_ffn(
-    x: jax.Array, layer: dict, moe: MoeConfig, expert_ffn
+    x: jax.Array, layer: dict, moe: MoeConfig, expert_ffn, grad_sync=None
 ) -> tuple[jax.Array, jax.Array]:
     """The family-agnostic route/dispatch/combine skeleton.
 
@@ -222,6 +222,17 @@ def _routed_ffn(
     )
     probs = jax.nn.softmax(logits, axis=-1)
     dispatch, combine, aux = _top_k_routing(probs, moe, capacity)
+    if grad_sync is not None:
+        # fully-manual pp x tp: the expert weights are ff-carved over
+        # "model", so the cotangents reaching dispatch/combine (and
+        # through them the router) are per-shard PARTIAL sums; grad_sync
+        # (Megatron's f operator — identity forward, psum backward)
+        # restores the full cotangent so the replicated router's
+        # gradient matches the unsharded math.  The aux term reads the
+        # raw probs above and needs no correction (its per-shard
+        # cotangents are already identical full copies).
+        dispatch = grad_sync(dispatch)
+        combine = grad_sync(combine)
 
     dispatch = dispatch.astype(x.dtype)
     # [G,T,E,C] x [G,T,D] -> [E,G,C,D]: the forward all-to-all
@@ -242,29 +253,41 @@ def _gelu_experts(expert_in: jax.Array, layer: dict) -> jax.Array:
 
 
 def _swiglu_experts(expert_in: jax.Array, layer: dict) -> jax.Array:
-    gate_up = jnp.einsum(
-        "egcd,edf->egcf", expert_in, layer["w_gate_up_experts"]
-    )
-    gate, up = jnp.split(gate_up, 2, axis=-1)
+    if "w_gate_experts" in layer:
+        # the pipeline stage layout splits the fused projection so each
+        # expert's gate/up columns shard contiguously under pp x tp (a
+        # fused [2F] axis chunks across the gate/up boundary — same
+        # reason the dense w_gate_up splits, pipeline.stack_llama_layers)
+        gate = jnp.einsum(
+            "egcd,edf->egcf", expert_in, layer["w_gate_experts"]
+        )
+        up = jnp.einsum(
+            "egcd,edf->egcf", expert_in, layer["w_up_experts"]
+        )
+    else:
+        gate_up = jnp.einsum(
+            "egcd,edf->egcf", expert_in, layer["w_gate_up_experts"]
+        )
+        gate, up = jnp.split(gate_up, 2, axis=-1)
     return jnp.einsum(
         "egcf,efd->egcd", jax.nn.silu(gate) * up, layer["w_down_experts"]
     )
 
 
 def moe_mlp(
-    x: jax.Array, layer: dict, moe: MoeConfig
+    x: jax.Array, layer: dict, moe: MoeConfig, grad_sync=None
 ) -> tuple[jax.Array, jax.Array]:
     """Sparse MLP for the gpt family: GELU experts behind the shared
     routing skeleton (:func:`_routed_ffn`)."""
-    return _routed_ffn(x, layer, moe, _gelu_experts)
+    return _routed_ffn(x, layer, moe, _gelu_experts, grad_sync=grad_sync)
 
 
 def llama_moe_mlp(
-    x: jax.Array, layer: dict, moe: MoeConfig
+    x: jax.Array, layer: dict, moe: MoeConfig, grad_sync=None
 ) -> tuple[jax.Array, jax.Array]:
     """Sparse MLP for the llama family: SwiGLU experts (fused gate+up
     projection per expert) behind the same routing skeleton."""
-    return _routed_ffn(x, layer, moe, _swiglu_experts)
+    return _routed_ffn(x, layer, moe, _swiglu_experts, grad_sync=grad_sync)
 
 
 def init_llama_moe_params(

@@ -77,33 +77,34 @@ def make_pipeline_mesh(
 ) -> Mesh:
     """A ``("pipe", "data")`` mesh — or ``("pipe", "data", "model")``
     (pp x dp x tp) / ``("pipe", "data", "seq")`` (pp x dp x sp, ring
-    attention inside the stages) when the respective degree is > 1;
-    ``pipe_parallel`` defaults to all devices.  tp and sp are mutually
-    exclusive under pp (a 4-axis manual body buys nothing at this
-    scale)."""
+    attention inside the stages) when the respective degree is > 1, or
+    the full 4-axis ``("pipe", "data", "seq", "model")`` (pp x dp x sp
+    x tp — the flagship large-model pod layout: stages over ``pipe``,
+    Megatron head/ff shards over ``model`` innermost so its two
+    per-block all-reduces ride the shortest ICI hops, ring attention
+    over ``seq`` above it) when both are; ``pipe_parallel`` defaults to
+    all devices."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     pipe = pipe_parallel if pipe_parallel is not None else n
-    if model_parallel > 1 and seq_parallel > 1:
-        raise ValueError(
-            "pipeline meshes take model_parallel OR seq_parallel, not both"
-        )
     if n % (pipe * model_parallel * seq_parallel):
         raise ValueError(
             f"{n} devices not divisible by pipe_parallel={pipe} x "
             f"model_parallel={model_parallel} x seq_parallel={seq_parallel}"
         )
-    if model_parallel > 1:
+    data = n // (pipe * model_parallel * seq_parallel)
+    if model_parallel > 1 and seq_parallel > 1:
         grid = np.asarray(devices).reshape(
-            pipe, n // (pipe * model_parallel), model_parallel
+            pipe, data, seq_parallel, model_parallel
         )
+        return Mesh(grid, ("pipe", "data", "seq", "model"))
+    if model_parallel > 1:
+        grid = np.asarray(devices).reshape(pipe, data, model_parallel)
         return Mesh(grid, ("pipe", "data", "model"))
     if seq_parallel > 1:
-        grid = np.asarray(devices).reshape(
-            pipe, n // (pipe * seq_parallel), seq_parallel
-        )
+        grid = np.asarray(devices).reshape(pipe, data, seq_parallel)
         return Mesh(grid, ("pipe", "data", "seq"))
-    grid = np.asarray(devices).reshape(pipe, n // pipe)
+    grid = np.asarray(devices).reshape(pipe, data)
     return Mesh(grid, ("pipe", "data"))
 
 
@@ -171,6 +172,14 @@ def stack_llama_layers(params: dict) -> dict:
     if "w_gate_up" in stacked:
         w_gate, w_up = jnp.split(stacked.pop("w_gate_up"), 2, axis=-1)
         stacked["w_gate"], stacked["w_up"] = w_gate, w_up
+    if "w_gate_up_experts" in stacked:
+        # fused SwiGLU expert projection splits for the same reason: each
+        # expert's ff columns shard contiguously under pp x tp, and a
+        # fused [2F] chunk crosses the gate/up boundary
+        w_gate_e, w_up_e = jnp.split(
+            stacked.pop("w_gate_up_experts"), 2, axis=-1
+        )
+        stacked["w_gate_experts"], stacked["w_up_experts"] = w_gate_e, w_up_e
     return stacked
 
 
@@ -186,6 +195,12 @@ def unstack_llama_layers(params: dict) -> dict:
     if "w_gate" in stages:
         w_gate, w_up = stages.pop("w_gate"), stages.pop("w_up")
         stages["w_gate_up"] = jnp.concatenate([w_gate, w_up], axis=-1)
+    if "w_gate_experts" in stages:
+        w_gate_e = stages.pop("w_gate_experts")
+        w_up_e = stages.pop("w_up_experts")
+        stages["w_gate_up_experts"] = jnp.concatenate(
+            [w_gate_e, w_up_e], axis=-1
+        )
     n_layers = next(iter(stages.values())).shape[0]
     flat = {k: v for k, v in params.items() if k != "stages"}
     flat["layers"] = [
@@ -305,9 +320,28 @@ def _stage_zigzag_attention(mesh: Mesh):
 def _stage_spec(name: str, with_model: bool) -> P:
     """PartitionSpec of one stage-stack leaf: leading layer axis over
     ``"pipe"``; on a pp x tp mesh, the PARAM_AXES Megatron axes over
-    ``"model"`` (column-parallel wq/wk/wv/w_up, row-parallel wo/w_down)."""
+    ``"model"`` (column-parallel wq/wk/wv/w_up, row-parallel wo/w_down).
+
+    MoE leaves under tp: the router replicates (routing decisions must
+    be identical on every model shard) and each expert's FF axis carves
+    over ``"model"`` — column-parallel ``w_up/w_gate`` columns,
+    row-parallel ``w_down`` rows — so the routed expert compute is
+    genuinely tensor-parallel and the block's ``reduce`` seam closes the
+    partial sums exactly like the dense MLP's.  The EXPERT axis stays
+    unsharded (the flat path's expert-over-``data`` placement does not
+    apply inside the fully-manual stage body: routing there addresses
+    the full expert set per data shard)."""
     from .train import _LOGICAL_TO_MESH
 
+    if name == "router":
+        return P("pipe")
+    if "experts" in name:
+        if not with_model:
+            return P("pipe")
+        axes = PARAM_AXES[name]
+        return P("pipe", *(
+            None if a == "expert" else _LOGICAL_TO_MESH[a] for a in axes
+        ))
     axes = PARAM_AXES.get(name) if with_model else None
     if axes is None:
         return P("pipe")
@@ -395,11 +429,17 @@ def _stage_apply(
     attend = attention_fn
 
     if moe is not None:
-        # routed expert MLP in the block's mlp seam; aux rides the carry
+        # routed expert MLP in the block's mlp seam; aux rides the carry.
+        # Under tp the expert ff shards over "model" (stage_partition_
+        # specs), so the router's dispatch/combine cotangents need the
+        # Megatron f-operator sync (see moe._routed_ffn's grad_sync).
+        emlp = expert_mlp
+        if tp_size > 1:
+            emlp = partial(expert_mlp, grad_sync=_tp_promote)
         return _moe_layer_scan(
             lambda h, layer, mlp: block(h, layer, cfg, attend, mlp,
                                         reduce, promote),
-            x, stage_layers, expert_mlp, moe,
+            x, stage_layers, emlp, moe,
         )
 
     def one_layer(h, layer):
@@ -479,10 +519,14 @@ def _llama_stage_apply(
             positions = positions + jax.lax.axis_index(seq_axis) * x.shape[1]
 
     if moe is not None:
+        # same router grad sync as the gpt stage apply (moe._routed_ffn)
+        emlp = expert_mlp
+        if tp_size > 1:
+            emlp = partial(expert_mlp, grad_sync=_tp_promote)
         return _moe_layer_scan(
             lambda h, layer, mlp: block(h, layer, cfg, positions, attend,
                                         mlp, reduce, promote),
-            x, stage_layers, expert_mlp, moe,
+            x, stage_layers, emlp, moe,
         )
 
     def one_layer(h, layer):
@@ -657,6 +701,12 @@ def _pipeline_body(
         result = unsplit(result)
     if moe_aux:
         aux_total = jax.lax.psum(aux_acc, (axis_name, "data")) / data_size
+        if tp_size > 1:
+            # same boundary correction as the activations: the P() out
+            # spec splits the aux cotangent across the unmentioned
+            # "model" axis; unsplit's backward psum restores the full
+            # cotangent on every shard before it reaches the router
+            aux_total = unsplit(aux_total)
         return result, aux_total
     return result
 
@@ -1072,7 +1122,7 @@ def moe_pipeline_loss_fn(
         axis_name="pipe",
         axis_size=mesh.shape["pipe"],
         remat=False,  # MoE rejects remat (aux closure vs re-tracing)
-        tp_size=1,
+        tp_size=mesh.shape.get("model", 1),
         attention_fn=stage_attention,
         stage_apply=stage_apply,
         moe_aux=True,
@@ -1143,20 +1193,22 @@ def make_moe_pipeline_train_step(
     GPipe differentiates the lockstep forward; 1F1B uses the explicitly
     scheduled backward with the Switch aux term riding each stage vjp
     as a constant cotangent (:func:`moe_one_f_one_b_value_and_grad`).
-    No tp (experts replicate per stage; the Megatron seams don't carve
-    expert stacks), no remat (the flat MoE constraint).  Gradient
-    accumulation composes (``accum_axis=1``).
+    On a (pipe, data, model) mesh the attention weights carry Megatron
+    shards AND each expert's ff axis carves over ``model``
+    (column-parallel up/gate, row-parallel down — see
+    :func:`_stage_spec`), so expert FLOPs and memory shrink by tp like
+    the dense MLP's; only the router replicates (routing must be
+    identical per shard), with its dispatch/combine cotangents synced
+    through ``moe._routed_ffn``'s ``grad_sync`` seam.  The EXPERT axis
+    stays unsharded inside the pipeline (no ep).  No sp, no remat (the
+    flat MoE constraints).  Gradient accumulation composes
+    (``accum_axis=1``).
     """
     from .moe import _require_no_remat
     from .train import make_train_step
 
     _require_no_remat(train_config)
     _require_no_seq_axis(mesh)
-    if mesh.shape.get("model", 1) > 1:
-        raise ValueError(
-            "MoE x pipeline does not compose with tensor parallelism "
-            "(experts replicate per stage); use a (pipe, data) mesh"
-        )
     if getattr(config, "sliding_window", None) is not None:
         raise ValueError(
             "sliding_window does not compose with the pipelined MoE "
@@ -1949,8 +2001,9 @@ def moe_one_f_one_b_value_and_grad(
     scaling lands it at the GPipe objective's
     ``weight · aux_total / (n_layers · M)``), and every stage's aux
     value joins the reported loss via the body's separate accumulator.
-    Same mesh contract as the GPipe MoE objective: (pipe, data) only
-    (experts replicate per stage), no remat."""
+    Same mesh contract as the GPipe MoE objective: (pipe, data[, model])
+    — attention AND expert ff Megatron-sharded under tp, router
+    replicated with grad-synced dispatch/combine — no sp, no remat."""
     from .moe import llama_moe_mlp, moe_mlp
 
     _require_no_seq_axis(mesh)
@@ -1981,7 +2034,7 @@ def moe_one_f_one_b_value_and_grad(
         axis_size=mesh.shape["pipe"],
         data_size=mesh.shape["data"],
         remat=False,  # MoE rejects remat (aux closure vs re-tracing)
-        tp_size=1,
+        tp_size=mesh.shape.get("model", 1),
         attention_fn=stage_attention,
         stage_apply=stage_apply,
         head_loss=head_loss,

@@ -249,24 +249,13 @@ def train(args) -> dict:
                 f"--pipe-microbatches {args.pipe_microbatches}"
             )
         if args.seq_parallel > 1:
-            # pp x sp: ring attention inside the stages (both schedules)
-            if args.model_parallel > 1:
-                raise SystemExit(
-                    "--pipe-parallel takes --model-parallel OR "
-                    "--seq-parallel, not both"
-                )
+            # pp x sp (ring attention inside the stages, both schedules)
+            # and the full 4-axis pp x sp x tp (Megatron shards inside
+            # the ring-attention stages) both compose
             if args.moe:
                 raise SystemExit(
                     "--moe with --pipe-parallel does not combine with "
                     "--seq-parallel"
-                )
-        if args.moe:
-            # MoE x pp, both schedules (1F1B threads the aux term as a
-            # constant cotangent); no tp (experts replicate per stage)
-            if args.model_parallel > 1:
-                raise SystemExit(
-                    "--moe with --pipe-parallel does not combine with "
-                    "--model-parallel (experts replicate per stage)"
                 )
     if args.sliding_window < 0:
         raise SystemExit(

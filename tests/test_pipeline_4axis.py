@@ -1,0 +1,169 @@
+"""The 4-axis mesh — pp x dp x sp x tp — and MoE x pp x tp.
+
+The flagship large-model pod layout (VERDICT r4 next #5): stages over
+``pipe``, Megatron head/ff shards over ``model``, ring attention over
+``seq``, batch over ``data``.  The invariant everywhere: adding mesh
+axes changes the schedule and the communication pattern, never the math
+— losses (and therefore the gradients driving step 2) match the plain
+pp x dp truth on the same batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.llama import LlamaConfig
+from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
+from kube_sqs_autoscaler_tpu.workloads.moe import MoeConfig
+from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+    PipelineConfig,
+    init_llama_pipeline_train_state,
+    init_moe_pipeline_train_state,
+    init_pipeline_train_state,
+    make_llama_pipeline_train_step,
+    make_moe_pipeline_train_step,
+    make_pipeline_mesh,
+    make_pipeline_train_step,
+    pipeline_batch_sharding,
+    place_pipeline_state,
+)
+from kube_sqs_autoscaler_tpu.workloads.train import TrainConfig
+
+# fp32 so cross-mesh loss comparisons are reduction-order-tight
+CFG = ModelConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32,
+)
+LCFG = LlamaConfig(
+    vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+    d_ff=128, max_seq_len=64, dtype=jnp.float32,
+)
+
+
+def tokens_for(mesh, vocab=256, seed=1):
+    toks = jax.random.randint(jax.random.key(seed), (2, 2, 32), 0, vocab,
+                              jnp.int32)
+    return jax.device_put(toks, pipeline_batch_sharding(mesh))
+
+
+def two_losses(mesh, schedule, init_fn, step_factory, seed=0):
+    state = place_pipeline_state(mesh, init_fn(jax.random.key(seed)))
+    step = step_factory(
+        mesh, PipelineConfig(n_microbatches=2, schedule=schedule), state
+    )
+    toks = tokens_for(mesh)
+    state, l1 = step(state, toks)
+    state, l2 = step(state, toks)
+    return float(l1), float(l2)
+
+
+def test_4axis_mesh_shape():
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              model_parallel=2, seq_parallel=2)
+    assert dict(mesh.shape) == {"pipe": 2, "data": 1, "seq": 2, "model": 2}
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_4axis_losses_match_plain_pp(schedule):
+    def init_fn(key):
+        return init_pipeline_train_state(key, CFG, TrainConfig(), n_stages=2)
+
+    def factory(mesh, pcfg, state):
+        return make_pipeline_train_step(mesh, CFG, pcfg, TrainConfig(),
+                                        state)
+
+    ref_mesh = make_pipeline_mesh(jax.devices()[:4], pipe_parallel=2)
+    mesh4 = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                               model_parallel=2, seq_parallel=2)
+    r1, r2 = two_losses(ref_mesh, "gpipe", init_fn, factory)
+    g1, g2 = two_losses(mesh4, schedule, init_fn, factory)
+    # step 1: identical math (fp32, same batch); step 2 inherits step-1
+    # gradients, so agreement pins the backward too
+    np.testing.assert_allclose(g1, r1, rtol=2e-5)
+    np.testing.assert_allclose(g2, r2, rtol=2e-3)
+
+
+def test_llama_4axis_1f1b_matches_plain_pp():
+    def init_fn(key):
+        return init_llama_pipeline_train_state(key, LCFG, TrainConfig(),
+                                               n_stages=2)
+
+    def factory(mesh, pcfg, state):
+        return make_llama_pipeline_train_step(mesh, LCFG, pcfg,
+                                              TrainConfig(), state)
+
+    ref_mesh = make_pipeline_mesh(jax.devices()[:4], pipe_parallel=2)
+    mesh4 = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                               model_parallel=2, seq_parallel=2)
+    r1, r2 = two_losses(ref_mesh, "gpipe", init_fn, factory, seed=3)
+    f1, f2 = two_losses(mesh4, "1f1b", init_fn, factory, seed=3)
+    np.testing.assert_allclose(f1, r1, rtol=2e-5)
+    np.testing.assert_allclose(f2, r2, rtol=2e-3)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_moe_pp_tp_matches_no_tp(schedule):
+    # MoE x pp x tp: attention Megatron-sharded, each expert's ff axis
+    # carved over "model" (true tensor-parallel expert compute — the
+    # router stays replicated and its dispatch/combine cotangents ride
+    # the f-operator sync, moe._routed_ffn grad_sync); the first loss
+    # must be bitwise-level equal to the (pipe, data) run and the second
+    # inherits the corrected gradients
+    moe = MoeConfig(n_experts=4, top_k=2)
+
+    def init_fn(key):
+        return init_moe_pipeline_train_state(key, CFG, moe, TrainConfig(),
+                                             n_stages=2)
+
+    def factory(mesh, pcfg, state):
+        return make_moe_pipeline_train_step(mesh, CFG, moe, pcfg,
+                                            TrainConfig(), state)
+
+    # both meshes keep data=2 so the per-data-shard routing groups match
+    ref_mesh = make_pipeline_mesh(jax.devices()[:4], pipe_parallel=2)
+    tp_mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                                 model_parallel=2)
+    r1, r2 = two_losses(ref_mesh, "gpipe", init_fn, factory, seed=5)
+    g1, g2 = two_losses(tp_mesh, schedule, init_fn, factory, seed=5)
+    np.testing.assert_allclose(g1, r1, rtol=2e-5)
+    np.testing.assert_allclose(g2, r2, rtol=2e-3)
+
+
+def test_llama_moe_pp_tp_runs():
+    # llama MoE under pp x tp: the fused SwiGLU expert projection splits
+    # into gate/up stacks so each expert's ff columns shard contiguously
+    # (pipeline.stack_llama_layers); pin a finite two-step run
+    moe = MoeConfig(n_experts=4, top_k=2)
+    tp_mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                                 model_parallel=2)
+    state = place_pipeline_state(
+        tp_mesh,
+        init_moe_pipeline_train_state(jax.random.key(7), LCFG, moe,
+                                      TrainConfig(), n_stages=2,
+                                      llama=True),
+    )
+    step = make_moe_pipeline_train_step(
+        tp_mesh, LCFG, moe, PipelineConfig(n_microbatches=2),
+        TrainConfig(), state, llama=True,
+    )
+    toks = tokens_for(tp_mesh)
+    state, l1 = step(state, toks)
+    state, l2 = step(state, toks)
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+    assert float(l2) < float(l1)  # optimizing
+
+
+def test_trainer_binary_4axis():
+    # the CLI end to end: --pipe-parallel 2 --model-parallel 2
+    # --seq-parallel 2 trains on the 8-device mesh (VERDICT r4 next #5
+    # "done" criterion)
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    main([
+        "--steps", "2", "--batch-size", "4", "--seq-len", "32",
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128",
+        "--pipe-parallel", "2", "--model-parallel", "2",
+        "--seq-parallel", "2", "--pipe-microbatches", "2",
+    ])
