@@ -1,12 +1,21 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test clean compile build push bench workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
-VERSION=v0.4.0
+VERSION=v0.5.0
 
+# Fast tier: controller layer + light workload smokes (<10 min).  The
+# model/mesh-heavy modules carry a `slow` mark (tests/conftest.py
+# SLOW_MODULES); `make test-all` runs everything.
 test:
+	python -m pytest tests/ -x -q -m "not slow"
+
+test-slow:
+	python -m pytest tests/ -x -q -m "slow"
+
+test-all:
 	python -m pytest tests/ -x -q
 
 clean:
