@@ -264,6 +264,9 @@ def _pipeline_targets(targets: tuple) -> tuple:
         "wqkv": ("wq", "wk", "wv"),
         "wkv": ("wk", "wv"),
         "w_gate_up": ("w_gate", "w_up"),
+        # the stacked MoE layout splits the fused SwiGLU expert
+        # projection the same way (pipeline.stack_llama_layers)
+        "w_gate_up_experts": ("w_gate_experts", "w_up_experts"),
     }
     out: list = []
     for name in targets:
@@ -285,24 +288,28 @@ def init_pipeline_lora_params(
     out]`` covering every layer — the per-layer factors ride the same
     leading axis as the weights they adapt (and shard over ``"pipe"``
     with them if placed; the trainer replicates them — they are tiny).
-    Same init scheme as :func:`init_lora_params`: ``A ~ N(0, 1/r)``,
-    ``B = 0`` so the adapted model starts exactly at the base.
+    MoE expert stacks add an expert axis (``[L, E, in, out]``) and get
+    PER-EXPERT factors ``a [L, E, in, r]``, ``b [L, E, r, out]`` — the
+    stage-stacked form of the flat path's per-expert adapters (the
+    router stays frozen, same as flat).  Same init scheme as
+    :func:`init_lora_params`: ``A ~ N(0, 1/r)``, ``B = 0`` so the
+    adapted model starts exactly at the base.
     """
     stages = params["stages"]
     adapters = {}
     for t, name in enumerate(_pipeline_targets(config.targets)):
         w = stages.get(name)
-        if w is None or w.ndim != 3:
+        if w is None or w.ndim not in (3, 4):
             continue
         key = jax.random.fold_in(rng, t)
         adapters[name] = {
             "a": (
                 jax.random.normal(
-                    key, (w.shape[0], w.shape[1], config.rank), jnp.float32
+                    key, (*w.shape[:-1], config.rank), jnp.float32
                 )
                 / config.rank
             ),
-            "b": jnp.zeros((w.shape[0], config.rank, w.shape[2]),
+            "b": jnp.zeros((*w.shape[:-2], config.rank, w.shape[-1]),
                            jnp.float32),
         }
     if not adapters:
@@ -325,7 +332,8 @@ def apply_pipeline_lora(
     stages = dict(params["stages"])
     for name, ab in adapters["stages"].items():
         w = stages[name]
-        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * config.scale
+        eq = "leir,lero->leio" if w.ndim == 4 else "lir,lro->lio"
+        delta = jnp.einsum(eq, ab["a"], ab["b"]) * config.scale
         stages[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
     return dict(params, stages=stages)
 
@@ -353,6 +361,7 @@ def lora_pipeline_value_and_grad(
     lora: LoraConfig,
     llama: bool = False,
     remat: bool = False,
+    moe: Any = None,
 ):
     """``(adapters, tokens) -> (loss, adapter_grads)`` through the
     pipeline, either schedule.
@@ -362,22 +371,34 @@ def lora_pipeline_value_and_grad(
     constant).  1F1B: the hand-built backward computes effective-WEIGHT
     gradients; the adapter gradients follow by the chain rule of
     ``W_eff = W + s·A@B`` — ``dA = s · dW @ Bᵀ``, ``dB = s · Aᵀ @ dW``
-    (batched over the leading layer axis) — so the 1F1B memory schedule
-    and the LoRA optimizer-state savings compose.  Exported for the
-    schedule-equality test."""
+    (batched over the leading layer — and, for expert stacks, expert —
+    axes) — so the 1F1B memory schedule and the LoRA optimizer-state
+    savings compose.  ``moe`` swaps in the routed pipeline objective
+    (aux term included; the frozen router's gradients are discarded
+    like every other non-adapted leaf, expert adapters train through
+    the dispatch/combine).  Exported for the schedule-equality test."""
     from .pipeline import (
         llama_one_f_one_b_value_and_grad,
         llama_pipeline_loss_fn,
+        moe_one_f_one_b_value_and_grad,
+        moe_pipeline_loss_fn,
         one_f_one_b_value_and_grad,
         pipeline_loss_fn,
     )
 
     if pcfg.schedule == "1f1b":
-        vag_full = partial(
-            llama_one_f_one_b_value_and_grad if llama
-            else one_f_one_b_value_and_grad,
-            config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
-        )
+        if moe is not None:
+            vag_full = partial(
+                moe_one_f_one_b_value_and_grad,
+                config=model_config, moe=moe, pcfg=pcfg, mesh=mesh,
+                llama=llama,
+            )
+        else:
+            vag_full = partial(
+                llama_one_f_one_b_value_and_grad if llama
+                else one_f_one_b_value_and_grad,
+                config=model_config, pcfg=pcfg, mesh=mesh, remat=remat,
+            )
 
         def adapter_vag(adapters, tokens):
             eff = apply_pipeline_lora(frozen_params, adapters, lora)
@@ -386,17 +407,30 @@ def lora_pipeline_value_and_grad(
             dadapters = {"stages": {}}
             for name, ab in adapters["stages"].items():
                 dw = dstages[name].astype(jnp.float32)
+                if dw.ndim == 4:  # expert stacks: [L, E, in, out]
+                    eq_a, eq_b = "leio,lero->leir", "leir,leio->lero"
+                else:
+                    eq_a, eq_b = "lio,lro->lir", "lir,lio->lro"
                 dadapters["stages"][name] = {
-                    "a": jnp.einsum("lio,lro->lir", dw, ab["b"])
-                    * lora.scale,
-                    "b": jnp.einsum("lir,lio->lro", ab["a"], dw)
-                    * lora.scale,
+                    "a": jnp.einsum(eq_a, dw, ab["b"]) * lora.scale,
+                    "b": jnp.einsum(eq_b, ab["a"], dw) * lora.scale,
                 }
-            # the frozen base's other gradients (embed/head/non-adapted
-            # stage leaves) are discarded — nothing updates them
+            # the frozen base's other gradients (embed/head/router/
+            # non-adapted stage leaves) are discarded — nothing updates
+            # them
             return loss, dadapters
 
         return adapter_vag
+
+    if moe is not None:
+        def adapter_loss(adapters, tokens):
+            return moe_pipeline_loss_fn(
+                apply_pipeline_lora(frozen_params, adapters, lora),
+                tokens, config=model_config, moe=moe, pcfg=pcfg,
+                mesh=mesh, llama=llama,
+            )
+
+        return jax.value_and_grad(adapter_loss)
 
     loss_fn = llama_pipeline_loss_fn if llama else pipeline_loss_fn
 
@@ -418,6 +452,7 @@ def make_lora_pipeline_train_step(
     adapter_state: dict,
     lora: LoraConfig,
     llama: bool = False,
+    moe: Any = None,
 ):
     """Compile one adapter-only optimizer step over a pipeline mesh,
     either schedule (:func:`lora_pipeline_value_and_grad`).  The frozen
@@ -425,16 +460,22 @@ def make_lora_pipeline_train_step(
     ``"pipe"``-sharded layout, never donated); gradient accumulation
     composes via the shared fp32 chunked scan over the batch axis
     (``accum_axis=1`` — axis 0 is the pipeline's own microbatch
-    schedule).
+    schedule).  ``moe``: adapter-only fine-tuning of a frozen routed
+    base through the MoE pipeline objective (no remat — the flat MoE
+    constraint).
     """
     from .pipeline import pipeline_batch_sharding
     from .train import accumulate_value_and_grad, make_optimizer
 
+    if moe is not None:
+        from .moe import _require_no_remat
+
+        _require_no_remat(train_config)
     optimizer = make_optimizer(train_config)
     compute_grads = accumulate_value_and_grad(
         lora_pipeline_value_and_grad(
             mesh, model_config, pcfg, frozen_params, lora, llama=llama,
-            remat=getattr(train_config, "remat", False),
+            remat=getattr(train_config, "remat", False), moe=moe,
         ),
         train_config.grad_accum,
         accum_axis=1,

@@ -1039,6 +1039,123 @@ def zigzag_pipeline_loss_fn(
     return jnp.sum(nll * valid) / (n_micro * b * (seq - 1))
 
 
+def _zigzag_masked_nll(valid_tbl: jax.Array, seq_size: int):
+    """The zig-zag variant of :func:`_sp_masked_nll`: targets arrive
+    pre-shifted-and-permuted (computed outside the body), and validity
+    is the static permuted table row for this ``"seq"`` shard (the slot
+    holding natural position ``S-1`` has no target) — same global
+    ``B * (S_global - 1)`` normalization, so the 1F1B epilogue's psums
+    reassemble exactly the GPipe zig-zag objective's mean.
+    Collective-free (``axis_index`` is a constant per shard)."""
+
+    def nll(logits, next_t):
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        token_nll = -jnp.take_along_axis(
+            log_probs, next_t[..., None], axis=-1
+        )[..., 0]
+        valid = valid_tbl[jax.lax.axis_index("seq")]
+        s_loc = next_t.shape[-1]
+        total = next_t.shape[0] * (seq_size * s_loc - 1)
+        return jnp.sum(token_nll * valid[None, :]) / total
+
+    return nll
+
+
+def zigzag_one_f_one_b_value_and_grad(
+    params: dict,
+    tokens: jax.Array,
+    config,
+    pcfg: "PipelineConfig",
+    mesh: Mesh,
+    llama: bool = False,
+    remat: bool = False,
+):
+    """``(loss, grads)`` for the zig-zag pipeline objective via the 1F1B
+    schedule — gradient-equal to autodiff of
+    :func:`zigzag_pipeline_loss_fn` (same permuted layout and mask,
+    explicitly-scheduled backward).  The permutation work all happens
+    OUTSIDE the manual body: tokens and next-token targets permute with
+    static gathers, positions ride permuted (gpt: ``pos_embed[perm]``;
+    llama: the per-shard RoPE table), and the body's sp seams get the
+    identity targets fn plus the permuted-validity masked NLL — the
+    slot machinery is untouched."""
+    from .zigzag import zigzag_permutation
+
+    if getattr(config, "sliding_window", None) is not None:
+        raise ValueError(
+            "sliding_window does not compose with the zig-zag schedule; "
+            "use plain pp x sp (windowed ring attention inside stages)"
+        )
+    n_micro, b, seq = tokens.shape
+    if n_micro != pcfg.n_microbatches:
+        raise ValueError(
+            f"tokens have {n_micro} microbatches, config says "
+            f"{pcfg.n_microbatches}"
+        )
+    sp = mesh.shape.get("seq", 1)
+    if sp < 2:
+        raise ValueError(
+            "the zig-zag pipeline objective needs a (pipe, data, seq) "
+            "mesh with seq >= 2"
+        )
+    perm = zigzag_permutation(seq, sp)
+    perm_j = jnp.asarray(perm)
+    tokens_zz = tokens[:, :, perm_j]
+    next_tokens = jnp.concatenate(
+        [tokens[:, :, 1:], jnp.zeros_like(tokens[:, :, :1])], axis=2
+    )
+    targets_zz = next_tokens[:, :, perm_j]
+    valid_tbl = jnp.asarray(perm < seq - 1, jnp.float32).reshape(
+        sp, seq // sp
+    )
+
+    attend = _stage_zigzag_attention(mesh)
+    if llama:
+        x_micro, head, assemble_grads = _llama_embed_head(params, tokens_zz)
+        stage_apply = partial(
+            _llama_stage_apply, seq_axis="seq",
+            positions_table=perm_j.reshape(sp, seq // sp),
+        )
+        head_loss = _llama_head_loss(config.rms_eps)
+        head_logits = _llama_head_logits(config.rms_eps)
+    else:
+        x_micro, head, assemble_grads = _gpt_embed_head(
+            params, tokens_zz, positions=perm_j
+        )
+        stage_apply = None
+        head_loss = _gpt_head_loss
+        head_logits = _gpt_head_logits
+
+    stage_specs = stage_partition_specs(params["stages"], mesh)
+    body = partial(
+        _one_f_one_b_body,
+        config=config,
+        n_micro=pcfg.n_microbatches,
+        axis_name="pipe",
+        axis_size=mesh.shape["pipe"],
+        data_size=mesh.shape["data"],
+        remat=remat,
+        tp_size=mesh.shape.get("model", 1),
+        seq_size=sp,
+        attention_fn=attend,
+        stage_apply=stage_apply,
+        head_loss=head_loss,
+        head_logits=head_logits,
+        sp_targets_fn=lambda t: t,  # targets precomputed above
+        sp_nll_fn=_zigzag_masked_nll(valid_tbl, sp),
+    )
+    loss, dstages, dhead, dx_micro = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_specs, P(), _act_spec(mesh), _act_spec(mesh)),
+        out_specs=(P(), stage_specs, P(), _act_spec(mesh)),
+        check_vma=False,
+    )(params["stages"], head, x_micro, targets_zz)
+
+    inv_m = 1.0 / pcfg.n_microbatches
+    return loss * inv_m, assemble_grads(dstages, dhead, dx_micro, inv_m)
+
+
 def make_zigzag_pipeline_train_step(
     mesh: Mesh,
     config,
@@ -1047,16 +1164,32 @@ def make_zigzag_pipeline_train_step(
     state: dict,
     llama: bool = False,
 ):
-    """Compile one pp x dp x sp optimizer step on the zig-zag objective
-    (:func:`zigzag_pipeline_loss_fn`) — the same
-    :func:`.train.make_train_step` seams every pipeline step uses."""
+    """Compile one pp x dp x sp optimizer step on the zig-zag objective,
+    either schedule — GPipe differentiates the lockstep forward
+    (:func:`zigzag_pipeline_loss_fn`); 1F1B uses the explicitly
+    scheduled backward (:func:`zigzag_one_f_one_b_value_and_grad`) —
+    through the same :func:`.train.make_train_step` seams every
+    pipeline step uses."""
     from .train import make_train_step
 
+    remat = getattr(train_config, "remat", False)
+    if pcfg.schedule == "1f1b":
+        return make_train_step(
+            mesh, config, train_config, state,
+            value_and_grad_fn=partial(
+                zigzag_one_f_one_b_value_and_grad,
+                config=config, pcfg=pcfg, mesh=mesh, llama=llama,
+                remat=remat,
+            ),
+            state_shardings_fn=pipeline_state_shardings,
+            batch_sharding_fn=pipeline_batch_sharding,
+            accum_axis=1,
+        )
     return make_train_step(
         mesh, config, train_config, state,
         loss=partial(
             zigzag_pipeline_loss_fn, config=config, pcfg=pcfg, mesh=mesh,
-            llama=llama, remat=getattr(train_config, "remat", False),
+            llama=llama, remat=remat,
         ),
         state_shardings_fn=pipeline_state_shardings,
         batch_sharding_fn=pipeline_batch_sharding,
@@ -1342,6 +1475,8 @@ def _one_f_one_b_body(
     head_logits=None,
     moe_aux: bool = False,
     aux_cot: float = 0.0,
+    sp_targets_fn=None,
+    sp_nll_fn=None,
 ):
     """Per-stage 1F1B schedule (inside a fully-manual ``shard_map`` over
     every mesh axis — see the module docstring for why partial-manual is
@@ -1403,8 +1538,19 @@ def _one_f_one_b_body(
         # across "seq" shards — depends on the tokens alone, so it runs
         # ONCE here instead of inside every slot (keeping the per-slot
         # head computation collective-free and gateable to the last
-        # stage)
-        next_targets_micro = _sp_shift_targets(tokens_micro, seq_size)
+        # stage).  ``sp_targets_fn``/``sp_nll_fn`` are the zig-zag
+        # seams: the permuted layout precomputes its (permuted) targets
+        # outside the body (identity here) and masks by the static
+        # permuted-validity table instead of the last-global-position
+        # rule.
+        _targets = sp_targets_fn or (
+            lambda t: _sp_shift_targets(t, seq_size)
+        )
+        _sp_nll = sp_nll_fn or (
+            lambda logits, next_t: _sp_masked_nll(logits, next_t,
+                                                  seq_size)
+        )
+        next_targets_micro = _targets(tokens_micro)
 
     def uniform_slot(carry, tables):
         """The sp variant of ``slot``: ring attention puts collectives
@@ -1452,7 +1598,7 @@ def _one_f_one_b_body(
 
         def do_head(y):
             def head_obj(h, yy):
-                return _sp_masked_nll(head_logits(h, yy), next_t, seq_size)
+                return _sp_nll(head_logits(h, yy), next_t)
 
             loss_m, (dhead, dy) = jax.value_and_grad(
                 head_obj, argnums=(0, 1)
@@ -1768,20 +1914,25 @@ def _one_f_one_b_body(
     return loss, dstages, dhead, dx_micro
 
 
-def _gpt_embed_head(params: dict, tokens: jax.Array):
+def _gpt_embed_head(params: dict, tokens: jax.Array,
+                    positions: jax.Array | None = None):
     """The gpt family's outside-the-pipeline pieces for a 1F1B backward:
     embedded microbatches (with the embed vjp), the loss-head leaves,
     and the grads assembler that folds the body's raw sums into the
     final gradient pytree (embedding lookup cotangents from stage 0,
     tied-embedding unembed contribution from the last stage — summed).
-    One implementation for the dense AND MoE 1F1B callers."""
+    One implementation for the dense AND MoE 1F1B callers.
+    ``positions`` (static-content int32 ``[S]``) overrides the natural
+    positional indices — the zig-zag objective passes its permutation
+    so slot ``i`` embeds position ``perm[i]``."""
     seq = tokens.shape[-1]
 
     def embed_fn(embed_params):
-        return (
-            embed_params["embed"][tokens]
-            + embed_params["pos_embed"][:seq]
+        pos = (
+            embed_params["pos_embed"][:seq] if positions is None
+            else embed_params["pos_embed"][positions]
         )
+        return embed_params["embed"][tokens] + pos
 
     embed_params = {
         "embed": params["embed"], "pos_embed": params["pos_embed"]

@@ -221,18 +221,14 @@ def train(args) -> dict:
         # the pipelined stack (either family) runs over a dedicated
         # ("pipe","data"[,"model"|"seq"]) mesh
         if args.zigzag:
-            # zig-zag inside the GPipe stages: load-balanced causal sp
-            # (zigzag_pipeline_loss_fn); the combos its objective cannot
-            # express fail fast rather than silently ignore flags
+            # zig-zag inside the pipeline stages: load-balanced causal
+            # sp, GPipe (autodiff) or 1F1B (explicit backward); the
+            # combos its objective cannot express fail fast rather than
+            # silently ignore flags
             if args.seq_parallel < 2:
                 raise SystemExit(
                     "--zigzag with --pipe-parallel needs "
                     "--seq-parallel >= 2"
-                )
-            if args.pipe_schedule != "gpipe":
-                raise SystemExit(
-                    "--zigzag with --pipe-parallel supports "
-                    "--pipe-schedule gpipe only"
                 )
             for flag, bad in (("--moe", args.moe),
                               ("--lora-rank", bool(args.lora_rank)),
@@ -275,20 +271,15 @@ def train(args) -> dict:
         )
     if args.lora_rank:
         # adapters wrap every targeted matmul weight — flat 2-D,
-        # stage-stacked, or 3-D expert stacks (per-expert factors; the
-        # router stays frozen).  Resume, grad-accum, zig-zag (permutes
-        # the batch, not the params), pipelines under BOTH schedules
-        # (1F1B chain-rules stage grads into adapter grads), and flat
-        # MoE all compose; the moe x {zigzag, pipeline} lora
-        # combinations are out of scope and fail fast.
+        # stage-stacked, or per-expert stacks (3-D flat, 4-D stacked;
+        # the router stays frozen).  Resume, grad-accum, zig-zag
+        # (permutes the batch, not the params), pipelines under BOTH
+        # schedules (1F1B chain-rules stage grads into adapter grads),
+        # and MoE — flat or pipelined — all compose; moe x zigzag lora
+        # stays out of scope and fails fast.
         if args.moe and args.zigzag:
             raise SystemExit(
                 "--lora-rank with --moe does not combine with --zigzag"
-            )
-        if args.moe and pipe > 1:
-            raise SystemExit(
-                "--lora-rank with --moe does not combine with "
-                "--pipe-parallel"
             )
     if args.hf_checkpoint:
         if args.moe:
@@ -408,20 +399,36 @@ def train(args) -> dict:
                 )
             if args.lora_rank:
                 # frozen stage-stacked base, params only (no full-model
-                # Adam moments — the LoRA point, same as the flat branch)
+                # Adam moments — the LoRA point, same as the flat branch);
+                # --moe freezes a routed base (per-expert adapters)
                 from .pipeline import (
                     init_llama_pipeline_params,
                     pipeline_param_shardings,
                 )
 
-                state = _lora_base_state(
-                    mesh,
-                    as_llama_pipeline_params(hf_base)
-                    if hf_base is not None
-                    else init_llama_pipeline_params(
+                if args.moe:
+                    from .moe import init_llama_moe_params
+                    from .pipeline import as_llama_pipeline_params as _stack
+
+                    if model_config.n_layers % pipe:
+                        # same clear error the non-MoE init paths raise
+                        # (vs an opaque sharding-divisibility failure at
+                        # placement)
+                        raise SystemExit(
+                            f"n_layers={model_config.n_layers} not "
+                            f"divisible by n_stages={pipe}"
+                        )
+                    base = _stack(init_llama_moe_params(
+                        jax.random.key(args.seed), model_config, moe_config
+                    ))
+                elif hf_base is not None:
+                    base = as_llama_pipeline_params(hf_base)
+                else:
+                    base = init_llama_pipeline_params(
                         jax.random.key(args.seed), model_config, pipe
-                    ),
-                    pipeline_param_shardings,
+                    )
+                state = _lora_base_state(
+                    mesh, base, pipeline_param_shardings,
                 )
             else:
                 if hf_base is not None:
@@ -510,18 +517,32 @@ def train(args) -> dict:
             )
 
             if args.lora_rank:
-                # frozen stage-stacked base, params only (see llama branch)
+                # frozen stage-stacked base, params only (see llama
+                # branch); --moe freezes a routed base
                 from .pipeline import (
                     init_pipeline_params,
                     pipeline_param_shardings,
                 )
 
-                state = _lora_base_state(
-                    mesh,
-                    init_pipeline_params(
+                if args.moe:
+                    from .moe import init_moe_params
+                    from .pipeline import as_pipeline_params as _stack
+
+                    if model_config.n_layers % pipe:
+                        # same clear error the non-MoE init paths raise
+                        raise SystemExit(
+                            f"n_layers={model_config.n_layers} not "
+                            f"divisible by n_stages={pipe}"
+                        )
+                    base = _stack(init_moe_params(
+                        jax.random.key(args.seed), model_config, moe_config
+                    ))
+                else:
+                    base = init_pipeline_params(
                         jax.random.key(args.seed), model_config, pipe
-                    ),
-                    pipeline_param_shardings,
+                    )
+                state = _lora_base_state(
+                    mesh, base, pipeline_param_shardings,
                 )
             else:
                 if args.moe:
@@ -739,6 +760,7 @@ def train(args) -> dict:
         step_fn = make_lora_pipeline_train_step(
             mesh, model_config, pipe_config, train_config, lora_frozen,
             state, lora_cfg, llama=args.family == "llama",
+            moe=(moe_config if args.moe else None),
         )
     elif args.lora_rank:
         from functools import partial as _partial

@@ -295,3 +295,69 @@ def test_trainer_1f1b_lora_learns():
     losses = result["losses"]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_lora_moe_pipeline_both_schedules():
+    # the last lora-matrix hole (VERDICT r4 next #9): adapter-only
+    # fine-tuning of a frozen ROUTED base through the pipeline — expert
+    # stacks get per-expert stage-stacked factors (4-D), the router
+    # stays frozen, and the 1F1B chain-ruled adapter grads must match
+    # GPipe autodiff of the same routed objective
+    from kube_sqs_autoscaler_tpu.workloads.moe import MoeConfig
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        init_moe_pipeline_train_state,
+        place_pipeline_state,
+    )
+
+    moe = MoeConfig(n_experts=4, top_k=2)
+    mesh = make_pipeline_mesh(jax.devices()[:4], pipe_parallel=2)
+    base_state = place_pipeline_state(
+        mesh,
+        init_moe_pipeline_train_state(jax.random.key(11), TINY, moe,
+                                      TrainConfig(), n_stages=2),
+    )
+    frozen = base_state["params"]
+    lora = LoraConfig(rank=2)
+    tokens = jax.device_put(microtokens(m=2, seed=12),
+                            pipeline_batch_sharding(mesh))
+
+    # expert adapters exist in the 4-D per-expert stage-stacked shape
+    adapters = init_pipeline_lora_params(jax.random.key(13), frozen, lora)
+    assert adapters["stages"]["w_up_experts"]["a"].shape == (
+        TINY.n_layers, moe.n_experts, TINY.d_model, lora.rank
+    )
+    assert adapters["stages"]["w_up_experts"]["b"].shape == (
+        TINY.n_layers, moe.n_experts, lora.rank, TINY.d_ff
+    )
+
+    def two(schedule):
+        st = init_pipeline_lora_train_state(
+            jax.random.key(14), frozen, lora, TrainConfig()
+        )
+        step = make_lora_pipeline_train_step(
+            mesh, TINY, PipelineConfig(n_microbatches=2,
+                                       schedule=schedule),
+            TrainConfig(), frozen, st, lora,
+            moe=moe,
+        )
+        st, l1 = step(st, tokens)
+        st, l2 = step(st, tokens)
+        return float(l1), float(l2)
+
+    g1, g2 = two("gpipe")
+    f1, f2 = two("1f1b")
+    np.testing.assert_allclose(f1, g1, rtol=1e-5)
+    np.testing.assert_allclose(f2, g2, rtol=2e-3)
+    assert g2 < g1  # adapters actually optimize the routed objective
+
+
+def test_trainer_binary_lora_moe_pipeline():
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    main([
+        "--steps", "2", "--batch-size", "8", "--seq-len", "16",
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128",
+        "--pipe-parallel", "2", "--pipe-microbatches", "2",
+        "--moe", "--moe-experts", "4", "--lora-rank", "2",
+    ])

@@ -154,6 +154,54 @@ def test_llama_moe_pp_tp_runs():
     assert float(l2) < float(l1)  # optimizing
 
 
+@pytest.mark.parametrize("llama", [False, True])
+def test_zigzag_1f1b_matches_zigzag_gpipe(llama):
+    # the last training-matrix hole (VERDICT r4 next #9): the zig-zag
+    # objective under the explicitly-scheduled 1F1B backward — the
+    # permuted layout precomputes its targets outside the body and the
+    # sp seams carry the permuted-validity mask, so both schedules
+    # compute the same mean (and step-2 agreement pins the gradients)
+    from kube_sqs_autoscaler_tpu.workloads.pipeline import (
+        make_zigzag_pipeline_train_step,
+    )
+
+    cfg = LCFG if llama else CFG
+    init = (init_llama_pipeline_train_state if llama
+            else init_pipeline_train_state)
+    mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                              seq_parallel=2)
+
+    def two(schedule):
+        state = place_pipeline_state(
+            mesh, init(jax.random.key(3), cfg, TrainConfig(), n_stages=2)
+        )
+        step = make_zigzag_pipeline_train_step(
+            mesh, cfg, PipelineConfig(n_microbatches=2, schedule=schedule),
+            TrainConfig(), state, llama=llama,
+        )
+        toks = tokens_for(mesh)
+        state, l1 = step(state, toks)
+        state, l2 = step(state, toks)
+        return float(l1), float(l2)
+
+    g1, g2 = two("gpipe")
+    f1, f2 = two("1f1b")
+    np.testing.assert_allclose(f1, g1, rtol=1e-5)
+    np.testing.assert_allclose(f2, g2, rtol=2e-3)
+
+
+def test_trainer_binary_zigzag_1f1b():
+    from kube_sqs_autoscaler_tpu.workloads.trainer import main
+
+    main([
+        "--steps", "2", "--batch-size", "4", "--seq-len", "32",
+        "--vocab-size", "256", "--d-model", "64", "--n-heads", "4",
+        "--n-layers", "2", "--d-ff", "128",
+        "--pipe-parallel", "2", "--seq-parallel", "2", "--zigzag",
+        "--pipe-schedule", "1f1b", "--pipe-microbatches", "2",
+    ])
+
+
 def test_trainer_binary_4axis():
     # the CLI end to end: --pipe-parallel 2 --model-parallel 2
     # --seq-parallel 2 trains on the 8-device mesh (VERDICT r4 next #5
