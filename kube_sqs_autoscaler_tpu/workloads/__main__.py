@@ -102,8 +102,10 @@ def main(argv=None) -> None:
              "warped-target sample; requires --generate-tokens >= 1; "
              "composes with --continuous (draft-and-verify rounds inside "
              "the rolling slots, per-slot accept counts), with "
-             "--model-parallel, --quantize-kv, and --prefix-ids (all "
-             "three at once only under --continuous); not with --beams)",
+             "--model-parallel, --quantize-kv, and --prefix-ids — except "
+             "--prefix-ids with --quantize-kv under --continuous (the "
+             "rolling slot machine takes no prefix in the int8 layout); "
+             "not with --beams)",
     )
     parser.add_argument(
         "--speculative-draft-tokens", type=int, default=4, metavar="K",
@@ -129,7 +131,8 @@ def main(argv=None) -> None:
              "generated token; requires --generate-tokens >= 1; composes "
              "with --continuous — rolling slots store int8 — with "
              "--model-parallel — codes/scales shard by head like the "
-             "bf16 cache — and with --prefix-ids; not with --beams)",
+             "bf16 cache — with --prefix-ids, with --beams, and with "
+             "--speculative-draft-layers)",
     )
     parser.add_argument(
         "--result-queue-url", default="",
@@ -157,8 +160,10 @@ def main(argv=None) -> None:
              "prompt, minus its repeated prefill cost; "
              "--generate-tokens >= 1; composes with --continuous — slots "
              "start past the shared prefix — with --model-parallel — the "
-             "prefix shards by head over the mesh — and with "
-             "--quantize-kv)",
+             "prefix shards by head over the mesh — with --quantize-kv "
+             "(except under --continuous: the rolling slot machine takes "
+             "no prefix in the int8 layout), --beams, and "
+             "--speculative-draft-layers)",
     )
     parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
@@ -180,20 +185,8 @@ def main(argv=None) -> None:
         ):
             if bad:
                 raise SystemExit(f"--beams does not support {flag}")
-    if args.quantize_kv:
-        for flag, bad in (
-            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
-            ("--beams > 1", args.beams > 1),
-            ("--model-parallel with --speculative-draft-layers (the "
-             "sharded speculative factory streams bf16 caches; the "
-             "--continuous slot machine does shard int8 speculative "
-             "slots)",
-             bool(args.model_parallel)
-             and bool(args.speculative_draft_layers)
-             and not args.continuous),
-        ):
-            if bad:
-                raise SystemExit(f"--quantize-kv does not support {flag}")
+    if args.quantize_kv and args.generate_tokens < 1:
+        raise SystemExit("--quantize-kv requires --generate-tokens >= 1")
     prefix_ids: list[int] = []
     if args.prefix_ids:
         try:
@@ -205,23 +198,13 @@ def main(argv=None) -> None:
         if not prefix_ids:
             raise SystemExit("--prefix-ids is empty")
         # the prefix rides the padded cache (bf16 or int8, single-chip
-        # or head-sharded over a (data, model) mesh); the combos whose
-        # decode machinery does not take a prefix fail fast (same
-        # convention as the --quantize-kv combo checks above)
+        # or head-sharded over a (data, model) mesh); the one combo
+        # whose decode machinery does not take a prefix fails fast
         for flag, bad in (
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
             ("--quantize-kv with --continuous (the rolling slot machine "
              "does not take a prefix in the int8 layout)",
              args.quantize_kv and args.continuous),
-            ("--model-parallel with --beams (the sharded beam factory "
-             "takes no prefix)",
-             bool(args.model_parallel) and args.beams > 1),
-            ("--model-parallel with --speculative-draft-layers (the "
-             "sharded speculative factory takes no prefix; the "
-             "--continuous slot machine does take one)",
-             bool(args.model_parallel)
-             and bool(args.speculative_draft_layers)
-             and not args.continuous),
         ):
             if bad:
                 raise SystemExit(f"--prefix-ids does not support {flag}")
@@ -529,6 +512,8 @@ def main(argv=None) -> None:
             beam_run = make_beam_serving_fn(
                 mesh, model_config, params, beams=args.beams,
                 eos_id=service_config.eos_id,
+                prefix_cache=prefix_cache,
+                quantized_cache=service_config.quantized_kv,
             )
             worker_kwargs["generate_fn"] = (
                 lambda p, t, n, lengths: beam_run(p, t, lengths, n)
@@ -554,9 +539,14 @@ def main(argv=None) -> None:
                 lambda p, t, n, lengths: beam_search_jit(
                     p, model_config, t, n, args.beams,
                     eos_id=service_config.eos_id,
-                    attention_fn=_beam_prefill_attention(t.shape[1]),
+                    # under a prefix the suffix prefill runs the chunk
+                    # decoder (no attention override — beam_search
+                    # rejects the pair, same as decode.generate)
+                    attention_fn=(None if prefix_cache is not None else
+                                  _beam_prefill_attention(t.shape[1])),
                     lengths=lengths,
                     prefix_cache=prefix_cache,
+                    quantized_cache=service_config.quantized_kv,
                 )
             )
         log.info("Beam search: %d beams", args.beams)
@@ -617,6 +607,8 @@ def main(argv=None) -> None:
                 draft_tokens=k, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p,
                 eos_id=service_config.eos_id,
+                prefix_cache=prefix_cache,
+                quantized_cache=service_config.quantized_kv,
             )
             worker_kwargs["generate_fn"] = (
                 lambda p, t, n, lengths: spec_run(

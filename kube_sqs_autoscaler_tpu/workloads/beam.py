@@ -45,6 +45,7 @@ def beam_search(
     lengths: jax.Array | None = None,
     return_all: bool = False,
     prefix_cache: dict | None = None,
+    quantized_cache: bool = False,
 ) -> jax.Array:
     """The best continuation of each prompt under beam search.
 
@@ -58,7 +59,11 @@ def beam_search(
     :func:`.decode.prefill_prefix`) makes the prompts per-request
     suffixes of a shared, once-prefilled prefix; the beam expansion and
     steps are cache-agnostic, so the search equals beam search of the
-    concatenated prompts.
+    concatenated prompts.  ``quantized_cache=True`` searches through the
+    int8 KV cache — the row-repeat and per-step parent gather are
+    layout-agnostic (codes and scales gather exactly like bf16 k/v), so
+    beams stream half the cache bytes per step (scores match the
+    full-precision search to int8 rounding).
     """
     from .decode import _check_prefix_budget, _check_prefix_layout
 
@@ -69,9 +74,16 @@ def beam_search(
         raise ValueError(f"beams must be >= 1, got {beams}")
     _check_prefix_budget(prefix_cache, prompt_len, num_tokens, config)
     if prefix_cache is not None:
-        # beams decode the full-precision cache only
-        _check_prefix_layout(prefix_cache, False)
-    prefill_fn, step_fn, _, prefix_prefill = _family_ops(config)
+        if attention_fn is not None:
+            # same contract as decode.generate: the suffix prefill runs
+            # the chunk decoder, which has no attention override
+            raise ValueError(
+                "attention_fn does not apply with prefix_cache (the "
+                "suffix prefill runs the chunk decoder); drop one"
+            )
+        _check_prefix_layout(prefix_cache, quantized_cache)
+    prefill_fn, step_fn, _, prefix_prefill = _family_ops(
+        config, quantized_cache)
     width = beams
     rows = jnp.arange(batch)
 
@@ -163,6 +175,8 @@ def make_beam_serving_fn(
     beams: int,
     length_penalty: float = 0.0,
     eos_id: int | None = None,
+    prefix_cache: dict | None = None,
+    quantized_cache: bool = False,
 ):
     """Compile :func:`beam_search` over a ``(data, model)`` serving mesh.
 
@@ -172,12 +186,20 @@ def make_beam_serving_fn(
     their Megatron/head shardings — the same layout contract as
     :func:`.decode.compile_serving_fns`.  Prefill runs the config's
     default attention (window-aware for llama), like the sharded
-    generate path.  Returns ``run(params, prompt, lengths, num_tokens)
-    -> [B, num_tokens]`` with ``num_tokens`` static.
+    generate path.  ``prefix_cache`` pins a shared prompt prefix into
+    the compiled search as a replicated-batch operand (heads over
+    ``"model"`` via :func:`.decode.prefix_cache_shardings`);
+    ``quantized_cache`` searches the int8 KV layout.  Returns
+    ``run(params, prompt, lengths, num_tokens) -> [B, num_tokens]`` with
+    ``num_tokens`` static.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from .decode import require_serving_mesh
+    from .decode import (
+        _check_prefix_layout,
+        prefix_cache_shardings,
+        require_serving_mesh,
+    )
     from .train import param_shardings
 
     require_serving_mesh(mesh)
@@ -185,17 +207,41 @@ def make_beam_serving_fn(
     tokens_2d = NamedSharding(mesh, P("data", None))
     tokens_1d = NamedSharding(mesh, P("data"))
 
-    def run(params, prompt, lengths, num_tokens):
+    if prefix_cache is None:
+
+        def run(params, prompt, lengths, num_tokens):
+            return beam_search(
+                params, config, prompt, num_tokens, beams=beams,
+                length_penalty=length_penalty, eos_id=eos_id,
+                lengths=lengths, quantized_cache=quantized_cache,
+            )
+
+        return jax.jit(
+            run,
+            static_argnames=("num_tokens",),
+            in_shardings=(p_shard, tokens_2d, tokens_1d),
+            out_shardings=tokens_2d,
+        )
+
+    _check_prefix_layout(prefix_cache, quantized_cache)
+    pfx_shard = prefix_cache_shardings(mesh, prefix_cache)
+    placed_prefix = jax.device_put(prefix_cache, pfx_shard)
+
+    def run_pfx(params, prefix, prompt, lengths, num_tokens):
         return beam_search(
             params, config, prompt, num_tokens, beams=beams,
             length_penalty=length_penalty, eos_id=eos_id, lengths=lengths,
+            prefix_cache=prefix, quantized_cache=quantized_cache,
         )
 
-    return jax.jit(
-        run,
+    fn = jax.jit(
+        run_pfx,
         static_argnames=("num_tokens",),
-        in_shardings=(p_shard, tokens_2d, tokens_1d),
+        in_shardings=(p_shard, pfx_shard, tokens_2d, tokens_1d),
         out_shardings=tokens_2d,
+    )
+    return lambda params, prompt, lengths, num_tokens: fn(
+        params, placed_prefix, prompt, lengths, num_tokens
     )
 
 
@@ -203,7 +249,7 @@ def make_beam_serving_fn(
     jax.jit,
     static_argnames=(
         "config", "num_tokens", "beams", "length_penalty", "eos_id",
-        "attention_fn", "return_all",
+        "attention_fn", "return_all", "quantized_cache",
     ),
 )
 def beam_search_jit(
@@ -218,11 +264,12 @@ def beam_search_jit(
     lengths: jax.Array | None = None,
     return_all: bool = False,
     prefix_cache: dict | None = None,
+    quantized_cache: bool = False,
 ):
     """Compiled :func:`beam_search` (prefill + the whole scan)."""
     return beam_search(
         params, config, prompt, num_tokens, beams=beams,
         length_penalty=length_penalty, eos_id=eos_id,
         attention_fn=attention_fn, lengths=lengths, return_all=return_all,
-        prefix_cache=prefix_cache,
+        prefix_cache=prefix_cache, quantized_cache=quantized_cache,
     )

@@ -453,6 +453,8 @@ def make_speculative_serving_fn(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    prefix_cache: dict | None = None,
+    quantized_cache: bool = False,
 ):
     """Compile the draft-and-verify loop over a ``(data, model)`` serving
     mesh: batch rows shard over ``data``, both models' weights and KV
@@ -461,6 +463,14 @@ def make_speculative_serving_fn(
     draft steps, and the per-row rollback are all row-local, so nothing
     about the speculative schedule fights the partitioner).
 
+    ``prefix_cache`` pins a shared prompt prefix into the compiled loop
+    as a replicated-batch operand (heads over ``"model"`` via
+    :func:`.decode.prefix_cache_shardings`); the self-draft's prefix
+    cache is derived per :func:`draft_prefix_from_target` — no second
+    prefill.  ``quantized_cache`` streams both models' caches as int8
+    (the caches are internal to the compiled loop, so only the flag
+    changes; a given ``prefix_cache`` must match the layout).
+
     Returns ``run(params_target, params_draft, prompt, lengths, rng,
     num_tokens) -> [B, num_tokens]`` with ``num_tokens`` static; ``rng``
     is always an operand (ignored under greedy), so greedy and sampled
@@ -468,7 +478,11 @@ def make_speculative_serving_fn(
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from .decode import require_serving_mesh
+    from .decode import (
+        _check_prefix_layout,
+        prefix_cache_shardings,
+        require_serving_mesh,
+    )
     from .train import param_shardings
 
     require_serving_mesh(mesh)
@@ -483,20 +497,56 @@ def make_speculative_serving_fn(
     tokens_1d = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())
 
-    def run(params_t, params_d, prompt, lengths, rng, num_tokens):
+    if prefix_cache is None:
+
+        def run(params_t, params_d, prompt, lengths, rng, num_tokens):
+            return speculative_generate(
+                params_t, config_target, params_d, config_draft, prompt,
+                num_tokens, draft_tokens=draft_tokens, lengths=lengths,
+                temperature=temperature,
+                rng=rng if temperature > 0.0 else None,
+                top_k=top_k, top_p=top_p, eos_id=eos_id,
+                quantized_cache=quantized_cache,
+            )
+
+        return jax.jit(
+            run,
+            static_argnames=("num_tokens",),
+            in_shardings=(p_shard_t, p_shard_d, tokens_2d, tokens_1d,
+                          rep),
+            out_shardings=tokens_2d,
+        )
+
+    _check_prefix_layout(prefix_cache, quantized_cache)
+    draft_prefix = draft_prefix_from_target(prefix_cache,
+                                            config_draft.n_layers)
+    pfx_shard_t = prefix_cache_shardings(mesh, prefix_cache)
+    pfx_shard_d = prefix_cache_shardings(mesh, draft_prefix)
+    placed_t = jax.device_put(prefix_cache, pfx_shard_t)
+    placed_d = jax.device_put(draft_prefix, pfx_shard_d)
+
+    def run_pfx(params_t, params_d, pfx_t, pfx_d, prompt, lengths, rng,
+                num_tokens):
         return speculative_generate(
             params_t, config_target, params_d, config_draft, prompt,
             num_tokens, draft_tokens=draft_tokens, lengths=lengths,
             temperature=temperature,
             rng=rng if temperature > 0.0 else None,
             top_k=top_k, top_p=top_p, eos_id=eos_id,
+            quantized_cache=quantized_cache,
+            prefix_cache=pfx_t, draft_prefix_cache=pfx_d,
         )
 
-    return jax.jit(
-        run,
+    fn = jax.jit(
+        run_pfx,
         static_argnames=("num_tokens",),
-        in_shardings=(p_shard_t, p_shard_d, tokens_2d, tokens_1d, rep),
+        in_shardings=(p_shard_t, p_shard_d, pfx_shard_t, pfx_shard_d,
+                      tokens_2d, tokens_1d, rep),
         out_shardings=tokens_2d,
+    )
+    return lambda params_t, params_d, prompt, lengths, rng, num_tokens: (
+        fn(params_t, params_d, placed_t, placed_d, prompt, lengths, rng,
+           num_tokens)
     )
 
 

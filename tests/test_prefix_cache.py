@@ -404,17 +404,10 @@ def test_worker_binary_prefix_combo_rejections():
 
     base = ["--demo", "1", "--seq-len", "8", "--generate-tokens", "4",
             "--prefix-ids", "1,2"]
-    for extra, match in (
-        (["--quantize-kv", "--continuous"], "quantize-kv"),
-        # --model-parallel alone now composes (the prefix shards by head
-        # over the serving mesh); only the sharded factories that take no
-        # prefix still fail fast
-        (["--model-parallel", "1", "--beams", "2"], "beams"),
-        (["--model-parallel", "1", "--speculative-draft-layers", "1"],
-         "speculative"),
-    ):
-        with pytest.raises(SystemExit, match=match):
-            main(base + extra)
+    # the one remaining prefix combo hole: the int8 slot machine takes
+    # no prefix
+    with pytest.raises(SystemExit, match="quantize-kv"):
+        main(base + ["--quantize-kv", "--continuous"])
     with pytest.raises(SystemExit, match="generate-tokens"):
         main(["--demo", "1", "--seq-len", "8", "--prefix-ids", "1,2"])
     with pytest.raises(SystemExit, match="integers"):

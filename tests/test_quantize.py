@@ -272,10 +272,12 @@ def test_worker_binary_quantize_kv_flag():
                  "--temperature", "0.7"])
     with pytest.raises(SystemExit, match="generate-tokens"):
         worker_main(["--demo", "1", "--quantize-kv"])
-    # --model-parallel alone now composes (codes/scales shard by head);
-    # the sharded speculative factory still streams bf16, so the triple
-    # fails fast
-    with pytest.raises(SystemExit, match="speculative"):
-        worker_main(["--demo", "1", "--quantize-kv", "--generate-tokens",
-                     "2", "--model-parallel", "2",
-                     "--speculative-draft-layers", "1"])
+    # the triple quantize-kv x model-parallel x speculative now serves
+    # (the sharded factory streams int8 caches for both models)
+    worker_main(["--demo", "2", "--quantize-kv", "--generate-tokens",
+                 "3", "--model-parallel", "2", "--batch-size", "4",
+                 "--seq-len", "8", "--speculative-draft-layers", "1"])
+    # so does beam search over the int8 cache
+    worker_main(["--demo", "2", "--quantize-kv", "--generate-tokens",
+                 "3", "--beams", "2", "--batch-size", "2",
+                 "--seq-len", "8"])
