@@ -102,10 +102,8 @@ def main(argv=None) -> None:
              "warped-target sample; requires --generate-tokens >= 1; "
              "composes with --continuous (draft-and-verify rounds inside "
              "the rolling slots, per-slot accept counts), with "
-             "--model-parallel, --quantize-kv, and --prefix-ids — except "
-             "--prefix-ids with --quantize-kv under --continuous (the "
-             "rolling slot machine takes no prefix in the int8 layout); "
-             "not with --beams)",
+             "--model-parallel, --quantize-kv, and --prefix-ids, in any "
+             "combination; not with --beams)",
     )
     parser.add_argument(
         "--speculative-draft-tokens", type=int, default=4, metavar="K",
@@ -160,10 +158,9 @@ def main(argv=None) -> None:
              "prompt, minus its repeated prefill cost; "
              "--generate-tokens >= 1; composes with --continuous — slots "
              "start past the shared prefix — with --model-parallel — the "
-             "prefix shards by head over the mesh — with --quantize-kv "
-             "(except under --continuous: the rolling slot machine takes "
-             "no prefix in the int8 layout), --beams, and "
-             "--speculative-draft-layers)",
+             "prefix shards by head over the mesh — with --quantize-kv, "
+             "--beams, and --speculative-draft-layers, in any "
+             "combination)",
     )
     parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
@@ -198,16 +195,12 @@ def main(argv=None) -> None:
         if not prefix_ids:
             raise SystemExit("--prefix-ids is empty")
         # the prefix rides the padded cache (bf16 or int8, single-chip
-        # or head-sharded over a (data, model) mesh); the one combo
-        # whose decode machinery does not take a prefix fails fast
-        for flag, bad in (
-            ("--generate-tokens >= 1 required", args.generate_tokens < 1),
-            ("--quantize-kv with --continuous (the rolling slot machine "
-             "does not take a prefix in the int8 layout)",
-             args.quantize_kv and args.continuous),
-        ):
-            if bad:
-                raise SystemExit(f"--prefix-ids does not support {flag}")
+        # or head-sharded over a (data, model) mesh) through every
+        # decode mode — only the generate requirement remains
+        if args.generate_tokens < 1:
+            raise SystemExit(
+                "--prefix-ids requires --generate-tokens >= 1"
+            )
     if args.top_k < 0:
         raise SystemExit(f"--top-k {args.top_k} must be >= 0 (0 = off)")
     if not 0.0 < args.top_p <= 1.0:

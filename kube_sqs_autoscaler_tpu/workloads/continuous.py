@@ -107,7 +107,14 @@ def _row_prefill(params, prompt, length, config, family, quantized_kv,
     """One prompt's prefill as a ``[1, P]`` batch through the family's
     layout variant; returns ``(logits [1, V], row_cache)``."""
     if prefix_len:
-        if family == "llama":
+        if quantized_kv:
+            if family == "llama":
+                from .llama import (
+                    llama_quantized_prefill_with_prefix as pf,
+                )
+            else:
+                from .decode import quantized_prefill_with_prefix as pf
+        elif family == "llama":
             from .llama import llama_prefill_with_prefix as pf
         else:
             from .decode import prefill_with_prefix as pf
@@ -263,14 +270,14 @@ class ContinuousBatcher:
         self._prefix_cache = prefix_cache
         if prefix_cache is not None:
             # slots start past a shared, once-prefilled prefix (see
-            # decode.prefill_prefix); the prefix rides the full-precision
-            # padded cache layout — single-chip, or head-sharded over a
-            # (data, model) mesh (the broadcast rows land under
-            # cache_shardings in the mesh block below)
-            if quantized_kv:
-                raise ValueError(
-                    "prefix_cache does not combine with quantized_kv"
-                )
+            # decode.prefill_prefix) in the decode path's cache layout —
+            # bf16 or int8 (quantized_kv takes a quantized_prefill_prefix
+            # cache), single-chip or head-sharded over a (data, model)
+            # mesh (the broadcast rows land under cache_shardings in the
+            # mesh block below)
+            from .decode import _check_prefix_layout
+
+            _check_prefix_layout(prefix_cache, quantized_kv)
             self.prefix_len = int(prefix_cache["length"][0])
         if draft_layers:
             # speculative slots: early-exit self-draft inside the slot

@@ -186,17 +186,75 @@ def test_continuous_batcher_with_prefix_equals_concat(gpt_params):
                                       err_msg=f"request {idx}")
 
 
-def test_continuous_prefix_rejects_quantized_slots(gpt_params):
+def test_continuous_prefix_layout_mismatch_rejected(gpt_params):
+    # int8 slots take an int8 prefix; a bf16 prefix cache fails loudly
+    # instead of KeyError-ing deep inside the chunk decoder
     from kube_sqs_autoscaler_tpu.workloads.continuous import (
         ContinuousBatcher,
     )
 
     pc = prefill_prefix(gpt_params, ids((4,), 22), TINY)
-    with pytest.raises(ValueError, match="quantized_kv"):
+    with pytest.raises(ValueError, match="layout mismatch"):
         ContinuousBatcher(
             gpt_params, TINY, batch_size=2, prompt_len=8,
             generate_tokens=4, prefix_cache=pc, quantized_kv=True,
         )
+
+
+def test_continuous_quantized_prefix_equals_quantized_concat(gpt_params):
+    # the LAST serve-side composition hole (prefix x int8 x continuous):
+    # int8 slots start past a quantized shared prefix; greedy outputs
+    # equal generate(quantized_cache=True) of each concatenated prompt
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.decode import (
+        quantized_prefill_prefix,
+    )
+    from tests.conftest import drain_batcher
+
+    prefix = ids((6,), 50)
+    pc = quantized_prefill_prefix(gpt_params, prefix, TINY)
+    batcher = ContinuousBatcher(
+        gpt_params, TINY, batch_size=2, prompt_len=8, generate_tokens=5,
+        prefix_cache=pc, quantized_kv=True,
+    )
+    rng = np.random.default_rng(51)
+    requests = [
+        rng.integers(1, TINY.vocab_size, rng.integers(2, 9))
+        .astype(np.int32)
+        for _ in range(4)
+    ]
+    results = drain_batcher(batcher, requests, max_steps=200)
+    assert len(results) == 4
+    for idx, toks in enumerate(requests):
+        concat = jnp.concatenate(
+            [prefix, jnp.asarray(toks, jnp.int32)]
+        )[None, :]
+        ref = np.asarray(generate(gpt_params, concat, 5, TINY,
+                                  quantized_cache=True)[0])
+        np.testing.assert_array_equal(results[idx], ref,
+                                      err_msg=f"request {idx}")
+
+    # the full quadruple — prefix x int8 x continuous x SPECULATIVE:
+    # quantized spec rounds continue past the shared quantized prefix
+    # (the draft's prefix is the layer slice), still bitwise the plain
+    # quantized generate of the concatenated prompts
+    spec_batcher = ContinuousBatcher(
+        gpt_params, TINY, batch_size=2, prompt_len=8, generate_tokens=5,
+        prefix_cache=pc, quantized_kv=True, draft_layers=1,
+        draft_tokens=2,
+    )
+    spec_results = drain_batcher(spec_batcher, requests, max_steps=200)
+    assert len(spec_results) == 4
+    for idx, toks in enumerate(requests):
+        concat = jnp.concatenate(
+            [prefix, jnp.asarray(toks, jnp.int32)]
+        )[None, :]
+        ref = np.asarray(generate(gpt_params, concat, 5, TINY,
+                                  quantized_cache=True)[0])
+        np.testing.assert_array_equal(spec_results[idx], ref,
+                                      err_msg=f"spec request {idx}")
 
 
 def test_worker_binary_continuous_prefix_demo():
@@ -404,10 +462,8 @@ def test_worker_binary_prefix_combo_rejections():
 
     base = ["--demo", "1", "--seq-len", "8", "--generate-tokens", "4",
             "--prefix-ids", "1,2"]
-    # the one remaining prefix combo hole: the int8 slot machine takes
-    # no prefix
-    with pytest.raises(SystemExit, match="quantize-kv"):
-        main(base + ["--quantize-kv", "--continuous"])
+    # every decode mode now takes a prefix — int8 slots included
+    main(base + ["--quantize-kv", "--continuous", "--batch-size", "2"])
     with pytest.raises(SystemExit, match="generate-tokens"):
         main(["--demo", "1", "--seq-len", "8", "--prefix-ids", "1,2"])
     with pytest.raises(SystemExit, match="integers"):
