@@ -113,7 +113,11 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--beams", type=int, default=1, metavar="W",
         help="beam-search generation with W beams (deterministic — does "
-             "not combine with --temperature; 1 = greedy/sampled decode)",
+             "not combine with --temperature or "
+             "--speculative-draft-layers; 1 = greedy/sampled decode; "
+             "composes with --continuous — each rolling slot owns W "
+             "beam rows and finishes independently — with "
+             "--model-parallel, --quantize-kv, and --prefix-ids)",
     )
     parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
@@ -177,7 +181,6 @@ def main(argv=None) -> None:
              args.temperature > 0.0),
             ("--speculative-draft-layers",
              bool(args.speculative_draft_layers)),
-            ("--continuous", args.continuous),
             ("--generate-tokens >= 1 required", args.generate_tokens < 1),
         ):
             if bad:
@@ -497,7 +500,11 @@ def main(argv=None) -> None:
                 )
             )
     if args.beams > 1:
-        if mesh is not None:
+        if args.continuous:
+            # the slot machine hosts the per-slot beam search itself
+            # (ContinuousWorker below gets the beams knob)
+            pass
+        elif mesh is not None:
             # beams over the (data, model) mesh: expanded rows shard over
             # data, weights/caches keep their Megatron shardings
             from .beam import make_beam_serving_fn
@@ -668,6 +675,7 @@ def main(argv=None) -> None:
                 prefix_cache=prefix_cache,
                 draft_layers=args.speculative_draft_layers,
                 draft_tokens=args.speculative_draft_tokens,
+                beams=args.beams,
             )
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
@@ -722,6 +730,7 @@ def main(argv=None) -> None:
             mesh=mesh,
             draft_layers=args.speculative_draft_layers,
             draft_tokens=args.speculative_draft_tokens,
+            beams=args.beams,
         )
         _maybe_serve_metrics(args.metrics_port, cworker)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)

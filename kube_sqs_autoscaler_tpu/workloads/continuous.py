@@ -133,9 +133,16 @@ def _row_prefill(params, prompt, length, config, family, quantized_kv,
     return prefill_fn(params, prompt[None], config, lengths=length[None])
 
 
-def _splice_row_layers(cache, row_cache, row, prefix_len, prompt_len):
+def _splice_row_layers(cache, row_cache, row, prefix_len, prompt_len,
+                       beams: int = 1):
     """Splice a ``[1, ...]`` row cache's prompt positions into slot
-    ``row`` of the batch cache; returns the new layers list."""
+    ``row`` of the batch cache; returns the new layers list.
+
+    ``beams > 1``: the one prefilled row is repeated ``beams`` times and
+    spliced into the slot's contiguous row block
+    ``[row*beams, (row+1)*beams)`` — every beam of a fresh beam slot
+    starts from the same prompt cache (``beams=1`` degenerates to the
+    plain single-row splice)."""
     new_layers = []
     for layer_cache, row_layer in zip(cache["layers"], row_cache["layers"]):
         entry = {}
@@ -147,7 +154,9 @@ def _splice_row_layers(cache, row_cache, row, prefix_len, prompt_len):
             piece = jax.lax.slice_in_dim(
                 piece, prefix_len, prefix_len + prompt_len, axis=2
             )
-            start = (row, 0, prefix_len) + (0,) * (buf.ndim - 3)
+            if beams > 1:
+                piece = jnp.repeat(piece, beams, axis=0)
+            start = (row * beams, 0, prefix_len) + (0,) * (buf.ndim - 3)
             entry[name] = jax.lax.dynamic_update_slice(buf, piece, start)
         new_layers.append(entry)
     return new_layers
@@ -219,6 +228,73 @@ _spec_insert_row = partial(
 )(_spec_insert_row_impl)
 
 
+def _beam_insert_row_impl(
+    params: dict,
+    cache: dict,
+    scores: jax.Array,
+    out: jax.Array,
+    alive: jax.Array,
+    emitted: jax.Array,
+    current: jax.Array,
+    row: jax.Array,
+    prompt: jax.Array,
+    length: jax.Array,
+    config: Any,
+    prompt_len: int,
+    beams: int,
+    family: str = "gpt",
+    quantized_kv: bool = False,
+    prefix_len: int = 0,
+    eos_id: int | None = None,
+    prefix_cache: dict | None = None,
+) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """:func:`_insert_row_impl` for beam slots: one prefill seeds the
+    slot's ``beams`` cache rows and its device-side search state — the
+    first expansion's top-``beams`` tokens become the beams' seeds
+    (scores, first output column, alive mask), exactly the standalone
+    :func:`.beam.beam_search` seeding re-hosted per slot."""
+    logits, row_cache = _row_prefill(
+        params, prompt, length, config, family, quantized_kv, prefix_len,
+        prefix_cache,
+    )
+    new_layers = _splice_row_layers(cache, row_cache, row, prefix_len,
+                                    prompt_len, beams=beams)
+    lengths = jax.lax.dynamic_update_slice(
+        cache["length"],
+        jnp.full((beams,), prefix_len + length, jnp.int32),
+        (row * beams,),
+    )
+    logp = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+    first_scores, first_tokens = jax.lax.top_k(logp, beams)
+    first_tokens = first_tokens.astype(jnp.int32)
+    out_row = jnp.full((beams, out.shape[-1]),
+                       eos_id if eos_id is not None else 0, jnp.int32)
+    out_row = out_row.at[:, 0].set(first_tokens)
+    alive_row = (
+        first_tokens != eos_id if eos_id is not None
+        else jnp.ones((beams,), bool)
+    )
+    scores = jax.lax.dynamic_update_index_in_dim(scores, first_scores,
+                                                 row, 0)
+    out = jax.lax.dynamic_update_index_in_dim(out, out_row, row, 0)
+    alive = jax.lax.dynamic_update_index_in_dim(alive, alive_row, row, 0)
+    emitted = jax.lax.dynamic_update_index_in_dim(
+        emitted, jnp.ones((beams,), jnp.int32), row, 0
+    )
+    current = jax.lax.dynamic_update_slice(current, first_tokens,
+                                           (row * beams,))
+    return ({"layers": new_layers, "length": lengths}, scores, out,
+            alive, emitted, current)
+
+
+_beam_insert_row = partial(
+    jax.jit,
+    static_argnames=("config", "prompt_len", "beams", "family",
+                     "quantized_kv", "prefix_len", "eos_id"),
+    donate_argnums=(1,),
+)(_beam_insert_row_impl)
+
+
 @dataclass
 class _Slot:
     busy: bool = False
@@ -265,7 +341,25 @@ class ContinuousBatcher:
         prefix_cache: dict | None = None,
         draft_layers: int = 0,
         draft_tokens: int = 4,
+        beams: int = 1,
+        length_penalty: float = 0.0,
     ) -> None:
+        if beams < 1:
+            raise ValueError(f"beams={beams} must be >= 1")
+        if beams > 1:
+            # beam slots: each slot owns `beams` contiguous cache rows
+            # and a device-side search state; deterministic by
+            # construction, so the sampling/speculative knobs are out
+            if draft_layers:
+                raise ValueError(
+                    "beams do not combine with draft_layers (beam "
+                    "search is deterministic; speculative rounds are "
+                    "per-row)"
+                )
+            if temperature > 0.0:
+                raise ValueError(
+                    "beams are deterministic; temperature must be 0"
+                )
         self.prefix_len = 0
         self._prefix_cache = prefix_cache
         if prefix_cache is not None:
@@ -325,16 +419,20 @@ class ContinuousBatcher:
         self.quantized_kv = quantized_kv
         self.draft_layers = draft_layers
         self.draft_tokens = draft_tokens
+        self.beams = beams
+        self.length_penalty = length_penalty
         # aggregate speculative stats (per-request stats ride the slots)
         self.spec_rounds = 0
         self.spec_accepted = 0
+        # beam slots own `beams` contiguous cache rows each
+        cache_rows = batch_size * beams
         if prefix_cache is not None:
             # every slot row starts as a copy of the shared prefix (the
             # broadcast is layout-agnostic: gpt and llama caches both
             # put rows on axis 0)
             from .decode import broadcast_prefix
 
-            self.cache = broadcast_prefix(prefix_cache, batch_size)
+            self.cache = broadcast_prefix(prefix_cache, cache_rows)
         elif quantized_kv:
             # slots store int8 codes + per-position scales: half the
             # bytes every engine step streams (see decode's int8 cache),
@@ -342,16 +440,16 @@ class ContinuousBatcher:
             from .decode import init_quantized_cache
 
             self.cache = init_quantized_cache(
-                config, batch_size,
+                config, cache_rows,
                 kv_heads=(config.n_kv_heads if family == "llama"
                           else None),
             )
         elif family == "llama":
             from .llama import init_llama_cache
 
-            self.cache = init_llama_cache(config, batch_size)
+            self.cache = init_llama_cache(config, cache_rows)
         else:
-            self.cache = init_cache(config, batch_size)
+            self.cache = init_cache(config, cache_rows)
         if draft_layers:
             # the draft is the target's first layers: its params are a
             # layer slice, its cache the same layout with fewer layers
@@ -389,8 +487,18 @@ class ContinuousBatcher:
                 self.draft_cache = init_cache(self.draft_config,
                                               batch_size)
         self.slots = [_Slot() for _ in range(batch_size)]
-        # each slot's pending input token for the next decode step
-        self._current = jnp.zeros((batch_size,), jnp.int32)
+        # each slot's pending input token(s) for the next decode step
+        self._current = jnp.zeros((cache_rows,), jnp.int32)
+        if beams > 1:
+            # device-side per-slot search state (the standalone
+            # beam_search's scan carry, re-hosted as rolling state)
+            self._beam_scores = jnp.zeros((batch_size, beams), jnp.float32)
+            self._beam_out = jnp.full(
+                (batch_size, beams, generate_tokens),
+                eos_id if eos_id is not None else 0, jnp.int32,
+            )
+            self._beam_alive = jnp.zeros((batch_size, beams), bool)
+            self._beam_emitted = jnp.zeros((batch_size, beams), jnp.int32)
         if mesh is not None:
             # mesh-sharded slots: batch rows over "data", heads over
             # "model" (the serving layout of decode.cache_shardings);
@@ -412,6 +520,20 @@ class ContinuousBatcher:
             self._rows_shard = NamedSharding(mesh, P("data"))
             self.cache = jax.device_put(self.cache, self._cache_shard)
             self._current = jax.device_put(self._current, self._rows_shard)
+            if beams > 1:
+                # slot-major state: slots over "data" (each slot's beam
+                # rows stay contiguous within one shard because
+                # batch_size % data == 0)
+                self._slot_shard = NamedSharding(mesh, P("data", None))
+                self._beam_scores = jax.device_put(self._beam_scores,
+                                                   self._slot_shard)
+                self._beam_out = jax.device_put(
+                    self._beam_out, NamedSharding(mesh, P("data", None,
+                                                          None)))
+                self._beam_alive = jax.device_put(self._beam_alive,
+                                                  self._slot_shard)
+                self._beam_emitted = jax.device_put(self._beam_emitted,
+                                                    self._slot_shard)
             if draft_layers:
                 self._draft_cache_shard = cache_shardings(
                     mesh, self.draft_cache
@@ -429,7 +551,10 @@ class ContinuousBatcher:
             self._keys = sampling_keys(sample_seed)
         else:
             self._keys = itertools.repeat(None)
-        if draft_layers:
+        if beams > 1:
+            self._insert = self._make_beam_insert()
+            self._beam_step_fn = self._make_beam_step()
+        elif draft_layers:
             self._insert = self._make_spec_insert()
             self._spec = self._make_spec_round()
         else:
@@ -646,6 +771,206 @@ class ContinuousBatcher:
             donate_argnums=(2, 3),
         )
 
+    def _make_beam_insert(self):
+        statics = dict(
+            config=self.config, prompt_len=self.prompt_len,
+            beams=self.beams, family=self.family,
+            quantized_kv=self.quantized_kv,
+            prefix_len=self.prefix_len, eos_id=self.eos_id,
+        )
+        if self.mesh is None:
+            return lambda params, cache, scores, out, alive, emitted, \
+                    current, row, prompt, length: (
+                _beam_insert_row(params, cache, scores, out, alive,
+                                 emitted, current, row, prompt, length,
+                                 prefix_cache=self._prefix_cache,
+                                 **statics)
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        p_shard = param_shardings(self.mesh, self.params)
+        out_shard = NamedSharding(self.mesh, P("data", None, None))
+        state_in = (self._slot_shard, out_shard, self._slot_shard,
+                    self._slot_shard, self._rows_shard)
+        if self._prefix_cache is None:
+            return jax.jit(
+                partial(_beam_insert_row_impl, **statics),
+                in_shardings=(p_shard, self._cache_shard, *state_in,
+                              rep, rep, rep),
+                out_shardings=(self._cache_shard, *state_in),
+                donate_argnums=(1,),
+            )
+        from .decode import prefix_cache_shardings
+
+        pfx_shard = prefix_cache_shardings(self.mesh, self._prefix_cache)
+        placed_prefix = jax.device_put(self._prefix_cache, pfx_shard)
+
+        def _ins(params, cache, scores, out, alive, emitted, current,
+                 row, prompt, length, prefix):
+            return _beam_insert_row_impl(
+                params, cache, scores, out, alive, emitted, current, row,
+                prompt, length, prefix_cache=prefix, **statics)
+
+        fn = jax.jit(
+            _ins,
+            in_shardings=(p_shard, self._cache_shard, *state_in, rep,
+                          rep, rep, pfx_shard),
+            out_shardings=(self._cache_shard, *state_in),
+            donate_argnums=(1,),
+        )
+        return lambda *operands: fn(*operands, placed_prefix)
+
+    def _make_beam_step(self):
+        """One compiled beam step over ALL slots: advance every beam row
+        one position, per-slot top-k over the ``W*V`` expansions with
+        frozen-beam handling, in-block parent gathers of cache and
+        state — the standalone :func:`.beam.beam_search` scan body,
+        re-hosted with an ``active`` mask so free/finished slots neither
+        reorder nor emit (the same compute-always discipline as the
+        plain and speculative steps)."""
+        if self.quantized_kv:
+            if self.family == "llama":
+                from .llama import llama_quantized_decode_step as step_fn
+            else:
+                from .decode import quantized_decode_step as step_fn
+        elif self.family == "llama":
+            from .llama import llama_decode_step as step_fn
+        else:
+            from .decode import decode_step as step_fn
+
+        config = self.config
+        eos_id = self.eos_id
+        W = self.beams
+
+        def bstep(params, cache, current, scores, out, alive, emitted,
+                  active):
+            logits, cache = step_fn(params, cache, current, config)
+            S = scores.shape[0]
+            vocab = logits.shape[-1]
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(S, W, vocab)
+            if eos_id is not None:
+                # a finished beam contributes exactly one continuation —
+                # its frozen self emitting eos at no score cost
+                frozen = jnp.full((S, W, vocab), -jnp.inf)
+                frozen = frozen.at[:, :, eos_id].set(0.0)
+                logp = jnp.where(alive[..., None], logp, frozen)
+            total = scores[..., None] + logp
+            flat_scores, flat_idx = jax.lax.top_k(
+                total.reshape(S, W * vocab), W
+            )
+            parent = flat_idx // vocab
+            token = (flat_idx % vocab).astype(jnp.int32)
+            # inactive slots: identity parents, no writes, no advance
+            act = active[:, None]
+            parent = jnp.where(act, parent, jnp.arange(W)[None, :])
+            rows = jnp.arange(S)
+            flat_parent = (rows[:, None] * W + parent).reshape(-1)
+            cache = jax.tree.map(lambda a: a[flat_parent], cache)
+            out_g = out[rows[:, None], parent]
+            alive_g = alive[rows[:, None], parent]
+            emitted_g = emitted[rows[:, None], parent]
+            write = jnp.where(
+                alive_g, token,
+                eos_id if eos_id is not None else token,
+            )
+            budget = out.shape[-1]
+            out_w = jax.vmap(
+                jax.vmap(lambda r, t, v: r.at[t].set(v))
+            )(out_g, jnp.minimum(emitted_g, budget - 1), write)
+            out = jnp.where(act[..., None], out_w, out)
+            emitted = jnp.where(
+                act, emitted_g + alive_g.astype(jnp.int32), emitted
+            )
+            new_alive = (
+                alive_g & (token != eos_id) if eos_id is not None
+                else alive_g
+            )
+            alive = jnp.where(act, new_alive, alive)
+            scores = jnp.where(act, flat_scores, scores)
+            current = jnp.where(
+                act, token, current.reshape(S, W)
+            ).reshape(-1)
+            return (cache, current, scores, out, alive, emitted,
+                    jnp.any(alive, axis=1))
+
+        if self.mesh is None:
+            return jax.jit(bstep, donate_argnums=(1,))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        p_shard = param_shardings(self.mesh, self.params)
+        out_shard = NamedSharding(self.mesh, P("data", None, None))
+        slot_1d = NamedSharding(self.mesh, P("data"))
+        return jax.jit(
+            bstep,
+            in_shardings=(p_shard, self._cache_shard, self._rows_shard,
+                          self._slot_shard, out_shard, self._slot_shard,
+                          self._slot_shard, slot_1d),
+            out_shardings=(self._cache_shard, self._rows_shard,
+                           self._slot_shard, out_shard, self._slot_shard,
+                           self._slot_shard, slot_1d),
+            donate_argnums=(1,),
+        )
+
+    def _beam_best(self, row: int) -> np.ndarray:
+        """The finished slot's best beam, ranked exactly like
+        :func:`.beam.beam_search` (GNMT length normalization when
+        ``length_penalty > 0``; ties resolve to the lowest beam index,
+        matching the standalone's stable descending sort)."""
+        out = np.asarray(self._beam_out[row])
+        scores = np.asarray(self._beam_scores[row])
+        if self.length_penalty > 0:
+            # float32 throughout, matching the standalone's ranking math
+            # bit for bit (a float64 norm could flip ties)
+            emitted = np.asarray(self._beam_emitted[row]).astype(
+                np.float32
+            )
+            norm = ((np.float32(5.0) + emitted) / np.float32(6.0))                 ** np.float32(self.length_penalty)
+            ranked = scores / norm
+        else:
+            ranked = scores
+        return out[int(np.argmax(ranked))].astype(np.int32)
+
+    def _step_beam(self) -> list[tuple[Any, np.ndarray]]:
+        finished = []
+        needs = [
+            s.busy and not s.done and s.rounds < s.budget - 1
+            for s in self.slots
+        ]
+        if any(needs):
+            active = jnp.asarray(needs)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                active = jax.device_put(
+                    active, NamedSharding(self.mesh, P("data"))
+                )
+            (self.cache, self._current, self._beam_scores,
+             self._beam_out, self._beam_alive, self._beam_emitted,
+             alive_any) = self._beam_step_fn(
+                self.params, self.cache, self._current,
+                self._beam_scores, self._beam_out, self._beam_alive,
+                self._beam_emitted, active,
+            )
+            alive_host = np.asarray(alive_any)
+            for row, slot in enumerate(self.slots):
+                if needs[row]:
+                    slot.rounds += 1
+                    if not alive_host[row]:
+                        # every beam frozen: further steps are no-ops
+                        # (frozen beams emit eos at unchanged scores),
+                        # so the result is already final
+                        slot.done = True
+        for row, slot in enumerate(self.slots):
+            if slot.busy and (slot.done or slot.rounds >= slot.budget - 1):
+                finished.append((slot.payload, self._beam_best(row)))
+                self.slots[row] = _Slot()
+        return finished
+
     @property
     def free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if not s.busy]
@@ -668,6 +993,21 @@ class ContinuousBatcher:
         real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
         ids[: real.size] = real
         length = max(1, real.size)
+        if self.beams > 1:
+            (self.cache, self._beam_scores, self._beam_out,
+             self._beam_alive, self._beam_emitted,
+             self._current) = self._insert(
+                self.params, self.cache, self._beam_scores,
+                self._beam_out, self._beam_alive, self._beam_emitted,
+                self._current, jnp.asarray(row, jnp.int32),
+                jnp.asarray(ids), jnp.asarray(length, jnp.int32),
+            )
+            # rounds counts beam steps taken; a budget-1 slot finishes
+            # without any (the insert's first expansion is the answer)
+            self.slots[row] = _Slot(
+                busy=True, budget=self.generate_tokens, payload=payload,
+            )
+            return row
         if self.draft_layers:
             self.cache, self.draft_cache, first = self._insert(
                 self.params, self.cache, self.draft_cache,
@@ -707,6 +1047,8 @@ class ContinuousBatcher:
         nothing is active."""
         if self.active == 0:
             return []
+        if self.beams > 1:
+            return self._step_beam()
         finished = []
         needs = [self._needs_decode(s) for s in self.slots]
         # rows whose budget is a single token (or that already hit eos)
@@ -792,6 +1134,8 @@ class ContinuousWorker:
         prefix_cache: dict | None = None,
         draft_layers: int = 0,
         draft_tokens: int = 4,
+        beams: int = 1,
+        length_penalty: float = 0.0,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -826,6 +1170,8 @@ class ContinuousWorker:
             prefix_cache=prefix_cache,
             draft_layers=draft_layers,
             draft_tokens=draft_tokens,
+            beams=beams,
+            length_penalty=length_penalty,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as

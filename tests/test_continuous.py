@@ -535,3 +535,105 @@ def test_worker_binary_continuous_speculative_demo():
           "--generate-tokens", "4", "--continuous",
           "--speculative-draft-layers", "1",
           "--speculative-draft-tokens", "2"])
+
+
+def test_beam_slots_equal_standalone_beam_search():
+    # beam search INSIDE continuous batching: each slot owns W beam
+    # rows and a device-side search state; per-request results equal
+    # the standalone beam_search exactly — eos, length penalty, int8
+    # cache, and slot reuse included
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+
+    params = init_params(jax.random.key(0), TINY)
+    requests = prompts(5, rng_seed=21)
+
+    def pin(batcher_kwargs, beam_kwargs):
+        batcher = ContinuousBatcher(
+            params, TINY, batch_size=2, prompt_len=12, generate_tokens=6,
+            beams=3, **batcher_kwargs,
+        )
+        results = _drain(batcher, requests)
+        assert len(results) == 5
+        for idx, ids in enumerate(requests):
+            ref = np.asarray(beam_search(
+                params, TINY, jnp.asarray(ids, jnp.int32)[None], 6,
+                beams=3, **beam_kwargs,
+            )[0])
+            np.testing.assert_array_equal(results[idx], ref,
+                                          err_msg=f"request {idx}")
+        return results
+
+    plain = pin({}, {})
+    eos = int(plain[0][2])
+    pin({"eos_id": eos}, {"eos_id": eos})
+    pin({"eos_id": eos, "length_penalty": 0.8},
+        {"eos_id": eos, "length_penalty": 0.8})
+    pin({"quantized_kv": True}, {"quantized_cache": True})
+
+
+def test_beam_slots_with_prefix_equal_concat():
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+    from kube_sqs_autoscaler_tpu.workloads.decode import prefill_prefix
+
+    params = init_params(jax.random.key(0), TINY)
+    requests = prompts(4, rng_seed=22)
+    prefix = jnp.arange(1, 7, dtype=jnp.int32)
+    pc = prefill_prefix(params, prefix, TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        beams=2, prefix_cache=pc,
+    )
+    results = _drain(batcher, requests)
+    assert len(results) == 4
+    for idx, ids in enumerate(requests):
+        concat = jnp.concatenate([prefix, jnp.asarray(ids, jnp.int32)])
+        ref = np.asarray(beam_search(params, TINY, concat[None], 5,
+                                     beams=2)[0])
+        np.testing.assert_array_equal(results[idx], ref,
+                                      err_msg=f"request {idx}")
+
+
+def test_sharded_beam_slots_equal_single_chip():
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    params = init_params(jax.random.key(0), TINY)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params, param_shardings(mesh, params))
+    requests = prompts(5, rng_seed=23)
+    plain = _drain(ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        beams=2,
+    ), requests)
+    sharded = _drain(ContinuousBatcher(
+        placed, TINY, batch_size=2, prompt_len=12, generate_tokens=5,
+        beams=2, mesh=mesh,
+    ), requests)
+    assert len(sharded) == 5
+    for idx in plain:
+        np.testing.assert_array_equal(sharded[idx], plain[idx],
+                                      err_msg=f"request {idx}")
+
+
+def test_beam_slots_reject_bad_combos():
+    import pytest
+
+    params = init_params(jax.random.key(0), TINY)
+    with pytest.raises(ValueError, match="draft_layers"):
+        ContinuousBatcher(params, TINY, batch_size=2, prompt_len=12,
+                          generate_tokens=4, beams=2, draft_layers=1)
+    with pytest.raises(ValueError, match="deterministic"):
+        ContinuousBatcher(params, TINY, batch_size=2, prompt_len=12,
+                          generate_tokens=4, beams=2, temperature=0.7)
+
+
+def test_worker_binary_continuous_beams_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "3", "--batch-size", "2", "--seq-len", "8",
+          "--generate-tokens", "4", "--continuous", "--beams", "2"])
+    main(["--demo", "3", "--batch-size", "2", "--seq-len", "8",
+          "--generate-tokens", "4", "--continuous", "--beams", "2",
+          "--quantize-kv", "--prefix-ids", "5,6", "--family", "llama"])

@@ -536,7 +536,7 @@ def test_llama_moe_1f1b_pipeline_learns():
     assert losses[-1] < losses[0]
 
 
-def test_moe_pipeline_rejects_tp():
+def test_moe_pipeline_rejects_seq_axis():
     import jax
 
     from kube_sqs_autoscaler_tpu.workloads.model import ModelConfig
@@ -557,11 +557,15 @@ def test_moe_pipeline_rejects_tp():
     tc = TrainConfig()
     state = init_moe_pipeline_train_state(jax.random.key(0), config, moe,
                                           tc, n_stages=2)
-    tp_mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
-                                 model_parallel=2)
-    with pytest.raises(ValueError, match="tensor parallelism"):
+    # round-5 lift: moe x pp x TP composes (expert ff carved over
+    # "model", router grad-synced) — the step factory now accepts a
+    # (pipe, data, model) mesh (pinned loss-equal in
+    # test_pipeline_4axis); only the seq axis still fails fast
+    sp_mesh = make_pipeline_mesh(jax.devices(), pipe_parallel=2,
+                                 seq_parallel=2)
+    with pytest.raises(ValueError, match="seq"):
         make_moe_pipeline_train_step(
-            tp_mesh, config, moe, PipelineConfig(n_microbatches=2), tc,
+            sp_mesh, config, moe, PipelineConfig(n_microbatches=2), tc,
             state)
 
 
@@ -598,8 +602,12 @@ def test_trainer_moe_pipeline_flags(caplog):
     assert all(np.isfinite(result["losses"]))
     assert result["losses"][-1] < result["losses"][0]
 
-    with pytest.raises(SystemExit, match="model-parallel"):
-        trainer_main(base + ["--model-parallel", "2"])
+    # round-5 lift: --moe --pipe-parallel --model-parallel trains
+    # (attention AND expert ff Megatron-sharded; pinned equal to the
+    # no-tp truth in test_pipeline_4axis)
+    result = trainer_main(base + ["--model-parallel", "2"])
+    assert result["final_step"] == 4
+    assert all(np.isfinite(result["losses"]))
 
 
 def test_trainer_llama_moe_flag():

@@ -218,10 +218,15 @@ def test_zigzag_pipeline_trains_from_the_trainer():
             "--pipe-parallel", "2", "--zigzag"]
     with _pytest.raises(SystemExit, match="seq-parallel"):
         main(base)
-    with _pytest.raises(SystemExit, match="gpipe"):
-        main(base + ["--seq-parallel", "2", "--pipe-schedule", "1f1b"])
     with _pytest.raises(SystemExit, match="moe"):
         main(base + ["--seq-parallel", "2", "--moe"])
+    # round-5 lift: --zigzag --pipe-schedule 1f1b trains (the explicit
+    # backward with the permuted-validity loss seam; pinned equal to
+    # GPipe zig-zag in test_pipeline_4axis)
+    result = main(base + ["--seq-parallel", "2", "--pipe-schedule",
+                          "1f1b", "--pipe-microbatches", "2",
+                          "--overfit", "--learning-rate", "1e-2"])
+    assert result["final_step"] == 1
 
 
 def test_pipeline_microbatches_are_independent():

@@ -160,9 +160,9 @@ def test_trainer_pipeline_seq_parallel_learns():
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
 
-    with pytest.raises(SystemExit, match="not both"):
-        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
-                           "--seq-parallel", "2", "--model-parallel", "2"])
+    # round-5 lift: --pipe-parallel takes --model-parallel AND
+    # --seq-parallel together (the 4-axis mesh; trained end to end by
+    # test_pipeline_4axis::test_trainer_binary_4axis)
 
 
 def test_trainer_pipeline_topology_mesh_learns():
@@ -182,10 +182,8 @@ def test_trainer_pipeline_flag_conflicts_fail_fast():
     with pytest.raises(SystemExit, match="--zigzag"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--seq-parallel", "1", "--zigzag"])
-    # moe x pp works (both schedules — tests/test_moe.py) but not with tp
-    with pytest.raises(SystemExit, match="model-parallel"):
-        main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2", "--moe",
-                           "--model-parallel", "2"])
+    # moe x pp x tp composes since round 5 (tests/test_moe.py trains
+    # it end to end); the microbatch divisibility check still fails fast
     with pytest.raises(SystemExit, match="not divisible"):
         main(TINY_FLAGS + ["--steps", "1", "--pipe-parallel", "2",
                            "--pipe-microbatches", "3"])
