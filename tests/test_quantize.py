@@ -157,12 +157,19 @@ def test_worker_binary_serves_int8_model_parallel():
 def test_worker_binary_serves_quantized_kv_model_parallel():
     # the round-4 hole: --quantize-kv rejected --model-parallel; now the
     # int8 cache shards by head over the serving mesh (plain generate AND
-    # the continuous slot machine), and int8 weights compose on top
+    # the continuous slot machine), and int8 weights compose on top.
+    # clear_caches between the two binary runs: this test sits ~65% into
+    # the slow tier and the second run (llama + int8 weights + int8 KV +
+    # continuous + mesh) has twice aborted the whole suite inside XLA CPU
+    # with the backend's accumulated state — each run is a full worker
+    # binary, so dropping executables between them is free
     from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
 
+    jax.clear_caches()
     worker_main(["--demo", "2", "--quantize-kv", "--model-parallel", "2",
                  "--batch-size", "4", "--seq-len", "8",
                  "--generate-tokens", "3"])
+    jax.clear_caches()
     worker_main(["--demo", "3", "--quantize-kv", "--model-parallel", "2",
                  "--continuous", "--quantize", "int8", "--batch-size", "4",
                  "--seq-len", "8", "--generate-tokens", "3",
