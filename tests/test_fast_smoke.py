@@ -1,0 +1,58 @@
+"""Fast-tier serving smokes: the advanced serving compositions at the
+smallest useful scale, so the DEFAULT gate (`make test`, <10 min)
+touches the round-5 machinery — the full pinned-equality tests live in
+the slow tier (test_continuous/test_prefix_cache/test_beam/...).
+
+jax/numpy imports stay inside the test (the conftest's optional-extras
+collection invariant: without them the controller tests must still
+collect and run).
+"""
+
+from tests.conftest import drain_batcher
+
+
+def test_speculative_and_beam_slots_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.decode import generate
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    tiny = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), tiny)
+    rng = np.random.default_rng(1)
+    requests = [
+        rng.integers(1, tiny.vocab_size, 4).astype(np.int32)
+        for _ in range(3)
+    ]
+
+    spec = drain_batcher(ContinuousBatcher(
+        params, tiny, batch_size=2, prompt_len=4, generate_tokens=4,
+        draft_layers=1, draft_tokens=2,
+    ), requests, max_steps=100)
+    assert len(spec) == 3
+    for idx, ids in enumerate(requests):
+        ref = np.asarray(generate(params, jnp.asarray(ids)[None], 4,
+                                  tiny)[0])
+        np.testing.assert_array_equal(spec[idx], ref)
+
+    beam = drain_batcher(ContinuousBatcher(
+        params, tiny, batch_size=2, prompt_len=4, generate_tokens=4,
+        beams=2,
+    ), requests, max_steps=100)
+    assert len(beam) == 3
+    for idx, ids in enumerate(requests):
+        ref = np.asarray(beam_search(params, tiny, jnp.asarray(ids)[None],
+                                     4, beams=2)[0])
+        np.testing.assert_array_equal(beam[idx], ref)
