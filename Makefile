@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -35,6 +35,10 @@ push: build
 
 bench:
 	python bench.py
+
+# Reactive-vs-predictive scenario battery (CPU, <60 s); writes BENCH_r06.json
+bench-forecast:
+	JAX_PLATFORMS=cpu python bench.py --suite forecast
 
 # TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
 # the real chip; writes WORKBENCH.json

@@ -16,13 +16,23 @@ controller can make decisions when not rate-limited by the poll sleep.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Deliberately imports no JAX: the controller is plain Python (the reference
-is a plain Go binary with no accelerator workload, SURVEY.md §2); model
-workload microbenchmarks live in tests/ and the workloads package.
+``--suite forecast`` instead runs the reactive-vs-predictive scenario
+battery (`sim/evaluate.py`: step/ramp/diurnal/burst, scored on max depth,
+time-over-SLO, and replica churn), writes the full report to
+``BENCH_r06.json``, and prints a one-line summary of the winning
+forecaster's deltas.  CPU-only, < 60 s end to end (the predictive
+episodes pay one JAX trace each; the battery itself is seconds).
+
+The default suite deliberately imports no JAX: the controller is plain
+Python (the reference is a plain Go binary with no accelerator workload,
+SURVEY.md §2); model workload microbenchmarks live in tests/ and the
+workloads package.  The forecast suite imports JAX lazily inside the
+predictive episodes only.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -150,5 +160,54 @@ def run_bench(total_ticks: int = 10_000, repeats: int = 8,
     }
 
 
+def run_forecast_suite(output: str = "BENCH_r06.json") -> dict:
+    """The scenario battery as a smoke benchmark + committed artifact.
+
+    Reactive vs. every forecaster on every scenario; the artifact carries
+    the full scorecard, stdout carries the winner's headline: summed max
+    depth across the battery's target scenarios (ramp + diurnal),
+    predictive vs. reactive, with the churn budget verdict.
+    """
+    from kube_sqs_autoscaler_tpu.sim.evaluate import evaluate_battery, summarize
+
+    start = time.perf_counter()
+    report = evaluate_battery()
+    summary = summarize(report)
+    elapsed = time.perf_counter() - start
+    winner = summary["winner"]
+    targets = summary["target_scenarios"]
+    reactive_depth = sum(report[s]["reactive"]["max_depth"] for s in targets)
+    winner_depth = sum(report[s][winner]["max_depth"] for s in targets)
+    artifact = {
+        "suite": "forecast",
+        "elapsed_s": round(elapsed, 2),
+        "report": report,
+        "summary": summary,
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    return {
+        "metric": "forecast_target_max_depth",
+        "value": round(winner_depth, 1),
+        "unit": "messages (ramp+diurnal, winner=" + winner + ")",
+        "vs_baseline": round(reactive_depth / max(winner_depth, 1e-9), 2),
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run_bench()))
+    cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    cli.add_argument(
+        "--suite", choices=("controller", "forecast"), default="controller",
+        help="controller = decision-throughput bench (default); forecast ="
+        " reactive-vs-predictive scenario battery",
+    )
+    cli.add_argument(
+        "--output", default="BENCH_r06.json",
+        help="artifact path for --suite forecast",
+    )
+    cli_args = cli.parse_args()
+    if cli_args.suite == "forecast":
+        print(json.dumps(run_forecast_suite(cli_args.output)))
+    else:
+        print(json.dumps(run_bench()))

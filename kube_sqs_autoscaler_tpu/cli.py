@@ -121,7 +121,46 @@ def build_parser() -> argparse.ArgumentParser:
             "(0 = disabled)"
         ),
     )
+    # Extensions over the reference: the predictive scaling policy
+    # (forecast/ subsystem). The default is the reference's reactive
+    # behavior; --policy=predictive thresholds the forecasted depth at
+    # now + --forecast-horizon through the same gates.
+    parser.add_argument(
+        "--policy", choices=("reactive", "predictive"), default="reactive",
+        help=(
+            "Scaling policy: 'reactive' thresholds the observed queue depth "
+            "(reference behavior); 'predictive' thresholds the forecasted "
+            "depth at now + --forecast-horizon"
+        ),
+    )
+    parser.add_argument(
+        "--forecaster", choices=("ewma", "holt", "lstsq"), default="holt",
+        help=(
+            "Forecaster for --policy=predictive: ewma (flat level), holt "
+            "(level+trend), lstsq (windowed line fit)"
+        ),
+    )
+    parser.add_argument(
+        "--forecast-horizon", type=parse_duration, default=60.0,
+        metavar="DURATION",
+        help="How far ahead the predictive policy forecasts queue depth",
+    )
+    parser.add_argument(
+        "--forecast-history", type=_history_size, default=128,
+        help="Depth observations kept for forecasting (ring buffer size)",
+    )
     return parser
+
+
+def _history_size(value: str) -> int:
+    """Ring-buffer capacity: a usage error below 2, like every other flag
+    (DepthHistory would reject it later with a raw traceback otherwise)."""
+    size = int(value)
+    if size < 2:
+        raise argparse.ArgumentTypeError(
+            f"--forecast-history must be >= 2, got {size}"
+        )
+    return size
 
 
 def config_from_args(args: argparse.Namespace) -> LoopConfig:
@@ -163,16 +202,44 @@ def main(argv: Sequence[str] | None = None) -> None:
     )
 
     server = None
-    observer = None
+    observers = []
     if args.metrics_port:
         from .obs import ControllerMetrics, ObservabilityServer
 
-        observer = ControllerMetrics()
-        server = ObservabilityServer(observer, port=args.metrics_port)
+        metrics = ControllerMetrics()
+        observers.append(metrics)
+        server = ObservabilityServer(metrics, port=args.metrics_port)
         server.start()
 
+    # Predictive policy: deferred import like the real-client stacks — the
+    # reactive control plane never pays the JAX import.
+    depth_policy = None
+    if args.policy == "predictive":
+        from .forecast import DepthHistory, PredictivePolicy, make_forecaster
+
+        history = DepthHistory(capacity=args.forecast_history)
+        depth_policy = PredictivePolicy(
+            make_forecaster(args.forecaster),
+            history,
+            horizon=args.forecast_horizon,
+        )
+        observers.append(history)  # fed from the tick-record observer hook
+
+    if not observers:
+        observer = None
+    elif len(observers) == 1:
+        observer = observers[0]
+    else:
+        from .core.events import CompositeTickObserver
+
+        observer = CompositeTickObserver(observers)
+
     loop = ControlLoop(
-        autoscaler, metric_source, config_from_args(args), observer=observer
+        autoscaler,
+        metric_source,
+        config_from_args(args),
+        observer=observer,
+        depth_policy=depth_policy,
     )
 
     # Extension over the reference (which runs until killed): exit cleanly

@@ -17,10 +17,13 @@ Lives in ``core`` (not ``obs``) so the layering stays one-directional:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Protocol, runtime_checkable
 
 from .policy import Gate
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -36,6 +39,12 @@ class TickRecord:
       reference's ``continue`` at ``main.go:54``);
     - ``up_error``/``down_error`` set ⇒ the gate fired but actuation failed
       (the cooldown timestamp was *not* advanced);
+    - ``decision_messages`` is the depth the gates actually thresholded on:
+      equal to ``num_messages`` under the reactive policy, the forecasted
+      depth under a :class:`~..core.types.DepthPolicy`;
+    - ``predicted_messages``/``forecast_error`` are the depth policy's
+      forecast scoreboard for this tick (``None`` when reactive or not yet
+      warmed up / scored);
     - ``duration`` is measured on the loop's own clock, so it is virtual
       under a ``FakeClock`` and wall-clock in production.
     """
@@ -44,6 +53,9 @@ class TickRecord:
     duration: float = 0.0
     num_messages: int | None = None
     metric_error: str | None = None
+    decision_messages: int | None = None
+    predicted_messages: int | None = None
+    forecast_error: float | None = None
     up: Gate = Gate.SKIPPED
     down: Gate = Gate.SKIPPED
     up_error: str | None = None
@@ -70,3 +82,23 @@ class TickObserver(Protocol):
     def on_tick(self, record: TickRecord) -> None:
         """Called once per completed tick, after all tick side effects."""
         ...
+
+
+class CompositeTickObserver:
+    """Fans one tick record out to several observers.
+
+    Lets the loop feed the Prometheus registry *and* a forecast history
+    (and tests) from its single observer slot.  Failure isolation matches
+    the loop's own observer contract: one observer raising is logged and
+    must not starve the others, so each child is guarded individually.
+    """
+
+    def __init__(self, observers: list[TickObserver] | tuple[TickObserver, ...]):
+        self.observers = tuple(observers)
+
+    def on_tick(self, record: TickRecord) -> None:
+        for observer in self.observers:
+            try:
+                observer.on_tick(record)
+            except Exception:  # same never-dies guarantee as the loop's guard
+                log.exception("Tick observer %r failed", observer)

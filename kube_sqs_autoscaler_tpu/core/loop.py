@@ -41,7 +41,7 @@ from .policy import (
     mark_scaled_down,
     mark_scaled_up,
 )
-from .types import MetricSource, Scaler
+from .types import DepthPolicy, MetricSource, Scaler
 
 log = logging.getLogger(__name__)
 
@@ -64,12 +64,15 @@ class ControlLoop:
         config: LoopConfig | None = None,
         clock: Clock | None = None,
         observer: TickObserver | None = None,
+        depth_policy: DepthPolicy | None = None,
     ) -> None:
         self.scaler = scaler
         self.metric_source = metric_source
         self.config = config or LoopConfig()
         self.clock = clock or SystemClock()
         self.observer = observer
+        # None = reference behavior: gates threshold the observed depth.
+        self.depth_policy = depth_policy
         self.ticks = 0  # completed ticks (observability; not used by policy)
         self._stop = threading.Event()
 
@@ -136,12 +139,49 @@ class ControlLoop:
         record.num_messages = num_messages
         log.info("Found %d messages in the queue", num_messages)
 
+        # Depth-policy seam: the gates threshold `decision` — the observed
+        # depth under the reactive default, the forecasted depth at
+        # now + horizon under a predictive policy.  A policy failure falls
+        # back to the observed depth; the loop never dies.
+        decision = num_messages
+        if self.depth_policy is not None:
+            try:
+                decision = max(
+                    0,
+                    int(
+                        self.depth_policy.effective_messages(
+                            self.clock.now(), num_messages
+                        )
+                    ),
+                )
+            except Exception as err:
+                log.error(
+                    "Depth policy failed, using observed depth: %s", err
+                )
+                # no forecast fields on the record: a stale prediction from
+                # an earlier tick must not be exported as this tick's
+                decision = num_messages
+            else:
+                if decision != num_messages:
+                    log.info(
+                        "Forecast %d messages at horizon (observed %d)",
+                        decision,
+                        num_messages,
+                    )
+                record.predicted_messages = getattr(
+                    self.depth_policy, "last_prediction", None
+                )
+                record.forecast_error = getattr(
+                    self.depth_policy, "last_abs_error", None
+                )
+        record.decision_messages = decision
+
         # Gates are evaluated sequentially with a fresh clock read each, like
         # the reference's inline time.Now() calls (main.go:52,66): under a
         # real clock the down gate sees time that has advanced past the
         # scale-up RPCs.
         policy = self.config.policy
-        record.up = up = gate_up(num_messages, self.clock.now(), policy, state)
+        record.up = up = gate_up(decision, self.clock.now(), policy, state)
         if up is Gate.COOLING:
             log.info("Waiting for cool down, skipping scale up ")
             return state
@@ -155,7 +195,7 @@ class ControlLoop:
             state = mark_scaled_up(state, self.clock.now())
 
         record.down = down = gate_down(
-            num_messages, self.clock.now(), policy, state
+            decision, self.clock.now(), policy, state
         )
         if down is Gate.COOLING:
             log.info("Waiting for cool down, skipping scale down")

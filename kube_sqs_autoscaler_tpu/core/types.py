@@ -35,6 +35,24 @@ class MetricSource(Protocol):
 
 
 @runtime_checkable
+class DepthPolicy(Protocol):
+    """Maps the observed queue depth to the depth the gates threshold on.
+
+    The plug-point for predictive scaling (``forecast.PredictivePolicy``):
+    it sits *before* the pure gates, so threshold inclusivity, cooldown
+    strictness, and the up-cooling ``continue`` are untouched whatever the
+    policy returns.  The reactive/reference behavior is the identity map
+    (``ControlLoop`` with no policy, or ``forecast.ReactivePolicy``).
+    """
+
+    def effective_messages(self, now: float, num_messages: int) -> int:
+        """Depth for this tick's gates. Pure w.r.t. the loop; may keep
+        internal forecast state. Exceptions fall back to the observed
+        depth (the loop never dies)."""
+        ...
+
+
+@runtime_checkable
 class Scaler(Protocol):
     """Actuates the replica count on an orchestrator."""
 

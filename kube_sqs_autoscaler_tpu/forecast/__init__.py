@@ -1,0 +1,48 @@
+"""Queue-depth forecasting: the predictive autoscaling subsystem.
+
+The reference's policy is purely reactive — it thresholds the *current*
+queue depth, so a traffic ramp always pays one full cooldown of backlog
+growth before the controller responds.  This package adds the predictive
+path (ROADMAP: serve bursty traffic at production scale; KIS-S
+arxiv 2507.07932 for simulator-driven evaluation, BLITZSCALE
+arxiv 2412.17246 for why scale-up latency dominates):
+
+- :mod:`.history` — :class:`DepthHistory`, a fixed-capacity ring buffer of
+  ``(time, depth)`` observations fed from the loop's
+  :class:`~..core.events.TickRecord` observer hook;
+- :mod:`.forecasters` — the :class:`Forecaster` protocol and three
+  JAX-backed implementations (EWMA, Holt double-exponential trend,
+  windowed linear least-squares), each a pure ``jax.jit``-compiled
+  function over the fixed-shape history arrays;
+- :mod:`.predictive` — :class:`PredictivePolicy`, which substitutes the
+  forecasted depth at ``now + horizon`` for the observed depth *before*
+  the existing pure gates (``gate_up``/``gate_down``), so every reference
+  cooldown subtlety is preserved unchanged.
+
+Layering: this package imports ``core`` and JAX; ``core`` never imports
+this package.  The CLI and simulator wire it in lazily, so the reactive
+control plane stays JAX-free.
+"""
+
+from .forecasters import (
+    FORECASTER_NAMES,
+    EwmaForecaster,
+    Forecaster,
+    HoltForecaster,
+    LeastSquaresForecaster,
+    make_forecaster,
+)
+from .history import DepthHistory
+from .predictive import PredictivePolicy, ReactivePolicy
+
+__all__ = [
+    "DepthHistory",
+    "Forecaster",
+    "EwmaForecaster",
+    "HoltForecaster",
+    "LeastSquaresForecaster",
+    "FORECASTER_NAMES",
+    "make_forecaster",
+    "PredictivePolicy",
+    "ReactivePolicy",
+]

@@ -1,0 +1,209 @@
+"""JAX-backed queue-depth forecasters behind one protocol.
+
+Each forecaster is a pure, ``jax.jit``-compiled function over the
+fixed-shape ``(times, depths, n)`` snapshot a :class:`~.history.DepthHistory`
+produces: shapes never change as samples accumulate, the valid-sample
+count ``n`` and all smoothing parameters are traced scalars, so every
+forecaster compiles exactly once per history capacity and then runs from
+cache on every tick — the repo's first numerical JAX hot path on the
+control plane.
+
+The three families cover the classical trend spectrum:
+
+- **EWMA** — exponentially weighted level, flat extrapolation.  The
+  recency-weighted baseline: robust to noise, lags trends.
+- **Holt** — double exponential smoothing (level + trend), linear
+  extrapolation ``level + trend * steps(horizon)``.  Catches ramps and
+  diurnal slopes one cooldown earlier than any reactive read.
+- **Windowed least squares** — exact line fit over the last ``window``
+  samples against *actual* sample times (poll jitter handled), linear
+  extrapolation.  The low-noise, irregular-cadence counterpart to Holt.
+
+All predictions are clamped to ``>= 0`` (queue depth is nonnegative).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@runtime_checkable
+class Forecaster(Protocol):
+    """Predicts queue depth ``horizon`` seconds past the newest sample."""
+
+    name: str
+
+    def predict(
+        self, times: np.ndarray, depths: np.ndarray, n: int, horizon: float
+    ) -> float:
+        """Forecast depth at ``times[n-1] + horizon`` from the first ``n``
+        (chronological) samples of fixed-shape ``times``/``depths``."""
+        ...
+
+
+@partial(jax.jit, static_argnames=())
+def _ewma_level(depths: jax.Array, n: jax.Array, alpha: jax.Array) -> jax.Array:
+    """Masked EWMA over the first ``n`` entries; returns the final level."""
+    idx = jnp.arange(depths.shape[0])
+    valid = idx < n
+
+    def step(level, x):
+        depth, is_valid, is_first = x
+        updated = jnp.where(is_first, depth, alpha * depth + (1 - alpha) * level)
+        return jnp.where(is_valid, updated, level), None
+
+    level, _ = lax.scan(step, 0.0, (depths, valid, idx == 0))
+    return level
+
+
+@partial(jax.jit, static_argnames=())
+def _holt_forecast(
+    times: jax.Array,
+    depths: jax.Array,
+    n: jax.Array,
+    horizon: jax.Array,
+    alpha: jax.Array,
+    beta: jax.Array,
+) -> jax.Array:
+    """Holt level+trend over the first ``n`` entries, extrapolated.
+
+    The trend is per *sample step*; the horizon converts to steps via the
+    mean observed inter-sample interval, so the forecast is calibrated in
+    seconds whatever the poll cadence.
+    """
+    idx = jnp.arange(depths.shape[0])
+    valid = idx < n
+
+    def step(carry, x):
+        level, trend = carry
+        depth, is_valid, is_first = x
+        new_level = alpha * depth + (1 - alpha) * (level + trend)
+        new_trend = beta * (new_level - level) + (1 - beta) * trend
+        new_level = jnp.where(is_first, depth, new_level)
+        new_trend = jnp.where(is_first, 0.0, new_trend)
+        level = jnp.where(is_valid, new_level, level)
+        trend = jnp.where(is_valid, new_trend, trend)
+        return (level, trend), None
+
+    (level, trend), _ = lax.scan(step, (0.0, 0.0), (depths, valid, idx == 0))
+    t_last = jnp.take(times, jnp.maximum(n - 1, 0))
+    span = t_last - times[0]
+    mean_dt = span / jnp.maximum(n - 1, 1)
+    steps = jnp.where(mean_dt > 0, horizon / mean_dt, 0.0)
+    return jnp.maximum(level + trend * steps, 0.0)
+
+
+@partial(jax.jit, static_argnames=())
+def _lstsq_forecast(
+    times: jax.Array,
+    depths: jax.Array,
+    n: jax.Array,
+    horizon: jax.Array,
+    window: jax.Array,
+) -> jax.Array:
+    """Line fit over the last ``min(window, n)`` samples, extrapolated.
+
+    Times are centered on the newest sample before the normal equations,
+    so the fit is conditioned regardless of the clock's epoch, and the
+    prediction is simply ``intercept + slope * horizon``.
+    """
+    idx = jnp.arange(depths.shape[0])
+    mask = (idx < n) & (idx >= n - window)
+    t_last = jnp.take(times, jnp.maximum(n - 1, 0))
+    x = jnp.where(mask, times - t_last, 0.0)
+    y = jnp.where(mask, depths, 0.0)
+    count = jnp.sum(mask)
+    sx = jnp.sum(x)
+    sy = jnp.sum(y)
+    sxx = jnp.sum(x * x)
+    sxy = jnp.sum(x * y)
+    denom = count * sxx - sx * sx
+    depth_last = jnp.take(depths, jnp.maximum(n - 1, 0))
+    degenerate = jnp.abs(denom) < 1e-9  # < 2 samples or coincident times
+    safe_denom = jnp.where(degenerate, 1.0, denom)
+    slope = (count * sxy - sx * sy) / safe_denom
+    intercept = (sy - slope * sx) / jnp.maximum(count, 1)
+    fit = intercept + slope * horizon
+    return jnp.maximum(jnp.where(degenerate, depth_last, fit), 0.0)
+
+
+def _center_times(times: np.ndarray, n: int) -> np.ndarray:
+    """Times relative to the newest sample, in float64 BEFORE the float32
+    jit boundary.  Raw ``time.monotonic()`` stamps grow unboundedly (seconds
+    since boot); at ~1e8 s float32 spacing is 8 s, which silently corrupts
+    5 s poll intervals.  Centered deltas are small and exact."""
+    times = np.asarray(times, dtype=np.float64)
+    return times - times[max(n - 1, 0)]
+
+
+@dataclass(frozen=True)
+class EwmaForecaster:
+    """Flat extrapolation of an exponentially weighted level."""
+
+    alpha: float = 0.3
+    name: str = "ewma"
+
+    def predict(self, times, depths, n, horizon) -> float:
+        del times, horizon  # EWMA's forecast is horizon-independent
+        return float(max(0.0, _ewma_level(jnp.asarray(depths), n, self.alpha)))
+
+
+@dataclass(frozen=True)
+class HoltForecaster:
+    """Double exponential smoothing: level + trend, linear extrapolation."""
+
+    alpha: float = 0.5
+    beta: float = 0.3
+    name: str = "holt"
+
+    def predict(self, times, depths, n, horizon) -> float:
+        return float(
+            _holt_forecast(
+                jnp.asarray(_center_times(times, n)), jnp.asarray(depths),
+                n, horizon, self.alpha, self.beta,
+            )
+        )
+
+
+@dataclass(frozen=True)
+class LeastSquaresForecaster:
+    """Exact line fit over the last ``window`` samples' actual times."""
+
+    window: int = 12
+    name: str = "lstsq"
+
+    def predict(self, times, depths, n, horizon) -> float:
+        return float(
+            _lstsq_forecast(
+                jnp.asarray(_center_times(times, n)), jnp.asarray(depths),
+                n, horizon, self.window,
+            )
+        )
+
+
+_FORECASTERS = {
+    "ewma": EwmaForecaster,
+    "holt": HoltForecaster,
+    "lstsq": LeastSquaresForecaster,
+}
+
+FORECASTER_NAMES: tuple[str, ...] = tuple(_FORECASTERS)
+
+
+def make_forecaster(name: str, **params) -> Forecaster:
+    """Build a forecaster by CLI name (``ewma``/``holt``/``lstsq``)."""
+    try:
+        cls = _FORECASTERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown forecaster {name!r}; choose from {FORECASTER_NAMES}"
+        ) from None
+    return cls(**params)

@@ -1,0 +1,89 @@
+"""Fixed-capacity ring buffer of ``(time, depth)`` observations.
+
+The forecasters are ``jax.jit``-compiled over fixed-shape arrays, so the
+history hands out ``(capacity,)``-shaped snapshots with a valid-sample
+count rather than growing lists — one compiled executable per capacity,
+no retracing as samples accumulate.
+
+Feeding happens through the loop's existing observer seam: the class
+implements :class:`~..core.events.TickObserver` and records every
+successful observation (``record.num_messages``) at the tick's start
+time.  Thread-safe: the loop thread writes, forecast/scrape threads read.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..core.events import TickRecord
+
+
+class DepthHistory:
+    """Ring buffer of queue-depth observations on the loop's clock."""
+
+    def __init__(self, capacity: int = 128) -> None:
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2, got {capacity}")
+        self.capacity = capacity
+        self._times = np.zeros(capacity, dtype=np.float64)
+        self._depths = np.zeros(capacity, dtype=np.float64)
+        self._total = 0  # samples ever observed (write index = total % cap)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return min(self._total, self.capacity)
+
+    def observe(self, t: float, depth: float) -> None:
+        """Append one observation (monotone ``t`` expected, not enforced)."""
+        with self._lock:
+            slot = self._total % self.capacity
+            self._times[slot] = t
+            self._depths[slot] = depth
+            self._total += 1
+
+    def on_tick(self, record: TickRecord) -> None:
+        """:class:`~..core.events.TickObserver`: record successful reads."""
+        if record.num_messages is not None:
+            self.observe(record.start, float(record.num_messages))
+
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """``(times, depths, n)`` — fixed ``(capacity,)`` shapes, the first
+        ``n`` entries chronological, the tail padded with the newest sample
+        (benign under masking, no huge jumps for unmasked arithmetic)."""
+        with self._lock:
+            n = min(self._total, self.capacity)
+            if self._total <= self.capacity:
+                times = self._times.copy()
+                depths = self._depths.copy()
+            else:
+                start = self._total % self.capacity
+                times = np.roll(self._times, -start)
+                depths = np.roll(self._depths, -start)
+        if 0 < n < self.capacity:
+            times[n:] = times[n - 1]
+            depths[n:] = depths[n - 1]
+        return times, depths, n
+
+    def with_sample(
+        self, t: float, depth: float
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Snapshot *as if* ``(t, depth)`` had just been observed.
+
+        Pure — the buffer is not mutated.  Lets the predictive policy
+        forecast from history *including* the current tick's observation,
+        which only enters the real buffer via the observer after the tick
+        completes.  When full, the oldest sample falls off, exactly as a
+        real append would.
+        """
+        times, depths, n = self.snapshot()
+        if n < self.capacity:
+            times[n:] = t
+            depths[n:] = depth
+            return times, depths, n + 1
+        times = np.roll(times, -1)
+        depths = np.roll(depths, -1)
+        times[-1] = t
+        depths[-1] = depth
+        return times, depths, n

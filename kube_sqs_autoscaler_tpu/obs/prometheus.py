@@ -36,6 +36,9 @@ class ControllerMetrics:
         self._observations = 0
         self._metric_failures = 0
         self._queue_messages: int | None = None
+        self._decision_messages: int | None = None
+        self._predicted_messages: int | None = None
+        self._forecast_abs_error: float | None = None
         self._cooldown_skips = {"up": 0, "down": 0}
         self._scale_events = {"up": 0, "down": 0}
         self._scale_failures = {"up": 0, "down": 0}
@@ -50,6 +53,13 @@ class ControllerMetrics:
                 return
             self._observations += 1
             self._queue_messages = record.num_messages
+            # unconditional: a tick without a forecast (reactive, warm-up,
+            # or a failing depth policy) must CLEAR the forecast gauges —
+            # latching the last success would export an arbitrarily stale
+            # forecast as live (the loop's no-stale-forecast contract).
+            self._decision_messages = record.decision_messages
+            self._predicted_messages = record.predicted_messages
+            self._forecast_abs_error = record.forecast_error
             for direction, gate, error in (
                 ("up", record.up, record.up_error),
                 ("down", record.down, record.down_error),
@@ -86,6 +96,34 @@ class ControllerMetrics:
             ]
             if self._queue_messages is not None:
                 lines.append(f"{_PREFIX}_queue_messages {self._queue_messages}")
+            lines += [
+                f"# HELP {_PREFIX}_predicted_queue_messages Forecasted depth"
+                " at now + horizon (predictive policy only).",
+                f"# TYPE {_PREFIX}_predicted_queue_messages gauge",
+            ]
+            if self._predicted_messages is not None:
+                lines.append(
+                    f"{_PREFIX}_predicted_queue_messages"
+                    f" {self._predicted_messages}"
+                )
+            lines += [
+                f"# HELP {_PREFIX}_decision_messages Depth the scaling gates"
+                " thresholded on this tick (= observed depth when reactive).",
+                f"# TYPE {_PREFIX}_decision_messages gauge",
+            ]
+            if self._decision_messages is not None:
+                lines.append(
+                    f"{_PREFIX}_decision_messages {self._decision_messages}"
+                )
+            lines += [
+                f"# HELP {_PREFIX}_forecast_abs_error |forecast - actual| for"
+                " the latest matured forecast (messages).",
+                f"# TYPE {_PREFIX}_forecast_abs_error gauge",
+            ]
+            if self._forecast_abs_error is not None:
+                lines.append(
+                    f"{_PREFIX}_forecast_abs_error {self._forecast_abs_error}"
+                )
             lines += [
                 f"# HELP {_PREFIX}_scale_events_total Successful scale actuations"
                 " (includes boundary no-ops, which the reference counts as"

@@ -3,13 +3,33 @@
 The reference can only be observed end-to-end against real AWS + a real
 cluster; its tests exercise open-loop fragments with hand-set queue depths
 (SURVEY.md §4).  This simulator closes the loop deterministically: a
-virtual queue fed at a configured arrival rate, drained by virtual worker
-replicas at a configured per-replica service rate, scaled by the *real*
-production ``ControlLoop``/``PodAutoScaler`` against the in-memory fakes on
-a ``FakeClock``.  Used by tests (dynamics assertions) and ``bench.py``
-(throughput measurement).
+virtual queue fed by a configured arrival process (constant, or the
+step/ramp/diurnal/burst shapes in :mod:`.scenarios`), drained by virtual
+worker replicas at a configured per-replica service rate, scaled by the
+*real* production ``ControlLoop``/``PodAutoScaler`` against the in-memory
+fakes on a ``FakeClock``.  Used by tests (dynamics assertions),
+``bench.py`` (throughput measurement), and the reactive-vs-predictive
+scenario battery in :mod:`.evaluate` (``bench.py --suite forecast``).
 """
 
+from .scenarios import (
+    ArrivalProcess,
+    BurstArrival,
+    ConstantArrival,
+    DiurnalArrival,
+    RampArrival,
+    StepArrival,
+)
 from .simulator import SimConfig, SimResult, Simulation
 
-__all__ = ["SimConfig", "SimResult", "Simulation"]
+__all__ = [
+    "SimConfig",
+    "SimResult",
+    "Simulation",
+    "ArrivalProcess",
+    "ConstantArrival",
+    "StepArrival",
+    "RampArrival",
+    "DiurnalArrival",
+    "BurstArrival",
+]

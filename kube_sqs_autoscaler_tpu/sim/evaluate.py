@@ -1,0 +1,214 @@
+"""Head-to-head scenario battery: reactive vs. each forecaster.
+
+Simulator-driven policy evaluation (the KIS-S harness shape,
+arxiv 2507.07932): every candidate policy runs the *same* deterministic
+world — identical arrival process, service rates, bounds, cadence — and
+is scored on the three numbers a queue-serving fleet cares about:
+
+- ``max_depth``      — worst backlog (latency proxy; BLITZSCALE's point
+  that scale-up lateness is the dominant serving cost, arxiv 2412.17246);
+- ``time_over_slo``  — seconds the observed depth sat above the
+  scenario's SLO depth;
+- ``replica_changes``— churn (each change is a pod start/stop: image
+  pulls, TPU grab/release, cache warm-up).
+
+Used by ``bench.py --suite forecast`` (the ``BENCH_r06`` artifact) and the
+acceptance tests; later policies (RL, multi-queue) plug into the same
+battery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.loop import LoopConfig
+from ..core.policy import PolicyConfig
+from .scenarios import (
+    ArrivalProcess,
+    BurstArrival,
+    DiurnalArrival,
+    RampArrival,
+    StepArrival,
+)
+from .simulator import SimConfig, Simulation
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One world the battery replays under every candidate policy."""
+
+    name: str
+    arrival: ArrivalProcess
+    duration: float = 900.0
+    service_rate_per_replica: float = 10.0
+    min_pods: int = 1
+    max_pods: int = 30
+    initial_replicas: int = 1
+    slo_depth: float = 300.0
+    # Forecast horizon (s) predictive policies use on this scenario — a
+    # deployment knob matched to the traffic's timescale: ~1 cooldown past
+    # the poll period for fast transients, longer for slow cycles (a long
+    # horizon on a fast ramp extrapolates the trend past its end and
+    # overshoots; a short one on a slow cycle sees the peak too late).
+    horizon: float = 60.0
+    loop: LoopConfig = field(
+        default_factory=lambda: LoopConfig(
+            poll_interval=5.0,
+            policy=PolicyConfig(
+                scale_up_messages=100,
+                scale_down_messages=10,
+                scale_up_cooldown=10.0,
+                scale_down_cooldown=30.0,
+            ),
+        )
+    )
+
+
+def default_battery() -> tuple[Scenario, ...]:
+    """Step, ramp, diurnal, burst — the four arrival shapes from ISSUE/KIS-S.
+
+    Magnitudes are sized so the default thresholds are genuinely exercised:
+    steady-state demand crosses several replicas' capacity and the backlog
+    moves through both gates' thresholds within each episode.
+    """
+    return (
+        Scenario(
+            name="step",
+            # launch day: 20 msg/s overnight, 120 msg/s from t=120 on
+            arrival=StepArrival(before=20.0, after=120.0, at=120.0),
+        ),
+        Scenario(
+            name="ramp",
+            # organic growth: 10 -> 150 msg/s over 10 minutes, then flat
+            arrival=RampArrival(
+                start_rate=10.0, end_rate=150.0, t_start=60.0, t_end=660.0
+            ),
+            horizon=30.0,
+        ),
+        Scenario(
+            name="diurnal",
+            # user traffic: 80 +/- 60 msg/s, two full cycles per episode.
+            # The fleet starts at steady state for the base load (8 pods at
+            # 10 msg/s each): a cold 1-pod start makes every policy's max
+            # depth the same cold-start backlog (actuation-rate-limited,
+            # one pod per cooldown), hiding the cyclic behavior the
+            # scenario exists to score.
+            arrival=DiurnalArrival(base=80.0, amplitude=60.0, period=450.0),
+            initial_replicas=8,
+        ),
+        Scenario(
+            name="burst",
+            # retry storms: 250 msg/s for 45 s every 5 minutes over 25 base
+            arrival=BurstArrival(
+                base=25.0, burst_rate=250.0, period=300.0,
+                burst_len=45.0, first_burst=120.0,
+            ),
+        ),
+    )
+
+
+def run_episode(
+    scenario: Scenario,
+    policy: str = "reactive",
+    forecaster: str = "holt",
+    horizon: float | None = None,
+) -> dict:
+    """One policy through one scenario; returns the scorecard row.
+
+    ``horizon=None`` uses the scenario's own tuned horizon.
+    """
+    horizon = scenario.horizon if horizon is None else horizon
+    sim = Simulation(
+        SimConfig(
+            arrival_rate=scenario.arrival,
+            service_rate_per_replica=scenario.service_rate_per_replica,
+            duration=scenario.duration,
+            initial_replicas=scenario.initial_replicas,
+            min_pods=scenario.min_pods,
+            max_pods=scenario.max_pods,
+            loop=scenario.loop,
+            policy=policy,
+            forecaster=forecaster,
+            forecast_horizon=horizon,
+        )
+    )
+    result = sim.run()
+    return {
+        "max_depth": round(result.max_depth, 1),
+        "time_over_slo_s": round(result.time_over(scenario.slo_depth), 1),
+        "replica_changes": result.replica_changes,
+        "final_replicas": result.final_replicas,
+        "final_depth": round(result.final_depth, 1),
+        "ticks": result.ticks,
+    }
+
+
+def evaluate_battery(
+    scenarios: tuple[Scenario, ...] | None = None,
+    forecasters: tuple[str, ...] = ("ewma", "holt", "lstsq"),
+    horizon: float | None = None,
+) -> dict:
+    """Every scenario × (reactive + each forecaster) → nested scorecard."""
+    scenarios = scenarios if scenarios is not None else default_battery()
+    report: dict = {}
+    for scenario in scenarios:
+        row: dict = {"reactive": run_episode(scenario, policy="reactive")}
+        for name in forecasters:
+            row[f"predictive:{name}"] = run_episode(
+                scenario, policy="predictive", forecaster=name, horizon=horizon
+            )
+        report[scenario.name] = row
+    return report
+
+
+def summarize(
+    report: dict,
+    target_scenarios: tuple[str, ...] = ("ramp", "diurnal"),
+    churn_budget: float = 1.25,
+) -> dict:
+    """Pick the winning forecaster and spell out the acceptance deltas.
+
+    The winner is the forecaster with the lowest summed ``max_depth`` over
+    ``target_scenarios`` among those whose churn stays within
+    ``churn_budget`` × reactive on every target scenario; ties break to
+    the lower total churn.
+    """
+    candidates: dict[str, dict] = {}
+    names = [k for k in next(iter(report.values())) if k != "reactive"]
+    for name in names:
+        depth_total = 0.0
+        churn_ok = True
+        churn_total = 0
+        deltas = {}
+        for scen in target_scenarios:
+            reactive = report[scen]["reactive"]
+            predictive = report[scen][name]
+            depth_total += predictive["max_depth"]
+            churn_total += predictive["replica_changes"]
+            # a churn-free reactive baseline leaves any churn over budget
+            allowed = churn_budget * max(reactive["replica_changes"], 1)
+            if predictive["replica_changes"] > allowed:
+                churn_ok = False
+            deltas[scen] = {
+                "max_depth_reduction": round(
+                    reactive["max_depth"] - predictive["max_depth"], 1
+                ),
+                "churn_delta": (
+                    predictive["replica_changes"] - reactive["replica_changes"]
+                ),
+            }
+        candidates[name] = {
+            "depth_total": depth_total,
+            "churn_total": churn_total,
+            "within_churn_budget": churn_ok,
+            "deltas": deltas,
+        }
+    eligible = {n: c for n, c in candidates.items() if c["within_churn_budget"]}
+    pool = eligible or candidates
+    winner = min(pool, key=lambda n: (pool[n]["depth_total"], pool[n]["churn_total"]))
+    return {
+        "winner": winner,
+        "target_scenarios": list(target_scenarios),
+        "churn_budget": churn_budget,
+        "candidates": candidates,
+    }

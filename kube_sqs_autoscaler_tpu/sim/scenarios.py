@@ -1,0 +1,191 @@
+"""Time-varying arrival processes for the closed-loop simulator.
+
+The seed simulator modeled one world: a constant arrival rate.  Real
+queue-fed fleets see steps (a product launch), ramps (organic growth,
+cache warm-up), diurnal cycles (user traffic), and bursts (retry storms,
+cron fan-out) — the scenarios the predictive-vs-reactive evaluation in
+:mod:`.evaluate` runs head-to-head.
+
+Each process exposes the instantaneous ``rate_at(t)`` and the *exact*
+integral ``arrivals_between(t0, t1)``: the simulator integrates arrivals
+analytically over each poll interval, so no quadrature error enters the
+dynamics at any poll cadence.  One caveat the constant-rate seed world
+does not share: the empty-queue floor is applied once per observation
+interval, so if the queue empties mid-interval *and* the rate then rises
+within that same interval, drain capacity idled while empty is credited
+against the later arrivals — depth can be understated by at most one
+interval's drain.  (With a constant rate the net rate cannot change sign
+inside an interval, so the seed's lump-sum floor is genuinely exact.)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class ArrivalProcess(Protocol):
+    """A deterministic message-arrival intensity over simulated time."""
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate (msg/s) at time ``t``."""
+        ...
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        """Exact ``∫ rate dt`` over ``[t0, t1]`` (``t1 >= t0``)."""
+        ...
+
+
+@dataclass(frozen=True)
+class ConstantArrival:
+    """The seed's world: a flat rate."""
+
+    rate: float
+
+    def rate_at(self, t: float) -> float:
+        del t
+        return self.rate
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        return self.rate * (t1 - t0)
+
+
+@dataclass(frozen=True)
+class StepArrival:
+    """``before`` msg/s until ``at``, ``after`` msg/s from then on."""
+
+    before: float
+    after: float
+    at: float
+
+    def rate_at(self, t: float) -> float:
+        return self.after if t >= self.at else self.before
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        if t1 <= self.at:
+            return self.before * (t1 - t0)
+        if t0 >= self.at:
+            return self.after * (t1 - t0)
+        return self.before * (self.at - t0) + self.after * (t1 - self.at)
+
+
+@dataclass(frozen=True)
+class RampArrival:
+    """Linear ramp from ``start_rate`` at ``t_start`` to ``end_rate`` at
+    ``t_end``; clamped flat outside the ramp."""
+
+    start_rate: float
+    end_rate: float
+    t_start: float
+    t_end: float
+
+    def __post_init__(self):
+        if self.t_end <= self.t_start:
+            raise ValueError("t_end must be > t_start")
+
+    def rate_at(self, t: float) -> float:
+        if t <= self.t_start:
+            return self.start_rate
+        if t >= self.t_end:
+            return self.end_rate
+        frac = (t - self.t_start) / (self.t_end - self.t_start)
+        return self.start_rate + frac * (self.end_rate - self.start_rate)
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        # Piecewise: flat | linear | flat.  The linear segment integrates
+        # exactly as the trapezoid of its endpoint rates.
+        total = 0.0
+        if t0 < self.t_start:
+            flat_end = min(t1, self.t_start)
+            total += self.start_rate * (flat_end - t0)
+            t0 = flat_end
+        if t0 < min(t1, self.t_end):
+            seg_end = min(t1, self.t_end)
+            total += 0.5 * (self.rate_at(t0) + self.rate_at(seg_end)) * (
+                seg_end - t0
+            )
+            t0 = seg_end
+        if t0 < t1:
+            total += self.end_rate * (t1 - t0)
+        return total
+
+
+@dataclass(frozen=True)
+class DiurnalArrival:
+    """Sinusoidal daily cycle: ``base + amplitude·sin(2π(t−phase)/period)``.
+
+    Requires ``amplitude <= base`` so the rate never clips at zero and the
+    closed-form integral is exact everywhere.
+    """
+
+    base: float
+    amplitude: float
+    period: float
+    phase: float = 0.0
+
+    def __post_init__(self):
+        if self.period <= 0:
+            raise ValueError("period must be positive")
+        if self.amplitude > self.base:
+            raise ValueError(
+                "amplitude must be <= base (rate would clip at zero and the"
+                " analytic integral would be wrong)"
+            )
+
+    def _omega(self) -> float:
+        return 2.0 * math.pi / self.period
+
+    def rate_at(self, t: float) -> float:
+        return self.base + self.amplitude * math.sin(self._omega() * (t - self.phase))
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        w = self._omega()
+        return self.base * (t1 - t0) + (self.amplitude / w) * (
+            math.cos(w * (t0 - self.phase)) - math.cos(w * (t1 - self.phase))
+        )
+
+
+@dataclass(frozen=True)
+class BurstArrival:
+    """Rectangular bursts: ``burst_rate`` for ``burst_len`` seconds at the
+    start of every ``period``, ``base`` in between."""
+
+    base: float
+    burst_rate: float
+    period: float
+    burst_len: float
+    first_burst: float = 0.0
+
+    def __post_init__(self):
+        if not 0 < self.burst_len <= self.period:
+            raise ValueError("need 0 < burst_len <= period")
+
+    def _in_burst(self, t: float) -> bool:
+        if t < self.first_burst:
+            return False
+        return (t - self.first_burst) % self.period < self.burst_len
+
+    def rate_at(self, t: float) -> float:
+        return self.burst_rate if self._in_burst(t) else self.base
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        # base everywhere + the burst surplus over every overlapped window.
+        total = self.base * (t1 - t0)
+        surplus = self.burst_rate - self.base
+        k = max(0, math.floor((t0 - self.first_burst) / self.period))
+        burst_start = self.first_burst + k * self.period
+        while burst_start < t1:
+            overlap = min(t1, burst_start + self.burst_len) - max(t0, burst_start)
+            if overlap > 0:
+                total += surplus * overlap
+            burst_start += self.period
+        return total
+
+
+def as_process(arrival: "float | int | ArrivalProcess") -> ArrivalProcess:
+    """Coerce a plain number (the seed's config style) to a process."""
+    if isinstance(arrival, (int, float)):
+        return ConstantArrival(float(arrival))
+    return arrival

@@ -24,13 +24,23 @@ from ..metrics.fake import FakeQueueService
 from ..metrics.queue import QueueMetricSource
 from ..scale.actuator import PodAutoScaler
 from ..scale.fake import FakeDeploymentAPI
+from .scenarios import ArrivalProcess
 
 
 @dataclass(frozen=True)
 class SimConfig:
-    """World + policy parameters (policy defaults = reference defaults)."""
+    """World + policy parameters (policy defaults = reference defaults).
 
-    arrival_rate: float = 50.0  # msg/s into the queue
+    ``arrival_rate`` accepts the seed's plain msg/s number *or* any
+    :class:`~.scenarios.ArrivalProcess` (step/ramp/diurnal/burst); a plain
+    number keeps the exact constant-rate arithmetic of the seed.
+
+    ``policy`` selects the depth policy the gates threshold through:
+    ``"reactive"`` (the reference) or ``"predictive"`` (forecasted depth at
+    ``now + forecast_horizon`` via the named ``forecaster``).
+    """
+
+    arrival_rate: float | ArrivalProcess = 50.0  # msg/s into the queue
     service_rate_per_replica: float = 10.0  # msg/s drained per replica
     duration: float = 600.0  # simulated seconds
     initial_depth: float = 0.0
@@ -40,6 +50,12 @@ class SimConfig:
     scale_up_pods: int = 1
     scale_down_pods: int = 1
     loop: LoopConfig = field(default_factory=LoopConfig)
+    policy: str = "reactive"  # "reactive" | "predictive"
+    forecaster: str = "holt"  # ewma | holt | lstsq (policy="predictive")
+    forecast_horizon: float = 30.0  # seconds ahead the gates look
+    forecast_history: int = 128  # ring-buffer capacity (samples)
+    forecast_min_samples: int = 3  # reactive warm-up before forecasting
+    forecast_conservative: bool = True  # gates see max(observed, forecast)
 
 
 @dataclass
@@ -59,6 +75,16 @@ class SimResult:
             if a != b:
                 changes += 1
         return changes
+
+    def time_over(self, depth_threshold: float) -> float:
+        """Simulated seconds the *observed* depth sat above ``depth_threshold``
+        (left-rule over the observation timeline — the SLO metric the
+        scenario battery reports)."""
+        over = 0.0
+        for (t0, d0, _), (t1, _, _) in zip(self.timeline, self.timeline[1:]):
+            if d0 > depth_threshold:
+                over += t1 - t0
+        return over
 
 
 class _WorldQueue(FakeQueueService):
@@ -101,8 +127,35 @@ class Simulation:
             queue_url="sim://queue",
             attribute_names=("ApproximateNumberOfMessages",),
         )
+        depth_policy = None
+        observer = None
+        if self.config.policy == "predictive":
+            # Lazy import: the reactive path (and bench.py's default suite)
+            # stays JAX-free; only a predictive episode pays the import.
+            from ..forecast import DepthHistory, PredictivePolicy, make_forecaster
+
+            history = DepthHistory(capacity=self.config.forecast_history)
+            depth_policy = PredictivePolicy(
+                make_forecaster(self.config.forecaster),
+                history,
+                horizon=self.config.forecast_horizon,
+                min_samples=self.config.forecast_min_samples,
+                conservative=self.config.forecast_conservative,
+            )
+            observer = history
+        elif self.config.policy != "reactive":
+            raise ValueError(
+                f"policy must be 'reactive' or 'predictive', got"
+                f" {self.config.policy!r}"
+            )
+        self.depth_policy = depth_policy
         self.loop = ControlLoop(
-            self.scaler, self.metric_source, self.config.loop, clock=self.clock
+            self.scaler,
+            self.metric_source,
+            self.config.loop,
+            clock=self.clock,
+            observer=observer,
+            depth_policy=depth_policy,
         )
         self.timeline: list[tuple[float, int, int]] = []
         self._max_depth = self.depth
@@ -114,11 +167,19 @@ class Simulation:
         if dt <= 0:
             return
         replicas = self.deployments.replicas("workers")
-        net_rate = (
-            self.config.arrival_rate
-            - replicas * self.config.service_rate_per_replica
-        )
-        self.depth = max(0.0, self.depth + net_rate * dt)
+        arrival = self.config.arrival_rate
+        if isinstance(arrival, (int, float)):
+            # The seed's constant-rate arithmetic, expression-for-expression:
+            # time-varying worlds must not perturb existing sim results.
+            net_rate = arrival - replicas * self.config.service_rate_per_replica
+            self.depth = max(0.0, self.depth + net_rate * dt)
+        else:
+            # Arrivals integrate analytically; the empty-queue floor is
+            # per-interval, so a mid-interval empty + rate rise understates
+            # depth by at most that interval's drain (see scenarios.py).
+            arrived = arrival.arrivals_between(self._last_world_update, now)
+            drained = replicas * self.config.service_rate_per_replica * dt
+            self.depth = max(0.0, self.depth + arrived - drained)
         self._max_depth = max(self._max_depth, self.depth)
         self._last_world_update = now
         self.timeline.append((now, int(self.depth), replicas))

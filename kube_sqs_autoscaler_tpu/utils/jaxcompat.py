@@ -1,0 +1,46 @@
+"""JAX version compatibility shims.
+
+The workload code targets the current ``jax.shard_map`` API (top-level
+export, ``check_vma=`` keyword).  Older jaxlibs — including the 0.4.x this
+image may ship — only have ``jax.experimental.shard_map.shard_map`` with
+the ``check_rep=`` spelling.  :func:`install` bridges the gap in one place
+so the ~20 call sites across flash/ring/zigzag/pipeline stay written
+against the modern API.
+
+Import-guarded: the control plane never imports JAX, and this module keeps
+that true when jax is absent entirely.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def install() -> None:
+    """Make ``jax.shard_map`` exist with the modern signature. Idempotent."""
+    if importlib.util.find_spec("jax") is None:
+        return
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        # check_rep is always disabled on the legacy API: the old
+        # replication checker false-positives on these manual-collective
+        # programs (e.g. "branches of cond produced mismatched replication
+        # types" for the zig-zag kernel-vs-einsum cond), which is why it
+        # was redesigned as check_vma.  The modern checker still runs
+        # wherever jax.shard_map exists natively.
+        del check_vma
+        return _legacy_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=False,
+            **kwargs,
+        )
+
+    jax.shard_map = shard_map

@@ -48,3 +48,10 @@ The controller itself (core/metrics/scale/cli) imports none of this; the
 dependency edge goes one way, mirroring the reference where the autoscaler
 and the scaled workload are separate programs.
 """
+
+from ..utils import jaxcompat
+
+# Every workload module is reached through this package, so the JAX
+# version shims (jax.shard_map naming) are installed exactly once here.
+jaxcompat.install()
+

@@ -96,7 +96,12 @@ _LANES = 128
 # Telling Mosaic so (instead of the all-"arbitrary" default) lets it
 # reorder/pipeline the parallel dims — measured ~10% off fwd+bwd at the
 # flagship train shape (B=8, H=16, S=2048, D=64, TPU v5e).
-_GRID_SEMANTICS = pltpu.CompilerParams(
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5; accept
+# whichever this jaxlib ships so the kernels import on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+_GRID_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
 )
 

@@ -1,0 +1,132 @@
+"""Property-testing front end: hypothesis when installed, a seeded
+deterministic fallback otherwise.
+
+The image this suite runs on does not ship ``hypothesis`` and nothing may
+be pip-installed, but the property tests are tier-1 — so this module
+re-exports the real library when available and otherwise provides a
+minimal drop-in subset (``given``/``settings``/``strategies``) backed by a
+per-test seeded ``random.Random``.  The fallback is deliberately small:
+only the strategy combinators this suite uses, no shrinking — a failing
+example is reported verbatim in the assertion chain instead.
+"""
+
+from __future__ import annotations
+
+try:  # pragma: no cover - exercised only where hypothesis is installed
+    from hypothesis import given, settings, strategies as st  # noqa: F401
+
+    HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:
+    HAVE_HYPOTHESIS = False
+
+    import random
+    import zlib
+
+    _DEFAULT_MAX_EXAMPLES = 100
+
+    class _Strategy:
+        """A value generator: ``example(rng)`` draws one value."""
+
+        def __init__(self, draw):
+            self._draw = draw
+
+        def example(self, rng):
+            return self._draw(rng)
+
+        def map(self, fn):
+            return _Strategy(lambda rng: fn(self._draw(rng)))
+
+    class _Strategies:
+        """The ``hypothesis.strategies`` subset this suite draws from.
+
+        Numeric strategies bias ~1/4 of draws to the interval endpoints —
+        threshold/cooldown boundaries are exactly where the reference
+        semantics have their subtleties (inclusive gates, strictly-After
+        cooldowns), and uniform sampling almost never lands on them.
+        """
+
+        @staticmethod
+        def integers(min_value, max_value):
+            def draw(rng):
+                if rng.random() < 0.25:
+                    return rng.choice((min_value, max_value))
+                return rng.randint(min_value, max_value)
+
+            return _Strategy(draw)
+
+        @staticmethod
+        def floats(min_value, max_value, **_kwargs):
+            def draw(rng):
+                if rng.random() < 0.25:
+                    return float(rng.choice((min_value, max_value)))
+                return rng.uniform(min_value, max_value)
+
+            return _Strategy(draw)
+
+        @staticmethod
+        def booleans():
+            return _Strategy(lambda rng: rng.random() < 0.5)
+
+        @staticmethod
+        def sampled_from(elements):
+            items = list(elements)
+            return _Strategy(lambda rng: rng.choice(items))
+
+        @staticmethod
+        def lists(elements, min_size=0, max_size=10):
+            def draw(rng):
+                size = rng.randint(min_size, max_size)
+                return [elements.example(rng) for _ in range(size)]
+
+            return _Strategy(draw)
+
+        @staticmethod
+        def builds(target, **kwargs):
+            def draw(rng):
+                return target(**{k: s.example(rng) for k, s in kwargs.items()})
+
+            return _Strategy(draw)
+
+        @staticmethod
+        def one_of(*strategies):
+            return _Strategy(lambda rng: rng.choice(strategies).example(rng))
+
+    st = _Strategies()
+
+    def settings(max_examples=_DEFAULT_MAX_EXAMPLES, **_kwargs):
+        """Applied *outside* ``given``: stamps the example budget on the
+        already-wrapped test; the wrapper reads it at call time."""
+
+        def decorate(fn):
+            fn._proptest_max_examples = max_examples
+            return fn
+
+        return decorate
+
+    def given(**strategies):
+        def decorate(fn):
+            # NOT functools.wraps: that sets __wrapped__, making pytest see
+            # the original signature and demand fixtures for every
+            # strategy-filled parameter.  The wrapper must look zero-arg.
+            def wrapper(*args, **kwargs):
+                # Seed from the test name: deterministic across runs and
+                # processes (unlike hash()), distinct across tests.
+                rng = random.Random(zlib.crc32(fn.__qualname__.encode()))
+                budget = getattr(
+                    wrapper, "_proptest_max_examples", _DEFAULT_MAX_EXAMPLES
+                )
+                for i in range(budget):
+                    example = {k: s.example(rng) for k, s in strategies.items()}
+                    try:
+                        fn(*args, **example, **kwargs)
+                    except Exception as err:
+                        raise AssertionError(
+                            f"falsifying example #{i}: {example!r}"
+                        ) from err
+
+            wrapper.__name__ = fn.__name__
+            wrapper.__qualname__ = fn.__qualname__
+            wrapper.__doc__ = fn.__doc__
+            return wrapper
+
+        return decorate

@@ -87,3 +87,35 @@ def test_config_from_args_maps_to_loop_and_policy():
 def test_default_attribute_names_round_trip():
     args = build_parser().parse_args([])
     assert parse_attribute_names(args.attribute_names) is DEFAULT_ATTRIBUTE_NAMES
+
+
+def test_forecast_flags_default_to_reference_reactive_behavior():
+    args = build_parser().parse_args([])
+    assert args.policy == "reactive"
+    assert args.forecaster == "holt"
+    assert args.forecast_horizon == 60.0
+    assert args.forecast_history == 128
+
+
+def test_predictive_policy_flags_parse_with_go_durations():
+    args = build_parser().parse_args(
+        ["--policy=predictive", "--forecaster=lstsq",
+         "--forecast-horizon=2m", "--forecast-history=64"]
+    )
+    assert args.policy == "predictive"
+    assert args.forecaster == "lstsq"
+    assert args.forecast_horizon == 120.0
+    assert args.forecast_history == 64
+
+
+def test_unknown_policy_or_forecaster_is_a_usage_error():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--policy=quantum"])
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--forecaster=arima"])
+
+
+def test_too_small_forecast_history_is_a_usage_error():
+    # not a raw DepthHistory ValueError traceback later in main()
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--forecast-history=1"])

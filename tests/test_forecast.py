@@ -1,0 +1,392 @@
+"""Forecast subsystem: ring-buffer history, the three JAX forecasters
+(jit-compiled, exact on their model classes), the predictive policy's
+warm-up/conservative/scoreboard behavior, and the loop integration —
+a predictive loop scales up before the backlog the reactive loop waits for.
+"""
+
+import numpy as np
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.events import (
+    CompositeTickObserver,
+    TickRecord,
+)
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.forecast import (
+    DepthHistory,
+    EwmaForecaster,
+    HoltForecaster,
+    LeastSquaresForecaster,
+    PredictivePolicy,
+    ReactivePolicy,
+    make_forecaster,
+)
+from kube_sqs_autoscaler_tpu.metrics import FakeQueueService, QueueMetricSource
+from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+
+# --------------------------------------------------------------------------
+# DepthHistory
+
+
+def test_history_fills_then_wraps_chronologically():
+    h = DepthHistory(capacity=4)
+    for i in range(7):
+        h.observe(float(i), float(i * 10))
+    times, depths, n = h.snapshot()
+    assert n == 4
+    assert times.tolist() == [3.0, 4.0, 5.0, 6.0]
+    assert depths.tolist() == [30.0, 40.0, 50.0, 60.0]
+
+
+def test_history_partial_snapshot_pads_with_newest_sample():
+    h = DepthHistory(capacity=4)
+    h.observe(1.0, 5.0)
+    h.observe(2.0, 7.0)
+    times, depths, n = h.snapshot()
+    assert n == 2
+    assert times.tolist() == [1.0, 2.0, 2.0, 2.0]
+    assert depths.tolist() == [5.0, 7.0, 7.0, 7.0]
+
+
+def test_with_sample_is_pure_and_drops_oldest_when_full():
+    h = DepthHistory(capacity=3)
+    for i in range(3):
+        h.observe(float(i), float(i))
+    times, depths, n = h.with_sample(3.0, 99.0)
+    assert n == 3
+    assert times.tolist() == [1.0, 2.0, 3.0]
+    assert depths.tolist() == [1.0, 2.0, 99.0]
+    assert len(h) == 3  # unchanged
+    assert h.snapshot()[0].tolist() == [0.0, 1.0, 2.0]
+
+
+def test_history_is_fed_from_tick_records_and_skips_metric_errors():
+    h = DepthHistory(capacity=8)
+    h.on_tick(TickRecord(start=5.0, num_messages=120))
+    h.on_tick(TickRecord(start=10.0, metric_error="boom"))  # no observation
+    h.on_tick(TickRecord(start=15.0, num_messages=130))
+    times, depths, n = h.snapshot()
+    assert n == 2
+    assert times[:2].tolist() == [5.0, 15.0]
+    assert depths[:2].tolist() == [120.0, 130.0]
+
+
+def test_history_rejects_tiny_capacity():
+    with pytest.raises(ValueError):
+        DepthHistory(capacity=1)
+
+
+# --------------------------------------------------------------------------
+# Forecasters
+
+
+def linear_history(capacity=32, n=12, dt=5.0, start=100.0, slope=4.0):
+    h = DepthHistory(capacity=capacity)
+    for i in range(n):
+        h.observe(i * dt, start + slope * (i * dt))
+    return h.snapshot()
+
+
+def test_forecasters_are_jit_compiled():
+    from kube_sqs_autoscaler_tpu.forecast import forecasters
+
+    for fn in (forecasters._ewma_level, forecasters._holt_forecast,
+               forecasters._lstsq_forecast):
+        # the jit wrapper exposes lower(); a plain function doesn't
+        assert hasattr(fn, "lower")
+
+
+def test_lstsq_is_exact_on_a_linear_trend():
+    times, depths, n = linear_history(slope=4.0)
+    pred = LeastSquaresForecaster(window=8).predict(times, depths, n, 30.0)
+    last = depths[n - 1]
+    assert pred == pytest.approx(last + 4.0 * 30.0, rel=1e-4)
+
+
+def test_holt_tracks_a_linear_trend():
+    times, depths, n = linear_history(n=20, slope=4.0)
+    pred = HoltForecaster().predict(times, depths, n, 30.0)
+    last = depths[n - 1]
+    # converging, not exact: within 15% of the true extrapolation step
+    assert pred == pytest.approx(last + 4.0 * 30.0, rel=0.15)
+    assert pred > last  # and definitely trending up
+
+
+def test_ewma_converges_to_a_constant_level():
+    h = DepthHistory(capacity=32)
+    for i in range(20):
+        h.observe(float(i * 5), 250.0)
+    times, depths, n = h.snapshot()
+    assert EwmaForecaster().predict(times, depths, n, 60.0) == pytest.approx(
+        250.0, rel=1e-5
+    )
+
+
+def test_forecasts_clamp_at_zero_on_steep_drains():
+    h = DepthHistory(capacity=16)
+    for i in range(8):
+        h.observe(i * 5.0, max(0.0, 700.0 - 100.0 * i))  # -20 msg/s
+    times, depths, n = h.snapshot()
+    for forecaster in (HoltForecaster(), LeastSquaresForecaster(window=8)):
+        assert forecaster.predict(times, depths, n, 120.0) >= 0.0
+
+
+def test_forecasters_handle_degenerate_histories():
+    h = DepthHistory(capacity=8)
+    h.observe(5.0, 100.0)
+    h.observe(5.0, 100.0)  # coincident timestamps
+    times, depths, n = h.snapshot()
+    for forecaster in (EwmaForecaster(), HoltForecaster(),
+                       LeastSquaresForecaster()):
+        value = forecaster.predict(times, depths, n, 30.0)
+        assert np.isfinite(value)
+        assert value >= 0.0
+
+
+def test_trend_forecasters_survive_large_clock_epochs():
+    # SystemClock.now() is monotonic seconds since boot: at ~2.7e8 s the
+    # raw stamps are not representable 5 s apart in float32.  Times are
+    # centered in float64 before the jit boundary, so predictions must
+    # match the epoch-0 ones.
+    offset = 2.7e8
+    h0, h1 = DepthHistory(capacity=32), DepthHistory(capacity=32)
+    for i in range(12):
+        h0.observe(i * 5.0, 100.0 + 4.0 * (i * 5.0))
+        h1.observe(offset + i * 5.0, 100.0 + 4.0 * (i * 5.0))
+    for forecaster in (HoltForecaster(), LeastSquaresForecaster(window=8)):
+        base = forecaster.predict(*h0.snapshot(), 30.0)
+        shifted = forecaster.predict(*h1.snapshot(), 30.0)
+        assert shifted == pytest.approx(base, rel=1e-3), forecaster.name
+
+
+def test_make_forecaster_registry():
+    assert make_forecaster("ewma").name == "ewma"
+    assert make_forecaster("holt").name == "holt"
+    assert make_forecaster("lstsq").name == "lstsq"
+    with pytest.raises(ValueError):
+        make_forecaster("arima")
+
+
+# --------------------------------------------------------------------------
+# PredictivePolicy
+
+
+def ramping_policy(conservative=True, min_samples=3):
+    h = DepthHistory(capacity=32)
+    return PredictivePolicy(
+        LeastSquaresForecaster(window=16), h,
+        horizon=30.0, min_samples=min_samples, conservative=conservative,
+    ), h
+
+
+def test_policy_passes_through_until_warm():
+    policy, history = ramping_policy(min_samples=3)
+    assert policy.effective_messages(0.0, 50) == 50
+    assert policy.last_prediction is None
+    history.observe(0.0, 50.0)
+    assert policy.effective_messages(5.0, 54) == 54  # still n=2 < 3
+    history.observe(5.0, 54.0)
+    # third sample: forecasting starts
+    effective = policy.effective_messages(10.0, 58)
+    assert policy.last_prediction is not None
+    assert effective >= 58
+
+
+def test_policy_forecasts_ahead_on_a_ramp():
+    policy, history = ramping_policy(conservative=False)
+    for i in range(10):
+        history.observe(i * 5.0, 50.0 + 4.0 * i * 5.0)
+    now, observed = 50.0, 250
+    effective = policy.effective_messages(now, observed)
+    # slope 4 msg/s, horizon 30 s => ~120 ahead of the observation
+    assert effective == pytest.approx(observed + 120, abs=5)
+
+
+def test_conservative_policy_never_goes_below_observation():
+    policy, history = ramping_policy(conservative=True)
+    for i in range(10):
+        history.observe(i * 5.0, max(0.0, 500.0 - 40.0 * i))  # steep drain
+    assert policy.effective_messages(50.0, 100) == 100  # forecast < observed
+
+
+def test_policy_scores_matured_forecasts():
+    policy, history = ramping_policy(conservative=False)
+    for i in range(6):
+        history.observe(i * 5.0, 100.0)
+    policy.effective_messages(30.0, 100)  # forecast for t=60
+    assert policy.last_abs_error is None
+    for t in (35.0, 40.0, 45.0, 50.0, 55.0):
+        history.observe(t, 100.0)
+        policy.effective_messages(t, 100)
+    history.observe(60.0, 130.0)
+    policy.effective_messages(60.0, 130)  # t=60 forecast matures here
+    assert policy.last_abs_error == pytest.approx(30.0, abs=1.0)
+
+
+def test_policy_rejects_negative_horizon():
+    with pytest.raises(ValueError):
+        PredictivePolicy(EwmaForecaster(), horizon=-1.0)
+
+
+def test_reactive_policy_is_identity():
+    policy = ReactivePolicy()
+    assert policy.effective_messages(123.0, 77) == 77
+
+
+# --------------------------------------------------------------------------
+# Loop integration
+
+
+def _episode(depth_policy, depths, up=100, poll=5.0):
+    """Run one episode over a queue-depth trace; returns (api, loop, clock)."""
+    api = FakeDeploymentAPI.with_deployments("ns", 1, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=20, min=1, scale_up_pods=1, scale_down_pods=1,
+        deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(depths[0])
+    clock = FakeClock()
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(
+            poll_interval=poll,
+            policy=PolicyConfig(
+                scale_up_messages=up, scale_down_messages=10,
+                scale_up_cooldown=10.0, scale_down_cooldown=30.0,
+            ),
+        ),
+        clock=clock,
+        observer=depth_policy.history if depth_policy else None,
+        depth_policy=depth_policy,
+    )
+    for i, depth in enumerate(depths):
+        clock.at(float(i) * poll, lambda d=depth: queue.set_depths(d))
+    return api, loop, clock
+
+
+def test_predictive_loop_fires_before_the_reactive_threshold():
+    # depth ramps 0, 20, 40, ... (+4 msg/s): crosses the 100-message gate
+    # at t=25s.  With a 30 s horizon the predictive loop sees >= 100 one
+    # horizon earlier and scales while the reactive loop still idles.
+    depths = [20 * i for i in range(12)]
+
+    def first_scale_time(depth_policy):
+        api, loop, clock = _episode(depth_policy, depths)
+        replicas_at: list[tuple[float, int]] = []
+        original_tick = loop.tick
+
+        def recording_tick(state):
+            new_state = original_tick(state)
+            replicas_at.append((clock.now(), api.replicas("deploy")))
+            return new_state
+
+        loop.tick = recording_tick
+        loop.run(max_ticks=len(depths))
+        return next((t for t, r in replicas_at if r > 1), None)
+
+    reactive_t = first_scale_time(None)
+    predictive_t = first_scale_time(
+        PredictivePolicy(
+            LeastSquaresForecaster(window=8), DepthHistory(capacity=16),
+            horizon=30.0, min_samples=3,
+        )
+    )
+    assert reactive_t is not None and predictive_t is not None
+    assert predictive_t < reactive_t
+
+
+def test_depth_policy_failure_falls_back_to_observed_depth():
+    class ExplodingPolicy:
+        history = None
+
+        def effective_messages(self, now, num_messages):
+            raise RuntimeError("forecast kaboom")
+
+    api, loop, _ = _episode(ExplodingPolicy(), [500] * 3)
+    loop.run(max_ticks=3)
+    # the loop survived AND still scaled up reactively on the raw depth
+    assert api.replicas("deploy") > 1
+
+
+def test_failing_policy_does_not_export_a_stale_forecast():
+    # succeeds twice (leaving last_prediction set), then explodes forever:
+    # failing ticks must not carry the old forecast on their records.
+    class FlakyPolicy:
+        def __init__(self):
+            self.inner = PredictivePolicy(
+                LeastSquaresForecaster(window=8), DepthHistory(capacity=16),
+                horizon=30.0, min_samples=2,
+            )
+            self.history = self.inner.history
+            self.calls = 0
+
+        @property
+        def last_prediction(self):
+            return self.inner.last_prediction
+
+        @property
+        def last_abs_error(self):
+            return self.inner.last_abs_error
+
+        def effective_messages(self, now, num_messages):
+            self.calls += 1
+            if self.calls > 2:
+                raise RuntimeError("forecast kaboom")
+            return self.inner.effective_messages(now, num_messages)
+
+    records = []
+
+    class Recorder:
+        def on_tick(self, record):
+            records.append(record)
+
+    policy = FlakyPolicy()
+    api, loop, _ = _episode(policy, [100, 120, 140, 160])
+    loop.observer = CompositeTickObserver([policy.history, Recorder()])
+    loop.run(max_ticks=4)
+    assert records[1].predicted_messages is not None  # warm, succeeded
+    for record in records[2:]:  # policy raising: observed depth, no forecast
+        assert record.predicted_messages is None
+        assert record.forecast_error is None
+        assert record.decision_messages == record.num_messages
+
+
+def test_tick_record_carries_forecast_fields():
+    records = []
+
+    class Recorder:
+        def on_tick(self, record):
+            records.append(record)
+
+    policy = PredictivePolicy(
+        LeastSquaresForecaster(window=8), DepthHistory(capacity=16),
+        horizon=30.0, min_samples=2,
+    )
+    api, loop, _ = _episode(policy, [100, 120, 140, 160])
+    loop.observer = CompositeTickObserver([policy.history, Recorder()])
+    loop.run(max_ticks=4)
+    assert len(records) == 4
+    warm = [r for r in records if r.predicted_messages is not None]
+    assert warm, "policy never warmed up in 4 ticks"
+    for record in records:
+        assert record.decision_messages is not None
+        assert record.decision_messages >= record.num_messages
+
+
+def test_composite_observer_isolates_failures():
+    class Bad:
+        def on_tick(self, record):
+            raise RuntimeError("observer kaboom")
+
+    seen = []
+
+    class Good:
+        def on_tick(self, record):
+            seen.append(record)
+
+    composite = CompositeTickObserver([Bad(), Good()])
+    composite.on_tick(TickRecord(start=0.0, num_messages=5))
+    assert len(seen) == 1

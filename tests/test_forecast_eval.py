@@ -1,0 +1,79 @@
+"""Scenario-battery acceptance: the headline claim of the predictive
+subsystem, asserted end to end — on the ramp and diurnal scenarios the
+best forecaster strictly reduces max queue depth vs. the reactive policy
+without blowing the churn budget.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.sim.evaluate import (
+    default_battery,
+    evaluate_battery,
+    run_episode,
+    summarize,
+)
+
+TARGETS = ("ramp", "diurnal")
+
+
+@pytest.fixture(scope="module")
+def target_report():
+    battery = tuple(s for s in default_battery() if s.name in TARGETS)
+    return evaluate_battery(scenarios=battery)
+
+
+def test_best_forecaster_beats_reactive_on_ramp_and_diurnal(target_report):
+    summary = summarize(target_report, target_scenarios=TARGETS)
+    winner = summary["winner"]
+    assert summary["candidates"][winner]["within_churn_budget"]
+    for scenario in TARGETS:
+        reactive = target_report[scenario]["reactive"]
+        predictive = target_report[scenario][winner]
+        # strictly lower worst backlog...
+        assert predictive["max_depth"] < reactive["max_depth"], scenario
+        # ...within the +25% churn budget
+        assert predictive["replica_changes"] <= 1.25 * max(
+            reactive["replica_changes"], 1
+        ), scenario
+
+
+def test_predictive_never_worsens_time_over_slo_on_targets(target_report):
+    summary = summarize(target_report, target_scenarios=TARGETS)
+    winner = summary["winner"]
+    for scenario in TARGETS:
+        assert (
+            target_report[scenario][winner]["time_over_slo_s"]
+            <= target_report[scenario]["reactive"]["time_over_slo_s"]
+        ), scenario
+
+
+def test_episodes_are_deterministic(target_report):
+    battery = {s.name: s for s in default_battery()}
+    again = run_episode(battery["ramp"], policy="predictive", forecaster="holt")
+    assert again == target_report["ramp"]["predictive:holt"]
+
+
+@pytest.mark.slow
+def test_bench_forecast_suite_emits_artifact(tmp_path):
+    out_path = tmp_path / "BENCH_forecast.json"
+    run = subprocess.run(
+        [sys.executable, "bench.py", "--suite", "forecast",
+         "--output", str(out_path)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    lines = run.stdout.strip().splitlines()
+    assert len(lines) == 1  # the one-JSON-line stdout contract holds
+    headline = json.loads(lines[0])
+    assert set(headline) == {"metric", "value", "unit", "vs_baseline"}
+    assert headline["metric"] == "forecast_target_max_depth"
+    assert headline["vs_baseline"] > 1.0  # predictive beats reactive
+    artifact = json.loads(out_path.read_text())
+    assert artifact["suite"] == "forecast"
+    assert set(artifact["report"]) == {"step", "ramp", "diurnal", "burst"}
+    assert artifact["summary"]["winner"].startswith("predictive:")
+    assert artifact["elapsed_s"] < 60.0

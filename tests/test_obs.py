@@ -166,6 +166,46 @@ def test_queue_messages_gauge_absent_until_first_observation():
     assert "# TYPE kube_sqs_autoscaler_queue_messages gauge" in metrics.render()
 
 
+def test_forecast_gauges_render_from_tick_records():
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+
+    metrics = ControllerMetrics()
+    # reactive-shaped tick: decision only, no forecast sample
+    metrics.on_tick(
+        TickRecord(start=0.0, num_messages=80, decision_messages=80)
+    )
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_decision_messages 80" in text
+    assert "# TYPE kube_sqs_autoscaler_predicted_queue_messages gauge" in text
+    assert not [  # no forecast sample yet: HELP/TYPE only
+        line for line in text.splitlines()
+        if line.startswith("kube_sqs_autoscaler_predicted_queue_messages")
+    ]
+    # predictive-shaped tick: forecast + matured error
+    metrics.on_tick(
+        TickRecord(
+            start=5.0, num_messages=90, decision_messages=150,
+            predicted_messages=150, forecast_error=12.5,
+        )
+    )
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_decision_messages 150" in text
+    assert "kube_sqs_autoscaler_predicted_queue_messages 150" in text
+    assert "kube_sqs_autoscaler_forecast_abs_error 12.5" in text
+    # a forecast-less tick (failing or warm-up policy) CLEARS the gauges:
+    # latching would export an arbitrarily stale forecast as live
+    metrics.on_tick(
+        TickRecord(start=10.0, num_messages=95, decision_messages=95)
+    )
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_decision_messages 95" in text
+    for gauge in ("predicted_queue_messages", "forecast_abs_error"):
+        assert not [
+            line for line in text.splitlines()
+            if line.startswith(f"kube_sqs_autoscaler_{gauge} ")
+        ], gauge
+
+
 # --- HTTP endpoints ---------------------------------------------------------
 
 

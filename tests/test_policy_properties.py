@@ -4,7 +4,7 @@ the full loop under arbitrary workload traces — bounds are never violated
 and cooldowns always separate actuations.
 """
 
-from hypothesis import given, settings, strategies as st
+from tests.proptest import given, settings, st
 
 from kube_sqs_autoscaler_tpu.core.clock import FakeClock
 from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
@@ -140,3 +140,126 @@ def test_episode_invariants(
                 assert t - last_down_time >= down_cool - 1e-6
             last_down_time = t
         prev = replicas
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    depths=st.lists(st.integers(0, 500), min_size=1, max_size=40),
+    up=st.integers(50, 300),
+    down=st.integers(0, 49),
+    up_cool=st.floats(0, 30, allow_nan=False),
+    down_cool=st.floats(0, 30, allow_nan=False),
+    min_pods=st.integers(1, 3),
+    extra=st.integers(0, 10),
+    init_offset=st.integers(0, 5),
+    step=st.integers(1, 5),
+    forecaster_name=st.sampled_from(["ewma", "holt", "lstsq"]),
+    horizon=st.floats(0, 120, allow_nan=False),
+    conservative=st.booleans(),
+)
+def test_predictive_episode_invariants(
+    depths, up, down, up_cool, down_cool, min_pods, extra, init_offset, step,
+    forecaster_name, horizon, conservative,
+):
+    """The predictive policy sits *before* the unchanged gates, so whatever
+    a forecaster hallucinates, an episode must uphold exactly the
+    invariants the reactive episode does: replica bounds are never
+    violated and actuations in one direction are always separated by that
+    direction's cooldown."""
+    from kube_sqs_autoscaler_tpu.forecast import (
+        DepthHistory,
+        PredictivePolicy,
+        make_forecaster,
+    )
+
+    max_pods = min_pods + extra
+    init = min(min_pods + init_offset, max_pods)
+    api = FakeDeploymentAPI.with_deployments("ns", init, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=max_pods, min=min_pods, scale_up_pods=step,
+        scale_down_pods=step, deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(depths[0])
+    clock = FakeClock()
+    policy = PredictivePolicy(
+        make_forecaster(forecaster_name),
+        DepthHistory(capacity=16),
+        horizon=horizon,
+        conservative=conservative,
+    )
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(
+            poll_interval=1.0,
+            policy=PolicyConfig(
+                scale_up_messages=up, scale_down_messages=down,
+                scale_up_cooldown=up_cool, scale_down_cooldown=down_cool,
+            ),
+        ),
+        clock=clock,
+        observer=policy.history,
+        depth_policy=policy,
+    )
+    for i, depth in enumerate(depths):
+        clock.at(float(i), lambda d=depth: queue.set_depths(d))
+
+    observations: list[tuple[float, int]] = []
+    original_tick = loop.tick
+
+    def recording_tick(state):
+        new_state = original_tick(state)
+        observations.append((clock.now(), api.replicas("deploy")))
+        return new_state
+
+    loop.tick = recording_tick
+    loop.run(max_ticks=len(depths))
+
+    low = min(min_pods, init)
+    high = max(max_pods, init)
+    assert all(low <= r <= high for _, r in observations)
+
+    last_up_time = None
+    last_down_time = None
+    prev = init
+    for t, replicas in observations:
+        if replicas > prev:
+            if last_up_time is not None:
+                assert t - last_up_time >= up_cool - 1e-6
+            last_up_time = t
+        elif replicas < prev:
+            if last_down_time is not None:
+                assert t - last_down_time >= down_cool - 1e-6
+            last_down_time = t
+        prev = replicas
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    depths=st.lists(st.integers(0, 400), min_size=3, max_size=30),
+    up=st.integers(50, 300),
+    horizon=st.floats(0, 120, allow_nan=False),
+    forecaster_name=st.sampled_from(["ewma", "holt", "lstsq"]),
+)
+def test_conservative_policy_effective_depth_dominates_observed(
+    depths, up, horizon, forecaster_name
+):
+    """conservative=True thresholds on max(observed, forecast): the up gate
+    can only ever see a depth >= the reactive gate's — it fires no later —
+    and the down gate needs both signals below threshold."""
+    from kube_sqs_autoscaler_tpu.forecast import (
+        DepthHistory,
+        PredictivePolicy,
+        make_forecaster,
+    )
+
+    policy = PredictivePolicy(
+        make_forecaster(forecaster_name),
+        DepthHistory(capacity=8),
+        horizon=horizon,
+        conservative=True,
+    )
+    for i, depth in enumerate(depths):
+        effective = policy.effective_messages(float(i), depth)
+        assert effective >= depth
+        policy.history.observe(float(i), float(depth))
