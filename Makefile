@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay replay-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -39,6 +39,18 @@ bench:
 # Reactive-vs-predictive scenario battery (CPU, <60 s); writes BENCH_r06.json
 bench-forecast:
 	JAX_PLATFORMS=cpu python bench.py --suite forecast
+
+# Flight-recorder loop: record a simulated episode to a JSONL journal,
+# re-drive the production loop from it (exits non-zero on ANY decision
+# divergence), validate the trace export, counterfactually re-score under
+# every forecaster; writes BENCH_r07.json
+bench-replay:
+	JAX_PLATFORMS=cpu python bench.py --suite replay
+
+# The fidelity gate alone (no JAX, seconds): record a short simulated
+# episode, replay it, fail on any decision divergence
+replay-demo:
+	python -m kube_sqs_autoscaler_tpu.sim.replay
 
 # TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
 # the real chip; writes WORKBENCH.json

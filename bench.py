@@ -23,6 +23,13 @@ time-over-SLO, and replica churn), writes the full report to
 forecaster's deltas.  CPU-only, < 60 s end to end (the predictive
 episodes pay one JAX trace each; the battery itself is seconds).
 
+``--suite replay`` exercises the flight-recorder loop end to end: record
+a simulated episode to a JSONL journal (`obs/journal.py`), re-drive the
+production loop from it and fail on any decision divergence
+(`sim/replay.py`), validate the Chrome trace-event export, then
+counterfactually re-score the same recorded episode under reactive +
+every forecaster; writes ``BENCH_r07.json``.
+
 The default suite deliberately imports no JAX: the controller is plain
 Python (the reference is a plain Go binary with no accelerator workload,
 SURVEY.md §2); model workload microbenchmarks live in tests/ and the
@@ -34,6 +41,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 from kube_sqs_autoscaler_tpu.core.loop import LoopConfig
@@ -195,19 +203,105 @@ def run_forecast_suite(output: str = "BENCH_r06.json") -> dict:
     }
 
 
+def run_replay_suite(output: str = "BENCH_r07.json") -> dict:
+    """Record → replay → counterfactual, as one self-checking benchmark.
+
+    Fidelity is a hard gate: any recorded-vs-replayed decision divergence
+    raises ``SystemExit(2)`` (the ``make replay-demo`` contract).  The
+    headline number is the best counterfactual policy's max-depth
+    improvement over the recorded reactive episode — i.e. what the flight
+    recorder's postmortem loop would have bought during this episode.
+    """
+    import os
+    import tempfile
+
+    from kube_sqs_autoscaler_tpu.obs.journal import read_journal
+    from kube_sqs_autoscaler_tpu.obs.trace import to_chrome_trace
+    from kube_sqs_autoscaler_tpu.sim.evaluate import score_result
+    from kube_sqs_autoscaler_tpu.sim.replay import (
+        _demo_config,
+        counterfactual,
+        record_episode,
+        replay,
+    )
+
+    start = time.perf_counter()
+    slo_depth = 300.0
+    with tempfile.TemporaryDirectory(prefix="bench-replay-") as tmp:
+        journal_path = os.path.join(tmp, "journal.jsonl")
+        config = _demo_config()
+        _, sim_result = record_episode(config, journal_path)
+        meta, records = read_journal(journal_path)
+        fidelity = replay(records, meta)
+        if not fidelity.ok:
+            for line in fidelity.format_divergences():
+                print(line, file=sys.stderr)
+            raise SystemExit(2)
+        trace = to_chrome_trace(records, meta)
+        trace_ok = bool(trace["traceEvents"])  # shape pinned in tests/test_trace.py
+        recorded_score = score_result(sim_result, slo_depth)
+        rows = {
+            "recorded": recorded_score,
+            "counterfactual:reactive": counterfactual(
+                records, meta, policy="reactive", slo_depth=slo_depth
+            ),
+        }
+        # horizon matched to the demo burst's timescale, like the scenario
+        # battery tunes horizons per scenario (evaluate.Scenario.horizon)
+        for name in ("ewma", "holt", "lstsq"):
+            rows[f"counterfactual:predictive:{name}"] = counterfactual(
+                records, meta, policy="predictive", forecaster=name,
+                horizon=30.0, slo_depth=slo_depth,
+            )
+    elapsed = time.perf_counter() - start
+    best_name = min(
+        (k for k in rows if k.startswith("counterfactual:predictive")),
+        key=lambda k: rows[k]["max_depth"],
+    )
+    artifact = {
+        "suite": "replay",
+        "elapsed_s": round(elapsed, 2),
+        "fidelity": {
+            "ticks": fidelity.ticks,
+            "divergences": len(fidelity.divergences),
+            "trace_events": len(trace["traceEvents"]),
+            "trace_valid": trace_ok,
+        },
+        "scores": rows,
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    best = rows[best_name]["max_depth"]
+    return {
+        "metric": "replay_counterfactual_max_depth",
+        "value": round(best, 1),
+        "unit": (
+            f"messages ({fidelity.ticks} ticks replayed, 0 divergences,"
+            f" winner={best_name.rsplit(':', 1)[1]})"
+        ),
+        "vs_baseline": round(recorded_score["max_depth"] / max(best, 1e-9), 2),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
-        "--suite", choices=("controller", "forecast"), default="controller",
+        "--suite", choices=("controller", "forecast", "replay"),
+        default="controller",
         help="controller = decision-throughput bench (default); forecast ="
-        " reactive-vs-predictive scenario battery",
+        " reactive-vs-predictive scenario battery; replay = flight-recorder"
+        " record/replay fidelity + counterfactual re-scoring",
     )
     cli.add_argument(
-        "--output", default="BENCH_r06.json",
-        help="artifact path for --suite forecast",
+        "--output", default="",
+        help="artifact path for --suite forecast/replay (defaults:"
+        " BENCH_r06.json / BENCH_r07.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
-        print(json.dumps(run_forecast_suite(cli_args.output)))
+        print(json.dumps(run_forecast_suite(cli_args.output or "BENCH_r06.json")))
+    elif cli_args.suite == "replay":
+        print(json.dumps(run_replay_suite(cli_args.output or "BENCH_r07.json")))
     else:
         print(json.dumps(run_bench()))

@@ -30,7 +30,7 @@ Layers (reference counterpart in parens, file:line cited per module):
   simulator used by tests and ``bench.py``.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"  # kept in sync with the Makefile's image VERSION
 
 from .core.clock import Clock, FakeClock, SystemClock
 from .core.policy import (

@@ -117,8 +117,35 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--metrics-port", type=int, default=0,
         help=(
-            "Serve /metrics (Prometheus), /healthz and /readyz on this port "
-            "(0 = disabled)"
+            "Serve /metrics (Prometheus), /healthz, /readyz and the "
+            "/debug/ticks + /debug/trace flight-recorder endpoints on this "
+            "port (0 = disabled)"
+        ),
+    )
+    # Flight recorder (obs/journal.py): an append-only JSONL journal of
+    # every tick record, plus an in-memory ring behind the /debug
+    # endpoints.  Both disabled-by-default extensions; a recorded journal
+    # replays through `python -m kube_sqs_autoscaler_tpu.sim.replay`.
+    parser.add_argument(
+        "--journal-path", default="", metavar="PATH",
+        help=(
+            "Append every tick record as one JSON line to this file "
+            "(schema-versioned flight journal; empty = disabled)"
+        ),
+    )
+    parser.add_argument(
+        "--journal-ring", type=int, default=256, metavar="N",
+        help=(
+            "Tick records kept in memory for /debug/ticks and /debug/trace "
+            "when --metrics-port is enabled (0 = disabled)"
+        ),
+    )
+    parser.add_argument(
+        "--journal-max-bytes", type=int, default=64 * 1024 * 1024,
+        metavar="BYTES",
+        help=(
+            "Rotate the journal file (to <path>.1) when it would exceed "
+            "this size"
         ),
     )
     # Extensions over the reference: the predictive scaling policy
@@ -203,13 +230,34 @@ def main(argv: Sequence[str] | None = None) -> None:
 
     server = None
     observers = []
+    journal = None
     if args.metrics_port:
-        from .obs import ControllerMetrics, ObservabilityServer
+        from . import __version__
+        from .obs import ControllerMetrics, ObservabilityServer, TickRing
 
-        metrics = ControllerMetrics()
+        metrics = ControllerMetrics(
+            version=__version__,
+            policy=args.policy,
+            forecaster=(
+                args.forecaster if args.policy == "predictive" else ""
+            ),
+        )
         observers.append(metrics)
-        server = ObservabilityServer(metrics, port=args.metrics_port)
+        ring = None
+        if args.journal_ring > 0:
+            ring = TickRing(args.journal_ring)
+            observers.append(ring)
+        server = ObservabilityServer(metrics, port=args.metrics_port, ring=ring)
         server.start()
+    if args.journal_path:
+        from .obs import TickJournal
+
+        journal = TickJournal(
+            args.journal_path,
+            meta=_journal_meta(args),
+            max_bytes=args.journal_max_bytes,
+        )
+        observers.append(journal)
 
     # Predictive policy: deferred import like the real-client stacks — the
     # reactive control plane never pays the JAX import.
@@ -230,9 +278,9 @@ def main(argv: Sequence[str] | None = None) -> None:
     elif len(observers) == 1:
         observer = observers[0]
     else:
-        from .core.events import CompositeTickObserver
+        from .core.events import MultiObserver
 
-        observer = CompositeTickObserver(observers)
+        observer = MultiObserver(observers)
 
     loop = ControlLoop(
         autoscaler,
@@ -259,7 +307,51 @@ def main(argv: Sequence[str] | None = None) -> None:
     finally:
         if server is not None:
             server.stop()
+        if journal is not None:
+            journal.close()
     log.info("kube-sqs-autoscaler stopped")
+
+
+def _journal_meta(args: argparse.Namespace) -> dict:
+    """The flight journal's header meta for a live run: the controller
+    config :mod:`.sim.replay` re-drives decisions from, plus the scaler
+    world bounds the counterfactual re-scorer needs (a live journal has no
+    known service rate, so counterfactuals additionally require one —
+    sim-recorded journals carry it; see ``sim.replay.sim_journal_meta``)."""
+    return {
+        "source": "live",
+        "poll_interval": args.poll_period,
+        "policy_config": {
+            "scale_up_messages": args.scale_up_messages,
+            "scale_down_messages": args.scale_down_messages,
+            "scale_up_cooldown": args.scale_up_cool_down,
+            "scale_down_cooldown": args.scale_down_cool_down,
+        },
+        "policy": args.policy,
+        # no initial_replicas: the controller does not know the
+        # deployment's size without an extra RPC, and a fabricated value
+        # would make replayed replica trajectories look authoritative —
+        # its absence makes replay flag the trajectory as assumed instead
+        # (ReplayResult.assumed_initial_replicas).
+        "world": {
+            "min_pods": args.min_pods,
+            "max_pods": args.max_pods,
+            "scale_up_pods": args.scale_up_pods,
+            "scale_down_pods": args.scale_down_pods,
+        },
+        "forecast": (
+            {
+                "forecaster": args.forecaster,
+                "horizon": args.forecast_horizon,
+                "history": args.forecast_history,
+            }
+            if args.policy == "predictive"
+            else {}
+        ),
+        "deployment": args.kubernetes_deployment,
+        "namespace": args.kubernetes_namespace,
+        "queue_url": args.sqs_queue_url,
+    }
 
 
 if __name__ == "__main__":  # pragma: no cover

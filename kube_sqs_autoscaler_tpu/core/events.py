@@ -18,8 +18,8 @@ Lives in ``core`` (not ``obs``) so the layering stays one-directional:
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
-from typing import Protocol, runtime_checkable
+from dataclasses import dataclass, fields
+from typing import Any, Protocol, runtime_checkable
 
 from .policy import Gate
 
@@ -46,7 +46,12 @@ class TickRecord:
       forecast scoreboard for this tick (``None`` when reactive or not yet
       warmed up / scored);
     - ``duration`` is measured on the loop's own clock, so it is virtual
-      under a ``FakeClock`` and wall-clock in production.
+      under a ``FakeClock`` and wall-clock in production;
+    - ``observe_s``/``decide_s``/``actuate_s`` split ``duration`` into the
+      tick's three phases (metric fetch / depth policy + gates / scaler
+      RPCs) for the flight recorder's trace export — ``actuate_s`` stays
+      ``None`` when no gate fired, ``decide_s`` when the tick ended at the
+      observation.  All zero under a ``FakeClock``.
     """
 
     start: float
@@ -60,6 +65,9 @@ class TickRecord:
     down: Gate = Gate.SKIPPED
     up_error: str | None = None
     down_error: str | None = None
+    observe_s: float | None = None
+    decide_s: float | None = None
+    actuate_s: float | None = None
 
     def scaled(self, direction: str) -> bool:
         """Did this tick successfully actuate in ``direction`` ("up"/"down")?
@@ -73,6 +81,32 @@ class TickRecord:
         if direction == "down":
             return self.down is Gate.FIRE and self.down_error is None
         raise ValueError(f"direction must be 'up' or 'down', got {direction!r}")
+
+    def to_dict(self) -> dict[str, Any]:
+        """The record as one flat JSON-ready dict (the journal line format).
+
+        ``None`` fields are omitted (journal lines stay lean; the reader
+        restores dataclass defaults); :class:`~.policy.Gate` s serialize as
+        their string values.
+        """
+        out: dict[str, Any] = {}
+        for f in fields(self):
+            value = getattr(self, f.name)
+            if value is None:
+                continue
+            out[f.name] = value.value if isinstance(value, Gate) else value
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "TickRecord":
+        """Inverse of :meth:`to_dict`.  Unknown keys are ignored so a newer
+        journal (same schema version, added fields) still loads."""
+        known = {f.name for f in fields(cls)}
+        kwargs = {k: v for k, v in data.items() if k in known}
+        for gate_field in ("up", "down"):
+            if gate_field in kwargs:
+                kwargs[gate_field] = Gate(kwargs[gate_field])
+        return cls(**kwargs)
 
 
 @runtime_checkable
@@ -102,3 +136,8 @@ class CompositeTickObserver:
                 observer.on_tick(record)
             except Exception:  # same never-dies guarantee as the loop's guard
                 log.exception("Tick observer %r failed", observer)
+
+
+# The fan-out under its observability name: the CLI wires Prometheus +
+# flight-recorder ring + journal through one of these.
+MultiObserver = CompositeTickObserver

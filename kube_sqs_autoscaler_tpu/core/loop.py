@@ -122,20 +122,46 @@ class ControlLoop:
             return self._tick(state, record)
         finally:
             record.duration = self.clock.now() - record.start
+            # The decide span is the remainder once observation and scaler
+            # time are accounted — defined only for ticks that got past the
+            # observation (a metric failure ends the tick inside observe).
+            if record.metric_error is None and record.observe_s is not None:
+                record.decide_s = max(
+                    0.0,
+                    record.duration
+                    - record.observe_s
+                    - (record.actuate_s or 0.0),
+                )
             if self.observer is not None:
                 try:
                     self.observer.on_tick(record)
                 except Exception:  # instrumentation must never kill the loop
                     log.exception("Tick observer failed")
 
+    def _actuate(self, record: TickRecord, action) -> str | None:
+        """One scaler call with its clock time accumulated into the record's
+        actuate span; returns the error string on failure (tick ends)."""
+        started = self.clock.now()
+        try:
+            action()
+        except Exception as err:
+            return str(err)
+        finally:
+            record.actuate_s = (record.actuate_s or 0.0) + (
+                self.clock.now() - started
+            )
+        return None
+
     def _tick(self, state: PolicyState, record: TickRecord) -> PolicyState:
         try:
             num_messages = self.metric_source.num_messages()
         except Exception as err:  # the loop must never die (main.go:43-47)
+            record.observe_s = self.clock.now() - record.start
             log.error("Failed to get SQS messages: %s", err)
             record.metric_error = str(err)
             return state
 
+        record.observe_s = self.clock.now() - record.start
         record.num_messages = num_messages
         log.info("Found %d messages in the queue", num_messages)
 
@@ -186,11 +212,10 @@ class ControlLoop:
             log.info("Waiting for cool down, skipping scale up ")
             return state
         if up is Gate.FIRE:
-            try:
-                self.scaler.scale_up()
-            except Exception as err:
-                log.error("Failed scaling up: %s", err)
-                record.up_error = str(err)
+            error = self._actuate(record, self.scaler.scale_up)
+            if error is not None:
+                log.error("Failed scaling up: %s", error)
+                record.up_error = error
                 return state
             state = mark_scaled_up(state, self.clock.now())
 
@@ -201,11 +226,10 @@ class ControlLoop:
             log.info("Waiting for cool down, skipping scale down")
             return state
         if down is Gate.FIRE:
-            try:
-                self.scaler.scale_down()
-            except Exception as err:
-                log.error("Failed scaling down: %s", err)
-                record.down_error = str(err)
+            error = self._actuate(record, self.scaler.scale_down)
+            if error is not None:
+                log.error("Failed scaling down: %s", error)
+                record.down_error = error
                 return state
             state = mark_scaled_down(state, self.clock.now())
 

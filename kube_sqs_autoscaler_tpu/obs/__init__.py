@@ -1,11 +1,38 @@
-"""Observability: Prometheus metrics + health/readiness endpoints.
+"""Observability: Prometheus metrics, health endpoints, flight recorder.
 
 Extension over the reference, which has *no* metrics endpoint, no
 Prometheus, no health/readiness probes (SURVEY.md §5).  Opt-in via
 ``--metrics-port`` (default 0 = disabled ⇒ reference behavior exactly).
+
+The flight recorder (:mod:`.journal`) adds the *historical* counterpart
+of the live gauges: a bounded in-memory ring and an append-only JSONL
+journal of every tick record, exportable as Chrome trace-event JSON
+(:mod:`.trace`) and replayable through :mod:`..sim.replay`.
 """
 
+from .journal import (
+    JOURNAL_SCHEMA_VERSION,
+    JournalSchemaError,
+    TickJournal,
+    TickRing,
+    read_journal,
+    read_journal_episodes,
+)
 from .prometheus import ControllerMetrics, WorkloadMetrics
 from .server import ObservabilityServer
+from .trace import render_chrome_trace, to_chrome_trace, trace_events
 
-__all__ = ["ControllerMetrics", "ObservabilityServer", "WorkloadMetrics"]
+__all__ = [
+    "ControllerMetrics",
+    "JOURNAL_SCHEMA_VERSION",
+    "JournalSchemaError",
+    "ObservabilityServer",
+    "TickJournal",
+    "TickRing",
+    "WorkloadMetrics",
+    "read_journal",
+    "read_journal_episodes",
+    "render_chrome_trace",
+    "to_chrome_trace",
+    "trace_events",
+]

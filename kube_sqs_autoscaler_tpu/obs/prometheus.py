@@ -16,11 +16,34 @@ the loop thread writes, HTTP handler threads render.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..core.events import TickRecord
 from ..core.policy import Gate
 
 _PREFIX = "kube_sqs_autoscaler"
+
+# Tick latency histogram buckets (seconds).  A tick is two RPC round trips
+# (SQS read + at most two apiserver writes): sub-ms in simulation, tens to
+# hundreds of ms in production, pathological past 1 s — the buckets bracket
+# all three regimes.  Cumulative ``le`` semantics; +Inf is the count.
+TICK_DURATION_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def escape_help(text: str) -> str:
+    """Escape a HELP line per the text exposition format (``\\`` and LF)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(text: str) -> str:
+    """Escape a label value (``\\``, ``"`` and LF) — caller-supplied values
+    (help text, versions, policy names) must not corrupt the exposition."""
+    return (
+        text.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
 
 
 class ControllerMetrics:
@@ -30,7 +53,18 @@ class ControllerMetrics:
     ``ControlLoop(observer=...)``.
     """
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        version: str | None = None,
+        policy: str = "reactive",
+        forecaster: str = "",
+    ) -> None:
+        if version is None:
+            from .. import __version__ as version  # the package's own build id
+        self._build_labels = (
+            ("version", version), ("policy", policy), ("forecaster", forecaster)
+        )
+        self._started_monotonic = time.monotonic()
         self._lock = threading.Lock()
         self._ticks = 0
         self._observations = 0
@@ -43,11 +77,15 @@ class ControllerMetrics:
         self._scale_events = {"up": 0, "down": 0}
         self._scale_failures = {"up": 0, "down": 0}
         self._tick_seconds_sum = 0.0
+        self._tick_bucket_counts = [0] * len(TICK_DURATION_BUCKETS)
 
     def on_tick(self, record: TickRecord) -> None:
         with self._lock:
             self._ticks += 1
             self._tick_seconds_sum += record.duration
+            for i, le in enumerate(TICK_DURATION_BUCKETS):
+                if record.duration <= le:
+                    self._tick_bucket_counts[i] += 1
             if record.metric_error is not None:
                 self._metric_failures += 1
                 return
@@ -141,12 +179,41 @@ class ControllerMetrics:
                 f"# TYPE {_PREFIX}_cooldown_skips_total counter",
             ]
             lines += self._directional(self._cooldown_skips, "cooldown_skips_total")
+            # Real cumulative histogram (was a 2-sample summary); the
+            # _sum/_count names are unchanged so existing dashboards keep
+            # working and rate(_sum)/rate(_count) stays the mean latency.
             lines += [
                 f"# HELP {_PREFIX}_tick_duration_seconds Tick latency"
                 " (observe + decide + actuate).",
-                f"# TYPE {_PREFIX}_tick_duration_seconds summary",
+                f"# TYPE {_PREFIX}_tick_duration_seconds histogram",
+            ]
+            for le, count in zip(
+                TICK_DURATION_BUCKETS, self._tick_bucket_counts
+            ):
+                lines.append(
+                    f'{_PREFIX}_tick_duration_seconds_bucket{{le="{le:g}"}}'
+                    f" {count}"
+                )
+            lines += [
+                f'{_PREFIX}_tick_duration_seconds_bucket{{le="+Inf"}}'
+                f" {self._ticks}",
                 f"{_PREFIX}_tick_duration_seconds_sum {self._tick_seconds_sum}",
                 f"{_PREFIX}_tick_duration_seconds_count {self._ticks}",
+            ]
+            build_labels = ",".join(
+                f'{name}="{escape_label_value(value)}"'
+                for name, value in self._build_labels
+            )
+            lines += [
+                f"# HELP {_PREFIX}_build_info Constant 1; controller"
+                " build/config identity in the labels.",
+                f"# TYPE {_PREFIX}_build_info gauge",
+                f"{_PREFIX}_build_info{{{build_labels}}} 1",
+                f"# HELP {_PREFIX}_process_uptime_seconds Seconds since the"
+                " controller metrics registry was created.",
+                f"# TYPE {_PREFIX}_process_uptime_seconds gauge",
+                f"{_PREFIX}_process_uptime_seconds"
+                f" {round(time.monotonic() - self._started_monotonic, 3)}",
             ]
             return "\n".join(lines) + "\n"
 
@@ -203,7 +270,9 @@ class WorkloadMetrics:
         for name, (value, help_text) in sorted(gauges.items()):
             metric = f"{_WORKLOAD_PREFIX}_{name}"
             if help_text:
-                lines.append(f"# HELP {metric} {help_text}")
+                # caller-supplied text: a raw newline/backslash here would
+                # corrupt the whole exposition for every scraper
+                lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
         for name, timer in sorted(timers.items()):

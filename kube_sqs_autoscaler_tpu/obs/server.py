@@ -10,53 +10,96 @@ thread serving
   observation, 200 after (so a probe gates traffic/alerts on "the
   controller can actually see its queue");
 - ``/metrics``  — the :class:`~.prometheus.ControllerMetrics` registry in
-  Prometheus text format.
+  Prometheus text format;
+- ``/debug/ticks`` — the flight recorder's most recent tick records as
+  JSON (``?n=`` limits to the last N), when a :class:`~.journal.TickRing`
+  is attached;
+- ``/debug/trace`` — the same ring as Chrome/Perfetto trace-event JSON
+  (open in ``chrome://tracing`` or ui.perfetto.dev).
 
 Disabled by default (``--metrics-port 0``), preserving reference behavior.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .journal import JOURNAL_SCHEMA_VERSION, TickRing
 from .prometheus import ControllerMetrics
+from .trace import render_chrome_trace
 
 log = logging.getLogger(__name__)
 
 
 class ObservabilityServer:
-    """Serves one metrics registry; ``port=0`` binds an ephemeral port."""
+    """Serves one metrics registry; ``port=0`` binds an ephemeral port.
+
+    ``ring`` (optional) enables the ``/debug/ticks`` and ``/debug/trace``
+    flight-recorder endpoints; without it they 404 like any unknown path.
+    """
 
     def __init__(
         self,
         metrics: ControllerMetrics,
         host: str = "0.0.0.0",
         port: int = 8080,
+        ring: TickRing | None = None,
     ) -> None:
         self.metrics = metrics
+        self.ring = ring
         registry = metrics  # close over for the handler class
+        tick_ring = ring
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                if self.path == "/metrics":
+                url = urllib.parse.urlsplit(self.path)
+                if url.path == "/metrics":
                     self._reply(
                         200,
                         registry.render(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
-                elif self.path == "/healthz":
+                elif url.path == "/healthz":
                     self._reply(200, "ok\n")
-                elif self.path == "/readyz":
+                elif url.path == "/readyz":
                     if registry.ready:
                         self._reply(200, "ok\n")
                     else:
                         self._reply(
                             503, "waiting for first successful observation\n"
                         )
+                elif url.path == "/debug/ticks" and tick_ring is not None:
+                    self._reply(
+                        200, self._ticks_body(url.query), "application/json"
+                    )
+                elif url.path == "/debug/trace" and tick_ring is not None:
+                    self._reply(
+                        200,
+                        render_chrome_trace(tick_ring.snapshot()),
+                        "application/json",
+                    )
                 else:
                     self._reply(404, "not found\n")
+
+            @staticmethod
+            def _ticks_body(query: str) -> str:
+                params = urllib.parse.parse_qs(query)
+                try:
+                    last = int(params["n"][0])
+                except (KeyError, IndexError, ValueError):
+                    last = 100
+                records = tick_ring.snapshot(last=last)
+                return json.dumps(
+                    {
+                        "schema": JOURNAL_SCHEMA_VERSION,
+                        "ticks": [r.to_dict() for r in records],
+                    },
+                    separators=(",", ":"),
+                )
 
             def _reply(
                 self, status: int, body: str, content_type: str = "text/plain"
@@ -87,8 +130,10 @@ class ObservabilityServer:
             daemon=True,
         )
         self._thread.start()
-        log.info("Observability endpoints on :%d (/metrics /healthz /readyz)",
-                 self.port)
+        endpoints = "/metrics /healthz /readyz" + (
+            " /debug/ticks /debug/trace" if self.ring is not None else ""
+        )
+        log.info("Observability endpoints on :%d (%s)", self.port, endpoints)
 
     def stop(self) -> None:
         self._server.shutdown()

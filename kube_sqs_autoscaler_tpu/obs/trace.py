@@ -1,0 +1,146 @@
+"""Chrome/Perfetto trace-event export of tick records.
+
+Turns a journal (or the live :class:`~.journal.TickRing`) into the JSON
+trace-event format that ``chrome://tracing`` / https://ui.perfetto.dev
+load directly: one complete ("X") span per tick with child spans for the
+tick's three phases (observe → decide → actuate, from the record's span
+fields), plus instant ("i") events at the moments an operator actually
+hunts for in a postmortem — gate fires (with actuation failures marked),
+cooldown skips, and metric failures.
+
+Timestamps are microseconds from the first record's start (the loop's
+own clock — virtual under a ``FakeClock``), so traces from simulation
+and production render identically.  Served live at ``/debug/trace`` by
+:class:`~.server.ObservabilityServer`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Sequence
+
+from ..core.events import TickRecord
+from ..core.policy import Gate
+
+_PID = 1
+_TID = 1
+
+_SPAN_FIELDS = (
+    ("observe", "observe_s"),
+    ("decide", "decide_s"),
+    ("actuate", "actuate_s"),
+)
+
+
+def _us(seconds: float) -> int:
+    return int(round(seconds * 1e6))
+
+
+def trace_events(
+    records: Sequence[TickRecord] | Iterable[TickRecord],
+    time_origin: float | None = None,
+) -> list[dict[str, Any]]:
+    """The records as a flat trace-event list (oldest record first).
+
+    ``time_origin`` defaults to the first record's start, so traces begin
+    at t=0 regardless of the recording clock's epoch.
+    """
+    records = list(records)
+    if not records:
+        return []
+    origin = records[0].start if time_origin is None else time_origin
+    events: list[dict[str, Any]] = []
+    for index, record in enumerate(records):
+        start = record.start - origin
+        end = start + record.duration
+        events.append(
+            {
+                "name": "tick",
+                "cat": "tick",
+                "ph": "X",
+                "ts": _us(start),
+                "dur": _us(record.duration),
+                "pid": _PID,
+                "tid": _TID,
+                "args": {
+                    "tick": index,
+                    "num_messages": record.num_messages,
+                    "decision_messages": record.decision_messages,
+                    "up": record.up.value,
+                    "down": record.down.value,
+                },
+            }
+        )
+        cursor = start
+        for name, field in _SPAN_FIELDS:
+            span = getattr(record, field)
+            if span is None:
+                continue
+            events.append(
+                {
+                    "name": name,
+                    "cat": "phase",
+                    "ph": "X",
+                    "ts": _us(cursor),
+                    "dur": _us(span),
+                    "pid": _PID,
+                    "tid": _TID,
+                }
+            )
+            cursor += span
+        if record.metric_error is not None:
+            events.append(
+                _instant("metric-failure", end, {"error": record.metric_error})
+            )
+        for direction, gate, error in (
+            ("up", record.up, record.up_error),
+            ("down", record.down, record.down_error),
+        ):
+            if gate is Gate.COOLING:
+                events.append(
+                    _instant("cooldown-skip", end, {"direction": direction})
+                )
+            elif gate is Gate.FIRE:
+                args: dict[str, Any] = {
+                    "direction": direction,
+                    "ok": error is None,
+                }
+                if error is not None:
+                    args["error"] = error
+                events.append(_instant(f"scale-{direction}", end, args))
+    return events
+
+
+def _instant(name: str, at: float, args: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "name": name,
+        "cat": "event",
+        "ph": "i",
+        "s": "t",  # thread-scoped instant
+        "ts": _us(at),
+        "pid": _PID,
+        "tid": _TID,
+        "args": args,
+    }
+
+
+def to_chrome_trace(
+    records: Sequence[TickRecord] | Iterable[TickRecord],
+    meta: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """The JSON-object trace format (``{"traceEvents": [...]}``)."""
+    trace: dict[str, Any] = {
+        "traceEvents": trace_events(records),
+        "displayTimeUnit": "ms",
+    }
+    if meta:
+        trace["otherData"] = meta
+    return trace
+
+
+def render_chrome_trace(
+    records: Sequence[TickRecord] | Iterable[TickRecord],
+    meta: dict[str, Any] | None = None,
+) -> str:
+    """``to_chrome_trace`` as a compact JSON string (the HTTP body)."""
+    return json.dumps(to_chrome_trace(records, meta), separators=(",", ":"))

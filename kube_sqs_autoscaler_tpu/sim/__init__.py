@@ -10,8 +10,16 @@ worker replicas at a configured per-replica service rate, scaled by the
 fakes on a ``FakeClock``.  Used by tests (dynamics assertions),
 ``bench.py`` (throughput measurement), and the reactive-vs-predictive
 scenario battery in :mod:`.evaluate` (``bench.py --suite forecast``).
+:mod:`.replay` closes the observability loop the other way: it re-drives
+the production loop from a recorded flight journal (``obs/journal.py``)
+and counterfactually re-scores the episode under any other policy
+(``bench.py --suite replay``).
 """
 
+# NOTE: .replay is intentionally NOT imported here — it is runnable as
+# `python -m kube_sqs_autoscaler_tpu.sim.replay` (the make replay-demo
+# entry), and importing it from the package __init__ would shadow that
+# execution with a second module copy (runpy's sys.modules warning).
 from .scenarios import (
     ArrivalProcess,
     BurstArrival,

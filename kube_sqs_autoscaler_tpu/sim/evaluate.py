@@ -133,9 +133,19 @@ def run_episode(
         )
     )
     result = sim.run()
+    return score_result(result, scenario.slo_depth)
+
+
+def score_result(result, slo_depth: float) -> dict:
+    """One :class:`~.simulator.SimResult` as the battery's scorecard row.
+
+    Shared by the live scenario battery and the journal counterfactual
+    re-scoring (:mod:`.replay`), so recorded episodes and synthetic
+    scenarios are judged on identical numbers.
+    """
     return {
         "max_depth": round(result.max_depth, 1),
-        "time_over_slo_s": round(result.time_over(scenario.slo_depth), 1),
+        "time_over_slo_s": round(result.time_over(slo_depth), 1),
         "replica_changes": result.replica_changes,
         "final_replicas": result.final_replicas,
         "final_depth": round(result.final_depth, 1),

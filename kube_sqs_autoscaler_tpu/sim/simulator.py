@@ -17,8 +17,10 @@ under test.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Sequence
 
 from ..core.clock import FakeClock
+from ..core.events import MultiObserver, TickObserver
 from ..core.loop import ControlLoop, LoopConfig
 from ..metrics.fake import FakeQueueService
 from ..metrics.queue import QueueMetricSource
@@ -102,9 +104,19 @@ class _WorldQueue(FakeQueueService):
 
 
 class Simulation:
-    """One closed-loop episode."""
+    """One closed-loop episode.
 
-    def __init__(self, config: SimConfig | None = None):
+    ``extra_observers`` (e.g. a flight-recorder :class:`~..obs.journal.
+    TickJournal`/``TickRing``) are fanned out on the loop's observer slot
+    alongside any forecast history the policy needs — recording a
+    simulated episode uses exactly the production observer seam.
+    """
+
+    def __init__(
+        self,
+        config: SimConfig | None = None,
+        extra_observers: Sequence[TickObserver] = (),
+    ):
         self.config = config or SimConfig()
         self.clock = FakeClock()
         self.depth = float(self.config.initial_depth)
@@ -128,7 +140,7 @@ class Simulation:
             attribute_names=("ApproximateNumberOfMessages",),
         )
         depth_policy = None
-        observer = None
+        observers: list[TickObserver] = list(extra_observers)
         if self.config.policy == "predictive":
             # Lazy import: the reactive path (and bench.py's default suite)
             # stays JAX-free; only a predictive episode pays the import.
@@ -142,12 +154,18 @@ class Simulation:
                 min_samples=self.config.forecast_min_samples,
                 conservative=self.config.forecast_conservative,
             )
-            observer = history
+            observers.insert(0, history)
         elif self.config.policy != "reactive":
             raise ValueError(
                 f"policy must be 'reactive' or 'predictive', got"
                 f" {self.config.policy!r}"
             )
+        if not observers:
+            observer: TickObserver | None = None
+        elif len(observers) == 1:
+            observer = observers[0]
+        else:
+            observer = MultiObserver(observers)
         self.depth_policy = depth_policy
         self.loop = ControlLoop(
             self.scaler,

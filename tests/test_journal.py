@@ -1,0 +1,413 @@
+"""Flight recorder: tick ring, JSONL journal, schema versioning.
+
+The journal is the controller's black box: every tick record appended as
+one JSON line under a schema-versioned header, crash-safe line-at-a-time,
+rotated by size.  These tests pin the wire format — `sim/replay.py`
+re-drives episodes from these files, so a silent format drift would
+corrupt postmortems rather than crash them.
+"""
+
+import json
+import os
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.events import (
+    MultiObserver,
+    TickRecord,
+)
+from kube_sqs_autoscaler_tpu.core.policy import Gate
+from kube_sqs_autoscaler_tpu.obs.journal import (
+    JOURNAL_SCHEMA_VERSION,
+    JournalSchemaError,
+    TickJournal,
+    TickRing,
+    read_journal,
+)
+
+
+def make_record(i: int = 0, **overrides) -> TickRecord:
+    defaults = dict(
+        start=5.0 * (i + 1),
+        duration=0.01,
+        num_messages=100 + i,
+        decision_messages=100 + i,
+        up=Gate.FIRE,
+        down=Gate.IDLE,
+        observe_s=0.004,
+        decide_s=0.001,
+        actuate_s=0.005,
+    )
+    defaults.update(overrides)
+    return TickRecord(**defaults)
+
+
+# --- record serialization ---------------------------------------------------
+
+
+def test_record_roundtrips_through_dict():
+    record = make_record(3, up_error="Failed to scale up", forecast_error=2.5)
+    assert TickRecord.from_dict(record.to_dict()) == record
+
+
+def test_record_dict_omits_none_and_serializes_gates_as_strings():
+    record = TickRecord(start=1.0, metric_error="boom")
+    data = record.to_dict()
+    assert data["up"] == "skipped" and data["down"] == "skipped"
+    assert "num_messages" not in data and "decision_messages" not in data
+    json.dumps(data)  # every value JSON-serializable
+
+
+def test_record_from_dict_ignores_unknown_keys():
+    data = make_record().to_dict()
+    data["added_in_some_future_minor_version"] = {"x": 1}
+    assert TickRecord.from_dict(data) == make_record()
+
+
+# --- ring -------------------------------------------------------------------
+
+
+def test_ring_keeps_only_the_newest_capacity_records():
+    ring = TickRing(capacity=3)
+    for i in range(5):
+        ring.on_tick(make_record(i))
+    assert len(ring) == 3
+    assert [r.start for r in ring.snapshot()] == [15.0, 20.0, 25.0]
+    assert [r.start for r in ring.snapshot(last=2)] == [20.0, 25.0]
+
+
+def test_ring_rejects_zero_capacity():
+    with pytest.raises(ValueError):
+        TickRing(capacity=0)
+
+
+# --- journal writer/reader --------------------------------------------------
+
+
+def test_journal_roundtrip_records_and_meta(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    meta = {"poll_interval": 5.0, "policy": "reactive"}
+    with TickJournal(path, meta=meta) as journal:
+        for i in range(4):
+            journal.on_tick(make_record(i))
+    read_meta, records = read_journal(path)
+    assert read_meta == meta
+    assert records == [make_record(i) for i in range(4)]
+
+
+def test_journal_lines_are_flushed_per_tick(tmp_path):
+    """Crash-safety: every completed tick is on disk before the next —
+    reading mid-run (no close) sees all records written so far."""
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={})
+    journal.on_tick(make_record(0))
+    journal.on_tick(make_record(1))
+    _, records = read_journal(path)  # journal still open
+    assert len(records) == 2
+    journal.close()
+
+
+def test_journal_header_carries_schema_version(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    TickJournal(path, meta={"a": 1}).close()
+    header = json.loads(open(path).read().splitlines()[0])
+    assert header["kind"] == "header"
+    assert header["schema"] == JOURNAL_SCHEMA_VERSION
+
+
+def test_schema_version_is_pinned():
+    """Tier-1 guard: bumping the schema must be a deliberate act that also
+    updates the reader/replayer (see obs/journal.py docstring)."""
+    assert JOURNAL_SCHEMA_VERSION == 1
+
+
+def test_reader_rejects_wrong_schema_version(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "header", "schema": 999, "meta": {}}))
+        fh.write("\n")
+    with pytest.raises(JournalSchemaError):
+        read_journal(path)
+
+
+def test_reader_rejects_headerless_file(tmp_path):
+    path = str(tmp_path / "not-a-journal.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(make_record().to_dict()) + "\n")
+    with pytest.raises(JournalSchemaError):
+        read_journal(path)
+
+
+def test_reader_tolerates_torn_final_line(tmp_path):
+    """A crash mid-write leaves a partial last line; the journal contract
+    is 'lose at most the tick in flight', not 'refuse the whole file'."""
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={}) as journal:
+        journal.on_tick(make_record(0))
+        journal.on_tick(make_record(1))
+    with open(path, "a") as fh:
+        fh.write('{"kind":"tick","start":15.0,"num_mes')  # torn write
+    _, records = read_journal(path)
+    assert records == [make_record(0), make_record(1)]
+
+
+def test_reader_rejects_corruption_before_the_end(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={}) as journal:
+        journal.on_tick(make_record(0))
+    with open(path, "a") as fh:
+        fh.write("garbage-not-json\n")
+        fh.write(json.dumps({"kind": "tick", **make_record(1).to_dict()}) + "\n")
+    with pytest.raises(JournalSchemaError):
+        read_journal(path)
+
+
+def test_journal_restart_appends_new_header_first_meta_wins(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={"run": 1}) as journal:
+        journal.on_tick(make_record(0))
+    with TickJournal(path, meta={"run": 2}) as journal:
+        journal.on_tick(make_record(1))
+    meta, records = read_journal(path)
+    assert meta == {"run": 1}
+    assert len(records) == 2
+
+
+def test_journal_rotates_by_size(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={"big": "x" * 100}, max_bytes=4096)
+    for i in range(200):  # each line ~150 bytes: several rotations
+        journal.on_tick(make_record(i))
+    journal.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 4096
+    assert os.path.getsize(path + ".1") <= 4096
+    # both generations are valid journals (fresh header after rotation);
+    # the live file's header is marked as a rotation CONTINUATION — its
+    # ticks continue the same episode, they are not a controller restart
+    meta, newest = read_journal(path)
+    assert meta["big"] == "x" * 100
+    assert meta["_continuation"] is True
+    _, previous = read_journal(path + ".1")
+    assert newest and previous
+    # newest file continues exactly where the rotated one left off
+    assert newest[0].start - previous[-1].start == pytest.approx(5.0)
+
+
+def test_journal_observer_survives_close():
+    """A closed journal drops ticks instead of raising — shutdown order
+    (server/journal/loop) must not matter."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        journal = TickJournal(os.path.join(tmp, "j.jsonl"), meta={})
+        journal.close()
+        journal.on_tick(make_record())  # no raise
+
+
+# --- fan-out ----------------------------------------------------------------
+
+
+def test_ring_and_journal_fan_out_from_one_loop(tmp_path):
+    """The production wiring: Prometheus + ring + journal behind one
+    MultiObserver on the loop's single observer slot."""
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.metrics import (
+        FakeQueueService,
+        QueueMetricSource,
+    )
+    from kube_sqs_autoscaler_tpu.obs import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+
+    path = str(tmp_path / "journal.jsonl")
+    metrics = ControllerMetrics()
+    ring = TickRing(capacity=2)
+    journal = TickJournal(path, meta={"poll_interval": 1.0})
+    api = FakeDeploymentAPI.with_deployments("ns", 3, "deploy")
+    loop = ControlLoop(
+        PodAutoScaler(
+            client=api, max=5, min=1, scale_up_pods=1, scale_down_pods=1,
+            deployment="deploy", namespace="ns",
+        ),
+        QueueMetricSource(
+            client=FakeQueueService.with_depths(100, 100, 100),
+            queue_url="example.com",
+        ),
+        LoopConfig(poll_interval=1.0, policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=3,
+            scale_up_cooldown=1.0, scale_down_cooldown=1.0,
+        )),
+        clock=FakeClock(),
+        observer=MultiObserver([metrics, ring, journal]),
+    )
+    loop.run(max_ticks=5)
+    journal.close()
+    assert "kube_sqs_autoscaler_ticks_total 5" in metrics.render()
+    assert len(ring) == 2  # bounded
+    _, records = read_journal(path)
+    assert len(records) == 5  # unbounded (until rotation)
+    assert records[-1] == ring.snapshot()[-1]
+
+
+# --- restart episodes + mid-file schema (review findings) -------------------
+
+
+def test_reader_rejects_wrong_schema_in_a_restart_header(tmp_path):
+    """A restart header from a foreign build must fail loudly — its tick
+    lines must never be silently parsed under this build's schema."""
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={}) as journal:
+        journal.on_tick(make_record(0))
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"kind": "header", "schema": 2, "meta": {}}) + "\n")
+        fh.write(json.dumps({"kind": "tick", "start": 10.0}) + "\n")
+    with pytest.raises(JournalSchemaError):
+        read_journal(path)
+
+
+def test_read_journal_episodes_splits_on_restart_headers(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.journal import read_journal_episodes
+
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={"run": 1}) as journal:
+        journal.on_tick(make_record(0))
+        journal.on_tick(make_record(1))
+    with TickJournal(path, meta={"run": 2}) as journal:
+        journal.on_tick(make_record(0))
+    episodes = read_journal_episodes(path)
+    assert [meta["run"] for meta, _ in episodes] == [1, 2]
+    assert [len(records) for _, records in episodes] == [2, 1]
+
+
+def test_failed_rotation_does_not_kill_the_recorder(tmp_path, monkeypatch):
+    """A transient filesystem error during rotation must degrade to
+    appending in place, not silently drop every subsequent tick."""
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={}, max_bytes=4096)
+    monkeypatch.setattr(
+        os, "replace", lambda *a: (_ for _ in ()).throw(OSError("read-only"))
+    )
+    for i in range(60):  # crosses the rotation threshold several times
+        journal.on_tick(make_record(i))
+    monkeypatch.undo()
+    journal.close()
+    assert not os.path.exists(path + ".1")  # rotation never succeeded
+    _, records = read_journal(path)
+    assert len(records) == 60  # ...but no tick was lost
+
+
+def test_reader_handles_non_dict_json_lines(tmp_path):
+    """Valid-JSON-but-not-an-object corruption raises the typed error
+    mid-file and is tolerated as a torn tail on the final line."""
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={}) as journal:
+        journal.on_tick(make_record(0))
+    with open(path, "a") as fh:
+        fh.write("0\n")  # corrupt but json.loads-able
+        fh.write(json.dumps({"kind": "tick", **make_record(1).to_dict()}) + "\n")
+    with pytest.raises(JournalSchemaError):
+        read_journal(path)
+    # same corruption as the very last line: tolerated like a torn tail
+    path2 = str(tmp_path / "journal2.jsonl")
+    with TickJournal(path2, meta={}) as journal:
+        journal.on_tick(make_record(0))
+    with open(path2, "a") as fh:
+        fh.write("[]\n")
+    _, records = read_journal(path2)
+    assert records == [make_record(0)]
+
+
+def test_failed_header_write_after_rotation_recovers(tmp_path, monkeypatch):
+    """ENOSPC between the rotation rename and the continuation header must
+    not leave the live file headerless (permanently unreadable): tick
+    lines are held back until the header lands."""
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={}, max_bytes=4096)
+    filler = 0
+    while os.path.getsize(path) < 3900:
+        journal.on_tick(make_record(filler))
+        filler += 1
+    original = TickJournal._write_line
+    failures = {"left": 1}
+    def flaky(self, line):
+        if '"kind":"header"' in line and failures["left"]:
+            failures["left"] -= 1
+            raise OSError("ENOSPC")
+        return original(self, line)
+    monkeypatch.setattr(TickJournal, "_write_line", flaky)
+    journal.on_tick(make_record(filler))  # trips rotation; header fails once
+    journal.close()
+    assert os.path.exists(path + ".1")
+    meta, records = read_journal(path)  # live file MUST still be a journal
+    assert meta["_continuation"] is True
+    assert records  # the post-rotation tick landed after the header retry
+
+
+def test_rotation_threshold_counts_bytes_not_characters(tmp_path):
+    """Non-ASCII content (AWS error strings, unicode deployment names) is
+    multi-byte in the UTF-8 file; rotation must trigger on bytes."""
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={}, max_bytes=4096)
+    for i in range(80):
+        journal.on_tick(make_record(i, up_error="münchen-ü" * 10))
+    journal.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 4096
+    assert os.path.getsize(path + ".1") <= 4096
+
+
+def test_failed_reopen_after_rotation_recovers_on_later_ticks(
+    tmp_path, monkeypatch
+):
+    """If even reopening the live file fails mid-rotation, recording must
+    resume once the filesystem recovers — never die permanently."""
+    import builtins
+
+    path = str(tmp_path / "journal.jsonl")
+    journal = TickJournal(path, meta={}, max_bytes=4096)
+    filler = 0
+    while os.path.getsize(path) < 3900:
+        journal.on_tick(make_record(filler))
+        filler += 1
+    original_open = builtins.open
+    failures = {"left": 2}
+    def flaky_open(file, *args, **kwargs):
+        if file == path and failures["left"]:
+            failures["left"] -= 1
+            raise OSError("EACCES")
+        return original_open(file, *args, **kwargs)
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    # rotation: rename ok, open fails, immediate reopen fails too — this
+    # tick is dropped and the journal is left with no live file handle
+    journal.on_tick(make_record(filler))
+    monkeypatch.undo()
+    journal.on_tick(make_record(filler + 1))  # filesystem recovered
+    journal.on_tick(make_record(filler + 2))
+    journal.close()
+    meta, records = read_journal(path)  # live file is a valid journal again
+    assert meta["_continuation"] is True
+    assert len(records) == 2  # only the failure-window tick was dropped
+
+
+def test_restart_onto_crash_torn_journal_keeps_both_episodes(tmp_path):
+    """Crash mid-write, then restart onto the same --journal-path: the new
+    run's header must NOT merge with the torn fragment into one corrupt
+    line that makes the whole file unreadable (the crash-postmortem case
+    the journal exists for)."""
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta={"run": 1}) as journal:
+        journal.on_tick(make_record(0))
+    with open(path, "a") as fh:
+        fh.write('{"kind":"tick","start":10.0,"num_mes')  # crash mid-write
+    with TickJournal(path, meta={"run": 2}) as journal:  # restart
+        journal.on_tick(make_record(5))
+    from kube_sqs_autoscaler_tpu.obs.journal import read_journal_episodes
+
+    episodes = read_journal_episodes(path)
+    assert [meta["run"] for meta, _ in episodes] == [1, 2]
+    assert [len(records) for _, records in episodes] == [1, 1]
+    # only the in-flight tick was lost — the contract held
+    assert episodes[0][1][0] == make_record(0)
+    assert episodes[1][1][0] == make_record(5)

@@ -264,8 +264,9 @@ def test_metrics_render_is_prometheus_parseable():
     metrics = ControllerMetrics()
     metrics.on_tick(TickRecord(start=0.0, duration=0.25, num_messages=7))
     sample = re.compile(
-        r'^kube_sqs_autoscaler_[a-z_]+(\{[a-z_]+="[a-z]+"(,[a-z_]+="[a-z]+")*\})?'
-        r" -?[0-9.]+$"
+        r'^kube_sqs_autoscaler_[a-z_]+(\{[a-zA-Z_]+="[^"]*"'
+        r'(,[a-zA-Z_]+="[^"]*")*\})?'
+        r" -?[0-9.eE+-]+$"
     )
     for line in metrics.render().strip().splitlines():
         if line.startswith("#"):
@@ -373,3 +374,265 @@ def test_trainer_metrics_port_exposes_training_gauges(tmp_path):
     assert "body" in seen, "never scraped a train_loss gauge mid-run"
     assert "kube_sqs_autoscaler_workload_train_loss" in seen["body"]
     assert "kube_sqs_autoscaler_workload_train_step" in seen["body"]
+
+
+# --- tick-duration histogram (ISSUE 2 satellite) ----------------------------
+
+
+def test_tick_duration_is_a_cumulative_histogram():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import TICK_DURATION_BUCKETS
+
+    metrics = ControllerMetrics()
+    for duration in (0.0005, 0.03, 0.03, 0.7, 20.0):
+        metrics.on_tick(TickRecord(start=0.0, duration=duration, num_messages=1))
+    text = metrics.render()
+    assert "# TYPE kube_sqs_autoscaler_tick_duration_seconds histogram" in text
+    # cumulative: every bucket counts all observations <= its bound
+    assert 'tick_duration_seconds_bucket{le="0.001"} 1' in text
+    assert 'tick_duration_seconds_bucket{le="0.05"} 3' in text
+    assert 'tick_duration_seconds_bucket{le="1"} 4' in text
+    assert 'tick_duration_seconds_bucket{le="10"} 4' in text  # 20 s overflows
+    assert 'tick_duration_seconds_bucket{le="+Inf"} 5' in text
+    # _sum/_count names unchanged from the old summary (dashboards survive)
+    assert "kube_sqs_autoscaler_tick_duration_seconds_count 5" in text
+    assert "kube_sqs_autoscaler_tick_duration_seconds_sum" in text
+    # monotone non-decreasing across the rendered bucket sequence
+    counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("kube_sqs_autoscaler_tick_duration_seconds_bucket")
+    ]
+    assert len(counts) == len(TICK_DURATION_BUCKETS) + 1
+    assert counts == sorted(counts)
+
+
+# --- build_info + uptime (ISSUE 2 satellite) --------------------------------
+
+
+def test_build_info_gauge_carries_version_policy_forecaster():
+    metrics = ControllerMetrics(
+        version="1.2.3", policy="predictive", forecaster="holt"
+    )
+    text = metrics.render()
+    assert (
+        'kube_sqs_autoscaler_build_info{version="1.2.3",'
+        'policy="predictive",forecaster="holt"} 1' in text
+    )
+
+
+def test_build_info_defaults_to_package_version_and_reactive():
+    from kube_sqs_autoscaler_tpu import __version__
+
+    text = ControllerMetrics().render()
+    assert (
+        f'kube_sqs_autoscaler_build_info{{version="{__version__}",'
+        'policy="reactive",forecaster=""} 1' in text
+    )
+
+
+def test_process_uptime_gauge_advances():
+    import time as _time
+
+    metrics = ControllerMetrics()
+    first = float(
+        next(
+            line for line in metrics.render().splitlines()
+            if line.startswith("kube_sqs_autoscaler_process_uptime_seconds")
+        ).rsplit(" ", 1)[1]
+    )
+    assert first >= 0.0
+    _time.sleep(0.02)
+    second = float(
+        next(
+            line for line in metrics.render().splitlines()
+            if line.startswith("kube_sqs_autoscaler_process_uptime_seconds")
+        ).rsplit(" ", 1)[1]
+    )
+    assert second > first
+
+
+# --- exposition escaping (ISSUE 2 satellite) --------------------------------
+
+
+def test_workload_help_text_newlines_and_backslashes_are_escaped():
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+
+    metrics = WorkloadMetrics()
+    metrics.set_gauge("g", 1.0, "line one\nline two \\ backslash")
+    text = metrics.render()
+    assert (
+        "# HELP kube_sqs_autoscaler_workload_g"
+        " line one\\nline two \\\\ backslash" in text
+    )
+    # the exposition stays line-oriented: every line still starts with a
+    # comment marker or a metric name
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or line.startswith("kube_sqs_")
+
+
+def test_build_info_label_values_are_escaped():
+    metrics = ControllerMetrics(
+        version='1.0"evil\nname\\', policy="reactive", forecaster=""
+    )
+    text = metrics.render()
+    assert '\\"evil\\nname\\\\' in text
+    assert "\nname" not in text.replace("\\nname", "")  # no raw newline leaked
+
+
+def test_escape_helpers_are_prometheus_spec_order():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import (
+        escape_help,
+        escape_label_value,
+    )
+
+    assert escape_help("a\\b\nc") == "a\\\\b\\nc"
+    assert escape_label_value('a"b\nc\\d') == 'a\\"b\\nc\\\\d'
+
+
+# --- observer fan-out isolation (ISSUE 2 satellite) -------------------------
+
+
+def test_multi_observer_exception_does_not_starve_later_observers():
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+
+    class Exploding:
+        calls = 0
+
+        def on_tick(self, record):
+            type(self).calls += 1
+            raise RuntimeError("observer bug")
+
+    first_bad = Exploding()
+    after = RecordingObserver()
+    loop, api, _ = make_system(MultiObserver([first_bad, after]))
+    loop.run(max_ticks=3)
+    # the raising observer ran every tick, the one after it saw every tick,
+    # and the loop itself kept scaling
+    assert Exploding.calls == 3
+    assert len(after.records) == 3
+    assert api.replicas("deploy") == 5
+
+
+def test_multi_observer_all_members_see_identical_record():
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+
+    a, b = RecordingObserver(), RecordingObserver()
+    loop, _, _ = make_system(MultiObserver([a, b]))
+    loop.run(max_ticks=2)
+    assert a.records == b.records
+    assert a.records[0] is b.records[0]  # same record object, no copies
+
+
+# --- concurrent scrape-while-writing (ISSUE 2 satellite) --------------------
+
+
+def test_concurrent_scrapes_while_loop_writes():
+    """HTTP scrapes racing the loop thread's registry writes must always
+    see a complete, parseable exposition (the registry lock's contract)."""
+    import threading
+
+    metrics = ControllerMetrics()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    failures: list = []
+
+    def hammer():
+        try:
+            for _ in range(50):
+                status, body = _get(server.port, "/metrics")
+                assert status == 200
+                # ticks_total must always be present and integral
+                line = next(
+                    ln for ln in body.splitlines()
+                    if ln.startswith("kube_sqs_autoscaler_ticks_total")
+                )
+                int(line.rsplit(" ", 1)[1])
+        except Exception as err:  # pragma: no cover - failure path
+            failures.append(err)
+
+    scrapers = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in scrapers:
+            t.start()
+        loop, _, _ = make_system(metrics)
+        for _ in range(10):
+            loop.run(max_ticks=20)
+            loop.reset()
+    finally:
+        for t in scrapers:
+            t.join(timeout=30)
+        server.stop()
+    assert not failures
+    assert "kube_sqs_autoscaler_ticks_total 200" in metrics.render()
+
+
+# --- /debug flight-recorder endpoints (ISSUE 2 tentpole) --------------------
+
+
+def test_debug_endpoints_404_without_a_ring():
+    metrics = ControllerMetrics()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert _get(server.port, "/debug/ticks")[0] == 404
+        assert _get(server.port, "/debug/trace")[0] == 404
+    finally:
+        server.stop()
+
+
+def test_debug_ticks_serves_last_n_records_as_json():
+    import json
+
+    from kube_sqs_autoscaler_tpu.obs import JOURNAL_SCHEMA_VERSION, TickRing
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+
+    metrics = ControllerMetrics()
+    ring = TickRing(capacity=64)
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0, ring=ring)
+    server.start()
+    try:
+        loop, _, _ = make_system(MultiObserver([metrics, ring]))
+        loop.run(max_ticks=7)
+        status, body = _get(server.port, "/debug/ticks")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["schema"] == JOURNAL_SCHEMA_VERSION
+        assert len(payload["ticks"]) == 7
+        assert payload["ticks"][-1]["num_messages"] == 300
+        status, body = _get(server.port, "/debug/ticks?n=3")
+        assert len(json.loads(body)["ticks"]) == 3
+        # bad n falls back to the default instead of erroring
+        status, _ = _get(server.port, "/debug/ticks?n=bogus")
+        assert status == 200
+    finally:
+        server.stop()
+
+
+def test_debug_trace_serves_valid_chrome_trace_json():
+    import json
+
+    from kube_sqs_autoscaler_tpu.obs import TickRing
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+
+    metrics = ControllerMetrics()
+    ring = TickRing()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0, ring=ring)
+    server.start()
+    try:
+        loop, _, _ = make_system(MultiObserver([metrics, ring]))
+        loop.run(max_ticks=4)
+        status, body = _get(server.port, "/debug/trace")
+        assert status == 200
+        trace = json.loads(body)  # the ISSUE's validity bar
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "tick" in names and "scale-up" in names
+        assert len([e for e in trace["traceEvents"] if e["name"] == "tick"]) == 4
+    finally:
+        server.stop()
+
+
+def test_journal_flag_defaults():
+    args = build_parser().parse_args([])
+    assert args.journal_path == ""
+    assert args.journal_ring == 256
+    assert args.journal_max_bytes == 64 * 1024 * 1024

@@ -1,0 +1,473 @@
+"""Journal replay: deterministic re-drive + counterfactual re-scoring.
+
+The acceptance bar (ISSUE 2): a journal recorded from a simulated episode,
+replayed through ``sim/replay.py``, reproduces the recorded gate decisions
+and replica trajectory tick-for-tick; the same journal re-scores under any
+other policy/forecaster through the battery's scoring.
+"""
+
+import dataclasses
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.policy import Gate
+from kube_sqs_autoscaler_tpu.obs.journal import read_journal
+from kube_sqs_autoscaler_tpu.sim import BurstArrival, SimConfig, StepArrival
+from kube_sqs_autoscaler_tpu.sim.replay import (
+    RecordedArrival,
+    counterfactual,
+    infer_arrivals,
+    record_episode,
+    replay,
+    replay_journal,
+    sim_journal_meta,
+)
+
+
+def demo_config(**overrides) -> SimConfig:
+    defaults = dict(
+        arrival_rate=BurstArrival(
+            base=20.0, burst_rate=140.0, period=120.0,
+            burst_len=40.0, first_burst=30.0,
+        ),
+        service_rate_per_replica=10.0,
+        duration=200.0,
+        initial_replicas=2,
+        max_pods=10,
+    )
+    defaults.update(overrides)
+    return SimConfig(**defaults)
+
+
+def record(tmp_path, **overrides):
+    path = str(tmp_path / "journal.jsonl")
+    meta, result = record_episode(demo_config(**overrides), path)
+    return path, meta, result
+
+
+# --- deterministic re-drive -------------------------------------------------
+
+
+def test_replay_reproduces_recorded_decisions_tick_for_tick(tmp_path):
+    path, _, _ = record(tmp_path)
+    meta, records = read_journal(path)
+    result = replay(records, meta)
+    assert result.ticks == len(records) == 40  # 200 s / 5 s poll
+    assert result.divergences == []
+    assert result.ok
+    # the episode actually exercised the interesting paths
+    assert any(r.up is Gate.FIRE for r in records)
+    assert any(r.up is Gate.COOLING for r in records)
+
+
+def test_replay_reproduces_replica_trajectory(tmp_path):
+    path, _, sim_result = record(tmp_path)
+    result = replay_journal(path)
+    # sim timeline entry k = replicas entering tick k (observed mid-read);
+    # the replayed trajectory must match at every tick
+    recorded_replicas = [r for (_, _, r) in sim_result.timeline]
+    assert result.start_replicas == recorded_replicas[: result.ticks]
+    assert result.final_replicas == sim_result.final_replicas
+
+
+def test_replay_detects_a_tampered_decision(tmp_path):
+    path, _, _ = record(tmp_path)
+    meta, records = read_journal(path)
+    fired = next(i for i, r in enumerate(records) if r.up is Gate.FIRE)
+    records[fired] = dataclasses.replace(records[fired], up=Gate.IDLE)
+    result = replay(records, meta)
+    assert not result.ok
+    assert any(
+        d.tick == fired and d.tick_field == "up" for d in result.divergences
+    )
+
+
+def test_replay_reproduces_recorded_actuation_failures():
+    """A recorded scale failure must replay as a failure (policy state not
+    advanced), not as a success that shifts every later cooldown."""
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+
+    meta = {
+        "t0": 0.0,
+        "poll_interval": 5.0,
+        "policy_config": {
+            "scale_up_messages": 100, "scale_down_messages": 10,
+            "scale_up_cooldown": 10.0, "scale_down_cooldown": 30.0,
+        },
+        "policy": "reactive",
+        "world": {"initial_replicas": 1, "min_pods": 1, "max_pods": 5,
+                  "scale_up_pods": 1, "scale_down_pods": 1},
+    }
+    records = [
+        TickRecord(start=5.0, num_messages=200, decision_messages=200,
+                   up=Gate.COOLING),  # startup grace
+        TickRecord(start=10.0, num_messages=200, decision_messages=200,
+                   up=Gate.FIRE, up_error="Failed to scale up"),
+        # failure did NOT advance the cooldown: the next tick fires again
+        TickRecord(start=15.0, num_messages=200, decision_messages=200,
+                   up=Gate.FIRE, down=Gate.IDLE),
+        TickRecord(start=20.0, num_messages=200, decision_messages=200,
+                   up=Gate.COOLING),
+    ]
+    result = replay(records, meta)
+    assert result.divergences == []
+    assert result.final_replicas == 2  # only the successful fire actuated
+
+
+def test_replay_reproduces_metric_failure_ticks():
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+
+    meta = {
+        "t0": 0.0, "poll_interval": 5.0, "policy": "reactive",
+        "policy_config": {
+            "scale_up_messages": 100, "scale_down_messages": 10,
+            "scale_up_cooldown": 10.0, "scale_down_cooldown": 30.0,
+        },
+        "world": {"initial_replicas": 1, "min_pods": 1, "max_pods": 5,
+                  "scale_up_pods": 1, "scale_down_pods": 1},
+    }
+    records = [
+        TickRecord(start=5.0, metric_error="Failed to get messages in SQS"),
+        TickRecord(start=10.0, num_messages=50, decision_messages=50,
+                   up=Gate.IDLE, down=Gate.IDLE),
+    ]
+    result = replay(records, meta)
+    assert result.divergences == []
+
+
+def test_replay_of_predictive_episode(tmp_path):
+    """Predictive journals replay through the rebuilt forecaster+history —
+    the jit forecast pipeline is deterministic, so decisions reproduce."""
+    path, _, _ = record(
+        tmp_path, policy="predictive", forecaster="holt",
+        forecast_horizon=30.0, duration=150.0,
+    )
+    meta, records = read_journal(path)
+    assert meta["policy"] == "predictive"
+    assert meta["forecast"]["forecaster"] == "holt"
+    result = replay(records, meta)
+    assert result.divergences == []
+    # the forecast actually moved at least one decision off the observation
+    assert any(
+        r.decision_messages != r.num_messages
+        for r in records
+        if r.num_messages is not None
+    )
+
+
+def test_replay_empty_journal_raises(tmp_path):
+    with pytest.raises(ValueError):
+        replay([], {"poll_interval": 5.0})
+
+
+# --- arrival inference ------------------------------------------------------
+
+
+def test_recorded_arrival_integrates_piecewise():
+    arrival = RecordedArrival(times=(0.0, 10.0, 20.0), rates=(1.0, 3.0, 0.5))
+    assert arrival.rate_at(5.0) == 1.0
+    assert arrival.rate_at(10.0) == 3.0
+    assert arrival.rate_at(100.0) == 0.5
+    assert arrival.arrivals_between(0.0, 30.0) == pytest.approx(
+        1.0 * 10 + 3.0 * 10 + 0.5 * 10
+    )
+    assert arrival.arrivals_between(5.0, 15.0) == pytest.approx(
+        1.0 * 5 + 3.0 * 5
+    )
+    # before the first boundary the first rate extends backwards
+    assert arrival.arrivals_between(-10.0, 5.0) == pytest.approx(15.0)
+
+
+def test_inferred_arrivals_reproduce_recorded_world(tmp_path):
+    """The fidelity identity behind counterfactuals: re-simulating the
+    inferred arrivals under the SAME policy reproduces the recorded
+    episode's scorecard (depth floored per-interval, int observations)."""
+    from kube_sqs_autoscaler_tpu.sim.evaluate import score_result
+
+    path, _, sim_result = record(tmp_path)
+    meta, records = read_journal(path)
+    rescored = counterfactual(records, meta, policy="reactive")
+    recorded = score_result(sim_result, 300.0)
+    assert rescored["replica_changes"] == recorded["replica_changes"]
+    assert rescored["final_replicas"] == recorded["final_replicas"]
+    assert rescored["max_depth"] == pytest.approx(
+        recorded["max_depth"], rel=0.02
+    )
+    assert rescored["time_over_slo_s"] == pytest.approx(
+        recorded["time_over_slo_s"], abs=10.0
+    )
+
+
+def test_infer_arrivals_requires_world_meta(tmp_path):
+    path, _, _ = record(tmp_path)
+    meta, records = read_journal(path)
+    del meta["world"]["service_rate_per_replica"]
+    with pytest.raises(ValueError, match="service_rate_per_replica"):
+        infer_arrivals(records, meta)
+
+
+# --- counterfactual re-scoring ----------------------------------------------
+
+
+def test_counterfactual_scores_other_policies_on_the_recorded_world(tmp_path):
+    path, _, _ = record(tmp_path)
+    meta, records = read_journal(path)
+    row = counterfactual(
+        records, meta, policy="predictive", forecaster="ewma", horizon=30.0
+    )
+    assert row["policy"] == "predictive:ewma"
+    assert row["ticks"] == len(records)
+    for key in ("max_depth", "time_over_slo_s", "replica_changes"):
+        assert key in row
+
+
+def test_sim_journal_meta_round_trips_loop_config():
+    from kube_sqs_autoscaler_tpu.sim.replay import loop_config_from_meta
+
+    config = demo_config()
+    meta = sim_journal_meta(config)
+    rebuilt = loop_config_from_meta(meta)
+    assert rebuilt == config.loop
+
+
+# --- the make replay-demo entry ---------------------------------------------
+
+
+def test_replay_main_records_and_verifies(tmp_path, capsys):
+    import json
+
+    from kube_sqs_autoscaler_tpu.sim.replay import main
+
+    journal = str(tmp_path / "demo.jsonl")
+    assert main(["--record-to", journal]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["ok"] is True and verdict["divergences"] == 0
+    # the journal it wrote replays standalone too
+    assert main(["--journal", journal]) == 0
+
+
+def test_replay_main_fails_on_divergence(tmp_path, capsys):
+    """The make replay-demo contract: decision drift exits non-zero."""
+    import json
+
+    from kube_sqs_autoscaler_tpu.sim.replay import main
+
+    path = str(tmp_path / "journal.jsonl")
+    record_episode(demo_config(), path)
+    meta, records = read_journal(path)
+    # tamper: claim a fired gate never fired, rewrite the journal
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+
+    fired = next(i for i, r in enumerate(records) if r.up is Gate.FIRE)
+    records[fired] = dataclasses.replace(records[fired], up=Gate.IDLE)
+    tampered = str(tmp_path / "tampered.jsonl")
+    with TickJournal(tampered, meta=meta) as journal:
+        for r in records:
+            journal.on_tick(r)
+    assert main(["--journal", tampered]) == 2
+    out = capsys.readouterr()
+    assert json.loads(out.out)["ok"] is False
+
+
+# --- review-finding regressions ---------------------------------------------
+
+
+def test_replay_journal_replays_last_episode_of_restarted_file(tmp_path):
+    """A restarted controller appends a second episode with its own clock
+    epoch and startup grace; replaying the flattened file as one run would
+    report spurious divergences — replay_journal must pick one episode."""
+    path = str(tmp_path / "journal.jsonl")
+    record_episode(demo_config(duration=100.0), path)
+    meta2, result2 = record_episode(demo_config(duration=150.0), path)
+    result = replay_journal(path)
+    assert result.ok
+    assert result.ticks == 30  # the LAST episode only (150 s / 5 s)
+    assert result.final_replicas == result2.final_replicas
+
+
+def test_counterfactual_handles_wall_clock_epochs():
+    """Live journals carry time.monotonic() epochs and no t0; the inferred
+    arrivals must land in the rebuilt sim's 0-based window, not 800k
+    seconds away from it (review finding: silent garbage world)."""
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+
+    epoch = 812345.678  # a plausible monotonic reading
+    meta = {
+        "source": "live",
+        "poll_interval": 5.0,
+        "policy_config": {
+            "scale_up_messages": 100, "scale_down_messages": 10,
+            "scale_up_cooldown": 10.0, "scale_down_cooldown": 30.0,
+        },
+        "policy": "reactive",
+        "world": {
+            "service_rate_per_replica": 10.0, "initial_depth": 100.0,
+            "initial_replicas": 1, "min_pods": 1, "max_pods": 5,
+            "scale_up_pods": 1, "scale_down_pods": 1,
+        },
+    }
+    # steady observed depth 100 with 1 replica at 10 msg/s ⇒ the implied
+    # arrival rate is exactly 10 msg/s on every interval
+    records = [
+        TickRecord(start=epoch + 5.0 * (i + 1), num_messages=100,
+                   decision_messages=100, up=Gate.COOLING)
+        for i in range(8)
+    ]
+    arrival = infer_arrivals(records, meta)
+    assert arrival.times[0] == 0.0  # episode-relative, not wall-clock
+    assert all(rate == pytest.approx(10.0) for rate in arrival.rates)
+    row = counterfactual(records, meta, policy="reactive", slo_depth=300.0)
+    # a faithful world: the backlog stays at the observed plateau instead
+    # of the runaway (or empty) world a broken time base would produce
+    assert row["max_depth"] == pytest.approx(100.0, abs=10.0)
+    assert row["time_over_slo_s"] == 0.0
+
+
+def test_counterfactual_duration_counts_metric_failure_ticks(tmp_path):
+    """Metric-failure ticks consumed a poll interval; dropping them from
+    the duration would score a truncated world (review finding)."""
+    path, _, _ = record(tmp_path)
+    meta, records = read_journal(path)
+    failed = dataclasses.replace(
+        records[3], num_messages=None, decision_messages=None,
+        metric_error="Failed to get messages in SQS",
+        up=Gate.SKIPPED, down=Gate.SKIPPED, up_error=None, down_error=None,
+    )
+    records[3] = failed
+    row = counterfactual(records, meta, policy="reactive")
+    assert row["ticks"] == len(records)  # 40, not 39
+
+
+def test_replay_journal_rejoins_episode_across_rotation(tmp_path):
+    """Size rotation splits one episode across <path>.1 and the live file;
+    replay must rejoin it instead of re-applying startup grace mid-episode
+    (which would report false divergences on a healthy build)."""
+    import os
+
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+    from kube_sqs_autoscaler_tpu.sim import Simulation
+
+    config = demo_config()  # 40 ticks ≈ 6 KB of journal
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta=sim_journal_meta(config),
+                     max_bytes=4096) as journal:
+        Simulation(config, extra_observers=(journal,)).run()
+    assert os.path.exists(path + ".1")  # rotation actually happened
+    meta, _ = read_journal(path)
+    assert meta["_continuation"] is True
+    result = replay_journal(path)
+    assert result.ok and result.ticks == 40  # the FULL rejoined episode
+
+
+def test_replay_journal_refuses_when_episode_head_rotated_away(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+    from kube_sqs_autoscaler_tpu.sim import Simulation
+
+    config = demo_config(duration=700.0)  # ≈ 20 KB: several rotations
+    path = str(tmp_path / "journal.jsonl")
+    with TickJournal(path, meta=sim_journal_meta(config),
+                     max_bytes=4096) as journal:
+        Simulation(config, extra_observers=(journal,)).run()
+    with pytest.raises(ValueError, match="rotation continuation"):
+        replay_journal(path)
+
+
+def test_live_journal_without_initial_replicas_flags_assumed_trajectory():
+    """The live CLI meta deliberately omits initial_replicas (the
+    controller cannot know the deployment's size); replay must mark the
+    trajectory as assumed rather than reporting it as authoritative."""
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+
+    meta = {
+        "source": "live", "poll_interval": 5.0, "policy": "reactive",
+        "policy_config": {
+            "scale_up_messages": 100, "scale_down_messages": 10,
+            "scale_up_cooldown": 10.0, "scale_down_cooldown": 30.0,
+        },
+        "world": {"min_pods": 1, "max_pods": 5,
+                  "scale_up_pods": 1, "scale_down_pods": 1},
+    }
+    records = [TickRecord(start=5.0, num_messages=50, decision_messages=50,
+                          up=Gate.IDLE, down=Gate.IDLE)]
+    result = replay(records, meta)
+    assert result.ok
+    assert result.assumed_initial_replicas
+    # sim journals carry the real start: not assumed
+    assert "initial_replicas" in sim_journal_meta(demo_config())["world"]
+
+
+def test_replay_journal_restart_header_rotated_out_before_first_tick(tmp_path):
+    """Restart onto a nearly-full journal: the restart header is rotated
+    into <path>.1 with zero ticks before the new run's first tick lands.
+    The rejoin must treat that empty episode as the episode boundary — not
+    graft the previous run's records onto the new episode (review repro:
+    a 3-tick episode replayed as a 28-tick hybrid of two runs)."""
+    import os
+
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    # run 1: fill to just under the rotation threshold without tripping it
+    with TickJournal(path, meta={"run": 1}, max_bytes=4096) as journal:
+        i = 0
+        while os.path.getsize(path) < 3700:
+            journal.on_tick(
+                TickRecord(start=5.0 * (i + 1), num_messages=50,
+                           decision_messages=50, up=Gate.IDLE, down=Gate.IDLE)
+            )
+            i += 1
+    assert not os.path.exists(path + ".1")  # run 1 never rotated
+    # run 2 (restart): header appends past the threshold; the FIRST tick
+    # trips rotation, sweeping the empty run-2 header into <path>.1
+    meta2 = {
+        "run": 2, "t0": 0.0, "poll_interval": 5.0, "policy": "reactive",
+        "policy_config": {
+            "scale_up_messages": 100, "scale_down_messages": 10,
+            "scale_up_cooldown": 10.0, "scale_down_cooldown": 30.0,
+        },
+        "world": {"initial_replicas": 1, "min_pods": 1, "max_pods": 5,
+                  "scale_up_pods": 1, "scale_down_pods": 1},
+    }
+    run2 = [
+        TickRecord(start=5.0, num_messages=200, decision_messages=200,
+                   up=Gate.COOLING),
+        TickRecord(start=10.0, num_messages=200, decision_messages=200,
+                   up=Gate.FIRE, down=Gate.IDLE),
+        TickRecord(start=15.0, num_messages=200, decision_messages=200,
+                   up=Gate.COOLING),
+    ]
+    with TickJournal(path, meta=meta2, max_bytes=4096) as journal:
+        for record in run2:
+            journal.on_tick(record)
+    from kube_sqs_autoscaler_tpu.obs.journal import read_journal_episodes
+
+    assert os.path.exists(path + ".1")
+    assert read_journal_episodes(path + ".1")[-1] == (meta2, [])  # the boundary
+    result = replay_journal(path)
+    assert result.ticks == 3  # run 2 only, NOT run 1's records grafted on
+    assert result.ok
+    assert result.final_replicas == 2
+
+
+def test_counterfactual_honors_recorded_forecast_config(tmp_path):
+    """Re-scoring 'the recorded policy' must rebuild its recorded warm-up
+    and gating config, not the defaults — matching what replay() does."""
+    path, _, _ = record(
+        tmp_path, policy="predictive", forecaster="ewma",
+        forecast_horizon=30.0, forecast_min_samples=10,
+        forecast_conservative=False, forecast_history=64, duration=100.0,
+    )
+    meta, records = read_journal(path)
+    assert meta["forecast"] == {
+        "forecaster": "ewma", "horizon": 30.0, "history": 64,
+        "min_samples": 10, "conservative": False,
+    }
+    row = counterfactual(records, meta, policy="predictive",
+                         forecaster="ewma")
+    assert row["ticks"] == len(records)
+    # the rebuilt sim under the SAME policy+config reproduces the recorded
+    # churn exactly — with default min_samples/conservative it would not
+    from kube_sqs_autoscaler_tpu.sim.replay import replay as _replay
+
+    assert _replay(records, meta).ok

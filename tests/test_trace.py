@@ -1,0 +1,138 @@
+"""Chrome trace-event export of tick records.
+
+The acceptance bar (ISSUE 2): exported trace JSON is valid Chrome
+trace-event format — loads via ``json.loads``, spans carry integer
+microsecond ``ts``/``dur``, instant events mark gate fires, cooldown
+skips, and metric failures.
+"""
+
+import json
+
+from kube_sqs_autoscaler_tpu.core.events import TickRecord
+from kube_sqs_autoscaler_tpu.core.policy import Gate
+from kube_sqs_autoscaler_tpu.obs.trace import (
+    render_chrome_trace,
+    to_chrome_trace,
+    trace_events,
+)
+
+
+def _records():
+    return [
+        TickRecord(
+            start=100.0, duration=0.05, num_messages=150,
+            decision_messages=150, up=Gate.FIRE, down=Gate.IDLE,
+            observe_s=0.03, decide_s=0.005, actuate_s=0.015,
+        ),
+        TickRecord(
+            start=105.0, duration=0.02, num_messages=150,
+            decision_messages=150, up=Gate.COOLING, down=Gate.SKIPPED,
+            observe_s=0.02, decide_s=0.0,
+        ),
+        TickRecord(start=110.0, duration=0.01, metric_error="boom",
+                   observe_s=0.01),
+        TickRecord(
+            start=115.0, duration=0.03, num_messages=2,
+            decision_messages=2, up=Gate.IDLE, down=Gate.FIRE,
+            down_error="Failed to scale down",
+            observe_s=0.02, decide_s=0.005, actuate_s=0.005,
+        ),
+    ]
+
+
+def test_trace_round_trips_as_json_with_expected_top_level_shape():
+    body = render_chrome_trace(_records(), meta={"source": "test"})
+    trace = json.loads(body)  # the ISSUE's validity bar
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"] == {"source": "test"}
+
+
+def test_every_event_is_well_formed():
+    for event in trace_events(_records()):
+        assert event["ph"] in ("X", "i")
+        assert isinstance(event["ts"], int) and event["ts"] >= 0
+        assert isinstance(event["pid"], int) and isinstance(event["tid"], int)
+        if event["ph"] == "X":
+            assert isinstance(event["dur"], int) and event["dur"] >= 0
+        else:
+            assert event["s"] == "t"
+
+
+def test_timestamps_are_microseconds_from_first_record():
+    events = trace_events(_records())
+    ticks = [e for e in events if e["name"] == "tick"]
+    assert [e["ts"] for e in ticks] == [0, 5_000_000, 10_000_000, 15_000_000]
+    assert ticks[0]["dur"] == 50_000  # 0.05 s
+
+
+def test_phase_spans_tile_the_tick():
+    events = trace_events(_records())
+    observe = next(e for e in events if e["name"] == "observe")
+    decide = next(e for e in events if e["name"] == "decide")
+    actuate = next(e for e in events if e["name"] == "actuate")
+    assert observe["ts"] == 0 and observe["dur"] == 30_000
+    assert decide["ts"] == 30_000 and decide["dur"] == 5_000
+    assert actuate["ts"] == 35_000 and actuate["dur"] == 15_000
+
+
+def test_instant_events_mark_the_postmortem_moments():
+    events = trace_events(_records())
+    by_name = {}
+    for e in events:
+        if e["ph"] == "i":
+            by_name.setdefault(e["name"], []).append(e)
+    assert by_name["scale-up"][0]["args"] == {"direction": "up", "ok": True}
+    assert by_name["cooldown-skip"][0]["args"] == {"direction": "up"}
+    assert by_name["metric-failure"][0]["args"] == {"error": "boom"}
+    (down,) = by_name["scale-down"]
+    assert down["args"]["ok"] is False
+    assert down["args"]["error"] == "Failed to scale down"
+
+
+def test_ticks_without_span_fields_export_without_phase_spans():
+    """Pre-PR-2 records (or observers that never saw spans) still trace."""
+    record = TickRecord(start=0.0, duration=0.01, num_messages=5,
+                        up=Gate.IDLE, down=Gate.FIRE)
+    names = {e["name"] for e in trace_events([record])}
+    assert "tick" in names and "scale-down" in names
+    assert not {"observe", "decide", "actuate"} & names
+
+
+def test_empty_record_list_exports_an_empty_trace():
+    assert trace_events([]) == []
+    assert json.loads(render_chrome_trace([]))["traceEvents"] == []
+
+
+def test_live_loop_records_export_directly():
+    """End to end: real loop on a FakeClock → ring → trace."""
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.metrics import (
+        FakeQueueService,
+        QueueMetricSource,
+    )
+    from kube_sqs_autoscaler_tpu.obs.journal import TickRing
+    from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
+
+    ring = TickRing()
+    api = FakeDeploymentAPI.with_deployments("ns", 1, "deploy")
+    loop = ControlLoop(
+        PodAutoScaler(client=api, max=5, min=1, scale_up_pods=1,
+                      scale_down_pods=1, deployment="deploy", namespace="ns"),
+        QueueMetricSource(client=FakeQueueService.with_depths(200),
+                          queue_url="example.com"),
+        LoopConfig(poll_interval=5.0, policy=PolicyConfig(
+            scale_up_cooldown=1.0, scale_down_cooldown=1.0)),
+        clock=FakeClock(),
+        observer=ring,
+    )
+    loop.run(max_ticks=4)
+    trace = json.loads(render_chrome_trace(ring.snapshot()))
+    ticks = [e for e in trace["traceEvents"] if e["name"] == "tick"]
+    assert len(ticks) == 4
+    # FakeClock ticks are instantaneous: spans exist and are zero-length
+    observes = [e for e in trace["traceEvents"] if e["name"] == "observe"]
+    assert len(observes) == 4 and all(e["dur"] == 0 for e in observes)
+    assert any(e["name"] == "scale-up" for e in trace["traceEvents"])
