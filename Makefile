@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay replay-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep replay-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -46,6 +46,14 @@ bench-forecast:
 # every forecaster; writes BENCH_r07.json
 bench-replay:
 	JAX_PLATFORMS=cpu python bench.py --suite replay
+
+# Compiled-simulator autotuning sweep: verify the lax.scan episodes
+# reproduce the real control loop tick-for-tick on the full battery
+# (exits non-zero on ANY divergence), then grid-search gate/forecast
+# parameters through the vmapped compiled simulator and record the
+# per-episode speedup over the Python real-loop sim; writes BENCH_r08.json
+bench-sweep:
+	JAX_PLATFORMS=cpu python bench.py --suite sweep
 
 # The fidelity gate alone (no JAX, seconds): record a short simulated
 # episode, replay it, fail on any decision divergence

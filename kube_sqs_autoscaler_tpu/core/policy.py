@@ -42,6 +42,30 @@ class Gate(enum.Enum):
     SKIPPED = "skipped"  # not evaluated (an earlier gate ended the tick)
 
 
+# Integer gate codes: the scan-able twin of :class:`Gate`.  The compiled
+# simulator (``sim/compiled.py``) evaluates whole episodes inside
+# ``jax.lax.scan``, where enum values cannot flow; both the live gates
+# below and the compiled gates share :func:`gate_code`, so the decision
+# arithmetic exists exactly once.
+GATE_IDLE, GATE_FIRE, GATE_COOLING, GATE_SKIPPED = 0, 1, 2, 3
+GATE_BY_CODE: tuple[Gate, ...] = (Gate.IDLE, Gate.FIRE, Gate.COOLING, Gate.SKIPPED)
+
+
+def gate_code(threshold_met, now, last, cooldown):
+    """Branchless core of both gates; works elementwise on arrays.
+
+    Encodes the two reference subtleties shared by ``main.go:51-52`` and
+    ``main.go:65-66``: the threshold test is inclusive (callers pass the
+    already-evaluated ``threshold_met``), and cooldown is "still cooling"
+    iff ``last + cooldown > now`` *strictly* — a tick landing exactly on
+    the boundary fires.  Returns ``GATE_IDLE``/``GATE_FIRE``/
+    ``GATE_COOLING``; all inputs may be Python scalars or numpy/JAX
+    arrays (the arithmetic form is what makes it ``lax.scan``-able).
+    """
+    cooling = last + cooldown > now
+    return threshold_met * (GATE_FIRE + cooling)
+
+
 @dataclass(frozen=True)
 class PolicyConfig:
     """Thresholds and cooldowns (reference defaults, ``main.go:83-87``)."""
@@ -85,22 +109,32 @@ def gate_up(
     num_messages: int, now: float, config: PolicyConfig, state: PolicyState
 ) -> Gate:
     """The scale-up gate (``main.go:51-52``). Pure."""
-    if num_messages < config.scale_up_messages:
-        return Gate.IDLE
-    if state.last_scale_up + config.scale_up_cooldown > now:
-        return Gate.COOLING
-    return Gate.FIRE
+    return GATE_BY_CODE[
+        int(
+            gate_code(
+                num_messages >= config.scale_up_messages,
+                now,
+                state.last_scale_up,
+                config.scale_up_cooldown,
+            )
+        )
+    ]
 
 
 def gate_down(
     num_messages: int, now: float, config: PolicyConfig, state: PolicyState
 ) -> Gate:
     """The scale-down gate (``main.go:65-66``). Pure."""
-    if num_messages > config.scale_down_messages:
-        return Gate.IDLE
-    if state.last_scale_down + config.scale_down_cooldown > now:
-        return Gate.COOLING
-    return Gate.FIRE
+    return GATE_BY_CODE[
+        int(
+            gate_code(
+                num_messages <= config.scale_down_messages,
+                now,
+                state.last_scale_down,
+                config.scale_down_cooldown,
+            )
+        )
+    ]
 
 
 def plan_tick(

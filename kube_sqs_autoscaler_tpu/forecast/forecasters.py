@@ -20,6 +20,13 @@ The three families cover the classical trend spectrum:
   extrapolation.  The low-noise, irregular-cadence counterpart to Holt.
 
 All predictions are clamped to ``>= 0`` (queue depth is nonnegative).
+
+Each forecaster's math lives in a plain pure function (``ewma_level``,
+``holt_forecast``, ``lstsq_forecast``) with a jitted wrapper the live
+predictors call; the compiled closed-loop simulator
+(``sim/compiled.py``) inlines the same pure functions inside its episode
+``lax.scan``, so the per-tick path and the batched sweep path share one
+set of forecasting ops and cannot drift apart.
 """
 
 from __future__ import annotations
@@ -49,9 +56,15 @@ class Forecaster(Protocol):
         ...
 
 
-@partial(jax.jit, static_argnames=())
-def _ewma_level(depths: jax.Array, n: jax.Array, alpha: jax.Array) -> jax.Array:
-    """Masked EWMA over the first ``n`` entries; returns the final level."""
+def ewma_level(depths: jax.Array, n: jax.Array, alpha: jax.Array) -> jax.Array:
+    """Masked EWMA over the first ``n`` entries; returns the final level.
+
+    Pure and jit-free: the live forecasters call the jitted wrapper
+    ``_ewma_level``; the compiled simulator (``sim/compiled.py``) inlines
+    this same function inside its per-tick ``lax.scan`` body, so the two
+    paths cannot drift.  Keep inputs ``float32`` (cast before calling) —
+    the fidelity gate depends on both paths running identical f32 ops.
+    """
     idx = jnp.arange(depths.shape[0])
     valid = idx < n
 
@@ -64,8 +77,10 @@ def _ewma_level(depths: jax.Array, n: jax.Array, alpha: jax.Array) -> jax.Array:
     return level
 
 
-@partial(jax.jit, static_argnames=())
-def _holt_forecast(
+_ewma_level = partial(jax.jit, static_argnames=())(ewma_level)
+
+
+def holt_forecast(
     times: jax.Array,
     depths: jax.Array,
     n: jax.Array,
@@ -78,6 +93,10 @@ def _holt_forecast(
     The trend is per *sample step*; the horizon converts to steps via the
     mean observed inter-sample interval, so the forecast is calibrated in
     seconds whatever the poll cadence.
+
+    Pure (see :func:`ewma_level` for the jit-free contract); ``times``
+    must already be centered on the newest sample
+    (:func:`_center_times`).
     """
     idx = jnp.arange(depths.shape[0])
     valid = idx < n
@@ -101,8 +120,10 @@ def _holt_forecast(
     return jnp.maximum(level + trend * steps, 0.0)
 
 
-@partial(jax.jit, static_argnames=())
-def _lstsq_forecast(
+_holt_forecast = partial(jax.jit, static_argnames=())(holt_forecast)
+
+
+def lstsq_forecast(
     times: jax.Array,
     depths: jax.Array,
     n: jax.Array,
@@ -114,6 +135,8 @@ def _lstsq_forecast(
     Times are centered on the newest sample before the normal equations,
     so the fit is conditioned regardless of the clock's epoch, and the
     prediction is simply ``intercept + slope * horizon``.
+
+    Pure (see :func:`ewma_level` for the jit-free contract).
     """
     idx = jnp.arange(depths.shape[0])
     mask = (idx < n) & (idx >= n - window)
@@ -133,6 +156,9 @@ def _lstsq_forecast(
     intercept = (sy - slope * sx) / jnp.maximum(count, 1)
     fit = intercept + slope * horizon
     return jnp.maximum(jnp.where(degenerate, depth_last, fit), 0.0)
+
+
+_lstsq_forecast = partial(jax.jit, static_argnames=())(lstsq_forecast)
 
 
 def _center_times(times: np.ndarray, n: int) -> np.ndarray:

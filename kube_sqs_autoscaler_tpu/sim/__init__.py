@@ -13,13 +13,20 @@ scenario battery in :mod:`.evaluate` (``bench.py --suite forecast``).
 :mod:`.replay` closes the observability loop the other way: it re-drives
 the production loop from a recorded flight journal (``obs/journal.py``)
 and counterfactually re-scores the episode under any other policy
-(``bench.py --suite replay``).
+(``bench.py --suite replay``).  :mod:`.compiled` is this simulator's
+XLA twin — whole episodes as one ``jax.lax.scan``, vmapped over
+parameter grids for the autotuning sweeps in :mod:`.sweep`
+(``bench.py --suite sweep``), fidelity-gated tick-for-tick against the
+Python loop here (``verify_fidelity``; see ARCHITECTURE.md "The
+compiled twin").
 """
 
 # NOTE: .replay is intentionally NOT imported here — it is runnable as
 # `python -m kube_sqs_autoscaler_tpu.sim.replay` (the make replay-demo
 # entry), and importing it from the package __init__ would shadow that
 # execution with a second module copy (runpy's sys.modules warning).
+# .compiled and .sweep are also not imported: they pull in JAX, and this
+# package must stay importable JAX-free (bench.py's default suite).
 from .scenarios import (
     ArrivalProcess,
     BurstArrival,

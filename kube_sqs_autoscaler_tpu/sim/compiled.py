@@ -1,0 +1,650 @@
+"""Compiled closed-loop simulator: whole episodes as one XLA program.
+
+The Python simulator (:mod:`.simulator`) drives the *real*
+``ControlLoop`` one tick at a time — the right tool for fidelity, the
+wrong one for search: evaluating a single (scenario × policy × parameter)
+point costs a full Python-rate episode, so the scenario battery tops out
+at a handful of configurations.  KIS-S (arxiv 2507.07932) needs thousands
+of simulated episodes for policy search to be useful; BLITZSCALE
+(arxiv 2412.17246) argues scaling decisions should be tuned against the
+workload's actual arrival process.  Both need a simulator that is orders
+of magnitude faster than wall-clock re-execution.
+
+This module re-expresses the closed loop as a functionally pure
+``jax.lax.scan`` over ticks — fluid queue world + threshold/cooldown
+gates + the EWMA/Holt/lstsq forecasters — so an entire episode is a
+single XLA executable, then ``jax.vmap``\\ s it over a batch of encoded
+configurations so hundreds of (scenario × policy × parameter) points
+evaluate in one device call (:func:`run_compiled`; the sweep driver in
+:mod:`.sweep` sits on top).
+
+**Fidelity is mechanically checked, not assumed.**  The scan is written
+to reproduce the reference semantics *bit-for-bit* where they are exact:
+
+- world arithmetic runs in float64 via ``jax.experimental.enable_x64``,
+  expression-for-expression identical to :meth:`.simulator.Simulation.
+  advance_world` (including the seed's separate constant-rate formula);
+  tick times and arrival integrals are precomputed host-side by the
+  *actual* Python ``FakeClock`` accumulation and ``arrivals_between``
+  implementations (:func:`_tick_times_and_arrivals`), so they are exact
+  by construction and any :class:`~.scenarios.ArrivalProcess` — including
+  a journal-inferred :class:`~.replay.RecordedArrival` — can sweep;
+- gate decisions go through :func:`~..core.policy.gate_code` — the same
+  branchless function the live ``gate_up``/``gate_down`` call — with the
+  reference's inclusive thresholds, strictly-After cooldowns, up-cooling
+  ``continue`` (down gate ``SKIPPED``), and boundary-no-op-refreshes-
+  cooldown semantics;
+- forecaster math runs in float32 on the same pure step functions the
+  jitted live forecasters wrap (:func:`~..forecast.forecasters.
+  ewma_level` / ``holt_forecast`` / ``lstsq_forecast``), fed a history
+  snapshot maintained with ``DepthHistory.with_sample``'s exact
+  append/pad/roll semantics.
+
+:func:`verify_fidelity` runs the compiled episodes against real-loop
+Python episodes on the full scenario battery and asserts the replica
+trajectory and gate decisions agree tick-for-tick, reporting any mismatch
+through the same :class:`~.replay.Divergence` machinery the flight
+recorder uses — the compiled path can never silently drift from the
+reference semantics.  ``bench.py --suite sweep`` runs this gate before
+trusting any sweep number.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import enable_x64
+
+from ..core.events import TickRecord
+from ..core.policy import GATE_BY_CODE, GATE_COOLING, GATE_FIRE, GATE_SKIPPED, gate_code
+from ..forecast.forecasters import (
+    EwmaForecaster,
+    HoltForecaster,
+    LeastSquaresForecaster,
+    ewma_level,
+    holt_forecast,
+    lstsq_forecast,
+)
+from .replay import Divergence
+from .simulator import SimConfig, SimResult, Simulation
+
+#: forecaster name -> policy kind inside the scan (0 = reactive)
+FORECASTER_KINDS = {"ewma": 1, "holt": 2, "lstsq": 3}
+
+
+def _tick_times_and_arrivals(
+    config: SimConfig, ticks: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-tick observation times and exact arrival integrals, host-side.
+
+    The arrival process is state-free — ``∫ rate dt`` over each poll
+    interval depends only on the tick times, which are known before the
+    episode runs — so the integrals are evaluated here by the *actual*
+    Python ``arrivals_between`` implementations and fed to the scan as
+    inputs.  That makes the compiled world's arrivals bit-identical to
+    the Python world's by construction (XLA re-derivations of the
+    closed forms differ in the last ulp — its backend contracts
+    mul+add chains into FMAs — and one ulp is enough to flip the
+    ``int(depth)`` floor on ticks where the backlog lands exactly on an
+    integer), and it means any :class:`~.scenarios.ArrivalProcess` —
+    including :class:`~.replay.RecordedArrival` from a flight journal —
+    sweeps without a compiled-side re-implementation.
+
+    Times accumulate ``t += poll`` exactly like ``FakeClock.sleep``, so
+    cooldown arithmetic inside the scan sees the same instants the real
+    loop's clock produced.
+
+    Cached per ``(arrival, poll, ticks)``: the arrays are identical for
+    every config sharing a scenario, and a sweep encodes hundreds of
+    configs over a handful of scenarios — without the cache the grid
+    pays ``points × ticks`` redundant ``arrivals_between`` calls per
+    ``run_sweep``.  Arrival processes are frozen dataclasses (hashable);
+    an unhashable custom process just skips the cache.
+    """
+    arrival = config.arrival_rate
+    poll = config.loop.poll_interval
+    try:
+        return _cached_times_and_arrivals(arrival, poll, ticks)
+    except TypeError:
+        return _compute_times_and_arrivals(arrival, poll, ticks)
+
+
+def _compute_times_and_arrivals(
+    arrival: Any, poll: float, ticks: int
+) -> tuple[np.ndarray, np.ndarray]:
+    times = np.zeros(ticks, dtype=np.float64)
+    arrived = np.zeros(ticks, dtype=np.float64)
+    t = 0.0
+    for k in range(ticks):
+        t_prev, t = t, t + poll
+        times[k] = t
+        if not isinstance(arrival, (int, float)):
+            arrived[k] = arrival.arrivals_between(t_prev, t)
+    return times, arrived
+
+
+_cached_times_and_arrivals = lru_cache(maxsize=128)(
+    _compute_times_and_arrivals
+)
+
+
+def encode_config(config: SimConfig) -> dict[str, Any]:
+    """One :class:`~.simulator.SimConfig` as the scan's parameter row.
+
+    Everything dynamic (thresholds, cooldowns, rates, forecast knobs) is a
+    numpy scalar so rows stack into a vmap batch; the per-tick times and
+    arrival integrals ride along as ``(ticks,)`` arrays
+    (:func:`_tick_times_and_arrivals`); the static shape knobs — tick
+    count and history capacity — stay on the Python side
+    (:func:`episode_ticks`, ``config.forecast_history``).
+
+    ``seed_const`` marks the seed's plain-float ``arrival_rate`` config
+    style, which uses a *different* depth-update expression than
+    ``ConstantArrival`` (net-rate form vs arrived-minus-drained) —
+    numerically equal but not bit-identical, and fidelity is bit-level.
+    """
+    times, arrived = _tick_times_and_arrivals(config, episode_ticks(config))
+    policy = config.loop.policy
+    seed_const = isinstance(config.arrival_rate, (int, float))
+    row: dict[str, Any] = {
+        "times": times,
+        "arrived": arrived,
+        "seed_const": np.bool_(seed_const),
+        "seed_rate": np.float64(
+            config.arrival_rate if seed_const else 0.0
+        ),
+        "service_rate": np.float64(config.service_rate_per_replica),
+        "initial_depth": np.float64(config.initial_depth),
+        "initial_replicas": np.int32(config.initial_replicas),
+        "min_pods": np.int32(config.min_pods),
+        "max_pods": np.int32(config.max_pods),
+        "scale_up_pods": np.int32(config.scale_up_pods),
+        "scale_down_pods": np.int32(config.scale_down_pods),
+        "scale_up_messages": np.int32(policy.scale_up_messages),
+        "scale_down_messages": np.int32(policy.scale_down_messages),
+        "scale_up_cooldown": np.float64(policy.scale_up_cooldown),
+        "scale_down_cooldown": np.float64(policy.scale_down_cooldown),
+        "policy_kind": np.int32(0),
+        # forecast params (ignored by reactive rows but always present so
+        # every row has the same pytree structure); f32 to match the live
+        # forecasters' jit dtype exactly
+        "horizon": np.float32(config.forecast_horizon),
+        "alpha": np.float32(0.0),
+        "beta": np.float32(0.0),
+        "window": np.int32(1),
+        "min_samples": np.int32(max(2, int(config.forecast_min_samples))),
+        "conservative": np.bool_(config.forecast_conservative),
+    }
+    if config.policy == "predictive":
+        name = config.forecaster
+        if name not in FORECASTER_KINDS:
+            raise ValueError(
+                f"unknown forecaster {name!r};"
+                f" choose from {tuple(FORECASTER_KINDS)}"
+            )
+        row["policy_kind"] = np.int32(FORECASTER_KINDS[name])
+        # parameter defaults come from the live forecaster dataclasses, so
+        # the compiled path can't drift if a default is retuned
+        if name == "ewma":
+            row["alpha"] = np.float32(EwmaForecaster().alpha)
+        elif name == "holt":
+            holt = HoltForecaster()
+            row["alpha"] = np.float32(holt.alpha)
+            row["beta"] = np.float32(holt.beta)
+        else:
+            row["window"] = np.int32(LeastSquaresForecaster().window)
+    elif config.policy != "reactive":
+        raise ValueError(
+            f"policy must be 'reactive' or 'predictive', got"
+            f" {config.policy!r}"
+        )
+    return row
+
+
+def episode_ticks(config: SimConfig) -> int:
+    """Tick count of one episode — ``Simulation.run``'s exact formula."""
+    return max(1, int(config.duration / config.loop.poll_interval))
+
+
+def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
+    """One closed-loop episode as a single ``lax.scan`` over ticks.
+
+    Carry = (clock, depth, replicas, cooldown stamps, forecast history,
+    running max depth) — the entire state the Python stack spreads across
+    ``FakeClock``/``Simulation``/``PolicyState``/``DepthHistory``.
+    """
+    idx = jnp.arange(capacity)
+
+    def tick(carry, xs):
+        t_new, arrived = xs
+        t, depth, replicas, last_up, last_down, h_t, h_d, h_n, max_depth = carry
+        # -- sleep first, then poll (main.go:41): the tick's clock reads
+        # all happen at t_new (FakeClock does not advance inside a tick;
+        # t_new comes precomputed from the host with FakeClock's exact
+        # accumulation)
+        dt = t_new - t
+        reps_f = replicas.astype(jnp.float64)
+        # -- world integration, both config styles (simulator.advance_world);
+        # arrivals are host-precomputed exact integrals (see
+        # _tick_times_and_arrivals)
+        net_rate = p["seed_rate"] - reps_f * p["service_rate"]
+        seed_depth = jnp.maximum(0.0, depth + net_rate * dt)
+        drained = reps_f * p["service_rate"] * dt
+        gen_depth = jnp.maximum(0.0, depth + arrived - drained)
+        depth_new = jnp.where(p["seed_const"], seed_depth, gen_depth)
+        max_depth = jnp.maximum(max_depth, depth_new)
+        observed = jnp.floor(depth_new).astype(jnp.int32)
+
+        decision = observed
+        if predictive:
+            # -- history snapshot including the current observation:
+            # DepthHistory.with_sample's exact semantics (append when not
+            # full, padding the tail with the newest sample; shift-in when
+            # full).  f64 here; cast to f32 only at the forecaster
+            # boundary, exactly where the live path's jnp.asarray casts.
+            obs_f = observed.astype(jnp.float64)
+            full = h_n >= capacity
+            snap_t = jnp.where(
+                full,
+                jnp.roll(h_t, -1).at[-1].set(t_new),
+                jnp.where(idx < h_n, h_t, t_new),
+            )
+            snap_d = jnp.where(
+                full,
+                jnp.roll(h_d, -1).at[-1].set(obs_f),
+                jnp.where(idx < h_n, h_d, obs_f),
+            )
+            n = jnp.minimum(h_n + 1, capacity)
+            # newest sample is always the last slot (padding == newest),
+            # so centering on [-1] is _center_times centering on n-1
+            times32 = (snap_t - snap_t[-1]).astype(jnp.float32)
+            depths32 = snap_d.astype(jnp.float32)
+            pred_ewma = jnp.maximum(0.0, ewma_level(depths32, n, p["alpha"]))
+            pred_holt = holt_forecast(
+                times32, depths32, n, p["horizon"], p["alpha"], p["beta"]
+            )
+            pred_lstsq = lstsq_forecast(
+                times32, depths32, n, p["horizon"], p["window"]
+            )
+            predicted = jnp.where(
+                p["policy_kind"] == 1,
+                pred_ewma,
+                jnp.where(p["policy_kind"] == 2, pred_holt, pred_lstsq),
+            )
+            # PredictivePolicy: max(0, int(round(.))), conservative gates
+            # see max(observed, forecast), reactive warm-up below
+            # min_samples
+            prediction = jnp.maximum(0, jnp.round(predicted).astype(jnp.int32))
+            effective = jnp.where(
+                p["conservative"],
+                jnp.maximum(observed, prediction),
+                prediction,
+            )
+            warmed = n >= p["min_samples"]
+            decision = jnp.where(
+                (p["policy_kind"] > 0) & warmed, effective, observed
+            )
+            h_t, h_d, h_n = snap_t, snap_d, n
+
+        # -- gates: same gate_code as the live gate_up/gate_down; the
+        # up-cooling `continue` marks the down gate SKIPPED (main.go:54);
+        # FIRE refreshes the matching cooldown stamp (boundary no-ops
+        # included — PodAutoScaler returns success on clamp)
+        up_code = gate_code(
+            decision >= p["scale_up_messages"],
+            t_new,
+            last_up,
+            p["scale_up_cooldown"],
+        )
+        up_fire = up_code == GATE_FIRE
+        reps1 = jnp.where(
+            up_fire & (replicas < p["max_pods"]),
+            jnp.minimum(replicas + p["scale_up_pods"], p["max_pods"]),
+            replicas,
+        )
+        last_up = jnp.where(up_fire, t_new, last_up)
+        down_code = jnp.where(
+            up_code == GATE_COOLING,
+            GATE_SKIPPED,
+            gate_code(
+                decision <= p["scale_down_messages"],
+                t_new,
+                last_down,
+                p["scale_down_cooldown"],
+            ),
+        )
+        down_fire = down_code == GATE_FIRE
+        reps2 = jnp.where(
+            down_fire & (reps1 > p["min_pods"]),
+            jnp.maximum(reps1 - p["scale_down_pods"], p["min_pods"]),
+            reps1,
+        )
+        last_down = jnp.where(down_fire, t_new, last_down)
+
+        out = (t_new, observed, decision, up_code, down_code, replicas, reps2)
+        carry = (
+            t_new, depth_new, reps2, last_up, last_down, h_t, h_d, h_n,
+            max_depth,
+        )
+        return carry, out
+
+    init = (
+        jnp.asarray(0.0, jnp.float64),  # FakeClock() starts at 0
+        jnp.asarray(p["initial_depth"], jnp.float64),
+        jnp.asarray(p["initial_replicas"], jnp.int32),
+        jnp.asarray(0.0, jnp.float64),  # initial_state(now=0): startup grace
+        jnp.asarray(0.0, jnp.float64),
+        jnp.zeros(capacity, jnp.float64),
+        jnp.zeros(capacity, jnp.float64),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(p["initial_depth"], jnp.float64),  # max_depth seed
+    )
+    carry, (t, observed, decision, up, down, reps_before, reps_after) = lax.scan(
+        tick, init, (p["times"], p["arrived"]), length=ticks
+    )
+    return {
+        "t": t,
+        "observed": observed,
+        "decision": decision,
+        "up": up,
+        "down": down,
+        "replicas_before": reps_before,
+        "replicas_after": reps_after,
+        "final_depth": carry[1],
+        "final_replicas": carry[2],
+        "max_depth": carry[8],
+    }
+
+
+@partial(jax.jit, static_argnames=("ticks", "capacity", "predictive"))
+def _run_batch(params, ticks: int, capacity: int, predictive: bool):
+    return jax.vmap(lambda row: _episode(row, ticks, capacity, predictive))(
+        params
+    )
+
+
+@dataclass
+class CompiledEpisode:
+    """One compiled episode: the battery-facing result + the per-tick
+    decision trail the fidelity gate checks."""
+
+    result: SimResult
+    times: np.ndarray
+    observed: np.ndarray
+    decision: np.ndarray
+    up_codes: np.ndarray
+    down_codes: np.ndarray
+    replicas_before: np.ndarray
+    replicas_after: np.ndarray
+
+    def gates(self, index: int) -> tuple[Any, Any]:
+        """(up, down) as :class:`~..core.policy.Gate` for tick ``index``."""
+        return (
+            GATE_BY_CODE[int(self.up_codes[index])],
+            GATE_BY_CODE[int(self.down_codes[index])],
+        )
+
+
+def run_episodes(configs: Sequence[SimConfig]) -> list[CompiledEpisode]:
+    """Run a batch of configs through the compiled simulator.
+
+    One device call for the whole batch.  All configs must share a tick
+    count (``duration / poll_interval``) and a ``forecast_history``
+    capacity — those are compiled shapes; the sweep driver groups by them.
+    """
+    configs = list(configs)
+    if not configs:
+        return []
+    ticks_set = {episode_ticks(c) for c in configs}
+    if len(ticks_set) > 1:
+        raise ValueError(
+            f"all configs in one compiled batch must share a tick count,"
+            f" got {sorted(ticks_set)}; group by duration/poll first"
+        )
+    cap_set = {int(c.forecast_history) for c in configs}
+    if len(cap_set) > 1:
+        raise ValueError(
+            f"all configs in one compiled batch must share forecast_history,"
+            f" got {sorted(cap_set)}; group by capacity first"
+        )
+    ticks = ticks_set.pop()
+    capacity = cap_set.pop()
+    predictive = any(c.policy == "predictive" for c in configs)
+    if predictive and capacity < 2:
+        # DepthHistory enforces this on the live path; match it
+        raise ValueError(f"forecast_history must be >= 2, got {capacity}")
+    rows = [encode_config(c) for c in configs]
+    batch = {key: np.stack([row[key] for row in rows]) for key in rows[0]}
+    with enable_x64():
+        out = _run_batch(
+            {key: jnp.asarray(value) for key, value in batch.items()},
+            ticks=ticks,
+            capacity=capacity,
+            predictive=predictive,
+        )
+        out = {key: np.asarray(value) for key, value in out.items()}
+    episodes = []
+    for i in range(len(configs)):
+        timeline = [
+            (float(t), int(d), int(r))
+            for t, d, r in zip(
+                out["t"][i], out["observed"][i], out["replicas_before"][i]
+            )
+        ]
+        result = SimResult(
+            timeline=timeline,
+            final_replicas=int(out["final_replicas"][i]),
+            final_depth=float(out["final_depth"][i]),
+            max_depth=float(out["max_depth"][i]),
+            ticks=ticks,
+        )
+        episodes.append(
+            CompiledEpisode(
+                result=result,
+                times=out["t"][i],
+                observed=out["observed"][i],
+                decision=out["decision"][i],
+                up_codes=out["up"][i],
+                down_codes=out["down"][i],
+                replicas_before=out["replicas_before"][i],
+                replicas_after=out["replicas_after"][i],
+            )
+        )
+    return episodes
+
+
+def run_episodes_grouped(
+    configs: Sequence[SimConfig],
+) -> list[CompiledEpisode]:
+    """:func:`run_episodes` over configs of *mixed* compiled shapes.
+
+    Tick count and history capacity are compiled shapes, so one device
+    call can only take configs that share them; this helper groups by
+    ``(ticks, capacity)``, runs one batch per group, and scatters the
+    episodes back into input order.  Both :func:`verify_fidelity` and
+    the sweep driver (:mod:`.sweep`) batch through here.
+    """
+    configs = list(configs)
+    groups: dict[tuple[int, int], list[int]] = {}
+    for index, config in enumerate(configs):
+        key = (episode_ticks(config), int(config.forecast_history))
+        groups.setdefault(key, []).append(index)
+    episodes: list[CompiledEpisode | None] = [None] * len(configs)
+    for indices in groups.values():
+        for index, episode in zip(
+            indices, run_episodes([configs[i] for i in indices])
+        ):
+            episodes[index] = episode
+    return episodes  # type: ignore[return-value]  # every slot filled
+
+
+def run_compiled(configs: Sequence[SimConfig]) -> list[SimResult]:
+    """Batch of configs -> battery-compatible :class:`SimResult`\\ s."""
+    return [episode.result for episode in run_episodes(configs)]
+
+
+def run_compiled_one(config: SimConfig) -> SimResult:
+    """Single-config convenience wrapper around :func:`run_compiled`."""
+    return run_compiled([config])[0]
+
+
+class _Recorder:
+    def __init__(self) -> None:
+        self.records: list[TickRecord] = []
+
+    def on_tick(self, record: TickRecord) -> None:
+        self.records.append(record)
+
+
+@dataclass
+class FidelityReport:
+    """Outcome of one compiled-vs-real fidelity pass."""
+
+    episodes: int
+    ticks: int
+    divergences: list[tuple[str, Divergence]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences
+
+    def format_divergences(self, limit: int = 10) -> list[str]:
+        """Human-readable lines in the flight recorder's divergence format
+        (shared shape with :meth:`~.replay.ReplayResult.
+        format_divergences`, prefixed with the episode label)."""
+        return [
+            f"{label}: tick {d.tick}: {d.tick_field} recorded={d.recorded!r}"
+            f" replayed={d.replayed!r}"
+            for label, d in self.divergences[:limit]
+        ]
+
+
+def _fidelity_configs(
+    scenarios, forecasters: Sequence[str]
+) -> list[tuple[str, SimConfig]]:
+    episodes: list[tuple[str, SimConfig]] = []
+    for scenario in scenarios:
+        base = dict(
+            arrival_rate=scenario.arrival,
+            service_rate_per_replica=scenario.service_rate_per_replica,
+            duration=scenario.duration,
+            initial_replicas=scenario.initial_replicas,
+            min_pods=scenario.min_pods,
+            max_pods=scenario.max_pods,
+            loop=scenario.loop,
+        )
+        episodes.append((f"{scenario.name}/reactive", SimConfig(**base)))
+        for name in forecasters:
+            episodes.append(
+                (
+                    f"{scenario.name}/predictive:{name}",
+                    SimConfig(
+                        **base,
+                        policy="predictive",
+                        forecaster=name,
+                        forecast_horizon=scenario.horizon,
+                    ),
+                )
+            )
+    return episodes
+
+
+def verify_fidelity(
+    scenarios=None,
+    forecasters: Sequence[str] = ("ewma", "holt", "lstsq"),
+    extra_episodes: Sequence[tuple[str, SimConfig]] = (),
+) -> FidelityReport:
+    """Assert the compiled scan reproduces the real-``ControlLoop`` sim.
+
+    Runs reactive plus each requested forecaster over every scenario
+    (default: the full :func:`~.evaluate.default_battery`), once through
+    the Python closed-loop simulator (the real production stack on a
+    ``FakeClock``) and once through the compiled scan, and compares
+    **tick-for-tick**: observed depth, the depth the gates thresholded
+    (``decision_messages``), both gate outcomes, and the replica count
+    entering each tick — plus the episode's final replicas and max depth.
+    Any mismatch is a :class:`~.replay.Divergence`; callers gate on
+    :attr:`FidelityReport.ok` (``bench.py --suite sweep`` exits 2, the
+    same contract as ``make replay-demo``).
+
+    The default episodes all use the scenarios' stock gate parameters —
+    the knobs a sweep *tunes* (thresholds, cooldowns, scale step,
+    horizon, history) stay at their defaults.  ``extra_episodes``
+    extends the gate with arbitrary ``(label, SimConfig)`` pairs so
+    callers can cover the swept region too: ``bench.py --suite sweep``
+    passes a deterministic sample of its own grid points, so the
+    published best/Pareto configs come from a region the gate actually
+    checked.  Episodes are batched by compiled shape (tick count ×
+    history capacity), so mixed durations/capacities are fine.
+    """
+    if scenarios is None:
+        from .evaluate import default_battery
+
+        scenarios = default_battery()
+    episodes = _fidelity_configs(scenarios, forecasters)
+    episodes.extend(extra_episodes)
+    compiled = run_episodes_grouped([config for _, config in episodes])
+    divergences: list[tuple[str, Divergence]] = []
+    total_ticks = 0
+    for (label, config), comp in zip(episodes, compiled):
+        recorder = _Recorder()
+        result = Simulation(config, extra_observers=(recorder,)).run()
+        total_ticks += result.ticks
+        for k, record in enumerate(recorder.records):
+            up, down = comp.gates(k)
+            checks = (
+                ("num_messages", record.num_messages, int(comp.observed[k])),
+                (
+                    "decision_messages",
+                    record.decision_messages,
+                    int(comp.decision[k]),
+                ),
+                ("up", record.up, up),
+                ("down", record.down, down),
+                ("replicas", result.timeline[k][2], int(comp.replicas_before[k])),
+            )
+            for name, recorded, replayed in checks:
+                if recorded != replayed:
+                    divergences.append(
+                        (label, Divergence(k, name, recorded, replayed))
+                    )
+        if result.final_replicas != comp.result.final_replicas:
+            divergences.append(
+                (
+                    label,
+                    Divergence(
+                        result.ticks,
+                        "final_replicas",
+                        result.final_replicas,
+                        comp.result.final_replicas,
+                    ),
+                )
+            )
+        # max depth is float64 world state; everything upstream of it is
+        # bit-exact except libm-vs-XLA transcendentals (diurnal's cos), so
+        # a relative tolerance at f64 noise level is the honest check
+        if not math.isclose(
+            result.max_depth, comp.result.max_depth, rel_tol=1e-9, abs_tol=1e-6
+        ):
+            divergences.append(
+                (
+                    label,
+                    Divergence(
+                        result.ticks,
+                        "max_depth",
+                        result.max_depth,
+                        comp.result.max_depth,
+                    ),
+                )
+            )
+    return FidelityReport(
+        episodes=len(episodes), ticks=total_ticks, divergences=divergences
+    )

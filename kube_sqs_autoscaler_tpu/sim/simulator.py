@@ -17,6 +17,7 @@ under test.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import cached_property
 from typing import Sequence
 
 from ..core.clock import FakeClock
@@ -70,8 +71,15 @@ class SimResult:
     max_depth: float
     ticks: int
 
-    @property
+    @cached_property
     def replica_changes(self) -> int:
+        """Scaling churn: ticks whose entering replica count changed.
+
+        Cached: the recount is O(timeline) and sweep scoring
+        (:mod:`.sweep`) reads it once per scored configuration — results
+        are effectively frozen once built, so the first read's answer is
+        the answer.
+        """
         changes = 0
         for (_, _, a), (_, _, b) in zip(self.timeline, self.timeline[1:]):
             if a != b:

@@ -929,7 +929,9 @@ class ContinuousBatcher:
             emitted = np.asarray(self._beam_emitted[row]).astype(
                 np.float32
             )
-            norm = ((np.float32(5.0) + emitted) / np.float32(6.0))                 ** np.float32(self.length_penalty)
+            norm = (
+                (np.float32(5.0) + emitted) / np.float32(6.0)
+            ) ** np.float32(self.length_penalty)
             ranked = scores / norm
         else:
             ranked = scores

@@ -1,0 +1,209 @@
+"""Compiled simulator fidelity: the `lax.scan` twin must reproduce the
+real-`ControlLoop` Python simulator tick-for-tick.
+
+This is the tentpole's non-negotiable gate (ISSUE 3): every observed
+depth, every gate-thresholded decision, both gate outcomes, and the
+replica trajectory must agree exactly — for reactive and all three
+predictive forecasters, across the full default scenario battery.  The
+same check runs in ``bench.py --suite sweep`` before any sweep number is
+recorded.
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.loop import LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.sim import SimConfig, Simulation
+from kube_sqs_autoscaler_tpu.sim.compiled import (
+    encode_config,
+    episode_ticks,
+    run_compiled,
+    run_compiled_one,
+    run_episodes,
+    verify_fidelity,
+)
+from kube_sqs_autoscaler_tpu.sim.evaluate import default_battery, score_result
+from kube_sqs_autoscaler_tpu.sim.scenarios import BurstArrival, RampArrival
+
+
+def short_loop(poll=5.0):
+    return LoopConfig(
+        poll_interval=poll,
+        policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=10,
+            scale_up_cooldown=10.0, scale_down_cooldown=30.0,
+        ),
+    )
+
+
+def test_fidelity_full_battery_reactive_and_all_forecasters():
+    # The acceptance gate itself: 4 scenarios x (reactive + ewma + holt +
+    # lstsq), tick-for-tick.  Any divergence message is the test output.
+    report = verify_fidelity()
+    assert report.episodes == 16
+    assert report.ticks == 16 * 180
+    assert report.ok, "\n".join(report.format_divergences(20))
+
+
+def test_fidelity_covers_nondefault_sweep_knobs():
+    # The sweep tunes thresholds/cooldowns/scale-step/horizon/history —
+    # none of which the default battery episodes vary.  Pin the compiled
+    # twin on a sample of that region (including a mixed history
+    # capacity, which forces a second compiled batch) so a semantic
+    # drift confined to a non-default knob cannot hide from the gate.
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint
+
+    scenarios = default_battery()[:2]  # step + ramp keep this fast
+    points = [
+        SweepPoint(scale_up_messages=50, scale_up_cooldown=20.0,
+                   scale_up_pods=2, policy="holt", horizon=45.0),
+        SweepPoint(scale_up_messages=200, scale_down_messages=20,
+                   scale_down_cooldown=60.0, policy="reactive"),
+        SweepPoint(scale_up_pods=3, policy="lstsq", horizon=15.0,
+                   history=64),
+        SweepPoint(scale_up_messages=50, policy="ewma", horizon=15.0),
+    ]
+    extra = [
+        (f"{scenario.name}/{point.label()}", point.to_config(scenario))
+        for scenario in scenarios
+        for point in points
+    ]
+    report = verify_fidelity(
+        scenarios=scenarios, forecasters=(), extra_episodes=extra
+    )
+    assert report.episodes == 2 + len(extra)
+    assert report.ok, "\n".join(report.format_divergences(20))
+
+
+def test_fidelity_report_formats_divergences_with_episode_labels():
+    from kube_sqs_autoscaler_tpu.sim.compiled import FidelityReport
+    from kube_sqs_autoscaler_tpu.sim.replay import Divergence
+
+    report = FidelityReport(
+        episodes=1,
+        ticks=3,
+        divergences=[("ramp/reactive", Divergence(2, "up", "fire", "idle"))],
+    )
+    assert not report.ok
+    lines = report.format_divergences()
+    assert lines == [
+        "ramp/reactive: tick 2: up recorded='fire' replayed='idle'"
+    ]
+
+
+def test_seed_constant_world_matches_python_exactly():
+    # The seed's plain-float arrival_rate path uses its own net-rate
+    # expression; the compiled twin must reproduce its timeline
+    # sample-for-sample, including float times and int depths.
+    config = SimConfig(
+        arrival_rate=120.0, service_rate_per_replica=10.0, duration=400.0,
+        initial_replicas=1, max_pods=50, loop=short_loop(poll=1.0),
+    )
+    python = Simulation(config).run()
+    compiled = run_compiled_one(config)
+    assert compiled.timeline == python.timeline
+    assert compiled.final_replicas == python.final_replicas
+    assert compiled.max_depth == python.max_depth
+    assert compiled.ticks == python.ticks
+
+
+def test_compiled_result_scores_like_the_battery():
+    scenario = default_battery()[0]
+    config = SimConfig(
+        arrival_rate=scenario.arrival,
+        service_rate_per_replica=scenario.service_rate_per_replica,
+        duration=scenario.duration,
+        initial_replicas=scenario.initial_replicas,
+        min_pods=scenario.min_pods,
+        max_pods=scenario.max_pods,
+        loop=scenario.loop,
+    )
+    python_row = score_result(Simulation(config).run(), scenario.slo_depth)
+    compiled_row = score_result(run_compiled_one(config), scenario.slo_depth)
+    assert compiled_row == python_row
+
+
+def test_recorded_arrival_from_a_journal_sweeps_through_compiled():
+    # Host-side arrival precomputation means ANY ArrivalProcess works —
+    # including the piecewise process replay infers from a flight journal,
+    # closing the loop from incident journal to compiled parameter sweep.
+    from kube_sqs_autoscaler_tpu.sim.replay import RecordedArrival
+
+    arrival = RecordedArrival(
+        times=(0.0, 50.0, 100.0), rates=(20.0, 150.0, 30.0)
+    )
+    config = SimConfig(
+        arrival_rate=arrival, service_rate_per_replica=10.0, duration=300.0,
+        initial_replicas=2, max_pods=20, loop=short_loop(),
+    )
+    python = Simulation(config).run()
+    compiled = run_compiled_one(config)
+    assert compiled.timeline == python.timeline
+    assert compiled.final_replicas == python.final_replicas
+
+
+def test_predictive_compiled_episode_matches_python_on_a_short_ramp():
+    config = SimConfig(
+        arrival_rate=RampArrival(
+            start_rate=10.0, end_rate=150.0, t_start=30.0, t_end=300.0
+        ),
+        service_rate_per_replica=10.0, duration=300.0,
+        initial_replicas=1, max_pods=25, loop=short_loop(),
+        policy="predictive", forecaster="holt", forecast_horizon=30.0,
+        forecast_history=64,
+    )
+    python = Simulation(config).run()
+    compiled = run_compiled_one(config)
+    assert compiled.timeline == python.timeline
+    assert compiled.final_replicas == python.final_replicas
+
+
+def test_batch_rejects_mixed_tick_counts_and_capacities():
+    base = dict(
+        arrival_rate=50.0, service_rate_per_replica=10.0,
+        initial_replicas=1, loop=short_loop(),
+    )
+    with pytest.raises(ValueError, match="tick count"):
+        run_compiled([
+            SimConfig(duration=300.0, **base),
+            SimConfig(duration=600.0, **base),
+        ])
+    with pytest.raises(ValueError, match="forecast_history"):
+        run_compiled([
+            SimConfig(duration=300.0, forecast_history=64, **base),
+            SimConfig(duration=300.0, forecast_history=128, **base),
+        ])
+
+
+def test_encode_rejects_unknown_policy_and_forecaster():
+    base = dict(arrival_rate=50.0, duration=100.0, loop=short_loop())
+    with pytest.raises(ValueError, match="policy"):
+        encode_config(SimConfig(policy="quantum", **base))
+    with pytest.raises(ValueError, match="forecaster"):
+        encode_config(
+            SimConfig(policy="predictive", forecaster="oracle", **base)
+        )
+
+
+def test_episode_ticks_matches_simulation_run():
+    config = SimConfig(arrival_rate=10.0, duration=42.0, loop=short_loop())
+    assert episode_ticks(config) == Simulation(config).run().ticks
+
+
+def test_compiled_episode_exposes_gate_enums():
+    from kube_sqs_autoscaler_tpu.core.policy import Gate
+
+    config = SimConfig(
+        arrival_rate=BurstArrival(
+            base=20.0, burst_rate=200.0, period=120.0, burst_len=30.0,
+            first_burst=30.0,
+        ),
+        service_rate_per_replica=10.0, duration=300.0,
+        initial_replicas=1, max_pods=20, loop=short_loop(),
+    )
+    (episode,) = run_episodes([config])
+    gates = {episode.gates(i) for i in range(len(episode.observed))}
+    ups = {up for up, _ in gates}
+    assert Gate.FIRE in ups  # the burst must trip the up gate
+    assert all(isinstance(up, Gate) and isinstance(dn, Gate)
+               for up, dn in gates)

@@ -98,6 +98,31 @@ def test_forecasters_are_jit_compiled():
         assert hasattr(fn, "lower")
 
 
+def test_pure_step_functions_match_their_jitted_wrappers():
+    # sim/compiled.py inlines the pure functions inside its episode scan;
+    # the live path calls the jitted wrappers.  Same function object
+    # underneath, same numbers out — the compiled sim's fidelity gate
+    # leans on this equivalence.
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.forecast import forecasters
+
+    times64, depths64, n = linear_history(n=20, slope=4.0)
+    times = jnp.asarray(times64 - times64[n - 1])
+    depths = jnp.asarray(depths64)
+    pairs = [
+        (forecasters.ewma_level(depths, n, 0.3),
+         forecasters._ewma_level(depths, n, 0.3)),
+        (forecasters.holt_forecast(times, depths, n, 30.0, 0.5, 0.3),
+         forecasters._holt_forecast(times, depths, n, 30.0, 0.5, 0.3)),
+        (forecasters.lstsq_forecast(times, depths, n, 30.0, 12),
+         forecasters._lstsq_forecast(times, depths, n, 30.0, 12)),
+    ]
+    for pure, jitted in pairs:
+        assert np.asarray(pure) == np.asarray(jitted)
+
+
 def test_lstsq_is_exact_on_a_linear_trend():
     times, depths, n = linear_history(slope=4.0)
     pred = LeastSquaresForecaster(window=8).predict(times, depths, n, 30.0)

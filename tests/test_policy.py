@@ -147,3 +147,54 @@ def test_property_up_gate_matches_reference_predicate():
             assert plan.down is expect_down
         else:
             assert plan.down is Gate.IDLE
+
+
+def test_gate_code_is_the_gates_shared_core():
+    # gate_up/gate_down delegate to the branchless gate_code (the compiled
+    # simulator runs the same function inside lax.scan); sweep random and
+    # boundary cases to pin the delegation
+    from kube_sqs_autoscaler_tpu.core.policy import (
+        GATE_BY_CODE,
+        gate_code,
+        gate_down,
+        gate_up,
+    )
+
+    rng = random.Random(13)
+    for _ in range(500):
+        num = rng.choice([0, 9, 10, 11, 99, 100, 101, rng.randrange(0, 500)])
+        now = rng.uniform(0.0, 200.0)
+        if rng.random() < 0.3:  # land exactly on cooldown boundaries too
+            now = round(now)
+        state = PolicyState(
+            last_scale_up=now - rng.choice([0.0, 5.0, 10.0, 50.0]),
+            last_scale_down=now - rng.choice([0.0, 15.0, 30.0, 90.0]),
+        )
+        up_code = gate_code(
+            num >= CFG.scale_up_messages, now, state.last_scale_up,
+            CFG.scale_up_cooldown,
+        )
+        down_code = gate_code(
+            num <= CFG.scale_down_messages, now, state.last_scale_down,
+            CFG.scale_down_cooldown,
+        )
+        assert gate_up(num, now, CFG, state) is GATE_BY_CODE[int(up_code)]
+        assert gate_down(num, now, CFG, state) is GATE_BY_CODE[int(down_code)]
+
+
+def test_gate_code_works_elementwise_on_arrays():
+    # the scan-ability contract: numpy arrays in, coded outcomes out
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.core.policy import (
+        GATE_COOLING,
+        GATE_FIRE,
+        GATE_IDLE,
+        gate_code,
+    )
+
+    nums = np.array([50, 150, 150])
+    met = nums >= 100
+    last = np.array([0.0, 0.0, 95.0])
+    codes = gate_code(met, 100.0, last, 10.0)
+    assert codes.tolist() == [GATE_IDLE, GATE_FIRE, GATE_COOLING]

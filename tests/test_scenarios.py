@@ -5,6 +5,8 @@ constant-rate seed behavior.
 
 import pytest
 
+from tests.proptest import given, settings, st
+
 from kube_sqs_autoscaler_tpu.core.loop import LoopConfig
 from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
 from kube_sqs_autoscaler_tpu.sim import (
@@ -48,6 +50,76 @@ def test_analytic_integral_matches_quadrature(process, interval):
     exact = process.arrivals_between(t0, t1)
     approx = numeric_integral(process, t0, t1)
     assert exact == pytest.approx(approx, rel=1e-4, abs=1e-3)
+
+
+def trapezoid_integral(process, t0, t1, steps=4000):
+    """Composite trapezoid rule over ``rate_at`` — an independent check of
+    the analytic ``arrivals_between`` forms the *compiled* world consumes
+    verbatim (sim/compiled.py precomputes per-tick arrivals from these
+    exact functions, so this property covers both worlds)."""
+    if t1 <= t0:
+        return 0.0
+    dt = (t1 - t0) / steps
+    total = 0.5 * (process.rate_at(t0) + process.rate_at(t1))
+    total += sum(process.rate_at(t0 + i * dt) for i in range(1, steps))
+    return total * dt
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    t0=st.floats(min_value=0.0, max_value=1500.0),
+    span=st.floats(min_value=0.1, max_value=900.0),
+    before=st.floats(min_value=0.0, max_value=200.0),
+    after=st.floats(min_value=0.0, max_value=300.0),
+    at=st.floats(min_value=10.0, max_value=1000.0),
+    base=st.floats(min_value=50.0, max_value=150.0),
+    amp_frac=st.floats(min_value=0.0, max_value=1.0),
+    period=st.floats(min_value=30.0, max_value=900.0),
+    burst_len_frac=st.floats(min_value=0.05, max_value=1.0),
+)
+def test_analytic_integrals_match_trapezoid_on_random_windows(
+    t0, span, before, after, at, base, amp_frac, period, burst_len_frac
+):
+    # Random window x random parameters, all four time-varying shapes:
+    # the property the battery, the Python world, and the compiled world
+    # all lean on is that arrivals_between IS the integral of rate_at.
+    t1 = t0 + span
+    import math
+
+    rate_range = before + after + base
+    dt = span / 4000
+    omega = 2.0 * math.pi / period
+    # Trapezoid error budget per shape: each jump discontinuity costs up
+    # to rate_range * dt (step: 1 edge; burst: 2 per period in-window),
+    # smooth curvature costs span * dt^2 * max|f''| / 12 (diurnal:
+    # max|f''| = amp * omega^2); ramp kinks are continuous (O(dt^2),
+    # covered by the 2x safety factor on the edge bound).
+    edge = rate_range * dt
+    processes = [
+        (StepArrival(before=before, after=after, at=at), 2 * edge),
+        (
+            RampArrival(start_rate=before, end_rate=after, t_start=at,
+                        t_end=at + period),
+            2 * edge,
+        ),
+        (
+            DiurnalArrival(base=base, amplitude=base * amp_frac,
+                           period=period, phase=at),
+            2 * span * dt * dt * (base * amp_frac) * omega * omega / 12,
+        ),
+        (
+            BurstArrival(base=before, burst_rate=before + after,
+                         period=period, burst_len=period * burst_len_frac,
+                         first_burst=at),
+            2 * (2 * (span / period + 2)) * edge,
+        ),
+    ]
+    for process, tol in processes:
+        exact = process.arrivals_between(t0, t1)
+        approx = trapezoid_integral(process, t0, t1)
+        assert exact == pytest.approx(approx, abs=max(tol, 1e-6), rel=1e-6), (
+            type(process).__name__, t0, t1,
+        )
 
 
 def test_rates_are_nonnegative_everywhere():

@@ -81,6 +81,27 @@ def test_cooldowns_bound_scaling_rate():
     assert result.final_replicas <= 7
 
 
+def test_replica_changes_counts_and_is_cached():
+    sim = Simulation(
+        SimConfig(
+            arrival_rate=50.0, service_rate_per_replica=10.0, duration=300.0,
+            initial_replicas=1, max_pods=8, loop=fast_policy(),
+        )
+    )
+    result = sim.run()
+    recount = sum(
+        1
+        for (_, _, a), (_, _, b) in zip(result.timeline, result.timeline[1:])
+        if a != b
+    )
+    assert result.replica_changes == recount
+    assert recount > 0  # the overloaded world must actually have scaled
+    # cached_property contract: the first read is the answer — sweep
+    # scoring reads it once per config and results are frozen once built
+    result.timeline = []
+    assert result.replica_changes == recount
+
+
 def test_bench_prints_single_json_line():
     out = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,

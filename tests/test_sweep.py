@@ -1,0 +1,201 @@
+"""Autotuning sweep driver: grid/sample construction, Pareto math, and
+end-to-end scoring through the compiled simulator."""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.loop import LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+from kube_sqs_autoscaler_tpu.sim.evaluate import Scenario
+from kube_sqs_autoscaler_tpu.sim.scenarios import RampArrival, StepArrival
+from kube_sqs_autoscaler_tpu.sim.sweep import (
+    SweepPoint,
+    SweepReport,
+    SweepSpec,
+    pareto_front,
+    run_sweep,
+)
+
+
+def test_grid_size_and_reactive_dedupe():
+    spec = SweepSpec(
+        scale_up_messages=(50, 100),
+        scale_down_messages=(10,),
+        scale_up_cooldown=(10.0,),
+        scale_down_cooldown=(30.0,),
+        scale_up_pods=(1,),
+        policies=("reactive", "holt"),
+        horizons=(15.0, 45.0),
+        histories=(128,),
+    )
+    grid = spec.grid()
+    # 2 gate combos x (1 reactive + 2 holt horizons) = 6 — reactive must
+    # NOT be multiplied by the horizon axis it ignores
+    assert len(grid) == 6
+    reactive = [p for p in grid if p.policy == "reactive"]
+    assert len(reactive) == 2
+    assert len(set(grid)) == len(grid)  # frozen dataclass: dedupe-able
+
+
+def test_sample_is_seeded_and_subsets_the_grid():
+    spec = SweepSpec()
+    a = spec.sample(10, seed=7)
+    b = spec.sample(10, seed=7)
+    assert a == b
+    assert len(a) == 10
+    assert set(a) <= set(spec.grid())
+    # asking for more than the grid returns the whole grid
+    assert len(spec.sample(10_000)) == len(spec.grid())
+
+
+def test_point_label_distinguishes_policies():
+    reactive = SweepPoint(policy="reactive")
+    holt = SweepPoint(policy="holt", horizon=45.0)
+    assert "reactive" in reactive.label()
+    assert "holt@45s" in holt.label()
+    assert reactive.label() != holt.label()
+
+
+def test_point_to_config_wires_gates_and_forecast():
+    scenario = Scenario(
+        name="t", arrival=StepArrival(before=5.0, after=50.0, at=60.0),
+        duration=300.0,
+    )
+    point = SweepPoint(
+        scale_up_messages=77, scale_up_pods=3, policy="lstsq",
+        horizon=20.0, history=64,
+    )
+    config = point.to_config(scenario)
+    assert config.loop.policy.scale_up_messages == 77
+    assert config.scale_up_pods == 3
+    assert config.policy == "predictive"
+    assert config.forecaster == "lstsq"
+    assert config.forecast_horizon == 20.0
+    assert config.forecast_history == 64
+    reactive_config = SweepPoint(policy="reactive").to_config(scenario)
+    assert reactive_config.policy == "reactive"
+
+
+def test_pareto_front_keeps_only_nondominated():
+    #     y
+    #  4  a          a dominated by c (worse on both)
+    #  2      c   .  c, d, e on the front; b dominated by d
+    #  1        d b
+    #  0          e
+    points = [(3.0, 4.0), (4.0, 1.0), (2.0, 2.0), (3.0, 1.0), (4.0, 0.0)]
+    front = pareto_front(points)
+    assert front == [2, 3, 4]
+
+
+def test_pareto_front_keeps_duplicate_optima():
+    points = [(1.0, 1.0), (1.0, 1.0), (2.0, 2.0)]
+    assert pareto_front(points) == [0, 1]
+
+
+def _tiny_scenarios():
+    loop = LoopConfig(
+        poll_interval=5.0,
+        policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=10,
+            scale_up_cooldown=10.0, scale_down_cooldown=30.0,
+        ),
+    )
+    return (
+        Scenario(
+            name="mini-step",
+            arrival=StepArrival(before=20.0, after=120.0, at=60.0),
+            duration=200.0, max_pods=15, loop=loop,
+        ),
+        Scenario(
+            name="mini-ramp",
+            arrival=RampArrival(
+                start_rate=10.0, end_rate=120.0, t_start=30.0, t_end=180.0
+            ),
+            duration=200.0, max_pods=15, loop=loop,
+        ),
+    )
+
+
+def _tiny_spec():
+    return SweepSpec(
+        scale_up_messages=(50, 100),
+        scale_down_messages=(10,),
+        scale_up_cooldown=(10.0,),
+        scale_down_cooldown=(30.0,),
+        scale_up_pods=(1,),
+        policies=("reactive", "holt"),
+        horizons=(30.0,),
+        histories=(64,),
+    )
+
+
+def test_run_sweep_scores_every_scenario_point_pair():
+    scenarios = _tiny_scenarios()
+    report = run_sweep(_tiny_spec(), scenarios)
+    assert report.points == 2 * 4  # 2 scenarios x 4 grid points
+    names = {row["scenario"] for row in report.rows}
+    assert names == {"mini-step", "mini-ramp"}
+    for row in report.rows:
+        assert set(row["score"]) >= {
+            "max_depth", "time_over_slo_s", "replica_changes",
+        }
+
+
+def test_run_sweep_summary_has_best_and_pareto_per_scenario():
+    report = run_sweep(_tiny_spec(), _tiny_scenarios())
+    summary = report.summary()
+    assert summary["points"] == report.points
+    assert set(summary["best"]) == {"mini-step", "mini-ramp"}
+    for name, front in summary["pareto"].items():
+        assert front, name
+        best = summary["best"][name]
+        # the best config is on its scenario's Pareto front by definition
+        assert best["config"] in {row["config"] for row in front}
+
+
+def test_best_ranking_prefers_depth_then_churn():
+    report = SweepReport(rows=[
+        {"scenario": "s", "label": "deep", "point": {},
+         "score": {"max_depth": 500.0, "replica_changes": 1,
+                   "time_over_slo_s": 0.0}},
+        {"scenario": "s", "label": "calm", "point": {},
+         "score": {"max_depth": 100.0, "replica_changes": 9,
+                   "time_over_slo_s": 0.0}},
+        {"scenario": "s", "label": "churny", "point": {},
+         "score": {"max_depth": 100.0, "replica_changes": 30,
+                   "time_over_slo_s": 0.0}},
+    ])
+    assert report.best_per_scenario()["s"]["label"] == "calm"
+
+
+def test_run_sweep_rejects_empty_points():
+    with pytest.raises(ValueError):
+        run_sweep([], _tiny_scenarios())
+
+
+def test_run_sweep_groups_mixed_histories_into_separate_batches():
+    # Points with different history capacities cannot share one compiled
+    # batch (the capacity is a compiled shape); the driver must group
+    # them transparently rather than error.
+    points = [
+        SweepPoint(policy="holt", history=32),
+        SweepPoint(policy="holt", history=64),
+        SweepPoint(policy="reactive"),
+    ]
+    report = run_sweep(points, _tiny_scenarios()[:1])
+    assert report.points == 3
+
+
+@pytest.mark.slow
+def test_default_grid_full_battery_sweep():
+    # The bench-sweep operating point: the full default grid over the full
+    # battery, >= 100 scenario-config points, one compiled batch.
+    report = run_sweep(SweepSpec())
+    assert report.points >= 100
+    summary = report.summary()
+    assert set(summary["best"]) == {"step", "ramp", "diurnal", "burst"}
+    # a tuned configuration must never lose to every other point: each
+    # scenario's best is on that scenario's Pareto front
+    for name, front in summary["pareto"].items():
+        assert summary["best"][name]["config"] in {
+            row["config"] for row in front
+        }
