@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep replay-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos replay-demo chaos-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -55,10 +55,26 @@ bench-replay:
 bench-sweep:
 	JAX_PLATFORMS=cpu python bench.py --suite sweep
 
+# Chaos battery (no JAX, seconds): resilient vs reference failure
+# handling on identical worlds under identical deterministic faults
+# (metric blackout, flaky calls, actuation outage, latency spikes);
+# exits non-zero unless the resilient configuration wins at least one
+# fault scenario AND is invisible on the healthy ones; writes
+# BENCH_r09.json
+bench-chaos:
+	python bench.py --suite chaos
+
 # The fidelity gate alone (no JAX, seconds): record a short simulated
 # episode, replay it, fail on any decision divergence
 replay-demo:
 	python -m kube_sqs_autoscaler_tpu.sim.replay
+
+# Deterministic FakeClock episode through a correlated outage (no JAX,
+# seconds): metric retries burn, the stale-depth hold engages then
+# expires to fail-static, the circuit breaker opens and re-closes via a
+# half-open probe, the fleet recovers — exits 2 on any missing milestone
+chaos-demo:
+	python -m kube_sqs_autoscaler_tpu.sim.faults
 
 # TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
 # the real chip; writes WORKBENCH.json

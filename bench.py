@@ -30,6 +30,13 @@ production loop from it and fail on any decision divergence
 counterfactually re-score the same recorded episode under reactive +
 every forecaster; writes ``BENCH_r07.json``.
 
+``--suite chaos`` scores the resilience layer (`core/resilience.py`)
+against the reference's log-and-skip failure handling: identical worlds
+under identical deterministic faults (`sim/faults.py` — metric
+blackouts, flaky calls, actuation outages, latency spikes), scored on
+the same battery numbers; writes ``BENCH_r09.json``.  JAX-free like the
+default suite (both configurations run the reactive policy).
+
 ``--suite sweep`` drives the compiled closed-loop simulator
 (`sim/compiled.py`): first the fidelity gate (`verify_fidelity` — the
 compiled `lax.scan` episodes must reproduce the real-`ControlLoop` sim
@@ -217,6 +224,62 @@ def run_forecast_suite(output: str = "BENCH_r06.json") -> dict:
         "value": round(winner_depth, 1),
         "unit": "messages (ramp+diurnal, winner=" + winner + ")",
         "vs_baseline": round(reactive_depth / max(winner_depth, 1e-9), 2),
+    }
+
+
+def run_chaos_suite(output: str = "BENCH_r09.json") -> dict:
+    """The chaos battery as a self-checking benchmark + artifact.
+
+    Two hard gates mirror the acceptance criteria: the resilient
+    configuration must strictly beat the reference on at least one fault
+    scenario, and must not change ANYTHING on the no-fault control
+    scenarios (on a healthy world the resilience layer is invisible).
+    Either violation exits 2.  The headline is the biggest win: the
+    reference-vs-resilient max-depth ratio on the best fault scenario.
+    """
+    from kube_sqs_autoscaler_tpu.sim.evaluate import (
+        evaluate_chaos,
+        summarize_chaos,
+    )
+
+    start = time.perf_counter()
+    report = evaluate_chaos()
+    summary = summarize_chaos(report)
+    elapsed = time.perf_counter() - start
+    if not summary["resilient_wins"]:
+        print("chaos: resilient configuration won no fault scenario",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if summary["no_fault_regressions"]:
+        print(
+            "chaos: resilience changed behavior on healthy scenarios: "
+            + ", ".join(summary["no_fault_regressions"]),
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    best = max(
+        summary["resilient_wins"],
+        key=lambda n: summary["deltas"][n]["max_depth_reduction"],
+    )
+    ref_depth = report[best]["reference"]["max_depth"]
+    res_depth = report[best]["resilient"]["max_depth"]
+    artifact = {
+        "suite": "chaos",
+        "elapsed_s": round(elapsed, 2),
+        "report": report,
+        "summary": summary,
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    return {
+        "metric": "chaos_best_max_depth",
+        "value": round(res_depth, 1),
+        "unit": (
+            f"messages ({best}; wins={len(summary['resilient_wins'])},"
+            " no-fault regressions=0)"
+        ),
+        "vs_baseline": round(ref_depth / max(res_depth, 1e-9), 2),
     }
 
 
@@ -422,17 +485,21 @@ def run_sweep_suite(output: str = "BENCH_r08.json") -> dict:
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
-        "--suite", choices=("controller", "forecast", "replay", "sweep"),
+        "--suite",
+        choices=("controller", "forecast", "replay", "sweep", "chaos"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
         " record/replay fidelity + counterfactual re-scoring; sweep ="
-        " compiled-simulator fidelity gate + autotuning parameter sweep",
+        " compiled-simulator fidelity gate + autotuning parameter sweep;"
+        " chaos = resilient-vs-reference failure handling under"
+        " deterministic fault injection",
     )
     cli.add_argument(
         "--output", default="",
-        help="artifact path for --suite forecast/replay/sweep (defaults:"
-        " BENCH_r06.json / BENCH_r07.json / BENCH_r08.json)",
+        help="artifact path for --suite forecast/replay/sweep/chaos"
+        " (defaults: BENCH_r06.json / BENCH_r07.json / BENCH_r08.json /"
+        " BENCH_r09.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -441,5 +508,7 @@ if __name__ == "__main__":
         print(json.dumps(run_replay_suite(cli_args.output or "BENCH_r07.json")))
     elif cli_args.suite == "sweep":
         print(json.dumps(run_sweep_suite(cli_args.output or "BENCH_r08.json")))
+    elif cli_args.suite == "chaos":
+        print(json.dumps(run_chaos_suite(cli_args.output or "BENCH_r09.json")))
     else:
         print(json.dumps(run_bench()))

@@ -42,6 +42,7 @@ from typing import Sequence
 
 from .core.loop import ControlLoop, LoopConfig
 from .core.policy import PolicyConfig
+from .core.resilience import ResilienceConfig
 from .metrics.queue import (
     DEFAULT_ATTRIBUTE_NAMES_CSV,
     QueueMetricSource,
@@ -176,7 +177,86 @@ def build_parser() -> argparse.ArgumentParser:
         "--forecast-history", type=_history_size, default=128,
         help="Depth observations kept for forecasting (ring buffer size)",
     )
+    # Resilience layer (core/resilience.py): retries, per-call deadlines,
+    # circuit breaker, stale-depth hold.  Every default is the reference's
+    # log-and-skip behavior; each flag opts one mechanism in.
+    parser.add_argument(
+        "--metric-retries", type=_retry_count, default=0, metavar="N",
+        help=(
+            "Extra attempts per queue-depth poll, with seeded jittered "
+            "exponential backoff budgeted within the poll period "
+            "(0 = reference: one attempt, failures skip the tick)"
+        ),
+    )
+    parser.add_argument(
+        "--metric-timeout", type=parse_duration, default=0.0,
+        metavar="DURATION",
+        help=(
+            "Per-attempt deadline for queue-depth polls; a poll returning "
+            "later counts as failed (0 = no deadline)"
+        ),
+    )
+    parser.add_argument(
+        "--scaler-retries", type=_retry_count, default=0, metavar="N",
+        help=(
+            "Extra attempts per scale actuation, same backoff policy "
+            "(0 = reference: one attempt, failures end the tick)"
+        ),
+    )
+    parser.add_argument(
+        "--scaler-timeout", type=parse_duration, default=0.0,
+        metavar="DURATION",
+        help=(
+            "Per-attempt deadline for scale actuations; a call returning "
+            "later counts as failed (0 = no deadline)"
+        ),
+    )
+    parser.add_argument(
+        "--breaker-failures", type=int, default=0, metavar="N",
+        help=(
+            "Open a circuit breaker around the scaler after N consecutive "
+            "actuation failures — further fires fail fast without the RPC "
+            "until a half-open probe succeeds (0 = no breaker)"
+        ),
+    )
+    parser.add_argument(
+        "--breaker-reset", type=parse_duration, default=60.0,
+        metavar="DURATION",
+        help=(
+            "How long the breaker stays open before admitting one "
+            "half-open probe (success re-closes, failure re-opens)"
+        ),
+    )
+    parser.add_argument(
+        "--stale-depth-ttl", type=parse_duration, default=0.0,
+        metavar="DURATION",
+        help=(
+            "On a failed poll, reuse the last good queue depth up to this "
+            "age (the tick proceeds marked stale; forecasters never see "
+            "held depths); past the TTL the tick skips like the reference "
+            "(0 = never hold)"
+        ),
+    )
+    parser.add_argument(
+        "--healthz-stale-after", type=parse_duration, default=0.0,
+        metavar="DURATION",
+        help=(
+            "/healthz turns 503 when no tick has completed for this long "
+            "(0 = always 200 while serving; needs --metrics-port)"
+        ),
+    )
     return parser
+
+
+def _retry_count(value: str) -> int:
+    """Retry flags: a usage error below 0, like every other flag
+    (RetryPolicy would reject it later with a raw traceback otherwise)."""
+    count = int(value)
+    if count < 0:
+        raise argparse.ArgumentTypeError(
+            f"retry count must be >= 0, got {count}"
+        )
+    return count
 
 
 def _history_size(value: str) -> int:
@@ -202,10 +282,44 @@ def config_from_args(args: argparse.Namespace) -> LoopConfig:
     )
 
 
+def resilience_from_args(args: argparse.Namespace) -> ResilienceConfig:
+    """The resilience flags as one config (``enabled`` False at defaults,
+    so the loop keeps the reference code path)."""
+    return ResilienceConfig(
+        metric_retries=args.metric_retries,
+        metric_timeout=args.metric_timeout,
+        scaler_retries=args.scaler_retries,
+        scaler_timeout=args.scaler_timeout,
+        breaker_failures=args.breaker_failures,
+        breaker_reset=args.breaker_reset,
+        stale_depth_ttl=args.stale_depth_ttl,
+    )
+
+
+def validate_flag_interactions(parser: argparse.ArgumentParser,
+                               args: argparse.Namespace) -> None:
+    """Cross-flag checks argparse types cannot express.
+
+    The loop is sleep-first: ``seconds_since_last_tick`` legitimately
+    grows to a full poll period between ticks, so a staleness threshold
+    at or below the poll period would 503 a perfectly healthy controller
+    for most of every interval (and restart-loop the pod).
+    """
+    if 0 < args.healthz_stale_after <= args.poll_period:
+        parser.error(
+            f"--healthz-stale-after ({args.healthz_stale_after:g}s) must "
+            f"exceed --poll-period ({args.poll_period:g}s): the loop "
+            "completes at most one tick per poll period, so a healthy "
+            "controller would fail the probe between ticks"
+        )
+
+
 def main(argv: Sequence[str] | None = None) -> None:
     """Wire real clients and run forever (``main.go:82-116``)."""
     configure_logging()
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    validate_flag_interactions(parser, args)
 
     # Imports deferred so the pure-control-plane modules (policy/loop/fakes)
     # never pull in the real-client stacks, mirroring the package split.
@@ -247,7 +361,12 @@ def main(argv: Sequence[str] | None = None) -> None:
         if args.journal_ring > 0:
             ring = TickRing(args.journal_ring)
             observers.append(ring)
-        server = ObservabilityServer(metrics, port=args.metrics_port, ring=ring)
+        server = ObservabilityServer(
+            metrics,
+            port=args.metrics_port,
+            ring=ring,
+            unhealthy_after=args.healthz_stale_after,
+        )
         server.start()
     if args.journal_path:
         from .obs import TickJournal
@@ -288,6 +407,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         config_from_args(args),
         observer=observer,
         depth_policy=depth_policy,
+        resilience=resilience_from_args(args),
     )
 
     # Extension over the reference (which runs until killed): exit cleanly
@@ -346,6 +466,22 @@ def _journal_meta(args: argparse.Namespace) -> dict:
                 "history": args.forecast_history,
             }
             if args.policy == "predictive"
+            else {}
+        ),
+        # enabled resilience knobs only (empty = reference failure
+        # handling) — lets a journal reader see whether stale/retry/
+        # breaker fields can appear in this episode's tick lines
+        "resilience": (
+            {
+                "metric_retries": args.metric_retries,
+                "metric_timeout": args.metric_timeout,
+                "scaler_retries": args.scaler_retries,
+                "scaler_timeout": args.scaler_timeout,
+                "breaker_failures": args.breaker_failures,
+                "breaker_reset": args.breaker_reset,
+                "stale_depth_ttl": args.stale_depth_ttl,
+            }
+            if resilience_from_args(args).enabled
             else {}
         ),
         "deployment": args.kubernetes_deployment,

@@ -1,4 +1,4 @@
-"""Core: clock abstraction, pure scaling policy, and the control loop."""
+"""Core: clock, pure scaling policy, control loop, and resilience layer."""
 
 from .clock import Clock, FakeClock, SystemClock
 from .policy import (
@@ -8,6 +8,15 @@ from .policy import (
     TickPlan,
     initial_state,
     plan_tick,
+)
+from .resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    ResilienceConfig,
+    ResiliencePolicy,
+    RetryPolicy,
+    call_with_deadline,
 )
 
 __all__ = [
@@ -20,4 +29,11 @@ __all__ = [
     "TickPlan",
     "initial_state",
     "plan_tick",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceeded",
+    "ResilienceConfig",
+    "ResiliencePolicy",
+    "RetryPolicy",
+    "call_with_deadline",
 ]

@@ -52,6 +52,21 @@ class TickRecord:
       RPCs) for the flight recorder's trace export — ``actuate_s`` stays
       ``None`` when no gate fired, ``decide_s`` when the tick ended at the
       observation.  All zero under a ``FakeClock``.
+
+    Resilience extension fields (``core/resilience.py``; all ``None`` —
+    and therefore absent from journal lines — unless the opt-in layer
+    produced them):
+
+    - ``stale`` is ``True`` when the poll failed but the tick proceeded
+      on the last good depth within the stale TTL (``num_messages`` is
+      that *held* depth, ``metric_error`` stays ``None`` so gate
+      accounting and replay treat the tick as a normal observation;
+      ``stale_age_s`` is the held observation's age);
+    - ``metric_retries``/``scaler_retries`` count *extra* attempts the
+      retry policy spent this tick (absent when the first try sufficed);
+    - ``breaker_state`` is the circuit breaker's state after the tick
+      (``closed``/``half_open``/``open``), present only when a breaker
+      is configured.
     """
 
     start: float
@@ -68,6 +83,11 @@ class TickRecord:
     observe_s: float | None = None
     decide_s: float | None = None
     actuate_s: float | None = None
+    stale: bool | None = None
+    stale_age_s: float | None = None
+    metric_retries: int | None = None
+    scaler_retries: int | None = None
+    breaker_state: str | None = None
 
     def scaled(self, direction: str) -> bool:
         """Did this tick successfully actuate in ``direction`` ("up"/"down")?

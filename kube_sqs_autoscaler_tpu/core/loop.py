@@ -41,6 +41,7 @@ from .policy import (
     mark_scaled_down,
     mark_scaled_up,
 )
+from .resilience import ResilienceConfig, ResiliencePolicy
 from .types import DepthPolicy, MetricSource, Scaler
 
 log = logging.getLogger(__name__)
@@ -65,6 +66,7 @@ class ControlLoop:
         clock: Clock | None = None,
         observer: TickObserver | None = None,
         depth_policy: DepthPolicy | None = None,
+        resilience: ResilienceConfig | None = None,
     ) -> None:
         self.scaler = scaler
         self.metric_source = metric_source
@@ -73,6 +75,13 @@ class ControlLoop:
         self.observer = observer
         # None = reference behavior: gates threshold the observed depth.
         self.depth_policy = depth_policy
+        # None / all-defaults = reference behavior: one attempt per RPC,
+        # metric failures fail static, no breaker (core/resilience.py).
+        self.resilience = (
+            ResiliencePolicy(resilience, self.clock, self.config.poll_interval)
+            if resilience is not None and resilience.enabled
+            else None
+        )
         self.ticks = 0  # completed ticks (observability; not used by policy)
         self._stop = threading.Event()
 
@@ -121,6 +130,8 @@ class ControlLoop:
         try:
             return self._tick(state, record)
         finally:
+            if self.resilience is not None:
+                record.breaker_state = self.resilience.breaker_state
             record.duration = self.clock.now() - record.start
             # The decide span is the remainder once observation and scaler
             # time are accounted — defined only for ticks that got past the
@@ -140,10 +151,16 @@ class ControlLoop:
 
     def _actuate(self, record: TickRecord, action) -> str | None:
         """One scaler call with its clock time accumulated into the record's
-        actuate span; returns the error string on failure (tick ends)."""
+        actuate span; returns the error string on failure (tick ends).
+        With a resilience policy the call goes through the circuit breaker,
+        per-call deadline, and retry budget (``core/resilience.py``) — an
+        open breaker fails here without touching the scaler."""
         started = self.clock.now()
         try:
-            action()
+            if self.resilience is not None:
+                self.resilience.actuate(action, record)
+            else:
+                action()
         except Exception as err:
             return str(err)
         finally:
@@ -154,23 +171,50 @@ class ControlLoop:
 
     def _tick(self, state: PolicyState, record: TickRecord) -> PolicyState:
         try:
-            num_messages = self.metric_source.num_messages()
+            if self.resilience is not None:
+                num_messages = self.resilience.observe(
+                    self.metric_source.num_messages, record
+                )
+            else:
+                num_messages = self.metric_source.num_messages()
         except Exception as err:  # the loop must never die (main.go:43-47)
             record.observe_s = self.clock.now() - record.start
-            log.error("Failed to get SQS messages: %s", err)
-            record.metric_error = str(err)
-            return state
-
-        record.observe_s = self.clock.now() - record.start
+            # Degraded mode: within the stale TTL the tick proceeds on the
+            # last good depth (marked stale; the forecaster history skips
+            # it); past the TTL the reference's fail-static skip applies.
+            held = (
+                self.resilience.stale_depth(self.clock.now())
+                if self.resilience is not None
+                else None
+            )
+            if held is None:
+                log.error("Failed to get SQS messages: %s", err)
+                record.metric_error = str(err)
+                return state
+            num_messages, age = held
+            record.stale = True
+            record.stale_age_s = age
+            log.warning(
+                "Metric poll failed (%s); holding last good depth %d"
+                " (age %.1fs of %gs TTL)",
+                err,
+                num_messages,
+                age,
+                self.resilience.config.stale_depth_ttl,
+            )
+        else:
+            record.observe_s = self.clock.now() - record.start
+            log.info("Found %d messages in the queue", num_messages)
         record.num_messages = num_messages
-        log.info("Found %d messages in the queue", num_messages)
 
         # Depth-policy seam: the gates threshold `decision` — the observed
         # depth under the reactive default, the forecasted depth at
         # now + horizon under a predictive policy.  A policy failure falls
-        # back to the observed depth; the loop never dies.
+        # back to the observed depth; the loop never dies.  A stale-held
+        # depth bypasses the policy: forecasting forward from an
+        # observation that is itself old double-counts the staleness.
         decision = num_messages
-        if self.depth_policy is not None:
+        if self.depth_policy is not None and not record.stale:
             try:
                 decision = max(
                     0,

@@ -44,8 +44,14 @@ class DepthHistory:
             self._total += 1
 
     def on_tick(self, record: TickRecord) -> None:
-        """:class:`~..core.events.TickObserver`: record successful reads."""
-        if record.num_messages is not None:
+        """:class:`~..core.events.TickObserver`: record successful reads.
+
+        Stale-held depths (``record.stale``, the resilience layer's
+        degraded mode) are NOT history: they are an old observation
+        replayed at a new timestamp, and feeding them would teach every
+        forecaster that the queue flatlined during the outage.
+        """
+        if record.num_messages is not None and not record.stale:
             self.observe(record.start, float(record.num_messages))
 
     def snapshot(self) -> tuple[np.ndarray, np.ndarray, int]:

@@ -20,6 +20,7 @@ import time
 
 from ..core.events import TickRecord
 from ..core.policy import Gate
+from ..core.resilience import BREAKER_STATE_CODES
 
 _PREFIX = "kube_sqs_autoscaler"
 
@@ -78,19 +79,52 @@ class ControllerMetrics:
         self._scale_failures = {"up": 0, "down": 0}
         self._tick_seconds_sum = 0.0
         self._tick_bucket_counts = [0] * len(TICK_DURATION_BUCKETS)
+        # Resilience layer (core/resilience.py): degradation visibility.
+        self._stale_ticks = 0
+        self._retries = {"metric": 0, "scaler": 0}
+        self._breaker_state: str | None = None
+        self._consecutive_metric_failures = 0
+        self._consecutive_scale_failures = 0
+        self._last_successful_poll: float | None = None  # unix seconds
+        self._last_successful_scale: float | None = None
+        self._last_tick_monotonic: float | None = None
 
     def on_tick(self, record: TickRecord) -> None:
         with self._lock:
             self._ticks += 1
+            self._last_tick_monotonic = time.monotonic()
             self._tick_seconds_sum += record.duration
             for i, le in enumerate(TICK_DURATION_BUCKETS):
                 if record.duration <= le:
                     self._tick_bucket_counts[i] += 1
+            self._retries["metric"] += record.metric_retries or 0
+            self._retries["scaler"] += record.scaler_retries or 0
+            if record.breaker_state is not None:
+                self._breaker_state = record.breaker_state
+            # A stale-held tick IS a failed poll (the hold is the degraded
+            # response to it): the consecutive-failure gauge must climb
+            # through a blackout even while depth holds keep the gates fed.
+            if record.metric_error is not None or record.stale:
+                self._consecutive_metric_failures += 1
+            else:
+                self._consecutive_metric_failures = 0
+                self._last_successful_poll = time.time()
+            if record.stale:
+                self._stale_ticks += 1
             if record.metric_error is not None:
                 self._metric_failures += 1
                 return
-            self._observations += 1
-            self._queue_messages = record.num_messages
+            if not record.stale:
+                # a stale tick proceeded to the gates, but it is NOT a
+                # successful queue read: readiness and the observed-depth
+                # gauge stay pinned to genuinely fresh observations
+                self._observations += 1
+                self._queue_messages = record.num_messages
+            if record.scaled("up") or record.scaled("down"):
+                self._consecutive_scale_failures = 0
+                self._last_successful_scale = time.time()
+            elif record.up_error is not None or record.down_error is not None:
+                self._consecutive_scale_failures += 1
             # unconditional: a tick without a forecast (reactive, warm-up,
             # or a failing depth policy) must CLEAR the forecast gauges —
             # latching the last success would export an arbitrarily stale
@@ -115,6 +149,18 @@ class ControllerMetrics:
         """Readiness = at least one successful queue observation."""
         with self._lock:
             return self._observations > 0
+
+    def seconds_since_last_tick(self) -> float:
+        """Wall seconds since the last completed tick (registry creation
+        before the first one) — the liveness signal behind the server's
+        ``--healthz-stale-after`` staleness threshold."""
+        with self._lock:
+            base = (
+                self._last_tick_monotonic
+                if self._last_tick_monotonic is not None
+                else self._started_monotonic
+            )
+        return time.monotonic() - base
 
     def render(self) -> str:
         """The registry as Prometheus text exposition format 0.0.4."""
@@ -200,6 +246,63 @@ class ControllerMetrics:
                 f"{_PREFIX}_tick_duration_seconds_sum {self._tick_seconds_sum}",
                 f"{_PREFIX}_tick_duration_seconds_count {self._ticks}",
             ]
+            # Resilience layer: degradation made scrapable.  The counters
+            # always render (zero = healthy); the breaker gauge and the
+            # last-success timestamps render once they have a value
+            # (no breaker configured / nothing succeeded yet).
+            lines += [
+                f"# HELP {_PREFIX}_stale_ticks_total Ticks that proceeded"
+                " on a held (stale) queue depth after a failed poll.",
+                f"# TYPE {_PREFIX}_stale_ticks_total counter",
+                f"{_PREFIX}_stale_ticks_total {self._stale_ticks}",
+                f"# HELP {_PREFIX}_retries_total Extra RPC attempts spent"
+                " by the retry policy.",
+                f"# TYPE {_PREFIX}_retries_total counter",
+            ]
+            lines += [
+                f'{_PREFIX}_retries_total{{call="{call}"}} {count}'
+                for call, count in self._retries.items()
+            ]
+            lines += [
+                f"# HELP {_PREFIX}_consecutive_metric_failures Failed polls"
+                " (incl. stale holds) since the last fresh observation.",
+                f"# TYPE {_PREFIX}_consecutive_metric_failures gauge",
+                f"{_PREFIX}_consecutive_metric_failures"
+                f" {self._consecutive_metric_failures}",
+                f"# HELP {_PREFIX}_consecutive_scale_failures Failed"
+                " actuations since the last successful one.",
+                f"# TYPE {_PREFIX}_consecutive_scale_failures gauge",
+                f"{_PREFIX}_consecutive_scale_failures"
+                f" {self._consecutive_scale_failures}",
+                f"# HELP {_PREFIX}_breaker_state Scaler circuit breaker"
+                " state (0=closed, 1=half_open, 2=open).",
+                f"# TYPE {_PREFIX}_breaker_state gauge",
+            ]
+            if self._breaker_state is not None:
+                lines.append(
+                    f"{_PREFIX}_breaker_state"
+                    f" {BREAKER_STATE_CODES[self._breaker_state]}"
+                )
+            lines += [
+                f"# HELP {_PREFIX}_last_successful_poll_timestamp Unix time"
+                " of the last fresh queue observation.",
+                f"# TYPE {_PREFIX}_last_successful_poll_timestamp gauge",
+            ]
+            if self._last_successful_poll is not None:
+                lines.append(
+                    f"{_PREFIX}_last_successful_poll_timestamp"
+                    f" {self._last_successful_poll}"
+                )
+            lines += [
+                f"# HELP {_PREFIX}_last_successful_scale_timestamp Unix"
+                " time of the last successful scale actuation.",
+                f"# TYPE {_PREFIX}_last_successful_scale_timestamp gauge",
+            ]
+            if self._last_successful_scale is not None:
+                lines.append(
+                    f"{_PREFIX}_last_successful_scale_timestamp"
+                    f" {self._last_successful_scale}"
+                )
             build_labels = ",".join(
                 f'{name}="{escape_label_value(value)}"'
                 for name, value in self._build_labels

@@ -5,7 +5,10 @@ deployment relies on Kubernetes restarting a crashed controller pod.  This
 server is the opt-in extension: a stdlib ``ThreadingHTTPServer`` on a daemon
 thread serving
 
-- ``/healthz``  — liveness: 200 while the process serves requests;
+- ``/healthz``  — liveness: 200 while the process serves requests; with
+  ``unhealthy_after > 0`` (``--healthz-stale-after``) it turns 503 once
+  no control-loop tick has completed for that long — a wedged loop (hung
+  RPC, deadlock) gets restarted instead of serving 200 forever;
 - ``/readyz``   — readiness: 503 until the first successful queue
   observation, 200 after (so a probe gates traffic/alerts on "the
   controller can actually see its queue");
@@ -48,11 +51,14 @@ class ObservabilityServer:
         host: str = "0.0.0.0",
         port: int = 8080,
         ring: TickRing | None = None,
+        unhealthy_after: float = 0.0,
     ) -> None:
         self.metrics = metrics
         self.ring = ring
+        self.unhealthy_after = unhealthy_after
         registry = metrics  # close over for the handler class
         tick_ring = ring
+        stale_after = unhealthy_after
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -64,7 +70,21 @@ class ObservabilityServer:
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif url.path == "/healthz":
-                    self._reply(200, "ok\n")
+                    # Tick-progress liveness: a wedged loop must stop
+                    # answering 200 so the orchestrator restarts it.
+                    # Guarded by getattr — WorkloadMetrics registries
+                    # have no tick clock and stay always-healthy.
+                    since = getattr(registry, "seconds_since_last_tick", None)
+                    if stale_after > 0 and since is not None and (
+                        since() > stale_after
+                    ):
+                        self._reply(
+                            503,
+                            f"no tick progress in {since():.0f}s"
+                            f" (threshold {stale_after:g}s)\n",
+                        )
+                    else:
+                        self._reply(200, "ok\n")
                 elif url.path == "/readyz":
                     if registry.ready:
                         self._reply(200, "ok\n")

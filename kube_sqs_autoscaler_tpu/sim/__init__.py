@@ -21,10 +21,11 @@ Python loop here (``verify_fidelity``; see ARCHITECTURE.md "The
 compiled twin").
 """
 
-# NOTE: .replay is intentionally NOT imported here — it is runnable as
-# `python -m kube_sqs_autoscaler_tpu.sim.replay` (the make replay-demo
-# entry), and importing it from the package __init__ would shadow that
-# execution with a second module copy (runpy's sys.modules warning).
+# NOTE: .replay and .faults are intentionally NOT imported here — they
+# are runnable as `python -m kube_sqs_autoscaler_tpu.sim.replay` /
+# `...sim.faults` (the make replay-demo / chaos-demo entries), and
+# importing them from the package __init__ would shadow that execution
+# with a second module copy (runpy's sys.modules warning).
 # .compiled and .sweep are also not imported: they pull in JAX, and this
 # package must stay importable JAX-free (bench.py's default suite).
 from .scenarios import (

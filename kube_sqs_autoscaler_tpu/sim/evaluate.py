@@ -15,6 +15,13 @@ is scored on the three numbers a queue-serving fleet cares about:
 Used by ``bench.py --suite forecast`` (the ``BENCH_r06`` artifact) and the
 acceptance tests; later policies (RL, multi-queue) plug into the same
 battery.
+
+The CHAOS battery (:func:`chaos_battery` / :func:`evaluate_chaos`,
+``bench.py --suite chaos``) reuses the same machinery with a fourth
+input dimension: a deterministic :class:`~.faults.FailureProcess` per
+scenario, scoring the resilience layer (``core/resilience.py``) against
+the reference's log-and-skip failure handling on identical worlds under
+identical faults.
 """
 
 from __future__ import annotations
@@ -23,6 +30,8 @@ from dataclasses import dataclass, field
 
 from ..core.loop import LoopConfig
 from ..core.policy import PolicyConfig
+from ..core.resilience import ResilienceConfig
+from .faults import Blackout, FailureProcess, FlakyCalls, LatencySpikes
 from .scenarios import (
     ArrivalProcess,
     BurstArrival,
@@ -62,6 +71,10 @@ class Scenario:
             ),
         )
     )
+    # Chaos dimension: deterministic fault process injected around the
+    # metric source and scaler (None = healthy world, the forecast
+    # battery's scenarios).
+    faults: FailureProcess | None = None
 
 
 def default_battery() -> tuple[Scenario, ...]:
@@ -221,4 +234,218 @@ def summarize(
         "target_scenarios": list(target_scenarios),
         "churn_budget": churn_budget,
         "candidates": candidates,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Chaos battery: the resilience layer vs. reference failure handling.
+# ---------------------------------------------------------------------------
+
+
+def default_resilience() -> ResilienceConfig:
+    """The battery's resilient configuration.
+
+    Retries absorb per-call flakiness, the stale hold bridges metric
+    blackouts (TTL sized to the battery's longest outage), the breaker
+    stops paying a dead API server's latency after 3 straight failures.
+    Timeouts stay off: the post-hoc deadline would convert the latency
+    scenario's *slow successes* into failures — strictly worse than
+    using the data (the deadline knob is for real RPC stacks where slow
+    usually means doomed, and is covered by unit tests).
+    """
+    return ResilienceConfig(
+        metric_retries=2,
+        scaler_retries=1,
+        breaker_failures=3,
+        breaker_reset=30.0,
+        stale_depth_ttl=300.0,
+    )
+
+
+def chaos_battery() -> tuple[Scenario, ...]:
+    """Five worlds: one healthy control + four fault shapes.
+
+    Every fault window opens *after* the demand shift has pushed the
+    observed depth through the scale-up threshold, so the stale hold has
+    a meaningful observation to bridge with — the incident shape that
+    matters (an outage during quiet hours strands nothing).
+    """
+    return (
+        Scenario(
+            name="calm",
+            # the no-fault control: any resilient-vs-reference difference
+            # here is a regression by definition
+            arrival=StepArrival(before=20.0, after=120.0, at=120.0),
+        ),
+        Scenario(
+            name="metric-blackout",
+            # monitoring dies for 5 minutes in the middle of a launch
+            # ramp: reference freezes scaling; the stale hold keeps
+            # climbing toward the last observed backlog
+            arrival=StepArrival(before=20.0, after=120.0, at=120.0),
+            faults=Blackout(start=150.0, duration=300.0, metric=True),
+        ),
+        Scenario(
+            name="flaky-metric",
+            # 35% of polls fail all episode long during organic growth:
+            # reference loses a third of its decisions, retries recover
+            # nearly all of them
+            arrival=RampArrival(
+                start_rate=10.0, end_rate=150.0, t_start=60.0, t_end=660.0
+            ),
+            faults=FlakyCalls(failure_rate=0.35, seed=7, metric=True),
+        ),
+        Scenario(
+            name="actuation-outage",
+            # the apiserver is down AND slow (3 s per failing call) while
+            # demand steps up: reference pays the latency on every fire
+            # attempt; the breaker stops paying after 3
+            arrival=StepArrival(before=20.0, after=120.0, at=120.0),
+            faults=Blackout(
+                start=150.0, duration=250.0, metric=False, scale=True,
+                latency=3.0,
+            ),
+        ),
+        Scenario(
+            name="latency-spikes",
+            # a slow-but-healthy dependency: polls succeed after 2.5 s
+            # inside periodic windows — both configurations should ride
+            # it out identically (no timeouts in default_resilience)
+            arrival=StepArrival(before=20.0, after=120.0, at=120.0),
+            faults=LatencySpikes(
+                period=120.0, spike_len=30.0, delay=2.5, metric=True
+            ),
+        ),
+    )
+
+
+class _ChaosCounters:
+    """TickObserver tallying the resilience layer's per-tick evidence."""
+
+    def __init__(self) -> None:
+        self.metric_failures = 0  # fail-static ticks (no depth at all)
+        self.stale_ticks = 0  # degraded-mode depth holds
+        self.retries = 0  # extra attempts, metric + scaler
+        self.breaker_open_ticks = 0  # ticks ending with the breaker open
+
+    def on_tick(self, record) -> None:
+        if record.metric_error is not None:
+            self.metric_failures += 1
+        if record.stale:
+            self.stale_ticks += 1
+        self.retries += (record.metric_retries or 0) + (
+            record.scaler_retries or 0
+        )
+        if record.breaker_state == "open":
+            self.breaker_open_ticks += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "fail_static_ticks": self.metric_failures,
+            "stale_ticks": self.stale_ticks,
+            "retries": self.retries,
+            "breaker_open_ticks": self.breaker_open_ticks,
+        }
+
+
+def run_chaos_episode(
+    scenario: Scenario,
+    resilience: ResilienceConfig | None = None,
+) -> dict:
+    """One (world × faults × failure-handling) episode → scorecard row.
+
+    ``resilience=None`` is the reference configuration (log-and-skip);
+    the row carries the battery scores plus the chaos counters so the
+    artifact shows *why* a configuration scored as it did.
+    """
+    counters = _ChaosCounters()
+    sim = Simulation(
+        SimConfig(
+            arrival_rate=scenario.arrival,
+            service_rate_per_replica=scenario.service_rate_per_replica,
+            duration=scenario.duration,
+            initial_replicas=scenario.initial_replicas,
+            min_pods=scenario.min_pods,
+            max_pods=scenario.max_pods,
+            loop=scenario.loop,
+            faults=scenario.faults,
+            resilience=resilience,
+        ),
+        extra_observers=(counters,),
+    )
+    result = sim.run()
+    row = score_result(result, scenario.slo_depth)
+    row.update(counters.as_dict())
+    # fault provenance rides the row so summarize_chaos can tell control
+    # scenarios from outage scenarios without trusting names
+    row["faulted"] = scenario.faults is not None
+    return row
+
+
+def evaluate_chaos(
+    scenarios: tuple[Scenario, ...] | None = None,
+    resilience: ResilienceConfig | None = None,
+) -> dict:
+    """Every chaos scenario × {reference, resilient} → nested scorecard."""
+    scenarios = scenarios if scenarios is not None else chaos_battery()
+    resilience = resilience if resilience is not None else default_resilience()
+    report: dict = {}
+    for scenario in scenarios:
+        report[scenario.name] = {
+            "reference": run_chaos_episode(scenario, resilience=None),
+            "resilient": run_chaos_episode(scenario, resilience=resilience),
+        }
+    return report
+
+
+def summarize_chaos(
+    report: dict,
+    no_fault_scenarios: tuple[str, ...] | None = None,
+) -> dict:
+    """Deltas + the two acceptance verdicts.
+
+    ``resilient_wins`` lists fault scenarios where the resilient
+    configuration strictly improved max depth or time-over-SLO;
+    ``no_fault_regressions`` lists control scenarios where it changed
+    *anything* (on a healthy world the resilience layer must be
+    invisible: identical decisions, identical scores).  Control
+    scenarios are identified by the rows' recorded fault provenance
+    (``faulted``, set by :func:`run_chaos_episode`), not by name, so a
+    custom battery's healthy scenarios can never be mis-scored as
+    resilience wins; ``no_fault_scenarios`` overrides the derivation.
+    """
+    if no_fault_scenarios is None:
+        no_fault_scenarios = tuple(
+            name for name, row in report.items()
+            if not row["reference"].get("faulted", True)
+        )
+    deltas: dict = {}
+    wins: list[str] = []
+    regressions: list[str] = []
+    for name, row in report.items():
+        ref, res = row["reference"], row["resilient"]
+        delta = {
+            "max_depth_reduction": round(
+                ref["max_depth"] - res["max_depth"], 1
+            ),
+            "time_over_slo_reduction_s": round(
+                ref["time_over_slo_s"] - res["time_over_slo_s"], 1
+            ),
+            "churn_delta": res["replica_changes"] - ref["replica_changes"],
+        }
+        deltas[name] = delta
+        if name in no_fault_scenarios:
+            if any(ref[k] != res[k] for k in ("max_depth", "time_over_slo_s",
+                                              "replica_changes")):
+                regressions.append(name)
+        elif (
+            delta["max_depth_reduction"] > 0
+            or delta["time_over_slo_reduction_s"] > 0
+        ):
+            wins.append(name)
+    return {
+        "resilient_wins": wins,
+        "no_fault_regressions": regressions,
+        "no_fault_scenarios": list(no_fault_scenarios),
+        "deltas": deltas,
     }

@@ -39,14 +39,19 @@ from ..core.clock import FakeClock
 from ..core.events import MultiObserver, TickObserver, TickRecord
 from ..core.loop import ControlLoop, LoopConfig
 from ..core.policy import PolicyConfig, initial_state
+from ..core.resilience import ResilienceConfig
 from ..core.types import MetricError, ScaleError
 from .simulator import SimConfig, Simulation
 
 #: Record fields whose recorded/replayed values must match tick-for-tick.
+#: ``stale`` is a decision: a held-depth tick proceeds to the gates while
+#: a fail-static tick ends at the observation, and the two must replay as
+#: what they were.
 DECISION_FIELDS = (
     "metric_error",
     "num_messages",
     "decision_messages",
+    "stale",
     "up",
     "down",
     "up_error",
@@ -96,16 +101,27 @@ class ReplayResult:
 
 
 class _ScriptedSource:
-    """MetricSource replaying the journal's observations, one per tick."""
+    """MetricSource replaying the journal's observations, one per tick.
 
-    def __init__(self) -> None:
+    With ``raise_for_stale`` (journals recorded under a stale-depth
+    hold), a recorded-stale tick replays as the *poll failure* it was:
+    the replayed loop's own stale hold then regenerates the held depth
+    from its last fresh observation — the same mechanism, not a
+    transcript of its output.  Without it (reference journals), stale
+    records never appear and the flag is moot.
+    """
+
+    def __init__(self, raise_for_stale: bool = False) -> None:
         self.record: TickRecord | None = None
+        self.raise_for_stale = raise_for_stale
 
     def num_messages(self) -> int:
         record = self.record
         assert record is not None, "arm() must run before each tick"
         if record.metric_error is not None:
             raise MetricError(record.metric_error)
+        if record.stale and self.raise_for_stale:
+            raise MetricError("replayed stale-held poll failure")
         assert record.num_messages is not None
         return record.num_messages
 
@@ -195,6 +211,18 @@ def sim_journal_meta(config: SimConfig) -> dict[str, Any]:
             "min_samples": config.forecast_min_samples,
             "conservative": config.forecast_conservative,
         }
+    if config.resilience is not None and config.resilience.enabled:
+        # replay needs the stale TTL to re-derive held-depth decisions;
+        # the rest documents what could appear in the tick lines
+        meta["resilience"] = {
+            "metric_retries": config.resilience.metric_retries,
+            "metric_timeout": config.resilience.metric_timeout,
+            "scaler_retries": config.resilience.scaler_retries,
+            "scaler_timeout": config.resilience.scaler_timeout,
+            "breaker_failures": config.resilience.breaker_failures,
+            "breaker_reset": config.resilience.breaker_reset,
+            "stale_depth_ttl": config.resilience.stale_depth_ttl,
+        }
     return meta
 
 
@@ -242,6 +270,17 @@ def replay(
     runs, so cooldown arithmetic sees exactly the recorded instants —
     journals from the simulator replay bit-exactly; wall-clock journals
     replay to within the (sub-tick) drift of their in-tick clock reads.
+
+    Journals recorded under a stale-depth hold (``meta["resilience"]``
+    carries ``stale_depth_ttl``) replay the hold through the real
+    mechanism: recorded-stale ticks re-raise as poll failures and the
+    replayed loop's own hold regenerates the held depth, its TTL-expiry
+    decisions, and — critically — the forecaster-history *skip* the live
+    loop applied (feeding held depths to the history would forecast from
+    data the live policy never saw).  Retries/timeouts/breaker are
+    deliberately NOT re-driven (their backoff sleeps would need the live
+    RNG stream replayed draw-for-draw); their in-tick clock consumption
+    falls under the same sub-tick-drift caveat as wall-clock reads.
     """
     records = list(records)
     if not records:
@@ -256,7 +295,10 @@ def replay(
         scale_up_pods=int(world.get("scale_up_pods", 1)),
         scale_down_pods=int(world.get("scale_down_pods", 1)),
     )
-    source = _ScriptedSource()
+    stale_ttl = float(
+        (meta.get("resilience") or {}).get("stale_depth_ttl", 0.0) or 0.0
+    )
+    source = _ScriptedSource(raise_for_stale=stale_ttl > 0)
     depth_policy, history = _depth_policy_from_meta(meta)
     recorder = _Recorder()
     observers: list[TickObserver] = [recorder]
@@ -270,6 +312,11 @@ def replay(
         clock=clock,
         observer=MultiObserver(observers),
         depth_policy=depth_policy,
+        resilience=(
+            ResilienceConfig(stale_depth_ttl=stale_ttl)
+            if stale_ttl > 0
+            else None
+        ),
     )
     state = initial_state(clock.now())
     start_replicas: list[int] = []

@@ -18,7 +18,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import cached_property
-from typing import Sequence
+from typing import TYPE_CHECKING, Sequence
+
+if TYPE_CHECKING:  # annotation-only: the reactive path stays lean
+    from ..core.resilience import ResilienceConfig
+    from .faults import FailureProcess
 
 from ..core.clock import FakeClock
 from ..core.events import MultiObserver, TickObserver
@@ -41,6 +45,13 @@ class SimConfig:
     ``policy`` selects the depth policy the gates threshold through:
     ``"reactive"`` (the reference) or ``"predictive"`` (forecasted depth at
     ``now + forecast_horizon`` via the named ``forecaster``).
+
+    ``faults`` injects a deterministic :class:`~.faults.FailureProcess`
+    around the metric source and scaler (``None`` = healthy world);
+    ``resilience`` hands the loop an opt-in
+    :class:`~..core.resilience.ResilienceConfig` (``None`` = reference
+    failure handling) — the chaos battery (:mod:`.evaluate`) scores the
+    two against each other.
     """
 
     arrival_rate: float | ArrivalProcess = 50.0  # msg/s into the queue
@@ -59,6 +70,8 @@ class SimConfig:
     forecast_history: int = 128  # ring-buffer capacity (samples)
     forecast_min_samples: int = 3  # reactive warm-up before forecasting
     forecast_conservative: bool = True  # gates see max(observed, forecast)
+    faults: "FailureProcess | None" = None  # sim.faults injection
+    resilience: "ResilienceConfig | None" = None  # core.resilience opt-in
 
 
 @dataclass
@@ -147,6 +160,24 @@ class Simulation:
             queue_url="sim://queue",
             attribute_names=("ApproximateNumberOfMessages",),
         )
+        # Fault injection wraps the REAL source/scaler (the system under
+        # test is unchanged); a failing poll still advances the world so
+        # the timeline — and max_depth — track the backlog the controller
+        # could not see.
+        loop_metric_source = self.metric_source
+        loop_scaler = self.scaler
+        if self.config.faults is not None:
+            from .faults import FaultyMetricSource, FaultyScaler
+
+            loop_metric_source = FaultyMetricSource(
+                self.metric_source,
+                self.config.faults,
+                self.clock,
+                on_failure=self.advance_world,
+            )
+            loop_scaler = FaultyScaler(
+                self.scaler, self.config.faults, self.clock
+            )
         depth_policy = None
         observers: list[TickObserver] = list(extra_observers)
         if self.config.policy == "predictive":
@@ -176,12 +207,13 @@ class Simulation:
             observer = MultiObserver(observers)
         self.depth_policy = depth_policy
         self.loop = ControlLoop(
-            self.scaler,
-            self.metric_source,
+            loop_scaler,
+            loop_metric_source,
             self.config.loop,
             clock=self.clock,
             observer=observer,
             depth_policy=depth_policy,
+            resilience=self.config.resilience,
         )
         self.timeline: list[tuple[float, int, int]] = []
         self._max_depth = self.depth

@@ -120,6 +120,14 @@ def main(argv=None) -> None:
              "--model-parallel, --quantize-kv, and --prefix-ids)",
     )
     parser.add_argument(
+        "--length-penalty", type=float, default=0.0, metavar="ALPHA",
+        help="GNMT length normalization for --beams > 1: finished beams "
+             "rank by score / ((5 + len) / 6) ** ALPHA, favoring longer "
+             "continuations as ALPHA grows (0 = raw log-prob ranking; "
+             "applies to the standalone, mesh, and --continuous beam "
+             "paths alike)",
+    )
+    parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
         help="int8: post-training per-channel weight quantization of the "
              "served matmul weights (half the HBM bytes per decode step; "
@@ -185,6 +193,14 @@ def main(argv=None) -> None:
         ):
             if bad:
                 raise SystemExit(f"--beams does not support {flag}")
+    if args.length_penalty < 0.0:
+        raise SystemExit(
+            f"--length-penalty {args.length_penalty} must be >= 0"
+        )
+    if args.length_penalty > 0.0 and args.beams < 2:
+        # fail loudly instead of silently ignoring a dead knob (this was
+        # exactly the bug: the config existed but nothing consumed it)
+        raise SystemExit("--length-penalty requires --beams > 1")
     if args.quantize_kv and args.generate_tokens < 1:
         raise SystemExit("--quantize-kv requires --generate-tokens >= 1")
     prefix_ids: list[int] = []
@@ -511,6 +527,7 @@ def main(argv=None) -> None:
 
             beam_run = make_beam_serving_fn(
                 mesh, model_config, params, beams=args.beams,
+                length_penalty=args.length_penalty,
                 eos_id=service_config.eos_id,
                 prefix_cache=prefix_cache,
                 quantized_cache=service_config.quantized_kv,
@@ -538,6 +555,7 @@ def main(argv=None) -> None:
                 # suffixes of the once-prefilled cache
                 lambda p, t, n, lengths: beam_search_jit(
                     p, model_config, t, n, args.beams,
+                    length_penalty=args.length_penalty,
                     eos_id=service_config.eos_id,
                     # under a prefix the suffix prefill runs the chunk
                     # decoder (no attention override — beam_search
@@ -676,6 +694,7 @@ def main(argv=None) -> None:
                 draft_layers=args.speculative_draft_layers,
                 draft_tokens=args.speculative_draft_tokens,
                 beams=args.beams,
+                length_penalty=args.length_penalty,
             )
             obs = _maybe_serve_metrics(args.metrics_port, cworker)
             start = time.perf_counter()
@@ -731,6 +750,7 @@ def main(argv=None) -> None:
             draft_layers=args.speculative_draft_layers,
             draft_tokens=args.speculative_draft_tokens,
             beams=args.beams,
+            length_penalty=args.length_penalty,
         )
         _maybe_serve_metrics(args.metrics_port, cworker)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)

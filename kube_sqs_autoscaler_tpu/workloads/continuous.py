@@ -287,11 +287,15 @@ def _beam_insert_row_impl(
             alive, emitted, current)
 
 
+# Donate the KV cache AND the five beam-state operands (scores, out,
+# alive, emitted, current): all six are returned updated and immediately
+# rebound by the caller, so XLA reuses their buffers in place instead of
+# copying the whole search state per insert.
 _beam_insert_row = partial(
     jax.jit,
     static_argnames=("config", "prompt_len", "beams", "family",
                      "quantized_kv", "prefix_len", "eos_id"),
-    donate_argnums=(1,),
+    donate_argnums=(1, 2, 3, 4, 5, 6),
 )(_beam_insert_row_impl)
 
 
@@ -796,12 +800,15 @@ class ContinuousBatcher:
         state_in = (self._slot_shard, out_shard, self._slot_shard,
                     self._slot_shard, self._rows_shard)
         if self._prefix_cache is None:
+            # cache + beam state donated, like the single-chip insert:
+            # every operand in (1..6) comes back as an output the caller
+            # rebinds, so the sharded buffers are reused in place
             return jax.jit(
                 partial(_beam_insert_row_impl, **statics),
                 in_shardings=(p_shard, self._cache_shard, *state_in,
                               rep, rep, rep),
                 out_shardings=(self._cache_shard, *state_in),
-                donate_argnums=(1,),
+                donate_argnums=(1, 2, 3, 4, 5, 6),
             )
         from .decode import prefix_cache_shardings
 
@@ -819,7 +826,7 @@ class ContinuousBatcher:
             in_shardings=(p_shard, self._cache_shard, *state_in, rep,
                           rep, rep, pfx_shard),
             out_shardings=(self._cache_shard, *state_in),
-            donate_argnums=(1,),
+            donate_argnums=(1, 2, 3, 4, 5, 6),
         )
         return lambda *operands: fn(*operands, placed_prefix)
 
@@ -847,6 +854,7 @@ class ContinuousBatcher:
 
         def bstep(params, cache, current, scores, out, alive, emitted,
                   active):
+            lengths_in = cache["length"]  # pre-step, for inactive freeze
             logits, cache = step_fn(params, cache, current, config)
             S = scores.shape[0]
             vocab = logits.shape[-1]
@@ -869,6 +877,19 @@ class ContinuousBatcher:
             rows = jnp.arange(S)
             flat_parent = (rows[:, None] * W + parent).reshape(-1)
             cache = jax.tree.map(lambda a: a[flat_parent], cache)
+            # Gate the length-pointer advance by the active mask, the way
+            # the speculative round does (advance = where(active, n+1, 0)):
+            # free/finished slots keep their pointer frozen instead of
+            # marching toward max_seq_len and leaning on the scatter's
+            # out-of-bounds clamp + the attention mask.  (Their identity
+            # parent gather kept their own advanced length, so restoring
+            # the pre-step value is exact.)
+            cache = dict(
+                cache,
+                length=jnp.where(
+                    jnp.repeat(active, W), cache["length"], lengths_in
+                ),
+            )
             out_g = out[rows[:, None], parent]
             alive_g = alive[rows[:, None], parent]
             emitted_g = emitted[rows[:, None], parent]

@@ -234,3 +234,23 @@ def test_beam_int8_cache_and_sharded_prefix(params):
     np.testing.assert_array_equal(
         np.asarray(run_pq(placed, prompt, lengths, 6)), single_pq
     )
+
+
+def test_serve_binary_length_penalty_flag():
+    # the --length-penalty knob threads from the binary into every beam
+    # path (was dead config: ContinuousBatcher/beam_search took it, the
+    # CLI never offered it)
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--beams", "3",
+          "--length-penalty", "0.6"])
+    main(["--demo", "2", "--batch-size", "1", "--seq-len", "8",
+          "--generate-tokens", "4", "--beams", "2", "--continuous",
+          "--length-penalty", "0.6"])
+    with pytest.raises(SystemExit, match="length-penalty"):
+        main(["--demo", "1", "--generate-tokens", "4",
+              "--length-penalty", "0.6"])  # needs --beams > 1
+    with pytest.raises(SystemExit, match=">= 0"):
+        main(["--demo", "1", "--generate-tokens", "4", "--beams", "2",
+              "--length-penalty", "-1"])

@@ -1,0 +1,323 @@
+"""Fault-process + chaos-battery tests (sim/faults.py, sim/evaluate.py).
+
+Everything is FakeClock-deterministic: the fault processes are pure
+functions of virtual time, the injected episodes run the real
+ControlLoop, and the battery assertions are exact re-runs of what
+``bench.py --suite chaos`` gates on.
+"""
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.resilience import ResilienceConfig
+from kube_sqs_autoscaler_tpu.core.types import MetricError, ScaleError
+from kube_sqs_autoscaler_tpu.sim.faults import (
+    OK,
+    Blackout,
+    BurstyOutage,
+    FaultyMetricSource,
+    FaultyScaler,
+    FlakyCalls,
+    LatencySpikes,
+    compose,
+)
+from kube_sqs_autoscaler_tpu.sim.scenarios import StepArrival
+from kube_sqs_autoscaler_tpu.sim.simulator import SimConfig, Simulation
+
+
+# --- fault processes --------------------------------------------------------
+
+
+def test_blackout_window_half_open():
+    fault = Blackout(start=10.0, duration=5.0, metric=True, scale=False)
+    assert fault.metric_fault(9.99) is OK
+    assert fault.metric_fault(10.0).error is not None
+    assert fault.metric_fault(14.99).error is not None
+    assert fault.metric_fault(15.0) is OK  # [start, start+duration)
+    assert fault.scale_fault(12.0) is OK  # unaffected surface
+
+
+def test_blackout_correlated_and_latency():
+    fault = Blackout(start=0.0, duration=10.0, metric=True, scale=True,
+                     latency=3.0)
+    m, s = fault.metric_fault(5.0), fault.scale_fault(5.0)
+    assert m.error is not None and s.error is not None
+    assert m.latency == 3.0 and s.latency == 3.0
+
+
+def test_bursty_outage_periodicity():
+    fault = BurstyOutage(period=100.0, outage_len=20.0, first=50.0)
+    assert fault.metric_fault(40.0) is OK  # before first
+    assert fault.metric_fault(55.0).error is not None
+    assert fault.metric_fault(75.0) is OK
+    assert fault.metric_fault(155.0).error is not None  # next period
+    with pytest.raises(ValueError):
+        BurstyOutage(period=10.0, outage_len=20.0)
+
+
+def test_flaky_calls_deterministic_per_instant():
+    fault = FlakyCalls(failure_rate=0.5, seed=3)
+    outcomes = [fault.metric_fault(t).error for t in range(100)]
+    again = [fault.metric_fault(t).error for t in range(100)]
+    assert outcomes == again  # pure function of (seed, surface, t)
+    failures = sum(1 for e in outcomes if e is not None)
+    assert 25 <= failures <= 75  # seeded Bernoulli near the rate
+    # different instants draw independently (a retry gets a fresh draw)
+    assert len({e is None for e in outcomes}) == 2
+
+
+def test_flaky_calls_rate_extremes_and_validation():
+    assert FlakyCalls(failure_rate=0.0).metric_fault(1.0) is OK
+    assert FlakyCalls(failure_rate=1.0).metric_fault(1.0).error is not None
+    with pytest.raises(ValueError):
+        FlakyCalls(failure_rate=1.5)
+
+
+def test_flaky_scale_surface_independent_of_metric():
+    fault = FlakyCalls(failure_rate=0.5, seed=3, metric=True, scale=True)
+    metric = [fault.metric_fault(t).error is None for t in range(200)]
+    scale = [fault.scale_fault(t).error is None for t in range(200)]
+    assert metric != scale  # the surfaces hash separately
+
+
+def test_latency_spikes_succeed_slowly():
+    fault = LatencySpikes(period=100.0, spike_len=10.0, delay=2.5)
+    inside, outside = fault.metric_fault(5.0), fault.metric_fault(50.0)
+    assert inside.error is None and inside.latency == 2.5
+    assert outside is OK
+
+
+def test_compose_merges_latency_and_first_error():
+    both = compose(
+        LatencySpikes(period=100.0, spike_len=100.0, delay=1.5),
+        Blackout(start=0.0, duration=50.0, latency=2.0),
+    )
+    fault = both.metric_fault(10.0)
+    assert fault.latency == 3.5  # latencies add
+    assert "outage" in fault.error
+    assert both.metric_fault(60.0).latency == 1.5  # spike only
+    assert both.metric_fault(60.0).error is None
+
+
+# --- injection wrappers -----------------------------------------------------
+
+
+class _Inner:
+    def __init__(self):
+        self.polls = 0
+        self.ups = 0
+
+    def num_messages(self):
+        self.polls += 1
+        return 7
+
+    def scale_up(self):
+        self.ups += 1
+
+    def scale_down(self):
+        pass
+
+
+def test_faulty_metric_source_raises_and_advances_world():
+    clock = FakeClock()
+    inner = _Inner()
+    advanced = []
+    source = FaultyMetricSource(
+        inner,
+        Blackout(start=0.0, duration=10.0, latency=2.0),
+        clock,
+        on_failure=lambda: advanced.append(clock.now()),
+    )
+    with pytest.raises(MetricError):
+        source.num_messages()
+    assert inner.polls == 0  # never reached the real source
+    assert clock.now() == 2.0  # the failing call still cost its latency
+    assert advanced == [2.0]  # world sampled at failure time
+    clock.advance(10.0)
+    assert source.num_messages() == 7  # healthy after the window
+
+
+def test_faulty_scaler_raises_scale_error():
+    clock = FakeClock()
+    inner = _Inner()
+    scaler = FaultyScaler(
+        inner, Blackout(start=0.0, duration=5.0, metric=False, scale=True),
+        clock,
+    )
+    with pytest.raises(ScaleError):
+        scaler.scale_up()
+    assert inner.ups == 0
+    clock.advance(6.0)
+    scaler.scale_up()
+    assert inner.ups == 1
+
+
+# --- closed-loop chaos episodes ---------------------------------------------
+
+
+def _blackout_config(resilience):
+    """Small fast blackout world: demand steps up, then the metric dies."""
+    return SimConfig(
+        arrival_rate=StepArrival(before=20.0, after=120.0, at=60.0),
+        service_rate_per_replica=10.0,
+        duration=400.0,
+        initial_replicas=2,
+        max_pods=20,
+        faults=Blackout(start=90.0, duration=150.0, metric=True),
+        resilience=resilience,
+    )
+
+
+def test_reference_freezes_during_blackout_resilient_does_not():
+    reference = Simulation(_blackout_config(None)).run()
+    resilient = Simulation(
+        _blackout_config(ResilienceConfig(stale_depth_ttl=200.0))
+    ).run()
+    # the reference cannot scale while blind; the stale hold keeps
+    # climbing toward the last observed backlog
+    assert resilient.max_depth < reference.max_depth
+    # replica trajectory during the outage window: frozen vs climbing
+    def replicas_at(result, t):
+        return max(r for (when, _, r) in result.timeline if when <= t)
+
+    assert replicas_at(reference, 230.0) == replicas_at(reference, 95.0)
+    assert replicas_at(resilient, 230.0) > replicas_at(resilient, 95.0)
+
+
+def test_sim_timeline_tracks_unobserved_backlog():
+    # even while every poll fails, the world keeps being sampled so
+    # max_depth reflects the backlog the controller could not see
+    result = Simulation(_blackout_config(None)).run()
+    in_window = [d for (t, d, _) in result.timeline if 90.0 <= t < 240.0]
+    assert in_window and max(in_window) > 0
+    assert result.max_depth >= max(in_window)
+
+
+def test_sim_config_defaults_keep_seed_behavior():
+    # faults=None/resilience=None: byte-identical to the pre-chaos sim
+    plain = Simulation(SimConfig(duration=100.0)).run()
+    explicit = Simulation(
+        SimConfig(duration=100.0, faults=None, resilience=None)
+    ).run()
+    assert plain.timeline == explicit.timeline
+    assert plain.max_depth == explicit.max_depth
+
+
+# --- the battery -------------------------------------------------------------
+
+
+def test_chaos_battery_shape_and_verdicts():
+    from kube_sqs_autoscaler_tpu.sim.evaluate import (
+        chaos_battery,
+        evaluate_chaos,
+        summarize_chaos,
+    )
+
+    report = evaluate_chaos()
+    names = {s.name for s in chaos_battery()}
+    assert set(report) == names
+    for row in report.values():
+        for kind in ("reference", "resilient"):
+            assert {"max_depth", "time_over_slo_s", "replica_changes",
+                    "stale_ticks", "retries", "fail_static_ticks",
+                    "breaker_open_ticks"} <= set(row[kind])
+    summary = summarize_chaos(report)
+    # the acceptance criteria, verbatim: at least one outage win, zero
+    # no-fault regressions
+    assert "metric-blackout" in summary["resilient_wins"]
+    assert summary["no_fault_regressions"] == []
+    # and the blackout win is substantial, not a rounding artifact
+    blackout = report["metric-blackout"]
+    assert blackout["resilient"]["max_depth"] < (
+        0.5 * blackout["reference"]["max_depth"]
+    )
+    assert blackout["resilient"]["stale_ticks"] > 0
+    assert blackout["reference"]["stale_ticks"] == 0
+
+
+def test_chaos_calm_scenario_identical():
+    from kube_sqs_autoscaler_tpu.sim.evaluate import (
+        chaos_battery,
+        run_chaos_episode,
+        default_resilience,
+    )
+
+    calm = next(s for s in chaos_battery() if s.name == "calm")
+    reference = run_chaos_episode(calm, resilience=None)
+    resilient = run_chaos_episode(calm, resilience=default_resilience())
+    assert reference == resilient  # invisible on a healthy world
+
+
+def test_breaker_engages_in_actuation_outage():
+    from kube_sqs_autoscaler_tpu.sim.evaluate import (
+        chaos_battery,
+        run_chaos_episode,
+        default_resilience,
+    )
+
+    scenario = next(
+        s for s in chaos_battery() if s.name == "actuation-outage"
+    )
+    row = run_chaos_episode(scenario, resilience=default_resilience())
+    assert row["breaker_open_ticks"] > 0
+
+
+# --- make chaos-demo ---------------------------------------------------------
+
+
+def test_chaos_demo_exits_zero(capsys):
+    import json
+
+    from kube_sqs_autoscaler_tpu.sim.faults import main
+
+    assert main([]) == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    assert verdict["stale_ticks"] > 0
+    assert verdict["fail_static_ticks"] > 0
+    assert verdict["breaker_transitions"][0] == "closed"
+    assert "open" in verdict["breaker_transitions"]
+    assert verdict["breaker_transitions"][-1] == "closed"
+
+
+def test_chaos_demo_detects_bad_trajectory():
+    # hand the checker a trajectory with no stale ticks: it must complain
+    from kube_sqs_autoscaler_tpu.core.events import TickRecord
+    from kube_sqs_autoscaler_tpu.sim.faults import _check_demo
+    from kube_sqs_autoscaler_tpu.sim.simulator import SimResult
+
+    records = [TickRecord(start=float(i) * 5.0, num_messages=1)
+               for i in range(10)]
+    result = SimResult(
+        timeline=[(float(i) * 5.0, 1, 1) for i in range(10)],
+        final_replicas=1, final_depth=0.0, max_depth=1.0, ticks=10,
+    )
+    problems = _check_demo(records, result)
+    assert any("stale" in p for p in problems)
+    assert any("breaker" in p for p in problems)
+
+
+def test_summarize_chaos_identifies_controls_by_fault_provenance():
+    # a custom battery whose healthy control is NOT named "calm": the
+    # summary must still treat it as a control (regression check), never
+    # as a resilience win
+    from kube_sqs_autoscaler_tpu.sim.evaluate import summarize_chaos
+
+    report = {
+        "baseline": {
+            "reference": {"max_depth": 10.0, "time_over_slo_s": 0.0,
+                          "replica_changes": 2, "faulted": False},
+            "resilient": {"max_depth": 8.0, "time_over_slo_s": 0.0,
+                          "replica_changes": 2, "faulted": False},
+        },
+        "outage": {
+            "reference": {"max_depth": 100.0, "time_over_slo_s": 50.0,
+                          "replica_changes": 2, "faulted": True},
+            "resilient": {"max_depth": 40.0, "time_over_slo_s": 10.0,
+                          "replica_changes": 3, "faulted": True},
+        },
+    }
+    summary = summarize_chaos(report)
+    assert summary["no_fault_scenarios"] == ["baseline"]
+    assert summary["no_fault_regressions"] == ["baseline"]  # it changed!
+    assert summary["resilient_wins"] == ["outage"]

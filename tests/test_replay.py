@@ -471,3 +471,72 @@ def test_counterfactual_honors_recorded_forecast_config(tmp_path):
     from kube_sqs_autoscaler_tpu.sim.replay import replay as _replay
 
     assert _replay(records, meta).ok
+
+
+# --- resilient episodes (stale-depth hold) replay tick-for-tick -------------
+
+
+def _stale_hold_config(**overrides) -> SimConfig:
+    """Overloaded world + metric blackout: the episode records fresh
+    ticks, stale-held ticks, TTL-expired fail-static ticks, and recovery
+    (metric_retries stays 0 so live in-tick clock consumption matches
+    the replayed loop exactly)."""
+    from kube_sqs_autoscaler_tpu.core.resilience import ResilienceConfig
+    from kube_sqs_autoscaler_tpu.sim.faults import Blackout
+
+    defaults = dict(
+        arrival_rate=StepArrival(before=20.0, after=120.0, at=30.0),
+        service_rate_per_replica=10.0,
+        duration=300.0,
+        initial_replicas=2,
+        max_pods=15,
+        faults=Blackout(start=60.0, duration=120.0, metric=True),
+        resilience=ResilienceConfig(stale_depth_ttl=60.0),
+    )
+    defaults.update(overrides)
+    return SimConfig(**defaults)
+
+
+def test_reactive_stale_hold_episode_replays_exactly(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    record_episode(_stale_hold_config(), path)
+    meta, records = read_journal(path)
+    assert meta["resilience"]["stale_depth_ttl"] == 60.0
+    stale = [r for r in records if r.stale]
+    static = [r for r in records if r.metric_error is not None]
+    assert stale and static  # the episode exercises hold AND expiry
+    result = replay(records, meta)
+    assert result.ok, result.format_divergences()
+    # the replayed loop re-derived the holds, not transcribed them
+    assert [r.stale for r in result.records] == [r.stale for r in records]
+
+
+def test_predictive_stale_hold_episode_replays_exactly(tmp_path):
+    # the regression shape: held depths must NOT enter the replayed
+    # forecaster history (the live DepthHistory skipped them), or the
+    # forecast — and with it decision_messages — diverges mid-episode
+    pytest.importorskip("jax")
+    path = str(tmp_path / "journal.jsonl")
+    record_episode(
+        _stale_hold_config(
+            policy="predictive", forecaster="ewma", forecast_horizon=30.0
+        ),
+        path,
+    )
+    meta, records = read_journal(path)
+    assert any(r.stale for r in records)
+    result = replay(records, meta)
+    assert result.ok, result.format_divergences()
+
+
+def test_stale_records_without_ttl_meta_flag_divergence(tmp_path):
+    # a journal whose records carry stale ticks but whose meta lost the
+    # resilience block cannot re-derive the holds — replay must say so
+    # loudly (divergences), never silently feed held depths as fresh
+    path = str(tmp_path / "journal.jsonl")
+    record_episode(_stale_hold_config(), path)
+    meta, records = read_journal(path)
+    del meta["resilience"]
+    result = replay(records, meta)
+    assert not result.ok
+    assert any(d.tick_field == "stale" for d in result.divergences)

@@ -1,0 +1,841 @@
+"""Resilience-layer tests: retries, deadlines, breaker, stale hold.
+
+Everything runs on a FakeClock — backoff sleeps, deadline measurement,
+breaker reset windows, and stale TTLs are all virtual time, no wall-clock
+sleeps anywhere (the acceptance contract).  The loop-level tests drive the
+REAL ControlLoop with scripted sources/scalers, so what is covered is the
+wiring the flags actually enable, not the pieces in isolation only.
+"""
+
+import logging
+import time
+import urllib.request
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.events import MultiObserver, TickRecord
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import Gate, PolicyConfig
+from kube_sqs_autoscaler_tpu.core.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    ResilienceConfig,
+    RetryPolicy,
+    call_with_deadline,
+)
+from kube_sqs_autoscaler_tpu.core.types import MetricError, ScaleError
+
+
+class ScriptedSource:
+    """Metric source driven by a list of outcomes.
+
+    Each item is an int (depth), an exception instance (raised), or
+    ``("slow", seconds, outcome)`` which consumes virtual clock time
+    before resolving ``outcome``.  The script end repeats the last plain
+    depth (or 0).
+    """
+
+    def __init__(self, clock, outcomes):
+        self.clock = clock
+        self.outcomes = list(outcomes)
+        self.calls = 0
+        self._last_depth = 0
+
+    def num_messages(self) -> int:
+        self.calls += 1
+        item = self.outcomes.pop(0) if self.outcomes else self._last_depth
+        if isinstance(item, tuple) and item[0] == "slow":
+            _, seconds, item = item
+            self.clock.sleep(seconds)
+        if isinstance(item, BaseException):
+            raise item
+        self._last_depth = int(item)
+        return self._last_depth
+
+
+class ScriptedScaler:
+    """Scaler whose up-calls follow a script of outcomes.
+
+    Items: ``None`` (success), an exception instance (raised), or
+    ``("slow", seconds)`` (consume clock, then succeed).  Script end
+    repeats success.  Down-calls always succeed.
+    """
+
+    def __init__(self, clock, up_outcomes=()):
+        self.clock = clock
+        self.up_outcomes = list(up_outcomes)
+        self.up_calls = 0
+        self.down_calls = 0
+
+    def scale_up(self) -> None:
+        self.up_calls += 1
+        item = self.up_outcomes.pop(0) if self.up_outcomes else None
+        if isinstance(item, tuple) and item[0] == "slow":
+            self.clock.sleep(item[1])
+            item = None
+        if isinstance(item, BaseException):
+            raise item
+
+    def scale_down(self) -> None:
+        self.down_calls += 1
+
+
+class RecordingObserver:
+    def __init__(self):
+        self.records = []
+
+    def on_tick(self, record):
+        self.records.append(record)
+
+
+def make_loop(
+    source_outcomes,
+    resilience,
+    *,
+    up_outcomes=(),
+    poll=5.0,
+    up_msgs=100,
+    down_msgs=0,
+    up_cool=0.0,
+    down_cool=1e9,
+):
+    """Real ControlLoop on a FakeClock with scripted seams.
+
+    Defaults neutralize the down gate (threshold 0, huge cooldown) so
+    tests reason about the up path only.
+    """
+    clock = FakeClock()
+    source = ScriptedSource(clock, source_outcomes)
+    scaler = ScriptedScaler(clock, up_outcomes)
+    observer = RecordingObserver()
+    loop = ControlLoop(
+        scaler,
+        source,
+        LoopConfig(
+            poll_interval=poll,
+            policy=PolicyConfig(
+                scale_up_messages=up_msgs,
+                scale_down_messages=down_msgs,
+                scale_up_cooldown=up_cool,
+                scale_down_cooldown=down_cool,
+            ),
+        ),
+        clock=clock,
+        observer=observer,
+        resilience=resilience,
+    )
+    return loop, source, scaler, clock, observer
+
+
+# --- config gating ---------------------------------------------------------
+
+
+def test_default_config_disables_the_layer():
+    # all-defaults config: the loop must keep the reference code path
+    assert not ResilienceConfig().enabled
+    loop, _, _, _, _ = make_loop([1], ResilienceConfig())
+    assert loop.resilience is None
+
+
+def test_any_optin_enables_the_layer():
+    for kwargs in (
+        {"metric_retries": 1},
+        {"metric_timeout": 1.0},
+        {"scaler_retries": 1},
+        {"scaler_timeout": 1.0},
+        {"breaker_failures": 1},
+        {"stale_depth_ttl": 1.0},
+    ):
+        assert ResilienceConfig(**kwargs).enabled, kwargs
+
+
+def test_reference_parity_when_disabled(caplog):
+    # resilience=None and resilience=defaults produce identical records
+    # on an eventful script (failure, observation, scale-up)
+    script = lambda: [MetricError("down"), 200, 200]  # noqa: E731
+
+    def run(resilience):
+        loop, _, scaler, _, observer = make_loop(script(), resilience)
+        loop.run(max_ticks=3)
+        return observer.records, scaler.up_calls
+
+    ref_records, ref_ups = run(None)
+    cfg_records, cfg_ups = run(ResilienceConfig())
+    assert ref_ups == cfg_ups
+    for a, b in zip(ref_records, cfg_records):
+        assert a == b
+
+
+# --- RetryPolicy -----------------------------------------------------------
+
+
+def test_backoff_deterministic_and_bounded():
+    a = RetryPolicy(5, base_delay=0.2, max_delay=2.0, jitter=0.5, seed=7)
+    b = RetryPolicy(5, base_delay=0.2, max_delay=2.0, jitter=0.5, seed=7)
+    delays_a = [a.delay(i) for i in range(6)]
+    delays_b = [b.delay(i) for i in range(6)]
+    assert delays_a == delays_b  # seeded: reproducible
+    for i, d in enumerate(delays_a):
+        ceiling = min(2.0, 0.2 * 2**i)
+        assert 0.5 * ceiling <= d <= ceiling  # jitter only shrinks
+
+
+def test_zero_jitter_is_pure_exponential():
+    p = RetryPolicy(5, base_delay=0.5, max_delay=4.0, jitter=0.0, seed=0)
+    assert [p.delay(i) for i in range(5)] == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+
+def test_retry_run_recovers_and_counts():
+    clock = FakeClock()
+    attempts = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise MetricError("blip")
+        return 42
+
+    policy = RetryPolicy(3, base_delay=1.0, jitter=0.0, seed=0)
+    value, extra = policy.run(
+        flaky, clock, on_attempts=attempts.append
+    )
+    assert value == 42 and extra == 2
+    assert calls["n"] == 3
+    assert attempts == [0, 1, 2]
+    assert clock.now() == pytest.approx(1.0 + 2.0)  # two backoffs
+
+
+def test_retry_run_respects_budget_deadline():
+    clock = FakeClock()
+    policy = RetryPolicy(10, base_delay=2.0, max_delay=2.0, jitter=0.0, seed=0)
+
+    def always_fails():
+        raise MetricError("dead")
+
+    # deadline at t=2.5: first backoff (to t=2.0) fits, the second (to
+    # t=4.0) would overshoot -> the original error surfaces
+    with pytest.raises(MetricError):
+        policy.run(always_fails, clock, deadline=2.5)
+    assert clock.now() == pytest.approx(2.0)
+
+
+def test_retry_does_not_catch_base_exceptions():
+    clock = FakeClock()
+    policy = RetryPolicy(5, seed=0)
+    calls = {"n": 0}
+
+    def interrupted():
+        calls["n"] += 1
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        policy.run(interrupted, clock)
+    assert calls["n"] == 1  # not retried
+    assert clock.sleeps == []  # no backoff consumed
+
+
+# --- call_with_deadline ----------------------------------------------------
+
+
+def test_deadline_converts_slow_into_failure():
+    clock = FakeClock()
+
+    def slow():
+        clock.sleep(3.0)
+        return "late"
+
+    with pytest.raises(DeadlineExceeded):
+        call_with_deadline(slow, clock, timeout=2.0)
+
+
+def test_deadline_boundary_and_disabled():
+    clock = FakeClock()
+
+    def exactly():
+        clock.sleep(2.0)
+        return "on time"
+
+    # boundary-exact fires like the gates' boundary convention
+    assert call_with_deadline(exactly, clock, timeout=2.0) == "on time"
+
+    def very_slow():
+        clock.sleep(100.0)
+        return "fine"
+
+    assert call_with_deadline(very_slow, clock, timeout=0.0) == "fine"
+
+
+# --- loop integration: metric retries + timeout ----------------------------
+
+
+def test_metric_retry_recovers_within_tick():
+    loop, source, _, clock, observer = make_loop(
+        [MetricError("a"), MetricError("b"), 42],
+        ResilienceConfig(metric_retries=2),
+    )
+    loop.run(max_ticks=1)
+    record = observer.records[0]
+    assert record.num_messages == 42
+    assert record.metric_error is None
+    assert record.metric_retries == 2
+    assert source.calls == 3
+    assert len(clock.sleeps) == 3  # the poll sleep + two backoffs
+
+
+def test_metric_retry_exhaustion_falls_back_to_reference(caplog):
+    loop, source, _, _, observer = make_loop(
+        [MetricError("x")] * 3,
+        ResilienceConfig(metric_retries=2),
+    )
+    with caplog.at_level(logging.ERROR):
+        loop.run(max_ticks=1)
+    record = observer.records[0]
+    assert record.metric_error == "x"
+    assert record.metric_retries == 2  # the attempts are still ledgered
+    assert source.calls == 3
+    assert any("Failed to get SQS messages" in r.message for r in caplog.records)
+
+
+def test_metric_timeout_converts_slow_poll_to_failure():
+    loop, _, _, _, observer = make_loop(
+        [("slow", 5.0, 42)],
+        ResilienceConfig(metric_timeout=2.0),
+    )
+    loop.run(max_ticks=1)
+    record = observer.records[0]
+    assert record.metric_error is not None
+    assert "deadline" in record.metric_error
+    assert record.num_messages is None
+
+
+def test_retry_budget_is_within_poll_interval():
+    # base 2s/no-jitter backoffs against a 5s poll with the default 0.5
+    # budget: only ONE backoff (to t~2) fits under the 2.5s budget
+    loop, source, _, clock, observer = make_loop(
+        [MetricError("x")] * 10,
+        ResilienceConfig(
+            metric_retries=8,
+            retry_base_delay=2.0,
+            retry_max_delay=2.0,
+            retry_jitter=0.0,
+        ),
+        poll=5.0,
+    )
+    loop.run(max_ticks=1)
+    assert source.calls == 2  # first try + the single budgeted retry
+    assert observer.records[0].metric_retries == 1
+    # the next tick still starts on cadence: 5s sleep + 2s backoff + 5s sleep
+    assert clock.now() == pytest.approx(5.0 + 2.0)
+
+
+# --- loop integration: stale-depth hold ------------------------------------
+
+
+def test_stale_hold_keeps_scaling_through_outage(caplog):
+    loop, _, scaler, _, observer = make_loop(
+        [200, MetricError("dark"), MetricError("dark")],
+        ResilienceConfig(stale_depth_ttl=60.0),
+    )
+    with caplog.at_level(logging.WARNING):
+        loop.run(max_ticks=3)
+    fresh, stale1, stale2 = observer.records
+    assert not fresh.stale and fresh.num_messages == 200
+    for record in (stale1, stale2):
+        assert record.stale is True
+        assert record.num_messages == 200  # the held depth
+        assert record.metric_error is None  # the tick proceeded
+        assert record.up is Gate.FIRE
+    assert stale1.stale_age_s == pytest.approx(5.0)
+    assert stale2.stale_age_s == pytest.approx(10.0)
+    assert scaler.up_calls == 3
+    assert any("holding last good depth 200" in r.message for r in caplog.records)
+
+
+def test_stale_ttl_expiry_goes_fail_static():
+    loop, _, scaler, _, observer = make_loop(
+        [200] + [MetricError("dark")] * 3,
+        ResilienceConfig(stale_depth_ttl=8.0),
+        poll=5.0,
+    )
+    loop.run(max_ticks=4)
+    _, stale, static1, static2 = observer.records
+    assert stale.stale is True  # age 5 <= 8
+    for record in (static1, static2):  # ages 10, 15 > 8: reference path
+        assert record.metric_error == "dark"
+        assert record.stale is None
+        assert record.up is Gate.SKIPPED
+    assert scaler.up_calls == 2  # fresh + one held tick only
+
+
+def test_stale_hold_without_prior_observation_fails_static():
+    loop, _, scaler, _, observer = make_loop(
+        [MetricError("dark")],
+        ResilienceConfig(stale_depth_ttl=60.0),
+    )
+    loop.run(max_ticks=1)
+    assert observer.records[0].metric_error == "dark"
+    assert scaler.up_calls == 0
+
+
+def test_stale_ticks_never_feed_forecaster_history():
+    from kube_sqs_autoscaler_tpu.forecast.history import DepthHistory
+
+    history = DepthHistory(capacity=8)
+    clock = FakeClock()
+    source = ScriptedSource(clock, [200, MetricError("dark"), 300])
+    scaler = ScriptedScaler(clock)
+    loop = ControlLoop(
+        scaler,
+        source,
+        LoopConfig(poll_interval=5.0),
+        clock=clock,
+        observer=history,
+        resilience=ResilienceConfig(stale_depth_ttl=60.0),
+    )
+    loop.run(max_ticks=3)
+    times, depths, n = history.snapshot()
+    assert n == 2  # the stale tick is absent
+    assert list(depths[:2]) == [200.0, 300.0]
+
+
+def test_stale_tick_bypasses_depth_policy():
+    calls = []
+
+    class CountingPolicy:
+        def effective_messages(self, now, num_messages):
+            calls.append(num_messages)
+            return num_messages
+
+    clock = FakeClock()
+    source = ScriptedSource(clock, [200, MetricError("dark"), 300])
+    scaler = ScriptedScaler(clock)
+    loop = ControlLoop(
+        scaler,
+        source,
+        LoopConfig(poll_interval=5.0),
+        clock=clock,
+        depth_policy=CountingPolicy(),
+        resilience=ResilienceConfig(stale_depth_ttl=60.0),
+    )
+    loop.run(max_ticks=3)
+    assert calls == [200, 300]  # not consulted on the stale tick
+
+
+# --- loop integration: circuit breaker -------------------------------------
+
+
+def test_breaker_opens_and_fails_fast():
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(breaker_failures=2, breaker_reset=60.0),
+        up_outcomes=[ScaleError("api down")] * 10,
+    )
+    loop.run(max_ticks=4)
+    r1, r2, r3, r4 = observer.records
+    assert r1.up_error == "api down" and r1.breaker_state == "closed"
+    assert r2.up_error == "api down" and r2.breaker_state == "open"
+    for record in (r3, r4):  # rejected without touching the scaler
+        assert "circuit breaker open" in record.up_error
+        assert record.breaker_state == "open"
+    assert scaler.up_calls == 2
+
+
+def test_breaker_half_open_probe_success_closes():
+    # failures at t=5,10 open the breaker at t=10; reset 12s makes the
+    # t=25 tick the first eligible probe (15 and 20 are rejected), and
+    # the scaler script has recovered by then -> closed, scaling resumes
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(breaker_failures=2, breaker_reset=12.0),
+        up_outcomes=[ScaleError("down"), ScaleError("down")],
+    )
+    loop.run(max_ticks=5)
+    records = observer.records
+    assert [r.breaker_state for r in records] == [
+        "closed", "open", "open", "open", "closed"
+    ]
+    assert records[4].scaled("up")  # the successful probe
+    assert scaler.up_calls == 3  # 2 failures + the probe
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(breaker_failures=2, breaker_reset=12.0),
+        up_outcomes=[ScaleError("down")] * 3 + [None],
+    )
+    loop.run(max_ticks=8)
+    states = [r.breaker_state for r in observer.records]
+    # open at t=10; probe at t=25 fails -> re-open (reset restarts from
+    # the failed probe); next probe at t=40 succeeds
+    assert states == ["closed", "open", "open", "open", "open",
+                      "open", "open", "closed"]
+    assert scaler.up_calls == 4  # 2 + failed probe + successful probe
+    assert observer.records[7].scaled("up")
+
+
+def test_breaker_unit_transitions():
+    breaker = CircuitBreaker(failure_threshold=2, reset_timeout=10.0)
+    assert breaker.allow(0.0) and breaker.state == "closed"
+    breaker.record_failure(0.0)
+    assert breaker.state == "closed" and breaker.failures == 1
+    breaker.record_failure(1.0)
+    assert breaker.state == "open"
+    assert not breaker.allow(5.0)
+    assert breaker.seconds_until_probe(5.0) == pytest.approx(6.0)
+    assert breaker.allow(11.0)  # boundary-inclusive probe
+    assert breaker.state == "half_open"
+    breaker.record_failure(11.0)  # probe fails: re-open, reset restarts
+    assert breaker.state == "open"
+    assert not breaker.allow(20.0)
+    assert breaker.allow(21.0)
+    breaker.record_success()
+    assert breaker.state == "closed" and breaker.failures == 0
+    assert breaker.seconds_until_probe(99.0) == 0.0
+
+
+def test_failed_breaker_rejection_does_not_advance_cooldown():
+    # An open-breaker rejection is an actuation failure: the cooldown
+    # timestamp must stay put (main.go:57-60 semantics).  With cooldown
+    # 6s, if the t=10 RPC failure OR the t=15 breaker rejection had
+    # advanced the timestamp, the following gate would read COOLING
+    # instead of firing — so the observed FIRE/FIRE/FIRE tail proves
+    # neither failure path touched policy state.
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(breaker_failures=1, breaker_reset=7.0),
+        up_outcomes=[ScaleError("down")],
+        up_cool=6.0,
+    )
+    loop.run(max_ticks=4)
+    r1, r2, r3, r4 = observer.records
+    assert r1.up is Gate.COOLING  # t=5: startup grace (0 + 6 > 5)
+    assert r2.up is Gate.FIRE and r2.up_error == "down"  # opens at t=10
+    assert r3.up is Gate.FIRE  # cooldown NOT advanced by the failure
+    assert "circuit breaker open" in r3.up_error  # 10 + 7 > 15
+    assert r4.up is Gate.FIRE  # nor by the rejection: probe at t=20
+    assert r4.scaled("up")
+    assert scaler.up_calls == 2  # the t=10 failure + the t=20 probe
+
+
+# --- scaler retries + timeout ----------------------------------------------
+
+
+def test_scaler_retry_recovers_within_tick():
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(scaler_retries=1),
+        up_outcomes=[ScaleError("conflict"), None],
+    )
+    loop.run(max_ticks=1)
+    record = observer.records[0]
+    assert record.scaled("up")
+    assert record.scaler_retries == 1
+    assert scaler.up_calls == 2
+
+
+def test_scaler_timeout_feeds_the_breaker():
+    # slow-but-successful actuations: the deadline turns them into
+    # failures and the breaker opens on consecutive timeouts
+    loop, _, scaler, _, observer = make_loop(
+        [500],
+        ResilienceConfig(scaler_timeout=1.0, breaker_failures=2),
+        up_outcomes=[("slow", 3.0), ("slow", 3.0), ("slow", 3.0)],
+    )
+    loop.run(max_ticks=3)
+    r1, r2, r3 = observer.records
+    assert "deadline" in r1.up_error
+    assert r2.breaker_state == "open"
+    assert "circuit breaker open" in r3.up_error
+    assert scaler.up_calls == 2
+
+
+# --- BaseException hygiene (satellite) --------------------------------------
+
+
+@pytest.mark.parametrize("resilience", [None, ResilienceConfig(
+    metric_retries=3, stale_depth_ttl=60.0)])
+def test_keyboard_interrupt_from_metric_source_propagates(resilience):
+    loop, source, _, _, _ = make_loop([KeyboardInterrupt()], resilience)
+    with pytest.raises(KeyboardInterrupt):
+        loop.run(max_ticks=1)
+    assert source.calls == 1  # never retried, never stale-held
+
+
+@pytest.mark.parametrize("resilience", [None, ResilienceConfig(
+    scaler_retries=3, breaker_failures=5)])
+def test_system_exit_from_scaler_propagates(resilience):
+    loop, _, scaler, _, _ = make_loop(
+        [500], resilience, up_outcomes=[SystemExit(3)]
+    )
+    with pytest.raises(SystemExit):
+        loop.run(max_ticks=1)
+    assert scaler.up_calls == 1  # never retried
+
+
+def test_keyboard_interrupt_from_observer_propagates():
+    class InterruptingObserver:
+        def on_tick(self, record):
+            raise KeyboardInterrupt()
+
+    clock = FakeClock()
+    loop = ControlLoop(
+        ScriptedScaler(clock),
+        ScriptedSource(clock, [1]),
+        LoopConfig(poll_interval=1.0),
+        clock=clock,
+        observer=InterruptingObserver(),
+    )
+    with pytest.raises(KeyboardInterrupt):
+        loop.run(max_ticks=1)
+
+
+def test_keyboard_interrupt_through_multi_observer_propagates():
+    seen = RecordingObserver()
+
+    class InterruptingObserver:
+        def on_tick(self, record):
+            raise KeyboardInterrupt()
+
+    clock = FakeClock()
+    loop = ControlLoop(
+        ScriptedScaler(clock),
+        ScriptedSource(clock, [1]),
+        LoopConfig(poll_interval=1.0),
+        clock=clock,
+        observer=MultiObserver([seen, InterruptingObserver()]),
+    )
+    with pytest.raises(KeyboardInterrupt):
+        loop.run(max_ticks=1)
+    assert len(seen.records) == 1  # earlier observers already ran
+
+
+def test_ordinary_observer_exception_still_swallowed(caplog):
+    class FailingObserver:
+        def on_tick(self, record):
+            raise RuntimeError("boom")
+
+    clock = FakeClock()
+    loop = ControlLoop(
+        ScriptedScaler(clock),
+        ScriptedSource(clock, [1, 2]),
+        LoopConfig(poll_interval=1.0),
+        clock=clock,
+        observer=FailingObserver(),
+    )
+    with caplog.at_level(logging.ERROR):
+        loop.run(max_ticks=2)  # the loop survives both ticks
+    assert loop.ticks == 2
+
+
+# --- record round-trip ------------------------------------------------------
+
+
+def test_resilience_fields_roundtrip_and_stay_lean():
+    record = TickRecord(
+        start=1.0,
+        num_messages=7,
+        stale=True,
+        stale_age_s=12.5,
+        metric_retries=2,
+        scaler_retries=1,
+        breaker_state="half_open",
+    )
+    data = record.to_dict()
+    assert data["stale"] is True and data["breaker_state"] == "half_open"
+    assert TickRecord.from_dict(data) == record
+    # a reference tick serializes exactly as before: no resilience keys
+    plain = TickRecord(start=0.0, num_messages=3).to_dict()
+    for key in ("stale", "stale_age_s", "metric_retries", "scaler_retries",
+                "breaker_state"):
+        assert key not in plain
+
+
+def test_stale_record_journals_and_reads_back(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal, read_journal
+
+    path = str(tmp_path / "journal.jsonl")
+    record = TickRecord(start=5.0, num_messages=200, stale=True,
+                        stale_age_s=5.0, breaker_state="open")
+    with TickJournal(path, meta={"resilience": {"stale_depth_ttl": 60.0}}) as j:
+        j.on_tick(record)
+    meta, records = read_journal(path)
+    assert meta["resilience"]["stale_depth_ttl"] == 60.0
+    assert records[0].stale is True
+    assert records[0].breaker_state == "open"
+
+
+# --- observability ----------------------------------------------------------
+
+
+def _tick(start, **kwargs):
+    return TickRecord(start=start, **kwargs)
+
+
+def test_prometheus_resilience_metrics_render():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+
+    metrics = ControllerMetrics(version="test")
+    base = metrics.render()
+    # counters render at zero; state/timestamp gauges wait for a value
+    assert "stale_ticks_total 0" in base
+    assert 'retries_total{call="metric"} 0' in base
+    assert "consecutive_metric_failures 0" in base
+    assert "breaker_state\n" not in base.replace("# TYPE", "#T")
+
+    metrics.on_tick(_tick(0.0, num_messages=5, metric_retries=2,
+                          breaker_state="closed"))
+    metrics.on_tick(_tick(5.0, num_messages=5, stale=True, stale_age_s=5.0,
+                          breaker_state="open", scaler_retries=1))
+    metrics.on_tick(_tick(10.0, metric_error="dark", breaker_state="open"))
+    text = metrics.render()
+    assert "stale_ticks_total 1" in text
+    assert 'retries_total{call="metric"} 2' in text
+    assert 'retries_total{call="scaler"} 1' in text
+    assert "breaker_state 2" in text  # open
+    assert "consecutive_metric_failures 2" in text  # stale + fail-static
+    assert "last_successful_poll_timestamp" in text
+    # a fresh observation resets the consecutive gauge
+    metrics.on_tick(_tick(15.0, num_messages=9, breaker_state="closed"))
+    text = metrics.render()
+    assert "consecutive_metric_failures 0" in text
+    assert "breaker_state 0" in text
+
+
+def test_prometheus_consecutive_scale_failures():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+
+    metrics = ControllerMetrics(version="test")
+    metrics.on_tick(_tick(0.0, num_messages=500, up=Gate.FIRE,
+                          up_error="down"))
+    metrics.on_tick(_tick(5.0, num_messages=500, up=Gate.FIRE,
+                          up_error="down"))
+    assert "consecutive_scale_failures 2" in metrics.render()
+    metrics.on_tick(_tick(10.0, num_messages=500, up=Gate.FIRE))
+    text = metrics.render()
+    assert "consecutive_scale_failures 0" in text
+    assert "last_successful_scale_timestamp" in text
+
+
+def test_stale_ticks_do_not_count_as_observations():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+
+    metrics = ControllerMetrics(version="test")
+    metrics.on_tick(_tick(0.0, num_messages=100, stale=True))
+    assert not metrics.ready  # a held depth is not a successful read
+    assert "queue_messages 100" not in metrics.render()
+    metrics.on_tick(_tick(5.0, num_messages=42))
+    assert metrics.ready
+    assert "queue_messages 42" in metrics.render()
+
+
+def test_healthz_turns_503_when_ticks_stall():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    metrics = ControllerMetrics(version="test")
+    server = ObservabilityServer(
+        metrics, host="127.0.0.1", port=0, unhealthy_after=30.0
+    )
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        with urllib.request.urlopen(url) as reply:
+            assert reply.status == 200  # fresh registry: not yet stalled
+        # simulate a wedged loop: last tick 100 wall-seconds ago
+        metrics._last_tick_monotonic = time.monotonic() - 100.0
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(url)
+        assert excinfo.value.code == 503
+        assert "no tick progress" in excinfo.value.read().decode()
+        metrics.on_tick(_tick(0.0, num_messages=1))  # progress: healthy again
+        with urllib.request.urlopen(url) as reply:
+            assert reply.status == 200
+    finally:
+        server.stop()
+
+
+def test_healthz_threshold_zero_is_always_healthy():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    metrics = ControllerMetrics(version="test")
+    metrics._last_tick_monotonic = time.monotonic() - 1e6
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        with urllib.request.urlopen(url) as reply:
+            assert reply.status == 200
+    finally:
+        server.stop()
+
+
+# --- CLI --------------------------------------------------------------------
+
+
+def test_cli_defaults_keep_reference_behavior():
+    from kube_sqs_autoscaler_tpu.cli import build_parser, resilience_from_args
+
+    args = build_parser().parse_args([])
+    config = resilience_from_args(args)
+    assert not config.enabled
+    assert args.healthz_stale_after == 0.0
+
+
+def test_cli_resilience_flags_parse_and_wire():
+    from kube_sqs_autoscaler_tpu.cli import build_parser, resilience_from_args
+
+    args = build_parser().parse_args([
+        "--metric-retries", "3",
+        "--metric-timeout", "2s",
+        "--scaler-retries", "1",
+        "--scaler-timeout", "1500ms",
+        "--breaker-failures", "5",
+        "--breaker-reset", "45s",
+        "--stale-depth-ttl", "2m",
+        "--healthz-stale-after", "1m",
+    ])
+    config = resilience_from_args(args)
+    assert config.enabled
+    assert config.metric_retries == 3
+    assert config.metric_timeout == 2.0
+    assert config.scaler_retries == 1
+    assert config.scaler_timeout == 1.5
+    assert config.breaker_failures == 5
+    assert config.breaker_reset == 45.0
+    assert config.stale_depth_ttl == 120.0
+    assert args.healthz_stale_after == 60.0
+
+
+def test_cli_rejects_negative_retries(capsys):
+    from kube_sqs_autoscaler_tpu.cli import build_parser
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--metric-retries", "-1"])
+    assert "must be >= 0" in capsys.readouterr().err
+
+
+def test_cli_healthz_threshold_must_exceed_poll_period(capsys):
+    # sleep-first loop: at most one tick per poll period, so a staleness
+    # threshold <= the period would 503 a healthy controller between
+    # ticks — reject the combination at startup
+    from kube_sqs_autoscaler_tpu.cli import (
+        build_parser,
+        validate_flag_interactions,
+    )
+
+    parser = build_parser()
+    bad = parser.parse_args(
+        ["--poll-period", "5m", "--healthz-stale-after", "60s"]
+    )
+    with pytest.raises(SystemExit):
+        validate_flag_interactions(parser, bad)
+    assert "must exceed --poll-period" in capsys.readouterr().err
+    good = parser.parse_args(
+        ["--poll-period", "5s", "--healthz-stale-after", "60s"]
+    )
+    validate_flag_interactions(parser, good)  # no error
+    validate_flag_interactions(parser, parser.parse_args([]))  # defaults off
