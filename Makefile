@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos replay-demo chaos-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve replay-demo chaos-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -63,6 +63,14 @@ bench-sweep:
 # BENCH_r09.json
 bench-chaos:
 	python bench.py --suite chaos
+
+# Serving hot path (CPU JAX, ~a minute): continuous-batching blocked
+# engine (block decode + batched admission + dispatch-ahead overlap) vs
+# the single-step engine on the same seeded queue; exits non-zero unless
+# blocked reaches >=1.3x tokens/s with byte-identical greedy outputs;
+# writes BENCH_r10.json
+bench-serve:
+	JAX_PLATFORMS=cpu python bench.py --suite serve
 
 # The fidelity gate alone (no JAX, seconds): record a short simulated
 # episode, replay it, fail on any decision divergence

@@ -37,6 +37,12 @@ blackouts, flaky calls, actuation outages, latency spikes), scored on
 the same battery numbers; writes ``BENCH_r09.json``.  JAX-free like the
 default suite (both configurations run the reactive policy).
 
+``--suite serve`` benchmarks the continuous-serving hot path
+(`workloads/continuous.py`): the blocked engine (jitted block decode +
+batched admission + dispatch-ahead double-buffering) against the
+single-step engine on the same seeded queue, hard-gated on >= 1.3x
+tokens/s AND byte-identical greedy outputs; writes ``BENCH_r10.json``.
+
 ``--suite sweep`` drives the compiled closed-loop simulator
 (`sim/compiled.py`): first the fidelity gate (`verify_fidelity` — the
 compiled `lax.scan` episodes must reproduce the real-`ControlLoop` sim
@@ -482,24 +488,231 @@ def run_sweep_suite(output: str = "BENCH_r08.json") -> dict:
     }
 
 
+def _serve_episode(params, model, prompts, *, batch_size, prompt_len,
+                   generate_tokens, decode_block):
+    """Drive one ContinuousWorker over a seeded queue of ``prompts``,
+    twice: the first drain pays every XLA compile, the second is timed.
+    Returns per-config stats + the timed run's outputs keyed by prompt
+    index (the reply's ``request_id`` maps back through the fake queue's
+    MessageIds)."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.utils.profiling import SpanTimer
+    from kube_sqs_autoscaler_tpu.workloads.continuous import ContinuousWorker
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    worker = ContinuousWorker(
+        queue, params, model,
+        ServiceConfig(
+            queue_url="bench://serve", batch_size=batch_size,
+            seq_len=prompt_len, generate_tokens=generate_tokens,
+            decode_block=decode_block,
+            result_queue_url="bench://serve-results",
+        ),
+        result_queue=results,
+    )
+
+    def send_all():
+        ids_by_message = {}
+        for index, ids in enumerate(prompts):
+            message_id = queue.send_message(
+                "bench://serve", json.dumps(ids.tolist())
+            )
+            ids_by_message[message_id] = index
+        return ids_by_message
+
+    def receive_outputs(ids_by_message):
+        outputs = {}
+        while True:
+            batch = results.receive_messages(
+                "bench://serve-results", max_messages=16
+            )
+            if not batch:
+                return outputs
+            for message in batch:
+                # delete as we read: an undeleted reply would reappear
+                # after the fake's visibility timeout and leak the warm
+                # run's MessageIds into the timed collection
+                results.delete_message(
+                    "bench://serve-results", message["ReceiptHandle"]
+                )
+                payload = json.loads(message["Body"])
+                index = ids_by_message[payload["request_id"]]
+                outputs[index] = payload["tokens"]
+
+    # warmup drain: compiles (insert per refill size, the decode/block
+    # program) all land here, so the timed drain measures steady state
+    warm_ids = send_all()
+    worker.drain(total=len(prompts), max_cycles=100_000)
+    receive_outputs(warm_ids)
+
+    batcher = worker.batcher
+    batcher.tokens_emitted = 0
+    batcher.ttft_sum = 0.0
+    batcher.ttft_count = 0
+    batcher.block_tokens = 0
+    batcher.block_capacity = 0
+    worker.timer = SpanTimer()
+    timed_ids = send_all()
+    start = time.perf_counter()
+    worker.drain(total=2 * len(prompts), max_cycles=100_000)
+    elapsed = time.perf_counter() - start
+    outputs = receive_outputs(timed_ids)
+    if len(outputs) != len(prompts):
+        # gate-style failure like every other serve check: a stalled
+        # drain must not surface as a bare assert/KeyError downstream
+        print(
+            f"serve: decode_block={decode_block} drain finished only "
+            f"{len(outputs)}/{len(prompts)} requests",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    cycle = worker.timer.summary()["cycle"]
+    return {
+        "decode_block": decode_block,
+        "tokens_per_second": batcher.tokens_emitted / elapsed,
+        "tokens": batcher.tokens_emitted,
+        "elapsed_s": round(elapsed, 4),
+        "time_to_first_token_s": {
+            "mean": (batcher.ttft_sum / batcher.ttft_count
+                     if batcher.ttft_count else 0.0),
+            "last": batcher.last_ttft_s,
+        },
+        "cycle_s": {
+            "p50": cycle["p50_s"], "p99": cycle["p99_s"],
+            "count": cycle["count"],
+        },
+        "block_utilization": (
+            batcher.block_tokens / batcher.block_capacity
+            if batcher.block_capacity else None
+        ),
+    }, outputs
+
+
+def run_serve_suite(output: str = "BENCH_r10.json", *, messages: int = 32,
+                    prompt_len: int = 8, generate_tokens: int = 64,
+                    batch_size: int = 4, decode_block: int = 16,
+                    min_speedup: float = 1.3) -> dict:
+    """Serving hot-path benchmark: the blocked engine (block decode +
+    batched admission + dispatch-ahead overlap) vs the single-step
+    engine on the SAME seeded queue, same weights, same prompts.
+
+    Two hard gates mirror the acceptance criteria (either violation
+    exits 2): the blocked configuration must reach ``min_speedup``x the
+    single-step tokens/s on this decode-bound config, AND every
+    request's greedy continuation must be byte-identical between the
+    two engines — the pipeline changes scheduling, never results.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    # deliberately decode-bound: a model small enough that per-token
+    # device time is dwarfed by per-token dispatch + sync overhead —
+    # exactly the regime where the single-step engine is Python-bound
+    model = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=prompt_len + generate_tokens, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    rng = np.random.default_rng(10)
+    prompts = [
+        rng.integers(1, model.vocab_size, rng.integers(2, prompt_len + 1))
+        .astype(np.int32)
+        for _ in range(messages)
+    ]
+
+    start = time.perf_counter()
+    kwargs = dict(batch_size=batch_size, prompt_len=prompt_len,
+                  generate_tokens=generate_tokens)
+    single, single_out = _serve_episode(params, model, prompts,
+                                        decode_block=1, **kwargs)
+    blocked, blocked_out = _serve_episode(params, model, prompts,
+                                          decode_block=decode_block,
+                                          **kwargs)
+    elapsed = time.perf_counter() - start
+    divergences = [
+        index for index in range(messages)
+        if single_out[index] != blocked_out[index]
+    ]
+    speedup = blocked["tokens_per_second"] / single["tokens_per_second"]
+    artifact = {
+        "suite": "serve",
+        "elapsed_s": round(elapsed, 2),
+        "config": {
+            "messages": messages, "prompt_len": prompt_len,
+            "generate_tokens": generate_tokens, "batch_size": batch_size,
+            "decode_block": decode_block,
+            "model": {"d_model": model.d_model, "n_layers": model.n_layers,
+                      "n_heads": model.n_heads,
+                      "vocab_size": model.vocab_size},
+        },
+        "single_step": single,
+        "blocked": blocked,
+        "speedup": round(speedup, 2),
+        "parity": {
+            "requests": messages,
+            "divergences": len(divergences),
+            "divergent_requests": divergences[:8],
+        },
+        "gates": {"min_speedup": min_speedup, "parity": "byte-identical"},
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if divergences:
+        print(
+            f"serve: {len(divergences)} request(s) diverged between "
+            f"decode_block=1 and decode_block={decode_block} "
+            f"(first: {divergences[:8]})",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    if speedup < min_speedup:
+        print(
+            f"serve: blocked engine reached only {speedup:.2f}x the "
+            f"single-step tokens/s (gate: {min_speedup}x)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return {
+        "metric": "serve_tokens_per_sec",
+        "value": round(blocked["tokens_per_second"], 1),
+        "unit": (
+            f"tokens/s (decode_block={decode_block}, {messages} requests,"
+            f" 0 parity divergences)"
+        ),
+        "vs_baseline": round(speedup, 2),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
-        choices=("controller", "forecast", "replay", "sweep", "chaos"),
+        choices=("controller", "forecast", "replay", "sweep", "chaos",
+                 "serve"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
         " record/replay fidelity + counterfactual re-scoring; sweep ="
         " compiled-simulator fidelity gate + autotuning parameter sweep;"
         " chaos = resilient-vs-reference failure handling under"
-        " deterministic fault injection",
+        " deterministic fault injection; serve = continuous-serving hot"
+        " path, blocked vs single-step engine (throughput + parity gates)",
     )
     cli.add_argument(
         "--output", default="",
-        help="artifact path for --suite forecast/replay/sweep/chaos"
+        help="artifact path for --suite forecast/replay/sweep/chaos/serve"
         " (defaults: BENCH_r06.json / BENCH_r07.json / BENCH_r08.json /"
-        " BENCH_r09.json)",
+        " BENCH_r09.json / BENCH_r10.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -510,5 +723,7 @@ if __name__ == "__main__":
         print(json.dumps(run_sweep_suite(cli_args.output or "BENCH_r08.json")))
     elif cli_args.suite == "chaos":
         print(json.dumps(run_chaos_suite(cli_args.output or "BENCH_r09.json")))
+    elif cli_args.suite == "serve":
+        print(json.dumps(run_serve_suite(cli_args.output or "BENCH_r10.json")))
     else:
         print(json.dumps(run_bench()))

@@ -358,6 +358,38 @@ class WorkloadMetrics:
         with self._lock:
             self._timers[name] = timer
 
+    def set_serving_gauges(
+        self,
+        *,
+        tokens_per_second: float,
+        time_to_first_token_seconds: float,
+        active_slots: int,
+        decode_block_utilization: float,
+    ) -> None:
+        """The serving hot-path gauge family the continuous worker
+        reports each engine cycle, scraped alongside its cycle-latency
+        summaries (one canonical name per number — dashboards pin these
+        four)."""
+        self.set_gauge(
+            "tokens_per_second", tokens_per_second,
+            "Generated tokens per second over the worker's serving "
+            "lifetime (prefill first tokens included).",
+        )
+        self.set_gauge(
+            "time_to_first_token_seconds", time_to_first_token_seconds,
+            "Mean seconds from request admission to its first generated "
+            "token being host-visible.",
+        )
+        self.set_gauge(
+            "active_slots", active_slots,
+            "Decode slots currently holding an in-flight request.",
+        )
+        self.set_gauge(
+            "decode_block_utilization", decode_block_utilization,
+            "Kept tokens per dispatched block-decode position "
+            "(accepted/block-size; 0 until a block runs).",
+        )
+
     @property
     def ready(self) -> bool:
         """Readiness = at least one gauge sample or timed span recorded."""

@@ -93,6 +93,18 @@ def main(argv=None) -> None:
              "--speculative-draft-layers)",
     )
     parser.add_argument(
+        "--decode-block", type=int, default=1, metavar="B",
+        help="continuous serving: advance every live slot up to B tokens "
+             "per device call (one jitted lax.scan with on-device "
+             "eos/budget masks, double-buffered so host bookkeeping "
+             "overlaps device compute) instead of one token per "
+             "host round-trip; greedy results are identical to "
+             "--decode-block 1 (sampled runs draw the same policy but "
+             "consume RNG keys in a different order; requires "
+             "--continuous; plain decode path only — not with --beams "
+             "or --speculative-draft-layers)",
+    )
+    parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
         help="speculative decoding with an early-exit self-draft: the "
              "model's own first N layers propose tokens and the full "
@@ -203,6 +215,18 @@ def main(argv=None) -> None:
         raise SystemExit("--length-penalty requires --beams > 1")
     if args.quantize_kv and args.generate_tokens < 1:
         raise SystemExit("--quantize-kv requires --generate-tokens >= 1")
+    if args.decode_block < 1:
+        raise SystemExit(f"--decode-block {args.decode_block} must be >= 1")
+    if args.decode_block > 1:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --beams checks above)
+        if not args.continuous:
+            raise SystemExit("--decode-block requires --continuous")
+        if args.beams > 1 or args.speculative_draft_layers:
+            raise SystemExit(
+                "--decode-block applies to the plain continuous decode "
+                "path (not --beams / --speculative-draft-layers)"
+            )
     prefix_ids: list[int] = []
     if args.prefix_ids:
         try:
@@ -360,6 +384,7 @@ def main(argv=None) -> None:
         result_queue_url=args.result_queue_url,
         eos_id=None if args.eos_id < 0 else args.eos_id,
         quantized_kv=args.quantize_kv,
+        decode_block=args.decode_block,
     )
     tokenizer = None
     if args.tokenizer:
@@ -770,13 +795,17 @@ def main(argv=None) -> None:
 
 def _maybe_serve_metrics(port: int, worker):
     """Start /metrics with the worker's serve-cycle SpanTimer attached
-    (``--metrics-port 0`` = disabled)."""
+    (``--metrics-port 0`` = disabled).  Continuous workers additionally
+    publish the serving gauges (tokens/s, time-to-first-token, active
+    slots, decode-block utilization), refreshed every engine cycle."""
     if not port:
         return None
     from ..obs import ObservabilityServer, WorkloadMetrics
 
     metrics = WorkloadMetrics()
     metrics.attach_timer("worker", worker.timer)
+    if hasattr(worker, "attach_metrics"):
+        worker.attach_metrics(metrics)
     server = ObservabilityServer(metrics, port=port)
     server.start()
     return server

@@ -15,12 +15,20 @@ families serve through one slot machine.
 
 TPU shape discipline: there are only two compiled programs —
 
-- ``decode_step`` (the existing one): advances all ``batch`` slots one
-  position, active or not (inactive rows compute garbage that is never
-  read — lockstep static shapes beat dynamic batch reshapes);
-- ``insert`` : prefill one prompt (padded to a fixed bucket) as a
-  ``[1, P]`` batch and ``dynamic_update_slice`` its layer caches into the
-  slot's row, set the row's length, and return the first sampled token.
+- the decode program: at ``decode_block == 1`` one ``decode_step`` that
+  advances all ``batch`` slots one position, active or not (inactive
+  rows compute garbage that is never read — lockstep static shapes beat
+  dynamic batch reshapes); at ``decode_block > 1`` a
+  :func:`.decode.block_decode` scan that advances every live slot up to
+  ``decode_block`` tokens per device call with on-device per-row
+  liveness masks, double-buffered so the host settles/refills cycle N
+  while block N+1 is already running;
+- ``insert``: prefill a refill cycle's prompts (each padded to a fixed
+  bucket) as ONE ``[M, P]`` batch and ``dynamic_update_slice`` their
+  layer caches into the slots' rows, folding the per-row lengths,
+  pending tokens, and liveness masks into the returned state — no
+  per-request device ops, no host sync (first tokens settle in one
+  deferred transfer).
 
 Sampling is :func:`.decode._pick` — the one policy every decode path
 shares (greedy at temperature 0, else temperature/top-k/top-p), keyed
@@ -39,6 +47,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -52,60 +61,83 @@ from .decode import _pick, init_cache, prefill
 log = logging.getLogger(__name__)
 
 
-def _insert_row_impl(
+def _insert_rows_impl(
     params: dict,
     cache: dict,
-    row: jax.Array,
-    prompt: jax.Array,
-    length: jax.Array,
+    current: jax.Array,
+    done: jax.Array,
+    remaining: jax.Array,
+    rows: jax.Array,
+    prompts: jax.Array,
+    lengths: jax.Array,
     key: jax.Array | None,
     config: Any,
     prompt_len: int,
+    n_rows: int,
+    budget: int,
     family: str = "gpt",
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
     quantized_kv: bool = False,
     prefix_len: int = 0,
+    eos_id: int | None = None,
     prefix_cache: dict | None = None,
-) -> tuple[dict, jax.Array]:
-    """Prefill ``prompt`` (int32 ``[prompt_len]``, right-padded to the
-    static bucket) and splice it into slot ``row`` of ``cache``.
+) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Batched admission: prefill ``n_rows`` prompts (int32
+    ``[n_rows, prompt_len]``, right-padded to the static bucket) as ONE
+    batch and splice each into its slot row of ``cache``.
 
-    Returns ``(cache, first_token)`` — the slot's length is the prompt's
-    real length and its first continuation token (greedy or sampled by
-    the shared ``_pick`` policy with ``key``) is ready to feed the next
-    ``decode_step``.  ``family`` picks the prefill: the gpt path or the
-    llama GQA path — the splice is layout-agnostic (every cache entry
-    puts the batch row on axis 0 and the POSITION on axis 2: ``[B, H,
-    S, D]`` codes/values and ``[B, H, S]`` scales alike, so one
-    axis-2 slice serves both the bf16 and the int8 layouts).
+    The whole refill cycle is one device call: per-row lengths, the
+    pending next-token state (``current``), and the block-decode
+    liveness masks (``done`` cleared — or set where the first token IS
+    ``eos_id`` — and ``remaining`` re-armed to ``budget - 1``; the first
+    token spends one) all fold into the returned state, so admission
+    costs no per-request device ops and no host sync at all — the first
+    tokens come back as a device ``[n_rows]`` array the caller consumes
+    in one deferred transfer.
 
-    ``prefix_len > 0`` (with ``prefix_cache``): the prompt is a SUFFIX
+    ``family`` picks the prefill: the gpt path or the llama GQA path —
+    the splice is layout-agnostic (every cache entry puts the batch row
+    on axis 0 and the POSITION on axis 2: ``[B, H, S, D]`` codes/values
+    and ``[B, H, S]`` scales alike, so one axis-2 slice serves both the
+    bf16 and the int8 layouts).
+
+    ``prefix_len > 0`` (with ``prefix_cache``): the prompts are SUFFIXES
     continuing from a shared prefix — the prefill runs through
     ``prefill_with_prefix``, only the suffix region ``[prefix_len,
     prefix_len + prompt_len)`` is spliced (the batch cache's rows
     already hold the broadcast prefix, which slot reuse never
-    overwrites — decode writes at ``length >= prefix_len``), and the
+    overwrites — decode writes at ``length >= prefix_len``), and each
     slot's length starts past the prefix.
     """
-    logits, row_cache = _row_prefill(
-        params, prompt, length, config, family, quantized_kv, prefix_len,
+    logits, rows_cache = _rows_prefill(
+        params, prompts, lengths, config, family, quantized_kv, prefix_len,
         prefix_cache,
     )
-    new_layers = _splice_row_layers(cache, row_cache, row, prefix_len,
-                                    prompt_len)
-    lengths = jax.lax.dynamic_update_index_in_dim(
-        cache["length"], prefix_len + length, row, 0
+    new_layers = _splice_rows_layers(cache, rows_cache, rows, prefix_len,
+                                     prompt_len, n_rows)
+    full_lengths = cache["length"].at[rows].set(prefix_len + lengths)
+    firsts = _pick(logits, key, temperature, top_k, top_p)
+    current = current.at[rows].set(firsts)
+    first_done = (
+        firsts == eos_id if eos_id is not None
+        else jnp.zeros((n_rows,), bool)
     )
-    first = _pick(logits, key, temperature, top_k, top_p)[0]
-    return {"layers": new_layers, "length": lengths}, first
+    done = done.at[rows].set(first_done)
+    remaining = remaining.at[rows].set(budget - 1)
+    return (
+        {"layers": new_layers, "length": full_lengths},
+        current, done, remaining, firsts,
+    )
 
 
-def _row_prefill(params, prompt, length, config, family, quantized_kv,
-                 prefix_len, prefix_cache):
-    """One prompt's prefill as a ``[1, P]`` batch through the family's
-    layout variant; returns ``(logits [1, V], row_cache)``."""
+def _rows_prefill(params, prompts, lengths, config, family, quantized_kv,
+                  prefix_len, prefix_cache):
+    """``M`` prompts' prefill as one ``[M, P]`` batch through the
+    family's layout variant; returns ``(logits [M, V], rows_cache)``.
+    Rows never interact across the batch axis, so the results are
+    bitwise what ``M`` separate ``[1, P]`` prefills would produce."""
     if prefix_len:
         if quantized_kv:
             if family == "llama":
@@ -118,9 +150,7 @@ def _row_prefill(params, prompt, length, config, family, quantized_kv,
             from .llama import llama_prefill_with_prefix as pf
         else:
             from .decode import prefill_with_prefix as pf
-        return pf(
-            params, prefix_cache, prompt[None], config, lengths=length[None]
-        )
+        return pf(params, prefix_cache, prompts, config, lengths=lengths)
     if quantized_kv:
         if family == "llama":
             from .llama import llama_quantized_prefill as prefill_fn
@@ -130,7 +160,44 @@ def _row_prefill(params, prompt, length, config, family, quantized_kv,
         from .llama import llama_prefill as prefill_fn
     else:
         prefill_fn = prefill
-    return prefill_fn(params, prompt[None], config, lengths=length[None])
+    return prefill_fn(params, prompts, config, lengths=lengths)
+
+
+def _row_prefill(params, prompt, length, config, family, quantized_kv,
+                 prefix_len, prefix_cache):
+    """One prompt's prefill as a ``[1, P]`` batch (the ``M = 1`` case of
+    :func:`_rows_prefill` — kept for the beam/speculative inserts, whose
+    per-slot state is seeded one request at a time)."""
+    return _rows_prefill(params, prompt[None], length[None], config, family,
+                         quantized_kv, prefix_len, prefix_cache)
+
+
+def _splice_rows_layers(cache, rows_cache, rows, prefix_len, prompt_len,
+                        n_rows):
+    """Splice each of ``n_rows`` prefilled rows' prompt positions into
+    its slot row of the batch cache (the multi-row generalization of
+    :func:`_splice_row_layers`: one ``dynamic_update_slice`` per row per
+    entry, all inside the one compiled insert); returns the new layers
+    list."""
+    new_layers = []
+    for layer_cache, rows_layer in zip(cache["layers"], rows_cache["layers"]):
+        entry = {}
+        for name, buf in layer_cache.items():
+            # keep only the prompt positions (axis 2 for [M, H, S, D]
+            # codes/values and [M, H, S] scales alike; under a prefix,
+            # the suffix positions only)
+            pieces = jax.lax.slice_in_dim(
+                rows_layer[name], prefix_len, prefix_len + prompt_len, axis=2
+            )
+            for i in range(n_rows):
+                start = (rows[i], 0, prefix_len) + (0,) * (buf.ndim - 3)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, jax.lax.slice_in_dim(pieces, i, i + 1, axis=0),
+                    start,
+                )
+            entry[name] = buf
+        new_layers.append(entry)
+    return new_layers
 
 
 def _splice_row_layers(cache, row_cache, row, prefix_len, prompt_len,
@@ -166,6 +233,7 @@ def _spec_insert_row_impl(
     params: dict,
     cache: dict,
     draft_cache: dict,
+    current: jax.Array,
     row: jax.Array,
     prompt: jax.Array,
     length: jax.Array,
@@ -180,13 +248,15 @@ def _spec_insert_row_impl(
     quantized_kv: bool = False,
     prefix_len: int = 0,
     prefix_cache: dict | None = None,
-) -> tuple[dict, dict, jax.Array]:
-    """:func:`_insert_row_impl` for speculative slots: ONE target prefill
-    populates both caches — the early-exit self-draft is the target's
-    first ``draft_layers`` layers, and layer ``i``'s k/v depend only on
-    layers ``< i``, so the draft's row cache is literally the layer-wise
-    prefix of the target's (same trick as
-    :func:`.speculative.draft_prefix_from_target`)."""
+) -> tuple[dict, dict, jax.Array, jax.Array]:
+    """:func:`_insert_rows_impl` for speculative slots: ONE target
+    prefill populates both caches — the early-exit self-draft is the
+    target's first ``draft_layers`` layers, and layer ``i``'s k/v depend
+    only on layers ``< i``, so the draft's row cache is literally the
+    layer-wise prefix of the target's (same trick as
+    :func:`.speculative.draft_prefix_from_target`).  The slot's pending
+    token folds into the returned ``current`` like the plain and beam
+    inserts — no per-submit device op or host sync."""
     logits, row_cache = _row_prefill(
         params, prompt, length, config, family, quantized_kv, prefix_len,
         prefix_cache,
@@ -204,19 +274,22 @@ def _spec_insert_row_impl(
         draft_cache["length"], prefix_len + length, row, 0
     )
     first = _pick(logits, key, temperature, top_k, top_p)[0]
+    current = current.at[row].set(first)
     return (
         {"layers": new_layers, "length": lengths},
         {"layers": new_draft_layers, "length": draft_lengths},
+        current,
         first,
     )
 
 
-_insert_row = partial(
+_insert_rows = partial(
     jax.jit,
-    static_argnames=("config", "prompt_len", "family", "temperature",
-                     "top_k", "top_p", "quantized_kv", "prefix_len"),
-    donate_argnums=(1,),
-)(_insert_row_impl)
+    static_argnames=("config", "prompt_len", "n_rows", "budget", "family",
+                     "temperature", "top_k", "top_p", "quantized_kv",
+                     "prefix_len", "eos_id"),
+    donate_argnums=(1, 2, 3, 4),
+)(_insert_rows_impl)
 
 
 _spec_insert_row = partial(
@@ -224,7 +297,7 @@ _spec_insert_row = partial(
     static_argnames=("config", "prompt_len", "draft_layers", "family",
                      "temperature", "top_k", "top_p", "quantized_kv",
                      "prefix_len"),
-    donate_argnums=(1, 2),
+    donate_argnums=(1, 2, 3),
 )(_spec_insert_row_impl)
 
 
@@ -248,7 +321,7 @@ def _beam_insert_row_impl(
     eos_id: int | None = None,
     prefix_cache: dict | None = None,
 ) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """:func:`_insert_row_impl` for beam slots: one prefill seeds the
+    """:func:`_insert_rows_impl` for beam slots: one prefill seeds the
     slot's ``beams`` cache rows and its device-side search state — the
     first expansion's top-``beams`` tokens become the beams' seeds
     (scores, first output column, alive mask), exactly the standalone
@@ -310,6 +383,8 @@ class _Slot:
     # (the serving-side signal for tuning draft_tokens / draft_layers)
     rounds: int = 0
     accepted: int = 0
+    # admission wall-clock, for the time-to-first-token gauge
+    submitted_at: float = 0.0
 
 
 class ContinuousBatcher:
@@ -347,9 +422,18 @@ class ContinuousBatcher:
         draft_tokens: int = 4,
         beams: int = 1,
         length_penalty: float = 0.0,
+        decode_block: int = 1,
     ) -> None:
         if beams < 1:
             raise ValueError(f"beams={beams} must be >= 1")
+        if decode_block < 1:
+            raise ValueError(f"decode_block={decode_block} must be >= 1")
+        if decode_block > 1 and (beams > 1 or draft_layers):
+            raise ValueError(
+                "decode_block > 1 applies to the plain decode path (beam "
+                "steps and speculative rounds already amortize their own "
+                "device calls)"
+            )
         if beams > 1:
             # beam slots: each slot owns `beams` contiguous cache rows
             # and a device-side search state; deterministic by
@@ -425,9 +509,23 @@ class ContinuousBatcher:
         self.draft_tokens = draft_tokens
         self.beams = beams
         self.length_penalty = length_penalty
+        self.decode_block = decode_block
         # aggregate speculative stats (per-request stats ride the slots)
         self.spec_rounds = 0
         self.spec_accepted = 0
+        # serving stats (the worker's metrics gauges read these)
+        self.tokens_emitted = 0
+        self.ttft_sum = 0.0
+        self.ttft_count = 0
+        self.last_ttft_s: float | None = None
+        # block-decode utilization: kept tokens vs dispatched positions
+        self.block_tokens = 0
+        self.block_capacity = 0
+        # deferred first tokens: (device array, slot rows), consumed in
+        # one batched transfer at the next step()
+        self._pending_firsts: list[tuple[Any, list[int]]] = []
+        # in-flight decode block: (tokens, counts, busy-at-dispatch)
+        self._pending_block: tuple[Any, Any, int] | None = None
         # beam slots own `beams` contiguous cache rows each
         cache_rows = batch_size * beams
         if prefix_cache is not None:
@@ -493,6 +591,14 @@ class ContinuousBatcher:
         self.slots = [_Slot() for _ in range(batch_size)]
         # each slot's pending input token(s) for the next decode step
         self._current = jnp.zeros((cache_rows,), jnp.int32)
+        if beams == 1 and not draft_layers:
+            # plain slots keep their liveness ON DEVICE: done marks a
+            # free/finished row (admission clears it), remaining is the
+            # row's unspent token budget — what lets a decode block (and
+            # its dispatch-ahead overlap) run without consulting the
+            # host between tokens
+            self._done = jnp.ones((cache_rows,), bool)
+            self._remaining = jnp.zeros((cache_rows,), jnp.int32)
         if beams > 1:
             # device-side per-slot search state (the standalone
             # beam_search's scan carry, re-hosted as rolling state)
@@ -524,6 +630,10 @@ class ContinuousBatcher:
             self._rows_shard = NamedSharding(mesh, P("data"))
             self.cache = jax.device_put(self.cache, self._cache_shard)
             self._current = jax.device_put(self._current, self._rows_shard)
+            if beams == 1 and not draft_layers:
+                self._done = jax.device_put(self._done, self._rows_shard)
+                self._remaining = jax.device_put(self._remaining,
+                                                 self._rows_shard)
             if beams > 1:
                 # slot-major state: slots over "data" (each slot's beam
                 # rows stay contiguous within one shard because
@@ -562,45 +672,98 @@ class ContinuousBatcher:
             self._insert = self._make_spec_insert()
             self._spec = self._make_spec_round()
         else:
-            self._insert = self._make_insert()
-            self._decode = self._make_decode_step()
+            self._insert_many = self._make_insert_many()
+            if decode_block > 1:
+                self._block_fn = self._make_block_fn()
+            else:
+                self._decode = self._make_decode_step()
 
-    def _make_insert(self):
+    def _make_insert_many(self):
+        """The plain path's batched-admission jit: ``(params, cache,
+        current, done, remaining, rows, prompts, lengths, key, n_rows)``
+        with ``n_rows`` static (one compiled program per refill size —
+        at most ``batch_size`` of them)."""
         statics = dict(
             config=self.config, prompt_len=self.prompt_len,
+            budget=self.generate_tokens,
             family=self.family, temperature=self.temperature,
             top_k=self.top_k, top_p=self.top_p,
             quantized_kv=self.quantized_kv,
-            prefix_len=self.prefix_len,
+            prefix_len=self.prefix_len, eos_id=self.eos_id,
         )
         if self.mesh is None:
-            return lambda params, cache, row, prompt, length, key: (
-                _insert_row(params, cache, row, prompt, length, key,
-                            prefix_cache=self._prefix_cache, **statics)
+            return lambda *operands, n_rows: _insert_rows(
+                *operands, n_rows=n_rows,
+                prefix_cache=self._prefix_cache, **statics,
             )
-        return self._mesh_insert_jit(_insert_row_impl, statics,
-                                     (self._cache_shard,))
-
-    def _mesh_insert_jit(self, impl, statics, cache_shards):
-        """The one mesh insert wiring the plain and speculative inserts
-        share: pinned in/out shardings with the cache operands donated,
-        and — under a prefix — the shared batch-1 prefix riding as an
-        explicit trailing operand (heads over "model", batch
-        replicated), injected by a closure so both returned callables
-        keep their prefix-free signature."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .train import param_shardings
 
         rep = NamedSharding(self.mesh, P())
         p_shard = param_shardings(self.mesh, self.params)
-        scalar_ops = (rep, rep, rep, rep)  # row, prompt, length, key
-        donate = tuple(range(1, 1 + len(cache_shards)))
+        rows = self._rows_shard
+        # rows/prompts/lengths/key are tiny per-refill operands — they
+        # replicate, like the single-prompt insert's scalars did
+        in_ops = (p_shard, self._cache_shard, rows, rows, rows,
+                  rep, rep, rep, rep)
+        out_ops = (self._cache_shard, rows, rows, rows, rep)
+        if self._prefix_cache is not None:
+            from .decode import prefix_cache_shardings
+
+            pfx_shard = prefix_cache_shardings(self.mesh, self._prefix_cache)
+            placed_prefix = jax.device_put(self._prefix_cache, pfx_shard)
+        jits: dict[int, Any] = {}
+
+        def insert_many(*operands, n_rows):
+            fn = jits.get(n_rows)
+            if fn is None:
+                if self._prefix_cache is None:
+                    fn = jax.jit(
+                        partial(_insert_rows_impl, n_rows=n_rows, **statics),
+                        in_shardings=in_ops, out_shardings=out_ops,
+                        donate_argnums=(1, 2, 3, 4),
+                    )
+                else:
+                    def _with_prefix(*args, _n=n_rows):
+                        *ops, prefix = args
+                        return _insert_rows_impl(
+                            *ops, n_rows=_n, prefix_cache=prefix, **statics
+                        )
+
+                    inner = jax.jit(
+                        _with_prefix,
+                        in_shardings=(*in_ops, pfx_shard),
+                        out_shardings=out_ops,
+                        donate_argnums=(1, 2, 3, 4),
+                    )
+                    fn = lambda *ops, _f=inner: _f(*ops, placed_prefix)
+                jits[n_rows] = fn
+            return fn(*operands)
+
+        return insert_many
+
+    def _mesh_insert_jit(self, impl, statics, cache_shards):
+        """The speculative insert's mesh wiring: pinned in/out shardings
+        with the cache operands AND the folded ``current`` donated, and —
+        under a prefix — the shared batch-1 prefix riding as an explicit
+        trailing operand (heads over "model", batch replicated),
+        injected by a closure so the returned callable keeps its
+        prefix-free signature."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        p_shard = param_shardings(self.mesh, self.params)
+        # current, then row, prompt, length, key
+        state_ops = (self._rows_shard, rep, rep, rep, rep)
+        donate = tuple(range(1, 2 + len(cache_shards)))
         if self._prefix_cache is None:
             return jax.jit(
                 partial(impl, **statics),
-                in_shardings=(p_shard, *cache_shards, *scalar_ops),
-                out_shardings=(*cache_shards, rep),
+                in_shardings=(p_shard, *cache_shards, *state_ops),
+                out_shardings=(*cache_shards, self._rows_shard, rep),
                 donate_argnums=donate,
             )
         from .decode import prefix_cache_shardings
@@ -614,13 +777,16 @@ class ContinuousBatcher:
 
         fn = jax.jit(
             _with_prefix,
-            in_shardings=(p_shard, *cache_shards, *scalar_ops, pfx_shard),
-            out_shardings=(*cache_shards, rep),
+            in_shardings=(p_shard, *cache_shards, *state_ops, pfx_shard),
+            out_shardings=(*cache_shards, self._rows_shard, rep),
             donate_argnums=donate,
         )
         return lambda *operands: fn(*operands, placed_prefix)
 
-    def _make_decode_step(self):
+    def _family_step_fn(self):
+        """The family/layout decode step every plain-path program shares
+        (single-step, block scan, and the beam step pick theirs the same
+        way)."""
         if self.quantized_kv:
             if self.family == "llama":
                 from .llama import llama_quantized_decode_step as step_fn
@@ -630,7 +796,10 @@ class ContinuousBatcher:
             from .llama import llama_decode_step as step_fn
         else:
             from .decode import decode_step as step_fn
+        return step_fn
 
+    def _make_decode_step(self):
+        step_fn = self._family_step_fn()
         config = self.config
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
 
@@ -656,6 +825,43 @@ class ContinuousBatcher:
             donate_argnums=(1,),
         )
 
+    def _make_block_fn(self):
+        """The compiled decode block (``decode_block > 1``): a
+        :func:`.decode.block_decode` scan over the family step, cache and
+        per-row liveness state donated so the buffers roll in place
+        block after block."""
+        from .decode import block_decode
+
+        step_fn = self._family_step_fn()
+        config = self.config
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        eos_id = self.eos_id
+
+        def blk(params, cache, current, done, remaining, keys):
+            return block_decode(
+                params, cache, current, done, remaining, keys, config,
+                step_fn, temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id,
+            )
+
+        if self.mesh is None:
+            return jax.jit(blk, donate_argnums=(1, 2, 3, 4))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        rows = self._rows_shard
+        tokens_shard = NamedSharding(self.mesh, P(None, "data"))
+        return jax.jit(
+            blk,
+            in_shardings=(param_shardings(self.mesh, self.params),
+                          self._cache_shard, rows, rows, rows, rep),
+            out_shardings=(self._cache_shard, rows, rows, rows,
+                           tokens_shard, rows),
+            donate_argnums=(1, 2, 3, 4),
+        )
+
     def _make_spec_insert(self):
         statics = dict(
             config=self.config, prompt_len=self.prompt_len,
@@ -666,9 +872,10 @@ class ContinuousBatcher:
             prefix_len=self.prefix_len,
         )
         if self.mesh is None:
-            return lambda params, cache, dcache, row, prompt, length, key: (
-                _spec_insert_row(params, cache, dcache, row, prompt,
-                                 length, key,
+            return lambda params, cache, dcache, current, row, prompt, \
+                    length, key: (
+                _spec_insert_row(params, cache, dcache, current, row,
+                                 prompt, length, key,
                                  prefix_cache=self._prefix_cache,
                                  **statics)
             )
@@ -838,16 +1045,7 @@ class ContinuousBatcher:
         re-hosted with an ``active`` mask so free/finished slots neither
         reorder nor emit (the same compute-always discipline as the
         plain and speculative steps)."""
-        if self.quantized_kv:
-            if self.family == "llama":
-                from .llama import llama_quantized_decode_step as step_fn
-            else:
-                from .decode import quantized_decode_step as step_fn
-        elif self.family == "llama":
-            from .llama import llama_decode_step as step_fn
-        else:
-            from .decode import decode_step as step_fn
-
+        step_fn = self._family_step_fn()
         config = self.config
         eos_id = self.eos_id
         W = self.beams
@@ -990,7 +1188,25 @@ class ContinuousBatcher:
                         slot.done = True
         for row, slot in enumerate(self.slots):
             if slot.busy and (slot.done or slot.rounds >= slot.budget - 1):
-                finished.append((slot.payload, self._beam_best(row)))
+                best = self._beam_best(row)
+                # count kept tokens like _emit does for the other paths:
+                # everything up to and including the first eos, never the
+                # padding after it (a budget-64 request that ends at
+                # token 3 emitted 3 tokens, not 64)
+                kept = int(best.size)
+                if self.eos_id is not None:
+                    hits = np.flatnonzero(best == self.eos_id)
+                    if hits.size:
+                        kept = int(hits[0]) + 1
+                self.tokens_emitted += kept
+                # beam search has no incremental first token — the best
+                # beam is only known at completion — so TTFT is the time
+                # until the request's first token is *available*: finish
+                ttft = time.perf_counter() - slot.submitted_at
+                self.ttft_sum += ttft
+                self.ttft_count += 1
+                self.last_ttft_s = ttft
+                finished.append((slot.payload, best))
                 self.slots[row] = _Slot()
         return finished
 
@@ -1002,20 +1218,81 @@ class ContinuousBatcher:
     def active(self) -> int:
         return sum(s.busy for s in self.slots)
 
+    def _pad_prompt(self, token_ids) -> tuple[np.ndarray, int]:
+        """Truncate/right-pad one prompt to the static ``prompt_len``
+        bucket (empty prompts count one pad token)."""
+        ids = np.zeros((self.prompt_len,), np.int32)
+        real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
+        ids[: real.size] = real
+        return ids, max(1, real.size)
+
     def submit(self, token_ids: np.ndarray, payload: Any = None) -> int:
         """Prefill one request into a free slot; returns the slot index.
 
         ``token_ids`` is truncated/right-padded to the batcher's static
-        ``prompt_len`` bucket (empty prompts count one pad token).
+        ``prompt_len`` bucket (empty prompts count one pad token).  The
+        single-request case of :meth:`submit_many` — like it, the first
+        token stays on device until the next :meth:`step` (no per-submit
+        host sync).
         """
+        return self.submit_many([(token_ids, payload)])[0]
+
+    def submit_many(
+        self, requests: list[tuple[np.ndarray, Any]]
+    ) -> list[int]:
+        """Admit ``requests`` (``(token_ids, payload)`` pairs) into free
+        slots; returns their slot indices in order.
+
+        Plain slots: ONE jitted multi-row insert prefills every prompt
+        as an ``[M, P]`` batch and folds the per-row lengths, pending
+        tokens, and block-liveness masks into the returned device state —
+        one device call and ZERO host syncs per refill cycle, where
+        per-request :meth:`submit` used to pay a blocking ``int(first)``
+        plus an extra ``.at[row].set`` dispatch each.  First tokens are
+        consumed in a single batched transfer at the next :meth:`step`.
+
+        Beam and speculative slots admit sequentially (their inserts
+        seed per-slot search/draft state) but share the deferred
+        first-token sync.
+        """
+        if not requests:
+            return []
         free = self.free_slots
-        if not free:
-            raise RuntimeError("no free slot; call step() until one opens")
-        row = free[0]
-        ids = np.zeros((self.prompt_len,), np.int32)
-        real = np.asarray(token_ids, np.int32).reshape(-1)[: self.prompt_len]
-        ids[: real.size] = real
-        length = max(1, real.size)
+        if len(requests) > len(free):
+            raise RuntimeError(
+                f"no free slot for {len(requests)} request(s) "
+                f"({len(free)} free); call step() until slots open"
+            )
+        rows = free[: len(requests)]
+        now = time.perf_counter()
+        if self.beams > 1 or self.draft_layers:
+            for row, (token_ids, payload) in zip(rows, requests):
+                self._submit_one(row, token_ids, payload, now)
+            return rows
+        padded = [self._pad_prompt(ids) for ids, _ in requests]
+        prompts = np.stack([ids for ids, _ in padded])
+        lengths = np.asarray([ln for _, ln in padded], np.int32)
+        (self.cache, self._current, self._done, self._remaining,
+         firsts) = self._insert_many(
+            self.params, self.cache, self._current, self._done,
+            self._remaining, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(prompts), jnp.asarray(lengths),
+            next(self._keys), n_rows=len(rows),
+        )
+        self._pending_firsts.append((firsts, list(rows)))
+        for row, (_, payload) in zip(rows, requests):
+            # a fresh record per request: step() replaces finished slots
+            # with new _Slot()s, but resetting here keeps the per-request
+            # contract independent of that cleanup path
+            self.slots[row] = _Slot(
+                busy=True, budget=self.generate_tokens, payload=payload,
+                submitted_at=now,
+            )
+        return rows
+
+    def _submit_one(self, row, token_ids, payload, now) -> None:
+        """Sequential admission for beam and speculative slots."""
+        ids, length = self._pad_prompt(token_ids)
         if self.beams > 1:
             (self.cache, self._beam_scores, self._beam_out,
              self._beam_alive, self._beam_emitted,
@@ -1029,90 +1306,57 @@ class ContinuousBatcher:
             # without any (the insert's first expansion is the answer)
             self.slots[row] = _Slot(
                 busy=True, budget=self.generate_tokens, payload=payload,
+                submitted_at=now,
             )
-            return row
-        if self.draft_layers:
-            self.cache, self.draft_cache, first = self._insert(
-                self.params, self.cache, self.draft_cache,
-                jnp.asarray(row, jnp.int32), jnp.asarray(ids),
-                jnp.asarray(length, jnp.int32), next(self._keys),
-            )
-        else:
-            self.cache, first = self._insert(
-                self.params, self.cache, jnp.asarray(row, jnp.int32),
-                jnp.asarray(ids), jnp.asarray(length, jnp.int32),
-                next(self._keys),
-            )
-        first = int(first)
-        self._current = self._current.at[row].set(first)
-        # a fresh record per request: step() replaces finished slots with
-        # new _Slot()s, but resetting here keeps the per-request
-        # rounds/accepted contract independent of that cleanup path
-        slot = _Slot(
-            busy=True, produced=[first], budget=self.generate_tokens,
-            done=self.eos_id is not None and first == self.eos_id,
-            payload=payload,
+            return
+        (self.cache, self.draft_cache, self._current,
+         first) = self._insert(
+            self.params, self.cache, self.draft_cache, self._current,
+            jnp.asarray(row, jnp.int32), jnp.asarray(ids),
+            jnp.asarray(length, jnp.int32), next(self._keys),
         )
-        self.slots[row] = slot
-        return row
+        self._pending_firsts.append((first, [row]))
+        self.slots[row] = _Slot(
+            busy=True, budget=self.generate_tokens, payload=payload,
+            submitted_at=now,
+        )
+
+    def _emit(self, slot: _Slot, token: int) -> None:
+        """Append one kept token to a slot — THE one place the eos check
+        and the emitted-token counter live (every decode mode's host
+        loop funnels through here, so parity across modes is parity of
+        device programs, not of bookkeeping)."""
+        slot.produced.append(token)
+        self.tokens_emitted += 1
+        if self.eos_id is not None and token == self.eos_id:
+            slot.done = True
+
+    def _settle_pending_firsts(self) -> None:
+        """Consume deferred first tokens — one batched device transfer
+        per admission call instead of one blocking sync per request —
+        and record time-to-first-token."""
+        if not self._pending_firsts:
+            return
+        pending, self._pending_firsts = self._pending_firsts, []
+        now = time.perf_counter()
+        for arr, rows in pending:
+            vals = np.asarray(arr).reshape(-1)
+            for token, row in zip(vals, rows):
+                slot = self.slots[row]
+                self._emit(slot, int(token))
+                ttft = now - slot.submitted_at
+                self.ttft_sum += ttft
+                self.ttft_count += 1
+                self.last_ttft_s = ttft
 
     def _needs_decode(self, slot: _Slot) -> bool:
         return slot.busy and not slot.done and len(slot.produced) < slot.budget
 
-    def step(self) -> list[tuple[Any, np.ndarray]]:
-        """Advance every active slot; return finished requests as
-        ``(payload, continuation_tokens)`` pairs (their slots are free
-        again on return).  Plain slots advance ONE token per step;
-        speculative slots (``draft_layers > 0``) advance 1..k+1 tokens —
-        one draft-and-verify round.  Finished = budget reached or eos
-        emitted; either way the tokens are padded with ``eos_id`` to the
-        budget (matching ``generate``'s post-eos padding).  No-op when
-        nothing is active."""
-        if self.active == 0:
-            return []
-        if self.beams > 1:
-            return self._step_beam()
+    def _finish_ready(self) -> list[tuple[Any, np.ndarray]]:
+        """Free every slot whose request completed; returns the finished
+        ``(payload, tokens)`` pairs, eos-padded to the budget exactly
+        like ``generate``."""
         finished = []
-        needs = [self._needs_decode(s) for s in self.slots]
-        # rows whose budget is a single token (or that already hit eos)
-        # never need a decode step
-        if self.draft_layers and any(needs):
-            active = jnp.asarray(needs)
-            if self.mesh is not None:
-                active = jax.device_put(active, self._rows_shard)
-            (self.cache, self.draft_cache, self._current, round_tokens,
-             n) = self._spec(
-                self.params, self.draft_params, self.cache,
-                self.draft_cache, self._current, active, next(self._keys),
-            )
-            toks_host = np.asarray(round_tokens)
-            n_host = np.asarray(n)
-            for row, slot in enumerate(self.slots):
-                if not needs[row]:
-                    continue
-                slot.rounds += 1
-                slot.accepted += int(n_host[row])
-                self.spec_rounds += 1
-                self.spec_accepted += int(n_host[row])
-                for token in toks_host[row, : int(n_host[row]) + 1]:
-                    if slot.done or len(slot.produced) >= slot.budget:
-                        break
-                    token = int(token)
-                    slot.produced.append(token)
-                    if self.eos_id is not None and token == self.eos_id:
-                        slot.done = True
-        elif any(needs):
-            self.cache, nxt = self._decode(
-                self.params, self.cache, self._current, next(self._keys)
-            )
-            nxt_host = np.asarray(nxt)
-            for row, slot in enumerate(self.slots):
-                if needs[row]:
-                    token = int(nxt_host[row])
-                    slot.produced.append(token)
-                    if self.eos_id is not None and token == self.eos_id:
-                        slot.done = True
-            self._current = nxt
         for row, slot in enumerate(self.slots):
             if slot.busy and (slot.done or len(slot.produced) >= slot.budget):
                 tokens = slot.produced
@@ -1127,6 +1371,157 @@ class ContinuousBatcher:
                 )
                 self.slots[row] = _Slot()
         return finished
+
+    def step(self) -> list[tuple[Any, np.ndarray]]:
+        """Advance every active slot; return finished requests as
+        ``(payload, continuation_tokens)`` pairs (their slots are free
+        again on return).  Plain slots advance ONE token per step
+        (``decode_block`` of them per device call when ``decode_block >
+        1`` — results identical, scheduling coarser); speculative slots
+        (``draft_layers > 0``) advance 1..k+1 tokens per round, two
+        rounds pipelined when completion is provable in advance.
+        Finished = budget reached or eos emitted; either way the tokens
+        are padded with ``eos_id`` to the budget (matching ``generate``'s
+        post-eos padding).  No-op when nothing is active."""
+        if self.active == 0:
+            return []
+        if self.beams > 1:
+            return self._step_beam()
+        if self.draft_layers:
+            return self._step_spec()
+        if self.decode_block > 1:
+            return self._step_block()
+        return self._step_single()
+
+    def _step_single(self) -> list[tuple[Any, np.ndarray]]:
+        """The unpipelined engine cycle (``decode_block == 1``): one
+        token per device call, host-consumed immediately — today's
+        behavior, byte for byte, and the bench's comparison baseline."""
+        self._settle_pending_firsts()
+        # rows whose budget is a single token (or that already hit eos)
+        # never need a decode step
+        needs = [self._needs_decode(s) for s in self.slots]
+        if any(needs):
+            self.cache, nxt = self._decode(
+                self.params, self.cache, self._current, next(self._keys)
+            )
+            nxt_host = np.asarray(nxt)
+            for row, slot in enumerate(self.slots):
+                if needs[row]:
+                    self._emit(slot, int(nxt_host[row]))
+            self._current = nxt
+        return self._finish_ready()
+
+    def _block_keys(self):
+        if self.temperature > 0.0 or self.mesh is not None:
+            return jnp.stack(
+                [next(self._keys) for _ in range(self.decode_block)]
+            )
+        # greedy single-chip: _pick ignores the key operand (same dummy
+        # generate() scans over)
+        return jnp.zeros((self.decode_block, 2), jnp.uint32)
+
+    def _step_block(self) -> list[tuple[Any, np.ndarray]]:
+        """The pipelined engine cycle (``decode_block > 1``): dispatch
+        block N+1 BEFORE consuming block N.
+
+        The on-device ``done``/``remaining`` masks make the dispatch
+        independent of block N's outcome — rows that finish mid-block
+        stay frozen on device, rows admitted this cycle were folded in
+        by the insert — so the host's entire settle/reply/refill pass
+        for cycle N overlaps device compute for cycle N+1.  The sync is
+        one ``np.asarray`` of an already-dispatched (usually finished)
+        block, not an eager wait on the block just launched.
+        """
+        new_block = None
+        busy = sum(s.busy for s in self.slots)
+        if busy:
+            (self.cache, self._current, self._done, self._remaining,
+             tokens, counts) = self._block_fn(
+                self.params, self.cache, self._current, self._done,
+                self._remaining, self._block_keys(),
+            )
+            new_block = (tokens, counts, busy)
+        self._settle_pending_firsts()
+        pending, self._pending_block = self._pending_block, new_block
+        if pending is not None:
+            tokens, counts, dispatched_busy = pending
+            toks_host = np.asarray(tokens)
+            counts_host = np.asarray(counts)
+            self.block_capacity += self.decode_block * dispatched_busy
+            self.block_tokens += int(counts_host.sum())
+            for row, slot in enumerate(self.slots):
+                if not slot.busy:
+                    continue
+                # rows admitted after this block was dispatched idled
+                # through it frozen (count 0); post-eos positions were
+                # never counted — the host keeps a contiguous prefix
+                for token in toks_host[: int(counts_host[row]), row]:
+                    if slot.done or len(slot.produced) >= slot.budget:
+                        break
+                    self._emit(slot, int(token))
+        return self._finish_ready()
+
+    def _dispatch_spec_round(self, mask: list[bool]):
+        """Launch one draft-and-verify round over the masked rows;
+        returns the (device-resident) ``(round_tokens, n)`` pair."""
+        active = jnp.asarray(mask)
+        if self.mesh is not None:
+            active = jax.device_put(active, self._rows_shard)
+        (self.cache, self.draft_cache, self._current, round_tokens,
+         n) = self._spec(
+            self.params, self.draft_params, self.cache,
+            self.draft_cache, self._current, active, next(self._keys),
+        )
+        return round_tokens, n
+
+    def _consume_spec_round(self, mask: list[bool], handle) -> None:
+        round_tokens, n = handle
+        toks_host = np.asarray(round_tokens)
+        n_host = np.asarray(n)
+        for row, slot in enumerate(self.slots):
+            if not mask[row]:
+                continue
+            slot.rounds += 1
+            slot.accepted += int(n_host[row])
+            self.spec_rounds += 1
+            self.spec_accepted += int(n_host[row])
+            for token in toks_host[row, : int(n_host[row]) + 1]:
+                if slot.done or len(slot.produced) >= slot.budget:
+                    break
+                self._emit(slot, int(token))
+
+    def _step_spec(self) -> list[tuple[Any, np.ndarray]]:
+        """One (or two, pipelined) draft-and-verify rounds.
+
+        Deferred sync: a row that will need another round even on FULL
+        acceptance of the in-flight one (``produced + k + 1 < budget``)
+        is known NOW, so its next round is dispatched before the host
+        consumes this round's ``(round_tokens, n)`` — the first consume
+        then overlaps the second round's device time.  ``eos_id`` makes
+        any row's completion unknowable in advance, so the overlap only
+        engages for eos-free serving; masked-off rows keep their pending
+        token and catch up next cycle, which also caps the cache
+        overshoot at the same ``budget + k`` bound a single worst-case
+        round already has (the 2k slack reserved at construction).
+        """
+        self._settle_pending_firsts()
+        needs = [self._needs_decode(s) for s in self.slots]
+        if any(needs):
+            first_round = self._dispatch_spec_round(needs)
+            k1 = self.draft_tokens + 1
+            certain = [
+                needs[row] and self.eos_id is None
+                and len(slot.produced) + k1 < slot.budget
+                for row, slot in enumerate(self.slots)
+            ]
+            second_round = (
+                self._dispatch_spec_round(certain) if any(certain) else None
+            )
+            self._consume_spec_round(needs, first_round)
+            if second_round is not None:
+                self._consume_spec_round(certain, second_round)
+        return self._finish_ready()
 
 
 class ContinuousWorker:
@@ -1195,6 +1590,7 @@ class ContinuousWorker:
             draft_tokens=draft_tokens,
             beams=beams,
             length_penalty=length_penalty,
+            decode_block=service_config.decode_block,
         )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as
@@ -1204,6 +1600,10 @@ class ContinuousWorker:
         self.timer = SpanTimer()
         self._stop = None  # lazily a threading.Event in run_forever
         self._poll_backoff = 0
+        # optional WorkloadMetrics registry (attach_metrics); gauges
+        # refresh once per engine cycle
+        self.metrics = None
+        self._served_since: float | None = None
 
     # poll throttle: after an EMPTY zero-wait receive while slots are
     # still decoding, skip this many cycles before polling again — one
@@ -1251,6 +1651,7 @@ class ContinuousWorker:
         )
         if not messages and self.batcher.active:
             self._poll_backoff = self.POLL_BACKOFF_CYCLES
+        admit = []
         for message in messages:
             ids = parse_request_body(message["Body"], self.tokenizer)
             if ids is None:
@@ -1259,12 +1660,51 @@ class ContinuousWorker:
                 # counted as processed work
                 self._settle(message, None)
                 continue
-            self.batcher.submit(ids, payload=message)
+            admit.append((ids, message))
+        if admit:
+            # batched admission: the whole refill prefills in ONE jitted
+            # multi-row insert (plain slots; beam/speculative admit
+            # sequentially inside submit_many)
+            self.batcher.submit_many(admit)
         return len(messages)
 
+    def attach_metrics(self, metrics) -> None:
+        """Report the serving gauges (tokens/s, time-to-first-token,
+        active slots, block utilization) to a
+        :class:`~..obs.WorkloadMetrics` registry, refreshed every engine
+        cycle."""
+        self.metrics = metrics
+        self._update_metrics()
+
+    def _update_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        batcher = self.batcher
+        elapsed = (
+            time.perf_counter() - self._served_since
+            if self._served_since is not None else 0.0
+        )
+        self.metrics.set_serving_gauges(
+            tokens_per_second=(
+                batcher.tokens_emitted / elapsed if elapsed > 0 else 0.0
+            ),
+            time_to_first_token_seconds=(
+                batcher.ttft_sum / batcher.ttft_count
+                if batcher.ttft_count else 0.0
+            ),
+            active_slots=batcher.active,
+            decode_block_utilization=(
+                batcher.block_tokens / batcher.block_capacity
+                if batcher.block_capacity else 0.0
+            ),
+        )
+
     def run_once(self) -> int:
-        """One engine cycle: refill free slots, advance one token, settle
-        finished requests.  Returns messages completed this cycle."""
+        """One engine cycle: refill free slots, advance the decode block
+        (one token per slot at ``decode_block=1``), settle finished
+        requests.  Returns messages completed this cycle."""
+        if self._served_since is None:
+            self._served_since = time.perf_counter()
         self._refill()
         done = self.batcher.step()
         for message, tokens in done:
@@ -1272,6 +1712,7 @@ class ContinuousWorker:
         if done:
             self._poll_backoff = 0  # a slot just freed: poll right away
         self.processed += len(done)
+        self._update_metrics()
         return len(done)
 
     def stop(self) -> None:

@@ -574,6 +574,78 @@ def chunk_decode(
                               write_and_attend)
 
 
+def block_decode(
+    params: dict,
+    cache: dict,
+    current: jax.Array,
+    done: jax.Array,
+    remaining: jax.Array,
+    keys: jax.Array,
+    config: ModelConfig,
+    step_fn=None,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Advance every live row up to ``block = keys.shape[0]`` tokens in
+    ONE compiled call — a ``lax.scan`` of decode steps with on-device
+    per-row liveness masks, so the host pays one dispatch + one sync per
+    *block* instead of per token.
+
+    Per-row state (all ``[batch]``, owned by the caller across calls):
+
+    - ``current``: the next input token (the last emitted one);
+    - ``done``: the row emitted ``eos_id`` (or holds no request at all —
+      frozen rows start every block with ``done=True``);
+    - ``remaining``: tokens the row may still emit (its budget).
+
+    A row is **live** at a scan step iff ``~done & (remaining > 0)``.
+    Live rows run exactly the single-step computation (same
+    :func:`decode_step`/:func:`_pick` math — per-row results are
+    byte-identical to single-stepping, because rows never interact across
+    the batch axis).  Frozen rows still *compute* (lockstep static shapes,
+    the same discipline as every other masked path here) but neither
+    advance — their ``length`` is restored to its pre-step value, so the
+    stray k/v write lands at a fixed already-dead position that the next
+    admission overwrites — nor emit, nor consume budget.
+
+    Liveness is monotone (``done`` only sets, ``remaining`` only falls),
+    so each row's emissions form a contiguous PREFIX of the block:
+    returns ``(cache, current, done, remaining, tokens [block, batch],
+    counts [batch])`` where ``tokens[:counts[b], b]`` are row ``b``'s
+    kept tokens this block (post-eos positions hold a pad the host never
+    reads).  ``eos_id`` sets ``done`` the step it is emitted — the eos
+    itself is a kept token, exactly like the single-step host loop.
+    """
+    if step_fn is None:
+        step_fn = decode_step
+    pad = eos_id if eos_id is not None else 0
+
+    def body(carry, key):
+        cache, current, done, remaining = carry
+        live = ~done & (remaining > 0)
+        logits, stepped = step_fn(params, cache, current, config)
+        nxt = _pick(logits, key, temperature, top_k, top_p)
+        emitted = jnp.where(live, nxt, pad)
+        if eos_id is not None:
+            done = done | (live & (nxt == eos_id))
+        remaining = jnp.where(live, remaining - 1, remaining)
+        current = jnp.where(live, nxt, current)
+        cache = dict(
+            stepped,
+            length=jnp.where(live, stepped["length"], cache["length"]),
+        )
+        return (cache, current, done, remaining), (emitted, live)
+
+    (cache, current, done, remaining), (tokens, lives) = jax.lax.scan(
+        body, (cache, current, done, remaining), keys
+    )
+    counts = jnp.sum(lives.astype(jnp.int32), axis=0)
+    return cache, current, done, remaining, tokens, counts
+
+
 # ---------------------------------------------------------------------------
 # Prefix caching: share one prompt prefix's KV across a batch of requests
 # ---------------------------------------------------------------------------

@@ -170,6 +170,14 @@ class ServiceConfig:
     # bytes per token — decode.quantized_decode_step); weights-int8 is a
     # separate, composable choice (the quantize module)
     quantized_kv: bool = False
+    # continuous serving only: tokens the engine advances per device
+    # call (decode.block_decode).  1 = the single-step engine; > 1
+    # amortizes the per-token dispatch + host sync over a block and
+    # double-buffers blocks against host bookkeeping — greedy results
+    # are identical (eos-masked on device, post-eos tokens discarded),
+    # only scheduling granularity changes; sampled runs stay
+    # distribution-exact but consume RNG keys in a different order.
+    decode_block: int = 1
     # request/reply: when set, the worker publishes one JSON result per
     # input message to this queue (after compute, before deleting the
     # input — at-least-once semantics, so consumers must tolerate
@@ -192,6 +200,10 @@ class ServiceConfig:
         if not 0.0 < self.top_p <= 1.0:
             raise ValueError(
                 f"top_p={self.top_p} must be in (0, 1] (1.0 = off)"
+            )
+        if self.decode_block < 1:
+            raise ValueError(
+                f"decode_block={self.decode_block} must be >= 1"
             )
 
 

@@ -637,3 +637,272 @@ def test_worker_binary_continuous_beams_demo():
     main(["--demo", "3", "--batch-size", "2", "--seq-len", "8",
           "--generate-tokens", "4", "--continuous", "--beams", "2",
           "--quantize-kv", "--prefix-ids", "5,6", "--family", "llama"])
+
+
+# ---------------------------------------------------------------------------
+# Block decode (decode_block > 1): the pipelined serving hot path must
+# change SCHEDULING only — every request's greedy output byte-identical
+# to the single-step engine and to per-request generate.
+# ---------------------------------------------------------------------------
+
+
+def test_block_batcher_outputs_equal_per_request_generate():
+    # block=3 with slot reuse: requests outnumber slots, budgets don't
+    # divide the block, and the dispatch-ahead pipeline must still
+    # produce exactly what per-request generate produces
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=3, prompt_len=12, generate_tokens=5,
+        decode_block=3,
+    )
+    requests = prompts(7)
+    results = _drain(batcher, requests)
+    assert len(results) == 7
+    for idx, ids in enumerate(requests):
+        np.testing.assert_array_equal(
+            results[idx], reference_continuation(params, ids, 5),
+            err_msg=f"request {idx}",
+        )
+    # every kept token was counted; capacity >= kept (frozen tail steps)
+    assert batcher.tokens_emitted == 7 * 5
+    assert 0 < batcher.block_tokens <= batcher.block_capacity
+
+
+def test_block_eos_at_every_offset_matches_single_step():
+    # eos firing at each offset within the block: the device mask must
+    # freeze the row mid-block and the host must discard post-eos
+    # positions — outputs, freed slots, and padding byte-identical to
+    # both generate and the single-step engine
+    params = init_params(jax.random.key(0), TINY)
+    ids = prompts(1, rng_seed=31, max_len=8)[0]
+    plain = reference_continuation(params, ids, 6)
+    for offset in range(6):
+        eos = int(plain[offset])
+        ref = np.asarray(generate(
+            params, jnp.asarray(ids, jnp.int32)[None], 6, TINY, eos_id=eos
+        )[0])
+        blocked = ContinuousBatcher(
+            params, TINY, batch_size=2, prompt_len=8, generate_tokens=6,
+            eos_id=eos, decode_block=4,
+        )
+        single = ContinuousBatcher(
+            params, TINY, batch_size=2, prompt_len=8, generate_tokens=6,
+            eos_id=eos, decode_block=1,
+        )
+        out_b = _drain(blocked, [ids])
+        out_s = _drain(single, [ids])
+        np.testing.assert_array_equal(out_b[0], ref,
+                                      err_msg=f"offset {offset} (blocked)")
+        np.testing.assert_array_equal(out_s[0], ref,
+                                      err_msg=f"offset {offset} (single)")
+        # the slot freed in both engines; no stale pending state
+        assert blocked.active == 0 and single.active == 0
+        assert blocked.tokens_emitted == single.tokens_emitted
+
+
+def test_block_worker_drains_queue_with_replies():
+    # worker-level parity: same queue drained by block=4 and block=1
+    # workers — same processed counts, same reply payloads per request
+    params = init_params(jax.random.key(0), TINY)
+    reqs = prompts(5, rng_seed=32)
+
+    def run(block):
+        queue = FakeMessageQueue()
+        body_by_id = {}
+        for ids in reqs:
+            body = json.dumps(ids.tolist())
+            body_by_id[queue.send_message(URL, body)] = body
+        results = FakeMessageQueue()
+        worker = ContinuousWorker(
+            queue, params, TINY,
+            ServiceConfig(queue_url=URL, batch_size=2, seq_len=12,
+                          generate_tokens=4, decode_block=block,
+                          result_queue_url="fake://results"),
+            result_queue=results,
+        )
+        assert worker.drain(total=5, max_cycles=500) == 5
+        attrs = queue.get_queue_attributes(URL, ())
+        assert attrs["ApproximateNumberOfMessages"] == "0"
+        assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+        replies = {}
+        for message in results.receive_messages("fake://results",
+                                                max_messages=10):
+            payload = json.loads(message["Body"])
+            replies[body_by_id[payload["request_id"]]] = payload["tokens"]
+        return replies
+
+    blocked, single = run(4), run(1)
+    assert blocked == single and len(blocked) == 5
+
+
+def test_submit_many_equals_sequential_submits():
+    # one [M, P] admission insert vs M sequential submits: identical
+    # cache contents, lengths, pending tokens — and identical outputs
+    # when both batchers then run to completion
+    params = init_params(jax.random.key(0), TINY)
+    requests = prompts(3, rng_seed=33)
+    many = ContinuousBatcher(
+        params, TINY, batch_size=3, prompt_len=12, generate_tokens=4,
+    )
+    seq = ContinuousBatcher(
+        params, TINY, batch_size=3, prompt_len=12, generate_tokens=4,
+    )
+    rows = many.submit_many(
+        [(ids, idx) for idx, ids in enumerate(requests)]
+    )
+    assert rows == [seq.submit(ids, payload=idx)
+                    for idx, ids in enumerate(requests)]
+    np.testing.assert_array_equal(np.asarray(many._current),
+                                  np.asarray(seq._current))
+    np.testing.assert_array_equal(np.asarray(many._done),
+                                  np.asarray(seq._done))
+    np.testing.assert_array_equal(np.asarray(many._remaining),
+                                  np.asarray(seq._remaining))
+    np.testing.assert_array_equal(np.asarray(many.cache["length"]),
+                                  np.asarray(seq.cache["length"]))
+    for layer_m, layer_s in zip(many.cache["layers"], seq.cache["layers"]):
+        for name in layer_m:
+            np.testing.assert_allclose(
+                np.asarray(layer_m[name]), np.asarray(layer_s[name]),
+                err_msg=name,
+            )
+    out_m = _drain(many, [])
+    out_s = _drain(seq, [])
+    assert len(out_m) == len(out_s) == 3
+    for idx in out_m:
+        np.testing.assert_array_equal(out_m[idx], out_s[idx])
+
+
+def test_submit_many_rejects_overflow():
+    import pytest
+
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=8, generate_tokens=2,
+    )
+    with pytest.raises(RuntimeError, match="free slot"):
+        batcher.submit_many([(ids, i) for i, ids in
+                             enumerate(prompts(3, rng_seed=34, max_len=8))])
+
+
+def test_block_sampled_slots_terminate_in_vocab():
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=8, generate_tokens=5,
+        temperature=0.8, top_k=20, top_p=0.95, sample_seed=7,
+        decode_block=3,
+    )
+    results = _drain(batcher, prompts(3, rng_seed=35, max_len=8))
+    assert len(results) == 3
+    for tokens in results.values():
+        assert tokens.shape == (5,)
+        assert (tokens >= 0).all() and (tokens < TINY.vocab_size).all()
+
+
+def test_block_quantized_and_prefix_compose():
+    # decode_block composes with the int8 cache and with a shared
+    # prefix: outputs equal the corresponding generate paths exactly
+    from kube_sqs_autoscaler_tpu.workloads.decode import prefill_prefix
+
+    params = init_params(jax.random.key(0), TINY)
+    requests = prompts(4, rng_seed=36)
+    quantized = _drain(ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        quantized_kv=True, eos_id=5, decode_block=3,
+    ), requests)
+    assert len(quantized) == 4
+    for idx, ids in enumerate(requests):
+        ref = np.asarray(generate(
+            params, jnp.asarray(ids, jnp.int32)[None], 4, TINY,
+            quantized_cache=True, eos_id=5,
+        )[0])
+        np.testing.assert_array_equal(quantized[idx], ref,
+                                      err_msg=f"request {idx}")
+
+    prefix = jnp.arange(1, 7, dtype=jnp.int32)
+    pc = prefill_prefix(params, prefix, TINY)
+    with_prefix = _drain(ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        prefix_cache=pc, decode_block=2,
+    ), requests)
+    assert len(with_prefix) == 4
+    for idx, ids in enumerate(requests):
+        concat = jnp.concatenate([prefix, jnp.asarray(ids, jnp.int32)])
+        ref = np.asarray(generate(params, concat[None], 4, TINY)[0])
+        np.testing.assert_array_equal(with_prefix[idx], ref,
+                                      err_msg=f"request {idx}")
+
+
+def test_sharded_block_batcher_equals_single_chip():
+    from kube_sqs_autoscaler_tpu.workloads.train import (
+        make_mesh,
+        param_shardings,
+    )
+
+    params = init_params(jax.random.key(0), TINY)
+    mesh = make_mesh(jax.devices()[:4], model_parallel=2, seq_parallel=1)
+    placed = jax.device_put(params, param_shardings(mesh, params))
+    requests = prompts(5, rng_seed=37)
+    plain = _drain(ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        decode_block=2,
+    ), requests)
+    sharded = _drain(ContinuousBatcher(
+        placed, TINY, batch_size=2, prompt_len=12, generate_tokens=4,
+        decode_block=2, mesh=mesh,
+    ), requests)
+    assert len(sharded) == 5
+    for idx in plain:
+        np.testing.assert_array_equal(sharded[idx], plain[idx],
+                                      err_msg=f"request {idx}")
+
+
+def test_worker_binary_continuous_decode_block_demo():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "5", "--continuous", "--decode-block", "4",
+                 "--batch-size", "2", "--seq-len", "12",
+                 "--generate-tokens", "6", "--eos-id", "5"])
+
+
+def test_speculative_overlap_rounds_equal_generate():
+    # budgets deep enough that rows PROVABLY need another round even on
+    # full acceptance -> the deferred-sync second round engages (two
+    # rounds per step(), the second dispatched before the first is
+    # host-consumed); outputs must still equal per-request generate
+    params = init_params(jax.random.key(0), TINY)
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=9,
+        draft_layers=1, draft_tokens=2,
+    )
+    requests = prompts(4, rng_seed=38)
+    results = _drain(batcher, requests)
+    assert len(results) == 4
+    for idx, ids in enumerate(requests):
+        np.testing.assert_array_equal(
+            results[idx], reference_continuation(params, ids, 9),
+            err_msg=f"request {idx}",
+        )
+    assert batcher.spec_rounds > 0
+
+
+def test_beam_slots_count_kept_tokens_and_ttft():
+    # beam serving stats: tokens_emitted counts tokens up to and
+    # including the first eos (never the padding after it), and TTFT is
+    # recorded at completion (beam search has no incremental first token)
+    from kube_sqs_autoscaler_tpu.workloads.beam import beam_search
+
+    params = init_params(jax.random.key(0), TINY)
+    ids = prompts(1, rng_seed=41)[0]
+    plain = np.asarray(beam_search(
+        params, TINY, jnp.asarray(ids, jnp.int32)[None], 6, beams=2,
+    )[0])
+    eos = int(plain[2])  # fires before the budget by construction
+    batcher = ContinuousBatcher(
+        params, TINY, batch_size=2, prompt_len=12, generate_tokens=6,
+        beams=2, eos_id=eos,
+    )
+    (out,) = _drain(batcher, [ids]).values()
+    kept = list(out).index(eos) + 1 if eos in out else out.size
+    assert batcher.tokens_emitted == kept < 6
+    assert batcher.ttft_count == 1 and batcher.ttft_sum > 0

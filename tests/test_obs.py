@@ -636,3 +636,25 @@ def test_journal_flag_defaults():
     assert args.journal_path == ""
     assert args.journal_ring == 256
     assert args.journal_max_bytes == 64 * 1024 * 1024
+
+
+def test_workload_metrics_serving_gauges():
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+
+    metrics = WorkloadMetrics()
+    metrics.set_serving_gauges(
+        tokens_per_second=1234.5,
+        time_to_first_token_seconds=0.01,
+        active_slots=3,
+        decode_block_utilization=0.75,
+    )
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    assert f"{prefix}_tokens_per_second 1234.5" in text
+    assert f"{prefix}_time_to_first_token_seconds 0.01" in text
+    assert f"{prefix}_active_slots 3.0" in text
+    assert f"{prefix}_decode_block_utilization 0.75" in text
+    # each carries HELP text (escaped by the registry)
+    for name in ("tokens_per_second", "time_to_first_token_seconds",
+                 "active_slots", "decode_block_utilization"):
+        assert f"# HELP {prefix}_{name} " in text, name

@@ -1,0 +1,145 @@
+"""Serving hot-path gates (tier-1 smoke + slow full bench).
+
+The smoke run pins the serve suite's whole contract on a tiny config —
+blocked engine vs single-step engine on the same seeded queue, gated on
+byte-identical greedy outputs (``min_speedup=0`` keeps the throughput
+gate out of the fast tier, where a loaded CI host would make it flaky) —
+plus the serving gauges the worker publishes.  The full decode-bound
+bench (the committed ``BENCH_r10.json`` numbers, >= 1.3x gate) runs in
+the slow tier.
+"""
+
+import json
+
+import pytest
+
+from bench import run_serve_suite
+
+
+def test_serve_suite_smoke_parity_block4(tmp_path):
+    out = tmp_path / "bench_serve.json"
+    headline = run_serve_suite(
+        str(out), messages=6, prompt_len=8, generate_tokens=8,
+        batch_size=2, decode_block=4, min_speedup=0.0,
+    )
+    artifact = json.loads(out.read_text())
+    assert artifact["parity"]["divergences"] == 0
+    assert artifact["parity"]["requests"] == 6
+    # every request generated its full budget on both engines
+    assert artifact["single_step"]["tokens"] == 6 * 8
+    assert artifact["blocked"]["tokens"] == 6 * 8
+    assert 0.0 < artifact["blocked"]["block_utilization"] <= 1.0
+    assert artifact["single_step"]["block_utilization"] is None
+    assert "0 parity divergences" in headline["unit"]
+
+
+@pytest.mark.slow
+def test_serve_suite_full_gate(tmp_path):
+    # the committed-artifact configuration: decode-bound model, >=1.3x
+    # throughput gate AND exact greedy parity (SystemExit(2) otherwise)
+    out = tmp_path / "bench_r10.json"
+    headline = run_serve_suite(str(out))
+    artifact = json.loads(out.read_text())
+    assert artifact["speedup"] >= 1.3
+    assert artifact["parity"]["divergences"] == 0
+    assert headline["vs_baseline"] >= 1.3
+
+
+def test_continuous_worker_serving_gauges(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+    from kube_sqs_autoscaler_tpu.workloads.continuous import ContinuousWorker
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    queue = FakeMessageQueue()
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        queue.send_message(
+            "fake://jobs", json.dumps(rng.integers(1, 64, 5).tolist())
+        )
+    worker = ContinuousWorker(
+        queue, params, config,
+        ServiceConfig(queue_url="fake://jobs", batch_size=2, seq_len=8,
+                      generate_tokens=4, decode_block=2),
+    )
+    metrics = WorkloadMetrics()
+    worker.attach_metrics(metrics)
+    assert worker.drain(total=3, max_cycles=200) == 3
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    for name in ("tokens_per_second", "time_to_first_token_seconds",
+                 "active_slots", "decode_block_utilization"):
+        assert f"# TYPE {prefix}_{name} gauge" in text, name
+    # 3 requests x 4 tokens drained: throughput and TTFT are live numbers
+    gauges = {
+        line.split(" ")[0]: float(line.split(" ")[1])
+        for line in text.splitlines()
+        if line.startswith(prefix) and " " in line and "{" not in line
+    }
+    assert gauges[f"{prefix}_tokens_per_second"] > 0
+    assert gauges[f"{prefix}_time_to_first_token_seconds"] > 0
+    assert gauges[f"{prefix}_active_slots"] == 0  # drained
+    assert 0 < gauges[f"{prefix}_decode_block_utilization"] <= 1
+
+
+def test_decode_block_flag_rejections():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    with pytest.raises(SystemExit, match="--continuous"):
+        worker_main(["--demo", "1", "--generate-tokens", "2",
+                     "--decode-block", "4"])
+    with pytest.raises(SystemExit, match="plain continuous decode"):
+        worker_main(["--demo", "1", "--continuous", "--generate-tokens",
+                     "2", "--decode-block", "4", "--beams", "2"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        worker_main(["--demo", "1", "--continuous", "--generate-tokens",
+                     "2", "--decode-block", "0"])
+
+
+def test_service_config_rejects_bad_decode_block():
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    with pytest.raises(ValueError, match="decode_block"):
+        ServiceConfig(queue_url="fake://x", decode_block=0)
+
+
+def test_batcher_rejects_decode_block_combos():
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    with pytest.raises(ValueError, match="decode_block"):
+        ContinuousBatcher(params, config, batch_size=2, prompt_len=8,
+                          generate_tokens=4, decode_block=0)
+    with pytest.raises(ValueError, match="plain decode path"):
+        ContinuousBatcher(params, config, batch_size=2, prompt_len=8,
+                          generate_tokens=4, decode_block=4, beams=2)
+    with pytest.raises(ValueError, match="plain decode path"):
+        ContinuousBatcher(params, config, batch_size=2, prompt_len=8,
+                          generate_tokens=4, decode_block=4,
+                          draft_layers=1)
