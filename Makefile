@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve replay-demo chaos-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet replay-demo chaos-demo fleet-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -72,6 +72,14 @@ bench-chaos:
 bench-serve:
 	JAX_PLATFORMS=cpu python bench.py --suite serve
 
+# Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
+# real ContinuousWorker replicas over one shared queue, with a
+# deterministic mid-episode replica kill; exits non-zero unless every
+# request is answered exactly once (zero lost, zero duplicated) and the
+# scale episode really scaled up and back down; writes BENCH_r11.json
+bench-fleet:
+	JAX_PLATFORMS=cpu python bench.py --suite fleet
+
 # The fidelity gate alone (no JAX, seconds): record a short simulated
 # episode, replay it, fail on any decision divergence
 replay-demo:
@@ -83,6 +91,14 @@ replay-demo:
 # half-open probe, the fleet recovers — exits 2 on any missing milestone
 chaos-demo:
 	python -m kube_sqs_autoscaler_tpu.sim.faults
+
+# Deterministic FakeClock fleet episode (CPU JAX, seconds): backlog
+# spawns replicas (shared params + adopted compiled engine), a fault
+# plan kills a busy replica, its in-flight requests re-dispatch to
+# survivors with reply dedup, the drained queue scales the fleet back
+# down — exits 2 on any missing milestone
+fleet-demo:
+	JAX_PLATFORMS=cpu python -m kube_sqs_autoscaler_tpu.fleet
 
 # TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
 # the real chip; writes WORKBENCH.json

@@ -43,6 +43,13 @@ batched admission + dispatch-ahead double-buffering) against the
 single-step engine on the same seeded queue, hard-gated on >= 1.3x
 tokens/s AND byte-identical greedy outputs; writes ``BENCH_r10.json``.
 
+``--suite fleet`` closes the real loop (`fleet/`): a ControlLoop
+autoscales a pool of in-process ContinuousWorker replicas over one
+shared queue, a deterministic fault plan kills a replica mid-episode,
+and the battery hard-gates ZERO lost and ZERO duplicated requests while
+scoring scale-up/down episodes end-to-end in tokens/s, TTFT, and
+time-over-TTFT-SLO; writes ``BENCH_r11.json``.
+
 ``--suite sweep`` drives the compiled closed-loop simulator
 (`sim/compiled.py`): first the fidelity gate (`verify_fidelity` — the
 compiled `lax.scan` episodes must reproduce the real-`ControlLoop` sim
@@ -523,23 +530,17 @@ def _serve_episode(params, model, prompts, *, batch_size, prompt_len,
         return ids_by_message
 
     def receive_outputs(ids_by_message):
-        outputs = {}
-        while True:
-            batch = results.receive_messages(
-                "bench://serve-results", max_messages=16
-            )
-            if not batch:
-                return outputs
-            for message in batch:
-                # delete as we read: an undeleted reply would reappear
-                # after the fake's visibility timeout and leak the warm
-                # run's MessageIds into the timed collection
-                results.delete_message(
-                    "bench://serve-results", message["ReceiptHandle"]
-                )
-                payload = json.loads(message["Body"])
-                index = ids_by_message[payload["request_id"]]
-                outputs[index] = payload["tokens"]
+        # collect_replies deletes as it reads (an undeleted reply would
+        # reappear after the fake's visibility timeout and leak the warm
+        # run's MessageIds into the timed collection) and dedups by
+        # request id (at-least-once replies must never double-count)
+        from kube_sqs_autoscaler_tpu.workloads.service import collect_replies
+
+        replies, _ = collect_replies(results, "bench://serve-results")
+        return {
+            ids_by_message[rid]: payload["tokens"]
+            for rid, payload in replies.items()
+        }
 
     # warmup drain: compiles (insert per refill size, the decode/block
     # program) all land here, so the timed drain measures steady state
@@ -693,12 +694,289 @@ def run_serve_suite(output: str = "BENCH_r10.json", *, messages: int = 32,
     }
 
 
+def _fleet_episode(
+    model, params, prompts, *, queue_url, batch_size, prompt_len,
+    generate_tokens, decode_block, min_replicas, max_replicas, initial,
+    engine_source=None, policy=None, fault_plan=None, ttft_slo_s=0.25,
+    require_scale_down=False,
+):
+    """One fleet episode over a fresh seeded queue: drive the pool (and,
+    with ``policy``, a real ControlLoop autoscaling it) until every
+    request is answered — scored in serving terms (tokens/s, TTFT,
+    time-over-TTFT-SLO), never fluid queue depth."""
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop
+    from kube_sqs_autoscaler_tpu.fleet import FleetDriver, WorkerPool
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    config = ServiceConfig(
+        queue_url=queue_url, batch_size=batch_size, seq_len=prompt_len,
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        result_queue_url=f"{queue_url}-results",
+    )
+    sent = [
+        queue.send_message(queue_url, json.dumps(ids.tolist()))
+        for ids in prompts
+    ]
+    pool = WorkerPool.serving(
+        queue, params, model, config, result_queue=results,
+        min=min_replicas, max=max_replicas, initial=initial,
+        engine_source=engine_source, drain_timeout_cycles=2000,
+    )
+    loop = None
+    if policy is not None:
+        loop = ControlLoop(
+            pool,
+            QueueMetricSource(queue, queue_url,
+                              ("ApproximateNumberOfMessages",)),
+            policy,
+        )
+    driver = FleetDriver(pool, loop, fault_plan=fault_plan)
+    served_at: list[float] = []
+
+    def finished() -> bool:
+        if pool.processed < len(prompts) or not pool.idle:
+            return False
+        if not served_at:
+            # the instant the last request settled — throughput is
+            # scored to here; the scale-down tail that follows is idle
+            # by construction and must not dilute tokens/s
+            served_at.append(time.perf_counter())
+        if require_scale_down:
+            from kube_sqs_autoscaler_tpu.fleet import DRAINING
+
+            return pool.replicas == min_replicas and not any(
+                r.state == DRAINING for r in pool.members
+            )
+        return True
+
+    start = time.perf_counter()
+    stats = driver.run(max_cycles=200_000, until=finished)
+    elapsed = time.perf_counter() - start
+    serve_elapsed = (served_at[0] - start) if served_at else elapsed
+    replies, duplicates = collect_replies(results, config.result_queue_url)
+    tokens = sum(r.worker.batcher.tokens_emitted for r in pool.members)
+    ttft = sorted(
+        t for r in pool.members for t in r.worker.batcher.ttft_samples
+    )
+    over_slo = [t - ttft_slo_s for t in ttft if t > ttft_slo_s]
+    episode = {
+        "requests": len(prompts),
+        "replies": len(replies),
+        "lost": len(set(sent) - set(replies)),
+        "duplicate_replies": duplicates,
+        "redispatched": pool.redispatched_total,
+        "released": pool.released_total,
+        "elapsed_s": round(elapsed, 3),
+        "serve_elapsed_s": round(serve_elapsed, 3),
+        "cycles": stats["cycles"],
+        "ticks": stats["ticks"],
+        "replica_trajectory": stats["replica_trajectory"],
+        "final_replicas": pool.replicas,
+        "tokens": tokens,
+        "tokens_per_second": round(tokens / serve_elapsed, 1),
+        "time_to_first_token_s": {
+            # admission-to-first-token (queue wait before admission is
+            # the autoscaler's score, not the engine's)
+            "mean": round(sum(ttft) / len(ttft), 5) if ttft else None,
+            "p95": round(ttft[int(0.95 * (len(ttft) - 1))], 5)
+            if ttft else None,
+        },
+        "ttft_slo_s": ttft_slo_s,
+        "requests_over_ttft_slo": len(over_slo),
+        "time_over_ttft_slo_s": round(sum(over_slo), 4),
+        "events": [e.name for e in pool.events],
+    }
+    return episode, pool
+
+
+def run_fleet_suite(output: str = "BENCH_r11.json", *, messages: int = 64,
+                    prompt_len: int = 8, generate_tokens: int = 48,
+                    batch_size: int = 4, decode_block: int = 4) -> dict:
+    """The fleet chaos battery: the ControlLoop autoscaling REAL serving
+    replicas, scored end-to-end in serving terms.
+
+    Three episodes over identical prompt sets:
+
+    - **single** — one pinned replica (the baseline the fleet's
+      tokens/s is compared against);
+    - **scale** — min=1/max=3 under a real ControlLoop: the backlog must
+      scale the fleet up and the drained queue must scale it back down,
+      with tokens/s, TTFT, and time-over-TTFT-SLO reported;
+    - **kill** — two replicas, a FleetFaultPlan kills one mid-episode
+      with requests in flight.
+
+    Hard gates (exit 2 on violation), mirroring the acceptance criteria:
+    the kill episode completes with ZERO lost and ZERO duplicated
+    requests (and actually re-dispatched something — a kill that
+    orphaned nothing gates nothing); every episode answers every request
+    exactly once; the scale episode's trajectory really scaled up AND
+    back down; replica spin-up shares params + compiled engine (no
+    model rebuild — also pinned by tests/test_fleet.py).
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.core.loop import LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    model = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=prompt_len + generate_tokens, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, model.vocab_size, rng.integers(2, prompt_len + 1))
+        .astype(np.int32)
+        for _ in range(messages)
+    ]
+    kwargs = dict(batch_size=batch_size, prompt_len=prompt_len,
+                  generate_tokens=generate_tokens,
+                  decode_block=decode_block)
+
+    start = time.perf_counter()
+    # warmup: pays every XLA compile (insert sizes, the block program)
+    # once; its engine is donated to every later pool so the timed
+    # episodes — and every spin-up inside them — are compile-free
+    warm, warm_pool = _fleet_episode(
+        model, params, prompts[:8], queue_url="fleet://warm",
+        min_replicas=1, max_replicas=1, initial=1, **kwargs,
+    )
+    donor = warm_pool.engine_donor()
+
+    single, _ = _fleet_episode(
+        model, params, prompts, queue_url="fleet://single",
+        min_replicas=1, max_replicas=1, initial=1, engine_source=donor,
+        **kwargs,
+    )
+    policy = LoopConfig(
+        poll_interval=0.05,
+        policy=PolicyConfig(
+            scale_up_messages=4 * batch_size,
+            scale_down_messages=batch_size,
+            scale_up_cooldown=0.08,
+            scale_down_cooldown=0.15,
+        ),
+    )
+    scale, scale_pool = _fleet_episode(
+        model, params, prompts, queue_url="fleet://scale",
+        min_replicas=1, max_replicas=3, initial=1, engine_source=donor,
+        policy=policy, require_scale_down=True, **kwargs,
+    )
+    kill, kill_pool = _fleet_episode(
+        model, params, prompts[:24], queue_url="fleet://kill",
+        min_replicas=1, max_replicas=2, initial=2, engine_source=donor,
+        fault_plan=FleetFaultPlan(kills=((4, 1),)), **kwargs,
+    )
+    # spin-up probe: one scale_up on a warm engine — O(1) host work
+    # (shared params by reference, adopted compiled programs)
+    probe_pool = kill_pool
+    t0 = time.perf_counter()
+    probe_pool.scale_up()
+    spawn_s = time.perf_counter() - t0
+    spun = probe_pool.members[-1].worker.batcher
+    shared_params = all(
+        r.worker.batcher.params is params for r in probe_pool.members
+    )
+    engine_reused = spun._insert_many is donor._insert_many
+    elapsed = time.perf_counter() - start
+
+    artifact = {
+        "suite": "fleet",
+        "elapsed_s": round(elapsed, 2),
+        "config": {
+            "messages": messages, "prompt_len": prompt_len,
+            "generate_tokens": generate_tokens, "batch_size": batch_size,
+            "decode_block": decode_block,
+            "model": {"d_model": model.d_model, "n_layers": model.n_layers,
+                      "n_heads": model.n_heads,
+                      "vocab_size": model.vocab_size},
+        },
+        "warmup": {"requests": warm["requests"],
+                   "elapsed_s": warm["elapsed_s"]},
+        "single": single,
+        "scale": scale,
+        "kill": kill,
+        "spinup": {
+            "spawn_s": round(spawn_s, 4),
+            "shared_params": shared_params,
+            "engine_reused": engine_reused,
+        },
+        "gates": {
+            "kill": "zero lost, zero duplicated, >0 redispatched",
+            "scale": "all answered once; scaled up >= 2 and back to min",
+            "spinup": "shared params + adopted engine (no rebuild)",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+
+    failures = []
+    for name, episode in (("single", single), ("scale", scale),
+                          ("kill", kill)):
+        if episode["lost"] or episode["replies"] != episode["requests"]:
+            failures.append(
+                f"{name}: {episode['replies']}/{episode['requests']}"
+                f" answered ({episode['lost']} lost)"
+            )
+        if episode["duplicate_replies"]:
+            failures.append(
+                f"{name}: {episode['duplicate_replies']} duplicate"
+                " reply(ies)"
+            )
+    if kill["redispatched"] < 1:
+        failures.append("kill: the killed replica had nothing in flight")
+    if max(scale["replica_trajectory"], default=0) < 2:
+        failures.append("scale: the fleet never scaled past 1 replica")
+    if scale["final_replicas"] != 1:
+        failures.append(
+            f"scale: fleet ended at {scale['final_replicas']} replicas,"
+            " not back at min=1"
+        )
+    if not shared_params:
+        failures.append("spinup: replica params were rebuilt, not shared")
+    if not engine_reused:
+        failures.append("spinup: replica recompiled instead of adopting")
+    if failures:
+        for line in failures:
+            print(f"fleet: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    return {
+        "metric": "fleet_tokens_per_sec",
+        "value": scale["tokens_per_second"],
+        "unit": (
+            f"tokens/s (autoscaled 1..3 replicas, {messages} requests,"
+            f" 0 lost, 0 duplicated; kill episode redispatched"
+            f" {kill['redispatched']})"
+        ),
+        "vs_baseline": round(
+            scale["tokens_per_second"]
+            / max(single["tokens_per_second"], 1e-9), 2,
+        ),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
-                 "serve"),
+                 "serve", "fleet"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -706,13 +984,16 @@ if __name__ == "__main__":
         " compiled-simulator fidelity gate + autotuning parameter sweep;"
         " chaos = resilient-vs-reference failure handling under"
         " deterministic fault injection; serve = continuous-serving hot"
-        " path, blocked vs single-step engine (throughput + parity gates)",
+        " path, blocked vs single-step engine (throughput + parity gates);"
+        " fleet = ControlLoop-autoscaled serving replicas with a"
+        " mid-episode worker kill (zero-lost/zero-duplicate gates, scored"
+        " in tokens/s + TTFT + time-over-TTFT-SLO)",
     )
     cli.add_argument(
         "--output", default="",
-        help="artifact path for --suite forecast/replay/sweep/chaos/serve"
-        " (defaults: BENCH_r06.json / BENCH_r07.json / BENCH_r08.json /"
-        " BENCH_r09.json / BENCH_r10.json)",
+        help="artifact path for --suite forecast/replay/sweep/chaos/serve/"
+        "fleet (defaults: BENCH_r06.json / BENCH_r07.json / BENCH_r08.json"
+        " / BENCH_r09.json / BENCH_r10.json / BENCH_r11.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -725,5 +1006,7 @@ if __name__ == "__main__":
         print(json.dumps(run_chaos_suite(cli_args.output or "BENCH_r09.json")))
     elif cli_args.suite == "serve":
         print(json.dumps(run_serve_suite(cli_args.output or "BENCH_r10.json")))
+    elif cli_args.suite == "fleet":
+        print(json.dumps(run_fleet_suite(cli_args.output or "BENCH_r11.json")))
     else:
         print(json.dumps(run_bench()))

@@ -1,0 +1,33 @@
+"""The serving fleet: ControlLoop-actuated ContinuousWorker replicas.
+
+``WorkerPool`` implements the :class:`~..core.types.Scaler` seam over a
+pool of in-process serving replicas — the subsystem that closes the loop
+between the autoscaling control plane and the serving engine (ROADMAP
+item 1).  ``FleetDriver`` interleaves serving cycles with real control
+ticks; ``FleetWorker`` is imported lazily (it pulls the JAX serving
+stack) so the pool, driver, and contract tests stay control-plane-light.
+"""
+
+from .pool import (
+    DEAD,
+    DRAINING,
+    REPLICA_STATE_CODES,
+    SERVING,
+    STOPPED,
+    FleetDriver,
+    FleetEvent,
+    Replica,
+    WorkerPool,
+)
+
+__all__ = [
+    "DEAD",
+    "DRAINING",
+    "REPLICA_STATE_CODES",
+    "SERVING",
+    "STOPPED",
+    "FleetDriver",
+    "FleetEvent",
+    "Replica",
+    "WorkerPool",
+]

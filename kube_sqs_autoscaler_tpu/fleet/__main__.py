@@ -1,0 +1,203 @@
+"""``make fleet-demo``: one deterministic FakeClock fleet episode.
+
+Walks the whole crash-safe serving story on a virtual clock — the real
+:class:`~..core.loop.ControlLoop` autoscaling a real
+:class:`~.pool.WorkerPool` of serving replicas over one shared queue:
+
+1. **spawn** — backlog trips the up gate; new replicas share the
+   already-built params by reference and adopt the first replica's
+   compiled programs (no model rebuild, no recompile);
+2. **kill** — a :class:`~..sim.faults.FleetFaultPlan` kills a busy
+   replica mid-episode; the supervisor re-dispatches its un-replied
+   in-flight requests to survivors;
+3. **re-dispatch / dedup** — every request is answered exactly once
+   (zero lost, zero duplicated replies), redeliveries and failover
+   notwithstanding;
+4. **drain** — the drained queue trips the down gate; replicas stop
+   admitting, finish their in-flight slots, and retire; the fleet
+   returns to min.
+
+Exit 0 = every milestone observed; exit 2 = unexpected trajectory (the
+``make chaos-demo`` / ``make replay-demo`` contract).  Runs the real JAX
+serving engine on a tiny model (CPU-friendly, ~seconds); only the
+*clocks* are virtual.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+from ..core.clock import FakeClock
+from ..core.loop import ControlLoop, LoopConfig
+from ..core.policy import PolicyConfig
+from ..metrics.fake import FakeMessageQueue
+from ..metrics.queue import QueueMetricSource
+from ..sim.faults import FleetFaultPlan
+from .pool import DRAINING, SERVING, FleetDriver, WorkerPool
+
+MESSAGES = 12
+KILL_CYCLE = 8
+KILL_REPLICA = 1
+
+
+def _demo_episode():
+    import jax
+    import numpy as np
+
+    from ..workloads.model import ModelConfig, init_params
+    from ..workloads.service import ServiceConfig, collect_replies
+
+    model = ModelConfig(
+        vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=6 + 24,
+    )
+    params = init_params(jax.random.key(0), model)
+    clock = FakeClock()
+    # virtual-time visibility: an in-flight message outliving 30 virtual
+    # seconds is redelivered — which the reply dedup must absorb
+    queue = FakeMessageQueue(visibility_timeout=30.0, now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    config = ServiceConfig(
+        queue_url="fleet://demo", batch_size=2, seq_len=6,
+        generate_tokens=24, decode_block=4,
+        result_queue_url="fleet://demo-results",
+    )
+    rng = np.random.default_rng(7)
+    sent = [
+        queue.send_message(
+            "fleet://demo",
+            json.dumps(rng.integers(1, model.vocab_size, 5).tolist()),
+        )
+        for _ in range(MESSAGES)
+    ]
+    pool = WorkerPool.serving(
+        queue, params, model, config, result_queue=results,
+        min=1, max=3, clock=clock, drain_timeout_cycles=200,
+    )
+    loop = ControlLoop(
+        pool,
+        QueueMetricSource(queue, "fleet://demo",
+                          ("ApproximateNumberOfMessages",)),
+        LoopConfig(
+            poll_interval=1.0,
+            policy=PolicyConfig(
+                scale_up_messages=4, scale_down_messages=1,
+                scale_up_cooldown=1.0, scale_down_cooldown=2.0,
+            ),
+        ),
+        clock=clock,
+    )
+    plan = FleetFaultPlan(kills=((KILL_CYCLE, KILL_REPLICA),))
+    driver = FleetDriver(pool, loop, cycle_dt=0.5, fault_plan=plan)
+    stats = driver.run(
+        max_cycles=600,
+        until=lambda: (
+            pool.processed >= MESSAGES
+            and pool.idle
+            and pool.replicas == pool.min
+            and not any(r.state == DRAINING for r in pool.members)
+        ),
+    )
+    replies, duplicates = collect_replies(results, "fleet://demo-results")
+    return pool, params, stats, sent, replies, duplicates
+
+
+def _check_demo(pool, params, stats, sent, replies, duplicates) -> list[str]:
+    """The expected trajectory, as individually reportable milestones."""
+    problems: list[str] = []
+
+    def expect(condition: bool, message: str) -> None:
+        if not condition:
+            problems.append(message)
+
+    names = [e.name for e in pool.events]
+    # 1. spawn: the backlog scaled the fleet past one replica, and
+    #    spin-up shared the already-built weights + compiled programs
+    expect(names.count("replica-spawn") >= 2,
+           "the up gate never spawned a second replica")
+    expect(max(stats["replica_trajectory"], default=0) >= 2,
+           "the replica trajectory never reached 2")
+    expect(
+        all(r.worker.batcher.params is params for r in pool.members),
+        "a replica rebuilt its params instead of sharing the pool's",
+    )
+    engines = {id(r.worker.batcher._insert_many) for r in pool.members}
+    expect(
+        len(engines) == 1,
+        "replicas compiled separate engines instead of adopting one",
+    )
+    # 2. kill: the fault plan fired on a busy replica and the supervisor
+    #    re-dispatched its in-flight work
+    kills = [e for e in pool.events if e.name == "replica-kill"]
+    expect(bool(kills), "the kill was never detected")
+    expect(
+        any(e.args.get("redispatched", 0) > 0 for e in kills),
+        "the killed replica had no in-flight requests to re-dispatch "
+        "(tune KILL_CYCLE)",
+    )
+    # 3. lossless + dedup: every request answered exactly once
+    expect(
+        len(replies) == len(sent),
+        f"lost replies: {len(replies)}/{len(sent)} requests answered",
+    )
+    expect(duplicates == 0,
+           f"{duplicates} duplicate reply(ies) reached the consumer")
+    expect(
+        set(replies) == set(sent),
+        "reply request_ids do not match the sent MessageIds",
+    )
+    # 4. drain: the down gate retired the extra replicas gracefully
+    expect("replica-drain-start" in names, "no replica ever drained")
+    expect("replica-drain-done" in names, "no drain ever completed")
+    expect(
+        pool.replicas == pool.min,
+        f"fleet did not return to min={pool.min} "
+        f"(serving {pool.replicas})",
+    )
+    expect(
+        sum(1 for r in pool.members if r.state == SERVING) == pool.min,
+        "serving-state accounting disagrees with the replicas property",
+    )
+    # the supervisor's decisions must be exportable on the tick timeline
+    expect(
+        bool(pool.trace_events()),
+        "the fleet produced no Chrome-trace instant events",
+    )
+    return problems
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Deterministic fleet episode: spawn -> kill -> "
+        "re-dispatch -> drain — fails on any missing milestone."
+    )
+    parser.parse_args(argv)
+    pool, params, stats, sent, replies, duplicates = _demo_episode()
+    problems = _check_demo(pool, params, stats, sent, replies, duplicates)
+    print(
+        json.dumps(
+            {
+                "cycles": stats["cycles"],
+                "ticks": stats["ticks"],
+                "requests": len(sent),
+                "replies": len(replies),
+                "duplicate_replies": duplicates,
+                "duplicates_suppressed": pool.duplicates_suppressed,
+                "redispatched": pool.redispatched_total,
+                "replica_trajectory": stats["replica_trajectory"],
+                "final_replicas": pool.replicas,
+                "events": [e.name for e in pool.events],
+                "ok": not problems,
+            }
+        )
+    )
+    for line in problems:
+        print(f"unexpected trajectory: {line}", file=sys.stderr)
+    return 0 if not problems else 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

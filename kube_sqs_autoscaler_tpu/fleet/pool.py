@@ -1,0 +1,691 @@
+"""WorkerPool: the serving fleet behind the ``scale`` actuator seam.
+
+The control plane (PRs 1-4) actuates a replica *integer*; the serving
+engine (PR 5) is one in-process worker.  This module fuses them: a
+:class:`WorkerPool` is a :class:`~..core.types.Scaler` whose
+``scale_up``/``scale_down`` spin real
+:class:`~.worker.FleetWorker` replicas up and down, so the unchanged
+:class:`~..core.loop.ControlLoop` — forecasting, resilience, journal,
+replay and all — drives a measurable serving fleet instead of a number.
+The fleet is the deployment.
+
+Semantics mirror :class:`~..scale.actuator.PodAutoScaler` exactly
+through the seam (the contract test in
+``tests/test_actuator_contract.py`` pins this): step by
+``scale_up_pods``/``scale_down_pods`` clamped to ``[min, max]``,
+boundary no-ops are *success* (the policy refreshes its cooldown on
+them), failures raise :class:`~..core.types.ScaleError` and change
+nothing.
+
+Robustness model (the tentpole):
+
+- **spin-up is O(1) host work** — a new replica shares the pool's
+  already-built (optionally int8-quantized) params by reference and
+  adopts the donor replica's compiled programs
+  (:meth:`~..workloads.continuous.ContinuousBatcher.adopt_engine`); it
+  pays only its own KV-cache allocation, never a model rebuild or an XLA
+  recompile (BLITZSCALE, PAPERS.md);
+- **drain is graceful** — ``scale_down`` marks the newest replicas
+  draining: they stop admitting, keep stepping their in-flight slots,
+  and retire once empty.  A drain that exceeds
+  ``drain_timeout_cycles`` hands its un-finished requests back to the
+  queue (``change_message_visibility(0)`` when the queue supports it)
+  so survivors pick them up — giving up never loses work;
+- **the supervisor loses nothing** — a killed replica (or a hung one,
+  caught by the progress watchdog after ``hang_grace_cycles`` busy
+  cycles without a token) is declared dead; its un-replied in-flight
+  requests are re-dispatched to surviving replicas, and the pool-level
+  reply registry guarantees a request the dead replica already answered
+  is never answered twice (the same registry dedups visibility-timeout
+  redeliveries).  The fleet degrades to fewer replicas rather than
+  stalling — respawning is the control loop's job, through the same
+  gates as any other scale-up;
+- **the router spreads traffic** — each fleet cycle steps serving
+  replicas freest-first, each pulling at most its free-slot count from
+  the shared queue, with re-dispatched orphans admitted ahead of fresh
+  queue traffic.
+
+Everything is synchronous and deterministic: faults are flag flips at
+known cycles (:class:`~..sim.faults.FleetFaultPlan`), not process
+murder, so the chaos battery's zero-lost / zero-duplicate gates are
+replayable.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..core.clock import Clock, SystemClock
+from ..core.types import ScaleError
+
+log = logging.getLogger(__name__)
+
+# The constructor's min/max keyword names (chosen for PodAutoScaler
+# field parity) shadow the builtins in signatures — alias them so the
+# clamp math inside methods stays unambiguous.
+builtins_min = min
+builtins_max = max
+
+# Lifecycle states a replica moves through (exported as the
+# fleet_replica_state gauge; codes are stable dashboard contract).
+SERVING = "serving"
+DRAINING = "draining"
+DEAD = "dead"
+STOPPED = "stopped"
+REPLICA_STATE_CODES = {SERVING: 0, DRAINING: 1, DEAD: 2, STOPPED: 3}
+
+
+@dataclass(frozen=True)
+class FleetEvent:
+    """One supervisor decision, timestamped on the pool's clock — the
+    fleet's analogue of a :class:`~..core.events.TickRecord`, exported
+    as Chrome-trace instants (:func:`~..obs.trace.instant_trace_events`)."""
+
+    name: str  # replica-spawn | replica-kill | replica-drain-start | ...
+    t: float
+    args: dict = field(default_factory=dict)
+
+
+class _BoundedSet:
+    """Insertion-ordered set with a capacity: the reply registry.
+
+    Request ids are unique per queue, so membership only ever needs to
+    cover the recent past (a redelivery horizon); bounding it keeps a
+    long-lived fleet's memory flat."""
+
+    def __init__(self, capacity: int) -> None:
+        self._capacity = capacity
+        self._set: set = set()
+        self._order: deque = deque()
+
+    def add(self, item) -> None:
+        if item in self._set:
+            return
+        self._set.add(item)
+        self._order.append(item)
+        while len(self._order) > self._capacity:
+            self._set.discard(self._order.popleft())
+
+    def __contains__(self, item) -> bool:
+        return item in self._set
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+
+class Replica:
+    """One supervised fleet member: a worker plus its lifecycle state."""
+
+    def __init__(self, index: int, worker: Any, spawned_at: float) -> None:
+        self.index = index
+        self.worker = worker
+        self.state = SERVING
+        self.spawned_at = spawned_at
+        self.drain_started_cycle: int | None = None
+        # progress watchdog (hang detection)
+        self.last_progress = -1
+        self.stalled_cycles = 0
+
+    def progress(self) -> int:
+        """Monotone progress signal: tokens emitted + requests settled."""
+        return self.worker.batcher.tokens_emitted + self.worker.processed
+
+
+class WorkerPool:
+    """A supervised pool of serving replicas behind the Scaler seam.
+
+    ``replica_factory(pool)`` builds one replica worker (the real thing:
+    :meth:`serving` wires a :class:`~.worker.FleetWorker`; the contract
+    test substitutes a featherweight stub — the pool itself is JAX-free).
+    ``min``/``max``/``scale_up_pods``/``scale_down_pods`` mirror
+    :class:`~..scale.actuator.PodAutoScaler`'s fields.
+    """
+
+    def __init__(
+        self,
+        replica_factory: Callable[["WorkerPool"], Any],
+        *,
+        min: int,
+        max: int,
+        scale_up_pods: int = 1,
+        scale_down_pods: int = 1,
+        initial: int | None = None,
+        clock: Clock | None = None,
+        hang_grace_cycles: int = 3,
+        drain_timeout_cycles: int | None = None,
+        replied_capacity: int = 65536,
+    ) -> None:
+        if not 1 <= min <= max:
+            raise ValueError(f"need 1 <= min ({min}) <= max ({max})")
+        if scale_up_pods < 1 or scale_down_pods < 1:
+            raise ValueError("scale step sizes must be >= 1")
+        if hang_grace_cycles < 2:
+            # one no-progress cycle is legitimate (the block engine's
+            # dispatch-ahead consumes block N one cycle after dispatch)
+            raise ValueError("hang_grace_cycles must be >= 2")
+        self.replica_factory = replica_factory
+        self.min = min
+        self.max = max
+        self.scale_up_pods = scale_up_pods
+        self.scale_down_pods = scale_down_pods
+        self.clock = clock or SystemClock()
+        self.hang_grace_cycles = hang_grace_cycles
+        self.drain_timeout_cycles = drain_timeout_cycles
+        # live replicas plus a bounded tail of recently-retired/dead ones
+        # (postmortem introspection + their final gauges); older corpses
+        # are pruned each cycle with their counters folded into
+        # _retired_processed so a long-lived, high-churn fleet stays flat
+        self.members: list[Replica] = []
+        self.retired_keep = 32
+        self._retired_processed = 0
+        self.events: deque[FleetEvent] = deque(maxlen=4096)
+        self.cycle = 0
+        self._next_index = 0
+        self._spawn_ordinal = 0  # factory invocations (pre-commit safe)
+        self._orphans: list[dict] = []  # re-dispatch queue (priority)
+        self._replied = _BoundedSet(replied_capacity)
+        self.redispatched_total = 0
+        self.released_total = 0
+        self.duplicates_suppressed = 0
+        self.metrics = None
+        # test seams, mirroring the fakes' error injection hooks
+        self.fail_next_up: Exception | None = None
+        self.fail_next_down: Exception | None = None
+        if initial is None:
+            initial = min
+        if not min <= initial <= max:
+            raise ValueError(
+                f"initial ({initial}) must be within [min, max]"
+            )
+        for _ in range(initial):
+            self._spawn()
+
+    # ------------------------------------------------------------------
+    # The Scaler seam (PodAutoScaler parity — pinned by contract test)
+    # ------------------------------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        """Serving replica count — the fleet's ``spec.replicas``.
+
+        Draining replicas are already excluded (like pods past their
+        deletion timestamp: still finishing work, no longer capacity the
+        policy should count)."""
+        return sum(1 for r in self.members if r.state == SERVING)
+
+    def scale_up(self) -> None:
+        if self.fail_next_up is not None:
+            err, self.fail_next_up = self.fail_next_up, None
+            raise ScaleError("Failed to scale up") from err
+        current = self.replicas
+        if current >= self.max:
+            log.info(
+                "More than max replicas serving. No scale up. Replicas: %d",
+                current,
+            )
+            return
+        target = builtins_min(current + self.scale_up_pods, self.max)
+        # build-then-commit so a factory failure changes NOTHING, like
+        # PodAutoScaler's single read-modify-write (the parity contract:
+        # a failed scale leaves the replica count exactly as it was)
+        workers = []
+        try:
+            for _ in range(target - current):
+                workers.append(self.replica_factory(self))
+        except Exception as err:
+            for worker in workers:
+                worker.stop()
+            raise ScaleError("Failed to scale up") from err
+        for worker in workers:
+            self._add_replica(worker)
+        log.info("Scale up successful. Replicas: %d", self.replicas)
+
+    def scale_down(self) -> None:
+        if self.fail_next_down is not None:
+            err, self.fail_next_down = self.fail_next_down, None
+            raise ScaleError("Failed to scale down") from err
+        current = self.replicas
+        if current <= self.min:
+            log.info(
+                "Less than min replicas serving. No scale down. "
+                "Replicas: %d",
+                current,
+            )
+            return
+        target = builtins_max(current - self.scale_down_pods, self.min)
+        for _ in range(current - target):
+            self._drain_one()
+        log.info("Scale down successful. Replicas: %d", self.replicas)
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def _spawn(self) -> Replica:
+        return self._add_replica(self.replica_factory(self))
+
+    def _add_replica(self, worker: Any) -> Replica:
+        replica = Replica(self._next_index, worker, self.clock.now())
+        self._next_index += 1
+        self.members.append(replica)
+        self._event("replica-spawn", replica=replica.index)
+        return replica
+
+    def _drain_one(self) -> None:
+        # newest serving replica first (its cache is coldest; the oldest
+        # replicas keep their momentum)
+        replica = builtins_max(
+            (r for r in self.members if r.state == SERVING),
+            key=lambda r: r.index,
+        )
+        replica.state = DRAINING
+        replica.worker.admitting = False
+        replica.drain_started_cycle = self.cycle
+        self._event(
+            "replica-drain-start", replica=replica.index,
+            inflight=replica.worker.batcher.active,
+        )
+
+    def engine_donor(self):
+        """The batcher whose compiled programs a new replica adopts:
+        any existing member's (compiled executables are state-free, so
+        even a dead replica can donate).  ``None`` for the first spawn —
+        it pays the one compile the whole fleet then shares."""
+        for replica in self.members:
+            return replica.worker.batcher
+        return None
+
+    def kill_worker(self, index: int) -> None:
+        """Deterministic fault injection: crash replica ``index`` NOW
+        (flag flip, not process murder — see :mod:`..sim.faults`).  The
+        next :meth:`run_cycle`'s supervisor pass re-dispatches its
+        un-replied in-flight requests to survivors."""
+        self._member(index).worker.kill()
+
+    def hang_worker(self, index: int) -> None:
+        """Deterministic fault injection: wedge replica ``index`` — it
+        looks alive but makes no progress until the watchdog declares it
+        dead after ``hang_grace_cycles`` busy cycles."""
+        self._member(index).worker.hang()
+
+    def _member(self, index: int) -> Replica:
+        for replica in self.members:
+            if replica.index == index:
+                return replica
+        raise ValueError(f"no replica with index {index}")
+
+    # ------------------------------------------------------------------
+    # The fleet cycle: supervise -> route -> serve -> retire
+    # ------------------------------------------------------------------
+
+    def run_cycle(self) -> int:
+        """One fleet cycle; returns requests completed across replicas."""
+        self.cycle += 1
+        self._supervise()
+        done = 0
+        serving = [r for r in self.members if r.state == SERVING]
+        # router: freest replica first, so a refill cycle spreads the
+        # queue's head across the fleet instead of soaking one replica
+        serving.sort(
+            key=lambda r: len(r.worker.batcher.free_slots), reverse=True
+        )
+        for replica in serving:
+            if self._orphans:
+                self._dispatch_orphans(replica)
+            done += replica.worker.run_once()
+        for replica in [r for r in self.members if r.state == DRAINING]:
+            done += replica.worker.run_once()
+            if replica.worker.batcher.active == 0:
+                # nothing in flight: the drain is complete (hung or not —
+                # an empty wedged replica has nothing left to lose)
+                self._retire(replica, released=0)
+            elif (
+                self.drain_timeout_cycles is not None
+                and replica.drain_started_cycle is not None
+                and self.cycle - replica.drain_started_cycle
+                >= self.drain_timeout_cycles
+            ):
+                # the drain stalled: hand un-finished requests back to
+                # the queue so survivors pick them up, then retire
+                released = replica.worker.release_inflight()
+                self.released_total += released
+                self._retire(replica, released=released)
+        self._prune_retired()
+        self._update_metrics()
+        return done
+
+    def _supervise(self) -> None:
+        """Declare killed/hung replicas dead and queue their failover.
+
+        The watchdog only counts stall cycles while the replica HOLDS
+        work (``active > 0``): an idle replica legitimately makes no
+        progress, so an idle wedge is indistinguishable from idleness —
+        the same blind spot a pod without a liveness probe has.  It is
+        self-limiting: the moment any work lands on the wedge (queue
+        admission can't — a wedged ``run_once`` never polls — but the
+        router's orphan dispatch marks slots busy synchronously), the
+        stall counter starts and the work fails over within
+        ``hang_grace_cycles``.
+        """
+        for replica in self.members:
+            if replica.state not in (SERVING, DRAINING):
+                continue
+            worker = replica.worker
+            if worker.killed:
+                self._declare_dead(replica, cause="killed")
+                continue
+            progress = replica.progress()
+            if worker.batcher.active > 0 and progress == replica.last_progress:
+                replica.stalled_cycles += 1
+                if replica.stalled_cycles >= self.hang_grace_cycles:
+                    self._declare_dead(replica, cause="hung")
+                    continue
+            else:
+                replica.stalled_cycles = 0
+            replica.last_progress = progress
+
+    def _declare_dead(self, replica: Replica, cause: str) -> None:
+        replica.state = DEAD
+        replica.worker.killed = True  # a hung replica must never step again
+        orphans = replica.worker.take_inflight()
+        self.redispatched_total += len(orphans)
+        self._orphans.extend(orphans)
+        self._event(
+            "replica-kill", replica=replica.index, cause=cause,
+            redispatched=len(orphans),
+        )
+        log.warning(
+            "Replica %d declared dead (%s); re-dispatching %d in-flight "
+            "request(s) to %d survivor(s)",
+            replica.index, cause, len(orphans), self.replicas,
+        )
+
+    def _dispatch_orphans(self, replica: Replica) -> None:
+        free = len(replica.worker.batcher.free_slots)
+        if free <= 0:
+            return
+        take, self._orphans = self._orphans[:free], self._orphans[free:]
+        if take:
+            replica.worker._admit(take)
+            self._event(
+                "redispatch", replica=replica.index, requests=len(take),
+            )
+
+    def _retire(self, replica: Replica, *, released: int) -> None:
+        replica.state = STOPPED
+        replica.worker.stop()
+        self._event(
+            "replica-drain-done", replica=replica.index, released=released,
+        )
+
+    # ------------------------------------------------------------------
+    # Reply registry (the zero-duplicate guarantee)
+    # ------------------------------------------------------------------
+
+    def already_replied(self, rid: str) -> bool:
+        return rid in self._replied
+
+    def mark_replied(self, rid: str) -> None:
+        self._replied.add(rid)
+
+    def note_duplicate(self, rid: str) -> None:
+        self.duplicates_suppressed += 1
+        log.info("Suppressed duplicate reply for request %s", rid)
+
+    # ------------------------------------------------------------------
+    # Introspection / observability
+    # ------------------------------------------------------------------
+
+    def next_spawn_ordinal(self) -> int:
+        """Monotone per-factory-call counter (distinct even for builds
+        that later roll back) — :meth:`serving` derives each replica's
+        sampling seed from it so sampled fleets draw independent PRNG
+        streams instead of every replica replaying one seed."""
+        ordinal = self._spawn_ordinal
+        self._spawn_ordinal += 1
+        return ordinal
+
+    def _prune_retired(self) -> None:
+        """Drop all but the newest ``retired_keep`` DEAD/STOPPED
+        replicas, folding their settle counts into the retired total.
+        (Pruned indices disappear from ``members`` — ``kill_worker`` on
+        one raises, as killing a corpse should.)"""
+        retired = [
+            r for r in self.members if r.state in (DEAD, STOPPED)
+        ]
+        for replica in retired[: -self.retired_keep or None]:
+            self._retired_processed += replica.worker.processed
+            self.members.remove(replica)
+
+    @property
+    def processed(self) -> int:
+        """Requests settled over the fleet's lifetime (dead, retired,
+        and long-pruned replicas included; duplicate-suppressed settles
+        excluded — this counts uniquely answered requests)."""
+        return self._retired_processed + sum(
+            r.worker.processed for r in self.members
+        )
+
+    @property
+    def idle(self) -> bool:
+        """Nothing in flight anywhere and nothing awaiting re-dispatch."""
+        return not self._orphans and all(
+            r.worker.batcher.active == 0
+            for r in self.members
+            if r.state in (SERVING, DRAINING)
+        )
+
+    def stop_all(self) -> None:
+        """Stop every replica (draining ones release their in-flight
+        requests back to the queue first — shutdown never loses work)."""
+        for replica in self.members:
+            if replica.state in (SERVING, DRAINING):
+                released = replica.worker.release_inflight()
+                self.released_total += released
+                self._retire(replica, released=released)
+        self._update_metrics()
+
+    def _event(self, name: str, **args) -> None:
+        self.events.append(FleetEvent(name, self.clock.now(), args))
+
+    def trace_events(self, time_origin: float | None = None) -> list[dict]:
+        """The supervisor's decisions as Chrome-trace instant events
+        (merge into a tick trace via ``to_chrome_trace(...,
+        extra_events=...)``)."""
+        from ..obs.trace import instant_trace_events
+
+        return instant_trace_events(self.events, time_origin)
+
+    def attach_metrics(self, metrics) -> None:
+        """Refresh per-replica fleet gauges into a
+        :class:`~..obs.prometheus.WorkloadMetrics` registry every cycle:
+        ``fleet_replica_state`` / ``fleet_replica_tokens_per_second`` /
+        ``fleet_replica_active_slots`` (labeled by replica), plus
+        ``fleet_replicas_draining`` and the
+        ``fleet_requests_redispatched_total`` counter."""
+        self.metrics = metrics
+        self._update_metrics()
+
+    def _update_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        now = time.perf_counter()
+        for replica in self.members:
+            labels = (("replica", str(replica.index)),)
+            worker = replica.worker
+            served_since = getattr(worker, "_served_since", None)
+            rate = 0.0
+            if served_since is not None and now > served_since:
+                rate = worker.batcher.tokens_emitted / (now - served_since)
+            self.metrics.set_gauge(
+                "fleet_replica_state",
+                REPLICA_STATE_CODES[replica.state],
+                "Replica lifecycle state (0=serving, 1=draining, 2=dead, "
+                "3=stopped).",
+                labels=labels,
+            )
+            self.metrics.set_gauge(
+                "fleet_replica_tokens_per_second", rate,
+                "Generated tokens per second over this replica's serving "
+                "lifetime.",
+                labels=labels,
+            )
+            self.metrics.set_gauge(
+                "fleet_replica_active_slots", worker.batcher.active,
+                "Decode slots currently holding an in-flight request on "
+                "this replica.",
+                labels=labels,
+            )
+        self.metrics.set_gauge(
+            "fleet_replicas_draining",
+            sum(1 for r in self.members if r.state == DRAINING),
+            "Replicas draining (finishing in-flight work, not admitting).",
+        )
+        self.metrics.set_gauge(
+            "fleet_requests_redispatched_total", self.redispatched_total,
+            "In-flight requests re-dispatched from dead replicas to "
+            "survivors.",
+            kind="counter",
+        )
+
+    # ------------------------------------------------------------------
+    # Real-fleet construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def serving(
+        cls,
+        queue,
+        params,
+        model_config,
+        service_config,
+        *,
+        min: int,
+        max: int,
+        family: str = "gpt",
+        tokenizer=None,
+        result_queue=None,
+        mesh=None,
+        engine_source=None,
+        **pool_kwargs,
+    ) -> "WorkerPool":
+        """A pool of real :class:`~.worker.FleetWorker` replicas over one
+        shared queue.  ``params`` may be the plain bf16 tree or an
+        int8-quantized one (:mod:`..workloads.quantize`) — replicas share
+        whichever by reference; only the FIRST replica compiles, the
+        rest adopt its programs.  ``engine_source`` seeds even the first
+        replica from an external donor batcher (e.g. a previous pool
+        over the same params), making whole-pool startup compile-free.
+
+        Sampled serving (``temperature > 0``): each replica gets
+        ``sample_seed + spawn_ordinal`` so the fleet draws independent
+        PRNG streams — one shared seed would make every replica replay
+        the same randomness.  The seed is not an engine static, so
+        adoption is unaffected."""
+        import dataclasses
+
+        def factory(pool: "WorkerPool"):
+            from .worker import FleetWorker
+
+            seeded = dataclasses.replace(
+                service_config,
+                sample_seed=service_config.sample_seed
+                + pool.next_spawn_ordinal(),
+            )
+            return FleetWorker(
+                queue, params, model_config, seeded,
+                family=family, tokenizer=tokenizer,
+                result_queue=result_queue, mesh=mesh,
+                pool=pool,
+                engine_source=pool.engine_donor() or engine_source,
+            )
+
+        return cls(factory, min=min, max=max, **pool_kwargs)
+
+
+class FleetDriver:
+    """Interleaves fleet serving cycles with real control-loop ticks.
+
+    The fleet's analogue of :class:`~..sim.simulator.Simulation`: the
+    loop under drive is the REAL :class:`~..core.loop.ControlLoop`
+    (``loop.tick`` on its own clock, one tick per ``poll_interval``),
+    the actuator is the pool, and the world between ticks is actual
+    serving.  ``loop=None`` drives the pool alone (the chaos episodes
+    that need no autoscaler).  ``cycle_dt > 0`` advances a
+    :class:`~..core.clock.FakeClock` that much virtual time per cycle —
+    the deterministic demo mode; ``0`` reads real time (the bench).
+    ``fault_plan`` applies a :class:`~..sim.faults.FleetFaultPlan`'s
+    kills/hangs at their scheduled cycles.
+    """
+
+    def __init__(
+        self,
+        pool: WorkerPool,
+        loop=None,
+        *,
+        cycle_dt: float = 0.0,
+        fault_plan=None,
+    ) -> None:
+        self.pool = pool
+        self.loop = loop
+        self.cycle_dt = cycle_dt
+        self.fault_plan = fault_plan
+        self.ticks = 0
+
+    def run(
+        self,
+        *,
+        until_processed: int | None = None,
+        max_cycles: int = 100_000,
+        until: Callable[[], bool] | None = None,
+    ) -> dict:
+        """Drive until ``until_processed`` requests settled and the fleet
+        is idle (or ``max_cycles``); returns summary stats.  ``until``
+        replaces the stop condition with an arbitrary predicate,
+        evaluated after each cycle (e.g. "all replies collected AND the
+        fleet scaled back down to min")."""
+        clock = self.loop.clock if self.loop is not None else self.pool.clock
+        state = None
+        next_tick = None
+        if self.loop is not None:
+            from ..core.policy import initial_state
+
+            state = initial_state(clock.now())
+            next_tick = clock.now() + self.loop.config.poll_interval
+        trajectory: list[int] = []
+        cycles = 0
+        for _ in range(max_cycles):
+            if self.fault_plan is not None:
+                self.fault_plan.apply(self.pool.cycle, self.pool)
+            self.pool.run_cycle()
+            cycles += 1
+            if self.cycle_dt:
+                clock.advance(self.cycle_dt)  # FakeClock only
+            if self.loop is not None and clock.now() >= next_tick:
+                state = self.loop.tick(state)
+                self.loop.ticks += 1
+                self.ticks += 1
+                trajectory.append(self.pool.replicas)
+                # re-anchor rather than accumulate: a long serve cycle
+                # must not cause a burst of catch-up ticks
+                next_tick = clock.now() + self.loop.config.poll_interval
+            if until is not None:
+                if until():
+                    break
+            elif (
+                until_processed is not None
+                and self.pool.processed >= until_processed
+                and self.pool.idle
+            ):
+                break
+        return {
+            "cycles": cycles,
+            "ticks": self.ticks,
+            "processed": self.pool.processed,
+            "replica_trajectory": trajectory,
+            "final_replicas": self.pool.replicas,
+        }

@@ -1,0 +1,142 @@
+"""FleetWorker: a :class:`~..workloads.continuous.ContinuousWorker` that
+serves as one supervised replica of a :class:`~.pool.WorkerPool`.
+
+It IS the production continuous worker — same batcher, same engine
+cycle, same at-least-once settle discipline — extended with exactly the
+hooks a supervised fleet member needs:
+
+- **admission gate** (``admitting``): a draining replica stops pulling
+  queue traffic but keeps stepping its in-flight slots to completion;
+- **deterministic fault injection** (``killed``/``hung`` flags flipped by
+  :meth:`~.pool.WorkerPool.kill_worker` /
+  :meth:`~.pool.WorkerPool.hang_worker`): the fleet chaos battery's
+  analogue of :mod:`..sim.faults` — a flag flip at a known cycle is
+  replayable where process murder is not.  A killed replica never steps
+  again; a hung one looks alive but makes no progress until the pool's
+  watchdog declares it dead;
+- **reply dedup** through the pool's registry: the serving system is
+  at-least-once (replies are sent *before* the input is deleted), so a
+  request redelivered by the queue's visibility timeout — or
+  re-dispatched from a dead replica — can reach two replicas.  The FIRST
+  completed settle wins; any later completion deletes its input copy
+  without replying, so consumers never see two answers for one request
+  id;
+- **in-flight handoff** (:meth:`take_inflight`): when the supervisor
+  declares this replica dead, its un-replied busy slots' messages are
+  re-dispatched to survivors (their device state is abandoned — greedy
+  decoding restarts from the prompt and produces the identical
+  continuation).
+
+Construction shares the pool's already-built params by reference and
+adopts the donor replica's compiled programs
+(:meth:`~..workloads.continuous.ContinuousBatcher.adopt_engine`), so
+spin-up does no model rebuild and no recompile — O(1) host work plus the
+replica's own KV-cache allocation.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..workloads.continuous import ContinuousWorker
+from ..workloads.service import request_id
+
+log = logging.getLogger(__name__)
+
+
+class FleetWorker(ContinuousWorker):
+    """One supervised fleet replica (see module docstring)."""
+
+    def __init__(self, *args, pool=None, engine_source=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pool = pool
+        if engine_source is not None:
+            # BLITZSCALE-style spin-up: reuse the donor's compiled
+            # insert/decode programs — a new replica pays cache
+            # allocation, never a retrace or recompile
+            self.batcher.adopt_engine(engine_source)
+        self.admitting = True
+        self.killed = False
+        self.hung = False
+
+    # -- fault injection (pool.kill_worker / pool.hang_worker) ----------
+
+    def kill(self) -> None:
+        """Deterministic crash: the replica never steps again; its
+        un-replied in-flight requests await :meth:`take_inflight`."""
+        self.killed = True
+
+    def hang(self) -> None:
+        """Deterministic wedge: cycles become no-ops (the replica looks
+        alive but makes no progress) until the watchdog declares it
+        dead."""
+        self.hung = True
+
+    # -- supervised engine cycle ----------------------------------------
+
+    def run_once(self) -> int:
+        if self.killed or self.hung:
+            # a dead replica must not touch the queue or its device
+            # state; a hung one consumes the cycle without progress —
+            # exactly what the pool's progress watchdog keys on
+            return 0
+        return super().run_once()
+
+    def _refill(self) -> int:
+        if not self.admitting:
+            return 0  # draining: finish in-flight slots, admit nothing
+        return super()._refill()
+
+    # -- reply dedup through the pool registry --------------------------
+
+    def _settle(self, message, tokens) -> None:
+        if self._pool is not None:
+            rid = request_id(message)
+            if self._pool.already_replied(rid):
+                # a redelivered / re-dispatched copy of a request that
+                # was already answered: consume the duplicate input,
+                # never send a second reply.  It must not count toward
+                # `processed` either — run_once is about to add one for
+                # this settle, and completion criteria (the driver's
+                # `pool.processed >= N`) must count UNIQUE requests, or
+                # a suppressed duplicate could stand in for a real one
+                # still waiting in the queue.
+                self.queue.delete_message(
+                    self.config.queue_url, message["ReceiptHandle"]
+                )
+                self._pool.note_duplicate(rid)
+                self.processed -= 1
+                return
+        super()._settle(message, tokens)
+        if self._pool is not None:
+            self._pool.mark_replied(request_id(message))
+
+    # -- failover handoff ------------------------------------------------
+
+    def take_inflight(self) -> list[dict]:
+        """Remove and return the un-replied in-flight messages (busy
+        slots' payloads, admission order).  Called once by the
+        supervisor when this replica is declared dead; the slots are
+        freed (their requests now live elsewhere — a dead replica must
+        not keep reporting them as active) and the device state is
+        abandoned with the replica, which never steps again."""
+        from ..workloads.continuous import _Slot
+
+        messages = []
+        for row, slot in enumerate(self.batcher.slots):
+            if slot.busy:
+                messages.append(slot.payload)
+                self.batcher.slots[row] = _Slot()
+        return messages
+
+    def release_inflight(self) -> int:
+        """Hand every un-replied in-flight request back to the queue
+        (the drain-timeout path): make each message visible again NOW
+        when the queue supports ``change_message_visibility``, else rely
+        on its visibility timeout.  Returns the number released."""
+        messages = self.take_inflight()
+        nack = getattr(self.queue, "change_message_visibility", None)
+        for message in messages:
+            if nack is not None:
+                nack(self.config.queue_url, message["ReceiptHandle"], 0)
+        return len(messages)

@@ -137,6 +137,28 @@ class FakeMessageQueue:
         with self._lock:
             self._inflight.pop(receipt_handle, None)
 
+    def change_message_visibility(
+        self, queue_url: str, receipt_handle: str, visibility_timeout: float
+    ) -> None:
+        """Re-deadline one in-flight message (SQS ChangeMessageVisibility).
+
+        ``visibility_timeout=0`` returns the message to the visible queue
+        immediately — how a draining worker hands un-finished requests
+        back instead of making survivors wait out the full timeout.  A
+        stale/unknown handle is a silent no-op, like ``delete_message``.
+        """
+        with self._lock:
+            entry = self._inflight.pop(receipt_handle, None)
+            if entry is None:
+                return
+            _, message_id, body = entry
+            if visibility_timeout <= 0:
+                self._visible.append((message_id, body))
+            else:
+                self._inflight[receipt_handle] = (
+                    self._now() + visibility_timeout, message_id, body
+                )
+
     def get_queue_attributes(self, queue_url, attribute_names):
         with self._lock:
             self._requeue_expired()

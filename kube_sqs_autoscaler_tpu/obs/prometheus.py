@@ -344,13 +344,32 @@ class WorkloadMetrics:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._gauges: dict[str, tuple[float, str]] = {}
+        # (name, labels) -> (value, help, kind); labels is a tuple of
+        # (label, value) pairs or None for the unlabeled family
+        self._gauges: dict[
+            tuple[str, tuple[tuple[str, str], ...] | None],
+            tuple[float, str, str],
+        ] = {}
         self._timers: dict[str, object] = {}
 
-    def set_gauge(self, name: str, value: float, help_text: str = "") -> None:
-        """Record one gauge sample (e.g. ``train_tokens_per_sec``)."""
+    def set_gauge(
+        self,
+        name: str,
+        value: float,
+        help_text: str = "",
+        *,
+        labels: tuple[tuple[str, str], ...] | None = None,
+        kind: str = "gauge",
+    ) -> None:
+        """Record one sample (e.g. ``train_tokens_per_sec``).
+
+        ``labels`` makes it one series of a labeled family (the fleet's
+        per-replica gauges: ``fleet_replica_state{replica="3"}``);
+        ``kind="counter"`` changes only the exposition TYPE line —
+        monotonicity is the caller's contract, as with every counter the
+        registries derive from caller-owned state."""
         with self._lock:
-            self._gauges[name] = (float(value), help_text)
+            self._gauges[(name, labels)] = (float(value), help_text, kind)
 
     def attach_timer(self, name: str, timer) -> None:
         """Expose a SpanTimer's spans as ``<name>_<span>_seconds{quantile}``
@@ -402,14 +421,30 @@ class WorkloadMetrics:
             gauges = dict(self._gauges)
             timers = dict(self._timers)
         lines: list[str] = []
-        for name, (value, help_text) in sorted(gauges.items()):
+        last_family = None
+        for (name, labels), (value, help_text, kind) in sorted(
+            gauges.items(),
+            key=lambda item: (item[0][0], item[0][1] or ()),
+        ):
             metric = f"{_WORKLOAD_PREFIX}_{name}"
-            if help_text:
-                # caller-supplied text: a raw newline/backslash here would
-                # corrupt the whole exposition for every scraper
-                lines.append(f"# HELP {metric} {escape_help(help_text)}")
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {value}")
+            if name != last_family:
+                # HELP/TYPE once per family, however many labeled series
+                if help_text:
+                    # caller-supplied text: a raw newline/backslash here
+                    # would corrupt the whole exposition for every scraper
+                    lines.append(
+                        f"# HELP {metric} {escape_help(help_text)}"
+                    )
+                lines.append(f"# TYPE {metric} {kind}")
+                last_family = name
+            if labels:
+                rendered = ",".join(
+                    f'{label}="{escape_label_value(str(val))}"'
+                    for label, val in labels
+                )
+                lines.append(f"{metric}{{{rendered}}} {value}")
+            else:
+                lines.append(f"{metric} {value}")
         for name, timer in sorted(timers.items()):
             for span, stats in sorted(timer.summary().items()):
                 metric = f"{_WORKLOAD_PREFIX}_{name}_{span}_seconds"

@@ -111,10 +111,11 @@ def trace_events(
     return events
 
 
-def _instant(name: str, at: float, args: dict[str, Any]) -> dict[str, Any]:
+def _instant(name: str, at: float, args: dict[str, Any],
+             cat: str = "event") -> dict[str, Any]:
     return {
         "name": name,
-        "cat": "event",
+        "cat": cat,
         "ph": "i",
         "s": "t",  # thread-scoped instant
         "ts": _us(at),
@@ -124,13 +125,41 @@ def _instant(name: str, at: float, args: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def instant_trace_events(
+    events: Iterable[Any], time_origin: float | None = None
+) -> list[dict[str, Any]]:
+    """Generic instant events from ``(name, t, args)``-shaped values.
+
+    ``events`` is any iterable of objects with ``name``/``t``/``args``
+    attributes — the fleet's :class:`~..fleet.FleetEvent` supervisor
+    decisions (replica spawn / kill / drain) are the motivating
+    producer.  Timestamps share the same clock as the tick records they
+    are merged with (``to_chrome_trace(..., extra_events=...)``), so
+    scaling decisions land on the same timeline as the ticks that caused
+    them; ``time_origin`` defaults to the first event's time.
+    """
+    events = list(events)
+    if not events:
+        return []
+    origin = events[0].t if time_origin is None else time_origin
+    return [
+        _instant(e.name, e.t - origin, dict(e.args), cat="fleet")
+        for e in events
+    ]
+
+
 def to_chrome_trace(
     records: Sequence[TickRecord] | Iterable[TickRecord],
     meta: dict[str, Any] | None = None,
+    extra_events: Sequence[dict[str, Any]] | None = None,
 ) -> dict[str, Any]:
-    """The JSON-object trace format (``{"traceEvents": [...]}``)."""
+    """The JSON-object trace format (``{"traceEvents": [...]}``).
+
+    ``extra_events`` are pre-built trace-event dicts appended verbatim
+    (e.g. the fleet's :func:`instant_trace_events` with ``time_origin``
+    set to the first tick's start, so both streams share t=0)."""
     trace: dict[str, Any] = {
-        "traceEvents": trace_events(records),
+        "traceEvents": trace_events(records) + list(extra_events or ()),
         "displayTimeUnit": "ms",
     }
     if meta:
@@ -141,6 +170,9 @@ def to_chrome_trace(
 def render_chrome_trace(
     records: Sequence[TickRecord] | Iterable[TickRecord],
     meta: dict[str, Any] | None = None,
+    extra_events: Sequence[dict[str, Any]] | None = None,
 ) -> str:
     """``to_chrome_trace`` as a compact JSON string (the HTTP body)."""
-    return json.dumps(to_chrome_trace(records, meta), separators=(",", ":"))
+    return json.dumps(
+        to_chrome_trace(records, meta, extra_events), separators=(",", ":")
+    )

@@ -1,10 +1,34 @@
 """Actuator layer: replica scalers over an orchestrator API.
 
 Reference counterpart: package ``scale`` (``scale/scale.go``).
+
+Two production actuators satisfy the :class:`~..core.types.Scaler` seam:
+:class:`PodAutoScaler` (a Deployment's replica integer, the reference's
+semantics) and the in-process serving fleet's
+:class:`~..fleet.WorkerPool` (re-exported lazily here — real
+ContinuousWorker replicas with failover and graceful drain; the contract
+test pins that both behave identically through the ControlLoop).
 """
 
 from .actuator import PodAutoScaler
 from .fake import FakeDeploymentAPI, NotFoundError
 from .objects import Deployment
 
-__all__ = ["PodAutoScaler", "FakeDeploymentAPI", "NotFoundError", "Deployment"]
+__all__ = [
+    "PodAutoScaler",
+    "FakeDeploymentAPI",
+    "NotFoundError",
+    "Deployment",
+    "WorkerPool",
+]
+
+
+def __getattr__(name):
+    # Lazy: the fleet package is the actuator seam's other production
+    # implementation, but importing it here eagerly would couple the
+    # plain control plane to the serving stack's module graph.
+    if name == "WorkerPool":
+        from ..fleet import WorkerPool
+
+        return WorkerPool
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -243,6 +243,37 @@ def compose(*processes: FailureProcess) -> ComposedFaults:
     return ComposedFaults(tuple(processes))
 
 
+@dataclass(frozen=True)
+class FleetFaultPlan:
+    """Deterministic replica-fault schedule for the serving fleet.
+
+    The fleet's analogue of the RPC :class:`FailureProcess`es above:
+    faults are values applied at known *pool cycles* (flag flips via
+    :meth:`~..fleet.WorkerPool.kill_worker` /
+    :meth:`~..fleet.WorkerPool.hang_worker`), not process murder — so
+    the fleet chaos battery's zero-lost / zero-duplicate gates replay
+    identically every run.  ``kills``/``hangs`` are ``(cycle,
+    replica_index)`` pairs; the driver calls :meth:`apply` once per
+    cycle BEFORE the cycle runs.  Unknown replica indices fail loudly
+    (a plan that kills nobody would gate nothing).
+    """
+
+    kills: tuple[tuple[int, int], ...] = ()
+    hangs: tuple[tuple[int, int], ...] = ()
+
+    def apply(self, cycle: int, pool) -> None:
+        for at, index in self.kills:
+            if at == cycle:
+                pool.kill_worker(index)
+        for at, index in self.hangs:
+            if at == cycle:
+                pool.hang_worker(index)
+
+    def indices(self) -> set[int]:
+        """Every replica index the plan touches (for pre-validation)."""
+        return {i for _, i in self.kills} | {i for _, i in self.hangs}
+
+
 # ---------------------------------------------------------------------------
 # Injection wrappers: the simulator wires these around the REAL metric
 # source and scaler, so the system under test stays the production stack.

@@ -187,6 +187,21 @@ def main(argv=None) -> None:
              "combination)",
     )
     parser.add_argument(
+        "--fleet-max-replicas", type=int, default=0, metavar="N",
+        help="autoscale a POOL of continuous workers between "
+             "--fleet-min-replicas and N with the real control loop: "
+             "replicas share the already-built params and compiled "
+             "programs (O(1) spin-up), drain gracefully on scale-down, "
+             "and survive worker death via supervised re-dispatch "
+             "(0 = single worker; requires --continuous and --demo; "
+             "plain decode path — not with --beams / "
+             "--speculative-draft-layers)",
+    )
+    parser.add_argument(
+        "--fleet-min-replicas", type=int, default=1, metavar="N",
+        help="lower replica bound for --fleet-max-replicas",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -250,6 +265,31 @@ def main(argv=None) -> None:
         raise SystemExit(
             f"--top-p {args.top_p} must be in (0, 1] (1.0 = off)"
         )
+    if args.fleet_max_replicas:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --beams checks above)
+        if not args.continuous:
+            raise SystemExit("--fleet-max-replicas requires --continuous")
+        if args.beams > 1 or args.speculative_draft_layers:
+            raise SystemExit(
+                "--fleet-max-replicas applies to the plain continuous "
+                "decode path (replica spin-up adopts the donor's "
+                "compiled engine; not with --beams / "
+                "--speculative-draft-layers)"
+            )
+        if not 1 <= args.fleet_min_replicas <= args.fleet_max_replicas:
+            raise SystemExit(
+                f"need 1 <= --fleet-min-replicas "
+                f"({args.fleet_min_replicas}) <= --fleet-max-replicas "
+                f"({args.fleet_max_replicas})"
+            )
+        if not args.demo:
+            raise SystemExit(
+                "--fleet-max-replicas currently requires --demo (the "
+                "in-process fleet autoscales over the demo's in-memory "
+                "queue; AWS-backed fleets are one process per replica, "
+                "scaled by the kube-sqs-autoscaler binary itself)"
+            )
 
     import jax
 
@@ -709,6 +749,57 @@ def main(argv=None) -> None:
         if args.result_queue_url:
             # demo replies land on a second in-memory queue
             result_queue = FakeMessageQueue()
+        if args.fleet_max_replicas:
+            # the closed loop in one process: a real ControlLoop
+            # autoscales a WorkerPool of continuous replicas over the
+            # demo queue (spin-up shares params + compiled engine;
+            # scale-down drains gracefully; a dead replica's in-flight
+            # work re-dispatches to survivors)
+            from ..core.loop import ControlLoop, LoopConfig
+            from ..core.policy import PolicyConfig
+            from ..fleet import FleetDriver, WorkerPool
+            from ..metrics.queue import QueueMetricSource
+
+            pool = WorkerPool.serving(
+                queue, params, model_config, service_config,
+                family=family, tokenizer=tokenizer,
+                result_queue=result_queue, mesh=mesh,
+                min=args.fleet_min_replicas, max=args.fleet_max_replicas,
+            )
+            loop = ControlLoop(
+                pool,
+                QueueMetricSource(queue, service_config.queue_url,
+                                  ("ApproximateNumberOfMessages",)),
+                LoopConfig(
+                    poll_interval=0.1,
+                    policy=PolicyConfig(
+                        scale_up_messages=2 * args.batch_size,
+                        scale_down_messages=args.batch_size,
+                        scale_up_cooldown=0.2,
+                        scale_down_cooldown=0.4,
+                    ),
+                ),
+            )
+            driver = FleetDriver(pool, loop)
+            start = time.perf_counter()
+            stats = driver.run(
+                until=lambda: pool.processed >= args.demo and pool.idle,
+            )
+            elapsed = time.perf_counter() - start
+            log.info(
+                "Fleet processed %d messages in %.2fs (%.1f msg/s, "
+                "%d ticks, replicas %s, redispatched %d, duplicate "
+                "replies suppressed %d)",
+                pool.processed, elapsed, pool.processed / elapsed,
+                stats["ticks"], stats["replica_trajectory"] or [1],
+                pool.redispatched_total, pool.duplicates_suppressed,
+            )
+            pool.stop_all()
+            if result_queue is not None:
+                for message in result_queue.receive_messages(
+                        args.result_queue_url, max_messages=2):
+                    log.info("Reply: %.120s", message["Body"])
+            return
         if args.continuous:
             from .continuous import ContinuousWorker
 

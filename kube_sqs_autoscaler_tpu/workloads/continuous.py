@@ -518,6 +518,14 @@ class ContinuousBatcher:
         self.ttft_sum = 0.0
         self.ttft_count = 0
         self.last_ttft_s: float | None = None
+        # recent per-request TTFT samples (bounded: long-lived workers
+        # must not grow with requests served) — the fleet bench scores
+        # time-over-TTFT-SLO from these
+        import collections
+
+        self.ttft_samples: collections.deque[float] = collections.deque(
+            maxlen=4096
+        )
         # block-decode utilization: kept tokens vs dispatched positions
         self.block_tokens = 0
         self.block_capacity = 0
@@ -677,6 +685,58 @@ class ContinuousBatcher:
                 self._block_fn = self._make_block_fn()
             else:
                 self._decode = self._make_decode_step()
+
+    def adopt_engine(self, source: "ContinuousBatcher") -> None:
+        """Rebind this batcher's compiled programs to ``source``'s.
+
+        The jitted insert/decode callables close over *static* knobs only
+        (config, bucket sizes, sampling policy) — never over a batcher's
+        rolling device state — so two batchers constructed with the same
+        knobs can share one set of compiled executables.  That is what
+        makes replica spin-up O(1) host work (BLITZSCALE-style): a new
+        fleet replica shares the already-built params by reference AND
+        the already-compiled programs by adoption, paying only its own
+        KV-cache allocation instead of a retrace + recompile per replica.
+
+        Plain decode slots only (the fleet path); every static knob must
+        match, or the adopted programs would silently compute the wrong
+        policy.
+        """
+        if self.beams > 1 or self.draft_layers or source.beams > 1 \
+                or source.draft_layers:
+            raise ValueError(
+                "adopt_engine supports the plain decode path only"
+            )
+        mine = self._engine_key()
+        theirs = source._engine_key()
+        if mine != theirs:
+            raise ValueError(
+                f"engine mismatch: {mine} != {theirs} (a replica must be "
+                "constructed with the donor's exact serving knobs)"
+            )
+        if (self.config is not source.config
+                or self.params is not source.params
+                or self.mesh is not source.mesh
+                or self._prefix_cache is not source._prefix_cache):
+            raise ValueError(
+                "adopt_engine requires the donor's exact params/config/"
+                "mesh/prefix objects (the compiled programs close over "
+                "them)"
+            )
+        self._insert_many = source._insert_many
+        if self.decode_block > 1:
+            self._block_fn = source._block_fn
+        else:
+            self._decode = source._decode
+
+    def _engine_key(self) -> tuple:
+        """The static knobs the plain path's compiled programs depend on."""
+        return (
+            len(self.slots), self.prompt_len, self.generate_tokens,
+            self.family, self.temperature, self.top_k, self.top_p,
+            self.eos_id, self.quantized_kv, self.prefix_len,
+            self.decode_block, self.mesh is None,
+        )
 
     def _make_insert_many(self):
         """The plain path's batched-admission jit: ``(params, cache,
@@ -1206,6 +1266,7 @@ class ContinuousBatcher:
                 self.ttft_sum += ttft
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
+                self.ttft_samples.append(ttft)
                 finished.append((slot.payload, best))
                 self.slots[row] = _Slot()
         return finished
@@ -1348,6 +1409,7 @@ class ContinuousBatcher:
                 self.ttft_sum += ttft
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
+                self.ttft_samples.append(ttft)
 
     def _needs_decode(self, slot: _Slot) -> bool:
         return slot.busy and not slot.done and len(slot.produced) < slot.budget
@@ -1598,7 +1660,13 @@ class ContinuousWorker:
         from ..utils.profiling import SpanTimer
 
         self.timer = SpanTimer()
-        self._stop = None  # lazily a threading.Event in run_forever
+        import threading
+
+        # Created eagerly (not lazily in run_forever) so a stop() landing
+        # before run_forever starts is sticky, like ControlLoop.stop —
+        # the lazy event silently dropped pre-start stops.
+        self._stop = threading.Event()
+        self._running = False
         self._poll_backoff = 0
         # optional WorkloadMetrics registry (attach_metrics); gauges
         # refresh once per engine cycle
@@ -1636,8 +1704,6 @@ class ContinuousWorker:
 
     def _refill(self) -> int:
         """Pull up to free-slot-count messages and prefill them in."""
-        from .service import parse_request_body
-
         free = len(self.batcher.free_slots)
         if not free:
             return 0
@@ -1651,13 +1717,22 @@ class ContinuousWorker:
         )
         if not messages and self.batcher.active:
             self._poll_backoff = self.POLL_BACKOFF_CYCLES
+        self._admit(messages)
+        return len(messages)
+
+    def _admit(self, messages: list[dict]) -> int:
+        """Parse and prefill already-received ``messages`` (at most the
+        current free-slot count) into the batcher; returns the number
+        admitted.  Poison bodies are consumed (with an error reply when
+        replies are on), not redelivered forever — and not counted as
+        processed work.  Shared by :meth:`_refill` and the fleet router's
+        direct re-dispatch path."""
+        from .service import parse_request_body
+
         admit = []
         for message in messages:
             ids = parse_request_body(message["Body"], self.tokenizer)
             if ids is None:
-                # poison messages are consumed (with an error reply when
-                # replies are on), not redelivered forever — and not
-                # counted as processed work
                 self._settle(message, None)
                 continue
             admit.append((ids, message))
@@ -1666,7 +1741,7 @@ class ContinuousWorker:
             # multi-row insert (plain slots; beam/speculative admit
             # sequentially inside submit_many)
             self.batcher.submit_many(admit)
-        return len(messages)
+        return len(admit)
 
     def attach_metrics(self, metrics) -> None:
         """Report the serving gauges (tokens/s, time-to-first-token,
@@ -1716,36 +1791,67 @@ class ContinuousWorker:
         return len(done)
 
     def stop(self) -> None:
-        if self._stop is not None:
-            self._stop.set()
+        """Ask the serve loop to exit after its current cycle.
+
+        Idempotent, and sticky like :meth:`..core.loop.ControlLoop.stop`:
+        a stop requested before :meth:`run_forever` starts still takes
+        effect (the event is created at construction, not lazily)."""
+        self._stop.set()
 
     def run_forever(self) -> None:
         """Serve until :meth:`stop` — same never-dies guarantee as
         :meth:`.service.QueueWorker.run_forever`: a transient queue or
         compute error logs, backs off, and retries (unfinished slots stay
         in flight; their messages reappear after the visibility timeout
-        if the process dies)."""
-        import threading
+        if the process dies).
 
-        if self._stop is None:
-            self._stop = threading.Event()
-        while not self._stop.is_set():
-            try:
-                with self.timer.span("cycle"):
-                    idle = self.run_once() == 0 and self.batcher.active == 0
-            except Exception as err:
-                log.error("Continuous worker cycle failed: %s", err)
-                self._stop.wait(self.config.error_backoff_s)
-                continue
-            if idle:
-                self._stop.wait(self.config.idle_sleep_s)
+        Raises :class:`RuntimeError` on a double start: two concurrent
+        serve loops over one batcher would interleave refill/step state
+        nondeterministically — the second caller must be told, not
+        silently raced."""
+        if self._running:
+            raise RuntimeError(
+                "ContinuousWorker is already running; one serve loop per "
+                "worker (spawn another replica to add capacity)"
+            )
+        self._running = True
+        try:
+            while not self._stop.is_set():
+                try:
+                    with self.timer.span("cycle"):
+                        idle = (self.run_once() == 0
+                                and self.batcher.active == 0)
+                except Exception as err:
+                    log.error("Continuous worker cycle failed: %s", err)
+                    self._stop.wait(self.config.error_backoff_s)
+                    continue
+                if idle:
+                    self._stop.wait(self.config.idle_sleep_s)
+        finally:
+            self._running = False
 
-    def drain(self, total: int, max_cycles: int | None = None) -> int:
-        """Run cycles until ``total`` messages complete (or the cycle
-        budget runs out); returns the number completed."""
+    def drain(
+        self,
+        total: int,
+        max_cycles: int | None = None,
+        timeout_s: float | None = None,
+    ) -> int:
+        """Run cycles until ``total`` messages complete (or the cycle /
+        wall-clock budget runs out); returns the number completed.
+
+        ``timeout_s`` bounds the drain in wall time: when the queue (or
+        the engine) stalls with requests still in flight, the call
+        returns instead of hanging — the un-finished messages stay
+        in-flight on the queue and reappear after its visibility
+        timeout, so giving up on a drain never loses work."""
         cycles = 0
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
         while self.processed < total:
             if max_cycles is not None and cycles >= max_cycles:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
                 break
             cycles += 1
             with self.timer.span("cycle"):

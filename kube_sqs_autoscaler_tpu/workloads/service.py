@@ -124,6 +124,49 @@ def request_id(message: dict) -> str:
     return message.get("MessageId", message["ReceiptHandle"])
 
 
+def collect_replies(
+    queue, queue_url: str, *, max_messages: int = 16
+) -> tuple[dict[str, dict], int]:
+    """Drain every currently-visible reply from ``queue_url``, deleting
+    each as it is read and de-duplicating by ``request_id``.
+
+    Returns ``(replies, duplicates)``: one parsed payload per request id
+    (first reply wins) plus the count of duplicate replies dropped.  THE
+    one reply-collection policy — the serving system is at-least-once
+    end to end (workers reply *before* deleting their input), so any
+    consumer that counts replies without this discipline double-counts:
+
+    - a reply left undeleted reappears after the queue's visibility
+      timeout and is collected again on a later pass (delete-as-read
+      closes this);
+    - a request redelivered to — or re-dispatched onto — a second worker
+      can legitimately produce a second reply (the request-id dedup
+      closes this).
+
+    Used by the serve and fleet benches and by the fleet demo; a reply
+    body that is not valid JSON is dropped (counted as a duplicate of
+    nothing — it has no request id to correlate)."""
+    replies: dict[str, dict] = {}
+    duplicates = 0
+    while True:
+        batch = queue.receive_messages(queue_url, max_messages=max_messages)
+        if not batch:
+            return replies, duplicates
+        for message in batch:
+            queue.delete_message(queue_url, message["ReceiptHandle"])
+            try:
+                payload = json.loads(message["Body"])
+                rid = payload["request_id"]
+            except Exception:
+                log.error("Dropping malformed reply body: %.64r",
+                          message["Body"])
+                continue
+            if rid in replies:
+                duplicates += 1
+                continue
+            replies[rid] = payload
+
+
 class MessageQueue(Protocol):
     """What a worker needs from a queue (satisfied by
     :class:`~..metrics.fake.FakeMessageQueue` and

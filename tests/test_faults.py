@@ -321,3 +321,37 @@ def test_summarize_chaos_identifies_controls_by_fault_provenance():
     assert summary["no_fault_scenarios"] == ["baseline"]
     assert summary["no_fault_regressions"] == ["baseline"]  # it changed!
     assert summary["resilient_wins"] == ["outage"]
+
+
+def test_fleet_fault_plan_applies_at_scheduled_cycles():
+    from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan
+
+    class _PoolSpy:
+        def __init__(self):
+            self.killed = []
+            self.hung = []
+
+        def kill_worker(self, index):
+            self.killed.append(index)
+
+        def hang_worker(self, index):
+            self.hung.append(index)
+
+    plan = FleetFaultPlan(kills=((3, 1), (5, 0)), hangs=((3, 2),))
+    assert plan.indices() == {0, 1, 2}
+    pool = _PoolSpy()
+    for cycle in range(7):
+        plan.apply(cycle, pool)
+    assert pool.killed == [1, 0]
+    assert pool.hung == [2]
+
+
+def test_fleet_fault_plan_is_deterministic_and_frozen():
+    import dataclasses
+
+    from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan
+
+    plan = FleetFaultPlan(kills=((1, 0),))
+    assert dataclasses.is_dataclass(plan)
+    with __import__("pytest").raises(dataclasses.FrozenInstanceError):
+        plan.kills = ()

@@ -1,0 +1,465 @@
+"""Fleet tests: crash-safe serving replicas behind the actuator seam.
+
+Tier-1 (tiny model, CPU): spin-up weight/engine sharing, kill →
+re-dispatch losslessness, reply dedup under visibility-timeout
+redelivery, graceful drain + drain-timeout release, hang detection,
+the ContinuousWorker lifecycle hardening pins, and the fleet
+observability surfaces (labeled gauges, trace instants).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock  # noqa: E402
+from kube_sqs_autoscaler_tpu.fleet import (  # noqa: E402
+    DEAD,
+    DRAINING,
+    SERVING,
+    STOPPED,
+    FleetDriver,
+    WorkerPool,
+)
+from kube_sqs_autoscaler_tpu.fleet.worker import FleetWorker  # noqa: E402
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue  # noqa: E402
+from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan  # noqa: E402
+from kube_sqs_autoscaler_tpu.workloads.continuous import (  # noqa: E402
+    ContinuousBatcher,
+    ContinuousWorker,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.service import (  # noqa: E402
+    ServiceConfig,
+    collect_replies,
+)
+
+BATCH, PROMPT, TOKENS, BLOCK = 2, 4, 8, 2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=PROMPT + TOKENS, dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return init_params(jax.random.key(0), model)
+
+
+def _config(**overrides):
+    base = dict(
+        queue_url="t://q", batch_size=BATCH, seq_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK,
+        result_queue_url="t://r",
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def donor(model, params):
+    """One warmed engine the whole module's pools adopt — every test
+    shares a single set of compiled programs."""
+    worker = FleetWorker(
+        FakeMessageQueue(), params, model, _config(result_queue_url="")
+    )
+    return worker.batcher
+
+
+def make_fleet(model, params, donor, *, messages, min=1, max=2,
+               initial=None, clock=None, visibility=30.0, **pool_kwargs):
+    now_fn = clock.now if clock is not None else None
+    queue = FakeMessageQueue(visibility_timeout=visibility, now_fn=now_fn)
+    results = FakeMessageQueue(now_fn=now_fn)
+    rng = np.random.default_rng(3)
+    sent = [
+        queue.send_message(
+            "t://q", json.dumps(rng.integers(1, 64, 3).tolist())
+        )
+        for _ in range(messages)
+    ]
+    pool = WorkerPool.serving(
+        queue, params, model, _config(), result_queue=results,
+        min=min, max=max, initial=initial, engine_source=donor,
+        clock=clock, **pool_kwargs,
+    )
+    return pool, queue, results, sent
+
+
+def drive(pool, *, until, max_cycles=400, after_cycle=None):
+    for _ in range(max_cycles):
+        pool.run_cycle()
+        if after_cycle is not None:
+            after_cycle()
+        if until():
+            return
+    raise AssertionError("fleet did not converge within the cycle budget")
+
+
+def all_done(pool, sent):
+    return pool.processed >= len(sent) and pool.idle
+
+
+# ---------------------------------------------------------------------------
+# Spin-up: shared weights, adopted engine (no rebuild, no recompile)
+# ---------------------------------------------------------------------------
+
+
+def test_spinup_shares_params_and_engine(model, params, donor):
+    pool, _, _, _ = make_fleet(model, params, donor, messages=0,
+                               min=1, max=3)
+    pool.scale_up()
+    pool.scale_up()
+    assert pool.replicas == 3
+    batchers = [r.worker.batcher for r in pool.members]
+    assert all(b.params is params for b in batchers)  # shared, not rebuilt
+    assert all(b._insert_many is donor._insert_many for b in batchers)
+    assert all(b._block_fn is donor._block_fn for b in batchers)
+    # per-replica state is NOT shared: each replica owns its cache
+    assert len({id(b.cache["length"]) for b in batchers}) == 3
+
+
+def test_replicas_get_distinct_sample_seeds(model, params, donor):
+    # one shared seed would make every sampled replica replay the same
+    # PRNG stream; spin-up derives sample_seed + spawn_ordinal instead
+    pool, _, _, _ = make_fleet(model, params, donor, messages=0,
+                               min=1, max=3, initial=3)
+    seeds = [r.worker.config.sample_seed for r in pool.members]
+    assert len(set(seeds)) == 3
+
+
+def test_adopt_engine_rejects_mismatched_knobs(model, params, donor):
+    other = ContinuousBatcher(
+        params, model, batch_size=BATCH, prompt_len=PROMPT,
+        generate_tokens=TOKENS - 1, decode_block=BLOCK,
+    )
+    with pytest.raises(ValueError, match="engine mismatch"):
+        other.adopt_engine(donor)
+
+
+def test_adopt_engine_rejects_beam_paths(model, params, donor):
+    beam = ContinuousBatcher(
+        params, model, batch_size=BATCH, prompt_len=PROMPT,
+        generate_tokens=TOKENS, beams=2,
+    )
+    with pytest.raises(ValueError, match="plain decode path"):
+        beam.adopt_engine(donor)
+
+
+# ---------------------------------------------------------------------------
+# Kill → re-dispatch: lossless failover
+# ---------------------------------------------------------------------------
+
+
+def test_kill_redispatches_inflight_lossless(model, params, donor):
+    pool, _, results, sent = make_fleet(
+        model, params, donor, messages=6, min=1, max=2, initial=2,
+    )
+    pool.run_cycle()  # both replicas admit a full batch
+    victim = pool.members[1]
+    assert victim.worker.batcher.active > 0
+    pool.kill_worker(1)
+    drive(pool, until=lambda: all_done(pool, sent))
+    assert victim.state == DEAD
+    assert pool.redispatched_total > 0
+    # failover freed the dead replica's slots: its orphaned requests
+    # must not keep counting as active anywhere but the survivor
+    assert victim.worker.batcher.active == 0
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)  # zero lost
+    assert duplicates == 0  # zero duplicated
+    # degraded, not stalled: the fleet kept serving with fewer replicas
+    assert pool.replicas == 1
+    events = [e.name for e in pool.events]
+    assert "replica-kill" in events and "redispatch" in events
+
+
+def test_fault_plan_drives_kill_deterministically(model, params, donor):
+    pool, _, results, sent = make_fleet(
+        model, params, donor, messages=6, min=1, max=2, initial=2,
+    )
+    plan = FleetFaultPlan(kills=((1, 0),))
+    driver = FleetDriver(pool, fault_plan=plan)
+    driver.run(until=lambda: all_done(pool, sent), max_cycles=400)
+    assert pool.members[0].state == DEAD
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_hang_detection_declares_dead_and_recovers(model, params, donor):
+    pool, _, results, sent = make_fleet(
+        model, params, donor, messages=6, min=1, max=2, initial=2,
+        hang_grace_cycles=3,
+    )
+    pool.run_cycle()
+    pool.hang_worker(1)
+    drive(pool, until=lambda: all_done(pool, sent))
+    assert pool.members[1].state == DEAD
+    kill_events = [e for e in pool.events if e.name == "replica-kill"]
+    assert kill_events and kill_events[0].args["cause"] == "hung"
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+# ---------------------------------------------------------------------------
+# Reply dedup: visibility-timeout redelivery can never double-count
+# ---------------------------------------------------------------------------
+
+
+def test_redelivered_request_answered_exactly_once(model, params, donor):
+    clock = FakeClock()
+    pool, queue, results, sent = make_fleet(
+        model, params, donor, messages=1, min=1, max=1,
+        clock=clock, visibility=0.5,
+    )
+    pool.run_cycle()  # admit the request (in-flight deadline now+0.5)
+    clock.advance(1.0)  # expire its visibility mid-service: redelivery
+    drive(
+        pool,
+        until=lambda: pool.idle and queue.get_queue_attributes("t://q", [])
+        ["ApproximateNumberOfMessages"] == "0",
+    )
+    # both copies were served; exactly one reply reached the consumer
+    assert pool.duplicates_suppressed >= 1
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    # suppressed duplicates do not count as settled work: completion
+    # criteria count UNIQUE requests answered
+    assert pool.processed == len(sent)
+
+
+def test_collect_replies_dedups_redelivered_reply(model, params):
+    # satellite regression: a reply RECEIVED but not deleted (a consumer
+    # crash) reappears after the visibility timeout; collection must
+    # count it once — and delete it so it can never reappear again
+    clock = FakeClock()
+    results = FakeMessageQueue(visibility_timeout=1.0, now_fn=clock.now)
+    results.send_message(
+        "t://r", json.dumps({"request_id": "m-1", "tokens": [1, 2]})
+    )
+    first = results.receive_messages("t://r", max_messages=1)
+    assert first and not results.receive_messages("t://r")  # in flight
+    clock.advance(2.0)  # crashed consumer: the reply redelivers
+    replies, duplicates = collect_replies(results, "t://r")
+    assert list(replies) == ["m-1"]
+    assert duplicates == 0
+    clock.advance(5.0)
+    assert results.receive_messages("t://r") == []  # deleted for good
+
+
+def test_collect_replies_counts_true_duplicates():
+    results = FakeMessageQueue()
+    for _ in range(2):  # two replicas answered the same request
+        results.send_message(
+            "t://r", json.dumps({"request_id": "m-7", "tokens": [3]})
+        )
+    replies, duplicates = collect_replies(results, "t://r")
+    assert list(replies) == ["m-7"]
+    assert duplicates == 1
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain: finish in-flight, hand back what can't finish
+# ---------------------------------------------------------------------------
+
+
+def test_scale_down_drains_then_retires(model, params, donor):
+    pool, _, results, sent = make_fleet(
+        model, params, donor, messages=4, min=1, max=2, initial=2,
+    )
+    pool.run_cycle()
+    draining_worker = pool.members[1].worker
+    assert draining_worker.batcher.active > 0
+    pool.scale_down()
+    assert pool.replicas == 1
+    assert pool.members[1].state == DRAINING
+    assert draining_worker.admitting is False
+    drive(pool, until=lambda: all_done(pool, sent))
+    assert pool.members[1].state == STOPPED
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    events = [e.name for e in pool.events]
+    assert "replica-drain-start" in events
+    assert "replica-drain-done" in events
+
+
+def test_drain_timeout_releases_inflight_to_survivors(model, params, donor):
+    pool, queue, results, sent = make_fleet(
+        model, params, donor, messages=4, min=1, max=2, initial=2,
+        drain_timeout_cycles=2, hang_grace_cycles=10,
+    )
+    pool.run_cycle()
+    pool.hang_worker(1)  # this drain can never finish on its own
+    pool.scale_down()
+    drive(pool, until=lambda: all_done(pool, sent))
+    assert pool.members[1].state == STOPPED
+    assert pool.released_total > 0  # handed back, not lost
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_stop_all_releases_and_retires(model, params, donor):
+    pool, queue, _, _ = make_fleet(
+        model, params, donor, messages=4, min=1, max=2, initial=2,
+    )
+    pool.run_cycle()
+    inflight = sum(r.worker.batcher.active for r in pool.members)
+    assert inflight > 0
+    pool.stop_all()
+    assert all(r.state == STOPPED for r in pool.members)
+    assert pool.released_total == inflight
+    # released requests became visible again: shutdown loses nothing
+    depth = int(
+        queue.get_queue_attributes("t://q", [])
+        ["ApproximateNumberOfMessages"]
+    )
+    assert depth == 4  # everything un-replied is visible again
+
+
+# ---------------------------------------------------------------------------
+# ContinuousWorker lifecycle hardening (satellite pins)
+# ---------------------------------------------------------------------------
+
+
+def _worker(model, params, donor, queue=None):
+    worker = ContinuousWorker(
+        queue or FakeMessageQueue(), params, model,
+        _config(result_queue_url=""),
+    )
+    worker.batcher.adopt_engine(donor)
+    return worker
+
+
+def test_worker_stop_is_idempotent_and_sticky(model, params, donor):
+    worker = _worker(model, params, donor)
+    worker.stop()
+    worker.stop()  # idempotent
+    # sticky: a stop BEFORE run_forever must prevent the loop (the old
+    # lazily-created event silently dropped pre-start stops)
+    worker.run_forever()  # returns immediately instead of serving
+
+
+def test_worker_double_start_raises(model, params, donor):
+    worker = _worker(model, params, donor)
+    started = threading.Event()
+    original = worker.run_once
+
+    def run_once():
+        started.set()
+        return original()
+
+    worker.run_once = run_once
+    thread = threading.Thread(target=worker.run_forever, daemon=True)
+    thread.start()
+    assert started.wait(5.0)
+    with pytest.raises(RuntimeError, match="already running"):
+        worker.run_forever()
+    worker.stop()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    # after a clean exit the worker may serve again
+    worker.run_forever()  # stop flag still set: returns immediately
+
+
+def test_drain_timeout_returns_when_engine_stalls(model, params, donor):
+    worker = _worker(model, params, donor)
+    worker.batcher.submit(np.asarray([1, 2], np.int32),
+                          payload={"ReceiptHandle": "rh", "Body": "[1,2]"})
+    worker.batcher.step = lambda: []  # wedge the engine: no progress ever
+    start = time.monotonic()
+    processed = worker.drain(total=1, timeout_s=0.2)
+    elapsed = time.monotonic() - start
+    assert processed == 0
+    assert 0.15 <= elapsed < 5.0  # returned on the timeout, no hang
+
+
+# ---------------------------------------------------------------------------
+# Observability: labeled gauges + trace instants
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_prometheus_gauges(model, params, donor):
+    from kube_sqs_autoscaler_tpu.obs.prometheus import WorkloadMetrics
+
+    pool, _, results, sent = make_fleet(
+        model, params, donor, messages=6, min=1, max=2, initial=2,
+    )
+    metrics = WorkloadMetrics()
+    pool.attach_metrics(metrics)
+    pool.run_cycle()  # both replicas admit a full batch
+    pool.scale_down()  # replica 1 drains with work in flight
+    pool.run_cycle()
+    text = metrics.render()
+    assert 'fleet_replica_state{replica="0"} 0.0' in text  # serving
+    assert 'fleet_replica_state{replica="1"} 1.0' in text  # draining
+    assert "fleet_replicas_draining 1.0" in text
+    assert 'fleet_replica_active_slots{replica="0"}' in text
+    assert 'fleet_replica_tokens_per_second{replica="0"}' in text
+    assert (
+        "# TYPE kube_sqs_autoscaler_workload_fleet_requests_"
+        "redispatched_total counter" in text
+    )
+    # HELP/TYPE emitted once per family even with several labeled series
+    assert text.count(
+        "# TYPE kube_sqs_autoscaler_workload_fleet_replica_state gauge"
+    ) == 1
+    pool.kill_worker(0)
+    pool.run_cycle()
+    text = metrics.render()
+    assert 'fleet_replica_state{replica="0"} 2.0' in text  # dead
+    pool.scale_up()  # respawn so the orphans have a survivor to land on
+    drive(pool, until=lambda: all_done(pool, sent))
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent) and duplicates == 0
+    text = metrics.render()
+    assert "fleet_requests_redispatched_total 2.0" in text
+
+
+def test_labeled_and_unlabeled_gauges_coexist():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import WorkloadMetrics
+
+    metrics = WorkloadMetrics()
+    metrics.set_gauge("tokens_per_second", 5.0, "Plain gauge.")
+    metrics.set_gauge("thing", 1.0, "Labeled.", labels=(("shard", "a"),))
+    metrics.set_gauge("thing", 2.0, "Labeled.", labels=(("shard", "b"),))
+    text = metrics.render()
+    assert "kube_sqs_autoscaler_workload_tokens_per_second 5.0" in text
+    assert 'kube_sqs_autoscaler_workload_thing{shard="a"} 1.0' in text
+    assert 'kube_sqs_autoscaler_workload_thing{shard="b"} 2.0' in text
+
+
+def test_fleet_trace_instants(model, params, donor):
+    from kube_sqs_autoscaler_tpu.obs.trace import to_chrome_trace
+
+    pool, _, _, sent = make_fleet(
+        model, params, donor, messages=2, min=1, max=2, initial=1,
+    )
+    pool.scale_up()
+    pool.run_cycle()
+    pool.kill_worker(1)
+    pool.run_cycle()
+    events = pool.trace_events(time_origin=0.0)
+    names = {e["name"] for e in events}
+    assert {"replica-spawn", "replica-kill"} <= names
+    assert all(e["ph"] == "i" and e["cat"] == "fleet" for e in events)
+    trace = to_chrome_trace([], extra_events=events)
+    assert trace["traceEvents"] == events
