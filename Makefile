@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet replay-demo chaos-demo fleet-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale replay-demo chaos-demo fleet-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -71,6 +71,16 @@ bench-chaos:
 # writes BENCH_r10.json
 bench-serve:
 	JAX_PLATFORMS=cpu python bench.py --suite serve
+
+# Sharded-plane scaling curve (CPU JAX, a few minutes): the gang-stepped
+# data-parallel serving plane vs N independent single engines on
+# identical request streams, tokens/s over shard-count x decode-block;
+# exits non-zero unless greedy outputs are byte-identical at every
+# point, the plane pays exactly one decode dispatch per cycle at every
+# shard count, and aggregate tokens/s grows monotonically S=1->2->4 in
+# the decode-bound regime; writes BENCH_r12.json
+bench-scale:
+	JAX_PLATFORMS=cpu python bench.py --suite scale
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

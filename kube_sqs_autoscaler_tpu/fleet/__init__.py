@@ -19,15 +19,19 @@ from .pool import (
     Replica,
     WorkerPool,
 )
+from .sharded import INACTIVE, SHARD_STATE_CODES, ShardedWorkerPool
 
 __all__ = [
     "DEAD",
     "DRAINING",
+    "INACTIVE",
     "REPLICA_STATE_CODES",
     "SERVING",
+    "SHARD_STATE_CODES",
     "STOPPED",
     "FleetDriver",
     "FleetEvent",
     "Replica",
+    "ShardedWorkerPool",
     "WorkerPool",
 ]

@@ -117,6 +117,65 @@ class _BoundedSet:
         return len(self._order)
 
 
+class FleetPoolBase:
+    """Plumbing shared by the two fleet actuators (:class:`WorkerPool`
+    and :class:`~.sharded.ShardedWorkerPool`): the bounded exactly-once
+    reply registry, the :class:`FleetEvent` stream + Chrome-trace
+    export, and the contract tests' one-shot failure-injection seams —
+    single-sourced so a fix to the zero-duplicate guarantee can never
+    apply to one actuator and silently miss the other."""
+
+    def __init__(
+        self,
+        *,
+        clock: Clock | None = None,
+        replied_capacity: int = 65536,
+    ) -> None:
+        self.clock = clock or SystemClock()
+        self.events: deque[FleetEvent] = deque(maxlen=4096)
+        self.cycle = 0
+        self.metrics = None
+        self._replied = _BoundedSet(replied_capacity)
+        self.duplicates_suppressed = 0
+        # test seams, mirroring the fakes' error injection hooks
+        self.fail_next_up: Exception | None = None
+        self.fail_next_down: Exception | None = None
+
+    def _injected_failure(self, direction: str) -> None:
+        """Raise (once) the armed ``fail_next_up``/``fail_next_down``
+        error as a :class:`ScaleError`, changing nothing — the contract
+        tests' failure seam."""
+        attr = f"fail_next_{direction}"
+        err = getattr(self, attr)
+        if err is not None:
+            setattr(self, attr, None)
+            raise ScaleError(f"Failed to scale {direction}") from err
+
+    # -- reply registry (the zero-duplicate guarantee) -------------------
+
+    def already_replied(self, rid: str) -> bool:
+        return rid in self._replied
+
+    def mark_replied(self, rid: str) -> None:
+        self._replied.add(rid)
+
+    def note_duplicate(self, rid: str) -> None:
+        self.duplicates_suppressed += 1
+        log.info("Suppressed duplicate reply for request %s", rid)
+
+    # -- event stream ----------------------------------------------------
+
+    def _event(self, name: str, **args) -> None:
+        self.events.append(FleetEvent(name, self.clock.now(), args))
+
+    def trace_events(self, time_origin: float | None = None) -> list[dict]:
+        """The pool's decisions as Chrome-trace instant events (merge
+        into a tick trace via ``to_chrome_trace(..., extra_events=...)``)."""
+        from ..obs.trace import instant_trace_events
+
+        return instant_trace_events(self.events, time_origin)
+
+
 class Replica:
     """One supervised fleet member: a worker plus its lifecycle state."""
 
@@ -135,7 +194,7 @@ class Replica:
         return self.worker.batcher.tokens_emitted + self.worker.processed
 
 
-class WorkerPool:
+class WorkerPool(FleetPoolBase):
     """A supervised pool of serving replicas behind the Scaler seam.
 
     ``replica_factory(pool)`` builds one replica worker (the real thing:
@@ -167,12 +226,12 @@ class WorkerPool:
             # one no-progress cycle is legitimate (the block engine's
             # dispatch-ahead consumes block N one cycle after dispatch)
             raise ValueError("hang_grace_cycles must be >= 2")
+        super().__init__(clock=clock, replied_capacity=replied_capacity)
         self.replica_factory = replica_factory
         self.min = min
         self.max = max
         self.scale_up_pods = scale_up_pods
         self.scale_down_pods = scale_down_pods
-        self.clock = clock or SystemClock()
         self.hang_grace_cycles = hang_grace_cycles
         self.drain_timeout_cycles = drain_timeout_cycles
         # live replicas plus a bounded tail of recently-retired/dead ones
@@ -182,19 +241,11 @@ class WorkerPool:
         self.members: list[Replica] = []
         self.retired_keep = 32
         self._retired_processed = 0
-        self.events: deque[FleetEvent] = deque(maxlen=4096)
-        self.cycle = 0
         self._next_index = 0
         self._spawn_ordinal = 0  # factory invocations (pre-commit safe)
         self._orphans: list[dict] = []  # re-dispatch queue (priority)
-        self._replied = _BoundedSet(replied_capacity)
         self.redispatched_total = 0
         self.released_total = 0
-        self.duplicates_suppressed = 0
-        self.metrics = None
-        # test seams, mirroring the fakes' error injection hooks
-        self.fail_next_up: Exception | None = None
-        self.fail_next_down: Exception | None = None
         if initial is None:
             initial = min
         if not min <= initial <= max:
@@ -218,9 +269,7 @@ class WorkerPool:
         return sum(1 for r in self.members if r.state == SERVING)
 
     def scale_up(self) -> None:
-        if self.fail_next_up is not None:
-            err, self.fail_next_up = self.fail_next_up, None
-            raise ScaleError("Failed to scale up") from err
+        self._injected_failure("up")
         current = self.replicas
         if current >= self.max:
             log.info(
@@ -245,9 +294,7 @@ class WorkerPool:
         log.info("Scale up successful. Replicas: %d", self.replicas)
 
     def scale_down(self) -> None:
-        if self.fail_next_down is not None:
-            err, self.fail_next_down = self.fail_next_down, None
-            raise ScaleError("Failed to scale down") from err
+        self._injected_failure("down")
         current = self.replicas
         if current <= self.min:
             log.info(
@@ -327,7 +374,17 @@ class WorkerPool:
         self.cycle += 1
         self._supervise()
         done = 0
-        serving = [r for r in self.members if r.state == SERVING]
+        # ONE state-partition pass per cycle (this loop used to re-scan
+        # `self.members` — live replicas plus the bounded retired tail —
+        # once per state it routed), so cycle cost stays flat however
+        # much retirement history the bounded tail holds
+        serving: list[Replica] = []
+        draining: list[Replica] = []
+        for replica in self.members:
+            if replica.state == SERVING:
+                serving.append(replica)
+            elif replica.state == DRAINING:
+                draining.append(replica)
         # router: freest replica first, so a refill cycle spreads the
         # queue's head across the fleet instead of soaking one replica
         serving.sort(
@@ -337,7 +394,7 @@ class WorkerPool:
             if self._orphans:
                 self._dispatch_orphans(replica)
             done += replica.worker.run_once()
-        for replica in [r for r in self.members if r.state == DRAINING]:
+        for replica in draining:
             done += replica.worker.run_once()
             if replica.worker.batcher.active == 0:
                 # nothing in flight: the drain is complete (hung or not —
@@ -423,21 +480,8 @@ class WorkerPool:
         )
 
     # ------------------------------------------------------------------
-    # Reply registry (the zero-duplicate guarantee)
-    # ------------------------------------------------------------------
-
-    def already_replied(self, rid: str) -> bool:
-        return rid in self._replied
-
-    def mark_replied(self, rid: str) -> None:
-        self._replied.add(rid)
-
-    def note_duplicate(self, rid: str) -> None:
-        self.duplicates_suppressed += 1
-        log.info("Suppressed duplicate reply for request %s", rid)
-
-    # ------------------------------------------------------------------
-    # Introspection / observability
+    # Introspection / observability (reply registry + event stream live
+    # on FleetPoolBase, shared with the sharded plane's pool)
     # ------------------------------------------------------------------
 
     def next_spawn_ordinal(self) -> int:
@@ -488,17 +532,6 @@ class WorkerPool:
                 self.released_total += released
                 self._retire(replica, released=released)
         self._update_metrics()
-
-    def _event(self, name: str, **args) -> None:
-        self.events.append(FleetEvent(name, self.clock.now(), args))
-
-    def trace_events(self, time_origin: float | None = None) -> list[dict]:
-        """The supervisor's decisions as Chrome-trace instant events
-        (merge into a tick trace via ``to_chrome_trace(...,
-        extra_events=...)``)."""
-        from ..obs.trace import instant_trace_events
-
-        return instant_trace_events(self.events, time_origin)
 
     def attach_metrics(self, metrics) -> None:
         """Refresh per-replica fleet gauges into a

@@ -1,0 +1,290 @@
+"""ShardedWorkerPool: the Scaler seam over shard-active mask flips.
+
+The :class:`~.pool.WorkerPool` scales capacity by spawning/draining
+whole worker replicas — real robustness (a replica can die), but every
+serving cycle steps N engines from Python.  This pool is the sharded
+actuation mode: ONE gang-stepped worker
+(:class:`~..workloads.shard_plane.ShardedBatcher` behind a
+:class:`~.worker.FleetWorker`) holds ``shards`` engine shards, and
+``scale_up``/``scale_down`` flip device-side shard-active masks — O(1),
+no spawn, no rebuild, no recompile — while the UNCHANGED
+:class:`~..core.loop.ControlLoop` drives the same
+:class:`~..core.types.Scaler` seam (PodAutoScaler parity pinned by the
+actuator contract test, exactly like the replica pool):
+
+- step by ``scale_up_pods``/``scale_down_pods`` clamped to
+  ``[min, max]``; boundary no-ops are success; injected failures raise
+  :class:`~..core.types.ScaleError` and change nothing;
+- ``scale_down`` DRAINS: the newest serving shards stop admitting
+  instantly (mask flip — the router and the device summary skip them)
+  but their in-flight slots decode to completion; a drained-empty shard
+  retires to inactive.  ``scale_up`` resurrects draining shards first
+  (cancelling a drain is the same O(1) flip), then activates inactive
+  ones lowest-index first;
+- replies stay exactly-once on the at-least-once queue through the same
+  bounded reply registry the replica pool uses (the worker is a
+  :class:`~.worker.FleetWorker`, so visibility-timeout redeliveries
+  dedup identically).
+
+What the mask flip does NOT re-drive: shard state never moves — there
+is no weight broadcast, no cache migration, no engine adoption, because
+every shard lives inside the one already-compiled gang program.  The
+trade against the replica pool is isolation: shards share a process and
+a device program, so there is no kill/hang failover INSIDE the plane —
+whole-plane crashes are the queue's visibility timeout's job, and
+mixed deployments (several sharded planes under one replica pool)
+compose the two seams.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from ..core.clock import Clock
+from .pool import DRAINING, SERVING, FleetPoolBase
+
+log = logging.getLogger(__name__)
+
+builtins_min = min
+builtins_max = max
+
+# The third shard state: mask off, nothing in flight.  (A shard is never
+# DEAD/STOPPED — it has no process to lose.)
+INACTIVE = "inactive"
+SHARD_STATE_CODES = {SERVING: 0, DRAINING: 1, INACTIVE: 2}
+
+
+class ShardedWorkerPool(FleetPoolBase):
+    """A Scaler whose replica count is the active-shard count of one
+    gang-stepped serving plane.
+
+    ``worker_factory(pool)`` builds THE worker (called once; real
+    fleets wire a :class:`~.worker.FleetWorker` over a sharded batcher
+    via :meth:`serving`, the contract test substitutes a featherweight
+    stub).  ``max`` defaults to — and may not exceed — the batcher's
+    allocated shard count: activation is a mask flip, so capacity
+    beyond the allocation would need a real spawn (that is the replica
+    pool's job).
+    """
+
+    def __init__(
+        self,
+        worker_factory: Callable[["ShardedWorkerPool"], Any],
+        *,
+        min: int,
+        max: int | None = None,
+        scale_up_pods: int = 1,
+        scale_down_pods: int = 1,
+        initial: int | None = None,
+        clock: Clock | None = None,
+        replied_capacity: int = 65536,
+    ) -> None:
+        if scale_up_pods < 1 or scale_down_pods < 1:
+            raise ValueError("scale step sizes must be >= 1")
+        super().__init__(clock=clock, replied_capacity=replied_capacity)
+        self.worker = worker_factory(self)
+        self.shards = self.worker.batcher.shards
+        if max is None:
+            max = self.shards
+        if not 1 <= min <= max:
+            raise ValueError(f"need 1 <= min ({min}) <= max ({max})")
+        if max > self.shards:
+            raise ValueError(
+                f"max ({max}) exceeds the plane's allocated shards "
+                f"({self.shards}); activation is a mask flip, not a spawn"
+            )
+        self.min = min
+        self.max = max
+        self.scale_up_pods = scale_up_pods
+        self.scale_down_pods = scale_down_pods
+        if initial is None:
+            initial = min
+        if not min <= initial <= max:
+            raise ValueError(
+                f"initial ({initial}) must be within [min, max]"
+            )
+        self.shard_states = [
+            SERVING if s < initial else INACTIVE for s in range(self.shards)
+        ]
+        for s in range(self.shards):
+            self.worker.batcher.set_shard_active(s, s < initial)
+            if s < initial:
+                self._event("shard-activate", shard=s)
+
+    # ------------------------------------------------------------------
+    # The Scaler seam (PodAutoScaler parity — pinned by contract test)
+    # ------------------------------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        """Active shard count — the plane's ``spec.replicas``.  Draining
+        shards are excluded, like the replica pool's DRAINING members."""
+        return sum(1 for st in self.shard_states if st == SERVING)
+
+    def scale_up(self) -> None:
+        self._injected_failure("up")
+        current = self.replicas
+        if current >= self.max:
+            log.info(
+                "More than max shards active. No scale up. Shards: %d",
+                current,
+            )
+            return
+        target = builtins_min(current + self.scale_up_pods, self.max)
+        # resurrect draining shards first (newest drain first — their
+        # slots are warmest and cancelling a drain is the same O(1)
+        # flip), then activate inactive shards lowest-index first
+        draining = [
+            s for s in reversed(range(self.shards))
+            if self.shard_states[s] == DRAINING
+        ]
+        inactive = [
+            s for s in range(self.shards)
+            if self.shard_states[s] == INACTIVE
+        ]
+        for shard in (draining + inactive)[: target - current]:
+            self.shard_states[shard] = SERVING
+            self.worker.batcher.set_shard_active(shard, True)
+            self._event("shard-activate", shard=shard)
+        log.info("Scale up successful. Shards: %d", self.replicas)
+
+    def scale_down(self) -> None:
+        self._injected_failure("down")
+        current = self.replicas
+        if current <= self.min:
+            log.info(
+                "Less than min shards active. No scale down. Shards: %d",
+                current,
+            )
+            return
+        target = builtins_max(current - self.scale_down_pods, self.min)
+        serving = [
+            s for s in reversed(range(self.shards))
+            if self.shard_states[s] == SERVING
+        ]
+        for shard in serving[: current - target]:
+            # newest shard first, mirroring the replica pool's drain
+            # order; the mask flip stops admission instantly, in-flight
+            # slots finish on the gang step
+            self.shard_states[shard] = DRAINING
+            self.worker.batcher.set_shard_active(shard, False)
+            self._event(
+                "shard-drain-start", shard=shard,
+                inflight=self.worker.batcher.shard_busy(shard),
+            )
+        log.info("Scale down successful. Shards: %d", self.replicas)
+
+    # ------------------------------------------------------------------
+    # The serving cycle
+    # ------------------------------------------------------------------
+
+    def run_cycle(self) -> int:
+        """One plane cycle: ONE worker cycle (refill + gang step +
+        settle) however many shards are active, then retire any draining
+        shard that emptied.  Returns requests completed."""
+        self.cycle += 1
+        done = self.worker.run_once()
+        for shard, state in enumerate(self.shard_states):
+            if state == DRAINING and self.worker.batcher.shard_busy(shard) == 0:
+                self.shard_states[shard] = INACTIVE
+                self._event("shard-deactivate", shard=shard)
+        self._update_metrics()
+        return done
+
+    @property
+    def processed(self) -> int:
+        return self.worker.processed
+
+    @property
+    def idle(self) -> bool:
+        return self.worker.batcher.active == 0
+
+    def stop_all(self) -> None:
+        """Stop the plane, releasing un-finished in-flight requests back
+        to the queue (shutdown never loses work — same contract as the
+        replica pool's stop_all)."""
+        release = getattr(self.worker, "release_inflight", None)
+        if release is not None:
+            release()
+        self.worker.stop()
+        for shard, state in enumerate(self.shard_states):
+            if state in (SERVING, DRAINING):
+                self.shard_states[shard] = INACTIVE
+                self.worker.batcher.set_shard_active(shard, False)
+        self._update_metrics()
+
+    # ------------------------------------------------------------------
+    # Observability (the reply registry and the FleetEvent stream —
+    # including the exactly-once protocol the FleetWorker settle path
+    # speaks — live on FleetPoolBase, shared with WorkerPool)
+    # ------------------------------------------------------------------
+
+    def attach_metrics(self, metrics) -> None:
+        """Refresh the per-shard gauge family (``shard_active``,
+        ``shard_active_slots``, ``shard_tokens_per_second``) into a
+        :class:`~..obs.prometheus.WorkloadMetrics` registry each cycle."""
+        self.metrics = metrics
+        self._update_metrics()
+
+    def _update_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        batcher = self.worker.batcher
+        served_since = getattr(self.worker, "_served_since", None)
+        for row in batcher.shard_stats(served_since):
+            self.metrics.set_shard_gauges(
+                row["shard"],
+                active=self.shard_states[row["shard"]] == SERVING,
+                active_slots=row["active_slots"],
+                tokens_per_second=row["tokens_per_second"],
+            )
+
+    # ------------------------------------------------------------------
+    # Real-plane construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def serving(
+        cls,
+        queue,
+        params,
+        model_config,
+        service_config,
+        *,
+        min: int,
+        max: int | None = None,
+        shards: int | None = None,
+        family: str = "gpt",
+        tokenizer=None,
+        result_queue=None,
+        mesh=None,
+        **pool_kwargs,
+    ) -> "ShardedWorkerPool":
+        """One gang-stepped :class:`~.worker.FleetWorker` whose batcher
+        stacks ``shards`` engine shards of ``service_config.batch_size``
+        slots each (``shards`` defaults to ``service_config.shards``,
+        which defaults to ``max``)."""
+        import dataclasses
+
+        if shards is None:
+            shards = (
+                service_config.shards if service_config.shards > 1
+                else (max or service_config.shards)
+            )
+        seeded = dataclasses.replace(service_config, shards=shards)
+
+        def factory(pool: "ShardedWorkerPool"):
+            from .worker import FleetWorker
+
+            return FleetWorker(
+                queue, params, model_config, seeded,
+                family=family, tokenizer=tokenizer,
+                result_queue=result_queue, mesh=mesh, pool=pool,
+                # force the gang engine even for a one-shard plane (the
+                # worker's auto-pick would build the plain batcher,
+                # which has no shard surface to actuate)
+                sharded=True,
+            )
+
+        return cls(factory, min=min, max=max, **pool_kwargs)

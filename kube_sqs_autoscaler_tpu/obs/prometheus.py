@@ -409,6 +409,37 @@ class WorkloadMetrics:
             "(accepted/block-size; 0 until a block runs).",
         )
 
+    def set_shard_gauges(
+        self,
+        shard: int,
+        *,
+        active: bool,
+        active_slots: int,
+        tokens_per_second: float,
+    ) -> None:
+        """The sharded serving plane's per-shard gauge family (one
+        labeled series per engine shard, refreshed every plane cycle by
+        :class:`~..fleet.sharded.ShardedWorkerPool`)."""
+        labels = (("shard", str(shard)),)
+        self.set_gauge(
+            "shard_active", 1.0 if active else 0.0,
+            "Shard participates in admission (1) or is draining/inactive "
+            "(0). Flipped by the scale path's device-side mask.",
+            labels=labels,
+        )
+        self.set_gauge(
+            "shard_active_slots", active_slots,
+            "Decode slots of this shard currently holding an in-flight "
+            "request.",
+            labels=labels,
+        )
+        self.set_gauge(
+            "shard_tokens_per_second", tokens_per_second,
+            "Generated tokens per second attributed to this shard over "
+            "the plane's serving lifetime.",
+            labels=labels,
+        )
+
     @property
     def ready(self) -> bool:
         """Readiness = at least one gauge sample or timed span recorded."""

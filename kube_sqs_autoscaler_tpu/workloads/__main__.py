@@ -105,6 +105,18 @@ def main(argv=None) -> None:
              "or --speculative-draft-layers)",
     )
     parser.add_argument(
+        "--shards", type=int, default=1, metavar="S",
+        help="sharded serving plane: stack S gang-stepped engine shards "
+             "of --batch-size slots each behind ONE admission plane — "
+             "all shards advance in a single jitted decode call per "
+             "cycle, refills route freest-shard-first, and greedy "
+             "outputs stay byte-identical to S independent workers "
+             "(requires --continuous; plain decode path only — not "
+             "with --beams / --speculative-draft-layers; under "
+             "--model-parallel the mesh's data axis must divide S, so "
+             "each device holds whole shards)",
+    )
+    parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
         help="speculative decoding with an early-exit self-draft: the "
              "model's own first N layers propose tokens and the full "
@@ -241,6 +253,18 @@ def main(argv=None) -> None:
             raise SystemExit(
                 "--decode-block applies to the plain continuous decode "
                 "path (not --beams / --speculative-draft-layers)"
+            )
+    if args.shards < 1:
+        raise SystemExit(f"--shards {args.shards} must be >= 1")
+    if args.shards > 1:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --decode-block checks above)
+        if not args.continuous:
+            raise SystemExit("--shards requires --continuous")
+        if args.beams > 1 or args.speculative_draft_layers:
+            raise SystemExit(
+                "--shards applies to the plain continuous decode path "
+                "(not --beams / --speculative-draft-layers)"
             )
     prefix_ids: list[int] = []
     if args.prefix_ids:
@@ -425,6 +449,7 @@ def main(argv=None) -> None:
         eos_id=None if args.eos_id < 0 else args.eos_id,
         quantized_kv=args.quantize_kv,
         decode_block=args.decode_block,
+        shards=args.shards,
     )
     tokenizer = None
     if args.tokenizer:

@@ -529,6 +529,16 @@ class ContinuousBatcher:
         # block-decode utilization: kept tokens vs dispatched positions
         self.block_tokens = 0
         self.block_capacity = 0
+        # host-sync instrumentation (cheap ints, test-pinned): device
+        # program launches and host-blocking transfers over the engine's
+        # lifetime.  The serving contract these pin: admission costs ONE
+        # insert dispatch and ZERO transfers per refill cycle however
+        # many requests it admits, and a decode cycle costs one decode
+        # dispatch plus one bounded settle transfer — never per-request,
+        # never per-shard.
+        self.decode_dispatches = 0
+        self.insert_dispatches = 0
+        self.host_transfers = 0
         # deferred first tokens: (device array, slot rows), consumed in
         # one batched transfer at the next step()
         self._pending_firsts: list[tuple[Any, list[int]]] = []
@@ -1237,7 +1247,9 @@ class ContinuousBatcher:
                 self._beam_scores, self._beam_out, self._beam_alive,
                 self._beam_emitted, active,
             )
+            self.decode_dispatches += 1
             alive_host = np.asarray(alive_any)
+            self.host_transfers += 1
             for row, slot in enumerate(self.slots):
                 if needs[row]:
                     slot.rounds += 1
@@ -1340,6 +1352,7 @@ class ContinuousBatcher:
             jnp.asarray(prompts), jnp.asarray(lengths),
             next(self._keys), n_rows=len(rows),
         )
+        self.insert_dispatches += 1
         self._pending_firsts.append((firsts, list(rows)))
         for row, (_, payload) in zip(rows, requests):
             # a fresh record per request: step() replaces finished slots
@@ -1363,6 +1376,7 @@ class ContinuousBatcher:
                 self._current, jnp.asarray(row, jnp.int32),
                 jnp.asarray(ids), jnp.asarray(length, jnp.int32),
             )
+            self.insert_dispatches += 1
             # rounds counts beam steps taken; a budget-1 slot finishes
             # without any (the insert's first expansion is the answer)
             self.slots[row] = _Slot(
@@ -1376,6 +1390,7 @@ class ContinuousBatcher:
             jnp.asarray(row, jnp.int32), jnp.asarray(ids),
             jnp.asarray(length, jnp.int32), next(self._keys),
         )
+        self.insert_dispatches += 1
         self._pending_firsts.append((first, [row]))
         self.slots[row] = _Slot(
             busy=True, budget=self.generate_tokens, payload=payload,
@@ -1399,10 +1414,21 @@ class ContinuousBatcher:
         if not self._pending_firsts:
             return
         pending, self._pending_firsts = self._pending_firsts, []
+        self.host_transfers += len(pending)
+        self._record_firsts(
+            [(np.asarray(arr), rows) for arr, rows in pending]
+        )
+
+    def _record_firsts(
+        self, pending_host: list[tuple[np.ndarray, list[int]]]
+    ) -> None:
+        """Emit already-host-resident first tokens and record TTFT (the
+        transfer-free half of :meth:`_settle_pending_firsts`, split out
+        so the sharded plane can fold the fetch into its one combined
+        settle transfer per cycle)."""
         now = time.perf_counter()
-        for arr, rows in pending:
-            vals = np.asarray(arr).reshape(-1)
-            for token, row in zip(vals, rows):
+        for vals, rows in pending_host:
+            for token, row in zip(np.asarray(vals).reshape(-1), rows):
                 slot = self.slots[row]
                 self._emit(slot, int(token))
                 ttft = now - slot.submitted_at
@@ -1467,7 +1493,9 @@ class ContinuousBatcher:
             self.cache, nxt = self._decode(
                 self.params, self.cache, self._current, next(self._keys)
             )
+            self.decode_dispatches += 1
             nxt_host = np.asarray(nxt)
+            self.host_transfers += 1
             for row, slot in enumerate(self.slots):
                 if needs[row]:
                     self._emit(slot, int(nxt_host[row]))
@@ -1503,13 +1531,16 @@ class ContinuousBatcher:
                 self.params, self.cache, self._current, self._done,
                 self._remaining, self._block_keys(),
             )
+            self.decode_dispatches += 1
             new_block = (tokens, counts, busy)
         self._settle_pending_firsts()
         pending, self._pending_block = self._pending_block, new_block
         if pending is not None:
             tokens, counts, dispatched_busy = pending
-            toks_host = np.asarray(tokens)
-            counts_host = np.asarray(counts)
+            # ONE host sync for the whole settled block (tokens + counts
+            # fetched together), not one per array
+            toks_host, counts_host = jax.device_get((tokens, counts))
+            self.host_transfers += 1
             self.block_capacity += self.decode_block * dispatched_busy
             self.block_tokens += int(counts_host.sum())
             for row, slot in enumerate(self.slots):
@@ -1535,12 +1566,12 @@ class ContinuousBatcher:
             self.params, self.draft_params, self.cache,
             self.draft_cache, self._current, active, next(self._keys),
         )
+        self.decode_dispatches += 1
         return round_tokens, n
 
     def _consume_spec_round(self, mask: list[bool], handle) -> None:
-        round_tokens, n = handle
-        toks_host = np.asarray(round_tokens)
-        n_host = np.asarray(n)
+        toks_host, n_host = jax.device_get(handle)
+        self.host_transfers += 1
         for row, slot in enumerate(self.slots):
             if not mask[row]:
                 continue
@@ -1616,6 +1647,7 @@ class ContinuousWorker:
         draft_tokens: int = 4,
         beams: int = 1,
         length_penalty: float = 0.0,
+        sharded: bool | None = None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -1634,11 +1666,7 @@ class ContinuousWorker:
         self.config = service_config
         self.tokenizer = tokenizer
         self.result_queue = result_queue
-        self.batcher = ContinuousBatcher(
-            params, model_config,
-            batch_size=service_config.batch_size,
-            prompt_len=service_config.seq_len,
-            generate_tokens=service_config.generate_tokens,
+        batcher_kwargs = dict(
             family=family,
             temperature=service_config.temperature,
             top_k=service_config.top_k,
@@ -1654,6 +1682,35 @@ class ContinuousWorker:
             length_penalty=length_penalty,
             decode_block=service_config.decode_block,
         )
+        shards = getattr(service_config, "shards", 1)
+        if sharded is None:
+            sharded = shards > 1
+        if sharded:
+            # the sharded serving plane: `shards` gang-stepped engine
+            # shards of batch_size slots each behind this one worker's
+            # admission loop (ONE decode dispatch per cycle however many
+            # shards; see workloads/shard_plane.py).  `sharded=True`
+            # forces the plane even at shards=1 — the S=1 end of the
+            # scaling curve, and a ShardedWorkerPool pinned to one shard,
+            # must run the gang engine, not the plain block engine.
+            from .shard_plane import ShardedBatcher
+
+            self.batcher: ContinuousBatcher = ShardedBatcher(
+                params, model_config,
+                shards=shards,
+                shard_slots=service_config.batch_size,
+                prompt_len=service_config.seq_len,
+                generate_tokens=service_config.generate_tokens,
+                **batcher_kwargs,
+            )
+        else:
+            self.batcher = ContinuousBatcher(
+                params, model_config,
+                batch_size=service_config.batch_size,
+                prompt_len=service_config.seq_len,
+                generate_tokens=service_config.generate_tokens,
+                **batcher_kwargs,
+            )
         self.processed = 0
         # wall-clock engine-cycle spans (same metrics surface as
         # QueueWorker: obs attaches this to /metrics)

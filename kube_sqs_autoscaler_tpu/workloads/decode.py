@@ -646,6 +646,106 @@ def block_decode(
     return cache, current, done, remaining, tokens, counts
 
 
+def gang_block_decode(
+    params: dict,
+    cache: dict,
+    current: jax.Array,
+    done: jax.Array,
+    remaining: jax.Array,
+    keys: jax.Array,
+    shard_active: jax.Array,
+    config: ModelConfig,
+    step_fn=None,
+    *,
+    shards: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+    fold_keys: bool = False,
+) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array]:
+    """Advance ``shards`` stacked engine shards with ONE compiled call.
+
+    The operands are the flat ``[S*B]`` row space a
+    :class:`~.continuous.ContinuousBatcher` already owns; internally each
+    leaf is viewed ``[S, B, ...]`` and :func:`block_decode` is ``vmap``ed
+    over the leading shard axis — per-shard results are byte-identical
+    to ``S`` independent engines of ``B`` slots each, because rows never
+    interact across the batch axis and the vmapped inner computation is
+    the independent engine's computation (pinned by the scale bench's
+    parity gate).  Decode needs NO cross-shard communication at all; the
+    only cross-shard product is the trailing ``[S]`` free-slot summary,
+    a reduction the host fetches once per cycle — overlapped with the
+    next block via the caller's dispatch-ahead — as the plane's
+    device-confirmed depth signal (per-shard observability and the
+    one-transfer-per-cycle contract the bench pins).  Routing itself
+    reads the host's own slot bookkeeping, which is authoritative and
+    costs no transfer at all.
+
+    ``shard_active`` (bool ``[S]``) is the device-side scale mask:
+    deactivated shards report 0 free slots (the admission plane stops
+    routing to them instantly) while their in-flight rows keep decoding
+    to completion — the drain contract.  Flipping it is O(1); no state
+    moves, nothing recompiles.
+
+    ``fold_keys`` (sampled serving): fold the shard index into each
+    block key so shards draw independent PRNG streams instead of every
+    shard replaying one stream.  Greedy ignores keys entirely.
+
+    Returns ``(cache, current, done, remaining, tokens [block, S*B],
+    counts [S*B], free [S])`` — the flat-state contract of
+    :func:`block_decode` plus the per-shard summary.
+    """
+    if step_fn is None:
+        step_fn = decode_step
+    rows = current.shape[0]
+    if rows % shards:
+        raise ValueError(f"{rows} rows not divisible by {shards} shards")
+    slots = rows // shards
+
+    def to_shards(leaf):
+        return leaf.reshape((shards, slots) + leaf.shape[1:])
+
+    def to_rows(leaf):
+        return leaf.reshape((rows,) + leaf.shape[2:])
+
+    cache_s = jax.tree.map(to_shards, cache)
+    cur_s, done_s, rem_s = (to_shards(x) for x in (current, done, remaining))
+    if fold_keys:
+        shard_keys = jax.vmap(
+            lambda s: jax.vmap(lambda k: jax.random.fold_in(k, s))(keys)
+        )(jnp.arange(shards))
+        key_axis = 0
+    else:
+        shard_keys = keys
+        key_axis = None
+
+    def one_shard(shard_cache, cur, done, rem, shard_keys):
+        return block_decode(
+            params, shard_cache, cur, done, rem, shard_keys, config,
+            step_fn, temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id,
+        )
+
+    cache_s, cur_s, done_s, rem_s, toks, counts = jax.vmap(
+        one_shard, in_axes=(0, 0, 0, 0, key_axis)
+    )(cache_s, cur_s, done_s, rem_s, shard_keys)
+    # [S, block, B] -> [block, S*B]: the host consume loop reads the same
+    # (position, row) layout the single-plane block engine returns
+    block = toks.shape[1]
+    tokens = jnp.transpose(toks, (1, 0, 2)).reshape(block, rows)
+    free = jnp.where(
+        shard_active,
+        jnp.sum((done_s | (rem_s <= 0)).astype(jnp.int32), axis=1),
+        0,
+    )
+    return (
+        jax.tree.map(to_rows, cache_s), to_rows(cur_s), to_rows(done_s),
+        to_rows(rem_s), tokens, counts.reshape(rows), free,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Prefix caching: share one prompt prefix's KV across a batch of requests
 # ---------------------------------------------------------------------------

@@ -221,6 +221,13 @@ class ServiceConfig:
     # only scheduling granularity changes; sampled runs stay
     # distribution-exact but consume RNG keys in a different order.
     decode_block: int = 1
+    # continuous serving only: > 1 stacks this many engine shards of
+    # batch_size slots each behind ONE admission plane, gang-stepped in
+    # a single jitted decode call per cycle (workloads/shard_plane.py);
+    # scale-up/down flips device-side shard-active masks instead of
+    # spawning workers.  Greedy outputs are byte-identical to `shards`
+    # independent single engines; plain decode path only.
+    shards: int = 1
     # request/reply: when set, the worker publishes one JSON result per
     # input message to this queue (after compute, before deleting the
     # input — at-least-once semantics, so consumers must tolerate
@@ -248,6 +255,8 @@ class ServiceConfig:
             raise ValueError(
                 f"decode_block={self.decode_block} must be >= 1"
             )
+        if self.shards < 1:
+            raise ValueError(f"shards={self.shards} must be >= 1")
 
 
 class QueueWorker:

@@ -1,0 +1,350 @@
+"""The sharded serving plane: gang-stepped data-parallel engine shards.
+
+The PR 6 fleet scales capacity by stepping N independent
+:class:`~.continuous.ContinuousWorker` replicas in a sequential Python
+loop — aggregate tokens/s is host-bound again, paying N block dispatches,
+N settle transfers, and N refill syncs per fleet cycle.  This module
+removes that Python-rate wall by re-expressing the whole fleet's decode
+as ONE program over a shard axis:
+
+- **slot state stacks along a leading shard axis** ``[S, B, ...]``
+  (stored flat as ``[S*B]`` rows — the exact
+  :class:`~.continuous.ContinuousBatcher` layout, so the insert, the
+  liveness masks, and every cache layout variant are reused verbatim);
+- **one gang-stepped decode per cycle**:
+  :func:`~.decode.gang_block_decode` ``vmap``s the PR 5 block engine
+  over the shard axis — all shards advance up to ``decode_block`` tokens
+  in one jitted call, per-row liveness kept device-side exactly as the
+  block engine does per row.  One dispatch, however many shards.  Under
+  a mesh the leading shard axis partitions over ``"data"`` (GSPMD
+  places whole shards per device — the ``shard_map`` layout without the
+  explicit collective plumbing, and decode itself needs NO cross-shard
+  communication to overlap: the NCCL/collective-synthesis literature's
+  question of which collectives to hide never arises because the only
+  cross-shard product is the ``[S]`` summary below);
+- **one admission plane**: the host routes each refill cycle's requests
+  freest-shard-first (deterministic tie-break: lowest shard index) and
+  prefills them with the existing one-shot ``[M, P]`` insert over GLOBAL
+  row ids — one insert dispatch per refill cycle even when the batch
+  splits across shards, zero per-request host syncs;
+- **one summary transfer per cycle**: the gang step returns a per-shard
+  ``[S]`` free-slot summary; the host fetches it together with the
+  settled block's tokens in ONE ``jax.device_get`` — overlapped with
+  the next block via the inherited dispatch-ahead double buffering.
+  The summary is the plane's device-confirmed depth signal (surfaced
+  per shard via :meth:`ShardedBatcher.shard_stats`); the router's
+  freest-first ordering reads the host's own slot bookkeeping, which
+  is authoritative and transfer-free;
+- **O(1) scale**: :meth:`ShardedBatcher.set_shard_active` flips a
+  device-side ``[S]`` mask bit.  A deactivated shard stops admitting
+  instantly (the summary reports it full; the router skips it) while
+  its in-flight rows decode to completion — drain semantics without
+  spawning, rebuilding, or recompiling anything.
+  :class:`~..fleet.sharded.ShardedWorkerPool` actuates this through the
+  unchanged :class:`~..core.types.Scaler` seam.
+
+Greedy outputs are byte-identical to ``S`` independent single engines on
+the same request stream (hard-gated in ``bench.py --suite scale``):
+rows never interact across the batch axis, and the vmapped inner
+computation IS the independent engine's computation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .continuous import ContinuousBatcher
+
+
+class ShardedBatcher(ContinuousBatcher):
+    """``shards`` gang-stepped engine shards behind one admission plane.
+
+    Construction mirrors :class:`~.continuous.ContinuousBatcher` with
+    ``batch_size`` replaced by ``shards`` x ``shard_slots`` (shard ``s``
+    owns rows ``[s*shard_slots, (s+1)*shard_slots)``).  Plain decode
+    path only — beam and speculative slots amortize their own device
+    calls per slot, not per shard.  Everything else composes: both
+    families, greedy or sampled (shards draw independent PRNG streams
+    via per-shard key folding), int8 KV, shared prefix, mesh.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        shards: int,
+        shard_slots: int,
+        prompt_len: int,
+        generate_tokens: int,
+        **kwargs,
+    ) -> None:
+        if shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        if shard_slots < 1:
+            raise ValueError(f"shard_slots={shard_slots} must be >= 1")
+        if kwargs.get("beams", 1) > 1 or kwargs.get("draft_layers", 0):
+            raise ValueError(
+                "the sharded plane applies to the plain continuous "
+                "decode path (not beams / speculative slots)"
+            )
+        mesh = kwargs.get("mesh")
+        if mesh is not None and shards % mesh.shape["data"]:
+            # each device must hold WHOLE shards for the [S*B] -> [S, B]
+            # view to stay resharding-free under the pinned row
+            # sharding; checked BEFORE the base constructor allocates
+            # the full cache and device-puts state across the mesh
+            raise ValueError(
+                f"shards ({shards}) not divisible by the mesh's data "
+                f"axis ({mesh.shape['data']})"
+            )
+        self.shards = shards
+        self.shard_slots = shard_slots
+        super().__init__(
+            params, config, batch_size=shards * shard_slots,
+            prompt_len=prompt_len, generate_tokens=generate_tokens,
+            **kwargs,
+        )
+        # the device-side scale mask: True = the shard admits (its free
+        # slots count in the summary).  In-flight rows of a deactivated
+        # shard keep decoding — drain, not kill.
+        self._shard_active = jnp.ones((shards,), bool)
+        # host mirror the router consults without a device read
+        self.shard_admitting = [True] * shards
+        # per-shard emitted-token counters (the per-shard tokens/s gauge)
+        self.shard_tokens = [0] * shards
+        # the last consumed [S] free-slot summary (None until a block
+        # settles) — the device-confirmed depth signal behind
+        # shard_stats' device_free column, fetched in the ONE combined
+        # transfer per cycle alongside the block tokens
+        self.last_free_summary: np.ndarray | None = None
+        # gang instrumentation: cycles that dispatched a gang block and
+        # combined settle transfers (the bench gates dispatches/cycle
+        # == 1 and transfers/cycle <= 1 at every shard count)
+        self.gang_cycles = 0
+        self.summary_transfers = 0
+        self._gang_fn = self._make_gang_fn()
+
+    # ------------------------------------------------------------------
+    # Engine identity / adoption
+    # ------------------------------------------------------------------
+
+    def _engine_key(self) -> tuple:
+        return super()._engine_key() + (self.shards, self.shard_slots)
+
+    def adopt_engine(self, source: ContinuousBatcher) -> None:
+        if not isinstance(source, ShardedBatcher):
+            raise ValueError(
+                "a sharded plane adopts from a sharded donor only"
+            )
+        super().adopt_engine(source)  # validates the full engine key
+        self._gang_fn = source._gang_fn
+
+    # ------------------------------------------------------------------
+    # The gang step
+    # ------------------------------------------------------------------
+
+    def _make_gang_fn(self):
+        """The ONE compiled decode program for all shards: the vmapped
+        block engine plus the per-shard free-slot summary, flat-state
+        donated so the buffers roll in place cycle after cycle."""
+        from .decode import gang_block_decode
+
+        step_fn = self._family_step_fn()
+        config = self.config
+        shards = self.shards
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        eos_id = self.eos_id
+        fold = self.temperature > 0.0
+
+        def gang(params, cache, current, done, remaining, keys, active):
+            return gang_block_decode(
+                params, cache, current, done, remaining, keys, active,
+                config, step_fn, shards=shards, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_id=eos_id, fold_keys=fold,
+            )
+
+        if self.mesh is None:
+            return jax.jit(gang, donate_argnums=(1, 2, 3, 4))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        rows = self._rows_shard
+        tokens_shard = NamedSharding(self.mesh, P(None, "data"))
+        return jax.jit(
+            gang,
+            in_shardings=(param_shardings(self.mesh, self.params),
+                          self._cache_shard, rows, rows, rows, rep, rep),
+            out_shardings=(self._cache_shard, rows, rows, rows,
+                           tokens_shard, rows, rep),
+            donate_argnums=(1, 2, 3, 4),
+        )
+
+    # ------------------------------------------------------------------
+    # Scale: device-side mask flips
+    # ------------------------------------------------------------------
+
+    def set_shard_active(self, shard: int, active: bool) -> None:
+        """Flip shard ``shard``'s admission mask — the O(1) scale path.
+
+        Deactivating stops the router and the device summary from
+        offering the shard's slots; rows already in flight keep decoding
+        to completion (drain).  Reactivating is the same flip back —
+        nothing is spawned, moved, or recompiled."""
+        if not 0 <= shard < self.shards:
+            raise ValueError(
+                f"shard {shard} out of range [0, {self.shards})"
+            )
+        self.shard_admitting[shard] = bool(active)
+        self._shard_active = self._shard_active.at[shard].set(bool(active))
+
+    def shard_rows(self, shard: int) -> range:
+        return range(shard * self.shard_slots, (shard + 1) * self.shard_slots)
+
+    def shard_busy(self, shard: int) -> int:
+        """Slots of ``shard`` holding an in-flight request (host view)."""
+        return sum(self.slots[row].busy for row in self.shard_rows(shard))
+
+    def shard_free(self, shard: int) -> int:
+        return self.shard_slots - self.shard_busy(shard)
+
+    # ------------------------------------------------------------------
+    # The admission plane: freest-first routing
+    # ------------------------------------------------------------------
+
+    @property
+    def free_slots(self) -> list[int]:
+        """Admission-eligible rows, ROUTED: requests are assigned one at
+        a time to the currently-freest admitting shard (deterministic
+        tie-break: lowest shard index), so a refill larger than any one
+        shard's free slots splits across shards and equal-depth shards
+        fill in index order.  ``submit_many`` consuming this order IS
+        the cross-shard router — the whole refill still prefills as one
+        global-row ``[M, P]`` insert."""
+        per_shard = [
+            [row for row in self.shard_rows(s) if not self.slots[row].busy]
+            if self.shard_admitting[s] else []
+            for s in range(self.shards)
+        ]
+        order: list[int] = []
+        heads = [0] * self.shards
+        while True:
+            best, best_avail = -1, 0
+            for s in range(self.shards):
+                avail = len(per_shard[s]) - heads[s]
+                if avail > best_avail:  # strict: ties keep the lowest s
+                    best, best_avail = s, avail
+            if best < 0:
+                break
+            order.append(per_shard[best][heads[best]])
+            heads[best] += 1
+        return order
+
+    # ------------------------------------------------------------------
+    # The engine cycle
+    # ------------------------------------------------------------------
+
+    def step(self) -> list[tuple[Any, np.ndarray]]:
+        """Advance ALL shards' active slots with one gang-stepped block
+        dispatch; settle the previous block + any deferred first tokens
+        + the ``[S]`` free summary in one combined transfer.  Same
+        dispatch-ahead overlap, results, and finished-request contract
+        as the single-plane block engine."""
+        if self.active == 0:
+            return []
+        return self._step_gang()
+
+    def _record_firsts(self, pending_host) -> None:
+        # attribute prefill first tokens to their shard before the
+        # shared TTFT/emit bookkeeping runs
+        for _, rows in pending_host:
+            for row in rows:
+                self.shard_tokens[row // self.shard_slots] += 1
+        super()._record_firsts(pending_host)
+
+    def _step_gang(self) -> list[tuple[Any, np.ndarray]]:
+        new_block = None
+        busy = sum(s.busy for s in self.slots)
+        if busy:
+            (self.cache, self._current, self._done, self._remaining,
+             tokens, counts, free) = self._gang_fn(
+                self.params, self.cache, self._current, self._done,
+                self._remaining, self._block_keys(), self._shard_active,
+            )
+            self.decode_dispatches += 1
+            self.gang_cycles += 1
+            new_block = (tokens, counts, free, busy)
+        pending_firsts, self._pending_firsts = self._pending_firsts, []
+        pending, self._pending_block = self._pending_block, new_block
+        # ONE combined host transfer per cycle: deferred first tokens,
+        # the settled block's tokens/counts, and the [S] summary all
+        # land in a single device_get
+        firsts_dev = [arr for arr, _ in pending_firsts]
+        block_dev = pending[:3] if pending is not None else ()
+        if firsts_dev or block_dev:
+            firsts_host, block_host = jax.device_get(
+                (firsts_dev, block_dev)
+            )
+            self.host_transfers += 1
+            if pending_firsts:
+                self._record_firsts([
+                    (vals, rows)
+                    for vals, (_, rows) in zip(firsts_host, pending_firsts)
+                ])
+            if pending is not None:
+                toks_host, counts_host, free_host = block_host
+                self.last_free_summary = free_host
+                self.summary_transfers += 1
+                dispatched_busy = pending[3]
+                self.block_capacity += self.decode_block * dispatched_busy
+                self.block_tokens += int(counts_host.sum())
+                for row, slot in enumerate(self.slots):
+                    if not slot.busy:
+                        continue
+                    shard = row // self.shard_slots
+                    for token in toks_host[: int(counts_host[row]), row]:
+                        if slot.done or len(slot.produced) >= slot.budget:
+                            break
+                        self._emit(slot, int(token))
+                        self.shard_tokens[shard] += 1
+        return self._finish_ready()
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def shard_stats(self, served_since: float | None = None) -> list[dict]:
+        """Per-shard gauge rows: admitting, busy slots, tokens emitted,
+        tokens/s over the serving lifetime (0 before serving starts),
+        and ``device_free`` — the device-confirmed free-slot count from
+        the last settled ``[S]`` summary (None until a block settles;
+        one cycle behind the authoritative host view by construction,
+        since the summary rides the dispatch-ahead settle)."""
+        now = time.perf_counter()
+        elapsed = (
+            now - served_since
+            if served_since is not None and now > served_since else 0.0
+        )
+        summary = self.last_free_summary
+        return [
+            {
+                "shard": s,
+                "active": self.shard_admitting[s],
+                "active_slots": self.shard_busy(s),
+                "device_free": (
+                    int(summary[s]) if summary is not None else None
+                ),
+                "tokens": self.shard_tokens[s],
+                "tokens_per_second": (
+                    self.shard_tokens[s] / elapsed if elapsed > 0 else 0.0
+                ),
+            }
+            for s in range(self.shards)
+        ]

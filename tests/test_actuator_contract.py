@@ -1,16 +1,18 @@
 """Contract test for the ``Scaler`` actuator seam.
 
-Two production actuators implement the seam: :class:`PodAutoScaler` (a
-Deployment's replica integer over an orchestrator API) and the fleet's
-:class:`WorkerPool` (real in-process serving replicas).  The ControlLoop
-must not be able to tell them apart: min/max clamping, boundary-no-op
-success, cooldown interaction, and failure behavior (ScaleError ends the
-tick without advancing the cooldown) are asserted IDENTICAL through the
-real loop, tick for tick.
+Three production actuators implement the seam: :class:`PodAutoScaler`
+(a Deployment's replica integer over an orchestrator API), the fleet's
+:class:`WorkerPool` (real in-process serving replicas), and the
+:class:`ShardedWorkerPool` (device-side shard-active mask flips over one
+gang-stepped serving plane).  The ControlLoop must not be able to tell
+them apart: min/max clamping, boundary-no-op success, cooldown
+interaction, and failure behavior (ScaleError ends the tick without
+advancing the cooldown) are asserted IDENTICAL through the real loop,
+tick for tick.
 
-JAX-free: the pool under contract runs featherweight stub replicas — the
-pool's scaling semantics live entirely in the pool, not in the serving
-engine.
+JAX-free: the pools under contract run featherweight stub replicas /
+stub sharded batchers — the scaling semantics live entirely in the
+pools, not in the serving engine.
 """
 
 from __future__ import annotations
@@ -21,7 +23,7 @@ from kube_sqs_autoscaler_tpu.core.clock import FakeClock
 from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
 from kube_sqs_autoscaler_tpu.core.policy import Gate, PolicyConfig
 from kube_sqs_autoscaler_tpu.core.types import ScaleError, Scaler
-from kube_sqs_autoscaler_tpu.fleet import WorkerPool
+from kube_sqs_autoscaler_tpu.fleet import ShardedWorkerPool, WorkerPool
 from kube_sqs_autoscaler_tpu.scale import FakeDeploymentAPI, PodAutoScaler
 
 
@@ -90,8 +92,46 @@ def make_pool(initial, min_, max_, up=1, down=1):
     return pool, (lambda: pool.replicas), fail_next_up
 
 
-MAKERS = [make_pod, make_pool]
-IDS = ["pod", "pool"]
+class _StubShardedBatcher:
+    """The sharded-plane surface ShardedWorkerPool needs, with no JAX."""
+
+    def __init__(self, shards):
+        self.shards = shards
+        self.shard_admitting = [True] * shards
+        self.active = 0
+        self.free_slots = []
+        self.tokens_emitted = 0
+
+    def set_shard_active(self, shard, active):
+        self.shard_admitting[shard] = bool(active)
+
+    def shard_busy(self, shard):
+        return 0
+
+    def shard_stats(self, served_since=None):
+        return []
+
+
+class _StubShardedWorker(_StubWorker):
+    def __init__(self, shards):
+        super().__init__()
+        self.batcher = _StubShardedBatcher(shards)
+
+
+def make_shards(initial, min_, max_, up=1, down=1):
+    pool = ShardedWorkerPool(
+        lambda p: _StubShardedWorker(max_), min=min_, max=max_,
+        scale_up_pods=up, scale_down_pods=down, initial=initial,
+    )
+
+    def fail_next_up(err):
+        pool.fail_next_up = err
+
+    return pool, (lambda: pool.replicas), fail_next_up
+
+
+MAKERS = [make_pod, make_pool, make_shards]
+IDS = ["pod", "pool", "shards"]
 
 
 @pytest.mark.parametrize("make", MAKERS, ids=IDS)
@@ -221,7 +261,7 @@ SCRIPT = [150, 150, 150, 150, 150, 150, 5, 5, 5, 5, 5, 5, 5, 150, 150]
 
 def test_identical_through_control_loop():
     fingerprints = [_drive(make, SCRIPT) for make in MAKERS]
-    assert fingerprints[0] == fingerprints[1]
+    assert all(fp == fingerprints[0] for fp in fingerprints[1:])
     # sanity: the script really exercised the interesting gates
     ups = [row[0] for row in fingerprints[0]]
     assert Gate.FIRE in ups and Gate.COOLING in ups
@@ -230,11 +270,11 @@ def test_identical_through_control_loop():
 def test_failure_behavior_identical_through_control_loop():
     # tick 2 (the first FIRE for this cooldown schedule) fails; the
     # cooldown must NOT advance, so the very next tick fires again —
-    # identically for both actuators
+    # identically for every actuator
     fingerprints = [
         _drive(make, SCRIPT, fail_up_at=2) for make in MAKERS
     ]
-    assert fingerprints[0] == fingerprints[1]
+    assert all(fp == fingerprints[0] for fp in fingerprints[1:])
     failed = [row for row in fingerprints[0] if row[2]]
     assert failed, "the injected actuation failure never surfaced"
 
@@ -294,3 +334,75 @@ def test_pool_drain_excluded_from_replica_count():
     assert draining[0].worker.admitting is False
     # newest serving replica drains first
     assert draining[0].index == 2
+
+
+def test_pool_cycle_cost_flat_under_retired_history():
+    # the fleet cycle computes its member-state partition ONCE: cycle
+    # cost (full scans of `members`, itself bounded by retired_keep)
+    # must not grow however much retirement history churns through
+    class CountingList(list):
+        def __init__(self, items=()):
+            super().__init__(items)
+            self.iterations = 0
+
+        def __iter__(self):
+            self.iterations += 1
+            return super().__iter__()
+
+    pool = WorkerPool(lambda p: _StubWorker(), min=1, max=500, initial=1)
+    pool.retired_keep = 4
+
+    def churn(n):
+        for _ in range(n):
+            pool.scale_up()
+            victim = max(
+                (r for r in pool.members if r.state == "serving"),
+                key=lambda r: r.index,
+            )
+            victim.worker.processed = 2
+            pool.kill_worker(victim.index)
+            pool.run_cycle()
+
+    churn(10)
+    counting = CountingList(pool.members)
+    pool.members = counting
+    base = counting.iterations
+    pool.run_cycle()
+    per_cycle_early = counting.iterations - base
+    assert per_cycle_early > 0
+    churn(100)
+    assert pool.members is counting  # mutated in place, never rebound
+    base = counting.iterations
+    pool.run_cycle()
+    assert counting.iterations - base == per_cycle_early
+    assert len(pool.members) <= 1 + pool.retired_keep
+    assert pool.processed == 110 * 2  # pruned history's counts folded in
+
+
+def test_sharded_pool_scale_up_resurrects_draining_shards_first():
+    pool, replicas, _ = make_shards(4, 1, 5)
+    pool.scale_down()
+    pool.scale_down()
+    assert replicas() == 2
+    from kube_sqs_autoscaler_tpu.fleet import DRAINING, SERVING
+
+    assert pool.shard_states[2] == DRAINING
+    assert pool.shard_states[3] == DRAINING
+    # admission really stopped on the drained shards (the mask
+    # flipped); shard 4 was never activated (initial=4 of max=5)
+    assert pool.worker.batcher.shard_admitting == [
+        True, True, False, False, False,
+    ]
+    pool.scale_up()
+    # the newest drain resurrects first — same O(1) flip back
+    assert pool.shard_states[3] == SERVING
+    assert pool.shard_states[2] == DRAINING
+    assert pool.worker.batcher.shard_admitting[3] is True
+    assert replicas() == 3
+
+
+def test_sharded_pool_max_clamped_to_allocated_shards():
+    with pytest.raises(ValueError, match="allocated shards"):
+        ShardedWorkerPool(
+            lambda p: _StubShardedWorker(2), min=1, max=5,
+        )

@@ -1,0 +1,498 @@
+"""Sharded serving plane: gang-step parity, cross-shard admission,
+host-sync accounting, and the mask-flip scale path.
+
+Tier-1 (CPU JAX, tiny model).  The fast scale-suite smoke pins the
+whole bench contract — parity vs independent engines plus the
+one-dispatch-per-cycle gate — at shards (1, 2); the full decode-bound
+curve (the committed ``BENCH_r12.json``, monotone gate) runs in the
+slow tier.  The host-transfer/dispatch counter tests also retro-pin
+PR 5's zero-per-request-sync claim on the single-plane engine.
+"""
+
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.workloads.continuous import (  # noqa: E402
+    ContinuousBatcher,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.shard_plane import (  # noqa: E402
+    ShardedBatcher,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    return params, config
+
+
+def make_plane(tiny, *, shards=2, shard_slots=2, generate_tokens=6,
+               decode_block=2, **kwargs):
+    params, config = tiny
+    return ShardedBatcher(
+        params, config, shards=shards, shard_slots=shard_slots,
+        prompt_len=8, generate_tokens=generate_tokens,
+        decode_block=decode_block, **kwargs,
+    )
+
+
+def prompts_for(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, 64, rng.integers(2, 9)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def drain(batcher, max_steps=200):
+    out = {}
+    for _ in range(max_steps):
+        for payload, tokens in batcher.step():
+            out[payload] = tokens
+        if batcher.active == 0:
+            # drained (a dispatch-ahead block may stay pending forever:
+            # step() early-returns on idle and only a busy cycle swaps
+            # it out — its frozen rows emit nothing either way)
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The scale-suite smoke: bench gates (parity + dispatch counters) tier-1
+# ---------------------------------------------------------------------------
+
+
+def test_scale_suite_smoke_parity_and_dispatch(tmp_path):
+    from bench import run_scale_suite
+
+    out = tmp_path / "bench_scale.json"
+    headline = run_scale_suite(
+        str(out), messages=6, prompt_len=8, generate_tokens=8,
+        batch_size=2, shard_counts=(1, 2), decode_blocks=(2,),
+        require_monotone=False,
+    )
+    artifact = json.loads(out.read_text())
+    assert len(artifact["curve"]) == 2
+    for point in artifact["curve"]:
+        assert point["parity_divergences"] == 0
+        # THE tentpole invariant: one gang decode dispatch per busy
+        # cycle, whatever the shard count
+        assert point["sharded"]["dispatches_per_cycle"] == 1.0
+        assert (point["sharded"]["summary_transfers"]
+                <= point["sharded"]["busy_cycles"])
+        # every request generated its full budget on both planes, in
+        # every one of the best-of-N timed repeats
+        repeats = len(point["sharded"]["rates_per_repeat"])
+        assert repeats >= 1
+        assert point["sharded"]["tokens"] == repeats * 6 * 8
+        assert point["independent"]["tokens"] == repeats * 6 * 8
+    two = artifact["curve"][1]
+    assert two["shards"] == 2
+    # the independent baseline pays MORE dispatches than the gang plane
+    assert (two["independent"]["decode_dispatches"]
+            > two["sharded"]["decode_dispatches"])
+    assert "0 parity divergences" in headline["unit"]
+
+
+@pytest.mark.slow
+def test_scale_suite_full_gate(tmp_path):
+    # the committed-artifact configuration: decode-bound curve, monotone
+    # + parity + dispatch gates (SystemExit(2) otherwise)
+    from bench import run_scale_suite
+
+    out = tmp_path / "bench_r12.json"
+    run_scale_suite(str(out))
+    artifact = json.loads(out.read_text())
+    rates = artifact["monotone"]["tokens_per_second_by_shards"]
+    assert rates["4"] > rates["2"] > rates["1"]
+
+
+# ---------------------------------------------------------------------------
+# Host-sync counters (also retro-pins PR 5's zero-per-request-sync claim)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_cycle_costs_one_dispatch_one_transfer(tiny):
+    plane = make_plane(tiny, shards=3, shard_slots=2, generate_tokens=6,
+                       decode_block=2)
+    reqs = prompts_for(6)
+    # admission: ONE insert dispatch and ZERO host transfers for the
+    # whole 6-request refill, however many shards it splits across
+    plane.submit_many([(ids, i) for i, ids in enumerate(reqs)])
+    assert plane.insert_dispatches == 1
+    assert plane.host_transfers == 0
+    # every stepping cycle: exactly one gang decode dispatch and at most
+    # one combined settle transfer — independent of the shard count
+    for _ in range(10):
+        before = (plane.decode_dispatches, plane.host_transfers,
+                  plane.gang_cycles)
+        plane.step()
+        after = (plane.decode_dispatches, plane.host_transfers,
+                 plane.gang_cycles)
+        assert after[0] - before[0] <= 1
+        assert after[1] - before[1] <= 1
+        assert after[0] - before[0] == after[2] - before[2]
+        if plane.active == 0:
+            break
+    assert plane.decode_dispatches == plane.gang_cycles
+    assert plane.summary_transfers >= 1
+    assert plane.last_free_summary is not None
+    assert list(plane.last_free_summary) == [2, 2, 2]  # all drained free
+
+
+def test_single_plane_admission_is_one_dispatch_zero_transfers(tiny):
+    # PR 5's batched-admission claim, now pinned by counters: submit_many
+    # of M requests = ONE compiled insert, no blocking sync; the first
+    # tokens settle later in one deferred batched transfer
+    params, config = tiny
+    batcher = ContinuousBatcher(params, config, batch_size=4,
+                                prompt_len=8, generate_tokens=4,
+                                decode_block=2)
+    batcher.submit_many([(ids, i) for i, ids in enumerate(prompts_for(4))])
+    assert batcher.insert_dispatches == 1
+    assert batcher.host_transfers == 0
+    batcher.step()
+    # the settle consumed the deferred firsts (1) and no block had
+    # settled yet (dispatch-ahead): bounded, never per-request
+    assert batcher.host_transfers == 1
+    assert batcher.decode_dispatches == 1
+    drain(batcher)
+    # block cycles: one dispatch + one combined transfer each — total
+    # transfers stay O(cycles), not O(requests x tokens)
+    assert batcher.host_transfers <= batcher.decode_dispatches + 2
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard admission edges
+# ---------------------------------------------------------------------------
+
+
+def test_refill_larger_than_any_shard_splits_across_shards(tiny):
+    plane = make_plane(tiny, shards=2, shard_slots=2)
+    # 3 requests, no shard has 3 free slots: must split 2 + 1
+    rows = plane.submit_many([(ids, i) for i, ids in
+                              enumerate(prompts_for(3))])
+    shards_hit = {row // plane.shard_slots for row in rows}
+    assert shards_hit == {0, 1}
+    assert plane.shard_busy(0) + plane.shard_busy(1) == 3
+    out = drain(plane)
+    assert sorted(out) == [0, 1, 2]
+
+
+def test_all_shards_full_rejects(tiny):
+    plane = make_plane(tiny, shards=2, shard_slots=1)
+    plane.submit_many([(ids, i) for i, ids in enumerate(prompts_for(2))])
+    assert plane.free_slots == []
+    with pytest.raises(RuntimeError, match="no free slot"):
+        plane.submit(prompts_for(1)[0], payload=99)
+
+
+def test_freest_first_tie_break_is_deterministic(tiny):
+    plane = make_plane(tiny, shards=3, shard_slots=2)
+    # equal depths everywhere: the router must fill in shard-index order,
+    # one slot per shard per round (freest-first with lowest-index ties)
+    order = [row // plane.shard_slots for row in plane.free_slots]
+    assert order == [0, 1, 2, 0, 1, 2]
+    # unequal depths: the freest shard leads until depths equalize
+    plane.submit(prompts_for(1)[0], payload=0)  # lands on shard 0
+    order = [row // plane.shard_slots for row in plane.free_slots]
+    assert order == [1, 2, 0, 1, 2]
+
+
+def test_deactivated_shard_gets_no_admits_but_finishes_inflight(tiny):
+    plane = make_plane(tiny, shards=2, shard_slots=2, generate_tokens=4)
+    reqs = prompts_for(4)
+    plane.submit_many([(reqs[0], 0)])  # shard 0 (freest tie-break)
+    plane.set_shard_active(1, False)
+    # the router now offers only shard 0's remaining slot
+    assert [r // plane.shard_slots for r in plane.free_slots] == [0]
+    plane.submit_many([(reqs[1], 1)])
+    with pytest.raises(RuntimeError, match="no free slot"):
+        plane.submit_many([(reqs[2], 2), (reqs[3], 3)])
+    out = drain(plane)  # in-flight rows decode to completion regardless
+    assert sorted(out) == [0, 1]
+    # reactivation is the same O(1) flip back
+    plane.set_shard_active(1, True)
+    assert {r // plane.shard_slots for r in plane.free_slots} == {0, 1}
+    with pytest.raises(ValueError, match="out of range"):
+        plane.set_shard_active(7, True)
+
+
+# ---------------------------------------------------------------------------
+# Parity beyond the bench smoke: slot reuse + eos across shard boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_gang_parity_with_slot_reuse_and_eos(tiny):
+    params, config = tiny
+    reqs = prompts_for(10, seed=9)
+    eos = 7  # small vocab: greedy decode hits it naturally for some rows
+
+    def outputs(batcher_factory):
+        batcher = batcher_factory()
+        out, queue = {}, list(enumerate(reqs))
+        for _ in range(300):
+            while queue and batcher.free_slots:
+                idx, ids = queue.pop(0)
+                batcher.submit(ids, payload=idx)
+            for idx, tokens in batcher.step():
+                out[idx] = tokens.tolist()
+            if not queue and batcher.active == 0:
+                break
+        return out
+
+    sharded = outputs(lambda: ShardedBatcher(
+        params, config, shards=2, shard_slots=2, prompt_len=8,
+        generate_tokens=6, decode_block=3, eos_id=eos,
+    ))
+    single = outputs(lambda: ContinuousBatcher(
+        params, config, batch_size=2, prompt_len=8, generate_tokens=6,
+        decode_block=1, eos_id=eos,
+    ))
+    assert sharded == single
+
+
+@pytest.mark.slow
+def test_gang_parity_under_mesh(tiny):
+    from jax.sharding import Mesh
+
+    params, config = tiny
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("data", "model"))
+    reqs = prompts_for(8, seed=11)
+
+    def outputs(batcher):
+        out, queue = {}, list(enumerate(reqs))
+        for _ in range(300):
+            while queue and batcher.free_slots:
+                idx, ids = queue.pop(0)
+                batcher.submit(ids, payload=idx)
+            for idx, tokens in batcher.step():
+                out[idx] = tokens.tolist()
+            if not queue and batcher.active == 0:
+                break
+        return out
+
+    sharded = outputs(ShardedBatcher(
+        params, config, shards=2, shard_slots=2, prompt_len=8,
+        generate_tokens=6, decode_block=2, mesh=mesh,
+    ))
+    single = outputs(ContinuousBatcher(
+        params, config, batch_size=2, prompt_len=8, generate_tokens=6,
+        decode_block=2,
+    ))
+    assert sharded == single
+
+
+# ---------------------------------------------------------------------------
+# Construction / validation
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_rejects_non_plain_paths(tiny):
+    params, config = tiny
+    with pytest.raises(ValueError, match="plain continuous decode"):
+        ShardedBatcher(params, config, shards=2, shard_slots=2,
+                       prompt_len=8, generate_tokens=4, beams=2)
+    with pytest.raises(ValueError, match="plain continuous decode"):
+        ShardedBatcher(params, config, shards=2, shard_slots=2,
+                       prompt_len=8, generate_tokens=4, draft_layers=1)
+    with pytest.raises(ValueError, match="shards"):
+        ShardedBatcher(params, config, shards=0, shard_slots=2,
+                       prompt_len=8, generate_tokens=4)
+
+
+def test_service_config_and_cli_reject_bad_shards():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    with pytest.raises(ValueError, match="shards"):
+        ServiceConfig(queue_url="fake://x", shards=0)
+    with pytest.raises(SystemExit, match="--continuous"):
+        worker_main(["--demo", "1", "--generate-tokens", "2",
+                     "--shards", "2"])
+    with pytest.raises(SystemExit, match="plain continuous decode"):
+        worker_main(["--demo", "1", "--continuous", "--generate-tokens",
+                     "2", "--shards", "2", "--beams", "2"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        worker_main(["--demo", "1", "--continuous", "--generate-tokens",
+                     "2", "--shards", "0"])
+
+
+def test_adopt_engine_requires_sharded_donor_with_same_layout(tiny):
+    params, config = tiny
+    a = make_plane(tiny, shards=2, shard_slots=2)
+    b = make_plane(tiny, shards=2, shard_slots=2)
+    b.adopt_engine(a)
+    assert b._gang_fn is a._gang_fn
+    assert b._insert_many is a._insert_many
+    plain = ContinuousBatcher(params, config, batch_size=4, prompt_len=8,
+                              generate_tokens=6, decode_block=2)
+    with pytest.raises(ValueError, match="sharded donor"):
+        b.adopt_engine(plain)
+    other = make_plane(tiny, shards=4, shard_slots=1)
+    with pytest.raises(ValueError, match="engine mismatch"):
+        other.adopt_engine(a)
+
+
+# ---------------------------------------------------------------------------
+# ShardedWorkerPool over the real plane (scale path + exactly-once)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_pool_serves_scales_and_drains(tiny):
+    from kube_sqs_autoscaler_tpu.fleet import (
+        DRAINING,
+        INACTIVE,
+        SERVING,
+        ShardedWorkerPool,
+    )
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+
+    params, config = tiny
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    service = ServiceConfig(
+        queue_url="fake://scale", batch_size=2, seq_len=8,
+        generate_tokens=4, decode_block=2, shards=3,
+        result_queue_url="fake://scale-results",
+    )
+    pool = ShardedWorkerPool.serving(
+        queue, params, config, service, result_queue=results,
+        min=1, max=3, initial=1,
+    )
+    metrics = WorkloadMetrics()
+    pool.attach_metrics(metrics)
+    reqs = prompts_for(8, seed=4)
+    sent = [queue.send_message("fake://scale", json.dumps(ids.tolist()))
+            for ids in reqs]
+    pool.scale_up()
+    pool.scale_up()
+    assert pool.replicas == 3
+    cycles = 0
+    while pool.processed < len(reqs) and cycles < 300:
+        pool.run_cycle()
+        cycles += 1
+    assert pool.processed == len(reqs)
+    # scale down: the shard drains (replicas drop instantly, admission
+    # stops) and retires to inactive on the next cycle once empty
+    pool.scale_down()
+    assert pool.replicas == 2
+    assert pool.shard_states == [SERVING, SERVING, DRAINING]
+    pool.run_cycle()
+    assert pool.shard_states == [SERVING, SERVING, INACTIVE]
+    assert "shard-deactivate" in [e.name for e in pool.events]
+    replies, duplicates = collect_replies(results, "fake://scale-results")
+    assert len(replies) == len(sent)
+    assert set(replies) == set(sent)  # zero lost
+    assert duplicates == 0  # zero duplicated
+    # per-shard gauges render as labeled families
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    for name in ("shard_active", "shard_active_slots",
+                 "shard_tokens_per_second"):
+        assert f"# TYPE {prefix}_{name} gauge" in text, name
+    assert f'{prefix}_shard_active{{shard="2"}} 0.0' in text
+    assert f'{prefix}_shard_active{{shard="0"}} 1.0' in text
+    # shard activate/drain instants land on the Chrome-trace timeline
+    events = pool.trace_events(time_origin=0.0)
+    names = [e["name"] for e in events]
+    assert "shard-activate" in names and "shard-drain-start" in names
+    assert "shard-deactivate" in names
+    assert all(e["ph"] == "i" for e in events)
+    pool.stop_all()
+    assert all(state == INACTIVE for state in pool.shard_states)
+    assert DRAINING not in pool.shard_states
+
+
+def test_sharded_pool_works_pinned_to_one_shard(tiny):
+    # a one-shard plane is legal (min=max=1): the worker must build the
+    # gang engine (sharded=True forces it past the shards>1 auto-pick),
+    # not the plain batcher with no shard surface to actuate
+    from kube_sqs_autoscaler_tpu.fleet import ShardedWorkerPool
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    params, config = tiny
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    service = ServiceConfig(
+        queue_url="fake://one", batch_size=2, seq_len=8,
+        generate_tokens=4, decode_block=2, shards=1,
+        result_queue_url="fake://one-results",
+    )
+    pool = ShardedWorkerPool.serving(
+        queue, params, config, service, result_queue=results, min=1, max=1,
+    )
+    assert isinstance(pool.worker.batcher, ShardedBatcher)
+    assert pool.worker.batcher.shards == 1
+    assert pool.replicas == 1
+    pool.scale_up()  # boundary no-op is success
+    assert pool.replicas == 1
+    queue.send_message("fake://one", json.dumps(prompts_for(1)[0].tolist()))
+    cycles = 0
+    while pool.processed < 1 and cycles < 100:
+        pool.run_cycle()
+        cycles += 1
+    assert pool.processed == 1
+    # the settled [S] summary surfaces as the device-confirmed depth
+    stats = pool.worker.batcher.shard_stats()
+    assert stats[0]["device_free"] == 2
+
+
+def test_sharded_pool_drain_finishes_inflight_and_redelivery_dedups(tiny):
+    from kube_sqs_autoscaler_tpu.fleet import DRAINING, ShardedWorkerPool
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+
+    params, config = tiny
+    # tiny visibility timeout: the queue redelivers every in-flight
+    # message that is not settled fast — the registry must keep replies
+    # exactly-once anyway
+    queue = FakeMessageQueue(visibility_timeout=0.0)
+    results = FakeMessageQueue()
+    service = ServiceConfig(
+        queue_url="fake://drain", batch_size=1, seq_len=8,
+        generate_tokens=4, decode_block=2, shards=2,
+        result_queue_url="fake://drain-results",
+    )
+    pool = ShardedWorkerPool.serving(
+        queue, params, config, service, result_queue=results,
+        min=1, max=2, initial=2,
+    )
+    reqs = prompts_for(4, seed=6)
+    sent = [queue.send_message("fake://drain", json.dumps(ids.tolist()))
+            for ids in reqs]
+    pool.run_cycle()  # admit across both shards
+    busy_before = pool.worker.batcher.shard_busy(1)
+    assert busy_before > 0
+    pool.scale_down()  # shard 1 drains with work in flight
+    assert pool.shard_states[1] == DRAINING
+    cycles = 0
+    while pool.processed < len(reqs) and cycles < 300:
+        pool.run_cycle()
+        cycles += 1
+    replies, duplicates = collect_replies(results, "fake://drain-results")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    assert pool.worker.batcher.shard_busy(1) == 0
