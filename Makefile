@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale replay-demo chaos-demo fleet-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve replay-demo chaos-demo fleet-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -81,6 +81,17 @@ bench-serve:
 # the decode-bound regime; writes BENCH_r12.json
 bench-scale:
 	JAX_PLATFORMS=cpu python bench.py --suite scale
+
+# Shard-level serving chaos battery (CPU JAX, ~a minute): scripted
+# poison / wedge / mask-corruption episodes against the REAL sharded
+# plane on a virtual clock — exits non-zero unless every episode ends
+# with zero lost and zero duplicated replies, >=1 shard quarantined and
+# later re-admitted via probe, replies byte-identical to the no-fault
+# control, sentinels riding the one combined settle transfer, and
+# healthy-shard TTFT / post-readmit throughput within the gate bounds;
+# writes BENCH_r13.json
+bench-chaos-serve:
+	JAX_PLATFORMS=cpu python bench.py --suite chaos-serve
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

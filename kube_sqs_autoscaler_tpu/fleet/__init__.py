@@ -19,14 +19,24 @@ from .pool import (
     Replica,
     WorkerPool,
 )
-from .sharded import INACTIVE, SHARD_STATE_CODES, ShardedWorkerPool
+from .sharded import (
+    INACTIVE,
+    PROBING,
+    QUARANTINED,
+    SHARD_HEALTH_CODES,
+    SHARD_STATE_CODES,
+    ShardedWorkerPool,
+)
 
 __all__ = [
     "DEAD",
     "DRAINING",
     "INACTIVE",
+    "PROBING",
+    "QUARANTINED",
     "REPLICA_STATE_CODES",
     "SERVING",
+    "SHARD_HEALTH_CODES",
     "SHARD_STATE_CODES",
     "STOPPED",
     "FleetDriver",

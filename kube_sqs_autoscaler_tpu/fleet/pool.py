@@ -188,6 +188,9 @@ class Replica:
         # progress watchdog (hang detection)
         self.last_progress = -1
         self.stalled_cycles = 0
+        # idle-wedge watchdog: refill-pass liveness while HOLDING no work
+        self.last_refills: int | None = None
+        self.idle_stalled_cycles = 0
 
     def progress(self) -> int:
         """Monotone progress signal: tokens emitted + requests settled."""
@@ -418,15 +421,23 @@ class WorkerPool(FleetPoolBase):
     def _supervise(self) -> None:
         """Declare killed/hung replicas dead and queue their failover.
 
-        The watchdog only counts stall cycles while the replica HOLDS
-        work (``active > 0``): an idle replica legitimately makes no
-        progress, so an idle wedge is indistinguishable from idleness —
-        the same blind spot a pod without a liveness probe has.  It is
-        self-limiting: the moment any work lands on the wedge (queue
-        admission can't — a wedged ``run_once`` never polls — but the
-        router's orphan dispatch marks slots busy synchronously), the
-        stall counter starts and the work fails over within
-        ``hang_grace_cycles``.
+        Two watchdogs cover the two ways a wedge can look:
+
+        - **busy wedge** — the replica HOLDS work (``active > 0``) but
+          its token/settle progress froze: dead after
+          ``hang_grace_cycles`` stalled cycles (one stall cycle is
+          legitimate — the block engine's dispatch-ahead lag);
+        - **idle wedge** — the replica holds nothing, so token progress
+          proves nothing.  A *healthy* idle serving replica still runs
+          its refill pass every cycle (poll, poll-backoff tick, or
+          full-slots early-out — ``ContinuousWorker.refill_cycles``
+          counts all three), while a wedged ``run_once`` never reaches
+          it.  A serving, admitting replica whose refill counter
+          freezes while idle is declared dead after the same grace.
+          This closes the PR 6 blind spot where an idle wedge was only
+          bounded by the router's next orphan dispatch.  Draining
+          replicas are exempt (they stop refilling by design — an idle
+          one retires via the drain path the same cycle anyway).
         """
         for replica in self.members:
             if replica.state not in (SERVING, DRAINING):
@@ -444,6 +455,23 @@ class WorkerPool(FleetPoolBase):
             else:
                 replica.stalled_cycles = 0
             replica.last_progress = progress
+            refills = getattr(worker, "refill_cycles", None)
+            if (
+                refills is not None
+                and replica.state == SERVING
+                and getattr(worker, "admitting", True)
+                and worker.batcher.active == 0
+            ):
+                if refills == replica.last_refills:
+                    replica.idle_stalled_cycles += 1
+                    if replica.idle_stalled_cycles >= self.hang_grace_cycles:
+                        self._declare_dead(replica, cause="hung-idle")
+                        continue
+                else:
+                    replica.idle_stalled_cycles = 0
+            else:
+                replica.idle_stalled_cycles = 0
+            replica.last_refills = refills
 
     def _declare_dead(self, replica: Replica, cause: str) -> None:
         replica.state = DEAD

@@ -52,7 +52,21 @@ builtins_max = max
 # The third shard state: mask off, nothing in flight.  (A shard is never
 # DEAD/STOPPED — it has no process to lose.)
 INACTIVE = "inactive"
-SHARD_STATE_CODES = {SERVING: 0, DRAINING: 1, INACTIVE: 2}
+# Fault states (the shard-level failure domain): QUARANTINED = a health
+# sentinel indicted the shard — masked out of admission, its live rows
+# evacuated, excluded from scale_up resurrection; PROBING = the breaker's
+# half-open twin — one request is let through, and the same sentinels
+# that indicted the shard decide re-quarantine vs. re-admission.
+QUARANTINED = "quarantined"
+PROBING = "probing"
+SHARD_STATE_CODES = {
+    SERVING: 0, DRAINING: 1, INACTIVE: 2, QUARANTINED: 3, PROBING: 4,
+}
+
+# shard_health gauge codes (0 = healthy keeps dashboards' zero-is-good)
+SHARD_HEALTH_CODES = {
+    SERVING: 0, DRAINING: 0, INACTIVE: 0, PROBING: 1, QUARANTINED: 2,
+}
 
 
 class ShardedWorkerPool(FleetPoolBase):
@@ -79,12 +93,28 @@ class ShardedWorkerPool(FleetPoolBase):
         initial: int | None = None,
         clock: Clock | None = None,
         replied_capacity: int = 65536,
+        hang_grace_cycles: int = 3,
+        probe_after_cycles: int = 8,
     ) -> None:
         if scale_up_pods < 1 or scale_down_pods < 1:
             raise ValueError("scale step sizes must be >= 1")
+        if hang_grace_cycles < 2:
+            # one no-progress settle is legitimate (the gang engine's
+            # dispatch-ahead settles block N one cycle after dispatch,
+            # and a just-admitted budget-1 row contributes no block
+            # tokens at all) — same floor as the replica watchdog
+            raise ValueError("hang_grace_cycles must be >= 2")
+        if probe_after_cycles < 1:
+            raise ValueError("probe_after_cycles must be >= 1")
         super().__init__(clock=clock, replied_capacity=replied_capacity)
         self.worker = worker_factory(self)
         self.shards = self.worker.batcher.shards
+        # this pool IS the recovery authority: settled blocks from a
+        # NaN-flagged shard are discarded (never reach a slot) because
+        # quarantine + evacuation re-decode the rows from their last
+        # clean token.  Contract-test stubs have no such surface.
+        if hasattr(self.worker.batcher, "discard_bad_blocks"):
+            self.worker.batcher.discard_bad_blocks = True
         if max is None:
             max = self.shards
         if not 1 <= min <= max:
@@ -104,6 +134,19 @@ class ShardedWorkerPool(FleetPoolBase):
             raise ValueError(
                 f"initial ({initial}) must be within [min, max]"
             )
+        self.hang_grace_cycles = hang_grace_cycles
+        self.probe_after_cycles = probe_after_cycles
+        # the shard-level chaos ledger: quarantines, evacuations, queue
+        # hand-backs, and probe re-admissions over the plane's lifetime
+        self.quarantined_total = 0
+        self.rows_evacuated_total = 0
+        self.released_total = 0
+        self.readmitted_total = 0
+        self._quarantined_at: dict[int, int] = {}
+        # shards that were DRAINING when quarantined: a passed probe
+        # must resume the drain the Scaler ordered, not silently undo a
+        # scale_down by re-admitting the shard to SERVING
+        self._drain_on_readmit: set[int] = set()
         self.shard_states = [
             SERVING if s < initial else INACTIVE for s in range(self.shards)
         ]
@@ -181,16 +224,141 @@ class ShardedWorkerPool(FleetPoolBase):
 
     def run_cycle(self) -> int:
         """One plane cycle: ONE worker cycle (refill + gang step +
-        settle) however many shards are active, then retire any draining
-        shard that emptied.  Returns requests completed."""
+        settle) however many shards are active, then the shard-level
+        supervision pass — quarantine any shard the health sentinels
+        indict (detect → quarantine → evacuate), advance the probe
+        state machine, and retire any draining shard that emptied.
+        Returns requests completed."""
         self.cycle += 1
         done = self.worker.run_once()
+        self._supervise_shards()
         for shard, state in enumerate(self.shard_states):
             if state == DRAINING and self.worker.batcher.shard_busy(shard) == 0:
                 self.shard_states[shard] = INACTIVE
                 self._event("shard-deactivate", shard=shard)
+        self._probe_shards()
         self._update_metrics()
         return done
+
+    # ------------------------------------------------------------------
+    # The shard failure domain: detect -> quarantine -> evacuate ->
+    # probe -> readmit (the PR 4 breaker's closed/open/half-open cycle,
+    # re-expressed over device-side shard health sentinels)
+    # ------------------------------------------------------------------
+
+    def _supervise_shards(self) -> None:
+        """Quarantine every shard the batcher's settle-time sentinels
+        indict.  Detection is the batcher's (the flags ride the one
+        combined settle transfer); actuation — mask flip, evacuation,
+        probe scheduling — is this pool's."""
+        batcher = self.worker.batcher
+        suspects = getattr(batcher, "shard_suspects", None)
+        if suspects is None:  # contract-test stubs have no health surface
+            return
+        for shard, cause in suspects(self.hang_grace_cycles):
+            if self.shard_states[shard] == QUARANTINED:
+                continue
+            self._quarantine(shard, cause)
+
+    def _quarantine(self, shard: int, cause: str) -> None:
+        batcher = self.worker.batcher
+        if self.shard_states[shard] == DRAINING:
+            # remember the Scaler's intent; a PROBING re-quarantine
+            # keeps whatever was remembered the first time
+            self._drain_on_readmit.add(shard)
+        elif self.shard_states[shard] == SERVING:
+            self._drain_on_readmit.discard(shard)
+        self.shard_states[shard] = QUARANTINED
+        self._quarantined_at[shard] = self.cycle
+        # the mask flip stops the router AND re-asserts the device bit
+        # (healing a corrupted mask is the same write as draining)
+        batcher.set_shard_active(shard, False)
+        batcher.shard_probing[shard] = False
+        batcher.clear_shard_health(shard)
+        self.quarantined_total += 1
+        evacuated, released = self.worker.evacuate_shard(shard)
+        self.rows_evacuated_total += evacuated
+        self.released_total += released
+        self._event(
+            "shard-quarantine", shard=shard, cause=cause,
+            evacuated=evacuated, released=released,
+        )
+        log.warning(
+            "Shard %d quarantined (%s); evacuated %d row(s) to healthy "
+            "shards, released %d to the queue",
+            shard, cause, evacuated, released,
+        )
+
+    def _probe_shards(self) -> None:
+        """Advance quarantined shards toward re-admission: after
+        ``probe_after_cycles`` a quarantined shard turns PROBING (mask
+        back on, router capacity 1); a probing shard whose probe block
+        settled clean — busy rows, real progress, no NaN flag — is
+        re-admitted to SERVING.  A probe that trips a sentinel goes
+        straight back to QUARANTINED via the supervision pass, timer
+        reset.  A shard that was DRAINING when it fell sick resumes the
+        drain instead of returning to SERVING (the probe's one request
+        is the only admission it ever gets): quarantine must not
+        silently undo a scale_down the Scaler ordered."""
+        batcher = self.worker.batcher
+        for shard, state in enumerate(self.shard_states):
+            if state == QUARANTINED:
+                if (self.cycle - self._quarantined_at[shard]
+                        >= self.probe_after_cycles):
+                    self.shard_states[shard] = PROBING
+                    batcher.set_shard_active(shard, True)
+                    batcher.shard_probing[shard] = True
+                    self._event("shard-probe", shard=shard)
+            elif state == PROBING:
+                bad = batcher.last_health_bad
+                clean = (
+                    batcher.last_settle_busy[shard] > 0
+                    and batcher.shard_stall_cycles[shard] == 0
+                    and not (bad is not None and bool(bad[shard]))
+                    # the verdict needs evidence the DECODE path worked:
+                    # gang-block tokens, or the probe request finishing
+                    # outright (a budget-1 row never enters a gang block
+                    # — its completion IS the shard's whole job).  An
+                    # admission-insert first token alone proves nothing
+                    # about a still-faulted gang program.
+                    and (batcher.shard_last_gang_progress[shard] > 0
+                         or batcher.shard_last_completed[shard] > 0)
+                )
+                if clean:
+                    resume_drain = shard in self._drain_on_readmit
+                    batcher.shard_probing[shard] = False
+                    self.readmitted_total += 1
+                    if resume_drain:
+                        # healthy again, but the Scaler had drained it:
+                        # stop admitting and let run_cycle retire it to
+                        # inactive once the probe row finishes
+                        self._drain_on_readmit.discard(shard)
+                        self.shard_states[shard] = DRAINING
+                        batcher.set_shard_active(shard, False)
+                    else:
+                        self.shard_states[shard] = SERVING
+                    self._event("shard-readmit", shard=shard,
+                                resumed_drain=resume_drain)
+                    log.info(
+                        "Shard %d passed its probe; %s", shard,
+                        "resuming its drain" if resume_drain
+                        else "re-admitted",
+                    )
+
+    # -- deterministic fault injection (sim.faults.FleetFaultPlan) -------
+
+    def poison_shard(self, shard: int, poisoned: bool = True) -> None:
+        """Chaos seam: NaN-poison (or heal) the shard's decode logits."""
+        self.worker.batcher.inject_poison(shard, poisoned)
+
+    def wedge_shard(self, shard: int, wedged: bool = True) -> None:
+        """Chaos seam: freeze (or un-freeze) the shard's gang results."""
+        self.worker.batcher.inject_wedge(shard, wedged)
+
+    def corrupt_shard_mask(self, shard: int) -> None:
+        """Chaos seam: flip the shard's DEVICE admission bit off while
+        the host still believes it admits."""
+        self.worker.batcher.corrupt_active_mask(shard)
 
     @property
     def processed(self) -> int:
@@ -209,9 +377,14 @@ class ShardedWorkerPool(FleetPoolBase):
             release()
         self.worker.stop()
         for shard, state in enumerate(self.shard_states):
-            if state in (SERVING, DRAINING):
+            if state in (SERVING, DRAINING, PROBING, QUARANTINED):
                 self.shard_states[shard] = INACTIVE
                 self.worker.batcher.set_shard_active(shard, False)
+            # a later scale_up must get a full-capacity shard, not one
+            # still capped to the half-open probe's single slot
+            self.worker.batcher.shard_probing[shard] = False
+        self._drain_on_readmit.clear()
+        self._quarantined_at.clear()
         self._update_metrics()
 
     # ------------------------------------------------------------------
@@ -222,7 +395,9 @@ class ShardedWorkerPool(FleetPoolBase):
 
     def attach_metrics(self, metrics) -> None:
         """Refresh the per-shard gauge family (``shard_active``,
-        ``shard_active_slots``, ``shard_tokens_per_second``) into a
+        ``shard_active_slots``, ``shard_tokens_per_second``,
+        ``shard_health``) plus the pool-level chaos counters
+        (``shard_quarantined_total``, ``rows_evacuated_total``) into a
         :class:`~..obs.prometheus.WorkloadMetrics` registry each cycle."""
         self.metrics = metrics
         self._update_metrics()
@@ -233,12 +408,28 @@ class ShardedWorkerPool(FleetPoolBase):
         batcher = self.worker.batcher
         served_since = getattr(self.worker, "_served_since", None)
         for row in batcher.shard_stats(served_since):
+            state = self.shard_states[row["shard"]]
             self.metrics.set_shard_gauges(
                 row["shard"],
-                active=self.shard_states[row["shard"]] == SERVING,
+                active=state in (SERVING, PROBING),
                 active_slots=row["active_slots"],
                 tokens_per_second=row["tokens_per_second"],
+                health=SHARD_HEALTH_CODES[state],
             )
+        self.metrics.set_gauge(
+            "shard_quarantined_total", self.quarantined_total,
+            "Shards quarantined by the health sentinels (poisoned "
+            "logits, no progress, admission-mask mismatch) over the "
+            "plane's lifetime.",
+            kind="counter",
+        )
+        self.metrics.set_gauge(
+            "rows_evacuated_total", self.rows_evacuated_total,
+            "In-flight rows moved off quarantined shards onto healthy "
+            "ones (re-prefilled mid-request; un-evacuable rows are "
+            "released to the queue instead).",
+            kind="counter",
+        )
 
     # ------------------------------------------------------------------
     # Real-plane construction
@@ -259,12 +450,17 @@ class ShardedWorkerPool(FleetPoolBase):
         tokenizer=None,
         result_queue=None,
         mesh=None,
+        engine_source=None,
+        now_fn=None,
         **pool_kwargs,
     ) -> "ShardedWorkerPool":
         """One gang-stepped :class:`~.worker.FleetWorker` whose batcher
         stacks ``shards`` engine shards of ``service_config.batch_size``
         slots each (``shards`` defaults to ``service_config.shards``,
-        which defaults to ``max``)."""
+        which defaults to ``max``).  ``engine_source`` seeds the plane
+        from an external sharded donor batcher (compile-free startup,
+        same contract as the replica pool); ``now_fn`` is the worker's
+        request-TTL clock."""
         import dataclasses
 
         if shards is None:
@@ -281,6 +477,7 @@ class ShardedWorkerPool(FleetPoolBase):
                 queue, params, model_config, seeded,
                 family=family, tokenizer=tokenizer,
                 result_queue=result_queue, mesh=mesh, pool=pool,
+                engine_source=engine_source, now_fn=now_fn,
                 # force the gang engine even for a one-shard plane (the
                 # worker's auto-pick would build the plain batcher,
                 # which has no shard surface to actuate)

@@ -89,27 +89,35 @@ class FleetWorker(ContinuousWorker):
 
     # -- reply dedup through the pool registry --------------------------
 
-    def _settle(self, message, tokens) -> None:
+    def _settle(self, message, tokens, *, error=None,
+                counted: bool = True) -> bool:
         if self._pool is not None:
             rid = request_id(message)
             if self._pool.already_replied(rid):
                 # a redelivered / re-dispatched copy of a request that
                 # was already answered: consume the duplicate input,
                 # never send a second reply.  It must not count toward
-                # `processed` either — run_once is about to add one for
-                # this settle, and completion criteria (the driver's
+                # `processed` either — when this settle came from the
+                # completion loop (`counted`), run_once is about to add
+                # one for it, and completion criteria (the driver's
                 # `pool.processed >= N`) must count UNIQUE requests, or
                 # a suppressed duplicate could stand in for a real one
-                # still waiting in the queue.
+                # still waiting in the queue.  Admission-time settles
+                # (TTL sheds, malformed drops) were never going to be
+                # counted, so there is nothing to cancel out.
                 self.queue.delete_message(
                     self.config.queue_url, message["ReceiptHandle"]
                 )
                 self._pool.note_duplicate(rid)
-                self.processed -= 1
-                return
-        super()._settle(message, tokens)
+                if counted:
+                    self.processed -= 1
+                return False
+        answered = super()._settle(
+            message, tokens, error=error, counted=counted
+        )
         if self._pool is not None:
             self._pool.mark_replied(request_id(message))
+        return answered
 
     # -- failover handoff ------------------------------------------------
 

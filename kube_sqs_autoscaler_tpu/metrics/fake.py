@@ -84,29 +84,39 @@ class FakeMessageQueue:
     def __init__(self, visibility_timeout: float = 30.0, now_fn=None):
         self._lock = threading.Lock()
         self._now = now_fn or time.monotonic
+        # SentTimestamp base: the injected clock when given (so request
+        # ages are deterministic under a FakeClock), else epoch seconds
+        # like real SQS — NOT the monotonic visibility clock, whose
+        # origin is arbitrary and would not match any consumer's clock
+        self._sent_now = now_fn or time.time
         self.visibility_timeout = visibility_timeout
-        self._visible: list[tuple[str, str]] = []  # (message_id, body)
-        # receipt_handle -> (deadline, message_id, body); like real SQS, a
-        # fresh receipt handle is issued per receive, so a stale handle
-        # from a previous delivery cannot delete a redelivered message
-        self._inflight: dict[str, tuple[float, str, str]] = {}
+        # (message_id, body, sent_ms) triples
+        self._visible: list[tuple[str, str, str]] = []
+        # receipt_handle -> (deadline, message_id, body, sent_ms); like
+        # real SQS, a fresh receipt handle is issued per receive, so a
+        # stale handle from a previous delivery cannot delete a
+        # redelivered message
+        self._inflight: dict[str, tuple[float, str, str, str]] = {}
         self._message_counter = 0
         self._receipt_counter = 0
 
     def _requeue_expired(self) -> None:
         now = self._now()
         expired = [
-            h for h, (deadline, _, _) in self._inflight.items() if deadline <= now
+            h for h, (deadline, _, _, _) in self._inflight.items()
+            if deadline <= now
         ]
         for handle in expired:
-            _, message_id, body = self._inflight.pop(handle)
-            self._visible.append((message_id, body))
+            _, message_id, body, sent = self._inflight.pop(handle)
+            self._visible.append((message_id, body, sent))
 
     def send_message(self, queue_url: str, body: str) -> str:
         with self._lock:
             self._message_counter += 1
             message_id = f"msg-{self._message_counter}"
-            self._visible.append((message_id, body))
+            # SQS stamps SentTimestamp in epoch milliseconds, as a string
+            sent = str(int(self._sent_now() * 1000))
+            self._visible.append((message_id, body, sent))
             return message_id
 
     def receive_messages(
@@ -122,14 +132,16 @@ class FakeMessageQueue:
             )
             deadline = self._now() + self.visibility_timeout
             out = []
-            for message_id, body in batch:
+            for message_id, body, sent in batch:
                 self._receipt_counter += 1
                 handle = f"rh-{self._receipt_counter}"
-                self._inflight[handle] = (deadline, message_id, body)
+                self._inflight[handle] = (deadline, message_id, body, sent)
                 out.append({
                     "MessageId": message_id,
                     "ReceiptHandle": handle,
                     "Body": body,
+                    # the attribute surface request-TTL shedding reads
+                    "Attributes": {"SentTimestamp": sent},
                 })
             return out
 
@@ -151,12 +163,13 @@ class FakeMessageQueue:
             entry = self._inflight.pop(receipt_handle, None)
             if entry is None:
                 return
-            _, message_id, body = entry
+            _, message_id, body, sent = entry
             if visibility_timeout <= 0:
-                self._visible.append((message_id, body))
+                self._visible.append((message_id, body, sent))
             else:
                 self._inflight[receipt_handle] = (
-                    self._now() + visibility_timeout, message_id, body
+                    self._now() + visibility_timeout, message_id, body,
+                    sent,
                 )
 
     def get_queue_attributes(self, queue_url, attribute_names):

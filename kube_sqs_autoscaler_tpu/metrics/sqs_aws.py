@@ -259,17 +259,37 @@ class AwsSqsService:
                 # SQS rejects MaxNumberOfMessages outside 1..10
                 "MaxNumberOfMessages": max(1, min(max_messages, 10)),
                 "WaitTimeSeconds": wait_time_s,
+                # SentTimestamp feeds the workers' --request-ttl
+                # admission deadline; without it messages never expire
+                "AttributeNames": ["SentTimestamp"],
             },
         )
-        return [
-            {"MessageId": m.get("MessageId", ""),
-             "ReceiptHandle": m["ReceiptHandle"], "Body": m.get("Body", "")}
-            for m in payload.get("Messages", [])
-        ]
+        out = []
+        for m in payload.get("Messages", []):
+            message = {"MessageId": m.get("MessageId", ""),
+                       "ReceiptHandle": m["ReceiptHandle"],
+                       "Body": m.get("Body", "")}
+            if m.get("Attributes"):
+                message["Attributes"] = m["Attributes"]
+            out.append(message)
+        return out
 
     def delete_message(self, queue_url: str, receipt_handle: str) -> None:
         self._call(
             "DeleteMessage",
             queue_url,
             {"QueueUrl": queue_url, "ReceiptHandle": receipt_handle},
+        )
+
+    def change_message_visibility(
+        self, queue_url: str, receipt_handle: str, visibility_timeout: float
+    ) -> None:
+        """Reset an in-flight message's visibility window (0 = return it
+        to the queue immediately — the fleet's drain-timeout and
+        evacuation hand-back path)."""
+        self._call(
+            "ChangeMessageVisibility",
+            queue_url,
+            {"QueueUrl": queue_url, "ReceiptHandle": receipt_handle,
+             "VisibilityTimeout": int(visibility_timeout)},
         )

@@ -416,15 +416,26 @@ class WorkloadMetrics:
         active: bool,
         active_slots: int,
         tokens_per_second: float,
+        health: int = 0,
     ) -> None:
         """The sharded serving plane's per-shard gauge family (one
         labeled series per engine shard, refreshed every plane cycle by
-        :class:`~..fleet.sharded.ShardedWorkerPool`)."""
+        :class:`~..fleet.sharded.ShardedWorkerPool`).  ``health`` is
+        the quarantine state machine's code (0 = healthy, 1 = probing,
+        2 = quarantined — ``fleet.SHARD_HEALTH_CODES``)."""
         labels = (("shard", str(shard)),)
         self.set_gauge(
+            "shard_health", health,
+            "Shard health per the quarantine state machine "
+            "(0=healthy, 1=probing half-open, 2=quarantined).",
+            labels=labels,
+        )
+        self.set_gauge(
             "shard_active", 1.0 if active else 0.0,
-            "Shard participates in admission (1) or is draining/inactive "
-            "(0). Flipped by the scale path's device-side mask.",
+            "Shard participates in admission (1 — serving, or probing "
+            "half-open with one slot; shard_health discriminates) or is "
+            "draining/inactive/quarantined (0). Flipped by the scale "
+            "path's device-side mask.",
             labels=labels,
         )
         self.set_gauge(

@@ -137,13 +137,21 @@ def instant_trace_events(
     are merged with (``to_chrome_trace(..., extra_events=...)``), so
     scaling decisions land on the same timeline as the ticks that caused
     them; ``time_origin`` defaults to the first event's time.
+
+    Shard-domain events (``shard-*``: activate/drain as well as the
+    chaos loop's quarantine/probe/readmit instants) get their own
+    ``"shard"`` category so Perfetto can filter the shard failure
+    domain separately from replica lifecycle events.
     """
     events = list(events)
     if not events:
         return []
     origin = events[0].t if time_origin is None else time_origin
     return [
-        _instant(e.name, e.t - origin, dict(e.args), cat="fleet")
+        _instant(
+            e.name, e.t - origin, dict(e.args),
+            cat="shard" if e.name.startswith("shard-") else "fleet",
+        )
         for e in events
     ]
 

@@ -245,7 +245,7 @@ def compose(*processes: FailureProcess) -> ComposedFaults:
 
 @dataclass(frozen=True)
 class FleetFaultPlan:
-    """Deterministic replica-fault schedule for the serving fleet.
+    """Deterministic replica- and shard-fault schedule for the fleet.
 
     The fleet's analogue of the RPC :class:`FailureProcess`es above:
     faults are values applied at known *pool cycles* (flag flips via
@@ -256,10 +256,31 @@ class FleetFaultPlan:
     replica_index)`` pairs; the driver calls :meth:`apply` once per
     cycle BEFORE the cycle runs.  Unknown replica indices fail loudly
     (a plan that kills nobody would gate nothing).
+
+    Shard-granularity faults (the sharded plane's failure domain,
+    actuated through :class:`~..fleet.ShardedWorkerPool`'s chaos
+    seams): ``shard_poisons``/``shard_wedges`` are ``(start_cycle,
+    end_cycle, shard)`` windows — the fault is injected at ``start``
+    and healed at ``end`` (end-exclusive, like every window here) —
+    and ``shard_mask_corruptions`` are one-shot ``(cycle, shard)``
+    device-mask bit flips (the quarantine path's mask re-assert is
+    what heals those).
     """
 
     kills: tuple[tuple[int, int], ...] = ()
     hangs: tuple[tuple[int, int], ...] = ()
+    shard_poisons: tuple[tuple[int, int, int], ...] = ()
+    shard_wedges: tuple[tuple[int, int, int], ...] = ()
+    shard_mask_corruptions: tuple[tuple[int, int], ...] = ()
+
+    def __post_init__(self):
+        for name in ("shard_poisons", "shard_wedges"):
+            for start, end, _ in getattr(self, name):
+                if not start < end:
+                    raise ValueError(
+                        f"{name} window needs start < end, got "
+                        f"[{start}, {end})"
+                    )
 
     def apply(self, cycle: int, pool) -> None:
         for at, index in self.kills:
@@ -268,10 +289,31 @@ class FleetFaultPlan:
         for at, index in self.hangs:
             if at == cycle:
                 pool.hang_worker(index)
+        for start, end, shard in self.shard_poisons:
+            if cycle == start:
+                pool.poison_shard(shard, True)
+            elif cycle == end:
+                pool.poison_shard(shard, False)
+        for start, end, shard in self.shard_wedges:
+            if cycle == start:
+                pool.wedge_shard(shard, True)
+            elif cycle == end:
+                pool.wedge_shard(shard, False)
+        for at, shard in self.shard_mask_corruptions:
+            if at == cycle:
+                pool.corrupt_shard_mask(shard)
 
     def indices(self) -> set[int]:
         """Every replica index the plan touches (for pre-validation)."""
         return {i for _, i in self.kills} | {i for _, i in self.hangs}
+
+    def shards(self) -> set[int]:
+        """Every shard index the plan touches (for pre-validation)."""
+        return (
+            {s for _, _, s in self.shard_poisons}
+            | {s for _, _, s in self.shard_wedges}
+            | {s for _, s in self.shard_mask_corruptions}
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -117,6 +117,15 @@ def main(argv=None) -> None:
              "each device holds whole shards)",
     )
     parser.add_argument(
+        "--request-ttl", type=float, default=0.0, metavar="SECONDS",
+        help="continuous serving: shed requests already older than this "
+             "on arrival (queue SentTimestamp age) with an explicit "
+             "{'error': 'expired'} reply instead of occupying a decode "
+             "slot — answered exactly once, never silently dropped; "
+             "exported as requests_shed_total (0 = off; requires "
+             "--continuous)",
+    )
+    parser.add_argument(
         "--speculative-draft-layers", type=int, default=0, metavar="N",
         help="speculative decoding with an early-exit self-draft: the "
              "model's own first N layers propose tokens and the full "
@@ -254,6 +263,13 @@ def main(argv=None) -> None:
                 "--decode-block applies to the plain continuous decode "
                 "path (not --beams / --speculative-draft-layers)"
             )
+    if args.request_ttl < 0:
+        raise SystemExit(
+            f"--request-ttl {args.request_ttl} must be >= 0 (0 = off)"
+        )
+    if args.request_ttl > 0 and not args.continuous:
+        # args-only check, same convention as --decode-block above
+        raise SystemExit("--request-ttl requires --continuous")
     if args.shards < 1:
         raise SystemExit(f"--shards {args.shards} must be >= 1")
     if args.shards > 1:
@@ -450,6 +466,7 @@ def main(argv=None) -> None:
         quantized_kv=args.quantize_kv,
         decode_block=args.decode_block,
         shards=args.shards,
+        request_ttl_s=args.request_ttl,
     )
     tokenizer = None
     if args.tokenizer:

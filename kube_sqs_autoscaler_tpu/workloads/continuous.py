@@ -83,6 +83,7 @@ def _insert_rows_impl(
     prefix_len: int = 0,
     eos_id: int | None = None,
     prefix_cache: dict | None = None,
+    budgets: jax.Array | None = None,
 ) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Batched admission: prefill ``n_rows`` prompts (int32
     ``[n_rows, prompt_len]``, right-padded to the static bucket) as ONE
@@ -110,6 +111,11 @@ def _insert_rows_impl(
     already hold the broadcast prefix, which slot reuse never
     overwrites — decode writes at ``length >= prefix_len``), and each
     slot's length starts past the prefix.
+
+    ``budgets`` (int32 ``[n_rows]``, optional) overrides the static
+    ``budget - 1`` re-arm with per-row remaining budgets — the
+    evacuation/resume path admits rows mid-request, each with whatever
+    budget its first life left unspent.
     """
     logits, rows_cache = _rows_prefill(
         params, prompts, lengths, config, family, quantized_kv, prefix_len,
@@ -125,7 +131,9 @@ def _insert_rows_impl(
         else jnp.zeros((n_rows,), bool)
     )
     done = done.at[rows].set(first_done)
-    remaining = remaining.at[rows].set(budget - 1)
+    remaining = remaining.at[rows].set(
+        budgets if budgets is not None else budget - 1
+    )
     return (
         {"layers": new_layers, "length": full_lengths},
         current, done, remaining, firsts,
@@ -385,6 +393,10 @@ class _Slot:
     accepted: int = 0
     # admission wall-clock, for the time-to-first-token gauge
     submitted_at: float = 0.0
+    # TTFT already recorded (set at the first settle; pre-set on
+    # evacuated/resumed rows so a request's TTFT is measured once, at
+    # its FIRST first token, never again on a later shard)
+    ttft_done: bool = False
 
 
 class ContinuousBatcher:
@@ -691,6 +703,12 @@ class ContinuousBatcher:
             self._spec = self._make_spec_round()
         else:
             self._insert_many = self._make_insert_many()
+            # the evacuation/resume insert: building the closure is free
+            # (compilation stays lazy per resume size), and building it
+            # HERE lets adopt_engine share one compile cache across a
+            # fleet — an evacuation wave hits one compile, not one per
+            # engine
+            self._resume_insert = self._make_insert_many(resume=True)
             if decode_block > 1:
                 self._block_fn = self._make_block_fn()
             else:
@@ -734,6 +752,7 @@ class ContinuousBatcher:
                 "them)"
             )
         self._insert_many = source._insert_many
+        self._resume_insert = source._resume_insert
         if self.decode_block > 1:
             self._block_fn = source._block_fn
         else:
@@ -748,13 +767,20 @@ class ContinuousBatcher:
             self.decode_block, self.mesh is None,
         )
 
-    def _make_insert_many(self):
+    def _make_insert_many(self, resume: bool = False):
         """The plain path's batched-admission jit: ``(params, cache,
         current, done, remaining, rows, prompts, lengths, key, n_rows)``
         with ``n_rows`` static (one compiled program per refill size —
-        at most ``batch_size`` of them)."""
+        at most ``batch_size`` of them).
+
+        ``resume=True`` builds the evacuation/resume variant of the SAME
+        machinery: the static prompt bucket widens to :attr:`resume_len`
+        (a resumed row prefills prompt + already-produced tokens) and a
+        trailing ``budgets`` int32 ``[n_rows]`` operand replaces the
+        static ``budget - 1`` re-arm with each row's unspent budget."""
         statics = dict(
-            config=self.config, prompt_len=self.prompt_len,
+            config=self.config,
+            prompt_len=self.resume_len if resume else self.prompt_len,
             budget=self.generate_tokens,
             family=self.family, temperature=self.temperature,
             top_k=self.top_k, top_p=self.top_p,
@@ -762,6 +788,11 @@ class ContinuousBatcher:
             prefix_len=self.prefix_len, eos_id=self.eos_id,
         )
         if self.mesh is None:
+            if resume:
+                return lambda *operands, n_rows: _insert_rows(
+                    *operands[:-1], n_rows=n_rows, budgets=operands[-1],
+                    prefix_cache=self._prefix_cache, **statics,
+                )
             return lambda *operands, n_rows: _insert_rows(
                 *operands, n_rows=n_rows,
                 prefix_cache=self._prefix_cache, **statics,
@@ -777,6 +808,8 @@ class ContinuousBatcher:
         # replicate, like the single-prompt insert's scalars did
         in_ops = (p_shard, self._cache_shard, rows, rows, rows,
                   rep, rep, rep, rep)
+        if resume:
+            in_ops = in_ops + (rep,)  # the trailing budgets operand
         out_ops = (self._cache_shard, rows, rows, rows, rep)
         if self._prefix_cache is not None:
             from .decode import prefix_cache_shardings
@@ -785,21 +818,31 @@ class ContinuousBatcher:
             placed_prefix = jax.device_put(self._prefix_cache, pfx_shard)
         jits: dict[int, Any] = {}
 
+        def impl(*args, _n, _prefix=None):
+            # peel the optional trailing operands back into keywords
+            # (pjit rejects kwargs when in_shardings is set)
+            if resume:
+                *ops, budgets = args
+            else:
+                ops, budgets = args, None
+            return _insert_rows_impl(
+                *ops, n_rows=_n, budgets=budgets, prefix_cache=_prefix,
+                **statics,
+            )
+
         def insert_many(*operands, n_rows):
             fn = jits.get(n_rows)
             if fn is None:
                 if self._prefix_cache is None:
                     fn = jax.jit(
-                        partial(_insert_rows_impl, n_rows=n_rows, **statics),
+                        partial(impl, _n=n_rows),
                         in_shardings=in_ops, out_shardings=out_ops,
                         donate_argnums=(1, 2, 3, 4),
                     )
                 else:
                     def _with_prefix(*args, _n=n_rows):
                         *ops, prefix = args
-                        return _insert_rows_impl(
-                            *ops, n_rows=_n, prefix_cache=prefix, **statics
-                        )
+                        return impl(*ops, _n=_n, _prefix=prefix)
 
                     inner = jax.jit(
                         _with_prefix,
@@ -1364,6 +1407,91 @@ class ContinuousBatcher:
             )
         return rows
 
+    @property
+    def resume_len(self) -> int:
+        """The resume insert's static prompt bucket: a resumed row
+        prefills its original (truncated) prompt plus everything it had
+        produced, which is at most ``prompt_len + generate_tokens`` —
+        within ``max_seq_len`` by the construction-time budget check."""
+        return self.prompt_len + self.generate_tokens
+
+    def submit_resume(
+        self, resumes: list[tuple[np.ndarray, Any, list, int, float]]
+    ) -> list[int]:
+        """Re-admit evacuated mid-flight requests into free slots.
+
+        Each resume is ``(token_ids, payload, produced, budget,
+        submitted_at)``: the request's original prompt, its payload, the
+        tokens it had already produced (and which the final reply must
+        keep), its original token budget, and its original admission
+        time.  The whole batch re-prefills prompt + produced as ONE
+        ``[M, resume_len]`` insert through the same admission plane as
+        :meth:`submit_many` — on the sharded plane the rows route
+        through :attr:`free_slots`, i.e. onto healthy admitting shards —
+        with per-row remaining budgets, so a resumed row decodes exactly
+        the continuation its first life had left (greedy: byte-identical
+        to never having been interrupted, up to the prefill-vs-decode
+        reduction-order caveat every chunked path here carries).
+        TTFT is not re-recorded: the request's first token already
+        reached the consumer-visible state once.  Plain decode path
+        only, like :meth:`adopt_engine`.
+        """
+        if self.beams > 1 or self.draft_layers:
+            raise ValueError(
+                "submit_resume supports the plain decode path only"
+            )
+        if not resumes:
+            return []
+        free = self.free_slots
+        if len(resumes) > len(free):
+            raise RuntimeError(
+                f"no free slot for {len(resumes)} resumed request(s) "
+                f"({len(free)} free); release the rest to the queue"
+            )
+        rows = free[: len(resumes)]
+        prompts = np.zeros((len(resumes), self.resume_len), np.int32)
+        lengths = np.zeros((len(resumes),), np.int32)
+        budgets = np.zeros((len(resumes),), np.int32)
+        for i, (ids, _, produced, budget, _) in enumerate(resumes):
+            prior = np.asarray(ids, np.int32).reshape(-1)[: self.prompt_len]
+            full = np.concatenate(
+                [prior, np.asarray(produced, np.int32)]
+            )
+            if not 0 <= len(produced) < budget:
+                raise ValueError(
+                    f"resumed row produced {len(produced)} of budget "
+                    f"{budget} tokens — a complete request settles, it "
+                    "does not resume"
+                )
+            if full.size > self.resume_len:
+                raise ValueError(
+                    f"resume prompt of {full.size} tokens exceeds the "
+                    f"resume bucket ({self.resume_len})"
+                )
+            prompts[i, : full.size] = full
+            lengths[i] = max(1, full.size)
+            # the insert's first token spends one of the remaining budget
+            budgets[i] = budget - len(produced) - 1
+        (self.cache, self._current, self._done, self._remaining,
+         firsts) = self._resume_insert(
+            self.params, self.cache, self._current, self._done,
+            self._remaining, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(prompts), jnp.asarray(lengths),
+            next(self._keys), jnp.asarray(budgets),
+            n_rows=len(rows),
+        )
+        self.insert_dispatches += 1
+        self._pending_firsts.append((firsts, list(rows)))
+        for row, (_, payload, produced, budget, submitted_at) in zip(
+            rows, resumes
+        ):
+            self.slots[row] = _Slot(
+                busy=True, budget=budget, payload=payload,
+                produced=list(produced), submitted_at=submitted_at,
+                ttft_done=bool(produced),
+            )
+        return rows
+
     def _submit_one(self, row, token_ids, payload, now) -> None:
         """Sequential admission for beam and speculative slots."""
         ids, length = self._pad_prompt(token_ids)
@@ -1431,11 +1559,21 @@ class ContinuousBatcher:
             for token, row in zip(np.asarray(vals).reshape(-1), rows):
                 slot = self.slots[row]
                 self._emit(slot, int(token))
+                if slot.ttft_done:
+                    # a resumed (evacuated) row: its TTFT was recorded
+                    # in its first life — this is a mid-request token
+                    continue
+                slot.ttft_done = True
                 ttft = now - slot.submitted_at
                 self.ttft_sum += ttft
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
                 self.ttft_samples.append(ttft)
+                self._note_ttft(row, ttft)
+
+    def _note_ttft(self, row: int, ttft: float) -> None:
+        """Per-row TTFT hook (no-op here; the sharded plane attributes
+        the sample to the row's shard for the healthy-shard SLO gate)."""
 
     def _needs_decode(self, slot: _Slot) -> bool:
         return slot.busy and not slot.done and len(slot.produced) < slot.budget
@@ -1648,6 +1786,7 @@ class ContinuousWorker:
         beams: int = 1,
         length_penalty: float = 0.0,
         sharded: bool | None = None,
+        now_fn=None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -1712,6 +1851,20 @@ class ContinuousWorker:
                 **batcher_kwargs,
             )
         self.processed = 0
+        # request-TTL clock (``ServiceConfig.request_ttl_s``): must share
+        # a time base with the queue's SentTimestamp stamps — epoch
+        # seconds for AWS SQS (the default), a FakeClock's now for
+        # deterministic tests/benches
+        self._now = now_fn or time.time
+        # requests shed at admission because they were already older
+        # than request_ttl_s (each got an explicit expired reply — shed
+        # is answered, never silently dropped)
+        self.shed = 0
+        # liveness counter the fleet's idle-wedge watchdog keys on: a
+        # healthy worker bumps it every refill pass (poll, poll-backoff
+        # tick, or full-slots early-out alike); a wedged run_once never
+        # reaches _refill, so the counter freezes
+        self.refill_cycles = 0
         # wall-clock engine-cycle spans (same metrics surface as
         # QueueWorker: obs attaches this to /metrics)
         from ..utils.profiling import SpanTimer
@@ -1735,16 +1888,29 @@ class ContinuousWorker:
     # billed ReceiveMessage per generated token would be absurd on SQS
     POLL_BACKOFF_CYCLES = 16
 
-    def _settle(self, message, tokens: np.ndarray | None) -> None:
+    def _settle(
+        self, message, tokens: np.ndarray | None, *,
+        error: str | None = None, counted: bool = True,
+    ) -> bool:
         """Reply (when configured) and delete one finished message.
-        ``tokens=None`` marks a malformed body: error reply, no result."""
+        ``tokens=None`` marks a request answered with an error instead
+        of a result: ``error`` names it (default "malformed body"; the
+        TTL shed path passes "expired").  ``counted=False`` marks a
+        settle that does NOT ride the run_once completion count
+        (admission-time sheds and malformed drops) — unused here, but
+        the fleet override's duplicate accounting depends on it.
+        Returns True when this call answered the request; the fleet
+        override returns False when it consumed an already-replied
+        duplicate instead (the TTL shed counter keys off this, so a
+        redelivered-then-expired copy is counted as a duplicate, not
+        double-booked as a shed too)."""
         import json
 
         from .service import build_token_reply, request_id
 
         if self.config.result_queue_url:
             if tokens is None:
-                payload = {"error": "malformed body"}
+                payload = {"error": error or "malformed body"}
             else:
                 payload = build_token_reply(
                     tokens, self.config.eos_id, self.tokenizer
@@ -1758,9 +1924,11 @@ class ContinuousWorker:
         self.queue.delete_message(
             self.config.queue_url, message["ReceiptHandle"]
         )
+        return True
 
     def _refill(self) -> int:
         """Pull up to free-slot-count messages and prefill them in."""
+        self.refill_cycles += 1  # liveness: this worker's loop is running
         free = len(self.batcher.free_slots)
         if not free:
             return 0
@@ -1788,9 +1956,21 @@ class ContinuousWorker:
 
         admit = []
         for message in messages:
+            if self._expired(message):
+                # older than --request-ttl already on arrival: shed with
+                # an explicit expired reply instead of occupying a slot.
+                # The reply + delete ride the normal settle path, so the
+                # request stays exactly-once (fleet workers register it
+                # in the reply registry like any other answer) and is
+                # never silently dropped.
+                if self._settle(
+                    message, None, error="expired", counted=False
+                ):
+                    self.shed += 1
+                continue
             ids = parse_request_body(message["Body"], self.tokenizer)
             if ids is None:
-                self._settle(message, None)
+                self._settle(message, None, counted=False)
                 continue
             admit.append((ids, message))
         if admit:
@@ -1799,6 +1979,71 @@ class ContinuousWorker:
             # sequentially inside submit_many)
             self.batcher.submit_many(admit)
         return len(admit)
+
+    def _expired(self, message: dict) -> bool:
+        """Deadline check at admission: the message's queue-stamped
+        ``SentTimestamp`` (epoch milliseconds, the SQS attribute) is
+        older than ``ServiceConfig.request_ttl_s``.  Messages without
+        the attribute never expire (a queue that doesn't stamp cannot
+        age its messages)."""
+        ttl = getattr(self.config, "request_ttl_s", 0.0)
+        if ttl <= 0:
+            return False
+        sent = message.get("Attributes", {}).get("SentTimestamp")
+        if sent is None:
+            return False
+        try:
+            age = self._now() - float(sent) / 1000.0
+        except (TypeError, ValueError):
+            return False
+        return age > ttl
+
+    def evacuate_shard(self, shard: int) -> tuple[int, int]:
+        """Move a quarantined shard's un-finished rows off it: re-admit
+        prompt + produced-so-far onto healthy shards through ONE batched
+        resume insert, and hand anything un-evacuable (no healthy free
+        slot, or a prompt that no longer parses) back to the queue.
+        Returns ``(evacuated, released)``; the shard must already be
+        masked out of admission (the caller quarantines first, so the
+        resume rows cannot route straight back onto the sick shard).
+        Sharded-plane workers only."""
+        from .service import parse_request_body
+
+        taken = self.batcher.take_shard_inflight(shard)
+        capacity = len(self.batcher.free_slots)
+        resumes, handback = [], []
+        for payload, produced, budget, submitted_at in taken:
+            ids = parse_request_body(payload["Body"], self.tokenizer)
+            fits = (
+                ids is not None
+                and len(resumes) < capacity
+                and min(ids.size, self.batcher.prompt_len) + len(produced)
+                <= self.batcher.resume_len
+            )
+            if fits:
+                resumes.append((ids, payload, produced, budget,
+                                submitted_at))
+            else:
+                handback.append(payload)
+        if resumes:
+            self.batcher.submit_resume(resumes)
+        nack = getattr(self.queue, "change_message_visibility", None)
+        if handback and nack is None:
+            # still handed back — redelivery just waits out the full
+            # visibility timeout instead of happening immediately
+            log.warning(
+                "Queue has no change_message_visibility; %d released "
+                "request(s) will redeliver only after the visibility "
+                "timeout", len(handback),
+            )
+        for payload in handback:
+            # back through the queue: the produced prefix is abandoned
+            # and a survivor decodes the request from scratch — slower,
+            # never lost (and the reply registry still dedups if the
+            # queue redelivers a copy racing this hand-back)
+            if nack is not None:
+                nack(self.config.queue_url, payload["ReceiptHandle"], 0)
+        return len(resumes), len(handback)
 
     def attach_metrics(self, metrics) -> None:
         """Report the serving gauges (tokens/s, time-to-first-token,
@@ -1829,6 +2074,13 @@ class ContinuousWorker:
                 batcher.block_tokens / batcher.block_capacity
                 if batcher.block_capacity else 0.0
             ),
+        )
+        self.metrics.set_gauge(
+            "requests_shed_total", self.shed,
+            "Requests shed at admission because they were already older "
+            "than --request-ttl (each answered with an explicit expired "
+            "reply).",
+            kind="counter",
         )
 
     def run_once(self) -> int:

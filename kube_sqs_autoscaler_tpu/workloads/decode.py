@@ -588,7 +588,10 @@ def block_decode(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
-) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    freeze: jax.Array | None = None,
+    corrupt: jax.Array | None = None,
+    health: bool = False,
+):
     """Advance every live row up to ``block = keys.shape[0]`` tokens in
     ONE compiled call — a ``lax.scan`` of decode steps with on-device
     per-row liveness masks, so the host pays one dispatch + one sync per
@@ -618,15 +621,41 @@ def block_decode(
     kept tokens this block (post-eos positions hold a pad the host never
     reads).  ``eos_id`` sets ``done`` the step it is emitted — the eos
     itself is a kept token, exactly like the single-step host loop.
+
+    Robustness seams (the sharded plane's chaos machinery; all default
+    off and leave the compiled program byte-identical when unused):
+
+    - ``freeze`` (traced bool, scalar or per-row): treat every matching
+      row as non-live for the whole block — it computes (lockstep static
+      shapes) but neither advances, emits, nor spends budget.  The
+      deterministic "wedged shard" fault is this flag held True.
+    - ``corrupt`` (traced bool, scalar or per-row): overwrite the step's
+      logits with NaN BEFORE sampling — the deterministic "poisoned
+      logits" fault (emitted tokens become garbage the caller must
+      discard; the health flag below is how it finds out).
+    - ``health=True``: additionally return a ``bad [batch]`` bool — row
+      was live at some step whose logits contained a non-finite value.
+      The flag is computed from the same logits the pick consumed, so a
+      poisoned row can never emit silently.
     """
     if step_fn is None:
         step_fn = decode_step
     pad = eos_id if eos_id is not None else 0
 
     def body(carry, key):
-        cache, current, done, remaining = carry
+        if health:
+            cache, current, done, remaining, bad = carry
+        else:
+            cache, current, done, remaining = carry
         live = ~done & (remaining > 0)
+        if freeze is not None:
+            live = live & ~freeze
         logits, stepped = step_fn(params, cache, current, config)
+        if corrupt is not None:
+            nan = jnp.full_like(logits, jnp.nan)
+            logits = jnp.where(jnp.reshape(corrupt, (-1, 1)), nan, logits)
+        if health:
+            bad = bad | (live & ~jnp.all(jnp.isfinite(logits), axis=-1))
         nxt = _pick(logits, key, temperature, top_k, top_p)
         emitted = jnp.where(live, nxt, pad)
         if eos_id is not None:
@@ -637,12 +666,21 @@ def block_decode(
             stepped,
             length=jnp.where(live, stepped["length"], cache["length"]),
         )
-        return (cache, current, done, remaining), (emitted, live)
+        carry = (
+            (cache, current, done, remaining, bad) if health
+            else (cache, current, done, remaining)
+        )
+        return carry, (emitted, live)
 
-    (cache, current, done, remaining), (tokens, lives) = jax.lax.scan(
-        body, (cache, current, done, remaining), keys
-    )
+    init = (cache, current, done, remaining)
+    if health:
+        init = init + (jnp.zeros(current.shape, bool),)
+    carry, (tokens, lives) = jax.lax.scan(body, init, keys)
     counts = jnp.sum(lives.astype(jnp.int32), axis=0)
+    if health:
+        cache, current, done, remaining, bad = carry
+        return cache, current, done, remaining, tokens, counts, bad
+    cache, current, done, remaining = carry
     return cache, current, done, remaining, tokens, counts
 
 
@@ -663,8 +701,10 @@ def gang_block_decode(
     top_p: float = 1.0,
     eos_id: int | None = None,
     fold_keys: bool = False,
+    poison: jax.Array | None = None,
+    wedge: jax.Array | None = None,
 ) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
-           jax.Array]:
+           jax.Array, jax.Array]:
     """Advance ``shards`` stacked engine shards with ONE compiled call.
 
     The operands are the flat ``[S*B]`` row space a
@@ -693,9 +733,20 @@ def gang_block_decode(
     block key so shards draw independent PRNG streams instead of every
     shard replaying one stream.  Greedy ignores keys entirely.
 
+    ``poison``/``wedge`` (bool ``[S]``, optional) are the deterministic
+    shard-fault seams: a poisoned shard's logits become NaN before
+    sampling (its emissions are garbage the caller discards on the
+    health flag), a wedged shard's rows are frozen for the whole block
+    (computes, emits nothing, advances nothing) — flag flips, not
+    process murder, exactly like :class:`~..sim.faults.FleetFaultPlan`.
+
     Returns ``(cache, current, done, remaining, tokens [block, S*B],
-    counts [S*B], free [S])`` — the flat-state contract of
-    :func:`block_decode` plus the per-shard summary.
+    counts [S*B], free [S], bad [S])`` — the flat-state contract of
+    :func:`block_decode` plus the per-shard free summary and the
+    per-shard health sentinel (``bad[s]`` = some live row of shard
+    ``s`` saw non-finite logits this block).  Both ``[S]`` vectors are
+    reduced ON DEVICE and ride the caller's one combined settle
+    transfer — health detection adds zero host syncs per cycle.
     """
     if step_fn is None:
         step_fn = decode_step
@@ -720,17 +771,22 @@ def gang_block_decode(
     else:
         shard_keys = keys
         key_axis = None
+    if poison is None:
+        poison = jnp.zeros((shards,), bool)
+    if wedge is None:
+        wedge = jnp.zeros((shards,), bool)
 
-    def one_shard(shard_cache, cur, done, rem, shard_keys):
+    def one_shard(shard_cache, cur, done, rem, shard_keys, poisoned,
+                  wedged):
         return block_decode(
             params, shard_cache, cur, done, rem, shard_keys, config,
             step_fn, temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_id=eos_id,
+            eos_id=eos_id, freeze=wedged, corrupt=poisoned, health=True,
         )
 
-    cache_s, cur_s, done_s, rem_s, toks, counts = jax.vmap(
-        one_shard, in_axes=(0, 0, 0, 0, key_axis)
-    )(cache_s, cur_s, done_s, rem_s, shard_keys)
+    cache_s, cur_s, done_s, rem_s, toks, counts, bad_rows = jax.vmap(
+        one_shard, in_axes=(0, 0, 0, 0, key_axis, 0, 0)
+    )(cache_s, cur_s, done_s, rem_s, shard_keys, poison, wedge)
     # [S, block, B] -> [block, S*B]: the host consume loop reads the same
     # (position, row) layout the single-plane block engine returns
     block = toks.shape[1]
@@ -740,9 +796,10 @@ def gang_block_decode(
         jnp.sum((done_s | (rem_s <= 0)).astype(jnp.int32), axis=1),
         0,
     )
+    bad = jnp.any(bad_rows, axis=1)
     return (
         jax.tree.map(to_rows, cache_s), to_rows(cur_s), to_rows(done_s),
-        to_rows(rem_s), tokens, counts.reshape(rows), free,
+        to_rows(rem_s), tokens, counts.reshape(rows), free, bad,
     )
 
 

@@ -221,6 +221,14 @@ class ServiceConfig:
     # only scheduling granularity changes; sampled runs stay
     # distribution-exact but consume RNG keys in a different order.
     decode_block: int = 1
+    # continuous serving only: > 0 sheds requests that are already older
+    # than this many seconds on ARRIVAL (per the queue's SentTimestamp
+    # attribute) with an explicit {"error": "expired"} reply instead of
+    # occupying a decode slot — a deadline no consumer is still waiting
+    # past should not cost GPU/TPU time.  Shed requests stay
+    # exactly-once (the reply registry records them); they are never
+    # silently dropped.  0 = off.
+    request_ttl_s: float = 0.0
     # continuous serving only: > 1 stacks this many engine shards of
     # batch_size slots each behind ONE admission plane, gang-stepped in
     # a single jitted decode call per cycle (workloads/shard_plane.py);
@@ -257,6 +265,11 @@ class ServiceConfig:
             )
         if self.shards < 1:
             raise ValueError(f"shards={self.shards} must be >= 1")
+        if self.request_ttl_s < 0:
+            raise ValueError(
+                f"request_ttl_s={self.request_ttl_s} must be >= 0 "
+                "(0 = off)"
+            )
 
 
 class QueueWorker:

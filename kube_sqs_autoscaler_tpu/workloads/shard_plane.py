@@ -116,8 +116,55 @@ class ShardedBatcher(ContinuousBatcher):
         self._shard_active = jnp.ones((shards,), bool)
         # host mirror the router consults without a device read
         self.shard_admitting = [True] * shards
+        # half-open probe capacity: a probing shard admits at most ONE
+        # request until its health sentinel clears it (the pool's
+        # quarantine state machine flips these, mirroring the PR 4
+        # breaker's half-open state)
+        self.shard_probing = [False] * shards
+        # deterministic shard-fault seams (sim.faults.FleetFaultPlan):
+        # device [S] masks folded into every gang dispatch + host
+        # mirrors for introspection.  All-False = the healthy program.
+        self._shard_poison = jnp.zeros((shards,), bool)
+        self._shard_wedge = jnp.zeros((shards,), bool)
+        self.shard_poisoned = [False] * shards
+        self.shard_wedged = [False] * shards
+        # discard a flagged shard's whole settled block (nothing garbage
+        # ever reaches a slot)?  Only safe when a supervisor will
+        # quarantine + evacuate the rows afterwards — the device already
+        # spent their budget, so WITHOUT recovery a discard strands the
+        # slots forever.  ShardedWorkerPool opts in; a standalone plane
+        # keeps the pre-quarantine contract (requests complete, the
+        # health flag still reports the corruption).
+        self.discard_bad_blocks = False
+        # health sentinels, updated at each combined settle (zero extra
+        # host syncs — they ride the same device_get as the tokens):
+        # last settled [S] NaN flags, per-shard tokens of the settled
+        # block, consecutive no-progress busy settles, and the
+        # device-vs-host admission-mask mismatch flags
+        self.last_health_bad: np.ndarray | None = None
+        self.shard_last_progress = [0] * shards
+        # gang-only progress + completions, split out of the total so a
+        # probe verdict can demand evidence the DECODE path worked: an
+        # admission-insert first token alone must not re-admit a shard
+        # whose gang program is still faulted
+        self.shard_last_gang_progress = [0] * shards
+        self.shard_last_completed = [0] * shards
+        self.shard_stall_cycles = [0] * shards
+        self.last_settle_busy = [0] * shards
+        self.mask_mismatch = [False] * shards
+        # settles to ignore for mismatch detection after a mask-ON flip:
+        # the settled summary is one block older than the flip, so the
+        # first post-flip settle legitimately still reports 0 free
+        self._mask_grace = [0] * shards
         # per-shard emitted-token counters (the per-shard tokens/s gauge)
         self.shard_tokens = [0] * shards
+        # per-shard TTFT samples (bounded like the global deque) — the
+        # chaos-serve bench scores healthy-shard TTFT SLOs from these
+        import collections
+
+        self.shard_ttft: list = [
+            collections.deque(maxlen=1024) for _ in range(shards)
+        ]
         # the last consumed [S] free-slot summary (None until a block
         # settles) — the device-confirmed depth signal behind
         # shard_stats' device_free column, fetched in the ONE combined
@@ -162,11 +209,13 @@ class ShardedBatcher(ContinuousBatcher):
         eos_id = self.eos_id
         fold = self.temperature > 0.0
 
-        def gang(params, cache, current, done, remaining, keys, active):
+        def gang(params, cache, current, done, remaining, keys, active,
+                 poison, wedge):
             return gang_block_decode(
                 params, cache, current, done, remaining, keys, active,
                 config, step_fn, shards=shards, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_id=eos_id, fold_keys=fold,
+                poison=poison, wedge=wedge,
             )
 
         if self.mesh is None:
@@ -181,9 +230,10 @@ class ShardedBatcher(ContinuousBatcher):
         return jax.jit(
             gang,
             in_shardings=(param_shardings(self.mesh, self.params),
-                          self._cache_shard, rows, rows, rows, rep, rep),
+                          self._cache_shard, rows, rows, rows, rep, rep,
+                          rep, rep),
             out_shardings=(self._cache_shard, rows, rows, rows,
-                           tokens_shard, rows, rep),
+                           tokens_shard, rows, rep, rep),
             donate_argnums=(1, 2, 3, 4),
         )
 
@@ -198,12 +248,128 @@ class ShardedBatcher(ContinuousBatcher):
         offering the shard's slots; rows already in flight keep decoding
         to completion (drain).  Reactivating is the same flip back —
         nothing is spawned, moved, or recompiled."""
+        self._check_shard(shard)
+        self.shard_admitting[shard] = bool(active)
+        self._shard_active = self._shard_active.at[shard].set(bool(active))
+        if active:
+            # the next settle's summary predates this flip — give the
+            # mismatch sentinel two settles before trusting it again
+            self._mask_grace[shard] = 2
+
+    # ------------------------------------------------------------------
+    # Deterministic shard-fault seams (the chaos battery's injection
+    # points — flag flips folded into the next gang dispatch, so faults
+    # land at exact known cycles and every episode replays)
+    # ------------------------------------------------------------------
+
+    def inject_poison(self, shard: int, poisoned: bool = True) -> None:
+        """Poisoned-logits fault: the shard's decode logits become NaN
+        (its emissions are garbage; the device-side health sentinel
+        flags the shard at the same settle, so nothing garbage is ever
+        emitted to a slot)."""
+        self._check_shard(shard)
+        self.shard_poisoned[shard] = bool(poisoned)
+        self._shard_poison = self._shard_poison.at[shard].set(bool(poisoned))
+
+    def inject_wedge(self, shard: int, wedged: bool = True) -> None:
+        """Wedged-shard fault: the shard's rows freeze — they compute
+        but emit nothing and advance nothing, the no-progress signature
+        the stall sentinel keys on."""
+        self._check_shard(shard)
+        self.shard_wedged[shard] = bool(wedged)
+        self._shard_wedge = self._shard_wedge.at[shard].set(bool(wedged))
+
+    def corrupt_active_mask(self, shard: int) -> None:
+        """Admission-mask-corruption fault: flip the DEVICE bit off
+        without touching the host mirror — the device summary and the
+        router now disagree about the shard, which is exactly the
+        divergence the mask-mismatch sentinel detects (re-asserting the
+        mask via :meth:`set_shard_active` heals it)."""
+        self._check_shard(shard)
+        self._shard_active = self._shard_active.at[shard].set(False)
+
+    def _check_shard(self, shard: int) -> None:
         if not 0 <= shard < self.shards:
             raise ValueError(
                 f"shard {shard} out of range [0, {self.shards})"
             )
-        self.shard_admitting[shard] = bool(active)
-        self._shard_active = self._shard_active.at[shard].set(bool(active))
+
+    # ------------------------------------------------------------------
+    # Evacuation surface (the pool's quarantine path)
+    # ------------------------------------------------------------------
+
+    def kill_rows(self, rows) -> None:
+        """Stop the device twins of evacuated rows: mark them done with
+        no budget so every later gang block freezes them (their slots
+        were freed host-side; the in-flight dispatch-ahead block may
+        still compute them once, but its tokens land on non-busy slots
+        and are discarded).  One tiny device op at evacuation time —
+        never on the per-cycle path."""
+        rows = list(rows)
+        if not rows:
+            return
+        idx = jnp.asarray(rows, jnp.int32)
+        self._done = self._done.at[idx].set(True)
+        self._remaining = self._remaining.at[idx].set(0)
+
+    def take_shard_inflight(self, shard: int) -> list[tuple]:
+        """Remove and return the shard's un-finished in-flight requests
+        as ``(payload, produced, budget, submitted_at)`` records (the
+        :meth:`~.continuous.ContinuousBatcher.submit_resume` contract,
+        minus the prompt the caller re-parses).  Slots are freed and
+        their device rows killed; rows that are already complete but
+        un-settled are left to finish through the normal settle path.
+        Deferred first tokens are flushed first (one evacuation-time
+        transfer) so a row admitted this very cycle still carries its
+        first token into its next life."""
+        self._check_shard(shard)
+        self._settle_pending_firsts()
+        from .continuous import _Slot
+
+        taken, killed = [], []
+        for row in self.shard_rows(shard):
+            slot = self.slots[row]
+            if not self._needs_decode(slot):
+                continue
+            taken.append(
+                (slot.payload, list(slot.produced), slot.budget,
+                 slot.submitted_at)
+            )
+            self.slots[row] = _Slot()
+            killed.append(row)
+        self.kill_rows(killed)
+        return taken
+
+    def clear_shard_health(self, shard: int) -> None:
+        """Reset the shard's sentinel counters (on quarantine, so stale
+        pre-quarantine readings can never count for or against the
+        probe verdict)."""
+        self.shard_stall_cycles[shard] = 0
+        self.shard_last_progress[shard] = 0
+        self.shard_last_gang_progress[shard] = 0
+        self.shard_last_completed[shard] = 0
+        self.last_settle_busy[shard] = 0
+        self.mask_mismatch[shard] = False
+        if self.last_health_bad is not None:
+            self.last_health_bad = np.array(self.last_health_bad)
+            self.last_health_bad[shard] = False
+
+    def shard_suspects(self, stall_grace: int = 3) -> list[tuple[int, str]]:
+        """Shards the latest settle's sentinels indict, with causes:
+        ``poisoned-logits`` (NaN flag), ``no-progress`` (busy rows,
+        zero tokens for ``stall_grace`` consecutive settles), or
+        ``mask-mismatch`` (device admission mask diverged from the
+        host's).  Pure introspection — quarantining is the pool's job."""
+        suspects = []
+        bad = self.last_health_bad
+        for s in range(self.shards):
+            if bad is not None and bool(bad[s]):
+                suspects.append((s, "poisoned-logits"))
+            elif self.shard_stall_cycles[s] >= stall_grace:
+                suspects.append((s, "no-progress"))
+            elif self.mask_mismatch[s]:
+                suspects.append((s, "mask-mismatch"))
+        return suspects
 
     def shard_rows(self, shard: int) -> range:
         return range(shard * self.shard_slots, (shard + 1) * self.shard_slots)
@@ -227,12 +393,18 @@ class ShardedBatcher(ContinuousBatcher):
         shard's free slots splits across shards and equal-depth shards
         fill in index order.  ``submit_many`` consuming this order IS
         the cross-shard router — the whole refill still prefills as one
-        global-row ``[M, P]`` insert."""
+        global-row ``[M, P]`` insert.  A PROBING shard (half-open after
+        quarantine) offers at most ONE slot until its health sentinel
+        clears it."""
         per_shard = [
             [row for row in self.shard_rows(s) if not self.slots[row].busy]
             if self.shard_admitting[s] else []
             for s in range(self.shards)
         ]
+        for s in range(self.shards):
+            if self.shard_probing[s]:
+                cap = max(0, 1 - self.shard_busy(s))
+                per_shard[s] = per_shard[s][:cap]
         order: list[int] = []
         heads = [0] * self.shards
         while True:
@@ -269,25 +441,43 @@ class ShardedBatcher(ContinuousBatcher):
                 self.shard_tokens[row // self.shard_slots] += 1
         super()._record_firsts(pending_host)
 
+    def _note_ttft(self, row: int, ttft: float) -> None:
+        # per-shard TTFT attribution: the chaos-serve bench gates the
+        # healthy shards' p99 against the no-fault baseline
+        self.shard_ttft[row // self.shard_slots].append(ttft)
+
     def _step_gang(self) -> list[tuple[Any, np.ndarray]]:
         new_block = None
         busy = sum(s.busy for s in self.slots)
         if busy:
             (self.cache, self._current, self._done, self._remaining,
-             tokens, counts, free) = self._gang_fn(
+             tokens, counts, free, bad) = self._gang_fn(
                 self.params, self.cache, self._current, self._done,
                 self._remaining, self._block_keys(), self._shard_active,
+                self._shard_poison, self._shard_wedge,
             )
             self.decode_dispatches += 1
             self.gang_cycles += 1
-            new_block = (tokens, counts, free, busy)
+            new_block = (
+                tokens, counts, free, bad, busy,
+                [self.shard_busy(s) for s in range(self.shards)],
+            )
         pending_firsts, self._pending_firsts = self._pending_firsts, []
         pending, self._pending_block = self._pending_block, new_block
         # ONE combined host transfer per cycle: deferred first tokens,
-        # the settled block's tokens/counts, and the [S] summary all
-        # land in a single device_get
+        # the settled block's tokens/counts, the [S] free summary, AND
+        # the [S] health sentinel all land in a single device_get —
+        # shard-fault detection costs zero additional host syncs
         firsts_dev = [arr for arr, _ in pending_firsts]
-        block_dev = pending[:3] if pending is not None else ()
+        block_dev = pending[:4] if pending is not None else ()
+        # first tokens settling this cycle count as shard progress too:
+        # a budget-1 row is never live in any gang block (its one token
+        # comes from the admission insert), so without this a healthy
+        # shard serving generate_tokens=1 traffic would read as stalled
+        firsts_by_shard = [0] * self.shards
+        for _, rows in pending_firsts:
+            for row in rows:
+                firsts_by_shard[row // self.shard_slots] += 1
         if firsts_dev or block_dev:
             firsts_host, block_host = jax.device_get(
                 (firsts_dev, block_dev)
@@ -299,22 +489,80 @@ class ShardedBatcher(ContinuousBatcher):
                     for vals, (_, rows) in zip(firsts_host, pending_firsts)
                 ])
             if pending is not None:
-                toks_host, counts_host, free_host = block_host
+                toks_host, counts_host, free_host, bad_host = block_host
                 self.last_free_summary = free_host
+                self.last_health_bad = np.asarray(bad_host)
                 self.summary_transfers += 1
-                dispatched_busy = pending[3]
+                dispatched_busy = pending[4]
+                dispatch_busy_by_shard = pending[5]
                 self.block_capacity += self.decode_block * dispatched_busy
-                self.block_tokens += int(counts_host.sum())
+                progress = (
+                    np.asarray(counts_host)
+                    .reshape(self.shards, self.shard_slots)
+                    .sum(axis=1)
+                )
+                for s in range(self.shards):
+                    total = int(progress[s]) + firsts_by_shard[s]
+                    self.shard_last_progress[s] = total
+                    self.shard_last_gang_progress[s] = int(progress[s])
+                    self.last_settle_busy[s] = dispatch_busy_by_shard[s]
+                    # no-progress sentinel: busy rows at dispatch, zero
+                    # tokens back — a wedged shard's exact signature
+                    # (a poisoned one keeps "progressing", its NaN flag
+                    # is the detector there)
+                    if dispatch_busy_by_shard[s] > 0 and total == 0:
+                        self.shard_stall_cycles[s] += 1
+                    else:
+                        self.shard_stall_cycles[s] = 0
                 for row, slot in enumerate(self.slots):
                     if not slot.busy:
                         continue
                     shard = row // self.shard_slots
+                    if (self.discard_bad_blocks
+                            and bool(self.last_health_bad[shard])):
+                        # the shard's logits went non-finite mid-block:
+                        # every token it emitted this block is garbage —
+                        # discard them all, so nothing corrupt ever
+                        # reaches a slot (the quarantine path re-decodes
+                        # from the last clean token)
+                        continue
                     for token in toks_host[: int(counts_host[row]), row]:
                         if slot.done or len(slot.produced) >= slot.budget:
                             break
                         self._emit(slot, int(token))
                         self.shard_tokens[shard] += 1
-        return self._finish_ready()
+                        self.block_tokens += 1
+        busy_before = [self.shard_busy(s) for s in range(self.shards)]
+        finished = self._finish_ready()
+        for s in range(self.shards):
+            self.shard_last_completed[s] = busy_before[s] - self.shard_busy(s)
+        if pending is not None:
+            self._update_mask_mismatch()
+        return finished
+
+    def _update_mask_mismatch(self) -> None:
+        """Compare the just-settled device ``[S]`` free summary against
+        the host's post-settle slot bookkeeping.  For an honestly-active
+        shard the device can only over-report free slots (its summary is
+        one block older than the host view: rows the host has since
+        admitted were still free to it, and rows the host just freed
+        were already done to it), so ``device == 0 < host`` is
+        impossible — unless the device-side admission mask diverged
+        (the corruption fault).  Runs on data already in hand: no
+        transfers."""
+        summary = self.last_free_summary
+        if summary is None:
+            return
+        for s in range(self.shards):
+            if self._mask_grace[s] > 0:
+                self._mask_grace[s] -= 1
+                self.mask_mismatch[s] = False
+                continue
+            self.mask_mismatch[s] = (
+                self.shard_admitting[s]
+                and int(summary[s]) == 0
+                and self.shard_free(s) > 0
+            )
 
     # ------------------------------------------------------------------
     # Observability
@@ -333,14 +581,18 @@ class ShardedBatcher(ContinuousBatcher):
             if served_since is not None and now > served_since else 0.0
         )
         summary = self.last_free_summary
+        bad = self.last_health_bad
         return [
             {
                 "shard": s,
                 "active": self.shard_admitting[s],
+                "probing": self.shard_probing[s],
                 "active_slots": self.shard_busy(s),
                 "device_free": (
                     int(summary[s]) if summary is not None else None
                 ),
+                "bad": bool(bad[s]) if bad is not None else False,
+                "stall_cycles": self.shard_stall_cycles[s],
                 "tokens": self.shard_tokens[s],
                 "tokens_per_second": (
                     self.shard_tokens[s] / elapsed if elapsed > 0 else 0.0

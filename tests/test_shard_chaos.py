@@ -1,0 +1,1036 @@
+"""Shard-level chaos: poisoned-shard quarantine, live slot evacuation,
+and the serving chaos battery's building blocks.
+
+Tier-1 (tiny model, CPU JAX): the device-side health sentinels (NaN
+flag, no-progress stall counter, admission-mask mismatch) and their
+zero-extra-transfer accounting, the pool's detect → quarantine →
+evacuate → probe → readmit state machine, the evacuation edge cases
+(greedy resume parity, visibility-timeout redelivery racing an
+evacuated row, no-free-slot queue hand-back), per-request TTL shedding,
+the idle-wedge watchdog regression, and the chaos-serve bench smoke.
+The full battery (all three fault classes, timing gates — the committed
+``BENCH_r13.json``) runs in the slow tier.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock  # noqa: E402
+from kube_sqs_autoscaler_tpu.fleet import (  # noqa: E402
+    DEAD,
+    PROBING,
+    QUARANTINED,
+    SERVING,
+    SHARD_HEALTH_CODES,
+    SHARD_STATE_CODES,
+    ShardedWorkerPool,
+    WorkerPool,
+)
+from kube_sqs_autoscaler_tpu.fleet.worker import FleetWorker  # noqa: E402
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue  # noqa: E402
+from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan  # noqa: E402
+from kube_sqs_autoscaler_tpu.workloads.continuous import (  # noqa: E402
+    ContinuousWorker,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.service import (  # noqa: E402
+    ServiceConfig,
+    collect_replies,
+)
+from kube_sqs_autoscaler_tpu.workloads.shard_plane import (  # noqa: E402
+    ShardedBatcher,
+)
+
+PROMPT, TOKENS, BLOCK = 8, 8, 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=PROMPT + TOKENS, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    return params, config
+
+
+def make_plane(tiny, *, shards=2, shard_slots=2, donor=None):
+    params, config = tiny
+    plane = ShardedBatcher(
+        params, config, shards=shards, shard_slots=shard_slots,
+        prompt_len=PROMPT, generate_tokens=TOKENS, decode_block=BLOCK,
+    )
+    if donor is not None:
+        plane.adopt_engine(donor)
+    return plane
+
+
+@pytest.fixture(scope="module")
+def donor22(tiny):
+    """One warmed (2 shards x 2 slots) engine the batcher-level tests
+    adopt, so the module pays each compiled program once."""
+    return make_plane(tiny)
+
+
+def service_config(**overrides):
+    base = dict(
+        queue_url="chaos://q", batch_size=2, seq_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK, shards=2,
+        result_queue_url="chaos://r",
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+def prompts_for(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, 64, rng.integers(2, PROMPT + 1)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def submit(plane, prompts, tag=0):
+    plane.submit_many([
+        (ids, f"req-{tag}-{i}") for i, ids in enumerate(prompts)
+    ])
+
+
+def drain(plane, max_steps=300):
+    out = {}
+    for _ in range(max_steps):
+        for payload, tokens in plane.step():
+            out[payload] = list(tokens)
+        if plane.active == 0:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FleetFaultPlan: shard-granularity fault scripting
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_shard_windows_validate():
+    with pytest.raises(ValueError, match="start < end"):
+        FleetFaultPlan(shard_poisons=((5, 5, 0),))
+    with pytest.raises(ValueError, match="start < end"):
+        FleetFaultPlan(shard_wedges=((7, 3, 1),))
+    plan = FleetFaultPlan(
+        kills=((1, 0),),
+        shard_poisons=((1, 4, 0),),
+        shard_wedges=((2, 5, 1),),
+        shard_mask_corruptions=((3, 2),),
+    )
+    assert plan.shards() == {0, 1, 2}
+    assert plan.indices() == {0}
+
+
+def test_fault_plan_applies_shard_faults_at_exact_cycles():
+    calls = []
+
+    class Recorder:
+        def poison_shard(self, shard, poisoned):
+            calls.append(("poison", shard, poisoned))
+
+        def wedge_shard(self, shard, wedged):
+            calls.append(("wedge", shard, wedged))
+
+        def corrupt_shard_mask(self, shard):
+            calls.append(("mask", shard))
+
+    plan = FleetFaultPlan(
+        shard_poisons=((2, 4, 1),),
+        shard_wedges=((3, 6, 0),),
+        shard_mask_corruptions=((5, 1),),
+    )
+    pool = Recorder()
+    for cycle in range(8):
+        plan.apply(cycle, pool)
+    # windows inject at start, heal at end (end-exclusive); one-shot
+    # corruption fires exactly once
+    assert calls == [
+        ("poison", 1, True),
+        ("wedge", 0, True),
+        ("poison", 1, False),
+        ("mask", 1),
+        ("wedge", 0, False),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Device-side health sentinels (batcher level): detection rides the one
+# combined settle transfer
+# ---------------------------------------------------------------------------
+
+
+def test_poison_sentinel_flags_shard_and_discards_garbage(tiny, donor22):
+    plane = make_plane(tiny, donor=donor22)
+    # the supervised contract under test: ShardedWorkerPool opts in so a
+    # flagged block is discarded whole (it then quarantines + evacuates
+    # the stranded rows; a standalone plane defaults to False)
+    plane.discard_bad_blocks = True
+    submit(plane, prompts_for(4))  # fills both shards
+    plane.step()  # dispatch block 1
+    plane.step()  # settle block 1, dispatch block 2
+    transfers_before = plane.host_transfers
+    plane.inject_poison(1)
+    plane.step()  # settles the clean block 2; dispatches poisoned block
+    poisoned_rows = [
+        list(plane.slots[row].produced) for row in plane.shard_rows(1)
+    ]
+    healthy_rows = [
+        list(plane.slots[row].produced) for row in plane.shard_rows(0)
+    ]
+    plane.step()  # settles the poisoned block
+    assert plane.last_health_bad is not None
+    assert bool(plane.last_health_bad[1]) and not bool(
+        plane.last_health_bad[0]
+    )
+    assert (1, "poisoned-logits") in plane.shard_suspects()
+    # nothing garbage ever reached a slot: the poisoned shard's rows are
+    # exactly where they were before the poisoned block settled...
+    assert [
+        list(plane.slots[row].produced) for row in plane.shard_rows(1)
+    ] == poisoned_rows
+    # ...while the healthy shard kept decoding
+    assert all(
+        len(plane.slots[row].produced) > len(prior)
+        for row, prior in zip(plane.shard_rows(0), healthy_rows)
+    )
+    # zero additional host syncs: detection rode the existing one
+    # combined settle transfer per cycle
+    assert plane.host_transfers - transfers_before == 2
+
+
+def test_wedge_sentinel_counts_stalls_and_heals_lossless(tiny, donor22):
+    control = make_plane(tiny, donor=donor22)
+    submit(control, prompts_for(2, seed=9))
+    expected = drain(control)
+
+    plane = make_plane(tiny, donor=donor22)
+    submit(plane, prompts_for(2, seed=9))
+    out = {}
+
+    def step():
+        # the healthy shard keeps finishing requests mid-wedge
+        out.update((p, list(t)) for p, t in plane.step())
+
+    step()
+    step()
+    plane.inject_wedge(1)
+    step()  # settles the last clean block
+    for _ in range(3):
+        step()  # wedged blocks: busy rows, zero tokens back
+    assert plane.shard_stall_cycles[1] >= 3
+    assert (1, "no-progress") in plane.shard_suspects(stall_grace=3)
+    # the healthy shard may read one spurious stall right as its rows
+    # complete (busy at dispatch, nothing left to emit) — exactly why
+    # the grace floor is >= 2 — but it never reaches the indictment bar
+    assert plane.shard_stall_cycles[0] <= 1
+    # the freeze is lossless: un-wedging resumes exactly where the rows
+    # stopped — outputs byte-identical to the never-wedged control
+    plane.inject_wedge(1, False)
+    out.update(drain(plane))
+    assert out == expected
+
+
+def test_mask_corruption_sentinel_and_reassert_heals(tiny, donor22):
+    plane = make_plane(tiny, donor=donor22)
+    # keep the gang busy on shard 0 while shard 1 sits empty
+    submit(plane, prompts_for(1, seed=11))
+    plane.corrupt_active_mask(1)
+    plane.step()
+    plane.step()  # the corrupted summary settles: device says 0 free
+    assert plane.mask_mismatch[1] and not plane.mask_mismatch[0]
+    assert (1, "mask-mismatch") in plane.shard_suspects()
+    # re-asserting the mask is the heal; the sentinel holds off for the
+    # two settles whose summaries predate the flip, then stays clear
+    plane.set_shard_active(1, True)
+    for _ in range(3):
+        plane.step()
+    assert not plane.mask_mismatch[1]
+
+
+# ---------------------------------------------------------------------------
+# Evacuation surface: take_shard_inflight + submit_resume
+# ---------------------------------------------------------------------------
+
+
+def test_resume_parity_greedy(tiny, donor22):
+    # a re-prefilled (evacuated) row decodes byte-identically to one
+    # that was never interrupted
+    ids = prompts_for(1, seed=21)[0]
+    control = make_plane(tiny, donor=donor22)
+    submit(control, [ids], tag="c")
+    expected = drain(control)["req-c-0"]
+
+    plane = make_plane(tiny, donor=donor22)
+    submit(plane, [ids], tag="c")
+    plane.step()
+    plane.step()
+    plane.step()  # a few tokens in flight, mid-request
+    taken = plane.take_shard_inflight(0)
+    assert len(taken) == 1
+    payload, produced, budget, submitted_at = taken[0]
+    assert 0 < len(produced) < budget
+    assert plane.shard_busy(0) == 0  # slots freed
+    plane.set_shard_active(0, False)  # quarantine stand-in
+    rows = plane.submit_resume(
+        [(ids, payload, produced, budget, submitted_at)]
+    )
+    assert all(row in plane.shard_rows(1) for row in rows)
+    out = drain(plane)
+    assert out["req-c-0"] == expected
+
+
+def test_submit_resume_validates(tiny, donor22):
+    plane = make_plane(tiny, donor=donor22)
+    ids = prompts_for(1)[0]
+    with pytest.raises(ValueError, match="does not resume"):
+        plane.submit_resume([(ids, "p", list(range(TOKENS)), TOKENS, 0.0)])
+    too_many = [
+        (ids, f"p{i}", [1], TOKENS, 0.0)
+        for i in range(len(plane.slots) + 1)
+    ]
+    with pytest.raises(RuntimeError, match="no free slot"):
+        plane.submit_resume(too_many)
+
+
+# ---------------------------------------------------------------------------
+# The pool's quarantine state machine: detect -> quarantine -> evacuate
+# -> probe -> readmit
+# ---------------------------------------------------------------------------
+
+
+def make_pool(tiny, *, queue_url, batch_size=3, shards=2, visibility=30.0,
+              probe_after_cycles=3, hang_grace_cycles=2, donor=None):
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(visibility_timeout=visibility,
+                             now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    service = service_config(
+        queue_url=queue_url, batch_size=batch_size, shards=shards,
+        result_queue_url=f"{queue_url}-r",
+    )
+    pool = ShardedWorkerPool.serving(
+        queue, params, config, service, result_queue=results,
+        min=shards, max=shards, initial=shards, clock=clock,
+        engine_source=donor, now_fn=clock.now,
+        probe_after_cycles=probe_after_cycles,
+        hang_grace_cycles=hang_grace_cycles,
+    )
+    return pool, clock, queue, results, service
+
+
+@pytest.fixture(scope="module")
+def pool_donor(tiny):
+    """A warmed (2 shards x 3 slots) gang engine for the pool tests."""
+    params, config = tiny
+    worker = FleetWorker(
+        FakeMessageQueue(), params, config,
+        service_config(batch_size=3, result_queue_url=""),
+        sharded=True,
+    )
+    return worker.batcher
+
+
+def drive(pool, clock, queue, *, queue_url, to_send, until,
+          on_cycle=None, max_cycles=400, send_every=1):
+    sent = []
+    for step in range(max_cycles):
+        if to_send and step % send_every == 0:
+            sent.append(queue.send_message(
+                queue_url, json.dumps(to_send.pop(0).tolist())
+            ))
+        if on_cycle is not None:
+            on_cycle(pool.cycle)
+        pool.run_cycle()
+        clock.advance(0.2)
+        if not to_send and until():
+            return sent
+    raise AssertionError("pool did not converge within the cycle budget")
+
+
+def test_pool_quarantine_evacuate_probe_readmit(tiny, pool_donor):
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://loop", donor=pool_donor,
+    )
+    metrics = WorkloadMetrics()
+    pool.attach_metrics(metrics)
+    seen = {"quarantined_render": False}
+
+    def on_cycle(cycle):
+        # heal two cycles after the quarantine landed; capture the
+        # mid-quarantine gauge rendering on the way
+        if pool.quarantined_total and not seen["quarantined_render"]:
+            seen["quarantined_render"] = True
+            text = metrics.render()
+            prefix = "kube_sqs_autoscaler_workload"
+            assert f'{prefix}_shard_health{{shard="1"}} 2.0' in text
+            assert f"# TYPE {prefix}_shard_quarantined_total counter" in text
+            assert f"{prefix}_shard_quarantined_total 1" in text
+            pool.poison_shard(1, False)
+        elif cycle == 4:
+            pool.poison_shard(1)
+
+    sent = drive(
+        pool, clock, queue, queue_url="chaos://loop",
+        to_send=prompts_for(16, seed=31),
+        until=lambda: (
+            pool.processed >= 16 and pool.idle
+            and all(s == SERVING for s in pool.shard_states)
+        ),
+        on_cycle=on_cycle,
+        # half-rate arrivals keep slack on the healthy shard — the
+        # regime where evacuation has somewhere to put rows
+        send_every=2,
+    )
+    # the whole loop ran: quarantine with the right cause, live
+    # evacuation (shard 0 had exactly one free slot: one row resumed,
+    # the rest released to the queue), probe, readmission
+    assert pool.quarantined_total == 1
+    assert pool.rows_evacuated_total >= 1
+    assert pool.readmitted_total == 1
+    names = [e.name for e in pool.events]
+    assert ["shard-quarantine", "shard-probe", "shard-readmit"] == [
+        n for n in names
+        if n in ("shard-quarantine", "shard-probe", "shard-readmit")
+    ]
+    quarantine = next(e for e in pool.events if e.name == "shard-quarantine")
+    assert quarantine.args["cause"] == "poisoned-logits"
+    assert quarantine.args["evacuated"] + quarantine.args["released"] >= 1
+    # exactly-once across evacuation, hand-back, and redelivery
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    # the chaos instants land on the Chrome-trace timeline under their
+    # own shard category
+    events = pool.trace_events(time_origin=0.0)
+    by_name = {e["name"]: e for e in events}
+    for name in ("shard-quarantine", "shard-probe", "shard-readmit"):
+        assert by_name[name]["cat"] == "shard"
+        assert by_name[name]["ph"] == "i"
+    # after recovery the health gauge reads 0 again
+    text = metrics.render()
+    assert 'shard_health{shard="1"} 0.0' in text
+    assert "rows_evacuated_total" in text
+
+
+def test_failed_probe_requarantines_until_healed(tiny, pool_donor):
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://probe", donor=pool_donor,
+    )
+    state = {"serving_mid_fault": False}
+
+    def on_cycle(cycle):
+        if cycle == 4:
+            pool.wedge_shard(1)
+        elif pool.quarantined_total >= 2:
+            # the shard failed its first probe (still wedged) and was
+            # re-quarantined: NOW let it heal
+            pool.wedge_shard(1, False)
+        if (pool.worker.batcher.shard_wedged[1]
+                and pool.shard_states[1] == SERVING
+                and pool.quarantined_total > 0):
+            # a probe must never re-admit a still-faulted shard: an
+            # admission-insert first token alone is not evidence the
+            # gang decode works
+            state["serving_mid_fault"] = True
+
+    sent = drive(
+        pool, clock, queue, queue_url="chaos://probe",
+        to_send=prompts_for(24, seed=37),
+        until=lambda: (
+            pool.processed >= 24 and pool.idle
+            and all(s == SERVING for s in pool.shard_states)
+        ),
+        on_cycle=on_cycle,
+    )
+    assert pool.quarantined_total >= 2  # first detection + failed probe
+    assert pool.readmitted_total == 1
+    assert not state["serving_mid_fault"]
+    causes = [
+        e.args["cause"] for e in pool.events
+        if e.name == "shard-quarantine"
+    ]
+    assert all(cause == "no-progress" for cause in causes)
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_mask_corruption_quarantines_and_recovers(tiny, pool_donor):
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://mask", donor=pool_donor,
+    )
+
+    def on_cycle(cycle):
+        if cycle == 5:
+            pool.corrupt_shard_mask(1)
+
+    sent = drive(
+        pool, clock, queue, queue_url="chaos://mask",
+        to_send=prompts_for(16, seed=41),
+        until=lambda: (
+            pool.processed >= 16 and pool.idle
+            and all(s == SERVING for s in pool.shard_states)
+        ),
+        on_cycle=on_cycle,
+    )
+    causes = [
+        e.args["cause"] for e in pool.events
+        if e.name == "shard-quarantine"
+    ]
+    assert causes == ["mask-mismatch"]
+    # the quarantine's mask write re-asserted the device bit; the probe
+    # then re-admitted a healthy shard (corruption is one-shot)
+    assert pool.readmitted_total == 1
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_redelivery_racing_evacuated_row_stays_exactly_once(
+    tiny, pool_donor,
+):
+    # a visibility-timeout redelivery races the evacuated row's resumed
+    # twin: the moment the quarantine lands, the victims' original
+    # messages are forced back to visible (exactly what an expiring
+    # visibility window does), so the queue re-dispatches them while
+    # their resumed twins decode on healthy shards — one reply each,
+    # not two
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://race", donor=pool_donor,
+    )
+    batcher = pool.worker.batcher
+    state = {"victims": [], "redelivered": False}
+
+    def on_cycle(cycle):
+        if not state["victims"] and batcher.shard_busy(1) > 0:
+            # the first cycle shard 1 holds work: poison it, and note
+            # which requests are about to be evacuated
+            pool.poison_shard(1)
+            state["victims"] = [
+                batcher.slots[row].payload
+                for row in batcher.shard_rows(1)
+                if batcher.slots[row].busy
+            ]
+        elif pool.quarantined_total and not state["redelivered"]:
+            state["redelivered"] = True
+            pool.poison_shard(1, False)
+            for payload in state["victims"]:
+                # stale handles (already settled / already handed back)
+                # are no-ops, like real SQS
+                queue.change_message_visibility(
+                    "chaos://race", payload["ReceiptHandle"], 0
+                )
+
+    def queue_drained():
+        attrs = queue.get_queue_attributes("chaos://race", ["All"])
+        return (attrs["ApproximateNumberOfMessages"] == "0"
+                and attrs["ApproximateNumberOfMessagesNotVisible"] == "0")
+
+    sent = drive(
+        pool, clock, queue, queue_url="chaos://race",
+        to_send=prompts_for(12, seed=43),
+        until=lambda: (
+            pool.processed >= 12 and pool.idle and queue_drained()
+            and all(s == SERVING for s in pool.shard_states)
+        ),
+        on_cycle=on_cycle,
+        send_every=2,
+    )
+    assert pool.quarantined_total >= 1
+    assert pool.rows_evacuated_total >= 1
+    # the redelivered copies were consumed without a second reply...
+    assert pool.duplicates_suppressed > 0
+    # ...and every request was still answered exactly once
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_evacuation_without_free_slots_hands_back_to_queue(
+    tiny, pool_donor,
+):
+    # every slot on every shard full at quarantine time: evacuation
+    # finds no healthy free slot, so the sick shard's rows go back
+    # through the queue instead (slower, never lost)
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://full", donor=pool_donor,
+    )
+    sent = [
+        queue.send_message("chaos://full", json.dumps(ids.tolist()))
+        for ids in prompts_for(6, seed=47)
+    ]
+    pool.run_cycle()  # one refill admits all six: both shards full
+    clock.advance(0.2)
+    batcher = pool.worker.batcher
+    assert batcher.shard_busy(0) == 3 and batcher.shard_busy(1) == 3
+    pool.poison_shard(1)
+    for _ in range(6):
+        pool.run_cycle()
+        clock.advance(0.2)
+        if pool.quarantined_total:
+            break
+    assert pool.quarantined_total == 1
+    assert pool.rows_evacuated_total == 0  # nowhere to put them live
+    assert pool.released_total >= 1
+    pool.poison_shard(1, False)
+    for _ in range(200):
+        pool.run_cycle()
+        clock.advance(0.2)
+        if (pool.processed >= len(sent) and pool.idle
+                and all(s == SERVING for s in pool.shard_states)):
+            break
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)  # slower, never lost
+    assert duplicates == 0
+
+
+def test_scale_up_never_resurrects_a_quarantined_shard(tiny, pool_donor):
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    pool = ShardedWorkerPool.serving(
+        queue, params, config,
+        service_config(queue_url="chaos://up", batch_size=3,
+                       result_queue_url="chaos://up-r"),
+        result_queue=results, min=1, max=2, initial=2, clock=clock,
+        engine_source=pool_donor, probe_after_cycles=50,
+    )
+    queue.send_message(
+        "chaos://up", json.dumps(prompts_for(1, seed=51)[0].tolist())
+    )
+    pool.run_cycle()  # shard 0 takes the request; shard 1 sits idle
+    pool.poison_shard(1)
+    # force work onto shard 1 so the sentinel can see it
+    queue.send_message(
+        "chaos://up", json.dumps(prompts_for(1, seed=52)[0].tolist())
+    )
+    for _ in range(10):
+        pool.run_cycle()
+        clock.advance(0.2)
+        if pool.quarantined_total:
+            break
+    assert pool.shard_states[1] == QUARANTINED
+    replicas_before = pool.replicas
+    pool.scale_up()  # must NOT flip the sick shard's mask back on
+    assert pool.shard_states[1] == QUARANTINED
+    assert not pool.worker.batcher.shard_admitting[1]
+    assert pool.replicas == replicas_before
+
+
+def test_quarantined_draining_shard_resumes_drain_after_probe(
+    tiny, pool_donor,
+):
+    # a scale_down the Scaler ordered must survive a quarantine: the
+    # passed probe resumes the drain (shard retires to inactive) rather
+    # than silently re-admitting the shard to SERVING
+    from kube_sqs_autoscaler_tpu.fleet import DRAINING, INACTIVE
+
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://drain", donor=pool_donor,
+    )
+    pool.min = 1  # allow the scale_down
+    sent = [
+        queue.send_message("chaos://drain", json.dumps(ids.tolist()))
+        for ids in prompts_for(4, seed=53)
+    ]
+    pool.run_cycle()  # 2 rows per shard in flight
+    clock.advance(0.2)
+    assert pool.worker.batcher.shard_busy(1) == 2
+    pool.scale_down()
+    assert pool.shard_states[1] == DRAINING
+    assert pool.replicas == 1
+    pool.poison_shard(1)  # the draining shard falls sick mid-drain
+    for _ in range(6):
+        pool.run_cycle()
+        clock.advance(0.2)
+        if pool.quarantined_total:
+            break
+    assert pool.shard_states[1] == QUARANTINED
+    pool.poison_shard(1, False)
+    # keep a trickle of traffic flowing so the probe gets its request
+    extra = drive(
+        pool, clock, queue, queue_url="chaos://drain",
+        to_send=prompts_for(12, seed=54),
+        until=lambda: (
+            pool.readmitted_total > 0
+            and pool.shard_states[1] == INACTIVE and pool.idle
+        ),
+        send_every=2,
+    )
+    # the probe passed, but the shard resumed its drain: it was never
+    # re-admitted to SERVING and the actuated replica count held
+    assert pool.readmitted_total == 1
+    readmit = next(e for e in pool.events if e.name == "shard-readmit")
+    assert readmit.args["resumed_drain"] is True
+    assert pool.replicas == 1
+    # drive the rest of the traffic home on the surviving shard
+    for _ in range(300):
+        pool.run_cycle()
+        clock.advance(0.2)
+        if pool.processed >= len(sent) + len(extra) and pool.idle:
+            break
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent) | set(extra)
+    assert duplicates == 0
+    assert pool.shard_states[1] == INACTIVE
+
+
+def test_budget_one_traffic_never_trips_the_stall_sentinel(tiny):
+    # generate_tokens=1 rows are never live in any gang block — their
+    # single token arrives via the deferred-firsts settle.  That settle
+    # must count as shard progress, or a perfectly healthy plane under
+    # steady budget-1 traffic reads as stalled and quarantines itself.
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    pool = ShardedWorkerPool.serving(
+        queue, params, config,
+        service_config(queue_url="chaos://b1", generate_tokens=1,
+                       result_queue_url="chaos://b1-r"),
+        result_queue=results, min=2, max=2, initial=2, clock=clock,
+        now_fn=clock.now, hang_grace_cycles=2,
+    )
+    prompts = prompts_for(20, seed=61)
+    sent = []
+    for _ in range(80):
+        if prompts:
+            sent.append(queue.send_message(
+                "chaos://b1", json.dumps(prompts.pop(0).tolist())
+            ))
+        pool.run_cycle()
+        clock.advance(0.2)
+        if not prompts and pool.processed >= len(sent) and pool.idle:
+            break
+    assert pool.quarantined_total == 0
+    assert all(state == SERVING for state in pool.shard_states)
+    replies, duplicates = collect_replies(results, "chaos://b1-r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_budget_one_probe_readmits_on_completion_evidence(tiny):
+    # budget-1 rows never enter a gang block, so a probing shard can
+    # never show gang progress — the probe verdict must accept the
+    # probe request COMPLETING as the shard's proof of health, or a
+    # budget-1 plane could never leave quarantine
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    pool = ShardedWorkerPool.serving(
+        queue, params, config,
+        service_config(queue_url="chaos://b1p", generate_tokens=1,
+                       result_queue_url="chaos://b1p-r"),
+        result_queue=results, min=2, max=2, initial=2, clock=clock,
+        now_fn=clock.now, hang_grace_cycles=2, probe_after_cycles=2,
+    )
+    prompts = prompts_for(40, seed=67)
+    sent, corrupted = [], False
+    for _ in range(200):
+        # 3 arrivals per cycle: budget-1 requests turn over fast and the
+        # freest-first router orders the probing shard's single slot
+        # LAST, so the refill must out-size the healthy shard's free
+        # slots for any probe traffic to spill over at all
+        for _ in range(3):
+            if prompts:
+                sent.append(queue.send_message(
+                    "chaos://b1p", json.dumps(prompts.pop(0).tolist())
+                ))
+        if len(sent) == 6 and not corrupted:
+            corrupted = True
+            pool.corrupt_shard_mask(1)  # one-shot fault, heals on quarantine
+        pool.run_cycle()
+        clock.advance(0.2)
+        if (not prompts and pool.processed >= len(sent) and pool.idle
+                and all(s == SERVING for s in pool.shard_states)):
+            break
+    assert pool.quarantined_total == 1
+    assert pool.readmitted_total == 1
+    assert all(state == SERVING for state in pool.shard_states)
+    replies, duplicates = collect_replies(results, "chaos://b1p-r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+def test_stop_all_clears_probe_capacity_cap(tiny, pool_donor):
+    # a pool stopped while a shard is PROBING must not leave the
+    # half-open one-slot router cap armed for the next scale_up
+    pool, clock, queue, results, service = make_pool(
+        tiny, queue_url="chaos://stop", donor=pool_donor,
+        probe_after_cycles=2,
+    )
+
+    def on_cycle(cycle):
+        if cycle == 3:
+            pool.poison_shard(1)
+
+    try:
+        drive(
+            pool, clock, queue, queue_url="chaos://stop",
+            to_send=prompts_for(8, seed=71),
+            until=lambda: pool.shard_states[1] == PROBING,
+            on_cycle=on_cycle, max_cycles=60,
+        )
+    except AssertionError:
+        pass  # remaining traffic is irrelevant — we only need PROBING
+    assert pool.shard_states[1] == PROBING
+    assert pool.worker.batcher.shard_probing[1]
+    pool.stop_all()
+    assert not any(pool.worker.batcher.shard_probing)
+    assert pool._quarantined_at == {}
+
+
+def test_shard_health_codes_cover_every_state():
+    assert set(SHARD_HEALTH_CODES) == set(SHARD_STATE_CODES)
+    assert SHARD_HEALTH_CODES[QUARANTINED] == 2
+    assert SHARD_HEALTH_CODES[PROBING] == 1
+    assert SHARD_HEALTH_CODES[SERVING] == 0
+
+
+def test_pool_validates_chaos_knobs(tiny, pool_donor):
+    params, config = tiny
+    with pytest.raises(ValueError, match="hang_grace_cycles"):
+        make_pool(tiny, queue_url="chaos://bad", donor=pool_donor,
+                  hang_grace_cycles=1)
+    with pytest.raises(ValueError, match="probe_after_cycles"):
+        make_pool(tiny, queue_url="chaos://bad", donor=pool_donor,
+                  probe_after_cycles=0)
+
+
+# ---------------------------------------------------------------------------
+# Trace polish: shard-domain instants carry their own category
+# ---------------------------------------------------------------------------
+
+
+def test_shard_events_get_shard_trace_category():
+    from kube_sqs_autoscaler_tpu.obs.trace import instant_trace_events
+
+    Event = collections.namedtuple("Event", "name t args")
+    events = instant_trace_events([
+        Event("replica-kill", 1.0, {"cause": "hung"}),
+        Event("shard-quarantine", 2.0, {"shard": 1}),
+        Event("shard-readmit", 3.0, {"shard": 1}),
+    ], time_origin=0.0)
+    assert [e["cat"] for e in events] == ["fleet", "shard", "shard"]
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-request deadline / TTL at admission
+# ---------------------------------------------------------------------------
+
+
+def test_request_ttl_sheds_expired_with_explicit_reply(tiny):
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    service = service_config(
+        queue_url="ttl://q", shards=1, request_ttl_s=5.0,
+        result_queue_url="ttl://r",
+    )
+    worker = ContinuousWorker(
+        queue, params, config, service, result_queue=results,
+        now_fn=clock.now,
+    )
+    stale = queue.send_message(
+        "ttl://q", json.dumps(prompts_for(1)[0].tolist())
+    )
+    clock.advance(10.0)  # now older than the TTL
+    fresh = queue.send_message(
+        "ttl://q", json.dumps(prompts_for(1, seed=3)[0].tolist())
+    )
+    for _ in range(40):
+        worker.run_once()
+        if worker.processed >= 1 and worker.batcher.active == 0:
+            break
+    assert worker.shed == 1
+    replies, duplicates = collect_replies(results, "ttl://r")
+    # shed is answered, never silently dropped: an explicit expired
+    # reply for the stale request, a normal reply for the fresh one
+    assert replies[stale]["error"] == "expired"
+    assert "tokens" not in replies[stale]
+    assert replies[fresh]["tokens"]
+    assert duplicates == 0
+    attrs = queue.get_queue_attributes("ttl://q", ["All"])
+    assert attrs["ApproximateNumberOfMessages"] == "0"
+    assert attrs["ApproximateNumberOfMessagesNotVisible"] == "0"
+    # the counter reaches Prometheus
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+
+    metrics = WorkloadMetrics()
+    worker.attach_metrics(metrics)
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    assert f"# TYPE {prefix}_requests_shed_total counter" in text
+    assert f"{prefix}_requests_shed_total 1" in text
+
+
+def test_request_ttl_shed_registers_in_reply_registry(tiny):
+    # on the fleet substrate a shed still counts toward exactly-once: a
+    # redelivered copy of an expired-and-answered request is suppressed
+    params, config = tiny
+
+    class Registry:
+        def __init__(self):
+            self.replied = set()
+            self.dups = 0
+
+        def already_replied(self, rid):
+            return rid in self.replied
+
+        def mark_replied(self, rid):
+            self.replied.add(rid)
+
+        def note_duplicate(self, rid):
+            self.dups += 1
+
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now, visibility_timeout=1.0)
+    results = FakeMessageQueue(now_fn=clock.now)
+    registry = Registry()
+    worker = FleetWorker(
+        queue, params, config,
+        service_config(queue_url="ttl://f", shards=1, request_ttl_s=5.0,
+                       result_queue_url="ttl://f-r"),
+        result_queue=results, pool=registry, now_fn=clock.now,
+    )
+    mid = queue.send_message(
+        "ttl://f", json.dumps(prompts_for(1)[0].tolist())
+    )
+    clock.advance(10.0)
+    worker.run_once()
+    assert registry.already_replied(mid)
+    assert worker.processed == 0  # sheds never count as completions
+
+
+def test_request_ttl_validates():
+    with pytest.raises(ValueError, match="request_ttl_s"):
+        service_config(request_ttl_s=-1.0)
+    # messages without a SentTimestamp never expire
+    assert service_config(request_ttl_s=0.0).request_ttl_s == 0.0
+
+
+def test_cli_rejects_ttl_without_continuous():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main
+
+    with pytest.raises(SystemExit, match="requires --continuous"):
+        main(["--demo", "1", "--generate-tokens", "2",
+              "--request-ttl", "5"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        main(["--demo", "1", "--continuous", "--generate-tokens", "2",
+              "--request-ttl", "-1"])
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the idle-wedge watchdog (PR 6 blind-spot regression)
+# ---------------------------------------------------------------------------
+
+
+def test_idle_wedged_replica_declared_dead(tiny):
+    # an idle replica that wedges (hung with ZERO in flight) used to be
+    # invisible to the progress watchdog — only the router's next
+    # orphan dispatch would have surfaced it.  The refill-liveness
+    # counter closes that: a healthy idle replica bumps it every cycle,
+    # a wedged one freezes it.
+    params, config = tiny
+    plain = service_config(queue_url="idle://q", shards=1,
+                           result_queue_url="idle://r")
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    donor = FleetWorker(
+        FakeMessageQueue(), params, config,
+        service_config(queue_url="idle://d", shards=1,
+                       result_queue_url=""),
+    ).batcher
+    pool = WorkerPool.serving(
+        queue, params, config, plain, result_queue=results,
+        min=1, max=2, initial=2, engine_source=donor,
+        hang_grace_cycles=3,
+    )
+    for _ in range(6):
+        pool.run_cycle()
+    # no false positive: both replicas idle and healthy, both serving
+    assert [r.state for r in pool.members] == [SERVING, SERVING]
+    pool.hang_worker(1)
+    for _ in range(5):
+        pool.run_cycle()
+    assert pool.members[1].state == DEAD
+    kill = next(e for e in pool.events if e.name == "replica-kill")
+    assert kill.args["cause"] == "hung-idle"
+    # the survivor still serves traffic
+    mid = queue.send_message(
+        "idle://q", json.dumps(prompts_for(1)[0].tolist())
+    )
+    for _ in range(60):
+        pool.run_cycle()
+        if pool.processed >= 1 and pool.idle:
+            break
+    replies, _ = collect_replies(results, "idle://r")
+    assert mid in replies
+
+
+# ---------------------------------------------------------------------------
+# The chaos-serve suite: tier-1 smoke + full battery (slow)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_serve_suite_smoke(tmp_path):
+    from bench import run_chaos_serve_suite
+
+    out = tmp_path / "bench_chaos_serve.json"
+    headline = run_chaos_serve_suite(
+        str(out), messages=24, episodes=("poison",), timing_gates=False,
+    )
+    artifact = json.loads(out.read_text())
+    episode = artifact["report"]["poison"]
+    # the acceptance gates the suite enforces (it exits 2 otherwise):
+    # exactly-once, >=1 quarantined and re-admitted via probe, rows
+    # rescued, replies byte-identical to the no-fault control, and the
+    # sentinels riding the one combined settle transfer
+    assert episode["lost"] == 0 and episode["duplicate_replies"] == 0
+    assert episode["quarantined"] >= 1
+    assert episode["readmitted"] >= 1
+    assert episode["rows_evacuated"] + episode["rows_released"] >= 1
+    assert artifact["parity_divergences"]["poison"] == 0
+    assert episode["decode_dispatches"] == episode["gang_cycles"]
+    assert (episode["host_transfers"]
+            <= episode["cycles"] + episode["quarantined"] + 1)
+    assert all(state == "serving" for state in episode["final_states"])
+    assert "0 parity divergences" in headline["unit"]
+
+
+@pytest.mark.slow
+def test_chaos_serve_full_battery(tmp_path):
+    # the committed-artifact configuration: all three fault classes,
+    # timing gates on (healthy-shard TTFT p99 + post-readmit recovery)
+    from bench import run_chaos_serve_suite
+
+    out = tmp_path / "bench_r13.json"
+    run_chaos_serve_suite(str(out))
+    artifact = json.loads(out.read_text())
+    for name in ("poison", "wedge", "mask"):
+        assert artifact["report"][name]["lost"] == 0
+        assert artifact["report"][name]["readmitted"] >= 1
+        assert artifact["parity_divergences"][name] == 0

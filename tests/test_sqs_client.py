@@ -89,9 +89,9 @@ def test_service_error_becomes_metric_error():
 
 
 def test_message_operations_roundtrip():
-    # send/receive/delete speak the same signed JSON protocol with the
-    # right X-Amz-Target per action
-    state = {"deleted": []}
+    # send/receive/delete/change-visibility speak the same signed JSON
+    # protocol with the right X-Amz-Target per action
+    state = {"deleted": [], "visibility": []}
 
     def handler(exchange):
         target = exchange.headers["X-Amz-Target"]
@@ -101,11 +101,24 @@ def test_message_operations_roundtrip():
             return Reply.json({"MessageId": "m-1"})
         if target == "AmazonSQS.ReceiveMessage":
             assert 1 <= body["MaxNumberOfMessages"] <= 10  # SQS hard limit
+            # the --request-ttl deadline needs the queue's send stamp
+            assert body["AttributeNames"] == ["SentTimestamp"]
             return Reply.json(
-                {"Messages": [{"ReceiptHandle": "rh-1", "Body": "[1, 2, 3]"}]}
+                {"Messages": [
+                    {"ReceiptHandle": "rh-1", "Body": "[1, 2, 3]",
+                     "Attributes": {"SentTimestamp": "1700000000000"}},
+                    # SQS may omit Attributes (e.g. a proxy that strips
+                    # them); the adapter must not invent the key
+                    {"ReceiptHandle": "rh-2", "Body": "[4]"},
+                ]}
             )
         if target == "AmazonSQS.DeleteMessage":
             state["deleted"].append(body["ReceiptHandle"])
+            return Reply.json({})
+        if target == "AmazonSQS.ChangeMessageVisibility":
+            state["visibility"].append(
+                (body["ReceiptHandle"], body["VisibilityTimeout"])
+            )
             return Reply.json({})
         raise AssertionError(f"unexpected target {target}")
 
@@ -116,10 +129,15 @@ def test_message_operations_roundtrip():
         url = f"{server.url}/123/q"
         assert service.send_message(url, "[1, 2, 3]") == "m-1"
         messages = service.receive_messages(url, max_messages=16)  # clamped
-        assert messages == [{"MessageId": "", "ReceiptHandle": "rh-1",
-                                 "Body": "[1, 2, 3]"}]
+        assert messages == [
+            {"MessageId": "", "ReceiptHandle": "rh-1", "Body": "[1, 2, 3]",
+             "Attributes": {"SentTimestamp": "1700000000000"}},
+            {"MessageId": "", "ReceiptHandle": "rh-2", "Body": "[4]"},
+        ]
         service.delete_message(url, "rh-1")
+        service.change_message_visibility(url, "rh-2", 0)
     assert state["deleted"] == ["rh-1"]
+    assert state["visibility"] == [("rh-2", 0)]
     for exchange in server.exchanges:
         assert exchange.headers["Authorization"].startswith("AWS4-HMAC-SHA256")
 
