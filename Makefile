@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve replay-demo chaos-demo fleet-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -93,6 +93,18 @@ bench-scale:
 bench-chaos-serve:
 	JAX_PLATFORMS=cpu python bench.py --suite chaos-serve
 
+# Learned-policy suite (CPU JAX, ~a minute): ES-train a tiny policy
+# network inside the compiled lax.scan twin (thousands of parallel
+# episodes per device call), then gate it like any hand-written policy —
+# exits non-zero unless compiled-vs-Python fidelity shows 0 divergences
+# for the trained network, the learned policy beats the train-tuned
+# sweep winners on held-out seeded scenario variants (lexicographic
+# max-depth -> churn -> time-over-SLO), and no chaos-battery world
+# scores lexicographically worse than the reactive reference; writes
+# BENCH_r14.json + the deployable LEARNED_POLICY.json checkpoint
+bench-learn:
+	JAX_PLATFORMS=cpu python bench.py --suite learn
+
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a
 # deterministic mid-episode replica kill; exits non-zero unless every
@@ -120,6 +132,14 @@ chaos-demo:
 # down — exits 2 on any missing milestone
 fleet-demo:
 	JAX_PLATFORMS=cpu python -m kube_sqs_autoscaler_tpu.fleet
+
+# Deterministic learned-policy lifecycle (CPU JAX, seconds): tiny-
+# population ES smoke train in the compiled twin, checkpoint
+# save -> load bitwise round trip, the compiled-vs-Python fidelity gate
+# on the trained network, and a real ControlLoop episode on a FakeClock
+# driven by the loaded checkpoint — exits 2 on any missing milestone
+learn-demo:
+	JAX_PLATFORMS=cpu python -m kube_sqs_autoscaler_tpu.learn
 
 # TPU workload benchmark (train tokens/s + MFU, flash-vs-dense) — runs on
 # the real chip; writes WORKBENCH.json

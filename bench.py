@@ -68,6 +68,15 @@ timing the batched compiled path against sampled Python real-loop
 episodes; writes ``BENCH_r08.json`` with best-per-scenario configs, the
 max-depth-vs-churn Pareto fronts, and the measured per-episode speedup.
 
+``--suite learn`` trains a learned autoscaling policy inside the
+compiled twin (`learn/`: antithetic ES over a tiny network, thousands of
+(population x scenario) episodes per device call) and then gates it like
+any hand-written policy: compiled-vs-Python fidelity with 0 divergences,
+a lexicographic (depth -> churn -> SLO) win over the train-tuned sweep
+winners on *held-out* seeded scenario variants, and zero chaos-battery
+regression vs the reactive reference; writes ``BENCH_r14.json`` plus the
+deployable checkpoint ``LEARNED_POLICY.json``.
+
 The default suite deliberately imports no JAX: the controller is plain
 Python (the reference is a plain Go binary with no accelerator workload,
 SURVEY.md §2); model workload microbenchmarks live in tests/ and the
@@ -78,6 +87,7 @@ predictive episodes only; the sweep suite is the JAX-native one.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -500,6 +510,327 @@ def run_sweep_suite(output: str = "BENCH_r08.json") -> dict:
             f" {fidelity.ticks} fidelity ticks, 0 divergences)"
         ),
         "vs_baseline": round(speedup, 1),
+    }
+
+
+#: Seeds for the learn suite's scenario-variant splits.  Train and
+#: held-out worlds are disjoint by construction (different seeds feed the
+#: sha256-keyed variant generator), and both are fully reproducible.
+LEARN_TRAIN_SEED = 101
+LEARN_HELD_OUT_SEED = 202
+
+
+def _lex_score(rows) -> tuple:
+    """Aggregate lexicographic key (depth, churn, SLO) over score rows —
+    the sweep's own 'best' ordering, applied to totals."""
+    return (
+        round(sum(r["max_depth"] for r in rows), 1),
+        sum(r["replica_changes"] for r in rows),
+        round(sum(r["time_over_slo_s"] for r in rows), 1),
+    )
+
+
+def run_learn_suite(
+    output: str = "BENCH_r14.json",
+    checkpoint_output: str = "LEARNED_POLICY.json",
+) -> dict:
+    """Train a policy in the compiled twin, then gate it like any other.
+
+    Four phases, three hard gates (any failure exits 2):
+
+    1. **Train** — antithetic ES (`learn/train.py`) on the default
+       battery plus seeded train variants; the checkpoint artifact lands
+       in ``LEARNED_POLICY.json`` ready for ``--policy learned``.
+    2. **Fidelity gate** — `verify_fidelity` over the full default
+       battery (reactive + all three forecasters, the sweep suite's
+       gate) EXTENDED with the trained network on every base scenario
+       and a sample of held-out variants: the compiled episodes that
+       trained the policy must reproduce the real ``ControlLoop``
+       tick-for-tick, 0 divergences.
+    3. **Held-out gate** — the PR 3 sweep grid is tuned on the *train*
+       battery, its per-scenario winners are re-scored on *held-out*
+       variants the search never saw, and the learned policy must beat
+       the winners' aggregate lexicographically (max depth, then churn,
+       then time-over-SLO).  The full grid is also re-scored on held-out
+       to report where the learned policy lands on the max-depth-vs-churn
+       Pareto front (including the oracle best, which is NOT the gate —
+       a baseline tuned on the held-out set itself is not a fair fight,
+       but the reader deserves to see it).
+    4. **Chaos gate** — every PR 4 chaos-battery world (faults included)
+       is scored under the learned policy vs the reactive reference;
+       a scenario where the learned policy is lexicographically worse is
+       a regression, and the gate demands zero.
+    """
+    from kube_sqs_autoscaler_tpu.learn.checkpoint import save_checkpoint
+    from kube_sqs_autoscaler_tpu.learn.rollout import (
+        evaluate_checkpoint,
+        learned_config,
+    )
+    from kube_sqs_autoscaler_tpu.learn.train import ESConfig, train
+    from kube_sqs_autoscaler_tpu.sim.compiled import verify_fidelity
+    from kube_sqs_autoscaler_tpu.sim.evaluate import (
+        chaos_battery,
+        default_battery,
+        score_result,
+    )
+    from kube_sqs_autoscaler_tpu.sim.scenarios import scenario_variants
+    from kube_sqs_autoscaler_tpu.sim.simulator import (
+        SimConfig as LearnSimConfig,
+        Simulation as LearnSimulation,
+    )
+    from kube_sqs_autoscaler_tpu.sim.sweep import (
+        SweepPoint,
+        SweepSpec,
+        run_sweep,
+    )
+
+    start = time.perf_counter()
+    base = list(default_battery())
+    train_set = base + scenario_variants(base, 2, seed=LEARN_TRAIN_SEED)
+    held_out = scenario_variants(base, 3, seed=LEARN_HELD_OUT_SEED)
+
+    # -- 1. train --------------------------------------------------------
+    es = ESConfig(
+        population=32, generations=40, seed=0,
+        churn_weight=0.3, replica_weight=0.15,
+    )
+    t0 = time.perf_counter()
+    result = train(train_set, es)
+    train_s = time.perf_counter() - t0
+    checkpoint = result.checkpoint
+    # NOT saved yet: checkpoint_output is the deployable artifact, and a
+    # failed gate below must not replace the last fully-gated weights on
+    # disk with ungated ones — the save happens after the chaos gate.
+
+    # -- 2. fidelity gate ------------------------------------------------
+    t0 = time.perf_counter()
+    extra = [
+        (f"learn:{s.name}/learned", learned_config(s, checkpoint))
+        for s in base + held_out[::4]
+    ]
+    fidelity = verify_fidelity(extra_episodes=extra)
+    fidelity_s = time.perf_counter() - t0
+    if not fidelity.ok:
+        for line in fidelity.format_divergences():
+            print(line, file=sys.stderr)
+        raise SystemExit(2)
+
+    # -- 3. held-out gate ------------------------------------------------
+    spec = SweepSpec()
+    t0 = time.perf_counter()
+    family_of = lambda name: name.split("~")[0]  # noqa: E731
+    # The baseline is tuned on the SAME train battery the learned policy
+    # saw (base + train variants): per family, the configuration with the
+    # best aggregate lexicographic score over that family's train worlds.
+    # Anything less (e.g. tuning on base only) would hand the learned
+    # side a data advantage and overstate the headline.
+    train_report = run_sweep(spec, train_set)
+    train_by_family: dict[str, dict[str, dict]] = {}
+    for row in train_report.rows:
+        family = family_of(row["scenario"])
+        entry = train_by_family.setdefault(family, {}).setdefault(
+            row["label"], {"scores": [], "point": row["point"]}
+        )
+        entry["scores"].append(row["score"])
+    winners = {}
+    for family, labels in train_by_family.items():
+        best_label = min(
+            labels, key=lambda label: _lex_score(labels[label]["scores"])
+        )
+        winners[family] = SweepPoint(**labels[best_label]["point"])
+    held_by_family: dict[str, list] = {}
+    for scenario in held_out:
+        held_by_family.setdefault(family_of(scenario.name), []).append(scenario)
+    winner_rows = []
+    for family, scenarios in held_by_family.items():
+        for row in run_sweep([winners[family]], scenarios).rows:
+            winner_rows.append(row["score"] | {"scenario": row["scenario"],
+                                               "config": row["label"],
+                                               "family": family})
+    learned_rows = evaluate_checkpoint(checkpoint, held_out)
+    learned_total = _lex_score(learned_rows)
+    winner_total = _lex_score(winner_rows)
+    learned_wins = learned_total < winner_total
+    # Pareto position: the whole grid re-scored on held-out, aggregated
+    # per configuration; is the learned point non-dominated?
+    held_grid = run_sweep(spec, held_out)
+    by_label: dict[str, list] = {}
+    for row in held_grid.rows:
+        by_label.setdefault(row["label"], []).append(row["score"])
+    axes = {
+        label: (
+            round(sum(r["max_depth"] for r in rows), 1),
+            sum(r["replica_changes"] for r in rows),
+        )
+        for label, rows in by_label.items()
+    }
+    learned_axis = (learned_total[0], learned_total[1])
+    dominated = any(
+        (d <= learned_axis[0] and c <= learned_axis[1])
+        and (d < learned_axis[0] or c < learned_axis[1])
+        for d, c in axes.values()
+    )
+    oracle_label = min(axes, key=lambda k: (axes[k][0], axes[k][1]))
+    sweep_s = time.perf_counter() - t0
+    if not learned_wins:
+        print(
+            f"learn: held-out gate failed — learned {learned_total} vs"
+            f" sweep winners {winner_total} (lexicographic depth, churn,"
+            f" SLO)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+
+    # -- 4. chaos gate ---------------------------------------------------
+    # The fault episodes log every injected failure at ERROR through the
+    # loop's never-dies handler; hundreds of expected lines would bury
+    # this suite's one-line verdict, so controller logging is quieted for
+    # the battery and restored after.
+    import logging
+
+    controller_log = logging.getLogger("kube_sqs_autoscaler_tpu")
+    previous_level = controller_log.level
+    controller_log.setLevel(logging.CRITICAL)
+    t0 = time.perf_counter()
+    chaos_rows = {}
+    regressions = []
+    try:
+        for scenario in chaos_battery():
+            reference = score_result(
+                LearnSimulation(
+                    LearnSimConfig(
+                        arrival_rate=scenario.arrival,
+                        service_rate_per_replica=(
+                            scenario.service_rate_per_replica
+                        ),
+                        duration=scenario.duration,
+                        initial_replicas=scenario.initial_replicas,
+                        min_pods=scenario.min_pods,
+                        max_pods=scenario.max_pods,
+                        loop=scenario.loop,
+                        faults=scenario.faults,
+                    )
+                ).run(),
+                scenario.slo_depth,
+            )
+            # The learned world is the SAME mapping training/evaluation
+            # used (rollout.learned_config), plus this scenario's fault
+            # plan — a hand-rebuilt config here would silently drift when
+            # SimConfig grows a field.
+            learned = score_result(
+                LearnSimulation(
+                    dataclasses.replace(
+                        learned_config(scenario, checkpoint),
+                        faults=scenario.faults,
+                    )
+                ).run(),
+                scenario.slo_depth,
+            )
+            chaos_rows[scenario.name] = {
+                "reference": reference,
+                "learned": learned,
+                "faulted": scenario.faults is not None,
+            }
+            if _lex_score([learned]) > _lex_score([reference]):
+                regressions.append(scenario.name)
+    finally:
+        # An exception mid-battery must not leave the package logger
+        # muted — it would suppress the diagnostics explaining it.
+        controller_log.setLevel(previous_level)
+    chaos_s = time.perf_counter() - t0
+    if regressions:
+        print(
+            f"learn: chaos gate failed — learned policy lexicographically"
+            f" worse than reactive on: {', '.join(regressions)}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+
+    # Every gate passed — only now publish the deployable artifact.
+    save_checkpoint(checkpoint_output, checkpoint)
+
+    depth_reduction = (
+        winner_total[0] / learned_total[0] if learned_total[0] else float("inf")
+    )
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "learn",
+        "elapsed_s": round(elapsed, 2),
+        "training": {
+            "config": {
+                "population": es.population,
+                "generations": es.generations,
+                "sigma": es.sigma,
+                "lr": es.lr,
+                "seed": es.seed,
+                "weights": {
+                    "depth": es.depth_weight,
+                    "churn": es.churn_weight,
+                    "slo": es.slo_weight,
+                    "replica_seconds": es.replica_weight,
+                },
+            },
+            "scenarios": [s.name for s in train_set],
+            "elapsed_s": round(train_s, 2),
+            "episodes_per_generation": (es.population + 1) * len(train_set),
+            "reward_first": round(result.reward_curve[0], 4),
+            "reward_best": round(max(result.reward_curve), 4),
+            "checkpoint": checkpoint_output,
+            "checkpoint_hash": checkpoint.hash,
+            "parameters": int(checkpoint.theta.size),
+        },
+        "fidelity": {
+            "episodes": fidelity.episodes,
+            "learned_episodes": len(extra),
+            "ticks": fidelity.ticks,
+            "divergences": len(fidelity.divergences),
+            "elapsed_s": round(fidelity_s, 2),
+        },
+        "held_out": {
+            "seed": LEARN_HELD_OUT_SEED,
+            "episodes": len(held_out),
+            "winners_on_train": {
+                name: point.label() for name, point in winners.items()
+            },
+            "learned_total": dict(
+                zip(("max_depth", "replica_changes", "time_over_slo_s"),
+                    learned_total)
+            ),
+            "winner_total": dict(
+                zip(("max_depth", "replica_changes", "time_over_slo_s"),
+                    winner_total)
+            ),
+            "learned_rows": learned_rows,
+            "winner_rows": winner_rows,
+            "pareto": {
+                "learned_on_front": not dominated,
+                "learned_depth_churn": list(learned_axis),
+                "oracle_best_on_held_out": {
+                    "config": oracle_label,
+                    "depth_churn": list(axes[oracle_label]),
+                },
+                "grid_points": len(axes),
+            },
+            "elapsed_s": round(sweep_s, 2),
+        },
+        "chaos": {
+            "regressions": regressions,
+            "rows": chaos_rows,
+            "elapsed_s": round(chaos_s, 2),
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    return {
+        "metric": "learn_held_out_max_depth_reduction",
+        "value": round(depth_reduction, 2),
+        "unit": (
+            f"x vs train-tuned sweep winners on {len(held_out)} held-out"
+            f" scenario variants ({fidelity.ticks} fidelity ticks,"
+            f" 0 divergences; chaos regressions 0)"
+        ),
+        "vs_baseline": round(depth_reduction, 2),
     }
 
 
@@ -1721,7 +2052,7 @@ if __name__ == "__main__":
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
-                 "serve", "fleet", "scale", "chaos-serve"),
+                 "serve", "fleet", "scale", "chaos-serve", "learn"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -1737,14 +2068,18 @@ if __name__ == "__main__":
         " independent engines (parity + one-dispatch-per-cycle + monotone"
         " gates); chaos-serve = shard-level chaos battery on the sharded"
         " plane (poison/wedge/mask-corruption episodes; exactly-once +"
-        " quarantine/probe + parity + TTFT/recovery gates)",
+        " quarantine/probe + parity + TTFT/recovery gates); learn = ES-train"
+        " a policy network in the compiled twin, then gate it (fidelity 0"
+        " divergences, beats train-tuned sweep winners on held-out scenario"
+        " variants, zero chaos regression)",
     )
     cli.add_argument(
         "--output", default="",
         help="artifact path for --suite forecast/replay/sweep/chaos/serve/"
-        "fleet/scale/chaos-serve (defaults: BENCH_r06.json / BENCH_r07.json"
-        " / BENCH_r08.json / BENCH_r09.json / BENCH_r10.json /"
-        " BENCH_r11.json / BENCH_r12.json / BENCH_r13.json)",
+        "fleet/scale/chaos-serve/learn (defaults: BENCH_r06.json /"
+        " BENCH_r07.json / BENCH_r08.json / BENCH_r09.json / BENCH_r10.json"
+        " / BENCH_r11.json / BENCH_r12.json / BENCH_r13.json /"
+        " BENCH_r14.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -1765,5 +2100,7 @@ if __name__ == "__main__":
         print(json.dumps(
             run_chaos_serve_suite(cli_args.output or "BENCH_r13.json")
         ))
+    elif cli_args.suite == "learn":
+        print(json.dumps(run_learn_suite(cli_args.output or "BENCH_r14.json")))
     else:
         print(json.dumps(run_bench()))

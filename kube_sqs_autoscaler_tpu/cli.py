@@ -154,11 +154,24 @@ def build_parser() -> argparse.ArgumentParser:
     # behavior; --policy=predictive thresholds the forecasted depth at
     # now + --forecast-horizon through the same gates.
     parser.add_argument(
-        "--policy", choices=("reactive", "predictive"), default="reactive",
+        "--policy", choices=("reactive", "predictive", "learned"),
+        default="reactive",
         help=(
             "Scaling policy: 'reactive' thresholds the observed queue depth "
             "(reference behavior); 'predictive' thresholds the forecasted "
-            "depth at now + --forecast-horizon"
+            "depth at now + --forecast-horizon; 'learned' thresholds a "
+            "trained network's up/hold/down decision (requires "
+            "--policy-checkpoint)"
+        ),
+    )
+    parser.add_argument(
+        "--policy-checkpoint", default="", metavar="PATH",
+        help=(
+            "Trained learned-policy checkpoint (versioned JSON from "
+            "`python -m kube_sqs_autoscaler_tpu.learn` or bench.py --suite "
+            "learn); validated at startup — a missing/corrupt/incompatible "
+            "file is rejected before the loop starts. Requires "
+            "--policy=learned"
         ),
     )
     parser.add_argument(
@@ -312,6 +325,35 @@ def validate_flag_interactions(parser: argparse.ArgumentParser,
             "completes at most one tick per poll period, so a healthy "
             "controller would fail the probe between ticks"
         )
+    if args.policy == "learned" and not args.policy_checkpoint:
+        parser.error(
+            "--policy=learned requires --policy-checkpoint (the trained "
+            "weights are a deployment artifact, not a default)"
+        )
+    if args.policy_checkpoint and args.policy != "learned":
+        parser.error(
+            "--policy-checkpoint only applies to --policy=learned "
+            f"(got --policy={args.policy})"
+        )
+
+
+def load_learned_checkpoint(parser: argparse.ArgumentParser,
+                            args: argparse.Namespace):
+    """Load + validate the learned checkpoint, or ``None`` when not learned.
+
+    Runs at startup, after :func:`validate_flag_interactions` and before
+    any client wiring: a missing, corrupt, wrong-kind, future-schema, or
+    geometry-mismatched checkpoint is a *usage error* (exit 2 with the
+    loader's operator-grade message), never a mid-tick traceback.
+    """
+    if args.policy != "learned":
+        return None
+    from .learn import CheckpointError, load_checkpoint
+
+    try:
+        return load_checkpoint(args.policy_checkpoint)
+    except CheckpointError as err:
+        parser.error(str(err))
 
 
 def main(argv: Sequence[str] | None = None) -> None:
@@ -320,6 +362,8 @@ def main(argv: Sequence[str] | None = None) -> None:
     parser = build_parser()
     args = parser.parse_args(argv)
     validate_flag_interactions(parser, args)
+    # Learned policy: reject a bad checkpoint NOW, not mid-tick.
+    checkpoint = load_learned_checkpoint(parser, args)
 
     # Imports deferred so the pure-control-plane modules (policy/loop/fakes)
     # never pull in the real-client stacks, mirroring the package split.
@@ -351,7 +395,13 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         metrics = ControllerMetrics(
             version=__version__,
-            policy=args.policy,
+            # build_info{policy}: the learned label carries the checkpoint
+            # content hash, so a scrape names exactly which weights run
+            policy=(
+                f"learned@{checkpoint.hash}"
+                if checkpoint is not None
+                else args.policy
+            ),
             forecaster=(
                 args.forecaster if args.policy == "predictive" else ""
             ),
@@ -373,13 +423,13 @@ def main(argv: Sequence[str] | None = None) -> None:
 
         journal = TickJournal(
             args.journal_path,
-            meta=_journal_meta(args),
+            meta=_journal_meta(args, checkpoint),
             max_bytes=args.journal_max_bytes,
         )
         observers.append(journal)
 
-    # Predictive policy: deferred import like the real-client stacks — the
-    # reactive control plane never pays the JAX import.
+    # Predictive/learned policies: deferred import like the real-client
+    # stacks — the reactive control plane never pays the JAX import.
     depth_policy = None
     if args.policy == "predictive":
         from .forecast import DepthHistory, PredictivePolicy, make_forecaster
@@ -391,6 +441,42 @@ def main(argv: Sequence[str] | None = None) -> None:
             horizon=args.forecast_horizon,
         )
         observers.append(history)  # fed from the tick-record observer hook
+    elif checkpoint is not None:
+        from .forecast import DepthHistory
+        from .learn import LearnedPolicy
+        from .learn.checkpoint import checkpoint_history
+
+        # The feature window is part of what the weights mean: it comes
+        # from the checkpoint (stamped at training time), not from
+        # --forecast-history.
+        history_size, min_samples = checkpoint_history(checkpoint)
+        depth_policy = LearnedPolicy(
+            checkpoint,
+            policy=config_from_args(args).policy,
+            poll_interval=args.poll_period,
+            max_pods=args.max_pods,
+            min_pods=args.min_pods,
+            scale_up_pods=args.scale_up_pods,
+            scale_down_pods=args.scale_down_pods,
+            # The controller never reads the deployment's size; the
+            # mirror tracks the same relative trajectory replay reports.
+            # Start it at min_pods — the training worlds all start at or
+            # above min_pods, and a mirror below it would jump UP on the
+            # first scale-DOWN clamp, feeding the network a replicas
+            # feature no training episode ever produced.
+            initial_replicas=args.min_pods,
+            history=DepthHistory(capacity=history_size),
+            min_samples=min_samples,
+        )
+        # the policy is its own observer: the tick-record hook feeds both
+        # the depth history and the replica/cooldown mirror
+        observers.append(depth_policy)
+        log.info(
+            "Loaded learned policy checkpoint %s (hash %s, hidden %d)",
+            args.policy_checkpoint,
+            checkpoint.hash,
+            checkpoint.hidden,
+        )
 
     if not observers:
         observer = None
@@ -432,7 +518,7 @@ def main(argv: Sequence[str] | None = None) -> None:
     log.info("kube-sqs-autoscaler stopped")
 
 
-def _journal_meta(args: argparse.Namespace) -> dict:
+def _journal_meta(args: argparse.Namespace, checkpoint=None) -> dict:
     """The flight journal's header meta for a live run: the controller
     config :mod:`.sim.replay` re-drives decisions from, plus the scaler
     world bounds the counterfactual re-scorer needs (a live journal has no
@@ -468,6 +554,11 @@ def _journal_meta(args: argparse.Namespace) -> dict:
             if args.policy == "predictive"
             else {}
         ),
+        # learned policy: the content hash names which weights ran, so
+        # replay can demand (and verify) the matching checkpoint
+        "learn": (
+            _learn_meta(args, checkpoint) if checkpoint is not None else {}
+        ),
         # enabled resilience knobs only (empty = reference failure
         # handling) — lets a journal reader see whether stale/retry/
         # breaker fields can appear in this episode's tick lines
@@ -487,6 +578,19 @@ def _journal_meta(args: argparse.Namespace) -> dict:
         "deployment": args.kubernetes_deployment,
         "namespace": args.kubernetes_namespace,
         "queue_url": args.sqs_queue_url,
+    }
+
+
+def _learn_meta(args: argparse.Namespace, checkpoint) -> dict:
+    from .learn.checkpoint import checkpoint_history
+
+    history, min_samples = checkpoint_history(checkpoint)
+    return {
+        "checkpoint_hash": checkpoint.hash,
+        "checkpoint_path": args.policy_checkpoint,
+        "hidden": int(checkpoint.hidden),
+        "history": history,
+        "min_samples": min_samples,
     }
 
 

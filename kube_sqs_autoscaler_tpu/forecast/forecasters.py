@@ -123,20 +123,14 @@ def holt_forecast(
 _holt_forecast = partial(jax.jit, static_argnames=())(holt_forecast)
 
 
-def lstsq_forecast(
-    times: jax.Array,
-    depths: jax.Array,
-    n: jax.Array,
-    horizon: jax.Array,
-    window: jax.Array,
-) -> jax.Array:
-    """Line fit over the last ``min(window, n)`` samples, extrapolated.
+def _lstsq_fit(times, depths, n, window):
+    """Normal-equations core of the windowed line fit.
 
-    Times are centered on the newest sample before the normal equations,
-    so the fit is conditioned regardless of the clock's epoch, and the
-    prediction is simply ``intercept + slope * horizon``.
-
-    Pure (see :func:`ewma_level` for the jit-free contract).
+    Returns ``(slope, intercept, depth_last, degenerate)``; shared by
+    :func:`lstsq_forecast` (the forecaster) and :func:`lstsq_slope` (a
+    trend *feature* for the learned policy, ``learn/``), so the fit
+    arithmetic exists exactly once and both consumers stay bit-identical
+    between the live jitted path and the compiled simulator's scan.
     """
     idx = jnp.arange(depths.shape[0])
     mask = (idx < n) & (idx >= n - window)
@@ -154,8 +148,42 @@ def lstsq_forecast(
     safe_denom = jnp.where(degenerate, 1.0, denom)
     slope = (count * sxy - sx * sy) / safe_denom
     intercept = (sy - slope * sx) / jnp.maximum(count, 1)
+    return slope, intercept, depth_last, degenerate
+
+
+def lstsq_forecast(
+    times: jax.Array,
+    depths: jax.Array,
+    n: jax.Array,
+    horizon: jax.Array,
+    window: jax.Array,
+) -> jax.Array:
+    """Line fit over the last ``min(window, n)`` samples, extrapolated.
+
+    Times are centered on the newest sample before the normal equations,
+    so the fit is conditioned regardless of the clock's epoch, and the
+    prediction is simply ``intercept + slope * horizon``.
+
+    Pure (see :func:`ewma_level` for the jit-free contract).
+    """
+    slope, intercept, depth_last, degenerate = _lstsq_fit(
+        times, depths, n, window
+    )
     fit = intercept + slope * horizon
     return jnp.maximum(jnp.where(degenerate, depth_last, fit), 0.0)
+
+
+def lstsq_slope(
+    times: jax.Array, depths: jax.Array, n: jax.Array, window: jax.Array
+) -> jax.Array:
+    """Fitted depth trend (msg/s) over the last ``min(window, n)`` samples.
+
+    The shared history *feature* the learned policy (``learn/``)
+    thresholds on: zero while degenerate (< 2 samples or coincident
+    times).  Pure; same centering contract as :func:`lstsq_forecast`.
+    """
+    slope, _, _, degenerate = _lstsq_fit(times, depths, n, window)
+    return jnp.where(degenerate, 0.0, slope)
 
 
 _lstsq_forecast = partial(jax.jit, static_argnames=())(lstsq_forecast)

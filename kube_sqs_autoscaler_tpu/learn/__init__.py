@@ -1,0 +1,68 @@
+"""Learned autoscaling policy: train in the compiled twin, deploy on the loop.
+
+ROADMAP item 2 (KIS-S, arxiv 2507.07932): the vmapped ``lax.scan``
+simulator (:mod:`..sim.compiled`) is an RL environment in all but name —
+thousands of (population × scenario) episodes evaluate in one device
+call, so a seeded evolution-strategies search over a tiny policy network
+costs seconds, not cluster-hours.  The package is four seams:
+
+- :mod:`.network` — the decision arithmetic, exactly once: features over
+  the shared ring-buffer history (``ewma_level``/``lstsq_slope``, the
+  forecasters' own pure functions), a one-hidden-layer MLP, and the
+  up/hold/down action expressed as an *effective queue depth* through
+  the untouched reference gates;
+- :mod:`.checkpoint` — the deployable artifact: versioned JSON with
+  load-time validation and a content hash that names exactly which
+  weights ran (journal meta, ``build_info{policy}``);
+- :mod:`.policy` — :class:`LearnedPolicy`, the
+  :class:`~..core.types.DepthPolicy` for the real ``ControlLoop``,
+  bit-identical to the compiled scan (``verify_fidelity``-gated);
+- :mod:`.rollout` / :mod:`.train` — population evaluation fused into the
+  compiled episode scan, and the antithetic-sampled ES loop on top.
+
+Exports resolve lazily: :mod:`..sim.compiled` imports :mod:`.network`
+(the shared decision function) while :mod:`.rollout` imports
+``sim.compiled`` (the shared episode scan) — eager re-exports here would
+make that mutual dependency a cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_EXPORTS = {
+    "LearnedPolicy": ("policy", "LearnedPolicy"),
+    "PolicyCheckpoint": ("checkpoint", "PolicyCheckpoint"),
+    "CheckpointError": ("checkpoint", "CheckpointError"),
+    "SCHEMA_VERSION": ("checkpoint", "SCHEMA_VERSION"),
+    "load_checkpoint": ("checkpoint", "load_checkpoint"),
+    "save_checkpoint": ("checkpoint", "save_checkpoint"),
+    "checkpoint_hash": ("checkpoint", "checkpoint_hash"),
+    "init_params": ("network", "init_params"),
+    "param_count": ("network", "param_count"),
+    "evaluate_population": ("rollout", "evaluate_population"),
+    "learned_config": ("rollout", "learned_config"),
+    "ESConfig": ("train", "ESConfig"),
+    "train": ("train", "train"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    module = importlib.import_module(f".{module_name}", __name__)
+    value = getattr(module, attr)
+    globals()[name] = value  # cache: subsequent lookups skip __getattr__
+    return value
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_EXPORTS))

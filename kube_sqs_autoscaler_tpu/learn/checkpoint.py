@@ -1,0 +1,246 @@
+"""Versioned JSON checkpoints for the learned autoscaling policy.
+
+A checkpoint is the *deployable artifact*: the flat float32 parameter
+vector plus the network geometry and feature-schema pins that give those
+numbers meaning.  JSON on purpose — a policy small enough to train in the
+compiled twin (~200 floats) does not need a binary format, and an
+operator diffing two checkpoints in a code review should see numbers,
+not bytes.
+
+Round-trip exactness: parameters are float32, and every float32 is
+exactly representable as a JSON double, so ``save → load`` reproduces
+``theta`` bit-for-bit — :class:`~.policy.LearnedPolicy` decisions are
+bitwise identical across the round trip (pinned in tests).
+
+Validation happens at **load time, before the loop starts**: a missing
+file, corrupt JSON, wrong kind, unknown schema version (including a
+*future* one), geometry/parameter-count mismatch, or non-finite weights
+all raise :class:`CheckpointError` with an operator-grade message — never
+a mid-tick traceback.
+
+``checkpoint_hash`` fingerprints the decision-relevant content
+(canonical JSON of kind/schema/geometry/theta plus the effective
+feature-window pins — free-form provenance metadata excluded).  The CLI
+stamps it into ``build_info{policy}`` and the flight-journal meta so a
+replayed incident knows exactly which weights ran.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from .network import DEFAULT_HIDDEN, N_FEATURES, param_count
+
+#: Current checkpoint schema.  Version 1: flat one-hidden-layer MLP over
+#: the 8-feature vector (``network.policy_features``'s declaration
+#: order).  Bump ONLY with a loader for every prior version.
+SCHEMA_VERSION = 1
+
+#: ``kind`` discriminator: rejects feeding some other JSON artifact
+#: (a BENCH file, a journal header) to ``--policy-checkpoint``.
+KIND = "kube-sqs-autoscaler-tpu/learned-policy"
+
+
+class CheckpointError(ValueError):
+    """A checkpoint failed validation (missing/corrupt/incompatible)."""
+
+
+#: History-ring capacity the learned features run on, train and deploy.
+#: Smaller than the forecasters' 128 default on purpose: the feature set
+#: (EWMA level, 12-sample trend) saturates well below 64 samples, and
+#: the scan's per-tick history roll is O(capacity) — at 64 a training
+#: generation is ~2× cheaper.  Stamped into checkpoint meta by the
+#: trainer so deployment rebuilds the identical feature window.
+DEFAULT_HISTORY = 64
+
+#: Reactive warm-up ticks before the network decides (same contract as
+#: ``PredictivePolicy``); stamped into checkpoint meta alongside history.
+DEFAULT_MIN_SAMPLES = 3
+
+
+def checkpoint_history(checkpoint: PolicyCheckpoint) -> tuple[int, int]:
+    """(history capacity, min_samples) a checkpoint was trained with.
+
+    Read from checkpoint meta (the trainer stamps both); the defaults
+    cover hand-built checkpoints.  Deployment MUST use these — the EWMA
+    level feature sees the whole ring, so a different capacity silently
+    changes what the trained weights mean.
+    """
+    return (
+        int(checkpoint.meta.get("forecast_history", DEFAULT_HISTORY)),
+        int(checkpoint.meta.get("min_samples", DEFAULT_MIN_SAMPLES)),
+    )
+
+
+@dataclass(frozen=True)
+class PolicyCheckpoint:
+    """One loaded (or freshly trained) policy checkpoint."""
+
+    theta: np.ndarray  # float32, param_count(hidden)
+    hidden: int = DEFAULT_HIDDEN
+    #: provenance: trainer config, seeds, scenario names, reward weights —
+    #: free-form, excluded from the content hash
+    meta: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        theta = np.ascontiguousarray(self.theta, dtype=np.float32)
+        object.__setattr__(self, "theta", theta)
+        if self.hidden < 1:
+            raise CheckpointError(f"hidden must be >= 1, got {self.hidden}")
+        expected = param_count(self.hidden)
+        if theta.shape != (expected,):
+            raise CheckpointError(
+                f"theta has {theta.size} parameters; hidden={self.hidden}"
+                f" needs exactly {expected}"
+            )
+        if not np.isfinite(theta).all():
+            raise CheckpointError("theta contains non-finite values")
+        # The feature-window pins are decision-relevant (read by
+        # checkpoint_history and hashed): a malformed value must be a
+        # CheckpointError here, not an int() traceback mid-deployment.
+        if not isinstance(self.meta, dict):
+            raise CheckpointError(f"meta must be a mapping, got {self.meta!r}")
+        for key, floor in (("forecast_history", 1), ("min_samples", 0)):
+            if key in self.meta:
+                value = self.meta[key]
+                if (
+                    not isinstance(value, int)
+                    or isinstance(value, bool)
+                    or value < floor
+                ):
+                    raise CheckpointError(
+                        f"meta[{key!r}] must be an integer >= {floor},"
+                        f" got {value!r}"
+                    )
+
+    @property
+    def hash(self) -> str:
+        """Content fingerprint (first 12 hex of sha256; see module doc)."""
+        return checkpoint_hash(self)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "kind": KIND,
+            "schema": SCHEMA_VERSION,
+            "hidden": int(self.hidden),
+            "n_features": N_FEATURES,
+            "theta": [float(w) for w in self.theta],
+            "meta": self.meta,
+        }
+
+
+def checkpoint_hash(checkpoint: PolicyCheckpoint) -> str:
+    """sha256 over the canonical decision-relevant JSON, truncated to 12
+    hex chars (enough to discriminate checkpoints in a label value).
+
+    float32 -> Python float -> ``json.dumps`` is exact (every float32 is
+    a representable double with an exact shortest-repr), so two
+    checkpoints hash equal iff their decisions are bitwise equal.  The
+    effective feature-window pins (``checkpoint_history``) are hashed
+    too: the EWMA level feature sees the whole ring, so identical theta
+    over a different window is a *different policy* — free-form
+    provenance in ``meta`` stays excluded.
+    """
+    history, min_samples = checkpoint_history(checkpoint)
+    content = {
+        "kind": KIND,
+        "schema": SCHEMA_VERSION,
+        "hidden": int(checkpoint.hidden),
+        "n_features": N_FEATURES,
+        "forecast_history": history,
+        "min_samples": min_samples,
+        "theta": [float(w) for w in checkpoint.theta],
+    }
+    canonical = json.dumps(content, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest()[:12]
+
+
+def save_checkpoint(path: str, checkpoint: PolicyCheckpoint) -> str:
+    """Write ``checkpoint`` as versioned JSON; returns its content hash.
+
+    Write-then-rename so a crash mid-write never leaves a torn file where
+    a valid checkpoint used to be (the loader would reject the torn tail,
+    but the *previous* weights would be gone).
+    """
+    data = checkpoint.to_dict()
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return checkpoint_hash(checkpoint)
+
+
+def load_checkpoint(path: str) -> PolicyCheckpoint:
+    """Load + validate a checkpoint; :class:`CheckpointError` on any defect.
+
+    Every message names the path and the specific failure — this runs at
+    CLI startup, where "reject before the loop starts" is the contract
+    (a corrupt checkpoint must never surface as a mid-tick policy error
+    silently falling back to reactive).
+    """
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        raise CheckpointError(f"checkpoint {path!r} does not exist") from None
+    except (OSError, json.JSONDecodeError) as err:
+        raise CheckpointError(
+            f"checkpoint {path!r} is unreadable or corrupt: {err}"
+        ) from None
+    if not isinstance(data, dict):
+        raise CheckpointError(
+            f"checkpoint {path!r} is not a JSON object"
+        )
+    if data.get("kind") != KIND:
+        raise CheckpointError(
+            f"checkpoint {path!r} has kind {data.get('kind')!r}, expected"
+            f" {KIND!r} (is this really a learned-policy checkpoint?)"
+        )
+    schema = data.get("schema")
+    if not isinstance(schema, int) or schema < 1:
+        raise CheckpointError(
+            f"checkpoint {path!r} has invalid schema version {schema!r}"
+        )
+    if schema > SCHEMA_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path!r} has schema version {schema}, newer than"
+            f" this build supports ({SCHEMA_VERSION}) — upgrade the"
+            " controller or re-train the policy"
+        )
+    if data.get("n_features") != N_FEATURES:
+        raise CheckpointError(
+            f"checkpoint {path!r} was trained on"
+            f" {data.get('n_features')!r} features; this build's feature"
+            f" vector has {N_FEATURES} — re-train"
+        )
+    hidden = data.get("hidden")
+    if not isinstance(hidden, int):
+        raise CheckpointError(
+            f"checkpoint {path!r} has invalid hidden size {hidden!r}"
+        )
+    theta = data.get("theta")
+    if not isinstance(theta, list) or not all(
+        isinstance(w, (int, float)) and math.isfinite(w) for w in theta
+    ):
+        raise CheckpointError(
+            f"checkpoint {path!r} theta must be a list of finite numbers"
+        )
+    meta = data.get("meta") or {}
+    if not isinstance(meta, dict):
+        raise CheckpointError(f"checkpoint {path!r} meta must be an object")
+    try:
+        return PolicyCheckpoint(
+            theta=np.asarray(theta, dtype=np.float32),
+            hidden=hidden,
+            meta=meta,
+        )
+    except CheckpointError as err:
+        raise CheckpointError(f"checkpoint {path!r}: {err}") from None

@@ -1,0 +1,225 @@
+"""The learned autoscaling policy's decision arithmetic, exactly once.
+
+A tiny MLP maps a fixed feature vector — observed depth, ring-buffer
+history features (EWMA level + fitted trend, the same pure functions the
+forecasters run), tracked replicas, and the two cooldown states — to one
+of three actions: *scale down*, *hold*, *scale up*.  The action is then
+expressed through the existing :class:`~..core.types.DepthPolicy` seam as
+an **effective queue depth**: ``scale_up_messages`` to trip the up gate,
+``scale_down_messages`` to trip the down gate, or a value strictly
+between the thresholds to trip neither (:func:`hold_depth`).  Everything
+downstream — inclusive thresholds, strictly-After cooldowns, the
+up-cooling ``continue``, bound clamps — is the untouched reference gate
+logic, so the network can decide *when* to scale but can never violate a
+cooldown or a bound (the same guarantee :class:`~..forecast.predictive.
+PredictivePolicy` rides).
+
+**The fidelity contract.**  Training evaluates thousands of episodes
+inside the compiled ``lax.scan`` simulator (:mod:`..sim.compiled`);
+deployment runs one decision per tick on the real ``ControlLoop``.  Both
+paths call :func:`learned_decision` — the same float32 ops in the same
+order, the ``ewma_level``/``lstsq_slope`` pure functions shared with the
+forecasters — so ``verify_fidelity`` can hold the learned policy to the
+same 0-divergence gate every hand-written policy passes.  Keep this
+module free of anything the scan cannot trace (no Python branches on
+traced values, no host state).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..forecast.forecasters import ewma_level, lstsq_slope
+
+#: Action codes — the argmax index over the network's output logits.
+ACTION_DOWN, ACTION_HOLD, ACTION_UP = 0, 1, 2
+N_ACTIONS = 3
+
+#: The fixed feature vector (all float32, assembled in
+#: :func:`policy_features` — keep the docstring there in sync).
+N_FEATURES = 8
+
+#: Checkpoint/network geometry default.
+DEFAULT_HIDDEN = 16
+
+#: History-feature smoothing parameters.  Deliberately the live
+#: forecasters' defaults (``EwmaForecaster.alpha``,
+#: ``LeastSquaresForecaster.window``) but pinned HERE as independent
+#: constants: the features are part of the checkpoint schema — retuning a
+#: forecaster default must never silently change what a saved policy's
+#: weights mean.
+FEATURE_ALPHA = 0.3
+FEATURE_WINDOW = 12
+
+
+def param_count(hidden: int = DEFAULT_HIDDEN) -> int:
+    """Flat parameter vector length for one hidden layer of ``hidden``."""
+    return hidden * N_FEATURES + hidden + N_ACTIONS * hidden + N_ACTIONS
+
+
+def init_params(seed: int, hidden: int = DEFAULT_HIDDEN) -> np.ndarray:
+    """Seeded float32 init (scaled normal) — deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    theta = rng.standard_normal(param_count(hidden)).astype(np.float32)
+    # modest fan-in scaling keeps tanh out of saturation at init
+    theta[: hidden * N_FEATURES] *= np.float32(0.5 / np.sqrt(N_FEATURES))
+    theta[hidden * N_FEATURES :] *= np.float32(0.5 / np.sqrt(hidden))
+    return theta
+
+
+def hold_depth(scale_up_messages: int, scale_down_messages: int) -> int:
+    """An effective depth that trips *neither* gate.
+
+    Strictly between the inclusive thresholds when the config leaves room
+    (the reference default 10 < 55 < 100); with touching/inverted
+    thresholds there is no neutral value, so the deterministic fallback
+    ``down + 1`` applies (both the live policy and the compiled scan use
+    this same function, so they agree even then).
+    """
+    up, down = int(scale_up_messages), int(scale_down_messages)
+    hold = (up + down) // 2
+    if not down < hold < up:
+        hold = down + 1
+    return hold
+
+
+def policy_logits(theta: jax.Array, features: jax.Array, hidden: int) -> jax.Array:
+    """MLP forward: ``features (F,) -> logits (3,)``; ``theta`` flat.
+
+    The matvecs are written as broadcast-multiply + ``jnp.sum`` — the
+    exact reduction pattern :func:`~..forecast.forecasters.lstsq_forecast`
+    already proves bit-stable between the live jitted path and the
+    vmapped compiled scan — rather than ``jnp.dot``, whose lowering may
+    differ between those contexts.
+    """
+    f = N_FEATURES
+    o = 0
+    w1 = theta[o : o + hidden * f].reshape(hidden, f)
+    o += hidden * f
+    b1 = theta[o : o + hidden]
+    o += hidden
+    w2 = theta[o : o + N_ACTIONS * hidden].reshape(N_ACTIONS, hidden)
+    o += N_ACTIONS * hidden
+    b2 = theta[o : o + N_ACTIONS]
+    h = jnp.tanh(jnp.sum(w1 * features[None, :], axis=1) + b1)
+    return jnp.sum(w2 * h[None, :], axis=1) + b2
+
+
+def policy_features(
+    times32: jax.Array,
+    depths32: jax.Array,
+    n: jax.Array,
+    observed: jax.Array,
+    replicas: jax.Array,
+    frac_up32: jax.Array,
+    frac_down32: jax.Array,
+    scale_up_messages: jax.Array,
+    max_pods: jax.Array,
+    poll32: jax.Array,
+    alpha32: jax.Array,
+    window: jax.Array,
+) -> jax.Array:
+    """The fixed ``(8,)`` float32 feature vector, in declaration order:
+
+    0. observed depth / up threshold (how far through the gate band);
+    1. EWMA depth level / up threshold (:func:`ewma_level`, the shared
+       forecaster smoothing — history's recency-weighted baseline);
+    2. fitted depth trend × poll interval / up threshold
+       (:func:`lstsq_slope`: depth change per tick, sign carries
+       ramp-vs-drain);
+    3. replicas / max pods (how much actuation headroom remains);
+    4. remaining up-cooldown fraction (1 = just fired, 0 = armed);
+    5. remaining down-cooldown fraction;
+    6. ``log1p(observed)/10`` (scale-free backlog magnitude — the
+       normalized features saturate above the up threshold);
+    7. constant 1 (lets ES shape pure biases through the input layer).
+
+    ``times32`` must be centered on the newest sample
+    (:func:`~..forecast.forecasters._center_times` semantics), exactly as
+    the forecasters require.
+    """
+    obs32 = observed.astype(jnp.float32)
+    up_scale = jnp.maximum(scale_up_messages, 1).astype(jnp.float32)
+    pods_scale = jnp.maximum(max_pods, 1).astype(jnp.float32)
+    level = ewma_level(depths32, n, alpha32)
+    slope = lstsq_slope(times32, depths32, n, window)
+    return jnp.stack(
+        [
+            obs32 / up_scale,
+            level / up_scale,
+            slope * poll32 / up_scale,
+            replicas.astype(jnp.float32) / pods_scale,
+            frac_up32,
+            frac_down32,
+            jnp.log1p(obs32) * jnp.float32(0.1),
+            jnp.asarray(1.0, jnp.float32),
+        ]
+    )
+
+
+def learned_decision(
+    theta: jax.Array,
+    times32: jax.Array,
+    depths32: jax.Array,
+    n: jax.Array,
+    observed: jax.Array,
+    replicas: jax.Array,
+    frac_up32: jax.Array,
+    frac_down32: jax.Array,
+    scale_up_messages: jax.Array,
+    scale_down_messages: jax.Array,
+    hold: jax.Array,
+    min_samples: jax.Array,
+    max_pods: jax.Array,
+    poll32: jax.Array,
+    alpha32: jax.Array,
+    window: jax.Array,
+    *,
+    hidden: int,
+) -> jax.Array:
+    """One tick's effective depth (int32) from history + state features.
+
+    Below ``min_samples`` history observations the policy passes the
+    observed depth through unchanged — the same reactive warm-up contract
+    as :class:`~..forecast.predictive.PredictivePolicy`, so a fresh
+    controller behaves exactly like the reference until it has signal.
+    The result is clamped to ``>= 0`` (the loop clamps its side too, so
+    the compiled scan must match).
+    """
+    features = policy_features(
+        times32, depths32, n, observed, replicas, frac_up32, frac_down32,
+        scale_up_messages, max_pods, poll32, alpha32, window,
+    )
+    logits = policy_logits(theta, features, hidden)
+    action = jnp.argmax(logits)
+    decision = jnp.where(
+        action == ACTION_UP,
+        scale_up_messages,
+        jnp.where(action == ACTION_DOWN, scale_down_messages, hold),
+    )
+    warmed = n >= min_samples
+    return jnp.maximum(0, jnp.where(warmed, decision, observed)).astype(
+        jnp.int32
+    )
+
+
+def cooldown_fraction(last: float, cooldown: float, now: float) -> float:
+    """Remaining-cooldown fraction in [0, 1], computed in float64.
+
+    ``((last + cooldown) - now) / cooldown`` with the zero floors — the
+    *host-side* twin of the expression the compiled scan evaluates in
+    float64 under ``enable_x64`` (plain adds and one divide: IEEE-exact
+    in both, so the float32 feature cast downstream sees identical
+    values).  Kept outside the jitted decision function on purpose: the
+    live forecasters jit at float32, where an epoch-sized ``now`` would
+    lose the seconds that matter.
+    """
+    if cooldown <= 0:
+        return 0.0
+    remaining = (last + cooldown) - now
+    if remaining <= 0:
+        return 0.0
+    return remaining / cooldown

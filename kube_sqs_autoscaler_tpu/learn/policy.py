@@ -1,0 +1,162 @@
+"""The learned policy on the real control loop.
+
+:class:`LearnedPolicy` is a :class:`~..core.types.DepthPolicy` — exactly
+the seam :class:`~..forecast.predictive.PredictivePolicy` rides — so the
+loop code does not know the decision came from a network: the policy
+returns an *effective queue depth* and the untouched reference gates
+(inclusive thresholds, strictly-After cooldowns, the up-cooling
+``continue``, success-only timestamp advancement) do the rest.  Whatever
+the weights say, a learned episode can never violate a bound or a
+cooldown the reactive episode respects.
+
+The feature vector needs state the ``DepthPolicy`` call does not carry —
+the replica count and the two cooldown stamps — so the policy also
+implements :class:`~..core.events.TickObserver` and mirrors that state
+from the per-tick record, the same arithmetic the gates and
+``PodAutoScaler`` apply (``record.scaled``: gate FIRE + no actuation
+error, boundary no-ops included).  Against the simulator this mirror is
+exact, which is what lets :func:`~..sim.compiled.verify_fidelity` hold
+the live policy to the compiled scan tick-for-tick.  On a live cluster
+the replica count is the same *relative* trajectory replay reports for
+live journals (the controller never reads the deployment's size; it
+starts from ``initial_replicas`` and folds in its own actuations).
+
+Decision arithmetic lives in :func:`~.network.learned_decision` — one
+pure function shared verbatim with the compiled scan — wrapped here in
+the same ``jax.jit``-at-float32 convention as the live forecasters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+from ..core.events import TickRecord
+from ..core.policy import PolicyConfig
+from ..forecast.forecasters import _center_times
+from ..forecast.history import DepthHistory
+from .checkpoint import PolicyCheckpoint
+from .network import (
+    FEATURE_ALPHA,
+    FEATURE_WINDOW,
+    cooldown_fraction,
+    hold_depth,
+    learned_decision,
+)
+
+_learned_decision = partial(jax.jit, static_argnames=("hidden",))(
+    learned_decision
+)
+
+
+class LearnedPolicy:
+    """Threshold the gates on a trained network's up/hold/down decision.
+
+    One instance drives one episode (like ``PredictivePolicy``'s history,
+    the mirrored cooldown/replica state is episode-local).  Wire it into
+    ``ControlLoop(depth_policy=policy, observer=policy)`` — the observer
+    hook feeds both the depth history and the replica/cooldown mirror.
+    """
+
+    def __init__(
+        self,
+        checkpoint: PolicyCheckpoint,
+        *,
+        policy: PolicyConfig,
+        poll_interval: float,
+        max_pods: int,
+        min_pods: int = 1,
+        scale_up_pods: int = 1,
+        scale_down_pods: int = 1,
+        initial_replicas: int = 1,
+        history: DepthHistory | None = None,
+        min_samples: int = 3,
+    ) -> None:
+        self.checkpoint = checkpoint
+        self.policy = policy
+        self.poll_interval = float(poll_interval)
+        self.max_pods = int(max_pods)
+        self.min_pods = int(min_pods)
+        self.scale_up_pods = int(scale_up_pods)
+        self.scale_down_pods = int(scale_down_pods)
+        self.history = history if history is not None else DepthHistory()
+        # reactive warm-up below min_samples, same floor as PredictivePolicy
+        self.min_samples = max(2, int(min_samples))
+        self.name = f"learned@{checkpoint.hash}"
+        self._theta = checkpoint.theta
+        self._hidden = int(checkpoint.hidden)
+        self._hold = hold_depth(
+            policy.scale_up_messages, policy.scale_down_messages
+        )
+        self.replicas = int(initial_replicas)
+        # Cooldown mirror: the loop's initial_state(now) sets both stamps
+        # at run() start, one poll interval BEFORE the first tick (sleep
+        # first, then poll) — lazily initialized at the first call since
+        # the policy cannot see the loop's start instant.
+        self._last_up: float | None = None
+        self._last_down: float | None = None
+        #: scoreboard for the observability layer (same field the
+        #: predictive policy exports: the depth the gates thresholded)
+        self.last_prediction: int | None = None
+
+    def effective_messages(self, now: float, num_messages: int) -> int:
+        if self._last_up is None:
+            self._last_up = now - self.poll_interval
+            self._last_down = now - self.poll_interval
+        times, depths, n = self.history.with_sample(now, float(num_messages))
+        frac_up = cooldown_fraction(
+            self._last_up, self.policy.scale_up_cooldown, now
+        )
+        frac_down = cooldown_fraction(
+            self._last_down, self.policy.scale_down_cooldown, now
+        )
+        decision = int(
+            _learned_decision(
+                self._theta,
+                # f64 centering before the float32 jit boundary, exactly
+                # the forecasters' convention (_center_times docstring)
+                np.asarray(_center_times(times, n)),
+                np.asarray(depths),
+                n,
+                int(num_messages),
+                self.replicas,
+                np.float32(frac_up),
+                np.float32(frac_down),
+                self.policy.scale_up_messages,
+                self.policy.scale_down_messages,
+                self._hold,
+                self.min_samples,
+                self.max_pods,
+                np.float32(self.poll_interval),
+                np.float32(FEATURE_ALPHA),
+                FEATURE_WINDOW,
+                hidden=self._hidden,
+            )
+        )
+        self.last_prediction = decision
+        return decision
+
+    def on_tick(self, record: TickRecord) -> None:
+        """Mirror the world the features describe, from the tick record.
+
+        History: successful fresh observations only (stale-held depths
+        are an old observation at a new timestamp — same exclusion as
+        ``DepthHistory.on_tick``).  Replicas/cooldowns: every successful
+        actuation, stale ticks included (the gates really fired there),
+        with ``PodAutoScaler``'s exact clamp arithmetic and the
+        reference's success-only stamp advancement.
+        """
+        self.history.on_tick(record)
+        if record.scaled("up"):
+            self.replicas = min(
+                self.max_pods, self.replicas + self.scale_up_pods
+            )
+            self._last_up = record.start
+        if record.scaled("down"):
+            self.replicas = max(
+                self.min_pods, self.replicas - self.scale_down_pods
+            )
+            self._last_down = record.start

@@ -181,8 +181,10 @@ class ControllerMetrics:
             if self._queue_messages is not None:
                 lines.append(f"{_PREFIX}_queue_messages {self._queue_messages}")
             lines += [
-                f"# HELP {_PREFIX}_predicted_queue_messages Forecasted depth"
-                " at now + horizon (predictive policy only).",
+                f"# HELP {_PREFIX}_predicted_queue_messages Effective depth"
+                " the depth policy substituted this tick: the forecast at"
+                " now + horizon (predictive) or the network's decision"
+                " depth (learned).",
                 f"# TYPE {_PREFIX}_predicted_queue_messages gauge",
             ]
             if self._predicted_messages is not None:

@@ -35,6 +35,9 @@ from .scenarios import (
     DiurnalArrival,
     RampArrival,
     StepArrival,
+    arrival_variant,
+    scenario_variants,
+    variant_bounds,
 )
 from .simulator import SimConfig, SimResult, Simulation
 
@@ -48,4 +51,7 @@ __all__ = [
     "RampArrival",
     "DiurnalArrival",
     "BurstArrival",
+    "arrival_variant",
+    "scenario_variants",
+    "variant_bounds",
 ]

@@ -73,11 +73,17 @@ from ..forecast.forecasters import (
     holt_forecast,
     lstsq_forecast,
 )
+from ..learn.network import FEATURE_ALPHA, FEATURE_WINDOW, hold_depth, learned_decision
 from .replay import Divergence
 from .simulator import SimConfig, SimResult, Simulation
 
 #: forecaster name -> policy kind inside the scan (0 = reactive)
 FORECASTER_KINDS = {"ewma": 1, "holt": 2, "lstsq": 3}
+
+#: the learned policy's kind code (``learn/``): the scan calls the same
+#: :func:`~..learn.network.learned_decision` the live ``LearnedPolicy``
+#: jits, so fidelity is checkable for trained networks too.
+LEARNED_KIND = 4
 
 
 def _tick_times_and_arrivals(
@@ -136,20 +142,26 @@ _cached_times_and_arrivals = lru_cache(maxsize=128)(
 )
 
 
-def encode_config(config: SimConfig) -> dict[str, Any]:
+def encode_config(config: SimConfig, slo_depth: float = 0.0) -> dict[str, Any]:
     """One :class:`~.simulator.SimConfig` as the scan's parameter row.
 
     Everything dynamic (thresholds, cooldowns, rates, forecast knobs) is a
     numpy scalar so rows stack into a vmap batch; the per-tick times and
     arrival integrals ride along as ``(ticks,)`` arrays
     (:func:`_tick_times_and_arrivals`); the static shape knobs — tick
-    count and history capacity — stay on the Python side
-    (:func:`episode_ticks`, ``config.forecast_history``).
+    count, history capacity, and the learned network's hidden width —
+    stay on the Python side (:func:`episode_ticks`,
+    ``config.forecast_history``, ``config.learned_checkpoint.hidden``).
 
     ``seed_const`` marks the seed's plain-float ``arrival_rate`` config
     style, which uses a *different* depth-update expression than
     ``ConstantArrival`` (net-rate form vs arrived-minus-drained) —
     numerically equal but not bit-identical, and fidelity is bit-level.
+
+    ``slo_depth`` feeds the scan's *in-episode* time-over-SLO
+    accumulator, which only the summary-consuming paths (ES training,
+    :mod:`..learn.rollout`) read; trajectory consumers keep scoring on
+    the host via ``score_result``, so the default 0.0 is inert.
     """
     times, arrived = _tick_times_and_arrivals(config, episode_ticks(config))
     policy = config.loop.policy
@@ -182,8 +194,29 @@ def encode_config(config: SimConfig) -> dict[str, Any]:
         "window": np.int32(1),
         "min_samples": np.int32(max(2, int(config.forecast_min_samples))),
         "conservative": np.bool_(config.forecast_conservative),
+        # learned-policy row params (inert placeholders on other rows so
+        # every row keeps the same pytree structure; run_episodes pads
+        # theta to the batch's common length)
+        "theta": np.zeros(1, np.float32),
+        "hold": np.int32(
+            hold_depth(policy.scale_up_messages, policy.scale_down_messages)
+        ),
+        "poll32": np.float32(config.loop.poll_interval),
+        "slo_depth": np.float64(slo_depth),
     }
-    if config.policy == "predictive":
+    if config.policy == "learned":
+        checkpoint = config.learned_checkpoint
+        if checkpoint is None:
+            raise ValueError(
+                "policy='learned' requires SimConfig.learned_checkpoint"
+            )
+        row["policy_kind"] = np.int32(LEARNED_KIND)
+        row["theta"] = np.asarray(checkpoint.theta, np.float32)
+        # the history features are part of the checkpoint schema — pinned
+        # constants in learn.network, NOT the live forecaster defaults
+        row["alpha"] = np.float32(FEATURE_ALPHA)
+        row["window"] = np.int32(FEATURE_WINDOW)
+    elif config.policy == "predictive":
         name = config.forecaster
         if name not in FORECASTER_KINDS:
             raise ValueError(
@@ -214,18 +247,40 @@ def episode_ticks(config: SimConfig) -> int:
     return max(1, int(config.duration / config.loop.poll_interval))
 
 
-def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
+def _episode(
+    p: dict[str, Any],
+    ticks: int,
+    capacity: int,
+    predictive: bool,
+    hidden: int = 0,
+    trajectory: bool = True,
+):
     """One closed-loop episode as a single ``lax.scan`` over ticks.
 
     Carry = (clock, depth, replicas, cooldown stamps, forecast history,
-    running max depth) — the entire state the Python stack spreads across
-    ``FakeClock``/``Simulation``/``PolicyState``/``DepthHistory``.
+    running max depth, episode-score accumulators) — the entire state the
+    Python stack spreads across ``FakeClock``/``Simulation``/
+    ``PolicyState``/``DepthHistory`` plus the summary arithmetic
+    ``score_result`` runs on the host.
+
+    ``hidden > 0`` compiles the learned-policy branch (``learn/``): rows
+    with ``policy_kind == LEARNED_KIND`` threshold the gates on
+    :func:`~..learn.network.learned_decision` over ``p["theta"]`` — the
+    same pure function the live ``LearnedPolicy`` jits.  ``trajectory``
+    selects per-tick outputs; ``False`` returns summaries only, so a
+    training population of thousands of episodes transfers a handful of
+    scalars per episode instead of ``O(ticks)`` arrays
+    (:mod:`..learn.rollout`).
     """
     idx = jnp.arange(capacity)
+    learned = hidden > 0
 
     def tick(carry, xs):
         t_new, arrived = xs
-        t, depth, replicas, last_up, last_down, h_t, h_d, h_n, max_depth = carry
+        (
+            t, depth, replicas, last_up, last_down, h_t, h_d, h_n,
+            max_depth, prev_obs, over_slo, prev_reps, changes, replica_s,
+        ) = carry
         # -- sleep first, then poll (main.go:41): the tick's clock reads
         # all happen at t_new (FakeClock does not advance inside a tick;
         # t_new comes precomputed from the host with FakeClock's exact
@@ -243,8 +298,19 @@ def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
         max_depth = jnp.maximum(max_depth, depth_new)
         observed = jnp.floor(depth_new).astype(jnp.int32)
 
+        # -- episode-score accumulators, the host scorer's exact forms:
+        # time_over is a left rule over the observation timeline (the
+        # interval ending now is credited to the PREVIOUS observation;
+        # prev_obs starts at -1 so the pre-first-observation interval
+        # never counts), replica_changes counts ticks whose ENTERING
+        # count changed vs the previous tick, replica-seconds integrates
+        # the fluid world's piecewise-constant replica count.
+        over_slo = over_slo + dt * (prev_obs > p["slo_depth"])
+        changes = changes + (replicas != prev_reps).astype(jnp.int32)
+        replica_s = replica_s + reps_f * dt
+
         decision = observed
-        if predictive:
+        if predictive or learned:
             # -- history snapshot including the current observation:
             # DepthHistory.with_sample's exact semantics (append when not
             # full, padding the tail with the newest sample; shift-in when
@@ -267,31 +333,84 @@ def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
             # so centering on [-1] is _center_times centering on n-1
             times32 = (snap_t - snap_t[-1]).astype(jnp.float32)
             depths32 = snap_d.astype(jnp.float32)
-            pred_ewma = jnp.maximum(0.0, ewma_level(depths32, n, p["alpha"]))
-            pred_holt = holt_forecast(
-                times32, depths32, n, p["horizon"], p["alpha"], p["beta"]
-            )
-            pred_lstsq = lstsq_forecast(
-                times32, depths32, n, p["horizon"], p["window"]
-            )
-            predicted = jnp.where(
-                p["policy_kind"] == 1,
-                pred_ewma,
-                jnp.where(p["policy_kind"] == 2, pred_holt, pred_lstsq),
-            )
-            # PredictivePolicy: max(0, int(round(.))), conservative gates
-            # see max(observed, forecast), reactive warm-up below
-            # min_samples
-            prediction = jnp.maximum(0, jnp.round(predicted).astype(jnp.int32))
-            effective = jnp.where(
-                p["conservative"],
-                jnp.maximum(observed, prediction),
-                prediction,
-            )
-            warmed = n >= p["min_samples"]
-            decision = jnp.where(
-                (p["policy_kind"] > 0) & warmed, effective, observed
-            )
+            if predictive:
+                pred_ewma = jnp.maximum(
+                    0.0, ewma_level(depths32, n, p["alpha"])
+                )
+                pred_holt = holt_forecast(
+                    times32, depths32, n, p["horizon"], p["alpha"], p["beta"]
+                )
+                pred_lstsq = lstsq_forecast(
+                    times32, depths32, n, p["horizon"], p["window"]
+                )
+                predicted = jnp.where(
+                    p["policy_kind"] == 1,
+                    pred_ewma,
+                    jnp.where(p["policy_kind"] == 2, pred_holt, pred_lstsq),
+                )
+                # PredictivePolicy: max(0, int(round(.))), conservative
+                # gates see max(observed, forecast), reactive warm-up
+                # below min_samples
+                prediction = jnp.maximum(
+                    0, jnp.round(predicted).astype(jnp.int32)
+                )
+                effective = jnp.where(
+                    p["conservative"],
+                    jnp.maximum(observed, prediction),
+                    prediction,
+                )
+                warmed = n >= p["min_samples"]
+                forecaster_row = (
+                    (p["policy_kind"] >= 1) & (p["policy_kind"] <= 3)
+                )
+                decision = jnp.where(
+                    forecaster_row & warmed, effective, observed
+                )
+            if learned:
+                # Remaining-cooldown fractions: the f64 twin of the live
+                # mirror's host-side cooldown_fraction (plain adds and one
+                # divide — IEEE-exact in both), cast f32 exactly where the
+                # live path's np.float32(frac) casts.
+                rem_up = (last_up + p["scale_up_cooldown"]) - t_new
+                rem_down = (last_down + p["scale_down_cooldown"]) - t_new
+                frac_up32 = jnp.where(
+                    (p["scale_up_cooldown"] > 0) & (rem_up > 0),
+                    rem_up / jnp.where(
+                        p["scale_up_cooldown"] > 0, p["scale_up_cooldown"], 1.0
+                    ),
+                    0.0,
+                ).astype(jnp.float32)
+                frac_down32 = jnp.where(
+                    (p["scale_down_cooldown"] > 0) & (rem_down > 0),
+                    rem_down / jnp.where(
+                        p["scale_down_cooldown"] > 0,
+                        p["scale_down_cooldown"],
+                        1.0,
+                    ),
+                    0.0,
+                ).astype(jnp.float32)
+                learned_dec = learned_decision(
+                    p["theta"],
+                    times32,
+                    depths32,
+                    n,
+                    observed,
+                    replicas,
+                    frac_up32,
+                    frac_down32,
+                    p["scale_up_messages"],
+                    p["scale_down_messages"],
+                    p["hold"],
+                    p["min_samples"],
+                    p["max_pods"],
+                    p["poll32"],
+                    p["alpha"],
+                    p["window"],
+                    hidden=hidden,
+                )
+                decision = jnp.where(
+                    p["policy_kind"] == LEARNED_KIND, learned_dec, decision
+                )
             h_t, h_d, h_n = snap_t, snap_d, n
 
         # -- gates: same gate_code as the live gate_up/gate_down; the
@@ -329,10 +448,14 @@ def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
         )
         last_down = jnp.where(down_fire, t_new, last_down)
 
-        out = (t_new, observed, decision, up_code, down_code, replicas, reps2)
+        out = (
+            (t_new, observed, decision, up_code, down_code, replicas, reps2)
+            if trajectory
+            else ()
+        )
         carry = (
             t_new, depth_new, reps2, last_up, last_down, h_t, h_d, h_n,
-            max_depth,
+            max_depth, observed, over_slo, replicas, changes, replica_s,
         )
         return carry, out
 
@@ -346,10 +469,26 @@ def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
         jnp.zeros(capacity, jnp.float64),
         jnp.asarray(0, jnp.int32),
         jnp.asarray(p["initial_depth"], jnp.float64),  # max_depth seed
+        jnp.asarray(-1, jnp.int32),  # prev_obs: nothing observed yet
+        jnp.asarray(0.0, jnp.float64),  # time-over-SLO accumulator
+        jnp.asarray(p["initial_replicas"], jnp.int32),  # prev entering reps
+        jnp.asarray(0, jnp.int32),  # replica_changes
+        jnp.asarray(0.0, jnp.float64),  # replica-seconds integral
     )
-    carry, (t, observed, decision, up, down, reps_before, reps_after) = lax.scan(
+    carry, outs = lax.scan(
         tick, init, (p["times"], p["arrived"]), length=ticks
     )
+    summary = {
+        "final_depth": carry[1],
+        "final_replicas": carry[2],
+        "max_depth": carry[8],
+        "time_over_slo": carry[10],
+        "replica_changes": carry[12],
+        "replica_seconds": carry[13],
+    }
+    if not trajectory:
+        return summary
+    t, observed, decision, up, down, reps_before, reps_after = outs
     return {
         "t": t,
         "observed": observed,
@@ -358,17 +497,19 @@ def _episode(p: dict[str, Any], ticks: int, capacity: int, predictive: bool):
         "down": down,
         "replicas_before": reps_before,
         "replicas_after": reps_after,
-        "final_depth": carry[1],
-        "final_replicas": carry[2],
-        "max_depth": carry[8],
+        **summary,
     }
 
 
-@partial(jax.jit, static_argnames=("ticks", "capacity", "predictive"))
-def _run_batch(params, ticks: int, capacity: int, predictive: bool):
-    return jax.vmap(lambda row: _episode(row, ticks, capacity, predictive))(
-        params
-    )
+@partial(
+    jax.jit, static_argnames=("ticks", "capacity", "predictive", "hidden")
+)
+def _run_batch(
+    params, ticks: int, capacity: int, predictive: bool, hidden: int = 0
+):
+    return jax.vmap(
+        lambda row: _episode(row, ticks, capacity, predictive, hidden)
+    )(params)
 
 
 @dataclass
@@ -418,10 +559,28 @@ def run_episodes(configs: Sequence[SimConfig]) -> list[CompiledEpisode]:
     ticks = ticks_set.pop()
     capacity = cap_set.pop()
     predictive = any(c.policy == "predictive" for c in configs)
-    if predictive and capacity < 2:
+    hidden_set = {
+        int(c.learned_checkpoint.hidden)
+        for c in configs
+        if c.policy == "learned" and c.learned_checkpoint is not None
+    }
+    if len(hidden_set) > 1:
+        raise ValueError(
+            f"all learned configs in one compiled batch must share a hidden"
+            f" width (a compiled shape), got {sorted(hidden_set)}; group"
+            f" by hidden first"
+        )
+    hidden = hidden_set.pop() if hidden_set else 0
+    if (predictive or hidden) and capacity < 2:
         # DepthHistory enforces this on the live path; match it
         raise ValueError(f"forecast_history must be >= 2, got {capacity}")
     rows = [encode_config(c) for c in configs]
+    # theta rows must stack: pad the non-learned placeholders (length 1)
+    # to the batch's learned parameter length
+    theta_len = max(row["theta"].shape[0] for row in rows)
+    for row in rows:
+        if row["theta"].shape[0] < theta_len:
+            row["theta"] = np.zeros(theta_len, np.float32)
     batch = {key: np.stack([row[key] for row in rows]) for key in rows[0]}
     with enable_x64():
         out = _run_batch(
@@ -429,6 +588,7 @@ def run_episodes(configs: Sequence[SimConfig]) -> list[CompiledEpisode]:
             ticks=ticks,
             capacity=capacity,
             predictive=predictive,
+            hidden=hidden,
         )
         out = {key: np.asarray(value) for key, value in out.items()}
     episodes = []
@@ -466,16 +626,22 @@ def run_episodes_grouped(
 ) -> list[CompiledEpisode]:
     """:func:`run_episodes` over configs of *mixed* compiled shapes.
 
-    Tick count and history capacity are compiled shapes, so one device
-    call can only take configs that share them; this helper groups by
-    ``(ticks, capacity)``, runs one batch per group, and scatters the
-    episodes back into input order.  Both :func:`verify_fidelity` and
+    Tick count, history capacity, and the learned network's hidden width
+    are compiled shapes, so one device call can only take configs that
+    share them; this helper groups by ``(ticks, capacity, hidden)``, runs
+    one batch per group, and scatters the episodes back into input order.  Both :func:`verify_fidelity` and
     the sweep driver (:mod:`.sweep`) batch through here.
     """
     configs = list(configs)
-    groups: dict[tuple[int, int], list[int]] = {}
+    groups: dict[tuple[int, int, int], list[int]] = {}
     for index, config in enumerate(configs):
-        key = (episode_ticks(config), int(config.forecast_history))
+        hidden = (
+            int(config.learned_checkpoint.hidden)
+            if config.policy == "learned"
+            and config.learned_checkpoint is not None
+            else 0
+        )
+        key = (episode_ticks(config), int(config.forecast_history), hidden)
         groups.setdefault(key, []).append(index)
     episodes: list[CompiledEpisode | None] = [None] * len(configs)
     for indices in groups.values():

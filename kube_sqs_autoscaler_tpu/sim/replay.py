@@ -211,6 +211,15 @@ def sim_journal_meta(config: SimConfig) -> dict[str, Any]:
             "min_samples": config.forecast_min_samples,
             "conservative": config.forecast_conservative,
         }
+    if config.policy == "learned" and config.learned_checkpoint is not None:
+        # the hash names which weights ran; replay demands a checkpoint
+        # matching it (weights are an artifact, not journal content)
+        meta["learn"] = {
+            "checkpoint_hash": config.learned_checkpoint.hash,
+            "hidden": int(config.learned_checkpoint.hidden),
+            "history": config.forecast_history,
+            "min_samples": config.forecast_min_samples,
+        }
     if config.resilience is not None and config.resilience.enabled:
         # replay needs the stale TTL to re-derive held-depth decisions;
         # the rest documents what could appear in the tick lines
@@ -241,9 +250,59 @@ def loop_config_from_meta(meta: dict[str, Any]) -> LoopConfig:
 
 def _depth_policy_from_meta(
     meta: dict[str, Any],
+    checkpoint: Any = None,
 ) -> tuple[Any, TickObserver | None]:
-    """(depth policy, its history observer) for a predictive journal;
-    (None, None) for reactive."""
+    """(depth policy, its history observer) for a predictive or learned
+    journal; (None, None) for reactive."""
+    if meta.get("policy") == "learned":
+        # Weights are a deployment artifact, not journal content — the
+        # journal records only their content hash, so re-driving a
+        # learned episode needs the caller to supply the checkpoint and
+        # we verify it is THE one that ran.
+        learn = meta.get("learn") or {}
+        recorded_hash = learn.get("checkpoint_hash")
+        if checkpoint is None:
+            raise ValueError(
+                f"this journal was recorded under a learned policy"
+                f" (checkpoint hash {recorded_hash!r}); pass the matching"
+                f" checkpoint via checkpoint= to replay it"
+            )
+        if recorded_hash is not None and checkpoint.hash != recorded_hash:
+            raise ValueError(
+                f"checkpoint hash {checkpoint.hash!r} does not match the"
+                f" journal's recorded weights {recorded_hash!r} — replaying"
+                f" different weights would silently re-score a different"
+                f" policy"
+            )
+        from ..forecast import DepthHistory
+        from ..learn import LearnedPolicy
+        from ..learn.checkpoint import checkpoint_history
+
+        default_history, default_min = checkpoint_history(checkpoint)
+        config = loop_config_from_meta(meta)
+        world = meta.get("world") or {}
+        policy = LearnedPolicy(
+            checkpoint,
+            policy=config.policy,
+            poll_interval=config.poll_interval,
+            max_pods=int(world.get("max_pods", 5)),
+            min_pods=int(world.get("min_pods", 1)),
+            scale_up_pods=int(world.get("scale_up_pods", 1)),
+            scale_down_pods=int(world.get("scale_down_pods", 1)),
+            # Live journals omit initial_replicas (the controller never
+            # knows the deployment's size; see cli._journal_meta) and the
+            # live mirror starts at min_pods — start the replay mirror at
+            # the same place or decisions diverge on a faithful journal.
+            initial_replicas=int(
+                world.get("initial_replicas", world.get("min_pods", 1))
+            ),
+            history=DepthHistory(
+                capacity=int(learn.get("history", default_history))
+            ),
+            min_samples=int(learn.get("min_samples", default_min)),
+        )
+        # the policy is its own observer (history + replica/cooldown mirror)
+        return policy, policy
     if meta.get("policy") != "predictive":
         return None, None
     # Lazy import: reactive replays stay JAX-free, like the live CLI.
@@ -262,7 +321,9 @@ def _depth_policy_from_meta(
 
 
 def replay(
-    records: Sequence[TickRecord], meta: dict[str, Any]
+    records: Sequence[TickRecord],
+    meta: dict[str, Any],
+    checkpoint: Any = None,
 ) -> ReplayResult:
     """Deterministically re-drive ``ControlLoop`` over a recorded episode.
 
@@ -299,7 +360,7 @@ def replay(
         (meta.get("resilience") or {}).get("stale_depth_ttl", 0.0) or 0.0
     )
     source = _ScriptedSource(raise_for_stale=stale_ttl > 0)
-    depth_policy, history = _depth_policy_from_meta(meta)
+    depth_policy, history = _depth_policy_from_meta(meta, checkpoint)
     recorder = _Recorder()
     observers: list[TickObserver] = [recorder]
     if history is not None:
@@ -345,7 +406,7 @@ def replay(
     )
 
 
-def replay_journal(path: str) -> ReplayResult:
+def replay_journal(path: str, checkpoint: Any = None) -> ReplayResult:
     """:func:`replay` straight from a journal file.
 
     A journal accumulates one episode per controller restart (each restart
@@ -388,7 +449,7 @@ def replay_journal(path: str) -> ReplayResult:
             )
         head_meta, head_records = previous[-1]
         meta, records = head_meta, head_records + records
-    return replay(records, meta)
+    return replay(records, meta, checkpoint)
 
 
 @dataclass(frozen=True)
@@ -508,6 +569,7 @@ def counterfactual(
     forecaster: str = "holt",
     horizon: float | None = None,
     slo_depth: float = 300.0,
+    checkpoint: Any = None,
 ) -> dict:
     """Re-score a recorded episode under any policy/forecaster.
 
@@ -516,6 +578,11 @@ def counterfactual(
     simulator, and scores it with the battery's
     :func:`~.evaluate.score_result` — so "what would the holt forecaster
     have done during yesterday's incident?" is one function call.
+
+    ``policy="learned"`` re-scores a trained network
+    (:mod:`..learn`): pass its ``checkpoint``; the row is labeled with
+    the checkpoint's content hash so an incident review names exactly
+    which weights the what-if ran.
     """
     from .evaluate import score_result
 
@@ -526,6 +593,19 @@ def counterfactual(
     forecast = meta.get("forecast") or {}
     if horizon is None:
         horizon = float(forecast.get("horizon", 60.0))
+    history = int(forecast.get("history", 128))
+    min_samples = int(forecast.get("min_samples", 3))
+    if policy == "learned":
+        if checkpoint is None:
+            raise ValueError(
+                "counterfactual(policy='learned') needs the trained"
+                " weights: pass checkpoint=load_checkpoint(path)"
+            )
+        # the feature window is part of what the weights mean — it comes
+        # from the checkpoint, not from the journal's forecast block
+        from ..learn.checkpoint import checkpoint_history
+
+        history, min_samples = checkpoint_history(checkpoint)
     # duration spans ALL recorded ticks — metric-failure ticks consumed a
     # poll interval too, so filtering them out here would truncate the
     # rebuilt episode and score a shorter world than the recorded row
@@ -548,14 +628,20 @@ def counterfactual(
             # honor the recorded forecast configuration like replay() does:
             # re-scoring "the recorded policy" with default warm-up/gating
             # would silently score a different policy
-            forecast_history=int(forecast.get("history", 128)),
-            forecast_min_samples=int(forecast.get("min_samples", 3)),
+            forecast_history=history,
+            forecast_min_samples=min_samples,
             forecast_conservative=bool(forecast.get("conservative", True)),
+            learned_checkpoint=checkpoint if policy == "learned" else None,
         )
     )
     result = sim.run()
     row = score_result(result, slo_depth)
-    row["policy"] = policy if policy == "reactive" else f"{policy}:{forecaster}"
+    if policy == "reactive":
+        row["policy"] = "reactive"
+    elif policy == "learned":
+        row["policy"] = f"learned@{checkpoint.hash}"
+    else:
+        row["policy"] = f"{policy}:{forecaster}"
     return row
 
 
@@ -614,14 +700,34 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="where the demo episode's journal is written (default: a"
         " temporary directory)",
     )
+    parser.add_argument(
+        "--checkpoint", default="",
+        help="learned-policy checkpoint (JSON) for journals recorded under"
+        " --policy=learned; must match the journal's recorded weights hash",
+    )
     args = parser.parse_args(argv)
+    checkpoint = None
+    if args.checkpoint:
+        from ..learn.checkpoint import CheckpointError, load_checkpoint
+
+        try:
+            checkpoint = load_checkpoint(args.checkpoint)
+        except CheckpointError as err:
+            parser.error(str(err))
     path = args.journal
     if not path:
         path = args.record_to or (
             tempfile.mkdtemp(prefix="replay-demo-") + "/journal.jsonl"
         )
         record_episode(_demo_config(), path)
-    result = replay_journal(path)
+    try:
+        result = replay_journal(path, checkpoint=checkpoint)
+    except ValueError as err:
+        # e.g. a learned journal without (or with mismatched) weights:
+        # an actionable message and the tool's exit-2 verdict, not a
+        # traceback
+        print(f"cannot replay {path}: {err}", file=sys.stderr)
+        return 2
     print(
         json.dumps(
             {

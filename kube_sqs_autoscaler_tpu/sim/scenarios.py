@@ -20,9 +20,11 @@ inside an interval, so the seed's lump-sum floor is genuinely exact.)
 
 from __future__ import annotations
 
+import hashlib
 import math
+import random
 from dataclasses import dataclass
-from typing import Protocol, runtime_checkable
+from typing import Any, Protocol, Sequence, runtime_checkable
 
 
 @runtime_checkable
@@ -189,3 +191,168 @@ def as_process(arrival: "float | int | ArrivalProcess") -> ArrivalProcess:
     if isinstance(arrival, (int, float)):
         return ConstantArrival(float(arrival))
     return arrival
+
+
+# ---------------------------------------------------------------------------
+# Seeded scenario variants: principled train-vs-held-out splits.
+# ---------------------------------------------------------------------------
+#
+# A policy tuned against the exact battery worlds (sweep winners, learned
+# policies) must be scored on worlds it did NOT see, or the score is just
+# memorization.  Variants jitter each arrival shape's parameters —
+# rates, step instants, ramp slopes, diurnal phases, burst timings —
+# within declared multiplicative bounds, seeded so a (seed, name, index)
+# triple always produces the same world on any host/process (the seed is
+# hashed with sha256, never Python's per-process ``hash``).  Every
+# variant is an instance of the same analytic process class, so
+# ``arrivals_between`` stays the *exact* integral of ``rate_at`` by
+# construction — the property the simulators lean on.
+
+
+def _variant_rng(seed: int, name: str, index: int) -> random.Random:
+    """Process-stable RNG for one variant (sha256, not ``hash``)."""
+    digest = hashlib.sha256(f"{seed}:{name}:{index}".encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+def variant_bounds(
+    process: ArrivalProcess, jitter: float = 0.2
+) -> dict[str, tuple[float, float]]:
+    """Declared per-parameter bounds a variant of ``process`` must obey.
+
+    Multiplicative ``×(1 ± jitter)`` on every rate and timing parameter,
+    except the diurnal ``phase`` which redraws uniformly over one (jittered)
+    period — a phase shift is the whole point of a diurnal variant.  The
+    generator additionally enforces each class's own validity invariants
+    (``amplitude <= base``, ``0 < burst_len <= period``) by clamping
+    *within* these bounds, so ``variant_bounds`` is the complete contract
+    the property tests check.
+    """
+    lo, hi = 1.0 - jitter, 1.0 + jitter
+
+    def band(value: float) -> tuple[float, float]:
+        return (value * lo, value * hi)
+
+    if isinstance(process, ConstantArrival):
+        return {"rate": band(process.rate)}
+    if isinstance(process, StepArrival):
+        return {
+            "before": band(process.before),
+            "after": band(process.after),
+            "at": band(process.at),
+        }
+    if isinstance(process, RampArrival):
+        ramp_len = process.t_end - process.t_start
+        return {
+            "start_rate": band(process.start_rate),
+            "end_rate": band(process.end_rate),
+            "t_start": band(process.t_start),
+            # the *slope* jitters through the ramp duration: t_end moves
+            # with t_start plus a jittered length
+            "ramp_len": band(ramp_len),
+        }
+    if isinstance(process, DiurnalArrival):
+        return {
+            "base": band(process.base),
+            "amplitude": band(process.amplitude),
+            "period": band(process.period),
+            "phase": (0.0, process.period * hi),
+        }
+    if isinstance(process, BurstArrival):
+        return {
+            "base": band(process.base),
+            "burst_rate": band(process.burst_rate),
+            "period": band(process.period),
+            "burst_len": band(process.burst_len),
+            "first_burst": band(process.first_burst),
+        }
+    raise TypeError(
+        f"no variant rule for arrival process {type(process).__name__}"
+    )
+
+
+def arrival_variant(
+    process: "float | int | ArrivalProcess",
+    seed: int,
+    name: str,
+    index: int,
+    jitter: float = 0.2,
+) -> ArrivalProcess:
+    """One seeded variant of ``process`` within :func:`variant_bounds`."""
+    process = as_process(process)
+    rng = _variant_rng(seed, name, index)
+    bounds = variant_bounds(process, jitter)
+
+    def draw(key: str) -> float:
+        lo, hi = bounds[key]
+        return rng.uniform(lo, hi)
+
+    if isinstance(process, ConstantArrival):
+        return ConstantArrival(rate=draw("rate"))
+    if isinstance(process, StepArrival):
+        return StepArrival(
+            before=draw("before"), after=draw("after"), at=draw("at")
+        )
+    if isinstance(process, RampArrival):
+        t_start = draw("t_start")
+        return RampArrival(
+            start_rate=draw("start_rate"),
+            end_rate=draw("end_rate"),
+            t_start=t_start,
+            t_end=t_start + max(draw("ramp_len"), 1e-6),
+        )
+    if isinstance(process, DiurnalArrival):
+        base = draw("base")
+        period = draw("period")
+        return DiurnalArrival(
+            base=base,
+            # amplitude <= base keeps the closed-form integral exact
+            # (class invariant); the clamp stays inside the declared band
+            # because amplitude's lower bound is below base's
+            amplitude=min(draw("amplitude"), base),
+            period=period,
+            phase=rng.uniform(0.0, period),
+        )
+    if isinstance(process, BurstArrival):
+        period = draw("period")
+        return BurstArrival(
+            base=draw("base"),
+            burst_rate=draw("burst_rate"),
+            period=period,
+            burst_len=min(draw("burst_len"), period),
+            first_burst=draw("first_burst"),
+        )
+    raise TypeError(  # pragma: no cover — variant_bounds rejects first
+        f"no variant rule for arrival process {type(process).__name__}"
+    )
+
+
+def scenario_variants(
+    scenarios: "Sequence[Any]",
+    n_variants: int,
+    seed: int,
+    jitter: float = 0.2,
+) -> "list[Any]":
+    """``n_variants`` seeded world-variants of each scenario.
+
+    ``scenarios`` are :class:`~.evaluate.Scenario`-shaped frozen
+    dataclasses (anything with ``name`` + ``arrival`` fields); each
+    variant keeps every non-arrival field and appends ``~v{i}s{seed}`` to
+    the name, so train (one seed) and held-out (another) splits are
+    disjoint, reproducible, and self-describing in score rows.
+    """
+    import dataclasses
+
+    out = []
+    for scenario in scenarios:
+        for index in range(n_variants):
+            out.append(
+                dataclasses.replace(
+                    scenario,
+                    name=f"{scenario.name}~v{index}s{seed}",
+                    arrival=arrival_variant(
+                        scenario.arrival, seed, scenario.name, index, jitter
+                    ),
+                )
+            )
+    return out

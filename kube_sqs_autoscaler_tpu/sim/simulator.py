@@ -22,6 +22,7 @@ from typing import TYPE_CHECKING, Sequence
 
 if TYPE_CHECKING:  # annotation-only: the reactive path stays lean
     from ..core.resilience import ResilienceConfig
+    from ..learn.checkpoint import PolicyCheckpoint
     from .faults import FailureProcess
 
 from ..core.clock import FakeClock
@@ -43,8 +44,12 @@ class SimConfig:
     number keeps the exact constant-rate arithmetic of the seed.
 
     ``policy`` selects the depth policy the gates threshold through:
-    ``"reactive"`` (the reference) or ``"predictive"`` (forecasted depth at
-    ``now + forecast_horizon`` via the named ``forecaster``).
+    ``"reactive"`` (the reference), ``"predictive"`` (forecasted depth at
+    ``now + forecast_horizon`` via the named ``forecaster``), or
+    ``"learned"`` (a trained network's up/hold/down decision expressed as
+    an effective depth; requires ``learned_checkpoint``, reuses
+    ``forecast_history``/``forecast_min_samples`` for its feature
+    ring buffer and reactive warm-up).
 
     ``faults`` injects a deterministic :class:`~.faults.FailureProcess`
     around the metric source and scaler (``None`` = healthy world);
@@ -72,6 +77,7 @@ class SimConfig:
     forecast_conservative: bool = True  # gates see max(observed, forecast)
     faults: "FailureProcess | None" = None  # sim.faults injection
     resilience: "ResilienceConfig | None" = None  # core.resilience opt-in
+    learned_checkpoint: "PolicyCheckpoint | None" = None  # policy="learned"
 
 
 @dataclass
@@ -194,10 +200,35 @@ class Simulation:
                 conservative=self.config.forecast_conservative,
             )
             observers.insert(0, history)
+        elif self.config.policy == "learned":
+            # Lazy import like the predictive path: only a learned episode
+            # pays the learn-package (and JAX) import.
+            from ..forecast import DepthHistory
+            from ..learn import LearnedPolicy
+
+            if self.config.learned_checkpoint is None:
+                raise ValueError(
+                    "policy='learned' requires SimConfig.learned_checkpoint"
+                )
+            depth_policy = LearnedPolicy(
+                self.config.learned_checkpoint,
+                policy=self.config.loop.policy,
+                poll_interval=self.config.loop.poll_interval,
+                max_pods=self.config.max_pods,
+                min_pods=self.config.min_pods,
+                scale_up_pods=self.config.scale_up_pods,
+                scale_down_pods=self.config.scale_down_pods,
+                initial_replicas=self.config.initial_replicas,
+                history=DepthHistory(capacity=self.config.forecast_history),
+                min_samples=self.config.forecast_min_samples,
+            )
+            # the policy IS its own observer: the tick-record hook feeds
+            # both the depth history and the replica/cooldown mirror
+            observers.insert(0, depth_policy)
         elif self.config.policy != "reactive":
             raise ValueError(
-                f"policy must be 'reactive' or 'predictive', got"
-                f" {self.config.policy!r}"
+                f"policy must be 'reactive', 'predictive' or 'learned',"
+                f" got {self.config.policy!r}"
             )
         if not observers:
             observer: TickObserver | None = None

@@ -214,6 +214,21 @@ class SweepReport:
             for name, rows in self._by_scenario().items()
         }
 
+    def best_points_per_scenario(self) -> dict[str, SweepPoint]:
+        """The winning configurations as re-runnable :class:`SweepPoint`\\ s.
+
+        Rebuilt from the rows' recorded ``point`` dicts, so a tuned
+        winner can be re-evaluated on *other* worlds than the one it was
+        tuned on.  (The learn bench aggregates winners per scenario
+        *family* across variants rather than per scenario, so it picks
+        its baseline from the raw rows directly — this per-scenario form
+        is the API for everything else.)
+        """
+        return {
+            name: SweepPoint(**row["point"])
+            for name, row in self.best_per_scenario().items()
+        }
+
     def pareto_per_scenario(self) -> dict[str, list[dict]]:
         """Max-depth-vs-churn Pareto front per scenario, depth-sorted."""
         fronts: dict[str, list[dict]] = {}

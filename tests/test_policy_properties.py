@@ -234,6 +234,97 @@ def test_predictive_episode_invariants(
         prev = replicas
 
 
+@settings(max_examples=15, deadline=None)
+@given(
+    depths=st.lists(st.integers(0, 500), min_size=1, max_size=40),
+    up=st.integers(50, 300),
+    down=st.integers(0, 49),
+    up_cool=st.floats(0, 30, allow_nan=False),
+    down_cool=st.floats(0, 30, allow_nan=False),
+    min_pods=st.integers(1, 3),
+    extra=st.integers(0, 10),
+    init_offset=st.integers(0, 5),
+    step=st.integers(1, 5),
+    theta_seed=st.integers(0, 1000),
+)
+def test_learned_episode_invariants(
+    depths, up, down, up_cool, down_cool, min_pods, extra, init_offset, step,
+    theta_seed,
+):
+    """The learned policy also sits *before* the unchanged gates, so
+    whatever a random (untrained) network decides, an episode must uphold
+    exactly the reactive episode's invariants: replica bounds are never
+    violated and same-direction actuations are separated by that
+    direction's cooldown."""
+    from kube_sqs_autoscaler_tpu.forecast import DepthHistory
+    from kube_sqs_autoscaler_tpu.learn import LearnedPolicy, PolicyCheckpoint
+    from kube_sqs_autoscaler_tpu.learn.network import init_params
+
+    max_pods = min_pods + extra
+    init = min(min_pods + init_offset, max_pods)
+    api = FakeDeploymentAPI.with_deployments("ns", init, "deploy")
+    scaler = PodAutoScaler(
+        client=api, max=max_pods, min=min_pods, scale_up_pods=step,
+        scale_down_pods=step, deployment="deploy", namespace="ns",
+    )
+    queue = FakeQueueService.with_depths(depths[0])
+    clock = FakeClock()
+    config = PolicyConfig(
+        scale_up_messages=up, scale_down_messages=down,
+        scale_up_cooldown=up_cool, scale_down_cooldown=down_cool,
+    )
+    policy = LearnedPolicy(
+        PolicyCheckpoint(theta=init_params(theta_seed)),
+        policy=config,
+        poll_interval=1.0,
+        max_pods=max_pods,
+        min_pods=min_pods,
+        scale_up_pods=step,
+        scale_down_pods=step,
+        initial_replicas=init,
+        history=DepthHistory(capacity=16),
+    )
+    loop = ControlLoop(
+        scaler,
+        QueueMetricSource(client=queue, queue_url="q"),
+        LoopConfig(poll_interval=1.0, policy=config),
+        clock=clock,
+        observer=policy,
+        depth_policy=policy,
+    )
+    for i, depth in enumerate(depths):
+        clock.at(float(i), lambda d=depth: queue.set_depths(d))
+
+    observations: list[tuple[float, int]] = []
+    original_tick = loop.tick
+
+    def recording_tick(state):
+        new_state = original_tick(state)
+        observations.append((clock.now(), api.replicas("deploy")))
+        return new_state
+
+    loop.tick = recording_tick
+    loop.run(max_ticks=len(depths))
+
+    low = min(min_pods, init)
+    high = max(max_pods, init)
+    assert all(low <= r <= high for _, r in observations)
+
+    last_up_time = None
+    last_down_time = None
+    prev = init
+    for t, replicas in observations:
+        if replicas > prev:
+            if last_up_time is not None:
+                assert t - last_up_time >= up_cool - 1e-6
+            last_up_time = t
+        elif replicas < prev:
+            if last_down_time is not None:
+                assert t - last_down_time >= down_cool - 1e-6
+            last_down_time = t
+        prev = replicas
+
+
 @settings(max_examples=25, deadline=None)
 @given(
     depths=st.lists(st.integers(0, 400), min_size=3, max_size=30),

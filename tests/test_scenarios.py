@@ -200,3 +200,93 @@ def test_burst_world_grows_during_bursts_and_recovers():
     result = sim.run()
     assert result.max_depth > 100.0  # bursts visibly pile up backlog
     assert result.final_depth < result.max_depth  # and the pool drains it
+
+
+# --- seeded scenario variants (learn/ train-vs-held-out splits) -------------
+
+
+def _variant_battery():
+    from kube_sqs_autoscaler_tpu.sim.evaluate import default_battery
+
+    return list(default_battery())
+
+
+def test_variants_are_deterministic_per_seed_and_disjoint_across_seeds():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import scenario_variants
+
+    base = _variant_battery()
+    a = scenario_variants(base, 2, seed=7)
+    b = scenario_variants(base, 2, seed=7)
+    c = scenario_variants(base, 2, seed=8)
+    assert [s.arrival for s in a] == [s.arrival for s in b]
+    assert [s.name for s in a] == [s.name for s in b]
+    # a different seed re-draws every world (frozen dataclasses compare
+    # by value, so equality here would mean an identical parameter draw)
+    assert all(x.arrival != y.arrival for x, y in zip(a, c))
+    assert len(a) == 2 * len(base)
+
+
+def test_variants_keep_world_fields_and_tag_names():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import scenario_variants
+
+    base = _variant_battery()
+    for scenario, variant in zip(base, scenario_variants(base, 1, seed=3)):
+        assert variant.name == f"{scenario.name}~v0s3"
+        assert variant.duration == scenario.duration
+        assert variant.max_pods == scenario.max_pods
+        assert variant.slo_depth == scenario.slo_depth
+        assert variant.initial_replicas == scenario.initial_replicas
+        assert type(variant.arrival) is type(scenario.arrival)
+
+
+@settings(max_examples=30, deadline=None)
+@given(seed=st.integers(0, 10_000), index=st.integers(0, 20),
+       jitter=st.floats(0.05, 0.4))
+def test_variant_parameters_stay_inside_declared_bounds(seed, index, jitter):
+    import dataclasses
+
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        DiurnalArrival as Diurnal,
+        RampArrival as Ramp,
+        arrival_variant,
+        variant_bounds,
+    )
+
+    for scenario in _variant_battery():
+        process = scenario.arrival
+        bounds = variant_bounds(process, jitter)
+        variant = arrival_variant(
+            process, seed, scenario.name, index, jitter
+        )
+        values = dataclasses.asdict(variant)
+        if isinstance(process, Ramp):
+            # t_end is declared through the jittered ramp duration
+            values["ramp_len"] = values.pop("t_end") - values["t_start"]
+        for key, (lo, hi) in bounds.items():
+            assert lo - 1e-9 <= values[key] <= hi + 1e-9, (
+                scenario.name, key, values[key], (lo, hi),
+            )
+        # class invariants survive the jitter (the generator clamps
+        # within the declared bounds, never outside them)
+        if isinstance(variant, Diurnal):
+            assert variant.amplitude <= variant.base
+
+
+@settings(max_examples=15, deadline=None)
+@given(seed=st.integers(0, 10_000), t0=st.floats(0.0, 800.0),
+       span=st.floats(1.0, 400.0))
+def test_variant_integrals_stay_exact(seed, t0, span):
+    """Variants are instances of the same analytic classes, so
+    arrivals_between must remain the exact integral of rate_at — the
+    property both simulators consume verbatim."""
+    from kube_sqs_autoscaler_tpu.sim.scenarios import arrival_variant
+
+    t1 = t0 + span
+    for scenario in _variant_battery():
+        variant = arrival_variant(scenario.arrival, seed, scenario.name, 0)
+        exact = variant.arrivals_between(t0, t1)
+        approx = trapezoid_integral(variant, t0, t1, steps=8000)
+        scale = max(abs(exact), 1.0)
+        assert exact == pytest.approx(approx, rel=5e-3, abs=0.05 * scale), (
+            scenario.name, t0, t1,
+        )
