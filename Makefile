@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -104,6 +104,17 @@ bench-chaos-serve:
 # BENCH_r14.json + the deployable LEARNED_POLICY.json checkpoint
 bench-learn:
 	JAX_PLATFORMS=cpu python bench.py --suite learn
+
+# Multi-tenant fair-admission battery (CPU JAX, ~a minute): flood
+# isolation (victim TTFT p99 under a flooding tenant bounded vs the
+# no-flood control, DRR admission), sticky-vs-freest prefix-cache
+# locality on the sharded plane (strictly fewer installs AND more
+# tokens/s), exact greedy parity against the prefix-prepended
+# reference engine, tenancy-off byte-identity (equal outputs and
+# dispatch/transfer counts), and exactly-once per-tenant accounting;
+# exits 2 on any gate failure; writes BENCH_r15.json
+bench-tenants:
+	JAX_PLATFORMS=cpu python bench.py --suite tenants
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

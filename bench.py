@@ -89,6 +89,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import sys
 import time
 
@@ -2047,12 +2048,620 @@ def _chaos_serve_donor(model, params, prompts, *, shards, batch_size,
     return {"requests": len(prompts)}, {}, batcher
 
 
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant fair admission: flood isolation + sticky prefix locality
+# ---------------------------------------------------------------------------
+
+
+def _tenant_model(prefix_len, prompt_len, generate_tokens):
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    model = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=prefix_len + prompt_len + generate_tokens,
+        dtype=jnp.float32,
+    )
+    return model, init_params(jax.random.key(0), model)
+
+
+def _tenant_bodies(model, scenario, *, prompt_len, prefix_len, seed=5):
+    """Deterministic (tenant, index) -> body maps for one scenario:
+    tenancy bodies (tenant + pooled prefix + suffix ids) and the
+    prefix-PREPENDED plain bodies the tenancy-off reference decodes —
+    identical token streams, two envelopes."""
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        seeded_token_ids,
+        tenant_prefix_ids,
+    )
+
+    prefixes = {
+        t: tenant_prefix_ids(t, prefix_len, model.vocab_size, seed)
+        for t in scenario.tenants
+    }
+
+    def suffix_ids(tenant, index):
+        return seeded_token_ids(
+            f"tenant-req:{tenant}:{index}:{seed}", prompt_len,
+            model.vocab_size,
+        )
+
+    def tenancy_body(tenant, index):
+        return json.dumps({
+            "tenant": tenant,
+            "prefix": prefixes[tenant],
+            "ids": suffix_ids(tenant, index),
+        })
+
+    def plain_body(tenant, index):
+        return json.dumps(prefixes[tenant] + suffix_ids(tenant, index))
+
+    return tenancy_body, plain_body
+
+
+def _drive_tenant_schedule(worker, queue, url, scenario, body_for,
+                           max_drain_cycles=100_000):
+    """Interleave the scenario's per-cycle sends with real engine
+    cycles, then drain; returns ``(sent, total)`` where ``sent`` maps
+    message id -> (tenant, index)."""
+    sent = {}
+    counters: dict[str, int] = {}
+    for cycle_sends in scenario.schedule():
+        for tenant, count in cycle_sends:
+            for _ in range(count):
+                index = counters.get(tenant, 0)
+                counters[tenant] = index + 1
+                mid = queue.send_message(url, body_for(tenant, index))
+                sent[mid] = (tenant, index)
+        worker.run_once()
+    total = sum(counters.values())
+    cycles = 0
+    while worker.processed < total and cycles < max_drain_cycles:
+        worker.run_once()
+        cycles += 1
+    return sent, total
+
+
+def _ttft_p99(samples) -> float:
+    """Nearest-rank p99: ceil(0.99·n)-1, so small sample sets (every
+    victim here has ~a dozen TTFTs) report their WORST sample instead
+    of silently excluding it — the isolation gate must see the one
+    request the flood parked longest."""
+    ordered = sorted(samples)
+    if not ordered:
+        return 0.0
+    return ordered[max(0, math.ceil(0.99 * len(ordered)) - 1)]
+
+
+def _tenant_flood_episode(model, params, scenario, *, prompt_len,
+                          generate_tokens, batch_size, decode_block,
+                          fair, engine_source=None):
+    """One flood/control run: DRR (``fair=True``) or FIFO admission over
+    the same staging machinery, no prefix pool (isolates admission
+    policy).  Returns per-tenant TTFT p99s + exactly-once accounting +
+    the outputs keyed by (tenant, index) for the parity gate."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+        tenant_completions,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    url = f"bench://tenants-{scenario.name}-{'drr' if fair else 'fifo'}"
+    config = ServiceConfig(
+        queue_url=url, batch_size=batch_size, seq_len=prompt_len,
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        result_queue_url=url + "-results",
+    )
+    tenancy = TenancyConfig(
+        tenants=scenario.tenants,
+        weights=tuple(t.weight for t in scenario.traffics),
+        ttft_slo_s=tuple(t.ttft_slo_s for t in scenario.traffics),
+        fair=fair,
+    )
+    worker = ContinuousWorker(queue, params, model, config,
+                              result_queue=results, tenancy=tenancy)
+    if engine_source is not None:
+        worker.batcher.adopt_engine(engine_source)
+    body_for, _ = _tenant_bodies(
+        model, scenario, prompt_len=prompt_len, prefix_len=prompt_len,
+    )
+
+    def tenancy_only_body(tenant, index):
+        payload = json.loads(body_for(tenant, index))
+        del payload["prefix"]  # pool-less episode: admission policy only
+        return json.dumps(payload)
+
+    sent, total = _drive_tenant_schedule(
+        worker, queue, url, scenario, tenancy_only_body,
+    )
+    replies, duplicates = collect_replies(results, config.result_queue_url)
+    outputs = {
+        sent[rid]: payload["tokens"]
+        for rid, payload in replies.items() if rid in sent
+    }
+    batcher = worker.batcher
+    return {
+        "mode": "drr" if fair else "fifo",
+        "requests": total,
+        "answered": len(replies),
+        "duplicates": duplicates,
+        "completions_by_tenant": tenant_completions(replies),
+        "worker_completions": dict(worker.completed_by_tenant),
+        "ttft_p99_by_tenant": {
+            t: round(_ttft_p99(batcher.tenant_ttft.get(t, ())), 4)
+            for t in scenario.tenants
+        },
+        "insert_dispatches": batcher.insert_dispatches,
+        "decode_dispatches": batcher.decode_dispatches,
+        "host_transfers": batcher.host_transfers,
+    }, outputs, batcher
+
+
+def _tenant_sticky_episode(model, params, scenario, *, prompt_len,
+                           prefix_len, generate_tokens, shards,
+                           batch_size, decode_block, pool_entries,
+                           sticky, timed_repeats=3, engine_source=None):
+    """Sticky vs freest-first routing on prefix-sharing traffic over the
+    sharded plane, per-shard prefix pools on.  A warm episode pays the
+    compiles; ``timed_repeats`` fresh engines (adopting the warm one)
+    then run the identical schedule and the best tokens/s is kept —
+    install/hit counters come from the LAST timed engine (they are
+    deterministic across repeats, asserted)."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    tenancy = TenancyConfig(
+        tenants=scenario.tenants,
+        prefix_pool=pool_entries, prefix_len=prefix_len, sticky=sticky,
+    )
+    mode = "sticky" if sticky else "freest"
+    body_for, _ = _tenant_bodies(
+        model, scenario, prompt_len=prompt_len, prefix_len=prefix_len,
+    )
+
+    def run_once(engine_source):
+        queue = FakeMessageQueue()
+        results = FakeMessageQueue()
+        url = f"bench://tenants-sticky-{mode}"
+        config = ServiceConfig(
+            queue_url=url, batch_size=batch_size, seq_len=prompt_len,
+            generate_tokens=generate_tokens, decode_block=decode_block,
+            shards=shards, result_queue_url=url + "-results",
+        )
+        worker = ContinuousWorker(queue, params, model, config,
+                                  result_queue=results, tenancy=tenancy,
+                                  sharded=True)
+        if engine_source is not None:
+            worker.batcher.adopt_engine(engine_source)
+        start = time.perf_counter()
+        sent, total = _drive_tenant_schedule(
+            worker, queue, url, scenario, body_for,
+        )
+        elapsed = time.perf_counter() - start
+        replies, _ = collect_replies(results, config.result_queue_url)
+        outputs = {
+            sent[rid]: payload["tokens"]
+            for rid, payload in replies.items() if rid in sent
+        }
+        return worker, outputs, total, elapsed
+
+    warm_worker, _, _, _ = run_once(engine_source)
+    rates, outputs, stats = [], {}, None
+    for _ in range(timed_repeats):
+        worker, outputs, total, elapsed = run_once(warm_worker.batcher)
+        if len(outputs) != total:
+            print(
+                f"tenants: {mode} drain finished only "
+                f"{len(outputs)}/{total} requests", file=sys.stderr,
+            )
+            raise SystemExit(2)
+        rates.append(worker.batcher.tokens_emitted / elapsed)
+        if stats is not None and stats != worker.batcher.prefix_pool.stats():
+            print(
+                f"tenants: {mode} pool behavior was not deterministic "
+                f"across repeats: {stats} != "
+                f"{worker.batcher.prefix_pool.stats()}", file=sys.stderr,
+            )
+            raise SystemExit(2)
+        stats = worker.batcher.prefix_pool.stats()
+    return {
+        "mode": mode,
+        "requests": len(outputs),
+        "tokens_per_second": round(max(rates), 1),
+        "rates_per_repeat": [round(r, 1) for r in rates],
+        "prefix_installs": stats["installs"],
+        "prefix_hits": stats["hits"],
+        "prefix_misses": stats["misses"],
+        "prefix_evictions": stats["evictions"],
+        "insert_dispatches": worker.batcher.insert_dispatches,
+        "decode_dispatches": worker.batcher.decode_dispatches,
+    }, outputs, warm_worker.batcher
+
+
+def _tenant_reference_outputs(model, params, scenario, *, prompt_len,
+                              prefix_len, generate_tokens, batch_size,
+                              decode_block):
+    """Today's engine (tenancy=None) decoding the prefix-PREPENDED
+    prompts — the greedy-parity oracle for the pooled episodes, plus
+    its dispatch counters for the tenancy-off byte-identity gate."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    url = "bench://tenants-reference"
+    config = ServiceConfig(
+        queue_url=url, batch_size=batch_size,
+        seq_len=prefix_len + prompt_len,
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        result_queue_url=url + "-results",
+    )
+    worker = ContinuousWorker(queue, params, model, config,
+                              result_queue=results)
+    _, plain_body = _tenant_bodies(
+        model, scenario, prompt_len=prompt_len, prefix_len=prefix_len,
+    )
+    sent, total = _drive_tenant_schedule(
+        worker, queue, url, scenario, plain_body,
+    )
+    replies, _ = collect_replies(results, config.result_queue_url)
+    outputs = {
+        sent[rid]: payload["tokens"]
+        for rid, payload in replies.items() if rid in sent
+    }
+    if len(outputs) != total:
+        # the parity gate iterates the reference keys: a short reference
+        # drain would make byte-identity pass vacuously for the missing
+        # requests, so an incomplete oracle is itself a hard failure
+        print(
+            f"tenants: reference drain finished only "
+            f"{len(outputs)}/{total} requests", file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return outputs
+
+
+def _tenant_off_parity(model, params, *, messages, prompt_len,
+                       generate_tokens, batch_size, decode_block):
+    """Byte-identity of the tenancy seam when it is OFF: the same
+    preloaded queue drained by (a) today's engine (tenancy=None) and
+    (b) a single-default-tenant tenancy engine with the pool off — the
+    reference path.  Returns both runs' outputs and dispatch counters
+    (the gate demands equal outputs AND equal counters)."""
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    rng = np.random.default_rng(21)
+    prompts = [
+        rng.integers(1, model.vocab_size,
+                     rng.integers(2, prompt_len + 1)).astype(np.int32)
+        for _ in range(messages)
+    ]
+    runs = {}
+    for label, tenancy in (
+        ("off", None),
+        ("single-default", TenancyConfig(tenants=("default",))),
+    ):
+        queue = FakeMessageQueue()
+        results = FakeMessageQueue()
+        url = f"bench://tenants-off-{label}"
+        config = ServiceConfig(
+            queue_url=url, batch_size=batch_size, seq_len=prompt_len,
+            generate_tokens=generate_tokens, decode_block=decode_block,
+            result_queue_url=url + "-results",
+        )
+        worker = ContinuousWorker(queue, params, model, config,
+                                  result_queue=results, tenancy=tenancy)
+        sent = {
+            queue.send_message(url, json.dumps(ids.tolist())): index
+            for index, ids in enumerate(prompts)
+        }
+        worker.drain(total=messages, max_cycles=100_000)
+        replies, _ = collect_replies(results, config.result_queue_url)
+        runs[label] = {
+            "outputs": {
+                sent[rid]: payload["tokens"]
+                for rid, payload in replies.items() if rid in sent
+            },
+            "insert_dispatches": worker.batcher.insert_dispatches,
+            "decode_dispatches": worker.batcher.decode_dispatches,
+            "host_transfers": worker.batcher.host_transfers,
+        }
+    return runs
+
+
+def run_tenants_suite(output: str = "BENCH_r15.json", *,
+                      prompt_len: int = 8, prefix_len: int = 16,
+                      generate_tokens: int = 24, batch_size: int = 2,
+                      shards: int = 2, decode_block: int = 8,
+                      pool_entries: int = 3, flood_per_cycle: int = 8,
+                      flood_cycles: int = 10, victims: int = 2,
+                      sticky_tenants: int = 6, sticky_cycles: int = 48,
+                      isolation_factor: float = 25.0,
+                      isolation_floor_s: float = 0.25,
+                      timing_gates: bool = True,
+                      timed_repeats: int = 3) -> dict:
+    """Multi-tenant fair admission battery (ROADMAP item 3), hard-gated
+    (exit 2) on:
+
+    - **flood isolation** — with DRR admission, every victim tenant's
+      TTFT p99 under the flood stays within ``isolation_factor`` x the
+      no-flood control (floored at ``isolation_floor_s`` so a quiet
+      control can't make the bound vacuous) — while the FIFO run is
+      reported for contrast;
+    - **sticky locality** — on prefix-sharing traffic over the sharded
+      plane, sticky routing installs strictly fewer prefix entries than
+      freest-first (the deterministic locality claim) AND measures more
+      tokens/s (the throughput claim; best-of-``timed_repeats``);
+    - **exact greedy parity** — every pooled episode's outputs are
+      byte-identical to today's engine decoding the prefix-prepended
+      prompts, and the flood episodes' outputs are identical across
+      DRR/FIFO (admission reorders, never rewrites);
+    - **tenancy off = reference path** — a single-default-tenant
+      tenancy engine with the pool off produces byte-identical outputs
+      AND identical insert/decode-dispatch + host-transfer counts to
+      today's engine on the same preloaded queue;
+    - **exactly-once** — every episode answers every request exactly
+      once, per-tenant completion counts included.
+
+    ``timing_gates=False`` (the tier-1 smoke) skips the two wall-clock
+    gates (isolation factor, tokens/s win) but keeps every
+    deterministic gate.
+    """
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        flood_scenario,
+        prefix_share_scenario,
+        without_flood,
+    )
+
+    model, params = _tenant_model(prefix_len, prompt_len, generate_tokens)
+    failures = []
+    start = time.perf_counter()
+
+    # -- flood isolation ---------------------------------------------------
+    flood = flood_scenario(
+        victims=victims, flood_per_cycle=flood_per_cycle,
+        flood_cycles=flood_cycles,
+        cycles=4 + flood_cycles + 4 * victims + 8,
+    )
+    control = without_flood(flood)
+    kwargs = dict(prompt_len=prompt_len, generate_tokens=generate_tokens,
+                  batch_size=batch_size, decode_block=decode_block)
+    # warm run first: every timed episode adopts this engine, so no
+    # victim's arrival-based TTFT ever includes a jit compile stall
+    # (nearest-rank p99 on ~a dozen samples reports the WORST one)
+    _, _, warm = _tenant_flood_episode(
+        model, params, control, fair=True, **kwargs,
+    )
+    drr, drr_out, _ = _tenant_flood_episode(
+        model, params, flood, fair=True, engine_source=warm, **kwargs,
+    )
+    fifo, fifo_out, _ = _tenant_flood_episode(
+        model, params, flood, fair=False, engine_source=warm, **kwargs,
+    )
+    ctrl, _, _ = _tenant_flood_episode(
+        model, params, control, fair=True, engine_source=warm, **kwargs,
+    )
+    for row in (drr, fifo, ctrl):
+        if row["answered"] != row["requests"] or row["duplicates"]:
+            failures.append(
+                f"flood[{row['mode']}]: {row['answered']}/"
+                f"{row['requests']} answered, {row['duplicates']} "
+                "duplicate replies (gate: exactly once)"
+            )
+        if row["completions_by_tenant"] != row["worker_completions"]:
+            failures.append(
+                f"flood[{row['mode']}]: reply-side per-tenant counts "
+                f"{row['completions_by_tenant']} != worker-side "
+                f"{row['worker_completions']}"
+            )
+    if drr_out != fifo_out:
+        failures.append(
+            "flood: DRR and FIFO admission produced different outputs "
+            "(admission must reorder, never rewrite)"
+        )
+    isolation = {}
+    for victim in flood.victims:
+        flood_p99 = drr["ttft_p99_by_tenant"][victim]
+        ctrl_p99 = ctrl["ttft_p99_by_tenant"][victim]
+        bound = max(isolation_factor * ctrl_p99, isolation_floor_s)
+        isolation[victim] = {
+            "ttft_p99_flood_s": flood_p99,
+            "ttft_p99_control_s": ctrl_p99,
+            "ttft_p99_fifo_s": fifo["ttft_p99_by_tenant"][victim],
+            "bound_s": round(bound, 4),
+        }
+        if timing_gates and flood_p99 > bound:
+            failures.append(
+                f"flood: victim {victim} TTFT p99 {flood_p99:.4f}s "
+                f"exceeds the isolation bound {bound:.4f}s "
+                f"(control {ctrl_p99:.4f}s x{isolation_factor:g}, "
+                f"floor {isolation_floor_s:g}s)"
+            )
+
+    # -- sticky prefix locality --------------------------------------------
+    share = prefix_share_scenario(tenants=sticky_tenants,
+                                  cycles=sticky_cycles)
+    skwargs = dict(prompt_len=prompt_len, prefix_len=prefix_len,
+                   generate_tokens=generate_tokens, shards=shards,
+                   batch_size=batch_size, decode_block=decode_block,
+                   pool_entries=pool_entries,
+                   timed_repeats=timed_repeats)
+    sticky, sticky_out, sticky_warm = _tenant_sticky_episode(
+        model, params, share, sticky=True, **skwargs,
+    )
+    freest, freest_out, _ = _tenant_sticky_episode(
+        model, params, share, sticky=False, engine_source=sticky_warm,
+        **skwargs,
+    )
+    reference_out = _tenant_reference_outputs(
+        model, params, share, prompt_len=prompt_len,
+        prefix_len=prefix_len, generate_tokens=generate_tokens,
+        batch_size=batch_size, decode_block=decode_block,
+    )
+    for label, outputs in (("sticky", sticky_out), ("freest", freest_out)):
+        divergences = [
+            key for key in reference_out if outputs.get(key) !=
+            reference_out[key]
+        ]
+        if divergences:
+            failures.append(
+                f"sticky[{label}]: {len(divergences)} request(s) "
+                "diverged from the prefix-prepended reference engine "
+                f"(first: {sorted(divergences)[:4]})"
+            )
+    if sticky["prefix_installs"] >= freest["prefix_installs"]:
+        failures.append(
+            f"sticky: {sticky['prefix_installs']} prefix installs vs "
+            f"freest-first's {freest['prefix_installs']} (gate: strictly "
+            "fewer — stickiness must preserve locality)"
+        )
+    if timing_gates and (sticky["tokens_per_second"]
+                         <= freest["tokens_per_second"]):
+        failures.append(
+            f"sticky: {sticky['tokens_per_second']} tokens/s <= "
+            f"freest-first's {freest['tokens_per_second']} (gate: a "
+            "measured win on prefix-sharing traffic)"
+        )
+
+    # -- tenancy off = reference path --------------------------------------
+    off = _tenant_off_parity(
+        model, params, messages=12, prompt_len=prompt_len,
+        generate_tokens=generate_tokens, batch_size=batch_size,
+        decode_block=decode_block,
+    )
+    if off["off"]["outputs"] != off["single-default"]["outputs"]:
+        failures.append(
+            "off-parity: single-default-tenant outputs differ from "
+            "today's engine (gate: byte-identical)"
+        )
+    for counter in ("insert_dispatches", "decode_dispatches",
+                    "host_transfers"):
+        if off["off"][counter] != off["single-default"][counter]:
+            failures.append(
+                f"off-parity: {counter} {off['single-default'][counter]} "
+                f"!= reference {off['off'][counter]} (gate: the tenancy "
+                "seam adds zero dispatches/syncs when idle)"
+            )
+    elapsed = time.perf_counter() - start
+
+    artifact = {
+        "suite": "tenants",
+        "elapsed_s": round(elapsed, 2),
+        "config": {
+            "prompt_len": prompt_len, "prefix_len": prefix_len,
+            "generate_tokens": generate_tokens,
+            "batch_size_per_shard": batch_size, "shards": shards,
+            "decode_block": decode_block,
+            "tenancy": {
+                "flood_tenants": list(flood.tenants),
+                "flood_weights": [t.weight for t in flood.traffics],
+                "sticky_tenants": list(share.tenants),
+                "prefix_pool_entries": pool_entries,
+                "isolation_factor": isolation_factor,
+                "isolation_floor_s": isolation_floor_s,
+            },
+            "model": {"d_model": model.d_model,
+                      "n_layers": model.n_layers,
+                      "n_heads": model.n_heads,
+                      "vocab_size": model.vocab_size},
+        },
+        "flood": {"drr": drr, "fifo": fifo, "control": ctrl,
+                  "isolation": isolation},
+        "sticky": {"sticky": sticky, "freest": freest,
+                   "parity_requests": len(reference_out)},
+        "off_parity": {
+            label: {k: v for k, v in run.items() if k != "outputs"}
+            | {"requests": len(run["outputs"])}
+            for label, run in off.items()
+        },
+        "gates": {
+            "isolation": (
+                f"victim TTFT p99 under flood <= max({isolation_factor:g}"
+                f" x control, {isolation_floor_s:g}s), DRR admission"
+                if timing_gates else "off (smoke run)"
+            ),
+            "sticky": (
+                "strictly fewer prefix installs than freest-first"
+                + (" AND more tokens/s" if timing_gates else
+                   " (tokens/s gate off: smoke run)")
+            ),
+            "parity": "byte-identical to the prefix-prepended reference "
+                      "engine at every pooled point; DRR == FIFO outputs",
+            "off": "tenancy-off and single-default-tenant runs "
+                   "byte-identical with equal dispatch/transfer counts",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"tenants: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    worst = max(
+        (row["ttft_p99_flood_s"] / max(row["ttft_p99_control_s"], 1e-9))
+        for row in isolation.values()
+    )
+    return {
+        "metric": "tenants_sticky_tokens_per_sec",
+        "value": sticky["tokens_per_second"],
+        "unit": (
+            f"tokens/s (sticky admission, {shards} shards, "
+            f"{sticky_tenants} tenants, {sticky['prefix_installs']} "
+            f"installs vs freest-first's {freest['prefix_installs']}; "
+            f"worst victim flood/control TTFT p99 ratio {worst:.1f}x)"
+        ),
+        "vs_baseline": round(
+            sticky["tokens_per_second"]
+            / max(freest["tokens_per_second"], 1e-9), 2,
+        ),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
-                 "serve", "fleet", "scale", "chaos-serve", "learn"),
+                 "serve", "fleet", "scale", "chaos-serve", "learn",
+                 "tenants"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -2071,15 +2680,18 @@ if __name__ == "__main__":
         " quarantine/probe + parity + TTFT/recovery gates); learn = ES-train"
         " a policy network in the compiled twin, then gate it (fidelity 0"
         " divergences, beats train-tuned sweep winners on held-out scenario"
-        " variants, zero chaos regression)",
+        " variants, zero chaos regression); tenants = multi-tenant fair"
+        " admission battery (flood isolation under DRR, sticky-vs-freest"
+        " prefix locality, tenancy-off byte-identity, exactly-once per"
+        " tenant)",
     )
     cli.add_argument(
         "--output", default="",
         help="artifact path for --suite forecast/replay/sweep/chaos/serve/"
-        "fleet/scale/chaos-serve/learn (defaults: BENCH_r06.json /"
+        "fleet/scale/chaos-serve/learn/tenants (defaults: BENCH_r06.json /"
         " BENCH_r07.json / BENCH_r08.json / BENCH_r09.json / BENCH_r10.json"
         " / BENCH_r11.json / BENCH_r12.json / BENCH_r13.json /"
-        " BENCH_r14.json)",
+        " BENCH_r14.json / BENCH_r15.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -2102,5 +2714,9 @@ if __name__ == "__main__":
         ))
     elif cli_args.suite == "learn":
         print(json.dumps(run_learn_suite(cli_args.output or "BENCH_r14.json")))
+    elif cli_args.suite == "tenants":
+        print(json.dumps(
+            run_tenants_suite(cli_args.output or "BENCH_r15.json")
+        ))
     else:
         print(json.dumps(run_bench()))

@@ -244,6 +244,7 @@ class WorkerPool(FleetPoolBase):
         self.members: list[Replica] = []
         self.retired_keep = 32
         self._retired_processed = 0
+        self._retired_tenant: dict[str, int] = {}
         self._next_index = 0
         self._spawn_ordinal = 0  # factory invocations (pre-commit safe)
         self._orphans: list[dict] = []  # re-dispatch queue (priority)
@@ -531,6 +532,25 @@ class WorkerPool(FleetPoolBase):
         ]
         for replica in retired[: -self.retired_keep or None]:
             self._retired_processed += replica.worker.processed
+            counts = getattr(replica.worker, "completed_by_tenant", {})
+            if counts:
+                # deferred import: workloads pulls jax and the bare
+                # fleet seam must stay importable without it; only
+                # tenancy pools (real serving workers) reach this
+                from ..workloads.service import bounded_tenant_key
+
+                for tenant, count in counts.items():
+                    # re-apply the per-worker label-cardinality bound
+                    # at the pool fold: every fresh replica accepts up
+                    # to MAX_TENANT_SERIES NEW labels, so an unbounded
+                    # fold would grow ~512 entries per retired replica
+                    # under churn with adversarial unique labels
+                    tenant = bounded_tenant_key(
+                        tenant, self._retired_tenant
+                    )
+                    self._retired_tenant[tenant] = (
+                        self._retired_tenant.get(tenant, 0) + count
+                    )
             self.members.remove(replica)
 
     @property
@@ -543,10 +563,30 @@ class WorkerPool(FleetPoolBase):
         )
 
     @property
+    def completed_by_tenant(self) -> dict[str, int]:
+        """Uniquely-answered completions per tenant over the fleet's
+        lifetime.  Exactly-once by construction: each worker counts a
+        tenant completion only on a settle that actually answered (the
+        pool registry's duplicate-suppression path returns before the
+        counter), so visibility-timeout redeliveries and dead-replica
+        re-dispatches never double-book a tenant."""
+        totals = dict(self._retired_tenant)
+        for replica in self.members:
+            for tenant, count in getattr(
+                replica.worker, "completed_by_tenant", {}
+            ).items():
+                totals[tenant] = totals.get(tenant, 0) + count
+        return totals
+
+    @property
     def idle(self) -> bool:
-        """Nothing in flight anywhere and nothing awaiting re-dispatch."""
+        """Nothing in flight anywhere and nothing awaiting re-dispatch.
+        Fair-admission staging counts as in flight: a staged message's
+        receipt handle is live, so a pool declared idle with staged
+        work would strand it for the full visibility timeout."""
         return not self._orphans and all(
             r.worker.batcher.active == 0
+            and getattr(r.worker, "staged", 0) == 0
             for r in self.members
             if r.state in (SERVING, DRAINING)
         )
@@ -632,6 +672,7 @@ class WorkerPool(FleetPoolBase):
         result_queue=None,
         mesh=None,
         engine_source=None,
+        tenancy=None,
         **pool_kwargs,
     ) -> "WorkerPool":
         """A pool of real :class:`~.worker.FleetWorker` replicas over one
@@ -661,7 +702,7 @@ class WorkerPool(FleetPoolBase):
                 queue, params, model_config, seeded,
                 family=family, tokenizer=tokenizer,
                 result_queue=result_queue, mesh=mesh,
-                pool=pool,
+                pool=pool, tenancy=tenancy,
                 engine_source=pool.engine_donor() or engine_source,
             )
 

@@ -365,8 +365,16 @@ class ShardedWorkerPool(FleetPoolBase):
         return self.worker.processed
 
     @property
+    def completed_by_tenant(self) -> dict[str, int]:
+        """Uniquely-answered completions per tenant (the plane has one
+        worker; the exactly-once discipline is the same registry-backed
+        settle path as the replica pool's)."""
+        return dict(getattr(self.worker, "completed_by_tenant", {}))
+
+    @property
     def idle(self) -> bool:
-        return self.worker.batcher.active == 0
+        return (self.worker.batcher.active == 0
+                and getattr(self.worker, "staged", 0) == 0)
 
     def stop_all(self) -> None:
         """Stop the plane, releasing un-finished in-flight requests back
@@ -452,6 +460,7 @@ class ShardedWorkerPool(FleetPoolBase):
         mesh=None,
         engine_source=None,
         now_fn=None,
+        tenancy=None,
         **pool_kwargs,
     ) -> "ShardedWorkerPool":
         """One gang-stepped :class:`~.worker.FleetWorker` whose batcher
@@ -478,6 +487,7 @@ class ShardedWorkerPool(FleetPoolBase):
                 family=family, tokenizer=tokenizer,
                 result_queue=result_queue, mesh=mesh, pool=pool,
                 engine_source=engine_source, now_fn=now_fn,
+                tenancy=tenancy,
                 # force the gang engine even for a one-shard plane (the
                 # worker's auto-pick would build the plain batcher,
                 # which has no shard surface to actuate)

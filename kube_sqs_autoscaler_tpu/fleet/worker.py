@@ -135,6 +135,13 @@ class FleetWorker(ContinuousWorker):
             if slot.busy:
                 messages.append(slot.payload)
                 self.batcher.slots[row] = _Slot()
+        # fair-admission staging holds received-but-unadmitted messages
+        # (live receipt handles): they are in-flight work too — strand
+        # them and a dead replica's staged requests wait out the full
+        # visibility timeout instead of failing over with its slots
+        if self._fair is not None:
+            for _tenant, item in self._fair.pick(self._fair.staged):
+                messages.append(item[3])
         return messages
 
     def release_inflight(self) -> int:

@@ -453,6 +453,54 @@ class WorkloadMetrics:
             labels=labels,
         )
 
+    def set_tenant_gauges(
+        self,
+        tenant: str,
+        *,
+        queue_depth: int,
+        ttft_seconds: float,
+        tokens_per_second: float,
+    ) -> None:
+        """The multi-tenant admission plane's per-tenant gauge family
+        (one labeled series per tenant, refreshed every engine cycle by
+        a tenancy-enabled :class:`~..workloads.continuous.ContinuousWorker`)."""
+        labels = (("tenant", tenant),)
+        self.set_gauge(
+            "tenant_queue_depth", queue_depth,
+            "Requests staged in this tenant's fair-admission sub-queue "
+            "(the DRR lookahead window, not the shared queue's backlog).",
+            labels=labels,
+        )
+        self.set_gauge(
+            "tenant_ttft_seconds", ttft_seconds,
+            "Mean seconds to first generated token over this tenant's "
+            "recent requests, measured from QUEUE ARRIVAL "
+            "(SentTimestamp) when the queue stamps it, else from "
+            "admission — the queue wait is where a flooding tenant "
+            "starves its victims, so this is the isolation signal.",
+            labels=labels,
+        )
+        self.set_gauge(
+            "tenant_tokens_per_second", tokens_per_second,
+            "Generated tokens per second attributed to this tenant over "
+            "the worker's serving lifetime.",
+            labels=labels,
+        )
+
+    def set_build_info(self, version: str, **labels: str) -> None:
+        """The workload binary's ``build_info`` stamp (value 1, identity
+        in the labels — the serving twin of the controller registry's
+        build_info): version plus whatever deployment knobs the caller
+        wants scrape-visible, e.g. the tenancy flags."""
+        rendered = (("version", version),) + tuple(
+            (name, str(value)) for name, value in sorted(labels.items())
+        )
+        self.set_gauge(
+            "build_info", 1.0,
+            "Workload build/deployment identity; value is always 1.",
+            labels=rendered,
+        )
+
     @property
     def ready(self) -> bool:
         """Readiness = at least one gauge sample or timed span recorded."""

@@ -141,17 +141,24 @@ def instant_trace_events(
     Shard-domain events (``shard-*``: activate/drain as well as the
     chaos loop's quarantine/probe/readmit instants) get their own
     ``"shard"`` category so Perfetto can filter the shard failure
-    domain separately from replica lifecycle events.
+    domain separately from replica lifecycle events; prefix-pool
+    residency decisions (``prefix-*``: the per-tenant pool's
+    install/evict instants) likewise land under ``"prefix"``.
     """
     events = list(events)
     if not events:
         return []
     origin = events[0].t if time_origin is None else time_origin
+
+    def _cat(name: str) -> str:
+        if name.startswith("shard-"):
+            return "shard"
+        if name.startswith("prefix-"):
+            return "prefix"
+        return "fleet"
+
     return [
-        _instant(
-            e.name, e.t - origin, dict(e.args),
-            cat="shard" if e.name.startswith("shard-") else "fleet",
-        )
+        _instant(e.name, e.t - origin, dict(e.args), cat=_cat(e.name))
         for e in events
     ]
 

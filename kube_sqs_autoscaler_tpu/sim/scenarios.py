@@ -356,3 +356,194 @@ def scenario_variants(
                 )
             )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant serving scenarios: deterministic per-cycle request schedules
+# ---------------------------------------------------------------------------
+#
+# The arrival processes above drive the FLUID autoscaling world (messages
+# per second into a depth integral).  The tenant battery instead drives
+# the REAL serving engine cycle by cycle, so its schedules are integer
+# send counts at exact engine cycles — adversarial shapes (one tenant
+# floods, victims must keep their TTFT) stay bit-reproducible without
+# any arrival quadrature.
+
+
+@dataclass(frozen=True)
+class TenantTraffic:
+    """One tenant's deterministic send schedule within a scenario.
+
+    The tenant sends ``per_cycle`` requests at every cycle ``c`` with
+    ``start_cycle <= c < end_cycle`` and ``(c - start_cycle) % every ==
+    0`` (``end_cycle=None`` = the scenario's full span).  ``weight`` is
+    the DRR share the episode configures for it; ``ttft_slo_s`` its
+    TTFT SLO (0 = none); ``flood=True`` marks the adversary the
+    isolation gates exclude from the victim set."""
+
+    tenant: str
+    weight: float = 1.0
+    per_cycle: int = 1
+    every: int = 1
+    start_cycle: int = 0
+    end_cycle: "int | None" = None
+    ttft_slo_s: float = 0.0
+    flood: bool = False
+
+    def __post_init__(self) -> None:
+        if self.per_cycle < 0:
+            raise ValueError("per_cycle must be >= 0")
+        if self.every < 1:
+            raise ValueError("every must be >= 1")
+        if self.start_cycle < 0:
+            raise ValueError("start_cycle must be >= 0")
+        if self.end_cycle is not None and self.end_cycle < self.start_cycle:
+            raise ValueError("end_cycle must be >= start_cycle")
+
+    def sends_at(self, cycle: int, span: int) -> int:
+        """Requests this tenant sends at engine cycle ``cycle`` of a
+        ``span``-cycle schedule."""
+        end = span if self.end_cycle is None else min(self.end_cycle, span)
+        if not self.start_cycle <= cycle < end:
+            return 0
+        if (cycle - self.start_cycle) % self.every:
+            return 0
+        return self.per_cycle
+
+
+@dataclass(frozen=True)
+class TenantScenario:
+    """A named multi-tenant traffic shape over ``cycles`` engine cycles."""
+
+    name: str
+    cycles: int
+    traffics: "tuple[TenantTraffic, ...]"
+    description: str = ""
+
+    def __post_init__(self) -> None:
+        if self.cycles < 1:
+            raise ValueError("cycles must be >= 1")
+        names = [t.tenant for t in self.traffics]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenants in scenario {self.name}")
+
+    @property
+    def tenants(self) -> "tuple[str, ...]":
+        return tuple(t.tenant for t in self.traffics)
+
+    @property
+    def victims(self) -> "tuple[str, ...]":
+        return tuple(t.tenant for t in self.traffics if not t.flood)
+
+    def total_requests(self) -> int:
+        return sum(
+            t.sends_at(c, self.cycles)
+            for t in self.traffics
+            for c in range(self.cycles)
+        )
+
+    def schedule(self) -> "list[list[tuple[str, int]]]":
+        """``schedule()[c]`` = this cycle's ``(tenant, send_count)``
+        pairs in declared tenant order — the bench interleaves these
+        sends with real engine cycles."""
+        return [
+            [
+                (t.tenant, t.sends_at(c, self.cycles))
+                for t in self.traffics
+                if t.sends_at(c, self.cycles)
+            ]
+            for c in range(self.cycles)
+        ]
+
+
+def seeded_token_ids(tag: str, n: int, vocab: int) -> "list[int]":
+    """``n`` token ids drawn from a sha256-of-``tag``-seeded stream —
+    the one seeding convention every deterministic token stream in the
+    tenant battery uses (prefixes here, per-request suffixes in the
+    bench), so the two can never silently desynchronize."""
+    digest = hashlib.sha256(tag.encode()).digest()
+    rng = random.Random(int.from_bytes(digest[:8], "big"))
+    return [rng.randrange(1, max(2, vocab)) for _ in range(n)]
+
+
+def tenant_prefix_ids(
+    tenant: str, prefix_len: int, vocab: int, seed: int = 0
+) -> "list[int]":
+    """The tenant's shared prompt prefix: ``prefix_len`` token ids drawn
+    from a hash-seeded stream, so every (tenant, seed) pair gets a
+    distinct, reproducible prefix without any shared RNG state."""
+    return seeded_token_ids(
+        f"tenant-prefix:{tenant}:{seed}", prefix_len, vocab
+    )
+
+
+def flood_scenario(
+    *, victims: int = 2, cycles: int = 40, flood_start: int = 4,
+    flood_cycles: int = 8, flood_per_cycle: int = 8,
+) -> TenantScenario:
+    """One adversary floods a burst while victims trickle steadily —
+    the isolation shape: with FIFO admission every victim request
+    arriving during (or after) the burst waits behind the whole flood
+    backlog; with DRR each refill still hands the victims their share."""
+    traffics = [
+        TenantTraffic(
+            tenant="flood", weight=1.0, per_cycle=flood_per_cycle,
+            start_cycle=flood_start,
+            end_cycle=flood_start + flood_cycles, flood=True,
+        )
+    ]
+    for v in range(victims):
+        traffics.append(
+            TenantTraffic(tenant=f"victim{v}", weight=1.0, per_cycle=1,
+                          every=4, start_cycle=v)
+        )
+    return TenantScenario(
+        name="flood-isolation", cycles=cycles, traffics=tuple(traffics),
+        description=(
+            "one tenant bursts %d req/cycle for %d cycles; %d victims "
+            "send 1 req every 4 cycles throughout"
+            % (flood_per_cycle, flood_cycles, victims)
+        ),
+    )
+
+
+def prefix_share_scenario(
+    *, tenants: int = 6, cycles: int = 48, every: int = 2,
+) -> TenantScenario:
+    """Many tenants, each reusing its own shared prefix — the locality
+    shape the sticky-vs-freest routing comparison runs on: more tenants
+    than one shard's pool entries, so scattered routing re-installs and
+    LRU-thrashes prefixes that sticky routing keeps resident."""
+    return TenantScenario(
+        name="prefix-share", cycles=cycles,
+        traffics=tuple(
+            TenantTraffic(tenant=f"tenant{i}", per_cycle=1, every=every,
+                          start_cycle=i % every)
+            for i in range(tenants)
+        ),
+        description=(
+            "%d prefix-sharing tenants, 1 req each every %d cycles"
+            % (tenants, every)
+        ),
+    )
+
+
+def default_tenant_battery() -> "list[TenantScenario]":
+    """The adversarial-tenant battery ``bench.py --suite tenants``
+    scores: flood isolation plus the prefix-sharing locality shape
+    (the no-flood control is derived from the flood scenario by
+    dropping its flood traffic — see the bench)."""
+    return [flood_scenario(), prefix_share_scenario()]
+
+
+def without_flood(scenario: TenantScenario) -> TenantScenario:
+    """The scenario's no-flood control: identical victim schedules,
+    adversary removed — the baseline the isolation gate compares
+    victim TTFT against."""
+    import dataclasses
+
+    return dataclasses.replace(
+        scenario,
+        name=f"{scenario.name}~control",
+        traffics=tuple(t for t in scenario.traffics if not t.flood),
+    )

@@ -117,6 +117,36 @@ def main(argv=None) -> None:
              "each device holds whole shards)",
     )
     parser.add_argument(
+        "--tenants", default="", metavar="NAME,NAME,...",
+        help="multi-tenant fair admission: per-tenant sub-queues feed "
+             "the continuous batcher through deficit-round-robin "
+             "admission (one flooding tenant can no longer starve the "
+             "others' TTFT), with per-tenant Prometheus gauges; message "
+             "bodies opt in via {'tenant': ..., 'ids': [...]} and "
+             "unlabeled traffic lands on the FIRST listed tenant "
+             "(single default tenant = the reference FIFO path, "
+             "byte-identical results; requires --continuous; plain "
+             "decode path only — not with --beams / "
+             "--speculative-draft-layers)",
+    )
+    parser.add_argument(
+        "--tenant-weights", default="", metavar="W,W,...",
+        help="DRR weights aligned with --tenants (floats >= 0.01, one "
+             "per tenant; default: all 1.0 — equal shares)",
+    )
+    parser.add_argument(
+        "--prefix-pool", type=int, default=0, metavar="N",
+        help="per-tenant prefix-cache pool: keep N resident prefix "
+             "entries per shard with LRU eviction — a tenant's shared "
+             "prompt prefix ({'prefix': [...]} in the body, exactly "
+             "--seq-len tokens) is prefilled once at install and every "
+             "reuse gathers the cached KV inside the one admission "
+             "insert; on the sharded plane requests route sticky "
+             "(affinity-first-then-freest) so tenants keep their hits "
+             "(0 = off; requires --tenants; not with --prefix-ids or "
+             "--model-parallel)",
+    )
+    parser.add_argument(
         "--request-ttl", type=float, default=0.0, metavar="SECONDS",
         help="continuous serving: shed requests already older than this "
              "on arrival (queue SentTimestamp age) with an explicit "
@@ -223,6 +253,15 @@ def main(argv=None) -> None:
         help="lower replica bound for --fleet-max-replicas",
     )
     parser.add_argument(
+        "--journal-path", default="", metavar="PATH",
+        help="append the fleet control loop's tick records to this "
+             "JSONL flight journal (the controller CLI's recorder, "
+             "pointed at the serving fleet; the header meta stamps the "
+             "deployment knobs incl. the tenancy config so a reader "
+             "knows which admission policy ran; requires "
+             "--fleet-max-replicas; empty = disabled)",
+    )
+    parser.add_argument(
         "--demo", type=int, default=0, metavar="N",
         help="process N random messages from a local in-memory queue and exit",
     )
@@ -282,6 +321,78 @@ def main(argv=None) -> None:
                 "--shards applies to the plain continuous decode path "
                 "(not --beams / --speculative-draft-layers)"
             )
+    tenancy = None
+    if args.tenants:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --decode-block checks above)
+        if not args.continuous:
+            raise SystemExit("--tenants requires --continuous")
+        if args.beams > 1 or args.speculative_draft_layers:
+            raise SystemExit(
+                "--tenants applies to the plain continuous decode path "
+                "(not --beams / --speculative-draft-layers)"
+            )
+        tenant_names = tuple(
+            s.strip() for s in args.tenants.split(",") if s.strip()
+        )
+        if not tenant_names:
+            raise SystemExit("--tenants is empty")
+        weights: tuple[float, ...] = ()
+        if args.tenant_weights:
+            try:
+                weights = tuple(
+                    float(s) for s in args.tenant_weights.split(",")
+                    if s.strip()
+                )
+            except ValueError as err:
+                raise SystemExit(
+                    f"--tenant-weights must be floats ({err})"
+                )
+        if args.prefix_pool < 0:
+            raise SystemExit(
+                f"--prefix-pool {args.prefix_pool} must be >= 0 (0 = off)"
+            )
+        if args.prefix_pool:
+            if args.prefix_ids:
+                raise SystemExit(
+                    "--prefix-pool and --prefix-ids are mutually "
+                    "exclusive (the pool generalizes the single "
+                    "broadcast prefix)"
+                )
+            if args.model_parallel:
+                raise SystemExit(
+                    "--prefix-pool is single-chip for now (not with "
+                    "--model-parallel)"
+                )
+            if args.prefix_pool < args.batch_size:
+                raise SystemExit(
+                    f"--prefix-pool {args.prefix_pool} must be >= "
+                    f"--batch-size {args.batch_size} (one refill can "
+                    "admit that many distinct prefixes per shard; a "
+                    "smaller pool could LRU-evict an entry the same "
+                    "admission batch still references)"
+                )
+        from .tenancy import TenancyConfig
+
+        try:
+            tenancy = TenancyConfig(
+                tenants=tenant_names, weights=weights,
+                prefix_pool=args.prefix_pool,
+                prefix_len=args.seq_len if args.prefix_pool else 0,
+            )
+        except ValueError as err:
+            # weight/tenant count mismatches, non-positive weights:
+            # usage errors at startup, never mid-cycle tracebacks
+            raise SystemExit(str(err))
+    elif args.tenant_weights:
+        raise SystemExit("--tenant-weights requires --tenants")
+    elif args.prefix_pool:
+        raise SystemExit("--prefix-pool requires --tenants")
+    if args.journal_path and not args.fleet_max_replicas:
+        raise SystemExit(
+            "--journal-path records the fleet control loop "
+            "(requires --fleet-max-replicas)"
+        )
     prefix_ids: list[int] = []
     if args.prefix_ids:
         try:
@@ -805,9 +916,17 @@ def main(argv=None) -> None:
             pool = WorkerPool.serving(
                 queue, params, model_config, service_config,
                 family=family, tokenizer=tokenizer,
-                result_queue=result_queue, mesh=mesh,
+                result_queue=result_queue, mesh=mesh, tenancy=tenancy,
                 min=args.fleet_min_replicas, max=args.fleet_max_replicas,
             )
+            journal = None
+            if args.journal_path:
+                from ..obs import TickJournal
+
+                journal = TickJournal(
+                    args.journal_path,
+                    meta=_fleet_journal_meta(args, tenancy),
+                )
             loop = ControlLoop(
                 pool,
                 QueueMetricSource(queue, service_config.queue_url,
@@ -821,6 +940,7 @@ def main(argv=None) -> None:
                         scale_down_cooldown=0.4,
                     ),
                 ),
+                observer=journal,
             )
             driver = FleetDriver(pool, loop)
             start = time.perf_counter()
@@ -837,6 +957,8 @@ def main(argv=None) -> None:
                 pool.redispatched_total, pool.duplicates_suppressed,
             )
             pool.stop_all()
+            if journal is not None:
+                journal.close()
             if result_queue is not None:
                 for message in result_queue.receive_messages(
                         args.result_queue_url, max_messages=2):
@@ -853,8 +975,10 @@ def main(argv=None) -> None:
                 draft_tokens=args.speculative_draft_tokens,
                 beams=args.beams,
                 length_penalty=args.length_penalty,
+                tenancy=tenancy,
             )
-            obs = _maybe_serve_metrics(args.metrics_port, cworker)
+            obs = _maybe_serve_metrics(args.metrics_port, cworker,
+                                       tenancy=tenancy)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
             elapsed = time.perf_counter() - start
@@ -909,8 +1033,9 @@ def main(argv=None) -> None:
             draft_tokens=args.speculative_draft_tokens,
             beams=args.beams,
             length_penalty=args.length_penalty,
+            tenancy=tenancy,
         )
-        _maybe_serve_metrics(args.metrics_port, cworker)
+        _maybe_serve_metrics(args.metrics_port, cworker, tenancy=tenancy)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)
         cworker.run_forever()
         return
@@ -926,17 +1051,62 @@ def main(argv=None) -> None:
     worker.run_forever()
 
 
-def _maybe_serve_metrics(port: int, worker):
+def _fleet_journal_meta(args, tenancy) -> dict:
+    """The serving-fleet journal's header meta: which deployment knobs
+    (incl. the tenancy/admission policy) produced these tick lines —
+    the serving twin of the controller CLI's ``_journal_meta``."""
+    return {
+        "source": "serving-fleet",
+        "queue_url": "demo://queue",
+        "world": {
+            "min_pods": args.fleet_min_replicas,
+            "max_pods": args.fleet_max_replicas,
+        },
+        "serving": {
+            "batch_size": args.batch_size,
+            "generate_tokens": args.generate_tokens,
+            "decode_block": args.decode_block,
+            "shards": args.shards,
+        },
+        # tenancy knobs: a journal reader must know which admission
+        # policy (DRR weights, prefix pool, stickiness) shaped the
+        # depth trajectory it is looking at
+        "tenancy": (
+            {
+                "tenants": list(tenancy.tenants),
+                "weights": list(tenancy.weights),
+                "prefix_pool": tenancy.prefix_pool,
+                "prefix_len": tenancy.prefix_len,
+                "sticky": tenancy.sticky,
+                "fair": tenancy.fair,
+            }
+            if tenancy is not None
+            else {}
+        ),
+    }
+
+
+def _maybe_serve_metrics(port: int, worker, tenancy=None):
     """Start /metrics with the worker's serve-cycle SpanTimer attached
     (``--metrics-port 0`` = disabled).  Continuous workers additionally
     publish the serving gauges (tokens/s, time-to-first-token, active
-    slots, decode-block utilization), refreshed every engine cycle."""
+    slots, decode-block utilization), refreshed every engine cycle;
+    tenancy-enabled workers the per-tenant families and a build_info
+    stamp naming the tenancy deployment knobs."""
     if not port:
         return None
+    from .. import __version__
     from ..obs import ObservabilityServer, WorkloadMetrics
 
     metrics = WorkloadMetrics()
     metrics.attach_timer("worker", worker.timer)
+    if tenancy is not None:
+        metrics.set_build_info(
+            __version__,
+            tenants=",".join(tenancy.tenants),
+            tenant_weights=",".join(str(w) for w in tenancy.weights),
+            prefix_pool=tenancy.prefix_pool,
+        )
     if hasattr(worker, "attach_metrics"):
         worker.attach_metrics(metrics)
     server = ObservabilityServer(metrics, port=port)

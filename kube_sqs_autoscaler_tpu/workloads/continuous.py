@@ -140,6 +140,98 @@ def _insert_rows_impl(
     )
 
 
+def _family_chunk_fn(family: str, quantized_kv: bool):
+    """The family/layout chunk decoder the pooled insert continues
+    suffixes through (the same pick :func:`_rows_prefill` makes for the
+    broadcast-prefix path, minus the broadcast)."""
+    if quantized_kv:
+        if family == "llama":
+            from .llama import llama_quantized_chunk_decode as fn
+        else:
+            from .decode import quantized_chunk_decode as fn
+    elif family == "llama":
+        from .llama import llama_chunk_decode as fn
+    else:
+        from .decode import chunk_decode as fn
+    return fn
+
+
+def _insert_rows_pooled_impl(
+    params: dict,
+    cache: dict,
+    current: jax.Array,
+    done: jax.Array,
+    remaining: jax.Array,
+    rows: jax.Array,
+    prompts: jax.Array,
+    lengths: jax.Array,
+    key: jax.Array | None,
+    entry_idx: jax.Array,
+    pool_layers: list,
+    config: Any,
+    prompt_len: int,
+    n_rows: int,
+    budget: int,
+    family: str = "gpt",
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    quantized_kv: bool = False,
+    pool_prefix_len: int = 0,
+    eos_id: int | None = None,
+) -> tuple[dict, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """:func:`_insert_rows_impl` for the per-tenant prefix-cache pool:
+    each row's shared-prefix KV is GATHERED from the pool's stacked
+    device rows by ``entry_idx`` (int32 ``[n_rows]``) instead of
+    re-prefilled — the prefix forward was paid once at
+    :meth:`~.tenancy.PrefixPool.acquire` install time and every request
+    that reuses the entry skips it entirely.  The suffix prompts run
+    one chunk-decode forward continuing from the gathered per-row
+    prefixes (the multi-prefix generalization of
+    :func:`~.decode.prefill_with_prefix`, which broadcasts a single
+    batch-1 prefix), then prefix + suffix splice into the slot rows and
+    the per-row lengths / pending tokens / liveness masks fold in
+    exactly as the plain insert folds them.  Still ONE device call and
+    ZERO host syncs per refill cycle, whatever mix of tenants the batch
+    carries."""
+    gathered = [
+        {name: buf[entry_idx] for name, buf in layer.items()}
+        for layer in pool_layers
+    ]
+    prefix_rows = {
+        "layers": gathered,
+        "length": jnp.full((n_rows,), pool_prefix_len, jnp.int32),
+    }
+    chunk_fn = _family_chunk_fn(family, quantized_kv)
+    logits_all, rows_cache = chunk_fn(params, prefix_rows, prompts, config)
+    logits = logits_all[jnp.arange(n_rows), lengths.astype(jnp.int32) - 1]
+    new_layers = _splice_rows_layers(
+        cache, rows_cache, rows, 0, pool_prefix_len + prompt_len, n_rows
+    )
+    full_lengths = cache["length"].at[rows].set(pool_prefix_len + lengths)
+    firsts = _pick(logits, key, temperature, top_k, top_p)
+    current = current.at[rows].set(firsts)
+    first_done = (
+        firsts == eos_id if eos_id is not None
+        else jnp.zeros((n_rows,), bool)
+    )
+    done = done.at[rows].set(first_done)
+    remaining = remaining.at[rows].set(budget - 1)
+    return (
+        {"layers": new_layers, "length": full_lengths},
+        current, done, remaining, firsts,
+    )
+
+
+# the shared tenant-label cardinality bound (see workloads/service.py:
+# the jax-free fleet pool applies the same bound to its retired fold)
+from .service import (  # noqa: E402
+    MAX_TENANT_SERIES,
+    OTHER_TENANTS,
+    bounded_tenant_key as _bounded_tenant_key,
+)
+
+
 def _rows_prefill(params, prompts, lengths, config, family, quantized_kv,
                   prefix_len, prefix_cache):
     """``M`` prompts' prefill as one ``[M, P]`` batch through the
@@ -309,6 +401,17 @@ _spec_insert_row = partial(
 )(_spec_insert_row_impl)
 
 
+# the pool buffers ride as (undonated) operands: they are shared by
+# every future insert — only the batcher's rolling state rolls in place
+_insert_rows_pooled = partial(
+    jax.jit,
+    static_argnames=("config", "prompt_len", "n_rows", "budget", "family",
+                     "temperature", "top_k", "top_p", "quantized_kv",
+                     "pool_prefix_len", "eos_id"),
+    donate_argnums=(1, 2, 3, 4),
+)(_insert_rows_pooled_impl)
+
+
 def _beam_insert_row_impl(
     params: dict,
     cache: dict,
@@ -393,6 +496,15 @@ class _Slot:
     accepted: int = 0
     # admission wall-clock, for the time-to-first-token gauge
     submitted_at: float = 0.0
+    # multi-tenant serving: the admitting tenant's label ("" = tenancy
+    # off — the per-tenant attribution below is skipped entirely), and
+    # the request's QUEUE arrival time (epoch seconds from its
+    # SentTimestamp).  Per-tenant TTFT counts from arrival, not from
+    # admission: the queue/staging wait is exactly where a flooding
+    # tenant starves its victims, so an admission-based TTFT would
+    # define the isolation problem away.
+    tenant: str = ""
+    arrived_at: float | None = None
     # TTFT already recorded (set at the first settle; pre-set on
     # evacuated/resumed rows so a request's TTFT is measured once, at
     # its FIRST first token, never again on a later shard)
@@ -435,9 +547,15 @@ class ContinuousBatcher:
         beams: int = 1,
         length_penalty: float = 0.0,
         decode_block: int = 1,
+        tenancy=None,
     ) -> None:
         if beams < 1:
             raise ValueError(f"beams={beams} must be >= 1")
+        if tenancy is not None and (beams > 1 or draft_layers):
+            raise ValueError(
+                "tenancy applies to the plain continuous decode path "
+                "(not beams / speculative slots)"
+            )
         if decode_block < 1:
             raise ValueError(f"decode_block={decode_block} must be >= 1")
         if decode_block > 1 and (beams > 1 or draft_layers):
@@ -522,6 +640,74 @@ class ContinuousBatcher:
         self.beams = beams
         self.length_penalty = length_penalty
         self.decode_block = decode_block
+        # multi-tenant admission (workloads/tenancy.py): per-tenant
+        # token/TTFT attribution always-on once configured; the prefix
+        # pool below only when tenancy.prefix_pool > 0.  tenancy=None
+        # keeps every per-cycle path byte-identical to today.
+        self.tenancy = tenancy
+        self._prefix_pool = None
+        self._pool_prefix_len = 0
+        import collections
+
+        self.tenant_tokens: dict[str, int] = {}
+        self.tenant_ttft: dict[str, Any] = {}
+        self._tenant_ttft_deque = partial(collections.deque, maxlen=1024)
+        # epoch clock for arrival-based per-tenant TTFT — the worker
+        # rebinds it to its request-TTL clock so FakeClock episodes and
+        # SQS SentTimestamps share one time base
+        self._epoch_now = time.time
+        # tenant -> home shard for sticky routing (bounded; the sharded
+        # plane's router consults it, the plain batcher never does)
+        self._tenant_home: Any = collections.OrderedDict()
+        if tenancy is not None and tenancy.prefix_pool > 0:
+            if prefix_cache is not None:
+                raise ValueError(
+                    "the per-tenant prefix pool and the single global "
+                    "prefix_cache are mutually exclusive (the pool IS "
+                    "the generalization of the broadcast prefix)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "the per-tenant prefix pool is single-chip for now "
+                    "(the pooled insert's gather is not mesh-sharded)"
+                )
+            if tenancy.prefix_len < 1:
+                raise ValueError(
+                    "tenancy.prefix_len must be >= 1 when prefix_pool "
+                    "is enabled (the pool's static prefix bucket)"
+                )
+            pooled_budget = (tenancy.prefix_len + prompt_len
+                             + generate_tokens)
+            if pooled_budget > config.max_seq_len:
+                raise ValueError(
+                    f"pool prefix_len + prompt_len + generate_tokens = "
+                    f"{pooled_budget} exceeds max_seq_len="
+                    f"{config.max_seq_len}"
+                )
+            shard_slots = getattr(self, "shard_slots", batch_size)
+            if tenancy.prefix_pool < shard_slots:
+                # one refill can admit shard_slots distinct prefixes to
+                # a shard; with entries >= shard_slots every same-batch
+                # entry sits at the LRU's MRU end when the next install
+                # picks a victim, so an eviction can never overwrite a
+                # pool row an earlier request in the SAME batched insert
+                # is about to gather (silent cross-tenant KV corruption)
+                raise ValueError(
+                    f"prefix_pool={tenancy.prefix_pool} must be >= the "
+                    f"per-shard slot count ({shard_slots}) so a single "
+                    "admission batch can never LRU-evict an entry "
+                    "another row of the same batch still references"
+                )
+            from .tenancy import PrefixPool
+
+            self._pool_prefix_len = tenancy.prefix_len
+            self._prefix_pool = PrefixPool(
+                params, config,
+                entries=tenancy.prefix_pool,
+                prefix_len=tenancy.prefix_len,
+                shards=getattr(self, "shards", 1),
+                family=family, quantized_kv=quantized_kv,
+            )
         # aggregate speculative stats (per-request stats ride the slots)
         self.spec_rounds = 0
         self.spec_accepted = 0
@@ -709,6 +895,8 @@ class ContinuousBatcher:
             # fleet — an evacuation wave hits one compile, not one per
             # engine
             self._resume_insert = self._make_insert_many(resume=True)
+            if self._prefix_pool is not None:
+                self._pooled_insert = self._make_insert_pooled()
             if decode_block > 1:
                 self._block_fn = self._make_block_fn()
             else:
@@ -753,6 +941,12 @@ class ContinuousBatcher:
             )
         self._insert_many = source._insert_many
         self._resume_insert = source._resume_insert
+        if (self._prefix_pool is not None
+                and source._prefix_pool is not None):
+            # the pooled insert closes over statics only (pool buffers
+            # ride as operands), so replicas share one compile for it
+            # too — each keeps its OWN pool rows and LRU state
+            self._pooled_insert = source._pooled_insert
         if self.decode_block > 1:
             self._block_fn = source._block_fn
         else:
@@ -765,6 +959,7 @@ class ContinuousBatcher:
             self.family, self.temperature, self.top_k, self.top_p,
             self.eos_id, self.quantized_kv, self.prefix_len,
             self.decode_block, self.mesh is None,
+            self._pool_prefix_len,
         )
 
     def _make_insert_many(self, resume: bool = False):
@@ -855,6 +1050,23 @@ class ContinuousBatcher:
             return fn(*operands)
 
         return insert_many
+
+    def _make_insert_pooled(self):
+        """The prefix-pool admission jit: same shape discipline as
+        :meth:`_make_insert_many` (one compiled program per refill
+        size), plus the per-row pool entry indices and the pool's
+        stacked layer buffers as operands.  Single-chip only (checked
+        at construction)."""
+        statics = dict(
+            config=self.config, prompt_len=self.prompt_len,
+            budget=self.generate_tokens, family=self.family,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, quantized_kv=self.quantized_kv,
+            pool_prefix_len=self._pool_prefix_len, eos_id=self.eos_id,
+        )
+        return lambda *operands, n_rows: _insert_rows_pooled(
+            *operands, n_rows=n_rows, **statics,
+        )
 
     def _mesh_insert_jit(self, impl, statics, cache_shards):
         """The speculative insert's mesh wiring: pinned in/out shardings
@@ -1408,6 +1620,100 @@ class ContinuousBatcher:
         return rows
 
     @property
+    def prefix_pool(self):
+        """The per-tenant :class:`~.tenancy.PrefixPool` (None when
+        tenancy is off or ``prefix_pool == 0``)."""
+        return self._prefix_pool
+
+    def _route_prefixed(self, keys: list) -> list[int]:
+        """Rows for a prefixed admission batch, one per pool key.  The
+        single-plane batcher has nowhere to be sticky TO — admission
+        order is exactly :attr:`free_slots` order, like
+        :meth:`submit_many`.  The sharded plane overrides this with
+        affinity-first-then-freest routing."""
+        return self.free_slots[: len(keys)]
+
+    def _free_slot_count(self) -> int:
+        """Admission capacity as a bare count — the sharded plane
+        overrides this with a sum over its per-shard availability so
+        the capacity guard never pays the full routed ordering."""
+        return len(self.free_slots)
+
+    def _pool_shard_of(self, row: int) -> int:
+        """Which pool partition a slot row draws prefix entries from
+        (the sharded plane maps rows to their engine shard)."""
+        return 0
+
+    def submit_many_prefixed(
+        self, requests: list[tuple[str, np.ndarray, np.ndarray, Any]]
+    ) -> list[int]:
+        """Admit ``(tenant, prefix_ids, token_ids, payload)`` requests
+        through the per-tenant prefix pool; returns their slot rows.
+
+        Routing first (:meth:`_route_prefixed` — sticky on the sharded
+        plane), then each row's prefix entry is acquired on its row's
+        pool partition (LRU hit, or a one-time install prefill on
+        miss), then the WHOLE batch prefills as ONE pooled insert: the
+        compiled call gathers each row's prefix KV from the pool by
+        entry index and runs one suffix chunk forward — a pool hit
+        never re-prefills the shared prefix region.  Same zero
+        per-request host syncs as :meth:`submit_many`; first tokens
+        settle in the same deferred batched transfer."""
+        if self._prefix_pool is None:
+            raise ValueError(
+                "submit_many_prefixed needs tenancy with prefix_pool > 0"
+            )
+        if not requests:
+            return []
+        free = self._free_slot_count()
+        if len(requests) > free:
+            raise RuntimeError(
+                f"no free slot for {len(requests)} request(s) "
+                f"({free} free); call step() until slots open"
+            )
+        from .tenancy import prefix_pool_key
+
+        keys = [
+            prefix_pool_key(tenant, prefix_ids)
+            for tenant, prefix_ids, _, _ in requests
+        ]
+        rows = self._route_prefixed(keys)
+        entry_idx = [
+            self._prefix_pool.acquire(
+                self._pool_shard_of(row), key, prefix_ids
+            )
+            for row, key, (_, prefix_ids, _, _) in zip(rows, keys,
+                                                       requests)
+        ]
+        now = time.perf_counter()
+        padded = [self._pad_prompt(ids) for _, _, ids, _ in requests]
+        prompts = np.stack([ids for ids, _ in padded])
+        lengths = np.asarray([ln for _, ln in padded], np.int32)
+        (self.cache, self._current, self._done, self._remaining,
+         firsts) = self._pooled_insert(
+            self.params, self.cache, self._current, self._done,
+            self._remaining, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(prompts), jnp.asarray(lengths),
+            next(self._keys), jnp.asarray(entry_idx, jnp.int32),
+            self._prefix_pool.layers, n_rows=len(rows),
+        )
+        self.insert_dispatches += 1
+        self._pending_firsts.append((firsts, list(rows)))
+        for row, (tenant, _, _, payload) in zip(rows, requests):
+            self.slots[row] = _Slot(
+                busy=True, budget=self.generate_tokens, payload=payload,
+                submitted_at=now, tenant=tenant,
+            )
+        return rows
+
+    def tag_tenant(self, rows: list[int], tenants: list[str]) -> None:
+        """Label freshly-admitted slots with their tenants (the
+        pool-less tenancy path: plain :meth:`submit_many` admission,
+        per-tenant attribution still on)."""
+        for row, tenant in zip(rows, tenants):
+            self.slots[row].tenant = tenant
+
+    @property
     def resume_len(self) -> int:
         """The resume insert's static prompt bucket: a resumed row
         prefills its original (truncated) prompt plus everything it had
@@ -1532,6 +1838,11 @@ class ContinuousBatcher:
         device programs, not of bookkeeping)."""
         slot.produced.append(token)
         self.tokens_emitted += 1
+        if slot.tenant:
+            tenant = _bounded_tenant_key(slot.tenant, self.tenant_tokens)
+            self.tenant_tokens[tenant] = (
+                self.tenant_tokens.get(tenant, 0) + 1
+            )
         if self.eos_id is not None and token == self.eos_id:
             slot.done = True
 
@@ -1569,6 +1880,21 @@ class ContinuousBatcher:
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
                 self.ttft_samples.append(ttft)
+                if slot.tenant:
+                    tenant = _bounded_tenant_key(
+                        slot.tenant, self.tenant_ttft
+                    )
+                    samples = self.tenant_ttft.get(tenant)
+                    if samples is None:
+                        samples = self.tenant_ttft[tenant] = (
+                            self._tenant_ttft_deque()
+                        )
+                    # arrival-based when the queue stamped the request
+                    # (SentTimestamp), admission-based otherwise
+                    samples.append(
+                        max(0.0, self._epoch_now() - slot.arrived_at)
+                        if slot.arrived_at is not None else ttft
+                    )
                 self._note_ttft(row, ttft)
 
     def _note_ttft(self, row: int, ttft: float) -> None:
@@ -1787,6 +2113,7 @@ class ContinuousWorker:
         length_penalty: float = 0.0,
         sharded: bool | None = None,
         now_fn=None,
+        tenancy=None,
     ) -> None:
         if service_config.generate_tokens < 1:
             raise ValueError(
@@ -1805,6 +2132,17 @@ class ContinuousWorker:
         self.config = service_config
         self.tokenizer = tokenizer
         self.result_queue = result_queue
+        if tenancy is not None and tenancy.prefix_pool > 0 \
+                and tenancy.prefix_len < 1:
+            # the pool's static prefix bucket defaults to the prompt
+            # bucket — one knob fewer, and the bench/demo traffic
+            # generators size their shared prefixes to it
+            import dataclasses
+
+            tenancy = dataclasses.replace(
+                tenancy, prefix_len=service_config.seq_len
+            )
+        self.tenancy = tenancy
         batcher_kwargs = dict(
             family=family,
             temperature=service_config.temperature,
@@ -1820,6 +2158,7 @@ class ContinuousWorker:
             beams=beams,
             length_penalty=length_penalty,
             decode_block=service_config.decode_block,
+            tenancy=tenancy,
         )
         shards = getattr(service_config, "shards", 1)
         if sharded is None:
@@ -1851,11 +2190,37 @@ class ContinuousWorker:
                 **batcher_kwargs,
             )
         self.processed = 0
+        # fair admission: the staging/DRR layer between the queue and
+        # the batcher (tenancy only; None keeps _refill on the exact
+        # reference code path).  Staging is bounded at one refill's
+        # lookahead per tenant and two engine-fulls total — overflow
+        # hands messages back to the queue (visibility 0), never drops.
+        self._fair = None
+        if tenancy is not None:
+            from .tenancy import FairAdmission
+
+            total_slots = len(self.batcher.slots)
+            self._fair = FairAdmission(
+                tenancy,
+                per_tenant_limit=max(1, total_slots),
+                total_limit=max(2, 2 * total_slots),
+            )
+        # uniquely-answered completions per tenant (exactly-once: the
+        # fleet's duplicate-suppression path never reaches the counter,
+        # and TTL sheds / malformed drops are answered but not counted)
+        self.completed_by_tenant: dict[str, int] = {}
+        # every tenant label ever exported as a Prometheus series —
+        # bounded by _bounded_tenant_key, re-exported every cycle so no
+        # series goes permanently stale (see _update_metrics)
+        self._gauge_tenants: dict[str, bool] = {}
         # request-TTL clock (``ServiceConfig.request_ttl_s``): must share
         # a time base with the queue's SentTimestamp stamps — epoch
         # seconds for AWS SQS (the default), a FakeClock's now for
         # deterministic tests/benches
         self._now = now_fn or time.time
+        # per-tenant TTFT shares the TTL clock's epoch base (so
+        # FakeClock episodes and SQS SentTimestamps agree)
+        self.batcher._epoch_now = self._now
         # requests shed at admission because they were already older
         # than request_ttl_s (each got an explicit expired reply — shed
         # is answered, never silently dropped)
@@ -1908,6 +2273,7 @@ class ContinuousWorker:
 
         from .service import build_token_reply, request_id
 
+        tenant = message.get("_tenant", "")
         if self.config.result_queue_url:
             if tokens is None:
                 payload = {"error": error or "malformed body"}
@@ -1916,6 +2282,12 @@ class ContinuousWorker:
                     tokens, self.config.eos_id, self.tokenizer
                 )
             payload["request_id"] = request_id(message)
+            if tenant:
+                # replies carry the tenant label so consumers (and the
+                # bench) can account completions per tenant — dedup by
+                # request_id still decides exactly-once, the label only
+                # attributes it
+                payload["tenant"] = tenant
             # reply BEFORE deleting the input (at-least-once: consumers
             # may see duplicates, never lose a result)
             self.result_queue.send_message(
@@ -1924,10 +2296,28 @@ class ContinuousWorker:
         self.queue.delete_message(
             self.config.queue_url, message["ReceiptHandle"]
         )
+        if tenant and tokens is not None:
+            tenant = _bounded_tenant_key(tenant, self.completed_by_tenant)
+            self.completed_by_tenant[tenant] = (
+                self.completed_by_tenant.get(tenant, 0) + 1
+            )
         return True
 
+    @property
+    def staged(self) -> int:
+        """Requests parked in fair-admission staging (0 with tenancy
+        off): received from the queue — their receipt handles are live —
+        but not yet admitted to a slot.  Idleness and drain decisions
+        must count them as in-flight work."""
+        return self._fair.staged if self._fair is not None else 0
+
     def _refill(self) -> int:
-        """Pull up to free-slot-count messages and prefill them in."""
+        """Pull up to free-slot-count messages and prefill them in.
+        With tenancy configured the pull goes through the fair-admission
+        staging layer instead (:meth:`_refill_tenant`); tenancy=None is
+        the reference path, byte for byte."""
+        if self.tenancy is not None:
+            return self._refill_tenant()
         self.refill_cycles += 1  # liveness: this worker's loop is running
         free = len(self.batcher.free_slots)
         if not free:
@@ -1945,40 +2335,185 @@ class ContinuousWorker:
         self._admit(messages)
         return len(messages)
 
+    def _refill_tenant(self) -> int:
+        """The fair-admission refill: receive into bounded per-tenant
+        staging, then PICK this cycle's admission batch by deficit
+        round robin instead of arrival order.  The picked batch still
+        prefills as one insert (:meth:`_submit_parsed`) — fairness is
+        host bookkeeping, not device work.  Staging overflow (a tenant
+        flooding past its lookahead cap) hands messages back to the
+        queue with visibility 0: backpressure, never loss."""
+        self.refill_cycles += 1  # liveness: this worker's loop is running
+        free = len(self.batcher.free_slots)
+        messages = []
+        if self._poll_backoff > 0:
+            self._poll_backoff -= 1
+        elif self._fair.room > 0:
+            messages = self.queue.receive_messages(
+                self.config.queue_url, max_messages=self._fair.room,
+                wait_time_s=0 if (self.batcher.active
+                                  or self._fair.staged) else
+                self.config.receive_wait_s,
+            )
+            if not messages and self.batcher.active:
+                self._poll_backoff = self.POLL_BACKOFF_CYCLES
+        nack = getattr(self.queue, "change_message_visibility", None)
+        for message in messages:
+            if self._shed_if_expired(message):
+                continue
+            parsed = self._parse_for_admit(message)
+            if parsed is None:
+                self._settle(message, None, counted=False)
+                continue
+            tenant = parsed[0]
+            if not self._fair.stage(tenant, parsed + (message,)):
+                # the tenant's staging cap is the fairness backstop:
+                # hand the message back NOW so other tenants' traffic
+                # gets received next cycle (no nack support = stage
+                # anyway; bounded-memory beats a redelivery stall)
+                if nack is not None:
+                    nack(self.config.queue_url,
+                         message["ReceiptHandle"], 0)
+                    self._fair.overflow_total += 1
+                else:
+                    self._fair.drr.push(tenant, parsed + (message,))
+            self._poll_backoff = 0  # staged work: keep the loop hot
+        picked = self._fair.pick(free)
+        admit = []
+        for _, item in picked:
+            message = item[3]
+            # expired while staged: the same shed contract as
+            # arrival-time sheds (answered, never dropped)
+            if self._shed_if_expired(message):
+                continue
+            admit.append(item)
+        if admit:
+            self._submit_parsed(admit)
+        return len(admit)
+
+    def _parse_for_admit(self, message: dict):
+        """One message -> ``(tenant, prefix_ids, ids)`` (tenancy) or
+        ``("", None, ids)`` (reference path); None = malformed."""
+        from .service import parse_request_body, parse_tenant_request
+
+        if self.tenancy is None:
+            ids = parse_request_body(message["Body"], self.tokenizer)
+            return None if ids is None else ("", None, ids)
+        tenant, prefix_ids, ids = parse_tenant_request(
+            message["Body"], self.tokenizer,
+            default_tenant=self.tenancy.tenants[0],
+        )
+        if ids is None:
+            return None
+        message["_tenant"] = tenant
+        return (tenant, prefix_ids, ids)
+
+    def _submit_parsed(
+        self, parsed: list[tuple[str, Any, np.ndarray, dict]]
+    ) -> int:
+        """Prefill already-parsed ``(tenant, prefix_ids, ids, message)``
+        records: pool-bucket prefixes go through the pooled insert
+        (sticky-routed on the sharded plane), everything else through
+        the plain insert — off-bucket prefixes are PREPENDED to the
+        prompt (identical results, just uncached).  At most one insert
+        dispatch per admission class per cycle."""
+        pool = self.batcher.prefix_pool
+        plain, plain_tenants, prefixed = [], [], []
+        for tenant, prefix_ids, ids, message in parsed:
+            if (pool is not None and prefix_ids is not None
+                    and prefix_ids.size == pool.prefix_len):
+                prefixed.append((tenant, prefix_ids, ids, message))
+                continue
+            if prefix_ids is not None and prefix_ids.size:
+                ids = np.concatenate(
+                    [np.asarray(prefix_ids, np.int32).reshape(-1),
+                     np.asarray(ids, np.int32).reshape(-1)]
+                )
+                if ids.size > self.batcher.prompt_len:
+                    # the prepended request no longer fits the prompt
+                    # bucket: _pad_prompt would silently truncate away
+                    # the user's actual prompt.  Shed it with an
+                    # explicit error instead — answered, never
+                    # silently corrupted (the poison-body idiom)
+                    self._settle(
+                        message, None,
+                        error="prefix + prompt exceeds the prompt "
+                              "bucket (shrink the prefix or size "
+                              "--seq-len / the prefix pool for it)",
+                        counted=False,
+                    )
+                    continue
+            plain.append((ids, message))
+            plain_tenants.append(tenant)
+        admitted = []
+        if prefixed:
+            rows = self.batcher.submit_many_prefixed(prefixed)
+            admitted += list(zip(rows, (m for _, _, _, m in prefixed)))
+        if plain:
+            rows = self.batcher.submit_many(plain)
+            if self.tenancy is not None:
+                self.batcher.tag_tenant(rows, plain_tenants)
+                admitted += list(zip(rows, (m for _, m in plain)))
+        if self.tenancy is not None:
+            # arrival stamps for per-tenant TTFT (host bookkeeping
+            # only; the reference path never reaches here)
+            for row, message in admitted:
+                self.batcher.slots[row].arrived_at = (
+                    self._sent_epoch(message)
+                )
+        return len(parsed)
+
+    def _sent_epoch(self, message: dict) -> float | None:
+        """The request's queue arrival in epoch seconds (SentTimestamp
+        is epoch milliseconds, like SQS stamps it); None when the queue
+        does not stamp."""
+        sent = message.get("Attributes", {}).get("SentTimestamp")
+        if sent is None:
+            return None
+        try:
+            return float(sent) / 1000.0
+        except (TypeError, ValueError):
+            return None
+
     def _admit(self, messages: list[dict]) -> int:
         """Parse and prefill already-received ``messages`` (at most the
         current free-slot count) into the batcher; returns the number
         admitted.  Poison bodies are consumed (with an error reply when
         replies are on), not redelivered forever — and not counted as
         processed work.  Shared by :meth:`_refill` and the fleet router's
-        direct re-dispatch path."""
-        from .service import parse_request_body
-
+        direct re-dispatch path (which is why it stays tenant-aware:
+        re-dispatched orphans keep their tenant attribution)."""
         admit = []
         for message in messages:
-            if self._expired(message):
-                # older than --request-ttl already on arrival: shed with
-                # an explicit expired reply instead of occupying a slot.
-                # The reply + delete ride the normal settle path, so the
-                # request stays exactly-once (fleet workers register it
-                # in the reply registry like any other answer) and is
-                # never silently dropped.
-                if self._settle(
-                    message, None, error="expired", counted=False
-                ):
-                    self.shed += 1
+            # older than --request-ttl already on arrival: shed instead
+            # of occupying a slot (see _shed_if_expired for the
+            # exactly-once contract)
+            if self._shed_if_expired(message):
                 continue
-            ids = parse_request_body(message["Body"], self.tokenizer)
-            if ids is None:
+            parsed = self._parse_for_admit(message)
+            if parsed is None:
                 self._settle(message, None, counted=False)
                 continue
-            admit.append((ids, message))
+            admit.append(parsed + (message,))
         if admit:
             # batched admission: the whole refill prefills in ONE jitted
             # multi-row insert (plain slots; beam/speculative admit
             # sequentially inside submit_many)
-            self.batcher.submit_many(admit)
+            self._submit_parsed(admit)
         return len(admit)
+
+    def _shed_if_expired(self, message: dict) -> bool:
+        """TTL-shed ``message`` if it is already older than
+        ``request_ttl_s``: answered with an explicit expired error
+        through the normal settle path (exactly-once, never silently
+        dropped) and counted in :attr:`shed` — the ONE shed contract
+        every admission path (arrival, staged, re-dispatch) shares.
+        Returns True when the message was shed."""
+        if not self._expired(message):
+            return False
+        if self._settle(message, None, error="expired", counted=False):
+            self.shed += 1
+        return True
 
     def _expired(self, message: dict) -> bool:
         """Deadline check at admission: the message's queue-stamped
@@ -2082,6 +2617,49 @@ class ContinuousWorker:
             "reply).",
             kind="counter",
         )
+        if self.tenancy is not None:
+            # the gauge label registry is persistent AND bounded: raw
+            # staged labels fold through bounded_tenant_key before they
+            # can mint a Prometheus series (set_gauge keeps every
+            # (name, labels) row forever), and every registered label
+            # is re-exported each cycle so a pruned tenant's depth
+            # series resets to 0 instead of sticking at its last value
+            depths: dict[str, int] = {}
+            for tenant, depth in self._fair.depths().items():
+                label = _bounded_tenant_key(tenant, self._gauge_tenants)
+                self._gauge_tenants[label] = True
+                depths[label] = depths.get(label, 0) + depth
+            for tenant in set(batcher.tenant_tokens) | \
+                    set(batcher.tenant_ttft):
+                self._gauge_tenants.setdefault(tenant, True)
+            for tenant in sorted(self._gauge_tenants):
+                ttfts = batcher.tenant_ttft.get(tenant)
+                self.metrics.set_tenant_gauges(
+                    tenant,
+                    queue_depth=depths.get(tenant, 0),
+                    ttft_seconds=(
+                        sum(ttfts) / len(ttfts) if ttfts else 0.0
+                    ),
+                    tokens_per_second=(
+                        batcher.tenant_tokens.get(tenant, 0) / elapsed
+                        if elapsed > 0 else 0.0
+                    ),
+                )
+            pool = batcher.prefix_pool
+            if pool is not None:
+                self.metrics.set_gauge(
+                    "prefix_cache_hits_total", pool.hits,
+                    "Prefix-pool admissions that reused a resident "
+                    "prefix entry (the shared-prefix prefill skipped "
+                    "entirely).",
+                    kind="counter",
+                )
+                self.metrics.set_gauge(
+                    "prefix_cache_misses_total", pool.misses,
+                    "Prefix-pool admissions that had to install (prefill "
+                    "once + LRU-evict) their prefix entry.",
+                    kind="counter",
+                )
 
     def run_once(self) -> int:
         """One engine cycle: refill free slots, advance the decode block

@@ -103,6 +103,92 @@ def parse_request_body(body: str, tokenizer=None) -> np.ndarray | None:
     return None
 
 
+def parse_tenant_request(
+    body: str, tokenizer=None, default_tenant: str = "default"
+) -> tuple[str, np.ndarray | None, np.ndarray | None]:
+    """One multi-tenant message body -> ``(tenant, prefix_ids, ids)``.
+
+    The tenancy envelope is a JSON object: ``{"tenant": "a", "prefix":
+    [...], "ids": [...]}`` (or ``"text"`` with a tokenizer) — ``tenant``
+    and ``prefix`` both optional.  Everything that is NOT that envelope
+    falls through to :func:`parse_request_body` verbatim and lands on
+    ``default_tenant`` with no prefix, so a tenancy-enabled worker
+    serves today's plain traffic unchanged (single default tenant = the
+    reference path).  ``ids is None`` marks a malformed body — the same
+    drop-with-error-reply contract as the plain parser.  The one
+    tenant-request parsing policy, shared by the worker's fair-admission
+    refill and the fleet router's re-dispatch path.
+    """
+    try:
+        payload = json.loads(body)
+    except Exception:
+        payload = None
+    if not isinstance(payload, dict):
+        return default_tenant, None, parse_request_body(body, tokenizer)
+    tenant = payload.get("tenant")
+    tenant = tenant if isinstance(tenant, str) and tenant \
+        else default_tenant
+    prefix = None
+    if isinstance(payload.get("prefix"), list):
+        try:
+            prefix = np.asarray(payload["prefix"], np.int32).reshape(-1)
+        except Exception:
+            prefix = None
+    if "ids" in payload:
+        try:
+            return tenant, prefix, np.asarray(
+                payload["ids"], np.int32
+            ).reshape(-1)
+        except Exception:
+            log.error("Dropping malformed tenant body: %.64r", body)
+            return tenant, prefix, None
+    ids = parse_request_body(body, tokenizer)
+    return tenant, prefix, ids
+
+
+# Tenant labels come from untrusted message bodies: per-tenant
+# attribution tables (tokens, TTFT samples, completion counts — and the
+# Prometheus series exported from them) must not grow one entry per
+# distinct label an adversary invents.  Past this many distinct labels,
+# new ones fold into one catch-all series.  Lives here (not in
+# continuous.py) because the jax-free fleet pool applies the same bound
+# when folding retired replicas' per-tenant counts.
+MAX_TENANT_SERIES = 512
+OTHER_TENANTS = "~other"
+
+
+def bounded_tenant_key(tenant: str, table: dict) -> str:
+    """The attribution key for ``tenant`` in ``table``: itself while the
+    table has room (or it already has a row), else the catch-all."""
+    if tenant in table or len(table) < MAX_TENANT_SERIES:
+        return tenant
+    return OTHER_TENANTS
+
+
+def tenant_completions(replies: dict[str, dict]) -> dict[str, int]:
+    """Per-tenant completion counts from :func:`collect_replies` output.
+
+    ``collect_replies`` already de-duplicated by request id, so counting
+    its REPLIES (not raw queue messages) is what keeps per-tenant
+    completions exactly-once under redelivery: a request answered twice
+    on the at-least-once substrate contributes one reply here, labeled
+    with the tenant its worker stamped.  Counting received messages —
+    the latent FIFO assumption the pre-tenancy benches leaned on —
+    double-books every redelivered copy.  Error replies (TTL sheds,
+    malformed bodies) are answered but are NOT completions — skipping
+    them keeps this count equal to the worker-side
+    ``completed_by_tenant``, which the bench gates on.  Unlabeled
+    replies count under ``""``."""
+    counts: dict[str, int] = {}
+    for payload in replies.values():
+        if "error" in payload:
+            continue
+        tenant = payload.get("tenant", "")
+        tenant = tenant if isinstance(tenant, str) else ""
+        counts[tenant] = counts.get(tenant, 0) + 1
+    return counts
+
+
 def build_token_reply(tokens, eos_id: int | None, tokenizer=None) -> dict:
     """One generate-mode reply payload: ``{"tokens": [...]}`` trimmed at
     ``eos_id`` (the reply carries the finished sequence, not the eos

@@ -385,17 +385,13 @@ class ShardedBatcher(ContinuousBatcher):
     # The admission plane: freest-first routing
     # ------------------------------------------------------------------
 
-    @property
-    def free_slots(self) -> list[int]:
-        """Admission-eligible rows, ROUTED: requests are assigned one at
-        a time to the currently-freest admitting shard (deterministic
-        tie-break: lowest shard index), so a refill larger than any one
-        shard's free slots splits across shards and equal-depth shards
-        fill in index order.  ``submit_many`` consuming this order IS
-        the cross-shard router — the whole refill still prefills as one
-        global-row ``[M, P]`` insert.  A PROBING shard (half-open after
-        quarantine) offers at most ONE slot until its health sentinel
-        clears it."""
+    def _admission_rows_by_shard(self) -> list[list[int]]:
+        """Admission-eligible rows per shard — the ONE availability
+        computation both routers (freest-first :attr:`free_slots` and
+        sticky :meth:`route_prefixed`) consume, so probing caps and
+        drain masks can never apply to one router and miss the other.
+        A PROBING shard (half-open after quarantine) offers at most ONE
+        slot until its health sentinel clears it."""
         per_shard = [
             [row for row in self.shard_rows(s) if not self.slots[row].busy]
             if self.shard_admitting[s] else []
@@ -405,6 +401,18 @@ class ShardedBatcher(ContinuousBatcher):
             if self.shard_probing[s]:
                 cap = max(0, 1 - self.shard_busy(s))
                 per_shard[s] = per_shard[s][:cap]
+        return per_shard
+
+    @property
+    def free_slots(self) -> list[int]:
+        """Admission-eligible rows, ROUTED: requests are assigned one at
+        a time to the currently-freest admitting shard (deterministic
+        tie-break: lowest shard index), so a refill larger than any one
+        shard's free slots splits across shards and equal-depth shards
+        fill in index order.  ``submit_many`` consuming this order IS
+        the cross-shard router — the whole refill still prefills as one
+        global-row ``[M, P]`` insert."""
+        per_shard = self._admission_rows_by_shard()
         order: list[int] = []
         heads = [0] * self.shards
         while True:
@@ -418,6 +426,80 @@ class ShardedBatcher(ContinuousBatcher):
             order.append(per_shard[best][heads[best]])
             heads[best] += 1
         return order
+
+    def _route_prefixed(self, keys: list) -> list[int]:
+        """Affinity-first-then-freest routing for prefixed admissions.
+
+        Each key's FIRST admission establishes its home shard (the
+        freest at that moment — same deterministic lowest-index
+        tie-break as :attr:`free_slots`); later admissions stick to the
+        home shard, where the key's prefix entry is resident in the
+        per-shard pool, so the tenant keeps its prefix-cache hits.
+        Stickiness YIELDS under imbalance: when the home shard has no
+        eligible slot, or the freest shard leads it by at least
+        ``tenancy.sticky_imbalance`` free slots (0 = auto: the shard's
+        slot count, i.e. yield only when home is full), the request
+        spills to the freest shard — the home assignment is NOT moved,
+        so a one-off spill pays one foreign install and the tenant
+        returns home next refill.  ``tenancy.sticky=False`` degrades to
+        pure freest-first (the FIFO-routing baseline the tenants bench
+        compares against)."""
+        per_shard = self._admission_rows_by_shard()
+        heads = [0] * self.shards
+        sticky = self.tenancy is not None and self.tenancy.sticky
+        threshold = (
+            self.tenancy.sticky_imbalance
+            if self.tenancy is not None and self.tenancy.sticky_imbalance
+            else self.shard_slots
+        )
+
+        def avail(s: int) -> int:
+            return len(per_shard[s]) - heads[s]
+
+        def freest() -> int:
+            best, best_avail = -1, 0
+            for s in range(self.shards):
+                if avail(s) > best_avail:  # strict: ties keep lowest s
+                    best, best_avail = s, avail(s)
+            return best
+
+        rows: list[int] = []
+        for key in keys:
+            pick = None
+            home = self._tenant_home.get(key)
+            if home is not None:
+                # LRU-touch on every lookup, not just on first
+                # assignment: the cap must evict cold keys, never the
+                # busiest long-lived tenant's home
+                self._tenant_home.move_to_end(key)
+            if sticky and home is not None and avail(home) > 0:
+                top = freest()
+                if top < 0 or avail(top) - avail(home) < threshold:
+                    pick = home
+            if pick is None:
+                pick = freest()
+                if pick < 0:
+                    raise RuntimeError(
+                        "no admission-eligible slot for a routed "
+                        "request (caller must size batches by "
+                        "free_slots)"
+                    )
+                if sticky and home is None:
+                    self._tenant_home[key] = pick
+                    self._tenant_home.move_to_end(key)
+                    while len(self._tenant_home) > 4096:
+                        self._tenant_home.popitem(last=False)
+            rows.append(per_shard[pick][heads[pick]])
+            heads[pick] += 1
+        return rows
+
+    def _pool_shard_of(self, row: int) -> int:
+        return row // self.shard_slots
+
+    def _free_slot_count(self) -> int:
+        # capacity only: skips the freest-first merge the routed
+        # free_slots ordering pays
+        return sum(len(rows) for rows in self._admission_rows_by_shard())
 
     # ------------------------------------------------------------------
     # The engine cycle

@@ -1,0 +1,628 @@
+"""Multi-tenant fair admission: millions of users are not one queue.
+
+The serving plane up to PR 9 admits strictly in arrival order — one
+FIFO, one global broadcast prefix, and a flooding tenant starves every
+other tenant's TTFT while it drains.  This module adds the three pieces
+that turn fairness from a tax into a throughput optimization
+(MQFQ-Sticky, PAPERS.md):
+
+- :class:`TenancyConfig` — the per-tenant policy surface (names,
+  weights, TTFT SLOs, prefix-pool size, stickiness knobs), validated at
+  construction so the worker's never-dies loop can't trip on bad knobs
+  mid-cycle;
+- :class:`DeficitRoundRobin` + :class:`FairAdmission` — per-tenant
+  sub-queues feeding the continuous batcher through deficit-round-robin
+  admission.  Each refill cycle's batch is *picked* by deficit counters
+  instead of arrival order, then still prefills as ONE ``[M, P]`` insert
+  (the scheduler is pure host bookkeeping — zero new device dispatches
+  or host syncs; the PR 7 ``insert_dispatches``/``host_transfers``
+  counters pin it).  DRR's invariants are the classic ones: work
+  conservation (no idle slot while any tenant queue is non-empty),
+  bounded deficit (an empty queue resets its counter, so no tenant
+  banks unbounded credit and none starves beyond a weight-proportional
+  delay), deterministic order (no randomness anywhere — a fixed request
+  stream admits identically every run);
+- :class:`PrefixPool` — N resident prefix-cache entries with LRU
+  eviction, generalizing the single ``--prefix-ids`` broadcast prefix.
+  A tenant's shared prompt prefix is prefilled ONCE at install
+  (one forward), then every request that reuses it gathers the cached
+  KV on device inside the admission insert — a pool hit never
+  re-prefills the shared region.  On the sharded plane each shard owns
+  its own pool partition (its HBM, its residency), which is exactly why
+  sticky routing (:meth:`~.shard_plane.ShardedBatcher.route_prefixed`)
+  pays: a tenant kept on its home shard keeps its prefix hits, while
+  freest-first scatter re-installs (and LRU-thrashes) the same prefix on
+  every shard it touches.
+
+Everything here is deliberately queue-shaped, not device-shaped: the
+scheduler and the pool's LRU are plain Python; only the pool's KV
+buffers and the install splice live on device (one tiny jit at install
+time, never on the per-cycle path).  With ``tenancy=None`` nothing in
+this module is even imported by the hot path — the engine keeps today's
+reference behavior byte for byte.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from functools import cached_property
+from typing import Any
+
+
+@dataclass(frozen=True)
+class _PoolEvent:
+    """One prefix-pool decision (install/evict), timestamped — shaped
+    like a :class:`~..fleet.pool.FleetEvent` so
+    :func:`~..obs.trace.instant_trace_events` exports it onto the same
+    Chrome-trace timeline as the fleet's supervisor decisions."""
+
+    name: str
+    t: float
+    args: dict = field(default_factory=dict)
+
+
+# the smallest admissible weight/quantum — AND their product: one DRR
+# round earns quantum*weight of deficit, so admitting one request costs
+# ~1/(quantum*weight) scheduler rounds; flooring the product (validated
+# in TenancyConfig) bounds that at 100 rounds
+MIN_WEIGHT = 0.01
+
+
+@dataclass(frozen=True)
+class TenancyConfig:
+    """The multi-tenant admission policy.
+
+    ``tenants`` names the KNOWN tenants (weights/SLOs align by index);
+    unknown tenant labels arriving on the queue are still served, at
+    weight 1.0 — fairness must not require pre-registration, only
+    priority does.  ``weights`` empty = all 1.0.
+
+    ``prefix_pool`` > 0 enables the per-tenant prefix-cache pool with
+    that many resident entries PER SHARD; ``prefix_len`` is the pool's
+    static prefix bucket (every pooled prefix must be exactly this many
+    tokens — the compiled insert closes over it; the worker defaults it
+    to ``seq_len``).  ``sticky`` toggles affinity-first routing on the
+    sharded plane (off = today's freest-first, the FIFO-routing
+    baseline the bench compares against); ``sticky_imbalance`` is how
+    many free slots the freest shard may lead the home shard by before
+    stickiness yields (0 = auto: the shard's slot count, i.e. yield
+    only when the home shard is full).  ``fair`` toggles the DRR pick
+    (off = arrival order through the same staging machinery — the FIFO
+    admission baseline).  ``ttft_slo_s`` aligns per-tenant TTFT SLOs
+    with ``tenants`` (empty = no SLO); the bench scores
+    time-over-TTFT-SLO per tenant from it.
+    """
+
+    tenants: tuple[str, ...]
+    weights: tuple[float, ...] = ()
+    prefix_pool: int = 0
+    prefix_len: int = 0
+    sticky: bool = True
+    sticky_imbalance: int = 0
+    fair: bool = True
+    quantum: float = 1.0
+    ttft_slo_s: tuple[float, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not self.tenants:
+            raise ValueError("tenancy needs at least one tenant name")
+        if len(set(self.tenants)) != len(self.tenants):
+            raise ValueError(f"duplicate tenant names in {self.tenants}")
+        for name in self.tenants:
+            if not name or not isinstance(name, str):
+                raise ValueError(f"tenant names must be non-empty strings "
+                                 f"(got {name!r})")
+        if self.weights and len(self.weights) != len(self.tenants):
+            raise ValueError(
+                f"{len(self.weights)} weight(s) for {len(self.tenants)} "
+                "tenant(s) — counts must match"
+            )
+        for w in self.weights:
+            if not w >= MIN_WEIGHT:
+                # a round earns quantum*weight of deficit: a vanishing
+                # weight makes the DRR spin ~1/(quantum*weight) full
+                # rounds per admitted request inside the refill loop —
+                # a legal-looking config must not be able to stall the
+                # serving worker, so tiny weights are a usage error
+                raise ValueError(
+                    f"tenant weights must be >= {MIN_WEIGHT} (got {w}; "
+                    "express shares by raising the other weights "
+                    "instead of vanishing this one)"
+                )
+        if self.prefix_pool < 0:
+            raise ValueError(
+                f"prefix_pool={self.prefix_pool} must be >= 0 (0 = off)"
+            )
+        if self.prefix_len < 0:
+            raise ValueError(
+                f"prefix_len={self.prefix_len} must be >= 0"
+            )
+        if self.sticky_imbalance < 0:
+            raise ValueError(
+                f"sticky_imbalance={self.sticky_imbalance} must be >= 0 "
+                "(0 = auto)"
+            )
+        if not self.quantum >= MIN_WEIGHT:
+            raise ValueError(
+                f"quantum={self.quantum} must be >= {MIN_WEIGHT} "
+                "(a vanishing quantum spins the scheduler)"
+            )
+        if self.weights and self.quantum * min(self.weights) < MIN_WEIGHT:
+            # the two floors compose: a round earns quantum*weight, so
+            # quantum=0.01 with weight=0.01 would still cost ~10,000
+            # rounds per admitted request — the PRODUCT is what bounds
+            # the scheduler's work, so the product gets the floor
+            raise ValueError(
+                f"quantum * min(weight) = "
+                f"{self.quantum * min(self.weights):g} must be >= "
+                f"{MIN_WEIGHT} (each DRR round earns quantum*weight of "
+                "deficit; a vanishing product spins the refill loop)"
+            )
+        if self.ttft_slo_s and len(self.ttft_slo_s) != len(self.tenants):
+            raise ValueError(
+                f"{len(self.ttft_slo_s)} TTFT SLO(s) for "
+                f"{len(self.tenants)} tenant(s) — counts must match"
+            )
+        for slo in self.ttft_slo_s:
+            if slo < 0:
+                raise ValueError(f"TTFT SLOs must be >= 0 (got {slo})")
+
+    # weight_of runs once per tenant per DRR round on the refill hot
+    # path: dict lookups, built once (cached_property assigns through
+    # the instance __dict__, which frozen dataclasses allow)
+    @cached_property
+    def _weight_by_tenant(self) -> "dict[str, float]":
+        return dict(zip(self.tenants, self.weights))
+
+    @cached_property
+    def _slo_by_tenant(self) -> "dict[str, float]":
+        return dict(zip(self.tenants, self.ttft_slo_s))
+
+    def weight_of(self, tenant: str) -> float:
+        """Configured weight, or 1.0 for tenants not pre-registered."""
+        return self._weight_by_tenant.get(tenant, 1.0)
+
+    def slo_of(self, tenant: str) -> float:
+        """Configured TTFT SLO seconds, or 0.0 (= none)."""
+        return self._slo_by_tenant.get(tenant, 0.0)
+
+
+class DeficitRoundRobin:
+    """Deficit-round-robin over per-tenant sub-queues.
+
+    The classic Shreedhar/Varghese scheduler with per-request cost 1:
+    each round visits tenants in first-seen order starting at a rotating
+    cursor; a visited non-empty tenant earns ``quantum * weight`` of
+    deficit and pops requests while its deficit covers them.  An
+    emptied queue resets its deficit to 0 — the bounded-deficit
+    invariant (credit never banks while there is nothing to spend it
+    on), which also bounds any tenant's wait at a weight-proportional
+    number of rounds.  ``pick`` keeps cycling rounds until ``k``
+    requests are picked or every queue is empty — the work-conservation
+    invariant (a free slot is never left idle while any tenant has a
+    staged request).  No randomness anywhere: a fixed arrival stream
+    picks identically every run (the determinism invariant all three
+    are property-tested in ``tests/test_admission.py``).
+    """
+
+    def __init__(self, weight_of=None, quantum: float = 1.0,
+                 keep=()) -> None:
+        if not quantum > 0:
+            raise ValueError(f"quantum={quantum} must be > 0")
+        self._weight_of = weight_of or (lambda tenant: 1.0)
+        self.quantum = quantum
+        # tenants whose (empty) sub-queues stay registered forever —
+        # the CONFIGURED tenants.  Unknown labels arrive from untrusted
+        # message bodies, so their entries are pruned the moment they
+        # drain: scheduler state stays bounded by keep + staging depth
+        # no matter how many distinct labels an adversary invents.
+        self._keep = frozenset(keep)
+        self._queues: dict[str, deque] = {}
+        self._deficit: dict[str, float] = {}
+        self._order: list[str] = []  # first-seen tenant order
+        self._cursor = 0
+        self._ordinal = 0  # arrival stamp (the fair=False pick order)
+
+    def push(self, tenant: str, item: Any) -> None:
+        queue = self._queues.get(tenant)
+        if queue is None:
+            queue = self._queues[tenant] = deque()
+            self._deficit[tenant] = 0.0
+            self._order.append(tenant)
+        queue.append((self._ordinal, item))
+        self._ordinal += 1
+
+    def depth(self, tenant: str) -> int:
+        queue = self._queues.get(tenant)
+        return len(queue) if queue is not None else 0
+
+    def depths(self) -> dict[str, int]:
+        """Per-tenant staged depth: every configured tenant (a drained
+        one's gauge reads 0 instead of disappearing) plus whatever
+        unknown labels are currently staged — drained unknowns are
+        pruned, so the gauge cardinality stays bounded."""
+        return {t: len(q) for t, q in self._queues.items()}
+
+    @property
+    def staged(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def deficit(self, tenant: str) -> float:
+        """Introspection for the property tests."""
+        return self._deficit.get(tenant, 0.0)
+
+    def _prune(self) -> None:
+        """Drop drained non-configured tenants (their deficit is already
+        0 by the bounded-deficit reset, so removal changes no future
+        pick; a re-arrival re-registers at the order's tail exactly like
+        a first arrival).  The cursor is remapped to the same next-round
+        tenant, so pruning never skips anyone's turn."""
+        dead = {
+            t for t in self._order
+            if not self._queues[t] and t not in self._keep
+        }
+        if not dead:
+            return
+        n = len(self._order)
+        survivors = [t for t in self._order if t not in dead]
+        cursor = 0
+        for i in range(n):
+            tenant = self._order[(self._cursor + i) % n]
+            if tenant not in dead:
+                cursor = survivors.index(tenant)
+                break
+        for tenant in dead:
+            del self._queues[tenant]
+            del self._deficit[tenant]
+        self._order = survivors
+        self._cursor = cursor
+
+    def pick(self, k: int, *, fair: bool = True) -> list[tuple[str, Any]]:
+        """Pop up to ``k`` ``(tenant, item)`` pairs by deficit order.
+
+        ``fair=False`` degrades to global arrival order across the same
+        sub-queues (the FIFO-admission baseline the bench contrasts) —
+        same staging, same bounds, no deficit accounting.
+        """
+        try:
+            return self._pick(k, fair)
+        finally:
+            self._prune()
+
+    def _pick(self, k: int, fair: bool) -> list[tuple[str, Any]]:
+        out: list[tuple[str, Any]] = []
+        if k <= 0 or not self._order:
+            return out
+        if not fair:
+            # arrival order: items carry a monotone stage ordinal
+            while len(out) < k:
+                oldest, best = None, None
+                for tenant in self._order:
+                    queue = self._queues[tenant]
+                    if queue and (best is None or queue[0][0] < best):
+                        best, oldest = queue[0][0], tenant
+                if oldest is None:
+                    break
+                out.append((oldest, self._queues[oldest].popleft()[1]))
+            return out
+        n = len(self._order)
+        while len(out) < k and any(
+            self._queues[t] for t in self._order
+        ):
+            for i in range(n):
+                tenant = self._order[(self._cursor + i) % n]
+                queue = self._queues[tenant]
+                if not queue:
+                    # bounded deficit: an empty queue banks nothing
+                    self._deficit[tenant] = 0.0
+                    continue
+                if self._deficit[tenant] < 1.0:
+                    # earn once per serviced round: a visit that merely
+                    # RESUMES spending credit left over from a
+                    # k-truncated pick must not earn again, or deficits
+                    # grow without bound and weighted shares collapse
+                    # toward equal whenever the per-refill pick is
+                    # smaller than a tenant's round quantum
+                    self._deficit[tenant] += (
+                        self.quantum * self._weight_of(tenant)
+                    )
+                while queue and self._deficit[tenant] >= 1.0 \
+                        and len(out) < k:
+                    out.append((tenant, queue.popleft()[1]))
+                    self._deficit[tenant] -= 1.0
+                if not queue:
+                    self._deficit[tenant] = 0.0
+                if len(out) >= k:
+                    # the rotation that keeps a small k from always
+                    # favoring the first-seen tenant: resume the NEXT
+                    # pick one past the tenant that filled this one —
+                    # UNLESS its turn is unfinished (backlog left and
+                    # deficit still ≥ 1): then the cursor stays put so
+                    # the next pick resumes the same turn, or a
+                    # high-weight tenant would spend each round's
+                    # credit at the same one-visit-per-pick rate as
+                    # weight-1 tenants and shares would collapse
+                    unfinished = bool(queue) and \
+                        self._deficit[tenant] >= 1.0
+                    self._cursor = (
+                        self._cursor + i + (0 if unfinished else 1)
+                    ) % n
+                    return out
+        return out
+
+
+class FairAdmission:
+    """The worker-side staging area between the queue and the batcher.
+
+    Receives go into per-tenant sub-queues (bounded — the queue itself
+    is the backlog; staging is only the one-refill lookahead DRR needs
+    to see across tenants), and each refill cycle's admission batch is
+    picked by :class:`DeficitRoundRobin`.  Per-tenant staging is capped
+    at ``per_tenant_limit`` so one flooding tenant cannot monopolize the
+    lookahead window either: overflow messages are *handed back* to the
+    queue by the worker (``change_message_visibility(0)``) instead of
+    staged — at-least-once backpressure, never a drop.
+    """
+
+    def __init__(
+        self,
+        tenancy: TenancyConfig,
+        *,
+        per_tenant_limit: int,
+        total_limit: int,
+    ) -> None:
+        if per_tenant_limit < 1 or total_limit < 1:
+            raise ValueError("staging limits must be >= 1")
+        self.tenancy = tenancy
+        self.per_tenant_limit = per_tenant_limit
+        self.total_limit = total_limit
+        self.drr = DeficitRoundRobin(
+            weight_of=tenancy.weight_of, quantum=tenancy.quantum,
+            keep=tenancy.tenants,
+        )
+        # messages actually handed back to the queue on a staging-cap
+        # hit — the CALLER increments it when its
+        # change_message_visibility(0) went through, so the counter
+        # never claims a backpressure event that did not happen
+        self.overflow_total = 0
+
+    @property
+    def staged(self) -> int:
+        return self.drr.staged
+
+    @property
+    def room(self) -> int:
+        """How many more messages staging can hold right now."""
+        return max(0, self.total_limit - self.staged)
+
+    def stage(self, tenant: str, item: Any) -> bool:
+        """Stage one parsed request; False = per-tenant/total cap hit
+        (the caller hands the message back to the queue and counts it
+        in :attr:`overflow_total` — only when the hand-back actually
+        happened)."""
+        if (self.drr.depth(tenant) >= self.per_tenant_limit
+                or self.staged >= self.total_limit):
+            return False
+        self.drr.push(tenant, item)
+        return True
+
+    def pick(self, k: int) -> list[tuple[str, Any]]:
+        return self.drr.pick(k, fair=self.tenancy.fair)
+
+    def depths(self) -> dict[str, int]:
+        depths = {t: 0 for t in self.tenancy.tenants}
+        depths.update(self.drr.depths())
+        return depths
+
+
+def prefix_pool_key(tenant: str, prefix_ids) -> tuple[str, int]:
+    """The pool's entry key: (tenant, content checksum).  A tenant that
+    rotates its system prompt gets a fresh entry instead of silently
+    decoding against the stale cached KV; crc32 keeps the key
+    deterministic across runs (Python's ``hash`` is salted)."""
+    import numpy as np
+
+    ids = np.asarray(prefix_ids, np.int32).reshape(-1)
+    return (tenant, zlib.crc32(ids.tobytes()))
+
+
+class PrefixPool:
+    """N resident prefix-cache entries per shard, LRU-evicted.
+
+    The device side is one stacked cache buffer per layer entry —
+    ``[shards * entries, heads, max_seq_len, head_dim]`` rows in the
+    batcher's exact cache layout (bf16 k/v or int8 codes+scales, gpt or
+    llama) — so the admission insert can *gather* each request's prefix
+    KV by entry index inside its one compiled call.  The host side is
+    one ``OrderedDict`` per shard mapping entry key -> local pool slot:
+    a **hit** touches LRU and returns the global row (no forward, no
+    transfer — the gather happens inside the insert that was running
+    anyway); a **miss** prefills the prefix ONCE
+    (:func:`~.decode.prefill_prefix` or the family/layout variant) and
+    splices it into the victim's pool row with one small jitted write —
+    an occasional amortized event, never on the per-cycle path.
+
+    What the pool does NOT share across tenants: entries are keyed by
+    (tenant, prefix checksum), so two tenants with byte-identical
+    prefixes still get separate entries — residency is a per-tenant
+    resource (one tenant's eviction pressure must not silently revoke
+    another's cache hit), and nothing decoded from one tenant's prefix
+    entry is ever visible to another tenant's requests.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        entries: int,
+        prefix_len: int,
+        shards: int = 1,
+        family: str = "gpt",
+        quantized_kv: bool = False,
+    ) -> None:
+        if entries < 1:
+            raise ValueError(f"entries={entries} must be >= 1")
+        if prefix_len < 1:
+            raise ValueError(f"prefix_len={prefix_len} must be >= 1")
+        if shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        if prefix_len > config.max_seq_len:
+            raise ValueError(
+                f"prefix_len={prefix_len} exceeds max_seq_len="
+                f"{config.max_seq_len}"
+            )
+        self.params = params
+        self.config = config
+        self.entries = entries
+        self.prefix_len = prefix_len
+        self.shards = shards
+        self.family = family
+        self.quantized_kv = quantized_kv
+        # the stacked device rows, in the batcher's cache layout
+        if quantized_kv:
+            from .decode import init_quantized_cache
+
+            cache = init_quantized_cache(
+                config, shards * entries,
+                kv_heads=(config.n_kv_heads if family == "llama"
+                          else None),
+            )
+        elif family == "llama":
+            from .llama import init_llama_cache
+
+            cache = init_llama_cache(config, shards * entries)
+        else:
+            from .decode import init_cache
+
+            cache = init_cache(config, shards * entries)
+        self.layers = cache["layers"]
+        # key -> local slot, per shard, in LRU order (oldest first)
+        self._lru: list[OrderedDict] = [
+            OrderedDict() for _ in range(shards)
+        ]
+        self.hits = 0
+        self.misses = 0
+        self.installs = 0
+        self.evictions = 0
+        self.events: deque[_PoolEvent] = deque(maxlen=1024)
+        self._write_jit = None
+
+    def resident(self, shard: int, key) -> bool:
+        """Residency probe for the sticky router — never touches LRU."""
+        return key in self._lru[shard]
+
+    def resident_keys(self, shard: int) -> list:
+        return list(self._lru[shard])
+
+    def _prefill_entry(self, prefix_ids):
+        """The ONE-TIME prefix prefill (the cost a pool hit amortizes
+        away), through the family/layout prefill-prefix variant."""
+        if self.quantized_kv:
+            if self.family == "llama":
+                from .llama import (
+                    llama_quantized_prefill_prefix as build,
+                )
+            else:
+                from .decode import quantized_prefill_prefix as build
+        elif self.family == "llama":
+            from .llama import llama_prefill_prefix as build
+        else:
+            from .decode import prefill_prefix as build
+        return build(self.params, prefix_ids, self.config)
+
+    def _write_entry(self, entry_cache, index: int) -> None:
+        """Splice a batch-1 prefix cache into pool row ``index`` — one
+        small jitted program (pool buffers donated, so the stacked rows
+        roll in place install after install)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._write_jit is None:
+            def write(pool_layers, entry_layers, idx):
+                out = []
+                for pool_layer, entry in zip(pool_layers, entry_layers):
+                    row = {}
+                    for name, buf in pool_layer.items():
+                        piece = entry[name]
+                        start = (idx,) + (
+                            jnp.zeros((), jnp.int32),
+                        ) * (buf.ndim - 1)
+                        row[name] = jax.lax.dynamic_update_slice(
+                            buf, piece, start
+                        )
+                    out.append(row)
+                return out
+
+            self._write_jit = jax.jit(write, donate_argnums=(0,))
+        self.layers = self._write_jit(
+            self.layers, entry_cache["layers"],
+            jnp.asarray(index, jnp.int32),
+        )
+
+    def acquire(self, shard: int, key, prefix_ids) -> int:
+        """Return the GLOBAL pool row holding ``key``'s prefix KV on
+        ``shard``, installing (and LRU-evicting) on a miss.  The
+        returned index feeds the admission insert's device-side
+        gather."""
+        import numpy as np
+
+        if not 0 <= shard < self.shards:
+            raise ValueError(f"shard {shard} out of range "
+                             f"[0, {self.shards})")
+        lru = self._lru[shard]
+        slot = lru.get(key)
+        if slot is not None:
+            lru.move_to_end(key)
+            self.hits += 1
+            return shard * self.entries + slot
+        self.misses += 1
+        ids = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if ids.size != self.prefix_len:
+            raise ValueError(
+                f"pooled prefixes are a static {self.prefix_len}-token "
+                f"bucket; got {ids.size} tokens (the worker prepends "
+                "off-bucket prefixes to the prompt instead)"
+            )
+        if len(lru) >= self.entries:
+            victim, slot = lru.popitem(last=False)
+            self.evictions += 1
+            self.events.append(_PoolEvent(
+                "prefix-evict", time.perf_counter(),
+                {"shard": shard, "tenant": victim[0], "slot": slot},
+            ))
+        else:
+            slot = len(lru)
+        entry = self._prefill_entry(ids)
+        self._write_entry(entry, shard * self.entries + slot)
+        lru[key] = slot
+        self.installs += 1
+        self.events.append(_PoolEvent(
+            "prefix-install", time.perf_counter(),
+            {"shard": shard, "tenant": key[0], "slot": slot},
+        ))
+        return shard * self.entries + slot
+
+    def trace_events(self, time_origin: float | None = None) -> list[dict]:
+        """The pool's install/evict decisions as Chrome-trace instant
+        events (``prefix-*`` names land in their own ``"prefix"``
+        category; merge into a tick trace via
+        ``to_chrome_trace(..., extra_events=...)`` like the fleet's)."""
+        from ..obs.trace import instant_trace_events
+
+        return instant_trace_events(self.events, time_origin)
+
+    def stats(self) -> dict:
+        return {
+            "entries_per_shard": self.entries,
+            "shards": self.shards,
+            "prefix_len": self.prefix_len,
+            "hits": self.hits,
+            "misses": self.misses,
+            "installs": self.installs,
+            "evictions": self.evictions,
+            "resident": [len(lru) for lru in self._lru],
+        }

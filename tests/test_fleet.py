@@ -275,6 +275,50 @@ def test_collect_replies_counts_true_duplicates():
     assert duplicates == 1
 
 
+def test_redelivered_tenant_request_counted_once_per_tenant(
+    model, params, donor
+):
+    # the PR 6 redelivery episode with tenant labels: per-tenant
+    # completion counts must stay exactly-once on the at-least-once
+    # substrate — the pool registry suppresses the redelivered twin
+    # BEFORE the worker's tenant counter, and the reply-side
+    # tenant_completions counts deduped replies, never raw messages
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        tenant_completions,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    clock = FakeClock()
+    queue = FakeMessageQueue(visibility_timeout=0.5, now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    rng = np.random.default_rng(7)
+    sent = {}
+    for tenant in ("alpha", "beta"):
+        mid = queue.send_message("t://q", json.dumps(
+            {"tenant": tenant, "ids": rng.integers(1, 64, 3).tolist()}
+        ))
+        sent[mid] = tenant
+    pool = WorkerPool.serving(
+        queue, params, model, _config(), result_queue=results,
+        min=1, max=1, engine_source=donor, clock=clock,
+        tenancy=TenancyConfig(tenants=("alpha", "beta")),
+    )
+    pool.run_cycle()  # admit both (visibility deadline now + 0.5)
+    clock.advance(1.0)  # expire mid-service: both copies redeliver
+    drive(
+        pool,
+        until=lambda: pool.idle and queue.get_queue_attributes("t://q", [])
+        ["ApproximateNumberOfMessages"] == "0",
+    )
+    assert pool.duplicates_suppressed >= 1
+    replies, duplicates = collect_replies(results, "t://r")
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    assert pool.completed_by_tenant == {"alpha": 1, "beta": 1}
+    assert tenant_completions(replies) == {"alpha": 1, "beta": 1}
+    assert pool.processed == len(sent)
+
+
 # ---------------------------------------------------------------------------
 # Graceful drain: finish in-flight, hand back what can't finish
 # ---------------------------------------------------------------------------
