@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -115,6 +115,18 @@ bench-learn:
 # exits 2 on any gate failure; writes BENCH_r15.json
 bench-tenants:
 	JAX_PLATFORMS=cpu python bench.py --suite tenants
+
+# Deadline-aware admission under overload (CPU JAX, a few minutes):
+# EDF-blended DRR + the tiered shed ladder vs today's pure DRR under a
+# coordinated multi-tenant flood, a zipf population with thousands of
+# distinct tenants, and a flash crowd; exits 2 unless victim TTFT p99
+# AND time-over-SLO are strictly better under attack, every request is
+# answered exactly once (sheds are explicit error replies), no victim
+# request is ever shed, and the SLO-free armed plane is byte-identical
+# to the PR 10 plane (dispatch/transfer counts included); writes
+# BENCH_r16.json
+bench-overload:
+	JAX_PLATFORMS=cpu python bench.py --suite overload
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

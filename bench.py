@@ -2655,13 +2655,511 @@ def run_tenants_suite(output: str = "BENCH_r15.json", *,
     }
 
 
+def _overload_tenancy(scenario, *, urgency_window, urgency_budget,
+                      shed_tiers, staging_per_tenant, staging_total):
+    """The episode's TenancyConfig: victims (SLO tenants) and any
+    non-default-weight tenants are REGISTERED; the zipf tail and the
+    flash crowd stay unregistered (the open-population path — you
+    cannot pre-register millions of tenants)."""
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    registered = [
+        t for t in scenario.traffics
+        if t.ttft_slo_s > 0 or t.weight != 1.0
+    ]
+    if not registered:
+        raise ValueError(f"scenario {scenario.name} has no SLO tenants")
+    return TenancyConfig(
+        tenants=tuple(t.tenant for t in registered),
+        weights=tuple(t.weight for t in registered),
+        ttft_slo_s=tuple(t.ttft_slo_s for t in registered),
+        urgency_window_s=urgency_window,
+        urgency_budget=urgency_budget,
+        shed_tiers=shed_tiers,
+        staging_per_tenant=staging_per_tenant,
+        staging_total=staging_total,
+    )
+
+
+def _overload_episode(model, params, scenario, *, mode, prompt_len,
+                      generate_tokens, batch_size, decode_block,
+                      urgency_window, urgency_budget, shed_tiers,
+                      staging_per_tenant, staging_total,
+                      cycle_pace_s=0.0, engine_source=None,
+                      max_drain_cycles=200_000):
+    """One adversarial run of ``scenario`` through a real tenancy
+    worker: ``mode="baseline"`` is today's pure PR 10 DRR (SLOs are
+    configured — they are scored — but never bias the pick and no
+    ladder exists); ``mode="deadline"`` arms the EDF blend and the
+    shed ladder.  Identical staging window both modes, so the
+    comparison isolates the admission policy, not the lookahead.
+    ``cycle_pace_s`` pads every engine cycle to at least that long:
+    victim TTFT then scales with the CYCLES a request waits rather
+    than raw host speed, so the strictly-better gates hold on a fast
+    or JIT-warm machine exactly as they do on a slow one."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.sim.scenarios import seeded_token_ids
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+        tenant_completions,
+    )
+
+    deadline_mode = mode == "deadline"
+    tenancy = _overload_tenancy(
+        scenario,
+        urgency_window=urgency_window if deadline_mode else 0.0,
+        urgency_budget=urgency_budget,
+        shed_tiers=shed_tiers if deadline_mode else 0,
+        staging_per_tenant=staging_per_tenant,
+        staging_total=staging_total,
+    )
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    url = f"bench://overload-{scenario.name}-{mode}"
+    config = ServiceConfig(
+        queue_url=url, batch_size=batch_size, seq_len=prompt_len,
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        result_queue_url=url + "-results",
+    )
+    worker = ContinuousWorker(queue, params, model, config,
+                              result_queue=results, tenancy=tenancy)
+    if engine_source is not None:
+        worker.batcher.adopt_engine(engine_source)
+
+    def body_for(tenant, index):
+        return json.dumps({
+            "tenant": tenant,
+            "ids": seeded_token_ids(
+                f"overload:{tenant}:{index}", prompt_len,
+                model.vocab_size,
+            ),
+        })
+
+    def paced_cycle():
+        began = time.perf_counter()
+        worker.run_once()
+        if cycle_pace_s > 0:
+            leftover = cycle_pace_s - (time.perf_counter() - began)
+            if leftover > 0:
+                time.sleep(leftover)
+
+    counters: dict[str, int] = {}
+    start = time.perf_counter()
+    for cycle_sends in scenario.schedule():
+        for tenant, count in cycle_sends:
+            for _ in range(count):
+                index = counters.get(tenant, 0)
+                counters[tenant] = index + 1
+                queue.send_message(url, body_for(tenant, index))
+        paced_cycle()
+    total = sum(counters.values())
+    cycles = 0
+    # drain: completions + hard sheds must account for every request
+    # (degraded completions already count as processed)
+    while (worker.processed + worker.shed_by_reason["ttl"]
+           + worker.shed_by_reason["pressure"]) < total:
+        paced_cycle()
+        cycles += 1
+        if cycles >= max_drain_cycles:
+            break
+    elapsed = time.perf_counter() - start
+    replies, duplicates = collect_replies(results, config.result_queue_url)
+    batcher = worker.batcher
+    # the scored victims are the SLO-carrying non-flood tenants; the
+    # zipf tail / flash crowd are legitimate background the ladder MAY
+    # shed — they are accounted (exactly-once) but not victims
+    slo_by_victim = {
+        t.tenant: t.ttft_slo_s for t in scenario.traffics
+        if not t.flood and t.ttft_slo_s > 0
+    }
+    victims = tuple(slo_by_victim)
+    pooled: list[float] = []
+    over_slo = 0.0
+    per_victim = {}
+    for victim in victims:
+        samples = list(batcher.tenant_ttft.get(victim, ()))
+        slo = slo_by_victim[victim]
+        over = sum(max(0.0, s - slo) for s in samples)
+        over_slo += over
+        pooled += samples
+        per_victim[victim] = {
+            "requests": counters.get(victim, 0),
+            "completed": worker.completed_by_tenant.get(victim, 0),
+            "ttft_p99_s": round(_ttft_p99(samples), 4),
+            "time_over_slo_s": round(over, 4),
+            "slo_s": slo,
+        }
+    errors = sum(1 for p in replies.values() if "error" in p)
+    return {
+        "mode": mode,
+        "scenario": scenario.name,
+        "requests": total,
+        "answered": len(replies),
+        "completions": len(replies) - errors,
+        "error_replies": errors,
+        "duplicates": duplicates,
+        "elapsed_s": round(elapsed, 3),
+        "victim_ttft_p99_s": round(_ttft_p99(pooled), 4),
+        "victim_time_over_slo_s": round(over_slo, 4),
+        "victims": per_victim,
+        "shed_by_reason": dict(worker.shed_by_reason),
+        "urgent_picks": worker._fair.drr.urgent_picks,
+        "ladder": (
+            {
+                "tier": worker.ladder.tier,
+                "transitions": worker.ladder.transitions,
+                "entered_total": list(worker.ladder.entered_total),
+            }
+            if worker.ladder is not None else None
+        ),
+        "overflow_handbacks": worker._fair.overflow_total,
+        "insert_dispatches": batcher.insert_dispatches,
+        "decode_dispatches": batcher.decode_dispatches,
+        "host_transfers": batcher.host_transfers,
+        "completions_by_tenant_victims": {
+            v: worker.completed_by_tenant.get(v, 0) for v in victims
+        },
+        "_tenant_completions": tenant_completions(replies),
+    }, worker
+
+
+def _overload_slo_free_parity(model, params, *, prompt_len,
+                              generate_tokens, batch_size,
+                              decode_block, cycles=30):
+    """The dormancy gate: with NO SLOs configured, the fully-armed
+    deadline plane (urgency window + budget set, shed ladder built)
+    must be byte-identical to the PR 10 plane — same outputs, same
+    insert/decode dispatch and host-transfer counts, ladder never
+    leaving tier 0 — on an identical gentle schedule."""
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        TenantScenario,
+        TenantTraffic,
+        seeded_token_ids,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    scenario = TenantScenario(
+        name="slo-free-trickle", cycles=cycles,
+        traffics=(
+            TenantTraffic(tenant="a", per_cycle=1, every=5,
+                          start_cycle=0),
+            TenantTraffic(tenant="b", per_cycle=1, every=5,
+                          start_cycle=2),
+        ),
+    )
+    runs = {}
+    for label, tenancy in (
+        ("pr10", TenancyConfig(tenants=("a", "b"))),
+        ("deadline-armed", TenancyConfig(
+            tenants=("a", "b"), urgency_window_s=0.4,
+            urgency_budget=2.0, shed_tiers=3,
+        )),
+    ):
+        queue = FakeMessageQueue()
+        results = FakeMessageQueue()
+        url = f"bench://overload-parity-{label}"
+        config = ServiceConfig(
+            queue_url=url, batch_size=batch_size, seq_len=prompt_len,
+            generate_tokens=generate_tokens, decode_block=decode_block,
+            result_queue_url=url + "-results",
+        )
+        worker = ContinuousWorker(queue, params, model, config,
+                                  result_queue=results, tenancy=tenancy)
+        sent = {}
+        counters: dict[str, int] = {}
+        for cycle_sends in scenario.schedule():
+            for tenant, count in cycle_sends:
+                for _ in range(count):
+                    index = counters.get(tenant, 0)
+                    counters[tenant] = index + 1
+                    body = json.dumps({
+                        "tenant": tenant,
+                        "ids": seeded_token_ids(
+                            f"parity:{tenant}:{index}", prompt_len,
+                            model.vocab_size,
+                        ),
+                    })
+                    sent[queue.send_message(url, body)] = (tenant, index)
+            worker.run_once()
+        total = sum(counters.values())
+        worker.drain(total=total, max_cycles=100_000)
+        replies, _ = collect_replies(results, config.result_queue_url)
+        runs[label] = {
+            "outputs": {
+                sent[rid]: payload["tokens"]
+                for rid, payload in replies.items() if rid in sent
+            },
+            "requests": total,
+            "insert_dispatches": worker.batcher.insert_dispatches,
+            "decode_dispatches": worker.batcher.decode_dispatches,
+            "host_transfers": worker.batcher.host_transfers,
+            "ladder_transitions": (
+                worker.ladder.transitions
+                if worker.ladder is not None else 0
+            ),
+            "urgent_picks": worker._fair.drr.urgent_picks,
+        }
+    return runs
+
+
+def run_overload_suite(output: str = "BENCH_r16.json", *,
+                       prompt_len: int = 8, generate_tokens: int = 12,
+                       batch_size: int = 4, decode_block: int = 4,
+                       scale: float = 1.0,
+                       urgency_window: float = 0.5,
+                       urgency_budget: float = 2.0,
+                       shed_tiers: int = 3,
+                       staging_depth: int = 6,
+                       cycle_pace_s: float = 0.005,
+                       timing_gates: bool = True) -> dict:
+    """Deadline-aware admission under overload (ROADMAP item 5),
+    hard-gated (exit 2) on:
+
+    - **strictly better under attack** — in the coordinated-flood and
+      zipf episodes, the pooled victim TTFT p99 AND total
+      time-over-SLO are strictly lower under EDF-blended DRR + the
+      shed ladder than under today's pure PR 10 DRR on the identical
+      schedule (and the baseline must actually violate the SLO — an
+      attack the old plane shrugs off gates as too weak);
+    - **zero lost / zero duplicated** — every episode answers every
+      request exactly once; every shed is an explicit error reply,
+      never a silent drop;
+    - **victims never shed** — the deadline plane's wins may not come
+      from dropping victim traffic: every victim request completes in
+      BOTH planes;
+    - **the machinery actually ran** — the deadline plane took >= 1
+      deadline jump and >= 1 pressure shed in each gated episode;
+    - **SLO-free dormancy** — with no SLOs configured the fully-armed
+      deadline plane is byte-identical to the PR 10 plane (outputs,
+      dispatch/transfer counts) and its ladder never leaves tier 0.
+
+    ``timing_gates=False`` (the tier-1 smoke) keeps every
+    deterministic gate and skips the wall-clock strictly-better ones;
+    ``scale`` shrinks the tenant populations for the smoke.
+    ``cycle_pace_s`` pads every engine cycle to a floor so the TTFT
+    gates measure CYCLES waited, not host speed — without it a fast
+    or JIT-warm host can serve the whole flood inside the SLO and the
+    attack-sanity gate correctly (but uselessly) reports the attack
+    as too weak.
+    """
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        overload_battery,
+        without_flood,
+    )
+
+    model, params = _tenant_model(0, prompt_len, generate_tokens)
+    battery = overload_battery(scale=scale)
+    failures = []
+    start = time.perf_counter()
+    kwargs = dict(
+        prompt_len=prompt_len, generate_tokens=generate_tokens,
+        batch_size=batch_size, decode_block=decode_block,
+        urgency_window=urgency_window, urgency_budget=urgency_budget,
+        shed_tiers=shed_tiers,
+        staging_per_tenant=2 * batch_size,
+        staging_total=staging_depth * batch_size,
+        cycle_pace_s=cycle_pace_s,
+    )
+    # warm engine: every timed episode adopts it so no victim TTFT
+    # includes a jit compile stall (same discipline as the tenants
+    # suite — nearest-rank p99 reports the worst sample)
+    warm_scenario = without_flood(battery[0])
+    _, warm_worker = _overload_episode(
+        model, params, warm_scenario, mode="deadline", **kwargs,
+    )
+    warm = warm_worker.batcher
+
+    episodes: dict[str, dict] = {}
+    gated = {"coordinated-flood", "zipf"}
+    for scenario in battery:
+        rows = {}
+        for mode in ("baseline", "deadline"):
+            row, _worker = _overload_episode(
+                model, params, scenario, mode=mode,
+                engine_source=warm, **kwargs,
+            )
+            rows[mode] = row
+            if row["answered"] != row["requests"] or row["duplicates"]:
+                failures.append(
+                    f"{scenario.name}[{mode}]: {row['answered']}/"
+                    f"{row['requests']} answered, {row['duplicates']} "
+                    "duplicates (gate: every request answered exactly "
+                    "once, sheds included)"
+                )
+        base, dl = rows["baseline"], rows["deadline"]
+        for victim, brow in base["victims"].items():
+            drow = dl["victims"][victim]
+            if (brow["completed"] != brow["requests"]
+                    or drow["completed"] != drow["requests"]):
+                failures.append(
+                    f"{scenario.name}: victim {victim} completed "
+                    f"{brow['completed']}/{brow['requests']} (baseline) "
+                    f"vs {drow['completed']}/{drow['requests']} "
+                    "(deadline) — victims must never be shed"
+                )
+        if scenario.name in gated:
+            if dl["urgent_picks"] < 1:
+                failures.append(
+                    f"{scenario.name}: the deadline plane took no "
+                    "deadline jumps — the comparison would measure "
+                    "noise, not the policy"
+                )
+            if dl["shed_by_reason"]["pressure"] < 1:
+                failures.append(
+                    f"{scenario.name}: the deadline plane shed nothing "
+                    "under pressure — the attack never engaged the "
+                    "ladder"
+                )
+            if timing_gates:
+                if base["victim_time_over_slo_s"] <= 0:
+                    failures.append(
+                        f"{scenario.name}: baseline victims never "
+                        "violated their SLO — attack too weak to gate "
+                        "an improvement"
+                    )
+                if not (dl["victim_ttft_p99_s"]
+                        < base["victim_ttft_p99_s"]):
+                    failures.append(
+                        f"{scenario.name}: victim TTFT p99 "
+                        f"{dl['victim_ttft_p99_s']}s (deadline) not "
+                        f"strictly better than "
+                        f"{base['victim_ttft_p99_s']}s (pure DRR)"
+                    )
+                if not (dl["victim_time_over_slo_s"]
+                        < base["victim_time_over_slo_s"]):
+                    failures.append(
+                        f"{scenario.name}: time-over-SLO "
+                        f"{dl['victim_time_over_slo_s']}s (deadline) "
+                        f"not strictly better than "
+                        f"{base['victim_time_over_slo_s']}s (pure DRR)"
+                    )
+        episodes[scenario.name] = {
+            "description": scenario.description,
+            "distinct_tenants": len(scenario.tenants),
+            "baseline": {k: v for k, v in base.items()
+                         if not k.startswith("_")},
+            "deadline": {k: v for k, v in dl.items()
+                         if not k.startswith("_")},
+        }
+
+    parity = _overload_slo_free_parity(
+        model, params, prompt_len=prompt_len,
+        generate_tokens=generate_tokens, batch_size=batch_size,
+        decode_block=decode_block,
+    )
+    if parity["pr10"]["outputs"] != parity["deadline-armed"]["outputs"]:
+        failures.append(
+            "slo-free parity: outputs differ (gate: the armed deadline "
+            "plane with no SLOs is byte-identical to the PR 10 plane)"
+        )
+    for counter in ("insert_dispatches", "decode_dispatches",
+                    "host_transfers"):
+        if parity["pr10"][counter] != parity["deadline-armed"][counter]:
+            failures.append(
+                f"slo-free parity: {counter} "
+                f"{parity['deadline-armed'][counter]} != PR 10's "
+                f"{parity['pr10'][counter]} (gate: zero added "
+                "dispatches/syncs when dormant)"
+            )
+    if parity["deadline-armed"]["ladder_transitions"]:
+        failures.append(
+            "slo-free parity: the ladder left tier 0 on a gentle "
+            "trickle (hysteresis thresholds are wrong)"
+        )
+    if parity["deadline-armed"]["urgent_picks"]:
+        failures.append(
+            "slo-free parity: deadline jumps happened without any SLO "
+            "configured"
+        )
+    elapsed = time.perf_counter() - start
+
+    artifact = {
+        "suite": "overload",
+        "elapsed_s": round(elapsed, 2),
+        "config": {
+            "prompt_len": prompt_len,
+            "generate_tokens": generate_tokens,
+            "batch_size": batch_size, "decode_block": decode_block,
+            "scale": scale,
+            "urgency_window_s": urgency_window,
+            "urgency_budget": urgency_budget,
+            "shed_tiers": shed_tiers,
+            "cycle_pace_s": cycle_pace_s,
+            "staging": {"per_tenant": kwargs["staging_per_tenant"],
+                        "total": kwargs["staging_total"]},
+            "model": {"d_model": model.d_model,
+                      "n_layers": model.n_layers,
+                      "vocab_size": model.vocab_size},
+        },
+        "episodes": episodes,
+        "slo_free_parity": {
+            label: {k: v for k, v in run.items() if k != "outputs"}
+            | {"outputs_compared": len(run["outputs"])}
+            for label, run in parity.items()
+        },
+        "gates": {
+            "attack": (
+                "victim TTFT p99 AND time-over-SLO strictly better "
+                "under EDF+ladder than pure DRR in the "
+                "coordinated-flood and zipf episodes"
+                if timing_gates else "off (smoke run)"
+            ),
+            "exactly_once": "every request answered exactly once in "
+                            "every episode (sheds are explicit error "
+                            "replies)",
+            "victims": "every victim request completes in both planes "
+                       "(wins may not come from shedding victims)",
+            "dormancy": "SLO-free armed plane byte-identical to PR 10 "
+                        "incl. dispatch/transfer counts; ladder stays "
+                        "tier 0; zero deadline jumps",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"overload: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    flood = episodes["coordinated-flood"]
+    ratio = (
+        flood["baseline"]["victim_ttft_p99_s"]
+        / max(flood["deadline"]["victim_ttft_p99_s"], 1e-9)
+    )
+    return {
+        "metric": "overload_victim_ttft_p99_improvement",
+        "value": round(ratio, 2),
+        "unit": (
+            "x lower victim TTFT p99 under the coordinated flood "
+            f"(pure DRR {flood['baseline']['victim_ttft_p99_s']}s -> "
+            f"EDF+ladder {flood['deadline']['victim_ttft_p99_s']}s; "
+            f"time-over-SLO "
+            f"{flood['baseline']['victim_time_over_slo_s']}s -> "
+            f"{flood['deadline']['victim_time_over_slo_s']}s)"
+        ),
+        "vs_baseline": round(ratio, 2),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
-                 "tenants"),
+                 "tenants", "overload"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -2683,15 +3181,20 @@ if __name__ == "__main__":
         " variants, zero chaos regression); tenants = multi-tenant fair"
         " admission battery (flood isolation under DRR, sticky-vs-freest"
         " prefix locality, tenancy-off byte-identity, exactly-once per"
-        " tenant)",
+        " tenant); overload = deadline-aware admission battery"
+        " (EDF-blended DRR + shed ladder vs pure DRR under coordinated"
+        " floods / zipf populations / flash crowds; strictly-better"
+        " victim p99 + time-over-SLO gates, SLO-free dormancy"
+        " byte-identity)",
     )
     cli.add_argument(
         "--output", default="",
         help="artifact path for --suite forecast/replay/sweep/chaos/serve/"
-        "fleet/scale/chaos-serve/learn/tenants (defaults: BENCH_r06.json /"
-        " BENCH_r07.json / BENCH_r08.json / BENCH_r09.json / BENCH_r10.json"
-        " / BENCH_r11.json / BENCH_r12.json / BENCH_r13.json /"
-        " BENCH_r14.json / BENCH_r15.json)",
+        "fleet/scale/chaos-serve/learn/tenants/overload (defaults:"
+        " BENCH_r06.json / BENCH_r07.json / BENCH_r08.json /"
+        " BENCH_r09.json / BENCH_r10.json / BENCH_r11.json /"
+        " BENCH_r12.json / BENCH_r13.json / BENCH_r14.json /"
+        " BENCH_r15.json / BENCH_r16.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -2717,6 +3220,10 @@ if __name__ == "__main__":
     elif cli_args.suite == "tenants":
         print(json.dumps(
             run_tenants_suite(cli_args.output or "BENCH_r15.json")
+        ))
+    elif cli_args.suite == "overload":
+        print(json.dumps(
+            run_overload_suite(cli_args.output or "BENCH_r16.json")
         ))
     else:
         print(json.dumps(run_bench()))

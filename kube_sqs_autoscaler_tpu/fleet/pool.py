@@ -578,6 +578,26 @@ class WorkerPool(FleetPoolBase):
                 totals[tenant] = totals.get(tenant, 0) + count
         return totals
 
+    def staged_by_tenant(self) -> dict[str, int]:
+        """Live per-tenant staged depths aggregated across the fleet's
+        serving/draining replicas (empty with tenancy off) — the
+        forecaster seam's WHO-is-arriving signal: feed it to
+        :class:`~..forecast.tenants.TenantAwareDepth` so the control
+        loop weighs a tight-SLO tenant's backlog harder than a batch
+        tenant's.  Pure host bookkeeping (each worker's fair-admission
+        ``depths()``), bounded by the workers' own label-cardinality
+        bounds."""
+        totals: dict[str, int] = {}
+        for replica in self.members:
+            if replica.state not in (SERVING, DRAINING):
+                continue
+            fair = getattr(replica.worker, "_fair", None)
+            if fair is None:
+                continue
+            for tenant, depth in fair.depths().items():
+                totals[tenant] = totals.get(tenant, 0) + depth
+        return totals
+
     @property
     def idle(self) -> bool:
         """Nothing in flight anywhere and nothing awaiting re-dispatch.

@@ -34,6 +34,11 @@ from .forecasters import (
 )
 from .history import DepthHistory
 from .predictive import PredictivePolicy, ReactivePolicy
+from .tenants import (
+    TenantAwareDepth,
+    TenantDepthHistory,
+    slo_urgency_weights,
+)
 
 __all__ = [
     "DepthHistory",
@@ -45,4 +50,7 @@ __all__ = [
     "make_forecaster",
     "PredictivePolicy",
     "ReactivePolicy",
+    "TenantAwareDepth",
+    "TenantDepthHistory",
+    "slo_urgency_weights",
 ]

@@ -143,7 +143,9 @@ def instant_trace_events(
     ``"shard"`` category so Perfetto can filter the shard failure
     domain separately from replica lifecycle events; prefix-pool
     residency decisions (``prefix-*``: the per-tenant pool's
-    install/evict instants) likewise land under ``"prefix"``.
+    install/evict instants) likewise land under ``"prefix"``, and the
+    overload ladder's tier transitions (``overload-*``) under
+    ``"overload"``.
     """
     events = list(events)
     if not events:
@@ -155,6 +157,8 @@ def instant_trace_events(
             return "shard"
         if name.startswith("prefix-"):
             return "prefix"
+        if name.startswith("overload-"):
+            return "overload"
         return "fleet"
 
     return [

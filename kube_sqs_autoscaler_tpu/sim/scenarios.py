@@ -536,6 +536,155 @@ def default_tenant_battery() -> "list[TenantScenario]":
     return [flood_scenario(), prefix_share_scenario()]
 
 
+def coordinated_flood_scenario(
+    *, floods: int = 4, victims: int = 2, flood_per_cycle: int = 3,
+    flood_start: int = 4, flood_cycles: int = 10,
+    victim_every: int = 3, slo_s: float = 0.35,
+    cycles: "int | None" = None,
+) -> TenantScenario:
+    """``floods`` distinct adversaries burst the SAME window — the
+    shape pure DRR handles worst: fairness splits capacity evenly over
+    the whole flood coalition, so each victim's share shrinks to
+    ``1/(floods+victims)`` while every flooder individually looks
+    legitimate.  Victims carry TTFT SLOs; the deadline-aware plane must
+    keep their p99/time-over-SLO strictly better than pure DRR."""
+    if cycles is None:
+        cycles = flood_start + flood_cycles + 14
+    traffics = [
+        TenantTraffic(
+            tenant=f"flood{f}", per_cycle=flood_per_cycle,
+            start_cycle=flood_start,
+            end_cycle=flood_start + flood_cycles, flood=True,
+        )
+        for f in range(floods)
+    ]
+    traffics += [
+        TenantTraffic(tenant=f"victim{v}", per_cycle=1,
+                      every=victim_every, start_cycle=v,
+                      ttft_slo_s=slo_s)
+        for v in range(victims)
+    ]
+    return TenantScenario(
+        name="coordinated-flood", cycles=cycles,
+        traffics=tuple(traffics),
+        description=(
+            "%d tenants flood %d req/cycle each for %d cycles in the "
+            "same window; %d SLO victims send 1 req every %d cycles"
+            % (floods, flood_per_cycle, flood_cycles, victims,
+               victim_every)
+        ),
+    )
+
+
+def zipf_scenario(
+    *, tenants: int = 2000, heads: int = 2, head_per_cycle: int = 3,
+    victims: int = 2, victim_every: int = 3, slo_s: float = 0.4,
+    s: float = 1.0, cycles: int = 40,
+) -> TenantScenario:
+    """Zipf-distributed traffic over a large open tenant population.
+
+    Rank-``k`` of the ``tenants`` background tenants sends one request
+    every ``ceil((k+1)**s)`` cycles — the classic 1/k rate curve, so a
+    handful of head tenants dominate volume while a long tail of
+    mostly-one-shot tenants churns the scheduler's registration state
+    (they arrive unregistered, weight 1.0, and are pruned when
+    drained).  The ``heads`` heaviest ranks send ``head_per_cycle``
+    every cycle and are marked as the flood (the attack IS the zipf
+    head); ``victims`` registered SLO tenants trickle throughout."""
+    if tenants < heads:
+        raise ValueError("tenants must be >= heads")
+    traffics = []
+    for k in range(tenants):
+        if k < heads:
+            traffics.append(TenantTraffic(
+                tenant=f"z{k}", per_cycle=head_per_cycle, flood=True,
+            ))
+            continue
+        every = min(cycles, max(1, math.ceil((k + 1) ** s)))
+        if every >= cycles and k % 5:
+            # deep-tail thinning: keep a deterministic 1-in-5 of the
+            # one-shot tail so a multi-thousand-tenant population does
+            # not mean multi-thousand requests all landing at once
+            continue
+        traffics.append(TenantTraffic(
+            tenant=f"z{k}", per_cycle=1, every=every,
+            start_cycle=k % max(1, min(cycles, every)),
+        ))
+    traffics += [
+        TenantTraffic(tenant=f"victim{v}", per_cycle=1,
+                      every=victim_every, start_cycle=v,
+                      ttft_slo_s=slo_s)
+        for v in range(victims)
+    ]
+    return TenantScenario(
+        name="zipf", cycles=cycles, traffics=tuple(traffics),
+        description=(
+            "%d-tenant zipf(s=%g) population, %d flooding head(s) at "
+            "%d req/cycle, %d SLO victims"
+            % (tenants, s, heads, head_per_cycle, victims)
+        ),
+    )
+
+
+def flash_crowd_scenario(
+    *, crowd: int = 1600, crowd_start: int = 6, crowd_span: int = 4,
+    victims: int = 2, victim_every: int = 3, slo_s: float = 0.4,
+    cycles: "int | None" = None,
+) -> TenantScenario:
+    """Tenant-population churn at its sharpest: ``crowd`` NEVER-seen
+    tenants each send exactly one request inside a ``crowd_span``-cycle
+    window (a product launch / retry storm), then vanish.  Stresses
+    the open-population paths — unregistered staging, DRR registration
+    churn and pruning, label-cardinality bounds — while the registered
+    SLO victims must keep their TTFT through the stampede."""
+    if cycles is None:
+        cycles = crowd_start + crowd_span + 18
+    traffics = [
+        TenantTraffic(
+            tenant=f"crowd{i}", per_cycle=1,
+            start_cycle=crowd_start + (i % crowd_span),
+            end_cycle=crowd_start + (i % crowd_span) + 1,
+            flood=True,
+        )
+        for i in range(crowd)
+    ]
+    traffics += [
+        TenantTraffic(tenant=f"victim{v}", per_cycle=1,
+                      every=victim_every, start_cycle=v,
+                      ttft_slo_s=slo_s)
+        for v in range(victims)
+    ]
+    return TenantScenario(
+        name="flash-crowd", cycles=cycles, traffics=tuple(traffics),
+        description=(
+            "%d one-shot tenants stampede over %d cycles from cycle "
+            "%d; %d SLO victims trickle throughout"
+            % (crowd, crowd_span, crowd_start, victims)
+        ),
+    )
+
+
+def overload_battery(
+    *, scale: float = 1.0,
+) -> "list[TenantScenario]":
+    """The adversarial overload battery ``bench.py --suite overload``
+    scores (ROADMAP item 5): a coordinated multi-tenant flood, a
+    zipf-population attack with thousands of distinct tenants, and a
+    flash crowd.  ``scale`` shrinks the tenant POPULATIONS for the
+    tier-1 smoke (1.0 = the full battery); the per-cycle attack
+    intensity is deliberately NOT scaled — a smoke whose "flood" fits
+    the engine's capacity would never engage the ladder and the
+    battery would gate nothing."""
+    def pop(value: int, floor: int) -> int:
+        return max(floor, int(round(value * scale)))
+
+    return [
+        coordinated_flood_scenario(floods=pop(4, 4)),
+        zipf_scenario(tenants=pop(2000, 40)),
+        flash_crowd_scenario(crowd=pop(1600, 30)),
+    ]
+
+
 def without_flood(scenario: TenantScenario) -> TenantScenario:
     """The scenario's no-flood control: identical victim schedules,
     adversary removed — the baseline the isolation gate compares

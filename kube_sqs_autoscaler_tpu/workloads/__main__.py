@@ -135,6 +135,37 @@ def main(argv=None) -> None:
              "per tenant; default: all 1.0 — equal shares)",
     )
     parser.add_argument(
+        "--tenant-slos", default="", metavar="S,S,...",
+        help="per-tenant TTFT SLOs in seconds aligned with --tenants "
+             "(floats >= 0, one per tenant; 0 = none).  Scored per "
+             "tenant, biases the DRR pick when --urgency-window is set "
+             "(EDF-blended admission), weighs the tenant's staged "
+             "backlog in the fleet autoscaler's depth signal, and "
+             "orders the overload ladder's tier-3 shed",
+    )
+    parser.add_argument(
+        "--urgency-window", type=float, default=0.0, metavar="SECONDS",
+        help="EDF-blended admission: a staged request whose "
+             "arrival-based TTFT deadline (SentTimestamp + its "
+             "tenant's --tenant-slos entry) is within this window of "
+             "now jumps the DRR quantum, charged against a bounded "
+             "per-tenant urgency budget so deadline jumps can never "
+             "starve a compliant tenant (0 = off — pure DRR, "
+             "byte-identical; requires --tenants)",
+    )
+    parser.add_argument(
+        "--shed-tiers", type=int, default=0, metavar="N",
+        help="tiered load shedding under measured overload pressure "
+             "(staged backlog x slot occupancy, hysteretic "
+             "transitions): 1 = degrade over-share tenants to half "
+             "--generate-tokens, 2 = + evict cold prefix-pool "
+             "entries, 3 = + shed staged requests from the "
+             "most-over-share tenants with explicit error replies "
+             "(exactly-once, never a silent drop); exported as "
+             "overload_tier / requests_shed_total{reason=...} "
+             "(0 = off; requires --tenants)",
+    )
+    parser.add_argument(
         "--prefix-pool", type=int, default=0, metavar="N",
         help="per-tenant prefix-cache pool: keep N resident prefix "
              "entries per shard with LRU eviction — a tenant's shared "
@@ -348,6 +379,31 @@ def main(argv=None) -> None:
                 raise SystemExit(
                     f"--tenant-weights must be floats ({err})"
                 )
+        slos: tuple[float, ...] = ()
+        if args.tenant_slos:
+            try:
+                slos = tuple(
+                    float(s) for s in args.tenant_slos.split(",")
+                    if s.strip()
+                )
+            except ValueError as err:
+                raise SystemExit(f"--tenant-slos must be floats ({err})")
+        if args.urgency_window < 0:
+            raise SystemExit(
+                f"--urgency-window {args.urgency_window} must be >= 0 "
+                "(0 = off)"
+            )
+        if args.urgency_window > 0 and not any(s > 0 for s in slos):
+            raise SystemExit(
+                "--urgency-window needs at least one positive "
+                "--tenant-slos entry (without a deadline nothing can "
+                "jump the quantum)"
+            )
+        if not 0 <= args.shed_tiers <= 3:
+            raise SystemExit(
+                f"--shed-tiers {args.shed_tiers} must be in [0, 3] "
+                "(0 = off)"
+            )
         if args.prefix_pool < 0:
             raise SystemExit(
                 f"--prefix-pool {args.prefix_pool} must be >= 0 (0 = off)"
@@ -379,13 +435,23 @@ def main(argv=None) -> None:
                 tenants=tenant_names, weights=weights,
                 prefix_pool=args.prefix_pool,
                 prefix_len=args.seq_len if args.prefix_pool else 0,
+                ttft_slo_s=slos,
+                urgency_window_s=args.urgency_window,
+                shed_tiers=args.shed_tiers,
             )
         except ValueError as err:
-            # weight/tenant count mismatches, non-positive weights:
-            # usage errors at startup, never mid-cycle tracebacks
+            # weight/SLO/tenant count mismatches, non-positive weights,
+            # bad urgency/shed knobs: usage errors at startup, never
+            # mid-cycle tracebacks
             raise SystemExit(str(err))
     elif args.tenant_weights:
         raise SystemExit("--tenant-weights requires --tenants")
+    elif args.tenant_slos:
+        raise SystemExit("--tenant-slos requires --tenants")
+    elif args.urgency_window:
+        raise SystemExit("--urgency-window requires --tenants")
+    elif args.shed_tiers:
+        raise SystemExit("--shed-tiers requires --tenants")
     elif args.prefix_pool:
         raise SystemExit("--prefix-pool requires --tenants")
     if args.journal_path and not args.fleet_max_replicas:
@@ -927,6 +993,18 @@ def main(argv=None) -> None:
                     args.journal_path,
                     meta=_fleet_journal_meta(args, tenancy),
                 )
+            depth_policy = None
+            if tenancy is not None:
+                # the forecaster seam's WHO-is-arriving signal: the
+                # gates threshold on the SLO-weighted per-tenant staged
+                # backlog (a tight-SLO tenant's requests move the
+                # autoscaler harder than a batch tenant's), never
+                # below the raw observed depth
+                from ..forecast.tenants import TenantAwareDepth
+
+                depth_policy = TenantAwareDepth(
+                    pool.staged_by_tenant, tenancy
+                )
             loop = ControlLoop(
                 pool,
                 QueueMetricSource(queue, service_config.queue_url,
@@ -941,6 +1019,7 @@ def main(argv=None) -> None:
                     ),
                 ),
                 observer=journal,
+                depth_policy=depth_policy,
             )
             driver = FleetDriver(pool, loop)
             start = time.perf_counter()
@@ -1079,6 +1158,10 @@ def _fleet_journal_meta(args, tenancy) -> dict:
                 "prefix_len": tenancy.prefix_len,
                 "sticky": tenancy.sticky,
                 "fair": tenancy.fair,
+                "ttft_slo_s": list(tenancy.ttft_slo_s),
+                "urgency_window_s": tenancy.urgency_window_s,
+                "urgency_budget": tenancy.urgency_budget,
+                "shed_tiers": tenancy.shed_tiers,
             }
             if tenancy is not None
             else {}
@@ -1105,6 +1188,9 @@ def _maybe_serve_metrics(port: int, worker, tenancy=None):
             __version__,
             tenants=",".join(tenancy.tenants),
             tenant_weights=",".join(str(w) for w in tenancy.weights),
+            tenant_slos=",".join(str(s) for s in tenancy.ttft_slo_s),
+            urgency_window=tenancy.urgency_window_s,
+            shed_tiers=tenancy.shed_tiers,
             prefix_pool=tenancy.prefix_pool,
         )
     if hasattr(worker, "attach_metrics"):

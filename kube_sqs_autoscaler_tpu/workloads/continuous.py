@@ -229,6 +229,7 @@ from .service import (  # noqa: E402
     MAX_TENANT_SERIES,
     OTHER_TENANTS,
     bounded_tenant_key as _bounded_tenant_key,
+    request_id as _request_id,
 )
 
 
@@ -509,6 +510,10 @@ class _Slot:
     # evacuated/resumed rows so a request's TTFT is measured once, at
     # its FIRST first token, never again on a later shard)
     ttft_done: bool = False
+    # overload ladder tier 1: the slot's budget was cut below the
+    # engine's static generate_tokens, so the device row outlives the
+    # host's completion — _finish_ready quiesces it (see _quiesce_rows)
+    degraded: bool = False
 
 
 class ContinuousBatcher:
@@ -737,6 +742,14 @@ class ContinuousBatcher:
         self.decode_dispatches = 0
         self.insert_dispatches = 0
         self.host_transfers = 0
+        # rows quiesced mid-budget (a degraded slot finished before its
+        # DEVICE budget ran out): excluded from admission until the
+        # block that was in flight at quiesce time settles, because
+        # that block still computed them live — re-admitting sooner
+        # would let its stale tokens land in the new request's slot.
+        # Always empty outside the overload ladder's tier 1, so the
+        # reference path never pays the membership check.
+        self._tainted: set[int] = set()
         # deferred first tokens: (device array, slot rows), consumed in
         # one batched transfer at the next step()
         self._pending_firsts: list[tuple[Any, list[int]]] = []
@@ -1540,7 +1553,32 @@ class ContinuousBatcher:
 
     @property
     def free_slots(self) -> list[int]:
+        if self._tainted:
+            return [
+                i for i, s in enumerate(self.slots)
+                if not s.busy and i not in self._tainted
+            ]
         return [i for i, s in enumerate(self.slots) if not s.busy]
+
+    def _quiesce_rows(self, rows: list[int]) -> None:
+        """Freeze the device twins of host-finished rows whose DEVICE
+        budget has not run out (the degraded-completion case): mark
+        them done with no remaining budget so the next dispatched block
+        skips them, and taint them out of admission until the block
+        already in flight settles (its tokens for these rows were
+        computed live and must drain onto non-busy slots, never into a
+        re-admitted request).  One tiny device op per cycle, and only
+        on cycles where a degraded slot actually finished."""
+        if not rows:
+            return
+        idx = jnp.asarray(rows, jnp.int32)
+        self._done = self._done.at[idx].set(True)
+        self._remaining = self._remaining.at[idx].set(0)
+        if self._pending_block is not None:
+            # only the dispatch-ahead engines have a block in flight;
+            # the single-step engine consumes every token in the same
+            # cycle, so its quiesced rows are immediately re-admissible
+            self._tainted.update(rows)
 
     @property
     def active(self) -> int:
@@ -1909,6 +1947,7 @@ class ContinuousBatcher:
         ``(payload, tokens)`` pairs, eos-padded to the budget exactly
         like ``generate``."""
         finished = []
+        quiesce = []
         for row, slot in enumerate(self.slots):
             if slot.busy and (slot.done or len(slot.produced) >= slot.budget):
                 tokens = slot.produced
@@ -1918,10 +1957,16 @@ class ContinuousBatcher:
                     tokens = tokens + [self.eos_id] * (
                         slot.budget - len(tokens)
                     )
+                if slot.degraded and not slot.done:
+                    # finished at a DEGRADED budget (not eos): the
+                    # device row still thinks it has budget left
+                    quiesce.append(row)
                 finished.append(
                     (slot.payload, np.asarray(tokens, np.int32))
                 )
                 self.slots[row] = _Slot()
+        if quiesce:
+            self._quiesce_rows(quiesce)
         return finished
 
     def step(self) -> list[tuple[Any, np.ndarray]]:
@@ -1935,7 +1980,10 @@ class ContinuousBatcher:
         Finished = budget reached or eos emitted; either way the tokens
         are padded with ``eos_id`` to the budget (matching ``generate``'s
         post-eos padding).  No-op when nothing is active."""
-        if self.active == 0:
+        if self.active == 0 and not self._tainted:
+            # tainted rows need one more settle to clear even with no
+            # active request (the reference path never taints, so its
+            # early-out is byte-identical to today's)
             return []
         if self.beams > 1:
             return self._step_beam()
@@ -2017,6 +2065,11 @@ class ContinuousBatcher:
                     if slot.done or len(slot.produced) >= slot.budget:
                         break
                     self._emit(slot, int(token))
+        # every block dispatched before the last quiesce has now
+        # settled (there is only ever one in flight), so tainted rows
+        # are safe to admit again; rows quiesced by the finish below
+        # re-taint for the next cycle
+        self._tainted.clear()
         return self._finish_ready()
 
     def _dispatch_spec_round(self, mask: list[bool]):
@@ -2202,8 +2255,13 @@ class ContinuousWorker:
             total_slots = len(self.batcher.slots)
             self._fair = FairAdmission(
                 tenancy,
-                per_tenant_limit=max(1, total_slots),
-                total_limit=max(2, 2 * total_slots),
+                per_tenant_limit=(
+                    tenancy.staging_per_tenant
+                    or max(1, total_slots)
+                ),
+                total_limit=(
+                    tenancy.staging_total or max(2, 2 * total_slots)
+                ),
             )
         # uniquely-answered completions per tenant (exactly-once: the
         # fleet's duplicate-suppression path never reaches the counter,
@@ -2221,10 +2279,28 @@ class ContinuousWorker:
         # per-tenant TTFT shares the TTL clock's epoch base (so
         # FakeClock episodes and SQS SentTimestamps agree)
         self.batcher._epoch_now = self._now
-        # requests shed at admission because they were already older
-        # than request_ttl_s (each got an explicit expired reply — shed
-        # is answered, never silently dropped)
-        self.shed = 0
+        # requests shed per reason — "ttl" (already older than
+        # request_ttl_s at admission), "degraded" (overload tier 1 cut
+        # the request's token budget; answered short, never dropped),
+        # "pressure" (overload tier 3 shed it from staging with an
+        # explicit error reply).  `shed` (the dashboard-compatible
+        # unlabeled requests_shed_total) is their sum.
+        self.shed_by_reason: dict[str, int] = {
+            "ttl": 0, "degraded": 0, "pressure": 0,
+        }
+        # the overload ladder (tenancy.shed_tiers > 0): _run_ladder
+        # measures pressure and applies the active tier's actions once
+        # per tenant refill cycle; None = no ladder, the PR 8 TTL shed
+        # stays the only degradation
+        self.ladder = None
+        self._degrade_tenants: frozenset = frozenset()
+        self._degraded_tokens = max(
+            1, service_config.generate_tokens // 2
+        )
+        if tenancy is not None and tenancy.shed_tiers > 0:
+            from .tenancy import OverloadLadder
+
+            self.ladder = OverloadLadder(tenancy.shed_tiers)
         # liveness counter the fleet's idle-wedge watchdog keys on: a
         # healthy worker bumps it every refill pass (poll, poll-backoff
         # tick, or full-slots early-out alike); a wedged run_once never
@@ -2311,6 +2387,16 @@ class ContinuousWorker:
         must count them as in-flight work."""
         return self._fair.staged if self._fair is not None else 0
 
+    @property
+    def shed(self) -> int:
+        """Requests shed over the worker's lifetime, all reasons summed
+        (the unlabeled ``requests_shed_total`` series — per-reason
+        counts live in :attr:`shed_by_reason`)."""
+        return sum(self.shed_by_reason.values())
+
+    def _note_shed(self, reason: str) -> None:
+        self.shed_by_reason[reason] += 1
+
     def _refill(self) -> int:
         """Pull up to free-slot-count messages and prefill them in.
         With tenancy configured the pull goes through the fair-admission
@@ -2344,6 +2430,7 @@ class ContinuousWorker:
         flooding past its lookahead cap) hands messages back to the
         queue with visibility 0: backpressure, never loss."""
         self.refill_cycles += 1  # liveness: this worker's loop is running
+        self._fair.note_cycle()  # decay the arrival-rate classifier
         free = len(self.batcher.free_slots)
         messages = []
         if self._poll_backoff > 0:
@@ -2366,7 +2453,15 @@ class ContinuousWorker:
                 self._settle(message, None, counted=False)
                 continue
             tenant = parsed[0]
-            if not self._fair.stage(tenant, parsed + (message,)):
+            # the arrival-based TTFT deadline rides into staging so the
+            # EDF blend can see it at pick time (None = no SLO / no
+            # queue stamp — the request can never jump the quantum)
+            deadline = self.tenancy.deadline_of(
+                tenant, self._sent_epoch(message)
+            )
+            if not self._fair.stage(tenant, parsed + (message,),
+                                    deadline=deadline,
+                                    message_id=_request_id(message)):
                 # the tenant's staging cap is the fairness backstop:
                 # hand the message back NOW so other tenants' traffic
                 # gets received next cycle (no nack support = stage
@@ -2376,20 +2471,166 @@ class ContinuousWorker:
                          message["ReceiptHandle"], 0)
                     self._fair.overflow_total += 1
                 else:
-                    self._fair.drr.push(tenant, parsed + (message,))
+                    self._fair.drr.push(tenant, parsed + (message,),
+                                        deadline=deadline)
             self._poll_backoff = 0  # staged work: keep the loop hot
-        picked = self._fair.pick(free)
-        admit = []
-        for _, item in picked:
-            message = item[3]
-            # expired while staged: the same shed contract as
-            # arrival-time sheds (answered, never dropped)
-            if self._shed_if_expired(message):
-                continue
-            admit.append(item)
+        if self.ladder is not None:
+            self._run_ladder()
+        now = self._now()
+        admit: list = []
+        while len(admit) < free:
+            picked = self._fair.pick(free - len(admit), now=now)
+            if not picked:
+                break
+            shed_any = False
+            for tenant, item in picked:
+                # expired while staged: the same shed contract as
+                # arrival-time sheds (answered, never dropped) — but
+                # the pick CHARGED the tenant's deficit for a request
+                # that consumes no slot, so the charge is refunded
+                # (without it a flood of expired/redelivered copies
+                # silently shrinks the tenant's future share) and the
+                # freed room is re-picked so no slot idles while other
+                # tenants still have staged work
+                if self._shed_if_expired(item[3]):
+                    self._fair.drr.refund(tenant, item)
+                    shed_any = True
+                else:
+                    admit.append(item)
+            if not shed_any:
+                break
         if admit:
             self._submit_parsed(admit)
         return len(admit)
+
+    def _overload_pressure(self) -> float:
+        """The ladder's scalar pressure: staged-backlog fraction gated
+        by slot occupancy AFTER the imminent admission.  A full
+        staging area behind genuinely idle slots is a transient (the
+        next pick drains it) and a full engine with empty staging is
+        just steady-state load — overload is BOTH at once.  Free slots
+        that this very cycle's pick is about to fill count as occupied
+        (raw at-this-instant occupancy dips to near zero every time a
+        synchronized batch completes, which would make the pressure
+        flap at full overload).  The prefix pool's memory enters the
+        ladder as tier 2's action target (its resident fraction is
+        what the tier shrinks), not as a pressure term: a warm pool is
+        healthy, not overloaded."""
+        slots = len(self.batcher.slots)
+        if not slots:
+            return 0.0
+        staged = self._fair.staged
+        free = slots - self.batcher.active
+        occupancy = min(
+            1.0, (self.batcher.active + min(staged, free)) / slots
+        )
+        staged_frac = min(1.0, staged / self._fair.total_limit)
+        return staged_frac * occupancy
+
+    def _run_ladder(self) -> None:
+        """Measure pressure, advance the ladder, apply the active
+        tier's actions (tier 1: mark over-share tenants for degraded
+        budgets at admission; tier 2: + evict cold prefix-pool entries;
+        tier 3: + shed staged requests with explicit error replies).
+        Runs once per tenant refill cycle, before the pick."""
+        # no explicit `now`: the ladder stamps transition events with
+        # time.perf_counter(), the same timebase every other trace
+        # producer (PrefixPool, fleet events) uses — passing the epoch
+        # TTL clock here would put overload instants decades off the
+        # merged Chrome-trace timeline
+        tier = self.ladder.update(self._overload_pressure())
+        self._degrade_tenants = (
+            self._fair.over_share() if tier >= 1 else frozenset()
+        )
+        pool = self.batcher.prefix_pool
+        if tier >= 2 and pool is not None:
+            pool.evict_cold(max(1, pool.entries // 2))
+        if tier >= 3:
+            target = int(
+                self.ladder.exit_threshold(3) * self._fair.total_limit
+            )
+            # tier 3 implies tier 1: reuse the over-share set computed
+            # above instead of re-running the O(tenants) classifier
+            self._shed_pressure(target, self._degrade_tenants)
+
+    def _shed_pressure(self, target: int, over_share) -> None:
+        """Tier 3: shed staged requests down to ``target`` — ONLY from
+        tenants currently over their weight share (the flood
+        signature; a compliant tenant's requests are served late, not
+        dropped, however overloaded the plane is).  Within the
+        over-share set, first the requests already past their TTFT
+        deadline (most over-SLO first: nobody is waiting for them),
+        then the NEWEST arrivals of the most-over-share (staged depth
+        / weight) tenant, so the lowest-weight deepest-backlog flooder
+        absorbs the shed.  Every shed is an explicit error reply
+        through the normal settle path — exactly-once (the fleet's
+        reply registry dedups redelivered copies before the counter),
+        never a silent drop."""
+        drr = self._fair.drr
+        fair = self._fair
+        now = self._now()
+        # eligibility comes from the SUSTAINED unique-message offered
+        # rate (FairAdmission.over_share), never instantaneous staged
+        # depth: the staging caps flatten every backlogged tenant to
+        # similar depths, so depth ratios cannot tell a coalition
+        # member from a victim queued behind it — sustained NEW-work
+        # rate can.  Two classes within the flood set:
+        # - best-effort (no-SLO) flooders absorb the shed (tail pass);
+        # - SLO-carrying tenants are near-unsheddable (an SLO is the
+        #   no-shed contract): only an UNAMBIGUOUS premium flood
+        #   (PREMIUM_FLOOD_FACTOR x the rate floor — a victim's
+        #   backlog clump can never sustain that on unique messages)
+        #   loses requests, and then only ones already past deadline.
+        over = {t for t in over_share if drr.depth(t) > 0}
+        best_effort = {
+            t for t in over if self.tenancy.slo_of(t) <= 0
+        }
+        premium_bar = (
+            fair.PREMIUM_FLOOD_FACTOR * fair.OVER_SHARE_MIN_RATE
+        )
+        premium_flood = {
+            t for t in over - best_effort
+            if fair.arrival_rate.get(t, 0.0) >= premium_bar
+        }
+        if not best_effort and not premium_flood:
+            return  # uniform overload: everyone is compliant — serve
+        # one staged count and one depths snapshot, decremented as the
+        # loops pop — the shed loop runs on already-overloaded cycles,
+        # so an O(tenants)/O(queues) rescan per shed would pile host
+        # work on exactly the wrong cycles
+        staged = self._fair.staged
+        while premium_flood and staged > target:
+            popped = drr.pop_over_deadline(now, eligible=premium_flood)
+            if popped is None:
+                break
+            staged -= 1
+            self._shed_item(popped[1])
+        depths = {
+            t: d for t, d in drr.depths().items()
+            if d > 0 and t in best_effort
+        }
+        while depths and staged > target:
+            victim = max(
+                depths,
+                key=lambda t: (
+                    depths[t] / self.tenancy.weight_of(t), t
+                ),
+            )
+            item = drr.pop_tail(victim)
+            if item is None:
+                depths.pop(victim)
+                continue
+            staged -= 1
+            depths[victim] -= 1
+            if depths[victim] <= 0:
+                depths.pop(victim)
+            self._shed_item(item)
+
+    def _shed_item(self, item) -> None:
+        if self._settle(item[3], None,
+                        error="shed under overload pressure",
+                        counted=False):
+            self._note_shed("pressure")
 
     def _parse_for_admit(self, message: dict):
         """One message -> ``(tenant, prefix_ids, ids)`` (tenancy) or
@@ -2448,19 +2689,33 @@ class ContinuousWorker:
         admitted = []
         if prefixed:
             rows = self.batcher.submit_many_prefixed(prefixed)
-            admitted += list(zip(rows, (m for _, _, _, m in prefixed)))
+            admitted += [
+                (row, t, m)
+                for row, (t, _, _, m) in zip(rows, prefixed)
+            ]
         if plain:
             rows = self.batcher.submit_many(plain)
             if self.tenancy is not None:
                 self.batcher.tag_tenant(rows, plain_tenants)
-                admitted += list(zip(rows, (m for _, m in plain)))
+                admitted += [
+                    (row, t, m)
+                    for row, t, (_, m) in zip(rows, plain_tenants, plain)
+                ]
         if self.tenancy is not None:
             # arrival stamps for per-tenant TTFT (host bookkeeping
-            # only; the reference path never reaches here)
-            for row, message in admitted:
-                self.batcher.slots[row].arrived_at = (
-                    self._sent_epoch(message)
-                )
+            # only; the reference path never reaches here), plus the
+            # ladder's tier-1 action: an over-share tenant's fresh
+            # admissions get a degraded token budget — answered short
+            # with an honest (shorter) reply, never dropped
+            degrade = self._degrade_tenants
+            for row, tenant, message in admitted:
+                slot = self.batcher.slots[row]
+                slot.arrived_at = self._sent_epoch(message)
+                if (degrade and tenant in degrade
+                        and self._degraded_tokens < slot.budget):
+                    slot.budget = self._degraded_tokens
+                    slot.degraded = True
+                    self._note_shed("degraded")
         return len(parsed)
 
     def _sent_epoch(self, message: dict) -> float | None:
@@ -2512,7 +2767,7 @@ class ContinuousWorker:
         if not self._expired(message):
             return False
         if self._settle(message, None, error="expired", counted=False):
-            self.shed += 1
+            self._note_shed("ttl")
         return True
 
     def _expired(self, message: dict) -> bool:
@@ -2610,13 +2865,41 @@ class ContinuousWorker:
                 if batcher.block_capacity else 0.0
             ),
         )
-        self.metrics.set_gauge(
-            "requests_shed_total", self.shed,
-            "Requests shed at admission because they were already older "
-            "than --request-ttl (each answered with an explicit expired "
-            "reply).",
-            kind="counter",
+        shed_help = (
+            "Requests shed or degraded at admission, by reason: ttl = "
+            "older than --request-ttl on arrival (explicit expired "
+            "reply), degraded = overload tier 1 cut the token budget "
+            "(answered short), pressure = overload tier 3 shed it from "
+            "staging (explicit error reply).  The unlabeled series is "
+            "their sum (pre-ladder dashboards keep working)."
         )
+        self.metrics.set_gauge(
+            "requests_shed_total", self.shed, shed_help, kind="counter",
+        )
+        for reason, count in sorted(self.shed_by_reason.items()):
+            self.metrics.set_gauge(
+                "requests_shed_total", count, shed_help,
+                labels=(("reason", reason),), kind="counter",
+            )
+        if self.ladder is not None:
+            self.metrics.set_gauge(
+                "overload_tier", self.ladder.tier,
+                "Active overload-ladder tier (0 = serving normally, "
+                "1 = degrading over-share tenants, 2 = + evicting cold "
+                "prefix entries, 3 = + shedding staged requests).",
+            )
+            self.metrics.set_gauge(
+                "overload_pressure", self.ladder.last_pressure,
+                "Measured overload pressure (staged-backlog fraction "
+                "gated by slot occupancy) the ladder last acted on.",
+            )
+            self.metrics.set_gauge(
+                "overload_tier_transitions_total",
+                self.ladder.transitions,
+                "Ladder tier transitions (enter + exit) over the "
+                "worker's lifetime.",
+                kind="counter",
+            )
         if self.tenancy is not None:
             # the gauge label registry is persistent AND bounded: raw
             # staged labels fold through bounded_tenant_key before they

@@ -393,7 +393,8 @@ class ShardedBatcher(ContinuousBatcher):
         A PROBING shard (half-open after quarantine) offers at most ONE
         slot until its health sentinel clears it."""
         per_shard = [
-            [row for row in self.shard_rows(s) if not self.slots[row].busy]
+            [row for row in self.shard_rows(s)
+             if not self.slots[row].busy and row not in self._tainted]
             if self.shard_admitting[s] else []
             for s in range(self.shards)
         ]
@@ -511,7 +512,7 @@ class ShardedBatcher(ContinuousBatcher):
         + the ``[S]`` free summary in one combined transfer.  Same
         dispatch-ahead overlap, results, and finished-request contract
         as the single-plane block engine."""
-        if self.active == 0:
+        if self.active == 0 and not self._tainted:
             return []
         return self._step_gang()
 
@@ -614,6 +615,10 @@ class ShardedBatcher(ContinuousBatcher):
                         self._emit(slot, int(token))
                         self.shard_tokens[shard] += 1
                         self.block_tokens += 1
+        # every gang block dispatched before the last quiesce has now
+        # settled, so tainted rows are admissible again (see the block
+        # engine's identical clear)
+        self._tainted.clear()
         busy_before = [self.shard_busy(s) for s in range(self.shards)]
         finished = self._finish_ready()
         for s in range(self.shards):

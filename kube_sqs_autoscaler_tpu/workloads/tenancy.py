@@ -70,6 +70,10 @@ class _PoolEvent:
 # in TenancyConfig) bounds that at 100 rounds
 MIN_WEIGHT = 0.01
 
+# the overload ladder's tier count: 1 = degrade, 2 = + evict cold
+# prefix entries, 3 = + shed with explicit error replies
+MAX_SHED_TIERS = 3
+
 
 @dataclass(frozen=True)
 class TenancyConfig:
@@ -105,6 +109,29 @@ class TenancyConfig:
     fair: bool = True
     quantum: float = 1.0
     ttft_slo_s: tuple[float, ...] = ()
+    # deadline-aware admission (EDF blended into the DRR pick): a staged
+    # request whose arrival-based TTFT deadline (SentTimestamp +
+    # ttft_slo_s) falls within ``urgency_window_s`` of now may jump the
+    # quantum — charged against its tenant's deficit, which may go at
+    # most ``urgency_budget`` requests negative (the bounded borrow that
+    # keeps deadline jumps from starving compliant tenants).  0 = off:
+    # the pick is byte-identical to pure DRR, deadlines or not.
+    urgency_window_s: float = 0.0
+    urgency_budget: float = 2.0
+    # tiered load shedding under measured overload pressure (see
+    # OverloadLadder): 0 = off (the PR 8 TTL shed stays the only tier);
+    # 1 = degrade over-share tenants to a smaller generate_tokens;
+    # 2 = + evict cold prefix-pool entries; 3 = + shed staged requests
+    # from the most-over-share tenants with explicit error replies.
+    shed_tiers: int = 0
+    # the fair-admission staging (lookahead) window, in requests:
+    # 0 = auto (per-tenant one engine-full, total two engine-fulls —
+    # the PR 10 defaults).  A deeper window lets DRR/EDF reorder more
+    # of the backlog (a victim's request must be STAGED before any
+    # admission policy can prefer it), at bounded extra memory: the
+    # queue itself remains the real backlog.
+    staging_per_tenant: int = 0
+    staging_total: int = 0
 
     def __post_init__(self) -> None:
         if not self.tenants:
@@ -169,6 +196,25 @@ class TenancyConfig:
         for slo in self.ttft_slo_s:
             if slo < 0:
                 raise ValueError(f"TTFT SLOs must be >= 0 (got {slo})")
+        if self.urgency_window_s < 0:
+            raise ValueError(
+                f"urgency_window_s={self.urgency_window_s} must be >= 0 "
+                "(0 = off)"
+            )
+        if self.urgency_budget < 0:
+            raise ValueError(
+                f"urgency_budget={self.urgency_budget} must be >= 0"
+            )
+        if not 0 <= self.shed_tiers <= MAX_SHED_TIERS:
+            raise ValueError(
+                f"shed_tiers={self.shed_tiers} must be in "
+                f"[0, {MAX_SHED_TIERS}] (0 = off)"
+            )
+        if self.staging_per_tenant < 0 or self.staging_total < 0:
+            raise ValueError(
+                "staging_per_tenant and staging_total must be >= 0 "
+                "(0 = auto)"
+            )
 
     # weight_of runs once per tenant per DRR round on the refill hot
     # path: dict lookups, built once (cached_property assigns through
@@ -189,31 +235,71 @@ class TenancyConfig:
         """Configured TTFT SLO seconds, or 0.0 (= none)."""
         return self._slo_by_tenant.get(tenant, 0.0)
 
+    def deadline_of(
+        self, tenant: str, arrived_epoch: "float | None"
+    ) -> "float | None":
+        """The request's arrival-based TTFT deadline (epoch seconds), or
+        None when the tenant has no SLO or the queue did not stamp an
+        arrival — an undeadlined request never jumps the quantum."""
+        slo = self.slo_of(tenant)
+        if slo <= 0 or arrived_epoch is None:
+            return None
+        return arrived_epoch + slo
+
 
 class DeficitRoundRobin:
-    """Deficit-round-robin over per-tenant sub-queues.
+    """Deficit-round-robin over per-tenant sub-queues, EDF-blendable.
 
     The classic Shreedhar/Varghese scheduler with per-request cost 1:
     each round visits tenants in first-seen order starting at a rotating
     cursor; a visited non-empty tenant earns ``quantum * weight`` of
     deficit and pops requests while its deficit covers them.  An
-    emptied queue resets its deficit to 0 — the bounded-deficit
-    invariant (credit never banks while there is nothing to spend it
-    on), which also bounds any tenant's wait at a weight-proportional
-    number of rounds.  ``pick`` keeps cycling rounds until ``k``
-    requests are picked or every queue is empty — the work-conservation
-    invariant (a free slot is never left idle while any tenant has a
-    staged request).  No randomness anywhere: a fixed arrival stream
-    picks identically every run (the determinism invariant all three
-    are property-tested in ``tests/test_admission.py``).
+    emptied queue resets its deficit (credit never banks while there is
+    nothing to spend it on), which also bounds any tenant's wait at a
+    weight-proportional number of rounds.  ``pick`` keeps cycling
+    rounds until ``k`` requests are picked or every queue is empty —
+    the work-conservation invariant (a free slot is never left idle
+    while any tenant has a staged request).  No randomness anywhere: a
+    fixed arrival stream picks identically every run.
+
+    **EDF blend** (``urgency_window_s > 0`` and ``pick(..., now=...)``):
+    before the fair rounds, staged HEAD requests whose deadline falls
+    within the urgency window of ``now`` are picked earliest-deadline-
+    first.  Two bounds keep the blend fair:
+
+    - every jump is *charged* to its tenant's deficit, which may go at
+      most ``urgency_budget`` requests negative and resets with the
+      queue on empty (per-busy-period borrow — kept debt would turn
+      steady trickle traffic's jumps into loans repaid in extra wait);
+    - every jump also spends one urgency CREDIT from a token bucket of
+      capacity ``urgency_budget`` refilling at ``quantum * weight``
+      per fair round — the tenant's fair-share rate — so a sustained
+      urgent stream cannot jump faster than its share no matter how it
+      shapes bursts (deficit reset alone would let a drain-and-refill
+      abuser re-arm unlimited jumps).
+
+    A tenant at either cap falls back to fair order, so the combined
+    invariant holds: ``-urgency_budget <= deficit <= quantum * weight
+    + 1``, sustained jump rate <= fair share, and a compliant
+    backlogged tenant keeps its share whatever the deadline traffic
+    does.  With no deadlines staged (or the window at 0) the pick is
+    byte-identical to pure DRR — all of it property-tested in
+    ``tests/test_admission.py``.
     """
 
     def __init__(self, weight_of=None, quantum: float = 1.0,
-                 keep=()) -> None:
+                 keep=(), urgency_window_s: float = 0.0,
+                 urgency_budget: float = 0.0) -> None:
         if not quantum > 0:
             raise ValueError(f"quantum={quantum} must be > 0")
+        if urgency_window_s < 0 or urgency_budget < 0:
+            raise ValueError(
+                "urgency_window_s and urgency_budget must be >= 0"
+            )
         self._weight_of = weight_of or (lambda tenant: 1.0)
         self.quantum = quantum
+        self.urgency_window_s = urgency_window_s
+        self.urgency_budget = urgency_budget
         # tenants whose (empty) sub-queues stay registered forever —
         # the CONFIGURED tenants.  Unknown labels arrive from untrusted
         # message bodies, so their entries are pruned the moment they
@@ -225,14 +311,45 @@ class DeficitRoundRobin:
         self._order: list[str] = []  # first-seen tenant order
         self._cursor = 0
         self._ordinal = 0  # arrival stamp (the fair=False pick order)
+        # deadline jumps taken out of fair order (introspection/gauges)
+        self.urgent_picks = 0
+        # the urgency-credit token bucket: jumps spend from a per-tenant
+        # credit (capacity = urgency_budget) that refills at quantum *
+        # weight per completed fair ROUND — i.e. at the tenant's fair-
+        # share rate.  The deficit charge alone is not enough: deficit
+        # resets when a queue empties (per-busy-period budgets, which
+        # steady trickle traffic needs), so a drain-and-refill abuser
+        # could re-arm unlimited jumps by sending its urgent requests
+        # two at a time.  Credit persists across busy periods and
+        # refills only as rounds pass, bounding sustained jump rate to
+        # the fair share however the abuser shapes its bursts.
+        # _rounds counts fair-phase rotations FRACTIONALLY (a pick
+        # truncated after visiting i of n tenants advances i/n), so
+        # credits keep refilling even when every pick is smaller than
+        # one rotation — the common case under many-tenant contention.
+        self._rounds = 0.0
+        self._credit: dict[str, float] = {}
+        self._credit_round: dict[str, float] = {}
+        # object ids of the MOST RECENT pick()'s urgent items —
+        # refund() consults it so a shed urgent pick gives back its
+        # credit too, attributed to the exact item (a count per tenant
+        # would let a shed FAIR pick return a credit that an admitted
+        # urgent jump in the same pick legitimately spent)
+        self._last_urgent_ids: set[int] = set()
 
-    def push(self, tenant: str, item: Any) -> None:
+    def push(self, tenant: str, item: Any,
+             deadline: "float | None" = None) -> None:
+        """Stage one item.  ``deadline`` (epoch seconds) is the
+        request's TTFT deadline; None = no SLO — the item can never
+        jump the quantum."""
         queue = self._queues.get(tenant)
         if queue is None:
             queue = self._queues[tenant] = deque()
             self._deficit[tenant] = 0.0
+            self._credit.setdefault(tenant, self.urgency_budget)
+            self._credit_round.setdefault(tenant, self._rounds)
             self._order.append(tenant)
-        queue.append((self._ordinal, item))
+        queue.append((self._ordinal, deadline, item))
         self._ordinal += 1
 
     def depth(self, tenant: str) -> int:
@@ -259,10 +376,19 @@ class DeficitRoundRobin:
         0 by the bounded-deficit reset, so removal changes no future
         pick; a re-arrival re-registers at the order's tail exactly like
         a first arrival).  The cursor is remapped to the same next-round
-        tenant, so pruning never skips anyone's turn."""
+        tenant, so pruning never skips anyone's turn.  A tenant whose
+        urgency credit is still refilling is kept too: pruning it
+        would hand its re-registration a FULL bucket — the exact
+        drain-and-refill re-arm the credit exists to prevent.  (In the
+        worker only configured tenants can ever spend credit —
+        unregistered labels have no SLO, so no deadline, so no jumps —
+        which keeps this no-cardinality-leak: an adversarial unique
+        label always drains with a full, prunable bucket.)"""
         dead = {
             t for t in self._order
             if not self._queues[t] and t not in self._keep
+            and self._deficit[t] == 0.0
+            and self._refill_credit(t) >= self.urgency_budget
         }
         if not dead:
             return
@@ -277,22 +403,163 @@ class DeficitRoundRobin:
         for tenant in dead:
             del self._queues[tenant]
             del self._deficit[tenant]
+            self._credit.pop(tenant, None)
+            self._credit_round.pop(tenant, None)
         self._order = survivors
         self._cursor = cursor
 
-    def pick(self, k: int, *, fair: bool = True) -> list[tuple[str, Any]]:
+    def refund(self, tenant: str, item: Any = None) -> None:
+        """Give back one picked request's charges (most recent pick).
+
+        The redelivery/TTL skew fix: a picked item that is then SHED
+        (expired while staged, or a redelivered copy of an already-
+        answered request) consumed no slot — without the refund its
+        tenant paid a full request of deficit (and, for an urgent
+        pick, an urgency credit) for nothing, so a flood of
+        expired/redelivered copies would silently shrink a tenant's
+        future share — or strip an SLO tenant's jump budget.  Pass
+        the picked ``item`` so the credit refund is attributed to the
+        exact urgent pick that spent it (fair picks spent none — a
+        per-tenant count would let a shed fair pick return a credit an
+        ADMITTED urgent jump in the same pick legitimately consumed);
+        without the item only the deficit is refunded.  The deficit
+        refund is only meaningful while the tenant still has backlog
+        (an emptied queue resets anyway).  Neither refund can exceed
+        its bound: each returns exactly what the pick charged."""
+        if item is not None and id(item) in self._last_urgent_ids:
+            self._last_urgent_ids.discard(id(item))
+            if tenant in self._credit:
+                self._credit[tenant] = min(
+                    self.urgency_budget, self._credit[tenant] + 1.0
+                )
+        if self._queues.get(tenant):
+            self._deficit[tenant] = self._deficit.get(tenant, 0.0) + 1.0
+
+    def pop_over_deadline(
+        self, now: float, eligible=None,
+    ) -> "tuple[str, Any] | None":
+        """Pop the staged HEAD item most over its deadline at ``now``
+        (ties by arrival), or None when nothing staged is past due —
+        the ladder's tier-3 most-over-SLO shed order.  ``eligible``
+        (a set of tenant names, or None = all) restricts candidates:
+        the worker passes the over-share set so a COMPLIANT tenant's
+        late request is served late rather than shed."""
+        best = None
+        for tenant in self._order:
+            if eligible is not None and tenant not in eligible:
+                continue
+            queue = self._queues[tenant]
+            if not queue:
+                continue
+            ordinal, deadline, _ = queue[0]
+            if deadline is None or deadline >= now:
+                continue
+            cand = (deadline, ordinal, tenant)
+            if best is None or cand < best:
+                best = cand
+        if best is None:
+            return None
+        tenant = best[2]
+        item = self._queues[tenant].popleft()[2]
+        if not self._queues[tenant]:
+            self._deficit[tenant] = 0.0
+        return tenant, item
+
+    def pop_tail(self, tenant: str) -> "Any | None":
+        """Pop the NEWEST staged item of ``tenant`` (the ladder's tier-3
+        over-share shed order: the oldest staged requests keep their
+        place; the latest arrivals of the over-share tenant absorb the
+        shed), or None when nothing is staged."""
+        queue = self._queues.get(tenant)
+        if not queue:
+            return None
+        item = queue.pop()[2]
+        if not queue:
+            self._deficit[tenant] = 0.0
+        return item
+
+    def pick(self, k: int, *, fair: bool = True,
+             now: "float | None" = None) -> list[tuple[str, Any]]:
         """Pop up to ``k`` ``(tenant, item)`` pairs by deficit order.
 
         ``fair=False`` degrades to global arrival order across the same
         sub-queues (the FIFO-admission baseline the bench contrasts) —
-        same staging, same bounds, no deficit accounting.
+        same staging, same bounds, no deficit accounting.  ``now``
+        (epoch seconds) arms the EDF blend: staged deadlines within
+        ``urgency_window_s`` of it may jump the quantum, charged
+        against the bounded urgency budget.  ``now=None`` or a zero
+        window is pure DRR, byte for byte.
         """
+        self._last_urgent_ids = set()
         try:
-            return self._pick(k, fair)
+            return self._pick(k, fair, now)
         finally:
             self._prune()
 
-    def _pick(self, k: int, fair: bool) -> list[tuple[str, Any]]:
+    def _refill_credit(self, tenant: str) -> float:
+        """Lazily refill the tenant's urgency credit: quantum * weight
+        per fair round elapsed since its last refill, capped at the
+        budget."""
+        elapsed = self._rounds - self._credit_round[tenant]
+        if elapsed > 0:
+            self._credit[tenant] = min(
+                self.urgency_budget,
+                self._credit[tenant]
+                + elapsed * self.quantum * self._weight_of(tenant),
+            )
+            self._credit_round[tenant] = self._rounds
+        return self._credit[tenant]
+
+    def _pick_urgent(self, k: int, now: float,
+                     out: list[tuple[str, Any]]) -> None:
+        """The EDF phase: pop staged heads whose deadline falls within
+        the urgency window, earliest deadline first (ties by arrival).
+        Every jump spends one urgency CREDIT (the fair-share-rate
+        token bucket) AND charges the tenant's deficit down to the
+        ``-urgency_budget`` debt cap.  Runs before the fair rounds, so
+        an SLO tenant about to blow its TTFT jumps the quantum — but
+        its sustained jump rate can never exceed its fair share, and
+        its per-busy-period borrow never exceeds the budget."""
+        horizon = now + self.urgency_window_s
+        while len(out) < k:
+            best = None
+            for tenant in self._order:
+                queue = self._queues[tenant]
+                if not queue:
+                    continue
+                ordinal, deadline, _ = queue[0]
+                if deadline is None or deadline > horizon:
+                    continue
+                if self._deficit[tenant] - 1.0 < -self.urgency_budget:
+                    continue  # debt cap: back to fair order
+                if self._refill_credit(tenant) < 1.0:
+                    continue  # jump rate cap: back to fair order
+                cand = (deadline, ordinal, tenant)
+                if best is None or cand < best:
+                    best = cand
+            if best is None:
+                return
+            tenant = best[2]
+            item = self._queues[tenant].popleft()[2]
+            out.append((tenant, item))
+            self._deficit[tenant] -= 1.0
+            self._credit[tenant] -= 1.0
+            self._last_urgent_ids.add(id(item))
+            self.urgent_picks += 1
+            if not self._queues[tenant]:
+                # the classic reset-on-empty applies to urgency debt
+                # too: the budget is PER BUSY PERIOD.  A drained tenant
+                # consumed no more than it arrived with, so carrying
+                # its debt forward would turn every future urgent
+                # request into a loan repaid in extra waiting — under
+                # steady trickle arrivals that makes EDF *worse* than
+                # pure DRR once the budget exhausts.  An abuser cannot
+                # farm resets: refreshing the budget requires its own
+                # queue to empty, i.e. it stopped flooding.
+                self._deficit[tenant] = 0.0
+
+    def _pick(self, k: int, fair: bool,
+              now: "float | None") -> list[tuple[str, Any]]:
         out: list[tuple[str, Any]] = []
         if k <= 0 or not self._order:
             return out
@@ -306,8 +573,10 @@ class DeficitRoundRobin:
                         best, oldest = queue[0][0], tenant
                 if oldest is None:
                     break
-                out.append((oldest, self._queues[oldest].popleft()[1]))
+                out.append((oldest, self._queues[oldest].popleft()[2]))
             return out
+        if self.urgency_window_s > 0 and now is not None:
+            self._pick_urgent(k, now, out)
         n = len(self._order)
         while len(out) < k and any(
             self._queues[t] for t in self._order
@@ -317,6 +586,8 @@ class DeficitRoundRobin:
                 queue = self._queues[tenant]
                 if not queue:
                     # bounded deficit: an empty queue banks nothing
+                    # (and urgency debt resets with it — per-busy-
+                    # period budgets, see _pick_urgent)
                     self._deficit[tenant] = 0.0
                     continue
                 if self._deficit[tenant] < 1.0:
@@ -325,13 +596,16 @@ class DeficitRoundRobin:
                     # k-truncated pick must not earn again, or deficits
                     # grow without bound and weighted shares collapse
                     # toward equal whenever the per-refill pick is
-                    # smaller than a tenant's round quantum
+                    # smaller than a tenant's round quantum.  A tenant
+                    # in urgency debt earns its way back toward 1.0
+                    # over several rounds — the repayment that keeps
+                    # deadline jumps from compounding.
                     self._deficit[tenant] += (
                         self.quantum * self._weight_of(tenant)
                     )
                 while queue and self._deficit[tenant] >= 1.0 \
                         and len(out) < k:
-                    out.append((tenant, queue.popleft()[1]))
+                    out.append((tenant, queue.popleft()[2]))
                     self._deficit[tenant] -= 1.0
                 if not queue:
                     self._deficit[tenant] = 0.0
@@ -350,7 +624,13 @@ class DeficitRoundRobin:
                     self._cursor = (
                         self._cursor + i + (0 if unfinished else 1)
                     ) % n
+                    # a truncated pick still advances the round clock
+                    # by the fraction of the rotation it visited
+                    self._rounds += (i + 1) / n
                     return out
+            # one full rotation completed: urgency credits accrue one
+            # round of fair-share refill (see _refill_credit)
+            self._rounds += 1
         return out
 
 
@@ -365,7 +645,34 @@ class FairAdmission:
     lookahead window either: overflow messages are *handed back* to the
     queue by the worker (``change_message_visibility(0)``) instead of
     staged — at-least-once backpressure, never a drop.
+
+    The staging layer also keeps the overload ladder's flood
+    classifier: a per-tenant exponentially-decayed STAGED-ARRIVAL rate
+    (:meth:`note_cycle` decays, :meth:`stage` counts).  Instantaneous
+    staged depth cannot tell a coordinated coalition from normal load
+    (the staging caps flatten every backlogged tenant to a similar
+    depth), but sustained arrival rate can — and a victim trickling
+    one request every few cycles can never cross the rate floor.
     """
+
+    #: per-cycle decay of the arrival-rate EWMA (steady state for a
+    #: tenant staging r requests/cycle is r / (1 - decay) = 5r)
+    ARRIVAL_DECAY = 0.8
+    #: rate entries below this decay out entirely (bounds the dict)
+    ARRIVAL_FLOOR = 0.05
+    #: over-share = rate share > margin x weight share, AND the
+    #: absolute rate is at least the floor below — both tuned so a
+    #: coalition member modestly over its share still classifies while
+    #: a trickling SLO victim never can
+    OVER_SHARE_MARGIN = 1.25
+    OVER_SHARE_MIN_RATE = 3.0
+    #: how many times the min rate an SLO-carrying tenant must sustain
+    #: before the shed tier may treat it as flooding (an SLO is close
+    #: to a no-shed contract: only an unambiguous premium flood loses
+    #: requests, and then only already-expired ones)
+    PREMIUM_FLOOD_FACTOR = 3.0
+    #: distinct message ids remembered for rate dedup (see stage())
+    SEEN_IDS = 8192
 
     def __init__(
         self,
@@ -382,12 +689,70 @@ class FairAdmission:
         self.drr = DeficitRoundRobin(
             weight_of=tenancy.weight_of, quantum=tenancy.quantum,
             keep=tenancy.tenants,
+            urgency_window_s=tenancy.urgency_window_s,
+            urgency_budget=tenancy.urgency_budget,
         )
         # messages actually handed back to the queue on a staging-cap
         # hit — the CALLER increments it when its
         # change_message_visibility(0) went through, so the counter
         # never claims a backpressure event that did not happen
         self.overflow_total = 0
+        # tenant -> decayed staged-arrivals-per-cycle (the ladder's
+        # flood classifier input; pure bookkeeping — nothing on the
+        # admission path reads it unless a ladder asks).  Rated by
+        # UNIQUE message id: a backlogged victim's messages redeliver
+        # every cycle while staging is contended, and counting each
+        # redelivery would read exactly like a flood — only NEW work
+        # is offered load.
+        self.arrival_rate: dict[str, float] = {}
+        self._seen_ids: OrderedDict = OrderedDict()
+        # classification is STICKY while the flood's backlog persists:
+        # a flood that stops sending drops below the rate floor within
+        # a few decay cycles, but its queued backlog keeps drowning
+        # everyone behind it — a classified tenant stays classified
+        # until its staged queue actually drains
+        self._flood_sticky: set[str] = set()
+
+    def note_cycle(self) -> None:
+        """Decay the arrival-rate EWMA one refill cycle (entries under
+        :attr:`ARRIVAL_FLOOR` drop out, so the dict stays bounded by
+        recent stagers no matter how many labels an adversary mints)."""
+        decay = self.ARRIVAL_DECAY
+        self.arrival_rate = {
+            tenant: rate * decay
+            for tenant, rate in self.arrival_rate.items()
+            if rate * decay >= self.ARRIVAL_FLOOR
+        }
+
+    def over_share(self) -> frozenset:
+        """Tenants whose decayed staged-arrival-rate share exceeds
+        their weight share by :attr:`OVER_SHARE_MARGIN` and whose
+        absolute rate clears :attr:`OVER_SHARE_MIN_RATE` — the
+        overload ladder's flood set.  Empty under uniform load, for a
+        lone trickler, or when nothing has staged recently.  Sticky:
+        a classified tenant stays in the set while its staged queue
+        is non-empty even after its measured rate decays (the attack
+        stopped SENDING, but its backlog is still the overload), and
+        drops out the moment its backlog clears."""
+        fresh: set[str] = set()
+        rates = self.arrival_rate
+        if len(rates) >= 2:
+            total = sum(rates.values())
+            if total > 0:
+                weights = {
+                    t: self.tenancy.weight_of(t) for t in rates
+                }
+                wtotal = sum(weights.values())
+                fresh = {
+                    tenant for tenant, rate in rates.items()
+                    if rate >= self.OVER_SHARE_MIN_RATE
+                    and rate * wtotal
+                    > self.OVER_SHARE_MARGIN * weights[tenant] * total
+                }
+        self._flood_sticky = fresh | {
+            t for t in self._flood_sticky if self.drr.depth(t) > 0
+        }
+        return frozenset(self._flood_sticky)
 
     @property
     def staged(self) -> int:
@@ -398,24 +763,179 @@ class FairAdmission:
         """How many more messages staging can hold right now."""
         return max(0, self.total_limit - self.staged)
 
-    def stage(self, tenant: str, item: Any) -> bool:
+    def _note_offered(self, tenant: str, message_id: "str | None") -> None:
+        """Count one unit of OFFERED load into the tenant's rate —
+        once per distinct message id (redeliveries of the same message
+        are not new work; ``message_id=None`` always counts)."""
+        if message_id is not None:
+            if message_id in self._seen_ids:
+                self._seen_ids.move_to_end(message_id)
+                return
+            self._seen_ids[message_id] = True
+            while len(self._seen_ids) > self.SEEN_IDS:
+                self._seen_ids.popitem(last=False)
+        self.arrival_rate[tenant] = (
+            self.arrival_rate.get(tenant, 0.0) + 1.0
+        )
+
+    def stage(self, tenant: str, item: Any,
+              deadline: "float | None" = None,
+              message_id: "str | None" = None) -> bool:
         """Stage one parsed request; False = per-tenant/total cap hit
         (the caller hands the message back to the queue and counts it
         in :attr:`overflow_total` — only when the hand-back actually
-        happened)."""
-        if (self.drr.depth(tenant) >= self.per_tenant_limit
-                or self.staged >= self.total_limit):
+        happened).  ``deadline`` is the request's arrival-based TTFT
+        deadline (epoch seconds; None = no SLO), carried so the EDF
+        blend can see it at pick time; ``message_id`` dedups the
+        offered-load rate under redelivery."""
+        if self.drr.depth(tenant) >= self.per_tenant_limit:
+            # offered past its OWN cap: the per-tenant flood signature
+            # — counted into the rate even though nothing stages (a
+            # saturated flooder's successful stages are throttled to
+            # the drain rate, which would blind the classifier to the
+            # sustained offered load behind them)
+            self._note_offered(tenant, message_id)
             return False
-        self.drr.push(tenant, item)
+        if self.staged >= self.total_limit:
+            # the TOTAL cap is shared congestion, not tenant behavior:
+            # counting it would accrue flood-rate onto whoever happens
+            # to arrive (e.g. a victim redelivering behind a stampede)
+            return False
+        self.drr.push(tenant, item, deadline=deadline)
+        self._note_offered(tenant, message_id)
         return True
 
-    def pick(self, k: int) -> list[tuple[str, Any]]:
-        return self.drr.pick(k, fair=self.tenancy.fair)
+    def pick(self, k: int,
+             now: "float | None" = None) -> list[tuple[str, Any]]:
+        return self.drr.pick(k, fair=self.tenancy.fair, now=now)
 
     def depths(self) -> dict[str, int]:
         depths = {t: 0 for t in self.tenancy.tenants}
         depths.update(self.drr.depths())
         return depths
+
+
+#: Per-tier (enter, exit) pressure thresholds — enter at or above the
+#: first, leave below the second.  The gap is the hysteresis band: a
+#: pressure oscillating inside it neither enters nor exits, so the
+#: ladder cannot flap tier actions at the noise floor.
+TIER_THRESHOLDS: tuple[tuple[float, float], ...] = (
+    (0.50, 0.35),  # tier 1: degrade over-share tenants
+    (0.75, 0.60),  # tier 2: + evict cold prefix-pool entries
+    (0.90, 0.75),  # tier 3: + shed with explicit error replies
+)
+
+
+class OverloadLadder:
+    """The graceful-degradation state machine between "serving normally"
+    and "cliff-edge failure".
+
+    The worker measures a scalar overload pressure each refill cycle
+    (staged-backlog fraction gated by slot occupancy — see
+    ``ContinuousWorker._overload_pressure``) and feeds it here; the
+    ladder answers with the active tier.  Transitions are hysteretic
+    per tier (:data:`TIER_THRESHOLDS`): entry jumps straight to the
+    highest tier whose enter threshold the pressure clears (a cliff
+    must be answered immediately); exit descends through every tier
+    whose exit threshold the pressure has fallen below (one transition
+    event records the whole descent), and holds inside a tier's
+    hysteresis band.
+    ``tiers`` caps how far the ladder may climb (the ``shed_tiers``
+    knob); every transition is recorded as an ``overload-*`` event for
+    the Chrome-trace timeline and counted for the Prometheus side.
+
+    What the tiers DO lives in the worker (degrade / evict / shed) —
+    the ladder only decides WHEN, so the decision logic stays a pure,
+    clock-free, property-testable function of the pressure stream.
+    """
+
+    def __init__(self, tiers: int,
+                 thresholds=TIER_THRESHOLDS,
+                 smoothing: float = 0.5) -> None:
+        if not 1 <= tiers <= len(thresholds):
+            raise ValueError(
+                f"tiers={tiers} must be in [1, {len(thresholds)}]"
+            )
+        for enter, exit_ in thresholds:
+            if not 0.0 < exit_ < enter <= 1.0:
+                raise ValueError(
+                    f"need 0 < exit < enter <= 1 per tier "
+                    f"(got enter={enter}, exit={exit_})"
+                )
+        if not 0.0 < smoothing <= 1.0:
+            raise ValueError(
+                f"smoothing={smoothing} must be in (0, 1] (1 = none)"
+            )
+        self.tiers = tiers
+        self.thresholds = tuple(thresholds)
+        # EWMA weight of the newest pressure sample: tier actions
+        # (especially tier 3's shed) drop the raw pressure the very
+        # next cycle, so acting on the instantaneous value would flap
+        # enter/exit every few cycles no matter how wide the
+        # hysteresis band — the smoothed pressure is what transitions
+        # compare against
+        self.smoothing = smoothing
+        self.tier = 0
+        self.last_pressure = 0.0
+        self._ewma: "float | None" = None
+        self.transitions = 0
+        # per-tier entry counters (index 1..tiers; 0 unused)
+        self.entered_total = [0] * (len(thresholds) + 1)
+        self.events: deque[_PoolEvent] = deque(maxlen=1024)
+
+    def exit_threshold(self, tier: int) -> float:
+        return self.thresholds[tier - 1][1]
+
+    def update(self, pressure: float,
+               now: "float | None" = None) -> int:
+        """Advance the ladder one observation; returns the active tier.
+
+        ``now`` timestamps the transition events; the default
+        (``time.perf_counter()``) matches every other trace-event
+        producer's timebase, so merged Chrome traces line up — only
+        pass a clock that shares it (tests pin exact instants with
+        explicit values)."""
+        self._ewma = (
+            pressure if self._ewma is None
+            else self.smoothing * pressure
+            + (1.0 - self.smoothing) * self._ewma
+        )
+        pressure = self._ewma
+        self.last_pressure = pressure
+        target = self.tier
+        for tier in range(1, self.tiers + 1):
+            if pressure >= self.thresholds[tier - 1][0]:
+                target = max(target, tier)
+        if target > self.tier:
+            self._transition(self.tier, target, pressure, now)
+            self.tier = target
+        else:
+            tier = self.tier
+            while tier > 0 and pressure < self.exit_threshold(tier):
+                tier -= 1
+            if tier != self.tier:
+                self._transition(self.tier, tier, pressure, now)
+                self.tier = tier
+        return self.tier
+
+    def _transition(self, old: int, new: int, pressure: float,
+                    now: "float | None") -> None:
+        self.transitions += 1
+        if new > old:
+            self.entered_total[new] += 1
+        self.events.append(_PoolEvent(
+            "overload-enter" if new > old else "overload-exit",
+            time.perf_counter() if now is None else now,
+            {"from": old, "to": new, "pressure": round(pressure, 4)},
+        ))
+
+    def trace_events(self, time_origin: float | None = None) -> list[dict]:
+        """Tier transitions as Chrome-trace instants (``overload-*``
+        names land in their own ``"overload"`` category, mergeable into
+        a tick trace via ``to_chrome_trace(..., extra_events=...)``)."""
+        from ..obs.trace import instant_trace_events
+
+        return instant_trace_events(self.events, time_origin)
 
 
 def prefix_pool_key(tenant: str, prefix_ids) -> tuple[str, int]:
@@ -504,6 +1024,13 @@ class PrefixPool:
         self._lru: list[OrderedDict] = [
             OrderedDict() for _ in range(shards)
         ]
+        # slots handed back by evict_cold, reused lowest-first; fresh
+        # slots are minted from _next_slot while any remain.  (After a
+        # cold eviction len(lru) no longer names the next fresh slot,
+        # so installs must never derive a slot from it — a collision
+        # would silently share one KV row between two tenants.)
+        self._free_slots: list[list[int]] = [[] for _ in range(shards)]
+        self._next_slot: list[int] = [0] * shards
         self.hits = 0
         self.misses = 0
         self.installs = 0
@@ -587,15 +1114,20 @@ class PrefixPool:
                 f"bucket; got {ids.size} tokens (the worker prepends "
                 "off-bucket prefixes to the prompt instead)"
             )
-        if len(lru) >= self.entries:
+        if self._free_slots[shard]:
+            import heapq
+
+            slot = heapq.heappop(self._free_slots[shard])
+        elif self._next_slot[shard] < self.entries:
+            slot = self._next_slot[shard]
+            self._next_slot[shard] += 1
+        else:
             victim, slot = lru.popitem(last=False)
             self.evictions += 1
             self.events.append(_PoolEvent(
                 "prefix-evict", time.perf_counter(),
                 {"shard": shard, "tenant": victim[0], "slot": slot},
             ))
-        else:
-            slot = len(lru)
         entry = self._prefill_entry(ids)
         self._write_entry(entry, shard * self.entries + slot)
         lru[key] = slot
@@ -605,6 +1137,34 @@ class PrefixPool:
             {"shard": shard, "tenant": key[0], "slot": slot},
         ))
         return shard * self.entries + slot
+
+    def evict_cold(self, keep: int) -> int:
+        """Evict LRU-cold entries down to ``keep`` resident per shard —
+        the overload ladder's tier-2 action (shrink the pool's LIVE
+        footprint under memory pressure so the hottest tenants keep
+        their hits while cold residency stops pinning HBM rows).
+        Returns the number evicted; idempotent once resident <= keep.
+        Only host bookkeeping changes — the device rows are simply
+        reusable again, so this can never corrupt an in-flight gather
+        (an already-dispatched insert holds its own buffer reference).
+        """
+        if keep < 0:
+            raise ValueError(f"keep={keep} must be >= 0")
+        import heapq
+
+        evicted = 0
+        for shard, lru in enumerate(self._lru):
+            while len(lru) > keep:
+                victim, slot = lru.popitem(last=False)
+                heapq.heappush(self._free_slots[shard], slot)
+                self.evictions += 1
+                evicted += 1
+                self.events.append(_PoolEvent(
+                    "prefix-evict", time.perf_counter(),
+                    {"shard": shard, "tenant": victim[0], "slot": slot,
+                     "reason": "pressure"},
+                ))
+        return evicted
 
     def trace_events(self, time_origin: float | None = None) -> list[dict]:
         """The pool's install/evict decisions as Chrome-trace instant

@@ -260,6 +260,383 @@ def test_drr_emptied_queue_banks_nothing():
 
 
 # ---------------------------------------------------------------------------
+# EDF-blended DRR: deadline jumps under a bounded urgency budget
+# ---------------------------------------------------------------------------
+
+
+def _edf_drr(window=1.0, budget=2.0, weights=None, quantum=1.0):
+    weights = weights or {}
+    return DeficitRoundRobin(
+        weight_of=lambda t: weights.get(t, 1.0), quantum=quantum,
+        urgency_window_s=window, urgency_budget=budget,
+    )
+
+
+def test_edf_jump_charges_deficit_and_respects_budget():
+    # the worked example the module docstring promises, pinned: tenant
+    # "slo" has 4 staged requests all near deadline; tenant "bulk" has
+    # 6.  With budget 2, slo jumps exactly 2 requests ahead of fair
+    # order, then falls back into the rotation to repay.
+    drr = _edf_drr(window=1.0, budget=2.0)
+    for i in range(6):
+        drr.push("bulk", f"b{i}")
+    for i in range(4):
+        drr.push("slo", f"s{i}", deadline=100.0 + i)
+    picked = [item for _, item in drr.pick(4, now=100.0)]
+    # EDF phase: s0, s1 jump (deficit -> -2, the cap); fair rounds then
+    # resume at the cursor: bulk earns 1.0 and pops b0; slo is in debt
+    # (earns 1.0 -> -1.0, cannot pop); next round bulk pops b1
+    assert picked == ["s0", "s1", "b0", "b1"]
+    assert drr.deficit("slo") == pytest.approx(-1.0)  # repaying
+    assert drr.deficit("bulk") == pytest.approx(0.0)
+    assert drr.urgent_picks == 2
+
+
+def test_edf_deadline_outside_window_does_not_jump():
+    drr = _edf_drr(window=0.5, budget=2.0)
+    drr.push("bulk", "b0")
+    drr.push("slo", "s0", deadline=200.0)  # 100 s away: not urgent
+    assert [i for _, i in drr.pick(2, now=100.0)] == ["b0", "s0"]
+    assert drr.urgent_picks == 0
+
+
+def test_edf_without_now_or_window_is_pure_drr():
+    # pick(now=None) and window=0 both disarm the EDF phase even with
+    # deadlines staged
+    for drr in (_edf_drr(window=0.0), _edf_drr(window=5.0)):
+        drr.push("bulk", "b0")
+        drr.push("slo", "s0", deadline=100.0)
+        now = None if drr.urgency_window_s else 100.0
+        assert [i for _, i in drr.pick(2, now=now)] == ["b0", "s0"]
+        assert drr.urgent_picks == 0
+
+
+def test_edf_slo_free_stream_is_byte_identical_to_pure_drr():
+    # the dormancy contract: an ARMED scheduler fed a deadline-free
+    # stream picks exactly what the PR 10 scheduler picks
+    rng = np.random.default_rng(61)
+    ops = []
+    for _ in range(120):
+        if rng.random() < 0.6:
+            ops.append(("push", rng.choice(["a", "b", "c"])))
+        else:
+            ops.append(("pick", int(rng.integers(0, 5))))
+    plain = DeficitRoundRobin()
+    armed = _edf_drr(window=2.0, budget=3.0)
+    plain_picks, armed_picks = [], []
+    for n, (op, value) in enumerate(ops):
+        if op == "push":
+            plain.push(value, n)
+            armed.push(value, n)  # no deadline
+        else:
+            plain_picks += plain.pick(value)
+            armed_picks += armed.pick(value, now=1000.0 + n)
+    assert plain_picks == armed_picks
+    assert armed.urgent_picks == 0
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    ops=st.lists(
+        st.one_of(
+            st.builds(
+                lambda t, d: ("push", t, d),
+                t=st.sampled_from(("slo1", "slo2", "bulk")),
+                d=st.floats(0.0, 3.0),
+            ),
+            st.builds(lambda k: ("pick", k, 0), k=st.integers(0, 5)),
+        ),
+        min_size=1, max_size=80,
+    ),
+    w1=st.floats(0.25, 4.0),
+    budget=st.floats(0.0, 4.0),
+)
+def test_edf_combined_invariants_on_random_streams(ops, w1, budget):
+    # the combined fairness+urgency invariant on random deadline
+    # streams: deficits stay within [-budget, quantum*weight + 1],
+    # work conservation holds with jumps in play, and the whole thing
+    # is deterministic
+    weights = {"slo1": w1, "slo2": 1.0, "bulk": 1.0}
+
+    def run():
+        drr = DeficitRoundRobin(
+            weight_of=weights.get, quantum=1.0,
+            urgency_window_s=1.0, urgency_budget=budget,
+        )
+        picks = []
+        t = 100.0
+        for op, value, extra in ops:
+            t += 0.01
+            if op == "push":
+                # deadlines only for the slo tenants (bulk = no SLO)
+                deadline = t + extra if value != "bulk" else None
+                drr.push(value, f"{value}#{drr.staged}",
+                         deadline=deadline)
+            else:
+                staged_before = drr.staged
+                out = drr.pick(value, now=t)
+                # work conservation survives deadline jumps
+                assert len(out) == min(value, staged_before)
+                for tenant, weight in weights.items():
+                    d = drr.deficit(tenant)
+                    assert d <= 1.0 * weight + 1.0 + 1e-9
+                    assert d >= -budget - 1e-9
+                picks.extend(out)
+        return picks
+
+    assert run() == run()
+
+
+def test_edf_jump_never_starves_compliant_tenant():
+    # a continuous stream of always-urgent requests cannot lock out a
+    # compliant (no-SLO) backlogged tenant: the urgency budget bounds
+    # the borrow, and the fair rounds keep serving the victim
+    drr = _edf_drr(window=10.0, budget=2.0)
+    for i in range(50):
+        drr.push("bulk", f"b{i}")
+    served_bulk = 0
+    t = 0.0
+    for round_ in range(30):
+        # two fresh urgent requests arrive every pick
+        drr.push("urgent", f"u{round_}a", deadline=t + 0.1)
+        drr.push("urgent", f"u{round_}b", deadline=t + 0.1)
+        picked = [tenant for tenant, _ in drr.pick(2, now=t)]
+        served_bulk += picked.count("bulk")
+        t += 1.0
+    # bulk holds (close to) its fair half share despite every urgent
+    # request being inside the window — the budget repayment math
+    assert served_bulk >= 25
+
+
+def test_refund_restores_urgency_credit_for_urgent_picks():
+    # the review regression: a shed URGENT pick must give back the
+    # urgency credit it spent, or a flood of expired/redelivered
+    # copies strips an SLO tenant's jump budget permanently — while a
+    # shed FAIR pick must not mint credit it never spent, even when
+    # the SAME pick also contained an admitted urgent jump
+    drr = _edf_drr(window=5.0, budget=1.0)
+    drr.push("slo", "s0", deadline=100.0)
+    drr.push("slo", "s1", deadline=101.0)
+    (tenant, item), = drr.pick(1, now=100.0)
+    assert tenant == "slo" and drr.urgent_picks == 1
+    assert drr._credit["slo"] == pytest.approx(0.0)
+    drr.refund("slo", item)
+    assert drr._credit["slo"] == pytest.approx(1.0)  # jump re-armed
+    # refunding the same item twice cannot mint a second credit
+    drr._credit["slo"] = 0.0
+    drr.refund("slo", item)
+    assert drr._credit["slo"] == pytest.approx(0.0)
+    # a mixed pick: the urgent jump is ADMITTED, the fair pick of the
+    # SAME tenant is shed — the fair item's refund must not return the
+    # credit the admitted jump legitimately spent (credit refunds are
+    # attributed to the exact item, not a per-tenant count)
+    drr2 = DeficitRoundRobin(
+        keep=("slo",), urgency_window_s=5.0, urgency_budget=2.0,
+    )
+    drr2.push("slo", "u0", deadline=100.0)
+    drr2.push("slo", "f0")  # no deadline: picked by the fair rounds
+    picked = drr2.pick(2, now=100.0)
+    assert [i for _, i in picked] == ["u0", "f0"]
+    # pin a mid-stream credit level and freeze the lazy refill so the
+    # assertions see refund() alone
+    drr2._credit["slo"] = 0.5
+    drr2._credit_round["slo"] = drr2._rounds
+    drr2.refund("slo", picked[1][1])  # shed the FAIR item
+    assert drr2._credit["slo"] == pytest.approx(0.5)  # untouched
+    drr2.refund("slo", picked[0][1])  # shed the URGENT item
+    assert drr2._credit["slo"] == pytest.approx(1.5)  # exactly one back
+
+
+def test_refund_restores_charge_only_with_backlog():
+    drr = DeficitRoundRobin(weight_of=lambda t: 2.0)
+    for i in range(4):
+        drr.push("a", f"a{i}")
+    drr.pick(1)
+    charged = drr.deficit("a")
+    drr.refund("a")
+    assert drr.deficit("a") == pytest.approx(charged + 1.0)
+    # bounded: the refund returned exactly what the pick charged
+    assert drr.deficit("a") <= 2.0 + 1.0
+    # a drained tenant's refund is moot (deficit already reset)
+    drr2 = DeficitRoundRobin()
+    drr2.push("a", "a0")
+    drr2.pick(1)
+    drr2.refund("a")
+    assert drr2.deficit("a") == 0.0
+
+
+def test_pop_over_deadline_and_pop_tail():
+    drr = _edf_drr()
+    drr.push("a", "a0", deadline=10.0)
+    drr.push("a", "a1", deadline=11.0)
+    drr.push("b", "b0", deadline=5.0)
+    drr.push("c", "c0")  # no deadline: never past due
+    # most-over-SLO first (b0 at 5.0 beats a0 at 10.0)
+    assert drr.pop_over_deadline(now=20.0) == ("b", "b0")
+    # eligibility filter skips ineligible tenants
+    assert drr.pop_over_deadline(now=20.0, eligible={"c"}) is None
+    assert drr.pop_over_deadline(now=20.0) == ("a", "a0")
+    assert drr.pop_over_deadline(now=9.0) is None  # nothing past due
+    # pop_tail takes the NEWEST staged item
+    drr.push("a", "a2", deadline=12.0)
+    assert drr.pop_tail("a") == "a2"
+    assert drr.pop_tail("missing") is None
+
+
+# ---------------------------------------------------------------------------
+# OverloadLadder: hysteretic tiers, smoothing, trace instants
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_enters_highest_cleared_tier_and_exits_stepwise():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import OverloadLadder
+
+    ladder = OverloadLadder(3, smoothing=1.0)  # no smoothing: raw
+    assert ladder.update(0.2) == 0
+    assert ladder.update(0.95) == 3  # a cliff jumps straight to 3
+    # hysteresis: inside the band (>= exit 0.75) tier 3 holds
+    assert ladder.update(0.8) == 3
+    # below tier 3's exit but above tier 2's (0.6): steps down ONE
+    assert ladder.update(0.7) == 2
+    assert ladder.update(0.1) == 0  # below every exit: all the way
+    # 0->3, 3->2, 2->0: the full descent is one transition event
+    assert ladder.transitions == 3
+    assert ladder.entered_total[3] == 1
+
+
+def test_ladder_tier_cap_and_validation():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import OverloadLadder
+
+    ladder = OverloadLadder(1, smoothing=1.0)
+    assert ladder.update(1.0) == 1  # capped at tiers=1
+    with pytest.raises(ValueError, match="tiers"):
+        OverloadLadder(0)
+    with pytest.raises(ValueError, match="tiers"):
+        OverloadLadder(4)
+    with pytest.raises(ValueError, match="smoothing"):
+        OverloadLadder(2, smoothing=0.0)
+
+
+def test_ladder_smoothing_rides_through_dips():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import OverloadLadder
+
+    ladder = OverloadLadder(3, smoothing=0.5)
+    for _ in range(6):
+        ladder.update(1.0)
+    assert ladder.tier == 3
+    # a one-cycle dip (shed just drained staging) must not exit
+    ladder.update(0.55)
+    assert ladder.tier == 3
+    assert ladder.transitions == 1
+
+
+def test_ladder_trace_instants_land_in_overload_category():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import OverloadLadder
+
+    ladder = OverloadLadder(3, smoothing=1.0)
+    ladder.update(0.95, now=1.0)
+    ladder.update(0.1, now=2.0)
+    names = [e.name for e in ladder.events]
+    assert names[0] == "overload-enter"
+    assert "overload-exit" in names
+    events = ladder.trace_events(time_origin=0.0)
+    assert all(e["cat"] == "overload" and e["ph"] == "i"
+               for e in events)
+    assert events[0]["args"]["to"] == 3
+
+
+def test_prefix_pool_evict_cold_reuses_slots_without_collision(
+    model, params,
+):
+    # the slot-accounting regression: after evict_cold frees arbitrary
+    # slots, installs must reuse THOSE slots — deriving the slot from
+    # len(lru) would collide with a surviving entry's row (silent
+    # cross-tenant KV sharing)
+    pool = _pool(model, params, entries=3)
+    keys = [prefix_pool_key("t", _prefix(i)) for i in range(4)]
+    rows = [pool.acquire(0, keys[i], _prefix(i)) for i in range(3)]
+    assert pool.evict_cold(keep=1) == 2  # keeps only keys[2] (MRU)
+    assert pool.resident(0, keys[2])
+    assert not pool.resident(0, keys[0])
+    row3 = pool.acquire(0, keys[3], _prefix(3))
+    # the new install landed in a FREED slot, never on keys[2]'s row
+    assert row3 != rows[2]
+    assert row3 in rows[:2]
+    assert pool.acquire(0, keys[2], _prefix(2)) == rows[2]  # intact
+    assert pool.evict_cold(keep=3) == 0  # idempotent at/below keep
+    with pytest.raises(ValueError, match="keep"):
+        pool.evict_cold(keep=-1)
+
+
+# ---------------------------------------------------------------------------
+# The offered-rate flood classifier
+# ---------------------------------------------------------------------------
+
+
+def test_over_share_classifies_sustained_flood_not_trickler():
+    fair = FairAdmission(
+        TenancyConfig(tenants=("victim",), ttft_slo_s=(0.5,)),
+        per_tenant_limit=8, total_limit=64,
+    )
+    for cycle in range(12):
+        fair.note_cycle()
+        for i in range(4):  # flood: 4 new messages every cycle
+            fair.stage("flood", f"f{cycle}:{i}",
+                       message_id=f"mf{cycle}:{i}")
+        if cycle % 3 == 0:  # victim: one every third cycle
+            fair.stage("victim", f"v{cycle}", message_id=f"mv{cycle}")
+        fair.drr.pick(4)  # drain so caps never interfere
+    assert fair.over_share() == {"flood"}
+
+
+def test_over_share_counts_unique_messages_once():
+    # redeliveries of the SAME message are not offered load: a victim
+    # whose backlog redelivers every cycle must not read as a flood
+    fair = FairAdmission(
+        TenancyConfig(tenants=("v",)), per_tenant_limit=2,
+        total_limit=4,
+    )
+    for cycle in range(10):
+        fair.note_cycle()
+        for i in range(4):  # same four messages re-offered every cycle
+            fair.stage("v", f"item{i}", message_id=f"m{i}")
+    assert fair.arrival_rate.get("v", 0.0) < fair.OVER_SHARE_MIN_RATE
+
+
+def test_over_share_counts_per_tenant_cap_hits():
+    # a flooder saturating its own staging cap still classifies: the
+    # cap-hit rejections carry the offered-load signal its throttled
+    # stages cannot
+    fair = FairAdmission(
+        TenancyConfig(tenants=("victim",)), per_tenant_limit=2,
+        total_limit=32,
+    )
+    n = 0
+    for cycle in range(10):
+        fair.note_cycle()
+        for _ in range(5):
+            fair.stage("flood", f"f{n}", message_id=f"m{n}")
+            n += 1
+        fair.stage("victim", f"v{cycle}", message_id=f"mv{cycle}")
+        # nothing drains: flood pinned at its cap of 2
+    assert fair.drr.depth("flood") == 2
+    assert fair.over_share() == {"flood"}
+
+
+def test_arrival_rate_decays_out_and_stays_bounded():
+    fair = FairAdmission(
+        TenancyConfig(tenants=("a",)), per_tenant_limit=4,
+        total_limit=64,
+    )
+    for i in range(40):
+        fair.stage(f"ghost{i}", i, message_id=f"g{i}")
+    assert len(fair.arrival_rate) == 40
+    for _ in range(20):
+        fair.note_cycle()
+    assert not fair.arrival_rate  # fully decayed out
+
+
+# ---------------------------------------------------------------------------
 # FairAdmission: bounded staging with hand-back overflow
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,597 @@
+"""Deadline-aware admission under overload: the shed ladder end to
+end, the degraded-row device contract, the per-tenant forecaster seam,
+the adversarial scenario builders, and the overload bench smoke.
+
+Tier-1 (tiny model, CPU); the full zipf/flood battery with wall-clock
+gates runs in the slow tier.  The EDF/DRR scheduler invariants
+themselves live in tests/test_admission.py — this module covers the
+layers ABOVE the scheduler: worker integration, forecasting, scenarios,
+and the BENCH_r16 gates.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock  # noqa: E402
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue  # noqa: E402
+from kube_sqs_autoscaler_tpu.workloads.continuous import (  # noqa: E402
+    ContinuousBatcher,
+    ContinuousWorker,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.service import (  # noqa: E402
+    ServiceConfig,
+    collect_replies,
+)
+from kube_sqs_autoscaler_tpu.workloads.tenancy import (  # noqa: E402
+    TenancyConfig,
+)
+
+BATCH, PROMPT, TOKENS, BLOCK = 2, 4, 8, 2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=PROMPT + TOKENS, dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return init_params(jax.random.key(0), model)
+
+
+def _config(**overrides):
+    base = dict(
+        queue_url="t://q", batch_size=BATCH, seq_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK,
+        result_queue_url="t://r",
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+def _send(queue, tenant, ids, url="t://q"):
+    return queue.send_message(
+        url, json.dumps({"tenant": tenant,
+                         "ids": np.asarray(ids).tolist()})
+    )
+
+
+# ---------------------------------------------------------------------------
+# The staged-expiry refund bugfix (redelivered/expired picks must not
+# skew DRR accounting, and the freed room must be re-picked)
+# ---------------------------------------------------------------------------
+
+
+def test_staged_expiry_sheds_refund_and_repick(model, params):
+    clock = FakeClock()
+    queue = FakeMessageQueue(now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    worker = ContinuousWorker(
+        queue, params, model, _config(request_ttl_s=5.0),
+        result_queue=results,
+        tenancy=TenancyConfig(tenants=("victim", "flood"),
+                              staging_per_tenant=4, staging_total=8),
+        now_fn=clock.now,
+    )
+    rng = np.random.default_rng(3)
+    # three flood messages sent (and staged) at t=0
+    for _ in range(3):
+        _send(queue, "flood", rng.integers(1, 64, 3))
+    for message in queue.receive_messages("t://q", max_messages=3):
+        worker._fair.stage("flood", (
+            "flood", None,
+            np.asarray(json.loads(message["Body"])["ids"], np.int32),
+            message,
+        ))
+    # ten seconds later the staged flood items are long expired; two
+    # fresh victim messages arrive
+    clock.advance(10.0)
+    for _ in range(2):
+        _send(queue, "victim", rng.integers(1, 64, 3))
+    admitted = worker._refill()
+    # ONE refill: expired flood picks shed (explicit replies, TTL
+    # reason, deficit refunded) and the freed room re-picked the fresh
+    # victims — work conservation holds through the sheds: every free
+    # slot got a victim even though the DRR's first picks were all
+    # doomed flood items
+    assert worker.shed_by_reason["ttl"] == 2
+    assert admitted == 2
+    assert worker.batcher.active == 2
+    tenants = [s.tenant for s in worker.batcher.slots if s.busy]
+    assert tenants == ["victim", "victim"]
+    # the refund: the flood was charged for picks that consumed no
+    # slot, then refunded — its banked deficit lets its NEXT staged
+    # item pick without re-earning, instead of silently shrinking its
+    # future share
+    assert worker._fair.drr.deficit("flood") >= 1.0
+    # the remaining expired item sheds as soon as a refill has room
+    for _ in range(200):
+        worker.run_once()
+        if worker.processed + worker.shed >= 5:
+            break
+    assert worker.shed_by_reason["ttl"] == 3
+    replies, duplicates = collect_replies(results, "t://r")
+    assert duplicates == 0
+    assert sum(
+        1 for p in replies.values() if p.get("error") == "expired"
+    ) == 3
+    assert sum(1 for p in replies.values() if "tokens" in p) == 2
+
+
+# ---------------------------------------------------------------------------
+# The overload ladder through a real worker
+# ---------------------------------------------------------------------------
+
+
+def _flood_worker(model, params, *, shed_tiers, queue, results,
+                  generate_tokens=TOKENS):
+    tenancy = TenancyConfig(
+        tenants=("victim", "flood"), ttft_slo_s=(0.5, 0.0),
+        urgency_window_s=0.6, shed_tiers=shed_tiers,
+        staging_per_tenant=6, staging_total=6,
+    )
+    return ContinuousWorker(
+        queue, params, model, _config(generate_tokens=generate_tokens),
+        result_queue=results, tenancy=tenancy,
+    )
+
+
+def _drive_flood(worker, queue, *, cycles=14, flood_per_cycle=4,
+                 victim_every=3):
+    rng = np.random.default_rng(7)
+    sent = {"victim": [], "flood": []}
+    for cycle in range(cycles):
+        for _ in range(flood_per_cycle):
+            sent["flood"].append(
+                _send(queue, "flood", rng.integers(1, 64, PROMPT))
+            )
+        if cycle % victim_every == 0:
+            sent["victim"].append(
+                _send(queue, "victim", rng.integers(1, 64, PROMPT))
+            )
+        worker.run_once()
+    total = len(sent["victim"]) + len(sent["flood"])
+    for _ in range(4000):
+        if (worker.processed + worker.shed_by_reason["ttl"]
+                + worker.shed_by_reason["pressure"]) >= total:
+            break
+        worker.run_once()
+    return sent, total
+
+
+def test_tier3_sheds_flood_with_explicit_replies_never_victims(
+    model, params,
+):
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    worker = _flood_worker(model, params, shed_tiers=3, queue=queue,
+                           results=results)
+    sent, total = _drive_flood(worker, queue)
+    assert worker.shed_by_reason["pressure"] > 0
+    assert worker.ladder.entered_total[3] >= 1
+    replies, duplicates = collect_replies(results, "t://r")
+    assert duplicates == 0
+    assert len(replies) == total  # every shed answered: exactly-once
+    # every victim request COMPLETED (the no-victim-shed contract)
+    for mid in sent["victim"]:
+        assert "tokens" in replies[mid], replies[mid]
+    shed_replies = [
+        p for p in replies.values()
+        if p.get("error") == "shed under overload pressure"
+    ]
+    assert len(shed_replies) == worker.shed_by_reason["pressure"]
+    assert {p.get("tenant") for p in shed_replies} == {"flood"}
+
+
+def test_tier1_degrades_flood_budgets_not_victims(model, params):
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    worker = _flood_worker(model, params, shed_tiers=1, queue=queue,
+                           results=results)
+    sent, total = _drive_flood(worker, queue, cycles=10)
+    assert worker.shed_by_reason["degraded"] > 0
+    assert worker.shed_by_reason["pressure"] == 0  # tier capped at 1
+    replies, _ = collect_replies(results, "t://r")
+    assert len(replies) == total  # degraded requests still complete
+    degraded = max(1, TOKENS // 2)
+    flood_lengths = {len(replies[m]["tokens"]) for m in sent["flood"]}
+    assert degraded in flood_lengths  # some flood replies were cut
+    for mid in sent["victim"]:  # victims keep their full budget
+        assert len(replies[mid]["tokens"]) == TOKENS
+    assert worker.completed_by_tenant["victim"] == len(sent["victim"])
+
+
+def test_tier2_evicts_cold_pool_entries_under_pressure(model, params):
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    tenancy = TenancyConfig(
+        tenants=("victim", "flood"), prefix_pool=4, prefix_len=PROMPT,
+        shed_tiers=2, staging_per_tenant=6, staging_total=6,
+    )
+    config = _config(seq_len=PROMPT)
+    # the pooled budget check needs prefix + prompt + tokens to fit
+    small = ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=2 * PROMPT + TOKENS, dtype=jnp.float32,
+    )
+    small_params = init_params(jax.random.key(1), small)
+    worker = ContinuousWorker(
+        queue, small_params, small, config, result_queue=results,
+        tenancy=tenancy,
+    )
+    pool = worker.batcher.prefix_pool
+    rng = np.random.default_rng(11)
+    # warm three pool entries (distinct prefixes), then flood plain
+    # traffic to raise pressure past tier 2
+    for prefix_seed in range(3):
+        prefix = rng.integers(1, 64, PROMPT)
+        queue.send_message("t://q", json.dumps({
+            "tenant": "victim", "prefix": prefix.tolist(),
+            "ids": rng.integers(1, 64, PROMPT).tolist(),
+        }))
+        worker.run_once()
+    for _ in range(30):
+        worker.run_once()
+    resident_before = sum(pool.stats()["resident"])
+    assert resident_before == 3
+    for cycle in range(12):
+        for _ in range(4):
+            _send(queue, "flood", rng.integers(1, 64, PROMPT))
+        worker.run_once()
+    assert worker.ladder.entered_total[2] >= 1
+    assert pool.evictions >= 1  # tier 2 shrank the resident set
+    assert sum(pool.stats()["resident"]) <= max(1, pool.entries // 2)
+
+
+def test_shed_reason_counters_and_overload_gauges_render(model, params):
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+
+    queue, results = FakeMessageQueue(), FakeMessageQueue()
+    worker = _flood_worker(model, params, shed_tiers=3, queue=queue,
+                           results=results)
+    metrics = WorkloadMetrics()
+    worker.attach_metrics(metrics)
+    _drive_flood(worker, queue, cycles=8)
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    assert f"# TYPE {prefix}_requests_shed_total counter" in text
+    # the unlabeled series is the sum of the reason-labeled ones
+    # (dashboard compatibility)
+    total_line = [
+        line for line in text.splitlines()
+        if line.startswith(f"{prefix}_requests_shed_total ")
+    ]
+    assert total_line and float(total_line[0].split()[-1]) == float(
+        worker.shed
+    )
+    for reason in ("ttl", "degraded", "pressure"):
+        assert (
+            f'{prefix}_requests_shed_total{{reason="{reason}"}}' in text
+        )
+    assert f"{prefix}_overload_tier " in text
+    assert f"{prefix}_overload_pressure " in text
+    assert f"{prefix}_overload_tier_transitions_total" in text
+
+
+# ---------------------------------------------------------------------------
+# The degraded-row device contract (quiesce + taint)
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_row_reuse_is_byte_identical(model, params):
+    # a degraded slot finishes while its DEVICE budget is unspent; the
+    # row must be quiesced and kept out of admission until the
+    # in-flight block settles — re-admitting sooner would leak the old
+    # request's stale tokens into the new request's slot
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(1, 64, PROMPT).astype(np.int32)
+               for _ in range(3)]
+
+    def reference(prompt):
+        ref = ContinuousBatcher(
+            params, model, batch_size=BATCH, prompt_len=PROMPT,
+            generate_tokens=TOKENS, decode_block=BLOCK,
+        )
+        ref.submit(prompt, "ref")
+        out = []
+        for _ in range(100):
+            out += ref.step()
+            if out:
+                return out[0][1].tolist()
+        raise AssertionError("reference did not finish")
+
+    batcher = ContinuousBatcher(
+        params, model, batch_size=BATCH, prompt_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK,
+        tenancy=TenancyConfig(tenants=("a",)),
+    )
+    rows = batcher.submit_many([(prompts[0], "m0"), (prompts[1], "m1")])
+    # simulate the ladder's tier-1 action on m0: budget cut below the
+    # device's static budget
+    batcher.slots[rows[0]].budget = 2
+    batcher.slots[rows[0]].degraded = True
+    finished = {}
+    taint_seen = False
+    for _ in range(200):
+        for payload, tokens in batcher.step():
+            finished[payload] = tokens.tolist()
+        if batcher._tainted:
+            taint_seen = True
+            # a tainted row is not admissible this cycle
+            assert not set(batcher.free_slots) & batcher._tainted
+        if "m0" in finished and "m2" not in finished \
+                and batcher.free_slots:
+            batcher.submit_many([(prompts[2], "m2")])
+        if len(finished) == 3:
+            break
+    assert taint_seen
+    assert len(finished) == 3
+    assert len(finished["m0"]) == 2  # the degraded reply is short
+    # the request admitted into the recycled row decoded exactly what
+    # a fresh engine decodes — no stale-token leak
+    assert finished["m2"] == reference(prompts[2])
+    assert finished["m1"] == reference(prompts[1])
+
+
+# ---------------------------------------------------------------------------
+# The forecaster seam: per-tenant depths -> SLO-weighted gate depth
+# ---------------------------------------------------------------------------
+
+
+def test_slo_urgency_weights_anchor_at_loosest_slo():
+    from kube_sqs_autoscaler_tpu.forecast.tenants import (
+        slo_urgency_weights,
+    )
+
+    tenancy = TenancyConfig(
+        tenants=("tight", "loose", "free"),
+        ttft_slo_s=(0.25, 1.0, 0.0),
+    )
+    weights = slo_urgency_weights(tenancy)
+    assert weights == {"tight": 4.0, "loose": 1.0, "free": 1.0}
+    # no SLOs at all: every weight degenerates to 1.0
+    assert set(slo_urgency_weights(
+        TenancyConfig(tenants=("a", "b"))
+    ).values()) == {1.0}
+
+
+def test_tenant_depth_history_records_and_bounds():
+    from kube_sqs_autoscaler_tpu.forecast.tenants import (
+        OTHER_TENANTS,
+        TenantDepthHistory,
+    )
+
+    history = TenantDepthHistory(capacity=8, max_tenants=2)
+    history.observe(1.0, {"a": 3, "b": 1})
+    history.observe(2.0, {"a": 5, "evil1": 7, "evil2": 9})
+    assert history.latest()["a"] == 5.0
+    assert history.latest()["b"] == 0.0  # absent = explicit zero
+    # past max_tenants, new labels fold into the catch-all
+    assert set(history.tenants()) == {"a", "b", OTHER_TENANTS}
+    assert history.latest()[OTHER_TENANTS] == 16.0
+
+
+def test_tenant_aware_depth_boosts_gates_by_weighted_backlog():
+    from kube_sqs_autoscaler_tpu.forecast.tenants import (
+        TenantAwareDepth,
+    )
+
+    tenancy = TenancyConfig(
+        tenants=("tight", "loose"), ttft_slo_s=(0.25, 1.0),
+    )
+    depths = {"tight": 10, "loose": 4}
+    policy = TenantAwareDepth(lambda: depths, tenancy)
+    # 10 tight requests weigh 4x: 40 + 4 = 44 > the observed 20
+    assert policy.effective_messages(0.0, 20) == 44
+    assert policy.last_weighted == pytest.approx(44.0)
+    # monotone: a large observation passes through unshrunk
+    assert policy.effective_messages(1.0, 100) == 100
+    # unknown labels weigh 1.0
+    depths = {"stranger": 7}
+    assert policy.effective_messages(2.0, 0) == 7
+
+
+def test_tenant_aware_depth_forecasts_per_tenant():
+    from kube_sqs_autoscaler_tpu.forecast import EwmaForecaster
+    from kube_sqs_autoscaler_tpu.forecast.tenants import (
+        TenantAwareDepth,
+    )
+
+    tenancy = TenancyConfig(tenants=("tight",), ttft_slo_s=(0.5,))
+    feed = {"tight": 0}
+    policy = TenantAwareDepth(
+        lambda: feed, tenancy, forecaster=EwmaForecaster(alpha=0.9),
+        horizon=5.0, min_samples=2,
+    )
+    for t, depth in enumerate((2, 4, 6, 8)):
+        feed = {"tight": depth}
+        boosted = policy.effective_messages(float(t), 0)
+    # the forecast can only RAISE the weighted depth past the latest
+    # observation, never below it (conservative, like PredictivePolicy)
+    assert boosted >= 8
+    assert policy.name == "tenant-aware:ewma"
+
+
+def test_worker_pool_aggregates_staged_by_tenant(model, params):
+    from kube_sqs_autoscaler_tpu.fleet import WorkerPool
+
+    queue = FakeMessageQueue()
+    pool = WorkerPool.serving(
+        queue, params, model, _config(result_queue_url=""),
+        tenancy=TenancyConfig(tenants=("a", "b")),
+        min=1, max=2,
+    )
+    try:
+        rng = np.random.default_rng(23)
+        for tenant in ("a", "a", "a", "b"):
+            _send(queue, tenant, rng.integers(1, 64, 3))
+        pool.run_cycle()
+        staged = pool.staged_by_tenant()
+        # the DRR admitted one of each tenant into the BATCH slots;
+        # a's second stayed staged, a's third was handed back at the
+        # per-tenant cap; every configured tenant reports (0 included)
+        assert staged == {"a": 1, "b": 0}
+    finally:
+        pool.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# Scenario builders
+# ---------------------------------------------------------------------------
+
+
+def test_zipf_scenario_shape_and_determinism():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import zipf_scenario
+
+    scenario = zipf_scenario(tenants=60, heads=2, cycles=20)
+    again = zipf_scenario(tenants=60, heads=2, cycles=20)
+    assert scenario.schedule() == again.schedule()
+    floods = [t for t in scenario.traffics if t.flood]
+    assert len(floods) == 2  # the zipf head IS the flood
+    assert all(t.tenant.startswith("z") for t in floods)
+    victims = [t for t in scenario.traffics
+               if not t.flood and t.ttft_slo_s > 0]
+    assert victims  # SLO victims trickle through the attack
+    # rank-k rate follows ~1/k: rank 2 sends strictly more often than
+    # rank 20
+    by_name = {t.tenant: t for t in scenario.traffics}
+    assert by_name["z2"].every < by_name["z20"].every
+
+
+def test_flash_crowd_is_one_shot_population_churn():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        flash_crowd_scenario,
+    )
+
+    scenario = flash_crowd_scenario(crowd=50, crowd_start=3,
+                                    crowd_span=2)
+    crowd = [t for t in scenario.traffics if t.flood]
+    assert len(crowd) == 50
+    for t in crowd:
+        sends = [t.sends_at(c, scenario.cycles)
+                 for c in range(scenario.cycles)]
+        assert sum(sends) == 1  # each crowd tenant fires exactly once
+        assert 3 <= sends.index(1) < 5
+
+
+def test_coordinated_flood_windows_align():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        coordinated_flood_scenario,
+    )
+
+    scenario = coordinated_flood_scenario(floods=3, flood_start=4,
+                                          flood_cycles=6)
+    floods = [t for t in scenario.traffics if t.flood]
+    assert len(floods) == 3
+    assert {(t.start_cycle, t.end_cycle) for t in floods} == {(4, 10)}
+    assert all(t.ttft_slo_s > 0 for t in scenario.traffics
+               if not t.flood)
+
+
+def test_overload_battery_scales_population_not_intensity():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import overload_battery
+
+    full = overload_battery()
+    smoke = overload_battery(scale=0.05)
+    assert len(full) == len(smoke) == 3
+    # thousands of distinct tenants at full scale
+    assert sum(len(s.tenants) for s in full) > 2000
+    assert sum(len(s.tenants) for s in smoke) < 300
+    # the attack intensity survives the shrink (per-cycle flood rate)
+    full_flood = [t for t in full[0].traffics if t.flood][0]
+    smoke_flood = [t for t in smoke[0].traffics if t.flood][0]
+    assert full_flood.per_cycle == smoke_flood.per_cycle
+
+
+# ---------------------------------------------------------------------------
+# CLI rejections for the new knobs
+# ---------------------------------------------------------------------------
+
+
+def test_overload_flag_rejections():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import (
+        main as worker_main,
+    )
+
+    base = ["--demo", "1", "--continuous", "--generate-tokens", "2"]
+    with pytest.raises(SystemExit, match="requires --tenants"):
+        worker_main(base + ["--tenant-slos", "0.5"])
+    with pytest.raises(SystemExit, match="requires --tenants"):
+        worker_main(base + ["--urgency-window", "0.5"])
+    with pytest.raises(SystemExit, match="requires --tenants"):
+        worker_main(base + ["--shed-tiers", "2"])
+    with pytest.raises(SystemExit, match="counts must match"):
+        worker_main(base + ["--tenants", "a,b",
+                            "--tenant-slos", "0.5"])
+    with pytest.raises(SystemExit, match=">= 0"):
+        worker_main(base + ["--tenants", "a",
+                            "--tenant-slos", "-0.5"])
+    with pytest.raises(SystemExit, match="floats"):
+        worker_main(base + ["--tenants", "a",
+                            "--tenant-slos", "fast"])
+    with pytest.raises(SystemExit, match="positive --tenant-slos"):
+        worker_main(base + ["--tenants", "a",
+                            "--urgency-window", "0.5"])
+    with pytest.raises(SystemExit, match="\\[0, 3\\]"):
+        worker_main(base + ["--tenants", "a", "--shed-tiers", "4"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        worker_main(base + ["--tenants", "a",
+                            "--tenant-slos", "0.5",
+                            "--urgency-window", "-1"])
+
+
+# ---------------------------------------------------------------------------
+# The overload bench: tier-1 smoke, full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_overload_bench_smoke(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_overload.json"
+    summary = bench.run_overload_suite(
+        output=str(out), scale=0.05, timing_gates=False,
+    )
+    assert summary["metric"] == "overload_victim_ttft_p99_improvement"
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "overload"
+    for name, episode in artifact["episodes"].items():
+        for mode in ("baseline", "deadline"):
+            row = episode[mode]
+            assert row["answered"] == row["requests"], (name, mode)
+            assert row["duplicates"] == 0
+    deadline_flood = artifact["episodes"]["coordinated-flood"]["deadline"]
+    assert deadline_flood["shed_by_reason"]["pressure"] > 0
+    assert deadline_flood["urgent_picks"] > 0
+    parity = artifact["slo_free_parity"]
+    assert parity["deadline-armed"]["ladder_transitions"] == 0
+    assert parity["deadline-armed"]["urgent_picks"] == 0
+    assert (parity["pr10"]["insert_dispatches"]
+            == parity["deadline-armed"]["insert_dispatches"])
+
+
+@pytest.mark.slow
+def test_overload_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_overload_full.json"
+    summary = bench.run_overload_suite(output=str(out))
+    assert summary["vs_baseline"] > 1.0
+    artifact = json.loads(out.read_text())
+    for name in ("coordinated-flood", "zipf"):
+        episode = artifact["episodes"][name]
+        assert (episode["deadline"]["victim_ttft_p99_s"]
+                < episode["baseline"]["victim_ttft_p99_s"])
+        assert (episode["deadline"]["victim_time_over_slo_s"]
+                < episode["baseline"]["victim_time_over_slo_s"])
