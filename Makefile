@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -127,6 +127,19 @@ bench-tenants:
 # BENCH_r16.json
 bench-overload:
 	JAX_PLATFORMS=cpu python bench.py --suite overload
+
+# Token-level compiled serving twin (CPU JAX, ~a minute and a half):
+# cycle-exact fidelity of the lax.scan serving twin against the REAL
+# ShardedBatcher plane (completions, tokens, TTFT, queue depths, shard
+# counts, prefix hits/misses — 0 divergences, pre- AND post-training),
+# then antithetic-ES retraining of the policy network with reward in
+# serving units; exits 2 unless the serving-twin-trained checkpoint
+# beats the fluid-twin checkpoint, the stock reactive gates, and the
+# train-tuned reactive sweep winners on held-out scenario variants,
+# lexicographically (tokens/s -> time-over-TTFT-SLO -> shard churn);
+# writes BENCH_r17.json + the deployable SERVING_POLICY.json
+bench-twin:
+	JAX_PLATFORMS=cpu python bench.py --suite twin
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

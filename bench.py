@@ -90,6 +90,7 @@ import argparse
 import dataclasses
 import json
 import math
+import os
 import sys
 import time
 
@@ -3153,13 +3154,316 @@ def run_overload_suite(output: str = "BENCH_r16.json", *,
     }
 
 
+#: Seeds for the twin suite's serving-scenario variant splits (same
+#: discipline as the fluid learn suite: disjoint sha256-keyed worlds).
+TWIN_TRAIN_SEED = 301
+TWIN_HELD_OUT_SEED = 502
+
+
+def run_twin_suite(
+    output: str = "BENCH_r17.json",
+    checkpoint_output: str = "SERVING_POLICY.json",
+    *,
+    cycles: int = 240,
+    population: int = 24,
+    generations: int = 30,
+    train_variants: int = 1,
+    held_variants: int = 2,
+    fluid_checkpoint_path: str = "LEARNED_POLICY.json",
+    fidelity_learned_limit: "int | None" = None,
+    require_win: bool = True,
+) -> dict:
+    """Token-level serving twin: fidelity-gate it, retrain the policy
+    in serving units, and gate the result (ROADMAP item 2).
+
+    Phases and hard gates (any failure exits 2):
+
+    1. **Pre-train fidelity** — the full serving battery (steady /
+       ramp / flash-crowd / regime-switch / heavy-tail budgets /
+       prefix-tenants) plus swept gate points, compiled twin vs the
+       REAL ``ShardedBatcher`` plane cycle for cycle: completions,
+       tokens, TTFT, queue depth, shard counts, prefix hits/misses —
+       0 divergences.
+    2. **Serving-unit retraining** — antithetic ES inside the twin
+       with reward = tokens/s − time-over-TTFT-SLO − churn −
+       shard-seconds (`learn/serving.py`).
+    3. **Post-train fidelity** — the trained network's twin episodes
+       re-verified against the real plane, 0 divergences.
+    4. **Held-out win** (``require_win``; the tier-1 smoke reports it
+       without gating) — on variants no search saw, the serving-twin
+       checkpoint must beat, lexicographically in serving units
+       (tokens/s, then time-over-TTFT-SLO, then shard churn): the
+       FLUID-twin checkpoint evaluated in the serving twin (the
+       committed ``LEARNED_POLICY.json``, or a freshly trained one),
+       the stock reactive gates, AND the train-tuned reactive sweep
+       winners per scenario family.
+    """
+    from kube_sqs_autoscaler_tpu.learn.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from kube_sqs_autoscaler_tpu.learn.serving import (
+        ServingESConfig,
+        train_serving,
+    )
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint, SweepSpec, run_sweep
+    from kube_sqs_autoscaler_tpu.sim.twin import (
+        default_twin_battery,
+        twin_variants,
+        verify_twin_fidelity,
+    )
+    from kube_sqs_autoscaler_tpu.sim.twin.compiled import (
+        TwinConfig,
+        run_twin_grouped,
+        score_twin_summary,
+        serving_lex_key,
+        twin_config_for_point,
+    )
+
+    start = time.perf_counter()
+    base = default_twin_battery(cycles=cycles)
+    train_set = base + twin_variants(base, train_variants,
+                                     seed=TWIN_TRAIN_SEED)
+    held_out = twin_variants(base, held_variants, seed=TWIN_HELD_OUT_SEED)
+    family_of = lambda name: name.split("~")[0]  # noqa: E731
+
+    # the tuned-reactive search space, in QUEUE-DEPTH units (the twin's
+    # gate thresholds are request counts, not fluid message depths)
+    spec = SweepSpec(
+        scale_up_messages=(2, 4, 6, 10), scale_down_messages=(0, 1),
+        scale_up_cooldown=(0.25, 0.5, 1.0),
+        scale_down_cooldown=(1.0, 2.0), scale_up_pods=(1,),
+        policies=("reactive",),
+    )
+
+    # -- 1. pre-train fidelity ------------------------------------------
+    t0 = time.perf_counter()
+    pre_configs = [TwinConfig(scenario=s) for s in base]
+    # cover the swept gate region too, like the fluid sweep suite does
+    pre_configs += [
+        twin_config_for_point(point, base[0])
+        for point in spec.sample(2, seed=11)
+    ]
+    fidelity_pre = verify_twin_fidelity(pre_configs)
+    fidelity_pre_s = time.perf_counter() - t0
+    if not fidelity_pre.ok:
+        for line in fidelity_pre.format_divergences():
+            print(line, file=sys.stderr)
+        raise SystemExit(2)
+
+    # -- 2. train in serving units --------------------------------------
+    es = ServingESConfig(population=population, generations=generations)
+    t0 = time.perf_counter()
+    result = train_serving(train_set, es)
+    train_s = time.perf_counter() - t0
+    checkpoint = result.checkpoint
+
+    # -- 3. post-train fidelity -----------------------------------------
+    t0 = time.perf_counter()
+    learned_scenarios = (
+        base
+        if fidelity_learned_limit is None
+        else base[:fidelity_learned_limit]
+    )
+    fidelity_post = verify_twin_fidelity([
+        TwinConfig(scenario=s, policy="learned", checkpoint=checkpoint)
+        for s in learned_scenarios
+    ])
+    fidelity_post_s = time.perf_counter() - t0
+    if not fidelity_post.ok:
+        for line in fidelity_post.format_divergences():
+            print(line, file=sys.stderr)
+        raise SystemExit(2)
+
+    # -- 4. held-out comparison -----------------------------------------
+    t0 = time.perf_counter()
+    train_report = run_sweep(spec, train_set)
+    by_family: dict[str, dict[str, dict]] = {}
+    for row in train_report.rows:
+        entry = by_family.setdefault(
+            family_of(row["scenario"]), {}
+        ).setdefault(row["label"], {"scores": [], "point": row["point"]})
+        entry["scores"].append(row["score"])
+    winners = {
+        family: SweepPoint(**min(
+            labels.values(),
+            key=lambda e: serving_lex_key(e["scores"]),
+        )["point"])
+        for family, labels in by_family.items()
+    }
+    if os.path.exists(fluid_checkpoint_path):
+        fluid_checkpoint = load_checkpoint(fluid_checkpoint_path)
+        fluid_source = fluid_checkpoint_path
+    else:
+        # no committed fluid artifact: train one with the learn suite's
+        # exact configuration so the baseline stays the nuanced policy
+        # that bench produces, not a strawman
+        from kube_sqs_autoscaler_tpu.learn.train import ESConfig, train
+        from kube_sqs_autoscaler_tpu.sim.evaluate import default_battery
+        from kube_sqs_autoscaler_tpu.sim.scenarios import scenario_variants
+
+        fluid_base = list(default_battery())
+        fluid_result = train(
+            fluid_base + scenario_variants(fluid_base, 2,
+                                           seed=LEARN_TRAIN_SEED),
+            ESConfig(population=32, generations=40, seed=0,
+                     churn_weight=0.3, replica_weight=0.15),
+        )
+        fluid_checkpoint = fluid_result.checkpoint
+        fluid_source = "trained-in-suite (learn-suite config)"
+
+    def score_rows(configs):
+        episodes = run_twin_grouped(configs, trajectory=False)
+        rows = []
+        for episode in episodes:
+            row = score_twin_summary(
+                episode.summary, episode.config.scenario
+            )
+            row["scenario"] = episode.config.scenario.name
+            rows.append(row)
+        return rows
+
+    reactive_rows = score_rows([TwinConfig(scenario=s) for s in held_out])
+    tuned_rows = score_rows([
+        twin_config_for_point(winners[family_of(s.name)], s)
+        for s in held_out
+    ])
+    fluid_rows = score_rows([
+        TwinConfig(scenario=s, policy="learned",
+                   checkpoint=fluid_checkpoint, allow_twin_mismatch=True)
+        for s in held_out
+    ])
+    serving_rows = score_rows([
+        TwinConfig(scenario=s, policy="learned", checkpoint=checkpoint)
+        for s in held_out
+    ])
+    totals = {
+        "reactive": serving_lex_key(reactive_rows),
+        "tuned_reactive": serving_lex_key(tuned_rows),
+        "fluid_checkpoint": serving_lex_key(fluid_rows),
+        "serving_checkpoint": serving_lex_key(serving_rows),
+    }
+    beats = {
+        name: totals["serving_checkpoint"] < key
+        for name, key in totals.items()
+        if name != "serving_checkpoint"
+    }
+    compare_s = time.perf_counter() - t0
+    if require_win and not all(beats.values()):
+        losses = [name for name, won in beats.items() if not won]
+        print(
+            f"twin: held-out gate failed — serving checkpoint"
+            f" {totals['serving_checkpoint']} does not beat:"
+            f" {', '.join(losses)} ({ {k: list(v) for k, v in totals.items()} })"
+            f" (lexicographic -tokens/s, time-over-SLO, churn)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+
+    # every gate passed — publish the deployable serving-twin artifact
+    save_checkpoint(checkpoint_output, checkpoint)
+
+    def total_dict(key):
+        return dict(zip(
+            ("neg_tokens_per_second", "time_over_slo_s", "shard_changes"),
+            [float(key[0]), float(key[1]), int(key[2])],
+        ))
+
+    slo_reduction = (
+        totals["tuned_reactive"][1] / totals["serving_checkpoint"][1]
+        if totals["serving_checkpoint"][1]
+        else float("inf")
+    )
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "twin",
+        "elapsed_s": round(elapsed, 2),
+        "fidelity": {
+            "pre_train": {
+                "episodes": fidelity_pre.episodes,
+                "cycles": fidelity_pre.cycles,
+                "divergences": len(fidelity_pre.divergences),
+                "elapsed_s": round(fidelity_pre_s, 2),
+            },
+            "post_train": {
+                "episodes": fidelity_post.episodes,
+                "cycles": fidelity_post.cycles,
+                "divergences": len(fidelity_post.divergences),
+                "elapsed_s": round(fidelity_post_s, 2),
+            },
+        },
+        "training": {
+            "config": {
+                "population": es.population,
+                "generations": es.generations,
+                "sigma": es.sigma,
+                "lr": es.lr,
+                "seed": es.seed,
+                "weights": {
+                    "tokens": es.tokens_weight,
+                    "slo": es.slo_weight,
+                    "churn": es.churn_weight,
+                    "shard_seconds": es.shard_weight,
+                },
+            },
+            "scenarios": [s.name for s in train_set],
+            "elapsed_s": round(train_s, 2),
+            "episodes_per_generation": (
+                (es.population + 1) * len(train_set)
+            ),
+            "reward_first": round(result.reward_curve[0], 4),
+            "reward_best": round(max(result.reward_curve), 4),
+            "checkpoint": checkpoint_output,
+            "checkpoint_hash": checkpoint.hash,
+            "twin_kind": checkpoint.meta["twin"],
+            "reward_units": checkpoint.meta["reward_units"],
+        },
+        "held_out": {
+            "seed": TWIN_HELD_OUT_SEED,
+            "episodes": len(held_out),
+            "fluid_checkpoint": {
+                "source": fluid_source,
+                "hash": fluid_checkpoint.hash,
+            },
+            "tuned_winners": {
+                name: point.label() for name, point in winners.items()
+            },
+            "totals": {k: total_dict(v) for k, v in totals.items()},
+            "beats": beats,
+            "gated": require_win,
+            "rows": {
+                "reactive": reactive_rows,
+                "tuned_reactive": tuned_rows,
+                "fluid_checkpoint": fluid_rows,
+                "serving_checkpoint": serving_rows,
+            },
+            "elapsed_s": round(compare_s, 2),
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    fidelity_cycles = fidelity_pre.cycles + fidelity_post.cycles
+    return {
+        "metric": "twin_held_out_time_over_slo_reduction",
+        "value": round(slo_reduction, 2),
+        "unit": (
+            f"x less time-over-TTFT-SLO than train-tuned reactive on"
+            f" {len(held_out)} held-out serving variants, with >= its"
+            f" tokens/s and less churn ({fidelity_cycles} fidelity"
+            f" cycles vs the real sharded plane, 0 divergences)"
+        ),
+        "vs_baseline": round(slo_reduction, 2),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
-                 "tenants", "overload"),
+                 "tenants", "overload", "twin"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -3185,7 +3489,10 @@ if __name__ == "__main__":
         " (EDF-blended DRR + shed ladder vs pure DRR under coordinated"
         " floods / zipf populations / flash crowds; strictly-better"
         " victim p99 + time-over-SLO gates, SLO-free dormancy"
-        " byte-identity)",
+        " byte-identity); twin = token-level compiled serving twin"
+        " (cycle-exact fidelity vs the real sharded plane, ES retraining"
+        " with serving-unit reward, held-out win over the fluid-twin"
+        " checkpoint + reactive baselines)",
     )
     cli.add_argument(
         "--output", default="",
@@ -3194,7 +3501,7 @@ if __name__ == "__main__":
         " BENCH_r06.json / BENCH_r07.json / BENCH_r08.json /"
         " BENCH_r09.json / BENCH_r10.json / BENCH_r11.json /"
         " BENCH_r12.json / BENCH_r13.json / BENCH_r14.json /"
-        " BENCH_r15.json / BENCH_r16.json)",
+        " BENCH_r15.json / BENCH_r16.json / BENCH_r17.json)",
     )
     cli_args = cli.parse_args()
     if cli_args.suite == "forecast":
@@ -3225,5 +3532,7 @@ if __name__ == "__main__":
         print(json.dumps(
             run_overload_suite(cli_args.output or "BENCH_r16.json")
         ))
+    elif cli_args.suite == "twin":
+        print(json.dumps(run_twin_suite(cli_args.output or "BENCH_r17.json")))
     else:
         print(json.dumps(run_bench()))

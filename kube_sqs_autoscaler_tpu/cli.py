@@ -349,9 +349,16 @@ def load_learned_checkpoint(parser: argparse.ArgumentParser,
     if args.policy != "learned":
         return None
     from .learn import CheckpointError, load_checkpoint
+    from .learn.checkpoint import TWIN_FLUID, require_twin
 
     try:
-        return load_checkpoint(args.policy_checkpoint)
+        checkpoint = load_checkpoint(args.policy_checkpoint)
+        # deployment seam: this CLI drives the fluid control loop, so a
+        # SERVING-twin checkpoint (tokens/s reward, shard-count
+        # actuation) must be rejected here as a usage error, not
+        # surface as garbage decisions mid-episode
+        require_twin(checkpoint, TWIN_FLUID, "--policy learned")
+        return checkpoint
     except CheckpointError as err:
         parser.error(str(err))
 

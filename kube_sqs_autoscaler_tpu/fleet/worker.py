@@ -135,6 +135,7 @@ class FleetWorker(ContinuousWorker):
             if slot.busy:
                 messages.append(slot.payload)
                 self.batcher.slots[row] = _Slot()
+        self.batcher._invalidate_admission_cache()
         # fair-admission staging holds received-but-unadmitted messages
         # (live receipt handles): they are in-flight work too — strand
         # them and a dead replica's staged requests wait out the full

@@ -18,7 +18,11 @@ costs seconds, not cluster-hours.  The package is four seams:
   :class:`~..core.types.DepthPolicy` for the real ``ControlLoop``,
   bit-identical to the compiled scan (``verify_fidelity``-gated);
 - :mod:`.rollout` / :mod:`.train` — population evaluation fused into the
-  compiled episode scan, and the antithetic-sampled ES loop on top.
+  compiled episode scan, and the antithetic-sampled ES loop on top;
+- :mod:`.serving` — the same ES loop inside the token-level SERVING
+  twin (:mod:`..sim.twin`), reward in tokens/s + time-over-TTFT-SLO +
+  shard churn; checkpoints carry their training-twin kind and every
+  deployment seam enforces it at load time (``require_twin``).
 
 Exports resolve lazily: :mod:`..sim.compiled` imports :mod:`.network`
 (the shared decision function) while :mod:`.rollout` imports
@@ -44,6 +48,15 @@ _EXPORTS = {
     "learned_config": ("rollout", "learned_config"),
     "ESConfig": ("train", "ESConfig"),
     "train": ("train", "train"),
+    # the serving-twin trainer (reward in tokens/s + TTFT-SLO + churn;
+    # see sim/twin and ARCHITECTURE.md "The serving twin")
+    "ServingESConfig": ("serving", "ServingESConfig"),
+    "train_serving": ("serving", "train_serving"),
+    "evaluate_population_serving": (
+        "rollout", "evaluate_population_serving",
+    ),
+    "checkpoint_twin": ("checkpoint", "checkpoint_twin"),
+    "require_twin": ("checkpoint", "require_twin"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -52,6 +52,46 @@ class CheckpointError(ValueError):
     """A checkpoint failed validation (missing/corrupt/incompatible)."""
 
 
+#: Training-twin kinds.  A checkpoint's weights only mean something
+#: relative to the world that trained them: a FLUID-twin policy reads
+#: queue-depth features scaled by the reference gate thresholds and
+#: actuates replica counts; a SERVING-twin policy reads the serving
+#: plane's request-queue depth and actuates shard counts with reward in
+#: tokens/s + time-over-TTFT-SLO + churn.  Deploying one where the
+#: other is expected is silent garbage, so the kind is stamped into
+#: checkpoint meta and enforced at LOAD time by every deployment seam
+#: (``LearnedPolicy``, replay, the fluid rollout, the serving twin).
+TWIN_FLUID = "fluid"
+TWIN_SERVING = "serving"
+TWIN_KINDS = (TWIN_FLUID, TWIN_SERVING)
+
+
+def checkpoint_twin(checkpoint: "PolicyCheckpoint") -> str:
+    """The twin kind a checkpoint was trained in.
+
+    Missing stamp = ``fluid``: every checkpoint before the serving twin
+    existed was trained in the fluid twin, so the default keeps old
+    artifacts deployable without rewriting them.
+    """
+    return str(checkpoint.meta.get("twin", TWIN_FLUID))
+
+
+def require_twin(
+    checkpoint: "PolicyCheckpoint", expected: str, seam: str
+) -> None:
+    """Reject a checkpoint whose training twin doesn't match the
+    deployment seam — a load-time :class:`CheckpointError` naming both
+    sides, never silent garbage mid-tick."""
+    kind = checkpoint_twin(checkpoint)
+    if kind != expected:
+        raise CheckpointError(
+            f"checkpoint {checkpoint.hash} was trained in the {kind!r}"
+            f" twin; {seam} deploys {expected!r}-twin checkpoints —"
+            f" retrain for this seam (reward units:"
+            f" {checkpoint.meta.get('reward_units', 'unrecorded')!r})"
+        )
+
+
 #: History-ring capacity the learned features run on, train and deploy.
 #: Smaller than the forecasters' 128 default on purpose: the feature set
 #: (EWMA level, 12-sample trend) saturates well below 64 samples, and
@@ -119,6 +159,11 @@ class PolicyCheckpoint:
                         f"meta[{key!r}] must be an integer >= {floor},"
                         f" got {value!r}"
                     )
+        if "twin" in self.meta and self.meta["twin"] not in TWIN_KINDS:
+            raise CheckpointError(
+                f"meta['twin'] must be one of {TWIN_KINDS}, got"
+                f" {self.meta['twin']!r}"
+            )
 
     @property
     def hash(self) -> str:
@@ -158,6 +203,11 @@ def checkpoint_hash(checkpoint: PolicyCheckpoint) -> str:
         "min_samples": min_samples,
         "theta": [float(w) for w in checkpoint.theta],
     }
+    # the training twin is decision-relevant (it names the feature
+    # semantics and actuation units); keyed in only for non-fluid kinds
+    # so every pre-serving-twin checkpoint keeps its published hash
+    if checkpoint_twin(checkpoint) != TWIN_FLUID:
+        content["twin"] = checkpoint_twin(checkpoint)
     canonical = json.dumps(content, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(canonical.encode("utf-8")).hexdigest()[:12]
 

@@ -75,6 +75,13 @@ class LearnedPolicy:
         history: DepthHistory | None = None,
         min_samples: int = 3,
     ) -> None:
+        from .checkpoint import TWIN_FLUID, require_twin
+
+        # the deployment seam check: a serving-twin checkpoint's weights
+        # mean shard counts and serving-plane features — thresholding
+        # the fluid replica gates on them is silent garbage, so it must
+        # be a load-time error here, not a bad episode later
+        require_twin(checkpoint, TWIN_FLUID, "LearnedPolicy (ControlLoop)")
         self.checkpoint = checkpoint
         self.policy = policy
         self.poll_interval = float(poll_interval)

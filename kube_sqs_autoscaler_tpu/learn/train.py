@@ -222,6 +222,8 @@ def train(
         hidden=config.hidden,
         meta={
             "trainer": "antithetic-es",
+            "twin": "fluid",
+            "reward_units": "depth+churn+slo+replica-seconds (fluid)",
             "config": asdict(config),
             "forecast_history": config.history,
             "min_samples": config.min_samples,

@@ -31,15 +31,23 @@ compiled twin").
 from .scenarios import (
     ArrivalProcess,
     BurstArrival,
+    ComposedArrival,
     ConstantArrival,
     DiurnalArrival,
+    PulseArrival,
     RampArrival,
+    RegimeSwitchArrival,
     StepArrival,
     arrival_variant,
+    heavy_tail_lengths,
     scenario_variants,
     variant_bounds,
 )
 from .simulator import SimConfig, SimResult, Simulation
+
+# NOTE: .twin (the token-level serving twin) is also not imported here —
+# it pulls in JAX like .compiled; import kube_sqs_autoscaler_tpu.sim.twin
+# explicitly.
 
 __all__ = [
     "SimConfig",
@@ -51,7 +59,11 @@ __all__ = [
     "RampArrival",
     "DiurnalArrival",
     "BurstArrival",
+    "PulseArrival",
+    "ComposedArrival",
+    "RegimeSwitchArrival",
     "arrival_variant",
+    "heavy_tail_lengths",
     "scenario_variants",
     "variant_bounds",
 ]

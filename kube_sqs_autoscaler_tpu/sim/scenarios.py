@@ -186,6 +186,125 @@ class BurstArrival:
         return total
 
 
+@dataclass(frozen=True)
+class PulseArrival:
+    """A one-shot rectangular surge: ``rate`` msg/s on ``[start, start +
+    width)``, zero outside — the flash-crowd primitive.  On its own it is
+    a degenerate world (nothing before or after the pulse); composed over
+    a base process via :class:`ComposedArrival` it is a product launch /
+    retry storm landing on organic traffic."""
+
+    rate: float
+    start: float
+    width: float
+
+    def __post_init__(self):
+        if self.width <= 0:
+            raise ValueError("width must be positive")
+        if self.rate < 0:
+            raise ValueError("rate must be >= 0")
+
+    def rate_at(self, t: float) -> float:
+        return self.rate if self.start <= t < self.start + self.width else 0.0
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        overlap = min(t1, self.start + self.width) - max(t0, self.start)
+        return self.rate * max(0.0, overlap)
+
+
+@dataclass(frozen=True)
+class ComposedArrival:
+    """The sum of component processes — arbitrary shapes stack (base +
+    pulse, diurnal + bursts, ...).  Exact by construction: the integral
+    of a sum is the sum of the component integrals, each of which is
+    already exact."""
+
+    parts: "tuple[ArrivalProcess, ...]"
+
+    def __post_init__(self):
+        if not self.parts:
+            raise ValueError("ComposedArrival needs at least one part")
+
+    def rate_at(self, t: float) -> float:
+        return sum(p.rate_at(t) for p in self.parts)
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        return sum(p.arrivals_between(t0, t1) for p in self.parts)
+
+
+@dataclass(frozen=True)
+class RegimeSwitchArrival:
+    """Piecewise regimes: ``regimes[i] = (start_i, process_i)`` with
+    ``process_i`` active on ``[start_i, start_{i+1})`` (the last regime
+    runs forever).  Each regime's process is evaluated on its LOCAL
+    clock ``t - start_i`` — a burst regime restarts its burst phase at
+    the switch instant, which is what "the workload changed character"
+    means.  The integral splits exactly at the boundaries, so the shape
+    stays quadrature-free like every other process here."""
+
+    regimes: "tuple[tuple[float, ArrivalProcess], ...]"
+
+    def __post_init__(self):
+        if not self.regimes:
+            raise ValueError("RegimeSwitchArrival needs at least one regime")
+        starts = [s for s, _ in self.regimes]
+        if starts[0] != 0.0:
+            raise ValueError("the first regime must start at t=0")
+        if any(b <= a for a, b in zip(starts, starts[1:])):
+            raise ValueError("regime starts must be strictly increasing")
+
+    def _spans(self) -> "list[tuple[float, float, ArrivalProcess]]":
+        starts = [s for s, _ in self.regimes]
+        ends = starts[1:] + [math.inf]
+        return [
+            (s, e, p) for (s, p), e in zip(self.regimes, ends)
+        ]
+
+    def rate_at(self, t: float) -> float:
+        for start, end, process in self._spans():
+            if start <= t < end:
+                return process.rate_at(t - start)
+        # t before 0: the first regime's local clock extends backwards
+        start, _, process = self._spans()[0]
+        return process.rate_at(t - start)
+
+    def arrivals_between(self, t0: float, t1: float) -> float:
+        total = 0.0
+        for start, end, process in self._spans():
+            a, b = max(t0, start), min(t1, end)
+            if b > a:
+                total += process.arrivals_between(a - start, b - start)
+        return total
+
+
+def heavy_tail_lengths(
+    tag: str, n: int, lo: int, hi: int, alpha: float = 1.2
+) -> "list[int]":
+    """``n`` integer lengths from a bounded-Pareto tail on ``[lo, hi]``.
+
+    ``P(L >= k) ∝ k^-alpha``: most draws sit near ``lo``, a deterministic
+    rare few reach toward ``hi`` — the prompt/output-length shape real
+    serving traffic has and uniform budgets hide.  Seeded with sha256 of
+    ``tag`` (the :func:`seeded_token_ids` convention), so a (tag, n, lo,
+    hi, alpha) tuple always draws the identical sequence on any host —
+    the serving twin and the real plane consume the SAME concrete
+    integers, never "the same distribution"."""
+    if not 1 <= lo <= hi:
+        raise ValueError(f"need 1 <= lo <= hi, got lo={lo} hi={hi}")
+    if alpha <= 0:
+        raise ValueError(f"alpha={alpha} must be > 0")
+    digest = hashlib.sha256(f"lengths:{tag}".encode()).digest()
+    rng = random.Random(int.from_bytes(digest[:8], "big"))
+    ratio = (lo / hi) ** alpha
+    out = []
+    for _ in range(n):
+        u = rng.random()
+        # inverse CDF of the bounded Pareto(alpha) on [lo, hi]
+        x = lo / (1.0 - u * (1.0 - ratio)) ** (1.0 / alpha)
+        out.append(max(lo, min(hi, int(x))))
+    return out
+
+
 def as_process(arrival: "float | int | ArrivalProcess") -> ArrivalProcess:
     """Coerce a plain number (the seed's config style) to a process."""
     if isinstance(arrival, (int, float)):
@@ -266,6 +385,29 @@ def variant_bounds(
             "burst_len": band(process.burst_len),
             "first_burst": band(process.first_burst),
         }
+    if isinstance(process, PulseArrival):
+        return {
+            "rate": band(process.rate),
+            "start": band(process.start),
+            "width": band(process.width),
+        }
+    if isinstance(process, ComposedArrival):
+        # composite shapes declare bounds per part; the generator
+        # recurses with a per-part name so sibling parts draw
+        # independent jitters
+        bounds: dict[str, tuple[float, float]] = {}
+        for i, part in enumerate(process.parts):
+            for key, value in variant_bounds(part, jitter).items():
+                bounds[f"part{i}.{key}"] = value
+        return bounds
+    if isinstance(process, RegimeSwitchArrival):
+        bounds = {}
+        for i, (start, part) in enumerate(process.regimes):
+            if i > 0:  # the first regime's start is pinned at 0
+                bounds[f"regime{i}.start"] = band(start)
+            for key, value in variant_bounds(part, jitter).items():
+                bounds[f"regime{i}.{key}"] = value
+        return bounds
     raise TypeError(
         f"no variant rule for arrival process {type(process).__name__}"
     )
@@ -322,6 +464,42 @@ def arrival_variant(
             burst_len=min(draw("burst_len"), period),
             first_burst=draw("first_burst"),
         )
+    if isinstance(process, PulseArrival):
+        return PulseArrival(
+            rate=draw("rate"),
+            start=draw("start"),
+            width=max(draw("width"), 1e-6),
+        )
+    if isinstance(process, ComposedArrival):
+        return ComposedArrival(
+            parts=tuple(
+                arrival_variant(part, seed, f"{name}#p{i}", index, jitter)
+                for i, part in enumerate(process.parts)
+            )
+        )
+    if isinstance(process, RegimeSwitchArrival):
+        regimes = []
+        prev = -math.inf
+        for i, (start, part) in enumerate(process.regimes):
+            # the start jitter draws from its OWN key — sharing the
+            # part's key would consume the part's first draw and
+            # perfectly correlate "when the regime switches" with its
+            # first parameter, collapsing the variant space
+            rng_i = _variant_rng(seed, f"{name}#r{i}.start", index)
+            lo_hi = bounds.get(f"regime{i}.start")
+            new_start = 0.0 if i == 0 else rng_i.uniform(*lo_hi)
+            # boundaries must stay strictly increasing; clamp within the
+            # declared band like the diurnal amplitude clamp
+            new_start = max(new_start, prev + 1e-6)
+            prev = new_start
+            regimes.append(
+                (
+                    new_start,
+                    arrival_variant(part, seed, f"{name}#r{i}", index,
+                                    jitter),
+                )
+            )
+        return RegimeSwitchArrival(regimes=tuple(regimes))
     raise TypeError(  # pragma: no cover — variant_bounds rejects first
         f"no variant rule for arrival process {type(process).__name__}"
     )

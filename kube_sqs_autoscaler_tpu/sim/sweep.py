@@ -183,7 +183,16 @@ def pareto_front(points: Sequence[tuple[float, float]]) -> list[int]:
 
 #: score-row ordering for "best": worst backlog first, then churn, then
 #: SLO time — the battery's priorities (evaluate module docstring).
+#: Serving-twin rows rank in SERVING units instead: most tokens/s,
+#: then least time-over-TTFT-SLO, then least shard churn — the twin
+#: bench's lexicographic axes.
 def _rank(row: dict) -> tuple:
+    if "tokens_per_second" in row:
+        return (
+            -row["tokens_per_second"],
+            row["time_over_slo_s"],
+            row["shard_changes"],
+        )
     return (
         row["max_depth"],
         row["replica_changes"],
@@ -230,11 +239,21 @@ class SweepReport:
         }
 
     def pareto_per_scenario(self) -> dict[str, list[dict]]:
-        """Max-depth-vs-churn Pareto front per scenario, depth-sorted."""
+        """Backlog-vs-churn Pareto front per scenario, best-first.
+
+        Fluid rows minimize (max depth, replica churn); serving rows
+        minimize (-tokens/s, shard churn) — the same two-axis
+        throughput-vs-actuation tradeoff in each world's units."""
         fronts: dict[str, list[dict]] = {}
         for name, rows in self._by_scenario().items():
             axes = [
-                (r["score"]["max_depth"], r["score"]["replica_changes"])
+                (
+                    (-r["score"]["tokens_per_second"],
+                     r["score"]["shard_changes"])
+                    if "tokens_per_second" in r["score"]
+                    else (r["score"]["max_depth"],
+                          r["score"]["replica_changes"])
+                )
                 for r in rows
             ]
             front = [rows[i] for i in pareto_front(axes)]
@@ -271,14 +290,24 @@ def run_sweep(
     """
     # Lazy import: this module's spec/Pareto half stays importable without
     # JAX (bench.py's default suite imports nothing from sim.compiled).
-    from .compiled import run_episodes_grouped
-
     if isinstance(points, SweepSpec):
         points = points.grid()
     points = list(points)
     if not points:
         raise ValueError("sweep needs at least one point")
     scenarios = tuple(scenarios if scenarios is not None else default_battery())
+    from .twin.scenario import ServingScenario
+
+    serving = [isinstance(s, ServingScenario) for s in scenarios]
+    if any(serving):
+        if not all(serving):
+            raise ValueError(
+                "one sweep takes fluid scenarios OR serving scenarios,"
+                " not a mix (their score units are incomparable)"
+            )
+        return _run_serving_sweep(points, scenarios)
+    from .compiled import run_episodes_grouped
+
     jobs = [
         (scenario, point) for scenario in scenarios for point in points
     ]
@@ -293,6 +322,50 @@ def run_sweep(
                 "label": point.label(),
                 "point": asdict(point),
                 "score": score_result(episode.result, scenario.slo_depth),
+            }
+        )
+    return report
+
+
+def _run_serving_sweep(points, scenarios) -> SweepReport:
+    """Tuned-threshold baselines on SERVING worlds: each reactive gate
+    point re-runs through the token-level twin and is scored in serving
+    units (:func:`~.twin.compiled.score_twin_summary`), so
+    ``best_per_scenario``/``best_points_per_scenario`` pick winners on
+    the same lexicographic axes the twin bench gates.  Forecaster
+    points are skipped — the serving twin's policy seam is reactive
+    thresholds or the learned network, and a sweep must not silently
+    score a forecaster point as something else."""
+    from .twin.compiled import (
+        run_twin_grouped,
+        score_twin_summary,
+        twin_config_for_point,
+    )
+
+    reactive_points = [p for p in points if p.policy == "reactive"]
+    if not reactive_points:
+        raise ValueError(
+            "a serving sweep needs at least one reactive point"
+            " (forecaster points have no serving-twin analogue)"
+        )
+    jobs = [
+        (scenario, point)
+        for scenario in scenarios
+        for point in reactive_points
+    ]
+    episodes = run_twin_grouped(
+        [twin_config_for_point(point, scenario)
+         for scenario, point in jobs],
+        trajectory=False,
+    )
+    report = SweepReport()
+    for (scenario, point), episode in zip(jobs, episodes):
+        report.rows.append(
+            {
+                "scenario": scenario.name,
+                "label": point.label(),
+                "point": asdict(point),
+                "score": score_twin_summary(episode.summary, scenario),
             }
         )
     return report

@@ -1,0 +1,40 @@
+"""The token-level compiled serving twin (ROADMAP item 2).
+
+PR 9's compiled twin simulates a *fluid queue* — the right world for the
+reference autoscaler, the wrong one for the sharded serving fleet the
+controller has actuated since PR 6: the fleet is scored in tokens/s,
+TTFT, and time-over-TTFT-SLO, and its spin-up is a near-free mask flip
+(BLITZSCALE), which a fluid replica-rate world cannot express at all.
+
+This package simulates the serving plane itself at token granularity —
+slots, decode blocks, refill/admission, freest-first + sticky routing,
+prefix-cache hits/misses, shard counts behind the drain/retire state
+machine — as ONE ``jax.lax.scan`` per episode, vmapped over config ×
+scenario batches, exactly the architecture ``sim/compiled.py`` proved
+for the fluid loop.  Fidelity is mechanical, not assumed:
+:func:`~.fidelity.verify_twin_fidelity` replays the identical scripted
+request streams through the REAL :class:`~...workloads.shard_plane.
+ShardedBatcher` and compares cycle-for-cycle completions, tokens,
+TTFT, queue depths, shard counts, and prefix hits/misses — 0
+divergences, reported through replay's ``Divergence`` machinery.
+
+The learned autoscaling policy (``learn/``) retrains inside this twin
+with reward in serving units (tokens/s, time-over-TTFT-SLO, churn);
+``bench.py --suite twin`` gates the result.
+"""
+
+from .compiled import (  # noqa: F401
+    SERVING_SUMMARY_KEYS,
+    TwinConfig,
+    TwinEpisode,
+    run_twin_episodes,
+    run_twin_grouped,
+    score_twin_summary,
+)
+from .fidelity import TwinFidelityReport, verify_twin_fidelity  # noqa: F401
+from .host import run_host_episode, tiny_twin_model  # noqa: F401
+from .scenario import (  # noqa: F401
+    ServingScenario,
+    default_twin_battery,
+    twin_variants,
+)

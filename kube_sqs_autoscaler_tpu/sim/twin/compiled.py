@@ -1,0 +1,776 @@
+"""The serving plane as one ``lax.scan`` per episode.
+
+One scan iteration is one ENGINE CYCLE of the real sharded plane
+(:class:`~...workloads.shard_plane.ShardedBatcher` driven by the
+:mod:`.host` reference driver), reproduced integer-for-integer:
+
+- **arrivals** land on the queue from the scenario's exact-integral
+  send schedule;
+- every ``control_every`` cycles an **autoscaler tick** runs: the
+  observed queue depth (or the learned MLP's decision over it — the
+  same :func:`~...learn.network.learned_decision` the fluid twin and
+  the live ``LearnedPolicy`` call) goes through the reference
+  :func:`~...core.policy.gate_code` gates with cooldowns, actuating the
+  :mod:`...fleet.sharded` shard state machine (scale-up resurrects the
+  newest draining shard else activates the lowest inactive one;
+  scale-down drains the newest serving shard; both stamps refresh on
+  FIRE, boundary no-ops included);
+- **refill** admits ``min(queue, eligible slots)`` requests FIFO,
+  routed one at a time to the freest serving shard (deterministic
+  lowest-index tie-break — the real router's exact order), sticky to a
+  tenant's home shard when tenancy is on, each admission touching the
+  per-shard prefix-pool LRU (hit/miss/install counters);
+- **step** mirrors the gang block engine's dispatch-ahead mechanics
+  exactly: a dispatched block spends ``min(decode_block, remaining)``
+  device budget immediately but its tokens settle one cycle later;
+  admission first-tokens settle the same cycle (the one combined
+  transfer); a slot frees the cycle its produced count reaches budget;
+- **drain-retire** flips an emptied draining shard inactive, end of
+  cycle — the pool's ``run_cycle`` order.
+
+TTFT is cycle-counted at admission (first tokens settle at the
+admission cycle's combined transfer), so time-over-TTFT-SLO is exact —
+plus a lower-bound penalty for requests still queued at episode end,
+so refusing admission can never launder SLO debt.
+
+What the twin deliberately does NOT model (see ARCHITECTURE.md): KV
+bytes, host/queue-poll jitter and backoff, DRR fair admission, chaos
+states, speculative decode.  Within that boundary,
+:func:`~.fidelity.verify_twin_fidelity` holds it to ZERO divergences
+against the real plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import enable_x64
+
+from ...core.policy import GATE_COOLING, GATE_FIRE, GATE_SKIPPED, gate_code
+from ...learn.network import FEATURE_ALPHA, FEATURE_WINDOW, hold_depth, learned_decision
+from .scenario import SHARD_DRAINING, SHARD_INACTIVE, SHARD_SERVING, ServingScenario
+
+#: Policy kinds inside the twin scan — reactive thresholds, or the
+#: learned MLP (the fluid twin's code for it, for symmetry).
+REACTIVE_KIND, LEARNED_KIND = 0, 4
+
+#: Summary keys every twin episode returns (the serving-unit
+#: accumulators the ES trainer and the sweep scorer consume).
+SERVING_SUMMARY_KEYS = (
+    "tokens",
+    "time_over_slo_s",
+    "shard_changes",
+    "shard_seconds",
+    "completions",
+    "admitted",
+    "final_queue",
+    "max_queue",
+    "ttft_cycles_sum",
+    "pool_hits",
+    "pool_misses",
+)
+
+#: Trajectory keys (per-cycle arrays) the fidelity gate compares.
+TRAJECTORY_KEYS = (
+    "admitted",
+    "completed",
+    "tokens",
+    "ttft_cycles",
+    "queue",
+    "serving",
+    "pool_hits",
+    "pool_misses",
+)
+
+
+@dataclass(frozen=True)
+class TwinConfig:
+    """One twin episode: a scenario + the policy that autoscales it.
+
+    ``policy`` is ``"reactive"`` (threshold the scenario's queue gates
+    on the observed depth) or ``"learned"`` (a serving-twin-trained
+    checkpoint; fluid-twin checkpoints are rejected unless
+    ``allow_twin_mismatch`` — the bench's explicit baseline escape
+    hatch, never the deployment default).  Gate knobs default to the
+    scenario's; the serving sweep overrides them per point.
+    """
+
+    scenario: ServingScenario
+    policy: str = "reactive"
+    checkpoint: Any = None
+    allow_twin_mismatch: bool = False
+    scale_up_queue: "int | None" = None
+    scale_down_queue: "int | None" = None
+    up_cooldown_s: "float | None" = None
+    down_cooldown_s: "float | None" = None
+
+    def __post_init__(self):
+        if self.policy not in ("reactive", "learned"):
+            raise ValueError(
+                f"twin policy must be 'reactive' or 'learned', got"
+                f" {self.policy!r}"
+            )
+        if self.policy == "learned":
+            if self.checkpoint is None:
+                raise ValueError("policy='learned' needs a checkpoint")
+            from ...learn.checkpoint import TWIN_SERVING, checkpoint_twin
+
+            kind = checkpoint_twin(self.checkpoint)
+            if kind != TWIN_SERVING and not self.allow_twin_mismatch:
+                raise ValueError(
+                    f"checkpoint was trained in the {kind!r} twin; the"
+                    f" serving twin evaluates serving-twin checkpoints"
+                    f" (pass allow_twin_mismatch=True to score a"
+                    f" foreign checkpoint as an explicit baseline)"
+                )
+
+    @property
+    def up_q(self) -> int:
+        return (
+            self.scale_up_queue
+            if self.scale_up_queue is not None
+            else self.scenario.scale_up_queue
+        )
+
+    @property
+    def down_q(self) -> int:
+        return (
+            self.scale_down_queue
+            if self.scale_down_queue is not None
+            else self.scenario.scale_down_queue
+        )
+
+    @property
+    def up_cd(self) -> float:
+        return (
+            self.up_cooldown_s
+            if self.up_cooldown_s is not None
+            else self.scenario.up_cooldown_s
+        )
+
+    @property
+    def down_cd(self) -> float:
+        return (
+            self.down_cooldown_s
+            if self.down_cooldown_s is not None
+            else self.scenario.down_cooldown_s
+        )
+
+
+def encode_twin_config(
+    config: TwinConfig, r_max: int, t_max: int
+) -> dict[str, Any]:
+    """One :class:`TwinConfig` as the scan's parameter row (request
+    arrays padded to the batch group's ``r_max``/``t_max``)."""
+    s = config.scenario
+    sends = s.sends()
+    total = int(sends.sum())
+    if total > r_max:
+        raise ValueError(f"{total} requests exceed the group pad {r_max}")
+    arr = np.full(r_max, s.cycles + 1, np.int32)
+    arr[:total] = s.arrival_cycles()
+    budgets = np.ones(r_max, np.int32)
+    budgets[:total] = s.request_budgets(total)
+    tenants = np.zeros(r_max, np.int32)
+    tenants[:total] = s.request_tenants(total)
+    row: dict[str, Any] = {
+        "arrived": sends,
+        "arr_cycle": arr,
+        "budgets": budgets,
+        "tenant": tenants,
+        "n_requests": np.int32(total),
+        "block": np.int32(s.decode_block),
+        "min_shards": np.int32(s.min_shards),
+        "max_shards": np.int32(s.max_active),
+        "initial_shards": np.int32(s.initial_shards),
+        "control_every": np.int32(s.control_every),
+        "cycle_dt": np.float64(s.cycle_dt),
+        "slo_s": np.float64(s.ttft_slo_s),
+        "up_q": np.int32(config.up_q),
+        "down_q": np.int32(config.down_q),
+        "up_cd": np.float64(config.up_cd),
+        "down_cd": np.float64(config.down_cd),
+        "policy_kind": np.int32(REACTIVE_KIND),
+        "theta": np.zeros(1, np.float32),
+        "hold": np.int32(hold_depth(config.up_q, config.down_q)),
+        "alpha": np.float32(FEATURE_ALPHA),
+        "window": np.int32(FEATURE_WINDOW),
+        "min_samples": np.int32(2),
+        "poll32": np.float32(s.tick_dt),
+        "sticky": np.bool_(s.tenants > 0 and s.pool_entries > 0),
+        "sticky_threshold": np.int32(s.shard_slots),
+        "use_pool": np.bool_(s.pool_entries > 0),
+    }
+    if config.policy == "learned":
+        from ...learn.checkpoint import checkpoint_history
+
+        _, min_samples = checkpoint_history(config.checkpoint)
+        row["policy_kind"] = np.int32(LEARNED_KIND)
+        row["theta"] = np.asarray(config.checkpoint.theta, np.float32)
+        row["min_samples"] = np.int32(max(2, min_samples))
+    return row
+
+
+def _twin_episode(
+    p: dict[str, Any],
+    *,
+    cycles: int,
+    shards: int,
+    shard_slots: int,
+    r_max: int,
+    t_max: int,
+    entries: int,
+    capacity: int,
+    hidden: int,
+    trajectory: bool,
+):
+    """One serving episode as a single scan over engine cycles."""
+    slots = shards * shard_slots
+    shard_of = jnp.arange(slots, dtype=jnp.int32) // shard_slots
+    s_idx = jnp.arange(shards, dtype=jnp.int32)
+    cap_idx = jnp.arange(capacity)
+    learned = hidden > 0
+
+    def cycle_fn(carry, xs):
+        c, arrived = xs
+        (
+            queue, d, busy, dev_rem, fly, prod, budget_row,
+            state, last_up, last_down, h_t, h_d, h_n, home,
+            pool_key, pool_stamp, pool_ctr,
+            tokens, over_slo, ttft_sum, changes, shard_s,
+            completions, max_q, hits, misses,
+        ) = carry
+
+        # -- arrivals land before everything else this cycle
+        queue = queue + arrived
+
+        # -- autoscaler tick (every control_every cycles) ---------------
+        is_tick = (c % p["control_every"]) == 0
+        t = c.astype(jnp.float64) * p["cycle_dt"]
+        serving_mask = state == SHARD_SERVING
+        serving_before = jnp.sum(serving_mask).astype(jnp.int32)
+        observed = queue
+
+        decision = observed
+        snap_t, snap_d, n = h_t, h_d, h_n
+        if learned:
+            # history snapshot including this tick's observation —
+            # DepthHistory.with_sample's exact semantics, shared
+            # verbatim with the fluid twin's scan
+            obs_f = observed.astype(jnp.float64)
+            full = h_n >= capacity
+            snap_t = jnp.where(
+                full,
+                jnp.roll(h_t, -1).at[-1].set(t),
+                jnp.where(cap_idx < h_n, h_t, t),
+            )
+            snap_d = jnp.where(
+                full,
+                jnp.roll(h_d, -1).at[-1].set(obs_f),
+                jnp.where(cap_idx < h_n, h_d, obs_f),
+            )
+            n = jnp.minimum(h_n + 1, capacity)
+            times32 = (snap_t - snap_t[-1]).astype(jnp.float32)
+            depths32 = snap_d.astype(jnp.float32)
+            rem_up = (last_up + p["up_cd"]) - t
+            rem_down = (last_down + p["down_cd"]) - t
+            frac_up32 = jnp.where(
+                (p["up_cd"] > 0) & (rem_up > 0),
+                rem_up / jnp.where(p["up_cd"] > 0, p["up_cd"], 1.0),
+                0.0,
+            ).astype(jnp.float32)
+            frac_down32 = jnp.where(
+                (p["down_cd"] > 0) & (rem_down > 0),
+                rem_down / jnp.where(p["down_cd"] > 0, p["down_cd"], 1.0),
+                0.0,
+            ).astype(jnp.float32)
+            learned_dec = learned_decision(
+                p["theta"], times32, depths32, n, observed,
+                serving_before, frac_up32, frac_down32,
+                p["up_q"], p["down_q"], p["hold"], p["min_samples"],
+                p["max_shards"], p["poll32"], p["alpha"], p["window"],
+                hidden=hidden,
+            )
+            decision = jnp.where(
+                p["policy_kind"] == LEARNED_KIND, learned_dec, decision
+            )
+
+        # -- the reference gates (inclusive thresholds, strictly-After
+        # cooldowns, up-cooling skips the down gate, FIRE refreshes the
+        # stamp even on a clamped boundary no-op)
+        up_code = gate_code(
+            decision >= p["up_q"], t, last_up, p["up_cd"]
+        )
+        up_fire = is_tick & (up_code == GATE_FIRE)
+        down_code = jnp.where(
+            up_code == GATE_COOLING,
+            GATE_SKIPPED,
+            gate_code(decision <= p["down_q"], t, last_down, p["down_cd"]),
+        )
+        down_fire = is_tick & (down_code == GATE_FIRE)
+
+        # scale-up: resurrect the newest draining shard, else activate
+        # the lowest inactive one (ShardedWorkerPool.scale_up's order)
+        can_up = up_fire & (serving_before < p["max_shards"])
+        drain_mask = state == SHARD_DRAINING
+        has_drain = jnp.any(drain_mask)
+        pick_drain = jnp.argmax(jnp.where(drain_mask, s_idx + 1, 0))
+        pick_inact = jnp.argmax(
+            jnp.where(state == SHARD_INACTIVE, shards - s_idx, 0)
+        )
+        pick_up = jnp.where(has_drain, pick_drain, pick_inact)
+        state = jnp.where(
+            can_up & (s_idx == pick_up), SHARD_SERVING, state
+        )
+        last_up = jnp.where(up_fire, t, last_up)
+
+        # scale-down: drain the newest serving shard
+        serving_mid = jnp.sum(state == SHARD_SERVING).astype(jnp.int32)
+        can_down = down_fire & (serving_mid > p["min_shards"])
+        pick_down = jnp.argmax(
+            jnp.where(state == SHARD_SERVING, s_idx + 1, 0)
+        )
+        state = jnp.where(
+            can_down & (s_idx == pick_down), SHARD_DRAINING, state
+        )
+        last_down = jnp.where(down_fire, t, last_down)
+
+        serving_after = jnp.sum(state == SHARD_SERVING).astype(jnp.int32)
+        changes = changes + (
+            is_tick & (serving_after != serving_before)
+        ).astype(jnp.int32)
+        if learned:
+            h_t = jnp.where(is_tick, snap_t, h_t)
+            h_d = jnp.where(is_tick, snap_d, h_d)
+            h_n = jnp.where(is_tick, n, h_n)
+
+        # -- refill: FIFO over the queue, freest-serving-shard-first ----
+        eligible = (~busy) & (state[shard_of] == SHARD_SERVING)
+        k = jnp.minimum(queue, jnp.sum(eligible).astype(jnp.int32))
+        first_flag = jnp.zeros(slots, jnp.int32)
+
+        def admit(j, st):
+            (eligible, busy, dev_rem, prod, budget_row, first_flag,
+             home, pool_key, pool_stamp, pool_ctr,
+             ttft_sum, over_slo, hits, misses) = st
+            take = j < k
+            req = jnp.minimum(d + j, r_max - 1)
+            avail = jnp.sum(
+                eligible.reshape(shards, shard_slots), axis=1
+            ).astype(jnp.int32)
+            freest = jnp.argmax(avail).astype(jnp.int32)
+            tn = p["tenant"][req]
+            hm = home[jnp.minimum(tn, t_max - 1)]
+            safe_hm = jnp.maximum(hm, 0)
+            stick = (
+                p["sticky"] & (hm >= 0) & (avail[safe_hm] > 0)
+                & ((avail[freest] - avail[safe_hm])
+                   < p["sticky_threshold"])
+            )
+            pick = jnp.where(stick, safe_hm, freest)
+            # first admission under sticky routing sets the home shard
+            set_home = take & p["sticky"] & (hm < 0)
+            home = home.at[jnp.minimum(tn, t_max - 1)].set(
+                jnp.where(set_home, freest, hm)
+            )
+            row = jnp.argmax(eligible & (shard_of == pick))
+            g = p["budgets"][req]
+            busy = busy.at[row].set(jnp.where(take, True, busy[row]))
+            dev_rem = dev_rem.at[row].set(
+                jnp.where(take, g - 1, dev_rem[row])
+            )
+            prod = prod.at[row].set(jnp.where(take, 0, prod[row]))
+            budget_row = budget_row.at[row].set(
+                jnp.where(take, g, budget_row[row])
+            )
+            first_flag = first_flag.at[row].set(
+                jnp.where(take, 1, first_flag[row])
+            )
+            eligible = eligible.at[row].set(eligible[row] & ~take)
+            # prefix-pool acquire: LRU hit touches, miss installs into
+            # the first empty slot else evicts the least recently used
+            pooled = take & p["use_pool"]
+            keys_row = pool_key[pick]
+            is_hit = jnp.any(keys_row == tn)
+            hit_idx = jnp.argmax(keys_row == tn)
+            empty = keys_row < 0
+            install_idx = jnp.where(
+                jnp.any(empty),
+                jnp.argmax(empty),
+                jnp.argmin(
+                    jnp.where(empty, jnp.iinfo(jnp.int32).max,
+                              pool_stamp[pick])
+                ),
+            )
+            idx = jnp.where(is_hit, hit_idx, install_idx)
+            pool_ctr = pool_ctr + pooled.astype(jnp.int32)
+            pool_key = pool_key.at[pick, idx].set(
+                jnp.where(pooled, tn, pool_key[pick, idx])
+            )
+            pool_stamp = pool_stamp.at[pick, idx].set(
+                jnp.where(pooled, pool_ctr, pool_stamp[pick, idx])
+            )
+            hits = hits + (pooled & is_hit).astype(jnp.int32)
+            misses = misses + (pooled & ~is_hit).astype(jnp.int32)
+            # TTFT: first tokens settle at this cycle's combined
+            # transfer, so the wait is admission cycle - arrival cycle
+            wait = (c - p["arr_cycle"][req]).astype(jnp.int32)
+            ttft_sum = ttft_sum + jnp.where(take, wait, 0)
+            over_slo = over_slo + jnp.where(
+                take,
+                jnp.maximum(
+                    0.0,
+                    wait.astype(jnp.float64) * p["cycle_dt"] - p["slo_s"],
+                ),
+                0.0,
+            )
+            return (eligible, busy, dev_rem, prod, budget_row,
+                    first_flag, home, pool_key, pool_stamp, pool_ctr,
+                    ttft_sum, over_slo, hits, misses)
+
+        hits0, misses0, ttft0 = hits, misses, ttft_sum
+        (eligible, busy, dev_rem, prod, budget_row, first_flag, home,
+         pool_key, pool_stamp, pool_ctr, ttft_sum, over_slo, hits,
+         misses) = lax.fori_loop(
+            0, slots, admit,
+            (eligible, busy, dev_rem, prod, budget_row, first_flag,
+             home, pool_key, pool_stamp, pool_ctr, ttft_sum, over_slo,
+             hits, misses),
+        )
+        queue = queue - k
+        d = d + k
+        max_q = jnp.maximum(max_q, queue)
+
+        # -- step: the gang block engine's dispatch-ahead mechanics -----
+        # dispatch block N+1 (spends device budget now), settle the
+        # first tokens admitted this cycle AND block N's tokens (they
+        # ride the one combined transfer), then free completed slots
+        live = busy & (dev_rem > 0)
+        n_disp = jnp.where(live, jnp.minimum(p["block"], dev_rem), 0)
+        dev_rem = dev_rem - n_disp
+        settled = fly
+        fly = n_disp
+        tokens_c = k + jnp.sum(settled).astype(jnp.int32)
+        prod = prod + first_flag + settled
+        done_rows = busy & (prod >= budget_row)
+        busy = busy & ~done_rows
+        completed_c = jnp.sum(done_rows).astype(jnp.int32)
+        tokens = tokens + tokens_c
+        completions = completions + completed_c
+
+        # -- drain-retire: an emptied draining shard goes inactive
+        shard_busy = jnp.sum(
+            busy.reshape(shards, shard_slots), axis=1
+        )
+        state = jnp.where(
+            (state == SHARD_DRAINING) & (shard_busy == 0),
+            SHARD_INACTIVE, state,
+        )
+        serving_end = jnp.sum(state == SHARD_SERVING).astype(jnp.int32)
+        # integer serving-cycles; seconds = count * dt once at the end,
+        # so the accumulator is exact (the host scorer's sum * dt form)
+        shard_s = shard_s + serving_end
+
+        out = (
+            (
+                k, completed_c, tokens_c, ttft_sum - ttft0, queue,
+                serving_end, hits - hits0, misses - misses0,
+            )
+            if trajectory
+            else ()
+        )
+        carry = (
+            queue, d, busy, dev_rem, fly, prod, budget_row,
+            state, last_up, last_down, h_t, h_d, h_n, home,
+            pool_key, pool_stamp, pool_ctr,
+            tokens, over_slo, ttft_sum, changes, shard_s,
+            completions, max_q, hits, misses,
+        )
+        return carry, out
+
+    init = (
+        jnp.asarray(0, jnp.int32),  # queue
+        jnp.asarray(0, jnp.int32),  # admitted (FIFO cursor)
+        jnp.zeros(slots, bool),  # busy
+        jnp.zeros(slots, jnp.int32),  # device remaining
+        jnp.zeros(slots, jnp.int32),  # in-flight block tokens
+        jnp.zeros(slots, jnp.int32),  # produced
+        jnp.ones(slots, jnp.int32),  # budget
+        jnp.where(  # shard states: initial prefix serving
+            jnp.arange(shards) < p["initial_shards"],
+            SHARD_SERVING, SHARD_INACTIVE,
+        ).astype(jnp.int32),
+        jnp.asarray(0.0, jnp.float64),  # last_up (startup grace at t=0)
+        jnp.asarray(0.0, jnp.float64),  # last_down
+        jnp.zeros(capacity, jnp.float64),
+        jnp.zeros(capacity, jnp.float64),
+        jnp.asarray(0, jnp.int32),
+        jnp.full(t_max, -1, jnp.int32),  # tenant home shards
+        jnp.full((shards, entries), -1, jnp.int32),  # pool keys
+        jnp.zeros((shards, entries), jnp.int32),  # pool LRU stamps
+        jnp.asarray(0, jnp.int32),  # pool recency counter
+        jnp.asarray(0, jnp.int32),  # tokens
+        jnp.asarray(0.0, jnp.float64),  # time over TTFT SLO
+        jnp.asarray(0, jnp.int32),  # ttft cycle sum
+        jnp.asarray(0, jnp.int32),  # shard-count changes
+        jnp.asarray(0, jnp.int32),  # serving shard-cycles
+        jnp.asarray(0, jnp.int32),  # completions
+        jnp.asarray(0, jnp.int32),  # max queue
+        jnp.asarray(0, jnp.int32),  # pool hits
+        jnp.asarray(0, jnp.int32),  # pool misses
+    )
+    xs = (jnp.arange(cycles, dtype=jnp.int32), p["arrived"])
+    carry, outs = lax.scan(cycle_fn, init, xs, length=cycles)
+    d_final = carry[1]
+    # requests still queued at episode end: their TTFT is already at
+    # least (cycles - arrival), so the SLO debt below is a LOWER bound —
+    # a policy cannot improve its score by refusing admission
+    req_idx = jnp.arange(r_max, dtype=jnp.int32)
+    unserved = (req_idx >= d_final) & (req_idx < p["n_requests"])
+    pending_wait = (
+        (cycles - p["arr_cycle"]).astype(jnp.float64) * p["cycle_dt"]
+        - p["slo_s"]
+    )
+    over_slo = carry[18] + jnp.sum(
+        jnp.where(unserved, jnp.maximum(0.0, pending_wait), 0.0)
+    )
+    summary = {
+        "tokens": carry[17],
+        "time_over_slo_s": over_slo,
+        "shard_changes": carry[20],
+        "shard_seconds": carry[21].astype(jnp.float64) * p["cycle_dt"],
+        "completions": carry[22],
+        "admitted": d_final,
+        "final_queue": carry[0],
+        "max_queue": carry[23],
+        "ttft_cycles_sum": carry[19],
+        "pool_hits": carry[24],
+        "pool_misses": carry[25],
+    }
+    if not trajectory:
+        return summary
+    names = TRAJECTORY_KEYS
+    return {**summary, "trajectory": dict(zip(names, outs))}
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cycles", "shards", "shard_slots", "r_max", "t_max", "entries",
+        "capacity", "hidden", "trajectory",
+    ),
+)
+def _run_twin_batch(
+    params, cycles, shards, shard_slots, r_max, t_max, entries,
+    capacity, hidden, trajectory=True,
+):
+    return jax.vmap(
+        lambda row: _twin_episode(
+            row, cycles=cycles, shards=shards, shard_slots=shard_slots,
+            r_max=r_max, t_max=t_max, entries=entries, capacity=capacity,
+            hidden=hidden, trajectory=trajectory,
+        )
+    )(params)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cycles", "shards", "shard_slots", "r_max", "t_max", "entries",
+        "capacity", "hidden",
+    ),
+)
+def _run_twin_population(
+    params, thetas, cycles, shards, shard_slots, r_max, t_max, entries,
+    capacity, hidden,
+):
+    """``[P, D]`` thetas × ``[E, …]`` scenario rows → ``[P, E]``
+    serving summaries (trajectory off: a training generation transfers
+    :data:`SERVING_SUMMARY_KEYS` scalars per episode, nothing else)."""
+
+    def one(theta, row):
+        return _twin_episode(
+            dict(row, theta=theta), cycles=cycles, shards=shards,
+            shard_slots=shard_slots, r_max=r_max, t_max=t_max,
+            entries=entries, capacity=capacity, hidden=hidden,
+            trajectory=False,
+        )
+
+    return jax.vmap(
+        lambda theta: jax.vmap(lambda row: one(theta, row))(params)
+    )(thetas)
+
+
+@dataclass
+class TwinEpisode:
+    """One compiled serving episode: summary + per-cycle trail."""
+
+    config: TwinConfig
+    summary: dict[str, Any]
+    trajectory: dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def tokens_per_second(self) -> float:
+        return float(self.summary["tokens"]) / self.config.scenario.duration_s
+
+
+def _group_key(config: TwinConfig) -> tuple:
+    s = config.scenario
+    hidden = (
+        int(config.checkpoint.hidden) if config.policy == "learned" else 0
+    )
+    capacity = 2
+    if config.policy == "learned":
+        from ...learn.checkpoint import checkpoint_history
+
+        capacity, _ = checkpoint_history(config.checkpoint)
+    return (s.cycles, s.shards, s.shard_slots, capacity, hidden)
+
+
+def run_twin_episodes(
+    configs: Sequence[TwinConfig], trajectory: bool = True
+) -> list[TwinEpisode]:
+    """One device call for a batch of configs sharing compiled shapes
+    (cycles, plane geometry, history capacity, hidden width).  Request
+    counts, tenant populations, and pool sizes pad to the batch max."""
+    configs = list(configs)
+    if not configs:
+        return []
+    keys = {_group_key(c) for c in configs}
+    if len(keys) > 1:
+        raise ValueError(
+            f"one twin batch must share (cycles, shards, shard_slots,"
+            f" history, hidden); got {sorted(keys)} — use"
+            f" run_twin_grouped"
+        )
+    cycles, shards, shard_slots, capacity, hidden = keys.pop()
+    r_max = max(1, max(c.scenario.total_requests() for c in configs))
+    t_max = max(1, max(c.scenario.tenants for c in configs))
+    entries = max(1, max(c.scenario.pool_entries for c in configs))
+    rows = [encode_twin_config(c, r_max, t_max) for c in configs]
+    theta_len = max(row["theta"].shape[0] for row in rows)
+    for row in rows:
+        if row["theta"].shape[0] < theta_len:
+            row["theta"] = np.zeros(theta_len, np.float32)
+    batch = {key: np.stack([row[key] for row in rows]) for key in rows[0]}
+    with enable_x64():
+        out = _run_twin_batch(
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            cycles=cycles, shards=shards, shard_slots=shard_slots,
+            r_max=r_max, t_max=t_max, entries=entries, capacity=capacity,
+            hidden=hidden, trajectory=trajectory,
+        )
+        out = jax.tree_util.tree_map(np.asarray, out)
+    episodes = []
+    for i, config in enumerate(configs):
+        summary = {
+            key: out[key][i].item() for key in SERVING_SUMMARY_KEYS
+        }
+        traj = (
+            {
+                key: np.asarray(out["trajectory"][key][i])
+                for key in TRAJECTORY_KEYS
+            }
+            if trajectory
+            else {}
+        )
+        episodes.append(
+            TwinEpisode(config=config, summary=summary, trajectory=traj)
+        )
+    return episodes
+
+
+def run_twin_grouped(
+    configs: Sequence[TwinConfig], trajectory: bool = True
+) -> list[TwinEpisode]:
+    """:func:`run_twin_episodes` over mixed compiled shapes — groups,
+    runs one batch per group, scatters back into input order."""
+    configs = list(configs)
+    groups: dict[tuple, list[int]] = {}
+    for index, config in enumerate(configs):
+        groups.setdefault(_group_key(config), []).append(index)
+    episodes: list[TwinEpisode | None] = [None] * len(configs)
+    for indices in groups.values():
+        for index, episode in zip(
+            indices,
+            run_twin_episodes([configs[i] for i in indices], trajectory),
+        ):
+            episodes[index] = episode
+    return episodes  # type: ignore[return-value]
+
+
+def score_twin_summary(
+    summary: dict[str, Any], scenario: ServingScenario
+) -> dict:
+    """A twin summary as a battery-style scorecard row in SERVING
+    units — the lexicographic axes the twin bench gates on (tokens/s,
+    then time-over-TTFT-SLO, then shard churn), plus the context a
+    reviewer needs to read the row."""
+    duration = scenario.duration_s
+    return {
+        "tokens_per_second": round(float(summary["tokens"]) / duration, 1),
+        "time_over_slo_s": round(float(summary["time_over_slo_s"]), 3),
+        "shard_changes": int(summary["shard_changes"]),
+        "shard_seconds": round(float(summary["shard_seconds"]), 2),
+        "completions": int(summary["completions"]),
+        "admitted": int(summary["admitted"]),
+        "final_queue": int(summary["final_queue"]),
+        "max_queue": int(summary["max_queue"]),
+        "pool_hits": int(summary["pool_hits"]),
+        "pool_misses": int(summary["pool_misses"]),
+        "cycles": scenario.cycles,
+    }
+
+
+def serving_lex_key(rows: Sequence[dict]) -> tuple:
+    """Aggregate lexicographic ordering over serving score rows:
+    MORE tokens/s first (negated), then LESS time-over-SLO, then LESS
+    churn — smaller tuple wins, like the fluid ``_lex_score``."""
+    return (
+        -round(sum(r["tokens_per_second"] for r in rows), 1),
+        round(sum(r["time_over_slo_s"] for r in rows), 3),
+        sum(r["shard_changes"] for r in rows),
+    )
+
+
+def twin_config_for_point(point, scenario: ServingScenario) -> TwinConfig:
+    """A sweep point's gate knobs applied to one serving scenario —
+    how tuned-threshold reactive baselines re-run on serving worlds
+    (:func:`~..sweep.run_sweep` routes ServingScenario jobs here).
+    Forecaster points have no serving-twin analogue; callers filter to
+    reactive points."""
+    if point.policy != "reactive":
+        raise ValueError(
+            f"the serving twin sweeps reactive gate points only, got"
+            f" policy={point.policy!r}"
+        )
+    return TwinConfig(
+        scenario=scenario,
+        scale_up_queue=point.scale_up_messages,
+        scale_down_queue=point.scale_down_messages,
+        up_cooldown_s=point.scale_up_cooldown,
+        down_cooldown_s=point.scale_down_cooldown,
+    )
+
+
+__all__ = [
+    "LEARNED_KIND",
+    "REACTIVE_KIND",
+    "SERVING_SUMMARY_KEYS",
+    "TRAJECTORY_KEYS",
+    "TwinConfig",
+    "TwinEpisode",
+    "encode_twin_config",
+    "run_twin_episodes",
+    "run_twin_grouped",
+    "score_twin_summary",
+    "serving_lex_key",
+    "twin_config_for_point",
+]

@@ -1,0 +1,114 @@
+"""The serving twin's mechanical fidelity gate.
+
+Same contract as the fluid twin's :func:`~..compiled.verify_fidelity`:
+run identical scripted worlds through the compiled scan AND the real
+plane (:mod:`.host`), compare cycle-for-cycle, and report every
+mismatch through the flight recorder's :class:`~..replay.Divergence`
+machinery.  ``bench.py --suite twin`` exits 2 on any divergence before
+trusting a single training or comparison number.
+
+Compared per cycle: admitted count, completions, tokens emitted, TTFT
+cycle sums, queue depth, serving shard count, prefix-pool hits and
+misses.  Compared per episode: every serving summary accumulator
+(time-over-SLO to float64 noise, everything else exactly).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+from ..replay import Divergence
+from .compiled import (
+    SERVING_SUMMARY_KEYS,
+    TRAJECTORY_KEYS,
+    TwinConfig,
+    run_twin_grouped,
+)
+from .host import run_host_episode
+from .scenario import ServingScenario
+
+
+@dataclass
+class TwinFidelityReport:
+    """Outcome of one serving-twin fidelity pass."""
+
+    episodes: int
+    cycles: int
+    divergences: list[tuple[str, Divergence]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences
+
+    def format_divergences(self, limit: int = 10) -> list[str]:
+        return [
+            f"{label}: cycle {d.tick}: {d.tick_field}"
+            f" recorded={d.recorded!r} replayed={d.replayed!r}"
+            for label, d in self.divergences[:limit]
+        ]
+
+
+def _label(config: TwinConfig) -> str:
+    return f"{config.scenario.name}/{config.policy}"
+
+
+def verify_twin_fidelity(
+    configs: "Sequence[TwinConfig | ServingScenario]",
+) -> TwinFidelityReport:
+    """Compiled-vs-real over every config; 0 divergences or the list.
+
+    Bare :class:`ServingScenario`\\ s run under the reactive policy;
+    pass :class:`TwinConfig` rows to cover learned checkpoints and
+    swept gate knobs (the twin bench covers both).  Compiled episodes
+    batch by shape group in as few device calls as the shapes allow;
+    each real episode runs the actual jitted plane cycle by cycle.
+    """
+    rows = [
+        c if isinstance(c, TwinConfig) else TwinConfig(scenario=c)
+        for c in configs
+    ]
+    compiled = run_twin_grouped(rows, trajectory=True)
+    divergences: list[tuple[str, Divergence]] = []
+    total_cycles = 0
+    for config, twin in zip(rows, compiled):
+        host = run_host_episode(config)
+        label = _label(config)
+        total_cycles += config.scenario.cycles
+        for key in TRAJECTORY_KEYS:
+            a, b = host.trajectory[key], twin.trajectory[key]
+            for cycle in range(config.scenario.cycles):
+                if int(a[cycle]) != int(b[cycle]):
+                    divergences.append(
+                        (
+                            label,
+                            Divergence(
+                                cycle, key, int(a[cycle]), int(b[cycle])
+                            ),
+                        )
+                    )
+                    break  # first mismatch per field tells the story
+        for key in SERVING_SUMMARY_KEYS:
+            recorded, replayed = host.summary[key], twin.summary[key]
+            if key == "time_over_slo_s":
+                same = math.isclose(
+                    recorded, replayed, rel_tol=1e-9, abs_tol=1e-9
+                )
+            else:
+                same = int(recorded) == int(replayed)
+            if not same:
+                divergences.append(
+                    (
+                        label,
+                        Divergence(
+                            config.scenario.cycles,
+                            f"summary.{key}",
+                            recorded,
+                            replayed,
+                        ),
+                    )
+                )
+    return TwinFidelityReport(
+        episodes=len(rows), cycles=total_cycles, divergences=divergences
+    )

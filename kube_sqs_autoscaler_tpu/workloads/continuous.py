@@ -1560,6 +1560,14 @@ class ContinuousBatcher:
             ]
         return [i for i, s in enumerate(self.slots) if not s.busy]
 
+    def _invalidate_admission_cache(self) -> None:
+        """Hook for planes that memoize admission availability (the
+        sharded plane's per-refill ``_admission_rows_by_shard`` cache).
+        Called at every mutation that can change which rows are
+        admission-eligible: slot assignment, slot release, taint
+        changes, shard mask/probe flips.  No-op here — the single-plane
+        ``free_slots`` scan is already O(B) and uncached."""
+
     def _quiesce_rows(self, rows: list[int]) -> None:
         """Freeze the device twins of host-finished rows whose DEVICE
         budget has not run out (the degraded-completion case): mark
@@ -1571,6 +1579,7 @@ class ContinuousBatcher:
         on cycles where a degraded slot actually finished."""
         if not rows:
             return
+        self._invalidate_admission_cache()
         idx = jnp.asarray(rows, jnp.int32)
         self._done = self._done.at[idx].set(True)
         self._remaining = self._remaining.at[idx].set(0)
@@ -1655,6 +1664,7 @@ class ContinuousBatcher:
                 busy=True, budget=self.generate_tokens, payload=payload,
                 submitted_at=now,
             )
+        self._invalidate_admission_cache()
         return rows
 
     @property
@@ -1742,6 +1752,7 @@ class ContinuousBatcher:
                 busy=True, budget=self.generate_tokens, payload=payload,
                 submitted_at=now, tenant=tenant,
             )
+        self._invalidate_admission_cache()
         return rows
 
     def tag_tenant(self, rows: list[int], tenants: list[str]) -> None:
@@ -1834,6 +1845,7 @@ class ContinuousBatcher:
                 produced=list(produced), submitted_at=submitted_at,
                 ttft_done=bool(produced),
             )
+        self._invalidate_admission_cache()
         return rows
 
     def _submit_one(self, row, token_ids, payload, now) -> None:
@@ -1855,6 +1867,7 @@ class ContinuousBatcher:
                 busy=True, budget=self.generate_tokens, payload=payload,
                 submitted_at=now,
             )
+            self._invalidate_admission_cache()
             return
         (self.cache, self.draft_cache, self._current,
          first) = self._insert(
@@ -1868,6 +1881,7 @@ class ContinuousBatcher:
             busy=True, budget=self.generate_tokens, payload=payload,
             submitted_at=now,
         )
+        self._invalidate_admission_cache()
 
     def _emit(self, slot: _Slot, token: int) -> None:
         """Append one kept token to a slot — THE one place the eos check
@@ -1965,6 +1979,8 @@ class ContinuousBatcher:
                     (slot.payload, np.asarray(tokens, np.int32))
                 )
                 self.slots[row] = _Slot()
+        if finished:
+            self._invalidate_admission_cache()
         if quiesce:
             self._quiesce_rows(quiesce)
         return finished
@@ -2069,6 +2085,8 @@ class ContinuousBatcher:
         # settled (there is only ever one in flight), so tainted rows
         # are safe to admit again; rows quiesced by the finish below
         # re-taint for the next cycle
+        if self._tainted:
+            self._invalidate_admission_cache()
         self._tainted.clear()
         return self._finish_ready()
 

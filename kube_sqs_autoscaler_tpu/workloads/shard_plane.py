@@ -61,6 +61,21 @@ import numpy as np
 from .continuous import ContinuousBatcher
 
 
+class _ProbingFlags(list):
+    """``shard_probing`` as the plain mutable list the pool's
+    quarantine state machine writes in place — with each write
+    invalidating the plane's cached admission availability, so the
+    half-open capacity cap is visible to the very next router call."""
+
+    def __init__(self, flags, owner) -> None:
+        super().__init__(flags)
+        self._owner = owner
+
+    def __setitem__(self, index, value) -> None:
+        super().__setitem__(index, value)
+        self._owner._invalidate_admission_cache()
+
+
 class ShardedBatcher(ContinuousBatcher):
     """``shards`` gang-stepped engine shards behind one admission plane.
 
@@ -105,6 +120,9 @@ class ShardedBatcher(ContinuousBatcher):
             )
         self.shards = shards
         self.shard_slots = shard_slots
+        # per-refill admission-availability cache (see
+        # _admission_rows_by_shard); None = recompute on next read
+        self._avail_cache: list[list[int]] | None = None
         super().__init__(
             params, config, batch_size=shards * shard_slots,
             prompt_len=prompt_len, generate_tokens=generate_tokens,
@@ -118,9 +136,10 @@ class ShardedBatcher(ContinuousBatcher):
         self.shard_admitting = [True] * shards
         # half-open probe capacity: a probing shard admits at most ONE
         # request until its health sentinel clears it (the pool's
-        # quarantine state machine flips these, mirroring the PR 4
-        # breaker's half-open state)
-        self.shard_probing = [False] * shards
+        # quarantine state machine flips these in place, mirroring the
+        # PR 4 breaker's half-open state; writes invalidate the
+        # availability cache)
+        self.shard_probing = _ProbingFlags([False] * shards, self)
         # deterministic shard-fault seams (sim.faults.FleetFaultPlan):
         # device [S] masks folded into every gang dispatch + host
         # mirrors for introspection.  All-False = the healthy program.
@@ -249,6 +268,7 @@ class ShardedBatcher(ContinuousBatcher):
         to completion (drain).  Reactivating is the same flip back —
         nothing is spawned, moved, or recompiled."""
         self._check_shard(shard)
+        self._invalidate_admission_cache()
         self.shard_admitting[shard] = bool(active)
         self._shard_active = self._shard_active.at[shard].set(bool(active))
         if active:
@@ -323,6 +343,7 @@ class ShardedBatcher(ContinuousBatcher):
         transfer) so a row admitted this very cycle still carries its
         first token into its next life."""
         self._check_shard(shard)
+        self._invalidate_admission_cache()
         self._settle_pending_firsts()
         from .continuous import _Slot
 
@@ -385,13 +406,29 @@ class ShardedBatcher(ContinuousBatcher):
     # The admission plane: freest-first routing
     # ------------------------------------------------------------------
 
+    def _invalidate_admission_cache(self) -> None:
+        self._avail_cache = None
+
     def _admission_rows_by_shard(self) -> list[list[int]]:
         """Admission-eligible rows per shard — the ONE availability
         computation both routers (freest-first :attr:`free_slots` and
         sticky :meth:`route_prefixed`) consume, so probing caps and
         drain masks can never apply to one router and miss the other.
         A PROBING shard (half-open after quarantine) offers at most ONE
-        slot until its health sentinel clears it."""
+        slot until its health sentinel clears it.
+
+        Memoized per refill: a host cycle reads availability several
+        times (the refill's capacity check, the router's ordering, the
+        overload-pressure probe) and each read used to rescan all
+        ``S x B`` slot records.  Every mutation that can change
+        eligibility — slot assignment/release, taint changes, mask or
+        probe flips — invalidates via
+        :meth:`_invalidate_admission_cache`, so ONE scan serves the
+        whole cycle (pinned by the counting-audit test in
+        tests/test_shard_plane.py).  Callers must treat the returned
+        lists as read-only."""
+        if self._avail_cache is not None:
+            return self._avail_cache
         per_shard = [
             [row for row in self.shard_rows(s)
              if not self.slots[row].busy and row not in self._tainted]
@@ -402,6 +439,7 @@ class ShardedBatcher(ContinuousBatcher):
             if self.shard_probing[s]:
                 cap = max(0, 1 - self.shard_busy(s))
                 per_shard[s] = per_shard[s][:cap]
+        self._avail_cache = per_shard
         return per_shard
 
     @property
@@ -618,6 +656,8 @@ class ShardedBatcher(ContinuousBatcher):
         # every gang block dispatched before the last quiesce has now
         # settled, so tainted rows are admissible again (see the block
         # engine's identical clear)
+        if self._tainted:
+            self._invalidate_admission_cache()
         self._tainted.clear()
         busy_before = [self.shard_busy(s) for s in range(self.shards)]
         finished = self._finish_ready()

@@ -202,6 +202,192 @@ def test_burst_world_grows_during_bursts_and_recovers():
     assert result.final_depth < result.max_depth  # and the pool drains it
 
 
+# --- widened shapes: composed / pulse / regime-switch / heavy tails ---------
+
+
+def _composed(base, pulse_rate, start, width):
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        ComposedArrival,
+        PulseArrival,
+    )
+
+    return ComposedArrival(parts=(
+        ConstantArrival(base),
+        PulseArrival(rate=pulse_rate, start=start, width=width),
+    ))
+
+
+def _regime(low, burst_base, burst_rate, t1, t2, period, burst_len):
+    from kube_sqs_autoscaler_tpu.sim.scenarios import RegimeSwitchArrival
+
+    return RegimeSwitchArrival(regimes=(
+        (0.0, ConstantArrival(low)),
+        (t1, BurstArrival(base=burst_base, burst_rate=burst_rate,
+                          period=period, burst_len=burst_len)),
+        (t2, ConstantArrival(low / 2)),
+    ))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    t0=st.floats(min_value=0.0, max_value=800.0),
+    span=st.floats(min_value=0.5, max_value=600.0),
+    base=st.floats(min_value=0.0, max_value=100.0),
+    surge=st.floats(min_value=1.0, max_value=400.0),
+    start=st.floats(min_value=0.0, max_value=700.0),
+    width=st.floats(min_value=0.1, max_value=300.0),
+)
+def test_composed_and_pulse_integrals_match_trapezoid(
+    t0, span, base, surge, start, width
+):
+    t1 = t0 + span
+    process = _composed(base, surge, start, width)
+    dt = span / 4000
+    # two jump edges from the pulse, each costing up to rate_range * dt
+    tol = 2 * 2 * (base + surge) * dt
+    exact = process.arrivals_between(t0, t1)
+    approx = trapezoid_integral(process, t0, t1)
+    assert exact == pytest.approx(approx, abs=max(tol, 1e-6), rel=1e-6)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    t0=st.floats(min_value=0.0, max_value=500.0),
+    span=st.floats(min_value=0.5, max_value=500.0),
+    low=st.floats(min_value=1.0, max_value=50.0),
+    burst_rate=st.floats(min_value=60.0, max_value=300.0),
+    t1=st.floats(min_value=10.0, max_value=300.0),
+    gap=st.floats(min_value=10.0, max_value=300.0),
+    period=st.floats(min_value=20.0, max_value=200.0),
+    burst_frac=st.floats(min_value=0.05, max_value=1.0),
+)
+def test_regime_switch_integral_matches_trapezoid(
+    t0, span, low, burst_rate, t1, gap, period, burst_frac
+):
+    process = _regime(
+        low, low, burst_rate, t1, t1 + gap, period, period * burst_frac
+    )
+    end = t0 + span
+    dt = span / 4000
+    # edges: 2 regime boundaries + up to 2 burst edges per in-window
+    # period of the middle regime
+    edges = 2 + 2 * (span / period + 2)
+    tol = 2 * edges * (low + burst_rate) * dt
+    exact = process.arrivals_between(t0, end)
+    approx = trapezoid_integral(process, t0, end)
+    assert exact == pytest.approx(approx, abs=max(tol, 1e-6), rel=1e-6)
+
+
+def test_regime_switch_boundaries_are_exact():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import RegimeSwitchArrival
+
+    process = RegimeSwitchArrival(regimes=(
+        (0.0, ConstantArrival(10.0)),
+        (100.0, ConstantArrival(30.0)),
+    ))
+    # split at the boundary == integral across it, exactly (no seam)
+    assert (
+        process.arrivals_between(90.0, 100.0)
+        + process.arrivals_between(100.0, 110.0)
+        == process.arrivals_between(90.0, 110.0)
+    )
+    assert process.arrivals_between(90.0, 110.0) == 10.0 * 10 + 30.0 * 10
+    # the regime runs on its LOCAL clock: a burst regime starting at
+    # t=100 fires its first burst at the switch instant
+    burst = RegimeSwitchArrival(regimes=(
+        (0.0, ConstantArrival(0.0)),
+        (100.0, BurstArrival(base=0.0, burst_rate=50.0, period=60.0,
+                             burst_len=10.0)),
+    ))
+    assert burst.rate_at(99.9) == 0.0
+    assert burst.rate_at(100.0) == 50.0
+    assert burst.arrivals_between(100.0, 110.0) == pytest.approx(500.0)
+    assert burst.arrivals_between(0.0, 100.0) == 0.0
+
+
+def test_regime_switch_validation():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import RegimeSwitchArrival
+
+    with pytest.raises(ValueError, match="t=0"):
+        RegimeSwitchArrival(regimes=((5.0, ConstantArrival(1.0)),))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        RegimeSwitchArrival(regimes=(
+            (0.0, ConstantArrival(1.0)), (10.0, ConstantArrival(2.0)),
+            (10.0, ConstantArrival(3.0)),
+        ))
+
+
+def test_pulse_validation_and_edges():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import PulseArrival
+
+    with pytest.raises(ValueError):
+        PulseArrival(rate=1.0, start=0.0, width=0.0)
+    pulse = PulseArrival(rate=8.0, start=10.0, width=5.0)
+    assert pulse.arrivals_between(0.0, 10.0) == 0.0
+    assert pulse.arrivals_between(10.0, 15.0) == 40.0
+    assert pulse.arrivals_between(15.0, 99.0) == 0.0
+
+
+@settings(max_examples=25, deadline=None)
+@given(seed=st.integers(0, 10_000), n=st.integers(1, 200),
+       lo=st.integers(1, 8), extra=st.integers(0, 56),
+       alpha=st.floats(0.3, 3.0))
+def test_heavy_tail_lengths_seeded_and_bounded(seed, n, lo, extra, alpha):
+    from kube_sqs_autoscaler_tpu.sim.scenarios import heavy_tail_lengths
+
+    hi = lo + extra
+    tag = f"seed{seed}"
+    draws = heavy_tail_lengths(tag, n, lo, hi, alpha)
+    assert draws == heavy_tail_lengths(tag, n, lo, hi, alpha)
+    assert len(draws) == n
+    assert all(lo <= d <= hi for d in draws)
+
+
+def test_heavy_tail_lengths_are_heavy_tailed():
+    from kube_sqs_autoscaler_tpu.sim.scenarios import heavy_tail_lengths
+
+    draws = heavy_tail_lengths("tail-shape", 4000, 1, 64, 1.1)
+    import statistics
+
+    # bounded-Pareto signature: mass concentrates at the floor (median
+    # near lo) while rare long draws pull the mean well above it
+    assert statistics.median(draws) <= 4
+    assert statistics.mean(draws) > 1.5 * statistics.median(draws)
+    assert max(draws) > 16
+
+
+def test_variants_cover_composite_shapes():
+    import dataclasses as dc
+
+    from kube_sqs_autoscaler_tpu.sim.scenarios import (
+        arrival_variant,
+        variant_bounds,
+    )
+
+    composed = _composed(10.0, 50.0, 60.0, 20.0)
+    bounds = variant_bounds(composed)
+    assert "part0.rate" in bounds and "part1.start" in bounds
+    v1 = arrival_variant(composed, 3, "flash", 0)
+    v2 = arrival_variant(composed, 3, "flash", 0)
+    v3 = arrival_variant(composed, 4, "flash", 0)
+    assert v1 == v2 and v1 != v3
+    assert type(v1) is type(composed)
+    # parts jitter independently within their declared bounds
+    lo, hi = bounds["part1.rate"]
+    assert lo - 1e-9 <= v1.parts[1].rate <= hi + 1e-9
+
+    regime = _regime(10.0, 10.0, 80.0, 100.0, 240.0, 60.0, 15.0)
+    rv = arrival_variant(regime, 7, "regime", 1)
+    assert rv == arrival_variant(regime, 7, "regime", 1)
+    starts = [s for s, _ in rv.regimes]
+    assert starts[0] == 0.0
+    assert all(b > a for a, b in zip(starts, starts[1:]))
+    # variant integrals stay exact (same analytic classes recursively)
+    exact = rv.arrivals_between(37.0, 333.0)
+    approx = trapezoid_integral(rv, 37.0, 333.0, steps=40000)
+    assert exact == pytest.approx(approx, rel=2e-3, abs=0.6)
+
+
 # --- seeded scenario variants (learn/ train-vs-held-out splits) -------------
 
 

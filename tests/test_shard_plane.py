@@ -496,3 +496,81 @@ def test_sharded_pool_drain_finishes_inflight_and_redelivery_dedups(tiny):
     assert set(replies) == set(sent)
     assert duplicates == 0
     assert pool.worker.batcher.shard_busy(1) == 0
+
+# ---------------------------------------------------------------------------
+# The per-refill admission-availability cache (hot-path audit)
+# ---------------------------------------------------------------------------
+
+
+def _counting_plane(tiny, **kwargs):
+    """A plane whose availability COMPUTES (cache misses) are counted,
+    while reads stay unlimited — the counting-audit pattern of
+    test_pool_cycle_cost_flat_under_retired_history."""
+    params, config = tiny
+
+    class CountingPlane(ShardedBatcher):
+        computes = 0
+
+        def _admission_rows_by_shard(self):
+            if self._avail_cache is None:
+                CountingPlane.computes += 1
+            return super()._admission_rows_by_shard()
+
+    plane = CountingPlane(
+        params, config, shards=2, shard_slots=2, prompt_len=8,
+        generate_tokens=4, decode_block=2, **kwargs,
+    )
+    return plane, CountingPlane
+
+
+def test_admission_availability_scanned_once_per_cycle(tiny):
+    plane, cls = _counting_plane(tiny)
+    prompts = prompts_for(16)
+    sent = iter(range(1000))
+    cycles = 12
+    reads_per_cycle = 3
+    for _ in range(cycles):
+        # a worker cycle reads availability several times: the refill's
+        # capacity probe, the router, and a pressure probe
+        free = plane.free_slots
+        plane._free_slot_count()
+        len(plane.free_slots)
+        k = min(2, len(free))
+        if k:
+            plane.submit_many(
+                [(prompts[next(sent) % 16], f"r{next(sent)}")
+                 for _ in range(k)]
+            )
+        plane.step()
+    drain(plane)
+    # one scan per refill, plus at most one after each step's
+    # slot-freeing settle — NOT reads x cycles
+    assert cls.computes <= 2 * cycles + 2, cls.computes
+    assert cls.computes < reads_per_cycle * cycles
+
+
+def test_admission_cache_invalidates_on_every_eligibility_change(tiny):
+    plane, _ = _counting_plane(tiny)
+    assert len(plane.free_slots) == 4
+    # mask flip: a drained shard's slots vanish from the SAME cycle's
+    # next read
+    plane.set_shard_active(1, False)
+    assert len(plane.free_slots) == 2
+    plane.set_shard_active(1, True)
+    assert len(plane.free_slots) == 4
+    # probing cap: the in-place list write the pool performs must be
+    # visible immediately (the _ProbingFlags invalidation hook)
+    plane.shard_probing[1] = True
+    assert len(plane.free_slots) == 3
+    plane.shard_probing[1] = False
+    assert len(plane.free_slots) == 4
+    # admission consumes rows; settle/finish returns them
+    rows = plane.submit_many([(prompts_for(1)[0], "a")])
+    assert len(plane.free_slots) == 3
+    drain(plane)
+    assert len(plane.free_slots) == 4
+    # evacuation frees rows too
+    plane.submit_many([(prompts_for(1)[0], "b")])
+    taken = plane.take_shard_inflight(rows[0] // plane.shard_slots)
+    assert len(plane.free_slots) == 4
+    assert len(taken) <= 1

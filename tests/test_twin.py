@@ -1,0 +1,590 @@
+"""Token-level serving twin: cycle-exact fidelity vs the real sharded
+plane, serving-unit training/scoring plumbing, checkpoint twin-kind
+deployment seams, and the serving sweep path.
+
+Tier-1 (CPU JAX, tiny model, short episodes).  The full battery at the
+committed BENCH_r17 configuration runs in the slow tier.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kube_sqs_autoscaler_tpu.learn.checkpoint import (  # noqa: E402
+    CheckpointError,
+    PolicyCheckpoint,
+    checkpoint_twin,
+    load_checkpoint,
+    save_checkpoint,
+)
+from kube_sqs_autoscaler_tpu.learn.network import param_count  # noqa: E402
+from kube_sqs_autoscaler_tpu.learn.serving import (  # noqa: E402
+    ServingESConfig,
+    serving_reference_scales,
+    serving_reward_vector,
+    train_serving,
+)
+from kube_sqs_autoscaler_tpu.sim.scenarios import (  # noqa: E402
+    ConstantArrival,
+    RampArrival,
+)
+from kube_sqs_autoscaler_tpu.sim.twin import (  # noqa: E402
+    ServingScenario,
+    twin_variants,
+    verify_twin_fidelity,
+)
+from kube_sqs_autoscaler_tpu.sim.twin.compiled import (  # noqa: E402
+    SERVING_SUMMARY_KEYS,
+    TwinConfig,
+    run_twin_episodes,
+    run_twin_grouped,
+    serving_lex_key,
+    twin_config_for_point,
+)
+from kube_sqs_autoscaler_tpu.sim.twin.host import run_host_episode  # noqa: E402
+
+
+def small_scenario(**overrides):
+    defaults = dict(
+        name="t-small",
+        arrival=ConstantArrival(rate=24.0),
+        cycles=48,
+        shards=3,
+        shard_slots=2,
+        decode_block=2,
+        generate_tokens=5,
+    )
+    defaults.update(overrides)
+    return ServingScenario(**defaults)
+
+
+@pytest.fixture(scope="module")
+def tiny_serving_checkpoint():
+    scenarios = [
+        small_scenario(),
+        small_scenario(
+            name="t-ramp",
+            arrival=RampArrival(
+                start_rate=6.0, end_rate=40.0, t_start=0.2, t_end=1.6
+            ),
+        ),
+    ]
+    return train_serving(
+        scenarios, ServingESConfig(population=4, generations=2)
+    ).checkpoint
+
+
+# ---------------------------------------------------------------------------
+# Scenario script derivation
+# ---------------------------------------------------------------------------
+
+
+def test_sends_are_exact_integral_floor_differences():
+    s = small_scenario(arrival=ConstantArrival(rate=30.0), cycles=40)
+    sends = s.sends()
+    assert sends.sum() == int(30.0 * 40 * s.cycle_dt)
+    # cumulative floors, so no cycle can over- or under-count
+    cum = np.cumsum(sends)
+    for c in range(40):
+        assert cum[c] == int(30.0 * (c + 1) * s.cycle_dt)
+    assert len(s.arrival_cycles()) == s.total_requests()
+
+
+def test_heavy_tail_budgets_are_seeded_and_bounded():
+    s = small_scenario(heavy_tail=(1, 5, 1.2), generate_tokens=5)
+    a, b = s.request_budgets(), s.request_budgets()
+    assert np.array_equal(a, b)
+    assert a.min() >= 1 and a.max() <= 5
+    reseeded = dataclasses.replace(s, budget_seed=7).request_budgets()
+    assert not np.array_equal(a, reseeded)
+
+
+def test_twin_variants_are_deterministic_and_keep_geometry():
+    base = [small_scenario()]
+    a = twin_variants(base, 2, seed=9)
+    b = twin_variants(base, 2, seed=9)
+    c = twin_variants(base, 2, seed=10)
+    assert [v.arrival for v in a] == [v.arrival for v in b]
+    assert all(x.arrival != y.arrival for x, y in zip(a, c))
+    for v in a:
+        assert v.shards == base[0].shards
+        assert v.cycles == base[0].cycles
+        assert v.name.startswith("t-small~v")
+
+
+def test_scenario_validation():
+    with pytest.raises(ValueError):
+        small_scenario(initial_shards=9)
+    with pytest.raises(ValueError):
+        small_scenario(heavy_tail=(1, 99, 1.0))
+    with pytest.raises(ValueError):
+        small_scenario(pool_entries=2)  # pool needs tenants
+    with pytest.raises(ValueError):
+        small_scenario(tenants=2, pool_entries=1)  # < shard_slots
+    with pytest.raises(ValueError, match="pooled insert"):
+        # the real plane's pooled admission has no per-request budgets
+        small_scenario(
+            tenants=2, pool_entries=2, heavy_tail=(1, 5, 1.1)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fidelity: the compiled scan vs the REAL ShardedBatcher, cycle for cycle
+# ---------------------------------------------------------------------------
+
+
+def test_fidelity_reactive_scaling_world():
+    report = verify_twin_fidelity([
+        small_scenario(
+            name="t-scale",
+            arrival=RampArrival(
+                start_rate=6.0, end_rate=44.0, t_start=0.2, t_end=1.6
+            ),
+        ),
+    ])
+    assert report.ok, report.format_divergences()
+    assert report.cycles == 48
+
+
+def test_fidelity_heavy_tail_budgets():
+    report = verify_twin_fidelity([
+        small_scenario(name="t-tail", heavy_tail=(1, 5, 1.1)),
+    ])
+    assert report.ok, report.format_divergences()
+
+
+def test_fidelity_prefix_pool_and_sticky_routing():
+    report = verify_twin_fidelity([
+        small_scenario(name="t-prefix", tenants=4, pool_entries=2),
+    ])
+    assert report.ok, report.format_divergences()
+    # and the world genuinely exercised the pool
+    twin = run_twin_episodes(
+        [TwinConfig(scenario=small_scenario(
+            name="t-prefix", tenants=4, pool_entries=2))],
+    )[0]
+    assert twin.summary["pool_misses"] > 0
+    assert twin.summary["pool_hits"] > 0
+
+
+def test_fidelity_learned_policy(tiny_serving_checkpoint):
+    report = verify_twin_fidelity([
+        TwinConfig(
+            scenario=small_scenario(name="t-learned"),
+            policy="learned",
+            checkpoint=tiny_serving_checkpoint,
+        ),
+    ])
+    assert report.ok, report.format_divergences()
+
+
+def test_fidelity_swept_gate_points():
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint
+
+    point = SweepPoint(
+        scale_up_messages=3, scale_down_messages=0,
+        scale_up_cooldown=0.25, scale_down_cooldown=1.0,
+    )
+    report = verify_twin_fidelity([
+        twin_config_for_point(point, small_scenario(name="t-swept")),
+    ])
+    assert report.ok, report.format_divergences()
+
+
+def test_fidelity_report_formats_divergences():
+    from kube_sqs_autoscaler_tpu.sim.replay import Divergence
+    from kube_sqs_autoscaler_tpu.sim.twin.fidelity import TwinFidelityReport
+
+    report = TwinFidelityReport(
+        episodes=1, cycles=8,
+        divergences=[("world/reactive", Divergence(3, "tokens", 5, 4))],
+    )
+    assert not report.ok
+    line = report.format_divergences()[0]
+    assert "world/reactive" in line and "cycle 3" in line
+
+
+# ---------------------------------------------------------------------------
+# Summary accumulators pinned against the host scorer
+# ---------------------------------------------------------------------------
+
+
+def test_in_scan_summary_matches_trajectory_and_host_scorer():
+    scenario = small_scenario(name="t-pin")
+    twin = run_twin_episodes([TwinConfig(scenario=scenario)])[0]
+    # the in-scan accumulators must equal their own trajectory sums...
+    assert twin.summary["tokens"] == int(twin.trajectory["tokens"].sum())
+    assert twin.summary["completions"] == int(
+        twin.trajectory["completed"].sum()
+    )
+    assert twin.summary["admitted"] == int(
+        twin.trajectory["admitted"].sum()
+    )
+    assert twin.summary["ttft_cycles_sum"] == int(
+        twin.trajectory["ttft_cycles"].sum()
+    )
+    assert twin.summary["max_queue"] == int(twin.trajectory["queue"].max())
+    # ...and the independently-computed host scorer's summary exactly
+    host = run_host_episode(TwinConfig(scenario=scenario))
+    for key in SERVING_SUMMARY_KEYS:
+        if key == "time_over_slo_s":
+            assert host.summary[key] == pytest.approx(
+                twin.summary[key], abs=1e-9
+            )
+        else:
+            assert host.summary[key] == twin.summary[key], key
+
+
+def test_unserved_backlog_counts_as_slo_debt():
+    # a plane pinned at 1 shard under heavy load must end with backlog,
+    # and that backlog must surface as time-over-SLO (refusing
+    # admission can never launder SLO debt)
+    scenario = small_scenario(
+        name="t-overload", arrival=ConstantArrival(rate=60.0),
+        max_shards=1, initial_shards=1,
+    )
+    twin = run_twin_episodes(
+        [TwinConfig(scenario=scenario)], trajectory=False
+    )[0]
+    assert twin.summary["final_queue"] > 0
+    assert twin.summary["time_over_slo_s"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# Population rollouts (learn/rollout.py serving accumulators)
+# ---------------------------------------------------------------------------
+
+
+def test_population_rollout_matches_single_episode(tiny_serving_checkpoint):
+    from kube_sqs_autoscaler_tpu.learn.checkpoint import checkpoint_history
+    from kube_sqs_autoscaler_tpu.learn.rollout import (
+        SERVING_TRAIN_KEYS as ROLLOUT_KEYS,
+        evaluate_population_serving,
+    )
+
+    ck = tiny_serving_checkpoint
+    scenarios = [small_scenario(), small_scenario(name="t-b")]
+    history, _ = checkpoint_history(ck)
+    out = evaluate_population_serving(
+        np.stack([ck.theta, ck.theta]), scenarios,
+        hidden=ck.hidden, history=history,
+    )
+    episodes = run_twin_grouped(
+        [TwinConfig(scenario=s, policy="learned", checkpoint=ck)
+         for s in scenarios],
+        trajectory=False,
+    )
+    for key in ROLLOUT_KEYS:
+        assert out[key].shape == (2, 2)
+        for e, episode in enumerate(episodes):
+            for p in range(2):
+                assert out[key][p, e] == pytest.approx(
+                    episode.summary[key], abs=1e-9
+                ), key
+
+
+def test_serving_reward_prefers_more_tokens_less_debt():
+    scenarios = [small_scenario()]
+    scales = serving_reference_scales(scenarios)
+    config = ServingESConfig(population=2, generations=1)
+    good = {
+        "tokens": np.array([[100.0]]), "time_over_slo_s": np.array([[0.0]]),
+        "shard_changes": np.array([[1.0]]),
+        "shard_seconds": np.array([[2.0]]),
+    }
+    bad = {
+        "tokens": np.array([[50.0]]), "time_over_slo_s": np.array([[3.0]]),
+        "shard_changes": np.array([[9.0]]),
+        "shard_seconds": np.array([[2.0]]),
+    }
+    assert serving_reward_vector(good, scales, config) > (
+        serving_reward_vector(bad, scales, config)
+    )
+
+
+def test_train_serving_is_seeded_deterministic():
+    scenarios = [small_scenario()]
+    config = ServingESConfig(population=4, generations=2)
+    a = train_serving(scenarios, config).checkpoint
+    b = train_serving(scenarios, config).checkpoint
+    assert a.hash == b.hash
+    assert a.meta["twin"] == "serving"
+    assert "tokens/s" in a.meta["reward_units"]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint twin-kind deployment seams
+# ---------------------------------------------------------------------------
+
+
+def fluid_checkpoint():
+    return PolicyCheckpoint(
+        theta=np.zeros(param_count(4), np.float32), hidden=4, meta={}
+    )
+
+
+def serving_checkpoint():
+    return PolicyCheckpoint(
+        theta=np.zeros(param_count(4), np.float32), hidden=4,
+        meta={"twin": "serving"},
+    )
+
+
+def test_twin_kind_defaults_to_fluid_for_old_checkpoints():
+    assert checkpoint_twin(fluid_checkpoint()) == "fluid"
+
+
+def test_learned_policy_rejects_serving_checkpoint():
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.learn.policy import LearnedPolicy
+
+    with pytest.raises(CheckpointError, match="serving.*twin"):
+        LearnedPolicy(
+            serving_checkpoint(), policy=PolicyConfig(),
+            poll_interval=5.0, max_pods=5,
+        )
+
+
+def test_fluid_compiled_twin_rejects_serving_checkpoint():
+    from kube_sqs_autoscaler_tpu.sim.compiled import encode_config
+    from kube_sqs_autoscaler_tpu.sim.simulator import SimConfig
+
+    config = SimConfig(
+        arrival_rate=10.0, duration=50.0, policy="learned",
+        learned_checkpoint=serving_checkpoint(),
+    )
+    with pytest.raises(CheckpointError, match="fluid"):
+        encode_config(config)
+
+
+def test_serving_twin_rejects_fluid_checkpoint_by_default():
+    with pytest.raises(ValueError, match="fluid.*twin"):
+        TwinConfig(
+            scenario=small_scenario(), policy="learned",
+            checkpoint=fluid_checkpoint(),
+        )
+    # the bench's explicit baseline escape hatch still works
+    TwinConfig(
+        scenario=small_scenario(), policy="learned",
+        checkpoint=fluid_checkpoint(), allow_twin_mismatch=True,
+    )
+
+
+def test_twin_stamp_survives_save_load_and_changes_hash(tmp_path):
+    serving = serving_checkpoint()
+    path = tmp_path / "serving.json"
+    save_checkpoint(str(path), serving)
+    loaded = load_checkpoint(str(path))
+    assert checkpoint_twin(loaded) == "serving"
+    assert loaded.hash == serving.hash
+    # same weights, different twin kind = a different policy identity;
+    # fluid checkpoints keep their pre-stamp hashes (back-compat)
+    assert serving.hash != fluid_checkpoint().hash
+
+
+def test_invalid_twin_stamp_rejected():
+    with pytest.raises(CheckpointError, match="twin"):
+        PolicyCheckpoint(
+            theta=np.zeros(param_count(4), np.float32), hidden=4,
+            meta={"twin": "quantum"},
+        )
+
+
+def test_cli_rejects_serving_checkpoint_as_usage_error(tmp_path):
+    import contextlib
+    import io
+
+    from kube_sqs_autoscaler_tpu.cli import (
+        build_parser,
+        load_learned_checkpoint,
+    )
+
+    path = tmp_path / "serving.json"
+    save_checkpoint(str(path), serving_checkpoint())
+    parser = build_parser()
+    args = parser.parse_args(
+        ["--policy", "learned", "--policy-checkpoint", str(path)]
+    )
+    stderr = io.StringIO()
+    with pytest.raises(SystemExit) as excinfo:
+        with contextlib.redirect_stderr(stderr):
+            load_learned_checkpoint(parser, args)
+    assert excinfo.value.code == 2
+    assert "serving" in stderr.getvalue()
+
+
+def test_replay_rejects_serving_checkpoint():
+    from kube_sqs_autoscaler_tpu.sim.replay import _depth_policy_from_meta
+
+    meta = {
+        "policy": "learned",
+        "learn": {"checkpoint_hash": serving_checkpoint().hash},
+        "loop": {"poll_interval": 5.0},
+    }
+    with pytest.raises(CheckpointError, match="serving"):
+        _depth_policy_from_meta(meta, serving_checkpoint())
+
+
+# ---------------------------------------------------------------------------
+# The serving sweep path (sim/sweep.py scores twin results)
+# ---------------------------------------------------------------------------
+
+
+def test_run_sweep_on_serving_scenarios_scores_serving_units():
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint, run_sweep
+
+    points = [
+        SweepPoint(scale_up_messages=3, scale_down_messages=0,
+                   scale_up_cooldown=0.25, scale_down_cooldown=1.0),
+        SweepPoint(scale_up_messages=12, scale_down_messages=1,
+                   scale_up_cooldown=1.0, scale_down_cooldown=2.0),
+    ]
+    scenarios = [small_scenario(name="t-sweep")]
+    report = run_sweep(points, scenarios)
+    assert report.points == 2
+    for row in report.rows:
+        assert "tokens_per_second" in row["score"]
+        assert "shard_changes" in row["score"]
+    best = report.best_per_scenario()["t-sweep"]
+    # the eager low-threshold gates must win the serving lex ordering
+    assert best["label"].startswith("up3/")
+    # winners are re-runnable points
+    assert report.best_points_per_scenario()["t-sweep"].scale_up_messages == 3
+
+
+def test_run_sweep_rejects_mixed_and_forecaster_only():
+    from kube_sqs_autoscaler_tpu.sim.evaluate import default_battery
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint, run_sweep
+
+    with pytest.raises(ValueError, match="not a mix"):
+        run_sweep(
+            [SweepPoint()], [small_scenario(), default_battery()[0]]
+        )
+    with pytest.raises(ValueError, match="reactive"):
+        run_sweep(
+            [SweepPoint(policy="holt")], [small_scenario()]
+        )
+
+
+def test_twin_config_for_point_rejects_forecasters():
+    from kube_sqs_autoscaler_tpu.sim.sweep import SweepPoint
+
+    with pytest.raises(ValueError, match="reactive"):
+        twin_config_for_point(
+            SweepPoint(policy="ewma"), small_scenario()
+        )
+
+
+def test_serving_lex_key_orders_tokens_first():
+    more_tokens = [{"tokens_per_second": 10.0, "time_over_slo_s": 9.0,
+                    "shard_changes": 9}]
+    fewer = [{"tokens_per_second": 9.0, "time_over_slo_s": 0.0,
+              "shard_changes": 0}]
+    assert serving_lex_key(more_tokens) < serving_lex_key(fewer)
+
+
+# ---------------------------------------------------------------------------
+# Bench suite smoke (fidelity-gated; the held-out win gate runs slow)
+# ---------------------------------------------------------------------------
+
+
+def test_twin_suite_smoke(tmp_path):
+    from bench import run_twin_suite
+
+    out = tmp_path / "bench_twin.json"
+    ck_out = tmp_path / "serving_policy.json"
+    headline = run_twin_suite(
+        str(out), str(ck_out), cycles=80, population=4, generations=2,
+        train_variants=0, held_variants=1, fidelity_learned_limit=1,
+        require_win=False,
+    )
+    artifact = json.loads(out.read_text())
+    assert artifact["fidelity"]["pre_train"]["divergences"] == 0
+    assert artifact["fidelity"]["post_train"]["divergences"] == 0
+    assert artifact["training"]["twin_kind"] == "serving"
+    assert set(artifact["held_out"]["totals"]) == {
+        "reactive", "tuned_reactive", "fluid_checkpoint",
+        "serving_checkpoint",
+    }
+    assert artifact["held_out"]["gated"] is False
+    # the published artifact is a loadable serving-twin checkpoint
+    loaded = load_checkpoint(str(ck_out))
+    assert checkpoint_twin(loaded) == "serving"
+    assert loaded.hash == artifact["training"]["checkpoint_hash"]
+    assert "fidelity" in headline["unit"]
+
+
+@pytest.mark.slow
+def test_twin_suite_full_gate(tmp_path):
+    # the committed-artifact configuration: full battery, full training,
+    # held-out win gate armed (SystemExit(2) otherwise)
+    from bench import run_twin_suite
+
+    out = tmp_path / "bench_r17.json"
+    run_twin_suite(str(out), str(tmp_path / "serving_policy.json"))
+    artifact = json.loads(out.read_text())
+    assert artifact["held_out"]["gated"] is True
+    assert all(artifact["held_out"]["beats"].values())
+    for phase in artifact["fidelity"].values():
+        assert phase["divergences"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The host driver's scale ordering is the real pool's (pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_host_scale_ordering_matches_sharded_worker_pool():
+    from kube_sqs_autoscaler_tpu.fleet.sharded import (
+        DRAINING as POOL_DRAINING,
+        INACTIVE as POOL_INACTIVE,
+        SERVING as POOL_SERVING,
+        ShardedWorkerPool,
+    )
+    from kube_sqs_autoscaler_tpu.sim.twin.host import _scale_down, _scale_up
+    from kube_sqs_autoscaler_tpu.sim.twin.scenario import (
+        SHARD_DRAINING,
+        SHARD_INACTIVE,
+        SHARD_SERVING,
+    )
+
+    to_pool = {SHARD_INACTIVE: POOL_INACTIVE, SHARD_SERVING: POOL_SERVING,
+               SHARD_DRAINING: POOL_DRAINING}
+    from_pool = {v: k for k, v in to_pool.items()}
+
+    class _StubBatcher:
+        shards = 4
+
+        def set_shard_active(self, shard, active):
+            pass
+
+        def shard_busy(self, shard):
+            return 0
+
+    class _StubWorker:
+        batcher = _StubBatcher()
+
+    pool = ShardedWorkerPool(lambda p: _StubWorker(), min=1, max=4)
+    rng = np.random.default_rng(5)
+    for trial in range(200):
+        states = [int(x) for x in rng.integers(0, 3, size=4)]
+        pool.shard_states = [to_pool[s] for s in states]
+        twin_states = list(states)
+        if rng.integers(0, 2):
+            before = list(pool.shard_states)
+            pool.scale_up()
+            serving = sum(1 for s in twin_states if s == SHARD_SERVING)
+            if serving < 4:
+                twin_states[_scale_up(twin_states)] = SHARD_SERVING
+        else:
+            pool.scale_down()
+            serving = sum(1 for s in twin_states if s == SHARD_SERVING)
+            if serving > 1:
+                twin_states[_scale_down(twin_states)] = SHARD_DRAINING
+        assert twin_states == [
+            from_pool[s] for s in pool.shard_states
+        ], (trial, states)
