@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin replay-demo chaos-demo fleet-demo learn-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -141,6 +141,19 @@ bench-overload:
 bench-twin:
 	JAX_PLATFORMS=cpu python bench.py --suite twin
 
+# Controller crash-restart battery (CPU JAX, ~15 s): durable
+# control-plane snapshots + journal-tail rehydration proven at every
+# named crash point (after-observe / after-decide / after-actuate-
+# before-journal / torn-mid-journal-line / tick-boundary), loop-only AND
+# on the real serving fleet; exits 2 unless zero scale-ups fire inside a
+# cooldown across any restart, every request is answered exactly once
+# across every fleet restart (the cold contrast MUST produce
+# duplicates), the breaker stays open across the gap, warm restart beats
+# cold on post-restart backlog, and the loop is byte-identical with
+# durability off; writes BENCH_r18.json
+bench-restart:
+	JAX_PLATFORMS=cpu python bench.py --suite restart
+
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a
 # deterministic mid-episode replica kill; exits non-zero unless every
@@ -168,6 +181,15 @@ chaos-demo:
 # down — exits 2 on any missing milestone
 fleet-demo:
 	JAX_PLATFORMS=cpu python -m kube_sqs_autoscaler_tpu.fleet
+
+# Deterministic FakeClock kill -> restart -> reconcile walkthrough (no
+# JAX, seconds): the loop snapshots every tick, an after-actuate crash
+# leaves only the write-ahead intent, the warm restart honors the
+# cooldown across the gap and fires earlier than a cold one, an open
+# breaker survives the restart, and corrupt/future-schema snapshots
+# cold-start instead of crash-looping — exits 2 on any missing milestone
+restart-demo:
+	python -m kube_sqs_autoscaler_tpu.core.durable
 
 # Deterministic learned-policy lifecycle (CPU JAX, seconds): tiny-
 # population ES smoke train in the compiled twin, checkpoint

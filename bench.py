@@ -3457,13 +3457,831 @@ def run_twin_suite(
     }
 
 
+class _RecordCollector:
+    """TickObserver collecting record dicts (byte-identity evidence)."""
+
+    def __init__(self) -> None:
+        self.records: list = []
+
+    def on_tick(self, record) -> None:
+        self.records.append(record.to_dict())
+
+
+def _drive_restart_control(build, clock, crash_plan, *, poll,
+                           total_ticks, downtime_s):
+    """Tick-by-tick driver for the loop-only restart episodes (no
+    serving pool — the fleet episodes use FleetDriver's own crash/restart
+    machinery).  ``build(tick_fn)`` returns ``(loop, store)`` for one
+    boot; a ControllerCrash discards the boot, advances ``downtime_s``
+    of virtual time, and rebuilds.  Returns per-episode stats."""
+    from kube_sqs_autoscaler_tpu.core.durable import ControllerCrash
+
+    current = {"tick": -1}
+    loop, store = build(lambda: current["tick"])
+    state = loop.initial_policy_state()
+    reports = [store.last_report if store is not None else None]
+    crashes = restarts = 0
+    for tick in range(total_ticks):
+        clock.advance(poll)
+        current["tick"] = tick
+        boundary = (
+            crash_plan is not None and crash_plan.boundary_crash(tick)
+        )
+        try:
+            state = loop.tick(state)
+        except ControllerCrash:
+            crashes += 1
+        else:
+            if not boundary:
+                continue
+            crashes += 1
+        clock.advance(downtime_s)
+        loop, store = build(lambda: current["tick"])
+        state = loop.initial_policy_state()
+        restarts += 1
+        reports.append(store.last_report if store is not None else None)
+    return {"crashes": crashes, "restarts": restarts, "reports": reports}
+
+
+def _restart_control_episode(point, tmpdir, *, durable=True,
+                             crash_tick=11, downtime_s=7.0,
+                             total_ticks=22, collector=None):
+    """One scripted crash-point episode: constant heavy backlog, the up
+    gate fires every cooldown (t=30, 60, 90, ... — the deterministic
+    grid the gates check), one controller kill at ``crash_tick`` via
+    ``point``, one restart.  Returns (stats, api, stitches)."""
+    import os
+
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.core.durable import DurableStateStore
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.forecast.history import DepthHistory
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeQueueService
+    from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+    from kube_sqs_autoscaler_tpu.scale.actuator import PodAutoScaler
+    from kube_sqs_autoscaler_tpu.scale.fake import (
+        FakeDeploymentAPI,
+        RecordingDeploymentAPI,
+    )
+    from kube_sqs_autoscaler_tpu.sim.faults import (
+        CrashingJournal,
+        CrashingMetricSource,
+        CrashingScaler,
+        CrashPlan,
+    )
+    from kube_sqs_autoscaler_tpu.sim.replay import stitch_restart_episodes
+
+    clock = FakeClock()
+    queue = FakeQueueService.with_depths(5000)  # permanent overload
+    api = RecordingDeploymentAPI(
+        FakeDeploymentAPI.with_deployments("default", 1, "workers"), clock
+    )
+    state_path = os.path.join(tmpdir, "controller.state")
+    journal_path = os.path.join(tmpdir, "journal.jsonl")
+    plan = CrashPlan(crashes=((crash_tick, point),)) if point else None
+    config = LoopConfig(
+        poll_interval=5.0,
+        policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=-1,  # down: never
+            scale_up_cooldown=30.0, scale_down_cooldown=60.0,
+        ),
+    )
+
+    def build(tick_fn):
+        store = None
+        if durable:
+            store = DurableStateStore(
+                state_path, wall_clock=clock.now, journal_path=journal_path
+            )
+        history = DepthHistory(capacity=64)
+        if store is not None:
+            store.register("forecast-history", history, ttl_s=3600.0)
+        scaler = PodAutoScaler(
+            client=api, max=10, min=1, scale_up_pods=1,
+            scale_down_pods=1, deployment="workers", namespace="default",
+        )
+        source = QueueMetricSource(
+            queue, "restart://queue", ("ApproximateNumberOfMessages",)
+        )
+        if plan is not None:
+            scaler = CrashingScaler(scaler, plan, tick_fn)
+            source = CrashingMetricSource(source, plan, tick_fn)
+        loop = ControlLoop(
+            scaler, source, config, clock=clock, durable=store
+        )
+        meta = {"source": "restart-bench", "poll_interval": 5.0}
+        if store is not None:
+            # rehydrates BEFORE the journal reopens + stamps the
+            # restart block — the one correct ordering, pinned by the
+            # store helper
+            meta = store.journal_meta_after_rehydrate(clock.now(), meta)
+        journal = TickJournal(journal_path, meta=meta)
+        journal_obs = (
+            CrashingJournal(journal, plan, tick_fn)
+            if plan is not None else journal
+        )
+        observers = [history]
+        if collector is not None:
+            observers.append(collector)
+        observers.append(journal_obs)  # LAST: a torn-crash stops here
+        loop.observer = MultiObserver(observers)
+        return loop, store
+
+    stats = _drive_restart_control(
+        build, clock, plan, poll=5.0, total_ticks=total_ticks,
+        downtime_s=downtime_s,
+    )
+    stitches = stitch_restart_episodes(journal_path)
+    return stats, api, stitches
+
+
+def _restart_breaker_episode(tmpdir, *, durable=True):
+    """Breaker-across-the-gap: the apiserver is down, the breaker opens,
+    the controller dies at a tick boundary, restarts mid-reset-window.
+    Warm must keep the breaker OPEN (no RPC until the rebased probe at
+    t=95); cold forgets and hammers the dead apiserver at t=85."""
+    import os
+
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.core.durable import DurableStateStore
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.core.resilience import ResilienceConfig
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeQueueService
+    from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+    from kube_sqs_autoscaler_tpu.scale.actuator import PodAutoScaler
+    from kube_sqs_autoscaler_tpu.scale.fake import (
+        FakeDeploymentAPI,
+        RecordingDeploymentAPI,
+    )
+    from kube_sqs_autoscaler_tpu.sim.faults import (
+        CRASH_TICK_BOUNDARY,
+        CrashPlan,
+    )
+
+    clock = FakeClock()
+    queue = FakeQueueService.with_depths(5000)
+    api = RecordingDeploymentAPI(
+        FakeDeploymentAPI.with_deployments("default", 1, "workers"), clock
+    )
+    api.fail = True  # the apiserver is down for the whole episode
+    state_path = os.path.join(tmpdir, "controller.state")
+    plan = CrashPlan(crashes=((8, CRASH_TICK_BOUNDARY),))  # t=45
+    config = LoopConfig(
+        poll_interval=5.0,
+        policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=-1,
+            scale_up_cooldown=30.0, scale_down_cooldown=60.0,
+        ),
+    )
+
+    def build(tick_fn):
+        del tick_fn
+        store = (
+            DurableStateStore(state_path, wall_clock=clock.now)
+            if durable else None
+        )
+        loop = ControlLoop(
+            PodAutoScaler(
+                client=api, max=10, min=1, scale_up_pods=1,
+                scale_down_pods=1, deployment="workers",
+                namespace="default",
+            ),
+            QueueMetricSource(
+                queue, "restart://queue", ("ApproximateNumberOfMessages",)
+            ),
+            config, clock=clock,
+            resilience=ResilienceConfig(
+                breaker_failures=2, breaker_reset=60.0,
+            ),
+            durable=store,
+        )
+        if store is not None:
+            store.register("resilience", loop.resilience, ttl_s=3600.0)
+        return loop, store
+
+    # fires at t=30 (fail 1), 35 (fail 2 -> breaker opens, probe due
+    # t=95); boundary kill after tick t=45; 10s downtime -> restart 55
+    stats = _drive_restart_control(
+        build, clock, plan, poll=5.0, total_ticks=20, downtime_s=10.0,
+    )
+    return stats, api
+
+
+class _RampWorld:
+    """Closed fluid world for the warm-vs-cold forecaster episode: a
+    linear arrival ramp against replica-proportional service, advanced
+    lazily on every observation/actuation (so downtime accumulates
+    backlog exactly like a real queue would).  Doubles as MetricSource
+    and Scaler."""
+
+    def __init__(self, clock, *, base=5.0, ramp_start=40.0,
+                 ramp_slope=1.5, mu=10.0, max_pods=12) -> None:
+        self.clock = clock
+        self.base = base
+        self.ramp_start = ramp_start
+        self.ramp_slope = ramp_slope
+        self.mu = mu
+        self.max_pods = max_pods
+        self.depth = 0.0
+        self.replicas = 1
+        self._t = clock.now()
+
+    def _rate(self, t: float) -> float:
+        extra = self.ramp_slope * (t - self.ramp_start)
+        return self.base + (extra if t > self.ramp_start else 0.0)
+
+    def _advance(self) -> None:
+        target = self.clock.now()
+        t = self._t
+        while t < target - 1e-9:
+            dt = min(1.0, target - t)
+            self.depth = max(
+                0.0,
+                self.depth + self._rate(t + dt / 2.0) * dt
+                - self.mu * self.replicas * dt,
+            )
+            t += dt
+        self._t = target
+
+    def num_messages(self) -> int:
+        self._advance()
+        return int(self.depth)
+
+    def scale_up(self) -> None:
+        self._advance()
+        self.replicas = min(self.max_pods, self.replicas + 1)
+
+    def scale_down(self) -> None:
+        self._advance()
+        self.replicas = max(1, self.replicas - 1)
+
+
+def _restart_forecast_episode(tmpdir, *, durable=True):
+    """Warm vs cold restart on a ramp: the controller dies mid-ramp at a
+    tick boundary, the backlog keeps growing through the downtime, and
+    the restarted controller either resumes forecasting immediately
+    (warm: restored ring + cooldown stamps) or pays the reactive warm-up
+    AND the full startup grace (cold).  Returns (post-restart max depth,
+    first post-restart prediction, restart time)."""
+    import os
+
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.core.durable import DurableStateStore
+    from kube_sqs_autoscaler_tpu.core.events import MultiObserver
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.forecast import (
+        DepthHistory,
+        PredictivePolicy,
+        make_forecaster,
+    )
+    from kube_sqs_autoscaler_tpu.sim.faults import (
+        CRASH_TICK_BOUNDARY,
+        CrashPlan,
+    )
+
+    clock = FakeClock()
+    world = _RampWorld(clock)
+    state_path = os.path.join(
+        tmpdir, "warm.state" if durable else "cold.state"
+    )
+    crash_tick, downtime = 14, 25.0  # dies at t=75, restarts at t=100
+    plan = CrashPlan(crashes=((crash_tick, CRASH_TICK_BOUNDARY),))
+    config = LoopConfig(
+        poll_interval=5.0,
+        policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=-1,
+            scale_up_cooldown=15.0, scale_down_cooldown=60.0,
+        ),
+    )
+    collector = _RecordCollector()
+
+    def build(tick_fn):
+        del tick_fn
+        store = (
+            DurableStateStore(state_path, wall_clock=clock.now)
+            if durable else None
+        )
+        history = DepthHistory(capacity=64)
+        policy = PredictivePolicy(
+            make_forecaster("holt"), history, horizon=30.0
+        )
+        if store is not None:
+            store.register("forecast-history", history, ttl_s=3600.0)
+        loop = ControlLoop(
+            world, world, config, clock=clock, depth_policy=policy,
+            durable=store,
+        )
+        loop.observer = MultiObserver([history, collector])
+        return loop, store
+
+    _drive_restart_control(
+        build, clock, plan, poll=5.0, total_ticks=44, downtime_s=downtime,
+    )
+    restart_t = 5.0 * (crash_tick + 1) + downtime
+    post = [r for r in collector.records if r["start"] > restart_t]
+    post_max_depth = max((r["num_messages"] for r in post), default=0)
+    first_prediction = post[0].get("predicted_messages") if post else None
+    return {
+        "post_restart_max_depth": post_max_depth,
+        "first_post_restart_prediction": first_prediction,
+        "restart_t": restart_t,
+        "final_replicas": world.replicas,
+    }
+
+
+def _restart_fleet_episode(
+    point, tmpdir, *, model, params, donor, durable=True, messages=12,
+    crash_tick=6, downtime_s=5.0,
+):
+    """One fleet crash-restart episode: the REAL ControlLoop autoscaling
+    a REAL WorkerPool of serving replicas over one FakeClock queue with
+    a SHORT visibility timeout (3 virtual seconds < per-request service
+    time, so every in-flight request redelivers a copy mid-service —
+    the at-least-once regime where the reply registry earns its keep).
+    The controller process (loop AND pool) dies at ``point`` on tick
+    ``crash_tick``; the restart factory rebuilds both, rehydrating the
+    exactly-once reply registry from the snapshot (``durable=True``) or
+    forgetting it (the cold contrast, which must produce duplicates).
+    """
+    import os
+
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.core.durable import DurableStateStore
+    from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+    from kube_sqs_autoscaler_tpu.core.policy import PolicyConfig
+    from kube_sqs_autoscaler_tpu.fleet import FleetDriver, WorkerPool
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+    from kube_sqs_autoscaler_tpu.sim.faults import (
+        CRASH_TICK_BOUNDARY,
+        CrashingJournal,
+        CrashingMetricSource,
+        CrashingScaler,
+        CrashPlan,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+
+    clock = FakeClock()
+    queue = FakeMessageQueue(visibility_timeout=3.0, now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    queue_url = f"restart://{point or 'none'}-{'warm' if durable else 'cold'}"
+    config = ServiceConfig(
+        queue_url=queue_url, batch_size=2, seq_len=6,
+        generate_tokens=24, decode_block=4,
+        result_queue_url=f"{queue_url}-results",
+    )
+    rng = np.random.default_rng(23)
+    sent = [
+        queue.send_message(
+            queue_url,
+            json.dumps(rng.integers(1, model.vocab_size, 5).tolist()),
+        )
+        for _ in range(messages)
+    ]
+    state_path = os.path.join(tmpdir, "fleet.state")
+    journal_path = os.path.join(tmpdir, "fleet-journal.jsonl")
+    plan = CrashPlan(crashes=((crash_tick, point),))
+    loop_config = LoopConfig(
+        poll_interval=1.0,
+        policy=PolicyConfig(
+            scale_up_messages=4, scale_down_messages=1,
+            scale_up_cooldown=1.0, scale_down_cooldown=2.0,
+        ),
+    )
+    driver_box = {}
+    boots = []
+
+    def tick_fn():
+        driver = driver_box.get("driver")
+        return driver.tick_index - 1 if driver is not None else -1
+
+    def build():
+        store = (
+            DurableStateStore(state_path, wall_clock=clock.now,
+                              journal_path=journal_path)
+            if durable else None
+        )
+        pool = WorkerPool.serving(
+            queue, params, model, config, result_queue=results,
+            min=1, max=3, clock=clock, drain_timeout_cycles=200,
+            engine_source=donor,
+        )
+        if store is not None:
+            store.register("reply-registry", pool)
+        loop = ControlLoop(
+            CrashingScaler(pool, plan, tick_fn),
+            CrashingMetricSource(
+                QueueMetricSource(queue, queue_url,
+                                  ("ApproximateNumberOfMessages",)),
+                plan, tick_fn,
+            ),
+            loop_config, clock=clock, durable=store,
+        )
+        meta = {"source": "restart-bench-fleet", "poll_interval": 1.0}
+        if store is not None:
+            meta = store.journal_meta_after_rehydrate(
+                clock.now(), meta, observed_replicas=pool.replicas
+            )
+        journal = TickJournal(journal_path, meta=meta)
+        loop.observer = CrashingJournal(journal, plan, tick_fn)
+        boots.append({
+            "pool": pool,
+            "store": store,
+            "suppressed_at_boot": pool.duplicates_suppressed,
+        })
+        return pool, loop
+
+    pool, loop = build()
+    driver = FleetDriver(
+        pool, loop, cycle_dt=0.5,
+        crash_plan=plan if point == CRASH_TICK_BOUNDARY else None,
+        restart=build, downtime_s=downtime_s,
+    )
+    driver_box["driver"] = driver
+    # Termination: all originals answered AND a fixed virtual horizon
+    # passed.  NOT "idle": the 3s visibility is deliberately shorter
+    # than one request's service time, so redelivered copies of
+    # answered requests keep cycling (each re-serve outlives its
+    # visibility — real SQS would need heartbeat extensions); the
+    # horizon guarantees several such churn rounds hit the restored
+    # registry, which is the evidence the suppression gate counts.
+    stats = driver.run(
+        max_cycles=4000,
+        until=lambda: (
+            driver.pool.processed >= messages and clock.now() >= 25.0
+        ),
+    )
+    replies, duplicates = collect_replies(results, config.result_queue_url)
+    final = boots[-1]
+    # rehydration restores the pre-crash suppression counter, so the
+    # POST-restart suppressions (the registry actually earning its keep
+    # against redelivered already-answered copies) are the delta
+    suppressed_after_restart = (
+        final["pool"].duplicates_suppressed - final["suppressed_at_boot"]
+        if len(boots) > 1 else 0
+    )
+    report = (
+        final["store"].last_report
+        if final["store"] is not None else None
+    )
+    episode = {
+        "point": point,
+        "durable": durable,
+        "requests": messages,
+        "replies": len(replies),
+        "lost": len(set(sent) - set(replies)),
+        "duplicate_replies": duplicates,
+        "crashes": stats["crashes"],
+        "restarts": stats["restarts"],
+        "cycles": stats["cycles"],
+        "suppressed_after_restart": suppressed_after_restart,
+        "registry_records_recovered": (
+            report.records_recovered if report is not None
+            and len(boots) > 1 else None
+        ),
+        "cold_start": (
+            report.cold_start if report is not None
+            and len(boots) > 1 else None
+        ),
+        "replica_trajectory": stats["replica_trajectory"][:60],
+    }
+    return episode, final["pool"].engine_donor()
+
+
+def run_restart_suite(
+    output: str = "BENCH_r18.json", *, control_points=None,
+    fleet_points=None, fleet_messages: int = 12,
+) -> dict:
+    """The crash-restart battery (ISSUE 14): the controller itself is a
+    failure domain, proven at every named crash point.
+
+    Four sections, all on FakeClocks (deterministic verdicts):
+
+    - **crash-point battery** — scripted heavy-backlog world, one kill +
+      restart per :data:`~...sim.faults.CRASH_POINTS` entry.  Gates:
+      exactly one crash observed, ZERO cooldown violations across the
+      gap (every successful scale-up pair >= the cooldown apart — the
+      write-ahead intent closes the after-actuate window), warm restart
+      confirmed by the rehydration report, and the journal's restart
+      header stitching back to the pre-crash episode;
+    - **warm-beats-cold** — the same after-actuate episode without
+      durability: cold must ALSO never double-scale (startup grace
+      over-cools by design) but must fire strictly LATER than warm —
+      durability buys speed, not risk; plus byte-identity: a crash-free
+      episode's tick records with durability on == off, byte for byte;
+    - **breaker-across-the-gap** — the apiserver is down, the breaker
+      opens, the controller dies mid-reset-window: warm holds the
+      breaker open (zero RPCs until the probe instant), cold hammers
+      the dead apiserver at startup-grace expiry;
+    - **forecaster warm start** — a ramp backlog grows through the
+      crash + downtime: warm (restored ring + stamps) must beat cold on
+      post-restart max depth, strictly, and forecast on its FIRST
+      post-restart tick (cold has no history to forecast from);
+    - **fleet exactly-once** — the REAL serving fleet (loop + pool die
+      together) under a 3-second visibility timeout, per crash point:
+      every request answered exactly once across the restart, >= 1
+      redelivered already-answered copy actually suppressed by the
+      REHYDRATED registry across the battery, and the cold contrast
+      producing >= 1 duplicate reply (the gap is real).
+
+    Exit 2 on any gate failure; writes ``BENCH_r18.json``.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.sim.faults import (
+        CRASH_AFTER_ACTUATE,
+        CRASH_POINTS,
+        CRASH_TICK_BOUNDARY,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    control_points = tuple(control_points or CRASH_POINTS)
+    fleet_points = tuple(fleet_points or CRASH_POINTS)
+    start = time.perf_counter()
+    failures: list[str] = []
+
+    # -- crash-point battery (loop-only, JAX-free) ---------------------
+    crash_battery = {}
+    for point in control_points:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            stats, api, stitches = _restart_control_episode(point, tmpdir)
+        ups = [t for t, _ in api.scale_times]
+        gaps = [round(b - a, 6) for a, b in zip(ups, ups[1:])]
+        first_post_restart = next((t for t in ups if t > 60.0), None)
+        report = stats["reports"][-1] if len(stats["reports"]) > 1 else None
+        crash_battery[point] = {
+            "crashes": stats["crashes"],
+            "scale_up_times": ups,
+            "cooldown_gaps": gaps,
+            "first_post_restart_fire": first_post_restart,
+            "warm": report is not None and not report.cold_start,
+            "records_recovered": (
+                report.records_recovered if report is not None else None
+            ),
+            "journal_stitches": len(stitches),
+            "stitch_snapshot_hash": (
+                stitches[-1]["snapshot_hash"] if stitches else None
+            ),
+        }
+        if stats["crashes"] != 1:
+            failures.append(
+                f"{point}: expected exactly 1 crash, saw {stats['crashes']}"
+            )
+        if any(g < 30.0 - 1e-9 for g in gaps):
+            failures.append(
+                f"{point}: DOUBLE-SCALE — a scale-up fired inside the 30s "
+                f"cooldown across the restart (gaps {gaps})"
+            )
+        if report is None or report.cold_start:
+            failures.append(f"{point}: the restart did not rehydrate warm")
+        if not stitches or stitches[-1]["snapshot_hash"] is None:
+            failures.append(
+                f"{point}: the restart journal header did not stitch back "
+                "to a snapshot"
+            )
+
+    # -- warm-beats-cold + byte-identity -------------------------------
+    with tempfile.TemporaryDirectory() as tmpdir:
+        cold_stats, cold_api, _ = _restart_control_episode(
+            CRASH_AFTER_ACTUATE, tmpdir, durable=False
+        )
+    cold_ups = [t for t, _ in cold_api.scale_times]
+    cold_gaps = [round(b - a, 6) for a, b in zip(cold_ups, cold_ups[1:])]
+    cold_first = next((t for t in cold_ups if t > 60.0), None)
+    warm_first = crash_battery.get(CRASH_AFTER_ACTUATE, {}).get(
+        "first_post_restart_fire"
+    )
+    if any(g < 30.0 - 1e-9 for g in cold_gaps):
+        failures.append(
+            f"cold restart double-scaled (gaps {cold_gaps}) — the "
+            "reference grace should over-cool, never under-cool"
+        )
+    if warm_first is None or cold_first is None or not (
+        warm_first < cold_first
+    ):
+        failures.append(
+            f"warm restart did not fire strictly earlier than cold "
+            f"({warm_first} vs {cold_first}) — durability should buy "
+            "back the over-cooling"
+        )
+
+    warm_collector = _RecordCollector()
+    cold_collector = _RecordCollector()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        _restart_control_episode(
+            None, tmpdir, durable=True, collector=warm_collector,
+            total_ticks=16,
+        )
+    with tempfile.TemporaryDirectory() as tmpdir:
+        _restart_control_episode(
+            None, tmpdir, durable=False, collector=cold_collector,
+            total_ticks=16,
+        )
+    byte_identical = warm_collector.records == cold_collector.records
+    if not byte_identical:
+        failures.append(
+            "durability-on tick records differ from durability-off on a "
+            "crash-free episode (the off switch must be byte-exact)"
+        )
+
+    # -- breaker across the gap ----------------------------------------
+    with tempfile.TemporaryDirectory() as tmpdir:
+        warm_b, warm_api = _restart_breaker_episode(tmpdir, durable=True)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        cold_b, cold_api_b = _restart_breaker_episode(tmpdir, durable=False)
+    restart_t = 55.0
+    warm_attempts_after = [t for t in warm_api.update_attempts
+                           if t > restart_t]
+    cold_attempts_after = [t for t in cold_api_b.update_attempts
+                           if t > restart_t]
+    breaker = {
+        "probe_due_t": 95.0,
+        "warm_first_attempt_after_restart": (
+            warm_attempts_after[0] if warm_attempts_after else None
+        ),
+        "cold_first_attempt_after_restart": (
+            cold_attempts_after[0] if cold_attempts_after else None
+        ),
+    }
+    if not warm_attempts_after or warm_attempts_after[0] < 95.0 - 1e-9:
+        failures.append(
+            f"breaker: warm restart let an RPC through before the probe "
+            f"instant t=95 (first attempt "
+            f"{warm_attempts_after[:1] or None})"
+        )
+    if not cold_attempts_after or not (cold_attempts_after[0] < 95.0):
+        failures.append(
+            "breaker: the cold contrast did not hammer the dead "
+            "apiserver before the probe instant (the gap this section "
+            "demonstrates)"
+        )
+
+    # -- forecaster warm start -----------------------------------------
+    with tempfile.TemporaryDirectory() as tmpdir:
+        warm_f = _restart_forecast_episode(tmpdir, durable=True)
+        cold_f = _restart_forecast_episode(tmpdir, durable=False)
+    forecaster = {"warm": warm_f, "cold": cold_f}
+    if not (warm_f["post_restart_max_depth"]
+            < cold_f["post_restart_max_depth"]):
+        failures.append(
+            f"forecaster: warm restart did not beat cold on post-restart "
+            f"max depth ({warm_f['post_restart_max_depth']} vs "
+            f"{cold_f['post_restart_max_depth']})"
+        )
+    if warm_f["first_post_restart_prediction"] is None:
+        failures.append(
+            "forecaster: warm restart had no forecast on its first "
+            "post-restart tick (the restored ring should be past "
+            "min_samples)"
+        )
+    if cold_f["first_post_restart_prediction"] is not None:
+        failures.append(
+            "forecaster: the cold contrast forecast on its first "
+            "post-restart tick (it should have no history — the "
+            "contrast is vacuous)"
+        )
+
+    # -- fleet exactly-once across restart -----------------------------
+    model = ModelConfig(
+        vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=6 + 24, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    # compile warm-up: one tiny no-crash episode donates its engine to
+    # every later boot (restart spin-up stays compile-free, BLITZSCALE)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        warm_ep, donor = _restart_fleet_episode(
+            CRASH_TICK_BOUNDARY, tmpdir, model=model, params=params,
+            donor=None, durable=True, messages=4, crash_tick=10_000,
+        )
+    fleet = {}
+    suppressed_total = 0
+    for point in fleet_points:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            episode, _ = _restart_fleet_episode(
+                point, tmpdir, model=model, params=params, donor=donor,
+                durable=True, messages=fleet_messages,
+            )
+        fleet[point] = episode
+        suppressed_total += episode["suppressed_after_restart"]
+        if episode["lost"] or episode["replies"] != episode["requests"]:
+            failures.append(
+                f"fleet {point}: {episode['replies']}/"
+                f"{episode['requests']} answered ({episode['lost']} lost)"
+            )
+        if episode["duplicate_replies"]:
+            failures.append(
+                f"fleet {point}: {episode['duplicate_replies']} DUPLICATE "
+                "reply(ies) reached the consumer across the restart"
+            )
+        if episode["crashes"] != 1 or episode["restarts"] != 1:
+            failures.append(
+                f"fleet {point}: expected 1 crash + 1 restart, saw "
+                f"{episode['crashes']}/{episode['restarts']}"
+            )
+        if episode["cold_start"]:
+            failures.append(
+                f"fleet {point}: the registry did not rehydrate warm"
+            )
+    if suppressed_total < 1:
+        failures.append(
+            "fleet: no rehydrated registry ever suppressed a redelivered "
+            "already-answered copy — the zero-duplicate gates are vacuous"
+        )
+    with tempfile.TemporaryDirectory() as tmpdir:
+        cold_fleet, _ = _restart_fleet_episode(
+            CRASH_TICK_BOUNDARY, tmpdir, model=model, params=params,
+            donor=donor, durable=False, messages=fleet_messages,
+        )
+    if cold_fleet["duplicate_replies"] < 1:
+        failures.append(
+            "fleet cold contrast: a restart with NO registry rehydration "
+            "produced no duplicate reply — the episode does not exercise "
+            "the at-least-once gap"
+        )
+
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "restart",
+        "elapsed_s": round(elapsed, 2),
+        "crash_battery": crash_battery,
+        "warm_vs_cold": {
+            "warm_first_post_restart_fire": warm_first,
+            "cold_first_post_restart_fire": cold_first,
+            "cold_cooldown_gaps": cold_gaps,
+            "byte_identical_when_off": byte_identical,
+        },
+        "breaker": breaker,
+        "forecaster": forecaster,
+        "fleet": {
+            "warmup": {"requests": warm_ep["requests"],
+                       "replies": warm_ep["replies"]},
+            "episodes": fleet,
+            "suppressed_after_restart_total": suppressed_total,
+            "cold_contrast": cold_fleet,
+        },
+        "gates": {
+            "crash_battery": "1 crash/point; zero cooldown violations; "
+                             "warm rehydration; journal stitch",
+            "warm_vs_cold": "warm fires strictly earlier; cold never "
+                            "double-scales; byte-identity when off",
+            "breaker": "warm: no RPC before the probe instant",
+            "forecaster": "warm post-restart max depth < cold; warm "
+                          "forecasts on tick 1",
+            "fleet": "exactly-once at every crash point; >=1 suppression "
+                     "by a rehydrated registry; cold contrast duplicates",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"restart: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    depth_ratio = (
+        cold_f["post_restart_max_depth"]
+        / max(warm_f["post_restart_max_depth"], 1)
+    )
+    return {
+        "metric": "restart_duplicate_replies_prevented",
+        "value": cold_fleet["duplicate_replies"],
+        "unit": (
+            f"duplicate replies a registry-less restart produced (warm: 0 "
+            f"across {len(fleet_points)} fleet + {len(control_points)} "
+            f"loop crash points, 0 double-scales, "
+            f"{suppressed_total} redelivered copies suppressed, warm "
+            f"fires {cold_first - warm_first:g}s earlier than cold, "
+            f"post-restart backlog {depth_ratio:.2f}x lower warm)"
+        ),
+        "vs_baseline": cold_fleet["duplicate_replies"],
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
-                 "tenants", "overload", "twin"),
+                 "tenants", "overload", "twin", "restart"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -3492,7 +4310,11 @@ if __name__ == "__main__":
         " byte-identity); twin = token-level compiled serving twin"
         " (cycle-exact fidelity vs the real sharded plane, ES retraining"
         " with serving-unit reward, held-out win over the fluid-twin"
-        " checkpoint + reactive baselines)",
+        " checkpoint + reactive baselines); restart = controller"
+        " crash-restart battery (durable snapshot + rehydration at every"
+        " named crash point: zero double-scales, zero duplicate replies,"
+        " breaker/cooldown honored across the gap, warm beats cold on"
+        " post-restart backlog, byte-identity with durability off)",
     )
     cli.add_argument(
         "--output", default="",
@@ -3534,5 +4356,9 @@ if __name__ == "__main__":
         ))
     elif cli_args.suite == "twin":
         print(json.dumps(run_twin_suite(cli_args.output or "BENCH_r17.json")))
+    elif cli_args.suite == "restart":
+        print(json.dumps(
+            run_restart_suite(cli_args.output or "BENCH_r18.json")
+        ))
     else:
         print(json.dumps(run_bench()))

@@ -53,6 +53,12 @@ from .utils.logging import configure_logging
 
 log = logging.getLogger(__name__)
 
+#: Wall-clock TTL for every registered durable-state section in the CLI
+#: wiring: an hour-old snapshot's forecaster history, breaker verdicts,
+#: and learned mirror describe a world that no longer exists (expire by
+#: age, kube-controller style; core/durable.py applies it per section).
+_STATE_SECTION_TTL_S = 3600.0
+
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
@@ -258,6 +264,29 @@ def build_parser() -> argparse.ArgumentParser:
             "(0 = always 200 while serving; needs --metrics-port)"
         ),
     )
+    # Durable control-plane state (core/durable.py): snapshot the loop's
+    # whole control state each tick and rehydrate it on restart.  Empty =
+    # reference behavior (a restart loses cooldowns, breaker state,
+    # forecaster history, the learned mirror — everything).
+    parser.add_argument(
+        "--state-path", default="", metavar="PATH",
+        help=(
+            "Snapshot the control-plane state (cooldown stamps, breaker, "
+            "forecaster history, learned-policy mirror) to this file after "
+            "every tick, atomically, and rehydrate it on restart; a "
+            "corrupt/foreign snapshot cold-starts, never crash-loops "
+            "(empty = disabled, reference restart behavior)"
+        ),
+    )
+    parser.add_argument(
+        "--state-max-age", type=parse_duration, default=0.0,
+        metavar="DURATION",
+        help=(
+            "Cold-start instead of rehydrating when the snapshot is older "
+            "than this (stale memory is worse than no memory; 0 = no "
+            "limit — per-section TTLs still apply)"
+        ),
+    )
     return parser
 
 
@@ -324,6 +353,11 @@ def validate_flag_interactions(parser: argparse.ArgumentParser,
             f"exceed --poll-period ({args.poll_period:g}s): the loop "
             "completes at most one tick per poll period, so a healthy "
             "controller would fail the probe between ticks"
+        )
+    if args.state_max_age and not args.state_path:
+        parser.error(
+            "--state-max-age only applies with --state-path (there is "
+            "no snapshot to age out)"
         )
     if args.policy == "learned" and not args.policy_checkpoint:
         parser.error(
@@ -393,6 +427,21 @@ def main(argv: Sequence[str] | None = None) -> None:
         attribute_names=parse_attribute_names(args.attribute_names),
     )
 
+    # Durable control-plane state: the store is built first so every
+    # stateful subsystem can register a section as it is wired up;
+    # rehydration itself runs after the loop exists (and BEFORE the
+    # journal reopens, so the fresh journal header can carry the
+    # restart block replay stitches on).
+    store = None
+    if args.state_path:
+        from .core.durable import DurableStateStore
+
+        store = DurableStateStore(
+            args.state_path,
+            max_age_s=args.state_max_age,
+            journal_path=args.journal_path or None,
+        )
+
     server = None
     observers = []
     journal = None
@@ -414,6 +463,12 @@ def main(argv: Sequence[str] | None = None) -> None:
             ),
         )
         observers.append(metrics)
+        if store is not None:
+            # /healthz answers 503 ("rehydrating") until the first
+            # post-restart tick completes — readiness must not route
+            # to a controller still reconciling restored state
+            store.metrics = metrics
+            metrics.begin_rehydration()
         ring = None
         if args.journal_ring > 0:
             ring = TickRing(args.journal_ring)
@@ -423,17 +478,11 @@ def main(argv: Sequence[str] | None = None) -> None:
             port=args.metrics_port,
             ring=ring,
             unhealthy_after=args.healthz_stale_after,
+            # restart/rehydrate instants land beside the ticks on
+            # /debug/trace (their own "restart" category)
+            trace_sources=(store,) if store is not None else (),
         )
         server.start()
-    if args.journal_path:
-        from .obs import TickJournal
-
-        journal = TickJournal(
-            args.journal_path,
-            meta=_journal_meta(args, checkpoint),
-            max_bytes=args.journal_max_bytes,
-        )
-        observers.append(journal)
 
     # Predictive/learned policies: deferred import like the real-client
     # stacks — the reactive control plane never pays the JAX import.
@@ -448,6 +497,9 @@ def main(argv: Sequence[str] | None = None) -> None:
             horizon=args.forecast_horizon,
         )
         observers.append(history)  # fed from the tick-record observer hook
+        if store is not None:
+            store.register("forecast-history", history,
+                           ttl_s=_STATE_SECTION_TTL_S)
     elif checkpoint is not None:
         from .forecast import DepthHistory
         from .learn import LearnedPolicy
@@ -478,12 +530,74 @@ def main(argv: Sequence[str] | None = None) -> None:
         # the policy is its own observer: the tick-record hook feeds both
         # the depth history and the replica/cooldown mirror
         observers.append(depth_policy)
+        if store is not None:
+            store.register("learned-mirror", depth_policy,
+                           ttl_s=_STATE_SECTION_TTL_S)
         log.info(
             "Loaded learned policy checkpoint %s (hash %s, hidden %d)",
             args.policy_checkpoint,
             checkpoint.hash,
             checkpoint.hidden,
         )
+
+    loop = ControlLoop(
+        autoscaler,
+        metric_source,
+        config_from_args(args),
+        depth_policy=depth_policy,
+        resilience=resilience_from_args(args),
+        durable=store,
+    )
+    if store is not None:
+        if loop.resilience is not None:
+            store.register("resilience", loop.resilience,
+                           ttl_s=_STATE_SECTION_TTL_S)
+        # Trust the observed world: one deployment GET at boot (only
+        # with --state-path — the reference path stays RPC-free at
+        # startup) so the learned mirror reconciles against the ACTUAL
+        # replica count, not the remembered trajectory.  A dead
+        # apiserver degrades to no reconciliation, never a crash.
+        observed = None
+        try:
+            observed = autoscaler.client.get(
+                args.kubernetes_deployment
+            ).replicas
+        except Exception as err:
+            log.warning(
+                "Could not observe deployment replicas for "
+                "rehydration reconcile (%s); restored state stands", err,
+            )
+        # Rehydrate NOW, before the journal reopens: the fresh journal
+        # header must carry the restart block (which snapshot this boot
+        # rose from, how much state survived) for replay stitching —
+        # and rehydration itself reads the journal's pre-crash tail.
+        report = store.rehydrate(
+            loop.clock.now(), observed_replicas=observed,
+        )
+        log.info(
+            "Rehydration: %s (%d recovered, %d expired, restart #%d)",
+            "cold start" + (f" — {report.reason}" if report.reason else "")
+            if report.cold_start else "warm",
+            report.records_recovered, report.records_expired,
+            report.restarts,
+        )
+    if args.journal_path:
+        from .obs import TickJournal
+
+        meta = _journal_meta(args, checkpoint)
+        if store is not None:
+            # idempotent: the rehydrate above already ran; this stamps
+            # the restart block and pins the order (rehydrate must
+            # precede the journal reopen — core/durable.py)
+            meta = store.journal_meta_after_rehydrate(
+                loop.clock.now(), meta
+            )
+        journal = TickJournal(
+            args.journal_path,
+            meta=meta,
+            max_bytes=args.journal_max_bytes,
+        )
+        observers.append(journal)
 
     if not observers:
         observer = None
@@ -493,15 +607,7 @@ def main(argv: Sequence[str] | None = None) -> None:
         from .core.events import MultiObserver
 
         observer = MultiObserver(observers)
-
-    loop = ControlLoop(
-        autoscaler,
-        metric_source,
-        config_from_args(args),
-        observer=observer,
-        depth_policy=depth_policy,
-        resilience=resilience_from_args(args),
-    )
+    loop.observer = observer
 
     # Extension over the reference (which runs until killed): exit cleanly
     # on SIGTERM/SIGINT so Kubernetes pod termination ends the current tick

@@ -30,6 +30,7 @@ import threading
 from dataclasses import dataclass, field
 
 from .clock import Clock, SystemClock
+from .durable import ControllerCrash, DurableStateStore
 from .events import TickObserver, TickRecord
 from .policy import (
     Gate,
@@ -67,6 +68,7 @@ class ControlLoop:
         observer: TickObserver | None = None,
         depth_policy: DepthPolicy | None = None,
         resilience: ResilienceConfig | None = None,
+        durable: DurableStateStore | None = None,
     ) -> None:
         self.scaler = scaler
         self.metric_source = metric_source
@@ -82,6 +84,11 @@ class ControlLoop:
             if resilience is not None and resilience.enabled
             else None
         )
+        # None = reference behavior: the controller's memory dies with
+        # the process.  With a DurableStateStore the loop snapshots its
+        # whole control state after every tick and REHYDRATES it (via
+        # initial_policy_state) at episode start — core/durable.py.
+        self.durable = durable
         self.ticks = 0  # completed ticks (observability; not used by policy)
         self._stop = threading.Event()
 
@@ -99,6 +106,30 @@ class ControlLoop:
         """Clear a previous :meth:`stop` so the loop can run again."""
         self._stop.clear()
 
+    def initial_policy_state(self) -> PolicyState:
+        """The episode's starting policy state.
+
+        Reference behavior (no durable store): ``initial_state(now)`` —
+        both cooldowns start "just scaled", the startup grace window.
+        With a :class:`~.durable.DurableStateStore` the store rehydrates
+        first (snapshot + journal tail + unresolved actuation intent,
+        reconciled against the scaler's observed replica count) and the
+        restored, rebased cooldown stamps stand in; any refusal —
+        missing, torn, corrupt, or future-schema snapshot — falls back
+        to the cold start above, never to a crash.
+        """
+        now = self.clock.now()
+        if self.durable is None:
+            return initial_state(now)
+        self.durable.rehydrate(
+            now, observed_replicas=getattr(self.scaler, "replicas", None)
+        )
+        # consumed, not read: only the FIRST episode after boot starts
+        # from the restored stamps — a later run() on the same loop is
+        # a fresh episode (reference grace), per run()'s contract
+        restored = self.durable.take_restored_policy_state()
+        return restored if restored is not None else initial_state(now)
+
     def run(self, max_ticks: int | None = None) -> PolicyState:
         """Run the loop; blocks until ``max_ticks`` ticks or :meth:`stop`.
 
@@ -106,7 +137,7 @@ class ControlLoop:
         fresh episode (fresh startup-grace state and tick budget);
         ``self.ticks`` accumulates across episodes for observability.
         """
-        state = initial_state(self.clock.now())
+        state = self.initial_policy_state()
         ticks_this_run = 0
         while not self._stop.is_set():
             if max_ticks is not None and ticks_this_run >= max_ticks:
@@ -127,35 +158,70 @@ class ControlLoop:
         observer after the tick completes.
         """
         record = TickRecord(start=self.clock.now())
+        crashed = False
+        new_state = state
         try:
-            return self._tick(state, record)
+            new_state = self._tick(state, record)
+            return new_state
+        except ControllerCrash:
+            # simulated process death (sim/faults.CrashPlan): nothing
+            # after this instant happens — no observer, no journal line,
+            # no snapshot — exactly like the pod vanishing mid-tick
+            crashed = True
+            raise
         finally:
-            if self.resilience is not None:
-                record.breaker_state = self.resilience.breaker_state
-            record.duration = self.clock.now() - record.start
-            # The decide span is the remainder once observation and scaler
-            # time are accounted — defined only for ticks that got past the
-            # observation (a metric failure ends the tick inside observe).
-            if record.metric_error is None and record.observe_s is not None:
-                record.decide_s = max(
-                    0.0,
-                    record.duration
-                    - record.observe_s
-                    - (record.actuate_s or 0.0),
-                )
-            if self.observer is not None:
-                try:
-                    self.observer.on_tick(record)
-                except Exception:  # instrumentation must never kill the loop
-                    log.exception("Tick observer failed")
+            if not crashed:
+                if self.resilience is not None:
+                    record.breaker_state = self.resilience.breaker_state
+                record.duration = self.clock.now() - record.start
+                # The decide span is the remainder once observation and
+                # scaler time are accounted — defined only for ticks that
+                # got past the observation (a metric failure ends the
+                # tick inside observe).
+                if record.metric_error is None and record.observe_s is not None:
+                    record.decide_s = max(
+                        0.0,
+                        record.duration
+                        - record.observe_s
+                        - (record.actuate_s or 0.0),
+                    )
+                if self.observer is not None:
+                    try:
+                        self.observer.on_tick(record)
+                    except Exception:  # instrumentation must never kill the loop
+                        log.exception("Tick observer failed")
+                # The snapshot is the LAST durable act of the tick — after
+                # the journal observer, so the journal is never behind the
+                # snapshot (rehydration replays the journal tail forward,
+                # never backward).  A torn-journal crash (ControllerCrash
+                # out of the observer, a BaseException the guard above
+                # does not swallow) therefore skips the snapshot too.
+                if self.durable is not None:
+                    try:
+                        self.durable.snapshot(
+                            clock_now=self.clock.now(),
+                            policy_state=new_state,
+                            ticks=self.ticks + 1,
+                            last_tick_start=record.start,
+                        )
+                    except Exception:  # durability must never kill the loop
+                        log.exception("Control-plane snapshot failed")
 
-    def _actuate(self, record: TickRecord, action) -> str | None:
+    def _actuate(self, record: TickRecord, action, direction: str) -> str | None:
         """One scaler call with its clock time accumulated into the record's
         actuate span; returns the error string on failure (tick ends).
         With a resilience policy the call goes through the circuit breaker,
         per-call deadline, and retry budget (``core/resilience.py``) — an
-        open breaker fails here without touching the scaler."""
+        open breaker fails here without touching the scaler.  With a
+        durable store, a write-ahead INTENT lands before the RPC: a crash
+        between the actuation and the tick's snapshot must rehydrate as
+        "may have scaled" (cooldown stamp advanced), never double-scale."""
         started = self.clock.now()
+        if self.durable is not None:
+            try:
+                self.durable.note_intent(direction, started)
+            except Exception:  # durability must never block an actuation
+                log.exception("Actuation intent write failed")
         try:
             if self.resilience is not None:
                 self.resilience.actuate(action, record)
@@ -256,7 +322,7 @@ class ControlLoop:
             log.info("Waiting for cool down, skipping scale up ")
             return state
         if up is Gate.FIRE:
-            error = self._actuate(record, self.scaler.scale_up)
+            error = self._actuate(record, self.scaler.scale_up, "up")
             if error is not None:
                 log.error("Failed scaling up: %s", error)
                 record.up_error = error
@@ -270,7 +336,7 @@ class ControlLoop:
             log.info("Waiting for cool down, skipping scale down")
             return state
         if down is Gate.FIRE:
-            error = self._actuate(record, self.scaler.scale_down)
+            error = self._actuate(record, self.scaler.scale_down, "down")
             if error is not None:
                 log.error("Failed scaling down: %s", error)
                 record.down_error = error

@@ -362,3 +362,65 @@ class ResiliencePolicy:
         else:
             if self.breaker is not None:
                 self.breaker.record_success()
+
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py StateProvider): the breaker
+    # and the stale-hold are exactly the control state a restart used to
+    # zero — a crashed controller came back with a CLOSED breaker and
+    # hammered the still-dead apiserver, and with no last-good depth to
+    # hold through the outage that killed it.
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        state: dict = {"records": 0}
+        if self._last_good is not None:
+            t, depth = self._last_good
+            state["last_good"] = {"t": t, "depth": depth}
+            state["records"] += 1
+        if self.breaker is not None:
+            state["breaker"] = {
+                "state": self.breaker.state,
+                "failures": self.breaker.failures,
+                "opened_at": self.breaker.opened_at,
+            }
+            state["records"] += 1
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        """Restore the stale-hold observation and the breaker, every
+        instant shifted by ``rebase`` — a held depth that aged past its
+        TTL during the downtime expires through the ordinary
+        :meth:`stale_depth` age check, and an open breaker whose reset
+        window elapsed while the pod was down re-probes immediately
+        through the ordinary :meth:`~CircuitBreaker.allow` check."""
+        recovered = 0
+        last_good = state.get("last_good")
+        if isinstance(last_good, dict):
+            try:
+                t = float(last_good["t"]) + rebase
+                depth = int(last_good["depth"])
+            except (KeyError, TypeError, ValueError):
+                pass
+            else:
+                self._last_good = (t, depth)
+                recovered += 1
+        saved = state.get("breaker")
+        if self.breaker is not None and isinstance(saved, dict):
+            name = saved.get("state")
+            opened = saved.get("opened_at")
+            if name == BREAKER_OPEN and opened is None:
+                # an open breaker with no timestamp could never probe
+                # again — refuse the record, keep the fresh closed
+                # breaker (cold is safe; wedged-open forever is not)
+                name = None
+            if name in BREAKER_STATE_CODES:
+                self.breaker.state = name
+                self.breaker.failures = int(saved.get("failures", 0) or 0)
+                self.breaker.opened_at = (
+                    float(opened) + rebase if opened is not None else None
+                )
+                recovered += 1
+        return recovered

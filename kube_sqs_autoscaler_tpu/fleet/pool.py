@@ -116,6 +116,10 @@ class _BoundedSet:
     def __len__(self) -> int:
         return len(self._order)
 
+    def items(self) -> list:
+        """Insertion-ordered contents (durable-state serialization)."""
+        return list(self._order)
+
 
 class FleetPoolBase:
     """Plumbing shared by the two fleet actuators (:class:`WorkerPool`
@@ -162,6 +166,43 @@ class FleetPoolBase:
     def note_duplicate(self, rid: str) -> None:
         self.duplicates_suppressed += 1
         log.info("Suppressed duplicate reply for request %s", rid)
+
+    # -- durable-state surface (core/durable.py StateProvider) -----------
+    #
+    # The registry is the WORST thing a controller restart used to lose
+    # (ISSUE 14): the serving substrate is at-least-once, so a request
+    # answered just before the crash can still have a redelivered copy
+    # in the queue — a restarted pool with an empty registry re-answers
+    # it, and the consumer sees two replies for one request id.
+
+    def export_state(self) -> dict:
+        # capacity is NOT serialized: the restarted pool's constructor
+        # owns the bound, and re-adding through the bounded set below
+        # reproduces the exact eviction state under whatever bound the
+        # new boot configured
+        return {
+            "records": len(self._replied),
+            "replied": self._replied.items(),
+            "duplicates_suppressed": self.duplicates_suppressed,
+        }
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        """Restore the reply registry bitwise (insertion order and the
+        capacity bound both survive — re-adding through the bounded set
+        reproduces the exact eviction state a continuous pool would
+        have).  Request ids are opaque; nothing here is clock-based."""
+        del rebase, now, max_age_s
+        recovered = 0
+        for rid in state.get("replied") or ():
+            self._replied.add(rid)
+            recovered += 1
+        self.duplicates_suppressed = int(
+            state.get("duplicates_suppressed", 0) or 0
+        )
+        return recovered
 
     # -- event stream ----------------------------------------------------
 
@@ -742,6 +783,21 @@ class FleetDriver:
     the deterministic demo mode; ``0`` reads real time (the bench).
     ``fault_plan`` applies a :class:`~..sim.faults.FleetFaultPlan`'s
     kills/hangs at their scheduled cycles.
+
+    **Controller crashes** (ISSUE 14): a
+    :class:`~..core.durable.ControllerCrash` escaping ``loop.tick`` —
+    injected by a :class:`~..sim.faults.CrashPlan` at any of its named
+    crash points — kills the whole controller process: loop AND pool
+    (they share it).  With a ``restart`` factory the driver then models
+    Kubernetes restarting the pod: it stops nothing (the dead pool's
+    in-flight work is simply abandoned to the queue's visibility
+    timeout, like real process death), advances ``downtime_s`` of
+    virtual time, asks the factory for a fresh ``(pool, loop)`` —
+    typically rehydrating from a :class:`~..core.durable`
+    snapshot — and resumes the episode.  Without a factory the crash
+    propagates (a crash the episode did not expect must fail it).
+    ``tick_index`` counts tick *attempts* across restarts — the index
+    a ``CrashPlan`` keys on.
     """
 
     def __init__(
@@ -751,12 +807,43 @@ class FleetDriver:
         *,
         cycle_dt: float = 0.0,
         fault_plan=None,
+        crash_plan=None,
+        restart: Callable[[], tuple] | None = None,
+        downtime_s: float = 0.0,
     ) -> None:
         self.pool = pool
         self.loop = loop
         self.cycle_dt = cycle_dt
         self.fault_plan = fault_plan
+        # the CrashPlan is consulted here only for its TICK-BOUNDARY
+        # kills (after journal + snapshot); the mid-tick crash points
+        # raise from inside the loop via the sim.faults wrappers
+        self.crash_plan = crash_plan
+        self.restart = restart
+        self.downtime_s = downtime_s
         self.ticks = 0
+        self.tick_index = 0  # tick ATTEMPTS, crashed ones included
+        self.crashes = 0
+        self.restarts = 0
+
+    def _crash_restart(self, clock):
+        """One controller death + pod restart (see class docstring)."""
+        from ..core.durable import ControllerCrash
+
+        self.crashes += 1
+        if self.restart is None:
+            raise ControllerCrash(
+                "controller crashed with no restart factory"
+            )
+        log.warning(
+            "Controller crashed at tick %d; restarting after %.1fs",
+            self.tick_index, self.downtime_s,
+        )
+        if self.downtime_s:
+            clock.advance(self.downtime_s)  # FakeClock only
+        self.pool, self.loop = self.restart()
+        self.restarts += 1
+        return self.loop.initial_policy_state()
 
     def run(
         self,
@@ -770,13 +857,13 @@ class FleetDriver:
         replaces the stop condition with an arbitrary predicate,
         evaluated after each cycle (e.g. "all replies collected AND the
         fleet scaled back down to min")."""
+        from ..core.durable import ControllerCrash
+
         clock = self.loop.clock if self.loop is not None else self.pool.clock
         state = None
         next_tick = None
         if self.loop is not None:
-            from ..core.policy import initial_state
-
-            state = initial_state(clock.now())
+            state = self.loop.initial_policy_state()
             next_tick = clock.now() + self.loop.config.poll_interval
         trajectory: list[int] = []
         cycles = 0
@@ -788,10 +875,21 @@ class FleetDriver:
             if self.cycle_dt:
                 clock.advance(self.cycle_dt)  # FakeClock only
             if self.loop is not None and clock.now() >= next_tick:
-                state = self.loop.tick(state)
-                self.loop.ticks += 1
-                self.ticks += 1
-                trajectory.append(self.pool.replicas)
+                self.tick_index += 1
+                try:
+                    state = self.loop.tick(state)
+                except ControllerCrash:
+                    state = self._crash_restart(clock)
+                else:
+                    self.loop.ticks += 1
+                    self.ticks += 1
+                    trajectory.append(self.pool.replicas)
+                    if self.crash_plan is not None and \
+                            self.crash_plan.boundary_crash(
+                                self.tick_index - 1):
+                        # tick-boundary kill: journal line AND snapshot
+                        # landed; the restart must be seamless
+                        state = self._crash_restart(clock)
                 # re-anchor rather than accumulate: a long serve cycle
                 # must not cause a burst of catch-up ticks
                 next_tick = clock.now() + self.loop.config.poll_interval
@@ -810,4 +908,6 @@ class FleetDriver:
             "processed": self.pool.processed,
             "replica_trajectory": trajectory,
             "final_replicas": self.pool.replicas,
+            "crashes": self.crashes,
+            "restarts": self.restarts,
         }

@@ -396,6 +396,39 @@ class ShardedWorkerPool(FleetPoolBase):
         self._update_metrics()
 
     # ------------------------------------------------------------------
+    # Durable-state surface: the base class serializes the exactly-once
+    # reply registry; the sharded plane has exactly ONE worker, so its
+    # admission accounting (DRR/EDF deficits + urgency credits, flood
+    # classification, overload-ladder tier, sticky tenant homes) rides
+    # the same section.  Shard lifecycle states deliberately do NOT:
+    # the restarted plane's masks are the observed world, and the
+    # autoscaler re-derives the shard count through the ordinary gates.
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        state = super().export_state()
+        admission = getattr(self.worker, "export_admission_state", None)
+        if admission is not None:
+            state["admission"] = admission()
+            state["records"] += state["admission"].get("records", 0)
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        recovered = super().import_state(
+            state, rebase=rebase, now=now, max_age_s=max_age_s
+        )
+        admission = state.get("admission")
+        importer = getattr(self.worker, "import_admission_state", None)
+        if importer is not None and isinstance(admission, dict):
+            recovered += importer(
+                admission, rebase=rebase, now=now, max_age_s=max_age_s
+            )
+        return recovered
+
+    # ------------------------------------------------------------------
     # Observability (the reply registry and the FleetEvent stream —
     # including the exactly-once protocol the FleetWorker settle path
     # speaks — live on FleetPoolBase, shared with WorkerPool)

@@ -72,6 +72,42 @@ class DepthHistory:
             depths[n:] = depths[n - 1]
         return times, depths, n
 
+    def export_state(self) -> dict:
+        """Durable-state surface (``core/durable.py`` StateProvider):
+        the ring's chronological samples.  A restart used to zero this
+        buffer, sending every forecaster back through its reactive
+        warm-up exactly when the post-crash backlog made forecasts
+        matter most."""
+        times, depths, n = self.snapshot()
+        return {
+            "records": n,
+            "times": [float(t) for t in times[:n]],
+            "depths": [float(d) for d in depths[:n]],
+        }
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        """Re-observe the saved samples at their rebased instants —
+        the downtime becomes a visible gap in the series, exactly what
+        a trend fit should see.  Samples older than ``max_age_s`` at
+        ``now`` (wall-clock age incl. the downtime) are dropped: stale
+        demand history mis-trains every forecaster."""
+        times = state.get("times") or []
+        depths = state.get("depths") or []
+        recovered = 0
+        for t, depth in zip(times, depths):
+            try:
+                t, depth = float(t) + rebase, float(depth)
+            except (TypeError, ValueError):
+                continue
+            if max_age_s > 0 and now is not None and now - t > max_age_s:
+                continue
+            self.observe(t, depth)
+            recovered += 1
+        return recovered
+
     def with_sample(
         self, t: float, depth: float
     ) -> tuple[np.ndarray, np.ndarray, int]:

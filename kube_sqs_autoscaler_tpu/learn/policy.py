@@ -146,6 +146,58 @@ class LearnedPolicy:
         self.last_prediction = decision
         return decision
 
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py StateProvider): the mirror
+    # IS control state — a restart used to reset it to initial_replicas
+    # and lazy cooldown stamps, feeding the network replica/cooldown
+    # features from a world that no longer exists.
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        state: dict = {
+            "records": 1,
+            "replicas": self.replicas,
+            "last_up": self._last_up,
+            "last_down": self._last_down,
+            "checkpoint_hash": self.checkpoint.hash,
+            "history": self.history.export_state(),
+        }
+        state["records"] += state["history"].get("records", 0)
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        """Restore mirror + feature history.  A snapshot written under
+        DIFFERENT weights is refused whole: the mirror's meaning (and
+        the feature window) belongs to the checkpoint that ran."""
+        if state.get("checkpoint_hash") not in (None, self.checkpoint.hash):
+            return 0
+        recovered = 0
+        replicas = state.get("replicas")
+        if replicas is not None:
+            self.replicas = max(
+                self.min_pods, min(self.max_pods, int(replicas))
+            )
+            recovered += 1
+        for attr, key in (("_last_up", "last_up"), ("_last_down", "last_down")):
+            stamp = state.get(key)
+            if stamp is not None:
+                setattr(self, attr, float(stamp) + rebase)
+        history = state.get("history")
+        if isinstance(history, dict):
+            recovered += self.history.import_state(
+                history, rebase=rebase, now=now, max_age_s=max_age_s
+            )
+        return recovered
+
+    def reconcile_observed(self, replicas: int) -> None:
+        """kube-controller style: the OBSERVED replica count outranks the
+        remembered trajectory (the world may have scaled, crashed, or
+        been edited while this controller was down)."""
+        self.replicas = max(self.min_pods, min(self.max_pods, int(replicas)))
+
     def on_tick(self, record: TickRecord) -> None:
         """Mirror the world the features describe, from the tick record.
 

@@ -226,6 +226,24 @@ class TickJournal:
         self._write_line(self._header_line(continuation=True))
         self._needs_header = False
 
+    def tear(self, record: TickRecord) -> None:
+        """Crash-injection seam (``sim.faults.CrashingJournal``): write
+        HALF of the record's line — no newline, no flush discipline —
+        modeling the process dying mid-``write``.  The torn fragment is
+        exactly what :func:`parse_journal_episodes` already tolerates at
+        a file tail, and what a restarting :class:`TickJournal` heals by
+        newline-terminating before its fresh header."""
+        line = json.dumps(
+            {"kind": _TICK_KIND, **record.to_dict()}, separators=(",", ":")
+        )
+        with self._lock:
+            if self._closed or self._fh.closed:
+                return
+            fragment = line[: max(1, len(line) // 2)]
+            self._fh.write(fragment)
+            self._fh.flush()
+            self._size += len(fragment.encode("utf-8"))
+
     def close(self) -> None:
         with self._lock:
             self._closed = True

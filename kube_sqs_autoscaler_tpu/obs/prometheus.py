@@ -88,10 +88,50 @@ class ControllerMetrics:
         self._last_successful_poll: float | None = None  # unix seconds
         self._last_successful_scale: float | None = None
         self._last_tick_monotonic: float | None = None
+        # Durable control-plane restarts (core/durable.py): the store
+        # pushes its RehydrationReport here; the rehydrating flag gates
+        # /healthz at 503 until the first post-restart tick completes
+        # (readiness must not route to a controller still reconciling).
+        self._rehydrating = False
+        self._restarts_total = 0
+        self._rehydration_duration: float | None = None
+        self._snapshot_age: float | None = None
+        self._records_recovered: int | None = None
+        self._records_expired: int | None = None
+
+    def begin_rehydration(self) -> None:
+        """The controller is reconciling restored state against the
+        world; ``/healthz`` answers 503 until the next completed tick."""
+        with self._lock:
+            self._rehydrating = True
+
+    @property
+    def rehydrating(self) -> bool:
+        with self._lock:
+            return self._rehydrating
+
+    def set_rehydration(self, report) -> None:
+        """Record a :class:`~..core.durable.RehydrationReport`'s numbers
+        (restart counter, duration, snapshot age, recovered/expired)."""
+        with self._lock:
+            self._restarts_total = int(getattr(report, "restarts", 0) or 0)
+            self._rehydration_duration = float(
+                getattr(report, "duration_s", 0.0) or 0.0
+            )
+            self._snapshot_age = float(
+                getattr(report, "snapshot_age_s", 0.0) or 0.0
+            )
+            self._records_recovered = int(
+                getattr(report, "records_recovered", 0) or 0
+            )
+            self._records_expired = int(
+                getattr(report, "records_expired", 0) or 0
+            )
 
     def on_tick(self, record: TickRecord) -> None:
         with self._lock:
             self._ticks += 1
+            self._rehydrating = False  # first post-restart tick completed
             self._last_tick_monotonic = time.monotonic()
             self._tick_seconds_sum += record.duration
             for i, le in enumerate(TICK_DURATION_BUCKETS):
@@ -305,6 +345,34 @@ class ControllerMetrics:
                     f"{_PREFIX}_last_successful_scale_timestamp"
                     f" {self._last_successful_scale}"
                 )
+            # Durable restart visibility (core/durable.py): the restart
+            # counter always renders (0 = never restarted); the report
+            # gauges render once a rehydration produced them.
+            lines += [
+                f"# HELP {_PREFIX}_controller_restarts_total Controller"
+                " restarts observed via the durable snapshot chain"
+                " (0 = first boot or durability disabled).",
+                f"# TYPE {_PREFIX}_controller_restarts_total counter",
+                f"{_PREFIX}_controller_restarts_total {self._restarts_total}",
+            ]
+            for name, value, help_text in (
+                ("rehydration_duration_seconds", self._rehydration_duration,
+                 "Wall seconds the last startup rehydration took."),
+                ("snapshot_age_seconds", self._snapshot_age,
+                 "Age of the snapshot the last rehydration loaded"
+                 " (the restart's downtime)."),
+                ("state_records_recovered", self._records_recovered,
+                 "Control-state records the last rehydration restored."),
+                ("state_records_expired", self._records_expired,
+                 "Control-state records the last rehydration expired or"
+                 " refused (wall-clock TTLs, schema/hash refusals)."),
+            ):
+                lines += [
+                    f"# HELP {_PREFIX}_{name} {help_text}",
+                    f"# TYPE {_PREFIX}_{name} gauge",
+                ]
+                if value is not None:
+                    lines.append(f"{_PREFIX}_{name} {value}")
             build_labels = ",".join(
                 f'{name}="{escape_label_value(value)}"'
                 for name, value in self._build_labels

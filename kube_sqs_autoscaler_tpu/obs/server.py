@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .journal import JOURNAL_SCHEMA_VERSION, TickRing
 from .prometheus import ControllerMetrics
-from .trace import render_chrome_trace
+from .trace import instant_trace_events, render_chrome_trace
 
 log = logging.getLogger(__name__)
 
@@ -52,13 +52,20 @@ class ObservabilityServer:
         port: int = 8080,
         ring: TickRing | None = None,
         unhealthy_after: float = 0.0,
+        trace_sources: tuple = (),
     ) -> None:
+        # trace_sources: objects with an ``events`` iterable of
+        # (name, t, args)-shaped instants on the tick clock — e.g. a
+        # DurableStateStore's restart-detected/rehydrated events — so
+        # /debug/trace shows them beside the ticks (their name prefixes
+        # pick their trace category, "restart-*" → its own lane).
         self.metrics = metrics
         self.ring = ring
         self.unhealthy_after = unhealthy_after
         registry = metrics  # close over for the handler class
         tick_ring = ring
         stale_after = unhealthy_after
+        sources = tuple(trace_sources)
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -70,6 +77,24 @@ class ObservabilityServer:
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif url.path == "/healthz":
+                    # Rehydrating (core/durable.py): a restarted
+                    # controller still reconciling restored state
+                    # answers 503 until its first post-restart tick
+                    # completes (at most one poll period — size
+                    # liveness-probe windows past the poll period,
+                    # same rule --healthz-stale-after validates; the
+                    # routing gate is /readyz, which is 503 here
+                    # anyway until the first successful observation).
+                    # Guarded by getattr — WorkloadMetrics registries
+                    # have no rehydration state and stay healthy.
+                    if getattr(registry, "rehydrating", False):
+                        self._reply(
+                            503,
+                            "rehydrating: restored control-plane state"
+                            " not yet reconciled (first post-restart"
+                            " tick pending)\n",
+                        )
+                        return
                     # Tick-progress liveness: a wedged loop must stop
                     # answering 200 so the orchestrator restarts it.
                     # Guarded by getattr — WorkloadMetrics registries
@@ -86,7 +111,15 @@ class ObservabilityServer:
                     else:
                         self._reply(200, "ok\n")
                 elif url.path == "/readyz":
-                    if registry.ready:
+                    if getattr(registry, "rehydrating", False):
+                        # readiness is the ROUTING gate: never route to
+                        # a controller still reconciling restored state
+                        self._reply(
+                            503,
+                            "rehydrating: restored control-plane state"
+                            " not yet reconciled\n",
+                        )
+                    elif registry.ready:
                         self._reply(200, "ok\n")
                     else:
                         self._reply(
@@ -97,9 +130,18 @@ class ObservabilityServer:
                         200, self._ticks_body(url.query), "application/json"
                     )
                 elif url.path == "/debug/trace" and tick_ring is not None:
+                    records = tick_ring.snapshot()
+                    origin = records[0].start if records else None
+                    extra = [
+                        event
+                        for source in sources
+                        for event in instant_trace_events(
+                            source.events, time_origin=origin
+                        )
+                    ]
                     self._reply(
                         200,
-                        render_chrome_trace(tick_ring.snapshot()),
+                        render_chrome_trace(records, extra_events=extra),
                         "application/json",
                     )
                 else:

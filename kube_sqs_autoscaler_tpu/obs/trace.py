@@ -159,6 +159,11 @@ def instant_trace_events(
             return "prefix"
         if name.startswith("overload-"):
             return "overload"
+        if name.startswith("restart-"):
+            # the durable store's controller-restart / rehydration
+            # instants (core/durable.py) — their own lane so a
+            # postmortem can line recovery up against the ticks
+            return "restart"
         return "fleet"
 
     return [

@@ -75,3 +75,33 @@ class FakeDeploymentAPI:
             if name not in self._store:
                 raise NotFoundError(f'deployments.apps "{name}" not found')
             return self._store[name].replicas
+
+
+class RecordingDeploymentAPI:
+    """Recorder + persistent-failure proxy over a DeploymentAPI.
+
+    The restart battery's shared evidence collector (``core/durable``'s
+    demo and ``bench.py --suite restart``): timestamps every successful
+    replica write on the injected clock — the cooldown-violation
+    evidence — and counts/timestamps every attempt that reached the
+    "apiserver" — the breaker's did-an-RPC-happen evidence.  ``fail``
+    holds the apiserver down persistently (the one-shot
+    ``fail_next_update`` hook cannot keep it down long enough to open a
+    breaker)."""
+
+    def __init__(self, inner, clock) -> None:
+        self.inner = inner
+        self.clock = clock
+        self.fail = False
+        self.update_attempts: list = []  # t of every RPC that reached us
+        self.scale_times: list = []  # (t, replicas) successful writes
+
+    def get(self, name):
+        return self.inner.get(name)
+
+    def update(self, deployment):
+        self.update_attempts.append(self.clock.now())
+        if self.fail:
+            raise RuntimeError("apiserver down")
+        self.scale_times.append((self.clock.now(), deployment.replicas))
+        return self.inner.update(deployment)

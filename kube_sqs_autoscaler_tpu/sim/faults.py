@@ -317,6 +317,170 @@ class FleetFaultPlan:
 
 
 # ---------------------------------------------------------------------------
+# Controller crash injection (ISSUE 14): the controller itself is a
+# failure domain.  A CrashPlan kills the whole controller process — loop
+# AND in-process serving pool — at NAMED crash points inside a tick, so
+# the restart battery can prove the durable snapshot + rehydration path
+# (core/durable.py) at every window a real kill -9 could hit:
+#
+#   after-observe                the world was polled; nothing actuated,
+#                                nothing journaled, nothing snapshotted
+#   after-decide                 the gate fired; the crash lands BEFORE
+#                                the scaler RPC (the write-ahead intent
+#                                is already durable)
+#   after-actuate-before-journal the scaler RPC landed; no journal line,
+#                                no snapshot — the classic double-scale
+#                                window, closed by the intent
+#   torn-mid-journal-line        the tick ran fully; the journal write
+#                                tore mid-line; the snapshot (which
+#                                follows the journal) never happened
+#   tick-boundary                everything durable landed; the kill
+#                                falls between ticks (the seamless case)
+#
+# Crashes raise ControllerCrash (a BaseException) so no never-dies guard
+# can swallow them — exactly like the process vanishing at that instant.
+# ---------------------------------------------------------------------------
+
+CRASH_AFTER_OBSERVE = "after-observe"
+CRASH_AFTER_DECIDE = "after-decide"
+CRASH_AFTER_ACTUATE = "after-actuate-before-journal"
+CRASH_TORN_JOURNAL = "torn-mid-journal-line"
+CRASH_TICK_BOUNDARY = "tick-boundary"
+CRASH_POINTS = (
+    CRASH_AFTER_OBSERVE,
+    CRASH_AFTER_DECIDE,
+    CRASH_AFTER_ACTUATE,
+    CRASH_TORN_JOURNAL,
+    CRASH_TICK_BOUNDARY,
+)
+
+
+@dataclass(frozen=True)
+class CrashPlan:
+    """Deterministic controller-kill schedule: ``(tick_index, point)``
+    pairs, tick indices counted across restarts (the driver's tick
+    *attempt* counter, 0-based).  Unknown points fail loudly — a plan
+    that kills nowhere gates nothing.
+
+    The mid-tick points are actuated by the wrappers below
+    (:class:`CrashingMetricSource` / :class:`CrashingScaler` /
+    :class:`CrashingJournal`); ``tick-boundary`` is the
+    :class:`~..fleet.pool.FleetDriver`'s own post-tick check.  Note the
+    actuation points only fire on ticks where a gate actually reaches
+    the scaler — schedule them on ticks the episode's backlog makes
+    fire, and assert the observed crash count.
+    """
+
+    crashes: tuple[tuple[int, str], ...]
+
+    def __post_init__(self):
+        for tick, point in self.crashes:
+            if tick < 0:
+                raise ValueError(f"crash tick must be >= 0, got {tick}")
+            if point not in CRASH_POINTS:
+                raise ValueError(
+                    f"unknown crash point {point!r} (valid: "
+                    f"{', '.join(CRASH_POINTS)})"
+                )
+
+    def point_at(self, tick: int) -> "str | None":
+        """The crash point scheduled for tick ``tick`` (None = none)."""
+        for at, point in self.crashes:
+            if at == tick:
+                return point
+        return None
+
+    def boundary_crash(self, tick: int) -> bool:
+        return self.point_at(tick) == CRASH_TICK_BOUNDARY
+
+
+class CrashingMetricSource:
+    """MetricSource proxy that kills the controller right AFTER a
+    successful observation on the scheduled tick (``tick_fn`` supplies
+    the driver's current tick-attempt index)."""
+
+    def __init__(self, inner, plan: CrashPlan, tick_fn) -> None:
+        self.inner = inner
+        self.plan = plan
+        self.tick_fn = tick_fn
+
+    def num_messages(self) -> int:
+        value = self.inner.num_messages()
+        if self.plan.point_at(self.tick_fn()) == CRASH_AFTER_OBSERVE:
+            from ..core.durable import ControllerCrash
+
+            raise ControllerCrash(
+                f"injected kill after observe (tick {self.tick_fn()})"
+            )
+        return value
+
+
+class CrashingScaler:
+    """Scaler proxy for the two actuation-adjacent crash points:
+    ``after-decide`` dies BEFORE the wrapped RPC (decision made, intent
+    durable, world untouched); ``after-actuate-before-journal`` dies
+    right after the RPC returns (world changed, nothing durable knows)."""
+
+    def __init__(self, inner, plan: CrashPlan, tick_fn) -> None:
+        self.inner = inner
+        self.plan = plan
+        self.tick_fn = tick_fn
+
+    @property
+    def replicas(self):
+        # pass through the observed-world surface (rehydration
+        # reconciles against it; stubs without one stay without one)
+        return getattr(self.inner, "replicas")
+
+    def _call(self, action, direction: str) -> None:
+        from ..core.durable import ControllerCrash
+
+        point = self.plan.point_at(self.tick_fn())
+        if point == CRASH_AFTER_DECIDE:
+            raise ControllerCrash(
+                f"injected kill after decide, before scale_{direction} "
+                f"(tick {self.tick_fn()})"
+            )
+        action()
+        if point == CRASH_AFTER_ACTUATE:
+            raise ControllerCrash(
+                f"injected kill after scale_{direction}, before journal "
+                f"(tick {self.tick_fn()})"
+            )
+
+    def scale_up(self) -> None:
+        self._call(self.inner.scale_up, "up")
+
+    def scale_down(self) -> None:
+        self._call(self.inner.scale_down, "down")
+
+
+class CrashingJournal:
+    """TickObserver proxy that TEARS the journal mid-line on the
+    scheduled tick — half the record's bytes, no newline — then kills
+    the controller.  The loop's observer guard catches ``Exception``
+    only, so the ControllerCrash propagates and the tick's snapshot
+    (which follows the journal observer) never happens: the restart
+    must heal the torn tail (the journal reader already tolerates it)
+    and recover the tick from nothing but the previous snapshot."""
+
+    def __init__(self, journal, plan: CrashPlan, tick_fn) -> None:
+        self.journal = journal
+        self.plan = plan
+        self.tick_fn = tick_fn
+
+    def on_tick(self, record) -> None:
+        if self.plan.point_at(self.tick_fn()) == CRASH_TORN_JOURNAL:
+            from ..core.durable import ControllerCrash
+
+            self.journal.tear(record)
+            raise ControllerCrash(
+                f"injected kill mid-journal-line (tick {self.tick_fn()})"
+            )
+        self.journal.on_tick(record)
+
+
+# ---------------------------------------------------------------------------
 # Injection wrappers: the simulator wires these around the REAL metric
 # source and scaler, so the system under test stays the production stack.
 # ---------------------------------------------------------------------------

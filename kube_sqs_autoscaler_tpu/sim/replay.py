@@ -452,6 +452,57 @@ def replay_journal(path: str, checkpoint: Any = None) -> ReplayResult:
     return replay(records, meta, checkpoint)
 
 
+def stitch_restart_episodes(path: str) -> list[dict[str, Any]]:
+    """Pair every post-crash episode with its pre-crash predecessor.
+
+    A controller rehydrating from a :class:`~..core.durable`
+    snapshot stamps its fresh journal header with a ``restart`` meta
+    block (snapshot content hash, recovered/expired record counts,
+    downtime) — see ``DurableStateStore.restart_journal_meta``.  This
+    walks the journal's episodes and returns one stitch per restart
+    header: which snapshot the new boot rose from, how much state
+    actually survived, and what the pre-crash episode looked like at
+    the moment it died (tick count, last successful actuations) — the
+    postmortem view of "did the state that mattered make it across".
+
+    Rotation continuations are not restarts and are skipped; episodes
+    without a ``restart`` block (pre-durability runs, cold starts onto
+    a fresh path) contribute nothing.
+    """
+    from ..obs.journal import read_journal_episodes
+
+    episodes = read_journal_episodes(path)
+    stitches: list[dict[str, Any]] = []
+    for index, (meta, records) in enumerate(episodes):
+        restart = meta.get("restart")
+        if not isinstance(restart, dict) or meta.get("_continuation"):
+            continue
+        # the pre-crash episode: the newest earlier non-continuation
+        # boot plus its trailing continuations
+        prior_records: list[TickRecord] = []
+        for prior_meta, prior in reversed(episodes[:index]):
+            prior_records = list(prior) + prior_records
+            if not prior_meta.get("_continuation"):
+                break
+        stitches.append({
+            "episode": index,
+            "snapshot_hash": restart.get("snapshot_hash"),
+            "records_recovered": restart.get("records_recovered"),
+            "records_expired": restart.get("records_expired"),
+            "cold_start": restart.get("cold_start"),
+            "downtime_s": restart.get("downtime_s"),
+            "prior_ticks": len(prior_records),
+            "prior_scaled_up": sum(
+                1 for r in prior_records if r.scaled("up")
+            ),
+            "prior_scaled_down": sum(
+                1 for r in prior_records if r.scaled("down")
+            ),
+            "post_ticks": len(records),
+        })
+    return stitches
+
+
 @dataclass(frozen=True)
 class RecordedArrival:
     """Piecewise-constant arrival process inferred from a journal.

@@ -1,11 +1,14 @@
 """Checkpoint/resume for the workload's training state (orbax-backed).
 
-The *controller* is deliberately stateless — its whole memory is two
-in-process cooldown timestamps, reset on restart, with desired replica
-state living in the cluster (reference behavior, SURVEY.md §5
-"checkpoint/resume: none").  The *workload* is where checkpointing belongs
-in a TPU shop: a preemptible queue-fed trainer must save and restore its
-sharded train state.  This module wraps orbax's PyTree checkpointing with
+The *controller*'s durable state lives in ``core/durable.py``: the
+cooldown stamps (once reset on every restart — the gap this comment
+used to document), breaker state, forecaster history, reply registry,
+and admission accounting all snapshot each tick and rehydrate at boot,
+with desired replica state still living in the cluster (kube-controller
+style: the observed world outranks the remembered one).  The *workload*
+side is where THIS module's checkpointing belongs in a TPU shop: a
+preemptible queue-fed trainer must save and restore its sharded train
+state.  This module wraps orbax's PyTree checkpointing with
 the two things our state needs:
 
 - restore **onto the mesh**: arrays come back placed with the same

@@ -1673,6 +1673,20 @@ class ContinuousBatcher:
         tenancy is off or ``prefix_pool == 0``)."""
         return self._prefix_pool
 
+    def export_tenant_homes(self) -> dict:
+        """Sticky-home assignments as durable state (core/durable.py);
+        see :func:`~.tenancy.export_tenant_homes`."""
+        from .tenancy import export_tenant_homes
+
+        return export_tenant_homes(self._tenant_home)
+
+    def import_tenant_homes(self, state: dict) -> int:
+        from .tenancy import import_tenant_homes
+
+        return import_tenant_homes(
+            self._tenant_home, state, shards=getattr(self, "shards", 1)
+        )
+
     def _route_prefixed(self, keys: list) -> list[int]:
         """Rows for a prefixed admission batch, one per pool key.  The
         single-plane batcher has nowhere to be sticky TO — admission
@@ -2411,6 +2425,46 @@ class ContinuousWorker:
         (the unlabeled ``requests_shed_total`` series — per-reason
         counts live in :attr:`shed_by_reason`)."""
         return sum(self.shed_by_reason.values())
+
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py): the worker's admission
+    # plane — DRR/EDF accounting + flood classification (FairAdmission),
+    # the overload ladder, and the sticky tenant→home-shard map.  Staged
+    # message CONTENTS never serialize (live receipt handles; the queue
+    # redelivers them), only the accounting that a crash must not reset.
+    # ------------------------------------------------------------------
+
+    def export_admission_state(self) -> dict:
+        state: dict = {"records": 0}
+        if self._fair is not None:
+            state["fair"] = self._fair.export_state()
+            state["records"] += state["fair"].get("records", 0)
+        if self.ladder is not None:
+            state["ladder"] = self.ladder.export_state()
+            state["records"] += state["ladder"].get("records", 0)
+        homes = self.batcher.export_tenant_homes()
+        if homes.get("records"):
+            state["homes"] = homes
+            state["records"] += homes["records"]
+        return state
+
+    def import_admission_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: "float | None" = None, max_age_s: float = 0.0,
+    ) -> int:
+        recovered = 0
+        fair = state.get("fair")
+        if self._fair is not None and isinstance(fair, dict):
+            recovered += self._fair.import_state(
+                fair, rebase=rebase, now=now, max_age_s=max_age_s
+            )
+        ladder = state.get("ladder")
+        if self.ladder is not None and isinstance(ladder, dict):
+            recovered += self.ladder.import_state(ladder)
+        homes = state.get("homes")
+        if isinstance(homes, dict):
+            recovered += self.batcher.import_tenant_homes(homes)
+        return recovered
 
     def _note_shed(self, reason: str) -> None:
         self.shed_by_reason[reason] += 1

@@ -478,6 +478,74 @@ class DeficitRoundRobin:
             self._deficit[tenant] = 0.0
         return item
 
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py StateProvider).  Staged
+    # QUEUES are deliberately NOT serialized: staged messages are live
+    # receipt handles that die with the process and redeliver through
+    # the queue's visibility timeout — for queue contents, a crash is
+    # the start of a new busy period.  The ACCOUNTING must survive,
+    # though: urgency debt and the credit token bucket are exactly what
+    # a drain-and-refill abuser re-arms by forcing a restart, and
+    # deficits in debt are loans a crash must not forgive.
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        tenants = {
+            t: {
+                "deficit": self._deficit.get(t, 0.0),
+                "credit": self._credit.get(t, self.urgency_budget),
+                "credit_round": self._credit_round.get(t, self._rounds),
+            }
+            for t in self._order
+        }
+        return {
+            "records": len(tenants),
+            "tenants": tenants,
+            "order": list(self._order),
+            "cursor": self._cursor,
+            "rounds": self._rounds,
+            "urgent_picks": self.urgent_picks,
+        }
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: "float | None" = None, max_age_s: float = 0.0,
+    ) -> int:
+        """Restore the scheduler's accounting (round clock, cursor,
+        per-tenant deficits and urgency credits) into empty sub-queues.
+        Tenants with nothing owed and a full bucket prune away on the
+        next pick, exactly as live drained tenants do."""
+        del rebase, now, max_age_s  # nothing here is clock-based
+        order = [t for t in state.get("order", ()) if isinstance(t, str)]
+        tenants = state.get("tenants") or {}
+        self._rounds = float(state.get("rounds", self._rounds) or 0.0)
+        recovered = 0
+        for tenant in order:
+            saved = tenants.get(tenant)
+            if not isinstance(saved, dict):
+                continue
+            if tenant not in self._queues:
+                self._queues[tenant] = deque()
+                self._order.append(tenant)
+            try:
+                self._deficit[tenant] = float(saved.get("deficit", 0.0))
+                self._credit[tenant] = min(
+                    self.urgency_budget,
+                    float(saved.get("credit", self.urgency_budget)),
+                )
+                self._credit_round[tenant] = min(
+                    self._rounds,
+                    float(saved.get("credit_round", self._rounds)),
+                )
+            except (TypeError, ValueError):
+                continue
+            recovered += 1
+        cursor = state.get("cursor")
+        if self._order and isinstance(cursor, int):
+            self._cursor = cursor % len(self._order)
+        self.urgent_picks = int(state.get("urgent_picks", 0) or 0)
+        return recovered
+
     def pick(self, k: int, *, fair: bool = True,
              now: "float | None" = None) -> list[tuple[str, Any]]:
         """Pop up to ``k`` ``(tenant, item)`` pairs by deficit order.
@@ -712,6 +780,12 @@ class FairAdmission:
         # everyone behind it — a classified tenant stays classified
         # until its staged queue actually drains
         self._flood_sticky: set[str] = set()
+        # restart grace: a rehydrated classification has NO staged
+        # backlog yet (staging dies with the process; the flood's
+        # messages are still redelivering), so restored sticky entries
+        # survive this many cycles without depth before the ordinary
+        # drains-means-done rule applies again (import_state arms it)
+        self._sticky_grace: dict[str, int] = {}
 
     def note_cycle(self) -> None:
         """Decay the arrival-rate EWMA one refill cycle (entries under
@@ -723,6 +797,10 @@ class FairAdmission:
             for tenant, rate in self.arrival_rate.items()
             if rate * decay >= self.ARRIVAL_FLOOR
         }
+        if self._sticky_grace:
+            self._sticky_grace = {
+                t: n - 1 for t, n in self._sticky_grace.items() if n > 1
+            }
 
     def over_share(self) -> frozenset:
         """Tenants whose decayed staged-arrival-rate share exceeds
@@ -750,7 +828,8 @@ class FairAdmission:
                     > self.OVER_SHARE_MARGIN * weights[tenant] * total
                 }
         self._flood_sticky = fresh | {
-            t for t in self._flood_sticky if self.drr.depth(t) > 0
+            t for t in self._flood_sticky
+            if self.drr.depth(t) > 0 or self._sticky_grace.get(t, 0) > 0
         }
         return frozenset(self._flood_sticky)
 
@@ -813,6 +892,72 @@ class FairAdmission:
         depths = {t: 0 for t in self.tenancy.tenants}
         depths.update(self.drr.depths())
         return depths
+
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py StateProvider): the flood
+    # classifier.  A crash used to UN-classify an active flooder — the
+    # restarted worker saw zero offered-rate history, so a coalition
+    # mid-attack got a fresh innocence window while its backlog drowned
+    # every victim behind it.  The decayed rates, the sticky set, and
+    # the seen-message-id dedup window all come back; the sticky set
+    # additionally survives the first post-restart over_share() calls
+    # via a redelivery grace (staged queues restart empty, and dropping
+    # classification before the flood's backlog redelivers would be the
+    # exact un-classify bug this section exists to fix).
+    # ------------------------------------------------------------------
+
+    #: post-restart cycles a restored sticky classification survives
+    #: without backlog (the visibility-timeout redelivery window)
+    STICKY_RESTORE_GRACE = 64
+
+    def export_state(self) -> dict:
+        state = {
+            "drr": self.drr.export_state(),
+            "arrival_rate": dict(self.arrival_rate),
+            "flood_sticky": sorted(self._flood_sticky),
+            "seen_ids": list(self._seen_ids),
+            "overflow_total": self.overflow_total,
+        }
+        state["records"] = (
+            state["drr"].get("records", 0)
+            + len(self.arrival_rate) + len(self._flood_sticky)
+        )
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: "float | None" = None, max_age_s: float = 0.0,
+    ) -> int:
+        recovered = 0
+        drr = state.get("drr")
+        if isinstance(drr, dict):
+            recovered += self.drr.import_state(
+                drr, rebase=rebase, now=now, max_age_s=max_age_s
+            )
+        rates = state.get("arrival_rate")
+        if isinstance(rates, dict):
+            for tenant, rate in rates.items():
+                try:
+                    rate = float(rate)
+                except (TypeError, ValueError):
+                    continue
+                if rate >= self.ARRIVAL_FLOOR:
+                    self.arrival_rate[str(tenant)] = rate
+                    recovered += 1
+        sticky = state.get("flood_sticky") or ()
+        restored_sticky = {str(t) for t in sticky}
+        if restored_sticky:
+            self._flood_sticky |= restored_sticky
+            self._sticky_grace = {
+                t: self.STICKY_RESTORE_GRACE for t in restored_sticky
+            }
+            recovered += len(restored_sticky)
+        for mid in state.get("seen_ids") or ():
+            self._seen_ids[str(mid)] = True
+            while len(self._seen_ids) > self.SEEN_IDS:
+                self._seen_ids.popitem(last=False)
+        self.overflow_total = int(state.get("overflow_total", 0) or 0)
+        return recovered
 
 
 #: Per-tier (enter, exit) pressure thresholds — enter at or above the
@@ -936,6 +1081,80 @@ class OverloadLadder:
         from ..obs.trace import instant_trace_events
 
         return instant_trace_events(self.events, time_origin)
+
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py StateProvider): a crash
+    # used to reset the ladder to tier 0 — a controller that died UNDER
+    # overload came back serving the same overload at full budgets for
+    # the whole EWMA warm-up, the exact moment shedding mattered.
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        return {
+            "records": 1,
+            "tier": self.tier,
+            "ewma": self._ewma,
+            "last_pressure": self.last_pressure,
+            "transitions": self.transitions,
+            "entered_total": list(self.entered_total),
+        }
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: "float | None" = None, max_age_s: float = 0.0,
+    ) -> int:
+        del rebase, now, max_age_s  # pressure is cycle-based, not clocked
+        tier = state.get("tier")
+        if not isinstance(tier, int) or not 0 <= tier <= self.tiers:
+            return 0
+        self.tier = tier
+        ewma = state.get("ewma")
+        self._ewma = float(ewma) if ewma is not None else None
+        self.last_pressure = float(state.get("last_pressure", 0.0) or 0.0)
+        self.transitions = int(state.get("transitions", 0) or 0)
+        entered = state.get("entered_total")
+        if isinstance(entered, list) and len(entered) == len(self.entered_total):
+            self.entered_total = [int(n) for n in entered]
+        return 1
+
+
+def export_tenant_homes(homes) -> dict:
+    """Sticky-home map → JSON-able state (``core/durable.py``): the
+    ``(tenant, prefix-crc32)`` → home-shard assignments, LRU order
+    preserved.  Losing these on restart sent every tenant through a
+    fresh freest-first assignment — re-installing (and LRU-thrashing)
+    its prefix on whatever shard happened to be free, the exact scatter
+    sticky routing exists to prevent."""
+    return {
+        "records": len(homes),
+        "homes": [
+            [tenant, int(crc), int(shard)]
+            for (tenant, crc), shard in homes.items()
+        ],
+    }
+
+
+def import_tenant_homes(homes, state: dict, *, shards: int,
+                        limit: int = 4096) -> int:
+    """Inverse of :func:`export_tenant_homes` into a live OrderedDict;
+    assignments pointing past the new plane's shard count are dropped
+    (trust the observed world: a smaller restart plane has no shard to
+    go home to)."""
+    recovered = 0
+    for entry in state.get("homes") or ():
+        try:
+            tenant, crc, shard = entry
+            tenant, crc, shard = str(tenant), int(crc), int(shard)
+        except (TypeError, ValueError):
+            continue
+        if not 0 <= shard < shards:
+            continue
+        homes[(tenant, crc)] = shard
+        homes.move_to_end((tenant, crc))
+        recovered += 1
+        while len(homes) > limit:
+            homes.popitem(last=False)
+    return recovered
 
 
 def prefix_pool_key(tenant: str, prefix_ids) -> tuple[str, int]:

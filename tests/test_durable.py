@@ -1,0 +1,1012 @@
+"""Durable control-plane state (ISSUE 14, ``core/durable.py``).
+
+Covers the snapshot store's crash-safety edges (torn/corrupt/future-
+schema fallback-to-cold, wall-clock TTL expiry, atomic replace), the
+time-rebasing arithmetic across a monotonic-clock reset, the write-ahead
+actuation intent, every subsystem's export/import round trip (reply
+registry bitwise, resilience/breaker, forecaster ring, DRR/EDF
+accounting, flood classifier, overload ladder, sticky homes, learned
+mirror), the loop integration (snapshot-per-tick, byte-identity with
+durability off, crash points), journal restart-header stitching, the
+/healthz rehydrating state, and the restart bench smoke.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+from kube_sqs_autoscaler_tpu.core.durable import (
+    SNAPSHOT_SCHEMA_VERSION,
+    ControllerCrash,
+    DurableStateStore,
+    _content_hash,
+)
+from kube_sqs_autoscaler_tpu.core.events import TickRecord
+from kube_sqs_autoscaler_tpu.core.loop import ControlLoop, LoopConfig
+from kube_sqs_autoscaler_tpu.core.policy import Gate, PolicyConfig, PolicyState
+from kube_sqs_autoscaler_tpu.core.resilience import (
+    ResilienceConfig,
+    ResiliencePolicy,
+)
+from kube_sqs_autoscaler_tpu.forecast.history import DepthHistory
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeQueueService
+from kube_sqs_autoscaler_tpu.metrics.queue import QueueMetricSource
+from kube_sqs_autoscaler_tpu.scale.actuator import PodAutoScaler
+from kube_sqs_autoscaler_tpu.scale.fake import FakeDeploymentAPI
+from kube_sqs_autoscaler_tpu.sim.faults import (
+    CRASH_AFTER_ACTUATE,
+    CRASH_AFTER_DECIDE,
+    CRASH_AFTER_OBSERVE,
+    CRASH_POINTS,
+    CRASH_TORN_JOURNAL,
+    CrashingJournal,
+    CrashingMetricSource,
+    CrashingScaler,
+    CrashPlan,
+)
+
+
+def _store(path, clock, **kwargs) -> DurableStateStore:
+    return DurableStateStore(str(path), wall_clock=clock.now, **kwargs)
+
+
+class _DictProvider:
+    """Minimal StateProvider for store-level tests."""
+
+    def __init__(self, payload=None, records=1):
+        self.payload = dict(payload or {})
+        self.records = records
+        self.imported = None
+        self.import_kwargs = None
+
+    def export_state(self):
+        return {"records": self.records, **self.payload}
+
+    def import_state(self, state, *, rebase=0.0, now=None, max_age_s=0.0):
+        self.imported = dict(state)
+        self.import_kwargs = {
+            "rebase": rebase, "now": now, "max_age_s": max_age_s
+        }
+        return int(state.get("records", 0))
+
+
+# ---------------------------------------------------------------------------
+# Store crash-safety edges
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_round_trip_warm(tmp_path):
+    clock = FakeClock(100.0)
+    store = _store(tmp_path / "s.json", clock)
+    provider = _DictProvider({"x": 7}, records=3)
+    store.register("sec", provider)
+    store.snapshot(
+        clock_now=clock.now(),
+        policy_state=PolicyState(last_scale_up=90.0, last_scale_down=40.0),
+    )
+    assert store.snapshots_written == 1
+    assert store.snapshot_hash
+    assert not os.path.exists(str(tmp_path / "s.json") + ".tmp")
+
+    clock.advance(25.0)  # downtime on the shared wall clock
+    boot2 = _store(tmp_path / "s.json", clock)
+    p2 = _DictProvider()
+    boot2.register("sec", p2)
+    report = boot2.rehydrate(clock.now())
+    assert not report.cold_start
+    assert report.records_recovered == 3
+    assert report.records_expired == 0
+    assert report.snapshot_age_s == pytest.approx(25.0)
+    assert report.restarts == 1
+    assert p2.imported["x"] == 7
+    # shared continuing clock: zero rebase, stamps stay absolute
+    assert p2.import_kwargs["rebase"] == pytest.approx(0.0)
+    state = boot2.restored_policy_state()
+    assert state == PolicyState(last_scale_up=90.0, last_scale_down=40.0)
+    # memoized: one boot rehydrates once
+    assert boot2.rehydrate(clock.now()) is report
+
+
+def test_rebase_across_monotonic_reset(tmp_path):
+    # boot 1 runs on a clock at 500; boot 2's monotonic clock restarts
+    # at 3 — only the shared wall clock knows 20s of downtime passed
+    wall = FakeClock(1000.0)
+    store = DurableStateStore(str(tmp_path / "s.json"), wall_clock=wall.now)
+    history = DepthHistory(capacity=8)
+    history.observe(490.0, 50.0)
+    history.observe(495.0, 60.0)
+    store.register("hist", history)
+    store.snapshot(
+        clock_now=500.0,
+        policy_state=PolicyState(last_scale_up=480.0, last_scale_down=470.0),
+    )
+    wall.advance(20.0)  # the pod was down 20 wall seconds
+    boot2 = DurableStateStore(str(tmp_path / "s.json"), wall_clock=wall.now)
+    h2 = DepthHistory(capacity=8)
+    boot2.register("hist", h2)
+    boot2.rehydrate(3.0)  # fresh monotonic clock
+    # rebase = (3 - 20) - 500 = -517: t=480 -> -37 (37s before "now - 20s
+    # ago" ... i.e. the stamp is 20 + (500-480) = 40s in the past)
+    state = boot2.restored_policy_state()
+    assert state.last_scale_up == pytest.approx(3.0 - 20.0 - 20.0)
+    assert state.last_scale_down == pytest.approx(3.0 - 20.0 - 30.0)
+    times, depths, n = h2.snapshot()
+    assert n == 2
+    # the newest sample was 5s old at save + 20s downtime = 25s old
+    assert times[1] == pytest.approx(3.0 - 25.0)
+    assert depths[1] == pytest.approx(60.0)
+
+
+@pytest.mark.parametrize("corruption", [
+    "torn", "not-json", "wrong-kind", "future-schema", "hash-mismatch",
+])
+def test_refusals_cold_start_never_raise(tmp_path, corruption):
+    clock = FakeClock(10.0)
+    path = tmp_path / "s.json"
+    store = _store(path, clock)
+    store.snapshot(clock_now=10.0,
+                   policy_state=PolicyState(5.0, 5.0))
+    raw = path.read_text()
+    if corruption == "torn":
+        path.write_text(raw[: len(raw) // 2])
+    elif corruption == "not-json":
+        path.write_text("!!not json!!")
+    elif corruption == "wrong-kind":
+        path.write_text('{"kind": "something-else", "schema": 1}')
+    elif corruption == "future-schema":
+        body = json.loads(raw)
+        body["schema"] = SNAPSHOT_SCHEMA_VERSION + 3
+        body["hash"] = _content_hash(body)
+        path.write_text(json.dumps(body))
+    elif corruption == "hash-mismatch":
+        body = json.loads(raw)
+        body["policy"]["last_scale_up"] = 999.0  # tampered, hash stale
+        path.write_text(json.dumps(body))
+    boot2 = _store(path, clock)
+    report = boot2.rehydrate(clock.now())
+    assert report.cold_start
+    assert report.reason  # every refusal names itself
+    assert boot2.restored_policy_state() is None
+    # a refused file still counts the restart (the pod DID come back)
+    assert report.restarts == 1
+
+
+def test_refused_snapshot_still_counts_the_restart_chain(tmp_path):
+    # a corrupt predecessor must not reset restart monotonicity: the
+    # cold boot's own snapshots carry restarts=1, so the NEXT restart
+    # reports #2, not #1 again
+    clock = FakeClock(0.0)
+    path = tmp_path / "s.json"
+    path.write_text("!!corrupt!!")
+    boot = _store(path, clock)
+    assert boot.rehydrate(clock.now()).restarts == 1
+    boot.snapshot(clock_now=0.0, policy_state=PolicyState(0.0, 0.0))
+    boot2 = _store(path, clock)
+    assert boot2.rehydrate(clock.now()).restarts == 2
+
+
+def test_second_episode_gets_fresh_grace_not_restored_stamps(tmp_path):
+    # run() -> stop -> run() on a durable loop: the restored stamps
+    # belong to the FIRST post-boot episode only; a second episode is
+    # fresh (reference startup grace), per run()'s contract
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.snapshot(clock_now=0.0,
+                   policy_state=PolicyState(-100.0, -100.0))
+    clock.advance(5.0)
+    boot2 = _store(tmp_path / "s.json", clock)
+    loop, _, api = _scripted_loop(tmp_path, clock, durable=False)
+    loop.durable = boot2
+    first = loop.initial_policy_state()
+    assert first == PolicyState(-100.0, -100.0)  # restored, expired stamps
+    second = loop.initial_policy_state()
+    assert second == PolicyState(clock.now(), clock.now())  # fresh grace
+
+
+def test_missing_snapshot_is_silent_cold_start(tmp_path):
+    clock = FakeClock()
+    store = _store(tmp_path / "absent.json", clock)
+    report = store.rehydrate(clock.now())
+    assert report.cold_start
+    assert report.reason is None
+    assert report.restarts == 0
+
+
+def test_whole_snapshot_max_age_cold_start(tmp_path):
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock, max_age_s=60.0)
+    store.snapshot(clock_now=0.0, policy_state=PolicyState(0.0, 0.0))
+    clock.advance(61.0)
+    boot2 = _store(tmp_path / "s.json", clock, max_age_s=60.0)
+    report = boot2.rehydrate(clock.now())
+    assert report.cold_start
+    assert "old" in report.reason
+
+
+def test_snapshot_older_than_every_section_ttl_expires_everything(tmp_path):
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.register("a", _DictProvider(records=4), ttl_s=30.0)
+    store.register("b", _DictProvider(records=2), ttl_s=50.0)
+    store.snapshot(clock_now=0.0, policy_state=PolicyState(0.0, 0.0))
+    clock.advance(120.0)  # past BOTH TTLs
+    boot2 = _store(tmp_path / "s.json", clock)
+    pa, pb = _DictProvider(), _DictProvider()
+    boot2.register("a", pa, ttl_s=30.0)
+    boot2.register("b", pb, ttl_s=50.0)
+    report = boot2.rehydrate(clock.now())
+    assert not report.cold_start  # the snapshot itself is fine
+    assert report.records_recovered == 0
+    assert report.records_expired == 6
+    assert sorted(report.sections_expired) == ["a", "b"]
+    assert pa.imported is None and pb.imported is None
+    # ... and the cooldown stamps still rebased (they expire through the
+    # ordinary gate arithmetic, not a TTL)
+    assert boot2.restored_policy_state() is not None
+
+
+def test_broken_exporter_does_not_kill_snapshot(tmp_path):
+    class Broken:
+        def export_state(self):
+            raise RuntimeError("boom")
+
+    clock = FakeClock(5.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.register("broken", Broken())
+    store.register("ok", _DictProvider(records=1))
+    store.snapshot(clock_now=5.0, policy_state=PolicyState(1.0, 1.0))
+    boot2 = _store(tmp_path / "s.json", clock)
+    ok = _DictProvider()
+    boot2.register("ok", ok)
+    report = boot2.rehydrate(clock.now())
+    assert not report.cold_start
+    assert ok.imported is not None
+
+
+def test_duplicate_section_and_bad_ttl_rejected(tmp_path):
+    clock = FakeClock()
+    store = _store(tmp_path / "s.json", clock)
+    store.register("a", _DictProvider())
+    with pytest.raises(ValueError):
+        store.register("a", _DictProvider())
+    with pytest.raises(ValueError):
+        store.register("b", _DictProvider(), ttl_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead actuation intent
+# ---------------------------------------------------------------------------
+
+
+def test_unresolved_intent_advances_stamp(tmp_path):
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock)
+    clock.advance(50.0)
+    store.snapshot(clock_now=50.0, policy_state=PolicyState(30.0, 10.0))
+    clock.advance(5.0)  # the crashed tick ran at t=55
+    store.note_intent("up", 55.0)
+    clock.advance(10.0)  # downtime
+    boot2 = _store(tmp_path / "s.json", clock)
+    report = boot2.rehydrate(clock.now())
+    assert report.intent_applied == "up"
+    state = boot2.restored_policy_state()
+    assert state.last_scale_up == pytest.approx(55.0)  # advanced
+    assert state.last_scale_down == pytest.approx(10.0)  # untouched
+    # NOT consumed yet: the advanced stamp is only in memory until this
+    # boot's first snapshot — a second crash before that must find the
+    # intent again (double-crash window)
+    assert os.path.exists(store.intent_path)
+    clock.advance(1.0)
+    boot2.snapshot(clock_now=clock.now(), policy_state=state)
+    assert not os.path.exists(store.intent_path)  # now covered
+
+
+def test_intent_survives_a_double_crash(tmp_path):
+    # boot 1 actuates at t=55 and dies with only the intent as
+    # evidence; boot 2 rehydrates but dies BEFORE its first snapshot;
+    # boot 3 must still see the intent and keep the stamp at 55
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock)
+    clock.advance(50.0)
+    store.snapshot(clock_now=50.0, policy_state=PolicyState(30.0, 10.0))
+    clock.advance(5.0)
+    store.note_intent("up", 55.0)
+    clock.advance(10.0)
+    boot2 = _store(tmp_path / "s.json", clock)
+    assert boot2.rehydrate(clock.now()).intent_applied == "up"
+    # boot 2 dies here: no tick, no snapshot
+    clock.advance(10.0)
+    boot3 = _store(tmp_path / "s.json", clock)
+    assert boot3.rehydrate(clock.now()).intent_applied == "up"
+    assert boot3.restored_policy_state().last_scale_up == pytest.approx(55.0)
+
+
+def test_snapshot_clears_intent(tmp_path):
+    clock = FakeClock(20.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.note_intent("down", 20.0)
+    assert os.path.exists(store.intent_path)
+    clock.advance(1.0)
+    store.snapshot(clock_now=21.0, policy_state=PolicyState(21.0, 21.0))
+    assert not os.path.exists(store.intent_path)
+
+
+def test_stale_intent_ignored(tmp_path):
+    # an intent OLDER than the snapshot was resolved by it; a leftover
+    # file (failed remove) must not advance anything
+    clock = FakeClock(0.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.note_intent("up", 5.0)  # wall 0
+    clock.advance(30.0)
+    # snapshot at wall 30 — strictly newer than the intent's wall 0
+    body_state = PolicyState(8.0, 8.0)
+    store.snapshot(clock_now=30.0, policy_state=body_state)
+    # resurrect a stale intent file bitwise (snapshot removed it)
+    with open(store.intent_path, "w") as fh:
+        json.dump({"kind": "actuation-intent", "direction": "up",
+                   "clock": 5.0, "wall": 0.0}, fh)
+    boot2 = _store(tmp_path / "s.json", clock)
+    report = boot2.rehydrate(clock.now())
+    assert report.intent_applied is None
+    assert boot2.restored_policy_state() == body_state
+
+
+def test_intent_rejects_bad_direction(tmp_path):
+    store = _store(tmp_path / "s.json", FakeClock())
+    with pytest.raises(ValueError):
+        store.note_intent("sideways", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Subsystem providers
+# ---------------------------------------------------------------------------
+
+
+def test_reply_registry_round_trip_bitwise():
+    from kube_sqs_autoscaler_tpu.fleet.pool import FleetPoolBase
+
+    a = FleetPoolBase(clock=FakeClock(), replied_capacity=8)
+    for i in range(12):  # overflow the bound: 4 oldest evicted
+        a.mark_replied(f"req-{i}")
+    a.note_duplicate("req-11")
+    exported = a.export_state()
+    assert exported["records"] == 8
+
+    b = FleetPoolBase(clock=FakeClock(), replied_capacity=8)
+    assert b.import_state(exported) == 8
+    assert b.export_state() == exported  # bitwise
+
+    # continuation equivalence: adding the same new ids to both yields
+    # the same membership and eviction state as never having restarted
+    for pool in (a, b):
+        for i in range(12, 15):
+            pool.mark_replied(f"req-{i}")
+    assert a.export_state() == b.export_state()
+    assert not b.already_replied("req-6")  # evicted on both
+    assert b.already_replied("req-14")
+
+
+def test_resilience_provider_round_trip_and_breaker_rebase():
+    clock = FakeClock(100.0)
+    config = ResilienceConfig(breaker_failures=2, breaker_reset=40.0,
+                              stale_depth_ttl=30.0)
+    policy = ResiliencePolicy(config, clock, poll_interval=5.0)
+    policy._last_good = (95.0, 123)
+    policy.breaker.record_failure(90.0)
+    policy.breaker.record_failure(96.0)  # opens at 96
+    assert policy.breaker_state == "open"
+    exported = policy.export_state()
+    assert exported["records"] == 2
+
+    clock2 = FakeClock(7.0)  # monotonic reset; 10s downtime -> rebase
+    restored = ResiliencePolicy(config, clock2, poll_interval=5.0)
+    rebase = (7.0 - 10.0) - 100.0
+    assert restored.import_state(exported, rebase=rebase, now=7.0) == 2
+    assert restored.breaker_state == "open"
+    # opened 4s before save + 10s downtime = 14s ago; reset 40 -> probe
+    # in 26s on the new clock
+    assert restored.breaker.seconds_until_probe(7.0) == pytest.approx(26.0)
+    held = restored.stale_depth(7.0)
+    assert held is not None
+    depth, age = held
+    assert depth == 123
+    assert age == pytest.approx(15.0)  # 5s old at save + 10s downtime
+    # ... and past the TTL it expires through the ordinary check
+    assert restored.stale_depth(7.0 + 16.0) is None
+
+
+def test_resilience_refuses_open_breaker_without_timestamp():
+    clock = FakeClock()
+    config = ResilienceConfig(breaker_failures=2)
+    policy = ResiliencePolicy(config, clock, poll_interval=5.0)
+    restored = policy.import_state(
+        {"breaker": {"state": "open", "failures": 3, "opened_at": None}}
+    )
+    assert restored == 0
+    assert policy.breaker_state == "closed"
+
+
+def test_history_provider_max_age_drops_stale_samples():
+    h = DepthHistory(capacity=8)
+    h.observe(10.0, 1.0)
+    h.observe(50.0, 2.0)
+    exported = h.export_state()
+    h2 = DepthHistory(capacity=8)
+    # now=100, max_age 60: the t=10 sample is 90s old -> dropped
+    assert h2.import_state(exported, rebase=0.0, now=100.0,
+                           max_age_s=60.0) == 1
+    times, depths, n = h2.snapshot()
+    assert n == 1 and depths[0] == 2.0
+
+
+def test_drr_accounting_round_trip():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import DeficitRoundRobin
+
+    drr = DeficitRoundRobin(weight_of=lambda t: 2.0, quantum=1.0,
+                            keep=("a", "b"), urgency_window_s=1.0,
+                            urgency_budget=3.0)
+    for i in range(5):
+        drr.push("a", f"a{i}", deadline=0.5)
+        drr.push("b", f"b{i}")
+    drr.pick(3, now=0.0)  # spends credit + deficit
+    exported = drr.export_state()
+    assert exported["records"] >= 2
+
+    drr2 = DeficitRoundRobin(weight_of=lambda t: 2.0, quantum=1.0,
+                             keep=("a", "b"), urgency_window_s=1.0,
+                             urgency_budget=3.0)
+    assert drr2.import_state(exported) >= 2
+    for t in ("a", "b"):
+        assert drr2.deficit(t) == pytest.approx(drr.deficit(t))
+        assert drr2._credit[t] == pytest.approx(drr._credit[t])
+    assert drr2._cursor == drr._cursor
+    assert drr2._rounds == pytest.approx(drr._rounds)
+    # the restored scheduler picks identically on identical new streams
+    for d in (drr, drr2):
+        # fresh staged work (the old queues died with the process)
+        for q in d._queues.values():
+            q.clear()
+        for i in range(4):
+            d.push("a", f"na{i}")
+            d.push("b", f"nb{i}")
+    assert ([t for t, _ in drr.pick(4)]
+            == [t for t, _ in drr2.pick(4)])
+
+
+def test_fair_admission_flood_classification_survives_restart():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import (
+        FairAdmission,
+        TenancyConfig,
+    )
+
+    tenancy = TenancyConfig(tenants=("flood", "victim"))
+    fair = FairAdmission(tenancy, per_tenant_limit=8, total_limit=16)
+    # a sustained flood: high unique-id offered rate
+    for i in range(30):
+        fair.stage("flood", f"item{i}", message_id=f"m{i}")
+    fair.stage("victim", "v0", message_id="v0")
+    assert "flood" in fair.over_share()
+    exported = fair.export_state()
+
+    restarted = FairAdmission(tenancy, per_tenant_limit=8, total_limit=16)
+    assert restarted.import_state(exported) > 0
+    # staging is EMPTY after restart (receipt handles died with the
+    # process) — the restored classification must survive the
+    # redelivery window regardless
+    assert "flood" in restarted.over_share()
+    # redelivered copies of already-counted messages are still deduped
+    restarted._note_offered("flood", "m3")
+    assert restarted.arrival_rate["flood"] == pytest.approx(
+        fair.arrival_rate["flood"]
+    )
+    # the grace decays; with no backlog and a decayed rate the
+    # classification eventually drops, exactly like a live drain
+    for _ in range(restarted.STICKY_RESTORE_GRACE + 1):
+        restarted.note_cycle()
+    assert "flood" not in restarted.over_share()
+
+
+def test_overload_ladder_round_trip():
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import OverloadLadder
+
+    ladder = OverloadLadder(3)
+    for pressure in (0.6, 0.8, 0.95, 0.97):
+        ladder.update(pressure, now=0.0)
+    assert ladder.tier >= 2
+    exported = ladder.export_state()
+    restored = OverloadLadder(3)
+    assert restored.import_state(exported) == 1
+    assert restored.tier == ladder.tier
+    assert restored._ewma == pytest.approx(ladder._ewma)
+    assert restored.entered_total == ladder.entered_total
+    # hysteresis continues from the restored EWMA, not from scratch
+    assert restored.update(ladder.last_pressure, now=0.0) == ladder.tier
+
+
+def test_tenant_homes_round_trip_drops_out_of_range_shards():
+    from collections import OrderedDict
+
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import (
+        export_tenant_homes,
+        import_tenant_homes,
+    )
+
+    homes = OrderedDict()
+    homes[("acme", 123)] = 1
+    homes[("globex", 456)] = 3
+    exported = export_tenant_homes(homes)
+    assert exported["records"] == 2
+
+    restored = OrderedDict()
+    # the restarted plane has only 2 shards: globex's home is gone
+    assert import_tenant_homes(restored, exported, shards=2) == 1
+    assert restored == OrderedDict({("acme", 123): 1})
+
+
+def test_learned_mirror_round_trip_and_reconcile(tmp_path):
+    pytest.importorskip("jax")
+    from kube_sqs_autoscaler_tpu.learn.checkpoint import PolicyCheckpoint
+    from kube_sqs_autoscaler_tpu.learn.network import param_count
+    from kube_sqs_autoscaler_tpu.learn.policy import LearnedPolicy
+
+    import numpy as np
+
+    theta = np.zeros(param_count(8), dtype=np.float32)
+    checkpoint = PolicyCheckpoint(theta=theta, hidden=8)
+    policy_config = PolicyConfig()
+
+    def make():
+        return LearnedPolicy(
+            checkpoint, policy=policy_config, poll_interval=5.0,
+            max_pods=10, min_pods=1, initial_replicas=1,
+        )
+
+    a = make()
+    a.replicas = 4
+    a._last_up, a._last_down = 80.0, 60.0
+    a.history.observe(70.0, 11.0)
+    exported = a.export_state()
+
+    b = make()
+    assert b.import_state(exported, rebase=-10.0, now=90.0) >= 1
+    assert b.replicas == 4
+    assert b._last_up == pytest.approx(70.0)
+    assert len(b.history) == 1
+    # the observed world outranks the remembered trajectory
+    b.reconcile_observed(2)
+    assert b.replicas == 2
+    b.reconcile_observed(99)
+    assert b.replicas == 10  # clamped to max_pods
+
+    # foreign weights: refuse the whole mirror
+    other = PolicyCheckpoint(
+        theta=np.ones(param_count(8), dtype=np.float32), hidden=8
+    )
+    c = LearnedPolicy(
+        other, policy=policy_config, poll_interval=5.0,
+        max_pods=10, min_pods=1, initial_replicas=1,
+    )
+    assert c.import_state(exported) == 0
+    assert c.replicas == 1
+
+
+# ---------------------------------------------------------------------------
+# Loop integration
+# ---------------------------------------------------------------------------
+
+
+class _Collector:
+    def __init__(self):
+        self.records = []
+
+    def on_tick(self, record):
+        self.records.append(record.to_dict())
+
+
+def _scripted_loop(tmp_path, clock, *, durable, collector=None,
+                   depth=5000, suffix="s", api=None, queue=None):
+    if api is None:
+        api = FakeDeploymentAPI.with_deployments("default", 1, "workers")
+    if queue is None:
+        queue = FakeQueueService.with_depths(depth)
+    store = None
+    if durable:
+        store = DurableStateStore(
+            str(tmp_path / f"{suffix}.json"), wall_clock=clock.now
+        )
+    loop = ControlLoop(
+        PodAutoScaler(client=api, max=10, min=1, scale_up_pods=1,
+                      scale_down_pods=1, deployment="workers",
+                      namespace="default"),
+        QueueMetricSource(queue, "q://x",
+                          ("ApproximateNumberOfMessages",)),
+        LoopConfig(poll_interval=5.0, policy=PolicyConfig(
+            scale_up_messages=100, scale_down_messages=-1,
+            scale_up_cooldown=30.0, scale_down_cooldown=60.0,
+        )),
+        clock=clock,
+        observer=collector,
+        durable=store,
+    )
+    return loop, store, api
+
+
+def test_loop_byte_identity_with_durability_off(tmp_path):
+    runs = {}
+    for durable in (False, True):
+        clock = FakeClock()
+        collector = _Collector()
+        loop, _, _ = _scripted_loop(
+            tmp_path, clock, durable=durable, collector=collector,
+            suffix=f"ident-{durable}",
+        )
+        state = loop.initial_policy_state()
+        for _ in range(10):
+            clock.advance(5.0)
+            state = loop.tick(state)
+        runs[durable] = collector.records
+    assert runs[True] == runs[False]
+
+
+def test_loop_snapshots_every_tick_and_warm_restart(tmp_path):
+    clock = FakeClock()
+    loop, store, api = _scripted_loop(tmp_path, clock, durable=True)
+    state = loop.initial_policy_state()
+    for _ in range(7):  # ticks 5..35: fires at t=30 (grace end)
+        clock.advance(5.0)
+        state = loop.tick(state)
+    assert store.snapshots_written == 7
+    assert api.replicas("workers") == 2
+
+    clock.advance(13.0)  # downtime
+    loop2, store2, _ = _scripted_loop(tmp_path, clock, durable=True,
+                                      api=api)
+    state2 = loop2.initial_policy_state()
+    assert not store2.last_report.cold_start
+    # the restored stamp (t=30) cools the up gate until t=60: the tick
+    # at t=53 must NOT fire despite the huge backlog
+    clock.advance(5.0)  # t=53
+    state2 = loop2.tick(state2)
+    assert api.replicas("workers") == 2
+    clock.advance(7.0)  # t=60: boundary fires
+    loop2.tick(state2)
+    assert api.replicas("workers") == 3
+
+
+def test_crash_skips_observer_journal_and_snapshot(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.journal import (
+        TickJournal,
+        read_journal_episodes,
+    )
+
+    clock = FakeClock()
+    collector = _Collector()
+    loop, store, api = _scripted_loop(
+        tmp_path, clock, durable=True, collector=collector
+    )
+    plan = CrashPlan(crashes=((2, CRASH_AFTER_OBSERVE),))
+    tick = {"i": -1}
+    loop.metric_source = CrashingMetricSource(
+        loop.metric_source, plan, lambda: tick["i"]
+    )
+    state = loop.initial_policy_state()
+    for i in range(3):
+        clock.advance(5.0)
+        tick["i"] = i
+        if i == 2:
+            with pytest.raises(ControllerCrash):
+                loop.tick(state)
+        else:
+            state = loop.tick(state)
+    assert len(collector.records) == 2  # the crashed tick left nothing
+    assert store.snapshots_written == 2
+
+    # torn journal: the tick's record tears mid-line, the snapshot that
+    # would follow never happens, and the next boot heals the tail
+    journal = TickJournal(str(tmp_path / "j.jsonl"), meta={"m": 1})
+    plan2 = CrashPlan(crashes=((0, CRASH_TORN_JOURNAL),))
+    crasher = CrashingJournal(journal, plan2, lambda: 0)
+    record = TickRecord(start=1.0, num_messages=5, up=Gate.IDLE,
+                        down=Gate.IDLE)
+    with pytest.raises(ControllerCrash):
+        crasher.on_tick(record)
+    journal.close()
+    journal2 = TickJournal(str(tmp_path / "j.jsonl"), meta={"m": 2})
+    journal2.on_tick(record)
+    journal2.close()
+    episodes = read_journal_episodes(str(tmp_path / "j.jsonl"))
+    assert len(episodes) == 2  # torn fragment healed, both headers live
+    assert len(episodes[1][1]) == 1
+
+
+@pytest.mark.parametrize("point", [
+    CRASH_AFTER_DECIDE, CRASH_AFTER_ACTUATE,
+])
+def test_actuation_crash_points_never_double_scale(tmp_path, point):
+    clock = FakeClock()
+    loop, store, api = _scripted_loop(tmp_path, clock, durable=True)
+    plan = CrashPlan(crashes=((11, point),))  # t=60, a firing tick
+    tick = {"i": -1}
+    loop.scaler = CrashingScaler(loop.scaler, plan, lambda: tick["i"])
+    scale_times = []
+    real_update = api.update
+
+    def tracked(deployment):
+        scale_times.append(clock.now())
+        return real_update(deployment)
+
+    api.update = tracked
+    state = loop.initial_policy_state()
+    crashed = False
+    for i in range(20):
+        clock.advance(5.0)
+        tick["i"] = i
+        try:
+            state = loop.tick(state)
+        except ControllerCrash:
+            crashed = True
+            clock.advance(7.0)
+            # the restarted boot actuates the SAME world (same recorder)
+            loop, store, _api2 = _scripted_loop(tmp_path, clock,
+                                                durable=True, api=api)
+            state = loop.initial_policy_state()
+    assert crashed
+    gaps = [b - a for a, b in zip(scale_times, scale_times[1:])]
+    assert all(g >= 30.0 - 1e-9 for g in gaps), gaps
+    if point == CRASH_AFTER_ACTUATE:
+        assert 60.0 in scale_times  # the crash tick really actuated
+    else:
+        assert 60.0 not in scale_times  # after-decide dies before it
+
+
+def test_crash_plan_validation():
+    with pytest.raises(ValueError):
+        CrashPlan(crashes=((0, "nonsense"),))
+    with pytest.raises(ValueError):
+        CrashPlan(crashes=((-1, CRASH_AFTER_OBSERVE),))
+    plan = CrashPlan(crashes=((3, CRASH_AFTER_OBSERVE),))
+    assert plan.point_at(3) == CRASH_AFTER_OBSERVE
+    assert plan.point_at(4) is None
+    assert not plan.boundary_crash(3)
+
+
+# ---------------------------------------------------------------------------
+# Journal restart headers + stitching
+# ---------------------------------------------------------------------------
+
+
+def test_restart_journal_meta_and_stitch(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+    from kube_sqs_autoscaler_tpu.sim.replay import stitch_restart_episodes
+
+    clock = FakeClock(0.0)
+    path = str(tmp_path / "j.jsonl")
+    store = _store(tmp_path / "s.json", clock, journal_path=path)
+    journal = TickJournal(path, meta={"source": "test"})
+    record = TickRecord(start=5.0, num_messages=500,
+                        up=Gate.FIRE, down=Gate.SKIPPED)
+    journal.on_tick(record)
+    store.snapshot(clock_now=5.0, policy_state=PolicyState(5.0, 5.0),
+                   last_tick_start=5.0)
+    journal.close()
+
+    clock.advance(9.0)
+    boot2 = _store(tmp_path / "s.json", clock, journal_path=path)
+    report = boot2.rehydrate(clock.now())
+    assert not report.cold_start
+    meta = boot2.restart_journal_meta()
+    assert meta["snapshot_hash"] == report.snapshot_hash
+    journal2 = TickJournal(path, meta={"source": "test", "restart": meta})
+    journal2.on_tick(TickRecord(start=14.0, num_messages=480))
+    journal2.close()
+
+    stitches = stitch_restart_episodes(path)
+    assert len(stitches) == 1
+    stitch = stitches[0]
+    assert stitch["snapshot_hash"] == report.snapshot_hash
+    assert stitch["prior_ticks"] == 1
+    assert stitch["prior_scaled_up"] == 1
+    assert stitch["post_ticks"] == 1
+    assert stitch["cold_start"] is False
+
+
+def test_journal_tail_rehydration_advances_stamp(tmp_path):
+    # the journal is one tick AHEAD of the snapshot (the snapshot write
+    # crashed): the tail's successful scale-up must advance the stamp
+    from kube_sqs_autoscaler_tpu.obs.journal import TickJournal
+
+    clock = FakeClock(0.0)
+    path = str(tmp_path / "j.jsonl")
+    store = _store(tmp_path / "s.json", clock, journal_path=path)
+    clock.advance(50.0)
+    store.snapshot(clock_now=50.0, policy_state=PolicyState(30.0, 20.0),
+                   last_tick_start=50.0)
+    journal = TickJournal(path, meta={})
+    journal.on_tick(TickRecord(start=55.0, num_messages=500,
+                               up=Gate.FIRE, down=Gate.SKIPPED))
+    journal.close()
+    clock.advance(10.0)
+    boot2 = _store(tmp_path / "s.json", clock, journal_path=path)
+    report = boot2.rehydrate(clock.now())
+    assert report.journal_tail_ticks == 1
+    assert boot2.restored_policy_state().last_scale_up == pytest.approx(55.0)
+
+
+# ---------------------------------------------------------------------------
+# /healthz rehydrating + restart metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_rehydrating_and_restart_gauges():
+    from kube_sqs_autoscaler_tpu.core.durable import RehydrationReport
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+
+    metrics = ControllerMetrics(version="t", policy="reactive")
+    assert not metrics.rehydrating
+    metrics.begin_rehydration()
+    assert metrics.rehydrating
+    metrics.set_rehydration(RehydrationReport(
+        cold_start=False, snapshot_age_s=12.5, records_recovered=42,
+        records_expired=3, restarts=2, duration_s=0.004,
+    ))
+    text = metrics.render()
+    assert "controller_restarts_total 2" in text
+    assert "snapshot_age_seconds 12.5" in text
+    assert "state_records_recovered 42" in text
+    assert "state_records_expired 3" in text
+    assert "rehydration_duration_seconds 0.004" in text
+    # the first completed tick clears the rehydrating state
+    metrics.on_tick(TickRecord(start=0.0, num_messages=1))
+    assert not metrics.rehydrating
+
+
+def test_healthz_503_while_rehydrating():
+    import urllib.error
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    metrics = ControllerMetrics(version="t")
+    metrics.begin_rehydration()
+    server = ObservabilityServer(metrics, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        ready_url = f"http://127.0.0.1:{server.port}/readyz"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 503
+        assert "rehydrating" in err.value.read().decode()
+        # readiness (the routing gate) names rehydration too
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(ready_url)
+        assert err.value.code == 503
+        assert "rehydrating" in err.value.read().decode()
+        metrics.on_tick(TickRecord(start=0.0, num_messages=1))
+        with urllib.request.urlopen(url) as response:
+            assert response.status == 200
+    finally:
+        server.stop()
+
+
+def test_debug_trace_serves_restart_instants(tmp_path):
+    # the store's restart instants must actually REACH /debug/trace
+    # (trace_sources wiring), in their own "restart" category
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.obs.journal import TickRing
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    clock = FakeClock(2.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.rehydrate(clock.now())
+    ring = TickRing(capacity=8)
+    ring.on_tick(TickRecord(start=5.0, num_messages=1))
+    server = ObservabilityServer(
+        ControllerMetrics(version="t"), host="127.0.0.1", port=0,
+        ring=ring, trace_sources=(store,),
+    )
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/trace"
+        with urllib.request.urlopen(url) as response:
+            trace = json.loads(response.read())
+    finally:
+        server.stop()
+    restart_events = [
+        e for e in trace["traceEvents"] if e.get("cat") == "restart"
+    ]
+    assert {e["name"] for e in restart_events} == {
+        "restart-detected", "restart-rehydrated"
+    }
+
+
+def test_store_trace_events_have_restart_category(tmp_path):
+    from kube_sqs_autoscaler_tpu.obs.trace import instant_trace_events
+
+    clock = FakeClock(3.0)
+    store = _store(tmp_path / "s.json", clock)
+    store.rehydrate(clock.now())
+    events = instant_trace_events(store.events)
+    assert events
+    assert {e["cat"] for e in events} == {"restart"}
+    assert {e["name"] for e in events} == {
+        "restart-detected", "restart-rehydrated"
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI flags
+# ---------------------------------------------------------------------------
+
+
+def test_cli_state_flags():
+    from kube_sqs_autoscaler_tpu.cli import (
+        build_parser,
+        validate_flag_interactions,
+    )
+
+    parser = build_parser()
+    args = parser.parse_args(["--state-path", "/tmp/x.state",
+                              "--state-max-age", "1h"])
+    validate_flag_interactions(parser, args)
+    assert args.state_path == "/tmp/x.state"
+    assert args.state_max_age == 3600.0
+
+    bad = parser.parse_args(["--state-max-age", "1h"])
+    with pytest.raises(SystemExit):
+        validate_flag_interactions(parser, bad)
+
+
+# ---------------------------------------------------------------------------
+# The restart bench: tier-1 smoke, full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_restart_bench_smoke(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_restart.json"
+    summary = bench.run_restart_suite(
+        output=str(out),
+        control_points=(CRASH_AFTER_ACTUATE,),
+        fleet_points=(CRASH_AFTER_ACTUATE,),
+    )
+    assert summary["metric"] == "restart_duplicate_replies_prevented"
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "restart"
+    battery = artifact["crash_battery"][CRASH_AFTER_ACTUATE]
+    assert battery["crashes"] == 1
+    assert battery["warm"]
+    assert all(g >= 30.0 for g in battery["cooldown_gaps"])
+    fleet = artifact["fleet"]["episodes"][CRASH_AFTER_ACTUATE]
+    assert fleet["duplicate_replies"] == 0
+    assert fleet["lost"] == 0
+    assert artifact["fleet"]["cold_contrast"]["duplicate_replies"] >= 1
+    assert artifact["warm_vs_cold"]["byte_identical_when_off"]
+
+
+@pytest.mark.slow
+def test_restart_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_restart_full.json"
+    summary = bench.run_restart_suite(output=str(out))
+    assert summary["value"] >= 1  # the cold contrast really duplicates
+    artifact = json.loads(out.read_text())
+    assert set(artifact["crash_battery"]) == set(CRASH_POINTS)
+    assert set(artifact["fleet"]["episodes"]) == set(CRASH_POINTS)
+    for point, episode in artifact["fleet"]["episodes"].items():
+        assert episode["duplicate_replies"] == 0, point
+        assert episode["lost"] == 0, point
+        assert episode["crashes"] == 1, point
+    forecaster = artifact["forecaster"]
+    assert (forecaster["warm"]["post_restart_max_depth"]
+            < forecaster["cold"]["post_restart_max_depth"])
