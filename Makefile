@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -153,6 +153,16 @@ bench-twin:
 # durability off; writes BENCH_r18.json
 bench-restart:
 	JAX_PLATFORMS=cpu python bench.py --suite restart
+
+# Live engine knobs through the one-scheduler seam (CPU JAX, ~a
+# minute): scheduler-on/knobs-unarmed byte-identical to the hand-rolled
+# FleetDriver (tick records, counters, replies); adaptive decode-block
+# actuation beats the latency-safe static on tokens/s AND the
+# throughput static on time-over-SLO under a regime-switch workload;
+# every knob change journaled + snapshotted + gauge-exported; writes
+# BENCH_r19.json
+bench-knobs:
+	JAX_PLATFORMS=cpu python bench.py --suite knobs
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

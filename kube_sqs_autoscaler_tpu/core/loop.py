@@ -130,13 +130,30 @@ class ControlLoop:
         restored = self.durable.take_restored_policy_state()
         return restored if restored is not None else initial_state(now)
 
-    def run(self, max_ticks: int | None = None) -> PolicyState:
+    def run(self, max_ticks: int | None = None, *,
+            scheduler=None) -> PolicyState:
         """Run the loop; blocks until ``max_ticks`` ticks or :meth:`stop`.
 
         ``max_ticks=None`` runs forever, like the reference.  Each call is a
         fresh episode (fresh startup-grace state and tick budget);
         ``self.ticks`` accumulates across episodes for observability.
+
+        ``scheduler`` hands the sleep loop to the event scheduler seam
+        (:mod:`..sched`): pass an
+        :class:`~..sched.scheduler.EventScheduler` (or ``True`` to
+        build one on this loop's clock) and the episode runs as a
+        registered ``control-tick`` event instead — same cadence, same
+        sticky-stop and ``max_ticks`` semantics, byte-identical tick
+        records (pinned by test), but on a queue other events (knob
+        timers, fleet cycles) can share.
         """
+        if scheduler is not None and scheduler is not False:
+            from ..sched.scheduler import drive_loop
+
+            return drive_loop(
+                self, max_ticks=max_ticks,
+                scheduler=None if scheduler is True else scheduler,
+            )
         state = self.initial_policy_state()
         ticks_this_run = 0
         while not self._stop.is_set():

@@ -70,6 +70,17 @@ log = logging.getLogger(__name__)
 builtins_min = min
 builtins_max = max
 
+def _free_count(batcher) -> int:
+    """Admission capacity as a bare count.  The router and the orphan
+    dispatcher only need HOW MANY slots a replica offers — the sharded
+    plane's ``free_slots`` property additionally pays a freest-first
+    ordering merge per read, so count-only reads go through
+    ``_free_slot_count`` when the batcher provides it (contract-test
+    stubs carry a plain ``free_slots`` list and fall back)."""
+    counter = getattr(batcher, "_free_slot_count", None)
+    return counter() if counter is not None else len(batcher.free_slots)
+
+
 # Lifecycle states a replica moves through (exported as the
 # fleet_replica_state gauge; codes are stable dashboard contract).
 SERVING = "serving"
@@ -284,6 +295,12 @@ class WorkerPool(FleetPoolBase):
         # _retired_processed so a long-lived, high-churn fleet stays flat
         self.members: list[Replica] = []
         self.retired_keep = 32
+        # live count of DEAD/STOPPED members, maintained at the state
+        # transitions so the per-cycle prune pass can SKIP its members
+        # scan entirely while nothing exceeds retired_keep — the
+        # common case is every cycle of a healthy fleet (per-cycle
+        # bookkeeping audit, ROADMAP item 1)
+        self._retired_members = 0
         self._retired_processed = 0
         self._retired_tenant: dict[str, int] = {}
         self._next_index = 0
@@ -432,8 +449,9 @@ class WorkerPool(FleetPoolBase):
                 draining.append(replica)
         # router: freest replica first, so a refill cycle spreads the
         # queue's head across the fleet instead of soaking one replica
+        # (count-only read: the ordering merge is the admission's cost)
         serving.sort(
-            key=lambda r: len(r.worker.batcher.free_slots), reverse=True
+            key=lambda r: _free_count(r.worker.batcher), reverse=True
         )
         for replica in serving:
             if self._orphans:
@@ -517,6 +535,7 @@ class WorkerPool(FleetPoolBase):
 
     def _declare_dead(self, replica: Replica, cause: str) -> None:
         replica.state = DEAD
+        self._retired_members += 1
         replica.worker.killed = True  # a hung replica must never step again
         orphans = replica.worker.take_inflight()
         self.redispatched_total += len(orphans)
@@ -532,7 +551,7 @@ class WorkerPool(FleetPoolBase):
         )
 
     def _dispatch_orphans(self, replica: Replica) -> None:
-        free = len(replica.worker.batcher.free_slots)
+        free = _free_count(replica.worker.batcher)
         if free <= 0:
             return
         take, self._orphans = self._orphans[:free], self._orphans[free:]
@@ -543,6 +562,8 @@ class WorkerPool(FleetPoolBase):
             )
 
     def _retire(self, replica: Replica, *, released: int) -> None:
+        if replica.state != DEAD:  # a dead replica is already counted
+            self._retired_members += 1
         replica.state = STOPPED
         replica.worker.stop()
         self._event(
@@ -567,7 +588,12 @@ class WorkerPool(FleetPoolBase):
         """Drop all but the newest ``retired_keep`` DEAD/STOPPED
         replicas, folding their settle counts into the retired total.
         (Pruned indices disappear from ``members`` — ``kill_worker`` on
-        one raises, as killing a corpse should.)"""
+        one raises, as killing a corpse should.)  Skips the members
+        scan entirely while nothing exceeds ``retired_keep`` (the
+        ``_retired_members`` counter is maintained at the lifecycle
+        transitions), so a healthy fleet's cycle never pays it."""
+        if self._retired_members <= self.retired_keep:
+            return
         retired = [
             r for r in self.members if r.state in (DEAD, STOPPED)
         ]
@@ -593,6 +619,7 @@ class WorkerPool(FleetPoolBase):
                         self._retired_tenant.get(tenant, 0) + count
                     )
             self.members.remove(replica)
+            self._retired_members -= 1
 
     @property
     def processed(self) -> int:

@@ -92,6 +92,24 @@ def require_twin(
         )
 
 
+def require_no_knob_head(
+    checkpoint: "PolicyCheckpoint", seam: str
+) -> None:
+    """Reject a knob-headed checkpoint at a seam that assumes the
+    headless theta layout.  The knob head is GEOMETRY — it widens the
+    output layer, changing what the flat theta means — so the compiled
+    fluid/serving rollouts (which vmap homogeneous-geometry
+    populations) must refuse it loudly until the knob-reward training
+    loop lands (ROADMAP item 3), never mis-slice it silently."""
+    if getattr(checkpoint, "knob_head", False):
+        raise CheckpointError(
+            f"checkpoint {checkpoint.hash} carries a knob-action head;"
+            f" {seam} trains/evaluates the headless up/hold/down layout"
+            " — deploy the knob head through LearnedPolicy +"
+            " sched.KnobActuator instead"
+        )
+
+
 #: History-ring capacity the learned features run on, train and deploy.
 #: Smaller than the forecasters' 128 default on purpose: the feature set
 #: (EWMA level, 12-sample trend) saturates well below 64 samples, and
@@ -123,22 +141,31 @@ def checkpoint_history(checkpoint: PolicyCheckpoint) -> tuple[int, int]:
 class PolicyCheckpoint:
     """One loaded (or freshly trained) policy checkpoint."""
 
-    theta: np.ndarray  # float32, param_count(hidden)
+    theta: np.ndarray  # float32, param_count(hidden, knob_head)
     hidden: int = DEFAULT_HIDDEN
     #: provenance: trainer config, seeds, scenario names, reward weights —
     #: free-form, excluded from the content hash
     meta: dict[str, Any] = field(default_factory=dict)
+    #: the grown action space (ISSUE 15): three extra knob-delta output
+    #: logits.  Geometry, not provenance — validated against the
+    #: parameter count below and keyed into the content hash.
+    knob_head: bool = False
 
     def __post_init__(self):
         theta = np.ascontiguousarray(self.theta, dtype=np.float32)
         object.__setattr__(self, "theta", theta)
         if self.hidden < 1:
             raise CheckpointError(f"hidden must be >= 1, got {self.hidden}")
-        expected = param_count(self.hidden)
+        if not isinstance(self.knob_head, bool):
+            raise CheckpointError(
+                f"knob_head must be a bool, got {self.knob_head!r}"
+            )
+        expected = param_count(self.hidden, self.knob_head)
         if theta.shape != (expected,):
             raise CheckpointError(
                 f"theta has {theta.size} parameters; hidden={self.hidden}"
-                f" needs exactly {expected}"
+                f" with knob_head={self.knob_head} needs exactly"
+                f" {expected}"
             )
         if not np.isfinite(theta).all():
             raise CheckpointError("theta contains non-finite values")
@@ -171,7 +198,7 @@ class PolicyCheckpoint:
         return checkpoint_hash(self)
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        data = {
             "kind": KIND,
             "schema": SCHEMA_VERSION,
             "hidden": int(self.hidden),
@@ -179,6 +206,11 @@ class PolicyCheckpoint:
             "theta": [float(w) for w in self.theta],
             "meta": self.meta,
         }
+        if self.knob_head:
+            # absent for headless checkpoints so pre-knob files (and
+            # their byte-for-byte round trips) are untouched
+            data["knob_head"] = True
+        return data
 
 
 def checkpoint_hash(checkpoint: PolicyCheckpoint) -> str:
@@ -208,6 +240,10 @@ def checkpoint_hash(checkpoint: PolicyCheckpoint) -> str:
     # so every pre-serving-twin checkpoint keeps its published hash
     if checkpoint_twin(checkpoint) != TWIN_FLUID:
         content["twin"] = checkpoint_twin(checkpoint)
+    if checkpoint.knob_head:
+        # geometry is decision-relevant; keyed in only when armed so
+        # every headless checkpoint keeps its published hash
+        content["knob_head"] = True
     canonical = json.dumps(content, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(canonical.encode("utf-8")).hexdigest()[:12]
 
@@ -286,11 +322,18 @@ def load_checkpoint(path: str) -> PolicyCheckpoint:
     meta = data.get("meta") or {}
     if not isinstance(meta, dict):
         raise CheckpointError(f"checkpoint {path!r} meta must be an object")
+    knob_head = data.get("knob_head", False)
+    if not isinstance(knob_head, bool):
+        raise CheckpointError(
+            f"checkpoint {path!r} knob_head must be a bool, got"
+            f" {knob_head!r}"
+        )
     try:
         return PolicyCheckpoint(
             theta=np.asarray(theta, dtype=np.float32),
             hidden=hidden,
             meta=meta,
+            knob_head=knob_head,
         )
     except CheckpointError as err:
         raise CheckpointError(f"checkpoint {path!r}: {err}") from None

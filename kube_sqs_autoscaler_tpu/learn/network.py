@@ -38,6 +38,16 @@ from ..forecast.forecasters import ewma_level, lstsq_slope
 ACTION_DOWN, ACTION_HOLD, ACTION_UP = 0, 1, 2
 N_ACTIONS = 3
 
+#: Knob-head action codes (ISSUE 15: the action space grows past
+#: up/hold/down).  A checkpoint saved with ``knob_head=True`` carries
+#: three extra output logits whose argmax is a knob DELTA — step the
+#: armed engine knob (decode block, by default) down / hold / up one
+#: rung of its ladder.  The knob head shares the input layer and hidden
+#: features with the replica head, so what the network learned about
+#: backlog shape serves both actuators.
+KNOB_ACTION_DOWN, KNOB_ACTION_HOLD, KNOB_ACTION_UP = 0, 1, 2
+N_KNOB_ACTIONS = 3
+
 #: The fixed feature vector (all float32, assembled in
 #: :func:`policy_features` — keep the docstring there in sync).
 N_FEATURES = 8
@@ -55,15 +65,26 @@ FEATURE_ALPHA = 0.3
 FEATURE_WINDOW = 12
 
 
-def param_count(hidden: int = DEFAULT_HIDDEN) -> int:
+def n_outputs(knob_head: bool = False) -> int:
+    """Output-layer width: 3 replica actions, +3 knob actions with the
+    knob head armed."""
+    return N_ACTIONS + (N_KNOB_ACTIONS if knob_head else 0)
+
+
+def param_count(hidden: int = DEFAULT_HIDDEN,
+                knob_head: bool = False) -> int:
     """Flat parameter vector length for one hidden layer of ``hidden``."""
-    return hidden * N_FEATURES + hidden + N_ACTIONS * hidden + N_ACTIONS
+    outputs = n_outputs(knob_head)
+    return hidden * N_FEATURES + hidden + outputs * hidden + outputs
 
 
-def init_params(seed: int, hidden: int = DEFAULT_HIDDEN) -> np.ndarray:
+def init_params(seed: int, hidden: int = DEFAULT_HIDDEN,
+                knob_head: bool = False) -> np.ndarray:
     """Seeded float32 init (scaled normal) — deterministic per seed."""
     rng = np.random.default_rng(seed)
-    theta = rng.standard_normal(param_count(hidden)).astype(np.float32)
+    theta = rng.standard_normal(
+        param_count(hidden, knob_head)
+    ).astype(np.float32)
     # modest fan-in scaling keeps tanh out of saturation at init
     theta[: hidden * N_FEATURES] *= np.float32(0.5 / np.sqrt(N_FEATURES))
     theta[hidden * N_FEATURES :] *= np.float32(0.5 / np.sqrt(hidden))
@@ -86,24 +107,33 @@ def hold_depth(scale_up_messages: int, scale_down_messages: int) -> int:
     return hold
 
 
-def policy_logits(theta: jax.Array, features: jax.Array, hidden: int) -> jax.Array:
-    """MLP forward: ``features (F,) -> logits (3,)``; ``theta`` flat.
+def policy_logits(theta: jax.Array, features: jax.Array, hidden: int,
+                  knob_head: bool = False) -> jax.Array:
+    """MLP forward: ``features (F,) -> logits (3,)`` (or ``(6,)`` with
+    the knob head — replica actions first, knob actions after);
+    ``theta`` flat.
 
     The matvecs are written as broadcast-multiply + ``jnp.sum`` — the
     exact reduction pattern :func:`~..forecast.forecasters.lstsq_forecast`
     already proves bit-stable between the live jitted path and the
     vmapped compiled scan — rather than ``jnp.dot``, whose lowering may
-    differ between those contexts.
+    differ between those contexts.  With ``knob_head`` the input/hidden
+    layer layout is unchanged — only the output layer widens, replica
+    rows first — so splicing a headless theta's output rows into a
+    knob-headed layout computes IDENTICAL replica logits (pinned by
+    test): growing the action space never silently changes what the
+    replica head decides.
     """
     f = N_FEATURES
+    outputs = n_outputs(knob_head)
     o = 0
     w1 = theta[o : o + hidden * f].reshape(hidden, f)
     o += hidden * f
     b1 = theta[o : o + hidden]
     o += hidden
-    w2 = theta[o : o + N_ACTIONS * hidden].reshape(N_ACTIONS, hidden)
-    o += N_ACTIONS * hidden
-    b2 = theta[o : o + N_ACTIONS]
+    w2 = theta[o : o + outputs * hidden].reshape(outputs, hidden)
+    o += outputs * hidden
+    b2 = theta[o : o + outputs]
     h = jnp.tanh(jnp.sum(w1 * features[None, :], axis=1) + b1)
     return jnp.sum(w2 * h[None, :], axis=1) + b2
 
@@ -179,6 +209,7 @@ def learned_decision(
     window: jax.Array,
     *,
     hidden: int,
+    knob_head: bool = False,
 ) -> jax.Array:
     """One tick's effective depth (int32) from history + state features.
 
@@ -193,8 +224,8 @@ def learned_decision(
         times32, depths32, n, observed, replicas, frac_up32, frac_down32,
         scale_up_messages, max_pods, poll32, alpha32, window,
     )
-    logits = policy_logits(theta, features, hidden)
-    action = jnp.argmax(logits)
+    logits = policy_logits(theta, features, hidden, knob_head)
+    action = jnp.argmax(logits[:N_ACTIONS])
     decision = jnp.where(
         action == ACTION_UP,
         scale_up_messages,
@@ -204,6 +235,43 @@ def learned_decision(
     return jnp.maximum(0, jnp.where(warmed, decision, observed)).astype(
         jnp.int32
     )
+
+
+def knob_delta_decision(
+    theta: jax.Array,
+    times32: jax.Array,
+    depths32: jax.Array,
+    n: jax.Array,
+    observed: jax.Array,
+    replicas: jax.Array,
+    frac_up32: jax.Array,
+    frac_down32: jax.Array,
+    scale_up_messages: jax.Array,
+    min_samples: jax.Array,
+    max_pods: jax.Array,
+    poll32: jax.Array,
+    alpha32: jax.Array,
+    window: jax.Array,
+    *,
+    hidden: int,
+) -> jax.Array:
+    """The knob head's tick decision: a ladder DELTA in {-1, 0, +1}
+    (int32) — step the armed engine knob down / hold / up.  Same
+    feature vector, same warm-up contract as :func:`learned_decision`
+    (below ``min_samples`` the knob holds — a fresh controller must
+    not thrash the engine before it has signal).  Requires a
+    ``knob_head=True`` theta layout."""
+    features = policy_features(
+        times32, depths32, n, observed, replicas, frac_up32, frac_down32,
+        scale_up_messages, max_pods, poll32, alpha32, window,
+    )
+    logits = policy_logits(theta, features, hidden, knob_head=True)
+    delta = (
+        jnp.argmax(logits[N_ACTIONS : N_ACTIONS + N_KNOB_ACTIONS])
+        .astype(jnp.int32) - 1
+    )
+    warmed = n >= min_samples
+    return jnp.where(warmed, delta, 0).astype(jnp.int32)
 
 
 def cooldown_fraction(last: float, cooldown: float, now: float) -> float:

@@ -44,11 +44,16 @@ from .network import (
     FEATURE_WINDOW,
     cooldown_fraction,
     hold_depth,
+    knob_delta_decision,
     learned_decision,
 )
 
-_learned_decision = partial(jax.jit, static_argnames=("hidden",))(
-    learned_decision
+_learned_decision = partial(
+    jax.jit, static_argnames=("hidden", "knob_head")
+)(learned_decision)
+
+_knob_delta_decision = partial(jax.jit, static_argnames=("hidden",))(
+    knob_delta_decision
 )
 
 
@@ -95,6 +100,13 @@ class LearnedPolicy:
         self.name = f"learned@{checkpoint.hash}"
         self._theta = checkpoint.theta
         self._hidden = int(checkpoint.hidden)
+        # the grown action space (ISSUE 15): a knob-headed checkpoint's
+        # replica decision is computed the same way (first three
+        # logits); its knob head additionally emits a ladder delta per
+        # tick, read by sched.knobs.LearnedKnobPolicy off
+        # `last_knob_delta` and actuated through the KnobActuator
+        self._knob_head = bool(getattr(checkpoint, "knob_head", False))
+        self.last_knob_delta: int | None = None
         self._hold = hold_depth(
             policy.scale_up_messages, policy.scale_down_messages
         )
@@ -120,13 +132,15 @@ class LearnedPolicy:
         frac_down = cooldown_fraction(
             self._last_down, self.policy.scale_down_cooldown, now
         )
+        # f64 centering before the float32 jit boundary, exactly
+        # the forecasters' convention (_center_times docstring)
+        times32 = np.asarray(_center_times(times, n))
+        depths32 = np.asarray(depths)
         decision = int(
             _learned_decision(
                 self._theta,
-                # f64 centering before the float32 jit boundary, exactly
-                # the forecasters' convention (_center_times docstring)
-                np.asarray(_center_times(times, n)),
-                np.asarray(depths),
+                times32,
+                depths32,
                 n,
                 int(num_messages),
                 self.replicas,
@@ -141,10 +155,43 @@ class LearnedPolicy:
                 np.float32(FEATURE_ALPHA),
                 FEATURE_WINDOW,
                 hidden=self._hidden,
+                knob_head=self._knob_head,
             )
         )
+        if self._knob_head:
+            # same features, the other head: one extra tiny jitted call
+            # per tick, paid only by knob-headed checkpoints
+            self.last_knob_delta = int(
+                _knob_delta_decision(
+                    self._theta,
+                    times32,
+                    depths32,
+                    n,
+                    int(num_messages),
+                    self.replicas,
+                    np.float32(frac_up),
+                    np.float32(frac_down),
+                    self.policy.scale_up_messages,
+                    self.min_samples,
+                    self.max_pods,
+                    np.float32(self.poll_interval),
+                    np.float32(FEATURE_ALPHA),
+                    FEATURE_WINDOW,
+                    hidden=self._hidden,
+                )
+            )
         self.last_prediction = decision
         return decision
+
+    def take_knob_delta(self) -> int | None:
+        """Consume this tick's knob-head delta (None once taken, and on
+        ticks where no decision ran).  Consumption semantics on
+        purpose: the knob adapter evaluates every tick, including
+        metric-failure ticks where :meth:`effective_messages` never
+        runs — re-applying a stale delta would walk the ladder
+        repeatedly on ONE decision."""
+        delta, self.last_knob_delta = self.last_knob_delta, None
+        return delta
 
     # ------------------------------------------------------------------
     # Durable-state surface (core/durable.py StateProvider): the mirror

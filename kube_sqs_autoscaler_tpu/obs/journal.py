@@ -162,38 +162,60 @@ class TickJournal:
             {"kind": _TICK_KIND, **record.to_dict()}, separators=(",", ":")
         )
         with self._lock:
-            if self._closed:
-                return
-            if self._fh.closed and not self._reopen():
-                return  # transient failure: drop this tick, retry next tick
-            if (
-                not self._needs_header
-                and self._size + len(line.encode("utf-8")) + 1 > self.max_bytes
-            ):
-                try:
-                    self._rotate()
-                except OSError:
-                    # A transient filesystem error (permissions, read-only
-                    # remount, ENOSPC) must not kill the recorder forever:
-                    # keep appending to the live file and retry the
-                    # rotation at the next size check.
-                    log.exception(
-                        "journal rotation failed; continuing in place"
-                    )
-                    if self._fh.closed and not self._reopen():
-                        return
-            if self._needs_header:
-                # the rename succeeded but the continuation header did not
-                # land (e.g. ENOSPC): a tick line first would leave the
-                # file headerless and permanently unreadable — the header
-                # MUST precede any tick, so drop ticks until it lands
-                try:
-                    self._write_line(self._header_line(continuation=True))
-                except OSError:
-                    log.exception("journal header retry failed; tick dropped")
+            self._append_locked(line)
+
+    def append_event(self, kind: str, payload: dict) -> None:
+        """Append one non-tick event line (e.g. the knob actuator's
+        ``kind="knob"`` changes).  Same crash-safety discipline as tick
+        lines (line-at-a-time + flush, rotation-aware); readers that
+        don't know the kind skip it (the episode parser's
+        forward-compatibility rule), :func:`read_journal_events` finds
+        it."""
+        if kind in (_HEADER_KIND, _TICK_KIND):
+            raise ValueError(
+                f"kind {kind!r} is reserved for the journal itself"
+            )
+        line = json.dumps(
+            {"kind": kind, **payload}, separators=(",", ":")
+        )
+        with self._lock:
+            self._append_locked(line)
+
+    def _append_locked(self, line: str) -> None:
+        """One journal line through the shared rotation/reopen/header
+        machinery; caller holds the lock."""
+        if self._closed:
+            return
+        if self._fh.closed and not self._reopen():
+            return  # transient failure: drop this line, retry next write
+        if (
+            not self._needs_header
+            and self._size + len(line.encode("utf-8")) + 1 > self.max_bytes
+        ):
+            try:
+                self._rotate()
+            except OSError:
+                # A transient filesystem error (permissions, read-only
+                # remount, ENOSPC) must not kill the recorder forever:
+                # keep appending to the live file and retry the
+                # rotation at the next size check.
+                log.exception(
+                    "journal rotation failed; continuing in place"
+                )
+                if self._fh.closed and not self._reopen():
                     return
-                self._needs_header = False
-            self._write_line(line)
+        if self._needs_header:
+            # the rename succeeded but the continuation header did not
+            # land (e.g. ENOSPC): a tick line first would leave the
+            # file headerless and permanently unreadable — the header
+            # MUST precede any tick, so drop lines until it lands
+            try:
+                self._write_line(self._header_line(continuation=True))
+            except OSError:
+                log.exception("journal header retry failed; line dropped")
+                return
+            self._needs_header = False
+        self._write_line(line)
 
     def _reopen(self) -> bool:
         """Re-establish the file handle after an I/O failure mid-rotation.
@@ -337,6 +359,25 @@ def read_journal_episodes(
     """Load a journal file → one ``(meta, records)`` pair per episode
     (controller restart = new episode)."""
     return parse_journal_episodes(_read_lines(path))
+
+
+def read_journal_events(path: str, kind: str) -> "list[dict]":
+    """Load every non-tick event line of ``kind`` from a journal, in
+    file order (e.g. ``kind="knob"`` for the knob actuator's changes).
+    Torn/corrupt lines and foreign kinds are skipped — this reader is
+    for sidecar event streams, so it is deliberately lenient where the
+    episode parser is strict."""
+    events: list[dict] = []
+    for line in _read_lines(path):
+        if not line.strip():
+            continue
+        try:
+            data = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(data, dict) and data.get("kind") == kind:
+            events.append(data)
+    return events
 
 
 def _read_lines(path: str) -> "list[str]":

@@ -164,6 +164,11 @@ def instant_trace_events(
             # instants (core/durable.py) — their own lane so a
             # postmortem can line recovery up against the ticks
             return "restart"
+        if name.startswith("knob-"):
+            # live engine-knob changes (sched/knobs.py KnobActuator) —
+            # their own lane so an operator can line a tokens/s or
+            # TTFT inflection up against the knob flip that caused it
+            return "knob"
         return "fleet"
 
     return [

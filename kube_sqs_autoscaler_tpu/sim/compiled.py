@@ -210,13 +210,20 @@ def encode_config(config: SimConfig, slo_depth: float = 0.0) -> dict[str, Any]:
             raise ValueError(
                 "policy='learned' requires SimConfig.learned_checkpoint"
             )
-        from ..learn.checkpoint import TWIN_FLUID, require_twin
+        from ..learn.checkpoint import (
+            TWIN_FLUID,
+            require_no_knob_head,
+            require_twin,
+        )
 
         # every fluid-compiled consumer (sweep, rollout, counterfactual
         # replay) encodes through here: a serving-twin checkpoint's
         # weights mean shard counts, not replica gates — reject at
         # encode time, the compiled analogue of LearnedPolicy's check
         require_twin(checkpoint, TWIN_FLUID, "the fluid compiled twin")
+        # ...and a knob-headed theta has a wider output layer the
+        # scan's fixed slicing would silently mis-read
+        require_no_knob_head(checkpoint, "the fluid compiled twin")
         row["policy_kind"] = np.int32(LEARNED_KIND)
         row["theta"] = np.asarray(checkpoint.theta, np.float32)
         # the history features are part of the checkpoint schema — pinned

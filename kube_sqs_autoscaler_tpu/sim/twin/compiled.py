@@ -209,8 +209,13 @@ def encode_twin_config(
         "use_pool": np.bool_(s.pool_entries > 0),
     }
     if config.policy == "learned":
-        from ...learn.checkpoint import checkpoint_history
+        from ...learn.checkpoint import (
+            checkpoint_history,
+            require_no_knob_head,
+        )
 
+        # the serving twin's scan slices the headless theta layout
+        require_no_knob_head(config.checkpoint, "the serving twin")
         _, min_samples = checkpoint_history(config.checkpoint)
         row["policy_kind"] = np.int32(LEARNED_KIND)
         row["theta"] = np.asarray(config.checkpoint.theta, np.float32)

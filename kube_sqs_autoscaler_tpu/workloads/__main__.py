@@ -284,6 +284,28 @@ def main(argv=None) -> None:
         help="lower replica bound for --fleet-max-replicas",
     )
     parser.add_argument(
+        "--scheduler", action="store_true",
+        help="run the fleet demo's control loop + serving cycles on the "
+             "ONE event-driven scheduler (sched/: a priority-ordered "
+             "event queue over one clock) instead of the hand-rolled "
+             "interleave — byte-identical behavior with no knobs armed, "
+             "and the seam --knobs actuates through (requires "
+             "--fleet-max-replicas)",
+    )
+    parser.add_argument(
+        "--knobs", default="", metavar="KNOB,KNOB,...",
+        help="arm live engine knobs for actuation between cycles at "
+             "safe points: decode-block (re-dispatch boundary; needs "
+             "--decode-block >= 2 or --shards >= 2), slot-limit "
+             "(per-shard admission cap), shards (drain/retire mask "
+             "flips; needs --shards >= 2), speculative (round-overlap "
+             "toggle; needs --speculative-draft-layers, not --beams), "
+             "prefix-pool (residency ceiling; needs --prefix-pool).  "
+             "Every change is journaled, snapshotted, and exported as "
+             "engine_knob{knob=...} gauges (requires --continuous and "
+             "--scheduler)",
+    )
+    parser.add_argument(
         "--journal-path", default="", metavar="PATH",
         help="append the fleet control loop's tick records to this "
              "JSONL flight journal (the controller CLI's recorder, "
@@ -459,6 +481,62 @@ def main(argv=None) -> None:
             "--journal-path records the fleet control loop "
             "(requires --fleet-max-replicas)"
         )
+    if args.scheduler and not args.fleet_max_replicas:
+        raise SystemExit(
+            "--scheduler drives the fleet demo's loop + cycles "
+            "(requires --fleet-max-replicas)"
+        )
+    knob_names: tuple = ()
+    if args.knobs:
+        # args-only checks fail BEFORE the mesh is built or a checkpoint
+        # restored (same convention as the --decode-block checks above)
+        from ..sched.knobs import KnobError, parse_knob_names
+
+        try:
+            knob_names = parse_knob_names(args.knobs)
+        except KnobError as err:
+            raise SystemExit(str(err))
+        if not args.continuous:
+            raise SystemExit("--knobs requires --continuous")
+        if not args.scheduler:
+            raise SystemExit(
+                "--knobs actuates through the scheduler's between-cycle "
+                "safe point (requires --scheduler)"
+            )
+        if "speculative" in knob_names:
+            if args.beams > 1:
+                raise SystemExit(
+                    "the speculative knob does not combine with --beams "
+                    "(beam search is deterministic; there is no "
+                    "draft-and-verify round to toggle)"
+                )
+            if not args.speculative_draft_layers:
+                raise SystemExit(
+                    "the speculative knob requires "
+                    "--speculative-draft-layers (there is no "
+                    "draft-and-verify engine to toggle)"
+                )
+        if "decode_block" in knob_names and (
+            (args.decode_block < 2 and args.shards < 2)
+            or args.beams > 1
+            or args.speculative_draft_layers
+        ):
+            # the full _block_engine predicate, args-only: fails before
+            # the mesh is built, like every other startup check here
+            raise SystemExit(
+                "the decode-block knob needs the block/gang decode "
+                "engine: set --decode-block >= 2 or --shards >= 2 "
+                "(plain continuous path only — not with --beams / "
+                "--speculative-draft-layers)"
+            )
+        if "shards" in knob_names and args.shards < 2:
+            raise SystemExit(
+                "the shards knob needs the sharded plane (--shards >= 2)"
+            )
+        if "prefix_pool" in knob_names and not args.prefix_pool:
+            raise SystemExit(
+                "the prefix-pool knob requires --prefix-pool"
+            )
     prefix_ids: list[int] = []
     if args.prefix_ids:
         try:
@@ -991,8 +1069,25 @@ def main(argv=None) -> None:
 
                 journal = TickJournal(
                     args.journal_path,
-                    meta=_fleet_journal_meta(args, tenancy),
+                    meta=_fleet_journal_meta(args, tenancy, knob_names),
                 )
+            metrics = None
+            obs_server = None
+            if args.metrics_port:
+                from .. import __version__
+                from ..obs import ObservabilityServer, WorkloadMetrics
+
+                metrics = WorkloadMetrics()
+                metrics.set_build_info(
+                    __version__,
+                    scheduler=int(bool(args.scheduler)),
+                    knobs=",".join(knob_names) if knob_names else "none",
+                )
+                pool.attach_metrics(metrics)
+                obs_server = ObservabilityServer(
+                    metrics, port=args.metrics_port
+                )
+                obs_server.start()
             depth_policy = None
             if tenancy is not None:
                 # the forecaster seam's WHO-is-arriving signal: the
@@ -1005,10 +1100,28 @@ def main(argv=None) -> None:
                 depth_policy = TenantAwareDepth(
                     pool.staged_by_tenant, tenancy
                 )
+            class _LastDepthSource:
+                """Remembers the tick's observation so the knob policy
+                decides on the depth the loop just journaled instead of
+                re-polling the queue once per tick (doubled metric API
+                traffic against a real backend, and a knob decision on
+                a different depth than the tick's)."""
+
+                def __init__(self, source):
+                    self.source = source
+                    self.last = 0
+
+                def num_messages(self):
+                    self.last = self.source.num_messages()
+                    return self.last
+
+            metric_source = _LastDepthSource(QueueMetricSource(
+                queue, service_config.queue_url,
+                ("ApproximateNumberOfMessages",),
+            ))
             loop = ControlLoop(
                 pool,
-                QueueMetricSource(queue, service_config.queue_url,
-                                  ("ApproximateNumberOfMessages",)),
+                metric_source,
                 LoopConfig(
                     poll_interval=0.1,
                     policy=PolicyConfig(
@@ -1021,7 +1134,45 @@ def main(argv=None) -> None:
                 observer=journal,
                 depth_policy=depth_policy,
             )
-            driver = FleetDriver(pool, loop)
+            if args.scheduler:
+                # the one-scheduler seam: same interleave as registered
+                # events, plus — with --knobs — the actuator applying
+                # staged knob changes between cycles at safe points
+                from ..sched import (
+                    KnobActuator,
+                    KnobError,
+                    ReactiveKnobPolicy,
+                    ScheduledFleetDriver,
+                )
+
+                actuator = None
+                knob_policy = None
+                if knob_names:
+                    try:
+                        actuator = KnobActuator(
+                            pool, armed=knob_names,
+                            journal=journal, metrics=metrics,
+                        )
+                    except KnobError as err:
+                        raise SystemExit(str(err))
+                    if "decode_block" in knob_names:
+                        # backlog-reactive block policy: deep queue ->
+                        # big block (amortize host work), shallow ->
+                        # small block (tight TTFT floor); decisions
+                        # ride the control tick and read the depth that
+                        # tick observed — no second queue poll
+                        knob_policy = ReactiveKnobPolicy(
+                            actuator, lambda: metric_source.last,
+                            high=2 * args.batch_size,
+                            low=max(1, args.batch_size // 2),
+                            block_high=max(args.decode_block, 8),
+                            block_low=2,
+                        )
+                driver = ScheduledFleetDriver(
+                    pool, loop, knobs=actuator, knob_policy=knob_policy,
+                )
+            else:
+                driver = FleetDriver(pool, loop)
             start = time.perf_counter()
             stats = driver.run(
                 until=lambda: pool.processed >= args.demo and pool.idle,
@@ -1038,6 +1189,8 @@ def main(argv=None) -> None:
             pool.stop_all()
             if journal is not None:
                 journal.close()
+            if obs_server is not None:
+                obs_server.stop()
             if result_queue is not None:
                 for message in result_queue.receive_messages(
                         args.result_queue_url, max_messages=2):
@@ -1130,10 +1283,11 @@ def main(argv=None) -> None:
     worker.run_forever()
 
 
-def _fleet_journal_meta(args, tenancy) -> dict:
+def _fleet_journal_meta(args, tenancy, knob_names=()) -> dict:
     """The serving-fleet journal's header meta: which deployment knobs
-    (incl. the tenancy/admission policy) produced these tick lines —
-    the serving twin of the controller CLI's ``_journal_meta``."""
+    (incl. the tenancy/admission policy and the live-knob arming)
+    produced these tick lines — the serving twin of the controller
+    CLI's ``_journal_meta``."""
     return {
         "source": "serving-fleet",
         "queue_url": "demo://queue",
@@ -1146,6 +1300,13 @@ def _fleet_journal_meta(args, tenancy) -> dict:
             "generate_tokens": args.generate_tokens,
             "decode_block": args.decode_block,
             "shards": args.shards,
+        },
+        # the scheduler seam + armed live knobs: a journal reader must
+        # know whether `knob` event lines can appear in this episode
+        # and which subsystem owned the interleave
+        "sched": {
+            "scheduler": bool(args.scheduler),
+            "knobs": list(knob_names),
         },
         # tenancy knobs: a journal reader must know which admission
         # policy (DRR weights, prefix pool, stickiness) shaped the

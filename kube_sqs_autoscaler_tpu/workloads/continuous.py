@@ -645,6 +645,33 @@ class ContinuousBatcher:
         self.beams = beams
         self.length_penalty = length_penalty
         self.decode_block = decode_block
+        # which decode engine was BUILT (the knob seam routes on this,
+        # not on the live block size): the block engine's compiled scan
+        # is shape-polymorphic in its key operand, so decode_block can
+        # change live at the re-dispatch boundary without a rebuild —
+        # but only an engine constructed on the block path has one.
+        # The sharded plane overrides to True (its gang scan takes any
+        # block >= 1).
+        self._block_engine = decode_block > 1 and beams == 1 \
+            and not draft_layers
+        # a live decode_block change staged by the knob actuator
+        # (sched/knobs.py), completed inside the next step() at the
+        # re-dispatch boundary; None = no change pending
+        self._pending_decode_block: int | None = None
+        # admission cap (per shard on the sharded plane): free_slots
+        # offers at most slot_limit - busy rows.  None = unlimited,
+        # the reference path byte for byte.  Rows already above a
+        # lowered limit finish normally — drain semantics.
+        self.slot_limit: int | None = None
+        # audit counter (cheap int): full availability scans / routed
+        # orderings computed — the per-cycle bookkeeping tests pin that
+        # a host cycle pays O(B) availability work once, not per read
+        self.free_slot_scans = 0
+        # speculative round overlap (draft engines only): dispatch the
+        # provably-needed second draft-and-verify round before
+        # consuming the first.  True = today's behavior; the knob seam
+        # flips it between rounds.
+        self.spec_overlap = True
         # multi-tenant admission (workloads/tenancy.py): per-tenant
         # token/TTFT attribution always-on once configured; the prefix
         # pool below only when tenancy.prefix_pool > 0.  tenancy=None
@@ -960,10 +987,21 @@ class ContinuousBatcher:
             # ride as operands), so replicas share one compile for it
             # too — each keeps its OWN pool rows and LRU state
             self._pooled_insert = source._pooled_insert
-        if self.decode_block > 1:
+        # copy whichever decode program both sides BUILT: the engine
+        # key above matches live decode_block values, but a live knob
+        # change can leave a block-engine donor at block 1 — a fresh
+        # single-step replica must be told apart from it, not handed a
+        # program it cannot run
+        if hasattr(source, "_block_fn") and hasattr(self, "_block_fn"):
             self._block_fn = source._block_fn
-        else:
+        elif hasattr(source, "_decode") and hasattr(self, "_decode"):
             self._decode = source._decode
+        else:
+            raise ValueError(
+                "engine mismatch: donor and replica were constructed "
+                "on different decode paths (block-scan vs single-step) "
+                "— construct the replica with the donor's engine class"
+            )
 
     def _engine_key(self) -> tuple:
         """The static knobs the plain path's compiled programs depend on."""
@@ -974,6 +1012,88 @@ class ContinuousBatcher:
             self.decode_block, self.mesh is None,
             self._pool_prefix_len,
         )
+
+    # ------------------------------------------------------------------
+    # Live engine knobs (sched/knobs.py KnobActuator): each change is
+    # requested between cycles and lands at the knob's safe point.
+    # Unused, every flag keeps the per-cycle paths byte-identical.
+    # ------------------------------------------------------------------
+
+    def request_decode_block(self, block: int) -> bool:
+        """Stage a live decode-block change, completed inside the next
+        :meth:`step` at the RE-DISPATCH boundary: the engine skips one
+        dispatch-ahead so the in-flight block settles at the old size,
+        then dispatches the next block at the new one.  The compiled
+        block scan derives its length from the key operand's shape, so
+        a new size is one cached retrace — never a rebuild, never a
+        mid-block tear.  Block/gang engines only (an engine constructed
+        at ``decode_block == 1`` runs the single-step path and has no
+        block program to resize).  Returns False when ``block`` is
+        already the live (or staged) size."""
+        if not self._block_engine:
+            raise ValueError(
+                "decode_block is a live knob only on the block/gang "
+                "decode engine (construct with decode_block > 1, or "
+                "the sharded plane)"
+            )
+        block = int(block)
+        if block < 1:
+            raise ValueError(f"decode_block={block} must be >= 1")
+        current = (
+            self._pending_decode_block
+            if self._pending_decode_block is not None
+            else self.decode_block
+        )
+        if block == current:
+            return False
+        if self._pending_block is None and self.active == 0:
+            # idle engine: nothing in flight at any size — swap now
+            # (step() early-outs while idle, so a staged swap would
+            # otherwise wait for the next admission's first step)
+            self.decode_block = block
+            self._pending_decode_block = None
+            return True
+        self._pending_decode_block = block
+        return True
+
+    def _apply_pending_decode_block(self) -> None:
+        """Complete a staged block swap — called by the step bodies
+        AFTER the old-size block settled and only when nothing is in
+        flight (``_pending_block is None``)."""
+        if self._pending_decode_block is None:
+            return
+        self.decode_block = self._pending_decode_block
+        self._pending_decode_block = None
+
+    def set_slot_limit(self, limit: int | None) -> None:
+        """Cap admission at ``limit`` busy rows (per shard on the
+        sharded plane); ``None`` = unlimited (the reference path).
+        Pure host bookkeeping at the availability scan — rows already
+        above a lowered limit decode to completion (drain, never a
+        kill), and raising the limit re-offers the parked rows on the
+        very next refill."""
+        if limit is not None:
+            limit = int(limit)
+            per_shard = getattr(self, "shard_slots", len(self.slots))
+            if not 1 <= limit <= per_shard:
+                raise ValueError(
+                    f"slot_limit={limit} must be in [1, {per_shard}] "
+                    "(or None = unlimited)"
+                )
+        self.slot_limit = limit
+        self._invalidate_admission_cache()
+
+    def set_speculative(self, enabled: bool) -> None:
+        """Toggle the speculative engine's second-round overlap (the
+        dispatch-ahead of provably-needed draft-and-verify rounds).
+        Safe between rounds — the flag is read once per :meth:`step`.
+        Draft engines only."""
+        if not self.draft_layers:
+            raise ValueError(
+                "the speculative knob needs the draft-and-verify "
+                "engine (draft_layers > 0)"
+            )
+        self.spec_overlap = bool(enabled)
 
     def _make_insert_many(self, resume: bool = False):
         """The plain path's batched-admission jit: ``(params, cache,
@@ -1553,12 +1673,21 @@ class ContinuousBatcher:
 
     @property
     def free_slots(self) -> list[int]:
+        self.free_slot_scans += 1
         if self._tainted:
-            return [
+            rows = [
                 i for i, s in enumerate(self.slots)
                 if not s.busy and i not in self._tainted
             ]
-        return [i for i, s in enumerate(self.slots) if not s.busy]
+        else:
+            rows = [i for i, s in enumerate(self.slots) if not s.busy]
+        if self.slot_limit is not None:
+            # the active-slot knob: offer at most limit - busy rows
+            # (never negative — rows above a freshly-lowered limit
+            # simply finish, admission just stops offering headroom)
+            busy = sum(s.busy for s in self.slots)
+            rows = rows[: max(0, self.slot_limit - busy)]
+        return rows
 
     def _invalidate_admission_cache(self) -> None:
         """Hook for planes that memoize admission availability (the
@@ -2019,7 +2148,10 @@ class ContinuousBatcher:
             return self._step_beam()
         if self.draft_layers:
             return self._step_spec()
-        if self.decode_block > 1:
+        if self._block_engine:
+            # routed on the CONSTRUCTED engine, not the live block size:
+            # a live decode_block knob change can take the block engine
+            # to 1 (a one-step scan), which is not the single-step path
             return self._step_block()
         return self._step_single()
 
@@ -2067,7 +2199,11 @@ class ContinuousBatcher:
         """
         new_block = None
         busy = sum(s.busy for s in self.slots)
-        if busy:
+        if busy and self._pending_decode_block is None:
+            # a staged decode_block swap skips exactly one dispatch:
+            # the in-flight block settles below at the OLD size, the
+            # swap lands, and the next cycle dispatches at the new one
+            # — the re-dispatch boundary, never a mid-block resize
             (self.cache, self._current, self._done, self._remaining,
              tokens, counts) = self._block_fn(
                 self.params, self.cache, self._current, self._done,
@@ -2102,6 +2238,10 @@ class ContinuousBatcher:
         if self._tainted:
             self._invalidate_admission_cache()
         self._tainted.clear()
+        if self._pending_block is None:
+            # nothing in flight at the old size: a staged decode_block
+            # swap is safe to land — the next dispatch uses it
+            self._apply_pending_decode_block()
         return self._finish_ready()
 
     def _dispatch_spec_round(self, mask: list[bool]):
@@ -2158,7 +2298,8 @@ class ContinuousBatcher:
                 for row, slot in enumerate(self.slots)
             ]
             second_round = (
-                self._dispatch_spec_round(certain) if any(certain) else None
+                self._dispatch_spec_round(certain)
+                if any(certain) and self.spec_overlap else None
             )
             self._consume_spec_round(needs, first_round)
             if second_round is not None:
@@ -2477,7 +2618,12 @@ class ContinuousWorker:
         if self.tenancy is not None:
             return self._refill_tenant()
         self.refill_cycles += 1  # liveness: this worker's loop is running
-        free = len(self.batcher.free_slots)
+        # capacity only — the bare count, not the routed ordering (the
+        # sharded plane's free_slots pays a freest-first merge over
+        # S x B rows; the refill only needs to size its receive, and
+        # the actual admission consumes the ordering once inside
+        # submit_many).  ROADMAP item 1's remaining per-cycle debt.
+        free = self.batcher._free_slot_count()
         if not free:
             return 0
         if self._poll_backoff > 0:
@@ -2503,7 +2649,9 @@ class ContinuousWorker:
         queue with visibility 0: backpressure, never loss."""
         self.refill_cycles += 1  # liveness: this worker's loop is running
         self._fair.note_cycle()  # decay the arrival-rate classifier
-        free = len(self.batcher.free_slots)
+        # capacity only (see _refill): the DRR pick is sized by the
+        # count; the routed ordering is paid once by the admission
+        free = self.batcher._free_slot_count()
         messages = []
         if self._poll_backoff > 0:
             self._poll_backoff -= 1

@@ -195,6 +195,10 @@ class ShardedBatcher(ContinuousBatcher):
         self.gang_cycles = 0
         self.summary_transfers = 0
         self._gang_fn = self._make_gang_fn()
+        # the gang scan derives its block length from the key operand's
+        # shape, so the live decode_block knob applies at ANY
+        # constructed size (the base class only arms it past 1)
+        self._block_engine = True
 
     # ------------------------------------------------------------------
     # Engine identity / adoption
@@ -439,6 +443,14 @@ class ShardedBatcher(ContinuousBatcher):
             if self.shard_probing[s]:
                 cap = max(0, 1 - self.shard_busy(s))
                 per_shard[s] = per_shard[s][:cap]
+        if self.slot_limit is not None:
+            # the active-slot knob, per shard: offer at most
+            # limit - busy rows (rows above a lowered limit finish —
+            # drain semantics, same contract as the probing cap)
+            for s in range(self.shards):
+                if per_shard[s]:
+                    cap = max(0, self.slot_limit - self.shard_busy(s))
+                    per_shard[s] = per_shard[s][:cap]
         self._avail_cache = per_shard
         return per_shard
 
@@ -451,6 +463,7 @@ class ShardedBatcher(ContinuousBatcher):
         fill in index order.  ``submit_many`` consuming this order IS
         the cross-shard router — the whole refill still prefills as one
         global-row ``[M, P]`` insert."""
+        self.free_slot_scans += 1  # routed orderings computed (audit)
         per_shard = self._admission_rows_by_shard()
         order: list[int] = []
         heads = [0] * self.shards
@@ -570,7 +583,11 @@ class ShardedBatcher(ContinuousBatcher):
     def _step_gang(self) -> list[tuple[Any, np.ndarray]]:
         new_block = None
         busy = sum(s.busy for s in self.slots)
-        if busy:
+        if busy and self._pending_decode_block is None:
+            # staged decode_block swap: skip exactly one gang dispatch
+            # so the in-flight block settles at the old size — the
+            # re-dispatch boundary (see the block engine's identical
+            # contract)
             (self.cache, self._current, self._done, self._remaining,
              tokens, counts, free, bad) = self._gang_fn(
                 self.params, self.cache, self._current, self._done,
@@ -659,6 +676,10 @@ class ShardedBatcher(ContinuousBatcher):
         if self._tainted:
             self._invalidate_admission_cache()
         self._tainted.clear()
+        if self._pending_block is None:
+            # nothing in flight at the old size: a staged decode_block
+            # swap lands here; the next gang dispatch uses it
+            self._apply_pending_decode_block()
         busy_before = [self.shard_busy(s) for s in range(self.shards)]
         finished = self._finish_ready()
         for s in range(self.shards):

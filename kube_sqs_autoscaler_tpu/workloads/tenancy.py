@@ -1250,6 +1250,12 @@ class PrefixPool:
         # would silently share one KV row between two tenants.)
         self._free_slots: list[list[int]] = [[] for _ in range(shards)]
         self._next_slot: list[int] = [0] * shards
+        # residency ceiling within the allocated arena (the prefix_pool
+        # engine knob, sched/knobs.py): installs evict down to it, so
+        # lowering it live shrinks the pool's working footprint without
+        # a realloc.  Defaults to the full allocation = today's
+        # behavior byte for byte.
+        self.capacity = entries
         self.hits = 0
         self.misses = 0
         self.installs = 0
@@ -1333,20 +1339,25 @@ class PrefixPool:
                 f"bucket; got {ids.size} tokens (the worker prepends "
                 "off-bucket prefixes to the prompt instead)"
             )
-        if self._free_slots[shard]:
-            import heapq
-
-            slot = heapq.heappop(self._free_slots[shard])
-        elif self._next_slot[shard] < self.entries:
-            slot = self._next_slot[shard]
-            self._next_slot[shard] += 1
-        else:
+        if len(lru) >= self.capacity:
+            # at the residency ceiling (the live prefix_pool knob; ==
+            # the allocation by default, where this reduces to the old
+            # arena-exhausted branch): evict the LRU victim.  Same-
+            # batch safety holds because the knob floor keeps capacity
+            # >= per-shard slots (sched/knobs.py validates).
             victim, slot = lru.popitem(last=False)
             self.evictions += 1
             self.events.append(_PoolEvent(
                 "prefix-evict", time.perf_counter(),
                 {"shard": shard, "tenant": victim[0], "slot": slot},
             ))
+        elif self._free_slots[shard]:
+            import heapq
+
+            slot = heapq.heappop(self._free_slots[shard])
+        else:
+            slot = self._next_slot[shard]
+            self._next_slot[shard] += 1
         entry = self._prefill_entry(ids)
         self._write_entry(entry, shard * self.entries + slot)
         lru[key] = slot
@@ -1384,6 +1395,24 @@ class PrefixPool:
                      "reason": "pressure"},
                 ))
         return evicted
+
+    def set_capacity(self, capacity: int) -> int:
+        """Move the pool's residency ceiling within the allocated
+        arena — the live ``prefix_pool`` engine knob.  Shrinking
+        evicts LRU-cold entries down to the new ceiling NOW (returns
+        how many); growing simply re-opens headroom up to the
+        allocation.  The arena itself never reallocates (that is a
+        redeploy, not a knob), and the caller (sched/knobs.py) holds
+        the ``>= per-shard slots`` floor that keeps same-batch
+        eviction corruption impossible."""
+        capacity = int(capacity)
+        if not 1 <= capacity <= self.entries:
+            raise ValueError(
+                f"capacity={capacity} must be in [1, {self.entries}] "
+                "(the allocated arena)"
+            )
+        self.capacity = capacity
+        return self.evict_cold(capacity)
 
     def trace_events(self, time_origin: float | None = None) -> list[dict]:
         """The pool's install/evict decisions as Chrome-trace instant
