@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -163,6 +163,19 @@ bench-restart:
 # BENCH_r19.json
 bench-knobs:
 	JAX_PLATFORMS=cpu python bench.py --suite knobs
+
+# Disaggregated prefill/decode planes (CPU JAX, ~a minute): the
+# two-plane pool (batched prefill inserts, KV handoff into the
+# gang-stepped speculative decode plane, both planes actuated as
+# independent Scaler targets) vs the fused sharded plane at FIXED total
+# hardware on the same virtual-clock workload; exits 2 unless TTFT p99
+# is strictly better with tokens/s parity, greedy outputs are
+# byte-identical per request across every handoff (prefill kill
+# included), every request is answered exactly once, the measured
+# accept-rate economics flip speculation off AND back on, and the
+# per-plane gauges export; writes BENCH_r20.json
+bench-disagg:
+	JAX_PLATFORMS=cpu python bench.py --suite disagg
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

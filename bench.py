@@ -4898,13 +4898,641 @@ def run_knobs_suite(
     }
 
 
+def _disagg_prompt_ids(tag: str, k: int, prompt_len: int,
+                       vocab: int = 64) -> list:
+    """The k-th deterministic prompt for ``tag``: hash-seeded ids with a
+    hash-seeded length in [2, prompt_len] — same convention as the
+    tenant battery (sim.scenarios.seeded_token_ids) so the fused and
+    disaggregated episodes replay byte-identical traffic."""
+    from kube_sqs_autoscaler_tpu.sim.scenarios import seeded_token_ids
+
+    stream = seeded_token_ids(f"disagg:{tag}:{k}", prompt_len + 1, vocab)
+    length = 2 + stream[0] % max(1, prompt_len - 1)
+    return stream[1:1 + length]
+
+
+def _disagg_probe_accept_rates(
+    model, params, candidates, *, generate_tokens, decode_block,
+    spec_layers, spec_tokens,
+):
+    """Measure each candidate prompt's draft accept rate on the real
+    seeded model (one row, spec on, drain) — the reproducible partition
+    the measured-economics episode is built from."""
+    from kube_sqs_autoscaler_tpu.planes.engine import DecodePlaneBatcher
+
+    plane = DecodePlaneBatcher(
+        params, model, shards=1, shard_slots=1,
+        prompt_len=model.max_seq_len - generate_tokens - 2 * spec_tokens,
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        spec_layers=spec_layers, spec_tokens=spec_tokens,
+    )
+    rated = []
+    for ids in candidates:
+        before = (plane.spec_accepted, plane.spec_rounds)
+        plane.submit_many([(ids, "probe")])
+        for _ in range(200):
+            plane.step()
+            if plane.active == 0:
+                break
+        accepted = plane.spec_accepted - before[0]
+        rounds = plane.spec_rounds - before[1]
+        rate = accepted / (rounds * spec_tokens) if rounds else 0.0
+        rated.append((rate, ids))
+    rated.sort(key=lambda pair: pair[0], reverse=True)
+    return rated
+
+
+def _disagg_episode(
+    *, disagg, model, params, schedule, tenants, prompt_pools,
+    batch_size, prompt_len, generate_tokens, decode_block,
+    fused_shards, prefill_replicas, decode_shards,
+    spec_layers, spec_tokens, draft_enabled,
+    insert_cost_s, decode_cost_s, handoff_cost_s, poll_cost_s,
+    flip_policy_factory=None, kill_after=None, metrics=None,
+    prefill_engine_source=None, decode_engine_source=None,
+    fused_engine_source=None, decode_steps_per_cycle=2,
+    max_cycles=4000,
+):
+    """One virtual-time serving episode, fused or disaggregated.
+
+    Both deployments replay the same tenant-tagged schedule at the same
+    total slot count and are charged the same per-dispatch device-cost
+    model on a :class:`FakeClock` — fused pays prefill + decode
+    SERIALIZED on one box, disagg pays the MAX of the two planes (they
+    are separate hardware) plus the handoff copies on the decode side.
+    Deterministic: no wall-clock anywhere; TTFTs are arrival-stamped
+    virtual seconds via the tenancy plane.
+    """
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.fleet import DRAINING, SERVING
+    from kube_sqs_autoscaler_tpu.fleet.worker import FleetWorker
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.planes import DisaggregatedPool
+    from kube_sqs_autoscaler_tpu.workloads.service import (
+        ServiceConfig,
+        collect_replies,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    clock = FakeClock()
+    queue = FakeMessageQueue(visibility_timeout=1e6, now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    service = ServiceConfig(
+        queue_url="disagg://q", batch_size=batch_size,
+        seq_len=prompt_len, generate_tokens=generate_tokens,
+        decode_block=decode_block, shards=fused_shards,
+        result_queue_url="disagg://r",
+    )
+    tenancy = TenancyConfig(tenants=tuple(tenants))
+    if disagg:
+        target = DisaggregatedPool.serving(
+            queue, params, model, service, result_queue=results,
+            min=prefill_replicas, max=prefill_replicas,
+            decode_shards=decode_shards, spec_layers=spec_layers,
+            spec_tokens=spec_tokens, draft_enabled=draft_enabled,
+            tenancy=tenancy, now_fn=clock.now, clock=clock,
+            prefill_engine_source=prefill_engine_source,
+            decode_engine_source=decode_engine_source,
+            decode_steps_per_cycle=decode_steps_per_cycle,
+        )
+        decode_batcher = target.decode.batcher
+        if metrics is not None:
+            target.attach_metrics(metrics)
+            target.decode.attach_metrics(metrics)
+    else:
+        target = FleetWorker(
+            queue, params, model, service, result_queue=results,
+            sharded=True, tenancy=tenancy, now_fn=clock.now,
+            engine_source=fused_engine_source,
+        )
+        decode_batcher = None
+        if metrics is not None:
+            target.attach_metrics(metrics)
+
+    flip_policy = None
+    if flip_policy_factory is not None:
+        flip_policy = flip_policy_factory(target, clock)
+
+    def live_batchers():
+        if not disagg:
+            return [target.batcher]
+        return [
+            r.worker.batcher for r in target.members
+            if r.state in (SERVING, DRAINING)
+        ]
+
+    last: dict[int, tuple] = {}
+
+    def advance():
+        """Charge this cycle's device dispatches to the virtual clock."""
+        plane_dts = []
+        for batcher in live_batchers():
+            key = id(batcher)
+            ins, dec = batcher.insert_dispatches, batcher.decode_dispatches
+            p_ins, p_dec = last.get(key, (0, 0))
+            last[key] = (ins, dec)
+            plane_dts.append(
+                insert_cost_s * (ins - p_ins)
+                + (0 if disagg else decode_cost_s * (dec - p_dec))
+            )
+        dt = max(plane_dts, default=0.0)
+        if decode_batcher is not None:
+            key = id(decode_batcher)
+            ins = decode_batcher.insert_dispatches
+            dec = decode_batcher.decode_dispatches
+            p_ins, p_dec = last.get(key, (0, 0))
+            last[key] = (ins, dec)
+            # handoff copies + gang/spec dispatches, on the decode box
+            decode_dt = (
+                handoff_cost_s * (ins - p_ins)
+                + decode_cost_s * (dec - p_dec)
+            )
+            dt = max(dt, decode_dt)
+        clock.advance(max(dt, poll_cost_s))
+
+    total = sum(count for row in schedule for _, count in row)
+    sent_ids: list[str] = []
+    sent_tenants: list[str] = []
+    counters = {tenant: 0 for tenant in tenants}
+    killed: dict | None = None
+    cycle = 0
+    while True:
+        if cycle < len(schedule):
+            for tenant, count in schedule[cycle]:
+                pool = prompt_pools[tenant]
+                for _ in range(count):
+                    ids = pool(counters[tenant])
+                    counters[tenant] += 1
+                    sent_ids.append(queue.send_message(
+                        "disagg://q",
+                        json.dumps({"tenant": tenant,
+                                    "ids": [int(i) for i in ids]}),
+                    ))
+                    sent_tenants.append(tenant)
+        if (kill_after is not None and killed is None
+                and cycle >= kill_after and disagg):
+            victims = [r for r in target.members if r.state == SERVING]
+            victim = victims[-1] if victims else None
+            if victim is not None and victim.worker.batcher.active > 0:
+                killed = {
+                    "cycle": cycle,
+                    "replica": victim.index,
+                    "inflight_rows": int(victim.worker.batcher.active),
+                    "ready_handoffs": len(victim.worker.ready_handoffs()),
+                    "kv_handoffs_before": target.kv_handoffs_total,
+                }
+                victim.worker.kill()
+        if disagg:
+            target.run_cycle()
+        else:
+            target.run_once()
+        advance()
+        if flip_policy is not None:
+            flip_policy(cycle, sent_tenants)
+        cycle += 1
+        if cycle >= len(schedule):
+            if disagg:
+                done = target.processed >= total and target.idle
+            else:
+                done = (
+                    target.processed >= total
+                    and target.batcher.active == 0
+                    and getattr(target, "staged", 0) == 0
+                )
+            if done:
+                break
+        if cycle >= max_cycles:
+            break
+
+    replies, duplicates = collect_replies(results, "disagg://r")
+    reply_tokens = [
+        replies[mid]["tokens"] if mid in replies else None
+        for mid in sent_ids
+    ]
+    ttft_samples: list[float] = []
+    ttft_by_tenant: dict[str, list] = {}
+    for batcher in live_batchers():
+        for tenant, samples in batcher.tenant_ttft.items():
+            ttft_by_tenant.setdefault(tenant, []).extend(samples)
+            ttft_samples.extend(samples)
+    tokens = sum(len(t) for t in reply_tokens if t)
+    elapsed = clock.now()
+    episode = {
+        "deployment": "disagg" if disagg else "fused",
+        "requests": total,
+        "answered": len(replies),
+        "duplicates": duplicates,
+        "lost": sum(1 for t in reply_tokens if t is None),
+        "cycles": cycle,
+        "virtual_s": round(elapsed, 6),
+        "tokens": tokens,
+        "tokens_per_second": round(tokens / max(elapsed, 1e-9), 2),
+        "ttft_p99_s": round(_ttft_p99(ttft_samples), 6),
+        "ttft_count": len(ttft_samples),
+        "ttft_p99_by_tenant": {
+            tenant: round(_ttft_p99(samples), 6)
+            for tenant, samples in sorted(ttft_by_tenant.items())
+        },
+    }
+    if disagg:
+        episode["kv_handoffs"] = target.kv_handoffs_total
+        episode["prefill_replicas"] = prefill_replicas
+        episode["decode_shards"] = decode_shards
+        episode["spec"] = {
+            "rounds": decode_batcher.spec_rounds,
+            "accept_rate": decode_batcher.accept_rate(),
+            "accept_rate_by_tenant": {
+                tenant: decode_batcher.accept_rate(tenant)
+                for tenant in sorted(decode_batcher.tenant_spec_rounds)
+            },
+            "flips": decode_batcher.spec_flips,
+        }
+    else:
+        episode["shards"] = fused_shards
+    if killed is not None:
+        killed["kv_handoffs_after"] = target.kv_handoffs_total
+        episode["kill"] = killed
+    return episode, reply_tokens, target
+
+
+def run_disagg_suite(
+    output: str = "BENCH_r20.json", *,
+    prompt_len: int = 10, generate_tokens: int = 3, batch_size: int = 2,
+    decode_block: int = 2, spec_layers: int = 1, spec_tokens: int = 2,
+    prefill_replicas: int = 2, decode_shards: int = 2,
+    insert_cost_s: float = 0.006, decode_cost_s: float = 0.002,
+    handoff_cost_s: float = 0.0005, poll_cost_s: float = 0.0004,
+    probe_candidates: int = 18, accept_gap_floor: float = 0.05,
+    timing_gates: bool = True,
+) -> dict:
+    """Disaggregated prefill/decode planes vs the fused sharded engine
+    (ISSUE 16), hard-gated (exit 2) on:
+
+    - **TTFT at fixed hardware** — under the prefill-wave scenario the
+      disaggregated deployment's arrival-stamped TTFT p99 is strictly
+      better than the fused plane's at the SAME total slot count, with
+      tokens/s no worse.  Virtual-time: both sides are charged one
+      per-dispatch device-cost model on a FakeClock (fused pays the
+      [M,P] insert and the gang block serialized on one box; the planes
+      pay the max, plus the KV-handoff copies on the decode side), so
+      the gate is deterministic;
+    - **exact greedy parity per request** — every request's reply
+      tokens are byte-identical fused vs disaggregated (the KV handoff
+      changes WHERE decode happens, never WHAT it emits), and
+      byte-identical again through live speculative flips;
+    - **exactly-once through every handoff** — every request in every
+      episode is answered exactly once, including a prefill replica
+      killed mid-handoff with in-flight rows (orphans re-prefill on
+      survivors; the shared reply registry suppresses any second
+      reply);
+    - **speculative flips live, both directions, by measured
+      economics** — per-tenant accept rates measured on the decode
+      plane drive the ``speculative`` knob through the
+      :class:`KnobActuator` seam: drafting flips OFF when the traffic
+      mix turns draft-hostile and back ON when it turns friendly, with
+      the per-tenant accept-rate gauges exported.
+
+    ``timing_gates=False`` (the tier-1 smoke) shrinks the populations
+    and skips the TTFT/tokens-per-second win gate; every parity,
+    exactly-once, and flip gate still runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+    from kube_sqs_autoscaler_tpu.sched.knobs import (
+        KNOB_SPECULATIVE,
+        KnobActuator,
+    )
+    from kube_sqs_autoscaler_tpu.sim.scenarios import disagg_scenario
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    start = time.perf_counter()
+    failures: list[str] = []
+    model = ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=prompt_len + generate_tokens + 2 * spec_tokens,
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    fused_shards = prefill_replicas + decode_shards  # fixed hardware
+    if timing_gates:
+        scenario = disagg_scenario(
+            tenants=2, cycles=36, every=2,
+            wave_start=8, wave_cycles=6, wave_per_cycle=6,
+        )
+        flip_phases = (14, 22, 16)  # friendly / hostile / friendly
+        probe_n = probe_candidates
+    else:
+        scenario = disagg_scenario(
+            tenants=2, cycles=14, every=2,
+            wave_start=4, wave_cycles=3, wave_per_cycle=2,
+        )
+        flip_phases = (6, 10, 8)
+        # the probe is cheap (single-slot plane, a couple of rounds per
+        # candidate) and the accept-rate spread lives in the tail of
+        # the candidate stream — keep the full population in the smoke
+        probe_n = probe_candidates
+    costs = dict(
+        insert_cost_s=insert_cost_s, decode_cost_s=decode_cost_s,
+        handoff_cost_s=handoff_cost_s, poll_cost_s=poll_cost_s,
+    )
+    shape = dict(
+        model=model, params=params, batch_size=batch_size,
+        prompt_len=prompt_len, generate_tokens=generate_tokens,
+        decode_block=decode_block, fused_shards=fused_shards,
+        prefill_replicas=prefill_replicas, decode_shards=decode_shards,
+        spec_layers=spec_layers, spec_tokens=spec_tokens, **costs,
+    )
+
+    # -- the prefill-wave comparison: TTFT + tokens/s + greedy parity --
+    wave_pools = {
+        tenant: (lambda t: lambda k: _disagg_prompt_ids(
+            t, k, prompt_len))(tenant)
+        for tenant in scenario.tenants
+    }
+    fused_ep, fused_replies, fused_worker = _disagg_episode(
+        disagg=False, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=wave_pools,
+        draft_enabled=False, **shape,
+    )
+    disagg_ep, disagg_replies, disagg_pool = _disagg_episode(
+        disagg=True, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=wave_pools,
+        draft_enabled=False, **shape,
+    )
+    mismatched = sum(
+        1 for a, b in zip(fused_replies, disagg_replies) if a != b
+    )
+    if mismatched or len(fused_replies) != len(disagg_replies):
+        failures.append(
+            f"parity: {mismatched}/{len(fused_replies)} requests decoded "
+            f"differently across the KV handoff"
+        )
+    for name, episode in (("fused", fused_ep), ("disagg", disagg_ep)):
+        if episode["lost"] or episode["answered"] != episode["requests"]:
+            failures.append(
+                f"{name}: {episode['answered']}/{episode['requests']} "
+                f"answered ({episode['lost']} lost)"
+            )
+        if episode["duplicates"]:
+            failures.append(f"{name}: duplicate replies")
+    if disagg_ep.get("kv_handoffs", 0) <= 0:
+        failures.append("disagg: the KV shuttle never moved a row")
+    if timing_gates:
+        if not disagg_ep["ttft_p99_s"] < fused_ep["ttft_p99_s"]:
+            failures.append(
+                f"win: disagg TTFT p99 {disagg_ep['ttft_p99_s']}s did "
+                f"not beat fused {fused_ep['ttft_p99_s']}s at "
+                f"{fused_shards * batch_size} total slots"
+            )
+        if not disagg_ep["tokens_per_second"] \
+                >= fused_ep["tokens_per_second"]:
+            failures.append(
+                f"win: disagg tokens/s {disagg_ep['tokens_per_second']} "
+                f"worse than fused {fused_ep['tokens_per_second']}"
+            )
+
+    # -- exactly-once through a prefill kill mid-handoff ---------------
+    kill_ep, kill_replies, _ = _disagg_episode(
+        disagg=True, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=wave_pools,
+        draft_enabled=False,
+        kill_after=scenario.cycles // 3,
+        prefill_engine_source=disagg_pool.engine_donor(),
+        decode_engine_source=disagg_pool.decode.batcher,
+        # gang cadence 1: prefill rows strand awaiting handoff when the
+        # decode plane is busy, so the kill lands mid-handoff for real
+        decode_steps_per_cycle=1,
+        **shape,
+    )
+    if "kill" not in kill_ep:
+        failures.append(
+            "kill: no prefill replica had in-flight rows to kill — "
+            "retune the wave"
+        )
+    else:
+        if kill_ep["kill"]["inflight_rows"] <= 0:
+            failures.append("kill: the killed replica was idle")
+        if kill_ep["kill"]["kv_handoffs_after"] \
+                <= kill_ep["kill"]["kv_handoffs_before"]:
+            failures.append(
+                "kill: the shuttle never moved a row after the kill"
+            )
+    if kill_ep["lost"] or kill_ep["answered"] != kill_ep["requests"]:
+        failures.append(
+            f"kill: {kill_ep['answered']}/{kill_ep['requests']} answered "
+            f"({kill_ep['lost']} lost)"
+        )
+    if kill_ep["duplicates"]:
+        failures.append("kill: duplicate replies through the handoff")
+    kill_mismatch = sum(
+        1 for a, b in zip(fused_replies, kill_replies) if a != b
+    )
+    if kill_mismatch:
+        failures.append(
+            f"kill: {kill_mismatch} requests decoded differently after "
+            f"the mid-handoff kill (re-prefill must be greedy-exact)"
+        )
+
+    # -- measured-economics speculative flips ---------------------------
+    rated = _disagg_probe_accept_rates(
+        model, params,
+        [_disagg_prompt_ids("probe", k, prompt_len)
+         for k in range(probe_n)],
+        generate_tokens=generate_tokens, decode_block=decode_block,
+        spec_layers=spec_layers, spec_tokens=spec_tokens,
+    )
+    third = max(1, len(rated) // 3)
+    friendly = [ids for _, ids in rated[:third]]
+    hostile = [ids for _, ids in rated[-third:]]
+    mean_friendly = sum(r for r, _ in rated[:third]) / third
+    mean_hostile = sum(r for r, _ in rated[-third:]) / third
+    if mean_friendly - mean_hostile < accept_gap_floor:
+        raise RuntimeError(
+            f"probe: accept-rate gap {mean_friendly:.3f} vs "
+            f"{mean_hostile:.3f} too narrow to drive the economics "
+            f"episode; widen probe_candidates"
+        )
+    threshold = (mean_friendly + mean_hostile) / 2
+    a, b, c = flip_phases
+    flip_schedule = []
+    for cycle in range(a + b + c):
+        if cycle < a or cycle >= a + b:
+            flip_schedule.append([("friendly", 1)])
+        else:
+            flip_schedule.append([("hostile", 2)])
+    flip_pools = {
+        "friendly": lambda k: friendly[k % len(friendly)],
+        "hostile": lambda k: hostile[k % len(hostile)],
+    }
+    flip_changes: list[dict] = []
+
+    def flip_policy_factory(pool, clock):
+        actuator = KnobActuator(
+            pool, armed=(KNOB_SPECULATIVE,), clock=clock,
+        )
+        batcher = pool.decode.batcher
+
+        def policy(cycle, sent_tenants):
+            mix = sent_tenants[-6:]
+            if not mix:
+                return
+            expected = sum(
+                # unknown tenants draft optimistically: drafting is the
+                # only way to measure them
+                1.0 if batcher.accept_rate(t) is None
+                else batcher.accept_rate(t)
+                for t in mix
+            ) / len(mix)
+            if actuator.set(KNOB_SPECULATIVE, expected >= threshold):
+                flip_changes.extend(actuator.apply())
+
+        return policy
+
+    flip_metrics = WorkloadMetrics()
+    flip_ep, flip_replies, _ = _disagg_episode(
+        disagg=True, schedule=flip_schedule,
+        tenants=("friendly", "hostile"), prompt_pools=flip_pools,
+        draft_enabled=True, flip_policy_factory=flip_policy_factory,
+        metrics=flip_metrics,
+        prefill_engine_source=disagg_pool.engine_donor(),
+        decode_engine_source=disagg_pool.decode.batcher,
+        **shape,
+    )
+    plain_ep, plain_replies, _ = _disagg_episode(
+        disagg=True, schedule=flip_schedule,
+        tenants=("friendly", "hostile"), prompt_pools=flip_pools,
+        draft_enabled=False,
+        prefill_engine_source=disagg_pool.engine_donor(),
+        decode_engine_source=disagg_pool.decode.batcher,
+        **shape,
+    )
+    flip_values = [c["value"] for c in flip_changes]
+    if len(flip_changes) < 2 or True not in flip_values \
+            or False not in flip_values:
+        failures.append(
+            f"flip: expected measured economics to flip drafting BOTH "
+            f"ways, saw {flip_values}"
+        )
+    spec_mismatch = sum(
+        1 for x, y in zip(flip_replies, plain_replies) if x != y
+    )
+    if spec_mismatch:
+        failures.append(
+            f"flip: {spec_mismatch} requests decoded differently under "
+            f"live speculative flips (draft-and-verify must be "
+            f"greedy-exact)"
+        )
+    for name, episode in (("flip", flip_ep), ("flip-plain", plain_ep)):
+        if episode["lost"] or episode["answered"] != episode["requests"]:
+            failures.append(
+                f"{name}: {episode['answered']}/{episode['requests']} "
+                f"answered ({episode['lost']} lost)"
+            )
+        if episode["duplicates"]:
+            failures.append(f"{name}: duplicate replies")
+    if not flip_ep["spec"]["rounds"]:
+        failures.append("flip: the decode plane never ran a spec round")
+    gauge_text = flip_metrics.render()
+    for needle in (
+        'speculative_accept_rate{tenant="friendly"}',
+        'speculative_accept_rate{tenant="hostile"}',
+        "plane_kv_transfers_total",
+    ):
+        if needle not in gauge_text:
+            failures.append(f"gauges: {needle!r} not exported")
+
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "disagg",
+        "elapsed_s": round(elapsed, 2),
+        "hardware": {
+            "total_slots": fused_shards * batch_size,
+            "fused_shards": fused_shards,
+            "prefill_replicas": prefill_replicas,
+            "decode_shards": decode_shards,
+            "batch_size": batch_size,
+        },
+        "cost_model": costs,
+        "scenario": {"name": scenario.name,
+                     "description": scenario.description,
+                     "cycles": scenario.cycles},
+        "episodes": {
+            "fused": fused_ep, "disagg": disagg_ep,
+            "prefill-kill": kill_ep, "spec-flip": flip_ep,
+            "spec-plain": plain_ep,
+        },
+        "probe": {
+            "candidates": len(rated),
+            "accept_rate_friendly": round(mean_friendly, 4),
+            "accept_rate_hostile": round(mean_hostile, 4),
+            "threshold": round(threshold, 4),
+        },
+        "flip_changes": [
+            {"knob": c["knob"], "value": c["value"],
+             "previous": c["previous"], "t": round(c["t"], 6)}
+            for c in flip_changes
+        ],
+        "timing_gates": timing_gates,
+        "gates": {
+            "ttft": "disagg TTFT p99 strictly beats fused at the same "
+                    "total slot count, tokens/s no worse "
+                    "(virtual-time cost model)",
+            "parity": "per-request greedy tokens byte-identical across "
+                      "the KV handoff, the mid-handoff kill, and live "
+                      "speculative flips",
+            "exactly_once": "every request answered exactly once in "
+                            "every episode, including the prefill kill",
+            "economics": "per-tenant measured accept rates flip the "
+                         "speculative knob both directions through the "
+                         "actuator seam; accept-rate gauges exported",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"disagg: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    if timing_gates:
+        ttft_win = fused_ep["ttft_p99_s"] / max(
+            disagg_ep["ttft_p99_s"], 1e-9
+        )
+        value, unit = round(ttft_win, 2), (
+            f"x TTFT p99 vs fused at {fused_shards * batch_size} slots "
+            f"({disagg_ep['ttft_p99_s']}s vs {fused_ep['ttft_p99_s']}s) "
+            f"with tokens/s {disagg_ep['tokens_per_second']} vs "
+            f"{fused_ep['tokens_per_second']}, "
+            f"{disagg_ep['kv_handoffs']} KV handoffs, "
+            f"{len(flip_changes)} measured-economics spec flips, "
+            f"parity + exactly-once everywhere"
+        )
+    else:
+        value, unit = len(flip_changes), (
+            "spec flips by measured economics (smoke: timing gates "
+            "off), parity + exactly-once everywhere"
+        )
+    return {
+        "metric": "disagg_ttft_win",
+        "value": value,
+        "unit": unit,
+        "vs_baseline": value,
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
         "--suite",
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
-                 "tenants", "overload", "twin", "restart", "knobs"),
+                 "tenants", "overload", "twin", "restart", "knobs",
+                 "disagg"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -4942,7 +5570,13 @@ if __name__ == "__main__":
         " seam (scheduler-on/knobs-unarmed byte-identical to the"
         " hand-rolled drivers; adaptive decode-block beats every static"
         " config under a regime-switch workload; every knob change"
-        " journaled + snapshotted + gauge-exported)",
+        " journaled + snapshotted + gauge-exported); disagg ="
+        " disaggregated prefill/decode planes vs the fused sharded"
+        " engine (TTFT p99 win at fixed total slots with tokens/s no"
+        " worse under a virtual-time cost model; per-request greedy"
+        " parity across the KV handoff, a mid-handoff prefill kill, and"
+        " live speculative flips; exactly-once everywhere; per-tenant"
+        " measured accept rates flipping drafting both ways)",
     )
     cli.add_argument(
         "--output", default="",
@@ -4991,6 +5625,10 @@ if __name__ == "__main__":
     elif cli_args.suite == "knobs":
         print(json.dumps(
             run_knobs_suite(cli_args.output or "BENCH_r19.json")
+        ))
+    elif cli_args.suite == "disagg":
+        print(json.dumps(
+            run_disagg_suite(cli_args.output or "BENCH_r20.json")
         ))
     else:
         print(json.dumps(run_bench()))

@@ -60,7 +60,11 @@ Providers implement the :class:`StateProvider` protocol —
 count, ``import_state(state, rebase=, now=, max_age_s=)`` returning how
 many records were restored.  Wire-ups live with the subsystems
 (``core/resilience.py``, ``forecast/history.py``, ``learn/policy.py``,
-``fleet/pool.py``/``sharded.py``, ``workloads/tenancy.py``).
+``fleet/pool.py``/``sharded.py``, ``workloads/tenancy.py``,
+``sched/knobs.py``, and ``planes/pool.py`` — the disaggregated pool's
+section, :data:`~..planes.pool.DISAGG_SECTION`, carries the shared
+reply registry plus the plane-mode bit a restart must not forget:
+whether measured economics had speculative drafting on).
 
 Runnable as ``python -m kube_sqs_autoscaler_tpu.core.durable`` — the
 ``make restart-demo`` gate: a JAX-free FakeClock kill→restart→reconcile

@@ -143,9 +143,10 @@ def instant_trace_events(
     ``"shard"`` category so Perfetto can filter the shard failure
     domain separately from replica lifecycle events; prefix-pool
     residency decisions (``prefix-*``: the per-tenant pool's
-    install/evict instants) likewise land under ``"prefix"``, and the
+    install/evict instants) likewise land under ``"prefix"``, the
     overload ladder's tier transitions (``overload-*``) under
-    ``"overload"``.
+    ``"overload"``, and the disaggregated planes' KV-handoff batches
+    (``kv-*`` / ``plane-*``) under ``"plane"``.
     """
     events = list(events)
     if not events:
@@ -169,6 +170,12 @@ def instant_trace_events(
             # their own lane so an operator can line a tokens/s or
             # TTFT inflection up against the knob flip that caused it
             return "knob"
+        if name.startswith("kv-") or name.startswith("plane-"):
+            # the disaggregated planes (planes/pool.py): KV handoff
+            # batches and plane-level lifecycle instants — their own
+            # lane so the prefill->decode shuttle reads separately from
+            # replica churn
+            return "plane"
         return "fleet"
 
     return [

@@ -1,0 +1,42 @@
+"""Disaggregated prefill/decode planes behind one admission surface.
+
+The fused serving plane makes prefill and decode contend for the same
+gang slots even though they scale on different axes (prefill is
+admission-rate bound, decode is token-rate bound).  This package splits
+them:
+
+- :mod:`.prefill` — prefill workers run ONLY the batched ``[M, P]``
+  admission insert (never a decode dispatch) and surface finished rows'
+  KV for handoff; params are shared by reference and compiled programs
+  by :meth:`~..workloads.continuous.ContinuousBatcher.adopt_engine`, so
+  a prefill replica spins up in ~ms;
+- :mod:`.engine` — the decode plane: the sharded gang engine plus
+  first-class draft-and-verify (gang-stepped speculative rounds on the
+  ``[S, B]`` plane, per-tenant accept rate, live drain-to-plain) and
+  the ``submit_handoff`` KV transport that adopts a prefill row's cache
+  without re-running the forward pass;
+- :mod:`.pool` — :class:`~.pool.DisaggregatedPool`: both planes as
+  independent :class:`~..core.types.Scaler` targets through the
+  unchanged ``ControlLoop``/``sched`` seams, exactly-once replies
+  through the shared reply registry.
+
+``planes.pool`` is jax-free (like ``fleet``) so the actuator-contract
+tests drive it with stub workers; the jax engines import lazily.
+"""
+
+from .pool import DISAGG_SECTION, DisaggregatedPool
+
+__all__ = ["DisaggregatedPool", "DISAGG_SECTION", "DecodePlaneBatcher",
+           "PrefillWorker"]
+
+
+def __getattr__(name):  # lazy: keep `import planes` jax-free
+    if name == "DecodePlaneBatcher":
+        from .engine import DecodePlaneBatcher
+
+        return DecodePlaneBatcher
+    if name == "PrefillWorker":
+        from .prefill import PrefillWorker
+
+        return PrefillWorker
+    raise AttributeError(name)

@@ -1,0 +1,529 @@
+"""The decode plane: gang-stepped shards + first-class draft-and-verify.
+
+:class:`DecodePlaneBatcher` extends the sharded gang engine
+(:class:`~..workloads.shard_plane.ShardedBatcher`) with the two
+capabilities the disaggregated stack needs:
+
+**Speculative decoding on the ``[S, B]`` plane.**  The fused engine
+composes ``draft_layers`` only with the single plain batcher; here the
+draft-and-verify round (:meth:`~..workloads.continuous.ContinuousBatcher
+._make_spec_round`) runs over the WHOLE flat ``[S*B]`` row axis — the
+round body is per-row by construction (``where(active, ...)`` gates
+every advance), so the same compiled program serves all shards at once.
+Speculative rows are *frozen on device* (``done=True, remaining=0``, the
+same freeze :meth:`~..workloads.shard_plane.ShardedBatcher.kill_rows`
+uses) so the unchanged gang block skips them; their liveness is the
+host-side per-slot mode mark instead.  A cycle therefore dispatches at
+most one spec round (over the spec rows) plus one gang block (over the
+plain rows) — plain rows pay zero extra dispatches when drafting is off.
+
+**Drain-to-plain.**  ``set_speculative`` flips :attr:`draft_enabled`
+live: the mode is fixed per request AT ADMISSION, so in-flight drafted
+rows finish their speculative lives while every new admission lands
+plain (or vice versa) — no mid-request engine switch, and greedy
+parity per request is preserved in both directions because greedy
+draft-and-verify emits exactly the plain greedy continuation.
+
+**The KV handoff transport** (:meth:`DecodePlaneBatcher.submit_handoff`)
+adopts finished prefill rows from a prefill-plane batcher without
+re-running any model forward: one jitted full-row cache copy per
+handoff batch (every cache entry keys the row on axis 0, so the copy is
+layout-agnostic across gpt/llama/int8), plus the per-row
+length/pending/liveness arming that ``submit_resume``'s insert would
+have folded in.  Because the batched prefill is batch-invariant, the
+adopted rows decode bitwise what a fused engine would have produced —
+the disagg parity gate in ``bench.py --suite disagg`` pins this.  When
+drafting is on, the draft rows adopt the first ``spec_layers`` layers
+of the SAME donor rows (the early-exit self-draft's cache is a layer
+prefix of the target's — :func:`~..workloads.speculative
+.draft_prefix_from_target`'s identity), so a handoff seeds both planes
+in the one device call.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..workloads.continuous import (
+    _Slot,
+    _bounded_tenant_key,
+    _rows_prefill,
+    _splice_rows_layers,
+)
+from ..workloads.shard_plane import ShardedBatcher
+
+
+def _draft_rows_impl(
+    dparams: dict,
+    dcache: dict,
+    rows: jax.Array,
+    prompts: jax.Array,
+    lengths: jax.Array,
+    config: Any,
+    prompt_len: int,
+    n_rows: int,
+    family: str = "gpt",
+    quantized_kv: bool = False,
+) -> dict:
+    """Seed the draft cache for a speculative admission batch: the SAME
+    ``[M, P]`` batched prefill as the target insert, run through the
+    draft's layer-sliced params, spliced into the draft cache's rows.
+    The logits are never used — XLA drops the head matmul — so this is
+    ``spec_layers / n_layers`` of an admission insert's FLOPs."""
+    _, rows_cache = _rows_prefill(
+        dparams, prompts, lengths, config, family, quantized_kv, 0, None
+    )
+    new_layers = _splice_rows_layers(dcache, rows_cache, rows, 0,
+                                     prompt_len, n_rows)
+    new_lengths = dcache["length"].at[rows].set(lengths)
+    return {"layers": new_layers, "length": new_lengths}
+
+
+_draft_rows = partial(
+    jax.jit,
+    static_argnames=("config", "prompt_len", "n_rows", "family",
+                     "quantized_kv"),
+    donate_argnums=(1,),
+)(_draft_rows_impl)
+
+
+def _handoff_rows_impl(
+    cache: dict,
+    dcache: dict | None,
+    current: jax.Array,
+    done: jax.Array,
+    remaining: jax.Array,
+    src_cache: dict,
+    rows: jax.Array,
+    src_idx: jax.Array,
+    lasts: jax.Array,
+    budgets: jax.Array,
+    spec: bool = False,
+    spec_layers: int = 0,
+) -> tuple[dict, dict | None, jax.Array, jax.Array, jax.Array]:
+    """The KV handoff: adopt ``n`` finished prefill rows from a donor
+    cache into this plane's slot rows — a pure device copy, no model
+    forward.  Per entry the row moves whole (``[H, S, D]`` values and
+    ``[H, S]`` scales alike key the row on axis 0); positions past the
+    donor's per-row ``length`` are garbage on both sides, exactly as
+    they are after a native insert.  The per-row state arms like the
+    resume insert's fold: ``length`` copies the donor's, ``current``
+    takes the last produced token, and the gang-liveness masks arm live
+    (plain rows) or frozen (speculative rows, which the host steps via
+    draft-and-verify rounds instead)."""
+    src_lengths = src_cache["length"][src_idx]
+    new_layers = [
+        {name: buf.at[rows].set(src_layer[name][src_idx])
+         for name, buf in layer.items()}
+        for layer, src_layer in zip(cache["layers"], src_cache["layers"])
+    ]
+    cache = {"layers": new_layers,
+             "length": cache["length"].at[rows].set(src_lengths)}
+    current = current.at[rows].set(lasts)
+    if spec:
+        d_layers = [
+            {name: buf.at[rows].set(src_layer[name][src_idx])
+             for name, buf in layer.items()}
+            for layer, src_layer in zip(dcache["layers"],
+                                        src_cache["layers"][:spec_layers])
+        ]
+        dcache = {"layers": d_layers,
+                  "length": dcache["length"].at[rows].set(src_lengths)}
+        done = done.at[rows].set(True)
+        remaining = remaining.at[rows].set(0)
+    else:
+        done = done.at[rows].set(False)
+        remaining = remaining.at[rows].set(budgets)
+    return cache, dcache, current, done, remaining
+
+
+_handoff_rows = partial(
+    jax.jit,
+    static_argnames=("spec", "spec_layers"),
+    donate_argnums=(0, 1, 2, 3, 4),
+)(_handoff_rows_impl)
+
+
+class DecodePlaneBatcher(ShardedBatcher):
+    """The sharded gang engine with speculative rows and KV adoption.
+
+    Constructed exactly like :class:`~..workloads.shard_plane
+    .ShardedBatcher` plus ``spec_layers``/``spec_tokens`` — the
+    early-exit self-draft depth and proposal width.  ``spec_layers=0``
+    builds a pure disaggregation target (handoff transport, no
+    drafting).  The base engine is constructed on the PLAIN path
+    (``draft_layers=0``): every inherited program — the ``[M, P]``
+    insert, the resume insert, the gang block, ``adopt_engine``,
+    evacuation — works untouched, and rows only become speculative
+    through this class's admission overrides.
+
+    Single-chip for now (like the prefix pool): the spec round and the
+    handoff copy are not mesh-sharded.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        shards: int,
+        shard_slots: int,
+        prompt_len: int,
+        generate_tokens: int,
+        spec_layers: int = 0,
+        spec_tokens: int = 4,
+        draft_enabled: bool | None = None,
+        **kwargs,
+    ) -> None:
+        if kwargs.get("mesh") is not None and spec_layers:
+            raise ValueError(
+                "the speculative decode plane is single-chip for now "
+                "(the spec round and handoff copy are not mesh-sharded)"
+            )
+        if spec_layers:
+            if not 0 < spec_layers < config.n_layers:
+                raise ValueError(
+                    f"spec_layers={spec_layers} must be in "
+                    f"[1, n_layers-1] (model has n_layers="
+                    f"{config.n_layers})"
+                )
+            if spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens={spec_tokens} must be >= 1"
+                )
+            if kwargs.get("prefix_cache") is not None:
+                raise ValueError(
+                    "spec_layers does not combine with a global "
+                    "prefix_cache (the draft cache has no prefix rows)"
+                )
+            # speculative rounds overshoot like the fused spec engine:
+            # up to k past the budget, writing k+1 masked positions past
+            # the frozen length — reserve the same 2k slack
+            budget = prompt_len + generate_tokens + 2 * spec_tokens
+            if budget > config.max_seq_len:
+                raise ValueError(
+                    f"prompt_len + generate_tokens + 2*spec_tokens = "
+                    f"{budget} exceeds max_seq_len={config.max_seq_len}"
+                )
+        super().__init__(
+            params, config, shards=shards, shard_slots=shard_slots,
+            prompt_len=prompt_len, generate_tokens=generate_tokens,
+            **kwargs,
+        )
+        rows = shards * shard_slots
+        self.spec_layers = spec_layers
+        self.spec_tokens = spec_tokens
+        # per-slot admission mode: True = the row decodes by
+        # draft-and-verify rounds (device-frozen for the gang).  Fixed
+        # at admission; a live set_speculative flip changes only what
+        # NEW admissions get — the drain-to-plain contract.
+        self._slot_spec = [False] * rows
+        # handoff transport counter (the plane_kv_transfers_total family)
+        self.kv_transfers = 0
+        # per-tenant accept-rate attribution, bounded like every other
+        # per-tenant series
+        self.tenant_spec_rounds: dict[str, int] = {}
+        self.tenant_spec_accepted: dict[str, int] = {}
+        # rolling per-round accepted counts — the measured-economics
+        # signal the knob policy flips drafting on
+        self._accept_window: collections.deque[int] = collections.deque(
+            maxlen=256
+        )
+        self.spec_flips = 0
+        if spec_layers:
+            self.draft_config = dataclasses.replace(
+                config, n_layers=spec_layers
+            )
+            self.draft_params = dict(
+                params, layers=params["layers"][:spec_layers]
+            )
+            if self.quantized_kv:
+                from ..workloads.decode import init_quantized_cache
+
+                self.draft_cache = init_quantized_cache(
+                    self.draft_config, rows,
+                    kv_heads=(config.n_kv_heads if self.family == "llama"
+                              else None),
+                )
+            elif self.family == "llama":
+                from ..workloads.llama import init_llama_cache
+
+                self.draft_cache = init_llama_cache(self.draft_config,
+                                                    rows)
+            else:
+                from ..workloads.decode import init_cache
+
+                self.draft_cache = init_cache(self.draft_config, rows)
+            # the spec-round builder reads draft_tokens/draft_config;
+            # draft_layers stays 0 so every inherited plain-path check
+            # (submit_resume, adopt_engine, step routing) keeps treating
+            # this engine as the plain plane it extends
+            self.draft_tokens = spec_tokens
+            self._spec = self._make_spec_round()
+            self.draft_enabled = (
+                True if draft_enabled is None else bool(draft_enabled)
+            )
+        else:
+            self.draft_cache = None
+            self.draft_enabled = False
+
+    # ------------------------------------------------------------------
+    # Engine identity / adoption
+    # ------------------------------------------------------------------
+
+    def _engine_key(self) -> tuple:
+        return super()._engine_key() + (self.spec_layers, self.spec_tokens)
+
+    def adopt_engine(self, source) -> None:
+        if not isinstance(source, DecodePlaneBatcher):
+            raise ValueError(
+                "a decode plane adopts from a decode-plane donor only"
+            )
+        super().adopt_engine(source)  # validates the full engine key
+        if self.spec_layers:
+            self._spec = source._spec
+
+    # ------------------------------------------------------------------
+    # Admission: per-row mode marks ride every admission path
+    # ------------------------------------------------------------------
+
+    def submit_many(self, requests):
+        rows = super().submit_many(requests)
+        if not (self.draft_enabled and rows):
+            for row in rows:
+                self._slot_spec[row] = False
+            return rows
+        # drafted admission: the inherited plain insert already seeded
+        # the target cache, the pending first token, and the slots; add
+        # the draft plane's prefill and freeze the rows out of the gang
+        padded = [self._pad_prompt(ids) for ids, _ in requests]
+        prompts = np.stack([ids for ids, _ in padded])
+        lengths = np.asarray([ln for _, ln in padded], np.int32)
+        self.draft_cache = _draft_rows(
+            self.draft_params, self.draft_cache,
+            jnp.asarray(rows, jnp.int32), jnp.asarray(prompts),
+            jnp.asarray(lengths), config=self.draft_config,
+            prompt_len=self.prompt_len, n_rows=len(rows),
+            family=self.family, quantized_kv=self.quantized_kv,
+        )
+        self.insert_dispatches += 1
+        self.kill_rows(rows)  # device-freeze: spec rows skip the gang
+        for row in rows:
+            self._slot_spec[row] = True
+        return rows
+
+    def submit_resume(self, resumes):
+        # resumed rows always decode plain: greedy draft-and-verify
+        # emits the plain greedy continuation, so a drafted first life
+        # resumes bit-exact on the plain path — and the resume insert
+        # is the plain program
+        rows = super().submit_resume(resumes)
+        for row in rows:
+            self._slot_spec[row] = False
+        return rows
+
+    def submit_many_prefixed(self, requests):
+        # pooled-prefix admissions stay plain (the draft cache has no
+        # pool rows); drafting composes with tenancy through the plain
+        # tag_tenant path and the handoff path
+        rows = super().submit_many_prefixed(requests)
+        for row in rows:
+            self._slot_spec[row] = False
+        return rows
+
+    def submit_handoff(self, donor, handoffs: list[tuple]) -> list[int]:
+        """Adopt finished prefill rows from ``donor`` (a plain
+        :class:`~..workloads.continuous.ContinuousBatcher` the prefill
+        plane runs) into this plane's free slots.
+
+        Each handoff is ``(src_row, payload, produced, budget,
+        submitted_at, tenant)`` — the donor row index plus the
+        ``submit_resume`` record.  ONE jitted device copy moves the
+        whole batch's KV (target + draft rows when drafting is on) and
+        arms the per-row state; no forward pass runs, so a handoff
+        costs memory bandwidth, not FLOPs.  Rows route freest-first
+        through the same admission plane as every other path.  TTFT is
+        not re-recorded: the first token was produced (and timed) on
+        the prefill plane."""
+        if not handoffs:
+            return []
+        if donor.config is not self.config \
+                or donor.family != self.family \
+                or donor.quantized_kv != self.quantized_kv:
+            raise ValueError(
+                "a KV handoff needs the donor's exact config/family/"
+                "layout (the cache rows must be layout-identical)"
+            )
+        if donor.mesh is not None or self.mesh is not None:
+            raise ValueError("the KV handoff transport is single-chip")
+        free = self.free_slots
+        if len(handoffs) > len(free):
+            raise RuntimeError(
+                f"no free slot for {len(handoffs)} handoff(s) "
+                f"({len(free)} free); the pool must cap handoffs by "
+                "free_slots"
+            )
+        rows = free[: len(handoffs)]
+        src_idx, lasts, budgets = [], [], []
+        for src_row, _, produced, budget, _, _ in handoffs:
+            if not 0 < len(produced) < budget:
+                raise ValueError(
+                    f"handoff row produced {len(produced)} of budget "
+                    f"{budget} tokens — a handoff carries a started, "
+                    "unfinished request"
+                )
+            if self.eos_id is not None and produced[-1] == self.eos_id:
+                raise ValueError(
+                    "a completed (eos) request settles on the prefill "
+                    "plane, it does not hand off"
+                )
+            src_idx.append(src_row)
+            lasts.append(produced[-1])
+            budgets.append(budget - len(produced))
+        spec = bool(self.spec_layers) and self.draft_enabled
+        (self.cache, self.draft_cache, self._current, self._done,
+         self._remaining) = _handoff_rows(
+            self.cache, self.draft_cache, self._current, self._done,
+            self._remaining, donor.cache, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(src_idx, jnp.int32), jnp.asarray(lasts, jnp.int32),
+            jnp.asarray(budgets, jnp.int32), spec=spec,
+            spec_layers=self.spec_layers,
+        )
+        self.insert_dispatches += 1
+        self.kv_transfers += len(rows)
+        for row, (_, payload, produced, budget, submitted_at,
+                  tenant) in zip(rows, handoffs):
+            self.slots[row] = _Slot(
+                busy=True, budget=budget, payload=payload,
+                produced=list(produced), submitted_at=submitted_at,
+                tenant=tenant, ttft_done=True,
+            )
+            self._slot_spec[row] = spec
+        self._invalidate_admission_cache()
+        return rows
+
+    # ------------------------------------------------------------------
+    # The mixed engine cycle: one spec round + one gang block
+    # ------------------------------------------------------------------
+
+    def _step_gang(self):
+        spec_shards = None
+        if self.spec_layers:
+            mask = [
+                self._slot_spec[row] and self._needs_decode(slot)
+                for row, slot in enumerate(self.slots)
+            ]
+            if any(mask):
+                handle = self._dispatch_spec_round(mask)
+                # first tokens must land in slot.produced BEFORE round
+                # tokens (the plain spec engine settles firsts first,
+                # too) — the settle's host work overlaps the round's
+                # device time
+                self._settle_pending_firsts()
+                spec_shards = self._consume_plane_spec_round(mask, handle)
+        finished = super()._step_gang()
+        if spec_shards:
+            # spec emission IS shard progress: without this a shard
+            # holding only drafted rows (device-frozen, gang count 0)
+            # would trip the no-progress stall sentinel
+            for s in spec_shards:
+                self.shard_stall_cycles[s] = 0
+        return finished
+
+    def _consume_plane_spec_round(self, mask, handle) -> set[int]:
+        """The fused engine's round consume plus the plane's
+        attribution: per-shard token counts (the per-shard tokens/s
+        gauges and the stall sentinel) and the bounded per-tenant
+        accept-rate series."""
+        toks_host, n_host = jax.device_get(handle)
+        self.host_transfers += 1
+        progressed: set[int] = set()
+        for row, slot in enumerate(self.slots):
+            if not mask[row]:
+                continue
+            n = int(n_host[row])
+            slot.rounds += 1
+            slot.accepted += n
+            self.spec_rounds += 1
+            self.spec_accepted += n
+            self._accept_window.append(n)
+            if slot.tenant:
+                tenant = _bounded_tenant_key(
+                    slot.tenant, self.tenant_spec_rounds
+                )
+                self.tenant_spec_rounds[tenant] = (
+                    self.tenant_spec_rounds.get(tenant, 0) + 1
+                )
+                self.tenant_spec_accepted[tenant] = (
+                    self.tenant_spec_accepted.get(tenant, 0) + n
+                )
+            shard = row // self.shard_slots
+            emitted = 0
+            for token in toks_host[row, : n + 1]:
+                if slot.done or len(slot.produced) >= slot.budget:
+                    break
+                self._emit(slot, int(token))
+                emitted += 1
+            self.shard_tokens[shard] += emitted
+            if emitted:
+                progressed.add(shard)
+        return progressed
+
+    # ------------------------------------------------------------------
+    # The speculative knob: drain-to-plain
+    # ------------------------------------------------------------------
+
+    def set_speculative(self, enabled: bool) -> None:
+        """Flip draft-and-verify for NEW admissions, live.
+
+        Unlike the fused spec engine's overlap toggle, this is a full
+        mode switch with drain semantics: rows admitted while drafting
+        was on finish their speculative lives (their device rows are
+        already frozen out of the gang), rows admitted after the flip
+        decode plain through the gang — and symmetrically for flipping
+        on.  Greedy parity per request holds through the flip in both
+        directions because each row's whole life runs in one mode."""
+        if not self.spec_layers:
+            raise ValueError(
+                "the speculative knob needs a drafted decode plane "
+                "(spec_layers > 0)"
+            )
+        enabled = bool(enabled)
+        if enabled != self.draft_enabled:
+            self.spec_flips += 1
+        self.draft_enabled = enabled
+
+    # ------------------------------------------------------------------
+    # Measured economics
+    # ------------------------------------------------------------------
+
+    def accept_rate(self, tenant: str | None = None) -> float | None:
+        """Lifetime accepted-draft fraction in ``[0, 1]`` (``None``
+        before any round): accepted drafts over proposed drafts,
+        overall or for one (bounded) tenant label."""
+        if tenant is None:
+            rounds, accepted = self.spec_rounds, self.spec_accepted
+        else:
+            key = _bounded_tenant_key(tenant, self.tenant_spec_rounds)
+            rounds = self.tenant_spec_rounds.get(key, 0)
+            accepted = self.tenant_spec_accepted.get(key, 0)
+        if not rounds:
+            return None
+        return accepted / (rounds * self.spec_tokens)
+
+    def recent_accept_rate(self) -> float | None:
+        """Accept rate over the rolling round window — the signal the
+        knob policy compares against the drafting break-even point."""
+        if not self._accept_window:
+            return None
+        return (
+            sum(self._accept_window)
+            / (len(self._accept_window) * self.spec_tokens)
+        )

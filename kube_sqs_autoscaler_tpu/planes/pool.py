@@ -1,0 +1,397 @@
+"""DisaggregatedPool: both planes behind one Scaler-shaped seam each.
+
+The disaggregated deployment is a :class:`~..fleet.pool.WorkerPool` of
+prefill replicas (the cheap axis: by-reference params + program
+adoption make a spawn ~ms) FUSED to one gang-stepped decode plane (a
+:class:`~.engine.DecodePlaneBatcher` behind a
+:class:`~..fleet.worker.FleetWorker`, wrapped in a
+:class:`~..fleet.sharded.ShardedWorkerPool` so decode capacity is the
+same O(1) shard-mask flips the sharded plane already actuates).  Two
+independent :class:`~..core.types.Scaler` targets result:
+
+- the pool ITSELF scales the prefill plane (``scale_up``/``scale_down``
+  spawn/drain prefill replicas — inherited verbatim from
+  ``WorkerPool``, so the actuator contract's fingerprint is identical
+  by construction);
+- :attr:`decode_pool` scales the decode plane (shard-active mask
+  flips, ``ShardedWorkerPool`` semantics verbatim).
+
+One admission surface: only prefill replicas poll the queue.  Each
+fleet cycle the pool supervises and steps the prefill plane, then
+moves every started-but-unfinished row across the KV handoff transport
+(:meth:`~.engine.DecodePlaneBatcher.submit_handoff`) — capped by the
+decode plane's free slots, donor rows freed only AFTER the copy is
+dispatched — and then steps the decode plane, which settles replies.
+Requests that complete AT prefill (budget-1, eos on the first token)
+settle there and never hand off.
+
+Exactly-once holds through every handoff because both planes settle
+through the ONE reply registry this pool inherits from
+:class:`~..fleet.pool.FleetPoolBase`: a prefill replica killed
+mid-request re-dispatches its un-handed-off rows to surviving prefill
+replicas (the inherited supervisor), a visibility-timeout redelivery
+of a request the decode plane already owns re-prefills and re-hands
+off — and the registry suppresses whichever reply lands second.  The
+decode plane itself is a single failure domain, like the sharded
+plane: no kill/hang failover inside it; whole-plane loss is the
+queue's visibility timeout's job.
+
+Jax-free (like ``fleet``): the actuator-contract tests drive this pool
+with stub workers; real planes are wired by :meth:`DisaggregatedPool
+.serving`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from ..fleet.pool import DRAINING, SERVING, WorkerPool, _free_count
+from ..fleet.sharded import ShardedWorkerPool
+
+log = logging.getLogger(__name__)
+
+# core.durable snapshot section: the disaggregated pool's reply
+# registry + plane mode (draft_enabled) ride controller snapshots so a
+# restarted controller neither re-answers an answered request nor
+# forgets a measured-economics drafting decision.
+DISAGG_SECTION = "disagg_pool"
+
+
+class DisaggregatedPool(WorkerPool):
+    """A supervised prefill-replica pool shuttling KV to one decode plane.
+
+    ``prefill_factory(pool)`` builds one prefill replica (real fleets:
+    a :class:`~.prefill.PrefillWorker`; the contract test a stub).
+    ``decode_factory(pool)`` is called ONCE for the decode-plane worker
+    — a :class:`~..fleet.worker.FleetWorker` over a
+    :class:`~.engine.DecodePlaneBatcher` with ``pool=<this pool>`` so
+    its settles dedup through the shared registry; it is built AFTER
+    the initial prefill spawns, and its admission is forced off (the
+    prefill plane is the only queue consumer).
+
+    ``min``/``max``/``scale_up_pods``/``scale_down_pods`` govern the
+    prefill plane (the inherited Scaler seam); the ``decode_*`` twins
+    govern :attr:`decode_pool`'s shard mask.
+    """
+
+    def __init__(
+        self,
+        prefill_factory: Callable[["DisaggregatedPool"], Any],
+        decode_factory: Callable[["DisaggregatedPool"], Any],
+        *,
+        min: int,
+        max: int,
+        decode_min: int = 1,
+        decode_max: int | None = None,
+        decode_initial: int | None = None,
+        decode_scale_up_pods: int = 1,
+        decode_scale_down_pods: int = 1,
+        decode_steps_per_cycle: int = 2,
+        **pool_kwargs,
+    ) -> None:
+        if decode_steps_per_cycle < 1:
+            raise ValueError("decode_steps_per_cycle must be >= 1")
+        super().__init__(prefill_factory, min=min, max=max, **pool_kwargs)
+        self.decode_steps_per_cycle = decode_steps_per_cycle
+        # the decode plane: ONE worker, capacity actuated as shard-mask
+        # flips.  The inner pool's own reply registry goes unused — the
+        # worker's ``pool`` reference (this pool) is what its settle
+        # path consults — so the exactly-once surface stays single.
+        self.decode_pool = ShardedWorkerPool(
+            lambda _inner: decode_factory(self),
+            min=decode_min, max=decode_max, initial=decode_initial,
+            scale_up_pods=decode_scale_up_pods,
+            scale_down_pods=decode_scale_down_pods,
+            clock=self.clock,
+        )
+        self.decode = self.decode_pool.worker
+        # one admission surface: the decode plane never polls the queue
+        self.decode.admitting = False
+        self.kv_handoffs_total = 0
+
+    # ------------------------------------------------------------------
+    # The fleet cycle: supervise -> prefill -> handoff -> decode
+    # ------------------------------------------------------------------
+
+    def run_cycle(self) -> int:
+        """One disaggregated cycle; returns requests completed on both
+        planes.  Prefill replicas step first (admission + batched
+        insert + settle-at-prefill), the KV shuttle moves every ready
+        row the decode plane has a slot for, the decode plane steps its
+        gang (spec rounds + gang block) and settles replies, and
+        draining prefill replicas retire once empty — their last rows
+        leave through the same shuttle."""
+        self.cycle += 1
+        self._supervise()
+        done = 0
+        serving: list = []
+        draining: list = []
+        for replica in self.members:
+            if replica.state == SERVING:
+                serving.append(replica)
+            elif replica.state == DRAINING:
+                draining.append(replica)
+        serving.sort(
+            key=lambda r: _free_count(r.worker.batcher), reverse=True
+        )
+        for replica in serving:
+            if self._orphans:
+                self._dispatch_orphans(replica)
+            done += replica.worker.run_once()
+        for replica in draining:
+            done += replica.worker.run_once()
+        # the KV shuttle: draining replicas first (their rows are the
+        # ones blocking a retire), then serving freest-last so the
+        # busiest prefill replica unloads first.  The decode plane's
+        # gang cadence is decoupled from the poll/admission cadence —
+        # it steps ``decode_steps_per_cycle`` times per fleet cycle,
+        # with a shuttle before each step so slots freed by one gang
+        # settle refill before the next.  The fused engine cannot do
+        # this: its iteration interleaves admission, so its decode
+        # cadence is pinned to the poll cadence.  This is half the
+        # disaggregation win (the other half is inserts never queueing
+        # behind gang blocks).
+        order = draining + serving[::-1]
+        self._shuttle(order)
+        done += self.decode_pool.run_cycle()
+        for _ in range(self.decode_steps_per_cycle - 1):
+            self._shuttle(order)
+            done += self.decode.run_once()
+        for replica in draining:
+            if replica.worker.batcher.active == 0:
+                self._retire(replica, released=0)
+            elif (
+                self.drain_timeout_cycles is not None
+                and replica.drain_started_cycle is not None
+                and self.cycle - replica.drain_started_cycle
+                >= self.drain_timeout_cycles
+            ):
+                released = replica.worker.release_inflight()
+                self.released_total += released
+                self._retire(replica, released=released)
+        self._prune_retired()
+        self._update_metrics()
+        return done
+
+    def _shuttle(self, replicas: list) -> int:
+        """Move ready prefill rows to decode slots: per donor replica
+        one :meth:`~.engine.DecodePlaneBatcher.submit_handoff` batch
+        (one jitted device copy), capped by the decode plane's live
+        free-slot count, donor rows freed only after the copy is
+        dispatched.  Returns rows moved."""
+        batcher = self.decode.batcher
+        submit = getattr(batcher, "submit_handoff", None)
+        if submit is None:  # contract-test stubs: no handoff surface
+            return 0
+        free = _free_count(batcher)
+        moved = 0
+        for replica in replicas:
+            worker = replica.worker
+            ready = getattr(worker, "ready_handoffs", None)
+            if ready is None:
+                continue
+            all_ready = ready()
+            if not all_ready:
+                continue
+            # rows awaiting a decode slot are backpressure, not a
+            # wedge — don't let the progress watchdog count this
+            # replica as stalled while the decode plane is the
+            # bottleneck.  (A truly hung replica is still caught: its
+            # ready rows shuttle away — the shuttle acts on the
+            # batcher, not the wedged worker loop — and the idle-wedge
+            # watchdog fires on the frozen refill counter.)
+            replica.stalled_cycles = 0
+            if free <= 0:
+                continue
+            records = all_ready[:free]
+            submit(worker.batcher, records)
+            worker.complete_handoff([rec[0] for rec in records])
+            free -= len(records)
+            moved += len(records)
+            self._event(
+                "kv-handoff", replica=replica.index, rows=len(records),
+            )
+        self.kv_handoffs_total += moved
+        return moved
+
+    # ------------------------------------------------------------------
+    # Fleet-wide accounting spans both planes
+    # ------------------------------------------------------------------
+
+    @property
+    def processed(self) -> int:
+        return super().processed + self.decode.processed
+
+    @property
+    def completed_by_tenant(self) -> dict[str, int]:
+        totals = dict(super().completed_by_tenant)
+        for tenant, count in getattr(
+            self.decode, "completed_by_tenant", {}
+        ).items():
+            totals[tenant] = totals.get(tenant, 0) + count
+        return totals
+
+    @property
+    def idle(self) -> bool:
+        # a prefilled row awaiting handoff keeps its prefill slot busy,
+        # so prefill-side idleness already covers the shuttle
+        return (
+            super().idle
+            and self.decode.batcher.active == 0
+            and getattr(self.decode, "staged", 0) == 0
+        )
+
+    def stop_all(self) -> None:
+        super().stop_all()  # prefill replicas release + retire
+        self.decode_pool.stop_all()
+
+    # ------------------------------------------------------------------
+    # Durable-state surface (core/durable.py, section DISAGG_SECTION):
+    # the shared reply registry (FleetPoolBase) plus the one plane-mode
+    # bit a restart must not forget — whether measured economics had
+    # drafting on.  Replica/shard counts deliberately do NOT ride the
+    # snapshot (same philosophy as the sharded pool: the autoscaler
+    # re-derives them through the ordinary gates).
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        state = super().export_state()
+        state["kv_handoffs_total"] = self.kv_handoffs_total
+        draft = getattr(self.decode.batcher, "draft_enabled", None)
+        if draft is not None:
+            state["draft_enabled"] = bool(draft)
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: float | None = None, max_age_s: float = 0.0,
+    ) -> int:
+        recovered = super().import_state(
+            state, rebase=rebase, now=now, max_age_s=max_age_s
+        )
+        self.kv_handoffs_total = int(state.get("kv_handoffs_total", 0) or 0)
+        draft = state.get("draft_enabled")
+        batcher = self.decode.batcher
+        if draft is not None and getattr(batcher, "spec_layers", 0):
+            # silent restore (not set_speculative: a rehydration is not
+            # a knob flip and must not count one)
+            batcher.draft_enabled = bool(draft)
+        return recovered
+
+    # ------------------------------------------------------------------
+    # Observability: the inherited per-replica fleet gauges cover the
+    # prefill plane; add the plane-level families
+    # ------------------------------------------------------------------
+
+    def _update_metrics(self) -> None:
+        super()._update_metrics()
+        if self.metrics is None:
+            return
+        self.metrics.set_gauge(
+            "plane_prefill_replicas", self.replicas,
+            "Serving prefill-plane replicas (the pool Scaler's axis).",
+        )
+        self.metrics.set_gauge(
+            "plane_decode_shards", self.decode_pool.replicas,
+            "Serving decode-plane shards (the decode Scaler's axis).",
+        )
+        self.metrics.set_gauge(
+            "plane_kv_transfers_total", self.kv_handoffs_total,
+            "KV rows handed from the prefill plane to decode slots over "
+            "the pool shuttle.",
+            kind="counter",
+        )
+
+    def attach_metrics(self, metrics) -> None:
+        self.decode_pool.metrics = metrics
+        super().attach_metrics(metrics)
+
+    # ------------------------------------------------------------------
+    # Real-plane construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def serving(  # type: ignore[override]
+        cls,
+        queue,
+        params,
+        model_config,
+        service_config,
+        *,
+        min: int,
+        max: int,
+        decode_shards: int,
+        decode_min: int = 1,
+        spec_layers: int = 1,
+        spec_tokens: int = 4,
+        draft_enabled: bool | None = None,
+        family: str = "gpt",
+        tokenizer=None,
+        result_queue=None,
+        now_fn=None,
+        tenancy=None,
+        prefill_engine_source=None,
+        decode_engine_source=None,
+        **pool_kwargs,
+    ) -> "DisaggregatedPool":
+        """Real planes over one shared queue: ``min``..``max``
+        :class:`~.prefill.PrefillWorker` replicas (params shared by
+        reference, programs adopted from the first — a spawn is ~ms)
+        feeding a ``decode_shards``-shard
+        :class:`~.engine.DecodePlaneBatcher` behind one
+        :class:`~..fleet.worker.FleetWorker`.
+
+        The decode plane is ALWAYS built drafted (``spec_layers >= 1``):
+        plain decode is ``draft_enabled=False`` — a drain-to-plain MODE
+        of the same engine, not a different build — so the handoff
+        surface and the live speculative knob exist in every
+        disaggregated deployment."""
+        import dataclasses
+
+        if spec_layers < 1:
+            raise ValueError(
+                "the decode plane is built drafted (spec_layers >= 1); "
+                "run plain via draft_enabled=False, not spec_layers=0"
+            )
+
+        def prefill_factory(pool: "DisaggregatedPool"):
+            from .prefill import PrefillWorker
+
+            seeded = dataclasses.replace(
+                service_config,
+                sample_seed=service_config.sample_seed
+                + pool.next_spawn_ordinal(),
+            )
+            return PrefillWorker(
+                queue, params, model_config, seeded,
+                family=family, tokenizer=tokenizer,
+                result_queue=result_queue, pool=pool, tenancy=tenancy,
+                now_fn=now_fn,
+                engine_source=pool.engine_donor() or prefill_engine_source,
+            )
+
+        def decode_factory(pool: "DisaggregatedPool"):
+            from ..fleet.worker import FleetWorker
+
+            seeded = dataclasses.replace(
+                service_config, shards=decode_shards,
+            )
+            worker = FleetWorker(
+                queue, params, model_config, seeded,
+                family=family, tokenizer=tokenizer,
+                result_queue=result_queue, pool=pool, tenancy=tenancy,
+                now_fn=now_fn, sharded=True,
+                draft_layers=spec_layers, draft_tokens=spec_tokens,
+                engine_source=decode_engine_source,
+            )
+            if draft_enabled is not None and spec_layers:
+                worker.batcher.set_speculative(draft_enabled)
+                worker.batcher.spec_flips = 0  # construction, not a flip
+            return worker
+
+        return cls(
+            prefill_factory, decode_factory, min=min, max=max,
+            decode_min=decode_min, decode_max=decode_shards,
+            decode_initial=decode_shards, **pool_kwargs,
+        )

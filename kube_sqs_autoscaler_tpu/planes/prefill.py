@@ -1,0 +1,96 @@
+"""The prefill plane: admission-only workers that hand KV to decode.
+
+A :class:`PrefillWorker` is a :class:`~..fleet.worker.FleetWorker` whose
+engine cycle never dispatches a decode step: it pulls queue traffic,
+runs the batched ``[M, P]`` admission insert (the ONE compiled program
+this plane needs), settles the deferred first tokens — time-to-first-
+token is measured HERE, which is the disaggregation win: a saturated
+decode plane no longer queues prefills behind gang blocks — and then
+surfaces each started row for KV handoff to the decode plane
+(:meth:`~.engine.DecodePlaneBatcher.submit_handoff`).
+
+Everything else is inherited unchanged: the queue/admission discipline
+(TTL sheds, poison bodies, tenancy staging), the reply path for
+requests that COMPLETE at prefill (budget-1, or eos on the first
+token — they settle here and never hand off), the reply-registry dedup,
+and the kill/hang fault seams.  Params are shared by reference and the
+insert programs adopted from a donor replica, so a prefill replica
+spins up in ~ms — the O(1) spin-up that makes the prefill plane the
+cheap axis to scale.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..fleet.worker import FleetWorker
+from ..workloads.continuous import _Slot
+
+
+class PrefillWorker(FleetWorker):
+    """One prefill-plane replica (see module docstring).
+
+    Construct with ``sharded=False`` sizing (``batch_size`` prefill
+    slots); ``generate_tokens`` must match the decode plane's so the
+    handoff's budget accounting and the resume bucket line up.
+    """
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("sharded", False)
+        super().__init__(*args, **kwargs)
+        if self.batcher.beams > 1 or self.batcher.draft_layers:
+            raise ValueError(
+                "the prefill plane runs the plain admission insert "
+                "(drafting happens on the decode plane)"
+            )
+        self.handed_off = 0
+
+    def run_once(self) -> int:
+        """One prefill cycle: refill free slots (the batched insert),
+        settle first tokens, reply anything that completed AT the
+        prefill plane.  Never dispatches a decode step — rows that need
+        decoding wait (busy, one token produced) for the pool to move
+        them through :meth:`ready_handoffs`."""
+        if self.killed or self.hung:
+            return 0
+        if self._served_since is None:
+            self._served_since = time.perf_counter()
+        self._refill()
+        self.batcher._settle_pending_firsts()
+        done = self.batcher._finish_ready()
+        for message, tokens in done:
+            self._settle(message, tokens)
+        if done:
+            self._poll_backoff = 0
+        self.processed += len(done)
+        self._update_metrics()
+        return len(done)
+
+    def ready_handoffs(self) -> list[tuple]:
+        """Started-but-unfinished rows as ``(src_row, payload, produced,
+        budget, submitted_at, tenant)`` handoff records (the
+        ``submit_handoff`` contract).  A row appears once its first
+        token has settled; it stays busy — and its KV rows stay
+        untouched — until :meth:`complete_handoff` releases it, so the
+        decode plane's copy always reads live donor rows."""
+        records = []
+        for row, slot in enumerate(self.batcher.slots):
+            if (slot.busy and slot.produced and not slot.done
+                    and len(slot.produced) < slot.budget):
+                records.append(
+                    (row, slot.payload, list(slot.produced), slot.budget,
+                     slot.submitted_at, slot.tenant)
+                )
+        return records
+
+    def complete_handoff(self, rows: list[int]) -> None:
+        """Free the handed-off rows (called by the pool AFTER the decode
+        plane's copy was dispatched — the copy holds a read reference to
+        this batcher's cache buffers, so the next insert into these rows
+        orders after it)."""
+        for row in rows:
+            self.batcher.slots[row] = _Slot()
+        self.batcher._invalidate_admission_cache()
+        self.handed_off += len(rows)
+        if rows:
+            self._poll_backoff = 0

@@ -35,15 +35,24 @@ The knobs, and where each one is safe:
                 :class:`~..fleet.sharded.ShardedWorkerPool`'s scale
                 path when one owns the plane (quarantine bookkeeping
                 stays consistent).
-``speculative`` between rounds: toggles the speculative engine's
+``speculative`` between rounds.  On the fused spec engine: toggles the
                 provably-safe second-round overlap (dispatch-ahead of
-                draft-and-verify rounds).  Flipping draft-and-verify
-                itself off requires the drain-to-plain path — ROADMAP
-                item 3, which this seam exists to make small.
+                draft-and-verify rounds).  On the decode plane
+                (:class:`~..planes.engine.DecodePlaneBatcher`): flips
+                draft-and-verify itself via the drain-to-plain path —
+                in-flight rows finish in their admitted mode, new
+                admissions land in the new one.  On a
+                :class:`~..planes.pool.DisaggregatedPool` target the
+                knob routes to the decode-plane worker.
 ``prefix_pool`` between cycles: moves the pool's residency ceiling
                 within its allocated arena (shrink evicts LRU-cold
                 entries; the ``>= per-shard slots`` floor that makes
                 same-batch eviction corruption impossible still holds).
+``plane_ratio`` between cycles: the disaggregated pool's prefill-plane
+                replica count, walked through the pool's own Scaler
+                state machine (spawn/drain, clamps respected).  At
+                fixed decode shards this IS the prefill:decode ratio —
+                the knob the two-plane economics tune.
 =============== =====================================================
 
 Arming is validated at CONSTRUCTION (the CLI turns these into startup
@@ -65,6 +74,7 @@ KNOB_SLOT_LIMIT = "slot_limit"
 KNOB_SHARDS = "shards"
 KNOB_SPECULATIVE = "speculative"
 KNOB_PREFIX_POOL = "prefix_pool"
+KNOB_PLANE_RATIO = "plane_ratio"
 
 #: Every knob the actuator knows, in apply order (stable, test-pinned).
 ALL_KNOBS = (
@@ -73,6 +83,7 @@ ALL_KNOBS = (
     KNOB_SHARDS,
     KNOB_SPECULATIVE,
     KNOB_PREFIX_POOL,
+    KNOB_PLANE_RATIO,
 )
 
 #: CLI spelling (``--knobs decode-block,slot-limit,...``) -> knob name.
@@ -186,11 +197,14 @@ class KnobActuator:
                 "(--shards)"
             )
         if KNOB_SPECULATIVE in self.armed:
-            if getattr(batcher, "beams", 1) > 1:
+            spec = self._spec_workers()
+            spec_batcher = (spec[0] if spec else worker).batcher
+            if getattr(spec_batcher, "beams", 1) > 1:
                 raise KnobError(
                     "the speculative knob does not apply to beam slots"
                 )
-            if not getattr(batcher, "draft_layers", 0):
+            if not (getattr(spec_batcher, "draft_layers", 0)
+                    or getattr(spec_batcher, "spec_layers", 0)):
                 raise KnobError(
                     "the speculative knob needs the draft-and-verify "
                     "engine (--speculative-draft-layers)"
@@ -199,6 +213,11 @@ class KnobActuator:
             raise KnobError(
                 "the prefix_pool knob needs a prefix pool "
                 "(--prefix-pool with tenancy)"
+            )
+        if KNOB_PLANE_RATIO in self.armed and self._disagg_pool() is None:
+            raise KnobError(
+                "the plane_ratio knob needs a disaggregated pool "
+                "(planes.DisaggregatedPool)"
             )
         self.refresh_gauges()
 
@@ -234,6 +253,25 @@ class KnobActuator:
 
     def _multi_replica(self) -> bool:
         return hasattr(self._target, "members")
+
+    def _disagg_pool(self):
+        """The DisaggregatedPool under actuation, when the target IS
+        one (the plane_ratio knob's state machine; the speculative
+        knob's route to the decode-plane worker)."""
+        target = self._target
+        if hasattr(target, "decode_pool"):
+            return target
+        return None
+
+    def _spec_workers(self) -> list:
+        """The workers whose engine owns the speculative knob: the one
+        decode-plane worker on a disaggregated pool (prefill replicas
+        run the plain insert and have no drafting surface), every live
+        worker otherwise."""
+        pool = self._disagg_pool()
+        if pool is not None:
+            return [pool.decode]
+        return self._workers()
 
     def _shard_pool(self):
         """The ShardedWorkerPool supervising the plane, when the target
@@ -333,7 +371,11 @@ class KnobActuator:
             if knob not in self._actuated:
                 continue
             value = self._actuated[knob]
-            for worker in workers:
+            targets = (
+                self._spec_workers() if knob == KNOB_SPECULATIVE
+                else workers
+            )
+            for worker in targets:
                 try:
                     if self._read(knob, worker) != value:
                         self._apply_to_worker(knob, value, worker)
@@ -382,6 +424,15 @@ class KnobActuator:
             return value
         if knob == KNOB_SPECULATIVE:
             return bool(value)
+        if knob == KNOB_PLANE_RATIO:
+            value = int(value)
+            pool = self._disagg_pool()
+            if not pool.min <= value <= pool.max:
+                raise KnobError(
+                    f"plane_ratio (prefill replicas) must be in "
+                    f"[{pool.min}, {pool.max}], got {value}"
+                )
+            return value
         if knob == KNOB_PREFIX_POOL:
             value = int(value)
             pool = batcher.prefix_pool
@@ -401,6 +452,12 @@ class KnobActuator:
         raise KnobError(f"unknown knob {knob!r}")
 
     def _read(self, knob: str, worker=None):
+        if knob == KNOB_PLANE_RATIO:
+            return self._disagg_pool().replicas
+        if knob == KNOB_SPECULATIVE and worker is None:
+            spec = self._spec_workers()
+            if spec:
+                worker = spec[0]
         batcher = (worker or self._primary()).batcher
         if knob == KNOB_DECODE_BLOCK:
             pending = getattr(batcher, "_pending_decode_block", None)
@@ -413,6 +470,10 @@ class KnobActuator:
                 return pool.replicas
             return sum(1 for a in batcher.shard_admitting if a)
         if knob == KNOB_SPECULATIVE:
+            if getattr(batcher, "spec_layers", 0):
+                # the decode plane: the knob IS draft-and-verify (the
+                # drain-to-plain mode switch), not the round overlap
+                return bool(batcher.draft_enabled)
             return bool(batcher.spec_overlap)
         if knob == KNOB_PREFIX_POOL:
             return batcher.prefix_pool.capacity
@@ -481,8 +542,36 @@ class KnobActuator:
                     batcher.set_shard_active(s, False)
                     admitting.remove(s)
             return
+        if knob == KNOB_PLANE_RATIO:
+            # through the disaggregated pool's Scaler state machine
+            # (spawn/drain ordering, clamps) at step size 1, exactly
+            # like the shards knob's pool path
+            pool = self._disagg_pool()
+            saved = pool.scale_up_pods, pool.scale_down_pods
+            pool.scale_up_pods = pool.scale_down_pods = 1
+            try:
+                for _ in range(pool.max):
+                    if pool.replicas < value:
+                        pool.scale_up()
+                    elif pool.replicas > value:
+                        pool.scale_down()
+                    else:
+                        break
+            finally:
+                pool.scale_up_pods, pool.scale_down_pods = saved
+            if pool.replicas != value:
+                log.warning(
+                    "plane_ratio knob: pool settled at %d prefill "
+                    "replicas, wanted %d",
+                    pool.replicas, value,
+                )
+            return
         if knob in (KNOB_SPECULATIVE, KNOB_PREFIX_POOL):
-            for worker in workers:
+            targets = (
+                self._spec_workers() if knob == KNOB_SPECULATIVE
+                else workers
+            )
+            for worker in targets:
                 self._apply_to_worker(knob, value, worker)
             return
         raise KnobError(f"unknown knob {knob!r}")

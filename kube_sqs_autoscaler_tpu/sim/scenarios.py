@@ -863,6 +863,54 @@ def overload_battery(
     ]
 
 
+def disagg_scenario(
+    *, tenants: int = 2, cycles: int = 36, every: int = 2,
+    wave_start: int = 8, wave_cycles: int = 6, wave_per_cycle: int = 4,
+) -> TenantScenario:
+    """The two-plane shape: steady decode-bound tenants plus a mid-run
+    prefill WAVE of fresh arrivals.  On the fused engine every wave
+    arrival's ``[M,P]`` prefill serializes with the resident decode
+    steps, so steady tenants' tokens stall and the wave's own TTFT
+    queues behind decode work; with the planes split, the wave lands on
+    prefill replicas while the decode plane gang-steps undisturbed —
+    the separation the disagg TTFT gate measures at fixed total
+    hardware."""
+    traffics = [
+        TenantTraffic(tenant=f"steady{i}", per_cycle=1, every=every,
+                      start_cycle=i % every)
+        for i in range(tenants)
+    ]
+    traffics.append(TenantTraffic(
+        tenant="wave", per_cycle=wave_per_cycle, start_cycle=wave_start,
+        end_cycle=wave_start + wave_cycles,
+    ))
+    return TenantScenario(
+        name="disagg-wave", cycles=cycles, traffics=tuple(traffics),
+        description=(
+            "%d steady tenants send 1 req every %d cycles; a prefill "
+            "wave of %d req/cycle runs cycles %d..%d"
+            % (tenants, every, wave_per_cycle, wave_start,
+               wave_start + wave_cycles)
+        ),
+    )
+
+
+def draft_probe_prompts(
+    count: int, prompt_len: int, vocab: int, seed: int = 0,
+) -> "list[list[int]]":
+    """``count`` deterministic candidate prompts for the speculative
+    accept-rate probe.  Whether a draft model (the full model's first
+    ``k`` layers) agrees with the full model is a property of the
+    weights, not the prompt tag — so the bench MEASURES each candidate's
+    accept rate on the real seeded model and partitions the pool into
+    draft-friendly and draft-hostile halves; this helper only pins the
+    candidate stream so the partition is reproducible."""
+    return [
+        seeded_token_ids(f"draft-probe:{seed}:{i}", prompt_len, vocab)
+        for i in range(count)
+    ]
+
+
 def without_flood(scenario: TenantScenario) -> TenantScenario:
     """The scenario's no-flood control: identical victim schedules,
     adversary removed — the baseline the isolation gate compares

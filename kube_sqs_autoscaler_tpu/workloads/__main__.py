@@ -111,8 +111,9 @@ def main(argv=None) -> None:
              "all shards advance in a single jitted decode call per "
              "cycle, refills route freest-shard-first, and greedy "
              "outputs stay byte-identical to S independent workers "
-             "(requires --continuous; plain decode path only — not "
-             "with --beams / --speculative-draft-layers; under "
+             "(requires --continuous; not with --beams; composes with "
+             "--speculative-draft-layers — draft-and-verify rounds "
+             "gang-step over the whole plane, single-chip; under "
              "--model-parallel the mesh's data axis must divide S, so "
              "each device holds whole shards)",
     )
@@ -125,9 +126,9 @@ def main(argv=None) -> None:
              "bodies opt in via {'tenant': ..., 'ids': [...]} and "
              "unlabeled traffic lands on the FIRST listed tenant "
              "(single default tenant = the reference FIFO path, "
-             "byte-identical results; requires --continuous; plain "
-             "decode path only — not with --beams / "
-             "--speculative-draft-layers)",
+             "byte-identical results; requires --continuous; not with "
+             "--beams; composes with --speculative-draft-layers via "
+             "the decode plane, single-chip)",
     )
     parser.add_argument(
         "--tenant-weights", default="", metavar="W,W,...",
@@ -350,10 +351,17 @@ def main(argv=None) -> None:
         # restored (same convention as the --beams checks above)
         if not args.continuous:
             raise SystemExit("--decode-block requires --continuous")
-        if args.beams > 1 or args.speculative_draft_layers:
+        if args.beams > 1 or (
+            args.speculative_draft_layers
+            and not (args.shards > 1 or args.tenants)
+        ):
+            # spec + shards/tenants rides the gang plane, whose block
+            # engine carries plain rows; fused spec stays excluded
             raise SystemExit(
                 "--decode-block applies to the plain continuous decode "
-                "path (not --beams / --speculative-draft-layers)"
+                "path (not --beams; --speculative-draft-layers only "
+                "with --shards / --tenants, where the decode plane's "
+                "gang engine carries it)"
             )
     if args.request_ttl < 0:
         raise SystemExit(
@@ -364,15 +372,30 @@ def main(argv=None) -> None:
         raise SystemExit("--request-ttl requires --continuous")
     if args.shards < 1:
         raise SystemExit(f"--shards {args.shards} must be >= 1")
+    # --speculative-draft-layers with --shards or --tenants routes to
+    # the decode-plane engine (planes/engine.py): draft-and-verify
+    # rounds gang-step over the whole [S, B] plane, so these
+    # combinations are legal now.  --beams stays a usage error (beam
+    # search is deterministic; there is no draft round), and the plane
+    # is single-chip, so --model-parallel is rejected args-only here
+    # rather than mid-build.
+    spec_on_plane = bool(args.speculative_draft_layers) and (
+        args.shards > 1 or bool(args.tenants)
+    )
+    if spec_on_plane and args.model_parallel:
+        raise SystemExit(
+            "--speculative-draft-layers with --shards / --tenants runs "
+            "on the single-chip decode plane (not with --model-parallel)"
+        )
     if args.shards > 1:
         # args-only checks fail BEFORE the mesh is built or a checkpoint
         # restored (same convention as the --decode-block checks above)
         if not args.continuous:
             raise SystemExit("--shards requires --continuous")
-        if args.beams > 1 or args.speculative_draft_layers:
+        if args.beams > 1:
             raise SystemExit(
                 "--shards applies to the plain continuous decode path "
-                "(not --beams / --speculative-draft-layers)"
+                "(not --beams)"
             )
     tenancy = None
     if args.tenants:
@@ -380,10 +403,10 @@ def main(argv=None) -> None:
         # restored (same convention as the --decode-block checks above)
         if not args.continuous:
             raise SystemExit("--tenants requires --continuous")
-        if args.beams > 1 or args.speculative_draft_layers:
+        if args.beams > 1:
             raise SystemExit(
                 "--tenants applies to the plain continuous decode path "
-                "(not --beams / --speculative-draft-layers)"
+                "(not --beams)"
             )
         tenant_names = tuple(
             s.strip() for s in args.tenants.split(",") if s.strip()
@@ -519,7 +542,7 @@ def main(argv=None) -> None:
         if "decode_block" in knob_names and (
             (args.decode_block < 2 and args.shards < 2)
             or args.beams > 1
-            or args.speculative_draft_layers
+            or (args.speculative_draft_layers and not spec_on_plane)
         ):
             # the full _block_engine predicate, args-only: fails before
             # the mesh is built, like every other startup check here

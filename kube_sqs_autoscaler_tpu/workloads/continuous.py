@@ -2389,7 +2389,30 @@ class ContinuousWorker:
         shards = getattr(service_config, "shards", 1)
         if sharded is None:
             sharded = shards > 1
-        if sharded:
+        if draft_layers > 0 and (sharded or tenancy is not None):
+            # speculative x shards/tenancy: these combinations run on
+            # the decode-plane engine (planes/engine.py), which gang-
+            # steps draft-and-verify rounds over the whole [S*B] row
+            # axis — tenancy without --shards rides the S=1 end of the
+            # same plane (the plain spec engine has no tenant staging
+            # surface).  The fused single-tenant path below is
+            # unchanged.
+            from ..planes.engine import DecodePlaneBatcher
+
+            plane_kwargs = dict(batcher_kwargs)
+            plane_kwargs.pop("draft_layers")
+            plane_kwargs.pop("draft_tokens")
+            self.batcher = DecodePlaneBatcher(
+                params, model_config,
+                shards=shards,
+                shard_slots=service_config.batch_size,
+                prompt_len=service_config.seq_len,
+                generate_tokens=service_config.generate_tokens,
+                spec_layers=draft_layers,
+                spec_tokens=draft_tokens,
+                **plane_kwargs,
+            )
+        elif sharded:
             # the sharded serving plane: `shards` gang-stepped engine
             # shards of batch_size slots each behind this one worker's
             # admission loop (ONE decode dispatch per cycle however many
@@ -3163,6 +3186,35 @@ class ContinuousWorker:
                     "once + LRU-evict) their prefix entry.",
                     kind="counter",
                 )
+        # decode-plane serving (planes/engine.py): the measured-
+        # economics accept rate (per tenant through the same bounded
+        # label registry as every other tenant series) and the KV
+        # handoff counter
+        if getattr(batcher, "spec_layers", 0):
+            accept_help = (
+                "Accepted-draft fraction of proposed speculative "
+                "tokens in [0, 1] (labeled rows are per-tenant, "
+                "bounded like every tenant series; the unlabeled row "
+                "is plane-wide)."
+            )
+            rate = batcher.accept_rate()
+            if rate is not None:
+                self.metrics.set_gauge(
+                    "speculative_accept_rate", rate, accept_help,
+                )
+            for tenant in sorted(batcher.tenant_spec_rounds):
+                self.metrics.set_gauge(
+                    "speculative_accept_rate",
+                    batcher.accept_rate(tenant) or 0.0, accept_help,
+                    labels=(("tenant", tenant),),
+                )
+        if getattr(batcher, "kv_transfers", None) is not None:
+            self.metrics.set_gauge(
+                "plane_kv_transfers_total", batcher.kv_transfers,
+                "KV rows this decode plane adopted from prefill-plane "
+                "donors over the handoff transport.",
+                kind="counter",
+            )
 
     def run_once(self) -> int:
         """One engine cycle: refill free slots, advance the decode block

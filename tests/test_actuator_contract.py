@@ -130,8 +130,26 @@ def make_shards(initial, min_, max_, up=1, down=1):
     return pool, (lambda: pool.replicas), fail_next_up
 
 
-MAKERS = [make_pod, make_pool, make_shards]
-IDS = ["pod", "pool", "shards"]
+def make_disagg(initial, min_, max_, up=1, down=1):
+    # the prefill plane is the Scaler surface; the embedded decode
+    # plane (one stub sharded worker) rides along un-actuated.  The
+    # shuttle getattr-guards the handoff surface, so plain stubs work.
+    from kube_sqs_autoscaler_tpu.planes import DisaggregatedPool
+
+    pool = DisaggregatedPool(
+        lambda p: _StubWorker(), lambda p: _StubShardedWorker(2),
+        min=min_, max=max_, scale_up_pods=up, scale_down_pods=down,
+        initial=initial, decode_min=1, decode_max=2, decode_initial=2,
+    )
+
+    def fail_next_up(err):
+        pool.fail_next_up = err
+
+    return pool, (lambda: pool.replicas), fail_next_up
+
+
+MAKERS = [make_pod, make_pool, make_shards, make_disagg]
+IDS = ["pod", "pool", "shards", "disagg"]
 
 
 @pytest.mark.parametrize("make", MAKERS, ids=IDS)

@@ -1,0 +1,616 @@
+"""The disaggregated prefill/decode planes and fleet-wide speculation.
+
+Tier-1 (tiny model, CPU JAX): the decode-plane engine (plain parity
+against the sharded gang, gang-stepped draft-and-verify parity with
+per-tenant accept accounting, the drain-to-plain speculative flip, the
+KV-handoff transport and its validation), the DisaggregatedPool fleet
+cycle (exactly-once through the shuttle, decode-cadence decoupling, a
+prefill kill mid-handoff, a visibility-timeout redelivery racing a row
+the decode plane already owns), the durable plane-state surface, the
+``plane_ratio``/``speculative`` knob routing, the plane gauge families,
+and the ``--suite disagg`` bench smoke (timing gates off).  The full
+battery — the committed ``BENCH_r20.json`` with the TTFT/tokens-per-
+second win gates — runs in the slow tier.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.core.clock import FakeClock  # noqa: E402
+from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue  # noqa: E402
+from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics  # noqa: E402
+from kube_sqs_autoscaler_tpu.planes import (  # noqa: E402
+    DecodePlaneBatcher,
+    DisaggregatedPool,
+    PrefillWorker,
+)
+from kube_sqs_autoscaler_tpu.sched.knobs import (  # noqa: E402
+    KNOB_PLANE_RATIO,
+    KNOB_SPECULATIVE,
+    KnobActuator,
+    KnobError,
+)
+from kube_sqs_autoscaler_tpu.workloads.continuous import (  # noqa: E402
+    ContinuousBatcher,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from kube_sqs_autoscaler_tpu.workloads.service import (  # noqa: E402
+    ServiceConfig,
+    collect_replies,
+)
+from kube_sqs_autoscaler_tpu.workloads.shard_plane import (  # noqa: E402
+    ShardedBatcher,
+)
+from kube_sqs_autoscaler_tpu.workloads.tenancy import (  # noqa: E402
+    TenancyConfig,
+)
+
+PROMPT, TOKENS, BLOCK, SPEC = 8, 8, 2, 3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=PROMPT + TOKENS + 2 * SPEC, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    return params, config
+
+
+def make_decode_plane(tiny, *, donor=None, draft_enabled=None):
+    params, config = tiny
+    plane = DecodePlaneBatcher(
+        params, config, shards=2, shard_slots=2,
+        prompt_len=PROMPT, generate_tokens=TOKENS, decode_block=BLOCK,
+        spec_layers=1, spec_tokens=SPEC, draft_enabled=draft_enabled,
+    )
+    if donor is not None:
+        plane.adopt_engine(donor)
+    return plane
+
+
+@pytest.fixture(scope="module")
+def plane_donor(tiny):
+    """One warmed decode plane the engine tests adopt, so the module
+    pays each compiled program once."""
+    return make_decode_plane(tiny)
+
+
+@pytest.fixture(scope="module")
+def prefill_donor(tiny):
+    """One warmed plain batcher shaped like a prefill replica."""
+    params, config = tiny
+    return ContinuousBatcher(
+        params, config, 2, PROMPT, TOKENS, decode_block=BLOCK,
+    )
+
+
+def prompts_for(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, 64, rng.integers(2, PROMPT + 1)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def drain(plane, max_steps=300):
+    out = {}
+    for _ in range(max_steps):
+        for payload, tokens in plane.step():
+            out[payload] = list(tokens)
+        if plane.active == 0:
+            break
+    return out
+
+
+@pytest.fixture(scope="module")
+def expected(tiny, plane_donor):
+    """Reference outputs for ``prompts_for(4)`` from the plain sharded
+    gang — the parity target every decode-plane mode must match."""
+    params, config = tiny
+    control = ShardedBatcher(
+        params, config, shards=2, shard_slots=2,
+        prompt_len=PROMPT, generate_tokens=TOKENS, decode_block=BLOCK,
+    )
+    control.submit_many(
+        [(ids, f"p{i}") for i, ids in enumerate(prompts_for(4))]
+    )
+    return drain(control)
+
+
+# ---------------------------------------------------------------------------
+# The decode-plane engine
+# ---------------------------------------------------------------------------
+
+
+def test_plane_plain_parity(tiny, plane_donor, expected):
+    plane = make_decode_plane(tiny, donor=plane_donor, draft_enabled=False)
+    plane.submit_many(
+        [(ids, f"p{i}") for i, ids in enumerate(prompts_for(4))]
+    )
+    assert drain(plane) == expected
+    assert plane.spec_rounds == 0  # plain rows pay zero spec dispatches
+
+
+def test_plane_spec_parity_and_accept_accounting(
+    tiny, plane_donor, expected,
+):
+    plane = make_decode_plane(tiny, donor=plane_donor)
+    assert plane.draft_enabled  # a drafted plane defaults to drafting
+    assert plane.accept_rate() is None  # no rounds yet
+    rows = plane.submit_many(
+        [(ids, f"p{i}") for i, ids in enumerate(prompts_for(4))]
+    )
+    plane.tag_tenant(rows, ["a", "a", "b", "b"])
+    assert drain(plane) == expected  # greedy draft-and-verify is exact
+    assert plane.spec_rounds > 0
+    overall = plane.accept_rate()
+    assert 0.0 < overall <= 1.0
+    assert plane.recent_accept_rate() is not None
+    for tenant in ("a", "b"):
+        rate = plane.accept_rate(tenant)
+        assert rate is not None and 0.0 <= rate <= 1.0
+    assert plane.accept_rate("never-seen") is None
+
+
+def test_drain_to_plain_flip_mid_flight(tiny, plane_donor, expected):
+    plane = make_decode_plane(tiny, donor=plane_donor)
+    prompts = prompts_for(4)
+    plane.submit_many([(ids, f"q{i}") for i, ids in enumerate(prompts[:2])])
+    plane.step()  # the drafted rows are mid-flight
+    plane.set_speculative(False)
+    plane.submit_many([(ids, f"r{i}") for i, ids in enumerate(prompts[2:])])
+    # in-flight rows keep their admitted mode, new rows landed plain
+    assert plane._slot_spec.count(True) == 2
+    out = drain(plane)
+    assert out == {
+        **{f"q{i}": expected[f"p{i}"] for i in range(2)},
+        **{f"r{i}": expected[f"p{i + 2}"] for i in range(2)},
+    }
+    assert plane.spec_flips == 1
+    plane.set_speculative(False)  # no-op: not a flip
+    assert plane.spec_flips == 1
+    plane.set_speculative(True)
+    assert plane.spec_flips == 2
+
+
+def test_plane_validates(tiny, plane_donor):
+    params, config = tiny
+    with pytest.raises(ValueError, match="max_seq_len"):
+        DecodePlaneBatcher(
+            params, config, shards=2, shard_slots=2,
+            prompt_len=PROMPT, generate_tokens=TOKENS + 1,
+            decode_block=BLOCK, spec_layers=1, spec_tokens=SPEC,
+        )
+    with pytest.raises(ValueError, match="decode-plane donor"):
+        plane = make_decode_plane(tiny)
+        plane.adopt_engine(
+            ShardedBatcher(
+                params, config, shards=2, shard_slots=2,
+                prompt_len=PROMPT, generate_tokens=TOKENS,
+                decode_block=BLOCK,
+            )
+        )
+
+
+def _handoff_records(donor):
+    return [
+        (row, slot.payload, list(slot.produced), slot.budget,
+         slot.submitted_at, slot.tenant)
+        for row, slot in enumerate(donor.slots)
+        if slot.busy and slot.produced and not slot.done
+        and len(slot.produced) < slot.budget
+    ]
+
+
+@pytest.mark.parametrize("drafted", [False, True], ids=["plain", "spec"])
+def test_handoff_adopts_prefill_rows(
+    tiny, plane_donor, prefill_donor, expected, drafted,
+):
+    donor = prefill_donor
+    prompts = prompts_for(4)
+    donor.submit_many([(ids, f"p{i}") for i, ids in enumerate(prompts[:2])])
+    donor._settle_pending_firsts()  # first tokens only — no decode steps
+    records = _handoff_records(donor)
+    assert len(records) == 2
+    assert all(len(produced) == 1 for _, _, produced, _, _, _ in records)
+
+    plane = make_decode_plane(tiny, donor=plane_donor,
+                              draft_enabled=drafted)
+    rows = plane.submit_handoff(donor, records)
+    assert plane.kv_transfers == 2
+    for row in rows:
+        assert plane.slots[row].ttft_done  # TTFT was timed at prefill
+        assert plane._slot_spec[row] is drafted
+    for row, _ in zip(range(len(donor.slots)), records):
+        donor.slots[row].busy = False  # what complete_handoff does
+    donor._invalidate_admission_cache()
+    out = drain(plane)
+    # the adopted rows decode exactly what the fused engine produces
+    assert out == {f"p{i}": expected[f"p{i}"] for i in range(2)}
+    if drafted:
+        assert plane.spec_rounds > 0
+
+
+def test_handoff_validates(tiny, plane_donor, prefill_donor):
+    plane = make_decode_plane(tiny, donor=plane_donor)
+    ids = prompts_for(1)[0]
+    finished = [(0, "p", list(range(TOKENS)), TOKENS, 0.0, None)]
+    with pytest.raises(ValueError, match="started, unfinished"):
+        plane.submit_handoff(prefill_donor, finished)
+    too_many = [
+        (0, f"p{i}", [1], TOKENS, 0.0, None)
+        for i in range(len(plane.slots) + 1)
+    ]
+    with pytest.raises(RuntimeError, match="no free slot"):
+        plane.submit_handoff(prefill_donor, too_many)
+    with pytest.raises(ValueError, match="layout-identical"):
+        params, config = tiny
+        other = ContinuousBatcher(
+            params,
+            ModelConfig(
+                vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                d_ff=64, max_seq_len=PROMPT + TOKENS + 2 * SPEC,
+                dtype=jnp.float32,
+            ),
+            2, PROMPT, TOKENS, decode_block=BLOCK,
+        )
+        plane.submit_handoff(other, [(0, "p", [1], TOKENS, 0.0, None)])
+    assert plane.kv_transfers == 0  # nothing moved
+
+
+# ---------------------------------------------------------------------------
+# The disaggregated pool: one admission surface, two actuated planes
+# ---------------------------------------------------------------------------
+
+
+def service_config(**overrides):
+    base = dict(
+        queue_url="disagg://q", batch_size=2, seq_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK, shards=2,
+        result_queue_url="disagg://r",
+    )
+    base.update(overrides)
+    return ServiceConfig(**base)
+
+
+def make_disagg(tiny, *, queue_url, visibility=30.0, draft_enabled=None,
+                donor=None, tenants=("t",), min=2, max=2, **pool_kwargs):
+    params, config = tiny
+    clock = FakeClock()
+    queue = FakeMessageQueue(visibility_timeout=visibility,
+                             now_fn=clock.now)
+    results = FakeMessageQueue(now_fn=clock.now)
+    service = service_config(
+        queue_url=queue_url, result_queue_url=f"{queue_url}-r",
+    )
+    pool = DisaggregatedPool.serving(
+        queue, params, config, service, result_queue=results,
+        min=min, max=max, decode_shards=2, spec_layers=1,
+        spec_tokens=SPEC, draft_enabled=draft_enabled,
+        tenancy=TenancyConfig(tenants=tuple(tenants)),
+        clock=clock, now_fn=clock.now,
+        prefill_engine_source=(
+            donor.engine_donor() if donor is not None else None
+        ),
+        decode_engine_source=(
+            donor.decode.batcher if donor is not None else None
+        ),
+        **pool_kwargs,
+    )
+    return pool, clock, queue, results, service
+
+
+@pytest.fixture(scope="module")
+def pool_donor(tiny):
+    """One warmed disaggregated pool whose engines every pool test
+    adopts (prefill insert programs + decode gang/spec/handoff)."""
+    pool, _, _, _, _ = make_disagg(tiny, queue_url="disagg://donor")
+    return pool
+
+
+def send(queue, queue_url, ids, tenant="t"):
+    return queue.send_message(
+        queue_url,
+        json.dumps({"tenant": tenant, "ids": [int(i) for i in ids]}),
+    )
+
+
+def drive(pool, clock, *, until, max_cycles=200, on_cycle=None):
+    for cycle in range(max_cycles):
+        if on_cycle is not None:
+            on_cycle(cycle)
+        pool.run_cycle()
+        clock.advance(0.2)
+        if until():
+            return cycle + 1
+    raise AssertionError(
+        f"pool did not converge in {max_cycles} cycles: "
+        f"processed={pool.processed} idle={pool.idle}"
+    )
+
+
+def test_pool_exactly_once_through_the_shuttle(tiny, pool_donor):
+    pool, clock, queue, results, service = make_disagg(
+        tiny, queue_url="disagg://e2e", donor=pool_donor,
+    )
+    to_send = prompts_for(12, seed=31)
+    sent = []
+
+    def on_cycle(_):
+        if to_send:
+            sent.append(
+                send(queue, "disagg://e2e", to_send.pop(0))
+            )
+
+    drive(pool, clock,
+          until=lambda: not to_send and pool.processed >= 12 and pool.idle,
+          on_cycle=on_cycle)
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    assert pool.kv_handoffs_total >= 12
+    # the decode plane consumed only handoffs, never the queue
+    assert pool.decode.batcher.kv_transfers == pool.kv_handoffs_total
+    # TTFT lives on the prefill plane (arrival-stamped under tenancy)
+    ttfts = [
+        t for r in pool.members
+        for t in r.worker.batcher.tenant_ttft.get("t", ())
+    ]
+    assert ttfts and all(t >= 0.0 for t in ttfts)
+
+
+def test_decode_cadence_decouples_from_poll_cadence(tiny, pool_donor):
+    # same burst, same hardware: gang cadence 2 (default) sustains the
+    # full pipeline rate; cadence 1 leaves the classic insert/settle
+    # bubble.  The disaggregation win the bench quantifies, pinned here
+    # at the cycle level.
+    cycles = {}
+    for cadence in (1, 2):
+        pool, clock, queue, results, _ = make_disagg(
+            tiny, queue_url=f"disagg://cad{cadence}", donor=pool_donor,
+            draft_enabled=False, decode_steps_per_cycle=cadence,
+        )
+        sent = [
+            send(queue, f"disagg://cad{cadence}", ids)
+            for ids in prompts_for(16, seed=33)
+        ]
+        cycles[cadence] = drive(
+            pool, clock,
+            until=lambda: pool.processed >= 16 and pool.idle,
+        )
+        replies, duplicates = collect_replies(
+            results, f"disagg://cad{cadence}-r"
+        )
+        assert set(replies) == set(sent) and duplicates == 0
+    assert cycles[2] < cycles[1]
+    with pytest.raises(ValueError, match="decode_steps_per_cycle"):
+        make_disagg(
+            tiny, queue_url="disagg://cad0", donor=pool_donor,
+            decode_steps_per_cycle=0,
+        )
+
+
+def test_prefill_kill_mid_handoff_redispatches(tiny, pool_donor):
+    # cadence 1 strands started rows on their prefill replica while the
+    # decode plane is busy — the kill lands mid-handoff for real
+    pool, clock, queue, results, service = make_disagg(
+        tiny, queue_url="disagg://kill", donor=pool_donor,
+        draft_enabled=False, decode_steps_per_cycle=1,
+    )
+    to_send = prompts_for(14, seed=35)
+    sent = []
+    state = {"killed": None}
+
+    def on_cycle(_):
+        for _ in range(2):
+            if to_send:
+                sent.append(send(queue, "disagg://kill", to_send.pop(0)))
+        if state["killed"] is None:
+            victims = [
+                r for r in pool.members
+                if r.state == "serving" and r.worker.batcher.active > 0
+            ]
+            if victims:
+                state["killed"] = victims[-1].index
+                victims[-1].worker.kill()
+
+    drive(pool, clock,
+          until=lambda: not to_send and pool.processed >= 14 and pool.idle,
+          on_cycle=on_cycle)
+    assert state["killed"] is not None
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+    assert pool.redispatched_total > 0  # the kill stranded real rows
+
+
+def test_redelivery_racing_decode_owned_row_stays_exactly_once(
+    tiny, pool_donor,
+):
+    # a visibility-timeout redelivery of a request the decode plane
+    # already owns re-prefills and re-hands off; the shared reply
+    # registry suppresses whichever reply lands second
+    pool, clock, queue, results, service = make_disagg(
+        tiny, queue_url="disagg://race", donor=pool_donor,
+        draft_enabled=False,
+    )
+    sent = [
+        send(queue, "disagg://race", ids)
+        for ids in prompts_for(6, seed=37)
+    ]
+    state = {"redelivered": False}
+
+    def on_cycle(_):
+        decode = pool.decode.batcher
+        if not state["redelivered"] and decode.active > 0:
+            state["redelivered"] = True
+            for slot in decode.slots:
+                if slot.busy and slot.payload:
+                    queue.change_message_visibility(
+                        "disagg://race", slot.payload["ReceiptHandle"], 0,
+                    )
+
+    def queue_drained():
+        attrs = queue.get_queue_attributes("disagg://race", ["All"])
+        return (attrs["ApproximateNumberOfMessages"] == "0"
+                and attrs["ApproximateNumberOfMessagesNotVisible"] == "0")
+
+    drive(pool, clock,
+          until=lambda: pool.processed >= 6 and pool.idle
+          and queue_drained(),
+          on_cycle=on_cycle)
+    assert state["redelivered"]
+    assert pool.duplicates_suppressed > 0
+    replies, duplicates = collect_replies(results, service.result_queue_url)
+    assert set(replies) == set(sent)
+    assert duplicates == 0
+
+
+# ---------------------------------------------------------------------------
+# Durable plane state
+# ---------------------------------------------------------------------------
+
+
+def test_export_import_carries_plane_state(tiny, pool_donor):
+    pool, clock, queue, results, _ = make_disagg(
+        tiny, queue_url="disagg://dur", donor=pool_donor,
+    )
+    sent = [
+        send(queue, "disagg://dur", ids) for ids in prompts_for(4, seed=39)
+    ]
+    drive(pool, clock, until=lambda: pool.processed >= 4 and pool.idle)
+    pool.decode.batcher.set_speculative(False)  # a measured decision
+    state = pool.export_state()
+    assert state["kv_handoffs_total"] == pool.kv_handoffs_total > 0
+    assert state["draft_enabled"] is False
+
+    fresh, _, _, _, _ = make_disagg(
+        tiny, queue_url="disagg://dur2", donor=pool_donor,
+    )
+    assert fresh.decode.batcher.draft_enabled  # drafted by default
+    flips_before = fresh.decode.batcher.spec_flips
+    fresh.import_state(json.loads(json.dumps(state)))
+    assert fresh.kv_handoffs_total == pool.kv_handoffs_total
+    # the drafting decision survived the restart — silently (a
+    # rehydration is not a knob flip and must not count one)
+    assert fresh.decode.batcher.draft_enabled is False
+    assert fresh.decode.batcher.spec_flips == flips_before
+    # the reply registry rode along: the answered requests stay answered
+    assert all(fresh.already_replied(m) for m in sent)
+
+
+# ---------------------------------------------------------------------------
+# Knob routing and plane gauges
+# ---------------------------------------------------------------------------
+
+
+def test_knobs_route_to_the_right_plane(tiny, pool_donor):
+    pool, clock, queue, results, _ = make_disagg(
+        tiny, queue_url="disagg://knob", donor=pool_donor, min=1, max=3,
+    )
+    actuator = KnobActuator(
+        pool, armed=(KNOB_SPECULATIVE, KNOB_PLANE_RATIO), clock=clock,
+    )
+    # speculative routes to the ONE decode-plane worker
+    assert actuator.set(KNOB_SPECULATIVE, False)
+    (change,) = actuator.apply()
+    assert change["knob"] == KNOB_SPECULATIVE
+    assert pool.decode.batcher.draft_enabled is False
+    assert pool.decode.batcher.spec_flips == 1
+    # plane_ratio walks the prefill plane through its own Scaler
+    assert actuator.set(KNOB_PLANE_RATIO, 3)
+    (change,) = actuator.apply()
+    assert change["knob"] == KNOB_PLANE_RATIO and change["value"] == 3
+    assert pool.replicas == 3
+    assert pool.decode_pool.replicas == 2  # the decode plane unmoved
+    with pytest.raises(KnobError, match="plane_ratio"):
+        actuator.set(KNOB_PLANE_RATIO, 7)  # outside [min, max]
+
+
+def test_plane_gauges_exported(tiny, pool_donor):
+    pool, clock, queue, results, _ = make_disagg(
+        tiny, queue_url="disagg://obs", donor=pool_donor,
+        tenants=("a", "b"),
+    )
+    metrics = WorkloadMetrics()
+    pool.attach_metrics(metrics)
+    pool.decode.attach_metrics(metrics)
+    to_send = prompts_for(8, seed=41)
+    sent = []
+
+    def on_cycle(cycle):
+        if to_send:
+            sent.append(send(queue, "disagg://obs", to_send.pop(0),
+                             tenant="ab"[cycle % 2]))
+
+    drive(pool, clock,
+          until=lambda: not to_send and pool.processed >= 8 and pool.idle,
+          on_cycle=on_cycle)
+    text = metrics.render()
+    assert "plane_prefill_replicas 2.0" in text
+    assert "plane_decode_shards 2.0" in text
+    assert "plane_kv_transfers_total" in text
+    assert 'speculative_accept_rate{tenant="a"}' in text
+    assert 'speculative_accept_rate{tenant="b"}' in text
+
+
+def test_serving_requires_a_drafted_decode_plane(tiny):
+    params, config = tiny
+    with pytest.raises(ValueError, match="draft_enabled=False"):
+        DisaggregatedPool.serving(
+            FakeMessageQueue(), params, config, service_config(),
+            min=1, max=1, decode_shards=2, spec_layers=0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# The disagg bench: tier-1 smoke (timing gates off), full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_bench_smoke(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_disagg.json"
+    summary = bench.run_disagg_suite(str(out), timing_gates=False)
+    assert summary["metric"] == "disagg_ttft_win"
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "disagg"
+    for name, episode in artifact["episodes"].items():
+        assert episode["answered"] == episode["requests"], name
+        assert episode["duplicates"] == 0, name
+    assert artifact["episodes"]["disagg"]["kv_handoffs"] > 0
+    kill = artifact["episodes"]["prefill-kill"]["kill"]
+    assert kill["inflight_rows"] > 0
+    assert kill["kv_handoffs_after"] > kill["kv_handoffs_before"]
+    values = [c["value"] for c in artifact["flip_changes"]]
+    assert True in values and False in values  # both flip directions
+    probe = artifact["probe"]
+    assert probe["accept_rate_friendly"] > probe["accept_rate_hostile"]
+
+
+@pytest.mark.slow
+def test_disagg_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_disagg_full.json"
+    summary = bench.run_disagg_suite(str(out))
+    artifact = json.loads(out.read_text())
+    fused = artifact["episodes"]["fused"]
+    disagg = artifact["episodes"]["disagg"]
+    assert disagg["ttft_p99_s"] < fused["ttft_p99_s"]
+    assert disagg["tokens_per_second"] >= fused["tokens_per_second"]
+    assert summary["vs_baseline"] > 1.0

@@ -309,6 +309,82 @@ def test_submit_resume_validates(tiny, donor22):
         plane.submit_resume(too_many)
 
 
+@pytest.mark.parametrize("cut", [BLOCK, TOKENS - 1],
+                         ids=["block-boundary", "budget-edge"])
+def test_resume_at_block_and_budget_edges(tiny, donor22, cut):
+    # the two cut points the greedy-parity test can't hit by stepping:
+    # a resume cut exactly at a decode-block boundary, and one token
+    # short of the budget — there the resume insert's first token is
+    # the request's LAST (remaining block budget zero), so the row must
+    # complete straight out of the insert settle
+    ids = prompts_for(1, seed=23)[0]
+    control = make_plane(tiny, donor=donor22)
+    submit(control, [ids], tag="e")
+    expected = drain(control)["req-e-0"]
+    assert len(expected) == TOKENS
+
+    plane = make_plane(tiny, donor=donor22)
+    rows = plane.submit_resume(
+        [(ids, "resumed", expected[:cut], TOKENS, 0.0)]
+    )
+    assert len(rows) == 1
+    out = drain(plane)
+    assert out["resumed"] == expected
+
+
+def test_pooled_prefix_row_resume_parity(tiny, donor22):
+    # a row admitted through the shared prefix pool evacuates through
+    # the PLAIN resume path: the evacuation record carries only the
+    # produced tokens, so the resume re-prefills the full concatenated
+    # prompt with no pool entry behind it — parity must hold against a
+    # control that never touched the pool
+    params, config = tiny
+    rng = np.random.default_rng(43)
+    # the pooled layout spends max_seq_len on prefix + prompt + gen, so
+    # the pooled bucket is smaller than the module default — and the
+    # full concatenated prompt must fit the PLAIN bucket the resume
+    # lands in (the resume path truncates to prompt_len)
+    prefix_len, pooled_prompt = 2, 6
+    prefix = rng.integers(1, 64, prefix_len).astype(np.int32)
+    suffix = rng.integers(1, 64, pooled_prompt - prefix_len).astype(
+        np.int32
+    )
+    full = np.concatenate([prefix, suffix])
+
+    control = ShardedBatcher(
+        params, config, shards=2, shard_slots=2,
+        prompt_len=pooled_prompt, generate_tokens=TOKENS,
+        decode_block=BLOCK,
+    )
+    control.submit_many([(full, "req-x-0")])
+    expected = drain(control)["req-x-0"]
+
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    worker = ContinuousWorker(
+        FakeMessageQueue(), params, config,
+        service_config(seq_len=pooled_prompt, result_queue_url=""),
+        tenancy=TenancyConfig(
+            tenants=("a",), prefix_pool=2, prefix_len=prefix_len,
+            sticky=True,
+        ),
+        sharded=True,
+    )
+    batcher = worker.batcher
+    (row,) = batcher.submit_many_prefixed([("a", prefix, suffix, "pp")])
+    shard = row // service_config().batch_size
+    batcher.step()
+    batcher.step()  # a few tokens in, mid-request
+    taken = batcher.take_shard_inflight(shard)
+    assert len(taken) == 1
+    payload, produced, budget, submitted_at = taken[0]
+    assert payload == "pp" and 0 < len(produced) < budget
+    batcher.submit_resume([(full, payload, produced, budget,
+                            submitted_at)])
+    out = drain(batcher)
+    assert out["pp"] == expected
+
+
 # ---------------------------------------------------------------------------
 # The pool's quarantine state machine: detect -> quarantine -> evacuate
 # -> probe -> readmit
