@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -176,6 +176,19 @@ bench-knobs:
 # per-plane gauges export; writes BENCH_r20.json
 bench-disagg:
 	JAX_PLATFORMS=cpu python bench.py --suite disagg
+
+# Request-lifecycle tracing battery (CPU JAX, ~10 s): per-request phase
+# chains stamped at every seam on the disaggregated pool; exits 2
+# unless every answered request carries a gap-free monotone chain with
+# exactly ONE reply stamp — through a replica kill + registry
+# export/import restart (flow-id epochs must not collide) and a
+# redelivery storm (duplicate copies close without a reply) — tracing
+# adds zero dispatches/transfers with >=0.97x tokens/s and byte-
+# identical replies, the phase/TTFT/ITL/TPOT histograms export, and
+# attribute_slo names the injected bottleneck (prefill-starved vs
+# decode-contended); writes BENCH_r21.json
+bench-obs:
+	JAX_PLATFORMS=cpu python bench.py --suite obs
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

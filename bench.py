@@ -4951,7 +4951,8 @@ def _disagg_episode(
     flip_policy_factory=None, kill_after=None, metrics=None,
     prefill_engine_source=None, decode_engine_source=None,
     fused_engine_source=None, decode_steps_per_cycle=2,
-    max_cycles=4000,
+    max_cycles=4000, lifecycle=None, visibility_timeout=1e6,
+    staging_per_tenant=0, staging_total=0,
 ):
     """One virtual-time serving episode, fused or disaggregated.
 
@@ -4975,7 +4976,9 @@ def _disagg_episode(
     from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
 
     clock = FakeClock()
-    queue = FakeMessageQueue(visibility_timeout=1e6, now_fn=clock.now)
+    queue = FakeMessageQueue(
+        visibility_timeout=visibility_timeout, now_fn=clock.now
+    )
     results = FakeMessageQueue(now_fn=clock.now)
     service = ServiceConfig(
         queue_url="disagg://q", batch_size=batch_size,
@@ -4983,7 +4986,11 @@ def _disagg_episode(
         decode_block=decode_block, shards=fused_shards,
         result_queue_url="disagg://r",
     )
-    tenancy = TenancyConfig(tenants=tuple(tenants))
+    tenancy = TenancyConfig(
+        tenants=tuple(tenants),
+        staging_per_tenant=staging_per_tenant,
+        staging_total=staging_total,
+    )
     if disagg:
         target = DisaggregatedPool.serving(
             queue, params, model, service, result_queue=results,
@@ -5008,6 +5015,11 @@ def _disagg_episode(
         decode_batcher = None
         if metrics is not None:
             target.attach_metrics(metrics)
+    if lifecycle is not None:
+        # request-lifecycle tracing: one registry across the whole
+        # deployment (both planes, every replica) — stamps land at the
+        # existing host seams, so the engine path is unchanged
+        target.attach_lifecycle(lifecycle)
 
     flip_policy = None
     if flip_policy_factory is not None:
@@ -5525,6 +5537,514 @@ def run_disagg_suite(
     }
 
 
+def _obs_dispatch_counters(pool) -> dict:
+    """The PR 7 device-work odometers of a disaggregated deployment:
+    summed insert/decode dispatches and host transfers across the
+    prefill replicas plus the decode plane, and the decode plane's KV
+    transfer count.  Tracing must not move ANY of them."""
+    inserts = decodes = hosts = 0
+    for replica in pool.members:
+        batcher = replica.worker.batcher
+        inserts += batcher.insert_dispatches
+        decodes += batcher.decode_dispatches
+        hosts += batcher.host_transfers
+    decode_b = pool.decode.batcher
+    return {
+        "insert_dispatches": inserts + decode_b.insert_dispatches,
+        "decode_dispatches": decodes + decode_b.decode_dispatches,
+        "host_transfers": hosts + decode_b.host_transfers,
+        "kv_transfers": decode_b.kv_transfers,
+    }
+
+
+def _obs_audit_completeness(
+    registry, answered, *, label, require_staged, failures,
+) -> dict:
+    """The completeness gate: every answered request id shows exactly
+    one reply-stamped trace whose phase chain is gap-free and monotone
+    (``handoff`` required whenever the request decoded past its first
+    token — only those ever cross to the decode plane); any other
+    closed trace of the rid (a consumed duplicate copy) must carry ZERO
+    reply stamps.  Appends one failure line per violation."""
+    from kube_sqs_autoscaler_tpu.obs import validate_chain
+
+    audited = chains_ok = 0
+    for rid in answered:
+        traces = registry.traces_of(rid)
+        if not traces:
+            failures.append(f"{label}: {rid} answered but never traced")
+            continue
+        replied = [t for t in traces if t.count("reply") > 0]
+        if len(replied) != 1:
+            failures.append(
+                f"{label}: {rid} has {len(replied)} reply-stamped traces "
+                f"(exactly-once audit wants 1)"
+            )
+            continue
+        trace = replied[0]
+        problems = validate_chain(
+            trace,
+            require_staged=require_staged,
+            require_handoff=(
+                trace.error is None and len(trace.token_times) > 1
+            ),
+        )
+        audited += 1
+        if problems:
+            failures.append(
+                f"{label}: {rid} chain invalid: {'; '.join(problems)}"
+            )
+        else:
+            chains_ok += 1
+    return {"audited": audited, "chains_ok": chains_ok}
+
+
+def run_obs_suite(
+    output: str = "BENCH_r21.json", *,
+    prompt_len: int = 10, generate_tokens: int = 3, batch_size: int = 2,
+    decode_block: int = 2, spec_layers: int = 1, spec_tokens: int = 2,
+    prefill_replicas: int = 2, decode_shards: int = 2,
+    insert_cost_s: float = 0.006, decode_cost_s: float = 0.002,
+    handoff_cost_s: float = 0.0005, poll_cost_s: float = 0.0004,
+    overhead_floor: float = 0.97,
+    timing_gates: bool = True,
+) -> dict:
+    """Request-lifecycle tracing battery (ISSUE 17), hard-gated
+    (exit 2) on:
+
+    - **completeness** — with tracing on, every answered request shows
+      a gap-free monotone phase chain (arrival → staged → picked →
+      admitted → prefill → first_token → [handoff] → completed →
+      reply) with EXACTLY one ``reply`` stamp, through a clean episode,
+      a mid-handoff prefill kill + mid-episode registry restart
+      (export/import — the durable-snapshot ride), and a
+      short-visibility redelivery storm whose duplicate copies close
+      via the dedup path without ever minting a reply stamp.  The
+      trace audit doubles as an exactly-once proof;
+    - **overhead** — tracing adds ZERO device work: insert/decode
+      dispatches, host transfers, and KV transfers are identical
+      tracing-on vs tracing-off (the PR 7 odometers), replies are
+      byte-identical, and virtual-time tokens/s is within
+      ``overhead_floor`` of the untraced run;
+    - **restart identity** — the restarted registry's flow-id epoch
+      bumps, restored traces are marked, and no two traces in the
+      episode share a flow id (pre-crash ids can never collide with
+      post-restart ones);
+    - **non-vacuous SLO attribution** — ``attribute_slo`` names the
+      injected bottleneck: a prefill-starved episode (one prefill
+      replica against a burst) attributes over-SLO budget to the
+      ``queue`` phase (requests starve waiting for prefill capacity),
+      a decode-contended episode (roomy prefill, gang cadence 1,
+      expensive decode) attributes it to the decode plane (``handoff``
+      stall or ``decode``) — two different answers from one analyzer,
+      each matching its injected cause.
+
+    ``timing_gates=False`` (the tier-1 smoke) shrinks the populations
+    and skips the tokens/s-ratio gate; every completeness, parity,
+    zero-added-dispatch, restart, and attribution gate still runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kube_sqs_autoscaler_tpu.obs import (
+        LifecycleRegistry,
+        WorkloadMetrics,
+        request_trace_events,
+    )
+    from kube_sqs_autoscaler_tpu.sim.scenarios import disagg_scenario
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    start = time.perf_counter()
+    failures: list[str] = []
+    model = ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=prompt_len + generate_tokens + 2 * spec_tokens,
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    if timing_gates:
+        scenario = disagg_scenario(
+            tenants=2, cycles=36, every=2,
+            wave_start=8, wave_cycles=6, wave_per_cycle=6,
+        )
+        burst = 10
+    else:
+        scenario = disagg_scenario(
+            tenants=2, cycles=14, every=2,
+            wave_start=4, wave_cycles=3, wave_per_cycle=2,
+        )
+        burst = 6
+    costs = dict(
+        insert_cost_s=insert_cost_s, decode_cost_s=decode_cost_s,
+        handoff_cost_s=handoff_cost_s, poll_cost_s=poll_cost_s,
+    )
+    shape = dict(
+        model=model, params=params, batch_size=batch_size,
+        prompt_len=prompt_len, generate_tokens=generate_tokens,
+        decode_block=decode_block,
+        fused_shards=prefill_replicas + decode_shards,
+        prefill_replicas=prefill_replicas, decode_shards=decode_shards,
+        spec_layers=spec_layers, spec_tokens=spec_tokens, **costs,
+    )
+    pools = {
+        tenant: (lambda t: lambda k: _disagg_prompt_ids(
+            t, k, prompt_len))(tenant)
+        for tenant in scenario.tenants
+    }
+
+    # -- tracing OFF: the identity baseline (also compiles the donors) --
+    off_ep, off_replies, off_pool = _disagg_episode(
+        disagg=True, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=pools,
+        draft_enabled=False, **shape,
+    )
+    off_counters = _obs_dispatch_counters(off_pool)
+    donors = dict(
+        prefill_engine_source=off_pool.engine_donor(),
+        decode_engine_source=off_pool.decode.batcher,
+    )
+
+    # -- tracing ON: same schedule, registry attached -------------------
+    on_reg = LifecycleRegistry(capacity=4096)
+    on_metrics = WorkloadMetrics()
+    on_ep, on_replies, on_pool = _disagg_episode(
+        disagg=True, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=pools,
+        draft_enabled=False, lifecycle=on_reg, metrics=on_metrics,
+        **donors, **shape,
+    )
+    on_counters = _obs_dispatch_counters(on_pool)
+    if on_replies != off_replies:
+        mismatched = sum(
+            1 for a, b in zip(off_replies, on_replies) if a != b
+        )
+        failures.append(
+            f"identity: {mismatched}/{len(off_replies)} replies differ "
+            f"with tracing on (the engine path must be byte-identical)"
+        )
+    if on_counters != off_counters:
+        failures.append(
+            f"overhead: tracing moved the device-work odometers — "
+            f"off {off_counters} vs on {on_counters}"
+        )
+    ratio = on_ep["tokens_per_second"] / max(
+        off_ep["tokens_per_second"], 1e-9
+    )
+    if timing_gates and ratio < overhead_floor:
+        failures.append(
+            f"overhead: tokens/s tracing-on is {ratio:.4f}x off "
+            f"(floor {overhead_floor})"
+        )
+    # every request must be answered (gated below), so audit them all:
+    # the sent ids are msg-1..msg-N (the FakeMessageQueue counter walk)
+    answered_on = [f"msg-{i}" for i in range(1, on_ep["requests"] + 1)]
+    audit_on = _obs_audit_completeness(
+        on_reg, answered_on, label="on", require_staged=True,
+        failures=failures,
+    )
+    for name, episode in (("off", off_ep), ("on", on_ep)):
+        if episode["lost"] or episode["answered"] != episode["requests"]:
+            failures.append(
+                f"{name}: {episode['answered']}/{episode['requests']} "
+                f"answered ({episode['lost']} lost)"
+            )
+        if episode["duplicates"]:
+            failures.append(f"{name}: duplicate replies")
+    # the Prometheus layer: phase histograms + the per-tenant
+    # TTFT/ITL/TPOT families must come out of a traced episode
+    rendered = on_metrics.render()
+    for needle in (
+        'request_phase_seconds_bucket{phase="queue",le=',
+        'request_phase_seconds_bucket{phase="decode",le=',
+        'request_phase_seconds_bucket{phase="handoff",le=',
+        "request_phase_seconds_sum",
+        'ttft_seconds_bucket{le=',
+        'tenant_time_to_first_token_seconds_bucket{tenant=',
+        'tenant_inter_token_seconds_bucket{tenant=',
+        'tenant_time_per_output_token_seconds_bucket{tenant=',
+    ):
+        if needle not in rendered:
+            failures.append(f"histograms: {needle!r} not exported")
+    # the Perfetto layer: per-phase request spans threaded by one flow
+    # arrow per request on the "requests" process's lanes
+    events = request_trace_events(on_reg.done_traces())
+    span_phs = {e["ph"] for e in events}
+    if not events or not {"X", "s", "f"} <= span_phs:
+        failures.append(
+            f"perfetto: expected X spans + s/f flow arrows, saw "
+            f"{sorted(span_phs)}"
+        )
+    if any(e.get("cat") != "request" for e in events):
+        failures.append("perfetto: non-request category in request events")
+    flow_starts = [e["id"] for e in events if e["ph"] == "s"]
+    if len(flow_starts) != len(set(flow_starts)):
+        failures.append("perfetto: duplicate flow ids in one episode")
+
+    # -- kill + registry restart: the chain survives both ---------------
+    chaos_reg = {"reg": LifecycleRegistry(capacity=4096)}
+    restart_info: dict = {}
+    restart_cycle = scenario.cycles // 2
+
+    def restart_factory(pool, clock):
+        def policy(cycle, sent_tenants):
+            if cycle != restart_cycle or restart_info:
+                return
+            state = chaos_reg["reg"].export_state()
+            fresh = LifecycleRegistry(capacity=4096)
+            recovered = fresh.import_state(state, now=clock.now())
+            pool.attach_lifecycle(fresh)
+            chaos_reg["reg"] = fresh
+            restart_info.update(
+                cycle=cycle, epoch=fresh.epoch, recovered=recovered,
+                open_at_restart=len(state.get("open") or ()),
+            )
+        return policy
+
+    chaos_ep, chaos_replies, _ = _disagg_episode(
+        disagg=True, schedule=scenario.schedule(),
+        tenants=scenario.tenants, prompt_pools=pools,
+        draft_enabled=False, lifecycle=chaos_reg["reg"],
+        kill_after=scenario.cycles // 3,
+        flip_policy_factory=restart_factory,
+        decode_steps_per_cycle=1,
+        **donors, **shape,
+    )
+    if "kill" not in chaos_ep:
+        failures.append(
+            "chaos: no prefill replica had in-flight rows to kill"
+        )
+    if chaos_ep["lost"] or chaos_ep["answered"] != chaos_ep["requests"]:
+        failures.append(
+            f"chaos: {chaos_ep['answered']}/{chaos_ep['requests']} "
+            f"answered ({chaos_ep['lost']} lost)"
+        )
+    if chaos_ep["duplicates"]:
+        failures.append("chaos: duplicate replies")
+    if chaos_replies != off_replies:
+        failures.append(
+            "chaos: replies differ from the untraced baseline (tracing "
+            "+ kill + restart must stay greedy-exact)"
+        )
+    if not restart_info:
+        failures.append("chaos: the registry restart never ran")
+    else:
+        if restart_info["epoch"] != 1:
+            failures.append(
+                f"chaos: restarted flow-id epoch {restart_info['epoch']}"
+                f" != 1"
+            )
+        if restart_info["open_at_restart"] < 1:
+            failures.append(
+                "chaos: restart found no open traces — the snapshot "
+                "ride is vacuous; retune the wave"
+            )
+        if restart_info["recovered"] < 1:
+            failures.append("chaos: restart recovered no traces")
+    reg = chaos_reg["reg"]
+    audit_chaos = _obs_audit_completeness(
+        reg, [f"msg-{i}" for i in range(1, chaos_ep["requests"] + 1)],
+        label="chaos", require_staged=False, failures=failures,
+    )
+    all_traces = reg.done_traces() + reg.open_traces()
+    flow_ids = [t.flow_id for t in all_traces]
+    if len(flow_ids) != len(set(flow_ids)):
+        failures.append(
+            "chaos: flow-id collision across the restart epochs"
+        )
+    if not any(t.notes.get("restored") for t in all_traces):
+        failures.append(
+            "chaos: no trace carries the restored mark — open traces "
+            "did not ride the snapshot"
+        )
+    if not any(t.flow_id >> 32 == 1 for t in all_traces):
+        failures.append(
+            "chaos: no post-restart trace was minted in epoch 1"
+        )
+    redispatched = sum(
+        t.notes.get("redispatched", 0) for t in all_traces
+    )
+    if redispatched < 1:
+        failures.append(
+            "chaos: the kill produced no redispatched-note — failover "
+            "never crossed the trace"
+        )
+
+    # -- redelivery storm: duplicates close without a reply stamp -------
+    dedup_reg = LifecycleRegistry(capacity=4096)
+    # a steady trickle against a single prefill replica at gang
+    # cadence 1, with a visibility window SHORTER than one cycle:
+    # every receive requeues the still-working copies, so redelivered
+    # duplicates flow through admission while (and after) their
+    # originals answer — the dedup path (consume the copy, never a
+    # second reply) runs live.  Staging caps are raised far above the
+    # storm so overflow never nacks: with the PR 10 auto caps, the
+    # redelivered copies and the original tail rotate through a
+    # positional livelock (receive batches always land the same two
+    # rids behind the per-tenant cap); with staging wide open every
+    # received message stages, originals keep their FIFO position in
+    # the DRR queues, and only already-traced copies churn behind
+    # them.  The storm keeps the pool from ever going idle, so the
+    # episode is cycle-bounded instead of drain-bounded; the gates
+    # below only need every request ANSWERED (exactly once) and at
+    # least one duplicate consumed
+    dedup_schedule: list = [[(scenario.tenants[0], burst)]]
+    dedup_ep, dedup_replies, _ = _disagg_episode(
+        disagg=True, schedule=dedup_schedule,
+        tenants=scenario.tenants, prompt_pools=pools,
+        draft_enabled=False, lifecycle=dedup_reg,
+        prefill_replicas=1, decode_steps_per_cycle=1,
+        visibility_timeout=insert_cost_s * 0.5,
+        max_cycles=60,
+        staging_per_tenant=64 * burst, staging_total=64 * burst,
+        **donors, **{k: v for k, v in shape.items()
+                     if k != "prefill_replicas"},
+    )
+    if dedup_ep["lost"] or dedup_ep["answered"] != dedup_ep["requests"]:
+        failures.append(
+            f"dedup: {dedup_ep['answered']}/{dedup_ep['requests']} "
+            f"answered ({dedup_ep['lost']} lost)"
+        )
+    if dedup_ep["duplicates"]:
+        failures.append(
+            "dedup: a consumer saw a duplicate reply — dedup failed"
+        )
+    if dedup_reg.duplicates < 1:
+        failures.append(
+            "dedup: the visibility window never redelivered a request "
+            "(the storm is vacuous; shrink visibility_timeout)"
+        )
+    audit_dedup = _obs_audit_completeness(
+        dedup_reg,
+        [f"msg-{i}" for i in range(1, dedup_ep["requests"] + 1)],
+        label="dedup", require_staged=False, failures=failures,
+    )
+    for trace in dedup_reg.done_traces():
+        if trace.notes.get("duplicate") and trace.count("reply"):
+            failures.append(
+                f"dedup: {trace.rid} duplicate copy carries a reply "
+                f"stamp"
+            )
+
+    # -- SLO attribution: the analyzer names the injected bottleneck ----
+    def _attribution(name, *, n_prefill, steps, dec_cost, slo_s):
+        reg = LifecycleRegistry(capacity=4096)
+        sched: list = [
+            [(scenario.tenants[0], burst // 2)],
+            [(scenario.tenants[1], burst - burst // 2)],
+        ]
+        ep, _, _ = _disagg_episode(
+            disagg=True, schedule=sched, tenants=scenario.tenants,
+            prompt_pools=pools, draft_enabled=False, lifecycle=reg,
+            prefill_replicas=n_prefill, decode_steps_per_cycle=steps,
+            decode_cost_s=dec_cost,
+            **donors,
+            **{k: v for k, v in shape.items()
+               if k not in ("prefill_replicas", "decode_cost_s")},
+        )
+        if ep["lost"] or ep["answered"] != ep["requests"]:
+            failures.append(
+                f"{name}: {ep['answered']}/{ep['requests']} answered"
+            )
+        report = reg.attribute_slo(slo_s)
+        if report["over_slo"] < 1:
+            failures.append(
+                f"{name}: no request exceeded the {slo_s}s SLO — "
+                f"attribution is vacuous"
+            )
+        return report
+
+    starved = _attribution(
+        "prefill-starved", n_prefill=1, steps=2,
+        dec_cost=decode_cost_s, slo_s=0.0,
+    )
+    contended = _attribution(
+        "decode-contended", n_prefill=3, steps=1,
+        dec_cost=insert_cost_s * 2, slo_s=0.0,
+    )
+    if starved["dominant"] != "queue":
+        failures.append(
+            f"attribution: prefill-starved episode blamed "
+            f"{starved['dominant']!r}, expected 'queue' (requests "
+            f"starve waiting for prefill capacity)"
+        )
+    if contended["dominant"] not in ("handoff", "decode"):
+        failures.append(
+            f"attribution: decode-contended episode blamed "
+            f"{contended['dominant']!r}, expected the decode plane "
+            f"('handoff' stall or 'decode')"
+        )
+
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "obs",
+        "elapsed_s": round(elapsed, 2),
+        "scenario": {"name": scenario.name,
+                     "description": scenario.description,
+                     "cycles": scenario.cycles},
+        "cost_model": costs,
+        "episodes": {
+            "off": off_ep, "on": on_ep, "chaos": chaos_ep,
+            "dedup": dedup_ep,
+        },
+        "overhead": {
+            "tokens_per_second_ratio": round(ratio, 4),
+            "floor": overhead_floor,
+            "counters_off": off_counters,
+            "counters_on": on_counters,
+        },
+        "completeness": {
+            "on": audit_on, "chaos": audit_chaos, "dedup": audit_dedup,
+            "registry": {
+                "created": reg.created, "replies": reg.replies,
+                "duplicates": dedup_reg.duplicates,
+                "redispatched_notes": redispatched,
+            },
+        },
+        "restart": restart_info,
+        "attribution": {
+            "prefill_starved": starved, "decode_contended": contended,
+        },
+        "timing_gates": timing_gates,
+        "gates": {
+            "completeness": "every answered request shows a gap-free "
+                            "monotone phase chain with exactly one "
+                            "reply stamp, through kill + registry "
+                            "restart + redelivery-dedup",
+            "overhead": "zero added dispatches/transfers (PR 7 "
+                        "odometers), byte-identical replies, tokens/s "
+                        f">= {overhead_floor}x untraced",
+            "restart": "flow-id epoch bumps, restored traces marked, "
+                       "no flow-id collisions across epochs",
+            "attribution": "attribute_slo names the injected "
+                           "bottleneck: queue for prefill starvation, "
+                           "handoff/decode for decode contention",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"obs: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    value = audit_on["chains_ok"] + audit_chaos["chains_ok"] \
+        + audit_dedup["chains_ok"]
+    return {
+        "metric": "obs_complete_chains",
+        "value": value,
+        "unit": (
+            f"gap-free request chains audited across clean/kill+restart/"
+            f"redelivery episodes at {round(ratio, 4)}x tokens/s and "
+            f"zero added dispatches; SLO attribution named "
+            f"{starved['dominant']} vs {contended['dominant']}"
+        ),
+        "vs_baseline": value,
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
@@ -5532,7 +6052,7 @@ if __name__ == "__main__":
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
                  "tenants", "overload", "twin", "restart", "knobs",
-                 "disagg"),
+                 "disagg", "obs"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -5576,7 +6096,13 @@ if __name__ == "__main__":
         " worse under a virtual-time cost model; per-request greedy"
         " parity across the KV handoff, a mid-handoff prefill kill, and"
         " live speculative flips; exactly-once everywhere; per-tenant"
-        " measured accept rates flipping drafting both ways)",
+        " measured accept rates flipping drafting both ways); obs ="
+        " request-lifecycle tracing battery (gap-free per-request phase"
+        " chains with exactly one reply stamp through kill + registry"
+        " restart + redelivery-dedup; zero added dispatches and"
+        " byte-identical replies tracing-on; attribute_slo naming the"
+        " injected bottleneck in prefill-starved vs decode-contended"
+        " episodes)",
     )
     cli.add_argument(
         "--output", default="",
@@ -5629,6 +6155,10 @@ if __name__ == "__main__":
     elif cli_args.suite == "disagg":
         print(json.dumps(
             run_disagg_suite(cli_args.output or "BENCH_r20.json")
+        ))
+    elif cli_args.suite == "obs":
+        print(json.dumps(
+            run_obs_suite(cli_args.output or "BENCH_r21.json")
         ))
     else:
         print(json.dumps(run_bench()))

@@ -155,6 +155,23 @@ def build_parser() -> argparse.ArgumentParser:
             "this size"
         ),
     )
+    # Request-lifecycle tracing (obs/lifecycle.py): a bounded host-side
+    # registry of per-request phase chains behind /debug/requests and the
+    # request_phase_seconds histograms.  Off by default — and off means
+    # OFF: no registry is constructed, every stamp site in the serving
+    # path stays behind an `is None` check, the engine path is
+    # byte-identical (the BENCH_r21 identity gate).
+    parser.add_argument(
+        "--request-trace", type=int, default=0, metavar="N",
+        help=(
+            "Keep per-request phase-chain traces for the newest N open "
+            "requests (arrival/staged/admitted/prefill/first_token/"
+            "handoff/completed/reply stamps behind /debug/requests and "
+            "request_phase_seconds histograms; 0 = disabled, the engine "
+            "path is byte-identical). With --state-path, open traces "
+            "ride the durable snapshot across restarts"
+        ),
+    )
     # Extensions over the reference: the predictive scaling policy
     # (forecast/ subsystem). The default is the reference's reactive
     # behavior; --policy=predictive thresholds the forecasted depth at
@@ -442,6 +459,20 @@ def main(argv: Sequence[str] | None = None) -> None:
             journal_path=args.journal_path or None,
         )
 
+    # Request-lifecycle registry: built only when asked for — tracing
+    # off must leave the serving path byte-identical, and `None` is what
+    # every stamp site checks.  Registered as a durable section so open
+    # traces (requests in flight when the controller dies) rejoin their
+    # phase chain after the restart instead of reading as lost requests.
+    lifecycle = None
+    if args.request_trace > 0:
+        from .obs import LifecycleRegistry
+
+        lifecycle = LifecycleRegistry(capacity=args.request_trace)
+        if store is not None:
+            store.register("request_trace", lifecycle,
+                           ttl_s=_STATE_SECTION_TTL_S)
+
     server = None
     observers = []
     journal = None
@@ -481,6 +512,8 @@ def main(argv: Sequence[str] | None = None) -> None:
             # restart/rehydrate instants land beside the ticks on
             # /debug/trace (their own "restart" category)
             trace_sources=(store,) if store is not None else (),
+            # /debug/requests + per-request flow lanes on /debug/trace
+            lifecycle=lifecycle,
         )
         server.start()
 
@@ -598,6 +631,12 @@ def main(argv: Sequence[str] | None = None) -> None:
             max_bytes=args.journal_max_bytes,
         )
         observers.append(journal)
+        if lifecycle is not None:
+            # completed request traces land in the flight journal as
+            # "request" event lines, one per reply — the offline half of
+            # the completeness audit (journal replay can re-validate
+            # every phase chain the run produced)
+            lifecycle.journal = journal
 
     if not observers:
         observer = None

@@ -61,10 +61,15 @@ count, ``import_state(state, rebase=, now=, max_age_s=)`` returning how
 many records were restored.  Wire-ups live with the subsystems
 (``core/resilience.py``, ``forecast/history.py``, ``learn/policy.py``,
 ``fleet/pool.py``/``sharded.py``, ``workloads/tenancy.py``,
-``sched/knobs.py``, and ``planes/pool.py`` — the disaggregated pool's
+``sched/knobs.py``, ``planes/pool.py`` — the disaggregated pool's
 section, :data:`~..planes.pool.DISAGG_SECTION`, carries the shared
 reply registry plus the plane-mode bit a restart must not forget:
-whether measured economics had speculative drafting on).
+whether measured economics had speculative drafting on — and
+``obs/lifecycle.py``, whose ``request_trace`` section rides open
+request traces across the restart so the phase chain of an in-flight
+request survives the controller dying mid-decode: the rehydrated
+registry bumps its flow-id epoch, so re-stamped phases never collide
+with the pre-crash Perfetto flow).
 
 Runnable as ``python -m kube_sqs_autoscaler_tpu.core.durable`` — the
 ``make restart-demo`` gate: a JAX-free FakeClock kill→restart→reconcile

@@ -150,6 +150,11 @@ class FleetPoolBase:
         self.events: deque[FleetEvent] = deque(maxlen=4096)
         self.cycle = 0
         self.metrics = None
+        # request-lifecycle registry (obs/lifecycle.py): one registry
+        # for the WHOLE fleet — a request's chain threads through
+        # whichever members touch it; attach_lifecycle propagates to
+        # every current and future member.  None = tracing off.
+        self.lifecycle = None
         self._replied = _BoundedSet(replied_capacity)
         self.duplicates_suppressed = 0
         # test seams, mirroring the fakes' error injection hooks
@@ -381,6 +386,10 @@ class WorkerPool(FleetPoolBase):
         replica = Replica(self._next_index, worker, self.clock.now())
         self._next_index += 1
         self.members.append(replica)
+        if self.lifecycle is not None:
+            attach = getattr(worker, "attach_lifecycle", None)
+            if attach is not None:
+                attach(self.lifecycle)
         self._event("replica-spawn", replica=replica.index)
         return replica
 
@@ -556,6 +565,16 @@ class WorkerPool(FleetPoolBase):
             return
         take, self._orphans = self._orphans[:free], self._orphans[free:]
         if take:
+            if self.lifecycle is not None:
+                from ..workloads.service import request_id
+
+                for message in take:
+                    # the chain continues on the survivor: _admit will
+                    # re-stamp admitted/prefill (re-stamps append; the
+                    # FIRST occurrences keep the original timeline)
+                    self.lifecycle.note(
+                        request_id(message), "redispatched"
+                    )
             replica.worker._admit(take)
             self._event(
                 "redispatch", replica=replica.index, requests=len(take),
@@ -699,6 +718,20 @@ class WorkerPool(FleetPoolBase):
         self.metrics = metrics
         self._update_metrics()
 
+    def attach_lifecycle(self, registry) -> None:
+        """Wire ONE :class:`~..obs.LifecycleRegistry` through every
+        current member (and, via :meth:`_add_replica`, every future
+        spawn): a request's phase chain must thread through whichever
+        replicas touch it — admission on one, evacuation, re-dispatch
+        and settle on another — so the registry is fleet-scoped, never
+        per-replica.  ``getattr``-guarded: bench stub workers without
+        the hook simply stay untraced."""
+        self.lifecycle = registry
+        for replica in self.members:
+            attach = getattr(replica.worker, "attach_lifecycle", None)
+            if attach is not None:
+                attach(registry)
+
     def _update_metrics(self) -> None:
         if self.metrics is None:
             return
@@ -740,6 +773,16 @@ class WorkerPool(FleetPoolBase):
             "survivors.",
             kind="counter",
         )
+        # TTFT histograms: replicas never get a worker-level metrics
+        # registry (their unlabeled gauges would collide), but the
+        # cumulative histogram families merge correctly — drain every
+        # member's pending samples into the pool's registry
+        from ..workloads.continuous import drain_ttft_histograms
+
+        for replica in self.members:
+            batcher = getattr(replica.worker, "batcher", None)
+            if batcher is not None:
+                drain_ttft_histograms(batcher, self.metrics)
 
     # ------------------------------------------------------------------
     # Real-fleet construction

@@ -434,6 +434,16 @@ class ShardedWorkerPool(FleetPoolBase):
     # speaks — live on FleetPoolBase, shared with WorkerPool)
     # ------------------------------------------------------------------
 
+    def attach_lifecycle(self, registry) -> None:
+        """Wire a :class:`~..obs.LifecycleRegistry` through the sharded
+        worker's stamp sites (admission, emit, settle, evacuation) —
+        the sharded plane is ONE worker, so the whole plane shares the
+        pool's registry."""
+        self.lifecycle = registry
+        attach = getattr(self.worker, "attach_lifecycle", None)
+        if attach is not None:
+            attach(registry)
+
     def attach_metrics(self, metrics) -> None:
         """Refresh the per-shard gauge family (``shard_active``,
         ``shard_active_slots``, ``shard_tokens_per_second``,

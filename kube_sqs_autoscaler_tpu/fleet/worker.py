@@ -109,6 +109,13 @@ class FleetWorker(ContinuousWorker):
                     self.config.queue_url, message["ReceiptHandle"]
                 )
                 self._pool.note_duplicate(rid)
+                if self.lifecycle is not None:
+                    # close the duplicate copy's open trace WITHOUT a
+                    # reply stamp: the completeness audit counts exactly
+                    # one reply-stamped trace per answered request, and
+                    # this branch is what keeps the second copy from
+                    # minting one
+                    self.lifecycle.duplicate(rid)
                 if counted:
                     self.processed -= 1
                 return False

@@ -8,6 +8,11 @@ The flight recorder (:mod:`.journal`) adds the *historical* counterpart
 of the live gauges: a bounded in-memory ring and an append-only JSONL
 journal of every tick record, exportable as Chrome trace-event JSON
 (:mod:`.trace`) and replayable through :mod:`..sim.replay`.
+
+Request-lifecycle tracing (:mod:`.lifecycle`) adds the per-REQUEST
+counterpart of the per-tick recorder: bounded phase-stamped traces of
+every request across planes, shards, and restarts, decomposable into
+per-phase latency histograms and Perfetto flow spans.
 """
 
 from .journal import (
@@ -18,21 +23,39 @@ from .journal import (
     read_journal,
     read_journal_episodes,
 )
+from .lifecycle import (
+    LifecycleRegistry,
+    RequestTrace,
+    phase_durations,
+    request_key,
+    validate_chain,
+)
 from .prometheus import ControllerMetrics, WorkloadMetrics
 from .server import ObservabilityServer
-from .trace import render_chrome_trace, to_chrome_trace, trace_events
+from .trace import (
+    render_chrome_trace,
+    request_trace_events,
+    to_chrome_trace,
+    trace_events,
+)
 
 __all__ = [
     "ControllerMetrics",
     "JOURNAL_SCHEMA_VERSION",
     "JournalSchemaError",
+    "LifecycleRegistry",
     "ObservabilityServer",
+    "RequestTrace",
     "TickJournal",
     "TickRing",
     "WorkloadMetrics",
+    "phase_durations",
     "read_journal",
     "read_journal_episodes",
     "render_chrome_trace",
+    "request_key",
+    "request_trace_events",
     "to_chrome_trace",
     "trace_events",
+    "validate_chain",
 ]

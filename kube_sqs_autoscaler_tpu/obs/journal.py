@@ -361,14 +361,24 @@ def read_journal_episodes(
     return parse_journal_episodes(_read_lines(path))
 
 
-def read_journal_events(path: str, kind: str) -> "list[dict]":
+def read_journal_events(
+    path: str, kind: str, *, rejoin: bool = False
+) -> "list[dict]":
     """Load every non-tick event line of ``kind`` from a journal, in
-    file order (e.g. ``kind="knob"`` for the knob actuator's changes).
-    Torn/corrupt lines and foreign kinds are skipped — this reader is
-    for sidecar event streams, so it is deliberately lenient where the
-    episode parser is strict."""
+    file order (e.g. ``kind="knob"`` for the knob actuator's changes,
+    ``kind="request"`` for closed lifecycle traces).  Torn/corrupt
+    lines and foreign kinds are skipped — this reader is for sidecar
+    event streams, so it is deliberately lenient where the episode
+    parser is strict.  ``rejoin=True`` prepends the one kept rotated
+    generation (``<path>.1``) so events that rotated out mid-run stay
+    visible, mirroring :func:`~..sim.replay`'s episode rejoin."""
+    lines: list[str] = []
+    rotated = path + ".1"
+    if rejoin and os.path.exists(rotated):
+        lines.extend(_read_lines(rotated))
+    lines.extend(_read_lines(path))
     events: list[dict] = []
-    for line in _read_lines(path):
+    for line in lines:
         if not line.strip():
             continue
         try:

@@ -412,6 +412,13 @@ class WorkloadMetrics:
     :class:`~.server.ObservabilityServer` serves either.
     """
 
+    #: Default latency buckets (seconds) for :meth:`observe_histogram` —
+    #: spanning sub-ms prefill phases through minute-scale queue waits.
+    DEFAULT_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    )
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # (name, labels) -> (value, help, kind); labels is a tuple of
@@ -419,6 +426,11 @@ class WorkloadMetrics:
         self._gauges: dict[
             tuple[str, tuple[tuple[str, str], ...] | None],
             tuple[float, str, str],
+        ] = {}
+        # (name, labels) -> [bucket counts, sum, count, help, bounds]
+        self._histograms: dict[
+            tuple[str, tuple[tuple[str, str], ...] | None],
+            list,
         ] = {}
         self._timers: dict[str, object] = {}
 
@@ -440,6 +452,60 @@ class WorkloadMetrics:
         registries derive from caller-owned state."""
         with self._lock:
             self._gauges[(name, labels)] = (float(value), help_text, kind)
+
+    def observe_histogram(
+        self,
+        name: str,
+        value: float,
+        help_text: str = "",
+        *,
+        labels: tuple[tuple[str, str], ...] | None = None,
+        buckets: tuple[float, ...] | None = None,
+    ) -> None:
+        """Record one observation into a CUMULATIVE histogram series —
+        the real thing, not a windowed-deque gauge: counts never reset,
+        so rate()/histogram_quantile() work across scrapes and restarts
+        of the scraper (the request-lifecycle phase/TTFT/ITL/TPOT
+        families are the motivating producers).  ``buckets`` fixes the
+        upper bounds on the FIRST observation of a series; later calls
+        reuse them."""
+        with self._lock:
+            entry = self._histograms.get((name, labels))
+            if entry is None:
+                bounds = tuple(buckets or self.DEFAULT_BUCKETS)
+                entry = [[0] * len(bounds), 0.0, 0, help_text, bounds]
+                self._histograms[(name, labels)] = entry
+            counts, _, _, _, bounds = entry
+            for index, bound in enumerate(bounds):
+                if value <= bound:
+                    counts[index] += 1
+            entry[1] += value
+            entry[2] += 1
+
+    def histogram_quantile(
+        self,
+        name: str,
+        q: float,
+        *,
+        labels: tuple[tuple[str, str], ...] | None = None,
+    ) -> float | None:
+        """Nearest-bucket-upper-bound quantile from the cumulative
+        counts (what the benches gate on; coarser than the old
+        sample-deque nearest-rank but bounded-memory and
+        restart-additive).  None when the series has no observations;
+        +Inf-bucket hits report the largest finite bound."""
+        with self._lock:
+            entry = self._histograms.get((name, labels))
+            if entry is None:
+                return None
+            counts, _, count, _, bounds = entry
+            if count <= 0:
+                return None
+            rank = max(1, int(round(q * count)))
+            for index, bound in enumerate(bounds):
+                if counts[index] >= rank:
+                    return bound
+            return bounds[-1] if bounds else None
 
     def attach_timer(self, name: str, timer) -> None:
         """Expose a SpanTimer's spans as ``<name>_<span>_seconds{quantile}``
@@ -574,11 +640,19 @@ class WorkloadMetrics:
         """Readiness = at least one gauge sample or timed span recorded."""
         with self._lock:
             gauges, timers = dict(self._gauges), dict(self._timers)
-        return bool(gauges) or any(t.summary() for t in timers.values())
+            histograms = bool(self._histograms)
+        return bool(gauges) or histograms or any(
+            t.summary() for t in timers.values()
+        )
 
     def render(self) -> str:
         with self._lock:
             gauges = dict(self._gauges)
+            histograms = {
+                key: (list(entry[0]), entry[1], entry[2], entry[3],
+                      entry[4])
+                for key, entry in self._histograms.items()
+            }
             timers = dict(self._timers)
         lines: list[str] = []
         last_family = None
@@ -605,6 +679,35 @@ class WorkloadMetrics:
                 lines.append(f"{metric}{{{rendered}}} {value}")
             else:
                 lines.append(f"{metric} {value}")
+        last_family = None
+        for (name, labels), (counts, total, count, help_text, bounds) in (
+            sorted(
+                histograms.items(),
+                key=lambda item: (item[0][0], item[0][1] or ()),
+            )
+        ):
+            metric = f"{_WORKLOAD_PREFIX}_{name}"
+            if name != last_family:
+                if help_text:
+                    lines.append(
+                        f"# HELP {metric} {escape_help(help_text)}"
+                    )
+                lines.append(f"# TYPE {metric} histogram")
+                last_family = name
+            base = ",".join(
+                f'{label}="{escape_label_value(str(val))}"'
+                for label, val in (labels or ())
+            )
+            for bound, cumulative in zip(bounds, counts):
+                le = f'le="{bound:g}"'
+                rendered = f"{base},{le}" if base else le
+                lines.append(f"{metric}_bucket{{{rendered}}} {cumulative}")
+            le = 'le="+Inf"'
+            rendered = f"{base},{le}" if base else le
+            lines.append(f"{metric}_bucket{{{rendered}}} {count}")
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(f"{metric}_sum{suffix} {total}")
+            lines.append(f"{metric}_count{suffix} {count}")
         for name, timer in sorted(timers.items()):
             for span, stats in sorted(timer.summary().items()):
                 metric = f"{_WORKLOAD_PREFIX}_{name}_{span}_seconds"

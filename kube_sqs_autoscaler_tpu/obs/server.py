@@ -18,7 +18,14 @@ thread serving
   JSON (``?n=`` limits to the last N), when a :class:`~.journal.TickRing`
   is attached;
 - ``/debug/trace`` — the same ring as Chrome/Perfetto trace-event JSON
-  (open in ``chrome://tracing`` or ui.perfetto.dev).
+  (open in ``chrome://tracing`` or ui.perfetto.dev); with a lifecycle
+  registry attached, per-request phase spans render as flow-linked
+  lanes on the ``requests`` track;
+- ``/debug/requests`` — the request-lifecycle registry's most recent
+  traces + counters as JSON (``?n=`` limits; ``?slo=`` adds an
+  ``attribution`` block naming the phase that ate each over-SLO
+  request's budget), when a
+  :class:`~.lifecycle.LifecycleRegistry` is attached.
 
 Disabled by default (``--metrics-port 0``), preserving reference behavior.
 """
@@ -33,7 +40,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .journal import JOURNAL_SCHEMA_VERSION, TickRing
 from .prometheus import ControllerMetrics
-from .trace import instant_trace_events, render_chrome_trace
+from .trace import (
+    instant_trace_events,
+    render_chrome_trace,
+    request_trace_events,
+)
 
 log = logging.getLogger(__name__)
 
@@ -53,19 +64,24 @@ class ObservabilityServer:
         ring: TickRing | None = None,
         unhealthy_after: float = 0.0,
         trace_sources: tuple = (),
+        lifecycle=None,
     ) -> None:
         # trace_sources: objects with an ``events`` iterable of
         # (name, t, args)-shaped instants on the tick clock — e.g. a
         # DurableStateStore's restart-detected/rehydrated events — so
         # /debug/trace shows them beside the ticks (their name prefixes
         # pick their trace category, "restart-*" → its own lane).
+        # lifecycle: a LifecycleRegistry enabling /debug/requests and
+        # merging request flow spans into /debug/trace.
         self.metrics = metrics
         self.ring = ring
         self.unhealthy_after = unhealthy_after
+        self.lifecycle = lifecycle
         registry = metrics  # close over for the handler class
         tick_ring = ring
         stale_after = unhealthy_after
         sources = tuple(trace_sources)
+        lifecycle_registry = lifecycle
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -139,9 +155,26 @@ class ObservabilityServer:
                             source.events, time_origin=origin
                         )
                     ]
+                    if lifecycle_registry is not None:
+                        traces = (
+                            lifecycle_registry.done_traces()
+                            + lifecycle_registry.open_traces()
+                        )
+                        extra += request_trace_events(
+                            traces, time_origin=origin
+                        )
                     self._reply(
                         200,
                         render_chrome_trace(records, extra_events=extra),
+                        "application/json",
+                    )
+                elif (
+                    url.path == "/debug/requests"
+                    and lifecycle_registry is not None
+                ):
+                    self._reply(
+                        200,
+                        self._requests_body(url.query),
                         "application/json",
                     )
                 else:
@@ -162,6 +195,24 @@ class ObservabilityServer:
                     },
                     separators=(",", ":"),
                 )
+
+            @staticmethod
+            def _requests_body(query: str) -> str:
+                params = urllib.parse.parse_qs(query)
+                try:
+                    last = int(params["n"][0])
+                except (KeyError, IndexError, ValueError):
+                    last = 100
+                body = lifecycle_registry.snapshot(last=last)
+                try:
+                    slo = float(params["slo"][0])
+                except (KeyError, IndexError, ValueError):
+                    slo = None
+                if slo is not None:
+                    body["attribution"] = (
+                        lifecycle_registry.attribute_slo(slo)
+                    )
+                return json.dumps(body, separators=(",", ":"))
 
             def _reply(
                 self, status: int, body: str, content_type: str = "text/plain"
@@ -194,6 +245,8 @@ class ObservabilityServer:
         self._thread.start()
         endpoints = "/metrics /healthz /readyz" + (
             " /debug/ticks /debug/trace" if self.ring is not None else ""
+        ) + (
+            " /debug/requests" if self.lifecycle is not None else ""
         )
         log.info("Observability endpoints on :%d (%s)", self.port, endpoints)
 

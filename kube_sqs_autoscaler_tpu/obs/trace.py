@@ -25,11 +25,83 @@ from ..core.policy import Gate
 _PID = 1
 _TID = 1
 
+#: Category -> (pid, tid, process label, thread label).  Perfetto
+#: renders one lane per (pid, tid), so giving each event category a
+#: STATIC track assignment turns the previously interleaved single row
+#: into separate lanes: controller ticks, fleet/replica lifecycle,
+#: shard failure domain, restart/rehydration, knob actuations, the
+#: overload ladder, prefix-pool residency, the disaggregated planes'
+#: KV shuttle, and per-request lifecycle spans.  Keyed by category —
+#: never by discovery order — so the same event lands on the same lane
+#: across controller restarts and journal-rotation rejoins (pinned by
+#: tests).
+_TRACKS: dict[str, tuple[int, int, str, str]] = {
+    "tick": (_PID, _TID, "controller", "ticks"),
+    "phase": (_PID, _TID, "controller", "ticks"),
+    "event": (_PID, _TID, "controller", "ticks"),
+    "fleet": (2, 1, "fleet", "replicas"),
+    "shard": (2, 2, "fleet", "shards"),
+    "restart": (2, 3, "fleet", "restart"),
+    "knob": (2, 4, "fleet", "knobs"),
+    "overload": (3, 1, "admission", "overload"),
+    "prefix": (3, 2, "admission", "prefix-pool"),
+    "plane": (3, 3, "admission", "kv-shuttle"),
+    "request": (4, 1, "requests", "queue"),
+}
+
+#: The request process's per-phase lanes (pid 4): each lifecycle span
+#: renders on the lane of the phase that owns it, threaded together by
+#: flow arrows carrying the trace's flow id.
+_REQUEST_PID = 4
+_REQUEST_LANES: dict[str, tuple[int, str]] = {
+    "queue": (1, "queue"),
+    "prefill": (2, "prefill"),
+    "handoff": (3, "kv-handoff"),
+    "decode": (4, "decode"),
+    "settle": (5, "settle"),
+}
+
 _SPAN_FIELDS = (
     ("observe", "observe_s"),
     ("decide", "decide_s"),
     ("actuate", "actuate_s"),
 )
+
+
+def track_for(cat: str) -> tuple[int, int]:
+    """The stable (pid, tid) lane of an event category."""
+    pid, tid, _, _ = _TRACKS.get(cat, _TRACKS["fleet"])
+    return pid, tid
+
+
+def track_metadata_events() -> list[dict[str, Any]]:
+    """Perfetto ``"M"`` metadata naming every track in :data:`_TRACKS`
+    (process_name / thread_name), plus the request process's phase
+    lanes.  Appended by :func:`to_chrome_trace` only when the trace has
+    real events — an empty trace stays empty."""
+    events: list[dict[str, Any]] = []
+    seen_pid: set[int] = set()
+    seen_tid: set[tuple[int, int]] = set()
+
+    def _add(pid: int, tid: int, process: str, thread: str) -> None:
+        if pid not in seen_pid:
+            seen_pid.add(pid)
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": process},
+            })
+        if (pid, tid) not in seen_tid:
+            seen_tid.add((pid, tid))
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": thread},
+            })
+
+    for pid, tid, process, thread in _TRACKS.values():
+        _add(pid, tid, process, thread)
+    for tid, thread in _REQUEST_LANES.values():
+        _add(_REQUEST_PID, tid, "requests", thread)
+    return events
 
 
 def _us(seconds: float) -> int:
@@ -113,14 +185,15 @@ def trace_events(
 
 def _instant(name: str, at: float, args: dict[str, Any],
              cat: str = "event") -> dict[str, Any]:
+    pid, tid = track_for(cat)
     return {
         "name": name,
         "cat": cat,
         "ph": "i",
         "s": "t",  # thread-scoped instant
         "ts": _us(at),
-        "pid": _PID,
-        "tid": _TID,
+        "pid": pid,
+        "tid": tid,
         "args": args,
     }
 
@@ -184,6 +257,94 @@ def instant_trace_events(
     ]
 
 
+def request_trace_events(
+    traces: Iterable[Any], time_origin: float | None = None
+) -> list[dict[str, Any]]:
+    """Per-request lifecycle spans threaded by Perfetto flow arrows.
+
+    ``traces`` is any iterable of :class:`~.lifecycle.RequestTrace`
+    values (anything with ``rid`` / ``flow_id`` / ``tenant`` and the
+    ``first``/``last`` stamp accessors).  Each request renders as one
+    span per lifecycle phase — queue wait, prefill, KV-handoff stall,
+    decode, settle — on the ``requests`` process's per-phase lanes,
+    linked start-to-finish by flow events (``s``/``t``/``f``) carrying
+    the trace's flow id, so Perfetto draws the arrow a postmortem
+    follows: THIS request waited here, prefilled there, stalled on the
+    shuttle, decoded on the plane.  ``time_origin`` defaults to the
+    first trace's arrival so request spans share t=0 with whatever tick
+    records they are merged with.
+    """
+    from .lifecycle import phase_durations  # local: avoid import cycle
+
+    traces = list(traces)
+    starts = [
+        t.first("arrival") for t in traces
+        if t.first("arrival") is not None
+    ]
+    if time_origin is None:
+        if not starts:
+            return []
+        time_origin = min(starts)
+    events: list[dict[str, Any]] = []
+    for trace in traces:
+        arrival = trace.first("arrival")
+        if arrival is None:
+            continue
+        durations = phase_durations(trace)
+        cursor = arrival - time_origin
+        spans: list[tuple[str, float, float]] = []
+        for phase in ("queue", "prefill", "handoff", "decode", "settle"):
+            span = durations.get(phase)
+            if span is None:
+                continue
+            spans.append((phase, cursor, span))
+            cursor += span
+        if not spans:
+            continue
+        args = {
+            "rid": trace.rid,
+            "tenant": trace.tenant,
+            "notes": dict(trace.notes),
+        }
+        if getattr(trace, "error", None) is not None:
+            args["error"] = trace.error
+        for index, (phase, start, span) in enumerate(spans):
+            tid, _ = _REQUEST_LANES[phase]
+            events.append({
+                "name": phase,
+                "cat": "request",
+                "ph": "X",
+                "ts": _us(start),
+                "dur": _us(span),
+                "pid": _REQUEST_PID,
+                "tid": tid,
+                "args": args,
+            })
+            # the flow arrow: start at the first span, step through the
+            # middle ones, finish (binding to the enclosing slice) at
+            # the last — one arrow per request, id = its flow id, which
+            # the registry keeps unique across restart epochs
+            if index == 0:
+                ph = "s"
+            elif index == len(spans) - 1:
+                ph = "f"
+            else:
+                ph = "t"
+            flow: dict[str, Any] = {
+                "name": "request",
+                "cat": "request",
+                "ph": ph,
+                "id": trace.flow_id,
+                "ts": _us(start),
+                "pid": _REQUEST_PID,
+                "tid": tid,
+            }
+            if ph == "f":
+                flow["bp"] = "e"
+            events.append(flow)
+    return events
+
+
 def to_chrome_trace(
     records: Sequence[TickRecord] | Iterable[TickRecord],
     meta: dict[str, Any] | None = None,
@@ -194,8 +355,14 @@ def to_chrome_trace(
     ``extra_events`` are pre-built trace-event dicts appended verbatim
     (e.g. the fleet's :func:`instant_trace_events` with ``time_origin``
     set to the first tick's start, so both streams share t=0)."""
+    events = trace_events(records) + list(extra_events or ())
+    if events:
+        # name the tracks (process/thread lanes) — but an empty trace
+        # stays byte-empty, so consumers can cheaply test for "nothing
+        # recorded yet"
+        events = track_metadata_events() + events
     trace: dict[str, Any] = {
-        "traceEvents": trace_events(records) + list(extra_events or ()),
+        "traceEvents": events,
         "displayTimeUnit": "ms",
     }
     if meta:

@@ -406,6 +406,17 @@ class DecodePlaneBatcher(ShardedBatcher):
                 tenant=tenant, ttft_done=True,
             )
             self._slot_spec[row] = spec
+            if self.lifecycle is not None:
+                # the KV landed in a decode slot: the handoff phase
+                # (first_token -> here) closes — decode-plane time
+                # starts now.  Same dispatch either way; the stamp is
+                # host bookkeeping on a copy that already happened.
+                from ..obs.lifecycle import request_key
+
+                self.lifecycle.stamp(
+                    request_key(payload), "handoff",
+                    tenant=tenant or None,
+                )
         self._invalidate_admission_cache()
         return rows
 

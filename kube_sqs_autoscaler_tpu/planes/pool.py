@@ -307,6 +307,15 @@ class DisaggregatedPool(WorkerPool):
         self.decode_pool.metrics = metrics
         super().attach_metrics(metrics)
 
+    def attach_lifecycle(self, registry) -> None:
+        """One registry across BOTH planes: a disaggregated request's
+        chain runs arrival→first_token on a prefill replica, handoff on
+        the decode plane, completed/reply on the decode worker — split
+        registries would each see half a chain and fail the
+        completeness audit by construction."""
+        self.decode_pool.attach_lifecycle(registry)
+        super().attach_lifecycle(registry)
+
     # ------------------------------------------------------------------
     # Real-plane construction
     # ------------------------------------------------------------------

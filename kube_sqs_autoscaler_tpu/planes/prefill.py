@@ -89,6 +89,18 @@ class PrefillWorker(FleetWorker):
         this batcher's cache buffers, so the next insert into these rows
         orders after it)."""
         for row in rows:
+            if self.lifecycle is not None:
+                # the donor-side half of the handoff audit: every
+                # handed_off note must pair with a decode-plane
+                # "handoff" stamp on the same trace — a note without
+                # the stamp is a KV copy that was freed but never
+                # landed (exactly the loss the completeness gate hunts)
+                from ..obs.lifecycle import request_key
+
+                self.lifecycle.note(
+                    request_key(self.batcher.slots[row].payload),
+                    "handed_off",
+                )
             self.batcher.slots[row] = _Slot()
         self.batcher._invalidate_admission_cache()
         self.handed_off += len(rows)

@@ -231,6 +231,7 @@ from .service import (  # noqa: E402
     bounded_tenant_key as _bounded_tenant_key,
     request_id as _request_id,
 )
+from ..obs.lifecycle import request_key as _trace_key  # noqa: E402
 
 
 def _rows_prefill(params, prompts, lengths, config, family, quantized_kv,
@@ -684,6 +685,23 @@ class ContinuousBatcher:
         self.tenant_tokens: dict[str, int] = {}
         self.tenant_ttft: dict[str, Any] = {}
         self._tenant_ttft_deque = partial(collections.deque, maxlen=1024)
+        # cumulative per-tenant TTFT (sum, count) — the source of the
+        # tenant_ttft_seconds gauge (the recent-sample deques above stay
+        # for the benches' nearest-rank p50/p99, but gauges and
+        # histograms must never forget old requests the way a maxlen
+        # deque does)
+        self.tenant_ttft_sum: dict[str, float] = {}
+        self.tenant_ttft_count: dict[str, int] = {}
+        # TTFT observations awaiting the metrics registry's cumulative
+        # histograms, (tenant-or-None, seconds); bounded so a worker
+        # without attached metrics cannot grow
+        self._pending_ttft_obs: collections.deque = collections.deque(
+            maxlen=16384
+        )
+        # request-lifecycle tracing (obs/lifecycle.py): None = off =
+        # byte-identical engine path (same contract as tenancy=None);
+        # the worker's attach_lifecycle wires it
+        self.lifecycle = None
         # epoch clock for arrival-based per-tenant TTFT — the worker
         # rebinds it to its request-TTL clock so FakeClock episodes and
         # SQS SentTimestamps share one time base
@@ -1667,6 +1685,7 @@ class ContinuousBatcher:
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
                 self.ttft_samples.append(ttft)
+                self._pending_ttft_obs.append((None, ttft))
                 finished.append((slot.payload, best))
                 self.slots[row] = _Slot()
         return finished
@@ -1769,6 +1788,9 @@ class ContinuousBatcher:
             )
         rows = free[: len(requests)]
         now = time.perf_counter()
+        if self.lifecycle is not None:
+            for _, payload in requests:
+                self.lifecycle.stamp(_trace_key(payload), "prefill")
         if self.beams > 1 or self.draft_layers:
             for row, (token_ids, payload) in zip(rows, requests):
                 self._submit_one(row, token_ids, payload, now)
@@ -1877,6 +1899,11 @@ class ContinuousBatcher:
                                                        requests)
         ]
         now = time.perf_counter()
+        if self.lifecycle is not None:
+            for tenant, _, _, payload in requests:
+                self.lifecycle.stamp(
+                    _trace_key(payload), "prefill", tenant=tenant
+                )
         padded = [self._pad_prompt(ids) for _, _, ids, _ in requests]
         prompts = np.stack([ids for ids, _ in padded])
         lengths = np.asarray([ln for _, ln in padded], np.int32)
@@ -1988,6 +2015,10 @@ class ContinuousBatcher:
                 produced=list(produced), submitted_at=submitted_at,
                 ttft_done=bool(produced),
             )
+            if self.lifecycle is not None:
+                # the evacuation→resume seam: the trace keeps its first
+                # life's stamps; resumes only annotate
+                self.lifecycle.note(_trace_key(payload), "resumed")
         self._invalidate_admission_cache()
         return rows
 
@@ -2038,6 +2069,10 @@ class ContinuousBatcher:
             self.tenant_tokens[tenant] = (
                 self.tenant_tokens.get(tenant, 0) + 1
             )
+        if self.lifecycle is not None:
+            # host-side timestamp of a token that already settled — no
+            # extra dispatch or transfer, the value is in hand
+            self.lifecycle.token(_trace_key(slot.payload))
         if self.eos_id is not None and token == self.eos_id:
             slot.done = True
 
@@ -2075,6 +2110,12 @@ class ContinuousBatcher:
                 self.ttft_count += 1
                 self.last_ttft_s = ttft
                 self.ttft_samples.append(ttft)
+                self._pending_ttft_obs.append((None, ttft))
+                if self.lifecycle is not None:
+                    self.lifecycle.stamp(
+                        _trace_key(slot.payload), "first_token",
+                        tenant=slot.tenant or None,
+                    )
                 if slot.tenant:
                     tenant = _bounded_tenant_key(
                         slot.tenant, self.tenant_ttft
@@ -2086,10 +2127,18 @@ class ContinuousBatcher:
                         )
                     # arrival-based when the queue stamped the request
                     # (SentTimestamp), admission-based otherwise
-                    samples.append(
+                    sample = (
                         max(0.0, self._epoch_now() - slot.arrived_at)
                         if slot.arrived_at is not None else ttft
                     )
+                    samples.append(sample)
+                    self.tenant_ttft_sum[tenant] = (
+                        self.tenant_ttft_sum.get(tenant, 0.0) + sample
+                    )
+                    self.tenant_ttft_count[tenant] = (
+                        self.tenant_ttft_count.get(tenant, 0) + 1
+                    )
+                    self._pending_ttft_obs.append((tenant, sample))
                 self._note_ttft(row, ttft)
 
     def _note_ttft(self, row: int, ttft: float) -> None:
@@ -2118,6 +2167,10 @@ class ContinuousBatcher:
                     # finished at a DEGRADED budget (not eos): the
                     # device row still thinks it has budget left
                     quiesce.append(row)
+                if self.lifecycle is not None:
+                    self.lifecycle.stamp(
+                        _trace_key(slot.payload), "completed"
+                    )
                 finished.append(
                     (slot.payload, np.asarray(tokens, np.int32))
                 )
@@ -2305,6 +2358,39 @@ class ContinuousBatcher:
             if second_round is not None:
                 self._consume_spec_round(certain, second_round)
         return self._finish_ready()
+
+
+def drain_ttft_histograms(batcher, metrics) -> None:
+    """Drain a batcher's pending TTFT samples into the cumulative
+    histogram families (unlabeled engine-wide ``ttft_seconds`` plus the
+    per-tenant ``tenant_time_to_first_token_seconds``, label-bounded
+    upstream by ``_bounded_tenant_key``).  Module-level because TWO
+    consumers drain on their own cadence: the worker's own
+    ``_update_metrics`` and the fleet pool's (pool replicas never get a
+    worker-level metrics registry — unlabeled worker gauges would stomp
+    each other — but cumulative histograms MERGE correctly across
+    replicas, so the pool drains every member into one family)."""
+    pending = getattr(batcher, "_pending_ttft_obs", None)
+    if not pending:
+        return
+    while pending:
+        tenant, seconds = pending.popleft()
+        if tenant is None:
+            metrics.observe_histogram(
+                "ttft_seconds", seconds,
+                "Seconds from request admission to its first "
+                "generated token being host-visible (cumulative "
+                "histogram over the worker's lifetime).",
+            )
+        else:
+            metrics.observe_histogram(
+                "tenant_time_to_first_token_seconds", seconds,
+                "Seconds from queue arrival (SentTimestamp when "
+                "the queue stamps it, else admission) to the first "
+                "generated token, per tenant — the cumulative-"
+                "histogram form of the tenant_ttft_seconds gauge.",
+                labels=(("tenant", tenant),),
+            )
 
 
 class ContinuousWorker:
@@ -2519,6 +2605,9 @@ class ContinuousWorker:
         # refresh once per engine cycle
         self.metrics = None
         self._served_since: float | None = None
+        # optional request-lifecycle registry (attach_lifecycle);
+        # None = tracing off = the reference path byte for byte
+        self.lifecycle = None
 
     # poll throttle: after an EMPTY zero-wait receive while slots are
     # still decoding, skip this many cycles before polling again — one
@@ -2572,6 +2661,25 @@ class ContinuousWorker:
             tenant = _bounded_tenant_key(tenant, self.completed_by_tenant)
             self.completed_by_tenant[tenant] = (
                 self.completed_by_tenant.get(tenant, 0) + 1
+            )
+        lc = self.lifecycle
+        if lc is not None:
+            # THE reply stamp: this call answered the request (sent the
+            # reply, deleted the input).  Error settles (TTL sheds,
+            # malformed bodies) may never have been admitted, so their
+            # arrival is stamped here too (idempotent).  The fleet's
+            # duplicate-consuming override never reaches this line.
+            rid = request_id(message)
+            lc.arrival(
+                rid, sent=self._sent_epoch(message),
+                tenant=message.get("_tenant") or None,
+            )
+            lc.settle(
+                rid,
+                error=(
+                    (error or "malformed body") if tokens is None
+                    else None
+                ),
             )
         return True
 
@@ -2696,6 +2804,14 @@ class ContinuousWorker:
                 self._settle(message, None, counted=False)
                 continue
             tenant = parsed[0]
+            if self.lifecycle is not None:
+                # arrival must precede the staged stamp even when the
+                # queue does not stamp SentTimestamp (then it is the
+                # receive time) — stamped here, not at admission
+                self.lifecycle.arrival(
+                    _request_id(message),
+                    sent=self._sent_epoch(message), tenant=tenant,
+                )
             # the arrival-based TTFT deadline rides into staging so the
             # EDF blend can see it at pick time (None = no SLO / no
             # queue stamp — the request can never jump the quantum)
@@ -2739,6 +2855,11 @@ class ContinuousWorker:
                     self._fair.drr.refund(tenant, item)
                     shed_any = True
                 else:
+                    if self.lifecycle is not None:
+                        self.lifecycle.stamp(
+                            _request_id(item[3]), "picked",
+                            tenant=tenant,
+                        )
                     admit.append(item)
             if not shed_any:
                 break
@@ -2901,6 +3022,20 @@ class ContinuousWorker:
         the plain insert — off-bucket prefixes are PREPENDED to the
         prompt (identical results, just uncached).  At most one insert
         dispatch per admission class per cycle."""
+        lc = self.lifecycle
+        if lc is not None:
+            # ONE seam covers every admission path — refill, tenant
+            # refill, and the fleet's orphan re-dispatch: arrival
+            # (backdated to SentTimestamp, idempotent across
+            # redeliveries of a still-open request) + the admitted
+            # stamp that closes the queue-wait phase
+            for tenant, _, _, message in parsed:
+                rid = _request_id(message)
+                lc.arrival(
+                    rid, sent=self._sent_epoch(message),
+                    tenant=tenant or None,
+                )
+                lc.stamp(rid, "admitted")
         pool = self.batcher.prefix_pool
         plain, plain_tenants, prefixed = [], [], []
         for tenant, prefix_ids, ids, message in parsed:
@@ -2962,16 +3097,11 @@ class ContinuousWorker:
         return len(parsed)
 
     def _sent_epoch(self, message: dict) -> float | None:
-        """The request's queue arrival in epoch seconds (SentTimestamp
-        is epoch milliseconds, like SQS stamps it); None when the queue
-        does not stamp."""
-        sent = message.get("Attributes", {}).get("SentTimestamp")
-        if sent is None:
-            return None
-        try:
-            return float(sent) / 1000.0
-        except (TypeError, ValueError):
-            return None
+        """The request's queue arrival in epoch seconds; delegates to
+        the one shared parse (:func:`~.service.sent_epoch`)."""
+        from .service import sent_epoch
+
+        return sent_epoch(message)
 
     def _admit(self, messages: list[dict]) -> int:
         """Parse and prefill already-received ``messages`` (at most the
@@ -3022,14 +3152,10 @@ class ContinuousWorker:
         ttl = getattr(self.config, "request_ttl_s", 0.0)
         if ttl <= 0:
             return False
-        sent = message.get("Attributes", {}).get("SentTimestamp")
+        sent = self._sent_epoch(message)
         if sent is None:
             return False
-        try:
-            age = self._now() - float(sent) / 1000.0
-        except (TypeError, ValueError):
-            return False
-        return age > ttl
+        return self._now() - sent > ttl
 
     def evacuate_shard(self, shard: int) -> tuple[int, int]:
         """Move a quarantined shard's un-finished rows off it: re-admit
@@ -3085,6 +3211,20 @@ class ContinuousWorker:
         cycle."""
         self.metrics = metrics
         self._update_metrics()
+
+    def attach_lifecycle(self, registry) -> None:
+        """Wire a :class:`~..obs.LifecycleRegistry` through every stamp
+        site this worker owns — the batcher's admission/emit/settle
+        funnels and the fair-admission staging layer — and rebind the
+        registry's clock to the worker's epoch clock (the request-TTL
+        time base), so stamps, ``SentTimestamp`` arrivals, and FakeClock
+        episodes agree on one time base.  ``None`` detaches."""
+        self.lifecycle = registry
+        if registry is not None:
+            registry.now_fn = self._now
+        self.batcher.lifecycle = registry
+        if self._fair is not None:
+            self._fair.lifecycle = registry
 
     def _update_metrics(self) -> None:
         if self.metrics is None:
@@ -3159,12 +3299,18 @@ class ContinuousWorker:
                     set(batcher.tenant_ttft):
                 self._gauge_tenants.setdefault(tenant, True)
             for tenant in sorted(self._gauge_tenants):
-                ttfts = batcher.tenant_ttft.get(tenant)
+                # cumulative mean (sum/count over the tenant's whole
+                # lifetime), not the mean of a bounded recent-sample
+                # window: the gauge no longer forgets the flood it
+                # measured an hour ago (the recent-sample deques stay
+                # for the benches' nearest-rank quantiles)
+                count = batcher.tenant_ttft_count.get(tenant, 0)
                 self.metrics.set_tenant_gauges(
                     tenant,
                     queue_depth=depths.get(tenant, 0),
                     ttft_seconds=(
-                        sum(ttfts) / len(ttfts) if ttfts else 0.0
+                        batcher.tenant_ttft_sum.get(tenant, 0.0) / count
+                        if count else 0.0
                     ),
                     tokens_per_second=(
                         batcher.tenant_tokens.get(tenant, 0) / elapsed
@@ -3215,6 +3361,15 @@ class ContinuousWorker:
                 "donors over the handoff transport.",
                 kind="counter",
             )
+        # TTFT cumulative histograms (the real replacement for the
+        # sample-deque gauges: counts never reset, quantiles compose
+        # across scrapes) — unlabeled engine-wide plus per-tenant,
+        # label-bounded upstream by _bounded_tenant_key
+        drain_ttft_histograms(batcher, self.metrics)
+        if self.lifecycle is not None:
+            # drained here so lifecycle histograms refresh on the same
+            # cadence as every other serving gauge
+            self.lifecycle.export_metrics(self.metrics)
 
     def run_once(self) -> int:
         """One engine cycle: refill free slots, advance the decode block

@@ -210,6 +210,22 @@ def request_id(message: dict) -> str:
     return message.get("MessageId", message["ReceiptHandle"])
 
 
+def sent_epoch(message: dict) -> "float | None":
+    """The message's queue-stamped arrival in epoch seconds
+    (``SentTimestamp`` is epoch milliseconds, like SQS stamps it); None
+    when the queue does not stamp.  THE one parse of the attribute —
+    request-TTL aging, tenant TTFT deadlines, and lifecycle arrival
+    stamps all share it, so they can never disagree on when a request
+    arrived."""
+    sent = message.get("Attributes", {}).get("SentTimestamp")
+    if sent is None:
+        return None
+    try:
+        return float(sent) / 1000.0
+    except (TypeError, ValueError):
+        return None
+
+
 def collect_replies(
     queue, queue_url: str, *, max_messages: int = 16
 ) -> tuple[dict[str, dict], int]:

@@ -360,6 +360,14 @@ class ShardedBatcher(ContinuousBatcher):
                 (slot.payload, list(slot.produced), slot.budget,
                  slot.submitted_at)
             )
+            if self.lifecycle is not None:
+                # the trace survives the evacuation: submit_resume (or
+                # the queue hand-back's redelivery) continues the SAME
+                # chain, this only marks that the request crossed shards
+                from ..obs.lifecycle import request_key
+
+                self.lifecycle.note(request_key(slot.payload),
+                                    "evacuated")
             self.slots[row] = _Slot()
             killed.append(row)
         self.kill_rows(killed)

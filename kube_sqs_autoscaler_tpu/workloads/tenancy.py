@@ -786,6 +786,10 @@ class FairAdmission:
         # survive this many cycles without depth before the ordinary
         # drains-means-done rule applies again (import_state arms it)
         self._sticky_grace: dict[str, int] = {}
+        # request-lifecycle registry (obs/lifecycle.py): the worker's
+        # attach_lifecycle wires it so staging stamps the "staged"
+        # phase; None = tracing off, zero work on the staging path
+        self.lifecycle = None
 
     def note_cycle(self) -> None:
         """Decay the arrival-rate EWMA one refill cycle (entries under
@@ -882,6 +886,8 @@ class FairAdmission:
             return False
         self.drr.push(tenant, item, deadline=deadline)
         self._note_offered(tenant, message_id)
+        if self.lifecycle is not None:
+            self.lifecycle.stamp(message_id, "staged", tenant=tenant)
         return True
 
     def pick(self, k: int,

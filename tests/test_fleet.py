@@ -492,7 +492,10 @@ def test_labeled_and_unlabeled_gauges_coexist():
 
 
 def test_fleet_trace_instants(model, params, donor):
-    from kube_sqs_autoscaler_tpu.obs.trace import to_chrome_trace
+    from kube_sqs_autoscaler_tpu.obs.trace import (
+        to_chrome_trace,
+        track_metadata_events,
+    )
 
     pool, _, _, sent = make_fleet(
         model, params, donor, messages=2, min=1, max=2, initial=1,
@@ -506,4 +509,6 @@ def test_fleet_trace_instants(model, params, donor):
     assert {"replica-spawn", "replica-kill"} <= names
     assert all(e["ph"] == "i" and e["cat"] == "fleet" for e in events)
     trace = to_chrome_trace([], extra_events=events)
-    assert trace["traceEvents"] == events
+    # non-empty traces lead with the track-naming metadata, then the
+    # events verbatim
+    assert trace["traceEvents"] == track_metadata_events() + events

@@ -1,0 +1,607 @@
+"""Request-lifecycle tracing: the phase-chain registry, its Perfetto
+flow export, the journal's ``kind="request"`` sidecar records under
+rotation/torn tails, and the ``bench.py --suite obs`` battery smoke.
+
+The registry's contract (obs/lifecycle.py) is audit-grade: every
+answered request carries a gap-free monotone chain with exactly ONE
+reply stamp, duplicates close without one, restored registries bump
+their flow-id epoch so post-restart ids can never collide with
+pre-crash ones, and tracing-off means no registry at all (byte-identity
+is pinned by the bench, not here).
+"""
+
+import json
+import os
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.obs import (
+    ControllerMetrics,
+    LifecycleRegistry,
+    ObservabilityServer,
+    WorkloadMetrics,
+)
+from kube_sqs_autoscaler_tpu.obs.journal import (
+    TickJournal,
+    read_journal_events,
+)
+from kube_sqs_autoscaler_tpu.obs.lifecycle import (
+    RequestTrace,
+    phase_durations,
+    request_key,
+    validate_chain,
+)
+from kube_sqs_autoscaler_tpu.obs.trace import (
+    request_trace_events,
+    to_chrome_trace,
+    track_for,
+    track_metadata_events,
+)
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_registry(clock, **kwargs):
+    return LifecycleRegistry(now_fn=clock.now, **kwargs)
+
+
+def drive(reg, clock, rid, tenant="a", staged=True, handoff=True,
+          tokens=3, step=0.01):
+    """One full request through every seam on the virtual clock."""
+    reg.arrival(rid, tenant=tenant)
+    if staged:
+        clock.advance(step)
+        reg.stamp(rid, "staged")
+        clock.advance(step)
+        reg.stamp(rid, "picked")
+    clock.advance(step)
+    reg.stamp(rid, "admitted")
+    reg.stamp(rid, "prefill")
+    clock.advance(step)
+    reg.stamp(rid, "first_token")
+    reg.token(rid)
+    if handoff:
+        clock.advance(step)
+        reg.stamp(rid, "handoff")
+    for _ in range(max(0, tokens - 1)):
+        clock.advance(step)
+        reg.token(rid)
+    reg.stamp(rid, "completed")
+    clock.advance(step)
+    reg.settle(rid)
+
+
+# -- trace keys and chain validation ------------------------------------
+
+
+def test_request_key_prefers_message_id():
+    assert request_key({"MessageId": "m-1", "ReceiptHandle": "rh"}) == "m-1"
+    assert request_key({"ReceiptHandle": "rh"}) == "rh"
+    assert request_key({"MessageId": ""}) is None
+    assert request_key("not-a-message") is None
+    assert request_key(None) is None
+
+
+def test_full_chain_validates_gap_free():
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1")
+    (trace,) = reg.done_traces()
+    assert validate_chain(
+        trace, require_staged=True, require_handoff=True
+    ) == []
+    assert trace.count("reply") == 1
+    assert trace.total_s() == pytest.approx(0.08)
+    assert reg.replies == 1 and reg.open_count == 0
+
+
+def test_validate_chain_flags_missing_phases_and_double_reply():
+    trace = RequestTrace(rid="r", flow_id=1)
+    trace.stamps = [("arrival", 0.0), ("reply", 1.0), ("reply", 2.0)]
+    problems = validate_chain(trace)
+    assert any("exactly one reply" in p for p in problems)
+    assert any("missing admitted" in p for p in problems)
+    assert any("missing first_token" in p for p in problems)
+
+
+def test_validate_chain_flags_non_monotone_first_occurrences():
+    trace = RequestTrace(rid="r", flow_id=1)
+    trace.stamps = [
+        ("arrival", 1.0), ("admitted", 0.5), ("prefill", 0.6),
+        ("first_token", 0.7), ("completed", 0.8), ("reply", 0.9),
+    ]
+    assert any(
+        "non-monotone" in p for p in validate_chain(trace)
+    )
+
+
+def test_restamps_after_redispatch_keep_the_chain_valid():
+    # re-dispatch re-stamps admitted/prefill LATER; validation takes
+    # first occurrences, so the chain stays monotone
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1", staged=False, handoff=False)
+    (trace,) = reg.done_traces()
+    trace.stamps.append(("admitted", clock.advance(0.01)))
+    assert validate_chain(trace) == []
+
+
+def test_error_reply_needs_only_arrival_and_reply():
+    clock = Clock()
+    reg = make_registry(clock)
+    reg.arrival("r1", tenant="a")
+    clock.advance(0.01)
+    reg.settle("r1", error="shed: queue TTL exceeded")
+    (trace,) = reg.done_traces()
+    assert trace.error is not None
+    assert validate_chain(trace) == []
+
+
+def test_arrival_is_idempotent_and_backdates_to_sent():
+    clock = Clock(10.0)
+    reg = make_registry(clock)
+    reg.arrival("r1", sent=4.5)
+    reg.arrival("r1")  # redelivered copy of the still-open request
+    (trace,) = reg.open_traces()
+    assert trace.count("arrival") == 1
+    assert trace.first("arrival") == 4.5
+
+
+def test_duplicate_closes_without_a_reply_stamp():
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1")
+    # the redelivered copy re-opens, then the dedup path consumes it
+    reg.arrival("r1")
+    reg.duplicate("r1")
+    copies = reg.traces_of("r1")
+    assert len(copies) == 2
+    dup = [t for t in copies if t.notes.get("duplicate")]
+    assert len(dup) == 1
+    assert dup[0].count("reply") == 0
+    assert reg.duplicates == 1
+    assert sum(t.count("reply") for t in copies) == 1
+
+
+def test_capacity_eviction_bounds_open_traces():
+    clock = Clock()
+    reg = make_registry(clock, capacity=2)
+    for i in range(4):
+        reg.arrival(f"r{i}")
+    assert reg.open_count == 2
+    assert reg.evicted == 2
+    evicted = [t for t in reg.done_traces() if t.notes.get("evicted")]
+    assert {t.rid for t in evicted} == {"r0", "r1"}
+
+
+# -- the critical-path decomposition ------------------------------------
+
+
+def test_phase_durations_decompose_the_chain():
+    trace = RequestTrace(rid="r", flow_id=1)
+    trace.stamps = [
+        ("arrival", 0.0), ("admitted", 0.3), ("prefill", 0.3),
+        ("first_token", 0.5), ("handoff", 0.6), ("completed", 1.0),
+        ("reply", 1.1),
+    ]
+    durations = phase_durations(trace)
+    assert durations["queue"] == pytest.approx(0.3)
+    assert durations["prefill"] == pytest.approx(0.2)
+    assert durations["handoff"] == pytest.approx(0.1)
+    assert durations["decode"] == pytest.approx(0.4)
+    assert durations["settle"] == pytest.approx(0.1)
+
+
+def test_inter_token_and_tpot():
+    trace = RequestTrace(rid="r", flow_id=1)
+    trace.token_times = [1.0, 1.0, 1.2, 1.5]
+    assert trace.inter_token_s() == pytest.approx([0.0, 0.2, 0.3])
+    assert trace.tpot_s() == pytest.approx(0.5 / 3)
+    assert RequestTrace(rid="r", flow_id=1).tpot_s() is None
+
+
+def test_attribute_slo_names_the_dominant_phase():
+    clock = Clock()
+    reg = make_registry(clock)
+    # r-queue waits 1.0s before admission, decodes instantly
+    reg.arrival("r-queue")
+    clock.advance(1.0)
+    for phase in ("admitted", "prefill", "first_token", "completed"):
+        reg.stamp("r-queue", phase)
+    reg.settle("r-queue")
+    # r-decode admits instantly, decodes for 2.0s
+    reg.arrival("r-decode")
+    reg.stamp("r-decode", "admitted")
+    reg.stamp("r-decode", "prefill")
+    reg.stamp("r-decode", "first_token")
+    clock.advance(2.0)
+    reg.stamp("r-decode", "completed")
+    reg.settle("r-decode")
+    report = reg.attribute_slo(0.0)
+    assert report["requests"] == 2
+    assert report["over_slo"] == 2
+    assert report["by_phase"] == {"decode": 1, "queue": 1}
+    assert report["worst"][0]["rid"] == "r-decode"
+    assert report["worst"][0]["dominant"] == "decode"
+    assert report["worst"][1]["dominant"] == "queue"
+    # under a lenient SLO nothing attributes
+    assert reg.attribute_slo(10.0)["over_slo"] == 0
+
+
+# -- restart: epochs, flow ids, restored notes --------------------------
+
+
+def test_import_bumps_epoch_so_flow_ids_never_collide():
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "done-1")
+    reg.arrival("open-1")
+    before = {t.flow_id for t in reg.done_traces() + reg.open_traces()}
+    state = reg.export_state()
+
+    fresh = make_registry(clock)
+    recovered = fresh.import_state(state, now=clock.now())
+    assert recovered >= 2
+    assert fresh.epoch == reg.epoch + 1
+    (restored,) = fresh.open_traces()
+    assert restored.rid == "open-1"
+    assert restored.notes.get("restored") == 1
+    drive(fresh, clock, "post-restart")
+    after = {
+        t.flow_id
+        for t in fresh.done_traces() + fresh.open_traces()
+        if t.rid == "post-restart"
+    }
+    assert not (before & after)
+    assert all(fid >> 32 == fresh.epoch for fid in after)
+
+
+def test_import_counters_survive_and_stale_open_traces_age_out():
+    clock = Clock(100.0)
+    reg = make_registry(clock)
+    drive(reg, clock, "r1")
+    reg.arrival("stale")
+    clock.advance(50.0)
+    reg.arrival("fresh")
+    state = reg.export_state()
+
+    fresh = make_registry(clock)
+    fresh.import_state(state, now=clock.now(), max_age_s=10.0)
+    assert {t.rid for t in fresh.open_traces()} == {"fresh"}
+    assert fresh.replies == reg.replies
+    assert fresh.created == reg.created
+
+
+# -- histogram export ----------------------------------------------------
+
+
+def test_export_metrics_renders_cumulative_phase_histograms():
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1", tenant="tenant-a")
+    metrics = WorkloadMetrics()
+    reg.export_metrics(metrics)
+    body = metrics.render()
+    assert 'request_phase_seconds_bucket{phase="queue",le="' in body
+    assert 'request_phase_seconds_bucket{phase="decode",le="' in body
+    assert 'tenant_inter_token_seconds_bucket{tenant="tenant-a"' in body
+    assert (
+        'tenant_time_per_output_token_seconds_bucket{tenant="tenant-a"'
+        in body
+    )
+    q99 = metrics.histogram_quantile(
+        "request_phase_seconds", 0.99, labels=(("phase", "queue"),)
+    )
+    assert q99 is not None and q99 > 0
+    # drained: a second export adds nothing
+    count_before = body.count("request_phase_seconds_bucket")
+    reg.export_metrics(metrics)
+    assert (
+        metrics.render().count("request_phase_seconds_bucket")
+        == count_before
+    )
+
+
+def test_tenant_histogram_series_are_bounded():
+    clock = Clock()
+    reg = make_registry(clock)
+    reg.MAX_TENANT_SERIES = 2
+    for i in range(4):
+        drive(reg, clock, f"r{i}", tenant=f"tenant-{i}")
+    metrics = WorkloadMetrics()
+    reg.export_metrics(metrics)
+    body = metrics.render()
+    assert 'tenant="tenant-0"' in body
+    assert 'tenant="tenant-1"' in body
+    assert 'tenant="tenant-2"' not in body
+    assert f'tenant="{reg.OTHER_TENANTS}"' in body
+
+
+# -- journal sidecar records: rotation and torn tails -------------------
+
+
+def test_settle_journals_request_records(tmp_path):
+    clock = Clock()
+    path = str(tmp_path / "ticks.jsonl")
+    journal = TickJournal(path, meta={"run": "t"})
+    reg = make_registry(clock, journal=journal)
+    drive(reg, clock, "r1", tenant="a")
+    journal.close()
+    (event,) = read_journal_events(path, "request")
+    assert event["rid"] == "r1"
+    restored = RequestTrace.from_dict(event)
+    assert validate_chain(
+        restored, require_staged=True, require_handoff=True
+    ) == []
+
+
+def test_request_records_survive_rotation_with_rejoin(tmp_path):
+    clock = Clock()
+    path = str(tmp_path / "ticks.jsonl")
+    journal = TickJournal(path, meta={"run": "t"}, max_bytes=4096)
+    reg = make_registry(clock, journal=journal)
+    for i in range(30):
+        drive(reg, clock, f"r{i}", tenant="a")
+    journal.close()
+    assert os.path.exists(path + ".1"), "episode never rotated"
+    live_only = [
+        e["rid"] for e in read_journal_events(path, "request")
+    ]
+    rejoined = [
+        e["rid"]
+        for e in read_journal_events(path, "request", rejoin=True)
+    ]
+    assert len(live_only) < 30
+    # rejoin recovers the one kept rotated generation on top of the
+    # live file: a contiguous, in-order suffix of the stream ending at
+    # the newest record (older generations age out — the flight
+    # recorder keeps recent history, not an archive)
+    assert len(rejoined) > len(live_only)
+    assert rejoined[-len(live_only):] == live_only
+    first = int(rejoined[0][1:])
+    assert rejoined == [f"r{i}" for i in range(first, 30)]
+
+
+def test_torn_tail_does_not_lose_earlier_request_records(tmp_path):
+    clock = Clock()
+    path = str(tmp_path / "ticks.jsonl")
+    journal = TickJournal(path, meta={"run": "t"})
+    reg = make_registry(clock, journal=journal)
+    drive(reg, clock, "r1")
+    drive(reg, clock, "r2")
+    journal.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind":"request","rid":"torn","sta')  # crash mid-line
+    events = read_journal_events(path, "request", rejoin=True)
+    assert [e["rid"] for e in events] == ["r1", "r2"]
+
+
+def test_flow_ids_do_not_collide_across_journal_restart_episodes(tmp_path):
+    clock = Clock()
+    path = str(tmp_path / "ticks.jsonl")
+    journal = TickJournal(path, meta={"run": "t"})
+    reg = make_registry(clock, journal=journal)
+    for i in range(3):
+        drive(reg, clock, f"pre-{i}")
+    state = reg.export_state()
+    journal.close()
+    # the controller restarts: a fresh journal handle appends a new
+    # episode header onto the same path, and the rehydrated registry
+    # mints flow ids one epoch up
+    journal2 = TickJournal(path, meta={"run": "t"})
+    reg2 = make_registry(clock, journal=journal2)
+    reg2.import_state(state, now=clock.now())
+    for i in range(3):
+        drive(reg2, clock, f"post-{i}")
+    journal2.close()
+    events = read_journal_events(path, "request", rejoin=True)
+    flow_ids = [e["flow_id"] for e in events]
+    assert len(flow_ids) == 6
+    assert len(set(flow_ids)) == 6
+    epochs = {fid >> 32 for fid in flow_ids}
+    assert epochs == {0, 1}
+
+
+# -- Perfetto export: pinned tracks, flow arrows ------------------------
+
+
+def test_track_assignments_are_pinned():
+    # keyed by category, never discovery order: the same event lands on
+    # the same lane across restarts and rotation rejoins
+    assert track_for("tick") == (1, 1)
+    assert track_for("fleet") == (2, 1)
+    assert track_for("shard") == (2, 2)
+    assert track_for("restart") == (2, 3)
+    assert track_for("knob") == (2, 4)
+    assert track_for("overload") == (3, 1)
+    assert track_for("prefix") == (3, 2)
+    assert track_for("plane") == (3, 3)
+    assert track_for("request") == (4, 1)
+    assert track_for("never-heard-of-it") == track_for("fleet")
+
+
+def test_track_metadata_names_the_request_phase_lanes():
+    events = track_metadata_events()
+    assert all(e["ph"] == "M" for e in events)
+    processes = {
+        e["pid"]: e["args"]["name"]
+        for e in events if e["name"] == "process_name"
+    }
+    assert processes[4] == "requests"
+    lanes = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in events if e["name"] == "thread_name"
+    }
+    assert lanes[(4, 1)] == "queue"
+    assert lanes[(4, 2)] == "prefill"
+    assert lanes[(4, 3)] == "kv-handoff"
+    assert lanes[(4, 4)] == "decode"
+    assert lanes[(4, 5)] == "settle"
+    # one metadata entry per track, no duplicates
+    names = [(e["name"], e["pid"], e["tid"]) for e in events]
+    assert len(names) == len(set(names))
+
+
+def test_request_trace_events_render_flow_linked_phase_spans():
+    clock = Clock(50.0)
+    reg = make_registry(clock)
+    drive(reg, clock, "r1", tenant="a")
+    drive(reg, clock, "r2", tenant="b")
+    events = request_trace_events(reg.done_traces())
+    spans = [e for e in events if e["ph"] == "X"]
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    assert spans and flows
+    assert all(e["cat"] == "request" for e in events)
+    assert all(e["pid"] == 4 for e in events)
+    by_lane = {e["tid"] for e in spans}
+    assert by_lane == {1, 2, 3, 4, 5}  # every phase got its own lane
+    # zero-based on the first arrival despite the epoch-50 clock
+    assert min(e["ts"] for e in events) == 0
+    for rid in ("r1", "r2"):
+        chain = [
+            e for e in flows
+            if e["id"] in {
+                t.flow_id for t in reg.done_traces() if t.rid == rid
+            }
+        ]
+        assert [e["ph"] for e in chain[:1]] == ["s"]
+        assert chain[-1]["ph"] == "f"
+        assert chain[-1]["bp"] == "e"
+        assert all(e["ph"] == "t" for e in chain[1:-1])
+    # two requests, two distinct flow arrows
+    assert len({e["id"] for e in flows}) == 2
+
+
+def test_request_trace_events_skip_unarrived_and_render_errors():
+    assert request_trace_events([]) == []
+    never_arrived = RequestTrace(rid="r", flow_id=1)
+    never_arrived.stamps = [("admitted", 1.0)]
+    assert request_trace_events([never_arrived]) == []
+    clock = Clock()
+    reg = make_registry(clock)
+    reg.arrival("shed-1")
+    clock.advance(0.25)
+    reg.stamp("shed-1", "admitted")
+    reg.settle("shed-1", error="shed")
+    events = request_trace_events(reg.done_traces())
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans and all(e["args"]["error"] == "shed" for e in spans)
+
+
+def test_chrome_trace_merges_request_spans_without_tick_records():
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1")
+    trace = to_chrome_trace(
+        [], extra_events=request_trace_events(reg.done_traces())
+    )
+    cats = {e.get("cat") for e in trace["traceEvents"]}
+    assert "request" in cats
+    assert any(e["ph"] == "M" for e in trace["traceEvents"])
+    # still byte-empty with nothing recorded
+    assert to_chrome_trace([], extra_events=[])["traceEvents"] == []
+
+
+# -- the /debug/requests endpoint ---------------------------------------
+
+
+def test_debug_requests_endpoint_serves_snapshot_and_attribution():
+    import urllib.request
+
+    clock = Clock()
+    reg = make_registry(clock)
+    drive(reg, clock, "r1", tenant="a")
+    reg.arrival("still-open")
+    metrics = ControllerMetrics()
+    server = ObservabilityServer(
+        metrics, host="127.0.0.1", port=0, lifecycle=reg
+    )
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = json.loads(
+            urllib.request.urlopen(
+                f"{base}/debug/requests?n=10&slo=0.0"
+            ).read().decode()
+        )
+        assert body["replies"] == 1 and body["open"] == 1
+        assert [t["rid"] for t in body["requests"]] == ["r1"]
+        assert [t["rid"] for t in body["open_requests"]] == ["still-open"]
+        assert body["attribution"]["over_slo"] == 1
+        assert body["attribution"]["dominant"] in (
+            "queue", "prefill", "handoff", "decode", "settle"
+        )
+    finally:
+        server.stop()
+
+
+def test_debug_requests_404_without_a_registry():
+    import urllib.error
+    import urllib.request
+
+    server = ObservabilityServer(
+        ControllerMetrics(), host="127.0.0.1", port=0
+    )
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/requests"
+            )
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+# -- the bench battery ---------------------------------------------------
+
+
+def test_obs_bench_smoke(tmp_path):
+    """Tier-1: the full episode set with the timing gate off (virtual
+    clocks make everything else deterministic) — completeness,
+    dispatch-count parity, restart epochs, dedup, and both SLO
+    attributions must all hold."""
+    import bench
+
+    out = tmp_path / "BENCH_obs.json"
+    # any failed gate raises SystemExit(2) before returning
+    summary = bench.run_obs_suite(output=str(out), timing_gates=False)
+    assert summary["metric"] == "obs_complete_chains"
+    assert summary["value"] > 0
+    artifact = json.loads(out.read_text())
+    comp = artifact["completeness"]
+    assert comp["on"]["chains_ok"] == comp["on"]["audited"]
+    assert comp["chaos"]["chains_ok"] == comp["chaos"]["audited"]
+    assert comp["registry"]["duplicates"] >= 1
+    assert artifact["restart"]["epoch"] == 1
+    assert artifact["attribution"]["prefill_starved"]["dominant"] == "queue"
+    assert artifact["attribution"]["decode_contended"]["dominant"] in (
+        "decode", "handoff"
+    )
+
+
+@pytest.mark.slow
+def test_obs_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_obs_full.json"
+    summary = bench.run_obs_suite(output=str(out))
+    assert summary["metric"] == "obs_complete_chains"
+    artifact = json.loads(out.read_text())
+    assert (
+        artifact["overhead"]["tokens_per_second_ratio"]
+        >= artifact["overhead"]["floor"]
+    )
+    assert artifact["overhead"]["counters_on"] == (
+        artifact["overhead"]["counters_off"]
+    )
