@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs bench-comms replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -189,6 +189,19 @@ bench-disagg:
 # decode-contended); writes BENCH_r21.json
 bench-obs:
 	JAX_PLATFORMS=cpu python bench.py --suite obs
+
+# Scheduled collectives (CPU JAX, ~30 s): typed transfer ops dispatched
+# inside the dispatch-ahead window while the next gang block computes;
+# exits 2 unless comms-on performs strictly fewer blocking host
+# transfers than the pre-comms path on evacuation AND handoff episodes
+# with byte-identical greedy replies and exactly-once, a wired-but-
+# disabled scheduler changes nothing (odometers included), at least one
+# transfer span overlaps a decode span in the exported request trace,
+# the mesh-sharded pooled admission reproduces the single-chip pooled
+# path byte for byte on the forced 8-device CPU mesh, and virtual-time
+# tokens/s is monotone across shard counts 1/2/4; writes BENCH_r22.json
+bench-comms:
+	python bench.py --suite comms
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

@@ -1,0 +1,48 @@
+"""comms/ — scheduled collectives: typed, overlappable data movement.
+
+Cross-shard and cross-plane byte moves (evacuation KV, prefix
+installs, handoff KV gathers, settle pulls) become typed
+:class:`~.ops.TransferOp` values on a :class:`~.scheduler.\
+CollectiveScheduler` that dispatches them device-side inside the
+engine's dispatch-ahead window — while the next gang block is in
+flight — instead of paying a blocking host round-trip at settle time
+(ISSUE 18 / ROADMAP item 2).
+
+- :mod:`.ops` — the four-kind transfer taxonomy, size buckets, and
+  the ``copy_to_host_async``-backed settle-pull constructor;
+- :mod:`.scheduler` — queueing, small-op coalescing (one batched
+  dispatch per destination per cycle), the
+  ``transfer_dispatches`` / ``transfer_bytes`` /
+  ``overlapped_transfers_total`` counter family, lifecycle
+  ``transfer`` spans, and the ``sched/`` safety-net flush event.
+"""
+
+from .ops import (  # noqa: F401
+    EVACUATION_KV,
+    HANDOFF_KV,
+    PREFIX_INSTALL,
+    SETTLE_PULL,
+    SIZE_BUCKET_LABELS,
+    SMALL_OP_BYTES,
+    TRANSFER_KINDS,
+    TransferOp,
+    array_nbytes,
+    settle_pull_op,
+    size_bucket,
+)
+from .scheduler import CollectiveScheduler  # noqa: F401
+
+__all__ = [
+    "CollectiveScheduler",
+    "EVACUATION_KV",
+    "HANDOFF_KV",
+    "PREFIX_INSTALL",
+    "SETTLE_PULL",
+    "SIZE_BUCKET_LABELS",
+    "SMALL_OP_BYTES",
+    "TRANSFER_KINDS",
+    "TransferOp",
+    "array_nbytes",
+    "settle_pull_op",
+    "size_bucket",
+]

@@ -1,0 +1,266 @@
+"""The collective scheduler: transfers as first-class scheduled work.
+
+:class:`CollectiveScheduler` owns a queue of :class:`~.ops.TransferOp`
+and decides when the moves start.  The engine calls :meth:`flush`
+inside its dispatch-ahead window — immediately AFTER the next decode
+block / gang block is dispatched and BEFORE it blocks on the previous
+one — so every queued pull starts device-side while the block computes
+(the PR 5/16 overlap budget).  Ops flushed there are counted
+``overlapped``; the settle that later consumes a prefetched array is
+no longer a blocking host round-trip, which is exactly what the
+``host_transfers`` odometer stops counting (gated by ``bench.py
+--suite comms``).
+
+Small same-``(destination, kind)`` ops coalesce into ONE batched
+dispatch per flush (size-bucketed — the NCCL chunking idea), so
+transfer dispatches stay O(1) per cycle no matter how many deferred
+first-token arrays pile up.
+
+The scheduler also registers on the ``sched/`` event queue
+(:meth:`register`): a recurring ``comms-flush`` event drains anything
+an engine window missed, at :data:`~..sched.PRIORITY_CYCLE` like the
+serving cycles it rides between.  Those safety-net flushes run with no
+block in flight and are counted non-overlapped — the counters never
+flatter the overlap.
+
+With no scheduler attached (``engine.comms is None``) every engine
+path is byte-identical to the pre-comms code, counters included; the
+bench pins this too.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Sequence
+
+from .ops import (
+    SMALL_OP_BYTES,
+    TRANSFER_KINDS,
+    TransferOp,
+    settle_pull_op,
+    size_bucket,
+)
+
+
+class CollectiveScheduler:
+    """Queue, coalesce, dispatch, and account for transfer ops.
+
+    ``lifecycle`` (a :class:`~..obs.lifecycle.LifecycleRegistry`) gets
+    paired ``transfer`` / ``transfer_done`` stamps for every rid an op
+    serves, which is what renders the op as a span on the request's
+    Perfetto ``transfers`` lane — visibly parallel to the decode span
+    hiding it.  ``enabled=False`` parks the scheduler: submits return
+    ``None`` and flushes are no-ops, so a wired-but-disabled scheduler
+    is byte-identical to no scheduler at all.
+    """
+
+    def __init__(
+        self,
+        *,
+        lifecycle: Any = None,
+        enabled: bool = True,
+        small_bytes: int = SMALL_OP_BYTES,
+        trace_len: int = 256,
+    ) -> None:
+        self.lifecycle = lifecycle
+        self.enabled = enabled
+        self.small_bytes = small_bytes
+        self._pending: list[TransferOp] = []
+        #: most recent dispatched ops, for debugging / the bench artifact
+        self.recent: deque = deque(maxlen=trace_len)
+        # the counter family the bench pins
+        self.transfer_dispatches = 0
+        self.transfer_bytes = 0
+        self.overlapped_transfers_total = 0
+        self.submitted_ops = 0
+        self.dispatched_ops = 0
+        self.coalesced_ops = 0
+        self.finished_ops = 0
+        self.flushes = 0
+        self.by_kind = {kind: 0 for kind in TRANSFER_KINDS}
+        self.by_bucket: dict[str, int] = {}
+
+    def _now(self) -> float:
+        now_fn = getattr(self.lifecycle, "now_fn", None)
+        return now_fn() if now_fn is not None else time.time()
+
+    def _stamp(self, op: TransferOp, name: str, t: float) -> None:
+        lc = self.lifecycle
+        if lc is None:
+            return
+        for rid in op.rids:
+            lc.stamp(rid, name, t=t)
+
+    # -- the producer surface -------------------------------------------
+
+    def submit(self, op: TransferOp) -> TransferOp | None:
+        """Queue one op for the next flush (None when disabled)."""
+        if not self.enabled:
+            return None
+        self._pending.append(op)
+        self.submitted_ops += 1
+        return op
+
+    def settle_pull(
+        self,
+        arrays: Any,
+        *,
+        destination: str = "host",
+        rids: Sequence[str] = (),
+        args: dict | None = None,
+    ) -> TransferOp | None:
+        """Queue a device→host pull of ``arrays`` (see
+        :func:`~.ops.settle_pull_op`)."""
+        if not self.enabled:
+            return None
+        return self.submit(
+            settle_pull_op(
+                arrays, destination=destination, rids=rids, args=args,
+            )
+        )
+
+    def record(
+        self,
+        kind: str,
+        destination: str,
+        nbytes: int,
+        *,
+        rids: Sequence[str] = (),
+        t0: float | None = None,
+        overlapped: bool = False,
+        args: dict | None = None,
+    ) -> TransferOp | None:
+        """Account for a move some jit already dispatched (handoff
+        gathers, prefix installs, evacuation flushes): one dispatch,
+        its bytes, and a closed ``transfer`` span from ``t0`` (default
+        now) to now on every rid."""
+        if not self.enabled:
+            return None
+        now = self._now()
+        op = TransferOp(
+            kind=kind,
+            destination=destination,
+            nbytes=int(nbytes),
+            rids=tuple(r for r in rids if r),
+            args=dict(args or {}),
+        )
+        op.dispatched = True
+        op.dispatched_t = now if t0 is None else t0
+        op.overlapped = overlapped
+        self.submitted_ops += 1
+        self.dispatched_ops += 1
+        self.transfer_dispatches += 1
+        self.transfer_bytes += op.nbytes
+        if overlapped:
+            self.overlapped_transfers_total += 1
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+        bucket = size_bucket(op.nbytes)
+        self.by_bucket[bucket] = self.by_bucket.get(bucket, 0) + 1
+        self._stamp(op, "transfer", op.dispatched_t)
+        self.recent.append(op)
+        self.finish(op, t=now)
+        return op
+
+    # -- the scheduling surface -----------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def flush(self, *, overlapped: bool = False) -> int:
+        """Dispatch every queued op device-side and return the number
+        of DISPATCHES (coalesced groups count once).
+
+        ``overlapped=True`` asserts the caller just dispatched the next
+        block — the window the started copies hide in; the safety-net
+        ``sched/`` flush passes False.  Small ops sharing a
+        ``(destination, kind)`` key batch into one dispatch; each op
+        still runs its own ``dispatch`` thunk (the async starts are the
+        batch), but the cycle pays one dispatch count per group.
+        """
+        if not self.enabled or not self._pending:
+            return 0
+        pending, self._pending = self._pending, []
+        self.flushes += 1
+        now = self._now()
+        groups: dict[tuple, list[TransferOp]] = {}
+        singles: list[TransferOp] = []
+        for op in pending:
+            if op.nbytes <= self.small_bytes:
+                groups.setdefault(op.coalesce_key(), []).append(op)
+            else:
+                singles.append(op)
+        dispatches = 0
+        for batch in list(groups.values()) + [[op] for op in singles]:
+            dispatches += 1
+            self.transfer_dispatches += 1
+            if len(batch) > 1:
+                self.coalesced_ops += len(batch)
+            for op in batch:
+                if op.dispatch is not None:
+                    op.dispatch()
+                op.dispatched = True
+                op.dispatched_t = now
+                op.overlapped = overlapped
+                self.dispatched_ops += 1
+                self.transfer_bytes += op.nbytes
+                if overlapped:
+                    self.overlapped_transfers_total += 1
+                self.by_kind[op.kind] = self.by_kind.get(op.kind, 0) + 1
+                bucket = size_bucket(op.nbytes)
+                self.by_bucket[bucket] = self.by_bucket.get(bucket, 0) + 1
+                self._stamp(op, "transfer", now)
+                self.recent.append(op)
+        return dispatches
+
+    def finish(
+        self, op: TransferOp | None, *, t: float | None = None
+    ) -> None:
+        """Close an op's span at the moment its bytes were consumed
+        host-side (idempotent; None-safe for unsubmitted ops)."""
+        if op is None or op.finished:
+            return
+        op.finished = True
+        op.finished_t = self._now() if t is None else t
+        self.finished_ops += 1
+        self._stamp(op, "transfer_done", op.finished_t)
+
+    # -- sched/ integration ---------------------------------------------
+
+    def register(
+        self,
+        scheduler: Any,
+        *,
+        period: float = 1.0,
+        name: str = "comms-flush",
+    ) -> Any:
+        """Register the safety-net flush as a recurring ``sched/``
+        event (PRIORITY_CYCLE — it rides between serving cycles).  The
+        event drains ops no engine window flushed; those dispatches run
+        with no block in flight, so they count non-overlapped."""
+        from ..sched import PRIORITY_CYCLE
+
+        return scheduler.every(
+            name, period,
+            lambda: self.flush(overlapped=False),
+            priority=PRIORITY_CYCLE,
+        )
+
+    # -- introspection ---------------------------------------------------
+
+    def counters(self) -> dict:
+        """The counter family (bench artifact / assertions)."""
+        return {
+            "transfer_dispatches": self.transfer_dispatches,
+            "transfer_bytes": self.transfer_bytes,
+            "overlapped_transfers_total": self.overlapped_transfers_total,
+            "submitted_ops": self.submitted_ops,
+            "dispatched_ops": self.dispatched_ops,
+            "coalesced_ops": self.coalesced_ops,
+            "finished_ops": self.finished_ops,
+            "flushes": self.flushes,
+            "pending": len(self._pending),
+            "by_kind": dict(self.by_kind),
+            "by_bucket": dict(self.by_bucket),
+        }

@@ -28,6 +28,7 @@ from .lifecycle import (
     RequestTrace,
     phase_durations,
     request_key,
+    transfer_spans,
     validate_chain,
 )
 from .prometheus import ControllerMetrics, WorkloadMetrics
@@ -57,5 +58,6 @@ __all__ = [
     "request_trace_events",
     "to_chrome_trace",
     "trace_events",
+    "transfer_spans",
     "validate_chain",
 ]

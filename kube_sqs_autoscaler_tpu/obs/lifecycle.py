@@ -71,8 +71,12 @@ REQUIRED_PHASES = (
 
 #: Attribution buckets of :func:`phase_durations` /
 #: :meth:`LifecycleRegistry.attribute_slo` — where an over-SLO
-#: request's budget can go.
-DURATION_PHASES = ("queue", "prefill", "handoff", "decode", "settle")
+#: request's budget can go.  ``transfer`` is the scheduled-collective
+#: bucket: paired ``transfer``/``transfer_done`` stamps from the
+#: ``comms/`` scheduler (and the evacuation/handoff seams), summed —
+#: so ``attribute_slo`` can name a transfer-bound request.
+DURATION_PHASES = ("queue", "prefill", "handoff", "decode", "settle",
+                   "transfer")
 
 #: Per-trace token-time bound: generate budgets are engine-bounded, but
 #: a registry must stay bounded against any caller.
@@ -189,6 +193,23 @@ class RequestTrace:
         return trace
 
 
+def transfer_spans(trace: RequestTrace) -> list[tuple[float, float]]:
+    """Paired ``(start, done)`` windows of scheduled collective
+    transfers on the trace: each ``transfer`` stamp opens a window the
+    next ``transfer_done`` closes (FIFO — coalesced ops stamped at one
+    flush all close at their own settle).  An unmatched open stamp
+    (the op never finished — e.g. a kill mid-flight) contributes no
+    window."""
+    spans: list[tuple[float, float]] = []
+    open_starts: list[float] = []
+    for name, t in trace.stamps:
+        if name == "transfer":
+            open_starts.append(t)
+        elif name == "transfer_done" and open_starts:
+            spans.append((open_starts.pop(0), t))
+    return spans
+
+
 def phase_durations(trace: RequestTrace) -> dict[str, float]:
     """The trace decomposed into :data:`DURATION_PHASES` seconds.
 
@@ -200,6 +221,10 @@ def phase_durations(trace: RequestTrace) -> dict[str, float]:
       free-slot wait + the transfer; absent on fused serving)
     - ``decode``  — handoff (or first token) → final token settled
     - ``settle``  — final token → reply sent
+    - ``transfer`` — total seconds inside scheduled-collective windows
+      (:func:`transfer_spans`); transfers overlap the phases above by
+      design, so this bucket is a parallel attribution axis, not a
+      sixth slice of the arrival→reply wall
     """
     out: dict[str, float] = {}
     arrival = trace.first("arrival")
@@ -219,6 +244,9 @@ def phase_durations(trace: RequestTrace) -> dict[str, float]:
         out["decode"] = max(0.0, completed - decode_base)
     if reply is not None and completed is not None:
         out["settle"] = max(0.0, reply - completed)
+    windows = transfer_spans(trace)
+    if windows:
+        out["transfer"] = sum(max(0.0, b - a) for a, b in windows)
     return out
 
 

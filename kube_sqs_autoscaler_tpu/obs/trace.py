@@ -59,6 +59,10 @@ _REQUEST_LANES: dict[str, tuple[int, str]] = {
     "handoff": (3, "kv-handoff"),
     "decode": (4, "decode"),
     "settle": (5, "settle"),
+    # scheduled-collective transfer windows (comms/): rendered at their
+    # ABSOLUTE stamp times, not cursor-chained — an overlapped transfer
+    # sits visibly parallel to the decode span hiding it
+    "transfer": (6, "transfers"),
 }
 
 _SPAN_FIELDS = (
@@ -274,7 +278,10 @@ def request_trace_events(
     first trace's arrival so request spans share t=0 with whatever tick
     records they are merged with.
     """
-    from .lifecycle import phase_durations  # local: avoid import cycle
+    from .lifecycle import (  # local: avoid import cycle
+        phase_durations,
+        transfer_spans,
+    )
 
     traces = list(traces)
     starts = [
@@ -342,6 +349,23 @@ def request_trace_events(
             if ph == "f":
                 flow["bp"] = "e"
             events.append(flow)
+        # scheduled-collective windows (comms/): absolute-time spans on
+        # the transfers lane.  The chained spans above start at the
+        # trace's absolute stamp times too, so a transfer dispatched
+        # while a block decodes renders exactly under the decode span
+        # it hides behind — the overlap the bench gate looks for.
+        transfer_tid, _ = _REQUEST_LANES["transfer"]
+        for t0, t1 in transfer_spans(trace):
+            events.append({
+                "name": "transfer",
+                "cat": "request",
+                "ph": "X",
+                "ts": _us(t0 - time_origin),
+                "dur": _us(max(0.0, t1 - t0)),
+                "pid": _REQUEST_PID,
+                "tid": transfer_tid,
+                "args": args,
+            })
     return events
 
 

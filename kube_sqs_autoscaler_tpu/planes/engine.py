@@ -388,6 +388,9 @@ class DecodePlaneBatcher(ShardedBatcher):
             lasts.append(produced[-1])
             budgets.append(budget - len(produced))
         spec = bool(self.spec_layers) and self.draft_enabled
+        handoff_t0 = (
+            self.lifecycle.now_fn() if self.lifecycle is not None else None
+        )
         (self.cache, self.draft_cache, self._current, self._done,
          self._remaining) = _handoff_rows(
             self.cache, self.draft_cache, self._current, self._done,
@@ -398,6 +401,14 @@ class DecodePlaneBatcher(ShardedBatcher):
         )
         self.insert_dispatches += 1
         self.kv_transfers += len(rows)
+        if self.comms is not None and self.comms.enabled:
+            from ..comms.ops import HANDOFF_KV
+
+            self.comms.record(
+                HANDOFF_KV, "decode-plane",
+                nbytes=self._row_kv_nbytes() * len(rows),
+                args={"rows": len(rows)},
+            )
         for row, (_, payload, produced, budget, submitted_at,
                   tenant) in zip(rows, handoffs):
             self.slots[row] = _Slot(
@@ -413,10 +424,18 @@ class DecodePlaneBatcher(ShardedBatcher):
                 # host bookkeeping on a copy that already happened.
                 from ..obs.lifecycle import request_key
 
+                rid = request_key(payload)
                 self.lifecycle.stamp(
-                    request_key(payload), "handoff",
-                    tenant=tenant or None,
+                    rid, "handoff", tenant=tenant or None,
                 )
+                # the KV gather is itself a transfer: a paired window
+                # on the request's trace (previously only a fleet
+                # "kv-handoff" instant existed), so attribute_slo can
+                # name transfer-bound requests and the Perfetto export
+                # renders the move on the transfers lane
+                self.lifecycle.stamp(rid, "transfer", t=handoff_t0)
+                self.lifecycle.stamp(rid, "transfer_done")
+                self.lifecycle.note(rid, "transfer_handoff_kv")
         self._invalidate_admission_cache()
         return rows
 

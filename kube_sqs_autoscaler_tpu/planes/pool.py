@@ -316,6 +316,24 @@ class DisaggregatedPool(WorkerPool):
         self.decode_pool.attach_lifecycle(registry)
         super().attach_lifecycle(registry)
 
+    def attach_comms(self, comms) -> None:
+        """Wire one :class:`~..comms.CollectiveScheduler` through both
+        planes' engines (current members; attach before serving): the
+        decode plane's settle pulls ride the gang's dispatch-ahead
+        window, the prefill replicas' settle pulls ride their block
+        windows, and every KV handoff records its bytes on the shared
+        counter family.  Detached (the default) the shuttle keeps its
+        fleet instants and nothing else changes."""
+        self.comms = comms
+        attach = getattr(self.decode.batcher, "attach_comms", None)
+        if attach is not None:
+            attach(comms)
+        for replica in self.members:
+            batcher = getattr(replica.worker, "batcher", None)
+            attach = getattr(batcher, "attach_comms", None)
+            if attach is not None:
+                attach(comms)
+
     # ------------------------------------------------------------------
     # Real-plane construction
     # ------------------------------------------------------------------

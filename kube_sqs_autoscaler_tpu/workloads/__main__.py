@@ -175,8 +175,9 @@ def main(argv=None) -> None:
              "reuse gathers the cached KV inside the one admission "
              "insert; on the sharded plane requests route sticky "
              "(affinity-first-then-freest) so tenants keep their hits "
-             "(0 = off; requires --tenants; not with --prefix-ids or "
-             "--model-parallel)",
+             "(0 = off; requires --tenants; not with --prefix-ids; "
+             "composes with --model-parallel when the KV head count "
+             "divides the mesh's model axis)",
     )
     parser.add_argument(
         "--request-ttl", type=float, default=0.0, metavar="SECONDS",
@@ -460,11 +461,6 @@ def main(argv=None) -> None:
                     "exclusive (the pool generalizes the single "
                     "broadcast prefix)"
                 )
-            if args.model_parallel:
-                raise SystemExit(
-                    "--prefix-pool is single-chip for now (not with "
-                    "--model-parallel)"
-                )
             if args.prefix_pool < args.batch_size:
                 raise SystemExit(
                     f"--prefix-pool {args.prefix_pool} must be >= "
@@ -626,10 +622,13 @@ def main(argv=None) -> None:
         2 * args.speculative_draft_tokens
         if args.speculative_draft_layers else 0
     )
+    # the prefix pool prepends a seq_len-long cached prefix to every
+    # pooled row, so its rows need a second seq_len of cache positions
+    pool_prefix = args.seq_len if args.prefix_pool else 0
     needed_ctx = max(
         64,
-        len(prefix_ids) + args.seq_len + args.generate_tokens
-        + spec_headroom,
+        len(prefix_ids) + pool_prefix + args.seq_len
+        + args.generate_tokens + spec_headroom,
     )
     hf_params = None
     if args.hf_checkpoint:

@@ -717,10 +717,26 @@ class ContinuousBatcher:
                     "the generalization of the broadcast prefix)"
                 )
             if mesh is not None:
-                raise ValueError(
-                    "the per-tenant prefix pool is single-chip for now "
-                    "(the pooled insert's gather is not mesh-sharded)"
+                # the pooled gather IS mesh-sharded (comms/ PR): pool
+                # buffers place heads over "model" with the stacked
+                # entry axis replicated, so validate the layout divides
+                # — a head count the model axis can't split would make
+                # XLA silently pad-and-reshard every admission gather
+                from .decode import require_serving_mesh
+
+                require_serving_mesh(mesh)
+                kv_heads = (
+                    config.n_kv_heads if family == "llama"
+                    else config.n_heads
                 )
+                model_axis = mesh.shape["model"]
+                if kv_heads % model_axis:
+                    raise ValueError(
+                        f"prefix pool KV heads ({kv_heads}) not "
+                        f"divisible by the mesh's model axis "
+                        f"({model_axis}) — the pooled gather shards "
+                        "heads over 'model'"
+                    )
             if tenancy.prefix_len < 1:
                 raise ValueError(
                     "tenancy.prefix_len must be >= 1 when prefix_pool "
@@ -757,6 +773,7 @@ class ContinuousBatcher:
                 prefix_len=tenancy.prefix_len,
                 shards=getattr(self, "shards", 1),
                 family=family, quantized_kv=quantized_kv,
+                mesh=mesh,
             )
         # aggregate speculative stats (per-request stats ride the slots)
         self.spec_rounds = 0
@@ -787,6 +804,20 @@ class ContinuousBatcher:
         self.decode_dispatches = 0
         self.insert_dispatches = 0
         self.host_transfers = 0
+        # scheduled collectives (comms/CollectiveScheduler): None = off
+        # = the pre-comms engine byte for byte, counters included;
+        # attach_comms wires it.  With comms on, settle pulls dispatch
+        # device-side inside the dispatch-ahead window and the settle
+        # that consumes a prefetched array stops counting as a blocking
+        # host transfer.
+        self.comms = None
+        # in-flight TransferOps covering deferred first-token arrays,
+        # keyed by id(array) — safe because the arrays stay alive in
+        # _pending_firsts until the settle pops both together
+        self._first_ops: dict[int, Any] = {}
+        # the op covering the in-flight decode/gang block's settle
+        # arrays (one per cycle at most)
+        self._block_op: Any = None
         # rows quiesced mid-budget (a degraded slot finished before its
         # DEVICE budget ran out): excluded from admission until the
         # block that was in flight at quiesce time settles, because
@@ -1113,6 +1144,82 @@ class ContinuousBatcher:
             )
         self.spec_overlap = bool(enabled)
 
+    # ------------------------------------------------------------------
+    # Scheduled collectives (comms/): the engine's transfer seam.
+    # ------------------------------------------------------------------
+
+    def attach_comms(self, comms) -> None:
+        """Wire a :class:`~..comms.CollectiveScheduler` (None detaches).
+
+        With a scheduler attached, the block/gang step flushes queued
+        transfer ops inside its dispatch-ahead window — the settle
+        pulls start device-side while the next block computes — and
+        the prefix pool records its installs.  Detached (the default),
+        every per-cycle path is byte-identical to the pre-comms
+        engine, counters included."""
+        self.comms = comms
+        if self._prefix_pool is not None:
+            self._prefix_pool.comms = comms
+
+    def _comms_flush(self, *, overlapped: bool) -> None:
+        """Submit every not-yet-scheduled deferred first-token array
+        as a settle-pull op and dispatch the comms queue device-side.
+        Called by the block/gang step right AFTER the next block's
+        dispatch (``overlapped=True``: the copies hide behind its
+        device time); a flush with nothing in flight passes False and
+        the counters stay honest."""
+        comms = self.comms
+        if comms is None or not comms.enabled:
+            return
+        for arr, rows in self._pending_firsts:
+            if id(arr) in self._first_ops:
+                continue
+            rids = [
+                _trace_key(self.slots[row].payload) for row in rows
+            ]
+            op = comms.settle_pull(
+                arr,
+                destination="host",
+                rids=[r for r in rids if r is not None],
+                args={"rows": list(rows)},
+            )
+            if op is not None:
+                self._first_ops[id(arr)] = op
+        if self._block_op is None:
+            arrs = self._block_settle_arrays()
+            if arrs is not None:
+                rids = [
+                    _trace_key(slot.payload)
+                    for slot in self.slots if slot.busy
+                ]
+                self._block_op = comms.settle_pull(
+                    arrs, destination="host",
+                    rids=[r for r in rids if r is not None],
+                    args={"block": True},
+                )
+        comms.flush(overlapped=overlapped)
+
+    def _block_settle_arrays(self):
+        """The in-flight block's device arrays its settle will fetch
+        (None when nothing is in flight) — what the comms flush
+        prefetches.  The block was dispatched a full cycle ago, so by
+        flush time its results exist device-side and an async host
+        copy genuinely overlaps the block dispatched this cycle."""
+        if self._pending_block is None:
+            return None
+        tokens, counts, _ = self._pending_block
+        return (tokens, counts)
+
+    def _row_kv_nbytes(self) -> int:
+        """One cache row's KV bytes across every layer — the payload
+        size of a per-row KV move (evacuation, handoff) for the comms
+        accounting; layout-agnostic (bf16 k/v or int8 codes+scales)."""
+        total = 0
+        for layer in self.cache["layers"]:
+            for buf in layer.values():
+                total += buf.nbytes // max(1, buf.shape[0])
+        return total
+
     def _make_insert_many(self, resume: bool = False):
         """The plain path's batched-admission jit: ``(params, cache,
         current, done, remaining, rows, prompts, lengths, key, n_rows)``
@@ -1206,8 +1313,17 @@ class ContinuousBatcher:
         """The prefix-pool admission jit: same shape discipline as
         :meth:`_make_insert_many` (one compiled program per refill
         size), plus the per-row pool entry indices and the pool's
-        stacked layer buffers as operands.  Single-chip only (checked
-        at construction)."""
+        stacked layer buffers as operands.
+
+        Under a mesh the gather is sharding-aware (ROADMAP item 2):
+        pool buffers place heads over "model" with the stacked entry
+        axis replicated (the :func:`~.decode.prefix_cache_shardings`
+        layout applied per layer — any entry may be gathered to any
+        data-shard row), the slot cache keeps its serving layout, and
+        the whole insert stays ONE device call.  The gather's entry
+        axis never crosses the head axis, so outputs are byte-identical
+        to the single-chip pooled path (gated by the forced-CPU-mesh
+        parity tests)."""
         statics = dict(
             config=self.config, prompt_len=self.prompt_len,
             budget=self.generate_tokens, family=self.family,
@@ -1215,9 +1331,42 @@ class ContinuousBatcher:
             top_p=self.top_p, quantized_kv=self.quantized_kv,
             pool_prefix_len=self._pool_prefix_len, eos_id=self.eos_id,
         )
-        return lambda *operands, n_rows: _insert_rows_pooled(
-            *operands, n_rows=n_rows, **statics,
-        )
+        if self.mesh is None:
+            return lambda *operands, n_rows: _insert_rows_pooled(
+                *operands, n_rows=n_rows, **statics,
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .train import param_shardings
+
+        rep = NamedSharding(self.mesh, P())
+        p_shard = param_shardings(self.mesh, self.params)
+        rows = self._rows_shard
+        pool_shard = self._prefix_pool.layer_shardings(self.mesh)
+        # operand order mirrors _insert_rows_pooled_impl: params, the
+        # four donated state operands, then the tiny replicated
+        # per-refill operands (rows/prompts/lengths/key/entry_idx) and
+        # the pool's stacked layers
+        in_ops = (p_shard, self._cache_shard, rows, rows, rows,
+                  rep, rep, rep, rep, rep, pool_shard)
+        out_ops = (self._cache_shard, rows, rows, rows, rep)
+        jits: dict[int, Any] = {}
+
+        def impl(*args, _n):
+            return _insert_rows_pooled_impl(*args, n_rows=_n, **statics)
+
+        def insert_pooled(*operands, n_rows):
+            fn = jits.get(n_rows)
+            if fn is None:
+                fn = jax.jit(
+                    partial(impl, _n=n_rows),
+                    in_shardings=in_ops, out_shardings=out_ops,
+                    donate_argnums=(1, 2, 3, 4),
+                )
+                jits[n_rows] = fn
+            return fn(*operands)
+
+        return insert_pooled
 
     def _mesh_insert_jit(self, impl, statics, cache_shards):
         """The speculative insert's mesh wiring: pinned in/out shardings
@@ -2079,14 +2228,29 @@ class ContinuousBatcher:
     def _settle_pending_firsts(self) -> None:
         """Consume deferred first tokens — one batched device transfer
         per admission call instead of one blocking sync per request —
-        and record time-to-first-token."""
+        and record time-to-first-token.
+
+        With comms attached, an array whose settle-pull op was already
+        dispatched inside the dispatch-ahead window arrived (or is
+        arriving) via an async copy that overlapped device compute:
+        consuming it is not a blocking host round-trip, so
+        ``host_transfers`` counts only the arrays nothing prefetched —
+        the strict decrease the comms bench gates on."""
         if not self._pending_firsts:
             return
         pending, self._pending_firsts = self._pending_firsts, []
-        self.host_transfers += len(pending)
-        self._record_firsts(
-            [(np.asarray(arr), rows) for arr, rows in pending]
-        )
+        comms = self.comms
+        blocking = 0
+        host: list[tuple[np.ndarray, list[int]]] = []
+        for arr, rows in pending:
+            op = self._first_ops.pop(id(arr), None)
+            host.append((np.asarray(arr), rows))
+            if comms is not None and op is not None and op.dispatched:
+                comms.finish(op)
+            else:
+                blocking += 1
+        self.host_transfers += blocking
+        self._record_firsts(host)
 
     def _record_firsts(
         self, pending_host: list[tuple[np.ndarray, list[int]]]
@@ -2264,14 +2428,29 @@ class ContinuousBatcher:
             )
             self.decode_dispatches += 1
             new_block = (tokens, counts, busy)
+        if self.comms is not None:
+            # the dispatch-ahead window: the block dispatched above (or
+            # the one still in flight) occupies the device — start the
+            # queued transfer pulls now so their copies hide behind it
+            self._comms_flush(
+                overlapped=(new_block is not None
+                            or self._pending_block is not None),
+            )
         self._settle_pending_firsts()
         pending, self._pending_block = self._pending_block, new_block
         if pending is not None:
             tokens, counts, dispatched_busy = pending
+            block_op, self._block_op = self._block_op, None
             # ONE host sync for the whole settled block (tokens + counts
             # fetched together), not one per array
             toks_host, counts_host = jax.device_get((tokens, counts))
-            self.host_transfers += 1
+            if (self.comms is not None and block_op is not None
+                    and block_op.dispatched):
+                # the comms flush prefetched this block's arrays while
+                # the next block computed — not a blocking round-trip
+                self.comms.finish(block_op)
+            else:
+                self.host_transfers += 1
             self.block_capacity += self.decode_block * dispatched_busy
             self.block_tokens += int(counts_host.sum())
             for row, slot in enumerate(self.slots):

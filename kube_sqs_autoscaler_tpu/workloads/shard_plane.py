@@ -348,10 +348,14 @@ class ShardedBatcher(ContinuousBatcher):
         first token into its next life."""
         self._check_shard(shard)
         self._invalidate_admission_cache()
+        evac_t0 = (
+            self.lifecycle.now_fn() if self.lifecycle is not None else None
+        )
         self._settle_pending_firsts()
+        from ..obs.lifecycle import request_key
         from .continuous import _Slot
 
-        taken, killed = [], []
+        taken, killed, rids = [], [], []
         for row in self.shard_rows(shard):
             slot = self.slots[row]
             if not self._needs_decode(slot):
@@ -360,17 +364,36 @@ class ShardedBatcher(ContinuousBatcher):
                 (slot.payload, list(slot.produced), slot.budget,
                  slot.submitted_at)
             )
+            rids.append(request_key(slot.payload))
             if self.lifecycle is not None:
                 # the trace survives the evacuation: submit_resume (or
                 # the queue hand-back's redelivery) continues the SAME
                 # chain, this only marks that the request crossed shards
-                from ..obs.lifecycle import request_key
-
-                self.lifecycle.note(request_key(slot.payload),
-                                    "evacuated")
+                self.lifecycle.note(rids[-1], "evacuated")
             self.slots[row] = _Slot()
             killed.append(row)
         self.kill_rows(killed)
+        if killed and self.lifecycle is not None:
+            # the evacuation IS a transfer: the rows' deferred tokens
+            # flushed host-side and their KV abandoned — a paired
+            # transfer window on each evacuated trace, so attribute_slo
+            # can name a transfer-bound request (not just the fleet's
+            # shard-drain instant)
+            done_t = self.lifecycle.now_fn()
+            for rid in rids:
+                if rid is None:
+                    continue
+                self.lifecycle.stamp(rid, "transfer", t=evac_t0)
+                self.lifecycle.stamp(rid, "transfer_done", t=done_t)
+                self.lifecycle.note(rid, "transfer_evacuation_kv")
+        if killed and self.comms is not None and self.comms.enabled:
+            from ..comms.ops import EVACUATION_KV
+
+            self.comms.record(
+                EVACUATION_KV, f"shard:{shard}",
+                nbytes=self._row_kv_nbytes() * len(killed),
+                args={"shard": shard, "rows": len(killed)},
+            )
         return taken
 
     def clear_shard_health(self, shard: int) -> None:
@@ -588,6 +611,13 @@ class ShardedBatcher(ContinuousBatcher):
         # healthy shards' p99 against the no-fault baseline
         self.shard_ttft[row // self.shard_slots].append(ttft)
 
+    def _block_settle_arrays(self):
+        # the gang block's combined settle fetches tokens/counts plus
+        # the [S] free summary and health sentinel — all four prefetch
+        if self._pending_block is None:
+            return None
+        return self._pending_block[:4]
+
     def _step_gang(self) -> list[tuple[Any, np.ndarray]]:
         new_block = None
         busy = sum(s.busy for s in self.slots)
@@ -608,6 +638,16 @@ class ShardedBatcher(ContinuousBatcher):
                 tokens, counts, free, bad, busy,
                 [self.shard_busy(s) for s in range(self.shards)],
             )
+        if self.comms is not None:
+            # the dispatch-ahead window: the gang block above (or the
+            # one still in flight) occupies the devices — start the
+            # queued settle pulls (deferred firsts + the previous
+            # block's arrays, computed a full cycle ago) device-side so
+            # their copies hide behind the new block's compute
+            self._comms_flush(
+                overlapped=(new_block is not None
+                            or self._pending_block is not None),
+            )
         pending_firsts, self._pending_firsts = self._pending_firsts, []
         pending, self._pending_block = self._pending_block, new_block
         # ONE combined host transfer per cycle: deferred first tokens,
@@ -625,10 +665,33 @@ class ShardedBatcher(ContinuousBatcher):
             for row in rows:
                 firsts_by_shard[row // self.shard_slots] += 1
         if firsts_dev or block_dev:
+            block_op, self._block_op = self._block_op, None
+            first_ops = [
+                self._first_ops.pop(id(arr), None) for arr in firsts_dev
+            ]
             firsts_host, block_host = jax.device_get(
                 (firsts_dev, block_dev)
             )
-            self.host_transfers += 1
+            prefetched = [
+                op for op in first_ops
+                if op is not None and op.dispatched
+            ]
+            block_prefetched = (
+                block_op is not None and block_op.dispatched
+            )
+            if self.comms is not None:
+                for op in prefetched:
+                    self.comms.finish(op)
+                if block_prefetched:
+                    self.comms.finish(block_op)
+            if (self.comms is None
+                    or len(prefetched) != len(firsts_dev)
+                    or (block_dev and not block_prefetched)):
+                # at least one fetched array had no prefetch in flight:
+                # this cycle's combined settle blocked.  When the comms
+                # flush covered everything, the copies ran while the new
+                # gang computed and the settle is a non-blocking read.
+                self.host_transfers += 1
             if pending_firsts:
                 self._record_firsts([
                     (vals, rows)

@@ -1208,6 +1208,7 @@ class PrefixPool:
         shards: int = 1,
         family: str = "gpt",
         quantized_kv: bool = False,
+        mesh: Any = None,
     ) -> None:
         if entries < 1:
             raise ValueError(f"entries={entries} must be >= 1")
@@ -1245,6 +1246,22 @@ class PrefixPool:
 
             cache = init_cache(config, shards * entries)
         self.layers = cache["layers"]
+        # mesh-sharded pool rows: heads split over the "model" axis so
+        # the admission insert's entry gather stays device-local per
+        # shard of the mesh (the entry axis itself is replicated —
+        # every device sees every entry index, only the head slices
+        # differ).  Commit the stacked rows under those shardings up
+        # front; the donated install write then preserves them.
+        self.mesh = mesh
+        if mesh is not None:
+            import jax
+
+            self.layers = jax.device_put(
+                self.layers, self.layer_shardings(mesh)
+            )
+        # attach point for a comms CollectiveScheduler: installs are
+        # recorded as PREFIX_INSTALL transfer ops when set
+        self.comms = None
         # key -> local slot, per shard, in LRU order (oldest first)
         self._lru: list[OrderedDict] = [
             OrderedDict() for _ in range(shards)
@@ -1268,6 +1285,24 @@ class PrefixPool:
         self.evictions = 0
         self.events: deque[_PoolEvent] = deque(maxlen=1024)
         self._write_jit = None
+
+    def layer_shardings(self, mesh):
+        """Per-layer NamedShardings for the stacked pool rows: the
+        entry axis replicated, heads over the mesh's ``model`` axis —
+        the same split :func:`planes.mesh.prefix_cache_shardings`-style
+        callers use for the live cache, so the pooled gather composes
+        with ``--model-parallel`` without a resharding hop."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = []
+        for layer in self.layers:
+            row = {}
+            for name, buf in layer.items():
+                spec = (P(None, "model", None, None) if buf.ndim == 4
+                        else P(None, "model", None))
+                row[name] = NamedSharding(mesh, spec)
+            out.append(row)
+        return out
 
     def resident(self, shard: int, key) -> bool:
         """Residency probe for the sticky router — never touches LRU."""
@@ -1316,8 +1351,18 @@ class PrefixPool:
                 return out
 
             self._write_jit = jax.jit(write, donate_argnums=(0,))
+        entry_layers = entry_cache["layers"]
+        if self.mesh is not None:
+            # the one-time prefill runs single-device, so its batch-1
+            # cache is committed to one chip while the donated pool
+            # rows live mesh-sharded — resharding the entry under the
+            # pool's own specs first keeps the donated write's device
+            # sets compatible (and splits the splice per model shard)
+            entry_layers = jax.device_put(
+                entry_layers, self.layer_shardings(self.mesh)
+            )
         self.layers = self._write_jit(
-            self.layers, entry_cache["layers"],
+            self.layers, entry_layers,
             jnp.asarray(index, jnp.int32),
         )
 
@@ -1372,6 +1417,14 @@ class PrefixPool:
             "prefix-install", time.perf_counter(),
             {"shard": shard, "tenant": key[0], "slot": slot},
         ))
+        if self.comms is not None and self.comms.enabled:
+            from ..comms.ops import PREFIX_INSTALL, array_nbytes
+
+            self.comms.record(
+                PREFIX_INSTALL, f"pool:{shard}",
+                nbytes=array_nbytes(entry["layers"]),
+                args={"shard": shard, "slot": slot},
+            )
         return shard * self.entries + slot
 
     def evict_cold(self, keep: int) -> int:

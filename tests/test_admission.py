@@ -1312,6 +1312,20 @@ def test_worker_binary_tenants_demo():
 
 
 @pytest.mark.slow
+def test_worker_binary_prefix_pool_composes_with_model_parallel():
+    # the PR 18 lift: the pooled prefix cache on a tensor-parallel
+    # mesh through the binary (previously a SystemExit; divisibility
+    # is validated at batcher construction instead).  conftest forks 8
+    # host devices, so the mesh is real.
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
+
+    worker_main(["--demo", "4", "--continuous", "--batch-size", "4",
+                 "--seq-len", "12", "--generate-tokens", "3",
+                 "--model-parallel", "2",
+                 "--tenants", "a,b", "--prefix-pool", "4"])
+
+
+@pytest.mark.slow
 def test_fleet_demo_journal_stamps_tenancy_meta(tmp_path):
     from kube_sqs_autoscaler_tpu.workloads.__main__ import main as worker_main
 

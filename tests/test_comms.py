@@ -1,0 +1,452 @@
+"""Scheduled collectives (comms/): typed transfer ops, the
+CollectiveScheduler's coalescing/accounting, the engine seam's
+byte-identity contract (comms off OR merely attached-but-idle must
+change nothing, counters included), and the overlap win (comms on =
+strictly fewer blocking host transfers, same replies).
+"""
+
+import time
+
+import pytest
+
+np = pytest.importorskip("numpy")
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.comms import (  # noqa: E402
+    EVACUATION_KV,
+    HANDOFF_KV,
+    PREFIX_INSTALL,
+    SETTLE_PULL,
+    SIZE_BUCKET_LABELS,
+    SMALL_OP_BYTES,
+    CollectiveScheduler,
+    TransferOp,
+    array_nbytes,
+    settle_pull_op,
+    size_bucket,
+)
+from kube_sqs_autoscaler_tpu.obs.lifecycle import (  # noqa: E402
+    LifecycleRegistry,
+    phase_durations,
+    transfer_spans,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+
+PROMPT, TOKENS, BLOCK = 8, 5, 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=PROMPT + TOKENS, dtype=jnp.float32,
+    )
+    return init_params(jax.random.key(0), config), config
+
+
+def prompts_for(n, seed=7, vocab=64):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, vocab, rng.integers(2, PROMPT + 1))
+        .astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# The op taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_size_buckets_are_total_and_ordered():
+    assert size_bucket(1) == "le4k"
+    assert size_bucket(1 << 12) == "le4k"
+    assert size_bucket((1 << 12) + 1) == "le64k"
+    assert size_bucket(1 << 20) == "le1m"
+    assert size_bucket((1 << 20) + 1) == "gt1m"
+    assert set(SIZE_BUCKET_LABELS) == {"le4k", "le64k", "le1m", "gt1m"}
+
+
+def test_transfer_op_smallness_and_coalesce_key():
+    small = TransferOp(SETTLE_PULL, "host", nbytes=64)
+    big = TransferOp(EVACUATION_KV, "shard:1", nbytes=SMALL_OP_BYTES + 1)
+    assert small.small and not big.small
+    assert small.coalesce_key() == ("host", SETTLE_PULL)
+    assert big.coalesce_key() == ("shard:1", EVACUATION_KV)
+
+
+def test_array_nbytes_walks_nested_structures():
+    a = jnp.zeros((2, 3), jnp.float32)
+    assert array_nbytes(a) == 24
+    assert array_nbytes([{"k": a, "v": a}, {"k": a, "v": a}]) == 96
+
+
+def test_settle_pull_op_dispatch_starts_async_copies():
+    arrays = (jnp.arange(4, dtype=jnp.int32), jnp.ones((2,), jnp.float32))
+    op = settle_pull_op(arrays, rids=("r1",))
+    assert op.kind == SETTLE_PULL
+    assert op.nbytes == 16 + 8
+    assert not op.dispatched
+    op.dispatch()  # must not raise (async copy or no-op fallback)
+    assert np.asarray(arrays[0]).tolist() == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# The scheduler: coalescing, counters, lifecycle stamps
+# ---------------------------------------------------------------------------
+
+
+def test_flush_coalesces_small_same_destination_ops():
+    c = CollectiveScheduler()
+    for _ in range(3):
+        c.submit(TransferOp(SETTLE_PULL, "host", nbytes=128))
+    c.submit(TransferOp(SETTLE_PULL, "host", nbytes=SMALL_OP_BYTES + 1))
+    c.submit(TransferOp(SETTLE_PULL, "shard:1", nbytes=128))
+    n = c.flush(overlapped=True)
+    # 3 small same-dest ops -> ONE dispatch; the large op and the
+    # other-destination op dispatch on their own
+    assert n == 3
+    cc = c.counters()
+    assert cc["transfer_dispatches"] == 3
+    assert cc["dispatched_ops"] == 5
+    assert cc["coalesced_ops"] == 3
+    assert cc["overlapped_transfers_total"] == 5
+    assert cc["transfer_bytes"] == 3 * 128 + SMALL_OP_BYTES + 1 + 128
+    assert cc["pending"] == 0
+
+
+def test_record_counts_one_dispatch_and_stamps_the_trace():
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    c = CollectiveScheduler(lifecycle=reg)
+    t0 = reg.now_fn()
+    op = c.record(EVACUATION_KV, "shard:0", nbytes=2048,
+                  rids=("req-1",), t0=t0)
+    assert op.dispatched and op.finished
+    cc = c.counters()
+    assert cc["transfer_dispatches"] == 1
+    assert cc["by_kind"][EVACUATION_KV] == 1
+    (trace,) = reg.open_traces()
+    (span,) = transfer_spans(trace)
+    assert span[0] == t0 and span[1] >= t0
+
+
+def test_finish_is_idempotent_and_none_safe():
+    c = CollectiveScheduler()
+    c.finish(None)  # no-op
+    op = TransferOp(SETTLE_PULL, "host", nbytes=8)
+    c.submit(op)
+    c.flush()
+    c.finish(op)
+    c.finish(op)
+    assert c.counters()["finished_ops"] == 1
+
+
+def test_disabled_scheduler_declines_settle_pulls():
+    c = CollectiveScheduler(enabled=False)
+    assert c.settle_pull((jnp.zeros((2,), jnp.int32),)) is None
+    assert c.flush() == 0
+    assert c.counters()["transfer_dispatches"] == 0
+
+
+def test_register_flushes_from_the_event_scheduler():
+    from kube_sqs_autoscaler_tpu.core.clock import FakeClock
+    from kube_sqs_autoscaler_tpu.sched import EventScheduler
+
+    c = CollectiveScheduler()
+    c.submit(TransferOp(SETTLE_PULL, "host", nbytes=8))
+    sched = EventScheduler(FakeClock())
+    c.register(sched, period=1.0)
+    sched.run(max_events=1)
+    assert c.counters()["flushes"] >= 1
+    assert c.counters()["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The obs seam: transfer durations, SLO attribution, the trace lane
+# ---------------------------------------------------------------------------
+
+
+def test_phase_durations_gains_a_transfer_axis():
+    reg = LifecycleRegistry(now_fn=lambda: 0.0)
+    reg.stamp("r", "arrival", t=0.0)
+    reg.stamp("r", "prefill", t=1.0)
+    reg.stamp("r", "transfer", t=1.5)
+    reg.stamp("r", "transfer_done", t=1.9)
+    reg.stamp("r", "first_token", t=2.0)
+    (trace,) = reg.open_traces()
+    durations = phase_durations(trace)
+    assert durations["transfer"] == pytest.approx(0.4)
+    assert transfer_spans(trace) == [(1.5, 1.9)]
+
+
+def test_attribute_slo_names_transfer_bound_requests():
+    clock = [0.0]
+    reg = LifecycleRegistry(now_fn=lambda: clock[0])
+    reg.stamp("r", "arrival", t=0.0)
+    reg.stamp("r", "prefill", t=0.1)
+    reg.stamp("r", "first_token", t=0.2)
+    reg.stamp("r", "completed", t=1.0)
+    # the transfer window dwarfs every chained phase: a transfer-bound
+    # request the analyzer must name as such
+    reg.stamp("r", "transfer", t=0.2)
+    reg.stamp("r", "transfer_done", t=5.0)
+    clock[0] = 5.2
+    reg.settle("r")
+    report = reg.attribute_slo(1.0)
+    assert report["dominant"] == "transfer"
+    assert report["by_phase"] == {"transfer": 1}
+
+
+def test_request_trace_exports_transfer_spans_on_their_own_lane():
+    from kube_sqs_autoscaler_tpu.obs.trace import (
+        _REQUEST_LANES,
+        request_trace_events,
+    )
+
+    assert "transfer" in _REQUEST_LANES
+    clock = [0.0]
+    reg = LifecycleRegistry(now_fn=lambda: clock[0])
+    reg.stamp("r", "arrival", t=0.0)
+    reg.stamp("r", "prefill", t=1.0)
+    reg.stamp("r", "first_token", t=1.1)
+    reg.stamp("r", "transfer", t=1.2)
+    reg.stamp("r", "transfer_done", t=1.8)
+    reg.stamp("r", "completed", t=3.0)
+    clock[0] = 3.0
+    reg.settle("r")
+    events = request_trace_events(reg.done_traces(), time_origin=0.0)
+    lanes = {e["tid"]: e for e in events if e.get("ph") == "X"}
+    tid, _ = _REQUEST_LANES["transfer"]
+    xfer = [e for e in events
+            if e.get("ph") == "X" and e["tid"] == tid]
+    assert len(xfer) == 1
+    # absolute-time placement: the span sits INSIDE the decode window
+    decode_tid, _ = _REQUEST_LANES["decode"]
+    (decode,) = [e for e in events
+                 if e.get("ph") == "X" and e["tid"] == decode_tid]
+    assert decode["ts"] <= xfer[0]["ts"]
+    assert (xfer[0]["ts"] + xfer[0]["dur"]
+            <= decode["ts"] + decode["dur"])
+    assert lanes  # at least one span lane rendered
+
+
+# ---------------------------------------------------------------------------
+# The engine seam: byte identity off, strictly fewer blocking syncs on
+# ---------------------------------------------------------------------------
+
+
+def _block_episode(tiny, comms):
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+
+    params, config = tiny
+    b = ContinuousBatcher(params, config, batch_size=2,
+                          prompt_len=PROMPT, generate_tokens=TOKENS,
+                          decode_block=BLOCK)
+    if comms is not None:
+        b.attach_comms(comms)
+    queue = list(enumerate(prompts_for(4)))
+    results = {}
+    for _ in range(300):
+        while queue and b.free_slots:
+            idx, ids = queue.pop(0)
+            b.submit(ids, payload=idx)
+        for idx, toks in b.step():
+            results[idx] = tuple(int(t) for t in toks)
+        if not queue and b.active == 0:
+            break
+    return results, b.host_transfers, b.decode_dispatches
+
+
+def test_block_engine_comms_identical_replies_fewer_blocking_syncs(tiny):
+    r_off, ht_off, dd_off = _block_episode(tiny, None)
+    c = CollectiveScheduler()
+    r_on, ht_on, dd_on = _block_episode(tiny, c)
+    assert r_on == r_off  # exact greedy parity
+    assert dd_on == dd_off  # identical device-dispatch schedule
+    assert ht_on < ht_off  # the overlap win
+    cc = c.counters()
+    assert cc["overlapped_transfers_total"] >= 1
+    assert cc["by_kind"][SETTLE_PULL] >= 1
+    assert cc["pending"] == 0
+
+
+def test_attached_but_disabled_comms_is_byte_identical(tiny):
+    r_off, ht_off, dd_off = _block_episode(tiny, None)
+    c = CollectiveScheduler(enabled=False)
+    r_on, ht_on, dd_on = _block_episode(tiny, c)
+    assert (r_on, ht_on, dd_on) == (r_off, ht_off, dd_off)
+    cc = c.counters()
+    assert cc["transfer_dispatches"] == 0
+    assert cc["submitted_ops"] == 0
+
+
+def _sharded_evac_episode(tiny, comms, lifecycle=None):
+    from kube_sqs_autoscaler_tpu.workloads.shard_plane import (
+        ShardedBatcher,
+    )
+
+    params, config = tiny
+    plane = ShardedBatcher(params, config, shards=2, shard_slots=2,
+                           prompt_len=PROMPT, generate_tokens=TOKENS,
+                           decode_block=BLOCK)
+    if lifecycle is not None:
+        plane.lifecycle = lifecycle
+    if comms is not None:
+        plane.attach_comms(comms)
+    ps = prompts_for(6)
+    queue = [(ids, {"MessageId": f"r{i}"}) for i, ids in enumerate(ps)]
+    results = {}
+
+    def collect(finished):
+        for payload, toks in finished:
+            results[payload["MessageId"]] = tuple(int(t) for t in toks)
+
+    def fill():
+        n = min(len(queue), len(plane.free_slots))
+        if n:
+            plane.submit_many(queue[:n])
+            del queue[:n]
+
+    fill()
+    collect(plane.step())
+    collect(plane.step())
+    evacuated = plane.take_shard_inflight(1)
+    resumes = [
+        (ps[int(payload["MessageId"][1:])], payload, produced, budget, t)
+        for payload, produced, budget, t in evacuated
+    ]
+    for _ in range(400):
+        fill()
+        if resumes and plane.free_slots:
+            admitted = plane.submit_resume(resumes)
+            del resumes[:len(admitted)]
+        collect(plane.step())
+        if not queue and not resumes and plane.active == 0:
+            break
+    return results, plane.host_transfers
+
+
+def test_sharded_evacuation_comms_parity_and_transfer_stamps(tiny):
+    r_off, ht_off = _sharded_evac_episode(tiny, None)
+    assert len(r_off) == 6  # exactly once through the evacuation
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    c = CollectiveScheduler(lifecycle=reg)
+    r_on, ht_on = _sharded_evac_episode(tiny, c, lifecycle=reg)
+    assert r_on == r_off
+    assert ht_on < ht_off
+    cc = c.counters()
+    assert cc["by_kind"][EVACUATION_KV] == 1
+    # the satellite-6 bugfix: evacuation lands as per-request transfer
+    # stamps (so attribute_slo can name transfer-bound requests), not
+    # merely a fleet instant
+    traces = reg.open_traces() + reg.done_traces()
+    evacuated = [t for t in traces
+                 if t.notes.get("transfer_evacuation_kv")]
+    assert evacuated
+    assert all(transfer_spans(t) for t in evacuated)
+
+
+def test_handoff_records_transfer_and_stamps_requests(tiny):
+    from kube_sqs_autoscaler_tpu.planes.engine import DecodePlaneBatcher
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+
+    params, config = tiny
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    c = CollectiveScheduler(lifecycle=reg)
+    donor = ContinuousBatcher(params, config, 2, PROMPT, TOKENS,
+                              decode_block=BLOCK)
+    donor.submit_many([
+        (ids, {"MessageId": f"p{i}"})
+        for i, ids in enumerate(prompts_for(2))
+    ])
+    donor._settle_pending_firsts()
+    records = [
+        (row, slot.payload, list(slot.produced), slot.budget,
+         slot.submitted_at, slot.tenant)
+        for row, slot in enumerate(donor.slots)
+        if slot.busy and slot.produced and not slot.done
+    ]
+    plane = DecodePlaneBatcher(params, config, shards=2, shard_slots=1,
+                               prompt_len=PROMPT,
+                               generate_tokens=TOKENS,
+                               decode_block=BLOCK)
+    plane.lifecycle = reg
+    plane.attach_comms(c)
+    rows = plane.submit_handoff(donor, records)
+    assert len(rows) == len(records) == 2
+    assert c.counters()["by_kind"][HANDOFF_KV] == 1
+    traces = reg.open_traces() + reg.done_traces()
+    stamped = [t for t in traces if transfer_spans(t)]
+    assert len(stamped) == 2
+    assert all(t.notes.get("transfer_handoff_kv") for t in stamped)
+
+
+def test_prefix_pool_install_records_a_transfer(tiny):
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import (
+        PrefixPool,
+        prefix_pool_key,
+    )
+
+    params, config = tiny
+    pool = PrefixPool(params, config, entries=2, prefix_len=4)
+    c = CollectiveScheduler()
+    pool.comms = c
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 64, 4).astype(np.int32)
+    pool.acquire(0, prefix_pool_key("a", ids), ids)
+    assert c.counters()["by_kind"][PREFIX_INSTALL] == 1
+    pool.acquire(0, prefix_pool_key("a", ids), ids)  # hit: no new op
+    assert c.counters()["by_kind"][PREFIX_INSTALL] == 1
+
+
+# ---------------------------------------------------------------------------
+# The comms bench: tier-1 smoke (timing gates off), full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_comms_bench_smoke(tmp_path):
+    import json
+
+    import bench
+
+    out = tmp_path / "BENCH_comms.json"
+    summary = bench.run_comms_suite(str(out), timing_gates=False)
+    assert summary["metric"] == "comms_blocking_transfers_saved"
+    assert summary["value"] > 0
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "comms"
+    evac = artifact["evacuation"]
+    assert evac["comms_on"]["host_transfers"] < (
+        evac["baseline"]["host_transfers"]
+    )
+    assert evac["comms_on"]["tokens"] == evac["baseline"]["tokens"]
+    assert evac["comms_counters"]["overlapped_transfers_total"] >= 1
+    assert evac["overlapping_spans"] >= 1
+    hand = artifact["handoff"]
+    assert hand["comms_on"]["host_transfers"] < (
+        hand["baseline"]["host_transfers"]
+    )
+    assert not artifact["mesh"]["ran"]  # timing battery is slow-tier
+
+
+@pytest.mark.slow
+def test_comms_bench_full_battery(tmp_path):
+    import json
+
+    import bench
+
+    out = tmp_path / "BENCH_comms_full.json"
+    bench.run_comms_suite(str(out))
+    artifact = json.loads(out.read_text())
+    mesh = artifact["mesh"]
+    assert mesh["ran"]
+    rates = [p["tokens_per_second"] for p in mesh["scaling_curve"]]
+    assert rates == sorted(rates)

@@ -1,0 +1,191 @@
+"""Mesh parity: the pooled admission insert and the gang step must be
+byte-identical across mesh sizes 1/2/4 on the forced multi-device CPU
+mesh (conftest forks 8 host devices via
+``--xla_force_host_platform_device_count``).  Skips cleanly when the
+platform could not fork devices.
+"""
+
+import pytest
+
+np = pytest.importorskip("numpy")
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+
+PREFIX, PROMPT, TOKENS, BLOCK = 4, 6, 4, 2
+
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="platform could not fork >= 4 host devices",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=PREFIX + PROMPT + TOKENS, dtype=jnp.float32,
+    )
+    return init_params(jax.random.key(0), config), config
+
+
+def _mesh(n_devices):
+    """A ``(data, seq, model)`` mesh over the first ``n_devices``
+    forked host devices — model axis 2 whenever it fits."""
+    from kube_sqs_autoscaler_tpu.workloads.train import make_mesh
+
+    return make_mesh(
+        devices=jax.devices()[:n_devices],
+        model_parallel=(2 if n_devices >= 2 else 1),
+    )
+
+
+def _pooled_requests(rng_seed=5, n=6):
+    rng = np.random.default_rng(rng_seed)
+    prefix = {
+        "a": rng.integers(1, 64, PREFIX).astype(np.int32),
+        "b": rng.integers(1, 64, PREFIX).astype(np.int32),
+    }
+    reqs = []
+    for i in range(n):
+        tenant = "a" if i % 2 == 0 else "b"
+        prompt = rng.integers(
+            1, 64, rng.integers(2, PROMPT + 1)
+        ).astype(np.int32)
+        reqs.append((tenant, prefix[tenant], prompt, {"MessageId": f"r{i}"}))
+    return reqs
+
+
+def _pooled_episode(tiny, mesh, batch_size):
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    params, config = tiny
+    batcher = ContinuousBatcher(
+        params, config, batch_size=batch_size, prompt_len=PROMPT,
+        generate_tokens=TOKENS, mesh=mesh,
+        tenancy=TenancyConfig(
+            tenants=("a", "b"), prefix_pool=batch_size,
+            prefix_len=PREFIX,
+        ),
+    )
+    queue = _pooled_requests()
+    results = {}
+    for _ in range(300):
+        n = min(len(queue), len(batcher.free_slots))
+        if n:
+            batcher.submit_many_prefixed(queue[:n])
+            del queue[:n]
+        for payload, toks in batcher.step():
+            results[payload["MessageId"]] = tuple(int(t) for t in toks)
+        if not queue and batcher.active == 0:
+            break
+    pool = batcher.prefix_pool
+    return results, pool.installs, pool.hits
+
+
+@needs_devices
+@pytest.mark.parametrize("n_devices", [1, 2, 4])
+def test_pooled_insert_byte_identical_across_mesh_sizes(
+    tiny, n_devices,
+):
+    # reference: the single-chip pooled path (mesh=None) — per-request
+    # greedy outputs are scheduling-independent, so every mesh size
+    # must reproduce them bit for bit, pool odometers included
+    reference, ref_installs, ref_hits = _pooled_episode(tiny, None, 3)
+    mesh = _mesh(n_devices)
+    batch = 3 * mesh.shape["data"]
+    results, installs, hits = _pooled_episode(tiny, mesh, batch)
+    assert results == reference
+    assert (installs, hits) == (ref_installs, ref_hits)
+
+
+def _gang_episode(tiny, mesh):
+    from kube_sqs_autoscaler_tpu.workloads.shard_plane import (
+        ShardedBatcher,
+    )
+
+    params, config = tiny
+    plane = ShardedBatcher(
+        params, config, shards=2, shard_slots=2, prompt_len=PROMPT,
+        generate_tokens=TOKENS, decode_block=BLOCK, mesh=mesh,
+    )
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, 64, rng.integers(2, PROMPT + 1)).astype(np.int32)
+        for _ in range(6)
+    ]
+    queue = [(ids, f"r{i}") for i, ids in enumerate(prompts)]
+    results = {}
+    for _ in range(300):
+        n = min(len(queue), len(plane.free_slots))
+        if n:
+            plane.submit_many(queue[:n])
+            del queue[:n]
+        for payload, toks in plane.step():
+            results[payload] = tuple(int(t) for t in toks)
+        if not queue and plane.active == 0:
+            break
+    return results
+
+
+@needs_devices
+@pytest.mark.parametrize("n_devices", [1, 2, 4])
+def test_gang_step_byte_identical_across_mesh_sizes(tiny, n_devices):
+    reference = _gang_episode(tiny, None)
+    assert len(reference) == 6
+    assert _gang_episode(tiny, _mesh(n_devices)) == reference
+
+
+@needs_devices
+def test_pool_layout_must_divide_the_model_axis(tiny):
+    # heads=3 cannot split over a model axis of 2: startup validation,
+    # not a silent XLA pad-and-reshard on every admission gather
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import TenancyConfig
+
+    config = ModelConfig(
+        vocab_size=64, d_model=33, n_heads=3, n_layers=1, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), config)
+    with pytest.raises(ValueError, match="model axis"):
+        ContinuousBatcher(
+            params, config, batch_size=4, prompt_len=4,
+            generate_tokens=4, mesh=_mesh(2),
+            tenancy=TenancyConfig(
+                tenants=("a",), prefix_pool=4, prefix_len=4,
+            ),
+        )
+
+
+@needs_devices
+def test_mesh_pool_layers_stay_sharded_after_install(tiny):
+    # the donated install write must preserve the pool rows' mesh
+    # placement (a resharding here would put every later gather back
+    # on one chip)
+    from kube_sqs_autoscaler_tpu.workloads.tenancy import (
+        PrefixPool,
+        prefix_pool_key,
+    )
+
+    params, config = tiny
+    mesh = _mesh(2)
+    pool = PrefixPool(
+        params, config, entries=2, prefix_len=PREFIX, mesh=mesh,
+    )
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 64, PREFIX).astype(np.int32)
+    pool.acquire(0, prefix_pool_key("a", ids), ids)
+    expected = pool.layer_shardings(mesh)
+    for layer, specs in zip(pool.layers, expected):
+        for name, buf in layer.items():
+            assert buf.sharding == specs[name], name

@@ -424,6 +424,167 @@ def bench_continuous_speculative(
     }
 
 
+def bench_comms_overlap(
+    requests: int = 16, prompt_len: int = 32, generate_tokens: int = 64,
+    decode_block: int = 4,
+) -> dict:
+    """Serving throughput of the blocked engine with settle pulls left
+    blocking vs routed through the ``comms`` CollectiveScheduler, which
+    starts the device->host copies inside the dispatch-ahead window
+    (while the next block computes).  Greedy, identical outputs by
+    construction; the win is the blocking host syncs that disappear
+    behind decode — on a real TPU tunnel the hidden latency is the
+    device->host hop, so this is the entry to re-measure on the chip."""
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.comms import CollectiveScheduler
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    import jax
+
+    config = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=512,
+    )
+    params = init_params(jax.random.key(0), config)
+    rng = np.random.default_rng(3)
+    reqs = [
+        rng.integers(1, config.vocab_size, prompt_len).astype(np.int32)
+        for _ in range(requests)
+    ]
+
+    def drain(comms):
+        batcher = ContinuousBatcher(
+            params, config, batch_size=4, prompt_len=prompt_len,
+            generate_tokens=generate_tokens, decode_block=decode_block,
+        )
+        if comms is not None:
+            batcher.attach_comms(comms)
+        queue = list(reqs)
+        done = 0
+        start = time.perf_counter()
+        while done < len(reqs):
+            while queue and batcher.free_slots:
+                batcher.submit(queue.pop(0))
+            done += len(batcher.step())
+        return time.perf_counter() - start, batcher.host_transfers
+
+    drain(None)  # compile + warm both programs
+    blocking_s, blocking_syncs = drain(None)
+    comms = CollectiveScheduler()
+    overlapped_s, overlapped_syncs = drain(comms)
+    toks = requests * generate_tokens
+    return {
+        "blocking_tokens_per_sec": toks / blocking_s,
+        "overlapped_tokens_per_sec": toks / overlapped_s,
+        "speedup": blocking_s / overlapped_s,
+        "blocking_host_syncs": blocking_syncs,
+        "overlapped_host_syncs": overlapped_syncs,
+        "overlapped_dispatches": comms.counters()[
+            "overlapped_transfers_total"
+        ],
+        "requests": requests,
+        "generate_tokens": generate_tokens,
+        "decode_block": decode_block,
+    }
+
+
+def bench_comms_handoff(
+    requests: int = 4, prompt_len: int = 256, generate_tokens: int = 16,
+) -> dict:
+    """Admission-to-drain seconds on the decode plane: KV handoff (the
+    ``submit_handoff`` batched gather out of an already-prefilled donor)
+    vs re-prefilling the same prompts from scratch.  The gather moves
+    O(cache bytes) where re-prefill recomputes O(prompt^2) attention
+    FLOPs, so the gap widens with prompt length — the economics that
+    justify a disaggregated prefill plane."""
+    import numpy as np
+
+    import jax
+
+    from kube_sqs_autoscaler_tpu.planes.engine import DecodePlaneBatcher
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousBatcher,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+
+    config = ModelConfig(
+        vocab_size=8192, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+        max_seq_len=prompt_len + generate_tokens,
+    )
+    params = init_params(jax.random.key(0), config)
+    rng = np.random.default_rng(5)
+    prompts = [
+        rng.integers(1, config.vocab_size, prompt_len).astype(np.int32)
+        for _ in range(requests)
+    ]
+
+    def fresh_plane():
+        return DecodePlaneBatcher(
+            params, config, shards=2, shard_slots=2,
+            prompt_len=prompt_len, generate_tokens=generate_tokens,
+            decode_block=4,
+        )
+
+    def drain(plane):
+        done = 0
+        while plane.active:
+            done += len(plane.step())
+        return done
+
+    def reprefill_run():
+        plane = fresh_plane()
+        t0 = time.perf_counter()
+        plane.submit_many([
+            (ids, i) for i, ids in enumerate(prompts)
+        ])
+        drain(plane)
+        return time.perf_counter() - t0
+
+    def handoff_run():
+        # the donor's prefill is NOT timed: in a disaggregated fleet it
+        # already happened on the prefill plane
+        donor = ContinuousBatcher(
+            params, config, requests, prompt_len, generate_tokens,
+            decode_block=1,
+        )
+        donor.submit_many([(ids, i) for i, ids in enumerate(prompts)])
+        donor._settle_pending_firsts()
+        records = [
+            (row, slot.payload, list(slot.produced), slot.budget,
+             slot.submitted_at, slot.tenant)
+            for row, slot in enumerate(donor.slots)
+            if slot.busy and slot.produced and not slot.done
+        ]
+        plane = fresh_plane()
+        t0 = time.perf_counter()
+        plane.submit_handoff(donor, records)
+        drain(plane)
+        return time.perf_counter() - t0
+
+    reprefill_run()  # compile + warm both admission paths
+    handoff_run()
+    reprefill_s = reprefill_run()
+    handoff_s = handoff_run()
+    return {
+        "reprefill_s": reprefill_s,
+        "handoff_gather_s": handoff_s,
+        "speedup": reprefill_s / handoff_s,
+        "requests": requests,
+        "prompt_len": prompt_len,
+        "generate_tokens": generate_tokens,
+    }
+
+
 def bench_kv_cache(num_tokens: int = 64) -> dict:
     """Greedy decode tokens/s: bf16 KV cache vs the int8 cache
     (identical sampling path; decode streams the whole cache every
@@ -588,7 +749,8 @@ def main(argv=None) -> dict:
         + [f"attention_s{s}" for s in ATTN_SEQ_LENS]
         + [f"ring_local_s{s}" for s in (4096, 8192)]
         + ["window_s8192", "speculative", "kv_cache_int8", "weight_int8",
-           "prefix_cache", "continuous_speculative"]
+           "prefix_cache", "continuous_speculative", "comms_overlap",
+           "comms_handoff"]
     )
     if args.only is not None:
         unknown = sorted(set(args.only) - set(known_entries))
@@ -650,6 +812,10 @@ def main(argv=None) -> dict:
         record("prefix_cache", bench_prefix_cache())
     if want("continuous_speculative"):
         record("continuous_speculative", bench_continuous_speculative())
+    if want("comms_overlap"):
+        record("comms_overlap", bench_comms_overlap())
+    if want("comms_handoff"):
+        record("comms_handoff", bench_comms_handoff())
     if args.only is not None:
         for name in ran:
             results[name] = {**results[name], **run_meta}
@@ -708,6 +874,12 @@ def main(argv=None) -> dict:
     if "prefix_cache" in report:
         metrics.append(("prefix_cache_prefill_speedup",
                         report["prefix_cache"]["speedup"], "x"))
+    if "comms_overlap" in report:
+        metrics.append(("comms_overlap_serving_speedup",
+                        report["comms_overlap"]["speedup"], "x"))
+    if "comms_handoff" in report:
+        metrics.append(("comms_handoff_gather_speedup",
+                        report["comms_handoff"]["speedup"], "x"))
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
