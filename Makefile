@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs bench-comms replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs bench-comms bench-admission-scale replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -202,6 +202,20 @@ bench-obs:
 # tokens/s is monotone across shard counts 1/2/4; writes BENCH_r22.json
 bench-comms:
 	python bench.py --suite comms
+
+# Sharded admission plane at 100k-1M zipf tenant populations (CPU JAX,
+# ~a minute): N=4 crash-tolerant admission shards vs the single plane
+# under a coordinated head flood, scored on a virtual-time cost model
+# (engine work charged identically; admission host work serial at N=1
+# vs max-over-shards at N=4); exits 2 unless N=4 beats N=1 on victim
+# TTFT p99 AND tokens/s on every battery scenario, a LOADED shard
+# killed mid-pick loses zero requests / duplicates zero replies and
+# restarts from its tombstone (not cold), >= 1 mid-decode request is
+# shed with an explicit "decode deadline" error reply, and the
+# single-shard no-decode-SLO config stays byte-identical to the PR 11
+# plane; writes BENCH_r23.json
+bench-admission-scale:
+	JAX_PLATFORMS=cpu python bench.py --suite admission-scale
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

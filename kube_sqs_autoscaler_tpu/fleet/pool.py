@@ -430,6 +430,47 @@ class WorkerPool(FleetPoolBase):
         dead after ``hang_grace_cycles`` busy cycles."""
         self._member(index).worker.hang()
 
+    def kill_admission_shard(self, shard: int) -> int:
+        """Deterministic fault injection
+        (``FleetFaultPlan.admission_kills``): kill admission shard
+        ``shard`` on every replica running a sharded admission plane —
+        the staging failure domain, not the engine's.  Staged requests
+        hand back via ``change_message_visibility(0)`` and the shard
+        rehydrates next cycle.  Fails loudly when no replica runs one
+        (a plan that kills nobody would gate nothing).  Returns the
+        total hand-back count."""
+        released, hit = 0, False
+        for replica in self.members:
+            worker = replica.worker
+            if hasattr(getattr(worker, "_fair", None), "kill_shard"):
+                released += worker.kill_admission_shard(shard)
+                hit = True
+        if not hit:
+            raise ValueError(
+                "no replica runs a sharded admission plane "
+                "(tenancy.admission_shards must be >= 2)"
+            )
+        return released
+
+    def partition_admission_shard(
+        self, shard: int, partitioned: bool = True,
+    ) -> None:
+        """Deterministic fault injection
+        (``FleetFaultPlan.admission_partitions``): gossip-partition (or
+        heal) admission shard ``shard`` on every replica running a
+        sharded admission plane."""
+        hit = False
+        for replica in self.members:
+            worker = replica.worker
+            if hasattr(getattr(worker, "_fair", None), "partition_shard"):
+                worker.partition_admission_shard(shard, partitioned)
+                hit = True
+        if not hit:
+            raise ValueError(
+                "no replica runs a sharded admission plane "
+                "(tenancy.admission_shards must be >= 2)"
+            )
+
     def _member(self, index: int) -> Replica:
         for replica in self.members:
             if replica.index == index:

@@ -360,6 +360,21 @@ class ShardedWorkerPool(FleetPoolBase):
         the host still believes it admits."""
         self.worker.batcher.corrupt_active_mask(shard)
 
+    def kill_admission_shard(self, shard: int) -> int:
+        """Chaos seam (``FleetFaultPlan.admission_kills``): kill one
+        ADMISSION shard — staging, not engine, failure domain; staged
+        requests hand back via ``change_message_visibility(0)`` and
+        the shard rehydrates next cycle.  Requires
+        ``tenancy.admission_shards >= 2``."""
+        return self.worker.kill_admission_shard(shard)
+
+    def partition_admission_shard(
+        self, shard: int, partitioned: bool = True,
+    ) -> None:
+        """Chaos seam (``FleetFaultPlan.admission_partitions``):
+        gossip-partition (or heal) one admission shard."""
+        self.worker.partition_admission_shard(shard, partitioned)
+
     @property
     def processed(self) -> int:
         return self.worker.processed

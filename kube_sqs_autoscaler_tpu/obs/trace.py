@@ -247,6 +247,12 @@ def instant_trace_events(
             # their own lane so an operator can line a tokens/s or
             # TTFT inflection up against the knob flip that caused it
             return "knob"
+        if name.startswith("admission-"):
+            # the sharded admission plane (workloads/admission_shards
+            # .py): shard kill / rehydrate instants — their own lane so
+            # staging-plane churn reads separately from engine-shard
+            # chaos
+            return "admission"
         if name.startswith("kv-") or name.startswith("plane-"):
             # the disaggregated planes (planes/pool.py): KV handoff
             # batches and plane-level lifecycle instants — their own

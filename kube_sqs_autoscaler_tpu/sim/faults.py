@@ -265,6 +265,16 @@ class FleetFaultPlan:
     and ``shard_mask_corruptions`` are one-shot ``(cycle, shard)``
     device-mask bit flips (the quarantine path's mask re-assert is
     what heals those).
+
+    Admission-plane faults (ISSUE 19 — the sharded admission front is
+    its own failure domain): ``admission_kills`` are one-shot
+    ``(cycle, admission_shard)`` kills — the shard's staged requests
+    hand back via ``change_message_visibility(0)`` and the shard
+    rehydrates from its tombstone + gossip on the next cycle — and
+    ``admission_partitions`` are ``(start_cycle, end_cycle, shard)``
+    gossip-partition windows validated like ``shard_poisons``: the
+    shard keeps admitting but neither sends nor receives flood
+    classifications until the window heals.
     """
 
     kills: tuple[tuple[int, int], ...] = ()
@@ -272,9 +282,11 @@ class FleetFaultPlan:
     shard_poisons: tuple[tuple[int, int, int], ...] = ()
     shard_wedges: tuple[tuple[int, int, int], ...] = ()
     shard_mask_corruptions: tuple[tuple[int, int], ...] = ()
+    admission_kills: tuple[tuple[int, int], ...] = ()
+    admission_partitions: tuple[tuple[int, int, int], ...] = ()
 
     def __post_init__(self):
-        for name in ("shard_poisons", "shard_wedges"):
+        for name in ("shard_poisons", "shard_wedges", "admission_partitions"):
             for start, end, _ in getattr(self, name):
                 if not start < end:
                     raise ValueError(
@@ -302,6 +314,14 @@ class FleetFaultPlan:
         for at, shard in self.shard_mask_corruptions:
             if at == cycle:
                 pool.corrupt_shard_mask(shard)
+        for at, shard in self.admission_kills:
+            if at == cycle:
+                pool.kill_admission_shard(shard)
+        for start, end, shard in self.admission_partitions:
+            if cycle == start:
+                pool.partition_admission_shard(shard, True)
+            elif cycle == end:
+                pool.partition_admission_shard(shard, False)
 
     def indices(self) -> set[int]:
         """Every replica index the plan touches (for pre-validation)."""
@@ -314,6 +334,12 @@ class FleetFaultPlan:
             | {s for _, _, s in self.shard_wedges}
             | {s for _, s in self.shard_mask_corruptions}
         )
+
+    def admission_shards(self) -> set[int]:
+        """Every admission shard the plan touches (for pre-validation)."""
+        return {s for _, s in self.admission_kills} | {
+            s for _, _, s in self.admission_partitions
+        }
 
 
 # ---------------------------------------------------------------------------

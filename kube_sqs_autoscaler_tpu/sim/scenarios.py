@@ -757,7 +757,7 @@ def coordinated_flood_scenario(
 def zipf_scenario(
     *, tenants: int = 2000, heads: int = 2, head_per_cycle: int = 3,
     victims: int = 2, victim_every: int = 3, slo_s: float = 0.4,
-    s: float = 1.0, cycles: int = 40,
+    s: float = 1.0, cycles: int = 40, tail_keep: int = 5,
 ) -> TenantScenario:
     """Zipf-distributed traffic over a large open tenant population.
 
@@ -768,9 +768,15 @@ def zipf_scenario(
     (they arrive unregistered, weight 1.0, and are pruned when
     drained).  The ``heads`` heaviest ranks send ``head_per_cycle``
     every cycle and are marked as the flood (the attack IS the zipf
-    head); ``victims`` registered SLO tenants trickle throughout."""
+    head); ``victims`` registered SLO tenants trickle throughout.
+    ``tail_keep`` thins the one-shot deep tail to a deterministic
+    1-in-``tail_keep`` (5 = the historical default; the admission-
+    scale battery raises it so a 100k–1M population keeps a few
+    thousand actual senders instead of tens of thousands)."""
     if tenants < heads:
         raise ValueError("tenants must be >= heads")
+    if tail_keep < 1:
+        raise ValueError(f"tail_keep={tail_keep} must be >= 1")
     traffics = []
     for k in range(tenants):
         if k < heads:
@@ -779,10 +785,10 @@ def zipf_scenario(
             ))
             continue
         every = min(cycles, max(1, math.ceil((k + 1) ** s)))
-        if every >= cycles and k % 5:
-            # deep-tail thinning: keep a deterministic 1-in-5 of the
-            # one-shot tail so a multi-thousand-tenant population does
-            # not mean multi-thousand requests all landing at once
+        if every >= cycles and k % tail_keep:
+            # deep-tail thinning: keep a deterministic 1-in-tail_keep
+            # of the one-shot tail so a huge tenant population does
+            # not mean that many requests all landing at once
             continue
         traffics.append(TenantTraffic(
             tenant=f"z{k}", per_cycle=1, every=every,
@@ -860,6 +866,50 @@ def overload_battery(
         coordinated_flood_scenario(floods=pop(4, 4)),
         zipf_scenario(tenants=pop(2000, 40)),
         flash_crowd_scenario(crowd=pop(1600, 30)),
+    ]
+
+
+def admission_scale_scenario(
+    *, tenants: int = 100_000, heads: int = 4, head_per_cycle: int = 4,
+    victims: int = 2, victim_every: int = 2, slo_s: float = 0.4,
+    cycles: int = 32,
+) -> TenantScenario:
+    """The sharded-admission stress shape: a 100k+-tenant zipf
+    population whose COORDINATED head flood hammers the staging
+    plane's O(active tenants) host work while SLO victims trickle —
+    the regime where N admission shards beat one (each shard pays only
+    its slice of the classifier/decay work, and they run
+    concurrently).  The deep tail is thinned to ~``tenants/500``
+    actual one-shot senders (deterministically), so the POPULATION
+    scales to a million without the request count following it."""
+    import dataclasses
+
+    return dataclasses.replace(
+        zipf_scenario(
+            tenants=tenants, heads=heads,
+            head_per_cycle=head_per_cycle, victims=victims,
+            victim_every=victim_every, slo_s=slo_s, cycles=cycles,
+            tail_keep=max(5, tenants // 500),
+        ),
+        name=f"admission-zipf-{tenants // 1000}k",
+    )
+
+
+def admission_scale_battery(
+    *, scale: float = 1.0,
+) -> "list[TenantScenario]":
+    """The 100k–1M zipf battery ``bench.py --suite admission-scale``
+    scores (ROADMAP item 4).  ``scale`` shrinks the tenant POPULATIONS
+    for the tier-1 smoke (1.0 = the full battery); the coordinated
+    head flood's per-cycle intensity is deliberately NOT scaled — a
+    smoke whose flood never pressures the staging plane would gate
+    nothing."""
+    def pop(value: int, floor: int) -> int:
+        return max(floor, int(round(value * scale)))
+
+    return [
+        admission_scale_scenario(tenants=pop(100_000, 1_000)),
+        admission_scale_scenario(tenants=pop(1_000_000, 4_000)),
     ]
 
 

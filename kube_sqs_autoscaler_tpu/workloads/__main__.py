@@ -167,6 +167,32 @@ def main(argv=None) -> None:
              "(0 = off; requires --tenants)",
     )
     parser.add_argument(
+        "--admission-shards", type=int, default=1, metavar="N",
+        help="sharded admission plane: split fair-admission staging "
+             "across N crash-tolerant admission shards — tenants map "
+             "to shards by consistent hash (sticky: a tenant's prefix "
+             "home and DRR state live on ONE shard), each shard runs "
+             "its own DRR/EDF + overload ladder over its slice, "
+             "global fairness reconciles through rate-bounded "
+             "cross-shard credit borrowing, and flood classifications "
+             "gossip between shards (journaled as kind='admission' "
+             "lines when --journal-path is set); a killed shard hands "
+             "its staged requests back to the queue and rehydrates "
+             "its deficit/flood state next cycle (1 = the single "
+             "staging plane, byte-identical; requires --tenants)",
+    )
+    parser.add_argument(
+        "--decode-slo-budget", type=float, default=0.0,
+        metavar="SECONDS",
+        help="decode-phase deadline enforcement: once a request has "
+             "its first token it must sustain this many seconds per "
+             "remaining generated token or be shed MID-decode with an "
+             "explicit error reply — deadlines extended past TTFT "
+             "into decode; exported as "
+             "requests_shed_total{reason='decode_deadline'} "
+             "(0 = off; requires --tenants)",
+    )
+    parser.add_argument(
         "--prefix-pool", type=int, default=0, metavar="N",
         help="per-tenant prefix-cache pool: keep N resident prefix "
              "entries per shard with LRU eviction — a tenant's shared "
@@ -450,6 +476,16 @@ def main(argv=None) -> None:
                 f"--shed-tiers {args.shed_tiers} must be in [0, 3] "
                 "(0 = off)"
             )
+        if args.admission_shards < 1:
+            raise SystemExit(
+                f"--admission-shards {args.admission_shards} must be "
+                ">= 1 (1 = the single staging plane)"
+            )
+        if args.decode_slo_budget < 0:
+            raise SystemExit(
+                f"--decode-slo-budget {args.decode_slo_budget} must be "
+                ">= 0 (0 = off)"
+            )
         if args.prefix_pool < 0:
             raise SystemExit(
                 f"--prefix-pool {args.prefix_pool} must be >= 0 (0 = off)"
@@ -479,6 +515,8 @@ def main(argv=None) -> None:
                 ttft_slo_s=slos,
                 urgency_window_s=args.urgency_window,
                 shed_tiers=args.shed_tiers,
+                admission_shards=args.admission_shards,
+                decode_slo_s=args.decode_slo_budget,
             )
         except ValueError as err:
             # weight/SLO/tenant count mismatches, non-positive weights,
@@ -493,6 +531,10 @@ def main(argv=None) -> None:
         raise SystemExit("--urgency-window requires --tenants")
     elif args.shed_tiers:
         raise SystemExit("--shed-tiers requires --tenants")
+    elif args.admission_shards != 1:
+        raise SystemExit("--admission-shards requires --tenants")
+    elif args.decode_slo_budget:
+        raise SystemExit("--decode-slo-budget requires --tenants")
     elif args.prefix_pool:
         raise SystemExit("--prefix-pool requires --tenants")
     if args.journal_path and not args.fleet_max_replicas:
@@ -1093,6 +1135,13 @@ def main(argv=None) -> None:
                     args.journal_path,
                     meta=_fleet_journal_meta(args, tenancy, knob_names),
                 )
+                # sharded admission plane: gossip / kill / rehydrate
+                # transitions ride the same journal as kind="admission"
+                # lines (PR 13 machinery; lenient readers skip them)
+                for replica in pool.members:
+                    fair = getattr(replica.worker, "_fair", None)
+                    if hasattr(fair, "attach_journal"):
+                        fair.attach_journal(journal)
             metrics = None
             obs_server = None
             if args.metrics_port:
@@ -1345,6 +1394,8 @@ def _fleet_journal_meta(args, tenancy, knob_names=()) -> dict:
                 "urgency_window_s": tenancy.urgency_window_s,
                 "urgency_budget": tenancy.urgency_budget,
                 "shed_tiers": tenancy.shed_tiers,
+                "admission_shards": tenancy.admission_shards,
+                "decode_slo_s": tenancy.decode_slo_s,
             }
             if tenancy is not None
             else {}
@@ -1375,6 +1426,8 @@ def _maybe_serve_metrics(port: int, worker, tenancy=None):
             urgency_window=tenancy.urgency_window_s,
             shed_tiers=tenancy.shed_tiers,
             prefix_pool=tenancy.prefix_pool,
+            admission_shards=tenancy.admission_shards,
+            decode_slo_budget=tenancy.decode_slo_s,
         )
     if hasattr(worker, "attach_metrics"):
         worker.attach_metrics(metrics)

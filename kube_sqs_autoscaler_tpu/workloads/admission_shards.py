@@ -1,0 +1,728 @@
+"""The sharded admission plane: N crash-tolerant admission workers.
+
+A million tenants break the single staging plane before they break the
+fleet (ROADMAP item 4): every refill cycle the lone
+:class:`~.tenancy.FairAdmission` pays O(active tenants) of serial host
+work — rate decays, flood scans, DRR registration churn — and that one
+instance is also the last unreplicated failure domain in the stack.
+This module splits it MQFQ-Sticky-style (PAPERS.md):
+
+- :class:`HashRing` — consistent hashing with virtual nodes (crc32,
+  process-stable like :func:`~.tenancy.prefix_pool_key`) maps tenants
+  to shards; changing N moves only ~1/N of the population, and the
+  sticky home map pins a tenant where it first staged so its prefix
+  home and DRR state live on ONE shard across restarts;
+- :class:`AdmissionShard` — one slice of the plane: its own
+  :class:`~.tenancy.FairAdmission` (DRR + EDF + flood classifier) over
+  its tenant slice and, when ``shed_tiers`` asks for one, its own
+  :class:`~.tenancy.OverloadLadder` — one shard's overload engages
+  tier actions for ITS tenants without degrading anyone else's;
+- :class:`AdmissionCoordinator` — global fairness across shards the
+  way DRR credits already work: each busy shard earns pick credit
+  proportional to its staged tenants' weight, banks per-busy-period
+  debt (reset on idle, like DRR's reset-on-empty), and may go
+  work-conservingly beyond its share only through a rate-bounded
+  borrow bucket — so one shard's flood cannot starve another shard's
+  victims by more than a bounded, refunded debt;
+- :class:`ShardedAdmission` — the facade the worker talks to.  It
+  duck-types ``FairAdmission``'s whole surface (``note_cycle`` /
+  ``room`` / ``stage`` / ``pick`` / ``over_share`` / ``.drr`` / the
+  durable-state pair), so ``ContinuousWorker`` and the fleet's
+  snapshot machinery run unchanged; ``admission_shards=1`` never
+  constructs this module at all — the single plane stays byte-
+  identical.
+
+Crash tolerance: :meth:`ShardedAdmission.kill_shard` tombstones the
+shard's deficit/credit/flood state, hands every staged request back to
+the queue via the worker's ``change_message_visibility(0)`` callback
+(at-least-once: redelivered, never lost; the pool reply registry still
+dedups, so exactly-once holds end to end), and the next
+:meth:`~ShardedAdmission.note_cycle` rehydrates the shard from its
+tombstone plus peer gossip — NOT cold.  What rehydration does NOT
+re-drive: staged message contents (live receipt handles die with the
+shard; the queue redelivers them) and already-picked requests (they
+are the engine's in-flight work, not staging's).
+
+Flood classifications GOSSIP between shards each ladder cycle
+(:meth:`ShardedAdmission.gossip`): a coalition classified on its home
+shard stays classified when a kill fails its tenants over to a peer,
+and every newly shared classification is journaled as a
+``kind="admission"`` line on the PR 13 tick journal so operators (and
+the restart path) can replay who knew what, when.  A PARTITIONED shard
+(chaos seam, :class:`~..sim.faults.FleetFaultPlan`
+``admission_partitions``) keeps admitting its slice but neither sends
+nor receives gossip until the window heals.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any
+
+from .tenancy import (
+    FairAdmission,
+    OverloadLadder,
+    TenancyConfig,
+    _PoolEvent,
+)
+
+
+class HashRing:
+    """Consistent tenant→shard hashing with virtual nodes.
+
+    crc32-based (Python's ``hash`` is salted; the mapping must be
+    stable across processes so a restarted plane routes every tenant
+    to the same home).  ``vnodes`` virtual points per shard smooth the
+    arc lengths, so growing N by one moves ~1/(N+1) of tenants — the
+    property the hash-stability test pins."""
+
+    #: virtual points per shard — enough to keep arc-length variance
+    #: low at small N without making ring construction noticeable
+    VNODES = 64
+
+    def __init__(self, shards: int, vnodes: int = VNODES) -> None:
+        if shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        self.shards = shards
+        points = []
+        for shard in range(shards):
+            for v in range(vnodes):
+                key = f"admission-shard:{shard}:{v}".encode()
+                points.append((zlib.crc32(key), shard))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [s for _, s in points]
+
+    def shard_of(self, tenant: str, alive=None) -> int:
+        """The tenant's home shard; ``alive`` (a set of shard indices,
+        or None = all) walks the ring past dead owners so a killed
+        shard's tenants fail over deterministically to the next alive
+        point instead of erroring."""
+        h = zlib.crc32(str(tenant).encode())
+        start = bisect.bisect_right(self._hashes, h)
+        n = len(self._owners)
+        for step in range(n):
+            owner = self._owners[(start + step) % n]
+            if alive is None or owner in alive:
+                return owner
+        raise ValueError("no alive admission shard to route to")
+
+
+class AdmissionShard:
+    """One slice of the sharded plane: its own staging, classifier,
+    and (optionally) overload ladder, plus the liveness flags the
+    chaos seams flip."""
+
+    def __init__(
+        self, index: int, tenancy: TenancyConfig, *,
+        per_tenant_limit: int, total_limit: int,
+    ) -> None:
+        self.index = index
+        self.tenancy = tenancy
+        self.per_tenant_limit = per_tenant_limit
+        self.total_limit = total_limit
+        self.fair = FairAdmission(
+            tenancy,
+            per_tenant_limit=per_tenant_limit,
+            total_limit=total_limit,
+        )
+        self.ladder = (
+            OverloadLadder(tenancy.shed_tiers)
+            if tenancy.shed_tiers > 0 else None
+        )
+        self.alive = True
+        self.partitioned = False
+        self.kills = 0
+        self.rehydrations = 0
+        #: records recovered by the LAST rehydration (the chaos gate's
+        #: "rehydrated, not cold" evidence)
+        self.rehydrated_records = 0
+        #: exported state captured at kill time, consumed at restart
+        self.tombstone: "dict | None" = None
+
+    def _fresh_fair(self) -> FairAdmission:
+        return FairAdmission(
+            self.tenancy,
+            per_tenant_limit=self.per_tenant_limit,
+            total_limit=self.total_limit,
+        )
+
+
+class AdmissionCoordinator:
+    """Global fairness across admission shards, DRR-style.
+
+    Each pick cycle every BUSY shard (staged work > 0) earns credit
+    proportional to its staged tenants' configured weight; a shard
+    spends one credit per picked request.  Credit banks only within a
+    busy period — an idle shard's balance resets to zero, the exact
+    reset-on-empty rule that bounds DRR deficits — so no shard can
+    hoard entitlement while idle and then burst past everyone.
+
+    The work-conserving pass then hands LEFTOVER capacity (credit the
+    entitled shards could not use) to shards with remaining demand, in
+    rotating-cursor order, but each extra grant costs a token from
+    that shard's rate-bounded borrow bucket (refilled
+    :data:`BORROW_REFILL` per cycle, capped at :data:`BORROW_CAP`) and
+    is charged as negative credit — debt the shard repays out of its
+    future earnings.  The invariant the property tests pin: no
+    shard's debt ever exceeds ``BORROW_CAP``, so the total share a
+    flooded shard can take from its peers over any window is their
+    proportional entitlement plus a constant — a flood cannot starve
+    another shard's victims, only briefly borrow from them."""
+
+    #: borrow tokens refilled per cycle (the cross-shard borrow RATE)
+    BORROW_REFILL = 1.0
+    #: max banked borrow tokens — and the per-shard debt bound
+    BORROW_CAP = 4.0
+
+    def __init__(self, shards: int) -> None:
+        if shards < 1:
+            raise ValueError(f"shards={shards} must be >= 1")
+        self.shards = shards
+        self._credit = [0.0] * shards
+        self._borrow = [self.BORROW_CAP] * shards
+        self._cursor = 0
+        self.borrows_total = 0
+
+    def debt(self, shard: int) -> float:
+        """How far ``shard`` has picked beyond its earned share this
+        busy period (>= 0; the invariant bounds it by BORROW_CAP)."""
+        return max(0.0, -self._credit[shard])
+
+    def allocate(
+        self, k: int, demands, weights,
+    ) -> "list[int]":
+        """Split ``k`` pick slots across shards given per-shard staged
+        ``demands`` and active staged ``weights``; returns per-shard
+        grants summing to at most ``min(k, sum(demands))``."""
+        n = self.shards
+        grants = [0] * n
+        busy = [s for s in range(n) if demands[s] > 0]
+        for s in range(n):
+            self._borrow[s] = min(
+                self.BORROW_CAP, self._borrow[s] + self.BORROW_REFILL
+            )
+            if demands[s] <= 0:
+                # busy period over: entitlement does not bank across
+                # idle gaps (reset-on-empty), and neither does debt —
+                # the backlog that owed it is gone
+                self._credit[s] = 0.0
+        if not busy or k <= 0:
+            return grants
+        wtotal = sum(max(0.0, weights[s]) for s in busy) or float(len(busy))
+        remaining = k
+        for s in busy:
+            share = (max(0.0, weights[s]) or 1.0) / wtotal
+            self._credit[s] += k * share
+            # banked credit from under-granted cycles can exceed this
+            # cycle's slice: cap at what is left of k so the plane
+            # never picks past the engine's free slots (the surplus
+            # stays banked for the next cycle)
+            grant = min(demands[s], int(self._credit[s]), remaining)
+            if grant > 0:
+                grants[s] = grant
+                self._credit[s] -= grant
+                remaining -= grant
+        # work conservation: leftover capacity (fractional credits,
+        # idle entitlement) goes to shards that still have demand —
+        # rate-bounded, charged as debt
+        leftover = k - sum(grants)
+        spin = 0
+        while leftover > 0 and spin < 2 * len(busy):
+            s = busy[self._cursor % len(busy)]
+            self._cursor += 1
+            spin += 1
+            if demands[s] - grants[s] <= 0 or self._borrow[s] < 1.0:
+                continue
+            self._borrow[s] -= 1.0
+            self._credit[s] -= 1.0
+            if self._credit[s] < -self.BORROW_CAP:
+                # the debt bound is an invariant, not a hope: clamp so
+                # arithmetic drift can never widen what a borrow
+                # bucket's worth of tokens allows
+                self._credit[s] = -self.BORROW_CAP
+            grants[s] += 1
+            self.borrows_total += 1
+            leftover -= 1
+            spin = 0
+        return grants
+
+    def export_state(self) -> dict:
+        return {
+            "records": self.shards,
+            "credit": list(self._credit),
+            "borrow": list(self._borrow),
+            "cursor": self._cursor,
+            "borrows_total": self.borrows_total,
+        }
+
+    def import_state(self, state: dict) -> int:
+        recovered = 0
+        for name, default in (("credit", 0.0), ("borrow", self.BORROW_CAP)):
+            values = state.get(name)
+            if not isinstance(values, (list, tuple)):
+                continue
+            dest = self._credit if name == "credit" else self._borrow
+            for s, value in enumerate(values[: self.shards]):
+                try:
+                    dest[s] = float(value)
+                except (TypeError, ValueError):
+                    dest[s] = default
+                recovered += 1
+        self._cursor = int(state.get("cursor", 0) or 0)
+        self.borrows_total = int(state.get("borrows_total", 0) or 0)
+        return recovered
+
+
+class _ShardedDrr:
+    """The ``.drr`` facade: ContinuousWorker reaches into
+    ``_fair.drr`` for push/refund (the no-nack fallback and the
+    expired-pick refund) and the shed loops reach it per shard — every
+    call here routes by the tenant's home so the charge lands on the
+    scheduler that staged the request."""
+
+    def __init__(self, plane: "ShardedAdmission") -> None:
+        self._plane = plane
+
+    def _drr_of(self, tenant: str):
+        return self._plane.shard_of(tenant).fair.drr
+
+    def push(self, tenant: str, item: Any,
+             deadline: "float | None" = None) -> None:
+        self._drr_of(tenant).push(tenant, item, deadline=deadline)
+
+    def refund(self, tenant: str, item: Any = None) -> None:
+        self._drr_of(tenant).refund(tenant, item)
+
+    def depth(self, tenant: str) -> int:
+        return self._drr_of(tenant).depth(tenant)
+
+    def depths(self) -> dict:
+        merged: dict[str, int] = {}
+        for shard in self._plane.shards:
+            for tenant, depth in shard.fair.drr.depths().items():
+                merged[tenant] = merged.get(tenant, 0) + depth
+        return merged
+
+    def pop_over_deadline(
+        self, now: float, eligible=None,
+    ) -> "tuple[str, Any] | None":
+        for shard in self._plane.shards:
+            if not shard.alive:
+                continue
+            popped = shard.fair.drr.pop_over_deadline(
+                now, eligible=eligible
+            )
+            if popped is not None:
+                return popped
+        return None
+
+    def pop_tail(self, tenant: str) -> "Any | None":
+        return self._drr_of(tenant).pop_tail(tenant)
+
+    @property
+    def staged(self) -> int:
+        return sum(s.fair.drr.staged for s in self._plane.shards)
+
+    @property
+    def urgent_picks(self) -> int:
+        return sum(s.fair.drr.urgent_picks for s in self._plane.shards)
+
+
+class ShardedAdmission:
+    """N :class:`AdmissionShard`s behind one ``FairAdmission``-shaped
+    facade (see the module docstring for the architecture)."""
+
+    def __init__(
+        self, tenancy: TenancyConfig, *,
+        per_tenant_limit: int, total_limit: int,
+    ) -> None:
+        n = tenancy.admission_shards
+        if n < 2:
+            raise ValueError(
+                "ShardedAdmission needs admission_shards >= 2; the "
+                "single plane is plain FairAdmission (byte-identical)"
+            )
+        if per_tenant_limit < 1 or total_limit < 1:
+            raise ValueError("staging limits must be >= 1")
+        self.tenancy = tenancy
+        self.per_tenant_limit = per_tenant_limit
+        # the GLOBAL staging bound is unchanged by sharding; each shard
+        # owns an equal slice (ceil so N never rounds capacity to 0)
+        self.total_limit = total_limit
+        per_shard = max(2, -(-total_limit // n))
+        self.ring = HashRing(n)
+        self.shards = [
+            AdmissionShard(
+                i, tenancy,
+                per_tenant_limit=per_tenant_limit,
+                total_limit=per_shard,
+            )
+            for i in range(n)
+        ]
+        self.coordinator = AdmissionCoordinator(n)
+        self.drr = _ShardedDrr(self)
+        # sticky home map: tenant -> shard, pinned at first stage and
+        # exported with the durable state so a rehydrated plane keeps
+        # every tenant's home (ring changes move only unpinned tenants)
+        self._homes: OrderedDict = OrderedDict()
+        self.HOME_LIMIT = 8192
+        # worker-incremented, like FairAdmission's (the facade keeps
+        # the counter global: one backpressure series, not N)
+        self.overflow_total = 0
+        self._lifecycle = None
+        self._journal = None
+        # classifications already gossiped (so each union member
+        # journals once, not once per cycle)
+        self._gossiped: set[str] = set()
+        # admission-kill / admission-rehydrate instants for the merged
+        # Chrome-trace timeline (same shape as PrefixPool/ladder events)
+        from collections import deque
+
+        self.events = deque(maxlen=1024)
+
+    # -- constants the worker's shed loop reads off its `fair` handle --
+    PREMIUM_FLOOD_FACTOR = FairAdmission.PREMIUM_FLOOD_FACTOR
+    OVER_SHARE_MIN_RATE = FairAdmission.OVER_SHARE_MIN_RATE
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def _alive(self) -> set:
+        return {s.index for s in self.shards if s.alive}
+
+    def shard_of(self, tenant: str) -> AdmissionShard:
+        """The tenant's current shard: its sticky home when that shard
+        is alive, else the ring walked past dead owners (failover is
+        deterministic, and the home re-pins once it lands)."""
+        alive = self._alive()
+        home = self._homes.get(tenant)
+        if home is not None and home in alive:
+            self._homes.move_to_end(tenant)
+            return self.shards[home]
+        owner = self.ring.shard_of(tenant, alive=alive or None)
+        self._homes[tenant] = owner
+        self._homes.move_to_end(tenant)
+        while len(self._homes) > self.HOME_LIMIT:
+            self._homes.popitem(last=False)
+        return self.shards[owner]
+
+    # ------------------------------------------------------------------
+    # the FairAdmission facade surface
+    # ------------------------------------------------------------------
+
+    @property
+    def lifecycle(self):
+        return self._lifecycle
+
+    @lifecycle.setter
+    def lifecycle(self, registry) -> None:
+        self._lifecycle = registry
+        for shard in self.shards:
+            shard.fair.lifecycle = registry
+
+    @property
+    def staged(self) -> int:
+        return sum(s.fair.staged for s in self.shards)
+
+    @property
+    def room(self) -> int:
+        """Receive sizing: alive shards' remaining slices (a full or
+        dead shard contributes nothing — its tenants' messages bounce
+        through the stage() → hand-back path, backpressure not loss)."""
+        return sum(
+            max(0, s.total_limit - s.fair.staged)
+            for s in self.shards if s.alive
+        )
+
+    @property
+    def arrival_rate(self) -> dict:
+        """Merged per-tenant offered rates (introspection + the shed
+        loop's premium bar; each shard still classifies on its own)."""
+        merged: dict[str, float] = {}
+        for shard in self.shards:
+            for tenant, rate in shard.fair.arrival_rate.items():
+                merged[tenant] = merged.get(tenant, 0.0) + rate
+        return merged
+
+    @property
+    def host_ops(self) -> int:
+        """Total serial host work across shards (the N=1-equivalent
+        cost; the bench charges the MAX over shards instead — see
+        :meth:`host_ops_by_shard`)."""
+        return sum(s.fair.host_ops for s in self.shards)
+
+    def host_ops_by_shard(self) -> "tuple[int, ...]":
+        """Per-shard host-op counters: the admission-scale bench's
+        virtual clock charges max-over-shards of the per-cycle deltas
+        (shards run concurrently; the slowest one bounds the cycle)."""
+        return tuple(s.fair.host_ops for s in self.shards)
+
+    def note_cycle(self) -> None:
+        """One refill cycle: restart any killed shard (the plane's
+        supervisor restarts an admission worker within a cycle — the
+        rehydration path, not a cold start), then decay every alive
+        shard's classifier."""
+        for shard in self.shards:
+            if not shard.alive:
+                self.restart_shard(shard.index)
+        for shard in self.shards:
+            if shard.alive:
+                shard.fair.note_cycle()
+
+    def stage(self, tenant: str, item: Any,
+              deadline: "float | None" = None,
+              message_id: "str | None" = None) -> bool:
+        shard = self.shard_of(tenant)
+        return shard.fair.stage(
+            tenant, item, deadline=deadline, message_id=message_id
+        )
+
+    def pick(self, k: int,
+             now: "float | None" = None) -> "list[tuple[str, Any]]":
+        """This cycle's admission batch: the coordinator splits ``k``
+        across shards by earned credit (plus bounded borrowing), each
+        shard's own DRR/EDF picks its grant."""
+        shards = self.shards
+        demands = [
+            s.fair.staged if s.alive else 0 for s in shards
+        ]
+        weights = []
+        for s in shards:
+            if not s.alive or s.fair.staged == 0:
+                weights.append(0.0)
+                continue
+            weights.append(sum(
+                self.tenancy.weight_of(t)
+                for t, d in s.fair.drr.depths().items() if d > 0
+            ))
+        grants = self.coordinator.allocate(k, demands, weights)
+        picked: list = []
+        for shard, grant in zip(shards, grants):
+            if grant > 0:
+                picked += shard.fair.pick(grant, now=now)
+        return picked
+
+    def over_share(self) -> frozenset:
+        """The union flood set across alive shards, after a gossip
+        exchange — a coalition classified anywhere is degraded
+        everywhere (except across a partition)."""
+        self.gossip()
+        flood: set = set()
+        for shard in self.shards:
+            if shard.alive:
+                flood |= set(shard.fair.over_share())
+        return frozenset(flood)
+
+    def depths(self) -> dict:
+        depths = {t: 0 for t in self.tenancy.tenants}
+        for tenant, depth in self.drr.depths().items():
+            depths[tenant] = depths.get(tenant, 0) + depth
+        return depths
+
+    # ------------------------------------------------------------------
+    # gossip
+    # ------------------------------------------------------------------
+
+    def attach_journal(self, journal) -> None:
+        """Record gossip and kill/rehydrate transitions as
+        ``kind="admission"`` lines on a :class:`~..obs.TickJournal`
+        (None detaches; journaling is observability + replay, never
+        load-bearing for the exchange itself)."""
+        self._journal = journal
+
+    def _journal_event(self, payload: dict) -> None:
+        if self._journal is None:
+            return
+        try:
+            self._journal.append_event("admission", payload)
+        except (OSError, ValueError):  # crash-safe: gossip never dies
+            pass
+
+    def gossip(self) -> None:
+        """Exchange flood classifications between alive, un-partitioned
+        shards: every peer adopts the union (sticky, grace-armed), and
+        each classification is journaled ONCE when it first spreads."""
+        connected = [
+            s for s in self.shards if s.alive and not s.partitioned
+        ]
+        if len(connected) < 2:
+            return
+        union: set = set()
+        for shard in connected:
+            union |= shard.fair._flood_sticky
+        if not union:
+            return
+        for shard in connected:
+            shard.fair.adopt_flood(union)
+        fresh = union - self._gossiped
+        if fresh:
+            self._gossiped |= fresh
+            self._journal_event({
+                "event": "gossip",
+                "flood": sorted(fresh),
+                "shards": [s.index for s in connected],
+            })
+
+    # ------------------------------------------------------------------
+    # chaos seams (FleetFaultPlan admission_kills / admission_partitions)
+    # ------------------------------------------------------------------
+
+    def kill_shard(self, shard: int, handback=None) -> int:
+        """Kill one admission shard: tombstone its durable accounting,
+        hand every staged request back to the queue through
+        ``handback(message)`` (the worker wires
+        ``change_message_visibility(0)``), and mark it dead until
+        :meth:`restart_shard` / the next cycle's auto-restart.  Returns
+        the number of staged requests handed back."""
+        target = self.shards[shard]
+        if not target.alive:
+            return 0
+        target.tombstone = target.fair.export_state()
+        released = 0
+        drr = target.fair.drr
+        for tenant in list(drr.depths()):
+            while True:
+                item = drr.pop_tail(tenant)
+                if item is None:
+                    break
+                released += 1
+                if handback is not None:
+                    # back through the queue: redelivers immediately,
+                    # re-stages on a surviving shard next cycle — the
+                    # reply registry dedups any copy racing this
+                    handback(item[3])
+        target.fair = target._fresh_fair()
+        target.fair.lifecycle = self._lifecycle
+        target.alive = False
+        target.kills += 1
+        self.events.append(_PoolEvent(
+            "admission-kill", time.perf_counter(),
+            {"shard": shard, "handed_back": released},
+        ))
+        self._journal_event({
+            "event": "kill", "shard": shard, "handed_back": released,
+        })
+        return released
+
+    def restart_shard(self, shard: int) -> int:
+        """Restart a killed shard: rehydrate deficit/credit/flood
+        accounting from its tombstone, then adopt the peers' current
+        flood gossip — the shard comes back knowing what the plane
+        knew, not cold.  Returns the number of records recovered."""
+        target = self.shards[shard]
+        if target.alive:
+            return 0
+        recovered = 0
+        if target.tombstone is not None:
+            recovered = target.fair.import_state(target.tombstone)
+            target.tombstone = None
+        target.alive = True
+        target.partitioned = False
+        peers_flood: set = set()
+        for peer in self.shards:
+            if peer.alive and not peer.partitioned and peer is not target:
+                peers_flood |= peer.fair._flood_sticky
+        if peers_flood:
+            target.fair.adopt_flood(peers_flood)
+        target.rehydrations += 1
+        target.rehydrated_records = recovered
+        self.events.append(_PoolEvent(
+            "admission-rehydrate", time.perf_counter(),
+            {"shard": shard, "records": recovered},
+        ))
+        self._journal_event({
+            "event": "rehydrate", "shard": shard, "records": recovered,
+        })
+        return recovered
+
+    def partition_shard(self, shard: int, partitioned: bool = True) -> None:
+        """Flip one shard's gossip partition: it keeps admitting its
+        slice but is excluded from the exchange both ways."""
+        self.shards[shard].partitioned = bool(partitioned)
+        self._journal_event({
+            "event": "partition" if partitioned else "heal",
+            "shard": shard,
+        })
+
+    def trace_events(self, origin: float) -> list:
+        """Kill/rehydrate instants for the merged Chrome-trace
+        timeline (same contract as the ladder's)."""
+        from ..obs.trace import instant_trace_events
+
+        return instant_trace_events(self.events, origin)
+
+    # ------------------------------------------------------------------
+    # durable-state surface: slots into ContinuousWorker's existing
+    # export_admission_state "fair" key unchanged
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        shards = []
+        for shard in self.shards:
+            entry = {
+                "fair": shard.fair.export_state(),
+                "alive": shard.alive,
+                "kills": shard.kills,
+                "rehydrations": shard.rehydrations,
+            }
+            if shard.ladder is not None:
+                entry["ladder"] = shard.ladder.export_state()
+            shards.append(entry)
+        state = {
+            "sharded": True,
+            "shards": shards,
+            "coordinator": self.coordinator.export_state(),
+            "homes": [
+                [tenant, int(shard)]
+                for tenant, shard in self._homes.items()
+            ],
+            "overflow_total": self.overflow_total,
+        }
+        state["records"] = (
+            sum(e["fair"].get("records", 0) for e in shards)
+            + len(self._homes)
+        )
+        return state
+
+    def import_state(
+        self, state: dict, *, rebase: float = 0.0,
+        now: "float | None" = None, max_age_s: float = 0.0,
+    ) -> int:
+        recovered = 0
+        entries = state.get("shards") or ()
+        for shard, entry in zip(self.shards, entries):
+            if not isinstance(entry, dict):
+                continue
+            fair = entry.get("fair")
+            if isinstance(fair, dict):
+                recovered += shard.fair.import_state(
+                    fair, rebase=rebase, now=now, max_age_s=max_age_s
+                )
+            ladder = entry.get("ladder")
+            if shard.ladder is not None and isinstance(ladder, dict):
+                recovered += shard.ladder.import_state(ladder)
+            shard.kills = int(entry.get("kills", 0) or 0)
+            shard.rehydrations = int(entry.get("rehydrations", 0) or 0)
+        coordinator = state.get("coordinator")
+        if isinstance(coordinator, dict):
+            recovered += self.coordinator.import_state(coordinator)
+        for entry in state.get("homes") or ():
+            try:
+                tenant, shard = entry
+                tenant, shard = str(tenant), int(shard)
+            except (TypeError, ValueError):
+                continue
+            if not 0 <= shard < len(self.shards):
+                continue
+            self._homes[tenant] = shard
+            self._homes.move_to_end(tenant)
+            recovered += 1
+            while len(self._homes) > self.HOME_LIMIT:
+                self._homes.popitem(last=False)
+        self.overflow_total = int(state.get("overflow_total", 0) or 0)
+        return recovered

@@ -515,6 +515,11 @@ class _Slot:
     # engine's static generate_tokens, so the device row outlives the
     # host's completion — _finish_ready quiesces it (see _quiesce_rows)
     degraded: bool = False
+    # decode-phase deadline (tenancy.decode_slo_s > 0): armed at the
+    # first produced token to first-token time + slo x remaining
+    # budget; a slot still decoding past it is shed mid-decode with an
+    # explicit error reply (reason="decode_deadline").  None = unarmed.
+    decode_deadline_at: float | None = None
 
 
 class ContinuousBatcher:
@@ -2714,8 +2719,7 @@ class ContinuousWorker:
             from .tenancy import FairAdmission
 
             total_slots = len(self.batcher.slots)
-            self._fair = FairAdmission(
-                tenancy,
+            fair_limits = dict(
                 per_tenant_limit=(
                     tenancy.staging_per_tenant
                     or max(1, total_slots)
@@ -2724,6 +2728,16 @@ class ContinuousWorker:
                     tenancy.staging_total or max(2, 2 * total_slots)
                 ),
             )
+            if getattr(tenancy, "admission_shards", 1) > 1:
+                # the sharded admission plane (ISSUE 19): N crash-
+                # tolerant staging shards behind the same facade —
+                # admission_shards=1 never imports the module, so the
+                # single plane stays byte-identical to PR 11
+                from .admission_shards import ShardedAdmission
+
+                self._fair = ShardedAdmission(tenancy, **fair_limits)
+            else:
+                self._fair = FairAdmission(tenancy, **fair_limits)
         # uniquely-answered completions per tenant (exactly-once: the
         # fleet's duplicate-suppression path never reaches the counter,
         # and TTL sheds / malformed drops are answered but not counted)
@@ -2744,21 +2758,27 @@ class ContinuousWorker:
         # request_ttl_s at admission), "degraded" (overload tier 1 cut
         # the request's token budget; answered short, never dropped),
         # "pressure" (overload tier 3 shed it from staging with an
+        # explicit error reply), "decode_deadline" (the decode phase
+        # blew its per-token SLO budget; shed mid-decode with an
         # explicit error reply).  `shed` (the dashboard-compatible
         # unlabeled requests_shed_total) is their sum.
         self.shed_by_reason: dict[str, int] = {
-            "ttl": 0, "degraded": 0, "pressure": 0,
+            "ttl": 0, "degraded": 0, "pressure": 0, "decode_deadline": 0,
         }
         # the overload ladder (tenancy.shed_tiers > 0): _run_ladder
         # measures pressure and applies the active tier's actions once
         # per tenant refill cycle; None = no ladder, the PR 8 TTL shed
-        # stays the only degradation
+        # stays the only degradation.  On the SHARDED admission plane
+        # each AdmissionShard owns its own ladder instead (one shard's
+        # overload degrades its tenants, not everyone's) — see
+        # _run_shard_ladders.
         self.ladder = None
         self._degrade_tenants: frozenset = frozenset()
         self._degraded_tokens = max(
             1, service_config.generate_tokens // 2
         )
-        if tenancy is not None and tenancy.shed_tiers > 0:
+        if tenancy is not None and tenancy.shed_tiers > 0 \
+                and getattr(tenancy, "admission_shards", 1) == 1:
             from .tenancy import OverloadLadder
 
             self.ladder = OverloadLadder(tenancy.shed_tiers)
@@ -3014,6 +3034,9 @@ class ContinuousWorker:
             self._poll_backoff = 0  # staged work: keep the loop hot
         if self.ladder is not None:
             self._run_ladder()
+        elif self.tenancy.shed_tiers > 0 and \
+                hasattr(self._fair, "shards"):
+            self._run_shard_ladders()
         now = self._now()
         admit: list = []
         while len(admit) < free:
@@ -3096,7 +3119,51 @@ class ContinuousWorker:
             # above instead of re-running the O(tenants) classifier
             self._shed_pressure(target, self._degrade_tenants)
 
-    def _shed_pressure(self, target: int, over_share) -> None:
+    def _run_shard_ladders(self) -> None:
+        """The sharded admission plane's ladder pass: each alive
+        AdmissionShard measures its OWN pressure (its staged fraction,
+        gated by the shared engine's occupancy) and advances its own
+        ladder — one shard's flood engages tier actions for its slice
+        of tenants without degrading another shard's.  The degrade set
+        is the union across shards, and tier-3 sheds run per shard
+        against that shard's staging; gossip then shares every flood
+        classification plane-wide (a coalition classified on its home
+        shard stays classified wherever a kill fails it over)."""
+        fair = self._fair
+        slots = len(self.batcher.slots)
+        if not slots:
+            return
+        free = slots - self.batcher.active
+        occupancy = min(
+            1.0,
+            (self.batcher.active + min(fair.staged, free)) / slots,
+        )
+        degrade: set = set()
+        pool = self.batcher.prefix_pool
+        for shard in fair.shards:
+            if not shard.alive or shard.ladder is None:
+                continue
+            staged_frac = min(
+                1.0, shard.fair.staged / shard.fair.total_limit
+            )
+            tier = shard.ladder.update(staged_frac * occupancy)
+            if tier < 1:
+                continue
+            flood = shard.fair.over_share()
+            degrade |= set(flood)
+            if tier >= 2 and pool is not None:
+                pool.evict_cold(max(1, pool.entries // 2))
+            if tier >= 3:
+                target = int(
+                    shard.ladder.exit_threshold(3)
+                    * shard.fair.total_limit
+                )
+                self._shed_pressure(target, flood, fair=shard.fair)
+        fair.gossip()
+        self._degrade_tenants = frozenset(degrade)
+
+    def _shed_pressure(self, target: int, over_share,
+                       fair=None) -> None:
         """Tier 3: shed staged requests down to ``target`` — ONLY from
         tenants currently over their weight share (the flood
         signature; a compliant tenant's requests are served late, not
@@ -3108,9 +3175,11 @@ class ContinuousWorker:
         absorbs the shed.  Every shed is an explicit error reply
         through the normal settle path — exactly-once (the fleet's
         reply registry dedups redelivered copies before the counter),
-        never a silent drop."""
-        drr = self._fair.drr
-        fair = self._fair
+        never a silent drop.  ``fair`` scopes the shed to one
+        admission shard's staging (the sharded plane's per-shard
+        tier 3); None = the worker's whole plane."""
+        fair = fair if fair is not None else self._fair
+        drr = fair.drr
         now = self._now()
         # eligibility comes from the SUSTAINED unique-message offered
         # rate (FairAdmission.over_share), never instantaneous staged
@@ -3141,7 +3210,7 @@ class ContinuousWorker:
         # loops pop — the shed loop runs on already-overloaded cycles,
         # so an O(tenants)/O(queues) rescan per shed would pile host
         # work on exactly the wrong cycles
-        staged = self._fair.staged
+        staged = fair.staged
         while premium_flood and staged > target:
             popped = drr.pop_over_deadline(now, eligible=premium_flood)
             if popped is None:
@@ -3383,6 +3452,49 @@ class ContinuousWorker:
                 nack(self.config.queue_url, payload["ReceiptHandle"], 0)
         return len(resumes), len(handback)
 
+    def kill_admission_shard(self, shard: int) -> int:
+        """Chaos seam (``FleetFaultPlan.admission_kills``): kill one
+        admission shard mid-cycle.  Its staged requests hand back to
+        the queue via ``change_message_visibility(0)`` (redelivered,
+        never lost — and the reply registry still dedups, so
+        exactly-once holds), its deficit/credit/flood accounting
+        tombstones, and the next refill cycle rehydrates it.  Sharded
+        admission plane only; returns the hand-back count."""
+        fair = self._fair
+        if fair is None or not hasattr(fair, "kill_shard"):
+            raise ValueError(
+                "no sharded admission plane to kill a shard of "
+                "(tenancy.admission_shards must be >= 2)"
+            )
+        nack = getattr(self.queue, "change_message_visibility", None)
+        if nack is None:
+            log.warning(
+                "Queue has no change_message_visibility; the killed "
+                "admission shard's staged requests will redeliver only "
+                "after the visibility timeout"
+            )
+
+        def handback(message) -> None:
+            if nack is not None:
+                nack(self.config.queue_url, message["ReceiptHandle"], 0)
+
+        return fair.kill_shard(shard, handback)
+
+    def partition_admission_shard(
+        self, shard: int, partitioned: bool = True,
+    ) -> None:
+        """Chaos seam (``FleetFaultPlan.admission_partitions``): flip
+        one admission shard's gossip partition — it keeps admitting
+        its tenant slice but is excluded from flood-classification
+        gossip both ways until healed."""
+        fair = self._fair
+        if fair is None or not hasattr(fair, "partition_shard"):
+            raise ValueError(
+                "no sharded admission plane to partition a shard of "
+                "(tenancy.admission_shards must be >= 2)"
+            )
+        fair.partition_shard(shard, partitioned)
+
     def attach_metrics(self, metrics) -> None:
         """Report the serving gauges (tokens/s, time-to-first-token,
         active slots, block utilization) to a
@@ -3432,8 +3544,10 @@ class ContinuousWorker:
             "older than --request-ttl on arrival (explicit expired "
             "reply), degraded = overload tier 1 cut the token budget "
             "(answered short), pressure = overload tier 3 shed it from "
-            "staging (explicit error reply).  The unlabeled series is "
-            "their sum (pre-ladder dashboards keep working)."
+            "staging (explicit error reply), decode_deadline = the "
+            "decode phase blew its per-token SLO budget (shed "
+            "mid-decode with an explicit error reply).  The unlabeled "
+            "series is their sum (pre-ladder dashboards keep working)."
         )
         self.metrics.set_gauge(
             "requests_shed_total", self.shed, shed_help, kind="counter",
@@ -3462,6 +3576,65 @@ class ContinuousWorker:
                 "worker's lifetime.",
                 kind="counter",
             )
+        elif self._fair is not None and hasattr(self._fair, "shards"):
+            # the sharded admission plane: plane-wide ladder rollup
+            # (max tier / pressure, summed transitions — the pre-shard
+            # dashboards keep reading one series) plus per-shard
+            # labeled gauges.  Shard-index labels are bounded by
+            # construction (N is a config knob, not request input), so
+            # they need no bounded_tenant_key fold.
+            shards = self._fair.shards
+            ladders = [s.ladder for s in shards if s.ladder is not None]
+            if ladders:
+                self.metrics.set_gauge(
+                    "overload_tier",
+                    max(ladder.tier for ladder in ladders),
+                    "Active overload-ladder tier (0 = serving normally, "
+                    "1 = degrading over-share tenants, 2 = + evicting "
+                    "cold prefix entries, 3 = + shedding staged "
+                    "requests).  Sharded admission: the MAX across "
+                    "per-shard ladders.",
+                )
+                self.metrics.set_gauge(
+                    "overload_pressure",
+                    max(ladder.last_pressure for ladder in ladders),
+                    "Measured overload pressure the ladder last acted "
+                    "on.  Sharded admission: the MAX across per-shard "
+                    "ladders.",
+                )
+                self.metrics.set_gauge(
+                    "overload_tier_transitions_total",
+                    sum(ladder.transitions for ladder in ladders),
+                    "Ladder tier transitions (enter + exit), summed "
+                    "across admission shards.",
+                    kind="counter",
+                )
+            for shard in shards:
+                labels = (("shard", str(shard.index)),)
+                self.metrics.set_gauge(
+                    "admission_shard_staged", shard.fair.staged,
+                    "Requests parked in this admission shard's staging "
+                    "slice.",
+                    labels=labels,
+                )
+                self.metrics.set_gauge(
+                    "admission_shard_tenants",
+                    sum(
+                        1 for depth in shard.fair.drr.depths().values()
+                        if depth > 0
+                    ),
+                    "Tenants with staged work on this admission shard.",
+                    labels=labels,
+                )
+                self.metrics.set_gauge(
+                    "admission_shard_state",
+                    0 if not shard.alive
+                    else (1 if shard.partitioned else 2),
+                    "Admission-shard liveness: 2 = serving, 1 = "
+                    "gossip-partitioned (still admitting), 0 = killed "
+                    "(staged work handed back; rehydrates next cycle).",
+                    labels=labels,
+                )
         if self.tenancy is not None:
             # the gauge label registry is persistent AND bounded: raw
             # staged labels fold through bounded_tenant_key before they
@@ -3550,6 +3723,46 @@ class ContinuousWorker:
             # cadence as every other serving gauge
             self.lifecycle.export_metrics(self.metrics)
 
+    def _enforce_decode_deadlines(self) -> None:
+        """Deadlines past TTFT (``tenancy.decode_slo_s`` > 0): once a
+        slot has its first token, it must finish its remaining budget
+        at ``decode_slo_s`` seconds per token or be shed MID-decode
+        with an explicit error reply — the enforcement side of the
+        PR 17 decode-phase histograms.  The shed settles the reply
+        here (exactly-once through the normal settle path), then cuts
+        the slot's budget to what it already produced so the engine
+        frees — and quiesces — the row on its next step; run_once
+        skips the resulting payload-None done pair so the request is
+        neither double-settled nor counted as a completion."""
+        slo = self.tenancy.decode_slo_s
+        now = self._now()
+        for slot in self.batcher.slots:
+            if not slot.busy or slot.done or slot.payload is None:
+                continue
+            produced = len(slot.produced)
+            if produced < 1:
+                continue  # pre-first-token is the TTFT SLO's territory
+            if slot.decode_deadline_at is None:
+                slot.decode_deadline_at = now + slo * max(
+                    1, slot.budget - produced
+                )
+                continue
+            if now <= slot.decode_deadline_at:
+                continue
+            message = slot.payload
+            slot.payload = None
+            slot.budget = produced  # finishes (and quiesces) next step
+            slot.degraded = True
+            if self._settle(
+                message, None,
+                error=(
+                    "decode deadline exceeded (the decode phase blew "
+                    "its per-token SLO budget)"
+                ),
+                counted=False,
+            ):
+                self._note_shed("decode_deadline")
+
     def run_once(self) -> int:
         """One engine cycle: refill free slots, advance the decode block
         (one token per slot at ``decode_block=1``), settle finished
@@ -3557,14 +3770,22 @@ class ContinuousWorker:
         if self._served_since is None:
             self._served_since = time.perf_counter()
         self._refill()
+        if self.tenancy is not None and self.tenancy.decode_slo_s > 0:
+            self._enforce_decode_deadlines()
         done = self.batcher.step()
+        completed = 0
         for message, tokens in done:
+            if message is None:
+                # a decode-deadline shed: the error reply settled at
+                # enforcement time; the engine just freed the row
+                continue
             self._settle(message, tokens)
+            completed += 1
         if done:
             self._poll_backoff = 0  # a slot just freed: poll right away
-        self.processed += len(done)
+        self.processed += completed
         self._update_metrics()
-        return len(done)
+        return completed
 
     def stop(self) -> None:
         """Ask the serve loop to exit after its current cycle.

@@ -132,6 +132,19 @@ class TenancyConfig:
     # queue itself remains the real backlog.
     staging_per_tenant: int = 0
     staging_total: int = 0
+    # sharded admission plane (ISSUE 19): N >= 2 splits the staging
+    # plane into N crash-tolerant AdmissionShard workers — tenants map
+    # to shards by consistent hash (sticky, so a tenant's prefix home
+    # and DRR state live on one shard), global fairness reconciled via
+    # rate-bounded cross-shard credit borrowing.  1 = the single-plane
+    # PR 11 behaviour, byte-identical.
+    admission_shards: int = 1
+    # decode-phase deadline (seconds of decode budget per generated
+    # token): once a request has produced its first token, it must
+    # sustain decode_slo_s per remaining token or be shed with an
+    # explicit error reply (reason="decode_deadline").  0 = off — the
+    # TTFT deadline remains the only enforced SLO.
+    decode_slo_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.tenants:
@@ -214,6 +227,15 @@ class TenancyConfig:
             raise ValueError(
                 "staging_per_tenant and staging_total must be >= 0 "
                 "(0 = auto)"
+            )
+        if self.admission_shards < 1:
+            raise ValueError(
+                f"admission_shards={self.admission_shards} must be >= 1 "
+                "(1 = the single staging plane)"
+            )
+        if self.decode_slo_s < 0:
+            raise ValueError(
+                f"decode_slo_s={self.decode_slo_s} must be >= 0 (0 = off)"
             )
 
     # weight_of runs once per tenant per DRR round on the refill hot
@@ -765,6 +787,11 @@ class FairAdmission:
         # change_message_visibility(0) went through, so the counter
         # never claims a backpressure event that did not happen
         self.overflow_total = 0
+        # serial host work this plane has performed (rate decays,
+        # stagings, flood scans) — the admission-scale bench's virtual
+        # cost model charges these to the clock, and a sharded plane
+        # charges only the max over its shards (they run concurrently)
+        self.host_ops = 0
         # tenant -> decayed staged-arrivals-per-cycle (the ladder's
         # flood classifier input; pure bookkeeping — nothing on the
         # admission path reads it unless a ladder asks).  Rated by
@@ -795,6 +822,7 @@ class FairAdmission:
         """Decay the arrival-rate EWMA one refill cycle (entries under
         :attr:`ARRIVAL_FLOOR` drop out, so the dict stays bounded by
         recent stagers no matter how many labels an adversary mints)."""
+        self.host_ops += 1 + len(self.arrival_rate)
         decay = self.ARRIVAL_DECAY
         self.arrival_rate = {
             tenant: rate * decay
@@ -816,6 +844,7 @@ class FairAdmission:
         is non-empty even after its measured rate decays (the attack
         stopped SENDING, but its backlog is still the overload), and
         drops out the moment its backlog clears."""
+        self.host_ops += len(self.arrival_rate)
         fresh: set[str] = set()
         rates = self.arrival_rate
         if len(rates) >= 2:
@@ -836,6 +865,20 @@ class FairAdmission:
             if self.drr.depth(t) > 0 or self._sticky_grace.get(t, 0) > 0
         }
         return frozenset(self._flood_sticky)
+
+    def adopt_flood(self, tenants) -> None:
+        """Adopt peer-gossiped flood classifications (the sharded
+        admission plane's gossip receive side): sticky, armed with the
+        restore grace — this shard has no local backlog or offered-rate
+        history for the tenant yet, so without the grace the ordinary
+        drains-means-done rule would immediately un-classify a flooder
+        the moment it fails over here."""
+        fresh = {str(t) for t in tenants} - self._flood_sticky
+        if not fresh:
+            return
+        self._flood_sticky |= fresh
+        for tenant in fresh:
+            self._sticky_grace[tenant] = self.STICKY_RESTORE_GRACE
 
     @property
     def staged(self) -> int:
@@ -871,6 +914,7 @@ class FairAdmission:
         deadline (epoch seconds; None = no SLO), carried so the EDF
         blend can see it at pick time; ``message_id`` dedups the
         offered-load rate under redelivery."""
+        self.host_ops += 1
         if self.drr.depth(tenant) >= self.per_tenant_limit:
             # offered past its OWN cap: the per-tenant flood signature
             # — counted into the rate even though nothing stages (a

@@ -1,0 +1,454 @@
+"""Sharded admission plane: consistent-hash stability, cross-shard
+credit borrowing invariants, kill/rehydrate crash tolerance, gossip
+partitions, the FleetFaultPlan admission faults, the CLI knobs, and the
+admission-scale bench smoke (full 100k-1M battery marked slow).
+"""
+
+import json
+
+import pytest
+
+from kube_sqs_autoscaler_tpu.workloads.admission_shards import (
+    AdmissionCoordinator,
+    HashRing,
+    ShardedAdmission,
+)
+from kube_sqs_autoscaler_tpu.workloads.tenancy import (
+    FairAdmission,
+    TenancyConfig,
+)
+
+
+def _plane(shards=4, tenants=("a", "b", "c", "d"), **overrides):
+    config = dict(
+        tenants=tenants, admission_shards=shards,
+        staging_per_tenant=8, staging_total=32,
+    )
+    config.update(overrides)
+    return ShardedAdmission(
+        TenancyConfig(**config), per_tenant_limit=8, total_limit=32,
+    )
+
+
+def _item(tenant, index):
+    # the worker stages (tenant, prefix_ids, ids, message) — item[3]
+    # is the raw queue message the kill path hands back
+    return (tenant, None, (1, 2, 3),
+            {"MessageId": f"{tenant}-{index}",
+             "ReceiptHandle": f"rh-{tenant}-{index}",
+             "Body": "{}"})
+
+
+# ---------------------------------------------------------------------------
+# Consistent hashing: stability, determinism, failover
+# ---------------------------------------------------------------------------
+
+
+def test_hash_ring_moves_about_one_over_n_when_growing():
+    tenants = [f"t{i}" for i in range(10_000)]
+    four = HashRing(4)
+    five = HashRing(5)
+    moved = sum(
+        1 for t in tenants if four.shard_of(t) != five.shard_of(t)
+    )
+    # ideal is 1/5 = 0.2; the virtual-node ring lands close to it —
+    # the point is that it is nowhere near the 0.8 a mod-N hash moves
+    assert 0.10 < moved / len(tenants) < 0.30
+
+
+def test_hash_ring_is_deterministic_across_instances():
+    tenants = [f"t{i}" for i in range(500)]
+    a, b = HashRing(4), HashRing(4)
+    assert [a.shard_of(t) for t in tenants] == \
+        [b.shard_of(t) for t in tenants]
+    # every shard owns a non-trivial slice
+    owners = {a.shard_of(t) for t in tenants}
+    assert owners == {0, 1, 2, 3}
+
+
+def test_hash_ring_failover_walks_past_dead_owner():
+    ring = HashRing(4)
+    tenant = "victim-tenant"
+    home = ring.shard_of(tenant)
+    alive = {s for s in range(4) if s != home}
+    rerouted = ring.shard_of(tenant, alive=alive)
+    assert rerouted != home
+    assert rerouted in alive
+    # tenants whose owner is alive do not move
+    for t in (f"t{i}" for i in range(200)):
+        if ring.shard_of(t) != home:
+            assert ring.shard_of(t, alive=alive) == ring.shard_of(t)
+
+
+# ---------------------------------------------------------------------------
+# Sticky homes: survive rehydration, pin across failover
+# ---------------------------------------------------------------------------
+
+
+def test_sticky_home_survives_export_import():
+    plane = _plane()
+    tenants = [f"t{i}" for i in range(64)]
+    for i, tenant in enumerate(tenants):
+        plane.stage(tenant, _item(tenant, 0),
+                    message_id=f"{tenant}-m0")
+    homes = {t: plane.shard_of(t).index for t in tenants}
+
+    fresh = _plane()
+    fresh.import_state(plane.export_state())
+    assert {t: fresh.shard_of(t).index for t in tenants} == homes
+
+
+def test_sticky_home_survives_kill_and_restart():
+    plane = _plane()
+    tenant = "sticky-tenant"
+    plane.stage(tenant, _item(tenant, 0), message_id="m0")
+    home = plane.shard_of(tenant).index
+
+    handed = []
+    plane.kill_shard(home, handback=handed.append)
+    assert [m["MessageId"] for m in handed] == [f"{tenant}-0"]
+    # while dead the tenant fails over to a surviving shard and the
+    # home RE-PINS there (deterministic, no flapping)...
+    failover = plane.shard_of(tenant).index
+    assert failover != home
+    plane.restart_shard(home)
+    # ...so the restart does not bounce it back: sticky means stable
+    assert plane.shard_of(tenant).index == failover
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard credit borrowing: debt bound, no starvation
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_debt_never_exceeds_borrow_cap():
+    coordinator = AdmissionCoordinator(4)
+    demands = [40, 1, 0, 3]
+    weights = [1.0, 1.0, 0.0, 2.0]
+    for cycle in range(300):
+        demands[1] = cycle % 3  # flickering busy period
+        grants = coordinator.allocate(4, demands, weights)
+        assert sum(grants) <= min(4, sum(demands))
+        assert all(g >= 0 for g in grants)
+        for s in range(4):
+            assert coordinator.debt(s) <= coordinator.BORROW_CAP + 1e-9
+
+
+def test_coordinator_never_starves_a_busy_peer():
+    # shard 0 has a bottomless backlog; shard 1 trickles — equal
+    # weights must still earn shard 1 about half the slots while it
+    # has demand, no matter how hungry shard 0 is
+    coordinator = AdmissionCoordinator(2)
+    granted = [0, 0]
+    offered = 0
+    for cycle in range(200):
+        demands = [1000, 2 if cycle % 2 else 0]
+        if demands[1]:
+            offered += 1
+        grants = coordinator.allocate(4, demands, [1.0, 1.0])
+        granted[0] += grants[0]
+        granted[1] += grants[1]
+    # shard 1 was busy half the time at demand 2 of k=4: its earned
+    # share alone is ~2 per busy cycle; borrowing by shard 0 may not
+    # eat into it
+    assert granted[1] >= offered
+    # and work conservation actually used the leftover capacity
+    assert granted[0] > granted[1]
+
+
+def test_coordinator_state_round_trips():
+    coordinator = AdmissionCoordinator(3)
+    for cycle in range(20):
+        coordinator.allocate(4, [5, 3, 1], [1.0, 2.0, 1.0])
+    state = coordinator.export_state()
+    fresh = AdmissionCoordinator(3)
+    fresh.import_state(state)
+    assert fresh.export_state() == state
+    assert fresh.borrows_total == coordinator.borrows_total
+
+
+# ---------------------------------------------------------------------------
+# The plane: pick caps, kill/rehydrate, gossip partitions
+# ---------------------------------------------------------------------------
+
+
+def test_pick_never_exceeds_free_slots_under_banked_credit():
+    plane = _plane()
+    staged = sum(
+        1 for i in range(24)
+        if plane.stage(f"burst{i}", _item(f"burst{i}", i),
+                       message_id=f"b{i}")
+    )
+    assert staged >= 16  # some shard slices fill first; most land
+    # several under-granted cycles bank fractional credit; a later
+    # pick must still cap at k (the engine's free slots), not spill
+    for k in (1, 1, 1, 4, 4, 8):
+        plane.note_cycle()
+        assert len(plane.pick(k, now=None)) <= k
+
+
+def test_kill_hands_back_staged_and_rehydrates_from_tombstone():
+    plane = _plane()
+    staged = 0
+    for i in range(12):
+        tenant = f"t{i}"
+        if plane.stage(tenant, _item(tenant, i), message_id=f"m{i}"):
+            staged += 1
+    victim = max(range(4), key=lambda s: plane.shards[s].fair.staged)
+    before = plane.shards[victim].fair.staged
+    assert before >= 1
+
+    handed = []
+    released = plane.kill_shard(victim, handback=handed.append)
+    assert released == before == len(handed)
+    assert not plane.shards[victim].alive
+    assert plane.staged == staged - released
+
+    # the next cycle's supervisor auto-restart rehydrates accounting
+    plane.note_cycle()
+    shard = plane.shards[victim]
+    assert shard.alive
+    assert shard.rehydrations == 1
+    assert shard.rehydrated_records >= 1  # tombstone, not cold
+    # the handed-back work is NOT re-driven from state: it redelivers
+    # through the queue, so the restarted shard starts empty
+    assert shard.fair.staged == 0
+
+
+def test_killed_shard_tombstone_carries_flood_state_to_restart():
+    plane = _plane()
+    plane.shards[1].fair._flood_sticky.add("coalition")
+    plane.kill_shard(1)
+    plane.restart_shard(1)
+    assert "coalition" in plane.shards[1].fair._flood_sticky
+
+
+def test_gossip_unions_flood_state_except_across_partitions():
+    plane = _plane(shards=3, tenants=("a", "b", "c"))
+    plane.partition_shard(2, True)
+    plane.shards[0].fair._flood_sticky.add("mob")
+    plane.gossip()
+    assert "mob" in plane.shards[1].fair._flood_sticky
+    assert "mob" not in plane.shards[2].fair._flood_sticky
+    plane.partition_shard(2, False)
+    plane.gossip()
+    assert "mob" in plane.shards[2].fair._flood_sticky
+
+
+def test_restarted_shard_adopts_peer_flood_gossip():
+    plane = _plane()
+    plane.kill_shard(0)
+    plane.shards[1].fair._flood_sticky.add("mob")
+    plane.restart_shard(0)
+    assert "mob" in plane.shards[0].fair._flood_sticky
+
+
+def test_adopt_flood_arms_sticky_grace():
+    fair = FairAdmission(
+        TenancyConfig(tenants=("a",)), per_tenant_limit=4,
+        total_limit=8,
+    )
+    fair.adopt_flood({"mob"})
+    assert "mob" in fair._flood_sticky
+    assert fair._sticky_grace["mob"] == fair.STICKY_RESTORE_GRACE
+    # adopting again is idempotent (no grace reset churn on re-gossip)
+    fair._sticky_grace["mob"] = 3
+    fair.adopt_flood({"mob"})
+    assert fair._sticky_grace["mob"] == 3
+
+
+def test_single_shard_config_builds_the_plain_plane():
+    with pytest.raises(ValueError, match="admission_shards"):
+        ShardedAdmission(
+            TenancyConfig(tenants=("a",), admission_shards=1),
+            per_tenant_limit=4, total_limit=8,
+        )
+    with pytest.raises(ValueError, match="admission_shards"):
+        TenancyConfig(tenants=("a",), admission_shards=0)
+    with pytest.raises(ValueError, match="decode_slo_s"):
+        TenancyConfig(tenants=("a",), decode_slo_s=-0.1)
+
+
+# ---------------------------------------------------------------------------
+# FleetFaultPlan: admission kills + gossip partitions
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_validates_admission_partition_windows():
+    from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan
+
+    with pytest.raises(ValueError, match="admission_partitions"):
+        FleetFaultPlan(admission_partitions=((5, 5, 0),))
+    with pytest.raises(ValueError, match="admission_partitions"):
+        FleetFaultPlan(admission_partitions=((8, 2, 1),))
+    plan = FleetFaultPlan(
+        admission_kills=((3, 1),),
+        admission_partitions=((2, 6, 0),),
+    )
+    assert plan.admission_shards() == {0, 1}
+
+
+def test_fault_plan_dispatches_admission_faults_by_cycle():
+    from kube_sqs_autoscaler_tpu.sim.faults import FleetFaultPlan
+
+    calls = []
+
+    class Pool:
+        def kill_admission_shard(self, shard):
+            calls.append(("kill", shard))
+
+        def partition_admission_shard(self, shard, partitioned=True):
+            calls.append(("partition", shard, partitioned))
+
+    plan = FleetFaultPlan(
+        admission_kills=((3, 1),),
+        admission_partitions=((2, 5, 0),),
+    )
+    pool = Pool()
+    for cycle in range(7):
+        plan.apply(cycle, pool)
+    assert calls == [
+        ("partition", 0, True),
+        ("kill", 1),
+        ("partition", 0, False),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# CLI knobs
+# ---------------------------------------------------------------------------
+
+
+def test_admission_shard_flag_rejections():
+    from kube_sqs_autoscaler_tpu.workloads.__main__ import (
+        main as worker_main,
+    )
+
+    base = ["--demo", "1", "--continuous", "--generate-tokens", "2"]
+    with pytest.raises(SystemExit, match="requires --tenants"):
+        worker_main(base + ["--admission-shards", "2"])
+    with pytest.raises(SystemExit, match="requires --tenants"):
+        worker_main(base + ["--decode-slo-budget", "0.5"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        worker_main(base + ["--tenants", "a", "--admission-shards", "0"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        worker_main(base + ["--tenants", "a",
+                            "--decode-slo-budget", "-1"])
+
+
+# ---------------------------------------------------------------------------
+# Per-shard observability: the three gauges render per shard
+# ---------------------------------------------------------------------------
+
+
+def test_per_shard_gauges_render():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.metrics.fake import FakeMessageQueue
+    from kube_sqs_autoscaler_tpu.obs import WorkloadMetrics
+    from kube_sqs_autoscaler_tpu.workloads.continuous import (
+        ContinuousWorker,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.service import ServiceConfig
+
+    model = ModelConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+    queue = FakeMessageQueue()
+    results = FakeMessageQueue()
+    worker = ContinuousWorker(
+        queue, params, model,
+        ServiceConfig(
+            queue_url="t://q", batch_size=2, seq_len=4,
+            generate_tokens=4, decode_block=2,
+            result_queue_url="t://r",
+        ),
+        result_queue=results,
+        tenancy=TenancyConfig(tenants=("a", "b"), admission_shards=4),
+    )
+    metrics = WorkloadMetrics()
+    worker.attach_metrics(metrics)
+    rng = np.random.default_rng(41)
+    for index in range(3):
+        queue.send_message("t://q", json.dumps(
+            {"tenant": ("a", "b")[index % 2],
+             "ids": rng.integers(1, 64, 3).tolist()},
+        ))
+    cycles = 0
+    while worker.processed < 3:
+        worker.run_once()
+        cycles += 1
+        assert cycles < 200, "worker did not drain"
+    text = metrics.render()
+    prefix = "kube_sqs_autoscaler_workload"
+    for shard in range(4):
+        label = f'{{shard="{shard}"}}'
+        assert f"{prefix}_admission_shard_staged{label}" in text
+        assert f"{prefix}_admission_shard_tenants{label}" in text
+        # every shard is alive and unpartitioned: state reads 2
+        assert f"{prefix}_admission_shard_state{label} 2.0" in text
+    # a killed shard reads 0 on the next rendered cycle (it rehydrates
+    # the cycle after, so pause auto-restart by not calling note_cycle)
+    worker._fair.kill_shard(1)
+    worker._update_metrics()
+    text = metrics.render()
+    assert f'{prefix}_admission_shard_state{{shard="1"}} 0.0' in text
+
+
+# ---------------------------------------------------------------------------
+# The admission-scale bench: tier-1 smoke, full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_admission_scale_bench_smoke(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_admission.json"
+    summary = bench.run_admission_scale_suite(
+        output=str(out), scale=0.002, timing_gates=False,
+    )
+    assert summary["metric"] == \
+        "admission_scale_victim_ttft_p99_improvement"
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "admission-scale"
+    for name, episode in artifact["episodes"].items():
+        for key in ("n1", "n4"):
+            row = episode[key]
+            assert row["answered"] == row["requests"], (name, key)
+            assert row["duplicates"] == 0
+    chaos = artifact["chaos"]
+    assert chaos["answered"] == chaos["requests"]
+    assert chaos["duplicates"] == 0
+    assert chaos["kill"]["handed_back"] >= 1
+    assert chaos["kill"]["rehydrated_records"] >= 1
+    decode = artifact["decode_deadline"]
+    assert decode["shed_by_reason"]["decode_deadline"] >= 1
+    assert decode["decode_deadline_replies"] >= 1
+    parity = artifact["parity"]
+    for label in ("single-shard", "decode-armed-dormant"):
+        assert parity[label]["single_plane"]
+        assert (parity[label]["insert_dispatches"]
+                == parity["pr11"]["insert_dispatches"])
+
+
+@pytest.mark.slow
+def test_admission_scale_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_admission_full.json"
+    summary = bench.run_admission_scale_suite(output=str(out))
+    assert summary["vs_baseline"] > 1.0
+    artifact = json.loads(out.read_text())
+    for name, episode in artifact["episodes"].items():
+        assert (episode["n4"]["victim_ttft_p99_s"]
+                < episode["n1"]["victim_ttft_p99_s"]), name
+        assert (episode["n4"]["tokens_per_virtual_s"]
+                > episode["n1"]["tokens_per_virtual_s"]), name
