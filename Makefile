@@ -1,7 +1,7 @@
 # Build/packaging targets (reference counterpart: Makefile — same five
 # targets: test/clean/compile/build/push; SURVEY.md §2.1 C6).
 
-.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs bench-comms bench-admission-scale replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
+.PHONY: test test-slow test-all clean compile build push bench bench-forecast bench-replay bench-sweep bench-chaos bench-serve bench-fleet bench-scale bench-chaos-serve bench-learn bench-tenants bench-overload bench-twin bench-restart bench-knobs bench-disagg bench-obs bench-comms bench-admission-scale bench-routes replay-demo chaos-demo fleet-demo learn-demo restart-demo workbench dryrun native demo
 
 IMAGE=kube-sqs-autoscaler-tpu
 VERSION=v0.5.0
@@ -216,6 +216,21 @@ bench-comms:
 # plane; writes BENCH_r23.json
 bench-admission-scale:
 	JAX_PLATFORMS=cpu python bench.py --suite admission-scale
+
+# Topology-aware collective routing battery (CPU JAX, seconds): the
+# scheduler picks WHICH ROUTE, not just WHEN.  Exits 2 unless routed
+# dispatch (chunked link-disjoint paths + greedy earliest-first-link
+# order against the per-link virtual-time ledger) beats WHEN-only FIFO
+# by >= 1.5x modeled transfer completion on a contended 16-shard-torus
+# evacuation episode, no schedule oversubscribes any link, replies and
+# engine odometers stay byte-identical with routing on, topology=None
+# keeps the counter family byte-identical to the WHEN-only scheduler,
+# route hop lists land on lifecycle traces + exported Perfetto spans +
+# /debug/topology, and virtual tokens/s is monotone across shard
+# counts 1/2/4 under the topology-priced cost model; writes
+# BENCH_r24.json
+bench-routes:
+	JAX_PLATFORMS=cpu python bench.py --suite routes
 
 # Fleet chaos battery (CPU JAX, ~a minute): the ControlLoop autoscaling
 # real ContinuousWorker replicas over one shared queue, with a

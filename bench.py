@@ -7150,6 +7150,405 @@ def run_comms_suite(
     }
 
 
+def run_routes_suite(
+    output: str = "BENCH_r24.json", *,
+    prompt_len: int = 8, generate_tokens: int = 5, decode_block: int = 2,
+    timing_gates: bool = True,
+) -> dict:
+    """Topology-aware routing battery (ISSUE 20), hard-gated (exit 2) on:
+
+    - **routed speedup** — on a contended 2D-torus episode (six 8 MiB
+      evacuations funneling toward the host gateways plus a cross-plane
+      KV handoff), the route-choosing scheduler's modeled transfer
+      completion beats the WHEN-only baseline by >= 1.5x under the SAME
+      link cost model on the SAME ops — the only difference is WHICH
+      ROUTE (chunked link-disjoint paths + greedy earliest-first-link
+      order vs FIFO single shortest path);
+    - **no oversubscription** — every schedule the model produces
+      (routed and WHEN-only, contended and disjoint) passes the
+      per-link ledger audit: no two reservations overlap on any link;
+    - **routing never hurts** — on a contention-free battery (small
+      ops between link-disjoint neighbor pairs) the routed makespan is
+      no worse than WHEN-only (small ops go latency-minimal);
+    - **exact greedy parity + exactly-once** — the real evacuation
+      episode's replies are byte-identical comms-off vs WHEN-only
+      comms vs topology-attached comms, every request answered exactly
+      once, and the engine odometers (host transfers, dispatches,
+      tokens) are identical WHEN-only vs routed — routes change the
+      MODEL, never the work;
+    - **topology=None byte-identity** — the WHEN-only scheduler's
+      counter family has no ``routing`` key, and every
+      grouping-independent counter (submitted/dispatched/finished ops,
+      bytes, kinds, buckets, flushes) matches the routed run exactly
+      (only the coalesce grouping — dispatch count — may differ, by
+      design: first-hop-aware keys);
+    - **routes visible** — the topology-attached episode stamps hop
+      lists into the lifecycle traces and the exported Perfetto
+      transfer spans (``args.route``), and the ``/debug/topology``
+      snapshot carries the graph + live ledger + routing odometers;
+    - **monotone virtual tokens/s** (timing battery) — under the
+      topology-priced :class:`~kube_sqs_autoscaler_tpu.sim.CostModel`
+      (transfer cost = modeled completion of the episode's recorded
+      ops over the link graph), gang-plane tokens per virtual second
+      is monotone non-decreasing across shard counts 1→2→4.
+
+    ``timing_gates=False`` (the tier-1 smoke) skips the scaling curve;
+    every routing-model, parity, exactly-once, and oversubscription
+    gate still runs.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kube_sqs_autoscaler_tpu.comms import (
+        CollectiveScheduler,
+        assert_no_oversubscription,
+        simulate_schedule,
+        topology_from_geometry,
+    )
+    from kube_sqs_autoscaler_tpu.comms.ops import (
+        EVACUATION_KV,
+        HANDOFF_KV,
+        SMALL_OP_BYTES,
+    )
+    from kube_sqs_autoscaler_tpu.obs.lifecycle import LifecycleRegistry
+    from kube_sqs_autoscaler_tpu.obs.trace import request_trace_events
+    from kube_sqs_autoscaler_tpu.sim import CostModel
+    from kube_sqs_autoscaler_tpu.workloads.model import (
+        ModelConfig,
+        init_params,
+    )
+    from kube_sqs_autoscaler_tpu.workloads.shard_plane import ShardedBatcher
+
+    start = time.perf_counter()
+    failures: list[str] = []
+
+    def _audit(ledger, label):
+        try:
+            assert_no_oversubscription(ledger)
+        except AssertionError as err:
+            failures.append(f"oversubscription ({label}): {err}")
+
+    # -- battery 1: the contended torus (WHICH ROUTE matters) -----------
+    # Six 8 MiB evacuations from shards proximal to gateway 0 plus one
+    # cross-plane handoff: WHEN-only serializes everything through the
+    # shard:0->host uplink; routing spreads chunks across both gateways
+    # and the disjoint ring paths feeding them.
+    torus = topology_from_geometry("torus", shards=16)
+    for node in ("prefill", "decode-plane"):
+        torus.ensure_node(node)
+    contended_ops = [
+        {"kind": EVACUATION_KV, "source": f"shard:{s}",
+         "destination": "host", "nbytes": 8 << 20}
+        for s in (1, 2, 3, 4, 5, 13)
+    ] + [
+        {"kind": HANDOFF_KV, "source": "prefill",
+         "destination": "decode-plane", "nbytes": 8 << 20},
+    ]
+    when = simulate_schedule(contended_ops, torus, routed=False)
+    routed = simulate_schedule(contended_ops, torus, routed=True)
+    _audit(when.ledger, "contended when-only")
+    _audit(routed.ledger, "contended routed")
+    speedup = (
+        when.makespan / routed.makespan if routed.makespan > 0 else 0.0
+    )
+    if speedup < 1.5:
+        failures.append(
+            f"contended: routed speedup {speedup:.3f}x < 1.5x "
+            f"(when-only {when.makespan * 1e3:.3f} ms vs routed "
+            f"{routed.makespan * 1e3:.3f} ms)"
+        )
+
+    # -- battery 2: disjoint small ops (routing never hurts) ------------
+    disjoint_ops = [
+        {"kind": EVACUATION_KV, "source": f"shard:{a}",
+         "destination": f"shard:{b}", "nbytes": SMALL_OP_BYTES}
+        for a, b in ((1, 2), (5, 6), (9, 10), (13, 14))
+    ]
+    dis_when = simulate_schedule(disjoint_ops, torus, routed=False)
+    dis_routed = simulate_schedule(disjoint_ops, torus, routed=True)
+    _audit(dis_when.ledger, "disjoint when-only")
+    _audit(dis_routed.ledger, "disjoint routed")
+    if dis_routed.makespan > dis_when.makespan * (1 + 1e-9):
+        failures.append(
+            f"disjoint: routed makespan {dis_routed.makespan:.9f}s "
+            f"worse than when-only {dis_when.makespan:.9f}s"
+        )
+
+    # -- battery 3: the real engine, three ways -------------------------
+    model = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=prompt_len + generate_tokens,
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.key(0), model)
+
+    def _prompts(n, seed=7):
+        rng = np.random.default_rng(seed)
+        return [
+            rng.integers(1, 64, rng.integers(2, prompt_len + 1))
+            .astype(np.int32)
+            for _ in range(n)
+        ]
+
+    def evac_episode(comms, *, lifecycle=None, shards=2, n_requests=6):
+        plane = ShardedBatcher(
+            params, model, shards=shards, shard_slots=2,
+            prompt_len=prompt_len, generate_tokens=generate_tokens,
+            decode_block=decode_block,
+        )
+        plane.lifecycle = lifecycle
+        if comms is not None:
+            plane.attach_comms(comms)
+        prompts = _prompts(n_requests)
+        queue = [(ids, {"MessageId": f"r{i}"})
+                 for i, ids in enumerate(prompts)]
+        replies: list = []
+
+        def collect(finished):
+            for payload, toks in finished:
+                replies.append(
+                    (payload["MessageId"], tuple(int(t) for t in toks))
+                )
+                if lifecycle is not None:
+                    lifecycle.settle(payload["MessageId"])
+
+        def fill():
+            n = min(len(queue), len(plane.free_slots))
+            if n:
+                if lifecycle is not None:
+                    for _, payload in queue[:n]:
+                        lifecycle.stamp(
+                            payload["MessageId"], "arrival",
+                            t=lifecycle.now_fn(),
+                        )
+                plane.submit_many(queue[:n])
+                del queue[:n]
+
+        fill()
+        collect(plane.step())
+        collect(plane.step())
+        # evacuate the top shard mid-flight: the big EVACUATION_KV move
+        # whose route (with a topology attached) crosses the gateways
+        evacuated = plane.take_shard_inflight(shards - 1)
+        resumes = [
+            (prompts[int(p["MessageId"][1:])], p, produced, budget, t)
+            for p, produced, budget, t in evacuated
+        ]
+        for _ in range(600):
+            fill()
+            if resumes and plane.free_slots:
+                n = min(len(resumes), len(plane.free_slots))
+                admitted = plane.submit_resume(resumes[:n])
+                del resumes[:len(admitted)]
+            collect(plane.step())
+            if not queue and not resumes and plane.active == 0:
+                break
+        tokens = sum(len(toks) for _, toks in replies)
+        return replies, {
+            "host_transfers": plane.host_transfers,
+            "decode_dispatches": plane.decode_dispatches,
+            "insert_dispatches": plane.insert_dispatches,
+            "tokens": tokens,
+        }
+
+    base_replies, base_counters = evac_episode(None)
+    if sorted(r for r, _ in base_replies) != sorted(
+        f"r{i}" for i in range(6)
+    ):
+        failures.append(f"evac baseline: not exactly-once — {base_replies}")
+
+    when_reg = LifecycleRegistry(now_fn=time.perf_counter)
+    when_comms = CollectiveScheduler(lifecycle=when_reg)
+    when_replies, when_counters = evac_episode(
+        when_comms, lifecycle=when_reg,
+    )
+    if when_replies != base_replies:
+        failures.append(
+            "evac: replies differ WHEN-only comms-on (parity broken)"
+        )
+    when_cc = when_comms.counters()
+    if "routing" in when_cc:
+        failures.append(
+            "topology=None identity: WHEN-only counters grew a "
+            "routing key"
+        )
+
+    topo2 = topology_from_geometry("torus", shards=2)
+    routed_reg = LifecycleRegistry(now_fn=time.perf_counter)
+    routed_comms = CollectiveScheduler(
+        lifecycle=routed_reg, topology=topo2,
+    )
+    routed_replies, routed_counters = evac_episode(
+        routed_comms, lifecycle=routed_reg,
+    )
+    if routed_replies != base_replies:
+        failures.append(
+            "evac: replies differ topology-attached (routing changed "
+            "the math)"
+        )
+    if routed_counters != when_counters:
+        failures.append(
+            f"evac: engine odometers differ WHEN-only vs routed — "
+            f"{when_counters} vs {routed_counters}"
+        )
+    routed_cc = routed_comms.counters()
+    routing_cc = routed_cc.get("routing")
+    if routing_cc is None:
+        failures.append("evac: topology-attached counters lack routing")
+        routing_cc = {}
+    # the grouping-independent counter family must match exactly:
+    # first-hop-aware coalescing may regroup (transfer_dispatches,
+    # coalesced_ops) but routing must not invent or lose work
+    grouping_keys = ("transfer_dispatches", "coalesced_ops", "routing")
+    when_family = {
+        k: v for k, v in when_cc.items() if k not in grouping_keys
+    }
+    routed_family = {
+        k: v for k, v in routed_cc.items() if k not in grouping_keys
+    }
+    if when_family != routed_family:
+        failures.append(
+            f"counter identity: grouping-independent families differ — "
+            f"WHEN-only {when_family} vs routed {routed_family}"
+        )
+    if routing_cc.get("routed_ops", 0) < 1:
+        failures.append("evac: no op was ever routed")
+    if not routing_cc.get("link_bytes"):
+        failures.append("evac: the link ledger charged no bytes")
+
+    # route visibility: hop lists on the traces and in the exported
+    # Perfetto transfer spans
+    traces = routed_reg.done_traces() + routed_reg.open_traces()
+    stamped = sum(
+        1 for t in traces
+        if any(hops for hops in getattr(t, "routes", []))
+    )
+    if stamped < 1:
+        failures.append("routes: no lifecycle trace carries a hop list")
+    events = request_trace_events(traces, time_origin=0.0)
+    span_routes = sum(
+        1 for e in events
+        if e.get("ph") == "X" and e.get("args", {}).get("route")
+    )
+    if span_routes < 1:
+        failures.append(
+            "routes: no exported transfer span carries args.route"
+        )
+
+    snapshot = routed_comms.topology_snapshot()
+    if snapshot is None or not all(
+        key in snapshot for key in ("topology", "ledger", "routing")
+    ):
+        failures.append(
+            f"debug/topology: snapshot incomplete — "
+            f"{sorted(snapshot) if snapshot else snapshot}"
+        )
+
+    # -- battery 4 (timing): tokens per virtual second, topology-priced -
+    curve = None
+    if timing_gates:
+        curve = []
+        for shards in (1, 2, 4):
+            topo = topology_from_geometry("torus", shards=shards)
+            comms = CollectiveScheduler(topology=topo)
+            replies, counters = evac_episode(comms, shards=shards)
+            if sorted(r for r, _ in replies) != sorted(
+                f"r{i}" for i in range(6)
+            ):
+                failures.append(
+                    f"curve shards={shards}: not exactly-once"
+                )
+            cost = CostModel(topology=topo).episode_cost_s(
+                decode_dispatches=counters["decode_dispatches"],
+                insert_dispatches=counters["insert_dispatches"],
+                transfer_ops=list(comms.recent),
+            )
+            curve.append({
+                "shards": shards,
+                "tokens": counters["tokens"],
+                "virtual_cost_s": round(cost, 6),
+                "tokens_per_vs": round(counters["tokens"] / cost, 3),
+            })
+        rates = [point["tokens_per_vs"] for point in curve]
+        if any(b < a for a, b in zip(rates, rates[1:])):
+            failures.append(
+                f"curve: virtual tokens/s not monotone across shards "
+                f"1/2/4 — {rates}"
+            )
+
+    elapsed = time.perf_counter() - start
+    artifact = {
+        "suite": "routes",
+        "elapsed_s": round(elapsed, 2),
+        "topology": {
+            "kind": "torus",
+            "shards": 16,
+            "nodes": len(torus.nodes),
+            "links": len(torus.links),
+        },
+        "contended": {
+            "speedup": round(speedup, 4),
+            "when_only": when.to_dict(),
+            "routed": routed.to_dict(),
+        },
+        "disjoint": {
+            "when_only_makespan_s": dis_when.makespan,
+            "routed_makespan_s": dis_routed.makespan,
+        },
+        "evacuation": {
+            "baseline": base_counters,
+            "when_only": when_counters,
+            "routed": routed_counters,
+            "when_comms": when_cc,
+            "routed_comms": routed_cc,
+            "traces_with_routes": stamped,
+            "spans_with_routes": span_routes,
+        },
+        "debug_topology": snapshot,
+        "scaling_curve": curve,
+        "timing_gates": timing_gates,
+        "gates": {
+            "routed_speedup": ">= 1.5x modeled transfer completion vs "
+                              "WHEN-only on the contended torus episode",
+            "no_oversubscription": "every schedule passes the per-link "
+                                   "ledger audit",
+            "routing_never_hurts": "disjoint small-op battery no worse "
+                                   "routed than WHEN-only",
+            "parity": "byte-identical replies + exactly-once comms-off "
+                      "vs WHEN-only vs topology-attached; identical "
+                      "engine odometers WHEN-only vs routed",
+            "topology_none_identity": "no routing key and an unchanged "
+                                      "grouping-independent counter "
+                                      "family with topology=None",
+            "routes_visible": "hop lists on lifecycle traces, exported "
+                              "span args, and /debug/topology snapshot",
+            "monotone": "virtual tokens/s non-decreasing across shard "
+                        "counts 1/2/4 under the topology-priced cost "
+                        "model",
+        },
+    }
+    with open(output, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    if failures:
+        for line in failures:
+            print(f"routes: {line}", file=sys.stderr)
+        raise SystemExit(2)
+    return {
+        "metric": "routes_contended_speedup",
+        "value": round(speedup, 4),
+        "unit": (
+            f"x modeled transfer-completion speedup, routed vs "
+            f"WHEN-only, on the contended 16-shard torus "
+            f"(when-only {when.makespan * 1e3:.2f} ms vs routed "
+            f"{routed.makespan * 1e3:.2f} ms; "
+            f"{routing_cc.get('routed_ops', 0)} engine ops routed)"
+        ),
+        "vs_baseline": round(speedup, 4),
+    }
+
+
 if __name__ == "__main__":
     cli = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     cli.add_argument(
@@ -7157,7 +7556,7 @@ if __name__ == "__main__":
         choices=("controller", "forecast", "replay", "sweep", "chaos",
                  "serve", "fleet", "scale", "chaos-serve", "learn",
                  "tenants", "overload", "twin", "restart", "knobs",
-                 "disagg", "obs", "comms", "admission-scale"),
+                 "disagg", "obs", "comms", "admission-scale", "routes"),
         default="controller",
         help="controller = decision-throughput bench (default); forecast ="
         " reactive-vs-predictive scenario battery; replay = flight-recorder"
@@ -7221,7 +7620,17 @@ if __name__ == "__main__":
         " tokens/s under a virtual-time cost model; zero-lost /"
         " zero-duplicated through a loaded-shard kill with tombstone"
         " rehydration; >= 1 decode-phase deadline shed with an"
-        " explicit error reply; single-shard dormancy byte-identity)",
+        " explicit error reply; single-shard dormancy byte-identity);"
+        " routes = topology-aware collective routing battery (the"
+        " scheduler picks WHICH ROUTE: >= 1.5x modeled"
+        " transfer-completion speedup vs WHEN-only on a contended"
+        " 2D-torus episode; no schedule oversubscribes any link on the"
+        " virtual-time ledger; byte-identical replies + engine"
+        " odometers with routing on, byte-identical counter family"
+        " with topology=None; route hop lists on lifecycle traces +"
+        " exported Perfetto spans + /debug/topology; monotone virtual"
+        " tokens/s across shard counts under the topology-priced cost"
+        " model)",
     )
     cli.add_argument(
         "--output", default="",
@@ -7286,6 +7695,10 @@ if __name__ == "__main__":
     elif cli_args.suite == "admission-scale":
         print(json.dumps(
             run_admission_scale_suite(cli_args.output or "BENCH_r23.json")
+        ))
+    elif cli_args.suite == "routes":
+        print(json.dumps(
+            run_routes_suite(cli_args.output or "BENCH_r24.json")
         ))
     else:
         print(json.dumps(run_bench()))

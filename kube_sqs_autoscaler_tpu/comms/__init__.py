@@ -14,7 +14,14 @@ flight — instead of paying a blocking host round-trip at settle time
   dispatch per destination per cycle), the
   ``transfer_dispatches`` / ``transfer_bytes`` /
   ``overlapped_transfers_total`` counter family, lifecycle
-  ``transfer`` spans, and the ``sched/`` safety-net flush event.
+  ``transfer`` spans, and the ``sched/`` safety-net flush event;
+- :mod:`.topology` — the fleet as a link graph (ring / 2D mesh /
+  torus / host-staged two-tier builders, derived from the live
+  serving geometry) — ISSUE 20's WHICH-ROUTE half;
+- :mod:`.routing` — the route planner (disjoint-path chunking for
+  large ops, latency-minimal paths for small), the per-link
+  virtual-time ledger, and the routed-vs-WHEN-only schedule
+  simulator the routes bench gates on.
 """
 
 from .ops import (  # noqa: F401
@@ -30,10 +37,44 @@ from .ops import (  # noqa: F401
     settle_pull_op,
     size_bucket,
 )
+from .routing import (  # noqa: F401
+    PIPELINE_BYTES,
+    LinkLedger,
+    RouteChunk,
+    RoutePlan,
+    RoutePlanner,
+    ScheduleResult,
+    assert_no_oversubscription,
+    simulate_schedule,
+)
 from .scheduler import CollectiveScheduler  # noqa: F401
+from .topology import (  # noqa: F401
+    TOPOLOGY_KINDS,
+    Link,
+    Topology,
+    mesh2d_topology,
+    ring_topology,
+    topology_from_geometry,
+    two_tier_topology,
+)
 
 __all__ = [
     "CollectiveScheduler",
+    "Link",
+    "LinkLedger",
+    "PIPELINE_BYTES",
+    "RouteChunk",
+    "RoutePlan",
+    "RoutePlanner",
+    "ScheduleResult",
+    "TOPOLOGY_KINDS",
+    "Topology",
+    "assert_no_oversubscription",
+    "mesh2d_topology",
+    "ring_topology",
+    "simulate_schedule",
+    "topology_from_geometry",
+    "two_tier_topology",
     "EVACUATION_KV",
     "HANDOFF_KV",
     "PREFIX_INSTALL",

@@ -99,6 +99,10 @@ class TransferOp:
     kind: str
     destination: str
     nbytes: int
+    #: routing endpoint the bytes LEAVE — ``shard:N`` / ``prefill`` /
+    #: ``device`` — used only when a topology is attached (PR 18
+    #: producers that never set it default to host staging)
+    source: str = "host"
     #: request ids this move serves — each gets paired
     #: ``transfer``/``transfer_done`` lifecycle stamps
     rids: tuple = ()
@@ -131,6 +135,7 @@ def settle_pull_op(
     arrays: Any,
     *,
     destination: str = "host",
+    source: str = "device",
     rids: Sequence[str] = (),
     args: dict | None = None,
 ) -> TransferOp:
@@ -165,6 +170,7 @@ def settle_pull_op(
     return TransferOp(
         kind=SETTLE_PULL,
         destination=destination,
+        source=source,
         nbytes=array_nbytes(flat),
         rids=tuple(r for r in rids if r),
         dispatch=_dispatch,

@@ -109,6 +109,10 @@ class RequestTrace:
     token_times: list = field(default_factory=list)
     #: failover / audit notes: name -> count
     notes: dict = field(default_factory=dict)
+    #: per-transfer route hop lists (one entry per routed ``transfer``
+    #: stamp, in stamp order — the comms route planner appends them so
+    #: Perfetto transfer spans can carry their hops)
+    routes: list = field(default_factory=list)
     #: error replies (TTL shed, malformed, overload shed) carry the
     #: error string; a full-result reply leaves it None
     error: str | None = None
@@ -158,7 +162,7 @@ class RequestTrace:
         return max(0.0, times[-1] - times[0]) / (len(times) - 1)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "rid": self.rid,
             "flow_id": self.flow_id,
             "tenant": self.tenant,
@@ -167,6 +171,9 @@ class RequestTrace:
             "notes": dict(self.notes),
             "error": self.error,
         }
+        if self.routes:
+            out["routes"] = [list(hops) for hops in self.routes]
+        return out
 
     @classmethod
     def from_dict(cls, state: dict) -> "RequestTrace":
@@ -190,6 +197,9 @@ class RequestTrace:
         notes = state.get("notes")
         if isinstance(notes, dict):
             trace.notes = {str(k): int(v) for k, v in notes.items()}
+        for hops in state.get("routes") or ():
+            if isinstance(hops, (list, tuple)):
+                trace.routes.append(list(hops))
         return trace
 
 
@@ -434,6 +444,21 @@ class LifecycleRegistry:
             return
         trace = self._trace(rid)
         trace.notes[name] = trace.notes.get(name, 0) + 1
+
+    #: per-trace route-record bound (a trace must stay bounded against
+    #: any routed-transfer producer)
+    MAX_ROUTES = 64
+
+    def route(self, rid: str | None, hops: list) -> None:
+        """Record the hop lists the comms route planner assigned to
+        this trace's next ``transfer`` span (appended in stamp order —
+        :func:`~.trace.request_trace_events` zips them onto the paired
+        transfer windows)."""
+        if rid is None:
+            return
+        trace = self._trace(rid)
+        if len(trace.routes) < self.MAX_ROUTES:
+            trace.routes.append([list(h) for h in hops])
 
     def settle(
         self, rid: str | None, *, error: str | None = None

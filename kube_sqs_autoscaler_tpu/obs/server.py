@@ -25,7 +25,11 @@ thread serving
   traces + counters as JSON (``?n=`` limits; ``?slo=`` adds an
   ``attribution`` block naming the phase that ate each over-SLO
   request's budget), when a
-  :class:`~.lifecycle.LifecycleRegistry` is attached.
+  :class:`~.lifecycle.LifecycleRegistry` is attached;
+- ``/debug/topology`` — the comms route planner's link graph + live
+  per-link virtual-time ledger + routing odometers as JSON, when a
+  topology-attached :class:`~..comms.CollectiveScheduler` is wired
+  (``comms=``; 404 without one, like every optional endpoint).
 
 Disabled by default (``--metrics-port 0``), preserving reference behavior.
 """
@@ -65,6 +69,7 @@ class ObservabilityServer:
         unhealthy_after: float = 0.0,
         trace_sources: tuple = (),
         lifecycle=None,
+        comms=None,
     ) -> None:
         # trace_sources: objects with an ``events`` iterable of
         # (name, t, args)-shaped instants on the tick clock — e.g. a
@@ -77,11 +82,13 @@ class ObservabilityServer:
         self.ring = ring
         self.unhealthy_after = unhealthy_after
         self.lifecycle = lifecycle
+        self.comms = comms
         registry = metrics  # close over for the handler class
         tick_ring = ring
         stale_after = unhealthy_after
         sources = tuple(trace_sources)
         lifecycle_registry = lifecycle
+        comms_scheduler = comms
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -177,6 +184,20 @@ class ObservabilityServer:
                         self._requests_body(url.query),
                         "application/json",
                     )
+                elif (
+                    url.path == "/debug/topology"
+                    and comms_scheduler is not None
+                    and getattr(comms_scheduler, "topology", None)
+                    is not None
+                ):
+                    self._reply(
+                        200,
+                        json.dumps(
+                            comms_scheduler.topology_snapshot(),
+                            separators=(",", ":"),
+                        ),
+                        "application/json",
+                    )
                 else:
                     self._reply(404, "not found\n")
 
@@ -247,6 +268,9 @@ class ObservabilityServer:
             " /debug/ticks /debug/trace" if self.ring is not None else ""
         ) + (
             " /debug/requests" if self.lifecycle is not None else ""
+        ) + (
+            " /debug/topology"
+            if getattr(self.comms, "topology", None) is not None else ""
         )
         log.info("Observability endpoints on :%d (%s)", self.port, endpoints)
 

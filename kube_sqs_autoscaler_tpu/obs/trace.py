@@ -361,7 +361,14 @@ def request_trace_events(
         # while a block decodes renders exactly under the decode span
         # it hides behind — the overlap the bench gate looks for.
         transfer_tid, _ = _REQUEST_LANES["transfer"]
-        for t0, t1 in transfer_spans(trace):
+        routes = getattr(trace, "routes", None) or []
+        for index, (t0, t1) in enumerate(transfer_spans(trace)):
+            transfer_args = args
+            if index < len(routes):
+                # the comms route planner appended hop lists in stamp
+                # order — the i-th route belongs to the i-th span
+                transfer_args = dict(args)
+                transfer_args["route"] = routes[index]
             events.append({
                 "name": "transfer",
                 "cat": "request",
@@ -370,7 +377,7 @@ def request_trace_events(
                 "dur": _us(max(0.0, t1 - t0)),
                 "pid": _REQUEST_PID,
                 "tid": transfer_tid,
-                "args": args,
+                "args": transfer_args,
             })
     return events
 

@@ -406,6 +406,7 @@ class DecodePlaneBatcher(ShardedBatcher):
 
             self.comms.record(
                 HANDOFF_KV, "decode-plane",
+                source="prefill",
                 nbytes=self._row_kv_nbytes() * len(rows),
                 args={"rows": len(rows)},
             )

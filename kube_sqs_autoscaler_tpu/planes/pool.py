@@ -325,6 +325,14 @@ class DisaggregatedPool(WorkerPool):
         counter family.  Detached (the default) the shuttle keeps its
         fleet instants and nothing else changes."""
         self.comms = comms
+        topology = getattr(comms, "topology", None)
+        if topology is not None:
+            # the handoff endpoints must be routable before the first
+            # shuttle move plans a path (lazily they'd join with the
+            # same host-grade links — this just makes /debug/topology
+            # complete from the start)
+            topology.ensure_node("prefill")
+            topology.ensure_node("decode-plane")
         attach = getattr(self.decode.batcher, "attach_comms", None)
         if attach is not None:
             attach(comms)

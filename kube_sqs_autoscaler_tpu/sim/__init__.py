@@ -43,6 +43,12 @@ from .scenarios import (
     scenario_variants,
     variant_bounds,
 )
+from .costmodel import (
+    DECODE_COST_S,
+    INSERT_COST_S,
+    TRANSFER_COST_S,
+    CostModel,
+)
 from .simulator import SimConfig, SimResult, Simulation
 
 # NOTE: .twin (the token-level serving twin) is also not imported here —
@@ -50,6 +56,10 @@ from .simulator import SimConfig, SimResult, Simulation
 # explicitly.
 
 __all__ = [
+    "CostModel",
+    "DECODE_COST_S",
+    "INSERT_COST_S",
+    "TRANSFER_COST_S",
     "SimConfig",
     "SimResult",
     "Simulation",

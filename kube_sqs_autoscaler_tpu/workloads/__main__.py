@@ -118,6 +118,21 @@ def main(argv=None) -> None:
              "each device holds whole shards)",
     )
     parser.add_argument(
+        "--topology", default="", metavar="SHAPE",
+        choices=("", "ring", "mesh2d", "torus", "two-tier"),
+        help="topology-aware collective routing: model the fleet as a "
+             "link graph of this shape (ring, mesh2d, torus, or "
+             "two-tier ICI-islands-over-DCN), derived from the live "
+             "--shards/--model-parallel geometry, and attach a "
+             "route-planning CollectiveScheduler — transfers get "
+             "concrete multi-hop routes (large KV moves chunked "
+             "across link-disjoint paths), dispatch order respects a "
+             "per-link virtual-time ledger, and /metrics gains "
+             "link_bytes_total/link_utilization plus a "
+             "/debug/topology endpoint (default: off — the WHEN-only "
+             "scheduler, byte-identical; requires --continuous)",
+    )
+    parser.add_argument(
         "--tenants", default="", metavar="NAME,NAME,...",
         help="multi-tenant fair admission: per-tenant sub-queues feed "
              "the continuous batcher through deficit-round-robin "
@@ -399,6 +414,9 @@ def main(argv=None) -> None:
         raise SystemExit("--request-ttl requires --continuous")
     if args.shards < 1:
         raise SystemExit(f"--shards {args.shards} must be >= 1")
+    if args.topology and not args.continuous:
+        # args-only check, same convention as --decode-block above
+        raise SystemExit("--topology requires --continuous")
     # --speculative-draft-layers with --shards or --tenants routes to
     # the decode-plane engine (planes/engine.py): draft-and-verify
     # rounds gang-step over the whole [S, B] plane, so these
@@ -1280,8 +1298,9 @@ def main(argv=None) -> None:
                 length_penalty=args.length_penalty,
                 tenancy=tenancy,
             )
+            comms = _maybe_attach_topology(args, cworker)
             obs = _maybe_serve_metrics(args.metrics_port, cworker,
-                                       tenancy=tenancy)
+                                       tenancy=tenancy, comms=comms)
             start = time.perf_counter()
             cworker.drain(total=args.demo)
             elapsed = time.perf_counter() - start
@@ -1338,7 +1357,9 @@ def main(argv=None) -> None:
             length_penalty=args.length_penalty,
             tenancy=tenancy,
         )
-        _maybe_serve_metrics(args.metrics_port, cworker, tenancy=tenancy)
+        comms = _maybe_attach_topology(args, cworker)
+        _maybe_serve_metrics(args.metrics_port, cworker, tenancy=tenancy,
+                             comms=comms)
         log.info("Starting continuous worker on %s", args.sqs_queue_url)
         cworker.run_forever()
         return
@@ -1403,13 +1424,41 @@ def _fleet_journal_meta(args, tenancy, knob_names=()) -> dict:
     }
 
 
-def _maybe_serve_metrics(port: int, worker, tenancy=None):
+def _maybe_attach_topology(args, cworker):
+    """Build the ``--topology`` route-planning CollectiveScheduler
+    over the live ``--shards``/``--model-parallel`` geometry and wire
+    it through the worker's engine (None when the flag is off — the
+    WHEN-only byte-identical path)."""
+    if not args.topology:
+        return None
+    from ..comms import CollectiveScheduler, topology_from_geometry
+
+    topology = topology_from_geometry(
+        args.topology,
+        shards=args.shards,
+        model_parallel=args.model_parallel or 1,
+    )
+    comms = CollectiveScheduler(
+        lifecycle=getattr(cworker, "lifecycle", None),
+        topology=topology,
+    )
+    cworker.batcher.attach_comms(comms)
+    logging.getLogger("worker").info(
+        "Topology-aware routing on: %s (%d nodes, %d links)",
+        args.topology, len(topology.nodes), len(topology.links),
+    )
+    return comms
+
+
+def _maybe_serve_metrics(port: int, worker, tenancy=None, comms=None):
     """Start /metrics with the worker's serve-cycle SpanTimer attached
     (``--metrics-port 0`` = disabled).  Continuous workers additionally
     publish the serving gauges (tokens/s, time-to-first-token, active
     slots, decode-block utilization), refreshed every engine cycle;
     tenancy-enabled workers the per-tenant families and a build_info
-    stamp naming the tenancy deployment knobs."""
+    stamp naming the tenancy deployment knobs.  A topology-attached
+    comms scheduler enables /debug/topology and the per-link gauge
+    families."""
     if not port:
         return None
     from .. import __version__
@@ -1431,7 +1480,7 @@ def _maybe_serve_metrics(port: int, worker, tenancy=None):
         )
     if hasattr(worker, "attach_metrics"):
         worker.attach_metrics(metrics)
-    server = ObservabilityServer(metrics, port=port)
+    server = ObservabilityServer(metrics, port=port, comms=comms)
     server.start()
     return server
 

@@ -1185,6 +1185,7 @@ class ContinuousBatcher:
             op = comms.settle_pull(
                 arr,
                 destination="host",
+                source=self._comms_source(rows),
                 rids=[r for r in rids if r is not None],
                 args={"rows": list(rows)},
             )
@@ -1199,10 +1200,19 @@ class ContinuousBatcher:
                 ]
                 self._block_op = comms.settle_pull(
                     arrs, destination="host",
+                    source=self._comms_source(None),
                     rids=[r for r in rids if r is not None],
                     args={"block": True},
                 )
         comms.flush(overlapped=overlapped)
+
+    def _comms_source(self, rows) -> str:
+        """The routing endpoint a settle pull leaves from — the
+        topology node whose links the route planner charges.  The flat
+        engine is one device (``rows`` unused); the sharded plane
+        overrides this to attribute single-shard pulls to their shard
+        (a gang-wide pull stays ``device``)."""
+        return "device"
 
     def _block_settle_arrays(self):
         """The in-flight block's device arrays its settle will fetch
@@ -3539,6 +3549,13 @@ class ContinuousWorker:
                 if batcher.block_capacity else 0.0
             ),
         )
+        comms = getattr(batcher, "comms", None)
+        if comms is not None:
+            export = getattr(comms, "export_gauges", None)
+            if export is not None:
+                # per-link routing gauges (topology-attached comms
+                # only) refresh on the same cadence as the serving set
+                export(self.metrics)
         shed_help = (
             "Requests shed or degraded at admission, by reason: ttl = "
             "older than --request-ttl on arrival (explicit expired "

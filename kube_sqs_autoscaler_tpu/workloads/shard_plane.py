@@ -373,6 +373,19 @@ class ShardedBatcher(ContinuousBatcher):
             self.slots[row] = _Slot()
             killed.append(row)
         self.kill_rows(killed)
+        evac_op = None
+        if killed and self.comms is not None and self.comms.enabled:
+            from ..comms.ops import EVACUATION_KV
+
+            # the rows LEAVE the draining shard for host staging — the
+            # (source, destination) pair the route planner charges the
+            # fabric for; the shard label rides in args either way
+            evac_op = self.comms.record(
+                EVACUATION_KV, "host",
+                source=f"shard:{shard}",
+                nbytes=self._row_kv_nbytes() * len(killed),
+                args={"shard": shard, "rows": len(killed)},
+            )
         if killed and self.lifecycle is not None:
             # the evacuation IS a transfer: the rows' deferred tokens
             # flushed host-side and their KV abandoned — a paired
@@ -380,20 +393,20 @@ class ShardedBatcher(ContinuousBatcher):
             # can name a transfer-bound request (not just the fleet's
             # shard-drain instant)
             done_t = self.lifecycle.now_fn()
+            route = (
+                evac_op.args.get("route") if evac_op is not None else None
+            )
             for rid in rids:
                 if rid is None:
                     continue
+                if route is not None:
+                    # the evacuation hops ride THIS span: append before
+                    # stamping so each trace's i-th route stays zipped
+                    # onto its i-th transfer span
+                    self.lifecycle.route(rid, route)
                 self.lifecycle.stamp(rid, "transfer", t=evac_t0)
                 self.lifecycle.stamp(rid, "transfer_done", t=done_t)
                 self.lifecycle.note(rid, "transfer_evacuation_kv")
-        if killed and self.comms is not None and self.comms.enabled:
-            from ..comms.ops import EVACUATION_KV
-
-            self.comms.record(
-                EVACUATION_KV, f"shard:{shard}",
-                nbytes=self._row_kv_nbytes() * len(killed),
-                args={"shard": shard, "rows": len(killed)},
-            )
         return taken
 
     def clear_shard_health(self, shard: int) -> None:
@@ -617,6 +630,16 @@ class ShardedBatcher(ContinuousBatcher):
         if self._pending_block is None:
             return None
         return self._pending_block[:4]
+
+    def _comms_source(self, rows) -> str:
+        # settle pulls covering exactly one shard's rows route from
+        # that shard; the gang-wide combined block pull (rows=None or
+        # spanning shards) stays the generic device endpoint
+        if rows:
+            shards = {row // self.shard_slots for row in rows}
+            if len(shards) == 1:
+                return f"shard:{shards.pop()}"
+        return super()._comms_source(rows)
 
     def _step_gang(self) -> list[tuple[Any, np.ndarray]]:
         new_block = None

@@ -1,0 +1,515 @@
+"""Topology-aware collective routing (comms/topology, comms/routing):
+the link-graph builders, the route planner's size regimes, the
+per-link virtual-time ledger's no-oversubscription contract, the
+scheduler's routed dispatch order + coalescer seam fix, and the
+topology=None byte-identity battery on the real engine.
+"""
+
+import json
+import time
+
+import pytest
+
+np = pytest.importorskip("numpy")
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_sqs_autoscaler_tpu.comms import (  # noqa: E402
+    EVACUATION_KV,
+    SETTLE_PULL,
+    SMALL_OP_BYTES,
+    CollectiveScheduler,
+    RoutePlanner,
+    TransferOp,
+    assert_no_oversubscription,
+    ring_topology,
+    simulate_schedule,
+    topology_from_geometry,
+    two_tier_topology,
+)
+from kube_sqs_autoscaler_tpu.obs.lifecycle import (  # noqa: E402
+    LifecycleRegistry,
+)
+from kube_sqs_autoscaler_tpu.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+
+PROMPT, TOKENS, BLOCK = 8, 5, 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=PROMPT + TOKENS, dtype=jnp.float32,
+    )
+    return init_params(jax.random.key(0), config), config
+
+
+def prompts_for(n, seed=7, vocab=64):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, vocab, rng.integers(2, PROMPT + 1))
+        .astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Topology builders
+# ---------------------------------------------------------------------------
+
+
+def test_ring_topology_shape():
+    topo = ring_topology(8)
+    assert topo.kind == "ring"
+    assert topo.nodes == sorted(
+        [f"shard:{i}" for i in range(8)] + ["host"]
+    )
+    # 8 bidirectional ring edges + 2 gateway uplinks (shards 0 and 4)
+    assert len(topo.links) == 16 + 4
+    assert topo.link("shard:0", "host") is not None
+    assert topo.link("shard:4", "host") is not None
+    assert topo.link("shard:1", "host") is None
+
+
+def test_torus_topology_shape_and_paths():
+    topo = topology_from_geometry("torus", shards=16)
+    assert topo.kind == "torus"
+    assert len(topo.nodes) == 17
+    # every shard has degree 4 on the 4x4 torus + 2 gateway uplinks
+    assert len(topo.links) == 16 * 4 + 4
+    path = topo.shortest_path("shard:15", "host")
+    assert len(path) == 3 and path[-1].dst == "host"
+    # exactly as many edge-disjoint routes into staging as gateways
+    paths = topo.disjoint_paths("shard:1", "host", k=4)
+    assert len(paths) == 2
+    gateways = {p[-1].src for p in paths}
+    assert gateways == {"shard:0", "shard:8"}
+
+
+def test_small_torus_does_not_double_wrap():
+    # a 2-wide axis must not wrap (the wrap edge would duplicate the
+    # mesh edge); shards=2 factors to 1x2
+    topo = topology_from_geometry("torus", shards=2)
+    assert len(topo.nodes) == 3
+    assert len(topo.links) == 2 + 2  # one ICI pair + one gateway pair
+
+
+def test_two_tier_topology_bridges_over_host():
+    topo = two_tier_topology(2, 4)
+    assert topo.kind == "two-tier"
+    # island rings (8 directed each) + one DCN gateway pair per island
+    assert len(topo.links) == 16 + 4
+    path = topo.shortest_path("shard:1", "shard:5")
+    names = [link.name for link in path]
+    assert "host" in {link.src for link in path} | {
+        link.dst for link in path
+    }, names
+
+
+def test_ensure_node_wires_unknown_endpoints_to_host():
+    topo = ring_topology(4)
+    assert topo.shortest_path("prefill", "decode-plane") is not None
+    assert topo.link("prefill", "host") is not None
+
+
+def test_topology_from_geometry_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        topology_from_geometry("hypercube", shards=4)
+
+
+# ---------------------------------------------------------------------------
+# Route planner: size regimes
+# ---------------------------------------------------------------------------
+
+
+def test_planner_small_op_takes_single_latency_minimal_path():
+    topo = topology_from_geometry("torus", shards=16)
+    planner = RoutePlanner(topo)
+    plan = planner.plan("shard:5", "host", 1024)
+    assert len(plan.chunks) == 1
+    assert plan.chunks[0].nbytes == 1024
+    assert len(plan.chunks[0].path) == 3  # two ICI hops + the uplink
+
+
+def test_planner_large_op_chunks_across_disjoint_paths():
+    topo = topology_from_geometry("torus", shards=16)
+    planner = RoutePlanner(topo)
+    nbytes = 8 << 20
+    plan = planner.plan("shard:1", "host", nbytes)
+    assert sum(c.nbytes for c in plan.chunks) == nbytes
+    assert len(plan.paths) == 2  # both gateways used
+    # pipelined: no chunk exceeds the pipeline grain
+    assert max(c.nbytes for c in plan.chunks) <= planner.pipeline_bytes
+    assert len(plan.chunks) >= 8  # 8 MiB / 1 MiB grain
+
+
+def test_planner_local_and_first_hop():
+    topo = ring_topology(4)
+    planner = RoutePlanner(topo)
+    assert planner.plan("host", "host", 4096).local
+    assert planner.first_hop("host", "host", 4096) is None
+    assert planner.first_hop("shard:1", "host", 64) in (
+        "shard:1->shard:0", "shard:1->shard:2",
+    )
+
+
+# ---------------------------------------------------------------------------
+# The ledger: no schedule oversubscribes a link (property test)
+# ---------------------------------------------------------------------------
+
+
+def test_no_schedule_oversubscribes_any_link():
+    topo = topology_from_geometry("torus", shards=16)
+    topo.ensure_node("prefill")
+    topo.ensure_node("decode-plane")
+    endpoints = (
+        [f"shard:{i}" for i in range(16)]
+        + ["host", "prefill", "decode-plane"]
+    )
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        ops = []
+        for _ in range(24):
+            src, dst = rng.choice(endpoints, size=2, replace=False)
+            ops.append({
+                "kind": EVACUATION_KV, "source": str(src),
+                "destination": str(dst),
+                "nbytes": int(rng.integers(1 << 10, 16 << 20)),
+            })
+        for routed in (True, False):
+            result = simulate_schedule(ops, topo, routed=routed)
+            assert_no_oversubscription(result.ledger)
+            for op in result.ops:
+                assert op["finish_s"] >= op["start_s"] >= 0.0
+            assert result.makespan == max(
+                op["finish_s"] for op in result.ops
+            )
+
+
+def test_contended_torus_routed_beats_when_only():
+    # the BENCH_r24 gate episode, pinned deterministically: sources
+    # proximal to gateway 0 funnel through one uplink WHEN-only, while
+    # routing chunks across both gateways
+    topo = topology_from_geometry("torus", shards=16)
+    ops = [
+        {"kind": EVACUATION_KV, "source": f"shard:{s}",
+         "destination": "host", "nbytes": 8 << 20}
+        for s in (1, 2, 3, 4, 5, 13)
+    ]
+    when = simulate_schedule(ops, topo, routed=False)
+    routed = simulate_schedule(ops, topo, routed=True)
+    assert when.makespan / routed.makespan >= 1.5
+    # the schedule exports hop lists and per-link utilization
+    assert all(op["hops"] for op in routed.ops)
+    assert routed.link_utilization["shard:0->host"] > 0.5
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: the coalescer seam fix (applies with AND without routing)
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_group_seals_at_small_bytes_threshold():
+    comms = CollectiveScheduler()
+    for _ in range(5):
+        comms.submit(TransferOp(SETTLE_PULL, "host", 20480))
+    # 3 x 20 KiB = 60 KiB fits under the 64 KiB threshold; the 4th op
+    # would cross it, sealing the group: 2 dispatches, all 5 coalesced
+    assert comms.flush() == 2
+    cc = comms.counters()
+    assert cc["transfer_dispatches"] == 2
+    assert cc["coalesced_ops"] == 5
+    assert cc["dispatched_ops"] == 5
+
+
+def test_single_small_op_still_one_dispatch():
+    comms = CollectiveScheduler()
+    comms.submit(TransferOp(SETTLE_PULL, "host", SMALL_OP_BYTES))
+    assert comms.flush() == 1
+    assert comms.counters()["coalesced_ops"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: routed dispatch + route stamps
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_routes_flushed_and_recorded_ops():
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    topo = topology_from_geometry("torus", shards=16)
+    comms = CollectiveScheduler(lifecycle=reg, topology=topo)
+    reg.arrival("rA")
+    comms.submit(TransferOp(
+        EVACUATION_KV, "host", 8 << 20,
+        source="shard:1", rids=("rA",),
+    ))
+    comms.flush()
+    reg.arrival("rB")
+    comms.record(
+        EVACUATION_KV, "host", 4 << 20,
+        source="shard:5", rids=("rB",),
+    )
+    cc = comms.counters()
+    assert cc["routing"]["routed_ops"] == 2
+    assert cc["routing"]["route_chunks"] >= 12
+    assert cc["routing"]["link_bytes"]["shard:0->host"] > 0
+    assert_no_oversubscription(comms.ledger)
+    # both traces carry their op's hop lists, zipped onto the spans
+    for rid in ("rA", "rB"):
+        (trace,) = [t for t in reg.open_traces() if t.rid == rid]
+        assert trace.routes and trace.routes[0]
+    # sequential flushes never falsely overlap: virtual now advanced
+    assert comms.vt_now > 0
+
+
+def test_scheduler_local_moves_route_as_empty():
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    comms = CollectiveScheduler(
+        lifecycle=reg, topology=ring_topology(2),
+    )
+    reg.arrival("rL")
+    comms.record(SETTLE_PULL, "host", 512, source="host", rids=("rL",))
+    assert comms.counters()["routing"]["local_ops"] == 1
+    (trace,) = [t for t in reg.open_traces() if t.rid == "rL"]
+    assert trace.routes == [[]]  # alignment entry, no hops
+
+
+def test_topology_none_counters_have_no_routing_key():
+    comms = CollectiveScheduler()
+    comms.submit(TransferOp(SETTLE_PULL, "host", 64))
+    comms.flush()
+    cc = comms.counters()
+    assert "routing" not in cc
+    assert comms.topology_snapshot() is None
+    op = TransferOp(SETTLE_PULL, "host", 64, source="shard:1")
+    assert comms._coalesce_key(op) == op.coalesce_key()
+
+
+def test_export_gauges_emits_per_link_series():
+    from kube_sqs_autoscaler_tpu.obs.prometheus import WorkloadMetrics
+
+    comms = CollectiveScheduler(topology=ring_topology(4))
+    comms.record(EVACUATION_KV, "host", 1 << 20, source="shard:1")
+    metrics = WorkloadMetrics()
+    comms.export_gauges(metrics)
+    body = metrics.render()
+    assert 'link_bytes_total{link="shard:1->shard:0"}' in body
+    assert "link_utilization{" in body
+    # no topology, no phantom series
+    bare = WorkloadMetrics()
+    CollectiveScheduler().export_gauges(bare)
+    assert "link_bytes_total" not in bare.render()
+
+
+# ---------------------------------------------------------------------------
+# /debug/topology endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_debug_topology_endpoint_serves_snapshot():
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    comms = CollectiveScheduler(topology=ring_topology(4))
+    comms.record(EVACUATION_KV, "host", 2 << 20, source="shard:2")
+    server = ObservabilityServer(
+        ControllerMetrics(), host="127.0.0.1", port=0, comms=comms,
+    )
+    server.start()
+    try:
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/topology"
+            ).read().decode()
+        )
+        assert body["topology"]["kind"] == "ring"
+        assert body["routing"]["routed_ops"] == 1
+        assert body["ledger"]["link_bytes"]
+    finally:
+        server.stop()
+
+
+def test_debug_topology_404_without_a_topology():
+    import urllib.error
+    import urllib.request
+
+    from kube_sqs_autoscaler_tpu.obs.prometheus import ControllerMetrics
+    from kube_sqs_autoscaler_tpu.obs.server import ObservabilityServer
+
+    server = ObservabilityServer(
+        ControllerMetrics(), host="127.0.0.1", port=0,
+        comms=CollectiveScheduler(),
+    )
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/topology"
+            )
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# The real engine: topology=None byte-identity at shards 1/2/4
+# ---------------------------------------------------------------------------
+
+
+def run_evac_episode(tiny, comms, *, lifecycle=None, shards=2):
+    from kube_sqs_autoscaler_tpu.workloads.shard_plane import (
+        ShardedBatcher,
+    )
+
+    params, config = tiny
+    plane = ShardedBatcher(
+        params, config, shards=shards, shard_slots=2,
+        prompt_len=PROMPT, generate_tokens=TOKENS, decode_block=BLOCK,
+    )
+    plane.lifecycle = lifecycle
+    if comms is not None:
+        plane.attach_comms(comms)
+    prompts = prompts_for(6)
+    queue = [(ids, {"MessageId": f"r{i}"})
+             for i, ids in enumerate(prompts)]
+    replies = []
+
+    def fill():
+        n = min(len(queue), len(plane.free_slots))
+        if n:
+            if lifecycle is not None:
+                for _, payload in queue[:n]:
+                    lifecycle.arrival(payload["MessageId"])
+            plane.submit_many(queue[:n])
+            del queue[:n]
+
+    def collect(finished):
+        for payload, toks in finished:
+            replies.append(
+                (payload["MessageId"], tuple(int(t) for t in toks))
+            )
+            if lifecycle is not None:
+                lifecycle.settle(payload["MessageId"])
+
+    fill()
+    collect(plane.step())
+    collect(plane.step())
+    evacuated = plane.take_shard_inflight(shards - 1)
+    resumes = [
+        (prompts[int(p["MessageId"][1:])], p, produced, budget, t)
+        for p, produced, budget, t in evacuated
+    ]
+    for _ in range(600):
+        fill()
+        if resumes and plane.free_slots:
+            n = min(len(resumes), len(plane.free_slots))
+            admitted = plane.submit_resume(resumes[:n])
+            del resumes[:len(admitted)]
+        collect(plane.step())
+        if not queue and not resumes and plane.active == 0:
+            break
+    return replies, {
+        "host_transfers": plane.host_transfers,
+        "decode_dispatches": plane.decode_dispatches,
+        "insert_dispatches": plane.insert_dispatches,
+    }
+
+
+@pytest.mark.parametrize("shards", (1, 2, 4))
+def test_topology_none_byte_identity_on_engine(tiny, shards):
+    base_replies, base_counters = run_evac_episode(tiny, None,
+                                                  shards=shards)
+    assert sorted(r for r, _ in base_replies) == sorted(
+        f"r{i}" for i in range(6)
+    )
+    when_comms = CollectiveScheduler()
+    when_replies, when_counters = run_evac_episode(
+        tiny, when_comms, shards=shards,
+    )
+    assert when_replies == base_replies
+    when_cc = when_comms.counters()
+    assert "routing" not in when_cc
+
+    routed_comms = CollectiveScheduler(
+        topology=topology_from_geometry("torus", shards=shards),
+    )
+    routed_replies, routed_counters = run_evac_episode(
+        tiny, routed_comms, shards=shards,
+    )
+    # routing changes the MODEL, never the math or the engine work
+    assert routed_replies == base_replies
+    assert routed_counters == when_counters
+    routed_cc = routed_comms.counters()
+    assert routed_cc["routing"]["routed_ops"] >= 1
+    assert_no_oversubscription(routed_comms.ledger)
+    # the grouping-independent counter family is byte-identical;
+    # only the coalesce grouping (first-hop-aware keys) may differ
+    varying = ("transfer_dispatches", "coalesced_ops", "routing")
+    assert {
+        k: v for k, v in when_cc.items() if k not in varying
+    } == {
+        k: v for k, v in routed_cc.items() if k not in varying
+    }
+
+
+def test_routes_appear_in_exported_span_args(tiny):
+    from kube_sqs_autoscaler_tpu.obs.trace import request_trace_events
+
+    reg = LifecycleRegistry(now_fn=time.perf_counter)
+    comms = CollectiveScheduler(
+        lifecycle=reg,
+        topology=topology_from_geometry("torus", shards=2),
+    )
+    run_evac_episode(tiny, comms, lifecycle=reg, shards=2)
+    traces = reg.done_traces() + reg.open_traces()
+    assert any(
+        any(hops for hops in getattr(t, "routes", []))
+        for t in traces
+    )
+    events = request_trace_events(traces, time_origin=0.0)
+    routed_spans = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("args", {}).get("route")
+    ]
+    assert routed_spans
+    # hops are link names, multi-hop across the gateway
+    assert all(
+        "->" in hop
+        for e in routed_spans for path in e["args"]["route"]
+        for hop in path
+    )
+
+
+# ---------------------------------------------------------------------------
+# The routes bench: tier-1 smoke (timing gates off), full battery slow
+# ---------------------------------------------------------------------------
+
+
+def test_routes_bench_smoke(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_routes.json"
+    summary = bench.run_routes_suite(str(out), timing_gates=False)
+    assert summary["metric"] == "routes_contended_speedup"
+    assert summary["value"] >= 1.5
+    artifact = json.loads(out.read_text())
+    assert artifact["suite"] == "routes"
+    assert artifact["contended"]["speedup"] >= 1.5
+    assert artifact["evacuation"]["spans_with_routes"] >= 1
+    assert artifact["scaling_curve"] is None  # timing battery slow-tier
+
+
+@pytest.mark.slow
+def test_routes_bench_full_battery(tmp_path):
+    import bench
+
+    out = tmp_path / "BENCH_routes_full.json"
+    bench.run_routes_suite(str(out))
+    artifact = json.loads(out.read_text())
+    rates = [p["tokens_per_vs"] for p in artifact["scaling_curve"]]
+    assert rates == sorted(rates)

@@ -585,6 +585,83 @@ def bench_comms_handoff(
     }
 
 
+def bench_routes_contended(op_bytes: int = 8 << 20) -> dict:
+    """Modeled transfer-completion of the contended 16-shard-torus
+    evacuation episode (the BENCH_r24 gate shape): WHEN-only dispatch
+    (FIFO, single shortest path) vs topology-aware routing (chunked
+    link-disjoint paths, greedy earliest-first-link order).  Virtual
+    time under the modeled link constants — the number is a RATIO, not
+    wall seconds; the entry to re-measure on a real pod is the link
+    grades themselves (ICI/DCN/host bandwidth+latency) feeding the
+    same planner."""
+    from kube_sqs_autoscaler_tpu.comms import (
+        simulate_schedule,
+        topology_from_geometry,
+    )
+    from kube_sqs_autoscaler_tpu.comms.ops import (
+        EVACUATION_KV,
+        HANDOFF_KV,
+    )
+
+    topo = topology_from_geometry("torus", shards=16)
+    for node in ("prefill", "decode-plane"):
+        topo.ensure_node(node)
+    ops = [
+        {"kind": EVACUATION_KV, "source": f"shard:{s}",
+         "destination": "host", "nbytes": op_bytes}
+        for s in (1, 2, 3, 4, 5, 13)
+    ] + [
+        {"kind": HANDOFF_KV, "source": "prefill",
+         "destination": "decode-plane", "nbytes": op_bytes},
+    ]
+    t0 = time.perf_counter()
+    when = simulate_schedule(ops, topo, routed=False)
+    routed = simulate_schedule(ops, topo, routed=True)
+    plan_s = time.perf_counter() - t0
+    return {
+        "when_only_makespan_ms": when.makespan * 1e3,
+        "routed_makespan_ms": routed.makespan * 1e3,
+        "speedup": when.makespan / routed.makespan,
+        "planning_wall_s": plan_s,
+        "ops": len(ops),
+        "op_bytes": op_bytes,
+        "max_link_utilization": max(
+            routed.link_utilization.values(), default=0.0
+        ),
+    }
+
+
+def bench_routes_disjoint(op_bytes: int = 8 << 20) -> dict:
+    """The contention-free counterpart: large transfers between
+    link-disjoint neighbor pairs on the 16-shard torus, WHEN-only vs
+    routed.  With no shared bottleneck there is little for route
+    choice to win (the direct link is already the bandwidth-optimal
+    path), so the ratio here brackets the contended entry — together
+    they show the speedup comes from ROUTING AROUND CONTENTION, not
+    from the chunking alone."""
+    from kube_sqs_autoscaler_tpu.comms import (
+        simulate_schedule,
+        topology_from_geometry,
+    )
+    from kube_sqs_autoscaler_tpu.comms.ops import EVACUATION_KV
+
+    topo = topology_from_geometry("torus", shards=16)
+    ops = [
+        {"kind": EVACUATION_KV, "source": f"shard:{a}",
+         "destination": f"shard:{b}", "nbytes": op_bytes}
+        for a, b in ((1, 2), (5, 6), (9, 10), (13, 14))
+    ]
+    when = simulate_schedule(ops, topo, routed=False)
+    routed = simulate_schedule(ops, topo, routed=True)
+    return {
+        "when_only_makespan_ms": when.makespan * 1e3,
+        "routed_makespan_ms": routed.makespan * 1e3,
+        "speedup": when.makespan / routed.makespan,
+        "ops": len(ops),
+        "op_bytes": op_bytes,
+    }
+
+
 def bench_kv_cache(num_tokens: int = 64) -> dict:
     """Greedy decode tokens/s: bf16 KV cache vs the int8 cache
     (identical sampling path; decode streams the whole cache every
@@ -750,7 +827,7 @@ def main(argv=None) -> dict:
         + [f"ring_local_s{s}" for s in (4096, 8192)]
         + ["window_s8192", "speculative", "kv_cache_int8", "weight_int8",
            "prefix_cache", "continuous_speculative", "comms_overlap",
-           "comms_handoff"]
+           "comms_handoff", "routes_contended", "routes_disjoint"]
     )
     if args.only is not None:
         unknown = sorted(set(args.only) - set(known_entries))
@@ -816,6 +893,10 @@ def main(argv=None) -> dict:
         record("comms_overlap", bench_comms_overlap())
     if want("comms_handoff"):
         record("comms_handoff", bench_comms_handoff())
+    if want("routes_contended"):
+        record("routes_contended", bench_routes_contended())
+    if want("routes_disjoint"):
+        record("routes_disjoint", bench_routes_disjoint())
     if args.only is not None:
         for name in ran:
             results[name] = {**results[name], **run_meta}
@@ -880,6 +961,12 @@ def main(argv=None) -> dict:
     if "comms_handoff" in report:
         metrics.append(("comms_handoff_gather_speedup",
                         report["comms_handoff"]["speedup"], "x"))
+    if "routes_contended" in report:
+        metrics.append(("routes_contended_speedup",
+                        report["routes_contended"]["speedup"], "x"))
+    if "routes_disjoint" in report:
+        metrics.append(("routes_disjoint_speedup",
+                        report["routes_disjoint"]["speedup"], "x"))
     for name, value, unit in metrics:
         print(json.dumps({
             "metric": name,
